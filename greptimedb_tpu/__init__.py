@@ -1,0 +1,28 @@
+"""greptimedb_tpu — a TPU-native observability database framework.
+
+A from-scratch build with the capability surface of GreptimeDB (the Rust
+reference at /root/reference): metrics/logs/traces stored in an LSM engine
+(WAL -> memtable -> Parquet SSTs, manifest-checkpointed), queried via SQL and
+PromQL over an Arrow-columnar engine, scaled out as frontend/datanode/metasrv
+roles.  The differentiator: the scan->filter->time-bucketed-aggregate hot path
+lowers to JAX/XLA/Pallas kernels on TPU, with partial aggregates merged via
+psum over ICI (the TPU-native equivalent of the reference's MergeScan
+datanode-partial / frontend-final split, see
+reference query/src/dist_plan/merge_scan.rs and commutativity.rs).
+
+Layout mirrors the reference's layer map (SURVEY.md section 1):
+  utils/      L0 foundation: errors, config, metrics, tracing
+  datatypes/  L0 type system (ConcreteDataType/Schema/vectors over Arrow)
+  storage/    L1/L2 storage substrate + region engine (WAL, memtable, SST,
+              manifest, flush, compaction)
+  models/     table/catalog data model + region routing (metadata plane)
+  query/      L5 query engine: SQL + PromQL front doors, logical plans,
+              CPU executor (authoritative) and the TPU physical planner
+  ops/        JAX/Pallas kernels: tiling, predicate masks, segmented
+              aggregates, rate/increase, topk
+  parallel/   mesh + shard_map distributed execution (ICI collectives)
+  distributed/ metasrv-style coordination: KV backend, heartbeats, procedures
+  servers/    protocol front-ends (HTTP line-protocol/SQL/PromQL endpoints)
+"""
+
+__version__ = "0.1.0"

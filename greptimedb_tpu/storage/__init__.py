@@ -1,0 +1,3 @@
+from .engine import TimeSeriesEngine
+
+__all__ = ["TimeSeriesEngine"]

@@ -1,0 +1,54 @@
+"""Write buffer accounting + flush policy.
+
+Role-equivalent of the reference's `WriteBufferManagerImpl`
+(reference src/mito2/src/flush.rs:107): tracks global mutable memtable
+memory, decides when the engine should flush (`should_flush_engine`,
+flush.rs:152) and when writes must stall (`should_stall`, flush.rs:173).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class WriteBufferManager:
+    def __init__(self, global_limit_bytes: int, region_limit_bytes: int):
+        self.global_limit = global_limit_bytes
+        self.region_limit = region_limit_bytes
+        self._mutable: dict[int, int] = {}  # region_id -> bytes
+        self._lock = threading.Lock()
+
+    def set_region_usage(self, region_id: int, bytes_: int):
+        with self._lock:
+            self._mutable[region_id] = bytes_
+
+    def remove_region(self, region_id: int):
+        with self._lock:
+            self._mutable.pop(region_id, None)
+
+    def mutable_usage(self) -> int:
+        with self._lock:
+            return sum(self._mutable.values())
+
+    def region_usage(self, region_id: int) -> int:
+        with self._lock:
+            return self._mutable.get(region_id, 0)
+
+    def should_flush_region(self, region_id: int) -> bool:
+        return self.region_usage(region_id) >= self.region_limit
+
+    def should_flush_engine(self) -> bool:
+        # Reference flushes when global mutable usage crosses 7/8 of limit.
+        return self.mutable_usage() >= self.global_limit * 7 // 8
+
+    def should_stall(self) -> bool:
+        return self.mutable_usage() >= self.global_limit
+
+    def pick_flush_candidates(self) -> list[int]:
+        """Regions to flush, largest first (greedy pressure relief)."""
+        with self._lock:
+            return [
+                rid
+                for rid, b in sorted(self._mutable.items(), key=lambda kv: -kv[1])
+                if b > 0
+            ]

@@ -1,0 +1,157 @@
+"""Time-partitioned columnar memtable.
+
+Role-equivalent of the reference's memtables (reference
+src/mito2/src/memtable/): buffered writes live in memory until flush.  The
+reference keeps three builders (partition-tree, per-series, bulk); we keep a
+single append-mode columnar memtable partitioned by time window (the
+reference's `time_partition.rs` behavior), with last-write-wins dedup applied
+on read/flush by a stable sort over (primary key, time index, sequence).
+This matches the reference's `DedupReader` last-row semantics
+(mito2/src/read/dedup.rs) while keeping ingestion append-only — the shape
+that flushes to TPU-friendly columnar tiles.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..datatypes.schema import Schema
+
+_SEQ_COL = "__seq"
+
+
+def _partition_starts(ts: np.ndarray, window_ms: int) -> np.ndarray:
+    return (ts // window_ms) * window_ms
+
+
+class Memtable:
+    """Append-only columnar buffer with time-window partitioning."""
+
+    def __init__(self, schema: Schema, time_partition_ms: int = 86_400_000):
+        self.schema = schema
+        self.time_partition_ms = time_partition_ms
+        self._chunks: list[pa.RecordBatch] = []
+        self._seqs: list[np.ndarray] = []
+        self._rows = 0
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self._min_ts: int | None = None
+        self._max_ts: int | None = None
+
+    # ---- write ------------------------------------------------------------
+    def write(self, batch: pa.RecordBatch, sequence: int):
+        """Append a batch stamped with a monotonically increasing sequence.
+
+        The sequence plays the role of the reference's per-write `SequenceNumber`
+        (store-api) — dedup keeps the highest sequence for identical
+        (primary key, timestamp) rows.
+        """
+        ts_col = self.schema.time_index
+        with self._lock:
+            self._chunks.append(batch)
+            self._seqs.append(np.full(batch.num_rows, sequence, dtype=np.int64))
+            self._rows += batch.num_rows
+            self._bytes += batch.nbytes
+            if ts_col is not None and batch.num_rows:
+                ts = batch.column(batch.schema.get_field_index(ts_col.name))
+                lo = pc.min(ts).cast(pa.int64()).as_py()
+                hi = pc.max(ts).cast(pa.int64()).as_py()
+                self._min_ts = lo if self._min_ts is None else min(self._min_ts, lo)
+                self._max_ts = hi if self._max_ts is None else max(self._max_ts, hi)
+
+    # ---- stats ------------------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        return self._rows
+
+    @property
+    def memory_usage(self) -> int:
+        return self._bytes
+
+    def is_empty(self) -> bool:
+        return self._rows == 0
+
+    def time_range(self) -> tuple[int, int] | None:
+        if self._min_ts is None:
+            return None
+        return (self._min_ts, self._max_ts)
+
+    # ---- read -------------------------------------------------------------
+    def to_table(self, dedup: bool = True) -> pa.Table:
+        """Materialize buffered rows sorted by (pk, ts), last write wins."""
+        with self._lock:
+            if not self._chunks:
+                return self.schema.to_arrow().empty_table()
+            table = pa.Table.from_batches(self._chunks, schema=self._chunks[0].schema)
+            seq = pa.array(np.concatenate(self._seqs))
+        table = table.append_column(_SEQ_COL, seq)
+        table = _sort_and_dedup(table, self.schema, dedup=dedup)
+        return table.drop_columns([_SEQ_COL])
+
+    def scan(self, time_range: tuple[int, int] | None = None) -> pa.Table:
+        table = self.to_table(dedup=True)
+        if time_range is not None and self.schema.time_index is not None:
+            lo, hi = time_range
+            ts_name = self.schema.time_index.name
+            ts = pc.cast(table[ts_name], pa.int64())
+            mask = pc.and_(pc.greater_equal(ts, lo), pc.less(ts, hi))
+            table = table.filter(mask)
+        return table
+
+    def split_by_time_partition(self) -> list[tuple[int, pa.Table]]:
+        """Split into (window_start_ms, rows) — flush writes one SST per window
+        so SSTs stay window-aligned for TWCS (reference
+        mito2/src/memtable/time_partition.rs)."""
+        table = self.to_table(dedup=True)
+        ts_col = self.schema.time_index
+        if table.num_rows == 0:
+            return []
+        if ts_col is None:
+            return [(0, table)]
+        ts = pc.cast(table[ts_col.name], pa.int64()).to_numpy(zero_copy_only=False)
+        starts = _partition_starts(ts, self.time_partition_ms)
+        out = []
+        for start in np.unique(starts):
+            mask = starts == start
+            out.append((int(start), table.filter(pa.array(mask))))
+        return out
+
+
+def _sort_and_dedup(table: pa.Table, schema: Schema, dedup: bool) -> pa.Table:
+    """Stable sort by (pk..., ts, seq) then keep the last row per (pk..., ts)."""
+    keys = [c.name for c in schema.tag_columns()]
+    ts_col = schema.time_index
+    if ts_col is not None:
+        keys.append(ts_col.name)
+    if not keys:
+        return table
+    sort_keys = [(k, "ascending") for k in keys] + [(_SEQ_COL, "ascending")]
+    idx = pc.sort_indices(table, sort_keys=sort_keys)
+    table = table.take(idx)
+    if not dedup or table.num_rows <= 1:
+        return table
+    # Keep the LAST row of each equal-key run (highest sequence).
+    n = table.num_rows
+    same = np.ones(n - 1, dtype=bool)
+    for k in keys:
+        col = table[k].combine_chunks()
+        arr = col.to_numpy(zero_copy_only=False)
+        a, b = arr[:-1], arr[1:]
+        if arr.dtype == object:
+            eq = np.array([x == y for x, y in zip(a, b)], dtype=bool)
+        else:
+            eq = (a == b) | (_isnan(a) & _isnan(b))
+        same &= eq
+    keep = np.ones(n, dtype=bool)
+    keep[:-1] = ~same  # row i dropped if identical key to row i+1 (later seq)
+    return table.filter(pa.array(keep))
+
+
+def _isnan(a: np.ndarray) -> np.ndarray:
+    if np.issubdtype(a.dtype, np.floating):
+        return np.isnan(a)
+    return np.zeros(len(a), dtype=bool)

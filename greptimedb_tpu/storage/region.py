@@ -1,0 +1,307 @@
+"""Region: the unit of storage, replication and scan parallelism.
+
+Role-equivalent of the reference's `MitoRegion` (reference
+src/mito2/src/region.rs:121) plus its opener (region/opener.rs): a region
+owns a WAL stream, an active memtable, a set of immutable SSTs tracked by a
+manifest, and a monotonically increasing sequence number.  Writes go
+WAL-then-memtable (reference worker/handle_write.rs:83-135); flush turns the
+memtable into time-window-aligned SSTs and advances `flushed_entry_id` so
+the WAL can be truncated; open replays manifest then WAL from
+`flushed_entry_id` (reference region/opener.rs:500-516).
+
+Concurrency model: like the reference's single-writer-per-region actor
+(worker.rs:459), all mutations take the region write lock; scans only read
+immutable snapshots (memtable materialization + SST list copy).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+import pyarrow as pa
+
+from ..datatypes.schema import Schema
+from ..utils import metrics
+from ..utils.errors import IllegalStateError, RegionReadonlyError
+from .manifest import ManifestManager
+from .memtable import Memtable
+from .sst import FileMeta, ScanPredicate, SstReader, SstWriter
+from .wal import RegionWal
+
+
+@dataclass
+class RegionStat:
+    region_id: int
+    num_rows: int
+    sst_count: int
+    sst_bytes: int
+    memtable_bytes: int
+    wal_entry_id: int
+    flushed_entry_id: int
+
+
+class Region:
+    def __init__(
+        self,
+        region_id: int,
+        region_dir: str,
+        schema: Schema,
+        wal: RegionWal,
+        *,
+        time_partition_ms: int = 86_400_000,
+        checkpoint_distance: int = 10,
+        writable: bool = True,
+    ):
+        self.region_id = region_id
+        self.region_dir = region_dir
+        self.wal = wal
+        self.time_partition_ms = time_partition_ms
+        self._lock = threading.RLock()
+        self.writable = writable  # follower replicas are read-only
+
+        os.makedirs(region_dir, exist_ok=True)
+        self.manifest_mgr = ManifestManager(region_dir, region_id, checkpoint_distance)
+        if self.manifest_mgr.manifest.schema is None:
+            self.manifest_mgr.apply({"kind": "change", "schema": schema.to_json()})
+        self.schema = self.manifest_mgr.manifest.schema
+        sst_dir = os.path.join(region_dir, "sst")
+        self.sst_writer = SstWriter(sst_dir, self.schema)
+        self.sst_reader = SstReader(sst_dir, self.schema)
+
+        self.memtable = Memtable(self.schema, time_partition_ms)
+        # Frozen memtables: flushed but whose SSTs are not yet committed to the
+        # manifest; readable by scans so flush never opens a visibility gap.
+        self._frozen_memtables: list[Memtable] = []
+        # SSTs removed from the manifest but not yet safe to delete (readers
+        # in flight may hold the old file list); purged when readers drain.
+        self._garbage_files: list[str] = []
+        self._active_scans = 0
+        self.sequence = self.manifest_mgr.manifest.flushed_sequence
+        # Future WAL entry ids must exceed the flush watermark, else writes
+        # after an obsolete()+restart would replay below it and be lost.
+        self.wal.advance_to(
+            max(
+                self.manifest_mgr.manifest.flushed_entry_id,
+                self.manifest_mgr.manifest.truncated_entry_id or 0,
+            )
+        )
+        self._replay_wal()
+
+    # ---- open/replay ------------------------------------------------------
+    def _replay_wal(self):
+        """Replay WAL entries newer than flushed_entry_id into the memtable."""
+        flushed = self.manifest_mgr.manifest.flushed_entry_id
+        truncated = self.manifest_mgr.manifest.truncated_entry_id or 0
+        start = max(flushed, truncated)
+        replayed = 0
+        for entry in self.wal.replay(start):
+            self.sequence += 1
+            self.memtable.write(entry.batch, self.sequence)
+            replayed += entry.batch.num_rows
+        return replayed
+
+    # ---- write ------------------------------------------------------------
+    def write(self, batch: pa.RecordBatch) -> int:
+        """WAL append then memtable insert; returns affected rows."""
+        if not self.writable:
+            raise RegionReadonlyError(f"region {self.region_id} is read-only")
+        with self._lock:
+            self.wal.append(batch)
+            self.sequence += 1
+            self.memtable.write(batch, self.sequence)
+        metrics.WRITE_ROWS_TOTAL.inc(batch.num_rows)
+        return batch.num_rows
+
+    # ---- flush ------------------------------------------------------------
+    def flush(self) -> list[FileMeta]:
+        """Freeze the memtable, write one SST per time window, commit the
+        manifest edit, truncate WAL.  The frozen memtable stays scannable
+        (in _frozen_memtables) until the manifest edit lands, so concurrent
+        scans never see the flush-in-progress rows vanish."""
+        with self._lock:
+            if self.memtable.is_empty():
+                return []
+            frozen = self.memtable
+            frozen_entry_id = self.wal.last_entry_id
+            frozen_sequence = self.sequence
+            self.memtable = Memtable(self.schema, self.time_partition_ms)
+            self._frozen_memtables.append(frozen)
+        t0 = time.perf_counter()
+        added: list[FileMeta] = []
+        for _window_start, table in frozen.split_by_time_partition():
+            meta = self.sst_writer.write(table, level=0)
+            if meta is not None:
+                added.append(meta)
+        with self._lock:
+            self.manifest_mgr.apply(
+                {
+                    "kind": "edit",
+                    "files_to_add": [m.to_dict() for m in added],
+                    "files_to_remove": [],
+                    "flushed_entry_id": frozen_entry_id,
+                    "flushed_sequence": frozen_sequence,
+                }
+            )
+            self._frozen_memtables.remove(frozen)
+        self.wal.obsolete(frozen_entry_id)
+        metrics.FLUSH_TOTAL.inc()
+        metrics.FLUSH_ELAPSED.observe(time.perf_counter() - t0)
+        return added
+
+    # ---- compaction hook (files swapped by CompactionScheduler) -----------
+    def apply_compaction(self, files_to_add: list[FileMeta], files_to_remove: list[str]):
+        with self._lock:
+            self.manifest_mgr.apply(
+                {
+                    "kind": "edit",
+                    "files_to_add": [m.to_dict() for m in files_to_add],
+                    "files_to_remove": files_to_remove,
+                }
+            )
+            # Defer physical deletion: in-flight scans may hold the old file
+            # list (the reference defers via a file purger + refcounts).
+            self._garbage_files.extend(files_to_remove)
+            self._purge_garbage_locked()
+        metrics.COMPACTION_TOTAL.inc()
+
+    def _purge_garbage_locked(self):
+        if self._active_scans > 0 or not self._garbage_files:
+            return
+        for fid in self._garbage_files:
+            path = self.sst_reader.path_for_id(fid)
+            if os.path.exists(path):
+                os.remove(path)
+        self._garbage_files.clear()
+
+    # ---- read -------------------------------------------------------------
+    def scan(
+        self,
+        pred: ScanPredicate | None = None,
+        columns: list[str] | None = None,
+    ) -> pa.Table:
+        """Snapshot scan: SSTs (pruned) + frozen + active memtables, dedup
+        last-write-wins across sources.  Memtable rows shadow SST rows for
+        equal (pk, ts) because they carry later sequences."""
+        pred = pred or ScanPredicate()
+        with self._lock:
+            files = list(self.manifest_mgr.manifest.files.values())
+            mems = list(self._frozen_memtables) + [self.memtable]
+            self._active_scans += 1
+        try:
+            # Projection pushdown: read only requested columns plus the
+            # pk/ts columns dedup needs; final select() trims the extras.
+            read_cols = None
+            if columns:
+                need = list(dict.fromkeys(columns))
+                for c in self.schema.primary_key():
+                    if c not in need:
+                        need.append(c)
+                if self.schema.time_index and self.schema.time_index.name not in need:
+                    need.append(self.schema.time_index.name)
+                for name, _op, _v in pred.filters:
+                    if self.schema.has_column(name) and name not in need:
+                        need.append(name)
+                read_cols = need
+            tables = []
+            for meta in self.sst_reader.prune_files(files, pred):
+                t = self.sst_reader.read(meta, pred, columns=read_cols)
+                if t.num_rows:
+                    tables.append(_undict(t))
+            n_sst_tables = len(tables)
+            from .sst import _apply_residual
+
+            ts_name = self.schema.time_index.name if self.schema.time_index else None
+            mem_rows = 0
+            for mem in mems:
+                mem_table = mem.scan(pred.time_range)
+                if mem_table.num_rows:
+                    mem_table = _apply_residual(mem_table, pred, ts_name)
+                if mem_table.num_rows:
+                    if read_cols:
+                        mem_table = mem_table.select(
+                            [c for c in read_cols if c in mem_table.column_names]
+                        )
+                    mem_rows += mem_table.num_rows
+                    tables.append(_undict(mem_table))
+            if not tables:
+                out = self.schema.to_arrow().empty_table()
+            else:
+                out = pa.concat_tables(tables, promote_options="permissive")
+                out = self._dedup_across_sources(
+                    out, had_multiple=len(tables) > 1 or (n_sst_tables and mem_rows)
+                )
+            if columns:
+                out = out.select(columns)
+            return out
+        finally:
+            with self._lock:
+                self._active_scans -= 1
+                self._purge_garbage_locked()
+
+    def _dedup_across_sources(self, table: pa.Table, had_multiple: bool) -> pa.Table:
+        if not had_multiple or table.num_rows <= 1:
+            return table
+        # Order sources oldest->newest (SSTs then memtable appended last);
+        # reuse memtable sort+dedup with the append order as sequence.
+        import numpy as np
+
+        from .memtable import _SEQ_COL, _sort_and_dedup
+
+        seq = pa.array(np.arange(table.num_rows, dtype=np.int64))
+        table = table.append_column(_SEQ_COL, seq)
+        table = _sort_and_dedup(table, self.schema, dedup=True)
+        return table.drop_columns([_SEQ_COL])
+
+    # ---- admin ------------------------------------------------------------
+    def truncate(self):
+        with self._lock:
+            entry_id = self.wal.last_entry_id
+            self.manifest_mgr.apply({"kind": "truncate", "truncated_entry_id": entry_id})
+            self.memtable = Memtable(self.schema, self.time_partition_ms)
+            self.wal.obsolete(entry_id)
+
+    def alter_schema(self, new_schema: Schema):
+        """Schema change: flush first so existing SSTs stay self-describing."""
+        with self._lock:
+            self.flush()
+            self.manifest_mgr.apply({"kind": "change", "schema": new_schema.to_json()})
+            self.schema = new_schema
+            self.sst_writer.schema = new_schema
+            self.sst_reader.schema = new_schema
+            self.memtable = Memtable(new_schema, self.time_partition_ms)
+
+    def set_writable(self, writable: bool):
+        """Leader/follower role flip (reference set_region_role)."""
+        self.writable = writable
+
+    def stat(self) -> RegionStat:
+        m = self.manifest_mgr.manifest
+        return RegionStat(
+            region_id=self.region_id,
+            num_rows=sum(f.num_rows for f in m.files.values()) + self.memtable.num_rows,
+            sst_count=len(m.files),
+            sst_bytes=sum(f.file_size for f in m.files.values()),
+            memtable_bytes=self.memtable.memory_usage,
+            wal_entry_id=self.wal.last_entry_id,
+            flushed_entry_id=m.flushed_entry_id,
+        )
+
+    def files(self) -> list[FileMeta]:
+        with self._lock:
+            return list(self.manifest_mgr.manifest.files.values())
+
+    def read_sst(self, meta: FileMeta, pred: ScanPredicate | None = None) -> pa.Table:
+        return _undict(self.sst_reader.read(meta, pred))
+
+
+def _undict(table: pa.Table) -> pa.Table:
+    """Decode dictionary columns back to plain values for cross-file concat."""
+    import pyarrow.compute as pc
+
+    for i, f in enumerate(table.schema):
+        if pa.types.is_dictionary(f.type):
+            table = table.set_column(i, f.name, pc.cast(table[f.name], f.type.value_type))
+    return table

@@ -1,0 +1,141 @@
+"""Layered configuration: defaults -> TOML file -> environment variables.
+
+Mirrors the reference's `Configurable::load_layered_options`
+(reference src/common/config/src/config.rs:29-74): env vars use the
+`GREPTIMEDB_TPU__SECTION__KEY` convention (double underscore separates
+nesting levels), analogous to the reference's `GREPTIMEDB_<ROLE>__A__B`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from typing import Any
+
+ENV_PREFIX = "GREPTIMEDB_TPU"
+
+
+def _coerce(value: str, template: Any) -> Any:
+    """Coerce an env-var string to the type of the default it overrides."""
+    if isinstance(template, bool):
+        return value.lower() in ("1", "true", "yes", "on")
+    if isinstance(template, int):
+        return int(value)
+    if isinstance(template, float):
+        return float(value)
+    if isinstance(template, (list, tuple)):
+        return [v.strip() for v in value.split(",")]
+    return value
+
+
+def _deep_merge(base: dict, overlay: dict) -> dict:
+    out = dict(base)
+    for k, v in overlay.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+@dataclasses.dataclass
+class StorageConfig:
+    data_home: str = "./greptimedb_data"
+    wal_dir: str = ""  # defaults to {data_home}/wal
+    sst_dir: str = ""  # defaults to {data_home}/data
+    manifest_checkpoint_distance: int = 10
+    write_buffer_size_mb: int = 64
+    global_write_buffer_size_mb: int = 512
+    memtable_time_partition_secs: int = 86400
+    num_workers: int = 4
+    wal_fsync: bool = False
+    compaction_max_active_window_runs: int = 4
+    compaction_max_inactive_window_runs: int = 1
+    compaction_time_window_secs: int = 0  # 0 = infer from data
+
+    def __post_init__(self):
+        if not self.wal_dir:
+            self.wal_dir = os.path.join(self.data_home, "wal")
+        if not self.sst_dir:
+            self.sst_dir = os.path.join(self.data_home, "data")
+
+
+@dataclasses.dataclass
+class QueryConfig:
+    # "tpu" lowers eligible plans to JAX kernels; "cpu" is the authoritative
+    # Arrow-compute path (reference gates similarly via query.execution hooks).
+    backend: str = "tpu"
+    tile_rows: int = 1 << 20
+    max_groups: int = 1 << 16
+    parallelism: int = 0  # 0 = number of local devices
+    fallback_to_cpu: bool = True
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    # Mesh axes for distributed execution: regions (data parallel over
+    # devices) is the DB analogue of DP; within-host reduction rides ICI.
+    mesh_shape: str = "auto"  # "auto" or e.g. "4x2"
+    region_axis: str = "regions"
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    http_addr: str = "127.0.0.1:4000"
+    grpc_addr: str = "127.0.0.1:4001"
+
+
+@dataclasses.dataclass
+class Config:
+    storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
+    query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    server: ServerConfig = dataclasses.field(default_factory=ServerConfig)
+
+    def __post_init__(self):
+        self.storage.__post_init__()
+
+    @classmethod
+    def load(cls, path: str | None = None, env: dict[str, str] | None = None) -> "Config":
+        """defaults -> TOML at `path` -> GREPTIMEDB_TPU__SECTION__KEY env vars."""
+        layers: dict = {}
+        if path and os.path.exists(path):
+            with open(path, "rb") as f:
+                layers = _deep_merge(layers, tomllib.load(f))
+        env = env if env is not None else dict(os.environ)
+        for key, val in env.items():
+            if not key.startswith(ENV_PREFIX + "__"):
+                continue
+            parts = [p.lower() for p in key[len(ENV_PREFIX) + 2 :].split("__")]
+            node: dict = layers
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = val
+        return cls._from_dict(layers)
+
+    @classmethod
+    def _from_dict(cls, d: dict) -> "Config":
+        cfg = cls()
+        # cls() already derived wal/sst dirs from the default data_home;
+        # reset them so __post_init__ re-derives from the loaded one unless
+        # the overlay pins them explicitly.
+        storage_overlay = d.get("storage", {})
+        if "wal_dir" not in storage_overlay:
+            cfg.storage.wal_dir = ""
+        if "sst_dir" not in storage_overlay:
+            cfg.storage.sst_dir = ""
+        for section_field in dataclasses.fields(cls):
+            section = getattr(cfg, section_field.name)
+            overlay = d.get(section_field.name, {})
+            if not isinstance(overlay, dict):
+                continue
+            for f in dataclasses.fields(section):
+                if f.name in overlay:
+                    raw = overlay[f.name]
+                    default = getattr(section, f.name)
+                    if isinstance(raw, str) and not isinstance(default, str):
+                        raw = _coerce(raw, default)
+                    setattr(section, f.name, raw)
+        cfg.__post_init__()
+        return cfg

@@ -1,0 +1,158 @@
+"""Prometheus-style in-process metrics registry.
+
+The reference exposes lazy_static prometheus counters/histograms per crate
+(e.g. reference src/mito2/src/metrics.rs) served at /metrics.  We keep the
+same shape: a process-global registry of counters, gauges and histograms,
+renderable in the Prometheus text exposition format.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import defaultdict
+
+_DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name, self.help = name, help_
+        self._values: dict[tuple, float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] += amount
+
+    def get(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._values.items()):
+            lines.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return lines
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = value
+
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for key, v in sorted(self._values.items()):
+            lines.append(f"{self.name}{_fmt_labels(key)} {v}")
+        return lines
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets=_DEFAULT_BUCKETS):
+        self.name, self.help = name, help_
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = defaultdict(float)
+        self._totals: dict[tuple, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            i = bisect.bisect_left(self.buckets, value)
+            if i < len(counts):
+                counts[i] += 1
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    class _Timer:
+        def __init__(self, hist, labels):
+            self._hist, self._labels = hist, labels
+
+        def __enter__(self):
+            self._start = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self._hist.observe(time.perf_counter() - self._start, **self._labels)
+            return False
+
+    def time(self, **labels) -> "Histogram._Timer":
+        return self._Timer(self, labels)
+
+    def total(self, **labels) -> int:
+        return self._totals.get(tuple(sorted(labels.items())), 0)
+
+    def sum(self, **labels) -> float:
+        return self._sums.get(tuple(sorted(labels.items())), 0.0)
+
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for key in sorted(self._counts):
+            cum = 0
+            for ub, c in zip(self.buckets, self._counts[key]):
+                cum += c
+                lk = key + (("le", repr(ub)),)
+                lines.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
+            lk = key + (("le", "+Inf"),)
+            lines.append(f"{self.name}_bucket{_fmt_labels(lk)} {self._totals[key]}")
+            lines.append(f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}")
+            lines.append(f"{self.name}_count{_fmt_labels(key)} {self._totals[key]}")
+        return lines
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help_), Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_create(name, lambda: Gauge(name, help_), Gauge)
+
+    def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(name, lambda: Histogram(name, help_, buckets), Histogram)
+
+    def _get_or_create(self, name, factory, kind):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            assert isinstance(m, kind), f"metric {name} registered as {type(m)}"
+            return m
+
+    def render(self) -> str:
+        lines: list[str] = []
+        for name in sorted(self._metrics):
+            lines.extend(self._metrics[name].render())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# Core engine metrics, named after the reference's (mito2/src/metrics.rs).
+WRITE_ROWS_TOTAL = REGISTRY.counter("greptime_mito_write_rows_total", "Rows written")
+FLUSH_TOTAL = REGISTRY.counter("greptime_mito_flush_total", "Memtable flushes")
+FLUSH_ELAPSED = REGISTRY.histogram("greptime_mito_flush_elapsed", "Flush seconds")
+COMPACTION_TOTAL = REGISTRY.counter("greptime_mito_compaction_total", "Compactions")
+WRITE_STALL_TOTAL = REGISTRY.counter("greptime_mito_write_stall_total", "Write stalls")
+QUERY_ELAPSED = REGISTRY.histogram("greptime_query_elapsed", "Query seconds")
+TPU_LOWERED_TOTAL = REGISTRY.counter("greptime_query_tpu_lowered_total", "Plans lowered to TPU")
+TPU_FALLBACK_TOTAL = REGISTRY.counter("greptime_query_tpu_fallback_total", "Plans that fell back to CPU")

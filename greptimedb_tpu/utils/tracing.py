@@ -1,0 +1,109 @@
+"""Lightweight span tracing with W3C-style context propagation.
+
+The reference propagates tracing context across RPC boundaries in request
+headers (reference src/common/telemetry/src/tracing_context.rs) and
+instruments hot entry points.  We provide the same surface: spans with
+trace/span ids, a contextvar-based current span, `traceparent` encode/decode
+for cross-process propagation, and an in-memory exporter for tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    start: float = field(default_factory=time.time)
+    end: float | None = None
+    attributes: dict = field(default_factory=dict)
+
+    def duration(self) -> float:
+        return (self.end or time.time()) - self.start
+
+
+_current: contextvars.ContextVar[Span | None] = contextvars.ContextVar("span", default=None)
+
+
+class SpanExporter:
+    """In-memory exporter; swap for OTLP in production deployments."""
+
+    def __init__(self, capacity: int = 4096):
+        self._spans: list[Span] = []
+        self._cap = capacity
+        self._lock = threading.Lock()
+
+    def export(self, span: Span):
+        with self._lock:
+            if len(self._spans) < self._cap:
+                self._spans.append(span)
+
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self):
+        with self._lock:
+            self._spans.clear()
+
+
+EXPORTER = SpanExporter()
+
+
+def current_span() -> Span | None:
+    return _current.get()
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes):
+    parent = _current.get()
+    s = Span(
+        name=name,
+        trace_id=parent.trace_id if parent else secrets.token_hex(16),
+        span_id=secrets.token_hex(8),
+        parent_id=parent.span_id if parent else None,
+        attributes=attributes,
+    )
+    token = _current.set(s)
+    try:
+        yield s
+    finally:
+        s.end = time.time()
+        _current.reset(token)
+        EXPORTER.export(s)
+
+
+def inject_context() -> dict[str, str]:
+    """Produce a `traceparent` header for the current span (W3C format)."""
+    s = _current.get()
+    if s is None:
+        return {}
+    return {"traceparent": f"00-{s.trace_id}-{s.span_id}-01"}
+
+
+@contextlib.contextmanager
+def extract_context(headers: dict[str, str], name: str = "remote"):
+    """Continue a trace from a `traceparent` header on the receiving side."""
+    tp = headers.get("traceparent", "")
+    parts = tp.split("-")
+    if len(parts) == 4 and len(parts[1]) == 32:
+        s = Span(name=name, trace_id=parts[1], span_id=secrets.token_hex(8), parent_id=parts[2])
+        token = _current.set(s)
+        try:
+            yield s
+        finally:
+            s.end = time.time()
+            _current.reset(token)
+            EXPORTER.export(s)
+    else:
+        with span(name) as s:
+            yield s

@@ -1,0 +1,30 @@
+"""Test harness configuration.
+
+Tests run on an 8-device virtual CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware (the driver's dryrun does the same).  This
+must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# The query layer uses float64 accumulators to match CPU results.
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_engine(tmp_path):
+    from greptimedb_tpu.storage.engine import TimeSeriesEngine
+    from greptimedb_tpu.utils.config import StorageConfig
+
+    cfg = StorageConfig(data_home=str(tmp_path))
+    cfg.wal_dir = str(tmp_path / "wal")
+    cfg.sst_dir = str(tmp_path / "data")
+    engine = TimeSeriesEngine(cfg)
+    yield engine
+    engine.close()

@@ -1,0 +1,118 @@
+"""L0 foundation tests: errors, config layering, metrics, tracing, datatypes."""
+
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.datatypes import ColumnSchema, ConcreteDataType, Schema, SemanticType
+from greptimedb_tpu.utils.config import Config
+from greptimedb_tpu.utils.errors import (
+    GreptimeError,
+    InvalidArgumentsError,
+    StatusCode,
+    TableNotFoundError,
+)
+from greptimedb_tpu.utils.metrics import Registry
+from greptimedb_tpu.utils.tracing import EXPORTER, extract_context, inject_context, span
+
+
+def test_error_codes():
+    err = TableNotFoundError("no such table: t")
+    assert err.status_code() == StatusCode.TABLE_NOT_FOUND
+    assert "TABLE_NOT_FOUND" in err.output_msg()
+    generic = GreptimeError("boom", code=StatusCode.RETRY_LATER)
+    assert generic.status_code() == StatusCode.RETRY_LATER
+
+
+def test_config_layering(tmp_path):
+    toml = tmp_path / "cfg.toml"
+    toml.write_text(
+        """
+[storage]
+data_home = "/tmp/x"
+num_workers = 8
+
+[query]
+backend = "cpu"
+"""
+    )
+    cfg = Config.load(str(toml), env={"GREPTIMEDB_TPU__QUERY__TILE_ROWS": "4096"})
+    assert cfg.storage.data_home == "/tmp/x"
+    assert cfg.storage.num_workers == 8
+    assert cfg.query.backend == "cpu"
+    assert cfg.query.tile_rows == 4096  # env overrides
+    assert cfg.storage.wal_dir == "/tmp/x/wal"  # derived default
+
+
+def test_config_env_only():
+    cfg = Config.load(env={"GREPTIMEDB_TPU__STORAGE__WAL_FSYNC": "true"})
+    assert cfg.storage.wal_fsync is True
+
+
+def test_metrics_registry():
+    reg = Registry()
+    c = reg.counter("test_total", "help")
+    c.inc(2, region="1")
+    c.inc(3, region="1")
+    assert c.get(region="1") == 5
+    h = reg.histogram("test_seconds", "help")
+    with h.time(op="x"):
+        pass
+    assert h.total(op="x") == 1
+    text = reg.render()
+    assert 'test_total{region="1"} 5' in text
+    assert "test_seconds_bucket" in text
+
+
+def test_tracing_propagation():
+    EXPORTER.clear()
+    with span("parent") as p:
+        headers = inject_context()
+        assert headers["traceparent"].split("-")[1] == p.trace_id
+    with extract_context(headers, name="child") as c:
+        assert c.trace_id == p.trace_id
+    spans = EXPORTER.spans()
+    assert {s.name for s in spans} >= {"parent", "child"}
+
+
+def test_datatype_parse_and_arrow_roundtrip():
+    assert ConcreteDataType.parse("BIGINT") == ConcreteDataType.INT64
+    assert ConcreteDataType.parse("timestamp(3)") == ConcreteDataType.TIMESTAMP_MILLISECOND
+    for t in ConcreteDataType:
+        if t == ConcreteDataType.NULL:
+            continue
+        assert ConcreteDataType.from_arrow(t.to_arrow()) is not None
+    with pytest.raises(InvalidArgumentsError):
+        ConcreteDataType.parse("frobnicate")
+
+
+def test_schema_semantics():
+    schema = Schema(
+        columns=[
+            ColumnSchema("host", ConcreteDataType.STRING, SemanticType.TAG),
+            ColumnSchema("ts", ConcreteDataType.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP),
+            ColumnSchema("usage_user", ConcreteDataType.FLOAT64),
+        ]
+    )
+    assert schema.time_index.name == "ts"
+    assert schema.primary_key() == ["host"]
+    assert not schema.column("ts").nullable
+    arrow = schema.to_arrow()
+    assert isinstance(arrow, pa.Schema)
+    back = Schema.from_arrow(arrow)
+    assert back.column("host").semantic_type == SemanticType.TAG
+    assert back.column("ts").semantic_type == SemanticType.TIMESTAMP
+
+    s2 = schema.add_column(ColumnSchema("usage_sys", ConcreteDataType.FLOAT64))
+    assert s2.version == 1 and s2.has_column("usage_sys")
+    with pytest.raises(InvalidArgumentsError):
+        s2.drop_column("host")  # tags cannot be dropped
+
+
+def test_schema_rejects_two_time_indexes():
+    with pytest.raises(InvalidArgumentsError):
+        Schema(
+            columns=[
+                ColumnSchema("a", ConcreteDataType.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP),
+                ColumnSchema("b", ConcreteDataType.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP),
+            ]
+        )
