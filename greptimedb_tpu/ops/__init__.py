@@ -1,0 +1,25 @@
+"""JAX/Pallas kernels for the query hot path.
+
+The TPU-native replacement for the reference's DataFusion physical operators
+(scan streams -> filter eval -> hash aggregate, reference SURVEY.md section
+3.2 "hot loops"): columns are padded into fixed-shape tiles with validity
+masks (XLA wants static shapes; this mirrors the reference's PartitionRange
+blocking), predicates become boolean-mask kernels, group-by becomes
+segment-reduction partials per shard (the reference's lower "state" aggregate,
+query/src/dist_plan/commutativity.rs:45), and partials merge with psum over
+ICI (the reference's MergeScan + upper merge aggregate).
+"""
+
+from .tiles import TileBatch, tiles_from_table
+from .aggregate import AggState, segment_aggregate, merge_states, finalize
+from .filter import compile_predicate
+
+__all__ = [
+    "TileBatch",
+    "tiles_from_table",
+    "AggState",
+    "segment_aggregate",
+    "merge_states",
+    "finalize",
+    "compile_predicate",
+]

@@ -1,0 +1,203 @@
+"""Segmented (group-by) aggregation kernels: the two-step state/merge pattern.
+
+TPU-native equivalent of the reference's split of steppable aggregates into a
+lower **state** stage per region and an upper **merge** stage at the frontend
+(reference query/src/dist_plan/commutativity.rs:45 `step_aggr_to_upper_aggr`,
+StateMergeHelper): `segment_aggregate` computes per-shard partial states with
+`jax.ops.segment_*` reductions, `merge_states`/`psum_states` combine partials
+(psum over ICI replaces the Flight N:1 MergeScan), and `finalize` produces
+sum/avg/min/max/count outputs with empty groups marked invalid.
+
+Group ids are dense ints computed on device from time buckets and tag codes:
+    gid = ((tag0 * card1 + tag1) * ... ) * n_buckets + time_bucket
+Rows failing the predicate mask get gid = num_groups (one overflow slot) so
+reductions stay branch-free; the slot is dropped at finalize.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+SUM, COUNT, MIN, MAX, LAST = "sum", "count", "min", "max", "last"
+_MERGEABLE = (SUM, COUNT, MIN, MAX, LAST)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class AggState:
+    """Partial aggregation state for one value column over G groups.
+
+    Mirrors the reference's state-aggregate output (e.g. `sum_state`,
+    `count_state` columns shipped from datanodes).  All arrays are [G].
+    `last_ts`/`last_val` implement last_value(value ORDER BY ts).
+    """
+
+    sums: jnp.ndarray | None = None
+    counts: jnp.ndarray | None = None
+    mins: jnp.ndarray | None = None
+    maxs: jnp.ndarray | None = None
+    last_ts: jnp.ndarray | None = None
+    last_val: jnp.ndarray | None = None
+
+    def tree_flatten(self):
+        fields = (self.sums, self.counts, self.mins, self.maxs, self.last_ts, self.last_val)
+        mask = tuple(f is not None for f in fields)
+        return tuple(f for f in fields if f is not None), mask
+
+    @classmethod
+    def tree_unflatten(cls, mask, leaves):
+        it = iter(leaves)
+        vals = [next(it) if present else None for present in mask]
+        return cls(*vals)
+
+
+def group_ids(
+    components: list[tuple[jnp.ndarray, int]],
+    mask: jnp.ndarray,
+    num_groups: int,
+) -> jnp.ndarray:
+    """Mixed-radix combine (component, cardinality) pairs into dense gids.
+
+    Components out of range [0, card) (e.g. dict code -1 for "unseen") or
+    masked rows map to the overflow slot `num_groups`.
+    """
+    gid = jnp.zeros(mask.shape, dtype=jnp.int32)
+    in_range = mask
+    for comp, card in components:
+        c = comp.astype(jnp.int32)
+        in_range = in_range & (c >= 0) & (c < card)
+        gid = gid * card + jnp.clip(c, 0, card - 1)
+    return jnp.where(in_range, gid, num_groups)
+
+
+def time_bucket(ts: jnp.ndarray, origin: int, interval: int) -> jnp.ndarray:
+    """Floor timestamps into interval buckets (reference date_bin / RANGE ALIGN)."""
+    return ((ts - origin) // interval).astype(jnp.int32)
+
+
+def segment_aggregate(
+    values: jnp.ndarray,
+    gids: jnp.ndarray,
+    num_groups: int,
+    aggs: tuple[str, ...],
+    mask: jnp.ndarray | None = None,
+    ts: jnp.ndarray | None = None,
+    acc_dtype=jnp.float32,
+) -> AggState:
+    """Per-shard partial aggregation (the lower/state stage).
+
+    `gids` must already encode masking via the overflow slot; `mask` is only
+    needed again for COUNT/sum zeroing of the overflow rows' values.
+    """
+    segs = num_groups + 1  # + overflow slot
+    if mask is None:
+        mask = gids < num_groups
+    v = values.astype(acc_dtype)
+    v0 = jnp.where(mask, v, 0)
+    state = AggState()
+    if SUM in aggs or "avg" in aggs:
+        state.sums = jax.ops.segment_sum(v0, gids, num_segments=segs)[:num_groups]
+    if COUNT in aggs or "avg" in aggs:
+        state.counts = jax.ops.segment_sum(
+            mask.astype(jnp.int32), gids, num_segments=segs
+        )[:num_groups]
+    if MIN in aggs:
+        big = jnp.asarray(jnp.finfo(acc_dtype).max, acc_dtype)
+        state.mins = jax.ops.segment_min(
+            jnp.where(mask, v, big), gids, num_segments=segs
+        )[:num_groups]
+    if MAX in aggs:
+        small = jnp.asarray(jnp.finfo(acc_dtype).min, acc_dtype)
+        state.maxs = jax.ops.segment_max(
+            jnp.where(mask, v, small), gids, num_segments=segs
+        )[:num_groups]
+    if LAST in aggs:
+        if ts is None:
+            raise ValueError("LAST aggregation requires ts")
+        tsmin = jnp.iinfo(jnp.int64).min
+        t = jnp.where(mask, ts, tsmin)
+        state.last_ts = jax.ops.segment_max(t, gids, num_segments=segs)[:num_groups]
+        # Second pass: among rows whose ts equals the group max, take the max
+        # value (ties broken by value, deterministic).
+        is_last = mask & (ts == state.last_ts[jnp.clip(gids, 0, num_groups - 1)])
+        small = jnp.asarray(jnp.finfo(acc_dtype).min, acc_dtype)
+        state.last_val = jax.ops.segment_max(
+            jnp.where(is_last, v, small), gids, num_segments=segs
+        )[:num_groups]
+    return state
+
+
+def merge_states(a: AggState, b: AggState) -> AggState:
+    """Combine two partials (the upper/merge stage, tree or pairwise)."""
+    out = AggState()
+    if a.sums is not None:
+        out.sums = a.sums + b.sums
+    if a.counts is not None:
+        out.counts = a.counts + b.counts
+    if a.mins is not None:
+        out.mins = jnp.minimum(a.mins, b.mins)
+    if a.maxs is not None:
+        out.maxs = jnp.maximum(a.maxs, b.maxs)
+    if a.last_ts is not None:
+        newer = b.last_ts > a.last_ts
+        tie = b.last_ts == a.last_ts
+        out.last_ts = jnp.maximum(a.last_ts, b.last_ts)
+        out.last_val = jnp.where(
+            newer, b.last_val, jnp.where(tie, jnp.maximum(a.last_val, b.last_val), a.last_val)
+        )
+    return out
+
+
+def psum_states(state: AggState, axis_name: str) -> AggState:
+    """Merge partials across a mesh axis with XLA collectives over ICI.
+
+    This is the TPU-native MergeScan: sums/counts ride psum, min/max ride
+    pmin/pmax, LAST does an argmax-style two-field reduction.
+    """
+    out = AggState()
+    if state.sums is not None:
+        out.sums = jax.lax.psum(state.sums, axis_name)
+    if state.counts is not None:
+        out.counts = jax.lax.psum(state.counts, axis_name)
+    if state.mins is not None:
+        out.mins = jax.lax.pmin(state.mins, axis_name)
+    if state.maxs is not None:
+        out.maxs = jax.lax.pmax(state.maxs, axis_name)
+    if state.last_ts is not None:
+        max_ts = jax.lax.pmax(state.last_ts, axis_name)
+        mine = state.last_ts == max_ts
+        small = jnp.asarray(jnp.finfo(state.last_val.dtype).min, state.last_val.dtype)
+        out.last_ts = max_ts
+        out.last_val = jax.lax.pmax(jnp.where(mine, state.last_val, small), axis_name)
+    return out
+
+
+def finalize(state: AggState, aggs: tuple[str, ...]) -> dict[str, jnp.ndarray]:
+    """State -> final outputs; `non_empty` marks groups with any row."""
+    out: dict[str, jnp.ndarray] = {}
+    counts = state.counts
+    if counts is not None:
+        out["count"] = counts
+    if SUM in aggs or "avg" in aggs:
+        out["sum"] = state.sums
+    if "avg" in aggs:
+        safe = jnp.maximum(counts, 1)
+        out["avg"] = state.sums / safe
+    if MIN in aggs:
+        out["min"] = state.mins
+    if MAX in aggs:
+        out["max"] = state.maxs
+    if LAST in aggs:
+        out["last"] = state.last_val
+        out["last_ts"] = state.last_ts
+    if counts is not None:
+        out["non_empty"] = counts > 0
+    else:
+        probe = state.mins if state.mins is not None else state.maxs
+        if probe is not None:
+            extreme = jnp.finfo(probe.dtype).max if probe is state.mins else jnp.finfo(probe.dtype).min
+            out["non_empty"] = probe != extreme
+    return out

@@ -1,0 +1,241 @@
+"""PromQL range-vector kernels: rate / increase / delta + *_over_time.
+
+TPU-native port of the reference's PromQL extension operators
+(reference src/promql/src/extension_plan/range_manipulate.rs building the
+range-vector matrix, and src/promql/src/functions/extrapolate_rate.rs
+implementing Prometheus' extrapolated rate — itself a port of Prometheus'
+`extrapolatedRate`).
+
+Design: instead of materializing a ragged range-vector matrix (dynamic
+shapes), every sample is assigned to the K eval windows that can contain it
+(K = ceil(range/step), static from the query), and per-(series, window)
+statistics are computed with segment reductions.  Counter resets are removed
+up front by a per-series monotonic re-accumulation so first/last arithmetic
+needs no pairwise pass inside windows.
+
+Inputs are flat sorted columns (series id, ts, value) — exactly what the
+region scan produces after dedup — padded per `tiles.py`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class RangeSpec:
+    """Static description of a PromQL range query evaluation grid."""
+
+    start: int  # first eval timestamp (ms)
+    end: int  # last eval timestamp (ms, inclusive)
+    step: int  # eval step (ms)
+    range_: int  # range-vector selector length (ms)
+
+    @property
+    def num_steps(self) -> int:
+        return (self.end - self.start) // self.step + 1
+
+    @property
+    def windows_per_sample(self) -> int:
+        return -(-self.range_ // self.step)  # ceil
+
+
+def strip_counter_resets(series: jnp.ndarray, values: jnp.ndarray, valid: jnp.ndarray):
+    """Per-series monotonic re-accumulation: after a counter reset
+    (v[i] < v[i-1]), add the pre-reset level so adjusted values never
+    decrease.  increase() over [a, b] then equals adj[b] - adj[a].
+    Matches prometheus' reset handling in extrapolatedRate."""
+    prev_v = jnp.concatenate([values[:1], values[:-1]])
+    prev_s = jnp.concatenate([series[:1], series[:-1]])
+    prev_valid = jnp.concatenate([jnp.zeros(1, dtype=bool), valid[:-1]])
+    same = (series == prev_s) & prev_valid & valid
+    reset_add = jnp.where(same & (values < prev_v), prev_v, 0.0)
+    cum = jnp.cumsum(reset_add)
+    # Subtract each series' cumsum baseline (value of cum just before its
+    # first element) so accumulation restarts per series.
+    is_first = ~same & valid
+    # Propagate the most recent series-start baseline forward (series are
+    # contiguous in the sorted layout), then subtract it.
+    idx = jnp.arange(series.shape[0])
+    marked = jnp.where(is_first, idx, -1)
+    last_first_idx = jax.lax.associative_scan(jnp.maximum, marked)
+    baseline = jnp.where(
+        last_first_idx >= 0,
+        jnp.take(cum - reset_add, jnp.clip(last_first_idx, 0, None)),
+        0.0,
+    )
+    return values + (cum - baseline)
+
+
+@dataclass
+class WindowStats:
+    """Per-(series, window) statistics; arrays are [num_series * num_steps]."""
+
+    count: jnp.ndarray
+    first_ts: jnp.ndarray
+    last_ts: jnp.ndarray
+    first_val: jnp.ndarray
+    last_val: jnp.ndarray
+    sum: jnp.ndarray
+    min: jnp.ndarray
+    max: jnp.ndarray
+
+
+def range_windows(
+    series: jnp.ndarray,
+    ts: jnp.ndarray,
+    values: jnp.ndarray,
+    valid: jnp.ndarray,
+    spec: RangeSpec,
+    num_series: int,
+    acc_dtype=jnp.float64,
+) -> WindowStats:
+    """Assign each sample to its <=K containing windows and reduce.
+
+    Window w covers (t_w - range, t_w] with t_w = start + w*step —
+    Prometheus range selector semantics (left-open, right-closed).
+    """
+    n_steps = spec.num_steps
+    k = spec.windows_per_sample
+    num_groups = num_series * n_steps
+    segs = num_groups + 1
+    v = values.astype(acc_dtype)
+
+    tsmax = jnp.iinfo(jnp.int64).max
+    tsmin = jnp.iinfo(jnp.int64).min
+    big = jnp.asarray(jnp.finfo(acc_dtype).max, acc_dtype)
+    small = jnp.asarray(jnp.finfo(acc_dtype).min, acc_dtype)
+
+    count = jnp.zeros(segs, jnp.int32)
+    first_ts = jnp.full(segs, tsmax, jnp.int64)
+    last_ts = jnp.full(segs, tsmin, jnp.int64)
+    sum_ = jnp.zeros(segs, acc_dtype)
+    min_ = jnp.full(segs, big, acc_dtype)
+    max_ = jnp.full(segs, small, acc_dtype)
+
+    # First window index that can contain sample t: smallest w with t_w >= t.
+    w0 = jnp.ceil((ts - spec.start) / spec.step).astype(jnp.int32)
+    w0 = jnp.maximum(w0, 0)
+    for j in range(k):  # static unroll: samples fall in at most k windows
+        w = w0 + j
+        t_w = spec.start + w.astype(jnp.int64) * spec.step
+        in_win = valid & (w >= 0) & (w < n_steps) & (ts <= t_w) & (ts > t_w - spec.range_)
+        gid = jnp.where(in_win, series.astype(jnp.int32) * n_steps + w, num_groups)
+        count = count + jax.ops.segment_sum(in_win.astype(jnp.int32), gid, num_segments=segs)
+        first_ts = jnp.minimum(
+            first_ts, jax.ops.segment_min(jnp.where(in_win, ts, tsmax), gid, num_segments=segs)
+        )
+        last_ts = jnp.maximum(
+            last_ts, jax.ops.segment_max(jnp.where(in_win, ts, tsmin), gid, num_segments=segs)
+        )
+        sum_ = sum_ + jax.ops.segment_sum(jnp.where(in_win, v, 0), gid, num_segments=segs)
+        min_ = jnp.minimum(
+            min_, jax.ops.segment_min(jnp.where(in_win, v, big), gid, num_segments=segs)
+        )
+        max_ = jnp.maximum(
+            max_, jax.ops.segment_max(jnp.where(in_win, v, small), gid, num_segments=segs)
+        )
+
+    count, first_ts, last_ts = count[:num_groups], first_ts[:num_groups], last_ts[:num_groups]
+    sum_, min_, max_ = sum_[:num_groups], min_[:num_groups], max_[:num_groups]
+
+    # Second pass: values at the first/last timestamps (two-field argmin/max).
+    first_val = jnp.zeros(num_groups + 1, acc_dtype)
+    last_val = jnp.zeros(num_groups + 1, acc_dtype)
+    fv = jnp.full(num_groups + 1, small, acc_dtype)
+    lv = jnp.full(num_groups + 1, small, acc_dtype)
+    for j in range(k):
+        w = w0 + j
+        t_w = spec.start + w.astype(jnp.int64) * spec.step
+        in_win = valid & (w >= 0) & (w < n_steps) & (ts <= t_w) & (ts > t_w - spec.range_)
+        gid = jnp.where(in_win, series.astype(jnp.int32) * n_steps + w, num_groups)
+        safe_gid = jnp.clip(gid, 0, num_groups - 1)
+        at_first = in_win & (ts == first_ts[safe_gid])
+        at_last = in_win & (ts == last_ts[safe_gid])
+        fv = jnp.maximum(
+            fv, jax.ops.segment_max(jnp.where(at_first, v, small), gid, num_segments=num_groups + 1)
+        )
+        lv = jnp.maximum(
+            lv, jax.ops.segment_max(jnp.where(at_last, v, small), gid, num_segments=num_groups + 1)
+        )
+    first_val = fv[:num_groups]
+    last_val = lv[:num_groups]
+
+    return WindowStats(
+        count=count,
+        first_ts=first_ts,
+        last_ts=last_ts,
+        first_val=first_val,
+        last_val=last_val,
+        sum=sum_,
+        min=min_,
+        max=max_,
+    )
+
+
+def extrapolated_rate(
+    stats: WindowStats,
+    spec: RangeSpec,
+    kind: str,  # "rate" | "increase" | "delta"
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Prometheus `extrapolatedRate` on window stats; returns (value, defined).
+
+    Port of the semantics in reference
+    promql/src/functions/extrapolate_rate.rs (is_counter = rate/increase,
+    is_rate divides by range seconds).  For counters the caller must have
+    applied `strip_counter_resets` so last-first already includes resets.
+    """
+    num_groups = stats.count.shape[0]
+    n_steps = spec.num_steps
+    w = jnp.arange(num_groups, dtype=jnp.int64) % n_steps
+    t_end = spec.start + w * spec.step
+    t_start = t_end - spec.range_
+
+    defined = stats.count >= 2
+    sampled_interval = (stats.last_ts - stats.first_ts).astype(jnp.float64)
+    safe_count = jnp.maximum(stats.count, 2)
+    avg_between = sampled_interval / (safe_count - 1).astype(jnp.float64)
+    dur_to_start = (stats.first_ts - t_start).astype(jnp.float64)
+    dur_to_end = (t_end - stats.last_ts).astype(jnp.float64)
+    threshold = avg_between * 1.1
+
+    extend_start = jnp.where(dur_to_start < threshold, dur_to_start, avg_between / 2.0)
+    extend_end = jnp.where(dur_to_end < threshold, dur_to_end, avg_between / 2.0)
+
+    result = (stats.last_val - stats.first_val).astype(jnp.float64)
+    if kind in ("rate", "increase"):
+        # Counter: cannot extrapolate below zero at the window start.
+        zero_dur = jnp.where(
+            result > 0,
+            sampled_interval * (stats.first_val / jnp.where(result == 0, 1.0, result)),
+            jnp.asarray(float("inf"), jnp.float64),
+        )
+        extend_start = jnp.minimum(extend_start, jnp.where(zero_dur < 0, extend_start, zero_dur))
+    extrapolate_to = sampled_interval + extend_start + extend_end
+    safe_si = jnp.where(sampled_interval == 0, 1.0, sampled_interval)
+    value = result * (extrapolate_to / safe_si)
+    if kind == "rate":
+        value = value / (spec.range_ / 1000.0)
+    return value, defined
+
+
+def over_time(stats: WindowStats, func: str) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """avg/sum/min/max/count/last_over_time from window stats
+    (reference promql/src/functions/aggr_over_time.rs)."""
+    defined = stats.count >= 1
+    if func == "avg_over_time":
+        return stats.sum / jnp.maximum(stats.count, 1), defined
+    if func == "sum_over_time":
+        return stats.sum, defined
+    if func == "min_over_time":
+        return stats.min, defined
+    if func == "max_over_time":
+        return stats.max, defined
+    if func == "count_over_time":
+        return stats.count.astype(jnp.float64), defined
+    if func == "last_over_time":
+        return stats.last_val, defined
+    raise ValueError(f"unknown over_time func: {func}")

@@ -11,8 +11,14 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-# The query layer uses float64 accumulators to match CPU results.
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+# Force the CPU backend via jax.config, not just env: the TPU tunnel plugin
+# registers itself even when JAX_PLATFORMS=cpu is set late, and every eager
+# op would silently dispatch over the tunnel (~1s each).  The query layer
+# uses float64 accumulators to match CPU results.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,251 @@
+"""Kernel tests: tiles, predicate masks, segmented aggregates, rate.
+
+Every kernel is checked against a straightforward numpy reference — the
+TPU==CPU result-equality bar from SURVEY.md section 7 step 3.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.datatypes import ColumnSchema, ConcreteDataType, Schema, SemanticType
+from greptimedb_tpu.ops.aggregate import (
+    AggState,
+    finalize,
+    group_ids,
+    merge_states,
+    segment_aggregate,
+    time_bucket,
+)
+from greptimedb_tpu.ops.filter import compile_predicate
+from greptimedb_tpu.ops.rate import (
+    RangeSpec,
+    extrapolated_rate,
+    over_time,
+    range_windows,
+    strip_counter_resets,
+)
+from greptimedb_tpu.ops.tiles import padded_size, tiles_from_table
+
+
+def test_padded_size_quantization():
+    assert padded_size(0) == 1024
+    assert padded_size(1) == 1
+    assert padded_size(1000) == 1024
+    assert padded_size(1 << 20) == 1 << 20
+    assert padded_size((1 << 20) + 1, 1 << 20) == 2 << 20
+    # Only O(log) distinct shapes below one tile.
+    sizes = {padded_size(n) for n in range(1, 5000)}
+    assert len(sizes) <= 14
+
+
+def test_tiles_from_table_encoding():
+    t = pa.table(
+        {
+            "host": pa.array(["a", "b", "a", None]),
+            "ts": pa.array([1, 2, 3, 4], pa.timestamp("ms")),
+            "v": pa.array([1.0, None, 3.0, 4.0]),
+        }
+    )
+    batch = tiles_from_table(t, tile_rows=8)
+    assert batch.num_rows == 4
+    assert batch.padded_rows == 4
+    assert batch.dicts["host"] == ["a", "b", None]
+    np.testing.assert_array_equal(np.asarray(batch.columns["host"]), [0, 1, 0, 2])
+    np.testing.assert_array_equal(np.asarray(batch.columns["ts"]), [1, 2, 3, 4])
+    # v nulls: mask False at index 1
+    assert not bool(batch.nulls["v"][1])
+    assert bool(batch.nulls["v"][0])
+    assert bool(batch.valid[3])
+
+
+def test_tiles_pinned_dictionary():
+    t = pa.table({"host": ["x", "y", "z"]})
+    batch = tiles_from_table(t, dicts={"host": {"y": 0, "x": 1}})
+    np.testing.assert_array_equal(np.asarray(batch.columns["host"])[: batch.num_rows], [1, 0, -1])
+    assert batch.dicts["host"] == ["y", "x"]
+
+
+def test_compile_predicate_ops():
+    t = pa.table({"host": ["a", "b", "c", "a"], "v": [1.0, 2.0, 3.0, 4.0]})
+    batch = tiles_from_table(t)
+    mask_fn = compile_predicate(batch, [("host", "in", ["a", "c"]), ("v", ">", 1.5)])
+    mask = np.asarray(mask_fn(batch.columns, batch.valid))[: batch.num_rows]
+    np.testing.assert_array_equal(mask, [False, False, True, True])
+    # String literal not present in batch matches nothing.
+    mask_fn = compile_predicate(batch, [("host", "=", "zzz")])
+    assert not np.asarray(mask_fn(batch.columns, batch.valid)).any()
+    # != on missing literal matches everything valid.
+    mask_fn = compile_predicate(batch, [("host", "!=", "zzz")])
+    assert np.asarray(mask_fn(batch.columns, batch.valid))[:4].all()
+
+
+def _np_groupby(hosts, buckets, vals, mask):
+    out = {}
+    for h, b, v, m in zip(hosts, buckets, vals, mask):
+        if not m:
+            continue
+        key = (h, b)
+        out.setdefault(key, []).append(v)
+    return out
+
+
+def test_segment_aggregate_matches_numpy():
+    rng = np.random.default_rng(42)
+    n, n_hosts, n_buckets = 5000, 7, 12
+    hosts = rng.integers(0, n_hosts, n)
+    ts = rng.integers(0, n_buckets * 1000, n).astype(np.int64)
+    vals = rng.normal(50, 20, n)
+    mask = rng.random(n) > 0.3
+
+    buckets = time_bucket(jnp.asarray(ts), 0, 1000)
+    gids = group_ids(
+        [(jnp.asarray(hosts), n_hosts), (buckets, n_buckets)],
+        jnp.asarray(mask),
+        n_hosts * n_buckets,
+    )
+    state = segment_aggregate(
+        jnp.asarray(vals),
+        gids,
+        n_hosts * n_buckets,
+        aggs=("sum", "count", "min", "max", "avg"),
+        mask=jnp.asarray(mask),
+        acc_dtype=jnp.float64,
+    )
+    out = finalize(state, ("sum", "count", "min", "max", "avg"))
+
+    ref = _np_groupby(hosts, ts // 1000, vals, mask)
+    for (h, b), vs in ref.items():
+        g = h * n_buckets + b
+        assert out["count"][g] == len(vs)
+        np.testing.assert_allclose(out["sum"][g], np.sum(vs), rtol=1e-12)
+        np.testing.assert_allclose(out["avg"][g], np.mean(vs), rtol=1e-12)
+        np.testing.assert_allclose(out["min"][g], np.min(vs))
+        np.testing.assert_allclose(out["max"][g], np.max(vs))
+    # Empty groups flagged.
+    empty = [g for g in range(n_hosts * n_buckets) if (g // n_buckets, g % n_buckets) not in ref]
+    for g in empty[:5]:
+        assert not bool(out["non_empty"][g])
+
+
+def test_merge_states_equals_single_pass():
+    rng = np.random.default_rng(0)
+    n, groups = 2000, 10
+    gids_np = rng.integers(0, groups, n)
+    vals = rng.normal(size=n)
+    mask = np.ones(n, dtype=bool)
+    full = segment_aggregate(
+        jnp.asarray(vals), jnp.asarray(gids_np, dtype=jnp.int32), groups,
+        ("sum", "count", "min", "max"), jnp.asarray(mask), acc_dtype=jnp.float64,
+    )
+    half1 = segment_aggregate(
+        jnp.asarray(vals[: n // 2]), jnp.asarray(gids_np[: n // 2], dtype=jnp.int32), groups,
+        ("sum", "count", "min", "max"), jnp.asarray(mask[: n // 2]), acc_dtype=jnp.float64,
+    )
+    half2 = segment_aggregate(
+        jnp.asarray(vals[n // 2 :]), jnp.asarray(gids_np[n // 2 :], dtype=jnp.int32), groups,
+        ("sum", "count", "min", "max"), jnp.asarray(mask[n // 2 :]), acc_dtype=jnp.float64,
+    )
+    merged = merge_states(half1, half2)
+    np.testing.assert_allclose(np.asarray(merged.sums), np.asarray(full.sums), rtol=1e-12)
+    np.testing.assert_array_equal(np.asarray(merged.counts), np.asarray(full.counts))
+    np.testing.assert_array_equal(np.asarray(merged.mins), np.asarray(full.mins))
+    np.testing.assert_array_equal(np.asarray(merged.maxs), np.asarray(full.maxs))
+
+
+def test_last_value_aggregation():
+    # lastpoint: value at max ts per group.
+    ts = jnp.asarray(np.array([10, 30, 20, 5, 50], dtype=np.int64))
+    vals = jnp.asarray(np.array([1.0, 3.0, 2.0, 9.0, 5.0]))
+    gids = jnp.asarray(np.array([0, 0, 0, 1, 1], dtype=np.int32))
+    state = segment_aggregate(vals, gids, 2, ("last",), jnp.ones(5, dtype=bool), ts=ts, acc_dtype=jnp.float64)
+    out = finalize(state, ("last",))
+    np.testing.assert_array_equal(np.asarray(out["last"]), [3.0, 5.0])
+    np.testing.assert_array_equal(np.asarray(out["last_ts"]), [30, 50])
+
+
+def test_group_ids_overflow_slot():
+    comp = jnp.asarray(np.array([0, 5, -1, 2], dtype=np.int32))
+    mask = jnp.asarray(np.array([True, True, True, False]))
+    gids = group_ids([(comp, 4)], mask, 4)
+    np.testing.assert_array_equal(np.asarray(gids), [0, 4, 4, 4])
+
+
+# ---- rate kernels ----------------------------------------------------------
+
+
+def test_strip_counter_resets():
+    series = jnp.asarray(np.array([0, 0, 0, 1, 1], dtype=np.int32))
+    vals = jnp.asarray(np.array([5.0, 2.0, 4.0, 10.0, 1.0]))  # resets at idx1, idx4
+    valid = jnp.ones(5, dtype=bool)
+    adj = np.asarray(strip_counter_resets(series, vals, valid))
+    np.testing.assert_allclose(adj, [5.0, 7.0, 9.0, 10.0, 11.0])
+
+
+def test_range_windows_and_rate_regular_grid():
+    # One series, perfectly regular 10s scrape, counter increasing 1/s.
+    step = 60_000
+    spec = RangeSpec(start=300_000, end=600_000, step=step, range_=300_000)
+    ts_np = np.arange(0, 600_001, 10_000, dtype=np.int64)
+    vals_np = ts_np / 1000.0  # 1 unit per second
+    n = len(ts_np)
+    series = jnp.zeros(n, dtype=jnp.int32)
+    valid = jnp.ones(n, dtype=bool)
+    adj = strip_counter_resets(series, jnp.asarray(vals_np), valid)
+    stats = range_windows(series, jnp.asarray(ts_np), adj, valid, spec, num_series=1)
+    rate, defined = extrapolated_rate(stats, spec, "rate")
+    rate = np.asarray(rate)[np.asarray(defined)]
+    # Perfect 1/s counter -> rate 1.0 everywhere (extrapolation exact on grid).
+    np.testing.assert_allclose(rate, 1.0, rtol=1e-6)
+
+    inc, defined = extrapolated_rate(stats, spec, "increase")
+    np.testing.assert_allclose(np.asarray(inc)[np.asarray(defined)], 300.0, rtol=1e-6)
+
+
+def test_over_time_functions():
+    spec = RangeSpec(start=100, end=100, step=100, range_=100)  # one window (0,100]
+    series = jnp.zeros(4, dtype=jnp.int32)
+    ts = jnp.asarray(np.array([10, 40, 70, 100], dtype=np.int64))
+    vals = jnp.asarray(np.array([1.0, 5.0, 3.0, 7.0]))
+    valid = jnp.ones(4, dtype=bool)
+    stats = range_windows(series, ts, vals, valid, spec, num_series=1)
+    for func, want in [
+        ("avg_over_time", 4.0),
+        ("sum_over_time", 16.0),
+        ("min_over_time", 1.0),
+        ("max_over_time", 7.0),
+        ("count_over_time", 4.0),
+        ("last_over_time", 7.0),
+    ]:
+        v, d = over_time(stats, func)
+        assert bool(d[0])
+        np.testing.assert_allclose(float(v[0]), want)
+
+
+def test_range_windows_overlapping_windows():
+    # step < range: samples must appear in multiple windows.
+    spec = RangeSpec(start=100, end=300, step=100, range_=200)
+    series = jnp.zeros(3, dtype=jnp.int32)
+    ts = jnp.asarray(np.array([50, 150, 250], dtype=np.int64))
+    vals = jnp.asarray(np.array([1.0, 2.0, 3.0]))
+    valid = jnp.ones(3, dtype=bool)
+    stats = range_windows(series, ts, vals, valid, spec, num_series=1)
+    counts = np.asarray(stats.count)
+    # windows: (−100,100]→{50}, (0,200]→{50,150}, (100,300]→{150,250}
+    np.testing.assert_array_equal(counts, [1, 2, 2])
+    np.testing.assert_allclose(np.asarray(stats.sum), [1.0, 3.0, 5.0])
+
+
+def test_segment_aggregate_under_jit_and_masked_all():
+    @jax.jit
+    def run(vals, gids, mask):
+        return segment_aggregate(vals, gids, 4, ("sum", "count"), mask, acc_dtype=jnp.float64)
+
+    vals = jnp.asarray(np.array([1.0, 2.0, 3.0]))
+    gids = jnp.asarray(np.array([4, 4, 4], dtype=np.int32))  # all overflow
+    mask = jnp.zeros(3, dtype=bool)
+    state = run(vals, gids, mask)
+    assert np.asarray(state.counts).sum() == 0
+    assert np.asarray(state.sums).sum() == 0
