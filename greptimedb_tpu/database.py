@@ -1,0 +1,399 @@
+"""Database: the standalone all-in-one facade.
+
+Role-equivalent of the reference's standalone mode gluing frontend +
+datanode + metadata into one process (reference cmd/src/standalone.rs:327):
+catalog (metadata plane) + TimeSeriesEngine (region engine) + QueryEngine
+(SQL/PromQL) + row routing via partition rules (the reference Inserter's
+split_rows fan-out, operator/src/insert.rs:321).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+
+from .datatypes.data_type import ConcreteDataType
+from .datatypes.schema import ColumnSchema, Schema, SemanticType
+from .models.catalog import DEFAULT_SCHEMA, Catalog, region_id
+from .models.partition import HashPartitionRule, SingleRegionRule
+from .query.engine import QueryEngine
+from .query.logical_plan import TableScan
+from .query.sql_parser import (
+    AdminStmt,
+    CreateDatabaseStmt,
+    CreateTableStmt,
+    DeleteStmt,
+    DescribeStmt,
+    DropStmt,
+    ExplainStmt,
+    InsertStmt,
+    SelectStmt,
+    ShowStmt,
+    TqlStmt,
+    UseStmt,
+    parse_sql,
+)
+from .storage.engine import TimeSeriesEngine
+from .storage.sst import ScanPredicate
+from .utils.config import Config
+from .utils.errors import (
+    InvalidArgumentsError,
+    PlanError,
+    TableNotFoundError,
+    UnsupportedError,
+)
+
+
+class Database:
+    def __init__(self, config: Config | None = None, data_home: str | None = None):
+        self.config = config or Config()
+        if data_home is not None:
+            self.config.storage.data_home = data_home
+            self.config.storage.wal_dir = os.path.join(data_home, "wal")
+            self.config.storage.sst_dir = os.path.join(data_home, "data")
+        self.storage = TimeSeriesEngine(self.config.storage)
+        catalog_path = os.path.join(self.config.storage.data_home, "catalog.json")
+        self.catalog = Catalog(catalog_path)
+        self.current_database = DEFAULT_SCHEMA
+        self.query_engine = QueryEngine(
+            schema_provider=self._schema_of,
+            scan_provider=self._scan,
+            region_scan_provider=self._region_scan,
+            time_bounds_provider=self._time_bounds,
+            config=self.config.query,
+        )
+        self._reopen_regions()
+
+    def close(self):
+        self.storage.close()
+
+    # ---- SQL entry --------------------------------------------------------
+    def sql(self, text: str):
+        """Execute ;-separated SQL; returns a list of results (pa.Table for
+        queries, int affected-rows for writes, None for DDL)."""
+        results = []
+        for stmt in parse_sql(text):
+            results.append(self._execute(stmt))
+        return results
+
+    def sql_one(self, text: str):
+        out = self.sql(text)
+        return out[-1] if out else None
+
+    # ---- dispatch (reference StatementExecutor::execute_stmt) -------------
+    def _execute(self, stmt):
+        if isinstance(stmt, SelectStmt):
+            return self.query_engine.execute_select(stmt, self.current_database)
+        if isinstance(stmt, CreateTableStmt):
+            return self._create_table(stmt)
+        if isinstance(stmt, CreateDatabaseStmt):
+            self.catalog.create_database(stmt.name, if_not_exists=stmt.if_not_exists)
+            return None
+        if isinstance(stmt, DropStmt):
+            return self._drop(stmt)
+        if isinstance(stmt, InsertStmt):
+            return self._insert(stmt)
+        if isinstance(stmt, ShowStmt):
+            return self._show(stmt)
+        if isinstance(stmt, DescribeStmt):
+            return self._describe(stmt)
+        if isinstance(stmt, ExplainStmt):
+            if isinstance(stmt.inner, SelectStmt):
+                return self.query_engine.explain(stmt.inner, self.current_database)
+            raise UnsupportedError("EXPLAIN only supports SELECT")
+        if isinstance(stmt, UseStmt):
+            if stmt.database not in self.catalog.databases():
+                raise InvalidArgumentsError(f"database not found: {stmt.database}")
+            self.current_database = stmt.database
+            return None
+        if isinstance(stmt, AdminStmt):
+            return self._admin(stmt)
+        if isinstance(stmt, TqlStmt):
+            return self._tql(stmt)
+        if isinstance(stmt, DeleteStmt):
+            raise UnsupportedError("DELETE is not supported yet")
+        raise UnsupportedError(f"unsupported statement: {type(stmt).__name__}")
+
+    # ---- DDL --------------------------------------------------------------
+    def _create_table(self, stmt: CreateTableStmt):
+        columns: list[ColumnSchema] = []
+        time_index = stmt.time_index
+        pks = set(stmt.primary_key)
+        for c in stmt.columns:
+            if c.is_time_index:
+                time_index = c.name
+            if c.is_primary_key:
+                pks.add(c.name)
+        for c in stmt.columns:
+            if c.name == time_index:
+                sem = SemanticType.TIMESTAMP
+            elif c.name in pks:
+                sem = SemanticType.TAG
+            else:
+                sem = SemanticType.FIELD
+            columns.append(
+                ColumnSchema(
+                    name=c.name,
+                    data_type=ConcreteDataType.parse(c.type_name),
+                    semantic_type=sem,
+                    nullable=c.nullable and sem == SemanticType.FIELD,
+                    default=c.default,
+                )
+            )
+        if time_index is None:
+            raise InvalidArgumentsError("table requires a TIME INDEX column")
+        schema = Schema(columns=columns)
+        rule = SingleRegionRule()
+        if stmt.partition_by_hash is not None:
+            cols, n = stmt.partition_by_hash
+            rule = HashPartitionRule(cols, n)
+        meta = self.catalog.create_table(
+            stmt.name,
+            schema,
+            partition_rule=rule,
+            database=self.current_database,
+            if_not_exists=stmt.if_not_exists,
+            options=stmt.options,
+        )
+        for rid in meta.region_ids:
+            self.storage.create_region(rid, schema)
+        return None
+
+    def _drop(self, stmt: DropStmt):
+        if stmt.kind == "database":
+            for meta in self.catalog.tables(stmt.name):
+                for rid in meta.region_ids:
+                    self.storage.drop_region(rid)
+            self.catalog.drop_database(stmt.name)
+            return None
+        if stmt.if_exists and not self.catalog.has_table(stmt.name, self.current_database):
+            return None
+        meta = self.catalog.drop_table(stmt.name, self.current_database)
+        for rid in meta.region_ids:
+            self.storage.drop_region(rid)
+        return None
+
+    # ---- DML --------------------------------------------------------------
+    def _insert(self, stmt: InsertStmt) -> int:
+        meta = self.catalog.table(stmt.table, self.current_database)
+        schema = meta.schema
+        columns = stmt.columns or schema.column_names()
+        if any(not schema.has_column(c) for c in columns):
+            bad = [c for c in columns if not schema.has_column(c)]
+            raise InvalidArgumentsError(f"unknown columns in INSERT: {bad}")
+        arrays = []
+        fields = []
+        by_name = {c: [row[i] for row in stmt.rows] for i, c in enumerate(columns)}
+        for col in schema.columns:
+            field = col.to_arrow()
+            if col.name in by_name:
+                values = by_name[col.name]
+            else:
+                values = [col.default] * len(stmt.rows)
+            arrays.append(_coerce_array(values, col))
+            fields.append(field)
+        batch = pa.RecordBatch.from_arrays(arrays, schema=schema.to_arrow())
+        return self.write_batch(meta, batch)
+
+    def write_batch(self, meta, batch: pa.RecordBatch) -> int:
+        """Route rows to regions via the partition rule and write each
+        (the reference Inserter fan-out)."""
+        table = pa.Table.from_batches([batch])
+        affected = 0
+        parts = meta.partition_rule.split(table)
+        for i, part in enumerate(parts):
+            if part.num_rows == 0:
+                continue
+            rid = region_id(meta.table_id, i)
+            for b in part.to_batches():
+                affected += self.storage.write(rid, b)
+        return affected
+
+    # ---- ingest API (line-protocol style, used by servers/) ---------------
+    def insert_rows(self, table: str, rows: pa.Table | pa.RecordBatch, database: str | None = None) -> int:
+        meta = self.catalog.table(table, database or self.current_database)
+        if isinstance(rows, pa.Table):
+            rows = rows.combine_chunks()
+            batches = rows.to_batches()
+        else:
+            batches = [rows]
+        total = 0
+        for b in batches:
+            total += self.write_batch(meta, _conform_batch(b, meta.schema))
+        return total
+
+    # ---- SHOW/DESCRIBE ----------------------------------------------------
+    def _show(self, stmt: ShowStmt):
+        if stmt.what == "tables":
+            names = [m.name for m in self.catalog.tables(self.current_database)]
+            if stmt.like:
+                import fnmatch
+
+                names = [n for n in names if fnmatch.fnmatch(n, stmt.like.replace("%", "*"))]
+            return pa.table({"Tables": names})
+        if stmt.what == "databases":
+            return pa.table({"Database": self.catalog.databases()})
+        if stmt.what == "create_table":
+            meta = self.catalog.table(stmt.target, self.current_database)
+            return pa.table({"Table": [meta.name], "Create Table": [_render_create(meta)]})
+        raise UnsupportedError(f"unsupported SHOW {stmt.what}")
+
+    def _describe(self, stmt: DescribeStmt):
+        meta = self.catalog.table(stmt.table, self.current_database)
+        rows = {
+            "Column": [],
+            "Type": [],
+            "Key": [],
+            "Null": [],
+            "Default": [],
+            "Semantic Type": [],
+        }
+        for c in meta.schema.columns:
+            rows["Column"].append(c.name)
+            rows["Type"].append(c.data_type.value)
+            rows["Key"].append("PRI" if c.semantic_type == SemanticType.TAG else "")
+            rows["Null"].append("YES" if c.nullable else "NO")
+            rows["Default"].append(str(c.default) if c.default is not None else "")
+            rows["Semantic Type"].append(
+                {SemanticType.TAG: "TAG", SemanticType.FIELD: "FIELD", SemanticType.TIMESTAMP: "TIMESTAMP"}[
+                    c.semantic_type
+                ]
+            )
+        return pa.table(rows)
+
+    # ---- ADMIN ------------------------------------------------------------
+    def _admin(self, stmt: AdminStmt):
+        f = stmt.func.lower()
+        if f == "flush_table":
+            meta = self.catalog.table(str(stmt.args[0]), self.current_database)
+            for rid in meta.region_ids:
+                self.storage.flush_region(rid)
+            return pa.table({"result": [0]})
+        if f == "flush_region":
+            self.storage.flush_region(int(stmt.args[0]))
+            return pa.table({"result": [0]})
+        if f == "compact_table":
+            from .storage.compaction import compact_region
+
+            meta = self.catalog.table(str(stmt.args[0]), self.current_database)
+            for rid in meta.region_ids:
+                compact_region(self.storage.region(rid))
+            return pa.table({"result": [0]})
+        raise UnsupportedError(f"unknown admin function: {stmt.func}")
+
+    # ---- TQL (PromQL-in-SQL) ----------------------------------------------
+    def _tql(self, stmt: TqlStmt):
+        from .query.promql.engine import PromqlEngine
+
+        engine = PromqlEngine(self)
+        return engine.query_range(
+            stmt.query,
+            start_ms=int(stmt.start * 1000),
+            end_ms=int(stmt.end * 1000),
+            step_ms=int(stmt.step * 1000),
+        )
+
+    # ---- providers for the query engine ------------------------------------
+    def _schema_of(self, table: str, database: str) -> Schema:
+        return self.catalog.table(table, database).schema
+
+    def _pred_of(self, scan: TableScan) -> ScanPredicate:
+        return ScanPredicate(
+            time_range=scan.time_range, filters=[tuple(f) for f in scan.filters]
+        )
+
+    def _region_scan(self, scan: TableScan) -> list[pa.Table]:
+        meta = self.catalog.table(scan.table, scan.database)
+        pred = self._pred_of(scan)
+        return [self.storage.scan(rid, pred) for rid in meta.region_ids]
+
+    def _scan(self, scan: TableScan) -> pa.Table:
+        if not scan.table:
+            return pa.table({"__dummy": [0]})  # constant SELECTs
+        tables = [t for t in self._region_scan(scan) if t.num_rows]
+        meta = self.catalog.table(scan.table, scan.database)
+        if not tables:
+            return meta.schema.to_arrow().empty_table()
+        return pa.concat_tables(tables, promote_options="permissive")
+
+    def _time_bounds(self, table: str, database: str) -> tuple[int, int]:
+        """Min/max time over a table, from SST metadata + memtable ranges
+        (no data scan — the reference prunes from FileMeta the same way)."""
+        meta = self.catalog.table(table, database)
+        lo, hi = None, None
+        for rid in meta.region_ids:
+            region = self.storage.region(rid)
+            for fm in region.files():
+                lo = fm.time_range[0] if lo is None else min(lo, fm.time_range[0])
+                hi = fm.time_range[1] if hi is None else max(hi, fm.time_range[1])
+            for mem in [region.memtable] + region._frozen_memtables:
+                r = mem.time_range()
+                if r is not None:
+                    lo = r[0] if lo is None else min(lo, r[0])
+                    hi = r[1] if hi is None else max(hi, r[1])
+        if lo is None:
+            return (0, 0)
+        return (lo, hi)
+
+    # ---- recovery ---------------------------------------------------------
+    def _reopen_regions(self):
+        for db in self.catalog.databases():
+            for meta in self.catalog.tables(db):
+                for rid in meta.region_ids:
+                    try:
+                        self.storage.open_region(rid)
+                    except Exception:
+                        self.storage.create_region(rid, meta.schema)
+
+
+def _coerce_array(values: list, col: ColumnSchema) -> pa.Array:
+    t = col.data_type.to_arrow()
+    if col.data_type.is_timestamp():
+        unit_ms = col.data_type.timestamp_unit_ns() // 1_000_000
+        coerced = []
+        for v in values:
+            if isinstance(v, str):
+                import datetime
+
+                dt = datetime.datetime.fromisoformat(v.replace(" ", "T"))
+                if dt.tzinfo is None:
+                    dt = dt.replace(tzinfo=datetime.timezone.utc)
+                coerced.append(int(dt.timestamp() * 1000) // max(unit_ms, 1))
+            else:
+                coerced.append(None if v is None else int(v))
+        return pa.array(coerced, t)
+    return pa.array(values, t)
+
+
+def _conform_batch(batch: pa.RecordBatch, schema: Schema) -> pa.RecordBatch:
+    """Reorder/cast incoming batch columns to the table schema."""
+    arrays = []
+    for col in schema.columns:
+        i = batch.schema.get_field_index(col.name)
+        if i < 0:
+            arrays.append(pa.nulls(batch.num_rows, col.data_type.to_arrow()))
+        else:
+            arr = batch.column(i)
+            want = col.data_type.to_arrow()
+            if arr.type != want:
+                arr = arr.cast(want)
+            arrays.append(arr)
+    return pa.RecordBatch.from_arrays(arrays, schema=schema.to_arrow())
+
+
+def _render_create(meta) -> str:
+    cols = []
+    for c in meta.schema.columns:
+        line = f'  "{c.name}" {c.data_type.value.upper()}'
+        if not c.nullable:
+            line += " NOT NULL"
+        cols.append(line)
+    if meta.schema.time_index:
+        cols.append(f'  TIME INDEX ("{meta.schema.time_index.name}")')
+    pk = meta.schema.primary_key()
+    if pk:
+        cols.append(f"  PRIMARY KEY ({', '.join(repr(p)[1:-1] for p in pk)})")
+    body = ",\n".join(cols)
+    return f'CREATE TABLE "{meta.name}" (\n{body}\n)'

@@ -1,0 +1,202 @@
+"""Catalog: databases -> tables -> regions, persisted in a KV-style store.
+
+Role-equivalent of the reference's catalog + table metadata plane
+(reference catalog/src/kvbackend/, common/meta/src/key.rs:389
+`TableMetadataManager`): table ids are allocated from a sequence, table
+metadata (schema, partition rule, region ids) lives in a JSON KV file, and
+region ids are derived as table_id * MAX_REGIONS + seq (matching the
+reference's RegionId = (table_id << 32) | region_seq encoding).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+
+from ..datatypes.schema import Schema
+from ..utils.errors import (
+    DatabaseNotFoundError,
+    TableAlreadyExistsError,
+    TableNotFoundError,
+)
+from .partition import PartitionRule, SingleRegionRule
+
+MAX_REGIONS_PER_TABLE = 1 << 10
+DEFAULT_CATALOG = "greptime"
+DEFAULT_SCHEMA = "public"
+
+
+def region_id(table_id: int, seq: int) -> int:
+    return table_id * MAX_REGIONS_PER_TABLE + seq
+
+
+@dataclass
+class TableMeta:
+    table_id: int
+    name: str
+    database: str
+    schema: Schema
+    partition_rule: PartitionRule = field(default_factory=SingleRegionRule)
+    options: dict = field(default_factory=dict)
+
+    @property
+    def region_ids(self) -> list[int]:
+        return [
+            region_id(self.table_id, i)
+            for i in range(self.partition_rule.num_partitions())
+        ]
+
+    def to_dict(self) -> dict:
+        return {
+            "table_id": self.table_id,
+            "name": self.name,
+            "database": self.database,
+            "schema": self.schema.to_json(),
+            "partition_rule": self.partition_rule.to_dict(),
+            "options": self.options,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TableMeta":
+        return cls(
+            table_id=d["table_id"],
+            name=d["name"],
+            database=d["database"],
+            schema=Schema.from_json(d["schema"]),
+            partition_rule=PartitionRule.from_dict(d["partition_rule"]),
+            options=d.get("options", {}),
+        )
+
+
+class Catalog:
+    """In-process catalog with optional file persistence.
+
+    With `path=None` it is the reference's memory catalog (tests); with a
+    path it journals every mutation, the reference's KV-backed catalog.
+    """
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._lock = threading.RLock()
+        self._databases: dict[str, dict[str, TableMeta]] = {DEFAULT_SCHEMA: {}}
+        self._next_table_id = 1024  # reference reserves low ids for system tables
+        if path and os.path.exists(path):
+            self._load()
+
+    # ---- databases --------------------------------------------------------
+    def create_database(self, name: str, if_not_exists: bool = False):
+        with self._lock:
+            if name in self._databases:
+                if if_not_exists:
+                    return
+                raise TableAlreadyExistsError(f"database {name!r} already exists")
+            self._databases[name] = {}
+            self._persist()
+
+    def drop_database(self, name: str):
+        with self._lock:
+            if name not in self._databases:
+                raise DatabaseNotFoundError(f"database not found: {name}")
+            if name == DEFAULT_SCHEMA:
+                raise DatabaseNotFoundError("cannot drop the default database")
+            del self._databases[name]
+            self._persist()
+
+    def databases(self) -> list[str]:
+        with self._lock:
+            return sorted(self._databases)
+
+    # ---- tables -----------------------------------------------------------
+    def create_table(
+        self,
+        name: str,
+        schema: Schema,
+        partition_rule: PartitionRule | None = None,
+        database: str = DEFAULT_SCHEMA,
+        if_not_exists: bool = False,
+        options: dict | None = None,
+    ) -> TableMeta:
+        with self._lock:
+            db = self._db(database)
+            if name in db:
+                if if_not_exists:
+                    return db[name]
+                raise TableAlreadyExistsError(f"table {name!r} already exists")
+            meta = TableMeta(
+                table_id=self._next_table_id,
+                name=name,
+                database=database,
+                schema=schema,
+                partition_rule=partition_rule or SingleRegionRule(),
+                options=options or {},
+            )
+            self._next_table_id += 1
+            db[name] = meta
+            self._persist()
+            return meta
+
+    def drop_table(self, name: str, database: str = DEFAULT_SCHEMA) -> TableMeta:
+        with self._lock:
+            db = self._db(database)
+            if name not in db:
+                raise TableNotFoundError(f"table not found: {name}")
+            meta = db.pop(name)
+            self._persist()
+            return meta
+
+    def table(self, name: str, database: str = DEFAULT_SCHEMA) -> TableMeta:
+        with self._lock:
+            db = self._db(database)
+            if name not in db:
+                raise TableNotFoundError(f"table not found: {database}.{name}")
+            return db[name]
+
+    def has_table(self, name: str, database: str = DEFAULT_SCHEMA) -> bool:
+        with self._lock:
+            return name in self._databases.get(database, {})
+
+    def tables(self, database: str = DEFAULT_SCHEMA) -> list[TableMeta]:
+        with self._lock:
+            return sorted(self._db(database).values(), key=lambda m: m.name)
+
+    def update_table(self, meta: TableMeta):
+        with self._lock:
+            self._db(meta.database)[meta.name] = meta
+            self._persist()
+
+    # ---- persistence ------------------------------------------------------
+    def _db(self, database: str) -> dict[str, TableMeta]:
+        if database not in self._databases:
+            raise DatabaseNotFoundError(f"database not found: {database}")
+        return self._databases[database]
+
+    def _persist(self):
+        if not self.path:
+            return
+        state = {
+            "next_table_id": self._next_table_id,
+            "databases": {
+                db: {name: meta.to_dict() for name, meta in tables.items()}
+                for db, tables in self._databases.items()
+            },
+        }
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def _load(self):
+        with open(self.path) as f:
+            state = json.load(f)
+        self._next_table_id = state["next_table_id"]
+        self._databases = {
+            db: {name: TableMeta.from_dict(d) for name, d in tables.items()}
+            for db, tables in state["databases"].items()
+        }
+        if DEFAULT_SCHEMA not in self._databases:
+            self._databases[DEFAULT_SCHEMA] = {}

@@ -1,0 +1,123 @@
+"""Region partition rules: route rows to regions.
+
+Role-equivalent of the reference's expression-based partitioning
+(reference partition/src/multi_dim.rs `MultiDimPartitionRule`,
+manager.rs:192 `split_rows`): a table's rows are split across regions by a
+rule evaluated per row.  We provide three rules:
+
+  SingleRegionRule  — everything in one region (default, like an
+                      unpartitioned reference table)
+  HashPartitionRule — hash(tag columns) % n, the common TSBS layout
+  RangePartitionRule— ordered ranges over one column's values, the
+                      reference's PARTITION ON COLUMNS surface
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, field
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+
+class PartitionRule:
+    def num_partitions(self) -> int:
+        raise NotImplementedError
+
+    def partition_indices(self, table: pa.Table) -> np.ndarray:
+        """Per-row partition index [0, num_partitions)."""
+        raise NotImplementedError
+
+    def split(self, table: pa.Table) -> list[pa.Table]:
+        """Split rows into per-partition tables (reference split_rows)."""
+        n = self.num_partitions()
+        if n == 1 or table.num_rows == 0:
+            return [table] + [table.schema.empty_table() for _ in range(n - 1)]
+        idx = self.partition_indices(table)
+        return [table.filter(pa.array(idx == p)) for p in range(n)]
+
+    def to_dict(self) -> dict:
+        raise NotImplementedError
+
+    @staticmethod
+    def from_dict(d: dict) -> "PartitionRule":
+        kind = d["kind"]
+        if kind == "single":
+            return SingleRegionRule()
+        if kind == "hash":
+            return HashPartitionRule(d["columns"], d["n"])
+        if kind == "range":
+            return RangePartitionRule(d["column"], d["bounds"])
+        raise ValueError(f"unknown partition rule kind: {kind}")
+
+
+@dataclass
+class SingleRegionRule(PartitionRule):
+    def num_partitions(self) -> int:
+        return 1
+
+    def partition_indices(self, table: pa.Table) -> np.ndarray:
+        return np.zeros(table.num_rows, dtype=np.int32)
+
+    def to_dict(self) -> dict:
+        return {"kind": "single"}
+
+
+@dataclass
+class HashPartitionRule(PartitionRule):
+    columns: list[str]
+    n: int
+
+    def num_partitions(self) -> int:
+        return self.n
+
+    def partition_indices(self, table: pa.Table) -> np.ndarray:
+        h = np.zeros(table.num_rows, dtype=np.uint64)
+        for c in self.columns:
+            col = table[c]
+            if pa.types.is_dictionary(col.type):
+                col = pc.cast(col, col.type.value_type)
+            vals = col.to_pylist()
+            # crc32 per distinct value, broadcast via a small cache — stable
+            # across processes (unlike Python hash()).
+            cache: dict = {}
+            hc = np.empty(table.num_rows, dtype=np.uint64)
+            for i, v in enumerate(vals):
+                if v not in cache:
+                    cache[v] = zlib.crc32(repr(v).encode())
+                hc[i] = cache[v]
+            h = h * np.uint64(1000003) + hc
+        return (h % np.uint64(self.n)).astype(np.int32)
+
+    def to_dict(self) -> dict:
+        return {"kind": "hash", "columns": self.columns, "n": self.n}
+
+
+@dataclass
+class RangePartitionRule(PartitionRule):
+    """Ranges over one column: bounds [b0, b1, ...] define len(bounds)+1
+    partitions: (-inf, b0), [b0, b1), ..., [bn, +inf)."""
+
+    column: str
+    bounds: list = field(default_factory=list)
+
+    def num_partitions(self) -> int:
+        return len(self.bounds) + 1
+
+    def partition_indices(self, table: pa.Table) -> np.ndarray:
+        vals = table[self.column].to_pylist()
+        out = np.empty(table.num_rows, dtype=np.int32)
+        for i, v in enumerate(vals):
+            p = 0
+            for b in self.bounds:
+                if v is not None and v >= b:
+                    p += 1
+                else:
+                    break
+            out[i] = p
+        return out
+
+    def to_dict(self) -> dict:
+        return {"kind": "range", "column": self.column, "bounds": self.bounds}

@@ -1,0 +1,4 @@
+from .mesh import make_mesh, local_device_count
+from .executor import DistGroupByPlan, distributed_groupby
+
+__all__ = ["make_mesh", "local_device_count", "DistGroupByPlan", "distributed_groupby"]

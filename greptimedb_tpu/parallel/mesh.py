@@ -1,0 +1,38 @@
+"""Device mesh construction for distributed query execution.
+
+The DB analogue of the reference's cluster topology: the mesh's `regions`
+axis plays the role of datanodes (each device scans+partially aggregates its
+region shard, reference merge_scan.rs fan-out), and the merge happens with
+XLA collectives over ICI instead of N:1 Flight streams.  Multi-host pods
+extend the same mesh over DCN — jax arranges the collectives; we only
+annotate shardings (scaling-book recipe).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+REGION_AXIS = "regions"
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def make_mesh(n_devices: int | None = None, axis: str = REGION_AXIS) -> Mesh:
+    """1-D mesh over (up to) n_devices local devices.
+
+    A 1-D `regions` axis is the right shape for scan fan-out + all-reduce
+    merge; model-parallel style 2-D meshes are unnecessary because the DB
+    hot path has no weight matrices to shard.
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices but only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))

@@ -1,0 +1,3 @@
+from .engine import QueryEngine
+
+__all__ = ["QueryEngine"]

@@ -1,0 +1,387 @@
+"""CPU executor: the authoritative Arrow-compute execution path.
+
+Role-equivalent of running the reference's plans on DataFusion's CPU
+operators — this path defines correct results; the TPU path must match it
+(SURVEY.md section 7 step 3's "CPU path authoritative" rule).  Evaluates
+logical plans over pyarrow tables with pyarrow.compute kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..utils.errors import ExecutionError, PlanError
+from .expr import (
+    AggCall,
+    Alias,
+    Between,
+    BinaryOp,
+    Column,
+    Expr,
+    FuncCall,
+    InList,
+    IsNull,
+    Literal,
+    Star,
+    UnaryOp,
+    find_agg_calls,
+    strip_alias,
+)
+from .logical_plan import (
+    Aggregate,
+    Filter,
+    Having,
+    Limit,
+    LogicalPlan,
+    Project,
+    Sort,
+    TableScan,
+)
+
+# ---- expression evaluation -------------------------------------------------
+
+
+def eval_expr(e: Expr, table: pa.Table):
+    """Evaluate an expression to an Arrow array (or scalar for literals)."""
+    if isinstance(e, Alias):
+        return eval_expr(e.expr, table)
+    if isinstance(e, Column):
+        if e.column not in table.column_names:
+            raise PlanError(f"unknown column: {e.column}")
+        col = table[e.column]
+        if pa.types.is_dictionary(col.type):
+            col = pc.cast(col, col.type.value_type)
+        return col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    if isinstance(e, Literal):
+        return pa.scalar(e.value)
+    if isinstance(e, BinaryOp):
+        return _eval_binary(e, table)
+    if isinstance(e, UnaryOp):
+        v = eval_expr(e.operand, table)
+        if e.op == "not":
+            return pc.invert(v)
+        if e.op == "-":
+            return pc.negate(v)
+        raise PlanError(f"unknown unary op {e.op}")
+    if isinstance(e, InList):
+        v = eval_expr(e.expr, table)
+        m = pc.is_in(v, value_set=pa.array(list(e.values)))
+        return pc.invert(m) if e.negated else m
+    if isinstance(e, Between):
+        v = eval_expr(e.expr, table)
+        lo = eval_expr(e.low, table)
+        hi = eval_expr(e.high, table)
+        m = pc.and_kleene(pc.greater_equal(v, lo), pc.less_equal(v, hi))
+        return pc.invert(m) if e.negated else m
+    if isinstance(e, IsNull):
+        v = eval_expr(e.expr, table)
+        m = pc.is_null(v)
+        return pc.invert(m) if e.negated else m
+    if isinstance(e, FuncCall):
+        return _eval_func(e, table)
+    raise PlanError(f"cannot evaluate expression: {e!r}")
+
+
+def _eval_binary(e: BinaryOp, table: pa.Table):
+    l = eval_expr(e.left, table)
+    r = eval_expr(e.right, table)
+    op = e.op
+    if op == "and":
+        return pc.and_kleene(l, r)
+    if op == "or":
+        return pc.or_kleene(l, r)
+    if op == "like":
+        pattern = r.as_py() if isinstance(r, pa.Scalar) else r
+        regex = pattern.replace("%", ".*").replace("_", ".")
+        return pc.match_substring_regex(l, f"^{regex}$")
+    cmp = {
+        "=": pc.equal,
+        "!=": pc.not_equal,
+        "<": pc.less,
+        "<=": pc.less_equal,
+        ">": pc.greater,
+        ">=": pc.greater_equal,
+    }
+    if op in cmp:
+        l, r = _align_ts(l, r)
+        return cmp[op](l, r)
+    arith = {"+": pc.add, "-": pc.subtract, "*": pc.multiply, "/": pc.divide, "%": _mod}
+    if op in arith:
+        return arith[op](l, r)
+    raise PlanError(f"unknown binary op {op}")
+
+
+def _mod(l, r):
+    ln = np.asarray(l)
+    rn = r.as_py() if isinstance(r, pa.Scalar) else np.asarray(r)
+    return pa.array(np.mod(ln, rn))
+
+
+def _align_ts(l, r):
+    """Compare timestamp columns against int/string literals sanely."""
+    def is_ts(x):
+        t = x.type if isinstance(x, (pa.Array, pa.ChunkedArray, pa.Scalar)) else None
+        return t is not None and pa.types.is_timestamp(t)
+
+    if is_ts(l) and isinstance(r, pa.Scalar) and not is_ts(r):
+        rv = r.as_py()
+        if isinstance(rv, (int, float)):
+            return pc.cast(l, pa.int64()), pa.scalar(int(rv))
+        if isinstance(rv, str):
+            return l, pa.scalar(np.datetime64(rv.replace(" ", "T"), "ms").astype("datetime64[ms]")).cast(l.type)
+    if is_ts(r) and isinstance(l, pa.Scalar) and not is_ts(l):
+        rr, ll = _align_ts(r, l)
+        return ll, rr
+    return l, r
+
+
+def _eval_func(e: FuncCall, table: pa.Table):
+    f = e.func
+    args = e.args
+    if f in ("time_bucket", "date_bin"):
+        # time_bucket(interval, ts) / date_bin(interval, ts[, origin])
+        interval = _interval_ms(args[0], table)
+        ts = eval_expr(args[1], table)
+        origin = 0
+        if len(args) > 2:
+            o = eval_expr(args[2], table)
+            origin = o.as_py() if isinstance(o, pa.Scalar) else 0
+        t_int = pc.cast(ts, pa.int64())
+        unit = ts.type.unit if pa.types.is_timestamp(ts.type) else "ms"
+        unit_ms = {"s": 0.001, "ms": 1, "us": 1000, "ns": 1_000_000}[unit]
+        iv_native = max(int(interval / unit_ms), 1) if unit_ms >= 1 else int(interval * 1000)
+        bucketed = pc.multiply(pc.floor(pc.divide(pc.subtract(t_int, origin), iv_native)), iv_native)
+        bucketed = pc.add(pc.cast(bucketed, pa.int64()), origin)
+        return pc.cast(bucketed, ts.type if pa.types.is_timestamp(ts.type) else pa.int64())
+    if f == "date_trunc":
+        unit = args[0].value if isinstance(args[0], Literal) else "hour"
+        ts = eval_expr(args[1], table)
+        return pc.floor_temporal(ts, unit=unit)
+    if f == "cast":
+        v = eval_expr(args[0], table)
+        from ..datatypes.data_type import ConcreteDataType
+
+        target = ConcreteDataType.parse(args[1].value)
+        return pc.cast(v, target.to_arrow())
+    if f == "case":
+        flat = [eval_expr(a, table) for a in args]
+        conds, vals = flat[:-1:2], flat[1:-1:2]
+        default = flat[-1]
+        n = table.num_rows
+        out = None
+        for cond, val in zip(reversed(conds), reversed(vals)):
+            base = out if out is not None else (
+                pa.array([default.as_py()] * n) if isinstance(default, pa.Scalar) else default
+            )
+            val_arr = pa.array([val.as_py()] * n) if isinstance(val, pa.Scalar) else val
+            out = pc.if_else(cond, val_arr, base)
+        return out if out is not None else default
+    simple = {
+        "abs": pc.abs, "round": pc.round, "floor": pc.floor, "ceil": pc.ceil,
+        "sqrt": pc.sqrt, "ln": pc.ln, "log10": pc.log10, "log2": pc.log2,
+        "exp": pc.exp, "sin": pc.sin, "cos": pc.cos, "tan": pc.tan,
+        "lower": pc.utf8_lower, "upper": pc.utf8_upper, "length": pc.utf8_length,
+        "trim": pc.utf8_trim_whitespace,
+    }
+    if f in simple:
+        return simple[f](eval_expr(args[0], table))
+    if f == "pow" or f == "power":
+        return pc.power(eval_expr(args[0], table), eval_expr(args[1], table))
+    if f == "coalesce":
+        vals = [eval_expr(a, table) for a in args]
+        return pc.coalesce(*vals)
+    if f == "now":
+        import time
+
+        return pa.scalar(int(time.time() * 1000), pa.timestamp("ms"))
+    raise PlanError(f"unknown function: {f}")
+
+
+def _interval_ms(e: Expr, table) -> int:
+    from .sql_parser import _parse_interval
+
+    if isinstance(e, Literal):
+        if isinstance(e.value, str):
+            return _parse_interval(e.value)
+        return int(e.value)
+    raise PlanError("interval argument must be a literal")
+
+
+# ---- plan execution --------------------------------------------------------
+
+
+class CpuExecutor:
+    """Executes a logical plan; scans are served by a callback so the same
+    executor runs standalone (local engine) or as the datanode-side stage
+    of a shipped sub-plan."""
+
+    def __init__(self, scan_provider):
+        # scan_provider(scan: TableScan) -> pa.Table
+        self.scan = scan_provider
+
+    def execute(self, plan: LogicalPlan) -> pa.Table:
+        if isinstance(plan, TableScan):
+            return self.scan(plan)
+        if isinstance(plan, Filter):
+            t = self.execute(plan.input)
+            mask = eval_expr(plan.predicate, t)
+            if isinstance(mask, pa.Scalar):
+                return t if mask.as_py() else t.schema.empty_table()
+            return t.filter(mask)
+        if isinstance(plan, Project):
+            t = self.execute(plan.input)
+            return self._project(plan.exprs, t)
+        if isinstance(plan, Aggregate):
+            t = self.execute(plan.input)
+            return self._aggregate(plan, t)
+        if isinstance(plan, Having):
+            t = self.execute(plan.input)
+            mask = eval_expr(_rewrite_agg_refs(plan.predicate, t), t)
+            return t.filter(mask)
+        if isinstance(plan, Sort):
+            t = self.execute(plan.input)
+            return self._sort(plan, t)
+        if isinstance(plan, Limit):
+            t = self.execute(plan.input)
+            return t.slice(plan.offset, plan.limit)
+        raise ExecutionError(f"unknown plan node: {plan!r}")
+
+    # ---- helpers ----------------------------------------------------------
+    def _project(self, exprs: list[Expr], t: pa.Table) -> pa.Table:
+        cols, names = [], []
+        for e in exprs:
+            if isinstance(e, Star):
+                for name in t.column_names:
+                    if name.startswith("__"):
+                        continue
+                    cols.append(t[name])
+                    names.append(name)
+                continue
+            name = e.alias if isinstance(e, Alias) else e.name()
+            inner = strip_alias(e)
+            # After aggregation the table already holds agg outputs by name.
+            if inner.name() in t.column_names:
+                cols.append(t[inner.name()])
+            elif isinstance(e, Alias) and e.alias in t.column_names:
+                cols.append(t[e.alias])
+            else:
+                v = eval_expr(inner, t)
+                if isinstance(v, pa.Scalar):
+                    v = pa.array([v.as_py()] * max(t.num_rows, 1))
+                cols.append(v)
+            names.append(name)
+        return pa.table(dict(zip(names, cols))) if names else t
+
+    def _aggregate(self, plan: Aggregate, t: pa.Table) -> pa.Table:
+        group_names = []
+        work = t
+        # Materialize group key expressions as columns.
+        for ge in plan.group_exprs:
+            name = ge.name()
+            inner = strip_alias(ge)
+            if isinstance(inner, Column):
+                name = inner.column
+            else:
+                arr = eval_expr(inner, work)
+                if isinstance(arr, pa.Scalar):
+                    arr = pa.array([arr.as_py()] * work.num_rows)
+                work = work.append_column(name, arr)
+            group_names.append(name)
+
+        # Materialize aggregate argument columns, collect (col, fn, out_name).
+        specs: list[tuple[str, str]] = []
+        out_names: list[str] = []
+        post_divide: list[tuple[str, str, str]] = []
+        for ae in plan.agg_exprs:
+            for agg in find_agg_calls(ae):
+                out_name = agg.name()
+                if out_name in out_names:
+                    continue
+                fn = agg.func
+                if fn == "count" and agg.arg is None:
+                    if "__one" not in work.column_names:
+                        work = work.append_column("__one", pa.array(np.ones(work.num_rows, dtype=np.int64)))
+                    specs.append(("__one", "sum"))
+                    out_names.append(out_name)
+                    continue
+                argname = f"__agg_{len(specs)}"
+                arr = eval_expr(agg.arg, work)
+                if isinstance(arr, pa.Scalar):
+                    arr = pa.array([arr.as_py()] * work.num_rows)
+                if pa.types.is_dictionary(arr.type):
+                    arr = pc.cast(arr, arr.type.value_type)
+                work = work.append_column(argname, arr)
+                pa_fn = {
+                    "sum": "sum", "avg": "mean", "min": "min", "max": "max",
+                    "count": "count", "stddev": "stddev", "stddev_pop": "stddev",
+                    "var": "variance", "var_pop": "variance",
+                    "last_value": "last", "first_value": "first",
+                    "approx_percentile_cont": "approximate_median", "percentile": "approximate_median",
+                }.get(fn)
+                if pa_fn is None:
+                    raise PlanError(f"unsupported aggregate: {fn}")
+                if fn in ("last_value", "first_value") and agg.order_by:
+                    work = _sorted_by(work, agg.order_by)
+                specs.append((argname, pa_fn))
+                out_names.append(out_name)
+
+        if not group_names:
+            # Global aggregate (no GROUP BY): aggregate whole table.
+            cols = {}
+            for (argname, pa_fn), out_name in zip(specs, out_names):
+                cols[out_name] = [_global_agg(work[argname], pa_fn)]
+            return pa.table(cols)
+
+        gb = work.group_by(group_names, use_threads=False)
+        result = gb.aggregate(specs)
+        # pyarrow names outputs "{col}_{fn}"; rename to our agg names.
+        rename = {}
+        for (argname, pa_fn), out_name in zip(specs, out_names):
+            rename[f"{argname}_{pa_fn}"] = out_name
+        new_names = [rename.get(n, n) for n in result.column_names]
+        return result.rename_columns(new_names)
+
+    def _sort(self, plan: Sort, t: pa.Table) -> pa.Table:
+        keys = []
+        work = t
+        for e, asc in plan.keys:
+            inner = strip_alias(e)
+            name = inner.name() if not isinstance(inner, Column) else inner.column
+            if name not in work.column_names:
+                arr = eval_expr(inner, work)
+                if isinstance(arr, pa.Scalar):
+                    arr = pa.array([arr.as_py()] * work.num_rows)
+                work = work.append_column(name, arr)
+            keys.append((name, "ascending" if asc else "descending"))
+        idx = pc.sort_indices(work, sort_keys=keys)
+        return t.take(idx) if set(t.column_names) == set(work.column_names) else work.take(idx).select(t.column_names)
+
+
+def _sorted_by(t: pa.Table, col: str) -> pa.Table:
+    return t.take(pc.sort_indices(t, sort_keys=[(col, "ascending")]))
+
+
+def _global_agg(col, pa_fn: str):
+    col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    fn = {
+        "sum": pc.sum, "mean": pc.mean, "min": pc.min, "max": pc.max,
+        "count": pc.count, "stddev": pc.stddev, "variance": pc.variance,
+        "approximate_median": pc.approximate_median,
+        "first": lambda c: c[0] if len(c) else pa.scalar(None),
+        "last": lambda c: c[-1] if len(c) else pa.scalar(None),
+    }[pa_fn]
+    return fn(col).as_py()
+
+
+def _rewrite_agg_refs(e: Expr, t: pa.Table) -> Expr:
+    """HAVING predicates reference agg outputs like avg(x) — rewrite those
+    AggCall nodes to Columns over the aggregated table."""
+    if isinstance(e, AggCall) and e.name() in t.column_names:
+        return Column(e.name())
+    if isinstance(e, BinaryOp):
+        return BinaryOp(e.op, _rewrite_agg_refs(e.left, t), _rewrite_agg_refs(e.right, t))
+    if isinstance(e, UnaryOp):
+        return UnaryOp(e.op, _rewrite_agg_refs(e.operand, t))
+    return e

@@ -1,0 +1,116 @@
+"""Query engine facade: parse -> plan -> execute (TPU or CPU backend).
+
+Role-equivalent of the reference's `QueryEngine` trait +
+`DatafusionQueryEngine` (reference query/src/query_engine.rs:58,
+query/src/datafusion.rs:74): owns planning and execution, with the TPU
+backend gated by config (`query.execution.backend = "tpu"`, the
+BASELINE.json plug-point) and automatic CPU fallback for plans the TPU
+planner cannot prove lowerable.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pyarrow as pa
+
+from ..datatypes.schema import Schema
+from ..utils import metrics
+from ..utils.config import QueryConfig
+from ..utils.errors import PlanError, TableNotFoundError
+from ..utils.tracing import span
+from .cpu_exec import CpuExecutor
+from .logical_plan import LogicalPlan, TableScan
+from .planner import plan_select
+from .sql_parser import SelectStmt
+from .tpu_exec import TpuExecutor, try_lower
+
+
+class QueryEngine:
+    def __init__(
+        self,
+        schema_provider,
+        scan_provider,
+        region_scan_provider,
+        time_bounds_provider,
+        config: QueryConfig | None = None,
+        mesh=None,
+    ):
+        """
+        schema_provider(table, database) -> Schema
+        scan_provider(scan: TableScan) -> pa.Table           (merged regions)
+        region_scan_provider(scan) -> list[pa.Table]         (one per region)
+        time_bounds_provider(table, database) -> (min_ts, max_ts)
+        """
+        self.config = config or QueryConfig()
+        self.schema_of = schema_provider
+        self.cpu = CpuExecutor(scan_provider)
+        self._mesh = mesh
+        self._region_scan = region_scan_provider
+        self._time_bounds = time_bounds_provider
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            from ..parallel.mesh import make_mesh
+
+            self._mesh = make_mesh()
+        return self._mesh
+
+    # ---- entry ------------------------------------------------------------
+    def execute_select(self, stmt: SelectStmt, database: str = "public") -> pa.Table:
+        if stmt.table is not None:
+            schema = self.schema_of(stmt.table, stmt.database or database)
+        else:
+            schema = Schema(columns=[])
+        plan = plan_select(stmt, schema, database)
+        return self.execute_plan(plan, schema)
+
+    def execute_plan(self, plan: LogicalPlan, schema: Schema) -> pa.Table:
+        t0 = time.perf_counter()
+        backend = "cpu"
+        try:
+            if self.config.backend == "tpu" and schema.columns:
+                lowering = try_lower(plan, schema)
+                if lowering is not None:
+                    backend = "tpu"
+                    with span("query.tpu", table=lowering.scan.table):
+                        tpu = TpuExecutor(
+                            self.mesh,
+                            self._region_scan,
+                            acc_dtype="float64" if _x64_enabled() else "float32",
+                        )
+                        scan = lowering.scan
+                        return tpu.execute(
+                            lowering,
+                            schema,
+                            time_bounds=lambda: self._time_bounds(scan.table, scan.database),
+                        )
+            with span("query.cpu"):
+                return self.cpu.execute(plan)
+        except Exception:
+            if backend == "tpu" and self.config.fallback_to_cpu:
+                metrics.TPU_FALLBACK_TOTAL.inc()
+                with span("query.cpu_fallback"):
+                    return self.cpu.execute(plan)
+            raise
+        finally:
+            metrics.QUERY_ELAPSED.observe(time.perf_counter() - t0, backend=backend)
+
+    def explain(self, stmt: SelectStmt, database: str = "public") -> pa.Table:
+        schema = (
+            self.schema_of(stmt.table, stmt.database or database)
+            if stmt.table
+            else Schema(columns=[])
+        )
+        plan = plan_select(stmt, schema, database)
+        lowered = try_lower(plan, schema) if schema.columns else None
+        lines = plan.describe().split("\n")
+        backend = ["tpu" if lowered is not None else "cpu"] * len(lines)
+        return pa.table({"plan": lines, "backend": backend})
+
+
+def _x64_enabled() -> bool:
+    import jax
+
+    return bool(jax.config.jax_enable_x64)

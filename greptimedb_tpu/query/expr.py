@@ -1,0 +1,172 @@
+"""Expression AST shared by the SQL planner and both executors.
+
+The role of DataFusion's `Expr` in the reference (reference query crate
+planning surface): a small, typed expression tree that the CPU executor
+evaluates over Arrow arrays and the TPU planner pattern-matches for
+lowering (filters -> mask kernels, time_bucket -> bucket components,
+aggregates -> segment reductions).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class Expr:
+    def name(self) -> str:
+        raise NotImplementedError
+
+    def children(self) -> list["Expr"]:
+        return []
+
+    def walk(self):
+        yield self
+        for c in self.children():
+            yield from c.walk()
+
+
+@dataclass(frozen=True)
+class Column(Expr):
+    column: str
+
+    def name(self) -> str:
+        return self.column
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: object
+
+    def name(self) -> str:
+        return repr(self.value)
+
+
+@dataclass(frozen=True)
+class BinaryOp(Expr):
+    op: str  # + - * / % = != < <= > >= and or like
+    left: Expr
+    right: Expr
+
+    def name(self) -> str:
+        return f"{self.left.name()} {self.op} {self.right.name()}"
+
+    def children(self) -> list[Expr]:
+        return [self.left, self.right]
+
+
+@dataclass(frozen=True)
+class UnaryOp(Expr):
+    op: str  # not, -
+    operand: Expr
+
+    def name(self) -> str:
+        return f"{self.op} {self.operand.name()}"
+
+    def children(self) -> list[Expr]:
+        return [self.operand]
+
+
+@dataclass(frozen=True)
+class InList(Expr):
+    expr: Expr
+    values: tuple
+    negated: bool = False
+
+    def name(self) -> str:
+        neg = "not in" if self.negated else "in"
+        return f"{self.expr.name()} {neg} {self.values}"
+
+    def children(self) -> list[Expr]:
+        return [self.expr]
+
+
+@dataclass(frozen=True)
+class Between(Expr):
+    expr: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+    def name(self) -> str:
+        return f"{self.expr.name()} between {self.low.name()} and {self.high.name()}"
+
+    def children(self) -> list[Expr]:
+        return [self.expr, self.low, self.high]
+
+
+@dataclass(frozen=True)
+class IsNull(Expr):
+    expr: Expr
+    negated: bool = False
+
+    def name(self) -> str:
+        return f"{self.expr.name()} is {'not ' if self.negated else ''}null"
+
+    def children(self) -> list[Expr]:
+        return [self.expr]
+
+
+@dataclass(frozen=True)
+class FuncCall(Expr):
+    """Scalar function: time_bucket/date_bin/date_trunc, abs, round, ..."""
+
+    func: str
+    args: tuple = ()
+
+    def name(self) -> str:
+        return f"{self.func}({', '.join(a.name() for a in self.args)})"
+
+    def children(self) -> list[Expr]:
+        return list(self.args)
+
+
+@dataclass(frozen=True)
+class AggCall(Expr):
+    """Aggregate function: sum/avg/min/max/count/last_value/first_value/
+    stddev/p50-p99 (approx)."""
+
+    func: str
+    arg: Expr | None = None  # None = count(*)
+    order_by: str | None = None  # for last_value(x ORDER BY ts)
+
+    def name(self) -> str:
+        inner = self.arg.name() if self.arg is not None else "*"
+        return f"{self.func}({inner})"
+
+    def children(self) -> list[Expr]:
+        return [self.arg] if self.arg is not None else []
+
+
+@dataclass(frozen=True)
+class Alias(Expr):
+    expr: Expr
+    alias: str
+
+    def name(self) -> str:
+        return self.alias
+
+    def children(self) -> list[Expr]:
+        return [self.expr]
+
+
+@dataclass(frozen=True)
+class Star(Expr):
+    def name(self) -> str:
+        return "*"
+
+
+def strip_alias(e: Expr) -> Expr:
+    return e.expr if isinstance(e, Alias) else e
+
+
+def find_agg_calls(e: Expr) -> list[AggCall]:
+    return [x for x in e.walk() if isinstance(x, AggCall)]
+
+
+def split_conjuncts(e: Expr | None) -> list[Expr]:
+    """Flatten nested ANDs into a conjunct list (for pushdown analysis)."""
+    if e is None:
+        return []
+    if isinstance(e, BinaryOp) and e.op == "and":
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]

@@ -1,0 +1,122 @@
+"""Logical plan nodes.
+
+The role of DataFusion `LogicalPlan` in the reference: a small relational
+algebra the SQL/PromQL planners emit and both executors consume.  The TPU
+physical planner pattern-matches Aggregate(Filter(Scan)) shapes (the
+reference's dist-planner commutative boundary, see
+query/src/dist_plan/analyzer.rs) and lowers them to device kernels;
+everything else runs on the CPU executor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .expr import Expr
+
+
+class LogicalPlan:
+    def children(self) -> list["LogicalPlan"]:
+        return []
+
+    def describe(self, indent: int = 0) -> str:
+        lines = [" " * indent + repr(self)]
+        for c in self.children():
+            lines.append(c.describe(indent + 2))
+        return "\n".join(lines)
+
+
+@dataclass(repr=False)
+class TableScan(LogicalPlan):
+    table: str
+    database: str = "public"
+    projection: list[str] | None = None
+    # pushed-down conjuncts: simple (col op literal) only
+    filters: list = field(default_factory=list)
+    time_range: tuple[int, int] | None = None  # native time-index unit, [lo, hi)
+
+    def __repr__(self):
+        return (
+            f"TableScan({self.database}.{self.table}, proj={self.projection}, "
+            f"filters={self.filters}, time_range={self.time_range})"
+        )
+
+
+@dataclass(repr=False)
+class Filter(LogicalPlan):
+    input: LogicalPlan
+    predicate: Expr
+
+    def children(self):
+        return [self.input]
+
+    def __repr__(self):
+        return f"Filter({self.predicate.name()})"
+
+
+@dataclass(repr=False)
+class Project(LogicalPlan):
+    input: LogicalPlan
+    exprs: list[Expr]
+
+    def children(self):
+        return [self.input]
+
+    def __repr__(self):
+        return f"Project({[e.name() for e in self.exprs]})"
+
+
+@dataclass(repr=False)
+class Aggregate(LogicalPlan):
+    input: LogicalPlan
+    group_exprs: list[Expr]
+    agg_exprs: list[Expr]  # AggCall or Alias(AggCall)
+
+    def children(self):
+        return [self.input]
+
+    def __repr__(self):
+        return (
+            f"Aggregate(group={[e.name() for e in self.group_exprs]}, "
+            f"aggs={[e.name() for e in self.agg_exprs]})"
+        )
+
+
+@dataclass(repr=False)
+class Sort(LogicalPlan):
+    input: LogicalPlan
+    keys: list[tuple[Expr, bool]]  # (expr, ascending)
+
+    def children(self):
+        return [self.input]
+
+    def __repr__(self):
+        return f"Sort({[(e.name(), a) for e, a in self.keys]})"
+
+
+@dataclass(repr=False)
+class Limit(LogicalPlan):
+    input: LogicalPlan
+    limit: int
+    offset: int = 0
+
+    def children(self):
+        return [self.input]
+
+    def __repr__(self):
+        return f"Limit({self.limit}, offset={self.offset})"
+
+
+@dataclass(repr=False)
+class Having(LogicalPlan):
+    """Post-aggregation filter (kept distinct so the TPU lowering can apply
+    it host-side after finalize)."""
+
+    input: LogicalPlan
+    predicate: Expr
+
+    def children(self):
+        return [self.input]
+
+    def __repr__(self):
+        return f"Having({self.predicate.name()})"

@@ -1,0 +1,180 @@
+"""SQL statement -> logical plan, with scan pushdown analysis.
+
+Role-equivalent of the reference's logical planning + the pushdown half of
+its distributed planner (reference query/src/planner.rs and
+query/src/dist_plan/analyzer.rs): WHERE conjuncts that are simple
+(column op literal) move into the TableScan as pushed filters, time-index
+comparisons become the scan's time_range (SST pruning), and the rest stays
+in a residual Filter node.
+"""
+
+from __future__ import annotations
+
+import datetime
+
+from ..datatypes.schema import Schema, SemanticType
+from ..utils.errors import PlanError
+from .expr import (
+    AggCall,
+    Alias,
+    Between,
+    BinaryOp,
+    Column,
+    Expr,
+    FuncCall,
+    InList,
+    Literal,
+    Star,
+    UnaryOp,
+    find_agg_calls,
+    split_conjuncts,
+    strip_alias,
+)
+from .logical_plan import (
+    Aggregate,
+    Filter,
+    Having,
+    Limit,
+    LogicalPlan,
+    Project,
+    Sort,
+    TableScan,
+)
+from .sql_parser import SelectStmt
+
+
+def plan_select(stmt: SelectStmt, schema: Schema, database: str = "public") -> LogicalPlan:
+    if stmt.table is None:
+        # SELECT 1, SELECT now() — constant projection over an empty scan.
+        return Project(TableScan(table="", database=database), stmt.projections)
+
+    ts_col = schema.time_index.name if schema.time_index else None
+    ts_unit_ms = (
+        schema.time_index.data_type.timestamp_unit_ns() // 1_000_000
+        if schema.time_index
+        else 1
+    )
+
+    pushed: list[tuple[str, str, object]] = []
+    time_lo: int | None = None
+    time_hi: int | None = None
+    residual: list[Expr] = []
+
+    for conj in split_conjuncts(stmt.where):
+        simple = _as_simple_filter(conj, schema)
+        if simple is None:
+            residual.append(conj)
+            continue
+        name, op, value = simple
+        if name == ts_col and op in ("<", "<=", ">", ">=", "="):
+            v = _to_native_ts(value, ts_unit_ms)
+            if v is None:
+                residual.append(conj)
+                continue
+            if op in (">", ">="):
+                lo = v + 1 if op == ">" else v
+                time_lo = lo if time_lo is None else max(time_lo, lo)
+            elif op in ("<", "<="):
+                hi = v if op == "<" else v + 1
+                time_hi = hi if time_hi is None else min(time_hi, hi)
+            else:  # =
+                time_lo = v if time_lo is None else max(time_lo, v)
+                time_hi = v + 1 if time_hi is None else min(time_hi, v + 1)
+            continue
+        pushed.append((name, op, value))
+
+    time_range = None
+    if time_lo is not None or time_hi is not None:
+        time_range = (
+            time_lo if time_lo is not None else -(1 << 62),
+            time_hi if time_hi is not None else (1 << 62),
+        )
+
+    plan: LogicalPlan = TableScan(
+        table=stmt.table,
+        database=stmt.database or database,
+        filters=pushed,
+        time_range=time_range,
+    )
+    for conj in residual:
+        plan = Filter(plan, conj)
+
+    # Aggregation?
+    proj_aggs = [a for p in stmt.projections if not isinstance(p, Star) for a in find_agg_calls(p)]
+    if stmt.group_by or proj_aggs:
+        group_exprs = [_resolve_positional(g, stmt.projections) for g in stmt.group_by]
+        agg_exprs = [p for p in stmt.projections if find_agg_calls(p)]
+        plan = Aggregate(plan, group_exprs, agg_exprs)
+        if stmt.having is not None:
+            plan = Having(plan, stmt.having)
+        plan = Project(plan, stmt.projections)
+    else:
+        if not (len(stmt.projections) == 1 and isinstance(stmt.projections[0], Star)):
+            plan = Project(plan, stmt.projections)
+
+    if stmt.order_by:
+        # ORDER BY runs over the projected output: positional refs and alias
+        # refs become output-column references, not re-evaluated expressions.
+        keys = [(_resolve_order_key(e, stmt.projections), asc) for e, asc in stmt.order_by]
+        plan = Sort(plan, keys)
+    if stmt.limit is not None:
+        plan = Limit(plan, stmt.limit, stmt.offset)
+    return plan
+
+
+def _resolve_order_key(e: Expr, projections: list[Expr]) -> Expr:
+    """ORDER BY key -> a reference to the projected output column."""
+    if isinstance(e, Literal) and isinstance(e.value, int):
+        i = e.value - 1
+        if 0 <= i < len(projections):
+            return Column(projections[i].name())
+        raise PlanError(f"positional reference {e.value} out of range")
+    return e  # Column names (incl. aliases) resolve against the output table
+
+
+def _resolve_positional(e: Expr, projections: list[Expr]) -> Expr:
+    """GROUP BY 1 / ORDER BY 2 -> the corresponding projection expr."""
+    if isinstance(e, Literal) and isinstance(e.value, int):
+        i = e.value - 1
+        if 0 <= i < len(projections):
+            return strip_alias(projections[i])
+        raise PlanError(f"positional reference {e.value} out of range")
+    if isinstance(e, Column):
+        # May reference a projection alias.
+        for p in projections:
+            if isinstance(p, Alias) and p.alias == e.column:
+                return p.expr
+    return e
+
+
+def _as_simple_filter(e: Expr, schema: Schema):
+    """(col op literal) or col IN (...) -> pushdown triple, else None."""
+    if isinstance(e, BinaryOp) and e.op in ("=", "!=", "<", "<=", ">", ">="):
+        if isinstance(e.left, Column) and isinstance(e.right, Literal) and schema.has_column(e.left.column):
+            return (e.left.column, e.op, e.right.value)
+        if isinstance(e.right, Column) and isinstance(e.left, Literal) and schema.has_column(e.right.column):
+            flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+            return (e.right.column, flip.get(e.op, e.op), e.left.value)
+    if isinstance(e, InList) and isinstance(e.expr, Column) and schema.has_column(e.expr.column):
+        if all(not isinstance(v, Expr) for v in e.values):
+            return (e.expr.column, "not in" if e.negated else "in", tuple(e.values))
+    if isinstance(e, Between) and not e.negated and isinstance(e.expr, Column):
+        return None  # handled as two conjuncts by caller? keep residual for now
+    return None
+
+
+def _to_native_ts(value, unit_ms: int):
+    """Literal -> native time-index units.  Ints are already native;
+    ISO strings are parsed as UTC."""
+    if isinstance(value, (int, float)):
+        return int(value)
+    if isinstance(value, str):
+        try:
+            dt = datetime.datetime.fromisoformat(value.replace(" ", "T"))
+            if dt.tzinfo is None:
+                dt = dt.replace(tzinfo=datetime.timezone.utc)
+            ms = int(dt.timestamp() * 1000)
+            return ms // unit_ms if unit_ms else ms
+        except ValueError:
+            return None
+    return None

@@ -1,0 +1,758 @@
+"""SQL front door: tokenizer + recursive-descent parser.
+
+Role-equivalent of the reference's forked sqlparser + custom statements
+(reference sql/src/parser.rs `ParserContext`, sql/src/statements/): SELECT
+with WHERE/GROUP BY/HAVING/ORDER BY/LIMIT, CREATE TABLE with TIME INDEX /
+PRIMARY KEY / PARTITION clauses, INSERT VALUES, SHOW/DESCRIBE, EXPLAIN,
+TQL EVAL (PromQL-in-SQL, reference statements/tql.rs), ADMIN functions.
+
+No external parser library exists in this environment, so this is a
+hand-rolled parser; precedence climbing matches standard SQL.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from ..utils.errors import InvalidSyntaxError
+from .expr import (
+    AggCall,
+    Alias,
+    Between,
+    BinaryOp,
+    Column,
+    Expr,
+    FuncCall,
+    InList,
+    IsNull,
+    Literal,
+    Star,
+    UnaryOp,
+)
+
+AGG_FUNCS = {
+    "sum", "avg", "min", "max", "count", "mean",
+    "last_value", "first_value", "stddev", "stddev_pop", "var", "var_pop",
+    "approx_percentile_cont", "percentile",
+}
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<comment>--[^\n]*|\/\*.*?\*\/)
+  | (?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*"|`(?:[^`]|``)*`)
+  | (?P<op><=|>=|!=|<>|::|\|\||[-+*/%(),.;=<>\[\]])
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+@dataclass
+class Token:
+    kind: str  # number|string|ident|qident|op|eof
+    value: str
+    pos: int
+
+
+def tokenize(sql: str) -> list[Token]:
+    tokens: list[Token] = []
+    i = 0
+    while i < len(sql):
+        m = _TOKEN_RE.match(sql, i)
+        if not m:
+            raise InvalidSyntaxError(f"unexpected character {sql[i]!r} at {i}")
+        kind = m.lastgroup
+        if kind not in ("ws", "comment"):
+            tokens.append(Token(kind, m.group(), i))
+        i = m.end()
+    tokens.append(Token("eof", "", len(sql)))
+    return tokens
+
+
+# ---- statements ------------------------------------------------------------
+
+
+@dataclass
+class SelectStmt:
+    projections: list[Expr]
+    table: str | None = None
+    database: str | None = None
+    where: Expr | None = None
+    group_by: list[Expr] = field(default_factory=list)
+    having: Expr | None = None
+    order_by: list[tuple[Expr, bool]] = field(default_factory=list)
+    limit: int | None = None
+    offset: int = 0
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    type_name: str
+    nullable: bool = True
+    default: object = None
+    is_time_index: bool = False
+    is_primary_key: bool = False
+
+
+@dataclass
+class CreateTableStmt:
+    name: str
+    columns: list[ColumnDef]
+    time_index: str | None = None
+    primary_key: list[str] = field(default_factory=list)
+    if_not_exists: bool = False
+    partition_by_hash: tuple[list[str], int] | None = None  # (columns, n)
+    partition_on_columns: tuple[str, list] | None = None  # (column, bounds)
+    engine: str = "mito"
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class CreateDatabaseStmt:
+    name: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropStmt:
+    kind: str  # table|database
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class InsertStmt:
+    table: str
+    columns: list[str] | None
+    rows: list[list[object]]
+
+
+@dataclass
+class ShowStmt:
+    what: str  # tables|databases|create_table
+    target: str | None = None
+    like: str | None = None
+
+
+@dataclass
+class DescribeStmt:
+    table: str
+
+
+@dataclass
+class ExplainStmt:
+    analyze: bool
+    inner: object
+
+
+@dataclass
+class TqlStmt:
+    kind: str  # eval|explain|analyze
+    start: float
+    end: float
+    step: float
+    query: str
+
+
+@dataclass
+class AdminStmt:
+    func: str
+    args: list[object]
+
+
+@dataclass
+class UseStmt:
+    database: str
+
+
+@dataclass
+class DeleteStmt:
+    table: str
+    where: Expr | None
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.i = 0
+        self.sql = sql
+
+    # ---- token helpers ----------------------------------------------------
+    def peek(self) -> Token:
+        return self.tokens[self.i]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "ident" and t.value.lower() in kws
+
+    def eat_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str):
+        if not self.eat_kw(kw):
+            raise InvalidSyntaxError(f"expected {kw.upper()} near {self.peek().value!r}")
+
+    def at_op(self, op: str) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.value == op
+
+    def eat_op(self, op: str) -> bool:
+        if self.at_op(op):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str):
+        if not self.eat_op(op):
+            raise InvalidSyntaxError(f"expected {op!r} near {self.peek().value!r} in {self.sql!r}")
+
+    def ident(self) -> str:
+        t = self.next()
+        if t.kind == "ident":
+            return t.value
+        if t.kind == "qident":
+            q = t.value[0]
+            return t.value[1:-1].replace(q + q, q)
+        raise InvalidSyntaxError(f"expected identifier, got {t.value!r}")
+
+    # ---- entry ------------------------------------------------------------
+    def parse_statement(self):
+        if self.at_kw("select"):
+            return self.parse_select()
+        if self.at_kw("create"):
+            return self.parse_create()
+        if self.at_kw("drop"):
+            return self.parse_drop()
+        if self.at_kw("insert"):
+            return self.parse_insert()
+        if self.at_kw("show"):
+            return self.parse_show()
+        if self.at_kw("describe", "desc"):
+            self.next()
+            if self.eat_kw("table"):
+                pass
+            return DescribeStmt(self.ident())
+        if self.at_kw("explain"):
+            self.next()
+            analyze = self.eat_kw("analyze")
+            return ExplainStmt(analyze, self.parse_statement())
+        if self.at_kw("tql"):
+            return self.parse_tql()
+        if self.at_kw("admin"):
+            self.next()
+            func = self.ident()
+            args = []
+            if self.eat_op("("):
+                while not self.at_op(")"):
+                    args.append(self.parse_literal_value())
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+            return AdminStmt(func, args)
+        if self.at_kw("use"):
+            self.next()
+            return UseStmt(self.ident())
+        if self.at_kw("delete"):
+            self.next()
+            self.expect_kw("from")
+            table = self.ident()
+            where = None
+            if self.eat_kw("where"):
+                where = self.parse_expr()
+            return DeleteStmt(table, where)
+        raise InvalidSyntaxError(f"unsupported statement: {self.peek().value!r}")
+
+    # ---- SELECT -----------------------------------------------------------
+    def parse_select(self) -> SelectStmt:
+        self.expect_kw("select")
+        projections = [self.parse_projection()]
+        while self.eat_op(","):
+            projections.append(self.parse_projection())
+        stmt = SelectStmt(projections=projections)
+        if self.eat_kw("from"):
+            name = self.ident()
+            if self.eat_op("."):
+                stmt.database = name
+                stmt.table = self.ident()
+            else:
+                stmt.table = name
+        if self.eat_kw("where"):
+            stmt.where = self.parse_expr()
+        if self.eat_kw("group"):
+            self.expect_kw("by")
+            stmt.group_by.append(self.parse_expr())
+            while self.eat_op(","):
+                stmt.group_by.append(self.parse_expr())
+        if self.eat_kw("having"):
+            stmt.having = self.parse_expr()
+        if self.eat_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.parse_expr()
+                asc = True
+                if self.eat_kw("desc"):
+                    asc = False
+                elif self.eat_kw("asc"):
+                    pass
+                stmt.order_by.append((e, asc))
+                if not self.eat_op(","):
+                    break
+        if self.eat_kw("limit"):
+            stmt.limit = int(self.next().value)
+        if self.eat_kw("offset"):
+            stmt.offset = int(self.next().value)
+        return stmt
+
+    def parse_projection(self) -> Expr:
+        if self.at_op("*"):
+            self.next()
+            return Star()
+        e = self.parse_expr()
+        if self.eat_kw("as"):
+            return Alias(e, self.ident())
+        t = self.peek()
+        if t.kind in ("ident", "qident") and not self.at_kw(
+            "from", "where", "group", "having", "order", "limit", "offset", "as", "and", "or", "asc", "desc",
+        ):
+            return Alias(e, self.ident())
+        return e
+
+    # ---- expressions (precedence climbing) --------------------------------
+    def parse_expr(self) -> Expr:
+        return self.parse_or()
+
+    def parse_or(self) -> Expr:
+        left = self.parse_and()
+        while self.eat_kw("or"):
+            left = BinaryOp("or", left, self.parse_and())
+        return left
+
+    def parse_and(self) -> Expr:
+        left = self.parse_not()
+        while self.eat_kw("and"):
+            left = BinaryOp("and", left, self.parse_not())
+        return left
+
+    def parse_not(self) -> Expr:
+        if self.eat_kw("not"):
+            return UnaryOp("not", self.parse_not())
+        return self.parse_comparison()
+
+    def parse_comparison(self) -> Expr:
+        left = self.parse_additive()
+        t = self.peek()
+        if t.kind == "op" and t.value in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            self.next()
+            op = "!=" if t.value == "<>" else t.value
+            return BinaryOp(op, left, self.parse_additive())
+        if self.at_kw("between"):
+            self.next()
+            low = self.parse_additive()
+            self.expect_kw("and")
+            high = self.parse_additive()
+            return Between(left, low, high)
+        negated = False
+        if self.at_kw("not"):
+            save = self.i
+            self.next()
+            if self.at_kw("in", "like", "between"):
+                negated = True
+            else:
+                self.i = save
+        if self.eat_kw("in"):
+            self.expect_op("(")
+            values = []
+            while not self.at_op(")"):
+                values.append(self.parse_literal_value())
+                if not self.eat_op(","):
+                    break
+            self.expect_op(")")
+            return InList(left, tuple(values), negated=negated)
+        if self.eat_kw("like"):
+            pattern = self.parse_additive()
+            e = BinaryOp("like", left, pattern)
+            return UnaryOp("not", e) if negated else e
+        if negated and self.eat_kw("between"):
+            low = self.parse_additive()
+            self.expect_kw("and")
+            high = self.parse_additive()
+            return Between(left, low, high, negated=True)
+        if self.at_kw("is"):
+            self.next()
+            neg = self.eat_kw("not")
+            self.expect_kw("null")
+            return IsNull(left, negated=neg)
+        return left
+
+    def parse_additive(self) -> Expr:
+        left = self.parse_multiplicative()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("+", "-"):
+                self.next()
+                left = BinaryOp(t.value, left, self.parse_multiplicative())
+            else:
+                return left
+
+    def parse_multiplicative(self) -> Expr:
+        left = self.parse_unary()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("*", "/", "%"):
+                self.next()
+                left = BinaryOp(t.value, left, self.parse_unary())
+            else:
+                return left
+
+    def parse_unary(self) -> Expr:
+        if self.eat_op("-"):
+            return UnaryOp("-", self.parse_unary())
+        if self.eat_op("+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self) -> Expr:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            v = float(t.value) if ("." in t.value or "e" in t.value.lower()) else int(t.value)
+            return self._maybe_cast(Literal(v))
+        if t.kind == "string":
+            self.next()
+            return self._maybe_cast(Literal(t.value[1:-1].replace("''", "'")))
+        if self.at_op("("):
+            self.next()
+            e = self.parse_expr()
+            self.expect_op(")")
+            return self._maybe_cast(e)
+        if t.kind in ("ident", "qident"):
+            if self.at_kw("interval"):
+                self.next()
+                s = self.next()
+                if s.kind != "string":
+                    raise InvalidSyntaxError("expected string after INTERVAL")
+                return Literal(_parse_interval(s.value[1:-1]))
+            if self.at_kw("case"):
+                return self.parse_case()
+            name = self.ident()
+            if self.at_op("("):
+                return self._maybe_cast(self.parse_call(name))
+            return self._maybe_cast(Column(name))
+        raise InvalidSyntaxError(f"unexpected token {t.value!r} in expression")
+
+    def _maybe_cast(self, e: Expr) -> Expr:
+        while self.eat_op("::"):
+            type_name = self.ident()
+            e = FuncCall("cast", (e, Literal(type_name.lower())))
+        return e
+
+    def parse_case(self) -> Expr:
+        self.expect_kw("case")
+        branches = []
+        default = Literal(None)
+        while self.eat_kw("when"):
+            cond = self.parse_expr()
+            self.expect_kw("then")
+            val = self.parse_expr()
+            branches.append((cond, val))
+        if self.eat_kw("else"):
+            default = self.parse_expr()
+        self.expect_kw("end")
+        args: list[Expr] = []
+        for c, v in branches:
+            args += [c, v]
+        args.append(default)
+        return FuncCall("case", tuple(args))
+
+    def parse_call(self, name: str) -> Expr:
+        self.expect_op("(")
+        lname = name.lower()
+        if lname == "count" and self.at_op("*"):
+            self.next()
+            self.expect_op(")")
+            return AggCall("count", None)
+        args: list[Expr] = []
+        while not self.at_op(")"):
+            if self.eat_kw("distinct"):
+                pass  # distinct handled by executor for count(distinct x)
+            args.append(self.parse_expr())
+            if self.at_kw("order"):  # last_value(x ORDER BY ts)
+                self.next()
+                self.expect_kw("by")
+                order_col = self.ident()
+                self.eat_kw("desc")
+                self.eat_kw("asc")
+                self.expect_op(")")
+                return AggCall(lname, args[0], order_by=order_col)
+            if not self.eat_op(","):
+                break
+        self.expect_op(")")
+        if lname in AGG_FUNCS:
+            if lname == "mean":
+                lname = "avg"
+            return AggCall(lname, args[0] if args else None)
+        return FuncCall(lname, tuple(args))
+
+    def parse_literal_value(self):
+        t = self.next()
+        if t.kind == "number":
+            return float(t.value) if "." in t.value else int(t.value)
+        if t.kind == "string":
+            return t.value[1:-1].replace("''", "'")
+        if t.kind == "ident":
+            lv = t.value.lower()
+            if lv == "null":
+                return None
+            if lv == "true":
+                return True
+            if lv == "false":
+                return False
+            return t.value
+        if t.kind == "op" and t.value == "-":
+            v = self.parse_literal_value()
+            return -v
+        raise InvalidSyntaxError(f"expected literal, got {t.value!r}")
+
+    # ---- CREATE -----------------------------------------------------------
+    def parse_create(self):
+        self.expect_kw("create")
+        if self.eat_kw("database", "schema"):
+            ine = self._if_not_exists()
+            return CreateDatabaseStmt(self.ident(), if_not_exists=ine)
+        self.expect_kw("table")
+        ine = self._if_not_exists()
+        name = self.ident()
+        stmt = CreateTableStmt(name=name, columns=[], if_not_exists=ine)
+        self.expect_op("(")
+        while not self.at_op(")"):
+            if self.at_kw("time"):
+                self.next()
+                self.expect_kw("index")
+                self.expect_op("(")
+                stmt.time_index = self.ident()
+                self.expect_op(")")
+            elif self.at_kw("primary"):
+                self.next()
+                self.expect_kw("key")
+                self.expect_op("(")
+                stmt.primary_key.append(self.ident())
+                while self.eat_op(","):
+                    stmt.primary_key.append(self.ident())
+                self.expect_op(")")
+            else:
+                stmt.columns.append(self.parse_column_def())
+            if not self.eat_op(","):
+                break
+        self.expect_op(")")
+        # table-level clauses
+        while True:
+            if self.eat_kw("partition"):
+                if self.eat_kw("by"):
+                    self.expect_kw("hash")
+                    self.expect_op("(")
+                    cols = [self.ident()]
+                    while self.eat_op(","):
+                        cols.append(self.ident())
+                    self.expect_op(")")
+                    self.expect_kw("partitions")
+                    n = int(self.next().value)
+                    stmt.partition_by_hash = (cols, n)
+                else:
+                    self.expect_kw("on")
+                    self.expect_kw("columns")
+                    self.expect_op("(")
+                    col = self.ident()
+                    self.expect_op(")")
+                    self.expect_op("(")
+                    depth = 1
+                    while depth:  # accept & ignore the expression list body
+                        t = self.next()
+                        if t.kind == "op" and t.value == "(":
+                            depth += 1
+                        elif t.kind == "op" and t.value == ")":
+                            depth -= 1
+                        elif t.kind == "eof":
+                            raise InvalidSyntaxError("unterminated PARTITION ON COLUMNS")
+                    stmt.partition_on_columns = (col, [])
+            elif self.eat_kw("engine"):
+                self.expect_op("=")
+                stmt.engine = self.ident()
+            elif self.eat_kw("with"):
+                self.expect_op("(")
+                while not self.at_op(")"):
+                    k = self.ident() if self.peek().kind != "string" else self.next().value[1:-1]
+                    self.expect_op("=")
+                    stmt.options[k] = self.parse_literal_value()
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+            else:
+                break
+        return stmt
+
+    def parse_column_def(self) -> ColumnDef:
+        name = self.ident()
+        type_parts = [self.ident()]
+        if self.at_op("("):  # e.g. TIMESTAMP(3), VARCHAR(255)
+            self.next()
+            prec = self.next().value
+            self.expect_op(")")
+            type_parts[0] += f"({prec})"
+        if self.at_kw("unsigned"):
+            self.next()
+            type_parts.append("unsigned")
+        col = ColumnDef(name=name, type_name=" ".join(type_parts))
+        while True:
+            if self.eat_kw("not"):
+                self.expect_kw("null")
+                col.nullable = False
+            elif self.eat_kw("null"):
+                col.nullable = True
+            elif self.eat_kw("default"):
+                col.default = self.parse_literal_value()
+            elif self.at_kw("time"):
+                self.next()
+                self.expect_kw("index")
+                col.is_time_index = True
+            elif self.at_kw("primary"):
+                self.next()
+                self.expect_kw("key")
+                col.is_primary_key = True
+            else:
+                break
+        return col
+
+    def _if_not_exists(self) -> bool:
+        if self.eat_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            return True
+        return False
+
+    # ---- DROP / INSERT / SHOW / TQL --------------------------------------
+    def parse_drop(self):
+        self.expect_kw("drop")
+        kind = "table"
+        if self.eat_kw("database", "schema"):
+            kind = "database"
+        else:
+            self.expect_kw("table")
+        if_exists = False
+        if self.eat_kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        return DropStmt(kind, self.ident(), if_exists=if_exists)
+
+    def parse_insert(self):
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        table = self.ident()
+        columns = None
+        if self.eat_op("("):
+            columns = [self.ident()]
+            while self.eat_op(","):
+                columns.append(self.ident())
+            self.expect_op(")")
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = []
+            while not self.at_op(")"):
+                row.append(self.parse_literal_value())
+                if not self.eat_op(","):
+                    break
+            self.expect_op(")")
+            rows.append(row)
+            if not self.eat_op(","):
+                break
+        return InsertStmt(table, columns, rows)
+
+    def parse_show(self):
+        self.expect_kw("show")
+        if self.eat_kw("tables"):
+            like = None
+            if self.eat_kw("like"):
+                like = self.next().value.strip("'")
+            return ShowStmt("tables", like=like)
+        if self.eat_kw("databases", "schemas"):
+            return ShowStmt("databases")
+        if self.eat_kw("create"):
+            self.expect_kw("table")
+            return ShowStmt("create_table", target=self.ident())
+        raise InvalidSyntaxError(f"unsupported SHOW near {self.peek().value!r}")
+
+    def parse_tql(self):
+        self.expect_kw("tql")
+        kind = "eval"
+        if self.eat_kw("eval", "evaluate"):
+            kind = "eval"
+        elif self.eat_kw("explain"):
+            kind = "explain"
+        elif self.eat_kw("analyze"):
+            kind = "analyze"
+        self.expect_op("(")
+        start = float(self.next().value)
+        self.expect_op(",")
+        end = float(self.next().value)
+        self.expect_op(",")
+        step_tok = self.next()
+        step = (
+            _parse_interval(step_tok.value[1:-1]) / 1000.0
+            if step_tok.kind == "string"
+            else float(step_tok.value)
+        )
+        self.expect_op(")")
+        # The rest of the statement (to trailing ; or EOF) is raw PromQL.
+        start_pos = self.peek().pos
+        end_pos = len(self.sql)
+        text = self.sql[start_pos:end_pos].strip()
+        if text.endswith(";"):
+            text = text[:-1].strip()
+        self.i = len(self.tokens) - 1  # consume everything
+        return TqlStmt(kind, start, end, step, text)
+
+
+def _parse_interval(s: str) -> int:
+    """'5m', '1h', '90 seconds', '1 day' ... -> milliseconds."""
+    s = s.strip().lower()
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)\s*([a-z]*)", s)
+    if not m:
+        raise InvalidSyntaxError(f"bad interval: {s!r}")
+    n = float(m.group(1))
+    unit = m.group(2) or "s"
+    mult = {
+        "ms": 1, "millisecond": 1, "milliseconds": 1,
+        "s": 1000, "sec": 1000, "second": 1000, "seconds": 1000,
+        "m": 60_000, "min": 60_000, "minute": 60_000, "minutes": 60_000,
+        "h": 3_600_000, "hour": 3_600_000, "hours": 3_600_000,
+        "d": 86_400_000, "day": 86_400_000, "days": 86_400_000,
+        "w": 604_800_000, "week": 604_800_000, "weeks": 604_800_000,
+    }.get(unit)
+    if mult is None:
+        raise InvalidSyntaxError(f"bad interval unit: {unit!r}")
+    return int(n * mult)
+
+
+def parse_sql(sql: str):
+    """Parse one or more ;-separated statements."""
+    statements = []
+    p = Parser(sql)
+    while p.peek().kind != "eof":
+        statements.append(p.parse_statement())
+        while p.eat_op(";"):
+            pass
+    return statements

@@ -1,0 +1,231 @@
+"""End-to-end SQL tests over the standalone Database facade.
+
+Modeled on the reference's sqlness golden cases (tests/cases/standalone/):
+DDL, INSERT, SELECT with filters/group-by/order/limit, SHOW/DESCRIBE,
+EXPLAIN backend choice, and the TPU==CPU result-equality bar.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.utils.errors import (
+    InvalidSyntaxError,
+    TableAlreadyExistsError,
+    TableNotFoundError,
+)
+
+CREATE_CPU = """
+CREATE TABLE cpu (
+  host STRING,
+  region STRING,
+  ts TIMESTAMP(3),
+  usage_user DOUBLE,
+  usage_system DOUBLE,
+  TIME INDEX (ts),
+  PRIMARY KEY (host, region)
+)
+"""
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(data_home=str(tmp_path))
+    yield d
+    d.close()
+
+
+@pytest.fixture()
+def loaded(db):
+    db.sql(CREATE_CPU)
+    rows = []
+    rng = np.random.default_rng(3)
+    for h in range(4):
+        for i in range(50):
+            ts = i * 60_000  # one point per minute
+            rows.append(
+                f"('host{h}', 'r{h % 2}', {ts}, {rng.uniform(0, 100):.3f}, {rng.uniform(0, 100):.3f})"
+            )
+    db.sql(f"INSERT INTO cpu VALUES {', '.join(rows)}")
+    return db
+
+
+def test_create_insert_select_roundtrip(db):
+    db.sql(CREATE_CPU)
+    n = db.sql_one("INSERT INTO cpu VALUES ('a', 'r0', 1000, 42.0, 1.0), ('b', 'r1', 2000, 43.0, 2.0)")
+    assert n == 2
+    t = db.sql_one("SELECT * FROM cpu ORDER BY ts")
+    assert t.num_rows == 2
+    assert t["host"].to_pylist() == ["a", "b"]
+    assert t["usage_user"].to_pylist() == [42.0, 43.0]
+
+
+def test_create_table_errors(db):
+    db.sql(CREATE_CPU)
+    with pytest.raises(TableAlreadyExistsError):
+        db.sql(CREATE_CPU)
+    db.sql("CREATE TABLE IF NOT EXISTS cpu (ts TIMESTAMP TIME INDEX, v DOUBLE)")  # no-op
+    with pytest.raises(TableNotFoundError):
+        db.sql("SELECT * FROM nope")
+    with pytest.raises(InvalidSyntaxError):
+        db.sql("SELEC 1")
+
+
+def test_where_filters(loaded):
+    t = loaded.sql_one("SELECT host, usage_user FROM cpu WHERE host = 'host1' AND usage_user > 50")
+    assert set(t["host"].to_pylist()) <= {"host1"}
+    assert all(v > 50 for v in t["usage_user"].to_pylist())
+
+    t = loaded.sql_one("SELECT count(*) FROM cpu WHERE host IN ('host0', 'host2')")
+    assert t["count(*)"].to_pylist() == [100]
+
+    t = loaded.sql_one("SELECT count(*) FROM cpu WHERE ts >= 1800000 AND ts < 2400000")
+    assert t["count(*)"].to_pylist() == [4 * 10]
+
+
+def test_groupby_tags(loaded):
+    t = loaded.sql_one(
+        "SELECT host, avg(usage_user) AS au, max(usage_user), count(*) FROM cpu GROUP BY host ORDER BY host"
+    )
+    assert t.num_rows == 4
+    assert t["host"].to_pylist() == ["host0", "host1", "host2", "host3"]
+    # cross-check with raw scan
+    raw = loaded.sql_one("SELECT host, usage_user FROM cpu")
+    by_host = {}
+    for h, v in zip(raw["host"].to_pylist(), raw["usage_user"].to_pylist()):
+        by_host.setdefault(h, []).append(v)
+    for h, au, mx in zip(t["host"].to_pylist(), t["au"].to_pylist(), t[2].to_pylist()):
+        np.testing.assert_allclose(au, np.mean(by_host[h]), rtol=1e-9)
+        np.testing.assert_allclose(mx, np.max(by_host[h]), rtol=1e-12)
+
+
+def test_time_bucket_groupby(loaded):
+    t = loaded.sql_one(
+        "SELECT time_bucket('10m', ts) AS bucket, host, avg(usage_user) AS au "
+        "FROM cpu GROUP BY bucket, host ORDER BY bucket, host"
+    )
+    # 50 minutes of data -> 5 buckets x 4 hosts
+    assert t.num_rows == 20
+    raw = loaded.sql_one("SELECT host, ts, usage_user FROM cpu")
+    ref = {}
+    for h, ts, v in zip(
+        raw["host"].to_pylist(), raw["ts"].cast(pa.int64()).to_pylist(), raw["usage_user"].to_pylist()
+    ):
+        ref.setdefault((ts // 600_000 * 600_000, h), []).append(v)
+    for b, h, au in zip(
+        t["bucket"].cast(pa.int64()).to_pylist(), t["host"].to_pylist(), t["au"].to_pylist()
+    ):
+        np.testing.assert_allclose(au, np.mean(ref[(b, h)]), rtol=1e-9)
+
+
+def test_tpu_cpu_result_equality(loaded):
+    """The bar from SURVEY.md section 7: identical results both backends."""
+    q = (
+        "SELECT time_bucket('10m', ts) AS bucket, host, avg(usage_user) AS au, "
+        "max(usage_system) AS mx, count(*) AS c "
+        "FROM cpu WHERE usage_user > 20 GROUP BY bucket, host ORDER BY bucket, host"
+    )
+    loaded.query_engine.config.backend = "tpu"
+    loaded.query_engine.config.fallback_to_cpu = False
+    t_tpu = loaded.sql_one(q)
+    loaded.query_engine.config.backend = "cpu"
+    t_cpu = loaded.sql_one(q)
+    assert t_tpu.num_rows == t_cpu.num_rows
+    assert t_tpu.column_names == t_cpu.column_names
+    for name in t_cpu.column_names:
+        a, b = t_tpu[name].to_pylist(), t_cpu[name].to_pylist()
+        if isinstance(a[0], float):
+            np.testing.assert_allclose(a, b, rtol=1e-9)
+        else:
+            assert a == b, name
+
+
+def test_explain_shows_backend(loaded):
+    t = loaded.sql_one(
+        "EXPLAIN SELECT host, max(usage_user) FROM cpu GROUP BY host"
+    )
+    assert t["backend"].to_pylist()[0] == "tpu"
+    t = loaded.sql_one("EXPLAIN SELECT host FROM cpu")  # no aggregate -> cpu
+    assert t["backend"].to_pylist()[0] == "cpu"
+
+
+def test_having_order_limit(loaded):
+    t = loaded.sql_one(
+        "SELECT host, avg(usage_user) AS au FROM cpu GROUP BY host "
+        "HAVING avg(usage_user) > 0 ORDER BY au DESC LIMIT 2"
+    )
+    assert t.num_rows == 2
+    vals = t["au"].to_pylist()
+    assert vals == sorted(vals, reverse=True)
+
+
+def test_show_describe(loaded):
+    t = loaded.sql_one("SHOW TABLES")
+    assert t["Tables"].to_pylist() == ["cpu"]
+    t = loaded.sql_one("DESCRIBE cpu")
+    sem = dict(zip(t["Column"].to_pylist(), t["Semantic Type"].to_pylist()))
+    assert sem["host"] == "TAG" and sem["ts"] == "TIMESTAMP" and sem["usage_user"] == "FIELD"
+    t = loaded.sql_one("SHOW CREATE TABLE cpu")
+    assert "TIME INDEX" in t["Create Table"].to_pylist()[0]
+
+
+def test_flush_and_query_from_sst(loaded):
+    loaded.sql("ADMIN flush_table('cpu')")
+    region = loaded.storage.region(loaded.catalog.table("cpu").region_ids[0])
+    assert region.stat().sst_count >= 1
+    t = loaded.sql_one("SELECT count(*) FROM cpu")
+    assert t["count(*)"].to_pylist() == [200]
+
+
+def test_global_aggregate_no_groupby(loaded):
+    t = loaded.sql_one("SELECT count(*), avg(usage_user), max(usage_user) FROM cpu")
+    assert t.num_rows == 1
+    assert t["count(*)"].to_pylist() == [200]
+
+
+def test_hash_partitioned_table(db):
+    db.sql(
+        "CREATE TABLE part (host STRING, ts TIMESTAMP(3), v DOUBLE, TIME INDEX (ts), PRIMARY KEY (host)) "
+        "PARTITION BY HASH (host) PARTITIONS 4"
+    )
+    rows = ", ".join(f"('h{i}', {i * 1000}, {float(i)})" for i in range(20))
+    assert db.sql_one(f"INSERT INTO part VALUES {rows}") == 20
+    meta = db.catalog.table("part")
+    assert len(meta.region_ids) == 4
+    counts = [db.storage.region(r).stat().num_rows for r in meta.region_ids]
+    assert sum(counts) == 20
+    assert sum(1 for c in counts if c > 0) >= 2  # actually spread out
+    t = db.sql_one("SELECT count(*) FROM part")
+    assert t["count(*)"].to_pylist() == [20]
+    t = db.sql_one("SELECT host, max(v) FROM part GROUP BY host ORDER BY host")
+    assert t.num_rows == 20
+
+
+def test_persistence_across_restart(tmp_path):
+    db = Database(data_home=str(tmp_path))
+    db.sql(CREATE_CPU)
+    db.sql("INSERT INTO cpu VALUES ('a', 'r0', 1000, 1.0, 2.0)")
+    db.close()
+    db2 = Database(data_home=str(tmp_path))
+    t = db2.sql_one("SELECT host, usage_user FROM cpu")
+    assert t["host"].to_pylist() == ["a"]
+    db2.close()
+
+
+def test_use_database_and_drop(db):
+    db.sql("CREATE DATABASE metrics")
+    db.sql("USE metrics")
+    db.sql("CREATE TABLE t (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+    assert db.sql_one("SHOW TABLES")["Tables"].to_pylist() == ["t"]
+    db.sql("DROP TABLE t")
+    assert db.sql_one("SHOW TABLES")["Tables"].to_pylist() == []
+    db.sql("USE public")
+    db.sql("DROP DATABASE metrics")
+    assert "metrics" not in db.catalog.databases()
+
+
+def test_projection_arithmetic(loaded):
+    t = loaded.sql_one("SELECT host, usage_user + usage_system AS total FROM cpu LIMIT 5")
+    assert t.num_rows == 5
+    assert "total" in t.column_names
