@@ -1,0 +1,3 @@
+from .engine import PromqlEngine
+
+__all__ = ["PromqlEngine"]

@@ -747,8 +747,31 @@ def _parse_interval(s: str) -> int:
     return int(n * mult)
 
 
+_TQL_RE = re.compile(
+    r"^\s*tql\s+(eval|evaluate|explain|analyze)\s*\(\s*([^,]+?)\s*,\s*([^,]+?)\s*,\s*([^)]+?)\s*\)\s*(.+?)\s*;?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
+
+
 def parse_sql(sql: str):
-    """Parse one or more ;-separated statements."""
+    """Parse one or more ;-separated statements.
+
+    TQL statements are matched by regex BEFORE SQL tokenization because
+    their tail is raw PromQL (`{label="x"}` is not SQL-tokenizable) —
+    the reference's parser special-cases TQL the same way
+    (sql/src/parsers/tql_parser.rs).
+    """
+    m = _TQL_RE.match(sql)
+    if m:
+        kind = {"evaluate": "eval"}.get(m.group(1).lower(), m.group(1).lower())
+        step_raw = m.group(4).strip()
+        if step_raw.startswith(("'", '"')):
+            step = _parse_interval(step_raw.strip("'\"")) / 1000.0
+        else:
+            step = float(step_raw)
+        return [
+            TqlStmt(kind, float(m.group(2)), float(m.group(3)), step, m.group(5).strip())
+        ]
     statements = []
     p = Parser(sql)
     while p.peek().kind != "eof":
