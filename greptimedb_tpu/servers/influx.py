@@ -1,0 +1,189 @@
+"""InfluxDB line protocol: parser + auto-schema ingestion.
+
+Role-equivalent of the reference's Influx write endpoint
+(reference servers/src/influxdb.rs + the Inserter's
+create_or_alter_tables_on_demand auto-schema path,
+operator/src/insert.rs:159): each measurement becomes a table whose tags
+are TAG strings, fields are FIELD doubles/strings/bools, and the timestamp
+is the TIME INDEX.  Unknown tables are created on first write; new fields
+alter the schema in place.
+
+Line syntax: measurement[,tag=val...] field=value[,field2=value2] [timestamp]
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import pyarrow as pa
+
+from ..datatypes.data_type import ConcreteDataType
+from ..datatypes.schema import ColumnSchema, Schema, SemanticType
+from ..utils.errors import InvalidArgumentsError
+
+_PRECISION_TO_MS = {"ns": 1e-6, "us": 1e-3, "ms": 1.0, "s": 1000.0}
+
+
+@dataclass
+class Point:
+    measurement: str
+    tags: dict[str, str]
+    fields: dict[str, object]
+    ts_ms: int | None
+
+
+def _split_unescaped(s: str, sep: str) -> list[str]:
+    """Split on unescaped `sep`, ignoring separators inside double quotes
+    (string field values may contain spaces and commas)."""
+    out, cur, i, in_quotes = [], [], 0, False
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            cur.append(s[i : i + 2])
+            i += 2
+            continue
+        if c == '"':
+            in_quotes = not in_quotes
+            cur.append(c)
+        elif c == sep and not in_quotes:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+        i += 1
+    out.append("".join(cur))
+    return out
+
+
+def _unescape(s: str) -> str:
+    return s.replace("\\,", ",").replace("\\ ", " ").replace("\\=", "=").replace('\\"', '"')
+
+
+def _parse_field_value(raw: str):
+    if raw.startswith('"') and raw.endswith('"') and len(raw) >= 2:
+        return raw[1:-1].replace('\\"', '"')
+    low = raw.lower()
+    if low in ("t", "true"):
+        return True
+    if low in ("f", "false"):
+        return False
+    if raw.endswith(("i", "u")):
+        return int(raw[:-1])
+    return float(raw)
+
+
+def parse_line_protocol(body: str, precision: str = "ns") -> list[Point]:
+    mult = _PRECISION_TO_MS.get(precision)
+    if mult is None:
+        raise InvalidArgumentsError(f"bad precision: {precision}")
+    points: list[Point] = []
+    for raw_line in body.splitlines():
+        line = raw_line.strip()
+        if not line or line.startswith("#"):
+            continue
+        # measurement+tags | fields | timestamp, split on unescaped spaces
+        parts = [p for p in _split_unescaped(line, " ") if p != ""]
+        if len(parts) < 2:
+            raise InvalidArgumentsError(f"bad line protocol line: {raw_line!r}")
+        head = _split_unescaped(parts[0], ",")
+        measurement = _unescape(head[0])
+        tags = {}
+        for kv in head[1:]:
+            k, _, v = kv.partition("=")
+            tags[_unescape(k)] = _unescape(v)
+        fields = {}
+        for kv in _split_unescaped(parts[1], ","):
+            k, _, v = kv.partition("=")
+            fields[_unescape(k)] = _parse_field_value(v)
+        if not fields:
+            raise InvalidArgumentsError(f"line has no fields: {raw_line!r}")
+        ts_ms = None
+        if len(parts) >= 3:
+            ts_ms = int(int(parts[2]) * mult)
+        points.append(Point(measurement, tags, fields, ts_ms))
+    return points
+
+
+def _field_type(v) -> ConcreteDataType:
+    if isinstance(v, bool):
+        return ConcreteDataType.BOOLEAN
+    if isinstance(v, int):
+        return ConcreteDataType.INT64
+    if isinstance(v, float):
+        return ConcreteDataType.FLOAT64
+    return ConcreteDataType.STRING
+
+
+def write_points(db, points: list[Point], default_now_ms: int | None = None) -> int:
+    """Group points by measurement, auto-create/alter tables, insert."""
+    import time as _time
+
+    now_ms = default_now_ms if default_now_ms is not None else int(_time.time() * 1000)
+    by_table: dict[str, list[Point]] = {}
+    for p in points:
+        by_table.setdefault(p.measurement, []).append(p)
+
+    total = 0
+    for table_name, pts in by_table.items():
+        tag_names: list[str] = []
+        field_types: dict[str, ConcreteDataType] = {}
+        for p in pts:
+            for tname in p.tags:
+                if tname not in tag_names:
+                    tag_names.append(tname)
+            for fname, v in p.fields.items():
+                t = _field_type(v)
+                prev = field_types.get(fname)
+                if prev is None or (prev == ConcreteDataType.INT64 and t == ConcreteDataType.FLOAT64):
+                    field_types[fname] = t
+
+        if not db.catalog.has_table(table_name, db.current_database):
+            columns = [ColumnSchema(t, ConcreteDataType.STRING, SemanticType.TAG) for t in tag_names]
+            columns.append(
+                ColumnSchema("ts", ConcreteDataType.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP)
+            )
+            columns += [ColumnSchema(f, t, SemanticType.FIELD) for f, t in field_types.items()]
+            meta = db.catalog.create_table(
+                table_name, Schema(columns=columns), database=db.current_database
+            )
+            for rid in meta.region_ids:
+                db.storage.create_region(rid, meta.schema)
+        else:
+            meta = db.catalog.table(table_name, db.current_database)
+            schema = meta.schema
+            new_cols = []
+            for tname in tag_names:
+                if not schema.has_column(tname):
+                    raise InvalidArgumentsError(
+                        f"new tag {tname!r} on existing table {table_name!r} "
+                        "(tags are part of the primary key and cannot be added)"
+                    )
+            for fname, t in field_types.items():
+                if not schema.has_column(fname):
+                    new_cols.append(ColumnSchema(fname, t, SemanticType.FIELD))
+            if new_cols:
+                for c in new_cols:
+                    schema = schema.add_column(c)
+                meta.schema = schema
+                db.catalog.update_table(meta)
+                for rid in meta.region_ids:
+                    db.storage.region(rid).alter_schema(schema)
+
+        meta = db.catalog.table(table_name, db.current_database)
+        schema = meta.schema
+        cols: dict[str, list] = {c.name: [] for c in schema.columns}
+        ts_name = schema.time_index.name
+        for p in pts:
+            for c in schema.columns:
+                if c.name == ts_name:
+                    cols[c.name].append(p.ts_ms if p.ts_ms is not None else now_ms)
+                elif c.semantic_type == SemanticType.TAG:
+                    cols[c.name].append(p.tags.get(c.name))
+                else:
+                    cols[c.name].append(p.fields.get(c.name))
+        arrays = [
+            pa.array(cols[c.name], c.data_type.to_arrow()) for c in schema.columns
+        ]
+        batch = pa.RecordBatch.from_arrays(arrays, schema=schema.to_arrow())
+        total += db.write_batch(meta, batch)
+    return total

@@ -1,0 +1,160 @@
+"""Protocol server tests: real HTTP over a socket, like the reference's
+endpoint integration tests (tests-integration/tests/http.rs)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.servers.http import HttpServer
+from greptimedb_tpu.servers.influx import parse_line_protocol
+
+
+@pytest.fixture()
+def server(tmp_path):
+    db = Database(data_home=str(tmp_path))
+    srv = HttpServer(db, "127.0.0.1:0").start()
+    yield srv, db
+    srv.stop()
+    db.close()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(f"http://{srv.address}{path}") as r:
+        return r.status, r.read()
+
+
+def _post(srv, path, body: bytes, content_type="text/plain"):
+    req = urllib.request.Request(
+        f"http://{srv.address}{path}", data=body, headers={"Content-Type": content_type}
+    )
+    with urllib.request.urlopen(req) as r:
+        return r.status, r.read()
+
+
+# ---- line protocol parser --------------------------------------------------
+
+
+def test_parse_line_protocol():
+    pts = parse_line_protocol(
+        'cpu,host=h1,region=us usage_user=42.5,active=t,name="web 1" 1700000000000000000\n'
+        "cpu,host=h2 usage_user=13i\n",
+        precision="ns",
+    )
+    assert len(pts) == 2
+    assert pts[0].measurement == "cpu"
+    assert pts[0].tags == {"host": "h1", "region": "us"}
+    assert pts[0].fields == {"usage_user": 42.5, "active": True, "name": "web 1"}
+    assert pts[0].ts_ms == 1700000000000
+    assert pts[1].fields == {"usage_user": 13}
+    assert pts[1].ts_ms is None
+
+
+def test_parse_line_protocol_escapes():
+    pts = parse_line_protocol(r"my\ metric,tag\,1=a\ b value=1 1000", precision="ms")
+    assert pts[0].measurement == "my metric"
+    assert pts[0].tags == {"tag,1": "a b"}
+    assert pts[0].ts_ms == 1000
+
+
+# ---- HTTP endpoints --------------------------------------------------------
+
+
+def test_health_and_metrics(server):
+    srv, _db = server
+    status, _ = _get(srv, "/health")
+    assert status == 200
+    status, body = _get(srv, "/metrics")
+    assert status == 200
+    assert b"greptime" in body
+
+
+def test_sql_over_http(server):
+    srv, _db = server
+    status, body = _post(
+        srv,
+        "/v1/sql",
+        b"sql=CREATE TABLE t (ts TIMESTAMP TIME INDEX, v DOUBLE)",
+        "application/x-www-form-urlencoded",
+    )
+    assert status == 200
+    status, body = _post(
+        srv,
+        "/v1/sql",
+        b"sql=INSERT INTO t VALUES (1000, 1.5), (2000, 2.5)",
+        "application/x-www-form-urlencoded",
+    )
+    assert json.loads(body)["output"][0]["affectedrows"] == 2
+    status, body = _post(
+        srv,
+        "/v1/sql",
+        b"sql=SELECT avg(v) FROM t",
+        "application/x-www-form-urlencoded",
+    )
+    out = json.loads(body)["output"][0]["records"]
+    assert out["rows"] == [[2.0]]
+
+
+def test_sql_error_maps_to_400(server):
+    srv, _db = server
+    req = urllib.request.Request(
+        f"http://{srv.address}/v1/sql",
+        data=b"sql=SELECT * FROM missing_table",
+        headers={"Content-Type": "application/x-www-form-urlencoded"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(req)
+    assert err.value.code == 400
+    payload = json.loads(err.value.read())
+    assert payload["code"] == 4001  # TABLE_NOT_FOUND
+
+
+def test_influx_write_auto_schema(server):
+    srv, db = server
+    lines = "\n".join(
+        f"cpu,host=h{i % 3} usage_user={i}.5,usage_system={i} {1700000000 + i}"
+        for i in range(30)
+    )
+    status, _ = _post(srv, "/v1/influxdb/write?precision=s", lines.encode())
+    assert status == 204
+    t = db.sql_one("SELECT count(*) FROM cpu")
+    assert t["count(*)"].to_pylist() == [30]
+    sem = db.sql_one("DESCRIBE cpu")
+    by_col = dict(zip(sem["Column"].to_pylist(), sem["Semantic Type"].to_pylist()))
+    assert by_col["host"] == "TAG"
+    assert by_col["usage_user"] == "FIELD"
+
+    # New field on existing table -> schema alter.
+    status, _ = _post(srv, "/v1/influxdb/write?precision=s", b"cpu,host=h0 usage_idle=9.9 1700000100")
+    assert status == 204
+    t = db.sql_one("SELECT max(usage_idle) FROM cpu")
+    assert t.num_rows == 1
+
+
+def test_prometheus_api(server):
+    srv, db = server
+    lines = "\n".join(
+        f"reqs,host=h{i % 2} val={i * 10} {1000 + i * 10}" for i in range(61)
+    )
+    _post(srv, "/v1/influxdb/write?precision=s", lines.encode())
+    status, body = _get(
+        srv,
+        "/v1/prometheus/api/v1/query_range?query=rate(reqs[5m])&start=1300&end=1600&step=60",
+    )
+    assert status == 200
+    data = json.loads(body)["data"]
+    assert data["resultType"] == "matrix"
+    assert len(data["result"]) == 2  # two hosts
+    for series in data["result"]:
+        # interleaved hosts: each host's counter climbs 20 per 20s -> 1/s
+        vals = [float(v) for _, v in series["values"]]
+        np.testing.assert_allclose(vals, 1.0, rtol=1e-6)
+
+    status, body = _get(srv, "/v1/prometheus/api/v1/labels")
+    assert "host" in json.loads(body)["data"]
+    status, body = _get(srv, "/v1/prometheus/api/v1/label/host/values")
+    assert json.loads(body)["data"] == ["h0", "h1"]
+    status, body = _get(srv, "/v1/prometheus/api/v1/label/__name__/values")
+    assert "reqs" in json.loads(body)["data"]
