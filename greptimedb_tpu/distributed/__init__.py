@@ -1,0 +1,4 @@
+from .cluster import Cluster
+from .kv import FileKvBackend, MemoryKvBackend
+
+__all__ = ["Cluster", "MemoryKvBackend", "FileKvBackend"]

@@ -1,0 +1,67 @@
+"""Phi-accrual failure detector.
+
+Port of the semantics of the reference's Akka-style detector (reference
+meta-srv/src/failure_detector.rs:43 `PhiAccrualFailureDetector`, default
+threshold 8.0 at :79): heartbeat inter-arrival times feed a normal model;
+phi(t) = -log10(P(no heartbeat by t)) grows as the silence stretches, and
+crossing the threshold declares the peer suspect.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PhiAccrualFailureDetector:
+    threshold: float = 8.0
+    min_std_deviation_ms: float = 100.0
+    acceptable_heartbeat_pause_ms: float = 3000.0
+    first_heartbeat_estimate_ms: float = 1000.0
+    max_sample_size: int = 200
+    _intervals: deque = field(default_factory=deque)
+    _last_heartbeat_ms: float | None = None
+
+    def heartbeat(self, now_ms: float):
+        if self._last_heartbeat_ms is not None:
+            interval = now_ms - self._last_heartbeat_ms
+            self._intervals.append(interval)
+            if len(self._intervals) > self.max_sample_size:
+                self._intervals.popleft()
+        else:
+            # Bootstrap with a synthetic sample (reference does the same:
+            # mean = first_heartbeat_estimate, stddev = mean/4).
+            mean = self.first_heartbeat_estimate_ms
+            self._intervals.append(mean - mean / 4)
+            self._intervals.append(mean + mean / 4)
+        self._last_heartbeat_ms = now_ms
+
+    def phi(self, now_ms: float) -> float:
+        if self._last_heartbeat_ms is None or not self._intervals:
+            return 0.0
+        elapsed = now_ms - self._last_heartbeat_ms
+        mean = sum(self._intervals) / len(self._intervals)
+        var = sum((x - mean) ** 2 for x in self._intervals) / max(len(self._intervals), 1)
+        std = max(math.sqrt(var), self.min_std_deviation_ms)
+        mean += self.acceptable_heartbeat_pause_ms
+        y = (elapsed - mean) / std
+        # Logistic approximation to the normal CDF (same as Akka/reference).
+        # Clamp the exponent: beyond ~700 exp() overflows a double and the
+        # probability is 0/1 to machine precision anyway.
+        exponent = -y * (1.5976 + 0.070566 * y * y)
+        if exponent > 700.0:
+            return 0.0 if elapsed <= mean else 300.0
+        if exponent < -700.0:
+            return 300.0 if elapsed > mean else 0.0
+        e = math.exp(exponent)
+        if elapsed > mean:
+            p_later = e / (1.0 + e)
+        else:
+            p_later = 1.0 - 1.0 / (1.0 + e)
+        p_later = max(p_later, 1e-300)
+        return -math.log10(p_later)
+
+    def is_available(self, now_ms: float) -> bool:
+        return self.phi(now_ms) < self.threshold

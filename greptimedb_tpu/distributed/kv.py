@@ -1,0 +1,110 @@
+"""KV backend: the metadata store under the coordination plane.
+
+Role-equivalent of the reference's `KvBackend` trait + TxnService
+(reference common/meta/src/kv_backend.rs:52, kv_backend/{memory,etcd}.rs):
+get/put/range/delete plus compare-and-put transactions — the primitive the
+procedure framework and metadata manager build on.  Memory backend for
+tests, file backend for standalone durability (the etcd/PG role is a later
+round's network backend behind the same interface).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+
+class KvBackend:
+    def get(self, key: str) -> str | None:
+        raise NotImplementedError
+
+    def put(self, key: str, value: str):
+        raise NotImplementedError
+
+    def delete(self, key: str):
+        raise NotImplementedError
+
+    def range(self, prefix: str) -> dict[str, str]:
+        raise NotImplementedError
+
+    def compare_and_put(self, key: str, expect: str | None, value: str) -> bool:
+        """Atomic CAS: write `value` iff current == expect (None = absent)."""
+        raise NotImplementedError
+
+    def batch_put(self, kvs: dict[str, str]):
+        for k, v in kvs.items():
+            self.put(k, v)
+
+
+class MemoryKvBackend(KvBackend):
+    def __init__(self):
+        self._data: dict[str, str] = {}
+        self._lock = threading.RLock()
+
+    def get(self, key):
+        with self._lock:
+            return self._data.get(key)
+
+    def put(self, key, value):
+        with self._lock:
+            self._data[key] = value
+
+    def delete(self, key):
+        with self._lock:
+            self._data.pop(key, None)
+
+    def range(self, prefix):
+        with self._lock:
+            return {k: v for k, v in self._data.items() if k.startswith(prefix)}
+
+    def compare_and_put(self, key, expect, value):
+        with self._lock:
+            cur = self._data.get(key)
+            if cur != expect:
+                return False
+            self._data[key] = value
+            return True
+
+
+class FileKvBackend(MemoryKvBackend):
+    """Memory backend journaled to a JSON file (atomic replace per write).
+
+    Plays the role of the reference's raft-engine-backed standalone KV
+    (log-store/src/raft_engine/backend.rs): durable single-node metadata.
+    """
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        if os.path.exists(path):
+            with open(path) as f:
+                self._data = json.load(f)
+
+    def _persist(self):
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(self._data, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def put(self, key, value):
+        with self._lock:
+            self._data[key] = value
+            self._persist()
+
+    def delete(self, key):
+        with self._lock:
+            self._data.pop(key, None)
+            self._persist()
+
+    def compare_and_put(self, key, expect, value):
+        with self._lock:
+            cur = self._data.get(key)
+            if cur != expect:
+                return False
+            self._data[key] = value
+            self._persist()
+            return True

@@ -1,0 +1,168 @@
+"""Metasrv: the cluster brain — routes, heartbeats, leases, failover.
+
+Role-equivalent of the reference's meta-srv (reference
+meta-srv/src/metasrv.rs:534): holds table routes in the KV backend, runs a
+heartbeat handler pipeline that feeds phi-accrual detectors and grants
+region leases, drives a region supervisor that turns detector trips into
+failover procedures (reference region/supervisor.rs:275 + procedure/
+region_migration/), and places new regions with a selector
+(reference selector/round_robin.rs).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+
+from ..utils.errors import IllegalStateError
+from .failure_detector import PhiAccrualFailureDetector
+from .kv import KvBackend
+from .procedure import DONE, EXECUTING, Procedure, ProcedureManager
+
+ROUTE_PREFIX = "/table_route/"
+LEASE_MS = 10_000
+
+
+@dataclass
+class DatanodeInfo:
+    node_id: int
+    alive: bool = True
+    detector: PhiAccrualFailureDetector = field(default_factory=PhiAccrualFailureDetector)
+    mailbox: list[dict] = field(default_factory=list)  # pending Instructions
+    last_stats: list = field(default_factory=list)
+
+
+class RegionFailoverProcedure(Procedure):
+    """Durable failover state machine (reference region_migration.rs:737):
+      select_target -> open_candidate -> update_metadata -> done.
+    State: {step, region_id, table_id, from_node, to_node}."""
+
+    type_name = "region_failover"
+
+    def lock_keys(self):
+        return [f"region/{self.state['region_id']}"]
+
+    def execute(self, ctx):
+        metasrv: "Metasrv" = ctx.services["metasrv"]
+        step = self.state.get("step", "select_target")
+        if step == "select_target":
+            target = metasrv.select_datanode(exclude={self.state["from_node"]})
+            if target is None:
+                raise IllegalStateError("no healthy datanode available for failover")
+            self.state["to_node"] = target
+            self.state["step"] = "open_candidate"
+            return EXECUTING
+        if step == "open_candidate":
+            # Shared storage: the target opens the region from the common
+            # data dir (the reference requires remote WAL/shared storage for
+            # failover the same way).
+            metasrv.node_manager.open_region(self.state["to_node"], self.state["region_id"])
+            self.state["step"] = "update_metadata"
+            return EXECUTING
+        if step == "update_metadata":
+            metasrv.update_route(
+                self.state["table_id"], self.state["region_id"], self.state["to_node"]
+            )
+            metasrv.node_manager.close_region_quiet(
+                self.state["from_node"], self.state["region_id"]
+            )
+            self.state["step"] = "done"
+            return DONE
+        return DONE
+
+
+class Metasrv:
+    def __init__(self, kv: KvBackend, node_manager):
+        """node_manager: gateway to datanodes (open_region/close_region...);
+        the in-process analogue of the reference's NodeManager gRPC clients."""
+        self.kv = kv
+        self.node_manager = node_manager
+        self.datanodes: dict[int, DatanodeInfo] = {}
+        self.procedures = ProcedureManager(kv, services={"metasrv": self})
+        self.procedures.register(RegionFailoverProcedure)
+        self._rr_counter = 0
+        self._lock = threading.RLock()
+        self.maintenance_mode = False
+
+    # ---- membership -------------------------------------------------------
+    def register_datanode(self, node_id: int):
+        with self._lock:
+            self.datanodes.setdefault(node_id, DatanodeInfo(node_id))
+
+    def select_datanode(self, exclude: set[int] = frozenset()) -> int | None:
+        """Round-robin over healthy nodes (reference selector/round_robin.rs)."""
+        with self._lock:
+            healthy = [n for n in sorted(self.datanodes) if self.datanodes[n].alive and n not in exclude]
+            if not healthy:
+                return None
+            self._rr_counter += 1
+            return healthy[self._rr_counter % len(healthy)]
+
+    # ---- routes -----------------------------------------------------------
+    def set_route(self, table_id: int, routes: dict[int, int]):
+        self.kv.put(ROUTE_PREFIX + str(table_id), json.dumps({str(k): v for k, v in routes.items()}))
+
+    def get_route(self, table_id: int) -> dict[int, int]:
+        raw = self.kv.get(ROUTE_PREFIX + str(table_id))
+        return {int(k): v for k, v in json.loads(raw).items()} if raw else {}
+
+    def update_route(self, table_id: int, region_id: int, node_id: int):
+        routes = self.get_route(table_id)
+        routes[region_id] = node_id
+        self.set_route(table_id, routes)
+
+    def regions_on(self, node_id: int) -> list[tuple[int, int]]:
+        out = []
+        for key, raw in self.kv.range(ROUTE_PREFIX).items():
+            table_id = int(key[len(ROUTE_PREFIX) :])
+            for region_id, n in json.loads(raw).items():
+                if n == node_id:
+                    out.append((table_id, int(region_id)))
+        return out
+
+    # ---- heartbeat pipeline (reference handler group) ---------------------
+    def handle_heartbeat(self, node_id: int, region_stats: list, now_ms: float) -> dict:
+        with self._lock:
+            info = self.datanodes.setdefault(node_id, DatanodeInfo(node_id))
+            info.detector.heartbeat(now_ms)
+            info.alive = True
+            info.last_stats = region_stats
+            instructions, info.mailbox = info.mailbox, []
+        # Lease extension for every region the routes say this node owns.
+        leases = [rid for _t, rid in self.regions_on(node_id)]
+        return {
+            "lease_regions": leases,
+            "lease_until_ms": now_ms + LEASE_MS,
+            "instructions": instructions,
+        }
+
+    def send_instruction(self, node_id: int, instruction: dict):
+        with self._lock:
+            self.datanodes[node_id].mailbox.append(instruction)
+
+    # ---- supervisor tick (reference RegionSupervisor) ---------------------
+    def tick(self, now_ms: float) -> list[str]:
+        """Detect failed datanodes and fail their regions over; returns
+        submitted procedure ids."""
+        if self.maintenance_mode:
+            return []
+        submitted = []
+        with self._lock:
+            suspects = [
+                info
+                for info in self.datanodes.values()
+                if info.alive and not info.detector.is_available(now_ms)
+            ]
+        for info in suspects:
+            info.alive = False
+            for table_id, region_id in self.regions_on(info.node_id):
+                proc = RegionFailoverProcedure(
+                    state={
+                        "region_id": region_id,
+                        "table_id": table_id,
+                        "from_node": info.node_id,
+                    }
+                )
+                submitted.append(self.procedures.submit(proc))
+        return submitted

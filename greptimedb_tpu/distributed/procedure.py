@@ -1,0 +1,203 @@
+"""Procedure framework: durable, resumable multi-step state machines.
+
+Role-equivalent of the reference's `common/procedure` crate (reference
+common/procedure/src/procedure.rs:182, local/ runner, RFC
+2023-01-03-procedure-framework): a Procedure executes step by step, dumps
+its state to the KV store after every step, holds key-range locks, retries
+with backoff, and resumes from the last dumped state after a crash or
+leader change (reference metasrv re-arms procedures on election,
+metasrv.rs:604-618).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+import uuid
+from dataclasses import dataclass, field
+
+from ..utils.errors import IllegalStateError, RetryLaterError
+from .kv import KvBackend
+
+PROC_PREFIX = "/procedure/"
+
+# Status values a step returns.
+EXECUTING = "executing"  # more steps to go
+DONE = "done"
+POISONED = "poisoned"  # non-retryable failure; rollback ran
+
+
+class Procedure:
+    """Subclass with `type_name`, `execute(ctx) -> str`, optional
+    `rollback(ctx)` and `lock_keys()`.
+
+    `execute` performs ONE step using self.state (a JSON-serializable dict;
+    `self.state["step"]` is conventional) and returns EXECUTING or DONE.
+    """
+
+    type_name: str = "procedure"
+
+    def __init__(self, state: dict | None = None):
+        self.state: dict = state or {}
+
+    def execute(self, ctx: "ProcedureContext") -> str:
+        raise NotImplementedError
+
+    def rollback(self, ctx: "ProcedureContext"):
+        pass
+
+    def lock_keys(self) -> list[str]:
+        return []
+
+
+@dataclass
+class ProcedureContext:
+    procedure_id: str
+    manager: "ProcedureManager"
+    services: dict = field(default_factory=dict)  # DI: engines, routers, ...
+
+
+@dataclass
+class ProcedureRecord:
+    procedure_id: str
+    type_name: str
+    status: str
+    state: dict
+    error: str | None = None
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "procedure_id": self.procedure_id,
+                "type_name": self.type_name,
+                "status": self.status,
+                "state": self.state,
+                "error": self.error,
+            }
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "ProcedureRecord":
+        return cls(**json.loads(s))
+
+
+class ProcedureManager:
+    """Runs procedures to completion, checkpointing state per step.
+
+    Key-range locks serialize conflicting procedures (reference
+    local/rwlock.rs): a procedure's lock_keys are acquired before the first
+    step and released at the end.
+    """
+
+    def __init__(self, kv: KvBackend, services: dict | None = None, max_retries: int = 3):
+        self.kv = kv
+        self.services = services or {}
+        self.max_retries = max_retries
+        self._registry: dict[str, type[Procedure]] = {}
+        self._locks: dict[str, str] = {}  # lock key -> procedure id
+        self._lock = threading.Lock()
+
+    def register(self, cls: type[Procedure]):
+        self._registry[cls.type_name] = cls
+        return cls
+
+    # ---- submission -------------------------------------------------------
+    def submit(self, procedure: Procedure, procedure_id: str | None = None) -> str:
+        """Run synchronously to completion (the reference runs async and
+        offers a watcher; our callers block, which keeps DDL linear)."""
+        pid = procedure_id or uuid.uuid4().hex
+        record = ProcedureRecord(pid, procedure.type_name, EXECUTING, procedure.state)
+        self.kv.put(PROC_PREFIX + pid, record.to_json())
+        self._acquire_locks(procedure, pid)
+        try:
+            self._drive(procedure, record)
+        finally:
+            self._release_locks(pid)
+        if record.status == POISONED:
+            raise IllegalStateError(
+                f"procedure {procedure.type_name}({pid}) failed: {record.error}"
+            )
+        return pid
+
+    def _drive(self, procedure: Procedure, record: ProcedureRecord):
+        ctx = ProcedureContext(record.procedure_id, self, self.services)
+        retries = 0
+        while True:
+            try:
+                status = procedure.execute(ctx)
+            except RetryLaterError:
+                retries += 1
+                if retries > self.max_retries:
+                    status = self._poison(procedure, ctx, record, "retries exhausted")
+                    return
+                time.sleep(min(0.01 * (2**retries), 0.5))
+                continue
+            except Exception:
+                status = self._poison(procedure, ctx, record, traceback.format_exc(limit=3))
+                return
+            retries = 0
+            record.state = procedure.state
+            record.status = status
+            self.kv.put(PROC_PREFIX + record.procedure_id, record.to_json())
+            if status != EXECUTING:
+                return
+
+    def _poison(self, procedure, ctx, record, error: str):
+        try:
+            procedure.rollback(ctx)
+        except Exception:
+            pass
+        record.status = POISONED
+        record.error = error
+        self.kv.put(PROC_PREFIX + record.procedure_id, record.to_json())
+        return POISONED
+
+    # ---- crash recovery ---------------------------------------------------
+    def recover(self) -> list[str]:
+        """Resume every EXECUTING procedure from its dumped state (called on
+        process start / new leader)."""
+        resumed = []
+        for key, raw in self.kv.range(PROC_PREFIX).items():
+            record = ProcedureRecord.from_json(raw)
+            if record.status != EXECUTING:
+                continue
+            cls = self._registry.get(record.type_name)
+            if cls is None:
+                continue
+            procedure = cls(state=record.state)
+            self._acquire_locks(procedure, record.procedure_id)
+            try:
+                self._drive(procedure, record)
+            finally:
+                self._release_locks(record.procedure_id)
+            resumed.append(record.procedure_id)
+        return resumed
+
+    def record(self, pid: str) -> ProcedureRecord | None:
+        raw = self.kv.get(PROC_PREFIX + pid)
+        return ProcedureRecord.from_json(raw) if raw else None
+
+    def list_records(self) -> list[ProcedureRecord]:
+        return [ProcedureRecord.from_json(v) for v in self.kv.range(PROC_PREFIX).values()]
+
+    # ---- locking ----------------------------------------------------------
+    def _acquire_locks(self, procedure: Procedure, pid: str):
+        keys = sorted(procedure.lock_keys())
+        deadline = time.time() + 10.0
+        while True:
+            with self._lock:
+                conflict = [k for k in keys if self._locks.get(k) not in (None, pid)]
+                if not conflict:
+                    for k in keys:
+                        self._locks[k] = pid
+                    return
+            if time.time() > deadline:
+                raise IllegalStateError(f"lock timeout on {conflict} for {pid}")
+            time.sleep(0.005)
+
+    def _release_locks(self, pid: str):
+        with self._lock:
+            for k in [k for k, v in self._locks.items() if v == pid]:
+                del self._locks[k]
