@@ -1,0 +1,136 @@
+"""ctypes bindings for the native runtime library.
+
+Auto-builds `libgreptime_native.so` with g++ on first import if missing
+(and a toolchain exists); every entry point has a pure-Python fallback so
+the package works without the native lib — but the hot paths (WAL recovery
+scan, line-protocol tokenize, crc32) run native when available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_DIR = os.path.dirname(__file__)
+_LIB_PATH = os.path.join(_DIR, "libgreptime_native.so")
+_lib = None
+
+
+def _try_build() -> bool:
+    src = os.path.join(_DIR, "src", "greptime_native.cpp")
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", _LIB_PATH, src],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH) and not _try_build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.gt_crc32.restype = ctypes.c_uint32
+    lib.gt_crc32.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
+    lib.gt_wal_scan.restype = ctypes.c_int64
+    lib.gt_wal_scan.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+    ]
+    lib.gt_lp_tokenize.restype = ctypes.c_int64
+    lib.gt_lp_tokenize.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+    ]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def crc32(data: bytes, seed: int = 0) -> int:
+    lib = load()
+    if lib is None:
+        import zlib
+
+        return zlib.crc32(data, seed)
+    return lib.gt_crc32(data, len(data), seed)
+
+
+def wal_scan(buf: bytes, max_entries: int = 1 << 20) -> list[tuple[int, int, int]]:
+    """Scan WAL frames -> [(payload_offset, payload_len, entry_id)]."""
+    lib = load()
+    if lib is None:
+        return _wal_scan_py(buf, max_entries)
+    out = (ctypes.c_int64 * (3 * max_entries))()
+    n = lib.gt_wal_scan(buf, len(buf), out, max_entries)
+    return [(out[i * 3], out[i * 3 + 1], out[i * 3 + 2]) for i in range(n)]
+
+
+def _wal_scan_py(buf: bytes, max_entries: int):
+    import struct
+    import zlib
+
+    header = struct.Struct("<IIQ")
+    out, pos = [], 0
+    while len(out) < max_entries and pos + header.size <= len(buf):
+        length, crc, entry_id = header.unpack_from(buf, pos)
+        payload_start = pos + header.size
+        if payload_start + length > len(buf):
+            break
+        payload = buf[payload_start : payload_start + length]
+        if zlib.crc32(payload) != crc:
+            break
+        out.append((payload_start, length, entry_id))
+        pos = payload_start + length
+    return out
+
+
+# Token kinds from greptime_native.cpp (kind >= 100 means "has escapes").
+TOK_MEASUREMENT = 0
+TOK_TAG_KEY = 1
+TOK_TAG_VAL = 2
+TOK_FIELD_KEY = 3
+TOK_FIELD_FLOAT = 4
+TOK_FIELD_INT = 5
+TOK_FIELD_STR = 6
+TOK_FIELD_BOOL_T = 7
+TOK_FIELD_BOOL_F = 8
+TOK_TIMESTAMP = 9
+TOK_LINE_END = 10
+
+
+def lp_tokenize(buf: bytes, max_tokens: int | None = None):
+    """Tokenize line protocol -> [(kind, start, end)] or None if the native
+    lib is unavailable (caller falls back to the Python parser)."""
+    lib = load()
+    if lib is None:
+        return None
+    if max_tokens is None:
+        max_tokens = max(64, buf.count(b"\n") * 16 + 64)
+    out = (ctypes.c_int64 * (3 * max_tokens))()
+    n = lib.gt_lp_tokenize(buf, len(buf), out, max_tokens)
+    if n < 0:
+        from ..utils.errors import InvalidArgumentsError
+
+        raise InvalidArgumentsError(f"bad line protocol near offset {-(n + 1)}")
+    return [(out[i * 3], out[i * 3 + 1], out[i * 3 + 2]) for i in range(n)]

@@ -1,0 +1,221 @@
+// Native runtime hot paths.
+//
+// The reference implements its whole runtime in Rust; these are the C++
+// equivalents for the paths where Python overhead matters most:
+//   - crc32 (zlib polynomial, slice-by-8): WAL frame checksums
+//   - wal_scan: frame-walk a WAL buffer, validating lengths + CRCs and
+//     reporting entry offsets (region open replays call this per region;
+//     reference raft-engine does its recovery scan in native code too)
+//   - lp_tokenize: InfluxDB line-protocol tokenizer emitting token offsets
+//     (measurement/tag/field/timestamp spans) so Python only slices —
+//     the ingest hot loop (reference servers/src/influxdb.rs + row_writer)
+//
+// Exposed with a plain C ABI for ctypes.  Build: `make` in native/.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+extern "C" {
+
+// ---------------------------------------------------------------- crc32 ----
+
+static uint32_t crc_table[8][256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+    if (crc_init_done) return;
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        crc_table[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = crc_table[0][i];
+        for (int s = 1; s < 8; s++) {
+            c = crc_table[0][c & 0xFF] ^ (c >> 8);
+            crc_table[s][i] = c;
+        }
+    }
+    crc_init_done = true;
+}
+
+uint32_t gt_crc32(const uint8_t* data, size_t len, uint32_t seed) {
+    crc_init();
+    uint32_t c = seed ^ 0xFFFFFFFFu;
+    while (len >= 8) {
+        c ^= (uint32_t)data[0] | ((uint32_t)data[1] << 8) |
+             ((uint32_t)data[2] << 16) | ((uint32_t)data[3] << 24);
+        uint32_t hi = (uint32_t)data[4] | ((uint32_t)data[5] << 8) |
+                      ((uint32_t)data[6] << 16) | ((uint32_t)data[7] << 24);
+        c = crc_table[7][c & 0xFF] ^ crc_table[6][(c >> 8) & 0xFF] ^
+            crc_table[5][(c >> 16) & 0xFF] ^ crc_table[4][(c >> 24) & 0xFF] ^
+            crc_table[3][hi & 0xFF] ^ crc_table[2][(hi >> 8) & 0xFF] ^
+            crc_table[1][(hi >> 16) & 0xFF] ^ crc_table[0][(hi >> 24) & 0xFF];
+        data += 8;
+        len -= 8;
+    }
+    while (len--) c = crc_table[0][(c ^ *data++) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+// ------------------------------------------------------------- wal scan ----
+
+// Frame: [u32 payload_len][u32 crc32(payload)][u64 entry_id][payload]
+// Scans up to max_entries frames; writes (offset, payload_len, entry_id)
+// triples into out (3 * max_entries int64 slots).  Returns the number of
+// valid frames; stops at a torn/corrupt tail like the Python replay().
+int64_t gt_wal_scan(const uint8_t* buf, int64_t len, int64_t* out,
+                    int64_t max_entries) {
+    crc_init();
+    int64_t pos = 0, n = 0;
+    const int64_t header = 16;
+    while (n < max_entries && pos + header <= len) {
+        uint32_t payload_len, crc;
+        uint64_t entry_id;
+        memcpy(&payload_len, buf + pos, 4);
+        memcpy(&crc, buf + pos + 4, 4);
+        memcpy(&entry_id, buf + pos + 8, 8);
+        if (pos + header + (int64_t)payload_len > len) break;  // torn tail
+        if (gt_crc32(buf + pos + header, payload_len, 0) != crc) break;
+        out[n * 3 + 0] = pos + header;
+        out[n * 3 + 1] = (int64_t)payload_len;
+        out[n * 3 + 2] = (int64_t)entry_id;
+        pos += header + payload_len;
+        n++;
+    }
+    return n;
+}
+
+// ---------------------------------------------------- line protocol -------
+
+// Token kinds emitted by lp_tokenize.
+enum TokKind : int32_t {
+    TOK_MEASUREMENT = 0,
+    TOK_TAG_KEY = 1,
+    TOK_TAG_VAL = 2,
+    TOK_FIELD_KEY = 3,
+    TOK_FIELD_FLOAT = 4,
+    TOK_FIELD_INT = 5,
+    TOK_FIELD_STR = 6,
+    TOK_FIELD_BOOL_T = 7,
+    TOK_FIELD_BOOL_F = 8,
+    TOK_TIMESTAMP = 9,
+    TOK_LINE_END = 10,
+    TOK_ERROR = 11,
+};
+
+// Tokenizes `buf` into (kind, start, end) triples written to out
+// (3 * max_tokens int64 slots, kind stored as int64).  Handles escapes
+// (\,  \space  \= inside identifiers) and double-quoted strings with \".
+// Escaped spans keep their backslashes; Python unescapes only when a
+// backslash was seen (flagged by kind += 100).
+// Returns token count, or -(1+offset) on error.
+int64_t gt_lp_tokenize(const uint8_t* buf, int64_t len, int64_t* out,
+                       int64_t max_tokens) {
+    int64_t n = 0;
+    int64_t i = 0;
+    auto emit = [&](int64_t kind, int64_t s, int64_t e) -> bool {
+        if (n >= max_tokens) return false;
+        out[n * 3] = kind; out[n * 3 + 1] = s; out[n * 3 + 2] = e;
+        n++;
+        return true;
+    };
+    while (i < len) {
+        // skip blank lines / comments
+        while (i < len && (buf[i] == '\n' || buf[i] == '\r')) i++;
+        if (i >= len) break;
+        if (buf[i] == '#') {
+            while (i < len && buf[i] != '\n') i++;
+            continue;
+        }
+        // measurement (to unescaped ',' or ' ')
+        int64_t start = i;
+        bool escaped = false;
+        while (i < len && buf[i] != ',' && buf[i] != ' ' && buf[i] != '\n') {
+            if (buf[i] == '\\' && i + 1 < len) { escaped = true; i += 2; }
+            else i++;
+        }
+        if (i >= len || buf[i] == '\n') return -(1 + start);
+        if (!emit(TOK_MEASUREMENT + (escaped ? 100 : 0), start, i)) return n;
+        // tags
+        while (i < len && buf[i] == ',') {
+            i++;
+            start = i; escaped = false;
+            while (i < len && buf[i] != '=') {
+                if (buf[i] == '\\' && i + 1 < len) { escaped = true; i += 2; }
+                else i++;
+            }
+            if (i >= len) return -(1 + start);
+            if (!emit(TOK_TAG_KEY + (escaped ? 100 : 0), start, i)) return n;
+            i++;  // '='
+            start = i; escaped = false;
+            while (i < len && buf[i] != ',' && buf[i] != ' ') {
+                if (buf[i] == '\\' && i + 1 < len) { escaped = true; i += 2; }
+                else i++;
+            }
+            if (!emit(TOK_TAG_VAL + (escaped ? 100 : 0), start, i)) return n;
+        }
+        if (i >= len || buf[i] != ' ') return -(1 + i);
+        while (i < len && buf[i] == ' ') i++;
+        // fields
+        bool more_fields = true;
+        while (more_fields) {
+            start = i; escaped = false;
+            while (i < len && buf[i] != '=') {
+                if (buf[i] == '\\' && i + 1 < len) { escaped = true; i += 2; }
+                else i++;
+            }
+            if (i >= len) return -(1 + start);
+            if (!emit(TOK_FIELD_KEY + (escaped ? 100 : 0), start, i)) return n;
+            i++;  // '='
+            if (i < len && buf[i] == '"') {
+                i++;
+                start = i; escaped = false;
+                while (i < len && buf[i] != '"') {
+                    if (buf[i] == '\\' && i + 1 < len) { escaped = true; i += 2; }
+                    else i++;
+                }
+                if (i >= len) return -(1 + start);
+                if (!emit(TOK_FIELD_STR + (escaped ? 100 : 0), start, i)) return n;
+                i++;  // closing quote
+            } else {
+                start = i;
+                while (i < len && buf[i] != ',' && buf[i] != ' ' && buf[i] != '\n') i++;
+                int64_t end = i;
+                if (end == start) return -(1 + start);
+                uint8_t last = buf[end - 1];
+                int64_t kind;
+                if (end - start == 1 && (buf[start] == 't' || buf[start] == 'T'))
+                    kind = TOK_FIELD_BOOL_T;
+                else if (end - start == 1 && (buf[start] == 'f' || buf[start] == 'F'))
+                    kind = TOK_FIELD_BOOL_F;
+                else if ((end - start == 4 && !strncmp((const char*)buf + start, "true", 4)))
+                    kind = TOK_FIELD_BOOL_T;
+                else if ((end - start == 5 && !strncmp((const char*)buf + start, "false", 5)))
+                    kind = TOK_FIELD_BOOL_F;
+                else if (last == 'i' || last == 'u')
+                    kind = TOK_FIELD_INT;
+                else
+                    kind = TOK_FIELD_FLOAT;
+                if (!emit(kind, start, end)) return n;
+            }
+            if (i < len && buf[i] == ',') { i++; continue; }
+            more_fields = false;
+        }
+        // optional timestamp
+        if (i < len && buf[i] == ' ') {
+            while (i < len && buf[i] == ' ') i++;
+            start = i;
+            while (i < len && buf[i] != '\n' && buf[i] != ' ' && buf[i] != '\r') i++;
+            if (i > start) {
+                if (!emit(TOK_TIMESTAMP, start, i)) return n;
+            }
+        }
+        if (!emit(TOK_LINE_END, i, i)) return n;
+        while (i < len && buf[i] != '\n') i++;
+    }
+    return n;
+}
+
+}  // extern "C"

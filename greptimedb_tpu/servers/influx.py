@@ -59,6 +59,19 @@ def _unescape(s: str) -> str:
     return s.replace("\\,", ",").replace("\\ ", " ").replace("\\=", "=").replace('\\"', '"')
 
 
+def _partition_unescaped(s: str, sep: str) -> tuple[str, str]:
+    """Split at the first unescaped `sep` (influx `\\=` escapes in tag keys)."""
+    i = 0
+    while i < len(s):
+        if s[i] == "\\":
+            i += 2
+            continue
+        if s[i] == sep:
+            return s[:i], s[i + 1 :]
+        i += 1
+    return s, ""
+
+
 def _parse_field_value(raw: str):
     if raw.startswith('"') and raw.endswith('"') and len(raw) >= 2:
         return raw[1:-1].replace('\\"', '"')
@@ -76,6 +89,9 @@ def parse_line_protocol(body: str, precision: str = "ns") -> list[Point]:
     mult = _PRECISION_TO_MS.get(precision)
     if mult is None:
         raise InvalidArgumentsError(f"bad precision: {precision}")
+    native_points = _parse_native(body, mult)
+    if native_points is not None:
+        return native_points
     points: list[Point] = []
     for raw_line in body.splitlines():
         line = raw_line.strip()
@@ -89,11 +105,11 @@ def parse_line_protocol(body: str, precision: str = "ns") -> list[Point]:
         measurement = _unescape(head[0])
         tags = {}
         for kv in head[1:]:
-            k, _, v = kv.partition("=")
+            k, v = _partition_unescaped(kv, "=")
             tags[_unescape(k)] = _unescape(v)
         fields = {}
         for kv in _split_unescaped(parts[1], ","):
-            k, _, v = kv.partition("=")
+            k, v = _partition_unescaped(kv, "=")
             fields[_unescape(k)] = _parse_field_value(v)
         if not fields:
             raise InvalidArgumentsError(f"line has no fields: {raw_line!r}")
@@ -101,6 +117,54 @@ def parse_line_protocol(body: str, precision: str = "ns") -> list[Point]:
         if len(parts) >= 3:
             ts_ms = int(int(parts[2]) * mult)
         points.append(Point(measurement, tags, fields, ts_ms))
+    return points
+
+
+def _parse_native(body: str, mult: float) -> list[Point] | None:
+    """Tokenize with the native C++ tokenizer (greptime_native.cpp
+    gt_lp_tokenize); falls back to the Python parser when unavailable."""
+    from .. import native
+
+    buf = body.encode()
+    tokens = native.lp_tokenize(buf)
+    if tokens is None:
+        return None
+    points: list[Point] = []
+    cur: Point | None = None
+    pending_key: str | None = None
+
+    def span(s: int, e: int, kind: int) -> str:
+        raw = buf[s:e].decode()
+        return _unescape(raw) if kind >= 100 else raw
+
+    for kind, s, e in tokens:
+        base = kind % 100
+        if base == native.TOK_MEASUREMENT:
+            cur = Point(span(s, e, kind), {}, {}, None)
+        elif base == native.TOK_TAG_KEY:
+            pending_key = span(s, e, kind)
+        elif base == native.TOK_TAG_VAL:
+            cur.tags[pending_key] = span(s, e, kind)
+        elif base == native.TOK_FIELD_KEY:
+            pending_key = span(s, e, kind)
+        elif base == native.TOK_FIELD_FLOAT:
+            cur.fields[pending_key] = float(buf[s:e])
+        elif base == native.TOK_FIELD_INT:
+            cur.fields[pending_key] = int(buf[s : e - 1])
+        elif base == native.TOK_FIELD_STR:
+            cur.fields[pending_key] = buf[s:e].decode().replace('\\"', '"')
+        elif base == native.TOK_FIELD_BOOL_T:
+            cur.fields[pending_key] = True
+        elif base == native.TOK_FIELD_BOOL_F:
+            cur.fields[pending_key] = False
+        elif base == native.TOK_TIMESTAMP:
+            cur.ts_ms = int(int(buf[s:e]) * mult)
+        elif base == native.TOK_LINE_END:
+            if cur is not None:
+                if not cur.fields:
+                    raise InvalidArgumentsError(f"line has no fields: {cur.measurement!r}")
+                points.append(cur)
+                cur = None
     return points
 
 

@@ -65,18 +65,17 @@ class RegionWal:
             self.last_entry_id = entry_id
 
     def _scan_entry_ids(self):
+        # Native frame scan (greptime_native.cpp gt_wal_scan) validates
+        # lengths + CRCs in C++; the Python path inside native.wal_scan is
+        # the fallback when the lib is unavailable.
         if not os.path.exists(self.path):
             return
+        from .. import native
+
         with open(self.path, "rb") as f:
-            while True:
-                header = f.read(_HEADER.size)
-                if len(header) < _HEADER.size:
-                    break
-                length, crc, entry_id = _HEADER.unpack(header)
-                payload = f.read(length)
-                if len(payload) < length or zlib.crc32(payload) != crc:
-                    break
-                yield entry_id
+            buf = f.read()
+        for _off, _len, entry_id in native.wal_scan(buf):
+            yield entry_id
 
     def advance_to(self, entry_id: int):
         """Ensure future entry ids exceed `entry_id`.  Called on region open

@@ -1,0 +1,63 @@
+"""Native C++ library tests: build, crc parity, WAL scan parity, line
+protocol tokenizer parity with the Python parser."""
+
+import zlib
+
+import pytest
+
+from greptimedb_tpu import native
+from greptimedb_tpu.servers import influx
+
+
+def test_native_builds_and_loads():
+    assert native.available(), "g++ toolchain present; native lib must build"
+
+
+def test_crc32_matches_zlib():
+    for data in (b"", b"a", b"hello world" * 100, bytes(range(256)) * 33):
+        assert native.crc32(data) == zlib.crc32(data)
+
+
+def test_wal_scan_matches_python():
+    import struct
+
+    frames = b""
+    for eid, payload in ((1, b"alpha"), (2, b"bravo" * 50), (3, b"")):
+        frames += struct.pack("<IIQ", len(payload), zlib.crc32(payload), eid) + payload
+    torn = frames + b"\x08\x00\x00\x00GARBAGE!"
+    got = native.wal_scan(torn)
+    ref = native._wal_scan_py(torn, 1 << 20)
+    assert got == ref
+    assert [e for _, _, e in got] == [1, 2, 3]
+
+
+def test_lp_tokenizer_matches_python_parser():
+    body = (
+        'cpu,host=h1,region=us\\ west usage_user=42.5,active=t,name="web, 1" 1700000000000000000\n'
+        "cpu,host=h2 usage_user=13i\n"
+        "# a comment\n"
+        "\n"
+        'mem,host=h3 used=0.25,total=100u,ok=false\n'
+        r"esc\ aped,ta\=g=v\,1 f=1 1000"
+    )
+    native_pts = influx._parse_native(body, 1e-6)
+    assert native_pts is not None
+    # Force the pure-Python path for comparison.
+    py_pts = []
+    orig = influx._parse_native
+    influx._parse_native = lambda *_: None
+    try:
+        py_pts = influx.parse_line_protocol(body, "ns")
+    finally:
+        influx._parse_native = orig
+    assert len(native_pts) == len(py_pts)
+    for a, b in zip(native_pts, py_pts):
+        assert a.measurement == b.measurement
+        assert a.tags == b.tags
+        assert a.fields == b.fields
+        assert a.ts_ms == b.ts_ms
+
+
+def test_lp_tokenizer_error_offset():
+    with pytest.raises(Exception):
+        native.lp_tokenize(b"measurement_no_fields\n")
