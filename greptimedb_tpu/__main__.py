@@ -1,0 +1,148 @@
+"""CLI: `python -m greptimedb_tpu <subcommand>`.
+
+Role-equivalent of the reference's `greptime` binary (reference
+cmd/src/bin/greptime.rs:26-61): `standalone start` brings up the all-in-one
+server; `sql` executes statements against a data dir; `export`/`import`
+move table data as Parquet (reference cli data export/import); `bench`
+runs the TSBS-style benchmark.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def cmd_standalone(args):
+    from .database import Database
+    from .servers.http import HttpServer
+    from .utils.config import Config
+
+    cfg = Config.load(args.config)
+    if args.data_home:
+        cfg.storage.data_home = args.data_home
+        cfg.storage.wal_dir = ""
+        cfg.storage.sst_dir = ""
+        cfg.storage.__post_init__()
+    if args.http_addr:
+        cfg.server.http_addr = args.http_addr
+    db = Database(config=cfg)
+    srv = HttpServer(db, cfg.server.http_addr).start()
+    print(f"greptimedb-tpu standalone listening on http://{srv.address}", flush=True)
+    print(f"data home: {cfg.storage.data_home}", flush=True)
+    try:
+        import signal
+        import threading
+
+        stop = threading.Event()
+        signal.signal(signal.SIGINT, lambda *_: stop.set())
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        stop.wait()
+    finally:
+        srv.stop()
+        db.close()
+    return 0
+
+
+def cmd_sql(args):
+    from .database import Database
+
+    db = Database(data_home=args.data_home)
+    try:
+        text = args.query or sys.stdin.read()
+        for result in db.sql(text):
+            if result is None:
+                print("OK")
+            elif isinstance(result, int):
+                print(f"{result} rows affected")
+            else:
+                print(result.to_pandas().to_string(index=False) if args.pretty else result)
+    finally:
+        db.close()
+    return 0
+
+
+def cmd_export(args):
+    import pyarrow.parquet as pq
+
+    from .database import Database
+    from .query.logical_plan import TableScan
+
+    db = Database(data_home=args.data_home)
+    try:
+        meta = db.catalog.table(args.table)
+        table = db._scan(TableScan(args.table, meta.database))
+        pq.write_table(table, args.output)
+        print(f"exported {table.num_rows} rows to {args.output}")
+    finally:
+        db.close()
+    return 0
+
+
+def cmd_import(args):
+    import pyarrow.parquet as pq
+
+    from .database import Database
+
+    db = Database(data_home=args.data_home)
+    try:
+        table = pq.read_table(args.input)
+        n = db.insert_rows(args.table, table)
+        print(f"imported {n} rows into {args.table}")
+    finally:
+        db.close()
+    return 0
+
+
+def cmd_bench(args):
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main()
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="greptimedb-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("standalone", help="start the all-in-one server")
+    p.add_argument("action", choices=["start"])
+    p.add_argument("--config", default=None, help="TOML config path")
+    p.add_argument("--data-home", default=None)
+    p.add_argument("--http-addr", default=None)
+    p.set_defaults(fn=cmd_standalone)
+
+    p = sub.add_parser("sql", help="execute SQL against a data dir")
+    p.add_argument("query", nargs="?", default=None, help="SQL text (stdin if omitted)")
+    p.add_argument("--data-home", default="./greptimedb_data")
+    p.add_argument("--pretty", action="store_true")
+    p.set_defaults(fn=cmd_sql)
+
+    p = sub.add_parser("export", help="export a table to Parquet")
+    p.add_argument("table")
+    p.add_argument("output")
+    p.add_argument("--data-home", default="./greptimedb_data")
+    p.set_defaults(fn=cmd_export)
+
+    p = sub.add_parser("import", help="import Parquet into a table")
+    p.add_argument("table")
+    p.add_argument("input")
+    p.add_argument("--data-home", default="./greptimedb_data")
+    p.set_defaults(fn=cmd_import)
+
+    p = sub.add_parser("bench", help="run the TSBS-style benchmark")
+    p.set_defaults(fn=cmd_bench)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,7 +104,11 @@ class Database:
                 return self.query_engine.explain(stmt.inner, self.current_database)
             raise UnsupportedError("EXPLAIN only supports SELECT")
         if isinstance(stmt, UseStmt):
-            if stmt.database not in self.catalog.databases():
+            from .models import information_schema as info
+
+            if stmt.database not in self.catalog.databases() and not info.is_information_schema(
+                stmt.database
+            ):
                 raise InvalidArgumentsError(f"database not found: {stmt.database}")
             self.current_database = stmt.database
             return None
@@ -226,7 +230,11 @@ class Database:
 
     # ---- SHOW/DESCRIBE ----------------------------------------------------
     def _show(self, stmt: ShowStmt):
+        from .models import information_schema as info
+
         if stmt.what == "tables":
+            if info.is_information_schema(self.current_database):
+                return pa.table({"Tables": info.table_names()})
             names = [m.name for m in self.catalog.tables(self.current_database)]
             if stmt.like:
                 import fnmatch
@@ -297,6 +305,10 @@ class Database:
 
     # ---- providers for the query engine ------------------------------------
     def _schema_of(self, table: str, database: str) -> Schema:
+        from .models import information_schema as info
+
+        if info.is_information_schema(database):
+            return info.schema_of(self, table)
         return self.catalog.table(table, database).schema
 
     def _pred_of(self, scan: TableScan) -> ScanPredicate:
@@ -305,13 +317,24 @@ class Database:
         )
 
     def _region_scan(self, scan: TableScan) -> list[pa.Table]:
+        from .models import information_schema as info
+
+        if info.is_information_schema(scan.database):
+            return [info.build(self, scan.table)]
         meta = self.catalog.table(scan.table, scan.database)
         pred = self._pred_of(scan)
         return [self.storage.scan(rid, pred) for rid in meta.region_ids]
 
     def _scan(self, scan: TableScan) -> pa.Table:
+        from .models import information_schema as info
+
         if not scan.table:
             return pa.table({"__dummy": [0]})  # constant SELECTs
+        if info.is_information_schema(scan.database):
+            from .storage.sst import _apply_residual
+
+            t = info.build(self, scan.table)
+            return _apply_residual(t, self._pred_of(scan), None)
         tables = [t for t in self._region_scan(scan) if t.num_rows]
         meta = self.catalog.table(scan.table, scan.database)
         if not tables:

@@ -1,0 +1,155 @@
+"""information_schema: queryable system introspection tables.
+
+Role-equivalent of the reference's virtual system schema (reference
+catalog/src/system_schema/information_schema/: tables, columns,
+region_statistics, cluster_info, engines, procedure_info...): synthesized
+from the catalog + storage engine on every scan, so `SELECT * FROM
+information_schema.tables` always reflects live state.
+"""
+
+from __future__ import annotations
+
+import pyarrow as pa
+
+from ..datatypes.data_type import ConcreteDataType
+from ..datatypes.schema import ColumnSchema, Schema, SemanticType
+
+INFORMATION_SCHEMA = "information_schema"
+
+
+def is_information_schema(database: str) -> bool:
+    return database.lower() == INFORMATION_SCHEMA
+
+
+def build(db, table: str) -> pa.Table:
+    fn = _TABLES.get(table.lower())
+    if fn is None:
+        from ..utils.errors import TableNotFoundError
+
+        raise TableNotFoundError(f"information_schema has no table {table!r}")
+    return fn(db)
+
+
+def schema_of(db, table: str) -> Schema:
+    t = build(db, table)
+    return Schema(
+        columns=[
+            ColumnSchema(f.name, ConcreteDataType.from_arrow(f.type), SemanticType.FIELD)
+            for f in t.schema
+        ]
+    )
+
+
+def _tables(db) -> pa.Table:
+    rows = {"table_catalog": [], "table_schema": [], "table_name": [], "table_id": [],
+            "table_type": [], "engine": [], "region_count": []}
+    for database in db.catalog.databases():
+        for meta in db.catalog.tables(database):
+            rows["table_catalog"].append("greptime")
+            rows["table_schema"].append(database)
+            rows["table_name"].append(meta.name)
+            rows["table_id"].append(meta.table_id)
+            rows["table_type"].append("BASE TABLE")
+            rows["engine"].append(meta.options.get("engine", "mito"))
+            rows["region_count"].append(len(meta.region_ids))
+    return pa.table(rows)
+
+
+def _columns(db) -> pa.Table:
+    rows = {"table_schema": [], "table_name": [], "column_name": [], "data_type": [],
+            "semantic_type": [], "is_nullable": [], "column_default": []}
+    sem_names = {SemanticType.TAG: "TAG", SemanticType.FIELD: "FIELD", SemanticType.TIMESTAMP: "TIMESTAMP"}
+    for database in db.catalog.databases():
+        for meta in db.catalog.tables(database):
+            for c in meta.schema.columns:
+                rows["table_schema"].append(database)
+                rows["table_name"].append(meta.name)
+                rows["column_name"].append(c.name)
+                rows["data_type"].append(c.data_type.value)
+                rows["semantic_type"].append(sem_names[c.semantic_type])
+                rows["is_nullable"].append("YES" if c.nullable else "NO")
+                rows["column_default"].append(str(c.default) if c.default is not None else None)
+    return pa.table(rows)
+
+
+def _region_statistics(db) -> pa.Table:
+    rows = {"region_id": [], "table_id": [], "region_rows": [], "disk_size": [],
+            "memtable_size": [], "sst_num": [], "wal_entry_id": [], "flushed_entry_id": []}
+    for stat in db.storage.region_statistics():
+        rows["region_id"].append(stat.region_id)
+        rows["table_id"].append(stat.region_id // 1024)
+        rows["region_rows"].append(stat.num_rows)
+        rows["disk_size"].append(stat.sst_bytes)
+        rows["memtable_size"].append(stat.memtable_bytes)
+        rows["sst_num"].append(stat.sst_count)
+        rows["wal_entry_id"].append(stat.wal_entry_id)
+        rows["flushed_entry_id"].append(stat.flushed_entry_id)
+    return pa.table(rows)
+
+
+def _engines(db) -> pa.Table:
+    return pa.table(
+        {
+            "engine": ["mito", "metric", "file"],
+            "support": ["DEFAULT", "YES", "YES"],
+            "comment": [
+                "TPU-native LSM time-series engine",
+                "logical-table multiplexer over mito",
+                "external-file tables",
+            ],
+        }
+    )
+
+
+def _cluster_info(db) -> pa.Table:
+    from .. import __version__
+
+    return pa.table(
+        {
+            "peer_id": [0],
+            "peer_type": ["STANDALONE"],
+            "peer_addr": [""],
+            "version": [__version__],
+            "active_time": [""],
+        }
+    )
+
+
+def _schemata(db) -> pa.Table:
+    dbs = db.catalog.databases()
+    return pa.table(
+        {
+            "catalog_name": ["greptime"] * len(dbs),
+            "schema_name": dbs,
+        }
+    )
+
+
+def _partitions(db) -> pa.Table:
+    rows = {"table_schema": [], "table_name": [], "partition_name": [], "partition_expression": [],
+            "greptime_partition_id": []}
+    for database in db.catalog.databases():
+        for meta in db.catalog.tables(database):
+            rule = meta.partition_rule.to_dict()
+            for i, rid in enumerate(meta.region_ids):
+                rows["table_schema"].append(database)
+                rows["table_name"].append(meta.name)
+                rows["partition_name"].append(f"p{i}")
+                rows["partition_expression"].append(str(rule))
+                rows["greptime_partition_id"].append(rid)
+    return pa.table(rows)
+
+
+_TABLES = {
+    "tables": _tables,
+    "columns": _columns,
+    "region_statistics": _region_statistics,
+    "engines": _engines,
+    "cluster_info": _cluster_info,
+    "schemata": _schemata,
+    "partitions": _partitions,
+}
+
+
+def table_names() -> list[str]:
+    return sorted(_TABLES)

@@ -229,3 +229,20 @@ def test_projection_arithmetic(loaded):
     t = loaded.sql_one("SELECT host, usage_user + usage_system AS total FROM cpu LIMIT 5")
     assert t.num_rows == 5
     assert "total" in t.column_names
+
+
+def test_information_schema(loaded):
+    t = loaded.sql_one("SELECT table_name, region_count FROM information_schema.tables")
+    assert "cpu" in t["table_name"].to_pylist()
+    t = loaded.sql_one(
+        "SELECT column_name, semantic_type FROM information_schema.columns WHERE table_name = 'cpu'"
+    )
+    sem = dict(zip(t["column_name"].to_pylist(), t["semantic_type"].to_pylist()))
+    assert sem["host"] == "TAG" and sem["ts"] == "TIMESTAMP"
+    loaded.sql("ADMIN flush_table('cpu')")
+    t = loaded.sql_one("SELECT region_rows, sst_num FROM information_schema.region_statistics")
+    assert sum(t["region_rows"].to_pylist()) == 200
+    loaded.sql("USE information_schema")
+    names = loaded.sql_one("SHOW TABLES")["Tables"].to_pylist()
+    assert "tables" in names and "columns" in names
+    loaded.sql("USE public")
