@@ -1,0 +1,18 @@
+-- aggregate coverage incl. time_bucket, HAVING, NULL semantics
+CREATE TABLE m (host STRING, ts TIMESTAMP(3), v DOUBLE, TIME INDEX (ts), PRIMARY KEY (host));
+
+INSERT INTO m VALUES
+  ('a', 0, 1.0), ('a', 60000, 2.0), ('a', 120000, 3.0),
+  ('b', 0, 10.0), ('b', 60000, NULL), ('b', 120000, 30.0);
+
+SELECT host, sum(v), avg(v), min(v), max(v), count(v), count(*) FROM m GROUP BY host ORDER BY host;
+
+SELECT time_bucket('2m', ts) AS b, sum(v) FROM m GROUP BY b ORDER BY b;
+
+SELECT host, sum(v) AS s FROM m GROUP BY host HAVING sum(v) > 10 ORDER BY host;
+
+SELECT count(*) FROM m WHERE v IS NULL;
+
+SELECT last_value(v ORDER BY ts) FROM m;
+
+DROP TABLE m;

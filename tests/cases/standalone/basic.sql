@@ -1,0 +1,28 @@
+-- basic DDL + DML + queries (mirrors reference tests/cases/standalone/common/basic.sql)
+CREATE TABLE system_metrics (
+  host STRING,
+  idc STRING,
+  cpu_util DOUBLE,
+  memory_util DOUBLE,
+  ts TIMESTAMP(3),
+  TIME INDEX (ts),
+  PRIMARY KEY (host, idc)
+);
+
+INSERT INTO system_metrics VALUES
+  ('host1', 'idc_a', 11.8, 10.3, 1667446797450),
+  ('host2', 'idc_a', 80.1, 70.3, 1667446797450),
+  ('host1', 'idc_b', 50.0, 66.7, 1667446797450),
+  ('host1', 'idc_a', 12.8, 11.3, 1667446798450);
+
+SELECT count(*) FROM system_metrics;
+
+SELECT avg(cpu_util) FROM system_metrics;
+
+SELECT idc, avg(memory_util) FROM system_metrics GROUP BY idc ORDER BY idc;
+
+SELECT host, cpu_util FROM system_metrics WHERE cpu_util > 40 ORDER BY host, cpu_util;
+
+SELECT * FROM system_metrics WHERE host = 'host2';
+
+DROP TABLE system_metrics;

@@ -1,0 +1,14 @@
+-- error surfaces keep stable messages
+SELECT * FROM does_not_exist;
+
+CREATE TABLE bad_no_time_index (v DOUBLE);
+
+CREATE TABLE t1 (ts TIMESTAMP TIME INDEX, v DOUBLE);
+
+CREATE TABLE t1 (ts TIMESTAMP TIME INDEX, v DOUBLE);
+
+INSERT INTO t1 (nope) VALUES (1);
+
+DROP TABLE t1;
+
+DROP TABLE t1;

@@ -1,0 +1,8 @@
+"""Golden-file (sqlness-style) case execution as a pytest test."""
+
+from tests.sqlness_runner import run_all
+
+
+def test_sqlness_cases():
+    failures = run_all(update=False)
+    assert not failures, "\n\n".join(failures)
