@@ -27,6 +27,7 @@ from .expr import (
     Star,
     UnaryOp,
     find_agg_calls,
+    map_aggs,
     strip_alias,
 )
 from .logical_plan import (
@@ -36,6 +37,7 @@ from .logical_plan import (
     Limit,
     LogicalPlan,
     Project,
+    RangeSelect,
     Sort,
     TableScan,
 )
@@ -178,24 +180,14 @@ def _eval_func(e: FuncCall, table: pa.Table):
             val_arr = pa.array([val.as_py()] * n) if isinstance(val, pa.Scalar) else val
             out = pc.if_else(cond, val_arr, base)
         return out if out is not None else default
-    simple = {
-        "abs": pc.abs, "round": pc.round, "floor": pc.floor, "ceil": pc.ceil,
-        "sqrt": pc.sqrt, "ln": pc.ln, "log10": pc.log10, "log2": pc.log2,
-        "exp": pc.exp, "sin": pc.sin, "cos": pc.cos, "tan": pc.tan,
-        "lower": pc.utf8_lower, "upper": pc.utf8_upper, "length": pc.utf8_length,
-        "trim": pc.utf8_trim_whitespace,
-    }
-    if f in simple:
-        return simple[f](eval_expr(args[0], table))
-    if f == "pow" or f == "power":
-        return pc.power(eval_expr(args[0], table), eval_expr(args[1], table))
-    if f == "coalesce":
-        vals = [eval_expr(a, table) for a in args]
-        return pc.coalesce(*vals)
-    if f == "now":
+    if f in ("now", "current_timestamp"):
         import time
 
         return pa.scalar(int(time.time() * 1000), pa.timestamp("ms"))
+    from .functions import call_function, has_function
+
+    if has_function(f):
+        return call_function(f, [eval_expr(a, table) for a in args])
     raise PlanError(f"unknown function: {f}")
 
 
@@ -240,6 +232,9 @@ class CpuExecutor:
             t = self.execute(plan.input)
             mask = eval_expr(_rewrite_agg_refs(plan.predicate, t), t)
             return t.filter(mask)
+        if isinstance(plan, RangeSelect):
+            t = self.execute(plan.input)
+            return _range_select(plan, t)
         if isinstance(plan, Sort):
             t = self.execute(plan.input)
             return self._sort(plan, t)
@@ -267,7 +262,9 @@ class CpuExecutor:
             elif isinstance(e, Alias) and e.alias in t.column_names:
                 cols.append(t[e.alias])
             else:
-                v = eval_expr(inner, t)
+                # scalar exprs over agg outputs (round(avg(v),1)): the agg is
+                # already a column of the aggregated table — reference it
+                v = eval_expr(_rewrite_agg_refs(inner, t), t)
                 if isinstance(v, pa.Scalar):
                     v = pa.array([v.as_py()] * max(t.num_rows, 1))
                 cols.append(v)
@@ -363,6 +360,265 @@ def _sorted_by(t: pa.Table, col: str) -> pa.Table:
     return t.take(pc.sort_indices(t, sort_keys=[(col, "ascending")]))
 
 
+# ---- RANGE ... ALIGN execution ---------------------------------------------
+
+
+def _ts_to_ms(arr: pa.Array) -> np.ndarray:
+    """Timestamp/int array -> epoch-ms int64 numpy array."""
+    if pa.types.is_timestamp(arr.type):
+        unit = arr.type.unit
+        raw = np.asarray(pc.fill_null(pc.cast(arr, pa.int64()), 0), dtype=np.int64)
+        if unit == "s":
+            return raw * 1000
+        if unit == "ms":
+            return raw
+        if unit == "us":
+            return raw // 1000
+        return raw // 1_000_000
+    return np.asarray(pc.fill_null(pc.cast(arr, pa.int64()), 0), dtype=np.int64)
+
+
+def _range_select(plan: RangeSelect, t: pa.Table) -> pa.Table:
+    """Execute the RangeSelect node.
+
+    Mirrors the reference's semantics (query/src/range_select/plan.rs:939):
+    a row at `ts` feeds every aligned slot `align_ts <= ts < align_ts+range`;
+    output rows are the union of touched (series, align_ts) keys; FILL
+    materializes each series' missing slots between its first and last key.
+    """
+    n = t.num_rows
+    ts_arr = t[plan.ts_col]
+    ts_arr = ts_arr.combine_chunks() if isinstance(ts_arr, pa.ChunkedArray) else ts_arr
+    ts_ms = _ts_to_ms(ts_arr)
+    align, origin = plan.align_ms, plan.origin_ms
+
+    # --- series codes from BY expressions
+    by_names, by_arrays = [], []
+    for e in plan.by_exprs:
+        inner = strip_alias(e)
+        arr = eval_expr(inner, t)
+        if isinstance(arr, pa.Scalar):
+            arr = pa.array([arr.as_py()] * n)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        by_names.append(e.name() if not isinstance(inner, Column) else inner.column)
+        by_arrays.append(arr)
+    code = np.zeros(n, dtype=np.int64)
+    for arr in by_arrays:
+        d = pc.dictionary_encode(arr)
+        card = len(d.dictionary) + 1
+        idx = np.asarray(pc.fill_null(pc.cast(d.indices, pa.int64()), card - 1), dtype=np.int64)
+        code = code * card + idx
+    if by_arrays:
+        _, code = np.unique(code, return_inverse=True)
+
+    if n == 0:
+        cols = {plan.ts_col: pa.array([], ts_arr.type if pa.types.is_timestamp(ts_arr.type) else pa.timestamp("ms"))}
+        for name, arr in zip(by_names, by_arrays):
+            cols[name] = pa.array([], arr.type)
+        for agg in plan.aggs:
+            cols[agg.name()] = pa.array([], pa.float64())
+        return pa.table(cols)
+
+    # --- contributions per distinct range duration
+    ranges = sorted({a.range_ms for a in plan.aggs})
+    contrib_ts, contrib_row = {}, {}
+    for r in ranges:
+        n_slots = max(-(-r // align), 1)
+        base = (ts_ms - origin) // align * align + origin
+        parts_ts, parts_row = [], []
+        for j in range(n_slots):
+            tj = base - j * align
+            valid = tj + r > ts_ms
+            parts_ts.append(tj[valid])
+            parts_row.append(np.nonzero(valid)[0])
+        contrib_ts[r] = np.concatenate(parts_ts) if parts_ts else np.zeros(0, np.int64)
+        contrib_row[r] = np.concatenate(parts_row) if parts_row else np.zeros(0, np.int64)
+
+    all_ts = np.concatenate([contrib_ts[r] for r in ranges])
+    all_row = np.concatenate([contrib_row[r] for r in ranges])
+    if len(all_ts) == 0:
+        # no row falls inside any sampled window (range < align)
+        cols = {plan.ts_col: pa.array([], ts_arr.type if pa.types.is_timestamp(ts_arr.type) else pa.timestamp("ms"))}
+        for name, arr in zip(by_names, by_arrays):
+            cols[name] = pa.array([], arr.type)
+        for agg in plan.aggs:
+            cols[agg.name()] = pa.array([], pa.float64())
+        return pa.table(cols)
+    all_code = code[all_row]
+    ts_lo = int(all_ts.min())
+    span = int((all_ts.max() - ts_lo) // align) + 1
+    combined = all_code * span + (all_ts - ts_lo) // align
+    keys, inv = np.unique(combined, return_inverse=True)
+    n_groups = len(keys)
+    g_code = keys // span
+    g_ts = (keys % span) * align + ts_lo
+
+    # exemplar input row per group (for decoding BY values)
+    exemplar = np.full(n_groups, n - 1, dtype=np.int64)
+    np.minimum.at(exemplar, inv, all_row)
+
+    slices, off = {}, 0
+    for r in ranges:
+        ln = len(contrib_ts[r])
+        slices[r] = (off, off + ln)
+        off += ln
+
+    arg_cache: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+
+    def _arg_values(agg: AggCall):
+        key = agg.arg.name()
+        if key not in arg_cache:
+            arr = eval_expr(agg.arg, t)
+            if isinstance(arr, pa.Scalar):
+                arr = pa.array([arr.as_py()] * n)
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+            if pa.types.is_dictionary(arr.type):
+                arr = pc.cast(arr, arr.type.value_type)
+            nulls = np.asarray(pc.is_null(arr))
+            vals = np.asarray(pc.fill_null(pc.cast(arr, pa.float64()), 0.0), dtype=np.float64)
+            arg_cache[key] = (vals, ~nulls)
+        return arg_cache[key]
+
+    agg_cols: dict[str, pa.Array] = {}
+    for agg in plan.aggs:
+        s, e = slices[agg.range_ms]
+        gid, rows = inv[s:e], all_row[s:e]
+        fn = agg.func
+        if fn == "count" and agg.arg is None:
+            cnt = np.bincount(gid, minlength=n_groups)
+            agg_cols[agg.name()] = pa.array(cnt.astype(np.int64))
+            continue
+        vals, valid = _arg_values(agg)
+        v_r, ok = vals[rows], valid[rows]
+        gid_v, v_v = gid[ok], v_r[ok]
+        cnt = np.bincount(gid_v, minlength=n_groups).astype(np.float64)
+        present = cnt > 0
+        if fn == "count":
+            agg_cols[agg.name()] = pa.array(cnt.astype(np.int64))
+            continue
+        if fn in ("sum", "avg", "mean", "stddev", "stddev_pop", "var", "var_pop"):
+            ssum = np.bincount(gid_v, weights=v_v, minlength=n_groups)
+            if fn == "sum":
+                out = ssum
+            elif fn in ("avg", "mean"):
+                out = np.divide(ssum, cnt, out=np.zeros_like(ssum), where=present)
+            else:
+                sq = np.bincount(gid_v, weights=v_v * v_v, minlength=n_groups)
+                mean = np.divide(ssum, cnt, out=np.zeros_like(ssum), where=present)
+                pop_var = np.maximum(
+                    np.divide(sq, cnt, out=np.zeros_like(sq), where=present) - mean * mean, 0.0
+                )
+                if fn in ("var_pop", "stddev_pop"):
+                    out = pop_var
+                else:  # sample variance, n-1 denominator (SQL default)
+                    denom = np.maximum(cnt - 1, 1)
+                    out = pop_var * cnt / denom
+                if fn.startswith("stddev"):
+                    out = np.sqrt(out)
+        elif fn == "min":
+            out = np.full(n_groups, np.inf)
+            np.minimum.at(out, gid_v, v_v)
+        elif fn == "max":
+            out = np.full(n_groups, -np.inf)
+            np.maximum.at(out, gid_v, v_v)
+        elif fn in ("first_value", "last_value"):
+            order = np.argsort(ts_ms[rows][ok], kind="stable")
+            if fn == "first_value":
+                order = order[::-1]
+            out = np.zeros(n_groups)
+            out[gid_v[order]] = v_v[order]  # later assignment wins
+        else:
+            raise PlanError(f"unsupported RANGE aggregate: {fn}")
+        agg_cols[agg.name()] = pc.if_else(
+            pa.array(present), pa.array(out, pa.float64()), pa.scalar(None, pa.float64())
+        )
+
+    # --- FILL: expand each series to its full align grid
+    need_fill = any(a.fill is not None for a in plan.aggs)
+    if need_fill and n_groups:
+        order = np.lexsort((g_ts, g_code))
+        g_code, g_ts, exemplar = g_code[order], g_ts[order], exemplar[order]
+        for k in agg_cols:
+            agg_cols[k] = agg_cols[k].take(pa.array(order))
+        out_code, out_ts, src_idx = [], [], []
+        series, starts = np.unique(g_code, return_index=True)
+        bounds = list(starts) + [len(g_code)]
+        for si, sc in enumerate(series):
+            lo, hi = bounds[si], bounds[si + 1]
+            t0, t1 = g_ts[lo], g_ts[hi - 1]
+            grid = np.arange(t0, t1 + 1, align)
+            out_code.append(np.full(len(grid), sc))
+            out_ts.append(grid)
+            pos = np.full(len(grid), -1, dtype=np.int64)
+            pos[(g_ts[lo:hi] - t0) // align] = np.arange(lo, hi)
+            src_idx.append(pos)
+        out_code = np.concatenate(out_code)
+        out_ts = np.concatenate(out_ts)
+        src_idx = np.concatenate(src_idx)
+        have = src_idx >= 0
+        # exemplar per output row = any exemplar of that series
+        series_ex = {int(c): int(exemplar[starts[i]]) for i, c in enumerate(series)}
+        out_ex = np.array([series_ex[int(c)] for c in out_code], dtype=np.int64)
+        new_cols = {}
+        for agg in plan.aggs:
+            name = agg.name()
+            col = np.asarray(pc.fill_null(agg_cols[name].cast(pa.float64()), np.nan), dtype=np.float64)
+            full = np.full(len(out_ts), np.nan)
+            full[have] = col[np.maximum(src_idx, 0)][have]
+            filled = _apply_fill(full, out_code, agg.fill)
+            new_cols[name] = pa.array(filled, pa.float64())
+            mask = np.isnan(filled)
+            if mask.any():
+                new_cols[name] = pc.if_else(pa.array(~mask), new_cols[name], pa.scalar(None, pa.float64()))
+        agg_cols = new_cols
+        g_ts, exemplar = out_ts, out_ex
+
+    # --- assemble output
+    cols: dict[str, object] = {}
+    ts_out = pa.array(g_ts, pa.timestamp("ms"))
+    if pa.types.is_timestamp(ts_arr.type) and ts_arr.type != ts_out.type:
+        ts_out = ts_out.cast(ts_arr.type, safe=False)
+    elif not pa.types.is_timestamp(ts_arr.type):
+        ts_out = pa.array(g_ts // max(plan.ts_unit_ms, 1), pa.int64())
+    cols[plan.ts_col] = ts_out
+    take_idx = pa.array(exemplar)
+    for name, arr in zip(by_names, by_arrays):
+        cols[name] = arr.take(take_idx)
+    for agg in plan.aggs:
+        cols[agg.name()] = agg_cols[agg.name()]
+    return pa.table(cols)
+
+
+def _apply_fill(vals: np.ndarray, series_code: np.ndarray, fill) -> np.ndarray:
+    """Apply a FILL policy along each series (vals NaN = missing)."""
+    if fill is None or fill == "null":
+        return vals
+    out = vals.copy()
+    for sc in np.unique(series_code):
+        m = series_code == sc
+        v = out[m]
+        nan = np.isnan(v)
+        if not nan.any():
+            continue
+        if fill == "prev":
+            idx = np.where(~nan, np.arange(len(v)), -1)
+            np.maximum.accumulate(idx, out=idx)
+            v = np.where(idx >= 0, v[np.maximum(idx, 0)], np.nan)
+        elif fill == "linear":
+            known = np.nonzero(~nan)[0]
+            if len(known) >= 2:
+                interp = np.interp(np.arange(len(v)), known, v[known])
+                # only interior gaps get interpolated; edges stay missing
+                interior = (np.arange(len(v)) >= known[0]) & (np.arange(len(v)) <= known[-1])
+                v = np.where(nan & interior, interp, v)
+        else:  # constant
+            v = np.where(nan, float(fill), v)
+        out[m] = v
+    return out
+
+
 def _global_agg(col, pa_fn: str):
     col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
     fn = {
@@ -378,10 +634,4 @@ def _global_agg(col, pa_fn: str):
 def _rewrite_agg_refs(e: Expr, t: pa.Table) -> Expr:
     """HAVING predicates reference agg outputs like avg(x) — rewrite those
     AggCall nodes to Columns over the aggregated table."""
-    if isinstance(e, AggCall) and e.name() in t.column_names:
-        return Column(e.name())
-    if isinstance(e, BinaryOp):
-        return BinaryOp(e.op, _rewrite_agg_refs(e.left, t), _rewrite_agg_refs(e.right, t))
-    if isinstance(e, UnaryOp):
-        return UnaryOp(e.op, _rewrite_agg_refs(e.operand, t))
-    return e
+    return map_aggs(e, lambda a: Column(a.name()) if a.name() in t.column_names else a)

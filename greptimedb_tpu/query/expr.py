@@ -123,15 +123,26 @@ class FuncCall(Expr):
 @dataclass(frozen=True)
 class AggCall(Expr):
     """Aggregate function: sum/avg/min/max/count/last_value/first_value/
-    stddev/p50-p99 (approx)."""
+    stddev/p50-p99 (approx).
+
+    `range_ms`/`fill` mark a RANGE-query aggregate (reference
+    query/src/range_select/plan.rs: each range expr carries its own
+    range duration and fill policy)."""
 
     func: str
     arg: Expr | None = None  # None = count(*)
     order_by: str | None = None  # for last_value(x ORDER BY ts)
+    range_ms: int | None = None  # agg(x) RANGE '10s'
+    fill: object = None  # None | "null" | "prev" | "linear" | constant
 
     def name(self) -> str:
         inner = self.arg.name() if self.arg is not None else "*"
-        return f"{self.func}({inner})"
+        base = f"{self.func}({inner})"
+        if self.range_ms is not None:
+            base += f" RANGE {self.range_ms}ms"
+            if self.fill is not None:
+                base += f" FILL {self.fill}"
+        return base
 
     def children(self) -> list[Expr]:
         return [self.arg] if self.arg is not None else []
@@ -161,6 +172,23 @@ def strip_alias(e: Expr) -> Expr:
 
 def find_agg_calls(e: Expr) -> list[AggCall]:
     return [x for x in e.walk() if isinstance(x, AggCall)]
+
+
+def map_aggs(e: Expr, fn) -> Expr:
+    """Rebuild an expression with every AggCall replaced by fn(agg)."""
+    import dataclasses
+
+    if isinstance(e, AggCall):
+        return fn(e)
+    if isinstance(e, Alias):
+        return Alias(map_aggs(e.expr, fn), e.alias)
+    if isinstance(e, BinaryOp):
+        return BinaryOp(e.op, map_aggs(e.left, fn), map_aggs(e.right, fn))
+    if isinstance(e, UnaryOp):
+        return UnaryOp(e.op, map_aggs(e.operand, fn))
+    if isinstance(e, FuncCall):
+        return FuncCall(e.func, tuple(map_aggs(a, fn) for a in e.args))
+    return e
 
 
 def split_conjuncts(e: Expr | None) -> list[Expr]:

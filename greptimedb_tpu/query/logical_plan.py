@@ -83,6 +83,35 @@ class Aggregate(LogicalPlan):
 
 
 @dataclass(repr=False)
+class RangeSelect(LogicalPlan):
+    """Time-bucketed sliding-window aggregation — GreptimeDB's
+    `SELECT agg(x) RANGE 'r' ... ALIGN 'a'` (reference
+    query/src/range_select/plan.rs:273 `RangeSelect` logical node).
+
+    Semantics (plan.rs:939): a row at time `ts` contributes to every
+    aligned slot `t = k*align + to` with `t <= ts < t + range`.
+    """
+
+    input: LogicalPlan
+    ts_col: str  # time index column name
+    ts_unit_ms: int  # native unit of ts col in ms-per-tick
+    align_ms: int
+    origin_ms: int  # resolved TO origin
+    by_exprs: list[Expr]  # series identity (default: primary key)
+    aggs: list[Expr]  # AggCall with range_ms set (each may differ)
+
+    def children(self):
+        return [self.input]
+
+    def __repr__(self):
+        return (
+            f"RangeSelect(align={self.align_ms}ms, to={self.origin_ms}, "
+            f"by={[e.name() for e in self.by_exprs]}, "
+            f"aggs={[a.name() for a in self.aggs]})"
+        )
+
+
+@dataclass(repr=False)
 class Sort(LogicalPlan):
     input: LogicalPlan
     keys: list[tuple[Expr, bool]]  # (expr, ascending)

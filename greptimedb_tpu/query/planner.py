@@ -27,6 +27,7 @@ from .expr import (
     Star,
     UnaryOp,
     find_agg_calls,
+    map_aggs,
     split_conjuncts,
     strip_alias,
 )
@@ -99,6 +100,9 @@ def plan_select(stmt: SelectStmt, schema: Schema, database: str = "public") -> L
     for conj in residual:
         plan = Filter(plan, conj)
 
+    if stmt.align is not None:
+        return _plan_range_select(stmt, schema, plan, ts_col, ts_unit_ms)
+
     # Aggregation?
     proj_aggs = [a for p in stmt.projections if not isinstance(p, Star) for a in find_agg_calls(p)]
     if stmt.group_by or proj_aggs:
@@ -117,6 +121,83 @@ def plan_select(stmt: SelectStmt, schema: Schema, database: str = "public") -> L
         # refs become output-column references, not re-evaluated expressions.
         keys = [(_resolve_order_key(e, stmt.projections), asc) for e, asc in stmt.order_by]
         plan = Sort(plan, keys)
+    if stmt.limit is not None:
+        plan = Limit(plan, stmt.limit, stmt.offset)
+    return plan
+
+
+def _plan_range_select(
+    stmt: SelectStmt, schema: Schema, scan: LogicalPlan, ts_col: str | None, ts_unit_ms: int
+) -> LogicalPlan:
+    """RANGE query: scan -> RangeSelect -> Project -> Sort/Limit
+    (reference query/src/range_select/plan_rewrite.rs)."""
+    import dataclasses
+    import time as _time
+
+    from .logical_plan import RangeSelect
+
+    if ts_col is None:
+        raise PlanError("RANGE query requires a table with a time index")
+    align = stmt.align
+
+    # Resolve TO origin to epoch ms.  TO NOW anchors window boundaries at the
+    # query time itself (NOT floored — flooring would collapse it back to the
+    # TO 0 lattice whenever now % align == 0).
+    if align.to == "now":
+        origin = int(_time.time() * 1000)
+    elif align.to == "calendar" or align.to == 0:
+        origin = 0
+    else:
+        origin = int(align.to)
+
+    # BY defaults to the table's primary key (reference plan_rewrite.rs
+    # default_by: the time-series identity columns).
+    by_exprs = align.by if align.by is not None else [Column(c.name) for c in schema.tag_columns()]
+
+    # Collect range aggregates from projections; apply the clause-level FILL
+    # to any agg without its own, and require RANGE on every aggregate.
+    aggs: list[Expr] = []
+    seen: set[str] = set()
+
+    def _check(agg: AggCall) -> AggCall:
+        if agg.range_ms is None:
+            raise PlanError(f"aggregate {agg.name()} in a RANGE query needs a RANGE duration")
+        if agg.fill is None and align.fill is not None:
+            agg = dataclasses.replace(agg, fill=align.fill)
+        return agg
+
+    new_projections: list[Expr] = []
+    for p in stmt.projections:
+        p2 = map_aggs(p, _check)
+        new_projections.append(p2)
+        for agg in find_agg_calls(p2):
+            if agg.name() not in seen:
+                seen.add(agg.name())
+                aggs.append(agg)
+    if not aggs:
+        raise PlanError("RANGE query requires at least one aggregate with RANGE")
+
+    plan: LogicalPlan = RangeSelect(
+        input=scan,
+        ts_col=ts_col,
+        ts_unit_ms=ts_unit_ms,
+        align_ms=align.align_ms,
+        origin_ms=origin,
+        by_exprs=by_exprs,
+        aggs=aggs,
+    )
+    plan = Project(plan, new_projections)
+    if stmt.order_by:
+        keys = [(_resolve_order_key(e, new_projections), asc) for e, asc in stmt.order_by]
+        plan = Sort(plan, keys)
+    else:
+        # Deterministic default ordering: by series, then aligned ts
+        # (the reference sorts range output the same way for sqlness goldens).
+        keys = [(Column(e.name()), True) for e in by_exprs] + [(Column(ts_col), True)]
+        present = {p.name() for p in new_projections}
+        keys = [(e, a) for e, a in keys if e.column in present]
+        if keys:
+            plan = Sort(plan, keys)
     if stmt.limit is not None:
         plan = Limit(plan, stmt.limit, stmt.offset)
     return plan

@@ -77,6 +77,18 @@ def tokenize(sql: str) -> list[Token]:
 
 
 @dataclass
+class AlignClause:
+    """`ALIGN '5s' [TO ...] [BY (...)] [FILL ...]` — the range-query clause
+    (reference sql/src/parsers/create_parser.rs range syntax +
+    query/src/range_select/plan_rewrite.rs)."""
+
+    align_ms: int
+    to: object = 0  # origin: 0 (epoch) | "now" | "calendar" | ms timestamp
+    by: list[Expr] | None = None  # None = default (table primary key)
+    fill: object = None  # default fill for range aggs without their own
+
+
+@dataclass
 class SelectStmt:
     projections: list[Expr]
     table: str | None = None
@@ -87,6 +99,7 @@ class SelectStmt:
     order_by: list[tuple[Expr, bool]] = field(default_factory=list)
     limit: int | None = None
     offset: int = 0
+    align: AlignClause | None = None
 
 
 @dataclass
@@ -291,6 +304,8 @@ class Parser:
                 stmt.table = name
         if self.eat_kw("where"):
             stmt.where = self.parse_expr()
+        if self.at_kw("align"):
+            stmt.align = self.parse_align()
         if self.eat_kw("group"):
             self.expect_kw("by")
             stmt.group_by.append(self.parse_expr())
@@ -439,6 +454,15 @@ class Parser:
             self.expect_op(")")
             return self._maybe_cast(e)
         if t.kind in ("ident", "qident"):
+            if self.at_kw("null"):
+                self.next()
+                return self._maybe_cast(Literal(None))
+            if self.at_kw("true"):
+                self.next()
+                return self._maybe_cast(Literal(True))
+            if self.at_kw("false"):
+                self.next()
+                return self._maybe_cast(Literal(False))
             if self.at_kw("interval"):
                 self.next()
                 s = self.next()
@@ -457,7 +481,95 @@ class Parser:
         while self.eat_op("::"):
             type_name = self.ident()
             e = FuncCall("cast", (e, Literal(type_name.lower())))
-        return e
+        return self._maybe_range(e)
+
+    def _maybe_range(self, e: Expr) -> Expr:
+        """Postfix `RANGE '10s' [FILL v]` attaches range/fill to every
+        aggregate inside e (reference range expr rewrite,
+        query/src/range_select/plan_rewrite.rs)."""
+        if not self.at_kw("range"):
+            return e
+        self.next()
+        range_ms = self._interval_token()
+        fill = None
+        if self.eat_kw("fill"):
+            fill = self._parse_fill_value()
+        import dataclasses
+
+        from .expr import map_aggs
+
+        hit = 0
+
+        def _attach(a):
+            nonlocal hit
+            hit += 1
+            return dataclasses.replace(a, range_ms=range_ms, fill=fill)
+
+        out = map_aggs(e, _attach)
+        if hit == 0:
+            raise InvalidSyntaxError(
+                f"RANGE must follow an aggregate expression, got {e.name()!r}"
+            )
+        return out
+
+    def _interval_token(self) -> int:
+        t = self.next()
+        if t.kind == "string":
+            return _parse_interval(t.value[1:-1])
+        if t.kind == "number":
+            return int(float(t.value) * 1000)  # bare numbers are seconds
+        raise InvalidSyntaxError(f"expected duration, got {t.value!r}")
+
+    def _parse_fill_value(self):
+        t = self.peek()
+        if self.eat_kw("null"):
+            return "null"
+        if self.eat_kw("prev"):
+            return "prev"
+        if self.eat_kw("linear"):
+            return "linear"
+        v = self.parse_literal_value()
+        if isinstance(v, str):
+            try:
+                return float(v)
+            except ValueError:
+                return v
+        return v
+
+    def parse_align(self) -> AlignClause:
+        self.expect_kw("align")
+        clause = AlignClause(self._interval_token())
+        if self.eat_kw("to"):
+            t = self.peek()
+            if self.at_kw("now"):
+                self.next()
+                clause.to = "now"
+            elif self.at_kw("calendar"):
+                self.next()
+                clause.to = "calendar"
+            elif t.kind == "string":
+                self.next()
+                import datetime as _dt
+
+                dt = _dt.datetime.fromisoformat(t.value[1:-1].replace(" ", "T"))
+                if dt.tzinfo is None:
+                    dt = dt.replace(tzinfo=_dt.timezone.utc)
+                clause.to = int(dt.timestamp() * 1000)
+            elif t.kind == "number":
+                self.next()
+                clause.to = int(t.value)
+        if self.eat_kw("by"):
+            self.expect_op("(")
+            exprs: list[Expr] = []
+            while not self.at_op(")"):
+                exprs.append(self.parse_expr())
+                if not self.eat_op(","):
+                    break
+            self.expect_op(")")
+            clause.by = exprs
+        if self.eat_kw("fill"):
+            clause.fill = self._parse_fill_value()
+        return clause
 
     def parse_case(self) -> Expr:
         self.expect_kw("case")
