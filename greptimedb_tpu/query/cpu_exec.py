@@ -412,13 +412,20 @@ def _range_select(plan: RangeSelect, t: pa.Table) -> pa.Table:
     if by_arrays:
         _, code = np.unique(code, return_inverse=True)
 
-    if n == 0:
-        cols = {plan.ts_col: pa.array([], ts_arr.type if pa.types.is_timestamp(ts_arr.type) else pa.timestamp("ms"))}
+    def _empty_result() -> pa.Table:
+        cols = {
+            plan.ts_col: pa.array(
+                [], ts_arr.type if pa.types.is_timestamp(ts_arr.type) else pa.timestamp("ms")
+            )
+        }
         for name, arr in zip(by_names, by_arrays):
             cols[name] = pa.array([], arr.type)
         for agg in plan.aggs:
             cols[agg.name()] = pa.array([], pa.float64())
         return pa.table(cols)
+
+    if n == 0:
+        return _empty_result()
 
     # --- contributions per distinct range duration
     ranges = sorted({a.range_ms for a in plan.aggs})
@@ -439,12 +446,7 @@ def _range_select(plan: RangeSelect, t: pa.Table) -> pa.Table:
     all_row = np.concatenate([contrib_row[r] for r in ranges])
     if len(all_ts) == 0:
         # no row falls inside any sampled window (range < align)
-        cols = {plan.ts_col: pa.array([], ts_arr.type if pa.types.is_timestamp(ts_arr.type) else pa.timestamp("ms"))}
-        for name, arr in zip(by_names, by_arrays):
-            cols[name] = pa.array([], arr.type)
-        for agg in plan.aggs:
-            cols[agg.name()] = pa.array([], pa.float64())
-        return pa.table(cols)
+        return _empty_result()
     all_code = code[all_row]
     ts_lo = int(all_ts.min())
     span = int((all_ts.max() - ts_lo) // align) + 1

@@ -70,12 +70,6 @@ def _np(v):
     return np.asarray(v)
 
 
-def _wrap_np(out, like):
-    if np.isscalar(out):
-        return pa.scalar(out)
-    return pa.array(out)
-
-
 # ---- math ------------------------------------------------------------------
 
 _SIMPLE_MATH = {
@@ -166,7 +160,9 @@ def _least(*args):
 @register("rate")
 def _rate_scalar(x):
     # greptime scalar `rate(col)`: per-row delta / time — approximated as diff
-    v = _np(x).astype(np.float64)
+    v = np.atleast_1d(np.asarray(_np(x), dtype=np.float64))
+    if len(v) == 0:
+        return pa.array([], pa.float64())
     out = np.empty_like(v)
     out[0] = np.nan
     out[1:] = np.diff(v)

@@ -138,6 +138,8 @@ def _plan_range_select(
 
     if ts_col is None:
         raise PlanError("RANGE query requires a table with a time index")
+    if stmt.group_by or stmt.having is not None:
+        raise PlanError("RANGE queries use BY (...) instead of GROUP BY/HAVING")
     align = stmt.align
 
     # Resolve TO origin to epoch ms.  TO NOW anchors window boundaries at the

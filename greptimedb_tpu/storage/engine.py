@@ -52,6 +52,9 @@ class TimeSeriesEngine:
                 time_partition_ms=self.config.memtable_time_partition_secs * 1000,
                 checkpoint_distance=self.config.manifest_checkpoint_distance,
                 writable=writable,
+                index_enable=self.config.index_enable,
+                index_segment_rows=self.config.index_segment_rows,
+                index_inverted_max_terms=self.config.index_inverted_max_terms,
             )
             self._regions[region_id] = region
             return region
@@ -71,6 +74,9 @@ class TimeSeriesEngine:
                 self.wal_mgr.region_wal(region_id),
                 time_partition_ms=self.config.memtable_time_partition_secs * 1000,
                 checkpoint_distance=self.config.manifest_checkpoint_distance,
+                index_enable=self.config.index_enable,
+                index_segment_rows=self.config.index_segment_rows,
+                index_inverted_max_terms=self.config.index_inverted_max_terms,
             )
             self._regions[region_id] = region
             return region

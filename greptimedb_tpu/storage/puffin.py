@@ -1,0 +1,113 @@
+"""Puffin blob container for per-SST index data.
+
+Role-equivalent of the reference's `puffin` crate (reference
+puffin/src/puffin_manager.rs, file_format/): the Apache-Iceberg-Puffin
+file layout — magic, concatenated blobs, JSON footer describing blob
+offsets/types/properties, footer length, flags, trailing magic — used as
+the single sidecar file holding all of an SST's secondary indexes.
+
+Layout (matches the Puffin spec structure):
+
+    "PFA1" | blob_0 | blob_1 | ... | footer_json | footer_len(u32 LE) |
+    flags(u32 LE) | "PFA1"
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from dataclasses import dataclass, field
+
+MAGIC = b"PFA1"
+
+
+@dataclass
+class BlobMeta:
+    blob_type: str  # e.g. "greptime-bloom-filter-v1", "greptime-inverted-index-v1"
+    offset: int
+    length: int
+    properties: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.blob_type,
+            "offset": self.offset,
+            "length": self.length,
+            "properties": self.properties,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BlobMeta":
+        return cls(d["type"], d["offset"], d["length"], d.get("properties", {}))
+
+
+class PuffinWriter:
+    def __init__(self, path: str):
+        self.path = path
+        self._blobs: list[tuple[BlobMeta, bytes]] = []
+
+    def add_blob(self, blob_type: str, data: bytes, properties: dict | None = None):
+        self._blobs.append((BlobMeta(blob_type, 0, len(data), properties or {}), data))
+
+    def finish(self) -> int:
+        """Write the container; returns file size. No file if no blobs."""
+        if not self._blobs:
+            return 0
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(MAGIC)
+            off = len(MAGIC)
+            metas = []
+            for meta, data in self._blobs:
+                meta.offset = off
+                f.write(data)
+                off += len(data)
+                metas.append(meta.to_dict())
+            footer = json.dumps({"blobs": metas}).encode()
+            f.write(footer)
+            f.write(struct.pack("<I", len(footer)))
+            f.write(struct.pack("<I", 0))  # flags
+            f.write(MAGIC)
+        os.replace(tmp, self.path)
+        return os.path.getsize(self.path)
+
+
+class PuffinReader:
+    def __init__(self, path: str):
+        self.path = path
+        self._metas: list[BlobMeta] | None = None
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def blobs(self) -> list[BlobMeta]:
+        if self._metas is None:
+            with open(self.path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(size - 12)
+                tail = f.read(12)
+                footer_len = struct.unpack("<I", tail[:4])[0]
+                if tail[8:] != MAGIC:
+                    raise ValueError(f"bad puffin trailer in {self.path}")
+                f.seek(size - 12 - footer_len)
+                footer = json.loads(f.read(footer_len))
+                f.seek(0)
+                if f.read(4) != MAGIC:
+                    raise ValueError(f"bad puffin magic in {self.path}")
+            self._metas = [BlobMeta.from_dict(d) for d in footer["blobs"]]
+        return self._metas
+
+    def read_blob(self, meta: BlobMeta) -> bytes:
+        with open(self.path, "rb") as f:
+            f.seek(meta.offset)
+            return f.read(meta.length)
+
+    def find(self, blob_type: str, **props) -> BlobMeta | None:
+        for m in self.blobs():
+            if m.blob_type == blob_type and all(
+                m.properties.get(k) == v for k, v in props.items()
+            ):
+                return m
+        return None

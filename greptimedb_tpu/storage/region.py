@@ -54,6 +54,9 @@ class Region:
         time_partition_ms: int = 86_400_000,
         checkpoint_distance: int = 10,
         writable: bool = True,
+        index_enable: bool = True,
+        index_segment_rows: int = 1024,
+        index_inverted_max_terms: int = 4096,
     ):
         self.region_id = region_id
         self.region_dir = region_dir
@@ -68,7 +71,13 @@ class Region:
             self.manifest_mgr.apply({"kind": "change", "schema": schema.to_json()})
         self.schema = self.manifest_mgr.manifest.schema
         sst_dir = os.path.join(region_dir, "sst")
-        self.sst_writer = SstWriter(sst_dir, self.schema)
+        self.sst_writer = SstWriter(
+            sst_dir,
+            self.schema,
+            index_enable=index_enable,
+            index_segment_rows=index_segment_rows,
+            index_inverted_max_terms=index_inverted_max_terms,
+        )
         self.sst_reader = SstReader(sst_dir, self.schema)
 
         self.memtable = Memtable(self.schema, time_partition_ms)
@@ -174,6 +183,9 @@ class Region:
             path = self.sst_reader.path_for_id(fid)
             if os.path.exists(path):
                 os.remove(path)
+            sidecar = os.path.join(os.path.dirname(path), f"{fid}.puffin")
+            if os.path.exists(sidecar):
+                os.remove(sidecar)
         self._garbage_files.clear()
 
     # ---- read -------------------------------------------------------------

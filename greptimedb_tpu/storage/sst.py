@@ -14,13 +14,22 @@ import os
 import uuid
 from dataclasses import dataclass, field
 
+import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 import pyarrow.parquet as pq
 
 from ..datatypes.schema import Schema
+from ..utils import metrics
+from . import index as idx
+from .index import BLOOM_BLOB, INVERTED_BLOB
+from .puffin import PuffinReader, PuffinWriter
 
 DEFAULT_ROW_GROUP_SIZE = 1 << 20  # rows per row group; big groups = big tiles
+
+INDEX_PRUNED_GROUPS = metrics.Counter(
+    "sst_index_pruned_row_groups", "row groups skipped via secondary indexes"
+)
 
 
 @dataclass
@@ -32,6 +41,8 @@ class FileMeta:
     num_rows: int
     file_size: int
     level: int = 0
+    indexed_columns: list[str] = field(default_factory=list)
+    index_file_size: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -40,6 +51,8 @@ class FileMeta:
             "num_rows": self.num_rows,
             "file_size": self.file_size,
             "level": self.level,
+            "indexed_columns": self.indexed_columns,
+            "index_file_size": self.index_file_size,
         }
 
     @classmethod
@@ -50,6 +63,8 @@ class FileMeta:
             num_rows=d["num_rows"],
             file_size=d["file_size"],
             level=d.get("level", 0),
+            indexed_columns=d.get("indexed_columns", []),
+            index_file_size=d.get("index_file_size", 0),
         )
 
 
@@ -64,11 +79,43 @@ class ScanPredicate:
 
 
 class SstWriter:
-    def __init__(self, sst_dir: str, schema: Schema, row_group_size: int = DEFAULT_ROW_GROUP_SIZE):
+    def __init__(
+        self,
+        sst_dir: str,
+        schema: Schema,
+        row_group_size: int = DEFAULT_ROW_GROUP_SIZE,
+        index_enable: bool = True,
+        index_segment_rows: int = idx.DEFAULT_SEGMENT_ROWS,
+        index_inverted_max_terms: int = 4096,
+    ):
         self.sst_dir = sst_dir
         self.schema = schema
         self.row_group_size = row_group_size
+        self.index_enable = index_enable
+        self.index_segment_rows = index_segment_rows
+        self.index_inverted_max_terms = index_inverted_max_terms
         os.makedirs(sst_dir, exist_ok=True)
+
+    def _build_indexes(self, table: pa.Table, file_id: str) -> tuple[list[str], int]:
+        """Build bloom + inverted indexes over tag columns into the puffin
+        sidecar (reference mito2/src/sst/index/indexer/ builds during flush)."""
+        cols = [c.name for c in self.schema.tag_columns() if c.name in table.column_names]
+        if not cols:
+            return [], 0
+        writer = PuffinWriter(os.path.join(self.sst_dir, f"{file_id}.puffin"))
+        indexed = []
+        for name in cols:
+            col = table[name]
+            col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+            bloom = idx.build_bloom_index(col, self.index_segment_rows)
+            writer.add_blob(BLOOM_BLOB, bloom, {"column": name})
+            inverted = idx.build_inverted_index(
+                col, self.index_segment_rows, self.index_inverted_max_terms
+            )
+            if inverted is not None:
+                writer.add_blob(INVERTED_BLOB, inverted, {"column": name})
+            indexed.append(name)
+        return indexed, writer.finish()
 
     def write(self, table: pa.Table, level: int = 0) -> FileMeta | None:
         """Write one sorted table as one SST file; returns its FileMeta."""
@@ -98,16 +145,24 @@ class SstWriter:
             compression="zstd",
             use_dictionary=True,
         )
+        indexed, index_size = ([], 0)
+        if self.index_enable:
+            indexed, index_size = self._build_indexes(table, file_id)
         return FileMeta(
             file_id=file_id,
             time_range=(t_min, t_max),
             num_rows=table.num_rows,
             file_size=os.path.getsize(path),
             level=level,
+            indexed_columns=indexed,
+            index_file_size=index_size,
         )
 
     def _path(self, file_id: str) -> str:
         return os.path.join(self.sst_dir, f"{file_id}.parquet")
+
+
+_INDEX_CACHE = idx.IndexCache(capacity=128)
 
 
 class SstReader:
@@ -139,6 +194,11 @@ class SstReader:
         pf = pq.ParquetFile(self.path(meta))
         ts_name = self.schema.time_index.name if self.schema.time_index else None
         groups = self._prune_row_groups(pf, pred, ts_name)
+        if groups and meta.indexed_columns:
+            before = len(groups)
+            groups = self._prune_with_indexes(pf, meta, pred, groups)
+            if len(groups) < before:
+                INDEX_PRUNED_GROUPS.inc(before - len(groups))
         if not groups:
             schema = pf.schema_arrow
             if columns:
@@ -155,6 +215,75 @@ class SstReader:
                 table = table.set_column(i, ts_name, pc.cast(table[ts_name], want))
         table = _apply_residual(table, pred, ts_name)
         return table
+
+    def _prune_with_indexes(
+        self, pf: pq.ParquetFile, meta: FileMeta, pred: ScanPredicate, groups: list[int]
+    ) -> list[int]:
+        """Row-group pruning via the puffin sidecar's bloom/inverted indexes
+        (reference mito2/src/read/scan_region.rs:479-487 index appliers)."""
+        usable = [
+            (name, op, value)
+            for name, op, value in pred.filters
+            if name in meta.indexed_columns and op in ("=", "in", "!=")
+        ]
+        if not usable:
+            return groups
+        sidecar = self._load_sidecar(meta)
+        if sidecar is None:
+            return groups
+        seg_bitmap: np.ndarray | None = None
+        for name, op, value in usable:
+            index_map = sidecar.get(name)
+            if not index_map:
+                continue
+            bm = None
+            if INVERTED_BLOB in index_map:
+                bm = index_map[INVERTED_BLOB].search(op, value)
+            if bm is None and BLOOM_BLOB in index_map:
+                bm = index_map[BLOOM_BLOB].search(op, value)
+            if bm is not None:
+                seg_bitmap = bm if seg_bitmap is None else (seg_bitmap & bm)
+        if seg_bitmap is None:
+            return groups
+        seg_rows = sidecar["__segment_rows__"]
+        md = pf.metadata
+        offsets = [0]
+        for g in range(md.num_row_groups):
+            offsets.append(offsets[-1] + md.row_group(g).num_rows)
+        keep = []
+        for g in groups:
+            s0 = offsets[g] // seg_rows
+            s1 = (offsets[g + 1] - 1) // seg_rows
+            if seg_bitmap[s0 : s1 + 1].any():
+                keep.append(g)
+        return keep
+
+    def _load_sidecar(self, meta: FileMeta) -> dict | None:
+        """column -> {blob_type -> parsed index object}, cached per file so
+        repeated scans skip the zlib/unpackbits decode entirely."""
+        cached = _INDEX_CACHE.get(meta.file_id)
+        if cached is not None:
+            return cached
+        path = os.path.join(self.sst_dir, f"{meta.file_id}.puffin")
+        reader = PuffinReader(path)
+        if not reader.exists():
+            return None
+        out: dict = {}
+        seg_rows = idx.DEFAULT_SEGMENT_ROWS
+        for bm in reader.blobs():
+            col = bm.properties.get("column")
+            blob = reader.read_blob(bm)
+            if bm.blob_type == BLOOM_BLOB:
+                parsed = idx.BloomIndex(blob)
+            elif bm.blob_type == INVERTED_BLOB:
+                parsed = idx.InvertedIndex(blob)
+            else:
+                continue
+            out.setdefault(col, {})[bm.blob_type] = parsed
+            seg_rows = parsed.segment_rows
+        out["__segment_rows__"] = seg_rows
+        _INDEX_CACHE.put(meta.file_id, out)
+        return out
 
     def _prune_row_groups(self, pf: pq.ParquetFile, pred: ScanPredicate, ts_name) -> list[int]:
         md = pf.metadata

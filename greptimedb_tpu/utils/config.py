@@ -53,6 +53,10 @@ class StorageConfig:
     compaction_max_active_window_runs: int = 4
     compaction_max_inactive_window_runs: int = 1
     compaction_time_window_secs: int = 0  # 0 = infer from data
+    # SST secondary indexes (reference mito2 `[region_engine.mito.index]`):
+    index_enable: bool = True
+    index_segment_rows: int = 1024  # bloom/inverted segment granularity
+    index_inverted_max_terms: int = 4096  # cardinality cap for inverted index
 
     def __post_init__(self):
         if not self.wal_dir:
