@@ -35,6 +35,13 @@ from .query.sql_parser import (
     UseStmt,
     parse_sql,
 )
+from .metric.engine import (
+    LOGICAL_TABLE_OPT,
+    PHYSICAL_TABLE_OPT,
+    MetricEngine,
+    is_logical_meta,
+    is_physical_meta,
+)
 from .storage.engine import TimeSeriesEngine
 from .storage.sst import ScanPredicate
 from .utils.config import Config
@@ -56,6 +63,8 @@ class Database:
         self.storage = TimeSeriesEngine(self.config.storage)
         catalog_path = os.path.join(self.config.storage.data_home, "catalog.json")
         self.catalog = Catalog(catalog_path)
+
+        self.metric = MetricEngine(self)
         self.current_database = DEFAULT_SCHEMA
         self.query_engine = QueryEngine(
             schema_provider=self._schema_of,
@@ -122,6 +131,48 @@ class Database:
 
     # ---- DDL --------------------------------------------------------------
     def _create_table(self, stmt: CreateTableStmt):
+
+        # Metric-engine routing (reference metric-engine DDL rewrite,
+        # src/metric-engine/src/engine/create.rs).
+        if PHYSICAL_TABLE_OPT in stmt.options or (
+            stmt.engine == "metric" and LOGICAL_TABLE_OPT not in stmt.options
+        ):
+            ts = stmt.time_index or next(
+                (c.name for c in stmt.columns if c.is_time_index), None
+            )
+            val = next(
+                (c.name for c in stmt.columns if not c.is_time_index and c.name != ts),
+                None,
+            )
+            self.metric.create_physical_table(
+                stmt.name,
+                database=self.current_database,
+                ts_col=ts or "greptime_timestamp",
+                val_col=val or "greptime_value",
+                if_not_exists=stmt.if_not_exists,
+            )
+            return None
+        if LOGICAL_TABLE_OPT in stmt.options:
+            ts = stmt.time_index or next(
+                (c.name for c in stmt.columns if c.is_time_index), None
+            )
+            pks = set(stmt.primary_key) | {
+                c.name for c in stmt.columns if c.is_primary_key
+            }
+            val = next(
+                (c.name for c in stmt.columns if c.name != ts and c.name not in pks),
+                None,
+            )
+            self.metric.create_logical_table(
+                stmt.name,
+                labels=sorted(pks),
+                physical=str(stmt.options[LOGICAL_TABLE_OPT]),
+                database=self.current_database,
+                ts_col=ts,
+                val_col=val,
+                if_not_exists=stmt.if_not_exists,
+            )
+            return None
         columns: list[ColumnSchema] = []
         time_index = stmt.time_index
         pks = set(stmt.primary_key)
@@ -174,6 +225,14 @@ class Database:
             return None
         if stmt.if_exists and not self.catalog.has_table(stmt.name, self.current_database):
             return None
+
+        meta = self.catalog.table(stmt.name, self.current_database)
+        if is_logical_meta(meta):
+            self.metric.drop_logical_table(meta)
+            return None
+        if is_physical_meta(meta):
+            self.metric.drop_physical_table(meta)
+            return None
         meta = self.catalog.drop_table(stmt.name, self.current_database)
         for rid in meta.region_ids:
             self.storage.drop_region(rid)
@@ -204,6 +263,9 @@ class Database:
     def write_batch(self, meta, batch: pa.RecordBatch) -> int:
         """Route rows to regions via the partition rule and write each
         (the reference Inserter fan-out)."""
+
+        if is_logical_meta(meta):
+            return self.metric.write_logical(meta, batch)
         table = pa.Table.from_batches([batch])
         affected = 0
         parts = meta.partition_rule.split(table)
@@ -275,7 +337,12 @@ class Database:
     def _admin(self, stmt: AdminStmt):
         f = stmt.func.lower()
         if f == "flush_table":
+
             meta = self.catalog.table(str(stmt.args[0]), self.current_database)
+            if is_logical_meta(meta):
+                meta = self.catalog.table(
+                    meta.options[LOGICAL_TABLE_OPT], self.current_database
+                )
             for rid in meta.region_ids:
                 self.storage.flush_region(rid)
             return pa.table({"result": [0]})
@@ -286,6 +353,10 @@ class Database:
             from .storage.compaction import compact_region
 
             meta = self.catalog.table(str(stmt.args[0]), self.current_database)
+            if is_logical_meta(meta):
+                meta = self.catalog.table(
+                    meta.options[LOGICAL_TABLE_OPT], self.current_database
+                )
             for rid in meta.region_ids:
                 compact_region(self.storage.region(rid))
             return pa.table({"result": [0]})
@@ -322,6 +393,8 @@ class Database:
         if info.is_information_schema(scan.database):
             return [info.build(self, scan.table)]
         meta = self.catalog.table(scan.table, scan.database)
+        if is_logical_meta(meta):
+            return self.metric.scan_logical(meta, scan)
         pred = self._pred_of(scan)
         return [self.storage.scan(rid, pred) for rid in meta.region_ids]
 
@@ -344,7 +417,12 @@ class Database:
     def _time_bounds(self, table: str, database: str) -> tuple[int, int]:
         """Min/max time over a table, from SST metadata + memtable ranges
         (no data scan — the reference prunes from FileMeta the same way)."""
+
         meta = self.catalog.table(table, database)
+        if is_logical_meta(meta):
+            # Logical tables share the physical region's bounds (cheap and
+            # conservative — pruning still applies __table_id at scan time).
+            meta = self.catalog.table(meta.options[LOGICAL_TABLE_OPT], database)
         lo, hi = None, None
         for rid in meta.region_ids:
             region = self.storage.region(rid)
@@ -362,8 +440,11 @@ class Database:
 
     # ---- recovery ---------------------------------------------------------
     def _reopen_regions(self):
+
         for db in self.catalog.databases():
             for meta in self.catalog.tables(db):
+                if is_logical_meta(meta):
+                    continue  # logical tables have no regions of their own
                 for rid in meta.region_ids:
                     try:
                         self.storage.open_region(rid)

@@ -1,0 +1,25 @@
+"""Metric engine: many logical tables multiplexed onto one physical region.
+
+Role-equivalent of the reference's `metric-engine` crate (reference
+src/metric-engine/src/engine.rs:58-130).
+"""
+
+from .engine import (
+    LOGICAL_TABLE_OPT,
+    PHYSICAL_TABLE_OPT,
+    TABLE_ID_COL,
+    TSID_COL,
+    MetricEngine,
+    is_logical_meta,
+    is_physical_meta,
+)
+
+__all__ = [
+    "MetricEngine",
+    "LOGICAL_TABLE_OPT",
+    "PHYSICAL_TABLE_OPT",
+    "TABLE_ID_COL",
+    "TSID_COL",
+    "is_logical_meta",
+    "is_physical_meta",
+]
