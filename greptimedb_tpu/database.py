@@ -140,8 +140,15 @@ class Database:
             ts = stmt.time_index or next(
                 (c.name for c in stmt.columns if c.is_time_index), None
             )
+            pks = set(stmt.primary_key) | {
+                c.name for c in stmt.columns if c.is_primary_key
+            }
             val = next(
-                (c.name for c in stmt.columns if not c.is_time_index and c.name != ts),
+                (
+                    c.name
+                    for c in stmt.columns
+                    if not c.is_time_index and c.name != ts and c.name not in pks
+                ),
                 None,
             )
             self.metric.create_physical_table(

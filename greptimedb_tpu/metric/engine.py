@@ -125,6 +125,11 @@ class MetricEngine:
         self.db = db
         self._meta_regions: dict[str, MetadataRegion] = {}
         self._lock = threading.Lock()
+        # Serializes logical-table DDL: concurrent ingest threads racing
+        # create/widen of the same metric (ThreadingHTTPServer handlers) must
+        # not double-create (the reference serializes DDL through the
+        # procedure framework's key locks, common/procedure/src/local/rwlock.rs).
+        self._ddl_lock = threading.RLock()
 
     # ---- metadata region handles -----------------------------------------
     def _metadata_region(self, phys_meta: TableMeta) -> MetadataRegion:
@@ -151,6 +156,14 @@ class MetricEngine:
         """Data region schema: ts + value + (__table_id, __tsid) tags.
         Label columns are added lazily as logical tables appear (reference
         engine/create.rs create_physical_region)."""
+        with self._ddl_lock:
+            return self._create_physical_table_locked(
+                name, database, ts_col, val_col, if_not_exists
+            )
+
+    def _create_physical_table_locked(
+        self, name, database, ts_col, val_col, if_not_exists
+    ) -> TableMeta:
         columns = [
             ColumnSchema(ts_col, ConcreteDataType.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP),
             ColumnSchema(val_col, ConcreteDataType.FLOAT64, SemanticType.FIELD),
@@ -168,6 +181,19 @@ class MetricEngine:
             self.db.storage.create_region(rid, meta.schema)
         return meta
 
+    def ensure_physical_table(
+        self, name: str, database: str = DEFAULT_SCHEMA
+    ) -> TableMeta:
+        """Create-if-absent with regions guaranteed to exist on return —
+        safe under concurrent ingest threads (the bare catalog has_table
+        check can observe the catalog entry before the data region)."""
+        with self._ddl_lock:
+            if self.db.catalog.has_table(name, database):
+                return self.db.catalog.table(name, database)
+            return self._create_physical_table_locked(
+                name, database, TS_COL, VAL_COL, True
+            )
+
     def create_logical_table(
         self,
         name: str,
@@ -181,6 +207,14 @@ class MetricEngine:
         """Register a logical table and make sure the physical data region
         has every label column (reference engine/create.rs
         create_logical_tables → alter physical on demand)."""
+        with self._ddl_lock:
+            return self._create_logical_table_locked(
+                name, labels, physical, database, ts_col, val_col, if_not_exists
+            )
+
+    def _create_logical_table_locked(
+        self, name, labels, physical, database, ts_col, val_col, if_not_exists
+    ) -> TableMeta:
         if self.db.catalog.has_table(name, database):
             if if_not_exists:
                 return self.db.catalog.table(name, database)
@@ -225,29 +259,36 @@ class MetricEngine:
         """Auto-create-or-widen used by the ingest path (reference
         operator Inserter create_or_alter_tables_on_demand for the metric
         engine's logical tables)."""
-        if not self.db.catalog.has_table(name, database):
-            return self.create_logical_table(
-                name, labels, physical, database, if_not_exists=True
-            )
-        meta = self.db.catalog.table(name, database)
-        if not is_logical_meta(meta):
-            raise InvalidArgumentsError(f"{name!r} is not a metric-engine logical table")
-        missing = [l for l in labels if not meta.schema.has_column(l)]
-        if missing:
-            phys_meta = self.db.catalog.table(meta.options[LOGICAL_TABLE_OPT], database)
-            self._ensure_physical_labels(phys_meta, missing)
-            schema = meta.schema
-            for lbl in sorted(missing):
-                schema = schema.add_column(
-                    ColumnSchema(lbl, ConcreteDataType.STRING, SemanticType.TAG, nullable=True)
+        with self._ddl_lock:
+            if not self.db.catalog.has_table(name, database):
+                return self._create_logical_table_locked(
+                    name, labels, physical, database, None, None, True
                 )
-            meta.schema = schema
-            self.db.catalog.update_table(meta)
-            self._metadata_region(phys_meta).update_columns(
-                f"{database}.{name}",
-                sorted(c.name for c in schema.tag_columns()),
-            )
-        return meta
+            meta = self.db.catalog.table(name, database)
+            if not is_logical_meta(meta):
+                raise InvalidArgumentsError(
+                    f"{name!r} is not a metric-engine logical table"
+                )
+            missing = [l for l in labels if not meta.schema.has_column(l)]
+            if missing:
+                phys_meta = self.db.catalog.table(
+                    meta.options[LOGICAL_TABLE_OPT], database
+                )
+                self._ensure_physical_labels(phys_meta, missing)
+                schema = meta.schema
+                for lbl in sorted(missing):
+                    schema = schema.add_column(
+                        ColumnSchema(
+                            lbl, ConcreteDataType.STRING, SemanticType.TAG, nullable=True
+                        )
+                    )
+                meta.schema = schema
+                self.db.catalog.update_table(meta)
+                self._metadata_region(phys_meta).update_columns(
+                    f"{database}.{name}",
+                    sorted(c.name for c in schema.tag_columns()),
+                )
+            return meta
 
     def drop_logical_table(self, meta: TableMeta):
         """Remove the registration; rows stay in the data region until

@@ -59,6 +59,40 @@ def load() -> ctypes.CDLL | None:
         ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int64,
     ]
+    for name in (
+        "gt_snappy_uncompressed_length",
+        "gt_snappy_decompress",
+        "gt_snappy_compress",
+        "gt_snappy_max_compressed_length",
+    ):
+        if not hasattr(lib, name):
+            # Stale .so from before the snappy entry points: rebuild once.
+            _lib = None
+            try:
+                os.remove(_LIB_PATH)
+            except OSError:
+                return None
+            if not _try_build():
+                return None
+            return load()
+    lib.gt_snappy_uncompressed_length.restype = ctypes.c_int64
+    lib.gt_snappy_uncompressed_length.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.gt_snappy_decompress.restype = ctypes.c_int64
+    lib.gt_snappy_decompress.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_char),
+        ctypes.c_int64,
+    ]
+    lib.gt_snappy_compress.restype = ctypes.c_int64
+    lib.gt_snappy_compress.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_char),
+        ctypes.c_int64,
+    ]
+    lib.gt_snappy_max_compressed_length.restype = ctypes.c_int64
+    lib.gt_snappy_max_compressed_length.argtypes = [ctypes.c_int64]
     _lib = lib
     return lib
 
@@ -134,3 +168,125 @@ def lp_tokenize(buf: bytes, max_tokens: int | None = None):
 
         raise InvalidArgumentsError(f"bad line protocol near offset {-(n + 1)}")
     return [(out[i * 3], out[i * 3 + 1], out[i * 3 + 2]) for i in range(n)]
+
+
+# ---- snappy (Prometheus remote write/read bodies) --------------------------
+
+
+class SnappyError(ValueError):
+    pass
+
+
+def snappy_decompress(data: bytes) -> bytes:
+    lib = load()
+    if lib is None:
+        return _snappy_decompress_py(data)
+    n = lib.gt_snappy_uncompressed_length(data, len(data))
+    # Snappy's worst-case expansion is a 2-byte copy element emitting 64
+    # bytes (32x); a preamble claiming more than that is hostile — reject
+    # before allocating (the length is attacker-controlled input).
+    if n < 0 or n > len(data) * 32 + 64:
+        raise SnappyError("bad snappy preamble")
+    out = ctypes.create_string_buffer(n)
+    got = lib.gt_snappy_decompress(data, len(data), out, n)
+    if got < 0:
+        raise SnappyError(f"snappy decompress failed (code {got})")
+    return out.raw[:got]
+
+
+def snappy_compress(data: bytes) -> bytes:
+    lib = load()
+    if lib is None:
+        return _snappy_compress_py(data)
+    cap = lib.gt_snappy_max_compressed_length(len(data))
+    out = ctypes.create_string_buffer(cap)
+    got = lib.gt_snappy_compress(data, len(data), out, cap)
+    if got < 0:
+        raise SnappyError(f"snappy compress failed (code {got})")
+    return out.raw[:got]
+
+
+def _uvarint(buf: bytes, pos: int) -> tuple[int, int]:
+    v, shift = 0, 0
+    while pos < len(buf):
+        b = buf[pos]
+        pos += 1
+        v |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return v, pos
+        shift += 7
+        if shift > 63:
+            break
+    raise SnappyError("bad varint")
+
+
+def _snappy_decompress_py(data: bytes) -> bytes:
+    expect, ip = _uvarint(data, 0)
+    if expect > len(data) * 32 + 64:
+        raise SnappyError("bad snappy preamble")
+    out = bytearray()
+    n = len(data)
+    while ip < n:
+        tag = data[ip]
+        ip += 1
+        kind = tag & 3
+        if kind == 0:
+            lit_len = (tag >> 2) + 1
+            if lit_len > 60:
+                extra = lit_len - 60
+                if ip + extra > n:
+                    raise SnappyError("truncated literal length")
+                lit_len = int.from_bytes(data[ip : ip + extra], "little") + 1
+                ip += extra
+            if ip + lit_len > n:
+                raise SnappyError("truncated literal")
+            out += data[ip : ip + lit_len]
+            ip += lit_len
+        else:
+            if kind == 1:
+                if ip + 1 > n:
+                    raise SnappyError("truncated copy")
+                cp_len = ((tag >> 2) & 7) + 4
+                offset = ((tag >> 5) << 8) | data[ip]
+                ip += 1
+            elif kind == 2:
+                if ip + 2 > n:
+                    raise SnappyError("truncated copy")
+                cp_len = (tag >> 2) + 1
+                offset = int.from_bytes(data[ip : ip + 2], "little")
+                ip += 2
+            else:
+                if ip + 4 > n:
+                    raise SnappyError("truncated copy")
+                cp_len = (tag >> 2) + 1
+                offset = int.from_bytes(data[ip : ip + 4], "little")
+                ip += 4
+            if offset == 0 or offset > len(out):
+                raise SnappyError("bad copy offset")
+            for _ in range(cp_len):  # may overlap its own output
+                out.append(out[-offset])
+    if len(out) != expect:
+        raise SnappyError("snappy length mismatch")
+    return bytes(out)
+
+
+def _snappy_compress_py(data: bytes) -> bytes:
+    """Literal-only encoding — valid snappy, zero compression (fallback)."""
+    out = bytearray()
+    v = len(data)
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    pos = 0
+    while pos < len(data):
+        chunk = data[pos : pos + 65536]
+        n = len(chunk) - 1
+        if n < 60:
+            out.append(n << 2)
+        else:
+            out.append(61 << 2)
+            out += n.to_bytes(2, "little")
+        out += chunk
+        pos += len(chunk)
+    return bytes(out)

@@ -218,4 +218,175 @@ int64_t gt_lp_tokenize(const uint8_t* buf, int64_t len, int64_t* out,
     return n;
 }
 
+// --------------------------------------------------------------- snappy ----
+// Snappy block format (https://github.com/google/snappy/blob/main/format_description.txt),
+// used by Prometheus remote write/read bodies (reference
+// servers/src/http/prom_store.rs decodes the same format via the snap crate).
+
+static int64_t read_varint(const uint8_t* in, int64_t len, int64_t* pos,
+                           uint64_t* out_val) {
+    uint64_t v = 0;
+    int shift = 0;
+    while (*pos < len && shift <= 63) {
+        uint8_t b = in[(*pos)++];
+        v |= (uint64_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) { *out_val = v; return 0; }
+        shift += 7;
+    }
+    return -1;
+}
+
+int64_t gt_snappy_uncompressed_length(const uint8_t* in, int64_t len) {
+    int64_t pos = 0;
+    uint64_t v;
+    if (read_varint(in, len, &pos, &v) != 0) return -1;
+    return (int64_t)v;
+}
+
+int64_t gt_snappy_decompress(const uint8_t* in, int64_t in_len,
+                             uint8_t* out, int64_t out_cap) {
+    int64_t ip = 0;
+    uint64_t expect;
+    if (read_varint(in, in_len, &ip, &expect) != 0) return -1;
+    if ((int64_t)expect > out_cap) return -2;
+    int64_t op = 0;
+    while (ip < in_len) {
+        uint8_t tag = in[ip++];
+        uint32_t kind = tag & 3;
+        if (kind == 0) {  // literal
+            int64_t lit_len = (tag >> 2) + 1;
+            if (lit_len > 60) {
+                int extra = (int)lit_len - 60;  // 1..4 length bytes
+                if (ip + extra > in_len) return -3;
+                lit_len = 0;
+                for (int k = 0; k < extra; k++) lit_len |= (int64_t)in[ip + k] << (8 * k);
+                lit_len += 1;
+                ip += extra;
+            }
+            if (ip + lit_len > in_len || op + lit_len > out_cap) return -3;
+            memcpy(out + op, in + ip, lit_len);
+            ip += lit_len;
+            op += lit_len;
+        } else {
+            int64_t cp_len, offset;
+            if (kind == 1) {
+                if (ip >= in_len) return -3;
+                cp_len = ((tag >> 2) & 7) + 4;
+                offset = ((int64_t)(tag >> 5) << 8) | in[ip++];
+            } else if (kind == 2) {
+                if (ip + 2 > in_len) return -3;
+                cp_len = (tag >> 2) + 1;
+                offset = (int64_t)in[ip] | ((int64_t)in[ip + 1] << 8);
+                ip += 2;
+            } else {
+                if (ip + 4 > in_len) return -3;
+                cp_len = (tag >> 2) + 1;
+                offset = (int64_t)in[ip] | ((int64_t)in[ip + 1] << 8) |
+                         ((int64_t)in[ip + 2] << 16) | ((int64_t)in[ip + 3] << 24);
+                ip += 4;
+            }
+            if (offset == 0 || offset > op || op + cp_len > out_cap) return -3;
+            // Byte-at-a-time: copies may overlap their own output (RLE).
+            for (int64_t k = 0; k < cp_len; k++) { out[op] = out[op - offset]; op++; }
+        }
+    }
+    return op == (int64_t)expect ? op : -4;
+}
+
+static void write_varint(uint8_t* out, int64_t* op, uint64_t v) {
+    while (v >= 0x80) { out[(*op)++] = (uint8_t)(v | 0x80); v >>= 7; }
+    out[(*op)++] = (uint8_t)v;
+}
+
+static void emit_literal(const uint8_t* in, int64_t start, int64_t len,
+                         uint8_t* out, int64_t* op) {
+    int64_t n = len - 1;
+    if (n < 60) {
+        out[(*op)++] = (uint8_t)(n << 2);
+    } else if (n < (1 << 8)) {
+        out[(*op)++] = 60 << 2;
+        out[(*op)++] = (uint8_t)n;
+    } else if (n < (1 << 16)) {
+        out[(*op)++] = 61 << 2;
+        out[(*op)++] = (uint8_t)n;
+        out[(*op)++] = (uint8_t)(n >> 8);
+    } else if (n < (1 << 24)) {
+        out[(*op)++] = 62 << 2;
+        out[(*op)++] = (uint8_t)n;
+        out[(*op)++] = (uint8_t)(n >> 8);
+        out[(*op)++] = (uint8_t)(n >> 16);
+    } else {
+        out[(*op)++] = 63 << 2;
+        out[(*op)++] = (uint8_t)n;
+        out[(*op)++] = (uint8_t)(n >> 8);
+        out[(*op)++] = (uint8_t)(n >> 16);
+        out[(*op)++] = (uint8_t)(n >> 24);
+    }
+    memcpy(out + *op, in + start, len);
+    *op += len;
+}
+
+static void emit_copy(int64_t offset, int64_t len, uint8_t* out, int64_t* op) {
+    // Split long copies; snappy copy elements carry at most 64 bytes.
+    while (len >= 68) {
+        out[(*op)++] = (uint8_t)((63 << 2) | 2);
+        out[(*op)++] = (uint8_t)offset;
+        out[(*op)++] = (uint8_t)(offset >> 8);
+        len -= 64;
+    }
+    if (len > 64) {  // leave >=4 for the tail element
+        out[(*op)++] = (uint8_t)((59 << 2) | 2);
+        out[(*op)++] = (uint8_t)offset;
+        out[(*op)++] = (uint8_t)(offset >> 8);
+        len -= 60;
+    }
+    if (len >= 4 && len <= 11 && offset < 2048) {
+        out[(*op)++] = (uint8_t)(((offset >> 8) << 5) | ((len - 4) << 2) | 1);
+        out[(*op)++] = (uint8_t)offset;
+    } else {
+        out[(*op)++] = (uint8_t)(((len - 1) << 2) | 2);
+        out[(*op)++] = (uint8_t)offset;
+        out[(*op)++] = (uint8_t)(offset >> 8);
+    }
+}
+
+int64_t gt_snappy_max_compressed_length(int64_t n) {
+    return 32 + n + n / 6;  // snappy's documented bound
+}
+
+int64_t gt_snappy_compress(const uint8_t* in, int64_t in_len,
+                           uint8_t* out, int64_t out_cap) {
+    if (out_cap < gt_snappy_max_compressed_length(in_len)) return -2;
+    int64_t op = 0;
+    write_varint(out, &op, (uint64_t)in_len);
+    if (in_len == 0) return op;
+    // Greedy LZ with a 16-bit hash of 4-byte windows (the classic snappy
+    // scheme, one table per block).
+    const int HASH_BITS = 14;
+    static thread_local int64_t table[1 << 14];
+    for (int64_t i = 0; i < (1 << HASH_BITS); i++) table[i] = -1;
+    int64_t ip = 0, lit_start = 0;
+    while (ip + 4 <= in_len) {
+        uint32_t w;
+        memcpy(&w, in + ip, 4);
+        uint32_t h = (w * 0x1e35a7bdu) >> (32 - HASH_BITS);
+        int64_t cand = table[h];
+        table[h] = ip;
+        uint32_t cw;
+        if (cand >= 0 && ip - cand < 65536 &&
+            (memcpy(&cw, in + cand, 4), cw == w)) {
+            if (ip > lit_start) emit_literal(in, lit_start, ip - lit_start, out, &op);
+            int64_t match = 4;
+            while (ip + match < in_len && in[cand + match] == in[ip + match]) match++;
+            emit_copy(ip - cand, match, out, &op);
+            ip += match;
+            lit_start = ip;
+        } else {
+            ip++;
+        }
+    }
+    if (in_len > lit_start) emit_literal(in, lit_start, in_len - lit_start, out, &op);
+    return op;
+}
+
 }  // extern "C"

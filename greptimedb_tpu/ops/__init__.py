@@ -10,6 +10,10 @@ query/src/dist_plan/commutativity.rs:45), and partials merge with psum over
 ICI (the reference's MergeScan + upper merge aggregate).
 """
 
+from ..utils.jax_env import ensure_x64
+
+ensure_x64()
+
 from .tiles import TileBatch, tiles_from_table
 from .aggregate import AggState, segment_aggregate, merge_states, finalize
 from .filter import compile_predicate

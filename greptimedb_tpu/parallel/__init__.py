@@ -1,3 +1,7 @@
+from ..utils.jax_env import ensure_x64
+
+ensure_x64()
+
 from .mesh import make_mesh, local_device_count
 from .executor import DistGroupByPlan, distributed_groupby
 

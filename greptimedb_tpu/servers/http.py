@@ -80,8 +80,11 @@ class _Handler(BaseHTTPRequestHandler):
             body = self.rfile.read(length)
             ctype = self.headers.get("Content-Type", "")
             if "application/x-www-form-urlencoded" in ctype:
-                for k, v in urllib.parse.parse_qs(body.decode()).items():
-                    params[k] = v[-1]
+                try:
+                    for k, v in urllib.parse.parse_qs(body.decode()).items():
+                        params[k] = v[-1]
+                except UnicodeDecodeError:
+                    params["__body"] = body  # binary body mislabelled as a form
             else:
                 params["__body"] = body
         return params
@@ -115,10 +118,20 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._handle_influx(params)
             if route.startswith("/v1/prometheus/api/v1/") or route.startswith("/api/v1/"):
                 return self._handle_prometheus(route.rsplit("/api/v1/", 1)[1], params)
+            if route == "/v1/prometheus/write":
+                return self._handle_prom_write(params)
+            if route == "/v1/prometheus/read":
+                return self._handle_prom_read(params)
             return self._send(404, {"error": f"no route {route}"})
         except GreptimeError as e:
             self._send(400, {"error": str(e), "code": int(e.status_code())})
         except Exception as e:  # noqa: BLE001
+            import logging
+            import traceback
+
+            logging.getLogger("greptimedb_tpu.http").error(
+                "500 on %s: %s", self.path, traceback.format_exc()
+            )
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
     # ---- handlers ---------------------------------------------------------
@@ -128,8 +141,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(400, {"error": "missing sql"})
         if params.get("db"):
             self.db.current_database = params["db"]
+        from ..utils import kernel_executor
+
         outputs = []
-        for result in self.db.sql(sql):
+        for result in kernel_executor.run(lambda: list(self.db.sql(sql))):
             if isinstance(result, int):
                 outputs.append({"affectedrows": result})
             elif result is None:
@@ -146,21 +161,53 @@ class _Handler(BaseHTTPRequestHandler):
         REGISTRY.counter("greptime_http_influx_rows_total", "Influx rows").inc(n)
         return self._send(204, b"", "text/plain")
 
+    def _handle_prom_write(self, params):
+        from .prom_store import DEFAULT_PHYSICAL_TABLE, remote_write
+
+        n = remote_write(
+            self.db,
+            params.get("__body") or b"",
+            database=params.get("db", "public"),
+            physical_table=params.get("physical_table", DEFAULT_PHYSICAL_TABLE),
+        )
+        REGISTRY.counter(
+            "greptime_http_prom_write_rows_total", "Prom remote-write rows"
+        ).inc(n)
+        return self._send(204, b"", "text/plain")
+
+    def _handle_prom_read(self, params):
+        from .prom_store import remote_read
+
+        body = remote_read(
+            self.db, params.get("__body") or b"", database=params.get("db", "public")
+        )
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-protobuf")
+        self.send_header("Content-Encoding", "snappy")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _handle_prometheus(self, endpoint: str, params):
         from ..query.promql.engine import PromqlEngine
+
+        from ..utils import kernel_executor
 
         engine = PromqlEngine(self.db)
         if endpoint == "query_range":
             start = float(params["start"])
             end = float(params["end"])
             step = _prom_duration_s(params.get("step", "60"))
-            table = engine.query_range(
-                params["query"], int(start * 1000), int(end * 1000), int(step * 1000)
+            table = kernel_executor.run(
+                engine.query_range,
+                params["query"], int(start * 1000), int(end * 1000), int(step * 1000),
             )
             return self._send(200, _prom_matrix_json(table))
         if endpoint == "query":
             t = float(params.get("time", 0))
-            table = engine.query_instant(params["query"], int(t * 1000))
+            table = kernel_executor.run(
+                engine.query_instant, params["query"], int(t * 1000)
+            )
             return self._send(200, _prom_vector_json(table))
         if endpoint == "labels":
             labels = set()
@@ -244,7 +291,14 @@ class HttpServer:
         host, port = self._httpd.server_address[:2]
         return f"{host}:{port}"
 
-    def start(self):
+    def start(self, warm: bool = True):
+        if warm:
+            from ..utils import kernel_executor
+
+            # Bind the jax backend to the long-lived kernel thread BEFORE
+            # serving: PJRT first-touch from short-lived handler threads can
+            # abort the process (see utils/kernel_executor.py).
+            kernel_executor.warm_up()
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
         return self

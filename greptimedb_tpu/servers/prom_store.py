@@ -1,0 +1,211 @@
+"""Prometheus remote storage: remote write + remote read.
+
+Role-equivalent of the reference's prom-store endpoints (reference
+servers/src/http/prom_store.rs + servers/src/prom_store.rs): bodies are
+snappy-compressed protobufs; each metric becomes a metric-engine logical
+table on a shared physical table (reference routes Prometheus writes through
+the metric engine the same way, operator inserts with
+physical_table=greptime_physical_table).
+"""
+
+from __future__ import annotations
+
+import re
+from collections import defaultdict
+
+import pyarrow as pa
+
+from .. import native
+from ..query.logical_plan import TableScan
+from ..utils.errors import InvalidArgumentsError, TableNotFoundError
+from . import protowire as pw
+
+# Reference default physical table for Prometheus ingest
+# (servers/src/http/prom_store.rs PHYSICAL_TABLE_PARAM default).
+DEFAULT_PHYSICAL_TABLE = "greptime_physical_table"
+
+NAME_LABEL = "__name__"
+
+
+def remote_write(
+    db,
+    body: bytes,
+    database: str = "public",
+    physical_table: str = DEFAULT_PHYSICAL_TABLE,
+) -> int:
+    """Decode a snappy+protobuf WriteRequest and ingest via the metric
+    engine (auto-creating/widening logical tables on demand)."""
+    try:
+        data = native.snappy_decompress(body)
+        series = pw.decode_write_request(data)
+    except (native.SnappyError, pw.WireError) as e:
+        raise InvalidArgumentsError(f"bad remote-write body: {e}") from e
+    if not series:
+        return 0
+    db.metric.ensure_physical_table(physical_table, database)
+
+    by_metric: dict[str, list[pw.PromTimeSeries]] = defaultdict(list)
+    for ts in series:
+        name = ts.labels.get(NAME_LABEL)
+        if not name:
+            raise InvalidArgumentsError("timeseries without __name__ label")
+        by_metric[name].append(ts)
+
+    total = 0
+    for metric, series_list in by_metric.items():
+        label_names = sorted(
+            {k for ts in series_list for k in ts.labels if k != NAME_LABEL}
+        )
+        meta = db.metric.ensure_logical_table(
+            metric, label_names, physical_table, database
+        )
+        ts_name = meta.schema.time_index.name
+        val_name = meta.schema.field_columns()[0].name
+        cols: dict[str, list] = {ts_name: [], val_name: []}
+        for lbl in label_names:
+            cols[lbl] = []
+        for ts in series_list:
+            for s in ts.samples:
+                cols[ts_name].append(s.timestamp_ms)
+                cols[val_name].append(s.value)
+                for lbl in label_names:
+                    cols[lbl].append(ts.labels.get(lbl))
+        arrays = {
+            ts_name: pa.array(cols[ts_name], pa.timestamp("ms")),
+            val_name: pa.array(cols[val_name], pa.float64()),
+        }
+        for lbl in label_names:
+            arrays[lbl] = pa.array(cols[lbl], pa.string())
+        total += db.insert_rows(metric, pa.table(arrays), database=database)
+    return total
+
+
+def remote_read(db, body: bytes, database: str = "public") -> bytes:
+    """Decode a ReadRequest, run each query, return an encoded+compressed
+    ReadResponse (reference servers/src/http/prom_store.rs remote_read)."""
+    try:
+        data = native.snappy_decompress(body)
+        queries = pw.decode_read_request(data)
+    except (native.SnappyError, pw.WireError) as e:
+        raise InvalidArgumentsError(f"bad remote-read body: {e}") from e
+    results = []
+    for q in queries:
+        results.append(_run_read_query(db, q, database))
+    return native.snappy_compress(pw.encode_read_response(results))
+
+
+def _run_read_query(db, q: pw.PromQuerySpec, database: str) -> list[pw.PromTimeSeries]:
+    name = None
+    name_re = None
+    label_matchers = []
+    for mtype, lname, value in q.matchers:
+        if lname == NAME_LABEL:
+            if mtype == pw.MATCH_EQ:
+                name = value
+            elif mtype == pw.MATCH_RE:
+                name_re = value
+            else:
+                raise InvalidArgumentsError("unsupported __name__ matcher type")
+        else:
+            label_matchers.append((mtype, lname, value))
+
+    if name is not None:
+        tables = [name]
+    elif name_re is not None:
+        rx = re.compile(f"^(?:{name_re})$")
+        tables = [
+            m.name
+            for m in db.catalog.tables(database)
+            if rx.match(m.name) and _prom_compatible(m)
+        ]
+    else:
+        raise InvalidArgumentsError("remote read requires a __name__ matcher")
+
+    out: list[pw.PromTimeSeries] = []
+    for table in tables:
+        try:
+            meta = db.catalog.table(table, database)
+        except TableNotFoundError:
+            continue
+        if not _prom_compatible(meta):
+            continue
+        # EQ matchers on known columns push down; the rest filter after scan.
+        pushed, residual = [], []
+        for mtype, lname, value in label_matchers:
+            if mtype == pw.MATCH_EQ and meta.schema.has_column(lname):
+                pushed.append((lname, "=", value))
+            else:
+                residual.append((mtype, lname, value))
+        scan = TableScan(
+            table=table,
+            database=database,
+            filters=pushed,
+            time_range=(q.start_ms, q.end_ms + 1),
+        )
+        parts = db._region_scan(scan)
+        parts = [p for p in parts if p.num_rows]
+        if not parts:
+            continue
+        t = pa.concat_tables(parts, promote_options="permissive")
+        out.extend(_to_series(meta, t, table, residual))
+    return out
+
+
+def _prom_compatible(meta) -> bool:
+    """A table is served to Prometheus readers iff it looks like a metric:
+    a time index, at least one numeric field, string-typed tags — and not
+    the metric engine's physical table (whose synthetic int64 tags would
+    leak every metric's rows mixed together)."""
+    from ..datatypes.data_type import ConcreteDataType
+    from ..metric.engine import is_physical_meta
+
+    if is_physical_meta(meta):
+        return False
+    if meta.schema.time_index is None or not meta.schema.field_columns():
+        return False
+    return all(
+        c.data_type == ConcreteDataType.STRING for c in meta.schema.tag_columns()
+    )
+
+
+def _matches(mtype: int, actual: str, value: str) -> bool:
+    if mtype == pw.MATCH_EQ:
+        return actual == value
+    if mtype == pw.MATCH_NEQ:
+        return actual != value
+    rx = re.compile(f"^(?:{value})$")
+    if mtype == pw.MATCH_RE:
+        return bool(rx.match(actual))
+    return not rx.match(actual)
+
+
+def _to_series(
+    meta, t: pa.Table, metric_name: str, residual: list[tuple[int, str, str]]
+) -> list[pw.PromTimeSeries]:
+    ts_name = meta.schema.time_index.name
+    val_name = meta.schema.field_columns()[0].name
+    label_cols = [c.name for c in meta.schema.tag_columns()]
+    ts_vals = [int(v.value) for v in t[ts_name]]
+    vals = t[val_name].to_pylist()
+    labels_per_row = {c: t[c].to_pylist() for c in label_cols}
+    series: dict[tuple, pw.PromTimeSeries] = {}
+    for i in range(t.num_rows):
+        labels = {
+            c: labels_per_row[c][i]
+            for c in label_cols
+            if labels_per_row[c][i] is not None
+        }
+        if residual and not all(
+            _matches(mtype, labels.get(lname, ""), value)
+            for mtype, lname, value in residual
+        ):
+            continue
+        key = tuple(sorted(labels.items()))
+        if key not in series:
+            series[key] = pw.PromTimeSeries(
+                labels={NAME_LABEL: metric_name, **labels}
+            )
+        series[key].samples.append(pw.PromSample(vals[i], ts_vals[i]))
+    for s in series.values():
+        s.samples.sort(key=lambda x: x.timestamp_ms)
+    return [series[k] for k in sorted(series)]

@@ -1,0 +1,221 @@
+"""Minimal protobuf wire-format codec (no generated code).
+
+Used for the Prometheus remote write/read protobufs (reference
+servers/src/proto/prometheus.rs via the prost crate) and OTLP payloads.
+Only the wire-level subset needed: varint, 64-bit, and length-delimited
+fields; unknown fields are skipped, matching protobuf semantics.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+
+class WireError(ValueError):
+    pass
+
+
+def read_uvarint(buf: bytes, pos: int) -> tuple[int, int]:
+    v, shift = 0, 0
+    while pos < len(buf):
+        b = buf[pos]
+        pos += 1
+        v |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return v, pos
+        shift += 7
+        if shift > 70:
+            break
+    raise WireError("bad varint")
+
+
+def write_uvarint(out: bytearray, v: int):
+    if v < 0:
+        v &= (1 << 64) - 1  # two's-complement int64 (10-byte encoding)
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+
+
+def to_int64(v: int) -> int:
+    """Reinterpret an unsigned varint as a signed int64."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def iter_fields(buf: bytes, start: int = 0, end: int | None = None):
+    """Yield (field_number, wire_type, value) over a message body.
+
+    wire_type 0 -> int (varint, unsigned), 1 -> bytes (8), 2 -> bytes slice,
+    5 -> bytes (4). Groups (3/4) are rejected.
+    """
+    pos = start
+    end = len(buf) if end is None else end
+    while pos < end:
+        key, pos = read_uvarint(buf, pos)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:
+            v, pos = read_uvarint(buf, pos)
+            yield fno, wt, v
+        elif wt == 1:
+            if pos + 8 > end:
+                raise WireError("truncated fixed64")
+            yield fno, wt, buf[pos : pos + 8]
+            pos += 8
+        elif wt == 2:
+            ln, pos = read_uvarint(buf, pos)
+            if pos + ln > end:
+                raise WireError("truncated length-delimited field")
+            yield fno, wt, buf[pos : pos + ln]
+            pos += ln
+        elif wt == 5:
+            if pos + 4 > end:
+                raise WireError("truncated fixed32")
+            yield fno, wt, buf[pos : pos + 4]
+            pos += 4
+        else:
+            raise WireError(f"unsupported wire type {wt}")
+
+
+def emit_tag(out: bytearray, fno: int, wt: int):
+    write_uvarint(out, (fno << 3) | wt)
+
+
+def emit_varint_field(out: bytearray, fno: int, v: int):
+    emit_tag(out, fno, 0)
+    write_uvarint(out, v)
+
+
+def emit_double_field(out: bytearray, fno: int, v: float):
+    emit_tag(out, fno, 1)
+    out += struct.pack("<d", v)
+
+
+def emit_bytes_field(out: bytearray, fno: int, data: bytes):
+    emit_tag(out, fno, 2)
+    write_uvarint(out, len(data))
+    out += data
+
+
+def emit_str_field(out: bytearray, fno: int, s: str):
+    emit_bytes_field(out, fno, s.encode())
+
+
+# ---- Prometheus remote storage messages ------------------------------------
+# prometheus/prompb/remote.proto + types.proto (the reference depends on the
+# same schema through greptime-proto).
+
+
+@dataclass
+class PromSample:
+    value: float
+    timestamp_ms: int
+
+
+@dataclass
+class PromTimeSeries:
+    labels: dict[str, str] = field(default_factory=dict)
+    samples: list[PromSample] = field(default_factory=list)
+
+
+def decode_label(buf: bytes) -> tuple[str, str]:
+    name = value = ""
+    for fno, wt, v in iter_fields(buf):
+        if fno == 1 and wt == 2:
+            name = v.decode()
+        elif fno == 2 and wt == 2:
+            value = v.decode()
+    return name, value
+
+
+def decode_write_request(buf: bytes) -> list[PromTimeSeries]:
+    """WriteRequest { repeated TimeSeries timeseries = 1; } — metadata
+    (field 3) is skipped like the reference does."""
+    series: list[PromTimeSeries] = []
+    for fno, wt, v in iter_fields(buf):
+        if fno != 1 or wt != 2:
+            continue
+        ts = PromTimeSeries()
+        for f2, w2, v2 in iter_fields(v):
+            if f2 == 1 and w2 == 2:  # Label
+                name, value = decode_label(v2)
+                ts.labels[name] = value
+            elif f2 == 2 and w2 == 2:  # Sample {double value=1; int64 ts=2}
+                value, ts_ms = 0.0, 0
+                for f3, w3, v3 in iter_fields(v2):
+                    if f3 == 1 and w3 == 1:
+                        value = struct.unpack("<d", v3)[0]
+                    elif f3 == 2 and w3 == 0:
+                        ts_ms = to_int64(v3)
+                ts.samples.append(PromSample(value, ts_ms))
+        series.append(ts)
+    return series
+
+
+def encode_write_request(series: list[PromTimeSeries]) -> bytes:
+    out = bytearray()
+    for ts in series:
+        body = bytearray()
+        for name, value in ts.labels.items():
+            lab = bytearray()
+            emit_str_field(lab, 1, name)
+            emit_str_field(lab, 2, value)
+            emit_bytes_field(body, 1, bytes(lab))
+        for s in ts.samples:
+            sam = bytearray()
+            emit_double_field(sam, 1, s.value)
+            emit_varint_field(sam, 2, s.timestamp_ms)
+            emit_bytes_field(body, 2, bytes(sam))
+        emit_bytes_field(out, 1, bytes(body))
+    return bytes(out)
+
+
+# LabelMatcher.Type enum
+MATCH_EQ, MATCH_NEQ, MATCH_RE, MATCH_NRE = 0, 1, 2, 3
+
+
+@dataclass
+class PromQuerySpec:
+    start_ms: int = 0
+    end_ms: int = 0
+    matchers: list[tuple[int, str, str]] = field(default_factory=list)  # (type, name, value)
+
+
+def decode_read_request(buf: bytes) -> list[PromQuerySpec]:
+    """ReadRequest { repeated Query queries = 1; }"""
+    queries: list[PromQuerySpec] = []
+    for fno, wt, v in iter_fields(buf):
+        if fno != 1 or wt != 2:
+            continue
+        q = PromQuerySpec()
+        for f2, w2, v2 in iter_fields(v):
+            if f2 == 1 and w2 == 0:
+                q.start_ms = to_int64(v2)
+            elif f2 == 2 and w2 == 0:
+                q.end_ms = to_int64(v2)
+            elif f2 == 3 and w2 == 2:  # LabelMatcher
+                mtype, name, value = MATCH_EQ, "", ""
+                for f3, w3, v3 in iter_fields(v2):
+                    if f3 == 1 and w3 == 0:
+                        mtype = v3
+                    elif f3 == 2 and w3 == 2:
+                        name = v3.decode()
+                    elif f3 == 3 and w3 == 2:
+                        value = v3.decode()
+                q.matchers.append((mtype, name, value))
+        queries.append(q)
+    return queries
+
+
+def encode_read_response(results: list[list[PromTimeSeries]]) -> bytes:
+    """ReadResponse { repeated QueryResult results = 1; } with
+    QueryResult { repeated TimeSeries timeseries = 1; }"""
+    out = bytearray()
+    for result in results:
+        body = bytearray()
+        # QueryResult.timeseries is field 1 of TimeSeries entries — reuse the
+        # WriteRequest layout (same field number + message shape).
+        body += encode_write_request(result)
+        emit_bytes_field(out, 1, bytes(body))
+    return bytes(out)

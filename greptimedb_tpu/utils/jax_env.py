@@ -1,0 +1,24 @@
+"""JAX runtime configuration shared by every kernel entry point.
+
+A time-series database computes on int64 timestamps (epoch-ms overflows
+int32), so x64 must be on wherever the kernels run — including the real
+TPU chip, where jax defaults to x32 and would silently truncate both the
+timestamps and the int64 sentinels in the segmented kernels (observed as
+an OverflowError in ops/rate.py on the axon platform).  Value columns stay
+float32/bfloat16 by explicit dtype choice in the kernels; this only widens
+the default so int64/float64 requests mean what they say.
+"""
+
+from __future__ import annotations
+
+_done = False
+
+
+def ensure_x64():
+    global _done
+    if _done:
+        return
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    _done = True

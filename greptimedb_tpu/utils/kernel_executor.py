@@ -1,0 +1,63 @@
+"""Dedicated kernel-execution thread for jax work in serving contexts.
+
+The reference pins query execution to dedicated tokio runtimes
+(common/runtime/src/global.rs:138) rather than protocol threads; we do the
+same for a harder reason: the TPU PJRT plugin is not robust to first-touch
+initialization from short-lived protocol handler threads (observed
+`terminate called after throwing an instance of ''` aborts when jax init
+raced an exiting HTTP handler thread).  All jax entry points in the serving
+path submit closures here — one long-lived thread owns the backend.
+
+Library use (tests, notebooks, bench) is unaffected: `run()` executes
+inline when called from the executor thread itself or when serving mode
+has not started.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+
+_executor: concurrent.futures.ThreadPoolExecutor | None = None
+_executor_thread_id: int | None = None
+_lock = threading.Lock()
+
+
+def _ensure_executor() -> concurrent.futures.ThreadPoolExecutor:
+    global _executor
+    with _lock:
+        if _executor is None:
+            _executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="gt-kernel"
+            )
+
+            def _capture_id():
+                global _executor_thread_id
+                _executor_thread_id = threading.get_ident()
+
+            _executor.submit(_capture_id).result()
+        return _executor
+
+
+def warm_up():
+    """Initialize the jax backend on the kernel thread (call once at server
+    start, from the main thread)."""
+
+    def _init():
+        import jax
+
+        jax.devices()
+
+    _ensure_executor().submit(_init).result()
+
+
+def run(fn, *args, **kwargs):
+    """Run `fn` on the kernel thread (inline if already on it, or if the
+    executor was never started and we're in library mode)."""
+    if _executor is None or threading.get_ident() == _executor_thread_id:
+        return fn(*args, **kwargs)
+    return _executor.submit(fn, *args, **kwargs).result()
+
+
+def started() -> bool:
+    return _executor is not None
