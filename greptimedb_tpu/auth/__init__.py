@@ -1,0 +1,24 @@
+"""Authentication & authorization.
+
+Role-equivalent of the reference's `auth` crate (reference
+src/auth/src/user_provider.rs:36 `UserProvider` trait): pluggable user
+providers (static option string, hot-reloading file) and a per-statement
+permission checker (reference src/auth/src/permission.rs).
+"""
+
+from .user_provider import (
+    StaticUserProvider,
+    UserProvider,
+    WatchFileUserProvider,
+    user_provider_from_option,
+)
+from .permission import PermissionChecker, PermissionDenied
+
+__all__ = [
+    "UserProvider",
+    "StaticUserProvider",
+    "WatchFileUserProvider",
+    "user_provider_from_option",
+    "PermissionChecker",
+    "PermissionDenied",
+]

@@ -1,0 +1,51 @@
+"""Per-statement permission checking.
+
+Reference: src/auth/src/permission.rs `PermissionChecker` — consulted by
+the frontend before executing a statement, keyed on the statement kind and
+the connection channel.
+"""
+
+from __future__ import annotations
+
+from ..utils.errors import GreptimeError, StatusCode
+
+
+class PermissionDenied(GreptimeError):
+    code = StatusCode.PERMISSION_DENIED
+
+
+class PermissionChecker:
+    """Default-allow checker with deny rules per (user, statement-kind).
+
+    Statement kinds: 'read' (SELECT/SHOW/DESCRIBE/TQL/EXPLAIN),
+    'write' (INSERT/DELETE), 'ddl' (CREATE/DROP/ALTER), 'admin' (ADMIN).
+    """
+
+    READ_KINDS = {"SelectStmt", "ShowStmt", "DescribeStmt", "TqlStmt", "ExplainStmt"}
+    WRITE_KINDS = {"InsertStmt", "DeleteStmt"}
+    DDL_KINDS = {"CreateTableStmt", "CreateDatabaseStmt", "DropStmt"}
+
+    def __init__(self, denies: dict[str, set[str]] | None = None):
+        # user -> denied kinds, '*' user applies to everyone
+        self.denies = denies or {}
+
+    @classmethod
+    def kind_of(cls, stmt) -> str:
+        name = type(stmt).__name__
+        if name in cls.READ_KINDS:
+            return "read"
+        if name in cls.WRITE_KINDS:
+            return "write"
+        if name in cls.DDL_KINDS:
+            return "ddl"
+        if name == "AdminStmt":
+            return "admin"
+        return "other"
+
+    def check(self, user: str, stmt) -> None:
+        kind = self.kind_of(stmt)
+        for scope in (user, "*"):
+            if kind in self.denies.get(scope, set()):
+                raise PermissionDenied(
+                    f"user {user!r} is not allowed to run {kind} statements"
+                )

@@ -1,0 +1,96 @@
+"""User providers: who can connect and with what password.
+
+Reference: src/auth/src/user_provider.rs:36 (`UserProvider`),
+static_user_provider.rs (`user=pw` option strings) and
+watch_file_user_provider.rs:26 (hot-reload file of `user=pw` lines).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class UserProvider:
+    def password_of(self, username: str) -> str | None:
+        """Plaintext password for `username`, or None if unknown.  Wire
+        protocols derive their own challenge hashes from it (MySQL
+        native-password scramble, PG md5/cleartext)."""
+        raise NotImplementedError
+
+    def authenticate(self, username: str, password: str) -> bool:
+        expected = self.password_of(username)
+        return expected is not None and expected == password
+
+
+class StaticUserProvider(UserProvider):
+    """Fixed user→password map (reference static_user_provider.rs, built
+    from `--user-provider=static_user_provider:cmd:user=pw`)."""
+
+    def __init__(self, users: dict[str, str]):
+        self._users = dict(users)
+
+    def password_of(self, username: str) -> str | None:
+        return self._users.get(username)
+
+
+class WatchFileUserProvider(UserProvider):
+    """`user=pw` lines re-read when the file mtime changes (reference
+    watch_file_user_provider.rs uses notify; polling the mtime on access is
+    equivalent without a watcher thread)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._mtime = 0.0
+        self._users: dict[str, str] = {}
+        self._reload_if_changed()
+
+    def _reload_if_changed(self):
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            return
+        with self._lock:
+            if mtime == self._mtime:
+                return
+            users = {}
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line or line.startswith("#") or "=" not in line:
+                        continue
+                    user, pw = line.split("=", 1)
+                    users[user.strip()] = pw.strip()
+            self._users = users
+            self._mtime = mtime
+
+    def password_of(self, username: str) -> str | None:
+        self._reload_if_changed()
+        with self._lock:
+            return self._users.get(username)
+
+
+def user_provider_from_option(option: str) -> UserProvider:
+    """Parse the reference's `--user-provider` option syntax:
+    `static_user_provider:cmd:user1=pw1,user2=pw2` or
+    `static_user_provider:file:<path>` or `watch_file_user_provider:<path>`
+    (reference src/auth/src/lib.rs user_provider_from_option)."""
+    kind, _, rest = option.partition(":")
+    if kind == "static_user_provider":
+        mode, _, arg = rest.partition(":")
+        if mode == "cmd":
+            users = {}
+            for pair in arg.split(","):
+                user, _, pw = pair.partition("=")
+                users[user] = pw
+            return StaticUserProvider(users)
+        if mode == "file":
+            provider = WatchFileUserProvider(arg)
+            return StaticUserProvider(
+                {u: provider.password_of(u) for u in provider._users}
+            )
+        raise ValueError(f"unknown static_user_provider mode: {mode}")
+    if kind == "watch_file_user_provider":
+        return WatchFileUserProvider(rest)
+    raise ValueError(f"unknown user provider: {kind}")

@@ -1,0 +1,219 @@
+"""Minimal MySQL client (text + prepared/binary protocol subset).
+
+Used by the test suite and CLI to talk to `MysqlServer` the way a real
+driver would (the reference tests its MySQL frontend with real client
+crates; this plays that role without a mysql dependency).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+from .mysql import (
+    CLIENT_CONNECT_WITH_DB,
+    CLIENT_PLUGIN_AUTH,
+    CLIENT_PROTOCOL_41,
+    CLIENT_SECURE_CONNECTION,
+    MYSQL_TYPE_DOUBLE,
+    MYSQL_TYPE_LONGLONG,
+    MYSQL_TYPE_TIMESTAMP,
+    _lenenc_int,
+    _lenenc_str,
+    _PacketIO,
+    _read_lenenc_int,
+    native_password_scramble,
+)
+
+
+class MysqlError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"[{code}] {message}")
+        self.code = code
+
+
+class MysqlClient:
+    def __init__(self, addr: str, user: str = "root", password: str = "", database: str = ""):
+        host, port = addr.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)), timeout=30)
+        self.io = _PacketIO(self.sock)
+        self._handshake(user, password, database)
+
+    def _handshake(self, user: str, password: str, database: str):
+        pkt = self.io.read_packet()
+        if pkt[0] == 0xFF:
+            raise self._err(pkt)
+        # HandshakeV10: version(1) server_version(nul) thread(4) auth1(8) 0x00
+        pos = 1
+        pos = pkt.index(b"\x00", pos) + 1
+        pos += 4
+        auth1 = pkt[pos : pos + 8]
+        pos += 9
+        pos += 2 + 1 + 2 + 2  # caps_lo, charset, status, caps_hi
+        alen = pkt[pos]
+        pos += 1 + 10
+        auth2 = pkt[pos : pos + max(13, alen - 8) - 1]
+        nonce = (auth1 + auth2)[:20]
+        caps = CLIENT_PROTOCOL_41 | CLIENT_SECURE_CONNECTION | CLIENT_PLUGIN_AUTH
+        if database:
+            caps |= CLIENT_CONNECT_WITH_DB
+        auth = native_password_scramble(password, nonce) if password else b""
+        out = bytearray()
+        out += struct.pack("<I", caps)
+        out += struct.pack("<I", 1 << 24)
+        out.append(0x21)
+        out += b"\x00" * 23
+        out += user.encode() + b"\x00"
+        out += bytes([len(auth)]) + auth
+        if database:
+            out += database.encode() + b"\x00"
+        out += b"mysql_native_password\x00"
+        self.io.send_packet(bytes(out))
+        pkt = self.io.read_packet()
+        if pkt[0] == 0xFF:
+            raise self._err(pkt)
+
+    def _err(self, pkt: bytes) -> MysqlError:
+        code = struct.unpack_from("<H", pkt, 1)[0]
+        msg = pkt[9:].decode(errors="replace") if pkt[3:4] == b"#" else pkt[3:].decode(errors="replace")
+        return MysqlError(code, msg)
+
+    def ping(self) -> bool:
+        self.io.reset_seq()
+        self.io.send_packet(b"\x0e")
+        return self.io.read_packet()[0] == 0x00
+
+    def query(self, sql: str):
+        """Run SQL; returns (columns, rows) for resultsets or affected-rows
+        int for OK responses."""
+        self.io.reset_seq()
+        self.io.send_packet(b"\x03" + sql.encode())
+        return self._read_response(binary=False)
+
+    def execute(self, sql: str, params: tuple = ()):
+        """Prepared-statement round trip (binary protocol)."""
+        self.io.reset_seq()
+        self.io.send_packet(b"\x16" + sql.encode())
+        pkt = self.io.read_packet()
+        if pkt[0] == 0xFF:
+            raise self._err(pkt)
+        stmt_id = struct.unpack_from("<I", pkt, 1)[0]
+        n_params = struct.unpack_from("<H", pkt, 7)[0]
+        for _ in range(n_params):
+            self.io.read_packet()  # param definitions
+        if n_params:
+            self.io.read_packet()  # EOF
+        out = bytearray(b"\x17")
+        out += struct.pack("<I", stmt_id)
+        out += b"\x00"
+        out += struct.pack("<I", 1)
+        if n_params:
+            bitmap = bytearray((n_params + 7) // 8)
+            types = bytearray()
+            values = bytearray()
+            for i, p in enumerate(params):
+                if p is None:
+                    bitmap[i // 8] |= 1 << (i % 8)
+                    types += bytes([MYSQL_TYPE_LONGLONG, 0])
+                elif isinstance(p, bool) or isinstance(p, int):
+                    types += bytes([MYSQL_TYPE_LONGLONG, 0])
+                    values += struct.pack("<q", int(p))
+                elif isinstance(p, float):
+                    types += bytes([MYSQL_TYPE_DOUBLE, 0])
+                    values += struct.pack("<d", p)
+                else:
+                    types += bytes([253, 0])
+                    values += _lenenc_str(str(p).encode())
+            out += bytes(bitmap) + b"\x01" + bytes(types) + bytes(values)
+        self.io.reset_seq()
+        self.io.send_packet(bytes(out))
+        return self._read_response(binary=True)
+
+    def _read_response(self, binary: bool):
+        pkt = self.io.read_packet()
+        if pkt[0] == 0xFF:
+            raise self._err(pkt)
+        if pkt[0] == 0x00:  # OK
+            affected, _ = _read_lenenc_int(pkt, 1)
+            return affected
+        ncols, _ = _read_lenenc_int(pkt, 0)
+        columns = []
+        col_types = []
+        for _ in range(ncols):
+            cp = self.io.read_packet()
+            pos = 0
+            vals = []
+            for _ in range(6):
+                ln, pos = _read_lenenc_int(cp, pos)
+                vals.append(cp[pos : pos + ln])
+                pos += ln
+            columns.append(vals[4].decode())
+            pos += 1 + 2 + 4  # marker, charset, length
+            col_types.append(cp[pos])
+        self.io.read_packet()  # EOF after columns
+        rows = []
+        while True:
+            rp = self.io.read_packet()
+            if rp[0] == 0xFE and len(rp) < 9:
+                break
+            rows.append(
+                self._decode_binary_row(rp, ncols, col_types)
+                if binary
+                else self._decode_text_row(rp, ncols)
+            )
+        return columns, rows
+
+    def _decode_text_row(self, rp: bytes, ncols: int):
+        row, pos = [], 0
+        for _ in range(ncols):
+            if rp[pos] == 0xFB:
+                row.append(None)
+                pos += 1
+            else:
+                ln, pos = _read_lenenc_int(rp, pos)
+                row.append(rp[pos : pos + ln].decode())
+                pos += ln
+        return row
+
+    def _decode_binary_row(self, rp: bytes, ncols: int, col_types):
+        bitmap_len = (ncols + 7 + 2) // 8
+        bitmap = rp[1 : 1 + bitmap_len]
+        pos = 1 + bitmap_len
+        row = []
+        for i in range(ncols):
+            bit = i + 2
+            if bitmap[bit // 8] & (1 << (bit % 8)):
+                row.append(None)
+                continue
+            t = col_types[i]
+            if t == MYSQL_TYPE_LONGLONG:
+                row.append(struct.unpack_from("<q", rp, pos)[0])
+                pos += 8
+            elif t == MYSQL_TYPE_DOUBLE:
+                row.append(struct.unpack_from("<d", rp, pos)[0])
+                pos += 8
+            elif t == MYSQL_TYPE_TIMESTAMP:
+                ln = rp[pos]
+                pos += 1
+                if ln >= 7:
+                    y, mo, d, h, mi, s = struct.unpack_from("<HBBBBB", rp, pos)
+                    us = struct.unpack_from("<I", rp, pos + 7)[0] if ln == 11 else 0
+                    import datetime
+
+                    row.append(datetime.datetime(y, mo, d, h, mi, s, us))
+                else:
+                    row.append(None)
+                pos += ln
+            else:
+                ln, pos = _read_lenenc_int(rp, pos)
+                row.append(rp[pos : pos + ln].decode())
+                pos += ln
+        return row
+
+    def close(self):
+        try:
+            self.io.reset_seq()
+            self.io.send_packet(b"\x01")
+        except OSError:
+            pass
+        self.sock.close()

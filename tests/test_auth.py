@@ -1,0 +1,55 @@
+"""Auth: user providers + permission checker (reference src/auth tests)."""
+
+import time
+
+import pytest
+
+from greptimedb_tpu.auth import (
+    PermissionChecker,
+    PermissionDenied,
+    StaticUserProvider,
+    WatchFileUserProvider,
+    user_provider_from_option,
+)
+from greptimedb_tpu.query.sql_parser import parse_sql
+
+
+def test_static_provider():
+    p = StaticUserProvider({"a": "pw"})
+    assert p.authenticate("a", "pw")
+    assert not p.authenticate("a", "no")
+    assert not p.authenticate("b", "pw")
+
+
+def test_option_parsing():
+    p = user_provider_from_option("static_user_provider:cmd:u1=p1,u2=p2")
+    assert p.password_of("u2") == "p2"
+    with pytest.raises(ValueError):
+        user_provider_from_option("bogus:whatever")
+
+
+def test_watch_file_hot_reload(tmp_path):
+    f = tmp_path / "users"
+    f.write_text("alice=one\n# comment\nbob=two\n")
+    p = WatchFileUserProvider(str(f))
+    assert p.password_of("alice") == "one"
+    time.sleep(0.01)
+    f.write_text("alice=changed\n")
+    import os
+
+    os.utime(f, (time.time() + 1, time.time() + 1))  # force mtime change
+    assert p.password_of("alice") == "changed"
+    assert p.password_of("bob") is None
+
+
+def test_permission_checker():
+    checker = PermissionChecker({"reader": {"write", "ddl"}, "*": {"admin"}})
+    select = parse_sql("SELECT 1")[0]
+    insert = parse_sql("INSERT INTO t VALUES (1)")[0]
+    admin = parse_sql("ADMIN flush_table('t')")[0]
+    checker.check("reader", select)
+    with pytest.raises(PermissionDenied):
+        checker.check("reader", insert)
+    checker.check("writer", insert)
+    with pytest.raises(PermissionDenied):
+        checker.check("writer", admin)
