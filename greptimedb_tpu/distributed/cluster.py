@@ -71,6 +71,18 @@ class Datanode:
     def region_stats(self) -> list:
         return [s.__dict__ for s in self.engine.region_statistics()]
 
+    def time_bounds(self, rid: int) -> tuple[int, int] | None:
+        region = self.engine.region(rid)
+        lo = hi = None
+        for fm in region.files():
+            lo = fm.time_range[0] if lo is None else min(lo, fm.time_range[0])
+            hi = fm.time_range[1] if hi is None else max(hi, fm.time_range[1])
+        r = region.memtable.time_range()
+        if r is not None:
+            lo = r[0] if lo is None else min(lo, r[0])
+            hi = r[1] if hi is None else max(hi, r[1])
+        return None if lo is None else (lo, hi)
+
     def kill(self):
         """Simulate crash: stop serving, drop in-memory state (the WAL and
         SSTs on shared storage survive)."""
@@ -97,14 +109,27 @@ class NodeManager:
 class Cluster:
     """Frontend facade + metasrv + datanodes in one process."""
 
-    def __init__(self, data_home: str, num_datanodes: int = 3, clock=None):
+    def __init__(
+        self,
+        data_home: str,
+        num_datanodes: int = 3,
+        clock=None,
+        transport: str = "inprocess",
+    ):
         self.data_home = data_home
         self.clock = clock or (lambda: _time.time() * 1000)
         self.kv = MemoryKvBackend()
         self.catalog = Catalog(os.path.join(data_home, "catalog.json"))
-        self.datanodes: dict[int, Datanode] = {
-            i: Datanode(i, data_home) for i in range(num_datanodes)
-        }
+        self.transport = transport
+        if transport == "flight":
+            # Real sockets: each datanode serves Arrow Flight on an ephemeral
+            # localhost port, the frontend talks through Flight clients
+            # (reference servers/src/grpc/flight.rs + client crate).
+            from .flight import FlightDatanode
+
+            self.datanodes = {i: FlightDatanode(i, data_home) for i in range(num_datanodes)}
+        else:
+            self.datanodes = {i: Datanode(i, data_home) for i in range(num_datanodes)}
         self.metasrv = Metasrv(self.kv, NodeManager(self))
         for i in self.datanodes:
             self.metasrv.register_datanode(i)
@@ -179,14 +204,11 @@ class Cluster:
         routes = self.metasrv.get_route(meta.table_id)
         lo = hi = None
         for rid in meta.region_ids:
-            region = self.datanodes[routes[rid]].engine.region(rid)
-            for fm in region.files():
-                lo = fm.time_range[0] if lo is None else min(lo, fm.time_range[0])
-                hi = fm.time_range[1] if hi is None else max(hi, fm.time_range[1])
-            r = region.memtable.time_range()
-            if r is not None:
-                lo = r[0] if lo is None else min(lo, r[0])
-                hi = r[1] if hi is None else max(hi, r[1])
+            b = self.datanodes[routes[rid]].time_bounds(rid)
+            if b is None:
+                continue
+            lo = b[0] if lo is None else min(lo, b[0])
+            hi = b[1] if hi is None else max(hi, b[1])
         return (lo or 0, hi or 0)
 
     def schema_of_region(self, rid: int) -> Schema | None:
@@ -222,5 +244,8 @@ class Cluster:
 
     def close(self):
         for dn in self.datanodes.values():
-            if dn.alive:
+            if self.transport == "flight":
+                if dn.alive:
+                    dn.shutdown()
+            elif dn.alive:
                 dn.engine.close()
