@@ -23,6 +23,7 @@ from .query.logical_plan import TableScan
 from .query.sql_parser import (
     AdminStmt,
     CreateDatabaseStmt,
+    CreateFlowStmt,
     CreateTableStmt,
     DeleteStmt,
     DescribeStmt,
@@ -65,6 +66,9 @@ class Database:
         self.catalog = Catalog(catalog_path)
 
         self.metric = MetricEngine(self)
+        from .flow.engine import FlowManager
+
+        self.flows = FlowManager(self)
         self.current_database = DEFAULT_SCHEMA
         self.query_engine = QueryEngine(
             schema_provider=self._schema_of,
@@ -76,6 +80,7 @@ class Database:
         self._reopen_regions()
 
     def close(self):
+        self.flows.stop()
         self.storage.close()
 
     # ---- SQL entry --------------------------------------------------------
@@ -99,6 +104,9 @@ class Database:
             return self._create_table(stmt)
         if isinstance(stmt, CreateDatabaseStmt):
             self.catalog.create_database(stmt.name, if_not_exists=stmt.if_not_exists)
+            return None
+        if isinstance(stmt, CreateFlowStmt):
+            self.flows.create_flow(stmt, self.current_database)
             return None
         if isinstance(stmt, DropStmt):
             return self._drop(stmt)
@@ -224,6 +232,9 @@ class Database:
         return None
 
     def _drop(self, stmt: DropStmt):
+        if stmt.kind == "flow":
+            self.flows.drop_flow(stmt.name, if_exists=stmt.if_exists)
+            return None
         if stmt.kind == "database":
             for meta in self.catalog.tables(stmt.name):
                 for rid in meta.region_ids:
@@ -267,9 +278,11 @@ class Database:
         batch = pa.RecordBatch.from_arrays(arrays, schema=schema.to_arrow())
         return self.write_batch(meta, batch)
 
-    def write_batch(self, meta, batch: pa.RecordBatch) -> int:
+    def write_batch(self, meta, batch: pa.RecordBatch, mirror: bool = True) -> int:
         """Route rows to regions via the partition rule and write each
-        (the reference Inserter fan-out)."""
+        (the reference Inserter fan-out).  `mirror` feeds flows on the
+        source table (reference FlowMirrorTask, insert.rs:397-406); flow
+        sink writes pass mirror=False to avoid self-feeding."""
 
         if is_logical_meta(meta):
             return self.metric.write_logical(meta, batch)
@@ -282,6 +295,8 @@ class Database:
             rid = region_id(meta.table_id, i)
             for b in part.to_batches():
                 affected += self.storage.write(rid, b)
+        if mirror and self.flows.infos:
+            self.flows.mirror_insert(meta.name, meta.database, table)
         return affected
 
     # ---- ingest API (line-protocol style, used by servers/) ---------------
@@ -315,6 +330,28 @@ class Database:
         if stmt.what == "create_table":
             meta = self.catalog.table(stmt.target, self.current_database)
             return pa.table({"Table": [meta.name], "Create Table": [_render_create(meta)]})
+        if stmt.what == "flows":
+            flows = self.flows.list_flows()
+            if stmt.like:
+                import fnmatch
+
+                flows = [f for f in flows if fnmatch.fnmatch(f.name, stmt.like.replace("%", "*"))]
+            return pa.table({"Flows": [f.name for f in flows]})
+        if stmt.what == "create_flow":
+            info = self.flows.infos.get(stmt.target)
+            if info is None:
+                from .utils.errors import FlowNotFoundError
+
+                raise FlowNotFoundError(f"flow not found: {stmt.target}")
+            parts = [f"CREATE FLOW {info.name}", f"SINK TO {info.sink_table}"]
+            if info.expire_after_ms is not None:
+                parts.append(f"EXPIRE AFTER '{info.expire_after_ms // 1000}s'")
+            if info.eval_interval_ms is not None:
+                parts.append(f"EVAL INTERVAL '{info.eval_interval_ms // 1000}s'")
+            if info.comment:
+                parts.append(f"COMMENT '{info.comment}'")
+            parts.append(f"AS {info.sql}")
+            return pa.table({"Flow": [info.name], "Create Flow": [" ".join(parts)]})
         raise UnsupportedError(f"unsupported SHOW {stmt.what}")
 
     def _describe(self, stmt: DescribeStmt):
@@ -366,6 +403,9 @@ class Database:
                 )
             for rid in meta.region_ids:
                 compact_region(self.storage.region(rid))
+            return pa.table({"result": [0]})
+        if f == "flush_flow":
+            self.flows.flush_flow(str(stmt.args[0]))
             return pa.table({"result": [0]})
         raise UnsupportedError(f"unknown admin function: {stmt.func}")
 

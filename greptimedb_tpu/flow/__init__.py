@@ -1,0 +1,11 @@
+"""Flow engine: incremental materialized views over streaming ingest.
+
+Role-equivalent of the reference's `flow` crate (src/flow/src/): CREATE FLOW
+compiles a SELECT over a source table into a continuously-maintained sink
+table, fed by inserts mirrored from the write path (reference
+operator/src/insert.rs:397-406 `FlowMirrorTask`).
+"""
+
+from .engine import BatchingFlowTask, FlowInfo, FlowManager, StreamingFlowTask
+
+__all__ = ["FlowManager", "FlowInfo", "StreamingFlowTask", "BatchingFlowTask"]

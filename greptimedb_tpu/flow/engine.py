@@ -1,0 +1,664 @@
+"""Flow engine: streaming (incremental) and batching (dirty-window) tasks.
+
+Two execution modes, mirroring the reference (src/flow/src/):
+
+* **StreamingFlowTask** — the reference's StreamingEngine (`adapter.rs:160`,
+  Hydroflow-inspired `repr::DiffRow` dataflow): keeps decomposable aggregate
+  state per group key in memory and folds every mirrored insert batch into
+  it, then upserts the touched groups into the sink table.  Only plans whose
+  aggregates are incrementally maintainable (sum/count/min/max/avg) take
+  this path.
+
+* **BatchingFlowTask** — the reference's BatchingEngine
+  (`batching_mode/engine.rs:59-178`): mirrored inserts only mark dirty time
+  windows; on `tick()` (or ADMIN flush_flow) the stored SQL is re-planned
+  with a time-range filter covering the dirty windows and the result is
+  upserted into the sink.  Handles arbitrary SELECTs.
+
+Upsert semantics come for free from the storage engine's last-write-wins
+dedup on (primary key, time index) — the same reason the reference sinks
+into ordinary mito tables.
+
+Flow definitions persist in `flows.json` under the data home (the reference
+stores them in flow metadata keys, common/meta/src/key/flow/); streaming
+state is in-memory and rebuilt from fresh ingest after restart, as in the
+reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time as _time
+from dataclasses import dataclass, field
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..datatypes.schema import ColumnSchema, ConcreteDataType, Schema, SemanticType
+from ..query.expr import AggCall, Alias, BinaryOp, Column, Expr, FuncCall, Literal
+from ..query.sql_parser import CreateFlowStmt, SelectStmt, parse_sql
+from ..utils.errors import (
+    FlowAlreadyExistsError,
+    FlowNotFoundError,
+    InvalidArgumentsError,
+    TableNotFoundError,
+    UnsupportedError,
+)
+
+_STREAMABLE_AGGS = {"sum", "count", "min", "max", "avg"}
+UPDATE_AT = "update_at"
+# Constant time index for sinks whose query has no time-window key: dedup on
+# (tags, 0) gives upsert semantics while `update_at` records freshness —
+# exactly the reference's `__ts_placeholder` trick (flow/src/adapter/table_source.rs).
+TS_PLACEHOLDER = "__ts_placeholder"
+
+
+@dataclass
+class FlowInfo:
+    flow_id: int
+    name: str
+    source_table: str
+    sink_table: str
+    database: str
+    sql: str
+    mode: str  # streaming | batching
+    expire_after_ms: int | None = None
+    eval_interval_ms: int | None = None
+    comment: str | None = None
+    created_at_ms: int = 0
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FlowInfo":
+        return cls(**d)
+
+
+def _strip_alias(e: Expr) -> Expr:
+    return e.expr if isinstance(e, Alias) else e
+
+
+def _is_streamable(stmt: SelectStmt) -> bool:
+    """Streaming handles: single-table SELECT of group-by keys + decomposable
+    aggregates, no HAVING/ORDER/LIMIT (reference transform/ restricts the
+    streaming plan class similarly)."""
+    if stmt.table is None or stmt.having is not None or stmt.order_by or stmt.limit:
+        return False
+    if stmt.align is not None:
+        return False
+    group_names = {g.name() for g in stmt.group_by}
+    has_agg = False
+    for p in stmt.projections:
+        inner = _strip_alias(p)
+        if isinstance(inner, AggCall):
+            if inner.func not in _STREAMABLE_AGGS or inner.range_ms is not None:
+                return False
+            has_agg = True
+        elif inner.name() not in group_names:
+            return False
+    return has_agg
+
+
+def _time_window_ms(stmt: SelectStmt) -> int | None:
+    """Window size from a date_bin/time_bucket group-by expr, if any
+    (reference batching_mode derives the dirty-window granularity from the
+    plan's time window expr, `batching_mode/time_window.rs`)."""
+    from ..query.cpu_exec import _interval_ms
+
+    for g in stmt.group_by:
+        g = _strip_alias(g)
+        if isinstance(g, FuncCall) and g.func in ("date_bin", "time_bucket"):
+            try:
+                return _interval_ms(g.args[0], None)
+            except Exception:
+                return None
+    return None
+
+
+class _AggState:
+    """Decomposable accumulator per (group, agg) — the lower/state half of
+    the reference's two-step aggregates (query/src/dist_plan/commutativity.rs:45)."""
+
+    __slots__ = ("sum", "count", "min", "max")
+
+    def __init__(self):
+        self.sum = 0.0
+        self.count = 0
+        self.min = None
+        self.max = None
+
+    def update(self, values: np.ndarray):
+        if values.size == 0:
+            return
+        self.sum += float(np.nansum(values))
+        self.count += int(np.sum(~np.isnan(values))) if values.dtype.kind == "f" else values.size
+        mn, mx = float(np.nanmin(values)), float(np.nanmax(values))
+        self.min = mn if self.min is None else min(self.min, mn)
+        self.max = mx if self.max is None else max(self.max, mx)
+
+    def get(self, func: str):
+        if func == "sum":
+            return self.sum
+        if func == "count":
+            return self.count
+        if func == "avg":
+            return self.sum / self.count if self.count else None
+        if func == "min":
+            return self.min
+        return self.max
+
+
+class StreamingFlowTask:
+    def __init__(self, info: FlowInfo, db):
+        self.info = info
+        self.db = db
+        self.stmt: SelectStmt = parse_sql(info.sql)[0]
+        self.aggs: list[tuple[AggCall, str]] = []
+        self.key_names: list[str] = []
+        proj_by_expr: dict = {}
+        for p in self.stmt.projections:
+            inner = _strip_alias(p)
+            if isinstance(inner, AggCall):
+                self.aggs.append((inner, p.name()))
+            else:
+                self.key_names.append(p.name())
+                proj_by_expr[inner] = p.name()
+        # group-by exprs carry their projection's output alias when one
+        # matches structurally (frozen dataclass equality)
+        self.group_exprs = [
+            (_strip_alias(g), proj_by_expr.get(_strip_alias(g), g.name()))
+            for g in self.stmt.group_by
+        ]
+        # state: group key tuple -> [per-agg _AggState]
+        self.state: dict[tuple, list[_AggState]] = {}
+        self._lock = threading.Lock()
+
+    # -- fold one mirrored batch -------------------------------------------
+    def on_insert(self, table: pa.Table, now_ms: int):
+        from ..query.cpu_exec import eval_expr
+
+        if self.stmt.where is not None:
+            mask = eval_expr(self.stmt.where, table)
+            table = table.filter(mask)
+        if table.num_rows == 0:
+            return
+        key_cols = []
+        for expr, _name in self.group_exprs:
+            arr = eval_expr(expr, table)
+            if not isinstance(arr, (pa.Array, pa.ChunkedArray)):
+                arr = pa.array([arr] * table.num_rows)
+            key_cols.append(arr.to_pylist() if hasattr(arr, "to_pylist") else list(arr))
+        agg_inputs = []
+        for agg, _name in self.aggs:
+            if agg.arg is None:
+                agg_inputs.append(np.ones(table.num_rows))
+            else:
+                arr = eval_expr(agg.arg, table)
+                np_arr = np.asarray(arr.to_pylist() if hasattr(arr, "to_pylist") else arr, dtype=float)
+                agg_inputs.append(np_arr)
+        touched: set[tuple] = set()
+        with self._lock:
+            rows = range(table.num_rows)
+            keys = list(zip(*key_cols)) if key_cols else [() for _ in rows]
+            by_key: dict[tuple, list[int]] = {}
+            for i, k in enumerate(keys):
+                by_key.setdefault(k, []).append(i)
+            for k, idxs in by_key.items():
+                states = self.state.get(k)
+                if states is None:
+                    states = [_AggState() for _ in self.aggs]
+                    self.state[k] = states
+                sel = np.asarray(idxs)
+                for j, (agg, _n) in enumerate(self.aggs):
+                    vals = agg_inputs[j][sel]
+                    if agg.func == "count" and agg.arg is None:
+                        states[j].count += len(sel)
+                        states[j].sum += len(sel)
+                    else:
+                        states[j].update(vals)
+                touched.add(k)
+            self._expire(now_ms)
+        if touched:
+            self._emit(touched, now_ms)
+
+    def _time_key_index(self) -> int | None:
+        for i, (expr, _name) in enumerate(self.group_exprs):
+            if isinstance(expr, FuncCall) and expr.func in ("date_bin", "time_bucket"):
+                return i
+            if isinstance(expr, Column):
+                src = self._source_schema()
+                col = src.column(expr.column) if src.has_column(expr.column) else None
+                if col is not None and col.semantic_type == SemanticType.TIMESTAMP:
+                    return i
+        return None
+
+    def _source_schema(self) -> Schema:
+        return self.db.catalog.table(self.info.source_table, self.info.database).schema
+
+    def _expire(self, now_ms: int):
+        if self.info.expire_after_ms is None:
+            return
+        ti = self._time_key_index()
+        if ti is None:
+            return
+        horizon = now_ms - self.info.expire_after_ms
+        dead = [k for k in self.state if _as_ms(k[ti]) < horizon]
+        for k in dead:
+            del self.state[k]
+
+    # -- write touched groups into the sink --------------------------------
+    def _emit(self, touched: set[tuple], now_ms: int):
+        cols: dict[str, list] = {n: [] for n in self.key_names}
+        for _agg, name in self.aggs:
+            cols[name] = []
+        # snapshot accumulator values under the lock: servers ingest from
+        # multiple threads and _AggState fields are not individually atomic
+        with self._lock:
+            for k in sorted(touched, key=lambda t: tuple(str(x) for x in t)):
+                states = self.state.get(k)
+                if states is None:
+                    continue  # expired between touch and emit
+                for (_, name), v in zip(self.group_exprs, k):
+                    if name in cols:
+                        cols[name].append(v)
+                for j, (agg, name) in enumerate(self.aggs):
+                    cols[name].append(states[j].get(agg.func))
+        n_out = len(next(iter(cols.values()))) if cols else 0
+        if n_out == 0:
+            return
+        sink_schema = self._ensure_sink(cols)
+        batch = _sink_batch(sink_schema, cols, n_out, now_ms)
+        meta = self.db.catalog.table(self.info.sink_table, self.info.database)
+        self.db.write_batch(meta, batch, mirror=False)
+
+    def _ensure_sink(self, cols: dict[str, list]) -> Schema:
+        return _ensure_sink_table(
+            self.db,
+            self.info,
+            key_names=self.key_names,
+            agg_names=[n for _a, n in self.aggs],
+            sample_cols=cols,
+            time_key=self._time_key_name(),
+        )
+
+    def _time_key_name(self) -> str | None:
+        ti = self._time_key_index()
+        return None if ti is None else self.group_exprs[ti][1]
+
+    def flush(self, now_ms: int):
+        with self._lock:
+            touched = set(self.state.keys())
+        if touched:
+            self._emit(touched, now_ms)
+
+
+class BatchingFlowTask:
+    def __init__(self, info: FlowInfo, db):
+        self.info = info
+        self.db = db
+        self.stmt: SelectStmt = parse_sql(info.sql)[0]
+        self.window_ms = _time_window_ms(self.stmt) or 3_600_000
+        self.dirty: set[int] = set()  # window start ms
+        self.last_eval_ms = 0
+        self._lock = threading.Lock()
+        # group-key output names (projection aliases for group-by exprs) so
+        # the auto-created sink marks only true keys as tags
+        proj_by_expr = {
+            _strip_alias(p): p.name()
+            for p in self.stmt.projections
+            if not isinstance(_strip_alias(p), AggCall)
+        }
+        self.key_names = [
+            proj_by_expr.get(_strip_alias(g), g.name()) for g in self.stmt.group_by
+        ]
+
+    def on_insert(self, table: pa.Table, now_ms: int):
+        """Mark dirty windows from the inserted timestamps (reference
+        batching_mode/engine.rs:94-178 `mark_dirty_time_window`)."""
+        src = self.db.catalog.table(self.info.source_table, self.info.database).schema
+        ts_col = src.time_index
+        if ts_col is None or ts_col.name not in table.column_names:
+            return
+        from ..query.cpu_exec import _ts_to_ms
+
+        ts = _ts_to_ms(table.column(ts_col.name))
+        with self._lock:
+            for w in np.unique(ts // self.window_ms):
+                self.dirty.add(int(w) * self.window_ms)
+
+    def due(self, now_ms: int) -> bool:
+        interval = self.info.eval_interval_ms or 10_000
+        return bool(self.dirty) and now_ms - self.last_eval_ms >= interval
+
+    def tick(self, now_ms: int, force: bool = False):
+        with self._lock:
+            if not self.dirty or (not force and not self.due(now_ms)):
+                return False
+            windows = sorted(self.dirty)
+            self.dirty.clear()
+            self.last_eval_ms = now_ms
+        if self.info.expire_after_ms is not None:
+            horizon = now_ms - self.info.expire_after_ms
+            windows = [w for w in windows if w + self.window_ms > horizon]
+            if not windows:
+                return False
+        src = self.db.catalog.table(self.info.source_table, self.info.database).schema
+        ts_col = src.time_index
+        ts_name = ts_col.name
+        # the executor compares the time index in its NATIVE unit, so the
+        # injected ms bounds must be rescaled for s/us/ns time indexes
+        unit = ts_col.to_arrow().type.unit if pa.types.is_timestamp(ts_col.to_arrow().type) else "ms"
+        stmt = parse_sql(self.info.sql)[0]
+        # contiguous dirty ranges -> one re-run each with an injected ts filter
+        ranges = _coalesce_windows(windows, self.window_ms)
+        for lo, hi in ranges:
+            bound = BinaryOp(
+                "and",
+                BinaryOp(">=", Column(ts_name), Literal(_ms_to_native(lo, unit, ceil=False))),
+                BinaryOp("<", Column(ts_name), Literal(_ms_to_native(hi, unit, ceil=True))),
+            )
+            stmt2 = parse_sql(self.info.sql)[0]
+            stmt2.where = bound if stmt.where is None else BinaryOp("and", stmt.where, bound)
+            result = self.db.query_engine.execute_select(stmt2, self.info.database)
+            if result.num_rows == 0:
+                continue
+            self._upsert(result, now_ms)
+        return True
+
+    def _upsert(self, result: pa.Table, now_ms: int):
+        cols = {name: result.column(i).to_pylist() for i, name in enumerate(result.column_names)}
+        time_key = None
+        for name, col_type in zip(result.column_names, result.schema.types):
+            if pa.types.is_timestamp(col_type):
+                time_key = name
+                break
+        sink_schema = _ensure_sink_table(
+            self.db,
+            self.info,
+            key_names=self.key_names,
+            agg_names=[n for n in result.column_names if n not in self.key_names],
+            sample_cols=cols,
+            time_key=time_key,
+            arrow_schema=result.schema,
+        )
+        batch = _sink_batch(sink_schema, cols, result.num_rows, now_ms)
+        meta = self.db.catalog.table(self.info.sink_table, self.info.database)
+        self.db.write_batch(meta, batch, mirror=False)
+
+    def flush(self, now_ms: int):
+        self.tick(now_ms, force=True)
+
+
+def _coalesce_windows(windows: list[int], width: int) -> list[tuple[int, int]]:
+    out: list[tuple[int, int]] = []
+    for w in windows:
+        if out and out[-1][1] == w:
+            out[-1] = (out[-1][0], w + width)
+        else:
+            out.append((w, w + width))
+    return out
+
+
+def _ms_to_native(ms: int, unit: str, ceil: bool) -> int:
+    """Rescale an epoch-ms bound into the time index's native unit."""
+    if unit == "s":
+        return (ms + 999) // 1000 if ceil else ms // 1000
+    factor = {"ms": 1, "us": 1000, "ns": 1_000_000}[unit]
+    return ms * factor
+
+
+def _as_ms(v) -> int:
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if hasattr(v, "timestamp"):
+        return int(v.timestamp() * 1000)
+    return 0
+
+
+def _sink_batch(sink_schema: Schema, cols: dict[str, list], n_out: int, now_ms: int) -> pa.RecordBatch:
+    arrays = []
+    for col in sink_schema.columns:
+        if col.name in cols:
+            arrays.append(_coerce(cols[col.name], col))
+        elif col.name == UPDATE_AT:
+            arrays.append(pa.array([now_ms] * n_out, pa.timestamp("ms")))
+        elif col.semantic_type == SemanticType.TIMESTAMP:
+            # pre-existing sink with a time index the flow doesn't produce
+            # (or our TS_PLACEHOLDER): pin to epoch so dedup keys stay stable
+            arrays.append(pa.array([0] * n_out, col.to_arrow().type))
+        else:
+            # pre-existing sink with extra columns: null-fill instead of
+            # failing the whole mirrored insert
+            arrays.append(pa.nulls(n_out, col.to_arrow().type))
+    return pa.RecordBatch.from_arrays(arrays, schema=sink_schema.to_arrow())
+
+
+def _coerce(values: list, col: ColumnSchema) -> pa.Array:
+    target = col.to_arrow().type
+    try:
+        return pa.array(values, target)
+    except (pa.ArrowInvalid, pa.ArrowTypeError):
+        arr = pa.array(values)
+        return pc.cast(arr, target)
+
+
+def _ensure_sink_table(
+    db,
+    info: FlowInfo,
+    key_names: list[str],
+    agg_names: list[str],
+    sample_cols: dict[str, list],
+    time_key: str | None,
+    arrow_schema: pa.Schema | None = None,
+) -> Schema:
+    """Auto-create the sink table from the flow's output shape (the
+    reference auto-creates sink tables on flow creation,
+    flow/src/adapter.rs `create_table_from_relation`)."""
+    try:
+        return db.catalog.table(info.sink_table, info.database).schema
+    except TableNotFoundError:
+        pass
+    columns: list[ColumnSchema] = []
+    names = list(sample_cols.keys())
+    for name in names:
+        if arrow_schema is not None and name in arrow_schema.names:
+            pa_type = arrow_schema.field(name).type
+        else:
+            pa_type = pa.array([v for v in sample_cols[name] if v is not None] or [0.0]).type
+        if name == time_key:
+            dt, sem = ConcreteDataType.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP
+        elif name in key_names and name != time_key:
+            if pa.types.is_string(pa_type) or pa.types.is_large_string(pa_type):
+                dt, sem = ConcreteDataType.STRING, SemanticType.TAG
+            elif pa.types.is_timestamp(pa_type):
+                dt, sem = ConcreteDataType.TIMESTAMP_MILLISECOND, SemanticType.FIELD
+            elif pa.types.is_integer(pa_type):
+                dt, sem = ConcreteDataType.INT64, SemanticType.TAG
+            else:
+                dt, sem = ConcreteDataType.FLOAT64, SemanticType.FIELD
+        else:
+            dt, sem = ConcreteDataType.FLOAT64, SemanticType.FIELD
+        columns.append(
+            ColumnSchema(name, dt, sem, nullable=sem == SemanticType.FIELD)
+        )
+    if time_key is None:
+        columns.append(
+            ColumnSchema(
+                UPDATE_AT,
+                ConcreteDataType.TIMESTAMP_MILLISECOND,
+                SemanticType.FIELD,
+                nullable=True,
+            )
+        )
+        columns.append(
+            ColumnSchema(
+                TS_PLACEHOLDER, ConcreteDataType.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP
+            )
+        )
+    schema = Schema(columns=columns)
+    meta = db.catalog.create_table(
+        info.sink_table, schema, database=info.database, if_not_exists=True
+    )
+    for rid in meta.region_ids:
+        db.storage.create_region(rid, schema)
+    return schema
+
+
+class FlowManager:
+    """Owns all flows; mirrors inserts; persists definitions (reference
+    flow/src/adapter.rs FlowStreamingEngine + common/meta flow keys)."""
+
+    def __init__(self, db, clock=None):
+        self.db = db
+        self.clock = clock or (lambda: int(_time.time() * 1000))
+        self.flows: dict[str, object] = {}  # name -> task
+        self.infos: dict[str, FlowInfo] = {}
+        self._by_source: dict[tuple[str, str], list[str]] = {}
+        self._next_id = 1
+        self._path = os.path.join(db.config.storage.data_home, "flows.json")
+        self.last_error: str | None = None
+        self._ticker: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._load()
+
+    # -- DDL ----------------------------------------------------------------
+    def create_flow(self, stmt: CreateFlowStmt, database: str) -> FlowInfo:
+        # validate the new definition BEFORE touching any existing flow so a
+        # failed CREATE OR REPLACE leaves the old flow intact
+        if stmt.query.table is None:
+            raise InvalidArgumentsError("flow query must read FROM a source table")
+        source_db = stmt.query.database or database
+        self.db.catalog.table(stmt.query.table, source_db)  # must exist
+        if stmt.name in self.flows:
+            if stmt.if_not_exists:
+                return self.infos[stmt.name]
+            if not stmt.or_replace:
+                raise FlowAlreadyExistsError(f"flow already exists: {stmt.name}")
+            self.drop_flow(stmt.name)
+        mode = (
+            "batching"
+            if stmt.eval_interval_ms is not None or not _is_streamable(stmt.query)
+            else "streaming"
+        )
+        info = FlowInfo(
+            flow_id=self._next_id,
+            name=stmt.name,
+            source_table=stmt.query.table,
+            sink_table=stmt.sink_table,
+            database=source_db,
+            sql=stmt.query_sql,
+            mode=mode,
+            expire_after_ms=stmt.expire_after_ms,
+            eval_interval_ms=stmt.eval_interval_ms,
+            comment=stmt.comment,
+            created_at_ms=self.clock(),
+        )
+        self._next_id += 1
+        self._register(info)
+        self._save()
+        return info
+
+    def _register(self, info: FlowInfo):
+        task = (
+            StreamingFlowTask(info, self.db)
+            if info.mode == "streaming"
+            else BatchingFlowTask(info, self.db)
+        )
+        self.flows[info.name] = task
+        self.infos[info.name] = info
+        self._by_source.setdefault((info.source_table, info.database), []).append(info.name)
+        if info.mode == "batching":
+            self._ensure_ticker()
+
+    def _ensure_ticker(self):
+        """Background eval loop for batching flows (reference
+        batching_mode/task.rs spawns a periodic eval task per flow)."""
+        if self._ticker is not None and self._ticker.is_alive():
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(1.0):
+                try:
+                    self.tick()
+                except Exception as e:  # keep the loop alive
+                    self.last_error = f"tick: {e}"
+
+        self._ticker = threading.Thread(target=loop, daemon=True, name="flow-ticker")
+        self._ticker.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=2.0)
+            self._ticker = None
+
+    def drop_flow(self, name: str, if_exists: bool = False):
+        if name not in self.flows:
+            if if_exists:
+                return
+            raise FlowNotFoundError(f"flow not found: {name}")
+        info = self.infos.pop(name)
+        self.flows.pop(name)
+        key = (info.source_table, info.database)
+        self._by_source[key] = [n for n in self._by_source.get(key, []) if n != name]
+        self._save()
+
+    def flush_flow(self, name: str) -> int:
+        if name not in self.flows:
+            raise FlowNotFoundError(f"flow not found: {name}")
+        self.flows[name].flush(self.clock())
+        return 0
+
+    # -- data plane ---------------------------------------------------------
+    def mirror_insert(self, table: str, database: str, batch: pa.RecordBatch | pa.Table):
+        """Called from the write path for every user insert (reference
+        FlowMirrorTask, operator/src/insert.rs:397)."""
+        names = self._by_source.get((table, database))
+        if not names:
+            return
+        t = pa.Table.from_batches([batch]) if isinstance(batch, pa.RecordBatch) else batch
+        now = self.clock()
+        for n in list(names):
+            # mirroring is best-effort (the reference detaches FlowMirrorTask):
+            # a broken flow must not fail the user's insert
+            try:
+                self.flows[n].on_insert(t, now)
+            except Exception as e:
+                self.last_error = f"flow {n}: {e}"
+
+    def tick(self):
+        """Periodic driver for batching flows (reference batching engine's
+        eval loop, batching_mode/task.rs)."""
+        now = self.clock()
+        for task in self.flows.values():
+            if isinstance(task, BatchingFlowTask):
+                task.tick(now)
+
+    # -- introspection ------------------------------------------------------
+    def list_flows(self) -> list[FlowInfo]:
+        return sorted(self.infos.values(), key=lambda i: i.flow_id)
+
+    # -- persistence --------------------------------------------------------
+    def _save(self):
+        data = {
+            "next_id": self._next_id,
+            "flows": [i.to_dict() for i in self.infos.values()],
+        }
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self._path)
+
+    def _load(self):
+        if not os.path.exists(self._path):
+            return
+        with open(self._path) as f:
+            data = json.load(f)
+        self._next_id = data.get("next_id", 1)
+        for d in data.get("flows", []):
+            self._register(FlowInfo.from_dict(d))

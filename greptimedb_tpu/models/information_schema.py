@@ -140,6 +140,26 @@ def _partitions(db) -> pa.Table:
     return pa.table(rows)
 
 
+def _flows(db) -> pa.Table:
+    """information_schema.flows (reference
+    catalog/src/system_schema/information_schema/flows.rs)."""
+    infos = db.flows.list_flows() if hasattr(db, "flows") else []
+    return pa.table(
+        {
+            "flow_name": [i.name for i in infos],
+            "flow_id": [i.flow_id for i in infos],
+            "state_size": [0 for _ in infos],
+            "table_catalog": ["greptime" for _ in infos],
+            "flow_definition": [i.sql for i in infos],
+            "comment": [i.comment or "" for i in infos],
+            "expire_after": [i.expire_after_ms for i in infos],
+            "source_table_names": [i.source_table for i in infos],
+            "sink_table_name": [i.sink_table for i in infos],
+            "options": [i.mode for i in infos],
+        }
+    )
+
+
 _TABLES = {
     "tables": _tables,
     "columns": _columns,
@@ -148,6 +168,7 @@ _TABLES = {
     "cluster_info": _cluster_info,
     "schemata": _schemata,
     "partitions": _partitions,
+    "flows": _flows,
 }
 
 

@@ -152,8 +152,8 @@ def _eval_func(e: FuncCall, table: pa.Table):
             origin = o.as_py() if isinstance(o, pa.Scalar) else 0
         t_int = pc.cast(ts, pa.int64())
         unit = ts.type.unit if pa.types.is_timestamp(ts.type) else "ms"
-        unit_ms = {"s": 0.001, "ms": 1, "us": 1000, "ns": 1_000_000}[unit]
-        iv_native = max(int(interval / unit_ms), 1) if unit_ms >= 1 else int(interval * 1000)
+        units_per_ms = {"s": 0.001, "ms": 1, "us": 1000, "ns": 1_000_000}[unit]
+        iv_native = max(int(interval * units_per_ms), 1)
         bucketed = pc.multiply(pc.floor(pc.divide(pc.subtract(t_int, origin), iv_native)), iv_native)
         bucketed = pc.add(pc.cast(bucketed, pa.int64()), origin)
         return pc.cast(bucketed, ts.type if pa.types.is_timestamp(ts.type) else pa.int64())

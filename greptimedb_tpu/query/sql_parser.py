@@ -133,9 +133,25 @@ class CreateDatabaseStmt:
 
 @dataclass
 class DropStmt:
-    kind: str  # table|database
+    kind: str  # table|database|flow|view
     name: str
     if_exists: bool = False
+
+
+@dataclass
+class CreateFlowStmt:
+    """`CREATE FLOW name SINK TO sink [EXPIRE AFTER i] [EVAL INTERVAL i]
+    [COMMENT '...'] AS SELECT ...` (reference sql/src/statements/create.rs:596)."""
+
+    name: str
+    sink_table: str
+    query: "SelectStmt"
+    query_sql: str  # raw SELECT text (persisted; batching mode re-plans it)
+    if_not_exists: bool = False
+    or_replace: bool = False
+    expire_after_ms: int | None = None
+    eval_interval_ms: int | None = None
+    comment: str | None = None
 
 
 @dataclass
@@ -641,6 +657,12 @@ class Parser:
     # ---- CREATE -----------------------------------------------------------
     def parse_create(self):
         self.expect_kw("create")
+        or_replace = False
+        if self.eat_kw("or"):
+            self.expect_kw("replace")
+            or_replace = True
+        if self.eat_kw("flow"):
+            return self.parse_create_flow(or_replace)
         if self.eat_kw("database", "schema"):
             ine = self._if_not_exists()
             return CreateDatabaseStmt(self.ident(), if_not_exists=ine)
@@ -755,11 +777,55 @@ class Parser:
         return False
 
     # ---- DROP / INSERT / SHOW / TQL --------------------------------------
+    def parse_create_flow(self, or_replace: bool) -> CreateFlowStmt:
+        ine = self._if_not_exists()
+        name = self.ident()
+        self.expect_kw("sink")
+        self.expect_kw("to")
+        sink = self.ident()
+        expire_after = eval_interval = comment = None
+        while True:
+            if self.eat_kw("expire"):
+                self.expect_kw("after")
+                expire_after = self._interval_value()
+            elif self.eat_kw("eval"):
+                self.expect_kw("interval")
+                eval_interval = self._interval_value()
+            elif self.eat_kw("comment"):
+                comment = self.next().value.strip("'")
+            else:
+                break
+        self.expect_kw("as")
+        start_pos = self.peek().pos
+        query = self.parse_select()
+        end_pos = self.peek().pos if self.peek().kind != "eof" else len(self.sql)
+        raw = self.sql[start_pos:end_pos].strip().rstrip(";").strip()
+        return CreateFlowStmt(
+            name=name,
+            sink_table=sink,
+            query=query,
+            query_sql=raw,
+            if_not_exists=ine,
+            or_replace=or_replace,
+            expire_after_ms=expire_after,
+            eval_interval_ms=eval_interval,
+            comment=comment,
+        )
+
+    def _interval_value(self) -> int:
+        """An interval literal: '1h' (string) or a bare number of seconds."""
+        t = self.next()
+        if t.kind == "string":
+            return _parse_interval(t.value[1:-1])
+        return int(float(t.value) * 1000)
+
     def parse_drop(self):
         self.expect_kw("drop")
         kind = "table"
         if self.eat_kw("database", "schema"):
             kind = "database"
+        elif self.eat_kw("flow"):
+            kind = "flow"
         else:
             self.expect_kw("table")
         if_exists = False
@@ -802,7 +868,14 @@ class Parser:
             return ShowStmt("tables", like=like)
         if self.eat_kw("databases", "schemas"):
             return ShowStmt("databases")
+        if self.eat_kw("flows"):
+            like = None
+            if self.eat_kw("like"):
+                like = self.next().value.strip("'")
+            return ShowStmt("flows", like=like)
         if self.eat_kw("create"):
+            if self.eat_kw("flow"):
+                return ShowStmt("create_flow", target=self.ident())
             self.expect_kw("table")
             return ShowStmt("create_table", target=self.ident())
         raise InvalidSyntaxError(f"unsupported SHOW near {self.peek().value!r}")

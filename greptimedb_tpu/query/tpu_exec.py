@@ -154,8 +154,8 @@ class TpuExecutor:
             else:
                 lo, hi = time_bounds()
                 hi += 1  # bounds are inclusive; range is half-open
-            unit_ms = schema.time_index.data_type.timestamp_unit_ns() // 1_000_000
-            interval_native = max(interval // max(unit_ms, 1), 1)
+            unit_ns = schema.time_index.data_type.timestamp_unit_ns()
+            interval_native = max(int(interval * 1_000_000) // max(unit_ns, 1), 1)
             origin = origin_hint + ((lo - origin_hint) // interval_native) * interval_native
             n_buckets = max(int((hi - origin + interval_native - 1) // interval_native), 1)
             bucket_col = ts_col

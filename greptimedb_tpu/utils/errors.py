@@ -52,6 +52,9 @@ class StatusCode(enum.IntEnum):
     ACCESS_DENIED = 7005
     PERMISSION_DENIED = 7006
 
+    FLOW_ALREADY_EXISTS = 8000
+    FLOW_NOT_FOUND = 8001
+
 
 class GreptimeError(Exception):
     """Base error carrying a StatusCode, like the reference's ErrorExt."""
@@ -126,3 +129,11 @@ class RetryLaterError(GreptimeError):
     """Transient condition; the caller should retry (reference RETRY_LATER)."""
 
     code = StatusCode.RETRY_LATER
+
+
+class FlowNotFoundError(GreptimeError):
+    code = StatusCode.FLOW_NOT_FOUND
+
+
+class FlowAlreadyExistsError(GreptimeError):
+    code = StatusCode.FLOW_ALREADY_EXISTS

@@ -1,0 +1,200 @@
+"""Flow engine tests: streaming + batching incremental materialized views
+(modeled on the reference's flow tests and sqlness flow cases)."""
+
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.utils.errors import (
+    FlowAlreadyExistsError,
+    FlowNotFoundError,
+    TableNotFoundError,
+)
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(data_home=str(tmp_path))
+    yield d
+    d.close()
+
+
+def _mk_source(db):
+    db.sql(
+        "CREATE TABLE cpu (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host))"
+    )
+
+
+def test_streaming_flow_incremental(db):
+    _mk_source(db)
+    db.sql(
+        "CREATE FLOW cpu_sum SINK TO cpu_sums AS "
+        "SELECT host, sum(v) AS total, count(v) AS n FROM cpu GROUP BY host"
+    )
+    assert db.flows.infos["cpu_sum"].mode == "streaming"
+    db.sql("INSERT INTO cpu VALUES ('a', 1000, 1.0), ('b', 2000, 2.0), ('a', 3000, 3.0)")
+    out = db.sql_one("SELECT host, total, n FROM cpu_sums ORDER BY host")
+    assert out.column("host").to_pylist() == ["a", "b"]
+    assert out.column("total").to_pylist() == [4.0, 2.0]
+    assert out.column("n").to_pylist() == [2, 1]
+    # incremental: second insert folds into existing state
+    db.sql("INSERT INTO cpu VALUES ('a', 4000, 5.0)")
+    out = db.sql_one("SELECT total, n FROM cpu_sums WHERE host = 'a'")
+    assert out.column("total").to_pylist() == [9.0]
+    assert out.column("n").to_pylist() == [3]
+
+
+def test_streaming_flow_avg_min_max_with_where(db):
+    _mk_source(db)
+    db.sql(
+        "CREATE FLOW stats SINK TO cpu_stats AS "
+        "SELECT host, avg(v) AS a, min(v) AS lo, max(v) AS hi FROM cpu "
+        "WHERE v > 0 GROUP BY host"
+    )
+    db.sql("INSERT INTO cpu VALUES ('x', 1000, 2.0), ('x', 2000, -5.0), ('x', 3000, 4.0)")
+    out = db.sql_one("SELECT a, lo, hi FROM cpu_stats")
+    assert out.column("a").to_pylist() == [3.0]  # -5 filtered out
+    assert out.column("lo").to_pylist() == [2.0]
+    assert out.column("hi").to_pylist() == [4.0]
+
+
+def test_streaming_flow_time_bucket_group(db):
+    _mk_source(db)
+    db.sql(
+        "CREATE FLOW win SINK TO cpu_win AS "
+        "SELECT host, date_bin('10s', ts) AS w, max(v) AS hi FROM cpu GROUP BY host, date_bin('10s', ts)"
+    )
+    db.sql(
+        "INSERT INTO cpu VALUES ('a', 1000, 1.0), ('a', 5000, 9.0), ('a', 12000, 3.0)"
+    )
+    out = db.sql_one("SELECT w, hi FROM cpu_win ORDER BY w")
+    assert out.num_rows == 2
+    assert out.column("hi").to_pylist() == [9.0, 3.0]
+
+
+def test_batching_flow_eval_interval(db, tmp_path):
+    _mk_source(db)
+    # eval interval forces batching mode
+    db.sql(
+        "CREATE FLOW lastv SINK TO cpu_last EVAL INTERVAL '10s' AS "
+        "SELECT host, date_bin('1m', ts) AS w, sum(v) AS s FROM cpu GROUP BY host, date_bin('1m', ts)"
+    )
+    assert db.flows.infos["lastv"].mode == "batching"
+    db.sql("INSERT INTO cpu VALUES ('a', 1000, 1.0), ('a', 2000, 2.0)")
+    # nothing materialized until flush/tick
+    with pytest.raises(Exception):
+        db.sql_one("SELECT * FROM cpu_last")
+    db.sql("ADMIN flush_flow('lastv')")
+    out = db.sql_one("SELECT host, s FROM cpu_last")
+    assert out.column("s").to_pylist() == [3.0]
+    # new data marks the window dirty again; re-eval updates in place
+    db.sql("INSERT INTO cpu VALUES ('a', 3000, 4.0)")
+    db.sql("ADMIN flush_flow('lastv')")
+    out = db.sql_one("SELECT host, s FROM cpu_last")
+    assert out.column("s").to_pylist() == [7.0]
+
+
+def test_batching_mode_for_complex_query(db):
+    _mk_source(db)
+    # ORDER BY makes it non-streamable -> batching
+    db.sql(
+        "CREATE FLOW topk SINK TO cpu_top AS "
+        "SELECT host, sum(v) AS s FROM cpu GROUP BY host ORDER BY s DESC LIMIT 2"
+    )
+    assert db.flows.infos["topk"].mode == "batching"
+
+
+def test_flow_ddl_surface(db):
+    _mk_source(db)
+    db.sql("CREATE FLOW f1 SINK TO s1 AS SELECT host, sum(v) FROM cpu GROUP BY host")
+    shows = db.sql_one("SHOW FLOWS")
+    assert shows.column("Flows").to_pylist() == ["f1"]
+    with pytest.raises(FlowAlreadyExistsError):
+        db.sql("CREATE FLOW f1 SINK TO s1 AS SELECT host, sum(v) FROM cpu GROUP BY host")
+    db.sql("CREATE FLOW IF NOT EXISTS f1 SINK TO s1 AS SELECT host, sum(v) FROM cpu GROUP BY host")
+    db.sql("DROP FLOW f1")
+    assert db.sql_one("SHOW FLOWS").num_rows == 0
+    with pytest.raises(FlowNotFoundError):
+        db.sql("DROP FLOW f1")
+
+
+def test_or_replace_failure_keeps_old_flow(db):
+    _mk_source(db)
+    db.sql("CREATE FLOW f SINK TO s AS SELECT host, sum(v) AS t FROM cpu GROUP BY host")
+    with pytest.raises(TableNotFoundError):
+        db.sql("CREATE OR REPLACE FLOW f SINK TO s AS SELECT host, sum(v) FROM nope GROUP BY host")
+    assert "f" in db.flows.infos  # old flow survived the failed replace
+    db.sql("INSERT INTO cpu VALUES ('a', 1000, 2.0)")
+    assert db.sql_one("SELECT t FROM s").column("t").to_pylist() == [2.0]
+
+
+def test_preexisting_sink_with_extra_columns(db):
+    _mk_source(db)
+    # user pre-creates the sink with an extra column the flow doesn't produce
+    db.sql(
+        "CREATE TABLE sums (host STRING, total DOUBLE, note STRING, "
+        "ts TIMESTAMP TIME INDEX, PRIMARY KEY(host))"
+    )
+    db.sql("CREATE FLOW f SINK TO sums AS SELECT host, sum(v) AS total FROM cpu GROUP BY host")
+    db.sql("INSERT INTO cpu VALUES ('a', 1000, 3.0)")
+    assert db.flows.last_error is None
+    out = db.sql_one("SELECT host, total FROM sums")
+    assert out.column("total").to_pylist() == [3.0]
+
+
+def test_show_create_flow_roundtrip(db):
+    _mk_source(db)
+    db.sql(
+        "CREATE FLOW f SINK TO s EXPIRE AFTER '1h' EVAL INTERVAL '10s' COMMENT 'c' "
+        "AS SELECT host, sum(v) AS t FROM cpu GROUP BY host"
+    )
+    ddl = db.sql_one("SHOW CREATE FLOW f").column("Create Flow").to_pylist()[0]
+    assert "EXPIRE AFTER '3600s'" in ddl
+    assert "EVAL INTERVAL '10s'" in ddl
+    assert "COMMENT 'c'" in ddl
+    # the rendered DDL must re-parse and recreate an equivalent flow
+    db.sql("DROP FLOW f")
+    db.sql(ddl)
+    info = db.flows.infos["f"]
+    assert info.expire_after_ms == 3_600_000 and info.eval_interval_ms == 10_000
+
+
+def test_batching_flow_background_ticker(tmp_path):
+    import time
+
+    db = Database(data_home=str(tmp_path))
+    try:
+        _mk_source(db)
+        db.sql(
+            "CREATE FLOW auto SINK TO out EVAL INTERVAL '1s' AS "
+            "SELECT host, sum(v) AS s FROM cpu GROUP BY host"
+        )
+        db.sql("INSERT INTO cpu VALUES ('a', 1000, 5.0)")
+        deadline = time.time() + 10
+        got = None
+        while time.time() < deadline:
+            try:
+                got = db.sql_one("SELECT s FROM out")
+                if got.num_rows:
+                    break
+            except TableNotFoundError:
+                pass
+            time.sleep(0.25)
+        assert got is not None and got.column("s").to_pylist() == [5.0]
+    finally:
+        db.close()
+
+
+def test_flow_persistence(tmp_path):
+    db = Database(data_home=str(tmp_path))
+    db.sql("CREATE TABLE m (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host))")
+    db.sql("CREATE FLOW keep SINK TO m_sums AS SELECT host, sum(v) AS s FROM m GROUP BY host")
+    db.close()
+    db2 = Database(data_home=str(tmp_path))
+    try:
+        assert "keep" in db2.flows.infos
+        db2.sql("INSERT INTO m VALUES ('h', 1000, 2.5)")
+        out = db2.sql_one("SELECT s FROM m_sums")
+        assert out.column("s").to_pylist() == [2.5]
+    finally:
+        db2.close()
