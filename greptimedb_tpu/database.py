@@ -285,7 +285,12 @@ class Database:
         sink writes pass mirror=False to avoid self-feeding."""
 
         if is_logical_meta(meta):
-            return self.metric.write_logical(meta, batch)
+            affected = self.metric.write_logical(meta, batch)
+            if mirror and self.flows.infos:
+                self.flows.mirror_insert(
+                    meta.name, meta.database, pa.Table.from_batches([batch])
+                )
+            return affected
         table = pa.Table.from_batches([batch])
         affected = 0
         parts = meta.partition_rule.split(table)

@@ -92,6 +92,7 @@ def _is_streamable(stmt: SelectStmt) -> bool:
         return False
     group_names = {g.name() for g in stmt.group_by}
     has_agg = False
+    non_agg_inners = set()
     for p in stmt.projections:
         inner = _strip_alias(p)
         if isinstance(inner, AggCall):
@@ -99,6 +100,14 @@ def _is_streamable(stmt: SelectStmt) -> bool:
                 return False
             has_agg = True
         elif inner.name() not in group_names:
+            return False
+        else:
+            non_agg_inners.add(inner)
+    # Every group key must surface in the SELECT list: the sink row is keyed
+    # by projected columns only, so a dropped key would collapse distinct
+    # groups into one sink row (batching mode handles those correctly).
+    for g in stmt.group_by:
+        if _strip_alias(g) not in non_agg_inners:
             return False
     return has_agg
 
@@ -132,11 +141,13 @@ class _AggState:
         self.max = None
 
     def update(self, values: np.ndarray):
+        if values.dtype.kind == "f":
+            values = values[~np.isnan(values)]  # aggregates ignore NULLs
         if values.size == 0:
             return
-        self.sum += float(np.nansum(values))
-        self.count += int(np.sum(~np.isnan(values))) if values.dtype.kind == "f" else values.size
-        mn, mx = float(np.nanmin(values)), float(np.nanmax(values))
+        self.sum += float(values.sum())
+        self.count += values.size
+        mn, mx = float(values.min()), float(values.max())
         self.min = mn if self.min is None else min(self.min, mn)
         self.max = mx if self.max is None else max(self.max, mx)
 
@@ -415,6 +426,12 @@ def _as_ms(v) -> int:
     if isinstance(v, (int, np.integer)):
         return int(v)
     if hasattr(v, "timestamp"):
+        if getattr(v, "tzinfo", None) is None:
+            # Arrow to_pylist yields naive UTC datetimes; .timestamp() on a
+            # naive value would reinterpret them in the host's local zone.
+            import datetime
+
+            v = v.replace(tzinfo=datetime.timezone.utc)
         return int(v.timestamp() * 1000)
     return 0
 
@@ -533,6 +550,19 @@ class FlowManager:
             raise InvalidArgumentsError("flow query must read FROM a source table")
         source_db = stmt.query.database or database
         self.db.catalog.table(stmt.query.table, source_db)  # must exist
+        # Every GROUP BY key must surface in the SELECT list: the sink table
+        # is keyed by the projected columns, so a dropped key would collapse
+        # distinct groups into one sink row (silently wrong results in either
+        # mode — the reference's sink-table model has the same constraint).
+        proj_inners = {_strip_alias(p) for p in stmt.query.projections}
+        proj_names = {p.name() for p in stmt.query.projections}
+        for g in stmt.query.group_by:
+            gi = _strip_alias(g)
+            if gi not in proj_inners and gi.name() not in proj_names:
+                raise InvalidArgumentsError(
+                    f"flow GROUP BY key {gi.name()!r} must appear in the SELECT "
+                    "list (the sink table is keyed by projected columns)"
+                )
         if stmt.name in self.flows:
             if stmt.if_not_exists:
                 return self.infos[stmt.name]

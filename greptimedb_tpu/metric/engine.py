@@ -290,6 +290,46 @@ class MetricEngine:
                 )
             return meta
 
+    def write_series_rows(
+        self,
+        rows: dict[str, list[tuple[dict, int, float]]],
+        physical_table: str,
+        database: str = DEFAULT_SCHEMA,
+    ) -> int:
+        """Ingest metric -> [(labels, ts_ms, value)] rows, auto-creating or
+        widening one logical table per metric.  Shared by the Prometheus
+        remote-write and OTLP metrics paths (the reference funnels both
+        through row_writer::MultiTableData the same way)."""
+        import pyarrow as pa
+
+        if not rows:
+            return 0
+        self.ensure_physical_table(physical_table, database)
+        total = 0
+        for metric, entries in rows.items():
+            label_names = sorted({k for labels, _, _ in entries for k in labels})
+            meta = self.ensure_logical_table(
+                metric, label_names, physical_table, database
+            )
+            ts_name = meta.schema.time_index.name
+            val_name = meta.schema.field_columns()[0].name
+            cols: dict[str, list] = {ts_name: [], val_name: []}
+            for lbl in label_names:
+                cols[lbl] = []
+            for labels, ts_ms, value in entries:
+                cols[ts_name].append(ts_ms)
+                cols[val_name].append(value)
+                for lbl in label_names:
+                    cols[lbl].append(labels.get(lbl))
+            arrays = {
+                ts_name: pa.array(cols[ts_name], pa.timestamp("ms")),
+                val_name: pa.array(cols[val_name], pa.float64()),
+            }
+            for lbl in label_names:
+                arrays[lbl] = pa.array(cols[lbl], pa.string())
+            total += self.db.insert_rows(metric, pa.table(arrays), database=database)
+        return total
+
     def drop_logical_table(self, meta: TableMeta):
         """Remove the registration; rows stay in the data region until
         compaction GC (the reference likewise drops metadata only)."""

@@ -112,15 +112,20 @@ def plan_select(stmt: SelectStmt, schema: Schema, database: str = "public") -> L
         if stmt.having is not None:
             plan = Having(plan, stmt.having)
         plan = Project(plan, stmt.projections)
+        if stmt.order_by:
+            # ORDER BY runs over the projected output: positional refs and
+            # alias refs become output-column references.
+            keys = [(_resolve_order_key(e, stmt.projections), asc) for e, asc in stmt.order_by]
+            plan = Sort(plan, keys)
     else:
+        if stmt.order_by:
+            # Sort below the projection: keys may reference base columns that
+            # the SELECT list drops (aliases resolve to their expressions).
+            keys = [(_resolve_positional(e, stmt.projections), asc) for e, asc in stmt.order_by]
+            plan = Sort(plan, keys)
         if not (len(stmt.projections) == 1 and isinstance(stmt.projections[0], Star)):
             plan = Project(plan, stmt.projections)
 
-    if stmt.order_by:
-        # ORDER BY runs over the projected output: positional refs and alias
-        # refs become output-column references, not re-evaluated expressions.
-        keys = [(_resolve_order_key(e, stmt.projections), asc) for e, asc in stmt.order_by]
-        plan = Sort(plan, keys)
     if stmt.limit is not None:
         plan = Limit(plan, stmt.limit, stmt.offset)
     return plan

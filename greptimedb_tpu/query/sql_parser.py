@@ -663,6 +663,8 @@ class Parser:
             or_replace = True
         if self.eat_kw("flow"):
             return self.parse_create_flow(or_replace)
+        if or_replace:
+            raise InvalidSyntaxError("OR REPLACE is only supported for CREATE FLOW")
         if self.eat_kw("database", "schema"):
             ine = self._if_not_exists()
             return CreateDatabaseStmt(self.ident(), if_not_exists=ine)

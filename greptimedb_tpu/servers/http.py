@@ -78,6 +78,15 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         if length:
             body = self.rfile.read(length)
+            encoding = (self.headers.get("Content-Encoding") or "").lower()
+            if "gzip" in encoding:
+                import gzip
+
+                body = gzip.decompress(body)
+            elif "deflate" in encoding:
+                import zlib
+
+                body = zlib.decompress(body)
             ctype = self.headers.get("Content-Type", "")
             if "application/x-www-form-urlencoded" in ctype:
                 try:
@@ -122,6 +131,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._handle_prom_write(params)
             if route == "/v1/prometheus/read":
                 return self._handle_prom_read(params)
+            if route.startswith("/v1/otlp/v1/"):
+                return self._handle_otlp(route.rsplit("/", 1)[1], params)
             return self._send(404, {"error": f"no route {route}"})
         except GreptimeError as e:
             self._send(400, {"error": str(e), "code": int(e.status_code())})
@@ -174,6 +185,36 @@ class _Handler(BaseHTTPRequestHandler):
             "greptime_http_prom_write_rows_total", "Prom remote-write rows"
         ).inc(n)
         return self._send(204, b"", "text/plain")
+
+    def _handle_otlp(self, signal: str, params):
+        from . import otlp
+
+        body = params.get("__body") or b""
+        db_name = self.headers.get("X-Greptime-DB-Name") or params.get("db", "public")
+        if signal == "metrics":
+            n = otlp.ingest_metrics(self.db, body, database=db_name)
+        elif signal == "traces":
+            n = otlp.ingest_traces(
+                self.db,
+                body,
+                database=db_name,
+                table=self.headers.get("X-Greptime-Trace-Table-Name")
+                or otlp.TRACE_TABLE_NAME,
+            )
+        elif signal == "logs":
+            n = otlp.ingest_logs(
+                self.db,
+                body,
+                database=db_name,
+                table=self.headers.get("X-Greptime-Log-Table-Name")
+                or otlp.LOG_TABLE_NAME,
+                pipeline_name=self.headers.get("X-Greptime-Log-Pipeline-Name"),
+            )
+        else:
+            return self._send(404, {"error": f"unknown OTLP signal {signal}"})
+        REGISTRY.counter("greptime_http_otlp_rows_total", "OTLP rows").inc(n)
+        # Export*ServiceResponse with no rejected points = empty message.
+        return self._send(200, b"", "application/x-protobuf")
 
     def _handle_prom_read(self, params):
         from .prom_store import remote_read

@@ -42,42 +42,15 @@ def remote_write(
         raise InvalidArgumentsError(f"bad remote-write body: {e}") from e
     if not series:
         return 0
-    db.metric.ensure_physical_table(physical_table, database)
-
-    by_metric: dict[str, list[pw.PromTimeSeries]] = defaultdict(list)
+    rows: dict[str, list[tuple[dict, int, float]]] = defaultdict(list)
     for ts in series:
         name = ts.labels.get(NAME_LABEL)
         if not name:
             raise InvalidArgumentsError("timeseries without __name__ label")
-        by_metric[name].append(ts)
-
-    total = 0
-    for metric, series_list in by_metric.items():
-        label_names = sorted(
-            {k for ts in series_list for k in ts.labels if k != NAME_LABEL}
-        )
-        meta = db.metric.ensure_logical_table(
-            metric, label_names, physical_table, database
-        )
-        ts_name = meta.schema.time_index.name
-        val_name = meta.schema.field_columns()[0].name
-        cols: dict[str, list] = {ts_name: [], val_name: []}
-        for lbl in label_names:
-            cols[lbl] = []
-        for ts in series_list:
-            for s in ts.samples:
-                cols[ts_name].append(s.timestamp_ms)
-                cols[val_name].append(s.value)
-                for lbl in label_names:
-                    cols[lbl].append(ts.labels.get(lbl))
-        arrays = {
-            ts_name: pa.array(cols[ts_name], pa.timestamp("ms")),
-            val_name: pa.array(cols[val_name], pa.float64()),
-        }
-        for lbl in label_names:
-            arrays[lbl] = pa.array(cols[lbl], pa.string())
-        total += db.insert_rows(metric, pa.table(arrays), database=database)
-    return total
+        labels = {k: v for k, v in ts.labels.items() if k != NAME_LABEL}
+        for s in ts.samples:
+            rows[name].append((labels, s.timestamp_ms, s.value))
+    return db.metric.write_series_rows(rows, physical_table, database)
 
 
 def remote_read(db, body: bytes, database: str = "public") -> bytes:

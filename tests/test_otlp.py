@@ -1,0 +1,263 @@
+"""OTLP ingest: metrics, traces, logs (wire codec + table mapping).
+
+Mirrors the reference's OTLP tests (reference servers/src/otlp/{metrics,
+trace,logs}.rs unit tests + servers/tests http otlp cases).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.servers import otlp
+from greptimedb_tpu.servers.http import HttpServer
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(data_home=str(tmp_path / "data"))
+    yield d
+    d.close()
+
+
+NS = 1_000_000_000
+
+
+def _gauge(name, points, unit=""):
+    return otlp.OtlpMetric(
+        name=name,
+        unit=unit,
+        kind="gauge",
+        points=[otlp.NumberPoint(attrs=a, time_unix_nano=t, value=v) for a, t, v in points],
+    )
+
+
+# ---- wire codec -------------------------------------------------------------
+
+
+def test_metrics_wire_roundtrip():
+    body = otlp.encode_metrics_request(
+        {"service.name": "api", "host.id": 7},
+        [
+            _gauge("cpu.usage", [({"core": "0"}, 5 * NS, 0.25)]),
+            otlp.OtlpMetric(
+                name="http.duration",
+                kind="histogram",
+                points=[
+                    otlp.HistogramPoint(
+                        attrs={"route": "/x"},
+                        time_unix_nano=6 * NS,
+                        count=7,
+                        sum=3.5,
+                        bucket_counts=[1, 4, 2],
+                        explicit_bounds=[0.1, 1.0],
+                    )
+                ],
+            ),
+            otlp.OtlpMetric(
+                name="rpc.latency",
+                kind="summary",
+                points=[
+                    otlp.SummaryPoint(
+                        attrs={},
+                        time_unix_nano=6 * NS,
+                        count=10,
+                        sum=2.0,
+                        quantiles=[(0.5, 0.1), (0.99, 0.9)],
+                    )
+                ],
+            ),
+        ],
+    )
+    decoded = otlp.decode_metrics_request(body)
+    assert len(decoded) == 1
+    attrs, metrics = decoded[0]
+    assert attrs == {"service.name": "api", "host.id": 7}
+    by_name = {m.name: m for m in metrics}
+    assert by_name["cpu.usage"].points[0].value == 0.25
+    assert by_name["cpu.usage"].points[0].attrs == {"core": "0"}
+    h = by_name["http.duration"].points[0]
+    assert (h.count, h.sum, h.bucket_counts, h.explicit_bounds) == (
+        7, 3.5, [1, 4, 2], [0.1, 1.0],
+    )
+    s = by_name["rpc.latency"].points[0]
+    assert s.quantiles == [(0.5, 0.1), (0.99, 0.9)]
+
+
+def test_traces_wire_roundtrip():
+    span = otlp.OtlpSpan(
+        trace_id="0af7651916cd43dd8448eb211c80319c",
+        span_id="b7ad6b7169203331",
+        parent_span_id="00f067aa0ba902b7",
+        name="GET /api",
+        kind=2,
+        start_unix_nano=10 * NS,
+        end_unix_nano=11 * NS,
+        attrs={"http.status_code": 200, "ok": True},
+        events=[{"time_unix_nano": 10 * NS + 5, "name": "retry", "attrs": {"n": 1}}],
+        links=[{"trace_id": "0af7651916cd43dd8448eb211c80319d", "span_id": "b7ad6b7169203332", "attrs": {}}],
+        status_code=2,
+        status_message="boom",
+    )
+    body = otlp.encode_traces_request({"service.name": "web"}, [span], "scope", "1.2")
+    decoded = otlp.decode_traces_request(body)
+    assert len(decoded) == 1
+    res, scope_name, scope_version, spans = decoded[0]
+    assert res == {"service.name": "web"}
+    assert (scope_name, scope_version) == ("scope", "1.2")
+    s = spans[0]
+    assert s.trace_id == span.trace_id
+    assert s.kind == 2 and s.status_code == 2 and s.status_message == "boom"
+    assert s.attrs == {"http.status_code": 200, "ok": True}
+    assert s.events[0]["name"] == "retry"
+    assert s.links[0]["span_id"] == "b7ad6b7169203332"
+
+
+def test_logs_wire_roundtrip():
+    rec = otlp.OtlpLogRecord(
+        time_unix_nano=20 * NS,
+        severity_number=9,
+        severity_text="INFO",
+        body="hello world",
+        attrs={"k": "v", "n": 3},
+        trace_id="0af7651916cd43dd8448eb211c80319c",
+        span_id="b7ad6b7169203331",
+        flags=1,
+    )
+    body = otlp.encode_logs_request({"service.name": "svc"}, [rec], "scope")
+    decoded = otlp.decode_logs_request(body)
+    res, scope_name, records = decoded[0]
+    assert res == {"service.name": "svc"}
+    r = records[0]
+    assert r.body == "hello world"
+    assert r.attrs == {"k": "v", "n": 3}
+    assert r.severity_number == 9 and r.flags == 1
+
+
+# ---- ingest mapping ---------------------------------------------------------
+
+
+def test_ingest_metrics_gauge_and_histogram(db):
+    body = otlp.encode_metrics_request(
+        {"service.name": "api"},
+        [
+            _gauge("cpu.usage", [({"core": "0"}, 5 * NS, 0.25), ({"core": "1"}, 5 * NS, 0.5)]),
+            otlp.OtlpMetric(
+                name="req.duration",
+                kind="histogram",
+                points=[
+                    otlp.HistogramPoint(
+                        attrs={},
+                        time_unix_nano=6 * NS,
+                        count=7,
+                        sum=3.5,
+                        bucket_counts=[1, 4, 2],
+                        explicit_bounds=[0.1, 1.0],
+                    )
+                ],
+            ),
+        ],
+    )
+    n = otlp.ingest_metrics(db, body)
+    # 2 gauge rows + 3 buckets + sum + count
+    assert n == 7
+    t = db.sql_one("SELECT core, greptime_value FROM cpu_usage ORDER BY core")
+    assert t["greptime_value"].to_pylist() == [0.25, 0.5]
+    assert t["core"].to_pylist() == ["0", "1"]
+    # cumulative bucket counts with +Inf tail
+    t = db.sql_one("SELECT le, greptime_value FROM req_duration_bucket ORDER BY le")
+    got = dict(zip(t["le"].to_pylist(), t["greptime_value"].to_pylist()))
+    assert got == {"0.1": 1.0, "1.0": 5.0, "+Inf": 7.0}
+    assert db.sql_one("SELECT greptime_value FROM req_duration_count")[
+        "greptime_value"
+    ].to_pylist() == [7.0]
+    # resource attr promoted to a label
+    t = db.sql_one("SELECT service_name FROM cpu_usage LIMIT 1")
+    assert t["service_name"].to_pylist() == ["api"]
+
+
+def test_ingest_traces_span_table(db):
+    span = otlp.OtlpSpan(
+        trace_id="ab" * 16,
+        span_id="cd" * 8,
+        name="GET /",
+        kind=2,
+        start_unix_nano=10 * NS,
+        end_unix_nano=10 * NS + 250_000_000,
+        attrs={"http.method": "GET"},
+        status_code=1,
+    )
+    body = otlp.encode_traces_request({"service.name": "frontend"}, [span])
+    assert otlp.ingest_traces(db, body) == 1
+    t = db.sql_one(
+        "SELECT service_name, span_name, span_kind, duration_nano, span_status_code "
+        "FROM opentelemetry_traces"
+    )
+    assert t["service_name"].to_pylist() == ["frontend"]
+    assert t["span_kind"].to_pylist() == ["SPAN_KIND_SERVER"]
+    assert t["duration_nano"].to_pylist() == [250_000_000]
+    assert t["span_status_code"].to_pylist() == ["STATUS_CODE_OK"]
+    attrs = json.loads(
+        db.sql_one("SELECT span_attributes FROM opentelemetry_traces")[
+            "span_attributes"
+        ].to_pylist()[0]
+    )
+    assert attrs == {"http.method": "GET"}
+
+
+def test_ingest_logs_table(db):
+    recs = [
+        otlp.OtlpLogRecord(
+            time_unix_nano=(30 + i) * NS,
+            severity_number=9,
+            severity_text="INFO",
+            body=f"line {i}",
+            attrs={"idx": i},
+        )
+        for i in range(3)
+    ]
+    body = otlp.encode_logs_request({"service.name": "svc"}, recs)
+    assert otlp.ingest_logs(db, body) == 3
+    t = db.sql_one(
+        "SELECT body, severity_text FROM opentelemetry_logs ORDER BY timestamp"
+    )
+    assert t["body"].to_pylist() == ["line 0", "line 1", "line 2"]
+
+
+# ---- HTTP endpoints ---------------------------------------------------------
+
+
+def test_http_otlp_endpoints(db):
+    server = HttpServer(db).start(warm=False)
+    try:
+        url = f"http://{server.address}/v1/otlp/v1"
+
+        def post(path, body):
+            req = urllib.request.Request(
+                f"{url}/{path}",
+                data=body,
+                headers={"Content-Type": "application/x-protobuf"},
+            )
+            return urllib.request.urlopen(req)
+
+        r = post("metrics", otlp.encode_metrics_request(
+            {"service.name": "api"}, [_gauge("up.time", [({}, 5 * NS, 1.0)])]
+        ))
+        assert r.status == 200
+        r = post("traces", otlp.encode_traces_request(
+            {"service.name": "api"},
+            [otlp.OtlpSpan(trace_id="ab" * 16, span_id="cd" * 8, name="op",
+                           start_unix_nano=NS, end_unix_nano=2 * NS)],
+        ))
+        assert r.status == 200
+        r = post("logs", otlp.encode_logs_request(
+            {"service.name": "api"},
+            [otlp.OtlpLogRecord(time_unix_nano=NS, body="msg")],
+        ))
+        assert r.status == 200
+        assert db.sql_one("SELECT count(*) AS c FROM opentelemetry_traces")["c"].to_pylist() == [1]
+        assert db.sql_one("SELECT count(*) AS c FROM opentelemetry_logs")["c"].to_pylist() == [1]
+        assert db.sql_one("SELECT greptime_value FROM up_time")["greptime_value"].to_pylist() == [1.0]
+    finally:
+        server.stop()
