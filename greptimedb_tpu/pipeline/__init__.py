@@ -1,0 +1,23 @@
+"""Pipeline: YAML-defined log ETL (the reference's `pipeline` crate).
+
+Processors parse/reshape incoming log documents, transforms type them into
+table rows, a dispatcher can fan documents out to other pipelines/tables
+(reference src/pipeline/src/etl.rs, dispatcher.rs, manager/).
+"""
+
+from .etl import Pipeline, PipelineExecError, PipelineParseError, parse_pipeline
+from .manager import (
+    GREPTIME_IDENTITY,
+    PipelineManager,
+    run_pipeline_ingest,
+)
+
+__all__ = [
+    "GREPTIME_IDENTITY",
+    "Pipeline",
+    "PipelineExecError",
+    "PipelineManager",
+    "PipelineParseError",
+    "parse_pipeline",
+    "run_pipeline_ingest",
+]

@@ -1,0 +1,662 @@
+"""Pipeline ETL core: YAML parse, processors, transforms, dispatcher.
+
+Role-equivalent of the reference's etl module (reference
+src/pipeline/src/etl.rs `Pipeline::exec_mut`, etl/processor/*.rs,
+etl/transform/): documents (dicts) flow through an ordered processor list,
+an optional dispatcher routes them to other pipelines/table suffixes, and a
+transform section types the surviving fields into storage rows.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import re
+import urllib.parse
+from dataclasses import dataclass, field
+
+from ..datatypes.data_type import ConcreteDataType
+from ..utils.errors import GreptimeError, StatusCode
+
+
+class PipelineParseError(GreptimeError):
+    def status_code(self) -> StatusCode:
+        return StatusCode.INVALID_ARGUMENTS
+
+
+class PipelineExecError(GreptimeError):
+    def status_code(self) -> StatusCode:
+        return StatusCode.INVALID_ARGUMENTS
+
+
+class DropDocument(Exception):
+    """Raised by the filter processor to discard the current document."""
+
+
+# ---- helpers ----------------------------------------------------------------
+
+
+def _as_fields(cfg: dict, *, required: bool = True) -> list[str]:
+    v = cfg.get("fields", cfg.get("field"))
+    if v is None:
+        if required:
+            raise PipelineParseError("processor requires field/fields")
+        return []
+    if isinstance(v, str):
+        # a single field spec, possibly a "src, dst" rename — NOT a list
+        return [v]
+    return [str(x) for x in v]
+
+
+def _split_rename(f: str) -> tuple[str, str]:
+    """`src, dst` field spec (reference etl/field.rs `Field`)."""
+    if "," in f:
+        a, b = f.split(",", 1)
+        return a.strip(), b.strip()
+    return f, f
+
+
+# ---- processors -------------------------------------------------------------
+
+
+class Processor:
+    """One step of the ETL chain; mutates the document dict in place."""
+
+    def __init__(self, cfg: dict):
+        self.cfg = cfg or {}
+        self.fields = [_split_rename(f) for f in _as_fields(self.cfg, required=self._needs_fields())]
+        self.ignore_missing = bool(self.cfg.get("ignore_missing", False))
+
+    def _needs_fields(self) -> bool:
+        return True
+
+    def __call__(self, doc: dict):
+        for src, dst in self.fields:
+            if src not in doc:
+                if self.ignore_missing:
+                    continue
+                raise PipelineExecError(f"field {src!r} missing (processor {type(self).__name__})")
+            self.apply(doc, src, dst)
+
+    def apply(self, doc: dict, src: str, dst: str):
+        raise NotImplementedError
+
+
+class DissectProcessor(Processor):
+    """Pattern tokenizer (reference etl/processor/dissect.rs): literal
+    separators between %{name} captures; modifiers: %{?skip}, %{+append},
+    %{name->} (greedy trailing separator)."""
+
+    def __init__(self, cfg: dict):
+        super().__init__(cfg)
+        patterns = cfg.get("patterns") or ([cfg["pattern"]] if "pattern" in cfg else [])
+        if not patterns:
+            raise PipelineParseError("dissect requires patterns")
+        self.append_separator = str(cfg.get("append_separator", " "))
+        self.patterns = [self._compile(p) for p in patterns]
+
+    _TOKEN = re.compile(r"%\{([^}]*)\}")
+
+    def _compile(self, pattern: str):
+        parts = []  # alternating literal, key-spec
+        pos = 0
+        for m in self._TOKEN.finditer(pattern):
+            parts.append(("lit", pattern[pos : m.start()]))
+            parts.append(("key", m.group(1)))
+            pos = m.end()
+        parts.append(("lit", pattern[pos:]))
+        return parts
+
+    def apply(self, doc: dict, src: str, dst: str):
+        text = str(doc[src])
+        for parts in self.patterns:
+            out = self._try(parts, text)
+            if out is not None:
+                doc.update(out)
+                return
+        raise PipelineExecError(f"dissect: no pattern matched {text[:80]!r}")
+
+    def _try(self, parts, text: str):
+        out: dict = {}
+        appends: dict[str, list[str]] = {}
+        i = 0
+        k = 0
+        while k < len(parts):
+            kind, spec = parts[k]
+            if kind == "lit":
+                if spec:
+                    if not text.startswith(spec, i):
+                        return None
+                    i += len(spec)
+                k += 1
+                continue
+            # key: find the next literal to bound the capture
+            next_lit = ""
+            for kk in range(k + 1, len(parts)):
+                if parts[kk][0] == "lit" and parts[kk][1]:
+                    next_lit = parts[kk][1]
+                    break
+            if next_lit:
+                j = text.find(next_lit, i)
+                if j < 0:
+                    return None
+            else:
+                j = len(text)
+            value = text[i:j]
+            i = j
+            name = spec
+            greedy = name.endswith("->")
+            if greedy:
+                name = name[:-2]
+            if greedy and next_lit:
+                # %{name->}: swallow repeated separators, leaving one for the
+                # following literal part to consume
+                while text.startswith(next_lit * 2, i):
+                    i += len(next_lit)
+            if name.startswith("?") or name == "":
+                pass  # named-skip
+            elif name.startswith("+"):
+                appends.setdefault(name[1:], []).append(value)
+            else:
+                out[name] = value
+            k += 1
+        for name, vals in appends.items():
+            out[name] = self.append_separator.join(vals)
+        return out
+
+
+class DateProcessor(Processor):
+    """strptime into an epoch-ns timestamp (reference processor/date.rs)."""
+
+    def __init__(self, cfg: dict):
+        super().__init__(cfg)
+        self.formats = cfg.get("formats") or ["%Y-%m-%dT%H:%M:%S%z"]
+        if isinstance(self.formats, str):
+            self.formats = [self.formats]
+        tz = cfg.get("timezone")
+        self.tz = None
+        if tz:
+            off = re.match(r"^([+-])(\d{2}):?(\d{2})$", str(tz))
+            if off:
+                sign = 1 if off.group(1) == "+" else -1
+                self.tz = datetime.timezone(
+                    sign * datetime.timedelta(hours=int(off.group(2)), minutes=int(off.group(3)))
+                )
+            elif str(tz).upper() in ("UTC", "Z"):
+                self.tz = datetime.timezone.utc
+            else:
+                try:
+                    import zoneinfo
+
+                    self.tz = zoneinfo.ZoneInfo(str(tz))
+                except (zoneinfo.ZoneInfoNotFoundError, ValueError) as e:
+                    raise PipelineParseError(f"date: unknown timezone {tz!r}") from e
+
+    def apply(self, doc: dict, src: str, dst: str):
+        text = str(doc[src])
+        for fmt in self.formats:
+            try:
+                dt = datetime.datetime.strptime(text, fmt)
+            except ValueError:
+                continue
+            if dt.tzinfo is None:
+                dt = dt.replace(tzinfo=self.tz or datetime.timezone.utc)
+            doc[dst] = int(dt.timestamp() * 1_000_000) * 1000
+            return
+        raise PipelineExecError(f"date: {text!r} matches none of {self.formats}")
+
+
+class EpochProcessor(Processor):
+    """Numeric epoch at s/ms/us/ns resolution -> epoch-ns
+    (reference processor/epoch.rs)."""
+
+    _FACTOR = {"s": 1_000_000_000, "second": 1_000_000_000,
+               "ms": 1_000_000, "millisecond": 1_000_000, "milli": 1_000_000,
+               "us": 1_000, "microsecond": 1_000, "micro": 1_000,
+               "ns": 1, "nanosecond": 1, "nano": 1}
+
+    def __init__(self, cfg: dict):
+        super().__init__(cfg)
+        res = str(cfg.get("resolution", "ms"))
+        if res not in self._FACTOR:
+            raise PipelineParseError(f"epoch: unknown resolution {res!r}")
+        self.factor = self._FACTOR[res]
+
+    def apply(self, doc: dict, src: str, dst: str):
+        v = doc[src]
+        try:
+            # int first: going through float would lose precision on ns
+            # epochs beyond 2^53
+            n = int(v)
+        except (TypeError, ValueError):
+            try:
+                n = int(float(v))
+            except (TypeError, ValueError) as e:
+                raise PipelineExecError(f"epoch: {v!r} is not numeric") from e
+        doc[dst] = n * self.factor
+
+
+class CsvProcessor(Processor):
+    def __init__(self, cfg: dict):
+        super().__init__(cfg)
+        tf = cfg.get("target_fields", "")
+        self.target_fields = (
+            [s.strip() for s in tf.split(",")] if isinstance(tf, str) else list(tf)
+        )
+        self.separator = str(cfg.get("separator", ","))
+        self.quote = str(cfg.get("quote", '"'))
+        self.trim = bool(cfg.get("trim", False))
+        self.empty_value = cfg.get("empty_value")
+
+    def apply(self, doc: dict, src: str, dst: str):
+        import csv as _csv
+        import io
+
+        reader = _csv.reader(
+            io.StringIO(str(doc[src])), delimiter=self.separator, quotechar=self.quote
+        )
+        row = next(reader, [])
+        for name, value in zip(self.target_fields, row):
+            if self.trim:
+                value = value.strip()
+            if value == "" and self.empty_value is not None:
+                value = self.empty_value
+            doc[name] = value
+
+
+class RegexProcessor(Processor):
+    """Named-group extraction; outputs <field>_<group>
+    (reference processor/regex.rs)."""
+
+    def __init__(self, cfg: dict):
+        super().__init__(cfg)
+        patterns = cfg.get("patterns") or ([cfg["pattern"]] if "pattern" in cfg else [])
+        if not patterns:
+            raise PipelineParseError("regex requires patterns")
+        # the DSL uses (?<name>...) like Rust/PCRE; Python wants (?P<name>...)
+        self.patterns = [re.compile(re.sub(r"\(\?<([A-Za-z_]\w*)>", r"(?P<\1>", p)) for p in patterns]
+
+    def apply(self, doc: dict, src: str, dst: str):
+        text = str(doc[src])
+        for rx in self.patterns:
+            m = rx.search(text)
+            if m:
+                for name, value in m.groupdict().items():
+                    if value is not None:
+                        doc[f"{dst}_{name}"] = value
+                return
+
+
+class GsubProcessor(Processor):
+    def __init__(self, cfg: dict):
+        super().__init__(cfg)
+        self.pattern = re.compile(str(cfg.get("pattern", "")))
+        self.replacement = str(cfg.get("replacement", ""))
+
+    def apply(self, doc: dict, src: str, dst: str):
+        doc[dst] = self.pattern.sub(self.replacement, str(doc[src]))
+
+
+class JoinProcessor(Processor):
+    def __init__(self, cfg: dict):
+        super().__init__(cfg)
+        self.separator = str(cfg.get("separator", ","))
+
+    def apply(self, doc: dict, src: str, dst: str):
+        v = doc[src]
+        if isinstance(v, (list, tuple)):
+            doc[dst] = self.separator.join(str(x) for x in v)
+
+
+class LetterProcessor(Processor):
+    def __init__(self, cfg: dict):
+        super().__init__(cfg)
+        self.method = str(cfg.get("method", "lower")).lower()
+
+    def apply(self, doc: dict, src: str, dst: str):
+        s = str(doc[src])
+        if self.method == "upper":
+            doc[dst] = s.upper()
+        elif self.method == "capital":
+            doc[dst] = s.capitalize()
+        else:
+            doc[dst] = s.lower()
+
+
+class UrlEncodingProcessor(Processor):
+    def __init__(self, cfg: dict):
+        super().__init__(cfg)
+        self.method = str(cfg.get("method", "decode")).lower()
+
+    def apply(self, doc: dict, src: str, dst: str):
+        s = str(doc[src])
+        doc[dst] = (
+            urllib.parse.quote(s) if self.method == "encode" else urllib.parse.unquote(s)
+        )
+
+
+class JsonParseProcessor(Processor):
+    def apply(self, doc: dict, src: str, dst: str):
+        try:
+            doc[dst] = json.loads(str(doc[src]))
+        except json.JSONDecodeError as e:
+            raise PipelineExecError(f"json_parse: invalid JSON in {src!r}: {e}") from e
+
+
+class SimpleExtractProcessor(Processor):
+    """Dot-path extraction from a parsed JSON value
+    (reference processor/simple_extract.rs)."""
+
+    def __init__(self, cfg: dict):
+        super().__init__(cfg)
+        self.key = str(cfg.get("key", ""))
+
+    def apply(self, doc: dict, src: str, dst: str):
+        v = doc[src]
+        for part in self.key.split(".") if self.key else []:
+            if isinstance(v, dict) and part in v:
+                v = v[part]
+            else:
+                if self.ignore_missing:
+                    return
+                raise PipelineExecError(f"simple_extract: key {self.key!r} not found")
+        doc[dst] = v
+
+
+class DecolorizeProcessor(Processor):
+    _ANSI = re.compile(r"\x1b\[[0-9;]*m")
+
+    def apply(self, doc: dict, src: str, dst: str):
+        doc[dst] = self._ANSI.sub("", str(doc[src]))
+
+
+class DigestProcessor(Processor):
+    """Strip variable content (numbers, uuids, ips, quoted strings, brackets)
+    to a stable template in <field>_digest (reference processor/digest.rs)."""
+
+    _PRESETS = {
+        "numbers": re.compile(r"\d+(\.\d+)?"),
+        "uuid": re.compile(
+            r"[0-9a-fA-F]{8}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}-[0-9a-fA-F]{12}"
+        ),
+        "ip": re.compile(r"((\d{1,3}\.){3}\d{1,3}(:\d+)?)|(\[[0-9a-fA-F:]+\](:\d+)?)"),
+        "quoted": re.compile(r"\"[^\"]*\"|'[^']*'"),
+        "bracketed": re.compile(r"\[[^\[\]]*\]|\{[^{}]*\}|<[^<>]*>"),
+    }
+
+    def __init__(self, cfg: dict):
+        super().__init__(cfg)
+        presets = cfg.get("presets", ["numbers", "uuid", "ip", "quoted", "bracketed"])
+        self.patterns = [self._PRESETS[p] for p in presets if p in self._PRESETS]
+        for extra in cfg.get("regex", []) or []:
+            self.patterns.append(re.compile(extra))
+
+    def apply(self, doc: dict, src: str, dst: str):
+        s = str(doc[src])
+        for rx in self.patterns:
+            s = rx.sub("", s)
+        doc[f"{dst}_digest"] = s
+
+
+class SelectProcessor(Processor):
+    def __init__(self, cfg: dict):
+        super().__init__(cfg)
+        self.mode = str(cfg.get("type", "include")).lower()
+
+    def __call__(self, doc: dict):
+        names = [src for src, _ in self.fields]
+        if self.mode == "exclude":
+            for n in names:
+                doc.pop(n, None)
+        else:
+            keep = set(names)
+            for n in list(doc.keys()):
+                if n not in keep:
+                    del doc[n]
+
+    def apply(self, doc: dict, src: str, dst: str):  # pragma: no cover
+        pass
+
+
+class FilterProcessor(Processor):
+    """Drop documents whose field matches/doesn't match targets
+    (reference processor/filter.rs)."""
+
+    def __init__(self, cfg: dict):
+        super().__init__(cfg)
+        self.targets = [str(t) for t in (cfg.get("targets") or [])]
+        self.match_op = str(cfg.get("match_op", "in")).lower()
+        self.case_insensitive = bool(cfg.get("case_insensitive", True))
+        if self.case_insensitive:
+            self.targets = [t.lower() for t in self.targets]
+
+    def apply(self, doc: dict, src: str, dst: str):
+        v = str(doc[src])
+        if self.case_insensitive:
+            v = v.lower()
+        hit = v in self.targets
+        if (self.match_op == "in" and hit) or (self.match_op == "not_in" and not hit):
+            raise DropDocument()
+
+
+PROCESSORS = {
+    "dissect": DissectProcessor,
+    "date": DateProcessor,
+    "epoch": EpochProcessor,
+    "csv": CsvProcessor,
+    "regex": RegexProcessor,
+    "gsub": GsubProcessor,
+    "join": JoinProcessor,
+    "letter": LetterProcessor,
+    "urlencoding": UrlEncodingProcessor,
+    "json_parse": JsonParseProcessor,
+    "simple_extract": SimpleExtractProcessor,
+    "decolorize": DecolorizeProcessor,
+    "digest": DigestProcessor,
+    "select": SelectProcessor,
+    "filter": FilterProcessor,
+}
+
+
+# ---- transform --------------------------------------------------------------
+
+_TYPE_ALIASES = {
+    "int8": ConcreteDataType.INT8, "int16": ConcreteDataType.INT16,
+    "int32": ConcreteDataType.INT32, "int64": ConcreteDataType.INT64,
+    "uint8": ConcreteDataType.UINT8, "uint16": ConcreteDataType.UINT16,
+    "uint32": ConcreteDataType.UINT32, "uint64": ConcreteDataType.UINT64,
+    "float32": ConcreteDataType.FLOAT32, "float64": ConcreteDataType.FLOAT64,
+    "string": ConcreteDataType.STRING, "boolean": ConcreteDataType.BOOLEAN,
+    "bool": ConcreteDataType.BOOLEAN, "json": ConcreteDataType.JSON,
+}
+_TS_UNITS = {
+    "s": ConcreteDataType.TIMESTAMP_SECOND, "sec": ConcreteDataType.TIMESTAMP_SECOND,
+    "ms": ConcreteDataType.TIMESTAMP_MILLISECOND,
+    "us": ConcreteDataType.TIMESTAMP_MICROSECOND,
+    "ns": ConcreteDataType.TIMESTAMP_NANOSECOND,
+}
+
+
+@dataclass
+class TransformRule:
+    fields: list[tuple[str, str]]
+    dtype: ConcreteDataType
+    index: str | None = None  # time | tag | fulltext | inverted | skip
+    on_failure: str | None = None  # ignore | default
+    default: object = None
+
+    @classmethod
+    def parse(cls, cfg: dict) -> "TransformRule":
+        fields = [_split_rename(f) for f in _as_fields(cfg)]
+        tspec = str(cfg.get("type", "string")).strip()
+        if tspec.startswith("timestamp"):
+            parts = [p.strip() for p in tspec.split(",")]
+            unit = parts[1] if len(parts) > 1 else "ms"
+            dtype = _TS_UNITS.get(unit, ConcreteDataType.TIMESTAMP_MILLISECOND)
+        elif tspec.startswith("epoch"):
+            parts = [p.strip() for p in tspec.split(",")]
+            unit = parts[1] if len(parts) > 1 else "ms"
+            dtype = _TS_UNITS.get(unit, ConcreteDataType.TIMESTAMP_MILLISECOND)
+        elif tspec in _TYPE_ALIASES:
+            dtype = _TYPE_ALIASES[tspec]
+        else:
+            raise PipelineParseError(f"transform: unknown type {tspec!r}")
+        return cls(
+            fields=fields,
+            dtype=dtype,
+            index=cfg.get("index"),
+            on_failure=cfg.get("on_failure"),
+            default=cfg.get("default"),
+        )
+
+    def convert(self, v):
+        try:
+            if v is None:
+                raise ValueError("null")
+            if self.dtype.is_timestamp():
+                # processors emit epoch-ns; rescale to the declared unit
+                return int(v) // self.dtype.timestamp_unit_ns()
+            if self.dtype == ConcreteDataType.BOOLEAN:
+                if isinstance(v, str):
+                    return v.lower() in ("1", "t", "true", "yes")
+                return bool(v)
+            if self.dtype in (ConcreteDataType.FLOAT32, ConcreteDataType.FLOAT64):
+                return float(v)
+            if self.dtype in (ConcreteDataType.STRING,):
+                return v if isinstance(v, str) else json.dumps(v, default=str)
+            if self.dtype == ConcreteDataType.JSON:
+                return v if isinstance(v, str) else json.dumps(v, default=str)
+            return int(v)
+        except (TypeError, ValueError) as e:
+            if self.on_failure == "ignore":
+                return None
+            if self.on_failure == "default":
+                return self.default
+            raise PipelineExecError(
+                f"transform: cannot convert {v!r} to {self.dtype.value}"
+            ) from e
+
+
+@dataclass
+class DispatcherRule:
+    value: str
+    table_suffix: str | None = None
+    pipeline: str | None = None
+
+
+@dataclass
+class Dispatcher:
+    field: str
+    rules: list[DispatcherRule]
+
+    def route(self, doc: dict) -> DispatcherRule | None:
+        v = doc.get(self.field)
+        if v is None:
+            return None
+        for r in self.rules:
+            if str(v) == r.value:
+                return r
+        return None
+
+
+# ---- pipeline ---------------------------------------------------------------
+
+
+@dataclass
+class Pipeline:
+    name: str
+    processors: list[Processor] = field(default_factory=list)
+    transforms: list[TransformRule] = field(default_factory=list)
+    dispatcher: Dispatcher | None = None
+    description: str = ""
+    source: str = ""
+
+    def exec_doc(self, doc: dict):
+        """Run one document; returns (row_dict, dispatcher_rule | None) or
+        None if the document was filtered out.  row_dict maps column name ->
+        (value, ConcreteDataType, index)."""
+        doc = dict(doc)
+        try:
+            for p in self.processors:
+                p(doc)
+        except DropDocument:
+            return None
+        rule = self.dispatcher.route(doc) if self.dispatcher else None
+        if rule is not None and rule.pipeline:
+            return (doc, rule)  # re-dispatched: caller runs the named pipeline
+        if self.transforms:
+            row: dict = {}
+            for t in self.transforms:
+                for src, dst in t.fields:
+                    row[dst] = (t.convert(doc.get(src)), t.dtype, t.index)
+            return (row, rule)
+        return (identity_row(doc), rule)
+
+
+def identity_row(doc: dict) -> dict:
+    """Auto-type every field (the greptime_identity pipeline, reference
+    etl/transform/transformer/greptime.rs identity_pipeline)."""
+    row: dict = {}
+    for k, v in doc.items():
+        if isinstance(v, bool):
+            row[k] = (v, ConcreteDataType.BOOLEAN, None)
+        elif isinstance(v, int):
+            row[k] = (v, ConcreteDataType.INT64, None)
+        elif isinstance(v, float):
+            row[k] = (v, ConcreteDataType.FLOAT64, None)
+        elif isinstance(v, (dict, list)):
+            row[k] = (json.dumps(v, default=str), ConcreteDataType.JSON, None)
+        elif v is None:
+            row[k] = (None, ConcreteDataType.STRING, None)
+        else:
+            row[k] = (str(v), ConcreteDataType.STRING, None)
+    return row
+
+
+def parse_pipeline(yaml_text: str, name: str = "") -> Pipeline:
+    import yaml as _yaml
+
+    try:
+        spec = _yaml.safe_load(yaml_text)
+    except _yaml.YAMLError as e:
+        raise PipelineParseError(f"invalid pipeline YAML: {e}") from e
+    if not isinstance(spec, dict):
+        raise PipelineParseError("pipeline YAML must be a mapping")
+    processors: list[Processor] = []
+    for item in spec.get("processors") or []:
+        if not isinstance(item, dict) or len(item) != 1:
+            raise PipelineParseError(f"bad processor entry: {item!r}")
+        ptype, cfg = next(iter(item.items()))
+        if ptype not in PROCESSORS:
+            raise PipelineParseError(f"unknown processor {ptype!r}")
+        processors.append(PROCESSORS[ptype](cfg or {}))
+    transforms = [
+        TransformRule.parse(t) for t in (spec.get("transform") or spec.get("transforms") or [])
+    ]
+    dispatcher = None
+    if "dispatcher" in spec:
+        d = spec["dispatcher"] or {}
+        if "field" not in d:
+            raise PipelineParseError("dispatcher requires a field")
+        dispatcher = Dispatcher(
+            field=str(d["field"]),
+            rules=[
+                DispatcherRule(
+                    value=str(r.get("value")),
+                    table_suffix=r.get("table_suffix"),
+                    pipeline=r.get("pipeline"),
+                )
+                for r in (d.get("rules") or [])
+            ],
+        )
+    n_time = sum(1 for t in transforms if t.index == "time")
+    if n_time > 1:
+        raise PipelineParseError("at most one transform field may be index: time")
+    return Pipeline(
+        name=name,
+        processors=processors,
+        transforms=transforms,
+        dispatcher=dispatcher,
+        description=str(spec.get("description", "")),
+        source=yaml_text,
+    )

@@ -1,0 +1,258 @@
+"""Pipeline manager: versioned definitions + the ingest path.
+
+Role-equivalent of the reference's manager module (reference
+src/pipeline/src/manager/pipeline_operator.rs): pipelines are stored
+versioned (created-at-ms version keys, latest wins), the built-in
+`greptime_identity` pipeline auto-types documents, and `run_pipeline_ingest`
+turns documents into typed rows and writes them to (possibly
+dispatcher-suffixed) tables.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pyarrow as pa
+
+from ..datatypes.data_type import ConcreteDataType
+from ..datatypes.schema import ColumnSchema, Schema, SemanticType
+from ..utils.errors import GreptimeError, InvalidArgumentsError, StatusCode
+from .etl import Pipeline, identity_row, parse_pipeline
+
+GREPTIME_IDENTITY = "greptime_identity"
+DEFAULT_TS_COLUMN = "greptime_timestamp"
+
+
+class PipelineNotFoundError(GreptimeError):
+    def status_code(self) -> StatusCode:
+        return StatusCode.INVALID_ARGUMENTS
+
+
+class PipelineManager:
+    """Versioned pipeline store persisted next to the catalog (the reference
+    keeps them in the greptime_private.pipelines system table)."""
+
+    def __init__(self, data_home: str):
+        self._path = os.path.join(data_home, "pipelines.json")
+        self._lock = threading.Lock()
+        # name -> {version_ms(str) -> yaml}
+        self._store: dict[str, dict[str, str]] = {}
+        self._cache: dict[tuple[str, str], Pipeline] = {}
+        if os.path.exists(self._path):
+            with open(self._path) as f:
+                self._store = json.load(f)
+
+    def _persist(self):
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._store, f)
+        os.replace(tmp, self._path)
+
+    def save(self, name: str, yaml_text: str) -> str:
+        """Validate + store a new version; returns the version key."""
+        if name == GREPTIME_IDENTITY:
+            raise InvalidArgumentsError(f"{GREPTIME_IDENTITY} is reserved")
+        parse_pipeline(yaml_text, name)  # validate before storing
+        version = str(int(time.time() * 1000))
+        with self._lock:
+            versions = self._store.setdefault(name, {})
+            while version in versions:  # same-ms saves
+                version = str(int(version) + 1)
+            versions[version] = yaml_text
+            self._persist()
+        return version
+
+    def get(self, name: str, version: str | None = None) -> Pipeline:
+        if name == GREPTIME_IDENTITY:
+            return Pipeline(name=GREPTIME_IDENTITY)
+        with self._lock:
+            versions = self._store.get(name)
+            if not versions:
+                raise PipelineNotFoundError(f"pipeline not found: {name}")
+            v = version or max(versions, key=int)
+            yaml_text = versions.get(v)
+            if yaml_text is None:
+                raise PipelineNotFoundError(f"pipeline {name} has no version {version}")
+            key = (name, v)
+            if key not in self._cache:
+                self._cache[key] = parse_pipeline(yaml_text, name)
+            return self._cache[key]
+
+    def delete(self, name: str, version: str | None = None):
+        with self._lock:
+            if name not in self._store:
+                raise PipelineNotFoundError(f"pipeline not found: {name}")
+            if version is None:
+                del self._store[name]
+            else:
+                self._store[name].pop(version, None)
+                if not self._store[name]:
+                    del self._store[name]
+            self._cache = {k: v for k, v in self._cache.items() if k[0] != name}
+            self._persist()
+
+    def list(self) -> list[tuple[str, str]]:
+        with self._lock:
+            return sorted(
+                (name, max(vs, key=int)) for name, vs in self._store.items() if vs
+            )
+
+
+_PIPELINES_INIT_LOCK = threading.Lock()
+
+
+def _pipelines(db) -> PipelineManager:
+    mgr = getattr(db, "_pipeline_manager", None)
+    if mgr is None:
+        with _PIPELINES_INIT_LOCK:
+            mgr = getattr(db, "_pipeline_manager", None)
+            if mgr is None:
+                mgr = PipelineManager(db.config.storage.data_home)
+                db._pipeline_manager = mgr
+    return mgr
+
+
+def run_pipeline_ingest(
+    db,
+    pipeline_name: str,
+    docs: list[dict],
+    table: str,
+    database: str = "public",
+    version: str | None = None,
+    max_depth: int = 4,
+) -> int:
+    """Execute a pipeline over documents and insert the rows.
+
+    Dispatcher rules may fan documents out to `<table>_<suffix>` and/or
+    another pipeline (depth-limited, reference dispatcher.rs)."""
+    mgr = _pipelines(db)
+    pipeline = mgr.get(pipeline_name, version)
+    # (table, pipeline) -> rows
+    grouped: dict[str, list[dict]] = {}
+    redispatch: dict[tuple[str, str], list[dict]] = {}
+    for doc in docs:
+        out = pipeline.exec_doc(doc)
+        if out is None:
+            continue  # filtered
+        row_or_doc, rule = out
+        if rule is not None and rule.pipeline:
+            if max_depth <= 0:
+                raise InvalidArgumentsError("pipeline dispatcher recursion too deep")
+            target = f"{table}_{rule.table_suffix}" if rule.table_suffix else table
+            redispatch.setdefault((rule.pipeline, target), []).append(row_or_doc)
+            continue
+        target = f"{table}_{rule.table_suffix}" if rule and rule.table_suffix else table
+        grouped.setdefault(target, []).append(row_or_doc)
+    total = 0
+    for target, rows in grouped.items():
+        total += _write_rows(db, target, rows, database)
+    for (pname, target), subdocs in redispatch.items():
+        total += run_pipeline_ingest(
+            db, pname, subdocs, target, database, max_depth=max_depth - 1
+        )
+    return total
+
+
+def _write_rows(db, table: str, rows: list[dict], database: str) -> int:
+    """rows: [{col -> (value, dtype, index)}] -> ensure table + insert."""
+    from ..servers.otlp import ensure_table
+
+    # Union the column layout over all rows (identity pipelines can vary).
+    layout: dict[str, tuple[ConcreteDataType, str | None]] = {}
+    for row in rows:
+        for name, (_v, dtype, index) in row.items():
+            if name not in layout:
+                layout[name] = (dtype, index)
+            elif layout[name][0] != dtype:
+                layout[name] = (_widen(layout[name][0], dtype), layout[name][1])
+    has_time = any(index == "time" for _d, index in layout.values())
+    if not has_time:
+        # identity pipelines get an ingestion-time ns column (reference
+        # identity_pipeline's greptime_timestamp)
+        layout[DEFAULT_TS_COLUMN] = (ConcreteDataType.TIMESTAMP_NANOSECOND, "time")
+        now_ns = time.time_ns()
+        for i, row in enumerate(rows):
+            # distinct per-row ns so rows without tags don't dedup-collapse
+            row[DEFAULT_TS_COLUMN] = (
+                now_ns + i, ConcreteDataType.TIMESTAMP_NANOSECOND, "time",
+            )
+    columns = []
+    for name, (dtype, index) in layout.items():
+        if index == "time":
+            sem = SemanticType.TIMESTAMP
+        elif index == "tag":
+            sem = SemanticType.TAG
+        else:
+            sem = SemanticType.FIELD
+        columns.append(
+            ColumnSchema(
+                name,
+                dtype,
+                sem,
+                nullable=sem == SemanticType.FIELD,
+                default="" if sem == SemanticType.TAG else None,
+            )
+        )
+    schema = Schema(columns=columns)
+    meta = ensure_table(db, table, schema, database)
+    # New columns may appear vs an existing table; conform to ITS schema and
+    # widen it first when needed.
+    missing = [c for c in columns if not meta.schema.has_column(c.name)]
+    if missing:
+        for c in missing:
+            meta.schema = meta.schema.add_column(c)
+        db.catalog.update_table(meta)
+        for rid in meta.region_ids:
+            db.storage.region(rid).alter_schema(meta.schema)
+    arrays = {}
+    for col in meta.schema.columns:
+        dt = col.data_type
+        vals = []
+        for row in rows:
+            v = row.get(col.name, (None, None, None))[0]
+            if v is None and col.semantic_type == SemanticType.TAG:
+                v = ""
+            vals.append(_coerce(v, dt, col.name))
+        arrays[col.name] = pa.array(vals, dt.to_arrow())
+    return db.insert_rows(meta.name, pa.table(arrays), database=database)
+
+
+def _widen(a: ConcreteDataType, b: ConcreteDataType) -> ConcreteDataType:
+    """Least common type for a cross-document conflict: numerics widen to
+    float64 when a float is involved (int64 otherwise), anything else
+    falls back to string."""
+    if a == b:
+        return a
+    if a.is_numeric() and b.is_numeric():
+        if a.is_float() or b.is_float():
+            return ConcreteDataType.FLOAT64
+        return ConcreteDataType.INT64
+    return ConcreteDataType.STRING
+
+
+def _coerce(v, dt: ConcreteDataType, col: str):
+    """Convert a document value to an existing column's type, raising a
+    client error (HTTP 400) instead of crashing or silently truncating."""
+    if v is None:
+        return None
+    try:
+        if dt in (ConcreteDataType.STRING, ConcreteDataType.JSON):
+            return v if isinstance(v, str) else json.dumps(v, default=str)
+        if dt == ConcreteDataType.BOOLEAN:
+            return bool(v)
+        if dt.is_float():
+            return float(v)
+        if dt.is_timestamp():
+            return int(v)
+        # integer column: a fractional float would silently truncate
+        if isinstance(v, float) and v != int(v):
+            raise ValueError("fractional value in integer column")
+        return int(v)
+    except (TypeError, ValueError) as e:
+        raise InvalidArgumentsError(
+            f"cannot store {v!r} into column {col!r} of type {dt.value} "
+            "(existing table schema wins; adjust the pipeline transform)"
+        ) from e

@@ -109,6 +109,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         self._dispatch()
 
+    def do_DELETE(self):
+        self._dispatch()
+
     def _dispatch(self):
         try:
             route = self.route
@@ -133,6 +136,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._handle_prom_read(params)
             if route.startswith("/v1/otlp/v1/"):
                 return self._handle_otlp(route.rsplit("/", 1)[1], params)
+            if route.startswith("/v1/pipelines/"):
+                return self._handle_pipelines(route[len("/v1/pipelines/") :], params)
+            if route == "/v1/ingest":
+                return self._handle_ingest(params)
             return self._send(404, {"error": f"no route {route}"})
         except GreptimeError as e:
             self._send(400, {"error": str(e), "code": int(e.status_code())})
@@ -185,6 +192,69 @@ class _Handler(BaseHTTPRequestHandler):
             "greptime_http_prom_write_rows_total", "Prom remote-write rows"
         ).inc(n)
         return self._send(204, b"", "text/plain")
+
+    def _handle_pipelines(self, name: str, params):
+        """Create (POST yaml body) / fetch (GET) / delete (DELETE) a pipeline
+        (reference servers/src/http/event.rs pipeline handlers)."""
+        from ..pipeline.manager import _pipelines
+
+        mgr = _pipelines(self.db)
+        if self.command == "POST":
+            body = params.get("__body") or b""
+            yaml_text = body.decode() if isinstance(body, bytes) else str(body)
+            if not yaml_text.strip():
+                return self._send(400, {"error": "empty pipeline body"})
+            version = mgr.save(name, yaml_text)
+            return self._send(200, {"pipelines": [{"name": name, "version": version}]})
+        if self.command == "DELETE":
+            mgr.delete(name, params.get("version"))
+            return self._send(200, {"pipelines": [{"name": name}]})
+        pipeline = mgr.get(name, params.get("version"))
+        return self._send(200, pipeline.source.encode(), "application/x-yaml")
+
+    def _handle_ingest(self, params):
+        """Log ingestion through a named pipeline: NDJSON / JSON array body
+        (reference servers/src/http/event.rs log_ingester)."""
+        import json as _json
+
+        from ..pipeline import GREPTIME_IDENTITY, run_pipeline_ingest
+
+        table = params.get("table")
+        if not table:
+            return self._send(400, {"error": "missing table parameter"})
+        pipeline_name = params.get("pipeline_name", GREPTIME_IDENTITY)
+        body = params.get("__body") or b""
+        text = body.decode() if isinstance(body, bytes) else str(body)
+        docs: list[dict] = []
+        stripped = text.strip()
+        if stripped.startswith("["):
+            try:
+                parsed = _json.loads(stripped)
+            except _json.JSONDecodeError as e:
+                return self._send(400, {"error": f"invalid JSON body: {e}"})
+            docs = [d for d in parsed if isinstance(d, dict)]
+        else:
+            for line in stripped.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = _json.loads(line)
+                except _json.JSONDecodeError:
+                    doc = None
+                if not isinstance(doc, dict):
+                    doc = {"message": line}  # plain-text / scalar log lines
+                docs.append(doc)
+        n = run_pipeline_ingest(
+            self.db,
+            pipeline_name,
+            docs,
+            table,
+            database=params.get("db", "public"),
+            version=params.get("version"),
+        )
+        REGISTRY.counter("greptime_http_ingest_rows_total", "Pipeline ingest rows").inc(n)
+        return self._send(200, {"rows": n})
 
     def _handle_otlp(self, signal: str, params):
         from . import otlp

@@ -758,7 +758,7 @@ def ingest_logs(
     except pw.WireError as e:
         raise InvalidArgumentsError(f"bad OTLP logs body: {e}") from e
     if pipeline_name:  # route rows through the ETL pipeline instead
-        from .pipeline import run_pipeline_ingest
+        from ..pipeline import run_pipeline_ingest
 
         docs: list[dict] = []
         for resource_attrs, scope_name, records in resources:

@@ -1,0 +1,407 @@
+"""Pipeline ETL: processors, transforms, dispatcher, versioning, HTTP ingest.
+
+Mirrors the reference's pipeline tests (reference src/pipeline/src/etl.rs
+test_csv_pipeline / test_dissect_pipeline and tests/pipeline.rs).
+"""
+
+import json
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.pipeline import (
+    GREPTIME_IDENTITY,
+    PipelineManager,
+    parse_pipeline,
+    run_pipeline_ingest,
+)
+from greptimedb_tpu.pipeline.etl import PipelineExecError, PipelineParseError
+from greptimedb_tpu.servers.http import HttpServer
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(data_home=str(tmp_path / "data"))
+    yield d
+    d.close()
+
+
+APACHE_LINE = (
+    '129.37.245.88 - meln1ks [01/Aug/2024:14:22:47 +0800] '
+    '"PATCH /observability/metrics/production HTTP/1.0" 501 33085'
+)
+
+APACHE_PIPELINE = """
+description: apache access logs
+processors:
+  - dissect:
+      fields:
+        - message
+      patterns:
+        - '%{ip} %{?ignored} %{username} [%{ts}] "%{method} %{path} %{proto}" %{status} %{bytes}'
+  - date:
+      fields:
+        - ts
+      formats:
+        - "%d/%b/%Y:%H:%M:%S %z"
+transform:
+  - field: ip
+    type: string
+    index: tag
+  - fields:
+      - username
+      - method
+      - path
+      - proto
+    type: string
+  - field: status
+    type: uint16
+  - field: bytes
+    type: uint64
+  - field: ts
+    type: timestamp, ns
+    index: time
+"""
+
+
+def test_dissect_date_pipeline_exec():
+    p = parse_pipeline(APACHE_PIPELINE, "apache")
+    out = p.exec_doc({"message": APACHE_LINE})
+    assert out is not None
+    row, rule = out
+    assert rule is None
+    assert row["ip"][0] == "129.37.245.88"
+    assert row["username"][0] == "meln1ks"
+    assert row["method"][0] == "PATCH"
+    assert row["status"][0] == 501
+    assert row["bytes"][0] == 33085
+    # 2024-08-01 14:22:47 +08:00 => epoch ns
+    assert row["ts"][0] == 1722493367000000000
+    assert row["ts"][2] == "time"
+    assert "ignored" not in row
+
+
+def test_csv_epoch_pipeline_exec():
+    p = parse_pipeline(
+        """
+processors:
+  - csv:
+      field: my_field
+      target_fields: field1, field2
+  - epoch:
+      field: ts
+      resolution: ns
+transform:
+  - field: field1
+    type: uint32
+  - field: field2
+    type: uint32
+  - field: ts
+    type: timestamp, ns
+    index: time
+""",
+        "csv",
+    )
+    row, _ = p.exec_doc({"my_field": "1,2", "foo": "bar", "ts": "1"})
+    assert row["field1"][0] == 1 and row["field2"][0] == 2
+    assert row["ts"][0] == 1
+
+
+def test_processors_gsub_letter_urlencoding_json():
+    p = parse_pipeline(
+        """
+processors:
+  - gsub:
+      field: msg
+      pattern: "\\\\d+"
+      replacement: "N"
+  - letter:
+      field: level
+      method: upper
+  - urlencoding:
+      field: url
+      method: decode
+  - json_parse:
+      field: payload
+  - simple_extract:
+      field: payload, user
+      key: user.name
+""",
+        "p",
+    )
+    row, _ = p.exec_doc(
+        {
+            "msg": "took 35ms retry 2",
+            "level": "warn",
+            "url": "a%20b%2Fc",
+            "payload": '{"user": {"name": "kit"}}',
+        }
+    )
+    assert row["msg"][0] == "took Nms retry N"
+    assert row["level"][0] == "WARN"
+    assert row["url"][0] == "a b/c"
+    assert row["user"][0] == "kit"
+
+
+def test_filter_and_select_processors():
+    p = parse_pipeline(
+        """
+processors:
+  - filter:
+      field: level
+      match_op: in
+      targets:
+        - debug
+  - select:
+      type: exclude
+      field: secret
+""",
+        "p",
+    )
+    assert p.exec_doc({"level": "DEBUG", "x": 1}) is None  # dropped
+    row, _ = p.exec_doc({"level": "info", "secret": "s", "x": 1})
+    assert "secret" not in row and row["x"][0] == 1
+
+
+def test_regex_and_digest():
+    p = parse_pipeline(
+        """
+processors:
+  - regex:
+      field: line
+      patterns:
+        - "user=(?<user>\\\\w+)"
+  - digest:
+      field: line
+""",
+        "p",
+    )
+    row, _ = p.exec_doc({"line": 'user=bob id=42 took "9ms"'})
+    assert row["line_user"][0] == "bob"
+    assert "42" not in row["line_digest"][0] and '"9ms"' not in row["line_digest"][0]
+
+
+def test_transform_on_failure_and_defaults():
+    p = parse_pipeline(
+        """
+transform:
+  - field: n
+    type: uint32
+    on_failure: default
+    default: 0
+  - field: t
+    type: timestamp, ms
+    index: time
+""",
+        "p",
+    )
+    row, _ = p.exec_doc({"n": "oops", "t": 1_700_000_000_000_000_000})
+    assert row["n"][0] == 0
+    assert row["t"][0] == 1_700_000_000_000  # ns -> ms
+
+    with pytest.raises(PipelineExecError):
+        parse_pipeline("transform:\n  - field: n\n    type: uint32\n", "p").exec_doc({"n": "x"})
+
+
+def test_parse_errors():
+    with pytest.raises(PipelineParseError):
+        parse_pipeline("processors:\n  - nope:\n      field: x\n", "p")
+    with pytest.raises(PipelineParseError):
+        parse_pipeline(
+            "transform:\n"
+            "  - field: a\n    type: timestamp, ms\n    index: time\n"
+            "  - field: b\n    type: timestamp, ms\n    index: time\n",
+            "p",
+        )
+
+
+def test_manager_versioning(tmp_path):
+    mgr = PipelineManager(str(tmp_path))
+    v1 = mgr.save("p", "transform:\n  - field: a\n    type: string\n")
+    v2 = mgr.save("p", "transform:\n  - field: b\n    type: string\n")
+    assert int(v2) > int(v1)
+    assert mgr.get("p").transforms[0].fields[0][0] == "b"  # latest wins
+    assert mgr.get("p", v1).transforms[0].fields[0][0] == "a"
+    # survives restart
+    mgr2 = PipelineManager(str(tmp_path))
+    assert mgr2.get("p").transforms[0].fields[0][0] == "b"
+    mgr2.delete("p", v2)
+    assert mgr2.get("p").transforms[0].fields[0][0] == "a"
+    mgr2.delete("p")
+    with pytest.raises(Exception):
+        mgr2.get("p")
+
+
+def test_ingest_identity_pipeline(db):
+    docs = [
+        {"host": "a", "latency": 12.5, "ok": True},
+        {"host": "b", "latency": 3.25, "ok": False, "extra": "x"},
+    ]
+    n = run_pipeline_ingest(db, GREPTIME_IDENTITY, docs, "svc_logs")
+    assert n == 2
+    t = db.sql_one("SELECT host, latency, extra FROM svc_logs ORDER BY host")
+    assert t["host"].to_pylist() == ["a", "b"]
+    assert t["latency"].to_pylist() == [12.5, 3.25]
+    assert t["extra"].to_pylist() == [None, "x"]
+
+
+def test_ingest_apache_pipeline(db):
+    db._pipeline_manager = PipelineManager(db.config.storage.data_home)
+    db._pipeline_manager.save("apache", APACHE_PIPELINE)
+    n = run_pipeline_ingest(db, "apache", [{"message": APACHE_LINE}], "access_logs")
+    assert n == 1
+    t = db.sql_one("SELECT ip, status, bytes FROM access_logs")
+    assert t["ip"].to_pylist() == ["129.37.245.88"]
+    assert t["status"].to_pylist() == [501]
+
+
+def test_dispatcher_routes_to_suffixed_tables(db):
+    mgr = PipelineManager(db.config.storage.data_home)
+    db._pipeline_manager = mgr
+    mgr.save(
+        "router",
+        """
+dispatcher:
+  field: type
+  rules:
+    - value: http
+      table_suffix: http
+transform:
+  - field: msg
+    type: string
+""",
+    )
+    docs = [{"type": "http", "msg": "GET /"}, {"type": "db", "msg": "SELECT 1"}]
+    n = run_pipeline_ingest(db, "router", docs, "logs")
+    assert n == 2
+    assert db.sql_one("SELECT msg FROM logs_http")["msg"].to_pylist() == ["GET /"]
+    assert db.sql_one("SELECT msg FROM logs")["msg"].to_pylist() == ["SELECT 1"]
+
+
+def test_http_pipeline_endpoints(db):
+    server = HttpServer(db).start(warm=False)
+    try:
+        base = f"http://{server.address}"
+        # create
+        req = urllib.request.Request(
+            f"{base}/v1/pipelines/apache", data=APACHE_PIPELINE.encode(),
+            headers={"Content-Type": "application/x-yaml"},
+        )
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert out["pipelines"][0]["name"] == "apache"
+        # fetch back
+        got = urllib.request.urlopen(f"{base}/v1/pipelines/apache").read().decode()
+        assert "dissect" in got
+        # ingest NDJSON through it
+        body = json.dumps({"message": APACHE_LINE}).encode()
+        req = urllib.request.Request(
+            f"{base}/v1/ingest?" + urllib.parse.urlencode(
+                {"table": "access_logs", "pipeline_name": "apache"}
+            ),
+            data=body, headers={"Content-Type": "application/json"},
+        )
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert out["rows"] == 1
+        # identity ingest of a JSON array
+        req = urllib.request.Request(
+            f"{base}/v1/ingest?" + urllib.parse.urlencode({"table": "plain"}),
+            data=json.dumps([{"a": 1}, {"a": 2}]).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        assert json.loads(urllib.request.urlopen(req).read())["rows"] == 2
+        # delete
+        req = urllib.request.Request(
+            f"{base}/v1/pipelines/apache", method="DELETE"
+        )
+        urllib.request.urlopen(req)
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/v1/pipelines/apache")
+    finally:
+        server.stop()
+
+
+def test_identity_numeric_widening(db):
+    # int-then-float documents must widen to float64, not truncate
+    n = run_pipeline_ingest(db, GREPTIME_IDENTITY, [{"x": 1}, {"x": 2.5}], "w")
+    assert n == 2
+    t = db.sql_one("SELECT x FROM w ORDER BY x")
+    assert t["x"].to_pylist() == [1.0, 2.5]
+
+
+def test_existing_table_type_conflict_is_client_error(db):
+    from greptimedb_tpu.utils.errors import InvalidArgumentsError
+
+    run_pipeline_ingest(db, GREPTIME_IDENTITY, [{"a": 1}], "t1")
+    with pytest.raises(InvalidArgumentsError):
+        run_pipeline_ingest(db, GREPTIME_IDENTITY, [{"a": "x"}], "t1")
+    with pytest.raises(InvalidArgumentsError):  # fractional into int column
+        run_pipeline_ingest(db, GREPTIME_IDENTITY, [{"a": 2.5}], "t1")
+    run_pipeline_ingest(db, GREPTIME_IDENTITY, [{"a": 3.0}], "t1")  # integral ok
+    assert db.sql_one("SELECT count(*) AS c FROM t1")["c"].to_pylist() == [2]
+
+
+def test_epoch_ns_precision():
+    p = parse_pipeline(
+        "processors:\n  - epoch:\n      field: t\n      resolution: ns\n"
+        "transform:\n  - field: t\n    type: timestamp, ns\n    index: time\n",
+        "p",
+    )
+    big = 1722493367123456789  # > 2^53: must not round through float
+    row, _ = p.exec_doc({"t": str(big)})
+    assert row["t"][0] == big
+
+
+def test_date_timezone_handling():
+    p = parse_pipeline(
+        "processors:\n  - date:\n      field: ts\n      formats:\n"
+        "        - \"%Y-%m-%d %H:%M:%S\"\n      timezone: \"+08:00\"\n"
+        "transform:\n  - field: ts\n    type: timestamp, s\n    index: time\n",
+        "p",
+    )
+    row, _ = p.exec_doc({"ts": "2024-08-01 14:22:47"})
+    assert row["ts"][0] == 1722493367  # 14:22:47 at +08:00
+    with pytest.raises(PipelineParseError):
+        parse_pipeline(
+            "processors:\n  - date:\n      field: ts\n      timezone: Not/AZone\n",
+            "p",
+        )
+
+
+def test_otlp_logs_via_pipeline(db):
+    from greptimedb_tpu.servers import otlp
+
+    mgr = PipelineManager(db.config.storage.data_home)
+    db._pipeline_manager = mgr
+    mgr.save(
+        "sev",
+        """
+processors:
+  - letter:
+      field: severity_text
+      method: upper
+  - epoch:
+      field: timestamp
+      resolution: ns
+transform:
+  - field: severity_text
+    type: string
+    index: tag
+  - field: body
+    type: string
+  - field: timestamp
+    type: timestamp, ns
+    index: time
+""",
+    )
+    NS = 1_000_000_000
+    body = otlp.encode_logs_request(
+        {"service.name": "svc"},
+        [otlp.OtlpLogRecord(time_unix_nano=7 * NS, severity_text="warn", body="disk full")],
+    )
+    n = otlp.ingest_logs(db, body, table="piped_logs", pipeline_name="sev")
+    assert n == 1
+    t = db.sql_one("SELECT severity_text, body FROM piped_logs")
+    assert t["severity_text"].to_pylist() == ["WARN"]
+    assert t["body"].to_pylist() == ["disk full"]
