@@ -10,6 +10,7 @@ split_rows fan-out, operator/src/insert.rs:321).
 from __future__ import annotations
 
 import os
+import threading
 
 import numpy as np
 import pyarrow as pa
@@ -64,6 +65,10 @@ class Database:
         self.storage = TimeSeriesEngine(self.config.storage)
         catalog_path = os.path.join(self.config.storage.data_home, "catalog.json")
         self.catalog = Catalog(catalog_path)
+        # Serializes schema-mutating DDL (auto-alter on ingest, ALTER TABLE)
+        # the way the reference's DDL procedures take key-range locks
+        # (common/procedure/src/local/rwlock.rs).
+        self.ddl_lock = threading.RLock()
 
         self.metric = MetricEngine(self)
         from .flow.engine import FlowManager
@@ -219,16 +224,17 @@ class Database:
         if stmt.partition_by_hash is not None:
             cols, n = stmt.partition_by_hash
             rule = HashPartitionRule(cols, n)
-        meta = self.catalog.create_table(
+        self.catalog.create_table(
             stmt.name,
             schema,
             partition_rule=rule,
             database=self.current_database,
             if_not_exists=stmt.if_not_exists,
             options=stmt.options,
+            on_create=lambda m: [
+                self.storage.create_region(rid, schema) for rid in m.region_ids
+            ],
         )
-        for rid in meta.region_ids:
-            self.storage.create_region(rid, schema)
         return None
 
     def _drop(self, stmt: DropStmt):

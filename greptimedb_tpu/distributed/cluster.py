@@ -149,14 +149,29 @@ class Cluster:
             if partitions > 1
             else SingleRegionRule()
         )
-        meta = self.catalog.create_table(name, schema, partition_rule=rule, database=database)
-        routes: dict[int, int] = {}
-        for rid in meta.region_ids:
-            node = self.metasrv.select_datanode()
-            self.datanodes[node].open_region(rid, schema)
-            routes[rid] = node
-        self.metasrv.set_route(meta.table_id, routes)
-        return meta
+        def place_regions(m):
+            routes: dict[int, int] = {}
+            try:
+                for rid in m.region_ids:
+                    node = self.metasrv.select_datanode()
+                    self.datanodes[node].open_region(rid, schema)
+                    routes[rid] = node
+            except Exception:
+                # creation failed partway: close the regions already opened
+                # so no orphans outlive the unpublished table (the reference
+                # rolls back via the DDL procedure's on_failure path)
+                for rid, node in routes.items():
+                    try:
+                        self.datanodes[node].close_region(rid)
+                    except Exception:
+                        pass
+                raise
+            self.metasrv.set_route(m.table_id, routes)
+
+        return self.catalog.create_table(
+            name, schema, partition_rule=rule, database=database,
+            on_create=place_regions,
+        )
 
     # ---- DML --------------------------------------------------------------
     def insert(self, table: str, batch: pa.RecordBatch, database: str = "public") -> int:

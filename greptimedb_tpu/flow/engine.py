@@ -517,11 +517,15 @@ def _ensure_sink_table(
             )
         )
     schema = Schema(columns=columns)
-    meta = db.catalog.create_table(
-        info.sink_table, schema, database=info.database, if_not_exists=True
+    db.catalog.create_table(
+        info.sink_table,
+        schema,
+        database=info.database,
+        if_not_exists=True,
+        on_create=lambda m: [
+            db.storage.create_region(rid, schema) for rid in m.region_ids
+        ],
     )
-    for rid in meta.region_ids:
-        db.storage.create_region(rid, schema)
     return schema
 
 

@@ -176,9 +176,10 @@ class MetricEngine:
             database=database,
             if_not_exists=if_not_exists,
             options={PHYSICAL_TABLE_OPT: "", "ts_col": ts_col, "val_col": val_col},
+            on_create=lambda m: [
+                self.db.storage.create_region(rid, m.schema) for rid in m.region_ids
+            ],
         )
-        for rid in meta.region_ids:
-            self.db.storage.create_region(rid, meta.schema)
         return meta
 
     def ensure_physical_table(

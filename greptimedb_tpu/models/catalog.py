@@ -117,7 +117,13 @@ class Catalog:
         database: str = DEFAULT_SCHEMA,
         if_not_exists: bool = False,
         options: dict | None = None,
+        on_create=None,
     ) -> TableMeta:
+        """Create a table. `on_create(meta)` runs under the catalog lock
+        before the table becomes visible, so callers can create storage
+        regions atomically with the metadata publish (the reference commits
+        region creation and KV metadata in one DDL procedure step,
+        common/meta/src/ddl/create_table.rs)."""
         with self._lock:
             db = self._db(database)
             if name in db:
@@ -133,6 +139,8 @@ class Catalog:
                 options=options or {},
             )
             self._next_table_id += 1
+            if on_create is not None:
+                on_create(meta)
             db[name] = meta
             self._persist()
             return meta

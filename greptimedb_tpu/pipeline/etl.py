@@ -33,6 +33,18 @@ class DropDocument(Exception):
     """Raised by the filter processor to discard the current document."""
 
 
+class TsNs(int):
+    """Epoch-nanosecond value produced by a date/epoch processor.
+
+    Marks the value as already-normalized so a downstream timestamp
+    transform rescales from ns, while a raw (unprocessed) number is
+    interpreted in the transform's declared unit — matching the reference,
+    where processors emit typed Timestamp values and `type: epoch, ms`
+    on a raw field means "this number is in ms"."""
+
+    __slots__ = ()
+
+
 # ---- helpers ----------------------------------------------------------------
 
 
@@ -165,6 +177,9 @@ class DissectProcessor(Processor):
         return out
 
 
+_EPOCH = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+
+
 class DateProcessor(Processor):
     """strptime into an epoch-ns timestamp (reference processor/date.rs)."""
 
@@ -201,7 +216,13 @@ class DateProcessor(Processor):
                 continue
             if dt.tzinfo is None:
                 dt = dt.replace(tzinfo=self.tz or datetime.timezone.utc)
-            doc[dst] = int(dt.timestamp() * 1_000_000) * 1000
+            # exact integer arithmetic: float timestamp() truncation can be
+            # off by 1us on ~1% of fractional-second inputs
+            delta = dt - _EPOCH
+            doc[dst] = TsNs(
+                (delta.days * 86_400 + delta.seconds) * 1_000_000_000
+                + delta.microseconds * 1_000
+            )
             return
         raise PipelineExecError(f"date: {text!r} matches none of {self.formats}")
 
@@ -233,7 +254,7 @@ class EpochProcessor(Processor):
                 n = int(float(v))
             except (TypeError, ValueError) as e:
                 raise PipelineExecError(f"epoch: {v!r} is not numeric") from e
-        doc[dst] = n * self.factor
+        doc[dst] = TsNs(n * self.factor)
 
 
 class CsvProcessor(Processor):
@@ -514,8 +535,13 @@ class TransformRule:
             if v is None:
                 raise ValueError("null")
             if self.dtype.is_timestamp():
-                # processors emit epoch-ns; rescale to the declared unit
-                return int(v) // self.dtype.timestamp_unit_ns()
+                if isinstance(v, TsNs):
+                    # a date/epoch processor normalized to epoch-ns;
+                    # rescale to the declared unit
+                    return int(v) // self.dtype.timestamp_unit_ns()
+                # raw field: the declared unit IS the input unit
+                # (reference `type: epoch, ms` semantics)
+                return int(v)
             if self.dtype == ConcreteDataType.BOOLEAN:
                 if isinstance(v, str):
                     return v.lower() in ("1", "t", "true", "yes")

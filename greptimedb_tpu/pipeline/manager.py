@@ -197,16 +197,22 @@ def _write_rows(db, table: str, rows: list[dict], database: str) -> int:
             )
         )
     schema = Schema(columns=columns)
-    meta = ensure_table(db, table, schema, database)
-    # New columns may appear vs an existing table; conform to ITS schema and
-    # widen it first when needed.
-    missing = [c for c in columns if not meta.schema.has_column(c.name)]
-    if missing:
-        for c in missing:
-            meta.schema = meta.schema.add_column(c)
-        db.catalog.update_table(meta)
-        for rid in meta.region_ids:
-            db.storage.region(rid).alter_schema(meta.schema)
+    # Widening an existing table's schema is a read-modify-write on shared
+    # catalog state; concurrent ingest threads (ThreadingHTTPServer) would
+    # otherwise lose columns, so serialize under the db DDL lock. Regions
+    # are altered before the catalog publishes the widened schema so a
+    # concurrent query never sees a column the regions lack.
+    with db.ddl_lock:
+        meta = ensure_table(db, table, schema, database)
+        missing = [c for c in columns if not meta.schema.has_column(c.name)]
+        if missing:
+            widened = meta.schema
+            for c in missing:
+                widened = widened.add_column(c)
+            for rid in meta.region_ids:
+                db.storage.region(rid).alter_schema(widened)
+            meta.schema = widened
+            db.catalog.update_table(meta)
     arrays = {}
     for col in meta.schema.columns:
         dt = col.data_type

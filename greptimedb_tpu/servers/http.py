@@ -227,12 +227,19 @@ class _Handler(BaseHTTPRequestHandler):
         text = body.decode() if isinstance(body, bytes) else str(body)
         docs: list[dict] = []
         stripped = text.strip()
-        if stripped.startswith("["):
+        whole = None
+        if stripped.startswith(("[", "{")):
+            # whole-body JSON first (array of docs, or one possibly
+            # pretty-printed object); fall back to NDJSON line splitting
             try:
-                parsed = _json.loads(stripped)
-            except _json.JSONDecodeError as e:
-                return self._send(400, {"error": f"invalid JSON body: {e}"})
-            docs = [d for d in parsed if isinstance(d, dict)]
+                whole = _json.loads(stripped)
+            except _json.JSONDecodeError:
+                if stripped.startswith("["):
+                    return self._send(400, {"error": "invalid JSON array body"})
+        if isinstance(whole, list):
+            docs = [d for d in whole if isinstance(d, dict)]
+        elif isinstance(whole, dict):
+            docs = [whole]
         else:
             for line in stripped.splitlines():
                 line = line.strip()

@@ -208,10 +208,14 @@ def write_points(db, points: list[Point], default_now_ms: int | None = None) -> 
             )
             columns += [ColumnSchema(f, t, SemanticType.FIELD) for f, t in field_types.items()]
             meta = db.catalog.create_table(
-                table_name, Schema(columns=columns), database=db.current_database
+                table_name,
+                Schema(columns=columns),
+                database=db.current_database,
+                if_not_exists=True,
+                on_create=lambda m: [
+                    db.storage.create_region(rid, m.schema) for rid in m.region_ids
+                ],
             )
-            for rid in meta.region_ids:
-                db.storage.create_region(rid, meta.schema)
         else:
             meta = db.catalog.table(table_name, db.current_database)
             schema = meta.schema

@@ -551,9 +551,10 @@ def ensure_table(db, name: str, schema: Schema, database: str):
         meta = db.catalog.create_table(
             name, schema, partition_rule=SingleRegionRule(), database=database,
             if_not_exists=True,
+            on_create=lambda m: [
+                db.storage.create_region(rid, schema) for rid in m.region_ids
+            ],
         )
-        for rid in meta.region_ids:
-            db.storage.create_region(rid, schema)
         return meta
 
 

@@ -118,11 +118,32 @@ class Region:
         if not self.writable:
             raise RegionReadonlyError(f"region {self.region_id} is read-only")
         with self._lock:
+            batch = self._conform(batch)
             self.wal.append(batch)
             self.sequence += 1
             self.memtable.write(batch, self.sequence)
         metrics.WRITE_ROWS_TOTAL.inc(batch.num_rows)
         return batch.num_rows
+
+    def _conform(self, batch: pa.RecordBatch) -> pa.RecordBatch:
+        """Project a write onto the region's current schema: a batch built
+        against an older (narrower) schema gets nulls for columns added by
+        a concurrent ALTER, and columns come out in schema order so every
+        memtable chunk shares one schema (the reference's write-compat shim,
+        mito2/src/read/compat.rs, does this on read instead)."""
+        target = self.schema.to_arrow()
+        if batch.schema.equals(target):
+            return batch
+        n = batch.num_rows
+        arrays = []
+        for f in target:
+            i = batch.schema.get_field_index(f.name)
+            if i >= 0:
+                col = batch.column(i)
+                arrays.append(col if col.type == f.type else col.cast(f.type))
+            else:
+                arrays.append(pa.nulls(n, f.type))
+        return pa.RecordBatch.from_arrays(arrays, schema=target)
 
     # ---- flush ------------------------------------------------------------
     def flush(self) -> list[FileMeta]:

@@ -197,9 +197,27 @@ transform:
 """,
         "p",
     )
-    row, _ = p.exec_doc({"n": "oops", "t": 1_700_000_000_000_000_000})
+    # a raw numeric field is interpreted in the declared unit (epoch-ms)
+    row, _ = p.exec_doc({"n": "oops", "t": 1_700_000_000_000})
     assert row["n"][0] == 0
-    assert row["t"][0] == 1_700_000_000_000  # ns -> ms
+    assert row["t"][0] == 1_700_000_000_000
+
+    # but a processor-produced timestamp is epoch-ns and gets rescaled
+    p2 = parse_pipeline(
+        """
+processors:
+  - epoch:
+      field: t
+      resolution: s
+transform:
+  - field: t
+    type: timestamp, ms
+    index: time
+""",
+        "p2",
+    )
+    row2, _ = p2.exec_doc({"t": 1_700_000_000})
+    assert row2["t"][0] == 1_700_000_000_000  # s -> ns -> ms
 
     with pytest.raises(PipelineExecError):
         parse_pipeline("transform:\n  - field: n\n    type: uint32\n", "p").exec_doc({"n": "x"})
