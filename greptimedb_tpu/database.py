@@ -21,8 +21,10 @@ from .models.catalog import DEFAULT_SCHEMA, Catalog, region_id
 from .models.partition import HashPartitionRule, SingleRegionRule
 from .query.engine import QueryEngine
 from .query.logical_plan import TableScan
+from .query.expr import Column
 from .query.sql_parser import (
     AdminStmt,
+    AlterTableStmt,
     CreateDatabaseStmt,
     CreateFlowStmt,
     CreateTableStmt,
@@ -34,6 +36,7 @@ from .query.sql_parser import (
     SelectStmt,
     ShowStmt,
     TqlStmt,
+    TruncateStmt,
     UseStmt,
     parse_sql,
 )
@@ -139,7 +142,11 @@ class Database:
         if isinstance(stmt, TqlStmt):
             return self._tql(stmt)
         if isinstance(stmt, DeleteStmt):
-            raise UnsupportedError("DELETE is not supported yet")
+            return self._delete(stmt)
+        if isinstance(stmt, AlterTableStmt):
+            return self._alter(stmt)
+        if isinstance(stmt, TruncateStmt):
+            return self._truncate(stmt)
         raise UnsupportedError(f"unsupported statement: {type(stmt).__name__}")
 
     # ---- DDL --------------------------------------------------------------
@@ -236,6 +243,133 @@ class Database:
             ],
         )
         return None
+
+    # ---- ALTER / TRUNCATE / DELETE ----------------------------------------
+    def _alter(self, stmt: AlterTableStmt):
+        """ALTER TABLE (reference operator/src/statement/ddl.rs alter path +
+        common/meta/src/ddl/alter_table.rs procedure)."""
+        with self.ddl_lock:
+            meta = self.catalog.table(stmt.table, self.current_database)
+            if is_logical_meta(meta) or is_physical_meta(meta):
+                raise UnsupportedError(
+                    "ALTER TABLE on metric-engine tables is not supported"
+                )
+            if stmt.action == "rename":
+                self.catalog.rename_table(
+                    stmt.table, stmt.new_name, self.current_database
+                )
+                return None
+            if stmt.action == "set_options":
+                meta.options.update({k: str(v) for k, v in stmt.options.items()})
+                self.catalog.update_table(meta)
+                return None
+            if stmt.action == "unset_options":
+                for k in stmt.unset_keys:
+                    meta.options.pop(k, None)
+                self.catalog.update_table(meta)
+                return None
+            schema = meta.schema
+            if stmt.action == "add_columns":
+                for cd in stmt.add_columns:
+                    if cd.is_time_index or cd.is_primary_key:
+                        raise InvalidArgumentsError(
+                            "only FIELD columns can be added (tags are part "
+                            "of the primary key; the time index is fixed)"
+                        )
+                    schema = schema.add_column(
+                        ColumnSchema(
+                            name=cd.name,
+                            data_type=ConcreteDataType.parse(cd.type_name),
+                            semantic_type=SemanticType.FIELD,
+                            nullable=True,
+                            default=cd.default,
+                        )
+                    )
+            elif stmt.action == "drop_columns":
+                for name in stmt.drop_columns:
+                    schema = schema.drop_column(name)
+            elif stmt.action == "modify_columns":
+                for name, tname in stmt.modify_columns:
+                    col = schema.column(name)
+                    if col.semantic_type != SemanticType.FIELD:
+                        raise InvalidArgumentsError(
+                            f"only FIELD columns can change type: {name!r}"
+                        )
+                    new_dt = ConcreteDataType.parse(tname)
+                    old_dt = col.data_type
+                    castable = (
+                        (old_dt.is_numeric() and new_dt.is_numeric())
+                        or new_dt == ConcreteDataType.STRING
+                        or old_dt == new_dt
+                    )
+                    if not castable:
+                        # existing SST data must remain scannable: only
+                        # lossless-ish casts are allowed (the reference
+                        # rejects incompatible modify the same way)
+                        raise InvalidArgumentsError(
+                            f"cannot change column {name!r} from "
+                            f"{old_dt.value} to {new_dt.value}"
+                        )
+                    new_cols = [
+                        ColumnSchema(
+                            name=c.name,
+                            data_type=(
+                                ConcreteDataType.parse(tname)
+                                if c.name == name
+                                else c.data_type
+                            ),
+                            semantic_type=c.semantic_type,
+                            nullable=c.nullable,
+                            default=c.default,
+                        )
+                        for c in schema.columns
+                    ]
+                    schema = Schema(columns=new_cols, version=schema.version + 1)
+            else:
+                raise UnsupportedError(f"unsupported ALTER action: {stmt.action}")
+            # regions first, catalog publish second (same ordering rationale
+            # as pipeline widening: queries never see columns regions lack)
+            for rid in meta.region_ids:
+                self.storage.region(rid).alter_schema(schema)
+            meta.schema = schema
+            self.catalog.update_table(meta)
+            return None
+
+    def _truncate(self, stmt: TruncateStmt):
+        meta = self.catalog.table(stmt.table, self.current_database)
+        if is_logical_meta(meta) or is_physical_meta(meta):
+            # truncating the shared physical regions would wipe every
+            # logical table multiplexed onto them
+            raise UnsupportedError("TRUNCATE on metric-engine tables is not supported")
+        for rid in meta.region_ids:
+            self.storage.truncate_region(rid)
+        return None
+
+    def _delete(self, stmt: DeleteStmt) -> int:
+        """DELETE FROM t [WHERE ...]: resolve live matching keys through the
+        query engine, then tombstone them per region (the reference converts
+        deletes to OpType::Delete rows routed like inserts,
+        operator/src/delete.rs)."""
+        meta = self.catalog.table(stmt.table, self.current_database)
+        if is_logical_meta(meta) or is_physical_meta(meta):
+            raise UnsupportedError(
+                "DELETE on metric-engine tables is not supported"
+            )
+        proj = [c.name for c in meta.schema.tag_columns()]
+        if meta.schema.time_index is not None:
+            proj.append(meta.schema.time_index.name)
+        if not proj:
+            raise UnsupportedError("DELETE requires a table with keys")
+        sel = SelectStmt(
+            projections=[Column(c) for c in proj], table=stmt.table, where=stmt.where
+        )
+        keys = self.query_engine.execute_select(sel, self.current_database)
+        if keys.num_rows == 0:
+            return 0
+        for i, part in enumerate(meta.partition_rule.split(keys)):
+            if part.num_rows:
+                self.storage.delete(region_id(meta.table_id, i), part)
+        return keys.num_rows
 
     def _drop(self, stmt: DropStmt):
         if stmt.kind == "flow":

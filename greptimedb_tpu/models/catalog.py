@@ -154,6 +154,23 @@ class Catalog:
             self._persist()
             return meta
 
+    def rename_table(
+        self, old: str, new: str, database: str = DEFAULT_SCHEMA
+    ) -> TableMeta:
+        """Rename keeps table_id and regions (the reference's RenameTable
+        alter kind rewrites only the name keys, common/meta/src/key/table_name.rs)."""
+        with self._lock:
+            db = self._db(database)
+            if old not in db:
+                raise TableNotFoundError(f"table not found: {database}.{old}")
+            if new in db:
+                raise TableAlreadyExistsError(f"table {new!r} already exists")
+            meta = db.pop(old)
+            meta.name = new
+            db[new] = meta
+            self._persist()
+            return meta
+
     def table(self, name: str, database: str = DEFAULT_SCHEMA) -> TableMeta:
         with self._lock:
             db = self._db(database)

@@ -300,7 +300,8 @@ class CpuExecutor:
                 if fn == "count" and agg.arg is None:
                     if "__one" not in work.column_names:
                         work = work.append_column("__one", pa.array(np.ones(work.num_rows, dtype=np.int64)))
-                    specs.append(("__one", "sum"))
+                    # "count" (not "sum") so an empty input yields 0, not null
+                    specs.append(("__one", "count"))
                     out_names.append(out_name)
                     continue
                 argname = f"__agg_{len(specs)}"

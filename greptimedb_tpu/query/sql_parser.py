@@ -205,6 +205,26 @@ class DeleteStmt:
     where: Expr | None
 
 
+@dataclass
+class AlterTableStmt:
+    """ALTER TABLE: add/drop/modify columns, rename, set/unset options
+    (reference sql/src/statements/alter.rs `AlterTableOperation`)."""
+
+    table: str
+    action: str  # add_columns|drop_columns|modify_columns|rename|set_options|unset_options
+    add_columns: list[ColumnDef] = field(default_factory=list)
+    drop_columns: list[str] = field(default_factory=list)
+    modify_columns: list[tuple[str, str]] = field(default_factory=list)  # (name, new type)
+    new_name: str | None = None
+    options: dict = field(default_factory=dict)
+    unset_keys: list[str] = field(default_factory=list)
+
+
+@dataclass
+class TruncateStmt:
+    table: str
+
+
 class Parser:
     def __init__(self, sql: str):
         self.tokens = tokenize(sql)
@@ -302,7 +322,75 @@ class Parser:
             if self.eat_kw("where"):
                 where = self.parse_expr()
             return DeleteStmt(table, where)
+        if self.at_kw("alter"):
+            return self.parse_alter()
+        if self.at_kw("truncate"):
+            self.next()
+            self.eat_kw("table")
+            return TruncateStmt(self.ident())
         raise InvalidSyntaxError(f"unsupported statement: {self.peek().value!r}")
+
+    # ---- ALTER ------------------------------------------------------------
+    def parse_alter(self) -> AlterTableStmt:
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        stmt = AlterTableStmt(table=self.ident(), action="")
+        if self.at_kw("add"):
+            stmt.action = "add_columns"
+            while self.eat_kw("add"):
+                self.eat_kw("column")
+                stmt.add_columns.append(self.parse_column_def())
+                if not self.eat_op(","):
+                    break
+            return stmt
+        if self.at_kw("drop"):
+            stmt.action = "drop_columns"
+            while self.eat_kw("drop"):
+                self.eat_kw("column")
+                stmt.drop_columns.append(self.ident())
+                if not self.eat_op(","):
+                    break
+            return stmt
+        if self.eat_kw("modify"):
+            stmt.action = "modify_columns"
+            while True:
+                self.eat_kw("column")
+                name = self.ident()
+                stmt.modify_columns.append((name, self.parse_type_name()))
+                if not (self.eat_op(",") and self.eat_kw("modify")):
+                    break
+            return stmt
+        if self.eat_kw("rename"):
+            stmt.action = "rename"
+            self.eat_kw("to")
+            stmt.new_name = self.ident()
+            return stmt
+        if self.eat_kw("set"):
+            stmt.action = "set_options"
+            while True:
+                k = self.parse_option_key()
+                self.expect_op("=")
+                stmt.options[k] = self.parse_literal_value()
+                if not self.eat_op(","):
+                    break
+            return stmt
+        if self.eat_kw("unset"):
+            stmt.action = "unset_options"
+            while True:
+                stmt.unset_keys.append(self.parse_option_key())
+                if not self.eat_op(","):
+                    break
+            return stmt
+        raise InvalidSyntaxError(
+            f"unsupported ALTER TABLE action near {self.peek().value!r}"
+        )
+
+    def parse_option_key(self) -> str:
+        t = self.peek()
+        if t.kind == "string":
+            self.next()
+            return t.value[1:-1].replace("''", "'")
+        return self.ident()
 
     # ---- SELECT -----------------------------------------------------------
     def parse_select(self) -> SelectStmt:
@@ -739,8 +827,7 @@ class Parser:
                 break
         return stmt
 
-    def parse_column_def(self) -> ColumnDef:
-        name = self.ident()
+    def parse_type_name(self) -> str:
         type_parts = [self.ident()]
         if self.at_op("("):  # e.g. TIMESTAMP(3), VARCHAR(255)
             self.next()
@@ -750,7 +837,11 @@ class Parser:
         if self.at_kw("unsigned"):
             self.next()
             type_parts.append("unsigned")
-        col = ColumnDef(name=name, type_name=" ".join(type_parts))
+        return " ".join(type_parts)
+
+    def parse_column_def(self) -> ColumnDef:
+        name = self.ident()
+        col = ColumnDef(name=name, type_name=self.parse_type_name())
         while True:
             if self.eat_kw("not"):
                 self.expect_kw("null")

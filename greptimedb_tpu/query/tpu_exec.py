@@ -180,6 +180,22 @@ class TpuExecutor:
         table = result.to_table()
         metrics.TPU_LOWERED_TOTAL.inc()
         table = self._rename_to_plan_names(table, lowering, schema)
+        if (
+            not lowering.group_tags
+            and lowering.bucket is None
+            and table.num_rows == 0
+        ):
+            # SQL semantics: an ungrouped aggregate over empty input yields
+            # one row — count()=0, everything else null
+            cols = {}
+            for ae in lowering.agg_exprs:
+                inner = strip_alias(ae)
+                is_count = isinstance(inner, AggCall) and inner.func == "count"
+                cols[inner.name()] = pa.array(
+                    [0 if is_count else None],
+                    pa.int64() if is_count else pa.float64(),
+                )
+            table = pa.table(cols)
         return self._run_post_ops(table, lowering)
 
     def _rename_to_plan_names(self, table: pa.Table, lowering: Lowering, schema: Schema) -> pa.Table:

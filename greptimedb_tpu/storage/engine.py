@@ -118,6 +118,17 @@ class TimeSeriesEngine:
             self.flush_region(region_id)
         return rows
 
+    def delete(self, region_id: int, keys: pa.Table) -> int:
+        """Tombstone-delete rows by (primary key, time index) keys."""
+        region = self.region(region_id)
+        deleted = region.delete(keys)
+        self.buffer_mgr.set_region_usage(region_id, region.memtable.memory_usage)
+        return deleted
+
+    def truncate_region(self, region_id: int):
+        self.region(region_id).truncate()
+        self.buffer_mgr.set_region_usage(region_id, 0)
+
     def flush_region(self, region_id: int):
         region = self._regions.get(region_id)
         if region is None:

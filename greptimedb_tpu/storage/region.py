@@ -21,6 +21,7 @@ import threading
 import time
 from dataclasses import dataclass
 
+import numpy as np
 import pyarrow as pa
 
 from ..datatypes.schema import Schema
@@ -30,6 +31,15 @@ from .manifest import ManifestManager
 from .memtable import Memtable
 from .sst import FileMeta, ScanPredicate, SstReader, SstWriter
 from .wal import RegionWal
+
+# Per-row operation marker carried through memtable, WAL and SSTs
+# (reference api::v1::OpType / mito2 key-value op types): 0 = put,
+# 1 = delete tombstone.  Tombstones win dedup (they carry a later
+# sequence) and are dropped from scan output; they persist through
+# flush/compaction so deletes survive restarts and file merges.
+OP_COL = "__op"
+OP_PUT = 0
+OP_DELETE = 1
 
 
 @dataclass
@@ -108,7 +118,7 @@ class Region:
         replayed = 0
         for entry in self.wal.replay(start):
             self.sequence += 1
-            self.memtable.write(entry.batch, self.sequence)
+            self.memtable.write(self._conform(entry.batch), self.sequence)
             replayed += entry.batch.num_rows
         return replayed
 
@@ -126,12 +136,13 @@ class Region:
         return batch.num_rows
 
     def _conform(self, batch: pa.RecordBatch) -> pa.RecordBatch:
-        """Project a write onto the region's current schema: a batch built
-        against an older (narrower) schema gets nulls for columns added by
-        a concurrent ALTER, and columns come out in schema order so every
-        memtable chunk shares one schema (the reference's write-compat shim,
+        """Project a write onto the region's current schema (+ the __op
+        marker): a batch built against an older (narrower) schema gets nulls
+        for columns added by a concurrent ALTER, puts without a marker get
+        __op=0, and columns come out in schema order so every memtable chunk
+        shares one schema (the reference's write-compat shim,
         mito2/src/read/compat.rs, does this on read instead)."""
-        target = self.schema.to_arrow()
+        target = self.schema.to_arrow().append(pa.field(OP_COL, pa.int8()))
         if batch.schema.equals(target):
             return batch
         n = batch.num_rows
@@ -141,9 +152,31 @@ class Region:
             if i >= 0:
                 col = batch.column(i)
                 arrays.append(col if col.type == f.type else col.cast(f.type))
+            elif f.name == OP_COL:
+                arrays.append(pa.array(np.zeros(n, dtype=np.int8)))
             else:
                 arrays.append(pa.nulls(n, f.type))
         return pa.RecordBatch.from_arrays(arrays, schema=target)
+
+    def delete(self, keys: pa.Table | pa.RecordBatch) -> int:
+        """Delete by key: `keys` carries the primary-key + time-index columns
+        of the rows to remove.  Writes tombstone rows (__op=1) through the
+        normal WAL/memtable path — _conform null-fills the field columns —
+        and dedup hides the victims immediately (reference mito2 handles
+        OpType::Delete the same way)."""
+        if isinstance(keys, pa.Table):
+            keys = keys.combine_chunks()
+            batches = keys.to_batches()
+        else:
+            batches = [keys]
+        deleted = 0
+        for b in batches:
+            if b.num_rows == 0:
+                continue
+            op = pa.array(np.full(b.num_rows, OP_DELETE, dtype=np.int8))
+            self.write(b.append_column(pa.field(OP_COL, pa.int8()), op))
+            deleted += b.num_rows
+        return deleted
 
     # ---- flush ------------------------------------------------------------
     def flush(self) -> list[FileMeta]:
@@ -224,8 +257,23 @@ class Region:
             mems = list(self._frozen_memtables) + [self.memtable]
             self._active_scans += 1
         try:
+            # Filters on key columns (tags + time index) are dedup-safe for
+            # pruning/pre-filtering: a newer version of a row (overwrite or
+            # tombstone) has the same key, so both versions pass or fail
+            # together.  Filters on FIELD columns must wait until after
+            # cross-source dedup — a stale SST row could pass a field filter
+            # while its memtable replacement (new value / tombstone with null
+            # fields) fails it, resurrecting overwritten data (the reference
+            # orders DedupReader before filter eval the same way).
+            key_cols = set(c.name for c in self.schema.tag_columns())
+            if self.schema.time_index is not None:
+                key_cols.add(self.schema.time_index.name)
+            key_filters = [f for f in pred.filters if f[0] in key_cols]
+            post_filters = [f for f in pred.filters if f[0] not in key_cols]
+            prune_pred = ScanPredicate(time_range=pred.time_range, filters=key_filters)
+
             # Projection pushdown: read only requested columns plus the
-            # pk/ts columns dedup needs; final select() trims the extras.
+            # pk/ts/__op columns dedup needs; final select() trims extras.
             read_cols = None
             if columns:
                 need = list(dict.fromkeys(columns))
@@ -237,12 +285,13 @@ class Region:
                 for name, _op, _v in pred.filters:
                     if self.schema.has_column(name) and name not in need:
                         need.append(name)
+                need.append(OP_COL)
                 read_cols = need
             tables = []
-            for meta in self.sst_reader.prune_files(files, pred):
-                t = self.sst_reader.read(meta, pred, columns=read_cols)
+            for meta in self.sst_reader.prune_files(files, prune_pred):
+                t = self.sst_reader.read(meta, prune_pred, columns=read_cols)
                 if t.num_rows:
-                    tables.append(_undict(t))
+                    tables.append(self._compat_cast(_undict(t)))
             n_sst_tables = len(tables)
             from .sst import _apply_residual
 
@@ -251,7 +300,7 @@ class Region:
             for mem in mems:
                 mem_table = mem.scan(pred.time_range)
                 if mem_table.num_rows:
-                    mem_table = _apply_residual(mem_table, pred, ts_name)
+                    mem_table = _apply_residual(mem_table, prune_pred, ts_name)
                 if mem_table.num_rows:
                     if read_cols:
                         mem_table = mem_table.select(
@@ -266,13 +315,54 @@ class Region:
                 out = self._dedup_across_sources(
                     out, had_multiple=len(tables) > 1 or (n_sst_tables and mem_rows)
                 )
+                out = self._drop_tombstones(out)
+                if post_filters:
+                    out = _apply_residual(
+                        out, ScanPredicate(filters=post_filters), None
+                    )
             if columns:
                 out = out.select(columns)
+            else:
+                # normalize to the CURRENT schema: old SSTs may still carry
+                # columns dropped by ALTER
+                want = [c for c in self.schema.column_names() if c in out.column_names]
+                if want != out.column_names:
+                    out = out.select(want)
             return out
         finally:
             with self._lock:
                 self._active_scans -= 1
                 self._purge_garbage_locked()
+
+    def _compat_cast(self, table: pa.Table) -> pa.Table:
+        """Cast an old SST's columns to the CURRENT schema types so scans
+        after ALTER ... MODIFY COLUMN return the declared type and concat
+        never sees conflicting field types (reference mito2/src/read/compat.rs
+        re-types old batches the same way)."""
+        import pyarrow.compute as pc
+
+        for col in self.schema.columns:
+            i = table.schema.get_field_index(col.name)
+            if i < 0:
+                continue
+            want = col.data_type.to_arrow()
+            if table.schema.field(i).type != want:
+                table = table.set_column(
+                    i, col.name, pc.cast(table.column(i), want)
+                )
+        return table
+
+    @staticmethod
+    def _drop_tombstones(table: pa.Table) -> pa.Table:
+        """Remove delete markers from scan output (rows from pre-__op files
+        have a null marker and count as puts)."""
+        if OP_COL not in table.column_names:
+            return table
+        import pyarrow.compute as pc
+
+        op = pc.fill_null(pc.cast(table[OP_COL], pa.int8()), OP_PUT)
+        table = table.filter(pc.equal(op, OP_PUT))
+        return table.drop_columns([OP_COL])
 
     def _dedup_across_sources(self, table: pa.Table, had_multiple: bool) -> pa.Table:
         if not had_multiple or table.num_rows <= 1:
@@ -292,9 +382,14 @@ class Region:
     def truncate(self):
         with self._lock:
             entry_id = self.wal.last_entry_id
+            dropped = list(self.manifest_mgr.manifest.files)
             self.manifest_mgr.apply({"kind": "truncate", "truncated_entry_id": entry_id})
             self.memtable = Memtable(self.schema, self.time_partition_ms)
             self.wal.obsolete(entry_id)
+            # the truncated SSTs are unreferenced now; reclaim them once
+            # in-flight scans drain (same deferred purge as compaction)
+            self._garbage_files.extend(dropped)
+            self._purge_garbage_locked()
 
     def alter_schema(self, new_schema: Schema):
         """Schema change: flush first so existing SSTs stay self-describing."""

@@ -199,6 +199,10 @@ class SstReader:
             groups = self._prune_with_indexes(pf, meta, pred, groups)
             if len(groups) < before:
                 INDEX_PRUNED_GROUPS.inc(before - len(groups))
+        if columns:
+            # tolerate requested columns the file predates (e.g. __op or a
+            # column added by ALTER after this SST was written)
+            columns = [c for c in columns if c in pf.schema_arrow.names]
         if not groups:
             schema = pf.schema_arrow
             if columns:

@@ -246,3 +246,100 @@ def test_information_schema(loaded):
     names = loaded.sql_one("SHOW TABLES")["Tables"].to_pylist()
     assert "tables" in names and "columns" in names
     loaded.sql("USE public")
+
+
+# ---- ALTER / DELETE / TRUNCATE ---------------------------------------------
+
+
+def test_alter_add_drop_modify_columns(db):
+    db.sql("CREATE TABLE m (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host))")
+    db.sql("INSERT INTO m VALUES ('a', 1000, 1.5)")
+    db.sql("ALTER TABLE m ADD COLUMN extra DOUBLE")
+    # old rows read NULL for the new column; new rows carry it
+    db.sql("INSERT INTO m VALUES ('b', 2000, 2.5, 9.0)")
+    t = db.sql_one("SELECT host, extra FROM m ORDER BY ts")
+    assert t["extra"].to_pylist() == [None, 9.0]
+    # flush so the pre-alter rows live in an old-schema SST, then read again
+    db.sql("ADMIN flush_table('m')")
+    t = db.sql_one("SELECT host, extra FROM m ORDER BY ts")
+    assert t["extra"].to_pylist() == [None, 9.0]
+    db.sql("ALTER TABLE m DROP COLUMN extra")
+    t = db.sql_one("SELECT * FROM m ORDER BY ts")
+    assert "extra" not in t.column_names
+    db.sql("ALTER TABLE m MODIFY COLUMN v BIGINT")
+    assert db.catalog.table("m").schema.column("v").data_type.value == "int64"
+
+
+def test_alter_rename_and_options(db):
+    db.sql("CREATE TABLE old_name (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+    db.sql("INSERT INTO old_name VALUES (1000, 1.0)")
+    db.sql("ALTER TABLE old_name RENAME new_name")
+    assert db.sql_one("SELECT v FROM new_name").num_rows == 1
+    with pytest.raises(TableNotFoundError):
+        db.sql("SELECT * FROM old_name")
+    db.sql("ALTER TABLE new_name SET ttl = '7d'")
+    assert db.catalog.table("new_name").options["ttl"] == "7d"
+    db.sql("ALTER TABLE new_name UNSET ttl")
+    assert "ttl" not in db.catalog.table("new_name").options
+
+
+def test_delete_rows(db):
+    db.sql("CREATE TABLE d (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host))")
+    db.sql("INSERT INTO d VALUES ('a', 1000, 1.0), ('a', 2000, 2.0), ('b', 1000, 3.0)")
+    n = db.sql_one("DELETE FROM d WHERE host = 'a' AND ts = 1000")
+    assert n == 1
+    t = db.sql_one("SELECT host, v FROM d ORDER BY host, v")
+    assert t["v"].to_pylist() == [2.0, 3.0]
+    # delete by field predicate
+    assert db.sql_one("DELETE FROM d WHERE v > 2.5") == 1
+    assert db.sql_one("SELECT count(*) AS c FROM d")["c"].to_pylist() == [1]
+    # deletes survive flush + restart
+    db.sql("ADMIN flush_table('d')")
+    assert db.sql_one("SELECT count(*) AS c FROM d")["c"].to_pylist() == [1]
+    # re-insert a deleted key: it comes back
+    db.sql("INSERT INTO d VALUES ('a', 1000, 9.0)")
+    t = db.sql_one("SELECT v FROM d WHERE host = 'a' ORDER BY ts")
+    assert t["v"].to_pylist() == [9.0, 2.0]
+
+
+def test_delete_survives_restart(tmp_path):
+    d = Database(data_home=str(tmp_path))
+    d.sql("CREATE TABLE d (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host))")
+    d.sql("INSERT INTO d VALUES ('a', 1000, 1.0), ('b', 2000, 2.0)")
+    d.sql("ADMIN flush_table('d')")  # victims into an SST
+    d.sql("DELETE FROM d WHERE host = 'a'")  # tombstone only in WAL
+    d.close()
+    d2 = Database(data_home=str(tmp_path))
+    try:
+        assert d2.sql_one("SELECT host FROM d")["host"].to_pylist() == ["b"]
+        # ... and through a flush + compaction of the tombstone itself
+        d2.sql("ADMIN flush_table('d')")
+        d2.sql("ADMIN compact_table('d')")
+        assert d2.sql_one("SELECT host FROM d")["host"].to_pylist() == ["b"]
+    finally:
+        d2.close()
+
+
+def test_overwrite_not_resurrected_by_field_filter(db):
+    """A field-filter scan must not resurrect an overwritten SST row
+    (filters apply after cross-source dedup, like the reference's
+    DedupReader-before-filter ordering)."""
+    db.sql("CREATE TABLE o (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host))")
+    db.sql("INSERT INTO o VALUES ('a', 1000, 10.0)")
+    db.sql("ADMIN flush_table('o')")  # v=10 lands in an SST
+    db.sql("INSERT INTO o VALUES ('a', 1000, 3.0)")  # overwrite in memtable
+    t = db.sql_one("SELECT v FROM o WHERE v > 5.0")
+    assert t.num_rows == 0, f"stale row resurrected: {t.to_pydict()}"
+    t = db.sql_one("SELECT v FROM o WHERE v < 5.0")
+    assert t["v"].to_pylist() == [3.0]
+
+
+def test_truncate(db):
+    db.sql("CREATE TABLE tr (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+    db.sql("INSERT INTO tr VALUES (1000, 1.0), (2000, 2.0)")
+    db.sql("ADMIN flush_table('tr')")
+    db.sql("INSERT INTO tr VALUES (3000, 3.0)")
+    db.sql("TRUNCATE TABLE tr")
+    assert db.sql_one("SELECT count(*) AS c FROM tr")["c"].to_pylist() == [0]
+    db.sql("INSERT INTO tr VALUES (4000, 4.0)")
+    assert db.sql_one("SELECT v FROM tr")["v"].to_pylist() == [4.0]
