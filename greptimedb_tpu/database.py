@@ -255,6 +255,16 @@ class Database:
                     "ALTER TABLE on metric-engine tables is not supported"
                 )
             if stmt.action == "rename":
+                referencing = self.flows.flows_referencing(
+                    stmt.table, self.current_database
+                )
+                if referencing:
+                    # flows hold the table name in their SQL and mirror keys;
+                    # renaming underneath them would silently detach them
+                    raise InvalidArgumentsError(
+                        f"table {stmt.table!r} is referenced by flows "
+                        f"{referencing}; drop them before renaming"
+                    )
                 self.catalog.rename_table(
                     stmt.table, stmt.new_name, self.current_database
                 )
@@ -313,18 +323,19 @@ class Database:
                     new_cols = [
                         ColumnSchema(
                             name=c.name,
-                            data_type=(
-                                ConcreteDataType.parse(tname)
-                                if c.name == name
-                                else c.data_type
-                            ),
+                            data_type=new_dt if c.name == name else c.data_type,
                             semantic_type=c.semantic_type,
                             nullable=c.nullable,
                             default=c.default,
+                            column_id=c.column_id,  # type change keeps identity
                         )
                         for c in schema.columns
                     ]
-                    schema = Schema(columns=new_cols, version=schema.version + 1)
+                    schema = Schema(
+                        columns=new_cols,
+                        version=schema.version + 1,
+                        next_column_id=schema.next_column_id,
+                    )
             else:
                 raise UnsupportedError(f"unsupported ALTER action: {stmt.action}")
             # regions first, catalog publish second (same ordering rationale

@@ -31,6 +31,11 @@ class ColumnSchema:
     semantic_type: SemanticType = SemanticType.FIELD
     nullable: bool = True
     default: object = None
+    # Stable column identity (reference store-api ColumnMetadata.column_id):
+    # survives renames of other columns and distinguishes a re-added column
+    # from a previously dropped one of the same name.  0 = unassigned; the
+    # Schema constructor allocates ids.
+    column_id: int = 0
 
     def __post_init__(self):
         if self.semantic_type == SemanticType.TIMESTAMP:
@@ -44,6 +49,7 @@ class ColumnSchema:
         meta = {
             b"greptime:semantic_type": str(int(self.semantic_type)).encode(),
             b"greptime:type": self.data_type.value.encode(),
+            b"greptime:column_id": str(self.column_id).encode(),
         }
         return pa.field(self.name, self.data_type.to_arrow(), nullable=self.nullable, metadata=meta)
 
@@ -56,6 +62,7 @@ class ColumnSchema:
             data_type=ConcreteDataType.from_arrow(f.type),
             semantic_type=sem,
             nullable=f.nullable,
+            column_id=int(meta.get(b"greptime:column_id", 0)),
         )
 
     def to_dict(self) -> dict:
@@ -65,6 +72,7 @@ class ColumnSchema:
             "semantic_type": int(self.semantic_type),
             "nullable": self.nullable,
             "default": self.default,
+            "column_id": self.column_id,
         }
 
     @classmethod
@@ -75,6 +83,7 @@ class ColumnSchema:
             semantic_type=SemanticType(d["semantic_type"]),
             nullable=d.get("nullable", True),
             default=d.get("default"),
+            column_id=d.get("column_id", 0),
         )
 
 
@@ -82,6 +91,11 @@ class ColumnSchema:
 class Schema:
     columns: list[ColumnSchema] = field(default_factory=list)
     version: int = 0
+    # Monotonic id allocator — never reused, even after DROP COLUMN, so a
+    # re-added name gets a NEW id and old SST data for the dropped column
+    # reads as NULL instead of resurrecting (reference mito2 compat by
+    # column_id).  0 = derive from the columns present.
+    next_column_id: int = 0
 
     def __post_init__(self):
         names = [c.name for c in self.columns]
@@ -90,6 +104,15 @@ class Schema:
         ts = [c for c in self.columns if c.semantic_type == SemanticType.TIMESTAMP]
         if len(ts) > 1:
             raise InvalidArgumentsError("schema may have at most one time index column")
+        # Allocate ids for unassigned columns (fresh CREATE or legacy data):
+        # position-based, deterministic across identical schema builds.
+        max_id = max((c.column_id for c in self.columns), default=0)
+        for c in self.columns:
+            if c.column_id == 0:
+                max_id += 1
+                c.column_id = max_id
+        if self.next_column_id <= max_id:
+            self.next_column_id = max_id + 1
         self._index = {c.name: i for i, c in enumerate(self.columns)}
 
     # ---- access -----------------------------------------------------------
@@ -131,14 +154,23 @@ class Schema:
     def add_column(self, col: ColumnSchema) -> "Schema":
         if self.has_column(col.name):
             raise InvalidArgumentsError(f"column {col.name!r} already exists")
-        return Schema(columns=self.columns + [col], version=self.version + 1)
+        import dataclasses
+
+        col = dataclasses.replace(col, column_id=self.next_column_id)
+        return Schema(
+            columns=self.columns + [col],
+            version=self.version + 1,
+            next_column_id=self.next_column_id + 1,
+        )
 
     def drop_column(self, name: str) -> "Schema":
         col = self.column(name)
         if col.semantic_type != SemanticType.FIELD:
             raise InvalidArgumentsError("only FIELD columns can be dropped")
         return Schema(
-            columns=[c for c in self.columns if c.name != name], version=self.version + 1
+            columns=[c for c in self.columns if c.name != name],
+            version=self.version + 1,
+            next_column_id=self.next_column_id,
         )
 
     # ---- conversions ------------------------------------------------------
@@ -154,9 +186,19 @@ class Schema:
         return cls(columns=[ColumnSchema.from_arrow(f) for f in s], version=version)
 
     def to_json(self) -> str:
-        return json.dumps({"version": self.version, "columns": [c.to_dict() for c in self.columns]})
+        return json.dumps(
+            {
+                "version": self.version,
+                "next_column_id": self.next_column_id,
+                "columns": [c.to_dict() for c in self.columns],
+            }
+        )
 
     @classmethod
     def from_json(cls, s: str) -> "Schema":
         d = json.loads(s)
-        return cls(columns=[ColumnSchema.from_dict(c) for c in d["columns"]], version=d["version"])
+        return cls(
+            columns=[ColumnSchema.from_dict(c) for c in d["columns"]],
+            version=d["version"],
+            next_column_id=d.get("next_column_id", 0),
+        )

@@ -677,6 +677,15 @@ class FlowManager:
     def list_flows(self) -> list[FlowInfo]:
         return sorted(self.infos.values(), key=lambda i: i.flow_id)
 
+    def flows_referencing(self, table: str, database: str) -> list[str]:
+        """Flows using `table` as source or sink — DDL like RENAME must not
+        silently detach them (their stored SQL names the table)."""
+        return sorted(
+            i.name
+            for i in self.infos.values()
+            if i.database == database and table in (i.source_table, i.sink_table)
+        )
+
     # -- persistence --------------------------------------------------------
     def _save(self):
         data = {

@@ -1,0 +1,85 @@
+"""Elasticsearch `_bulk` ingest compatibility.
+
+Role-equivalent of the reference's Elasticsearch endpoint (reference
+servers/src/elasticsearch.rs): `POST /v1/elasticsearch/_bulk` (and
+`/{index}/_bulk`) accepts NDJSON action/document pairs from Logstash or
+Filebeat and lands documents through the identity pipeline into the table
+named by the index.  Only `index` and `create` actions are supported, like
+the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..pipeline import GREPTIME_IDENTITY, run_pipeline_ingest
+from ..utils.errors import InvalidArgumentsError
+
+DEFAULT_TABLE = "logs"
+
+
+def parse_bulk(body: bytes, default_index: str | None) -> dict[str, list[dict]]:
+    """NDJSON action/doc pairs -> {index/table: [docs]}."""
+    lines = [ln for ln in body.decode(errors="replace").splitlines() if ln.strip()]
+    grouped: dict[str, list[dict]] = {}
+    i = 0
+    while i < len(lines):
+        try:
+            action = json.loads(lines[i])
+        except json.JSONDecodeError as e:
+            raise InvalidArgumentsError(
+                f"bad bulk action line {i}: {e}"
+            ) from e
+        if not isinstance(action, dict) or not action:
+            raise InvalidArgumentsError(f"bad bulk action line {i}")
+        op = next(iter(action))
+        if op not in ("index", "create"):
+            raise InvalidArgumentsError(
+                f"unsupported bulk action {op!r} (only index/create)"
+            )
+        index = (action[op] or {}).get("_index") or default_index or DEFAULT_TABLE
+        i += 1
+        if i >= len(lines):
+            raise InvalidArgumentsError("bulk action without a document line")
+        try:
+            doc = json.loads(lines[i])
+        except json.JSONDecodeError as e:
+            raise InvalidArgumentsError(f"bad bulk document line {i}: {e}") from e
+        if isinstance(doc, dict):
+            grouped.setdefault(str(index), []).append(doc)
+        i += 1
+    return grouped
+
+
+def handle_bulk(
+    db, body: bytes, default_index: str | None = None, database: str = "public"
+) -> dict:
+    """Ingest a bulk body; returns an ES-shaped response document."""
+    t0 = time.perf_counter()
+    grouped = parse_bulk(body, default_index)
+    items = []
+    errors = False
+    for index, docs in grouped.items():
+        try:
+            run_pipeline_ingest(db, GREPTIME_IDENTITY, docs, index, database)
+            items.extend(
+                {"index": {"_index": index, "status": 201}} for _ in docs
+            )
+        except Exception as e:  # noqa: BLE001 — per-index failure, ES semantics
+            errors = True
+            items.extend(
+                {
+                    "index": {
+                        "_index": index,
+                        "status": 400,
+                        "error": {"reason": str(e)},
+                    }
+                }
+                for _ in docs
+            )
+    return {
+        "took": int((time.perf_counter() - t0) * 1000),
+        "errors": errors,
+        "items": items,
+    }

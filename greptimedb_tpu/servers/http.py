@@ -140,6 +140,16 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._handle_pipelines(route[len("/v1/pipelines/") :], params)
             if route == "/v1/ingest":
                 return self._handle_ingest(params)
+            if route in ("/v1/loki/api/v1/push", "/loki/api/v1/push"):
+                return self._handle_loki(params)
+            if route.startswith("/v1/elasticsearch") and route.endswith("/_bulk"):
+                mid = route[len("/v1/elasticsearch") : -len("/_bulk")].strip("/")
+                return self._handle_elasticsearch(mid or None, params)
+            if route in ("/v1/opentsdb/api/put", "/opentsdb/api/put"):
+                return self._handle_opentsdb(params)
+            if route.startswith("/v1/jaeger/api/") or route.startswith("/jaeger/api/"):
+                endpoint = route.split("/api/", 1)[1]
+                return self._handle_jaeger(endpoint, params)
             return self._send(404, {"error": f"no route {route}"})
         except GreptimeError as e:
             self._send(400, {"error": str(e), "code": int(e.status_code())})
@@ -153,6 +163,75 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
     # ---- handlers ---------------------------------------------------------
+    def _handle_loki(self, params):
+        from . import loki
+
+        n = loki.ingest(
+            self.db,
+            params.get("__body") or b"",
+            content_type=self.headers.get("Content-Type", ""),
+            database=params.get("db", "public"),
+        )
+        # Loki replies 204 No Content on success
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return n
+
+    def _handle_elasticsearch(self, index, params):
+        from . import elasticsearch as es
+
+        resp = es.handle_bulk(
+            self.db,
+            params.get("__body") or b"",
+            default_index=index,
+            database=params.get("db", "public"),
+        )
+        return self._send(200, resp)
+
+    def _handle_opentsdb(self, params):
+        from . import opentsdb
+
+        n = opentsdb.ingest(
+            self.db, params.get("__body") or b"", database=params.get("db", "public")
+        )
+        # `?summary` / `?details` are bare flags (no value) — parse_qs drops
+        # them, so check the raw query string
+        query = urllib.parse.urlparse(self.path).query
+        flags = {p.split("=", 1)[0] for p in query.split("&") if p}
+        if "details" in flags:
+            return self._send(200, {"success": n, "failed": 0, "errors": []})
+        if "summary" in flags:
+            return self._send(200, {"success": n, "failed": 0})
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return n
+
+    def _handle_jaeger(self, endpoint: str, params):
+        from . import jaeger
+
+        database = params.get("db", "public")
+        if endpoint == "services":
+            return self._send(200, jaeger.services(self.db, database))
+        if endpoint == "operations":
+            svc = params.get("service")
+            if not svc:
+                return self._send(400, {"error": "missing service parameter"})
+            return self._send(
+                200, jaeger.operations(self.db, svc, params.get("spanKind"), database)
+            )
+        if endpoint.startswith("services/") and endpoint.endswith("/operations"):
+            svc = endpoint[len("services/") : -len("/operations")]
+            return self._send(200, jaeger.operation_names(self.db, svc, database))
+        if endpoint.startswith("traces/"):
+            return self._send(
+                200, jaeger.get_trace(self.db, endpoint[len("traces/") :], database)
+            )
+        if endpoint == "traces":
+            return self._send(200, jaeger.find_traces(self.db, params, database))
+        return self._send(404, {"error": f"no jaeger endpoint {endpoint!r}"})
+
     def _handle_sql(self, params):
         sql = params.get("sql") or (params.get("__body") or b"").decode()
         if not sql:

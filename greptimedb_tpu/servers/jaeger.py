@@ -1,0 +1,229 @@
+"""Jaeger HTTP query API over the OTLP trace table.
+
+Role-equivalent of the reference's Jaeger endpoint (reference
+servers/src/http/jaeger.rs + frontend/src/instance/jaeger.rs): serves
+`/api/services`, `/api/operations`, `/api/services/{svc}/operations`,
+`/api/traces/{trace_id}` and `/api/traces?service=...` from the
+`opentelemetry_traces` table written by the OTLP ingest path, translating
+rows into Jaeger's span JSON (trace/span ids, microsecond start/duration,
+tags from span attributes, process from resource attributes).
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..utils.errors import InvalidArgumentsError, TableNotFoundError
+from .otlp import TRACE_TABLE_NAME
+
+_KIND_TAGS = {
+    "SPAN_KIND_SERVER": "server",
+    "SPAN_KIND_CLIENT": "client",
+    "SPAN_KIND_PRODUCER": "producer",
+    "SPAN_KIND_CONSUMER": "consumer",
+}
+
+
+def _esc(s: str) -> str:
+    return str(s).replace("'", "''")
+
+
+def _scan(db, database: str, where: list[str], limit: int | None = None):
+    sql = f"SELECT * FROM {TRACE_TABLE_NAME}"
+    if where:
+        sql += " WHERE " + " AND ".join(where)
+    sql += " ORDER BY timestamp DESC"
+    if limit:
+        sql += f" LIMIT {int(limit)}"
+    prev = db.current_database
+    db.current_database = database
+    try:
+        return db.sql_one(sql)
+    finally:
+        db.current_database = prev
+
+
+def _response(data, total=None):
+    return {
+        "data": data,
+        "total": total if total is not None else (len(data) if isinstance(data, list) else 0),
+        "limit": 0,
+        "offset": 0,
+        "errors": None,
+    }
+
+
+def services(db, database: str = "public") -> dict:
+    try:
+        t = _scan(db, database, [])
+    except TableNotFoundError:
+        return _response([])
+    names = sorted({s for s in t["service_name"].to_pylist() if s})
+    return _response(names)
+
+
+def operations(db, service: str, span_kind: str | None = None, database: str = "public"):
+    """Full operation structs (reference jaeger.rs handle_operations)."""
+    try:
+        t = _scan(db, database, [f"service_name = '{_esc(service)}'"])
+    except TableNotFoundError:
+        return _response([])
+    seen = {}
+    for name, kind in zip(t["span_name"].to_pylist(), t["span_kind"].to_pylist()):
+        jk = _KIND_TAGS.get(kind or "", "")
+        if span_kind and jk != span_kind:
+            continue
+        seen.setdefault((name, jk), {"name": name, "spanKind": jk})
+    return _response([seen[k] for k in sorted(seen)])
+
+
+def operation_names(db, service: str, database: str = "public"):
+    ops = operations(db, service, database=database)
+    return _response(sorted({o["name"] for o in ops["data"]}))
+
+
+def _attr_tags(attrs_json: str) -> list[dict]:
+    try:
+        attrs = json.loads(attrs_json) if attrs_json else {}
+    except json.JSONDecodeError:
+        return []
+    tags = []
+    for k, v in (attrs or {}).items():
+        if isinstance(v, bool):
+            t, v2 = "bool", v
+        elif isinstance(v, int):
+            t, v2 = "int64", v
+        elif isinstance(v, float):
+            t, v2 = "float64", v
+        else:
+            t, v2 = "string", str(v)
+        tags.append({"key": k, "type": t, "value": v2})
+    return tags
+
+
+def _row_to_span(row: dict) -> dict:
+    refs = []
+    if row.get("parent_span_id"):
+        refs.append(
+            {
+                "refType": "CHILD_OF",
+                "traceID": row["trace_id"],
+                "spanID": row["parent_span_id"],
+            }
+        )
+    tags = _attr_tags(row.get("span_attributes") or "")
+    kind = _KIND_TAGS.get(row.get("span_kind") or "")
+    if kind:
+        tags.append({"key": "span.kind", "type": "string", "value": kind})
+    if (row.get("span_status_code") or "") == "STATUS_CODE_ERROR":
+        tags.append({"key": "error", "type": "bool", "value": True})
+    ts_us = _ns(row["timestamp"]) // 1000
+    return {
+        "traceID": row["trace_id"],
+        "spanID": row["span_id"],
+        "operationName": row.get("span_name") or "",
+        "references": refs,
+        "startTime": ts_us,
+        "duration": int(row.get("duration_nano") or 0) // 1000,
+        "tags": tags,
+        "logs": [],
+        "processID": "p1",
+    }
+
+
+def _ns(v) -> int:
+    import datetime
+
+    if isinstance(v, datetime.datetime):
+        return int(v.timestamp() * 1_000_000_000)
+    return int(v)
+
+
+def _rows(t) -> list[dict]:
+    cols = {name: t[name].to_pylist() for name in t.column_names}
+    return [
+        {name: cols[name][i] for name in cols} for i in range(t.num_rows)
+    ]
+
+
+def _traces_payload(rows: list[dict]) -> list[dict]:
+    by_trace: dict[str, list[dict]] = {}
+    procs: dict[str, dict] = {}
+    for r in rows:
+        by_trace.setdefault(r["trace_id"], []).append(r)
+        procs.setdefault(
+            r["trace_id"],
+            {
+                "serviceName": r.get("service_name") or "",
+                "tags": _attr_tags(r.get("resource_attributes") or ""),
+            },
+        )
+    out = []
+    for trace_id, rs in by_trace.items():
+        out.append(
+            {
+                "traceID": trace_id,
+                "spans": [_row_to_span(r) for r in rs],
+                "processes": {"p1": procs[trace_id]},
+                "warnings": None,
+            }
+        )
+    return out
+
+
+def get_trace(db, trace_id: str, database: str = "public") -> dict:
+    t = _scan(db, database, [f"trace_id = '{_esc(trace_id)}'"])
+    rows = _rows(t)
+    if not rows:
+        raise InvalidArgumentsError(f"trace not found: {trace_id}")
+    return _response(_traces_payload(rows))
+
+
+def find_traces(db, params: dict, database: str = "public") -> dict:
+    service = params.get("service")
+    if not service:
+        raise InvalidArgumentsError("find traces requires ?service=")
+    where = [f"service_name = '{_esc(service)}'"]
+    if params.get("operation"):
+        where.append(f"span_name = '{_esc(params['operation'])}'")
+    # start/end arrive in microseconds (Jaeger API convention)
+    if params.get("start"):
+        where.append(f"timestamp >= {int(params['start']) * 1000}")
+    if params.get("end"):
+        where.append(f"timestamp <= {int(params['end']) * 1000}")
+    try:
+        t = _scan(db, database, where)
+    except TableNotFoundError:
+        return _response([])
+    rows = _rows(t)
+    # duration filters apply to whole spans (reference jaeger.rs min/max duration)
+    if params.get("minDuration"):
+        lo = _duration_us(params["minDuration"])
+        rows = [r for r in rows if int(r.get("duration_nano") or 0) // 1000 >= lo]
+    if params.get("maxDuration"):
+        hi = _duration_us(params["maxDuration"])
+        rows = [r for r in rows if int(r.get("duration_nano") or 0) // 1000 <= hi]
+    if params.get("tags"):
+        try:
+            want = json.loads(params["tags"])
+        except json.JSONDecodeError as e:
+            raise InvalidArgumentsError(f"bad tags param: {e}") from e
+        def matches(r):
+            try:
+                attrs = json.loads(r.get("span_attributes") or "{}")
+            except json.JSONDecodeError:
+                attrs = {}
+            return all(str(attrs.get(k)) == str(v) for k, v in want.items())
+        rows = [r for r in rows if matches(r)]
+    traces = _traces_payload(rows)
+    limit = int(params.get("limit") or 20)
+    return _response(traces[:limit])
+
+
+def _duration_us(s: str) -> int:
+    """`100ms` / `1.2s` / `500us` -> microseconds."""
+    s = str(s).strip()
+    for suffix, mult in (("us", 1), ("ms", 1000), ("s", 1_000_000)):
+        if s.endswith(suffix):
+            return int(float(s[: -len(suffix)]) * mult)
+    return int(float(s))

@@ -1,0 +1,155 @@
+"""Loki push API ingest.
+
+Role-equivalent of the reference's Loki endpoint (reference
+servers/src/http/loki.rs): `POST /v1/loki/api/v1/push` accepts either the
+JSON push format or the snappy-compressed protobuf `PushRequest`, and lands
+lines in a log table whose tags are the stream labels (the reference's
+pipeline-less Loki path builds the same layout: ns time index, `line`
+field, one TAG column per label, structured metadata as JSON).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+import pyarrow as pa
+
+from ..datatypes.data_type import ConcreteDataType
+from ..datatypes.schema import ColumnSchema, Schema, SemanticType
+from ..utils.errors import InvalidArgumentsError
+from . import protowire as pw
+from .otlp import ensure_table
+
+LOKI_TABLE_NAME = "loki_logs"
+TS_COL = "greptime_timestamp"
+LINE_COL = "line"
+META_COL = "structured_metadata"
+
+_LABELS_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"((?:[^"\\]|\\.)*)"')
+
+
+def parse_label_string(s: str) -> dict[str, str]:
+    """`{job="x", instance="y"}` -> {"job": "x", "instance": "y"}."""
+    return {k: v.replace('\\"', '"') for k, v in _LABELS_RE.findall(s or "")}
+
+
+def _decode_entry(buf: bytes) -> tuple[int, str, dict]:
+    """EntryAdapter{timestamp=1 (Timestamp{seconds=1,nanos=2}), line=2,
+    structuredMetadata=3 (LabelPairAdapter{name=1,value=2})}."""
+    ts_ns, line, meta = 0, "", {}
+    for fno, wt, v in pw.iter_fields(buf):
+        if fno == 1 and wt == 2:
+            secs = nanos = 0
+            for f2, w2, v2 in pw.iter_fields(v):
+                if f2 == 1 and w2 == 0:
+                    secs = pw.to_int64(v2)
+                elif f2 == 2 and w2 == 0:
+                    nanos = pw.to_int64(v2)
+            ts_ns = secs * 1_000_000_000 + nanos
+        elif fno == 2 and wt == 2:
+            line = v.decode(errors="replace")
+        elif fno == 3 and wt == 2:
+            name = value = ""
+            for f2, w2, v2 in pw.iter_fields(v):
+                if f2 == 1 and w2 == 2:
+                    name = v2.decode(errors="replace")
+                elif f2 == 2 and w2 == 2:
+                    value = v2.decode(errors="replace")
+            if name:
+                meta[name] = value
+    return ts_ns, line, meta
+
+
+def decode_push_request(body: bytes) -> list[tuple[dict, list[tuple[int, str, dict]]]]:
+    """snappy(PushRequest{streams=1: StreamAdapter{labels=1, entries=2}})
+    -> [(labels, [(ts_ns, line, metadata)])]."""
+    from .. import native
+
+    data = native.snappy_decompress(body)
+    streams = []
+    for fno, wt, v in pw.iter_fields(data):
+        if fno == 1 and wt == 2:
+            labels: dict = {}
+            entries: list[tuple[int, str, dict]] = []
+            for f2, w2, v2 in pw.iter_fields(v):
+                if f2 == 1 and w2 == 2:
+                    labels = parse_label_string(v2.decode(errors="replace"))
+                elif f2 == 2 and w2 == 2:
+                    entries.append(_decode_entry(v2))
+            streams.append((labels, entries))
+    return streams
+
+
+def parse_json_push(body: bytes) -> list[tuple[dict, list[tuple[int, str, dict]]]]:
+    try:
+        doc = json.loads(body)
+    except json.JSONDecodeError as e:
+        raise InvalidArgumentsError(f"bad Loki JSON body: {e}") from e
+    streams = []
+    for s in doc.get("streams") or []:
+        labels = {str(k): str(v) for k, v in (s.get("stream") or {}).items()}
+        entries = []
+        for val in s.get("values") or []:
+            if not isinstance(val, list) or len(val) < 2:
+                continue
+            ts_ns = int(val[0])
+            line = str(val[1])
+            meta = val[2] if len(val) > 2 and isinstance(val[2], dict) else {}
+            entries.append((ts_ns, line, meta))
+        streams.append((labels, entries))
+    return streams
+
+
+def ingest(
+    db, body: bytes, content_type: str = "", database: str = "public",
+    table: str = LOKI_TABLE_NAME,
+) -> int:
+    """Ingest one push request; returns number of log lines written."""
+    if "json" in (content_type or "").lower():
+        streams = parse_json_push(body)
+    else:
+        try:
+            streams = decode_push_request(body)
+        except Exception:
+            # curl without a content type often sends JSON anyway
+            streams = parse_json_push(body)
+
+    label_names = sorted({k for labels, _ in streams for k in labels})
+    C, D, S = ColumnSchema, ConcreteDataType, SemanticType
+    cols = [
+        C(TS_COL, D.TIMESTAMP_NANOSECOND, S.TIMESTAMP, nullable=False),
+        C(LINE_COL, D.STRING, S.FIELD),
+        C(META_COL, D.JSON, S.FIELD),
+    ] + [C(name, D.STRING, S.TAG, nullable=True) for name in label_names]
+    schema = Schema(columns=cols)
+    meta_t = ensure_table(db, table, schema, database)
+
+    # conform to the existing table: labels never seen before need an ALTER
+    # (tags are fixed) — the reference rejects new labels the same way by
+    # erroring on unknown columns; we fold unknown labels into metadata
+    known = set(meta_t.schema.column_names())
+    out: dict[str, list] = {c: [] for c in meta_t.schema.column_names()}
+    n = 0
+    for labels, entries in streams:
+        extra = {k: v for k, v in labels.items() if k not in known}
+        for ts_ns, line, md in entries:
+            if extra:
+                md = {**md, **extra}
+            for c in meta_t.schema.columns:
+                if c.name == TS_COL:
+                    out[TS_COL].append(ts_ns)
+                elif c.name == LINE_COL:
+                    out[LINE_COL].append(line)
+                elif c.name == META_COL:
+                    out[META_COL].append(json.dumps(md, default=str))
+                else:
+                    out[c.name].append(labels.get(c.name, ""))
+            n += 1
+    if not n:
+        return 0
+    arrays = {
+        c.name: pa.array(out[c.name], c.data_type.to_arrow())
+        for c in meta_t.schema.columns
+    }
+    return db.insert_rows(meta_t.name, pa.table(arrays), database=database)

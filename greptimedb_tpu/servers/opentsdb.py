@@ -1,0 +1,81 @@
+"""OpenTSDB `/api/put` ingest.
+
+Role-equivalent of the reference's OpenTSDB endpoint (reference
+servers/src/opentsdb.rs + servers/src/http/opentsdb.rs): JSON datapoints
+{metric, timestamp, value, tags} become rows in a table named after the
+metric — tags as TAG columns, a millisecond time index, one DOUBLE value
+field (the reference's DataPoint model).  Second-resolution timestamps
+(<= 10 digits) are scaled to ms, matching OpenTSDB semantics.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pyarrow as pa
+
+from ..datatypes.data_type import ConcreteDataType
+from ..datatypes.schema import ColumnSchema, Schema, SemanticType
+from ..utils.errors import InvalidArgumentsError
+from .otlp import ensure_table
+
+TS_COL = "greptime_timestamp"
+VAL_COL = "greptime_value"
+
+
+def parse_put(body: bytes) -> list[dict]:
+    try:
+        doc = json.loads(body)
+    except json.JSONDecodeError as e:
+        raise InvalidArgumentsError(f"bad OpenTSDB body: {e}") from e
+    points = doc if isinstance(doc, list) else [doc]
+    out = []
+    for p in points:
+        if not isinstance(p, dict) or "metric" not in p:
+            raise InvalidArgumentsError("datapoint requires a metric name")
+        try:
+            ts = int(p["timestamp"])
+            value = float(p["value"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise InvalidArgumentsError(
+                f"datapoint {p.get('metric')}: bad timestamp/value"
+            ) from e
+        if ts < 10_000_000_000:  # seconds resolution
+            ts *= 1000
+        tags = {str(k): str(v) for k, v in (p.get("tags") or {}).items()}
+        out.append({"metric": str(p["metric"]), "ts": ts, "value": value, "tags": tags})
+    return out
+
+
+def ingest(db, body: bytes, database: str = "public") -> int:
+    points = parse_put(body)
+    by_metric: dict[str, list[dict]] = {}
+    for p in points:
+        by_metric.setdefault(p["metric"], []).append(p)
+    total = 0
+    C, D, S = ColumnSchema, ConcreteDataType, SemanticType
+    for metric, pts in by_metric.items():
+        tag_names = sorted({k for p in pts for k in p["tags"]})
+        schema = Schema(
+            columns=[
+                C(TS_COL, D.TIMESTAMP_MILLISECOND, S.TIMESTAMP, nullable=False),
+                C(VAL_COL, D.FLOAT64, S.FIELD),
+            ]
+            + [C(t, D.STRING, S.TAG, nullable=True) for t in tag_names]
+        )
+        meta = ensure_table(db, metric, schema, database)
+        cols: dict[str, list] = {name: [] for name in meta.schema.column_names()}
+        for p in pts:
+            for c in meta.schema.columns:
+                if c.name == TS_COL:
+                    cols[TS_COL].append(p["ts"])
+                elif c.name == VAL_COL:
+                    cols[VAL_COL].append(p["value"])
+                else:
+                    cols[c.name].append(p["tags"].get(c.name, ""))
+        arrays = {
+            c.name: pa.array(cols[c.name], c.data_type.to_arrow())
+            for c in meta.schema.columns
+        }
+        total += db.insert_rows(meta.name, pa.table(arrays), database=database)
+    return total

@@ -119,10 +119,20 @@ class TimeSeriesEngine:
         return rows
 
     def delete(self, region_id: int, keys: pa.Table) -> int:
-        """Tombstone-delete rows by (primary key, time index) keys."""
+        """Tombstone-delete rows by (primary key, time index) keys.
+        Tombstones are memtable writes too, so the same stall/flush
+        backpressure as `write` applies."""
         region = self.region(region_id)
+        if self.buffer_mgr.should_stall():
+            metrics.WRITE_STALL_TOTAL.inc()
+            for rid in self.buffer_mgr.pick_flush_candidates():
+                self.flush_region(rid)
+                if not self.buffer_mgr.should_stall():
+                    break
         deleted = region.delete(keys)
         self.buffer_mgr.set_region_usage(region_id, region.memtable.memory_usage)
+        if self.buffer_mgr.should_flush_region(region_id) or self.buffer_mgr.should_flush_engine():
+            self.flush_region(region_id)
         return deleted
 
     def truncate_region(self, region_id: int):

@@ -199,6 +199,17 @@ class Region:
             if meta is not None:
                 added.append(meta)
         with self._lock:
+            truncated = self.manifest_mgr.manifest.truncated_entry_id or 0
+            if truncated >= frozen_entry_id:
+                # a TRUNCATE landed while the SSTs were being written: the
+                # frozen rows are logically gone — discard the files instead
+                # of committing them (the reference versions flushes against
+                # the truncate watermark the same way)
+                if frozen in self._frozen_memtables:
+                    self._frozen_memtables.remove(frozen)
+                self._garbage_files.extend(m.file_id for m in added)
+                self._purge_garbage_locked()
+                return []
             self.manifest_mgr.apply(
                 {
                     "kind": "edit",
@@ -335,18 +346,25 @@ class Region:
                 self._purge_garbage_locked()
 
     def _compat_cast(self, table: pa.Table) -> pa.Table:
-        """Cast an old SST's columns to the CURRENT schema types so scans
-        after ALTER ... MODIFY COLUMN return the declared type and concat
-        never sees conflicting field types (reference mito2/src/read/compat.rs
-        re-types old batches the same way)."""
+        """Adapt an old SST to the CURRENT schema (reference
+        mito2/src/read/compat.rs): cast columns to the declared type after
+        ALTER ... MODIFY COLUMN, and null out name-collisions whose stored
+        column_id differs — data of a DROPped column must not resurrect when
+        a new column reuses its name."""
         import pyarrow.compute as pc
 
         for col in self.schema.columns:
             i = table.schema.get_field_index(col.name)
             if i < 0:
                 continue
+            fmeta = table.schema.field(i).metadata or {}
+            stored_id = int(fmeta.get(b"greptime:column_id", 0))
             want = col.data_type.to_arrow()
-            if table.schema.field(i).type != want:
+            if stored_id and col.column_id and stored_id != col.column_id:
+                table = table.set_column(
+                    i, col.to_arrow(), pa.nulls(table.num_rows, want)
+                )
+            elif table.schema.field(i).type != want:
                 table = table.set_column(
                     i, col.name, pc.cast(table.column(i), want)
                 )
@@ -385,6 +403,10 @@ class Region:
             dropped = list(self.manifest_mgr.manifest.files)
             self.manifest_mgr.apply({"kind": "truncate", "truncated_entry_id": entry_id})
             self.memtable = Memtable(self.schema, self.time_partition_ms)
+            # frozen memtables hold pre-truncate rows an in-flight flush froze;
+            # drop them so scans stop seeing truncated data immediately (the
+            # flush itself discards its SSTs when it observes the watermark)
+            self._frozen_memtables.clear()
             self.wal.obsolete(entry_id)
             # the truncated SSTs are unreferenced now; reclaim them once
             # in-flight scans drain (same deferred purge as compaction)

@@ -1,0 +1,275 @@
+"""Loki push, Elasticsearch _bulk, OpenTSDB put, Jaeger query API
+(reference servers/src/http/loki.rs, elasticsearch.rs, opentsdb.rs,
+http/jaeger.rs)."""
+
+import json
+
+import pytest
+
+from greptimedb_tpu import native
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.servers import elasticsearch as es
+from greptimedb_tpu.servers import jaeger, loki, opentsdb, otlp
+from greptimedb_tpu.servers import protowire as pw
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(data_home=str(tmp_path))
+    yield d
+    d.close()
+
+
+# ---- Loki -------------------------------------------------------------------
+
+
+def test_loki_json_push(db):
+    body = json.dumps(
+        {
+            "streams": [
+                {
+                    "stream": {"job": "api", "env": "prod"},
+                    "values": [
+                        ["1700000000000000000", "hello world"],
+                        ["1700000001000000000", "second line", {"req_id": "r1"}],
+                    ],
+                }
+            ]
+        }
+    ).encode()
+    n = loki.ingest(db, body, content_type="application/json")
+    assert n == 2
+    t = db.sql_one("SELECT line, job, env FROM loki_logs ORDER BY greptime_timestamp")
+    assert t["line"].to_pylist() == ["hello world", "second line"]
+    assert t["job"].to_pylist() == ["api", "api"]
+
+
+def _encode_loki_pb(streams):
+    req = bytearray()
+    for labels, entries in streams:
+        sa = bytearray()
+        label_str = "{" + ", ".join(f'{k}="{v}"' for k, v in labels.items()) + "}"
+        pw.emit_str_field(sa, 1, label_str)
+        for ts_ns, line in entries:
+            ea = bytearray()
+            tsb = bytearray()
+            pw.emit_varint_field(tsb, 1, ts_ns // 1_000_000_000)
+            pw.emit_varint_field(tsb, 2, ts_ns % 1_000_000_000)
+            pw.emit_bytes_field(ea, 1, bytes(tsb))
+            pw.emit_str_field(ea, 2, line)
+            pw.emit_bytes_field(sa, 2, bytes(ea))
+        pw.emit_bytes_field(req, 1, bytes(sa))
+    return native.snappy_compress(bytes(req))
+
+
+def test_loki_protobuf_push(db):
+    body = _encode_loki_pb(
+        [({"job": "worker"}, [(1700000000000000000, "pb line")])]
+    )
+    n = loki.ingest(db, body, content_type="application/x-protobuf")
+    assert n == 1
+    t = db.sql_one("SELECT line, job FROM loki_logs")
+    assert t["line"].to_pylist() == ["pb line"]
+    assert t["job"].to_pylist() == ["worker"]
+
+
+def test_loki_label_parse():
+    assert loki.parse_label_string('{a="1", b_x="two words"}') == {
+        "a": "1",
+        "b_x": "two words",
+    }
+
+
+def test_loki_new_labels_fold_into_metadata(db):
+    loki.ingest(
+        db,
+        json.dumps(
+            {"streams": [{"stream": {"job": "a"}, "values": [["1000000000", "l1"]]}]}
+        ).encode(),
+        content_type="application/json",
+    )
+    loki.ingest(
+        db,
+        json.dumps(
+            {
+                "streams": [
+                    {
+                        "stream": {"job": "a", "later": "x"},
+                        "values": [["2000000000", "l2"]],
+                    }
+                ]
+            }
+        ).encode(),
+        content_type="application/json",
+    )
+    t = db.sql_one(
+        "SELECT structured_metadata FROM loki_logs ORDER BY greptime_timestamp"
+    )
+    metas = [json.loads(m) for m in t["structured_metadata"].to_pylist()]
+    assert metas[1].get("later") == "x"
+
+
+# ---- Elasticsearch ----------------------------------------------------------
+
+
+def test_es_bulk(db):
+    body = (
+        b'{"index": {"_index": "applogs"}}\n'
+        b'{"msg": "boot", "level": "info"}\n'
+        b'{"create": {"_index": "applogs"}}\n'
+        b'{"msg": "ready", "level": "debug"}\n'
+    )
+    resp = es.handle_bulk(db, body)
+    assert resp["errors"] is False
+    assert len(resp["items"]) == 2
+    t = db.sql_one("SELECT msg FROM applogs")
+    assert sorted(t["msg"].to_pylist()) == ["boot", "ready"]
+
+
+def test_es_bulk_default_index_and_errors(db):
+    body = b'{"index": {}}\n{"m": 1}\n'
+    resp = es.handle_bulk(db, body, default_index="fallback")
+    assert resp["errors"] is False
+    assert db.sql_one("SELECT m FROM fallback").num_rows == 1
+    from greptimedb_tpu.utils.errors import GreptimeError
+
+    with pytest.raises(GreptimeError):
+        es.handle_bulk(db, b'{"delete": {"_index": "x"}}\n{}\n')
+
+
+# ---- OpenTSDB ---------------------------------------------------------------
+
+
+def test_opentsdb_put(db):
+    body = json.dumps(
+        [
+            {
+                "metric": "sys_cpu_user",
+                "timestamp": 1700000000,  # seconds -> ms
+                "value": 42.5,
+                "tags": {"host": "h1", "dc": "eu"},
+            },
+            {
+                "metric": "sys_cpu_user",
+                "timestamp": 1700000001000,  # already ms
+                "value": 43.5,
+                "tags": {"host": "h2", "dc": "eu"},
+            },
+        ]
+    ).encode()
+    assert opentsdb.ingest(db, body) == 2
+    t = db.sql_one(
+        "SELECT host, greptime_value FROM sys_cpu_user ORDER BY greptime_timestamp"
+    )
+    assert t["host"].to_pylist() == ["h1", "h2"]
+    assert t["greptime_value"].to_pylist() == [42.5, 43.5]
+
+
+# ---- Jaeger -----------------------------------------------------------------
+
+
+def _make_span(trace_id, span_id, name, start_ns, dur_ns, parent="", attrs=None):
+    s = otlp.OtlpSpan()
+    s.trace_id, s.span_id, s.parent_span_id = trace_id, span_id, parent
+    s.name = name
+    s.start_unix_nano = start_ns
+    s.end_unix_nano = start_ns + dur_ns
+    s.kind = 2  # SERVER
+    s.attrs = attrs or {}
+    return s
+
+
+def _load_traces(db):
+    spans = [
+        _make_span("1a" * 16, "a" * 16, "GET /users", 1_700_000_000_000_000_000, 5_000_000),
+        _make_span(
+            "1a" * 16, "b" * 16, "SELECT users", 1_700_000_000_001_000_000, 2_000_000,
+            parent="a" * 16, attrs={"db.system": "mysql"},
+        ),
+        _make_span("2b" * 16, "c" * 16, "GET /orders", 1_700_000_100_000_000_000, 8_000_000),
+    ]
+    body = otlp.encode_traces_request({"service.name": "shop"}, spans, "scope", "1")
+    assert otlp.ingest_traces(db, body) == 3
+
+
+def test_jaeger_services_and_operations(db):
+    _load_traces(db)
+    assert jaeger.services(db)["data"] == ["shop"]
+    ops = jaeger.operations(db, "shop")["data"]
+    assert {o["name"] for o in ops} == {"GET /users", "GET /orders", "SELECT users"}
+    assert all(o["spanKind"] == "server" for o in ops)
+    names = jaeger.operation_names(db, "shop")["data"]
+    assert names == sorted(names)
+
+
+def test_jaeger_get_trace(db):
+    _load_traces(db)
+    out = jaeger.get_trace(db, "1a" * 16)
+    assert len(out["data"]) == 1
+    trace = out["data"][0]
+    assert len(trace["spans"]) == 2
+    child = next(s for s in trace["spans"] if s["operationName"] == "SELECT users")
+    assert child["references"][0]["spanID"] == "a" * 16
+    assert child["duration"] == 2000  # us
+    assert trace["processes"]["p1"]["serviceName"] == "shop"
+
+
+def test_jaeger_find_traces(db):
+    _load_traces(db)
+    out = jaeger.find_traces(db, {"service": "shop"})
+    assert len(out["data"]) == 2
+    out = jaeger.find_traces(db, {"service": "shop", "operation": "GET /orders"})
+    assert len(out["data"]) == 1
+    assert out["data"][0]["traceID"] == "2b" * 16
+    out = jaeger.find_traces(
+        db, {"service": "shop", "tags": json.dumps({"db.system": "mysql"})}
+    )
+    assert len(out["data"]) == 1
+    out = jaeger.find_traces(db, {"service": "shop", "minDuration": "7ms"})
+    assert [t["traceID"] for t in out["data"]] == ["2b" * 16]
+
+
+# ---- HTTP routing -----------------------------------------------------------
+
+
+def test_http_routes(db):
+    import urllib.request
+
+    from greptimedb_tpu.servers.http import HttpServer
+
+    srv = HttpServer(db, addr="127.0.0.1:0")
+    srv.start(warm=False)
+    port = int(srv.address.rsplit(":", 1)[1])
+
+    def req(path, body=None, ctype="application/json", method=None):
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=body,
+            headers={"Content-Type": ctype},
+            method=method or ("POST" if body is not None else "GET"),
+        )
+        with urllib.request.urlopen(r) as resp:
+            return resp.status, resp.read()
+
+    status, _ = req(
+        "/v1/loki/api/v1/push",
+        json.dumps(
+            {"streams": [{"stream": {"job": "j"}, "values": [["1000000000", "x"]]}]}
+        ).encode(),
+    )
+    assert status == 204
+    status, body = req(
+        "/v1/elasticsearch/_bulk", b'{"index": {"_index": "est"}}\n{"a": 1}\n'
+    )
+    assert status == 200 and json.loads(body)["errors"] is False
+    status, body = req(
+        "/v1/opentsdb/api/put?summary",
+        json.dumps({"metric": "m1", "timestamp": 1700000000, "value": 1.0}).encode(),
+    )
+    assert status == 200 and json.loads(body)["success"] == 1
+    _load_traces(db)
+    status, body = req("/v1/jaeger/api/services")
+    assert status == 200 and json.loads(body)["data"] == ["shop"]
+    status, body = req("/v1/jaeger/api/traces?service=shop&operation=GET%20/users")
+    assert status == 200 and len(json.loads(body)["data"]) == 1
+    srv.stop()
