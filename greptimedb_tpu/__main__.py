@@ -17,6 +17,8 @@ import sys
 def cmd_standalone(args):
     from .database import Database
     from .servers.http import HttpServer
+    from .servers.mysql import MysqlServer
+    from .servers.postgres import PostgresServer
     from .utils.config import Config
 
     cfg = Config.load(args.config)
@@ -27,9 +29,16 @@ def cmd_standalone(args):
         cfg.storage.__post_init__()
     if args.http_addr:
         cfg.server.http_addr = args.http_addr
+    if args.mysql_addr:
+        cfg.server.mysql_addr = args.mysql_addr
+    if args.postgres_addr:
+        cfg.server.postgres_addr = args.postgres_addr
     db = Database(config=cfg)
     srv = HttpServer(db, cfg.server.http_addr).start()
+    mysql = MysqlServer(db, cfg.server.mysql_addr).start(warm=False)
+    pg = PostgresServer(db, cfg.server.postgres_addr).start(warm=False)
     print(f"greptimedb-tpu standalone listening on http://{srv.address}", flush=True)
+    print(f"mysql on {mysql.address}, postgres on {pg.address}", flush=True)
     print(f"data home: {cfg.storage.data_home}", flush=True)
     try:
         import signal
@@ -40,6 +49,8 @@ def cmd_standalone(args):
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
         stop.wait()
     finally:
+        pg.stop()
+        mysql.stop()
         srv.stop()
         db.close()
     return 0
@@ -117,6 +128,8 @@ def main(argv=None):
     p.add_argument("--config", default=None, help="TOML config path")
     p.add_argument("--data-home", default=None)
     p.add_argument("--http-addr", default=None)
+    p.add_argument("--mysql-addr", default=None)
+    p.add_argument("--postgres-addr", default=None)
     p.set_defaults(fn=cmd_standalone)
 
     p = sub.add_parser("sql", help="execute SQL against a data dir")

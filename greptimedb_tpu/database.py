@@ -34,8 +34,10 @@ from .query.sql_parser import (
     ExplainStmt,
     InsertStmt,
     SelectStmt,
+    SetStmt,
     ShowStmt,
     TqlStmt,
+    TransactionStmt,
     TruncateStmt,
     UseStmt,
     parse_sql,
@@ -77,7 +79,12 @@ class Database:
         from .flow.engine import FlowManager
 
         self.flows = FlowManager(self)
-        self.current_database = DEFAULT_SCHEMA
+        # Per-thread session database (reference QueryContext carries the
+        # schema per connection): protocol servers handle each connection on
+        # its own thread, so USE / startup database choices must not leak
+        # across connections sharing this Database.
+        self._default_database = DEFAULT_SCHEMA
+        self._session = threading.local()
         self.query_engine = QueryEngine(
             schema_provider=self._schema_of,
             scan_provider=self._scan,
@@ -86,6 +93,14 @@ class Database:
             config=self.config.query,
         )
         self._reopen_regions()
+
+    @property
+    def current_database(self) -> str:
+        return getattr(self._session, "database", None) or self._default_database
+
+    @current_database.setter
+    def current_database(self, value: str):
+        self._session.database = value
 
     def close(self):
         self.flows.stop()
@@ -147,7 +162,14 @@ class Database:
             return self._alter(stmt)
         if isinstance(stmt, TruncateStmt):
             return self._truncate(stmt)
+        if isinstance(stmt, (SetStmt, TransactionStmt)):
+            return None  # accepted client-bootstrap no-ops
         raise UnsupportedError(f"unsupported statement: {type(stmt).__name__}")
+
+    def execute_stmt(self, stmt):
+        """Execute one parsed statement (protocol servers dispatch per
+        statement to derive wire-level command tags)."""
+        return self._execute(stmt)
 
     # ---- DDL --------------------------------------------------------------
     def _create_table(self, stmt: CreateTableStmt):

@@ -225,6 +225,23 @@ class TruncateStmt:
     table: str
 
 
+@dataclass
+class SetStmt:
+    """`SET k = v` session variable (accepted and recorded; most are client
+    bootstrap noise like SET NAMES / search_path — the reference stores them
+    on the session, session/src/context.rs)."""
+
+    raw: str
+
+
+@dataclass
+class TransactionStmt:
+    """BEGIN/COMMIT/ROLLBACK — accepted as no-ops (the reference's
+    storage has no interactive transactions either)."""
+
+    kind: str  # begin|commit|rollback
+
+
 class Parser:
     def __init__(self, sql: str):
         self.tokens = tokenize(sql)
@@ -328,6 +345,21 @@ class Parser:
             self.next()
             self.eat_kw("table")
             return TruncateStmt(self.ident())
+        if self.at_kw("set"):
+            # swallow everything up to the statement boundary
+            start = self.peek().pos
+            while not (self.peek().kind == "eof" or self.at_op(";")):
+                self.next()
+            return SetStmt(self.sql[start : self.peek().pos].strip())
+        if self.at_kw("begin", "commit", "rollback"):
+            kind = self.next().value.lower()
+            while not (self.peek().kind == "eof" or self.at_op(";")):
+                self.next()  # BEGIN WORK / ROLLBACK TO SAVEPOINT ...
+            return TransactionStmt(kind)
+        if self.at_kw("start"):
+            self.next()
+            self.expect_kw("transaction")
+            return TransactionStmt("begin")
         raise InvalidSyntaxError(f"unsupported statement: {self.peek().value!r}")
 
     # ---- ALTER ------------------------------------------------------------

@@ -1,0 +1,501 @@
+"""PostgreSQL wire-protocol server (v3).
+
+Role-equivalent of the reference's PostgreSQL frontend (reference
+servers/src/postgres/ over pgwire 0.37): startup/auth, the simple query
+protocol, and enough of the extended protocol (Parse/Bind/Describe/
+Execute/Sync with `$n` parameter substitution) for psql and common drivers
+(psycopg, node-postgres) to connect and query.  SSLRequest is politely
+declined ('N'), auth is trust or cleartext password against the
+UserProvider — matching the reference's PgLoginVerifier flow.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+
+import pyarrow as pa
+
+from ..utils.errors import GreptimeError
+
+PROTOCOL_V3 = 196608  # 3 << 16
+SSL_REQUEST = 80877103
+CANCEL_REQUEST = 80877102
+
+# Type OIDs (pg_catalog.pg_type)
+OID_BOOL = 16
+OID_INT8 = 20
+OID_INT4 = 23
+OID_FLOAT4 = 700
+OID_FLOAT8 = 701
+OID_TEXT = 25
+OID_TIMESTAMP = 1114
+OID_JSON = 114
+
+
+def _oid_of(t: pa.DataType) -> int:
+    if pa.types.is_boolean(t):
+        return OID_BOOL
+    if pa.types.is_integer(t):
+        return OID_INT8 if t.bit_width > 32 else OID_INT4
+    if pa.types.is_float32(t):
+        return OID_FLOAT4
+    if pa.types.is_floating(t):
+        return OID_FLOAT8
+    if pa.types.is_timestamp(t):
+        return OID_TIMESTAMP
+    return OID_TEXT
+
+
+def _render(v) -> bytes | None:
+    import datetime
+    import math
+
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
+        return str(v).encode()
+    if isinstance(v, datetime.datetime):
+        return v.strftime("%Y-%m-%d %H:%M:%S.%f").encode()
+    return str(v).encode()
+
+
+class _Msg:
+    """Backend message writer."""
+
+    @staticmethod
+    def pack(tag: bytes, payload: bytes) -> bytes:
+        return tag + struct.pack("!I", len(payload) + 4) + payload
+
+    @staticmethod
+    def auth_ok() -> bytes:
+        return _Msg.pack(b"R", struct.pack("!I", 0))
+
+    @staticmethod
+    def auth_cleartext() -> bytes:
+        return _Msg.pack(b"R", struct.pack("!I", 3))
+
+    @staticmethod
+    def parameter_status(k: str, v: str) -> bytes:
+        return _Msg.pack(b"S", k.encode() + b"\x00" + v.encode() + b"\x00")
+
+    @staticmethod
+    def backend_key(pid: int, secret: int) -> bytes:
+        return _Msg.pack(b"K", struct.pack("!II", pid, secret))
+
+    @staticmethod
+    def ready(status: bytes = b"I") -> bytes:
+        return _Msg.pack(b"Z", status)
+
+    @staticmethod
+    def error(severity: str, code: str, message: str) -> bytes:
+        fields = (
+            b"S" + severity.encode() + b"\x00"
+            + b"C" + code.encode() + b"\x00"
+            + b"M" + message.encode() + b"\x00"
+            + b"\x00"
+        )
+        return _Msg.pack(b"E", fields)
+
+    @staticmethod
+    def row_description(table: pa.Table) -> bytes:
+        out = struct.pack("!H", table.num_columns)
+        for name, col in zip(table.column_names, table.columns):
+            oid = _oid_of(col.type)
+            out += (
+                name.encode() + b"\x00"
+                + struct.pack("!IhIhih", 0, 0, oid, -1, -1, 0)
+            )
+        return _Msg.pack(b"T", out)
+
+    @staticmethod
+    def data_row(values: list[bytes | None]) -> bytes:
+        out = struct.pack("!H", len(values))
+        for v in values:
+            if v is None:
+                out += struct.pack("!i", -1)
+            else:
+                out += struct.pack("!I", len(v)) + v
+        return _Msg.pack(b"D", out)
+
+    @staticmethod
+    def command_complete(tag: str) -> bytes:
+        return _Msg.pack(b"C", tag.encode() + b"\x00")
+
+    @staticmethod
+    def empty_query() -> bytes:
+        return _Msg.pack(b"I", b"")
+
+    @staticmethod
+    def parse_complete() -> bytes:
+        return _Msg.pack(b"1", b"")
+
+    @staticmethod
+    def bind_complete() -> bytes:
+        return _Msg.pack(b"2", b"")
+
+    @staticmethod
+    def no_data() -> bytes:
+        return _Msg.pack(b"n", b"")
+
+    @staticmethod
+    def parameter_description(n: int) -> bytes:
+        return _Msg.pack(b"t", struct.pack("!H", n) + struct.pack("!I", OID_TEXT) * n)
+
+
+def _read_cstr(buf: bytes, pos: int) -> tuple[str, int]:
+    end = buf.index(b"\x00", pos)
+    return buf[pos:end].decode(errors="replace"), end + 1
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        sock = self.request
+        srv = self.server.gt_server  # type: ignore[attr-defined]
+        try:
+            params = self._startup(sock)
+            if params is None:
+                return
+            user = params.get("user", "")
+            if srv.user_provider is not None:
+                sock.sendall(_Msg.auth_cleartext())
+                msg = self._read_message(sock)
+                if msg is None or msg[0] != b"p":
+                    return
+                password = msg[1].split(b"\x00", 1)[0].decode(errors="replace")
+                if not srv.user_provider.authenticate(user, password):
+                    sock.sendall(
+                        _Msg.error(
+                            "FATAL", "28P01",
+                            f'password authentication failed for user "{user}"',
+                        )
+                    )
+                    return
+            sock.sendall(_Msg.auth_ok())
+            for k, v in (
+                ("server_version", "16.0-greptimedb-tpu"),
+                ("server_encoding", "UTF8"),
+                ("client_encoding", "UTF8"),
+                ("DateStyle", "ISO, MDY"),
+                ("integer_datetimes", "on"),
+            ):
+                sock.sendall(_Msg.parameter_status(k, v))
+            sock.sendall(_Msg.backend_key(threading.get_ident() & 0x7FFFFFFF, 0))
+            sock.sendall(_Msg.ready())
+
+            if params.get("database") not in (None, "", "public", "postgres"):
+                srv.db.current_database = params["database"]
+
+            self._serve(sock, srv)
+        except (ConnectionError, OSError):
+            pass
+
+    # ---- startup -----------------------------------------------------------
+    def _startup(self, sock) -> dict | None:
+        while True:
+            head = self._read_exact(sock, 4)
+            if head is None:
+                return None
+            (length,) = struct.unpack("!I", head)
+            body = self._read_exact(sock, length - 4)
+            if body is None or len(body) < 4:
+                return None
+            (code,) = struct.unpack("!I", body[:4])
+            if code == SSL_REQUEST:
+                sock.sendall(b"N")  # no TLS; client retries in clear
+                continue
+            if code == CANCEL_REQUEST:
+                return None
+            if code != PROTOCOL_V3:
+                sock.sendall(
+                    _Msg.error("FATAL", "08P01", f"unsupported protocol {code}")
+                )
+                return None
+            params: dict[str, str] = {}
+            pos = 4
+            while pos < len(body) - 1:
+                k, pos = _read_cstr(body, pos)
+                if not k:
+                    break
+                v, pos = _read_cstr(body, pos)
+                params[k] = v
+            return params
+
+    # ---- message loop ------------------------------------------------------
+    def _serve(self, sock, srv):
+        statements: dict[str, str] = {}
+        portals: dict[str, dict] = {}  # name -> {sql, result (cached by Describe)}
+        # After an extended-protocol error the backend must skip messages
+        # until Sync (PG protocol spec): otherwise pipelined Execute would
+        # run a stale portal and hand the client another query's rows.
+        in_error = False
+        while True:
+            msg = self._read_message(sock)
+            if msg is None:
+                return
+            tag, body = msg
+            if tag == b"X":  # Terminate
+                return
+            if tag == b"S":  # Sync — always processed, ends any error state
+                in_error = False
+                sock.sendall(_Msg.ready())
+                continue
+            if in_error and tag != b"Q":
+                continue  # discard until Sync
+            if tag == b"Q":
+                sql = body.split(b"\x00", 1)[0].decode(errors="replace")
+                in_error = False
+                self._simple_query(sock, srv, sql)
+            elif tag == b"P":  # Parse: name, query, n param oids
+                name, pos = _read_cstr(body, 0)
+                query, pos = _read_cstr(body, pos)
+                statements[name] = query
+                sock.sendall(_Msg.parse_complete())
+            elif tag == b"B":  # Bind: portal, stmt, formats, params, result formats
+                try:
+                    portal, stmt, query = self._bind(body, statements)
+                except GreptimeError as e:
+                    sock.sendall(_Msg.error("ERROR", "0A000", str(e)))
+                    in_error = True
+                    continue
+                portals[portal] = {"sql": query, "result": None, "described": False}
+                sock.sendall(_Msg.bind_complete())
+            elif tag == b"D":  # Describe
+                kind = body[0:1]
+                name, _ = _read_cstr(body, 1)
+                if kind == b"S":
+                    sock.sendall(_Msg.parameter_description(0))
+                    sock.sendall(_Msg.no_data())
+                    continue
+                p = portals.get(name)
+                # libpq requires the RowDescription here for row-returning
+                # portals; run the query now and cache rows for Execute
+                if p and self._returns_rows(p["sql"]):
+                    try:
+                        p["result"] = srv.db.sql_one(p["sql"])
+                        p["described"] = True
+                        sock.sendall(_Msg.row_description(p["result"]))
+                    except Exception as e:  # noqa: BLE001
+                        sock.sendall(_Msg.error("ERROR", "42601", str(e)))
+                        in_error = True
+                else:
+                    sock.sendall(_Msg.no_data())
+            elif tag == b"E":  # Execute
+                name, _ = _read_cstr(body, 0)
+                p = portals.get(name) or {"sql": "", "result": None}
+                if p.get("result") is not None:
+                    result = p["result"]
+                    p["result"] = None
+                    cols = [c.to_pylist() for c in result.columns]
+                    for r in range(result.num_rows):
+                        sock.sendall(
+                            _Msg.data_row([_render(col[r]) for col in cols])
+                        )
+                    sock.sendall(_Msg.command_complete(f"SELECT {result.num_rows}"))
+                else:
+                    # RowDescription is only legal in response to Describe —
+                    # a client re-executing a described statement already
+                    # knows the row format
+                    ok = self._simple_query(
+                        sock, srv, p["sql"], ready=False, describe=False
+                    )
+                    if not ok:
+                        in_error = True
+            elif tag == b"H":  # Flush
+                pass
+            elif tag == b"C":  # Close statement/portal
+                kind = body[0:1]
+                name, _ = _read_cstr(body, 1)
+                (portals if kind == b"P" else statements).pop(name, None)
+                sock.sendall(_Msg.pack(b"3", b""))  # CloseComplete
+            else:
+                sock.sendall(
+                    _Msg.error("ERROR", "0A000", f"unsupported message {tag!r}")
+                )
+                in_error = True
+
+    @staticmethod
+    def _returns_rows(sql: str) -> bool:
+        first = sql.split(None, 1)[0].upper() if sql.split() else ""
+        return first in ("SELECT", "SHOW", "DESCRIBE", "DESC", "TQL", "EXPLAIN", "WITH")
+
+    def _bind(self, body: bytes, statements: dict) -> tuple[str, str, str]:
+        portal, pos = _read_cstr(body, 0)
+        stmt, pos = _read_cstr(body, pos)
+        (n_fmt,) = struct.unpack_from("!H", body, pos)
+        pos += 2
+        fmts = list(struct.unpack_from(f"!{n_fmt}H", body, pos)) if n_fmt else []
+        pos += 2 * n_fmt
+        (n_params,) = struct.unpack_from("!H", body, pos)
+        pos += 2
+        params: list[str | None] = []
+        for i in range(n_params):
+            (plen,) = struct.unpack_from("!i", body, pos)
+            pos += 4
+            if plen < 0:
+                params.append(None)
+            else:
+                raw = body[pos : pos + plen]
+                pos += plen
+                fmt = fmts[i] if i < len(fmts) else (fmts[0] if len(fmts) == 1 else 0)
+                if fmt == 1:
+                    raise GreptimeError("binary parameters are not supported")
+                params.append(raw.decode(errors="replace"))
+        query = statements.get(stmt, "")
+        return portal, stmt, _substitute(query, params)
+
+    # ---- query execution ---------------------------------------------------
+    def _simple_query(
+        self, sock, srv, sql: str, ready: bool = True, describe: bool = True
+    ) -> bool:
+        """Returns True on success, False if an ErrorResponse was sent."""
+        sql = sql.strip()
+        ok = True
+        try:
+            if not sql or sql == ";":
+                sock.sendall(_Msg.empty_query())
+            else:
+                for result, tag in self._execute(srv, sql):
+                    if isinstance(result, pa.Table):
+                        if describe:
+                            sock.sendall(_Msg.row_description(result))
+                        cols = [c.to_pylist() for c in result.columns]
+                        for r in range(result.num_rows):
+                            sock.sendall(
+                                _Msg.data_row([_render(col[r]) for col in cols])
+                            )
+                        sock.sendall(
+                            _Msg.command_complete(f"SELECT {result.num_rows}")
+                        )
+                    else:
+                        sock.sendall(_Msg.command_complete(tag))
+        except GreptimeError as e:
+            sock.sendall(_Msg.error("ERROR", "42601", str(e)))
+            ok = False
+        except Exception as e:  # noqa: BLE001 — wire loop must survive
+            sock.sendall(_Msg.error("ERROR", "XX000", f"{type(e).__name__}: {e}"))
+            ok = False
+        if ready:
+            sock.sendall(_Msg.ready())
+        return ok
+
+    def _execute(self, srv, sql: str):
+        """Yields (result, command_tag) per statement.  DISCARD/RESET are
+        client bootstrap noise handled here; SET/BEGIN/COMMIT/ROLLBACK are
+        real (no-op) statements the SQL parser understands, so multi-
+        statement batches like 'BEGIN; SELECT 1' execute every part."""
+        from ..query.sql_parser import (
+            DeleteStmt,
+            InsertStmt,
+            SetStmt,
+            TransactionStmt,
+            parse_sql,
+        )
+
+        first = sql.split(None, 1)[0].upper() if sql.split() else ""
+        if first in ("DISCARD", "RESET"):
+            yield None, first
+            return
+        for stmt in parse_sql(sql):
+            result = srv.db.execute_stmt(stmt)
+            if isinstance(result, pa.Table):
+                yield result, ""
+            elif isinstance(stmt, InsertStmt):
+                yield None, f"INSERT 0 {result or 0}"
+            elif isinstance(stmt, DeleteStmt):
+                yield None, f"DELETE {result or 0}"
+            elif isinstance(stmt, SetStmt):
+                yield None, "SET"
+            elif isinstance(stmt, TransactionStmt):
+                yield None, stmt.kind.upper()
+            elif isinstance(result, int):
+                yield None, f"INSERT 0 {result}"
+            else:
+                yield None, _tag_of(stmt)
+
+    # ---- IO ----------------------------------------------------------------
+    def _read_message(self, sock) -> tuple[bytes, bytes] | None:
+        head = self._read_exact(sock, 5)
+        if head is None:
+            return None
+        tag = head[:1]
+        (length,) = struct.unpack("!I", head[1:])
+        body = self._read_exact(sock, length - 4) if length > 4 else b""
+        if body is None:
+            return None
+        return tag, body
+
+    @staticmethod
+    def _read_exact(sock, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+
+def _tag_of(stmt) -> str:
+    """CommandComplete tag for non-row statements (pg spec verbs)."""
+    name = type(stmt).__name__
+    if name == "DropStmt":
+        return f"DROP {stmt.kind.upper()}"
+    return {
+        "CreateTableStmt": "CREATE TABLE",
+        "CreateDatabaseStmt": "CREATE DATABASE",
+        "CreateFlowStmt": "CREATE FLOW",
+        "AlterTableStmt": "ALTER TABLE",
+        "TruncateStmt": "TRUNCATE TABLE",
+        "UseStmt": "USE",
+        "AdminStmt": "ADMIN",
+    }.get(name, "OK")
+
+
+def _substitute(sql: str, params: list[str | None]) -> str:
+    """Replace $1..$n with quoted literals (the reference emulates prepared
+    statements by parameter substitution the same way, mysql handler.rs)."""
+    out = sql
+    for i in reversed(range(len(params))):  # $10 before $1
+        v = params[i]
+        lit = "NULL" if v is None else "'" + v.replace("'", "''") + "'"
+        out = out.replace(f"${i + 1}", lit)
+    return out
+
+
+class _ThreadingTCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PostgresServer:
+    def __init__(self, db, addr: str = "127.0.0.1:0", user_provider=None):
+        self.db = db
+        self.user_provider = user_provider
+        host, port = addr.rsplit(":", 1)
+        self._tcp = _ThreadingTCPServer((host, int(port)), _Handler)
+        self._tcp.gt_server = self  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._tcp.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self, warm: bool = True):
+        if warm:
+            from ..utils import kernel_executor
+
+            kernel_executor.warm_up()
+        self._thread = threading.Thread(target=self._tcp.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

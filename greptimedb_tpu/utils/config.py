@@ -88,6 +88,8 @@ class ParallelConfig:
 class ServerConfig:
     http_addr: str = "127.0.0.1:4000"
     grpc_addr: str = "127.0.0.1:4001"
+    mysql_addr: str = "127.0.0.1:4002"
+    postgres_addr: str = "127.0.0.1:4003"
 
 
 @dataclasses.dataclass

@@ -1,0 +1,270 @@
+"""PostgreSQL wire protocol server tests with a minimal v3 client
+(reference servers/src/postgres/ pgwire integration)."""
+
+import socket
+import struct
+
+import pytest
+
+from greptimedb_tpu.auth.user_provider import StaticUserProvider
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.servers.postgres import PostgresServer
+
+
+class PgClient:
+    def __init__(self, host, port, user="g", database="public", password=None):
+        self.sock = socket.create_connection((host, port), timeout=10)
+        params = f"user\x00{user}\x00database\x00{database}\x00\x00".encode()
+        body = struct.pack("!I", 196608) + params
+        self.sock.sendall(struct.pack("!I", len(body) + 4) + body)
+        self.params = {}
+        self.password = password
+        self._drain_until_ready()
+
+    def _read_msg(self):
+        head = self._read_exact(5)
+        tag = head[:1]
+        (length,) = struct.unpack("!I", head[1:])
+        body = self._read_exact(length - 4) if length > 4 else b""
+        return tag, body
+
+    def _read_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("eof")
+            buf += chunk
+        return buf
+
+    def _drain_until_ready(self):
+        while True:
+            tag, body = self._read_msg()
+            if tag == b"R":
+                (code,) = struct.unpack("!I", body[:4])
+                if code == 3:  # cleartext password
+                    pw = (self.password or "").encode() + b"\x00"
+                    self.sock.sendall(b"p" + struct.pack("!I", len(pw) + 4) + pw)
+                elif code != 0:
+                    raise AssertionError(f"unsupported auth {code}")
+            elif tag == b"S":
+                k, v = body.split(b"\x00")[:2]
+                self.params[k.decode()] = v.decode()
+            elif tag == b"E":
+                raise AssertionError(f"server error: {body}")
+            elif tag == b"Z":
+                return
+
+    def query(self, sql):
+        """Simple query protocol; returns (columns, rows, tags)."""
+        payload = sql.encode() + b"\x00"
+        self.sock.sendall(b"Q" + struct.pack("!I", len(payload) + 4) + payload)
+        cols, rows, tags, errors = [], [], [], []
+        while True:
+            tag, body = self._read_msg()
+            if tag == b"T":
+                (n,) = struct.unpack("!H", body[:2])
+                pos = 2
+                cols = []
+                for _ in range(n):
+                    end = body.index(b"\x00", pos)
+                    cols.append(body[pos:end].decode())
+                    pos = end + 1 + 18
+            elif tag == b"D":
+                (n,) = struct.unpack("!H", body[:2])
+                pos = 2
+                row = []
+                for _ in range(n):
+                    (ln,) = struct.unpack_from("!i", body, pos)
+                    pos += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(body[pos : pos + ln].decode())
+                        pos += ln
+                rows.append(row)
+            elif tag == b"C":
+                tags.append(body.rstrip(b"\x00").decode())
+            elif tag == b"E":
+                errors.append(body)
+            elif tag == b"Z":
+                if errors:
+                    raise AssertionError(f"query error: {errors}")
+                return cols, rows, tags
+
+    def extended(self, sql, args=()):
+        """Parse/Bind/Describe/Execute/Sync round trip."""
+        def msg(tag, payload):
+            return tag + struct.pack("!I", len(payload) + 4) + payload
+
+        out = msg(b"P", b"\x00" + sql.encode() + b"\x00" + struct.pack("!H", 0))
+        bind = b"\x00\x00" + struct.pack("!H", 0) + struct.pack("!H", len(args))
+        for a in args:
+            if a is None:
+                bind += struct.pack("!i", -1)
+            else:
+                raw = str(a).encode()
+                bind += struct.pack("!I", len(raw)) + raw
+        bind += struct.pack("!H", 0)
+        out += msg(b"B", bind)
+        out += msg(b"D", b"P\x00")
+        out += msg(b"E", b"\x00" + struct.pack("!I", 0))
+        out += msg(b"S", b"")
+        self.sock.sendall(out)
+        cols, rows, errors = [], [], []
+        while True:
+            tag, body = self._read_msg()
+            if tag == b"T":
+                (n,) = struct.unpack("!H", body[:2])
+                pos = 2
+                for _ in range(n):
+                    end = body.index(b"\x00", pos)
+                    cols.append(body[pos:end].decode())
+                    pos = end + 1 + 18
+            elif tag == b"D":
+                (n,) = struct.unpack("!H", body[:2])
+                pos = 2
+                row = []
+                for _ in range(n):
+                    (ln,) = struct.unpack_from("!i", body, pos)
+                    pos += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(body[pos : pos + ln].decode())
+                        pos += ln
+                rows.append(row)
+            elif tag == b"E":
+                errors.append(body)
+            elif tag == b"Z":
+                if errors:
+                    raise AssertionError(f"query error: {errors}")
+                return cols, rows
+
+    def close(self):
+        self.sock.sendall(b"X" + struct.pack("!I", 4))
+        self.sock.close()
+
+
+@pytest.fixture()
+def server(tmp_path):
+    db = Database(data_home=str(tmp_path))
+    db.sql(
+        "CREATE TABLE pgt (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host))"
+    )
+    db.sql("INSERT INTO pgt VALUES ('a', 1000, 1.5), ('b', 2000, 2.5)")
+    srv = PostgresServer(db, addr="127.0.0.1:0")
+    srv.start(warm=False)
+    host, port = srv.address.rsplit(":", 1)
+    yield host, int(port)
+    srv.stop()
+    db.close()
+
+
+def test_simple_query(server):
+    c = PgClient(*server)
+    assert c.params.get("server_encoding") == "UTF8"
+    cols, rows, tags = c.query("SELECT host, v FROM pgt ORDER BY ts")
+    assert cols == ["host", "v"]
+    assert rows == [["a", "1.5"], ["b", "2.5"]]
+    assert tags == ["SELECT 2"]
+    c.close()
+
+
+def test_insert_ddl_and_multi_statement(server):
+    c = PgClient(*server)
+    _, _, tags = c.query("INSERT INTO pgt VALUES ('c', 3000, 3.5)")
+    assert tags == ["INSERT 0 1"]
+    _, rows, _ = c.query("SELECT count(*) AS n FROM pgt")
+    assert rows == [["3"]]
+    _, _, tags = c.query("CREATE TABLE other (ts TIMESTAMP TIME INDEX, x DOUBLE); SELECT 1 AS one")
+    assert tags[-1] == "SELECT 1"
+    c.close()
+
+
+def test_set_and_begin_are_noops(server):
+    c = PgClient(*server)
+    _, _, tags = c.query("SET search_path = public")
+    assert tags == ["SET"]
+    _, _, tags = c.query("BEGIN")
+    assert tags == ["BEGIN"]
+    c.close()
+
+
+def test_error_then_recover(server):
+    c = PgClient(*server)
+    with pytest.raises(AssertionError):
+        c.query("SELECT nope FROM missing_table")
+    cols, rows, _ = c.query("SELECT 1 AS ok")
+    assert rows == [["1"]]
+    c.close()
+
+
+def test_extended_protocol(server):
+    c = PgClient(*server)
+    cols, rows = c.extended("SELECT host, v FROM pgt WHERE host = $1", ["a"])
+    assert cols == ["host", "v"]
+    assert rows == [["a", "1.5"]]
+    # non-row statement through extended protocol
+    cols, rows = c.extended("INSERT INTO pgt VALUES ('d', 4000, 4.5)")
+    assert rows == []
+    c.close()
+
+
+def test_cleartext_auth(tmp_path):
+    db = Database(data_home=str(tmp_path))
+    db.sql("CREATE TABLE t (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+    srv = PostgresServer(
+        db, addr="127.0.0.1:0", user_provider=StaticUserProvider({"alice": "s3cret"})
+    )
+    srv.start(warm=False)
+    host, port = srv.address.rsplit(":", 1)
+    try:
+        c = PgClient(host, int(port), user="alice", password="s3cret")
+        _, rows, _ = c.query("SELECT 42 AS x")
+        assert rows == [["42"]]
+        c.close()
+        with pytest.raises((AssertionError, ConnectionError)):
+            PgClient(host, int(port), user="alice", password="wrong")
+    finally:
+        srv.stop()
+        db.close()
+
+
+def test_ssl_request_declined(server):
+    host, port = server
+    s = socket.create_connection((host, port), timeout=10)
+    body = struct.pack("!I", 80877103)
+    s.sendall(struct.pack("!I", len(body) + 4) + body)
+    assert s.recv(1) == b"N"
+    # proceed with normal startup on the same connection
+    params = b"user\x00g\x00\x00"
+    body = struct.pack("!I", 196608) + params
+    s.sendall(struct.pack("!I", len(body) + 4) + body)
+    head = s.recv(5)
+    assert head[:1] == b"R"
+    s.close()
+
+
+def test_begin_then_select_in_one_batch(server):
+    c = PgClient(*server)
+    cols, rows, tags = c.query("BEGIN; SELECT count(*) AS n FROM pgt")
+    assert rows == [["2"]]
+    assert tags == ["BEGIN", "SELECT 1"]
+    c.close()
+
+
+def test_per_connection_database_isolation(server):
+    host, port = server
+    c1 = PgClient(host, port)
+    c2 = PgClient(host, port)
+    c1.query("CREATE DATABASE iso")
+    c1.query("USE iso")
+    c1.query("CREATE TABLE only_iso (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+    # c2 still resolves tables in public — pgt is visible, only_iso is not
+    _, rows, _ = c2.query("SELECT count(*) AS n FROM pgt")
+    assert rows == [["2"]]
+    with pytest.raises(AssertionError):
+        c2.query("SELECT * FROM only_iso")
+    c1.close()
+    c2.close()
