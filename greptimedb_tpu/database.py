@@ -25,6 +25,7 @@ from .query.expr import Column
 from .query.sql_parser import (
     AdminStmt,
     AlterTableStmt,
+    CopyStmt,
     CreateDatabaseStmt,
     CreateFlowStmt,
     CreateTableStmt,
@@ -162,6 +163,8 @@ class Database:
             return self._alter(stmt)
         if isinstance(stmt, TruncateStmt):
             return self._truncate(stmt)
+        if isinstance(stmt, CopyStmt):
+            return self._copy(stmt)
         if isinstance(stmt, (SetStmt, TransactionStmt)):
             return None  # accepted client-bootstrap no-ops
         raise UnsupportedError(f"unsupported statement: {type(stmt).__name__}")
@@ -173,6 +176,8 @@ class Database:
 
     # ---- DDL --------------------------------------------------------------
     def _create_table(self, stmt: CreateTableStmt):
+        if stmt.external or stmt.engine == "file":
+            return self._create_external_table(stmt)
 
         # Metric-engine routing (reference metric-engine DDL rewrite,
         # src/metric-engine/src/engine/create.rs).
@@ -266,6 +271,93 @@ class Database:
         )
         return None
 
+    def _create_external_table(self, stmt: CreateTableStmt):
+        """CREATE EXTERNAL TABLE over CSV/JSON/Parquet files (reference
+        file-engine + `CREATE EXTERNAL TABLE ... WITH (location, format)`)."""
+        from .storage import file_engine as fe
+
+        location = stmt.options.get("location")
+        if not location:
+            raise InvalidArgumentsError(
+                "external table requires WITH (location = '...')"
+            )
+        fmt = fe.detect_format(str(location), stmt.options.get("format"))
+        if stmt.columns:
+            columns = []
+            time_index = stmt.time_index or next(
+                (c.name for c in stmt.columns if c.is_time_index), None
+            )
+            pks = set(stmt.primary_key) | {
+                c.name for c in stmt.columns if c.is_primary_key
+            }
+            for c in stmt.columns:
+                if c.name == time_index:
+                    sem = SemanticType.TIMESTAMP
+                elif c.name in pks:
+                    sem = SemanticType.TAG
+                else:
+                    sem = SemanticType.FIELD
+                columns.append(
+                    ColumnSchema(
+                        name=c.name,
+                        data_type=ConcreteDataType.parse(c.type_name),
+                        semantic_type=sem,
+                    )
+                )
+            schema = Schema(columns=columns)
+        else:
+            schema = fe.infer_schema(str(location), fmt)
+        self.catalog.create_table(
+            stmt.name,
+            schema,
+            database=self.current_database,
+            if_not_exists=stmt.if_not_exists,
+            options={fe.LOCATION_OPT: str(location), fe.FORMAT_OPT: fmt},
+        )
+        return None
+
+    def _copy(self, stmt: CopyStmt):
+        """COPY table/database TO|FROM path (reference
+        operator/src/statement/copy_*.rs)."""
+        from .storage import file_engine as fe
+
+        if stmt.kind == "database":
+            if stmt.direction == "to":
+                fmt = str(stmt.options.get("format", "parquet")).lower()
+                fe.detect_format(f"x.{fmt}", fmt)  # validate
+                total = 0
+                for meta in self.catalog.tables(stmt.name):
+                    if is_logical_meta(meta) or fe.is_external_meta(meta):
+                        continue
+                    out = os.path.join(stmt.path, f"{meta.name}.{fmt}")
+                    t = self._scan(TableScan(meta.name, stmt.name))
+                    fe.write_file(t, out, fmt)
+                    total += t.num_rows
+                return total
+            total = 0
+            for path in fe.expand_location(stmt.path):
+                table_name = os.path.splitext(os.path.basename(path))[0]
+                t = fe.read_file(path, fe.detect_format(path))
+                total += self.insert_rows(table_name, t, database=stmt.name)
+            return total
+        fmt = fe.detect_format(stmt.path, stmt.options.get("format"))
+        if stmt.direction == "to":
+            t = self._scan(TableScan(stmt.name, self.current_database))
+            fe.write_file(t, stmt.path, fmt)
+            return t.num_rows
+        total = 0
+        for path in fe.expand_location(stmt.path):
+            t = fe.read_file(path, fmt)
+            total += self.insert_rows(stmt.name, t, database=self.current_database)
+        return total
+
+    @staticmethod
+    def _reject_external(meta):
+        from .storage import file_engine as fe
+
+        if fe.is_external_meta(meta):
+            raise UnsupportedError(f"external table {meta.name!r} is read-only")
+
     # ---- ALTER / TRUNCATE / DELETE ----------------------------------------
     def _alter(self, stmt: AlterTableStmt):
         """ALTER TABLE (reference operator/src/statement/ddl.rs alter path +
@@ -275,6 +367,13 @@ class Database:
             if is_logical_meta(meta) or is_physical_meta(meta):
                 raise UnsupportedError(
                     "ALTER TABLE on metric-engine tables is not supported"
+                )
+            from .storage import file_engine as fe
+
+            if fe.is_external_meta(meta):
+                raise UnsupportedError(
+                    f"external table {stmt.table!r} is read-only; "
+                    "recreate it to change the schema"
                 )
             if stmt.action == "rename":
                 referencing = self.flows.flows_referencing(
@@ -370,6 +469,7 @@ class Database:
 
     def _truncate(self, stmt: TruncateStmt):
         meta = self.catalog.table(stmt.table, self.current_database)
+        self._reject_external(meta)
         if is_logical_meta(meta) or is_physical_meta(meta):
             # truncating the shared physical regions would wipe every
             # logical table multiplexed onto them
@@ -384,6 +484,7 @@ class Database:
         deletes to OpType::Delete rows routed like inserts,
         operator/src/delete.rs)."""
         meta = self.catalog.table(stmt.table, self.current_database)
+        self._reject_external(meta)
         if is_logical_meta(meta) or is_physical_meta(meta):
             raise UnsupportedError(
                 "DELETE on metric-engine tables is not supported"
@@ -424,9 +525,13 @@ class Database:
         if is_physical_meta(meta):
             self.metric.drop_physical_table(meta)
             return None
+        from .storage import file_engine as fe
+
+        external = fe.is_external_meta(meta)
         meta = self.catalog.drop_table(stmt.name, self.current_database)
-        for rid in meta.region_ids:
-            self.storage.drop_region(rid)
+        if not external:  # external tables own no regions (files stay put)
+            for rid in meta.region_ids:
+                self.storage.drop_region(rid)
         return None
 
     # ---- DML --------------------------------------------------------------
@@ -457,6 +562,12 @@ class Database:
         source table (reference FlowMirrorTask, insert.rs:397-406); flow
         sink writes pass mirror=False to avoid self-feeding."""
 
+        from .storage import file_engine as fe
+
+        if fe.is_external_meta(meta):
+            raise UnsupportedError(
+                f"external table {meta.name!r} is read-only"
+            )
         if is_logical_meta(meta):
             affected = self.metric.write_logical(meta, batch)
             if mirror and self.flows.infos:
@@ -620,6 +731,10 @@ class Database:
         meta = self.catalog.table(scan.table, scan.database)
         if is_logical_meta(meta):
             return self.metric.scan_logical(meta, scan)
+        from .storage import file_engine as fe
+
+        if fe.is_external_meta(meta):
+            return [fe.scan(meta, self._pred_of(scan))]
         pred = self._pred_of(scan)
         return [self.storage.scan(rid, pred) for rid in meta.region_ids]
 
@@ -648,6 +763,10 @@ class Database:
             # Logical tables share the physical region's bounds (cheap and
             # conservative — pruning still applies __table_id at scan time).
             meta = self.catalog.table(meta.options[LOGICAL_TABLE_OPT], database)
+        from .storage import file_engine as fe
+
+        if fe.is_external_meta(meta):
+            return fe.time_bounds(meta) or (0, 0)
         lo, hi = None, None
         for rid in meta.region_ids:
             region = self.storage.region(rid)
@@ -666,10 +785,12 @@ class Database:
     # ---- recovery ---------------------------------------------------------
     def _reopen_regions(self):
 
+        from .storage import file_engine as fe
+
         for db in self.catalog.databases():
             for meta in self.catalog.tables(db):
-                if is_logical_meta(meta):
-                    continue  # logical tables have no regions of their own
+                if is_logical_meta(meta) or fe.is_external_meta(meta):
+                    continue  # no regions of their own
                 for rid in meta.region_ids:
                     try:
                         self.storage.open_region(rid)

@@ -123,6 +123,7 @@ class CreateTableStmt:
     partition_on_columns: tuple[str, list] | None = None  # (column, bounds)
     engine: str = "mito"
     options: dict = field(default_factory=dict)
+    external: bool = False  # CREATE EXTERNAL TABLE (file engine)
 
 
 @dataclass
@@ -223,6 +224,20 @@ class AlterTableStmt:
 @dataclass
 class TruncateStmt:
     table: str
+
+
+@dataclass
+class CopyStmt:
+    """COPY data in/out (reference sql/src/statements/copy.rs +
+    operator/src/statement/copy_table_{from,to}.rs, copy_database.rs):
+    `COPY tbl TO|FROM 'path' [WITH (format = 'parquet'|'csv'|'json')]`,
+    `COPY DATABASE db TO|FROM 'dir' [WITH (...)]`."""
+
+    kind: str  # table|database
+    name: str
+    direction: str  # to|from
+    path: str
+    options: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -360,7 +375,36 @@ class Parser:
             self.next()
             self.expect_kw("transaction")
             return TransactionStmt("begin")
+        if self.at_kw("copy"):
+            return self.parse_copy()
         raise InvalidSyntaxError(f"unsupported statement: {self.peek().value!r}")
+
+    def parse_copy(self) -> CopyStmt:
+        self.expect_kw("copy")
+        kind = "database" if self.eat_kw("database") else "table"
+        if kind == "table":
+            self.eat_kw("table")
+        name = self.ident()
+        if self.eat_kw("to"):
+            direction = "to"
+        else:
+            self.expect_kw("from")
+            direction = "from"
+        t = self.next()
+        if t.kind != "string":
+            raise InvalidSyntaxError("COPY requires a quoted path")
+        path = t.value[1:-1].replace("''", "'")
+        options: dict = {}
+        if self.eat_kw("with"):
+            self.expect_op("(")
+            while not self.at_op(")"):
+                k = self.parse_option_key()
+                self.expect_op("=")
+                options[k.lower()] = self.parse_literal_value()
+                if not self.eat_op(","):
+                    break
+            self.expect_op(")")
+        return CopyStmt(kind, name, direction, path, options)
 
     # ---- ALTER ------------------------------------------------------------
     def parse_alter(self) -> AlterTableStmt:
@@ -788,31 +832,34 @@ class Parser:
         if self.eat_kw("database", "schema"):
             ine = self._if_not_exists()
             return CreateDatabaseStmt(self.ident(), if_not_exists=ine)
+        external = self.eat_kw("external")
         self.expect_kw("table")
         ine = self._if_not_exists()
         name = self.ident()
         stmt = CreateTableStmt(name=name, columns=[], if_not_exists=ine)
-        self.expect_op("(")
-        while not self.at_op(")"):
-            if self.at_kw("time"):
-                self.next()
-                self.expect_kw("index")
-                self.expect_op("(")
-                stmt.time_index = self.ident()
-                self.expect_op(")")
-            elif self.at_kw("primary"):
-                self.next()
-                self.expect_kw("key")
-                self.expect_op("(")
-                stmt.primary_key.append(self.ident())
-                while self.eat_op(","):
+        stmt.external = external
+        if not external or self.at_op("("):
+            self.expect_op("(")
+            while not self.at_op(")"):
+                if self.at_kw("time"):
+                    self.next()
+                    self.expect_kw("index")
+                    self.expect_op("(")
+                    stmt.time_index = self.ident()
+                    self.expect_op(")")
+                elif self.at_kw("primary"):
+                    self.next()
+                    self.expect_kw("key")
+                    self.expect_op("(")
                     stmt.primary_key.append(self.ident())
-                self.expect_op(")")
-            else:
-                stmt.columns.append(self.parse_column_def())
-            if not self.eat_op(","):
-                break
-        self.expect_op(")")
+                    while self.eat_op(","):
+                        stmt.primary_key.append(self.ident())
+                    self.expect_op(")")
+                else:
+                    stmt.columns.append(self.parse_column_def())
+                if not self.eat_op(","):
+                    break
+            self.expect_op(")")
         # table-level clauses
         while True:
             if self.eat_kw("partition"):

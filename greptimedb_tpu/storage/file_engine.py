@@ -1,0 +1,184 @@
+"""File engine: external tables over CSV/NDJSON/Parquet files.
+
+Role-equivalent of the reference's file engine + datasource layer
+(reference src/file-engine/src/engine.rs `FileRegionEngine`,
+common/datasource): `CREATE EXTERNAL TABLE` registers a read-only table
+whose scans decode files on demand — no regions, no WAL.  Also provides
+the format codecs used by `COPY table TO/FROM` (reference
+operator/src/statement/copy_table_{from,to}.rs).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json as _json
+import os
+
+import pyarrow as pa
+import pyarrow.csv as pa_csv
+import pyarrow.parquet as pq
+
+from ..datatypes.data_type import ConcreteDataType
+from ..datatypes.schema import ColumnSchema, Schema, SemanticType
+from ..utils.errors import InvalidArgumentsError
+
+LOCATION_OPT = "__external_location"
+FORMAT_OPT = "__external_format"
+
+FORMATS = ("parquet", "csv", "json")
+
+_EXTENSIONS = {".parquet": "parquet", ".csv": "csv", ".json": "json", ".ndjson": "json"}
+
+
+def detect_format(path: str, explicit: str | None = None) -> str:
+    if explicit:
+        f = explicit.lower()
+        if f not in FORMATS:
+            raise InvalidArgumentsError(
+                f"unsupported format {explicit!r} (use parquet/csv/json)"
+            )
+        return f
+    ext = os.path.splitext(path)[1].lower()
+    if ext in _EXTENSIONS:
+        return _EXTENSIONS[ext]
+    raise InvalidArgumentsError(
+        f"cannot infer format from {path!r}; pass WITH (format = '...')"
+    )
+
+
+def expand_location(location: str) -> list[str]:
+    """A file, a directory (all supported files inside), or a glob."""
+    if os.path.isdir(location):
+        out = [
+            os.path.join(location, f)
+            for f in sorted(os.listdir(location))
+            if os.path.splitext(f)[1].lower() in _EXTENSIONS
+        ]
+        if not out:
+            raise InvalidArgumentsError(f"no data files in directory {location!r}")
+        return out
+    if any(c in location for c in "*?["):
+        out = sorted(_glob.glob(location))
+        if not out:
+            raise InvalidArgumentsError(f"glob matched no files: {location!r}")
+        return out
+    if not os.path.exists(location):
+        raise InvalidArgumentsError(f"no such file: {location!r}")
+    return [location]
+
+
+def read_file(path: str, fmt: str) -> pa.Table:
+    if fmt == "parquet":
+        return pq.read_table(path)
+    if fmt == "csv":
+        return pa_csv.read_csv(path)
+    if fmt == "json":
+        import pyarrow.json as pa_json
+
+        return pa_json.read_json(path)
+    raise InvalidArgumentsError(f"unsupported format {fmt!r}")
+
+
+def write_file(table: pa.Table, path: str, fmt: str):
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    if fmt == "parquet":
+        pq.write_table(table, path, compression="zstd")
+    elif fmt == "csv":
+        pa_csv.write_csv(table, path)
+    elif fmt == "json":
+        with open(path, "w") as f:
+            for row in table.to_pylist():
+                f.write(_json.dumps(row, default=str) + "\n")
+    else:
+        raise InvalidArgumentsError(f"unsupported format {fmt!r}")
+
+
+def infer_schema(location: str, fmt: str) -> Schema:
+    """Derive a Schema from the first file: the first timestamp-typed column
+    becomes the time index, everything else a FIELD (reference file-engine
+    infers the arrow schema from the file the same way)."""
+    first = expand_location(location)[0]
+    if fmt == "parquet":
+        arrow_schema = pq.read_schema(first)  # footer only, no data decode
+    else:
+        arrow_schema = read_file(first, fmt).schema
+    cols = []
+    ts_seen = False
+    for f in arrow_schema:
+        dt = ConcreteDataType.from_arrow(f.type)
+        if not ts_seen and pa.types.is_timestamp(f.type):
+            cols.append(ColumnSchema(f.name, dt, SemanticType.TIMESTAMP))
+            ts_seen = True
+        else:
+            cols.append(ColumnSchema(f.name, dt, SemanticType.FIELD))
+    return Schema(columns=cols)
+
+
+def time_bounds(meta) -> tuple[int, int] | None:
+    """Min/max of the time index.  Parquet answers from row-group footer
+    statistics without decoding data; other formats fall back to a scan."""
+    ts = meta.schema.time_index
+    if ts is None:
+        return None
+    fmt = meta.options[FORMAT_OPT]
+    unit_ns = ts.data_type.timestamp_unit_ns()
+    lo = hi = None
+    if fmt == "parquet":
+        from .sst import _ts_to_int
+
+        for path in expand_location(meta.options[LOCATION_OPT]):
+            pf = pq.ParquetFile(path)
+            idx = pf.schema_arrow.get_field_index(ts.name)
+            if idx < 0:
+                continue
+            for g in range(pf.metadata.num_row_groups):
+                stats = pf.metadata.row_group(g).column(idx).statistics
+                if stats is None or not stats.has_min_max:
+                    return _scan_bounds(meta, ts, unit_ns)  # stats missing
+                g_min = _ts_to_int(stats.min, unit_ns)
+                g_max = _ts_to_int(stats.max, unit_ns)
+                lo = g_min if lo is None else min(lo, g_min)
+                hi = g_max if hi is None else max(hi, g_max)
+        return None if lo is None else (lo, hi)
+    return _scan_bounds(meta, ts, unit_ns)
+
+
+def _scan_bounds(meta, ts, unit_ns) -> tuple[int, int] | None:
+    import pyarrow.compute as pc
+
+    t = scan(meta)
+    if t.num_rows == 0:
+        return None
+    col = pc.cast(t[ts.name], pa.int64())
+    return (pc.min(col).as_py(), pc.max(col).as_py())
+
+
+def is_external_meta(meta) -> bool:
+    return LOCATION_OPT in meta.options
+
+
+def scan(meta, pred=None) -> pa.Table:
+    """Scan an external table: read every file, conform to the declared
+    schema, apply pushed-down predicates."""
+    from .sst import ScanPredicate, _apply_residual
+
+    location = meta.options[LOCATION_OPT]
+    fmt = meta.options[FORMAT_OPT]
+    tables = []
+    want = meta.schema.to_arrow()
+    for path in expand_location(location):
+        t = read_file(path, fmt)
+        # project/cast to the declared columns (extra file columns dropped)
+        arrays, fields = [], []
+        for f in want:
+            i = t.schema.get_field_index(f.name)
+            if i >= 0:
+                col = t.column(i)
+                arrays.append(col if col.type == f.type else col.cast(f.type))
+            else:
+                arrays.append(pa.nulls(t.num_rows, f.type))
+            fields.append(f)
+        tables.append(pa.table(dict(zip([f.name for f in fields], arrays))))
+    out = pa.concat_tables(tables, promote_options="permissive")
+    ts = meta.schema.time_index
+    return _apply_residual(out, pred or ScanPredicate(), ts.name if ts else None)
