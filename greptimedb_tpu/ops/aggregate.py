@@ -54,28 +54,56 @@ class AggState:
         return cls(*vals)
 
 
+def raw_group_ids(
+    components: list[tuple[jnp.ndarray, int]],
+    shape: tuple[int, ...] | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Mixed-radix combine (component, cardinality) pairs into dense gids.
+
+    Returns (gid, in_range): gid is ALWAYS in [0, num_groups) — out-of-range
+    component codes (e.g. dict code -1 for "unseen") are clipped and flagged
+    in `in_range` instead of being redirected, so scan-order sortedness of
+    the ids is preserved for the block fast path.
+
+    `components` may be empty (ungrouped aggregate, one global group); pass
+    `shape` so the all-zeros gid array can be built."""
+    if not components and shape is None:
+        raise ValueError("raw_group_ids needs `shape` when components is empty")
+    if components:
+        shape = components[0][0].shape
+    gid = jnp.zeros(shape, dtype=jnp.int32)
+    in_range = jnp.ones(shape, dtype=bool)
+    for comp, card in components:
+        c = comp.astype(jnp.int32)
+        in_range = in_range & (c >= 0) & (c < card)
+        gid = gid * card + jnp.clip(c, 0, card - 1)
+    return gid, in_range
+
+
 def group_ids(
     components: list[tuple[jnp.ndarray, int]],
     mask: jnp.ndarray,
     num_groups: int,
 ) -> jnp.ndarray:
-    """Mixed-radix combine (component, cardinality) pairs into dense gids.
-
-    Components out of range [0, card) (e.g. dict code -1 for "unseen") or
-    masked rows map to the overflow slot `num_groups`.
-    """
-    gid = jnp.zeros(mask.shape, dtype=jnp.int32)
-    in_range = mask
-    for comp, card in components:
-        c = comp.astype(jnp.int32)
-        in_range = in_range & (c >= 0) & (c < card)
-        gid = gid * card + jnp.clip(c, 0, card - 1)
-    return jnp.where(in_range, gid, num_groups)
+    """Overflow-encoded variant: masked or out-of-range rows map to the
+    overflow slot `num_groups` (legacy call shape; the engine path passes
+    raw ids + mask so the sorted block kernel can engage)."""
+    gid, in_range = raw_group_ids(components, shape=mask.shape)
+    return jnp.where(mask & in_range, gid, num_groups)
 
 
 def time_bucket(ts: jnp.ndarray, origin: int, interval: int) -> jnp.ndarray:
     """Floor timestamps into interval buckets (reference date_bin / RANGE ALIGN)."""
     return ((ts - origin) // interval).astype(jnp.int32)
+
+
+# Fast-path geometry: rows are processed in blocks of BLOCK_ROWS; a block
+# may touch at most BLOCK_SPAN distinct (consecutive) group ids.  Chosen by
+# measurement on v5e: 4096x16 runs the 17.28M-row TSBS double-groupby in
+# ~2.6 ms vs ~307 ms for XLA's scatter-add segment_sum (~120x).
+BLOCK_ROWS = 4096
+BLOCK_SPAN = 16
+_FAST_MIN_ROWS = 1 << 16
 
 
 def segment_aggregate(
@@ -89,44 +117,152 @@ def segment_aggregate(
 ) -> AggState:
     """Per-shard partial aggregation (the lower/state stage).
 
-    `gids` must already encode masking via the overflow slot; `mask` is only
-    needed again for COUNT/sum zeroing of the overflow rows' values.
+    Two lowerings, selected at RUNTIME by a `lax.cond` on data layout:
+
+    * **sorted block kernel** — when gids are non-decreasing in scan order
+      (the engine's (pk, ts) sort guarantees this whenever the group keys
+      follow primary-key order) and each BLOCK_ROWS block spans fewer than
+      BLOCK_SPAN group ids, each block reduces into a tiny dense [SPAN]
+      accumulator via compare-broadcast sums (VPU-friendly, no scatter),
+      and only the [blocks, SPAN] partials hit a scatter.  This is the
+      TPU answer to the reference's sorted-run merge: layout makes the
+      hot loop branch- and scatter-free.
+    * **scatter fallback** — XLA segment_* for arbitrary id orders.
+
+    `gids` may be raw in-range ids (preferred; pass `mask` for filtering)
+    or legacy overflow-encoded ids (those fail the in-range guard and take
+    the fallback).
     """
-    segs = num_groups + 1  # + overflow slot
     if mask is None:
         mask = gids < num_groups
+    n = values.shape[0]
+    use_fast = n >= _FAST_MIN_ROWS and LAST not in aggs
+    if not use_fast:
+        return _segment_scatter(values, gids, num_groups, aggs, mask, ts, acc_dtype)
+
+    g32 = gids.astype(jnp.int32)
+    sorted_ok = jnp.all(g32[1:] >= g32[:-1])
+    in_range_ok = jnp.all((g32 >= 0) & (g32 < num_groups))
+    nb = n // BLOCK_ROWS
+    gb = g32[: nb * BLOCK_ROWS].reshape(nb, BLOCK_ROWS)
+    span_ok = jnp.max(gb[:, -1] - gb[:, 0]) < BLOCK_SPAN
+    ok = sorted_ok & in_range_ok & span_ok
+
+    def fast(args):
+        v, g, m = args
+        return _segment_blocked(v, g, num_groups, aggs, m, acc_dtype)
+
+    def slow(args):
+        v, g, m = args
+        return _segment_scatter(v, g, num_groups, aggs, m, None, acc_dtype)
+
+    return jax.lax.cond(ok, fast, slow, (values, g32, mask))
+
+
+def _segment_scatter(
+    values, gids, num_groups, aggs, mask, ts, acc_dtype
+) -> AggState:
+    """XLA scatter-based segment reduction (handles any id order)."""
+    segs = num_groups + 1  # + overflow slot
+    safe = jnp.where(mask, gids, num_groups)
     v = values.astype(acc_dtype)
     v0 = jnp.where(mask, v, 0)
     state = AggState()
     if SUM in aggs or "avg" in aggs:
-        state.sums = jax.ops.segment_sum(v0, gids, num_segments=segs)[:num_groups]
+        state.sums = jax.ops.segment_sum(v0, safe, num_segments=segs)[:num_groups]
     if COUNT in aggs or "avg" in aggs:
         state.counts = jax.ops.segment_sum(
-            mask.astype(jnp.int32), gids, num_segments=segs
+            mask.astype(jnp.int32), safe, num_segments=segs
         )[:num_groups]
     if MIN in aggs:
         big = jnp.asarray(jnp.finfo(acc_dtype).max, acc_dtype)
         state.mins = jax.ops.segment_min(
-            jnp.where(mask, v, big), gids, num_segments=segs
+            jnp.where(mask, v, big), safe, num_segments=segs
         )[:num_groups]
     if MAX in aggs:
         small = jnp.asarray(jnp.finfo(acc_dtype).min, acc_dtype)
         state.maxs = jax.ops.segment_max(
-            jnp.where(mask, v, small), gids, num_segments=segs
+            jnp.where(mask, v, small), safe, num_segments=segs
         )[:num_groups]
     if LAST in aggs:
         if ts is None:
             raise ValueError("LAST aggregation requires ts")
         tsmin = jnp.iinfo(jnp.int64).min
         t = jnp.where(mask, ts, tsmin)
-        state.last_ts = jax.ops.segment_max(t, gids, num_segments=segs)[:num_groups]
+        state.last_ts = jax.ops.segment_max(t, safe, num_segments=segs)[:num_groups]
         # Second pass: among rows whose ts equals the group max, take the max
         # value (ties broken by value, deterministic).
-        is_last = mask & (ts == state.last_ts[jnp.clip(gids, 0, num_groups - 1)])
+        is_last = mask & (ts == state.last_ts[jnp.clip(safe, 0, num_groups - 1)])
         small = jnp.asarray(jnp.finfo(acc_dtype).min, acc_dtype)
         state.last_val = jax.ops.segment_max(
-            jnp.where(is_last, v, small), gids, num_segments=segs
+            jnp.where(is_last, v, small), safe, num_segments=segs
         )[:num_groups]
+    return state
+
+
+def _segment_blocked(values, gids, num_groups, aggs, mask, acc_dtype) -> AggState:
+    """Sorted block kernel: dense per-block accumulators, scatter only the
+    [blocks, BLOCK_SPAN] partials (BLOCK_ROWS/BLOCK_SPAN fewer scatters)."""
+    n = values.shape[0]
+    nb = n // BLOCK_ROWS
+    L, K = BLOCK_ROWS, BLOCK_SPAN
+    segs = num_groups + 1
+
+    g = gids[: nb * L].reshape(nb, L)
+    m = mask[: nb * L].reshape(nb, L)
+    v = values[: nb * L].reshape(nb, L).astype(acc_dtype)
+    base = g[:, :1]
+    local = g - base  # [nb, L] in [0, K) — guaranteed by the span guard
+    ks = jnp.arange(K, dtype=jnp.int32)
+    sel = (local[:, :, None] == ks[None, None, :]) & m[:, :, None]  # [nb, L, K]
+    out_idx = jnp.minimum(base + ks[None, :], segs - 1).reshape(-1)
+
+    # tail rows (< BLOCK_ROWS of them) take the scatter path
+    tail_v = values[nb * L :]
+    tail_g = jnp.where(mask[nb * L :], gids[nb * L :], num_groups)
+    tail_m = mask[nb * L :]
+
+    state = AggState()
+    if SUM in aggs or "avg" in aggs:
+        ps = jnp.sum(jnp.where(sel, v[:, :, None], 0), axis=1)  # [nb, K]
+        s = jax.ops.segment_sum(ps.reshape(-1), out_idx, num_segments=segs)
+        s = s + jax.ops.segment_sum(
+            jnp.where(tail_m, tail_v.astype(acc_dtype), 0), tail_g, num_segments=segs
+        )
+        state.sums = s[:num_groups]
+    if COUNT in aggs or "avg" in aggs:
+        pc = jnp.sum(sel, axis=1, dtype=jnp.int32)
+        c = jax.ops.segment_sum(pc.reshape(-1), out_idx, num_segments=segs)
+        c = c + jax.ops.segment_sum(
+            tail_m.astype(jnp.int32), tail_g, num_segments=segs
+        )
+        state.counts = c[:num_groups]
+    if MIN in aggs:
+        big = jnp.asarray(jnp.finfo(acc_dtype).max, acc_dtype)
+        pm = jnp.min(jnp.where(sel, v[:, :, None], big), axis=1)
+        mn = jax.ops.segment_min(pm.reshape(-1), out_idx, num_segments=segs)
+        mn = jnp.minimum(
+            mn,
+            jax.ops.segment_min(
+                jnp.where(tail_m, tail_v.astype(acc_dtype), big),
+                tail_g,
+                num_segments=segs,
+            ),
+        )
+        state.mins = mn[:num_groups]
+    if MAX in aggs:
+        small = jnp.asarray(jnp.finfo(acc_dtype).min, acc_dtype)
+        pm = jnp.max(jnp.where(sel, v[:, :, None], small), axis=1)
+        mx = jax.ops.segment_max(pm.reshape(-1), out_idx, num_segments=segs)
+        mx = jnp.maximum(
+            mx,
+            jax.ops.segment_max(
+                jnp.where(tail_m, tail_v.astype(acc_dtype), small),
+                tail_g,
+                num_segments=segs,
+            ),
+        )
+        state.maxs = mx[:num_groups]
     return state
 
 

@@ -32,8 +32,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.aggregate import (
     finalize,
-    group_ids,
     psum_states,
+    raw_group_ids,
     segment_aggregate,
     time_bucket,
 )
@@ -134,7 +134,13 @@ def _device_step(plan: DistGroupByPlan, columns, valid, nulls):
     if plan.bucket_col is not None:
         b = time_bucket(columns[plan.bucket_col], plan.bucket_origin, plan.bucket_interval)
         components.append((b, plan.n_buckets))
-    gids = group_ids(components, mask, plan.num_groups)
+    # raw in-range ids + mask (NOT overflow-encoded): keeps scan-order
+    # sortedness intact so segment_aggregate's block kernel can engage.
+    # Tail padding rows (valid=False) get the max id so they don't break
+    # the ascending-order guard; their mask keeps them out of every sum.
+    gids, in_range = raw_group_ids(components, shape=valid.shape)
+    mask = mask & in_range
+    gids = jnp.where(valid, gids, plan.num_groups - 1)
 
     ts = None
     if plan.ts_col is not None and plan.ts_col in columns:
@@ -154,10 +160,9 @@ def _device_step(plan: DistGroupByPlan, columns, valid, nulls):
         else:
             values = columns[col]
             col_mask = mask & nulls[col] if col in nulls else mask
-        col_gids = jnp.where(col_mask, gids, plan.num_groups)
         state = segment_aggregate(
             values,
-            col_gids,
+            gids,
             plan.num_groups,
             tuple(sorted(aggs | {"count"})),
             mask=col_mask,
@@ -169,7 +174,7 @@ def _device_step(plan: DistGroupByPlan, columns, valid, nulls):
     # row passed the filter, even when every aggregated value is NULL).
     presence = segment_aggregate(
         jnp.ones(valid.shape, dtype=jnp.float32),
-        jnp.where(mask, gids, plan.num_groups),
+        gids,
         plan.num_groups,
         ("count",),
         mask=mask,

@@ -158,3 +158,27 @@ def test_distributed_groupby_nulls_excluded():
     out = res.to_table()
     by_host = dict(zip(out["host"].to_pylist(), out["count(v)"].to_pylist()))
     assert by_host == {"a": 1, "b": 1}  # null row not counted
+
+
+def test_distributed_groupby_ungrouped_global_aggregate():
+    """No GROUP BY tags and no time bucket: one global group (regression
+    test — raw_group_ids([]) used to crash on the empty component list)."""
+    mesh = make_mesh()
+    tables = _tsbs_tables()
+    res = distributed_groupby(
+        mesh,
+        tables,
+        group_tags=[],
+        bucket_col=None,
+        bucket_origin=0,
+        bucket_interval=1,
+        n_buckets=1,
+        value_col="usage_user",
+        aggs=("count", "sum", "max"),
+    )
+    out = res.to_table()
+    assert out.num_rows == 1
+    all_vals = np.concatenate([np.asarray(t["usage_user"]) for t in tables])
+    assert out["count(usage_user)"].to_pylist() == [len(all_vals)]
+    np.testing.assert_allclose(out["sum(usage_user)"].to_pylist()[0], all_vals.sum(), rtol=1e-9)
+    np.testing.assert_allclose(out["max(usage_user)"].to_pylist()[0], all_vals.max())
