@@ -29,8 +29,14 @@ from .wal import WalManager
 
 class TimeSeriesEngine:
     def __init__(self, config: StorageConfig | None = None):
+        from .object_store import build_object_store
+
         self.config = config or StorageConfig()
         os.makedirs(self.config.data_home, exist_ok=True)
+        # SSTs + manifests live behind the object-store abstraction
+        # (fs by default); the WAL stays a local append log like the
+        # reference's raft-engine store.
+        self.object_store = build_object_store(self.config)
         self.wal_mgr = WalManager(self.config.wal_dir, fsync=self.config.wal_fsync)
         self.buffer_mgr = WriteBufferManager(
             global_limit_bytes=self.config.global_write_buffer_size_mb << 20,
@@ -46,7 +52,7 @@ class TimeSeriesEngine:
                 return self._regions[region_id]
             region = Region(
                 region_id,
-                self._region_dir(region_id),
+                self._region_store(region_id),
                 schema,
                 self.wal_mgr.region_wal(region_id),
                 time_partition_ms=self.config.memtable_time_partition_secs * 1000,
@@ -64,12 +70,12 @@ class TimeSeriesEngine:
         with self._lock:
             if region_id in self._regions:
                 return self._regions[region_id]
-            region_dir = self._region_dir(region_id)
-            if not os.path.exists(os.path.join(region_dir, "manifest")):
+            store = self._region_store(region_id)
+            if not store.list("manifest"):
                 raise RegionNotFoundError(f"region {region_id} has no manifest")
             region = Region(
                 region_id,
-                region_dir,
+                store,
                 Schema(columns=[]),  # overwritten by manifest recovery
                 self.wal_mgr.region_wal(region_id),
                 time_partition_ms=self.config.memtable_time_partition_secs * 1000,
@@ -89,6 +95,11 @@ class TimeSeriesEngine:
     def drop_region(self, region_id: int):
         self.close_region(region_id)
         self.wal_mgr.drop_region(region_id)
+        store = self._region_store(region_id)
+        for sub in ("manifest", "sst"):
+            view = store.scoped(sub)
+            for name in view.list():
+                view.delete(name)
         shutil.rmtree(self._region_dir(region_id), ignore_errors=True)
 
     def region(self, region_id: int) -> Region:
@@ -164,6 +175,9 @@ class TimeSeriesEngine:
     # ---- helpers ----------------------------------------------------------
     def _region_dir(self, region_id: int) -> str:
         return os.path.join(self.config.sst_dir, f"region_{region_id}")
+
+    def _region_store(self, region_id: int):
+        return self.object_store.scoped(f"region_{region_id}")
 
     def close(self):
         self.wal_mgr.close()

@@ -64,12 +64,25 @@ class ManifestManager:
     replays newer deltas, exactly the reference's scheme.
     """
 
-    def __init__(self, region_dir: str, region_id: int, checkpoint_distance: int = 10):
-        self.dir = os.path.join(region_dir, "manifest")
+    def __init__(
+        self,
+        store_or_dir,
+        region_id: int,
+        checkpoint_distance: int = 10,
+    ):
+        from .object_store import FsObjectStore, ObjectStore
+
+        if isinstance(store_or_dir, ObjectStore):
+            self.store = store_or_dir.scoped("manifest")
+        else:
+            self.store = FsObjectStore(os.path.join(store_or_dir, "manifest"))
         self.region_id = region_id
         self.checkpoint_distance = checkpoint_distance
         self._lock = threading.Lock()
-        os.makedirs(self.dir, exist_ok=True)
+        # A crash mid-write can leave fs .tmp leftovers; clean them before
+        # recovery so they never accumulate (the pre-object-store code did
+        # this during checkpoint GC).
+        self.store.purge_incomplete()
         self.manifest = self._recover()
 
     # ---- actions ----------------------------------------------------------
@@ -84,12 +97,7 @@ class ManifestManager:
         """
         with self._lock:
             version = self.manifest.manifest_version + 1
-            path = os.path.join(self.dir, f"{version:020d}.json")
-            with open(path + ".tmp", "w") as f:
-                json.dump(action, f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(path + ".tmp", path)
+            self.store.write(f"{version:020d}.json", json.dumps(action).encode())
             self._apply_in_memory(action, version)
             if version % self.checkpoint_distance == 0:
                 self._write_checkpoint()
@@ -121,48 +129,39 @@ class ManifestManager:
     # ---- checkpointing / recovery -----------------------------------------
     def _write_checkpoint(self):
         version = self.manifest.manifest_version
-        path = os.path.join(self.dir, f"{version:020d}.checkpoint.json")
-        with open(path + ".tmp", "w") as f:
-            json.dump(self.manifest.to_dict(), f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(path + ".tmp", path)
+        self.store.write(
+            f"{version:020d}.checkpoint.json", json.dumps(self.manifest.to_dict()).encode()
+        )
         # GC: deltas and older checkpoints <= this version are now redundant.
-        for name in os.listdir(self.dir):
-            if name.endswith(".tmp"):
-                os.remove(os.path.join(self.dir, name))
-                continue
+        for name in self.store.list():
             v = _version_of(name)
             if v is None:
                 continue
             is_ckpt = name.endswith(".checkpoint.json")
             if (is_ckpt and v < version) or (not is_ckpt and v <= version):
-                os.remove(os.path.join(self.dir, name))
+                self.store.delete(name)
 
     def _recover(self) -> RegionManifest:
-        names = [n for n in os.listdir(self.dir) if n.endswith(".json") and not n.endswith(".tmp")]
+        names = [n for n in self.store.list() if n.endswith(".json")]
         ckpts = sorted(n for n in names if n.endswith(".checkpoint.json"))
         deltas = sorted(n for n in names if not n.endswith(".checkpoint.json"))
         manifest = RegionManifest(region_id=self.region_id)
         base_version = 0
         if ckpts:
-            with open(os.path.join(self.dir, ckpts[-1])) as f:
-                manifest = RegionManifest.from_dict(json.load(f))
+            manifest = RegionManifest.from_dict(json.loads(self.store.read(ckpts[-1])))
             base_version = manifest.manifest_version
         for name in deltas:
             v = _version_of(name)
             if v is None or v <= base_version:
                 continue
-            with open(os.path.join(self.dir, name)) as f:
-                action = json.load(f)
+            action = json.loads(self.store.read(name))
             self.__dict__["manifest"] = manifest  # allow _apply_in_memory use
             self._apply_in_memory(action, v)
         return manifest
 
     def destroy(self):
-        import shutil
-
-        shutil.rmtree(self.dir, ignore_errors=True)
+        for name in self.store.list():
+            self.store.delete(name)
 
 
 def _version_of(name: str) -> int | None:

@@ -42,9 +42,21 @@ class BlobMeta:
         return cls(d["type"], d["offset"], d["length"], d.get("properties", {}))
 
 
+def _as_store(store_or_path, key: str | None):
+    """(store, key) pair from either an ObjectStore+key or a bare fs path
+    (legacy call shape: PuffinWriter('/dir/x.puffin'))."""
+    from .object_store import FsObjectStore, ObjectStore
+
+    if isinstance(store_or_path, ObjectStore):
+        assert key is not None, "key required with an ObjectStore"
+        return store_or_path, key
+    path = store_or_path
+    return FsObjectStore(os.path.dirname(path) or "."), os.path.basename(path)
+
+
 class PuffinWriter:
-    def __init__(self, path: str):
-        self.path = path
+    def __init__(self, store_or_path, key: str | None = None):
+        self.store, self.key = _as_store(store_or_path, key)
         self._blobs: list[tuple[BlobMeta, bytes]] = []
 
     def add_blob(self, blob_type: str, data: bytes, properties: dict | None = None):
@@ -54,55 +66,56 @@ class PuffinWriter:
         """Write the container; returns file size. No file if no blobs."""
         if not self._blobs:
             return 0
-        tmp = self.path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(MAGIC)
-            off = len(MAGIC)
-            metas = []
-            for meta, data in self._blobs:
-                meta.offset = off
-                f.write(data)
-                off += len(data)
-                metas.append(meta.to_dict())
-            footer = json.dumps({"blobs": metas}).encode()
-            f.write(footer)
-            f.write(struct.pack("<I", len(footer)))
-            f.write(struct.pack("<I", 0))  # flags
-            f.write(MAGIC)
-        os.replace(tmp, self.path)
-        return os.path.getsize(self.path)
+        parts = [MAGIC]
+        off = len(MAGIC)
+        metas = []
+        for meta, data in self._blobs:
+            meta.offset = off
+            parts.append(data)
+            off += len(data)
+            metas.append(meta.to_dict())
+        footer = json.dumps({"blobs": metas}).encode()
+        parts.append(footer)
+        parts.append(struct.pack("<I", len(footer)))
+        parts.append(struct.pack("<I", 0))  # flags
+        parts.append(MAGIC)
+        payload = b"".join(parts)
+        self.store.write(self.key, payload)
+        return len(payload)
 
 
 class PuffinReader:
-    def __init__(self, path: str):
-        self.path = path
+    def __init__(self, store_or_path, key: str | None = None):
+        self.store, self.key = _as_store(store_or_path, key)
         self._metas: list[BlobMeta] | None = None
+        self._data: bytes | None = None
 
     def exists(self) -> bool:
-        return os.path.exists(self.path)
+        return self.store.exists(self.key)
+
+    def _payload(self) -> bytes:
+        # Index sidecars are small (bounded by cardinality caps); one ranged
+        # read beats three for every blob on a remote store.
+        if self._data is None:
+            self._data = self.store.read(self.key)
+        return self._data
 
     def blobs(self) -> list[BlobMeta]:
         if self._metas is None:
-            with open(self.path, "rb") as f:
-                f.seek(0, os.SEEK_END)
-                size = f.tell()
-                f.seek(size - 12)
-                tail = f.read(12)
-                footer_len = struct.unpack("<I", tail[:4])[0]
-                if tail[8:] != MAGIC:
-                    raise ValueError(f"bad puffin trailer in {self.path}")
-                f.seek(size - 12 - footer_len)
-                footer = json.loads(f.read(footer_len))
-                f.seek(0)
-                if f.read(4) != MAGIC:
-                    raise ValueError(f"bad puffin magic in {self.path}")
+            data = self._payload()
+            if data[:4] != MAGIC:
+                raise ValueError(f"bad puffin magic in {self.key}")
+            tail = data[-12:]
+            footer_len = struct.unpack("<I", tail[:4])[0]
+            if tail[8:] != MAGIC:
+                raise ValueError(f"bad puffin trailer in {self.key}")
+            footer = json.loads(data[len(data) - 12 - footer_len : len(data) - 12])
             self._metas = [BlobMeta.from_dict(d) for d in footer["blobs"]]
         return self._metas
 
     def read_blob(self, meta: BlobMeta) -> bytes:
-        with open(self.path, "rb") as f:
-            f.seek(meta.offset)
-            return f.read(meta.length)
+        data = self._payload()
+        return data[meta.offset : meta.offset + meta.length]
 
     def find(self, blob_type: str, **props) -> BlobMeta | None:
         for m in self.blobs():

@@ -68,27 +68,36 @@ class Region:
         index_segment_rows: int = 1024,
         index_inverted_max_terms: int = 4096,
     ):
+        from .object_store import FsObjectStore, ObjectStore
+
         self.region_id = region_id
-        self.region_dir = region_dir
+        # `region_dir` may be a local path (standalone default) or an
+        # ObjectStore view for this region (reference: SSTs+manifest live on
+        # object storage; only the WAL is local).
+        if isinstance(region_dir, ObjectStore):
+            self.store: ObjectStore = region_dir
+            self.region_dir = None
+        else:
+            self.store = FsObjectStore(region_dir)
+            self.region_dir = region_dir
         self.wal = wal
         self.time_partition_ms = time_partition_ms
         self._lock = threading.RLock()
         self.writable = writable  # follower replicas are read-only
 
-        os.makedirs(region_dir, exist_ok=True)
-        self.manifest_mgr = ManifestManager(region_dir, region_id, checkpoint_distance)
+        self.manifest_mgr = ManifestManager(self.store, region_id, checkpoint_distance)
         if self.manifest_mgr.manifest.schema is None:
             self.manifest_mgr.apply({"kind": "change", "schema": schema.to_json()})
         self.schema = self.manifest_mgr.manifest.schema
-        sst_dir = os.path.join(region_dir, "sst")
+        sst_store = self.store.scoped("sst")
         self.sst_writer = SstWriter(
-            sst_dir,
+            sst_store,
             self.schema,
             index_enable=index_enable,
             index_segment_rows=index_segment_rows,
             index_inverted_max_terms=index_inverted_max_terms,
         )
-        self.sst_reader = SstReader(sst_dir, self.schema)
+        self.sst_reader = SstReader(sst_store, self.schema)
 
         self.memtable = Memtable(self.schema, time_partition_ms)
         # Frozen memtables: flushed but whose SSTs are not yet committed to the
@@ -245,12 +254,7 @@ class Region:
         if self._active_scans > 0 or not self._garbage_files:
             return
         for fid in self._garbage_files:
-            path = self.sst_reader.path_for_id(fid)
-            if os.path.exists(path):
-                os.remove(path)
-            sidecar = os.path.join(os.path.dirname(path), f"{fid}.puffin")
-            if os.path.exists(sidecar):
-                os.remove(sidecar)
+            self.sst_reader.delete(fid)
         self._garbage_files.clear()
 
     # ---- read -------------------------------------------------------------

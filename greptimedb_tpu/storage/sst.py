@@ -23,6 +23,7 @@ from ..datatypes.schema import Schema
 from ..utils import metrics
 from . import index as idx
 from .index import BLOOM_BLOB, INVERTED_BLOB
+from .object_store import FsObjectStore, ObjectStore
 from .puffin import PuffinReader, PuffinWriter
 
 DEFAULT_ROW_GROUP_SIZE = 1 << 20  # rows per row group; big groups = big tiles
@@ -81,20 +82,21 @@ class ScanPredicate:
 class SstWriter:
     def __init__(
         self,
-        sst_dir: str,
+        store: ObjectStore | str,
         schema: Schema,
         row_group_size: int = DEFAULT_ROW_GROUP_SIZE,
         index_enable: bool = True,
         index_segment_rows: int = idx.DEFAULT_SEGMENT_ROWS,
         index_inverted_max_terms: int = 4096,
     ):
-        self.sst_dir = sst_dir
+        # A bare directory path means "local fs store rooted there" — the
+        # common standalone config and what unit tests pass.
+        self.store = FsObjectStore(store) if isinstance(store, str) else store
         self.schema = schema
         self.row_group_size = row_group_size
         self.index_enable = index_enable
         self.index_segment_rows = index_segment_rows
         self.index_inverted_max_terms = index_inverted_max_terms
-        os.makedirs(sst_dir, exist_ok=True)
 
     def _build_indexes(self, table: pa.Table, file_id: str) -> tuple[list[str], int]:
         """Build bloom + inverted indexes over tag columns into the puffin
@@ -102,7 +104,7 @@ class SstWriter:
         cols = [c.name for c in self.schema.tag_columns() if c.name in table.column_names]
         if not cols:
             return [], 0
-        writer = PuffinWriter(os.path.join(self.sst_dir, f"{file_id}.puffin"))
+        writer = PuffinWriter(self.store, f"{file_id}.puffin")
         indexed = []
         for name in cols:
             col = table[name]
@@ -137,14 +139,17 @@ class SstWriter:
                     i, tag.name, pc.dictionary_encode(table[tag.name].combine_chunks())
                 )
         file_id = uuid.uuid4().hex
-        path = self._path(file_id)
+        key = f"{file_id}.parquet"
+        scratch = self.store.scratch_path(key)
         pq.write_table(
             table,
-            path,
+            scratch,
             row_group_size=self.row_group_size,
             compression="zstd",
             use_dictionary=True,
         )
+        file_size = os.path.getsize(scratch)
+        self.store.put_file(key, scratch)
         indexed, index_size = ([], 0)
         if self.index_enable:
             indexed, index_size = self._build_indexes(table, file_id)
@@ -152,29 +157,25 @@ class SstWriter:
             file_id=file_id,
             time_range=(t_min, t_max),
             num_rows=table.num_rows,
-            file_size=os.path.getsize(path),
+            file_size=file_size,
             level=level,
             indexed_columns=indexed,
             index_file_size=index_size,
         )
-
-    def _path(self, file_id: str) -> str:
-        return os.path.join(self.sst_dir, f"{file_id}.parquet")
 
 
 _INDEX_CACHE = idx.IndexCache(capacity=128)
 
 
 class SstReader:
-    def __init__(self, sst_dir: str, schema: Schema):
-        self.sst_dir = sst_dir
+    def __init__(self, store: ObjectStore | str, schema: Schema):
+        self.store = FsObjectStore(store) if isinstance(store, str) else store
         self.schema = schema
 
-    def path(self, meta: FileMeta) -> str:
-        return self.path_for_id(meta.file_id)
-
-    def path_for_id(self, file_id: str) -> str:
-        return os.path.join(self.sst_dir, f"{file_id}.parquet")
+    def delete(self, file_id: str):
+        """Remove an SST and its index sidecar from the store."""
+        self.store.delete(f"{file_id}.parquet")
+        self.store.delete(f"{file_id}.puffin")
 
     def prune_files(self, files: list[FileMeta], pred: ScanPredicate) -> list[FileMeta]:
         """File-level pruning on time range (whole-file min/max)."""
@@ -191,7 +192,7 @@ class SstReader:
     ) -> pa.Table:
         """Read one SST with row-group pruning + residual filter application."""
         pred = pred or ScanPredicate()
-        pf = pq.ParquetFile(self.path(meta))
+        pf = pq.ParquetFile(self.store.open_input(f"{meta.file_id}.parquet"))
         ts_name = self.schema.time_index.name if self.schema.time_index else None
         groups = self._prune_row_groups(pf, pred, ts_name)
         if groups and meta.indexed_columns:
@@ -268,8 +269,7 @@ class SstReader:
         cached = _INDEX_CACHE.get(meta.file_id)
         if cached is not None:
             return cached
-        path = os.path.join(self.sst_dir, f"{meta.file_id}.puffin")
-        reader = PuffinReader(path)
+        reader = PuffinReader(self.store, f"{meta.file_id}.puffin")
         if not reader.exists():
             return None
         out: dict = {}

@@ -57,6 +57,14 @@ class StorageConfig:
     index_enable: bool = True
     index_segment_rows: int = 1024  # bloom/inverted segment granularity
     index_inverted_max_terms: int = 4096  # cardinality cap for inverted index
+    # Object store under SSTs/manifests (reference `[storage]` with OpenDAL
+    # fs/s3/gcs/oss/azblob builders).  Remote types are surfaced but gated in
+    # this build (no egress); "memory" exists for tests.
+    store_type: str = "fs"
+    object_cache_mb: int = 0  # >0 enables the LRU whole-object read cache
+    store_retry_attempts: int = 3
+    write_cache_enable: bool = False  # local staging in front of non-fs stores
+    write_cache_capacity_mb: int = 512
 
     def __post_init__(self):
         if not self.wal_dir:

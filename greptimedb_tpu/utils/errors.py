@@ -125,6 +125,12 @@ class StorageError(GreptimeError):
     code = StatusCode.STORAGE_UNAVAILABLE
 
 
+class ConfigError(GreptimeError):
+    """Invalid or unsupported configuration value."""
+
+    code = StatusCode.INVALID_ARGUMENTS
+
+
 class RetryLaterError(GreptimeError):
     """Transient condition; the caller should retry (reference RETRY_LATER)."""
 
