@@ -1,0 +1,428 @@
+"""Approximate aggregate sketches: HyperLogLog and UDDSketch.
+
+Role-equivalent of the reference's approx aggregates
+(reference common/function/src/aggrs/approximate.rs — `hll`/`hll_merge`/
+`hll_count` backed by HyperLogLog and `uddsketch_state`/`uddsketch_merge`/
+`uddsketch_calc` backed by UDDSketch for approx percentiles).
+
+Both sketches are mergeable states, so they follow the same two-step
+lower-state / upper-merge pattern as sum/min/max (reference
+commutativity.rs:45): per-shard partial sketches merge associatively —
+HLL registers with elementwise MAX, UDDSketch bucket counts with ADD —
+which on TPU means `lax.pmax` / `psum` over the mesh instead of shipping
+rows.
+
+Layout is TPU-friendly by construction:
+  * HLL state per group = 2^p uint8 registers → a [G, m] dense array;
+    the build kernel is one `segment_max` over flattened (gid, register)
+    ids — no scatter conflicts, no host loops.
+  * UDDSketch state per group = B log-spaced bucket counts → [G, B];
+    the build kernel is one `segment_sum`.  Device sketches use a fixed
+    bucket range (clipped at the extremes); the host (authoritative CPU
+    path) implements the full collapsing UDDSketch.
+
+Hashing happens on the host in vectorized numpy (strings via md5 of the
+dictionary uniques — deterministic across processes, required for merging
+states built on different nodes).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+
+import numpy as np
+import pyarrow as pa
+
+# ---------------------------------------------------------------------------
+# 64-bit hashing (host, vectorized)
+# ---------------------------------------------------------------------------
+
+_SPLITMIX_C1 = np.uint64(0xBF58476D1CE4E5B9)
+_SPLITMIX_C2 = np.uint64(0x94D049BB133111EB)
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+
+
+def splitmix64(x: np.ndarray) -> np.ndarray:
+    """Deterministic 64-bit mix (public splitmix64 finalizer)."""
+    with np.errstate(over="ignore"):
+        z = x.astype(np.uint64) + _GOLDEN
+        z = (z ^ (z >> np.uint64(30))) * _SPLITMIX_C1
+        z = (z ^ (z >> np.uint64(27))) * _SPLITMIX_C2
+        return z ^ (z >> np.uint64(31))
+
+
+def hash64(values: pa.Array | pa.ChunkedArray) -> np.ndarray:
+    """Deterministic uint64 hashes of an Arrow column (any type).
+
+    Numerics hash their 64-bit bit pattern; strings/binary hash md5 of the
+    dictionary-encoded uniques (cheap: one digest per distinct value).
+    Nulls hash to 0 — callers must mask them out.
+    """
+    if isinstance(values, pa.ChunkedArray):
+        values = values.combine_chunks()
+    t = values.type
+    if pa.types.is_dictionary(t):
+        codes = np.asarray(values.indices.fill_null(-1), dtype=np.int64)
+        uniq_hashes = hash64(values.dictionary)
+        out = np.zeros(len(values), dtype=np.uint64)
+        valid = codes >= 0
+        out[valid] = uniq_hashes[codes[valid]]
+        return out
+    if pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_binary(t):
+        out = np.zeros(len(values), dtype=np.uint64)
+        memo: dict = {}
+        pylist = values.to_pylist()
+        for i, v in enumerate(pylist):
+            if v is None:
+                continue
+            h = memo.get(v)
+            if h is None:
+                data = v.encode() if isinstance(v, str) else v
+                h = struct.unpack("<Q", hashlib.md5(data).digest()[:8])[0]
+                memo[v] = h
+            out[i] = h
+        return out
+    if pa.types.is_floating(t):
+        f = np.asarray(values.cast(pa.float64()).fill_null(np.nan))
+        bits = f.view(np.uint64).copy()
+        bits[f == 0.0] = 0  # -0.0 == 0.0 must hash identically
+        return splitmix64(bits)
+    if pa.types.is_timestamp(t) or pa.types.is_integer(t) or pa.types.is_boolean(t):
+        i64 = np.asarray(values.cast(pa.int64()).fill_null(0), dtype=np.int64)
+        return splitmix64(i64.view(np.uint64))
+    raise TypeError(f"hll: unhashable column type {t}")
+
+
+# ---------------------------------------------------------------------------
+# HyperLogLog
+# ---------------------------------------------------------------------------
+
+HLL_P_DEFAULT = 12  # 4096 registers, ~1.6% standard error (reference uses 14)
+_HLL_MAGIC = b"HLL1"
+
+
+def hll_inputs(hashes: np.ndarray, p: int) -> tuple[np.ndarray, np.ndarray]:
+    """Split 64-bit hashes into (register index, rho).
+
+    index = top p bits; rho = position of the first 1-bit in the remaining
+    64-p bits (1-based), the quantity HLL registers take the max of.
+    """
+    idx = (hashes >> np.uint64(64 - p)).astype(np.int32)
+    w = (hashes << np.uint64(p)).astype(np.uint64)  # remaining bits, left-aligned
+    # clz via 6-step binary search (vectorized; exact for all 64-bit values;
+    # w == 0 saturates at 63 and is clamped by the rho cap below)
+    clz = np.zeros(hashes.shape, dtype=np.int32)
+    cur = w.copy()
+    for shift in (32, 16, 8, 4, 2, 1):
+        high_zero = cur < (np.uint64(1) << np.uint64(64 - shift))
+        clz = np.where(high_zero, clz + shift, clz)
+        cur = np.where(high_zero, cur << np.uint64(shift), cur)
+    rho = np.minimum(clz + 1, 64 - p + 1).astype(np.int32)
+    return idx, rho
+
+
+def hll_build(hashes: np.ndarray, p: int = HLL_P_DEFAULT) -> np.ndarray:
+    """Dense HLL registers [2^p] uint8 from a hash array (host path)."""
+    m = 1 << p
+    idx, rho = hll_inputs(hashes, p)
+    regs = np.zeros(m, dtype=np.uint8)
+    np.maximum.at(regs, idx, rho.astype(np.uint8))
+    return regs
+
+
+def hll_build_grouped(hashes: np.ndarray, gids: np.ndarray, num_groups: int, p: int = HLL_P_DEFAULT) -> np.ndarray:
+    """[num_groups, 2^p] registers (host path, np.maximum.at scatter)."""
+    m = 1 << p
+    idx, rho = hll_inputs(hashes, p)
+    regs = np.zeros(num_groups * m, dtype=np.uint8)
+    flat = gids.astype(np.int64) * m + idx
+    np.maximum.at(regs, flat, rho.astype(np.uint8))
+    return regs.reshape(num_groups, m)
+
+
+def hll_merge(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return np.maximum(a, b)
+
+
+def hll_estimate(regs: np.ndarray) -> float | np.ndarray:
+    """Bias-corrected HLL cardinality estimate; accepts [m] or [..., m]."""
+    regs = np.asarray(regs)
+    m = regs.shape[-1]
+    if m >= 128:
+        alpha = 0.7213 / (1 + 1.079 / m)
+    elif m == 64:
+        alpha = 0.709
+    elif m == 32:
+        alpha = 0.697
+    else:
+        alpha = 0.673
+    inv = np.power(2.0, -regs.astype(np.float64)).sum(axis=-1)
+    e = alpha * m * m / inv
+    zeros = (regs == 0).sum(axis=-1)
+    # linear counting for the small range
+    small = (e <= 2.5 * m) & (zeros > 0)
+    with np.errstate(divide="ignore"):
+        lc = m * np.log(m / np.maximum(zeros, 1).astype(np.float64))
+    out = np.where(small, lc, e)
+    return float(out) if out.ndim == 0 else out
+
+
+def hll_serialize(regs: np.ndarray) -> bytes:
+    m = regs.shape[-1]
+    p = int(m).bit_length() - 1
+    return _HLL_MAGIC + struct.pack("<B", p) + regs.astype(np.uint8).tobytes()
+
+
+def hll_deserialize(data: bytes) -> np.ndarray:
+    if data[:4] != _HLL_MAGIC:
+        raise ValueError("not an HLL state")
+    p = struct.unpack("<B", data[4:5])[0]
+    m = 1 << p
+    return np.frombuffer(data[5 : 5 + m], dtype=np.uint8).copy()
+
+
+def segment_hll(reg_idx, rho, gids, num_groups: int, m: int):
+    """Device kernel: per-group HLL registers via one segment_max.
+
+    reg_idx/rho come from `hll_inputs` (host), shipped to device as int32.
+    Returns [num_groups, m] int32 registers.  Merge partials across the
+    mesh with `jax.lax.pmax` (the HLL union is elementwise max).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    flat = gids.astype(jnp.int32) * m + reg_idx.astype(jnp.int32)
+    regs = jax.ops.segment_max(
+        rho.astype(jnp.int32), flat, num_segments=num_groups * m
+    )
+    # segment_max fills empty segments with the dtype min; clamp to 0.
+    return jnp.maximum(regs, 0).reshape(num_groups, m)
+
+
+# ---------------------------------------------------------------------------
+# UDDSketch (approx percentiles over log-spaced buckets)
+# ---------------------------------------------------------------------------
+
+_UDD_MAGIC = b"UDD1"
+UDD_DEFAULT_BUCKETS = 128
+UDD_DEFAULT_ERROR = 0.01
+
+
+class UddSketch:
+    """Collapsing UDDSketch (host, authoritative).
+
+    Buckets: key k covers (γ^(k-1), γ^k] for positives, mirrored negative
+    keys for negatives, plus an exact zero count.  When the number of
+    distinct buckets exceeds `max_buckets`, γ is squared and keys halve
+    (k → ceil(k/2)), doubling the relative error — the standard UDDSketch
+    collapse, which keeps states mergeable.
+    """
+
+    def __init__(self, max_buckets: int = UDD_DEFAULT_BUCKETS, error: float = UDD_DEFAULT_ERROR):
+        if not 0 < error < 1:
+            raise ValueError("uddsketch error must be in (0, 1)")
+        self.max_buckets = max(8, int(max_buckets))
+        self.error = float(error)
+        self.gamma = (1 + error) / (1 - error)
+        self.pos: dict[int, int] = {}
+        self.neg: dict[int, int] = {}
+        self.zero = 0
+
+    # -- build --------------------------------------------------------------
+    def add_array(self, values: np.ndarray):
+        v = np.asarray(values, dtype=np.float64)
+        v = v[~np.isnan(v)]
+        if v.size == 0:
+            return
+        self.zero += int((v == 0).sum())
+        lg = np.log(self.gamma)
+        for sign, side in ((1, self.pos), (-1, self.neg)):
+            part = v[v * sign > 0] * sign
+            if part.size == 0:
+                continue
+            ks = np.ceil(np.log(part) / lg).astype(np.int64)
+            uniq, counts = np.unique(ks, return_counts=True)
+            for k, c in zip(uniq.tolist(), counts.tolist()):
+                side[k] = side.get(k, 0) + int(c)
+        self._maybe_collapse()
+
+    def _maybe_collapse(self):
+        while len(self.pos) + len(self.neg) > self.max_buckets:
+            self.gamma = self.gamma * self.gamma
+            for name in ("pos", "neg"):
+                side = getattr(self, name)
+                merged: dict[int, int] = {}
+                for k, c in side.items():
+                    nk = (k + 1) // 2  # ceil(k/2): (γ²)^nk covers γ^k
+                    merged[nk] = merged.get(nk, 0) + c
+                setattr(self, name, merged)
+
+    # -- merge --------------------------------------------------------------
+    def merge(self, other: "UddSketch"):
+        # Align γ: collapse the finer sketch until γ matches (γ collapses by
+        # squaring, so two sketches are mergeable iff their γs derive from
+        # the same seed by repeated squaring — i.e. the same error param).
+        a, b = self, other
+        # ln(γ_coarse)/ln(γ_fine) must be an exact power of two, else the
+        # sketches came from different error params and can never align.
+        import math
+
+        lo, hi = sorted((math.log(a.gamma), math.log(b.gamma)))
+        ratio = hi / lo
+        j = round(math.log2(ratio)) if ratio > 0 else 0
+        if abs(ratio - 2.0**j) > 1e-6 * ratio:
+            raise ValueError(
+                "cannot merge UDDSketches built with different error "
+                f"parameters (gamma {a.gamma} vs {b.gamma})"
+            )
+        while abs(a.gamma - b.gamma) > 1e-12 * max(a.gamma, b.gamma):
+            finer = a if a.gamma < b.gamma else b
+            finer.gamma = finer.gamma**2
+            for name in ("pos", "neg"):
+                side = getattr(finer, name)
+                merged: dict[int, int] = {}
+                for k, c in side.items():
+                    nk = (k + 1) // 2
+                    merged[nk] = merged.get(nk, 0) + c
+                setattr(finer, name, merged)
+        for k, c in other.pos.items():
+            self.pos[k] = self.pos.get(k, 0) + c
+        for k, c in other.neg.items():
+            self.neg[k] = self.neg.get(k, 0) + c
+        self.zero += other.zero
+        self._maybe_collapse()
+
+    # -- query --------------------------------------------------------------
+    def count(self) -> int:
+        return self.zero + sum(self.pos.values()) + sum(self.neg.values())
+
+    def _bucket_value(self, k: int, sign: int) -> float:
+        # midpoint of (γ^(k-1), γ^k] in log space
+        return sign * 2.0 * self.gamma**k / (self.gamma + 1)
+
+    def quantile(self, q: float) -> float:
+        if not 0 <= q <= 1:
+            raise ValueError("quantile must be in [0, 1]")
+        total = self.count()
+        if total == 0:
+            return float("nan")
+        rank = q * (total - 1)
+        # ascending value order: negatives (k desc), zero, positives (k asc)
+        cum = 0.0
+        for k in sorted(self.neg, reverse=True):
+            cum += self.neg[k]
+            if cum > rank:
+                return self._bucket_value(k, -1)
+        if self.zero:
+            cum += self.zero
+            if cum > rank:
+                return 0.0
+        for k in sorted(self.pos):
+            cum += self.pos[k]
+            if cum > rank:
+                return self._bucket_value(k, +1)
+        # numerical edge: return the max bucket
+        if self.pos:
+            return self._bucket_value(max(self.pos), +1)
+        if self.zero:
+            return 0.0
+        return self._bucket_value(min(self.neg), -1) if self.neg else float("nan")
+
+    # -- serialization ------------------------------------------------------
+    def serialize(self) -> bytes:
+        items = [(k, c, 1) for k, c in self.pos.items()] + [
+            (k, c, -1) for k, c in self.neg.items()
+        ]
+        out = [
+            _UDD_MAGIC,
+            struct.pack("<dIqI", self.gamma, self.max_buckets, self.zero, len(items)),
+        ]
+        for k, c, s in items:
+            out.append(struct.pack("<qqb", k, c, s))
+        return b"".join(out)
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "UddSketch":
+        if data[:4] != _UDD_MAGIC:
+            raise ValueError("not a UDDSketch state")
+        gamma, max_buckets, zero, n = struct.unpack("<dIqI", data[4:28])
+        sk = cls.__new__(cls)
+        sk.max_buckets = max_buckets
+        sk.gamma = gamma
+        sk.error = (gamma - 1) / (gamma + 1)
+        sk.zero = zero
+        sk.pos, sk.neg = {}, {}
+        off = 28
+        for _ in range(n):
+            k, c, s = struct.unpack("<qqb", data[off : off + 17])
+            off += 17
+            (sk.pos if s > 0 else sk.neg)[k] = c
+        return sk
+
+
+def udd_bucket_ids(values: np.ndarray, gamma: float, n_buckets: int) -> np.ndarray:
+    """Fixed-range bucket ids for the DEVICE kernel.
+
+    Layout over [0, n_buckets): negatives in [0, half) (k descending),
+    zero at `half`, positives in (half, n_buckets).  Out-of-range keys
+    clip to the edges (documented device-path approximation; the host
+    UDDSketch collapses instead).
+    """
+    half = n_buckets // 2
+    v = np.asarray(values, dtype=np.float64)
+    lg = np.log(gamma)
+    out = np.full(v.shape, half, dtype=np.int32)  # zeros (and NaN: masked upstream)
+    pos = v > 0
+    neg = v < 0
+    with np.errstate(divide="ignore", invalid="ignore"):
+        kpos = np.ceil(np.log(np.where(pos, v, 1.0)) / lg).astype(np.int64)
+        kneg = np.ceil(np.log(np.where(neg, -v, 1.0)) / lg).astype(np.int64)
+    span = half - 1
+    # positives: k shifted into [0, span) then mapped above `half`
+    out_pos = np.clip(kpos + span // 2, 0, span - 1) + half + 1
+    out_neg = half - 1 - np.clip(kneg + span // 2, 0, span - 1)
+    out = np.where(pos, out_pos, out)
+    out = np.where(neg, out_neg, out)
+    return np.clip(out, 0, n_buckets - 1).astype(np.int32)
+
+
+def udd_value_of_bucket(b: np.ndarray | int, gamma: float, n_buckets: int):
+    """Inverse of `udd_bucket_ids` (bucket midpoint values)."""
+    half = n_buckets // 2
+    span = half - 1
+    b = np.asarray(b)
+    k_pos = b - half - 1 - span // 2
+    k_neg = (half - 1 - b) - span // 2
+    mid_pos = 2.0 * np.power(gamma, k_pos.astype(np.float64)) / (gamma + 1)
+    mid_neg = -2.0 * np.power(gamma, k_neg.astype(np.float64)) / (gamma + 1)
+    out = np.where(b > half, mid_pos, np.where(b < half, mid_neg, 0.0))
+    return out
+
+
+def segment_udd(bucket_ids, gids, mask, num_groups: int, n_buckets: int):
+    """Device kernel: [num_groups, n_buckets] histogram via one segment_sum.
+    Merge partials across the mesh with `psum` (bucket counts add)."""
+    import jax
+    import jax.numpy as jnp
+
+    flat = gids.astype(jnp.int32) * n_buckets + bucket_ids.astype(jnp.int32)
+    flat = jnp.where(mask, flat, num_groups * n_buckets)  # overflow slot
+    counts = jax.ops.segment_sum(
+        mask.astype(jnp.int32), flat, num_segments=num_groups * n_buckets + 1
+    )
+    return counts[: num_groups * n_buckets].reshape(num_groups, n_buckets)
+
+
+def udd_quantile_dense(counts: np.ndarray, q: float, gamma: float) -> np.ndarray:
+    """Percentile from dense [..., B] device histograms (host finalize)."""
+    counts = np.asarray(counts, dtype=np.int64)
+    n_buckets = counts.shape[-1]
+    total = counts.sum(axis=-1)
+    rank = q * np.maximum(total - 1, 0)
+    cum = np.cumsum(counts, axis=-1)
+    # first bucket whose cumulative count exceeds rank
+    idx = (cum <= rank[..., None]).sum(axis=-1)
+    idx = np.minimum(idx, n_buckets - 1)
+    vals = udd_value_of_bucket(idx, gamma, n_buckets)
+    return np.where(total > 0, vals, np.nan)

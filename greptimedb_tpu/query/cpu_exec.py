@@ -291,12 +291,23 @@ class CpuExecutor:
         specs: list[tuple[str, str]] = []
         out_names: list[str] = []
         post_divide: list[tuple[str, str, str]] = []
+        # Sketch aggregates (hll/uddsketch) have no pyarrow kernel; they are
+        # computed per group from row indices after the hash group-by.
+        sketch_specs: list[tuple[str, str, tuple, str]] = []  # (argname, fn, params, out)
         for ae in plan.agg_exprs:
             for agg in find_agg_calls(ae):
                 out_name = agg.name()
-                if out_name in out_names:
+                if out_name in out_names or any(s[3] == out_name for s in sketch_specs):
                     continue
                 fn = agg.func
+                if fn in _SKETCH_AGGS:
+                    argname = f"__sketch_{len(sketch_specs)}"
+                    arr = eval_expr(agg.arg, work)
+                    if isinstance(arr, pa.Scalar):
+                        arr = pa.array([arr.as_py()] * work.num_rows)
+                    work = work.append_column(argname, arr)
+                    sketch_specs.append((argname, fn, agg.params, out_name))
+                    continue
                 if fn == "count" and agg.arg is None:
                     if "__one" not in work.column_names:
                         work = work.append_column("__one", pa.array(np.ones(work.num_rows, dtype=np.int64)))
@@ -330,8 +341,20 @@ class CpuExecutor:
             cols = {}
             for (argname, pa_fn), out_name in zip(specs, out_names):
                 cols[out_name] = [_global_agg(work[argname], pa_fn)]
+            for argname, fn, params, out_name in sketch_specs:
+                col = work[argname]
+                col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+                cols[out_name] = pa.array(
+                    [_sketch_of(fn, params, col)], pa.binary()
+                )
             return pa.table(cols)
 
+        if sketch_specs:
+            assert "__rowidx" not in work.column_names
+            work = work.append_column(
+                "__rowidx", pa.array(np.arange(work.num_rows, dtype=np.int64))
+            )
+            specs.append(("__rowidx", "list"))
         gb = work.group_by(group_names, use_threads=False)
         result = gb.aggregate(specs)
         # pyarrow names outputs "{col}_{fn}"; rename to our agg names.
@@ -339,7 +362,23 @@ class CpuExecutor:
         for (argname, pa_fn), out_name in zip(specs, out_names):
             rename[f"{argname}_{pa_fn}"] = out_name
         new_names = [rename.get(n, n) for n in result.column_names]
-        return result.rename_columns(new_names)
+        result = result.rename_columns(new_names)
+        if sketch_specs:
+            # Per-row group ids from the group-by's row-index lists: one
+            # vectorized scatter instead of per-group Python loops.
+            la = result["__rowidx_list"].combine_chunks()
+            flat = np.asarray(la.values, dtype=np.int64)
+            lengths = np.diff(np.asarray(la.offsets, dtype=np.int64))
+            num_groups = len(lengths)
+            gids = np.empty(work.num_rows, dtype=np.int64)
+            gids[flat] = np.repeat(np.arange(num_groups, dtype=np.int64), lengths)
+            for argname, fn, params, out_name in sketch_specs:
+                col = work[argname]
+                col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+                states = _sketch_grouped(fn, params, col, gids, num_groups, la)
+                result = result.append_column(out_name, pa.array(states, pa.binary()))
+            result = result.drop_columns(["__rowidx_list"])
+        return result
 
     def _sort(self, plan: Sort, t: pa.Table) -> pa.Table:
         keys = []
@@ -620,6 +659,105 @@ def _apply_fill(vals: np.ndarray, series_code: np.ndarray, fill) -> np.ndarray:
             v = np.where(nan, float(fill), v)
         out[m] = v
     return out
+
+
+_SKETCH_AGGS = {"hll", "hll_merge", "uddsketch_state", "uddsketch_merge"}
+
+
+def _sketch_of(fn: str, params: tuple, values: pa.Array) -> bytes:
+    """One serialized sketch state over `values` (nulls skipped).
+
+    hll(v)                          -> HLL registers from hashed values
+    hll_merge(state)                -> elementwise-max union of HLL states
+    uddsketch_state(nb, err, v)     -> UDDSketch histogram of values
+    uddsketch_merge(state)          -> count-sum union of UDDSketch states
+    """
+    from ..ops import sketch as sk
+
+    if fn == "hll":
+        hashes = sk.hash64(values)
+        valid = ~np.asarray(values.is_null())
+        return sk.hll_serialize(sk.hll_build(hashes[valid]))
+    if fn == "hll_merge":
+        regs = None
+        for state in values.to_pylist():
+            if state is None:
+                continue
+            r = sk.hll_deserialize(state)
+            regs = r if regs is None else sk.hll_merge(regs, r)
+        if regs is None:
+            regs = np.zeros(1 << sk.HLL_P_DEFAULT, dtype=np.uint8)
+        return sk.hll_serialize(regs)
+    if fn == "uddsketch_state":
+        u = _udd_new(params)
+        v = np.asarray(values.cast(pa.float64()).fill_null(np.nan), dtype=np.float64)
+        u.add_array(v)  # add_array drops NaN
+        return u.serialize()
+    if fn == "uddsketch_merge":
+        merged = None
+        for state in values.to_pylist():
+            if state is None:
+                continue
+            u = sk.UddSketch.deserialize(state)
+            if merged is None:
+                merged = u
+            else:
+                try:
+                    merged.merge(u)
+                except ValueError as e:
+                    raise PlanError(f"uddsketch_merge: {e}") from None
+        return (merged or sk.UddSketch()).serialize()
+    raise PlanError(f"unknown sketch aggregate: {fn}")
+
+
+def _sketch_grouped(
+    fn: str, params: tuple, col: pa.Array, gids: np.ndarray, num_groups: int, idx_lists
+) -> list[bytes]:
+    """Grouped sketch states, vectorized where it pays.
+
+    hll uses one hash64 pass + one np.maximum.at scatter over all groups
+    (sk.hll_build_grouped); uddsketch_state slices numpy values per group
+    (the collapsing sketch is inherently per-group); the *_merge variants
+    iterate their (few, small) serialized states.
+    """
+    from ..ops import sketch as sk
+
+    if fn == "hll":
+        hashes = sk.hash64(col)
+        valid = ~np.asarray(col.is_null())
+        regs = sk.hll_build_grouped(
+            hashes[valid], gids[valid], num_groups, sk.HLL_P_DEFAULT
+        )
+        return [sk.hll_serialize(regs[g]) for g in range(num_groups)]
+    if fn == "uddsketch_state":
+        v = np.asarray(col.cast(pa.float64()).fill_null(np.nan), dtype=np.float64)
+        flat = np.asarray(idx_lists.values, dtype=np.int64)
+        offsets = np.asarray(idx_lists.offsets, dtype=np.int64)
+        states = []
+        for g in range(num_groups):
+            u = _udd_new(params)
+            u.add_array(v[flat[offsets[g] : offsets[g + 1]]])
+            states.append(u.serialize())
+        return states
+    # merge variants: small binary state lists per group
+    return [
+        _sketch_of(fn, params, col.take(pa.array(ids)))
+        for ids in idx_lists.to_pylist()
+    ]
+
+
+def _udd_new(params: tuple):
+    """UddSketch from SQL literal params, with friendly errors."""
+    from ..ops import sketch as sk
+
+    try:
+        nb = int(params[0]) if params else sk.UDD_DEFAULT_BUCKETS
+        err = float(params[1]) if len(params) > 1 else sk.UDD_DEFAULT_ERROR
+        return sk.UddSketch(nb, err)
+    except (TypeError, ValueError) as e:
+        raise PlanError(
+            f"uddsketch_state(bucket_num, error_rate, value): bad parameters {params!r}: {e}"
+        ) from None
 
 
 def _global_agg(col, pa_fn: str):

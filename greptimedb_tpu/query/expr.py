@@ -134,9 +134,12 @@ class AggCall(Expr):
     order_by: str | None = None  # for last_value(x ORDER BY ts)
     range_ms: int | None = None  # agg(x) RANGE '10s'
     fill: object = None  # None | "null" | "prev" | "linear" | constant
+    params: tuple = ()  # literal leading args, e.g. uddsketch_state(128, 0.01, v)
 
     def name(self) -> str:
         inner = self.arg.name() if self.arg is not None else "*"
+        if self.params:
+            inner = ", ".join([*(str(p) for p in self.params), inner])
         base = f"{self.func}({inner})"
         if self.range_ms is not None:
             base += f" RANGE {self.range_ms}ms"

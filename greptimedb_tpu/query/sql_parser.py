@@ -35,7 +35,13 @@ AGG_FUNCS = {
     "sum", "avg", "min", "max", "count", "mean",
     "last_value", "first_value", "stddev", "stddev_pop", "var", "var_pop",
     "approx_percentile_cont", "percentile",
+    # approx sketches (reference common/function aggrs: hll, uddsketch)
+    "hll", "hll_merge", "uddsketch_state", "uddsketch_merge",
 }
+
+# Aggregates whose leading arguments are literal parameters and whose LAST
+# argument is the aggregated expression: uddsketch_state(128, 0.01, v).
+_PARAM_AGGS = {"uddsketch_state"}
 
 _TOKEN_RE = re.compile(
     r"""
@@ -795,6 +801,15 @@ class Parser:
         if lname in AGG_FUNCS:
             if lname == "mean":
                 lname = "avg"
+            if lname in _PARAM_AGGS and len(args) > 1:
+                params = []
+                for a in args[:-1]:
+                    if not isinstance(a, Literal):
+                        raise InvalidSyntaxError(
+                            f"{lname}: leading arguments must be literals"
+                        )
+                    params.append(a.value)
+                return AggCall(lname, args[-1], params=tuple(params))
             return AggCall(lname, args[0] if args else None)
         return FuncCall(lname, tuple(args))
 
