@@ -33,6 +33,7 @@ from .parser import (
     MatrixSelector,
     NumberLiteral,
     ParenExpr,
+    SubqueryExpr,
     VectorSelector,
     parse_promql,
 )
@@ -43,6 +44,13 @@ _RATE_FUNCS = {"rate", "increase", "delta"}
 _OVER_TIME = {
     "avg_over_time", "sum_over_time", "min_over_time", "max_over_time",
     "count_over_time", "last_over_time",
+}
+# Window functions evaluated host-side over raw window slices (sequential or
+# order-statistic semantics that don't reduce to the WindowStats moments).
+_HOST_WINDOW_FUNCS = {
+    "deriv", "predict_linear", "holt_winters", "resets", "changes",
+    "quantile_over_time", "stddev_over_time", "stdvar_over_time",
+    "present_over_time", "absent_over_time",
 }
 
 
@@ -67,7 +75,14 @@ class Matrix:
 
 @dataclass
 class Scalar:
-    value: float
+    """A PromQL scalar: one value per step.  `value` is a float (constant)
+    or a [W] ndarray (step-dependent, e.g. time())."""
+
+    value: object  # float | np.ndarray
+
+    def row(self, n_steps: int) -> np.ndarray:
+        v = np.asarray(self.value, dtype=np.float64)
+        return np.broadcast_to(v, (n_steps,))
 
 
 class PromqlEngine:
@@ -82,7 +97,7 @@ class PromqlEngine:
         if isinstance(out, Scalar):
             steps = np.arange(start_ms, end_ms + 1, step_ms, dtype=np.int64)
             return pa.table(
-                {"ts": pa.array(steps, pa.timestamp("ms")), "value": np.full(len(steps), out.value)}
+                {"ts": pa.array(steps, pa.timestamp("ms")), "value": out.row(len(steps)).copy()}
             )
         return _matrix_to_table(out.drop_empty())
 
@@ -98,7 +113,7 @@ class PromqlEngine:
         if isinstance(node, VectorSelector):
             # Instant vector: latest sample within lookback at each step.
             return self._eval_range_func("last_over_time", node, self.lookback_ms, start, end, step)
-        if isinstance(node, MatrixSelector):
+        if isinstance(node, (MatrixSelector, SubqueryExpr)):
             raise PlanError("range vector must be an argument of a range function")
         if isinstance(node, FunctionCall):
             return self._eval_function(node, start, end, step)
@@ -110,12 +125,67 @@ class PromqlEngine:
 
     def _eval_function(self, node: FunctionCall, start, end, step):
         f = node.func
-        if f in _RATE_FUNCS or f in _OVER_TIME or f == "irate" or f == "idelta":
-            if len(node.args) != 1 or not isinstance(node.args[0], MatrixSelector):
+        range_like = f in _RATE_FUNCS or f in _OVER_TIME or f in _HOST_WINDOW_FUNCS or f in ("irate", "idelta")
+        if range_like:
+            # the range vector may not be the first arg (quantile_over_time(q, m[5m]))
+            range_args = [a for a in node.args if isinstance(a, (MatrixSelector, SubqueryExpr))]
+            if len(range_args) != 1:
                 raise PlanError(f"promql: {f} expects a range vector")
-            sel = node.args[0]
+            sel = range_args[0]
+            extra = [
+                self._eval(a, start, end, step)
+                for a in node.args
+                if not isinstance(a, (MatrixSelector, SubqueryExpr))
+            ]
+            extra_vals = [a.value if isinstance(a, Scalar) else None for a in extra]
+            if any(v is None for v in extra_vals):
+                raise PlanError(f"promql: {f} extra arguments must be scalars")
+            if f in _HOST_WINDOW_FUNCS:
+                return self._eval_host_window(f, sel, extra_vals, start, end, step)
             fname = {"irate": "rate", "idelta": "delta"}.get(f, f)
+            if isinstance(sel, SubqueryExpr):
+                return self._with_at(
+                    sel.at_spec, start, end, step,
+                    lambda s, e, st: self._range_from_samples(
+                        fname, self._subquery_samples(sel, s, e, st), sel.range_ms, s, e, st
+                    ),
+                )
             return self._eval_range_func(fname, sel.vector, sel.range_ms, start, end, step)
+        if f == "time":
+            steps = np.arange(start, end + 1, step, dtype=np.int64)
+            return Scalar(steps / 1000.0)
+        if f == "vector":
+            arg = self._eval(node.args[0], start, end, step)
+            steps = np.arange(start, end + 1, step, dtype=np.int64)
+            if isinstance(arg, Scalar):
+                return Matrix([], [()], arg.row(len(steps))[None, :].copy(), steps)
+            return arg
+        if f in ("minute", "hour", "day_of_month", "day_of_week", "days_in_month", "month", "year"):
+            return self._eval_date_func(f, node.args, start, end, step)
+        if f == "timestamp":
+            if node.args and isinstance(node.args[0], VectorSelector):
+                # underlying sample timestamp (WindowStats.last_ts), not the step
+                return self._eval_range_func(
+                    "__last_ts", node.args[0], self.lookback_ms, start, end, step
+                )
+            m = self._eval(node.args[0], start, end, step)
+            vals = np.where(~np.isnan(m.values), m.steps[None, :] / 1000.0, np.nan)
+            return Matrix(m.label_names, m.label_values, vals, m.steps)
+        if f == "absent":
+            m = self._eval(node.args[0], start, end, step)
+            if isinstance(m, Scalar):
+                raise PlanError("promql: absent expects an instant vector")
+            no_series = (
+                np.ones(m.values.shape[1], dtype=bool)
+                if m.values.shape[0] == 0
+                else np.all(np.isnan(m.values), axis=0)
+            )
+            vals = np.where(no_series, 1.0, np.nan)[None, :]
+            return Matrix([], [()], vals, m.steps)
+        if f == "label_replace":
+            return self._label_replace(node.args, start, end, step)
+        if f == "label_join":
+            return self._label_join(node.args, start, end, step)
         simple = {
             "abs": np.abs, "ceil": np.ceil, "floor": np.floor, "sqrt": np.sqrt,
             "exp": np.exp, "ln": np.log, "log2": np.log2, "log10": np.log10,
@@ -124,7 +194,7 @@ class PromqlEngine:
         if f in simple:
             m = self._eval(node.args[0], start, end, step)
             if isinstance(m, Scalar):
-                return Scalar(float(simple[f](m.value)))
+                return Scalar(simple[f](m.value))
             return Matrix(m.label_names, m.label_values, simple[f](m.values), m.steps)
         if f in ("clamp_min", "clamp_max", "clamp"):
             m = self._eval(node.args[0], start, end, step)
@@ -146,12 +216,52 @@ class PromqlEngine:
                 np.nansum(m.values, axis=0),
                 np.nan,
             )
-            return Matrix([], [()], vals[None, :], m.steps)
+            return Scalar(vals)
         if f in ("sort", "sort_desc"):
             return self._eval(node.args[0], start, end, step)  # order applied at output
         raise UnsupportedError(f"promql: function {f} not supported yet")
 
+    def _resolve_at(self, at_spec, start, end):
+        """@ modifier -> fixed evaluation timestamp in ms (or None)."""
+        if at_spec is None:
+            return None
+        if at_spec == "start":
+            return start
+        if at_spec == "end":
+            return end
+        return int(at_spec)
+
+    def _broadcast_fixed(self, m: "Matrix", start, end, step) -> "Matrix":
+        """Tile a single-step result across the full step grid (@ modifier)."""
+        steps = np.arange(start, end + 1, step, dtype=np.int64)
+        vals = (
+            np.repeat(m.values[:, :1], len(steps), axis=1)
+            if m.values.size
+            else np.zeros((m.values.shape[0], len(steps)))
+        )
+        return Matrix(m.label_names, m.label_values, vals, steps)
+
+    def _with_at(self, at_spec, start, end, step, compute):
+        """THE @-modifier implementation, used by every range-vector
+        consumer: pin `compute` to the resolved timestamp and broadcast
+        the single-step result across the requested grid."""
+        at_ms = self._resolve_at(at_spec, start, end)
+        if at_ms is None:
+            return compute(start, end, step)
+        fixed = compute(at_ms, at_ms, max(step, 1))
+        return self._broadcast_fixed(fixed, start, end, step)
+
     def _eval_range_func(self, func: str, sel: VectorSelector, range_ms: int, start, end, step):
+        return self._with_at(
+            sel.at_spec, start, end, step,
+            lambda s, e, st: self._range_from_samples(
+                func, self._fetch(sel, s - range_ms, e), range_ms, s, e, st
+            ),
+        )
+
+    def _range_from_samples(self, func: str, flat, range_ms: int, start, end, step):
+        """Rate-family / over_time over flat (sid, ts, value) samples using
+        the TPU window kernels — shared by selectors and subqueries."""
         from ...ops.rate import (
             RangeSpec,
             extrapolated_rate,
@@ -160,9 +270,7 @@ class PromqlEngine:
             strip_counter_resets,
         )
 
-        series_ids, ts, values, label_names, label_values, num_series = self._fetch(
-            sel, start - range_ms, end
-        )
+        series_ids, ts, values, label_names, label_values, num_series = flat
         steps = np.arange(start, end + 1, step, dtype=np.int64)
         if num_series == 0:
             return Matrix(label_names, [], np.zeros((0, len(steps))), steps)
@@ -177,12 +285,146 @@ class PromqlEngine:
         stats = range_windows(s, t, v, valid, spec, num_series=num_series)
         if func in _RATE_FUNCS:
             vals, defined = extrapolated_rate(stats, spec, func)
+        elif func == "__last_ts":  # timestamp(): the last sample's time in seconds
+            vals, defined = stats.last_ts / 1000.0, stats.count >= 1
         else:
             vals, defined = over_time(stats, func)
         vals = np.asarray(vals, dtype=np.float64)
         defined = np.asarray(defined)
         vals = np.where(defined, vals, np.nan).reshape(num_series, len(steps))
         return Matrix(label_names, label_values, vals, steps)
+
+    def _subquery_samples(self, sub: SubqueryExpr, start, end, step):
+        """Evaluate the subquery's inner expr on the sub-step grid and
+        return its samples in the flat (sid, ts, value) shape _fetch uses."""
+        sub_step = sub.step_ms or step
+        s0 = start - sub.range_ms - sub.offset_ms
+        e0 = end - sub.offset_ms
+        # Align the sub-grid to multiples of sub_step like Prometheus does.
+        s0 = (s0 // sub_step) * sub_step
+        m = self._eval(sub.expr, s0, e0, sub_step)
+        if isinstance(m, Scalar):
+            steps = np.arange(s0, e0 + 1, sub_step, dtype=np.int64)
+            m = Matrix([], [()], m.row(len(steps))[None, :].copy(), steps)
+        S, W = m.values.shape
+        present = ~np.isnan(m.values)
+        sid_grid = np.broadcast_to(np.arange(S, dtype=np.int32)[:, None], (S, W))
+        ts_grid = np.broadcast_to(m.steps[None, :] + sub.offset_ms, (S, W))
+        sid = sid_grid[present]
+        ts = ts_grid[present]
+        vals = m.values[present]
+        order = np.lexsort((ts, sid))
+        return sid[order], ts[order], vals[order], m.label_names, m.label_values, S
+
+    # ---- host-evaluated window functions -----------------------------------
+    def _eval_host_window(self, func, sel, extra, start, end, step):
+        at_spec = sel.at_spec if isinstance(sel, SubqueryExpr) else sel.vector.at_spec
+        range_ms = sel.range_ms
+        return self._with_at(
+            at_spec, start, end, step,
+            lambda s, e, st: self._host_window_inner(func, sel, extra, range_ms, s, e, st),
+        )
+
+    def _host_window_inner(self, func, sel, extra, range_ms, start, end, step):
+        if isinstance(sel, SubqueryExpr):
+            flat = self._subquery_samples(sel, start, end, step)
+        else:
+            flat = self._fetch(sel.vector, start - range_ms, end)
+        sid, ts, values, label_names, label_values, num_series = flat
+        steps = np.arange(start, end + 1, step, dtype=np.int64)
+        W = len(steps)
+        out = np.full((num_series, W), np.nan)
+        # series are contiguous after the (sid, ts) lexsort
+        bounds = np.searchsorted(sid, np.arange(num_series + 1))
+        for si in range(num_series):
+            lo, hi = bounds[si], bounds[si + 1]
+            sts, svs = ts[lo:hi], values[lo:hi]
+            for w, t1 in enumerate(steps):
+                a = np.searchsorted(sts, t1 - range_ms, side="right")
+                b = np.searchsorted(sts, t1, side="right")
+                if a >= b:
+                    continue
+                # scalar args may be step-dependent (e.g. time()-derived)
+                ex = [x if np.isscalar(x) else float(np.asarray(x).reshape(-1)[min(w, np.asarray(x).size - 1)]) for x in extra]
+                out[si, w] = _window_func(func, sts[a:b], svs[a:b], t1, ex)
+        if func == "absent_over_time":
+            no_samples = (
+                np.ones(W, dtype=bool) if num_series == 0 else np.all(np.isnan(out), axis=0)
+            )
+            vals = np.where(no_samples, 1.0, np.nan)[None, :]
+            return Matrix([], [()], vals, steps)
+        return Matrix(label_names, label_values, out, steps)
+
+    # ---- date & label functions --------------------------------------------
+    def _eval_date_func(self, f, args, start, end, step):
+        if args:
+            m = self._eval(args[0], start, end, step)
+        else:
+            steps = np.arange(start, end + 1, step, dtype=np.int64)
+            m = Matrix([], [()], (steps / 1000.0)[None, :], steps)
+        if isinstance(m, Scalar):
+            steps = np.arange(start, end + 1, step, dtype=np.int64)
+            m = Matrix([], [()], m.row(len(steps))[None, :].copy(), steps)
+        vals = m.values
+        nan = np.isnan(vals)
+        secs = np.where(nan, 0, vals).astype(np.int64)
+        t64 = secs.astype("datetime64[s]")
+        if f == "minute":
+            out = (secs // 60) % 60
+        elif f == "hour":
+            out = (secs // 3600) % 24
+        elif f == "day_of_week":
+            out = (secs // 86_400 + 4) % 7  # epoch day 0 was a Thursday
+        elif f == "day_of_month":
+            months = t64.astype("datetime64[M]")
+            out = (t64.astype("datetime64[D]") - months.astype("datetime64[D]")).astype(np.int64) + 1
+        elif f == "days_in_month":
+            months = t64.astype("datetime64[M]")
+            out = ((months + 1).astype("datetime64[D]") - months.astype("datetime64[D]")).astype(np.int64)
+        elif f == "month":
+            out = t64.astype("datetime64[M]").astype(np.int64) % 12 + 1
+        else:  # year
+            out = t64.astype("datetime64[Y]").astype(np.int64) + 1970
+        return Matrix(m.label_names, m.label_values, np.where(nan, np.nan, out.astype(np.float64)), m.steps)
+
+    def _label_replace(self, args, start, end, step):
+        if len(args) != 5:
+            raise PlanError("label_replace(v, dst_label, replacement, src_label, regex)")
+        m = self._eval(args[0], start, end, step)
+        dst, repl, src, regex = (
+            _string_arg(args[1]), _string_arg(args[2]), _string_arg(args[3]), _string_arg(args[4]))
+        pat = re.compile(regex)
+        names = list(m.label_names)
+        if dst not in names:
+            names = names + [dst]
+        out_values = []
+        template = _dollar_template(repl)
+        for lv in m.label_values:
+            d = dict(zip(m.label_names, lv))
+            srcval = d.get(src, "") or ""
+            mt = pat.fullmatch(srcval)
+            if mt is not None:
+                d[dst] = mt.expand(template)
+            elif dst not in d:
+                d[dst] = ""
+            out_values.append(tuple(d.get(n, "") for n in names))
+        return Matrix(names, out_values, m.values, m.steps)
+
+    def _label_join(self, args, start, end, step):
+        if len(args) < 3:
+            raise PlanError("label_join(v, dst_label, separator, src_labels...)")
+        m = self._eval(args[0], start, end, step)
+        dst, sep = _string_arg(args[1]), _string_arg(args[2])
+        srcs = [_string_arg(a) for a in args[3:]]
+        names = list(m.label_names)
+        if dst not in names:
+            names = names + [dst]
+        out_values = []
+        for lv in m.label_values:
+            d = dict(zip(m.label_names, lv))
+            d[dst] = sep.join(str(d.get(s, "") or "") for s in srcs)
+            out_values.append(tuple(d.get(n, "") for n in names))
+        return Matrix(names, out_values, m.values, m.steps)
 
     def _eval_aggregate(self, node: AggregateExpr, start, end, step):
         m = self._eval(node.expr, start, end, step)
@@ -250,26 +492,137 @@ class PromqlEngine:
     def _eval_binary(self, node: BinaryExpr, start, end, step):
         l = self._eval(node.left, start, end, step)
         r = self._eval(node.right, start, end, step)
+        if node.op in ("and", "or", "unless"):
+            if isinstance(l, Scalar) or isinstance(r, Scalar):
+                raise PlanError(f"promql: {node.op} requires vector operands")
+            return self._set_op(node, l, r)
         if isinstance(l, Scalar) and isinstance(r, Scalar):
             return Scalar(_scalar_op(node.op, l.value, r.value))
         if isinstance(l, Scalar):
             return self._apply_scalar(node, r, l.value, scalar_on_left=True)
         if isinstance(r, Scalar):
             return self._apply_scalar(node, l, r.value, scalar_on_left=False)
-        # vector-vector: one-to-one join on full label sets
-        lmap = {lv: i for i, lv in enumerate(l.label_values)}
-        names = l.label_names
+        return self._vector_match(node, l, r)
+
+    @staticmethod
+    def _join_key(m: Matrix, i: int, on, ignoring) -> tuple:
+        d = dict(zip(m.label_names, m.label_values[i]))
+        if on is not None:
+            return tuple(d.get(n) for n in on)
+        keys = [n for n in m.label_names if ignoring is None or n not in ignoring]
+        return tuple((n, d[n]) for n in sorted(keys))
+
+    def _set_op(self, node: BinaryExpr, l: Matrix, r: Matrix):
+        """and/or/unless with on/ignoring matching, per-timestamp (Prometheus
+        semantics: presence is checked at each step, unioned across all
+        series sharing a join key)."""
+        W = l.values.shape[1]
+        # per-key presence mask on the right side (union across series)
+        rpresence: dict[tuple, np.ndarray] = {}
+        for j in range(len(r.label_values)):
+            key = self._join_key(r, j, node.on, node.ignoring)
+            mask = ~np.isnan(r.values[j])
+            prev = rpresence.get(key)
+            rpresence[key] = mask if prev is None else (prev | mask)
+        if node.op in ("and", "unless"):
+            out_vals = []
+            for i in range(len(l.label_values)):
+                rpresent = rpresence.get(
+                    self._join_key(l, i, node.on, node.ignoring), np.zeros(W, dtype=bool)
+                )
+                keep = rpresent if node.op == "and" else ~rpresent
+                out_vals.append(np.where(keep, l.values[i], np.nan))
+            values = np.stack(out_vals) if out_vals else np.zeros((0, W))
+            return Matrix(l.label_names, list(l.label_values), values, l.steps)
+        # or: all left series; right series contribute only at steps where NO
+        # left series with the same key has a value.
+        lpresence: dict[tuple, np.ndarray] = {}
+        for i in range(len(l.label_values)):
+            key = self._join_key(l, i, node.on, node.ignoring)
+            mask = ~np.isnan(l.values[i])
+            prev = lpresence.get(key)
+            lpresence[key] = mask if prev is None else (prev | mask)
+        names = list(l.label_names)
+        extra = [n for n in r.label_names if n not in names]
+        names_all = names + extra
         out_labels, out_vals = [], []
-        reorder = [r.label_names.index(n) if n in r.label_names else None for n in names]
-        for rv, j in zip(r.label_values, range(len(r.label_values))):
-            key = tuple(rv[k] if k is not None else None for k in reorder)
-            i = lmap.get(key)
-            if i is None:
+        for i in range(len(l.label_values)):
+            d = dict(zip(l.label_names, l.label_values[i]))
+            out_labels.append(tuple(d.get(n, "") for n in names_all))
+            out_vals.append(l.values[i])
+        for j in range(len(r.label_values)):
+            key = self._join_key(r, j, node.on, node.ignoring)
+            lmask = lpresence.get(key, np.zeros(W, dtype=bool))
+            vals = np.where(lmask, np.nan, r.values[j])
+            if np.all(np.isnan(vals)):
                 continue
-            vals = _vec_op(node.op, l.values[i], r.values[j], node.bool_modifier)
-            out_labels.append(l.label_values[i])
+            d = dict(zip(r.label_names, r.label_values[j]))
+            out_labels.append(tuple(d.get(n, "") for n in names_all))
             out_vals.append(vals)
-        values = np.stack(out_vals) if out_vals else np.zeros((0, len(l.steps)))
+        values = np.stack(out_vals) if out_vals else np.zeros((0, W))
+        return Matrix(names_all, out_labels, values, l.steps)
+
+    def _vector_match(self, node: BinaryExpr, l: Matrix, r: Matrix):
+        """Arithmetic/comparison with one-to-one or many-to-one matching
+        (reference PromPlanner vector matching: on/ignoring, group_left/right).
+
+        The "many" side is the left operand (group_left, the default for
+        one-to-one too) or the right operand (group_right); the "one" side
+        must have a unique series per join key.
+        """
+        one, many = (l, r) if node.group == "right" else (r, l)
+        one_map: dict[tuple, int] = {}
+        for j in range(len(one.label_values)):
+            key = self._join_key(one, j, node.on, node.ignoring)
+            if key in one_map:
+                side = "left" if node.group == "right" else "right"
+                raise PlanError(
+                    f"promql: many-to-many matching not allowed: duplicate series "
+                    f"on the {side} side for key {key}"
+                )
+            one_map[key] = j
+
+        if node.group is None:
+            # one-to-one: the other side must also be unique per key
+            seen: set = set()
+            for i in range(len(many.label_values)):
+                key = self._join_key(many, i, node.on, node.ignoring)
+                if key in seen:
+                    raise PlanError(
+                        "promql: many-to-many matching not allowed (use group_left/group_right)"
+                    )
+                seen.add(key)
+
+        # output labels: grouped match keeps the many side's labels
+        # (+include from the one side); one-to-one keeps the join-key labels
+        # when `on` is given, else left labels minus ignored.
+        if node.group is not None:
+            names = list(many.label_names) + [
+                n for n in node.include if n not in many.label_names
+            ]
+        elif node.on is not None:
+            names = list(node.on)
+        else:
+            names = [n for n in l.label_names if node.ignoring is None or n not in node.ignoring]
+
+        out_labels, out_vals = [], []
+        W = l.values.shape[1]
+        for i in range(len(many.label_values)):
+            key = self._join_key(many, i, node.on, node.ignoring)
+            j = one_map.get(key)
+            if j is None:
+                continue
+            lv = l.values[i] if node.group != "right" else l.values[j]
+            rv = r.values[j] if node.group != "right" else r.values[i]
+            vals = _vec_op(node.op, lv, rv, node.bool_modifier)
+            d = dict(zip(many.label_names, many.label_values[i]))
+            if node.group is not None:
+                do = dict(zip(one.label_names, one.label_values[j]))
+                for n in node.include:
+                    d[n] = do.get(n, "")
+            out_labels.append(tuple(d.get(n, "") for n in names))
+            out_vals.append(vals)
+        values = np.stack(out_vals) if out_vals else np.zeros((0, W))
         return Matrix(names, out_labels, values, l.steps)
 
     def _apply_scalar(self, node, m: Matrix, scalar: float, scalar_on_left: bool):
@@ -361,20 +714,102 @@ class PromqlEngine:
         return sid[order], ts[order], values[order], tags, label_values, len(label_values)
 
 
-def _scalar_op(op: str, a, b) -> float:
-    if op == "+":
-        return a + b
-    if op == "-":
-        return a - b
-    if op == "*":
-        return a * b
-    if op == "/":
-        return a / b if b != 0 else float("nan")
-    if op == "%":
-        return np.fmod(a, b)
-    if op == "^":
-        return a**b
-    return float(_cmp_np(op, np.float64(a), np.float64(b)))
+def _dollar_template(repl: str) -> str:
+    """RE2-style $N/${N}/$name/$$ replacement -> Python \\g<> template."""
+    out = []
+    i = 0
+    while i < len(repl):
+        c = repl[i]
+        if c == "$":
+            if repl[i + 1 : i + 2] == "$":
+                out.append("$")
+                i += 2
+                continue
+            m = re.match(r"\$\{(\w+)\}|\$(\w+)", repl[i:])
+            if m:
+                out.append(f"\\g<{m.group(1) or m.group(2)}>")
+                i += m.end()
+                continue
+            out.append("$")
+            i += 1
+        elif c == "\\":
+            out.append("\\\\")
+            i += 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _string_arg(node) -> str:
+    from .parser import StringLiteral
+
+    if isinstance(node, StringLiteral):
+        return node.value
+    raise PlanError("promql: expected a string literal argument")
+
+
+def _window_func(func: str, ts: np.ndarray, vs: np.ndarray, eval_ms: int, extra: list):
+    """One (series, window) evaluation for the host-side window functions
+    (reference promql/src/functions/{deriv,predict_linear,holt_winters,
+    resets,changes,quantile}.rs semantics)."""
+    n = len(vs)
+    if func == "present_over_time":
+        return 1.0
+    if func == "absent_over_time":
+        return 0.0  # sentinel: series HAS samples; absence derived by caller
+    if func == "quantile_over_time":
+        q = extra[0] if extra else 0.5
+        return float(np.quantile(vs, np.clip(q, 0, 1)))
+    if func == "stddev_over_time":
+        return float(np.std(vs))
+    if func == "stdvar_over_time":
+        return float(np.var(vs))
+    if func == "resets":
+        return float(np.sum(np.diff(vs) < 0)) if n > 1 else 0.0
+    if func == "changes":
+        return float(np.sum(np.diff(vs) != 0)) if n > 1 else 0.0
+    if func in ("deriv", "predict_linear"):
+        if n < 2:
+            return np.nan
+        # least-squares slope/intercept with x = seconds relative to eval time
+        x = (ts - eval_ms) / 1000.0
+        mx, my = x.mean(), vs.mean()
+        dx = x - mx
+        denom = np.dot(dx, dx)
+        if denom == 0:
+            return np.nan
+        slope = np.dot(dx, vs - my) / denom
+        if func == "deriv":
+            return float(slope)
+        intercept = my - slope * mx
+        return float(intercept + slope * extra[0])  # extra[0] = seconds ahead
+    if func == "holt_winters":
+        if n < 2:
+            return np.nan
+        sf = extra[0] if extra else 0.5
+        tf = extra[1] if len(extra) > 1 else 0.5
+        s, b = vs[0], vs[1] - vs[0]
+        for i in range(1, n):
+            s_prev = s
+            s = sf * vs[i] + (1 - sf) * (s + b)
+            b = tf * (s - s_prev) + (1 - tf) * b
+        return float(s)
+    raise PlanError(f"promql: unknown window function {func}")
+
+
+def _scalar_op(op: str, a, b):
+    """Scalar-scalar op; operands may be floats or per-step [W] arrays."""
+    with np.errstate(all="ignore"):
+        if op in ("+", "-", "*", "/", "%", "^"):
+            f = {
+                "+": np.add, "-": np.subtract, "*": np.multiply,
+                "/": np.divide, "%": np.fmod, "^": np.power,
+            }[op]
+            out = f(np.float64(a) if np.isscalar(a) else a, b)
+        else:
+            out = _cmp_np(op, np.asarray(a, dtype=np.float64), np.asarray(b)).astype(np.float64)
+        return float(out) if np.ndim(out) == 0 else out
 
 
 def _cmp_np(op, a, b):

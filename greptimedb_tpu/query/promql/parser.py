@@ -19,11 +19,11 @@ _TOKEN_RE = re.compile(
     r"""
     (?P<ws>\s+)
   | (?P<comment>\#[^\n]*)
+  | (?P<duration>\d+(?:ms|[smhdwy])\b)
   | (?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+|\d+(?:[eE][+-]?\d+)?|0x[0-9a-fA-F]+)
-  | (?P<duration>\d+(?:ms|[smhdwy]))
   | (?P<string>'(?:[^'\\]|\\.)*'|"(?:[^"\\]|\\.)*")
-  | (?P<op>=~|!~|!=|==|<=|>=|<|>|\+|-|\*|/|%|\^|\(|\)|\{|\}|\[|\]|,|=)
-  | (?P<ident>[A-Za-z_:][A-Za-z0-9_:]*)
+  | (?P<op>=~|!~|!=|==|<=|>=|<|>|\+|-|\*|/|%|\^|\(|\)|\{|\}|\[|\]|,|=|:|@)
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_:]*)
     """,
     re.VERBOSE,
 )
@@ -37,12 +37,18 @@ RANGE_FUNCS = {
     "avg_over_time", "sum_over_time", "min_over_time", "max_over_time",
     "count_over_time", "last_over_time", "present_over_time",
     "stddev_over_time", "stdvar_over_time", "quantile_over_time",
+    "deriv", "predict_linear", "holt_winters", "resets", "changes",
+    "absent_over_time",
 }
 INSTANT_FUNCS = {
     "abs", "ceil", "floor", "round", "sqrt", "exp", "ln", "log2", "log10",
     "clamp_min", "clamp_max", "clamp", "scalar", "sgn", "timestamp", "absent",
     "histogram_quantile", "sort", "sort_desc",
+    "label_replace", "label_join", "vector", "time",
+    "minute", "hour", "day_of_month", "day_of_week", "days_in_month",
+    "month", "year",
 }
+SET_OPS = {"and", "or", "unless"}
 
 
 @dataclass
@@ -57,6 +63,7 @@ class VectorSelector:
     metric: str
     matchers: list[Matcher] = field(default_factory=list)
     offset_ms: int = 0
+    at_spec: object = None  # None | float epoch-ms | "start" | "end"
 
 
 @dataclass
@@ -66,8 +73,25 @@ class MatrixSelector:
 
 
 @dataclass
+class SubqueryExpr:
+    """expr[range:step] — re-evaluates `expr` on a sub-step grid and feeds
+    the samples to an outer range function (Prometheus subquery)."""
+
+    expr: object
+    range_ms: int = 0
+    step_ms: int = 0  # 0 = use the outer evaluation step
+    offset_ms: int = 0
+    at_spec: object = None
+
+
+@dataclass
 class NumberLiteral:
     value: float
+
+
+@dataclass
+class StringLiteral:
+    value: str
 
 
 @dataclass
@@ -87,10 +111,15 @@ class AggregateExpr:
 
 @dataclass
 class BinaryExpr:
-    op: str  # + - * / % ^ == != < <= > >=
+    op: str  # + - * / % ^ == != < <= > >= and or unless
     left: object
     right: object
     bool_modifier: bool = False
+    # vector matching (reference PromPlanner vector matching support):
+    on: list[str] | None = None  # join on exactly these labels
+    ignoring: list[str] | None = None  # join on all labels except these
+    group: str | None = None  # "left" | "right" for many-to-one
+    include: list[str] = field(default_factory=list)  # extra labels to copy
 
 
 @dataclass
@@ -147,7 +176,41 @@ class PromParser:
         return e
 
     def parse_expr(self):
-        return self.parse_comparison()
+        return self.parse_or()
+
+    def _binary_modifiers(self) -> dict:
+        """Optional on/ignoring + group_left/group_right after a binary op."""
+        mods: dict = {}
+        if self.peek() == ("ident", "on"):
+            self.next()
+            mods["on"] = self._label_list()
+        elif self.peek() == ("ident", "ignoring"):
+            self.next()
+            mods["ignoring"] = self._label_list()
+        for side in ("left", "right"):
+            if self.peek() == ("ident", f"group_{side}"):
+                self.next()
+                mods["group"] = side
+                if self.peek() == ("op", "("):
+                    mods["include"] = self._label_list()
+                break
+        return mods
+
+    def parse_or(self):
+        left = self.parse_and()
+        while self.peek() == ("ident", "or"):
+            self.next()
+            mods = self._binary_modifiers()
+            left = BinaryExpr("or", left, self.parse_and(), **mods)
+        return left
+
+    def parse_and(self):
+        left = self.parse_comparison()
+        while self.peek()[0] == "ident" and self.peek()[1] in ("and", "unless"):
+            op = self.next()[1]
+            mods = self._binary_modifiers()
+            left = BinaryExpr(op, left, self.parse_comparison(), **mods)
+        return left
 
     def parse_comparison(self):
         left = self.parse_additive()
@@ -156,8 +219,9 @@ class PromParser:
             if k == "op" and v in ("==", "!=", "<", "<=", ">", ">="):
                 self.next()
                 bool_mod = self.eat("ident", "bool")
+                mods = self._binary_modifiers()
                 right = self.parse_additive()
-                left = BinaryExpr(v, left, right, bool_modifier=bool_mod)
+                left = BinaryExpr(v, left, right, bool_modifier=bool_mod, **mods)
             else:
                 return left
 
@@ -167,7 +231,8 @@ class PromParser:
             k, v = self.peek()
             if k == "op" and v in ("+", "-"):
                 self.next()
-                left = BinaryExpr(v, left, self.parse_multiplicative())
+                mods = self._binary_modifiers()
+                left = BinaryExpr(v, left, self.parse_multiplicative(), **mods)
             else:
                 return left
 
@@ -177,7 +242,8 @@ class PromParser:
             k, v = self.peek()
             if k == "op" and v in ("*", "/", "%"):
                 self.next()
-                left = BinaryExpr(v, left, self.parse_power())
+                mods = self._binary_modifiers()
+                left = BinaryExpr(v, left, self.parse_power(), **mods)
             else:
                 return left
 
@@ -185,7 +251,8 @@ class PromParser:
         left = self.parse_unary()
         if self.peek() == ("op", "^"):
             self.next()
-            return BinaryExpr("^", left, self.parse_power())
+            mods = self._binary_modifiers()
+            return BinaryExpr("^", left, self.parse_power(), **mods)
         return left
 
     def parse_unary(self):
@@ -197,16 +264,25 @@ class PromParser:
 
     def parse_postfix(self):
         e = self.parse_primary()
-        # range selector and offset
+        # range selector / subquery, offset, @ modifier
         while True:
             if self.peek() == ("op", "["):
                 self.next()
                 rng = self._parse_duration()
+                if self.eat("op", ":"):
+                    sub_step = 0
+                    if self.peek() != ("op", "]"):
+                        sub_step = self._parse_duration()
+                    self.expect("op", "]")
+                    e = SubqueryExpr(e, rng, sub_step)
+                    continue
                 self.expect("op", "]")
                 if isinstance(e, VectorSelector):
                     e = MatrixSelector(e, rng)
                 else:
-                    raise InvalidSyntaxError("promql: range on non-selector")
+                    raise InvalidSyntaxError(
+                        "promql: range on non-selector (use a subquery [range:step])"
+                    )
             elif self.peek() == ("ident", "offset"):
                 self.next()
                 off = self._parse_duration()
@@ -214,16 +290,42 @@ class PromParser:
                     e.offset_ms = off
                 elif isinstance(e, MatrixSelector):
                     e.vector.offset_ms = off
+                elif isinstance(e, SubqueryExpr):
+                    e.offset_ms = off
                 else:
                     raise InvalidSyntaxError("promql: offset on non-selector")
+            elif self.peek() == ("op", "@"):
+                self.next()
+                at = self._parse_at()
+                if isinstance(e, VectorSelector):
+                    e.at_spec = at
+                elif isinstance(e, MatrixSelector):
+                    e.vector.at_spec = at
+                elif isinstance(e, SubqueryExpr):
+                    e.at_spec = at
+                else:
+                    raise InvalidSyntaxError("promql: @ on non-selector")
             else:
                 return e
+
+    def _parse_at(self):
+        k, v = self.next()
+        if k == "number":
+            return float(v) * 1000.0  # epoch seconds -> ms
+        if k == "ident" and v in ("start", "end"):
+            self.expect("op", "(")
+            self.expect("op", ")")
+            return v
+        raise InvalidSyntaxError(f"promql: bad @ modifier {v!r}")
 
     def parse_primary(self):
         k, v = self.peek()
         if k == "number":
             self.next()
             return NumberLiteral(float(v))
+        if k == "string":
+            self.next()
+            return StringLiteral(_unquote(v))
         if k == "op" and v == "(":
             self.next()
             e = self.parse_expr()

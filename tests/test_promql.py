@@ -157,3 +157,254 @@ def test_tql_regex_matcher(db):
     assert t.num_rows == 2
     t = db.sql_one('TQL EVAL (600, 600, \'60s\') http_requests_total{host!~"a"}')
     assert t["host"].to_pylist() == ["b"]
+
+
+# ---- extended surface: subqueries, @, matching, window functions -----------
+
+
+def test_parse_subquery_and_at():
+    from greptimedb_tpu.query.promql.parser import SubqueryExpr
+
+    ast = parse_promql("max_over_time(rate(m[1m])[5m:30s])")
+    assert isinstance(ast, FunctionCall) and ast.func == "max_over_time"
+    sub = ast.args[0]
+    assert isinstance(sub, SubqueryExpr)
+    assert sub.range_ms == 300_000 and sub.step_ms == 30_000
+    inner = sub.expr
+    assert isinstance(inner, FunctionCall) and inner.func == "rate"
+
+    ast = parse_promql("m[5m:]")
+    assert isinstance(ast, SubqueryExpr) and ast.step_ms == 0
+
+    ast = parse_promql("m @ 1000")
+    assert ast.at_spec == 1_000_000.0  # epoch seconds -> ms
+    assert parse_promql("m @ start()").at_spec == "start"
+    assert parse_promql("m @ end()").at_spec == "end"
+
+
+def test_parse_vector_matching_modifiers():
+    ast = parse_promql("a * on(host) group_left(job) b")
+    assert ast.op == "*" and ast.on == ["host"]
+    assert ast.group == "left" and ast.include == ["job"]
+    ast = parse_promql("a / ignoring(cpu) b")
+    assert ast.ignoring == ["cpu"]
+    ast = parse_promql("a and on(host) b")
+    assert ast.op == "and" and ast.on == ["host"]
+    ast = parse_promql("a or b unless c")
+    assert ast.op == "or"
+
+
+def test_tql_subquery(db):
+    # max_over_time of rate over a subquery window: rate is constant per
+    # host, so the max equals the rate.
+    t = db.sql_one("TQL EVAL (600, 600, '60s') max_over_time(rate(http_requests_total[1m])[5m:30s])")
+    by_host = dict(zip(t["host"].to_pylist(), t["value"].to_pylist()))
+    np.testing.assert_allclose(by_host["a"], 2.0, rtol=1e-6)
+    np.testing.assert_allclose(by_host["b"], 5.0, rtol=1e-6)
+
+
+def test_tql_at_modifier(db):
+    # value pinned at t=300s regardless of eval step
+    t = db.sql_one("TQL EVAL (500, 600, '50s') http_requests_total{host=\"a\"} @ 300")
+    vals = t["value"].to_pylist()
+    assert len(vals) == 3  # steps 500, 550, 600
+    np.testing.assert_allclose(vals, 600.0)  # 2/s * 300s at all steps
+
+    t = db.sql_one("TQL EVAL (600, 600, '60s') http_requests_total{host=\"a\"} @ start()")
+    np.testing.assert_allclose(t["value"].to_pylist(), 1200.0)
+
+
+def test_tql_deriv_predict_linear(db):
+    t = db.sql_one("TQL EVAL (600, 600, '60s') deriv(http_requests_total{host=\"b\"}[2m])")
+    np.testing.assert_allclose(t["value"].to_pylist(), 5.0, rtol=1e-9)
+    # predict 60s ahead: 3000 + 5*60 = 3300
+    t = db.sql_one(
+        "TQL EVAL (600, 600, '60s') predict_linear(http_requests_total{host=\"b\"}[2m], 60)"
+    )
+    np.testing.assert_allclose(t["value"].to_pylist(), 3300.0, rtol=1e-9)
+
+
+def test_tql_resets_changes(db):
+    db.sql("CREATE TABLE saw (ts TIMESTAMP(3), val DOUBLE, TIME INDEX (ts))")
+    vals = [0, 1, 2, 0, 1, 0, 5, 5]
+    rows = ", ".join(f"({i * 10_000}, {v})" for i, v in enumerate(vals))
+    db.sql(f"INSERT INTO saw VALUES {rows}")
+    # window (0, 80] takes samples at 10..70s: [1, 2, 0, 1, 0, 5, 5]
+    t = db.sql_one("TQL EVAL (80, 80, '10s') resets(saw[80s])")
+    np.testing.assert_allclose(t["value"].to_pylist(), 2.0)
+    t = db.sql_one("TQL EVAL (80, 80, '10s') changes(saw[80s])")
+    np.testing.assert_allclose(t["value"].to_pylist(), 5.0)
+
+
+def test_tql_quantile_stddev_over_time(db):
+    t = db.sql_one(
+        "TQL EVAL (600, 600, '60s') quantile_over_time(0.5, http_requests_total{host=\"a\"}[1m])"
+    )
+    # samples (540,600]: 1100..1200 step 20 -> median 1150
+    np.testing.assert_allclose(t["value"].to_pylist(), 1150.0)
+    t = db.sql_one(
+        "TQL EVAL (600, 600, '60s') stddev_over_time(http_requests_total{host=\"a\"}[1m])"
+    )
+    samples = np.array([2.0 * s for s in range(550, 601, 10)])
+    np.testing.assert_allclose(t["value"].to_pylist(), np.std(samples), rtol=1e-9)
+
+
+def test_tql_holt_winters(db):
+    t = db.sql_one(
+        "TQL EVAL (600, 600, '60s') holt_winters(http_requests_total{host=\"a\"}[2m], 0.5, 0.5)"
+    )
+    # linear series: double exponential smoothing converges to the last value
+    np.testing.assert_allclose(t["value"].to_pylist(), 1200.0, rtol=1e-6)
+
+
+def test_tql_present_absent(db):
+    t = db.sql_one("TQL EVAL (600, 600, '60s') present_over_time(http_requests_total{host=\"a\"}[1m])")
+    np.testing.assert_allclose(t["value"].to_pylist(), 1.0)
+    t = db.sql_one("TQL EVAL (600, 600, '60s') absent(http_requests_total{host=\"zzz\"})")
+    np.testing.assert_allclose(t["value"].to_pylist(), 1.0)
+    t = db.sql_one("TQL EVAL (600, 600, '60s') absent(http_requests_total{host=\"a\"})")
+    assert t.num_rows == 0  # present -> empty result
+
+
+def test_tql_vector_matching_group_left(db):
+    db.sql("CREATE TABLE limits (host STRING, ts TIMESTAMP(3), val DOUBLE, TIME INDEX (ts), PRIMARY KEY (host))")
+    # within the 5m lookback of the t=600s evaluation
+    db.sql("INSERT INTO limits VALUES ('a', 400000, 100), ('b', 400000, 200)")
+    # http_requests_total has (host, job); limits has (host) only ->
+    # group_left joins many (host, job) rows to one host row.
+    t = db.sql_one(
+        "TQL EVAL (600, 600, '60s') http_requests_total / on(host) group_left limits"
+    )
+    by_host = dict(zip(t["host"].to_pylist(), t["value"].to_pylist()))
+    np.testing.assert_allclose(by_host["a"], 12.0)  # 1200/100
+    np.testing.assert_allclose(by_host["b"], 15.0)  # 3000/200
+
+
+def test_tql_set_ops(db):
+    t = db.sql_one(
+        "TQL EVAL (600, 600, '60s') http_requests_total and on(host) http_requests_total{host=\"a\"}"
+    )
+    assert t["host"].to_pylist() == ["a"]
+    t = db.sql_one(
+        "TQL EVAL (600, 600, '60s') http_requests_total unless on(host) http_requests_total{host=\"a\"}"
+    )
+    assert t["host"].to_pylist() == ["b"]
+    t = db.sql_one(
+        "TQL EVAL (600, 600, '60s') http_requests_total{host=\"a\"} or http_requests_total{host=\"b\"}"
+    )
+    assert sorted(t["host"].to_pylist()) == ["a", "b"]
+
+
+def test_tql_label_functions(db):
+    t = db.sql_one(
+        "TQL EVAL (600, 600, '60s') label_replace(http_requests_total{host=\"a\"},"
+        " \"dc\", \"dc-$1\", \"host\", \"(.*)\")"
+    )
+    assert t["dc"].to_pylist() == ["dc-a"]
+    t = db.sql_one(
+        "TQL EVAL (600, 600, '60s') label_join(http_requests_total{host=\"a\"},"
+        " \"hj\", \"-\", \"host\", \"job\")"
+    )
+    assert t["hj"].to_pylist() == ["a-api"]
+
+
+def test_tql_time_and_date_functions(db):
+    t = db.sql_one("TQL EVAL (600, 600, '60s') time()")
+    np.testing.assert_allclose(t["value"].to_pylist(), 600.0)
+    t = db.sql_one("TQL EVAL (600, 600, '60s') vector(7)")
+    np.testing.assert_allclose(t["value"].to_pylist(), 7.0)
+    # 1970-01-01 00:10:00 UTC -> minute 10, hour 0, Thursday (4)
+    t = db.sql_one("TQL EVAL (600, 600, '60s') minute()")
+    np.testing.assert_allclose(t["value"].to_pylist(), 10.0)
+    t = db.sql_one("TQL EVAL (600, 600, '60s') hour()")
+    np.testing.assert_allclose(t["value"].to_pylist(), 0.0)
+    t = db.sql_one("TQL EVAL (600, 600, '60s') day_of_week()")
+    np.testing.assert_allclose(t["value"].to_pylist(), 4.0)
+    t = db.sql_one("TQL EVAL (600, 600, '60s') days_in_month()")
+    np.testing.assert_allclose(t["value"].to_pylist(), 31.0)
+    t = db.sql_one("TQL EVAL (600, 600, '60s') month()")
+    np.testing.assert_allclose(t["value"].to_pylist(), 1.0)
+    t = db.sql_one("TQL EVAL (600, 600, '60s') year()")
+    np.testing.assert_allclose(t["value"].to_pylist(), 1970.0)
+
+
+def test_tql_timestamp_function(db):
+    t = db.sql_one("TQL EVAL (600, 600, '60s') timestamp(http_requests_total{host=\"a\"})")
+    np.testing.assert_allclose(t["value"].to_pylist(), 600.0)
+
+
+def test_promql_many_to_many_rejected(db):
+    from greptimedb_tpu.utils.errors import PlanError
+
+    with pytest.raises(PlanError, match="many-to-many"):
+        db.sql_one(
+            "TQL EVAL (600, 600, '60s') http_requests_total + on(job) http_requests_total"
+        )
+
+
+# ---- regression coverage for review findings -------------------------------
+
+
+def test_tql_subquery_with_at(db):
+    # @ on a subquery must pin AND broadcast (used to return empty)
+    t = db.sql_one(
+        "TQL EVAL (500, 600, '50s') max_over_time(http_requests_total{host=\"a\"}[1m:10s] @ 300)"
+    )
+    vals = t["value"].to_pylist()
+    assert len(vals) == 3
+    np.testing.assert_allclose(vals, 600.0)  # pinned at t=300s for all steps
+
+
+def test_tql_time_scalar_arithmetic(db):
+    # time() is a scalar: arithmetic against a labeled vector must work
+    t = db.sql_one("TQL EVAL (600, 600, '60s') time() - http_requests_total{host=\"a\"}")
+    np.testing.assert_allclose(t["value"].to_pylist(), [600.0 - 1200.0])
+    t = db.sql_one("TQL EVAL (600, 600, '60s') http_requests_total > bool time()")
+    assert t.num_rows == 2  # both hosts compared against the scalar
+
+
+def test_tql_timestamp_returns_sample_time(db):
+    db.sql("CREATE TABLE once (ts TIMESTAMP(3), val DOUBLE, TIME INDEX (ts))")
+    db.sql("INSERT INTO once VALUES (590000, 1.0)")
+    t = db.sql_one("TQL EVAL (600, 600, '60s') timestamp(once)")
+    np.testing.assert_allclose(t["value"].to_pylist(), 590.0)  # not 600
+
+
+def test_tql_or_fills_per_timestamp(db):
+    db.sql("CREATE TABLE s1 (host STRING, ts TIMESTAMP(3), val DOUBLE, TIME INDEX (ts), PRIMARY KEY (host))")
+    db.sql("CREATE TABLE s2 (host STRING, ts TIMESTAMP(3), val DOUBLE, TIME INDEX (ts), PRIMARY KEY (host))")
+    db.sql("INSERT INTO s1 VALUES ('x', 60000, 100)")
+    db.sql("INSERT INTO s2 VALUES ('x', 60000, 150), ('x', 900000, 200)")
+    t = db.sql_one(
+        "TQL EVAL (60, 900, '840s') last_over_time(s1[1m]) or last_over_time(s2[1m])"
+    )
+    got = {(h, ts.timestamp()): v for h, ts, v in zip(
+        t["host"].to_pylist(), t["ts"].to_pylist(), t["value"].to_pylist())}
+    # step 60: left value wins; step 900: left absent -> right fills in
+    assert got[("x", 60.0)] == 100.0
+    assert got[("x", 900.0)] == 200.0
+
+
+def test_tql_and_union_presence(db):
+    db.sql("CREATE TABLE lft (host STRING, ts TIMESTAMP(3), val DOUBLE, TIME INDEX (ts), PRIMARY KEY (host))")
+    db.sql("CREATE TABLE rgt (host STRING, job STRING, ts TIMESTAMP(3), val DOUBLE, TIME INDEX (ts), PRIMARY KEY (host, job))")
+    db.sql("INSERT INTO lft VALUES ('x', 60000, 1), ('x', 900000, 2)")
+    # two right series share host=x; together they cover both steps
+    db.sql("INSERT INTO rgt VALUES ('x', 'j1', 60000, 1), ('x', 'j2', 900000, 1)")
+    t = db.sql_one(
+        "TQL EVAL (60, 900, '840s') last_over_time(lft[1m]) and on(host) last_over_time(rgt[1m])"
+    )
+    assert sorted(v for v in t["value"].to_pylist()) == [1.0, 2.0]  # both steps kept
+
+
+def test_tql_label_replace_braced_and_dollar(db):
+    t = db.sql_one(
+        "TQL EVAL (600, 600, '60s') label_replace(http_requests_total{host=\"a\"},"
+        " \"dc\", \"${1}x\", \"host\", \"(.*)\")"
+    )
+    assert t["dc"].to_pylist() == ["ax"]
+    t = db.sql_one(
+        "TQL EVAL (600, 600, '60s') label_replace(http_requests_total{host=\"a\"},"
+        " \"price\", \"$$5\", \"host\", \"(.*)\")"
+    )
+    assert t["price"].to_pylist() == ["$5"]
