@@ -1,0 +1,433 @@
+"""Structured log-search DSL (the /v1/logs JSON API).
+
+Role-equivalent of the reference's `log-query` crate + planner
+(reference log-query/src/log_query.rs types; query/src/log_query/planner.rs
+translates them to a DataFusion plan).  The JSON shape mirrors the
+reference's serde encoding: externally-tagged enums like
+`{"Single": {...}}`, `{"Contains": "error"}`, `{"NamedIdent": "level"}`.
+
+Evaluation runs on the Arrow tables from the region scan: time-filter
+pushdown into the scan, filter trees evaluated columnar with pyarrow
+kernels, then processing exprs (scalar funcs via the shared
+FUNCTION_REGISTRY, aggregation via pyarrow group_by), projection, and
+skip/fetch limits.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..utils.errors import InvalidArgumentsError, PlanError
+from .functions import call_function, has_function
+
+DEFAULT_FETCH = 1000
+
+
+@dataclass
+class TimeFilter:
+    """start/end/span strings -> [start_ms, end_ms) (reference
+    log_query.rs TimeFilter::canonicalize)."""
+
+    start: str | None = None
+    end: str | None = None
+    span: str | None = None
+
+    def canonicalize(self, now_ms: int | None = None) -> tuple[int, int]:
+        import datetime as dt
+
+        start = parse_datetime(self.start) if self.start else None
+        end = parse_datetime(self.end) if self.end else None
+        if start and end:
+            lo = start[0]
+            # end as a date means "end of that period" (exclusive upper bound)
+            hi = end[0] if _is_timestamp(self.end) else end[1]
+        elif start and self.span:
+            lo = start[0]
+            hi = lo + parse_span_ms(self.span)
+        elif end and self.span:
+            hi = end[0] if _is_timestamp(self.end) else end[1]
+            lo = hi - parse_span_ms(self.span)
+        elif start:
+            # a vague date covers its whole range ("2024-12-01" = that day)
+            lo, hi = start
+            if _is_timestamp(self.start):
+                raise InvalidArgumentsError(
+                    "log query: time_filter with only start must be a date, not a timestamp"
+                )
+        elif self.span:
+            if now_ms is None:
+                now_ms = int(dt.datetime.now(dt.timezone.utc).timestamp() * 1000)
+            hi = now_ms
+            lo = hi - parse_span_ms(self.span)
+        elif end:
+            raise InvalidArgumentsError(
+                "log query: time_filter with only `end` is ambiguous; add `start` or `span`"
+            )
+        else:
+            raise InvalidArgumentsError("log query: time_filter requires start, end+span, or span")
+        if hi <= lo:
+            raise InvalidArgumentsError(f"log query: end ({hi}) must be after start ({lo})")
+        return lo, hi
+
+    @classmethod
+    def from_json(cls, d: dict | None) -> "TimeFilter":
+        d = d or {}
+        return cls(start=d.get("start"), end=d.get("end"), span=d.get("span"))
+
+
+def _is_timestamp(s: str) -> bool:
+    return "T" in s or " " in s.strip() or ":" in s
+
+
+def parse_datetime(s: str) -> tuple[int, int]:
+    """Date or timestamp string -> (start_ms, end_ms_exclusive) of the
+    instant/period it denotes ("2024" = the year, "2024-12-01" = the day)."""
+    import datetime as dt
+
+    s = s.strip()
+    utc = dt.timezone.utc
+    m = re.fullmatch(r"(\d{4})", s)
+    if m:
+        y = int(m.group(1))
+        return (
+            int(dt.datetime(y, 1, 1, tzinfo=utc).timestamp() * 1000),
+            int(dt.datetime(y + 1, 1, 1, tzinfo=utc).timestamp() * 1000),
+        )
+    m = re.fullmatch(r"(\d{4})-(\d{2})", s)
+    if m:
+        y, mo = int(m.group(1)), int(m.group(2))
+        nxt = (y + 1, 1) if mo == 12 else (y, mo + 1)
+        return (
+            int(dt.datetime(y, mo, 1, tzinfo=utc).timestamp() * 1000),
+            int(dt.datetime(nxt[0], nxt[1], 1, tzinfo=utc).timestamp() * 1000),
+        )
+    m = re.fullmatch(r"(\d{4})-(\d{2})-(\d{2})", s)
+    if m:
+        d0 = dt.datetime(int(m.group(1)), int(m.group(2)), int(m.group(3)), tzinfo=utc)
+        return (
+            int(d0.timestamp() * 1000),
+            int((d0 + dt.timedelta(days=1)).timestamp() * 1000),
+        )
+    # full timestamp (RFC3339-ish)
+    try:
+        t = dt.datetime.fromisoformat(s.replace("Z", "+00:00"))
+    except ValueError as e:
+        raise InvalidArgumentsError(f"log query: bad datetime {s!r}: {e}") from None
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=utc)
+    ms = int(t.timestamp() * 1000)
+    return ms, ms
+
+
+_SPAN_UNITS = {
+    "ms": 1,
+    "s": 1000, "sec": 1000, "second": 1000, "seconds": 1000,
+    "m": 60_000, "min": 60_000, "minute": 60_000, "minutes": 60_000,
+    "h": 3_600_000, "hour": 3_600_000, "hours": 3_600_000,
+    "d": 86_400_000, "day": 86_400_000, "days": 86_400_000,
+    "w": 604_800_000, "week": 604_800_000, "weeks": 604_800_000,
+    "month": 2_592_000_000, "months": 2_592_000_000,
+    "y": 31_536_000_000, "year": 31_536_000_000, "years": 31_536_000_000,
+}
+
+
+def parse_span_ms(s: str) -> int:
+    m = re.fullmatch(r"\s*(\d+(?:\.\d+)?)\s*([a-zA-Z]+)\s*", s)
+    if not m or m.group(2).lower() not in _SPAN_UNITS:
+        raise InvalidArgumentsError(f"log query: bad span {s!r}")
+    return int(float(m.group(1)) * _SPAN_UNITS[m.group(2).lower()])
+
+
+@dataclass
+class LogQuery:
+    table: str
+    database: str | None
+    time_filter: TimeFilter
+    filters: dict | None = None  # Filters tree, serde-tagged JSON
+    columns: list[str] = field(default_factory=list)
+    skip: int = 0
+    fetch: int = DEFAULT_FETCH
+    exprs: list = field(default_factory=list)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "LogQuery":
+        table = d.get("table")
+        database = None
+        if isinstance(table, dict):
+            database = table.get("schema_name") or None
+            table = table.get("table_name")
+        if not table:
+            raise InvalidArgumentsError("log query: missing table")
+        limit = d.get("limit") or {}
+        fetch = limit.get("fetch")
+        return cls(
+            table=table,
+            database=database,
+            time_filter=TimeFilter.from_json(d.get("time_filter")),
+            filters=d.get("filters"),
+            columns=list(d.get("columns") or []),
+            skip=int(limit.get("skip") or 0),
+            fetch=DEFAULT_FETCH if fetch is None else int(fetch),
+            exprs=list(d.get("exprs") or []),
+        )
+
+
+# ---------------------------------------------------------------------------
+# evaluation
+# ---------------------------------------------------------------------------
+
+
+def _expr_column(expr, table: pa.Table) -> pa.Array:
+    """LogExpr (serde-tagged) -> Arrow array over `table`."""
+    if isinstance(expr, str):  # tolerated shorthand for NamedIdent
+        expr = {"NamedIdent": expr}
+    if not isinstance(expr, dict) or len(expr) != 1:
+        raise PlanError(f"log query: bad expr {expr!r}")
+    (kind, val), = expr.items()
+    if kind == "NamedIdent":
+        if val not in table.column_names:
+            raise PlanError(f"log query: unknown column {val!r}")
+        col = table[val]
+        col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+        if pa.types.is_dictionary(col.type):
+            col = pc.cast(col, col.type.value_type)
+        return col
+    if kind == "PositionalIdent":
+        return _expr_column({"NamedIdent": table.column_names[int(val)]}, table)
+    if kind == "Literal":
+        return pa.array([val] * table.num_rows)
+    if kind == "ScalarFunc":
+        name = val["name"].lower()
+        if not has_function(name):
+            raise PlanError(f"log query: unknown function {name!r}")
+        args = [_expr_column(a, table) for a in val.get("args", [])]
+        out = call_function(name, args)
+        if isinstance(out, pa.Scalar):
+            out = pa.array([out.as_py()] * table.num_rows)
+        return out
+    if kind == "BinaryOp":
+        left = _expr_column(val["left"], table)
+        right = _expr_column(val["right"], table)
+        op = val["op"]
+        fn = {
+            "Add": pc.add, "Sub": pc.subtract, "Mul": pc.multiply, "Div": pc.divide,
+            "Eq": pc.equal, "Ne": pc.not_equal,
+            "Lt": pc.less, "Le": pc.less_equal, "Gt": pc.greater, "Ge": pc.greater_equal,
+        }.get(op)
+        if fn is None:
+            raise PlanError(f"log query: unknown binary op {op!r}")
+        return fn(left, right)
+    if kind == "Alias":
+        return _expr_column(val["expr"], table)
+    raise PlanError(f"log query: unsupported expr kind {kind!r}")
+
+
+def _expr_name(expr, table: pa.Table) -> str:
+    if isinstance(expr, str):
+        return expr
+    (kind, val), = expr.items()
+    if kind == "NamedIdent":
+        return val
+    if kind == "PositionalIdent":
+        return table.column_names[int(val)]
+    if kind == "Alias":
+        return val["alias"]
+    if kind == "ScalarFunc":
+        return val.get("alias") or val["name"]
+    return kind.lower()
+
+
+def _content_filter_mask(f, col: pa.Array) -> np.ndarray:
+    """One ContentFilter -> boolean row mask (reference ContentFilter)."""
+    if isinstance(f, str):  # unit variants serialize as bare strings
+        f = {f: None}
+    (kind, val), = f.items()
+    n = len(col)
+    str_col = col if pa.types.is_string(col.type) else pc.cast(col, pa.string())
+    if kind == "Exact":
+        return np.asarray(pc.equal(str_col, val).fill_null(False))
+    if kind == "Prefix":
+        return np.asarray(pc.starts_with(str_col, val).fill_null(False))
+    if kind == "Postfix":
+        return np.asarray(pc.ends_with(str_col, val).fill_null(False))
+    if kind == "Contains":
+        return np.asarray(pc.match_substring(str_col, val).fill_null(False))
+    if kind == "Regex":
+        return np.asarray(pc.match_substring_regex(str_col, val).fill_null(False))
+    if kind == "Exist":
+        return ~np.asarray(pc.is_null(col))
+    if kind == "IsTrue":
+        return np.asarray(pc.cast(col, pa.bool_()).fill_null(False))
+    if kind == "IsFalse":
+        return np.asarray(pc.invert(pc.cast(col, pa.bool_())).fill_null(False))
+    if kind == "In":
+        return np.asarray(pc.is_in(str_col, value_set=pa.array([str(v) for v in val])).fill_null(False))
+    if kind == "Equal":
+        (_, ev), = val.items() if isinstance(val, dict) else (("String", val),)
+        try:
+            typed = pc.cast(pa.scalar(ev), col.type)
+            return np.asarray(pc.equal(col, typed).fill_null(False))
+        except pa.ArrowInvalid:
+            return np.asarray(pc.equal(str_col, str(ev)).fill_null(False))
+    if kind in ("GreatThan", "LessThan"):
+        value, inclusive = val["value"], bool(val.get("inclusive"))
+        num = pc.cast(col, pa.float64()) if not pa.types.is_timestamp(col.type) else pc.cast(col, pa.int64())
+        v = float(value)
+        if kind == "GreatThan":
+            cmpf = pc.greater_equal if inclusive else pc.greater
+        else:
+            cmpf = pc.less_equal if inclusive else pc.less
+        return np.asarray(cmpf(num, v).fill_null(False))
+    if kind == "Between":
+        num = pc.cast(col, pa.float64())
+        lo, hi = float(val["start"]), float(val["end"])
+        lom = pc.greater_equal(num, lo) if val.get("start_inclusive", True) else pc.greater(num, lo)
+        him = pc.less_equal(num, hi) if val.get("end_inclusive", True) else pc.less(num, hi)
+        return np.asarray(pc.and_(lom, him).fill_null(False))
+    if kind == "Compound":
+        parts, conj = val
+        masks = [_content_filter_mask(p, col) for p in parts]
+        out = masks[0]
+        for m in masks[1:]:
+            out = (out & m) if conj == "And" else (out | m)
+        return out
+    raise PlanError(f"log query: unsupported content filter {kind!r}")
+
+
+def _filters_mask(tree, table: pa.Table) -> np.ndarray:
+    """Filters tree (Single/And/Or/Not) -> row mask."""
+    n = table.num_rows
+    if tree is None:
+        return np.ones(n, dtype=bool)
+    if isinstance(tree, dict) and len(tree) == 1:
+        (kind, val), = tree.items()
+        if kind == "Single":
+            col = _expr_column(val["expr"], table)
+            mask = np.ones(n, dtype=bool)
+            for f in val.get("filters", []):
+                mask &= _content_filter_mask(f, col)
+            return mask
+        if kind == "And":
+            mask = np.ones(n, dtype=bool)
+            for sub in val:
+                mask &= _filters_mask(sub, table)
+            return mask
+        if kind == "Or":
+            if not val:
+                return np.ones(n, dtype=bool)
+            mask = np.zeros(n, dtype=bool)
+            for sub in val:
+                mask |= _filters_mask(sub, table)
+            return mask
+        if kind == "Not":
+            return ~_filters_mask(val, table)
+    raise PlanError(f"log query: bad filters node {tree!r}")
+
+
+_AGG_MAP = {
+    "count": "count", "sum": "sum", "min": "min", "max": "max",
+    "avg": "mean", "mean": "mean",
+}
+
+
+def execute_log_query(db, query: LogQuery) -> pa.Table:
+    """Run one LogQuery against the database facade."""
+    from .logical_plan import TableScan
+
+    database = query.database or db.current_database
+    meta = db.catalog.table(query.table, database)
+    schema = meta.schema
+    ts_col = schema.time_index.name if schema.time_index else None
+    lo, hi = query.time_filter.canonicalize()
+    time_range = None
+    if ts_col:
+        # TableScan.time_range is in the column's NATIVE unit: ms bounds
+        # scale by 1e6/unit_ns (×1000 for us, ×1e6 for ns, ÷1000 for s).
+        unit_ns = schema.time_index.data_type.timestamp_unit_ns()
+        time_range = (lo * 1_000_000 // unit_ns, -(-hi * 1_000_000 // unit_ns))
+
+    scan = TableScan(
+        table=query.table,
+        database=database,
+        filters=[],
+        time_range=time_range,
+    )
+    tables = [t for t in db._region_scan(scan) if t.num_rows]
+    if tables:
+        table = pa.concat_tables(tables, promote_options="permissive")
+    else:
+        table = schema.to_arrow().empty_table()
+
+    mask = _filters_mask(query.filters, table)
+    if not mask.all():
+        table = table.filter(pa.array(mask))
+
+    # newest-first, the log-browsing order
+    if ts_col and table.num_rows:
+        table = table.take(pc.sort_indices(table, sort_keys=[(ts_col, "descending")]))
+
+    # processing exprs: scalar projections and (optionally) one aggregation
+    for expr in query.exprs:
+        if isinstance(expr, dict) and "AggrFunc" in expr:
+            table = _apply_aggr(expr["AggrFunc"], table)
+        else:
+            name = _expr_name(expr, table)
+            arr = _expr_column(expr, table)
+            if name in table.column_names:
+                table = table.set_column(table.schema.get_field_index(name), name, arr)
+            else:
+                table = table.append_column(name, arr)
+
+    if query.columns:
+        missing = [c for c in query.columns if c not in table.column_names]
+        if missing:
+            raise PlanError(f"log query: unknown columns {missing}")
+        table = table.select(query.columns)
+
+    if query.skip:
+        table = table.slice(min(query.skip, table.num_rows))
+    if query.fetch >= 0:
+        table = table.slice(0, query.fetch)
+    return table
+
+
+def _apply_aggr(spec: dict, table: pa.Table) -> pa.Table:
+    """AggrFunc {expr: [AggFunc...], by: [LogExpr...]} via pyarrow group_by."""
+    by_names = []
+    for b in spec.get("by", []):
+        name = _expr_name(b, table)
+        if name not in table.column_names:
+            table = table.append_column(name, _expr_column(b, table))
+        by_names.append(name)
+    aggs = []
+    out_names = []
+    for af in spec.get("expr", []):
+        fn = _AGG_MAP.get(af["name"].lower())
+        if fn is None:
+            raise PlanError(f"log query: unsupported aggregation {af['name']!r}")
+        args = af.get("args", [])
+        argname = _expr_name(args[0], table) if args else table.column_names[0]
+        if argname not in table.column_names:
+            table = table.append_column(argname, _expr_column(args[0], table))
+        col = table[argname]
+        if pa.types.is_dictionary(col.type if not isinstance(col, pa.ChunkedArray) else col.type):
+            table = table.set_column(
+                table.schema.get_field_index(argname), argname,
+                pc.cast(table[argname], table.schema.field(argname).type.value_type),
+            )
+        aggs.append((argname, fn))
+        out_names.append(af.get("alias") or f"{af['name'].lower()}({argname})")
+    if not by_names:
+        cols = {}
+        for (argname, fn), out in zip(aggs, out_names):
+            fmap = {"count": pc.count, "sum": pc.sum, "min": pc.min, "max": pc.max, "mean": pc.mean}
+            cols[out] = [fmap[fn](table[argname].combine_chunks()).as_py()]
+        return pa.table(cols)
+    result = table.group_by(by_names, use_threads=False).aggregate(aggs)
+    rename = {f"{argname}_{fn}": out for (argname, fn), out in zip(aggs, out_names)}
+    return result.rename_columns([rename.get(n, n) for n in result.column_names])

@@ -665,13 +665,18 @@ class PromqlEngine:
             else:
                 regex_matchers.append(mt)
 
-        unit_ms = schema.time_index.data_type.timestamp_unit_ns() // 1_000_000
+        # ms bounds -> the column's NATIVE unit: scale by 1e6/unit_ns
+        # (×1000 for us, ×1e6 for ns, ÷1000 for s columns).
+        unit_ns = schema.time_index.data_type.timestamp_unit_ns()
         offset = sel.offset_ms
         scan = TableScan(
             table=sel.metric,
             database=self.db.current_database,
             filters=filters,
-            time_range=((t_lo - offset) // max(unit_ms, 1), (t_hi - offset) // max(unit_ms, 1) + 1),
+            time_range=(
+                (t_lo - offset) * 1_000_000 // unit_ns,
+                (t_hi - offset) * 1_000_000 // unit_ns + 1,
+            ),
         )
         tables = [t for t in self.db._region_scan(scan) if t.num_rows]
         if not tables:
@@ -691,7 +696,8 @@ class PromqlEngine:
             if table.num_rows == 0:
                 return np.zeros(0, np.int32), np.zeros(0, np.int64), np.zeros(0), tags, [], 0
 
-        ts = np.asarray(pc.cast(table[ts_col], pa.int64())) * max(unit_ms, 1) + offset
+        # native unit -> ms (floor division is exact for s/ms; truncates us/ns)
+        ts = np.asarray(pc.cast(table[ts_col], pa.int64())) * unit_ns // 1_000_000 + offset
         values = np.asarray(pc.cast(table[value_col], pa.float64()))
         if tags:
             cols = []

@@ -126,6 +126,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(200, dataclasses.asdict(self.db.config))
             if route == "/v1/sql":
                 return self._handle_sql(params)
+            if route == "/v1/logs":
+                return self._handle_logs(params)
             if route == "/v1/influxdb/write" or route == "/v1/influxdb/api/v2/write":
                 return self._handle_influx(params)
             if route.startswith("/v1/prometheus/api/v1/") or route.startswith("/api/v1/"):
@@ -249,6 +251,28 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 outputs.append(_table_to_greptime_json(result))
         return self._send(200, {"output": outputs, "execution_time_ms": 0})
+
+    def _handle_logs(self, params):
+        """Structured log search (reference /v1/logs, log-query crate DSL)."""
+        from ..query.log_query import LogQuery, execute_log_query
+        from ..utils import kernel_executor
+
+        body = params.get("__body") or b"{}"
+        try:
+            payload = json.loads(body.decode())
+        except ValueError as e:
+            return self._send(400, {"error": f"bad log query JSON: {e}"})
+        if not isinstance(payload, dict):
+            return self._send(400, {"error": "log query body must be a JSON object"})
+        query = LogQuery.from_json(payload)
+        if params.get("db") and not query.database:
+            # per-query database, NOT the shared session default: concurrent
+            # requests on other threads must not see this request's db
+            query.database = params["db"]
+        table = kernel_executor.run(lambda: execute_log_query(self.db, query))
+        return self._send(
+            200, {"output": [_table_to_greptime_json(table)], "execution_time_ms": 0}
+        )
 
     def _handle_influx(self, params):
         body = (params.get("__body") or b"").decode()

@@ -1,0 +1,272 @@
+"""Log query DSL tests (reference log-query crate + /v1/logs endpoint).
+
+The JSON shapes mirror the reference's serde encoding of LogQuery /
+Filters / ContentFilter / LogExpr (reference log-query/src/log_query.rs).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.query.log_query import (
+    LogQuery,
+    TimeFilter,
+    execute_log_query,
+    parse_datetime,
+    parse_span_ms,
+)
+from greptimedb_tpu.utils.errors import InvalidArgumentsError, PlanError
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(data_home=str(tmp_path))
+    d.sql(
+        "CREATE TABLE app_logs (host STRING, level STRING, ts TIMESTAMP(3),"
+        " message STRING, latency DOUBLE, TIME INDEX (ts), PRIMARY KEY (host))"
+    )
+    rows = []
+    base = 1_700_000_000_000  # 2023-11-14T22:13:20Z
+    levels = ["INFO", "WARN", "ERROR"]
+    for i in range(60):
+        lvl = levels[i % 3]
+        rows.append(
+            f"('h{i % 2}', '{lvl}', {base + i * 1000},"
+            f" 'request {i} took too long' , {float(i)})"
+        )
+    d.sql(f"INSERT INTO app_logs VALUES {', '.join(rows)}")
+    yield d
+    d.close()
+
+
+def _tf(start_off=0, end_off=60_000):
+    base = 1_700_000_000_000
+    import datetime as dt
+
+    fmt = lambda ms: dt.datetime.fromtimestamp(ms / 1000, dt.timezone.utc).isoformat()
+    return {"start": fmt(base + start_off), "end": fmt(base + end_off)}
+
+
+def test_time_filter_parsing():
+    lo, hi = TimeFilter(start="2024-12-01").canonicalize()
+    assert hi - lo == 86_400_000
+    lo2, hi2 = TimeFilter(start="2024-12").canonicalize()
+    assert (hi2 - lo2) == 31 * 86_400_000
+    lo3, hi3 = TimeFilter(start="2024-01-01T00:00:00Z", span="2 hours").canonicalize()
+    assert hi3 - lo3 == 7_200_000
+    lo4, hi4 = TimeFilter(span="1h").canonicalize(now_ms=1_700_000_000_000)
+    assert (lo4, hi4) == (1_700_000_000_000 - 3_600_000, 1_700_000_000_000)
+    with pytest.raises(InvalidArgumentsError):
+        TimeFilter().canonicalize()
+    with pytest.raises(InvalidArgumentsError):
+        TimeFilter(start="2024-01-02", end="2024-01-01").canonicalize()
+    assert parse_span_ms("1 week") == 604_800_000
+    assert parse_datetime("2024")[1] - parse_datetime("2024")[0] == 366 * 86_400_000
+
+
+def test_filters_and_projection(db):
+    q = LogQuery.from_json(
+        {
+            "table": "app_logs",
+            "time_filter": _tf(),
+            "columns": ["ts", "level", "message"],
+            "filters": {
+                "Single": {
+                    "expr": {"NamedIdent": "level"},
+                    "filters": [{"Exact": "ERROR"}],
+                }
+            },
+            "limit": {"fetch": 100},
+        }
+    )
+    t = execute_log_query(db, q)
+    assert t.column_names == ["ts", "level", "message"]
+    assert t.num_rows == 20
+    assert set(t["level"].to_pylist()) == {"ERROR"}
+    # newest-first ordering
+    ts = t["ts"].to_pylist()
+    assert ts == sorted(ts, reverse=True)
+
+
+def test_filters_tree_and_content_kinds(db):
+    q = LogQuery.from_json(
+        {
+            "table": {"catalog_name": "greptime", "schema_name": "public", "table_name": "app_logs"},
+            "time_filter": _tf(),
+            "filters": {
+                "And": [
+                    {"Single": {"expr": {"NamedIdent": "message"}, "filters": [{"Contains": "took"}]}},
+                    {
+                        "Or": [
+                            {"Single": {"expr": {"NamedIdent": "level"}, "filters": [{"Prefix": "ERR"}]}},
+                            {"Single": {"expr": {"NamedIdent": "level"}, "filters": [{"Exact": "WARN"}]}},
+                        ]
+                    },
+                    {"Not": {"Single": {"expr": {"NamedIdent": "host"}, "filters": [{"Exact": "h0"}]}}},
+                ]
+            },
+        }
+    )
+    t = execute_log_query(db, q)
+    assert set(t["level"].to_pylist()) <= {"ERROR", "WARN"}
+    assert set(t["host"].to_pylist()) == {"h1"}
+
+
+def test_numeric_and_regex_filters(db):
+    q = LogQuery.from_json(
+        {
+            "table": "app_logs",
+            "time_filter": _tf(),
+            "filters": {
+                "And": [
+                    {"Single": {"expr": {"NamedIdent": "latency"}, "filters": [
+                        {"GreatThan": {"value": "50", "inclusive": True}}]}},
+                    {"Single": {"expr": {"NamedIdent": "message"}, "filters": [
+                        {"Regex": "request 5[0-9]"}]}},
+                ]
+            },
+        }
+    )
+    t = execute_log_query(db, q)
+    assert t.num_rows == 10  # latency 50..59
+    q2 = LogQuery.from_json(
+        {
+            "table": "app_logs",
+            "time_filter": _tf(),
+            "filters": {"Single": {"expr": {"NamedIdent": "latency"}, "filters": [
+                {"Between": {"start": "10", "end": "12", "start_inclusive": True, "end_inclusive": True}}]}},
+        }
+    )
+    assert execute_log_query(db, q2).num_rows == 3
+
+
+def test_skip_fetch_and_exprs(db):
+    q = LogQuery.from_json(
+        {
+            "table": "app_logs",
+            "time_filter": _tf(),
+            "columns": ["ts", "latency_x2"],
+            "exprs": [
+                {"Alias": {"expr": {"BinaryOp": {
+                    "left": {"NamedIdent": "latency"}, "op": "Mul",
+                    "right": {"Literal": 2}}}, "alias": "latency_x2"}}
+            ],
+            "limit": {"skip": 5, "fetch": 10},
+        }
+    )
+    t = execute_log_query(db, q)
+    assert t.num_rows == 10
+    # newest-first: latencies 59..0; skip 5 -> starts at 54
+    np.testing.assert_allclose(t["latency_x2"].to_pylist()[0], 108.0)
+
+
+def test_aggr_func(db):
+    q = LogQuery.from_json(
+        {
+            "table": "app_logs",
+            "time_filter": _tf(),
+            "exprs": [
+                {"AggrFunc": {
+                    "expr": [{"name": "count", "args": [{"NamedIdent": "message"}], "alias": "n"}],
+                    "by": [{"NamedIdent": "level"}],
+                }}
+            ],
+        }
+    )
+    t = execute_log_query(db, q)
+    counts = dict(zip(t["level"].to_pylist(), t["n"].to_pylist()))
+    assert counts == {"INFO": 20, "WARN": 20, "ERROR": 20}
+
+
+def test_bad_inputs(db):
+    with pytest.raises(InvalidArgumentsError):
+        LogQuery.from_json({"time_filter": _tf()})
+    q = LogQuery.from_json({"table": "app_logs", "time_filter": _tf(), "columns": ["nope"]})
+    with pytest.raises(PlanError, match="unknown columns"):
+        execute_log_query(db, q)
+
+
+def test_http_v1_logs_endpoint(db):
+    from greptimedb_tpu.servers.http import HttpServer
+
+    srv = HttpServer(db, "127.0.0.1:0").start()
+    try:
+        payload = {
+            "table": "app_logs",
+            "time_filter": _tf(),
+            "columns": ["ts", "level"],
+            "filters": {"Single": {"expr": {"NamedIdent": "level"}, "filters": [{"Exact": "WARN"}]}},
+            "limit": {"fetch": 5},
+        }
+        req = urllib.request.Request(
+            f"http://{srv.address}/v1/logs",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            out = json.load(r)
+        records = out["output"][0]["records"]
+        assert [c["name"] for c in records["schema"]["column_schemas"]] == ["ts", "level"]
+        assert len(records["rows"]) == 5
+        assert all(row[1] == "WARN" for row in records["rows"])
+    finally:
+        srv.stop()
+
+
+def test_microsecond_time_index_pushdown(tmp_path):
+    """ms query bounds must scale to the column's native unit (a us table
+    used to scan a 1970 window and silently return nothing)."""
+    d = Database(data_home=str(tmp_path))
+    d.sql("CREATE TABLE us_logs (ts TIMESTAMP(6), msg STRING, TIME INDEX (ts))")
+    base_us = 1_700_000_000_000_000  # microseconds
+    rows = ", ".join(f"({base_us + i * 1_000_000}, 'm{i}')" for i in range(10))
+    d.sql(f"INSERT INTO us_logs VALUES {rows}")
+    q = LogQuery.from_json(
+        {
+            "table": "us_logs",
+            "time_filter": {
+                "start": "2023-11-14T22:13:20Z",
+                "span": "20s",
+            },
+        }
+    )
+    t = execute_log_query(d, q)
+    assert t.num_rows == 10
+    d.close()
+
+
+def test_promql_microsecond_time_index(tmp_path):
+    d = Database(data_home=str(tmp_path))
+    d.sql("CREATE TABLE us_metric (ts TIMESTAMP(6), val DOUBLE, TIME INDEX (ts))")
+    rows = ", ".join(f"({i * 10_000_000}, {i * 10.0})" for i in range(61))  # 10s steps in us
+    d.sql(f"INSERT INTO us_metric VALUES {rows}")
+    t = d.sql_one("TQL EVAL (600, 600, '60s') rate(us_metric[1m])")
+    np.testing.assert_allclose(t["value"].to_pylist(), 1.0, rtol=1e-6)
+    d.close()
+
+
+def test_end_only_time_filter_rejected():
+    with pytest.raises(InvalidArgumentsError, match="only `end`"):
+        TimeFilter(end="2024-12-01").canonicalize()
+
+
+def test_http_v1_logs_bad_body(db):
+    from greptimedb_tpu.servers.http import HttpServer
+
+    srv = HttpServer(db, "127.0.0.1:0").start()
+    try:
+        req = urllib.request.Request(
+            f"http://{srv.address}/v1/logs", data=b"[1,2,3]",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        srv.stop()
