@@ -25,15 +25,19 @@ from .query.expr import Column
 from .query.sql_parser import (
     AdminStmt,
     AlterTableStmt,
+    CloseCursorStmt,
     CopyStmt,
     CreateDatabaseStmt,
     CreateFlowStmt,
     CreateTableStmt,
+    DeclareCursorStmt,
     DeleteStmt,
     DescribeStmt,
     DropStmt,
     ExplainStmt,
+    FetchCursorStmt,
     InsertStmt,
+    KillStmt,
     SelectStmt,
     SetStmt,
     ShowStmt,
@@ -86,6 +90,11 @@ class Database:
         # across connections sharing this Database.
         self._default_database = DEFAULT_SCHEMA
         self._session = threading.local()
+        from .models.process import ProcessManager
+
+        # Running-query registry behind information_schema.process_list and
+        # KILL (reference catalog/src/process_manager.rs:43).
+        self.process_manager = ProcessManager()
         self.query_engine = QueryEngine(
             schema_provider=self._schema_of,
             scan_provider=self._scan,
@@ -113,7 +122,7 @@ class Database:
         queries, int affected-rows for writes, None for DDL)."""
         results = []
         for stmt in parse_sql(text):
-            results.append(self._execute(stmt))
+            results.append(self._execute(stmt, query_text=text))
         return results
 
     def sql_one(self, text: str):
@@ -121,9 +130,10 @@ class Database:
         return out[-1] if out else None
 
     # ---- dispatch (reference StatementExecutor::execute_stmt) -------------
-    def _execute(self, stmt):
+    def _execute(self, stmt, query_text: str | None = None):
         if isinstance(stmt, SelectStmt):
-            return self.query_engine.execute_select(stmt, self.current_database)
+            with self.process_manager.track(self.current_database, query_text or "SELECT ..."):
+                return self.query_engine.execute_select(stmt, self.current_database)
         if isinstance(stmt, CreateTableStmt):
             return self._create_table(stmt)
         if isinstance(stmt, CreateDatabaseStmt):
@@ -156,7 +166,35 @@ class Database:
         if isinstance(stmt, AdminStmt):
             return self._admin(stmt)
         if isinstance(stmt, TqlStmt):
-            return self._tql(stmt)
+            with self.process_manager.track(self.current_database, query_text or "TQL ..."):
+                return self._tql(stmt)
+        if isinstance(stmt, DeclareCursorStmt):
+            cursors = self._session_cursors()
+            if stmt.name in cursors:
+                raise InvalidArgumentsError(f"cursor {stmt.name!r} already open")
+            result = self._execute(stmt.select, query_text=query_text)
+            cursors[stmt.name] = [result, 0]  # (materialized table, position)
+            return None
+        if isinstance(stmt, FetchCursorStmt):
+            cursors = self._session_cursors()
+            if stmt.name not in cursors:
+                raise InvalidArgumentsError(f"cursor {stmt.name!r} is not open")
+            table, pos = cursors[stmt.name]
+            if stmt.count < 0:  # FETCH ALL
+                out = table.slice(pos)
+                cursors[stmt.name][1] = table.num_rows
+            else:
+                out = table.slice(pos, stmt.count)
+                cursors[stmt.name][1] = min(pos + stmt.count, table.num_rows)
+            return out
+        if isinstance(stmt, CloseCursorStmt):
+            cursors = self._session_cursors()
+            if cursors.pop(stmt.name, None) is None:
+                raise InvalidArgumentsError(f"cursor {stmt.name!r} is not open")
+            return None
+        if isinstance(stmt, KillStmt):
+            self.process_manager.kill(stmt.process_id)
+            return None
         if isinstance(stmt, DeleteStmt):
             return self._delete(stmt)
         if isinstance(stmt, AlterTableStmt):
@@ -169,10 +207,11 @@ class Database:
             return None  # accepted client-bootstrap no-ops
         raise UnsupportedError(f"unsupported statement: {type(stmt).__name__}")
 
-    def execute_stmt(self, stmt):
+    def execute_stmt(self, stmt, query_text: str | None = None):
         """Execute one parsed statement (protocol servers dispatch per
-        statement to derive wire-level command tags)."""
-        return self._execute(stmt)
+        statement to derive wire-level command tags; pass the original SQL
+        so process_list shows real query text)."""
+        return self._execute(stmt, query_text=query_text)
 
     # ---- DDL --------------------------------------------------------------
     def _create_table(self, stmt: CreateTableStmt):
@@ -723,9 +762,18 @@ class Database:
             time_range=scan.time_range, filters=[tuple(f) for f in scan.filters]
         )
 
+    def _session_cursors(self) -> dict:
+        """Per-thread (per-connection) open cursors, like the reference's
+        per-session cursor map (session QueryContext)."""
+        cursors = getattr(self._session, "cursors", None)
+        if cursors is None:
+            cursors = self._session.cursors = {}
+        return cursors
+
     def _region_scan(self, scan: TableScan) -> list[pa.Table]:
         from .models import information_schema as info
 
+        self.process_manager.check_cancelled()  # KILL cancellation point
         if info.is_information_schema(scan.database):
             return [info.build(self, scan.table)]
         meta = self.catalog.table(scan.table, scan.database)
@@ -736,7 +784,11 @@ class Database:
         if fe.is_external_meta(meta):
             return [fe.scan(meta, self._pred_of(scan))]
         pred = self._pred_of(scan)
-        return [self.storage.scan(rid, pred) for rid in meta.region_ids]
+        out = []
+        for rid in meta.region_ids:
+            out.append(self.storage.scan(rid, pred))
+            self.process_manager.check_cancelled()  # between-region point
+        return out
 
     def _scan(self, scan: TableScan) -> pa.Table:
         from .models import information_schema as info

@@ -160,12 +160,34 @@ def _flows(db) -> pa.Table:
     )
 
 
+def _process_list(db) -> pa.Table:
+    """information_schema.process_list (reference
+    catalog/src/system_schema/information_schema/process_list.rs)."""
+    procs = db.process_manager.list() if hasattr(db, "process_manager") else []
+    addr = db.process_manager.server_addr if procs else "standalone"
+    return pa.table(
+        {
+            "id": pa.array([f"{addr}/{p.process_id}" for p in procs], pa.string()),
+            "catalog": pa.array(["greptime" for _ in procs], pa.string()),
+            "schemas": pa.array([p.database for p in procs], pa.string()),
+            "query": pa.array([p.query for p in procs], pa.string()),
+            "client": pa.array([p.client for p in procs], pa.string()),
+            "frontend": pa.array([addr for _ in procs], pa.string()),
+            "start_timestamp": pa.array(
+                [p.start_time_ms for p in procs], pa.timestamp("ms")
+            ),
+            "elapsed_time": pa.array([p.elapsed_ms() for p in procs], pa.int64()),
+        }
+    )
+
+
 _TABLES = {
     "tables": _tables,
     "columns": _columns,
     "region_statistics": _region_statistics,
     "engines": _engines,
     "cluster_info": _cluster_info,
+    "process_list": _process_list,
     "schemata": _schemata,
     "partitions": _partitions,
     "flows": _flows,

@@ -1,0 +1,102 @@
+"""Query process tracking and cancellation.
+
+Role-equivalent of the reference's `ProcessManager`
+(reference catalog/src/process_manager.rs:43): every running query is
+registered with an id, query text, and start time; `information_schema.
+process_list` exposes them; `KILL <id>` flags the process, and the scan
+loop raises `QueryCancelledError` at its next cancellation point.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..utils.errors import GreptimeError, InvalidArgumentsError, StatusCode
+
+
+class QueryCancelledError(GreptimeError):
+    code = StatusCode.CANCELLED
+
+
+@dataclass
+class Process:
+    process_id: int
+    database: str
+    query: str
+    start_time_ms: int
+    client: str = "local"
+    cancelled: threading.Event = field(default_factory=threading.Event)
+
+    def elapsed_ms(self, now: float | None = None) -> int:
+        return int((now or time.time()) * 1000) - self.start_time_ms
+
+
+class ProcessManager:
+    """Thread-safe registry of in-flight queries (one per execute call)."""
+
+    def __init__(self, server_addr: str = "standalone"):
+        self.server_addr = server_addr
+        self._lock = threading.Lock()
+        self._next_id = 1
+        self._processes: dict[int, Process] = {}
+        # the process currently executing on THIS thread (cancellation point
+        # checks consult it without plumbing tickets through every layer)
+        self._current = threading.local()
+
+    def register(self, database: str, query: str, client: str = "local") -> Process:
+        with self._lock:
+            pid = self._next_id
+            self._next_id += 1
+            proc = Process(
+                process_id=pid,
+                database=database,
+                query=query,
+                start_time_ms=int(time.time() * 1000),
+                client=client,
+            )
+            self._processes[pid] = proc
+        self._current.proc = proc
+        return proc
+
+    def deregister(self, proc: Process):
+        with self._lock:
+            self._processes.pop(proc.process_id, None)
+        if getattr(self._current, "proc", None) is proc:
+            self._current.proc = None
+
+    def list(self) -> list[Process]:
+        with self._lock:
+            return sorted(self._processes.values(), key=lambda p: p.process_id)
+
+    def kill(self, process_id: int) -> bool:
+        """Flag a process for cancellation (reference KILL <process_id>)."""
+        with self._lock:
+            proc = self._processes.get(process_id)
+        if proc is None:
+            raise InvalidArgumentsError(f"no running query with id {process_id}")
+        proc.cancelled.set()
+        return True
+
+    def check_cancelled(self):
+        """Cancellation point: raise if this thread's query was killed."""
+        proc = getattr(self._current, "proc", None)
+        if proc is not None and proc.cancelled.is_set():
+            raise QueryCancelledError(
+                f"query {proc.process_id} cancelled by KILL"
+            )
+
+    class _Ticket:
+        def __init__(self, mgr: "ProcessManager", proc: Process):
+            self.mgr, self.proc = mgr, proc
+
+        def __enter__(self):
+            return self.proc
+
+        def __exit__(self, *exc):
+            self.mgr.deregister(self.proc)
+            return False
+
+    def track(self, database: str, query: str, client: str = "local") -> "ProcessManager._Ticket":
+        return self._Ticket(self, self.register(database, query, client))

@@ -263,6 +263,35 @@ class TransactionStmt:
     kind: str  # begin|commit|rollback
 
 
+@dataclass
+class DeclareCursorStmt:
+    """DECLARE <name> CURSOR FOR <select> (reference
+    operator/src/statement/cursor.rs + common/recordbatch cursor.rs)."""
+
+    name: str
+    select: object  # SelectStmt | TqlStmt
+
+
+@dataclass
+class FetchCursorStmt:
+    """FETCH [n FROM] <name>."""
+
+    name: str
+    count: int
+
+
+@dataclass
+class CloseCursorStmt:
+    name: str
+
+
+@dataclass
+class KillStmt:
+    """KILL [QUERY] <process_id> (reference catalog process_manager kill)."""
+
+    process_id: int
+
+
 class Parser:
     def __init__(self, sql: str):
         self.tokens = tokenize(sql)
@@ -383,6 +412,52 @@ class Parser:
             return TransactionStmt("begin")
         if self.at_kw("copy"):
             return self.parse_copy()
+        if self.at_kw("declare"):
+            self.next()
+            name = self.ident()
+            self.expect_kw("cursor")
+            self.expect_kw("for")
+            inner = self.parse_statement()
+            if not isinstance(inner, (SelectStmt, TqlStmt)):
+                raise InvalidSyntaxError("DECLARE CURSOR requires a SELECT or TQL query")
+            return DeclareCursorStmt(name, inner)
+        if self.at_kw("fetch"):
+            # FETCH [NEXT | ALL | FORWARD [n | ALL] | n] [FROM] <name>
+            self.next()
+            count = 1
+            if self.eat_kw("forward"):
+                if self.eat_kw("all"):
+                    count = -1
+                elif self.peek().kind == "number":
+                    count = int(float(self.next().value))
+            elif self.eat_kw("next"):
+                count = 1
+            elif self.eat_kw("all"):
+                count = -1
+            elif self.peek().kind == "number":
+                count = int(float(self.next().value))
+            self.eat_kw("from")
+            return FetchCursorStmt(self.ident(), count)
+        if self.at_kw("close"):
+            self.next()
+            return CloseCursorStmt(self.ident())
+        if self.at_kw("kill"):
+            self.next()
+            self.eat_kw("query")
+            tok = self.next()
+            raw = tok.value
+            if tok.kind == "string":
+                raw = raw.strip("'\"")
+            # process_list renders ids as "<addr>/<pid>" — accept that form
+            if "/" in raw:
+                raw = raw.rsplit("/", 1)[1]
+            try:
+                pid = int(float(raw))
+            except ValueError:
+                raise InvalidSyntaxError(
+                    f"KILL expects a process id (e.g. 3 or 'addr/3'), got {tok.value!r}"
+                ) from None
+            return KillStmt(pid)
         raise InvalidSyntaxError(f"unsupported statement: {self.peek().value!r}")
 
     def parse_copy(self) -> CopyStmt:

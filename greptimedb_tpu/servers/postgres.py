@@ -400,7 +400,7 @@ class _Handler(socketserver.BaseRequestHandler):
             yield None, first
             return
         for stmt in parse_sql(sql):
-            result = srv.db.execute_stmt(stmt)
+            result = srv.db.execute_stmt(stmt, query_text=sql)
             if isinstance(result, pa.Table):
                 yield result, ""
             elif isinstance(stmt, InsertStmt):

@@ -1,0 +1,146 @@
+"""SQL cursors, process list, and KILL.
+
+Mirrors the reference's cursor statements (operator/src/statement/cursor.rs),
+ProcessManager (catalog/src/process_manager.rs:43), and
+information_schema.process_list.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.models.process import QueryCancelledError
+from greptimedb_tpu.utils.errors import InvalidArgumentsError
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(data_home=str(tmp_path))
+    d.sql("CREATE TABLE t (host STRING, ts TIMESTAMP(3), v DOUBLE, TIME INDEX (ts), PRIMARY KEY (host))")
+    rows = ", ".join(f"('h{i % 4}', {i * 1000}, {float(i)})" for i in range(20))
+    d.sql(f"INSERT INTO t VALUES {rows}")
+    yield d
+    d.close()
+
+
+def test_cursor_declare_fetch_close(db):
+    db.sql("DECLARE c CURSOR FOR SELECT ts, v FROM t ORDER BY ts")
+    t1 = db.sql_one("FETCH 5 FROM c")
+    assert t1.num_rows == 5
+    np.testing.assert_allclose(t1["v"].to_pylist(), [0, 1, 2, 3, 4])
+    t2 = db.sql_one("FETCH 5 FROM c")
+    np.testing.assert_allclose(t2["v"].to_pylist(), [5, 6, 7, 8, 9])
+    # drain to the end: short final batch, then empty
+    db.sql_one("FETCH 100 FROM c")
+    t4 = db.sql_one("FETCH 5 FROM c")
+    assert t4.num_rows == 0
+    db.sql("CLOSE c")
+    with pytest.raises(InvalidArgumentsError, match="not open"):
+        db.sql("FETCH 1 FROM c")
+
+
+def test_cursor_errors(db):
+    db.sql("DECLARE c CURSOR FOR SELECT * FROM t")
+    with pytest.raises(InvalidArgumentsError, match="already open"):
+        db.sql("DECLARE c CURSOR FOR SELECT * FROM t")
+    db.sql("CLOSE c")
+    with pytest.raises(InvalidArgumentsError, match="not open"):
+        db.sql("CLOSE c")
+
+
+def test_cursor_default_fetch_count(db):
+    db.sql("DECLARE one CURSOR FOR SELECT v FROM t ORDER BY ts")
+    t = db.sql_one("FETCH FROM one")
+    assert t.num_rows == 1
+
+
+def test_cursors_are_per_session(db):
+    db.sql("DECLARE c CURSOR FOR SELECT * FROM t")
+    seen = {}
+
+    def other_thread():
+        try:
+            db.sql("FETCH 1 FROM c")
+            seen["ok"] = True
+        except InvalidArgumentsError:
+            seen["isolated"] = True
+
+    th = threading.Thread(target=other_thread)
+    th.start()
+    th.join()
+    assert seen == {"isolated": True}  # another connection can't see it
+
+
+def test_process_list_and_kill(db):
+    # a running query appears in process_list and KILL cancels it
+    started = threading.Event()
+    outcome = {}
+
+    orig_scan = db.storage.scan
+
+    def slow_scan(rid, pred):
+        started.set()
+        time.sleep(0.3)
+        return orig_scan(rid, pred)
+
+    db.storage.scan = slow_scan
+
+    def run_query():
+        try:
+            db.sql("SELECT * FROM t")
+            outcome["done"] = True
+        except QueryCancelledError:
+            outcome["cancelled"] = True
+
+    th = threading.Thread(target=run_query)
+    th.start()
+    assert started.wait(5)
+    plist = db.sql_one("SELECT * FROM information_schema.process_list")
+    # the slow query plus this introspection query itself
+    queries = plist["query"].to_pylist()
+    assert any("SELECT * FROM t" in q for q in queries)
+    pid = None
+    for pid_str, q in zip(plist["id"].to_pylist(), queries):
+        if "SELECT * FROM t" in q:
+            pid = int(pid_str.rsplit("/", 1)[1])
+    db.sql(f"KILL {pid}")
+    th.join(timeout=10)
+    assert outcome == {"cancelled": True}
+    # deregistered after completion
+    plist = db.sql_one("SELECT query FROM information_schema.process_list")
+    assert not any("SELECT * FROM t" == q for q in plist["query"].to_pylist())
+
+
+def test_kill_unknown_process(db):
+    with pytest.raises(InvalidArgumentsError, match="no running query"):
+        db.sql("KILL 99999")
+
+
+def test_process_deregistered_after_success(db):
+    db.sql("SELECT count(*) FROM t")
+    plist = db.sql_one("SELECT query FROM information_schema.process_list")
+    # only the introspection query itself is ever present
+    assert all("process_list" in q for q in plist["query"].to_pylist())
+
+
+def test_fetch_pg_forms_and_kill_id_string(db):
+    db.sql("DECLARE pgc CURSOR FOR SELECT v FROM t ORDER BY ts")
+    assert db.sql_one("FETCH NEXT FROM pgc").num_rows == 1
+    assert db.sql_one("FETCH FORWARD 3 FROM pgc").num_rows == 3
+    rest = db.sql_one("FETCH ALL FROM pgc")
+    assert rest.num_rows == 16
+    db.sql("CLOSE pgc")
+
+    # KILL accepts the 'addr/pid' string process_list displays
+    from greptimedb_tpu.query.sql_parser import KillStmt, parse_sql
+
+    stmt = parse_sql("KILL 'standalone/7'")[0]
+    assert isinstance(stmt, KillStmt) and stmt.process_id == 7
+    from greptimedb_tpu.utils.errors import InvalidSyntaxError
+
+    with pytest.raises(InvalidSyntaxError):
+        parse_sql("KILL 'not-a-pid'")
