@@ -539,9 +539,10 @@ class Database:
         keys = self.query_engine.execute_select(sel, self.current_database)
         if keys.num_rows == 0:
             return 0
+        region_ids = meta.region_ids
         for i, part in enumerate(meta.partition_rule.split(keys)):
             if part.num_rows:
-                self.storage.delete(region_id(meta.table_id, i), part)
+                self.storage.delete(region_ids[i], part)
         return keys.num_rows
 
     def _drop(self, stmt: DropStmt):
@@ -617,12 +618,12 @@ class Database:
         table = pa.Table.from_batches([batch])
         affected = 0
         parts = meta.partition_rule.split(table)
+        region_ids = meta.region_ids  # includes any repartition generation base
         for i, part in enumerate(parts):
             if part.num_rows == 0:
                 continue
-            rid = region_id(meta.table_id, i)
             for b in part.to_batches():
-                affected += self.storage.write(rid, b)
+                affected += self.storage.write(region_ids[i], b)
         if mirror and self.flows.infos:
             self.flows.mirror_insert(meta.name, meta.database, table)
         return affected

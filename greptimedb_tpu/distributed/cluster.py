@@ -133,6 +133,23 @@ class Cluster:
         self.metasrv = Metasrv(self.kv, NodeManager(self))
         for i in self.datanodes:
             self.metasrv.register_datanode(i)
+        from .procedure import ProcedureManager
+        from .repartition import (
+            ReconcileDatabaseProcedure,
+            ReconcileTableProcedure,
+            RepartitionProcedure,
+        )
+
+        self.procedures = ProcedureManager(self.kv, services={"cluster": self})
+        self.procedures.register(RepartitionProcedure)
+        self.procedures.register(ReconcileTableProcedure)
+        self.procedures.register(ReconcileDatabaseProcedure)
+        # Per-table write locks close the fence-check/write race with the
+        # repartition procedure's write fence (see insert()).
+        import threading
+
+        self._write_locks: dict = {}
+        self._write_locks_guard = threading.Lock()
         self.current_database = "public"
         self.query_engine = QueryEngine(
             schema_provider=lambda t, d: self.catalog.table(t, d).schema,
@@ -177,18 +194,42 @@ class Cluster:
     def insert(self, table: str, batch: pa.RecordBatch, database: str = "public") -> int:
         """Split by partition rule, fan out per region to its route's node
         (reference Inserter group_requests_by_peer, insert.rs:441)."""
-        meta = self.catalog.table(table, database)
-        routes = self.metasrv.get_route(meta.table_id)
-        t = pa.Table.from_batches([batch])
-        affected = 0
-        for i, part in enumerate(meta.partition_rule.split(t)):
-            if part.num_rows == 0:
-                continue
-            rid = region_id(meta.table_id, i)
-            node = routes[rid]
-            for b in part.to_batches():
-                affected += self.datanodes[node].write(rid, b)
-        return affected
+        from ..utils.errors import RetryLaterError
+
+        # Fence check + writes are one critical section per table: the
+        # repartition procedure sets its fence under the same lock, so an
+        # insert either completes before the copy starts or observes the
+        # fence — never writes into an old region after it was copied.
+        with self.table_write_lock(database, table):
+            meta = self.catalog.table(table, database)
+            if meta.options.get("repartitioning"):
+                raise RetryLaterError(f"table {table!r} is repartitioning; retry the write")
+            routes = self.metasrv.get_route(meta.table_id)
+            t = pa.Table.from_batches([batch])
+            affected = 0
+            region_ids = meta.region_ids  # includes the repartition generation base
+            for i, part in enumerate(meta.partition_rule.split(t)):
+                if part.num_rows == 0:
+                    continue
+                rid = region_ids[i]
+                node = routes.get(rid)
+                if node is None:
+                    raise RetryLaterError(
+                        f"region {rid} of {table!r} has no route yet; retry the write"
+                    )
+                for b in part.to_batches():
+                    affected += self.datanodes[node].write(rid, b)
+            return affected
+
+    def table_write_lock(self, database: str, table: str):
+        with self._write_locks_guard:
+            key = (database, table)
+            lock = self._write_locks.get(key)
+            if lock is None:
+                import threading
+
+                lock = self._write_locks[key] = threading.RLock()
+            return lock
 
     # ---- query ------------------------------------------------------------
     def query(self, stmt_sql: str) -> pa.Table:
@@ -253,6 +294,30 @@ class Cluster:
 
     def supervise(self):
         return self.metasrv.tick(self.clock())
+
+    # ---- admin procedures -------------------------------------------------
+    def repartition_table(self, table: str, new_rule, database: str = "public") -> str:
+        """Online region split/merge to a new partition rule (reference
+        repartition procedure, RFC 2025-06-20-repartition.md)."""
+        from .repartition import RepartitionProcedure
+
+        return self.procedures.submit(RepartitionProcedure.create(database, table, new_rule))
+
+    def reconcile_table(self, table: str, database: str = "public") -> list[str]:
+        """Re-sync one table's metadata with datanode reality; returns the
+        repair actions taken (reference reconciliation manager)."""
+        from .repartition import ReconcileTableProcedure
+
+        proc = ReconcileTableProcedure.create(database, table)
+        self.procedures.submit(proc)
+        return proc.state["actions"]
+
+    def reconcile_database(self, database: str = "public") -> list[str]:
+        from .repartition import ReconcileDatabaseProcedure
+
+        proc = ReconcileDatabaseProcedure.create(database)
+        self.procedures.submit(proc)
+        return proc.state["actions"]
 
     def kill_datanode(self, node_id: int):
         self.datanodes[node_id].kill()

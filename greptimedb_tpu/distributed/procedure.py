@@ -58,6 +58,17 @@ class ProcedureContext:
     manager: "ProcedureManager"
     services: dict = field(default_factory=dict)  # DI: engines, routers, ...
 
+    def checkpoint(self, procedure: "Procedure"):
+        """Persist the procedure's CURRENT state mid-step, so a crash
+        between two side effects inside one step resumes after the last
+        checkpoint instead of replaying the whole step."""
+        raw = self.manager.kv.get(PROC_PREFIX + self.procedure_id)
+        if raw is None:
+            return  # driven outside the manager (unit tests)
+        record = ProcedureRecord.from_json(raw)
+        record.state = procedure.state
+        self.manager.kv.put(PROC_PREFIX + self.procedure_id, record.to_json())
+
 
 @dataclass
 class ProcedureRecord:

@@ -1,0 +1,274 @@
+"""Online repartition (region split/merge) and metadata reconciliation.
+
+Role-equivalents of the reference's repartition procedure
+(reference meta-srv/src/procedure/repartition/, RFC
+docs/rfcs/2025-06-20-repartition.md: staging regions + manifest remap +
+metadata swap) and the reconciliation procedures
+(reference common/meta/src/reconciliation/{reconcile_table,
+reconcile_database}/ — re-sync KV metadata with datanode reality).
+
+Differences from the reference, by design:
+  * the reference remaps SST manifests file-by-file; we re-split rows
+    through the partition rule into the staging regions — simpler, always
+    correct, and the copy runs through the same write path that ingest
+    uses (WAL-durable before the swap);
+  * writes are fenced with a `repartitioning` table option during the
+    copy (the reference pauses/stages writes around the swap window).
+
+Both are durable `Procedure`s: every step checkpoints its state, so a
+crashed metasrv resumes them from the KV record (ProcedureManager.recover).
+"""
+
+from __future__ import annotations
+
+from ..models.catalog import MAX_REGIONS_PER_TABLE, region_id
+from ..models.partition import PartitionRule
+from ..utils.errors import IllegalStateError, InvalidArgumentsError
+from .procedure import DONE, EXECUTING, Procedure
+
+
+class RepartitionProcedure(Procedure):
+    """Split/merge a table's regions to a new partition rule.
+
+    state: {database, table, new_rule, step, staging_routes, old_routes,
+            old_region_ids, new_base}
+    """
+
+    type_name = "repartition"
+
+    @classmethod
+    def create(cls, database: str, table: str, new_rule: PartitionRule) -> "RepartitionProcedure":
+        return cls(
+            state={
+                "database": database,
+                "table": table,
+                "new_rule": new_rule.to_dict(),
+                "step": "prepare",
+            }
+        )
+
+    def lock_keys(self):
+        return [f"table/{self.state['database']}/{self.state['table']}"]
+
+    def execute(self, ctx):
+        cluster = ctx.services["cluster"]
+        step = self.state["step"]
+        return getattr(self, f"_step_{step}")(cluster, ctx)
+
+    # -- steps ---------------------------------------------------------------
+    def _step_prepare(self, cluster, ctx):
+        meta = cluster.catalog.table(self.state["table"], self.state["database"])
+        new_rule = PartitionRule.from_dict(self.state["new_rule"])
+        if new_rule.num_partitions() < 1:
+            raise InvalidArgumentsError("repartition: new rule must have >= 1 partition")
+        self.state["old_region_ids"] = list(meta.region_ids)
+        self.state["old_routes"] = {
+            str(rid): node for rid, node in cluster.metasrv.get_route(meta.table_id).items()
+        }
+        new_base = meta.region_id_base + meta.partition_rule.num_partitions()
+        if new_base + new_rule.num_partitions() > MAX_REGIONS_PER_TABLE:
+            raise InvalidArgumentsError(
+                "repartition: region id space exhausted for this table "
+                f"(base {new_base} + {new_rule.num_partitions()} > {MAX_REGIONS_PER_TABLE})"
+            )
+        self.state["new_base"] = new_base
+        # Fence writes for the copy window (reference stages/pauses writes).
+        # Taken under the table write lock so an insert that already passed
+        # its fence check finishes before the fence lands (no lost rows).
+        with cluster.table_write_lock(self.state["database"], self.state["table"]):
+            meta.options["repartitioning"] = True
+            cluster.catalog.update_table(meta)
+        self.state["step"] = "create_staging"
+        return EXECUTING
+
+    def _step_create_staging(self, cluster, ctx):
+        meta = cluster.catalog.table(self.state["table"], self.state["database"])
+        new_rule = PartitionRule.from_dict(self.state["new_rule"])
+        staging = dict(self.state.get("staging_routes") or {})
+        for i in range(new_rule.num_partitions()):
+            rid = region_id(meta.table_id, self.state["new_base"] + i)
+            node = staging.get(str(rid))
+            if node is None:
+                # crash-resume dedup: the region may already be open from a
+                # crash between open_region and the checkpoint below — reuse
+                # that node instead of double-opening (single-writer).
+                for nid, dn in cluster.datanodes.items():
+                    if getattr(dn, "alive", True) and rid in dn.engine.region_ids():
+                        node = nid
+                        break
+            if node is None:
+                node = cluster.metasrv.select_datanode()
+                if node is None:
+                    raise IllegalStateError("repartition: no live datanode for staging region")
+                cluster.datanodes[node].open_region(rid, meta.schema)
+            staging[str(rid)] = node
+            self.state["staging_routes"] = staging
+            ctx.checkpoint(self)  # durable BEFORE the next side effect
+        self.state["step"] = "copy_data"
+        return EXECUTING
+
+    def _step_copy_data(self, cluster, ctx):
+        from ..storage.sst import ScanPredicate
+
+        meta = cluster.catalog.table(self.state["table"], self.state["database"])
+        new_rule = PartitionRule.from_dict(self.state["new_rule"])
+        staging = self.state["staging_routes"]
+        new_rids = [
+            region_id(meta.table_id, self.state["new_base"] + i)
+            for i in range(new_rule.num_partitions())
+        ]
+        for old_rid_s, node in self.state["old_routes"].items():
+            table = cluster.datanodes[int(node)].scan(int(old_rid_s), ScanPredicate())
+            if table.num_rows == 0:
+                continue
+            for i, part in enumerate(new_rule.split(table)):
+                if part.num_rows == 0:
+                    continue
+                rid = new_rids[i]
+                dn = cluster.datanodes[staging[str(rid)]]
+                for batch in part.to_batches():
+                    dn.write(rid, batch)
+        self.state["step"] = "swap_metadata"
+        return EXECUTING
+
+    def _step_swap_metadata(self, cluster, ctx):
+        import copy
+
+        meta = cluster.catalog.table(self.state["table"], self.state["database"])
+        new_rule = PartitionRule.from_dict(self.state["new_rule"])
+        # 1) make the staging regions routable WHILE the old routes stay:
+        #    readers between these two writes still see the old rule+routes.
+        for rid_s, node in self.state["staging_routes"].items():
+            cluster.metasrv.update_route(meta.table_id, int(rid_s), int(node))
+        # 2) atomically publish a NEW meta object (never mutate the live one
+        #    concurrent readers hold).
+        new_meta = copy.deepcopy(meta)
+        new_meta.partition_rule = new_rule
+        new_meta.region_id_base = self.state["new_base"]
+        new_meta.options.pop("repartitioning", None)
+        cluster.catalog.update_table(new_meta)
+        self.state["step"] = "cleanup"
+        return EXECUTING
+
+    def _step_cleanup(self, cluster, ctx):
+        meta = cluster.catalog.table(self.state["table"], self.state["database"])
+        for rid_s, node in self.state["old_routes"].items():
+            dn = cluster.datanodes.get(int(node))
+            if dn is None or not getattr(dn, "alive", True):
+                continue
+            try:
+                dn.engine.drop_region(int(rid_s))
+            except Exception:
+                dn.close_region(int(rid_s))
+        # prune the old routes now that the regions are gone
+        cluster.metasrv.set_route(
+            meta.table_id, {int(r): int(n) for r, n in self.state["staging_routes"].items()}
+        )
+        return DONE
+
+    def rollback(self, ctx):
+        """Failure handling: decided by the CATALOG, not the step counter —
+        if the swap committed, staging holds the only copy and must live."""
+        cluster = ctx.services["cluster"]
+        meta = cluster.catalog.table(self.state["table"], self.state["database"])
+        swap_committed = meta.region_id_base == self.state.get("new_base")
+        if not swap_committed:
+            for rid_s, node in (self.state.get("staging_routes") or {}).items():
+                dn = cluster.datanodes.get(int(node))
+                if dn is not None and getattr(dn, "alive", True):
+                    try:
+                        dn.engine.drop_region(int(rid_s))
+                    except Exception:
+                        pass
+            if meta.options.pop("repartitioning", None):
+                cluster.catalog.update_table(meta)
+
+
+class ReconcileTableProcedure(Procedure):
+    """Re-sync one table's metadata with datanode reality.
+
+    Repairs, in order (reference reconciliation/reconcile_table/):
+      * regions routed to dead/missing datanodes -> re-placed on live ones
+      * routed regions the datanode doesn't actually have open -> reopened
+      * regions of this table open on datanodes but absent from the route
+        (orphans of crashed repartitions/migrations) -> closed + dropped
+    state.actions records what was done for the admin's report.
+    """
+
+    type_name = "reconcile_table"
+
+    @classmethod
+    def create(cls, database: str, table: str) -> "ReconcileTableProcedure":
+        return cls(state={"database": database, "table": table, "actions": []})
+
+    def lock_keys(self):
+        return [f"table/{self.state['database']}/{self.state['table']}"]
+
+    def execute(self, ctx):
+        cluster = ctx.services["cluster"]
+        meta = cluster.catalog.table(self.state["table"], self.state["database"])
+        actions: list[str] = self.state["actions"]
+        routes = dict(cluster.metasrv.get_route(meta.table_id))
+        expected = set(meta.region_ids)
+
+        for rid in meta.region_ids:
+            node = routes.get(rid)
+            dn = cluster.datanodes.get(node) if node is not None else None
+            alive = dn is not None and getattr(dn, "alive", True)
+            if not alive:
+                new_node = cluster.metasrv.select_datanode(
+                    exclude={node} if node is not None else frozenset()
+                )
+                if new_node is None:
+                    raise IllegalStateError("reconcile: no live datanode available")
+                cluster.datanodes[new_node].open_region(rid, meta.schema)
+                cluster.metasrv.update_route(meta.table_id, rid, new_node)
+                actions.append(f"replaced route of region {rid}: {node} -> {new_node}")
+                continue
+            try:
+                dn.engine.region(rid)
+            except Exception:
+                dn.open_region(rid, meta.schema)
+                actions.append(f"reopened region {rid} on datanode {node}")
+
+        # close orphans: regions of this table open anywhere but not expected
+        for node_id, dn in cluster.datanodes.items():
+            if not getattr(dn, "alive", True):
+                continue
+            for rid in list(dn.engine.region_ids()):
+                if rid // MAX_REGIONS_PER_TABLE != meta.table_id or rid in expected:
+                    continue
+                try:
+                    dn.engine.drop_region(rid)
+                except Exception:
+                    dn.close_region(rid)
+                actions.append(f"dropped orphan region {rid} on datanode {node_id}")
+
+        self.state["actions"] = actions
+        return DONE
+
+
+class ReconcileDatabaseProcedure(Procedure):
+    """Reconcile every table of a database (reference reconcile_database/)."""
+
+    type_name = "reconcile_database"
+
+    @classmethod
+    def create(cls, database: str) -> "ReconcileDatabaseProcedure":
+        return cls(state={"database": database, "actions": []})
+
+    def lock_keys(self):
+        return [f"database/{self.state['database']}"]
+
+    def execute(self, ctx):
+        cluster = ctx.services["cluster"]
+        actions = self.state["actions"]
+        for meta in cluster.catalog.tables(self.state["database"]):
+            sub = ReconcileTableProcedure.create(self.state["database"], meta.name)
+            # submit through the manager so the per-table lock is honored —
+            # a concurrent repartition of the same table must finish first,
+            # else its staging regions would look like droppable orphans
+            ctx.manager.submit(sub)
+            actions += [f"{meta.name}: {a}" for a in sub.state["actions"]]
+        self.state["actions"] = actions
+        return DONE

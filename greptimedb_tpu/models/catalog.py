@@ -40,11 +40,15 @@ class TableMeta:
     schema: Schema
     partition_rule: PartitionRule = field(default_factory=SingleRegionRule)
     options: dict = field(default_factory=dict)
+    # Region-id generation offset: repartition allocates the new partition
+    # set at a fresh base so old and staging region ids never collide
+    # (reference repartition RFC's staging regions).
+    region_id_base: int = 0
 
     @property
     def region_ids(self) -> list[int]:
         return [
-            region_id(self.table_id, i)
+            region_id(self.table_id, self.region_id_base + i)
             for i in range(self.partition_rule.num_partitions())
         ]
 
@@ -56,6 +60,7 @@ class TableMeta:
             "schema": self.schema.to_json(),
             "partition_rule": self.partition_rule.to_dict(),
             "options": self.options,
+            "region_id_base": self.region_id_base,
         }
 
     @classmethod
@@ -67,6 +72,7 @@ class TableMeta:
             schema=Schema.from_json(d["schema"]),
             partition_rule=PartitionRule.from_dict(d["partition_rule"]),
             options=d.get("options", {}),
+            region_id_base=d.get("region_id_base", 0),
         )
 
 

@@ -1,0 +1,190 @@
+"""Repartition (region split/merge) and reconciliation procedure tests.
+
+Mirrors the reference's repartition procedure (meta-srv/src/procedure/
+repartition/, RFC 2025-06-20) and reconciliation manager
+(common/meta/src/reconciliation/) on the in-process cluster.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.datatypes import ColumnSchema, ConcreteDataType, Schema, SemanticType
+from greptimedb_tpu.distributed.cluster import Cluster
+from greptimedb_tpu.models.partition import HashPartitionRule, RangePartitionRule
+
+SCHEMA = Schema(
+    columns=[
+        ColumnSchema("host", ConcreteDataType.STRING, SemanticType.TAG),
+        ColumnSchema("ts", ConcreteDataType.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP),
+        ColumnSchema("v", ConcreteDataType.FLOAT64),
+    ]
+)
+
+
+def _batch(n=120, t0=0):
+    return pa.record_batch(
+        {
+            "host": pa.array([f"h{i % 8}" for i in range(n)]),
+            "ts": pa.array(np.arange(t0, t0 + n, dtype=np.int64), pa.timestamp("ms")),
+            "v": pa.array(np.arange(n, dtype=np.float64)),
+        }
+    )
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = Cluster(str(tmp_path), num_datanodes=3)
+    yield c
+    c.close()
+
+
+def _totals(cluster, table="cpu"):
+    t = cluster.query(f"SELECT count(*) AS n, sum(v) AS s FROM {table}")
+    return t["n"].to_pylist()[0], t["s"].to_pylist()[0]
+
+
+def test_repartition_split_1_to_3(cluster):
+    cluster.create_table("cpu", SCHEMA, partitions=1)
+    cluster.insert("cpu", _batch(120))
+    before = _totals(cluster)
+
+    cluster.repartition_table("cpu", HashPartitionRule(["host"], 3))
+
+    meta = cluster.catalog.table("cpu", "public")
+    assert meta.partition_rule.num_partitions() == 3
+    assert meta.region_id_base == 1  # staging generation
+    assert len(meta.region_ids) == 3
+    # data preserved across the split
+    assert _totals(cluster) == before
+    # new writes flow to the new regions
+    cluster.insert("cpu", _batch(30, t0=10_000))
+    n, _ = _totals(cluster)
+    assert n == 150
+    # old region is gone from every datanode
+    old_rid = meta.table_id * 1024
+    for dn in cluster.datanodes.values():
+        assert old_rid not in dn.engine.region_ids()
+
+
+def test_repartition_merge_3_to_1(cluster):
+    cluster.create_table("cpu", SCHEMA, partitions=3)
+    cluster.insert("cpu", _batch(90))
+    before = _totals(cluster)
+    from greptimedb_tpu.models.partition import SingleRegionRule
+
+    cluster.repartition_table("cpu", SingleRegionRule())
+    meta = cluster.catalog.table("cpu", "public")
+    assert meta.partition_rule.num_partitions() == 1
+    assert _totals(cluster) == before
+
+
+def test_repartition_to_range_rule(cluster):
+    cluster.create_table("cpu", SCHEMA, partitions=2)
+    cluster.insert("cpu", _batch(100))
+    before = _totals(cluster)
+    cluster.repartition_table("cpu", RangePartitionRule("host", ["h4"]))
+    assert _totals(cluster) == before
+    t = cluster.query("SELECT host, count(*) AS n FROM cpu GROUP BY host ORDER BY host")
+    assert t.num_rows == 8  # all hosts still present
+
+
+def test_repartition_fences_writes(cluster):
+    """During the copy window the table rejects writes with RETRY_LATER
+    (reference pauses/stages writes around the swap)."""
+    from greptimedb_tpu.utils.errors import RetryLaterError
+
+    cluster.create_table("cpu", SCHEMA, partitions=1)
+    cluster.insert("cpu", _batch(10))
+    meta = cluster.catalog.table("cpu", "public")
+    meta.options["repartitioning"] = True
+    cluster.catalog.update_table(meta)
+    with pytest.raises(RetryLaterError):
+        cluster.insert("cpu", _batch(10, t0=5000))
+    meta.options.pop("repartitioning")
+    cluster.catalog.update_table(meta)
+    cluster.insert("cpu", _batch(10, t0=5000))
+
+
+def test_repartition_resumes_after_crash(cluster):
+    """A procedure checkpointed mid-flight resumes from its dumped state
+    on recover() (reference ProcedureManager resumption)."""
+    from greptimedb_tpu.distributed.procedure import EXECUTING, PROC_PREFIX, ProcedureRecord
+    from greptimedb_tpu.distributed.repartition import RepartitionProcedure
+
+    cluster.create_table("cpu", SCHEMA, partitions=1)
+    cluster.insert("cpu", _batch(60))
+    before = _totals(cluster)
+
+    # Run prepare + create_staging by hand, checkpoint, then "crash".
+    from greptimedb_tpu.distributed.procedure import ProcedureContext
+
+    proc = RepartitionProcedure.create("public", "cpu", HashPartitionRule(["host"], 2))
+    ctx = ProcedureContext("crashpid", cluster.procedures, {"cluster": cluster})
+    assert proc.execute(ctx) == EXECUTING  # prepare
+    assert proc.execute(ctx) == EXECUTING  # create_staging
+    record = ProcedureRecord("crashpid", RepartitionProcedure.type_name, EXECUTING, proc.state)
+    cluster.kv.put(PROC_PREFIX + "crashpid", record.to_json())
+
+    resumed = cluster.procedures.recover()
+    assert "crashpid" in resumed
+    meta = cluster.catalog.table("cpu", "public")
+    assert meta.partition_rule.num_partitions() == 2
+    assert _totals(cluster) == before
+    assert not meta.options.get("repartitioning")
+
+
+def test_reconcile_reopens_missing_region(cluster):
+    cluster.create_table("cpu", SCHEMA, partitions=2)
+    cluster.insert("cpu", _batch(40))
+    meta = cluster.catalog.table("cpu", "public")
+    # silently close one routed region on its datanode (metadata now lies)
+    rid = meta.region_ids[0]
+    node = cluster.metasrv.get_route(meta.table_id)[rid]
+    cluster.datanodes[node].engine.close_region(rid)
+
+    actions = cluster.reconcile_table("cpu")
+    assert any("reopened" in a for a in actions)
+    n, _ = _totals(cluster)
+    assert n == 40
+
+
+def test_reconcile_replaces_dead_route(cluster):
+    cluster.create_table("cpu", SCHEMA, partitions=2)
+    cluster.insert("cpu", _batch(40))
+    meta = cluster.catalog.table("cpu", "public")
+    rid = meta.region_ids[0]
+    dead = cluster.metasrv.get_route(meta.table_id)[rid]
+    cluster.kill_datanode(dead)
+
+    actions = cluster.reconcile_table("cpu")
+    assert any("replaced route" in a for a in actions)
+    new_node = cluster.metasrv.get_route(meta.table_id)[rid]
+    assert new_node != dead
+    # shared-storage failover: data still queryable
+    n, _ = _totals(cluster)
+    assert n == 40
+
+
+def test_reconcile_drops_orphan_region(cluster):
+    cluster.create_table("cpu", SCHEMA, partitions=1)
+    cluster.insert("cpu", _batch(20))
+    meta = cluster.catalog.table("cpu", "public")
+    # fabricate an orphan: a staging region left behind by a failed split
+    orphan = meta.table_id * 1024 + 7
+    cluster.datanodes[0].open_region(orphan, SCHEMA)
+
+    actions = cluster.reconcile_table("cpu")
+    assert any("orphan" in a for a in actions)
+    assert orphan not in cluster.datanodes[0].engine.region_ids()
+
+
+def test_reconcile_database_covers_all_tables(cluster):
+    cluster.create_table("a", SCHEMA, partitions=1)
+    cluster.create_table("b", SCHEMA, partitions=2)
+    meta = cluster.catalog.table("b", "public")
+    rid = meta.region_ids[1]
+    node = cluster.metasrv.get_route(meta.table_id)[rid]
+    cluster.datanodes[node].engine.close_region(rid)
+    actions = cluster.reconcile_database("public")
+    assert any(a.startswith("b:") for a in actions)
