@@ -95,6 +95,16 @@ class Database:
         # Running-query registry behind information_schema.process_list and
         # KILL (reference catalog/src/process_manager.rs:43).
         self.process_manager = ProcessManager()
+        from .utils.events import EventRecorder
+        from .utils.memory import MemoryGovernor
+
+        # Slow queries + system events into greptime_private (reference
+        # common/event-recorder); admission budgets (common/memory-manager).
+        self.event_recorder = EventRecorder(self)
+        self.memory = MemoryGovernor(
+            self.config.memory.max_in_flight_write_bytes,
+            self.config.memory.max_concurrent_queries,
+        )
         self.query_engine = QueryEngine(
             schema_provider=self._schema_of,
             scan_provider=self._scan,
@@ -113,6 +123,7 @@ class Database:
         self._session.database = value
 
     def close(self):
+        self.event_recorder.stop()
         self.flows.stop()
         self.storage.close()
 
@@ -131,8 +142,15 @@ class Database:
 
     # ---- dispatch (reference StatementExecutor::execute_stmt) -------------
     def _execute(self, stmt, query_text: str | None = None):
+        from .utils.events import SlowQueryTimer
+
         if isinstance(stmt, SelectStmt):
-            with self.process_manager.track(self.current_database, query_text or "SELECT ..."):
+            with self.memory.query_guard(), self.process_manager.track(
+                self.current_database, query_text or "SELECT ..."
+            ), SlowQueryTimer(
+                self.event_recorder, self.config.slow_query,
+                query_text or "SELECT ...", self.current_database,
+            ):
                 return self.query_engine.execute_select(stmt, self.current_database)
         if isinstance(stmt, CreateTableStmt):
             return self._create_table(stmt)
@@ -166,7 +184,12 @@ class Database:
         if isinstance(stmt, AdminStmt):
             return self._admin(stmt)
         if isinstance(stmt, TqlStmt):
-            with self.process_manager.track(self.current_database, query_text or "TQL ..."):
+            with self.memory.query_guard(), self.process_manager.track(
+                self.current_database, query_text or "TQL ..."
+            ), SlowQueryTimer(
+                self.event_recorder, self.config.slow_query,
+                query_text or "TQL ...", self.current_database, is_promql=True,
+            ):
                 return self._tql(stmt)
         if isinstance(stmt, DeclareCursorStmt):
             cursors = self._session_cursors()
@@ -596,7 +619,7 @@ class Database:
         batch = pa.RecordBatch.from_arrays(arrays, schema=schema.to_arrow())
         return self.write_batch(meta, batch)
 
-    def write_batch(self, meta, batch: pa.RecordBatch, mirror: bool = True) -> int:
+    def write_batch(self, meta, batch: pa.RecordBatch, mirror: bool = True, system: bool = False) -> int:
         """Route rows to regions via the partition rule and write each
         (the reference Inserter fan-out).  `mirror` feeds flows on the
         source table (reference FlowMirrorTask, insert.rs:397-406); flow
@@ -615,21 +638,31 @@ class Database:
                     meta.name, meta.database, pa.Table.from_batches([batch])
                 )
             return affected
+        from .utils.memory import batch_nbytes
+
         table = pa.Table.from_batches([batch])
         affected = 0
         parts = meta.partition_rule.split(table)
         region_ids = meta.region_ids  # includes any repartition generation base
-        for i, part in enumerate(parts):
-            if part.num_rows == 0:
-                continue
-            for b in part.to_batches():
-                affected += self.storage.write(region_ids[i], b)
+        # system writes (event recorder) bypass the user write budget
+        with self.memory.write_guard(0 if system else batch_nbytes(batch)):
+            for i, part in enumerate(parts):
+                if part.num_rows == 0:
+                    continue
+                for b in part.to_batches():
+                    affected += self.storage.write(region_ids[i], b)
         if mirror and self.flows.infos:
             self.flows.mirror_insert(meta.name, meta.database, table)
         return affected
 
     # ---- ingest API (line-protocol style, used by servers/) ---------------
-    def insert_rows(self, table: str, rows: pa.Table | pa.RecordBatch, database: str | None = None) -> int:
+    def insert_rows(
+        self,
+        table: str,
+        rows: pa.Table | pa.RecordBatch,
+        database: str | None = None,
+        system: bool = False,
+    ) -> int:
         meta = self.catalog.table(table, database or self.current_database)
         if isinstance(rows, pa.Table):
             rows = rows.combine_chunks()
@@ -638,7 +671,7 @@ class Database:
             batches = [rows]
         total = 0
         for b in batches:
-            total += self.write_batch(meta, _conform_batch(b, meta.schema))
+            total += self.write_batch(meta, _conform_batch(b, meta.schema), system=system)
         return total
 
     # ---- SHOW/DESCRIBE ----------------------------------------------------

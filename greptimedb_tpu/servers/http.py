@@ -88,14 +88,17 @@ class _Handler(BaseHTTPRequestHandler):
 
                 body = zlib.decompress(body)
             ctype = self.headers.get("Content-Type", "")
+            # ALWAYS keep the raw body: many clients (urllib, some influx
+            # SDKs) default to the form content-type for payloads that are
+            # not forms (line protocol, SQL text); handlers that expect raw
+            # bodies read __body, form-style handlers read the parsed keys.
+            params["__body"] = body
             if "application/x-www-form-urlencoded" in ctype:
                 try:
                     for k, v in urllib.parse.parse_qs(body.decode()).items():
                         params[k] = v[-1]
                 except UnicodeDecodeError:
-                    params["__body"] = body  # binary body mislabelled as a form
-            else:
-                params["__body"] = body
+                    pass  # binary body mislabelled as a form
         return params
 
     @property

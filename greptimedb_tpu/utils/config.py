@@ -101,11 +101,33 @@ class ServerConfig:
 
 
 @dataclasses.dataclass
+class SlowQueryConfig:
+    """Slow-query recording (reference common/telemetry SlowQueryOptions +
+    event recorder into greptime_private.slow_queries)."""
+
+    enable: bool = True
+    threshold_ms: int = 5000
+    sample_ratio: float = 1.0  # record this fraction of slow queries
+
+
+@dataclasses.dataclass
+class MemoryConfig:
+    """Admission-style memory governance (reference common/memory-manager,
+    servers request_memory_limiter `max_in_flight_write_bytes`,
+    `max_concurrent_queries`).  0 = unlimited."""
+
+    max_in_flight_write_bytes: int = 0
+    max_concurrent_queries: int = 0
+
+
+@dataclasses.dataclass
 class Config:
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
     query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
     server: ServerConfig = dataclasses.field(default_factory=ServerConfig)
+    slow_query: SlowQueryConfig = dataclasses.field(default_factory=SlowQueryConfig)
+    memory: MemoryConfig = dataclasses.field(default_factory=MemoryConfig)
 
     def __post_init__(self):
         self.storage.__post_init__()

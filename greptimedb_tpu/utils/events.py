@@ -1,0 +1,233 @@
+"""Event recorder: system events into `greptime_private` tables.
+
+Role-equivalent of the reference's `common/event-recorder` crate (reference
+common/event-recorder/src/: a background recorder batching events into
+`greptime_private` system tables) and the slow-query pipeline
+(`SlowQueryTimer` wrapped around frontend queries,
+frontend/src/instance.rs:196-219, recorded into
+greptime_private.slow_queries).
+
+Events are enqueued non-blocking from the hot path; a daemon thread
+drains the queue and writes rows through the normal ingest path, so the
+tables are queryable with plain SQL:
+
+    SELECT * FROM greptime_private.slow_queries
+    SELECT * FROM greptime_private.events
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+
+EVENTS_DATABASE = "greptime_private"
+SLOW_QUERY_TABLE = "slow_queries"
+EVENTS_TABLE = "events"
+
+# `seq` is a per-recorder unique tag: the storage engine dedups on
+# (tags, ts) last-write-wins, so without it two events in the same
+# millisecond would silently collapse to one.
+_SLOW_QUERY_DDL = (
+    f"CREATE TABLE IF NOT EXISTS {SLOW_QUERY_TABLE} ("
+    "  seq STRING,"
+    "  cost_time_ms BIGINT,"
+    "  threshold_ms BIGINT,"
+    "  query STRING,"
+    "  is_promql BOOLEAN,"
+    "  query_database STRING,"
+    "  ts TIMESTAMP(3),"
+    "  TIME INDEX (ts),"
+    "  PRIMARY KEY (seq)"
+    ")"
+)
+
+_EVENTS_DDL = (
+    f"CREATE TABLE IF NOT EXISTS {EVENTS_TABLE} ("
+    "  seq STRING,"
+    "  event_type STRING,"
+    "  payload STRING,"
+    "  ts TIMESTAMP(3),"
+    "  TIME INDEX (ts),"
+    "  PRIMARY KEY (event_type, seq)"
+    ")"
+)
+
+
+class EventRecorder:
+    """Background writer of system events (daemon thread + queue)."""
+
+    def __init__(self, db, flush_interval_s: float = 0.05, max_queue: int = 4096):
+        import os
+        import uuid
+
+        self.db = db
+        self.flush_interval_s = flush_interval_s
+        self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._stop = threading.Event()
+        self._ready = False
+        self._seq_prefix = f"{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        self._seq = 0
+        # flush() synchronization: enqueued vs durably-handled counters
+        self._sync = threading.Condition()
+        self._enqueued = 0
+        self._handled = 0
+        self._thread = threading.Thread(target=self._run, daemon=True, name="event-recorder")
+        self._thread.start()
+
+    # ---- producers (non-blocking, drop on overflow) ------------------------
+    def record_slow_query(
+        self,
+        query: str,
+        cost_time_ms: int,
+        threshold_ms: int,
+        database: str,
+        is_promql: bool = False,
+    ):
+        self._offer(
+            (
+                SLOW_QUERY_TABLE,
+                {
+                    "cost_time_ms": cost_time_ms,
+                    "threshold_ms": threshold_ms,
+                    "query": query,
+                    "is_promql": is_promql,
+                    "query_database": database,
+                    "ts": int(time.time() * 1000),
+                },
+            )
+        )
+
+    def record_event(self, event_type: str, payload: dict):
+        self._offer(
+            (
+                EVENTS_TABLE,
+                {
+                    "event_type": event_type,
+                    "payload": json.dumps(payload),
+                    "ts": int(time.time() * 1000),
+                },
+            )
+        )
+
+    def _offer(self, item):
+        table, row = item
+        with self._sync:
+            self._seq += 1
+            row = {"seq": f"{self._seq_prefix}-{self._seq}", **row}
+        try:
+            self._queue.put_nowait((table, row))
+            with self._sync:
+                self._enqueued += 1
+        except queue.Full:
+            pass  # shed events rather than block the query path
+
+    # ---- consumer ----------------------------------------------------------
+    def _ensure_tables(self):
+        if self._ready:
+            return
+        prev = self.db.current_database
+        try:
+            if EVENTS_DATABASE not in self.db.catalog.databases():
+                self.db.catalog.create_database(EVENTS_DATABASE, if_not_exists=True)
+            self.db.current_database = EVENTS_DATABASE
+            self.db.sql(_SLOW_QUERY_DDL)
+            self.db.sql(_EVENTS_DDL)
+            self._ready = True
+        finally:
+            self.db.current_database = prev
+
+    def _run(self):
+        pending: dict[str, list[dict]] = {}
+        n_pending = 0
+        last_flush = time.time()
+        while not self._stop.is_set() or not self._queue.empty() or pending:
+            try:
+                table, row = self._queue.get(timeout=self.flush_interval_s)
+                pending.setdefault(table, []).append(row)
+                n_pending += 1
+            except queue.Empty:
+                pass
+            now = time.time()
+            if pending and (now - last_flush >= self.flush_interval_s or self._stop.is_set()):
+                self._flush(pending)
+                with self._sync:
+                    self._handled += n_pending
+                    self._sync.notify_all()
+                pending = {}
+                n_pending = 0
+                last_flush = now
+
+    def _flush(self, pending: dict[str, list[dict]]):
+        try:
+            self._ensure_tables()
+            for table, rows in pending.items():
+                cols: dict[str, list] = {}
+                for row in rows:
+                    for k, v in row.items():
+                        cols.setdefault(k, []).append(v)
+                arrays = {}
+                for k, vals in cols.items():
+                    if k == "ts":
+                        arrays[k] = pa.array(np.asarray(vals, dtype=np.int64), pa.timestamp("ms"))
+                    else:
+                        arrays[k] = pa.array(vals)
+                # system=True: the audit log must not be starved by the very
+                # write-pressure incidents it exists to record (the user
+                # write budget does not apply to internal system writes)
+                self.db.insert_rows(
+                    table, pa.record_batch(arrays), database=EVENTS_DATABASE, system=True
+                )
+        except Exception:  # noqa: BLE001 — the recorder must never kill the server
+            import logging
+
+            logging.getLogger("greptimedb_tpu.events").warning(
+                "event recorder flush failed", exc_info=True
+            )
+
+    def flush(self, timeout_s: float = 5.0):
+        """Wait until every event enqueued BEFORE this call has been handed
+        to storage (or dropped after a logged failure)."""
+        with self._sync:
+            target = self._enqueued
+            self._sync.wait_for(lambda: self._handled >= target, timeout=timeout_s)
+
+    def stop(self):
+        self.flush()
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+class SlowQueryTimer:
+    """Context manager timing one query (reference SlowQueryTimer)."""
+
+    def __init__(self, recorder: EventRecorder | None, cfg, query: str, database: str, is_promql=False):
+        self.recorder = recorder
+        self.cfg = cfg
+        self.query = query
+        self.database = database
+        self.is_promql = is_promql
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self.recorder is None or not self.cfg.enable:
+            return False
+        elapsed_ms = int((time.perf_counter() - self._t0) * 1000)
+        if elapsed_ms < self.cfg.threshold_ms:
+            return False
+        import random
+
+        if self.cfg.sample_ratio < 1.0 and random.random() > self.cfg.sample_ratio:
+            return False
+        self.recorder.record_slow_query(
+            self.query, elapsed_ms, self.cfg.threshold_ms, self.database, self.is_promql
+        )
+        return False

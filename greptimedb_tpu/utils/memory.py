@@ -1,0 +1,92 @@
+"""Admission-style memory governance.
+
+Role-equivalent of the reference's memory budgeting surfaces
+(reference common/memory-manager/src/lib.rs policy/guard;
+servers/src/request_memory_limiter.rs `max_in_flight_write_bytes`;
+`max_concurrent_queries` in config/standalone.example.toml): bounded
+in-flight write bytes with fail-fast rejection, and a concurrent-query
+admission gate.  0 budget = unlimited (the reference's default)."""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+from . import metrics
+from .errors import RetryLaterError
+
+WRITE_REJECTED = metrics.Counter(
+    "memory_write_requests_rejected", "writes rejected by the in-flight byte budget"
+)
+QUERY_REJECTED = metrics.Counter(
+    "memory_queries_rejected", "queries rejected by the concurrency gate"
+)
+
+
+class MemoryGovernor:
+    def __init__(self, max_in_flight_write_bytes: int = 0, max_concurrent_queries: int = 0):
+        self.max_write_bytes = max_in_flight_write_bytes
+        self.max_queries = max_concurrent_queries
+        self._lock = threading.Lock()
+        self._in_flight_bytes = 0
+        self._running_queries = 0
+
+    # ---- write admission ---------------------------------------------------
+    @contextmanager
+    def write_guard(self, nbytes: int):
+        """Reserve `nbytes` of write budget for the duration; fail fast with
+        RETRY_LATER when the budget is exhausted (the reference rejects with
+        a retryable status rather than queueing)."""
+        if self.max_write_bytes <= 0:
+            yield
+            return
+        with self._lock:
+            if self._in_flight_bytes + nbytes > self.max_write_bytes:
+                WRITE_REJECTED.inc()
+                raise RetryLaterError(
+                    f"in-flight write bytes budget exceeded "
+                    f"({self._in_flight_bytes} + {nbytes} > {self.max_write_bytes}); retry later"
+                )
+            self._in_flight_bytes += nbytes
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._in_flight_bytes -= nbytes
+
+    # ---- query admission ---------------------------------------------------
+    @contextmanager
+    def query_guard(self):
+        if self.max_queries <= 0:
+            yield
+            return
+        with self._lock:
+            if self._running_queries >= self.max_queries:
+                QUERY_REJECTED.inc()
+                raise RetryLaterError(
+                    f"too many concurrent queries (limit {self.max_queries}); retry later"
+                )
+            self._running_queries += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._running_queries -= 1
+
+    # ---- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "in_flight_write_bytes": self._in_flight_bytes,
+                "max_in_flight_write_bytes": self.max_write_bytes,
+                "running_queries": self._running_queries,
+                "max_concurrent_queries": self.max_queries,
+            }
+
+
+def batch_nbytes(batch) -> int:
+    """Approximate wire size of a RecordBatch (buffer byte sum)."""
+    try:
+        return batch.nbytes
+    except Exception:  # noqa: BLE001
+        return 0

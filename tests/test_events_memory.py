@@ -1,0 +1,197 @@
+"""Event recorder, slow-query log, and memory governance tests.
+
+Mirrors the reference's common/event-recorder (events into
+greptime_private tables), SlowQueryTimer (frontend/src/instance.rs:196),
+and admission memory budgets (common/memory-manager,
+servers request_memory_limiter).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.utils.config import Config
+from greptimedb_tpu.utils.errors import RetryLaterError
+from greptimedb_tpu.utils.memory import MemoryGovernor
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(data_home=str(tmp_path))
+    d.sql("CREATE TABLE t (host STRING, ts TIMESTAMP(3), v DOUBLE, TIME INDEX (ts), PRIMARY KEY (host))")
+    d.sql("INSERT INTO t VALUES ('a', 1000, 1.0), ('b', 2000, 2.0)")
+    yield d
+    d.close()
+
+
+def test_slow_query_recorded(db):
+    db.config.slow_query.threshold_ms = 0  # every query is "slow"
+    db.sql("SELECT * FROM t")
+    db.event_recorder.flush()
+    rows = db.sql_one("SELECT query, cost_time_ms, threshold_ms, query_database FROM greptime_private.slow_queries")
+    queries = rows["query"].to_pylist()
+    assert any("SELECT * FROM t" in q for q in queries)
+    assert all(c >= 0 for c in rows["cost_time_ms"].to_pylist())
+    assert set(rows["query_database"].to_pylist()) == {"public"}
+
+
+def test_slow_query_threshold_filters(db):
+    db.config.slow_query.threshold_ms = 60_000  # nothing is that slow
+    db.sql("SELECT * FROM t")
+    db.event_recorder.flush()
+    assert "greptime_private" not in db.catalog.databases() or (
+        db.sql_one("SELECT count(*) FROM greptime_private.slow_queries")
+        .column(0).to_pylist() == [0]
+    )
+
+
+def test_slow_query_disable(db):
+    db.config.slow_query.enable = False
+    db.config.slow_query.threshold_ms = 0
+    db.sql("SELECT * FROM t")
+    db.event_recorder.flush()
+    assert "greptime_private" not in db.catalog.databases() or (
+        db.sql_one("SELECT count(*) FROM greptime_private.slow_queries")
+        .column(0).to_pylist() == [0]
+    )
+
+
+def test_generic_events(db):
+    db.event_recorder.record_event("region_failover", {"region": 7, "from": 1, "to": 2})
+    db.event_recorder.flush()
+    rows = db.sql_one("SELECT event_type, payload FROM greptime_private.events")
+    assert rows["event_type"].to_pylist() == ["region_failover"]
+    assert '"region": 7' in rows["payload"].to_pylist()[0]
+
+
+def test_tql_slow_query_flagged_promql(db):
+    db.config.slow_query.threshold_ms = 0
+    db.sql("TQL EVAL (0, 10, '5s') t")
+    db.event_recorder.flush()
+    rows = db.sql_one("SELECT query, is_promql FROM greptime_private.slow_queries")
+    flags = dict(zip(rows["query"].to_pylist(), rows["is_promql"].to_pylist()))
+    assert any(flag for q, flag in flags.items() if "TQL" in q)
+
+
+def test_write_budget_rejects_oversize():
+    gov = MemoryGovernor(max_in_flight_write_bytes=100)
+    with gov.write_guard(60):
+        with pytest.raises(RetryLaterError, match="budget exceeded"):
+            with gov.write_guard(60):
+                pass
+    # budget released after the guard exits
+    with gov.write_guard(90):
+        pass
+    assert gov.stats()["in_flight_write_bytes"] == 0
+
+
+def test_query_concurrency_gate():
+    gov = MemoryGovernor(max_concurrent_queries=2)
+    entered = threading.Barrier(3)
+    release = threading.Event()
+    rejected = []
+
+    def long_query():
+        with gov.query_guard():
+            entered.wait()
+            release.wait()
+
+    threads = [threading.Thread(target=long_query) for _ in range(2)]
+    for th in threads:
+        th.start()
+    entered.wait()
+    with pytest.raises(RetryLaterError, match="concurrent queries"):
+        with gov.query_guard():
+            pass
+    rejected.append(True)
+    release.set()
+    for th in threads:
+        th.join()
+    with gov.query_guard():
+        pass  # slots free again
+
+
+def test_db_write_budget_integration(tmp_path):
+    cfg = Config()
+    cfg.storage.data_home = str(tmp_path)
+    cfg.storage.wal_dir = ""
+    cfg.storage.sst_dir = ""
+    cfg.storage.__post_init__()
+    cfg.memory.max_in_flight_write_bytes = 1  # everything is too big
+    d = Database(config=cfg)
+    d.sql("CREATE TABLE t (ts TIMESTAMP(3), v DOUBLE, TIME INDEX (ts))")
+    with pytest.raises(RetryLaterError):
+        d.insert_rows(
+            "t",
+            pa.record_batch(
+                {
+                    "ts": pa.array(np.arange(100, dtype=np.int64), pa.timestamp("ms")),
+                    "v": pa.array(np.ones(100)),
+                }
+            ),
+        )
+    d.close()
+
+
+def test_db_query_gate_integration(tmp_path):
+    cfg = Config()
+    cfg.storage.data_home = str(tmp_path)
+    cfg.storage.wal_dir = ""
+    cfg.storage.sst_dir = ""
+    cfg.storage.__post_init__()
+    cfg.memory.max_concurrent_queries = 1
+    d = Database(config=cfg)
+    d.sql("CREATE TABLE t (ts TIMESTAMP(3), v DOUBLE, TIME INDEX (ts))")
+    d.sql("INSERT INTO t VALUES (1000, 1.0)")
+
+    started = threading.Event()
+    release = threading.Event()
+    orig = d.storage.scan
+
+    def slow_scan(rid, pred):
+        started.set()
+        release.wait(5)
+        return orig(rid, pred)
+
+    d.storage.scan = slow_scan
+    th = threading.Thread(target=lambda: d.sql("SELECT * FROM t"))
+    th.start()
+    started.wait(5)
+    d.storage.scan = orig
+    with pytest.raises(RetryLaterError):
+        d.sql("SELECT * FROM t")
+    release.set()
+    th.join()
+    d.sql("SELECT * FROM t")  # gate released
+    d.close()
+
+
+def test_event_burst_same_millisecond_all_survive(db):
+    """Events sharing a millisecond must not collapse in storage dedup
+    (each carries a unique seq tag)."""
+    for i in range(25):
+        db.event_recorder.record_event("burst", {"i": i})
+    db.event_recorder.flush()
+    n = db.sql_one("SELECT count(*) FROM greptime_private.events").column(0).to_pylist()[0]
+    assert n == 25
+
+
+def test_recorder_survives_write_pressure(tmp_path):
+    """The audit log bypasses the user write budget: events are recorded
+    even when user writes are being rejected."""
+    cfg = Config()
+    cfg.storage.data_home = str(tmp_path)
+    cfg.storage.wal_dir = ""
+    cfg.storage.sst_dir = ""
+    cfg.storage.__post_init__()
+    cfg.memory.max_in_flight_write_bytes = 1
+    d = Database(config=cfg)
+    d.event_recorder.record_event("overload", {"x": 1})
+    d.event_recorder.flush()
+    n = d.sql_one("SELECT count(*) FROM greptime_private.events").column(0).to_pylist()[0]
+    assert n == 1
+    d.close()

@@ -158,3 +158,20 @@ def test_prometheus_api(server):
     assert json.loads(body)["data"] == ["h0", "h1"]
     status, body = _get(srv, "/v1/prometheus/api/v1/label/__name__/values")
     assert "reqs" in json.loads(body)["data"]
+
+
+def test_influx_write_with_form_content_type(server):
+    """Clients that default to x-www-form-urlencoded (urllib, some SDKs)
+    must still deliver line-protocol bodies (regression: the form parser
+    used to consume the body and silently write nothing)."""
+    srv, db = server
+    body = b"formcpu,host=h1 v=42 1700000000000000000"
+    req = urllib.request.Request(
+        f"http://{srv.address}/v1/influxdb/write", data=body,
+        headers={"Content-Type": "application/x-www-form-urlencoded"},
+    )
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 204
+    t = db.sql_one("SELECT host, v FROM formcpu")
+    assert t["host"].to_pylist() == ["h1"]
+    assert t["v"].to_pylist() == [42.0]
