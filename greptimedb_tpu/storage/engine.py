@@ -34,10 +34,33 @@ class TimeSeriesEngine:
         self.config = config or StorageConfig()
         os.makedirs(self.config.data_home, exist_ok=True)
         # SSTs + manifests live behind the object-store abstraction
-        # (fs by default); the WAL stays a local append log like the
-        # reference's raft-engine store.
+        # (fs by default); the WAL is a local append log (raft-engine
+        # analogue) or a shared-topic remote WAL for failover deployments.
         self.object_store = build_object_store(self.config)
-        self.wal_mgr = WalManager(self.config.wal_dir, fsync=self.config.wal_fsync)
+        provider = getattr(self.config, "wal_provider", "local")
+        if provider == "local":
+            self.wal_mgr = WalManager(self.config.wal_dir, fsync=self.config.wal_fsync)
+        elif provider == "shared_file":
+            from .remote_wal import RemoteWalManager
+
+            self.wal_mgr = RemoteWalManager(
+                self.config.wal_dir,
+                fsync=self.config.wal_fsync,
+                num_topics=getattr(self.config, "wal_num_topics", 4),
+                segment_bytes=getattr(self.config, "wal_segment_mb", 4) << 20,
+            )
+        elif provider == "kafka":
+            from ..utils.errors import ConfigError
+
+            raise ConfigError(
+                "wal provider 'kafka' requires network access, which this build "
+                "does not ship; use 'shared_file' on shared storage for the "
+                "same failover semantics"
+            )
+        else:
+            from ..utils.errors import ConfigError
+
+            raise ConfigError(f"unknown wal provider {provider!r}")
         self.buffer_mgr = WriteBufferManager(
             global_limit_bytes=self.config.global_write_buffer_size_mb << 20,
             region_limit_bytes=self.config.write_buffer_size_mb << 20,

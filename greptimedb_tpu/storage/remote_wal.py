@@ -1,0 +1,342 @@
+"""Shared-topic ("remote") WAL: the stateless-datanode failover log.
+
+Role-equivalent of the reference's Kafka log store
+(reference log-store/src/kafka/log_store.rs:70 — shared topics carrying
+entries of many regions, a per-region index to demultiplex on replay,
+high-watermark tracking, and WAL pruning that advances the topic trim
+point once every region flushed past it, reference
+meta-srv/src/procedure/wal_prune/ + RFC 2025-02-06-remote-wal-purge.md).
+
+This build ships a file-backed implementation of the same interface (a
+real Kafka backend needs network access, which this environment gates;
+the config surface matches so a deployment can swap one in):
+
+  * topic = a directory of CRC-framed segment files; the segment roll
+    boundary is the pruning unit (Kafka's segment deletion);
+  * entries carry (region_id, entry_id) so one topic serves many regions
+    (reference entry_distributor/entry_reader demultiplexing);
+  * `obsolete` only advances the region's flushed watermark — physical
+    deletion happens in `prune`, segment-at-a-time, once every region
+    with entries in the segment has flushed past them (exactly the
+    reference's prune condition);
+  * replay tolerates torn tails in the ACTIVE segment (crash mid-append)
+    but refuses corruption in sealed segments.
+
+Because topics live on shared storage, any datanode can replay any
+region — the property that makes region failover possible without
+copying data (reference: datanode replays from Kafka on open).
+
+Frame layout (little-endian):
+    [u32 payload_len][u32 crc32(payload)][u64 region_id][u64 entry_id][payload]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+
+import pyarrow as pa
+
+from ..utils.errors import StorageError
+from .wal import WalEntry, _decode_batch, _encode_batch
+
+_FRAME = struct.Struct("<IIQQ")
+SEGMENT_BYTES_DEFAULT = 4 << 20
+
+
+class SharedLogStore:
+    """Topic-sharded shared append log on a common directory."""
+
+    def __init__(self, root: str, fsync: bool = False, segment_bytes: int = SEGMENT_BYTES_DEFAULT):
+        self.root = root
+        self.fsync = fsync
+        self.segment_bytes = segment_bytes
+        self._lock = threading.RLock()
+        self._active: dict[str, "_ActiveSegment"] = {}
+        # region flushed watermarks (the per-region index the reference
+        # keeps alongside Kafka), persisted so prune survives restarts
+        self._flushed: dict[str, int] = {}
+        os.makedirs(root, exist_ok=True)
+        self._flushed_path = os.path.join(root, "flushed.json")
+        if os.path.exists(self._flushed_path):
+            with open(self._flushed_path) as f:
+                self._flushed = {k: int(v) for k, v in json.load(f).items()}
+
+    # ---- topics ------------------------------------------------------------
+    def _topic_dir(self, topic: str) -> str:
+        d = os.path.join(self.root, topic)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def topics(self) -> list[str]:
+        return sorted(
+            n for n in os.listdir(self.root) if os.path.isdir(os.path.join(self.root, n))
+        )
+
+    def _segments(self, topic: str) -> list[str]:
+        d = self._topic_dir(topic)
+        return sorted(n for n in os.listdir(d) if n.endswith(".seg"))
+
+    def _active_segment(self, topic: str) -> "_ActiveSegment":
+        seg = self._active.get(topic)
+        if seg is None:
+            names = self._segments(topic)
+            base = int(names[-1].split(".")[0]) + 1 if names else 0
+            seg = _ActiveSegment(self._topic_dir(topic), base, self.fsync)
+            # adopt the newest on-disk segment if it has no sealed index yet
+            if names and not os.path.exists(
+                os.path.join(self._topic_dir(topic), names[-1] + ".idx")
+            ):
+                seg = _ActiveSegment.adopt(
+                    self._topic_dir(topic), int(names[-1].split(".")[0]), self.fsync
+                )
+            self._active[topic] = seg
+        return seg
+
+    # ---- write -------------------------------------------------------------
+    def append(self, topic: str, region_id: int, entry_id: int, batch: pa.RecordBatch):
+        payload = _encode_batch(batch)
+        frame = _FRAME.pack(len(payload), zlib.crc32(payload), region_id, entry_id) + payload
+        with self._lock:
+            seg = self._active_segment(topic)
+            seg.write(frame, region_id, entry_id)
+            if seg.size >= self.segment_bytes:
+                seg.seal()
+                self._active.pop(topic, None)
+
+    # ---- read --------------------------------------------------------------
+    def read(self, topic: str, region_id: int, from_entry_id: int):
+        """Yield WalEntry of `region_id` with id > from_entry_id, in order."""
+        with self._lock:
+            names = self._segments(topic)
+            active = self._active.get(topic)
+            if active is not None:
+                active.flush()
+        d = self._topic_dir(topic)
+        for i, name in enumerate(names):
+            sealed = os.path.exists(os.path.join(d, name + ".idx"))
+            yield from self._read_segment(
+                os.path.join(d, name), region_id, from_entry_id, tolerate_tail=not sealed or i == len(names) - 1
+            )
+
+    def _read_segment(self, path: str, region_id: int, from_entry_id: int, tolerate_tail: bool):
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(_FRAME.size)
+                if len(header) < _FRAME.size:
+                    if header and not tolerate_tail:
+                        raise StorageError(f"corrupt sealed wal segment {path}")
+                    return
+                length, crc, rid, entry_id = _FRAME.unpack(header)
+                payload = f.read(length)
+                if len(payload) < length or zlib.crc32(payload) != crc:
+                    if not tolerate_tail:
+                        raise StorageError(f"corrupt sealed wal segment {path}")
+                    return  # torn tail of the active segment — stop here
+                if rid == region_id and entry_id > from_entry_id:
+                    yield WalEntry(entry_id, _decode_batch(payload))
+
+    def last_entry_id(self, topic: str, region_id: int) -> int:
+        last = 0
+        for entry in self.read(topic, region_id, 0):
+            last = entry.entry_id
+        return max(last, self._flushed.get(str(region_id), 0))
+
+    # ---- flush watermarks & pruning ---------------------------------------
+    def _reload_flushed_locked(self):
+        """Merge watermarks other store instances persisted (multiple
+        datanodes share this directory like they'd share a Kafka cluster;
+        max-merge keeps the map monotonic under racy writers)."""
+        if os.path.exists(self._flushed_path):
+            try:
+                with open(self._flushed_path) as f:
+                    on_disk = json.load(f)
+            except ValueError:
+                return
+            for k, v in on_disk.items():
+                if int(v) > self._flushed.get(k, 0):
+                    self._flushed[k] = int(v)
+
+    def set_flushed(self, region_id: int, entry_id: int):
+        with self._lock:
+            key = str(region_id)
+            if self._flushed.get(key, 0) >= entry_id:
+                return
+            self._reload_flushed_locked()
+            self._flushed[key] = max(self._flushed.get(key, 0), entry_id)
+            tmp = self._flushed_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._flushed, f)
+            os.replace(tmp, self._flushed_path)
+
+    def flushed(self, region_id: int) -> int:
+        return self._flushed.get(str(region_id), 0)
+
+    def prune(self, topic: str) -> int:
+        """Delete sealed segments whose every entry is flushed; returns the
+        number of segments removed (the reference's wal_prune procedure
+        advances Kafka's trim point under the same condition)."""
+        removed = 0
+        d = self._topic_dir(topic)
+        with self._lock:
+            self._reload_flushed_locked()  # see other datanodes' flush marks
+            for name in self._segments(topic):
+                idx_path = os.path.join(d, name + ".idx")
+                if not os.path.exists(idx_path):
+                    break  # active segment — nothing newer is prunable either
+                with open(idx_path) as f:
+                    max_by_region = json.load(f)
+                if all(
+                    self._flushed.get(rid, 0) >= max_id
+                    for rid, max_id in max_by_region.items()
+                ):
+                    os.remove(os.path.join(d, name))
+                    os.remove(idx_path)
+                    removed += 1
+                else:
+                    break  # keep order: never punch holes in the log
+        return removed
+
+    def prune_all(self) -> int:
+        return sum(self.prune(t) for t in self.topics())
+
+    def close(self):
+        with self._lock:
+            for seg in self._active.values():
+                seg.flush()
+                seg.close()
+            self._active.clear()
+
+
+class _ActiveSegment:
+    """The topic's open segment; sealing writes a {region: max_entry} index
+    sidecar that prune uses (the reference tracks the same per-region max
+    offsets in its Kafka index)."""
+
+    def __init__(self, topic_dir: str, base: int, fsync: bool):
+        self.path = os.path.join(topic_dir, f"{base:020d}.seg")
+        self.fsync = fsync
+        self._file = open(self.path, "ab")
+        self.size = os.path.getsize(self.path)
+        self.max_by_region: dict[str, int] = {}
+
+    @classmethod
+    def adopt(cls, topic_dir: str, base: int, fsync: bool) -> "_ActiveSegment":
+        """Reopen an unsealed segment after restart, rebuilding its index
+        from the frames (torn tail tolerated)."""
+        seg = cls(topic_dir, base, fsync)
+        with open(seg.path, "rb") as f:
+            while True:
+                header = f.read(_FRAME.size)
+                if len(header) < _FRAME.size:
+                    break
+                length, crc, rid, entry_id = _FRAME.unpack(header)
+                payload = f.read(length)
+                if len(payload) < length or zlib.crc32(payload) != crc:
+                    break
+                key = str(rid)
+                seg.max_by_region[key] = max(seg.max_by_region.get(key, 0), entry_id)
+        return seg
+
+    def write(self, frame: bytes, region_id: int, entry_id: int):
+        self._file.write(frame)
+        self._file.flush()
+        if self.fsync:
+            os.fsync(self._file.fileno())
+        self.size += len(frame)
+        key = str(region_id)
+        self.max_by_region[key] = max(self.max_by_region.get(key, 0), entry_id)
+
+    def flush(self):
+        self._file.flush()
+
+    def seal(self):
+        self.flush()
+        if self.fsync:
+            os.fsync(self._file.fileno())
+        self._file.close()
+        with open(self.path + ".idx.tmp", "w") as f:
+            json.dump(self.max_by_region, f)
+        os.replace(self.path + ".idx.tmp", self.path + ".idx")
+
+    def close(self):
+        try:
+            self._file.close()
+        except ValueError:
+            pass
+
+
+class RemoteRegionWal:
+    """RegionWal-compatible adapter over a SharedLogStore topic
+    (the reference's `Wal<KafkaLogStore>`)."""
+
+    def __init__(self, store: SharedLogStore, topic: str, region_id: int):
+        self.store = store
+        self.topic = topic
+        self.region_id = region_id
+        self._lock = threading.Lock()
+        self.last_entry_id = store.last_entry_id(topic, region_id)
+
+    def advance_to(self, entry_id: int):
+        with self._lock:
+            self.last_entry_id = max(self.last_entry_id, entry_id)
+
+    def append(self, batch: pa.RecordBatch) -> int:
+        with self._lock:
+            entry_id = self.last_entry_id + 1
+            self.store.append(self.topic, self.region_id, entry_id, batch)
+            self.last_entry_id = entry_id
+            return entry_id
+
+    def replay(self, from_entry_id: int):
+        yield from self.store.read(self.topic, self.region_id, from_entry_id)
+
+    def obsolete(self, up_to_entry_id: int):
+        """Advance the flushed watermark only — the shared topic is pruned
+        segment-wise by the wal-prune procedure (reference logstore
+        obsolete on Kafka likewise only moves indexes)."""
+        self.store.set_flushed(self.region_id, up_to_entry_id)
+
+    def close(self):
+        pass  # topic files are owned by the store
+
+
+class RemoteWalManager:
+    """WalManager facade over shared topics (reference topic_region mapping:
+    region -> topic by modulo, common/meta/src/key/topic_region.rs)."""
+
+    def __init__(self, wal_dir: str, fsync: bool = False, num_topics: int = 4,
+                 segment_bytes: int = SEGMENT_BYTES_DEFAULT):
+        self.store = SharedLogStore(wal_dir, fsync=fsync, segment_bytes=segment_bytes)
+        self.num_topics = max(1, num_topics)
+        self._regions: dict[int, RemoteRegionWal] = {}
+        self._lock = threading.Lock()
+
+    def topic_of(self, region_id: int) -> str:
+        return f"topic_{region_id % self.num_topics}"
+
+    def region_wal(self, region_id: int) -> RemoteRegionWal:
+        with self._lock:
+            wal = self._regions.get(region_id)
+            if wal is None:
+                wal = RemoteRegionWal(self.store, self.topic_of(region_id), region_id)
+                self._regions[region_id] = wal
+            return wal
+
+    def drop_region(self, region_id: int):
+        with self._lock:
+            wal = self._regions.pop(region_id, None)
+        if wal is not None:
+            # everything this region wrote becomes prunable
+            self.store.set_flushed(region_id, wal.last_entry_id)
+
+    def prune(self) -> int:
+        return self.store.prune_all()
+
+    def close(self):
+        with self._lock:
+            self._regions.clear()
+        self.store.close()

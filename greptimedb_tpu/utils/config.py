@@ -57,6 +57,14 @@ class StorageConfig:
     index_enable: bool = True
     index_segment_rows: int = 1024  # bloom/inverted segment granularity
     index_inverted_max_terms: int = 4096  # cardinality cap for inverted index
+    # WAL provider (reference `[wal] provider = "raft_engine" | "kafka"`):
+    # "local" = per-region append logs (raft-engine analogue);
+    # "shared_file" = shared-topic segmented log on wal_dir (the remote-WAL
+    # interface with a file backend — point wal_dir at shared storage for
+    # stateless-datanode failover); "kafka" is surfaced but gated (no egress).
+    wal_provider: str = "local"
+    wal_num_topics: int = 4
+    wal_segment_mb: int = 4
     # Object store under SSTs/manifests (reference `[storage]` with OpenDAL
     # fs/s3/gcs/oss/azblob builders).  Remote types are surfaced but gated in
     # this build (no egress); "memory" exists for tests.
