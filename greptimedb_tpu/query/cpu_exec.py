@@ -108,6 +108,7 @@ def _eval_binary(e: BinaryOp, table: pa.Table):
     }
     if op in cmp:
         l, r = _align_ts(l, r)
+        l, r = _coerce_literal(l, r)
         return cmp[op](l, r)
     arith = {"+": pc.add, "-": pc.subtract, "*": pc.multiply, "/": pc.divide, "%": _mod}
     if op in arith:
@@ -136,6 +137,22 @@ def _align_ts(l, r):
     if is_ts(r) and isinstance(l, pa.Scalar) and not is_ts(l):
         rr, ll = _align_ts(r, l)
         return ll, rr
+    return l, r
+
+
+def _coerce_literal(l, r):
+    """String literal vs numeric/bool column — shared rule, see
+    datatypes/coercion.py."""
+    from ..datatypes.coercion import coerce_string_scalar
+
+    def col_type(x):
+        return x.type if isinstance(x, (pa.Array, pa.ChunkedArray)) else None
+
+    lt, rt = col_type(l), col_type(r)
+    if lt is not None:
+        r = coerce_string_scalar(r, lt)
+    if rt is not None:
+        l = coerce_string_scalar(l, rt)
     return l, r
 
 

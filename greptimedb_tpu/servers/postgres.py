@@ -267,8 +267,25 @@ class _Handler(socketserver.BaseRequestHandler):
                 kind = body[0:1]
                 name, _ = _read_cstr(body, 1)
                 if kind == b"S":
-                    sock.sendall(_Msg.parameter_description(0))
-                    sock.sendall(_Msg.no_data())
+                    # Drivers that describe by statement (psycopg3, JDBC) need
+                    # the RowDescription before Execute streams DataRows; probe
+                    # the query with NULL params to learn the result schema
+                    stmt_sql = statements.get(name, "")
+                    nparams = _count_params(stmt_sql)
+                    sock.sendall(_Msg.parameter_description(nparams))
+                    if stmt_sql and self._returns_rows(stmt_sql):
+                        try:
+                            # schema-only probe: NULL params + LIMIT 0 where
+                            # the statement shape allows it (results are never
+                            # cached — Execute sees live data)
+                            probe = srv.db.sql_one(
+                                _limit0(_substitute(stmt_sql, [None] * nparams))
+                            )
+                            sock.sendall(_Msg.row_description(probe))
+                        except Exception:  # noqa: BLE001 — fall back to NoData
+                            sock.sendall(_Msg.no_data())
+                    else:
+                        sock.sendall(_Msg.no_data())
                     continue
                 p = portals.get(name)
                 # libpq requires the RowDescription here for row-returning
@@ -455,15 +472,62 @@ def _tag_of(stmt) -> str:
     }.get(name, "OK")
 
 
+import re as _re
+
+_QUOTED = _re.compile(r"'(?:[^']|'')*'")
+
+
+def _count_params(sql: str) -> int:
+    """Highest $n placeholder index (0 if none); '...'-quoted regions are
+    not placeholders ('won $100' is a literal)."""
+    stripped = _QUOTED.sub("''", sql)
+    return max((int(m) for m in _re.findall(r"\$(\d+)", stripped)), default=0)
+
+
 def _substitute(sql: str, params: list[str | None]) -> str:
-    """Replace $1..$n with quoted literals (the reference emulates prepared
-    statements by parameter substitution the same way, mysql handler.rs)."""
-    out = sql
-    for i in reversed(range(len(params))):  # $10 before $1
+    """Replace $1..$n with quoted literals OUTSIDE string literals (the
+    reference emulates prepared statements by parameter substitution the
+    same way, mysql handler.rs — 'cost $1' stays a literal)."""
+    def render(i: int) -> str:
         v = params[i]
-        lit = "NULL" if v is None else "'" + v.replace("'", "''") + "'"
-        out = out.replace(f"${i + 1}", lit)
-    return out
+        return "NULL" if v is None else "'" + v.replace("'", "''") + "'"
+
+    out = []
+    last = 0
+    for m in _QUOTED.finditer(sql):
+        out.append(_sub_span(sql[last : m.start()], render, len(params)))
+        out.append(m.group(0))
+        last = m.end()
+    out.append(_sub_span(sql[last:], render, len(params)))
+    return "".join(out)
+
+
+def _sub_span(span: str, render, n: int) -> str:
+    for i in reversed(range(n)):  # $10 before $1
+        span = span.replace(f"${i + 1}", render(i))
+    return span
+
+
+def _limit0(sql: str) -> str:
+    """Rewrite a SELECT into its zero-row schema probe when the statement
+    shape allows; otherwise return it unchanged (double execution is the
+    fallback cost, not a correctness issue)."""
+    try:
+        from ..query.sql_parser import SelectStmt, parse_sql
+
+        stmts = parse_sql(sql)
+        if (
+            len(stmts) == 1
+            and isinstance(stmts[0], SelectStmt)
+            and stmts[0].limit is None
+            and stmts[0].align is None  # RANGE grammar: don't append blindly
+        ):
+            rewritten = sql.rstrip().rstrip(";") + " LIMIT 0"
+            parse_sql(rewritten)  # reject if the rewrite broke the grammar
+            return rewritten
+    except Exception:  # noqa: BLE001 — probe rewrite must never break Describe
+        pass
+    return sql
 
 
 class _ThreadingTCPServer(socketserver.ThreadingTCPServer):

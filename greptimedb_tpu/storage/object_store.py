@@ -20,6 +20,7 @@ path (mmap-friendly for parquet), others a `pa.BufferReader`.
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 from collections import OrderedDict
@@ -487,6 +488,30 @@ class WriteCacheLayer(ObjectStore):
             return os.path.getsize(local)
         return self.inner.size(key)
 
+    # staging files older than this are crash leftovers; in-flight _stage
+    # writes live for milliseconds, so an hour protects concurrent processes
+    # sharing the cache dir as well as our own threads
+    PURGE_TMP_AGE_SECS = 3600
+
+    def purge_incomplete(self, prefix=""):
+        # crash leftovers: staging files that never got os.replace'd.
+        # Only the exact _stage() suffix pattern, never current-process files
+        # (a concurrent _stage may be mid-write), and never young files
+        # (another process sharing this dir may be mid-write).
+        pat = re.compile(r"\.tmp(\d+)\.\d+$")
+        now = time.time()
+        for name in os.listdir(self.cache_dir):
+            m = pat.search(name)
+            if not m or int(m.group(1)) == os.getpid():
+                continue
+            path = os.path.join(self.cache_dir, name)
+            try:
+                if now - os.path.getmtime(path) > self.PURGE_TMP_AGE_SECS:
+                    os.remove(path)
+            except FileNotFoundError:
+                pass
+        self.inner.purge_incomplete(prefix)
+
 
 _REMOTE_TYPES = ("s3", "gcs", "oss", "azblob")
 
@@ -519,10 +544,6 @@ def build_object_store(cfg) -> ObjectStore:
     if cache_mb:
         store = LruCacheLayer(store, capacity_bytes=cache_mb << 20)
     return store
-
-
-    def purge_incomplete(self, prefix=""):
-        self.inner.purge_incomplete(prefix)
 
 
 class ObjectStoreManager:

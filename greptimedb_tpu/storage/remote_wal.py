@@ -83,16 +83,15 @@ class SharedLogStore:
     def _active_segment(self, topic: str) -> "_ActiveSegment":
         seg = self._active.get(topic)
         if seg is None:
+            d = self._topic_dir(topic)
             names = self._segments(topic)
-            base = int(names[-1].split(".")[0]) + 1 if names else 0
-            seg = _ActiveSegment(self._topic_dir(topic), base, self.fsync)
-            # adopt the newest on-disk segment if it has no sealed index yet
-            if names and not os.path.exists(
-                os.path.join(self._topic_dir(topic), names[-1] + ".idx")
-            ):
-                seg = _ActiveSegment.adopt(
-                    self._topic_dir(topic), int(names[-1].split(".")[0]), self.fsync
-                )
+            # adopt the newest on-disk segment if it has no sealed index yet;
+            # decide before constructing so no stray empty segment is created
+            if names and not os.path.exists(os.path.join(d, names[-1] + ".idx")):
+                seg = _ActiveSegment.adopt(d, int(names[-1].split(".")[0]), self.fsync)
+            else:
+                base = int(names[-1].split(".")[0]) + 1 if names else 0
+                seg = _ActiveSegment(d, base, self.fsync)
             self._active[topic] = seg
         return seg
 
@@ -116,11 +115,19 @@ class SharedLogStore:
             if active is not None:
                 active.flush()
         d = self._topic_dir(topic)
-        for i, name in enumerate(names):
+        for name in names:
+            # sealed segments are strict: a roll with no new appends still
+            # leaves a sealed tail that must parse cleanly
             sealed = os.path.exists(os.path.join(d, name + ".idx"))
-            yield from self._read_segment(
-                os.path.join(d, name), region_id, from_entry_id, tolerate_tail=not sealed or i == len(names) - 1
-            )
+            try:
+                yield from self._read_segment(
+                    os.path.join(d, name), region_id, from_entry_id,
+                    tolerate_tail=not sealed,
+                )
+            except FileNotFoundError:
+                # pruned concurrently — prune only removes fully-flushed
+                # segments, so nothing this replay needs was lost
+                continue
 
     def _read_segment(self, path: str, region_id: int, from_entry_id: int, tolerate_tail: bool):
         with open(path, "rb") as f:
@@ -254,12 +261,16 @@ class _ActiveSegment:
         self._file.flush()
 
     def seal(self):
+        # The .idx sidecar marks the segment sealed, and replay treats sealed
+        # segments strictly — so the data must be durable BEFORE the marker
+        # appears, even when per-write fsync is off (one fsync per roll).
         self.flush()
-        if self.fsync:
-            os.fsync(self._file.fileno())
+        os.fsync(self._file.fileno())
         self._file.close()
         with open(self.path + ".idx.tmp", "w") as f:
             json.dump(self.max_by_region, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(self.path + ".idx.tmp", self.path + ".idx")
 
     def close(self):

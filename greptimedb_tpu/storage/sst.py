@@ -346,6 +346,10 @@ def _apply_residual(table: pa.Table, pred: ScanPredicate, ts_name) -> pa.Table:
 
 
 def _cmp(col, op: str, value):
+    if isinstance(value, str):
+        from ..datatypes.coercion import coerce_string_scalar
+
+        value = coerce_string_scalar(value, col.type)
     if op == "=":
         return pc.equal(col, value)
     if op == "!=":
