@@ -1,136 +1,265 @@
-"""Benchmark: TSBS double-groupby-1 analogue on the TPU query path.
+"""End-to-end TSBS benchmark through the FULL engine path.
 
-Workload (mirrors the reference's TSBS double-groupby-1, BASELINE.md:19 —
-mean of 1 CPU metric per (hour, host) over 12h across all 4000 hosts):
-  4000 hosts x 12h @ 10s scrape = 17.28M rows,
-  SELECT avg(usage_user) GROUP BY time_bucket(1h, ts), host  -> 48k groups.
+Unlike round 1 (a kernel micro-benchmark on pre-staged device arrays), every
+number here is measured through `Database.sql()`: SQL parse -> plan -> TPU
+lowering -> HBM tile cache (parallel/tile_cache.py) -> one compiled dispatch
+-> finalized Arrow result.  Data is really ingested (the servers'
+`insert_rows` path: partition split, WAL, memtable) and really flushed to
+Parquet SSTs first; the cold run pays Parquet decode + dictionary encode +
+H2D upload, warm runs hit the HBM-resident tiles — the engine's design
+point, matching the reference's warm-page-cache TSBS runs.
 
-Reference number: 673.08 ms (GreptimeDB v0.12.0 on EC2 c5d.2xlarge,
-docs/benchmarks/tsbs/v0.12.0.md:27).  vs_baseline = reference_ms / ours_ms
-(>1 = faster than reference).
+Workload (reference docs/benchmarks/tsbs/v0.12.0.md, BASELINE.md): scale
+4000 hosts @ 10s scrape, 10 CPU metrics.  Dataset spans GRAFT_BENCH_HOURS
+(default 24; TSBS uses 3 days) and queries touch the TSBS-defined windows.
+Reference numbers: GreptimeDB v0.12.0 on EC2 c5d.2xlarge (8 vCPU).
 
-Measured: steady-state query latency with tiles resident in HBM (the
-framework's design point: SSTs are tiled into an HBM cache; the reference's
-TSBS runs likewise hit a warm page cache).  Prints ONE JSON line.
+Prints ONE JSON line; headline = double-groupby-1 warm end-to-end p50.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
+import pyarrow as pa
 
-REFERENCE_MS = 673.08
-N_HOSTS = 4000
-HOURS = 12
+from greptimedb_tpu.utils.jax_env import ensure_x64
+
+N_HOSTS = int(os.environ.get("GRAFT_BENCH_HOSTS", 4000))
+HOURS = int(os.environ.get("GRAFT_BENCH_HOURS", 24))
 SCRAPE_S = 10
-BUCKET_MS = 3_600_000
+T0 = 1_767_225_600_000  # 2026-01-01 UTC, epoch ms
+METRICS = [
+    "usage_user", "usage_system", "usage_idle", "usage_nice", "usage_iowait",
+    "usage_irq", "usage_softirq", "usage_steal", "usage_guest", "usage_guest_nice",
+]
+WARM_REPS = int(os.environ.get("GRAFT_BENCH_REPS", 5))
+
+# 12h query window ending at the dataset's end (TSBS picks random windows
+# inside the dataset; fixed here for determinism)
+END = T0 + HOURS * 3600_000
+W12 = (END - 12 * 3600_000, END)
+W8 = (END - 8 * 3600_000, END)
+W1 = (END - 3600_000, END)
+
+HOST1 = f"host_{703 % N_HOSTS}"
+HOSTS8 = [
+    f"host_{i % N_HOSTS}" for i in (703, 1217, 2048, 99, 3777, 1500, 2901, 42)
+]
+
+
+def _q(window, metrics_n, hosts=None, bucket="1h", funcs="max"):
+    lo, hi = window
+    cols = ", ".join(f"{funcs}({m}) AS {funcs}_{m}" for m in METRICS[:metrics_n])
+    where = f"ts >= {lo} AND ts < {hi}"
+    if hosts is not None:
+        where += (
+            f" AND hostname = '{hosts}'"
+            if isinstance(hosts, str)
+            else f" AND hostname IN ({', '.join(repr(h) for h in hosts)})"
+        )
+    group = "tb" if hosts is not None else "hostname, tb"
+    sel_host = "" if hosts is not None else "hostname, "
+    return (
+        f"SELECT {sel_host}time_bucket('{bucket}', ts) AS tb, {cols} "
+        f"FROM cpu WHERE {where} GROUP BY {group}"
+    )
+
+
+QUERIES = [
+    # (name, sql, reference_ms)
+    ("double-groupby-1", _q(W12, 1, funcs="avg"), 673.08),
+    ("double-groupby-5", _q(W12, 5, funcs="avg"), 963.99),
+    ("double-groupby-all", _q(W12, 10, funcs="avg"), 1330.05),
+    ("cpu-max-all-1", _q(W8, 10, hosts=HOST1), 12.46),
+    ("cpu-max-all-8", _q(W8, 10, hosts=HOSTS8), 24.20),
+    ("single-groupby-1-1-1", _q(W1, 1, hosts=HOST1, bucket="1m"), 4.06),
+    ("single-groupby-1-1-12", _q(W12, 1, hosts=HOST1, bucket="1m"), 4.73),
+    ("single-groupby-1-8-1", _q(W1, 1, hosts=HOSTS8, bucket="1m"), 8.23),
+    ("single-groupby-5-1-1", _q(W1, 5, hosts=HOST1, bucket="1m"), 4.61),
+    ("single-groupby-5-1-12", _q(W12, 5, hosts=HOST1, bucket="1m"), 5.61),
+    ("single-groupby-5-8-1", _q(W1, 5, hosts=HOSTS8, bucket="1m"), 9.74),
+    (
+        "groupby-orderby-limit",
+        f"SELECT time_bucket('1m', ts) AS minute, max(usage_user) AS mu FROM cpu "
+        f"WHERE ts < {END - 1800_000} GROUP BY minute ORDER BY minute DESC LIMIT 5",
+        952.46,
+    ),
+    (
+        "lastpoint",
+        "SELECT hostname, last_value(usage_user) AS last_user FROM cpu GROUP BY hostname",
+        591.02,
+    ),
+    (
+        "high-cpu-all",
+        f"SELECT count(*) AS n, max(usage_user) AS m FROM cpu "
+        f"WHERE usage_user > 90.0 AND ts >= {W12[0]} AND ts < {W12[1]}",
+        4638.57,
+    ),
+    (
+        "high-cpu-1",
+        f"SELECT count(*) AS n, max(usage_user) AS m FROM cpu "
+        f"WHERE usage_user > 90.0 AND hostname = '{HOST1}' "
+        f"AND ts >= {W12[0]} AND ts < {W12[1]}",
+        5.08,
+    ),
+]
 
 
 def main():
+    ensure_x64()
+    import tempfile
+
     import jax
+
+    from greptimedb_tpu.database import Database
+
+    out_detail: dict = {"device": str(jax.devices()[0])}
+    home = tempfile.mkdtemp(prefix="graft_bench_")
+    db = Database(data_home=home)
+    cols_sql = ", ".join(f"{m} DOUBLE" for m in METRICS)
+    db.sql(
+        f"CREATE TABLE cpu (hostname STRING, ts TIMESTAMP(3) TIME INDEX, "
+        f"{cols_sql}, PRIMARY KEY (hostname)) WITH (append_mode = 'true')"
+    )
+
+    # ---- ingest (chunked; the servers' insert_rows path) -------------------
+    rng = np.random.default_rng(7)
+    ticks_total = HOURS * 3600 // SCRAPE_S
+    chunk_ticks = max(1, 2_000_000 // N_HOSTS)
+    hosts_arr = np.array([f"host_{i}" for i in range(N_HOSTS)])
+    # ground truth for double-groupby-1 accumulated on the fly
+    gt: dict[tuple, list] = {}
+    n_rows = 0
+    t_ing = 0.0
+    for start in range(0, ticks_total, chunk_ticks):
+        ticks = min(chunk_ticks, ticks_total - start)
+        ts = T0 + (start + np.arange(ticks, dtype=np.int64))[:, None] * (SCRAPE_S * 1000)
+        ts = np.broadcast_to(ts, (ticks, N_HOSTS)).reshape(-1)
+        hs = np.broadcast_to(hosts_arr[None, :], (ticks, N_HOSTS)).reshape(-1)
+        data = {"hostname": hs, "ts": ts}
+        vals = {}
+        for m in METRICS:
+            v = rng.uniform(0.0, 100.0, ticks * N_HOSTS)
+            vals[m] = v
+            data[m] = v
+        batch = pa.table(
+            {
+                "hostname": pa.array(data["hostname"]),
+                "ts": pa.array(data["ts"], pa.timestamp("ms")),
+                **{m: pa.array(data[m], pa.float64()) for m in METRICS},
+            }
+        )
+        t0 = time.perf_counter()
+        db.insert_rows("cpu", batch)
+        t_ing += time.perf_counter() - t0
+        n_rows += batch.num_rows
+        # ground truth: (host, hour) -> [sum, count] within W12
+        in_w = (ts >= W12[0]) & (ts < W12[1])
+        if in_w.any():
+            hour = ((ts[in_w] - W12[0]) // 3600_000).astype(np.int64)
+            hidx = np.broadcast_to(
+                np.arange(N_HOSTS)[None, :], (ticks, N_HOSTS)
+            ).reshape(-1)[in_w]
+            key = hidx * 100 + hour
+            sums = np.bincount(key, weights=vals["usage_user"][in_w])
+            cnts = np.bincount(key)
+            for k in np.nonzero(cnts)[0]:
+                acc = gt.setdefault(int(k), [0.0, 0])
+                acc[0] += sums[k]
+                acc[1] += int(cnts[k])
+    t0 = time.perf_counter()
+    db.storage.flush_all()
+    t_flush = time.perf_counter() - t0
+    out_detail["rows"] = n_rows
+    out_detail["ingest_rows_per_sec"] = round(n_rows / t_ing)
+    out_detail["ingest_reference_rows_per_sec"] = 326_839
+    out_detail["flush_secs"] = round(t_flush, 1)
+
+    # ---- tunnel overhead probe (context for co-located deployments) --------
     import jax.numpy as jnp
 
-    from greptimedb_tpu.ops.aggregate import finalize, group_ids, segment_aggregate, time_bucket
-
-    n_per_host = HOURS * 3600 // SCRAPE_S
-    n = N_HOSTS * n_per_host  # 17.28M
-    rng = np.random.default_rng(0)
-
-    ts = np.tile(np.arange(n_per_host, dtype=np.int64) * (SCRAPE_S * 1000), N_HOSTS)
-    hosts = np.repeat(np.arange(N_HOSTS, dtype=np.int32), n_per_host)
-    vals = rng.uniform(0.0, 100.0, n).astype(np.float32)
-
-    dev = jax.devices()[0]
-    ts_d = jax.device_put(jnp.asarray(ts), dev)
-    hosts_d = jax.device_put(jnp.asarray(hosts), dev)
-    vals_d = jax.device_put(jnp.asarray(vals), dev)
-    valid_d = jax.device_put(jnp.ones(n, dtype=bool), dev)
-
-    num_groups = N_HOSTS * HOURS
-
-    @jax.jit
-    def query(ts, hosts, vals, valid):
-        buckets = time_bucket(ts, 0, BUCKET_MS)
-        gids = group_ids([(hosts, N_HOSTS), (buckets, HOURS)], valid, num_groups)
-        state = segment_aggregate(
-            vals, gids, num_groups, ("avg",), mask=valid, acc_dtype=jnp.float32
-        )
-        out = finalize(state, ("avg",))
-        return out["avg"], out["count"]
-
-    # Warmup/compile.
-    avg, count = query(ts_d, hosts_d, vals_d, valid_d)
-    avg.block_until_ready()
-
-    # Correctness spot check vs numpy.
-    g = 17
-    h, b = g // HOURS, g % HOURS
-    sel = (hosts == h) & (ts // BUCKET_MS == b)
-    np.testing.assert_allclose(float(avg[g]), vals[sel].mean(), rtol=1e-4)
-
-    # Device query latency, measured as MARGINAL cost: run the query R times
-    # inside one compiled program (lax.scan; a data dependency defeats CSE)
-    # and difference two R values.  This cancels the per-dispatch host/tunnel
-    # overhead of this test harness, which no co-located deployment pays,
-    # while still charging everything the query actually executes.
-    def repeated(reps):
-        def run(ts, hosts, vals, valid):
-            def body(carry, _):
-                avg, count = query(ts, hosts, vals + carry * 0, valid)
-                return carry + avg[0] * 1e-20, None
-
-            carry, _ = jax.lax.scan(body, jnp.float32(0), None, length=reps)
-            return carry
-
-        return jax.jit(run)
-
-    r_lo, r_hi = 1, 11
-    f_lo, f_hi = repeated(r_lo), repeated(r_hi)
-    float(f_lo(ts_d, hosts_d, vals_d, valid_d))  # compile
-    float(f_hi(ts_d, hosts_d, vals_d, valid_d))
-
-    def wall(f):
-        t0 = time.perf_counter()
-        float(f(ts_d, hosts_d, vals_d, valid_d))
-        return (time.perf_counter() - t0) * 1000
-
-    marginals, walls = [], []
+    probe = jax.jit(lambda x: x + 1)
+    probe(jnp.float32(1.0)).block_until_ready()
+    rtts = []
     for _ in range(5):
-        t_lo, t_hi = wall(f_lo), wall(f_hi)
-        marginals.append((t_hi - t_lo) / (r_hi - r_lo))
-        walls.append(t_lo)
-    p50 = float(np.median(marginals))
-    wall_p50 = float(np.median(walls))
-    if p50 <= 0:
-        # Noise swamped the marginal estimate; fall back to the honest
-        # single-dispatch wall time rather than reporting a fabricated number.
-        p50 = wall_p50
+        t0 = time.perf_counter()
+        probe(jnp.float32(1.0)).block_until_ready()
+        rtts.append((time.perf_counter() - t0) * 1000)
+    dispatch_floor_ms = float(np.median(rtts))
+    out_detail["dispatch_floor_ms"] = round(dispatch_floor_ms, 2)
 
+    # ---- queries -----------------------------------------------------------
+    results = {}
+    headline = None
+    only = os.environ.get("GRAFT_BENCH_ONLY")
+    queries = [
+        q for q in QUERIES if only is None or q[0] in only.split(",")
+    ]
+    for name, sql, ref_ms in queries:
+        t0 = time.perf_counter()
+        table = db.sql_one(sql)
+        cold_ms = (time.perf_counter() - t0) * 1000
+        walls = []
+        for _ in range(WARM_REPS):
+            t0 = time.perf_counter()
+            table = db.sql_one(sql)
+            walls.append((time.perf_counter() - t0) * 1000)
+        warm_ms = float(np.median(walls))
+        entry = {
+            "warm_ms": round(warm_ms, 2),
+            "cold_ms": round(cold_ms, 1),
+            "reference_ms": ref_ms,
+            "vs_baseline": round(ref_ms / warm_ms, 2),
+            "rows_out": table.num_rows,
+        }
+        results[name] = entry
+        if name == "double-groupby-1":
+            headline = entry
+            # verify vs the independently accumulated ground truth
+            got = {}
+            hv = table["hostname"].to_pylist()
+            tv = table["tb"].to_pylist()
+            av = table[table.column_names[2]].to_pylist()
+            host_to_idx = {f"host_{i}": i for i in range(N_HOSTS)}
+            for h, t, a in zip(hv, tv, av):
+                ms = int(t.timestamp() * 1000) if hasattr(t, "timestamp") else int(t)
+                hour = (ms - W12[0]) // 3600_000
+                got[host_to_idx[h] * 100 + hour] = a
+            assert len(got) == len(gt), (len(got), len(gt))
+            for k, (s, c) in gt.items():
+                assert abs(got[k] - s / c) < 1e-6 * max(1.0, abs(s / c)), (
+                    k, got[k], s / c,
+                )
+            entry["verified"] = "matches independent numpy ground truth"
+
+    tile_stats = db.query_engine.tile_cache.stats() if db.query_engine.tile_cache else {}
+    out_detail["hbm_tile_cache"] = tile_stats
+    out_detail["queries"] = results
+    out_detail["method"] = (
+        "end-to-end Database.sql() wall time over real flushed Parquet SSTs: "
+        "parse+plan+lowering+dispatch+finalize. Warm = HBM tile cache hit "
+        f"(p50 of {WARM_REPS}); cold includes Parquet decode + encode + "
+        "upload + XLA compile. dispatch_floor_ms is this harness's measured "
+        "per-dispatch host->device round-trip (tunnel); co-located "
+        "deployments pay microseconds."
+    )
+    out_detail["dataset_hours"] = HOURS
     print(
         json.dumps(
             {
-                "metric": "tsbs_double_groupby_1_p50_latency",
-                "value": round(p50, 3),
+                "metric": "tsbs_double_groupby_1_e2e_warm_p50",
+                "value": headline["warm_ms"],
                 "unit": "ms",
-                "vs_baseline": round(REFERENCE_MS / p50, 2),
-                "detail": {
-                    "rows": n,
-                    "groups": num_groups,
-                    "rows_per_sec_per_chip": round(n / (p50 / 1000)),
-                    "reference_ms": REFERENCE_MS,
-                    "device": str(jax.devices()[0]),
-                    "method": (
-                        "marginal device time, (t[11 reps]-t[1 rep])/10 in one "
-                        "program; excludes this harness's per-dispatch tunnel "
-                        "overhead (see single_dispatch_wall_ms for wall time)"
-                    ),
-                    "single_dispatch_wall_ms": round(wall_p50, 3),
-                },
+                "vs_baseline": headline["vs_baseline"],
+                "detail": out_detail,
             }
         )
     )
+    db.close()
 
 
 if __name__ == "__main__":

@@ -105,12 +105,23 @@ class Database:
             self.config.memory.max_in_flight_write_bytes,
             self.config.memory.max_concurrent_queries,
         )
+        from .storage.dictionary import DictionaryRegistry
+        from .utils.jax_env import ensure_compilation_cache
+
+        ensure_compilation_cache()
+
+        # Per-table tag dictionaries backing the HBM tile cache (stable
+        # codes across files/queries — reference mito-codec pre-encoded keys).
+        self.dicts = DictionaryRegistry(
+            os.path.join(self.config.storage.data_home, "dicts")
+        )
         self.query_engine = QueryEngine(
             schema_provider=self._schema_of,
             scan_provider=self._scan,
             region_scan_provider=self._region_scan,
             time_bounds_provider=self._time_bounds,
             config=self.config.query,
+            tile_context_provider=self._tile_context,
         )
         self._reopen_regions()
 
@@ -328,7 +339,10 @@ class Database:
             if_not_exists=stmt.if_not_exists,
             options=stmt.options,
             on_create=lambda m: [
-                self.storage.create_region(rid, schema) for rid in m.region_ids
+                self.storage.create_region(
+                    rid, schema, append_mode=_opt_bool(stmt.options, "append_mode")
+                )
+                for rid in m.region_ids
             ],
         )
         return None
@@ -576,6 +590,9 @@ class Database:
             for meta in self.catalog.tables(stmt.name):
                 for rid in meta.region_ids:
                     self.storage.drop_region(rid)
+                    if self.query_engine.tile_cache is not None:
+                        self.query_engine.tile_cache.invalidate_region(rid, set())
+                self.dicts.drop(f"{stmt.name}.{meta.name}")
             self.catalog.drop_database(stmt.name)
             return None
         if stmt.if_exists and not self.catalog.has_table(stmt.name, self.current_database):
@@ -595,6 +612,9 @@ class Database:
         if not external:  # external tables own no regions (files stay put)
             for rid in meta.region_ids:
                 self.storage.drop_region(rid)
+                if self.query_engine.tile_cache is not None:
+                    self.query_engine.tile_cache.invalidate_region(rid, set())
+        self.dicts.drop(f"{self.current_database}.{stmt.name}")
         return None
 
     # ---- DML --------------------------------------------------------------
@@ -824,6 +844,33 @@ class Database:
             self.process_manager.check_cancelled()  # between-region point
         return out
 
+    def _tile_context(self, scan: TableScan):
+        """TileContext for the HBM tile cache, or None when this scan's
+        source can't be tiled (virtual/logical/external tables)."""
+        from .models import information_schema as info
+        from .parallel.tile_cache import TileContext
+        from .storage import file_engine as fe
+
+        if not scan.table or info.is_information_schema(scan.database):
+            return None
+        try:
+            meta = self.catalog.table(scan.table, scan.database)
+        except TableNotFoundError:
+            return None
+        if is_logical_meta(meta) or fe.is_external_meta(meta):
+            return None
+        try:
+            regions = [self.storage.region(rid) for rid in meta.region_ids]
+        except Exception:  # noqa: BLE001 — region mid-drop: fall back
+            return None
+        key = f"{scan.database or self.current_database}.{scan.table}"
+        return TileContext(
+            table_key=key,
+            dictionary=self.dicts.get(key),
+            regions=regions,
+            append_mode=any(r.append_mode for r in regions),
+        )
+
     def _scan(self, scan: TableScan) -> pa.Table:
         from .models import information_schema as info
 
@@ -877,11 +924,19 @@ class Database:
             for meta in self.catalog.tables(db):
                 if is_logical_meta(meta) or fe.is_external_meta(meta):
                     continue  # no regions of their own
+                append = _opt_bool(meta.options, "append_mode")
                 for rid in meta.region_ids:
                     try:
-                        self.storage.open_region(rid)
+                        self.storage.open_region(rid, append_mode=append)
                     except Exception:
-                        self.storage.create_region(rid, meta.schema)
+                        self.storage.create_region(rid, meta.schema, append_mode=append)
+
+
+def _opt_bool(options: dict, key: str) -> bool:
+    v = options.get(key)
+    if isinstance(v, str):
+        return v.lower() in ("1", "true", "yes", "on")
+    return bool(v)
 
 
 def _coerce_array(values: list, col: ColumnSchema) -> pa.Array:

@@ -68,6 +68,18 @@ class Datanode:
             raise ConnectionError(f"datanode {self.node_id} is down")
         return self.engine.scan(rid, pred)
 
+    def partial_agg(self, rid: int, pred: ScanPredicate, spec_dict: dict) -> pa.Table:
+        """Lower/state stage on the datanode: scan the region locally and
+        return [groups]-sized mergeable states (reference datanode-side
+        sub-plan execution, region_server.rs:245-316 — wire bytes scale
+        with groups, not rows)."""
+        if not self.alive:
+            raise ConnectionError(f"datanode {self.node_id} is down")
+        from ..query.dist_agg import AggSpec, partial_states
+
+        table = self.engine.scan(rid, pred)
+        return partial_states(table, AggSpec.from_dict(spec_dict))
+
     def region_stats(self) -> list:
         return [s.__dict__ for s in self.engine.region_statistics()]
 
@@ -157,6 +169,7 @@ class Cluster:
             region_scan_provider=self._region_scan,
             time_bounds_provider=self._time_bounds,
             config=Config().query,
+            partial_agg_provider=self._partial_agg,
         )
 
     # ---- DDL (frontend -> metasrv placement -> datanodes) -----------------
@@ -247,6 +260,18 @@ class Cluster:
         routes = self.metasrv.get_route(meta.table_id)
         pred = self._pred(scan)
         return [self.datanodes[routes[rid]].scan(rid, pred) for rid in meta.region_ids]
+
+    def _partial_agg(self, scan: TableScan, spec_dict: dict) -> list[pa.Table]:
+        """Lower/state stage fan-out: each region's datanode aggregates
+        locally and returns [groups]-sized states (reference MergeScan
+        do_get per region, merge_scan.rs:250-330)."""
+        meta = self.catalog.table(scan.table, scan.database)
+        routes = self.metasrv.get_route(meta.table_id)
+        pred = self._pred(scan)
+        return [
+            self.datanodes[routes[rid]].partial_agg(rid, pred, spec_dict)
+            for rid in meta.region_ids
+        ]
 
     def _scan(self, scan: TableScan) -> pa.Table:
         tables = [t for t in self._region_scan(scan) if t.num_rows]

@@ -33,27 +33,34 @@ from ..storage.sst import ScanPredicate
 from ..utils.errors import RegionNotFoundError
 
 
-def encode_scan_ticket(rid: int, pred: ScanPredicate, projection: list[str] | None = None) -> bytes:
+def encode_scan_ticket(
+    rid: int,
+    pred: ScanPredicate,
+    projection: list[str] | None = None,
+    agg: dict | None = None,
+) -> bytes:
     """The wire form of a region sub-query (the reference ships a substrait
-    `LogicalPlan`; our pushed-down unit is scan+predicate — the plan above
-    the scan runs on the frontend or on-device)."""
+    `LogicalPlan`; our pushed-down unit is scan+predicate plus, when the
+    plan lowers, the aggregate spec — the datanode then returns partial
+    STATES, the reference's commutativity split on the wire)."""
     return json.dumps(
         {
             "region_id": rid,
             "time_range": list(pred.time_range) if pred.time_range else None,
             "filters": [list(f) for f in pred.filters],
             "projection": projection,
+            "agg": agg,
         }
     ).encode()
 
 
-def decode_scan_ticket(raw: bytes) -> tuple[int, ScanPredicate, list[str] | None]:
+def decode_scan_ticket(raw: bytes) -> tuple[int, ScanPredicate, list[str] | None, dict | None]:
     d = json.loads(raw.decode())
     pred = ScanPredicate(
         time_range=tuple(d["time_range"]) if d["time_range"] else None,
         filters=[tuple(f) for f in d["filters"]],
     )
-    return d["region_id"], pred, d.get("projection")
+    return d["region_id"], pred, d.get("projection"), d.get("agg")
 
 
 class DatanodeFlightServer(fl.FlightServerBase):
@@ -71,8 +78,15 @@ class DatanodeFlightServer(fl.FlightServerBase):
 
     # ---- reads (do_get) ---------------------------------------------------
     def do_get(self, context, ticket: fl.Ticket):
-        rid, pred, projection = decode_scan_ticket(ticket.ticket)
+        rid, pred, projection, agg = decode_scan_ticket(ticket.ticket)
         table = self.engine.scan(rid, pred)
+        if agg is not None:
+            from ..query.dist_agg import AggSpec, partial_states
+
+            # lower/state stage runs HERE; only [groups]-sized states ship
+            return fl.RecordBatchStream(
+                partial_states(table, AggSpec.from_dict(agg))
+            )
         if projection:
             keep = [c for c in projection if c in table.column_names]
             table = table.select(keep)
@@ -202,6 +216,15 @@ class FlightDatanodeClient:
         except fl.FlightError as e:
             raise ConnectionError(f"datanode {self.node_id}: {e}") from e
 
+    def partial_agg(self, rid: int, pred: ScanPredicate, spec_dict: dict) -> pa.Table:
+        if not self.alive:
+            raise ConnectionError(f"datanode {self.node_id} is down")
+        ticket = fl.Ticket(encode_scan_ticket(rid, pred, agg=spec_dict))
+        try:
+            return self._client.do_get(ticket).read_all()
+        except fl.FlightError as e:
+            raise ConnectionError(f"datanode {self.node_id}: {e}") from e
+
     def kill(self):
         self.alive = False
 
@@ -243,6 +266,9 @@ class FlightDatanode:
 
     def scan(self, rid: int, pred: ScanPredicate) -> pa.Table:
         return self.client.scan(rid, pred)
+
+    def partial_agg(self, rid: int, pred: ScanPredicate, spec_dict: dict) -> pa.Table:
+        return self.client.partial_agg(rid, pred, spec_dict)
 
     def region_stats(self) -> list:
         return self.client.region_stats()

@@ -119,15 +119,24 @@ def segment_aggregate(
 
     Two lowerings, selected at RUNTIME by a `lax.cond` on data layout:
 
-    * **sorted block kernel** — when gids are non-decreasing in scan order
-      (the engine's (pk, ts) sort guarantees this whenever the group keys
-      follow primary-key order) and each BLOCK_ROWS block spans fewer than
-      BLOCK_SPAN group ids, each block reduces into a tiny dense [SPAN]
-      accumulator via compare-broadcast sums (VPU-friendly, no scatter),
-      and only the [blocks, SPAN] partials hit a scatter.  This is the
-      TPU answer to the reference's sorted-run merge: layout makes the
-      hot loop branch- and scatter-free.
-    * **scatter fallback** — XLA segment_* for arbitrary id orders.
+    * **blocked kernel** — when every BLOCK_ROWS block's MASKED rows span
+      fewer than BLOCK_SPAN distinct group ids (the engine's (pk, ts) sort
+      guarantees clustering whenever the group keys follow primary-key
+      order, and selective filters make sparse blocks trivially narrow),
+      each block reduces into a tiny dense [SPAN] accumulator via
+      compare-broadcast sums (VPU-friendly, no scatter), and only the
+      [blocks, SPAN] partials hit a scatter.  The guard is mask-aware and
+      does NOT require global sortedness — a filtered scan over
+      (host, ts)-sorted data engages it even though unmasked rows zigzag.
+      This is the TPU answer to the reference's sorted-run merge: layout
+      makes the hot loop branch- and scatter-free.
+    * **segmented-scan kernel** — when the MASKED gid subsequence is
+      globally non-decreasing but blocks span too many groups (fine time
+      buckets: one host's 12h at 1-minute buckets is 720 groups), a
+      flag-based segmented `associative_scan` computes every aggregate in
+      O(n) bandwidth with zero scatters; per-group results are gathered at
+      segment ends found by binary search.
+    * **scatter fallback** — XLA segment_* for arbitrary id layouts.
 
     `gids` may be raw in-range ids (preferred; pass `mask` for filtering)
     or legacy overflow-encoded ids (those fail the in-range guard and take
@@ -141,22 +150,38 @@ def segment_aggregate(
         return _segment_scatter(values, gids, num_groups, aggs, mask, ts, acc_dtype)
 
     g32 = gids.astype(jnp.int32)
-    sorted_ok = jnp.all(g32[1:] >= g32[:-1])
-    in_range_ok = jnp.all((g32 >= 0) & (g32 < num_groups))
+    in_range_ok = jnp.all(jnp.where(mask, (g32 >= 0) & (g32 < num_groups), True))
     nb = n // BLOCK_ROWS
     gb = g32[: nb * BLOCK_ROWS].reshape(nb, BLOCK_ROWS)
-    span_ok = jnp.max(gb[:, -1] - gb[:, 0]) < BLOCK_SPAN
-    ok = sorted_ok & in_range_ok & span_ok
+    mb = mask[: nb * BLOCK_ROWS].reshape(nb, BLOCK_ROWS)
+    sentinel = jnp.int32(2**31 - 1)
+    bmin = jnp.min(jnp.where(mb, gb, sentinel), axis=1)  # empty block -> sentinel
+    bmax = jnp.max(jnp.where(mb, gb, -1), axis=1)  # empty block -> -1
+    span_ok = jnp.all(bmax - bmin < BLOCK_SPAN)  # empty: -1 - sentinel < K
+    # carried gid: each row tagged with the latest masked gid seen so far
+    carried = jax.lax.cummax(jnp.where(mask, g32, -1))
+    sorted_ok = jnp.all(
+        jnp.where(mask[1:], g32[1:] >= carried[:-1], True)
+    )
+    ok_block = in_range_ok & span_ok
+    ok_scan = in_range_ok & sorted_ok
 
     def fast(args):
         v, g, m = args
-        return _segment_blocked(v, g, num_groups, aggs, m, acc_dtype)
+        return _segment_blocked(v, g, num_groups, aggs, m, acc_dtype, bmin)
+
+    def scan_path(args):
+        v, g, m = args
+        return _segment_scan_sorted(v, g, num_groups, aggs, m, acc_dtype, carried)
 
     def slow(args):
         v, g, m = args
         return _segment_scatter(v, g, num_groups, aggs, m, None, acc_dtype)
 
-    return jax.lax.cond(ok, fast, slow, (values, g32, mask))
+    def middle(args):
+        return jax.lax.cond(ok_scan, scan_path, slow, args)
+
+    return jax.lax.cond(ok_block, fast, middle, (values, g32, mask))
 
 
 def _segment_scatter(
@@ -200,9 +225,11 @@ def _segment_scatter(
     return state
 
 
-def _segment_blocked(values, gids, num_groups, aggs, mask, acc_dtype) -> AggState:
-    """Sorted block kernel: dense per-block accumulators, scatter only the
-    [blocks, BLOCK_SPAN] partials (BLOCK_ROWS/BLOCK_SPAN fewer scatters)."""
+def _segment_blocked(values, gids, num_groups, aggs, mask, acc_dtype, bmin) -> AggState:
+    """Blocked kernel: dense per-block accumulators, scatter only the
+    [blocks, BLOCK_SPAN] partials (BLOCK_ROWS/BLOCK_SPAN fewer scatters).
+    `bmin` = per-block min of MASKED gids (sentinel for all-masked blocks),
+    so clustering — not global sortedness — is the only layout demand."""
     n = values.shape[0]
     nb = n // BLOCK_ROWS
     L, K = BLOCK_ROWS, BLOCK_SPAN
@@ -211,8 +238,10 @@ def _segment_blocked(values, gids, num_groups, aggs, mask, acc_dtype) -> AggStat
     g = gids[: nb * L].reshape(nb, L)
     m = mask[: nb * L].reshape(nb, L)
     v = values[: nb * L].reshape(nb, L).astype(acc_dtype)
-    base = g[:, :1]
-    local = g - base  # [nb, L] in [0, K) — guaranteed by the span guard
+    # all-masked blocks land on the overflow slot; their partials are
+    # init values only (sel is False everywhere in them)
+    base = jnp.minimum(bmin, jnp.int32(num_groups))[:, None]
+    local = g - base  # masked rows: in [0, K) — guaranteed by the span guard
     ks = jnp.arange(K, dtype=jnp.int32)
     sel = (local[:, :, None] == ks[None, None, :]) & m[:, :, None]  # [nb, L, K]
     out_idx = jnp.minimum(base + ks[None, :], segs - 1).reshape(-1)
@@ -263,6 +292,131 @@ def _segment_blocked(values, gids, num_groups, aggs, mask, acc_dtype) -> AggStat
             ),
         )
         state.maxs = mx[:num_groups]
+    return state
+
+
+def segment_aggregate_multi(
+    values: jnp.ndarray,  # [C, n]
+    gids: jnp.ndarray,  # [n]
+    num_groups: int,
+    aggs: tuple[str, ...],
+    masks: jnp.ndarray,  # [C, n] per-column row masks (base & non-null)
+    base_mask: jnp.ndarray,  # [n] the filter mask before null-gating
+    acc_dtype=jnp.float32,
+) -> AggState:
+    """Multi-column variant of `segment_aggregate`: C value columns share
+    ONE layout guard and ONE compiled branch trio (blocked / segmented-scan
+    / scatter, vmapped over C).  Compile time and guard work stop scaling
+    with the number of aggregated columns.  Guards use `base_mask`; since
+    every per-column mask is a subset, clustering/sortedness established on
+    the base mask holds for each column.  Arrays in the result are [C, G].
+    LAST is not supported here (callers route last_value per-column)."""
+    if LAST in aggs:
+        raise ValueError("segment_aggregate_multi does not support LAST")
+    n = values.shape[1]
+    use_fast = n >= _FAST_MIN_ROWS
+    if not use_fast:
+        return jax.vmap(
+            lambda v, m: _segment_scatter(
+                v, gids, num_groups, aggs, m, None, acc_dtype
+            )
+        )(values, masks)
+
+    g32 = gids.astype(jnp.int32)
+    in_range_ok = jnp.all(
+        jnp.where(base_mask, (g32 >= 0) & (g32 < num_groups), True)
+    )
+    nb = n // BLOCK_ROWS
+    gb = g32[: nb * BLOCK_ROWS].reshape(nb, BLOCK_ROWS)
+    mb = base_mask[: nb * BLOCK_ROWS].reshape(nb, BLOCK_ROWS)
+    sentinel = jnp.int32(2**31 - 1)
+    bmin = jnp.min(jnp.where(mb, gb, sentinel), axis=1)
+    bmax = jnp.max(jnp.where(mb, gb, -1), axis=1)
+    span_ok = jnp.all(bmax - bmin < BLOCK_SPAN)
+    carried = jax.lax.cummax(jnp.where(base_mask, g32, -1))
+    sorted_ok = jnp.all(jnp.where(base_mask[1:], g32[1:] >= carried[:-1], True))
+    ok_block = in_range_ok & span_ok
+    ok_scan = in_range_ok & sorted_ok
+
+    def fast(args):
+        v, m = args
+        return jax.vmap(
+            lambda vv, mm: _segment_blocked(
+                vv, g32, num_groups, aggs, mm, acc_dtype, bmin
+            )
+        )(v, m)
+
+    def scan_path(args):
+        v, m = args
+        return jax.vmap(
+            lambda vv, mm: _segment_scan_sorted(
+                vv, g32, num_groups, aggs, mm, acc_dtype, carried
+            )
+        )(v, m)
+
+    def slow(args):
+        v, m = args
+        return jax.vmap(
+            lambda vv, mm: _segment_scatter(
+                vv, g32, num_groups, aggs, mm, None, acc_dtype
+            )
+        )(v, m)
+
+    def middle(args):
+        return jax.lax.cond(ok_scan, scan_path, slow, args)
+
+    return jax.lax.cond(ok_block, fast, middle, (values, masks))
+
+
+def _segment_scan_sorted(
+    values, gids, num_groups, aggs, mask, acc_dtype, carried
+) -> AggState:
+    """Segmented-scan reduction for masked-ascending gid layouts.
+
+    `carried[i]` = max masked gid at or before row i (ascending by the
+    guard).  Segment starts where `carried` changes; a flag-based
+    segmented scan (the classic (flag, value) monoid) folds each segment
+    left-to-right, and the per-group answer is read at the segment's last
+    row, located with searchsorted on `carried`.  Masked-out rows join the
+    current segment with the aggregate's identity value, so they never
+    contribute."""
+    n = values.shape[0]
+    start = jnp.concatenate(
+        [jnp.ones(1, dtype=bool), carried[1:] != carried[:-1]]
+    )
+
+    def segscan(vals, op):
+        def combine(a, b):
+            fa, va = a
+            fb, vb = b
+            return fa | fb, jnp.where(fb, vb, op(va, vb))
+
+        _f, out = jax.lax.associative_scan(combine, (start, vals))
+        return out
+
+    ids = jnp.arange(num_groups, dtype=carried.dtype)
+    idx = jnp.clip(
+        jnp.searchsorted(carried, ids, side="right") - 1, 0, n - 1
+    )
+    hit = carried[idx] == ids
+
+    v = values.astype(acc_dtype)
+    state = AggState()
+    counts = segscan(mask.astype(jnp.int32), jnp.add)
+    cnt = jnp.where(hit, counts[idx], 0)
+    if COUNT in aggs or "avg" in aggs:
+        state.counts = cnt
+    if SUM in aggs or "avg" in aggs:
+        s = segscan(jnp.where(mask, v, 0), jnp.add)
+        state.sums = jnp.where(hit, s[idx], 0)
+    if MIN in aggs:
+        big = jnp.asarray(jnp.finfo(acc_dtype).max, acc_dtype)
+        m = segscan(jnp.where(mask, v, big), jnp.minimum)
+        state.mins = jnp.where(hit & (cnt > 0), m[idx], big)
+    if MAX in aggs:
+        small = jnp.asarray(jnp.finfo(acc_dtype).min, acc_dtype)
+        m = segscan(jnp.where(mask, v, small), jnp.maximum)
+        state.maxs = jnp.where(hit & (cnt > 0), m[idx], small)
     return state
 
 

@@ -120,19 +120,43 @@ def _encode_column(col: pa.ChunkedArray, name: str, pinned: dict[str, dict] | No
         col = pc.cast(col, t.value_type)
         t = t.value_type
     if pa.types.is_string(t) or pa.types.is_large_string(t) or pa.types.is_binary(t):
-        values = col.to_pylist()
         if pinned and name in pinned:
-            mapping = pinned[name]
-            codes = np.array([mapping.get(v, -1) for v in values], dtype=np.int32)
-            dict_values = _mapping_to_list(mapping)
+            # vectorized lookup against the pre-agreed code assignment
+            dict_values = _mapping_to_list(pinned[name])
+            none_code = pinned[name].get(None, -1)
+            idx = pc.index_in(col, value_set=pa.array(dict_values, t))
+            codes = np.asarray(
+                pc.fill_null(idx, -1).to_numpy(zero_copy_only=False), np.int32
+            )
+            if none_code >= 0 and col.null_count:
+                null_np = np.asarray(
+                    pc.is_null(col).to_numpy(zero_copy_only=False), bool
+                )
+                codes = np.where(null_np, none_code, codes)
         else:
-            uniq: dict = {}
-            codes = np.empty(len(values), dtype=np.int32)
-            for i, v in enumerate(values):
-                if v not in uniq:
-                    uniq[v] = len(uniq)
-                codes[i] = uniq[v]
-            dict_values = list(uniq)
+            flat = col
+            if isinstance(flat, pa.ChunkedArray):
+                flat = flat.combine_chunks()
+                if isinstance(flat, pa.ChunkedArray):
+                    flat = (
+                        flat.chunk(0)
+                        if flat.num_chunks
+                        else pa.array([], type=t)
+                    )
+            enc = pc.dictionary_encode(flat)  # Array in -> DictionaryArray out
+            dict_values = enc.dictionary.to_pylist()
+            codes = np.asarray(
+                pc.fill_null(enc.indices, -1).to_numpy(zero_copy_only=False),
+                np.int32,
+            )
+            if col.null_count:
+                # nulls become a dictionary value of their own (legacy
+                # first-seen behavior: None was a dict key)
+                null_np = np.asarray(
+                    pc.is_null(col).to_numpy(zero_copy_only=False), bool
+                )
+                codes = np.where(null_np, len(dict_values), codes)
+                dict_values = dict_values + [None]
         return codes, null_mask, dict_values
     if pa.types.is_timestamp(t) or pa.types.is_duration(t):
         arr = np.asarray(pc.cast(col, pa.int64()).to_numpy(zero_copy_only=False))

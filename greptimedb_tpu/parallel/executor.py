@@ -31,6 +31,7 @@ import pyarrow.compute as pc
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.aggregate import (
+    AggState,
     finalize,
     psum_states,
     raw_group_ids,
@@ -71,6 +72,10 @@ class DistGroupByPlan:
     filters: tuple[tuple[str, str, object], ...] = ()
     acc_dtype: str = "float64"
     ts_col: str | None = None  # needed for last_value ordering
+    # nullable filter columns whose present-mask must gate the row mask
+    # (SQL: NULL never satisfies a predicate); the table-based path
+    # pre-filters on the host so this only matters for the tile path
+    filter_null_cols: tuple[str, ...] = ()
 
     @property
     def num_groups(self) -> int:
@@ -96,8 +101,13 @@ def _quantize_card(n: int) -> int:
     return p
 
 
-def _apply_filters(plan: DistGroupByPlan, columns, mask):
-    for name, op, value in plan.filters:
+def _apply_filters(plan: DistGroupByPlan, columns, mask, values=None):
+    """Evaluate pushed-down predicates.  `values` (optional) supplies the
+    literals as RUNTIME arguments — the tile path passes them dynamically
+    so changing a literal reuses the compiled program; the mesh path bakes
+    them into the plan (position i of `values` pairs with filter i)."""
+    for i, (name, op, static_v) in enumerate(plan.filters):
+        value = static_v if values is None else values[i]
         col = columns[name]
         if op == "=":
             mask = mask & (col == value)
@@ -122,17 +132,27 @@ def _apply_filters(plan: DistGroupByPlan, columns, mask):
     return mask
 
 
-def _device_step(plan: DistGroupByPlan, columns, valid, nulls):
-    """Per-device: mask -> group ids -> partial states -> psum merge.
-    Runs under shard_map; `nulls` maps value col -> present-mask."""
+def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=None):
+    """Shared lower/state stage: mask -> group ids -> partial AggStates.
+    No collectives — callers merge across devices (psum) or across tile
+    sources (merge_states).  `dyn` optionally carries runtime-dynamic plan
+    parameters: {'filter_values', 'bucket_origin', 'bucket_interval'} —
+    only shapes (cards, n_buckets, filter structure) stay compile-static."""
     acc = jnp.float64 if plan.acc_dtype == "float64" else jnp.float32
-    mask = _apply_filters(plan, columns, valid)
+    mask = _apply_filters(
+        plan, columns, valid, None if dyn is None else dyn["filter_values"]
+    )
+    for c in plan.filter_null_cols:
+        if c in nulls:
+            mask = mask & nulls[c]
 
     components: list[tuple[jnp.ndarray, int]] = []
     for tag, card in zip(plan.group_tags, plan.tag_cards):
         components.append((columns[tag], card))
     if plan.bucket_col is not None:
-        b = time_bucket(columns[plan.bucket_col], plan.bucket_origin, plan.bucket_interval)
+        origin = plan.bucket_origin if dyn is None else dyn["bucket_origin"]
+        interval = plan.bucket_interval if dyn is None else dyn["bucket_interval"]
+        b = time_bucket(columns[plan.bucket_col], origin, interval)
         components.append((b, plan.n_buckets))
     # raw in-range ids + mask (NOT overflow-encoded): keeps scan-order
     # sortedness intact so segment_aggregate's block kernel can engage.
@@ -146,42 +166,65 @@ def _device_step(plan: DistGroupByPlan, columns, valid, nulls):
     if plan.ts_col is not None and plan.ts_col in columns:
         ts = columns[plan.ts_col]
 
-    # One segment_aggregate per distinct value column (union of its funcs).
-    # "count" is always included: it doubles as the per-column null mask for
-    # SQL NULL semantics (sum over an all-null group is NULL, not 0).
+    # Columns sharing an aggregate set are STACKED into one
+    # segment_aggregate_multi call — one layout guard, one compiled branch
+    # trio, vmapped over columns (compile and guard cost stop scaling with
+    # column count).  "count" is always included: it doubles as the
+    # per-column null mask for SQL NULL semantics (sum over an all-null
+    # group is NULL, not 0).  last_value keeps the per-column path (needs
+    # the ts-ordered two-pass kernel).
+    from ..ops.aggregate import segment_aggregate_multi
+
     per_col_aggs: dict[str, set] = {}
     for func, col in plan.agg_specs:
         per_col_aggs.setdefault(col, set()).add(_FUNC_TO_KERNEL[func])
     states = {}
+    ones = jnp.ones(valid.shape, dtype=acc)
+    groups: dict[tuple, list[str]] = {}
     for col, aggs in per_col_aggs.items():
-        if col == COUNT_STAR:
-            values = jnp.ones(valid.shape, dtype=jnp.float32)
-            col_mask = mask
-        else:
-            values = columns[col]
+        key = tuple(sorted(aggs | {"count"}))
+        if "last" in key:
             col_mask = mask & nulls[col] if col in nulls else mask
-        state = segment_aggregate(
-            values,
-            gids,
-            plan.num_groups,
-            tuple(sorted(aggs | {"count"})),
-            mask=col_mask,
-            ts=ts,
-            acc_dtype=acc,
+            states[col] = segment_aggregate(
+                columns[col], gids, plan.num_groups, key,
+                mask=col_mask, ts=ts, acc_dtype=acc,
+            )
+        else:
+            groups.setdefault(key, []).append(col)
+    # group presence (independent of value nulls) rides along as a
+    # pseudo-column of ones in a ("count",)-only group
+    groups.setdefault(("count",), []).append("__presence")
+    for key, cols in groups.items():
+        vals = jnp.stack(
+            [
+                ones if c in ("__presence", COUNT_STAR) else columns[c].astype(acc)
+                for c in cols
+            ]
         )
-        states[col] = psum_states(state, REGION_AXIS)
-    # Group presence independent of value nulls (SQL: a group exists if any
-    # row passed the filter, even when every aggregated value is NULL).
-    presence = segment_aggregate(
-        jnp.ones(valid.shape, dtype=jnp.float32),
-        gids,
-        plan.num_groups,
-        ("count",),
-        mask=mask,
-        acc_dtype=jnp.float32,
-    )
-    states["__presence"] = psum_states(presence, REGION_AXIS)
+        col_masks = jnp.stack(
+            [
+                mask & nulls[c] if c in nulls else mask
+                for c in cols
+            ]
+        )
+        multi = segment_aggregate_multi(
+            vals, gids, plan.num_groups, key, col_masks, mask, acc_dtype=acc
+        )
+        for i, c in enumerate(cols):
+            states[c] = AggState(
+                sums=None if multi.sums is None else multi.sums[i],
+                counts=None if multi.counts is None else multi.counts[i],
+                mins=None if multi.mins is None else multi.mins[i],
+                maxs=None if multi.maxs is None else multi.maxs[i],
+            )
     return states
+
+
+def _device_step(plan: DistGroupByPlan, columns, valid, nulls):
+    """Per-device: partial states then psum merge over the mesh axis.
+    Runs under shard_map; `nulls` maps value col -> present-mask."""
+    states = compute_partial_states(plan, columns, valid, nulls)
+    return {k: psum_states(v, REGION_AXIS) for k, v in states.items()}
 
 
 @functools.lru_cache(maxsize=64)
@@ -208,6 +251,9 @@ class GroupByResult:
     non_empty: np.ndarray
     tag_values: dict[str, list]
     plan: DistGroupByPlan
+    # actual bucket geometry when the plan carries dynamic placeholders
+    bucket_origin: int | None = None
+    bucket_interval: int | None = None
 
     def to_table(self) -> pa.Table:
         idx = np.nonzero(self.non_empty)[0]
@@ -225,10 +271,17 @@ class GroupByResult:
             codes = decoded[tag]
             cols[tag] = [values[c] if c < len(values) else None for c in codes]
         if self.plan.bucket_col is not None:
-            ts = (
-                self.plan.bucket_origin
-                + decoded["__bucket"].astype(np.int64) * self.plan.bucket_interval
+            origin = (
+                self.bucket_origin
+                if self.bucket_origin is not None
+                else self.plan.bucket_origin
             )
+            interval = (
+                self.bucket_interval
+                if self.bucket_interval is not None
+                else self.plan.bucket_interval
+            )
+            ts = origin + decoded["__bucket"].astype(np.int64) * interval
             cols[self.plan.bucket_col] = ts
         for name, arr in self.outputs.items():
             sel = np.asarray(arr)[idx]

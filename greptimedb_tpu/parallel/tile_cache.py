@@ -1,0 +1,743 @@
+"""HBM-resident SST tile cache + single-dispatch aggregation executor.
+
+This is the engine's answer to "the tiles are resident in HBM": instead of
+re-reading Parquet, re-encoding tags and re-uploading columns on every query
+(the round-1 hot path), each SST file's needed columns are encoded ONCE —
+tag strings to stable per-table dictionary codes (storage/dictionary.py),
+timestamps to int64, values to float — and kept on the device, keyed by
+(region, file, column).  A query then:
+
+  1. snapshots each region's (files, memtables) under the region lock,
+  2. fetches/repairs cached file tiles (dictionary growth is repaired with
+     one gather using the recorded code permutation — no Parquet re-read),
+  3. encodes only the memtable tail (small, vectorized),
+  4. runs ONE jit-compiled program that computes per-source partial
+     AggStates with the shared kernels (ops/aggregate.py) and merges them —
+     per-source processing preserves each file's (pk, ts) sort order so the
+     sorted-block kernel engages per source,
+  5. finalizes [G]-sized states on the host.
+
+Role-equivalents in the reference: the write/page caches
+(mito2/src/cache/write_cache.rs, cache.rs — "upload on flush, serve reads
+from local media"; here the medium is HBM) and the pre-encoded primary keys
+(mito-codec/src/row_converter/).
+
+Correctness gate: the tile path aggregates raw file rows WITHOUT the
+last-write-wins dedup pass a normal scan performs, so it only engages when
+dedup is provably a no-op:
+  * the table is append_mode (duplicates are semantically kept), or
+  * every pair of sources (SST files + memtable) has disjoint inclusive
+    time ranges — two versions of one row need equal timestamps;
+and never when any source holds delete tombstones or a file predates
+tombstone accounting (FileMeta.num_deletes < 0).  Anything else returns
+None and the authoritative scan path runs.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..ops.aggregate import finalize, merge_states
+from ..ops.tiles import padded_size
+from ..storage.dictionary import TableDictionary
+from ..storage.region import OP_COL, Region
+from ..storage.sst import FileMeta, ScanPredicate
+from ..utils import metrics
+from .executor import (
+    COUNT_STAR,
+    DistGroupByPlan,
+    GroupByResult,
+    _FUNC_TO_KERNEL,
+    _quantize_card,
+    compute_partial_states,
+)
+
+TILE_QUANTUM = 1 << 14  # pad granularity for every source: bounds recompiles
+
+
+@dataclass
+class TileContext:
+    """What the Database hands the tile executor for one table scan."""
+
+    table_key: str
+    dictionary: TableDictionary
+    regions: list[Region]
+    append_mode: bool = False
+
+
+@dataclass
+class _FileTileEntry:
+    """Device tiles for one SST file, padded to TILE_QUANTUM at build time
+    so repeated queries hand the SAME arrays to the compiled program."""
+
+    cols: dict[str, jnp.ndarray] = field(default_factory=dict)
+    nulls: dict[str, jnp.ndarray] = field(default_factory=dict)
+    epochs: dict[str, int] = field(default_factory=dict)  # tag col -> dict epoch
+    valid: jnp.ndarray | None = None
+    num_rows: int = 0
+    nbytes: int = 0
+
+
+class TileCacheManager:
+    """Device-resident per-(region, SST file) column tiles with LRU budget."""
+
+    def __init__(self, budget_bytes: int = 8 << 30):
+        self.budget = budget_bytes
+        self._lock = threading.RLock()
+        self._entries: OrderedDict[tuple[int, str], _FileTileEntry] = OrderedDict()
+        self._used = 0
+        self._region_versions: dict[int, int] = {}
+
+    # ---- bookkeeping -------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {"files": len(self._entries), "bytes": self._used}
+
+    def invalidate_region(self, region_id: int, keep_file_ids: set[str] | None = None):
+        """Drop tiles of files no longer in the region's manifest."""
+        with self._lock:
+            for key in list(self._entries):
+                if key[0] == region_id and (
+                    keep_file_ids is None or key[1] not in keep_file_ids
+                ):
+                    self._used -= self._entries.pop(key).nbytes
+            self._region_versions.pop(region_id, None)
+
+    def invalidate_region_if_changed(
+        self, region_id: int, keep_file_ids: set[str], manifest_version: int
+    ):
+        """Version-gated sweep: the O(cache) scan only runs when the
+        region's manifest actually advanced since the last query."""
+        with self._lock:
+            if self._region_versions.get(region_id) == manifest_version:
+                return
+        self.invalidate_region(region_id, keep_file_ids)
+        with self._lock:
+            self._region_versions[region_id] = manifest_version
+
+    def _evict_locked(self, pinned: set[tuple[int, str]]):
+        while self._used > self.budget and len(self._entries) > len(pinned):
+            for key in list(self._entries):
+                if key not in pinned:
+                    self._used -= self._entries.pop(key).nbytes
+                    metrics.TILE_CACHE_EVICTIONS.inc()
+                    break
+            else:
+                break
+
+    # ---- tile build / fetch ------------------------------------------------
+    def file_tiles(
+        self,
+        region: Region,
+        dictionary: TableDictionary,
+        meta: FileMeta,
+        tag_cols: list[str],
+        ts_col: str | None,
+        value_cols: list[str],
+        pinned: set[tuple[int, str]],
+    ) -> _FileTileEntry | None:
+        """Cached (or freshly built) device tiles for one SST file.  Returns
+        None when the file cannot be tiled (e.g. a needed column is absent —
+        pre-ALTER files fall back to the scan path)."""
+        key = (region.region_id, meta.file_id)
+        need = list(dict.fromkeys(tag_cols + ([ts_col] if ts_col else []) + value_cols))
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+        if entry is None:
+            entry = _FileTileEntry(num_rows=meta.num_rows)
+        missing = [c for c in need if c not in entry.cols]
+        if missing:
+            built = self._build_columns(
+                region, dictionary, meta, missing, tag_cols, ts_col
+            )
+            if built is None:
+                return None
+            cols, nulls, epochs, nbytes, pad = built
+            if entry.valid is None:
+                v = np.zeros(pad, bool)
+                v[: entry.num_rows] = True
+                entry.valid = jnp.asarray(v)
+                nbytes += pad
+            entry.cols.update(cols)
+            entry.nulls.update(nulls)
+            entry.epochs.update(epochs)
+            entry.nbytes += nbytes
+            metrics.TILE_CACHE_MISSES.inc()
+            with self._lock:
+                old = self._entries.pop(key, None)
+                if old is not None and old is not entry:
+                    self._used -= old.nbytes
+                self._entries[key] = entry
+                self._used += nbytes
+                self._evict_locked(pinned)
+        else:
+            metrics.TILE_CACHE_HITS.inc()
+        return entry
+
+    def repair_entries(
+        self,
+        entries: list[_FileTileEntry],
+        dictionary: TableDictionary,
+        tag_cols: list[str],
+    ):
+        """Dictionary-growth repair: one gather per stale tag column.  MUST
+        run after every source of the query has updated the dictionary
+        (a later file/memtable can insert values that shift codes an
+        earlier-fetched tile was encoded with).  Serialized under the cache
+        lock so concurrent queries can't double-apply a permutation."""
+        with self._lock:
+            for entry in entries:
+                for tag in tag_cols:
+                    if tag not in entry.epochs:
+                        continue
+                    perm = dictionary.perm_since(tag, entry.epochs[tag])
+                    if perm is not None:
+                        entry.cols[tag] = jnp.take(
+                            jnp.asarray(perm),
+                            entry.cols[tag],
+                            mode="fill",
+                            fill_value=-1,
+                        ).astype(jnp.int32)
+                    entry.epochs[tag] = dictionary.epoch
+
+    def _build_columns(
+        self,
+        region: Region,
+        dictionary: TableDictionary,
+        meta: FileMeta,
+        columns: list[str],
+        tag_cols: list[str],
+        ts_col: str | None,
+    ):
+        table = region.sst_reader.read(meta, None, columns=columns)
+        if table.num_rows != meta.num_rows:
+            return None  # unexpected — refuse rather than mis-aggregate
+        for name in columns:
+            if name not in table.column_names:
+                return None  # file predates the column (ALTER) — not tileable
+        return _encode_table_tiles(dictionary, table, columns, tag_cols, ts_col)
+
+
+def _encode_table_tiles(
+    dictionary: TableDictionary,
+    table: pa.Table,
+    columns: list[str],
+    tag_cols: list[str],
+    ts_col: str | None,
+):
+    """Shared encode-and-pad for SST files and memtable tails: tag strings
+    -> dictionary codes (growing the dictionary), ts -> int64, values ->
+    numeric; everything zero-padded to TILE_QUANTUM and uploaded.  Returns
+    (cols, nulls, epochs, nbytes, pad) or None when a column can't tile."""
+    n = table.num_rows
+    pad = padded_size(n, TILE_QUANTUM)
+    cols: dict[str, jnp.ndarray] = {}
+    nulls: dict[str, jnp.ndarray] = {}
+    epochs: dict[str, int] = {}
+    nbytes = 0
+    for name in columns:
+        col = table[name]
+        if name in tag_cols:
+            dictionary.update(name, col)
+            np_arr = dictionary.encode(name, col)
+            epochs[name] = dictionary.epoch
+        elif name == ts_col:
+            np_arr = np.asarray(
+                pc.cast(col, pa.int64()).to_numpy(zero_copy_only=False)
+            )
+        else:
+            np_arr = _value_to_numpy(col)
+            if np_arr is None:
+                return None
+            if col.null_count:
+                present = np.zeros(pad, bool)
+                present[:n] = np.asarray(
+                    pc.is_valid(col).to_numpy(zero_copy_only=False), bool
+                )
+                nulls[name] = jnp.asarray(present)
+                nbytes += present.nbytes
+        padded = np.zeros(pad, dtype=np_arr.dtype)
+        padded[:n] = np_arr
+        arr = jnp.asarray(padded)
+        cols[name] = arr
+        nbytes += arr.nbytes
+    return cols, nulls, epochs, nbytes, pad
+
+
+def _value_to_numpy(col) -> np.ndarray | None:
+    t = col.type
+    if pa.types.is_dictionary(t):
+        col = pc.cast(col, t.value_type)
+        t = t.value_type
+    if not (pa.types.is_floating(t) or pa.types.is_integer(t) or pa.types.is_boolean(t)):
+        return None
+    arr = col.to_numpy(zero_copy_only=False)
+    if arr.dtype == object:
+        arr = np.array([0 if v is None else v for v in arr], dtype=np.float64)
+    elif np.issubdtype(arr.dtype, np.floating):
+        arr = np.nan_to_num(arr, nan=0.0)
+    elif arr.dtype == bool:
+        arr = arr.astype(np.float32)
+    return arr
+
+
+# ---- the single-dispatch program -------------------------------------------
+
+
+@functools.lru_cache(maxsize=64)
+def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
+    """jit program: per-source partial states, merged pairwise, FINALIZED on
+    device, and packed into ONE [K, G] float64 buffer holding ONLY the rows
+    this query's output consumes — one dispatch in, one device->host
+    transfer out.  On a remote-device harness every separate fetch pays the
+    full host round-trip, so everything rides one buffer (counts are exact
+    in float64 below 2^53), and bytes scale with requested outputs, not
+    with every state the kernels track.
+
+    Count rows ship only for (a) explicit count() outputs and (b) NULLABLE
+    aggregated columns (NULL-group gating); non-nullable columns gate on
+    the single presence row.  Returns (fn, layout)."""
+    per_col_aggs: dict[str, set] = {}
+    for func, col in plan.agg_specs:
+        per_col_aggs.setdefault(col, set()).add(_FUNC_TO_KERNEL[func])
+    layout: list[tuple[str, str]] = [("__presence", "count")]
+    for col, aggs in per_col_aggs.items():
+        for agg in sorted(aggs):
+            if agg == "count":
+                continue  # handled below
+            layout.append((col, agg))
+        if "count" in aggs or (col in nullable_cols and col != COUNT_STAR):
+            layout.append((col, "count"))
+
+    def run(sources, dyn):
+        merged = None
+        for cols, valid, nulls in sources:
+            states = compute_partial_states(plan, cols, valid, nulls, dyn)
+            if merged is None:
+                merged = states
+            else:
+                merged = {k: merge_states(merged[k], states[k]) for k in merged}
+        outs = {
+            col: finalize(merged[col], tuple(sorted(aggs | {"count"})))
+            for col, aggs in per_col_aggs.items()
+        }
+        outs["__presence"] = {"count": merged["__presence"].counts}
+        rows = [outs[col][agg].astype(jnp.float64) for col, agg in layout]
+        return jnp.stack(rows)
+
+    return jax.jit(run), tuple(layout)
+
+
+class TileExecutor:
+    """Aggregation over cached HBM tiles; returns None when not applicable
+    so the caller can fall back to the authoritative path."""
+
+    def __init__(self, cache: TileCacheManager, config):
+        self.cache = cache
+        self.config = config
+
+    # -- public entry --------------------------------------------------------
+    def execute(self, lowering, schema, time_bounds, ctx: TileContext):
+        t0 = time.perf_counter()
+        out = self._try_execute(lowering, schema, time_bounds, ctx)
+        if out is not None:
+            metrics.TILE_QUERY_ELAPSED.observe(time.perf_counter() - t0)
+        return out
+
+    def _try_execute(self, lowering, schema, time_bounds, ctx: TileContext):
+        scan = lowering.scan
+        ts_name = schema.time_index.name if schema.time_index else None
+        tag_cols = list(lowering.group_tags)
+        # tag-typed filter columns also need code tiles
+        tag_names = {c.name for c in schema.tag_columns()}
+        filter_tag_cols = [
+            f[0] for f in scan.filters if f[0] in tag_names and f[0] not in tag_cols
+        ]
+        all_tag_cols = tag_cols + filter_tag_cols
+        value_cols = list(
+            dict.fromkeys(
+                [c for _f, c in lowering.agg_specs if c is not None]
+                + [
+                    f[0]
+                    for f in scan.filters
+                    if f[0] not in tag_names and f[0] != ts_name
+                ]
+            )
+        )
+        needs_ts = (
+            lowering.bucket is not None
+            or any(f == "last_value" for f, _ in lowering.agg_specs)
+            or scan.time_range is not None
+            or any(f[0] == ts_name for f in scan.filters)
+        )
+        use_ts = ts_name if (needs_ts and ts_name) else None
+
+        # 1. snapshot + safety gate, pinning every region until dispatch
+        # done.  The table's dictionary gate serializes the whole
+        # epoch-sensitive section (tile fetch -> repair -> memtable encode
+        # -> plan build -> arg pack): without it a concurrent query could
+        # grow the dictionary and repair SHARED tile entries between our
+        # phases, mixing code epochs inside one dispatch.
+        pinned_regions: list[Region] = []
+        with ctx.dictionary.table_lock:
+            try:
+                return self._locked_execute(
+                    lowering, schema, scan, ctx, time_bounds, pinned_regions,
+                    ts_name, tag_names, tag_cols, all_tag_cols, value_cols, use_ts,
+                )
+            finally:
+                for region in pinned_regions:
+                    region.unpin_scan()
+
+    def _locked_execute(
+        self, lowering, schema, scan, ctx, time_bounds, pinned_regions,
+        ts_name, tag_names, tag_cols, all_tag_cols, value_cols, use_ts,
+    ):
+        if True:  # structure kept flat for readability of the phases below
+            sources_meta = []  # (region, FileMeta|None mem marker, mem table)
+            prune_pred = ScanPredicate(
+                time_range=scan.time_range,
+                filters=[f for f in scan.filters if f[0] in tag_names],
+            )
+            ranges: list[tuple[int, int]] = []
+            for region in ctx.regions:
+                region.pin_scan()
+                pinned_regions.append(region)
+                all_files, mems, version = region.tile_snapshot()
+                # drop cached tiles of files compaction removed — but only
+                # when the manifest actually changed since the last sweep
+                self.cache.invalidate_region_if_changed(
+                    region.region_id, {m.file_id for m in all_files}, version
+                )
+                files = region.sst_reader.prune_files(all_files, prune_pred)
+                for meta in files:
+                    if meta.num_deletes != 0:
+                        return None  # tombstones (or unknown) -> dedup needed
+                    sources_meta.append((region, meta, None))
+                    ranges.append(meta.time_range)
+                for mem in mems:
+                    mem_table = mem.scan(
+                        scan.time_range, dedup=not ctx.append_mode
+                    )
+                    if mem_table.num_rows == 0:
+                        continue
+                    if OP_COL in mem_table.column_names:
+                        op = pc.fill_null(
+                            pc.cast(mem_table[OP_COL], pa.int64()), 0
+                        )
+                        if pc.sum(op).as_py():
+                            return None  # tombstones in memtable
+                        mem_table = mem_table.drop_columns([OP_COL])
+                    sources_meta.append((region, None, mem_table))
+                    if ts_name and ts_name in mem_table.column_names:
+                        ts_i = pc.cast(mem_table[ts_name], pa.int64())
+                        ranges.append(
+                            (pc.min(ts_i).as_py(), pc.max(ts_i).as_py())
+                        )
+                    else:
+                        ranges.append((0, 0))
+            if not ctx.append_mode and not _disjoint(ranges):
+                return None
+            if not sources_meta:
+                return None  # empty table: let the normal path shape output
+
+            # 2. fetch/build file tiles + encode memtable tails
+            pinned_keys = {
+                (r.region_id, m.file_id) for r, m, _ in sources_meta if m is not None
+            }
+            # phase A: grow the dictionary from every source BEFORE any
+            # encode whose output must be final — memtable values first
+            # (cheap), then file builds (which update as they encode)
+            for _region, meta, mem_table in sources_meta:
+                if meta is None:
+                    ctx.dictionary.update_table(mem_table, all_tag_cols)
+            file_entries: list[_FileTileEntry] = []
+            slots: list = []
+            for region, meta, mem_table in sources_meta:
+                if meta is not None:
+                    entry = self.cache.file_tiles(
+                        region, ctx.dictionary, meta, all_tag_cols,
+                        use_ts, value_cols, pinned_keys,
+                    )
+                    if entry is None:
+                        return None
+                    file_entries.append(entry)
+                    slots.append(entry)
+                else:
+                    slots.append((region, mem_table))
+            # phase B: the dictionary is final for this query — repair any
+            # tile encoded under an older epoch with one gather, and encode
+            # the memtable tails against the final code assignment
+            self.cache.repair_entries(file_entries, ctx.dictionary, all_tag_cols)
+            device_sources = []
+            for s in slots:
+                if isinstance(s, _FileTileEntry):
+                    device_sources.append((s.cols, s.valid, s.nulls))
+                else:
+                    src = self._encode_mem(
+                        ctx.dictionary, s[1], all_tag_cols, use_ts, value_cols
+                    )
+                    if src is None:
+                        return None
+                    device_sources.append(src)
+
+            # 3. the static plan (cards AFTER all dictionary updates) plus
+            # its runtime-dynamic parameters (filter literals, bucket
+            # geometry) — changing a literal or window reuses the compile
+            built = self._build_plan(
+                lowering, schema, scan, ctx, tag_cols, time_bounds, use_ts
+            )
+            if built is None:
+                return None
+            plan, dyn_host = built
+            if plan.num_groups > self.config.max_groups * 64:
+                return None  # group space too large for dense [G] states
+
+            # 4. one dispatch
+            nullable_cols = tuple(
+                sorted(
+                    c
+                    for _f, c in plan.agg_specs
+                    if c != COUNT_STAR
+                    and schema.has_column(c)
+                    and schema.column(c).nullable
+                )
+            )
+            program, layout = _tile_program(plan, nullable_cols)
+            need_cols = self._plan_cols(plan)
+            args = []
+            for cols, valid, nulls in device_sources:
+                args.append(
+                    (
+                        {k: v for k, v in cols.items() if k in need_cols},
+                        valid,
+                        {k: v for k, v in nulls.items() if k in need_cols},
+                    )
+                )
+            dyn = {
+                "filter_values": tuple(dyn_host["filter_values"]),
+                "bucket_origin": np.int64(dyn_host["bucket_origin"]),
+                "bucket_interval": np.int64(dyn_host["bucket_interval"]),
+            }
+            packed = program(tuple(args), dyn)
+            metrics.TILE_LOWERED_TOTAL.inc()
+            return self._finalize(
+                packed, layout, plan, lowering, schema, ctx, dyn_host
+            )
+
+    # -- helpers -------------------------------------------------------------
+    @staticmethod
+    def _plan_cols(plan: DistGroupByPlan) -> set:
+        need = set(plan.group_tags) | {f[0] for f in plan.filters}
+        if plan.bucket_col:
+            need.add(plan.bucket_col)
+        if plan.ts_col:
+            need.add(plan.ts_col)
+        for _f, c in plan.agg_specs:
+            if c != COUNT_STAR:
+                need.add(c)
+        return need
+
+    def _encode_mem(self, dictionary, table, tag_cols, ts_col, value_cols):
+        """Encode the (small, fresh) memtable tail; same encode-and-pad as
+        file tiles (_encode_table_tiles) so the two can never diverge."""
+        need = list(
+            dict.fromkeys(tag_cols + ([ts_col] if ts_col else []) + value_cols)
+        )
+        for name in need:
+            if name not in table.column_names:
+                return None
+        built = _encode_table_tiles(dictionary, table, need, tag_cols, ts_col)
+        if built is None:
+            return None
+        cols, nulls, _epochs, _nbytes, pad = built
+        v = np.zeros(pad, bool)
+        v[: table.num_rows] = True
+        return (cols, jnp.asarray(v), nulls)
+
+    def _build_plan(self, lowering, schema, scan, ctx, tag_cols, time_bounds, use_ts):
+        """Returns (plan, dyn_host): `plan` is the compile-static structure
+        (filter literals replaced by placeholders, n_buckets quantized to a
+        power of two) and `dyn_host` carries the runtime values — so
+        dashboards that vary literals or time windows reuse one compile."""
+        d = ctx.dictionary
+        if lowering.bucket is not None:
+            ts_col, interval, origin_hint = lowering.bucket
+            if scan.time_range is not None and scan.time_range[0] > -(1 << 61) and scan.time_range[1] < (1 << 61):
+                lo, hi = scan.time_range
+            else:
+                lo, hi = time_bounds()
+                hi += 1
+            unit_ns = schema.time_index.data_type.timestamp_unit_ns()
+            interval_native = max(int(interval * 1_000_000) // max(unit_ns, 1), 1)
+            origin = origin_hint + ((lo - origin_hint) // interval_native) * interval_native
+            n_buckets = max(int((hi - origin + interval_native - 1) // interval_native), 1)
+            n_buckets = _quantize_card(n_buckets)
+            bucket_col = ts_col
+        else:
+            bucket_col, interval_native, origin, n_buckets = None, 1, 0, 1
+
+        # filters: tag values -> sorted codes (order-preserving, so even
+        # inequalities translate); time range -> explicit ts filters.
+        # Structure (name, op, arity) is static; values ride `dyn`.
+        ts_name = schema.time_index.name if schema.time_index else None
+        tag_names = {c.name for c in schema.tag_columns()}
+        enc_filters: list[tuple[str, str, object]] = []
+        filter_vals: list = []
+
+        def push(name, op, value, dtype):
+            if op in ("in", "not in"):
+                enc_filters.append((name, op, len(value)))
+                filter_vals.append(tuple(dtype(v) for v in value))
+            else:
+                enc_filters.append((name, op, None))
+                filter_vals.append(dtype(value))
+
+        for name, op, value in scan.filters:
+            if name in tag_names:
+                f = _encode_tag_filter(d, name, op, value)
+                if f is None:
+                    return None
+                for fname, fop, fval in f:
+                    push(fname, fop, fval, np.int32)
+            else:
+                if isinstance(value, str):
+                    from ..datatypes.coercion import coerce_string_scalar
+
+                    # numeric literal as string (prepared statements)
+                    v = coerce_string_scalar(value, pa.float64())
+                    value = v.as_py() if isinstance(v, pa.Scalar) else v
+                    if isinstance(value, str):
+                        return None
+                dtype = np.int64 if name == ts_name else np.float64
+                push(name, op, value, dtype)
+        if scan.time_range is not None and use_ts:
+            lo, hi = scan.time_range
+            if lo > -(1 << 61):
+                push(use_ts, ">=", int(lo), np.int64)
+            if hi < (1 << 61):
+                push(use_ts, "<", int(hi), np.int64)
+
+        norm_specs = []
+        for func, col in lowering.agg_specs:
+            norm_specs.append((func, COUNT_STAR if col is None else col))
+        needs_ts_order = any(f == "last_value" for f, _ in norm_specs)
+        filter_null_cols = tuple(
+            sorted(
+                {
+                    name
+                    for name, _op, _v in enc_filters
+                    if name not in tag_names
+                    and name != ts_name
+                    and schema.has_column(name)
+                    and schema.column(name).nullable
+                }
+            )
+        )
+        plan = DistGroupByPlan(
+            group_tags=tuple(tag_cols),
+            tag_cards=tuple(_quantize_card(d.cardinality(t)) for t in tag_cols),
+            bucket_col=bucket_col,
+            bucket_origin=0,  # dynamic — see dyn_host
+            bucket_interval=1,
+            n_buckets=n_buckets,
+            agg_specs=tuple(norm_specs),
+            filters=tuple(enc_filters),
+            acc_dtype=self.config_acc_dtype(),
+            ts_col=use_ts if needs_ts_order else None,
+            filter_null_cols=filter_null_cols,
+        )
+        dyn_host = {
+            "filter_values": filter_vals,
+            "bucket_origin": origin,
+            "bucket_interval": interval_native,
+        }
+        return plan, dyn_host
+
+    def config_acc_dtype(self) -> str:
+        import jax as _jax
+
+        return "float64" if _jax.config.jax_enable_x64 else "float32"
+
+    def _finalize(self, packed, layout, plan, lowering, schema, ctx, dyn_host):
+        # ONE host fetch total, regardless of how many aggregates ran
+        flat = np.asarray(packed)
+        finals: dict[str, dict[str, np.ndarray]] = {}
+        for i, (col, agg) in enumerate(layout):
+            finals.setdefault(col, {})[agg] = flat[i]
+        outputs: dict[str, np.ndarray] = {}
+        presence = finals["__presence"]["count"]
+        non_empty = presence > 0
+        for func, col in plan.agg_specs:
+            out = finals[col]
+            kernel = _FUNC_TO_KERNEL[func]
+            arr = np.asarray(out[kernel])
+            # NULL gating: nullable columns carry their own count row;
+            # non-nullable columns have count == presence by construction
+            col_count = out.get("count", presence)
+            if col == COUNT_STAR:
+                outputs["count(*)"] = arr.astype(np.int64)
+            elif func == "count":
+                outputs[f"count({col})"] = arr.astype(np.int64)
+            else:
+                outputs[f"{func}({col})"] = np.where(col_count > 0, arr, np.nan)
+        tag_values = {t: ctx.dictionary.values(t) for t in plan.group_tags}
+        result = GroupByResult(
+            outputs=outputs,
+            non_empty=non_empty,
+            tag_values=tag_values,
+            plan=plan,
+            bucket_origin=dyn_host["bucket_origin"],
+            bucket_interval=dyn_host["bucket_interval"],
+        )
+        return result.to_table()
+
+
+def _encode_tag_filter(
+    d: TableDictionary, name: str, op: str, value
+) -> list[tuple[str, str, object]] | None:
+    """Translate a tag-string predicate to code space.  Sorted codes make
+    inequalities exact; a null slot (always the max code) must be excluded
+    from every operator except '=' (SQL: NULL never satisfies a filter)."""
+    null_code = d.code_of(name, None)
+    guard = [(name, "!=", null_code)] if null_code >= 0 else []
+    if op == "=":
+        return [(name, "=", d.code_of(name, value))]
+    if op == "!=":
+        return guard + [(name, "!=", d.code_of(name, value))]
+    if op == "in":
+        return guard + [(name, "in", tuple(d.code_of(name, v) for v in value))]
+    if op == "not in":
+        return guard + [(name, "not in", tuple(d.code_of(name, v) for v in value))]
+    if op == "<":
+        return guard + [(name, "<", d.bound(name, value))]
+    if op == ">=":
+        return guard + [(name, ">=", d.bound(name, value))]
+    if op == "<=":
+        return guard + [(name, "<", d.bound_right(name, value))]
+    if op == ">":
+        return guard + [(name, ">=", d.bound_right(name, value))]
+    return None
+
+
+def _disjoint(ranges: list[tuple[int, int]]) -> bool:
+    """True when every pair of inclusive [lo, hi] ranges is non-overlapping."""
+    if len(ranges) <= 1:
+        return True
+    s = sorted(ranges)
+    for (alo, ahi), (blo, bhi) in zip(s, s[1:]):
+        if ahi >= blo:
+            return False
+    return True

@@ -1,0 +1,247 @@
+"""Distributed partial aggregation: ship STATES, not rows, between nodes.
+
+Role-equivalent of the reference's MergeScan + aggregate commutativity
+split (reference query/src/dist_plan/merge_scan.rs:134-330,
+commutativity.rs:45 `step_aggr_to_upper_aggr`): the lowered aggregate runs
+as a LOWER (state) stage on each datanode over its regions, and only
+[groups]-sized state tables cross the wire; the frontend runs the UPPER
+(merge) stage.  Wire bytes are proportional to group count, not row count.
+
+States are keyed by GROUP VALUES (tag strings + bucket timestamps), so each
+node's private dictionary encoding never needs to agree with any other
+node's — the same reason the reference keys merge-stage rows by group
+columns.  State columns per aggregated value column:
+    __sum_<col>, __count_<col>, __min_<col>, __max_<col>,
+    __last_ts_<col>, __last_<col>
+plus __presence (rows per group regardless of value nulls).  All states are
+mergeable: sum/count add, min/max fold, last folds by (ts, value).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .tpu_exec import Lowering
+
+PRESENCE = "__presence"
+
+
+@dataclass
+class AggSpec:
+    """The wire form of the lowered aggregate (JSON-serializable)."""
+
+    group_tags: list[str]
+    bucket: tuple[str, int, int] | None  # (ts_col, interval_native, origin_native)
+    agg_specs: list[tuple[str, str | None]]  # (func, col) — col None = count(*)
+    ts_col: str | None = None  # for last_value ordering
+
+    def to_dict(self) -> dict:
+        return {
+            "group_tags": self.group_tags,
+            "bucket": list(self.bucket) if self.bucket else None,
+            "agg_specs": [list(s) for s in self.agg_specs],
+            "ts_col": self.ts_col,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AggSpec":
+        return cls(
+            group_tags=list(d["group_tags"]),
+            bucket=tuple(d["bucket"]) if d.get("bucket") else None,
+            agg_specs=[tuple(s) for s in d["agg_specs"]],
+            ts_col=d.get("ts_col"),
+        )
+
+    def group_cols(self) -> list[str]:
+        cols = list(self.group_tags)
+        if self.bucket is not None:
+            cols.append(self.bucket[0])
+        return cols
+
+
+def spec_from_lowering(lowering: Lowering, schema) -> AggSpec | None:
+    """Translate a proven TPU lowering into the wire spec; None when an
+    aggregate isn't state-mergeable over the wire."""
+    bucket = None
+    if lowering.bucket is not None:
+        ts_col, interval_ms, origin = lowering.bucket
+        unit_ns = schema.time_index.data_type.timestamp_unit_ns()
+        interval_native = max(int(interval_ms * 1_000_000) // max(unit_ns, 1), 1)
+        bucket = (ts_col, interval_native, origin)
+    needs_ts = any(f == "last_value" for f, _c in lowering.agg_specs)
+    ts_name = schema.time_index.name if schema.time_index else None
+    if needs_ts and ts_name is None:
+        return None
+    return AggSpec(
+        group_tags=list(lowering.group_tags),
+        bucket=bucket,
+        agg_specs=[tuple(s) for s in lowering.agg_specs],
+        ts_col=ts_name if needs_ts else None,
+    )
+
+
+def _bucketize(table: pa.Table, spec: AggSpec) -> pa.Table:
+    """Replace the ts column with its bucket-floored value."""
+    ts_col, interval, origin = spec.bucket
+    ts = pc.cast(table[ts_col], pa.int64())
+    # subtract origin first so the float64 round-trip stays well inside 2^53
+    rel = pc.cast(pc.subtract(ts, origin), pa.float64())
+    b = pc.add(
+        pc.cast(
+            pc.multiply(pc.floor(pc.divide(rel, float(interval))), float(interval)),
+            pa.int64(),
+        ),
+        origin,
+    )
+    i = table.schema.get_field_index(ts_col)
+    return table.set_column(
+        i, ts_col, pc.cast(b, table.schema.field(i).type)
+    )
+
+
+def partial_states(table: pa.Table, spec: AggSpec) -> pa.Table:
+    """The LOWER stage, run datanode-side over one region's scan output.
+    Output: one row per group, group columns + state columns."""
+    if spec.bucket is not None:
+        table = _bucketize(table, spec)
+    keys = spec.group_cols()
+    if not keys:  # ungrouped aggregate: one global group
+        table = table.append_column(
+            "__global", pa.array(np.zeros(table.num_rows, np.int8))
+        )
+        keys = ["__global"]
+    value_cols = sorted(
+        {c for _f, c in spec.agg_specs if c is not None}
+    )
+
+    aggs: list[tuple[str, str]] = []
+    rename: list[tuple[str, str]] = []  # (pyarrow output name, ours)
+    needed: dict[str, set] = {c: set() for c in value_cols}
+    for func, col in spec.agg_specs:
+        if col is None:
+            continue
+        if func in ("sum", "avg"):
+            needed[col] |= {"sum", "count"}
+        elif func == "count":
+            needed[col] |= {"count"}
+        elif func in ("min", "max"):
+            needed[col].add(func)
+        elif func == "last_value":
+            needed[col].add("last")
+    for col, kinds in needed.items():
+        if "sum" in kinds:
+            aggs.append((col, "sum"))
+            rename.append((f"{col}_sum", f"__sum_{col}"))
+        if "count" in kinds or "sum" in kinds:
+            aggs.append((col, "count"))
+            rename.append((f"{col}_count", f"__count_{col}"))
+        if "min" in kinds:
+            aggs.append((col, "min"))
+            rename.append((f"{col}_min", f"__min_{col}"))
+        if "max" in kinds:
+            aggs.append((col, "max"))
+            rename.append((f"{col}_max", f"__max_{col}"))
+
+    # presence: rows per group regardless of value-column nulls
+    ones = pa.array(np.ones(table.num_rows, dtype=np.int64))
+    table = table.append_column(PRESENCE, ones)
+    aggs.append((PRESENCE, "sum"))
+    rename.append((f"{PRESENCE}_sum", PRESENCE))
+
+    last_cols = [c for c, kinds in needed.items() if "last" in kinds]
+    if last_cols and spec.ts_col:
+        # fold last_value(col ORDER BY ts) via a ts-sorted pass
+        table = table.sort_by([(spec.ts_col, "ascending")])
+    grouped = table.group_by(keys, use_threads=False).aggregate(aggs)
+    out_names = []
+    ren = dict(rename)
+    for name in grouped.column_names:
+        out_names.append(ren.get(name, name))
+    grouped = grouped.rename_columns(out_names)
+    if last_cols and spec.ts_col:
+        lasts = (
+            table.group_by(keys, use_threads=False)
+            .aggregate([(c, "last") for c in last_cols] + [(spec.ts_col, "max")])
+        )
+        lnames = []
+        for name in lasts.column_names:
+            for c in last_cols:
+                if name == f"{c}_last":
+                    name = f"__last_{c}"
+            if name == f"{spec.ts_col}_max":
+                name = "__last_ts"
+            lnames.append(name)
+        lasts = lasts.rename_columns(lnames)
+        grouped = grouped.join(lasts, keys=keys, join_type="inner")
+    return grouped
+
+
+def merge_states(state_tables: list[pa.Table], spec: AggSpec) -> pa.Table:
+    """The UPPER stage: fold per-node state tables into final outputs with
+    the same column naming as the device kernels ('avg(col)', 'count(*)')."""
+    keys = spec.group_cols() or ["__global"]
+    tables = [t for t in state_tables if t is not None and t.num_rows]
+    if not tables:
+        # empty result with the right shape: no groups at all
+        fields = [pa.field(k, pa.string()) for k in spec.group_tags]
+        if spec.bucket is not None:
+            fields.append(pa.field(spec.bucket[0], pa.int64()))
+        for func, col in spec.agg_specs:
+            name = "count(*)" if col is None else f"{func}({col})"
+            fields.append(
+                pa.field(name, pa.int64() if func == "count" or col is None else pa.float64())
+            )
+        return pa.schema(fields).empty_table()
+    all_states = pa.concat_tables(tables, promote_options="permissive")
+
+    aggs: list[tuple[str, str]] = []
+    for name in all_states.column_names:
+        if name.startswith("__sum_") or name.startswith("__count_") or name == PRESENCE:
+            aggs.append((name, "sum"))
+        elif name.startswith("__min_"):
+            aggs.append((name, "min"))
+        elif name.startswith("__max_"):
+            aggs.append((name, "max"))
+    has_last = any(n.startswith("__last_") and n != "__last_ts" for n in all_states.column_names)
+    if has_last:
+        all_states = all_states.sort_by([("__last_ts", "ascending")])
+        for name in all_states.column_names:
+            if name.startswith("__last_") and name != "__last_ts":
+                aggs.append((name, "last"))
+        aggs.append(("__last_ts", "max"))
+    merged = all_states.group_by(keys, use_threads=False).aggregate(aggs)
+
+    def col(name):
+        return merged[name]
+
+    out: dict[str, pa.Array] = {
+        k: merged[k] for k in keys if k != "__global"
+    }
+    for func, c in spec.agg_specs:
+        if c is None:
+            out["count(*)"] = pc.cast(col(f"{PRESENCE}_sum"), pa.int64())
+            continue
+        name = f"{func}({c})"
+        if func == "count":
+            out[name] = pc.cast(col(f"__count_{c}_sum"), pa.int64())
+        elif func == "sum":
+            cnt = col(f"__count_{c}_sum")
+            s = col(f"__sum_{c}_sum")
+            out[name] = pc.if_else(pc.greater(cnt, 0), s, pa.nulls(merged.num_rows, s.type))
+        elif func == "avg":
+            cnt = pc.cast(col(f"__count_{c}_sum"), pa.float64())
+            s = pc.cast(col(f"__sum_{c}_sum"), pa.float64())
+            out[name] = pc.if_else(
+                pc.greater(cnt, 0), pc.divide(s, cnt), pa.nulls(merged.num_rows, pa.float64())
+            )
+        elif func == "min":
+            out[name] = col(f"__min_{c}_min")
+        elif func == "max":
+            out[name] = col(f"__max_{c}_max")
+        elif func == "last_value":
+            out[name] = col(f"__last_{c}_last")
+    return pa.table(out)

@@ -35,12 +35,18 @@ class QueryEngine:
         time_bounds_provider,
         config: QueryConfig | None = None,
         mesh=None,
+        tile_context_provider=None,
+        partial_agg_provider=None,
     ):
         """
         schema_provider(table, database) -> Schema
         scan_provider(scan: TableScan) -> pa.Table           (merged regions)
         region_scan_provider(scan) -> list[pa.Table]         (one per region)
         time_bounds_provider(table, database) -> (min_ts, max_ts)
+        tile_context_provider(scan) -> TileContext | None    (HBM tile cache)
+        partial_agg_provider(scan, spec_dict) -> list[pa.Table] | None
+            (distributed lower/state stage: datanodes return [groups]-sized
+            mergeable states instead of raw rows — MergeScan on the wire)
         """
         self.config = config or QueryConfig()
         self.schema_of = schema_provider
@@ -48,6 +54,15 @@ class QueryEngine:
         self._mesh = mesh
         self._region_scan = region_scan_provider
         self._time_bounds = time_bounds_provider
+        self._tile_ctx = tile_context_provider
+        self._partial_agg = partial_agg_provider
+        self.tile_cache = None
+        self._tile_executor = None
+        if self.config.tile_cache_enable and tile_context_provider is not None:
+            from ..parallel.tile_cache import TileCacheManager, TileExecutor
+
+            self.tile_cache = TileCacheManager(self.config.tile_cache_mb << 20)
+            self._tile_executor = TileExecutor(self.tile_cache, self.config)
 
     @property
     def mesh(self):
@@ -72,6 +87,20 @@ class QueryEngine:
         try:
             if self.config.backend == "tpu" and schema.columns:
                 lowering = try_lower(plan, schema)
+                if lowering is not None and self._partial_agg is not None:
+                    # distributed: ship the aggregate, merge states — never
+                    # rows — across nodes (reference MergeScan split)
+                    from .dist_agg import merge_states, spec_from_lowering
+
+                    spec = spec_from_lowering(lowering, schema)
+                    if spec is not None:
+                        states = self._partial_agg(lowering.scan, spec.to_dict())
+                        if states is not None:
+                            backend = "dist_states"
+                            merged = merge_states(states, spec)
+                            shaper = TpuExecutor(None, None)
+                            metrics.DIST_STATE_QUERIES.inc()
+                            return shaper._shape_output(merged, lowering, schema)
                 if lowering is not None:
                     backend = "tpu"
                     with span("query.tpu", table=lowering.scan.table):
@@ -79,6 +108,8 @@ class QueryEngine:
                             self.mesh,
                             self._region_scan,
                             acc_dtype="float64" if _x64_enabled() else "float32",
+                            tile_executor=self._tile_executor,
+                            tile_context_provider=self._tile_ctx,
                         )
                         scan = lowering.scan
                         return tpu.execute(

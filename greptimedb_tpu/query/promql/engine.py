@@ -219,6 +219,14 @@ class PromqlEngine:
             return Scalar(vals)
         if f in ("sort", "sort_desc"):
             return self._eval(node.args[0], start, end, step)  # order applied at output
+        if f == "histogram_quantile":
+            phi_arg = self._eval(node.args[0], start, end, step)
+            if not isinstance(phi_arg, Scalar):
+                raise PlanError("promql: histogram_quantile expects a scalar φ")
+            m = self._eval(node.args[1], start, end, step)
+            if isinstance(m, Scalar):
+                raise PlanError("promql: histogram_quantile expects bucket series")
+            return _histogram_quantile(phi_arg.value, m)
         raise UnsupportedError(f"promql: function {f} not supported yet")
 
     def _resolve_at(self, at_spec, start, end):
@@ -837,6 +845,77 @@ def _vec_op(op: str, a, b, bool_modifier: bool):
         # filter semantics: keep left value where true, NaN elsewhere
         left = a if isinstance(a, np.ndarray) else np.broadcast_to(a, np.shape(m))
         return np.where(m, left, np.nan)
+
+
+def _histogram_quantile(phi, m: Matrix) -> Matrix:
+    """Prometheus histogram_quantile: fold `le`-bucketed cumulative series
+    per label set and interpolate the φ-quantile inside the located bucket
+    (reference promql/src/extension_plan/histogram_fold.rs; semantics from
+    Prometheus bucketQuantile: monotonicity repair, +Inf top bucket
+    required, linear interpolation, φ out of [0,1] -> ±Inf)."""
+    if "le" not in m.label_names:
+        return Matrix(m.label_names, [], np.zeros((0, len(m.steps))), m.steps)
+    le_i = m.label_names.index("le")
+    out_names = [n for n in m.label_names if n != "le"]
+    groups: dict[tuple, list[tuple[float, int]]] = {}
+    for s, lv in enumerate(m.label_values):
+        raw = lv[le_i]
+        try:
+            le = float("inf") if raw in ("+Inf", "Inf", "inf") else float(raw)
+        except (TypeError, ValueError):
+            continue
+        key = tuple(v for j, v in enumerate(lv) if j != le_i)
+        groups.setdefault(key, []).append((le, s))
+
+    W = len(m.steps)
+    phi_row = np.broadcast_to(np.asarray(phi, np.float64), (W,))
+    out_labels: list[tuple] = []
+    out_rows: list[np.ndarray] = []
+    for key, buckets in groups.items():
+        buckets.sort()
+        les = np.array([b[0] for b in buckets])
+        if len(les) < 2 or not np.isinf(les[-1]):
+            continue  # need at least one finite bucket plus +Inf
+        cum = m.values[[s for _le, s in buckets], :]  # [B, W] cumulative
+        # absent bucket samples (NaN) contribute nothing: carry the lower
+        # bucket's cumulative count forward (Prometheus computes from the
+        # buckets present); monotonicity repair rides the same accumulate
+        cum = np.maximum.accumulate(np.where(np.isnan(cum), -np.inf, cum), axis=0)
+        all_absent = np.isneginf(cum[-1])
+        cum = np.maximum(cum, 0.0)
+        total = np.where(all_absent, np.nan, cum[-1])
+        res = np.full(W, np.nan)
+        valid = ~np.isnan(total) & (total > 0) & ~np.isnan(phi_row)
+        rank = phi_row * total
+        # first bucket whose cumulative count reaches the rank
+        reached = cum >= rank[None, :]
+        b = np.argmax(reached, axis=0)
+        b = np.where(reached.any(axis=0), b, len(les) - 1)
+        top = b == len(les) - 1
+        res = np.where(valid & top, les[-2], res)
+        inner = valid & ~top
+        if inner.any():
+            b_in = np.where(inner, b, 1)
+            end_le = les[b_in]
+            start_le = np.where(b_in > 0, les[np.maximum(b_in - 1, 0)], 0.0)
+            # Prometheus: first bucket with le <= 0 returns its le directly
+            first_nonpos = (b_in == 0) & (les[0] <= 0)
+            count_before = np.where(
+                b_in > 0, np.take_along_axis(cum, np.maximum(b_in - 1, 0)[None, :], 0)[0], 0.0
+            )
+            bucket_count = np.take_along_axis(cum, b_in[None, :], 0)[0] - count_before
+            interp = start_le + (end_le - start_le) * np.where(
+                bucket_count > 0, (rank - count_before) / np.where(bucket_count > 0, bucket_count, 1), 0.0
+            )
+            res = np.where(inner, np.where(first_nonpos, les[0], interp), res)
+        res = np.where(
+            valid & (phi_row < 0), -np.inf,
+            np.where(valid & (phi_row > 1), np.inf, res),
+        )
+        out_labels.append(key)
+        out_rows.append(res)
+    values = np.stack(out_rows) if out_rows else np.zeros((0, W))
+    return Matrix(out_names, out_labels, values, m.steps)
 
 
 def _matrix_to_table(m: Matrix) -> pa.Table:

@@ -132,13 +132,27 @@ def try_lower(plan: LogicalPlan, schema: Schema) -> Lowering | None:
 
 
 class TpuExecutor:
-    """Executes lowered plans on the device mesh; delegates post-ops to CPU."""
+    """Executes lowered plans on the device mesh; delegates post-ops to CPU.
 
-    def __init__(self, mesh, region_scan_provider, acc_dtype: str = "float64"):
+    When a tile executor is wired in (the HBM-resident SST tile cache,
+    parallel/tile_cache.py), it is tried FIRST: warm queries skip the
+    Arrow scan + re-encode + upload entirely and go straight to one
+    compiled dispatch over cached device tiles."""
+
+    def __init__(
+        self,
+        mesh,
+        region_scan_provider,
+        acc_dtype: str = "float64",
+        tile_executor=None,
+        tile_context_provider=None,
+    ):
         # region_scan_provider(scan: TableScan) -> list[pa.Table], one per region
         self.mesh = mesh
         self.region_scan = region_scan_provider
         self.acc_dtype = acc_dtype
+        self.tile_executor = tile_executor
+        self.tile_context_provider = tile_context_provider
 
     def execute(self, lowering: Lowering, schema: Schema, time_bounds) -> pa.Table:
         """time_bounds: callback () -> (min_ts, max_ts) over the scanned data,
@@ -147,6 +161,17 @@ class TpuExecutor:
         from ..parallel.executor import distributed_groupby
 
         scan = lowering.scan
+        if self.tile_executor is not None and self.tile_context_provider is not None:
+            ctx = self.tile_context_provider(scan)
+            if ctx is not None:
+                table = self.tile_executor.execute(
+                    lowering,
+                    schema,
+                    lambda: time_bounds(),
+                    ctx,
+                )
+                if table is not None:
+                    return self._shape_output(table, lowering, schema)
         if lowering.bucket is not None:
             ts_col, interval, origin_hint = lowering.bucket
             if scan.time_range is not None and scan.time_range[0] > -(1 << 61) and scan.time_range[1] < (1 << 61):
@@ -179,6 +204,11 @@ class TpuExecutor:
         )
         table = result.to_table()
         metrics.TPU_LOWERED_TOTAL.inc()
+        return self._shape_output(table, lowering, schema)
+
+    def _shape_output(self, table: pa.Table, lowering: Lowering, schema: Schema) -> pa.Table:
+        """Kernel output -> SQL result: plan names, empty-input semantics,
+        host-side post ops.  Shared by the mesh and tile-cache paths."""
         table = self._rename_to_plan_names(table, lowering, schema)
         if (
             not lowering.group_tags

@@ -69,7 +69,10 @@ class TimeSeriesEngine:
         self._lock = threading.Lock()
 
     # ---- region lifecycle -------------------------------------------------
-    def create_region(self, region_id: int, schema: Schema, writable: bool = True) -> Region:
+    def create_region(
+        self, region_id: int, schema: Schema, writable: bool = True,
+        append_mode: bool = False,
+    ) -> Region:
         with self._lock:
             if region_id in self._regions:
                 return self._regions[region_id]
@@ -84,11 +87,12 @@ class TimeSeriesEngine:
                 index_enable=self.config.index_enable,
                 index_segment_rows=self.config.index_segment_rows,
                 index_inverted_max_terms=self.config.index_inverted_max_terms,
+                append_mode=append_mode,
             )
             self._regions[region_id] = region
             return region
 
-    def open_region(self, region_id: int) -> Region:
+    def open_region(self, region_id: int, append_mode: bool = False) -> Region:
         """Open an existing region from its manifest + WAL (crash recovery)."""
         with self._lock:
             if region_id in self._regions:
@@ -106,6 +110,7 @@ class TimeSeriesEngine:
                 index_enable=self.config.index_enable,
                 index_segment_rows=self.config.index_segment_rows,
                 index_inverted_max_terms=self.config.index_inverted_max_terms,
+                append_mode=append_mode,
             )
             self._regions[region_id] = region
             return region

@@ -92,8 +92,8 @@ class Memtable:
         table = _sort_and_dedup(table, self.schema, dedup=dedup)
         return table.drop_columns([_SEQ_COL])
 
-    def scan(self, time_range: tuple[int, int] | None = None) -> pa.Table:
-        table = self.to_table(dedup=True)
+    def scan(self, time_range: tuple[int, int] | None = None, dedup: bool = True) -> pa.Table:
+        table = self.to_table(dedup=dedup)
         if time_range is not None and self.schema.time_index is not None:
             lo, hi = time_range
             ts_name = self.schema.time_index.name
@@ -102,11 +102,11 @@ class Memtable:
             table = table.filter(mask)
         return table
 
-    def split_by_time_partition(self) -> list[tuple[int, pa.Table]]:
+    def split_by_time_partition(self, dedup: bool = True) -> list[tuple[int, pa.Table]]:
         """Split into (window_start_ms, rows) — flush writes one SST per window
         so SSTs stay window-aligned for TWCS (reference
         mito2/src/memtable/time_partition.rs)."""
-        table = self.to_table(dedup=True)
+        table = self.to_table(dedup=dedup)
         ts_col = self.schema.time_index
         if table.num_rows == 0:
             return []

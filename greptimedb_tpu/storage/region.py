@@ -67,6 +67,7 @@ class Region:
         index_enable: bool = True,
         index_segment_rows: int = 1024,
         index_inverted_max_terms: int = 4096,
+        append_mode: bool = False,
     ):
         from .object_store import FsObjectStore, ObjectStore
 
@@ -84,6 +85,11 @@ class Region:
         self.time_partition_ms = time_partition_ms
         self._lock = threading.RLock()
         self.writable = writable  # follower replicas are read-only
+        # Append-only mode (reference mito2 `append_mode` table option):
+        # duplicates are kept (no last-write-wins dedup) and DELETE is
+        # rejected — the shape log/trace workloads want, and the condition
+        # under which the device tile cache can aggregate SSTs directly.
+        self.append_mode = append_mode
 
         self.manifest_mgr = ManifestManager(self.store, region_id, checkpoint_distance)
         if self.manifest_mgr.manifest.schema is None:
@@ -173,6 +179,10 @@ class Region:
         normal WAL/memtable path — _conform null-fills the field columns —
         and dedup hides the victims immediately (reference mito2 handles
         OpType::Delete the same way)."""
+        if self.append_mode:
+            from ..utils.errors import UnsupportedError
+
+            raise UnsupportedError("DELETE is not supported on append_mode tables")
         if isinstance(keys, pa.Table):
             keys = keys.combine_chunks()
             batches = keys.to_batches()
@@ -203,7 +213,9 @@ class Region:
             self._frozen_memtables.append(frozen)
         t0 = time.perf_counter()
         added: list[FileMeta] = []
-        for _window_start, table in frozen.split_by_time_partition():
+        for _window_start, table in frozen.split_by_time_partition(
+            dedup=not self.append_mode
+        ):
             meta = self.sst_writer.write(table, level=0)
             if meta is not None:
                 added.append(meta)
@@ -313,7 +325,7 @@ class Region:
             ts_name = self.schema.time_index.name if self.schema.time_index else None
             mem_rows = 0
             for mem in mems:
-                mem_table = mem.scan(pred.time_range)
+                mem_table = mem.scan(pred.time_range, dedup=not self.append_mode)
                 if mem_table.num_rows:
                     mem_table = _apply_residual(mem_table, prune_pred, ts_name)
                 if mem_table.num_rows:
@@ -391,14 +403,38 @@ class Region:
             return table
         # Order sources oldest->newest (SSTs then memtable appended last);
         # reuse memtable sort+dedup with the append order as sequence.
+        # append_mode keeps duplicates but still sorts by (pk, ts) so
+        # downstream consumers (PromQL, range kernels) see ordered series.
         import numpy as np
 
         from .memtable import _SEQ_COL, _sort_and_dedup
 
         seq = pa.array(np.arange(table.num_rows, dtype=np.int64))
         table = table.append_column(_SEQ_COL, seq)
-        table = _sort_and_dedup(table, self.schema, dedup=True)
+        table = _sort_and_dedup(table, self.schema, dedup=not self.append_mode)
         return table.drop_columns([_SEQ_COL])
+
+    # ---- tile-cache support ------------------------------------------------
+    def pin_scan(self):
+        """Hold the deferred-purge refcount open while the device tile cache
+        reads SST files outside `scan()` (same protection in-flight scans
+        get: compaction must not delete files under us)."""
+        with self._lock:
+            self._active_scans += 1
+
+    def unpin_scan(self):
+        with self._lock:
+            self._active_scans -= 1
+            self._purge_garbage_locked()
+
+    def tile_snapshot(self) -> tuple[list[FileMeta], list[Memtable], int]:
+        """Consistent (files, memtables, manifest_version) snapshot for the
+        tile executor.  Caller must hold pin_scan() around use."""
+        with self._lock:
+            files = list(self.manifest_mgr.manifest.files.values())
+            mems = list(self._frozen_memtables) + [self.memtable]
+            version = self.manifest_mgr.manifest.manifest_version
+        return files, mems, version
 
     # ---- admin ------------------------------------------------------------
     def truncate(self):

@@ -44,6 +44,10 @@ class FileMeta:
     level: int = 0
     indexed_columns: list[str] = field(default_factory=list)
     index_file_size: int = 0
+    # Delete-tombstone rows in the file; -1 = unknown (file written before
+    # this field existed).  The device tile cache only aggregates files it
+    # can PROVE tombstone-free.
+    num_deletes: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -54,6 +58,7 @@ class FileMeta:
             "level": self.level,
             "indexed_columns": self.indexed_columns,
             "index_file_size": self.index_file_size,
+            "num_deletes": self.num_deletes,
         }
 
     @classmethod
@@ -66,6 +71,7 @@ class FileMeta:
             level=d.get("level", 0),
             indexed_columns=d.get("indexed_columns", []),
             index_file_size=d.get("index_file_size", 0),
+            num_deletes=d.get("num_deletes", -1),
         )
 
 
@@ -129,6 +135,14 @@ class SstWriter:
             t_min, t_max = pc.min(ts).as_py(), pc.max(ts).as_py()
         else:
             t_min = t_max = 0
+        num_deletes = 0
+        if "__op" in table.column_names:
+            num_deletes = int(
+                pc.sum(
+                    pc.fill_null(pc.cast(table["__op"], pa.int64()), 0)
+                ).as_py()
+                or 0
+            )
         # Dictionary-encode tag columns: small files + pre-built codes for TPU.
         for tag in self.schema.tag_columns():
             if tag.name in table.column_names and not pa.types.is_dictionary(
@@ -161,6 +175,7 @@ class SstWriter:
             level=level,
             indexed_columns=indexed,
             index_file_size=index_size,
+            num_deletes=num_deletes,
         )
 
 

@@ -90,6 +90,10 @@ class QueryConfig:
     max_groups: int = 1 << 16
     parallelism: int = 0  # 0 = number of local devices
     fallback_to_cpu: bool = True
+    # HBM-resident SST tile cache (parallel/tile_cache.py): warm queries run
+    # as one dispatch over cached device tiles instead of re-scanning Arrow.
+    tile_cache_enable: bool = True
+    tile_cache_mb: int = 8192
 
 
 @dataclasses.dataclass

@@ -22,3 +22,33 @@ def ensure_x64():
 
     jax.config.update("jax_enable_x64", True)
     _done = True
+
+
+_cache_done = False
+
+
+def ensure_compilation_cache(path: str | None = None):
+    """Persistent XLA compilation cache: query-plan shapes compile once per
+    machine, not once per process (cold-query latency is dominated by XLA
+    compilation; the reference's equivalent is DataFusion having no
+    compilation step at all, so cold starts must not regress vs it)."""
+    global _cache_done
+    if _cache_done:
+        return
+    import os
+
+    import jax
+
+    if path is None:
+        path = os.environ.get(
+            "GREPTIMEDB_TPU_XLA_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache", "greptimedb_tpu_xla"),
+        )
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        pass
+    _cache_done = True

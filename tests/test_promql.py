@@ -408,3 +408,58 @@ def test_tql_label_replace_braced_and_dollar(db):
         " \"price\", \"$$5\", \"host\", \"(.*)\")"
     )
     assert t["price"].to_pylist() == ["$5"]
+
+
+# ---- histogram_quantile ----------------------------------------------------
+
+
+def _pq(db, q, start, end, step):
+    from greptimedb_tpu.query.promql.engine import PromqlEngine
+
+    return PromqlEngine(db).query_range(q, start, end, step)
+
+
+def _mk_histogram(db):
+    db.sql(
+        "CREATE TABLE hist (le STRING, job STRING, ts TIMESTAMP(3), val DOUBLE,"
+        " TIME INDEX (ts), PRIMARY KEY (le, job))"
+    )
+    # cumulative bucket counts at one instant, classic Prometheus layout
+    rows = []
+    for job, counts in (("api", [10, 30, 60, 100]), ("db", [0, 5, 5, 40])):
+        for le, c in zip(["0.1", "0.5", "1", "+Inf"], counts):
+            rows.append(f"('{le}', '{job}', 60000, {c})")
+    db.sql("INSERT INTO hist VALUES " + ",".join(rows))
+
+
+def test_histogram_quantile_interpolates(db):
+    _mk_histogram(db)
+    t = _pq(db, "histogram_quantile(0.5, hist)", 60_000, 60_000, 1000)
+    got = {}
+    for i in range(t.num_rows):
+        got[t["job"][i].as_py()] = t["value"][i].as_py()
+    # api: total=100, rank=50; bucket (0.5, 1] holds counts 30->60
+    #   -> 0.5 + (1-0.5)*(50-30)/30
+    assert abs(got["api"] - (0.5 + 0.5 * 20 / 30)) < 1e-9
+    # db: total=40, rank=20; bucket (1, +Inf] -> returns le of the last
+    # finite bucket
+    assert got["db"] == 1.0
+    assert "le" not in t.column_names
+
+
+def test_histogram_quantile_phi_bounds(db):
+    _mk_histogram(db)
+    hi = _pq(db, "histogram_quantile(1.5, hist)", 60_000, 60_000, 1000)
+    assert all(v == float("inf") for v in hi["value"].to_pylist())
+    lo = _pq(db, "histogram_quantile(-1, hist)", 60_000, 60_000, 1000)
+    assert all(v == float("-inf") for v in lo["value"].to_pylist())
+
+
+def test_histogram_quantile_requires_inf_bucket(db):
+    db.sql(
+        "CREATE TABLE nobuck (le STRING, ts TIMESTAMP(3), val DOUBLE,"
+        " TIME INDEX (ts), PRIMARY KEY (le))"
+    )
+    db.sql("INSERT INTO nobuck VALUES ('0.5', 60000, 10), ('1', 60000, 20)")
+    t = _pq(db, "histogram_quantile(0.9, nobuck)", 60_000, 60_000, 1000)
+    assert t.num_rows == 0  # no +Inf bucket -> no result series

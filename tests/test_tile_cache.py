@@ -1,0 +1,271 @@
+"""HBM tile cache: correctness vs the authoritative CPU path, cache
+lifecycle (hits, invalidation, dictionary-growth repair, eviction), and
+the dedup-safety gate (reference parity: mito2 write cache serves reads
+from cached media, mito-codec pre-encodes keys at write time)."""
+
+import math
+
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.utils import metrics
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(data_home=str(tmp_path / "db"))
+    yield d
+    d.close()
+
+
+def _mk_cpu_table(db, name="cpu", append=""):
+    with_clause = f" WITH (append_mode = 'true')" if append else ""
+    db.sql(
+        f"CREATE TABLE {name} (host STRING, region STRING, ts TIMESTAMP TIME INDEX,"
+        f" usage_user DOUBLE, usage_system DOUBLE, PRIMARY KEY (host, region))"
+        + with_clause
+    )
+
+
+def _load(db, name="cpu", hosts=6, ticks=120, t0=0):
+    rows = []
+    for t in range(ticks):
+        for h in range(hosts):
+            rows.append(
+                f"('host_{h}', 'r{h % 2}', {t0 + t * 1000}, {t % 13 + h}, {(t + h) % 7})"
+            )
+    db.sql(f"INSERT INTO {name} VALUES " + ",".join(rows))
+
+
+Q = (
+    "SELECT host, time_bucket('30s', ts) AS tb, avg(usage_user) AS au,"
+    " max(usage_system) AS ms, count(*) AS c FROM cpu GROUP BY host, tb"
+)
+
+
+def _both(db, q):
+    """Run on the TPU (tile) path and the CPU path; return both tables."""
+    db.config.query.backend = "tpu"
+    t1 = db.sql_one(q)
+    db.config.query.backend = "cpu"
+    t2 = db.sql_one(q)
+    db.config.query.backend = "tpu"
+    return t1, t2
+
+
+def _assert_equal(t1: pa.Table, t2: pa.Table, keys):
+    assert t1.num_rows == t2.num_rows
+    s1 = t1.sort_by([(k, "ascending") for k in keys]).to_pydict()
+    s2 = t2.sort_by([(k, "ascending") for k in keys]).to_pydict()
+    assert len(s1) == len(s2)
+    for c1, c2 in zip(list(s1), list(s2)):
+        for x, y in zip(s1[c1], s2[c2]):
+            if isinstance(x, float) and isinstance(y, float):
+                assert math.isclose(x, y, rel_tol=1e-9) or (
+                    math.isnan(x) and math.isnan(y)
+                ), (c1, x, y)
+            else:
+                assert x == y, (c1, x, y)
+
+
+def _tile_count():
+    return metrics.TILE_LOWERED_TOTAL.get()
+
+
+def test_tile_path_engages_and_matches_cpu(db):
+    _mk_cpu_table(db)
+    _load(db)
+    db.sql("ADMIN flush_table('cpu')")
+    before = _tile_count()
+    t1, t2 = _both(db, Q)
+    assert _tile_count() == before + 1, "tile path did not engage"
+    _assert_equal(t1, t2, ["host", "tb"])
+
+
+def test_warm_query_hits_cache(db):
+    _mk_cpu_table(db)
+    _load(db)
+    db.sql("ADMIN flush_table('cpu')")
+    db.sql_one(Q)  # cold: builds tiles
+    h0 = metrics.TILE_CACHE_HITS.get()
+    m0 = metrics.TILE_CACHE_MISSES.get()
+    db.sql_one(Q)  # warm
+    assert metrics.TILE_CACHE_HITS.get() > h0
+    assert metrics.TILE_CACHE_MISSES.get() == m0
+
+
+def test_memtable_tail_included(db):
+    _mk_cpu_table(db)
+    _load(db, ticks=60)
+    db.sql("ADMIN flush_table('cpu')")
+    db.sql_one(Q)
+    # fresh rows in a later, disjoint time window stay in the memtable
+    _load(db, ticks=30, t0=600_000)
+    before = _tile_count()
+    t1, t2 = _both(db, Q)
+    assert _tile_count() == before + 1
+    _assert_equal(t1, t2, ["host", "tb"])
+
+
+def test_overlapping_sources_fall_back(db):
+    """Same keys written twice across flushes -> dedup required -> the tile
+    path must NOT engage, and results stay correct via the scan path."""
+    _mk_cpu_table(db)
+    _load(db, ticks=50)
+    db.sql("ADMIN flush_table('cpu')")
+    _load(db, ticks=50)  # identical (host, ts) keys again
+    db.sql("ADMIN flush_table('cpu')")
+    before = _tile_count()
+    t1, t2 = _both(db, Q)
+    assert _tile_count() == before, "tile path engaged despite overlap"
+    _assert_equal(t1, t2, ["host", "tb"])
+    # last-write-wins: counts match the single-write load
+    assert sum(t1["c"].to_pylist()) == 50 * 6
+
+
+def test_append_mode_keeps_duplicates_and_tiles(db):
+    _mk_cpu_table(db, append=True)
+    _load(db, ticks=50)
+    db.sql("ADMIN flush_table('cpu')")
+    _load(db, ticks=50)  # duplicates are KEPT in append mode
+    db.sql("ADMIN flush_table('cpu')")
+    before = _tile_count()
+    t1, t2 = _both(db, Q)
+    assert _tile_count() == before + 1, "append_mode table should tile"
+    _assert_equal(t1, t2, ["host", "tb"])
+    assert sum(t1["c"].to_pylist()) == 2 * 50 * 6
+
+
+def test_append_mode_rejects_delete(db):
+    _mk_cpu_table(db, append=True)
+    _load(db, ticks=5)
+    with pytest.raises(Exception, match="append_mode"):
+        db.sql("DELETE FROM cpu WHERE host = 'host_0'")
+
+
+def test_deleted_rows_fall_back(db):
+    _mk_cpu_table(db)
+    _load(db, ticks=30)
+    db.sql("DELETE FROM cpu WHERE host = 'host_3' AND ts < 10000")
+    db.sql("ADMIN flush_table('cpu')")
+    before = _tile_count()
+    t1, t2 = _both(db, Q)
+    assert _tile_count() == before, "tombstoned file must not tile"
+    _assert_equal(t1, t2, ["host", "tb"])
+
+
+def test_dictionary_growth_repairs_cached_tiles(db):
+    """New tag values that sort BEFORE existing ones shift codes; cached
+    tiles must be remapped (not re-read) and results stay correct."""
+    _mk_cpu_table(db)
+    _load(db, hosts=4, ticks=40)
+    db.sql("ADMIN flush_table('cpu')")
+    db.sql_one(Q)  # tiles built with codes for host_0..host_3
+    d = db.dicts.get("public.cpu")
+    epoch0 = d.epoch
+    # 'aaa_host' sorts before every existing value -> all codes shift
+    rows = [f"('aaa_host', 'r0', {1_000_000 + t * 1000}, 1.5, 2.5)" for t in range(20)]
+    db.sql("INSERT INTO cpu VALUES " + ",".join(rows))
+    db.sql("ADMIN flush_table('cpu')")
+    before = _tile_count()
+    t1, t2 = _both(db, Q)
+    assert _tile_count() == before + 1
+    assert d.epoch > epoch0
+    _assert_equal(t1, t2, ["host", "tb"])
+    assert "aaa_host" in set(t1["host"].to_pylist())
+
+
+def test_filters_on_tags_and_values(db):
+    _mk_cpu_table(db)
+    _load(db)
+    db.sql("ADMIN flush_table('cpu')")
+    for q in [
+        "SELECT host, count(*) AS c FROM cpu WHERE region = 'r0' GROUP BY host",
+        "SELECT host, count(*) AS c FROM cpu WHERE host > 'host_2' GROUP BY host",
+        "SELECT host, count(*) AS c FROM cpu WHERE host <= 'host_3' GROUP BY host",
+        "SELECT host, count(*) AS c FROM cpu WHERE host IN ('host_1','host_4') GROUP BY host",
+        "SELECT host, sum(usage_user) AS s FROM cpu WHERE usage_system > 3 GROUP BY host",
+        "SELECT host, max(usage_user) AS m FROM cpu WHERE ts >= 30000 AND ts < 90000 GROUP BY host",
+    ]:
+        t1, t2 = _both(db, q)
+        _assert_equal(t1, t2, [t1.column_names[0]])
+
+
+def test_string_inequality_filter_is_exact(db):
+    """Sorted dictionary codes make host > 'host_2' exact on codes."""
+    _mk_cpu_table(db)
+    _load(db, hosts=6, ticks=10)
+    db.sql("ADMIN flush_table('cpu')")
+    t = db.sql_one("SELECT host, count(*) AS c FROM cpu WHERE host > 'host_2' GROUP BY host")
+    hosts = sorted(set(t["host"].to_pylist()))
+    assert hosts == ["host_3", "host_4", "host_5"]
+
+
+def test_null_tags_and_values(db):
+    db.sql(
+        "CREATE TABLE n (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE,"
+        " PRIMARY KEY (host))"
+    )
+    db.sql(
+        "INSERT INTO n VALUES ('a', 1000, 1.0), (NULL, 2000, 2.0),"
+        " ('b', 3000, NULL), (NULL, 4000, NULL), ('a', 5000, 5.0)"
+    )
+    db.sql("ADMIN flush_table('n')")
+    q = "SELECT host, sum(v) AS s, count(v) AS cv, count(*) AS c FROM n GROUP BY host"
+    before = _tile_count()
+    t1, t2 = _both(db, q)
+    assert _tile_count() == before + 1
+    assert t1.num_rows == 3  # 'a', 'b', NULL groups
+    _assert_equal(t1, t2, ["host"])
+
+
+def test_dictionary_persists_across_restart(db, tmp_path):
+    _mk_cpu_table(db)
+    _load(db, hosts=3, ticks=20)
+    db.sql("ADMIN flush_table('cpu')")
+    db.sql_one(Q)
+    vals = db.dicts.get("public.cpu").values("host")
+    db.close()
+    db2 = Database(data_home=str(tmp_path / "db"))
+    try:
+        assert db2.dicts.get("public.cpu").values("host") == vals
+        t1, t2 = _both(db2, Q)
+        _assert_equal(t1, t2, ["host", "tb"])
+    finally:
+        db2.close()
+
+
+def test_eviction_under_tiny_budget(db):
+    db.query_engine.tile_cache.budget = 1  # evict everything not pinned
+    _mk_cpu_table(db)
+    _load(db)
+    db.sql("ADMIN flush_table('cpu')")
+    t1a = db.sql_one(Q)
+    e0 = metrics.TILE_CACHE_EVICTIONS.get()
+    t1b = db.sql_one(Q)  # rebuilt after eviction, still correct
+    assert metrics.TILE_CACHE_EVICTIONS.get() >= e0
+    _assert_equal(t1a, t1b, ["host", "tb"])
+
+
+def test_compaction_invalidates_tiles(db):
+    _mk_cpu_table(db)
+    _load(db, ticks=40)
+    db.sql("ADMIN flush_table('cpu')")
+    db.sql_one(Q)
+    _load(db, ticks=40, t0=200_000)
+    db.sql("ADMIN flush_table('cpu')")
+    db.sql("ADMIN compact_table('cpu')")
+    t1, t2 = _both(db, Q)
+    _assert_equal(t1, t2, ["host", "tb"])
+
+
+def test_ungrouped_aggregate_tiles(db):
+    _mk_cpu_table(db)
+    _load(db, ticks=30)
+    db.sql("ADMIN flush_table('cpu')")
+    before = _tile_count()
+    t1, t2 = _both(db, "SELECT max(usage_user) AS m, count(*) AS c FROM cpu")
+    assert _tile_count() == before + 1
+    assert t1["m"].to_pylist() == t2["m"].to_pylist()
+    assert t1["c"].to_pylist() == t2["c"].to_pylist()
