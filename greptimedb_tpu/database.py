@@ -104,6 +104,7 @@ class Database:
         self.memory = MemoryGovernor(
             self.config.memory.max_in_flight_write_bytes,
             self.config.memory.max_concurrent_queries,
+            getattr(self.config.memory, "max_scan_bytes", 0),
         )
         from .storage.dictionary import DictionaryRegistry
         from .utils.jax_env import ensure_compilation_cache
@@ -322,6 +323,7 @@ class Database:
                     semantic_type=sem,
                     nullable=c.nullable and sem == SemanticType.FIELD,
                     default=c.default,
+                    fulltext=getattr(c, "fulltext", False),
                 )
             )
         if time_index is None:
@@ -839,6 +841,22 @@ class Database:
             return [fe.scan(meta, self._pred_of(scan))]
         pred = self._pred_of(scan)
         out = []
+        if self.memory.max_scan_bytes > 0:
+            # bounded-memory path: admit each window slice against the scan
+            # budget; a too-large SELECT fails cleanly instead of OOMing
+            with self.memory.scan_tracker() as tracker:
+                for rid in meta.region_ids:
+                    chunks = []
+                    for chunk in self.storage.scan_stream(rid, pred):
+                        tracker.add(chunk.nbytes)
+                        chunks.append(chunk)
+                        self.process_manager.check_cancelled()
+                    out.append(
+                        pa.concat_tables(chunks, promote_options="permissive")
+                        if chunks
+                        else meta.schema.to_arrow().empty_table()
+                    )
+                return out
         for rid in meta.region_ids:
             out.append(self.storage.scan(rid, pred))
             self.process_manager.check_cancelled()  # between-region point

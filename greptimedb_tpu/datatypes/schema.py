@@ -36,6 +36,10 @@ class ColumnSchema:
     # from a previously dropped one of the same name.  0 = unassigned; the
     # Schema constructor allocates ids.
     column_id: int = 0
+    # Fulltext-indexed (reference datatypes fulltext options on ColumnSchema;
+    # declared as `col STRING FULLTEXT INDEX` — SSTs get a tokenized
+    # inverted index consulted by matches()/matches_term()).
+    fulltext: bool = False
 
     def __post_init__(self):
         if self.semantic_type == SemanticType.TIMESTAMP:
@@ -73,6 +77,7 @@ class ColumnSchema:
             "nullable": self.nullable,
             "default": self.default,
             "column_id": self.column_id,
+            "fulltext": self.fulltext,
         }
 
     @classmethod
@@ -84,6 +89,7 @@ class ColumnSchema:
             nullable=d.get("nullable", True),
             default=d.get("default"),
             column_id=d.get("column_id", 0),
+            fulltext=d.get("fulltext", False),
         )
 
 

@@ -184,6 +184,14 @@ def _eval_func(e: FuncCall, table: pa.Table):
 
         target = ConcreteDataType.parse(args[1].value)
         return pc.cast(v, target.to_arrow())
+    if f in ("matches", "matches_term"):
+        from ..storage.index import matches_mask, matches_term_mask
+
+        if len(args) != 2 or not isinstance(args[1], Literal):
+            raise PlanError(f"{f} expects (column, string literal)")
+        col = eval_expr(args[0], table)
+        q = args[1].value
+        return matches_mask(col, q) if f == "matches" else matches_term_mask(col, q)
     if f == "case":
         flat = [eval_expr(a, table) for a in args]
         conds, vals = flat[:-1:2], flat[1:-1:2]

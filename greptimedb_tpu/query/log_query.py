@@ -259,6 +259,11 @@ def _content_filter_mask(f, col: pa.Array) -> np.ndarray:
         return np.asarray(pc.match_substring(str_col, val).fill_null(False))
     if kind == "Regex":
         return np.asarray(pc.match_substring_regex(str_col, val).fill_null(False))
+    if kind in ("Matches", "MatchesTerm"):
+        from ..storage.index import matches_mask, matches_term_mask
+
+        m = matches_mask(str_col, val) if kind == "Matches" else matches_term_mask(str_col, val)
+        return np.asarray(pc.fill_null(m, False))
     if kind == "Exist":
         return ~np.asarray(pc.is_null(col))
     if kind == "IsTrue":

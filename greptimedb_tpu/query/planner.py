@@ -237,6 +237,18 @@ def _resolve_positional(e: Expr, projections: list[Expr]) -> Expr:
 
 def _as_simple_filter(e: Expr, schema: Schema):
     """(col op literal) or col IN (...) -> pushdown triple, else None."""
+    from .expr import FuncCall as _FuncCall
+
+    if (
+        isinstance(e, _FuncCall)
+        and e.func in ("matches", "matches_term")
+        and len(e.args) == 2
+        and isinstance(e.args[0], Column)
+        and isinstance(e.args[1], Literal)
+        and schema.has_column(e.args[0].column)
+    ):
+        op = "match" if e.func == "matches" else "match_term"
+        return (e.args[0].column, op, e.args[1].value)
     if isinstance(e, BinaryOp) and e.op in ("=", "!=", "<", "<=", ">", ">="):
         if isinstance(e.left, Column) and isinstance(e.right, Literal) and schema.has_column(e.left.column):
             return (e.left.column, e.op, e.right.value)

@@ -116,6 +116,7 @@ class ColumnDef:
     default: object = None
     is_time_index: bool = False
     is_primary_key: bool = False
+    fulltext: bool = False
 
 
 @dataclass
@@ -1027,6 +1028,22 @@ class Parser:
                 self.next()
                 self.expect_kw("key")
                 col.is_primary_key = True
+            elif self.eat_kw("fulltext"):
+                # `msg STRING FULLTEXT INDEX [WITH (...)]` (reference sql
+                # fulltext column option; analyzer options accepted+ignored)
+                self.eat_kw("index")
+                if self.eat_kw("with"):
+                    self.expect_op("(")
+                    depth = 1
+                    while depth:
+                        t = self.next()
+                        if t.kind == "op" and t.value == "(":
+                            depth += 1
+                        elif t.kind == "op" and t.value == ")":
+                            depth -= 1
+                        elif t.kind == "eof":
+                            raise InvalidSyntaxError("unterminated FULLTEXT WITH")
+                col.fulltext = True
             else:
                 break
         return col

@@ -58,7 +58,9 @@ def infer_window_ms(files: list[FileMeta]) -> int:
 
 
 def compact_files(region: Region, group: list[FileMeta]) -> FileMeta | None:
-    """Merge one window's files: read, concat, sort+dedup, write level-1."""
+    """Merge one window's files: read, concat, sort(+dedup unless the
+    region is append_mode — duplicates are semantically kept there), write
+    level-1."""
     import numpy as np
 
     tables = []
@@ -71,7 +73,7 @@ def compact_files(region: Region, group: list[FileMeta]) -> FileMeta | None:
     merged = pa.concat_tables(tables, promote_options="permissive")
     seq = pa.array(np.arange(merged.num_rows, dtype=np.int64))
     merged = merged.append_column(_SEQ_COL, seq)
-    merged = _sort_and_dedup(merged, region.schema, dedup=True)
+    merged = _sort_and_dedup(merged, region.schema, dedup=not region.append_mode)
     merged = merged.drop_columns([_SEQ_COL])
     return region.sst_writer.write(merged, level=1)
 
@@ -82,14 +84,18 @@ def compact_region(
     max_active_runs: int = 4,
     max_inactive_runs: int = 1,
 ) -> int:
-    """Run one compaction round; returns number of window merges done."""
-    files = region.files()
-    window = window_ms or infer_window_ms(files)
-    picks = pick_compaction(files, window, max_active_runs, max_inactive_runs)
-    done = 0
-    for group in picks:
-        new_meta = compact_files(region, group)
-        adds = [new_meta] if new_meta is not None else []
-        region.apply_compaction(adds, [f.file_id for f in group])
-        done += 1
-    return done
+    """Run one compaction round; returns number of window merges done.
+    Serialized per region: the background scheduler and ADMIN
+    compact_table must never pick the same group concurrently (the file
+    list is re-read under the lock so a waiter sees the winner's edits)."""
+    with region.compaction_lock:
+        files = region.files()
+        window = window_ms or infer_window_ms(files)
+        picks = pick_compaction(files, window, max_active_runs, max_inactive_runs)
+        done = 0
+        for group in picks:
+            new_meta = compact_files(region, group)
+            adds = [new_meta] if new_meta is not None else []
+            region.apply_compaction(adds, [f.file_id for f in group])
+            done += 1
+        return done

@@ -67,6 +67,17 @@ class TimeSeriesEngine:
         )
         self._regions: dict[int, Region] = {}
         self._lock = threading.Lock()
+        self.compactor = None
+        if getattr(self.config, "compaction_background_enable", True):
+            from .maintenance import CompactionScheduler
+
+            self.compactor = CompactionScheduler(
+                self,
+                tick_secs=getattr(self.config, "compaction_tick_secs", 5.0),
+                window_ms=(self.config.compaction_time_window_secs * 1000) or None,
+                max_active_runs=self.config.compaction_max_active_window_runs,
+                max_inactive_runs=self.config.compaction_max_inactive_window_runs,
+            )
 
     # ---- region lifecycle -------------------------------------------------
     def create_region(
@@ -182,8 +193,10 @@ class TimeSeriesEngine:
         region = self._regions.get(region_id)
         if region is None:
             return
-        region.flush()
+        added = region.flush()
         self.buffer_mgr.set_region_usage(region_id, region.memtable.memory_usage)
+        if added and self.compactor is not None:
+            self.compactor.notify_flush(region_id)
 
     def flush_all(self):
         for rid in self.region_ids():
@@ -207,5 +220,17 @@ class TimeSeriesEngine:
     def _region_store(self, region_id: int):
         return self.object_store.scoped(f"region_{region_id}")
 
+    def scan_stream(
+        self,
+        region_id: int,
+        pred: ScanPredicate | None = None,
+        columns: list[str] | None = None,
+        governor=None,
+    ):
+        """Bounded-memory windowed scan (see Region.scan_windows)."""
+        yield from self.region(region_id).scan_windows(pred, columns, governor=governor)
+
     def close(self):
+        if self.compactor is not None:
+            self.compactor.stop()
         self.wal_mgr.close()

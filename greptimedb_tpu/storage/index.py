@@ -30,6 +30,7 @@ import pyarrow.compute as pc
 
 BLOOM_BLOB = "greptime-bloom-filter-v1"
 INVERTED_BLOB = "greptime-inverted-index-v1"
+FULLTEXT_BLOB = "greptime-fulltext-index-v1"
 DEFAULT_SEGMENT_ROWS = 1024
 BLOOM_FPP = 0.01
 
@@ -163,6 +164,177 @@ def build_inverted_index(
         }
     ).encode()
     return struct.pack("<I", len(header)) + header + payload
+
+
+# ---- fulltext ---------------------------------------------------------------
+
+import re as _re
+
+_TOKEN_RE = _re.compile(r"[a-z0-9_]+")
+
+
+def tokenize(text: str) -> list[str]:
+    """Lowercase word tokenizer (the reference's default English analyzer
+    shape: tantivy SimpleTokenizer + lowercase, index/src/fulltext_index/)."""
+    return _TOKEN_RE.findall(text.lower())
+
+
+def build_fulltext_index(
+    column: pa.Array,
+    segment_rows: int = DEFAULT_SEGMENT_ROWS,
+    max_tokens: int = 1 << 16,
+) -> bytes | None:
+    """token -> segment bitmap over a tokenized text column (reference
+    mito2/src/sst/index/fulltext_index/ creator; segment-granular like the
+    bloom/inverted indexes so pruning plugs into the same applier).  None
+    when the vocabulary exceeds `max_tokens` (index would not pay off)."""
+    n = len(column)
+    if isinstance(column, pa.ChunkedArray):
+        column = column.combine_chunks()
+    if pa.types.is_dictionary(column.type):
+        column = pc.cast(column, column.type.value_type)
+    n_segs = (n + segment_rows - 1) // segment_rows
+    vocab: dict[str, int] = {}
+    rows_tok: list[set] = []
+    for i, v in enumerate(column.to_pylist()):
+        if v is None:
+            continue
+        seg = i // segment_rows
+        while len(rows_tok) <= seg:
+            rows_tok.append(set())
+        for t in tokenize(str(v)):
+            code = vocab.setdefault(t, len(vocab))
+            rows_tok[seg].add(code)
+        if len(vocab) > max_tokens:
+            return None
+    while len(rows_tok) < n_segs:
+        rows_tok.append(set())
+    bm = np.zeros((len(vocab), n_segs), dtype=bool)
+    for seg, codes in enumerate(rows_tok):
+        for c in codes:
+            bm[c, seg] = True
+    packed = np.packbits(bm, axis=1) if len(vocab) else np.zeros((0, 1), np.uint8)
+    payload = zlib.compress(packed.tobytes(), 3)
+    header = json.dumps(
+        {
+            "segment_rows": segment_rows,
+            "n_rows": n,
+            "n_segs": n_segs,
+            "tokens": sorted(vocab, key=vocab.get),
+            "row_bytes": int(packed.shape[1]) if len(vocab) else 1,
+        }
+    ).encode()
+    return struct.pack("<I", len(header)) + header + payload
+
+
+def parse_match_query(query: str) -> list[tuple[list[str], list[str], list[str]]]:
+    """`matches()` query -> disjuncts of (AND terms, AND phrases, NOT terms).
+
+    Grammar subset of the reference's matches() language: whitespace terms
+    are ANDed, `OR` splits alternatives, `"quoted phrases"` must appear
+    verbatim (case-insensitive), `-term` negates."""
+    disjuncts: list[tuple[list[str], list[str], list[str]]] = []
+    for part in _re.split(r"\s+OR\s+", query.strip()):
+        terms: list[str] = []
+        phrases: list[str] = []
+        negs: list[str] = []
+        for m in _re.finditer(r'"([^"]*)"|(\S+)', part):
+            if m.group(1) is not None:
+                phrases.append(m.group(1))
+            else:
+                tok = m.group(2)
+                if tok.startswith("-") and len(tok) > 1:
+                    negs.extend(tokenize(tok[1:]))
+                else:
+                    terms.extend(tokenize(tok))
+        disjuncts.append((terms, phrases, negs))
+    return disjuncts
+
+
+class FulltextIndex:
+    """Parsed token -> segment-bitmap table."""
+
+    def __init__(self, blob: bytes):
+        header, payload = _split_blob(blob)
+        self.segment_rows = header["segment_rows"]
+        self.tokens: list[str] = header["tokens"]
+        self.n_segs = header["n_segs"]
+        if self.tokens:
+            packed = np.frombuffer(zlib.decompress(payload), dtype=np.uint8).reshape(
+                -1, header["row_bytes"]
+            )
+            self.bm = np.unpackbits(packed, axis=1)[:, : self.n_segs].astype(bool)
+        else:
+            self.bm = np.zeros((0, self.n_segs), dtype=bool)
+        self._tok_idx = {t: i for i, t in enumerate(self.tokens)}
+
+    def _token_segs(self, token: str) -> np.ndarray:
+        i = self._tok_idx.get(token.lower())
+        if i is None:
+            return np.zeros(self.n_segs, dtype=bool)
+        return self.bm[i]
+
+    def search(self, op: str, value) -> np.ndarray | None:
+        """Conservative segment candidacy for match predicates: a segment
+        survives when it MIGHT match (phrases fall back to their tokens;
+        negations cannot prune)."""
+        if op == "match_term":
+            # the term may tokenize into several vocab tokens ('foo-bar' ->
+            # foo, bar): AND their bitmaps (conservative); an un-tokenizable
+            # term cannot prune at all
+            toks = tokenize(str(value))
+            if not toks:
+                return None
+            out = np.ones(self.n_segs, dtype=bool)
+            for t in toks:
+                out &= self._token_segs(t)
+            return out
+        if op != "match":
+            return None
+        out = np.zeros(self.n_segs, dtype=bool)
+        for terms, phrases, _negs in parse_match_query(str(value)):
+            cand = np.ones(self.n_segs, dtype=bool)
+            for t in terms:
+                cand &= self._token_segs(t)
+            for p in phrases:
+                for t in tokenize(p):
+                    cand &= self._token_segs(t)
+            out |= cand
+        return out
+
+
+# word-boundary regex: equals the tokenizer's word split for [a-z0-9_] terms
+def _term_regex(term: str) -> str:
+    return r"(?i)(?:^|[^A-Za-z0-9_])" + _re.escape(term) + r"(?:[^A-Za-z0-9_]|$)"
+
+
+def matches_term_mask(col, term) -> pa.Array:
+    """Exact per-row matches_term predicate (reference matches_term UDF)."""
+    return pc.match_substring_regex(col, _term_regex(str(term)))
+
+
+def matches_mask(col, query) -> pa.Array:
+    """Exact per-row matches() predicate over the parsed query language."""
+    result = None
+    for terms, phrases, negs in parse_match_query(str(query)):
+        cand = None
+        for t in terms:
+            m = matches_term_mask(col, t)
+            cand = m if cand is None else pc.and_kleene(cand, m)
+        for p in phrases:
+            m = pc.match_substring(col, p, ignore_case=True)
+            cand = m if cand is None else pc.and_kleene(cand, m)
+        for t in negs:
+            m = pc.invert(matches_term_mask(col, t))
+            cand = m if cand is None else pc.and_kleene(cand, m)
+        if cand is None:
+            continue
+        result = cand if result is None else pc.or_kleene(result, cand)
+    if result is None:
+        import numpy as _np
+
+        return pa.array(_np.ones(len(col), dtype=bool))
+    return result
 
 
 # ---- search -----------------------------------------------------------------

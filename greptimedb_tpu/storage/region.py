@@ -85,6 +85,10 @@ class Region:
         self.time_partition_ms = time_partition_ms
         self._lock = threading.RLock()
         self.writable = writable  # follower replicas are read-only
+        # Serializes compaction drivers (background scheduler vs ADMIN
+        # compact_table): two concurrent rounds would pick the same L0
+        # group and commit the merged rows twice.
+        self.compaction_lock = threading.Lock()
         # Append-only mode (reference mito2 `append_mode` table option):
         # duplicates are kept (no last-write-wins dedup) and DELETE is
         # rejected — the shape log/trace workloads want, and the condition
@@ -297,7 +301,13 @@ class Region:
                 key_cols.add(self.schema.time_index.name)
             key_filters = [f for f in pred.filters if f[0] in key_cols]
             post_filters = [f for f in pred.filters if f[0] not in key_cols]
-            prune_pred = ScanPredicate(time_range=pred.time_range, filters=key_filters)
+            # append_mode has no dedup, so FIELD filters (incl. fulltext
+            # match) may prune files/segments too — dropping a non-matching
+            # row can never resurrect an older version when versions don't
+            # shadow each other (the logs fast path: matches() + fulltext
+            # index pruning before any Parquet decode)
+            prune_filters = list(pred.filters) if self.append_mode else key_filters
+            prune_pred = ScanPredicate(time_range=pred.time_range, filters=prune_filters)
 
             # Projection pushdown: read only requested columns plus the
             # pk/ts/__op columns dedup needs; final select() trims extras.
@@ -356,6 +366,73 @@ class Region:
                 if want != out.column_names:
                     out = out.select(want)
             return out
+        finally:
+            with self._lock:
+                self._active_scans -= 1
+                self._purge_garbage_locked()
+
+    def scan_windows(
+        self,
+        pred: ScanPredicate | None = None,
+        columns: list[str] | None = None,
+        window_ms: int | None = None,
+        governor=None,
+    ):
+        """Bounded-memory streaming scan: yield one time window at a time.
+
+        The reference streams via PartitionRanges (mito2/src/read/range.rs +
+        seq_scan.rs); here the partition unit is the memtable time-partition
+        window.  Correctness: dedup keys include the time index, so a
+        (pk, ts) duplicate lives in exactly ONE window — per-window
+        sort+dedup equals the global pass.  Peak memory is one window's
+        rows, admitted against `governor.scan_guard` when provided."""
+        pred = pred or ScanPredicate()
+        w = window_ms or self.time_partition_ms
+        with self._lock:
+            files = list(self.manifest_mgr.manifest.files.values())
+            mems = list(self._frozen_memtables) + [self.memtable]
+            self._active_scans += 1
+        try:
+            # window set from file metas + memtable ranges, intersected with
+            # the predicate's time range
+            starts: set[int] = set()
+            lo_q, hi_q = pred.time_range if pred.time_range else (None, None)
+
+            def add_range(lo, hi):
+                lo = lo if lo_q is None else max(lo, lo_q)
+                hi = hi if hi_q is None else min(hi, hi_q - 1)
+                if hi < lo:
+                    return
+                s = (lo // w) * w
+                while s <= hi:
+                    starts.add(s)
+                    s += w
+            for fm in files:
+                add_range(*fm.time_range)
+            for mem in mems:
+                r = mem.time_range()
+                if r is not None:
+                    add_range(*r)
+            if self.schema.time_index is None:
+                # no time index: single-shot fallback
+                yield self.scan(pred, columns)
+                return
+            for s in sorted(starts):
+                win_pred = ScanPredicate(
+                    time_range=(
+                        max(s, lo_q) if lo_q is not None else s,
+                        min(s + w, hi_q) if hi_q is not None else s + w,
+                    ),
+                    filters=pred.filters,
+                )
+                chunk = self.scan(win_pred, columns)
+                if chunk.num_rows == 0:
+                    continue
+                if governor is not None:
+                    with governor.scan_guard(chunk.nbytes):
+                        yield chunk
+                else:
+                    yield chunk
         finally:
             with self._lock:
                 self._active_scans -= 1

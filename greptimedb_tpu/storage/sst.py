@@ -22,12 +22,16 @@ import pyarrow.parquet as pq
 from ..datatypes.schema import Schema
 from ..utils import metrics
 from . import index as idx
-from .index import BLOOM_BLOB, INVERTED_BLOB
+from .index import BLOOM_BLOB, FULLTEXT_BLOB, INVERTED_BLOB
 from .object_store import FsObjectStore, ObjectStore
 from .puffin import PuffinReader, PuffinWriter
 
 DEFAULT_ROW_GROUP_SIZE = 1 << 20  # rows per row group; big groups = big tiles
 
+INDEX_FULLTEXT_PRUNES = metrics.Counter(
+    "greptime_index_fulltext_applied_total",
+    "match predicates answered by the fulltext index",
+)
 INDEX_PRUNED_GROUPS = metrics.Counter(
     "sst_index_pruned_row_groups", "row groups skipped via secondary indexes"
 )
@@ -105,10 +109,17 @@ class SstWriter:
         self.index_inverted_max_terms = index_inverted_max_terms
 
     def _build_indexes(self, table: pa.Table, file_id: str) -> tuple[list[str], int]:
-        """Build bloom + inverted indexes over tag columns into the puffin
-        sidecar (reference mito2/src/sst/index/indexer/ builds during flush)."""
+        """Build bloom + inverted indexes over tag columns, and tokenized
+        fulltext indexes over FULLTEXT-declared text columns, into the
+        puffin sidecar (reference mito2/src/sst/index/indexer/ builds
+        during flush; fulltext_index/ for the tantivy analogue)."""
         cols = [c.name for c in self.schema.tag_columns() if c.name in table.column_names]
-        if not cols:
+        ft_cols = [
+            c.name
+            for c in self.schema.columns
+            if getattr(c, "fulltext", False) and c.name in table.column_names
+        ]
+        if not cols and not ft_cols:
             return [], 0
         writer = PuffinWriter(self.store, f"{file_id}.puffin")
         indexed = []
@@ -123,6 +134,14 @@ class SstWriter:
             if inverted is not None:
                 writer.add_blob(INVERTED_BLOB, inverted, {"column": name})
             indexed.append(name)
+        for name in ft_cols:
+            col = table[name]
+            col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+            ft = idx.build_fulltext_index(col, self.index_segment_rows)
+            if ft is not None:
+                writer.add_blob(FULLTEXT_BLOB, ft, {"column": name})
+                if name not in indexed:
+                    indexed.append(name)
         return indexed, writer.finish()
 
     def write(self, table: pa.Table, level: int = 0) -> FileMeta | None:
@@ -244,7 +263,8 @@ class SstReader:
         usable = [
             (name, op, value)
             for name, op, value in pred.filters
-            if name in meta.indexed_columns and op in ("=", "in", "!=")
+            if name in meta.indexed_columns
+            and op in ("=", "in", "!=", "match", "match_term")
         ]
         if not usable:
             return groups
@@ -257,10 +277,16 @@ class SstReader:
             if not index_map:
                 continue
             bm = None
-            if INVERTED_BLOB in index_map:
-                bm = index_map[INVERTED_BLOB].search(op, value)
-            if bm is None and BLOOM_BLOB in index_map:
-                bm = index_map[BLOOM_BLOB].search(op, value)
+            if op in ("match", "match_term"):
+                if FULLTEXT_BLOB in index_map:
+                    bm = index_map[FULLTEXT_BLOB].search(op, value)
+                    if bm is not None:
+                        INDEX_FULLTEXT_PRUNES.inc()
+            else:
+                if INVERTED_BLOB in index_map:
+                    bm = index_map[INVERTED_BLOB].search(op, value)
+                if bm is None and BLOOM_BLOB in index_map:
+                    bm = index_map[BLOOM_BLOB].search(op, value)
             if bm is not None:
                 seg_bitmap = bm if seg_bitmap is None else (seg_bitmap & bm)
         if seg_bitmap is None:
@@ -296,6 +322,8 @@ class SstReader:
                 parsed = idx.BloomIndex(blob)
             elif bm.blob_type == INVERTED_BLOB:
                 parsed = idx.InvertedIndex(blob)
+            elif bm.blob_type == FULLTEXT_BLOB:
+                parsed = idx.FulltextIndex(blob)
             else:
                 continue
             out.setdefault(col, {})[bm.blob_type] = parsed
@@ -361,6 +389,10 @@ def _apply_residual(table: pa.Table, pred: ScanPredicate, ts_name) -> pa.Table:
 
 
 def _cmp(col, op: str, value):
+    if op == "match":
+        return idx.matches_mask(col, value)
+    if op == "match_term":
+        return idx.matches_term_mask(col, value)
     if isinstance(value, str):
         from ..datatypes.coercion import coerce_string_scalar
 

@@ -53,6 +53,10 @@ class StorageConfig:
     compaction_max_active_window_runs: int = 4
     compaction_max_inactive_window_runs: int = 1
     compaction_time_window_secs: int = 0  # 0 = infer from data
+    # Background compaction scheduler (reference mito2 CompactionScheduler):
+    # flushes nudge it, a periodic tick catches the rest.
+    compaction_background_enable: bool = True
+    compaction_tick_secs: float = 5.0
     # SST secondary indexes (reference mito2 `[region_engine.mito.index]`):
     index_enable: bool = True
     index_segment_rows: int = 1024  # bloom/inverted segment granularity
@@ -130,6 +134,9 @@ class MemoryConfig:
 
     max_in_flight_write_bytes: int = 0
     max_concurrent_queries: int = 0
+    # Bounded-memory scans: windowed scan slices are admitted against this
+    # budget (0 = unlimited), so one huge SELECT cannot OOM the process.
+    max_scan_bytes: int = 0
 
 
 @dataclasses.dataclass

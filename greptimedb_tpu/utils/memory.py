@@ -21,15 +21,59 @@ WRITE_REJECTED = metrics.Counter(
 QUERY_REJECTED = metrics.Counter(
     "memory_queries_rejected", "queries rejected by the concurrency gate"
 )
+SCAN_REJECTED = metrics.Counter(
+    "memory_scans_rejected", "scan slices rejected by the scan-memory budget"
+)
+
+
+class ScanTracker:
+    """Held scan-byte reservations for one query; release on close."""
+
+    def __init__(self, gov: "MemoryGovernor"):
+        self._gov = gov
+        self._held = 0
+
+    def add(self, nbytes: int):
+        gov = self._gov
+        if gov.max_scan_bytes <= 0:
+            return
+        with gov._lock:
+            if gov._scan_bytes + nbytes > gov.max_scan_bytes:
+                SCAN_REJECTED.inc()
+                raise RetryLaterError(
+                    f"scan memory budget exceeded ({gov._scan_bytes} + {nbytes}"
+                    f" > {gov.max_scan_bytes}); narrow the query or retry later"
+                )
+            gov._scan_bytes += nbytes
+            self._held += nbytes
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def close(self):
+        if self._held:
+            with self._gov._lock:
+                self._gov._scan_bytes -= self._held
+            self._held = 0
 
 
 class MemoryGovernor:
-    def __init__(self, max_in_flight_write_bytes: int = 0, max_concurrent_queries: int = 0):
+    def __init__(
+        self,
+        max_in_flight_write_bytes: int = 0,
+        max_concurrent_queries: int = 0,
+        max_scan_bytes: int = 0,
+    ):
         self.max_write_bytes = max_in_flight_write_bytes
         self.max_queries = max_concurrent_queries
+        self.max_scan_bytes = max_scan_bytes
         self._lock = threading.Lock()
         self._in_flight_bytes = 0
         self._running_queries = 0
+        self._scan_bytes = 0
 
     # ---- write admission ---------------------------------------------------
     @contextmanager
@@ -72,6 +116,35 @@ class MemoryGovernor:
         finally:
             with self._lock:
                 self._running_queries -= 1
+
+    # ---- scan admission ----------------------------------------------------
+    @contextmanager
+    def scan_guard(self, nbytes: int):
+        """Account one scan slice against the scan-memory budget; raise
+        RETRY_LATER when the budget would be exceeded (the reference's scan
+        memory tiers; a huge SELECT degrades to retryable instead of OOM)."""
+        if getattr(self, "max_scan_bytes", 0) <= 0:
+            yield
+            return
+        with self._lock:
+            if self._scan_bytes + nbytes > self.max_scan_bytes:
+                SCAN_REJECTED.inc()
+                raise RetryLaterError(
+                    f"scan memory budget exceeded ({self._scan_bytes} + {nbytes}"
+                    f" > {self.max_scan_bytes}); retry later or narrow the query"
+                )
+            self._scan_bytes += nbytes
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._scan_bytes -= nbytes
+
+    def scan_tracker(self) -> "ScanTracker":
+        """Cumulative scan-memory accounting for one query: `add` bytes as
+        scan slices materialize; the query fails cleanly (RETRY_LATER) when
+        it would exceed the budget instead of OOMing the process."""
+        return ScanTracker(self)
 
     # ---- introspection -----------------------------------------------------
     def stats(self) -> dict:

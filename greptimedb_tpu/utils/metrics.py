@@ -162,3 +162,5 @@ TILE_CACHE_EVICTIONS = REGISTRY.counter("greptime_tile_cache_evictions_total", "
 TILE_QUERY_ELAPSED = REGISTRY.histogram("greptime_query_tile_elapsed", "Tile-path query seconds")
 TILE_LOWERED_TOTAL = REGISTRY.counter("greptime_query_tile_lowered_total", "Queries served from the HBM tile cache")
 DIST_STATE_QUERIES = REGISTRY.counter("greptime_query_dist_state_total", "Distributed queries merged from shipped states")
+COMPACTION_BACKGROUND = REGISTRY.counter("greptime_mito_compaction_background_total", "Background compaction merges")
+COMPACTION_FAILED = REGISTRY.counter("greptime_mito_compaction_failed_total", "Compaction rounds that errored")

@@ -1,0 +1,160 @@
+"""Fulltext index + matches()/matches_term (reference
+index/src/fulltext_index/, mito2/src/sst/index/fulltext_index/, and the
+matches()/matches_term UDFs in common/function)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.storage.index import (
+    FulltextIndex,
+    build_fulltext_index,
+    matches_mask,
+    matches_term_mask,
+    parse_match_query,
+    tokenize,
+)
+from greptimedb_tpu.storage.sst import INDEX_FULLTEXT_PRUNES
+
+LOGS = [
+    "ERROR disk full on /var/data",
+    "INFO request served in 12ms",
+    "WARN disk latency high",
+    "ERROR connection refused by upstream",
+    "INFO user login ok",
+    None,
+    "error while reading Disk sector",
+]
+
+
+def test_tokenize_and_parse():
+    assert tokenize("ERROR: disk_full on /var!") == ["error", "disk_full", "on", "var"]
+    d = parse_match_query('disk full OR "connection refused" -latency')
+    assert d[0] == (["disk", "full"], [], [])
+    assert d[1] == ([], ["connection refused"], ["latency"])
+
+
+def test_index_roundtrip_and_search():
+    col = pa.array(LOGS)
+    blob = build_fulltext_index(col, segment_rows=2)
+    ft = FulltextIndex(blob)
+    segs = ft.search("match_term", "disk")
+    # rows 0,2 (segs 0,1) and row 6 (seg 3) contain the token
+    assert segs.tolist() == [True, True, False, True]
+    both = ft.search("match", "disk error")
+    # conservative segment-level AND: seg0 (row 0 has both), seg1 (disk in
+    # row 2 + error in row 3 -> candidate, exact filter rejects later),
+    # seg3 (row 6 has both)
+    assert both.tolist() == [True, True, False, True]
+
+
+def test_row_masks_match_bruteforce():
+    col = pa.array(LOGS)
+    got = [bool(v) for v in matches_term_mask(col, "disk").fill_null(False).to_pylist()]
+    want = [v is not None and "disk" in tokenize(v) for v in LOGS]
+    assert got == want
+    got2 = [bool(v) for v in matches_mask(col, "disk error").fill_null(False).to_pylist()]
+    want2 = [
+        v is not None and {"disk", "error"} <= set(tokenize(v)) for v in LOGS
+    ]
+    assert got2 == want2
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(data_home=str(tmp_path))
+    yield d
+    d.close()
+
+
+def _mk_logs(db):
+    db.sql(
+        "CREATE TABLE logs (host STRING, ts TIMESTAMP(3) TIME INDEX,"
+        " msg STRING FULLTEXT INDEX, PRIMARY KEY (host))"
+        " WITH (append_mode = 'true')"
+    )
+    rows = []
+    for i, m in enumerate(LOGS):
+        lit = "NULL" if m is None else "'" + m + "'"
+        rows.append(f"('h{i % 2}', {1000 * (i + 1)}, {lit})")
+    db.sql("INSERT INTO logs VALUES " + ",".join(rows))
+    db.sql("ADMIN flush_table('logs')")
+
+
+def test_sql_matches_over_flushed_table(db):
+    _mk_logs(db)
+    t = db.sql_one("SELECT msg FROM logs WHERE matches_term(msg, 'disk') ORDER BY msg")
+    got = t["msg"].to_pylist()
+    want = sorted(v for v in LOGS if v is not None and "disk" in tokenize(v))
+    assert got == want
+
+    t2 = db.sql_one(
+        "SELECT count(*) AS c FROM logs WHERE matches(msg, 'error OR warn')"
+    )
+    want2 = sum(
+        1 for v in LOGS if v is not None and ({"error"} <= set(tokenize(v)) or {"warn"} <= set(tokenize(v)))
+    )
+    assert t2["c"][0].as_py() == want2
+
+
+def test_sql_matches_uses_index_pruning(db):
+    _mk_logs(db)
+    before = INDEX_FULLTEXT_PRUNES.get()
+    db.sql_one("SELECT msg FROM logs WHERE matches_term(msg, 'upstream')")
+    assert INDEX_FULLTEXT_PRUNES.get() > before, "fulltext index was not consulted"
+
+
+def test_matches_negation_and_phrase(db):
+    _mk_logs(db)
+    t = db.sql_one(
+        "SELECT msg FROM logs WHERE matches(msg, 'disk -latency')"
+    )
+    got = set(t["msg"].to_pylist())
+    assert got == {
+        "ERROR disk full on /var/data",
+        "error while reading Disk sector",
+    }
+    t2 = db.sql_one(
+        "SELECT msg FROM logs WHERE matches(msg, '\"connection refused\"')"
+    )
+    assert t2["msg"].to_pylist() == ["ERROR connection refused by upstream"]
+
+
+def test_fulltext_flag_survives_restart(db, tmp_path):
+    _mk_logs(db)
+    db.close()
+    db2 = Database(data_home=str(tmp_path))
+    try:
+        meta = db2.catalog.table("logs")
+        msg = meta.schema.column("msg")
+        assert msg.fulltext
+        t = db2.sql_one("SELECT count(*) AS c FROM logs WHERE matches_term(msg, 'disk')")
+        assert t["c"][0].as_py() == 3
+    finally:
+        db2.close()
+
+
+def test_log_query_matches_filter(db):
+    _mk_logs(db)
+    from greptimedb_tpu.query.log_query import LogQuery, execute_log_query
+
+    q = LogQuery.from_json(
+        {
+            "table": {"table_name": "logs", "schema_name": "public"},
+            "time_filter": {
+                "start": "1970-01-01T00:00:00+00:00",
+                "end": "1970-01-01T01:00:00+00:00",
+            },
+            "filters": {
+                "Single": {
+                    "expr": {"NamedIdent": "msg"},
+                    "filters": [{"Matches": "disk"}],
+                }
+            },
+            "limit": {"fetch": 100},
+            "columns": ["msg"],
+        }
+    )
+    out = execute_log_query(db, q)
+    assert len(out["msg"].to_pylist()) == 3
