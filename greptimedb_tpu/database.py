@@ -29,6 +29,7 @@ from .query.sql_parser import (
     CopyStmt,
     CreateDatabaseStmt,
     CreateFlowStmt,
+    CreateViewStmt,
     CreateTableStmt,
     DeclareCursorStmt,
     DeleteStmt,
@@ -58,6 +59,7 @@ from .storage.engine import TimeSeriesEngine
 from .storage.sst import ScanPredicate
 from .utils.config import Config
 from .utils.errors import (
+    DatabaseNotFoundError,
     InvalidArgumentsError,
     PlanError,
     TableNotFoundError,
@@ -123,6 +125,7 @@ class Database:
             time_bounds_provider=self._time_bounds,
             config=self.config.query,
             tile_context_provider=self._tile_context,
+            view_provider=self._view_stmt,
         )
         self._reopen_regions()
 
@@ -172,6 +175,8 @@ class Database:
         if isinstance(stmt, CreateFlowStmt):
             self.flows.create_flow(stmt, self.current_database)
             return None
+        if isinstance(stmt, CreateViewStmt):
+            return self._create_view(stmt)
         if isinstance(stmt, DropStmt):
             return self._drop(stmt)
         if isinstance(stmt, InsertStmt):
@@ -182,13 +187,21 @@ class Database:
             return self._describe(stmt)
         if isinstance(stmt, ExplainStmt):
             if isinstance(stmt.inner, SelectStmt):
+                if stmt.analyze:
+                    return self.query_engine.explain_analyze(
+                        stmt.inner, self.current_database
+                    )
                 return self.query_engine.explain(stmt.inner, self.current_database)
             raise UnsupportedError("EXPLAIN only supports SELECT")
         if isinstance(stmt, UseStmt):
             from .models import information_schema as info
 
-            if stmt.database not in self.catalog.databases() and not info.is_information_schema(
-                stmt.database
+            from .models import pg_catalog as pg
+
+            if (
+                stmt.database not in self.catalog.databases()
+                and not info.is_information_schema(stmt.database)
+                and not pg.is_pg_catalog(stmt.database)
             ):
                 raise InvalidArgumentsError(f"database not found: {stmt.database}")
             self.current_database = stmt.database
@@ -337,7 +350,7 @@ class Database:
             stmt.name,
             schema,
             partition_rule=rule,
-            database=self.current_database,
+            database=getattr(stmt, "database", None) or self.current_database,
             if_not_exists=stmt.if_not_exists,
             options=stmt.options,
             on_create=lambda m: [
@@ -588,6 +601,11 @@ class Database:
         if stmt.kind == "flow":
             self.flows.drop_flow(stmt.name, if_exists=stmt.if_exists)
             return None
+        if stmt.kind == "view":
+            self.catalog.drop_view(
+                stmt.name, self.current_database, if_exists=stmt.if_exists
+            )
+            return None
         if stmt.kind == "database":
             for meta in self.catalog.tables(stmt.name):
                 for rid in meta.region_ids:
@@ -621,7 +639,9 @@ class Database:
 
     # ---- DML --------------------------------------------------------------
     def _insert(self, stmt: InsertStmt) -> int:
-        meta = self.catalog.table(stmt.table, self.current_database)
+        meta = self.catalog.table(
+            stmt.table, getattr(stmt, "database", None) or self.current_database
+        )
         schema = meta.schema
         columns = stmt.columns or schema.column_names()
         if any(not schema.has_column(c) for c in columns):
@@ -721,6 +741,23 @@ class Database:
 
                 flows = [f for f in flows if fnmatch.fnmatch(f.name, stmt.like.replace("%", "*"))]
             return pa.table({"Flows": [f.name for f in flows]})
+        if stmt.what == "views":
+            names = sorted(self.catalog.views(self.current_database))
+            if stmt.like:
+                import fnmatch
+
+                names = [n for n in names if fnmatch.fnmatch(n, stmt.like.replace("%", "*"))]
+            return pa.table({"Views": names})
+        if stmt.what == "create_view":
+            sql_text = self.catalog.view(stmt.target, self.current_database)
+            if sql_text is None:
+                raise TableNotFoundError(f"view not found: {stmt.target}")
+            return pa.table(
+                {
+                    "View": [stmt.target],
+                    "Create View": [f"CREATE VIEW {stmt.target} AS {sql_text}"],
+                }
+            )
         if stmt.what == "create_flow":
             info = self.flows.infos.get(stmt.target)
             if info is None:
@@ -808,9 +845,12 @@ class Database:
     # ---- providers for the query engine ------------------------------------
     def _schema_of(self, table: str, database: str) -> Schema:
         from .models import information_schema as info
+        from .models import pg_catalog as pg
 
         if info.is_information_schema(database):
             return info.schema_of(self, table)
+        if pg.is_pg_catalog(database):
+            return pg.schema_of(self, table)
         return self.catalog.table(table, database).schema
 
     def _pred_of(self, scan: TableScan) -> ScanPredicate:
@@ -832,6 +872,10 @@ class Database:
         self.process_manager.check_cancelled()  # KILL cancellation point
         if info.is_information_schema(scan.database):
             return [info.build(self, scan.table)]
+        from .models import pg_catalog as pg
+
+        if pg.is_pg_catalog(scan.database):
+            return [pg.build(self, scan.table)]
         meta = self.catalog.table(scan.table, scan.database)
         if is_logical_meta(meta):
             return self.metric.scan_logical(meta, scan)
@@ -889,6 +933,35 @@ class Database:
             append_mode=any(r.append_mode for r in regions),
         )
 
+    def _view_stmt(self, name: str, database: str):
+        """view_provider for the planner: view name -> freshly parsed
+        defining SELECT (fresh parse per query so planning never mutates a
+        shared statement)."""
+        try:
+            sql_text = self.catalog.view(name, database)
+        except DatabaseNotFoundError:
+            return None
+        if sql_text is None:
+            return None
+        stmts = parse_sql(sql_text)
+        return stmts[0] if stmts and isinstance(stmts[0], SelectStmt) else None
+
+    def _create_view(self, stmt: CreateViewStmt):
+        """CREATE [OR REPLACE] VIEW: validate the definition plans against
+        the current catalog, then persist its SQL text (reference
+        create_view.rs validates the logical plan before committing)."""
+        from .query.planner import plan_query
+
+        plan_query(stmt.stmt, self._schema_of, self.current_database, self._view_stmt)
+        self.catalog.create_view(
+            stmt.name,
+            stmt.sql_text,
+            database=self.current_database,
+            or_replace=stmt.or_replace,
+            if_not_exists=stmt.if_not_exists,
+        )
+        return None
+
     def _scan(self, scan: TableScan) -> pa.Table:
         from .models import information_schema as info
 
@@ -899,6 +972,12 @@ class Database:
 
             t = info.build(self, scan.table)
             return _apply_residual(t, self._pred_of(scan), None)
+        from .models import pg_catalog as pg
+
+        if pg.is_pg_catalog(scan.database):
+            from .storage.sst import _apply_residual
+
+            return _apply_residual(pg.build(self, scan.table), self._pred_of(scan), None)
         tables = [t for t in self._region_scan(scan) if t.num_rows]
         meta = self.catalog.table(scan.table, scan.database)
         if not tables:

@@ -87,6 +87,7 @@ class Catalog:
         self.path = path
         self._lock = threading.RLock()
         self._databases: dict[str, dict[str, TableMeta]] = {DEFAULT_SCHEMA: {}}
+        self._views: dict[str, dict[str, str]] = {}  # db -> name -> SQL text
         self._next_table_id = 1024  # reference reserves low ids for system tables
         if path and os.path.exists(path):
             self._load()
@@ -108,6 +109,7 @@ class Catalog:
             if name == DEFAULT_SCHEMA:
                 raise DatabaseNotFoundError("cannot drop the default database")
             del self._databases[name]
+            self._views.pop(name, None)
             self._persist()
 
     def databases(self) -> list[str]:
@@ -197,6 +199,48 @@ class Catalog:
             self._db(meta.database)[meta.name] = meta
             self._persist()
 
+    # ---- views -------------------------------------------------------------
+    # Views are stored as their defining SQL text and re-planned at query
+    # time (the reference stores view_info in KV and decodes the logical
+    # plan, common/meta/src/ddl/create_view.rs + key/view_info.rs).
+    def create_view(
+        self,
+        name: str,
+        sql_text: str,
+        database: str = DEFAULT_SCHEMA,
+        or_replace: bool = False,
+        if_not_exists: bool = False,
+    ):
+        with self._lock:
+            self._db(database)  # validates the database exists
+            views = self._views.setdefault(database, {})
+            if name in views and not or_replace:
+                if if_not_exists:
+                    return
+                raise TableAlreadyExistsError(f"view {name!r} already exists")
+            if self.has_table(name, database):
+                raise TableAlreadyExistsError(f"table {name!r} already exists")
+            views[name] = sql_text
+            self._persist()
+
+    def drop_view(self, name: str, database: str = DEFAULT_SCHEMA, if_exists: bool = False):
+        with self._lock:
+            views = self._views.get(database, {})
+            if name not in views:
+                if if_exists:
+                    return
+                raise TableNotFoundError(f"view not found: {database}.{name}")
+            del views[name]
+            self._persist()
+
+    def view(self, name: str, database: str = DEFAULT_SCHEMA) -> str | None:
+        with self._lock:
+            return self._views.get(database, {}).get(name)
+
+    def views(self, database: str = DEFAULT_SCHEMA) -> dict[str, str]:
+        with self._lock:
+            return dict(self._views.get(database, {}))
+
     # ---- persistence ------------------------------------------------------
     def _db(self, database: str) -> dict[str, TableMeta]:
         if database not in self._databases:
@@ -212,6 +256,7 @@ class Catalog:
                 db: {name: meta.to_dict() for name, meta in tables.items()}
                 for db, tables in self._databases.items()
             },
+            "views": self._views,
         }
         tmp = self.path + ".tmp"
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
@@ -229,5 +274,6 @@ class Catalog:
             db: {name: TableMeta.from_dict(d) for name, d in tables.items()}
             for db, tables in state["databases"].items()
         }
+        self._views = state.get("views", {})
         if DEFAULT_SCHEMA not in self._databases:
             self._databases[DEFAULT_SCHEMA] = {}

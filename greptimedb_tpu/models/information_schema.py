@@ -160,6 +160,21 @@ def _flows(db) -> pa.Table:
     )
 
 
+def _views(db) -> pa.Table:
+    """information_schema.views (reference
+    catalog/src/system_schema/information_schema/views.rs)."""
+    rows = {"table_catalog": [], "table_schema": [], "table_name": [], "view_definition": []}
+    for database in db.catalog.databases():
+        for name, sql_text in sorted(db.catalog.views(database).items()):
+            rows["table_catalog"].append("greptime")
+            rows["table_schema"].append(database)
+            rows["table_name"].append(name)
+            rows["view_definition"].append(sql_text)
+    return pa.table(
+        {k: pa.array(v, pa.string()) for k, v in rows.items()}
+    )
+
+
 def _process_list(db) -> pa.Table:
     """information_schema.process_list (reference
     catalog/src/system_schema/information_schema/process_list.rs)."""
@@ -191,6 +206,7 @@ _TABLES = {
     "schemata": _schemata,
     "partitions": _partitions,
     "flows": _flows,
+    "views": _views,
 }
 
 

@@ -1,0 +1,136 @@
+"""pg_catalog: PostgreSQL system-catalog compatibility tables.
+
+Role-equivalent of the reference's pg_catalog virtual schema (reference
+catalog/src/system_schema/pg_catalog.rs + pg_catalog/): enough of
+pg_class / pg_namespace / pg_type / pg_database for BI tools and drivers
+that probe the PG catalog over the PostgreSQL wire protocol.
+
+Synthesized from the live catalog on every scan, like information_schema.
+"""
+
+from __future__ import annotations
+
+import pyarrow as pa
+
+from ..datatypes.data_type import ConcreteDataType
+from ..datatypes.schema import ColumnSchema, Schema, SemanticType
+
+PG_CATALOG = "pg_catalog"
+
+# Stable synthetic OID spaces (the reference derives oids by hashing names;
+# here: namespace oids are enumeration-ordered, table oids reuse table_id).
+_NS_BASE = 2200
+_TYPE_OIDS = {
+    "bool": (16, 1),
+    "int8": (20, 8),
+    "int4": (23, 4),
+    "float4": (700, 4),
+    "float8": (701, 8),
+    "text": (25, -1),
+    "varchar": (1043, -1),
+    "timestamp": (1114, 8),
+    "timestamptz": (1184, 8),
+    "date": (1082, 4),
+    "numeric": (1700, -1),
+    "bytea": (17, -1),
+    "json": (114, -1),
+}
+
+
+def is_pg_catalog(database: str) -> bool:
+    return database.lower() == PG_CATALOG
+
+
+def build(db, table: str) -> pa.Table:
+    fn = _TABLES.get(table.lower())
+    if fn is None:
+        from ..utils.errors import TableNotFoundError
+
+        raise TableNotFoundError(f"pg_catalog has no table {table!r}")
+    return fn(db)
+
+
+def schema_of(db, table: str) -> Schema:
+    t = build(db, table)
+    return Schema(
+        columns=[
+            ColumnSchema(f.name, ConcreteDataType.from_arrow(f.type), SemanticType.FIELD)
+            for f in t.schema
+        ]
+    )
+
+
+def _ns_oids(db) -> dict[str, int]:
+    return {name: _NS_BASE + i for i, name in enumerate(sorted(db.catalog.databases()))}
+
+
+def _pg_namespace(db) -> pa.Table:
+    ns = _ns_oids(db)
+    names = sorted(ns)
+    return pa.table(
+        {
+            "oid": pa.array([ns[n] for n in names], pa.int64()),
+            "nspname": names,
+        }
+    )
+
+
+def _pg_class(db) -> pa.Table:
+    ns = _ns_oids(db)
+    rows = {"oid": [], "relname": [], "relnamespace": [], "relkind": [], "relowner": []}
+    for database in db.catalog.databases():
+        for meta in db.catalog.tables(database):
+            rows["oid"].append(meta.table_id)
+            rows["relname"].append(meta.name)
+            rows["relnamespace"].append(ns[database])
+            rows["relkind"].append("r")
+            rows["relowner"].append(10)
+        for i, vname in enumerate(sorted(db.catalog.views(database))):
+            rows["oid"].append(1_000_000 + ns[database] * 1000 + i)
+            rows["relname"].append(vname)
+            rows["relnamespace"].append(ns[database])
+            rows["relkind"].append("v")
+            rows["relowner"].append(10)
+    return pa.table(
+        {
+            "oid": pa.array(rows["oid"], pa.int64()),
+            "relname": rows["relname"],
+            "relnamespace": pa.array(rows["relnamespace"], pa.int64()),
+            "relkind": rows["relkind"],
+            "relowner": pa.array(rows["relowner"], pa.int64()),
+        }
+    )
+
+
+def _pg_type(db) -> pa.Table:
+    names = sorted(_TYPE_OIDS)
+    return pa.table(
+        {
+            "oid": pa.array([_TYPE_OIDS[n][0] for n in names], pa.int64()),
+            "typname": names,
+            "typlen": pa.array([_TYPE_OIDS[n][1] for n in names], pa.int64()),
+        }
+    )
+
+
+def _pg_database(db) -> pa.Table:
+    names = sorted(db.catalog.databases())
+    ns = _ns_oids(db)
+    return pa.table(
+        {
+            "oid": pa.array([ns[n] for n in names], pa.int64()),
+            "datname": names,
+        }
+    )
+
+
+_TABLES = {
+    "pg_class": _pg_class,
+    "pg_namespace": _pg_namespace,
+    "pg_type": _pg_type,
+    "pg_database": _pg_database,
+}
+
+
+def table_names() -> list[str]:
+    return sorted(_TABLES)

@@ -24,35 +24,73 @@ from .expr import (
     InList,
     IsNull,
     Literal,
+    PlannedSubquery,
     Star,
     UnaryOp,
+    WindowCall,
     find_agg_calls,
     map_aggs,
+    map_expr,
+    split_conjuncts,
     strip_alias,
 )
 from .logical_plan import (
     Aggregate,
+    Distinct,
     Filter,
     Having,
+    Join,
     Limit,
     LogicalPlan,
     Project,
     RangeSelect,
     Sort,
+    SubqueryAlias,
     TableScan,
+    Union,
+    Window,
 )
 
 # ---- expression evaluation -------------------------------------------------
+
+
+def resolve_column(name: str, columns: list[str]) -> str | None:
+    """Resolve a (possibly alias-qualified) column reference against a
+    table's columns.  Join outputs qualify colliding columns as
+    "side.column"; unqualified refs resolve when unambiguous."""
+    if name in columns:
+        return name
+    if "." in name:
+        base = name.rsplit(".", 1)[1]
+        if base in columns:
+            return base
+        cands = [c for c in columns if c.endswith("." + base)]
+        if len(cands) == 1:
+            return cands[0]
+        return None
+    cands = [c for c in columns if c.endswith("." + name)]
+    if len(cands) == 1:
+        return cands[0]
+    if len(cands) > 1:
+        raise PlanError(f"ambiguous column reference: {name} (matches {cands})")
+    return None
 
 
 def eval_expr(e: Expr, table: pa.Table):
     """Evaluate an expression to an Arrow array (or scalar for literals)."""
     if isinstance(e, Alias):
         return eval_expr(e.expr, table)
+    if isinstance(e, WindowCall):
+        # Window columns are materialized by the Window node under this name.
+        if e.name() in table.column_names:
+            col = table[e.name()]
+            return col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+        raise PlanError(f"window expression {e.name()} not materialized")
     if isinstance(e, Column):
-        if e.column not in table.column_names:
+        resolved = resolve_column(e.column, table.column_names)
+        if resolved is None:
             raise PlanError(f"unknown column: {e.column}")
-        col = table[e.column]
+        col = table[resolved]
         if pa.types.is_dictionary(col.type):
             col = pc.cast(col, col.type.value_type)
         return col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
@@ -75,7 +113,9 @@ def eval_expr(e: Expr, table: pa.Table):
         v = eval_expr(e.expr, table)
         lo = eval_expr(e.low, table)
         hi = eval_expr(e.high, table)
-        m = pc.and_kleene(pc.greater_equal(v, lo), pc.less_equal(v, hi))
+        v1, lo = _align_ts(v, lo)
+        v2, hi = _align_ts(v, hi)
+        m = pc.and_kleene(pc.greater_equal(v1, lo), pc.less_equal(v2, hi))
         return pc.invert(m) if e.negated else m
     if isinstance(e, IsNull):
         v = eval_expr(e.expr, table)
@@ -117,7 +157,9 @@ def _eval_binary(e: BinaryOp, table: pa.Table):
 
 
 def _mod(l, r):
-    ln = np.asarray(l)
+    if isinstance(l, pa.Scalar) and isinstance(r, pa.Scalar):
+        return pa.scalar(np.mod(l.as_py(), r.as_py()).item())
+    ln = l.as_py() if isinstance(l, pa.Scalar) else np.asarray(l)
     rn = r.as_py() if isinstance(r, pa.Scalar) else np.asarray(r)
     return pa.array(np.mod(ln, rn))
 
@@ -239,23 +281,47 @@ class CpuExecutor:
         self.scan = scan_provider
 
     def execute(self, plan: LogicalPlan) -> pa.Table:
+        from .analyze import active_collector, stage
+
+        if active_collector() is None:
+            return self._execute_node(plan)
+        with stage(type(plan).__name__) as info:
+            t = self._execute_node(plan)
+            info["rows"] = t.num_rows
+            return t
+
+    def _execute_node(self, plan: LogicalPlan) -> pa.Table:
         if isinstance(plan, TableScan):
             return self.scan(plan)
         if isinstance(plan, Filter):
             t = self.execute(plan.input)
-            mask = eval_expr(plan.predicate, t)
+            mask = eval_expr(self._materialize_subqueries(plan.predicate), t)
             if isinstance(mask, pa.Scalar):
                 return t if mask.as_py() else t.schema.empty_table()
             return t.filter(mask)
         if isinstance(plan, Project):
             t = self.execute(plan.input)
             return self._project(plan.exprs, t)
+        if isinstance(plan, Join):
+            return self._join(plan)
+        if isinstance(plan, SubqueryAlias):
+            return self.execute(plan.input)
+        if isinstance(plan, Window):
+            return self._window(plan)
+        if isinstance(plan, Distinct):
+            t = self.execute(plan.input)
+            if t.num_rows == 0 or t.num_columns == 0:
+                return t
+            return t.group_by(t.column_names, use_threads=False).aggregate([])
+        if isinstance(plan, Union):
+            return self._union(plan)
         if isinstance(plan, Aggregate):
             t = self.execute(plan.input)
             return self._aggregate(plan, t)
         if isinstance(plan, Having):
             t = self.execute(plan.input)
-            mask = eval_expr(_rewrite_agg_refs(plan.predicate, t), t)
+            pred = self._materialize_subqueries(plan.predicate)
+            mask = eval_expr(_rewrite_agg_refs(pred, t), t)
             return t.filter(mask)
         if isinstance(plan, RangeSelect):
             t = self.execute(plan.input)
@@ -279,7 +345,18 @@ class CpuExecutor:
                     cols.append(t[name])
                     names.append(name)
                 continue
-            name = e.alias if isinstance(e, Alias) else e.name()
+            if isinstance(e, Alias):
+                name = e.alias
+            elif isinstance(e, Column) and "." in e.column:
+                # Qualified reference: the output column is named by the
+                # base column, per standard SQL (SELECT c.host -> "host");
+                # on collision (c.host, h.host) the qualified name survives.
+                name = e.column.rsplit(".", 1)[1]
+                if name in names:
+                    name = e.column
+            else:
+                name = e.name()
+            e = self._materialize_subqueries(e)
             inner = strip_alias(e)
             # After aggregation the table already holds agg outputs by name.
             if inner.name() in t.column_names:
@@ -291,7 +368,7 @@ class CpuExecutor:
                 # already a column of the aggregated table — reference it
                 v = eval_expr(_rewrite_agg_refs(inner, t), t)
                 if isinstance(v, pa.Scalar):
-                    v = pa.array([v.as_py()] * max(t.num_rows, 1))
+                    v = pa.array([v.as_py()] * t.num_rows)
                 cols.append(v)
             names.append(name)
         return pa.table(dict(zip(names, cols))) if names else t
@@ -304,7 +381,7 @@ class CpuExecutor:
             name = ge.name()
             inner = strip_alias(ge)
             if isinstance(inner, Column):
-                name = inner.column
+                name = resolve_column(inner.column, work.column_names) or inner.column
             else:
                 arr = eval_expr(inner, work)
                 if isinstance(arr, pa.Scalar):
@@ -354,6 +431,8 @@ class CpuExecutor:
                     "last_value": "last", "first_value": "first",
                     "approx_percentile_cont": "approximate_median", "percentile": "approximate_median",
                 }.get(fn)
+                if fn == "count" and agg.distinct:
+                    pa_fn = "count_distinct"
                 if pa_fn is None:
                     raise PlanError(f"unsupported aggregate: {fn}")
                 if fn in ("last_value", "first_value") and agg.order_by:
@@ -412,7 +491,8 @@ class CpuExecutor:
             inner = strip_alias(e)
             name = inner.name() if not isinstance(inner, Column) else inner.column
             if name not in work.column_names:
-                arr = eval_expr(inner, work)
+                # sort keys over aggregate output may reference agg columns
+                arr = eval_expr(_rewrite_agg_refs(inner, work), work)
                 if isinstance(arr, pa.Scalar):
                     arr = pa.array([arr.as_py()] * work.num_rows)
                 work = work.append_column(name, arr)
@@ -420,9 +500,493 @@ class CpuExecutor:
         idx = pc.sort_indices(work, sort_keys=keys)
         return t.take(idx) if set(t.column_names) == set(work.column_names) else work.take(idx).select(t.column_names)
 
+    # ---- relational operators (joins / windows / set ops) ------------------
+    # The reference gets these from DataFusion's physical operators; here
+    # they run as Arrow-compute hash joins and numpy window evaluation —
+    # deliberately CPU-side (the TPU lowering targets the scan→filter→agg
+    # hot shape; joins/windows are dashboard-query garnish, not the
+    # billion-row path).
+
+    def _materialize_subqueries(self, e: Expr) -> Expr:
+        """Execute uncorrelated subqueries, folding their results into
+        literal expressions (scalar -> Literal, IN -> InList, EXISTS ->
+        Literal bool)."""
+        if not any(isinstance(x, PlannedSubquery) for x in e.walk()):
+            return e
+
+        def fn(x):
+            if not isinstance(x, PlannedSubquery):
+                return x
+            sub = self.execute(x.plan)
+            if x.kind == "scalar":
+                if sub.num_columns != 1:
+                    raise PlanError("scalar subquery must return one column")
+                if sub.num_rows > 1:
+                    raise ExecutionError("scalar subquery returned more than one row")
+                v = sub.column(0)[0].as_py() if sub.num_rows == 1 else None
+                return Literal(v)
+            if x.kind == "in":
+                if sub.num_columns != 1:
+                    raise PlanError("IN subquery must return one column")
+                raw = sub.column(0).to_pylist()
+                vals = tuple(v for v in raw if v is not None)
+                has_null = len(vals) != len(raw)
+                if x.negated and has_null:
+                    # SQL 3-valued logic: NOT IN over a set containing NULL
+                    # is never TRUE (matches the reference's DataFusion).
+                    return Literal(False)
+                if not vals:
+                    # empty set: IN -> FALSE, NOT IN -> TRUE
+                    return Literal(bool(x.negated))
+                return InList(x.operand, vals, x.negated)
+            # exists
+            return Literal((sub.num_rows > 0) != x.negated)
+
+        return map_expr(e, fn)
+
+    def _join(self, plan: Join) -> pa.Table:
+        lt = _decode_dicts(self.execute(plan.left))
+        rt = _decode_dicts(self.execute(plan.right))
+        lcols, rcols = lt.column_names, rt.column_names
+
+        if plan.how == "cross":
+            out = _cross_product(lt, rt, plan.left_name, plan.right_name)
+            return out
+
+        pairs: list[tuple[str, str]] = []
+        residual: list[Expr] = []
+        if plan.using:
+            for u in plan.using:
+                lu, ru = resolve_column(u, lcols), resolve_column(u, rcols)
+                if lu is None or ru is None:
+                    raise PlanError(f"USING column {u} missing from join input")
+                pairs.append((lu, ru))
+        elif plan.condition is not None:
+            for conj in split_conjuncts(plan.condition):
+                pair = _equi_pair(conj, lcols, rcols)
+                if pair is not None:
+                    pairs.append(pair)
+                else:
+                    residual.append(conj)
+        if not pairs:
+            raise PlanError(
+                f"{plan.how.upper()} JOIN requires at least one equi-join "
+                "condition (col = col across the two sides)"
+            )
+        if residual and plan.how != "inner":
+            raise PlanError(
+                "non-equi conditions in OUTER JOIN ON clauses are not supported"
+            )
+
+        lkeys = [l for l, _ in pairs]
+        rkeys = [r for _, r in pairs]
+        # Qualify colliding non-key output columns as "side.column" so
+        # qualified references keep working after the join.
+        lset, rset = set(lcols), set(rcols)
+        collisions = (lset & (rset - set(rkeys))) | (set(rkeys) & (lset - set(lkeys)))
+        lren, rren = {}, {}
+        for c in sorted(collisions):
+            if c in rset and c not in rkeys:
+                rren[c] = f"{plan.right_name}.{c}" if plan.right_name else f"right.{c}"
+            if c in lset and c not in lkeys:
+                lren[c] = f"{plan.left_name}.{c}" if plan.left_name else f"left.{c}"
+        if lren:
+            lt = lt.rename_columns([lren.get(c, c) for c in lcols])
+        if rren:
+            rt = rt.rename_columns([rren.get(c, c) for c in rcols])
+
+        # Arrow's hash join rejects null-typed payload columns (all-NULL
+        # virtual-table columns like information_schema column_default).
+        lt, rt = _cast_null_cols(lt), _cast_null_cols(rt)
+
+        # Arrow coalesces the join-key columns into one output column named
+        # by the left key, which breaks side-qualified references: in a
+        # LEFT JOIN, `b.k` must be NULL on unmatched rows, not the left
+        # value, and with ON a.x = b.y the right column y vanishes.  Keep
+        # per-side copies of the key columns under qualified names — they
+        # join the output as ordinary payload columns with correct outer-
+        # join NULL semantics.  (USING keeps only the coalesced column, per
+        # standard SQL.)
+        qual_keys = not plan.using
+        if qual_keys:
+            for lk, rk in zip(lkeys, rkeys):
+                if plan.left_name and f"{plan.left_name}.{lk}" not in lt.column_names:
+                    lt = lt.append_column(f"{plan.left_name}.{lk}", lt[lk])
+                if plan.right_name and f"{plan.right_name}.{rk}" not in rt.column_names:
+                    rt = rt.append_column(f"{plan.right_name}.{rk}", rt[rk])
+
+        # Join-key types must agree for the Arrow hash join.
+        for lk, rk in zip(lkeys, rkeys):
+            if lt[lk].type != rt[rk].type:
+                try:
+                    rt = rt.set_column(
+                        rt.column_names.index(rk), rk, pc.cast(rt[rk], lt[lk].type)
+                    )
+                except (pa.ArrowInvalid, pa.ArrowNotImplementedError) as exc:
+                    raise PlanError(
+                        f"join key type mismatch: {lk}:{lt[lk].type} vs {rk}:{rt[rk].type}"
+                    ) from exc
+
+        join_type = {
+            "inner": "inner",
+            "left": "left outer",
+            "right": "right outer",
+            "full": "full outer",
+        }[plan.how]
+        out = lt.join(
+            rt, keys=lkeys, right_keys=rkeys, join_type=join_type, use_threads=False
+        )
+        if qual_keys and plan.left_name and plan.right_name:
+            # Both sides have qualified key copies: drop the non-standard
+            # coalesced column — per SQL, an ON join exposes a.k and b.k
+            # separately (unqualified k is then ambiguous, as it should be).
+            out = out.drop_columns([lk for lk in dict.fromkeys(lkeys) if lk in out.column_names])
+        for conj in residual:
+            mask = eval_expr(self._materialize_subqueries(conj), out)
+            if isinstance(mask, pa.Scalar):
+                if not mask.as_py():
+                    out = out.schema.empty_table()
+            else:
+                out = out.filter(mask)
+        return out
+
+    def _window(self, plan: Window) -> pa.Table:
+        t = self.execute(plan.input)
+        for w in plan.window_exprs:
+            name = w.name()
+            if name in t.column_names:
+                continue
+            t = t.append_column(name, _eval_window_call(w, t))
+        return t
+
+    def _union(self, plan: Union) -> pa.Table:
+        lt = _decode_dicts(self.execute(plan.left))
+        rt = _decode_dicts(self.execute(plan.right))
+        if lt.num_columns != rt.num_columns:
+            raise PlanError(
+                f"UNION inputs have {lt.num_columns} vs {rt.num_columns} columns"
+            )
+        rt = rt.rename_columns(lt.column_names)
+        try:
+            out = pa.concat_tables([lt, rt], promote_options="permissive")
+        except (pa.ArrowInvalid, pa.ArrowTypeError):
+            casted = [pc.cast(rt[c], lt[c].type) for c in lt.column_names]
+            out = pa.concat_tables(
+                [lt, pa.table(dict(zip(lt.column_names, casted)))]
+            )
+        if not plan.all and out.num_rows and out.num_columns:
+            out = out.group_by(out.column_names, use_threads=False).aggregate([])
+        return out
+
 
 def _sorted_by(t: pa.Table, col: str) -> pa.Table:
     return t.take(pc.sort_indices(t, sort_keys=[(col, "ascending")]))
+
+
+# ---- join / window helpers --------------------------------------------------
+
+
+def _decode_dicts(t: pa.Table) -> pa.Table:
+    """Decode dictionary-encoded columns (the Arrow hash join and concat
+    are picky about dictionary key spaces across tables)."""
+    for i, f in enumerate(t.schema):
+        if pa.types.is_dictionary(f.type):
+            t = t.set_column(i, f.name, pc.cast(t[f.name], f.type.value_type))
+    return t
+
+
+def _cast_null_cols(t: pa.Table) -> pa.Table:
+    for i, f in enumerate(t.schema):
+        if pa.types.is_null(f.type):
+            t = t.set_column(i, f.name, pc.cast(t[f.name], pa.string()))
+    return t
+
+
+def _equi_pair(conj: Expr, lcols: list[str], rcols: list[str]):
+    """`a.x = b.y` with sides resolving to opposite inputs -> (lname, rname)."""
+    if not (isinstance(conj, BinaryOp) and conj.op == "="):
+        return None
+    if not (isinstance(conj.left, Column) and isinstance(conj.right, Column)):
+        return None
+
+    def _try(name, cols):
+        try:
+            return resolve_column(name, cols)
+        except PlanError:
+            return None
+
+    a, b = conj.left.column, conj.right.column
+    al, ar = _try(a, lcols), _try(a, rcols)
+    bl, br = _try(b, lcols), _try(b, rcols)
+    # Prefer the unambiguous assignment; when a name resolves on both sides
+    # (e.g. `id = id`), fall back to left-for-left, right-for-right.
+    if al is not None and br is not None and (ar is None or bl is None):
+        return (al, br)
+    if ar is not None and bl is not None and (al is None or br is None):
+        return (bl, ar)
+    if al is not None and br is not None:
+        return (al, br)
+    return None
+
+
+def _cross_product(lt: pa.Table, rt: pa.Table, lname, rname) -> pa.Table:
+    n, m = lt.num_rows, rt.num_rows
+    li = np.repeat(np.arange(n, dtype=np.int64), m)
+    ri = np.tile(np.arange(m, dtype=np.int64), n)
+    lout = lt.take(li)
+    rout = rt.take(ri)
+    cols, names = [], []
+    common = set(lt.column_names) & set(rt.column_names)
+    for c in lt.column_names:
+        names.append((f"{lname}.{c}" if lname else f"left.{c}") if c in common else c)
+        cols.append(lout[c])
+    for c in rt.column_names:
+        names.append((f"{rname}.{c}" if rname else f"right.{c}") if c in common else c)
+        cols.append(rout[c])
+    return pa.table(dict(zip(names, cols)))
+
+
+_RANKING_WINDOW_FUNCS = {
+    "row_number", "rank", "dense_rank", "percent_rank", "cume_dist", "ntile",
+}
+_WINDOW_AGG_FUNCS = {"sum", "count", "avg", "min", "max", "mean"}
+
+
+def _eval_window_call(w: WindowCall, t: pa.Table) -> pa.Array:
+    """Evaluate one window function over the whole table.
+
+    Default-frame semantics match the reference's DataFusion execution:
+    with ORDER BY the frame is RANGE UNBOUNDED PRECEDING..CURRENT ROW
+    (peers included); without ORDER BY it is the whole partition."""
+    n = t.num_rows
+    func = "avg" if w.func == "mean" else w.func
+    if n == 0:
+        if func in _RANKING_WINDOW_FUNCS or func == "count":
+            return pa.array([], type=pa.int64())
+        if func in ("avg",):
+            return pa.array([], type=pa.float64())
+        return pa.array([], type=pa.null())
+
+    # partition ids
+    if w.partition_by:
+        codes = []
+        for pe in w.partition_by:
+            arr = eval_expr(pe, t)
+            if isinstance(arr, pa.Scalar):
+                codes.append(np.zeros(n, dtype=np.int64))
+                continue
+            arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+            codes.append(
+                np.asarray(
+                    pc.rank(arr, sort_keys=[("x", "ascending")], tiebreaker="dense"),
+                    dtype=np.int64,
+                )
+            )
+        key = np.stack(codes, axis=1)
+        _, pid = np.unique(key, axis=0, return_inverse=True)
+    else:
+        pid = np.zeros(n, dtype=np.int64)
+
+    # order codes (dense ranks encode both ordering and tie structure)
+    ocodes: list[np.ndarray] = []
+    for oe, asc in w.order_by:
+        arr = eval_expr(oe, t)
+        if isinstance(arr, pa.Scalar):
+            ocodes.append(np.zeros(n, dtype=np.int64))
+            continue
+        arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+        code = np.asarray(
+            pc.rank(
+                arr,
+                sort_keys=[("x", "ascending" if asc else "descending")],
+                tiebreaker="dense",
+            ),
+            dtype=np.int64,
+        )
+        if not asc:
+            # DataFusion/Postgres default: DESC implies NULLS FIRST
+            # (pc.rank puts them last); move nulls ahead of every value.
+            nulls = np.asarray(pc.is_null(arr))
+            if nulls.any():
+                code = np.where(nulls, 0, code)
+        ocodes.append(code)
+
+    if ocodes:
+        idx = np.lexsort((np.arange(n), *reversed(ocodes), pid))
+    else:
+        idx = np.argsort(pid, kind="stable")
+    pid_s = pid[idx]
+    new_part = np.empty(n, dtype=bool)
+    new_part[0] = True
+    new_part[1:] = pid_s[1:] != pid_s[:-1]
+    if ocodes:
+        new_peer = new_part.copy()
+        for c in ocodes:
+            cs = c[idx]
+            new_peer[1:] |= cs[1:] != cs[:-1]
+    else:
+        new_peer = new_part.copy()
+
+    rows = np.arange(n, dtype=np.int64)
+    part_start = np.maximum.accumulate(np.where(new_part, rows, 0))
+    part_sizes = np.diff(np.r_[np.flatnonzero(new_part), n])
+    part_size_per_row = np.repeat(part_sizes, part_sizes)
+    peer_gid = np.cumsum(new_peer) - 1  # global peer-group id
+    peer_last_idx = np.flatnonzero(np.r_[new_peer[1:], True])
+    group_end = peer_last_idx[peer_gid]  # last row index of this row's peer group
+    pos = rows - part_start
+
+    def _scatter(vals_sorted: np.ndarray, type_=None) -> pa.Array:
+        out = np.empty(n, dtype=vals_sorted.dtype)
+        out[idx] = vals_sorted
+        return pa.array(out, type=type_) if type_ is not None else pa.array(out)
+
+    if func == "row_number":
+        return _scatter(pos + 1)
+    if func == "rank":
+        gs = np.maximum.accumulate(np.where(new_peer, rows, 0))
+        return _scatter(gs - part_start + 1)
+    if func == "dense_rank":
+        dr = np.cumsum(new_peer)
+        dr_at_start = np.maximum.accumulate(np.where(new_part, dr, 0))
+        return _scatter(dr - dr_at_start + 1)
+    if func == "percent_rank":
+        gs = np.maximum.accumulate(np.where(new_peer, rows, 0))
+        rank = gs - part_start + 1
+        denom = np.maximum(part_size_per_row - 1, 1)
+        return _scatter(np.where(part_size_per_row == 1, 0.0, (rank - 1) / denom))
+    if func == "cume_dist":
+        return _scatter((group_end - part_start + 1) / part_size_per_row)
+    if func == "ntile":
+        if not w.args or not isinstance(w.args[0], Literal):
+            raise PlanError("ntile(k) requires a literal bucket count")
+        k = int(w.args[0].value)
+        if k <= 0:
+            raise PlanError("ntile bucket count must be positive")
+        size, p = part_size_per_row, pos
+        base, rem = size // k, size % k
+        cut = rem * (base + 1)
+        bucket = np.where(
+            p < cut,
+            p // np.maximum(base + 1, 1),
+            np.where(base > 0, rem + (p - cut) // np.maximum(base, 1), p),
+        )
+        return _scatter(np.minimum(bucket, k - 1) + 1)
+
+    # value-bearing functions need the argument column in sorted order
+    def _sorted_arg(i=0) -> pa.Array:
+        if len(w.args) <= i:
+            raise PlanError(f"{func} requires an argument")
+        arr = eval_expr(w.args[i], t)
+        if isinstance(arr, pa.Scalar):
+            arr = pa.array([arr.as_py()] * n)
+        arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+        return arr.take(pa.array(idx))
+
+    inv = np.empty(n, dtype=np.int64)
+    inv[idx] = rows  # original position -> sorted position
+
+    if func in ("lag", "lead"):
+        offset = 1
+        default = None
+        if len(w.args) >= 2:
+            if not isinstance(w.args[1], Literal):
+                raise PlanError(f"{func} offset must be a literal")
+            offset = int(w.args[1].value)
+        if len(w.args) >= 3:
+            if not isinstance(w.args[2], Literal):
+                raise PlanError(f"{func} default must be a literal")
+            default = w.args[2].value
+        vals_s = _sorted_arg()
+        shift = -offset if func == "lag" else offset
+        target = rows + shift
+        part_end = part_start + part_size_per_row - 1
+        valid = (target >= part_start) & (target <= part_end)
+        take_idx = pa.array(np.where(valid, target, 0), mask=~valid)
+        out_s = vals_s.take(take_idx)
+        if default is not None:
+            # fill only out-of-partition positions — a real NULL at the
+            # shifted position must stay NULL (SQL lag/lead semantics)
+            out_s = pc.if_else(pa.array(valid), out_s, pa.scalar(default))
+        return out_s.take(pa.array(inv))
+
+    if func == "first_value":
+        vals_s = _sorted_arg()
+        return vals_s.take(pa.array(part_start)).take(pa.array(inv))
+    if func == "last_value":
+        vals_s = _sorted_arg()
+        return vals_s.take(pa.array(group_end)).take(pa.array(inv))
+    if func == "nth_value":
+        if len(w.args) < 2 or not isinstance(w.args[1], Literal):
+            raise PlanError("nth_value(x, k) requires a literal k")
+        k = int(w.args[1].value)
+        vals_s = _sorted_arg()
+        target = part_start + k - 1
+        valid = (k >= 1) & (target <= part_start + part_size_per_row - 1)
+        take_idx = pa.array(np.where(valid, target, 0), mask=~valid)
+        return vals_s.take(take_idx).take(pa.array(inv))
+
+    if func in _WINDOW_AGG_FUNCS:
+        if func == "count" and not w.args:
+            if ocodes:
+                out_s = group_end - part_start + 1
+            else:
+                out_s = part_size_per_row
+            return _scatter(out_s.astype(np.int64))
+        vals_s = _sorted_arg()
+        arg_type = vals_s.type
+        null_mask = np.asarray(pc.is_null(vals_s))
+        v = np.asarray(pc.cast(pc.fill_null(vals_s, 0), pa.float64()), dtype=np.float64)
+        v = np.where(null_mask, np.nan, v)
+        starts = np.flatnonzero(new_part)
+        bounds = np.r_[starts, n]
+        out = np.empty(n, dtype=np.float64)
+        cnt = np.empty(n, dtype=np.int64)
+        for s, e in zip(bounds[:-1], bounds[1:]):
+            seg = v[s:e]
+            seg_valid = ~np.isnan(seg)
+            ge_local = group_end[s:e] - s
+            run_cnt = np.cumsum(seg_valid)
+            if ocodes:
+                if func == "count":
+                    acc = run_cnt.astype(np.float64)
+                elif func in ("sum", "avg"):
+                    acc = np.nancumsum(seg)
+                elif func == "min":
+                    acc = np.fmin.accumulate(seg)
+                else:  # max
+                    acc = np.fmax.accumulate(seg)
+                out[s:e] = acc[ge_local]
+                cnt[s:e] = run_cnt[ge_local]
+            else:
+                total_cnt = int(seg_valid.sum())
+                cnt[s:e] = total_cnt
+                if func == "count":
+                    out[s:e] = total_cnt
+                elif total_cnt == 0:
+                    out[s:e] = np.nan
+                elif func in ("sum", "avg"):
+                    out[s:e] = np.nansum(seg)  # avg divides by cnt below
+                elif func == "min":
+                    out[s:e] = np.nanmin(seg)
+                else:
+                    out[s:e] = np.nanmax(seg)
+        if func == "count":
+            return _scatter(out.astype(np.int64))
+        if func == "avg":
+            with np.errstate(invalid="ignore", divide="ignore"):
+                out = np.where(cnt > 0, out / np.maximum(cnt, 1), np.nan)
+        else:
+            # aggregate over an empty (all-null) frame is NULL
+            out = np.where(cnt > 0, out, np.nan)
+        res = np.empty(n, dtype=np.float64)
+        res[idx] = out
+        mask = np.isnan(res)
+        if func in ("sum", "min", "max") and pa.types.is_integer(arg_type) and not mask.any():
+            return pa.array(res.astype(np.int64))
+        return pa.array(res, mask=mask)
+
+    raise PlanError(f"unsupported window function: {func}")
 
 
 # ---- RANGE ... ALIGN execution ---------------------------------------------
@@ -790,6 +1354,7 @@ def _global_agg(col, pa_fn: str):
     fn = {
         "sum": pc.sum, "mean": pc.mean, "min": pc.min, "max": pc.max,
         "count": pc.count, "stddev": pc.stddev, "variance": pc.variance,
+        "count_distinct": pc.count_distinct,
         "approximate_median": pc.approximate_median,
         "first": lambda c: c[0] if len(c) else pa.scalar(None),
         "last": lambda c: c[-1] if len(c) else pa.scalar(None),

@@ -21,7 +21,7 @@ from ..utils.errors import PlanError, TableNotFoundError
 from ..utils.tracing import span
 from .cpu_exec import CpuExecutor
 from .logical_plan import LogicalPlan, TableScan
-from .planner import plan_select
+from .planner import plan_query
 from .sql_parser import SelectStmt
 from .tpu_exec import TpuExecutor, try_lower
 
@@ -37,6 +37,7 @@ class QueryEngine:
         mesh=None,
         tile_context_provider=None,
         partial_agg_provider=None,
+        view_provider=None,
     ):
         """
         schema_provider(table, database) -> Schema
@@ -50,6 +51,7 @@ class QueryEngine:
         """
         self.config = config or QueryConfig()
         self.schema_of = schema_provider
+        self.view_of = view_provider
         self.cpu = CpuExecutor(scan_provider)
         self._mesh = mesh
         self._region_scan = region_scan_provider
@@ -74,11 +76,7 @@ class QueryEngine:
 
     # ---- entry ------------------------------------------------------------
     def execute_select(self, stmt: SelectStmt, database: str = "public") -> pa.Table:
-        if stmt.table is not None:
-            schema = self.schema_of(stmt.table, stmt.database or database)
-        else:
-            schema = Schema(columns=[])
-        plan = plan_select(stmt, schema, database)
+        plan, schema = plan_query(stmt, self.schema_of, database, self.view_of)
         return self.execute_plan(plan, schema)
 
     def execute_plan(self, plan: LogicalPlan, schema: Schema) -> pa.Table:
@@ -94,10 +92,19 @@ class QueryEngine:
 
                     spec = spec_from_lowering(lowering, schema)
                     if spec is not None:
-                        states = self._partial_agg(lowering.scan, spec.to_dict())
+                        from .analyze import stage as _stage
+
+                        with _stage("dist.partial_states") as info:
+                            states = self._partial_agg(lowering.scan, spec.to_dict())
+                            if states is not None:
+                                info["nodes"] = len(states)
+                                info["state_rows"] = sum(s.num_rows for s in states)
+                                info["state_bytes"] = sum(s.nbytes for s in states)
                         if states is not None:
                             backend = "dist_states"
-                            merged = merge_states(states, spec)
+                            with _stage("dist.merge_states") as info:
+                                merged = merge_states(states, spec)
+                                info["groups"] = merged.num_rows
                             shaper = TpuExecutor(None, None)
                             metrics.DIST_STATE_QUERIES.inc()
                             return shaper._shape_output(merged, lowering, schema)
@@ -129,16 +136,34 @@ class QueryEngine:
             metrics.QUERY_ELAPSED.observe(time.perf_counter() - t0, backend=backend)
 
     def explain(self, stmt: SelectStmt, database: str = "public") -> pa.Table:
-        schema = (
-            self.schema_of(stmt.table, stmt.database or database)
-            if stmt.table
-            else Schema(columns=[])
-        )
-        plan = plan_select(stmt, schema, database)
+        plan, schema = plan_query(stmt, self.schema_of, database, self.view_of)
         lowered = try_lower(plan, schema) if schema.columns else None
         lines = plan.describe().split("\n")
         backend = ["tpu" if lowered is not None else "cpu"] * len(lines)
         return pa.table({"plan": lines, "backend": backend})
+
+    def explain_analyze(self, stmt: SelectStmt, database: str = "public") -> pa.Table:
+        """EXPLAIN ANALYZE: execute for real, report per-stage metrics
+        (reference query/src/analyze.rs DistAnalyzeExec)."""
+        from .analyze import StageCollector, render, use_collector
+
+        plan, schema = plan_query(stmt, self.schema_of, database, self.view_of)
+        lowered = try_lower(plan, schema) if schema.columns else None
+        collector = StageCollector()
+        t0 = time.perf_counter()
+        with use_collector(collector):
+            result = self.execute_plan(plan, schema)
+        total_ms = (time.perf_counter() - t0) * 1000.0
+        backend = "cpu"
+        if lowered is not None:
+            # distinguish how the lowered plan actually ran
+            names = {r.name for r in collector.records}
+            if "dist.merge_states" in names:
+                backend = "dist_states"
+            elif any(n.startswith("tpu.") for n in names):
+                backend = "tpu"
+        collector.add("output", 0.0, {"rows": result.num_rows}, depth=0)
+        return render(collector, plan.describe().split("\n"), total_ms, backend)
 
 
 def _x64_enabled() -> bool:

@@ -135,9 +135,12 @@ class AggCall(Expr):
     range_ms: int | None = None  # agg(x) RANGE '10s'
     fill: object = None  # None | "null" | "prev" | "linear" | constant
     params: tuple = ()  # literal leading args, e.g. uddsketch_state(128, 0.01, v)
+    distinct: bool = False  # count(DISTINCT x)
 
     def name(self) -> str:
         inner = self.arg.name() if self.arg is not None else "*"
+        if self.distinct:
+            inner = f"distinct {inner}"
         if self.params:
             inner = ", ".join([*(str(p) for p in self.params), inner])
         base = f"{self.func}({inner})"
@@ -149,6 +152,82 @@ class AggCall(Expr):
 
     def children(self) -> list[Expr]:
         return [self.arg] if self.arg is not None else []
+
+
+@dataclass(frozen=True)
+class Subquery(Expr):
+    """A subquery appearing in an expression (scalar, IN, or EXISTS form).
+
+    The reference gets these from DataFusion's SQL frontend
+    (query/src/planner.rs); here the parser emits `Subquery` and the
+    planner rewrites it to `PlannedSubquery` carrying a logical plan that
+    the executor materializes (uncorrelated subqueries only)."""
+
+    stmt: object  # SelectStmt (kept opaque to avoid a circular import)
+    kind: str = "scalar"  # scalar | in | exists
+    operand: Expr | None = None  # for `x IN (SELECT ...)`
+    negated: bool = False
+
+    def name(self) -> str:
+        if self.kind == "exists":
+            return f"{'not ' if self.negated else ''}exists(<subquery>)"
+        if self.kind == "in":
+            neg = "not in" if self.negated else "in"
+            return f"{self.operand.name()} {neg} (<subquery>)"
+        return "(<subquery>)"
+
+    def children(self) -> list[Expr]:
+        return [self.operand] if self.operand is not None else []
+
+
+@dataclass(frozen=True)
+class PlannedSubquery(Expr):
+    """Planner output for `Subquery`: holds the subquery's LogicalPlan."""
+
+    plan: object  # LogicalPlan
+    kind: str = "scalar"
+    operand: Expr | None = None
+    negated: bool = False
+
+    def name(self) -> str:
+        if self.kind == "exists":
+            return f"{'not ' if self.negated else ''}exists(<subquery>)"
+        if self.kind == "in":
+            neg = "not in" if self.negated else "in"
+            return f"{self.operand.name()} {neg} (<subquery>)"
+        return "(<subquery>)"
+
+    def children(self) -> list[Expr]:
+        return [self.operand] if self.operand is not None else []
+
+
+@dataclass(frozen=True)
+class WindowCall(Expr):
+    """Window function: func(args) OVER (PARTITION BY ... ORDER BY ...).
+
+    Default SQL frame semantics (RANGE UNBOUNDED PRECEDING .. CURRENT ROW
+    including peers when ORDER BY is present, whole partition otherwise) —
+    matching the reference's DataFusion window execution."""
+
+    func: str
+    args: tuple = ()
+    partition_by: tuple = ()  # tuple[Expr]
+    order_by: tuple = ()  # tuple[(Expr, ascending)]
+
+    def name(self) -> str:
+        inner = ", ".join(a.name() for a in self.args)
+        parts = []
+        if self.partition_by:
+            parts.append("partition by " + ", ".join(e.name() for e in self.partition_by))
+        if self.order_by:
+            parts.append(
+                "order by "
+                + ", ".join(f"{e.name()}{'' if asc else ' desc'}" for e, asc in self.order_by)
+            )
+        return f"{self.func}({inner}) over ({' '.join(parts)})"
+
+    def children(self) -> list[Expr]:
+        return [*self.args, *self.partition_by, *[e for e, _ in self.order_by]]
 
 
 @dataclass(frozen=True)
@@ -192,6 +271,54 @@ def map_aggs(e: Expr, fn) -> Expr:
     if isinstance(e, FuncCall):
         return FuncCall(e.func, tuple(map_aggs(a, fn) for a in e.args))
     return e
+
+
+def map_expr(e: Expr, fn) -> Expr:
+    """Bottom-up rebuild: fn is applied to every node after its children
+    have been rebuilt.  fn returns a replacement node (or the node itself)."""
+    if isinstance(e, Alias):
+        e = Alias(map_expr(e.expr, fn), e.alias)
+    elif isinstance(e, BinaryOp):
+        e = BinaryOp(e.op, map_expr(e.left, fn), map_expr(e.right, fn))
+    elif isinstance(e, UnaryOp):
+        e = UnaryOp(e.op, map_expr(e.operand, fn))
+    elif isinstance(e, FuncCall):
+        e = FuncCall(e.func, tuple(map_expr(a, fn) for a in e.args))
+    elif isinstance(e, InList):
+        e = InList(map_expr(e.expr, fn), e.values, e.negated)
+    elif isinstance(e, Between):
+        e = Between(map_expr(e.expr, fn), map_expr(e.low, fn), map_expr(e.high, fn), e.negated)
+    elif isinstance(e, IsNull):
+        e = IsNull(map_expr(e.expr, fn), e.negated)
+    elif isinstance(e, AggCall):
+        import dataclasses
+
+        if e.arg is not None:
+            e = dataclasses.replace(e, arg=map_expr(e.arg, fn))
+    elif isinstance(e, WindowCall):
+        e = WindowCall(
+            e.func,
+            tuple(map_expr(a, fn) for a in e.args),
+            tuple(map_expr(p, fn) for p in e.partition_by),
+            tuple((map_expr(o, fn), asc) for o, asc in e.order_by),
+        )
+    elif isinstance(e, (Subquery, PlannedSubquery)):
+        if e.operand is not None:
+            e = type(e)(
+                e.stmt if isinstance(e, Subquery) else e.plan,
+                e.kind,
+                map_expr(e.operand, fn),
+                e.negated,
+            )
+    return fn(e)
+
+
+def find_window_calls(e: Expr) -> list["WindowCall"]:
+    return [x for x in e.walk() if isinstance(x, WindowCall)]
+
+
+def find_subqueries(e: Expr) -> list["Subquery"]:
+    return [x for x in e.walk() if isinstance(x, Subquery)]
 
 
 def split_conjuncts(e: Expr | None) -> list[Expr]:

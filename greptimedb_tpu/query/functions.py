@@ -375,6 +375,22 @@ def _second(ts):
     return pc.second(ts)
 
 
+@register("date_part", "datepart")
+def _date_part(part, ts):
+    """date_part('year'|'month'|..., ts) — DataFusion-compatible form of
+    the unit extractors (reference gets it from DataFusion)."""
+    p = _scalar(part).lower()
+    fns = {
+        "year": pc.year, "month": pc.month, "day": pc.day, "hour": pc.hour,
+        "minute": pc.minute, "second": pc.second, "dow": pc.day_of_week,
+        "doy": pc.day_of_year, "week": pc.iso_week, "quarter": pc.quarter,
+        "millisecond": pc.millisecond, "microsecond": pc.microsecond,
+    }
+    if p not in fns:
+        raise ValueError(f"date_part: unknown field {p!r}")
+    return fns[p](ts)
+
+
 @register("dayofweek", "dow")
 def _dow(ts):
     return pc.day_of_week(ts)

@@ -137,6 +137,84 @@ class Limit(LogicalPlan):
 
 
 @dataclass(repr=False)
+class Join(LogicalPlan):
+    """Relational join.  The reference gets joins from DataFusion
+    (query/src/planner.rs → DataFusion SqlToRel); here the CPU executor
+    runs an Arrow hash join (equi conjuncts) with a residual post-filter.
+
+    `left_name`/`right_name` are the user-visible side names (table alias
+    or table name) used to qualify colliding output columns."""
+
+    left: LogicalPlan
+    right: LogicalPlan
+    how: str  # inner | left | right | full | cross
+    condition: Expr | None = None  # ON expr
+    using: tuple = ()  # USING (c1, c2)
+    left_name: str | None = None
+    right_name: str | None = None
+
+    def children(self):
+        return [self.left, self.right]
+
+    def __repr__(self):
+        cond = self.condition.name() if self.condition is not None else list(self.using)
+        return f"Join({self.how}, on={cond})"
+
+
+@dataclass(repr=False)
+class SubqueryAlias(LogicalPlan):
+    """FROM (SELECT ...) AS alias, or a CTE reference."""
+
+    input: LogicalPlan
+    alias: str
+
+    def children(self):
+        return [self.input]
+
+    def __repr__(self):
+        return f"SubqueryAlias({self.alias})"
+
+
+@dataclass(repr=False)
+class Window(LogicalPlan):
+    """Computes window-function columns (one per distinct WindowCall found
+    in `exprs`) and appends them to the input, named by WindowCall.name()."""
+
+    input: LogicalPlan
+    window_exprs: list[Expr]  # the WindowCalls to materialize
+
+    def children(self):
+        return [self.input]
+
+    def __repr__(self):
+        return f"Window({[e.name() for e in self.window_exprs]})"
+
+
+@dataclass(repr=False)
+class Distinct(LogicalPlan):
+    input: LogicalPlan
+
+    def children(self):
+        return [self.input]
+
+    def __repr__(self):
+        return "Distinct"
+
+
+@dataclass(repr=False)
+class Union(LogicalPlan):
+    left: LogicalPlan
+    right: LogicalPlan
+    all: bool = False
+
+    def children(self):
+        return [self.left, self.right]
+
+    def __repr__(self):
+        return f"Union({'all' if self.all else 'distinct'})"
+
+
+@dataclass(repr=False)
 class Having(LogicalPlan):
     """Post-aggregation filter (kept distinct so the TPU lowering can apply
     it host-side after finalize)."""

@@ -24,28 +24,291 @@ from .expr import (
     FuncCall,
     InList,
     Literal,
+    PlannedSubquery,
     Star,
+    Subquery,
     UnaryOp,
     find_agg_calls,
+    find_window_calls,
     map_aggs,
+    map_expr,
     split_conjuncts,
     strip_alias,
 )
 from .logical_plan import (
     Aggregate,
+    Distinct,
     Filter,
     Having,
+    Join,
     Limit,
     LogicalPlan,
     Project,
     Sort,
+    SubqueryAlias,
     TableScan,
+    Union,
+    Window,
 )
-from .sql_parser import SelectStmt
+from .sql_parser import JoinItem, SelectStmt, SubqueryRef, TableRef
 
 
-def plan_select(stmt: SelectStmt, schema: Schema, database: str = "public") -> LogicalPlan:
-    if stmt.table is None:
+def plan_query(stmt: SelectStmt, schema_provider, database: str = "public", view_provider=None):
+    """Full-query planner: CTEs, views, joined/subquery FROM items, UNIONs.
+
+    Returns (plan, schema) where schema is the single base table's schema
+    when the query is a plain single-table select (enabling the TPU
+    lowering) and an empty Schema otherwise.
+
+    `view_provider(table, database) -> SelectStmt | None` resolves view
+    names to their (freshly parsed) defining statements.
+
+    Role-equivalent of DataFusion's SqlToRel in the reference
+    (query/src/planner.rs): the relational surface beyond the
+    Aggregate(Filter(Scan)) hot shape executes on the CPU backend.
+    """
+    return _plan_full(stmt, schema_provider, database, {}, view_provider)
+
+
+def _plan_full(
+    stmt: SelectStmt, schema_provider, database: str, outer_ctes: dict, view_provider=None
+):
+    """plan_query with an inherited CTE scope (inner subqueries and views
+    see the outer query's CTEs, per SQL scoping)."""
+    cte_plans: dict[str, LogicalPlan] = dict(outer_ctes)
+    for name, cstmt in stmt.ctes:
+        cte_plans[name] = _plan_full(
+            cstmt, schema_provider, database, cte_plans, view_provider
+        )[0]
+
+    if not stmt.unions:
+        return _plan_branch(stmt, schema_provider, database, cte_plans, view_provider)
+
+    # UNION chain: the parser attaches a trailing ORDER BY/LIMIT to the last
+    # branch; per SQL they order the union's output, so hoist them.  Plan
+    # from a copy — the parsed statement may be re-executed (cursors,
+    # prepared statements), so it must not be mutated.
+    import dataclasses as _dc
+
+    branches = [stmt] + [s for _, s in stmt.unions]
+    last = branches[-1]
+    tail_order, tail_limit, tail_offset = last.order_by, last.limit, last.offset
+    branches[-1] = _dc.replace(last, order_by=[], limit=None, offset=0)
+    plans = [
+        _plan_branch(b, schema_provider, database, cte_plans, view_provider)[0]
+        for b in branches
+    ]
+    plan = plans[0]
+    for (all_, _), p in zip(stmt.unions, plans[1:]):
+        plan = Union(plan, p, all_)
+    if tail_order:
+        keys = [(_resolve_order_key(e, stmt.projections), asc) for e, asc in tail_order]
+        plan = Sort(plan, keys)
+    if tail_limit is not None or tail_offset:
+        plan = Limit(plan, tail_limit, tail_offset)
+    return plan, Schema(columns=[])
+
+
+def _plan_branch(
+    stmt: SelectStmt, schema_provider, database: str, cte_plans: dict, view_provider=None
+):
+    """Plan one SELECT (no unions) resolving CTEs, views, and FROM items."""
+
+    def subplanner(sub: SelectStmt) -> LogicalPlan:
+        return _plan_full(sub, schema_provider, database, cte_plans, view_provider)[0]
+
+    def view_stmt_of(item: TableRef):
+        if view_provider is None:
+            return None
+        return view_provider(item.table, item.database or database)
+
+    fi = stmt.from_item
+    if fi is None:
+        if stmt.table is not None:
+            # Synthetic statements (DELETE's key lookup, programmatic
+            # SelectStmts) set table without a from_item — keep the
+            # single-table pushdown fast path for them.
+            schema = schema_provider(stmt.table, stmt.database or database)
+            return plan_select(stmt, schema, database, subplanner=subplanner), schema
+        return plan_select(stmt, Schema(columns=[]), database, subplanner=subplanner), Schema(columns=[])
+    if isinstance(fi, TableRef) and not (fi.database is None and fi.table in cte_plans):
+        vstmt = view_stmt_of(fi)
+        if vstmt is None:
+            schema = schema_provider(fi.table, fi.database or database)
+            # Normalize alias-qualified references (m.ts -> ts) so pushdown
+            # and the TPU lowering see plain column names; unknown
+            # qualifiers are rejected rather than silently bound.
+            stmt = _normalize_qualifiers(stmt, {fi.alias or fi.table, fi.table})
+            return plan_select(stmt, schema, database, subplanner=subplanner), schema
+        # View as the sole FROM item: plan its (already parsed) definition
+        # once — _plan_from would re-resolve and re-parse it.
+        _validate_qualifiers(stmt, _from_names(fi))
+        source = _plan_view(
+            vstmt, fi, schema_provider, database, view_provider
+        )
+        plan = plan_select(
+            stmt, Schema(columns=[]), database, subplanner=subplanner, source=source
+        )
+        return plan, Schema(columns=[])
+    # CTE reference, subquery, or join tree: build the source plan, then
+    # run the (pushdown-free) select pipeline on top of it.
+    _validate_qualifiers(stmt, _from_names(fi))
+    source = _plan_from(fi, schema_provider, database, cte_plans, subplanner, view_provider)
+    plan = plan_select(
+        stmt, Schema(columns=[]), database, subplanner=subplanner, source=source
+    )
+    return plan, Schema(columns=[])
+
+
+# Per-thread stack of views being expanded, for cycle detection: a view
+# whose (re)definition references itself — directly or mutually — must fail
+# with a clean error, not a RecursionError (the reference rejects cycles at
+# plan time via DataFusion's recursive CTE/view checks).
+import threading as _threading
+
+_view_stack = _threading.local()
+
+
+def _plan_view(vstmt, item: TableRef, schema_provider, database, view_provider):
+    key = f"{item.database or database}.{item.table}"
+    stack = getattr(_view_stack, "keys", None)
+    if stack is None:
+        stack = _view_stack.keys = []
+    if key in stack:
+        raise PlanError(
+            f"circular view reference: {' -> '.join([*stack, key])}"
+        )
+    stack.append(key)
+    try:
+        vplan = _plan_full(
+            vstmt, schema_provider, item.database or database, {}, view_provider
+        )[0]
+    finally:
+        stack.pop()
+    return SubqueryAlias(vplan, item.alias or item.table)
+
+
+def _from_names(item) -> set[str]:
+    """All side names (aliases and table names) visible in a FROM tree."""
+    if isinstance(item, TableRef):
+        return {item.table} | ({item.alias} if item.alias else set())
+    if isinstance(item, SubqueryRef):
+        return {item.alias} if item.alias else set()
+    if isinstance(item, JoinItem):
+        return _from_names(item.left) | _from_names(item.right)
+    return set()
+
+
+def _iter_stmt_exprs(stmt: SelectStmt):
+    for p in stmt.projections:
+        if not isinstance(p, Star):
+            yield p
+    if stmt.where is not None:
+        yield stmt.where
+    if stmt.having is not None:
+        yield stmt.having
+    for g in stmt.group_by:
+        yield g
+    for e, _ in stmt.order_by:
+        yield e
+
+
+def _validate_qualifiers(stmt: SelectStmt, valid: set[str]):
+    """Reject column qualifiers that name no table in this branch's FROM —
+    a mistyped alias (or an outer reference from a correlated subquery)
+    must error, not silently bind to a same-named local column."""
+    for e in _iter_stmt_exprs(stmt):
+        for x in e.walk():
+            if isinstance(x, Column) and "." in x.column:
+                q = x.column.rsplit(".", 1)[0]
+                if q not in valid:
+                    raise PlanError(
+                        f"unknown table alias {q!r} in {x.column!r} "
+                        "(correlated subqueries are not supported)"
+                    )
+
+
+def _normalize_qualifiers(stmt: SelectStmt, valid: set[str]) -> SelectStmt:
+    """Single-table path: rewrite alias.col -> col (validating the alias)."""
+    import dataclasses
+
+    def has_qual(e: Expr) -> bool:
+        return any(isinstance(x, Column) and "." in x.column for x in e.walk())
+
+    if not any(has_qual(e) for e in _iter_stmt_exprs(stmt)):
+        return stmt
+
+    def fix(x: Expr) -> Expr:
+        if isinstance(x, Column) and "." in x.column:
+            q, base = x.column.rsplit(".", 1)
+            if q not in valid:
+                raise PlanError(f"unknown table alias {q!r} in {x.column!r}")
+            return Column(base)
+        return x
+
+    def rw(e: Expr) -> Expr:
+        return map_expr(e, fix)
+
+    return dataclasses.replace(
+        stmt,
+        projections=[p if isinstance(p, Star) else rw(p) for p in stmt.projections],
+        where=rw(stmt.where) if stmt.where is not None else None,
+        having=rw(stmt.having) if stmt.having is not None else None,
+        group_by=[rw(g) for g in stmt.group_by],
+        order_by=[(rw(e), asc) for e, asc in stmt.order_by],
+    )
+
+
+def _plan_from(item, schema_provider, database, cte_plans, subplanner, view_provider=None) -> LogicalPlan:
+    if isinstance(item, TableRef):
+        if item.database is None and item.table in cte_plans:
+            return SubqueryAlias(cte_plans[item.table], item.alias or item.table)
+        if view_provider is not None:
+            vstmt = view_provider(item.table, item.database or database)
+            if vstmt is not None:
+                # Views are planned in their own scope (no outer CTEs).
+                return _plan_view(vstmt, item, schema_provider, database, view_provider)
+        scan = TableScan(table=item.table, database=item.database or database)
+        # Schema lookup validates the table exists at plan time.
+        schema_provider(item.table, item.database or database)
+        return SubqueryAlias(scan, item.alias) if item.alias else scan
+    if isinstance(item, SubqueryRef):
+        return SubqueryAlias(subplanner(item.stmt), item.alias)
+    if isinstance(item, JoinItem):
+        left = _plan_from(item.left, schema_provider, database, cte_plans, subplanner, view_provider)
+        right = _plan_from(item.right, schema_provider, database, cte_plans, subplanner, view_provider)
+        return Join(
+            left,
+            right,
+            item.how,
+            condition=item.on,
+            using=item.using,
+            left_name=_side_name(item.left),
+            right_name=_side_name(item.right),
+        )
+    raise PlanError(f"unsupported FROM item: {item!r}")
+
+
+def _side_name(item) -> str | None:
+    if isinstance(item, TableRef):
+        return item.alias or item.table
+    if isinstance(item, SubqueryRef):
+        return item.alias
+    return None
+
+
+def plan_select(
+    stmt: SelectStmt,
+    schema: Schema,
+    database: str = "public",
+    subplanner=None,
+    source: LogicalPlan | None = None,
+) -> LogicalPlan:
+    # Rewrite subquery expressions into planned subqueries up front.
+    if subplanner is not None:
+        stmt = _rewrite_subqueries(stmt, subplanner)
+
+    if stmt.table is None and source is None:
         # SELECT 1, SELECT now() — constant projection over an empty scan.
         return Project(TableScan(table="", database=database), stmt.projections)
 
@@ -56,79 +319,176 @@ def plan_select(stmt: SelectStmt, schema: Schema, database: str = "public") -> L
         else 1
     )
 
-    pushed: list[tuple[str, str, object]] = []
-    time_lo: int | None = None
-    time_hi: int | None = None
-    residual: list[Expr] = []
+    if source is not None:
+        # Joined / subquery / CTE source: no static schema, so no pushdown —
+        # the WHERE clause stays a residual filter above the source.
+        plan: LogicalPlan = source
+        for conj in split_conjuncts(stmt.where):
+            plan = Filter(plan, conj)
+    else:
+        pushed: list[tuple[str, str, object]] = []
+        time_lo: int | None = None
+        time_hi: int | None = None
+        residual: list[Expr] = []
 
-    for conj in split_conjuncts(stmt.where):
-        simple = _as_simple_filter(conj, schema)
-        if simple is None:
-            residual.append(conj)
-            continue
-        name, op, value = simple
-        if name == ts_col and op in ("<", "<=", ">", ">=", "="):
-            v = _to_native_ts(value, ts_unit_ms)
-            if v is None:
+        for conj in split_conjuncts(stmt.where):
+            simple = _as_simple_filter(conj, schema)
+            if simple is None:
                 residual.append(conj)
                 continue
-            if op in (">", ">="):
-                lo = v + 1 if op == ">" else v
-                time_lo = lo if time_lo is None else max(time_lo, lo)
-            elif op in ("<", "<="):
-                hi = v if op == "<" else v + 1
-                time_hi = hi if time_hi is None else min(time_hi, hi)
-            else:  # =
-                time_lo = v if time_lo is None else max(time_lo, v)
-                time_hi = v + 1 if time_hi is None else min(time_hi, v + 1)
-            continue
-        pushed.append((name, op, value))
+            name, op, value = simple
+            if name == ts_col and op in ("<", "<=", ">", ">=", "="):
+                v = _to_native_ts(value, ts_unit_ms)
+                if v is None:
+                    residual.append(conj)
+                    continue
+                if op in (">", ">="):
+                    lo = v + 1 if op == ">" else v
+                    time_lo = lo if time_lo is None else max(time_lo, lo)
+                elif op in ("<", "<="):
+                    hi = v if op == "<" else v + 1
+                    time_hi = hi if time_hi is None else min(time_hi, hi)
+                else:  # =
+                    time_lo = v if time_lo is None else max(time_lo, v)
+                    time_hi = v + 1 if time_hi is None else min(time_hi, v + 1)
+                continue
+            pushed.append((name, op, value))
 
-    time_range = None
-    if time_lo is not None or time_hi is not None:
-        time_range = (
-            time_lo if time_lo is not None else -(1 << 62),
-            time_hi if time_hi is not None else (1 << 62),
+        time_range = None
+        if time_lo is not None or time_hi is not None:
+            time_range = (
+                time_lo if time_lo is not None else -(1 << 62),
+                time_hi if time_hi is not None else (1 << 62),
+            )
+
+        plan = TableScan(
+            table=stmt.table,
+            database=stmt.database or database,
+            filters=pushed,
+            time_range=time_range,
         )
-
-    plan: LogicalPlan = TableScan(
-        table=stmt.table,
-        database=stmt.database or database,
-        filters=pushed,
-        time_range=time_range,
-    )
-    for conj in residual:
-        plan = Filter(plan, conj)
+        for conj in residual:
+            plan = Filter(plan, conj)
 
     if stmt.align is not None:
         return _plan_range_select(stmt, schema, plan, ts_col, ts_unit_ms)
 
+    window_calls: list[Expr] = []
+    seen_windows: set[str] = set()
+    for p in stmt.projections:
+        if isinstance(p, Star):
+            continue
+        for w in find_window_calls(p):
+            if w.name() not in seen_windows:
+                seen_windows.add(w.name())
+                window_calls.append(w)
+
     # Aggregation?
     proj_aggs = [a for p in stmt.projections if not isinstance(p, Star) for a in find_agg_calls(p)]
     if stmt.group_by or proj_aggs:
+        if window_calls:
+            raise PlanError(
+                "window functions over aggregated output are not supported yet; "
+                "wrap the aggregation in a subquery"
+            )
         group_exprs = [_resolve_positional(g, stmt.projections) for g in stmt.group_by]
         agg_exprs = [p for p in stmt.projections if find_agg_calls(p)]
-        plan = Aggregate(plan, group_exprs, agg_exprs)
+        # HAVING (and ORDER BY) may reference aggregates absent from the
+        # SELECT list — compute them as hidden aggregates; the projection
+        # above drops them (the reference gets this from DataFusion's
+        # having-expression rewriting).
+        seen_aggs = {a.name() for p in agg_exprs for a in find_agg_calls(p)}
+        hidden: list[Expr] = []
+        for src in [stmt.having, *(e for e, _ in stmt.order_by)]:
+            if src is None:
+                continue
+            for a in find_agg_calls(src):
+                if a.name() not in seen_aggs:
+                    seen_aggs.add(a.name())
+                    hidden.append(a)
+        plan = Aggregate(plan, group_exprs, agg_exprs + hidden)
         if stmt.having is not None:
             plan = Having(plan, stmt.having)
-        plan = Project(plan, stmt.projections)
-        if stmt.order_by:
-            # ORDER BY runs over the projected output: positional refs and
-            # alias refs become output-column references.
-            keys = [(_resolve_order_key(e, stmt.projections), asc) for e, asc in stmt.order_by]
-            plan = Sort(plan, keys)
-    else:
-        if stmt.order_by:
-            # Sort below the projection: keys may reference base columns that
-            # the SELECT list drops (aliases resolve to their expressions).
+        hidden_names = {a.name() for a in hidden}
+        order_uses_hidden = any(
+            a.name() in hidden_names
+            for e, _ in stmt.order_by
+            for a in find_agg_calls(_resolve_positional(e, stmt.projections))
+        )
+        if order_uses_hidden:
+            # Sort over the aggregate output (hidden agg columns still
+            # present), then project them away.
             keys = [(_resolve_positional(e, stmt.projections), asc) for e, asc in stmt.order_by]
             plan = Sort(plan, keys)
-        if not (len(stmt.projections) == 1 and isinstance(stmt.projections[0], Star)):
             plan = Project(plan, stmt.projections)
+            if stmt.distinct:
+                plan = Distinct(plan)
+        else:
+            plan = Project(plan, stmt.projections)
+            if stmt.distinct:
+                plan = Distinct(plan)
+            if stmt.order_by:
+                # ORDER BY runs over the projected output: positional refs
+                # and alias refs become output-column references.
+                keys = [(_resolve_order_key(e, stmt.projections), asc) for e, asc in stmt.order_by]
+                plan = Sort(plan, keys)
+    else:
+        if window_calls:
+            plan = Window(plan, window_calls)
+        if stmt.distinct:
+            # Project -> Distinct -> Sort: distinct runs over the projected
+            # output, and ORDER BY keys must resolve against that output.
+            if not (len(stmt.projections) == 1 and isinstance(stmt.projections[0], Star)):
+                plan = Project(plan, stmt.projections)
+            plan = Distinct(plan)
+            if stmt.order_by:
+                keys = [(_resolve_order_key(e, stmt.projections), asc) for e, asc in stmt.order_by]
+                plan = Sort(plan, keys)
+        else:
+            if stmt.order_by:
+                # Sort below the projection: keys may reference base columns
+                # that the SELECT list drops (aliases resolve to their exprs).
+                keys = [(_resolve_positional(e, stmt.projections), asc) for e, asc in stmt.order_by]
+                plan = Sort(plan, keys)
+            if not (len(stmt.projections) == 1 and isinstance(stmt.projections[0], Star)):
+                plan = Project(plan, stmt.projections)
 
-    if stmt.limit is not None:
+    if stmt.limit is not None or stmt.offset:
         plan = Limit(plan, stmt.limit, stmt.offset)
     return plan
+
+
+def _rewrite_subqueries(stmt: SelectStmt, subplanner) -> SelectStmt:
+    """Replace Subquery exprs in WHERE/HAVING/projections/ORDER BY with
+    PlannedSubquery nodes carrying logical plans (uncorrelated only)."""
+    import dataclasses
+
+    def rw(e: Expr) -> Expr:
+        def fn(x):
+            if isinstance(x, Subquery):
+                return PlannedSubquery(subplanner(x.stmt), x.kind, x.operand, x.negated)
+            return x
+
+        return map_expr(e, fn)
+
+    has_sub = any(
+        isinstance(x, Subquery)
+        for e in [
+            *(p for p in stmt.projections if not isinstance(p, Star)),
+            *(x for x in [stmt.where, stmt.having] if x is not None),
+            *(e for e, _ in stmt.order_by),
+        ]
+        for x in e.walk()
+    )
+    if not has_sub:
+        return stmt
+    return dataclasses.replace(
+        stmt,
+        projections=[p if isinstance(p, Star) else rw(p) for p in stmt.projections],
+        where=rw(stmt.where) if stmt.where is not None else None,
+        having=rw(stmt.having) if stmt.having is not None else None,
+        order_by=[(rw(e), asc) for e, asc in stmt.order_by],
+    )
 
 
 def _plan_range_select(
@@ -205,7 +565,7 @@ def _plan_range_select(
         keys = [(e, a) for e, a in keys if e.column in present]
         if keys:
             plan = Sort(plan, keys)
-    if stmt.limit is not None:
+    if stmt.limit is not None or stmt.offset:
         plan = Limit(plan, stmt.limit, stmt.offset)
     return plan
 

@@ -28,8 +28,16 @@ from .expr import (
     IsNull,
     Literal,
     Star,
+    Subquery,
     UnaryOp,
+    WindowCall,
 )
+
+WINDOW_FUNCS = {
+    "row_number", "rank", "dense_rank", "ntile",
+    "lag", "lead", "first_value", "last_value", "nth_value",
+    "cume_dist", "percent_rank",
+}
 
 AGG_FUNCS = {
     "sum", "avg", "min", "max", "count", "mean",
@@ -106,6 +114,38 @@ class SelectStmt:
     limit: int | None = None
     offset: int = 0
     align: AlignClause | None = None
+    distinct: bool = False
+    # Relational surface beyond single-table scans (reference gets these
+    # from DataFusion's SQL frontend):
+    from_item: object = None  # TableRef | SubqueryRef | JoinItem | None
+    ctes: list = field(default_factory=list)  # [(name, SelectStmt)]
+    unions: list = field(default_factory=list)  # [(all: bool, SelectStmt)]
+
+
+@dataclass
+class TableRef:
+    """FROM db.table [AS alias]"""
+
+    table: str
+    database: str | None = None
+    alias: str | None = None
+
+
+@dataclass
+class SubqueryRef:
+    """FROM (SELECT ...) AS alias"""
+
+    stmt: SelectStmt = None
+    alias: str | None = None
+
+
+@dataclass
+class JoinItem:
+    left: object = None  # TableRef | SubqueryRef | JoinItem
+    right: object = None
+    how: str = "inner"  # inner | left | right | full | cross
+    on: Expr | None = None
+    using: tuple = ()
 
 
 @dataclass
@@ -123,6 +163,7 @@ class ColumnDef:
 class CreateTableStmt:
     name: str
     columns: list[ColumnDef]
+    database: str | None = None
     time_index: str | None = None
     primary_key: list[str] = field(default_factory=list)
     if_not_exists: bool = False
@@ -147,6 +188,19 @@ class DropStmt:
 
 
 @dataclass
+class CreateViewStmt:
+    """CREATE [OR REPLACE] VIEW name AS <select> (reference
+    common/meta/src/ddl/create_view.rs — stored as defining SQL here,
+    re-planned per query)."""
+
+    name: str
+    sql_text: str  # the defining SELECT, verbatim
+    stmt: object = None  # parsed SelectStmt (validation-time artifact)
+    or_replace: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass
 class CreateFlowStmt:
     """`CREATE FLOW name SINK TO sink [EXPIRE AFTER i] [EVAL INTERVAL i]
     [COMMENT '...'] AS SELECT ...` (reference sql/src/statements/create.rs:596)."""
@@ -167,6 +221,7 @@ class InsertStmt:
     table: str
     columns: list[str] | None
     rows: list[list[object]]
+    database: str | None = None
 
 
 @dataclass
@@ -348,7 +403,9 @@ class Parser:
     # ---- entry ------------------------------------------------------------
     def parse_statement(self):
         if self.at_kw("select"):
-            return self.parse_select()
+            return self.parse_select_query()
+        if self.at_kw("with"):
+            return self.parse_select_query()
         if self.at_kw("create"):
             return self.parse_create()
         if self.at_kw("drop"):
@@ -551,19 +608,44 @@ class Parser:
         return self.ident()
 
     # ---- SELECT -----------------------------------------------------------
+    def parse_select_query(self) -> SelectStmt:
+        """Full query: [WITH ctes] select [UNION [ALL] select]*"""
+        ctes: list = []
+        if self.eat_kw("with"):
+            while True:
+                name = self.ident()
+                self.expect_kw("as")
+                self.expect_op("(")
+                ctes.append((name, self.parse_select_query()))
+                self.expect_op(")")
+                if not self.eat_op(","):
+                    break
+        stmt = self.parse_select()
+        stmt.ctes = ctes
+        while self.at_kw("union"):
+            self.next()
+            all_ = self.eat_kw("all")
+            self.eat_kw("distinct")
+            stmt.unions.append((all_, self.parse_select()))
+        return stmt
+
     def parse_select(self) -> SelectStmt:
         self.expect_kw("select")
+        distinct = False
+        if self.eat_kw("distinct"):
+            distinct = True
+        self.eat_kw("all")
         projections = [self.parse_projection()]
         while self.eat_op(","):
             projections.append(self.parse_projection())
-        stmt = SelectStmt(projections=projections)
+        stmt = SelectStmt(projections=projections, distinct=distinct)
         if self.eat_kw("from"):
-            name = self.ident()
-            if self.eat_op("."):
-                stmt.database = name
-                stmt.table = self.ident()
-            else:
-                stmt.table = name
+            stmt.from_item = self.parse_from_item()
+            if isinstance(stmt.from_item, TableRef):
+                # Keep the single-table fast path fields populated (the TPU
+                # lowering and protocol servers read stmt.table directly).
+                stmt.table = stmt.from_item.table
+                stmt.database = stmt.from_item.database
         if self.eat_kw("where"):
             stmt.where = self.parse_expr()
         if self.at_kw("align"):
@@ -593,6 +675,85 @@ class Parser:
             stmt.offset = int(self.next().value)
         return stmt
 
+    _FROM_STOP_KWS = (
+        "join", "inner", "left", "right", "full", "outer", "cross", "on",
+        "using", "where", "group", "having", "order", "limit", "offset",
+        "align", "union", "natural",
+    )
+
+    def parse_from_item(self):
+        left = self.parse_from_primary()
+        while True:
+            how = None
+            if self.at_kw("join"):
+                how = "inner"
+            elif self.at_kw("inner"):
+                self.next()
+                how = "inner"
+            elif self.at_kw("left"):
+                self.next()
+                self.eat_kw("outer")
+                how = "left"
+            elif self.at_kw("right"):
+                self.next()
+                self.eat_kw("outer")
+                how = "right"
+            elif self.at_kw("full"):
+                self.next()
+                self.eat_kw("outer")
+                how = "full"
+            elif self.at_kw("cross"):
+                self.next()
+                how = "cross"
+            elif self.at_op(","):
+                # comma join = cross join (with WHERE doing the filtering)
+                self.next()
+                right = self.parse_from_primary()
+                left = JoinItem(left, right, "cross")
+                continue
+            else:
+                return left
+            self.expect_kw("join")
+            right = self.parse_from_primary()
+            item = JoinItem(left, right, how)
+            if how != "cross":
+                if self.eat_kw("on"):
+                    item.on = self.parse_expr()
+                elif self.eat_kw("using"):
+                    self.expect_op("(")
+                    cols = [self.ident()]
+                    while self.eat_op(","):
+                        cols.append(self.ident())
+                    self.expect_op(")")
+                    item.using = tuple(cols)
+                else:
+                    raise InvalidSyntaxError(f"{how.upper()} JOIN requires ON or USING")
+            left = item
+
+    def parse_from_primary(self):
+        if self.at_op("("):
+            self.next()
+            if self.at_kw("select", "with"):
+                sub = self.parse_select_query()
+                self.expect_op(")")
+                self.eat_kw("as")
+                alias = self.ident()
+                return SubqueryRef(sub, alias)
+            item = self.parse_from_item()
+            self.expect_op(")")
+            return item
+        name = self.ident()
+        database = None
+        if self.eat_op("."):
+            database = name
+            name = self.ident()
+        alias = None
+        if self.eat_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind in ("ident", "qident") and not self.at_kw(*self._FROM_STOP_KWS):
+            alias = self.ident()
+        return TableRef(name, database, alias)
+
     def parse_projection(self) -> Expr:
         if self.at_op("*"):
             self.next()
@@ -602,7 +763,7 @@ class Parser:
             return Alias(e, self.ident())
         t = self.peek()
         if t.kind in ("ident", "qident") and not self.at_kw(
-            "from", "where", "group", "having", "order", "limit", "offset", "as", "and", "or", "asc", "desc",
+            "from", "where", "group", "having", "order", "limit", "offset", "as", "and", "or", "asc", "desc", "union",
         ):
             return Alias(e, self.ident())
         return e
@@ -651,6 +812,10 @@ class Parser:
                 self.i = save
         if self.eat_kw("in"):
             self.expect_op("(")
+            if self.at_kw("select", "with"):
+                sub = self.parse_select_query()
+                self.expect_op(")")
+                return Subquery(sub, "in", operand=left, negated=negated)
             values = []
             while not self.at_op(")"):
                 values.append(self.parse_literal_value())
@@ -696,7 +861,10 @@ class Parser:
 
     def parse_unary(self) -> Expr:
         if self.eat_op("-"):
-            return UnaryOp("-", self.parse_unary())
+            e = self.parse_unary()
+            if isinstance(e, Literal) and isinstance(e.value, (int, float)):
+                return Literal(-e.value)  # fold negative numeric literals
+            return UnaryOp("-", e)
         if self.eat_op("+"):
             return self.parse_unary()
         return self.parse_primary()
@@ -712,10 +880,23 @@ class Parser:
             return self._maybe_cast(Literal(t.value[1:-1].replace("''", "'")))
         if self.at_op("("):
             self.next()
+            if self.at_kw("select", "with"):
+                sub = self.parse_select_query()
+                self.expect_op(")")
+                return self._maybe_cast(Subquery(sub, "scalar"))
             e = self.parse_expr()
             self.expect_op(")")
             return self._maybe_cast(e)
         if t.kind in ("ident", "qident"):
+            if self.at_kw("exists"):
+                save = self.i
+                self.next()
+                if self.at_op("("):
+                    self.next()
+                    sub = self.parse_select_query()
+                    self.expect_op(")")
+                    return Subquery(sub, "exists")
+                self.i = save
             if self.at_kw("null"):
                 self.next()
                 return self._maybe_cast(Literal(None))
@@ -736,6 +917,18 @@ class Parser:
             name = self.ident()
             if self.at_op("("):
                 return self._maybe_cast(self.parse_call(name))
+            # Qualified column reference: alias.column (resolved against the
+            # join output at execution; see cpu_exec column resolution).
+            if self.at_op("."):
+                nxt = self.tokens[self.i + 1] if self.i + 1 < len(self.tokens) else None
+                after = self.tokens[self.i + 2] if self.i + 2 < len(self.tokens) else None
+                if (
+                    nxt is not None
+                    and nxt.kind in ("ident", "qident")
+                    and not (after is not None and after.kind == "op" and after.value == "(")
+                ):
+                    self.next()
+                    name = f"{name}.{self.ident()}"
             return self._maybe_cast(Column(name))
         raise InvalidSyntaxError(f"unexpected token {t.value!r} in expression")
 
@@ -857,11 +1050,14 @@ class Parser:
         if lname == "count" and self.at_op("*"):
             self.next()
             self.expect_op(")")
+            if self.at_kw("over"):
+                return self._parse_over(lname, ())
             return AggCall("count", None)
+        distinct = False
         args: list[Expr] = []
         while not self.at_op(")"):
             if self.eat_kw("distinct"):
-                pass  # distinct handled by executor for count(distinct x)
+                distinct = True
             args.append(self.parse_expr())
             if self.at_kw("order"):  # last_value(x ORDER BY ts)
                 self.next()
@@ -874,6 +1070,12 @@ class Parser:
             if not self.eat_op(","):
                 break
         self.expect_op(")")
+        if self.at_kw("over"):
+            if distinct:
+                raise InvalidSyntaxError(
+                    f"DISTINCT is not supported in window function {lname}()"
+                )
+            return self._parse_over(lname, tuple(args))
         if lname in AGG_FUNCS:
             if lname == "mean":
                 lname = "avg"
@@ -886,8 +1088,42 @@ class Parser:
                         )
                     params.append(a.value)
                 return AggCall(lname, args[-1], params=tuple(params))
-            return AggCall(lname, args[0] if args else None)
+            if distinct and lname != "count":
+                raise InvalidSyntaxError(f"DISTINCT is only supported in count(), not {lname}()")
+            return AggCall(lname, args[0] if args else None, distinct=distinct)
+        if distinct:
+            raise InvalidSyntaxError(f"DISTINCT is not valid in {lname}()")
         return FuncCall(lname, tuple(args))
+
+    def _parse_over(self, func: str, args: tuple) -> Expr:
+        """func(args) OVER ([PARTITION BY ...] [ORDER BY ...])"""
+        self.expect_kw("over")
+        self.expect_op("(")
+        partition_by: list[Expr] = []
+        order_by: list[tuple[Expr, bool]] = []
+        if self.eat_kw("partition"):
+            self.expect_kw("by")
+            partition_by.append(self.parse_expr())
+            while self.eat_op(","):
+                partition_by.append(self.parse_expr())
+        if self.eat_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.parse_expr()
+                asc = True
+                if self.eat_kw("desc"):
+                    asc = False
+                elif self.eat_kw("asc"):
+                    pass
+                order_by.append((e, asc))
+                if not self.eat_op(","):
+                    break
+        if self.at_kw("rows", "range", "groups"):
+            raise InvalidSyntaxError("window frame specifications are not supported yet")
+        self.expect_op(")")
+        if func not in WINDOW_FUNCS and func not in AGG_FUNCS:
+            raise InvalidSyntaxError(f"{func} is not a window function")
+        return WindowCall(func, args, tuple(partition_by), order_by=tuple(order_by))
 
     def parse_literal_value(self):
         t = self.next()
@@ -918,8 +1154,20 @@ class Parser:
             or_replace = True
         if self.eat_kw("flow"):
             return self.parse_create_flow(or_replace)
+        if self.eat_kw("view"):
+            ine = self._if_not_exists()
+            name = self.ident()
+            self.expect_kw("as")
+            start = self.peek().pos
+            sub = self.parse_select_query()  # validates the definition
+            sql_text = self.sql[start : self.peek().pos].strip().rstrip(";").strip()
+            return CreateViewStmt(
+                name, sql_text, stmt=sub, or_replace=or_replace, if_not_exists=ine
+            )
         if or_replace:
-            raise InvalidSyntaxError("OR REPLACE is only supported for CREATE FLOW")
+            raise InvalidSyntaxError(
+                "OR REPLACE is only supported for CREATE FLOW / CREATE VIEW"
+            )
         if self.eat_kw("database", "schema"):
             ine = self._if_not_exists()
             return CreateDatabaseStmt(self.ident(), if_not_exists=ine)
@@ -927,7 +1175,11 @@ class Parser:
         self.expect_kw("table")
         ine = self._if_not_exists()
         name = self.ident()
-        stmt = CreateTableStmt(name=name, columns=[], if_not_exists=ine)
+        database = None
+        if self.eat_op("."):
+            database = name
+            name = self.ident()
+        stmt = CreateTableStmt(name=name, columns=[], if_not_exists=ine, database=database)
         stmt.external = external
         if not external or self.at_op("("):
             self.expect_op("(")
@@ -1105,6 +1357,8 @@ class Parser:
             kind = "database"
         elif self.eat_kw("flow"):
             kind = "flow"
+        elif self.eat_kw("view"):
+            kind = "view"
         else:
             self.expect_kw("table")
         if_exists = False
@@ -1117,6 +1371,10 @@ class Parser:
         self.expect_kw("insert")
         self.expect_kw("into")
         table = self.ident()
+        database = None
+        if self.eat_op("."):
+            database = table
+            table = self.ident()
         columns = None
         if self.eat_op("("):
             columns = [self.ident()]
@@ -1136,7 +1394,7 @@ class Parser:
             rows.append(row)
             if not self.eat_op(","):
                 break
-        return InsertStmt(table, columns, rows)
+        return InsertStmt(table, columns, rows, database=database)
 
     def parse_show(self):
         self.expect_kw("show")
@@ -1152,9 +1410,16 @@ class Parser:
             if self.eat_kw("like"):
                 like = self.next().value.strip("'")
             return ShowStmt("flows", like=like)
+        if self.eat_kw("views"):
+            like = None
+            if self.eat_kw("like"):
+                like = self.next().value.strip("'")
+            return ShowStmt("views", like=like)
         if self.eat_kw("create"):
             if self.eat_kw("flow"):
                 return ShowStmt("create_flow", target=self.ident())
+            if self.eat_kw("view"):
+                return ShowStmt("create_view", target=self.ident())
             self.expect_kw("table")
             return ShowStmt("create_table", target=self.ident())
         raise InvalidSyntaxError(f"unsupported SHOW near {self.peek().value!r}")

@@ -106,6 +106,8 @@ def try_lower(plan: LogicalPlan, schema: Schema) -> Lowering | None:
         func = "avg" if inner.func == "mean" else inner.func
         if func not in LOWERABLE_AGGS:
             return None
+        if inner.distinct:
+            return None  # count(DISTINCT x) has no segment-sum lowering
         if inner.arg is None:
             agg_specs.append(("count", None))
             continue
@@ -159,19 +161,23 @@ class TpuExecutor:
         used when the query has no explicit time range (bucket count must be
         static for XLA)."""
         from ..parallel.executor import distributed_groupby
+        from .analyze import stage
 
         scan = lowering.scan
         if self.tile_executor is not None and self.tile_context_provider is not None:
             ctx = self.tile_context_provider(scan)
             if ctx is not None:
-                table = self.tile_executor.execute(
-                    lowering,
-                    schema,
-                    lambda: time_bounds(),
-                    ctx,
-                )
+                with stage("tpu.tile_cache") as info:
+                    table = self.tile_executor.execute(
+                        lowering,
+                        schema,
+                        lambda: time_bounds(),
+                        ctx,
+                    )
+                    info["hit"] = table is not None
                 if table is not None:
-                    return self._shape_output(table, lowering, schema)
+                    with stage("tpu.post_ops"):
+                        return self._shape_output(table, lowering, schema)
         if lowering.bucket is not None:
             ts_col, interval, origin_hint = lowering.bucket
             if scan.time_range is not None and scan.time_range[0] > -(1 << 61) and scan.time_range[1] < (1 << 61):
@@ -187,24 +193,30 @@ class TpuExecutor:
         else:
             bucket_col, interval_native, origin, n_buckets = None, 1, 0, 1
 
-        region_tables = self.region_scan(scan)
+        with stage("tpu.region_scan") as info:
+            region_tables = self.region_scan(scan)
+            info["regions"] = len(region_tables)
+            info["rows"] = sum(t.num_rows for t in region_tables)
         needs_ts = any(f == "last_value" for f, _ in lowering.agg_specs)
-        result = distributed_groupby(
-            self.mesh,
-            region_tables,
-            group_tags=lowering.group_tags,
-            bucket_col=bucket_col,
-            bucket_origin=origin,
-            bucket_interval=interval_native,
-            n_buckets=n_buckets,
-            agg_specs=[(f, c) for f, c in lowering.agg_specs],
-            filters=list(scan.filters),
-            acc_dtype=self.acc_dtype,
-            ts_col=schema.time_index.name if needs_ts and schema.time_index else None,
-        )
-        table = result.to_table()
+        with stage("tpu.device_groupby") as info:
+            result = distributed_groupby(
+                self.mesh,
+                region_tables,
+                group_tags=lowering.group_tags,
+                bucket_col=bucket_col,
+                bucket_origin=origin,
+                bucket_interval=interval_native,
+                n_buckets=n_buckets,
+                agg_specs=[(f, c) for f, c in lowering.agg_specs],
+                filters=list(scan.filters),
+                acc_dtype=self.acc_dtype,
+                ts_col=schema.time_index.name if needs_ts and schema.time_index else None,
+            )
+            table = result.to_table()
+            info["groups"] = table.num_rows
         metrics.TPU_LOWERED_TOTAL.inc()
-        return self._shape_output(table, lowering, schema)
+        with stage("tpu.post_ops"):
+            return self._shape_output(table, lowering, schema)
 
     def _shape_output(self, table: pa.Table, lowering: Lowering, schema: Schema) -> pa.Table:
         """Kernel output -> SQL result: plan names, empty-input semantics,

@@ -274,6 +274,21 @@ class FulltextIndex:
             return np.zeros(self.n_segs, dtype=bool)
         return self.bm[i]
 
+    def _substr_token_segs(self, token: str) -> np.ndarray:
+        """Segments whose vocabulary contains `token` as a SUBSTRING of any
+        stored token.  Phrase row-matching is substring-based
+        (matches_mask uses pc.match_substring), so phrase pruning must be
+        substring-conservative: '\"err\"' must keep segments holding
+        'error'.  A phrase token is pure word chars, so it can only occur
+        inside a single text token — the OR over containing vocab tokens
+        is exact segment candidacy."""
+        t = token.lower()
+        out = np.zeros(self.n_segs, dtype=bool)
+        for v, i in self._tok_idx.items():
+            if t in v:
+                out |= self.bm[i]
+        return out
+
     def search(self, op: str, value) -> np.ndarray | None:
         """Conservative segment candidacy for match predicates: a segment
         survives when it MIGHT match (phrases fall back to their tokens;
@@ -298,7 +313,7 @@ class FulltextIndex:
                 cand &= self._token_segs(t)
             for p in phrases:
                 for t in tokenize(p):
-                    cand &= self._token_segs(t)
+                    cand &= self._substr_token_segs(t)
             out |= cand
         return out
 

@@ -411,4 +411,6 @@ def _cmp(col, op: str, value):
         return pc.greater_equal(col, value)
     if op == "in":
         return pc.is_in(col, value_set=pa.array(list(value)))
+    if op == "not in":
+        return pc.invert(pc.is_in(col, value_set=pa.array(list(value))))
     raise ValueError(f"unknown filter op: {op}")

@@ -1,0 +1,489 @@
+"""Joins, subqueries, CTEs, window functions, UNION, DISTINCT.
+
+Covers the relational surface the reference gets from DataFusion
+(reference query/src/planner.rs -> SqlToRel; window/physical operators in
+DataFusion itself).  The CPU executor is authoritative for these shapes.
+"""
+
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.utils.errors import ExecutionError, PlanError
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(data_home=str(tmp_path))
+    d.sql(
+        "CREATE TABLE hosts (host STRING, region STRING, ts TIMESTAMP TIME INDEX,"
+        " PRIMARY KEY(host))"
+    )
+    d.sql(
+        "CREATE TABLE cpu (host STRING, usage DOUBLE, ts TIMESTAMP TIME INDEX,"
+        " PRIMARY KEY(host))"
+    )
+    d.sql("INSERT INTO hosts VALUES ('h1','us-west',0),('h2','us-east',0),('h3','eu',0)")
+    d.sql(
+        "INSERT INTO cpu VALUES ('h1',10.0,1000),('h1',20.0,2000),"
+        "('h2',30.0,1000),('h4',40.0,1000)"
+    )
+    yield d
+    d.close()
+
+
+# ---- joins ------------------------------------------------------------------
+
+
+def test_inner_join(db):
+    t = db.sql_one(
+        "SELECT c.host, c.usage, h.region FROM cpu c JOIN hosts h"
+        " ON c.host = h.host ORDER BY c.usage"
+    )
+    assert t.to_pydict() == {
+        "host": ["h1", "h1", "h2"],
+        "usage": [10.0, 20.0, 30.0],
+        "region": ["us-west", "us-west", "us-east"],
+    }
+
+
+def test_left_join_nulls(db):
+    t = db.sql_one(
+        "SELECT c.host, h.region FROM cpu c LEFT JOIN hosts h ON c.host = h.host"
+        " ORDER BY c.host, c.ts"
+    )
+    assert t.to_pydict() == {
+        "host": ["h1", "h1", "h2", "h4"],
+        "region": ["us-west", "us-west", "us-east", None],
+    }
+
+
+def test_right_and_full_join(db):
+    t = db.sql_one(
+        "SELECT h.host, count(c.usage) n FROM cpu c RIGHT JOIN hosts h"
+        " ON c.host = h.host GROUP BY h.host ORDER BY h.host"
+    )
+    assert t.to_pydict() == {"host": ["h1", "h2", "h3"], "n": [2, 1, 0]}
+    t = db.sql_one(
+        "SELECT count(*) n FROM cpu c FULL JOIN hosts h ON c.host = h.host"
+    )
+    # h1 x2, h2, h4 (right null), h3 (left null)
+    assert t.to_pydict() == {"n": [5]}
+
+
+def test_join_using(db):
+    t = db.sql_one(
+        "SELECT host, region FROM cpu JOIN hosts USING (host)"
+        " ORDER BY host, region"
+    )
+    assert t.column("host").to_pylist() == ["h1", "h1", "h2"]
+
+
+def test_cross_join(db):
+    t = db.sql_one("SELECT count(*) n FROM cpu CROSS JOIN hosts")
+    assert t.to_pydict() == {"n": [12]}
+    # comma-join with WHERE behaves as an inner join
+    t = db.sql_one(
+        "SELECT count(*) n FROM cpu c, hosts h WHERE c.host = h.host"
+    )
+    assert t.to_pydict() == {"n": [3]}
+
+
+def test_join_with_residual_condition(db):
+    t = db.sql_one(
+        "SELECT c.host FROM cpu c JOIN hosts h ON c.host = h.host"
+        " AND c.usage > 15 ORDER BY c.usage"
+    )
+    assert t.column("host").to_pylist() == ["h1", "h2"]
+
+
+def test_join_on_aggregated_subquery(db):
+    t = db.sql_one(
+        "SELECT h.region, a.au FROM hosts h JOIN"
+        " (SELECT host, avg(usage) au FROM cpu GROUP BY host) a"
+        " ON h.host = a.host ORDER BY a.au"
+    )
+    assert t.to_pydict() == {"region": ["us-west", "us-east"], "au": [15.0, 30.0]}
+
+
+def test_self_join_qualified_collision(db):
+    t = db.sql_one(
+        "SELECT a.host, b.host FROM cpu a JOIN cpu b ON a.ts = b.ts"
+        " WHERE a.host != b.host ORDER BY a.host"
+    )
+    d = t.to_pydict()
+    # qualified names survive the collision
+    assert set(d.keys()) == {"host", "b.host"} or set(d.keys()) == {"a.host", "b.host"}
+
+
+def test_information_schema_join(db):
+    t = db.sql_one(
+        "SELECT c.column_name FROM information_schema.tables t"
+        " JOIN information_schema.columns c ON t.table_name = c.table_name"
+        " WHERE t.table_name = 'cpu' ORDER BY c.column_name"
+    )
+    assert t.column("column_name").to_pylist() == ["host", "ts", "usage"]
+
+
+def test_join_missing_equi_condition_errors(db):
+    with pytest.raises((PlanError, ExecutionError)):
+        db.sql_one("SELECT 1 x FROM cpu c JOIN hosts h ON c.usage > 1")
+
+
+# ---- subqueries -------------------------------------------------------------
+
+
+def test_scalar_subquery(db):
+    t = db.sql_one(
+        "SELECT host, usage FROM cpu WHERE usage > (SELECT avg(usage) FROM cpu)"
+        " ORDER BY usage"
+    )
+    assert t.to_pydict() == {"host": ["h2", "h4"], "usage": [30.0, 40.0]}
+
+
+def test_scalar_subquery_in_projection(db):
+    t = db.sql_one("SELECT (SELECT max(usage) FROM cpu) m FROM hosts LIMIT 1")
+    assert t.to_pydict() == {"m": [40.0]}
+
+
+def test_in_subquery(db):
+    t = db.sql_one(
+        "SELECT host, usage FROM cpu WHERE host IN"
+        " (SELECT host FROM hosts WHERE region = 'us-west') ORDER BY ts"
+    )
+    assert t.column("usage").to_pylist() == [10.0, 20.0]
+
+
+def test_not_in_subquery(db):
+    t = db.sql_one(
+        "SELECT DISTINCT host FROM cpu WHERE host NOT IN"
+        " (SELECT host FROM hosts) ORDER BY host"
+    )
+    assert t.column("host").to_pylist() == ["h4"]
+
+
+def test_exists_subquery(db):
+    t = db.sql_one(
+        "SELECT count(*) n FROM cpu WHERE EXISTS"
+        " (SELECT 1 FROM hosts WHERE region = 'eu')"
+    )
+    assert t.to_pydict() == {"n": [4]}
+    t = db.sql_one(
+        "SELECT count(*) n FROM cpu WHERE EXISTS"
+        " (SELECT 1 FROM hosts WHERE region = 'mars')"
+    )
+    assert t.to_pydict() == {"n": [0]}
+
+
+def test_scalar_subquery_multiple_rows_errors(db):
+    with pytest.raises((ExecutionError, PlanError)):
+        db.sql_one("SELECT host FROM cpu WHERE usage > (SELECT usage FROM cpu)")
+
+
+# ---- CTEs -------------------------------------------------------------------
+
+
+def test_cte_basic(db):
+    t = db.sql_one(
+        "WITH busy AS (SELECT host, avg(usage) au FROM cpu GROUP BY host)"
+        " SELECT host, au FROM busy ORDER BY au DESC"
+    )
+    assert t.to_pydict() == {"host": ["h4", "h2", "h1"], "au": [40.0, 30.0, 15.0]}
+
+
+def test_cte_join_and_chaining(db):
+    t = db.sql_one(
+        "WITH a AS (SELECT host, max(usage) mu FROM cpu GROUP BY host),"
+        " b AS (SELECT host, mu FROM a WHERE mu >= 20)"
+        " SELECT b.host, b.mu, h.region FROM b JOIN hosts h ON b.host = h.host"
+        " ORDER BY b.mu"
+    )
+    assert t.to_pydict() == {
+        "host": ["h1", "h2"],
+        "mu": [20.0, 30.0],
+        "region": ["us-west", "us-east"],
+    }
+
+
+# ---- window functions -------------------------------------------------------
+
+
+def test_row_number_rank(db):
+    db.sql("INSERT INTO cpu VALUES ('h2',30.0,3000)")
+    t = db.sql_one(
+        "SELECT host, usage, ts,"
+        " row_number() OVER (PARTITION BY host ORDER BY ts) rn,"
+        " rank() OVER (ORDER BY usage) rk,"
+        " dense_rank() OVER (ORDER BY usage) dr"
+        " FROM cpu ORDER BY host, ts"
+    )
+    d = t.to_pydict()
+    assert d["rn"] == [1, 2, 1, 2, 1]
+    assert d["rk"] == [1, 2, 3, 3, 5]
+    assert d["dr"] == [1, 2, 3, 3, 4]
+
+
+def test_running_and_partition_aggregates(db):
+    t = db.sql_one(
+        "SELECT host, ts, sum(usage) OVER (PARTITION BY host ORDER BY ts) rs,"
+        " avg(usage) OVER (PARTITION BY host) pa,"
+        " count(*) OVER () total"
+        " FROM cpu ORDER BY host, ts"
+    )
+    d = t.to_pydict()
+    assert d["rs"] == [10.0, 30.0, 30.0, 40.0]
+    assert d["pa"] == [15.0, 15.0, 30.0, 40.0]
+    assert d["total"] == [4, 4, 4, 4]
+
+
+def test_lag_lead_first_last(db):
+    t = db.sql_one(
+        "SELECT host, ts, lag(usage) OVER (PARTITION BY host ORDER BY ts) lg,"
+        " lead(usage, 1, -1.0) OVER (PARTITION BY host ORDER BY ts) ld,"
+        " first_value(usage) OVER (PARTITION BY host ORDER BY ts) fv,"
+        " last_value(usage) OVER (PARTITION BY host ORDER BY ts) lv"
+        " FROM cpu ORDER BY host, ts"
+    )
+    d = t.to_pydict()
+    assert d["lg"] == [None, 10.0, None, None]
+    assert d["ld"] == [20.0, -1.0, -1.0, -1.0]
+    assert d["fv"] == [10.0, 10.0, 30.0, 40.0]
+    # default frame: last_value = current row's peer group end
+    assert d["lv"] == [10.0, 20.0, 30.0, 40.0]
+
+
+def test_window_peers_running_sum(tmp_path):
+    # ties in ORDER BY: peers share the running value (RANGE frame)
+    db = Database(data_home=str(tmp_path / "w"))
+    db.sql("CREATE TABLE w (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(k))")
+    # distinct series so last-write-wins dedup keeps all four rows
+    db.sql(
+        "INSERT INTO w VALUES ('a',1.0,1),('b',2.0,2),('c',3.0,2),('d',4.0,3)"
+    )
+    t = db.sql_one("SELECT ts, sum(v) OVER (ORDER BY ts) rs FROM w ORDER BY ts, v")
+    assert t.column("rs").to_pylist() == [1.0, 6.0, 6.0, 10.0]
+    db.close()
+
+
+def test_window_in_subquery_over_aggregate(db):
+    t = db.sql_one(
+        "SELECT host, au, rank() OVER (ORDER BY au DESC) r FROM"
+        " (SELECT host, avg(usage) au FROM cpu GROUP BY host) a ORDER BY r"
+    )
+    assert t.column("host").to_pylist() == ["h4", "h2", "h1"]
+    assert t.column("r").to_pylist() == [1, 2, 3]
+
+
+def test_window_over_aggregate_rejected(db):
+    with pytest.raises(PlanError):
+        db.sql_one("SELECT host, rank() OVER (ORDER BY avg(usage)) FROM cpu GROUP BY host")
+
+
+# ---- UNION / DISTINCT -------------------------------------------------------
+
+
+def test_union_distinct_and_all(db):
+    t = db.sql_one("SELECT host FROM cpu UNION SELECT host FROM hosts ORDER BY host")
+    assert t.column("host").to_pylist() == ["h1", "h2", "h3", "h4"]
+    t = db.sql_one(
+        "SELECT host FROM cpu UNION ALL SELECT host FROM hosts ORDER BY host"
+    )
+    assert len(t.column("host")) == 7
+
+
+def test_union_order_limit_applies_to_whole(db):
+    t = db.sql_one(
+        "SELECT host FROM hosts UNION SELECT host FROM cpu ORDER BY host DESC LIMIT 2"
+    )
+    assert t.column("host").to_pylist() == ["h4", "h3"]
+
+
+def test_select_distinct(db):
+    t = db.sql_one("SELECT DISTINCT host FROM cpu ORDER BY host")
+    assert t.column("host").to_pylist() == ["h1", "h2", "h4"]
+    t = db.sql_one("SELECT DISTINCT host, usage FROM cpu ORDER BY usage")
+    assert len(t.column("host")) == 4
+
+
+def test_count_distinct(db):
+    t = db.sql_one("SELECT count(DISTINCT host) cd, count(*) n FROM cpu")
+    assert t.to_pydict() == {"cd": [3], "n": [4]}
+    t = db.sql_one(
+        "SELECT host, count(DISTINCT usage) cd FROM cpu GROUP BY host ORDER BY host"
+    )
+    assert t.to_pydict() == {"host": ["h1", "h2", "h4"], "cd": [2, 1, 1]}
+
+
+# ---- review-found regressions ----------------------------------------------
+
+
+def test_in_subquery_empty_result(db):
+    # empty set: IN -> no rows (not a crash), NOT IN -> all rows
+    t = db.sql_one(
+        "SELECT host FROM cpu WHERE host IN"
+        " (SELECT host FROM hosts WHERE region = 'nowhere')"
+    )
+    assert t.num_rows == 0
+    t = db.sql_one(
+        "SELECT count(*) n FROM cpu WHERE host NOT IN"
+        " (SELECT host FROM hosts WHERE region = 'nowhere')"
+    )
+    assert t.to_pydict() == {"n": [4]}
+
+
+def test_not_in_subquery_with_null(db, tmp_path):
+    # SQL 3-valued logic: NOT IN over a set containing NULL yields no rows
+    db.sql("CREATE TABLE nn (k STRING, v STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY(k))")
+    db.sql("INSERT INTO nn VALUES ('a', NULL, 1), ('b', 'h1', 2)")
+    t = db.sql_one("SELECT host FROM cpu WHERE host NOT IN (SELECT v FROM nn)")
+    assert t.num_rows == 0
+
+
+def test_union_stmt_reexecution(db):
+    # planning must not mutate the parsed statement (cursor/prepared reuse)
+    from greptimedb_tpu.query.sql_parser import parse_sql
+
+    stmt = parse_sql(
+        "SELECT usage FROM cpu UNION ALL SELECT usage FROM cpu ORDER BY usage DESC LIMIT 2"
+    )[0]
+    r1 = db.query_engine.execute_select(stmt, "public")
+    r2 = db.query_engine.execute_select(stmt, "public")
+    assert r1.column("usage").to_pylist() == [40.0, 40.0]
+    assert r2.column("usage").to_pylist() == [40.0, 40.0]
+
+
+# ---- EXPLAIN ANALYZE --------------------------------------------------------
+
+
+def test_explain_analyze_metrics(db):
+    t = db.sql_one("EXPLAIN ANALYZE SELECT host, avg(usage) FROM cpu GROUP BY host")
+    stages = t.column("stage").to_pylist()
+    metrics = t.column("metrics").to_pylist()
+    assert any(s.strip() == "── execution ──" for s in stages)
+    exec_meta = metrics[stages.index("── execution ──")]
+    assert "backend=" in exec_meta and "total=" in exec_meta
+    # per-stage rows are reported
+    assert any("rows=" in m for m in metrics)
+    # output row count marker present
+    assert "output" in [s.strip() for s in stages]
+
+
+def test_explain_analyze_join_tree(db):
+    t = db.sql_one(
+        "EXPLAIN ANALYZE SELECT c.host FROM cpu c JOIN hosts h ON c.host = h.host"
+    )
+    stages = [s.strip() for s in t.column("stage").to_pylist()]
+    assert "Join" in stages
+    assert stages.count("TableScan") >= 2
+
+
+def test_correlated_subquery_rejected(db):
+    # mistyped/outer alias must error, not silently bind to a local column
+    with pytest.raises(PlanError):
+        db.sql_one(
+            "SELECT host FROM cpu c WHERE EXISTS"
+            " (SELECT 1 FROM hosts h WHERE h.host = c.host)"
+        )
+    with pytest.raises(PlanError):
+        db.sql_one("SELECT z.host FROM cpu c JOIN hosts h ON c.host = h.host")
+
+
+def test_count_distinct_over_window_rejected(db):
+    from greptimedb_tpu.utils.errors import InvalidSyntaxError
+
+    with pytest.raises(InvalidSyntaxError):
+        db.sql_one("SELECT count(DISTINCT host) OVER () FROM cpu")
+
+
+def test_lag_preserves_real_nulls(db, tmp_path):
+    d2 = Database(data_home=str(tmp_path / "lagnull"))
+    d2.sql("CREATE TABLE ln (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(k))")
+    d2.sql("INSERT INTO ln VALUES ('a', 5.0, 1), ('b', NULL, 2), ('c', 7.0, 3)")
+    t = d2.sql_one("SELECT lag(v, 1, -1.0) OVER (ORDER BY ts) lg FROM ln ORDER BY ts")
+    # first row: out of partition -> default; third row: predecessor is a
+    # REAL NULL and must stay NULL
+    assert t.column("lg").to_pylist() == [-1.0, 5.0, None]
+    d2.close()
+
+
+def test_qualified_single_table_pushdown(db):
+    # alias-qualified predicates keep scan pushdown (time_range + filters)
+    from greptimedb_tpu.query.planner import plan_query
+    from greptimedb_tpu.query.sql_parser import parse_sql
+
+    stmt = parse_sql("SELECT m.host FROM cpu m WHERE m.ts < 5000 AND m.host = 'h1'")[0]
+    plan, _ = plan_query(stmt, db._schema_of, "public")
+    node = plan
+    while node.children():
+        node = node.children()[0]
+    assert node.filters == [("host", "=", "h1")]
+    assert node.time_range is not None
+
+
+def test_delete_keeps_pushdown(db):
+    # DELETE's synthetic SelectStmt (table set, no from_item) keeps pruning
+    from greptimedb_tpu.query.planner import plan_query
+    from greptimedb_tpu.query.sql_parser import SelectStmt
+    from greptimedb_tpu.query.expr import BinaryOp, Column, Literal, Star
+
+    sel = SelectStmt(
+        projections=[Star()],
+        table="cpu",
+        where=BinaryOp("and", BinaryOp("=", Column("host"), Literal("h1")),
+                       BinaryOp("<", Column("ts"), Literal(5000))),
+    )
+    plan, _ = plan_query(sel, db._schema_of, "public")
+    node = plan
+    while node.children():
+        node = node.children()[0]
+    assert node.filters == [("host", "=", "h1")]
+    assert node.time_range is not None
+
+
+def test_outer_join_null_side_key(db):
+    # b.k must be NULL on unmatched rows, not coalesced to the left value
+    t = db.sql_one(
+        "SELECT c.host, h.host FROM cpu c LEFT JOIN hosts h ON c.host = h.host"
+        " ORDER BY c.host, c.ts"
+    )
+    d = t.to_pydict()
+    assert d[t.column_names[0]] == ["h1", "h1", "h2", "h4"]
+    assert d[t.column_names[1]] == ["h1", "h1", "h2", None]
+
+
+def test_join_differently_named_keys(db, tmp_path):
+    d2 = Database(data_home=str(tmp_path / "dk"))
+    d2.sql("CREATE TABLE a1 (x STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(x))")
+    d2.sql("CREATE TABLE b1 (y STRING, w DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(y))")
+    d2.sql("INSERT INTO a1 VALUES ('p', 1.0, 0), ('q', 2.0, 0)")
+    d2.sql("INSERT INTO b1 VALUES ('p', 10.0, 0)")
+    t = d2.sql_one("SELECT a1.x, b1.y, b1.w FROM a1 JOIN b1 ON a1.x = b1.y")
+    assert t.to_pydict() == {"x": ["p"], "y": ["p"], "w": [10.0]}
+    d2.close()
+
+
+def test_view_cycle_detected(db):
+    db.sql("CREATE VIEW v1 AS SELECT host FROM cpu")
+    db.sql("CREATE OR REPLACE VIEW v1 AS SELECT host FROM v1")
+    with pytest.raises(PlanError):
+        db.sql_one("SELECT * FROM v1")
+
+
+def test_offset_without_limit(db):
+    t = db.sql_one("SELECT host FROM cpu ORDER BY usage OFFSET 2")
+    assert t.column("host").to_pylist() == ["h2", "h4"]
+    t = db.sql_one(
+        "SELECT host FROM cpu UNION ALL SELECT host FROM hosts ORDER BY host OFFSET 5"
+    )
+    assert len(t.column("host")) == 2
+
+
+def test_window_desc_nulls_first(db, tmp_path):
+    d2 = Database(data_home=str(tmp_path / "wn"))
+    d2.sql("CREATE TABLE wn (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(k))")
+    d2.sql("INSERT INTO wn VALUES ('a', 1.0, 1), ('b', NULL, 2), ('c', 3.0, 3)")
+    t = d2.sql_one("SELECT k, row_number() OVER (ORDER BY v DESC) rn FROM wn ORDER BY k")
+    # DESC => NULLS FIRST (DataFusion/Postgres default)
+    assert dict(zip(t.column("k").to_pylist(), t.column("rn").to_pylist())) == {
+        "b": 1, "c": 2, "a": 3,
+    }
+    d2.close()

@@ -1,0 +1,112 @@
+"""Views + pg_catalog (reference common/meta/src/ddl/create_view.rs,
+catalog/src/system_schema/pg_catalog.rs)."""
+
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.utils.errors import GreptimeError, TableNotFoundError
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(data_home=str(tmp_path))
+    d.sql("CREATE TABLE cpu (host STRING, usage DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(host))")
+    d.sql(
+        "INSERT INTO cpu VALUES ('h1',10.0,1000),('h1',20.0,2000),('h2',30.0,1000)"
+    )
+    yield d
+    d.close()
+
+
+def test_create_and_query_view(db):
+    db.sql("CREATE VIEW busy AS SELECT host, avg(usage) au FROM cpu GROUP BY host")
+    t = db.sql_one("SELECT host, au FROM busy ORDER BY au DESC")
+    assert t.to_pydict() == {"host": ["h2", "h1"], "au": [30.0, 15.0]}
+    # views reflect base-table changes (re-planned per query)
+    db.sql("INSERT INTO cpu VALUES ('h2',90.0,3000)")
+    t = db.sql_one("SELECT au FROM busy WHERE host = 'h2'")
+    assert t.column("au").to_pylist() == [60.0]
+
+
+def test_view_with_filter_join_window(db):
+    db.sql("CREATE VIEW hot AS SELECT host, usage, ts FROM cpu WHERE usage >= 20")
+    t = db.sql_one(
+        "SELECT v.host, v.usage, rank() OVER (ORDER BY v.usage DESC) r"
+        " FROM hot v ORDER BY r"
+    )
+    assert t.column("usage").to_pylist() == [30.0, 20.0]
+
+
+def test_or_replace_and_drop(db):
+    db.sql("CREATE VIEW v1 AS SELECT host FROM cpu")
+    with pytest.raises(GreptimeError):
+        db.sql("CREATE VIEW v1 AS SELECT usage FROM cpu")
+    db.sql("CREATE OR REPLACE VIEW v1 AS SELECT usage FROM cpu")
+    t = db.sql_one("SELECT * FROM v1 LIMIT 1")
+    assert t.column_names == ["usage"]
+    db.sql("DROP VIEW v1")
+    with pytest.raises(GreptimeError):
+        db.sql_one("SELECT * FROM v1")
+    db.sql("DROP VIEW IF EXISTS v1")  # no error
+    with pytest.raises(TableNotFoundError):
+        db.sql("DROP VIEW v1")
+
+
+def test_view_validates_at_create(db):
+    with pytest.raises(GreptimeError):
+        db.sql("CREATE VIEW bad AS SELECT nope FROM missing_table")
+
+
+def test_show_views_and_show_create(db):
+    db.sql("CREATE VIEW v_a AS SELECT host FROM cpu")
+    db.sql("CREATE VIEW v_b AS SELECT usage FROM cpu")
+    t = db.sql_one("SHOW VIEWS")
+    assert t.column("Views").to_pylist() == ["v_a", "v_b"]
+    t = db.sql_one("SHOW CREATE VIEW v_a")
+    assert "SELECT host FROM cpu" in t.column("Create View").to_pylist()[0]
+
+
+def test_information_schema_views(db):
+    db.sql("CREATE VIEW v AS SELECT host FROM cpu")
+    t = db.sql_one(
+        "SELECT table_name, view_definition FROM information_schema.views"
+    )
+    assert t.column("table_name").to_pylist() == ["v"]
+    assert "SELECT host FROM cpu" in t.column("view_definition").to_pylist()[0]
+
+
+def test_view_persists_across_restart(tmp_path):
+    d1 = Database(data_home=str(tmp_path))
+    d1.sql("CREATE TABLE t (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(k))")
+    d1.sql("INSERT INTO t VALUES ('a', 1.5, 1)")
+    d1.sql("CREATE VIEW vv AS SELECT k, v FROM t")
+    d1.close()
+    d2 = Database(data_home=str(tmp_path))
+    t = d2.sql_one("SELECT v FROM vv")
+    assert t.column("v").to_pylist() == [1.5]
+    d2.close()
+
+
+def test_pg_catalog_tables(db):
+    db.sql("CREATE VIEW v AS SELECT host FROM cpu")
+    t = db.sql_one(
+        "SELECT relname, relkind FROM pg_catalog.pg_class ORDER BY relname"
+    )
+    d = dict(zip(t.column("relname").to_pylist(), t.column("relkind").to_pylist()))
+    assert d["cpu"] == "r"
+    assert d["v"] == "v"
+    ns = db.sql_one("SELECT nspname FROM pg_catalog.pg_namespace")
+    assert "public" in ns.column("nspname").to_pylist()
+    ty = db.sql_one("SELECT typname FROM pg_catalog.pg_type WHERE oid = 25")
+    assert ty.column("typname").to_pylist() == ["text"]
+    dbs = db.sql_one("SELECT datname FROM pg_catalog.pg_database")
+    assert "public" in dbs.column("datname").to_pylist()
+
+
+def test_pg_class_join_pg_namespace(db):
+    t = db.sql_one(
+        "SELECT c.relname FROM pg_catalog.pg_class c"
+        " JOIN pg_catalog.pg_namespace n ON c.relnamespace = n.oid"
+        " WHERE n.nspname = 'public' ORDER BY c.relname"
+    )
+    assert "cpu" in t.column("relname").to_pylist()
