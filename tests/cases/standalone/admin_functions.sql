@@ -1,0 +1,16 @@
+-- ADMIN maintenance functions
+CREATE TABLE adm (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO adm VALUES ('a', 1.0, 0), ('b', 2.0, 1000);
+
+ADMIN flush_table('adm');
+
+SELECT count(*) FROM adm;
+
+INSERT INTO adm VALUES ('c', 3.0, 2000);
+
+ADMIN compact_table('adm');
+
+SELECT count(*) FROM adm;
+
+DROP TABLE adm;

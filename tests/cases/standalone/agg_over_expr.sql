@@ -1,0 +1,14 @@
+-- aggregates over expressions and expressions over aggregates
+CREATE TABLE ae (k STRING, g STRING, v DOUBLE, w DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO ae VALUES ('a', 'x', 1.0, 10.0, 0), ('b', 'x', 2.0, 20.0, 1000), ('c', 'y', 3.0, 30.0, 2000);
+
+SELECT g, sum(v * w) FROM ae GROUP BY g ORDER BY g;
+
+SELECT g, round(avg(v), 2) AS a FROM ae GROUP BY g ORDER BY g;
+
+SELECT g, max(v) - min(v) AS spread FROM ae GROUP BY g ORDER BY g;
+
+SELECT g, sum(v) / sum(w) AS ratio FROM ae GROUP BY g ORDER BY g;
+
+DROP TABLE ae;

@@ -1,0 +1,12 @@
+-- append_mode tables keep duplicates (log/trace ingest shape)
+CREATE TABLE am (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k)) WITH (append_mode = 'true');
+
+INSERT INTO am VALUES ('a', 1.0, 1000);
+
+INSERT INTO am VALUES ('a', 2.0, 1000);
+
+SELECT count(*) FROM am;
+
+SELECT k, v FROM am ORDER BY v;
+
+DROP TABLE am;

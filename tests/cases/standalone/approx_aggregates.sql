@@ -1,0 +1,12 @@
+-- approximate aggregates: hll, uddsketch percentile
+CREATE TABLE ap (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO ap VALUES ('a', 1.0, 0), ('b', 2.0, 1000), ('c', 3.0, 2000), ('d', 4.0, 3000), ('e', 5.0, 4000);
+
+SELECT hll_count(hll(k)) FROM ap;
+
+SELECT round(uddsketch_calc(0.5, uddsketch_state(128, 0.01, v)), 1) FROM ap;
+
+SELECT approx_percentile_cont(v) FROM ap;
+
+DROP TABLE ap;

@@ -1,0 +1,14 @@
+-- CASE expressions and :: casts
+CREATE TABLE cc (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO cc VALUES ('a', 1.0, 0), ('b', 25.0, 1000), ('c', 90.0, 2000);
+
+SELECT k, CASE WHEN v < 10 THEN 'low' WHEN v < 50 THEN 'mid' ELSE 'high' END AS band FROM cc ORDER BY k;
+
+SELECT k, CASE WHEN v > 50 THEN v ELSE NULL END AS big FROM cc ORDER BY k;
+
+SELECT v::bigint AS i FROM cc ORDER BY i;
+
+SELECT '42'::int + 1;
+
+DROP TABLE cc;

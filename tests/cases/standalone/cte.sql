@@ -1,0 +1,12 @@
+-- WITH common table expressions
+CREATE TABLE wt (k STRING, g STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO wt VALUES ('a', 'x', 1.0, 0), ('b', 'x', 3.0, 1000), ('c', 'y', 5.0, 2000);
+
+WITH s AS (SELECT g, sum(v) AS sv FROM wt GROUP BY g) SELECT g, sv FROM s ORDER BY g;
+
+WITH s AS (SELECT g, sum(v) AS sv FROM wt GROUP BY g), t AS (SELECT g FROM s WHERE sv > 3) SELECT g FROM t ORDER BY g;
+
+WITH s AS (SELECT v FROM wt WHERE g = 'x') SELECT count(*) FROM s;
+
+DROP TABLE wt;

@@ -1,0 +1,16 @@
+-- last-write-wins dedup on (primary key, timestamp)
+CREATE TABLE du (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO du VALUES ('a', 1.0, 1000);
+
+INSERT INTO du VALUES ('a', 2.0, 1000);
+
+SELECT k, v, ts FROM du;
+
+INSERT INTO du VALUES ('a', 3.0, 2000), ('a', 4.0, 2000);
+
+SELECT k, v, ts FROM du ORDER BY ts;
+
+SELECT count(*) FROM du;
+
+DROP TABLE du;

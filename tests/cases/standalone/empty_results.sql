@@ -1,0 +1,18 @@
+-- empty-input behaviors
+CREATE TABLE er (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+SELECT * FROM er;
+
+SELECT count(*), sum(v), avg(v) FROM er;
+
+SELECT k, sum(v) FROM er GROUP BY k;
+
+SELECT k FROM er ORDER BY v LIMIT 5;
+
+INSERT INTO er VALUES ('a', 1.0, 0);
+
+DELETE FROM er WHERE k = 'a';
+
+SELECT count(*) FROM er;
+
+DROP TABLE er;

@@ -1,0 +1,16 @@
+-- expression evaluation: precedence, aliasing, projection arithmetic
+CREATE TABLE ex (k STRING, a DOUBLE, b DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO ex VALUES ('x', 2.0, 3.0, 0), ('y', 4.0, 5.0, 1000);
+
+SELECT k, a + b * 2 FROM ex ORDER BY k;
+
+SELECT k, (a + b) * 2 AS t FROM ex ORDER BY t;
+
+SELECT k, -a, a - -b FROM ex ORDER BY k;
+
+SELECT k, a > 2 OR b < 4 FROM ex ORDER BY k;
+
+SELECT 1 + 2 * 3, (1 + 2) * 3, 10 / 4, 10 % 3;
+
+DROP TABLE ex;

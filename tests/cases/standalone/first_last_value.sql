@@ -1,0 +1,10 @@
+-- first/last value aggregates
+CREATE TABLE fl (host STRING, v DOUBLE, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY (host));
+
+INSERT INTO fl VALUES ('a', 1.0, 0), ('a', 2.0, 1000), ('a', 3.0, 2000), ('b', 10.0, 0), ('b', 30.0, 2000);
+
+SELECT host, last_value(v ORDER BY ts) FROM fl GROUP BY host ORDER BY host;
+
+SELECT host, first_value(v ORDER BY ts) FROM fl GROUP BY host ORDER BY host;
+
+DROP TABLE fl;

@@ -1,0 +1,18 @@
+-- flow: streaming materialized view
+CREATE TABLE src (host STRING, v DOUBLE, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY (host));
+
+CREATE TABLE sink (host STRING, sv DOUBLE, window_start TIMESTAMP(3) TIME INDEX, PRIMARY KEY (host));
+
+CREATE FLOW f1 SINK TO sink AS SELECT host, sum(v) AS sv, time_bucket('10s', ts) AS window_start FROM src GROUP BY host, window_start;
+
+INSERT INTO src VALUES ('a', 1.0, 0), ('a', 2.0, 1000), ('b', 5.0, 2000);
+
+ADMIN flush_flow('f1');
+
+SELECT host, sv FROM sink ORDER BY host;
+
+DROP FLOW f1;
+
+DROP TABLE src;
+
+DROP TABLE sink;

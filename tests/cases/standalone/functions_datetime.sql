@@ -1,0 +1,14 @@
+-- date/time scalar functions
+CREATE TABLE fd (k STRING, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO fd VALUES ('a', 0), ('b', 86400000), ('c', 90061000);
+
+SELECT k, date_trunc('day', ts) FROM fd ORDER BY k;
+
+SELECT k, year(ts), month(ts), day(ts), hour(ts) FROM fd ORDER BY k;
+
+SELECT k, date_part('year', ts), date_part('doy', ts) FROM fd ORDER BY k;
+
+SELECT to_unixtime('1970-01-02 00:00:00');
+
+DROP TABLE fd;

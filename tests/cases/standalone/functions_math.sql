@@ -1,0 +1,16 @@
+-- math scalar functions
+CREATE TABLE fm (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO fm VALUES ('a', -2.7, 0), ('b', 3.2, 1000), ('c', 16.0, 2000);
+
+SELECT k, abs(v), ceil(v), floor(v), round(v) FROM fm ORDER BY k;
+
+SELECT k, sqrt(v) FROM fm WHERE v > 0 ORDER BY k;
+
+SELECT round(3.14159, 2);
+
+SELECT power(2, 10), mod(10, 3);
+
+SELECT clamp(5.0, 0.0, 3.0);
+
+DROP TABLE fm;

@@ -1,0 +1,14 @@
+-- GROUP BY forms
+CREATE TABLE gb (k STRING, g STRING, h STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO gb VALUES ('a', 'x', 'p', 1.0, 0), ('b', 'x', 'q', 2.0, 1000), ('c', 'y', 'p', 3.0, 2000);
+
+SELECT g, h, sum(v) FROM gb GROUP BY g, h ORDER BY g, h;
+
+SELECT g, count(*) FROM gb GROUP BY 1 ORDER BY g;
+
+SELECT g, sum(v) AS s FROM gb GROUP BY g HAVING count(*) > 1 ORDER BY g;
+
+SELECT sum(v), max(v), min(v) FROM gb;
+
+DROP TABLE gb;

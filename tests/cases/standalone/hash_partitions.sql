@@ -1,0 +1,12 @@
+-- hash-partitioned tables
+CREATE TABLE hp (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (host)) PARTITION BY HASH(host) PARTITIONS 4;
+
+INSERT INTO hp VALUES ('h1', 1.0, 0), ('h2', 2.0, 0), ('h3', 3.0, 0), ('h4', 4.0, 0), ('h5', 5.0, 0);
+
+SELECT count(*) FROM hp;
+
+SELECT host, v FROM hp ORDER BY host;
+
+SELECT sum(v) FROM hp WHERE host = 'h3';
+
+DROP TABLE hp;

@@ -1,0 +1,12 @@
+-- information_schema introspection
+CREATE TABLE isc (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (host));
+
+SELECT table_name, table_type FROM information_schema.tables WHERE table_schema = 'public' ORDER BY table_name;
+
+SELECT column_name, semantic_type FROM information_schema.columns WHERE table_name = 'isc' ORDER BY column_name;
+
+SELECT schema_name FROM information_schema.schemata WHERE schema_name = 'public';
+
+SELECT engine, support FROM information_schema.engines ORDER BY engine;
+
+DROP TABLE isc;

@@ -1,0 +1,12 @@
+-- inserts: explicit columns, NULLs, defaults
+CREATE TABLE ind (k STRING, a DOUBLE, b DOUBLE DEFAULT 7.5, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO ind (k, a, ts) VALUES ('x', 1.0, 0);
+
+INSERT INTO ind VALUES ('y', NULL, 2.0, 1000);
+
+SELECT k, a, b FROM ind ORDER BY k;
+
+SELECT count(a), count(b), count(*) FROM ind;
+
+DROP TABLE ind;

@@ -1,0 +1,10 @@
+-- INTERVAL literals in expressions and filters
+CREATE TABLE il (k STRING, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO il VALUES ('a', 0), ('b', 3600000), ('c', 7200000);
+
+SELECT time_bucket('1h', ts) AS b, count(*) FROM il GROUP BY b ORDER BY b;
+
+SELECT date_bin(INTERVAL '1 hour', ts) AS b, count(*) FROM il GROUP BY b ORDER BY b;
+
+DROP TABLE il;

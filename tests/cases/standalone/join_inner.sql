@@ -1,0 +1,18 @@
+-- inner joins
+CREATE TABLE jm (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (host));
+
+CREATE TABLE jh (host STRING, region STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY (host));
+
+INSERT INTO jm VALUES ('h1', 10.0, 0), ('h2', 20.0, 1000), ('h3', 30.0, 2000);
+
+INSERT INTO jh VALUES ('h1', 'west', 0), ('h2', 'east', 0);
+
+SELECT m.host, m.v, h.region FROM jm m JOIN jh h ON m.host = h.host ORDER BY m.host;
+
+SELECT m.host, h.region FROM jm m INNER JOIN jh h ON m.host = h.host AND m.v > 15 ORDER BY m.host;
+
+SELECT host, region FROM jm JOIN jh USING (host) ORDER BY host;
+
+DROP TABLE jm;
+
+DROP TABLE jh;

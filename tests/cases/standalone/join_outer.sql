@@ -1,0 +1,20 @@
+-- outer joins
+CREATE TABLE jo1 (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+CREATE TABLE jo2 (k STRING, w DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO jo1 VALUES ('a', 1.0, 0), ('b', 2.0, 1000);
+
+INSERT INTO jo2 VALUES ('b', 20.0, 0), ('c', 30.0, 1000);
+
+SELECT l.k, l.v, r.w FROM jo1 l LEFT JOIN jo2 r ON l.k = r.k ORDER BY l.k;
+
+SELECT r.k, l.v, r.w FROM jo1 l RIGHT JOIN jo2 r ON l.k = r.k ORDER BY r.k;
+
+SELECT count(*) FROM jo1 l FULL JOIN jo2 r ON l.k = r.k;
+
+SELECT count(*) FROM jo1 CROSS JOIN jo2;
+
+DROP TABLE jo1;
+
+DROP TABLE jo2;

@@ -1,0 +1,22 @@
+-- three-table joins
+CREATE TABLE ja (k STRING, a DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+CREATE TABLE jb (k STRING, b DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+CREATE TABLE jc (k STRING, c DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO ja VALUES ('x', 1.0, 0), ('y', 2.0, 0);
+
+INSERT INTO jb VALUES ('x', 10.0, 0), ('y', 20.0, 0);
+
+INSERT INTO jc VALUES ('x', 100.0, 0);
+
+SELECT ja.k, ja.a, jb.b, jc.c FROM ja JOIN jb ON ja.k = jb.k JOIN jc ON jb.k = jc.k ORDER BY ja.k;
+
+SELECT ja.k, jc.c FROM ja JOIN jb ON ja.k = jb.k LEFT JOIN jc ON jb.k = jc.k ORDER BY ja.k;
+
+DROP TABLE ja;
+
+DROP TABLE jb;
+
+DROP TABLE jc;

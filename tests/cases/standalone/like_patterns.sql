@@ -1,0 +1,14 @@
+-- LIKE pattern matching
+CREATE TABLE lk (k STRING, s STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO lk VALUES ('a', 'apple', 0), ('b', 'banana', 1000), ('c', 'apricot', 2000), ('d', 'cherry', 3000);
+
+SELECT k FROM lk WHERE s LIKE 'ap%' ORDER BY k;
+
+SELECT k FROM lk WHERE s LIKE '%an%' ORDER BY k;
+
+SELECT k FROM lk WHERE s LIKE '_pple' ORDER BY k;
+
+SELECT k FROM lk WHERE s NOT LIKE 'ap%' ORDER BY k;
+
+DROP TABLE lk;

@@ -1,0 +1,14 @@
+-- log-style filtering over string fields
+CREATE TABLE lg (ts TIMESTAMP(3) TIME INDEX, level STRING, msg STRING);
+
+INSERT INTO lg VALUES (0, 'info', 'service started'), (1000, 'error', 'connection refused'), (2000, 'error', 'timeout after 30s'), (3000, 'warn', 'slow query');
+
+SELECT msg FROM lg WHERE level = 'error' ORDER BY ts;
+
+SELECT level, count(*) FROM lg GROUP BY level ORDER BY level;
+
+SELECT msg FROM lg WHERE msg LIKE '%time%' ORDER BY ts;
+
+SELECT count(*) FROM lg WHERE level IN ('error', 'warn');
+
+DROP TABLE lg;

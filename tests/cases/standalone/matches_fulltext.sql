@@ -1,0 +1,16 @@
+-- fulltext matches() / matches_term()
+CREATE TABLE ml (ts TIMESTAMP TIME INDEX, msg STRING FULLTEXT INDEX);
+
+INSERT INTO ml VALUES (0, 'error: disk full on /var'), (1000, 'warn: retry scheduled'), (2000, 'fatal error while writing');
+
+SELECT msg FROM ml WHERE matches(msg, 'error') ORDER BY ts;
+
+SELECT msg FROM ml WHERE matches(msg, 'error -disk') ORDER BY ts;
+
+SELECT msg FROM ml WHERE matches(msg, '"disk full"') ORDER BY ts;
+
+SELECT msg FROM ml WHERE matches_term(msg, 'retry') ORDER BY ts;
+
+SELECT msg FROM ml WHERE matches(msg, 'warn OR fatal') ORDER BY ts;
+
+DROP TABLE ml;

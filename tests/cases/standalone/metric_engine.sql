@@ -1,0 +1,14 @@
+-- metric engine: physical + logical tables
+CREATE TABLE phy (greptime_timestamp TIMESTAMP(3) TIME INDEX, greptime_value DOUBLE) WITH (physical_metric_table = 'true');
+
+CREATE TABLE m1 (greptime_timestamp TIMESTAMP(3) TIME INDEX, greptime_value DOUBLE, host STRING PRIMARY KEY) WITH (on_physical_table = 'phy');
+
+INSERT INTO m1 VALUES (0, 1.5, 'h1'), (1000, 2.5, 'h2');
+
+SELECT host, greptime_value FROM m1 ORDER BY host;
+
+SELECT count(*) FROM m1;
+
+DROP TABLE m1;
+
+DROP TABLE phy;

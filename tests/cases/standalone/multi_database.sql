@@ -1,0 +1,16 @@
+-- databases and cross-database references
+CREATE DATABASE db_a;
+
+CREATE TABLE db_a.t (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO db_a.t VALUES ('a', 1.0, 0);
+
+SELECT k, v FROM db_a.t;
+
+USE db_a;
+
+SELECT count(*) FROM t;
+
+USE public;
+
+DROP DATABASE db_a;

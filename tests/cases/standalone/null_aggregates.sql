@@ -1,0 +1,14 @@
+-- NULL semantics in aggregates
+CREATE TABLE na (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO na VALUES ('a', NULL, 0), ('b', NULL, 1000);
+
+SELECT count(*), count(v), sum(v), avg(v), min(v), max(v) FROM na;
+
+INSERT INTO na VALUES ('c', 5.0, 2000);
+
+SELECT count(*), count(v), sum(v), avg(v) FROM na;
+
+SELECT k FROM na WHERE v > 0;
+
+DROP TABLE na;

@@ -1,0 +1,12 @@
+-- pg_catalog compatibility
+CREATE TABLE pgc (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (host));
+
+SELECT relname, relkind FROM pg_catalog.pg_class WHERE relname = 'pgc';
+
+SELECT nspname FROM pg_catalog.pg_namespace WHERE nspname = 'public';
+
+SELECT typname FROM pg_catalog.pg_type WHERE oid = 25;
+
+SELECT c.relname FROM pg_catalog.pg_class c JOIN pg_catalog.pg_namespace n ON c.relnamespace = n.oid WHERE n.nspname = 'public' AND c.relname = 'pgc';
+
+DROP TABLE pgc;

@@ -1,0 +1,10 @@
+-- PromQL rate/increase over counters via TQL
+CREATE TABLE pr (host STRING, greptime_value DOUBLE, greptime_timestamp TIMESTAMP(3) TIME INDEX, PRIMARY KEY (host));
+
+INSERT INTO pr VALUES ('a', 0.0, 0), ('a', 30.0, 30000), ('a', 60.0, 60000), ('a', 90.0, 90000);
+
+TQL EVAL (60, 90, '30s') rate(pr[1m]);
+
+TQL EVAL (60, 90, '30s') increase(pr[1m]);
+
+DROP TABLE pr;

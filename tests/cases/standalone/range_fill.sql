@@ -1,0 +1,12 @@
+-- RANGE fill policies
+CREATE TABLE rf (host STRING, v DOUBLE, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY (host));
+
+INSERT INTO rf VALUES ('a', 1.0, 0), ('a', 5.0, 120000);
+
+SELECT ts, host, max(v) RANGE '1m' FILL PREV FROM rf ALIGN '1m' ORDER BY ts;
+
+SELECT ts, host, max(v) RANGE '1m' FILL LINEAR FROM rf ALIGN '1m' ORDER BY ts;
+
+SELECT ts, host, max(v) RANGE '1m' FILL 0 FROM rf ALIGN '1m' ORDER BY ts;
+
+DROP TABLE rf;

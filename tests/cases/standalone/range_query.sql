@@ -1,0 +1,14 @@
+-- RANGE ... ALIGN queries
+CREATE TABLE rq (host STRING, v DOUBLE, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY (host));
+
+INSERT INTO rq VALUES
+  ('a', 1.0, 0), ('a', 2.0, 30000), ('a', 3.0, 60000), ('a', 4.0, 90000),
+  ('b', 10.0, 0), ('b', 20.0, 60000);
+
+SELECT ts, host, max(v) RANGE '1m' FROM rq ALIGN '1m' ORDER BY host, ts;
+
+SELECT ts, host, sum(v) RANGE '2m' FROM rq ALIGN '1m' ORDER BY host, ts;
+
+SELECT ts, host, min(v) RANGE '1m' FILL NULL FROM rq ALIGN '30s' ORDER BY host, ts;
+
+DROP TABLE rq;

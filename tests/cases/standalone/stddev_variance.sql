@@ -1,0 +1,12 @@
+-- statistical aggregates
+CREATE TABLE sv (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO sv VALUES ('a', 2.0, 0), ('b', 4.0, 1000), ('c', 4.0, 2000), ('d', 4.0, 3000), ('e', 5.0, 4000), ('f', 5.0, 5000), ('g', 7.0, 6000), ('h', 9.0, 7000);
+
+SELECT round(stddev(v), 4) FROM sv;
+
+SELECT round(var(v), 4) FROM sv;
+
+SELECT round(stddev_pop(v), 4) FROM sv;
+
+DROP TABLE sv;

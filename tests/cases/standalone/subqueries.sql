@@ -1,0 +1,18 @@
+-- scalar / IN / EXISTS subqueries
+CREATE TABLE sq (k STRING, g STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO sq VALUES ('a', 'x', 1.0, 0), ('b', 'x', 2.0, 1000), ('c', 'y', 9.0, 2000);
+
+SELECT k, v FROM sq WHERE v > (SELECT avg(v) FROM sq) ORDER BY k;
+
+SELECT k FROM sq WHERE g IN (SELECT g FROM sq WHERE v > 5) ORDER BY k;
+
+SELECT k FROM sq WHERE g NOT IN (SELECT g FROM sq WHERE v > 5) ORDER BY k;
+
+SELECT count(*) FROM sq WHERE EXISTS (SELECT 1 FROM sq WHERE v > 100);
+
+SELECT (SELECT max(v) FROM sq) AS mx;
+
+SELECT g, avg(v) AS a FROM (SELECT g, v FROM sq WHERE v < 5) t GROUP BY g ORDER BY g;
+
+DROP TABLE sq;

@@ -1,0 +1,14 @@
+-- time_bucket / date_bin grouping
+CREATE TABLE tb (host STRING, v DOUBLE, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY (host));
+
+INSERT INTO tb VALUES
+  ('a', 1.0, 0), ('a', 2.0, 30000), ('a', 4.0, 61000),
+  ('b', 8.0, 0), ('b', 16.0, 95000);
+
+SELECT time_bucket('1m', ts) AS b, sum(v) FROM tb GROUP BY b ORDER BY b;
+
+SELECT time_bucket('1m', ts) AS b, host, max(v) FROM tb GROUP BY b, host ORDER BY b, host;
+
+SELECT date_bin(INTERVAL '30s', ts) AS b, count(*) FROM tb GROUP BY b ORDER BY b;
+
+DROP TABLE tb;

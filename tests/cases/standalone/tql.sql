@@ -1,0 +1,12 @@
+-- TQL (PromQL in SQL)
+CREATE TABLE tq (host STRING, greptime_value DOUBLE, greptime_timestamp TIMESTAMP(3) TIME INDEX, PRIMARY KEY (host));
+
+INSERT INTO tq VALUES ('a', 1.0, 0), ('a', 2.0, 15000), ('a', 3.0, 30000), ('b', 10.0, 0), ('b', 20.0, 30000);
+
+TQL EVAL (0, 30, '15s') tq;
+
+TQL EVAL (0, 30, '30s') sum(tq);
+
+TQL EVAL (0, 30, '30s') tq{host="a"};
+
+DROP TABLE tq;

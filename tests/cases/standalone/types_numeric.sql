@@ -1,0 +1,12 @@
+-- numeric type coverage: ints, floats, arithmetic, overflow-free ranges
+CREATE TABLE tn (k STRING, i8 TINYINT, i16 SMALLINT, i32 INT, i64 BIGINT, f32 FLOAT, f64 DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO tn VALUES ('a', 1, 100, 100000, 10000000000, 1.5, 2.25, 0), ('b', -1, -100, -100000, -10000000000, -1.5, -2.25, 1000);
+
+SELECT k, i8, i16, i32, i64 FROM tn ORDER BY k;
+
+SELECT k, f32, f64, f64 * 2, f64 + f32 FROM tn ORDER BY k;
+
+SELECT k, i32 / 4, i32 % 7 FROM tn ORDER BY k;
+
+DROP TABLE tn;

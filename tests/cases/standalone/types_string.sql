@@ -1,0 +1,14 @@
+-- string type + functions
+CREATE TABLE ts1 (k STRING, s STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO ts1 VALUES ('a', 'Hello World', 0), ('b', 'greptime', 1000), ('c', NULL, 2000);
+
+SELECT k, upper(s), lower(s), length(s) FROM ts1 ORDER BY k;
+
+SELECT k FROM ts1 WHERE s LIKE 'He%' ORDER BY k;
+
+SELECT k, concat(s, '!') FROM ts1 WHERE s IS NOT NULL ORDER BY k;
+
+SELECT k, substr(s, 1, 5) FROM ts1 WHERE k = 'a';
+
+DROP TABLE ts1;

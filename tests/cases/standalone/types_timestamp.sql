@@ -1,0 +1,12 @@
+-- timestamp precisions and comparisons
+CREATE TABLE tt (k STRING, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO tt VALUES ('a', 1000), ('b', 2000), ('c', 3000);
+
+SELECT k, ts FROM tt WHERE ts > 1000 ORDER BY ts;
+
+SELECT k FROM tt WHERE ts >= '1970-01-01 00:00:02' ORDER BY k;
+
+SELECT count(*) FROM tt WHERE ts BETWEEN 1000 AND 2000;
+
+DROP TABLE tt;

@@ -1,0 +1,20 @@
+-- views
+CREATE TABLE vt (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (host));
+
+INSERT INTO vt VALUES ('h1', 10.0, 0), ('h2', 20.0, 1000);
+
+CREATE VIEW vv AS SELECT host, v FROM vt WHERE v > 15;
+
+SELECT * FROM vv ORDER BY host;
+
+SHOW VIEWS;
+
+CREATE OR REPLACE VIEW vv AS SELECT host FROM vt;
+
+SELECT * FROM vv ORDER BY host;
+
+SELECT table_name FROM information_schema.views;
+
+DROP VIEW vv;
+
+DROP TABLE vt;

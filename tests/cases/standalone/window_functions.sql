@@ -1,0 +1,18 @@
+-- window functions
+CREATE TABLE wf (k STRING, g STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO wf VALUES ('a', 'x', 1.0, 0), ('b', 'x', 2.0, 1000), ('c', 'y', 3.0, 2000), ('d', 'y', 4.0, 3000);
+
+SELECT k, row_number() OVER (ORDER BY v) AS rn FROM wf ORDER BY k;
+
+SELECT k, rank() OVER (ORDER BY g) AS r, dense_rank() OVER (ORDER BY g) AS dr FROM wf ORDER BY k;
+
+SELECT k, sum(v) OVER (PARTITION BY g ORDER BY ts) AS rs FROM wf ORDER BY k;
+
+SELECT k, avg(v) OVER (PARTITION BY g) AS pa FROM wf ORDER BY k;
+
+SELECT k, lag(v) OVER (ORDER BY ts) AS lg, lead(v) OVER (ORDER BY ts) AS ld FROM wf ORDER BY k;
+
+SELECT k, first_value(v) OVER (PARTITION BY g ORDER BY ts) AS fv FROM wf ORDER BY k;
+
+DROP TABLE wf;

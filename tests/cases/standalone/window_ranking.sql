@@ -1,0 +1,14 @@
+-- ranking window functions: ntile, percent_rank, cume_dist, nth_value
+CREATE TABLE wr (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO wr VALUES ('a', 1.0, 0), ('b', 2.0, 1000), ('c', 3.0, 2000), ('d', 4.0, 3000);
+
+SELECT k, ntile(2) OVER (ORDER BY v) AS nt FROM wr ORDER BY k;
+
+SELECT k, percent_rank() OVER (ORDER BY v) AS pr FROM wr ORDER BY k;
+
+SELECT k, cume_dist() OVER (ORDER BY v) AS cd FROM wr ORDER BY k;
+
+SELECT k, nth_value(v, 2) OVER (ORDER BY v) AS nv FROM wr ORDER BY k;
+
+DROP TABLE wr;

@@ -92,24 +92,35 @@ def run_case(path: str, db) -> str:
     return "\n".join(chunks).rstrip() + "\n"
 
 
-def run_all(update: bool = False) -> list[str]:
-    """Run all cases; returns list of failure descriptions."""
+def _make_db(backend: str):
     import tempfile
 
     from greptimedb_tpu.database import Database
+    from greptimedb_tpu.utils.config import Config
 
+    cfg = Config()
+    cfg.storage.data_home = tempfile.mkdtemp()
+    cfg.query.backend = backend
+    return Database(config=cfg)
+
+
+def run_all(update: bool = False, backends: tuple[str, ...] = ("cpu", "tpu")) -> list[str]:
+    """Run all cases on every backend against ONE shared golden per case —
+    the reference's "identical result sets" bar: the TPU path must render
+    byte-identically to the authoritative CPU path (SURVEY.md section 7
+    step 3).  Goldens are regenerated from the CPU backend."""
     failures = []
     for name in sorted(os.listdir(CASES_DIR)):
         if not name.endswith(".sql"):
             continue
         case = os.path.join(CASES_DIR, name)
         golden = case[:-4] + ".result"
-        db = Database(data_home=tempfile.mkdtemp())
-        try:
-            got = run_case(case, db)
-        finally:
-            db.close()
         if update:
+            db = _make_db("cpu")
+            try:
+                got = run_case(case, db)
+            finally:
+                db.close()
             with open(golden, "w") as f:
                 f.write(got)
             continue
@@ -118,15 +129,25 @@ def run_all(update: bool = False) -> list[str]:
             continue
         with open(golden) as f:
             want = f.read()
-        if got != want:
-            import difflib
+        for backend in backends:
+            db = _make_db(backend)
+            try:
+                got = run_case(case, db)
+            finally:
+                db.close()
+            if got != want:
+                import difflib
 
-            diff = "\n".join(
-                difflib.unified_diff(
-                    want.splitlines(), got.splitlines(), "golden", "actual", lineterm=""
+                diff = "\n".join(
+                    difflib.unified_diff(
+                        want.splitlines(),
+                        got.splitlines(),
+                        "golden",
+                        f"actual[{backend}]",
+                        lineterm="",
+                    )
                 )
-            )
-            failures.append(f"{name}:\n{diff}")
+                failures.append(f"{name} [{backend}]:\n{diff}")
     return failures
 
 
