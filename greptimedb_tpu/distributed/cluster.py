@@ -58,6 +58,12 @@ class Datanode:
     def close_region(self, rid: int):
         self.engine.close_region(rid)
 
+    def flush_region(self, rid: int):
+        self.engine.flush_region(rid)
+
+    def set_region_writable(self, rid: int, writable: bool):
+        self.engine.region(rid).set_writable(writable)
+
     def write(self, rid: int, batch: pa.RecordBatch) -> int:
         if not self.alive:
             raise ConnectionError(f"datanode {self.node_id} is down")
@@ -116,6 +122,12 @@ class NodeManager:
         dn = self.cluster.datanodes.get(node_id)
         if dn is not None and dn.alive:
             dn.close_region(rid)
+
+    def flush_region(self, node_id: int, rid: int):
+        self.cluster.datanodes[node_id].flush_region(rid)
+
+    def set_region_writable(self, node_id: int, rid: int, writable: bool):
+        self.cluster.datanodes[node_id].set_region_writable(rid, writable)
 
 
 class Cluster:
@@ -230,8 +242,18 @@ class Cluster:
                     raise RetryLaterError(
                         f"region {rid} of {table!r} has no route yet; retry the write"
                     )
-                for b in part.to_batches():
-                    affected += self.datanodes[node].write(rid, b)
+                from ..utils.errors import RegionReadonlyError
+
+                try:
+                    for b in part.to_batches():
+                        affected += self.datanodes[node].write(rid, b)
+                except RegionReadonlyError as exc:
+                    # region is mid-migration (downgraded leader); the route
+                    # will move shortly — retryable, like the reference's
+                    # RegionBusy/migrating errors
+                    raise RetryLaterError(
+                        f"region {rid} of {table!r} is migrating; retry the write"
+                    ) from exc
             return affected
 
     def table_write_lock(self, database: str, table: str):
@@ -343,6 +365,12 @@ class Cluster:
         proc = ReconcileDatabaseProcedure.create(database)
         self.procedures.submit(proc)
         return proc.state["actions"]
+
+    def migrate_region(self, table: str, region_id: int, to_node: int, database: str = "public") -> str:
+        """Planned region movement to a specific datanode (reference
+        `SELECT migrate_region(...)` admin function)."""
+        meta = self.catalog.table(table, database)
+        return self.metasrv.migrate_region(meta.table_id, region_id, to_node)
 
     def kill_datanode(self, node_id: int):
         self.datanodes[node_id].kill()

@@ -1,0 +1,89 @@
+"""Metasrv leader election over the shared KV backend.
+
+Role-equivalent of the reference's `Election` trait
+(reference meta-srv/src/election.rs:132) with its etcd-lease and RDS-lock
+implementations (election/etcd.rs, election/rds/): candidates campaign by
+compare-and-put-ing a lease record under one well-known key; the holder
+renews before expiry; everyone else observes.  Clock is injected so tests
+are deterministic.
+
+The lease record is JSON: {"leader": node_id, "until_ms": t} — exactly the
+etcd lease shape (holder + TTL), CAS standing in for etcd transactions.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .kv import KvBackend
+
+ELECTION_KEY = "/election/metasrv_leader"
+
+
+class LeaseElection:
+    def __init__(self, kv: KvBackend, node_id: str, lease_ms: int = 3000, clock=None):
+        import time as _t
+
+        self.kv = kv
+        self.node_id = node_id
+        self.lease_ms = lease_ms
+        self.clock = clock or (lambda: _t.time() * 1000)
+        self._was_leader = False
+        # Callbacks fired on leadership transitions (reference re-arms the
+        # procedure manager on election, metasrv.rs:604-618).
+        self.on_leader_start: list = []
+        self.on_leader_stop: list = []
+
+    # ---- campaign ----------------------------------------------------------
+    def campaign(self) -> bool:
+        """One election round: acquire if free/expired, renew if held by us.
+        Returns whether this node is the leader after the round."""
+        now = self.clock()
+        raw = self.kv.get(ELECTION_KEY)
+        new = json.dumps({"leader": self.node_id, "until_ms": now + self.lease_ms})
+        if raw is None:
+            won = self.kv.compare_and_put(ELECTION_KEY, None, new)
+        else:
+            rec = json.loads(raw)
+            if rec["leader"] == self.node_id or rec["until_ms"] <= now:
+                won = self.kv.compare_and_put(ELECTION_KEY, raw, new)
+            else:
+                won = False
+        self._transition(won)
+        return won
+
+    def resign(self):
+        """Voluntarily drop the lease (leader restart/shutdown)."""
+        raw = self.kv.get(ELECTION_KEY)
+        if raw is not None and json.loads(raw)["leader"] == self.node_id:
+            self.kv.compare_and_put(
+                ELECTION_KEY,
+                raw,
+                json.dumps({"leader": self.node_id, "until_ms": 0}),
+            )
+        self._transition(False)
+
+    def is_leader(self) -> bool:
+        """Point-in-time check without campaigning."""
+        raw = self.kv.get(ELECTION_KEY)
+        if raw is None:
+            return False
+        rec = json.loads(raw)
+        return rec["leader"] == self.node_id and rec["until_ms"] > self.clock()
+
+    def leader(self) -> str | None:
+        raw = self.kv.get(ELECTION_KEY)
+        if raw is None:
+            return None
+        rec = json.loads(raw)
+        return rec["leader"] if rec["until_ms"] > self.clock() else None
+
+    def _transition(self, is_leader_now: bool):
+        if is_leader_now and not self._was_leader:
+            self._was_leader = True
+            for cb in self.on_leader_start:
+                cb()
+        elif not is_leader_now and self._was_leader:
+            self._was_leader = False
+            for cb in self.on_leader_stop:
+                cb()

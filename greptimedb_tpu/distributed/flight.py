@@ -121,6 +121,9 @@ class DatanodeFlightServer(fl.FlightServerBase):
         elif kind == "flush_region":
             self.engine.flush_region(body["region_id"])
             out = {"ok": True}
+        elif kind == "set_region_writable":
+            self.engine.region(body["region_id"]).set_writable(body["writable"])
+            out = {"ok": True}
         elif kind == "region_stats":
             out = {"stats": [s.__dict__ for s in self.engine.region_statistics()]}
         elif kind == "time_bounds":
@@ -182,6 +185,9 @@ class FlightDatanodeClient:
 
     def flush_region(self, rid: int):
         self._action("flush_region", {"region_id": rid})
+
+    def set_region_writable(self, rid: int, writable: bool):
+        self._action("set_region_writable", {"region_id": rid, "writable": writable})
 
     def region_stats(self) -> list:
         return self._action("region_stats", {})["stats"]
@@ -260,6 +266,12 @@ class FlightDatanode:
 
     def close_region(self, rid: int):
         self.client.close_region(rid)
+
+    def flush_region(self, rid: int):
+        self.client.flush_region(rid)
+
+    def set_region_writable(self, rid: int, writable: bool):
+        self.client.set_region_writable(rid, writable)
 
     def write(self, rid: int, batch: pa.RecordBatch) -> int:
         return self.client.write(rid, batch)

@@ -72,18 +72,94 @@ class RegionFailoverProcedure(Procedure):
         return DONE
 
 
+class RegionMigrationProcedure(Procedure):
+    """Planned region movement (reference
+    meta-srv/src/procedure/region_migration/region_migration.rs:737):
+      flush_leader -> downgrade_leader -> open_candidate (catchup via
+      shared-WAL replay) -> update_metadata -> close_downgraded.
+    State: {region_id, table_id, from_node, to_node, step}.
+
+    The candidate's open replays the WAL tail written after the leader's
+    flush — our shared WAL dir plays the reference's remote-WAL role, so
+    catchup = open.  The downgrade happens BEFORE the candidate opens:
+    the old leader stops accepting writes first, so the replayed tail is
+    complete (the reference orders downgrade before last-entry catchup
+    the same way)."""
+
+    type_name = "region_migration"
+
+    def lock_keys(self):
+        return [f"region/{self.state['region_id']}"]
+
+    def execute(self, ctx):
+        metasrv: "Metasrv" = ctx.services["metasrv"]
+        nm = metasrv.node_manager
+        rid = self.state["region_id"]
+        step = self.state.get("step", "flush_leader")
+        if step == "flush_leader":
+            nm.flush_region(self.state["from_node"], rid)
+            self.state["step"] = "downgrade_leader"
+            return EXECUTING
+        if step == "downgrade_leader":
+            nm.set_region_writable(self.state["from_node"], rid, False)
+            self.state["step"] = "open_candidate"
+            return EXECUTING
+        if step == "open_candidate":
+            nm.open_region(self.state["to_node"], rid)
+            self.state["step"] = "update_metadata"
+            return EXECUTING
+        if step == "update_metadata":
+            metasrv.update_route(self.state["table_id"], rid, self.state["to_node"])
+            self.state["step"] = "close_downgraded"
+            return EXECUTING
+        if step == "close_downgraded":
+            nm.close_region_quiet(self.state["from_node"], rid)
+            self.state["step"] = "done"
+            return DONE
+        return DONE
+
+    def rollback(self, ctx):
+        """Re-enable writes on the old leader if we failed before the route
+        moved (the candidate never became authoritative)."""
+        metasrv: "Metasrv" = ctx.services["metasrv"]
+        if self.state.get("step") in ("downgrade_leader", "open_candidate", "update_metadata"):
+            try:
+                metasrv.node_manager.set_region_writable(
+                    self.state["from_node"], self.state["region_id"], True
+                )
+            except Exception:  # noqa: BLE001 — best-effort un-fence
+                pass
+
+
 class Metasrv:
-    def __init__(self, kv: KvBackend, node_manager):
+    def __init__(self, kv: KvBackend, node_manager, election=None):
         """node_manager: gateway to datanodes (open_region/close_region...);
-        the in-process analogue of the reference's NodeManager gRPC clients."""
+        the in-process analogue of the reference's NodeManager gRPC clients.
+
+        election: optional LeaseElection.  When present, only the elected
+        leader supervises and drives procedures (reference
+        metasrv.rs:577-618); on takeover the new leader re-arms unfinished
+        procedures from the shared KV."""
         self.kv = kv
         self.node_manager = node_manager
         self.datanodes: dict[int, DatanodeInfo] = {}
         self.procedures = ProcedureManager(kv, services={"metasrv": self})
         self.procedures.register(RegionFailoverProcedure)
+        self.procedures.register(RegionMigrationProcedure)
         self._rr_counter = 0
         self._lock = threading.RLock()
         self.maintenance_mode = False
+        self.election = election
+        if election is not None:
+            election.on_leader_start.append(self._on_leader_start)
+
+    def _on_leader_start(self):
+        """Takeover: resume procedures the dead leader left mid-flight
+        (reference metasrv.rs:604-618 re-arms ProcedureManager on election)."""
+        self.procedures.recover()
+
+    def is_leader(self) -> bool:
+        return self.election is None or self.election.is_leader()
 
     # ---- membership -------------------------------------------------------
     def register_datanode(self, node_id: int):
@@ -142,11 +218,36 @@ class Metasrv:
             self.datanodes[node_id].mailbox.append(instruction)
 
     # ---- supervisor tick (reference RegionSupervisor) ---------------------
+    def migrate_region(self, table_id: int, region_id: int, to_node: int) -> str:
+        """Planned migration (reference admin fn migrate_region,
+        common/function/src/admin/migrate_region.rs)."""
+        routes = self.get_route(table_id)
+        from_node = routes.get(region_id)
+        if from_node is None:
+            raise IllegalStateError(f"region {region_id} has no route")
+        if from_node == to_node:
+            raise IllegalStateError(f"region {region_id} is already on node {to_node}")
+        with self._lock:
+            if to_node not in self.datanodes or not self.datanodes[to_node].alive:
+                raise IllegalStateError(f"target datanode {to_node} is not alive")
+        proc = RegionMigrationProcedure(
+            state={
+                "region_id": region_id,
+                "table_id": table_id,
+                "from_node": from_node,
+                "to_node": to_node,
+            }
+        )
+        return self.procedures.submit(proc)
+
+    # ---- supervisor tick (reference RegionSupervisor) ---------------------
     def tick(self, now_ms: float) -> list[str]:
         """Detect failed datanodes and fail their regions over; returns
         submitted procedure ids."""
         if self.maintenance_mode:
             return []
+        if not self.is_leader():
+            return []  # followers observe; only the leader supervises
         submitted = []
         with self._lock:
             suspects = [

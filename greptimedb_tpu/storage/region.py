@@ -541,8 +541,12 @@ class Region:
             self.memtable = Memtable(new_schema, self.time_partition_ms)
 
     def set_writable(self, writable: bool):
-        """Leader/follower role flip (reference set_region_role)."""
-        self.writable = writable
+        """Leader/follower role flip (reference set_region_role).  Takes
+        the region lock so a downgrade returns only after in-flight writes
+        finish their WAL append — the migration candidate's catch-up replay
+        must never race a torn tail."""
+        with self._lock:
+            self.writable = writable
 
     def stat(self) -> RegionStat:
         m = self.manifest_mgr.manifest
