@@ -126,6 +126,7 @@ class Database:
             config=self.config.query,
             tile_context_provider=self._tile_context,
             view_provider=self._view_stmt,
+            vector_search_provider=self._vector_search,
         )
         self._reopen_regions()
 
@@ -329,14 +330,27 @@ class Database:
                 sem = SemanticType.TAG
             else:
                 sem = SemanticType.FIELD
+            dt = ConcreteDataType.parse(c.type_name)
+            vdim = None
+            if dt == ConcreteDataType.VECTOR:
+                import re as _re
+
+                m = _re.match(r"vector\s*\(\s*(\d+)\s*\)", c.type_name.strip().lower())
+                if not m:
+                    raise InvalidArgumentsError(
+                        f"VECTOR column {c.name!r} needs a dimension: VECTOR(n)"
+                    )
+                vdim = int(m.group(1))
             columns.append(
                 ColumnSchema(
                     name=c.name,
-                    data_type=ConcreteDataType.parse(c.type_name),
+                    data_type=dt,
                     semantic_type=sem,
                     nullable=c.nullable and sem == SemanticType.FIELD,
                     default=c.default,
                     fulltext=getattr(c, "fulltext", False),
+                    vector_dim=vdim,
+                    vector_index=getattr(c, "vector_index", False),
                 )
             )
         if time_index is None:
@@ -346,6 +360,21 @@ class Database:
         if stmt.partition_by_hash is not None:
             cols, n = stmt.partition_by_hash
             rule = HashPartitionRule(cols, n)
+        elif stmt.partition_on_columns is not None:
+            from .models.partition import MultiDimPartitionRule
+
+            pcols, pexprs = stmt.partition_on_columns
+            if pexprs:
+                from .query.expr import to_sql
+
+                for pc_name in pcols:
+                    if not schema.has_column(pc_name):
+                        raise InvalidArgumentsError(
+                            f"partition column {pc_name!r} is not a table column"
+                        )
+                # fully-parenthesized rendering: the rule text must re-parse
+                # to the same tree (name() drops OR/AND grouping)
+                rule = MultiDimPartitionRule(pcols, [to_sql(e) for e in pexprs])
         self.catalog.create_table(
             stmt.name,
             schema,
@@ -933,6 +962,70 @@ class Database:
             append_mode=any(r.append_mode for r in regions),
         )
 
+    def _vector_search(self, vs) -> pa.Table:
+        """Top-k nearest rows for a VectorSearch node.
+
+        Append-mode regions consult the per-SST IVF index (reference
+        vector-index applier): distances are computed only over the probed
+        candidate rows; dedup-mode regions rank the authoritative merged
+        scan (last-write-wins must win before ranking).  Rows with NULL
+        vectors are excluded from the top-k, like the reference's index
+        search."""
+        import numpy as np
+
+        from .query.vector import decode_matrix, distances
+        from .storage.sst import INDEX_VECTOR_APPLIED
+
+        q = np.frombuffer(vs.query, dtype="<f4")
+
+        def topk_of(table: pa.Table) -> pa.Table:
+            if table.num_rows == 0 or vs.column not in table.column_names:
+                # pre-ALTER data may lack the vector column entirely: those
+                # rows have NULL vectors and never rank
+                return table.schema.empty_table() if table.num_rows else table
+            from .ops.vector import topk_host
+
+            mat, valid = decode_matrix(table[vs.column], len(q))
+            _dist, sel = topk_host(mat, valid, q, vs.metric, vs.k, vs.ascending)
+            return table.take(pa.array(np.sort(sel)))
+
+        meta = self.catalog.table(vs.scan.table, vs.scan.database)
+        out: list[pa.Table] = []
+        pred = self._pred_of(vs.scan)
+        regions = []
+        for rid in meta.region_ids:
+            try:
+                regions.append(self.storage.region(rid))
+            except Exception:  # noqa: BLE001 — virtual/logical/remote table:
+                # one whole-table scan REPLACES per-region work (augmenting
+                # it would rank already-processed regions twice)
+                return topk_of(self._scan(vs.scan))
+        for region in regions:
+            if region.append_mode:
+                # per-SST IVF candidates + memtable brute force; no dedup to
+                # disturb in append mode
+                for fm in region.sst_reader.prune_files(region.files(), pred):
+                    t = region.sst_reader.read(fm, pred)
+                    vi = region.sst_reader.vector_index(fm, vs.column)
+                    if vi is not None and t.num_rows == fm.num_rows:
+                        cand = vi.candidates(q, nprobe=8)
+                        if len(cand) >= min(vs.k, vi.n) and len(cand) < t.num_rows:
+                            INDEX_VECTOR_APPLIED.inc()
+                            t = t.take(pa.array(np.sort(cand)))
+                    out.append(topk_of(t))
+                from .storage.sst import _apply_residual
+
+                ts_name = meta.schema.time_index.name if meta.schema.time_index else None
+                for mem in [*region._frozen_memtables, region.memtable]:
+                    mt = _apply_residual(mem.to_table(dedup=False), pred, ts_name)
+                    out.append(topk_of(mt))
+            else:
+                out.append(topk_of(region.scan(pred)))
+        tables = [t for t in out if t.num_rows]
+        if not tables:
+            return meta.schema.to_arrow().empty_table()
+        return pa.concat_tables(tables, promote_options="permissive")
+
     def _view_stmt(self, name: str, database: str):
         """view_provider for the planner: view name -> freshly parsed
         defining SELECT (fresh parse per query so planning never mutates a
@@ -1038,6 +1131,14 @@ def _opt_bool(options: dict, key: str) -> bool:
 
 def _coerce_array(values: list, col: ColumnSchema) -> pa.Array:
     t = col.data_type.to_arrow()
+    if col.data_type == ConcreteDataType.VECTOR:
+        from .query.vector import parse_vector_literal
+
+        coerced = [
+            None if v is None else (v if isinstance(v, bytes) else parse_vector_literal(v, col.vector_dim))
+            for v in values
+        ]
+        return pa.array(coerced, t)
     if col.data_type.is_timestamp():
         unit_ms = col.data_type.timestamp_unit_ns() // 1_000_000
         coerced = []

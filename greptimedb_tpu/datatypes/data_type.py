@@ -40,6 +40,10 @@ class ConcreteDataType(enum.Enum):
     TIMESTAMP_NANOSECOND = "timestamp_ns"
     INTERVAL = "interval"
     JSON = "json"
+    # Fixed-dimension float32 embedding, stored as little-endian f32 bytes
+    # (reference datatypes vector type, stored as binary with dim metadata;
+    # the dimension lives on ColumnSchema.vector_dim).
+    VECTOR = "vector"
 
     # ---- classification ---------------------------------------------------
     def is_timestamp(self) -> bool:
@@ -96,6 +100,8 @@ class ConcreteDataType(enum.Enum):
         key = s.strip().lower()
         if key in _SQL_ALIASES:
             return _SQL_ALIASES[key]
+        if key.startswith("vector(") and key.endswith(")"):
+            return cls.VECTOR
         raise InvalidArgumentsError(f"unknown data type: {s!r}")
 
     def to_numpy(self) -> np.dtype:
@@ -103,7 +109,12 @@ class ConcreteDataType(enum.Enum):
             return np.dtype("int64")
         if self == ConcreteDataType.BOOLEAN:
             return np.dtype("bool")
-        if self in (ConcreteDataType.STRING, ConcreteDataType.BINARY, ConcreteDataType.JSON):
+        if self in (
+            ConcreteDataType.STRING,
+            ConcreteDataType.BINARY,
+            ConcreteDataType.JSON,
+            ConcreteDataType.VECTOR,
+        ):
             return np.dtype("object")
         return np.dtype(self.value)
 
@@ -159,6 +170,7 @@ _TO_ARROW = {
     ConcreteDataType.TIMESTAMP_NANOSECOND: pa.timestamp("ns"),
     ConcreteDataType.INTERVAL: pa.duration("ms"),
     ConcreteDataType.JSON: pa.string(),
+    ConcreteDataType.VECTOR: pa.binary(),
 }
 
 _TS_BY_UNIT = {

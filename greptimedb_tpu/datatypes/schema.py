@@ -40,6 +40,11 @@ class ColumnSchema:
     # declared as `col STRING FULLTEXT INDEX` — SSTs get a tokenized
     # inverted index consulted by matches()/matches_term()).
     fulltext: bool = False
+    # VECTOR(dim) columns: embedding dimension (reference VectorType dim;
+    # values are little-endian f32 bytes).  `VECTOR INDEX` adds an IVF-flat
+    # ANN sidecar at flush.
+    vector_dim: int | None = None
+    vector_index: bool = False
 
     def __post_init__(self):
         if self.semantic_type == SemanticType.TIMESTAMP:
@@ -78,6 +83,8 @@ class ColumnSchema:
             "default": self.default,
             "column_id": self.column_id,
             "fulltext": self.fulltext,
+            "vector_dim": self.vector_dim,
+            "vector_index": self.vector_index,
         }
 
     @classmethod
@@ -90,6 +97,8 @@ class ColumnSchema:
             default=d.get("default"),
             column_id=d.get("column_id", 0),
             fulltext=d.get("fulltext", False),
+            vector_dim=d.get("vector_dim"),
+            vector_index=d.get("vector_index", False),
         )
 
 

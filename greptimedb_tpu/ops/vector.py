@@ -1,0 +1,85 @@
+"""Batched vector-distance + top-k on device.
+
+The MXU-shaped formulation of query/vector.py's distances: a [N, d] x [d]
+matvec (or [N, d] x [d, B] matmul for query batches) plus `lax.top_k`.
+This is where vector search scales on TPU — the reference's usearch/HNSW
+is a pointer-chasing CPU structure; the TPU-native design is brute-force
+(or IVF-pruned) matmul over HBM-resident embedding tiles, which beats ANN
+graphs comfortably at observability-scale dimensions (d <= 1024).
+
+Static shapes: callers pad N to a tile multiple and pass a validity mask,
+like every other kernel in ops/ (SURVEY.md section 7 tile+mask+pad rule).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_DIST_THRESHOLD_ROWS = 100_000  # below this, numpy wins (no H2D copy)
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "k", "ascending"))
+def topk_distances(
+    mat: jnp.ndarray,  # [N, d] float32 (zero-padded invalid rows)
+    valid: jnp.ndarray,  # [N] bool
+    q: jnp.ndarray,  # [d] float32
+    metric: str = "cos",
+    k: int = 10,
+    ascending: bool = True,
+):
+    """-> (dist [k], idx [k]): the k best rows by distance.
+
+    Invalid rows are pushed to the losing end of the order.  One fused
+    dispatch: matvec + elementwise + top_k — XLA keeps it on-chip."""
+    dots = mat @ q  # [N] — the MXU product
+    if metric == "dot":
+        d = dots
+    elif metric == "l2sq":
+        d = jnp.sum(mat * mat, axis=1) - 2.0 * dots + jnp.dot(q, q)
+    else:  # cos
+        denom = jnp.sqrt(jnp.sum(mat * mat, axis=1)) * jnp.sqrt(jnp.dot(q, q))
+        d = 1.0 - jnp.where(denom > 0, dots / jnp.maximum(denom, 1e-30), 0.0)
+    bad = jnp.inf if ascending else -jnp.inf
+    d = jnp.where(valid, d, bad)
+    score = -d if ascending else d
+    top, idx = jax.lax.top_k(score, k)
+    return (-top if ascending else top), idx
+
+
+def topk_host(mat, valid, q, metric: str, k: int, ascending: bool = True):
+    """Host entry: picks numpy for small inputs, the jit kernel for large
+    ones; returns (dist np[k'], idx np[k']) with invalid rows dropped."""
+    import numpy as np
+
+    n = len(mat)
+    k = min(k, n)
+    if k == 0:
+        return np.array([]), np.array([], dtype=np.int64)
+    if n < _DIST_THRESHOLD_ROWS:
+        from ..query.vector import distances
+
+        d = distances(np.asarray(mat), np.asarray(q), metric)
+        bad = np.inf if ascending else -np.inf
+        d = np.where(valid, d, bad)
+        if k < n:
+            sel = np.argpartition(d if ascending else -d, k - 1)[:k]
+        else:
+            sel = np.arange(n)
+        order = np.argsort(d[sel] if ascending else -d[sel])
+        sel = sel[order]
+        keep = valid[sel]
+        return d[sel][keep], sel[keep]
+    dist, idx = topk_distances(
+        jnp.asarray(mat, dtype=jnp.float32),
+        jnp.asarray(valid),
+        jnp.asarray(q, dtype=jnp.float32),
+        metric=metric,
+        k=k,
+        ascending=ascending,
+    )
+    dist, idx = np.asarray(dist), np.asarray(idx, dtype=np.int64)
+    keep = np.asarray(valid)[idx]
+    return dist[keep], idx[keep]

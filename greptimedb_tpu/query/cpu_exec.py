@@ -48,6 +48,7 @@ from .logical_plan import (
     SubqueryAlias,
     TableScan,
     Union,
+    VectorSearch,
     Window,
 )
 
@@ -276,9 +277,11 @@ class CpuExecutor:
     executor runs standalone (local engine) or as the datanode-side stage
     of a shipped sub-plan."""
 
-    def __init__(self, scan_provider):
+    def __init__(self, scan_provider, vector_search_provider=None):
         # scan_provider(scan: TableScan) -> pa.Table
+        # vector_search_provider(vs: VectorSearch) -> pa.Table (top-k rows)
         self.scan = scan_provider
+        self.vector_search = vector_search_provider
 
     def execute(self, plan: LogicalPlan) -> pa.Table:
         from .analyze import active_collector, stage
@@ -293,6 +296,10 @@ class CpuExecutor:
     def _execute_node(self, plan: LogicalPlan) -> pa.Table:
         if isinstance(plan, TableScan):
             return self.scan(plan)
+        if isinstance(plan, VectorSearch):
+            if self.vector_search is not None:
+                return self.vector_search(plan)
+            return self.scan(plan.scan)  # no provider: full scan, Sort ranks it
         if isinstance(plan, Filter):
             t = self.execute(plan.input)
             mask = eval_expr(self._materialize_subqueries(plan.predicate), t)

@@ -38,6 +38,7 @@ class QueryEngine:
         tile_context_provider=None,
         partial_agg_provider=None,
         view_provider=None,
+        vector_search_provider=None,
     ):
         """
         schema_provider(table, database) -> Schema
@@ -52,7 +53,7 @@ class QueryEngine:
         self.config = config or QueryConfig()
         self.schema_of = schema_provider
         self.view_of = view_provider
-        self.cpu = CpuExecutor(scan_provider)
+        self.cpu = CpuExecutor(scan_provider, vector_search_provider)
         self._mesh = mesh
         self._region_scan = region_scan_provider
         self._time_bounds = time_bounds_provider

@@ -475,72 +475,6 @@ def _uuid():
     return pa.scalar(str(_u.uuid4()))
 
 
-# ---- vector functions (reference common/function vector ops) ---------------
-
-
-def _parse_vec(v):
-    if isinstance(v, str):
-        return np.fromstring(v.strip("[]"), sep=",") if v else np.zeros(0)
-    return np.asarray(v, dtype=np.float64)
-
-
-@register("vec_dim")
-def _vec_dim(v):
-    return pa.array([None if x is None else len(_parse_vec(x)) for x in _pylist(v)])
-
-
-@register("vec_norm")
-def _vec_norm(v):
-    return pa.array(
-        [None if x is None else float(np.linalg.norm(_parse_vec(x))) for x in _pylist(v)]
-    )
-
-
-@register("vec_dot_product")
-def _vec_dot(a, b):
-    bs = _parse_vec(_scalar(b)) if isinstance(b, pa.Scalar) else None
-    out = []
-    blist = _pylist(b) if bs is None else None
-    for i, x in enumerate(_pylist(a)):
-        if x is None:
-            out.append(None)
-            continue
-        yv = bs if bs is not None else _parse_vec(blist[i])
-        out.append(float(np.dot(_parse_vec(x), yv)))
-    return pa.array(out)
-
-
-@register("vec_cos_distance")
-def _vec_cos(a, b):
-    bs = _parse_vec(_scalar(b)) if isinstance(b, pa.Scalar) else None
-    out = []
-    blist = _pylist(b) if bs is None else None
-    for i, x in enumerate(_pylist(a)):
-        if x is None:
-            out.append(None)
-            continue
-        xv = _parse_vec(x)
-        yv = bs if bs is not None else _parse_vec(blist[i])
-        denom = np.linalg.norm(xv) * np.linalg.norm(yv)
-        out.append(float(1.0 - np.dot(xv, yv) / denom) if denom else None)
-    return pa.array(out)
-
-
-@register("vec_l2sq_distance")
-def _vec_l2sq(a, b):
-    bs = _parse_vec(_scalar(b)) if isinstance(b, pa.Scalar) else None
-    out = []
-    blist = _pylist(b) if bs is None else None
-    for i, x in enumerate(_pylist(a)):
-        if x is None:
-            out.append(None)
-            continue
-        yv = bs if bs is not None else _parse_vec(blist[i])
-        d = _parse_vec(x) - yv
-        out.append(float(np.dot(d, d)))
-    return pa.array(out)
-
-
 # ---- helpers ---------------------------------------------------------------
 
 
@@ -599,3 +533,114 @@ def _uddsketch_calc(q, state):
     if isinstance(state, pa.Scalar):
         return pa.scalar(one(state.as_py()), pa.float64())
     return pa.array([one(v) for v in _pylist(state)], pa.float64())
+
+
+# ---- vector functions (reference common/function/src/scalars/vector/) ------
+
+
+def _vec_arg_to_bytes(v):
+    """Scalar vector arg: binary bytes or a '[...]' string literal."""
+    from .vector import parse_vector_literal
+
+    raw = v.as_py() if isinstance(v, pa.Scalar) else v
+    if raw is None:
+        return None
+    if isinstance(raw, bytes):
+        return raw
+    return parse_vector_literal(raw)
+
+
+def _vec_distance(a, b, metric: str):
+    from .vector import decode_matrix, distances
+
+    # one side is a column, the other a literal (either order)
+    if isinstance(a, (pa.Array, pa.ChunkedArray)) and isinstance(b, (pa.Array, pa.ChunkedArray)):
+        ma, va = decode_matrix(a)
+        mb, vb = decode_matrix(b)
+        if ma.shape != mb.shape:
+            raise PlanError("vector columns have mismatched dimensions")
+        out = np.empty(len(ma), dtype=np.float64)
+        for i in range(len(ma)):
+            out[i] = distances(ma[i : i + 1], mb[i], metric)[0]
+        return pa.array(out, mask=~(va & vb))
+    if isinstance(b, (pa.Array, pa.ChunkedArray)):
+        a, b = b, a
+    qb = _vec_arg_to_bytes(b)
+    if qb is None:
+        n = len(a) if isinstance(a, (pa.Array, pa.ChunkedArray)) else 1
+        return pa.array([None] * n, pa.float64())
+    q = np.frombuffer(qb, dtype="<f4")
+    if isinstance(a, pa.Scalar) or isinstance(a, (bytes, str)):
+        ab = _vec_arg_to_bytes(a)
+        if ab is None:
+            return pa.scalar(None, pa.float64())
+        from .vector import distances as _d
+
+        v = np.frombuffer(ab, dtype="<f4")
+        return pa.scalar(float(_d(v[None, :], q, metric)[0]), pa.float64())
+    from .vector import decode_matrix as _dm, distances as _d
+
+    mat, valid = _dm(a, len(q))
+    out = _d(mat, q, metric).astype(np.float64)
+    return pa.array(out, mask=~valid)
+
+
+@register("vec_cos_distance")
+def _vec_cos_distance(a, b):
+    return _vec_distance(a, b, "cos")
+
+
+@register("vec_l2sq_distance")
+def _vec_l2sq_distance(a, b):
+    return _vec_distance(a, b, "l2sq")
+
+
+@register("vec_dot_product")
+def _vec_dot_product(a, b):
+    return _vec_distance(a, b, "dot")
+
+
+@register("parse_vec")
+def _parse_vec(s):
+    from .vector import parse_vector_literal
+
+    def one(v):
+        return None if v is None else parse_vector_literal(v)
+
+    if isinstance(s, pa.Scalar):
+        return pa.scalar(one(s.as_py()), pa.binary())
+    return pa.array([one(v) for v in _pylist(s)], pa.binary())
+
+
+@register("vec_to_string")
+def _vec_to_string(b):
+    from .vector import vector_to_string
+
+    def one(v):
+        return vector_to_string(_vec_arg_to_bytes(v) if v is not None else None)
+
+    if isinstance(b, pa.Scalar):
+        return pa.scalar(one(b.as_py()), pa.string())
+    return pa.array([one(v) for v in _pylist(b)], pa.string())
+
+
+@register("vec_dim")
+def _vec_dim(b):
+    def one(v):
+        return None if v is None else len(_vec_arg_to_bytes(v)) // 4
+
+    if isinstance(b, pa.Scalar):
+        return pa.scalar(one(b.as_py()), pa.int64())
+    return pa.array([one(v) for v in _pylist(b)], pa.int64())
+
+
+@register("vec_norm")
+def _vec_norm(b):
+    def one(v):
+        if v is None:
+            return None
+        return float(np.linalg.norm(np.frombuffer(_vec_arg_to_bytes(v), dtype="<f4")))
+
+    if isinstance(b, pa.Scalar):
+        return pa.scalar(one(b.as_py()), pa.float64())
+    return pa.array([one(v) for v in _pylist(b)], pa.float64())

@@ -112,6 +112,27 @@ class RangeSelect(LogicalPlan):
 
 
 @dataclass(repr=False)
+class VectorSearch(LogicalPlan):
+    """Top-k nearest-neighbor scan: replaces the TableScan under an
+    `ORDER BY vec_*_distance(col, literal) LIMIT k` pattern (reference
+    vector index applier, mito2/src/sst/index/vector_index/).  Produces at
+    most k rows; the Sort/Limit above re-order the reduced set."""
+
+    scan: TableScan
+    column: str
+    query: bytes  # f32-le encoded query vector
+    metric: str  # cos | l2sq | dot
+    k: int
+    ascending: bool = True
+
+    def children(self):
+        return [self.scan]
+
+    def __repr__(self):
+        return f"VectorSearch({self.column}, metric={self.metric}, k={self.k})"
+
+
+@dataclass(repr=False)
 class Sort(LogicalPlan):
     input: LogicalPlan
     keys: list[tuple[Expr, bool]]  # (expr, ascending)

@@ -139,7 +139,8 @@ def _plan_branch(
             # and the TPU lowering see plain column names; unknown
             # qualifiers are rejected rather than silently bound.
             stmt = _normalize_qualifiers(stmt, {fi.alias or fi.table, fi.table})
-            return plan_select(stmt, schema, database, subplanner=subplanner), schema
+            plan = plan_select(stmt, schema, database, subplanner=subplanner)
+            return _rewrite_vector_search(plan, schema), schema
         # View as the sole FROM item: plan its (already parsed) definition
         # once — _plan_from would re-resolve and re-parse it.
         _validate_qualifiers(stmt, _from_names(fi))
@@ -186,6 +187,60 @@ def _plan_view(vstmt, item: TableRef, schema_provider, database, view_provider):
     finally:
         stack.pop()
     return SubqueryAlias(vplan, item.alias or item.table)
+
+
+_VEC_DIST_FUNCS = {"vec_cos_distance", "vec_l2sq_distance", "vec_dot_product"}
+
+
+def _rewrite_vector_search(plan: LogicalPlan, schema: Schema) -> LogicalPlan:
+    """Limit(k) over [Project*] over Sort(vec_distance(col, lit)) over a
+    bare TableScan -> swap the scan for a VectorSearch top-k producer.
+    The Sort/Limit stay (re-ordering k rows is cheap); correctness is
+    unchanged because VectorSearch returns a superset-ordering-stable
+    top-(k+offset) of exactly the rows the sort would have ranked first."""
+    from .logical_plan import VectorSearch
+
+    if not isinstance(plan, Limit) or plan.limit is None:
+        return plan
+    k = plan.limit + plan.offset
+    node = plan.input
+    projects = []
+    while isinstance(node, Project):
+        projects.append(node)
+        node = node.input
+    if not isinstance(node, Sort) or len(node.keys) != 1:
+        return plan
+    key, asc = node.keys[0]
+    key = strip_alias(key)
+    if not (isinstance(key, FuncCall) and key.func in _VEC_DIST_FUNCS and len(key.args) == 2):
+        return plan
+    a, b = key.args
+    if isinstance(a, Column) and isinstance(b, Literal):
+        col, lit = a, b
+    elif isinstance(b, Column) and isinstance(a, Literal):
+        col, lit = b, a
+    else:
+        return plan
+    if not isinstance(node.input, TableScan):
+        return plan  # residual filters or joins: keep the full sort
+    cs = schema.column(col.column) if schema.has_column(col.column) else None
+    if cs is None or cs.data_type.value != "vector":
+        return plan
+    from .vector import parse_vector_literal
+
+    try:
+        qb = parse_vector_literal(lit.value, cs.vector_dim)
+    except Exception:  # noqa: BLE001 — malformed literal: let eval report it
+        return plan
+    metric = {"vec_cos_distance": "cos", "vec_l2sq_distance": "l2sq", "vec_dot_product": "dot"}[
+        key.func
+    ]
+    vs = VectorSearch(node.input, col.column, qb, metric, k, ascending=asc)
+    new_sort = Sort(vs, node.keys)
+    inner: LogicalPlan = new_sort
+    for p in reversed(projects):
+        inner = Project(inner, p.exprs)
+    return Limit(inner, plan.limit, plan.offset)
 
 
 def _from_names(item) -> set[str]:

@@ -157,6 +157,7 @@ class ColumnDef:
     is_time_index: bool = False
     is_primary_key: bool = False
     fulltext: bool = False
+    vector_index: bool = False
 
 
 @dataclass
@@ -168,7 +169,7 @@ class CreateTableStmt:
     primary_key: list[str] = field(default_factory=list)
     if_not_exists: bool = False
     partition_by_hash: tuple[list[str], int] | None = None  # (columns, n)
-    partition_on_columns: tuple[str, list] | None = None  # (column, bounds)
+    partition_on_columns: tuple[list[str], list] | None = None  # (columns, region exprs)
     engine: str = "mito"
     options: dict = field(default_factory=dict)
     external: bool = False  # CREATE EXTERNAL TABLE (file engine)
@@ -1217,22 +1218,24 @@ class Parser:
                     n = int(self.next().value)
                     stmt.partition_by_hash = (cols, n)
                 else:
+                    # PARTITION ON COLUMNS (c1, c2) (expr, expr, ...)
+                    # (reference multi-dimensional partition rule,
+                    # partition/src/multi_dim.rs + RFC 2024-02-21)
                     self.expect_kw("on")
                     self.expect_kw("columns")
                     self.expect_op("(")
-                    col = self.ident()
+                    cols = [self.ident()]
+                    while self.eat_op(","):
+                        cols.append(self.ident())
                     self.expect_op(")")
                     self.expect_op("(")
-                    depth = 1
-                    while depth:  # accept & ignore the expression list body
-                        t = self.next()
-                        if t.kind == "op" and t.value == "(":
-                            depth += 1
-                        elif t.kind == "op" and t.value == ")":
-                            depth -= 1
-                        elif t.kind == "eof":
-                            raise InvalidSyntaxError("unterminated PARTITION ON COLUMNS")
-                    stmt.partition_on_columns = (col, [])
+                    exprs = []
+                    while not self.at_op(")"):
+                        exprs.append(self.parse_expr())
+                        if not self.eat_op(","):
+                            break
+                    self.expect_op(")")
+                    stmt.partition_on_columns = (cols, exprs)
             elif self.eat_kw("engine"):
                 self.expect_op("=")
                 stmt.engine = self.ident()
@@ -1296,6 +1299,22 @@ class Parser:
                         elif t.kind == "eof":
                             raise InvalidSyntaxError("unterminated FULLTEXT WITH")
                 col.fulltext = True
+            elif self.eat_kw("vector"):
+                # `emb VECTOR(3) VECTOR INDEX [WITH (...)]` (reference
+                # vector index column extension; build options accepted+ignored)
+                self.eat_kw("index")
+                if self.eat_kw("with"):
+                    self.expect_op("(")
+                    depth = 1
+                    while depth:
+                        t = self.next()
+                        if t.kind == "op" and t.value == "(":
+                            depth += 1
+                        elif t.kind == "op" and t.value == ")":
+                            depth -= 1
+                        elif t.kind == "eof":
+                            raise InvalidSyntaxError("unterminated VECTOR INDEX WITH")
+                col.vector_index = True
             else:
                 break
         return col

@@ -1,0 +1,110 @@
+"""Client-facing Arrow Flight query + ingest service on the frontend.
+
+Role-equivalent of the reference's gRPC/Flight `Database` service
+(reference servers/src/grpc/flight.rs:104 client-facing DoGet/DoPut and
+servers/src/grpc/greptime_handler.rs:50): clients submit SQL in a Flight
+ticket and stream Arrow record batches back — the highest-throughput read
+surface, no text-protocol encode — and bulk-ingest record batches with
+DoPut addressed to a table.
+
+This is distinct from distributed/flight.py (the datanode/region server):
+that service speaks region ids and scan predicates; this one speaks SQL
+and table names, like the reference's separate frontend vs region Flight
+services.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pyarrow as pa
+import pyarrow.flight as fl
+
+
+class FrontendFlightServer(fl.FlightServerBase):
+    def __init__(self, db, location: str = "grpc://127.0.0.1:0"):
+        super().__init__(location)
+        self.db = db
+        self._lock = threading.Lock()
+
+    @property
+    def location(self) -> str:
+        return f"grpc://127.0.0.1:{self.port}"
+
+    # ---- queries (do_get: ticket = {"sql": ...}) --------------------------
+    def do_get(self, context, ticket: fl.Ticket):
+        body = json.loads(ticket.ticket.decode())
+        sql = body["sql"]
+        # per-request database selection must not leak into later requests
+        # served by the same worker thread
+        saved_db = self.db.current_database
+        try:
+            if "database" in body:
+                self.db.current_database = body["database"]
+            result = self.db.sql_one(sql)
+        except Exception as exc:  # noqa: BLE001 — surface as Flight error
+            raise fl.FlightServerError(f"{type(exc).__name__}: {exc}") from exc
+        finally:
+            self.db.current_database = saved_db
+        if result is None:
+            result = pa.table({"result": pa.array([], pa.string())})
+        elif isinstance(result, int):
+            result = pa.table({"affected_rows": pa.array([result], pa.int64())})
+        return fl.RecordBatchStream(result)
+
+    # ---- ingest (do_put: descriptor command = {"table": ...}) -------------
+    def do_put(self, context, descriptor: fl.FlightDescriptor, reader, writer):
+        cmd = json.loads(descriptor.command.decode())
+        table_name = cmd["table"]
+        database = cmd.get("database")
+        affected = 0
+        for chunk in reader:
+            with self._lock:
+                affected += self.db.insert_rows(table_name, chunk.data, database=database)
+        writer.write(json.dumps({"affected_rows": affected}).encode())
+
+    # ---- control ----------------------------------------------------------
+    def do_action(self, context, action: fl.Action):
+        if action.type == "health":
+            yield fl.Result(json.dumps({"ok": True}).encode())
+            return
+        raise fl.FlightServerError(f"unknown action {action.type!r}")
+
+    def list_actions(self, context):
+        return [("health", "liveness probe")]
+
+
+class FlightSqlClient:
+    """Client handle: execute SQL, stream results, bulk-ingest batches
+    (the reference's `Database` client handle, client/src/database.rs)."""
+
+    def __init__(self, location: str):
+        self._client = fl.FlightClient(location)
+
+    def execute(self, sql: str, database: str | None = None) -> pa.Table:
+        body = {"sql": sql}
+        if database:
+            body["database"] = database
+        reader = self._client.do_get(fl.Ticket(json.dumps(body).encode()))
+        return reader.read_all()
+
+    def write(self, table: str, rows: pa.Table | pa.RecordBatch, database: str | None = None) -> int:
+        batches = rows.to_batches() if isinstance(rows, pa.Table) else [rows]
+        desc = fl.FlightDescriptor.for_command(
+            json.dumps({"table": table, **({"database": database} if database else {})}).encode()
+        )
+        writer, meta_reader = self._client.do_put(desc, batches[0].schema)
+        for b in batches:
+            writer.write_batch(b)
+        writer.done_writing()
+        buf = meta_reader.read()
+        writer.close()
+        return json.loads(buf.to_pybytes().decode())["affected_rows"] if buf else 0
+
+    def health(self) -> bool:
+        out = list(self._client.do_action(fl.Action("health", b"")))
+        return json.loads(out[0].body.to_pybytes().decode()).get("ok", False)
+
+    def close(self):
+        self._client.close()

@@ -31,6 +31,7 @@ import pyarrow.compute as pc
 BLOOM_BLOB = "greptime-bloom-filter-v1"
 INVERTED_BLOB = "greptime-inverted-index-v1"
 FULLTEXT_BLOB = "greptime-fulltext-index-v1"
+VECTOR_BLOB = "greptime-vector-index-v1"
 DEFAULT_SEGMENT_ROWS = 1024
 BLOOM_FPP = 0.01
 
@@ -452,3 +453,48 @@ class IndexCache:
         elif len(self._data) >= self.capacity:
             self._data.pop(next(iter(self._data)))
         self._data[key] = value
+
+
+# ---- vector (ANN) index -----------------------------------------------------
+# IVF-flat per SST (reference mito2/src/sst/index/vector_index/, which wraps
+# usearch HNSW — IVF-flat is the TPU-friendly choice: probing is a batched
+# centroid matmul, re-ranking a candidate matmul, both MXU shapes).
+
+
+def build_vector_index(column: pa.Array, dim: int) -> bytes | None:
+    """Binary-f32 vector column -> serialized IVF-flat index (coarse
+    centroids + per-row assignments).  None for empty columns."""
+    from ..query.vector import build_ivf, decode_matrix
+
+    if isinstance(column, pa.ChunkedArray):
+        column = column.combine_chunks()
+    mat, valid = decode_matrix(column, dim)
+    if not valid.any():
+        return None
+    cent, assign = build_ivf(mat, valid)
+    header = json.dumps(
+        {"dim": dim, "nlist": len(cent), "n": len(assign)}
+    ).encode()
+    payload = zlib.compress(cent.astype("<f4").tobytes() + assign.astype("<i4").tobytes())
+    return struct.pack("<I", len(header)) + header + payload
+
+
+class VectorIndex:
+    """Parsed IVF-flat blob: probe nprobe nearest cells -> candidate rows."""
+
+    def __init__(self, blob: bytes):
+        header, payload = _split_blob(blob)
+        self.dim = header["dim"]
+        self.nlist = header["nlist"]
+        self.n = header["n"]
+        raw = zlib.decompress(payload)
+        cbytes = self.nlist * self.dim * 4
+        self.centroids = np.frombuffer(raw[:cbytes], dtype="<f4").reshape(
+            self.nlist, self.dim
+        )
+        self.assign = np.frombuffer(raw[cbytes:], dtype="<i4")
+
+    def candidates(self, q: np.ndarray, nprobe: int = 4) -> np.ndarray:
+        from ..query.vector import ivf_candidates
+
+        return ivf_candidates(self.centroids, self.assign, q, nprobe)

@@ -22,7 +22,7 @@ import pyarrow.parquet as pq
 from ..datatypes.schema import Schema
 from ..utils import metrics
 from . import index as idx
-from .index import BLOOM_BLOB, FULLTEXT_BLOB, INVERTED_BLOB
+from .index import BLOOM_BLOB, FULLTEXT_BLOB, INVERTED_BLOB, VECTOR_BLOB
 from .object_store import FsObjectStore, ObjectStore
 from .puffin import PuffinReader, PuffinWriter
 
@@ -34,6 +34,10 @@ INDEX_FULLTEXT_PRUNES = metrics.Counter(
 )
 INDEX_PRUNED_GROUPS = metrics.Counter(
     "sst_index_pruned_row_groups", "row groups skipped via secondary indexes"
+)
+INDEX_VECTOR_APPLIED = metrics.Counter(
+    "greptime_index_vector_applied_total",
+    "top-k vector searches answered via the IVF index",
 )
 
 
@@ -119,7 +123,12 @@ class SstWriter:
             for c in self.schema.columns
             if getattr(c, "fulltext", False) and c.name in table.column_names
         ]
-        if not cols and not ft_cols:
+        vec_cols = [
+            c
+            for c in self.schema.columns
+            if getattr(c, "vector_index", False) and c.name in table.column_names
+        ]
+        if not cols and not ft_cols and not vec_cols:
             return [], 0
         writer = PuffinWriter(self.store, f"{file_id}.puffin")
         indexed = []
@@ -142,6 +151,14 @@ class SstWriter:
                 writer.add_blob(FULLTEXT_BLOB, ft, {"column": name})
                 if name not in indexed:
                     indexed.append(name)
+        for c in vec_cols:
+            col = table[c.name]
+            col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+            vec = idx.build_vector_index(col, c.vector_dim or 0)
+            if vec is not None:
+                writer.add_blob(VECTOR_BLOB, vec, {"column": c.name})
+                if c.name not in indexed:
+                    indexed.append(c.name)
         return indexed, writer.finish()
 
     def write(self, table: pa.Table, level: int = 0) -> FileMeta | None:
@@ -324,6 +341,9 @@ class SstReader:
                 parsed = idx.InvertedIndex(blob)
             elif bm.blob_type == FULLTEXT_BLOB:
                 parsed = idx.FulltextIndex(blob)
+            elif bm.blob_type == VECTOR_BLOB:
+                out.setdefault(col, {})[VECTOR_BLOB] = idx.VectorIndex(blob)
+                continue  # no segment granularity
             else:
                 continue
             out.setdefault(col, {})[bm.blob_type] = parsed
@@ -331,6 +351,13 @@ class SstReader:
         out["__segment_rows__"] = seg_rows
         _INDEX_CACHE.put(meta.file_id, out)
         return out
+
+    def vector_index(self, meta: FileMeta, column: str):
+        """Parsed per-SST IVF index for `column`, or None."""
+        sidecar = self._load_sidecar(meta)
+        if not sidecar:
+            return None
+        return sidecar.get(column, {}).get(VECTOR_BLOB)
 
     def _prune_row_groups(self, pf: pq.ParquetFile, pred: ScanPredicate, ts_name) -> list[int]:
         md = pf.metadata

@@ -1,0 +1,16 @@
+-- VECTOR type + distance functions + top-k search
+CREATE TABLE emb (id STRING, v VECTOR(3), ts TIMESTAMP TIME INDEX, PRIMARY KEY (id));
+
+INSERT INTO emb VALUES ('a', '[1,0,0]', 1), ('b', '[0,1,0]', 2), ('c', '[0.9,0.1,0]', 3);
+
+SELECT id, vec_to_string(v) FROM emb ORDER BY id;
+
+SELECT id, round(vec_l2sq_distance(v, '[1,0,0]'), 4) AS d FROM emb ORDER BY d;
+
+SELECT id FROM emb ORDER BY vec_cos_distance(v, '[1,0,0]') LIMIT 2;
+
+SELECT vec_dim(v) FROM emb LIMIT 1;
+
+SELECT round(vec_norm(parse_vec('[3,4,0]')), 1);
+
+DROP TABLE emb;
