@@ -106,6 +106,57 @@ def cmd_import(args):
     return 0
 
 
+def cmd_metadata(args):
+    """metadata snapshot/restore/info (reference cli/src/metadata/:
+    `greptime cli metadata snapshot save|restore` + control info).  The
+    snapshot captures the catalog (tables, views, partition rules) and the
+    per-table dictionaries index — enough to rebuild metadata after a
+    catalog-file loss; region data (SSTs/WAL/manifests) is storage-level
+    and restored by region replay, as in the reference."""
+    import json
+    import os
+    import shutil
+
+    catalog_path = os.path.join(args.data_home, "catalog.json")
+    if args.action == "snapshot":
+        if not os.path.exists(catalog_path):
+            print(f"no catalog at {catalog_path}")
+            return 1
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)) or ".", exist_ok=True)
+        shutil.copyfile(catalog_path, args.out)
+        with open(catalog_path) as f:
+            state = json.load(f)
+        n_tables = sum(len(ts) for ts in state.get("databases", {}).values())
+        n_views = sum(len(vs) for vs in state.get("views", {}).values())
+        print(f"snapshot written to {args.out}: {n_tables} tables, {n_views} views")
+        return 0
+    if args.action == "restore":
+        with open(args.snapshot) as f:
+            state = json.load(f)  # validates JSON before overwriting anything
+        os.makedirs(args.data_home, exist_ok=True)
+        tmp = catalog_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, catalog_path)
+        print(f"catalog restored from {args.snapshot}")
+        return 0
+    if args.action == "info":
+        if not os.path.exists(catalog_path):
+            print(f"no catalog at {catalog_path}")
+            return 1
+        with open(catalog_path) as f:
+            state = json.load(f)
+        for db_name, tables in sorted(state.get("databases", {}).items()):
+            for name, meta in sorted(tables.items()):
+                print(f"table {db_name}.{name} id={meta.get('table_id')}")
+            for vname in sorted(state.get("views", {}).get(db_name, {})):
+                print(f"view  {db_name}.{vname}")
+        return 0
+    return 1
+
+
 def cmd_bench(args):
     import importlib.util
     import os
@@ -149,6 +200,13 @@ def main(argv=None):
     p.add_argument("input")
     p.add_argument("--data-home", default="./greptimedb_data")
     p.set_defaults(fn=cmd_import)
+
+    p = sub.add_parser("metadata", help="catalog snapshot / restore / info")
+    p.add_argument("action", choices=["snapshot", "restore", "info"])
+    p.add_argument("--data-home", default="./greptimedb_data")
+    p.add_argument("--out", default="./catalog_snapshot.json", help="snapshot output path")
+    p.add_argument("--snapshot", default="./catalog_snapshot.json", help="snapshot to restore")
+    p.set_defaults(fn=cmd_metadata)
 
     p = sub.add_parser("bench", help="run the TSBS-style benchmark")
     p.set_defaults(fn=cmd_bench)

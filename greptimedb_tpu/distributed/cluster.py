@@ -242,15 +242,16 @@ class Cluster:
                     raise RetryLaterError(
                         f"region {rid} of {table!r} has no route yet; retry the write"
                     )
-                from ..utils.errors import RegionReadonlyError
+                from ..utils.errors import RegionNotFoundError, RegionReadonlyError
 
                 try:
                     for b in part.to_batches():
                         affected += self.datanodes[node].write(rid, b)
-                except RegionReadonlyError as exc:
-                    # region is mid-migration (downgraded leader); the route
-                    # will move shortly — retryable, like the reference's
-                    # RegionBusy/migrating errors
+                except (RegionReadonlyError, RegionNotFoundError) as exc:
+                    # readonly = mid-migration downgraded leader; not-found =
+                    # the route moved and the old node already closed the
+                    # region — both transient, the re-read route resolves
+                    # them (reference RegionBusy/RegionNotReady retryables)
                     raise RetryLaterError(
                         f"region {rid} of {table!r} is migrating; retry the write"
                     ) from exc

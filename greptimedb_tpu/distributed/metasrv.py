@@ -119,10 +119,20 @@ class RegionMigrationProcedure(Procedure):
         return DONE
 
     def rollback(self, ctx):
-        """Re-enable writes on the old leader if we failed before the route
-        moved (the candidate never became authoritative)."""
+        """Failed before the route moved: close the candidate FIRST (it may
+        already hold the region open over the same shared WAL/manifest —
+        two open copies must never coexist once the leader resumes), then
+        re-enable writes on the old leader."""
         metasrv: "Metasrv" = ctx.services["metasrv"]
-        if self.state.get("step") in ("downgrade_leader", "open_candidate", "update_metadata"):
+        step = self.state.get("step")
+        if step in ("open_candidate", "update_metadata"):
+            try:
+                metasrv.node_manager.close_region_quiet(
+                    self.state["to_node"], self.state["region_id"]
+                )
+            except Exception:  # noqa: BLE001 — best-effort close
+                pass
+        if step in ("downgrade_leader", "open_candidate", "update_metadata"):
             try:
                 metasrv.node_manager.set_region_writable(
                     self.state["from_node"], self.state["region_id"], True
@@ -149,6 +159,7 @@ class Metasrv:
         self._rr_counter = 0
         self._lock = threading.RLock()
         self.maintenance_mode = False
+        self.selector = "round_robin"  # or "load_based"
         self.election = election
         if election is not None:
             election.on_leader_start.append(self._on_leader_start)
@@ -167,11 +178,23 @@ class Metasrv:
             self.datanodes.setdefault(node_id, DatanodeInfo(node_id))
 
     def select_datanode(self, exclude: set[int] = frozenset()) -> int | None:
-        """Round-robin over healthy nodes (reference selector/round_robin.rs)."""
+        """Datanode placement.  `selector` picks the policy:
+        round_robin (reference selector/round_robin.rs, default) or
+        load_based (reference selector/load_based.rs: weight by hosted
+        region count from routes + last heartbeat stats)."""
         with self._lock:
             healthy = [n for n in sorted(self.datanodes) if self.datanodes[n].alive and n not in exclude]
             if not healthy:
                 return None
+            if self.selector == "load_based":
+                loads = {n: 0 for n in healthy}
+                for _key, raw in self.kv.range(ROUTE_PREFIX).items():
+                    for _rid, node in json.loads(raw).items():
+                        if node in loads:
+                            loads[node] += 1
+                self._rr_counter += 1
+                # least-loaded wins; ties rotate round-robin for spread
+                return min(healthy, key=lambda n: (loads[n], (n + self._rr_counter) % len(healthy)))
             self._rr_counter += 1
             return healthy[self._rr_counter % len(healthy)]
 

@@ -50,6 +50,8 @@ class PartitionRule:
             return HashPartitionRule(d["columns"], d["n"])
         if kind == "range":
             return RangePartitionRule(d["column"], d["bounds"])
+        if kind == "multi_dim":
+            return MultiDimPartitionRule(d["columns"], d["exprs"])
         raise ValueError(f"unknown partition rule kind: {kind}")
 
 
@@ -93,6 +95,64 @@ class HashPartitionRule(PartitionRule):
 
     def to_dict(self) -> dict:
         return {"kind": "hash", "columns": self.columns, "n": self.n}
+
+
+@dataclass
+class MultiDimPartitionRule(PartitionRule):
+    """Expression-based multi-dimensional partitioning (reference
+    partition/src/multi_dim.rs:50 `MultiDimPartitionRule`, RFC
+    2024-02-21-multi-dimension-partition-rule): one boolean expression per
+    region, evaluated per row; first matching region wins.
+
+    Expressions persist as SQL text (re-parsed lazily) so the rule
+    round-trips through the JSON catalog like the other rules.  A row that
+    matches no expression is a rule-completeness violation and raises —
+    the reference's checker.rs rejects incomplete rules at CREATE; we
+    enforce at write time as the backstop."""
+
+    columns: list[str]
+    exprs: list[str]  # SQL boolean expressions, one per region
+
+    def __post_init__(self):
+        self._parsed = None
+
+    def _compiled(self):
+        if self._parsed is None:
+            from ..query.sql_parser import Parser
+
+            self._parsed = [Parser(e).parse_expr() for e in self.exprs]
+        return self._parsed
+
+    def num_partitions(self) -> int:
+        return len(self.exprs)
+
+    def partition_indices(self, table: pa.Table) -> np.ndarray:
+        from ..query.cpu_exec import eval_expr
+
+        n = table.num_rows
+        out = np.full(n, -1, dtype=np.int32)
+        unassigned = np.ones(n, dtype=bool)
+        for p, expr in enumerate(self._compiled()):
+            m = eval_expr(expr, table)
+            if isinstance(m, pa.Scalar):
+                mask = np.full(n, bool(m.as_py()))
+            else:
+                mask = np.asarray(pc.fill_null(m, False))
+            hit = unassigned & mask
+            out[hit] = p
+            unassigned &= ~mask
+            if not unassigned.any():
+                break
+        if unassigned.any():
+            i = int(np.flatnonzero(unassigned)[0])
+            row = {c: table[c][i].as_py() for c in self.columns if c in table.column_names}
+            raise ValueError(
+                f"row {row} matches no partition expression (incomplete rule)"
+            )
+        return out
+
+    def to_dict(self) -> dict:
+        return {"kind": "multi_dim", "columns": self.columns, "exprs": self.exprs}
 
 
 @dataclass

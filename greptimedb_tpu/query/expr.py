@@ -252,6 +252,37 @@ def strip_alias(e: Expr) -> Expr:
     return e.expr if isinstance(e, Alias) else e
 
 
+def to_sql(e: Expr) -> str:
+    """Fully-parenthesized SQL rendering that re-parses to the SAME tree —
+    unlike name(), which drops grouping parens (fine for display, wrong for
+    round-tripping, e.g. persisted partition expressions)."""
+    if isinstance(e, BinaryOp):
+        return f"({to_sql(e.left)} {e.op} {to_sql(e.right)})"
+    if isinstance(e, UnaryOp):
+        return f"({e.op} {to_sql(e.operand)})"
+    if isinstance(e, Literal):
+        if e.value is None:
+            return "NULL"
+        if isinstance(e.value, bool):
+            return "true" if e.value else "false"
+        if isinstance(e.value, str):
+            return "'" + e.value.replace("'", "''") + "'"
+        return repr(e.value)
+    if isinstance(e, Column):
+        return e.column
+    if isinstance(e, InList):
+        vals = ", ".join(to_sql(Literal(v)) for v in e.values)
+        return f"({to_sql(e.expr)} {'not in' if e.negated else 'in'} ({vals}))"
+    if isinstance(e, Between):
+        neg = "not " if e.negated else ""
+        return f"({to_sql(e.expr)} {neg}between {to_sql(e.low)} and {to_sql(e.high)})"
+    if isinstance(e, IsNull):
+        return f"({to_sql(e.expr)} is {'not ' if e.negated else ''}null)"
+    if isinstance(e, FuncCall):
+        return f"{e.func}({', '.join(to_sql(a) for a in e.args)})"
+    raise ValueError(f"cannot render {type(e).__name__} as SQL")
+
+
 def find_agg_calls(e: Expr) -> list[AggCall]:
     return [x for x in e.walk() if isinstance(x, AggCall)]
 

@@ -144,9 +144,13 @@ class Region:
     # ---- write ------------------------------------------------------------
     def write(self, batch: pa.RecordBatch) -> int:
         """WAL append then memtable insert; returns affected rows."""
-        if not self.writable:
-            raise RegionReadonlyError(f"region {self.region_id} is read-only")
         with self._lock:
+            # the writable check lives INSIDE the lock: set_writable(False)
+            # (migration downgrade) takes the same lock, so once the fence
+            # returns, no in-flight write can still append to the WAL the
+            # migration candidate is about to replay
+            if not self.writable:
+                raise RegionReadonlyError(f"region {self.region_id} is read-only")
             batch = self._conform(batch)
             self.wal.append(batch)
             self.sequence += 1
@@ -357,8 +361,16 @@ class Region:
                     out = _apply_residual(
                         out, ScanPredicate(filters=post_filters), None
                     )
+            # schema evolution: columns added by ALTER after this data was
+            # written materialize as NULL (reference mito2/src/read/compat.rs
+            # fills missing columns with default vectors at read)
+            for c in self.schema.columns:
+                if c.name not in out.column_names:
+                    out = out.append_column(
+                        c.name, pa.nulls(out.num_rows, c.data_type.to_arrow())
+                    )
             if columns:
-                out = out.select(columns)
+                out = out.select([c for c in columns if c in out.column_names])
             else:
                 # normalize to the CURRENT schema: old SSTs may still carry
                 # columns dropped by ALTER

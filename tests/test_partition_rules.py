@@ -1,0 +1,98 @@
+"""Multi-dimensional expression partition rules + load-based selector.
+
+Reference: partition/src/multi_dim.rs:50 (MultiDimPartitionRule),
+meta-srv/src/selector/load_based.rs."""
+
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.models.partition import MultiDimPartitionRule, PartitionRule
+
+
+def test_multi_dim_rule_eval():
+    rule = MultiDimPartitionRule(
+        ["host", "v"],
+        ["host < 'h5'", "host >= 'h5' and v < 100", "host >= 'h5' and v >= 100"],
+    )
+    t = pa.table(
+        {
+            "host": ["h1", "h7", "h9", "h2"],
+            "v": [1.0, 50.0, 200.0, 500.0],
+        }
+    )
+    idx = rule.partition_indices(t)
+    assert list(idx) == [0, 1, 2, 0]
+    parts = rule.split(t)
+    assert [p.num_rows for p in parts] == [2, 1, 1]
+
+
+def test_multi_dim_rule_incomplete_errors():
+    rule = MultiDimPartitionRule(["v"], ["v < 10"])
+    t = pa.table({"v": [5.0, 50.0]})
+    with pytest.raises(ValueError):
+        rule.partition_indices(t)
+
+
+def test_multi_dim_rule_roundtrip():
+    rule = MultiDimPartitionRule(["a"], ["a < 10", "a >= 10"])
+    d = rule.to_dict()
+    back = PartitionRule.from_dict(d)
+    assert isinstance(back, MultiDimPartitionRule)
+    t = pa.table({"a": [1, 99]})
+    assert list(back.partition_indices(t)) == [0, 1]
+
+
+def test_create_table_partition_on_columns(tmp_path):
+    db = Database(data_home=str(tmp_path))
+    db.sql(
+        "CREATE TABLE pt (host STRING, v DOUBLE, ts TIMESTAMP TIME INDEX,"
+        " PRIMARY KEY(host))"
+        " PARTITION ON COLUMNS (host) (host < 'm', host >= 'm')"
+    )
+    meta = db.catalog.table("pt")
+    assert len(meta.region_ids) == 2
+    db.sql("INSERT INTO pt VALUES ('apple', 1.0, 0), ('zebra', 2.0, 1000), ('kiwi', 3.0, 2000)")
+    t = db.sql_one("SELECT host, v FROM pt ORDER BY host")
+    assert t.column("host").to_pylist() == ["apple", "kiwi", "zebra"]
+    # rows actually land in distinct regions per the rule
+    r0 = db.storage.region(meta.region_ids[0]).scan()
+    r1 = db.storage.region(meta.region_ids[1]).scan()
+    assert sorted(r0.column("host").to_pylist()) == ["apple", "kiwi"]
+    assert r1.column("host").to_pylist() == ["zebra"]
+    db.close()
+
+
+def test_load_based_selector(tmp_path):
+    from greptimedb_tpu.distributed.cluster import Cluster
+
+    c = Cluster(str(tmp_path), num_datanodes=3)
+    try:
+        c.metasrv.selector = "load_based"
+        # preload node 0 with fake routes so it reads as loaded
+        c.metasrv.set_route(9999, {1: 0, 2: 0, 3: 0})
+        picks = [c.metasrv.select_datanode() for _ in range(4)]
+        assert 0 not in picks[:2]  # least-loaded nodes picked first
+    finally:
+        c.close()
+
+
+def test_multi_dim_parenthesized_exprs_roundtrip(tmp_path):
+    """OR/AND grouping must survive catalog persistence (to_sql keeps
+    parens; name() would drop them)."""
+    db = Database(data_home=str(tmp_path))
+    db.sql(
+        "CREATE TABLE pg (a STRING, b DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(a))"
+        " PARTITION ON COLUMNS (a, b)"
+        " ((a = 'x' OR a = 'y') AND b < 10, NOT ((a = 'x' OR a = 'y') AND b < 10))"
+    )
+    # (a='x', b=50): (x or y) and b<10 is FALSE -> partition 1
+    db.sql("INSERT INTO pg VALUES ('x', 50.0, 0), ('x', 5.0, 1000), ('z', 1.0, 2000)")
+    meta = db.catalog.table("pg")
+    r0 = db.storage.region(meta.region_ids[0]).scan()
+    r1 = db.storage.region(meta.region_ids[1]).scan()
+    assert sorted(zip(r0.column("a").to_pylist(), r0.column("b").to_pylist())) == [("x", 5.0)]
+    assert sorted(zip(r1.column("a").to_pylist(), r1.column("b").to_pylist())) == [
+        ("x", 50.0), ("z", 1.0),
+    ]
+    db.close()
