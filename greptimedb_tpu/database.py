@@ -67,9 +67,37 @@ from .utils.errors import (
 )
 
 
+class SessionState:
+    """Per-connection mutable state (reference session/src/context.rs
+    QueryContext: schema, timezone, cursors)."""
+
+    __slots__ = ("database", "timezone", "cursors")
+
+    def __init__(self):
+        self.database: str | None = None
+        self.timezone: str | None = None
+        self.cursors: dict = {}
+
+
+import contextvars as _contextvars
+
+# maps id(Database) -> SessionState within one connection's context
+_SESSION: _contextvars.ContextVar[dict | None] = _contextvars.ContextVar(
+    "gt_session", default=None
+)
+
+
 class Database:
-    def __init__(self, config: Config | None = None, data_home: str | None = None):
+    def __init__(
+        self,
+        config: Config | None = None,
+        data_home: str | None = None,
+        plugins=None,
+    ):
+        from .utils.plugins import Plugins
+
         self.config = config or Config()
+        self.plugins = plugins or Plugins()
         if data_home is not None:
             self.config.storage.data_home = data_home
             self.config.storage.wal_dir = os.path.join(data_home, "wal")
@@ -91,7 +119,6 @@ class Database:
         # its own thread, so USE / startup database choices must not leak
         # across connections sharing this Database.
         self._default_database = DEFAULT_SCHEMA
-        self._session = threading.local()
         from .models.process import ProcessManager
 
         # Running-query registry behind information_schema.process_list and
@@ -128,17 +155,89 @@ class Database:
             view_provider=self._view_stmt,
             vector_search_provider=self._vector_search,
         )
+        from collections import OrderedDict
+
+        from .utils.telemetry_report import TelemetryTask
+
+        # plan cache: (sql text, database) -> (catalog revision, plan, schema)
+        self._plan_cache: OrderedDict = OrderedDict()
+        self._plan_cache_lock = threading.Lock()
+        self.telemetry = TelemetryTask(self, self.config.telemetry).start()
         self._reopen_regions()
+
+    # ---- session state (reference session QueryContext) -------------------
+    # Stored in a contextvar holding MUTABLE per-connection state, not a
+    # threading.local: query execution hops to the kernel-executor thread
+    # (utils/kernel_executor.py), which runs closures under a COPY of the
+    # caller's context — mutations land in the shared SessionState object,
+    # so SET/USE made inside executed statements stay visible to the
+    # connection thread, while separate connections stay isolated.
+    def ensure_session(self):
+        """Get-or-create this connection's session.  Protocol servers call
+        this on their handler thread before dispatching work so the state
+        object is anchored in the connection's own context."""
+        sessions = _SESSION.get()
+        if sessions is None:
+            sessions = {}
+            _SESSION.set(sessions)
+        s = sessions.get(id(self))
+        if s is None:
+            s = sessions[id(self)] = SessionState()
+        return s
 
     @property
     def current_database(self) -> str:
-        return getattr(self._session, "database", None) or self._default_database
+        return self.ensure_session().database or self._default_database
 
     @current_database.setter
     def current_database(self, value: str):
-        self._session.database = value
+        self.ensure_session().database = value
+
+    # ---- session timezone (reference QueryContext timezone) ---------------
+    @property
+    def session_timezone(self) -> str:
+        return self.ensure_session().timezone or "UTC"
+
+    def set_session_timezone(self, tz: str):
+        self.session_tz_offset_minutes(tz)  # validates
+        self.ensure_session().timezone = tz
+
+    def session_tz_offset_minutes(self, tz: str | None = None) -> int:
+        """Current offset of the session zone (validation + fixed-offset
+        rendering); DST-correct per-value conversion uses session_tzinfo."""
+        info = self.session_tzinfo(tz)
+        if info is None:
+            return 0
+        import datetime as _dt
+
+        off = _dt.datetime.now(_dt.timezone.utc).astimezone(info).utcoffset()
+        return int(off.total_seconds() // 60) if off else 0
+
+    def session_tzinfo(self, tz: str | None = None):
+        """tzinfo for the session zone, or None for UTC.  Named zones keep
+        their DST rules so each VALUE converts with the offset in force at
+        that instant (the reference converts per-value the same way)."""
+        tz = tz if tz is not None else self.session_timezone
+        t = tz.strip()
+        if t.upper() in ("UTC", "GMT", "SYSTEM", "Z", ""):
+            return None
+        import datetime as _dt
+        import re as _re
+
+        m = _re.match(r"^([+-])(\d{1,2}):(\d{2})$", t)
+        if m:
+            sign = 1 if m.group(1) == "+" else -1
+            minutes = sign * (int(m.group(2)) * 60 + int(m.group(3)))
+            return _dt.timezone(_dt.timedelta(minutes=minutes))
+        try:
+            from zoneinfo import ZoneInfo
+
+            return ZoneInfo(t)
+        except Exception as exc:  # noqa: BLE001
+            raise InvalidArgumentsError(f"unknown time zone: {tz!r}") from exc
 
     def close(self):
+        self.telemetry.stop()
         self.event_recorder.stop()
         self.flows.stop()
         self.storage.close()
@@ -147,9 +246,27 @@ class Database:
     def sql(self, text: str):
         """Execute ;-separated SQL; returns a list of results (pa.Table for
         queries, int affected-rows for writes, None for DDL)."""
+        from .utils.plugins import SqlQueryInterceptor
+
+        interceptors = self.plugins.get_all(SqlQueryInterceptor)
+        ctx = {"database": self.current_database}
+        for ic in interceptors:
+            text = ic.pre_parsing(text, ctx)
+        stmts = parse_sql(text)
+        # plan-cacheable only when the text is exactly one SELECT (the cache
+        # key is the full text; see _execute).  ALIGN TO NOW plans are
+        # rejected at plan level (plan_uncacheable) wherever they nest.
+        cacheable = len(stmts) == 1 and isinstance(stmts[0], SelectStmt)
         results = []
-        for stmt in parse_sql(text):
-            results.append(self._execute(stmt, query_text=text))
+        for stmt in stmts:
+            for ic in interceptors:
+                ic.pre_execute(stmt, ctx)
+            result = self._execute(
+                stmt, query_text=text, plan_cacheable=cacheable
+            )
+            for ic in interceptors:
+                result = ic.post_execute(stmt, result, ctx)
+            results.append(result)
         return results
 
     def sql_one(self, text: str):
@@ -157,7 +274,7 @@ class Database:
         return out[-1] if out else None
 
     # ---- dispatch (reference StatementExecutor::execute_stmt) -------------
-    def _execute(self, stmt, query_text: str | None = None):
+    def _execute(self, stmt, query_text: str | None = None, plan_cacheable: bool = False):
         from .utils.events import SlowQueryTimer
 
         if isinstance(stmt, SelectStmt):
@@ -167,6 +284,8 @@ class Database:
                 self.event_recorder, self.config.slow_query,
                 query_text or "SELECT ...", self.current_database,
             ):
+                if plan_cacheable and query_text:
+                    return self._execute_select_cached(stmt, query_text)
                 return self.query_engine.execute_select(stmt, self.current_database)
         if isinstance(stmt, CreateTableStmt):
             return self._create_table(stmt)
@@ -252,7 +371,20 @@ class Database:
             return self._truncate(stmt)
         if isinstance(stmt, CopyStmt):
             return self._copy(stmt)
-        if isinstance(stmt, (SetStmt, TransactionStmt)):
+        if isinstance(stmt, SetStmt):
+            # session variables (reference session/src/context.rs): the
+            # timezone affects timestamp TEXT rendering on the wire servers;
+            # everything else is accepted client-bootstrap noise
+            import re as _re
+
+            m = _re.match(
+                r"(?is)^(?:set\s+)?(?:session\s+|local\s+)?(?:@@)?(?:session\.)?time[\s_]*zone\s*(?:=|to)?\s*'?([^';]+)'?",
+                stmt.raw,
+            )
+            if m:
+                self.set_session_timezone(m.group(1).strip())
+            return None
+        if isinstance(stmt, TransactionStmt):
             return None  # accepted client-bootstrap no-ops
         raise UnsupportedError(f"unsupported statement: {type(stmt).__name__}")
 
@@ -890,10 +1022,7 @@ class Database:
     def _session_cursors(self) -> dict:
         """Per-thread (per-connection) open cursors, like the reference's
         per-session cursor map (session QueryContext)."""
-        cursors = getattr(self._session, "cursors", None)
-        if cursors is None:
-            cursors = self._session.cursors = {}
-        return cursors
+        return self.ensure_session().cursors
 
     def _region_scan(self, scan: TableScan) -> list[pa.Table]:
         from .models import information_schema as info
@@ -1025,6 +1154,35 @@ class Database:
         if not tables:
             return meta.schema.to_arrow().empty_table()
         return pa.concat_tables(tables, promote_options="permissive")
+
+    def _execute_select_cached(self, stmt, query_text: str) -> pa.Table:
+        """Plan cache for repeated query texts (prepared statements re-parse
+        per execute in the reference's MySQL shim; this is the plan-cache
+        tier it lacks).  Keyed by (text, database); any catalog mutation —
+        DDL, view change, repartition — bumps catalog.revision and
+        invalidates."""
+        key = (query_text, self.current_database)
+        with self._plan_cache_lock:
+            hit = self._plan_cache.get(key)
+            if hit is not None and hit[0] == self.catalog.revision:
+                self._plan_cache.move_to_end(key)
+            else:
+                hit = None
+        if hit is not None:
+            plan, schema = hit[1], hit[2]
+        else:
+            from .query.planner import plan_query, plan_uncacheable
+
+            plan, schema = plan_query(
+                stmt, self._schema_of, self.current_database, self._view_stmt
+            )
+            if not plan_uncacheable(plan):
+                with self._plan_cache_lock:
+                    self._plan_cache[key] = (self.catalog.revision, plan, schema)
+                    self._plan_cache.move_to_end(key)
+                    while len(self._plan_cache) > 256:
+                        self._plan_cache.popitem(last=False)
+        return self.query_engine.execute_plan(plan, schema)
 
     def _view_stmt(self, name: str, database: str):
         """view_provider for the planner: view name -> freshly parsed

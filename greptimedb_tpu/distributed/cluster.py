@@ -164,10 +164,13 @@ class Cluster:
             RepartitionProcedure,
         )
 
+        from .ddl import DropTableProcedure
+
         self.procedures = ProcedureManager(self.kv, services={"cluster": self})
         self.procedures.register(RepartitionProcedure)
         self.procedures.register(ReconcileTableProcedure)
         self.procedures.register(ReconcileDatabaseProcedure)
+        self.procedures.register(DropTableProcedure)
         # Per-table write locks close the fence-check/write race with the
         # repartition procedure's write fence (see insert()).
         import threading
@@ -229,6 +232,8 @@ class Cluster:
             meta = self.catalog.table(table, database)
             if meta.options.get("repartitioning"):
                 raise RetryLaterError(f"table {table!r} is repartitioning; retry the write")
+            if meta.options.get("dropping"):
+                raise TableNotFoundError(f"table {table!r} is being dropped")
             routes = self.metasrv.get_route(meta.table_id)
             t = pa.Table.from_batches([batch])
             affected = 0
@@ -366,6 +371,13 @@ class Cluster:
         proc = ReconcileDatabaseProcedure.create(database)
         self.procedures.submit(proc)
         return proc.state["actions"]
+
+    def drop_table(self, table: str, database: str = "public") -> str:
+        """Resumable DROP TABLE via the procedure framework (reference
+        common/meta/src/ddl/drop_table.rs)."""
+        from .ddl import DropTableProcedure
+
+        return self.procedures.submit(DropTableProcedure.create(database, table))
 
     def migrate_region(self, table: str, region_id: int, to_node: int, database: str = "public") -> str:
         """Planned region movement to a specific datanode (reference

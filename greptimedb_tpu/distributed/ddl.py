@@ -1,0 +1,76 @@
+"""Procedure-driven cluster DDL: resumable DROP TABLE.
+
+Role-equivalent of the reference's DDL procedures
+(reference common/meta/src/ddl/drop_table.rs + drop_table/: a durable
+state machine that tombstones metadata, closes/destroys regions on every
+datanode, then commits the metadata removal — resumable at each step after
+a metasrv crash, with the tombstone preventing half-dropped tables from
+serving reads).
+
+Create remains callback-atomic in the catalog (create_table's on_create);
+drop is where crash-resumability earns its keep: region teardown spans
+multiple datanodes.
+"""
+
+from __future__ import annotations
+
+from .procedure import DONE, EXECUTING, Procedure
+
+
+class DropTableProcedure(Procedure):
+    """Steps: tombstone -> close_regions -> remove_metadata -> done.
+
+    State: {database, table, step, table_id, routes {rid: node}}."""
+
+    type_name = "drop_table"
+
+    @classmethod
+    def create(cls, database: str, table: str) -> "DropTableProcedure":
+        return cls(state={"database": database, "table": table})
+
+    def lock_keys(self):
+        return [f"table/{self.state['database']}.{self.state['table']}"]
+
+    def execute(self, ctx):
+        cluster = ctx.services["cluster"]
+        step = self.state.get("step", "tombstone")
+        if step == "tombstone":
+            # mark the table dropping (reference DdlMeta tombstone keys):
+            # writes fence immediately; the catalog entry survives until the
+            # regions are gone so a crashed drop can resume
+            meta = cluster.catalog.table(self.state["table"], self.state["database"])
+            self.state["table_id"] = meta.table_id
+            self.state["routes"] = {
+                str(rid): node
+                for rid, node in cluster.metasrv.get_route(meta.table_id).items()
+            }
+            meta.options["dropping"] = True
+            cluster.catalog.update_table(meta)
+            self.state["step"] = "close_regions"
+            return EXECUTING
+        if step == "close_regions":
+            alive = [d for d in cluster.datanodes.values() if d.alive]
+            for rid, node in self.state["routes"].items():
+                dn = cluster.datanodes.get(node)
+                # destroy, not just close: SSTs/WAL/manifest go too
+                # (reference drop_table destroys regions and GCs files).
+                # Regions live on SHARED storage, so when the owning node is
+                # dead any live engine can delete the region's directories.
+                target = dn if (dn is not None and dn.alive) else (alive[0] if alive else None)
+                if target is None:
+                    continue
+                try:
+                    target.engine.drop_region(int(rid))
+                except Exception:  # noqa: BLE001 — already dropped: resume-safe
+                    pass
+            self.state["step"] = "remove_metadata"
+            return EXECUTING
+        if step == "remove_metadata":
+            cluster.metasrv.set_route(self.state["table_id"], {})
+            try:
+                cluster.catalog.drop_table(self.state["table"], self.state["database"])
+            except Exception:  # noqa: BLE001 — already dropped: resume-safe
+                pass
+            self.state["step"] = "done"
+            return DONE
+        return DONE

@@ -1,0 +1,186 @@
+"""Metasrv as a network service + MetaClient.
+
+Role-equivalent of the reference's metasrv gRPC services and meta-client
+crate (reference meta-srv/src/service/: heartbeat/store/procedure/cluster;
+meta-client/src/client.rs with ask_leader + sub-clients): the cluster
+brain becomes separately addressable — frontends and datanodes in OTHER
+processes reach routes, heartbeats, placement, and migration over the
+wire instead of in-process calls.
+
+Transport is JSON-over-HTTP on the stdlib server (the serving plane has no
+tonic here; the method surface and semantics mirror the gRPC services).
+`MetaClient.ask_leader` probes every configured peer and locks onto the
+elected leader, re-probing on failure — the reference's leader-discovery
+loop (meta-client/src/client.rs ask_leader.rs).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.errors import IllegalStateError
+from .metasrv import Metasrv
+
+
+class MetasrvServer:
+    """Serves one Metasrv instance over HTTP."""
+
+    def __init__(self, metasrv: Metasrv, addr: str = "127.0.0.1:0"):
+        self.metasrv = metasrv
+        host, port = addr.rsplit(":", 1)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n).decode() or "{}")
+                try:
+                    out = outer._dispatch(self.path, body)
+                    code = 200
+                except IllegalStateError as e:
+                    out, code = {"error": str(e)}, 409
+                except Exception as e:  # noqa: BLE001
+                    out, code = {"error": f"{type(e).__name__}: {e}"}, 500
+                payload = json.dumps(out).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    def start(self) -> "MetasrvServer":
+        self._thread.start()
+        return self
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ---- service dispatch (reference meta-srv/src/service/) ---------------
+    def _dispatch(self, path: str, body: dict) -> dict:
+        m = self.metasrv
+        if path == "/leader":
+            # ask_leader: non-leaders answer with who leads
+            is_leader = m.is_leader()
+            leader = None
+            if m.election is not None:
+                leader = m.election.leader()
+            return {"is_leader": is_leader, "leader": leader}
+        if path == "/register":
+            m.register_datanode(int(body["node_id"]))
+            return {"ok": True}
+        if not m.is_leader():
+            raise IllegalStateError("not the metasrv leader")
+        if path == "/heartbeat":
+            return m.handle_heartbeat(
+                int(body["node_id"]), body.get("stats", []), float(body["now_ms"])
+            )
+        if path == "/route/get":
+            return {"routes": {str(k): v for k, v in m.get_route(int(body["table_id"])).items()}}
+        if path == "/route/set":
+            m.set_route(int(body["table_id"]), {int(k): v for k, v in body["routes"].items()})
+            return {"ok": True}
+        if path == "/select":
+            node = m.select_datanode(exclude=set(body.get("exclude", [])))
+            return {"node_id": node}
+        if path == "/migrate":
+            pid = m.migrate_region(
+                int(body["table_id"]), int(body["region_id"]), int(body["to_node"])
+            )
+            return {"procedure_id": pid}
+        if path == "/tick":
+            return {"submitted": m.tick(float(body["now_ms"]))}
+        raise ValueError(f"unknown path {path}")
+
+
+class MetaClient:
+    """Client handle with the Metasrv method surface, over the wire
+    (reference meta-client): probes peers for the leader, retries once on
+    leadership change."""
+
+    def __init__(self, peers: list[str]):
+        self.peers = list(peers)
+        self._leader: str | None = None
+
+    # ---- leader discovery --------------------------------------------------
+    def ask_leader(self) -> str:
+        for peer in self.peers:
+            try:
+                out = self._post(peer, "/leader", {})
+            except OSError:
+                continue
+            if out.get("is_leader"):
+                self._leader = peer
+                return peer
+        raise IllegalStateError(f"no metasrv leader among {self.peers}")
+
+    def _call(self, path: str, body: dict) -> dict:
+        if self._leader is None:
+            self.ask_leader()
+        try:
+            return self._post(self._leader, path, body)
+        except (OSError, IllegalStateError):
+            # leadership moved: re-probe once (reference ask_leader retry)
+            self._leader = None
+            self.ask_leader()
+            return self._post(self._leader, path, body)
+
+    @staticmethod
+    def _post(peer: str, path: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            f"http://{peer}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode()
+            try:
+                msg = json.loads(detail).get("error", detail)
+            except ValueError:
+                msg = detail
+            if e.code == 409:
+                raise IllegalStateError(msg) from e
+            raise RuntimeError(f"metasrv error {e.code}: {msg}") from e
+
+    # ---- Metasrv surface ---------------------------------------------------
+    def register_datanode(self, node_id: int):
+        self._call("/register", {"node_id": node_id})
+
+    def handle_heartbeat(self, node_id: int, stats: list, now_ms: float) -> dict:
+        return self._call("/heartbeat", {"node_id": node_id, "stats": stats, "now_ms": now_ms})
+
+    def get_route(self, table_id: int) -> dict[int, int]:
+        out = self._call("/route/get", {"table_id": table_id})
+        return {int(k): v for k, v in out["routes"].items()}
+
+    def set_route(self, table_id: int, routes: dict[int, int]):
+        self._call("/route/set", {"table_id": table_id, "routes": {str(k): v for k, v in routes.items()}})
+
+    def select_datanode(self, exclude=frozenset()) -> int | None:
+        return self._call("/select", {"exclude": sorted(exclude)})["node_id"]
+
+    def migrate_region(self, table_id: int, region_id: int, to_node: int) -> str:
+        return self._call(
+            "/migrate",
+            {"table_id": table_id, "region_id": region_id, "to_node": to_node},
+        )["procedure_id"]
+
+    def tick(self, now_ms: float) -> list[str]:
+        return self._call("/tick", {"now_ms": now_ms})["submitted"]

@@ -89,6 +89,9 @@ class Catalog:
         self._databases: dict[str, dict[str, TableMeta]] = {DEFAULT_SCHEMA: {}}
         self._views: dict[str, dict[str, str]] = {}  # db -> name -> SQL text
         self._next_table_id = 1024  # reference reserves low ids for system tables
+        # Bumped on every mutation — plan caches key on it so DDL invalidates
+        # cached plans (the reference invalidates via KV cache broadcasts).
+        self.revision = 0
         if path and os.path.exists(path):
             self._load()
 
@@ -248,6 +251,7 @@ class Catalog:
         return self._databases[database]
 
     def _persist(self):
+        self.revision += 1
         if not self.path:
             return
         state = {

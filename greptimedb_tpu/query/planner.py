@@ -608,6 +608,10 @@ def _plan_range_select(
         by_exprs=by_exprs,
         aggs=aggs,
     )
+    if align.to == "now":
+        # the origin was frozen at plan time: a plan cache must never reuse
+        # this plan (plan_uncacheable() walks for the marker)
+        plan._uncacheable = True
     plan = Project(plan, new_projections)
     if stmt.order_by:
         keys = [(_resolve_order_key(e, new_projections), asc) for e, asc in stmt.order_by]
@@ -623,6 +627,15 @@ def _plan_range_select(
     if stmt.limit is not None or stmt.offset:
         plan = Limit(plan, stmt.limit, stmt.offset)
     return plan
+
+
+def plan_uncacheable(plan: LogicalPlan) -> bool:
+    """True when any node froze query-time state at plan time (ALIGN TO
+    NOW origins) — such plans must never be served from a plan cache,
+    regardless of how deeply (subquery, view, CTE) the node is buried."""
+    if getattr(plan, "_uncacheable", False):
+        return True
+    return any(plan_uncacheable(c) for c in plan.children())
 
 
 def _resolve_order_key(e: Expr, projections: list[Expr]) -> Expr:

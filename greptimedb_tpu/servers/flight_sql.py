@@ -34,6 +34,7 @@ class FrontendFlightServer(fl.FlightServerBase):
 
     # ---- queries (do_get: ticket = {"sql": ...}) --------------------------
     def do_get(self, context, ticket: fl.Ticket):
+        self.db.ensure_session()
         body = json.loads(ticket.ticket.decode())
         sql = body["sql"]
         # per-request database selection must not leak into later requests

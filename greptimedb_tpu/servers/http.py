@@ -117,6 +117,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _dispatch(self):
         try:
+            self.db.ensure_session()  # per-request session anchor
             route = self.route
             params = self._params()
             if route == "/health" or route == "/ping":
@@ -155,6 +156,10 @@ class _Handler(BaseHTTPRequestHandler):
             if route.startswith("/v1/jaeger/api/") or route.startswith("/jaeger/api/"):
                 endpoint = route.split("/api/", 1)[1]
                 return self._handle_jaeger(endpoint, params)
+            if route == "/debug/prof/cpu":
+                return self._handle_prof_cpu(params)
+            if route == "/debug/prof/mem":
+                return self._handle_prof_mem(params)
             return self._send(404, {"error": f"no route {route}"})
         except GreptimeError as e:
             self._send(400, {"error": str(e), "code": int(e.status_code())})
@@ -212,6 +217,62 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", "0")
         self.end_headers()
         return n
+
+    def _handle_prof_cpu(self, params):
+        """Statistical CPU profile of live traffic for N seconds (reference
+        /debug/prof/cpu via common/pprof's sampling pprof-rs): samples every
+        thread's stack at ~100 Hz and renders the hottest frames, flamegraph-
+        style folded lines."""
+        import sys
+        import time as _time
+        from collections import Counter as _Counter
+
+        seconds = min(float(params.get("seconds", "2")), 30.0)
+        me = __import__("threading").get_ident()
+        counts: _Counter = _Counter()
+        deadline = _time.monotonic() + seconds
+        samples = 0
+        while _time.monotonic() < deadline:
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                stack = []
+                f = frame
+                while f is not None and len(stack) < 24:
+                    code = f.f_code
+                    stack.append(f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno})")
+                    f = f.f_back
+                counts[";".join(reversed(stack))] += 1
+            samples += 1
+            _time.sleep(0.01)
+        lines = [f"cpu profile: {samples} sampling rounds over {seconds}s"]
+        for stack, n in counts.most_common(50):
+            lines.append(f"{n} {stack}")
+        return self._send(200, ("\n".join(lines) + "\n").encode(), "text/plain")
+
+    def _handle_prof_mem(self, params):
+        """Heap snapshot (reference /debug/prof/mem via jemalloc heap
+        profiling; here tracemalloc top allocations)."""
+        import tracemalloc
+
+        top_n = int(params.get("top", "40"))
+        started_here = not tracemalloc.is_tracing()
+        if started_here:
+            # first call arms tracing and reports from now on (jemalloc's
+            # activation flag works the same way)
+            tracemalloc.start()
+            return self._send(
+                200,
+                b"tracemalloc armed; call again for a snapshot\n",
+                "text/plain",
+            )
+        snap = tracemalloc.take_snapshot()
+        lines = [f"heap top {top_n} by size:"]
+        for stat in snap.statistics("lineno")[:top_n]:
+            lines.append(str(stat))
+        total = sum(s.size for s in snap.statistics("filename"))
+        lines.append(f"total traced: {total / 1024 / 1024:.1f} MiB")
+        return self._send(200, ("\n".join(lines) + "\n").encode(), "text/plain")
 
     def _handle_jaeger(self, endpoint: str, params):
         from . import jaeger

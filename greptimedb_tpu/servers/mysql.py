@@ -131,7 +131,7 @@ def _arrow_to_mysql_type(t: pa.DataType) -> int:
     return MYSQL_TYPE_VAR_STRING
 
 
-def _render_value(v) -> bytes | None:
+def _render_value(v, tzinfo=None) -> bytes | None:
     if v is None:
         return None
     if isinstance(v, bool):
@@ -139,6 +139,11 @@ def _render_value(v) -> bytes | None:
     if isinstance(v, bytes):
         return v
     if hasattr(v, "isoformat"):  # datetime from timestamp columns
+        if tzinfo is not None:
+            import datetime as _dt
+
+            # per-value conversion: DST-correct for named zones
+            v = v.replace(tzinfo=_dt.timezone.utc).astimezone(tzinfo).replace(tzinfo=None)
         return v.isoformat(sep=" ").encode()
     if isinstance(v, float):
         # Match MySQL's shortest-roundtrip float rendering.
@@ -156,6 +161,7 @@ class _Session:
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         srv: MysqlServer = self.server.gt_server  # type: ignore[attr-defined]
+        srv.db.ensure_session()  # anchor per-connection session state
         io = _PacketIO(self.request)
         session = _Session(srv)
         nonce = os.urandom(20)
@@ -287,6 +293,15 @@ class _Handler(socketserver.BaseRequestHandler):
                 return self._send_resultset(io, pa.table({f"@@{name}": [""]}))
             if lowered == "select 1":
                 return self._send_resultset(io, pa.table({"1": [1]}))
+            if lowered.startswith("set "):
+                # session variables (time_zone...) must reach the session
+                # before we ack (reference handler records them the same way)
+                from ..utils import kernel_executor as _ke
+
+                try:
+                    _ke.run(lambda: list(srv.db.sql(sql)))
+                except Exception:  # noqa: BLE001 — unknown SETs stay no-ops
+                    pass
             return self._send_ok(io)
         from ..utils import kernel_executor
 
@@ -297,9 +312,9 @@ class _Handler(socketserver.BaseRequestHandler):
         elif isinstance(result, int):
             self._send_ok(io, affected=result)
         else:
-            self._send_resultset(io, result, binary=binary)
+            self._send_resultset(io, result, binary=binary, db=srv.db)
 
-    def _send_resultset(self, io: _PacketIO, table: pa.Table, binary: bool = False):
+    def _send_resultset(self, io: _PacketIO, table: pa.Table, binary: bool = False, db=None):
         io.send_packet(_lenenc_int(table.num_columns))
         for name in table.column_names:
             col_type = _arrow_to_mysql_type(table.schema.field(name).type)
@@ -322,13 +337,16 @@ class _Handler(socketserver.BaseRequestHandler):
         self._send_eof(io)
         cols = [table.column(i).to_pylist() for i in range(table.num_columns)]
         types = [table.schema.field(i).type for i in range(table.num_columns)]
+        # session time-zone shifts TEXT-rendered timestamps (reference
+        # QueryContext timezone; binary protocol ships raw values)
+        tzinfo = db.session_tzinfo() if db is not None else None
         for r in range(table.num_rows):
             if binary:
                 io.send_packet(self._binary_row(cols, types, r))
             else:
                 row = bytearray()
                 for c in cols:
-                    v = _render_value(c[r])
+                    v = _render_value(c[r], tzinfo)
                     row += b"\xfb" if v is None else _lenenc_str(v)
                 io.send_packet(bytes(row))
         self._send_eof(io)

@@ -48,7 +48,7 @@ def _oid_of(t: pa.DataType) -> int:
     return OID_TEXT
 
 
-def _render(v) -> bytes | None:
+def _render(v, tzinfo=None) -> bytes | None:
     import datetime
     import math
 
@@ -59,6 +59,9 @@ def _render(v) -> bytes | None:
     if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
         return str(v).encode()
     if isinstance(v, datetime.datetime):
+        if tzinfo is not None:
+            # per-value conversion: DST-correct for named zones
+            v = v.replace(tzinfo=datetime.timezone.utc).astimezone(tzinfo).replace(tzinfo=None)
         return v.strftime("%Y-%m-%d %H:%M:%S.%f").encode()
     return str(v).encode()
 
@@ -155,6 +158,7 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         sock = self.request
         srv = self.server.gt_server  # type: ignore[attr-defined]
+        srv.db.ensure_session()  # anchor per-connection session state
         try:
             params = self._startup(sock)
             if params is None:
@@ -307,9 +311,10 @@ class _Handler(socketserver.BaseRequestHandler):
                     result = p["result"]
                     p["result"] = None
                     cols = [c.to_pylist() for c in result.columns]
+                    tzinfo = srv.db.session_tzinfo()
                     for r in range(result.num_rows):
                         sock.sendall(
-                            _Msg.data_row([_render(col[r]) for col in cols])
+                            _Msg.data_row([_render(col[r], tzinfo) for col in cols])
                         )
                     sock.sendall(_Msg.command_complete(f"SELECT {result.num_rows}"))
                 else:
@@ -380,9 +385,10 @@ class _Handler(socketserver.BaseRequestHandler):
                         if describe:
                             sock.sendall(_Msg.row_description(result))
                         cols = [c.to_pylist() for c in result.columns]
+                        tzinfo = srv.db.session_tzinfo()
                         for r in range(result.num_rows):
                             sock.sendall(
-                                _Msg.data_row([_render(col[r]) for col in cols])
+                                _Msg.data_row([_render(col[r], tzinfo) for col in cols])
                             )
                         sock.sendall(
                             _Msg.command_complete(f"SELECT {result.num_rows}")

@@ -68,6 +68,11 @@ class TimeSeriesEngine:
         self._regions: dict[int, Region] = {}
         self._lock = threading.Lock()
         self.compactor = None
+        self.flusher = None
+        if getattr(self.config, "async_flush_enable", True):
+            from .maintenance import FlushScheduler
+
+            self.flusher = FlushScheduler(self)
         if getattr(self.config, "compaction_background_enable", True):
             from .maintenance import CompactionScheduler
 
@@ -165,7 +170,12 @@ class TimeSeriesEngine:
         rows = region.write(batch)
         self.buffer_mgr.set_region_usage(region_id, region.memtable.memory_usage)
         if self.buffer_mgr.should_flush_region(region_id) or self.buffer_mgr.should_flush_engine():
-            self.flush_region(region_id)
+            # threshold flush runs OFF the write path (reference
+            # FlushScheduler); stall flushes above stay synchronous
+            if self.flusher is not None:
+                self.flusher.schedule(region_id)
+            else:
+                self.flush_region(region_id)
         return rows
 
     def delete(self, region_id: int, keys: pa.Table) -> int:
@@ -182,7 +192,10 @@ class TimeSeriesEngine:
         deleted = region.delete(keys)
         self.buffer_mgr.set_region_usage(region_id, region.memtable.memory_usage)
         if self.buffer_mgr.should_flush_region(region_id) or self.buffer_mgr.should_flush_engine():
-            self.flush_region(region_id)
+            if self.flusher is not None:
+                self.flusher.schedule(region_id)
+            else:
+                self.flush_region(region_id)
         return deleted
 
     def truncate_region(self, region_id: int):
@@ -199,6 +212,8 @@ class TimeSeriesEngine:
             self.compactor.notify_flush(region_id)
 
     def flush_all(self):
+        if self.flusher is not None:
+            self.flusher.wait_idle()
         for rid in self.region_ids():
             self.flush_region(rid)
 
@@ -231,6 +246,8 @@ class TimeSeriesEngine:
         yield from self.region(region_id).scan_windows(pred, columns, governor=governor)
 
     def close(self):
+        if self.flusher is not None:
+            self.flusher.stop()
         if self.compactor is not None:
             self.compactor.stop()
         self.wal_mgr.close()

@@ -20,6 +20,69 @@ import threading
 from ..utils import metrics
 
 
+class FlushScheduler:
+    """Background flush worker: threshold-triggered flushes run OFF the
+    write path (reference mito2/src/flush.rs FlushScheduler — the write
+    loop only signals; a scheduler task does the Parquet encode + upload).
+    Stall-triggered flushes stay synchronous in the engine: that is the
+    backpressure mechanism, not an optimization target.
+
+    This is the §2.5 pipeline-parallelism axis for ingest: WAL append +
+    memtable insert proceed for new writes while earlier memtables encode
+    to SSTs on this thread."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._cv = threading.Condition()
+        self._pending: set[int] = set()
+        self._inflight: set[int] = set()
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, name="flush-scheduler", daemon=True)
+        self._thread.start()
+
+    def schedule(self, region_id: int):
+        with self._cv:
+            # always enqueue — a trigger during an in-flight flush means NEW
+            # rows landed in the fresh memtable; dropping it would leave an
+            # over-threshold memtable unflushed once writes stop
+            self._pending.add(region_id)
+            self._cv.notify()
+
+    def wait_idle(self, timeout: float = 30.0):
+        """Block until no flush is pending or running (tests, shutdown)."""
+        import time as _t
+
+        deadline = _t.monotonic() + timeout
+        with self._cv:
+            while (self._pending or self._inflight) and _t.monotonic() < deadline:
+                self._cv.wait(0.05)
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._thread.join(timeout=10)
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._pending and not self._stop:
+                    self._cv.wait(1.0)
+                if self._stop and not self._pending:
+                    return
+                rid = self._pending.pop()
+                self._inflight.add(rid)
+            try:
+                self.engine.flush_region(rid)
+            except Exception:  # noqa: BLE001 — a failed flush retries on the
+                # next threshold trip; the WAL still holds the data
+                pass
+            finally:
+                with self._cv:
+                    self._inflight.discard(rid)
+                    self._cv.notify_all()
+
+
 class CompactionScheduler:
     def __init__(
         self,

@@ -117,6 +117,18 @@ class ServerConfig:
 
 
 @dataclasses.dataclass
+class TelemetryConfig:
+    """Anonymous usage telemetry (reference common/greptimedb-telemetry:
+    version/mode/node-count every N hours unless disabled).  Default OFF;
+    with no egress the report sinks to a local JSON file, where the
+    reference POSTs it."""
+
+    enable: bool = False
+    interval_hours: float = 6.0
+    sink_path: str = ""  # empty = <data_home>/telemetry_report.json
+
+
+@dataclasses.dataclass
 class SlowQueryConfig:
     """Slow-query recording (reference common/telemetry SlowQueryOptions +
     event recorder into greptime_private.slow_queries)."""
@@ -147,6 +159,7 @@ class Config:
     server: ServerConfig = dataclasses.field(default_factory=ServerConfig)
     slow_query: SlowQueryConfig = dataclasses.field(default_factory=SlowQueryConfig)
     memory: MemoryConfig = dataclasses.field(default_factory=MemoryConfig)
+    telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
 
     def __post_init__(self):
         self.storage.__post_init__()

@@ -53,10 +53,18 @@ def warm_up():
 
 def run(fn, *args, **kwargs):
     """Run `fn` on the kernel thread (inline if already on it, or if the
-    executor was never started and we're in library mode)."""
+    executor was never started and we're in library mode).
+
+    The closure executes under a COPY of the caller's context so
+    contextvar-based session state (Database SessionState) resolves to the
+    calling connection's objects — SET/USE made inside the statement mutate
+    the shared state object and stay visible to the connection."""
     if _executor is None or threading.get_ident() == _executor_thread_id:
         return fn(*args, **kwargs)
-    return _executor.submit(fn, *args, **kwargs).result()
+    import contextvars
+
+    ctx = contextvars.copy_context()
+    return _executor.submit(ctx.run, fn, *args, **kwargs).result()
 
 
 def started() -> bool:

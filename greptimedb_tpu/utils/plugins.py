@@ -1,0 +1,62 @@
+"""Plugins: typemap DI container + SQL interceptor hooks.
+
+Role-equivalent of the reference's `Plugins` (reference common/base, a
+type-keyed Send+Sync map threaded through every role builder) and the
+frontend's `SqlQueryInterceptorRef` extension point (reference
+frontend/src/instance.rs + plugins/src setup hooks, the surface enterprise
+builds attach auth/audit/rewrites to).
+
+Usage:
+    plugins = Plugins()
+    plugins.insert(MyInterceptor())           # keyed by its class
+    db = Database(..., plugins=plugins)
+    plugins.get(SqlQueryInterceptor)          # subclass-aware lookup
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class Plugins:
+    """Type-keyed container; lookups match exact class or subclasses."""
+
+    def __init__(self):
+        self._items: dict[type, object] = {}
+        self._lock = threading.Lock()
+
+    def insert(self, obj: object, key: type | None = None):
+        with self._lock:
+            self._items[key or type(obj)] = obj
+
+    def get(self, cls: type):
+        """The registered instance of `cls` (or a subclass), or None."""
+        with self._lock:
+            hit = self._items.get(cls)
+            if hit is not None:
+                return hit
+            for k, v in self._items.items():
+                if issubclass(k, cls):
+                    return v
+        return None
+
+    def get_all(self, cls: type) -> list:
+        with self._lock:
+            return [v for k, v in self._items.items() if issubclass(k, cls)]
+
+
+class SqlQueryInterceptor:
+    """Hook points around statement execution (reference
+    SqlQueryInterceptorRef: pre_parsing / pre_execute / post_execute).
+    Subclass and override; raise to reject, return to rewrite."""
+
+    def pre_parsing(self, sql: str, ctx: dict) -> str:
+        """Before the parser sees the text; return (possibly rewritten) SQL."""
+        return sql
+
+    def pre_execute(self, stmt, ctx: dict):
+        """After parse, before execution; raise to reject the statement."""
+
+    def post_execute(self, stmt, result, ctx: dict):
+        """After execution; return the (possibly transformed) result."""
+        return result

@@ -1,0 +1,93 @@
+"""Anonymous telemetry reporter.
+
+Role-equivalent of the reference's greptimedb-telemetry task
+(reference common/greptimedb-telemetry/src/lib.rs: a background task that
+reports version / mode / node count every N hours, disabled via
+`enable_telemetry`): same scheduling and payload shape; the transport is a
+local JSON sink because this environment has zero egress — swap `_emit`
+for an HTTP POST where the reference uses reqwest.
+
+Default OFF, like any respectable telemetry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+
+
+class TelemetryTask:
+    def __init__(self, db, config):
+        self.db = db
+        self.config = config
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # stable anonymous installation id, persisted next to the catalog
+        self._uuid_path = os.path.join(db.config.storage.data_home, ".telemetry_uuid")
+
+    def start(self):
+        if not self.config.enable:
+            return self
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="telemetry")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # ---- internals --------------------------------------------------------
+    def _install_id(self) -> str:
+        try:
+            with open(self._uuid_path) as f:
+                return f.read().strip()
+        except FileNotFoundError:
+            uid = uuid.uuid4().hex
+            with open(self._uuid_path, "w") as f:
+                f.write(uid)
+            return uid
+
+    def build_report(self) -> dict:
+        """The reference's payload shape (version/os/arch/mode/nodes)."""
+        import platform
+
+        n_tables = 0
+        try:
+            for database in self.db.catalog.databases():
+                n_tables += len(self.db.catalog.tables(database))
+        except Exception:  # noqa: BLE001 — never let telemetry break serving
+            pass
+        return {
+            "uuid": self._install_id(),
+            "version": "0.2.0-tpu",
+            "os": platform.system().lower(),
+            "arch": platform.machine(),
+            "mode": "standalone",
+            "nodes": 1,
+            "table_count": n_tables,
+            "ts": int(time.time()),
+        }
+
+    def _emit(self, report: dict):
+        path = self.config.sink_path or os.path.join(
+            self.db.config.storage.data_home, "telemetry_report.json"
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f)
+        os.replace(tmp, path)
+
+    def report_once(self):
+        self._emit(self.build_report())
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.report_once()
+            except Exception:  # noqa: BLE001
+                pass
+            self._stop.wait(self.config.interval_hours * 3600.0)

@@ -1,0 +1,86 @@
+"""Metasrv network service + MetaClient (reference meta-srv/src/service/ +
+meta-client with ask_leader failover)."""
+
+import pytest
+
+from greptimedb_tpu.distributed.election import LeaseElection
+from greptimedb_tpu.distributed.kv import MemoryKvBackend
+from greptimedb_tpu.distributed.meta_service import MetaClient, MetasrvServer
+from greptimedb_tpu.distributed.metasrv import Metasrv
+from greptimedb_tpu.utils.errors import IllegalStateError
+
+
+class _NullNodeManager:
+    def open_region(self, *a):
+        pass
+
+    def close_region_quiet(self, *a):
+        pass
+
+    def flush_region(self, *a):
+        pass
+
+    def set_region_writable(self, *a):
+        pass
+
+
+def test_meta_client_roundtrip():
+    kv = MemoryKvBackend()
+    m = Metasrv(kv, _NullNodeManager())
+    srv = MetasrvServer(m).start()
+    try:
+        client = MetaClient([srv.address])
+        assert client.ask_leader() == srv.address  # no election = always leader
+        client.register_datanode(1)
+        client.register_datanode(2)
+        reply = client.handle_heartbeat(1, [], 1000.0)
+        assert "lease_until_ms" in reply
+        client.set_route(42, {43008: 1, 43009: 2})
+        assert client.get_route(42) == {43008: 1, 43009: 2}
+        picked = client.select_datanode()
+        assert picked in (1, 2)
+        picked2 = client.select_datanode(exclude={picked})
+        assert picked2 != picked
+        assert client.tick(2000.0) == []
+    finally:
+        srv.stop()
+
+
+def test_meta_client_follows_leader():
+    """Two metasrvs behind elections: the client locks onto the leader and
+    re-probes when leadership moves."""
+    kv = MemoryKvBackend()
+    now = [0.0]
+    e1 = LeaseElection(kv, "m1", lease_ms=3000, clock=lambda: now[0])
+    e2 = LeaseElection(kv, "m2", lease_ms=3000, clock=lambda: now[0])
+    m1 = Metasrv(kv, _NullNodeManager(), election=e1)
+    m2 = Metasrv(kv, _NullNodeManager(), election=e2)
+    s1 = MetasrvServer(m1).start()
+    s2 = MetasrvServer(m2).start()
+    try:
+        assert e1.campaign() and not e2.campaign()
+        client = MetaClient([s1.address, s2.address])
+        client.set_route(7, {7168: 1})
+        assert client.ask_leader() == s1.address
+        # leadership moves to m2; the client's next call re-probes
+        now[0] += 10_000
+        assert e2.campaign()
+        assert client.get_route(7) == {7168: 1}  # served by m2 (shared KV)
+        assert client._leader == s2.address
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_meta_client_no_leader():
+    kv = MemoryKvBackend()
+    now = [0.0]
+    e = LeaseElection(kv, "m1", clock=lambda: now[0])
+    m = Metasrv(kv, _NullNodeManager(), election=e)
+    srv = MetasrvServer(m).start()
+    try:
+        client = MetaClient([srv.address])
+        with pytest.raises(IllegalStateError):
+            client.ask_leader()  # nobody campaigned
+    finally:
+        srv.stop()

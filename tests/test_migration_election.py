@@ -300,3 +300,62 @@ def test_standby_metasrv_promotes_and_supervises(tmp_path):
         assert t.column("count(*)").to_pylist() == [4]
     finally:
         c.close()
+
+
+# ---- procedure-driven DDL ---------------------------------------------------
+
+
+def test_drop_table_procedure(cluster):
+    schema = cpu_schema()
+    cluster.create_table("dp", schema, partitions=3)
+    table_id = cluster.catalog.table("dp").table_id
+    region_ids = set(cluster.catalog.table("dp").region_ids)
+    cluster.insert("dp", make_batch(schema, ["a"], [0], [1.0]))
+    cluster.drop_table("dp")
+    assert not cluster.catalog.has_table("dp")
+    assert cluster.metasrv.get_route(table_id) == {}
+    # every region is gone from every datanode (destroyed, not just closed)
+    for dn in cluster.datanodes.values():
+        hosted = {s["region_id"] for s in dn.region_stats()}
+        assert not (hosted & region_ids)
+    # region data directories were destroyed on shared storage
+    import os
+
+    for rid in region_ids:
+        any_dn = next(iter(cluster.datanodes.values()))
+        assert not os.path.isdir(any_dn.engine._region_dir(rid))
+
+
+def test_drop_table_procedure_crash_resume(cluster):
+    """A drop interrupted after the tombstone resumes and finishes: the
+    table must not stay half-dropped (reference drop_table procedure)."""
+    from greptimedb_tpu.distributed.procedure import (
+        EXECUTING,
+        PROC_PREFIX,
+        ProcedureRecord,
+    )
+
+    schema = cpu_schema()
+    cluster.create_table("dpc", schema, partitions=2)
+    meta = cluster.catalog.table("dpc")
+    routes = cluster.metasrv.get_route(meta.table_id)
+    # simulate: tombstone step ran, then the metasrv died
+    meta.options["dropping"] = True
+    cluster.catalog.update_table(meta)
+    rec = ProcedureRecord(
+        "drop1",
+        "drop_table",
+        EXECUTING,
+        {
+            "database": "public",
+            "table": "dpc",
+            "table_id": meta.table_id,
+            "routes": {str(r): n for r, n in routes.items()},
+            "step": "close_regions",
+        },
+    )
+    cluster.kv.put(PROC_PREFIX + "drop1", rec.to_json())
+    resumed = cluster.procedures.recover()
+    assert "drop1" in resumed
+    assert not cluster.catalog.has_table("dpc")
+    assert cluster.metasrv.get_route(meta.table_id) == {}

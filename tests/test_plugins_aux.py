@@ -1,0 +1,249 @@
+"""Plugins/interceptors, telemetry, session timezone, profiling endpoints.
+
+Reference: common/base Plugins + SqlQueryInterceptorRef,
+common/greptimedb-telemetry, session QueryContext timezone,
+servers /debug/prof/{cpu,mem}."""
+
+import json
+import urllib.request
+
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.utils.errors import GreptimeError, InvalidArgumentsError
+from greptimedb_tpu.utils.plugins import Plugins, SqlQueryInterceptor
+
+
+# ---- plugins / interceptors -------------------------------------------------
+
+
+class Auditor(SqlQueryInterceptor):
+    def __init__(self):
+        self.seen = []
+
+    def pre_parsing(self, sql, ctx):
+        self.seen.append(sql)
+        return sql
+
+
+class DropBlocker(SqlQueryInterceptor):
+    def pre_execute(self, stmt, ctx):
+        from greptimedb_tpu.query.sql_parser import DropStmt
+
+        if isinstance(stmt, DropStmt):
+            raise InvalidArgumentsError("DROP is blocked by policy")
+
+
+class RowLimiter(SqlQueryInterceptor):
+    def post_execute(self, stmt, result, ctx):
+        import pyarrow as pa
+
+        if isinstance(result, pa.Table) and result.num_rows > 1:
+            return result.slice(0, 1)
+        return result
+
+
+def test_interceptor_hooks(tmp_path):
+    plugins = Plugins()
+    auditor = Auditor()
+    plugins.insert(auditor)
+    plugins.insert(DropBlocker())
+    db = Database(data_home=str(tmp_path), plugins=plugins)
+    try:
+        db.sql("CREATE TABLE p (k STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY(k))")
+        db.sql("INSERT INTO p VALUES ('a', 1), ('b', 2)")
+        assert len(auditor.seen) == 2
+        with pytest.raises(GreptimeError):
+            db.sql("DROP TABLE p")
+        assert db.catalog.has_table("p")  # blocked before execution
+    finally:
+        db.close()
+
+
+def test_interceptor_post_execute(tmp_path):
+    plugins = Plugins()
+    plugins.insert(RowLimiter())
+    db = Database(data_home=str(tmp_path), plugins=plugins)
+    try:
+        db.sql("CREATE TABLE q (k STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY(k))")
+        db.sql("INSERT INTO q VALUES ('a', 1), ('b', 2), ('c', 3)")
+        t = db.sql_one("SELECT k FROM q ORDER BY k")
+        assert t.num_rows == 1  # limiter transformed the result
+    finally:
+        db.close()
+
+
+def test_plugins_typemap_lookup():
+    p = Plugins()
+    a = Auditor()
+    p.insert(a)
+    assert p.get(Auditor) is a
+    assert p.get(SqlQueryInterceptor) is a  # subclass-aware
+    assert p.get_all(SqlQueryInterceptor) == [a]
+    assert p.get(DropBlocker) is None
+
+
+# ---- telemetry --------------------------------------------------------------
+
+
+def test_telemetry_disabled_by_default(tmp_path):
+    import os
+
+    db = Database(data_home=str(tmp_path))
+    try:
+        assert db.telemetry._thread is None
+        assert not os.path.exists(str(tmp_path) + "/telemetry_report.json")
+    finally:
+        db.close()
+
+
+def test_telemetry_report_shape(tmp_path):
+    import os
+
+    from greptimedb_tpu.utils.config import Config
+
+    cfg = Config()
+    cfg.storage.data_home = str(tmp_path)
+    cfg.telemetry.enable = True
+    cfg.telemetry.interval_hours = 100  # no repeat during the test
+    db = Database(config=cfg)
+    try:
+        db.sql("CREATE TABLE tm (k STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY(k))")
+        db.telemetry.report_once()
+        path = os.path.join(str(tmp_path), "telemetry_report.json")
+        with open(path) as f:
+            report = json.load(f)
+        assert report["mode"] == "standalone"
+        assert report["table_count"] >= 1
+        assert len(report["uuid"]) == 32
+        # uuid is stable across restarts
+        again = db.telemetry.build_report()
+        assert again["uuid"] == report["uuid"]
+    finally:
+        db.close()
+
+
+# ---- session timezone -------------------------------------------------------
+
+
+def test_session_timezone_parsing(tmp_path):
+    db = Database(data_home=str(tmp_path))
+    try:
+        assert db.session_tz_offset_minutes() == 0
+        db.sql("SET time_zone = '+08:00'")
+        assert db.session_timezone == "+08:00"
+        assert db.session_tz_offset_minutes() == 480
+        db.sql("SET TIME ZONE '-05:30'")
+        assert db.session_tz_offset_minutes() == -330
+        db.sql("SET time_zone = 'UTC'")
+        assert db.session_tz_offset_minutes() == 0
+        with pytest.raises(GreptimeError):
+            db.set_session_timezone("Not/AZone")
+    finally:
+        db.close()
+
+
+def test_mysql_timezone_rendering(tmp_path):
+    from greptimedb_tpu.servers.mysql import MysqlServer
+    from greptimedb_tpu.servers.mysql_client import MysqlClient
+
+    db = Database(data_home=str(tmp_path / "data"))
+    srv = MysqlServer(db, "127.0.0.1:0").start(warm=False)
+    try:
+        c = MysqlClient(srv.address)
+        c.query("CREATE TABLE tz (ts TIMESTAMP TIME INDEX, v DOUBLE, host STRING PRIMARY KEY)")
+        c.query("INSERT INTO tz VALUES (0, 1.0, 'a')")
+        _cols, rows = c.query("SELECT ts FROM tz")
+        assert rows[0][0].startswith("1970-01-01 00:00")
+        c.query("SET time_zone = '+08:00'")
+        _cols, rows = c.query("SELECT ts FROM tz")
+        # rendered in session time zone; stored value unchanged
+        assert rows[0][0].startswith("1970-01-01 08:00")
+        c.close()
+    finally:
+        srv.stop()
+        db.close()
+
+
+# ---- profiling endpoints ----------------------------------------------------
+
+
+def test_debug_prof_endpoints(tmp_path):
+    from greptimedb_tpu.servers.http import HttpServer
+
+    db = Database(data_home=str(tmp_path))
+    srv = HttpServer(db, "127.0.0.1:0").start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://{srv.address}/debug/prof/cpu?seconds=0.2"
+        ).read().decode()
+        assert "cpu profile" in body
+        # first call arms tracemalloc, second returns a snapshot
+        urllib.request.urlopen(f"http://{srv.address}/debug/prof/mem").read()
+        body = urllib.request.urlopen(f"http://{srv.address}/debug/prof/mem").read().decode()
+        assert "heap top" in body and "total traced" in body
+    finally:
+        srv.stop()
+        db.close()
+
+
+# ---- plan cache -------------------------------------------------------------
+
+
+def test_plan_cache_hit_and_ddl_invalidation(tmp_path):
+    db = Database(data_home=str(tmp_path))
+    try:
+        db.sql("CREATE TABLE pcache (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(k))")
+        db.sql("INSERT INTO pcache VALUES ('a', 1.0, 0)")
+        q = "SELECT k, v FROM pcache ORDER BY k"
+        t1 = db.sql_one(q)
+        assert ((q, "public") in db._plan_cache)
+        t2 = db.sql_one(q)  # served from cache
+        assert t1.to_pydict() == t2.to_pydict()
+        rev = db._plan_cache[(q, "public")][0]
+        # DDL bumps the catalog revision -> stale entry replanned
+        db.sql("ALTER TABLE pcache ADD COLUMN w DOUBLE")
+        db.sql("INSERT INTO pcache VALUES ('b', 2.0, 1000, 9.0)")
+        t3 = db.sql_one("SELECT k, w FROM pcache ORDER BY k")
+        assert t3.column("w").to_pylist() == [None, 9.0]
+        t4 = db.sql_one(q)
+        assert t4.num_rows == 2
+        assert db._plan_cache[(q, "public")][0] > rev
+    finally:
+        db.close()
+
+
+def test_plan_cache_skips_align_to_now(tmp_path):
+    """ALIGN TO NOW freezes its origin at plan time — such plans must never
+    be cached, even nested in a subquery."""
+    db = Database(data_home=str(tmp_path))
+    try:
+        db.sql("CREATE TABLE an (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY(k))")
+        db.sql("INSERT INTO an VALUES ('a', 1.0, 0)")
+        q = "SELECT * FROM (SELECT max(v) RANGE '5m' FROM an ALIGN '5m' TO NOW) x"
+        db.sql_one(q)
+        assert (q, "public") not in db._plan_cache
+        q2 = "SELECT max(v) RANGE '5m' FROM an ALIGN '5m'"
+        db.sql_one(q2)
+        assert (q2, "public") in db._plan_cache  # plain align still caches
+    finally:
+        db.close()
+
+
+def test_named_zone_dst_per_value(tmp_path):
+    """Winter and summer timestamps render with their own offsets under a
+    named zone (DST-correct per-value conversion)."""
+    from greptimedb_tpu.servers.mysql import _render_value
+
+    db = Database(data_home=str(tmp_path))
+    try:
+        db.set_session_timezone("America/New_York")
+        tzinfo = db.session_tzinfo()
+        import datetime as dt
+
+        winter = dt.datetime(2024, 1, 15, 12, 0, 0)  # UTC noon, EST = -5
+        summer = dt.datetime(2024, 7, 15, 12, 0, 0)  # UTC noon, EDT = -4
+        assert _render_value(winter, tzinfo) == b"2024-01-15 07:00:00"
+        assert _render_value(summer, tzinfo) == b"2024-07-15 08:00:00"
+    finally:
+        db.close()

@@ -253,6 +253,9 @@ def test_flush_on_buffer_pressure(tmp_path):
         1,
         make_batch(schema, ["h"] * n, list(range(0, 1000 * n, 1000)), list(np.arange(n, dtype=float))),
     )
+    # threshold flushes are asynchronous now (FlushScheduler); wait for it
+    if engine.flusher is not None:
+        engine.flusher.wait_idle()
     assert engine.region(1).stat().sst_count >= 1
     assert engine.region(1).memtable.is_empty()
     engine.close()
