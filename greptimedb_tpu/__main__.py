@@ -106,6 +106,98 @@ def cmd_import(args):
     return 0
 
 
+def cmd_datanode(args):
+    """Run a standalone datanode process: a region server speaking Arrow
+    Flight over shared storage (reference `greptime datanode start`)."""
+    import signal
+
+    from .distributed.flight import DatanodeFlightServer
+    from .storage.engine import TimeSeriesEngine
+    from .utils.config import StorageConfig
+
+    engine = TimeSeriesEngine(StorageConfig(data_home=args.data_home))
+    host, port = (args.addr.rsplit(":", 1) + ["0"])[:2]
+    server = DatanodeFlightServer(engine, f"grpc://{host}:{port}")
+    import threading
+
+    t = threading.Thread(target=server.serve, daemon=True)
+    t.start()
+    print(f"datanode {args.node_id} serving Flight at {server.location}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    try:
+        stop.wait()
+    finally:
+        server.shutdown()
+        engine.close()
+    return 0
+
+
+def cmd_metasrv(args):
+    """Run a metasrv process: routes/heartbeats/placement/migration over
+    HTTP with lease-based election on the shared KV (reference
+    `greptime metasrv start`).  Datanodes are reached through Flight using
+    --datanode node_id=host:port mappings."""
+    import signal
+    import threading
+
+    from .distributed.election import LeaseElection
+    from .distributed.flight import FlightDatanodeClient
+    from .distributed.kv import FileKvBackend
+    from .distributed.meta_service import MetasrvServer
+    from .distributed.metasrv import Metasrv
+
+    peers = {}
+    for spec in args.datanode or []:
+        nid, addr = spec.split("=", 1)
+        peers[int(nid)] = addr
+
+    class RemoteNodeManager:
+        """NodeManager over Flight clients (reference common/meta
+        NodeManager backed by per-peer gRPC clients)."""
+
+        def _client(self, node_id: int) -> FlightDatanodeClient:
+            return FlightDatanodeClient(node_id, f"grpc://{peers[node_id]}")
+
+        def open_region(self, node_id: int, rid: int):
+            self._client(node_id).open_region(rid)
+
+        def close_region_quiet(self, node_id: int, rid: int):
+            try:
+                self._client(node_id).close_region(rid)
+            except Exception:  # noqa: BLE001
+                pass
+
+        def flush_region(self, node_id: int, rid: int):
+            self._client(node_id).flush_region(rid)
+
+        def set_region_writable(self, node_id: int, rid: int, writable: bool):
+            self._client(node_id).set_region_writable(rid, writable)
+
+    kv = FileKvBackend(args.kv_dir)
+    election = LeaseElection(kv, args.node_id)
+    metasrv = Metasrv(kv, RemoteNodeManager(), election=election)
+    for nid in peers:
+        metasrv.register_datanode(nid)
+    server = MetasrvServer(metasrv, args.addr).start()
+    print(f"metasrv {args.node_id} serving at {server.address}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    # campaign + supervise loop (reference metasrv election/heartbeat loops)
+    import time as _time
+
+    while not stop.is_set():
+        election.campaign()
+        if metasrv.is_leader():
+            metasrv.tick(_time.time() * 1000)
+        stop.wait(1.0)
+    server.stop()
+    return 0
+
+
 def cmd_metadata(args):
     """metadata snapshot/restore/info (reference cli/src/metadata/:
     `greptime cli metadata snapshot save|restore` + control info).  The
@@ -200,6 +292,24 @@ def main(argv=None):
     p.add_argument("input")
     p.add_argument("--data-home", default="./greptimedb_data")
     p.set_defaults(fn=cmd_import)
+
+    p = sub.add_parser("datanode", help="start a datanode (Flight region server)")
+    p.add_argument("action", choices=["start"])
+    p.add_argument("--node-id", type=int, default=0)
+    p.add_argument("--data-home", default="./greptimedb_data")
+    p.add_argument("--addr", default="127.0.0.1:0")
+    p.set_defaults(fn=cmd_datanode)
+
+    p = sub.add_parser("metasrv", help="start a metasrv (routes/heartbeats/election)")
+    p.add_argument("action", choices=["start"])
+    p.add_argument("--node-id", default="metasrv-0")
+    p.add_argument("--kv-dir", default="./greptimedb_meta")
+    p.add_argument("--addr", default="127.0.0.1:0")
+    p.add_argument(
+        "--datanode", action="append",
+        help="node_id=host:port mapping (repeatable)",
+    )
+    p.set_defaults(fn=cmd_metasrv)
 
     p = sub.add_parser("metadata", help="catalog snapshot / restore / info")
     p.add_argument("action", choices=["snapshot", "restore", "info"])

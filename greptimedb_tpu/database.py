@@ -516,7 +516,13 @@ class Database:
             options=stmt.options,
             on_create=lambda m: [
                 self.storage.create_region(
-                    rid, schema, append_mode=_opt_bool(stmt.options, "append_mode")
+                    rid,
+                    schema,
+                    append_mode=_opt_bool(stmt.options, "append_mode"),
+                    memtable_kind=str(
+                        stmt.options.get("memtable.type", stmt.options.get("memtable_type", ""))
+                    )
+                    or None,
                 )
                 for rid in m.region_ids
             ],
@@ -1273,11 +1279,16 @@ class Database:
                 if is_logical_meta(meta) or fe.is_external_meta(meta):
                     continue  # no regions of their own
                 append = _opt_bool(meta.options, "append_mode")
+                mk = str(
+                    meta.options.get("memtable.type", meta.options.get("memtable_type", ""))
+                ) or None
                 for rid in meta.region_ids:
                     try:
-                        self.storage.open_region(rid, append_mode=append)
+                        self.storage.open_region(rid, append_mode=append, memtable_kind=mk)
                     except Exception:
-                        self.storage.create_region(rid, meta.schema, append_mode=append)
+                        self.storage.create_region(
+                            rid, meta.schema, append_mode=append, memtable_kind=mk
+                        )
 
 
 def _opt_bool(options: dict, key: str) -> bool:

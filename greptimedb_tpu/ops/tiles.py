@@ -29,13 +29,18 @@ DEFAULT_TILE_ROWS = 1 << 20
 
 
 def padded_size(n: int, tile_rows: int = DEFAULT_TILE_ROWS) -> int:
-    """Quantized padded length: next power of two <= one tile, else next
-    multiple of tile_rows.  Bounds distinct compiled shapes to
-    O(log tile_rows + total/tile_rows)."""
+    """Quantized padded length: next power of two, everywhere.
+
+    Shape count must stay O(log N), NOT O(N / tile_rows): XLA compiles of
+    the segment-aggregate program over multi-million-row arrays take tens
+    of seconds each on the tunnel backend, and flush timing (async
+    threshold flushes) jitters SST row counts run-to-run — multiple-of-tile
+    padding turned that jitter into fresh compiles per file.  Power-of-two
+    padding wastes at most 2x HBM per staged tile batch and collapses every
+    file of similar magnitude onto one compiled shape that also survives in
+    the persistent compilation cache across processes."""
     if n <= 0:
-        return tile_rows if tile_rows <= 1024 else 1024
-    if n >= tile_rows:
-        return -(-n // tile_rows) * tile_rows
+        return min(tile_rows, 1024)
     p = 1
     while p < n:
         p <<= 1

@@ -297,6 +297,7 @@ def _value_to_numpy(col) -> np.ndarray | None:
 
 
 @functools.lru_cache(maxsize=64)
+@functools.lru_cache(maxsize=256)
 def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
     """jit program: per-source partial states, merged pairwise, FINALIZED on
     device, and packed into ONE [K, G] float64 buffer holding ONLY the rows
@@ -321,14 +322,19 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
         if "count" in aggs or (col in nullable_cols and col != COUNT_STAR):
             layout.append((col, "count"))
 
-    def run(sources, dyn):
-        merged = None
-        for cols, valid, nulls in sources:
-            states = compute_partial_states(plan, cols, valid, nulls, dyn)
-            if merged is None:
-                merged = states
-            else:
-                merged = {k: merge_states(merged[k], states[k]) for k in merged}
+    # FIXED-SHAPE chunked dispatch, merges folded on device — NOT one jit
+    # over a Python loop of all sources: tracing that loop unrolls the
+    # program proportionally to SST count, and XLA compile time explodes
+    # with data size (observed: minutes at TSBS scale).  Instead every
+    # source is sliced into chunks of exactly CHUNK rows (sources are
+    # power-of-two padded, so chunks tile them evenly; smaller sources keep
+    # their own pow2 shape) — ONE compiled partial program serves any
+    # dataset size, survives in the persistent compilation cache, and the
+    # fold costs one tiny merge dispatch per chunk (~dispatch-floor each).
+    partial_jit = jax.jit(functools.partial(compute_partial_states, plan))
+    merge_jit = jax.jit(lambda a, b: {k: merge_states(a[k], b[k]) for k in a})
+
+    def _final(merged):
         outs = {
             col: finalize(merged[col], tuple(sorted(aggs | {"count"})))
             for col, aggs in per_col_aggs.items()
@@ -337,7 +343,24 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
         rows = [outs[col][agg].astype(jnp.float64) for col, agg in layout]
         return jnp.stack(rows)
 
-    return jax.jit(run), tuple(layout)
+    final_jit = jax.jit(_final)
+
+    from ..ops.tiles import DEFAULT_TILE_ROWS as _CHUNK
+
+    def run(sources, dyn):
+        merged = None
+        for cols, valid, nulls in sources:
+            n = int(valid.shape[0])
+            step = _CHUNK if n > _CHUNK else n
+            for start in range(0, n, step):
+                c = {k: a[start : start + step] for k, a in cols.items()}
+                v = valid[start : start + step]
+                u = {k: a[start : start + step] for k, a in nulls.items()}
+                states = partial_jit(c, v, u, dyn)
+                merged = states if merged is None else merge_jit(merged, states)
+        return final_jit(merged)
+
+    return run, tuple(layout)
 
 
 class TileExecutor:

@@ -87,7 +87,7 @@ class TimeSeriesEngine:
     # ---- region lifecycle -------------------------------------------------
     def create_region(
         self, region_id: int, schema: Schema, writable: bool = True,
-        append_mode: bool = False,
+        append_mode: bool = False, memtable_kind: str | None = None,
     ) -> Region:
         with self._lock:
             if region_id in self._regions:
@@ -104,11 +104,15 @@ class TimeSeriesEngine:
                 index_segment_rows=self.config.index_segment_rows,
                 index_inverted_max_terms=self.config.index_inverted_max_terms,
                 append_mode=append_mode,
+                memtable_kind=memtable_kind
+                or getattr(self.config, "memtable_kind", "time_partition"),
             )
             self._regions[region_id] = region
             return region
 
-    def open_region(self, region_id: int, append_mode: bool = False) -> Region:
+    def open_region(
+        self, region_id: int, append_mode: bool = False, memtable_kind: str | None = None
+    ) -> Region:
         """Open an existing region from its manifest + WAL (crash recovery)."""
         with self._lock:
             if region_id in self._regions:
@@ -127,6 +131,8 @@ class TimeSeriesEngine:
                 index_segment_rows=self.config.index_segment_rows,
                 index_inverted_max_terms=self.config.index_inverted_max_terms,
                 append_mode=append_mode,
+                memtable_kind=memtable_kind
+                or getattr(self.config, "memtable_kind", "time_partition"),
             )
             self._regions[region_id] = region
             return region

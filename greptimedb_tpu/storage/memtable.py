@@ -155,3 +155,120 @@ def _isnan(a: np.ndarray) -> np.ndarray:
     if np.issubdtype(a.dtype, np.floating):
         return np.isnan(a)
     return np.zeros(len(a), dtype=bool)
+
+
+class TimeSeriesMemtable(Memtable):
+    """Per-series write accumulation (reference
+    mito2/src/memtable/time_series.rs `TimeSeriesMemtable`: one vector
+    builder per primary key).  Batches are split by series at WRITE time
+    into per-series buckets, so flush/scan concatenates pre-grouped runs
+    instead of sorting the whole buffer — the right trade when series
+    count is small relative to rows (dense scrape workloads), and the
+    shape series_scan-style readers want.
+
+    Read-side semantics are identical to the base memtable: sorted by
+    (pk, ts), last-write-wins on (pk, ts) ties.
+    """
+
+    def __init__(self, schema: Schema, time_partition_ms: int = 86_400_000):
+        super().__init__(schema, time_partition_ms)
+        self._series: dict[tuple, list[pa.RecordBatch]] = {}
+        self._series_seqs: dict[tuple, list[np.ndarray]] = {}
+        self._pk_names = [c.name for c in schema.tag_columns()]
+
+    def write(self, batch: pa.RecordBatch, sequence: int):
+        ts_col = self.schema.time_index
+        with self._lock:
+            if not self._pk_names:
+                key = ()
+                self._series.setdefault(key, []).append(batch)
+                self._series_seqs.setdefault(key, []).append(
+                    np.full(batch.num_rows, sequence, dtype=np.int64)
+                )
+            else:
+                # group rows by series key via dictionary codes (vectorized;
+                # the reference hashes encoded primary keys the same way)
+                import pyarrow.compute as _pc
+
+                codes = None
+                dicts = []
+                for name in self._pk_names:
+                    col = batch.column(batch.schema.get_field_index(name))
+                    enc = _pc.dictionary_encode(col)
+                    idxs = np.asarray(enc.indices, dtype=np.int64)
+                    dicts.append(enc.dictionary.to_pylist())
+                    codes = idxs if codes is None else codes * len(dicts[-1]) + idxs
+                for code in np.unique(codes):
+                    mask = codes == code
+                    sub = batch.filter(pa.array(mask))
+                    first = int(np.flatnonzero(mask)[0])
+                    key = tuple(
+                        batch.column(batch.schema.get_field_index(n))[first].as_py()
+                        for n in self._pk_names
+                    )
+                    self._series.setdefault(key, []).append(sub)
+                    self._series_seqs.setdefault(key, []).append(
+                        np.full(sub.num_rows, sequence, dtype=np.int64)
+                    )
+            self._rows += batch.num_rows
+            self._bytes += batch.nbytes
+            if ts_col is not None and batch.num_rows:
+                ts = batch.column(batch.schema.get_field_index(ts_col.name))
+                lo = pc.min(ts).cast(pa.int64()).as_py()
+                hi = pc.max(ts).cast(pa.int64()).as_py()
+                self._min_ts = lo if self._min_ts is None else min(self._min_ts, lo)
+                self._max_ts = hi if self._max_ts is None else max(self._max_ts, hi)
+
+    def to_table(self, dedup: bool = True) -> pa.Table:
+        """Concatenate series in key order; each series sorts only its own
+        rows by (ts, seq) — no global sort."""
+        with self._lock:
+            if not self._series:
+                return self.schema.to_arrow().empty_table()
+            items = sorted(self._series.items(), key=lambda kv: _series_sort_key(kv[0]))
+            parts = []
+            for key, chunks in items:
+                t = pa.Table.from_batches(chunks, schema=chunks[0].schema)
+                seq = pa.array(np.concatenate(self._series_seqs[key]))
+                t = t.append_column(_SEQ_COL, seq)
+                parts.append(t)
+        out = []
+        for t in parts:
+            out.append(_sort_and_dedup_series(t, self.schema, dedup=dedup))
+        merged = pa.concat_tables(out)
+        return merged.drop_columns([_SEQ_COL])
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+
+def _series_sort_key(key: tuple):
+    # None sorts first, mirroring arrow's default null placement in the
+    # base memtable's global sort
+    return tuple((v is not None, v) for v in key)
+
+
+def _sort_and_dedup_series(table: pa.Table, schema: Schema, dedup: bool) -> pa.Table:
+    """Per-series (ts, seq) sort + last-write-wins on ts ties."""
+    ts_col = schema.time_index
+    if ts_col is None:
+        return table
+    idx = pc.sort_indices(
+        table, sort_keys=[(ts_col.name, "ascending"), (_SEQ_COL, "ascending")]
+    )
+    table = table.take(idx)
+    if not dedup or table.num_rows <= 1:
+        return table
+    ts = pc.cast(table[ts_col.name], pa.int64()).to_numpy(zero_copy_only=False)
+    keep = np.ones(len(ts), dtype=bool)
+    keep[:-1] = ts[:-1] != ts[1:]
+    return table.filter(pa.array(keep))
+
+
+def make_memtable(schema: Schema, time_partition_ms: int, kind: str = "time_partition") -> Memtable:
+    """Memtable builder selection (reference MemtableBuilderProvider,
+    mito2/src/memtable/builder.rs): time_partition (default) | time_series."""
+    if kind == "time_series":
+        return TimeSeriesMemtable(schema, time_partition_ms)
+    return Memtable(schema, time_partition_ms)

@@ -28,7 +28,7 @@ from ..datatypes.schema import Schema
 from ..utils import metrics
 from ..utils.errors import IllegalStateError, RegionReadonlyError
 from .manifest import ManifestManager
-from .memtable import Memtable
+from .memtable import Memtable, make_memtable
 from .sst import FileMeta, ScanPredicate, SstReader, SstWriter
 from .wal import RegionWal
 
@@ -68,6 +68,7 @@ class Region:
         index_segment_rows: int = 1024,
         index_inverted_max_terms: int = 4096,
         append_mode: bool = False,
+        memtable_kind: str = "time_partition",
     ):
         from .object_store import FsObjectStore, ObjectStore
 
@@ -109,7 +110,8 @@ class Region:
         )
         self.sst_reader = SstReader(sst_store, self.schema)
 
-        self.memtable = Memtable(self.schema, time_partition_ms)
+        self.memtable_kind = memtable_kind
+        self.memtable = make_memtable(self.schema, time_partition_ms, memtable_kind)
         # Frozen memtables: flushed but whose SSTs are not yet committed to the
         # manifest; readable by scans so flush never opens a visibility gap.
         self._frozen_memtables: list[Memtable] = []
@@ -217,7 +219,7 @@ class Region:
             frozen = self.memtable
             frozen_entry_id = self.wal.last_entry_id
             frozen_sequence = self.sequence
-            self.memtable = Memtable(self.schema, self.time_partition_ms)
+            self.memtable = make_memtable(self.schema, self.time_partition_ms, self.memtable_kind)
             self._frozen_memtables.append(frozen)
         t0 = time.perf_counter()
         added: list[FileMeta] = []
@@ -531,7 +533,7 @@ class Region:
             entry_id = self.wal.last_entry_id
             dropped = list(self.manifest_mgr.manifest.files)
             self.manifest_mgr.apply({"kind": "truncate", "truncated_entry_id": entry_id})
-            self.memtable = Memtable(self.schema, self.time_partition_ms)
+            self.memtable = make_memtable(self.schema, self.time_partition_ms, self.memtable_kind)
             # frozen memtables hold pre-truncate rows an in-flight flush froze;
             # drop them so scans stop seeing truncated data immediately (the
             # flush itself discards its SSTs when it observes the watermark)
@@ -550,7 +552,7 @@ class Region:
             self.schema = new_schema
             self.sst_writer.schema = new_schema
             self.sst_reader.schema = new_schema
-            self.memtable = Memtable(new_schema, self.time_partition_ms)
+            self.memtable = make_memtable(new_schema, self.time_partition_ms, self.memtable_kind)
 
     def set_writable(self, writable: bool):
         """Leader/follower role flip (reference set_region_role).  Takes

@@ -84,3 +84,74 @@ def test_meta_client_no_leader():
             client.ask_leader()  # nobody campaigned
     finally:
         srv.stop()
+
+
+def test_cli_role_subcommands(tmp_path):
+    """`datanode start` + `metasrv start` run as real processes and serve
+    their wire protocols (reference greptime datanode/metasrv subcommands)."""
+    import json
+    import os
+    import re
+    import signal
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    dn = subprocess.Popen(
+        [sys.executable, "-m", "greptimedb_tpu", "datanode", "start",
+         "--node-id", "1", "--data-home", str(tmp_path / "dn1")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+    ms = None
+    try:
+        line = dn.stdout.readline()
+        m = re.search(r"grpc://([\d.]+:\d+)", line)
+        assert m, line
+        dn_addr = m.group(1)
+
+        ms = subprocess.Popen(
+            [sys.executable, "-m", "greptimedb_tpu", "metasrv", "start",
+             "--kv-dir", str(tmp_path / "meta"),
+             "--datanode", f"1={dn_addr}"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        line = ms.stdout.readline()
+        m = re.search(r"serving at ([\d.]+:\d+)", line)
+        assert m, line
+        ms_addr = m.group(1)
+
+        # wait for the campaign loop to take the lease
+        from greptimedb_tpu.distributed.meta_service import MetaClient
+
+        client = MetaClient([ms_addr])
+        deadline = time.time() + 15
+        leader = None
+        while time.time() < deadline:
+            try:
+                leader = client.ask_leader()
+                break
+            except Exception:
+                time.sleep(0.3)
+        assert leader == ms_addr
+        client.set_route(77, {78848: 1})
+        assert client.get_route(77) == {78848: 1}
+        hb = client.handle_heartbeat(1, [], time.time() * 1000)
+        assert "lease_until_ms" in hb
+
+        # the datanode answers Flight health through the same wire
+        from greptimedb_tpu.distributed.flight import FlightDatanodeClient
+
+        fdc = FlightDatanodeClient(1, f"grpc://{dn_addr}")
+        assert fdc.alive
+    finally:
+        dn.send_signal(signal.SIGTERM)
+        if ms is not None:
+            ms.send_signal(signal.SIGTERM)
+        dn.wait(timeout=10)
+        if ms is not None:
+            ms.wait(timeout=10)
