@@ -76,11 +76,36 @@ class DistGroupByPlan:
     # (SQL: NULL never satisfies a predicate); the table-based path
     # pre-filters on the host so this only matters for the tile path
     filter_null_cols: tuple[str, ...] = ()
+    # Hierarchical grouping (ops/aggregate.py reduce_state_axes): when the
+    # requested group keys are not a primary-key prefix in pk order, the
+    # group id is composed over this pk prefix instead (+ bucket last), the
+    # blocked kernel aggregates at that finer layout-clustered granularity,
+    # and the state is folded down to `group_tags` on device.
+    layout_tags: tuple[str, ...] | None = None
+    layout_cards: tuple[int, ...] = ()
+    # Time-major execution: sources are gathered through a ts-ascending
+    # permutation before aggregation, making `gid = bucket` globally
+    # non-decreasing for ANY bucket interval (bucket-only group-bys like
+    # TSBS single-groupby / groupby-orderby-limit).
+    time_major: bool = False
 
     @property
     def num_groups(self) -> int:
+        """Output group-space size (the [G] the caller sees)."""
         g = 1
         for c in self.tag_cards:
+            g *= c
+        if self.bucket_col is not None:
+            g *= self.n_buckets
+        return g
+
+    @property
+    def internal_groups(self) -> int:
+        """Stage-1 group-space size (= num_groups unless hierarchical)."""
+        if self.layout_tags is None:
+            return self.num_groups
+        g = 1
+        for c in self.layout_cards:
             g *= c
         if self.bucket_col is not None:
             g *= self.n_buckets
@@ -132,13 +157,19 @@ def _apply_filters(plan: DistGroupByPlan, columns, mask, values=None):
     return mask
 
 
-def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=None):
+def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=None, perm=None):
     """Shared lower/state stage: mask -> group ids -> partial AggStates.
     No collectives — callers merge across devices (psum) or across tile
     sources (merge_states).  `dyn` optionally carries runtime-dynamic plan
     parameters: {'filter_values', 'bucket_origin', 'bucket_interval'} —
-    only shapes (cards, n_buckets, filter structure) stay compile-static."""
+    only shapes (cards, n_buckets, filter structure) stay compile-static.
+    `perm` (time-major plans) re-gathers every per-row array into
+    ts-ascending order first, so bucket-composed gids are sorted."""
     acc = jnp.float64 if plan.acc_dtype == "float64" else jnp.float32
+    if perm is not None:
+        columns = {k: v[perm] for k, v in columns.items()}
+        valid = valid[perm]
+        nulls = {k: v[perm] for k, v in nulls.items()}
     mask = _apply_filters(
         plan, columns, valid, None if dyn is None else dyn["filter_values"]
     )
@@ -147,20 +178,25 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
             mask = mask & nulls[c]
 
     components: list[tuple[jnp.ndarray, int]] = []
-    for tag, card in zip(plan.group_tags, plan.tag_cards):
-        components.append((columns[tag], card))
+    if plan.layout_tags is not None:
+        for tag, card in zip(plan.layout_tags, plan.layout_cards):
+            components.append((columns[tag], card))
+    else:
+        for tag, card in zip(plan.group_tags, plan.tag_cards):
+            components.append((columns[tag], card))
     if plan.bucket_col is not None:
         origin = plan.bucket_origin if dyn is None else dyn["bucket_origin"]
         interval = plan.bucket_interval if dyn is None else dyn["bucket_interval"]
         b = time_bucket(columns[plan.bucket_col], origin, interval)
         components.append((b, plan.n_buckets))
+    n_internal = plan.internal_groups
     # raw in-range ids + mask (NOT overflow-encoded): keeps scan-order
     # sortedness intact so segment_aggregate's block kernel can engage.
     # Tail padding rows (valid=False) get the max id so they don't break
     # the ascending-order guard; their mask keeps them out of every sum.
     gids, in_range = raw_group_ids(components, shape=valid.shape)
     mask = mask & in_range
-    gids = jnp.where(valid, gids, plan.num_groups - 1)
+    gids = jnp.where(valid, gids, n_internal - 1)
 
     ts = None
     if plan.ts_col is not None and plan.ts_col in columns:
@@ -173,7 +209,21 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
     # per-column null mask for SQL NULL semantics (sum over an all-null
     # group is NULL, not 0).  last_value keeps the per-column path (needs
     # the ts-ordered two-pass kernel).
-    from ..ops.aggregate import segment_aggregate_multi
+    from ..ops.aggregate import reduce_state_axes, segment_aggregate_multi
+
+    if plan.layout_tags is not None:
+        fold_cards = plan.layout_cards + (
+            (plan.n_buckets,) if plan.bucket_col is not None else ()
+        )
+        keep_axes = tuple(plan.layout_tags.index(t) for t in plan.group_tags) + (
+            (len(plan.layout_tags),) if plan.bucket_col is not None else ()
+        )
+
+        def fold(state: AggState) -> AggState:
+            return reduce_state_axes(state, fold_cards, keep_axes)
+    else:
+        def fold(state: AggState) -> AggState:
+            return state
 
     per_col_aggs: dict[str, set] = {}
     for func, col in plan.agg_specs:
@@ -184,11 +234,13 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
     for col, aggs in per_col_aggs.items():
         key = tuple(sorted(aggs | {"count"}))
         if "last" in key:
+            # LAST has no reshape-reduce fold; the planner never builds a
+            # hierarchical plan with last_value
             col_mask = mask & nulls[col] if col in nulls else mask
-            states[col] = segment_aggregate(
-                columns[col], gids, plan.num_groups, key,
+            states[col] = fold(segment_aggregate(
+                columns[col], gids, n_internal, key,
                 mask=col_mask, ts=ts, acc_dtype=acc,
-            )
+            ))
         else:
             groups.setdefault(key, []).append(col)
     # group presence (independent of value nulls) rides along as a
@@ -208,15 +260,15 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
             ]
         )
         multi = segment_aggregate_multi(
-            vals, gids, plan.num_groups, key, col_masks, mask, acc_dtype=acc
+            vals, gids, n_internal, key, col_masks, mask, acc_dtype=acc
         )
         for i, c in enumerate(cols):
-            states[c] = AggState(
+            states[c] = fold(AggState(
                 sums=None if multi.sums is None else multi.sums[i],
                 counts=None if multi.counts is None else multi.counts[i],
                 mins=None if multi.mins is None else multi.mins[i],
                 maxs=None if multi.maxs is None else multi.maxs[i],
-            )
+            ))
     return states
 
 

@@ -1,26 +1,45 @@
-"""HBM-resident SST tile cache + single-dispatch aggregation executor.
+"""HBM-resident SST super-tiles + single-dispatch aggregation executor.
 
 This is the engine's answer to "the tiles are resident in HBM": instead of
 re-reading Parquet, re-encoding tags and re-uploading columns on every query
-(the round-1 hot path), each SST file's needed columns are encoded ONCE —
-tag strings to stable per-table dictionary codes (storage/dictionary.py),
-timestamps to int64, values to float — and kept on the device, keyed by
-(region, file, column).  A query then:
+(the round-1 hot path), each region's flushed SSTs are encoded ONCE — tag
+strings to stable per-table dictionary codes (storage/dictionary.py),
+timestamps to int64, values to float — and consolidated into ONE device
+buffer per column (the "super-tile"), with each file's rows padded to a
+BLOCK_ROWS-aligned segment so the blocked aggregation kernel
+(ops/aggregate.py `_segment_blocked`) never sees a row block straddling two
+differently-sorted files.  A query then:
 
   1. snapshots each region's (files, memtables) under the region lock,
-  2. fetches/repairs cached file tiles (dictionary growth is repaired with
-     one gather using the recorded code permutation — no Parquet re-read),
+  2. fetches/extends the region's super-tile (host-side per-file encodes
+     are cached, so a new flush re-uploads only concatenation, and
+     dictionary growth is repaired with one device gather — no Parquet
+     re-read),
   3. encodes only the memtable tail (small, vectorized),
-  4. runs ONE jit-compiled program that computes per-source partial
-     AggStates with the shared kernels (ops/aggregate.py) and merges them —
-     per-source processing preserves each file's (pk, ts) sort order so the
-     sorted-block kernel engages per source,
-  5. finalizes [G]-sized states on the host.
+  4. runs ONE jit-compiled program over ALL sources that computes partial
+     AggStates with the shared kernels (ops/aggregate.py), merges them,
+     finalizes, and packs the outputs into one [K, G] buffer,
+  5. fetches that single buffer (ONE device->host transfer — on a remote
+     device harness every fetch pays the full link round-trip, so
+     everything rides one buffer) and decodes rows on the host.
+
+Latency is therefore flat in data size and SST count: one dispatch + one
+fetch regardless of scale.
+
+Layout strategy (what makes the hot kernel scatter-free):
+  * group keys that are a primary-key prefix (in pk order) ride the
+    engine's (pk, ts) sort directly;
+  * other tag subsets aggregate hierarchically at a pk-prefix granularity
+    and fold down on device (ops/aggregate.py `reduce_state_axes`);
+  * bucket-only group-bys (TSBS single-groupby, groupby-orderby-limit) go
+    time-major: rows are gathered through a cached ts-ascending
+    permutation, making `gid = bucket` sorted for ANY interval.
 
 Role-equivalents in the reference: the write/page caches
 (mito2/src/cache/write_cache.rs, cache.rs — "upload on flush, serve reads
-from local media"; here the medium is HBM) and the pre-encoded primary keys
-(mito-codec/src/row_converter/).
+from cached media"; here the medium is HBM), the pre-encoded primary keys
+(mito-codec/src/row_converter/), and the windowed-sort optimizer's use of
+physical order (query/src/optimizer/windowed_sort.rs).
 
 Correctness gate: the tile path aggregates raw file rows WITHOUT the
 last-write-wins dedup pass a normal scan performs, so it only engages when
@@ -47,11 +66,11 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
-from ..ops.aggregate import finalize, merge_states
+from ..ops.aggregate import BLOCK_ROWS, finalize, merge_states
 from ..ops.tiles import padded_size
 from ..storage.dictionary import TableDictionary
 from ..storage.region import OP_COL, Region
-from ..storage.sst import FileMeta, ScanPredicate
+from ..storage.sst import FileMeta
 from ..utils import metrics
 from .executor import (
     COUNT_STAR,
@@ -62,7 +81,9 @@ from .executor import (
     compute_partial_states,
 )
 
-TILE_QUANTUM = 1 << 14  # pad granularity for every source: bounds recompiles
+# Per-file segment alignment inside a super-tile: every BLOCK_ROWS row
+# block of the blocked kernel stays inside one (pk, ts)-sorted file.
+TILE_ALIGN = BLOCK_ROWS
 
 
 @dataclass
@@ -76,48 +97,109 @@ class TileContext:
 
 
 @dataclass
-class _FileTileEntry:
-    """Device tiles for one SST file, padded to TILE_QUANTUM at build time
-    so repeated queries hand the SAME arrays to the compiled program."""
+class _FileHostTiles:
+    """Host-side encoded columns for one SST file (the build cache the
+    device super-tile consolidates from; survives super-tile rebuilds so a
+    new flush or eviction never re-reads Parquet for old files).
 
-    cols: dict[str, jnp.ndarray] = field(default_factory=dict)
-    nulls: dict[str, jnp.ndarray] = field(default_factory=dict)
-    epochs: dict[str, int] = field(default_factory=dict)  # tag col -> dict epoch
-    valid: jnp.ndarray | None = None
+    `absent` lists value columns the file predates (ALTER ADD COLUMN):
+    consolidation NULL-fills their segment — the same schema-evolution
+    semantics as the reference's read compat shim
+    (mito2/src/read/compat.rs)."""
+
+    cols: dict[str, np.ndarray] = field(default_factory=dict)
+    nulls: dict[str, np.ndarray] = field(default_factory=dict)
+    epochs: dict[str, int] = field(default_factory=dict)
+    absent: set[str] = field(default_factory=set)
     num_rows: int = 0
     nbytes: int = 0
 
 
-class TileCacheManager:
-    """Device-resident per-(region, SST file) column tiles with LRU budget."""
+@dataclass
+class _SuperTiles:
+    """One region's consolidated device tiles."""
 
-    def __init__(self, budget_bytes: int = 8 << 30):
+    region_id: int
+    file_ids: tuple[str, ...]
+    offsets: tuple[int, ...]  # row offset of each file segment
+    num_rows: int  # real rows (sum of file rows)
+    pad: int  # padded (pow2) total length
+    cols: dict[str, jnp.ndarray] = field(default_factory=dict)
+    nulls: dict[str, jnp.ndarray] = field(default_factory=dict)
+    epochs: dict[str, int] = field(default_factory=dict)
+    valid: jnp.ndarray | None = None
+    perm: jnp.ndarray | None = None  # ts-ascending gather (time-major plans)
+    nbytes: int = 0
+
+
+def _segment_layout(metas: list[FileMeta]) -> tuple[tuple[int, ...], int, int]:
+    """(offsets, total_rows, padded_total) with per-file TILE_ALIGN padding."""
+    offsets = []
+    off = 0
+    total = 0
+    for m in metas:
+        offsets.append(off)
+        total += m.num_rows
+        seg = max(-(-m.num_rows // TILE_ALIGN) * TILE_ALIGN, TILE_ALIGN)
+        off += seg
+    return tuple(offsets), total, padded_size(off)
+
+
+class TileCacheManager:
+    """Device-resident per-region super-tiles + host-side per-file encode
+    cache, both LRU-bounded."""
+
+    def __init__(self, budget_bytes: int = 8 << 30, host_budget_bytes: int | None = None):
         self.budget = budget_bytes
+        self.host_budget = host_budget_bytes or budget_bytes * 2
         self._lock = threading.RLock()
-        self._entries: OrderedDict[tuple[int, str], _FileTileEntry] = OrderedDict()
+        self._super: OrderedDict[int, _SuperTiles] = OrderedDict()
+        self._host: OrderedDict[tuple[int, str], _FileHostTiles] = OrderedDict()
         self._used = 0
+        self._host_used = 0
         self._region_versions: dict[int, int] = {}
+        # files that can never join a super-tile (missing tag/ts column,
+        # row-count mismatch): excluded from the entry; queries whose
+        # window touches them fall back to the scan path
+        self._bad_files: set[tuple[int, str]] = set()
 
     # ---- bookkeeping -------------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
-            return {"files": len(self._entries), "bytes": self._used}
+            return {
+                "regions": len(self._super),
+                "bytes": self._used,
+                "host_files": len(self._host),
+                "host_bytes": self._host_used,
+            }
 
     def invalidate_region(self, region_id: int, keep_file_ids: set[str] | None = None):
-        """Drop tiles of files no longer in the region's manifest."""
+        """Drop host tiles of files no longer in the region's manifest and
+        the region's super-tile when its file set changed."""
         with self._lock:
-            for key in list(self._entries):
+            for key in list(self._host):
                 if key[0] == region_id and (
                     keep_file_ids is None or key[1] not in keep_file_ids
                 ):
-                    self._used -= self._entries.pop(key).nbytes
+                    self._host_used -= self._host.pop(key).nbytes
+            for key in list(self._bad_files):
+                if key[0] == region_id and (
+                    keep_file_ids is None or key[1] not in keep_file_ids
+                ):
+                    self._bad_files.discard(key)
+            entry = self._super.get(region_id)
+            if entry is not None and (
+                keep_file_ids is None
+                or not set(entry.file_ids) <= keep_file_ids
+            ):
+                self._used -= self._super.pop(region_id).nbytes
             self._region_versions.pop(region_id, None)
 
     def invalidate_region_if_changed(
         self, region_id: int, keep_file_ids: set[str], manifest_version: int
     ):
-        """Version-gated sweep: the O(cache) scan only runs when the
-        region's manifest actually advanced since the last query."""
+        """Version-gated sweep: runs only when the region's manifest
+        actually advanced since the last query."""
         with self._lock:
             if self._region_versions.get(region_id) == manifest_version:
                 return
@@ -125,78 +207,207 @@ class TileCacheManager:
         with self._lock:
             self._region_versions[region_id] = manifest_version
 
-    def _evict_locked(self, pinned: set[tuple[int, str]]):
-        while self._used > self.budget and len(self._entries) > len(pinned):
-            for key in list(self._entries):
-                if key not in pinned:
-                    self._used -= self._entries.pop(key).nbytes
+    def _evict_locked(self, pinned_regions: set[int]):
+        while self._used > self.budget and len(self._super) > len(pinned_regions):
+            for rid in list(self._super):
+                if rid not in pinned_regions:
+                    self._used -= self._super.pop(rid).nbytes
                     metrics.TILE_CACHE_EVICTIONS.inc()
                     break
             else:
                 break
+        while self._host_used > self.host_budget and len(self._host) > 0:
+            key, entry = next(iter(self._host.items()))
+            self._host_used -= entry.nbytes
+            del self._host[key]
 
-    # ---- tile build / fetch ------------------------------------------------
-    def file_tiles(
+    # ---- host-side per-file encode cache -----------------------------------
+    def _file_host_tiles(
         self,
         region: Region,
         dictionary: TableDictionary,
         meta: FileMeta,
+        columns: list[str],
         tag_cols: list[str],
         ts_col: str | None,
-        value_cols: list[str],
-        pinned: set[tuple[int, str]],
-    ) -> _FileTileEntry | None:
-        """Cached (or freshly built) device tiles for one SST file.  Returns
-        None when the file cannot be tiled (e.g. a needed column is absent —
-        pre-ALTER files fall back to the scan path)."""
+    ) -> _FileHostTiles | None:
         key = (region.region_id, meta.file_id)
-        need = list(dict.fromkeys(tag_cols + ([ts_col] if ts_col else []) + value_cols))
         with self._lock:
-            entry = self._entries.get(key)
+            entry = self._host.get(key)
             if entry is not None:
-                self._entries.move_to_end(key)
+                self._host.move_to_end(key)
         if entry is None:
-            entry = _FileTileEntry(num_rows=meta.num_rows)
-        missing = [c for c in need if c not in entry.cols]
+            entry = _FileHostTiles(num_rows=meta.num_rows)
+        missing = [c for c in columns if c not in entry.cols and c not in entry.absent]
         if missing:
-            built = self._build_columns(
-                region, dictionary, meta, missing, tag_cols, ts_col
-            )
-            if built is None:
+            table = region.sst_reader.read(meta, None, columns=missing)
+            if table.num_rows != meta.num_rows:
+                # unexpected — mark unusable rather than mis-aggregate
+                with self._lock:
+                    self._bad_files.add(key)
                 return None
-            cols, nulls, epochs, nbytes, pad = built
-            if entry.valid is None:
-                v = np.zeros(pad, bool)
-                v[: entry.num_rows] = True
-                entry.valid = jnp.asarray(v)
-                nbytes += pad
+            present = [c for c in missing if c in table.column_names]
+            for name in missing:
+                if name in table.column_names:
+                    continue
+                # file predates the column (ALTER ADD COLUMN): value
+                # columns NULL-fill at consolidation; a missing tag/ts
+                # column cannot be represented — exclude the file
+                if name in tag_cols or name == ts_col:
+                    with self._lock:
+                        self._bad_files.add(key)
+                    return None
+                entry.absent.add(name)
+            built = _encode_host_tiles(dictionary, table, present, tag_cols, ts_col)
+            if built is None:
+                with self._lock:
+                    self._bad_files.add(key)
+                return None
+            cols, nulls, epochs, nbytes = built
             entry.cols.update(cols)
             entry.nulls.update(nulls)
             entry.epochs.update(epochs)
             entry.nbytes += nbytes
             metrics.TILE_CACHE_MISSES.inc()
             with self._lock:
-                old = self._entries.pop(key, None)
+                old = self._host.pop(key, None)
                 if old is not None and old is not entry:
-                    self._used -= old.nbytes
-                self._entries[key] = entry
-                self._used += nbytes
-                self._evict_locked(pinned)
-        else:
-            metrics.TILE_CACHE_HITS.inc()
+                    self._host_used -= old.nbytes
+                self._host[key] = entry
+                self._host_used += nbytes
         return entry
 
-    def repair_entries(
+    def _repair_host_locked(self, entry: _FileHostTiles, dictionary: TableDictionary):
+        """Bring a host tile's tag codes to the current dictionary epoch
+        with one np gather per stale column."""
+        for tag, epoch in list(entry.epochs.items()):
+            perm = dictionary.perm_since(tag, epoch)
+            if perm is not None:
+                codes = entry.cols[tag]
+                ok = (codes >= 0) & (codes < len(perm))
+                entry.cols[tag] = np.where(
+                    ok, perm[np.clip(codes, 0, len(perm) - 1)], -1
+                ).astype(np.int32)
+            entry.epochs[tag] = dictionary.epoch
+
+    # ---- super-tile build / fetch -----------------------------------------
+    def super_tiles(
         self,
-        entries: list[_FileTileEntry],
+        region: Region,
+        dictionary: TableDictionary,
+        metas: list[FileMeta],
+        tag_cols: list[str],
+        ts_col: str | None,
+        value_cols: list[str],
+        pinned_regions: set[int],
+    ) -> tuple[_SuperTiles | None, list[FileMeta]]:
+        """Cached (or freshly consolidated) device tiles for one region's
+        SST set.  Returns (entry, excluded): `excluded` lists files that
+        cannot join the super-tile (missing tag/ts column, row-count
+        mismatch) — the caller must fall back when any of them intersects
+        the query window.  entry is None when no file is includable."""
+        need = list(dict.fromkeys(tag_cols + ([ts_col] if ts_col else []) + value_cols))
+        rid = region.region_id
+
+        for _attempt in range(len(metas) + 1):
+            with self._lock:
+                included = [
+                    m for m in metas if (rid, m.file_id) not in self._bad_files
+                ]
+            excluded = [m for m in metas if m not in included]
+            if not included:
+                return None, excluded
+            ids = tuple(m.file_id for m in included)
+            with self._lock:
+                entry = self._super.get(rid)
+                if entry is not None:
+                    if entry.file_ids != ids:
+                        self._used -= self._super.pop(rid).nbytes
+                        entry = None
+                    else:
+                        self._super.move_to_end(rid)
+            if entry is None:
+                offsets, total, pad = _segment_layout(included)
+                entry = _SuperTiles(
+                    region_id=rid, file_ids=ids, offsets=offsets,
+                    num_rows=total, pad=pad,
+                )
+            missing = [c for c in need if c not in entry.cols]
+            if not missing and entry.valid is not None:
+                metrics.TILE_CACHE_HITS.inc()
+                return entry, excluded
+
+            # host encodes (cheap when cached); these may GROW the
+            # dictionary, so callers build the plan only after every
+            # region is prepared
+            host_tiles: list[_FileHostTiles] = []
+            for meta in included:
+                ht = self._file_host_tiles(
+                    region, dictionary, meta, missing, tag_cols, ts_col
+                )
+                if ht is None:
+                    break  # newly-discovered bad file: retry without it
+                host_tiles.append(ht)
+            if len(host_tiles) != len(included):
+                continue
+            with self._lock:
+                for ht in host_tiles:
+                    self._repair_host_locked(ht, dictionary)
+
+            added = 0
+            if entry.valid is None:
+                v = np.zeros(entry.pad, bool)
+                for off, ht in zip(entry.offsets, host_tiles):
+                    v[off : off + ht.num_rows] = True
+                entry.valid = jnp.asarray(v)
+                added += v.nbytes
+            for name in missing:
+                src = next(
+                    (ht.cols[name] for ht in host_tiles if name in ht.cols), None
+                )
+                dtype = src.dtype if src is not None else np.float64
+                buf = np.zeros(entry.pad, dtype=dtype)
+                any_nulls = any(
+                    name in ht.nulls or name in ht.absent for ht in host_tiles
+                )
+                nbuf = np.zeros(entry.pad, bool) if any_nulls else None
+                for off, ht in zip(entry.offsets, host_tiles):
+                    if name in ht.absent:
+                        continue  # pre-ALTER file: NULL-filled (nbuf False)
+                    buf[off : off + ht.num_rows] = ht.cols[name]
+                    if nbuf is not None:
+                        if name in ht.nulls:
+                            nbuf[off : off + ht.num_rows] = ht.nulls[name]
+                        else:
+                            nbuf[off : off + ht.num_rows] = True
+                entry.cols[name] = jnp.asarray(buf)
+                added += buf.nbytes
+                if nbuf is not None:
+                    entry.nulls[name] = jnp.asarray(nbuf)
+                    added += nbuf.nbytes
+                if name in tag_cols:
+                    entry.epochs[name] = dictionary.epoch
+            entry.nbytes += added
+            with self._lock:
+                old = self._super.pop(rid, None)
+                if old is not None and old is not entry:
+                    self._used -= old.nbytes
+                self._super[rid] = entry
+                self._used += added
+                self._evict_locked(pinned_regions | {rid})
+            return entry, excluded
+        return None, list(metas)
+
+    def repair_super(
+        self,
+        entries: list[_SuperTiles],
         dictionary: TableDictionary,
         tag_cols: list[str],
     ):
-        """Dictionary-growth repair: one gather per stale tag column.  MUST
-        run after every source of the query has updated the dictionary
-        (a later file/memtable can insert values that shift codes an
-        earlier-fetched tile was encoded with).  Serialized under the cache
-        lock so concurrent queries can't double-apply a permutation."""
+        """Dictionary-growth repair: one device gather per stale tag
+        column.  MUST run after every source of the query has updated the
+        dictionary.  Serialized under the cache lock so concurrent queries
+        can't double-apply a permutation."""
         with self._lock:
             for entry in entries:
                 for tag in tag_cols:
@@ -212,39 +423,38 @@ class TileCacheManager:
                         ).astype(jnp.int32)
                     entry.epochs[tag] = dictionary.epoch
 
-    def _build_columns(
-        self,
-        region: Region,
-        dictionary: TableDictionary,
-        meta: FileMeta,
-        columns: list[str],
-        tag_cols: list[str],
-        ts_col: str | None,
-    ):
-        table = region.sst_reader.read(meta, None, columns=columns)
-        if table.num_rows != meta.num_rows:
-            return None  # unexpected — refuse rather than mis-aggregate
-        for name in columns:
-            if name not in table.column_names:
-                return None  # file predates the column (ALTER) — not tileable
-        return _encode_table_tiles(dictionary, table, columns, tag_cols, ts_col)
+    def ensure_perm(self, entry: _SuperTiles, ts_name: str):
+        """Lazily build the ts-ascending permutation for time-major plans
+        (padding rows sort last via an int64-max key).  Cached on the
+        entry; ~one device sort per (region, file-set).  Build + budget
+        accounting run under the lock so a concurrent eviction can't leave
+        phantom bytes in the counter (bytes are only charged while the
+        entry is still cached) and the argsort never runs twice."""
+        with self._lock:
+            if entry.perm is None:
+                ts = entry.cols[ts_name]
+                key = jnp.where(entry.valid, ts, jnp.iinfo(jnp.int64).max)
+                entry.perm = jnp.argsort(key).astype(jnp.int32)
+                entry.nbytes += entry.pad * 4
+                if self._super.get(entry.region_id) is entry:
+                    self._used += entry.pad * 4
+            return entry.perm
 
 
-def _encode_table_tiles(
+def _encode_host_tiles(
     dictionary: TableDictionary,
     table: pa.Table,
     columns: list[str],
     tag_cols: list[str],
     ts_col: str | None,
 ):
-    """Shared encode-and-pad for SST files and memtable tails: tag strings
+    """Shared host encode for SST files and memtable tails: tag strings
     -> dictionary codes (growing the dictionary), ts -> int64, values ->
-    numeric; everything zero-padded to TILE_QUANTUM and uploaded.  Returns
-    (cols, nulls, epochs, nbytes, pad) or None when a column can't tile."""
+    numeric.  Returns (cols, nulls, epochs, nbytes) of unpadded numpy
+    arrays, or None when a column can't tile."""
     n = table.num_rows
-    pad = padded_size(n, TILE_QUANTUM)
-    cols: dict[str, jnp.ndarray] = {}
-    nulls: dict[str, jnp.ndarray] = {}
+    cols: dict[str, np.ndarray] = {}
+    nulls: dict[str, np.ndarray] = {}
     epochs: dict[str, int] = {}
     nbytes = 0
     for name in columns:
@@ -262,18 +472,14 @@ def _encode_table_tiles(
             if np_arr is None:
                 return None
             if col.null_count:
-                present = np.zeros(pad, bool)
-                present[:n] = np.asarray(
+                present = np.asarray(
                     pc.is_valid(col).to_numpy(zero_copy_only=False), bool
                 )
-                nulls[name] = jnp.asarray(present)
+                nulls[name] = present
                 nbytes += present.nbytes
-        padded = np.zeros(pad, dtype=np_arr.dtype)
-        padded[:n] = np_arr
-        arr = jnp.asarray(padded)
-        cols[name] = arr
-        nbytes += arr.nbytes
-    return cols, nulls, epochs, nbytes, pad
+        cols[name] = np.ascontiguousarray(np_arr)
+        nbytes += np_arr.nbytes
+    return cols, nulls, epochs, nbytes
 
 
 def _value_to_numpy(col) -> np.ndarray | None:
@@ -296,16 +502,23 @@ def _value_to_numpy(col) -> np.ndarray | None:
 # ---- the single-dispatch program -------------------------------------------
 
 
-@functools.lru_cache(maxsize=64)
 @functools.lru_cache(maxsize=256)
 def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
-    """jit program: per-source partial states, merged pairwise, FINALIZED on
-    device, and packed into ONE [K, G] float64 buffer holding ONLY the rows
-    this query's output consumes — one dispatch in, one device->host
-    transfer out.  On a remote-device harness every separate fetch pays the
-    full host round-trip, so everything rides one buffer (counts are exact
-    in float64 below 2^53), and bytes scale with requested outputs, not
-    with every state the kernels track.
+    """jit program over ALL of a query's sources: per-source partial
+    states (blocked/scatter kernels), merged pairwise, FINALIZED on
+    device, and packed into ONE [K, G] float64 buffer holding ONLY the
+    rows this query's output consumes — one dispatch in, one device->host
+    transfer out.  On a remote-device harness every separate fetch pays
+    the full host round-trip, so everything rides one buffer (counts are
+    exact in float64 below 2^53), and bytes scale with requested outputs,
+    not with every state the kernels track.
+
+    Source count is small by construction (one super-tile per region plus
+    memtable tails), so the traced unroll stays bounded; jax re-traces
+    per distinct source-shape signature, and pow2 padding keeps that set
+    O(log N).  Compile time is flat in shape since the blocked/scatter
+    kernel pair compiles in ~3 s at any size (the superlinear
+    associative-scan branch was removed — see ops/aggregate.py).
 
     Count rows ship only for (a) explicit count() outputs and (b) NULLABLE
     aggregated columns (NULL-group gating); non-nullable columns gate on
@@ -322,19 +535,15 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
         if "count" in aggs or (col in nullable_cols and col != COUNT_STAR):
             layout.append((col, "count"))
 
-    # FIXED-SHAPE chunked dispatch, merges folded on device — NOT one jit
-    # over a Python loop of all sources: tracing that loop unrolls the
-    # program proportionally to SST count, and XLA compile time explodes
-    # with data size (observed: minutes at TSBS scale).  Instead every
-    # source is sliced into chunks of exactly CHUNK rows (sources are
-    # power-of-two padded, so chunks tile them evenly; smaller sources keep
-    # their own pow2 shape) — ONE compiled partial program serves any
-    # dataset size, survives in the persistent compilation cache, and the
-    # fold costs one tiny merge dispatch per chunk (~dispatch-floor each).
-    partial_jit = jax.jit(functools.partial(compute_partial_states, plan))
-    merge_jit = jax.jit(lambda a, b: {k: merge_states(a[k], b[k]) for k in a})
-
-    def _final(merged):
+    def run_all(sources, dyn):
+        merged = None
+        for cols, valid, nulls, perm in sources:
+            states = compute_partial_states(plan, cols, valid, nulls, dyn, perm=perm)
+            merged = (
+                states
+                if merged is None
+                else {k: merge_states(merged[k], states[k]) for k in merged}
+            )
         outs = {
             col: finalize(merged[col], tuple(sorted(aggs | {"count"})))
             for col, aggs in per_col_aggs.items()
@@ -343,29 +552,12 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
         rows = [outs[col][agg].astype(jnp.float64) for col, agg in layout]
         return jnp.stack(rows)
 
-    final_jit = jax.jit(_final)
-
-    from ..ops.tiles import DEFAULT_TILE_ROWS as _CHUNK
-
-    def run(sources, dyn):
-        merged = None
-        for cols, valid, nulls in sources:
-            n = int(valid.shape[0])
-            step = _CHUNK if n > _CHUNK else n
-            for start in range(0, n, step):
-                c = {k: a[start : start + step] for k, a in cols.items()}
-                v = valid[start : start + step]
-                u = {k: a[start : start + step] for k, a in nulls.items()}
-                states = partial_jit(c, v, u, dyn)
-                merged = states if merged is None else merge_jit(merged, states)
-        return final_jit(merged)
-
-    return run, tuple(layout)
+    return jax.jit(run_all), tuple(layout)
 
 
 class TileExecutor:
-    """Aggregation over cached HBM tiles; returns None when not applicable
-    so the caller can fall back to the authoritative path."""
+    """Aggregation over cached HBM super-tiles; returns None when not
+    applicable so the caller can fall back to the authoritative path."""
 
     def __init__(self, cache: TileCacheManager, config):
         self.cache = cache
@@ -388,7 +580,6 @@ class TileExecutor:
         filter_tag_cols = [
             f[0] for f in scan.filters if f[0] in tag_names and f[0] not in tag_cols
         ]
-        all_tag_cols = tag_cols + filter_tag_cols
         value_cols = list(
             dict.fromkeys(
                 [c for _f, c in lowering.agg_specs if c is not None]
@@ -406,6 +597,25 @@ class TileExecutor:
             or any(f[0] == ts_name for f in scan.filters)
         )
         use_ts = ts_name if (needs_ts and ts_name) else None
+        # hierarchical layouts compose gids over a pk prefix: those tag
+        # codes must be tiled even when not grouped or filtered on
+        pk = [c.name for c in schema.tag_columns()]
+        layout_probe = _choose_layout(pk, tag_cols, lowering.bucket is not None)
+        needs_last = any(f == "last_value" for f, _ in lowering.agg_specs)
+        if needs_last and (
+            (layout_probe is not None and set(tag_cols) != set(layout_probe))
+            or (lowering.bucket is not None and not tag_cols)
+        ):
+            # LAST states cannot fold away a pk axis (only permute) and
+            # have no time-major variant — bail BEFORE pinning/encoding
+            return None
+        extra_tag_cols = []
+        if layout_probe is not None:
+            extra_tag_cols = [
+                t for t in layout_probe
+                if t not in tag_cols and t not in filter_tag_cols
+            ]
+        all_tag_cols = tag_cols + filter_tag_cols + extra_tag_cols
 
         # 1. snapshot + safety gate, pinning every region until dispatch
         # done.  The table's dictionary gate serializes the whole
@@ -428,142 +638,179 @@ class TileExecutor:
         self, lowering, schema, scan, ctx, time_bounds, pinned_regions,
         ts_name, tag_names, tag_cols, all_tag_cols, value_cols, use_ts,
     ):
-        if True:  # structure kept flat for readability of the phases below
-            sources_meta = []  # (region, FileMeta|None mem marker, mem table)
-            prune_pred = ScanPredicate(
-                time_range=scan.time_range,
-                filters=[f for f in scan.filters if f[0] in tag_names],
+        # Eligibility is judged on the sources that INTERSECT the query's
+        # time window: the super-tile spans every file, but rows outside
+        # the window are masked out on device, so overlap/tombstones in
+        # out-of-window history cannot affect this query's result — a
+        # windowed query over disjoint recent files stays on the tile path
+        # even when old compacted files overlap each other.
+        window = scan.time_range if scan.time_range is not None else None
+
+        def in_window(lo: int, hi: int) -> bool:
+            if window is None:
+                return True
+            wlo, whi = window
+            return hi >= wlo and lo < whi
+
+        region_sources = []  # (region, [FileMeta], [mem pa.Table])
+        ranges: list[tuple[int, int]] = []
+        for region in ctx.regions:
+            region.pin_scan()
+            pinned_regions.append(region)
+            all_files, mems, version = region.tile_snapshot()
+            # drop cached tiles of files compaction removed — but only
+            # when the manifest actually changed since the last sweep
+            self.cache.invalidate_region_if_changed(
+                region.region_id, {m.file_id for m in all_files}, version
             )
-            ranges: list[tuple[int, int]] = []
-            for region in ctx.regions:
-                region.pin_scan()
-                pinned_regions.append(region)
-                all_files, mems, version = region.tile_snapshot()
-                # drop cached tiles of files compaction removed — but only
-                # when the manifest actually changed since the last sweep
-                self.cache.invalidate_region_if_changed(
-                    region.region_id, {m.file_id for m in all_files}, version
-                )
-                files = region.sst_reader.prune_files(all_files, prune_pred)
-                for meta in files:
-                    if meta.num_deletes != 0:
-                        return None  # tombstones (or unknown) -> dedup needed
-                    sources_meta.append((region, meta, None))
-                    ranges.append(meta.time_range)
-                for mem in mems:
-                    mem_table = mem.scan(
-                        scan.time_range, dedup=not ctx.append_mode
-                    )
-                    if mem_table.num_rows == 0:
-                        continue
-                    if OP_COL in mem_table.column_names:
-                        op = pc.fill_null(
-                            pc.cast(mem_table[OP_COL], pa.int64()), 0
-                        )
-                        if pc.sum(op).as_py():
-                            return None  # tombstones in memtable
-                        mem_table = mem_table.drop_columns([OP_COL])
-                    sources_meta.append((region, None, mem_table))
-                    if ts_name and ts_name in mem_table.column_names:
+            mem_tables = []
+            for meta in all_files:
+                if not in_window(*meta.time_range):
+                    continue
+                if meta.num_deletes != 0:
+                    return None  # tombstones (or unknown) -> dedup needed
+                ranges.append(meta.time_range)
+            for mem in mems:
+                mem_table = mem.scan(None, dedup=not ctx.append_mode)
+                if mem_table.num_rows == 0:
+                    continue
+                if OP_COL in mem_table.column_names:
+                    op_rows = mem_table
+                    if window is not None and ts_name in mem_table.column_names:
                         ts_i = pc.cast(mem_table[ts_name], pa.int64())
-                        ranges.append(
-                            (pc.min(ts_i).as_py(), pc.max(ts_i).as_py())
+                        sel = pc.and_(
+                            pc.greater_equal(ts_i, window[0]),
+                            pc.less(ts_i, window[1]),
                         )
-                    else:
-                        ranges.append((0, 0))
-            if not ctx.append_mode and not _disjoint(ranges):
-                return None
-            if not sources_meta:
-                return None  # empty table: let the normal path shape output
-
-            # 2. fetch/build file tiles + encode memtable tails
-            pinned_keys = {
-                (r.region_id, m.file_id) for r, m, _ in sources_meta if m is not None
-            }
-            # phase A: grow the dictionary from every source BEFORE any
-            # encode whose output must be final — memtable values first
-            # (cheap), then file builds (which update as they encode)
-            for _region, meta, mem_table in sources_meta:
-                if meta is None:
-                    ctx.dictionary.update_table(mem_table, all_tag_cols)
-            file_entries: list[_FileTileEntry] = []
-            slots: list = []
-            for region, meta, mem_table in sources_meta:
-                if meta is not None:
-                    entry = self.cache.file_tiles(
-                        region, ctx.dictionary, meta, all_tag_cols,
-                        use_ts, value_cols, pinned_keys,
-                    )
-                    if entry is None:
-                        return None
-                    file_entries.append(entry)
-                    slots.append(entry)
+                        op_rows = mem_table.filter(sel)
+                    if (
+                        op_rows.num_rows
+                        and pc.sum(
+                            pc.fill_null(pc.cast(op_rows[OP_COL], pa.int64()), 0)
+                        ).as_py()
+                    ):
+                        return None  # tombstones inside the window
+                    mem_table = mem_table.drop_columns([OP_COL])
+                if ts_name and ts_name in mem_table.column_names:
+                    ts_i = pc.cast(mem_table[ts_name], pa.int64())
+                    mlo, mhi = pc.min(ts_i).as_py(), pc.max(ts_i).as_py()
+                    if not in_window(mlo, mhi):
+                        continue  # fully out of window: skip the encode
+                    ranges.append((mlo, mhi))
                 else:
-                    slots.append((region, mem_table))
-            # phase B: the dictionary is final for this query — repair any
-            # tile encoded under an older epoch with one gather, and encode
-            # the memtable tails against the final code assignment
-            self.cache.repair_entries(file_entries, ctx.dictionary, all_tag_cols)
-            device_sources = []
-            for s in slots:
-                if isinstance(s, _FileTileEntry):
-                    device_sources.append((s.cols, s.valid, s.nulls))
-                else:
-                    src = self._encode_mem(
-                        ctx.dictionary, s[1], all_tag_cols, use_ts, value_cols
-                    )
-                    if src is None:
-                        return None
-                    device_sources.append(src)
+                    ranges.append((0, 0))
+                mem_tables.append(mem_table)
+            region_sources.append((region, all_files, mem_tables))
+        if not ctx.append_mode and not _disjoint(ranges):
+            return None
+        if not any(fs or ms for _r, fs, ms in region_sources):
+            return None  # empty table: let the normal path shape output
 
-            # 3. the static plan (cards AFTER all dictionary updates) plus
-            # its runtime-dynamic parameters (filter literals, bucket
-            # geometry) — changing a literal or window reuses the compile
-            built = self._build_plan(
-                lowering, schema, scan, ctx, tag_cols, time_bounds, use_ts
-            )
-            if built is None:
-                return None
-            plan, dyn_host = built
-            if plan.num_groups > self.config.max_groups * 64:
-                return None  # group space too large for dense [G] states
-
-            # 4. one dispatch
-            nullable_cols = tuple(
-                sorted(
-                    c
-                    for _f, c in plan.agg_specs
-                    if c != COUNT_STAR
-                    and schema.has_column(c)
-                    and schema.column(c).nullable
+        # 2. phase A — every dictionary mutation happens BEFORE the plan
+        # is built: memtable values first (cheap), then per-file host
+        # encodes inside super_tiles (cached after the first query)
+        for _region, _metas, mem_tables in region_sources:
+            for mt in mem_tables:
+                ctx.dictionary.update_table(mt, all_tag_cols)
+        pinned_ids = {r.region_id for r, _f, _m in region_sources}
+        super_entries: list[_SuperTiles] = []
+        slots: list = []
+        for region, metas, mem_tables in region_sources:
+            if metas:
+                entry, excluded = self.cache.super_tiles(
+                    region, ctx.dictionary, metas, all_tag_cols,
+                    use_ts, value_cols, pinned_ids,
                 )
-            )
-            program, layout = _tile_program(plan, nullable_cols)
-            need_cols = self._plan_cols(plan)
-            args = []
-            for cols, valid, nulls in device_sources:
-                args.append(
+                # a file that cannot join the super-tile only blocks
+                # queries whose window its rows could affect
+                for meta in excluded:
+                    if in_window(*meta.time_range):
+                        return None
+                if entry is not None:
+                    super_entries.append(entry)
+                    slots.append(entry)
+            for mt in mem_tables:
+                slots.append((region, mt))
+        if not slots:
+            return None  # nothing in-window to aggregate on device
+
+        # 3. the static plan (cards AFTER all dictionary updates) plus
+        # its runtime-dynamic parameters (filter literals, bucket
+        # geometry) — changing a literal or window reuses the compile
+        built = self._build_plan(
+            lowering, schema, scan, ctx, tag_cols, time_bounds, use_ts
+        )
+        if built is None:
+            return None
+        plan, dyn_host = built
+        if plan.num_groups > self.config.max_groups * 64:
+            return None  # group space too large for dense [G] states
+        if plan.internal_groups > self.config.max_internal_groups:
+            return None
+
+        # 4. phase B — dictionary is final for this query: repair stale
+        # device tiles with one gather, build perms, encode memtail
+        self.cache.repair_super(super_entries, ctx.dictionary, all_tag_cols)
+        device_sources = []
+        for s in slots:
+            if isinstance(s, _SuperTiles):
+                perm = None
+                if plan.time_major:
+                    perm = self.cache.ensure_perm(s, use_ts)
+                need_cols = self._plan_cols(plan)
+                device_sources.append(
+                    (
+                        {k: v for k, v in s.cols.items() if k in need_cols},
+                        s.valid,
+                        {k: v for k, v in s.nulls.items() if k in need_cols},
+                        perm,
+                    )
+                )
+            else:
+                src = self._encode_mem(
+                    ctx.dictionary, s[1], all_tag_cols, use_ts, value_cols
+                )
+                if src is None:
+                    return None
+                need_cols = self._plan_cols(plan)
+                cols, valid, nulls = src
+                device_sources.append(
                     (
                         {k: v for k, v in cols.items() if k in need_cols},
                         valid,
                         {k: v for k, v in nulls.items() if k in need_cols},
+                        None,
                     )
                 )
-            dyn = {
-                "filter_values": tuple(dyn_host["filter_values"]),
-                "bucket_origin": np.int64(dyn_host["bucket_origin"]),
-                "bucket_interval": np.int64(dyn_host["bucket_interval"]),
-            }
-            packed = program(tuple(args), dyn)
-            metrics.TILE_LOWERED_TOTAL.inc()
-            return self._finalize(
-                packed, layout, plan, lowering, schema, ctx, dyn_host
+
+        # 5. one dispatch, one fetch
+        nullable_cols = tuple(
+            sorted(
+                c
+                for _f, c in plan.agg_specs
+                if c != COUNT_STAR
+                and schema.has_column(c)
+                and schema.column(c).nullable
             )
+        )
+        program, layout = _tile_program(plan, nullable_cols)
+        dyn = {
+            "filter_values": tuple(dyn_host["filter_values"]),
+            "bucket_origin": np.int64(dyn_host["bucket_origin"]),
+            "bucket_interval": np.int64(dyn_host["bucket_interval"]),
+        }
+        packed = program(tuple(device_sources), dyn)
+        metrics.TILE_LOWERED_TOTAL.inc()
+        return self._finalize(
+            packed, layout, plan, lowering, schema, ctx, dyn_host
+        )
 
     # -- helpers -------------------------------------------------------------
     @staticmethod
     def _plan_cols(plan: DistGroupByPlan) -> set:
         need = set(plan.group_tags) | {f[0] for f in plan.filters}
+        if plan.layout_tags:
+            need |= set(plan.layout_tags)
         if plan.bucket_col:
             need.add(plan.bucket_col)
         if plan.ts_col:
@@ -574,27 +821,41 @@ class TileExecutor:
         return need
 
     def _encode_mem(self, dictionary, table, tag_cols, ts_col, value_cols):
-        """Encode the (small, fresh) memtable tail; same encode-and-pad as
-        file tiles (_encode_table_tiles) so the two can never diverge."""
+        """Encode the (small, fresh) memtable tail; same host encode as
+        file tiles (_encode_host_tiles) so the two can never diverge."""
         need = list(
             dict.fromkeys(tag_cols + ([ts_col] if ts_col else []) + value_cols)
         )
         for name in need:
             if name not in table.column_names:
                 return None
-        built = _encode_table_tiles(dictionary, table, need, tag_cols, ts_col)
+        built = _encode_host_tiles(dictionary, table, need, tag_cols, ts_col)
         if built is None:
             return None
-        cols, nulls, _epochs, _nbytes, pad = built
+        cols, nulls, _epochs, _nbytes = built
+        n = table.num_rows
+        pad = padded_size(n, 1024)
+        out_cols = {}
+        out_nulls = {}
+        for name, arr in cols.items():
+            buf = np.zeros(pad, dtype=arr.dtype)
+            buf[:n] = arr
+            out_cols[name] = jnp.asarray(buf)
+        for name, arr in nulls.items():
+            buf = np.zeros(pad, bool)
+            buf[:n] = arr
+            out_nulls[name] = jnp.asarray(buf)
         v = np.zeros(pad, bool)
-        v[: table.num_rows] = True
-        return (cols, jnp.asarray(v), nulls)
+        v[:n] = True
+        return (out_cols, jnp.asarray(v), out_nulls)
 
     def _build_plan(self, lowering, schema, scan, ctx, tag_cols, time_bounds, use_ts):
         """Returns (plan, dyn_host): `plan` is the compile-static structure
         (filter literals replaced by placeholders, n_buckets quantized to a
         power of two) and `dyn_host` carries the runtime values — so
-        dashboards that vary literals or time windows reuse one compile."""
+        dashboards that vary literals or time windows reuse one compile.
+        Also decides the LAYOUT strategy (direct / hierarchical /
+        time-major) from the primary-key order — see module docstring."""
         d = ctx.dictionary
         if lowering.bucket is not None:
             ts_col, interval, origin_hint = lowering.bucket
@@ -657,6 +918,20 @@ class TileExecutor:
         for func, col in lowering.agg_specs:
             norm_specs.append((func, COUNT_STAR if col is None else col))
         needs_ts_order = any(f == "last_value" for f, _ in norm_specs)
+
+        # layout strategy
+        pk = [c.name for c in schema.tag_columns()]
+        layout_tags = _choose_layout(pk, tag_cols, bucket_col is not None)
+        time_major = bucket_col is not None and not tag_cols and layout_tags is None
+        if (
+            layout_tags is not None
+            and needs_ts_order
+            and set(tag_cols) != set(layout_tags)
+        ):
+            return None  # LAST states only permute, never fold away an axis
+        if time_major and needs_ts_order:
+            return None
+
         filter_null_cols = tuple(
             sorted(
                 {
@@ -681,6 +956,11 @@ class TileExecutor:
             acc_dtype=self.config_acc_dtype(),
             ts_col=use_ts if needs_ts_order else None,
             filter_null_cols=filter_null_cols,
+            layout_tags=None if layout_tags is None else tuple(layout_tags),
+            layout_cards=()
+            if layout_tags is None
+            else tuple(_quantize_card(d.cardinality(t)) for t in layout_tags),
+            time_major=time_major,
         )
         dyn_host = {
             "filter_values": filter_vals,
@@ -696,7 +976,9 @@ class TileExecutor:
 
     def _finalize(self, packed, layout, plan, lowering, schema, ctx, dyn_host):
         # ONE host fetch total, regardless of how many aggregates ran
-        flat = np.asarray(packed)
+        t0 = time.perf_counter()
+        flat = jax.device_get(packed)
+        metrics.TILE_READBACK_MS.observe((time.perf_counter() - t0) * 1000.0)
         finals: dict[str, dict[str, np.ndarray]] = {}
         for i, (col, agg) in enumerate(layout):
             finals.setdefault(col, {})[agg] = flat[i]
@@ -726,6 +1008,32 @@ class TileExecutor:
             bucket_interval=dyn_host["bucket_interval"],
         )
         return result.to_table()
+
+
+def _choose_layout(
+    pk: list[str], group_tags: list[str], has_bucket: bool
+) -> list[str] | None:
+    """Pick the hierarchical gid composition, or None when the requested
+    groups already follow the storage sort order (direct layout) or when a
+    time-major permutation serves better (bucket-only group-by).
+
+    Sources are sorted by (pk..., ts); a gid composed over a pk PREFIX in
+    pk order (+ bucket last, which follows ts) is non-decreasing per
+    source, which is what the blocked kernel wants."""
+    if not all(t in pk for t in group_tags):
+        return None  # non-pk group tag: no layout claim (scatter handles)
+    if has_bucket:
+        if not group_tags:
+            return None  # bucket-only: time-major path instead
+        if list(group_tags) == pk:
+            return None  # direct: (full pk, bucket) rides the sort
+        return pk  # aggregate at (full pk, bucket), fold down
+    if not group_tags:
+        return None  # scalar aggregate: single group
+    if list(group_tags) == pk[: len(group_tags)]:
+        return None  # direct: pk prefix in pk order
+    j = 1 + max(pk.index(t) for t in group_tags)
+    return pk[:j]
 
 
 def _encode_tag_filter(

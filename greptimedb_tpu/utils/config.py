@@ -92,6 +92,10 @@ class QueryConfig:
     backend: str = "tpu"
     tile_rows: int = 1 << 20
     max_groups: int = 1 << 16
+    # stage-1 group-space cap for hierarchical (pk x bucket) aggregation
+    # (ops/aggregate.py reduce_state_axes); dense [G] states at 8 bytes make
+    # 2^24 = 128 MB per tracked aggregate — fine in HBM, folded before fetch
+    max_internal_groups: int = 1 << 24
     parallelism: int = 0  # 0 = number of local devices
     fallback_to_cpu: bool = True
     # HBM-resident SST tile cache (parallel/tile_cache.py): warm queries run

@@ -339,3 +339,138 @@ def test_raw_group_ids_empty_components():
     mask = jnp.asarray(np.array([True, True, False, True, True]))
     legacy = group_ids([], mask, 1)
     np.testing.assert_array_equal(np.asarray(legacy), [0, 0, 1, 0, 0])
+
+
+@pytest.mark.parametrize("layout", ["clustered", "unsorted"])
+def test_segment_aggregate_blocked_last(layout):
+    """last_value at large n: clustered layouts take the two-pass blocked
+    LAST kernel, unsorted ids its scatter fallback — both must agree with
+    a numpy last-by-ts (ties -> max value) reference."""
+    from greptimedb_tpu.ops import aggregate as agg
+
+    rng = np.random.default_rng(13)
+    n = agg._FAST_MIN_ROWS + 777
+    num_groups = 64
+    if layout == "clustered":
+        gids = np.sort(rng.integers(0, num_groups, n)).astype(np.int32)
+    else:
+        gids = rng.integers(0, num_groups, n).astype(np.int32)
+    ts = rng.integers(0, 1000, n).astype(np.int64)  # duplicate ts exercise ties
+    vals = rng.normal(10, 5, n)
+    mask = rng.random(n) > 0.15
+
+    state = segment_aggregate(
+        jnp.asarray(vals), jnp.asarray(gids), num_groups, ("last", "count"),
+        mask=jnp.asarray(mask), ts=jnp.asarray(ts), acc_dtype=jnp.float64,
+    )
+    last_ts = np.full(num_groups, np.iinfo(np.int64).min)
+    last_val = np.full(num_groups, -np.inf)
+    counts = np.zeros(num_groups, np.int64)
+    for g, t, v, m in zip(gids, ts, vals, mask):
+        if not m:
+            continue
+        counts[g] += 1
+        if t > last_ts[g] or (t == last_ts[g] and v > last_val[g]):
+            last_ts[g], last_val[g] = t, max(v, last_val[g] if t == last_ts[g] else -np.inf)
+    nz = counts > 0
+    np.testing.assert_array_equal(np.asarray(state.counts), counts)
+    np.testing.assert_array_equal(np.asarray(state.last_ts)[nz], last_ts[nz])
+    np.testing.assert_allclose(np.asarray(state.last_val)[nz], last_val[nz])
+
+
+def test_reduce_state_axes_fold_and_permute():
+    """Hierarchical stage 2: folding a [a, b, bucket] state down to
+    (b, bucket), (bucket,), and the pk-order-violating (b, a) must match
+    numpy reshape-reduce."""
+    from greptimedb_tpu.ops.aggregate import AggState, reduce_state_axes
+
+    rng = np.random.default_rng(5)
+    cards = (4, 3, 5)
+    g = 4 * 3 * 5
+    sums = rng.normal(size=g)
+    counts = rng.integers(0, 9, g).astype(np.int64)
+    mins = rng.normal(size=g)
+    maxs = rng.normal(size=g)
+    st = AggState(
+        sums=jnp.asarray(sums), counts=jnp.asarray(counts),
+        mins=jnp.asarray(mins), maxs=jnp.asarray(maxs),
+    )
+    cube = lambda a: a.reshape(cards)
+
+    out = reduce_state_axes(st, cards, keep_axes=(1, 2))  # drop axis 0
+    np.testing.assert_allclose(np.asarray(out.sums), cube(sums).sum(0).reshape(-1))
+    np.testing.assert_array_equal(np.asarray(out.counts), cube(counts).sum(0).reshape(-1))
+    np.testing.assert_allclose(np.asarray(out.mins), cube(mins).min(0).reshape(-1))
+    np.testing.assert_allclose(np.asarray(out.maxs), cube(maxs).max(0).reshape(-1))
+
+    out = reduce_state_axes(st, cards, keep_axes=(2,))  # bucket only
+    np.testing.assert_allclose(np.asarray(out.sums), cube(sums).sum((0, 1)).reshape(-1))
+
+    out = reduce_state_axes(st, cards, keep_axes=(1, 0))  # permuted, drop bucket
+    np.testing.assert_allclose(
+        np.asarray(out.sums), cube(sums).sum(2).transpose(1, 0).reshape(-1)
+    )
+
+    identity = reduce_state_axes(st, cards, keep_axes=(0, 1, 2))
+    np.testing.assert_allclose(np.asarray(identity.sums), sums)
+
+
+def test_compute_partial_states_hierarchical_matches_direct():
+    """A plan grouped by a non-prefix pk subset (layout over the full pk)
+    must produce the same states as the direct plan over shuffled data."""
+    from greptimedb_tpu.parallel.executor import DistGroupByPlan, compute_partial_states
+
+    rng = np.random.default_rng(3)
+    n = 4096
+    a = rng.integers(0, 4, n).astype(np.int32)
+    b = rng.integers(0, 8, n).astype(np.int32)
+    ts = rng.integers(0, 16_000, n).astype(np.int64)
+    v = rng.normal(10, 2, n)
+    cols = {
+        "a": jnp.asarray(a), "b": jnp.asarray(b),
+        "ts": jnp.asarray(ts), "v": jnp.asarray(v),
+    }
+    valid = jnp.asarray(np.ones(n, bool))
+    common = dict(
+        bucket_col="ts", bucket_origin=0, bucket_interval=1000, n_buckets=16,
+        agg_specs=(("avg", "v"), ("max", "v")), acc_dtype="float64",
+    )
+    direct = DistGroupByPlan(group_tags=("b",), tag_cards=(8,), **common)
+    hier = DistGroupByPlan(
+        group_tags=("b",), tag_cards=(8,),
+        layout_tags=("a", "b"), layout_cards=(4, 8), **common,
+    )
+    s1 = compute_partial_states(direct, cols, valid, {})
+    s2 = compute_partial_states(hier, cols, valid, {})
+    for k in s1:
+        if s1[k].sums is not None:
+            np.testing.assert_allclose(
+                np.asarray(s1[k].sums), np.asarray(s2[k].sums), rtol=1e-12
+            )
+        np.testing.assert_array_equal(np.asarray(s1[k].counts), np.asarray(s2[k].counts))
+        if s1[k].maxs is not None:
+            np.testing.assert_allclose(np.asarray(s1[k].maxs), np.asarray(s2[k].maxs))
+
+
+def test_compute_partial_states_time_major_perm():
+    """Time-major: passing a ts-ascending perm must leave results identical
+    (aggregation is order-independent) while making gids sorted."""
+    from greptimedb_tpu.parallel.executor import DistGroupByPlan, compute_partial_states
+
+    rng = np.random.default_rng(9)
+    n = 2048
+    ts = rng.permutation(np.arange(n)).astype(np.int64)
+    v = rng.normal(size=n)
+    cols = {"ts": jnp.asarray(ts), "v": jnp.asarray(v)}
+    valid = jnp.asarray(np.ones(n, bool))
+    plan = DistGroupByPlan(
+        group_tags=(), tag_cards=(), bucket_col="ts", bucket_origin=0,
+        bucket_interval=128, n_buckets=16, agg_specs=(("sum", "v"),),
+        acc_dtype="float64", time_major=True,
+    )
+    perm = jnp.asarray(np.argsort(ts).astype(np.int32))
+    s_perm = compute_partial_states(plan, cols, valid, {}, perm=perm)
+    s_plain = compute_partial_states(plan, cols, valid, {}, perm=None)
+    np.testing.assert_allclose(
+        np.asarray(s_perm["v"].sums), np.asarray(s_plain["v"].sums), rtol=1e-12
+    )

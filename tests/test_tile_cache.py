@@ -269,3 +269,108 @@ def test_ungrouped_aggregate_tiles(db):
     assert _tile_count() == before + 1
     assert t1["m"].to_pylist() == t2["m"].to_pylist()
     assert t1["c"].to_pylist() == t2["c"].to_pylist()
+
+
+def test_bucket_only_groupby_time_major(db):
+    """Bucket-only GROUP BY (TSBS single-groupby / groupby-orderby-limit
+    shape) rides the time-major permutation and must match CPU."""
+    _mk_cpu_table(db)
+    _load(db)
+    db.sql("ADMIN flush_table('cpu')")
+    before = _tile_count()
+    q = (
+        "SELECT time_bucket('10s', ts) AS tb, max(usage_user) AS mu,"
+        " count(*) AS c FROM cpu GROUP BY tb"
+    )
+    t1, t2 = _both(db, q)
+    assert _tile_count() == before + 1, "bucket-only query did not tile"
+    _assert_equal(t1, t2, ["tb"])
+
+
+def test_non_prefix_group_hierarchical(db):
+    """GROUP BY the second pk column (region) forces the hierarchical
+    (pk x bucket) layout with an on-device fold; results must match CPU."""
+    _mk_cpu_table(db)
+    _load(db)
+    db.sql("ADMIN flush_table('cpu')")
+    before = _tile_count()
+    q = (
+        "SELECT region, time_bucket('30s', ts) AS tb, avg(usage_user) AS au,"
+        " min(usage_system) AS ms FROM cpu GROUP BY region, tb"
+    )
+    t1, t2 = _both(db, q)
+    assert _tile_count() == before + 1, "hierarchical layout did not tile"
+    _assert_equal(t1, t2, ["region", "tb"])
+    # and without a bucket: non-prefix tag subset alone
+    q2 = "SELECT region, sum(usage_user) AS s FROM cpu GROUP BY region"
+    t1, t2 = _both(db, q2)
+    _assert_equal(t1, t2, ["region"])
+
+
+def test_windowed_query_tiles_despite_out_of_window_overlap(db):
+    """Overlap confined to OLD files must not disqualify a windowed query
+    whose in-window sources are disjoint (round-3 gate: eligibility is
+    judged per query window, not whole-table)."""
+    _mk_cpu_table(db)
+    _load(db, ticks=50)
+    db.sql("ADMIN flush_table('cpu')")
+    _load(db, ticks=50)  # same (host, ts) keys -> overlapping history
+    db.sql("ADMIN flush_table('cpu')")
+    _load(db, ticks=50, t0=1_000_000)  # disjoint recent window
+    db.sql("ADMIN flush_table('cpu')")
+    before = _tile_count()
+    q = (
+        "SELECT host, count(*) AS c FROM cpu"
+        " WHERE ts >= 1000000 AND ts < 2000000 GROUP BY host"
+    )
+    t1, t2 = _both(db, q)
+    assert _tile_count() == before + 1, "windowed query should tile"
+    _assert_equal(t1, t2, ["host"])
+    assert sum(t1["c"].to_pylist()) == 50 * 6
+    # whole-table query still correctly refuses (overlap inside window)
+    before = _tile_count()
+    t1, t2 = _both(db, Q)
+    assert _tile_count() == before, "overlapping whole-table query must not tile"
+    _assert_equal(t1, t2, ["host", "tb"])
+
+
+def test_last_value_tiles_on_pk_group(db):
+    """lastpoint shape: last_value grouped by the pk prefix tiles; grouped
+    by a non-prefix tag it must bail (no hierarchical LAST fold)."""
+    _mk_cpu_table(db)
+    _load(db)
+    db.sql("ADMIN flush_table('cpu')")
+    before = _tile_count()
+    q = (
+        "SELECT host, region, last_value(usage_user ORDER BY ts) AS lu"
+        " FROM cpu GROUP BY host, region"
+    )
+    t1, t2 = _both(db, q)
+    assert _tile_count() == before + 1, "pk-group last_value should tile"
+    _assert_equal(t1, t2, ["host", "region"])
+    q2 = "SELECT region, last_value(usage_user ORDER BY ts) AS lu FROM cpu GROUP BY region"
+    before = _tile_count()
+    t1, t2 = _both(db, q2)
+    assert _tile_count() == before, "non-prefix last_value must not tile"
+    _assert_equal(t1, t2, ["region"])
+
+
+def test_alter_added_column_null_fills_old_files(db):
+    """Files predating an ALTER ADD COLUMN contribute NULL for that column
+    (reference read-compat semantics) instead of disabling the tile path."""
+    _mk_cpu_table(db)
+    _load(db, ticks=30)
+    db.sql("ADMIN flush_table('cpu')")
+    db.sql("ALTER TABLE cpu ADD COLUMN extra DOUBLE")
+    rows = [
+        f"('host_0', 'r0', {500_000 + t * 1000}, 1.0, 2.0, {t * 1.5})"
+        for t in range(20)
+    ]
+    db.sql("INSERT INTO cpu (host, region, ts, usage_user, usage_system, extra) VALUES "
+           + ",".join(rows))
+    db.sql("ADMIN flush_table('cpu')")
+    before = _tile_count()
+    q = "SELECT host, avg(extra) AS ae, count(extra) AS ce, count(*) AS c FROM cpu GROUP BY host"
+    t1, t2 = _both(db, q)
+    assert _tile_count() == before + 1, "post-ALTER table should still tile"
+    _assert_equal(t1, t2, ["host"])
