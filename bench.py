@@ -1,26 +1,40 @@
 """End-to-end TSBS benchmark through the FULL engine path.
 
-Unlike round 1 (a kernel micro-benchmark on pre-staged device arrays), every
-number here is measured through `Database.sql()`: SQL parse -> plan -> TPU
-lowering -> HBM tile cache (parallel/tile_cache.py) -> one compiled dispatch
--> finalized Arrow result.  Data is really ingested (the servers'
-`insert_rows` path: partition split, WAL, memtable) and really flushed to
-Parquet SSTs first; the cold run pays Parquet decode + dictionary encode +
-H2D upload, warm runs hit the HBM-resident tiles — the engine's design
-point, matching the reference's warm-page-cache TSBS runs.
+Every number is measured through `Database.sql()`: SQL parse -> plan -> TPU
+lowering -> HBM super-tile cache (parallel/tile_cache.py) -> ONE compiled
+dispatch -> ONE device->host fetch -> finalized Arrow result.  Data is
+really ingested (the servers' `insert_rows` path: partition split, WAL,
+memtable) and really flushed to Parquet SSTs first; the cold run pays
+Parquet decode + dictionary encode + H2D upload + XLA compile, warm runs
+hit the HBM-resident super-tiles — the engine's design point, matching the
+reference's warm-page-cache TSBS runs.
+
+Timeout-proof by construction (round-2 lesson: rc=124 left zero evidence):
+  * one JSON line per query is printed (and flushed) AS IT COMPLETES;
+  * partial results are continuously written to BENCH_PARTIAL.json;
+  * GRAFT_BENCH_BUDGET_S (default 3000) is a soft wall-clock budget —
+    when exceeded the bench stops starting new queries and prints the
+    final summary line with whatever finished.
 
 Workload (reference docs/benchmarks/tsbs/v0.12.0.md, BASELINE.md): scale
-4000 hosts @ 10s scrape, 10 CPU metrics.  Dataset spans GRAFT_BENCH_HOURS
-(default 24; TSBS uses 3 days) and queries touch the TSBS-defined windows.
-Reference numbers: GreptimeDB v0.12.0 on EC2 c5d.2xlarge (8 vCPU).
+4000 hosts @ 10s scrape, 10 CPU metrics, GRAFT_BENCH_HOURS of data
+(default 24; TSBS uses 3 days).  Reference numbers: GreptimeDB v0.12.0 on
+EC2 c5d.2xlarge (8 vCPU).
 
-Prints ONE JSON line; headline = double-groupby-1 warm end-to-end p50.
+Latency context printed in `detail`: this harness drives a REMOTE TPU over
+a tunnel whose round-trip is ~100 ms — measured honestly as
+`tunnel_rtt_ms` (a fresh-buffer device fetch).  Any query that touches the
+device pays >= 1 RTT end-to-end; co-located deployments pay microseconds.
+
+Prints ONE final JSON line; headline = double-groupby-1 warm end-to-end p50.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
+import sys
 import time
 
 import numpy as np
@@ -37,9 +51,10 @@ METRICS = [
     "usage_irq", "usage_softirq", "usage_steal", "usage_guest", "usage_guest_nice",
 ]
 WARM_REPS = int(os.environ.get("GRAFT_BENCH_REPS", 5))
+BUDGET_S = float(os.environ.get("GRAFT_BENCH_BUDGET_S", 3000))
+PARTIAL_PATH = os.environ.get("GRAFT_BENCH_PARTIAL", "BENCH_PARTIAL.json")
+HTTP_INGEST_ROWS = int(os.environ.get("GRAFT_BENCH_HTTP_ROWS", 400_000))
 
-# 12h query window ending at the dataset's end (TSBS picks random windows
-# inside the dataset; fixed here for determinism)
 END = T0 + HOURS * 3600_000
 W12 = (END - 12 * 3600_000, END)
 W8 = (END - 8 * 3600_000, END)
@@ -49,6 +64,16 @@ HOST1 = f"host_{703 % N_HOSTS}"
 HOSTS8 = [
     f"host_{i % N_HOSTS}" for i in (703, 1217, 2048, 99, 3777, 1500, 2901, 42)
 ]
+
+_START = time.perf_counter()
+
+
+def _elapsed() -> float:
+    return time.perf_counter() - _START
+
+
+def _emit(obj: dict):
+    print(json.dumps(obj), flush=True)
 
 
 def _q(window, metrics_n, hosts=None, bucket="1h", funcs="max"):
@@ -109,6 +134,79 @@ QUERIES = [
 ]
 
 
+def _write_partial(payload: dict):
+    try:
+        with open(PARTIAL_PATH, "w") as f:
+            json.dump(payload, f)
+    except OSError:
+        pass
+
+
+def _probe_link(jax, jnp) -> dict:
+    """Honest link probes.  `block_until_ready` does NOT reliably block on
+    the axon tunnel, so the dispatch floor is measured with a real fetch
+    of a FRESH device buffer (fetching the same buffer twice is host-cached
+    and free)."""
+    import numpy as _np
+
+    f = jax.jit(lambda x: x + 1.0)
+    f(jnp.float32(0.0))  # compile
+    rtts = []
+    for i in range(5):
+        t0 = time.perf_counter()
+        _ = jax.device_get(f(jnp.float32(float(i))))
+        rtts.append((time.perf_counter() - t0) * 1000)
+    enq = []
+    for i in range(5):
+        t0 = time.perf_counter()
+        _ = f(jnp.float32(float(i + 100)))
+        enq.append((time.perf_counter() - t0) * 1000)
+    return {
+        "tunnel_rtt_ms": round(float(_np.median(rtts)), 1),
+        "dispatch_enqueue_ms": round(float(_np.median(enq)), 2),
+    }
+
+
+def _http_ingest_probe(db) -> dict:
+    """Honest protocol-path ingest: influx line protocol POSTed over a real
+    HTTP socket (reference BASELINE ingest is measured through the TSBS
+    client/HTTP path; round 2's in-process number was apples-to-oranges)."""
+    import urllib.request
+
+    from greptimedb_tpu.servers.http import HttpServer
+
+    srv = HttpServer(db).start()
+    try:
+        url = f"http://{srv.address}/v1/influxdb/write?db=public"
+        rng = np.random.default_rng(3)
+        rows_per_host = max(HTTP_INGEST_ROWS // 500, 1)
+        total = 0
+        t_total = 0.0
+        batch_hosts = 500
+        for b in range(rows_per_host):
+            ts_ns = (T0 + HOURS * 3600_000 + b * 1000 + 1000) * 1_000_000
+            vals = rng.uniform(0, 100, batch_hosts)
+            lines = "\n".join(
+                f"cpu_http,hostname=host_{h} usage_user={vals[h]:.3f} {ts_ns + h}"
+                for h in range(batch_hosts)
+            )
+            req = urllib.request.Request(
+                url, data=lines.encode(), method="POST",
+                headers={"Content-Type": "text/plain"},
+            )
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req) as resp:
+                resp.read()
+            t_total += time.perf_counter() - t0
+            total += batch_hosts
+        return {
+            "ingest_http_rows_per_sec": round(total / max(t_total, 1e-9)),
+            "ingest_http_rows": total,
+        }
+    finally:
+        srv.stop()
+
+
 def main():
     ensure_x64()
     import tempfile
@@ -116,11 +214,22 @@ def main():
     import jax
 
     from greptimedb_tpu.database import Database
+    from greptimedb_tpu.utils import metrics as m
 
-    out_detail: dict = {"device": str(jax.devices()[0])}
+    detail: dict = {"device": str(jax.devices()[0]), "dataset_hours": HOURS}
+    results: dict = {}
+    headline = None
+
     home = tempfile.mkdtemp(prefix="graft_bench_")
     db = Database(data_home=home)
-    cols_sql = ", ".join(f"{m} DOUBLE" for m in METRICS)
+    # cost-based routing: sub-threshold scans run on the LOCAL CPU path
+    # (no tunnel round-trip) — the same local-vs-local comparison the
+    # reference's numbers are measured under
+    db.config.query.tpu_min_rows = int(os.environ.get("GRAFT_TPU_MIN_ROWS", 300_000))
+    detail["tpu_min_rows"] = db.config.query.tpu_min_rows
+    if os.environ.get("GRAFT_BENCH_NO_FALLBACK"):
+        db.config.query.fallback_to_cpu = False
+    cols_sql = ", ".join(f"{mm} DOUBLE" for mm in METRICS)
     db.sql(
         f"CREATE TABLE cpu (hostname STRING, ts TIMESTAMP(3) TIME INDEX, "
         f"{cols_sql}, PRIMARY KEY (hostname)) WITH (append_mode = 'true')"
@@ -131,8 +240,7 @@ def main():
     ticks_total = HOURS * 3600 // SCRAPE_S
     chunk_ticks = max(1, 2_000_000 // N_HOSTS)
     hosts_arr = np.array([f"host_{i}" for i in range(N_HOSTS)])
-    # ground truth for double-groupby-1 accumulated on the fly
-    gt: dict[tuple, list] = {}
+    gt: dict[int, list] = {}  # (host, hour) ground truth for double-groupby-1
     n_rows = 0
     t_ing = 0.0
     for start in range(0, ticks_total, chunk_ticks):
@@ -140,24 +248,18 @@ def main():
         ts = T0 + (start + np.arange(ticks, dtype=np.int64))[:, None] * (SCRAPE_S * 1000)
         ts = np.broadcast_to(ts, (ticks, N_HOSTS)).reshape(-1)
         hs = np.broadcast_to(hosts_arr[None, :], (ticks, N_HOSTS)).reshape(-1)
-        data = {"hostname": hs, "ts": ts}
-        vals = {}
-        for m in METRICS:
-            v = rng.uniform(0.0, 100.0, ticks * N_HOSTS)
-            vals[m] = v
-            data[m] = v
+        vals = {mm: rng.uniform(0.0, 100.0, ticks * N_HOSTS) for mm in METRICS}
         batch = pa.table(
             {
-                "hostname": pa.array(data["hostname"]),
-                "ts": pa.array(data["ts"], pa.timestamp("ms")),
-                **{m: pa.array(data[m], pa.float64()) for m in METRICS},
+                "hostname": pa.array(hs),
+                "ts": pa.array(ts, pa.timestamp("ms")),
+                **{mm: pa.array(vals[mm], pa.float64()) for mm in METRICS},
             }
         )
         t0 = time.perf_counter()
         db.insert_rows("cpu", batch)
         t_ing += time.perf_counter() - t0
         n_rows += batch.num_rows
-        # ground truth: (host, hour) -> [sum, count] within W12
         in_w = (ts >= W12[0]) & (ts < W12[1])
         if in_w.any():
             hour = ((ts[in_w] - W12[0]) // 3600_000).astype(np.int64)
@@ -174,90 +276,127 @@ def main():
     t0 = time.perf_counter()
     db.storage.flush_all()
     t_flush = time.perf_counter() - t0
-    out_detail["rows"] = n_rows
-    out_detail["ingest_rows_per_sec"] = round(n_rows / t_ing)
-    out_detail["ingest_reference_rows_per_sec"] = 326_839
-    out_detail["flush_secs"] = round(t_flush, 1)
+    detail["rows"] = n_rows
+    detail["ingest_inprocess_rows_per_sec"] = round(n_rows / t_ing)
+    detail["ingest_reference_rows_per_sec"] = 326_839
+    detail["flush_secs"] = round(t_flush, 1)
+    _emit({"event": "ingested", "rows": n_rows, "secs": round(t_ing + t_flush, 1),
+           "elapsed_s": round(_elapsed(), 1)})
+    _write_partial({"detail": detail, "queries": results})
 
-    # ---- tunnel overhead probe (context for co-located deployments) --------
+    # ---- honest protocol-path ingest probe ---------------------------------
+    if HTTP_INGEST_ROWS > 0 and _elapsed() < BUDGET_S:
+        try:
+            detail.update(_http_ingest_probe(db))
+            _emit({"event": "http_ingest",
+                   "rows_per_sec": detail.get("ingest_http_rows_per_sec"),
+                   "elapsed_s": round(_elapsed(), 1)})
+        except Exception as e:  # noqa: BLE001 — probe must never kill the bench
+            detail["ingest_http_error"] = repr(e)
+
+    # ---- link probes -------------------------------------------------------
     import jax.numpy as jnp
 
-    probe = jax.jit(lambda x: x + 1)
-    probe(jnp.float32(1.0)).block_until_ready()
-    rtts = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        probe(jnp.float32(1.0)).block_until_ready()
-        rtts.append((time.perf_counter() - t0) * 1000)
-    dispatch_floor_ms = float(np.median(rtts))
-    out_detail["dispatch_floor_ms"] = round(dispatch_floor_ms, 2)
+    detail.update(_probe_link(jax, jnp))
+    _emit({"event": "link_probe", **{k: detail[k] for k in
+           ("tunnel_rtt_ms", "dispatch_enqueue_ms")}})
 
     # ---- queries -----------------------------------------------------------
-    results = {}
-    headline = None
     only = os.environ.get("GRAFT_BENCH_ONLY")
-    queries = [
-        q for q in QUERIES if only is None or q[0] in only.split(",")
-    ]
+    queries = [q for q in QUERIES if only is None or q[0] in only.split(",")]
+    budget_hit = False
     for name, sql, ref_ms in queries:
-        t0 = time.perf_counter()
-        table = db.sql_one(sql)
-        cold_ms = (time.perf_counter() - t0) * 1000
-        walls = []
-        for _ in range(WARM_REPS):
+        if _elapsed() > BUDGET_S:
+            budget_hit = True
+            _emit({"event": "budget_exhausted", "skipped_from": name,
+                   "elapsed_s": round(_elapsed(), 1)})
+            break
+        try:
+            rb0 = (m.TILE_READBACK_MS.sum(), m.TILE_READBACK_MS.total())
             t0 = time.perf_counter()
             table = db.sql_one(sql)
-            walls.append((time.perf_counter() - t0) * 1000)
-        warm_ms = float(np.median(walls))
-        entry = {
-            "warm_ms": round(warm_ms, 2),
-            "cold_ms": round(cold_ms, 1),
-            "reference_ms": ref_ms,
-            "vs_baseline": round(ref_ms / warm_ms, 2),
-            "rows_out": table.num_rows,
-        }
-        results[name] = entry
-        if name == "double-groupby-1":
-            headline = entry
-            # verify vs the independently accumulated ground truth
-            got = {}
-            hv = table["hostname"].to_pylist()
-            tv = table["tb"].to_pylist()
-            av = table[table.column_names[2]].to_pylist()
-            host_to_idx = {f"host_{i}": i for i in range(N_HOSTS)}
-            for h, t, a in zip(hv, tv, av):
-                ms = int(t.timestamp() * 1000) if hasattr(t, "timestamp") else int(t)
-                hour = (ms - W12[0]) // 3600_000
-                got[host_to_idx[h] * 100 + hour] = a
-            assert len(got) == len(gt), (len(got), len(gt))
-            for k, (s, c) in gt.items():
-                assert abs(got[k] - s / c) < 1e-6 * max(1.0, abs(s / c)), (
-                    k, got[k], s / c,
-                )
-            entry["verified"] = "matches independent numpy ground truth"
-
-    tile_stats = db.query_engine.tile_cache.stats() if db.query_engine.tile_cache else {}
-    out_detail["hbm_tile_cache"] = tile_stats
-    out_detail["queries"] = results
-    out_detail["method"] = (
-        "end-to-end Database.sql() wall time over real flushed Parquet SSTs: "
-        "parse+plan+lowering+dispatch+finalize. Warm = HBM tile cache hit "
-        f"(p50 of {WARM_REPS}); cold includes Parquet decode + encode + "
-        "upload + XLA compile. dispatch_floor_ms is this harness's measured "
-        "per-dispatch host->device round-trip (tunnel); co-located "
-        "deployments pay microseconds."
-    )
-    out_detail["dataset_hours"] = HOURS
-    print(
-        json.dumps(
-            {
-                "metric": "tsbs_double_groupby_1_e2e_warm_p50",
-                "value": headline["warm_ms"],
-                "unit": "ms",
-                "vs_baseline": headline["vs_baseline"],
-                "detail": out_detail,
+            cold_ms = (time.perf_counter() - t0) * 1000
+            walls = []
+            for _ in range(WARM_REPS):
+                t0 = time.perf_counter()
+                table = db.sql_one(sql)
+                walls.append((time.perf_counter() - t0) * 1000)
+            warm_ms = float(np.median(walls))
+            rb1 = (m.TILE_READBACK_MS.sum(), m.TILE_READBACK_MS.total())
+            n_rb = rb1[1] - rb0[1]
+            entry = {
+                "warm_ms": round(warm_ms, 2),
+                "cold_ms": round(cold_ms, 1),
+                "reference_ms": ref_ms,
+                "vs_baseline": round(ref_ms / warm_ms, 2),
+                "rows_out": table.num_rows,
             }
+            if n_rb:
+                entry["readback_ms_avg"] = round((rb1[0] - rb0[0]) / n_rb, 1)
+        except Exception as e:  # noqa: BLE001 — one bad query must not kill the run
+            entry = {"error": repr(e), "reference_ms": ref_ms}
+        results[name] = entry
+        _emit({"query": name, **entry, "elapsed_s": round(_elapsed(), 1)})
+        _write_partial({"detail": detail, "queries": results})
+
+        if name == "double-groupby-1" and "error" not in entry:
+            headline = entry
+            try:
+                got = {}
+                hv = table["hostname"].to_pylist()
+                tv = table["tb"].to_pylist()
+                av = table[table.column_names[2]].to_pylist()
+                host_to_idx = {f"host_{i}": i for i in range(N_HOSTS)}
+                for h, t, a in zip(hv, tv, av):
+                    ms = int(t.timestamp() * 1000) if hasattr(t, "timestamp") else int(t)
+                    hour = (ms - W12[0]) // 3600_000
+                    got[host_to_idx[h] * 100 + hour] = a
+                assert len(got) == len(gt), (len(got), len(gt))
+                for k, (s, c) in gt.items():
+                    assert abs(got[k] - s / c) < 1e-6 * max(1.0, abs(s / c)), (
+                        k, got[k], s / c,
+                    )
+                entry["verified"] = "matches independent numpy ground truth"
+            except Exception as e:  # noqa: BLE001 — keep the evidence, flag loudly
+                entry["verify_error"] = repr(e)
+                _emit({"event": "verify_failed", "query": name, "error": repr(e)})
+
+    # ---- summary -----------------------------------------------------------
+    ok = {k: v for k, v in results.items() if "vs_baseline" in v}
+    if ok:
+        detail["geomean_vs_baseline_all"] = round(
+            math.exp(sum(math.log(v["vs_baseline"]) for v in ok.values()) / len(ok)), 2
         )
+        heavy = [k for k in ok if ok[k]["reference_ms"] >= 500]
+        if heavy:
+            detail["geomean_vs_baseline_heavy"] = round(
+                math.exp(sum(math.log(ok[k]["vs_baseline"]) for k in heavy) / len(heavy)), 2
+            )
+    detail["hbm_tile_cache"] = (
+        db.query_engine.tile_cache.stats() if db.query_engine.tile_cache else {}
+    )
+    detail["queries"] = results
+    detail["budget_exhausted"] = budget_hit
+    detail["method"] = (
+        "end-to-end Database.sql() wall time over real flushed Parquet SSTs: "
+        "parse+plan+lowering+ONE dispatch+ONE device fetch+finalize. Warm = "
+        f"HBM super-tile hit (p50 of {WARM_REPS}); cold includes Parquet "
+        "decode + encode + upload + XLA compile. tunnel_rtt_ms is the "
+        "measured per-fetch round-trip of this harness's remote-TPU link — "
+        "the floor for ANY device query here; co-located deployments pay "
+        "microseconds. ingest_http_rows_per_sec is influx line protocol "
+        "over a real HTTP socket."
+    )
+    if headline is None:
+        headline = {"warm_ms": None, "vs_baseline": None}
+    _emit(
+        {
+            "metric": "tsbs_double_groupby_1_e2e_warm_p50",
+            "value": headline.get("warm_ms"),
+            "unit": "ms",
+            "vs_baseline": headline.get("vs_baseline"),
+            "detail": detail,
+        }
     )
     db.close()
 

@@ -114,6 +114,7 @@ def segment_aggregate(
     mask: jnp.ndarray | None = None,
     ts: jnp.ndarray | None = None,
     acc_dtype=jnp.float32,
+    span: int = BLOCK_SPAN,
 ) -> AggState:
     """Per-shard partial aggregation (the lower/state stage).
 
@@ -158,7 +159,7 @@ def segment_aggregate(
     sentinel = jnp.int32(2**31 - 1)
     bmin = jnp.min(jnp.where(mb, gb, sentinel), axis=1)  # empty block -> sentinel
     bmax = jnp.max(jnp.where(mb, gb, -1), axis=1)  # empty block -> -1
-    span_ok = jnp.all(bmax - bmin < BLOCK_SPAN)  # empty: -1 - sentinel < K
+    span_ok = jnp.all(bmax - bmin < span)  # empty: -1 - sentinel < span
     ok_block = in_range_ok & span_ok
 
     if LAST in aggs:
@@ -167,7 +168,9 @@ def segment_aggregate(
 
         def fast_last(args):
             v, g, m, t = args
-            return _segment_blocked_last(v, g, num_groups, aggs, m, t, acc_dtype, bmin)
+            return _segment_blocked_last(
+                v, g, num_groups, aggs, m, t, acc_dtype, bmin, span
+            )
 
         def slow_last(args):
             v, g, m, t = args
@@ -177,7 +180,7 @@ def segment_aggregate(
 
     def fast(args):
         v, g, m = args
-        return _segment_blocked(v, g, num_groups, aggs, m, acc_dtype, bmin)
+        return _segment_blocked(v, g, num_groups, aggs, m, acc_dtype, bmin, span)
 
     def slow(args):
         v, g, m = args
@@ -217,24 +220,31 @@ def _segment_scatter(
         tsmin = jnp.iinfo(jnp.int64).min
         t = jnp.where(mask, ts, tsmin)
         state.last_ts = jax.ops.segment_max(t, safe, num_segments=segs)[:num_groups]
-        # Second pass: among rows whose ts equals the group max, take the max
-        # value (ties broken by value, deterministic).
+        # Second pass: among rows at the group's max ts, the LAST one in
+        # layout order wins — the (pk, ts, write-order) sort makes this
+        # exactly last-write-wins, matching the CPU path on ts ties.
+        n = values.shape[0]
         is_last = mask & (ts == state.last_ts[jnp.clip(safe, 0, num_groups - 1)])
-        small = jnp.asarray(jnp.finfo(acc_dtype).min, acc_dtype)
-        state.last_val = jax.ops.segment_max(
-            jnp.where(is_last, v, small), safe, num_segments=segs
+        ridx = jnp.arange(n, dtype=jnp.int32)
+        pick = jax.ops.segment_max(
+            jnp.where(is_last, ridx, -1), safe, num_segments=segs
         )[:num_groups]
+        state.last_val = v[jnp.clip(pick, 0, n - 1)]
     return state
 
 
-def _segment_blocked(values, gids, num_groups, aggs, mask, acc_dtype, bmin) -> AggState:
+def _segment_blocked(
+    values, gids, num_groups, aggs, mask, acc_dtype, bmin, span=BLOCK_SPAN
+) -> AggState:
     """Blocked kernel: dense per-block accumulators, scatter only the
-    [blocks, BLOCK_SPAN] partials (BLOCK_ROWS/BLOCK_SPAN fewer scatters).
+    [blocks, span] partials (BLOCK_ROWS/span fewer scatters).
     `bmin` = per-block min of MASKED gids (sentinel for all-masked blocks),
-    so clustering — not global sortedness — is the only layout demand."""
+    so clustering — not global sortedness — is the only layout demand.
+    `span` is sized by the planner from expected groups-per-block (compute
+    cost scales with it, so it stays as small as the layout allows)."""
     n = values.shape[0]
     nb = n // BLOCK_ROWS
-    L, K = BLOCK_ROWS, BLOCK_SPAN
+    L, K = BLOCK_ROWS, span
     segs = num_groups + 1
 
     g = gids[: nb * L].reshape(nb, L)
@@ -305,6 +315,7 @@ def segment_aggregate_multi(
     masks: jnp.ndarray,  # [C, n] per-column row masks (base & non-null)
     base_mask: jnp.ndarray,  # [n] the filter mask before null-gating
     acc_dtype=jnp.float32,
+    span: int = BLOCK_SPAN,
 ) -> AggState:
     """Multi-column variant of `segment_aggregate`: C value columns share
     ONE layout guard and ONE compiled branch pair (blocked / scatter,
@@ -334,14 +345,14 @@ def segment_aggregate_multi(
     sentinel = jnp.int32(2**31 - 1)
     bmin = jnp.min(jnp.where(mb, gb, sentinel), axis=1)
     bmax = jnp.max(jnp.where(mb, gb, -1), axis=1)
-    span_ok = jnp.all(bmax - bmin < BLOCK_SPAN)
+    span_ok = jnp.all(bmax - bmin < span)
     ok_block = in_range_ok & span_ok
 
     def fast(args):
         v, m = args
         return jax.vmap(
             lambda vv, mm: _segment_blocked(
-                vv, g32, num_groups, aggs, mm, acc_dtype, bmin
+                vv, g32, num_groups, aggs, mm, acc_dtype, bmin, span
             )
         )(v, m)
 
@@ -357,24 +368,24 @@ def segment_aggregate_multi(
 
 
 def _segment_blocked_last(
-    values, gids, num_groups, aggs, mask, ts, acc_dtype, bmin
+    values, gids, num_groups, aggs, mask, ts, acc_dtype, bmin, span=BLOCK_SPAN
 ) -> AggState:
     """Blocked lowering of last_value(value ORDER BY ts): same dense
-    per-block [SPAN] accumulator trick as `_segment_blocked`, two passes —
-    (1) blocked max of ts -> last_ts[G]; (2) rows whose ts equals their
-    group's last_ts contribute a blocked max of value (ties broken by max
-    value, matching `_segment_scatter`'s LAST semantics).  Removes the
-    scatter bottleneck from full-table lastpoint queries (reference TSBS
-    `lastpoint`): scatter at 2^24 rows measured ~1.8 s on v5e vs
-    milliseconds blocked."""
+    per-block [span] accumulator trick as `_segment_blocked`, two passes —
+    (1) blocked max of ts -> last_ts[G]; (2) among rows at their group's
+    last_ts, the highest ROW INDEX wins (layout is (pk, ts, write-order)
+    sorted, so this is exactly last-write-wins, matching the CPU path on
+    ts ties), and ONE [G]-sized gather fetches the winning values.  All
+    per-row work is block-local — no n-sized gather/scatter — so
+    full-table lastpoint stays bandwidth-bound (scatter at 2^24 rows
+    measured ~1.8 s on v5e vs milliseconds blocked)."""
     n = values.shape[0]
     nb = n // BLOCK_ROWS
-    L, K = BLOCK_ROWS, BLOCK_SPAN
+    L, K = BLOCK_ROWS, span
     segs = num_groups + 1
 
     g = gids[: nb * L].reshape(nb, L)
     m = mask[: nb * L].reshape(nb, L)
-    v = values[: nb * L].reshape(nb, L).astype(acc_dtype)
     t = ts[: nb * L].reshape(nb, L)
     base = jnp.minimum(bmin, jnp.int32(num_groups))[:, None]
     local = g - base
@@ -388,7 +399,7 @@ def _segment_blocked_last(
     tail_t = ts[nb * L :]
 
     tsmin = jnp.iinfo(jnp.int64).min
-    # pass 1: last_ts per group
+    # pass 1: last_ts per group via block partials
     pt = jnp.max(jnp.where(sel, t[:, :, None], tsmin), axis=1)  # [nb, K]
     lt = jax.ops.segment_max(pt.reshape(-1), out_idx, num_segments=segs)
     lt = jnp.maximum(
@@ -398,29 +409,31 @@ def _segment_blocked_last(
         ),
     )
     last_ts = lt[:num_groups]
-    # pass 2: among rows at their group's last_ts, max value
-    small = jnp.asarray(jnp.finfo(acc_dtype).min, acc_dtype)
-    safe_g = jnp.clip(gids, 0, num_groups - 1)
-    is_last = mask & (ts == last_ts[safe_g])
-    il = is_last[: nb * L].reshape(nb, L)
-    pv = jnp.max(
-        jnp.where(sel & il[:, :, None], v[:, :, None], small), axis=1
-    )
-    lv = jax.ops.segment_max(pv.reshape(-1), out_idx, num_segments=segs)
+    # pass 2: highest row index among block rows at the block-slot max ts,
+    # gated by whether that slot's ts IS the global max ([nb, K] gather)
+    ridx = jnp.arange(nb * L, dtype=jnp.int32).reshape(nb, L)
+    slot_is_global = pt == lt[jnp.minimum(base + ks[None, :], segs - 1)]  # [nb, K]
+    row_at_slot_max = sel & (t[:, :, None] == pt[:, None, :])  # [nb, L, K]
+    pidx = jnp.max(
+        jnp.where(row_at_slot_max, ridx[:, :, None], -1), axis=1
+    )  # [nb, K]
+    pidx = jnp.where(slot_is_global, pidx, -1)
+    pick = jax.ops.segment_max(pidx.reshape(-1), out_idx, num_segments=segs)
     tail_is_last = tail_m & (tail_t == last_ts[jnp.clip(tail_g, 0, num_groups - 1)])
-    lv = jnp.maximum(
-        lv,
+    tail_idx = nb * L + jnp.arange(tail_v.shape[0], dtype=jnp.int32)
+    pick = jnp.maximum(
+        pick,
         jax.ops.segment_max(
-            jnp.where(tail_is_last, tail_v.astype(acc_dtype), small),
-            tail_g,
-            num_segments=segs,
+            jnp.where(tail_is_last, tail_idx, -1), tail_g, num_segments=segs
         ),
     )
-    state = AggState(last_ts=last_ts, last_val=lv[:num_groups])
+    pick = pick[:num_groups]
+    lv = values.astype(acc_dtype)[jnp.clip(pick, 0, n - 1)]
+    state = AggState(last_ts=last_ts, last_val=lv)
     if COUNT in aggs or SUM in aggs or "avg" in aggs or MIN in aggs or MAX in aggs:
         extra = _segment_blocked(
             values, gids, num_groups,
-            tuple(a for a in aggs if a != LAST), mask, acc_dtype, bmin,
+            tuple(a for a in aggs if a != LAST), mask, acc_dtype, bmin, span,
         )
         state.sums, state.counts = extra.sums, extra.counts
         state.mins, state.maxs = extra.mins, extra.maxs
@@ -495,12 +508,12 @@ def merge_states(a: AggState, b: AggState) -> AggState:
     if a.maxs is not None:
         out.maxs = jnp.maximum(a.maxs, b.maxs)
     if a.last_ts is not None:
-        newer = b.last_ts > a.last_ts
-        tie = b.last_ts == a.last_ts
+        # ties go to b: callers merge sources in write order (SSTs before
+        # memtable tails), so the later write wins — same rule the CPU
+        # path's (pk, ts, seq) sort implements
+        newer_or_tie = b.last_ts >= a.last_ts
         out.last_ts = jnp.maximum(a.last_ts, b.last_ts)
-        out.last_val = jnp.where(
-            newer, b.last_val, jnp.where(tie, jnp.maximum(a.last_val, b.last_val), a.last_val)
-        )
+        out.last_val = jnp.where(newer_or_tie, b.last_val, a.last_val)
     return out
 
 

@@ -88,6 +88,11 @@ class DistGroupByPlan:
     # non-decreasing for ANY bucket interval (bucket-only group-bys like
     # TSBS single-groupby / groupby-orderby-limit).
     time_major: bool = False
+    # Blocked-kernel span (ops/aggregate.py): sized by the planner from
+    # expected groups-per-block so layouts with more than 16 consecutive
+    # groups per 4096-row block (e.g. hour buckets over long windows)
+    # still take the scatter-free kernel.
+    block_span: int = 16
 
     @property
     def num_groups(self) -> int:
@@ -239,7 +244,7 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
             col_mask = mask & nulls[col] if col in nulls else mask
             states[col] = fold(segment_aggregate(
                 columns[col], gids, n_internal, key,
-                mask=col_mask, ts=ts, acc_dtype=acc,
+                mask=col_mask, ts=ts, acc_dtype=acc, span=plan.block_span,
             ))
         else:
             groups.setdefault(key, []).append(col)
@@ -260,7 +265,8 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
             ]
         )
         multi = segment_aggregate_multi(
-            vals, gids, n_internal, key, col_masks, mask, acc_dtype=acc
+            vals, gids, n_internal, key, col_masks, mask, acc_dtype=acc,
+            span=plan.block_span,
         )
         for i, c in enumerate(cols):
             states[c] = fold(AggState(

@@ -5,10 +5,10 @@ re-reading Parquet, re-encoding tags and re-uploading columns on every query
 (the round-1 hot path), each region's flushed SSTs are encoded ONCE — tag
 strings to stable per-table dictionary codes (storage/dictionary.py),
 timestamps to int64, values to float — and consolidated into ONE device
-buffer per column (the "super-tile"), with each file's rows padded to a
-BLOCK_ROWS-aligned segment so the blocked aggregation kernel
-(ops/aggregate.py `_segment_blocked`) never sees a row block straddling two
-differently-sorted files.  A query then:
+buffer per column (the "super-tile"), globally re-sorted by (pk..., ts) so
+primary-key runs stay long and the blocked aggregation kernel
+(ops/aggregate.py `_segment_blocked`) sees the layout it wants regardless
+of how many time-sliced flushes produced the data.  A query then:
 
   1. snapshots each region's (files, memtables) under the region lock,
   2. fetches/extends the region's super-tile (host-side per-file encodes
@@ -81,9 +81,6 @@ from .executor import (
     compute_partial_states,
 )
 
-# Per-file segment alignment inside a super-tile: every BLOCK_ROWS row
-# block of the blocked kernel stays inside one (pk, ts)-sorted file.
-TILE_ALIGN = BLOCK_ROWS
 
 
 @dataclass
@@ -117,32 +114,43 @@ class _FileHostTiles:
 
 @dataclass
 class _SuperTiles:
-    """One region's consolidated device tiles."""
+    """One region's consolidated device tiles.
+
+    Rows are GLOBALLY re-sorted by (pk..., ts) at consolidation (`order`):
+    concatenating time-sliced flushes keeps each primary-key run short
+    (rows-per-key-per-file), which explodes the blocked kernel's per-block
+    group span and silently demoted round-3's first super-tiles to the
+    scatter path.  The tile path never needs file boundaries (its
+    eligibility gate already guarantees dedup is a no-op), so the cache
+    owns the layout and picks the one the kernels want — long pk runs.
+    The reference gets the same effect from compaction's sorted-run merge
+    (mito2/src/compaction/run.rs); here one host-side lexsort per
+    (region, file-set) replaces it."""
 
     region_id: int
     file_ids: tuple[str, ...]
-    offsets: tuple[int, ...]  # row offset of each file segment
     num_rows: int  # real rows (sum of file rows)
     pad: int  # padded (pow2) total length
+    order: np.ndarray | None = None  # (pk, ts) sort of the file concat
     cols: dict[str, jnp.ndarray] = field(default_factory=dict)
     nulls: dict[str, jnp.ndarray] = field(default_factory=dict)
     epochs: dict[str, int] = field(default_factory=dict)
     valid: jnp.ndarray | None = None
     perm: jnp.ndarray | None = None  # ts-ascending gather (time-major plans)
+    # host-side sorted copies of (pk codes..., ts) + file row offsets:
+    # selective pk-equality queries binary-search these and aggregate the
+    # tiny slice on the host, skipping the device link entirely (the role
+    # of the reference's inverted index + page pruning point lookups)
+    sorted_host: dict[str, np.ndarray] = field(default_factory=dict)
+    host_epochs: dict[str, int] = field(default_factory=dict)
+    file_row_offsets: np.ndarray | None = None
+    # ts-ascending (time-major) device copies, built once per column so
+    # bucket-only queries dispatch with zero per-query gathers
+    tm_cols: dict[str, jnp.ndarray] = field(default_factory=dict)
+    tm_nulls: dict[str, jnp.ndarray] = field(default_factory=dict)
+    tm_valid: jnp.ndarray | None = None
     nbytes: int = 0
-
-
-def _segment_layout(metas: list[FileMeta]) -> tuple[tuple[int, ...], int, int]:
-    """(offsets, total_rows, padded_total) with per-file TILE_ALIGN padding."""
-    offsets = []
-    off = 0
-    total = 0
-    for m in metas:
-        offsets.append(off)
-        total += m.num_rows
-        seg = max(-(-m.num_rows // TILE_ALIGN) * TILE_ALIGN, TILE_ALIGN)
-        off += seg
-    return tuple(offsets), total, padded_size(off)
+    host_nbytes: int = 0  # sorted_host/order/offsets bytes (host budget)
 
 
 class TileCacheManager:
@@ -164,6 +172,13 @@ class TileCacheManager:
         self._bad_files: set[tuple[int, str]] = set()
 
     # ---- bookkeeping -------------------------------------------------------
+    def has_region(self, region_id: int) -> bool:
+        """True when a consolidated super-tile is resident for the region
+        (the cost model skips CPU routing then — the tile path's host fast
+        branch serves selective queries in milliseconds)."""
+        with self._lock:
+            return region_id in self._super
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -192,7 +207,9 @@ class TileCacheManager:
                 keep_file_ids is None
                 or not set(entry.file_ids) <= keep_file_ids
             ):
-                self._used -= self._super.pop(region_id).nbytes
+                dropped = self._super.pop(region_id)
+                self._used -= dropped.nbytes
+                self._host_used -= dropped.host_nbytes
             self._region_versions.pop(region_id, None)
 
     def invalidate_region_if_changed(
@@ -211,7 +228,9 @@ class TileCacheManager:
         while self._used > self.budget and len(self._super) > len(pinned_regions):
             for rid in list(self._super):
                 if rid not in pinned_regions:
-                    self._used -= self._super.pop(rid).nbytes
+                    dropped = self._super.pop(rid)
+                    self._used -= dropped.nbytes
+                    self._host_used -= dropped.host_nbytes
                     metrics.TILE_CACHE_EVICTIONS.inc()
                     break
             else:
@@ -300,13 +319,20 @@ class TileCacheManager:
         ts_col: str | None,
         value_cols: list[str],
         pinned_regions: set[int],
+        pk_cols: list[str],
     ) -> tuple[_SuperTiles | None, list[FileMeta]]:
         """Cached (or freshly consolidated) device tiles for one region's
         SST set.  Returns (entry, excluded): `excluded` lists files that
         cannot join the super-tile (missing tag/ts column, row-count
         mismatch) — the caller must fall back when any of them intersects
-        the query window.  entry is None when no file is includable."""
+        the query window.  entry is None when no file is includable.
+
+        `pk_cols` + `ts_col` define the global sort order: they are always
+        host-encoded (cheap, host-RAM only) so the (pk, ts) `order` can be
+        computed at entry creation and reused for columns added later."""
         need = list(dict.fromkeys(tag_cols + ([ts_col] if ts_col else []) + value_cols))
+        sort_cols = list(dict.fromkeys(pk_cols + ([ts_col] if ts_col else [])))
+        host_need = list(dict.fromkeys(sort_cols + need))
         rid = region.region_id
 
         for _attempt in range(len(metas) + 1):
@@ -322,15 +348,17 @@ class TileCacheManager:
                 entry = self._super.get(rid)
                 if entry is not None:
                     if entry.file_ids != ids:
-                        self._used -= self._super.pop(rid).nbytes
+                        dropped = self._super.pop(rid)
+                        self._used -= dropped.nbytes
+                        self._host_used -= dropped.host_nbytes
                         entry = None
                     else:
                         self._super.move_to_end(rid)
             if entry is None:
-                offsets, total, pad = _segment_layout(included)
+                total = sum(m.num_rows for m in included)
                 entry = _SuperTiles(
-                    region_id=rid, file_ids=ids, offsets=offsets,
-                    num_rows=total, pad=pad,
+                    region_id=rid, file_ids=ids,
+                    num_rows=total, pad=padded_size(max(total, 1)),
                 )
             missing = [c for c in need if c not in entry.cols]
             if not missing and entry.valid is not None:
@@ -343,7 +371,7 @@ class TileCacheManager:
             host_tiles: list[_FileHostTiles] = []
             for meta in included:
                 ht = self._file_host_tiles(
-                    region, dictionary, meta, missing, tag_cols, ts_col
+                    region, dictionary, meta, host_need, tag_cols + pk_cols, ts_col
                 )
                 if ht is None:
                     break  # newly-discovered bad file: retry without it
@@ -354,11 +382,39 @@ class TileCacheManager:
                 for ht in host_tiles:
                     self._repair_host_locked(ht, dictionary)
 
+            if entry.order is None:
+                # global (pk, ts) sort of the concatenation — lexsort keys
+                # are listed minor-to-major.  Code repair is a permutation
+                # of code VALUES that preserves relative order (the
+                # dictionary is value-sorted), so `order` stays valid
+                # across dictionary growth.
+                cats = {
+                    name: np.concatenate([ht.cols[name] for ht in host_tiles])
+                    for name in sort_cols
+                }
+                if cats:
+                    entry.order = np.lexsort(
+                        [cats[name] for name in reversed(sort_cols)]
+                    ).astype(np.int32)
+                else:
+                    entry.order = np.arange(entry.num_rows, dtype=np.int32)
+                for name in sort_cols:
+                    entry.sorted_host[name] = cats[name][entry.order]
+                    if name != ts_col:
+                        entry.host_epochs[name] = dictionary.epoch
+                entry.file_row_offsets = np.concatenate(
+                    [[0], np.cumsum([ht.num_rows for ht in host_tiles])]
+                ).astype(np.int64)
+                hb = sum(a.nbytes for a in entry.sorted_host.values())
+                hb += entry.order.nbytes + entry.file_row_offsets.nbytes
+                entry.host_nbytes += hb
+                with self._lock:
+                    self._host_used += hb
+
             added = 0
             if entry.valid is None:
                 v = np.zeros(entry.pad, bool)
-                for off, ht in zip(entry.offsets, host_tiles):
-                    v[off : off + ht.num_rows] = True
+                v[: entry.num_rows] = True
                 entry.valid = jnp.asarray(v)
                 added += v.nbytes
             for name in missing:
@@ -366,32 +422,44 @@ class TileCacheManager:
                     (ht.cols[name] for ht in host_tiles if name in ht.cols), None
                 )
                 dtype = src.dtype if src is not None else np.float64
-                buf = np.zeros(entry.pad, dtype=dtype)
+                cat = np.concatenate(
+                    [
+                        ht.cols[name]
+                        if name in ht.cols
+                        else np.zeros(ht.num_rows, dtype)
+                        for ht in host_tiles
+                    ]
+                )
+                buf = np.zeros(entry.pad, dtype=cat.dtype)
+                buf[: entry.num_rows] = cat[entry.order]
                 any_nulls = any(
                     name in ht.nulls or name in ht.absent for ht in host_tiles
                 )
-                nbuf = np.zeros(entry.pad, bool) if any_nulls else None
-                for off, ht in zip(entry.offsets, host_tiles):
-                    if name in ht.absent:
-                        continue  # pre-ALTER file: NULL-filled (nbuf False)
-                    buf[off : off + ht.num_rows] = ht.cols[name]
-                    if nbuf is not None:
-                        if name in ht.nulls:
-                            nbuf[off : off + ht.num_rows] = ht.nulls[name]
-                        else:
-                            nbuf[off : off + ht.num_rows] = True
+                nbuf = None
+                if any_nulls:
+                    ncat = np.concatenate(
+                        [
+                            ht.nulls[name]
+                            if name in ht.nulls
+                            else np.full(ht.num_rows, name not in ht.absent)
+                            for ht in host_tiles
+                        ]
+                    )
+                    nbuf = np.zeros(entry.pad, bool)
+                    nbuf[: entry.num_rows] = ncat[entry.order]
                 entry.cols[name] = jnp.asarray(buf)
                 added += buf.nbytes
                 if nbuf is not None:
                     entry.nulls[name] = jnp.asarray(nbuf)
                     added += nbuf.nbytes
-                if name in tag_cols:
+                if name in tag_cols or name in pk_cols:
                     entry.epochs[name] = dictionary.epoch
             entry.nbytes += added
             with self._lock:
                 old = self._super.pop(rid, None)
                 if old is not None and old is not entry:
                     self._used -= old.nbytes
+                    self._host_used -= old.host_nbytes
                 self._super[rid] = entry
                 self._used += added
                 self._evict_locked(pinned_regions | {rid})
@@ -422,6 +490,84 @@ class TileCacheManager:
                             fill_value=-1,
                         ).astype(jnp.int32)
                     entry.epochs[tag] = dictionary.epoch
+                    entry.tm_cols.pop(tag, None)
+                for tag, epoch in list(entry.host_epochs.items()):
+                    perm = dictionary.perm_since(tag, epoch)
+                    if perm is not None:
+                        codes = entry.sorted_host[tag]
+                        ok = (codes >= 0) & (codes < len(perm))
+                        entry.sorted_host[tag] = np.where(
+                            ok, perm[np.clip(codes, 0, len(perm) - 1)], -1
+                        ).astype(codes.dtype)
+                    entry.host_epochs[tag] = dictionary.epoch
+
+    def ensure_time_major(
+        self, entry: _SuperTiles, ts_name: str, cols_needed: set[str]
+    ):
+        """Materialize ts-ascending device copies of the needed columns
+        (one gather each, once per (region, file-set, column)) so
+        time-major dispatches are gather-free.  Returns (cols, valid,
+        nulls) views limited to `cols_needed`."""
+        perm = self.ensure_perm(entry, ts_name)
+        added = 0
+        with self._lock:
+            if entry.tm_valid is None:
+                entry.tm_valid = entry.valid[perm]
+                added += entry.pad
+            for c in cols_needed:
+                if c in entry.cols and c not in entry.tm_cols:
+                    entry.tm_cols[c] = entry.cols[c][perm]
+                    added += int(entry.cols[c].nbytes)
+                if c in entry.nulls and c not in entry.tm_nulls:
+                    entry.tm_nulls[c] = entry.nulls[c][perm]
+                    added += entry.pad
+            if added:
+                entry.nbytes += added
+                if self._super.get(entry.region_id) is entry:
+                    self._used += added
+        return (
+            {c: entry.tm_cols[c] for c in cols_needed if c in entry.tm_cols},
+            entry.tm_valid,
+            {c: entry.tm_nulls[c] for c in cols_needed if c in entry.tm_nulls},
+        )
+
+    def gather_host_values(
+        self, entry: _SuperTiles, col: str, positions: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray | None] | None:
+        """Host-side value gather for the selective fast path: `positions`
+        are concat-order rows (= entry.order[a:b]); values come straight
+        from the per-file host encode cache.  Returns (values, present) or
+        None when a needed host tile was evicted (caller falls back to the
+        device path)."""
+        offs = entry.file_row_offsets
+        with self._lock:
+            tiles = [
+                self._host.get((entry.region_id, fid)) for fid in entry.file_ids
+            ]
+        if any(t is None for t in tiles):
+            return None
+        fidx = np.searchsorted(offs, positions, side="right") - 1
+        rows = positions - offs[fidx]
+        dtype = next(
+            (t.cols[col].dtype for t in tiles if col in t.cols), np.float64
+        )
+        out = np.zeros(len(positions), dtype=dtype)
+        present: np.ndarray | None = None
+        for i, t in enumerate(tiles):
+            m = fidx == i
+            if not m.any():
+                continue
+            if col in t.absent or col not in t.cols:
+                if present is None:
+                    present = np.ones(len(positions), bool)
+                present[m] = False
+                continue
+            out[m] = t.cols[col][rows[m]]
+            if col in t.nulls:
+                if present is None:
+                    present = np.ones(len(positions), bool)
+                present[m] = t.nulls[col][rows[m]]
+        return out, present
 
     def ensure_perm(self, entry: _SuperTiles, ts_name: str):
         """Lazily build the ts-ascending permutation for time-major plans
@@ -506,12 +652,13 @@ def _value_to_numpy(col) -> np.ndarray | None:
 def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
     """jit program over ALL of a query's sources: per-source partial
     states (blocked/scatter kernels), merged pairwise, FINALIZED on
-    device, and packed into ONE [K, G] float64 buffer holding ONLY the
-    rows this query's output consumes — one dispatch in, one device->host
-    transfer out.  On a remote-device harness every separate fetch pays
-    the full host round-trip, so everything rides one buffer (counts are
-    exact in float64 below 2^53), and bytes scale with requested outputs,
-    not with every state the kernels track.
+    device, and packed into TWO result buffers — int32 [Ki, G] for
+    presence/count rows, float64 [Kf, G] for value rows — holding ONLY
+    the rows this query's output consumes.  One dispatch in, one
+    device_get of the pair out (multiple buffers batch into one
+    round-trip on the remote-device link; measured ~100 ms RTT +
+    ~15 MB/s, so result BYTES dominate past the first megabyte — int32
+    counts halve their cost vs f64 and are exact below 2^31).
 
     Source count is small by construction (one super-tile per region plus
     memtable tails), so the traced unroll stays bounded; jax re-traces
@@ -520,20 +667,22 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
     kernel pair compiles in ~3 s at any size (the superlinear
     associative-scan branch was removed — see ops/aggregate.py).
 
-    Count rows ship only for (a) explicit count() outputs and (b) NULLABLE
-    aggregated columns (NULL-group gating); non-nullable columns gate on
-    the single presence row.  Returns (fn, layout)."""
+    Count rows ship only for (a) explicit count() outputs and (b) columns
+    whose sources actually carry a null mask this query (NULL-group
+    gating); other columns gate on the single presence row.
+    Returns (fn, int_layout, acc_layout)."""
     per_col_aggs: dict[str, set] = {}
     for func, col in plan.agg_specs:
         per_col_aggs.setdefault(col, set()).add(_FUNC_TO_KERNEL[func])
-    layout: list[tuple[str, str]] = [("__presence", "count")]
+    int_layout: list[tuple[str, str]] = [("__presence", "count")]
+    acc_layout: list[tuple[str, str]] = []
     for col, aggs in per_col_aggs.items():
         for agg in sorted(aggs):
             if agg == "count":
                 continue  # handled below
-            layout.append((col, agg))
+            acc_layout.append((col, agg))
         if "count" in aggs or (col in nullable_cols and col != COUNT_STAR):
-            layout.append((col, "count"))
+            int_layout.append((col, "count"))
 
     def run_all(sources, dyn):
         merged = None
@@ -549,10 +698,18 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
             for col, aggs in per_col_aggs.items()
         }
         outs["__presence"] = {"count": merged["__presence"].counts}
-        rows = [outs[col][agg].astype(jnp.float64) for col, agg in layout]
-        return jnp.stack(rows)
+        ints = jnp.stack(
+            [outs[col][agg].astype(jnp.int32) for col, agg in int_layout]
+        )
+        if acc_layout:
+            accs = jnp.stack(
+                [outs[col][agg].astype(jnp.float64) for col, agg in acc_layout]
+            )
+        else:
+            accs = jnp.zeros((0, ints.shape[1]), jnp.float64)
+        return ints, accs
 
-    return jax.jit(run_all), tuple(layout)
+    return jax.jit(run_all), tuple(int_layout), tuple(acc_layout)
 
 
 class TileExecutor:
@@ -713,13 +870,14 @@ class TileExecutor:
             for mt in mem_tables:
                 ctx.dictionary.update_table(mt, all_tag_cols)
         pinned_ids = {r.region_id for r, _f, _m in region_sources}
+        pk = [c.name for c in schema.tag_columns()]
         super_entries: list[_SuperTiles] = []
         slots: list = []
         for region, metas, mem_tables in region_sources:
             if metas:
                 entry, excluded = self.cache.super_tiles(
                     region, ctx.dictionary, metas, all_tag_cols,
-                    use_ts, value_cols, pinned_ids,
+                    use_ts, value_cols, pinned_ids, pk,
                 )
                 # a file that cannot join the super-tile only blocks
                 # queries whose window its rows could affect
@@ -751,21 +909,41 @@ class TileExecutor:
         # 4. phase B — dictionary is final for this query: repair stale
         # device tiles with one gather, build perms, encode memtail
         self.cache.repair_super(super_entries, ctx.dictionary, all_tag_cols)
+
+        # 4.5 host fast path: a highly selective pk-equality query (TSBS
+        # single-groupby / cpu-max-all / high-cpu-1 shapes) binary-searches
+        # the (pk, ts)-sorted host copies and aggregates the tiny slice
+        # with numpy — no device link round-trip at all.  The reference
+        # serves these through its inverted index + page pruning; here the
+        # sorted encode cache plays that role.
+        host_table = self._host_execute(
+            plan, dyn_host, super_entries,
+            [s for s in slots if not isinstance(s, _SuperTiles)],
+            schema, ctx, use_ts, pk, value_cols, all_tag_cols,
+        )
+        if host_table is not None:
+            metrics.TILE_LOWERED_TOTAL.inc()
+            metrics.TILE_HOST_FAST_PATH.inc()
+            return host_table
+
         device_sources = []
         for s in slots:
             if isinstance(s, _SuperTiles):
-                perm = None
-                if plan.time_major:
-                    perm = self.cache.ensure_perm(s, use_ts)
                 need_cols = self._plan_cols(plan)
-                device_sources.append(
-                    (
-                        {k: v for k, v in s.cols.items() if k in need_cols},
-                        s.valid,
-                        {k: v for k, v in s.nulls.items() if k in need_cols},
-                        perm,
+                if plan.time_major:
+                    cols, valid, nulls = self.cache.ensure_time_major(
+                        s, use_ts, need_cols
                     )
-                )
+                    device_sources.append((cols, valid, nulls, None))
+                else:
+                    device_sources.append(
+                        (
+                            {k: v for k, v in s.cols.items() if k in need_cols},
+                            s.valid,
+                            {k: v for k, v in s.nulls.items() if k in need_cols},
+                            None,
+                        )
+                    )
             else:
                 src = self._encode_mem(
                     ctx.dictionary, s[1], all_tag_cols, use_ts, value_cols
@@ -783,17 +961,21 @@ class TileExecutor:
                     )
                 )
 
-        # 5. one dispatch, one fetch
+        # 5. one dispatch, one fetch.  NULL-gating count rows ship only
+        # for columns whose dispatched sources actually carry a null mask
+        # — a schema-nullable column with no nulls on disk costs nothing
+        # (result bytes ride a ~15 MB/s link; every dropped [G] row counts)
+        null_present = set()
+        for _cols, _valid, nulls, _perm in device_sources:
+            null_present |= set(nulls)
         nullable_cols = tuple(
             sorted(
                 c
                 for _f, c in plan.agg_specs
-                if c != COUNT_STAR
-                and schema.has_column(c)
-                and schema.column(c).nullable
+                if c != COUNT_STAR and c in null_present
             )
         )
-        program, layout = _tile_program(plan, nullable_cols)
+        program, int_layout, acc_layout = _tile_program(plan, nullable_cols)
         dyn = {
             "filter_values": tuple(dyn_host["filter_values"]),
             "bucket_origin": np.int64(dyn_host["bucket_origin"]),
@@ -802,7 +984,7 @@ class TileExecutor:
         packed = program(tuple(device_sources), dyn)
         metrics.TILE_LOWERED_TOTAL.inc()
         return self._finalize(
-            packed, layout, plan, lowering, schema, ctx, dyn_host
+            packed, int_layout, acc_layout, plan, lowering, schema, ctx, dyn_host
         )
 
     # -- helpers -------------------------------------------------------------
@@ -868,7 +1050,7 @@ class TileExecutor:
             interval_native = max(int(interval * 1_000_000) // max(unit_ns, 1), 1)
             origin = origin_hint + ((lo - origin_hint) // interval_native) * interval_native
             n_buckets = max(int((hi - origin + interval_native - 1) // interval_native), 1)
-            n_buckets = _quantize_card(n_buckets)
+            n_buckets = _quantize_soft(n_buckets)
             bucket_col = ts_col
         else:
             bucket_col, interval_native, origin, n_buckets = None, 1, 0, 1
@@ -897,13 +1079,30 @@ class TileExecutor:
                 for fname, fop, fval in f:
                     push(fname, fop, fval, np.int32)
             else:
-                if isinstance(value, str):
-                    from ..datatypes.coercion import coerce_string_scalar
+                from ..datatypes.coercion import coerce_string_scalar
 
-                    # numeric literal as string (prepared statements)
-                    v = coerce_string_scalar(value, pa.float64())
-                    value = v.as_py() if isinstance(v, pa.Scalar) else v
-                    if isinstance(value, str):
+                def _coerce(v):
+                    # numeric literal as string (prepared statements);
+                    # a truly non-numeric string on a value column cannot
+                    # tile — signalled as None
+                    if isinstance(v, str):
+                        try:
+                            c = coerce_string_scalar(v, pa.float64())
+                        except (ValueError, TypeError):
+                            return None
+                        v = c.as_py() if isinstance(c, pa.Scalar) else c
+                        if isinstance(v, str):
+                            return None
+                    return v
+
+                if op in ("in", "not in"):
+                    vals = [_coerce(v) for v in value]
+                    if any(v is None for v in vals):
+                        return None
+                    value = tuple(vals)
+                else:
+                    value = _coerce(value)
+                    if value is None:
                         return None
                 dtype = np.int64 if name == ts_name else np.float64
                 push(name, op, value, dtype)
@@ -944,6 +1143,32 @@ class TileExecutor:
                 }
             )
         )
+
+        # blocked-kernel span: expected distinct gids per 4096-row block of
+        # the (pk, ts)-sorted (or time-major) layout, plus the bucket-axis
+        # jump at a pk boundary.  Compute cost of the blocked kernel scales
+        # with span, so size it to the layout instead of hard-coding; past
+        # the cap the runtime guard fails and the scatter path (always
+        # correct) takes over.
+        est_rows = sum(r.approx_rows() for r in ctx.regions)
+        gid_tags = layout_tags if layout_tags is not None else tag_cols
+        real_groups = max(n_buckets, 1)
+        for t in gid_tags:
+            real_groups *= max(d.cardinality(t), 1)
+        if time_major:
+            # window rows spread over n_buckets; out-of-window rows are
+            # masked and don't count against the span guard
+            per_group = max(est_rows // max(n_buckets, 1), 1)
+            span_est = -(-BLOCK_ROWS // per_group) + 2
+        else:
+            per_group = max(est_rows // real_groups, 1)
+            span_est = -(-BLOCK_ROWS // per_group) + 2
+            if bucket_col is not None:
+                span_est += n_buckets  # pk-boundary bucket jump
+        block_span = 16
+        while block_span < min(span_est, 128):
+            block_span <<= 1
+
         plan = DistGroupByPlan(
             group_tags=tuple(tag_cols),
             tag_cards=tuple(_quantize_card(d.cardinality(t)) for t in tag_cols),
@@ -961,6 +1186,7 @@ class TileExecutor:
             if layout_tags is None
             else tuple(_quantize_card(d.cardinality(t)) for t in layout_tags),
             time_major=time_major,
+            block_span=block_span,
         )
         dyn_host = {
             "filter_values": filter_vals,
@@ -974,14 +1200,217 @@ class TileExecutor:
 
         return "float64" if _jax.config.jax_enable_x64 else "float32"
 
-    def _finalize(self, packed, layout, plan, lowering, schema, ctx, dyn_host):
+    # -- host fast path ------------------------------------------------------
+    _HOST_PATH_MAX_ROWS = 4 << 20
+
+    def _host_execute(
+        self, plan, dyn_host, super_entries, mem_slots,
+        schema, ctx, use_ts, pk, value_cols, all_tag_cols,
+    ):
+        """Selective pk-equality fast path: returns the result table, or
+        None when the query shape/size doesn't qualify."""
+        if plan.group_tags or not pk:
+            return None  # only scalar / bucket-grouped outputs
+        if any(_FUNC_TO_KERNEL[f] == "last" for f, _ in plan.agg_specs):
+            return None
+        pk0 = pk[0]
+        # split filters: pk0 equalities select row ranges; everything else
+        # is a residual mask on the slice
+        eq_codes: set[int] | None = None
+        residual: list[tuple[str, str, object]] = []
+        for (name, op, _arity), val in zip(plan.filters, dyn_host["filter_values"]):
+            if name == pk0 and op == "=":
+                codes = {int(val)}
+                eq_codes = codes if eq_codes is None else (eq_codes & codes)
+            elif name == pk0 and op == "in":
+                codes = {int(v) for v in val}
+                eq_codes = codes if eq_codes is None else (eq_codes & codes)
+            elif name == pk0 and op == "!=":
+                if eq_codes is not None:
+                    eq_codes.discard(int(val))
+                else:
+                    residual.append((name, op, val))
+            else:
+                residual.append((name, op, val))
+        if not eq_codes:
+            return None
+        # residuals must be computable on the slice: ts, pk codes, values
+        for name, _op, _v in residual:
+            if name != use_ts and name not in pk and name not in value_cols:
+                return None
+
+        n_buckets = plan.n_buckets if plan.bucket_col else 1
+        origin = dyn_host["bucket_origin"]
+        interval = dyn_host["bucket_interval"]
+
+        # row ranges per (entry, code) + total-size guard
+        ranges: list[tuple[object, int, int]] = []
+        total = 0
+        for entry in super_entries:
+            if entry.order is None or pk0 not in entry.sorted_host:
+                return None
+            arr = entry.sorted_host[pk0]
+            # one vectorized dtype-matched search for all codes: a python
+            # int scalar makes numpy value-cast the whole 4 M-row array
+            # per call (measured ~1.2 ms each)
+            codes_sorted = np.asarray(sorted(eq_codes), dtype=arr.dtype)
+            lefts = np.searchsorted(arr, codes_sorted, side="left")
+            rights = np.searchsorted(arr, codes_sorted, side="right")
+            for a, b in zip(lefts.tolist(), rights.tolist()):
+                if a < b:
+                    ranges.append((entry, a, b))
+                    total += b - a
+        if total > self._HOST_PATH_MAX_ROWS:
+            return None
+
+        per_col_aggs: dict[str, set] = {}
+        for func, col in plan.agg_specs:
+            per_col_aggs.setdefault(col, set()).add(_FUNC_TO_KERNEL[func])
+
+        finals: dict[str, dict[str, np.ndarray]] = {
+            "__presence": {"count": np.zeros(n_buckets, np.int64)}
+        }
+        for col, aggs in per_col_aggs.items():
+            d = finals.setdefault(col, {})
+            for agg in sorted(aggs | {"count"}):
+                if agg == "count":
+                    d["count"] = np.zeros(n_buckets, np.int64)
+                elif agg in ("sum", "avg"):
+                    d.setdefault("sum", np.zeros(n_buckets, np.float64))
+                elif agg == "min":
+                    d["min"] = np.full(n_buckets, np.inf)
+                elif agg == "max":
+                    d["max"] = np.full(n_buckets, -np.inf)
+
+        def accumulate(get_col, ts_arr, base_mask, n):
+            """get_col(name) -> (values, present|None); accumulates into
+            finals.  Shared by SST slices and memtable tails."""
+            mask = base_mask
+            for name, op, val in residual:
+                if name == use_ts:
+                    col = ts_arr
+                else:
+                    got = get_col(name)
+                    if got is None:
+                        return False
+                    col, pres = got
+                    if pres is not None:
+                        mask = mask & pres
+                mask = _np_filter(mask, col, op, val)
+            if plan.bucket_col is not None:
+                bucket = ((ts_arr - origin) // interval).astype(np.int64)
+                in_b = (bucket >= 0) & (bucket < n_buckets)
+                mask = mask & in_b
+                bucket = np.clip(bucket, 0, n_buckets - 1)
+            else:
+                bucket = np.zeros(n, np.int64)
+            if not mask.any():
+                return True
+            bsel = bucket[mask]
+            finals["__presence"]["count"] += np.bincount(
+                bsel, minlength=n_buckets
+            ).astype(np.int64)
+            for col_name, aggs in per_col_aggs.items():
+                if col_name == COUNT_STAR:
+                    finals[col_name]["count"] += np.bincount(
+                        bsel, minlength=n_buckets
+                    ).astype(np.int64)
+                    continue
+                got = get_col(col_name)
+                if got is None:
+                    return False
+                vals, pres = got
+                cmask = mask if pres is None else (mask & pres)
+                vsel = vals[cmask].astype(np.float64)
+                bs = bucket[cmask]
+                d = finals[col_name]
+                if "count" in d:
+                    d["count"] += np.bincount(bs, minlength=n_buckets).astype(np.int64)
+                if "sum" in d:
+                    d["sum"] += np.bincount(bs, weights=vsel, minlength=n_buckets)
+                if "min" in d:
+                    np.minimum.at(d["min"], bs, vsel)
+                if "max" in d:
+                    np.maximum.at(d["max"], bs, vsel)
+            return True
+
+        for entry, a, b in ranges:
+            positions = entry.order[a:b].astype(np.int64)
+            cache: dict[str, object] = {}
+
+            def get_col(name, _entry=entry, _pos=positions, _a=a, _b=b, _cache=cache):
+                if name in _cache:
+                    return _cache[name]
+                if name in _entry.sorted_host:
+                    got = (_entry.sorted_host[name][_a:_b], None)
+                else:
+                    got = self.cache.gather_host_values(_entry, name, _pos)
+                _cache[name] = got
+                return got
+
+            ts_arr = (
+                entry.sorted_host[use_ts][a:b] if use_ts else np.zeros(b - a, np.int64)
+            )
+            if not accumulate(get_col, ts_arr, np.ones(b - a, bool), b - a):
+                return None
+
+        for _region, mem_table in mem_slots:
+            need = list(
+                dict.fromkeys(
+                    [pk0]
+                    + ([use_ts] if use_ts else [])
+                    + value_cols
+                    + [n for n, _o, _v in residual if n in pk]
+                )
+            )
+            for name in need:
+                if name not in mem_table.column_names:
+                    return None
+            built = _encode_host_tiles(
+                ctx.dictionary, mem_table, need, all_tag_cols + pk, use_ts
+            )
+            if built is None:
+                return None
+            mcols, mnulls, _e, _b = built
+            codes_arr = mcols[pk0]
+            sel = np.isin(codes_arr, list(eq_codes))
+            ts_arr = (
+                mcols[use_ts] if use_ts else np.zeros(mem_table.num_rows, np.int64)
+            )
+
+            def get_mem_col(name, _mcols=mcols, _mnulls=mnulls):
+                if name not in _mcols:
+                    return None
+                return _mcols[name], _mnulls.get(name)
+
+            if not accumulate(get_mem_col, ts_arr, sel, mem_table.num_rows):
+                return None
+
+        # avg + non-finite cleanup to match the device finalize
+        for col, aggs in per_col_aggs.items():
+            d = finals[col]
+            if "avg" in aggs:
+                cnt = d.get("count", finals["__presence"]["count"])
+                d["avg"] = d["sum"] / np.maximum(cnt, 1)
+        return self._assemble_result(finals, plan, ctx, dyn_host)
+
+    def _finalize(
+        self, packed, int_layout, acc_layout, plan, lowering, schema, ctx, dyn_host
+    ):
         # ONE host fetch total, regardless of how many aggregates ran
         t0 = time.perf_counter()
-        flat = jax.device_get(packed)
+        ints, accs = jax.device_get(packed)
         metrics.TILE_READBACK_MS.observe((time.perf_counter() - t0) * 1000.0)
         finals: dict[str, dict[str, np.ndarray]] = {}
-        for i, (col, agg) in enumerate(layout):
-            finals.setdefault(col, {})[agg] = flat[i]
+        for i, (col, agg) in enumerate(int_layout):
+            finals.setdefault(col, {})[agg] = ints[i]
+        for i, (col, agg) in enumerate(acc_layout):
+            finals.setdefault(col, {})[agg] = accs[i]
+        return self._assemble_result(finals, plan, ctx, dyn_host)
+
+    def _assemble_result(self, finals, plan, ctx, dyn_host):
+        """Shared [G]-state -> SQL rows assembly for the device and host
+        fast paths (identical NULL-gating and naming semantics)."""
         outputs: dict[str, np.ndarray] = {}
         presence = finals["__presence"]["count"]
         non_empty = presence > 0
@@ -1008,6 +1437,38 @@ class TileExecutor:
             bucket_interval=dyn_host["bucket_interval"],
         )
         return result.to_table()
+
+
+def _quantize_soft(n: int) -> int:
+    """Round up keeping 3 significant bits (12 -> 12, 13 -> 14, 25 -> 28):
+    bounds the compile-key variety of window-derived bucket counts to ~8
+    per octave while wasting at most 12.5% of the [K, G] result transfer
+    (full pow2 padding wasted 33% on a 12-bucket window, and the transfer
+    rides a ~15 MB/s link)."""
+    if n <= 8:
+        return n
+    step = 1 << (n.bit_length() - 3)
+    return -(-n // step) * step
+
+
+def _np_filter(mask: np.ndarray, col: np.ndarray, op: str, val) -> np.ndarray:
+    if op == "=":
+        return mask & (col == val)
+    if op == "!=":
+        return mask & (col != val)
+    if op == "<":
+        return mask & (col < val)
+    if op == "<=":
+        return mask & (col <= val)
+    if op == ">":
+        return mask & (col > val)
+    if op == ">=":
+        return mask & (col >= val)
+    if op == "in":
+        return mask & np.isin(col, list(val))
+    if op == "not in":
+        return mask & ~np.isin(col, list(val))
+    return np.zeros_like(mask)
 
 
 def _choose_layout(

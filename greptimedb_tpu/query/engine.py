@@ -86,6 +86,50 @@ class QueryEngine:
         try:
             if self.config.backend == "tpu" and schema.columns:
                 lowering = try_lower(plan, schema)
+                if (
+                    lowering is not None
+                    and self.config.tpu_min_rows > 0
+                    and self._tile_ctx is not None
+                ):
+                    est = self._estimate_scan_rows(lowering.scan, schema)
+                    if (
+                        est is not None
+                        and est < self.config.tpu_min_rows
+                        and not self._tiles_resident(lowering.scan)
+                    ):
+                        # cost-based routing: building device tiles for a
+                        # tiny scan isn't worth it — but once a super-tile
+                        # is resident, the tile path's host fast branch
+                        # beats the CPU scan, so routing only applies cold
+                        # (reference analogue: the optimizer choosing a
+                        # plain scan over a parallelized one for tiny
+                        # inputs)
+                        metrics.TPU_ROUTED_TO_CPU.inc()
+                        lowering = None
+                if lowering is not None:
+                    # the HBM super-tile path wins whenever it applies
+                    # (standalone hot path: resident tiles, one dispatch,
+                    # host fast branch for selective queries) — try it
+                    # BEFORE state shipping.  backend is flipped first so
+                    # a tile-path error falls back instead of re-raising.
+                    scan = lowering.scan
+                    backend = "tpu"
+                    tpu = TpuExecutor(
+                        None,
+                        self._region_scan,
+                        acc_dtype="float64" if _x64_enabled() else "float32",
+                        tile_executor=self._tile_executor,
+                        tile_context_provider=self._tile_ctx,
+                    )
+                    with span("query.tpu", table=scan.table):
+                        table = tpu.try_tile(
+                            lowering,
+                            schema,
+                            lambda: self._time_bounds(scan.table, scan.database),
+                        )
+                    if table is not None:
+                        return table
+                    backend = "cpu"
                 if lowering is not None and self._partial_agg is not None:
                     # distributed: ship the aggregate, merge states — never
                     # rows — across nodes (reference MergeScan split)
@@ -112,12 +156,11 @@ class QueryEngine:
                 if lowering is not None:
                     backend = "tpu"
                     with span("query.tpu", table=lowering.scan.table):
+                        # tile path already declined above — mesh only
                         tpu = TpuExecutor(
                             self.mesh,
                             self._region_scan,
                             acc_dtype="float64" if _x64_enabled() else "float32",
-                            tile_executor=self._tile_executor,
-                            tile_context_provider=self._tile_ctx,
                         )
                         scan = lowering.scan
                         return tpu.execute(
@@ -135,6 +178,47 @@ class QueryEngine:
             raise
         finally:
             metrics.QUERY_ELAPSED.observe(time.perf_counter() - t0, backend=backend)
+
+    def _tiles_resident(self, scan: TableScan) -> bool:
+        if self.tile_cache is None:
+            return False
+        ctx = self._tile_ctx(scan)
+        if ctx is None or not ctx.regions:
+            return False
+        return all(self.tile_cache.has_region(r.region_id) for r in ctx.regions)
+
+    def _estimate_scan_rows(self, scan: TableScan, schema: Schema) -> int | None:
+        """Cheap pre-execution cardinality estimate for backend routing:
+        file rows intersecting the time window + memtable rows, scaled by
+        tag-equality selectivity from the dictionary cardinalities (the
+        role of the reference's region-stat based planning inputs)."""
+        ctx = self._tile_ctx(scan)
+        if ctx is None:
+            return None
+        window = scan.time_range
+        rows = 0
+        try:
+            for region in ctx.regions:
+                files, mems, _v = region.tile_snapshot()
+                for meta in files:
+                    lo, hi = meta.time_range
+                    if window is None or (hi >= window[0] and lo < window[1]):
+                        rows += meta.num_rows
+                for mem in mems:
+                    rows += mem.num_rows
+        except Exception:  # noqa: BLE001 — estimate only, never fatal
+            return None
+        sel = 1.0
+        if ctx.dictionary is not None:
+            tag_names = {c.name for c in schema.tag_columns()}
+            for name, op, value in scan.filters:
+                if name in tag_names:
+                    card = max(ctx.dictionary.cardinality(name), 1)
+                    if op == "=":
+                        sel /= card
+                    elif op == "in":
+                        sel *= min(len(value) / card, 1.0)
+        return int(rows * sel)
 
     def explain(self, stmt: SelectStmt, database: str = "public") -> pa.Table:
         plan, schema = plan_query(stmt, self.schema_of, database, self.view_of)

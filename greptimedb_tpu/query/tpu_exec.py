@@ -156,6 +156,28 @@ class TpuExecutor:
         self.tile_executor = tile_executor
         self.tile_context_provider = tile_context_provider
 
+    def try_tile(self, lowering: Lowering, schema: Schema, time_bounds) -> pa.Table | None:
+        """HBM super-tile path only: the standalone hot path.  Returns the
+        finished result table, or None when the tile executor doesn't
+        apply (caller then weighs dist-state shipping vs the mesh path)."""
+        from .analyze import stage
+
+        scan = lowering.scan
+        if self.tile_executor is None or self.tile_context_provider is None:
+            return None
+        ctx = self.tile_context_provider(scan)
+        if ctx is None:
+            return None
+        with stage("tpu.tile_cache") as info:
+            table = self.tile_executor.execute(
+                lowering, schema, lambda: time_bounds(), ctx
+            )
+            info["hit"] = table is not None
+        if table is None:
+            return None
+        with stage("tpu.post_ops"):
+            return self._shape_output(table, lowering, schema)
+
     def execute(self, lowering: Lowering, schema: Schema, time_bounds) -> pa.Table:
         """time_bounds: callback () -> (min_ts, max_ts) over the scanned data,
         used when the query has no explicit time range (bucket count must be
@@ -164,20 +186,9 @@ class TpuExecutor:
         from .analyze import stage
 
         scan = lowering.scan
-        if self.tile_executor is not None and self.tile_context_provider is not None:
-            ctx = self.tile_context_provider(scan)
-            if ctx is not None:
-                with stage("tpu.tile_cache") as info:
-                    table = self.tile_executor.execute(
-                        lowering,
-                        schema,
-                        lambda: time_bounds(),
-                        ctx,
-                    )
-                    info["hit"] = table is not None
-                if table is not None:
-                    with stage("tpu.post_ops"):
-                        return self._shape_output(table, lowering, schema)
+        table = self.try_tile(lowering, schema, time_bounds)
+        if table is not None:
+            return table
         if lowering.bucket is not None:
             ts_col, interval, origin_hint = lowering.bucket
             if scan.time_range is not None and scan.time_range[0] > -(1 << 61) and scan.time_range[1] < (1 << 61):

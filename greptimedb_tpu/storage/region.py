@@ -518,6 +518,18 @@ class Region:
             self._active_scans -= 1
             self._purge_garbage_locked()
 
+    def approx_rows(self) -> int:
+        """Cheap row-count estimate (manifest stats + memtables) for the
+        query planner's layout/cost decisions — the role of the
+        reference's region statistics (store-api region_statistic)."""
+        with self._lock:
+            rows = sum(
+                m.num_rows for m in self.manifest_mgr.manifest.files.values()
+            )
+            rows += self.memtable.num_rows
+            rows += sum(m.num_rows for m in self._frozen_memtables)
+        return rows
+
     def tile_snapshot(self) -> tuple[list[FileMeta], list[Memtable], int]:
         """Consistent (files, memtables, manifest_version) snapshot for the
         tile executor.  Caller must hold pin_scan() around use."""

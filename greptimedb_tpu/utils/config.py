@@ -96,6 +96,12 @@ class QueryConfig:
     # (ops/aggregate.py reduce_state_axes); dense [G] states at 8 bytes make
     # 2^24 = 128 MB per tracked aggregate — fine in HBM, folded before fetch
     max_internal_groups: int = 1 << 24
+    # Cost-based backend routing: lowerable plans whose post-prune row
+    # estimate falls below this stay on the LOCAL CPU path — on a
+    # remote-device harness every device query pays the link round-trip
+    # (~100 ms here), which dwarfs a small local Arrow aggregation.
+    # 0 disables routing (device path for every lowerable plan).
+    tpu_min_rows: int = 0
     parallelism: int = 0  # 0 = number of local devices
     fallback_to_cpu: bool = True
     # HBM-resident SST tile cache (parallel/tile_cache.py): warm queries run

@@ -156,12 +156,14 @@ WRITE_STALL_TOTAL = REGISTRY.counter("greptime_mito_write_stall_total", "Write s
 QUERY_ELAPSED = REGISTRY.histogram("greptime_query_elapsed", "Query seconds")
 TPU_LOWERED_TOTAL = REGISTRY.counter("greptime_query_tpu_lowered_total", "Plans lowered to TPU")
 TPU_FALLBACK_TOTAL = REGISTRY.counter("greptime_query_tpu_fallback_total", "Plans that fell back to CPU")
+TPU_ROUTED_TO_CPU = REGISTRY.counter("greptime_query_tpu_routed_cpu_total", "Lowerable plans routed to CPU by the cost model")
 TILE_CACHE_HITS = REGISTRY.counter("greptime_tile_cache_hits_total", "HBM tile cache hits (files)")
 TILE_CACHE_MISSES = REGISTRY.counter("greptime_tile_cache_misses_total", "HBM tile cache builds (files)")
 TILE_CACHE_EVICTIONS = REGISTRY.counter("greptime_tile_cache_evictions_total", "HBM tile cache evictions")
 TILE_QUERY_ELAPSED = REGISTRY.histogram("greptime_query_tile_elapsed", "Tile-path query seconds")
 TILE_LOWERED_TOTAL = REGISTRY.counter("greptime_query_tile_lowered_total", "Queries served from the HBM tile cache")
 TILE_READBACK_MS = REGISTRY.histogram("greptime_tile_readback_ms", "Device->host result fetch milliseconds per tile query")
+TILE_HOST_FAST_PATH = REGISTRY.counter("greptime_tile_host_fast_path_total", "Selective queries served from the sorted host encode cache")
 DIST_STATE_QUERIES = REGISTRY.counter("greptime_query_dist_state_total", "Distributed queries merged from shipped states")
 COMPACTION_BACKGROUND = REGISTRY.counter("greptime_mito_compaction_background_total", "Background compaction merges")
 COMPACTION_FAILED = REGISTRY.counter("greptime_mito_compaction_failed_total", "Compaction rounds that errored")

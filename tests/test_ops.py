@@ -345,7 +345,8 @@ def test_raw_group_ids_empty_components():
 def test_segment_aggregate_blocked_last(layout):
     """last_value at large n: clustered layouts take the two-pass blocked
     LAST kernel, unsorted ids its scatter fallback — both must agree with
-    a numpy last-by-ts (ties -> max value) reference."""
+    a numpy last-by-(ts, row-order) reference (ties -> later row, the
+    engine's last-write-wins)."""
     from greptimedb_tpu.ops import aggregate as agg
 
     rng = np.random.default_rng(13)
@@ -370,8 +371,8 @@ def test_segment_aggregate_blocked_last(layout):
         if not m:
             continue
         counts[g] += 1
-        if t > last_ts[g] or (t == last_ts[g] and v > last_val[g]):
-            last_ts[g], last_val[g] = t, max(v, last_val[g] if t == last_ts[g] else -np.inf)
+        if t >= last_ts[g]:
+            last_ts[g], last_val[g] = t, v
     nz = counts > 0
     np.testing.assert_array_equal(np.asarray(state.counts), counts)
     np.testing.assert_array_equal(np.asarray(state.last_ts)[nz], last_ts[nz])

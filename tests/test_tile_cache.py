@@ -374,3 +374,41 @@ def test_alter_added_column_null_fills_old_files(db):
     t1, t2 = _both(db, q)
     assert _tile_count() == before + 1, "post-ALTER table should still tile"
     _assert_equal(t1, t2, ["host"])
+
+
+def test_host_fast_path_selective_queries(db):
+    """pk-equality + bucket/scalar queries are answered from the sorted
+    host encode cache (no device dispatch) and must match CPU exactly."""
+    _mk_cpu_table(db)
+    _load(db)
+    db.sql("ADMIN flush_table('cpu')")
+    # warm the super-tile/order with a broad query first
+    db.sql_one(Q)
+    h0 = metrics.TILE_HOST_FAST_PATH.get()
+    for q in [
+        "SELECT time_bucket('30s', ts) AS tb, avg(usage_user) AS au,"
+        " count(*) AS c FROM cpu WHERE host = 'host_2' GROUP BY tb",
+        "SELECT time_bucket('30s', ts) AS tb, max(usage_user) AS mu"
+        " FROM cpu WHERE host IN ('host_1','host_4') GROUP BY tb",
+        "SELECT count(*) AS n, max(usage_user) AS m FROM cpu"
+        " WHERE host = 'host_3' AND usage_system > 2 AND ts >= 10000 AND ts < 60000",
+        "SELECT min(usage_user) AS mn, sum(usage_system) AS s FROM cpu"
+        " WHERE host = 'host_0' AND region = 'r0'",
+    ]:
+        t1, t2 = _both(db, q)
+        keys = [c for c in t1.column_names if c == "tb"]
+        _assert_equal(t1, t2, keys or [t1.column_names[0]])
+    assert metrics.TILE_HOST_FAST_PATH.get() >= h0 + 4, "host fast path did not engage"
+
+
+def test_host_fast_path_includes_memtable(db):
+    _mk_cpu_table(db)
+    _load(db, ticks=40)
+    db.sql("ADMIN flush_table('cpu')")
+    db.sql_one(Q)
+    _load(db, ticks=20, t0=600_000)  # unflushed tail in a disjoint window
+    q = ("SELECT count(*) AS c, avg(usage_user) AS au FROM cpu"
+         " WHERE host = 'host_1'")
+    t1, t2 = _both(db, q)
+    _assert_equal(t1, t2, ["c"])
+    assert t1["c"].to_pylist()[0] == 60
