@@ -31,11 +31,22 @@ Prints ONE final JSON line; headline = double-groupby-1 warm end-to-end p50.
 
 from __future__ import annotations
 
+import faulthandler
 import json
 import math
 import os
+import signal
 import sys
 import time
+
+# a fatal signal (segfault, external kill) must leave a stack trace in the
+# log — round 3's first full-scale run died silently mid-compile
+faulthandler.enable()
+if hasattr(faulthandler, "register") and hasattr(signal, "SIGTERM"):
+    try:
+        faulthandler.register(signal.SIGTERM, chain=True)
+    except (ValueError, OSError):
+        pass
 
 import numpy as np
 import pyarrow as pa

@@ -82,6 +82,7 @@ class SessionState:
 import contextvars as _contextvars
 
 # maps id(Database) -> SessionState within one connection's context
+_SESSION_TOKENS = __import__("itertools").count()
 _SESSION: _contextvars.ContextVar[dict | None] = _contextvars.ContextVar(
     "gt_session", default=None
 )
@@ -100,8 +101,10 @@ class Database:
         self.plugins = plugins or Plugins()
         if data_home is not None:
             self.config.storage.data_home = data_home
-            self.config.storage.wal_dir = os.path.join(data_home, "wal")
-            self.config.storage.sst_dir = os.path.join(data_home, "data")
+            # wal/sst dirs derive from data_home at use time
+            # (StorageConfig.effective_*_dir) — never bake them here
+            self.config.storage.wal_dir = ""
+            self.config.storage.sst_dir = ""
         self.storage = TimeSeriesEngine(self.config.storage)
         catalog_path = os.path.join(self.config.storage.data_home, "catalog.json")
         self.catalog = Catalog(catalog_path)
@@ -161,6 +164,7 @@ class Database:
 
         # plan cache: (sql text, database) -> (catalog revision, plan, schema)
         self._plan_cache: OrderedDict = OrderedDict()
+        self._session_token = next(_SESSION_TOKENS)
         self._plan_cache_lock = threading.Lock()
         self.telemetry = TelemetryTask(self, self.config.telemetry).start()
         self._reopen_regions()
@@ -175,14 +179,20 @@ class Database:
     def ensure_session(self):
         """Get-or-create this connection's session.  Protocol servers call
         this on their handler thread before dispatching work so the state
-        object is anchored in the connection's own context."""
+        object is anchored in the connection's own context.
+
+        Keyed by a process-unique instance token, NOT id(self): a context's
+        session dict outlives any one Database, and CPython recycles ids,
+        so a new Database could inherit a closed one's session state
+        (observed as flaky database/timezone leakage across the sqlness
+        runner's sequential Databases)."""
         sessions = _SESSION.get()
         if sessions is None:
             sessions = {}
             _SESSION.set(sessions)
-        s = sessions.get(id(self))
+        s = sessions.get(self._session_token)
         if s is None:
-            s = sessions[id(self)] = SessionState()
+            s = sessions[self._session_token] = SessionState()
         return s
 
     @property

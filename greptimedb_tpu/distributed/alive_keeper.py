@@ -1,0 +1,93 @@
+"""Datanode-side region lease enforcement — the split-brain guard.
+
+Role-equivalent of the reference's `RegionAliveKeeper`
+(datanode/src/alive_keeper.rs:50, `close_staled_region` :144): the metasrv
+grants per-region leases in heartbeat replies
+(meta-srv region/lease_keeper.rs; here metasrv.handle_heartbeat's
+`lease_regions`/`lease_until_ms`), and the DATANODE refuses writes to —
+and eventually closes — regions whose lease lapsed.  Without this, a
+network-partitioned datanode keeps accepting writes for a region the
+metasrv has already failed over elsewhere (two writers, diverging data);
+with it, the stale side fences itself off locally before the new leader
+takes over.
+
+Only regions that have ever been GRANTED a lease are enforced: a
+standalone engine (no metasrv, no leases) is untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils.errors import GreptimeError
+
+
+class RegionLeaseExpiredError(GreptimeError):
+    """Write refused: this datanode's lease on the region lapsed."""
+
+
+class RegionAliveKeeper:
+    """Tracks per-region lease deadlines delivered by heartbeat replies
+    and fences lapsed regions."""
+
+    def __init__(self, node_id: int, grace_ms: float = 0.0):
+        self.node_id = node_id
+        self.grace_ms = grace_ms
+        self._lock = threading.Lock()
+        self._deadlines: dict[int, float] = {}  # region id -> lease_until_ms
+
+    def renew(self, region_ids: list[int], lease_until_ms: float):
+        """Apply one heartbeat reply: extend leases for the granted set and
+        DROP regions the metasrv no longer leases to us (a reply that
+        omits a region is a revocation — the route moved)."""
+        granted = set(region_ids)
+        with self._lock:
+            # regions absent from the reply keep their OLD deadline and
+            # lapse naturally — omission is a revocation, not an extension
+            for rid in granted:
+                self._deadlines[rid] = lease_until_ms
+
+    def lease_until(self, rid: int) -> float | None:
+        with self._lock:
+            return self._deadlines.get(rid)
+
+    def expired(self, rid: int, now_ms: float) -> bool:
+        """True when the region WAS leased and the lease has lapsed."""
+        with self._lock:
+            dl = self._deadlines.get(rid)
+        return dl is not None and now_ms > dl + self.grace_ms
+
+    def check_write(self, rid: int, now_ms: float):
+        if self.expired(rid, now_ms):
+            raise RegionLeaseExpiredError(
+                f"datanode {self.node_id}: lease on region {rid} lapsed "
+                f"(deadline {self._deadlines.get(rid)}, now {now_ms}) — "
+                "writes fenced pending failover"
+            )
+
+    def close_staled_regions(self, engine, now_ms: float) -> list[int]:
+        """Close every region whose lease lapsed (reference
+        close_staled_region, alive_keeper.rs:144).  Returns the closed
+        region ids; the engine's WAL/SSTs on shared storage remain for the
+        new leaseholder to replay."""
+        from ..utils.errors import RegionNotFoundError
+
+        stale = [
+            rid for rid in list(self._deadlines) if self.expired(rid, now_ms)
+        ]
+        closed = []
+        for rid in stale:
+            try:
+                engine.close_region(rid)
+            except RegionNotFoundError:
+                pass  # already closed/moved
+            except Exception:  # noqa: BLE001
+                # close failed with the region possibly still open: KEEP
+                # the lapsed deadline so check_write keeps fencing — the
+                # next sweep retries.  Dropping it here would re-admit
+                # writes on a region the metasrv already moved.
+                continue
+            closed.append(rid)
+            with self._lock:
+                self._deadlines.pop(rid, None)
+        return closed

@@ -45,6 +45,11 @@ class Datanode:
         cfg = StorageConfig(data_home=shared_data_home)
         self.engine = TimeSeriesEngine(cfg)
         self.alive = True
+        from .alive_keeper import RegionAliveKeeper
+
+        # split-brain fence (reference datanode/src/alive_keeper.rs:50)
+        self.alive_keeper = RegionAliveKeeper(node_id)
+        self._clock = None  # wired by the cluster for lease checks
 
     # region lifecycle (driven by metasrv instructions)
     def open_region(self, rid: int, schema: Schema | None = None):
@@ -67,6 +72,10 @@ class Datanode:
     def write(self, rid: int, batch: pa.RecordBatch) -> int:
         if not self.alive:
             raise ConnectionError(f"datanode {self.node_id} is down")
+        if self._clock is not None:
+            # lease fence: a partitioned datanode must refuse writes for
+            # regions whose lease lapsed, even though it still "works"
+            self.alive_keeper.check_write(rid, self._clock())
         return self.engine.write(rid, batch)
 
     def scan(self, rid: int, pred: ScanPredicate) -> pa.Table:
@@ -85,6 +94,15 @@ class Datanode:
 
         table = self.engine.scan(rid, pred)
         return partial_states(table, AggSpec.from_dict(spec_dict))
+
+    def execute_plan(self, rid: int, plan_dict: dict) -> pa.Table:
+        """General sub-plan execution below the region-merge boundary
+        (reference region_server.rs:245 handle_remote_read)."""
+        if not self.alive:
+            raise ConnectionError(f"datanode {self.node_id} is down")
+        from .flight import execute_region_plan
+
+        return execute_region_plan(self.engine, rid, plan_dict)
 
     def region_stats(self) -> list:
         return [s.__dict__ for s in self.engine.region_statistics()]
@@ -155,8 +173,10 @@ class Cluster:
         else:
             self.datanodes = {i: Datanode(i, data_home) for i in range(num_datanodes)}
         self.metasrv = Metasrv(self.kv, NodeManager(self))
-        for i in self.datanodes:
+        for i, dn in self.datanodes.items():
             self.metasrv.register_datanode(i)
+            if hasattr(dn, "_clock"):
+                dn._clock = self.clock
         from .procedure import ProcedureManager
         from .repartition import (
             ReconcileDatabaseProcedure,
@@ -185,6 +205,7 @@ class Cluster:
             time_bounds_provider=self._time_bounds,
             config=Config().query,
             partial_agg_provider=self._partial_agg,
+            subplan_provider=self._sub_plan,
         )
 
     # ---- DDL (frontend -> metasrv placement -> datanodes) -----------------
@@ -301,6 +322,17 @@ class Cluster:
             for rid in meta.region_ids
         ]
 
+    def _sub_plan(self, scan: TableScan, plan_dict: dict) -> list[pa.Table]:
+        """Fan a serialized sub-plan out to every region's datanode
+        (reference MergeScan do_get per region with substrait bytes,
+        merge_scan.rs:250); each returns BOUNDED rows."""
+        meta = self.catalog.table(scan.table, scan.database)
+        routes = self.metasrv.get_route(meta.table_id)
+        return [
+            self.datanodes[routes[rid]].execute_plan(rid, plan_dict)
+            for rid in meta.region_ids
+        ]
+
     def _scan(self, scan: TableScan) -> pa.Table:
         tables = [t for t in self._region_scan(scan) if t.num_rows]
         meta = self.catalog.table(scan.table, scan.database)
@@ -335,6 +367,10 @@ class Cluster:
         for node_id, dn in self.datanodes.items():
             if dn.alive:
                 reply = self.metasrv.handle_heartbeat(node_id, dn.region_stats(), now)
+                if hasattr(dn, "alive_keeper"):
+                    dn.alive_keeper.renew(
+                        reply["lease_regions"], reply["lease_until_ms"]
+                    )
                 for instr in reply["instructions"]:
                     self._apply_instruction(dn, instr)
 

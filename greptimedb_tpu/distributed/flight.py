@@ -38,11 +38,15 @@ def encode_scan_ticket(
     pred: ScanPredicate,
     projection: list[str] | None = None,
     agg: dict | None = None,
+    plan: dict | None = None,
 ) -> bytes:
     """The wire form of a region sub-query (the reference ships a substrait
-    `LogicalPlan`; our pushed-down unit is scan+predicate plus, when the
-    plan lowers, the aggregate spec — the datanode then returns partial
-    STATES, the reference's commutativity split on the wire)."""
+    `LogicalPlan`).  Three escalating shapes ride the same ticket:
+    scan+predicate (raw rows), + aggregate spec (partial STATES back), or
+    + a serialized logical sub-plan (query/plan_wire.py — the datanode
+    executes filter/project/sort/limit below the merge boundary and ships
+    BOUNDED rows, the reference's region_server.rs:245 handle_remote_read
+    over substrait bytes)."""
     return json.dumps(
         {
             "region_id": rid,
@@ -50,17 +54,41 @@ def encode_scan_ticket(
             "filters": [list(f) for f in pred.filters],
             "projection": projection,
             "agg": agg,
+            "plan": plan,
         }
     ).encode()
 
 
-def decode_scan_ticket(raw: bytes) -> tuple[int, ScanPredicate, list[str] | None, dict | None]:
+def decode_scan_ticket(raw: bytes) -> tuple[int, ScanPredicate, list[str] | None, dict | None, dict | None]:
     d = json.loads(raw.decode())
     pred = ScanPredicate(
         time_range=tuple(d["time_range"]) if d["time_range"] else None,
         filters=[tuple(f) for f in d["filters"]],
     )
-    return d["region_id"], pred, d.get("projection"), d.get("agg")
+    return d["region_id"], pred, d.get("projection"), d.get("agg"), d.get("plan")
+
+
+def execute_region_plan(engine, rid: int, plan_dict: dict):
+    """Datanode-side general sub-plan execution: rebuild the shipped plan
+    and run it over THIS region's scan (reference
+    datanode/src/region_server.rs:245-316 — decode substrait against a
+    region-scoped catalog, execute on the local query engine)."""
+    from ..query.cpu_exec import CpuExecutor
+    from ..query.plan_wire import plan_from_dict
+
+    plan = plan_from_dict(plan_dict)
+
+    def scan_provider(scan):
+        pred = ScanPredicate(
+            time_range=scan.time_range,
+            filters=[tuple(f) for f in scan.filters],
+        )
+        t = engine.scan(rid, pred)
+        if scan.projection:
+            t = t.select([c for c in scan.projection if c in t.column_names])
+        return t
+
+    return CpuExecutor(scan_provider).execute(plan)
 
 
 class DatanodeFlightServer(fl.FlightServerBase):
@@ -78,7 +106,10 @@ class DatanodeFlightServer(fl.FlightServerBase):
 
     # ---- reads (do_get) ---------------------------------------------------
     def do_get(self, context, ticket: fl.Ticket):
-        rid, pred, projection, agg = decode_scan_ticket(ticket.ticket)
+        rid, pred, projection, agg, plan = decode_scan_ticket(ticket.ticket)
+        if plan is not None:
+            # general sub-plan: bounded rows back, never the raw region
+            return fl.RecordBatchStream(execute_region_plan(self.engine, rid, plan))
         table = self.engine.scan(rid, pred)
         if agg is not None:
             from ..query.dist_agg import AggSpec, partial_states
@@ -231,6 +262,17 @@ class FlightDatanodeClient:
         except fl.FlightError as e:
             raise ConnectionError(f"datanode {self.node_id}: {e}") from e
 
+    def execute_plan(self, rid: int, plan_dict: dict) -> pa.Table:
+        if not self.alive:
+            raise ConnectionError(f"datanode {self.node_id} is down")
+        ticket = fl.Ticket(
+            encode_scan_ticket(rid, ScanPredicate(), plan=plan_dict)
+        )
+        try:
+            return self._client.do_get(ticket).read_all()
+        except fl.FlightError as e:
+            raise ConnectionError(f"datanode {self.node_id}: {e}") from e
+
     def kill(self):
         self.alive = False
 
@@ -281,6 +323,9 @@ class FlightDatanode:
 
     def partial_agg(self, rid: int, pred: ScanPredicate, spec_dict: dict) -> pa.Table:
         return self.client.partial_agg(rid, pred, spec_dict)
+
+    def execute_plan(self, rid: int, plan_dict: dict) -> pa.Table:
+        return self.client.execute_plan(rid, plan_dict)
 
     def region_stats(self) -> list:
         return self.client.region_stats()

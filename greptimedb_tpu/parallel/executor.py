@@ -162,14 +162,21 @@ def _apply_filters(plan: DistGroupByPlan, columns, mask, values=None):
     return mask
 
 
-def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=None, perm=None):
+def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=None, perm=None, count_cols=None):
     """Shared lower/state stage: mask -> group ids -> partial AggStates.
     No collectives — callers merge across devices (psum) or across tile
     sources (merge_states).  `dyn` optionally carries runtime-dynamic plan
     parameters: {'filter_values', 'bucket_origin', 'bucket_interval'} —
     only shapes (cards, n_buckets, filter structure) stay compile-static.
     `perm` (time-major plans) re-gathers every per-row array into
-    ts-ascending order first, so bucket-composed gids are sorted."""
+    ts-ascending order first, so bucket-composed gids are sorted.
+    `count_cols` fixes WHICH columns carry their own null-gated count
+    pass: multi-source callers (the tile program) must pass the union
+    decision so every source produces structurally identical AggStates —
+    deciding per-source from `col in nulls` made merge_states silently
+    drop counts (or crash) when sources disagreed on a column's
+    nullability.  None = decide from this source's nulls (single-source
+    mesh path)."""
     acc = jnp.float64 if plan.acc_dtype == "float64" else jnp.float32
     if perm is not None:
         columns = {k: v[perm] for k, v in columns.items()}
@@ -234,36 +241,53 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
     for func, col in plan.agg_specs:
         per_col_aggs.setdefault(col, set()).add(_FUNC_TO_KERNEL[func])
     states = {}
-    ones = jnp.ones(valid.shape, dtype=acc)
     groups: dict[tuple, list[str]] = {}
     for col, aggs in per_col_aggs.items():
-        key = tuple(sorted(aggs | {"count"}))
-        if "last" in key:
+        if "last" in aggs:
             # LAST has no reshape-reduce fold; the planner never builds a
             # hierarchical plan with last_value
+            key = tuple(sorted(aggs | {"count"}))
             col_mask = mask & nulls[col] if col in nulls else mask
             states[col] = fold(segment_aggregate(
                 columns[col], gids, n_internal, key,
                 mask=col_mask, ts=ts, acc_dtype=acc, span=plan.block_span,
             ))
-        else:
-            groups.setdefault(key, []).append(col)
+            continue
+        # Count-pass sharing: for a column with NO null mask, its count
+        # equals the group presence count, so the per-column kernel skips
+        # the count pass entirely — at TSBS scale (10 avg columns, no
+        # nulls) this halves device work.  Null-bearing columns keep their
+        # own count (SQL NULL-gating).  count(*) is presence by definition.
+        if col == COUNT_STAR:
+            continue  # presence covers it
+        null_gated = (col in count_cols) if count_cols is not None else (col in nulls)
+        kernel_aggs = set()
+        if "sum" in aggs or "avg" in aggs:
+            kernel_aggs.add("sum")
+        if "min" in aggs:
+            kernel_aggs.add("min")
+        if "max" in aggs:
+            kernel_aggs.add("max")
+        if null_gated:
+            kernel_aggs.add("count")
+        elif not kernel_aggs:
+            continue  # count(col) on a non-null column: presence covers it
+        groups.setdefault(tuple(sorted(kernel_aggs)), []).append(col)
     # group presence (independent of value nulls) rides along as a
-    # pseudo-column of ones in a ("count",)-only group
+    # pseudo-column whose "values" are the mask itself
     groups.setdefault(("count",), []).append("__presence")
     for key, cols in groups.items():
-        vals = jnp.stack(
-            [
-                ones if c in ("__presence", COUNT_STAR) else columns[c].astype(acc)
-                for c in cols
-            ]
-        )
-        col_masks = jnp.stack(
-            [
-                mask & nulls[c] if c in nulls else mask
-                for c in cols
-            ]
-        )
+        # per-column lists, never a stacked [C, n] (HBM: see
+        # segment_aggregate_multi); count-only pseudo-columns reuse the
+        # mask as a dummy values array — counts come from the mask alone
+        vals = [
+            mask if c in ("__presence", COUNT_STAR) else columns[c].astype(acc)
+            for c in cols
+        ]
+        col_masks = [
+            mask & nulls[c] if c in nulls else mask
+            for c in cols
+        ]
         multi = segment_aggregate_multi(
             vals, gids, n_internal, key, col_masks, mask, acc_dtype=acc,
             span=plan.block_span,
@@ -434,7 +458,10 @@ def distributed_groupby(
     valid_stacked = jnp.stack([b.valid for b in batches])
     ones = jnp.ones(padded, dtype=bool)
     nulls_stacked = {
-        c: jnp.stack([b.nulls.get(c, ones) for b in batches]) for c in value_cols
+        c: jnp.stack([b.nulls.get(c, ones) for b in batches])
+        for c in value_cols
+        if any(c in b.nulls for b in batches)  # all-ones masks would defeat
+        # count-pass sharing and ship [D, N] bools for nothing
     }
 
     # 5. Encode filter literals to codes; quantize cardinalities.
@@ -472,16 +499,22 @@ def distributed_groupby(
     per_col_aggs: dict[str, set] = {}
     for func, col in norm_specs:
         per_col_aggs.setdefault(col, set()).add(_FUNC_TO_KERNEL[func])
+    presence = states["__presence"].counts
     finals = {
-        col: finalize(states[col], tuple(sorted(aggs | {"count"})))
+        col: finalize(states[col], tuple(sorted(aggs)), counts=presence)
         for col, aggs in per_col_aggs.items()
+        if col in states
     }
-    non_empty = np.asarray(states["__presence"].counts) > 0
+    presence_np = np.asarray(presence)
+    non_empty = presence_np > 0
     for func, col in norm_specs:
-        out = finals[col]
+        out = finals.get(col, {})
         kernel = _FUNC_TO_KERNEL[func]
-        arr = np.asarray(out[kernel])
-        col_count = np.asarray(out["count"])
+        arr = out.get(kernel)
+        if arr is None and kernel == "count":
+            arr = presence_np  # count-pass sharing: presence IS the count
+        arr = np.asarray(arr)
+        col_count = np.asarray(out.get("count", presence_np))
         if col == COUNT_STAR:
             outputs["count(*)"] = arr.astype(np.int64)
         elif func == "count":

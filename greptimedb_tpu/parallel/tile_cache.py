@@ -679,25 +679,32 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
     for col, aggs in per_col_aggs.items():
         for agg in sorted(aggs):
             if agg == "count":
-                continue  # handled below
+                continue  # count rides the int buffer (or presence)
             acc_layout.append((col, agg))
-        if "count" in aggs or (col in nullable_cols and col != COUNT_STAR):
+        # a per-column count row ships only when the column carries its
+        # own null-gated count; otherwise presence substitutes exactly
+        # (count-pass sharing, see compute_partial_states)
+        if col in nullable_cols and col != COUNT_STAR:
             int_layout.append((col, "count"))
 
     def run_all(sources, dyn):
         merged = None
         for cols, valid, nulls, perm in sources:
-            states = compute_partial_states(plan, cols, valid, nulls, dyn, perm=perm)
+            states = compute_partial_states(
+                plan, cols, valid, nulls, dyn, perm=perm, count_cols=nullable_cols
+            )
             merged = (
                 states
                 if merged is None
                 else {k: merge_states(merged[k], states[k]) for k in merged}
             )
-        outs = {
-            col: finalize(merged[col], tuple(sorted(aggs | {"count"})))
-            for col, aggs in per_col_aggs.items()
-        }
-        outs["__presence"] = {"count": merged["__presence"].counts}
+        presence = merged["__presence"].counts
+        outs = {"__presence": {"count": presence}}
+        for col, aggs in per_col_aggs.items():
+            if col in merged:
+                outs[col] = finalize(
+                    merged[col], tuple(sorted(aggs)), counts=presence
+                )
         ints = jnp.stack(
             [outs[col][agg].astype(jnp.int32) for col, agg in int_layout]
         )
@@ -1415,9 +1422,12 @@ class TileExecutor:
         presence = finals["__presence"]["count"]
         non_empty = presence > 0
         for func, col in plan.agg_specs:
-            out = finals[col]
+            out = finals.get(col, {})
             kernel = _FUNC_TO_KERNEL[func]
-            arr = np.asarray(out[kernel])
+            arr = out.get(kernel)
+            if arr is None and kernel == "count":
+                arr = presence  # count-pass sharing: presence IS the count
+            arr = np.asarray(arr)
             # NULL gating: nullable columns carry their own count row;
             # non-nullable columns have count == presence by construction
             col_count = out.get("count", presence)

@@ -39,6 +39,7 @@ class QueryEngine:
         partial_agg_provider=None,
         view_provider=None,
         vector_search_provider=None,
+        subplan_provider=None,
     ):
         """
         schema_provider(table, database) -> Schema
@@ -59,6 +60,7 @@ class QueryEngine:
         self._time_bounds = time_bounds_provider
         self._tile_ctx = tile_context_provider
         self._partial_agg = partial_agg_provider
+        self._subplan = subplan_provider
         self.tile_cache = None
         self._tile_executor = None
         if self.config.tile_cache_enable and tile_context_provider is not None:
@@ -168,6 +170,27 @@ class QueryEngine:
                             schema,
                             time_bounds=lambda: self._time_bounds(scan.table, scan.database),
                         )
+            if self._subplan is not None:
+                # general sub-plan shipping: push the maximal commutative
+                # prefix (filter/project/sort/limit) below the region-merge
+                # boundary so datanodes return BOUNDED rows instead of the
+                # raw region (reference dist_plan/analyzer.rs:97 +
+                # substrait shipping; ORDER BY ... LIMIT ships n x limit
+                # rows, not the table)
+                from .plan_wire import split_for_regions
+
+                split = split_for_regions(plan)
+                if split is not None:
+                    from .analyze import stage as _stage
+
+                    with _stage("dist.subplan") as info:
+                        tables = self._subplan(split.scan, split.ship)
+                        info["nodes"] = len(tables)
+                        info["rows_shipped"] = sum(t.num_rows for t in tables)
+                        info["bytes_shipped"] = sum(t.nbytes for t in tables)
+                        info["categories"] = ",".join(split.categories)
+                    backend = "dist_subplan"
+                    return _merge_subplan_results(tables, split)
             with span("query.cpu"):
                 return self.cpu.execute(plan)
         except Exception:
@@ -249,6 +272,27 @@ class QueryEngine:
                 backend = "tpu"
         collector.add("output", 0.0, {"rows": result.num_rows}, depth=0)
         return render(collector, plan.describe().split("\n"), total_ms, backend)
+
+
+def _merge_subplan_results(tables, split) -> pa.Table:
+    """Frontend side of the sub-plan boundary: concatenate the bounded
+    region results and re-apply merge sort + exact offset/limit (reference
+    MergeScanExec stream merge + the upper plan, merge_scan.rs:186)."""
+    from .logical_plan import Limit, Sort, TableScan
+
+    non_empty = [t for t in tables if t.num_rows]
+    if non_empty:
+        merged = pa.concat_tables(non_empty, promote_options="permissive")
+    else:
+        merged = tables[0] if tables else pa.table({})
+    plan: object = TableScan(table="__merged")
+    if split.merge_sort:
+        plan = Sort(plan, split.merge_sort)
+    if split.limit is not None:
+        plan = Limit(plan, split.limit, split.offset)
+    if isinstance(plan, TableScan):
+        return merged
+    return CpuExecutor(lambda _scan: merged).execute(plan)
 
 
 def _x64_enabled() -> bool:

@@ -1,0 +1,346 @@
+"""Logical-plan wire format + commutativity split for distributed reads.
+
+Role-equivalent of the reference's substrait plan shipping plus the
+distributed planner's commutativity framework:
+
+  reference                                   here
+  ---------                                   ----
+  DFLogicalSubstraitConvertor                 plan_to_dict / plan_from_dict
+    (common/substrait/src/df_substrait.rs)      (JSON-able dicts on the
+                                                 Flight ticket)
+  Commutativity categories                    `categorize` (commutative /
+    (query/src/dist_plan/commutativity.rs:76)   partial / none)
+  DistPlannerAnalyzer boundary walk           `split_for_regions`
+    (query/src/dist_plan/analyzer.rs:97)
+  MergeScan fan-out + frontend upper plan     engine's dist.subplan stage
+
+The split pushes the maximal plan prefix BELOW the region-merge boundary:
+Filter/Project ship verbatim (row-local, complete per region);
+Sort ships and is re-merged at the frontend (partial commutative);
+Limit ships as limit+offset per region — every region returns at most
+that many rows, so the frontend concatenates bounded inputs and re-applies
+sort/offset/limit exactly.  Aggregates are NOT handled here — the engine's
+state-shipping path (query/dist_agg.py) is the TransformedCommutative
+equivalent and runs first.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .expr import (
+    AggCall,
+    Alias,
+    Between,
+    BinaryOp,
+    Column,
+    Expr,
+    FuncCall,
+    InList,
+    IsNull,
+    Literal,
+    Star,
+    UnaryOp,
+)
+from .logical_plan import (
+    Filter,
+    Limit,
+    LogicalPlan,
+    Project,
+    Sort,
+    TableScan,
+)
+
+# ---- expression (de)serialization ------------------------------------------
+
+_EXPR_KINDS: dict[str, type] = {}
+
+
+def expr_to_dict(e: Expr) -> dict | None:
+    """Expr -> JSON-able dict, or None when the expr can't ship (subqueries,
+    window calls — those keep the plan frontend-side)."""
+    if isinstance(e, Column):
+        return {"k": "col", "name": e.column}
+    if isinstance(e, Literal):
+        v = e.value
+        if not isinstance(v, (int, float, str, bool, type(None))):
+            return None
+        return {"k": "lit", "value": v}
+    if isinstance(e, BinaryOp):
+        l, r = expr_to_dict(e.left), expr_to_dict(e.right)
+        if l is None or r is None:
+            return None
+        return {"k": "bin", "op": e.op, "left": l, "right": r}
+    if isinstance(e, UnaryOp):
+        x = expr_to_dict(e.operand)
+        if x is None:
+            return None
+        return {"k": "un", "op": e.op, "operand": x}
+    if isinstance(e, InList):
+        x = expr_to_dict(e.expr)
+        vals = [expr_to_dict(v) if isinstance(v, Expr) else {"k": "lit", "value": v} for v in e.values]
+        if x is None or any(v is None for v in vals):
+            return None
+        return {"k": "in", "expr": x, "values": vals, "negated": e.negated}
+    if isinstance(e, Between):
+        x, lo, hi = expr_to_dict(e.expr), expr_to_dict(e.low), expr_to_dict(e.high)
+        if x is None or lo is None or hi is None:
+            return None
+        return {"k": "between", "expr": x, "low": lo, "high": hi, "negated": e.negated}
+    if isinstance(e, IsNull):
+        x = expr_to_dict(e.expr)
+        if x is None:
+            return None
+        return {"k": "isnull", "expr": x, "negated": e.negated}
+    if isinstance(e, FuncCall):
+        args = [expr_to_dict(a) for a in e.args]
+        if any(a is None for a in args):
+            return None
+        return {"k": "func", "func": e.func, "args": args}
+    if isinstance(e, Alias):
+        x = expr_to_dict(e.expr)
+        if x is None:
+            return None
+        return {"k": "alias", "expr": x, "alias": e.alias}
+    if isinstance(e, Star):
+        return {"k": "star"}
+    if isinstance(e, AggCall):
+        return None  # aggregates ship via the state path, not this one
+    return None
+
+
+def expr_from_dict(d: dict) -> Expr:
+    k = d["k"]
+    if k == "col":
+        return Column(d["name"])
+    if k == "lit":
+        return Literal(d["value"])
+    if k == "bin":
+        return BinaryOp(d["op"], expr_from_dict(d["left"]), expr_from_dict(d["right"]))
+    if k == "un":
+        return UnaryOp(d["op"], expr_from_dict(d["operand"]))
+    if k == "in":
+        return InList(
+            expr_from_dict(d["expr"]),
+            tuple(expr_from_dict(v) for v in d["values"]),
+            d["negated"],
+        )
+    if k == "between":
+        return Between(
+            expr_from_dict(d["expr"]),
+            expr_from_dict(d["low"]),
+            expr_from_dict(d["high"]),
+            d["negated"],
+        )
+    if k == "isnull":
+        return IsNull(expr_from_dict(d["expr"]), d["negated"])
+    if k == "func":
+        return FuncCall(d["func"], tuple(expr_from_dict(a) for a in d["args"]))
+    if k == "alias":
+        return Alias(expr_from_dict(d["expr"]), d["alias"])
+    if k == "star":
+        return Star()
+    raise ValueError(f"unknown expr kind {k!r}")
+
+
+# ---- plan (de)serialization -------------------------------------------------
+
+
+def plan_to_dict(plan: LogicalPlan) -> dict | None:
+    """Shippable sub-plan -> dict, or None if any node can't ship."""
+    if isinstance(plan, TableScan):
+        return {
+            "k": "scan",
+            "table": plan.table,
+            "database": plan.database,
+            "time_range": list(plan.time_range) if plan.time_range else None,
+            "filters": [list(f) for f in plan.filters],
+            "projection": list(plan.projection) if plan.projection else None,
+        }
+    if isinstance(plan, Filter):
+        child = plan_to_dict(plan.input)
+        pred = expr_to_dict(plan.predicate)
+        if child is None or pred is None:
+            return None
+        return {"k": "filter", "input": child, "predicate": pred}
+    if isinstance(plan, Project):
+        child = plan_to_dict(plan.input)
+        exprs = [expr_to_dict(e) for e in plan.exprs]
+        if child is None or any(e is None for e in exprs):
+            return None
+        return {"k": "project", "input": child, "exprs": exprs}
+    if isinstance(plan, Sort):
+        child = plan_to_dict(plan.input)
+        keys = [(expr_to_dict(e), asc) for e, asc in plan.keys]
+        if child is None or any(k[0] is None for k in keys):
+            return None
+        return {"k": "sort", "input": child, "keys": [[k, a] for k, a in keys]}
+    if isinstance(plan, Limit):
+        child = plan_to_dict(plan.input)
+        if child is None:
+            return None
+        return {"k": "limit", "input": child, "limit": plan.limit, "offset": plan.offset}
+    return None
+
+
+def plan_from_dict(d: dict) -> LogicalPlan:
+    k = d["k"]
+    if k == "scan":
+        return TableScan(
+            table=d["table"],
+            database=d.get("database", "public"),
+            time_range=tuple(d["time_range"]) if d.get("time_range") else None,
+            filters=[tuple(f) for f in d.get("filters", [])],
+            projection=d.get("projection"),
+        )
+    if k == "filter":
+        return Filter(plan_from_dict(d["input"]), expr_from_dict(d["predicate"]))
+    if k == "project":
+        return Project(plan_from_dict(d["input"]), [expr_from_dict(e) for e in d["exprs"]])
+    if k == "sort":
+        return Sort(
+            plan_from_dict(d["input"]),
+            [(expr_from_dict(kd), asc) for kd, asc in d["keys"]],
+        )
+    if k == "limit":
+        return Limit(plan_from_dict(d["input"]), d["limit"], d.get("offset", 0))
+    raise ValueError(f"unknown plan kind {k!r}")
+
+
+# ---- commutativity split ----------------------------------------------------
+
+
+@dataclass
+class DistSplit:
+    """The boundary decision: `ship` runs on every region's datanode; the
+    frontend concatenates the region results and re-applies `merge_sort`
+    then offset/limit to produce exact results from bounded inputs."""
+
+    ship: dict  # plan_to_dict of the datanode sub-plan
+    scan: TableScan  # the underlying scan (for routing)
+    merge_sort: list | None = None  # Sort keys to re-apply after concat
+    limit: int | None = None
+    offset: int = 0
+    categories: list[str] = field(default_factory=list)  # for EXPLAIN
+
+
+def split_for_regions(plan: LogicalPlan) -> DistSplit | None:
+    """Walk the root chain and push the maximal commutative prefix below
+    the region boundary (reference analyzer.rs:97 walk with
+    commutativity.rs categories).  Returns None when nothing beyond a raw
+    scan would ship (caller falls back to row pull) or when the plan shape
+    isn't a simple chain over one scan."""
+    # Collect the chain root -> scan.
+    chain: list[LogicalPlan] = []
+    node = plan
+    while isinstance(node, (Filter, Project, Sort, Limit)):
+        chain.append(node)
+        node = node.children()[0]
+    if not isinstance(node, TableScan):
+        return None
+    scan = node
+
+    # Build bottom-up, pushing while commutative.  A Sort is only worth
+    # shipping when a Limit rides above it (then each region returns at
+    # most limit+offset rows); a bare Sort stays frontend-side — the
+    # per-region sort would be wasted work since the concat is re-sorted
+    # anyway.  Filters/Projects commute with an un-pushed Sort (row-local)
+    # and keep shipping below it.
+    pushed: LogicalPlan = scan
+    cats: list[str] = []
+    pending_sort: list | None = None
+    merge_sort = None
+    limit = None
+    offset = 0
+    for op in reversed(chain):
+        if limit is not None:
+            return None  # nothing pushes above a Limit; shape unsupported
+        if isinstance(op, Filter):
+            if expr_to_dict(op.predicate) is None:
+                return None
+            pushed = Filter(pushed, op.predicate)
+            cats.append("filter:commutative")
+        elif isinstance(op, Project):
+            if any(expr_to_dict(e) is None for e in op.exprs):
+                return None
+            keys = pending_sort if pending_sort is not None else merge_sort
+            if keys is not None and not _sort_keys_rebind_safely(keys, op.exprs):
+                # reordering this Project relative to the sort (deferred
+                # push, or the frontend re-merge) is only sound when every
+                # sort-key column passes through the projection as ITSELF —
+                # an alias shadowing a base column (SELECT -v AS v ...
+                # ORDER BY v) would silently invert the order, and a
+                # dropped key column would make the upper sort unevaluable
+                return None
+            pushed = Project(pushed, op.exprs)
+            cats.append("project:commutative")
+        elif isinstance(op, Sort):
+            if any(expr_to_dict(e) is None for e, _a in op.keys):
+                return None
+            pending_sort = op.keys
+        elif isinstance(op, Limit):
+            if op.limit is None:
+                return None  # OFFSET without LIMIT: rows unbounded
+            if pending_sort is not None:
+                pushed = Sort(pushed, pending_sort)
+                merge_sort = pending_sort
+                pending_sort = None
+                cats.append("sort:partial(re-merged)")
+            # per-region limit+offset bounds shipped rows; the frontend
+            # re-sorts the concat and applies exact offset/limit
+            pushed = Limit(pushed, op.limit + op.offset, 0)
+            limit = op.limit
+            offset = op.offset
+            cats.append("limit:partial(bounded)")
+    if pending_sort is not None:
+        # bare ORDER BY: regions ship unsorted, the frontend sorts once
+        merge_sort = pending_sort
+        cats.append("sort:frontend")
+    if isinstance(pushed, TableScan):
+        return None  # nothing pushed beyond the scan: plain row pull
+    ship = plan_to_dict(pushed)
+    if ship is None:
+        return None
+    return DistSplit(
+        ship=ship,
+        scan=scan,
+        merge_sort=merge_sort,
+        limit=limit,
+        offset=offset,
+        categories=cats,
+    )
+
+
+def _columns_of(e: Expr) -> set[str]:
+    out: set[str] = set()
+    if isinstance(e, Column):
+        out.add(e.column)
+    for c in e.children():
+        out |= _columns_of(c)
+    return out
+
+
+def _sort_keys_rebind_safely(keys: list, project_exprs: list) -> bool:
+    """True when every column the sort keys reference passes through the
+    projection AS ITSELF (`c` or `c AS c`), so evaluating the keys before
+    or after the projection is identical.  A key column that is dropped,
+    or whose name is shadowed by a different expression, fails."""
+    identity: set[str] = set()
+    shadowed: set[str] = set()
+    has_star = False
+    for e in project_exprs:
+        if isinstance(e, Star):
+            has_star = True
+            continue
+        inner = e.expr if isinstance(e, Alias) else e
+        name = e.name()
+        if isinstance(inner, Column) and inner.column == name:
+            identity.add(name)
+        else:
+            shadowed.add(name)
+    needed: set[str] = set()
+    for e, _asc in keys:
+        needed |= _columns_of(e)
+    return all(
+        (c in identity or has_star) and c not in shadowed for c in needed
+    )

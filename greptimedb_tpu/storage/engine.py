@@ -39,12 +39,12 @@ class TimeSeriesEngine:
         self.object_store = build_object_store(self.config)
         provider = getattr(self.config, "wal_provider", "local")
         if provider == "local":
-            self.wal_mgr = WalManager(self.config.wal_dir, fsync=self.config.wal_fsync)
+            self.wal_mgr = WalManager(self.config.effective_wal_dir(), fsync=self.config.wal_fsync)
         elif provider == "shared_file":
             from .remote_wal import RemoteWalManager
 
             self.wal_mgr = RemoteWalManager(
-                self.config.wal_dir,
+                self.config.effective_wal_dir(),
                 fsync=self.config.wal_fsync,
                 num_topics=getattr(self.config, "wal_num_topics", 4),
                 segment_bytes=getattr(self.config, "wal_segment_mb", 4) << 20,
@@ -236,7 +236,7 @@ class TimeSeriesEngine:
 
     # ---- helpers ----------------------------------------------------------
     def _region_dir(self, region_id: int) -> str:
-        return os.path.join(self.config.sst_dir, f"region_{region_id}")
+        return os.path.join(self.config.effective_sst_dir(), f"region_{region_id}")
 
     def _region_store(self, region_id: int):
         return self.object_store.scoped(f"region_{region_id}")

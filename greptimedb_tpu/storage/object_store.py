@@ -521,7 +521,7 @@ def build_object_store(cfg) -> ObjectStore:
     (reference object-store/src/{config,factory}.rs)."""
     kind = getattr(cfg, "store_type", "fs")
     if kind == "fs":
-        store: ObjectStore = FsObjectStore(cfg.sst_dir)
+        store: ObjectStore = FsObjectStore(cfg.effective_sst_dir())
     elif kind == "memory":
         store = MemoryObjectStore()
         if getattr(cfg, "write_cache_enable", False):

@@ -79,10 +79,21 @@ class StorageConfig:
     write_cache_capacity_mb: int = 512
 
     def __post_init__(self):
-        if not self.wal_dir:
-            self.wal_dir = os.path.join(self.data_home, "wal")
-        if not self.sst_dir:
-            self.sst_dir = os.path.join(self.data_home, "data")
+        # NOTE: wal_dir/sst_dir stay EMPTY unless explicitly set — they are
+        # derived from data_home at USE time (effective_*), so mutating
+        # data_home after construction keeps all three consistent.  Baking
+        # them here made every Database whose caller set data_home late
+        # share the DEFAULT ./greptimedb_data storage — colliding region
+        # ids across supposedly-isolated instances (recovered the wrong
+        # region's manifest; observed as cross-database data bleed in the
+        # sqlness runner under load).
+        pass
+
+    def effective_wal_dir(self) -> str:
+        return self.wal_dir or os.path.join(self.data_home, "wal")
+
+    def effective_sst_dir(self) -> str:
+        return self.sst_dir or os.path.join(self.data_home, "data")
 
 
 @dataclasses.dataclass
@@ -195,14 +206,6 @@ class Config:
     @classmethod
     def _from_dict(cls, d: dict) -> "Config":
         cfg = cls()
-        # cls() already derived wal/sst dirs from the default data_home;
-        # reset them so __post_init__ re-derives from the loaded one unless
-        # the overlay pins them explicitly.
-        storage_overlay = d.get("storage", {})
-        if "wal_dir" not in storage_overlay:
-            cfg.storage.wal_dir = ""
-        if "sst_dir" not in storage_overlay:
-            cfg.storage.sst_dir = ""
         for section_field in dataclasses.fields(cls):
             section = getattr(cfg, section_field.name)
             overlay = d.get(section_field.name, {})

@@ -33,7 +33,7 @@ EVENTS_TABLE = "events"
 # (tags, ts) last-write-wins, so without it two events in the same
 # millisecond would silently collapse to one.
 _SLOW_QUERY_DDL = (
-    f"CREATE TABLE IF NOT EXISTS {SLOW_QUERY_TABLE} ("
+    f"CREATE TABLE IF NOT EXISTS {EVENTS_DATABASE}.{SLOW_QUERY_TABLE} ("
     "  seq STRING,"
     "  cost_time_ms BIGINT,"
     "  threshold_ms BIGINT,"
@@ -47,7 +47,7 @@ _SLOW_QUERY_DDL = (
 )
 
 _EVENTS_DDL = (
-    f"CREATE TABLE IF NOT EXISTS {EVENTS_TABLE} ("
+    f"CREATE TABLE IF NOT EXISTS {EVENTS_DATABASE}.{EVENTS_TABLE} ("
     "  seq STRING,"
     "  event_type STRING,"
     "  payload STRING,"
@@ -130,16 +130,16 @@ class EventRecorder:
     def _ensure_tables(self):
         if self._ready:
             return
-        prev = self.db.current_database
-        try:
-            if EVENTS_DATABASE not in self.db.catalog.databases():
-                self.db.catalog.create_database(EVENTS_DATABASE, if_not_exists=True)
-            self.db.current_database = EVENTS_DATABASE
-            self.db.sql(_SLOW_QUERY_DDL)
-            self.db.sql(_EVENTS_DDL)
-            self._ready = True
-        finally:
-            self.db.current_database = prev
+        # database-qualified DDL: this runs on the recorder THREAD, so it
+        # must never touch db.current_database — flipping shared session
+        # state from a background thread made concurrent foreground queries
+        # resolve tables in greptime_private (observed: a UNION branch scan
+        # returning slow_queries rows under load)
+        if EVENTS_DATABASE not in self.db.catalog.databases():
+            self.db.catalog.create_database(EVENTS_DATABASE, if_not_exists=True)
+        self.db.sql(_SLOW_QUERY_DDL)
+        self.db.sql(_EVENTS_DDL)
+        self._ready = True
 
     def _run(self):
         pending: dict[str, list[dict]] = {}

@@ -1,0 +1,81 @@
+"""Regenerate the upgrade-compat fixture (tests/fixtures/upgrade_r3/).
+
+Run manually when the on-disk format changes INTENTIONALLY:
+    PYTHONPATH=/root/repo:$PYTHONPATH python tests/make_upgrade_fixture.py
+
+The committed fixture is a small data directory written by the code at the
+time of its creation; test_upgrade_compat.py opens it with CURRENT code and
+re-runs the golden queries — the same insurance as the reference's
+tests/upgrade-compat/ (RFC 2025-07-04-compatibility-test-framework.md):
+an accidental format break fails loudly instead of corrupting old data.
+"""
+
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures", "upgrade_r3")
+
+GOLDEN_QUERIES = [
+    "SELECT host, count(*) AS c, avg(v) AS a FROM cpu GROUP BY host ORDER BY host",
+    "SELECT host, time_bucket('30s', ts) AS tb, max(v) AS m FROM cpu"
+    " GROUP BY host, tb ORDER BY host, tb",
+    "SELECT count(*) AS n FROM cpu WHERE v > 50",
+    "SELECT host, last_value(v ORDER BY ts) AS lv FROM cpu GROUP BY host ORDER BY host",
+    "SELECT * FROM logs ORDER BY ts",
+]
+
+
+def build(path: str):
+    from greptimedb_tpu.database import Database
+
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    db = Database(data_home=path)
+    db.sql(
+        "CREATE TABLE cpu (host STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE,"
+        " PRIMARY KEY (host))"
+    )
+    rows = []
+    for t in range(90):
+        for h in range(5):
+            rows.append(f"('h{h}', {t * 1000}, {(t * 7 + h * 13) % 100})")
+    db.sql("INSERT INTO cpu VALUES " + ",".join(rows))
+    db.sql("ADMIN flush_table('cpu')")
+    # second write AFTER a flush: fixture holds SST + WAL-replayable tail
+    db.sql("INSERT INTO cpu VALUES ('h0', 100000, 1.5), ('h9', 101000, 2.5)")
+    db.sql(
+        "CREATE TABLE logs (svc STRING, ts TIMESTAMP(3) TIME INDEX, msg STRING,"
+        " PRIMARY KEY (svc))"
+    )
+    db.sql("INSERT INTO logs VALUES ('api', 1000, 'started'), ('api', 2000, 'ready')")
+    db.sql("ADMIN flush_table('logs')")
+
+    goldens = {}
+    for q in GOLDEN_QUERIES:
+        t = db.sql_one(q)
+        goldens[q] = {
+            "columns": t.column_names,
+            "rows": [[_norm(v) for v in row] for row in zip(*[t[c].to_pylist() for c in t.column_names])],
+        }
+    db.close()
+    with open(os.path.join(path, "GOLDENS.json"), "w") as f:
+        json.dump(goldens, f, indent=1, default=str)
+    print(f"fixture written to {path}")
+
+
+def _norm(v):
+    if hasattr(v, "isoformat"):
+        return v.isoformat()
+    if isinstance(v, float):
+        return round(v, 9)
+    return v
+
+
+if __name__ == "__main__":
+    build(FIXTURE)

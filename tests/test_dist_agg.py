@@ -173,3 +173,123 @@ def test_cluster_ships_states_not_rows(tmp_path, transport):
         assert sum(t.num_rows for t in states) < raw.num_rows / 4
     finally:
         cluster.close()
+
+
+@pytest.mark.parametrize("transport", ["inprocess", "flight"])
+def test_cluster_ships_subplans_bounded_rows(tmp_path, transport):
+    """Non-aggregate distributed queries ship a serialized sub-plan
+    (filter/sort/limit) below the region boundary: datanodes return at
+    most limit+offset rows each, never the raw region (reference
+    dist_plan/analyzer.rs + df_substrait.rs)."""
+    from greptimedb_tpu.query.plan_wire import plan_from_dict, split_for_regions
+
+    cluster = Cluster(str(tmp_path / transport), num_datanodes=2, transport=transport)
+    try:
+        cluster.create_table("cpu", _schema(), partitions=2)
+        for s in range(4):
+            cluster.insert("cpu", _batch(800, seed=s))
+        total_rows = 3200
+
+        q = "SELECT host, ts, v FROM cpu WHERE v > 10 ORDER BY v DESC LIMIT 5"
+        result = cluster.query(q)
+        assert result.num_rows == 5
+        vs = result["v"].to_pylist()
+        assert vs == sorted(vs, reverse=True)
+        # authoritative: central sort over raw rows
+        from greptimedb_tpu.query.logical_plan import TableScan
+
+        raw = pa.concat_tables(
+            cluster._region_scan(TableScan(table="cpu", database="public"))
+        )
+        want = sorted((v for v in raw["v"].to_pylist() if v > 10), reverse=True)[:5]
+        for x, y in zip(vs, want):
+            assert math.isclose(x, y, rel_tol=1e-12)
+
+        # wire-boundary assertion: each region returns <= limit rows
+        from greptimedb_tpu.query.sql_parser import parse_sql
+        from greptimedb_tpu.query.planner import plan_query
+
+        plan, _schema_out = plan_query(
+            parse_sql(q)[0], lambda t, d: cluster.catalog.table(t, d).schema, "public"
+        )
+        split = split_for_regions(plan)
+        assert split is not None and split.limit == 5
+        shipped = cluster._sub_plan(split.scan, split.ship)
+        assert all(t.num_rows <= 5 for t in shipped), [t.num_rows for t in shipped]
+        assert sum(t.num_rows for t in shipped) < total_rows / 10
+
+        # filtered non-agg scan ships filtered rows only
+        q2 = "SELECT host, v FROM cpu WHERE v > 99.5"
+        r2 = cluster.query(q2)
+        assert all(v > 99.5 for v in r2["v"].to_pylist())
+        plan2, _s2 = plan_query(
+            parse_sql(q2)[0], lambda t, d: cluster.catalog.table(t, d).schema, "public"
+        )
+        split2 = split_for_regions(plan2)
+        if split2 is not None:
+            shipped2 = cluster._sub_plan(split2.scan, split2.ship)
+            assert sum(t.num_rows for t in shipped2) == r2.num_rows
+            assert sum(t.num_rows for t in shipped2) < total_rows / 10
+    finally:
+        cluster.close()
+
+
+def test_explain_analyze_shows_subplan_stage(tmp_path):
+    cluster = Cluster(str(tmp_path / "ea"), num_datanodes=2)
+    try:
+        cluster.create_table("cpu", _schema(), partitions=2)
+        cluster.insert("cpu", _batch(500))
+        from greptimedb_tpu.query.sql_parser import parse_sql
+
+        stmt = parse_sql(
+            "SELECT host, v FROM cpu WHERE v > 50 ORDER BY v DESC LIMIT 3"
+        )[0]
+        table = cluster.query_engine.explain_analyze(stmt, "public")
+        text = "\n".join(str(v) for v in table.column(0).to_pylist())
+        assert "dist.subplan" in text, text
+    finally:
+        cluster.close()
+
+
+def test_subplan_split_edge_shapes(tmp_path):
+    """Shapes the commutativity split must refuse or handle exactly:
+    OFFSET without LIMIT, projections dropping sort keys, bare ORDER BY."""
+    from greptimedb_tpu.query.plan_wire import split_for_regions
+    from greptimedb_tpu.query.planner import plan_query
+    from greptimedb_tpu.query.sql_parser import parse_sql
+
+    cluster = Cluster(str(tmp_path / "edge"), num_datanodes=2)
+    try:
+        cluster.create_table("cpu", _schema(), partitions=2)
+        cluster.insert("cpu", _batch(600))
+        sp = lambda q: split_for_regions(
+            plan_query(
+                parse_sql(q)[0],
+                lambda t, d: cluster.catalog.table(t, d).schema, "public",
+            )[0]
+        )
+        # OFFSET without LIMIT: unbounded -> no split, and the query works
+        q = "SELECT host, v FROM cpu WHERE v > 10 ORDER BY v DESC OFFSET 3"
+        assert sp(q) is None or sp(q).limit is not None
+        r = cluster.query(q)
+        vs = r["v"].to_pylist()
+        assert vs == sorted(vs, reverse=True)
+        # projection drops the sort key: split bails, central path answers
+        q2 = "SELECT host, ts FROM cpu ORDER BY v DESC LIMIT 5"
+        r2 = cluster.query(q2)
+        assert r2.num_rows == 5
+        # bare ORDER BY: filters ship, sort stays frontend-side
+        q3 = "SELECT host, v FROM cpu WHERE v > 90 ORDER BY v"
+        s3 = sp(q3)
+        if s3 is not None:
+            assert "sort:frontend" in s3.categories or s3.merge_sort is None
+        r3 = cluster.query(q3)
+        vs3 = r3["v"].to_pylist()
+        assert vs3 == sorted(vs3) and all(v > 90 for v in vs3)
+        # alias-sorted projection keeps working (key survives by alias)
+        q4 = "SELECT host, v * 2 AS d FROM cpu ORDER BY d DESC LIMIT 5"
+        r4 = cluster.query(q4)
+        ds = r4["d"].to_pylist()
+        assert ds == sorted(ds, reverse=True) and len(ds) == 5
+    finally:
+        cluster.close()

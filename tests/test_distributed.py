@@ -253,3 +253,51 @@ def test_cluster_failover_preserves_unflushed_wal(cluster):
             break
     t = cluster.query("SELECT count(*) FROM t1")
     assert t["count(*)"].to_pylist() == [1]
+
+
+def test_alive_keeper_fences_stale_writes(tmp_path):
+    """A partitioned datanode must refuse writes once its lease lapses,
+    and close_staled_regions reclaims the region locally while failover
+    promotes it elsewhere (reference datanode/src/alive_keeper.rs:144)."""
+    import pyarrow as pa
+
+    from greptimedb_tpu.distributed.alive_keeper import RegionLeaseExpiredError
+    from greptimedb_tpu.distributed.metasrv import LEASE_MS
+
+    now = [1_000_000.0]
+    cluster = Cluster(str(tmp_path / "ak"), num_datanodes=2, clock=lambda: now[0])
+    try:
+        schema = cpu_schema()
+        cluster.create_table("cpu", schema, partitions=1)
+        cluster.heartbeat_all()  # grants leases
+        meta = cluster.catalog.table("cpu", "public")
+        rid = meta.region_ids[0]
+        routes = cluster.metasrv.get_route(meta.table_id)
+        owner = routes[rid]
+        dn = cluster.datanodes[owner]
+        batch = make_batch(
+            schema, [f"h{i}" for i in range(10)], list(range(0, 10_000, 1000)),
+            [float(i) for i in range(10)],
+        )
+        assert dn.write(rid, batch) == 10  # lease valid
+
+        # the node is partitioned: no more heartbeats reach the metasrv
+        now[0] += LEASE_MS * 4
+        other = cluster.datanodes[1 - owner]
+        if other.alive:
+            cluster.metasrv.handle_heartbeat(1 - owner, other.region_stats(), now[0])
+        try:
+            dn.write(rid, batch)
+            raise AssertionError("stale write was not fenced")
+        except RegionLeaseExpiredError:
+            pass
+        closed = dn.alive_keeper.close_staled_regions(dn.engine, now[0])
+        assert rid in closed
+        # failover side: supervisor promotes the region elsewhere
+        for _ in range(12):
+            cluster.supervise()
+            now[0] += 1000
+        new_routes = cluster.metasrv.get_route(meta.table_id)
+        assert new_routes[rid] != owner, "failover did not move the region"
+    finally:
+        cluster.close()

@@ -37,14 +37,15 @@ def make_batch(schema, hosts, tss, vals):
 
 def test_ticket_roundtrip():
     pred = ScanPredicate(time_range=(10, 20), filters=[("host", "=", "h1")])
-    rid, out, proj, agg = decode_scan_ticket(encode_scan_ticket(7, pred, ["ts", "v"]))
+    rid, out, proj, agg, plan = decode_scan_ticket(encode_scan_ticket(7, pred, ["ts", "v"]))
     assert rid == 7
     assert out.time_range == (10, 20)
     assert out.filters == [("host", "=", "h1")]
     assert proj == ["ts", "v"]
     assert agg is None
+    assert plan is None
     spec = {"group_tags": ["host"], "bucket": None, "agg_specs": [["count", None]]}
-    _rid, _out, _proj, agg2 = decode_scan_ticket(
+    _rid, _out, _proj, agg2, _plan = decode_scan_ticket(
         encode_scan_ticket(7, pred, agg=spec)
     )
     assert agg2 == spec

@@ -40,7 +40,7 @@ backend = "cpu"
     assert cfg.storage.num_workers == 8
     assert cfg.query.backend == "cpu"
     assert cfg.query.tile_rows == 4096  # env overrides
-    assert cfg.storage.wal_dir == "/tmp/x/wal"  # derived default
+    assert cfg.storage.effective_wal_dir() == "/tmp/x/wal"  # derived default
 
 
 def test_config_env_only():

@@ -98,6 +98,37 @@ def test_cli_role_subcommands(tmp_path):
     import time
     import urllib.request
 
+    import select
+
+    def read_line(proc, deadline_s=60.0):
+        """readline with a deadline: a loaded machine can take a while to
+        start a subprocess; a missing line must fail the test, not hang
+        (round-2 flake: fixed waits + TimeoutExpired under load)."""
+        end = time.time() + deadline_s
+        fd = proc.stdout
+        while time.time() < end:
+            r, _w, _x = select.select([fd], [], [], 0.5)
+            if r:
+                ch = fd.readline()
+                if ch:
+                    return ch
+            if proc.poll() is not None:
+                break
+        raise AssertionError(
+            f"subprocess produced no line within {deadline_s}s "
+            f"(returncode={proc.poll()})"
+        )
+
+    def stop(proc):
+        if proc is None:
+            return
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
@@ -109,7 +140,7 @@ def test_cli_role_subcommands(tmp_path):
     )
     ms = None
     try:
-        line = dn.stdout.readline()
+        line = read_line(dn)
         m = re.search(r"grpc://([\d.]+:\d+)", line)
         assert m, line
         dn_addr = m.group(1)
@@ -120,7 +151,7 @@ def test_cli_role_subcommands(tmp_path):
              "--datanode", f"1={dn_addr}"],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
         )
-        line = ms.stdout.readline()
+        line = read_line(ms)
         m = re.search(r"serving at ([\d.]+:\d+)", line)
         assert m, line
         ms_addr = m.group(1)
@@ -129,7 +160,7 @@ def test_cli_role_subcommands(tmp_path):
         from greptimedb_tpu.distributed.meta_service import MetaClient
 
         client = MetaClient([ms_addr])
-        deadline = time.time() + 15
+        deadline = time.time() + 60
         leader = None
         while time.time() < deadline:
             try:
@@ -149,9 +180,5 @@ def test_cli_role_subcommands(tmp_path):
         fdc = FlightDatanodeClient(1, f"grpc://{dn_addr}")
         assert fdc.alive
     finally:
-        dn.send_signal(signal.SIGTERM)
-        if ms is not None:
-            ms.send_signal(signal.SIGTERM)
-        dn.wait(timeout=10)
-        if ms is not None:
-            ms.wait(timeout=10)
+        stop(dn)
+        stop(ms)
