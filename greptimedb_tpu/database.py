@@ -498,6 +498,16 @@ class Database:
         if time_index is None:
             raise InvalidArgumentsError("table requires a TIME INDEX column")
         schema = Schema(columns=columns)
+        mm = str(stmt.options.get("merge_mode", "")).strip()
+        if mm not in ("", "last_row", "last_non_null"):
+            raise InvalidArgumentsError(
+                f"invalid merge_mode {mm!r}: expected 'last_row' or 'last_non_null'"
+            )
+        if mm == "last_non_null" and _opt_bool(stmt.options, "append_mode"):
+            raise InvalidArgumentsError(
+                "merge_mode = 'last_non_null' conflicts with append_mode "
+                "(append tables keep every row; there is nothing to merge)"
+            )
         rule = SingleRegionRule()
         if stmt.partition_by_hash is not None:
             cols, n = stmt.partition_by_hash
@@ -529,6 +539,7 @@ class Database:
                     rid,
                     schema,
                     append_mode=_opt_bool(stmt.options, "append_mode"),
+                    merge_mode=str(stmt.options.get("merge_mode", "")) or None,
                     memtable_kind=str(
                         stmt.options.get("memtable.type", stmt.options.get("memtable_type", ""))
                     )
@@ -1289,15 +1300,19 @@ class Database:
                 if is_logical_meta(meta) or fe.is_external_meta(meta):
                     continue  # no regions of their own
                 append = _opt_bool(meta.options, "append_mode")
+                mm = str(meta.options.get("merge_mode", "")) or None
                 mk = str(
                     meta.options.get("memtable.type", meta.options.get("memtable_type", ""))
                 ) or None
                 for rid in meta.region_ids:
                     try:
-                        self.storage.open_region(rid, append_mode=append, memtable_kind=mk)
+                        self.storage.open_region(
+                            rid, append_mode=append, memtable_kind=mk, merge_mode=mm
+                        )
                     except Exception:
                         self.storage.create_region(
-                            rid, meta.schema, append_mode=append, memtable_kind=mk
+                            rid, meta.schema, append_mode=append,
+                            memtable_kind=mk, merge_mode=mm,
                         )
 
 

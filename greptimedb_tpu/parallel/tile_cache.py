@@ -787,6 +787,14 @@ class TileExecutor:
         # -> plan build -> arg pack): without it a concurrent query could
         # grow the dictionary and repair SHARED tile entries between our
         # phases, mixing code epochs inside one dispatch.
+        if any(
+            getattr(r, "merge_mode", "last_row") == "last_non_null"
+            for r in ctx.regions
+        ) and not ctx.append_mode:
+            # fieldwise (last_non_null) merging is not a per-row no-op even
+            # over disjoint sources when the memtable holds partial-null
+            # versions — the authoritative scan path owns this mode
+            return None
         pinned_regions: list[Region] = []
         with ctx.dictionary.table_lock:
             try:

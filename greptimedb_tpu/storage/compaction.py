@@ -71,10 +71,24 @@ def compact_files(region: Region, group: list[FileMeta]) -> FileMeta | None:
     if not tables:
         return None
     merged = pa.concat_tables(tables, promote_options="permissive")
-    seq = pa.array(np.arange(merged.num_rows, dtype=np.int64))
-    merged = merged.append_column(_SEQ_COL, seq)
-    merged = _sort_and_dedup(merged, region.schema, dedup=not region.append_mode)
-    merged = merged.drop_columns([_SEQ_COL])
+    if region.merge_mode == "last_non_null" and not region.append_mode:
+        # fieldwise merge is associative: the compacted row carries the
+        # newest non-null value per field among its inputs, and future
+        # reads fieldwise-merge it with newer sources exactly as if the
+        # versions were still separate (reference dedup.rs LastNonNull)
+        from .merge import _SEQ, _dedup_chunk
+
+        key_cols = [c.name for c in region.schema.tag_columns()]
+        if region.schema.time_index is not None:
+            key_cols.append(region.schema.time_index.name)
+        seq = pa.array(np.arange(merged.num_rows, dtype=np.int64))
+        merged = merged.append_column(_SEQ, seq)
+        merged = _dedup_chunk(merged, key_cols, region.schema, True, "last_non_null")
+    else:
+        seq = pa.array(np.arange(merged.num_rows, dtype=np.int64))
+        merged = merged.append_column(_SEQ_COL, seq)
+        merged = _sort_and_dedup(merged, region.schema, dedup=not region.append_mode)
+        merged = merged.drop_columns([_SEQ_COL])
     return region.sst_writer.write(merged, level=1)
 
 

@@ -88,6 +88,7 @@ class TimeSeriesEngine:
     def create_region(
         self, region_id: int, schema: Schema, writable: bool = True,
         append_mode: bool = False, memtable_kind: str | None = None,
+        merge_mode: str | None = None,
     ) -> Region:
         with self._lock:
             if region_id in self._regions:
@@ -104,6 +105,7 @@ class TimeSeriesEngine:
                 index_segment_rows=self.config.index_segment_rows,
                 index_inverted_max_terms=self.config.index_inverted_max_terms,
                 append_mode=append_mode,
+                merge_mode=merge_mode,
                 memtable_kind=memtable_kind
                 or getattr(self.config, "memtable_kind", "time_partition"),
             )
@@ -111,7 +113,8 @@ class TimeSeriesEngine:
             return region
 
     def open_region(
-        self, region_id: int, append_mode: bool = False, memtable_kind: str | None = None
+        self, region_id: int, append_mode: bool = False, memtable_kind: str | None = None,
+        merge_mode: str | None = None,
     ) -> Region:
         """Open an existing region from its manifest + WAL (crash recovery)."""
         with self._lock:
@@ -131,6 +134,7 @@ class TimeSeriesEngine:
                 index_segment_rows=self.config.index_segment_rows,
                 index_inverted_max_terms=self.config.index_inverted_max_terms,
                 append_mode=append_mode,
+                merge_mode=merge_mode,
                 memtable_kind=memtable_kind
                 or getattr(self.config, "memtable_kind", "time_partition"),
             )
@@ -248,8 +252,15 @@ class TimeSeriesEngine:
         columns: list[str] | None = None,
         governor=None,
     ):
-        """Bounded-memory windowed scan (see Region.scan_windows)."""
-        yield from self.region(region_id).scan_windows(pred, columns, governor=governor)
+        """Bounded-memory streaming scan: k-way merge over per-source
+        sorted streams (Region.scan_merge_stream — one row group per source
+        in memory), with the scan governor admitting each emitted batch."""
+        for chunk in self.region(region_id).scan_merge_stream(pred, columns):
+            if governor is not None:
+                with governor.scan_guard(chunk.nbytes):
+                    yield chunk
+            else:
+                yield chunk
 
     def close(self):
         if self.flusher is not None:

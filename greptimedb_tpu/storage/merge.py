@@ -1,0 +1,262 @@
+"""Streaming k-way merge + dedup over per-source sorted streams.
+
+Role-equivalent of the reference's read pipeline
+(mito2/src/read/merge.rs `MergeReader` — a heap of sorted batch sources —
+and read/dedup.rs `DedupReader` with its two strategies `LastRow` and
+`LastNonNull`): each source yields (pk..., ts, seq)-sorted record batches;
+the merger emits globally sorted, deduplicated batches of bounded size, so
+peak memory is O(batch) instead of O(scan) — the previous materialized
+concat-sort-dedup pass held every source in memory at once.
+
+Mechanics: instead of a per-row heap (Python-loop slow), the merger picks
+the source with the smallest head key and emits its rows up to the next
+source's head key in one slice (run-cutting — the common case of
+non-interleaved sources moves whole batches).  The final key-group of
+every emitted chunk is held back until the next round so a (pk, ts) group
+can never straddle a chunk boundary; dedup is then chunk-local:
+
+  * last_row:       keep the newest (max seq) version of each (pk, ts)
+  * last_non_null:  fieldwise — the newest NON-NULL value per field wins
+                    (reference dedup.rs LastNonNull / table option
+                    `merge_mode = "last_non_null"`)
+"""
+
+from __future__ import annotations
+
+import bisect
+from collections.abc import Iterator
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..datatypes.schema import Schema
+
+_SEQ = "__seq"
+
+
+class _Source:
+    """One sorted stream with positioned batch access."""
+
+    def __init__(self, batches: Iterator[pa.Table], key_cols: list[str]):
+        self._it = iter(batches)
+        self._key_cols = key_cols
+        self.batch: pa.Table | None = None
+        self.pos = 0
+        self._advance_batch()
+
+    def _advance_batch(self):
+        self.batch = None
+        self.pos = 0
+        for b in self._it:
+            if b.num_rows:
+                self.batch = b
+                return
+
+    @property
+    def exhausted(self) -> bool:
+        return self.batch is None
+
+    def key_at(self, i: int) -> tuple:
+        # null-safe ordering: None sorts LAST (matches Arrow's at_end in
+        # the memtable sort); (1, 0) > (0, any-value), and values are only
+        # compared when both present
+        out = []
+        for c in self._key_cols:
+            v = self.batch[c][i].as_py()
+            out.append((1, 0) if v is None else (0, v))
+        return tuple(out)
+
+    def head_key(self) -> tuple:
+        return self.key_at(self.pos)
+
+    def cut(self, limit: tuple | None) -> pa.Table:
+        """Take rows from pos while key <= limit (all remaining rows when
+        limit is None), advancing the position/batch."""
+        b = self.batch
+        if limit is None:
+            end = b.num_rows
+        else:
+            # bisect_right over the batch's sorted keys
+            lo, hi = self.pos, b.num_rows
+            end = bisect.bisect_right(range(hi), limit, lo=lo, key=self.key_at)
+        out = b.slice(self.pos, end - self.pos)
+        self.pos = end
+        if self.pos >= b.num_rows:
+            self._advance_batch()
+        return out
+
+
+def merge_sorted(
+    sources: list[Iterator[pa.Table]],
+    schema: Schema,
+    dedup: bool = True,
+    mode: str = "last_row",
+    batch_rows: int = 65536,
+) -> Iterator[pa.Table]:
+    """Merge per-source sorted streams into globally sorted, deduplicated
+    batches.  Sources must each be sorted by (pk..., ts) and carry a
+    `__seq` int64 column (write order; later sources/rows win).  The
+    output drops `__seq`."""
+    key_cols = [c.name for c in schema.tag_columns()]
+    if schema.time_index is not None:
+        key_cols.append(schema.time_index.name)
+    srcs = [_Source(b, key_cols) for b in sources]
+    srcs = [s for s in srcs if not s.exhausted]
+
+    carry: pa.Table | None = None  # held-back final key-group
+    pending: list[pa.Table] = []
+    pending_rows = 0
+
+    def flush(chunks: list[pa.Table]) -> pa.Table | None:
+        nonlocal carry
+        if not chunks:
+            return None
+        t = pa.concat_tables(chunks, promote_options="permissive")
+        if carry is not None:
+            t = pa.concat_tables([carry, t], promote_options="permissive")
+            carry = None
+        if t.num_rows == 0:
+            return None
+        # hold back the last key-group so it can absorb rows from the next
+        # round (a (pk, ts) group must be deduped in one piece)
+        def key_of(i: int) -> tuple:
+            return tuple(
+                (1, 0) if (v := t[c][i].as_py()) is None else (0, v)
+                for c in key_cols
+            )
+
+        last_key = key_of(t.num_rows - 1)
+        first_of_last = t.num_rows - 1
+        while first_of_last > 0 and key_of(first_of_last - 1) == last_key:
+            first_of_last -= 1
+        carry = t.slice(first_of_last)
+        t = t.slice(0, first_of_last)
+        if t.num_rows == 0:
+            return None
+        return _dedup_chunk(t, key_cols, schema, dedup, mode)
+
+    while srcs:
+        # source with the smallest head key wins; emit its run up to the
+        # smallest OTHER head (inclusive — ties meet in the same chunk and
+        # are deduped together after the stable seq sort)
+        srcs.sort(key=lambda s: s.head_key())
+        winner = srcs[0]
+        limit = srcs[1].head_key() if len(srcs) > 1 else None
+        run = winner.cut(limit)
+        if winner.exhausted:
+            srcs.remove(winner)
+        if run.num_rows:
+            pending.append(run)
+            pending_rows += run.num_rows
+        if pending_rows >= batch_rows:
+            out = flush(pending)
+            pending, pending_rows = [], 0
+            if out is not None and out.num_rows:
+                yield out
+    out = flush(pending)
+    if out is not None and out.num_rows:
+        yield out
+    if carry is not None and carry.num_rows:
+        final = _dedup_chunk(carry, key_cols, schema, dedup, mode)
+        if final.num_rows:
+            yield final
+
+
+def _dedup_chunk(
+    t: pa.Table, key_cols: list[str], schema: Schema, dedup: bool, mode: str
+) -> pa.Table:
+    """Chunk-local dedup.  Rows are key-sorted; versions of one key may be
+    in any seq order within their group (runs from different sources), so
+    sort by (key, seq) first."""
+    sort_keys = [(c, "ascending") for c in key_cols] + [(_SEQ, "ascending")]
+    idx = pc.sort_indices(t, sort_keys=sort_keys)
+    t = t.take(idx)
+    if not dedup or t.num_rows <= 1:
+        return t.drop_columns([_SEQ]) if _SEQ in t.column_names else t
+    keys = [t[c] for c in key_cols]
+    n = t.num_rows
+    same = np.ones(n - 1, dtype=bool)
+    for col in keys:
+        a = col.slice(0, n - 1)
+        b = col.slice(1)
+        eq = pc.equal(a, b)
+        both_null = pc.and_(pc.is_null(a), pc.is_null(b))
+        same &= np.asarray(pc.fill_null(pc.or_(eq, both_null), False))
+    group_last = np.concatenate([~same, [True]])
+    if mode == "last_non_null":
+        from .region import OP_COL
+
+        if OP_COL in t.column_names:
+            # a delete tombstone kills every version at or before it
+            # (reference dedup.rs LastNonNull skips deleted versions);
+            # the group's newest delete index is broadcast to ALL of the
+            # group's rows so earlier versions die too
+            n2 = t.num_rows
+            op = np.asarray(
+                pc.fill_null(pc.cast(t[OP_COL], pa.int64()), 0)
+            )
+            ridx = np.arange(n2, dtype=np.int64)
+            dcand = np.where(op != 0, ridx, -1)
+            starts = np.nonzero(np.concatenate([[True], ~same]))[0]
+            gmax_del = np.maximum.reduceat(dcand, starts)
+            bcast = np.repeat(gmax_del, np.diff(np.append(starts, n2)))
+            keep = ridx > bcast
+            t = t.filter(pa.array(keep)).drop_columns([OP_COL])
+            if t.num_rows == 0:
+                return t.drop_columns([_SEQ]) if _SEQ in t.column_names else t
+            # groups changed: recompute boundaries
+            keys2 = [t[c] for c in key_cols]
+            m = t.num_rows
+            if m > 1:
+                same2 = np.ones(m - 1, dtype=bool)
+                for col in keys2:
+                    a2, b2 = col.slice(0, m - 1), col.slice(1)
+                    eq2 = pc.equal(a2, b2)
+                    bn2 = pc.and_(pc.is_null(a2), pc.is_null(b2))
+                    same2 &= np.asarray(pc.fill_null(pc.or_(eq2, bn2), False))
+                group_last = np.concatenate([~same2, [True]])
+            else:
+                group_last = np.ones(m, dtype=bool)
+        t = _last_non_null(t, group_last, schema, key_cols)
+    else:
+        t = t.filter(pa.array(group_last))
+    return t.drop_columns([_SEQ]) if _SEQ in t.column_names else t
+
+
+def _last_non_null(
+    t: pa.Table, group_last: np.ndarray, schema: Schema, key_cols: list[str]
+) -> pa.Table:
+    """Fieldwise merge: for each (pk, ts) group take the newest NON-NULL
+    value of every field column (reference read/dedup.rs LastNonNull).
+    Vectorized: forward-fill each field within groups (seq-ascending rows)
+    via a masked running index, then gather at group-last rows."""
+    n = t.num_rows
+    group_id = np.concatenate([[0], np.cumsum(group_last[:-1])]).astype(np.int64)
+    last_rows = np.nonzero(group_last)[0]
+    arrays: dict[str, pa.Array] = {}
+    key_set = set(key_cols)
+    for name in t.column_names:
+        if name == _SEQ:
+            continue
+        col = t[name].combine_chunks()
+        if name in key_set:
+            arrays[name] = col.take(pa.array(last_rows))
+            continue
+        valid = np.asarray(pc.is_valid(col))
+        ridx = np.arange(n, dtype=np.int64)
+        # running "latest non-null row index" via max-accumulate; a carry
+        # from a previous group is detected by group-id mismatch and
+        # treated as no-value
+        cand = np.where(valid, ridx, -1)
+        ff = np.maximum.accumulate(cand)
+        has = ff >= 0
+        ok = has & (group_id[np.clip(ff, 0, None)] == group_id)
+        pick = ff[last_rows]
+        pick_ok = ok[last_rows]
+        taken = col.take(pa.array(np.where(pick_ok, pick, 0)))
+        if not pick_ok.all():
+            mask = pa.array(~pick_ok)
+            taken = pc.if_else(mask, pa.nulls(len(last_rows), taken.type), taken)
+        arrays[name] = taken
+    return pa.table({name: arrays[name] for name in t.column_names if name != _SEQ})

@@ -68,6 +68,7 @@ class Region:
         index_segment_rows: int = 1024,
         index_inverted_max_terms: int = 4096,
         append_mode: bool = False,
+        merge_mode: str | None = None,
         memtable_kind: str = "time_partition",
     ):
         from .object_store import FsObjectStore, ObjectStore
@@ -90,6 +91,11 @@ class Region:
         # compact_table): two concurrent rounds would pick the same L0
         # group and commit the merged rows twice.
         self.compaction_lock = threading.Lock()
+        # Dedup strategy (reference mito2 `merge_mode` table option):
+        # "last_row" keeps the newest version whole; "last_non_null"
+        # merges fieldwise — the newest NON-NULL value per field wins
+        # (read/dedup.rs LastNonNull).
+        self.merge_mode = merge_mode or "last_row"
         # Append-only mode (reference mito2 `append_mode` table option):
         # duplicates are kept (no last-write-wins dedup) and DELETE is
         # rejected — the shape log/trace workloads want, and the condition
@@ -224,7 +230,10 @@ class Region:
         t0 = time.perf_counter()
         added: list[FileMeta] = []
         for _window_start, table in frozen.split_by_time_partition(
-            dedup=not self.append_mode
+            # last_non_null must NOT last-row-dedup on flush: older
+            # versions' non-null fields are still live until the READ-side
+            # fieldwise merge combines them
+            dedup=not self.append_mode and self.merge_mode != "last_non_null"
         ):
             meta = self.sst_writer.write(table, level=0)
             if meta is not None:
@@ -340,8 +349,12 @@ class Region:
 
             ts_name = self.schema.time_index.name if self.schema.time_index else None
             mem_rows = 0
+            keep_versions = self.merge_mode == "last_non_null"
             for mem in mems:
-                mem_table = mem.scan(pred.time_range, dedup=not self.append_mode)
+                mem_table = mem.scan(
+                    pred.time_range,
+                    dedup=not self.append_mode and not keep_versions,
+                )
                 if mem_table.num_rows:
                     mem_table = _apply_residual(mem_table, prune_pred, ts_name)
                 if mem_table.num_rows:
@@ -356,7 +369,10 @@ class Region:
             else:
                 out = pa.concat_tables(tables, promote_options="permissive")
                 out = self._dedup_across_sources(
-                    out, had_multiple=len(tables) > 1 or (n_sst_tables and mem_rows)
+                    out,
+                    had_multiple=len(tables) > 1
+                    or (n_sst_tables and mem_rows)
+                    or self.merge_mode == "last_non_null",
                 )
                 out = self._drop_tombstones(out)
                 if post_filters:
@@ -498,12 +514,136 @@ class Region:
         # downstream consumers (PromQL, range kernels) see ordered series.
         import numpy as np
 
+        if self.merge_mode == "last_non_null" and not self.append_mode:
+            from .merge import _SEQ, _dedup_chunk
+
+            key_cols = [c.name for c in self.schema.tag_columns()]
+            if self.schema.time_index is not None:
+                key_cols.append(self.schema.time_index.name)
+            seq = pa.array(np.arange(table.num_rows, dtype=np.int64))
+            table = table.append_column(_SEQ, seq)
+            return _dedup_chunk(table, key_cols, self.schema, True, "last_non_null")
+
         from .memtable import _SEQ_COL, _sort_and_dedup
 
         seq = pa.array(np.arange(table.num_rows, dtype=np.int64))
         table = table.append_column(_SEQ_COL, seq)
         table = _sort_and_dedup(table, self.schema, dedup=not self.append_mode)
         return table.drop_columns([_SEQ_COL])
+
+    def scan_merge_stream(
+        self,
+        pred: ScanPredicate | None = None,
+        columns: list[str] | None = None,
+        batch_rows: int = 65536,
+    ):
+        """Streaming scan: per-source sorted batches merged through a
+        k-way run-cutting merger with mode-aware dedup (reference
+        mito2/src/read/merge.rs MergeReader + dedup.rs DedupReader).
+        Peak memory is O(batch + one row group per source) instead of the
+        whole scan; SSTs stream row-group-at-a-time."""
+        import numpy as np
+
+        from .merge import _SEQ, merge_sorted
+        from .sst import _apply_residual
+
+        pred = pred or ScanPredicate()
+        with self._lock:
+            files = list(self.manifest_mgr.manifest.files.values())
+            mems = list(self._frozen_memtables) + [self.memtable]
+            self._active_scans += 1
+        try:
+            key_cols = {c.name for c in self.schema.tag_columns()}
+            if self.schema.time_index is not None:
+                key_cols.add(self.schema.time_index.name)
+            key_filters = [f for f in pred.filters if f[0] in key_cols]
+            post_filters = [f for f in pred.filters if f[0] not in key_cols]
+            prune_pred = ScanPredicate(
+                time_range=pred.time_range,
+                filters=list(pred.filters) if self.append_mode else key_filters,
+            )
+            read_cols = None
+            if columns:
+                need = list(dict.fromkeys(columns))
+                for c in self.schema.primary_key():
+                    if c not in need:
+                        need.append(c)
+                if self.schema.time_index and self.schema.time_index.name not in need:
+                    need.append(self.schema.time_index.name)
+                for name, _op, _v in pred.filters:
+                    if self.schema.has_column(name) and name not in need:
+                        need.append(name)
+                need.append(OP_COL)
+                read_cols = need
+            base = 0
+
+            def sst_source(meta, base_seq):
+                for t in self.sst_reader.read_batches(
+                    meta, prune_pred, columns=read_cols
+                ):
+                    t = self._compat_cast(_undict(t))
+                    seq = pa.array(
+                        base_seq + np.arange(t.num_rows, dtype=np.int64)
+                    )
+                    yield t.append_column(_SEQ, seq)
+
+            def mem_source(mem, base_seq):
+                t = mem.scan(pred.time_range, dedup=False)
+                if t.num_rows:
+                    t = _apply_residual(t, prune_pred, None)
+                if t.num_rows and read_cols:
+                    t = t.select([c for c in read_cols if c in t.column_names])
+                if t.num_rows:
+                    seq = pa.array(
+                        base_seq + np.arange(t.num_rows, dtype=np.int64)
+                    )
+                    yield _undict(t).append_column(_SEQ, seq)
+
+            sources = []
+            for meta in self.sst_reader.prune_files(files, prune_pred):
+                sources.append(sst_source(meta, base))
+                base += 1 << 40
+            for mem in mems:
+                sources.append(mem_source(mem, base))
+                base += 1 << 40
+            ts_name = (
+                self.schema.time_index.name if self.schema.time_index else None
+            )
+            for out in merge_sorted(
+                sources,
+                self.schema,
+                dedup=not self.append_mode,
+                mode=self.merge_mode,
+                batch_rows=batch_rows,
+            ):
+                out = self._drop_tombstones(out)
+                if post_filters:
+                    out = _apply_residual(
+                        out, ScanPredicate(filters=post_filters), None
+                    )
+                # schema evolution: late columns read as NULL
+                for c in self.schema.columns:
+                    if c.name not in out.column_names:
+                        out = out.append_column(
+                            c.name, pa.nulls(out.num_rows, c.data_type.to_arrow())
+                        )
+                if columns:
+                    out = out.select(
+                        [c for c in columns if c in out.column_names]
+                    )
+                else:
+                    want = [
+                        c for c in self.schema.column_names()
+                        if c in out.column_names
+                    ]
+                    if want != out.column_names:
+                        out = out.select(want)
+                if out.num_rows:
+                    yield out
+        finally:
+            with self._lock:
+                self._active_scans -= 1
+                self._purge_garbage_locked()
 
     # ---- tile-cache support ------------------------------------------------
     def pin_scan(self):

@@ -272,6 +272,38 @@ class SstReader:
         table = _apply_residual(table, pred, ts_name)
         return table
 
+    def read_batches(
+        self,
+        meta: FileMeta,
+        pred: ScanPredicate | None = None,
+        columns: list[str] | None = None,
+    ):
+        """Stream one SST row-group at a time (reference FileRange scan
+        units, mito2/src/sst/parquet/reader.rs): the streaming merge reader
+        holds at most one row group per source in memory."""
+        pred = pred or ScanPredicate()
+        pf = pq.ParquetFile(self.store.open_input(f"{meta.file_id}.parquet"))
+        ts_name = self.schema.time_index.name if self.schema.time_index else None
+        groups = self._prune_row_groups(pf, pred, ts_name)
+        if groups and meta.indexed_columns:
+            groups = self._prune_with_indexes(pf, meta, pred, groups)
+        if columns:
+            columns = [c for c in columns if c in pf.schema_arrow.names]
+        want = (
+            self.schema.time_index.data_type.to_arrow()
+            if self.schema.time_index
+            else None
+        )
+        for g in groups:
+            table = pf.read_row_groups([g], columns=columns, use_threads=False)
+            if ts_name is not None and ts_name in table.column_names:
+                i = table.schema.get_field_index(ts_name)
+                if want is not None and table.schema.field(i).type != want:
+                    table = table.set_column(i, ts_name, pc.cast(table[ts_name], want))
+            table = _apply_residual(table, pred, ts_name)
+            if table.num_rows:
+                yield table
+
     def _prune_with_indexes(
         self, pf: pq.ParquetFile, meta: FileMeta, pred: ScanPredicate, groups: list[int]
     ) -> list[int]:
