@@ -134,6 +134,15 @@ def cmd_datanode(args):
     return 0
 
 
+def cmd_flownode(args):
+    """Run a flownode process: the flow engine with a Flight service for
+    mirrored inserts + flow DDL, heartbeating to the metasrv (reference
+    `greptime flownode start`, flow/src/server.rs)."""
+    from .distributed.flownode import run_flownode
+
+    return run_flownode(args.node_id, args.data_home, args.addr, args.metasrv)
+
+
 def cmd_metasrv(args):
     """Run a metasrv process: routes/heartbeats/placement/migration over
     HTTP with lease-based election on the shared KV (reference
@@ -249,6 +258,50 @@ def cmd_metadata(args):
     return 1
 
 
+def cmd_objbench(args):
+    """Object-storage micro-benchmark (reference `greptime datanode
+    objbench`, cmd/src/datanode/objbench.rs): timed write/read/list/delete
+    rounds against the configured store."""
+    import json
+    import time
+
+    from .storage.object_store import build_object_store
+    from .utils.config import StorageConfig
+
+    cfg = StorageConfig(data_home=args.data_home)
+    cfg.store_type = args.store_type
+    store = build_object_store(cfg)
+    payload = b"\xab" * (args.size_kb << 10)
+    n = args.num_objects
+    t0 = time.perf_counter()
+    for i in range(n):
+        store.write(f"objbench/{i:06d}.bin", payload)
+    t_write = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    total = 0
+    for i in range(n):
+        total += len(store.read(f"objbench/{i:06d}.bin"))
+    t_read = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    listed = len(store.list("objbench"))
+    t_list = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for i in range(n):
+        store.delete(f"objbench/{i:06d}.bin")
+    t_delete = time.perf_counter() - t0
+    print(json.dumps({
+        "store_type": args.store_type,
+        "objects": n,
+        "object_kb": args.size_kb,
+        "write_mb_s": round(n * args.size_kb / 1024 / max(t_write, 1e-9), 1),
+        "read_mb_s": round(total / (1 << 20) / max(t_read, 1e-9), 1),
+        "list_ms": round(t_list * 1000, 2),
+        "listed": listed,
+        "delete_per_s": round(n / max(t_delete, 1e-9)),
+    }))
+    return 0
+
+
 def cmd_bench(args):
     import importlib.util
     import os
@@ -300,6 +353,14 @@ def main(argv=None):
     p.add_argument("--addr", default="127.0.0.1:0")
     p.set_defaults(fn=cmd_datanode)
 
+    p = sub.add_parser("flownode", help="start a flownode (streaming/batching flows)")
+    p.add_argument("start", choices=["start"])
+    p.add_argument("--node-id", type=int, default=1)
+    p.add_argument("--data-home", required=True)
+    p.add_argument("--addr", default="127.0.0.1:0")
+    p.add_argument("--metasrv", default=None, help="metasrv addr for heartbeats")
+    p.set_defaults(fn=cmd_flownode)
+
     p = sub.add_parser("metasrv", help="start a metasrv (routes/heartbeats/election)")
     p.add_argument("action", choices=["start"])
     p.add_argument("--node-id", default="metasrv-0")
@@ -317,6 +378,13 @@ def main(argv=None):
     p.add_argument("--out", default="./catalog_snapshot.json", help="snapshot output path")
     p.add_argument("--snapshot", default="./catalog_snapshot.json", help="snapshot to restore")
     p.set_defaults(fn=cmd_metadata)
+
+    p = sub.add_parser("objbench", help="object-storage micro-benchmark")
+    p.add_argument("--data-home", default="/tmp/greptimedb_objbench")
+    p.add_argument("--store-type", default="fs", choices=["fs", "memory"])
+    p.add_argument("--num-objects", type=int, default=64)
+    p.add_argument("--size-kb", type=int, default=1024)
+    p.set_defaults(fn=cmd_objbench)
 
     p = sub.add_parser("bench", help="run the TSBS-style benchmark")
     p.set_defaults(fn=cmd_bench)

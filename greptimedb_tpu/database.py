@@ -876,11 +876,26 @@ class Database:
         region_ids = meta.region_ids  # includes any repartition generation base
         # system writes (event recorder) bypass the user write budget
         with self.memory.write_guard(0 if system else batch_nbytes(batch)):
-            for i, part in enumerate(parts):
-                if part.num_rows == 0:
-                    continue
-                for b in part.to_batches():
-                    affected += self.storage.write(region_ids[i], b)
+            non_empty = [
+                (i, part) for i, part in enumerate(parts) if part.num_rows
+            ]
+            if len(non_empty) > 1:
+                # multi-region insert: pipeline through the sharded worker
+                # loops so per-region WAL appends overlap (reference
+                # Inserter fans per-region requests out concurrently,
+                # insert.rs:409-427, onto worker.rs write loops)
+                futures = []
+                for i, part in non_empty:
+                    for b in part.to_batches():
+                        futures.append(
+                            self.storage.submit_write(region_ids[i], b)
+                        )
+                for f in futures:
+                    affected += f.result(timeout=60)
+            else:
+                for i, part in non_empty:
+                    for b in part.to_batches():
+                        affected += self.storage.write(region_ids[i], b)
         if mirror and self.flows.infos:
             self.flows.mirror_insert(meta.name, meta.database, table)
         return affected
@@ -1086,6 +1101,21 @@ class Database:
                         else meta.schema.to_arrow().empty_table()
                     )
                 return out
+        if len(meta.region_ids) > 1:
+            # intra-node scan parallelism: regions decode Parquet
+            # concurrently (Arrow releases the GIL) — the role of the
+            # reference's ParallelizeScan redistributing PartitionRanges
+            # (query/src/optimizer/parallelize_scan.rs)
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=min(len(meta.region_ids), 8)
+            ) as pool:
+                out = list(
+                    pool.map(lambda rid: self.storage.scan(rid, pred), meta.region_ids)
+                )
+            self.process_manager.check_cancelled()
+            return out
         for rid in meta.region_ids:
             out.append(self.storage.scan(rid, pred))
             self.process_manager.check_cancelled()  # between-region point

@@ -107,6 +107,13 @@ class Datanode:
     def region_stats(self) -> list:
         return [s.__dict__ for s in self.engine.region_statistics()]
 
+    def file_refs(self) -> dict[int, set[str]]:
+        """SST files this node's regions still reference (reference
+        mito2/src/sst/file_ref.rs FileReferenceManager)."""
+        from .gc import region_file_refs
+
+        return region_file_refs(self.engine)
+
     def time_bounds(self, rid: int) -> tuple[int, int] | None:
         region = self.engine.region(rid)
         lo = hi = None
@@ -304,11 +311,24 @@ class Cluster:
     def _pred(self, scan: TableScan) -> ScanPredicate:
         return ScanPredicate(time_range=scan.time_range, filters=[tuple(f) for f in scan.filters])
 
+    def _fanout(self, region_ids, fn):
+        """Per-region requests run concurrently (reference MergeScan fans
+        sub-queries out per region and merges streams,
+        merge_scan.rs:250-330; over Flight this overlaps the wire)."""
+        if len(region_ids) <= 1:
+            return [fn(rid) for rid in region_ids]
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(len(region_ids), 8)) as pool:
+            return list(pool.map(fn, region_ids))
+
     def _region_scan(self, scan: TableScan) -> list[pa.Table]:
         meta = self.catalog.table(scan.table, scan.database)
         routes = self.metasrv.get_route(meta.table_id)
         pred = self._pred(scan)
-        return [self.datanodes[routes[rid]].scan(rid, pred) for rid in meta.region_ids]
+        return self._fanout(
+            meta.region_ids, lambda rid: self.datanodes[routes[rid]].scan(rid, pred)
+        )
 
     def _partial_agg(self, scan: TableScan, spec_dict: dict) -> list[pa.Table]:
         """Lower/state stage fan-out: each region's datanode aggregates
@@ -317,10 +337,10 @@ class Cluster:
         meta = self.catalog.table(scan.table, scan.database)
         routes = self.metasrv.get_route(meta.table_id)
         pred = self._pred(scan)
-        return [
-            self.datanodes[routes[rid]].partial_agg(rid, pred, spec_dict)
-            for rid in meta.region_ids
-        ]
+        return self._fanout(
+            meta.region_ids,
+            lambda rid: self.datanodes[routes[rid]].partial_agg(rid, pred, spec_dict),
+        )
 
     def _sub_plan(self, scan: TableScan, plan_dict: dict) -> list[pa.Table]:
         """Fan a serialized sub-plan out to every region's datanode
@@ -328,10 +348,10 @@ class Cluster:
         merge_scan.rs:250); each returns BOUNDED rows."""
         meta = self.catalog.table(scan.table, scan.database)
         routes = self.metasrv.get_route(meta.table_id)
-        return [
-            self.datanodes[routes[rid]].execute_plan(rid, plan_dict)
-            for rid in meta.region_ids
-        ]
+        return self._fanout(
+            meta.region_ids,
+            lambda rid: self.datanodes[routes[rid]].execute_plan(rid, plan_dict),
+        )
 
     def _scan(self, scan: TableScan) -> pa.Table:
         tables = [t for t in self._region_scan(scan) if t.num_rows]
@@ -383,6 +403,32 @@ class Cluster:
 
     def supervise(self):
         return self.metasrv.tick(self.clock())
+
+    def gc_round(self, grace_ms: float = 60_000.0) -> list[str]:
+        """Cross-node SST GC: gather every live datanode's file refs,
+        delete shared-storage orphans (reference meta-srv/src/gc/ driving
+        Instruction::GetFileRefs / GcRegions).  A dead datanode vetoes the
+        round — its references are unknown."""
+        from .gc import GcScheduler
+
+        refs, complete = [], True
+        for dn in self.datanodes.values():
+            if not dn.alive:
+                complete = False
+                continue
+            try:
+                refs.append(dn.file_refs())
+            except Exception:  # noqa: BLE001 — unreachable node vetoes
+                complete = False
+        routed: set[int] = set()
+        for db in self.catalog.databases():
+            for meta in self.catalog.tables(db):
+                routed.update(meta.region_ids)
+        sst_dir = os.path.join(self.data_home, "data")
+        # age is judged against REAL file mtimes, so the scheduler keeps
+        # wall-clock time even when the cluster runs on a logical clock
+        gc = GcScheduler(sst_dir, grace_ms=grace_ms)
+        return gc.gc_round(refs, routed, reporting_complete=complete)
 
     # ---- admin procedures -------------------------------------------------
     def repartition_table(self, table: str, new_rule, database: str = "public") -> str:

@@ -157,6 +157,15 @@ class DatanodeFlightServer(fl.FlightServerBase):
             out = {"ok": True}
         elif kind == "region_stats":
             out = {"stats": [s.__dict__ for s in self.engine.region_statistics()]}
+        elif kind == "file_refs":
+            from .gc import region_file_refs
+
+            out = {
+                "refs": {
+                    str(rid): sorted(ids)
+                    for rid, ids in region_file_refs(self.engine).items()
+                }
+            }
         elif kind == "time_bounds":
             region = self.engine.region(body["region_id"])
             lo = hi = None
@@ -222,6 +231,10 @@ class FlightDatanodeClient:
 
     def region_stats(self) -> list:
         return self._action("region_stats", {})["stats"]
+
+    def file_refs(self) -> dict[int, set[str]]:
+        out = self._action("file_refs", {})
+        return {int(rid): set(ids) for rid, ids in out["refs"].items()}
 
     def time_bounds(self, rid: int) -> tuple[int, int] | None:
         b = self._action("time_bounds", {"region_id": rid})["bounds"]
@@ -329,6 +342,9 @@ class FlightDatanode:
 
     def region_stats(self) -> list:
         return self.client.region_stats()
+
+    def file_refs(self) -> dict[int, set[str]]:
+        return self.client.file_refs()
 
     def time_bounds(self, rid: int):
         return self.client.time_bounds(rid)

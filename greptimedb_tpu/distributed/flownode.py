@@ -1,0 +1,143 @@
+"""Deployable flownode: the flow engine as its own process.
+
+Role-equivalent of the reference's flownode role (flow/src/server.rs
+`FlownodeBuilder`/`FlownodeInstance`, started by `greptime flownode start`,
+cmd/src/flownode.rs): a process that owns streaming/batching flows,
+receives mirrored inserts from frontends (the reference's
+`FlowMirrorTask` fan-out, operator/src/insert.rs:397-406), heartbeats to
+the metasrv, and writes flow sinks.
+
+Wire surface (Arrow Flight, like the datanode role):
+  do_put  descriptor {"flow_mirror": {"table": ..., "database": ...}}
+          — mirrored source-table batches feeding the flow engine
+  do_action create_flow {"sql": ..., "database": ...}
+            drop_flow   {"name": ...}
+            flush_flow  {"name": ...}   — force a batching-flow eval
+            list_flows  {}
+            health      {}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pyarrow as pa
+import pyarrow.flight as fl
+
+
+class FlownodeFlightServer(fl.FlightServerBase):
+    def __init__(self, db, location: str = "grpc://127.0.0.1:0"):
+        super().__init__(location)
+        self.db = db
+        self.flows = db.flows  # FlowManager
+
+    @property
+    def location(self) -> str:
+        return f"grpc://127.0.0.1:{self.port}"
+
+    # mirrored inserts (reference FlowMirrorTask over gRPC)
+    def do_put(self, context, descriptor: fl.FlightDescriptor, reader, writer):
+        cmd = json.loads(descriptor.command.decode())
+        mirror = cmd["flow_mirror"]
+        batches = [chunk.data for chunk in reader]
+        if not batches:
+            return
+        table = pa.Table.from_batches(batches)
+        self.flows.mirror_insert(mirror["table"], mirror.get("database", "public"), table)
+        writer.write(json.dumps({"rows": table.num_rows}).encode())
+
+    def do_action(self, context, action: fl.Action):
+        body = json.loads(action.body.to_pybytes().decode()) if action.body else {}
+        kind = action.type
+        if kind == "create_flow":
+            from ..query.sql_parser import parse_sql
+
+            stmts = parse_sql(body["sql"])
+            info = self.flows.create_flow(stmts[0], body.get("database", "public"))
+            out = {"flow_id": info.flow_id, "name": info.name}
+        elif kind == "drop_flow":
+            self.flows.drop_flow(body["name"])
+            out = {"ok": True}
+        elif kind == "flush_flow":
+            out = {"rows": self.flows.flush_flow(body["name"]) or 0}
+        elif kind == "list_flows":
+            out = {"flows": [i.to_dict() for i in self.flows.list_flows()]}
+        elif kind == "health":
+            out = {"ok": True, "flows": len(self.flows.infos)}
+        else:
+            raise KeyError(f"unknown flownode action {kind!r}")
+        yield fl.Result(json.dumps(out).encode())
+
+
+class FlownodeClient:
+    """Frontend-side handle (reference common/meta node_manager Flownode
+    client): mirror inserts + drive flow DDL over Flight."""
+
+    def __init__(self, node_id: int, location: str):
+        self.node_id = node_id
+        self._client = fl.connect(location)
+
+    def mirror_insert(self, table: str, database: str, batch: pa.Table) -> int:
+        descriptor = fl.FlightDescriptor.for_command(
+            json.dumps(
+                {"flow_mirror": {"table": table, "database": database}}
+            ).encode()
+        )
+        writer, meta_reader = self._client.do_put(descriptor, batch.schema)
+        for b in batch.to_batches():
+            writer.write_batch(b)
+        writer.done_writing()
+        buf = meta_reader.read()
+        writer.close()
+        return json.loads(buf.to_pybytes().decode())["rows"] if buf else 0
+
+    def action(self, kind: str, body: dict | None = None) -> dict:
+        results = list(
+            self._client.do_action(
+                fl.Action(kind, json.dumps(body or {}).encode())
+            )
+        )
+        return json.loads(results[0].body.to_pybytes().decode())
+
+
+def run_flownode(node_id: int, data_home: str, addr: str, metasrv_addr: str | None):
+    """Process entry (reference cmd flownode start): flow engine over the
+    shared data dir + Flight service + heartbeat loop."""
+    import signal
+    import time as _time
+
+    from ..database import Database
+
+    db = Database(data_home=data_home)
+    host, port = (addr.rsplit(":", 1) + ["0"])[:2]
+    server = FlownodeFlightServer(db, f"grpc://{host}:{port}")
+    t = threading.Thread(target=server.serve, daemon=True)
+    t.start()
+    print(f"flownode {node_id} serving Flight at {server.location}", flush=True)
+
+    stop = threading.Event()
+
+    def heartbeat_loop():
+        from .meta_service import MetaClient
+
+        client = MetaClient([metasrv_addr])
+        while not stop.wait(2.0):
+            try:
+                client.handle_heartbeat(
+                    node_id, [], _time.time() * 1000, role="flownode"
+                )
+            except Exception:  # noqa: BLE001 — metasrv may be down; keep trying
+                pass
+
+    if metasrv_addr:
+        threading.Thread(target=heartbeat_loop, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    try:
+        stop.wait()
+    finally:
+        server.shutdown()
+        db.close()
+    return 0

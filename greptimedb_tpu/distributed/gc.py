@@ -1,0 +1,127 @@
+"""Cross-node SST garbage collection over shared storage.
+
+Role-equivalent of the reference's global GC worker (RFC
+docs/rfcs/2025-07-23-global-gc-worker.md): datanodes report which SST
+files their regions still REFERENCE (mito2/src/sst/file_ref.rs — manifest
+entries plus files pinned by in-flight scans/deferred purge), and a
+metasrv-driven collector deletes shared-storage files nothing references —
+orphans from crashed flushes (SST written, manifest edit never landed),
+migration leftovers, and dropped regions (meta-srv/src/gc/ scheduler +
+handler; Instruction::GetFileRefs / GcRegions).
+
+Safety rules:
+  * a file is only deleted when EVERY datanode that could reference the
+    region has reported, and none references it;
+  * files younger than `grace_ms` are never touched (a flush may have
+    written the file but not yet committed the manifest);
+  * region directories belonging to no routed region are removed wholesale
+    once past the grace period (dropped tables).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def region_file_refs(engine) -> dict[int, set[str]]:
+    """One datanode's file references (reference FileReferenceManager):
+    manifest files of every open region, PLUS deferred-purge garbage still
+    pinned by in-flight scans (those files are still being read)."""
+    refs: dict[int, set[str]] = {}
+    with engine._lock:
+        regions = dict(engine._regions)
+    for rid, region in regions.items():
+        with region._lock:
+            ids = {m.file_id for m in region.manifest_mgr.manifest.files.values()}
+            # garbage awaiting purge is unreferenced by the manifest but may
+            # still be read by an in-flight scan — protect until drained
+            ids |= set(region._garbage_files)
+        refs[rid] = ids
+    return refs
+
+
+class GcScheduler:
+    """Metasrv-side collector (reference meta-srv/src/gc/scheduler.rs).
+
+    Works directly over the shared sst dir: list region dirs, subtract the
+    union of all datanodes' references, delete the rest past the grace
+    period."""
+
+    def __init__(self, sst_dir: str, grace_ms: float = 60_000.0, clock=None):
+        self.sst_dir = sst_dir
+        self.grace_ms = grace_ms
+        self.clock = clock or (lambda: time.time() * 1000)
+        self.stats = {"files_deleted": 0, "dirs_deleted": 0, "rounds": 0}
+
+    def gc_round(
+        self,
+        refs_per_node: list[dict[int, set[str]]],
+        routed_regions: set[int],
+        reporting_complete: bool = True,
+    ) -> list[str]:
+        """One collection pass.  `refs_per_node` must include a report from
+        EVERY live datanode (`reporting_complete` guards partial rounds —
+        a missing node vetoes deletion, reference gc handler's same rule).
+        Returns deleted paths."""
+        self.stats["rounds"] += 1
+        if not reporting_complete:
+            return []
+        now = self.clock()
+        merged: dict[int, set[str]] = {}
+        for refs in refs_per_node:
+            for rid, ids in refs.items():
+                merged.setdefault(rid, set()).update(ids)
+        deleted: list[str] = []
+        if not os.path.isdir(self.sst_dir):
+            return deleted
+        for entry in os.listdir(self.sst_dir):
+            if not entry.startswith("region_"):
+                continue
+            try:
+                rid = int(entry.split("_", 1)[1])
+            except ValueError:
+                continue
+            region_dir = os.path.join(self.sst_dir, entry)
+            if rid not in routed_regions and rid not in merged:
+                # dropped region: remove wholesale once quiescent
+                if self._dir_age_ms(region_dir, now) > self.grace_ms:
+                    import shutil
+
+                    shutil.rmtree(region_dir, ignore_errors=True)
+                    self.stats["dirs_deleted"] += 1
+                    deleted.append(region_dir)
+                continue
+            live = merged.get(rid, set())
+            sst_dir = os.path.join(region_dir, "sst")
+            if not os.path.isdir(sst_dir):
+                continue
+            for fname in os.listdir(sst_dir):
+                stem = fname.split(".", 1)[0]
+                if stem in live:
+                    continue
+                path = os.path.join(sst_dir, fname)
+                try:
+                    age = now - os.path.getmtime(path) * 1000
+                except OSError:
+                    continue
+                if age <= self.grace_ms:
+                    continue  # possibly a flush racing its manifest commit
+                try:
+                    os.remove(path)
+                    self.stats["files_deleted"] += 1
+                    deleted.append(path)
+                except OSError:
+                    pass
+        return deleted
+
+    @staticmethod
+    def _dir_age_ms(path: str, now: float) -> float:
+        try:
+            newest = max(
+                (os.path.getmtime(os.path.join(root, f)) for root, _d, fs in os.walk(path) for f in fs),
+                default=os.path.getmtime(path),
+            )
+        except OSError:
+            return 0.0
+        return now - newest * 1000

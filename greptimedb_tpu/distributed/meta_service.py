@@ -87,7 +87,8 @@ class MetasrvServer:
             raise IllegalStateError("not the metasrv leader")
         if path == "/heartbeat":
             return m.handle_heartbeat(
-                int(body["node_id"]), body.get("stats", []), float(body["now_ms"])
+                int(body["node_id"]), body.get("stats", []), float(body["now_ms"]),
+                role=body.get("role", "datanode"),
             )
         if path == "/route/get":
             return {"routes": {str(k): v for k, v in m.get_route(int(body["table_id"])).items()}}
@@ -163,8 +164,13 @@ class MetaClient:
     def register_datanode(self, node_id: int):
         self._call("/register", {"node_id": node_id})
 
-    def handle_heartbeat(self, node_id: int, stats: list, now_ms: float) -> dict:
-        return self._call("/heartbeat", {"node_id": node_id, "stats": stats, "now_ms": now_ms})
+    def handle_heartbeat(
+        self, node_id: int, stats: list, now_ms: float, role: str = "datanode"
+    ) -> dict:
+        return self._call(
+            "/heartbeat",
+            {"node_id": node_id, "stats": stats, "now_ms": now_ms, "role": role},
+        )
 
     def get_route(self, table_id: int) -> dict[int, int]:
         out = self._call("/route/get", {"table_id": table_id})

@@ -28,6 +28,7 @@ LEASE_MS = 10_000
 class DatanodeInfo:
     node_id: int
     alive: bool = True
+    role: str = "datanode"  # datanode | flownode | frontend
     detector: PhiAccrualFailureDetector = field(default_factory=PhiAccrualFailureDetector)
     mailbox: list[dict] = field(default_factory=list)  # pending Instructions
     last_stats: list = field(default_factory=list)
@@ -183,7 +184,13 @@ class Metasrv:
         load_based (reference selector/load_based.rs: weight by hosted
         region count from routes + last heartbeat stats)."""
         with self._lock:
-            healthy = [n for n in sorted(self.datanodes) if self.datanodes[n].alive and n not in exclude]
+            healthy = [
+                n for n in sorted(self.datanodes)
+                if self.datanodes[n].alive
+                and n not in exclude
+                and self.datanodes[n].role == "datanode"  # a flownode or
+                # frontend heartbeating must never receive region placement
+            ]
             if not healthy:
                 return None
             if self.selector == "load_based":
@@ -221,11 +228,15 @@ class Metasrv:
         return out
 
     # ---- heartbeat pipeline (reference handler group) ---------------------
-    def handle_heartbeat(self, node_id: int, region_stats: list, now_ms: float) -> dict:
+    def handle_heartbeat(
+        self, node_id: int, region_stats: list, now_ms: float,
+        role: str = "datanode",
+    ) -> dict:
         with self._lock:
             info = self.datanodes.setdefault(node_id, DatanodeInfo(node_id))
             info.detector.heartbeat(now_ms)
             info.alive = True
+            info.role = role
             info.last_stats = region_stats
             instructions, info.mailbox = info.mailbox, []
         # Lease extension for every region the routes say this node owns.

@@ -242,12 +242,15 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
         per_col_aggs.setdefault(col, set()).add(_FUNC_TO_KERNEL[func])
     states = {}
     groups: dict[tuple, list[str]] = {}
+    last_presence: str | None = None
     for col, aggs in per_col_aggs.items():
         if "last" in aggs:
             # LAST has no reshape-reduce fold; the planner never builds a
             # hierarchical plan with last_value
             key = tuple(sorted(aggs | {"count"}))
             col_mask = mask & nulls[col] if col in nulls else mask
+            if col not in nulls:
+                last_presence = col  # its count IS the presence count
             states[col] = fold(segment_aggregate(
                 columns[col], gids, n_internal, key,
                 mask=col_mask, ts=ts, acc_dtype=acc, span=plan.block_span,
@@ -273,9 +276,30 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
         elif not kernel_aggs:
             continue  # count(col) on a non-null column: presence covers it
         groups.setdefault(tuple(sorted(kernel_aggs)), []).append(col)
-    # group presence (independent of value nulls) rides along as a
-    # pseudo-column whose "values" are the mask itself
-    groups.setdefault(("count",), []).append("__presence")
+    # Presence fusing: a NON-null-gated value column counts exactly the
+    # base-mask rows, which IS the group presence — ride its kernel pass
+    # (the count reduction fuses with the column's sum/min/max over the
+    # same one-hot, nearly free) instead of spending a whole separate
+    # pass on a pseudo-column.  Only when every column is null-gated (or
+    # there are none) does presence pay its own pass.
+    presence_from: str | None = None
+    for key in list(groups):
+        if "count" in key:
+            continue
+        cols = groups[key]
+        rep = cols[0]
+        if len(cols) == 1:
+            del groups[key]
+        else:
+            groups[key] = cols[1:]
+        groups.setdefault(tuple(sorted(set(key) | {"count"})), []).insert(0, rep)
+        presence_from = rep
+        break
+    if presence_from is None and last_presence is not None:
+        presence_from = last_presence
+    if presence_from is None:
+        # pseudo-column whose "values" are the mask itself
+        groups.setdefault(("count",), []).append("__presence")
     for key, cols in groups.items():
         # per-column lists, never a stacked [C, n] (HBM: see
         # segment_aggregate_multi); count-only pseudo-columns reuse the
@@ -299,6 +323,8 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
                 mins=None if multi.mins is None else multi.mins[i],
                 maxs=None if multi.maxs is None else multi.maxs[i],
             ))
+    if presence_from is not None:
+        states["__presence"] = AggState(counts=states[presence_from].counts)
     return states
 
 

@@ -83,6 +83,20 @@ from .executor import (
 
 
 
+# Max rows per device chunk: one chunk's kernel working set fits HBM
+# comfortably even for 10-column programs (see _SuperTiles.cols).
+TILE_CHUNK_ROWS = 1 << 24
+
+
+def _chunk_bounds(pad: int) -> list[tuple[int, int]]:
+    if pad <= TILE_CHUNK_ROWS:
+        return [(0, pad)]
+    return [
+        (o, min(o + TILE_CHUNK_ROWS, pad))
+        for o in range(0, pad, TILE_CHUNK_ROWS)
+    ]
+
+
 @dataclass
 class TileContext:
     """What the Database hands the tile executor for one table scan."""
@@ -132,10 +146,16 @@ class _SuperTiles:
     num_rows: int  # real rows (sum of file rows)
     pad: int  # padded (pow2) total length
     order: np.ndarray | None = None  # (pk, ts) sort of the file concat
-    cols: dict[str, jnp.ndarray] = field(default_factory=dict)
-    nulls: dict[str, jnp.ndarray] = field(default_factory=dict)
+    # Device columns are stored CHUNKED (lists of <= TILE_CHUNK_ROWS
+    # arrays): one jit source per chunk keeps any single dispatch's
+    # temporaries bounded — a 10-column program over one 2^26 buffer
+    # overcommitted HBM (XLA schedules columns concurrently; measured
+    # 38 s warm after runtime spill), while four 2^24 chunks dispatched
+    # back-to-back peak at a quarter of the working set.
+    cols: dict[str, list] = field(default_factory=dict)
+    nulls: dict[str, list] = field(default_factory=dict)
     epochs: dict[str, int] = field(default_factory=dict)
-    valid: jnp.ndarray | None = None
+    valid: list | None = None
     perm: jnp.ndarray | None = None  # ts-ascending gather (time-major plans)
     # host-side sorted copies of (pk codes..., ts) + file row offsets:
     # selective pk-equality queries binary-search these and aggregate the
@@ -146,9 +166,9 @@ class _SuperTiles:
     file_row_offsets: np.ndarray | None = None
     # ts-ascending (time-major) device copies, built once per column so
     # bucket-only queries dispatch with zero per-query gathers
-    tm_cols: dict[str, jnp.ndarray] = field(default_factory=dict)
-    tm_nulls: dict[str, jnp.ndarray] = field(default_factory=dict)
-    tm_valid: jnp.ndarray | None = None
+    tm_cols: dict[str, list] = field(default_factory=dict)
+    tm_nulls: dict[str, list] = field(default_factory=dict)
+    tm_valid: list | None = None
     nbytes: int = 0
     host_nbytes: int = 0  # sorted_host/order/offsets bytes (host budget)
 
@@ -412,10 +432,11 @@ class TileCacheManager:
                     self._host_used += hb
 
             added = 0
+            bounds = _chunk_bounds(entry.pad)
             if entry.valid is None:
                 v = np.zeros(entry.pad, bool)
                 v[: entry.num_rows] = True
-                entry.valid = jnp.asarray(v)
+                entry.valid = [jnp.asarray(v[a:b]) for a, b in bounds]
                 added += v.nbytes
             for name in missing:
                 src = next(
@@ -447,10 +468,10 @@ class TileCacheManager:
                     )
                     nbuf = np.zeros(entry.pad, bool)
                     nbuf[: entry.num_rows] = ncat[entry.order]
-                entry.cols[name] = jnp.asarray(buf)
+                entry.cols[name] = [jnp.asarray(buf[a:b]) for a, b in bounds]
                 added += buf.nbytes
                 if nbuf is not None:
-                    entry.nulls[name] = jnp.asarray(nbuf)
+                    entry.nulls[name] = [jnp.asarray(nbuf[a:b]) for a, b in bounds]
                     added += nbuf.nbytes
                 if name in tag_cols or name in pk_cols:
                     entry.epochs[name] = dictionary.epoch
@@ -483,12 +504,11 @@ class TileCacheManager:
                         continue
                     perm = dictionary.perm_since(tag, entry.epochs[tag])
                     if perm is not None:
-                        entry.cols[tag] = jnp.take(
-                            jnp.asarray(perm),
-                            entry.cols[tag],
-                            mode="fill",
-                            fill_value=-1,
-                        ).astype(jnp.int32)
+                        pdev = jnp.asarray(perm)
+                        entry.cols[tag] = [
+                            jnp.take(pdev, c, mode="fill", fill_value=-1).astype(jnp.int32)
+                            for c in entry.cols[tag]
+                        ]
                     entry.epochs[tag] = dictionary.epoch
                     entry.tm_cols.pop(tag, None)
                 for tag, epoch in list(entry.host_epochs.items()):
@@ -509,17 +529,22 @@ class TileCacheManager:
         time-major dispatches are gather-free.  Returns (cols, valid,
         nulls) views limited to `cols_needed`."""
         perm = self.ensure_perm(entry, ts_name)
+        bounds = _chunk_bounds(entry.pad)
         added = 0
         with self._lock:
+            def permuted_chunks(chunks):
+                full = jnp.concatenate(chunks)[perm]
+                return [full[a:b] for a, b in bounds]
+
             if entry.tm_valid is None:
-                entry.tm_valid = entry.valid[perm]
+                entry.tm_valid = permuted_chunks(entry.valid)
                 added += entry.pad
             for c in cols_needed:
                 if c in entry.cols and c not in entry.tm_cols:
-                    entry.tm_cols[c] = entry.cols[c][perm]
-                    added += int(entry.cols[c].nbytes)
+                    entry.tm_cols[c] = permuted_chunks(entry.cols[c])
+                    added += sum(int(x.nbytes) for x in entry.cols[c])
                 if c in entry.nulls and c not in entry.tm_nulls:
-                    entry.tm_nulls[c] = entry.nulls[c][perm]
+                    entry.tm_nulls[c] = permuted_chunks(entry.nulls[c])
                     added += entry.pad
             if added:
                 entry.nbytes += added
@@ -578,8 +603,9 @@ class TileCacheManager:
         entry is still cached) and the argsort never runs twice."""
         with self._lock:
             if entry.perm is None:
-                ts = entry.cols[ts_name]
-                key = jnp.where(entry.valid, ts, jnp.iinfo(jnp.int64).max)
+                ts = jnp.concatenate(entry.cols[ts_name])
+                valid = jnp.concatenate(entry.valid)
+                key = jnp.where(valid, ts, jnp.iinfo(jnp.int64).max)
                 entry.perm = jnp.argsort(key).astype(jnp.int32)
                 entry.nbytes += entry.pad * 4
                 if self._super.get(entry.region_id) is entry:
@@ -687,17 +713,28 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
         if col in nullable_cols and col != COUNT_STAR:
             int_layout.append((col, "count"))
 
-    def run_all(sources, dyn):
-        merged = None
-        for cols, valid, nulls, perm in sources:
-            states = compute_partial_states(
-                plan, cols, valid, nulls, dyn, perm=perm, count_cols=nullable_cols
-            )
-            merged = (
-                states
-                if merged is None
-                else {k: merge_states(merged[k], states[k]) for k in merged}
-            )
+    # THREE small jitted pieces with a host-side loop, NOT one jit over
+    # every source: per-source partials share one compile per chunk shape
+    # (chunks are equal-sized by construction) and successive dispatches
+    # execute in order on the device stream, so peak HBM is ONE chunk's
+    # working set.  A single unrolled program over 4 chunks x 10 columns
+    # both overcommitted HBM (concurrent column scheduling) and took
+    # minutes to compile.
+    partial_jit = jax.jit(
+        functools.partial(
+            compute_partial_states, plan, count_cols=nullable_cols
+        ),
+        static_argnames=(),
+    )
+
+    def _partial(cols, valid, nulls, dyn, perm):
+        return partial_jit(cols, valid, nulls, dyn, perm)
+
+    merge_jit = jax.jit(
+        lambda a, b: {k: merge_states(a[k], b[k]) for k in a}
+    )
+
+    def _final(merged):
         presence = merged["__presence"].counts
         outs = {"__presence": {"count": presence}}
         for col, aggs in per_col_aggs.items():
@@ -716,7 +753,16 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
             accs = jnp.zeros((0, ints.shape[1]), jnp.float64)
         return ints, accs
 
-    return jax.jit(run_all), tuple(int_layout), tuple(acc_layout)
+    final_jit = jax.jit(_final)
+
+    def run_all(sources, dyn):
+        merged = None
+        for cols, valid, nulls, perm in sources:
+            states = _partial(cols, valid, nulls, dyn, perm)
+            merged = states if merged is None else merge_jit(merged, states)
+        return final_jit(merged)
+
+    return run_all, tuple(int_layout), tuple(acc_layout)
 
 
 class TileExecutor:
@@ -949,13 +995,18 @@ class TileExecutor:
                     cols, valid, nulls = self.cache.ensure_time_major(
                         s, use_ts, need_cols
                     )
-                    device_sources.append((cols, valid, nulls, None))
                 else:
+                    cols = {k: v for k, v in s.cols.items() if k in need_cols}
+                    valid = s.valid
+                    nulls = {k: v for k, v in s.nulls.items() if k in need_cols}
+                # one jit source per chunk: bounded per-dispatch temporaries
+                # (see _SuperTiles.cols), merged on device like any source
+                for i in range(len(valid)):
                     device_sources.append(
                         (
-                            {k: v for k, v in s.cols.items() if k in need_cols},
-                            s.valid,
-                            {k: v for k, v in s.nulls.items() if k in need_cols},
+                            {k: v[i] for k, v in cols.items()},
+                            valid[i],
+                            {k: v[i] for k, v in nulls.items()},
                             None,
                         )
                     )

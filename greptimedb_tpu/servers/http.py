@@ -565,10 +565,36 @@ def _prom_vector_json(table: pa.Table) -> dict:
 
 
 class HttpServer:
-    def __init__(self, db, addr: str = "127.0.0.1:0"):
+    def __init__(self, db, addr: str = "127.0.0.1:0", tls=None):
+        """`tls`: optional (cert_path, key_path) serving HTTPS (reference
+        servers/src/tls.rs TlsOption on the axum router)."""
         host, port = addr.rsplit(":", 1)
         handler = type("BoundHandler", (_Handler,), {"db": db})
-        self._httpd = ThreadingHTTPServer((host, int(port)), handler)
+        if tls is not None:
+            from ..utils.tls import make_server_context
+
+            ctx = make_server_context(*tls)
+
+            class _TlsHTTPServer(ThreadingHTTPServer):
+                # wrap PER CONNECTION in the worker thread: wrapping the
+                # LISTENING socket runs the handshake inside accept(), so
+                # one silent TCP client would block every other connection
+                def finish_request(self, request, client_address):
+                    request.settimeout(10.0)
+                    try:
+                        request = ctx.wrap_socket(request, server_side=True)
+                    except Exception:  # noqa: BLE001 — bad handshake: drop
+                        try:
+                            request.close()
+                        except OSError:
+                            pass
+                        return
+                    request.settimeout(None)
+                    super().finish_request(request, client_address)
+
+            self._httpd = _TlsHTTPServer((host, int(port)), handler)
+        else:
+            self._httpd = ThreadingHTTPServer((host, int(port)), handler)
         self._thread: threading.Thread | None = None
 
     @property

@@ -29,6 +29,7 @@ from ..utils.metrics import REGISTRY
 CLIENT_LONG_PASSWORD = 0x1
 CLIENT_PROTOCOL_41 = 0x200
 CLIENT_CONNECT_WITH_DB = 0x8
+CLIENT_SSL = 0x800
 CLIENT_SECURE_CONNECTION = 0x8000
 CLIENT_PLUGIN_AUTH = 0x80000
 CLIENT_TRANSACTIONS = 0x2000
@@ -165,10 +166,22 @@ class _Handler(socketserver.BaseRequestHandler):
         io = _PacketIO(self.request)
         session = _Session(srv)
         nonce = os.urandom(20)
-        io.send_packet(self._handshake_v10(nonce))
+        tls_ctx = getattr(srv, "tls_context", None)
+        caps = SERVER_CAPABILITIES | (CLIENT_SSL if tls_ctx is not None else 0)
+        io.send_packet(self._handshake_v10(nonce, caps))
         resp = io.read_packet()
         if resp is None:
             return
+        client_caps = struct.unpack_from("<I", resp, 0)[0] if len(resp) >= 4 else 0
+        if client_caps & CLIENT_SSL and tls_ctx is not None:
+            # SSLRequest: a short packet (no username); upgrade the stream
+            # and read the REAL handshake response over TLS (reference
+            # opensrv + tls.rs flow)
+            self.request = tls_ctx.wrap_socket(self.request, server_side=True)
+            io = _PacketIO(self.request)
+            resp = io.read_packet()
+            if resp is None:
+                return
         ok, username, database = self._check_auth(srv, resp, nonce)
         if not ok:
             self._send_err(io, 1045, "28000", f"Access denied for user '{username}'")
@@ -216,16 +229,17 @@ class _Handler(socketserver.BaseRequestHandler):
                 self._send_err(io, 1105, "HY000", f"{type(e).__name__}: {e}")
 
     # ---- handshake --------------------------------------------------------
-    def _handshake_v10(self, nonce: bytes) -> bytes:
+    def _handshake_v10(self, nonce: bytes, caps: int = None) -> bytes:
+        caps = SERVER_CAPABILITIES if caps is None else caps
         out = bytearray()
         out.append(10)  # protocol version
         out += b"8.4.0-greptimedb-tpu\x00"
         out += struct.pack("<I", threading.get_ident() & 0xFFFFFFFF)
         out += nonce[:8] + b"\x00"
-        out += struct.pack("<H", SERVER_CAPABILITIES & 0xFFFF)
+        out += struct.pack("<H", caps & 0xFFFF)
         out.append(0x21)  # charset utf8_general_ci
         out += struct.pack("<H", 0x0002)  # status: autocommit
-        out += struct.pack("<H", (SERVER_CAPABILITIES >> 16) & 0xFFFF)
+        out += struct.pack("<H", (caps >> 16) & 0xFFFF)
         out.append(21)  # auth plugin data length
         out += b"\x00" * 10
         out += nonce[8:20] + b"\x00"
@@ -475,9 +489,18 @@ class _ThreadingTCPServer(socketserver.ThreadingTCPServer):
 
 
 class MysqlServer:
-    def __init__(self, db, addr: str = "127.0.0.1:0", user_provider=None):
+    def __init__(
+        self, db, addr: str = "127.0.0.1:0", user_provider=None, tls=None
+    ):
+        """`tls`: optional (cert_path, key_path) enabling the in-protocol
+        TLS upgrade (reference servers/src/tls.rs TlsOption)."""
         self.db = db
         self.user_provider = user_provider
+        self.tls_context = None
+        if tls is not None:
+            from ..utils.tls import make_server_context
+
+            self.tls_context = make_server_context(*tls)
         host, port = addr.rsplit(":", 1)
         self._tcp = _ThreadingTCPServer((host, int(port)), _Handler)
         self._tcp.gt_server = self  # type: ignore[attr-defined]

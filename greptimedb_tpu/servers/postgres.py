@@ -161,6 +161,7 @@ class _Handler(socketserver.BaseRequestHandler):
         srv.db.ensure_session()  # anchor per-connection session state
         try:
             params = self._startup(sock)
+            sock = self.request  # may have been TLS-wrapped during startup
             if params is None:
                 return
             user = params.get("user", "")
@@ -209,7 +210,14 @@ class _Handler(socketserver.BaseRequestHandler):
                 return None
             (code,) = struct.unpack("!I", body[:4])
             if code == SSL_REQUEST:
-                sock.sendall(b"N")  # no TLS; client retries in clear
+                srv = self.server.gt_server  # type: ignore[attr-defined]
+                ctx = getattr(srv, "tls_context", None)
+                if ctx is None:
+                    sock.sendall(b"N")  # no TLS configured; client may retry clear
+                    continue
+                sock.sendall(b"S")
+                sock = ctx.wrap_socket(sock, server_side=True)
+                self.request = sock  # downstream reads/writes ride TLS
                 continue
             if code == CANCEL_REQUEST:
                 return None
@@ -542,9 +550,18 @@ class _ThreadingTCPServer(socketserver.ThreadingTCPServer):
 
 
 class PostgresServer:
-    def __init__(self, db, addr: str = "127.0.0.1:0", user_provider=None):
+    def __init__(
+        self, db, addr: str = "127.0.0.1:0", user_provider=None, tls=None
+    ):
+        """`tls`: optional (cert_path, key_path) enabling the SSLRequest
+        upgrade (reference servers/src/tls.rs TlsOption)."""
         self.db = db
         self.user_provider = user_provider
+        self.tls_context = None
+        if tls is not None:
+            from ..utils.tls import make_server_context
+
+            self.tls_context = make_server_context(*tls)
         host, port = addr.rsplit(":", 1)
         self._tcp = _ThreadingTCPServer((host, int(port)), _Handler)
         self._tcp.gt_server = self  # type: ignore[attr-defined]

@@ -69,6 +69,7 @@ class TimeSeriesEngine:
         self._lock = threading.Lock()
         self.compactor = None
         self.flusher = None
+        self._workers = None  # lazy sharded write loops (storage/worker.py)
         if getattr(self.config, "async_flush_enable", True):
             from .maintenance import FlushScheduler
 
@@ -245,6 +246,27 @@ class TimeSeriesEngine:
     def _region_store(self, region_id: int):
         return self.object_store.scoped(f"region_{region_id}")
 
+    @property
+    def workers(self):
+        """Sharded single-writer-per-region loops with request batching
+        (reference mito2/src/worker.rs WorkerGroup); created on first use
+        so simple embedded engines never spawn threads."""
+        if self._workers is None:
+            from .worker import WorkerGroup
+
+            with self._lock:
+                if self._workers is None:
+                    self._workers = WorkerGroup(
+                        self, num_workers=self.config.num_workers
+                    )
+        return self._workers
+
+    def submit_write(self, region_id: int, batch: pa.RecordBatch):
+        """Queue a write on the region's worker loop; returns a Future of
+        affected rows (pipelined ingest: protocol servers overlap decode
+        of the next request with this write's WAL+memtable apply)."""
+        return self.workers.submit_write(region_id, batch)
+
     def scan_stream(
         self,
         region_id: int,
@@ -263,6 +285,8 @@ class TimeSeriesEngine:
                 yield chunk
 
     def close(self):
+        if self._workers is not None:
+            self._workers.stop()
         if self.flusher is not None:
             self.flusher.stop()
         if self.compactor is not None:

