@@ -190,26 +190,31 @@ def _http_ingest_probe(db) -> dict:
     try:
         url = f"http://{srv.address}/v1/influxdb/write?db=public"
         rng = np.random.default_rng(3)
-        rows_per_host = max(HTTP_INGEST_ROWS // 500, 1)
+        batch_rows = 5000
+        n_batches = max(HTTP_INGEST_ROWS // batch_rows, 1)
+        bodies = []
+        for b in range(n_batches):
+            # distinct (host, ms-timestamp) per row — sub-ms offsets would
+            # collapse after the server's ns->ms conversion and the dedup'd
+            # rows would inflate the rows/s number
+            ts_ms0 = T0 + HOURS * 3600_000 + b * 10_000 + 1000
+            vals = rng.uniform(0, 100, batch_rows)
+            bodies.append("\n".join(
+                f"cpu_http,hostname=host_{h % 1000} usage_user={vals[h]:.3f} "
+                f"{(ts_ms0 + h) * 1_000_000}"
+                for h in range(batch_rows)
+            ).encode())
         total = 0
-        t_total = 0.0
-        batch_hosts = 500
-        for b in range(rows_per_host):
-            ts_ns = (T0 + HOURS * 3600_000 + b * 1000 + 1000) * 1_000_000
-            vals = rng.uniform(0, 100, batch_hosts)
-            lines = "\n".join(
-                f"cpu_http,hostname=host_{h} usage_user={vals[h]:.3f} {ts_ns + h}"
-                for h in range(batch_hosts)
-            )
+        t0 = time.perf_counter()
+        for body in bodies:
             req = urllib.request.Request(
-                url, data=lines.encode(), method="POST",
+                url, data=body, method="POST",
                 headers={"Content-Type": "text/plain"},
             )
-            t0 = time.perf_counter()
             with urllib.request.urlopen(req) as resp:
                 resp.read()
-            t_total += time.perf_counter() - t0
-            total += batch_hosts
+            total += batch_rows
+        t_total = time.perf_counter() - t0
         return {
             "ingest_http_rows_per_sec": round(total / max(t_total, 1e-9)),
             "ingest_http_rows": total,

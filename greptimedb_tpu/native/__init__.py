@@ -64,9 +64,10 @@ def load() -> ctypes.CDLL | None:
         "gt_snappy_decompress",
         "gt_snappy_compress",
         "gt_snappy_max_compressed_length",
+        "gt_lp_parse_homogeneous",
     ):
         if not hasattr(lib, name):
-            # Stale .so from before the snappy entry points: rebuild once.
+            # Stale .so missing newer entry points: rebuild once.
             _lib = None
             try:
                 os.remove(_LIB_PATH)
@@ -151,6 +152,63 @@ TOK_FIELD_BOOL_T = 7
 TOK_FIELD_BOOL_F = 8
 TOK_TIMESTAMP = 9
 TOK_LINE_END = 10
+
+
+def lp_parse_homogeneous(buf: bytes, mult_num: int, mult_den: int,
+                         max_tags: int = 16, max_fields: int = 32):
+    """Columnar parse of a HOMOGENEOUS line-protocol batch (one
+    measurement, fixed tag/float-field keys, timestamps present).
+    Returns (measurement, tag_keys, field_keys, ts int64[n],
+    fields float64[n, n_fields], tag_spans int64[n, n_tags, 2]) or None
+    (unavailable / batch not homogeneous — fall back to the tokenizer)."""
+    lib = load()
+    if lib is None or not hasattr(lib, "gt_lp_parse_homogeneous"):
+        return None
+    import numpy as np
+
+    # size outputs from LINE 1's shape (every later line must match it or
+    # the parse bails anyway) — sizing by the caps wasted ~500 MB on
+    # million-line single-field batches
+    first = buf.split(b"\n", 1)[0]
+    head = first.split(b" ", 1)
+    max_tags = min(max_tags, max(head[0].count(b","), 1))
+    if len(head) > 1:
+        max_fields = min(max_fields, max(head[1].count(b",") + 2, 2))
+    max_lines = buf.count(b"\n") + 2
+    ts = np.empty(max_lines, dtype=np.int64)
+    fields = np.empty(max_lines * max_fields, dtype=np.float64)
+    tag_spans = np.empty(max_lines * max_tags * 2, dtype=np.int64)
+    shape = np.zeros(4 + 2 * max_tags + 2 * max_fields, dtype=np.int64)
+    fn = lib.gt_lp_parse_homogeneous
+    fn.restype = ctypes.c_int64
+    n = fn(
+        buf, ctypes.c_int64(len(buf)),
+        ctypes.c_int64(mult_num), ctypes.c_int64(mult_den),
+        ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        fields.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        tag_spans.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(max_lines), ctypes.c_int64(max_tags),
+        ctypes.c_int64(max_fields),
+        shape.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    if n <= 0:
+        return None
+    n_tags, n_fields = int(shape[0]), int(shape[1])
+    measurement = buf[shape[2]:shape[3]].decode()
+    tag_keys = [
+        buf[shape[4 + t * 2]:shape[4 + t * 2 + 1]].decode() for t in range(n_tags)
+    ]
+    base = 4 + max_tags * 2
+    field_keys = [
+        buf[shape[base + f * 2]:shape[base + f * 2 + 1]].decode()
+        for f in range(n_fields)
+    ]
+    return (
+        measurement, tag_keys, field_keys,
+        ts[:n].copy(),
+        fields.reshape(max_lines, max_fields)[:n, :n_fields].copy(),
+        tag_spans.reshape(max_lines, max_tags, 2)[:n, :n_tags].copy(),
+    )
 
 
 def lp_tokenize(buf: bytes, max_tokens: int | None = None):

@@ -14,6 +14,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <cstdlib>
 #include <cstddef>
 
 extern "C" {
@@ -216,6 +217,169 @@ int64_t gt_lp_tokenize(const uint8_t* buf, int64_t len, int64_t* out,
         while (i < len && buf[i] != '\n') i++;
     }
     return n;
+}
+
+// Homogeneous columnar line-protocol parse (the hot ingest shape: every
+// line shares one measurement, the same tag keys in order, the same
+// FLOAT field keys, and carries a timestamp — the TSBS/scrape pattern).
+// Fills ts (int64, scaled by mult_num/mult_den), fields (row-major
+// doubles, n_fields per line) and tag value byte-spans (2 int64 per
+// (line, tag)).  Returns the line count, or -1 when the batch does not
+// fit the homogeneous shape (caller falls back to the tokenizer path).
+int64_t gt_lp_parse_homogeneous(const uint8_t* buf, int64_t len,
+                                int64_t mult_num, int64_t mult_den,
+                                int64_t* ts_out, double* field_out,
+                                int64_t* tag_spans_out,
+                                int64_t max_lines, int64_t max_tags,
+                                int64_t max_fields,
+                                int64_t* shape_out /* [4+2*max_tags+2*max_fields]:
+                                n_tags, n_fields, then key spans from line 1 */) {
+    int64_t i = 0, n_lines = 0;
+    int64_t n_tags = -1, n_fields = -1;
+    // first-line layout spans (keys compared by bytes for later lines)
+    int64_t tag_key_spans[64][2];
+    int64_t field_key_spans[64][2];
+    int64_t meas_s = -1, meas_e = -1;
+    while (i < len) {
+        while (i < len && (buf[i] == '\n' || buf[i] == '\r')) i++;
+        if (i >= len) break;
+        if (buf[i] == '#') { while (i < len && buf[i] != '\n') i++; continue; }
+        if (n_lines >= max_lines) return -1;
+        // measurement
+        int64_t s = i;
+        while (i < len && buf[i] != ',' && buf[i] != ' ') {
+            if (buf[i] == '\\') return -1;  // escapes: fallback
+            i++;
+        }
+        if (i >= len) return -1;
+        if (meas_s < 0) { meas_s = s; meas_e = i; }
+        else if (i - s != meas_e - meas_s ||
+                 memcmp(buf + s, buf + meas_s, i - s) != 0) return -1;
+        // tags
+        int64_t t = 0;
+        while (i < len && buf[i] == ',') {
+            i++;
+            int64_t ks = i;
+            while (i < len && buf[i] != '=') {
+                if (buf[i] == '\\') return -1;
+                i++;
+            }
+            if (i >= len) return -1;
+            int64_t ke = i;
+            i++;
+            int64_t vs = i;
+            while (i < len && buf[i] != ',' && buf[i] != ' ') {
+                if (buf[i] == '\\') return -1;
+                i++;
+            }
+            if (t >= max_tags || t >= 64) return -1;
+            if (n_tags < 0) { tag_key_spans[t][0] = ks; tag_key_spans[t][1] = ke; }
+            else {
+                if (t >= n_tags) return -1;
+                if (ke - ks != tag_key_spans[t][1] - tag_key_spans[t][0] ||
+                    memcmp(buf + ks, buf + tag_key_spans[t][0], ke - ks) != 0)
+                    return -1;
+            }
+            tag_spans_out[(n_lines * max_tags + t) * 2] = vs;
+            tag_spans_out[(n_lines * max_tags + t) * 2 + 1] = i;
+            t++;
+        }
+        if (n_tags < 0) n_tags = t;
+        else if (t != n_tags) return -1;
+        if (i >= len || buf[i] != ' ') return -1;
+        while (i < len && buf[i] == ' ') i++;
+        // fields (floats only)
+        int64_t f = 0;
+        bool more = true;
+        while (more) {
+            int64_t ks = i;
+            while (i < len && buf[i] != '=') {
+                if (buf[i] == '\\' || buf[i] == ' ' || buf[i] == '\n') return -1;
+                i++;
+            }
+            if (i >= len) return -1;
+            int64_t ke = i;
+            i++;
+            if (i < len && buf[i] == '"') return -1;  // string field: fallback
+            int64_t vs = i;
+            while (i < len && buf[i] != ',' && buf[i] != ' ' && buf[i] != '\n') i++;
+            if (i == vs) return -1;
+            uint8_t last = buf[i - 1];
+            if (last == 'i' || last == 'u' || last == 't' || last == 'T' ||
+                last == 'e' || last == 'E') {
+                // int/bool suffixes (or true/false): not the float shape
+                // (exponents also bail — strtod below would handle them,
+                // but 'e' is ambiguous with "false"; keep the fast path
+                // strict and let the tokenizer path take the rest)
+                return -1;
+            }
+            if (f >= max_fields || f >= 64) return -1;
+            if (n_fields < 0) { field_key_spans[f][0] = ks; field_key_spans[f][1] = ke; }
+            else {
+                if (f >= n_fields) return -1;
+                if (ke - ks != field_key_spans[f][1] - field_key_spans[f][0] ||
+                    memcmp(buf + ks, buf + field_key_spans[f][0], ke - ks) != 0)
+                    return -1;
+            }
+            char tmp[64];
+            int64_t flen = i - vs;
+            if (flen >= (int64_t)sizeof(tmp)) return -1;
+            for (int64_t k = vs; k < i; k++)
+                // strtod also eats hex floats ("0x1.8p3") and inf — the
+                // exact (Python) path rejects those, so bail to it
+                if (buf[k] == 'x' || buf[k] == 'X' || buf[k] == 'n' ||
+                    buf[k] == 'N')
+                    return -1;
+            memcpy(tmp, buf + vs, flen);
+            tmp[flen] = 0;
+            char* endp = nullptr;
+            double v = strtod(tmp, &endp);
+            if (endp != tmp + flen) return -1;
+            field_out[n_lines * max_fields + f] = v;
+            f++;
+            if (i < len && buf[i] == ',') { i++; continue; }
+            more = false;
+        }
+        if (n_fields < 0) n_fields = f;
+        else if (f != n_fields) return -1;
+        // timestamp (required on the fast path)
+        if (i >= len || buf[i] != ' ') return -1;
+        while (i < len && buf[i] == ' ') i++;
+        bool neg = false;
+        if (i < len && buf[i] == '-') { neg = true; i++; }
+        int64_t tv = 0;
+        int ndig = 0;
+        while (i < len && buf[i] >= '0' && buf[i] <= '9') {
+            int d = buf[i] - '0';
+            if (tv > (INT64_MAX - d) / 10) return -1;  // would overflow
+            tv = tv * 10 + d;
+            ndig++;
+            i++;
+        }
+        if (ndig == 0) return -1;  // empty or a lone '-'
+        if (i < len && buf[i] != '\n' && buf[i] != '\r' && buf[i] != ' ') return -1;
+        if (neg) tv = -tv;
+        if (mult_num > 1 &&
+            (tv > INT64_MAX / mult_num || tv < INT64_MIN / mult_num))
+            return -1;
+        ts_out[n_lines] = tv * mult_num / mult_den;
+        n_lines++;
+        while (i < len && buf[i] != '\n') i++;
+    }
+    if (n_lines == 0 || n_tags < 0 || n_fields < 0) return -1;
+    shape_out[0] = n_tags;
+    shape_out[1] = n_fields;
+    shape_out[2] = meas_s;
+    shape_out[3] = meas_e;
+    for (int64_t t = 0; t < n_tags; t++) {
+        shape_out[4 + t * 2] = tag_key_spans[t][0];
+        shape_out[4 + t * 2 + 1] = tag_key_spans[t][1];
+    }
+    for (int64_t f = 0; f < n_fields; f++) {
+        shape_out[4 + max_tags * 2 + f * 2] = field_key_spans[f][0];
+        shape_out[4 + max_tags * 2 + f * 2 + 1] = field_key_spans[f][1];
+    }
+    return n_lines;
 }
 
 // --------------------------------------------------------------- snappy ----

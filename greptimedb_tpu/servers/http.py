@@ -339,10 +339,19 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
     def _handle_influx(self, params):
-        body = (params.get("__body") or b"").decode()
+        body_raw = params.get("__body") or b""
         precision = params.get("precision", "ns")
-        points = parse_line_protocol(body, precision)
-        n = write_points(self.db, points)
+        # columnar fast path for homogeneous batches (native parser, no
+        # str round-trip); mixed/escaped batches take the Point parser
+        from .influx import parse_line_protocol_columnar, write_columnar
+
+        col = parse_line_protocol_columnar(body_raw, precision)
+        if col is not None:
+            measurement, table, tag_keys = col
+            n = write_columnar(self.db, measurement, table, tag_keys)
+        else:
+            points = parse_line_protocol(body_raw.decode(), precision)
+            n = write_points(self.db, points)
         REGISTRY.counter("greptime_http_influx_rows_total", "Influx rows").inc(n)
         return self._send(204, b"", "text/plain")
 

@@ -21,7 +21,7 @@ from ..datatypes.data_type import ConcreteDataType
 from ..datatypes.schema import ColumnSchema, Schema, SemanticType
 from ..utils.errors import InvalidArgumentsError
 
-_PRECISION_TO_MS = {"ns": 1e-6, "us": 1e-3, "ms": 1.0, "s": 1000.0}
+_PRECISION_TO_MS = {"ns": 1e-6, "us": 1e-3, "u": 1e-3, "ms": 1.0, "s": 1000.0}
 
 
 @dataclass
@@ -83,6 +83,47 @@ def _parse_field_value(raw: str):
     if raw.endswith(("i", "u")):
         return int(raw[:-1])
     return float(raw)
+
+
+_PRECISION_FRAC = {"ns": (1, 1_000_000), "us": (1, 1_000), "u": (1, 1_000),
+                   "ms": (1, 1), "s": (1_000, 1)}
+
+
+def parse_line_protocol_columnar(body, precision: str = "ns"):
+    """Columnar fast path for homogeneous batches (native
+    gt_lp_parse_homogeneous): returns (measurement, pa.Table, tag_keys)
+    ready for the bulk insert path, or None (fall back to the Point
+    parser).  The hot scrape/TSBS shape — one measurement, fixed tags,
+    float fields — skips per-point Python objects entirely.  `body` may
+    be bytes (preferred: no str round-trip) or str."""
+    frac = _PRECISION_FRAC.get(precision)
+    if frac is None:
+        return None
+    from .. import native
+
+    buf = bytes(body) if isinstance(body, (bytes, bytearray)) else body.encode()
+    out = native.lp_parse_homogeneous(buf, frac[0], frac[1])
+    if out is None:
+        return None
+    import pyarrow as _pa
+
+    measurement, tag_keys, field_keys, ts, fields, tag_spans = out
+    # a tag or field named like the timestamp column, or any duplicate
+    # key, would silently shadow a column — those batches take the exact
+    # Point path instead
+    all_keys = tag_keys + field_keys
+    if "ts" in all_keys or len(set(all_keys)) != len(all_keys):
+        return None
+    cols: dict = {}
+    for t, key in enumerate(tag_keys):
+        spans = tag_spans[:, t]
+        cols[key] = _pa.array(
+            [buf[s:e].decode() for s, e in spans], _pa.string()
+        )
+    cols["ts"] = _pa.array(ts, _pa.timestamp("ms"))
+    for f, key in enumerate(field_keys):
+        cols[key] = _pa.array(fields[:, f], _pa.float64())
+    return measurement, _pa.table(cols), tag_keys
 
 
 def parse_line_protocol(body: str, precision: str = "ns") -> list[Point]:
@@ -178,6 +219,86 @@ def _field_type(v) -> ConcreteDataType:
     return ConcreteDataType.STRING
 
 
+def _ensure_table(db, table_name: str, tag_names, field_types: dict):
+    """Auto-create the table, or alter in any new FIELD columns — shared by
+    the Point and columnar write paths (reference
+    operator/src/insert.rs:159 create_or_alter_tables_on_demand).  New tags
+    are rejected (primary-key columns cannot be added).  Returns the table
+    meta."""
+    if not db.catalog.has_table(table_name, db.current_database):
+        columns = [
+            ColumnSchema(t, ConcreteDataType.STRING, SemanticType.TAG)
+            for t in tag_names
+        ]
+        columns.append(
+            ColumnSchema("ts", ConcreteDataType.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP)
+        )
+        columns += [
+            ColumnSchema(f, t, SemanticType.FIELD) for f, t in field_types.items()
+        ]
+        return db.catalog.create_table(
+            table_name,
+            Schema(columns=columns),
+            database=db.current_database,
+            if_not_exists=True,
+            on_create=lambda m: [
+                db.storage.create_region(rid, m.schema) for rid in m.region_ids
+            ],
+        )
+    meta = db.catalog.table(table_name, db.current_database)
+    schema = meta.schema
+    for tname in tag_names:
+        if not schema.has_column(tname):
+            raise InvalidArgumentsError(
+                f"new tag {tname!r} on existing table {table_name!r} "
+                "(tags are part of the primary key and cannot be added)"
+            )
+    new_cols = [
+        ColumnSchema(f, t, SemanticType.FIELD)
+        for f, t in field_types.items()
+        if not schema.has_column(f)
+    ]
+    if new_cols:
+        for c in new_cols:
+            schema = schema.add_column(c)
+        meta.schema = schema
+        db.catalog.update_table(meta)
+        for rid in meta.region_ids:
+            db.storage.region(rid).alter_schema(schema)
+    return meta
+
+
+def write_columnar(db, measurement: str, table, tag_keys: list[str]) -> int:
+    """Bulk path for the columnar parse: ensure the table exists and has
+    every field column (same auto-create/alter rules as write_points),
+    then hand the whole Arrow table to the inserter — no per-point Python
+    objects on the hot scrape shape."""
+    field_keys = [
+        name for name in table.column_names
+        if name not in tag_keys and name != "ts"
+    ]
+    meta = _ensure_table(
+        db, measurement, tag_keys,
+        {f: ConcreteDataType.FLOAT64 for f in field_keys},
+    )
+    schema = meta.schema
+    ts_name = schema.time_index.name if schema.time_index else "ts"
+    if ts_name != "ts" and "ts" in table.column_names:
+        if ts_name in table.column_names:
+            # renaming would produce two columns named ts_name and the
+            # inserter would silently null-fill the time index
+            raise InvalidArgumentsError(
+                f"column {ts_name!r} collides with the time index of "
+                f"table {measurement!r}"
+            )
+        # the parser labels the timestamp 'ts'; an existing table may
+        # call its time index anything
+        table = table.rename_columns(
+            [ts_name if c == "ts" else c for c in table.column_names]
+        )
+    return db.insert_rows(measurement, table, database=db.current_database)
+
+
 def write_points(db, points: list[Point], default_now_ms: int | None = None) -> int:
     """Group points by measurement, auto-create/alter tables, insert."""
     import time as _time
@@ -201,41 +322,7 @@ def write_points(db, points: list[Point], default_now_ms: int | None = None) -> 
                 if prev is None or (prev == ConcreteDataType.INT64 and t == ConcreteDataType.FLOAT64):
                     field_types[fname] = t
 
-        if not db.catalog.has_table(table_name, db.current_database):
-            columns = [ColumnSchema(t, ConcreteDataType.STRING, SemanticType.TAG) for t in tag_names]
-            columns.append(
-                ColumnSchema("ts", ConcreteDataType.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP)
-            )
-            columns += [ColumnSchema(f, t, SemanticType.FIELD) for f, t in field_types.items()]
-            meta = db.catalog.create_table(
-                table_name,
-                Schema(columns=columns),
-                database=db.current_database,
-                if_not_exists=True,
-                on_create=lambda m: [
-                    db.storage.create_region(rid, m.schema) for rid in m.region_ids
-                ],
-            )
-        else:
-            meta = db.catalog.table(table_name, db.current_database)
-            schema = meta.schema
-            new_cols = []
-            for tname in tag_names:
-                if not schema.has_column(tname):
-                    raise InvalidArgumentsError(
-                        f"new tag {tname!r} on existing table {table_name!r} "
-                        "(tags are part of the primary key and cannot be added)"
-                    )
-            for fname, t in field_types.items():
-                if not schema.has_column(fname):
-                    new_cols.append(ColumnSchema(fname, t, SemanticType.FIELD))
-            if new_cols:
-                for c in new_cols:
-                    schema = schema.add_column(c)
-                meta.schema = schema
-                db.catalog.update_table(meta)
-                for rid in meta.region_ids:
-                    db.storage.region(rid).alter_schema(schema)
+        _ensure_table(db, table_name, tag_names, field_types)
 
         meta = db.catalog.table(table_name, db.current_database)
         schema = meta.schema

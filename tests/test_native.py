@@ -61,3 +61,22 @@ def test_lp_tokenizer_matches_python_parser():
 def test_lp_tokenizer_error_offset():
     with pytest.raises(Exception):
         native.lp_tokenize(b"measurement_no_fields\n")
+
+
+def test_lp_homogeneous_rejects_hostile_numbers():
+    """The columnar fast path must bail (return None -> exact path) on
+    inputs strtod would mis-accept or overflow: hex floats, inf/nan,
+    >int64 timestamps, a lone '-' timestamp."""
+    if native.load() is None or not hasattr(native.load(), "gt_lp_parse_homogeneous"):
+        pytest.skip("native lib unavailable")
+    ok = native.lp_parse_homogeneous(b"m,h=a v=1.5 1700000000\n", 1000, 1)
+    assert ok is not None
+    for bad in (
+        b"m,h=a v=0x1.8p3 1700000000\n",      # hex float
+        b"m,h=a v=inf 1700000000\n",          # inf
+        b"m,h=a v=nan 1700000000\n",          # nan
+        b"m,h=a v=1.5 99999999999999999999\n",  # ts overflows int64
+        b"m,h=a v=1.5 9999999999999999999\n",   # ts * 1000 overflows
+        b"m,h=a v=1.5 -\n",                   # lone '-' timestamp
+    ):
+        assert native.lp_parse_homogeneous(bad, 1000, 1) is None, bad

@@ -257,3 +257,68 @@ def test_tls_http_postgres_mysql(tmp_path):
     finally:
         my.stop()
     db.close()
+
+
+def test_influx_columnar_matches_point_path(server):
+    """The columnar fast path must produce exactly what the Point parser
+    produces (values, dedup keys, tags) for a homogeneous batch, and
+    heterogeneous batches must fall back."""
+    from greptimedb_tpu.servers.influx import (
+        parse_line_protocol,
+        parse_line_protocol_columnar,
+    )
+
+    srv, db = server
+    lines = "\n".join(
+        f"colm,host=h{i % 3},dc=eu v1={i}.25,v2={i * 2} {1700000000 + i}"
+        for i in range(40)
+    )
+    col = parse_line_protocol_columnar(lines, "s")
+    assert col is not None
+    m, t, tag_keys = col
+    assert m == "colm" and t.num_rows == 40
+    assert tag_keys == ["host", "dc"]
+    pts = parse_line_protocol(lines, "s")
+    assert len(pts) == 40
+    for i in (0, 17, 39):
+        assert t["v1"][i].as_py() == pts[i].fields["v1"]
+        assert t["v2"][i].as_py() == pts[i].fields["v2"]
+        assert t["host"][i].as_py() == pts[i].tags["host"]
+        assert t["ts"][i].value == pts[i].ts_ms
+    # heterogeneous: int-suffixed field -> fallback
+    assert parse_line_protocol_columnar("m v=5i 1700000000", "s") is None
+    # string field -> fallback
+    assert parse_line_protocol_columnar('m v="x" 1700000000', "s") is None
+    # missing timestamp -> fallback
+    assert parse_line_protocol_columnar("m v=1.5", "s") is None
+    # escapes -> fallback
+    assert parse_line_protocol_columnar(
+        "m\\ x,t=a v=1.5 1700000000", "s") is None
+
+
+def test_influx_columnar_ts_rename_and_collision(tmp_path):
+    """Columnar writes onto a table whose time index is not named 'ts'
+    rename the parsed timestamp column; a field that collides with that
+    time-index name is rejected (never silently null-filled)."""
+    from greptimedb_tpu.database import Database
+    from greptimedb_tpu.servers.influx import (
+        parse_line_protocol_columnar,
+        write_columnar,
+    )
+    from greptimedb_tpu.utils.errors import InvalidArgumentsError
+
+    db = Database(data_home=str(tmp_path))
+    db.sql_one(
+        "CREATE TABLE oddt (t TIMESTAMP TIME INDEX, host STRING, "
+        "v DOUBLE, PRIMARY KEY(host))"
+    )
+    col = parse_line_protocol_columnar(b"oddt,host=a v=1.5 1700000000", "s")
+    assert col is not None
+    assert write_columnar(db, *col) == 1
+    rows = db.sql_one("SELECT host, v FROM oddt").to_pylist()
+    assert rows == [{"host": "a", "v": 1.5}]
+
+    col = parse_line_protocol_columnar(b"oddt,host=a t=2.5,v=3.5 1700000001", "s")
+    assert col is not None
+    with pytest.raises(InvalidArgumentsError):
+        write_columnar(db, *col)
