@@ -106,6 +106,303 @@ BLOCK_SPAN = 16
 _FAST_MIN_ROWS = 1 << 16
 
 
+def windowed_slot_sum(ps, base, segs: int, span: int):
+    """Level-2 assembly: fold [nb, span(,C)] block partials — row b covers
+    the `span` consecutive groups starting at base[b] — into a dense
+    [segs(,C)] accumulator with ONE row-windowed scatter-add.
+
+    TPU scatter cost scales with the number of scattered elements for
+    scalar updates (~75 ns/elem measured on v5e) but a windowed scatter
+    moves a whole row per index, ~6x cheaper at the [4096, 64] shapes the
+    blocked kernels produce.  `base` entries may be any value in
+    [0, segs-1] (rows for the overflow slot land there); the operand is
+    over-allocated by `span` so base+span never writes out of bounds, and
+    the tail slice is dropped.
+    """
+    return windowed_slot_reduce(ps, base, segs, span, "sum")
+
+
+def windowed_slot_reduce(ps, base, segs: int, span: int, kind: str):
+    """windowed_slot_sum generalized over the reduction monoid
+    (sum / min / max); init value picked so untouched slots finalize the
+    same way the scalar segment_* ops initialized them."""
+    multi = ps.ndim == 3  # [nb, span, C]
+    out_shape = (segs + span, ps.shape[2]) if multi else (segs + span,)
+    if kind == "sum":
+        init = 0
+        op = jax.lax.scatter_add
+    elif kind == "min":
+        init = jnp.finfo(ps.dtype).max if jnp.issubdtype(ps.dtype, jnp.floating) else jnp.iinfo(ps.dtype).max
+        op = jax.lax.scatter_min
+    elif kind == "max":
+        init = jnp.finfo(ps.dtype).min if jnp.issubdtype(ps.dtype, jnp.floating) else jnp.iinfo(ps.dtype).min
+        op = jax.lax.scatter_max
+    else:  # pragma: no cover
+        raise ValueError(kind)
+    out = jnp.full(out_shape, init, ps.dtype)
+    dnums = jax.lax.ScatterDimensionNumbers(
+        update_window_dims=(1, 2) if multi else (1,),
+        inserted_window_dims=(),
+        scatter_dims_to_operand_dims=(0,),
+    )
+    # NOT indices_are_sorted: the blocked guard only proves per-block
+    # clustering — descending runs or an all-masked mid-stream block
+    # (base jumps to the overflow slot) legally produce unsorted bases,
+    # and a false sortedness claim makes XLA scatter undefined.
+    out = op(out, base[:, None], ps, dnums)
+    return out[:segs]
+
+
+# ---- MXU limb kernels ------------------------------------------------------
+#
+# The one-hot blocked VPU kernel above is layout-bound, not FLOP-bound
+# (K-minor one-hot uses 16/128 vector lanes; measured ~8 ms per f64 column
+# at 2^24 rows on v5e, and switching the accumulate to f32 bought <10%).
+# For the multi-column sum/avg/count shape (TSBS double-groupby-*) the MXU
+# is the right unit: encode every value as 4 base-256 digits that are
+# exactly representable in bfloat16, build the block one-hot ONCE as bf16,
+# and compute ALL columns' block partials in a single batched matmul whose
+# f32 accumulation is exact (integer sums < 2^24).  Quantization is the
+# only error: ~2^-30 of the per-block max per row (~1e-9 relative for
+# same-magnitude data; integers stay exact up to 2^29), far inside the
+# engine's result-equality bar but distinct from true f64.  The tile
+# executor selects it via plan acc_dtype "limb" (config
+# query.tile_acc_dtype, opt-out to "float64" for exact accumulation of
+# >2^29-magnitude integer data); callers that pass an explicit f64
+# acc_dtype to segment_aggregate* are never rerouted here.
+
+N_LIMBS = 4
+_LIMB_Q_EXP = 29  # |round(v/s)| <= 2^29; +2^29 offset makes digits unsigned
+
+
+def quantize_limbs(values: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-block fixed-point encode of one value column for
+    `limb_segment_sums`.  Length must be a multiple of BLOCK_ROWS.
+
+    Each block gets a power-of-two scale s = 2^(e-29) sized to its max
+    |v|; rows encode q = round(v/s) + 2^29 (unsigned, <= 2^30) split into
+    N_LIMBS base-256 digits in bfloat16 (digits in [0,255] are exact).
+    Zero-valued rows (including padding and decoded NULLs) encode
+    q = 2^29, which the offset correction cancels exactly.
+
+    Returns (limbs [nb, BLOCK_ROWS, N_LIMBS] bf16, scale [nb] f64).
+    """
+    n = values.shape[0]
+    nb = n // BLOCK_ROWS
+    vv = values.reshape(nb, BLOCK_ROWS).astype(jnp.float64)
+    # Non-finite guard: a single inf row would give scale=inf and poison
+    # EVERY group's sum with NaN (the f64 path confines inf to its own
+    # group).  Sanitize like the tile encode does — NaN contributes
+    # nothing, +/-inf saturates to a huge finite value that still
+    # dominates its own group's sum.
+    vv = jnp.nan_to_num(vv, nan=0.0, posinf=1e308, neginf=-1e308)
+    amax = jnp.max(jnp.abs(vv), axis=1)
+    e = jnp.ceil(jnp.log2(jnp.maximum(amax, 1e-30)))
+    inv = jnp.exp2(_LIMB_Q_EXP - e)
+    q = jnp.round(vv * inv[:, None]).astype(jnp.int32) + (1 << _LIMB_Q_EXP)
+    limbs = jnp.stack(
+        [((q >> (8 * j)) & 0xFF).astype(jnp.bfloat16) for j in range(N_LIMBS)],
+        axis=-1,
+    )
+    return limbs, jnp.exp2(e - jnp.float64(_LIMB_Q_EXP))
+
+
+def limb_segment_sums(
+    limb_cols: list,
+    gids: jnp.ndarray,
+    mask: jnp.ndarray,
+    num_groups: int,
+    span: int,
+    count01: list | None = None,
+):
+    """Multi-column segmented sum + count on the MXU.
+
+    limb_cols: C tuples (limbs [nb, L, N_LIMBS] bf16, scale [nb] f64)
+      from `quantize_limbs`.
+    count01: optional C-list of per-column non-null indicators ([n] bool
+      or None); columns with an indicator get their own null-gated count.
+
+    One bf16 one-hot [nb, L, span] contracts against the concatenated
+    digit planes [nb, L, M] (M = 1 ones column + count columns + 4C limb
+    planes) in a single batched matmul; per-(block, slot) integer sums
+    accumulate exactly in f32, are recombined/scaled in f64 at [nb, span]
+    size, and land in dense [G] space via `windowed_slot_sum`.  A runtime
+    `lax.cond` guard (same clustering condition as `segment_aggregate`)
+    falls back to a scatter path over values reconstructed from the limbs
+    — both branches share the quantized representation, so results are
+    branch-independent.
+
+    Every column also gets a per-group WORST-CASE quantization error
+    bound: err_g = sum over contributing blocks of count * scale_b / 2
+    (each row's error is at most half a quantization step of ITS block).
+    The caller compares err against |sum| to certify the result — the
+    per-block shared scale means a small-magnitude group co-blocked with
+    huge values can lose precision far beyond the homogeneous-data ~1e-9,
+    and the bound is what makes that case detectable instead of silent.
+
+    Returns (sums [C, G] f64, errs [C, G] f64, counts [C, G] int32 or
+    None, presence [G] int32): `counts` rows are presence for columns
+    without an indicator.
+    """
+    n = gids.shape[0]
+    nb = n // BLOCK_ROWS
+    L = BLOCK_ROWS
+    C = len(limb_cols)
+    segs = num_groups + 1
+    g32 = gids.astype(jnp.int32)
+    has_counts = count01 is not None
+
+    gb = g32.reshape(nb, L)
+    mb = mask.reshape(nb, L)
+    sentinel = jnp.int32(2**31 - 1)
+    bmin = jnp.min(jnp.where(mb, gb, sentinel), axis=1)
+    bmax = jnp.max(jnp.where(mb, gb, -1), axis=1)
+    in_range_ok = jnp.all(jnp.where(mask, (g32 >= 0) & (g32 < num_groups), True))
+    ok_block = in_range_ok & jnp.all(bmax - bmin < span)
+
+    def fast(args):
+        gb, mb, limbs_scales, counts01 = args
+        base = jnp.minimum(bmin, jnp.int32(num_groups))
+        local = gb - base[:, None]
+        ks = jnp.arange(span, dtype=jnp.int32)
+        sel = (
+            (local[:, :, None] == ks[None, None, :]) & mb[:, :, None]
+        ).astype(jnp.bfloat16)  # [nb, L, span]
+        planes = [jnp.ones((nb, L, 1), jnp.bfloat16)]
+        for c01 in counts01:
+            if c01 is not None:
+                planes.append(c01.reshape(nb, L, 1).astype(jnp.bfloat16))
+        for limbs, _s in limbs_scales:
+            planes.append(limbs)
+        M = jnp.concatenate(planes, axis=-1)  # [nb, L, 1 + NC + 4C]
+        P = jnp.einsum(
+            "blk,blm->bkm", sel, M, preferred_element_type=jnp.float32
+        )
+        presence_b = P[:, :, 0].astype(jnp.int32)  # exact (<= L per slot)
+        presence = windowed_slot_sum(presence_b, base, segs, span)[:num_groups]
+        off = 1
+        counts = None
+        if has_counts:
+            ccols = []
+            ci = 0
+            for c01 in counts01:
+                if c01 is None:
+                    ccols.append(presence_b)
+                else:
+                    ccols.append(P[:, :, off + ci].astype(jnp.int32))
+                    ci += 1
+            off += ci
+            pc = jnp.stack(ccols, axis=-1)  # [nb, span, C] int32
+            counts = windowed_slot_sum(pc, base, segs, span)[:num_groups].T
+        sums_cols = []
+        err_cols = []
+        pres64 = presence_b.astype(jnp.float64)
+        for c, (_limbs, scale) in enumerate(limbs_scales):
+            acc = -pres64 * jnp.float64(1 << _LIMB_Q_EXP)
+            for j in range(N_LIMBS):
+                acc = acc + P[:, :, off + N_LIMBS * c + j].astype(
+                    jnp.float64
+                ) * jnp.float64(1 << (8 * j))
+            sums_cols.append(acc * scale[:, None])
+            err_cols.append(pres64 * (scale[:, None] * 0.5))
+        ps = jnp.stack(sums_cols + err_cols, axis=-1)  # [nb, span, 2C] f64
+        packed = windowed_slot_sum(ps, base, segs, span)[:num_groups].T
+        sums, errs = packed[:C], packed[C:]
+        return sums, errs, counts, presence
+
+    def slow(args):
+        gb, mb, limbs_scales, counts01 = args
+        safe = jnp.where(mb, gb, num_groups).reshape(-1)
+        flat_mask = mb.reshape(-1)
+        presence = jax.ops.segment_sum(
+            flat_mask.astype(jnp.int32), safe, num_segments=segs
+        )[:num_groups]
+        counts = None
+        if has_counts:
+            rows = []
+            for c01 in counts01:
+                if c01 is None:
+                    rows.append(presence)
+                else:
+                    rows.append(
+                        jax.ops.segment_sum(
+                            (flat_mask & c01).astype(jnp.int32),
+                            safe,
+                            num_segments=segs,
+                        )[:num_groups]
+                    )
+            counts = jnp.stack(rows)
+        sums_rows = []
+        err_rows = []
+        for limbs, scale in limbs_scales:
+            q = jnp.zeros((nb, L), jnp.int32)
+            for j in range(N_LIMBS):
+                q = q + (limbs[:, :, j].astype(jnp.int32) << (8 * j))
+            vhat = (q - (1 << _LIMB_Q_EXP)).astype(jnp.float64) * scale[:, None]
+            sums_rows.append(
+                jax.ops.segment_sum(
+                    jnp.where(mb, vhat, 0.0).reshape(-1),
+                    safe,
+                    num_segments=segs,
+                )[:num_groups]
+            )
+            half_step = jnp.broadcast_to(scale[:, None] * 0.5, (nb, L))
+            err_rows.append(
+                jax.ops.segment_sum(
+                    jnp.where(mb, half_step, 0.0).reshape(-1),
+                    safe,
+                    num_segments=segs,
+                )[:num_groups]
+            )
+        return jnp.stack(sums_rows), jnp.stack(err_rows), counts, presence
+
+    counts01 = tuple(count01) if count01 is not None else tuple([None] * C)
+    return jax.lax.cond(
+        ok_block, fast, slow, (gb, mb, tuple(limb_cols), counts01)
+    )
+
+
+def segment_sums_scatter(
+    values_list: list,
+    gids: jnp.ndarray,
+    mask: jnp.ndarray,
+    num_groups: int,
+    count01: list | None = None,
+):
+    """Structure-compatible small-source companion to `limb_segment_sums`:
+    the same (sums [C, G] f64, errs, counts [C, G] int32 | None, presence
+    [G] int32) tuple computed with scalar segment ops over RAW values —
+    sources below the limb kernel's geometry (memtable tails, sub-block
+    chunks) are cheap enough to aggregate exactly (errs = 0), and emitting
+    the identical AggState shape keeps merge_states well-defined when a
+    query mixes limb-sized and tiny sources."""
+    segs = num_groups + 1
+    safe = jnp.where(mask, gids.astype(jnp.int32), num_groups)
+    presence = jax.ops.segment_sum(
+        mask.astype(jnp.int32), safe, num_segments=segs
+    )[:num_groups]
+    counts = None
+    if count01 is not None:
+        rows = []
+        for c01 in count01:
+            if c01 is None:
+                rows.append(presence)
+            else:
+                rows.append(
+                    jax.ops.segment_sum(
+                        (mask & c01).astype(jnp.int32), safe, num_segments=segs
+                    )[:num_groups]
+                )
+        counts = jnp.stack(rows)
+    sums = jnp.stack([
+        jax.ops.segment_sum(
+            jnp.where(mask, v.astype(jnp.float64), 0.0), safe, num_segments=segs
+        )[:num_groups]
+        for v in values_list
+    ])
+    return sums, jnp.zeros_like(sums), counts, presence
+
+
 def segment_aggregate(
     values: jnp.ndarray,
     gids: jnp.ndarray,
@@ -252,11 +549,10 @@ def _segment_blocked(
     v = values[: nb * L].reshape(nb, L).astype(acc_dtype)
     # all-masked blocks land on the overflow slot; their partials are
     # init values only (sel is False everywhere in them)
-    base = jnp.minimum(bmin, jnp.int32(num_groups))[:, None]
-    local = g - base  # masked rows: in [0, K) — guaranteed by the span guard
+    base = jnp.minimum(bmin, jnp.int32(num_groups))
+    local = g - base[:, None]  # masked rows: in [0, K) — span guard
     ks = jnp.arange(K, dtype=jnp.int32)
     sel = (local[:, :, None] == ks[None, None, :]) & m[:, :, None]  # [nb, L, K]
-    out_idx = jnp.minimum(base + ks[None, :], segs - 1).reshape(-1)
 
     # tail rows (< BLOCK_ROWS of them) take the scatter path
     tail_v = values[nb * L :]
@@ -266,14 +562,14 @@ def _segment_blocked(
     state = AggState()
     if SUM in aggs or "avg" in aggs:
         ps = jnp.sum(jnp.where(sel, v[:, :, None], 0), axis=1)  # [nb, K]
-        s = jax.ops.segment_sum(ps.reshape(-1), out_idx, num_segments=segs)
+        s = windowed_slot_sum(ps, base, segs, K)
         s = s + jax.ops.segment_sum(
             jnp.where(tail_m, tail_v.astype(acc_dtype), 0), tail_g, num_segments=segs
         )
         state.sums = s[:num_groups]
     if COUNT in aggs or "avg" in aggs:
         pc = jnp.sum(sel, axis=1, dtype=jnp.int32)
-        c = jax.ops.segment_sum(pc.reshape(-1), out_idx, num_segments=segs)
+        c = windowed_slot_sum(pc, base, segs, K)
         c = c + jax.ops.segment_sum(
             tail_m.astype(jnp.int32), tail_g, num_segments=segs
         )
@@ -281,7 +577,7 @@ def _segment_blocked(
     if MIN in aggs:
         big = jnp.asarray(jnp.finfo(acc_dtype).max, acc_dtype)
         pm = jnp.min(jnp.where(sel, v[:, :, None], big), axis=1)
-        mn = jax.ops.segment_min(pm.reshape(-1), out_idx, num_segments=segs)
+        mn = windowed_slot_reduce(pm, base, segs, K, "min")
         mn = jnp.minimum(
             mn,
             jax.ops.segment_min(
@@ -294,7 +590,7 @@ def _segment_blocked(
     if MAX in aggs:
         small = jnp.asarray(jnp.finfo(acc_dtype).min, acc_dtype)
         pm = jnp.max(jnp.where(sel, v[:, :, None], small), axis=1)
-        mx = jax.ops.segment_max(pm.reshape(-1), out_idx, num_segments=segs)
+        mx = windowed_slot_reduce(pm, base, segs, K, "max")
         mx = jnp.maximum(
             mx,
             jax.ops.segment_max(
@@ -403,11 +699,10 @@ def _segment_blocked_last(
     g = gids[: nb * L].reshape(nb, L)
     m = mask[: nb * L].reshape(nb, L)
     t = ts[: nb * L].reshape(nb, L)
-    base = jnp.minimum(bmin, jnp.int32(num_groups))[:, None]
-    local = g - base
+    base = jnp.minimum(bmin, jnp.int32(num_groups))
+    local = g - base[:, None]
     ks = jnp.arange(K, dtype=jnp.int32)
     sel = (local[:, :, None] == ks[None, None, :]) & m[:, :, None]  # [nb, L, K]
-    out_idx = jnp.minimum(base + ks[None, :], segs - 1).reshape(-1)
 
     tail_v = values[nb * L :]
     tail_g = jnp.where(mask[nb * L :], gids[nb * L :], num_groups)
@@ -417,7 +712,7 @@ def _segment_blocked_last(
     tsmin = jnp.iinfo(jnp.int64).min
     # pass 1: last_ts per group via block partials
     pt = jnp.max(jnp.where(sel, t[:, :, None], tsmin), axis=1)  # [nb, K]
-    lt = jax.ops.segment_max(pt.reshape(-1), out_idx, num_segments=segs)
+    lt = windowed_slot_reduce(pt, base, segs, K, "max")
     lt = jnp.maximum(
         lt,
         jax.ops.segment_max(
@@ -428,13 +723,13 @@ def _segment_blocked_last(
     # pass 2: highest row index among block rows at the block-slot max ts,
     # gated by whether that slot's ts IS the global max ([nb, K] gather)
     ridx = jnp.arange(nb * L, dtype=jnp.int32).reshape(nb, L)
-    slot_is_global = pt == lt[jnp.minimum(base + ks[None, :], segs - 1)]  # [nb, K]
+    slot_is_global = pt == lt[jnp.minimum(base[:, None] + ks[None, :], segs - 1)]  # [nb, K]
     row_at_slot_max = sel & (t[:, :, None] == pt[:, None, :])  # [nb, L, K]
     pidx = jnp.max(
         jnp.where(row_at_slot_max, ridx[:, :, None], -1), axis=1
     )  # [nb, K]
     pidx = jnp.where(slot_is_global, pidx, -1)
-    pick = jax.ops.segment_max(pidx.reshape(-1), out_idx, num_segments=segs)
+    pick = windowed_slot_reduce(pidx, base, segs, K, "max")
     tail_is_last = tail_m & (tail_t == last_ts[jnp.clip(tail_g, 0, num_groups - 1)])
     tail_idx = nb * L + jnp.arange(tail_v.shape[0], dtype=jnp.int32)
     pick = jnp.maximum(

@@ -31,9 +31,13 @@ import pyarrow.compute as pc
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.aggregate import (
+    BLOCK_ROWS,
+    _FAST_MIN_ROWS,
     AggState,
     finalize,
+    limb_segment_sums,
     psum_states,
+    quantize_limbs,
     raw_group_ids,
     segment_aggregate,
     time_bucket,
@@ -162,7 +166,7 @@ def _apply_filters(plan: DistGroupByPlan, columns, mask, values=None):
     return mask
 
 
-def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=None, perm=None, count_cols=None):
+def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=None, perm=None, count_cols=None, limbs=None):
     """Shared lower/state stage: mask -> group ids -> partial AggStates.
     No collectives — callers merge across devices (psum) or across tile
     sources (merge_states).  `dyn` optionally carries runtime-dynamic plan
@@ -176,12 +180,24 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
     deciding per-source from `col in nulls` made merge_states silently
     drop counts (or crash) when sources disagreed on a column's
     nullability.  None = decide from this source's nulls (single-source
-    mesh path)."""
-    acc = jnp.float64 if plan.acc_dtype == "float64" else jnp.float32
+    mesh path).
+
+    `plan.acc_dtype == "limb"` routes sum/avg/count columns through the
+    MXU limb kernel (ops/aggregate.py `limb_segment_sums`) — one batched
+    matmul for ALL such columns instead of a per-column VPU pass; min/max/
+    last keep the f64 blocked kernels.  `limbs` optionally supplies cached
+    quantized planes per column (dict col -> (limbs, scale)); missing
+    columns quantize in-program from their f64 plane."""
+    acc = jnp.float32 if plan.acc_dtype == "float32" else jnp.float64
     if perm is not None:
         columns = {k: v[perm] for k, v in columns.items()}
         valid = valid[perm]
         nulls = {k: v[perm] for k, v in nulls.items()}
+        # cached limb planes encode the UNpermuted block layout — they
+        # cannot be row-gathered (block scales would be wrong); callers
+        # with a perm must supply order-matched limbs (time-major planes)
+        # or none at all
+        limbs = None
     mask = _apply_filters(
         plan, columns, valid, None if dyn is None else dyn["filter_values"]
     )
@@ -243,6 +259,15 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
     states = {}
     groups: dict[tuple, list[str]] = {}
     last_presence: str | None = None
+    n_rows = valid.shape[0]
+    # Limb routing is decided from the PLAN alone (never per-source size):
+    # every source of a multi-source program must emit structurally
+    # identical AggStates or merge_states breaks — sources too small for
+    # the limb geometry take segment_sums_scatter, which produces the
+    # same trio exactly.
+    limb_mode = plan.acc_dtype == "limb"
+    limb_fits = n_rows >= _FAST_MIN_ROWS and n_rows % BLOCK_ROWS == 0
+    limb_batch: list[tuple[str, bool]] = []  # (col, counted)
     for col, aggs in per_col_aggs.items():
         if "last" in aggs:
             # LAST has no reshape-reduce fold; the planner never builds a
@@ -275,31 +300,40 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
             kernel_aggs.add("count")
         elif not kernel_aggs:
             continue  # count(col) on a non-null column: presence covers it
-        groups.setdefault(tuple(sorted(kernel_aggs)), []).append(col)
+        if limb_mode and "sum" in kernel_aggs:
+            # sum + null-gated count ride the MXU batch; min/max (order
+            # statistics have no matmul form) keep the blocked kernel,
+            # and count-only columns stay on their near-free count pass
+            limb_batch.append((col, null_gated))
+            kernel_aggs -= {"sum", "count"}
+        if kernel_aggs:
+            groups.setdefault(tuple(sorted(kernel_aggs)), []).append(col)
     # Presence fusing: a NON-null-gated value column counts exactly the
     # base-mask rows, which IS the group presence — ride its kernel pass
     # (the count reduction fuses with the column's sum/min/max over the
     # same one-hot, nearly free) instead of spending a whole separate
     # pass on a pseudo-column.  Only when every column is null-gated (or
-    # there are none) does presence pay its own pass.
+    # there are none) does presence pay its own pass.  The limb batch
+    # carries presence for free (its ones column), so it wins outright.
     presence_from: str | None = None
-    for key in list(groups):
-        if "count" in key:
-            continue
-        cols = groups[key]
-        rep = cols[0]
-        if len(cols) == 1:
-            del groups[key]
-        else:
-            groups[key] = cols[1:]
-        groups.setdefault(tuple(sorted(set(key) | {"count"})), []).insert(0, rep)
-        presence_from = rep
-        break
-    if presence_from is None and last_presence is not None:
-        presence_from = last_presence
-    if presence_from is None:
-        # pseudo-column whose "values" are the mask itself
-        groups.setdefault(("count",), []).append("__presence")
+    if not limb_batch:
+        for key in list(groups):
+            if "count" in key:
+                continue
+            cols = groups[key]
+            rep = cols[0]
+            if len(cols) == 1:
+                del groups[key]
+            else:
+                groups[key] = cols[1:]
+            groups.setdefault(tuple(sorted(set(key) | {"count"})), []).insert(0, rep)
+            presence_from = rep
+            break
+        if presence_from is None and last_presence is not None:
+            presence_from = last_presence
+        if presence_from is None:
+            # pseudo-column whose "values" are the mask itself
+            groups.setdefault(("count",), []).append("__presence")
     for key, cols in groups.items():
         # per-column lists, never a stacked [C, n] (HBM: see
         # segment_aggregate_multi); count-only pseudo-columns reuse the
@@ -323,7 +357,50 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
                 mins=None if multi.mins is None else multi.mins[i],
                 maxs=None if multi.maxs is None else multi.maxs[i],
             ))
-    if presence_from is not None:
+    if limb_batch:
+        count01 = [
+            nulls[c] if (counted and c in nulls) else None
+            for c, counted in limb_batch
+        ]
+        any_counted = any(counted for _c, counted in limb_batch)
+        c01 = count01 if any_counted else None
+        if limb_fits:
+            limb_inputs = []
+            for c, _counted in limb_batch:
+                if limbs is not None and c in limbs:
+                    limb_inputs.append(limbs[c])
+                else:
+                    limb_inputs.append(quantize_limbs(columns[c]))
+            lsums, lerrs, lcounts, lpresence = limb_segment_sums(
+                limb_inputs, gids, mask, n_internal, plan.block_span,
+                count01=c01,
+            )
+        else:
+            from ..ops.aggregate import segment_sums_scatter
+
+            lsums, lerrs, lcounts, lpresence = segment_sums_scatter(
+                [columns[c] for c, _counted in limb_batch],
+                gids, mask, n_internal, count01=c01,
+            )
+        for i, (c, counted) in enumerate(limb_batch):
+            st = fold(AggState(
+                sums=lsums[i],
+                counts=lcounts[i] if counted else None,
+            ))
+            prev = states.get(c)
+            if prev is not None:  # min/max part from the blocked kernel
+                st = AggState(
+                    sums=st.sums, counts=st.counts,
+                    mins=prev.mins, maxs=prev.maxs,
+                    last_ts=prev.last_ts, last_val=prev.last_val,
+                )
+            states[c] = st
+            # worst-case quantization error bound per group: merges by
+            # addition and folds like a sum — the tile program checks it
+            # against |sum| and reruns in exact f64 when it's too loose
+            states["__limb_err:" + c] = fold(AggState(sums=lerrs[i]))
+        states["__presence"] = fold(AggState(counts=lpresence))
+    elif presence_from is not None:
         states["__presence"] = AggState(counts=states[presence_from].counts)
     return states
 

@@ -10,6 +10,7 @@ planner cannot prove lowerable.
 
 from __future__ import annotations
 
+import logging
 import time
 
 import pyarrow as pa
@@ -196,6 +197,12 @@ class QueryEngine:
         except Exception:
             if backend == "tpu" and self.config.fallback_to_cpu:
                 metrics.TPU_FALLBACK_TOTAL.inc()
+                # the fallback keeps the query alive but must never hide
+                # the device-path failure from operators (a silent
+                # catch here masked a TPU-only lowering bug once)
+                logging.getLogger("greptimedb_tpu.query").warning(
+                    "tpu path failed; serving from cpu", exc_info=True
+                )
                 with span("query.cpu_fallback"):
                     return self.cpu.execute(plan)
             raise

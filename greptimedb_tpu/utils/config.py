@@ -119,6 +119,13 @@ class QueryConfig:
     # as one dispatch over cached device tiles instead of re-scanning Arrow.
     tile_cache_enable: bool = True
     tile_cache_mb: int = 8192
+    # Accumulation mode for tile-path sum/avg: "limb" routes them through
+    # the MXU fixed-point kernel (ops/aggregate.py limb_segment_sums; one
+    # batched matmul for every column).  Precision: ~1e-9 relative
+    # quantization error per block; integer data is exact up to 2^29 per
+    # value but loses low bits beyond that — set "float64" for exact f64
+    # accumulation (per-column VPU kernels, ~6x slower at TSBS scale).
+    tile_acc_dtype: str = "limb"
 
 
 @dataclasses.dataclass

@@ -303,6 +303,100 @@ def test_segment_aggregate_blocked_fast_path(layout):
     np.testing.assert_allclose(np.asarray(state.maxs)[nz], maxs[nz])
 
 
+@pytest.mark.parametrize("layout", ["sorted", "unsorted"])
+def test_limb_segment_sums_matches_numpy(layout):
+    """MXU limb kernel (fast one-hot matmul path AND the scatter fallback
+    over reconstructed values) vs numpy: sums within the quantization
+    bound (~1e-9 relative for same-magnitude data), counts/presence
+    exact."""
+    from greptimedb_tpu.ops import aggregate as agg
+
+    rng = np.random.default_rng(5)
+    n = agg.BLOCK_ROWS * 32
+    num_groups = 256
+    if layout == "sorted":
+        gids = np.sort(rng.integers(0, num_groups, n)).astype(np.int32)
+    else:
+        gids = rng.integers(0, num_groups, n).astype(np.int32)
+    mask = rng.random(n) > 0.2
+    v0 = rng.normal(50, 30, n)
+    v1 = rng.uniform(-1e6, 1e6, n)
+    nn1 = rng.random(n) > 0.1  # v1 nullable: null rows decode to 0.0
+    v1 = np.where(nn1, v1, 0.0)
+
+    limb0 = agg.quantize_limbs(jnp.asarray(v0))
+    limb1 = agg.quantize_limbs(jnp.asarray(v1))
+    sums, errs, counts, presence = jax.jit(
+        lambda a, b, g, m, c1: agg.limb_segment_sums(
+            [a, b], g, m, num_groups, span=64, count01=[None, c1]
+        )
+    )(limb0, limb1, jnp.asarray(gids), jnp.asarray(mask), jnp.asarray(nn1))
+
+    s0, c0, _mn, _mx = _np_segment(v0, gids, mask, num_groups)
+    s1, c1n, _mn, _mx = _np_segment(v1, gids, mask & nn1, num_groups)
+    # null rows of v1 hold value 0.0 so they don't move the sum
+    np.testing.assert_array_equal(np.asarray(presence), c0)
+    np.testing.assert_array_equal(np.asarray(counts[0]), c0)
+    np.testing.assert_array_equal(np.asarray(counts[1]), c1n)
+    np.testing.assert_allclose(np.asarray(sums[0]), s0, rtol=1e-7, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(sums[1]), s1, rtol=1e-7, atol=1e-1)
+    # the error bound must actually bound the observed error
+    assert np.all(np.abs(np.asarray(sums[0]) - s0) <= np.asarray(errs[0]) + 1e-9)
+    assert np.all(np.abs(np.asarray(sums[1]) - s1) <= np.asarray(errs[1]) + 1e-6)
+
+
+def test_limb_sums_nonfinite_confined():
+    """One inf/NaN row must not poison other groups' sums (scale=inf would
+    have NaN'd every group; the guard saturates inf to 1e308 and zeroes
+    NaN, so only the affected group goes huge)."""
+    from greptimedb_tpu.ops import aggregate as agg
+
+    n = agg.BLOCK_ROWS * 16
+    rng = np.random.default_rng(2)
+    v = rng.uniform(0, 100, n)
+    v[5] = np.inf
+    v[7] = np.nan
+    gids = np.sort(np.arange(n) % 8).astype(np.int32)  # 8 groups, sorted
+    limbs = agg.quantize_limbs(jnp.asarray(v))
+    sums, _e, _c, _p = jax.jit(
+        lambda L, g, m: agg.limb_segment_sums([L], g, m, 8, 16)
+    )(limbs, jnp.asarray(gids), jnp.ones(n, dtype=bool))
+    out = np.asarray(sums[0])
+    assert np.all(np.isfinite(out))
+    # groups 1..7 unaffected (rows 5 and 7 both land in group 0)
+    gt = np.zeros(8)
+    np.add.at(gt, gids, np.nan_to_num(v, nan=0.0, posinf=0.0))
+    np.testing.assert_allclose(out[1:], gt[1:], rtol=1e-6)
+    assert out[0] > 1e300  # inf saturated, dominates its own group
+
+
+def test_quantize_limbs_roundtrip_precision():
+    """v-hat reconstructed from limbs deviates from v by <= s/2 per row
+    (the documented quantization bound); exact for integer-valued data."""
+    from greptimedb_tpu.ops import aggregate as agg
+
+    rng = np.random.default_rng(9)
+    n = agg.BLOCK_ROWS * 2
+    v = rng.uniform(-100, 100, n)
+    limbs, scale = agg.quantize_limbs(jnp.asarray(v))
+    q = np.zeros((n // agg.BLOCK_ROWS, agg.BLOCK_ROWS), np.int64)
+    ln = np.asarray(limbs.astype(jnp.float32)).astype(np.int64)
+    for j in range(agg.N_LIMBS):
+        q += ln[:, :, j] << (8 * j)
+    vhat = (q - (1 << agg._LIMB_Q_EXP)) * np.asarray(scale)[:, None]
+    s = np.asarray(scale)
+    assert np.max(np.abs(vhat - v.reshape(vhat.shape)) / s[:, None]) <= 0.5 + 1e-9
+
+    vi = rng.integers(-(1 << 20), 1 << 20, n).astype(np.float64)
+    limbs, scale = agg.quantize_limbs(jnp.asarray(vi))
+    ln = np.asarray(limbs.astype(jnp.float32)).astype(np.int64)
+    q = np.zeros((n // agg.BLOCK_ROWS, agg.BLOCK_ROWS), np.int64)
+    for j in range(agg.N_LIMBS):
+        q += ln[:, :, j] << (8 * j)
+    vhat = (q - (1 << agg._LIMB_Q_EXP)) * np.asarray(scale)[:, None]
+    np.testing.assert_array_equal(vhat, vi.reshape(vhat.shape))
+
+
 def test_segment_aggregate_blocked_narrow_span_engages():
     """A layout engineered to pass every fast-path guard (dense sorted ids,
     span << BLOCK_SPAN) still matches numpy — this is the configuration the

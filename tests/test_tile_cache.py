@@ -108,6 +108,125 @@ def test_memtable_tail_included(db):
     _assert_equal(t1, t2, ["host", "tb"])
 
 
+def test_limb_kernel_with_mixed_source_sizes(db):
+    """A flushed chunk large enough for the MXU limb kernel merged with a
+    tiny memtable tail: both sources must emit structurally identical
+    AggStates (limb trio vs exact scatter trio) and match the CPU path."""
+    import numpy as np
+
+    _mk_cpu_table(db)
+    hosts, ticks = 8, 8192  # 65536 rows -> meets the limb fast-path floor
+    h = np.repeat([f"host_{i}" for i in range(hosts)], ticks)
+    r = np.repeat([f"r{i % 2}" for i in range(hosts)], ticks)
+    ts = np.tile(np.arange(ticks, dtype=np.int64) * 1000, hosts)
+    rng = np.random.default_rng(3)
+    tbl = pa.table({
+        "host": pa.array(h), "region": pa.array(r),
+        "ts": pa.array(ts, pa.timestamp("ms")),
+        "usage_user": pa.array(rng.uniform(0, 100, hosts * ticks)),
+        "usage_system": pa.array(rng.uniform(0, 100, hosts * ticks)),
+    })
+    db.insert_rows("cpu", tbl)
+    db.sql("ADMIN flush_table('cpu')")
+    # memtable tail AFTER the flushed range (disjoint -> tile path stays on)
+    db.sql(
+        "INSERT INTO cpu VALUES "
+        + ",".join(
+            f"('host_{i}', 'r{i % 2}', {ticks * 1000 + j * 1000}, {i + j}, {j})"
+            for i in range(hosts)
+            for j in range(3)
+        )
+    )
+    q = (
+        "SELECT host, avg(usage_user) AS au, sum(usage_system) AS ss,"
+        " count(*) AS c FROM cpu GROUP BY host"
+    )
+    before = _tile_count()
+    t1, t2 = _both(db, q)
+    assert _tile_count() == before + 1, "tile path did not engage"
+    # limb quantization bound is ~1e-9 relative; compare at 1e-7
+    s1 = t1.sort_by("host").to_pydict()
+    s2 = t2.sort_by("host").to_pydict()
+    assert s1["host"] == s2["host"]
+    assert s1["c"] == s2["c"]
+    np.testing.assert_allclose(s1["au"], s2["au"], rtol=1e-7)
+    np.testing.assert_allclose(s1["ss"], s2["ss"], rtol=1e-7)
+
+
+def test_limb_mixed_magnitude_reruns_exact(db):
+    """Groups of tiny values co-blocked with huge values break the limb
+    kernel's shared per-block scale; the per-group error-bound verdict
+    must detect it and transparently rerun in exact f64."""
+    import numpy as np
+
+    _mk_cpu_table(db)
+    n = 65536
+    ts = np.arange(n, dtype=np.int64) * 1000
+    # alternate magnitude per 600s bucket: 1e9-buckets share blocks with
+    # 1.0-buckets, so the small buckets' sums quantize to ~0 in limb mode
+    bucket = ts // 600_000
+    vals = np.where(bucket % 2 == 0, 1e9, 1.0).astype(np.float64)
+    db.insert_rows("cpu", pa.table({
+        "host": pa.array(np.repeat("h0", n)),
+        "region": pa.array(np.repeat("r0", n)),
+        "ts": pa.array(ts, pa.timestamp("ms")),
+        "usage_user": pa.array(vals),
+        "usage_system": pa.array(vals),
+    }))
+    db.sql("ADMIN flush_table('cpu')")
+    q = ("SELECT time_bucket('600s', ts) AS tb, sum(usage_user) AS su"
+         " FROM cpu GROUP BY tb")
+    rerun_before = metrics.TILE_LIMB_RERUNS.get()
+    before = _tile_count()
+    t1, t2 = _both(db, q)
+    assert _tile_count() == before + 1, "tile path did not engage"
+    assert metrics.TILE_LIMB_RERUNS.get() > rerun_before, "verdict did not fire"
+    s1 = t1.sort_by("tb").to_pydict()
+    s2 = t2.sort_by("tb").to_pydict()
+    assert s1["tb"] == s2["tb"]
+    np.testing.assert_allclose(s1["su"], s2["su"], rtol=1e-9)
+
+
+def test_packed_readback_large_group_space(db):
+    """>= 2^14 groups engages the byte-packed result buffer: bit-packed
+    uint8 gating rows + f32 avg rows + hand-computed host offsets (and,
+    with a count(*) output, the exact-int32 variant).  Round-trips must
+    match the CPU path."""
+    import numpy as np
+
+    _mk_cpu_table(db)
+    hosts, ticks = 32, 2048  # 65536 rows; 32 hosts x 512 buckets = 16384 groups
+    h = np.repeat([f"host_{i:02d}" for i in range(hosts)], ticks)
+    r = np.repeat([f"r{i % 2}" for i in range(hosts)], ticks)
+    ts = np.tile(np.arange(ticks, dtype=np.int64) * 1000, hosts)
+    rng = np.random.default_rng(17)
+    tbl = pa.table({
+        "host": pa.array(h), "region": pa.array(r),
+        "ts": pa.array(ts, pa.timestamp("ms")),
+        "usage_user": pa.array(rng.uniform(0, 100, hosts * ticks)),
+        "usage_system": pa.array(rng.uniform(0, 100, hosts * ticks)),
+    })
+    db.insert_rows("cpu", tbl)
+    db.sql("ADMIN flush_table('cpu')")
+    # avg-only -> uint8 bit-packed gating rows + f32 avg rows
+    q1 = ("SELECT host, time_bucket('4s', ts) AS tb, avg(usage_user) AS au"
+          " FROM cpu GROUP BY host, tb")
+    # count(*) -> exact int32 rows alongside the f32 avg rows
+    q2 = ("SELECT host, time_bucket('4s', ts) AS tb, avg(usage_user) AS au,"
+          " count(*) AS c FROM cpu GROUP BY host, tb")
+    for q in (q1, q2):
+        before = _tile_count()
+        t1, t2 = _both(db, q)
+        assert _tile_count() == before + 1, "tile path did not engage"
+        s1 = t1.sort_by([("host", "ascending"), ("tb", "ascending")]).to_pydict()
+        s2 = t2.sort_by([("host", "ascending"), ("tb", "ascending")]).to_pydict()
+        assert s1["host"] == s2["host"] and s1["tb"] == s2["tb"]
+        # f32-packed avg: 6e-8 relative
+        np.testing.assert_allclose(s1["au"], s2["au"], rtol=1e-6)
+        if "c" in s1:
+            assert s1["c"] == s2["c"]
+
+
 def test_overlapping_sources_fall_back(db):
     """Same keys written twice across flushes -> dedup required -> the tile
     path must NOT engage, and results stay correct via the scan path."""
