@@ -108,8 +108,12 @@ def cmd_import(args):
 
 def cmd_datanode(args):
     """Run a standalone datanode process: a region server speaking Arrow
-    Flight over shared storage (reference `greptime datanode start`)."""
+    Flight over shared storage (reference `greptime datanode start`).
+    With --metasrv it registers its Flight address and heartbeats region
+    stats (reference datanode/src/heartbeat.rs) so frontends discover it
+    and the metasrv's failure detection has real input."""
     import signal
+    import time as _time
 
     from .distributed.flight import DatanodeFlightServer
     from .storage.engine import TimeSeriesEngine
@@ -126,11 +130,102 @@ def cmd_datanode(args):
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
+
+    meta = None
+    if getattr(args, "metasrv", None):
+        from .distributed.meta_service import MetaClient
+
+        meta = MetaClient(args.metasrv.split(","))
+        flight_addr = server.location.removeprefix("grpc://")
+
+        def heartbeat_loop():
+            import logging
+
+            log = logging.getLogger("greptimedb_tpu.datanode")
+            last_err = None
+            while not stop.is_set():
+                try:
+                    reply = meta.handle_heartbeat(
+                        args.node_id,
+                        [s.__dict__ for s in engine.region_statistics()],
+                        _time.time() * 1000,
+                        addr=flight_addr,
+                    )
+                    last_err = None
+                except Exception as e:  # noqa: BLE001 — metasrv may be electing
+                    # log each DISTINCT failure once (a misconfiguration
+                    # like a node-id/role conflict would otherwise spin
+                    # silently forever at the heartbeat interval)
+                    if str(e) != last_err:
+                        last_err = str(e)
+                        log.warning("heartbeat to metasrv failed: %s", e)
+                    stop.wait(args.heartbeat_s)
+                    continue
+                # the metasrv drained its mailbox when it replied: apply
+                # each instruction independently so one failure cannot
+                # discard the rest of the batch (they are never requeued)
+                for instr in reply.get("instructions", []):
+                    try:
+                        _apply_datanode_instruction(engine, instr)
+                    except Exception:  # noqa: BLE001
+                        log.warning("instruction %s failed", instr, exc_info=True)
+                stop.wait(args.heartbeat_s)
+
+        threading.Thread(target=heartbeat_loop, daemon=True).start()
     try:
         stop.wait()
     finally:
         server.shutdown()
         engine.close()
+    return 0
+
+
+def _apply_datanode_instruction(engine, instr: dict):
+    """Mailbox instructions from metasrv heartbeat replies (reference
+    Instruction enum, common/meta/src/instruction.rs)."""
+    kind = instr.get("kind")
+    if kind == "open_region":
+        engine.open_region(instr["region_id"])
+    elif kind == "close_region":
+        engine.close_region(instr["region_id"])
+    elif kind == "flush_region":
+        engine.flush_region(instr["region_id"])
+
+
+def cmd_frontend(args):
+    """Run a distributed frontend process: SQL over HTTP (+ MySQL) planned
+    against metasrv routes and fanned out to Flight datanodes (reference
+    `greptime frontend start`, frontend/src/instance.rs:110)."""
+    import signal
+    import threading
+    import time as _time
+
+    from .distributed.frontend import Frontend
+    from .servers.http import HttpServer
+    from .servers.mysql import MysqlServer
+
+    fe = Frontend(
+        args.data_home, args.metasrv.split(","), node_id=args.node_id
+    )
+    http = HttpServer(fe, args.http_addr).start(warm=False)
+    mysql = None
+    if args.mysql_addr:
+        mysql = MysqlServer(fe, args.mysql_addr).start(warm=False)
+    print(
+        f"frontend {args.node_id} serving HTTP at {http.address}"
+        + (f", MySQL at {mysql.address}" if mysql else ""),
+        flush=True,
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    while not stop.is_set():
+        fe.heartbeat()
+        stop.wait(args.heartbeat_s)
+    http.stop()
+    if mysql:
+        mysql.stop()
+    fe.close()
     return 0
 
 
@@ -164,10 +259,20 @@ def cmd_metasrv(args):
 
     class RemoteNodeManager:
         """NodeManager over Flight clients (reference common/meta
-        NodeManager backed by per-peer gRPC clients)."""
+        NodeManager backed by per-peer gRPC clients).  Addresses come
+        from static --datanode mappings or, preferentially, from what
+        nodes registered via heartbeat (node_address role-equivalent)."""
+
+        metasrv = None  # wired after construction
 
         def _client(self, node_id: int) -> FlightDatanodeClient:
-            return FlightDatanodeClient(node_id, f"grpc://{peers[node_id]}")
+            addr = None
+            if self.metasrv is not None:
+                addr = self.metasrv.node_addresses().get(node_id)
+            addr = addr or peers.get(node_id)
+            if addr is None:
+                raise ConnectionError(f"datanode {node_id} has no known address")
+            return FlightDatanodeClient(node_id, f"grpc://{addr}")
 
         def open_region(self, node_id: int, rid: int):
             self._client(node_id).open_region(rid)
@@ -186,9 +291,11 @@ def cmd_metasrv(args):
 
     kv = FileKvBackend(args.kv_dir)
     election = LeaseElection(kv, args.node_id)
-    metasrv = Metasrv(kv, RemoteNodeManager(), election=election)
-    for nid in peers:
-        metasrv.register_datanode(nid)
+    node_manager = RemoteNodeManager()
+    metasrv = Metasrv(kv, node_manager, election=election)
+    node_manager.metasrv = metasrv
+    for nid, addr in peers.items():
+        metasrv.register_datanode(nid, addr)
     server = MetasrvServer(metasrv, args.addr).start()
     print(f"metasrv {args.node_id} serving at {server.address}", flush=True)
 
@@ -351,7 +458,25 @@ def main(argv=None):
     p.add_argument("--node-id", type=int, default=0)
     p.add_argument("--data-home", default="./greptimedb_data")
     p.add_argument("--addr", default="127.0.0.1:0")
+    p.add_argument("--metasrv", default=None,
+                   help="comma-separated metasrv addrs to register with + heartbeat")
+    p.add_argument("--heartbeat-s", type=float, default=1.0)
     p.set_defaults(fn=cmd_datanode)
+
+    p = sub.add_parser(
+        "frontend",
+        help="start a distributed frontend (HTTP/MySQL over Flight datanodes)",
+    )
+    p.add_argument("action", choices=["start"])
+    p.add_argument("--node-id", type=int, default=100)
+    p.add_argument("--data-home", required=True,
+                   help="shared storage root (catalog lives here)")
+    p.add_argument("--metasrv", required=True,
+                   help="comma-separated metasrv addrs")
+    p.add_argument("--http-addr", default="127.0.0.1:0")
+    p.add_argument("--mysql-addr", default=None)
+    p.add_argument("--heartbeat-s", type=float, default=1.0)
+    p.set_defaults(fn=cmd_frontend)
 
     p = sub.add_parser("flownode", help="start a flownode (streaming/batching flows)")
     p.add_argument("start", choices=["start"])

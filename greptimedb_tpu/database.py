@@ -457,76 +457,7 @@ class Database:
                 if_not_exists=stmt.if_not_exists,
             )
             return None
-        columns: list[ColumnSchema] = []
-        time_index = stmt.time_index
-        pks = set(stmt.primary_key)
-        for c in stmt.columns:
-            if c.is_time_index:
-                time_index = c.name
-            if c.is_primary_key:
-                pks.add(c.name)
-        for c in stmt.columns:
-            if c.name == time_index:
-                sem = SemanticType.TIMESTAMP
-            elif c.name in pks:
-                sem = SemanticType.TAG
-            else:
-                sem = SemanticType.FIELD
-            dt = ConcreteDataType.parse(c.type_name)
-            vdim = None
-            if dt == ConcreteDataType.VECTOR:
-                import re as _re
-
-                m = _re.match(r"vector\s*\(\s*(\d+)\s*\)", c.type_name.strip().lower())
-                if not m:
-                    raise InvalidArgumentsError(
-                        f"VECTOR column {c.name!r} needs a dimension: VECTOR(n)"
-                    )
-                vdim = int(m.group(1))
-            columns.append(
-                ColumnSchema(
-                    name=c.name,
-                    data_type=dt,
-                    semantic_type=sem,
-                    nullable=c.nullable and sem == SemanticType.FIELD,
-                    default=c.default,
-                    fulltext=getattr(c, "fulltext", False),
-                    vector_dim=vdim,
-                    vector_index=getattr(c, "vector_index", False),
-                )
-            )
-        if time_index is None:
-            raise InvalidArgumentsError("table requires a TIME INDEX column")
-        schema = Schema(columns=columns)
-        mm = str(stmt.options.get("merge_mode", "")).strip()
-        if mm not in ("", "last_row", "last_non_null"):
-            raise InvalidArgumentsError(
-                f"invalid merge_mode {mm!r}: expected 'last_row' or 'last_non_null'"
-            )
-        if mm == "last_non_null" and _opt_bool(stmt.options, "append_mode"):
-            raise InvalidArgumentsError(
-                "merge_mode = 'last_non_null' conflicts with append_mode "
-                "(append tables keep every row; there is nothing to merge)"
-            )
-        rule = SingleRegionRule()
-        if stmt.partition_by_hash is not None:
-            cols, n = stmt.partition_by_hash
-            rule = HashPartitionRule(cols, n)
-        elif stmt.partition_on_columns is not None:
-            from .models.partition import MultiDimPartitionRule
-
-            pcols, pexprs = stmt.partition_on_columns
-            if pexprs:
-                from .query.expr import to_sql
-
-                for pc_name in pcols:
-                    if not schema.has_column(pc_name):
-                        raise InvalidArgumentsError(
-                            f"partition column {pc_name!r} is not a table column"
-                        )
-                # fully-parenthesized rendering: the rule text must re-parse
-                # to the same tree (name() drops OR/AND grouping)
-                rule = MultiDimPartitionRule(pcols, [to_sql(e) for e in pexprs])
+        schema, rule = build_schema_and_rule(stmt)
         self.catalog.create_table(
             stmt.name,
             schema,
@@ -927,11 +858,7 @@ class Database:
             if info.is_information_schema(self.current_database):
                 return pa.table({"Tables": info.table_names()})
             names = [m.name for m in self.catalog.tables(self.current_database)]
-            if stmt.like:
-                import fnmatch
-
-                names = [n for n in names if fnmatch.fnmatch(n, stmt.like.replace("%", "*"))]
-            return pa.table({"Tables": names})
+            return pa.table({"Tables": filter_like(names, stmt.like)})
         if stmt.what == "databases":
             return pa.table({"Database": self.catalog.databases()})
         if stmt.what == "create_table":
@@ -939,18 +866,11 @@ class Database:
             return pa.table({"Table": [meta.name], "Create Table": [_render_create(meta)]})
         if stmt.what == "flows":
             flows = self.flows.list_flows()
-            if stmt.like:
-                import fnmatch
-
-                flows = [f for f in flows if fnmatch.fnmatch(f.name, stmt.like.replace("%", "*"))]
-            return pa.table({"Flows": [f.name for f in flows]})
+            names = filter_like([f.name for f in flows], stmt.like)
+            return pa.table({"Flows": names})
         if stmt.what == "views":
             names = sorted(self.catalog.views(self.current_database))
-            if stmt.like:
-                import fnmatch
-
-                names = [n for n in names if fnmatch.fnmatch(n, stmt.like.replace("%", "*"))]
-            return pa.table({"Views": names})
+            return pa.table({"Views": filter_like(names, stmt.like)})
         if stmt.what == "create_view":
             sql_text = self.catalog.view(stmt.target, self.current_database)
             if sql_text is None:
@@ -980,26 +900,7 @@ class Database:
 
     def _describe(self, stmt: DescribeStmt):
         meta = self.catalog.table(stmt.table, self.current_database)
-        rows = {
-            "Column": [],
-            "Type": [],
-            "Key": [],
-            "Null": [],
-            "Default": [],
-            "Semantic Type": [],
-        }
-        for c in meta.schema.columns:
-            rows["Column"].append(c.name)
-            rows["Type"].append(c.data_type.value)
-            rows["Key"].append("PRI" if c.semantic_type == SemanticType.TAG else "")
-            rows["Null"].append("YES" if c.nullable else "NO")
-            rows["Default"].append(str(c.default) if c.default is not None else "")
-            rows["Semantic Type"].append(
-                {SemanticType.TAG: "TAG", SemanticType.FIELD: "FIELD", SemanticType.TIMESTAMP: "TIMESTAMP"}[
-                    c.semantic_type
-                ]
-            )
-        return pa.table(rows)
+        return render_describe(meta)
 
     # ---- ADMIN ------------------------------------------------------------
     def _admin(self, stmt: AdminStmt):
@@ -1344,6 +1245,120 @@ class Database:
                             rid, meta.schema, append_mode=append,
                             memtable_kind=mk, merge_mode=mm,
                         )
+
+
+def render_describe(meta) -> pa.Table:
+    """DESCRIBE TABLE rendering, shared by the standalone Database and the
+    distributed Frontend so shared sqlness goldens stay byte-identical."""
+    rows = {
+        "Column": [],
+        "Type": [],
+        "Key": [],
+        "Null": [],
+        "Default": [],
+        "Semantic Type": [],
+    }
+    for c in meta.schema.columns:
+        rows["Column"].append(c.name)
+        rows["Type"].append(c.data_type.value)
+        rows["Key"].append("PRI" if c.semantic_type == SemanticType.TAG else "")
+        rows["Null"].append("YES" if c.nullable else "NO")
+        rows["Default"].append(str(c.default) if c.default is not None else "")
+        rows["Semantic Type"].append(
+            {
+                SemanticType.TAG: "TAG",
+                SemanticType.FIELD: "FIELD",
+                SemanticType.TIMESTAMP: "TIMESTAMP",
+            }[c.semantic_type]
+        )
+    return pa.table(rows)
+
+
+def filter_like(names: list[str], like: str | None) -> list[str]:
+    """SHOW ... LIKE pattern filter (SQL % glob), shared for the same
+    golden-parity reason as render_describe."""
+    if not like:
+        return names
+    import fnmatch
+
+    return [n for n in names if fnmatch.fnmatch(n, like.replace("%", "*"))]
+
+
+def build_schema_and_rule(stmt: CreateTableStmt):
+    """CreateTableStmt -> (Schema, partition rule): the single source of
+    CREATE TABLE semantics, shared by the standalone Database and the
+    distributed Frontend role so both build identical tables."""
+    columns: list[ColumnSchema] = []
+    time_index = stmt.time_index
+    pks = set(stmt.primary_key)
+    for c in stmt.columns:
+        if c.is_time_index:
+            time_index = c.name
+        if c.is_primary_key:
+            pks.add(c.name)
+    for c in stmt.columns:
+        if c.name == time_index:
+            sem = SemanticType.TIMESTAMP
+        elif c.name in pks:
+            sem = SemanticType.TAG
+        else:
+            sem = SemanticType.FIELD
+        dt = ConcreteDataType.parse(c.type_name)
+        vdim = None
+        if dt == ConcreteDataType.VECTOR:
+            import re as _re
+
+            m = _re.match(r"vector\s*\(\s*(\d+)\s*\)", c.type_name.strip().lower())
+            if not m:
+                raise InvalidArgumentsError(
+                    f"VECTOR column {c.name!r} needs a dimension: VECTOR(n)"
+                )
+            vdim = int(m.group(1))
+        columns.append(
+            ColumnSchema(
+                name=c.name,
+                data_type=dt,
+                semantic_type=sem,
+                nullable=c.nullable and sem == SemanticType.FIELD,
+                default=c.default,
+                fulltext=getattr(c, "fulltext", False),
+                vector_dim=vdim,
+                vector_index=getattr(c, "vector_index", False),
+            )
+        )
+    if time_index is None:
+        raise InvalidArgumentsError("table requires a TIME INDEX column")
+    schema = Schema(columns=columns)
+    mm = str(stmt.options.get("merge_mode", "")).strip()
+    if mm not in ("", "last_row", "last_non_null"):
+        raise InvalidArgumentsError(
+            f"invalid merge_mode {mm!r}: expected 'last_row' or 'last_non_null'"
+        )
+    if mm == "last_non_null" and _opt_bool(stmt.options, "append_mode"):
+        raise InvalidArgumentsError(
+            "merge_mode = 'last_non_null' conflicts with append_mode "
+            "(append tables keep every row; there is nothing to merge)"
+        )
+    rule = SingleRegionRule()
+    if stmt.partition_by_hash is not None:
+        cols, n = stmt.partition_by_hash
+        rule = HashPartitionRule(cols, n)
+    elif stmt.partition_on_columns is not None:
+        from .models.partition import MultiDimPartitionRule
+
+        pcols, pexprs = stmt.partition_on_columns
+        if pexprs:
+            from .query.expr import to_sql
+
+            for pc_name in pcols:
+                if not schema.has_column(pc_name):
+                    raise InvalidArgumentsError(
+                        f"partition column {pc_name!r} is not a table column"
+                    )
+            # fully-parenthesized rendering: the rule text must re-parse
+            # to the same tree (name() drops OR/AND grouping)
+            rule = MultiDimPartitionRule(pcols, [to_sql(e) for e in pexprs])
+    return schema, rule
 
 
 def _opt_bool(options: dict, key: str) -> bool:

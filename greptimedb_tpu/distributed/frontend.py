@@ -1,0 +1,381 @@
+"""Deployable distributed frontend role.
+
+Role-equivalent of the reference's `greptime frontend start` process
+(reference cmd/src/bin/greptime.rs:37-61 spawning
+frontend/src/instance.rs:110 `Instance`): a stateless node that serves
+SQL over HTTP/MySQL by
+
+  * resolving table metadata from the shared catalog (the reference reads
+    it from the metasrv-backed KV; here the catalog file lives on the
+    shared storage the datanodes already require),
+  * asking the metasrv for region routes and peer addresses
+    (distributed/meta_service.py MetaClient — the reference's
+    meta-client),
+  * fanning writes out per region over Arrow Flight DoPut and queries out
+    as serialized sub-plans / partial-aggregate tickets over Flight
+    do_get (reference operator/src/insert.rs:441 group_requests_by_peer,
+    query/src/dist_plan/merge_scan.rs:250-330 MergeScanExec).
+
+The frontend holds NO storage engine: every row it touches arrives over
+the wire.  DDL placement goes through the metasrv selector the same way
+the in-process Cluster does.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time as _time
+
+import pyarrow as pa
+
+from ..database import _coerce_array, _opt_bool, build_schema_and_rule
+from ..models.catalog import Catalog
+from ..query.engine import QueryEngine
+from ..query.logical_plan import TableScan
+from ..query.sql_parser import (
+    CreateTableStmt,
+    DescribeStmt,
+    DropStmt,
+    InsertStmt,
+    SelectStmt,
+    ShowStmt,
+    UseStmt,
+    parse_sql,
+)
+from ..storage.sst import ScanPredicate
+from ..utils.config import Config
+from ..utils.errors import (
+    InvalidArgumentsError,
+    RetryLaterError,
+    TableNotFoundError,
+    UnsupportedError,
+)
+from .flight import FlightDatanodeClient
+from .meta_service import MetaClient
+
+
+class Frontend:
+    """Distributed SQL front door over remote datanodes."""
+
+    def __init__(
+        self,
+        data_home: str,
+        metasrv_peers: list[str],
+        node_id: int = 0,
+    ):
+        self.node_id = node_id
+        self.data_home = data_home
+        self.meta = MetaClient(metasrv_peers)
+        self.catalog = Catalog(os.path.join(data_home, "catalog.json"))
+        self.current_database = "public"
+        self.config = Config()
+        # backend stays "tpu" so the engine's distributed planner engages
+        # (state shipping / sub-plan fan-out); with no tile context the
+        # frontend never touches local devices — datanodes own the
+        # data-proximate compute and ship bounded states/rows
+        self._clients: dict[int, FlightDatanodeClient] = {}
+        self._clients_lock = threading.Lock()
+        self.query_engine = QueryEngine(
+            schema_provider=lambda t, d: self._table(t, d).schema,
+            scan_provider=self._scan,
+            region_scan_provider=self._region_scan,
+            time_bounds_provider=self._time_bounds,
+            config=self.config.query,
+            partial_agg_provider=self._partial_agg,
+            subplan_provider=self._sub_plan,
+        )
+
+    # ---- peers -------------------------------------------------------------
+    def _client(self, node_id: int) -> FlightDatanodeClient:
+        with self._clients_lock:
+            c = self._clients.get(node_id)
+        if c is not None and c.alive:
+            return c
+        addrs = self.meta.node_addresses()
+        addr = addrs.get(node_id)
+        if addr is None:
+            raise RetryLaterError(f"datanode {node_id} has no registered address")
+        c = FlightDatanodeClient(node_id, f"grpc://{addr}")
+        with self._clients_lock:
+            self._clients[node_id] = c
+        return c
+
+    def _with_client(self, node_id: int, fn):
+        """Run `fn(client)`; on a connection failure drop the cached
+        client, re-resolve the node's address from the metasrv, and retry
+        ONCE — a restarted datanode comes back on a fresh port, and the
+        old Flight channel reports errors without ever marking itself
+        dead (reference client_manager channel invalidation)."""
+        try:
+            return fn(self._client(node_id))
+        except ConnectionError:
+            with self._clients_lock:
+                self._clients.pop(node_id, None)
+            return fn(self._client(node_id))
+
+    def _table(self, name: str, database: str | None = None):
+        database = database or self.current_database
+        try:
+            return self.catalog.table(name, database)
+        except TableNotFoundError:
+            # another frontend may have created it: reload from the
+            # shared catalog file once (reference frontends see DDL via
+            # KV cache invalidation; the file IS our KV here)
+            self.catalog.reload()
+            return self.catalog.table(name, database)
+
+    # ---- SQL entry (same contract as Database.sql) -------------------------
+    def sql(self, text: str) -> list:
+        """Execute ;-separated SQL; returns a list of results (pa.Table
+        for queries, int affected-rows for writes, None for DDL)."""
+        return [self._execute(stmt) for stmt in parse_sql(text)]
+
+    def sql_one(self, text: str):
+        out = self.sql(text)
+        return out[-1] if out else None
+
+    # protocol-server shims (the HTTP/MySQL servers speak the Database
+    # surface; the frontend is per-process single-session for now)
+    def ensure_session(self):
+        return self
+
+    def session_tzinfo(self, tz: str | None = None):
+        return None  # UTC
+
+    @property
+    def session_timezone(self) -> str:
+        return "UTC"
+
+    def _execute(self, stmt):
+        if isinstance(stmt, SelectStmt):
+            return self.query_engine.execute_select(stmt, self.current_database)
+        if isinstance(stmt, CreateTableStmt):
+            return self._create_table(stmt)
+        if isinstance(stmt, InsertStmt):
+            return self._insert(stmt)
+        if isinstance(stmt, ShowStmt):
+            return self._show(stmt)
+        if isinstance(stmt, DescribeStmt):
+            return self._describe(stmt)
+        if isinstance(stmt, DropStmt):
+            return self._drop(stmt)
+        if isinstance(stmt, UseStmt):
+            self.current_database = stmt.database
+            return None
+        raise UnsupportedError(
+            f"the distributed frontend does not support {type(stmt).__name__} yet"
+        )
+
+    # ---- DDL ---------------------------------------------------------------
+    def _create_table(self, stmt: CreateTableStmt):
+        if stmt.external or stmt.engine in ("file", "metric"):
+            raise UnsupportedError(
+                "external/metric tables are standalone-only for now"
+            )
+        schema, rule = build_schema_and_rule(stmt)
+
+        def place_regions(m):
+            routes: dict[int, int] = {}
+            try:
+                for rid in m.region_ids:
+                    node = self.meta.select_datanode()
+                    if node is None:
+                        raise RetryLaterError("no live datanode to place region on")
+                    self._client(node).open_region(rid, schema)
+                    routes[rid] = node
+            except Exception:
+                for rid, node in routes.items():
+                    try:
+                        self._client(node).close_region(rid)
+                    except Exception:  # noqa: BLE001 — best-effort rollback
+                        pass
+                raise
+            self.meta.set_route(m.table_id, routes)
+
+        self.catalog.create_table(
+            stmt.name,
+            schema,
+            partition_rule=rule,
+            database=getattr(stmt, "database", None) or self.current_database,
+            if_not_exists=stmt.if_not_exists,
+            options=stmt.options,
+            on_create=place_regions,
+        )
+        return None
+
+    def _drop(self, stmt: DropStmt):
+        if stmt.kind != "table":
+            raise UnsupportedError(f"DROP {stmt.kind} is standalone-only for now")
+        database = self.current_database
+        try:
+            meta = self._table(stmt.name, database)
+        except TableNotFoundError:
+            if stmt.if_exists:
+                return None
+            raise
+        routes = self.meta.get_route(meta.table_id)
+        self.catalog.drop_table(stmt.name, database)
+        for rid in meta.region_ids:
+            node = routes.get(rid)
+            if node is None:
+                continue
+            try:
+                self._client(node).close_region(rid)
+            except Exception:  # noqa: BLE001 — the region is unrouted already
+                pass
+        return None
+
+    # ---- DML ---------------------------------------------------------------
+    def _insert(self, stmt: InsertStmt) -> int:
+        meta = self._table(stmt.table, getattr(stmt, "database", None))
+        schema = meta.schema
+        columns = stmt.columns or schema.column_names()
+        if any(not schema.has_column(c) for c in columns):
+            bad = [c for c in columns if not schema.has_column(c)]
+            raise InvalidArgumentsError(f"unknown columns in INSERT: {bad}")
+        by_name = {c: [row[i] for row in stmt.rows] for i, c in enumerate(columns)}
+        arrays = []
+        for col in schema.columns:
+            values = by_name.get(col.name, [col.default] * len(stmt.rows))
+            arrays.append(_coerce_array(values, col))
+        batch = pa.RecordBatch.from_arrays(arrays, schema=schema.to_arrow())
+        return self.write_batch(meta, batch)
+
+    def write_batch(self, meta, batch: pa.RecordBatch) -> int:
+        """Per-region fan-out over Flight DoPut (reference Inserter)."""
+        routes = self.meta.get_route(meta.table_id)
+        table = pa.Table.from_batches([batch])
+        affected = 0
+        region_ids = meta.region_ids
+        for i, part in enumerate(meta.partition_rule.split(table)):
+            if part.num_rows == 0:
+                continue
+            rid = region_ids[i]
+            node = self._routed(routes, rid, meta)
+            for b in part.to_batches():
+                affected += self._with_client(node, lambda c: c.write(rid, b))
+        return affected
+
+    def insert_rows(self, table: str, rows, database: str | None = None) -> int:
+        meta = self._table(table, database)
+        if isinstance(rows, pa.Table):
+            batches = rows.combine_chunks().to_batches()
+        else:
+            batches = [rows]
+        from ..database import _conform_batch
+
+        return sum(
+            self.write_batch(meta, _conform_batch(b, meta.schema)) for b in batches
+        )
+
+    # ---- SHOW / DESCRIBE ---------------------------------------------------
+    def _show(self, stmt: ShowStmt):
+        # shared renderers keep this byte-identical to the standalone
+        # Database (shared sqlness goldens enforce it)
+        from ..database import filter_like
+
+        if stmt.what == "tables":
+            self.catalog.reload()
+            names = [m.name for m in self.catalog.tables(self.current_database)]
+            return pa.table({"Tables": filter_like(names, stmt.like)})
+        if stmt.what == "databases":
+            self.catalog.reload()
+            return pa.table({"Database": self.catalog.databases()})
+        raise UnsupportedError(f"SHOW {stmt.what} is standalone-only for now")
+
+    def _describe(self, stmt: DescribeStmt):
+        from ..database import render_describe
+
+        return render_describe(self._table(stmt.table))
+
+    # ---- query providers (mirror Cluster's, over Flight) -------------------
+    def _pred(self, scan: TableScan) -> ScanPredicate:
+        return ScanPredicate(
+            time_range=scan.time_range, filters=[tuple(f) for f in scan.filters]
+        )
+
+    def _routed(self, routes: dict, rid: int, meta) -> int:
+        node = routes.get(rid)
+        if node is None:
+            # same retryable shape as the write path: an unrouted region
+            # (metasrv restarted, table created outside the cluster) must
+            # never surface as a raw KeyError / HTTP 500
+            raise RetryLaterError(
+                f"region {rid} of {meta.name!r} has no route yet; retry"
+            )
+        return node
+
+    def _fanout(self, meta, fn):
+        routes = self.meta.get_route(meta.table_id)
+        rids = meta.region_ids
+        if len(rids) <= 1:
+            return [fn(rid, self._routed(routes, rid, meta)) for rid in rids]
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(len(rids), 8)) as pool:
+            return list(
+                pool.map(lambda r: fn(r, self._routed(routes, r, meta)), rids)
+            )
+
+    def _region_scan(self, scan: TableScan) -> list[pa.Table]:
+        meta = self._table(scan.table, scan.database)
+        pred = self._pred(scan)
+        return self._fanout(
+            meta,
+            lambda rid, node: self._with_client(node, lambda c: c.scan(rid, pred)),
+        )
+
+    def _partial_agg(self, scan: TableScan, spec_dict: dict) -> list[pa.Table]:
+        meta = self._table(scan.table, scan.database)
+        pred = self._pred(scan)
+        return self._fanout(
+            meta,
+            lambda rid, node: self._with_client(
+                node, lambda c: c.partial_agg(rid, pred, spec_dict)
+            ),
+        )
+
+    def _sub_plan(self, scan: TableScan, plan_dict: dict) -> list[pa.Table]:
+        meta = self._table(scan.table, scan.database)
+        return self._fanout(
+            meta,
+            lambda rid, node: self._with_client(
+                node, lambda c: c.execute_plan(rid, plan_dict)
+            ),
+        )
+
+    def _scan(self, scan: TableScan) -> pa.Table:
+        tables = [t for t in self._region_scan(scan) if t.num_rows]
+        meta = self._table(scan.table, scan.database)
+        if not tables:
+            return meta.schema.to_arrow().empty_table()
+        return pa.concat_tables(tables, promote_options="permissive")
+
+    def _time_bounds(self, table: str, database: str):
+        meta = self._table(table, database)
+        routes = self.meta.get_route(meta.table_id)
+        lo = hi = None
+        for rid in meta.region_ids:
+            node = self._routed(routes, rid, meta)
+            b = self._with_client(node, lambda c: c.time_bounds(rid))
+            if b is None:
+                continue
+            lo = b[0] if lo is None else min(lo, b[0])
+            hi = b[1] if hi is None else max(hi, b[1])
+        return (lo or 0, hi or 0)
+
+    # ---- liveness ----------------------------------------------------------
+    def heartbeat(self):
+        """Frontend liveness ping to the metasrv (reference
+        frontend/src/heartbeat.rs)."""
+        try:
+            self.meta.handle_heartbeat(
+                self.node_id, [], _time.time() * 1000, role="frontend"
+            )
+        except Exception:  # noqa: BLE001 — liveness is advisory
+            pass
+
+    def close(self):
+        with self._clients_lock:
+            self._clients.clear()

@@ -81,14 +81,20 @@ class MetasrvServer:
                 leader = m.election.leader()
             return {"is_leader": is_leader, "leader": leader}
         if path == "/register":
-            m.register_datanode(int(body["node_id"]))
+            m.register_datanode(int(body["node_id"]), body.get("addr"))
             return {"ok": True}
+        if path == "/nodes":
+            return {"nodes": {
+                str(k): v
+                for k, v in m.node_addresses(body.get("role", "datanode")).items()
+            }}
         if not m.is_leader():
             raise IllegalStateError("not the metasrv leader")
         if path == "/heartbeat":
             return m.handle_heartbeat(
                 int(body["node_id"]), body.get("stats", []), float(body["now_ms"]),
                 role=body.get("role", "datanode"),
+                addr=body.get("addr"),
             )
         if path == "/route/get":
             return {"routes": {str(k): v for k, v in m.get_route(int(body["table_id"])).items()}}
@@ -161,15 +167,21 @@ class MetaClient:
             raise RuntimeError(f"metasrv error {e.code}: {msg}") from e
 
     # ---- Metasrv surface ---------------------------------------------------
-    def register_datanode(self, node_id: int):
-        self._call("/register", {"node_id": node_id})
+    def register_datanode(self, node_id: int, addr: str | None = None):
+        self._call("/register", {"node_id": node_id, "addr": addr})
+
+    def node_addresses(self, role: str = "datanode") -> dict[int, str]:
+        out = self._call("/nodes", {"role": role})
+        return {int(k): v for k, v in out["nodes"].items()}
 
     def handle_heartbeat(
-        self, node_id: int, stats: list, now_ms: float, role: str = "datanode"
+        self, node_id: int, stats: list, now_ms: float, role: str = "datanode",
+        addr: str | None = None,
     ) -> dict:
         return self._call(
             "/heartbeat",
-            {"node_id": node_id, "stats": stats, "now_ms": now_ms, "role": role},
+            {"node_id": node_id, "stats": stats, "now_ms": now_ms, "role": role,
+             "addr": addr},
         )
 
     def get_route(self, table_id: int) -> dict[int, int]:

@@ -32,6 +32,11 @@ class DatanodeInfo:
     detector: PhiAccrualFailureDetector = field(default_factory=PhiAccrualFailureDetector)
     mailbox: list[dict] = field(default_factory=list)  # pending Instructions
     last_stats: list = field(default_factory=list)
+    # network address of the node's serving endpoint (Flight for
+    # datanodes), registered/refreshed via heartbeat so frontends can
+    # discover peers from the metasrv alone (reference
+    # common/meta/src/key/node_address.rs)
+    addr: str | None = None
 
 
 class RegionFailoverProcedure(Procedure):
@@ -174,9 +179,22 @@ class Metasrv:
         return self.election is None or self.election.is_leader()
 
     # ---- membership -------------------------------------------------------
-    def register_datanode(self, node_id: int):
+    def register_datanode(self, node_id: int, addr: str | None = None):
         with self._lock:
-            self.datanodes.setdefault(node_id, DatanodeInfo(node_id))
+            info = self.datanodes.setdefault(node_id, DatanodeInfo(node_id))
+            if addr is not None:
+                info.addr = addr
+
+    def node_addresses(self, role: str = "datanode") -> dict[int, str]:
+        """Live nodes of a role with a registered address — the peer
+        discovery surface frontends use (reference table-route +
+        node_address lookups resolved through the meta client)."""
+        with self._lock:
+            return {
+                n: info.addr
+                for n, info in self.datanodes.items()
+                if info.role == role and info.addr and info.alive
+            }
 
     def select_datanode(self, exclude: set[int] = frozenset()) -> int | None:
         """Datanode placement.  `selector` picks the policy:
@@ -231,13 +249,25 @@ class Metasrv:
     def handle_heartbeat(
         self, node_id: int, region_stats: list, now_ms: float,
         role: str = "datanode",
+        addr: str | None = None,
     ) -> dict:
         with self._lock:
-            info = self.datanodes.setdefault(node_id, DatanodeInfo(node_id))
+            info = self.datanodes.get(node_id)
+            if info is None:
+                info = self.datanodes[node_id] = DatanodeInfo(node_id, role=role)
+            elif info.role != role:
+                # a node id is bound to its first-seen role: silently
+                # flipping a datanode's role to frontend/flownode would
+                # remove it from placement + address discovery
+                raise IllegalStateError(
+                    f"node id {node_id} is registered as {info.role!r}; "
+                    f"give the {role} a distinct node id"
+                )
             info.detector.heartbeat(now_ms)
             info.alive = True
-            info.role = role
             info.last_stats = region_stats
+            if addr is not None:
+                info.addr = addr
             instructions, info.mailbox = info.mailbox, []
         # Lease extension for every region the routes say this node owns.
         leases = [rid for _t, rid in self.regions_on(node_id)]

@@ -92,12 +92,13 @@ class Catalog:
         # Bumped on every mutation — plan caches key on it so DDL invalidates
         # cached plans (the reference invalidates via KV cache broadcasts).
         self.revision = 0
+        self._loaded_stat: tuple | None = None  # (mtime_ns, size) at last load
         if path and os.path.exists(path):
             self._load()
 
     # ---- databases --------------------------------------------------------
     def create_database(self, name: str, if_not_exists: bool = False):
-        with self._lock:
+        with self._ddl_guard():
             if name in self._databases:
                 if if_not_exists:
                     return
@@ -106,7 +107,7 @@ class Catalog:
             self._persist()
 
     def drop_database(self, name: str):
-        with self._lock:
+        with self._ddl_guard():
             if name not in self._databases:
                 raise DatabaseNotFoundError(f"database not found: {name}")
             if name == DEFAULT_SCHEMA:
@@ -118,6 +119,55 @@ class Catalog:
     def databases(self) -> list[str]:
         with self._lock:
             return sorted(self._databases)
+
+    def reload(self):
+        """Re-read the persisted catalog: multi-process deployments (a
+        distributed frontend beside other frontends/standalone tools on
+        the same shared storage) see each other's DDL this way — the
+        file plays the role of the reference's KV + cache invalidation."""
+        with self._lock:
+            if self.path and os.path.exists(self.path):
+                # unchanged file = no-op: reload is called on every SHOW
+                # by multi-process frontends, and an unconditional bump
+                # would evict warm plan caches for nothing
+                st = os.stat(self.path)
+                if self._loaded_stat == (st.st_mtime_ns, st.st_size):
+                    return
+                self._load()
+                self.revision += 1  # invalidate plan caches keyed on it
+
+    def _ddl_guard(self):
+        """Cross-PROCESS DDL critical section: an exclusive flock around
+        reload -> mutate -> persist.  Without it two frontends over one
+        shared catalog file race read-modify-write: both allocate the
+        same table_id and the second _persist() erases the first's table
+        while its regions stay open (the reference serializes DDL through
+        metasrv procedures + KV transactions; the lock file plays the KV
+        txn's role here).  In-memory-only catalogs (tests) skip it."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def guard():
+            with self._lock:
+                if not self.path:
+                    yield
+                    return
+                import fcntl
+
+                os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+                with open(self.path + ".lock", "a") as lf:
+                    fcntl.flock(lf, fcntl.LOCK_EX)
+                    try:
+                        if os.path.exists(self.path):
+                            st = os.stat(self.path)
+                            if self._loaded_stat != (st.st_mtime_ns, st.st_size):
+                                self._load()  # another process mutated it
+                                self.revision += 1
+                        yield
+                    finally:
+                        fcntl.flock(lf, fcntl.LOCK_UN)
+
+        return guard()
 
     # ---- tables -----------------------------------------------------------
     def create_table(
@@ -135,7 +185,7 @@ class Catalog:
         regions atomically with the metadata publish (the reference commits
         region creation and KV metadata in one DDL procedure step,
         common/meta/src/ddl/create_table.rs)."""
-        with self._lock:
+        with self._ddl_guard():
             db = self._db(database)
             if name in db:
                 if if_not_exists:
@@ -157,7 +207,7 @@ class Catalog:
             return meta
 
     def drop_table(self, name: str, database: str = DEFAULT_SCHEMA) -> TableMeta:
-        with self._lock:
+        with self._ddl_guard():
             db = self._db(database)
             if name not in db:
                 raise TableNotFoundError(f"table not found: {name}")
@@ -170,7 +220,7 @@ class Catalog:
     ) -> TableMeta:
         """Rename keeps table_id and regions (the reference's RenameTable
         alter kind rewrites only the name keys, common/meta/src/key/table_name.rs)."""
-        with self._lock:
+        with self._ddl_guard():
             db = self._db(database)
             if old not in db:
                 raise TableNotFoundError(f"table not found: {database}.{old}")
@@ -198,7 +248,7 @@ class Catalog:
             return sorted(self._db(database).values(), key=lambda m: m.name)
 
     def update_table(self, meta: TableMeta):
-        with self._lock:
+        with self._ddl_guard():
             self._db(meta.database)[meta.name] = meta
             self._persist()
 
@@ -214,7 +264,7 @@ class Catalog:
         or_replace: bool = False,
         if_not_exists: bool = False,
     ):
-        with self._lock:
+        with self._ddl_guard():
             self._db(database)  # validates the database exists
             views = self._views.setdefault(database, {})
             if name in views and not or_replace:
@@ -227,7 +277,7 @@ class Catalog:
             self._persist()
 
     def drop_view(self, name: str, database: str = DEFAULT_SCHEMA, if_exists: bool = False):
-        with self._lock:
+        with self._ddl_guard():
             views = self._views.get(database, {})
             if name not in views:
                 if if_exists:
@@ -269,8 +319,12 @@ class Catalog:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+        st = os.stat(self.path)
+        self._loaded_stat = (st.st_mtime_ns, st.st_size)  # disk == memory
 
     def _load(self):
+        st = os.stat(self.path)
+        self._loaded_stat = (st.st_mtime_ns, st.st_size)
         with open(self.path) as f:
             state = json.load(f)
         self._next_table_id = state["next_table_id"]

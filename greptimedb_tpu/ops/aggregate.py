@@ -274,10 +274,34 @@ def limb_segment_sums(
                 planes.append(c01.reshape(nb, L, 1).astype(jnp.bfloat16))
         for limbs, _s in limbs_scales:
             planes.append(limbs)
-        M = jnp.concatenate(planes, axis=-1)  # [nb, L, 1 + NC + 4C]
-        P = jnp.einsum(
-            "blk,blm->bkm", sel, M, preferred_element_type=jnp.float32
-        )
+        # einsum in bounded column groups: ONE concatenated [nb, L, M]
+        # digit matrix for 10 columns is a ~2.7 GB transient at 2^24 rows
+        # — on top of ~10 GB of resident planes that overcommitted HBM at
+        # TSBS 3-day scale.  Grouping caps the transient at ~0.7 GB; sel
+        # is reused across groups, and XLA frees each group's buffers
+        # before the next materializes.
+        group_cols = 24  # digit planes per einsum (~6 value columns)
+        parts = []
+        i = 0
+        while i < len(planes):
+            g = planes[i:]
+            width = 0
+            take = 0
+            for p in g:
+                if take and width + p.shape[-1] > group_cols:
+                    break
+                width += p.shape[-1]
+                take += 1
+            M = (
+                jnp.concatenate(planes[i : i + take], axis=-1)
+                if take > 1
+                else planes[i]
+            )
+            parts.append(jnp.einsum(
+                "blk,blm->bkm", sel, M, preferred_element_type=jnp.float32
+            ))
+            i += take
+        P = jnp.concatenate(parts, axis=-1) if len(parts) > 1 else parts[0]
         presence_b = P[:, :, 0].astype(jnp.int32)  # exact (<= L per slot)
         presence = windowed_slot_sum(presence_b, base, segs, span)[:num_groups]
         off = 1

@@ -185,6 +185,16 @@ class _SuperTiles:
     # so warm aggregates skip the ~3 ms/column/chunk quantize pass.
     # Evicted before whole entries under HBM pressure (_evict_locked).
     limb_cols: dict[str, list] = field(default_factory=dict)
+    # last-write-wins dedup planes (built when a region's files overlap in
+    # time): keep[i] = row i is the LAST version of its (pk..., ts) key.
+    # The (pk, ts) lexsort is STABLE, so duplicate keys sit adjacent in
+    # flush order and one shifted != over the sorted host encodes finds
+    # the survivors — the TPU answer to the reference's in-stream
+    # DedupReader (mito2/src/read/dedup.rs).  keep_host serves the host
+    # fast path; valid_dedup replaces `valid` in device dispatches.
+    keep_host: np.ndarray | None = None
+    valid_dedup: list | None = None
+    tm_valid_dedup: list | None = None
     nbytes: int = 0
     host_nbytes: int = 0  # sorted_host/order/offsets bytes (host budget)
 
@@ -259,6 +269,50 @@ class TileCacheManager:
         self.invalidate_region(region_id, keep_file_ids)
         with self._lock:
             self._region_versions[region_id] = manifest_version
+
+    def _reserve_locked(self, est: int, pinned_regions: set[int]):
+        """Make room for `est` bytes ABOUT to allocate on device: evict as
+        if the budget were already reduced by them.  Every ensure_* path
+        that allocates must reserve first — charging after allocation let
+        transients overshoot HBM at TSBS 3-day scale."""
+        if est and self._used > self.budget - est:
+            saved, self.budget = self.budget, max(self.budget - est, 0)
+            try:
+                self._evict_locked(pinned_regions)
+            finally:
+                self.budget = saved
+
+    def emergency_release(self, pinned_regions: set[int]):
+        """Device OOM recovery: strip every re-derivable plane (limb +
+        time-major copies + perms) and evict unpinned entries down to
+        half the budget, so a retry dispatch sees maximal free HBM.
+        In-flight queries keep their own arrays alive via references."""
+        with self._lock:
+            for entry in list(self._super.values()):
+                freed = sum(
+                    sum(int(l.nbytes) + int(s.nbytes) for l, s in chunks)
+                    for chunks in entry.limb_cols.values()
+                )
+                entry.limb_cols.clear()
+                for attr in ("tm_valid", "tm_valid_dedup"):
+                    planes = getattr(entry, attr)
+                    if planes is not None:
+                        freed += sum(int(x.nbytes) for x in planes)
+                        setattr(entry, attr, None)
+                for d in (entry.tm_cols, entry.tm_nulls):
+                    for chunks in d.values():
+                        freed += sum(int(x.nbytes) for x in chunks)
+                    d.clear()
+                if entry.perm is not None:
+                    freed += int(entry.perm.nbytes)
+                    entry.perm = None
+                entry.nbytes -= freed
+                self._used -= freed
+            saved, self.budget = self.budget, self.budget // 2
+            try:
+                self._evict_locked(pinned_regions)
+            finally:
+                self.budget = saved
 
     def _evict_locked(self, pinned_regions: set[int]):
         # limb planes are re-derivable from the resident f64 planes in a
@@ -479,6 +533,24 @@ class TileCacheManager:
                 with self._lock:
                     self._host_used += hb
 
+            # pre-upload eviction: make room for the columns about to
+            # upload BEFORE the device allocations happen — charging the
+            # budget afterwards let the transient overshoot HBM at
+            # TSBS 3-day scale (resident limb planes + a 10-column f64
+            # upload exceeded the chip; the budget check came too late)
+            est = 0
+            for name in missing:
+                any_nulls_est = any(
+                    name in ht.nulls or name in ht.absent for ht in host_tiles
+                )
+                src0 = next(
+                    (ht.cols[name] for ht in host_tiles if name in ht.cols), None
+                )
+                item = src0.dtype.itemsize if src0 is not None else 8
+                est += entry.pad * (item + (1 if any_nulls_est else 0))
+            with self._lock:
+                self._reserve_locked(est, pinned_regions | {rid})
+
             added = 0
             bounds = _chunk_bounds(entry.pad)
             if entry.valid is None:
@@ -570,22 +642,40 @@ class TileCacheManager:
                     entry.host_epochs[tag] = dictionary.epoch
 
     def ensure_time_major(
-        self, entry: _SuperTiles, ts_name: str, cols_needed: set[str]
+        self, entry: _SuperTiles, ts_name: str, cols_needed: set[str],
+        dedup: bool = False,
     ):
         """Materialize ts-ascending device copies of the needed columns
         (one gather each, once per (region, file-set, column)) so
         time-major dispatches are gather-free.  Returns (cols, valid,
-        nulls) views limited to `cols_needed`."""
+        nulls) views limited to `cols_needed`; with `dedup` the valid
+        planes carry the last-write-wins keep mask (ensure_dedup_keep
+        must have run)."""
         perm = self.ensure_perm(entry, ts_name)
         bounds = _chunk_bounds(entry.pad)
         added = 0
         with self._lock:
+            # reserve for the copies about to materialize (each gather
+            # also holds a concatenated source transiently)
+            est = 0
+            for c in cols_needed:
+                if c in entry.cols and c not in entry.tm_cols:
+                    est += 2 * sum(int(x.nbytes) for x in entry.cols[c])
+                if c in entry.nulls and c not in entry.tm_nulls:
+                    est += 2 * entry.pad
+            if entry.tm_valid is None:
+                est += 2 * entry.pad
+            self._reserve_locked(est, {entry.region_id})
+
             def permuted_chunks(chunks):
                 full = jnp.concatenate(chunks)[perm]
                 return [full[a:b] for a, b in bounds]
 
             if entry.tm_valid is None:
                 entry.tm_valid = permuted_chunks(entry.valid)
+                added += entry.pad
+            if dedup and entry.tm_valid_dedup is None:
+                entry.tm_valid_dedup = permuted_chunks(entry.valid_dedup)
                 added += entry.pad
             for c in cols_needed:
                 if c in entry.cols and c not in entry.tm_cols:
@@ -600,7 +690,7 @@ class TileCacheManager:
                     self._used += added
         return (
             {c: entry.tm_cols[c] for c in cols_needed if c in entry.tm_cols},
-            entry.tm_valid,
+            entry.tm_valid_dedup if dedup else entry.tm_valid,
             {c: entry.tm_nulls[c] for c in cols_needed if c in entry.tm_nulls},
         )
 
@@ -627,20 +717,38 @@ class TileCacheManager:
         out: dict[str, list] = {}
         to_build: list[tuple[str, list]] = []
         with self._lock:
+            pending = []
             for c in cols_needed:
                 key = prefix + c
                 if key in entry.limb_cols:
                     out[c] = entry.limb_cols[key]
                     continue
-                chunks = src.get(c)
-                if chunks is None or any(
-                    x.shape[0] % BLOCK_ROWS or x.shape[0] < _LIMB_MIN_ROWS
-                    for x in chunks
-                ):
-                    continue
-                to_build.append((c, chunks))
+                pending.append(c)
+        for c in pending:
+            chunks = src.get(c)
+            if chunks is None and not time_major:
+                # f64 plane never uploaded (limb-only column): quantize
+                # straight from the host encodes — the f64 chunk uploads
+                # transiently and is freed once its limbs exist
+                chunks = self.host_column_chunks(entry, c)
+            if chunks is None or any(
+                x.shape[0] % BLOCK_ROWS or x.shape[0] < _LIMB_MIN_ROWS
+                for x in chunks
+            ):
+                continue
+            to_build.append((c, chunks))
         if not to_build:
             return out
+        # pre-evict for the planes about to allocate (4 bf16 digits =
+        # 8 B/row per column) — see the matching super_tiles pre-upload
+        # eviction; reserving after allocation can overshoot HBM
+        est = sum(
+            x.shape[0] * 8 + (x.shape[0] // BLOCK_ROWS) * 8
+            for _c, chunks in to_build
+            for x in chunks
+        )
+        with self._lock:
+            self._reserve_locked(est, pinned_regions | {entry.region_id})
         built_all = [
             (c, [_quantize_limbs_jit(x) for x in chunks])
             for c, chunks in to_build
@@ -665,6 +773,63 @@ class TileCacheManager:
                 # keep its own arrays alive regardless)
                 self._evict_locked(pinned_regions | {entry.region_id})
         return out
+
+    def ensure_dedup_keep(self, entry: _SuperTiles) -> bool:
+        """Build (once per file-set) the last-write-wins keep plane from
+        the sorted host encodes: a row survives unless the NEXT row holds
+        the same (pk..., ts) — lexsort stability orders duplicates by
+        flush sequence, so the newest version sits last in its run.
+        Returns False when the entry lacks sorted host planes."""
+        with self._lock:
+            if entry.valid_dedup is not None:
+                return True
+            if not entry.sorted_host or entry.order is None:
+                return False
+            n = entry.num_rows
+            keep = np.zeros(entry.pad, bool)
+            keep[:n] = True
+            if n > 1:
+                same = np.ones(n - 1, bool)
+                for arr in entry.sorted_host.values():
+                    same &= arr[:-1] == arr[1:]
+                keep[: n - 1] &= ~same
+            bounds = _chunk_bounds(entry.pad)
+            entry.keep_host = keep[:n]
+            entry.valid_dedup = [jnp.asarray(keep[a:b]) for a, b in bounds]
+            added = entry.pad  # device bools
+            entry.nbytes += added
+            entry.host_nbytes += entry.keep_host.nbytes
+            if self._super.get(entry.region_id) is entry:
+                self._used += added
+                self._host_used += entry.keep_host.nbytes
+            return True
+
+    def host_column_chunks(self, entry: _SuperTiles, name: str):
+        """Consolidated (sorted, padded, chunked) host-side numpy arrays
+        for one column, built from the per-file encode cache — the same
+        assembly `super_tiles` performs for device upload, without the
+        upload.  Lets `ensure_limbs` quantize a column whose f64 plane was
+        never sent to HBM (limb-only columns at TSBS 3-day scale: both
+        representations together exceed device memory).  Returns None when
+        a needed host tile was evicted."""
+        with self._lock:
+            tiles = [
+                self._host.get((entry.region_id, fid)) for fid in entry.file_ids
+            ]
+        if any(t is None for t in tiles):
+            return None
+        if not all(name in t.cols or name in t.absent for t in tiles):
+            return None
+        dtype = next(
+            (t.cols[name].dtype for t in tiles if name in t.cols), np.float64
+        )
+        cat = np.concatenate([
+            t.cols[name] if name in t.cols else np.zeros(t.num_rows, dtype)
+            for t in tiles
+        ])
+        buf = np.zeros(entry.pad, dtype=cat.dtype)
+        buf[: entry.num_rows] = cat[entry.order]
+        return [buf[a:b] for a, b in _chunk_bounds(entry.pad)]
 
     def gather_host_values(
         self, entry: _SuperTiles, col: str, positions: np.ndarray
@@ -713,6 +878,8 @@ class TileCacheManager:
         entry is still cached) and the argsort never runs twice."""
         with self._lock:
             if entry.perm is None:
+                # argsort over the full column + its int64 workspace
+                self._reserve_locked(entry.pad * 24, {entry.region_id})
                 ts = jnp.concatenate(entry.cols[ts_name])
                 valid = jnp.concatenate(entry.valid)
                 key = jnp.where(valid, ts, jnp.iinfo(jnp.int64).max)
@@ -1025,6 +1192,7 @@ class TileExecutor:
                 return self._locked_execute(
                     lowering, schema, scan, ctx, time_bounds, pinned_regions,
                     ts_name, tag_names, tag_cols, all_tag_cols, value_cols, use_ts,
+                    layout_probe,
                 )
             finally:
                 for region in pinned_regions:
@@ -1033,6 +1201,7 @@ class TileExecutor:
     def _locked_execute(
         self, lowering, schema, scan, ctx, time_bounds, pinned_regions,
         ts_name, tag_names, tag_cols, all_tag_cols, value_cols, use_ts,
+        layout_probe,
     ):
         # Eligibility is judged on the sources that INTERSECT the query's
         # time window: the super-tile spans every file, but rows outside
@@ -1049,7 +1218,7 @@ class TileExecutor:
             return hi >= wlo and lo < whi
 
         region_sources = []  # (region, [FileMeta], [mem pa.Table])
-        ranges: list[tuple[int, int]] = []
+        dedup_regions: set[int] = set()  # regions whose files overlap
         for region in ctx.regions:
             region.pin_scan()
             pinned_regions.append(region)
@@ -1059,13 +1228,15 @@ class TileExecutor:
             self.cache.invalidate_region_if_changed(
                 region.region_id, {m.file_id for m in all_files}, version
             )
+            file_ranges: list[tuple[int, int]] = []
+            mem_ranges: list[tuple[int, int]] = []
             mem_tables = []
             for meta in all_files:
                 if not in_window(*meta.time_range):
                     continue
                 if meta.num_deletes != 0:
                     return None  # tombstones (or unknown) -> dedup needed
-                ranges.append(meta.time_range)
+                file_ranges.append(meta.time_range)
             for mem in mems:
                 mem_table = mem.scan(None, dedup=not ctx.append_mode)
                 if mem_table.num_rows == 0:
@@ -1092,13 +1263,33 @@ class TileExecutor:
                     mlo, mhi = pc.min(ts_i).as_py(), pc.max(ts_i).as_py()
                     if not in_window(mlo, mhi):
                         continue  # fully out of window: skip the encode
-                    ranges.append((mlo, mhi))
+                    mem_ranges.append((mlo, mhi))
                 else:
-                    ranges.append((0, 0))
+                    mem_ranges.append((0, 0))
                 mem_tables.append(mem_table)
+            if not ctx.append_mode:
+                # A memtable version of a row always BEATS file versions
+                # and other memtables hold later writes still — those
+                # cross-source merges stay on the authoritative scan path,
+                # so any memtable time-overlap bails.  FILE-only overlap
+                # within a region is handled on-device: the keep plane
+                # (ensure_dedup_keep) makes dedup a mask, so out-of-order
+                # and overwrite ingest keeps the TPU path (the round-3
+                # gate silently fell back to the CPU scan here).
+                # Cross-REGION overlap needs nothing: the partition rule
+                # puts each pk in exactly one region.
+                if mem_ranges and not _disjoint(mem_ranges + file_ranges):
+                    if not _disjoint(mem_ranges):
+                        return None
+                    for mr in mem_ranges:
+                        if any(
+                            fr[1] >= mr[0] and fr[0] <= mr[1]
+                            for fr in file_ranges
+                        ):
+                            return None
+                if not _disjoint(file_ranges):
+                    dedup_regions.add(region.region_id)
             region_sources.append((region, all_files, mem_tables))
-        if not ctx.append_mode and not _disjoint(ranges):
-            return None
         if not any(fs or ms for _r, fs, ms in region_sources):
             return None  # empty table: let the normal path shape output
 
@@ -1110,6 +1301,36 @@ class TileExecutor:
                 ctx.dictionary.update_table(mt, all_tag_cols)
         pinned_ids = {r.region_id for r, _f, _m in region_sources}
         pk = [c.name for c in schema.tag_columns()]
+        # Limb-only columns skip the f64 device upload entirely: their
+        # aggregation reads quantized limb planes (same 8 B/row), so
+        # uploading both representations would double value-column HBM —
+        # at TSBS 3-day scale that alone exceeds device memory.  A column
+        # stays on the f64 plane when any query shape still needs raw
+        # values: min/max/last, value filters, nullable columns (the null
+        # plane rides the f64 upload), or time-major plans (tm copies
+        # gather from the f64 plane).
+        per_col_funcs: dict[str, set] = {}
+        for f, c in lowering.agg_specs:
+            if c is not None:
+                per_col_funcs.setdefault(c, set()).add(_FUNC_TO_KERNEL[f])
+        filter_col_names = {f[0] for f in scan.filters}
+        time_major_probe = (
+            lowering.bucket is not None
+            and not lowering.group_tags
+            and layout_probe is None  # same probe _try_execute computed
+        )
+        limb_skip_upload: set[str] = set()
+        if self.config_acc_dtype() == "limb" and not time_major_probe:
+            for c, funcs in per_col_funcs.items():
+                if (
+                    funcs & {"sum", "avg"}
+                    and not funcs & {"min", "max", "last"}
+                    and c not in filter_col_names
+                    and schema.has_column(c)
+                    and not schema.column(c).nullable
+                ):
+                    limb_skip_upload.add(c)
+        device_value_cols = [c for c in value_cols if c not in limb_skip_upload]
         super_entries: list[_SuperTiles] = []
         slots: list = []
         for region, metas, mem_tables in region_sources:
@@ -1118,10 +1339,18 @@ class TileExecutor:
                 # query doesn't touch ts: the entry is shared across
                 # queries, and one built by a ts-free query must still
                 # carry the (pk, ts) order + sorted ts the host fast path
-                # and blocked-kernel layout of later queries rely on
+                # and blocked-kernel layout of later queries rely on.
+                # The f64-upload skip only pays off (and the limb
+                # geometry only holds) for regions big enough that every
+                # chunk meets the limb fast-path floor.
+                big = padded_size(
+                    max(sum(m.num_rows for m in metas), 1)
+                ) >= _LIMB_MIN_ROWS
                 entry, excluded = self.cache.super_tiles(
                     region, ctx.dictionary, metas, all_tag_cols,
-                    ts_name or use_ts, value_cols, pinned_ids, pk,
+                    ts_name or use_ts,
+                    device_value_cols if big else value_cols,
+                    pinned_ids, pk,
                 )
                 # a file that cannot join the super-tile only blocks
                 # queries whose window its rows could affect
@@ -1163,7 +1392,7 @@ class TileExecutor:
         host_table = self._host_execute(
             plan, dyn_host, super_entries,
             [s for s in slots if not isinstance(s, _SuperTiles)],
-            schema, ctx, use_ts, pk, value_cols, all_tag_cols,
+            schema, ctx, use_ts, pk, value_cols, all_tag_cols, dedup_regions,
         )
         if host_table is not None:
             metrics.TILE_LOWERED_TOTAL.inc()
@@ -1175,13 +1404,16 @@ class TileExecutor:
         for s in slots:
             if isinstance(s, _SuperTiles):
                 need_cols = self._plan_cols(plan)
+                dedup = s.region_id in dedup_regions
+                if dedup and not self.cache.ensure_dedup_keep(s):
+                    return None  # host planes evicted: scan path owns it
                 if plan.time_major:
                     cols, valid, nulls = self.cache.ensure_time_major(
-                        s, use_ts, need_cols
+                        s, use_ts, need_cols, dedup=dedup
                     )
                 else:
                     cols = {k: v for k, v in s.cols.items() if k in need_cols}
-                    valid = s.valid
+                    valid = s.valid_dedup if dedup else s.valid
                     nulls = {k: v for k, v in s.nulls.items() if k in need_cols}
                 limbs = (
                     self.cache.ensure_limbs(
@@ -1190,6 +1422,15 @@ class TileExecutor:
                     if limb_need
                     else {}
                 )
+                # every limb column needs SOME device representation —
+                # cached limb planes or the f64 plane; a column with
+                # neither (f64 upload skipped + host tile evicted or
+                # geometry too small) cannot aggregate: authoritative
+                # scan path takes over
+                if any(
+                    c not in limbs and c not in s.cols for c in limb_need
+                ):
+                    return None
                 # one jit source per chunk: bounded per-dispatch temporaries
                 # (see _SuperTiles.cols), merged on device like any source
                 for i in range(len(valid)):
@@ -1247,11 +1488,24 @@ class TileExecutor:
             program, int_layout, acc32_layout, acc64_layout, int_dtype = (
                 _tile_program(attempt_plan, nullable_cols)
             )
-            packed = program(tuple(device_sources), dyn)
-            table = self._finalize(
-                packed, int_layout, acc32_layout, acc64_layout, int_dtype,
-                attempt_plan, lowering, schema, ctx, dyn_host,
-            )
+            try:
+                packed = program(tuple(device_sources), dyn)
+                table = self._finalize(
+                    packed, int_layout, acc32_layout, acc64_layout, int_dtype,
+                    attempt_plan, lowering, schema, ctx, dyn_host,
+                )
+            except Exception as e:  # noqa: BLE001 — only OOM is retryable
+                if "RESOURCE_EXHAUSTED" not in str(e):
+                    raise
+                # device OOM: release every re-derivable plane and retry
+                # once with maximal free HBM; a second failure falls back
+                # to the authoritative scan path
+                self.cache.emergency_release(pinned_ids)
+                packed = program(tuple(device_sources), dyn)
+                table = self._finalize(
+                    packed, int_layout, acc32_layout, acc64_layout, int_dtype,
+                    attempt_plan, lowering, schema, ctx, dyn_host,
+                )
             if table is not None:
                 return table
         return None  # unreachable: the f64 pass never fails the verdict
@@ -1494,6 +1748,7 @@ class TileExecutor:
     def _host_execute(
         self, plan, dyn_host, super_entries, mem_slots,
         schema, ctx, use_ts, pk, value_cols, all_tag_cols,
+        dedup_regions=frozenset(),
     ):
         """Selective pk-equality fast path: returns the result table, or
         None when the query shape/size doesn't qualify."""
@@ -1531,6 +1786,24 @@ class TileExecutor:
         origin = dyn_host["bucket_origin"]
         interval = dyn_host["bucket_interval"]
 
+        # explicit ts bounds from the pushed-down window: rows are
+        # (pk, ts)-sorted, so each pk run narrows by two more binary
+        # searches — without this the slice scales with the table's
+        # retention (72 h of history made a 1 h-window query 4x slower)
+        ts_lo = ts_hi = None
+        if use_ts:
+            for (name, op, _a), val in zip(plan.filters, dyn_host["filter_values"]):
+                if name != use_ts:
+                    continue
+                if op == ">=":
+                    ts_lo = val if ts_lo is None else max(ts_lo, val)
+                elif op == ">":
+                    ts_lo = val + 1 if ts_lo is None else max(ts_lo, val + 1)
+                elif op == "<":
+                    ts_hi = val if ts_hi is None else min(ts_hi, val)
+                elif op == "<=":
+                    ts_hi = val + 1 if ts_hi is None else min(ts_hi, val + 1)
+
         # row ranges per (entry, code) + total-size guard
         ranges: list[tuple[object, int, int]] = []
         total = 0
@@ -1540,6 +1813,7 @@ class TileExecutor:
             if use_ts and use_ts not in entry.sorted_host:
                 return None  # entry predates ts-inclusive sorting
             arr = entry.sorted_host[pk0]
+            ts_arr = entry.sorted_host[use_ts] if use_ts else None
             # one vectorized dtype-matched search for all codes: a python
             # int scalar makes numpy value-cast the whole 4 M-row array
             # per call (measured ~1.2 ms each)
@@ -1547,6 +1821,23 @@ class TileExecutor:
             lefts = np.searchsorted(arr, codes_sorted, side="left")
             rights = np.searchsorted(arr, codes_sorted, side="right")
             for a, b in zip(lefts.tolist(), rights.tolist()):
+                if a >= b:
+                    continue
+                # ts is only sorted WITHIN a pk run when pk == (pk0,):
+                # more pk columns interleave their own runs
+                if (
+                    ts_arr is not None
+                    and len(pk) == 1
+                    and (ts_lo is not None or ts_hi is not None)
+                ):
+                    run = ts_arr[a:b]
+                    if ts_lo is not None:
+                        a += int(np.searchsorted(run, ts_lo, side="left"))
+                    if ts_hi is not None:
+                        b = (
+                            b - len(run)
+                            + int(np.searchsorted(run, ts_hi, side="left"))
+                        )
                 if a < b:
                     ranges.append((entry, a, b))
                     total += b - a
@@ -1641,7 +1932,14 @@ class TileExecutor:
             ts_arr = (
                 entry.sorted_host[use_ts][a:b] if use_ts else np.zeros(b - a, np.int64)
             )
-            if not accumulate(get_col, ts_arr, np.ones(b - a, bool), b - a):
+            base = np.ones(b - a, bool)
+            if entry.region_id in dedup_regions:
+                # last-write-wins: stale versions are masked, same plane
+                # the device path ANDs in (ensure_dedup_keep)
+                if not self.cache.ensure_dedup_keep(entry):
+                    return None
+                base &= entry.keep_host[a:b]
+            if not accumulate(get_col, ts_arr, base, b - a):
                 return None
 
         for _region, mem_table in mem_slots:

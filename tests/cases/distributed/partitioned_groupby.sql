@@ -1,0 +1,13 @@
+-- Hash-partitioned table: rows split over regions on different
+-- datanodes; aggregation merges per-region partial states.
+CREATE TABLE dpart (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 4;
+
+INSERT INTO dpart VALUES ('h0', 1000, 1.0), ('h1', 1000, 2.0), ('h2', 1000, 3.0), ('h3', 1000, 4.0), ('h4', 1000, 5.0), ('h5', 1000, 6.0), ('h0', 2000, 7.0), ('h1', 2000, 8.0), ('h2', 2000, 9.0), ('h3', 2000, 10.0), ('h4', 2000, 11.0), ('h5', 2000, 12.0);
+
+SELECT count(*) AS n, sum(v) AS s, min(v) AS lo, max(v) AS hi FROM dpart;
+
+SELECT host, avg(v) AS a FROM dpart GROUP BY host ORDER BY host;
+
+SELECT host, v FROM dpart WHERE ts >= 2000 ORDER BY host;
+
+DROP TABLE dpart;

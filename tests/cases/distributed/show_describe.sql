@@ -1,0 +1,10 @@
+-- Catalog surface through the frontend.
+CREATE TABLE dmeta (tag1 STRING, ts TIMESTAMP TIME INDEX, val BIGINT, PRIMARY KEY (tag1));
+
+SHOW TABLES;
+
+DESCRIBE TABLE dmeta;
+
+DROP TABLE dmeta;
+
+SHOW TABLES;

@@ -1,0 +1,101 @@
+"""Real process cluster for distributed tests: 1 metasrv + N datanodes
+spawned as `python -m greptimedb_tpu ...` subprocesses over a shared data
+dir (the reference sqlness bare-mode environment,
+tests/runner/src/env/bare.rs:188-230, minus the frontend — tests attach
+either a Frontend object or a frontend process on top)."""
+
+from __future__ import annotations
+
+import os
+import re
+import select
+import signal
+import subprocess
+import sys
+import time
+
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def proc_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO_ROOT + ":" + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    return env
+
+
+def spawn(argv, env):
+    return subprocess.Popen(
+        [sys.executable, "-m", "greptimedb_tpu", *argv],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def await_line(proc, pattern, what, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        r, _w, _x = select.select([proc.stdout], [], [], 0.5)
+        if r:
+            line = proc.stdout.readline()
+            m = re.search(pattern, line or "")
+            if m:
+                return m
+        assert proc.poll() is None, f"{what} died at startup"
+    raise AssertionError(f"{what} did not report readiness")
+
+
+class ProcCluster:
+    """1 metasrv + N datanode processes over a shared data dir."""
+
+    def __init__(self, root: str, num_datanodes: int = 2):
+        self.home = os.path.join(root, "shared")
+        os.makedirs(self.home, exist_ok=True)
+        env = proc_env()
+        self.procs: list[subprocess.Popen] = []
+        meta = spawn(
+            ["metasrv", "start", "--node-id", "0",
+             "--kv-dir", os.path.join(root, "kv"), "--addr", "127.0.0.1:0"],
+            env,
+        )
+        self.procs.append(meta)
+        m = await_line(meta, r"serving at ([\d.]+:\d+)", "metasrv")
+        self.meta_addr = m.group(1)
+        for nid in range(1, num_datanodes + 1):
+            dn = spawn(
+                ["datanode", "start", "--node-id", str(nid),
+                 "--data-home", self.home, "--addr", "127.0.0.1:0",
+                 "--metasrv", self.meta_addr, "--heartbeat-s", "0.2"],
+                env,
+            )
+            self.procs.append(dn)
+            await_line(dn, r"serving Flight at grpc://[\d.]+:\d+", f"datanode {nid}")
+        self._await_registration(num_datanodes)
+
+    def _await_registration(self, n: int, timeout: float = 30.0):
+        """Wait until every datanode's Flight address is known to the
+        metasrv (placement needs it)."""
+        from greptimedb_tpu.distributed.meta_service import MetaClient
+
+        meta = MetaClient([self.meta_addr])
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                if len(meta.node_addresses()) >= n:
+                    return
+            except Exception:  # noqa: BLE001 — still electing
+                pass
+            time.sleep(0.2)
+        raise AssertionError("datanodes did not register with the metasrv")
+
+    def stop(self):
+        for p in reversed(self.procs):
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in self.procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=15)

@@ -14,6 +14,7 @@ import os
 import sys
 
 CASES_DIR = os.path.join(os.path.dirname(__file__), "cases", "standalone")
+DIST_CASES_DIR = os.path.join(os.path.dirname(__file__), "cases", "distributed")
 
 
 def render_result(result) -> str:
@@ -151,14 +152,76 @@ def run_all(update: bool = False, backends: tuple[str, ...] = ("cpu", "tpu")) ->
     return failures
 
 
+def run_all_distributed(update: bool = False) -> list[str]:
+    """Distributed sqlness tier (reference tests/cases/distributed run
+    against a bare-mode process cluster, tests/runner/src/env/bare.rs):
+    cases in cases/distributed/ execute through a Frontend attached to a
+    REAL 1-metasrv + 2-datanode process cluster.  Goldens are generated
+    from the standalone CPU Database running the SAME case — byte-equality
+    is the frontend/standalone parity bar."""
+    import tempfile
+
+    if not os.path.isdir(DIST_CASES_DIR):
+        return []
+    names = sorted(n for n in os.listdir(DIST_CASES_DIR) if n.endswith(".sql"))
+    if not names:
+        return []
+    failures = []
+    if update:
+        for name in names:
+            case = os.path.join(DIST_CASES_DIR, name)
+            db = _make_db("cpu")
+            try:
+                got = run_case(case, db)
+            finally:
+                db.close()
+            with open(case[:-4] + ".result", "w") as f:
+                f.write(got)
+        return []
+
+    from tests.proc_cluster import ProcCluster
+
+    from greptimedb_tpu.distributed.frontend import Frontend
+
+    root = tempfile.mkdtemp(prefix="sqlness_dist_")
+    cluster = ProcCluster(root, num_datanodes=2)
+    try:
+        fe = Frontend(cluster.home, [cluster.meta_addr])
+        for name in names:
+            case = os.path.join(DIST_CASES_DIR, name)
+            golden = case[:-4] + ".result"
+            if not os.path.exists(golden):
+                failures.append(f"{name}: missing golden {golden}")
+                continue
+            with open(golden) as f:
+                want = f.read()
+            got = run_case(case, fe)
+            if got != want:
+                import difflib
+
+                diff = "\n".join(
+                    difflib.unified_diff(
+                        want.splitlines(), got.splitlines(),
+                        "golden[standalone-cpu]", "actual[distributed]",
+                        lineterm="",
+                    )
+                )
+                failures.append(f"{name} [distributed]:\n{diff}")
+    finally:
+        cluster.stop()
+    return failures
+
+
 if __name__ == "__main__":
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     update = "--update" in sys.argv
     failures = run_all(update=update)
+    failures += run_all_distributed(update=update)
     if update:
         print("goldens regenerated")
     elif failures:

@@ -1,0 +1,147 @@
+"""Deployable distributed frontend: real metasrv + datanode + frontend
+PROCESSES wired over HTTP (meta) and Arrow Flight (data), driven black-box
+through the frontend's HTTP SQL endpoint.
+
+Reference parity: `greptime frontend start` serving SqlQueryHandler over
+remote datanodes (cmd/src/bin/greptime.rs:37-61,
+frontend/src/instance.rs:110), exercised the way the sqlness bare-mode
+runner drives a 1-metasrv + N-datanode + 1-frontend cluster
+(tests/runner/src/env/bare.rs:188-230).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from tests.proc_cluster import ProcCluster, await_line, proc_env, spawn
+
+
+def _sql(http_addr: str, sql: str):
+    req = urllib.request.Request(
+        f"http://{http_addr}/v1/sql",
+        data=sql.encode(),  # raw-SQL body, like `curl --data-binary`
+        headers={"Content-Type": "text/plain"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read().decode())["output"]
+
+
+@pytest.fixture()
+def cluster_env(tmp_path):
+    """1 metasrv + 2 datanodes + 1 frontend as real processes over a
+    shared data dir; yields (frontend http addr, ProcCluster)."""
+    cluster = ProcCluster(str(tmp_path), num_datanodes=2)
+    fe = spawn(
+        ["frontend", "start", "--node-id", "100", "--data-home", cluster.home,
+         "--metasrv", cluster.meta_addr, "--http-addr", "127.0.0.1:0",
+         "--heartbeat-s", "0.2"],
+        proc_env(),
+    )
+    cluster.procs.append(fe)
+    try:
+        m = await_line(fe, r"serving HTTP at ([\d.]+:\d+)", "frontend")
+        yield m.group(1), cluster
+    finally:
+        cluster.stop()
+
+
+@pytest.fixture()
+def cluster_procs(cluster_env):
+    return cluster_env[0]
+
+
+def _rows(outputs):
+    return outputs[0]["records"]["rows"]
+
+
+def test_frontend_serves_sql_over_remote_datanodes(cluster_procs):
+    addr = cluster_procs
+    # DDL: placement fans region-opens to the registered datanodes; a
+    # 4-way hash partition lands regions on BOTH datanodes
+    _sql(addr, "CREATE TABLE cpu (host STRING, ts TIMESTAMP TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (host))"
+               " PARTITION BY HASH (host) PARTITIONS 4")
+    out = _sql(addr, "SHOW TABLES")
+    assert ["cpu"] in _rows(out)
+
+    # DML: rows split by the partition rule, written over Flight DoPut
+    values = ",".join(
+        f"('h{i % 8}', {1000 * i}, {float(i)})" for i in range(64)
+    )
+    out = _sql(addr, f"INSERT INTO cpu VALUES {values}")
+    assert out[0]["affectedrows"] == 64
+
+    # query: group-by fans per-region sub-queries out and merges states
+    out = _sql(addr, "SELECT host, count(*) AS c, max(v) AS m FROM cpu"
+                     " GROUP BY host ORDER BY host")
+    rows = _rows(out)
+    assert len(rows) == 8
+    assert all(r[1] == 8 for r in rows)
+    got_max = {r[0]: r[2] for r in rows}
+    for h in range(8):
+        assert got_max[f"h{h}"] == float(56 + h)
+
+    # selective scan with predicate pushdown
+    out = _sql(addr, "SELECT v FROM cpu WHERE host = 'h3' ORDER BY ts")
+    assert [r[0] for r in _rows(out)] == [float(i) for i in range(3, 64, 8)]
+
+    # DESCRIBE via the frontend's catalog view
+    out = _sql(addr, "DESCRIBE TABLE cpu")
+    assert [r[0] for r in _rows(out)] == ["host", "ts", "v"]
+
+    # DROP closes remote regions and hides the table
+    _sql(addr, "DROP TABLE cpu")
+    out = _sql(addr, "SHOW TABLES")
+    assert ["cpu"] not in (_rows(out) or [])
+
+
+def test_frontend_failover_after_datanode_crash(cluster_env):
+    """Black-box failover: kill the datanode process hosting a region
+    mid-serving.  The metasrv's phi detector notices the missed
+    heartbeats, its failover procedure reopens the region on the
+    surviving datanode (shared storage + WAL replay preserves unflushed
+    rows), the route moves, and the frontend — whose cached Flight client
+    now errors — re-resolves and serves the query (reference
+    tests-fuzz/targets/failover black-box flow)."""
+    import time
+
+    addr, cluster = cluster_env
+    _sql(addr, "CREATE TABLE t2 (host STRING, ts TIMESTAMP TIME INDEX,"
+               " v DOUBLE, PRIMARY KEY (host))")
+    _sql(addr, "INSERT INTO t2 VALUES ('a', 1000, 1.0), ('b', 2000, 2.0),"
+               " ('c', 3000, 3.0)")
+    assert _rows(_sql(addr, "SELECT count(*) AS c FROM t2"))[0][0] == 3
+
+    # region placement is round-robin over 2 datanodes and t2 holds the
+    # only region — find its host by asking each datanode's stats via the
+    # metasrv-registered addresses, then kill that PROCESS
+    from greptimedb_tpu.distributed.flight import FlightDatanodeClient
+    from greptimedb_tpu.distributed.meta_service import MetaClient
+
+    meta = MetaClient([cluster.meta_addr])
+    victim = None
+    for nid, a in meta.node_addresses().items():
+        stats = FlightDatanodeClient(nid, f"grpc://{a}").region_stats()
+        if stats:
+            victim = nid
+            break
+    assert victim is not None
+    # procs[0] is the metasrv; datanode node_id N is procs[N]
+    cluster.procs[victim].kill()
+    cluster.procs[victim].wait(timeout=15)
+
+    deadline = time.time() + 90
+    last = None
+    while time.time() < deadline:
+        try:
+            out = _sql(addr, "SELECT count(*) AS c FROM t2")
+            if _rows(out)[0][0] == 3:
+                break
+        except Exception as e:  # noqa: BLE001 — mid-failover errors expected
+            last = e
+        time.sleep(0.5)
+    else:
+        raise AssertionError(f"failover did not complete: {last}")
+    out = _sql(addr, "SELECT host, v FROM t2 ORDER BY host")
+    assert _rows(out) == [["a", 1.0], ["b", 2.0], ["c", 3.0]]

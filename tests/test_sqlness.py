@@ -1,8 +1,17 @@
 """Golden-file (sqlness-style) case execution as a pytest test."""
 
-from tests.sqlness_runner import run_all
+from tests.sqlness_runner import run_all, run_all_distributed
 
 
 def test_sqlness_cases():
     failures = run_all(update=False)
+    assert not failures, "\n\n".join(failures)
+
+
+def test_sqlness_distributed_cases():
+    """cases/distributed/ through a Frontend over a REAL metasrv +
+    datanode process cluster, compared byte-for-byte against goldens the
+    standalone CPU path generated (reference distributed sqlness tier,
+    tests/runner/src/env/bare.rs)."""
+    failures = run_all_distributed(update=False)
     assert not failures, "\n\n".join(failures)

@@ -227,9 +227,10 @@ def test_packed_readback_large_group_space(db):
             assert s1["c"] == s2["c"]
 
 
-def test_overlapping_sources_fall_back(db):
-    """Same keys written twice across flushes -> dedup required -> the tile
-    path must NOT engage, and results stay correct via the scan path."""
+def test_overlapping_flushes_dedup_on_tile_path(db):
+    """Same keys written twice across flushes -> the tile path ENGAGES
+    with the last-write-wins keep plane (round 3 silently lost the TPU
+    path to any overwrite workload) and matches the scan path."""
     _mk_cpu_table(db)
     _load(db, ticks=50)
     db.sql("ADMIN flush_table('cpu')")
@@ -237,10 +238,45 @@ def test_overlapping_sources_fall_back(db):
     db.sql("ADMIN flush_table('cpu')")
     before = _tile_count()
     t1, t2 = _both(db, Q)
-    assert _tile_count() == before, "tile path engaged despite overlap"
+    assert _tile_count() == before + 1, "tile path did not engage on overlap"
     _assert_equal(t1, t2, ["host", "tb"])
     # last-write-wins: counts match the single-write load
     assert sum(t1["c"].to_pylist()) == 50 * 6
+
+
+def test_overwrite_changes_values_last_write_wins(db):
+    """Overwriting flushes with DIFFERENT values: the keep plane must
+    select the newer file's rows, not just collapse counts."""
+    import numpy as np
+
+    _mk_cpu_table(db)
+    n = 512
+    ts = np.arange(n, dtype=np.int64) * 1000
+    base = {
+        "host": pa.array(["h0"] * n),
+        "region": pa.array(["r0"] * n),
+        "ts": pa.array(ts, pa.timestamp("ms")),
+        "usage_system": pa.array(np.zeros(n)),
+    }
+    db.insert_rows("cpu", pa.table({**base, "usage_user": pa.array(np.full(n, 1.0))}))
+    db.sql("ADMIN flush_table('cpu')")
+    # overwrite the middle half with value 5.0
+    mid = slice(n // 4, 3 * n // 4)
+    db.insert_rows("cpu", pa.table({
+        "host": pa.array(["h0"] * (n // 2)),
+        "region": pa.array(["r0"] * (n // 2)),
+        "ts": pa.array(ts[mid], pa.timestamp("ms")),
+        "usage_user": pa.array(np.full(n // 2, 5.0)),
+        "usage_system": pa.array(np.zeros(n // 2)),
+    }))
+    db.sql("ADMIN flush_table('cpu')")
+    q = ("SELECT host, count(*) AS c, sum(usage_user) AS s, max(usage_user) AS m"
+         " FROM cpu GROUP BY host")
+    t1, t2 = _both(db, q)
+    _assert_equal(t1, t2, ["host"])
+    assert t1["c"].to_pylist() == [n]
+    assert t1["s"].to_pylist() == [float(n // 2) * 1.0 + float(n // 2) * 5.0]
+    assert t1["m"].to_pylist() == [5.0]
 
 
 def test_append_mode_keeps_duplicates_and_tiles(db):
@@ -446,10 +482,11 @@ def test_windowed_query_tiles_despite_out_of_window_overlap(db):
     assert _tile_count() == before + 1, "windowed query should tile"
     _assert_equal(t1, t2, ["host"])
     assert sum(t1["c"].to_pylist()) == 50 * 6
-    # whole-table query still correctly refuses (overlap inside window)
+    # whole-table query now tiles TOO: in-window overlap engages the
+    # last-write-wins keep plane instead of bailing (round 4 dedup kernel)
     before = _tile_count()
     t1, t2 = _both(db, Q)
-    assert _tile_count() == before, "overlapping whole-table query must not tile"
+    assert _tile_count() == before + 1, "overlapping whole-table query should tile"
     _assert_equal(t1, t2, ["host", "tb"])
 
 
