@@ -384,6 +384,42 @@ def main():
                 entry["verify_error"] = repr(e)
                 _emit({"event": "verify_failed", "query": name, "error": repr(e)})
 
+    # ---- second-process cold probe -----------------------------------------
+    # A FRESH process over the same data dir: persisted tile encodes +
+    # the on-disk XLA compile cache should make its first double-groupby
+    # orders cheaper than the first process's consolidation cold.
+    if not budget_hit and _elapsed() < BUDGET_S and os.environ.get(
+        "GRAFT_BENCH_COLD_PROBE", "1"
+    ) != "0":
+        import subprocess
+        import sys
+
+        probe_sql = _q(W12, 1, funcs="avg")
+        code = (
+            "import sys, time\n"
+            "from greptimedb_tpu.database import Database\n"
+            "db = Database(data_home=sys.argv[1])\n"
+            "db.config.query.tpu_min_rows = 300000\n"
+            "t0 = time.perf_counter()\n"
+            "t = db.sql_one(sys.argv[2])\n"
+            "print('COLD2', round((time.perf_counter() - t0) * 1000, 1), t.num_rows)\n"
+        )
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code, home, probe_sql],
+                capture_output=True, text=True, timeout=600,
+                env={**os.environ, "PYTHONUNBUFFERED": "1"},
+            )
+            for line in out.stdout.splitlines():
+                if line.startswith("COLD2"):
+                    _parts = line.split()
+                    detail["cold_ms_second_process"] = float(_parts[1])
+                    _emit({"event": "second_process_cold",
+                           "cold_ms": float(_parts[1]),
+                           "rows_out": int(_parts[2])})
+        except Exception as e:  # noqa: BLE001 — probe must never kill the bench
+            detail["cold_probe_error"] = repr(e)
+
     # ---- summary -----------------------------------------------------------
     ok = {k: v for k, v in results.items() if "vs_baseline" in v}
     if ok:

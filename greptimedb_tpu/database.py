@@ -143,6 +143,12 @@ class Database:
 
         ensure_compilation_cache()
 
+        # Persisted super-tile consolidations live beside the data so a
+        # fresh process mmaps them instead of re-decoding Parquet.
+        if not self.config.query.tile_persist_dir:
+            self.config.query.tile_persist_dir = os.path.join(
+                self.config.storage.data_home, "tile_cache"
+            )
         # Per-table tag dictionaries backing the HBM tile cache (stable
         # codes across files/queries — reference mito-codec pre-encoded keys).
         self.dicts = DictionaryRegistry(

@@ -69,6 +69,9 @@ class Datanode:
     def set_region_writable(self, rid: int, writable: bool):
         self.engine.region(rid).set_writable(writable)
 
+    def alter_region(self, rid: int, schema: Schema):
+        self.engine.region(rid).alter_schema(schema)
+
     def write(self, rid: int, batch: pa.RecordBatch) -> int:
         if not self.alive:
             raise ConnectionError(f"datanode {self.node_id} is down")
@@ -191,12 +194,14 @@ class Cluster:
             RepartitionProcedure,
         )
 
-        from .ddl import DropTableProcedure
+        from .ddl import AlterTableProcedure, CreateTableProcedure, DropTableProcedure
 
         self.procedures = ProcedureManager(self.kv, services={"cluster": self})
         self.procedures.register(RepartitionProcedure)
         self.procedures.register(ReconcileTableProcedure)
         self.procedures.register(ReconcileDatabaseProcedure)
+        self.procedures.register(CreateTableProcedure)
+        self.procedures.register(AlterTableProcedure)
         self.procedures.register(DropTableProcedure)
         # Per-table write locks close the fence-check/write race with the
         # repartition procedure's write fence (see insert()).
@@ -217,34 +222,34 @@ class Cluster:
 
     # ---- DDL (frontend -> metasrv placement -> datanodes) -----------------
     def create_table(self, name: str, schema: Schema, partitions: int = 1, database: str = "public"):
+        """CREATE TABLE as a durable procedure: allocate id + placements,
+        create regions (idempotent), then commit metadata — a crash at any
+        step resumes to a consistent catalog (reference
+        common/meta/src/ddl/create_table.rs via DdlManager)."""
+        from ..utils.errors import TableAlreadyExistsError
+        from .ddl import CreateTableProcedure
+
+        if self.catalog.has_table(name, database):
+            raise TableAlreadyExistsError(f"table {name!r} already exists")
         rule = (
             HashPartitionRule(schema.primary_key(), partitions)
             if partitions > 1
             else SingleRegionRule()
         )
-        def place_regions(m):
-            routes: dict[int, int] = {}
-            try:
-                for rid in m.region_ids:
-                    node = self.metasrv.select_datanode()
-                    self.datanodes[node].open_region(rid, schema)
-                    routes[rid] = node
-            except Exception:
-                # creation failed partway: close the regions already opened
-                # so no orphans outlive the unpublished table (the reference
-                # rolls back via the DDL procedure's on_failure path)
-                for rid, node in routes.items():
-                    try:
-                        self.datanodes[node].close_region(rid)
-                    except Exception:
-                        pass
-                raise
-            self.metasrv.set_route(m.table_id, routes)
-
-        return self.catalog.create_table(
-            name, schema, partition_rule=rule, database=database,
-            on_create=place_regions,
+        self.procedures.submit(
+            CreateTableProcedure.create(database, name, schema, rule)
         )
+        return self.catalog.table(name, database)
+
+    def alter_table(self, name: str, new_schema: Schema, database: str = "public"):
+        """Widen a table's schema across every region, durably (reference
+        common/meta/src/ddl/alter_table.rs)."""
+        from .ddl import AlterTableProcedure
+
+        self.procedures.submit(
+            AlterTableProcedure.create(database, name, new_schema)
+        )
+        return self.catalog.table(name, database)
 
     # ---- DML --------------------------------------------------------------
     def insert(self, table: str, batch: pa.RecordBatch, database: str = "public") -> int:

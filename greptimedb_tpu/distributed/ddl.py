@@ -1,20 +1,170 @@
-"""Procedure-driven cluster DDL: resumable DROP TABLE.
+"""Procedure-driven cluster DDL: resumable CREATE / ALTER / DROP TABLE.
 
 Role-equivalent of the reference's DDL procedures
-(reference common/meta/src/ddl/drop_table.rs + drop_table/: a durable
-state machine that tombstones metadata, closes/destroys regions on every
-datanode, then commits the metadata removal — resumable at each step after
-a metasrv crash, with the tombstone preventing half-dropped tables from
-serving reads).
+(reference common/meta/src/ddl/{create_table,alter_table,drop_table}.rs
++ ddl_manager.rs:90): every multi-node DDL is a durable state machine
+dumped to the KV store after each step, key-range locked, and resumable
+after a crash or leader change —
 
-Create remains callback-atomic in the catalog (create_table's on_create);
-drop is where crash-resumability earns its keep: region teardown spans
-multiple datanodes.
+  CREATE: allocate (burn a table id + pick placements) -> create_regions
+  (idempotent open-or-create fan-out) -> commit_metadata (routes + catalog
+  publish; the table becomes visible only in the final step, so a crash
+  mid-create leaves burnable ids and reopenable regions, never a
+  half-table).
+  ALTER: prepare (record the widened schema) -> alter_regions (fan-out,
+  flush-then-swap per region) -> update_metadata.
+  DROP: tombstone -> close_regions -> remove_metadata.
 """
 
 from __future__ import annotations
 
+from ..datatypes.schema import Schema
+from ..models.catalog import region_id
+from ..models.partition import PartitionRule
 from .procedure import DONE, EXECUTING, Procedure
+
+
+class CreateTableProcedure(Procedure):
+    """Steps: allocate -> create_regions -> commit_metadata.
+
+    State: {database, table, schema(json), rule(dict), options, step,
+    table_id, routes {rid: node}}."""
+
+    type_name = "create_table"
+
+    @classmethod
+    def create(
+        cls, database: str, table: str, schema: Schema, rule, options=None
+    ) -> "CreateTableProcedure":
+        return cls(state={
+            "database": database,
+            "table": table,
+            "schema": schema.to_json(),
+            "rule": rule.to_dict(),
+            "options": options or {},
+        })
+
+    def lock_keys(self):
+        return [f"table/{self.state['database']}.{self.state['table']}"]
+
+    def execute(self, ctx):
+        cluster = ctx.services["cluster"]
+        step = self.state.get("step", "allocate")
+        if step == "allocate":
+            tid = cluster.catalog.allocate_table_id()
+            rule = PartitionRule.from_dict(self.state["rule"])
+            routes: dict[str, int] = {}
+            for i in range(rule.num_partitions()):
+                node = cluster.metasrv.select_datanode()
+                if node is None:
+                    raise RuntimeError("no live datanode to place region on")
+                routes[str(region_id(tid, i))] = node
+            self.state["table_id"] = tid
+            self.state["routes"] = routes
+            self.state["step"] = "create_regions"
+            return EXECUTING
+        if step == "create_regions":
+            schema = Schema.from_json(self.state["schema"])
+            for rid, node in self.state["routes"].items():
+                # open-or-create: a resumed procedure re-opens regions a
+                # crashed attempt already created
+                cluster.datanodes[node].open_region(int(rid), schema)
+            self.state["step"] = "commit_metadata"
+            return EXECUTING
+        if step == "commit_metadata":
+            cluster.metasrv.set_route(
+                self.state["table_id"],
+                {int(rid): node for rid, node in self.state["routes"].items()},
+            )
+            meta = cluster.catalog.create_table(
+                self.state["table"],
+                Schema.from_json(self.state["schema"]),
+                partition_rule=PartitionRule.from_dict(self.state["rule"]),
+                database=self.state["database"],
+                options=self.state["options"],
+                table_id=self.state["table_id"],
+                if_not_exists=True,  # resume-safe republish
+            )
+            if meta.table_id != self.state["table_id"]:
+                # a concurrent create won the name: fail so rollback
+                # closes OUR regions and clears OUR route — silently
+                # returning the winner would leak them forever
+                raise RuntimeError(
+                    f"table {self.state['table']!r} was created concurrently"
+                )
+            self.state["step"] = "done"
+            return DONE
+        return DONE
+
+    def rollback(self, ctx):
+        """Poisoned mid-create: close the regions that were opened and
+        clear the route so no orphans outlive the never-published table
+        (reference on_create_failure rollback)."""
+        cluster = ctx.services["cluster"]
+        if self.state.get("table_id") is not None:
+            try:
+                cluster.metasrv.set_route(self.state["table_id"], {})
+            except Exception:  # noqa: BLE001 — route may not exist yet
+                pass
+        for rid, node in (self.state.get("routes") or {}).items():
+            dn = cluster.datanodes.get(node)
+            if dn is None or not dn.alive:
+                continue
+            try:
+                dn.close_region(int(rid))
+            except Exception:  # noqa: BLE001 — never opened: nothing to do
+                pass
+
+
+class AlterTableProcedure(Procedure):
+    """Steps: prepare -> alter_regions -> update_metadata.
+
+    State: {database, table, new_schema(json), step, table_id,
+    routes {rid: node}}.  Regions flush-then-swap their schema
+    (Region.alter_schema), so a crash between regions leaves some regions
+    widened — writes conform batches onto the region's CURRENT schema
+    either way, and resume finishes the rest."""
+
+    type_name = "alter_table"
+
+    @classmethod
+    def create(
+        cls, database: str, table: str, new_schema: Schema
+    ) -> "AlterTableProcedure":
+        return cls(state={
+            "database": database,
+            "table": table,
+            "new_schema": new_schema.to_json(),
+        })
+
+    def lock_keys(self):
+        return [f"table/{self.state['database']}.{self.state['table']}"]
+
+    def execute(self, ctx):
+        cluster = ctx.services["cluster"]
+        step = self.state.get("step", "prepare")
+        if step == "prepare":
+            meta = cluster.catalog.table(self.state["table"], self.state["database"])
+            self.state["table_id"] = meta.table_id
+            self.state["routes"] = {
+                str(rid): node
+                for rid, node in cluster.metasrv.get_route(meta.table_id).items()
+            }
+            self.state["step"] = "alter_regions"
+            return EXECUTING
+        if step == "alter_regions":
+            schema = Schema.from_json(self.state["new_schema"])
+            for rid, node in self.state["routes"].items():
+                cluster.datanodes[node].alter_region(int(rid), schema)
+            self.state["step"] = "update_metadata"
+            return EXECUTING
+        if step == "update_metadata":
+            meta = cluster.catalog.table(self.state["table"], self.state["database"])
+            meta.schema = Schema.from_json(self.state["new_schema"])
+            cluster.catalog.update_table(meta)
+            self.state["step"] = "done"
+            return DONE
+        return DONE
 
 
 class DropTableProcedure(Procedure):

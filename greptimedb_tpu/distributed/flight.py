@@ -155,6 +155,11 @@ class DatanodeFlightServer(fl.FlightServerBase):
         elif kind == "set_region_writable":
             self.engine.region(body["region_id"]).set_writable(body["writable"])
             out = {"ok": True}
+        elif kind == "alter_region":
+            self.engine.region(body["region_id"]).alter_schema(
+                Schema.from_json(body["schema"])
+            )
+            out = {"ok": True}
         elif kind == "region_stats":
             out = {"stats": [s.__dict__ for s in self.engine.region_statistics()]}
         elif kind == "file_refs":
@@ -228,6 +233,9 @@ class FlightDatanodeClient:
 
     def set_region_writable(self, rid: int, writable: bool):
         self._action("set_region_writable", {"region_id": rid, "writable": writable})
+
+    def alter_region(self, rid: int, schema: Schema):
+        self._action("alter_region", {"region_id": rid, "schema": schema.to_json()})
 
     def region_stats(self) -> list:
         return self._action("region_stats", {})["stats"]
@@ -327,6 +335,9 @@ class FlightDatanode:
 
     def set_region_writable(self, rid: int, writable: bool):
         self.client.set_region_writable(rid, writable)
+
+    def alter_region(self, rid: int, schema):
+        self.client.alter_region(rid, schema)
 
     def write(self, rid: int, batch: pa.RecordBatch) -> int:
         return self.client.write(rid, batch)

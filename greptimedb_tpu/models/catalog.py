@@ -170,6 +170,18 @@ class Catalog:
         return guard()
 
     # ---- tables -----------------------------------------------------------
+    def allocate_table_id(self) -> int:
+        """Burn a table id WITHOUT publishing a table: the durable
+        CreateTable procedure allocates first, creates regions, then
+        commits metadata (reference TableMetadataAllocator,
+        common/meta/src/ddl/table_meta.rs) — a crash between steps wastes
+        the id but can never collide."""
+        with self._ddl_guard():
+            tid = self._next_table_id
+            self._next_table_id += 1
+            self._persist()
+            return tid
+
     def create_table(
         self,
         name: str,
@@ -179,12 +191,14 @@ class Catalog:
         if_not_exists: bool = False,
         options: dict | None = None,
         on_create=None,
+        table_id: int | None = None,
     ) -> TableMeta:
         """Create a table. `on_create(meta)` runs under the catalog lock
         before the table becomes visible, so callers can create storage
         regions atomically with the metadata publish (the reference commits
         region creation and KV metadata in one DDL procedure step,
-        common/meta/src/ddl/create_table.rs)."""
+        common/meta/src/ddl/create_table.rs).  `table_id` commits a
+        previously `allocate_table_id`-reserved id (procedure path)."""
         with self._ddl_guard():
             db = self._db(database)
             if name in db:
@@ -192,14 +206,17 @@ class Catalog:
                     return db[name]
                 raise TableAlreadyExistsError(f"table {name!r} already exists")
             meta = TableMeta(
-                table_id=self._next_table_id,
+                table_id=table_id if table_id is not None else self._next_table_id,
                 name=name,
                 database=database,
                 schema=schema,
                 partition_rule=partition_rule or SingleRegionRule(),
                 options=options or {},
             )
-            self._next_table_id += 1
+            if table_id is None:
+                self._next_table_id += 1
+            else:
+                self._next_table_id = max(self._next_table_id, table_id + 1)
             if on_create is not None:
                 on_create(meta)
             db[name] = meta

@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -98,12 +99,12 @@ from .executor import (
 TILE_CHUNK_ROWS = 1 << 24
 
 
-def _chunk_bounds(pad: int) -> list[tuple[int, int]]:
-    if pad <= TILE_CHUNK_ROWS:
+def _chunk_bounds(pad: int, chunk_rows: int = TILE_CHUNK_ROWS) -> list[tuple[int, int]]:
+    if pad <= chunk_rows:
         return [(0, pad)]
     return [
-        (o, min(o + TILE_CHUNK_ROWS, pad))
-        for o in range(0, pad, TILE_CHUNK_ROWS)
+        (o, min(o + chunk_rows, pad))
+        for o in range(0, pad, chunk_rows)
     ]
 
 
@@ -195,17 +196,51 @@ class _SuperTiles:
     keep_host: np.ndarray | None = None
     valid_dedup: list | None = None
     tm_valid_dedup: list | None = None
+    # consolidated (sorted, padded) host arrays mmap'd from the persisted
+    # tile store: device upload slices straight out of these, skipping
+    # Parquet decode + tag encode + lexsort on a fresh process
+    persisted_cols: dict[str, np.ndarray] = field(default_factory=dict)
+    persisted_nulls: dict[str, np.ndarray] = field(default_factory=dict)
+    # dictionary epochs the persisted tag codes were written at: survives
+    # release_unneeded (which pops entry.epochs), so a RE-upload from the
+    # mmap stamps the true stored epoch and repair still gathers forward
+    persisted_epochs: dict[str, int] = field(default_factory=dict)
     nbytes: int = 0
     host_nbytes: int = 0  # sorted_host/order/offsets bytes (host budget)
 
 
 class TileCacheManager:
     """Device-resident per-region super-tiles + host-side per-file encode
-    cache, both LRU-bounded."""
+    cache, both LRU-bounded.
 
-    def __init__(self, budget_bytes: int = 8 << 30, host_budget_bytes: int | None = None):
+    With more than one local device, chunks place ROUND-ROBIN across the
+    device list: each chunk's partial AggState is computed where its data
+    lives (jit follows committed inputs) and the [G]-sized states — tiny
+    next to the chunks — merge on device 0, the reference MergeScan's
+    N:1 fan-in (merge_scan.rs:250) with ICI playing the stream transport.
+    `chunk_rows` is configurable so the multichip dryrun can drive this
+    exact path with toy chunks on virtual CPU devices."""
+
+    def __init__(
+        self,
+        budget_bytes: int = 8 << 30,
+        host_budget_bytes: int | None = None,
+        chunk_rows: int = TILE_CHUNK_ROWS,
+        devices: list | None = None,
+        persist_dir: str | None = None,
+    ):
         self.budget = budget_bytes
         self.host_budget = host_budget_bytes or budget_bytes * 2
+        self.chunk_rows = chunk_rows
+        self.devices = devices if devices is not None else list(jax.devices())
+        # On-disk home for consolidated encodes (persisted super-tiles):
+        # a FRESH process mmaps them instead of re-reading Parquet,
+        # re-encoding tags and re-sorting 100M rows — the dominant cold
+        # cost (measured minutes at TSBS 3-day scale; the reference's
+        # cold path has no consolidation step to pay, so ours must not
+        # either).  None disables persistence.
+        self.persist_dir = persist_dir
+        self._persist_pool: set[str] = set()  # filesets being written
         self._lock = threading.RLock()
         self._super: OrderedDict[int, _SuperTiles] = OrderedDict()
         self._host: OrderedDict[tuple[int, str], _FileHostTiles] = OrderedDict()
@@ -282,6 +317,40 @@ class TileCacheManager:
             finally:
                 self.budget = saved
 
+    def release_unneeded(self, entry: _SuperTiles, keep_cols: set[str]):
+        """Drop THIS entry's device planes for columns the current query
+        does not touch (f64/null/limb).  Whole-entry eviction can't help
+        when one region holds everything (TSBS 3-day = one entry whose
+        resident planes alone approach the budget): a time-major build
+        would OOM against column planes only OTHER query families use.
+        In-flight queries on those columns keep their arrays alive via
+        references; the cache just forgets and rebuilds later."""
+        with self._lock:
+            freed = 0
+            for d in (entry.cols, entry.nulls):
+                for name in list(d):
+                    if name not in keep_cols:
+                        freed += sum(int(x.nbytes) for x in d[name])
+                        del d[name]
+                        entry.epochs.pop(name, None)
+            for d in (entry.tm_cols, entry.tm_nulls):
+                for name in list(d):
+                    if name not in keep_cols:
+                        freed += sum(int(x.nbytes) for x in d[name])
+                        del d[name]
+            for key in list(entry.limb_cols):
+                base = key.split(":", 1)[-1]
+                if base not in keep_cols:
+                    freed += sum(
+                        int(l.nbytes) + int(s.nbytes)
+                        for l, s in entry.limb_cols[key]
+                    )
+                    del entry.limb_cols[key]
+            entry.nbytes -= freed
+            if self._super.get(entry.region_id) is entry:
+                self._used -= freed
+            return freed
+
     def emergency_release(self, pinned_regions: set[int]):
         """Device OOM recovery: strip every re-derivable plane (limb +
         time-major copies + perms) and evict unpinned entries down to
@@ -313,6 +382,187 @@ class TileCacheManager:
                 self._evict_locked(pinned_regions)
             finally:
                 self.budget = saved
+
+    # ---- persisted consolidated encodes ------------------------------------
+    def _fileset_dir(self, region_id: int, file_ids: tuple[str, ...]) -> str | None:
+        if not self.persist_dir:
+            return None
+        import hashlib
+
+        h = hashlib.sha1("|".join(file_ids).encode()).hexdigest()[:16]
+        return os.path.join(self.persist_dir, f"region_{region_id}", h)
+
+    def _try_load_persisted(self, entry: _SuperTiles) -> bool:
+        """Attach a persisted consolidation to a fresh entry: order,
+        sorted host planes, file offsets and mmap'd column buffers.
+        Returns True when the store matched this exact file-set."""
+        d = self._fileset_dir(entry.region_id, entry.file_ids)
+        if d is None or not os.path.exists(os.path.join(d, "meta.json")):
+            return False
+        try:
+            import json
+
+            with open(os.path.join(d, "meta.json")) as f:
+                meta = json.load(f)
+            if tuple(meta["file_ids"]) != entry.file_ids:
+                return False
+            entry.order = np.load(os.path.join(d, "order.npy"), mmap_mode="r")
+            entry.file_row_offsets = np.load(os.path.join(d, "offsets.npy"))
+            for c in meta["sorted_host"]:
+                entry.sorted_host[c] = np.load(
+                    os.path.join(d, f"sh_{c}.npy"), mmap_mode="r"
+                )
+            for c, epoch in meta.get("host_epochs", {}).items():
+                entry.host_epochs[c] = epoch
+            for c in meta["cols"]:
+                entry.persisted_cols[c] = np.load(
+                    os.path.join(d, f"col_{c}.npy"), mmap_mode="r"
+                )
+            for c in meta.get("nulls", []):
+                entry.persisted_nulls[c] = np.load(
+                    os.path.join(d, f"nul_{c}.npy"), mmap_mode="r"
+                )
+            for c, epoch in meta.get("epochs", {}).items():
+                entry.epochs[c] = epoch
+                entry.persisted_epochs[c] = epoch
+            hb = entry.order.nbytes + entry.file_row_offsets.nbytes
+            hb += sum(a.nbytes for a in entry.sorted_host.values())
+            entry.host_nbytes += hb
+            with self._lock:
+                self._host_used += hb
+            metrics.TILE_PERSIST_HITS.inc()
+            return True
+        except Exception:  # noqa: BLE001 — a torn store is just a miss
+            return False
+
+    def _persist_async(self, entry: _SuperTiles, host_tiles, tag_cols, dictionary):
+        """Write the consolidation to disk in the background so the NEXT
+        process skips Parquet decode + encode + lexsort.  One writer per
+        fileset; files land under a tmp name and meta.json commits last,
+        so readers never see a torn store."""
+        d = self._fileset_dir(entry.region_id, entry.file_ids)
+        if d is None:
+            return
+        with self._lock:
+            if d in self._persist_pool:
+                return
+            self._persist_pool.add(d)
+            # snapshot UNDER the cache lock: repairs swap tile arrays and
+            # advance epochs under this same lock, so the captured code
+            # arrays and their epoch labels cannot tear apart (codes at
+            # epoch N persisted with label N+1 would skip repair forever)
+            order = entry.order
+            offsets = entry.file_row_offsets
+            sorted_host = dict(entry.sorted_host)
+            host_epochs = dict(entry.host_epochs)
+            num_rows, pad = entry.num_rows, entry.pad
+            cols_src: dict[str, tuple] = {}
+            union: set[str] = set()
+            for ht in host_tiles:
+                union |= set(ht.cols)
+            epochs: dict[str, int] = {}
+            for name in union:
+                if not all(name in ht.cols or name in ht.absent for ht in host_tiles):
+                    continue
+                cols_src[name] = (
+                    [ht.cols.get(name) for ht in host_tiles],
+                    [ht.nulls.get(name) for ht in host_tiles],
+                    [name in ht.absent for ht in host_tiles],
+                    [ht.num_rows for ht in host_tiles],
+                )
+                if name in tag_cols:
+                    # the epoch the captured arrays are ACTUALLY at
+                    epochs[name] = next(
+                        (
+                            ht.epochs[name]
+                            for ht in host_tiles
+                            if name in ht.epochs
+                        ),
+                        dictionary.epoch,
+                    )
+
+        def write():
+            import json
+            import tempfile
+
+            try:
+                os.makedirs(d, exist_ok=True)
+                # prune older filesets of this region (superseded stores)
+                parent = os.path.dirname(d)
+                for sib in os.listdir(parent):
+                    p = os.path.join(parent, sib)
+                    if p != d:
+                        import shutil
+
+                        shutil.rmtree(p, ignore_errors=True)
+
+                def save(name, arr):
+                    tmp = os.path.join(d, f".tmp_{name}")
+                    np.save(tmp, arr)
+                    os.replace(tmp + ".npy", os.path.join(d, f"{name}.npy"))
+
+                save("order", np.asarray(order, dtype=np.int32))
+                save("offsets", np.asarray(offsets))
+                for c, arr in sorted_host.items():
+                    save(f"sh_{c}", np.asarray(arr))
+                col_names, null_names = [], []
+                for name, (parts, nulls, absents, nrows) in cols_src.items():
+                    dtype = next(
+                        (p.dtype for p in parts if p is not None), np.float64
+                    )
+                    cat = np.concatenate([
+                        p if p is not None else np.zeros(n, dtype)
+                        for p, n in zip(parts, nrows)
+                    ])
+                    buf = np.zeros(pad, dtype=cat.dtype)
+                    buf[:num_rows] = cat[order]
+                    save(f"col_{name}", buf)
+                    col_names.append(name)
+                    if any(n is not None for n in nulls) or any(absents):
+                        ncat = np.concatenate([
+                            n if n is not None else np.full(cnt, not absent)
+                            for n, absent, cnt in zip(nulls, absents, nrows)
+                        ])
+                        nbuf = np.zeros(pad, bool)
+                        nbuf[:num_rows] = ncat[order]
+                        save(f"nul_{name}", nbuf)
+                        null_names.append(name)
+                meta = {
+                    "file_ids": list(entry.file_ids),
+                    "num_rows": num_rows,
+                    "pad": pad,
+                    "cols": col_names,
+                    "nulls": null_names,
+                    "sorted_host": sorted(sorted_host),
+                    "host_epochs": host_epochs,
+                    "epochs": epochs,
+                }
+                fd, tmp = tempfile.mkstemp(dir=d)
+                with os.fdopen(fd, "w") as f:
+                    json.dump(meta, f)
+                os.replace(tmp, os.path.join(d, "meta.json"))
+                metrics.TILE_PERSIST_WRITES.inc()
+            except Exception:  # noqa: BLE001 — persistence is best-effort
+                pass
+            finally:
+                with self._lock:
+                    self._persist_pool.discard(d)
+
+        threading.Thread(target=write, name="tile-persist", daemon=True).start()
+
+    def chunk_device(self, i: int):
+        """Device for chunk index i (round-robin over local devices)."""
+        return self.devices[i % len(self.devices)]
+
+    def _up_chunks(self, buf: np.ndarray, bounds) -> list:
+        """Upload a consolidated host buffer chunk-wise, each chunk onto
+        its round-robin device (single-device: plain uploads)."""
+        if len(self.devices) <= 1:
+            return [jnp.asarray(buf[a:b]) for a, b in bounds]
+        return [
+            jax.device_put(buf[a:b], self.chunk_device(i))
+            for i, (a, b) in enumerate(bounds)
+        ]
 
     def _evict_locked(self, pinned_regions: set[int]):
         # limb planes are re-derivable from the resident f64 planes in a
@@ -482,27 +732,38 @@ class TileCacheManager:
                     region_id=rid, file_ids=ids,
                     num_rows=total, pad=padded_size(max(total, 1)),
                 )
+                self._try_load_persisted(entry)
             missing = [c for c in need if c not in entry.cols]
             if not missing and entry.valid is not None:
                 metrics.TILE_CACHE_HITS.inc()
                 return entry, excluded
 
-            # host encodes (cheap when cached); these may GROW the
-            # dictionary, so callers build the plan only after every
-            # region is prepared
-            host_tiles: list[_FileHostTiles] = []
-            for meta in included:
-                ht = self._file_host_tiles(
-                    region, dictionary, meta, host_need, tag_cols + pk_cols, ts_col
-                )
-                if ht is None:
-                    break  # newly-discovered bad file: retry without it
-                host_tiles.append(ht)
-            if len(host_tiles) != len(included):
-                continue
-            with self._lock:
-                for ht in host_tiles:
-                    self._repair_host_locked(ht, dictionary)
+            # a matching persisted consolidation already holds the order +
+            # every needed column: skip Parquet decode/encode/sort — THE
+            # cold-start cost — and upload straight from the mmap
+            use_persisted = entry.order is not None and all(
+                c in entry.persisted_cols for c in missing
+            )
+            host_tiles: list[_FileHostTiles] | None
+            if use_persisted:
+                host_tiles = None
+            else:
+                # host encodes (cheap when cached); these may GROW the
+                # dictionary, so callers build the plan only after every
+                # region is prepared
+                host_tiles = []
+                for meta in included:
+                    ht = self._file_host_tiles(
+                        region, dictionary, meta, host_need, tag_cols + pk_cols, ts_col
+                    )
+                    if ht is None:
+                        break  # newly-discovered bad file: retry without it
+                    host_tiles.append(ht)
+                if len(host_tiles) != len(included):
+                    continue
+                with self._lock:
+                    for ht in host_tiles:
+                        self._repair_host_locked(ht, dictionary)
 
             if entry.order is None:
                 # global (pk, ts) sort of the concatenation — lexsort keys
@@ -540,61 +801,80 @@ class TileCacheManager:
             # upload exceeded the chip; the budget check came too late)
             est = 0
             for name in missing:
-                any_nulls_est = any(
-                    name in ht.nulls or name in ht.absent for ht in host_tiles
-                )
-                src0 = next(
-                    (ht.cols[name] for ht in host_tiles if name in ht.cols), None
-                )
-                item = src0.dtype.itemsize if src0 is not None else 8
+                if host_tiles is None:
+                    item = entry.persisted_cols[name].dtype.itemsize
+                    any_nulls_est = name in entry.persisted_nulls
+                else:
+                    any_nulls_est = any(
+                        name in ht.nulls or name in ht.absent for ht in host_tiles
+                    )
+                    src0 = next(
+                        (ht.cols[name] for ht in host_tiles if name in ht.cols), None
+                    )
+                    item = src0.dtype.itemsize if src0 is not None else 8
                 est += entry.pad * (item + (1 if any_nulls_est else 0))
             with self._lock:
                 self._reserve_locked(est, pinned_regions | {rid})
 
             added = 0
-            bounds = _chunk_bounds(entry.pad)
+            bounds = _chunk_bounds(entry.pad, self.chunk_rows)
             if entry.valid is None:
                 v = np.zeros(entry.pad, bool)
                 v[: entry.num_rows] = True
-                entry.valid = [jnp.asarray(v[a:b]) for a, b in bounds]
+                entry.valid = self._up_chunks(v, bounds)
                 added += v.nbytes
             for name in missing:
-                src = next(
-                    (ht.cols[name] for ht in host_tiles if name in ht.cols), None
-                )
-                dtype = src.dtype if src is not None else np.float64
-                cat = np.concatenate(
-                    [
-                        ht.cols[name]
-                        if name in ht.cols
-                        else np.zeros(ht.num_rows, dtype)
-                        for ht in host_tiles
-                    ]
-                )
-                buf = np.zeros(entry.pad, dtype=cat.dtype)
-                buf[: entry.num_rows] = cat[entry.order]
-                any_nulls = any(
-                    name in ht.nulls or name in ht.absent for ht in host_tiles
-                )
-                nbuf = None
-                if any_nulls:
-                    ncat = np.concatenate(
+                if host_tiles is None:
+                    buf = entry.persisted_cols[name]
+                    nbuf = entry.persisted_nulls.get(name)
+                else:
+                    src = next(
+                        (ht.cols[name] for ht in host_tiles if name in ht.cols), None
+                    )
+                    dtype = src.dtype if src is not None else np.float64
+                    cat = np.concatenate(
                         [
-                            ht.nulls[name]
-                            if name in ht.nulls
-                            else np.full(ht.num_rows, name not in ht.absent)
+                            ht.cols[name]
+                            if name in ht.cols
+                            else np.zeros(ht.num_rows, dtype)
                             for ht in host_tiles
                         ]
                     )
-                    nbuf = np.zeros(entry.pad, bool)
-                    nbuf[: entry.num_rows] = ncat[entry.order]
-                entry.cols[name] = [jnp.asarray(buf[a:b]) for a, b in bounds]
+                    buf = np.zeros(entry.pad, dtype=cat.dtype)
+                    buf[: entry.num_rows] = cat[entry.order]
+                    any_nulls = any(
+                        name in ht.nulls or name in ht.absent for ht in host_tiles
+                    )
+                    nbuf = None
+                    if any_nulls:
+                        ncat = np.concatenate(
+                            [
+                                ht.nulls[name]
+                                if name in ht.nulls
+                                else np.full(ht.num_rows, name not in ht.absent)
+                                for ht in host_tiles
+                            ]
+                        )
+                        nbuf = np.zeros(entry.pad, bool)
+                        nbuf[: entry.num_rows] = ncat[entry.order]
+                entry.cols[name] = self._up_chunks(buf, bounds)
                 added += buf.nbytes
                 if nbuf is not None:
-                    entry.nulls[name] = [jnp.asarray(nbuf[a:b]) for a, b in bounds]
+                    entry.nulls[name] = self._up_chunks(nbuf, bounds)
                     added += nbuf.nbytes
                 if name in tag_cols or name in pk_cols:
-                    entry.epochs[name] = dictionary.epoch
+                    if host_tiles is None:
+                        # persisted codes keep their STORED epoch (repair
+                        # gathers them forward) — persisted_epochs, not
+                        # entry.epochs, is authoritative: release_unneeded
+                        # pops the latter, and restamping a re-upload with
+                        # the current epoch would skip the repair gather
+                        entry.epochs.setdefault(
+                            name,
+                            entry.persisted_epochs.get(name, dictionary.epoch),
+                        )
+                    else:
+                        entry.epochs[name] = dictionary.epoch
             entry.nbytes += added
             with self._lock:
                 old = self._super.pop(rid, None)
@@ -604,6 +884,13 @@ class TileCacheManager:
                 self._super[rid] = entry
                 self._used += added
                 self._evict_locked(pinned_regions | {rid})
+            if host_tiles is not None:
+                # freshly consolidated (or extended): persist in the
+                # background so the NEXT process mmaps instead of re-doing
+                # decode + encode + sort
+                self._persist_async(
+                    entry, host_tiles, set(tag_cols) | set(pk_cols), dictionary
+                )
             return entry, excluded
         return None, list(metas)
 
@@ -652,7 +939,7 @@ class TileCacheManager:
         planes carry the last-write-wins keep mask (ensure_dedup_keep
         must have run)."""
         perm = self.ensure_perm(entry, ts_name)
-        bounds = _chunk_bounds(entry.pad)
+        bounds = _chunk_bounds(entry.pad, self.chunk_rows)
         added = 0
         with self._lock:
             # reserve for the copies about to materialize (each gather
@@ -668,6 +955,11 @@ class TileCacheManager:
             self._reserve_locked(est, {entry.region_id})
 
             def permuted_chunks(chunks):
+                # time-major copies live on device 0: the ts-ascending
+                # gather is a global permutation, which has no chunk-local
+                # form (multi-device stays with the pk-sorted path)
+                if len(self.devices) > 1:
+                    chunks = [jax.device_put(x, self.devices[0]) for x in chunks]
                 full = jnp.concatenate(chunks)[perm]
                 return [full[a:b] for a, b in bounds]
 
@@ -729,8 +1021,16 @@ class TileCacheManager:
             if chunks is None and not time_major:
                 # f64 plane never uploaded (limb-only column): quantize
                 # straight from the host encodes — the f64 chunk uploads
-                # transiently and is freed once its limbs exist
-                chunks = self.host_column_chunks(entry, c)
+                # transiently (each onto its chunk's device) and is freed
+                # once its limbs exist
+                np_chunks = self.host_column_chunks(entry, c)
+                if np_chunks is not None and len(self.devices) > 1:
+                    chunks = [
+                        jax.device_put(x, self.chunk_device(i))
+                        for i, x in enumerate(np_chunks)
+                    ]
+                else:
+                    chunks = np_chunks
             if chunks is None or any(
                 x.shape[0] % BLOCK_ROWS or x.shape[0] < _LIMB_MIN_ROWS
                 for x in chunks
@@ -793,9 +1093,9 @@ class TileCacheManager:
                 for arr in entry.sorted_host.values():
                     same &= arr[:-1] == arr[1:]
                 keep[: n - 1] &= ~same
-            bounds = _chunk_bounds(entry.pad)
+            bounds = _chunk_bounds(entry.pad, self.chunk_rows)
             entry.keep_host = keep[:n]
-            entry.valid_dedup = [jnp.asarray(keep[a:b]) for a, b in bounds]
+            entry.valid_dedup = self._up_chunks(keep, bounds)
             added = entry.pad  # device bools
             entry.nbytes += added
             entry.host_nbytes += entry.keep_host.nbytes
@@ -812,6 +1112,9 @@ class TileCacheManager:
         never sent to HBM (limb-only columns at TSBS 3-day scale: both
         representations together exceed device memory).  Returns None when
         a needed host tile was evicted."""
+        if name in entry.persisted_cols:
+            buf = entry.persisted_cols[name]
+            return [buf[a:b] for a, b in _chunk_bounds(entry.pad, self.chunk_rows)]
         with self._lock:
             tiles = [
                 self._host.get((entry.region_id, fid)) for fid in entry.file_ids
@@ -829,7 +1132,7 @@ class TileCacheManager:
         ])
         buf = np.zeros(entry.pad, dtype=cat.dtype)
         buf[: entry.num_rows] = cat[entry.order]
-        return [buf[a:b] for a, b in _chunk_bounds(entry.pad)]
+        return [buf[a:b] for a, b in _chunk_bounds(entry.pad, self.chunk_rows)]
 
     def gather_host_values(
         self, entry: _SuperTiles, col: str, positions: np.ndarray
@@ -880,8 +1183,13 @@ class TileCacheManager:
             if entry.perm is None:
                 # argsort over the full column + its int64 workspace
                 self._reserve_locked(entry.pad * 24, {entry.region_id})
-                ts = jnp.concatenate(entry.cols[ts_name])
-                valid = jnp.concatenate(entry.valid)
+                ts_chunks = entry.cols[ts_name]
+                valid_chunks = entry.valid
+                if len(self.devices) > 1:
+                    ts_chunks = [jax.device_put(x, self.devices[0]) for x in ts_chunks]
+                    valid_chunks = [jax.device_put(x, self.devices[0]) for x in valid_chunks]
+                ts = jnp.concatenate(ts_chunks)
+                valid = jnp.concatenate(valid_chunks)
                 key = jnp.where(valid, ts, jnp.iinfo(jnp.int64).max)
                 entry.perm = jnp.argsort(key).astype(jnp.int32)
                 entry.nbytes += entry.pad * 4
@@ -1095,10 +1403,23 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
     final_jit = jax.jit(_final)
 
     def run_all(sources, dyn):
+        # per-source partials compute WHERE THE CHUNK LIVES (jit follows
+        # committed inputs; chunks round-robin over local devices); the
+        # [G]-sized states then hop to the first source's device for the
+        # N:1 merge — tiny transfers riding ICI on a real slice, the
+        # reference MergeScan fan-in (merge_scan.rs:250)
         merged = None
+        target = None
         for cols, valid, nulls, perm, limbs in sources:
             states = _partial(cols, valid, nulls, dyn, perm, limbs)
-            merged = states if merged is None else merge_jit(merged, states)
+            leaves = jax.tree_util.tree_leaves(states)
+            dev = next(iter(leaves[0].devices())) if leaves else None
+            if merged is None:
+                merged, target = states, dev
+                continue
+            if dev is not None and dev != target:
+                states = jax.device_put(states, target)
+            merged = merge_jit(merged, states)
         return final_jit(merged)
 
     return (
@@ -1407,6 +1728,11 @@ class TileExecutor:
                 dedup = s.region_id in dedup_regions
                 if dedup and not self.cache.ensure_dedup_keep(s):
                     return None  # host planes evicted: scan path owns it
+                if s.nbytes > self.cache.budget // 2:
+                    # one-entry deployments: make room for THIS query's
+                    # planes by dropping the entry's own unused columns
+                    # (whole-entry eviction can't, the entry is pinned)
+                    self.cache.release_unneeded(s, need_cols)
                 if plan.time_major:
                     cols, valid, nulls = self.cache.ensure_time_major(
                         s, use_ts, need_cols, dedup=dedup
@@ -1924,6 +2250,14 @@ class TileExecutor:
                     return _cache[name]
                 if name in _entry.sorted_host:
                     got = (_entry.sorted_host[name][_a:_b], None)
+                elif name in _entry.persisted_cols:
+                    # persisted consolidations are already in sorted
+                    # order: slice directly, no per-file gather
+                    pres = _entry.persisted_nulls.get(name)
+                    got = (
+                        np.asarray(_entry.persisted_cols[name][_a:_b]),
+                        None if pres is None else np.asarray(pres[_a:_b]),
+                    )
                 else:
                     got = self.cache.gather_host_values(_entry, name, _pos)
                 _cache[name] = got

@@ -67,7 +67,15 @@ class QueryEngine:
         if self.config.tile_cache_enable and tile_context_provider is not None:
             from ..parallel.tile_cache import TileCacheManager, TileExecutor
 
-            self.tile_cache = TileCacheManager(self.config.tile_cache_mb << 20)
+            self.tile_cache = TileCacheManager(
+                self.config.tile_cache_mb << 20,
+                chunk_rows=getattr(self.config, "tile_chunk_rows", 1 << 24),
+                persist_dir=(
+                    getattr(self.config, "tile_persist_dir", "") or None
+                    if getattr(self.config, "tile_persist_enable", True)
+                    else None
+                ),
+            )
             self._tile_executor = TileExecutor(self.tile_cache, self.config)
 
     @property

@@ -444,6 +444,14 @@ class _Handler(BaseHTTPRequestHandler):
 
         body = params.get("__body") or b""
         db_name = self.headers.get("X-Greptime-DB-Name") or params.get("db", "public")
+        if signal == "arrow":
+            # ONLY /v1/otlp/v1/metrics/arrow exists (reference
+            # otel_arrow.rs is metrics-only); traces/arrow etc. must 404
+            if not self.path.split("?")[0].endswith("/metrics/arrow"):
+                return self._send(404, {"error": "unknown OTel-Arrow endpoint"})
+            n = otlp.ingest_metrics_arrow(self.db, body, database=db_name)
+            REGISTRY.counter("greptime_http_otlp_rows_total", "OTLP rows").inc(n)
+            return self._send(200, {"batch_status": "ok", "rows": n})
         if signal == "metrics":
             n = otlp.ingest_metrics(self.db, body, database=db_name)
         elif signal == "traces":

@@ -838,3 +838,80 @@ def encode_logs_request(
     pw.emit_bytes_field(rl, 2, bytes(sl))
     pw.emit_bytes_field(req, 1, bytes(rl))
     return bytes(req)
+
+
+def ingest_metrics_arrow(
+    db,
+    body: bytes,
+    database: str = "public",
+    physical_table: str = DEFAULT_PHYSICAL_TABLE,
+) -> int:
+    """Arrow-encoded OTLP metrics ingest (role-equivalent of the
+    reference's OTel-Arrow service, servers/src/otel_arrow.rs: a stream of
+    BatchArrowRecords whose payloads are Arrow IPC batches of metric
+    points).  Here the transport is Arrow-native end to end: the body is
+    ONE Arrow IPC stream whose batches carry
+
+        metric: string        (required)  metric name
+        ts / time_unix_nano:  timestamp or int64 nanos (required)
+        value: float          (required)
+        <any other string column> = label
+
+    — the columnar form the reference's Consumer decodes OTAP into,
+    minus the protobuf wrapper.  Batches feed the same metric-engine
+    path as protobuf OTLP, so logical tables/widening behave
+    identically."""
+    import pyarrow as pa
+    import pyarrow.ipc as ipc
+
+    from collections import defaultdict as _dd
+
+    try:
+        reader = ipc.open_stream(pa.BufferReader(body))
+        table = reader.read_all()
+    except pa.ArrowInvalid as e:
+        raise InvalidArgumentsError(f"bad OTel-Arrow body: {e}") from e
+    if table.num_rows == 0:
+        return 0
+    names = set(table.column_names)
+    if "metric" not in names or "value" not in names:
+        raise InvalidArgumentsError(
+            "OTel-Arrow batches need 'metric' and 'value' columns"
+        )
+    if "ts" in names:
+        ts_col = table["ts"]
+        if pa.types.is_timestamp(ts_col.type):
+            ts_ms = ts_col.cast(pa.timestamp("ms")).cast(pa.int64()).to_pylist()
+        else:
+            ts_ms = ts_col.cast(pa.int64()).to_pylist()
+    elif "time_unix_nano" in names:
+        ts_ms = [
+            t // 1_000_000 for t in table["time_unix_nano"].cast(pa.int64()).to_pylist()
+        ]
+    else:
+        raise InvalidArgumentsError(
+            "OTel-Arrow batches need a 'ts' or 'time_unix_nano' column"
+        )
+    metric_names = table["metric"].to_pylist()
+    values = table["value"].cast(pa.float64()).to_pylist()
+    label_cols = {
+        c: table[c].to_pylist()
+        for c in table.column_names
+        if c not in ("metric", "value", "ts", "time_unix_nano")
+        and (
+            pa.types.is_string(table[c].type)
+            or pa.types.is_large_string(table[c].type)
+            or pa.types.is_dictionary(table[c].type)
+        )
+    }
+    rows: dict[str, list[tuple[dict, int, float]]] = _dd(list)
+    for i, (name, t, v) in enumerate(zip(metric_names, ts_ms, values)):
+        if name is None or v is None or t is None:
+            continue
+        labels = {
+            normalize_label_name(c): str(vals[i])
+            for c, vals in label_cols.items()
+            if vals[i] is not None
+        }
+        rows[normalize_metric_name(str(name))].append((labels, int(t), float(v)))
+    return db.metric.write_series_rows(rows, physical_table, database)

@@ -83,6 +83,7 @@ class TimeSeriesEngine:
                 window_ms=(self.config.compaction_time_window_secs * 1000) or None,
                 max_active_runs=self.config.compaction_max_active_window_runs,
                 max_inactive_runs=self.config.compaction_max_inactive_window_runs,
+                memory_mb=getattr(self.config, "compaction_memory_mb", 512),
             )
 
     # ---- region lifecycle -------------------------------------------------

@@ -91,12 +91,14 @@ class CompactionScheduler:
         window_ms: int | None = None,
         max_active_runs: int = 4,
         max_inactive_runs: int = 1,
+        memory_mb: int = 512,
     ):
         self.engine = engine
         self.tick_secs = tick_secs
         self.window_ms = window_ms
         self.max_active_runs = max_active_runs
         self.max_inactive_runs = max_inactive_runs
+        self.memory_mb = memory_mb
         self._cv = threading.Condition()
         self._dirty: set[int] = set()
         self._stop = False
@@ -135,6 +137,7 @@ class CompactionScheduler:
                     window_ms=self.window_ms,
                     max_active_runs=self.max_active_runs,
                     max_inactive_runs=self.max_inactive_runs,
+                    memory_mb=self.memory_mb,
                 )
             except Exception:  # noqa: BLE001 — keep the scheduler alive
                 metrics.COMPACTION_FAILED.inc()
@@ -167,6 +170,7 @@ class CompactionScheduler:
                         window_ms=self.window_ms,
                         max_active_runs=self.max_active_runs,
                         max_inactive_runs=self.max_inactive_runs,
+                        memory_mb=self.memory_mb,
                     )
                     if n:
                         metrics.COMPACTION_BACKGROUND.inc(n)

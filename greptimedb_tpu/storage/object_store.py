@@ -201,6 +201,71 @@ class MemoryObjectStore(ObjectStore):
             return len(self._objects[key])
 
 
+class SimulatedRemoteStore(ObjectStore):
+    """Remote-backend stand-in (reference object-store/src/factory.rs
+    builds s3/gcs/oss/azblob here; this build has no network, so a
+    directory plays the bucket).  Behaves like a remote for the layer
+    stack: every operation pays injected latency, a configurable fraction
+    of operations fail transiently with ConnectionError-grade OSErrors
+    (exercising RetryLayer), put_file UPLOADS bytes instead of renaming,
+    and there is no local scratch sibling.  `op_counts` lets tests assert
+    which operations actually crossed the "network" — the whole point is
+    proving the retry/write-cache/LRU layers off-load it."""
+
+    def __init__(self, root: str, latency_ms: float = 0.0, fail_every: int = 0):
+        self._backing = FsObjectStore(root)
+        self.latency_ms = latency_ms
+        self.fail_every = fail_every  # every Nth mutating/read op fails once
+        self._op_seq = 0
+        self.op_counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def _network(self, op: str):
+        with self._lock:
+            self._op_seq += 1
+            self.op_counts[op] = self.op_counts.get(op, 0) + 1
+            fail = self.fail_every and self._op_seq % self.fail_every == 0
+        if self.latency_ms:
+            time.sleep(self.latency_ms / 1000.0)
+        if fail:
+            raise TimeoutError(f"simulated remote timeout during {op}")
+
+    def read(self, key):
+        self._network("read")
+        return self._backing.read(key)
+
+    def write(self, key, data):
+        self._network("write")
+        self._backing.write(key, data)
+
+    def put_file(self, key, local_src):
+        # a REAL upload: bytes move over the simulated network, then the
+        # local file goes away (no rename fast path on remote stores)
+        self._network("put")
+        with open(local_src, "rb") as f:
+            self._backing.write(key, f.read())
+        os.remove(local_src)
+
+    def exists(self, key):
+        self._network("exists")
+        return self._backing.exists(key)
+
+    def delete(self, key):
+        self._network("delete")
+        self._backing.delete(key)
+
+    def list(self, prefix=""):
+        self._network("list")
+        return self._backing.list(prefix)
+
+    def size(self, key):
+        self._network("size")
+        return self._backing.size(key)
+
+    def purge_incomplete(self, prefix=""):
+        self._backing.purge_incomplete(prefix)
+
+
 class PrefixStore(ObjectStore):
     """Chroot view: all keys are joined under a fixed prefix."""
 
@@ -522,8 +587,17 @@ def build_object_store(cfg) -> ObjectStore:
     kind = getattr(cfg, "store_type", "fs")
     if kind == "fs":
         store: ObjectStore = FsObjectStore(cfg.effective_sst_dir())
-    elif kind == "memory":
-        store = MemoryObjectStore()
+    elif kind in ("memory", "mock_remote"):
+        if kind == "memory":
+            store = MemoryObjectStore()
+        else:
+            # simulated remote bucket: the full remote-deployment layer
+            # stack (write-cache staging + retry + LRU) runs against it
+            store = SimulatedRemoteStore(
+                os.path.join(cfg.data_home, "remote_bucket"),
+                latency_ms=getattr(cfg, "store_mock_latency_ms", 0.0),
+                fail_every=getattr(cfg, "store_mock_fail_every", 0),
+            )
         if getattr(cfg, "write_cache_enable", False):
             store = WriteCacheLayer(
                 store,
@@ -533,7 +607,8 @@ def build_object_store(cfg) -> ObjectStore:
     elif kind in _REMOTE_TYPES:
         raise ConfigError(
             f"object store type {kind!r} requires network access and credentials, "
-            "which this build does not ship; use 'fs' (or 'memory' for tests). "
+            "which this build does not ship; use 'fs', 'mock_remote' (a "
+            "simulated remote exercising the same layer stack), or 'memory'. "
             "The config surface matches the reference so deployments can swap "
             "in a remote backend implementation."
         )

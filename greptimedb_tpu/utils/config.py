@@ -53,6 +53,9 @@ class StorageConfig:
     compaction_max_active_window_runs: int = 4
     compaction_max_inactive_window_runs: int = 1
     compaction_time_window_secs: int = 0  # 0 = infer from data
+    # Budget for concurrent compaction working sets (reference
+    # compaction/memory_manager.rs); oversized merges split to fit.
+    compaction_memory_mb: int = 512
     # Background compaction scheduler (reference mito2 CompactionScheduler):
     # flushes nudge it, a periodic tick catches the rest.
     compaction_background_enable: bool = True
@@ -73,6 +76,10 @@ class StorageConfig:
     # fs/s3/gcs/oss/azblob builders).  Remote types are surfaced but gated in
     # this build (no egress); "memory" exists for tests.
     store_type: str = "fs"
+    # mock_remote tuning (SimulatedRemoteStore): per-op latency and
+    # transient-failure injection for exercising the remote layer stack
+    store_mock_latency_ms: float = 0.0
+    store_mock_fail_every: int = 0
     object_cache_mb: int = 0  # >0 enables the LRU whole-object read cache
     store_retry_attempts: int = 3
     write_cache_enable: bool = False  # local staging in front of non-fs stores
@@ -119,6 +126,16 @@ class QueryConfig:
     # as one dispatch over cached device tiles instead of re-scanning Arrow.
     tile_cache_enable: bool = True
     tile_cache_mb: int = 8192
+    # Rows per device chunk (pow2, multiple of the 4096-row kernel block).
+    # Chunks round-robin over local devices; the multichip dryrun shrinks
+    # this to drive the multi-device path with toy data.
+    tile_chunk_rows: int = 1 << 24
+    # Persist consolidated super-tile encodes to <data_home>/tile_cache so
+    # a fresh process mmaps them instead of re-decoding/sorting (the
+    # dominant cold-query cost).  Directory is set by the Database from
+    # data_home; empty disables.
+    tile_persist_enable: bool = True
+    tile_persist_dir: str = ""
     # Accumulation mode for tile-path sum/avg: "limb" routes them through
     # the MXU fixed-point kernel (ops/aggregate.py limb_segment_sums; one
     # batched matmul for every column).  Precision: ~1e-9 relative

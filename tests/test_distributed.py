@@ -337,16 +337,14 @@ def test_flownode_role_process(tmp_path):
     )
     try:
         deadline = time.time() + 60
-        line = ""
-        while time.time() < deadline:
+        m = None
+        while time.time() < deadline and m is None:
             r, _w, _x = select.select([fn.stdout], [], [], 0.5)
             if r:
                 line = fn.stdout.readline()
-                if line:
-                    break
+                m = re.search(r"grpc://([\d.]+:\d+)", line or "")
             assert fn.poll() is None, "flownode died at startup"
-        m = re.search(r"grpc://([\d.]+:\d+)", line)
-        assert m, line
+        assert m, "flownode did not report its Flight address"
         from greptimedb_tpu.distributed.flownode import FlownodeClient
 
         client = FlownodeClient(7, f"grpc://{m.group(1)}")

@@ -261,3 +261,38 @@ def test_http_otlp_endpoints(db):
         assert db.sql_one("SELECT greptime_value FROM up_time")["greptime_value"].to_pylist() == [1.0]
     finally:
         server.stop()
+
+
+def test_otel_arrow_metrics_ingest(db):
+    """Arrow-IPC-encoded OTLP metrics (reference otel_arrow.rs role):
+    batches land through the same metric-engine path as protobuf OTLP."""
+    import io
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.ipc as ipc
+
+    from greptimedb_tpu.servers.otlp import ingest_metrics_arrow
+
+    n = 64
+    table = pa.table({
+        "metric": pa.array(["arrow_cpu_usage"] * n),
+        "ts": pa.array(
+            1_700_000_000_000 + np.arange(n, dtype=np.int64) * 1000,
+            pa.timestamp("ms"),
+        ),
+        "value": pa.array(np.linspace(0, 1, n)),
+        "host": pa.array([f"h{i % 4}" for i in range(n)]),
+        "dc": pa.array(["eu"] * n),
+    })
+    sink = io.BytesIO()
+    with ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    assert ingest_metrics_arrow(db, sink.getvalue()) == n
+
+    out = db.sql_one(
+        "SELECT host, count(*) AS c FROM arrow_cpu_usage GROUP BY host ORDER BY host"
+    )
+    assert out["c"].to_pylist() == [16, 16, 16, 16]
+    meta = db.catalog.table("arrow_cpu_usage")
+    assert meta.schema.has_column("dc")  # labels widened the logical table

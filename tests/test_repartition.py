@@ -188,3 +188,89 @@ def test_reconcile_database_covers_all_tables(cluster):
     cluster.datanodes[node].engine.close_region(rid)
     actions = cluster.reconcile_database("public")
     assert any(a.startswith("b:") for a in actions)
+
+
+def test_create_table_resumes_after_crash(cluster):
+    """CREATE TABLE as a durable procedure (reference
+    common/meta/src/ddl/create_table.rs): crash after regions were created
+    but BEFORE the metadata commit — resume publishes the table with the
+    pre-allocated id; the half-created state never served reads."""
+    from greptimedb_tpu.distributed.ddl import CreateTableProcedure
+    from greptimedb_tpu.distributed.procedure import (
+        EXECUTING,
+        PROC_PREFIX,
+        ProcedureContext,
+        ProcedureRecord,
+    )
+    from greptimedb_tpu.models.partition import HashPartitionRule
+
+    proc = CreateTableProcedure.create(
+        "public", "cpu2", SCHEMA, HashPartitionRule(["host"], 2)
+    )
+    ctx = ProcedureContext("crashcreate", cluster.procedures, {"cluster": cluster})
+    assert proc.execute(ctx) == EXECUTING  # allocate
+    assert proc.execute(ctx) == EXECUTING  # create_regions
+    # crash BEFORE commit_metadata: table invisible, regions exist
+    assert not cluster.catalog.has_table("cpu2", "public")
+    record = ProcedureRecord(
+        "crashcreate", CreateTableProcedure.type_name, EXECUTING, proc.state
+    )
+    cluster.kv.put(PROC_PREFIX + "crashcreate", record.to_json())
+
+    resumed = cluster.procedures.recover()
+    assert "crashcreate" in resumed
+    meta = cluster.catalog.table("cpu2", "public")
+    assert meta.table_id == proc.state["table_id"]
+    assert meta.partition_rule.num_partitions() == 2
+    # routes committed and regions writable end-to-end
+    cluster.insert("cpu2", _batch(40))
+    assert _totals(cluster, "cpu2")[0] == 40
+
+
+def test_alter_table_resumes_after_crash(cluster):
+    """ALTER (widen) as a durable procedure: crash after half the regions
+    swapped schema — resume finishes the rest and commits metadata;
+    writes built against the old schema conform (null-fill) either way."""
+    import pyarrow as pa
+
+    from greptimedb_tpu.datatypes import ColumnSchema, ConcreteDataType, SemanticType
+    from greptimedb_tpu.datatypes.schema import Schema as _Schema
+    from greptimedb_tpu.distributed.ddl import AlterTableProcedure
+    from greptimedb_tpu.distributed.procedure import (
+        EXECUTING,
+        PROC_PREFIX,
+        ProcedureContext,
+        ProcedureRecord,
+    )
+
+    cluster.create_table("cpu3", SCHEMA, partitions=2)
+    cluster.insert("cpu3", _batch(40))
+    widened = _Schema(columns=list(SCHEMA.columns) + [
+        ColumnSchema("extra", ConcreteDataType.FLOAT64, SemanticType.FIELD, nullable=True)
+    ])
+    proc = AlterTableProcedure.create("public", "cpu3", widened)
+    ctx = ProcedureContext("crashalter", cluster.procedures, {"cluster": cluster})
+    assert proc.execute(ctx) == EXECUTING  # prepare
+    assert proc.execute(ctx) == EXECUTING  # alter_regions
+    # crash BEFORE update_metadata: catalog still narrow
+    assert not cluster.catalog.table("cpu3", "public").schema.has_column("extra")
+    record = ProcedureRecord(
+        "crashalter", AlterTableProcedure.type_name, EXECUTING, proc.state
+    )
+    cluster.kv.put(PROC_PREFIX + "crashalter", record.to_json())
+    resumed = cluster.procedures.recover()
+    assert "crashalter" in resumed
+    meta = cluster.catalog.table("cpu3", "public")
+    assert meta.schema.has_column("extra")
+    # writes with the widened schema land; old rows read back with nulls
+    b = pa.RecordBatch.from_arrays(
+        [
+            pa.array(["hx"]),
+            pa.array([999000], pa.timestamp("ms")),
+            pa.array([1.0]),
+            pa.array([2.5]),
+        ],
+        schema=meta.schema.to_arrow(),
+    )
+    cluster.insert("cpu3", b)
+    assert _totals(cluster, "cpu3")[0] == 41

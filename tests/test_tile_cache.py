@@ -108,6 +108,69 @@ def test_memtable_tail_included(db):
     _assert_equal(t1, t2, ["host", "tb"])
 
 
+def test_persisted_tiles_skip_reconsolidation(tmp_path):
+    """Cold-start: a SECOND Database over the same data dir loads the
+    persisted consolidation (order + sorted planes + column buffers)
+    instead of re-reading Parquet — and serves identical results, on the
+    device path AND the selective host fast path."""
+    import time as _time
+
+    import numpy as np
+
+    home = str(tmp_path / "db")
+    db = Database(data_home=home)
+    _mk_cpu_table(db)
+    n = 4096 * 4
+    hosts = np.repeat([f"host_{i}" for i in range(8)], n // 8)
+    ts = np.tile(np.arange(n // 8, dtype=np.int64) * 1000, 8)
+    rng = np.random.default_rng(31)
+    vals = rng.uniform(0, 100, n)
+    db.insert_rows("cpu", pa.table({
+        "host": pa.array(hosts),
+        "region": pa.array(np.repeat("r0", n)),
+        "ts": pa.array(ts, pa.timestamp("ms")),
+        "usage_user": pa.array(vals),
+        "usage_system": pa.array(vals * 2),
+    }))
+    db.sql("ADMIN flush_table('cpu')")
+    q = "SELECT host, avg(usage_user) AS a FROM cpu GROUP BY host ORDER BY host"
+    want = db.sql_one(q).to_pydict()
+    # wait for the background persist writer
+    deadline = _time.time() + 30
+    import os as _os
+
+    pdir = _os.path.join(home, "tile_cache")
+    while _time.time() < deadline:
+        metas = [
+            f
+            for root, _d, files in _os.walk(pdir)
+            for f in files
+            if f == "meta.json"
+        ]
+        if metas:
+            break
+        _time.sleep(0.2)
+    assert metas, "persist writer did not commit"
+    db.close()
+
+    db2 = Database(data_home=home)
+    before_hits = metrics.TILE_PERSIST_HITS.get()
+    got = db2.sql_one(q).to_pydict()
+    assert got == want
+    assert metrics.TILE_PERSIST_HITS.get() == before_hits + 1, (
+        "fresh process did not load the persisted consolidation"
+    )
+    # host fast path over persisted planes (selective pk query)
+    t = db2.sql_one(
+        "SELECT count(*) AS c, max(usage_system) AS m FROM cpu"
+        " WHERE host = 'host_3'"
+    )
+    assert t["c"].to_pylist() == [n // 8]
+    g = vals[np.asarray(hosts) == "host_3"] * 2
+    np.testing.assert_allclose(t["m"].to_pylist()[0], g.max(), rtol=1e-12)
+    db2.close()
+
+
 def test_limb_kernel_with_mixed_source_sizes(db):
     """A flushed chunk large enough for the MXU limb kernel merged with a
     tiny memtable tail: both sources must emit structurally identical
