@@ -245,10 +245,13 @@ def main():
     detail["tpu_min_rows"] = db.config.query.tpu_min_rows
     # 3-day TSBS needs ~10 GB of limb/value planes resident; the 8 GB
     # default budget would thrash between query families on a 16 GB chip
-    tile_mb = int(os.environ.get("GRAFT_TILE_CACHE_MB", 11264))
+    tile_mb = int(os.environ.get("GRAFT_TILE_CACHE_MB", 9216))
     db.config.query.tile_cache_mb = tile_mb
     if db.query_engine.tile_cache is not None:
         db.query_engine.tile_cache.budget = tile_mb << 20
+        if os.environ.get("GRAFT_TILE_PERSIST", "1") == "0":
+            # larger-than-disk runs: skip the on-disk consolidation copy
+            db.query_engine.tile_cache.persist_dir = None
     detail["tile_cache_mb"] = tile_mb
     if os.environ.get("GRAFT_BENCH_NO_FALLBACK"):
         db.config.query.fallback_to_cpu = False

@@ -133,10 +133,12 @@ def cmd_datanode(args):
 
     meta = None
     if getattr(args, "metasrv", None):
+        from .distributed.alive_keeper import RegionAliveKeeper
         from .distributed.meta_service import MetaClient
 
         meta = MetaClient(args.metasrv.split(","))
         flight_addr = server.location.removeprefix("grpc://")
+        keeper = RegionAliveKeeper(args.node_id)
 
         def heartbeat_loop():
             import logging
@@ -145,12 +147,18 @@ def cmd_datanode(args):
             last_err = None
             while not stop.is_set():
                 try:
+                    now_ms = _time.time() * 1000
                     reply = meta.handle_heartbeat(
                         args.node_id,
                         [s.__dict__ for s in engine.region_statistics()],
-                        _time.time() * 1000,
+                        now_ms,
                         addr=flight_addr,
                     )
+                    keeper.renew(
+                        reply.get("lease_regions", []),
+                        reply.get("lease_until_ms", now_ms),
+                    )
+                    keeper.close_staled_regions(engine, now_ms)
                     last_err = None
                 except Exception as e:  # noqa: BLE001 — metasrv may be electing
                     # log each DISTINCT failure once (a misconfiguration
@@ -159,6 +167,14 @@ def cmd_datanode(args):
                     if str(e) != last_err:
                         last_err = str(e)
                         log.warning("heartbeat to metasrv failed: %s", e)
+                    # the lease sweep runs EVEN when the metasrv is
+                    # unreachable — a partitioned node's leases lapse on
+                    # its own clock and its regions must close before the
+                    # failed-over holder's compaction races ours
+                    try:
+                        keeper.close_staled_regions(engine, _time.time() * 1000)
+                    except Exception:  # noqa: BLE001
+                        pass
                     stop.wait(args.heartbeat_s)
                     continue
                 # the metasrv drained its mailbox when it replied: apply

@@ -396,6 +396,12 @@ class Cluster:
                     dn.alive_keeper.renew(
                         reply["lease_regions"], reply["lease_until_ms"]
                     )
+                    # CLOSE lapsed regions, not just fence writes: a
+                    # phi-suspected-but-alive node that kept its region
+                    # open kept COMPACTING it too — two compactors on
+                    # shared storage corrupt the manifest (reference
+                    # close_staled_region, alive_keeper.rs:144)
+                    dn.alive_keeper.close_staled_regions(dn.engine, now)
                 for instr in reply["instructions"]:
                     self._apply_instruction(dn, instr)
 

@@ -201,6 +201,13 @@ class _SuperTiles:
     # Parquet decode + tag encode + lexsort on a fresh process
     persisted_cols: dict[str, np.ndarray] = field(default_factory=dict)
     persisted_nulls: dict[str, np.ndarray] = field(default_factory=dict)
+    # window tiles: compact device tiles holding ONLY the rows inside one
+    # query time window (and surviving dedup), gathered host-side from
+    # the sorted encodes.  A 12 h window over 3 days of retention scans
+    # 6x less data than masking the full super-tile — retention must not
+    # tax windowed queries (the reference prunes SSTs/row groups by time;
+    # this is the tile-resident equivalent).  Key: (wlo, whi, dedup).
+    window_tiles: dict[tuple, dict] = field(default_factory=dict)
     # dictionary epochs the persisted tag codes were written at: survives
     # release_unneeded (which pops entry.epochs), so a RE-upload from the
     # mmap stamps the true stored epoch and repair still gathers forward
@@ -346,6 +353,13 @@ class TileCacheManager:
                         for l, s in entry.limb_cols[key]
                     )
                     del entry.limb_cols[key]
+            for key in list(entry.window_tiles):
+                wt = entry.window_tiles[key]
+                if not all(
+                    c in wt["cols"] or c in wt["limbs"] for c in keep_cols
+                ):
+                    freed += wt["nbytes"]
+                    del entry.window_tiles[key]
             entry.nbytes -= freed
             if self._super.get(entry.region_id) is entry:
                 self._used -= freed
@@ -363,6 +377,8 @@ class TileCacheManager:
                     for chunks in entry.limb_cols.values()
                 )
                 entry.limb_cols.clear()
+                freed += sum(wt["nbytes"] for wt in entry.window_tiles.values())
+                entry.window_tiles.clear()
                 for attr in ("tm_valid", "tm_valid_dedup"):
                     planes = getattr(entry, attr)
                     if planes is not None:
@@ -576,8 +592,10 @@ class TileCacheManager:
                     sum(int(l.nbytes) + int(s.nbytes) for l, s in chunks)
                     for chunks in entry.limb_cols.values()
                 )
+                freed += sum(wt["nbytes"] for wt in entry.window_tiles.values())
                 if freed:
                     entry.limb_cols.clear()
+                    entry.window_tiles.clear()
                     entry.nbytes -= freed
                     self._used -= freed
         while self._used > self.budget and len(self._super) > len(pinned_regions):
@@ -1072,6 +1090,149 @@ class TileCacheManager:
                 # other entries strip first; this query's references
                 # keep its own arrays alive regardless)
                 self._evict_locked(pinned_regions | {entry.region_id})
+        return out
+
+    # window tiles engage when the window covers less than this fraction
+    # of the entry's rows (otherwise the full super-tile is cheaper than
+    # building a nearly-as-big copy)
+    _WINDOW_TILE_MAX_COVER = 0.5
+    _WINDOW_TILE_MIN_ROWS = 1 << 22  # below this the full scan is cheap
+
+    def ensure_window_tile(
+        self,
+        entry: _SuperTiles,
+        window: tuple[int, int],
+        ts_name: str,
+        need_cols: set[str],
+        limb_cols: set[str],
+        dedup: bool,
+        dict_epoch: int,
+    ):
+        """Build (or fetch) the compact device tile for one query window:
+        host-side flatnonzero over the sorted ts (AND the dedup keep
+        plane, so stale versions never even upload), mmap fancy-gather of
+        each needed column, upload in chunk-device order, quantize limb
+        planes from the gathered values.  Returns a list of source
+        tuples (cols, valid, nulls, perm, limbs) or None when the window
+        doesn't qualify.  Rows keep their (pk, ts) order, so the blocked
+        kernel geometry holds on the compacted tile."""
+        if entry.num_rows < self._WINDOW_TILE_MIN_ROWS:
+            return None
+        if ts_name not in entry.sorted_host:
+            return None
+        key = (int(window[0]), int(window[1]), bool(dedup))
+        with self._lock:
+            wt = entry.window_tiles.get(key)
+            if wt is not None and wt["epoch"] == dict_epoch and all(
+                c in wt["cols"] or c in wt["limbs"] for c in need_cols
+            ):
+                return self._window_sources(wt, need_cols, limb_cols)
+            if wt is not None and wt["epoch"] != dict_epoch:
+                # tag codes moved: drop and rebuild at the current epoch
+                freed = wt["nbytes"]
+                entry.window_tiles.pop(key)
+                entry.nbytes -= freed
+                if self._super.get(entry.region_id) is entry:
+                    self._used -= freed
+                wt = None
+
+        ts_sorted = entry.sorted_host[ts_name]
+        mask = (np.asarray(ts_sorted) >= window[0]) & (
+            np.asarray(ts_sorted) < window[1]
+        )
+        if dedup:
+            if not self.ensure_dedup_keep(entry):
+                return None
+            mask &= entry.keep_host
+        idx = np.flatnonzero(mask).astype(np.int32)
+        n = len(idx)
+        if n == 0 or n > entry.num_rows * self._WINDOW_TILE_MAX_COVER:
+            return None
+        # pad to a 2^22 grid: bounded compile-shape variety, chunks stay
+        # BLOCK_ROWS multiples
+        grid = 1 << 22
+        pad = -(-n // grid) * grid
+        bounds = _chunk_bounds(pad, self.chunk_rows)
+
+        cols_needed = [c for c in need_cols if c != ts_name] + [ts_name]
+        est = pad * (len(cols_needed) * 8 + 1)
+        with self._lock:
+            self._reserve_locked(est, {entry.region_id})
+
+        def host_source(name):
+            # all sources are in SORTED row order; idx indexes real rows
+            if name in entry.sorted_host:
+                return np.asarray(entry.sorted_host[name])
+            if name in entry.persisted_cols:
+                return np.asarray(entry.persisted_cols[name])
+            chunks = self.host_column_chunks(entry, name)
+            if chunks is None:
+                return None
+            return np.concatenate([np.asarray(x) for x in chunks])
+
+        cols_dev: dict[str, list] = {}
+        nulls_dev: dict[str, list] = {}
+        limbs_dev: dict[str, list] = {}
+        for name in dict.fromkeys(cols_needed):
+            # nullable columns without a persisted null plane can't build
+            # their gathered mask here — full super-tile path owns those
+            if name in entry.nulls and name not in entry.persisted_nulls:
+                return None
+            src = host_source(name)
+            if src is None:
+                return None
+            buf = np.zeros(pad, dtype=src.dtype)
+            buf[:n] = src[idx]
+            chunks = self._up_chunks(buf, bounds)
+            if name in limb_cols:
+                limbs_dev[name] = [_quantize_limbs_jit(x) for x in chunks]
+            # the f64 plane stays EVEN for limb columns: the exact-f64
+            # rerun after a failed limb verdict, mixed min/max+avg
+            # queries, and cache hits with a different limb set all read
+            # columns[c] — window tiles are small enough to afford both
+            cols_dev[name] = chunks
+            pres = entry.persisted_nulls.get(name)
+            if pres is not None:
+                nb = np.zeros(pad, bool)
+                nb[:n] = np.asarray(pres)[idx]
+                nulls_dev[name] = self._up_chunks(nb, bounds)
+        v = np.zeros(pad, bool)
+        v[:n] = True
+        wt = {
+            "cols": cols_dev,
+            "nulls": nulls_dev,
+            "limbs": limbs_dev,
+            "valid": self._up_chunks(v, bounds),
+            "rows": n,
+            "epoch": dict_epoch,
+            "nbytes": est,
+        }
+        with self._lock:
+            race = entry.window_tiles.get(key)
+            if race is not None and race["epoch"] == dict_epoch:
+                # a concurrent identical build won: use theirs, charge
+                # nothing (double-charging drifted _used upward forever)
+                wt = race
+            else:
+                entry.window_tiles[key] = wt
+                entry.nbytes += est
+                if self._super.get(entry.region_id) is entry:
+                    self._used += est
+        metrics.TILE_WINDOW_BUILDS.inc()
+        return self._window_sources(wt, need_cols, limb_cols)
+
+    @staticmethod
+    def _window_sources(wt: dict, need_cols: set[str], limb_cols: set[str]):
+        n_chunks = len(wt["valid"])
+        out = []
+        for i in range(n_chunks):
+            out.append((
+                {c: wt["cols"][c][i] for c in need_cols if c in wt["cols"]},
+                wt["valid"][i],
+                {c: wt["nulls"][c][i] for c in need_cols if c in wt["nulls"]},
+                None,
+                {c: wt["limbs"][c][i] for c in limb_cols if c in wt["limbs"]},
+            ))
         return out
 
     def ensure_dedup_keep(self, entry: _SuperTiles) -> bool:
@@ -1728,6 +1889,24 @@ class TileExecutor:
                 dedup = s.region_id in dedup_regions
                 if dedup and not self.cache.ensure_dedup_keep(s):
                     return None  # host planes evicted: scan path owns it
+                if (
+                    not plan.time_major
+                    and window is not None
+                    and use_ts
+                    and window[0] > -(1 << 61)
+                    and window[1] < (1 << 61)
+                ):
+                    # windowed query over deep retention: gather ONLY the
+                    # in-window (and dedup-surviving) rows into a compact
+                    # tile — the kernel then scans the window, not the
+                    # retention (reference prunes SSTs/row-groups by time)
+                    wsrc = self.cache.ensure_window_tile(
+                        s, window, use_ts, self._plan_cols(plan),
+                        set(limb_need), dedup, ctx.dictionary.epoch,
+                    )
+                    if wsrc is not None:
+                        device_sources.extend(wsrc)
+                        continue
                 if s.nbytes > self.cache.budget // 2:
                     # one-entry deployments: make room for THIS query's
                     # planes by dropping the entry's own unused columns
@@ -1823,9 +2002,15 @@ class TileExecutor:
             except Exception as e:  # noqa: BLE001 — only OOM is retryable
                 if "RESOURCE_EXHAUSTED" not in str(e):
                     raise
-                # device OOM: release every re-derivable plane and retry
-                # once with maximal free HBM; a second failure falls back
-                # to the authoritative scan path
+                # device OOM: release every re-derivable plane AND the
+                # pinned entries' own columns this query doesn't touch
+                # (a sole-entry deployment can hold 10 f64 planes another
+                # query family uploaded), then retry once; a second
+                # failure falls back to the authoritative scan path
+                need = self._plan_cols(plan)
+                for s in slots:
+                    if isinstance(s, _SuperTiles):
+                        self.cache.release_unneeded(s, need)
                 self.cache.emergency_release(pinned_ids)
                 packed = program(tuple(device_sources), dyn)
                 table = self._finalize(

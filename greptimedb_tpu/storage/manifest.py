@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import uuid
 from dataclasses import dataclass, field
 
 from ..datatypes.schema import Schema
@@ -97,7 +98,16 @@ class ManifestManager:
         """
         with self._lock:
             version = self.manifest.manifest_version + 1
-            self.store.write(f"{version:020d}.json", json.dumps(action).encode())
+            # writer-unique suffix: two region holders racing one version
+            # slot (transient split-brain during failover) must never
+            # OVERWRITE each other's edit — a lost files_to_remove leaves
+            # the manifest referencing deleted SSTs forever.  Both edits
+            # survive and replay deterministically; adds/removes are
+            # idempotent under re-application.
+            uid = uuid.uuid4().hex[:8]
+            self.store.write(
+                f"{version:020d}.{uid}.json", json.dumps(action).encode()
+            )
             self._apply_in_memory(action, version)
             if version % self.checkpoint_distance == 0:
                 self._write_checkpoint()
@@ -129,16 +139,23 @@ class ManifestManager:
     # ---- checkpointing / recovery -----------------------------------------
     def _write_checkpoint(self):
         version = self.manifest.manifest_version
+        # uid keeps two holders' same-version checkpoints from silently
+        # overwriting each other; recovery picks the lexically-last
         self.store.write(
-            f"{version:020d}.checkpoint.json", json.dumps(self.manifest.to_dict()).encode()
+            f"{version:020d}.{uuid.uuid4().hex[:8]}.checkpoint.json",
+            json.dumps(self.manifest.to_dict()).encode(),
         )
-        # GC: deltas and older checkpoints <= this version are now redundant.
+        # GC keeps a TRAILING WINDOW of deltas (2x checkpoint distance)
+        # below the checkpoint, not just same-version ones: a concurrent
+        # holder (transient split-brain) may have written edits at any
+        # recent version our checkpoint never saw — deleting them loses
+        # file adds/removes permanently.  The alive keeper closes stale
+        # holders within seconds, so the window comfortably covers the
+        # race; replay re-applies windowed deltas idempotently.
+        keep_from = version - 2 * self.checkpoint_distance
         for name in self.store.list():
             v = _version_of(name)
-            if v is None:
-                continue
-            is_ckpt = name.endswith(".checkpoint.json")
-            if (is_ckpt and v < version) or (not is_ckpt and v <= version):
+            if v is not None and v < keep_from:
                 self.store.delete(name)
 
     def _recover(self) -> RegionManifest:
@@ -152,7 +169,10 @@ class ManifestManager:
             base_version = manifest.manifest_version
         for name in deltas:
             v = _version_of(name)
-            if v is None or v <= base_version:
+            # re-apply the trailing delta window over the checkpoint
+            # (idempotent adds/removes; concurrent-holder edits the
+            # checkpoint never saw get incorporated here)
+            if v is None or v < base_version - 2 * self.checkpoint_distance:
                 continue
             action = json.loads(self.store.read(name))
             self.__dict__["manifest"] = manifest  # allow _apply_in_memory use

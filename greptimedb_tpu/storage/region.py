@@ -123,7 +123,14 @@ class Region:
         self._frozen_memtables: list[Memtable] = []
         # SSTs removed from the manifest but not yet safe to delete (readers
         # in flight may hold the old file list); purged when readers drain.
-        self._garbage_files: list[str] = []
+        # (file_id, tombstoned_at): physical deletion waits out BOTH
+        # local in-flight scans AND a wall-clock grace, because ANOTHER
+        # region holder (transient split-brain during failover, or a
+        # second process on shared storage) may still scan from an older
+        # manifest snapshot that references these files (the reference's
+        # file purger + object-store GC grace plays the same role)
+        self._garbage_files: list[tuple[str, float]] = []
+        self.gc_grace_secs: float = 60.0
         self._active_scans = 0
         self.sequence = self.manifest_mgr.manifest.flushed_sequence
         # Future WAL entry ids must exceed the flush watermark, else writes
@@ -247,7 +254,9 @@ class Region:
                 # the truncate watermark the same way)
                 if frozen in self._frozen_memtables:
                     self._frozen_memtables.remove(frozen)
-                self._garbage_files.extend(m.file_id for m in added)
+                self._garbage_files.extend(
+                (m.file_id, time.time()) for m in added
+            )
                 self._purge_garbage_locked()
                 return []
             self.manifest_mgr.apply(
@@ -277,16 +286,23 @@ class Region:
             )
             # Defer physical deletion: in-flight scans may hold the old file
             # list (the reference defers via a file purger + refcounts).
-            self._garbage_files.extend(files_to_remove)
+            self._garbage_files.extend(
+                (fid, time.time()) for fid in files_to_remove
+            )
             self._purge_garbage_locked()
         metrics.COMPACTION_TOTAL.inc()
 
     def _purge_garbage_locked(self):
         if self._active_scans > 0 or not self._garbage_files:
             return
-        for fid in self._garbage_files:
-            self.sst_reader.delete(fid)
-        self._garbage_files.clear()
+        now = time.time()
+        keep: list[tuple[str, float]] = []
+        for fid, t0 in self._garbage_files:
+            if now - t0 >= self.gc_grace_secs:
+                self.sst_reader.delete(fid)
+            else:
+                keep.append((fid, t0))
+        self._garbage_files = keep
 
     # ---- read -------------------------------------------------------------
     def scan(
@@ -693,7 +709,7 @@ class Region:
             self.wal.obsolete(entry_id)
             # the truncated SSTs are unreferenced now; reclaim them once
             # in-flight scans drain (same deferred purge as compaction)
-            self._garbage_files.extend(dropped)
+            self._garbage_files.extend((fid, time.time()) for fid in dropped)
             self._purge_garbage_locked()
 
     def alter_schema(self, new_schema: Schema):

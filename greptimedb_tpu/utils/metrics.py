@@ -166,6 +166,7 @@ TILE_READBACK_MS = REGISTRY.histogram("greptime_tile_readback_ms", "Device->host
 TILE_LIMB_RERUNS = REGISTRY.counter("greptime_tile_limb_reruns_total", "Tile queries rerun in exact f64 after the limb error-bound verdict failed")
 TILE_PERSIST_HITS = REGISTRY.counter("greptime_tile_persist_hits_total", "Super-tile consolidations loaded from the persisted store (cold-start skip)")
 TILE_PERSIST_WRITES = REGISTRY.counter("greptime_tile_persist_writes_total", "Super-tile consolidations written to the persisted store")
+TILE_WINDOW_BUILDS = REGISTRY.counter("greptime_tile_window_builds_total", "Compact window tiles gathered from sorted encodes")
 TILE_HOST_FAST_PATH = REGISTRY.counter("greptime_tile_host_fast_path_total", "Selective queries served from the sorted host encode cache")
 DIST_STATE_QUERIES = REGISTRY.counter("greptime_query_dist_state_total", "Distributed queries merged from shipped states")
 COMPACTION_BACKGROUND = REGISTRY.counter("greptime_mito_compaction_background_total", "Background compaction merges")

@@ -35,7 +35,7 @@ def cluster_env(tmp_path):
     fe = spawn(
         ["frontend", "start", "--node-id", "100", "--data-home", cluster.home,
          "--metasrv", cluster.meta_addr, "--http-addr", "127.0.0.1:0",
-         "--heartbeat-s", "0.2"],
+         "--heartbeat-s", "0.5"],
         proc_env(),
     )
     cluster.procs.append(fe)
@@ -131,7 +131,7 @@ def test_frontend_failover_after_datanode_crash(cluster_env):
     cluster.procs[victim].kill()
     cluster.procs[victim].wait(timeout=15)
 
-    deadline = time.time() + 240  # single-core CI: failover competes with the suite
+    deadline = time.time() + 600  # single-core CI: failover competes with the suite
     last = None
     while time.time() < deadline:
         try:
@@ -142,6 +142,20 @@ def test_frontend_failover_after_datanode_crash(cluster_env):
             last = e
         time.sleep(0.5)
     else:
-        raise AssertionError(f"failover did not complete: {last}")
+        import select as _select
+
+        tails = []
+        for p in cluster.procs:
+            if p.poll() is None and p.stdout is not None:
+                chunk = b""
+                while _select.select([p.stdout], [], [], 0)[0]:
+                    line = p.stdout.readline()
+                    if not line:
+                        break
+                    chunk += line.encode() if isinstance(line, str) else line
+                tails.append(chunk.decode(errors="replace")[-800:])
+        raise AssertionError(
+            f"failover did not complete: {last}\nproc tails: {tails}"
+        )
     out = _sql(addr, "SELECT host, v FROM t2 ORDER BY host")
     assert _rows(out) == [["a", 1.0], ["b", 2.0], ["c", 3.0]]

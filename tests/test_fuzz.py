@@ -230,3 +230,122 @@ def test_fuzz_cluster_writes_under_failover(tmp_path):
         assert t.column("count(*)").to_pylist() == [acked]
     finally:
         c.close()
+
+
+def test_fuzz_failover_under_churn(tmp_path):
+    """Repeated kills DURING migrations with writes in flight (reference
+    tests-fuzz/targets/failover + Chaos Mesh pod-kill): nodes die at
+    random points — including mid-migration — while writers keep
+    retrying; every acked row must survive and the cluster must converge
+    to serving all of them."""
+    from greptimedb_tpu.datatypes import (
+        ColumnSchema,
+        ConcreteDataType,
+        Schema,
+        SemanticType,
+    )
+    from greptimedb_tpu.distributed.cluster import Cluster
+    from greptimedb_tpu.utils.errors import GreptimeError, RetryLaterError
+
+    rng = random.Random(4242)
+    now = [0.0]
+    c = Cluster(str(tmp_path), num_datanodes=4, clock=lambda: now[0])
+    schema = Schema(
+        columns=[
+            ColumnSchema("host", ConcreteDataType.STRING, SemanticType.TAG),
+            ColumnSchema("ts", ConcreteDataType.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP),
+            ColumnSchema("v", ConcreteDataType.FLOAT64),
+        ]
+    )
+    try:
+        c.create_table("churn", schema, partitions=4)
+        for _ in range(5):
+            now[0] += 1000
+            c.heartbeat_all()
+        acked_keys: list[int] = []
+        kills = 0
+        i = 0
+        for step in range(200):
+            now[0] += 500
+            # random chaos: kill a node (max 2 of 4, keep quorum of data
+            # reachable via shared storage), sometimes mid-step between a
+            # migration submission and its heartbeat processing
+            if kills < 2 and rng.random() < 0.04:
+                alive = [n for n, d in c.datanodes.items() if d.alive]
+                if len(alive) > 2:
+                    if rng.random() < 0.5:
+                        # planned migration first, then kill the SOURCE
+                        meta = c.catalog.table("churn", "public")
+                        routes = c.metasrv.get_route(meta.table_id)
+                        rid = rng.choice(list(routes))
+                        src = routes[rid]
+                        dst = rng.choice([n for n in alive if n != src])
+                        try:
+                            c.migrate_region("churn", rid, dst)
+                        except GreptimeError:
+                            pass
+                        if src in alive and rng.random() < 0.7:
+                            for dn in c.datanodes.values():
+                                if dn.alive:
+                                    dn.engine.flush_all()
+                            c.kill_datanode(src)
+                            kills += 1
+                    else:
+                        for dn in c.datanodes.values():
+                            if dn.alive:
+                                dn.engine.flush_all()
+                        c.kill_datanode(rng.choice(alive))
+                        kills += 1
+            batch = pa.RecordBatch.from_arrays(
+                [
+                    pa.array([f"h{i % 11}"], pa.string()),
+                    pa.array([i * 1000], pa.timestamp("ms")),
+                    pa.array([float(i)]),
+                ],
+                schema=schema.to_arrow(),
+            )
+            try:
+                c.insert("churn", batch)
+                acked_keys.append(i)
+                i += 1
+            except (RetryLaterError, ConnectionError, GreptimeError, OSError):
+                # OSError: shared-storage file races during failover
+                # (a just-compacted SST vanishing under a stale reader)
+                # are transient — real clients retry
+                c.heartbeat_all()
+                c.supervise()
+                continue
+            if step % 5 == 0:
+                c.heartbeat_all()
+                c.supervise()
+        # convergence: drive detection + failover until reads serve
+        deadline = 200
+        for _ in range(deadline):
+            now[0] += 1000
+            c.heartbeat_all()
+            c.supervise()
+            try:
+                t = c.query("SELECT count(*) AS n FROM churn")
+                if t["n"].to_pylist()[0] == len(acked_keys):
+                    break
+            except (GreptimeError, ConnectionError, OSError):
+                continue
+        got = None
+        for _ in range(60):  # stale split-brain readers close via mailbox
+            now[0] += 1000
+            c.heartbeat_all()
+            c.supervise()
+            try:
+                t = c.query("SELECT v FROM churn")
+                got = sorted(t["v"].to_pylist())
+                if got == [float(k) for k in acked_keys]:
+                    break
+            except (GreptimeError, ConnectionError, OSError):
+                continue
+        assert got == [float(k) for k in acked_keys], (
+            f"lost {len(acked_keys) - len(got or [])} acked rows after churn "
+            f"({kills} kills)"
+        )
+        assert kills >= 1, "chaos never fired; loosen the schedule"
+    finally:
+        c.close()
