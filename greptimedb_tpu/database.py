@@ -294,7 +294,11 @@ class Database:
         from .utils.events import SlowQueryTimer
 
         if isinstance(stmt, SelectStmt):
-            with self.memory.query_guard(), self.process_manager.track(
+            from .utils.deadline import deadline_scope
+
+            with deadline_scope(
+                self.config.query.timeout_s
+            ), self.memory.query_guard(), self.process_manager.track(
                 self.current_database, query_text or "SELECT ..."
             ), SlowQueryTimer(
                 self.event_recorder, self.config.slow_query,
@@ -1015,11 +1019,16 @@ class Database:
             # (query/src/optimizer/parallelize_scan.rs)
             from concurrent.futures import ThreadPoolExecutor
 
+            from .utils.deadline import propagate
+
             with ThreadPoolExecutor(
                 max_workers=min(len(meta.region_ids), 8)
             ) as pool:
                 out = list(
-                    pool.map(lambda rid: self.storage.scan(rid, pred), meta.region_ids)
+                    pool.map(
+                        propagate(lambda rid: self.storage.scan(rid, pred)),
+                        meta.region_ids,
+                    )
                 )
             self.process_manager.check_cancelled()
             return out

@@ -224,6 +224,12 @@ class Frontend:
                 self._client(node).close_region(rid)
             except Exception:  # noqa: BLE001 — the region is unrouted already
                 pass
+        try:
+            # clear the metasrv route so dead table ids don't accumulate
+            # in the KV (Cluster's DropTableProcedure removes metadata)
+            self.meta.set_route(meta.table_id, {})
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
         return None
 
     # ---- DML ---------------------------------------------------------------

@@ -225,6 +225,11 @@ class Metasrv:
 
     # ---- routes -----------------------------------------------------------
     def set_route(self, table_id: int, routes: dict[int, int]):
+        if not routes:
+            # dropping the last route DELETES the key: dead table ids must
+            # not accumulate in the KV (DropTableProcedure / frontend DROP)
+            self.kv.delete(ROUTE_PREFIX + str(table_id))
+            return
         self.kv.put(ROUTE_PREFIX + str(table_id), json.dumps({str(k): v for k, v in routes.items()}))
 
     def get_route(self, table_id: int) -> dict[int, int]:

@@ -1121,12 +1121,11 @@ class TileCacheManager:
         if ts_name not in entry.sorted_host:
             return None
         key = (int(window[0]), int(window[1]), bool(dedup))
+        cols_needed = list(
+            dict.fromkeys([c for c in need_cols if c != ts_name] + [ts_name])
+        )
         with self._lock:
             wt = entry.window_tiles.get(key)
-            if wt is not None and wt["epoch"] == dict_epoch and all(
-                c in wt["cols"] or c in wt["limbs"] for c in need_cols
-            ):
-                return self._window_sources(wt, need_cols, limb_cols)
             if wt is not None and wt["epoch"] != dict_epoch:
                 # tag codes moved: drop and rebuild at the current epoch
                 freed = wt["nbytes"]
@@ -1135,29 +1134,71 @@ class TileCacheManager:
                 if self._super.get(entry.region_id) is entry:
                     self._used -= freed
                 wt = None
+            snap = None
+            if wt is not None:
+                missing = [c for c in cols_needed if c not in wt["cols"]]
+                missing_limbs = [
+                    c
+                    for c in limb_cols
+                    if c in need_cols
+                    and c not in wt["limbs"]
+                    and c not in missing
+                ]
+                if not missing and not missing_limbs:
+                    return self._window_sources(wt, need_cols, limb_cols)
+                # EXTEND the cached tile: build only the missing planes
+                # and merge them in (the round-4 code rebuilt everything
+                # and then DISCARDED the rebuild in its race branch,
+                # returning a tile missing columns — every multi-column
+                # query after a narrower one over the same window then
+                # fell back to the CPU scan, the round-4 driver-bench
+                # timeout).  Snapshot the existing planes so the merge
+                # commit below can survive a concurrent eviction.
+                snap = {
+                    "cols": dict(wt["cols"]),
+                    "nulls": dict(wt["nulls"]),
+                    "limbs": dict(wt["limbs"]),
+                    "valid": wt["valid"],
+                    "rows": wt["rows"],
+                }
+            else:
+                missing = list(cols_needed)
+                missing_limbs = []
 
-        ts_sorted = entry.sorted_host[ts_name]
-        mask = (np.asarray(ts_sorted) >= window[0]) & (
-            np.asarray(ts_sorted) < window[1]
-        )
-        if dedup:
-            if not self.ensure_dedup_keep(entry):
+        n = snap["rows"] if snap is not None else -1
+        idx = None
+        if missing:
+            ts_sorted = entry.sorted_host[ts_name]
+            mask = (np.asarray(ts_sorted) >= window[0]) & (
+                np.asarray(ts_sorted) < window[1]
+            )
+            if dedup:
+                if not self.ensure_dedup_keep(entry):
+                    return None
+                mask &= entry.keep_host
+            idx = np.flatnonzero(mask).astype(np.int32)
+            if snap is not None and len(idx) != snap["rows"]:
+                # row set changed under the same epoch (shouldn't happen:
+                # the file set pins sorted_host) — full rebuild, replace
+                snap = None
+                missing = list(cols_needed)
+                missing_limbs = []
+            n = len(idx)
+            if n == 0 or n > entry.num_rows * self._WINDOW_TILE_MAX_COVER:
                 return None
-            mask &= entry.keep_host
-        idx = np.flatnonzero(mask).astype(np.int32)
-        n = len(idx)
-        if n == 0 or n > entry.num_rows * self._WINDOW_TILE_MAX_COVER:
-            return None
         # pad to a 2^22 grid: bounded compile-shape variety, chunks stay
         # BLOCK_ROWS multiples
         grid = 1 << 22
         pad = -(-n // grid) * grid
         bounds = _chunk_bounds(pad, self.chunk_rows)
 
-        cols_needed = [c for c in need_cols if c != ts_name] + [ts_name]
-        est = pad * (len(cols_needed) * 8 + 1)
-        with self._lock:
-            self._reserve_locked(est, {entry.region_id})
+        # nullable columns without a persisted null plane can't build
+        # their gathered mask here — full super-tile path owns those.
+        # (All bail-outs happen BEFORE the device reservation below, so an
+        # aborted build never evicts other tiles for nothing.)
+        for name in missing:
+            if name in entry.nulls and name not in entry.persisted_nulls:
+                return None
 
         def host_source(name):
             # all sources are in SORTED row order; idx indexes real rows
@@ -1170,54 +1211,134 @@ class TileCacheManager:
                 return None
             return np.concatenate([np.asarray(x) for x in chunks])
 
+        # gather every host buffer FIRST (host RAM only) so the device
+        # reservation below never evicts tiles for a build that then
+        # aborts on a concurrently-evicted host encode
+        host_bufs: dict[str, tuple[np.ndarray, np.ndarray | None]] = {}
+        for name in missing:
+            src = host_source(name)
+            if src is None:
+                return None  # host encode evicted mid-flight: scan path
+            buf = np.zeros(pad, dtype=src.dtype)
+            buf[:n] = src[idx]
+            nb = None
+            pres = entry.persisted_nulls.get(name)
+            if pres is not None:
+                nb = np.zeros(pad, bool)
+                nb[:n] = np.asarray(pres)[idx]
+            host_bufs[name] = (buf, nb)
+
+        # reserve what is ABOUT to allocate, counting every plane: f64
+        # value + null planes for missing columns, limb digit planes
+        # (8 B/row) + per-block scales for limb columns, the valid plane
+        # for a fresh tile (round 4 under-counted limbs/nulls here, so
+        # _used drifted below actual HBM at TSBS scale)
+        limb_build = set(missing_limbs) | (set(limb_cols) & set(missing))
+        est = sum(
+            buf.nbytes + (0 if nb is None else nb.nbytes)
+            for buf, nb in host_bufs.values()
+        )
+        est += len(limb_build) * (pad * 8 + (pad // BLOCK_ROWS) * 8)
+        if snap is None:
+            est += pad
+        with self._lock:
+            self._reserve_locked(est, {entry.region_id})
+
         cols_dev: dict[str, list] = {}
         nulls_dev: dict[str, list] = {}
         limbs_dev: dict[str, list] = {}
-        for name in dict.fromkeys(cols_needed):
-            # nullable columns without a persisted null plane can't build
-            # their gathered mask here — full super-tile path owns those
-            if name in entry.nulls and name not in entry.persisted_nulls:
-                return None
-            src = host_source(name)
-            if src is None:
-                return None
-            buf = np.zeros(pad, dtype=src.dtype)
-            buf[:n] = src[idx]
+        for name in missing:
+            buf, nb = host_bufs[name]
             chunks = self._up_chunks(buf, bounds)
-            if name in limb_cols:
+            if name in limb_build:
                 limbs_dev[name] = [_quantize_limbs_jit(x) for x in chunks]
             # the f64 plane stays EVEN for limb columns: the exact-f64
             # rerun after a failed limb verdict, mixed min/max+avg
             # queries, and cache hits with a different limb set all read
             # columns[c] — window tiles are small enough to afford both
             cols_dev[name] = chunks
-            pres = entry.persisted_nulls.get(name)
-            if pres is not None:
-                nb = np.zeros(pad, bool)
-                nb[:n] = np.asarray(pres)[idx]
+            if nb is not None:
                 nulls_dev[name] = self._up_chunks(nb, bounds)
-        v = np.zeros(pad, bool)
-        v[:n] = True
-        wt = {
-            "cols": cols_dev,
-            "nulls": nulls_dev,
-            "limbs": limbs_dev,
-            "valid": self._up_chunks(v, bounds),
-            "rows": n,
-            "epoch": dict_epoch,
-            "nbytes": est,
-        }
+        for name in missing_limbs:
+            # column already on the tile: quantize straight from its
+            # resident device chunks, no host gather
+            limbs_dev[name] = [
+                _quantize_limbs_jit(x) for x in snap["cols"][name]
+            ]
+        valid = snap["valid"] if snap is not None else None
+        if valid is None:
+            v = np.zeros(pad, bool)
+            v[:n] = True
+            valid = self._up_chunks(v, bounds)
+
+        def plane_bytes(kind: str, chunks) -> int:
+            if kind == "limbs":
+                return sum(int(l.nbytes) + int(s.nbytes) for l, s in chunks)
+            return sum(int(x.nbytes) for x in chunks)
+
+        built = {"cols": cols_dev, "nulls": nulls_dev, "limbs": limbs_dev}
         with self._lock:
             race = entry.window_tiles.get(key)
-            if race is not None and race["epoch"] == dict_epoch:
-                # a concurrent identical build won: use theirs, charge
-                # nothing (double-charging drifted _used upward forever)
+            if (
+                race is not None
+                and race["epoch"] == dict_epoch
+                and race["rows"] == n
+            ):
+                # merge the freshly built planes into the live tile —
+                # never discard them (see above).  The SNAPSHOT's planes
+                # merge too: if the tile we extended was evicted and a
+                # concurrent build committed a replacement for a different
+                # column set, `built` alone would leave the race tile
+                # missing columns this query needs.  Double-charging is
+                # avoided by only adding planes the race tile lacks
+                # (race usually IS the snapshotted dict, so snap's planes
+                # are already present and skip).
+                added = 0
+                for kind, d in built.items():
+                    merged_d = (
+                        {**snap[kind], **d} if snap is not None else d
+                    )
+                    for c, chunks in merged_d.items():
+                        if c not in race[kind]:
+                            race[kind][c] = chunks
+                            added += plane_bytes(kind, chunks)
+                race["nbytes"] += added
+                entry.nbytes += added
+                if self._super.get(entry.region_id) is entry:
+                    self._used += added
                 wt = race
             else:
+                if race is not None:
+                    freed = race["nbytes"]
+                    entry.window_tiles.pop(key)
+                    entry.nbytes -= freed
+                    if self._super.get(entry.region_id) is entry:
+                        self._used -= freed
+                # commit snapshot ∪ new as a complete tile (the snapshot
+                # arrays are kept alive by our references even if the
+                # original entry was evicted mid-build)
+                merged = {
+                    kind: {**(snap[kind] if snap is not None else {}), **d}
+                    for kind, d in built.items()
+                }
+                wt = {
+                    **merged,
+                    "valid": valid,
+                    "rows": n,
+                    "epoch": dict_epoch,
+                    "nbytes": (
+                        sum(
+                            plane_bytes(kind, chunks)
+                            for kind, d in merged.items()
+                            for chunks in d.values()
+                        )
+                        + plane_bytes("valid", valid)
+                    ),
+                }
                 entry.window_tiles[key] = wt
-                entry.nbytes += est
+                entry.nbytes += wt["nbytes"]
                 if self._super.get(entry.region_id) is entry:
-                    self._used += est
+                    self._used += wt["nbytes"]
         metrics.TILE_WINDOW_BUILDS.inc()
         return self._window_sources(wt, need_cols, limb_cols)
 

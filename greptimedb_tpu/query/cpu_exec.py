@@ -12,6 +12,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
+from ..utils.deadline import check_deadline
 from ..utils.errors import ExecutionError, PlanError
 from .expr import (
     AggCall,
@@ -294,6 +295,7 @@ class CpuExecutor:
             return t
 
     def _execute_node(self, plan: LogicalPlan) -> pa.Table:
+        check_deadline()
         if isinstance(plan, TableScan):
             return self.scan(plan)
         if isinstance(plan, VectorSearch):

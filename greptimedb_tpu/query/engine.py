@@ -202,7 +202,11 @@ class QueryEngine:
                     return _merge_subplan_results(tables, split)
             with span("query.cpu"):
                 return self.cpu.execute(plan)
-        except Exception:
+        except Exception as e:
+            from ..utils.errors import QueryTimeoutError
+
+            if isinstance(e, QueryTimeoutError):
+                raise  # deadline passed: the CPU fallback IS the runaway scan
             if backend == "tpu" and self.config.fallback_to_cpu:
                 metrics.TPU_FALLBACK_TOTAL.inc()
                 # the fallback keeps the query alive but must never hide

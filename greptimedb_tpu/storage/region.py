@@ -26,6 +26,7 @@ import pyarrow as pa
 
 from ..datatypes.schema import Schema
 from ..utils import metrics
+from ..utils.deadline import check_deadline
 from ..utils.errors import IllegalStateError, RegionReadonlyError
 from .manifest import ManifestManager
 from .memtable import Memtable, make_memtable
@@ -357,6 +358,7 @@ class Region:
                 read_cols = need
             tables = []
             for meta in self.sst_reader.prune_files(files, prune_pred):
+                check_deadline()
                 t = self.sst_reader.read(meta, prune_pred, columns=read_cols)
                 if t.num_rows:
                     tables.append(self._compat_cast(_undict(t)))

@@ -21,6 +21,7 @@ import pyarrow.parquet as pq
 
 from ..datatypes.schema import Schema
 from ..utils import metrics
+from ..utils.deadline import check_deadline, current_deadline
 from . import index as idx
 from .index import BLOOM_BLOB, FULLTEXT_BLOB, INVERTED_BLOB, VECTOR_BLOB
 from .object_store import FsObjectStore, ObjectStore
@@ -260,7 +261,20 @@ class SstReader:
             if columns:
                 schema = pa.schema([schema.field(c) for c in columns])
             return schema.empty_table()
-        table = pf.read_row_groups(groups, columns=columns, use_threads=True)
+        check_deadline()
+        if current_deadline() is None or len(groups) <= 4:
+            table = pf.read_row_groups(groups, columns=columns, use_threads=True)
+        else:
+            # under an active deadline, decode in row-group batches so a
+            # runaway scan aborts between batches instead of grinding
+            # through the whole file in one opaque C call
+            parts = []
+            for i in range(0, len(groups), 4):
+                check_deadline()
+                parts.append(
+                    pf.read_row_groups(groups[i : i + 4], columns=columns, use_threads=True)
+                )
+            table = pa.concat_tables(parts)
         # Parquet has no seconds timestamp unit: a timestamp("s") column comes
         # back as timestamp("ms").  Restore the declared logical type so
         # residual predicates (expressed in the native unit) compare correctly.

@@ -143,6 +143,13 @@ class QueryConfig:
     # value but loses low bits beyond that — set "float64" for exact f64
     # accumulation (per-column VPU kernels, ~6x slower at TSBS scale).
     tile_acc_dtype: str = "limb"
+    # Per-statement wall-clock budget (seconds; 0 disables).  Enforced
+    # cooperatively (utils/deadline.py): scan loops, row-group reads and
+    # plan-node execution check it between units of work, so a query that
+    # degrades to a full CPU scan aborts with QueryTimeoutError instead of
+    # grinding unbounded (the reference cancels the DataFusion stream on
+    # its request timeouts).
+    timeout_s: float = 0.0
 
 
 @dataclasses.dataclass

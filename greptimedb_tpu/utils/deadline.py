@@ -1,0 +1,87 @@
+"""Cooperative per-query deadlines.
+
+A query that cannot be served from the device tile path may fall back to
+the CPU scan path, whose cost scales with raw table size — at TSBS 3-day
+scale (104M rows) an unbounded Python/Arrow scan runs for minutes.  The
+reference bounds runaway statements with per-request timeouts enforced in
+its stream executors (servers cancel the DataFusion stream); here the
+equivalent is a thread-local deadline that long-running loops check
+between units of work (per SST file, per row-group batch, per plan node).
+
+Usage:
+
+    with deadline_scope(30.0):       # seconds; None/0 disables
+        ... run the query ...
+
+    check_deadline()                 # raises QueryTimeoutError when past
+
+The deadline is thread-local: worker threads serving other queries are
+unaffected.  Scopes nest — an inner scope can only tighten the deadline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from .errors import QueryTimeoutError
+
+_local = threading.local()
+
+
+def current_deadline() -> float | None:
+    """The active absolute deadline (time.monotonic seconds), or None."""
+    return getattr(_local, "deadline", None)
+
+
+def check_deadline():
+    """Raise QueryTimeoutError when the active deadline has passed."""
+    d = getattr(_local, "deadline", None)
+    if d is not None and time.monotonic() > d:
+        raise QueryTimeoutError(
+            f"query exceeded its {getattr(_local, 'seconds', 0.0):.1f}s deadline"
+        )
+
+
+def propagate(fn):
+    """Wrap a callable about to run on ANOTHER thread (pool workers) so it
+    sees this thread's deadline: thread-locals don't cross pool.map, which
+    would silently disarm the deadline on exactly the multi-region scan
+    paths it exists to bound."""
+    d = getattr(_local, "deadline", None)
+    s = getattr(_local, "seconds", None)
+    if d is None:
+        return fn
+
+    def wrapped(*args, **kwargs):
+        prev = getattr(_local, "deadline", None)
+        prev_s = getattr(_local, "seconds", None)
+        _local.deadline = d if prev is None else min(prev, d)
+        _local.seconds = s
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _local.deadline = prev
+            _local.seconds = prev_s
+
+    return wrapped
+
+
+@contextlib.contextmanager
+def deadline_scope(seconds: float | None):
+    """Bound the enclosed work to `seconds` of wall clock.  None or <= 0
+    leaves any outer deadline in force.  Nested scopes only tighten."""
+    if not seconds or seconds <= 0:
+        yield
+        return
+    prev = getattr(_local, "deadline", None)
+    prev_s = getattr(_local, "seconds", None)
+    new = time.monotonic() + seconds
+    _local.deadline = new if prev is None else min(prev, new)
+    _local.seconds = seconds if prev is None else min(prev_s or seconds, seconds)
+    try:
+        yield
+    finally:
+        _local.deadline = prev
+        _local.seconds = prev_s

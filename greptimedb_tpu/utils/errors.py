@@ -125,6 +125,15 @@ class StorageError(GreptimeError):
     code = StatusCode.STORAGE_UNAVAILABLE
 
 
+class QueryTimeoutError(GreptimeError):
+    """A statement exceeded its cooperative deadline (utils/deadline.py).
+    Deliberately NOT retried on the CPU fallback path — the deadline has
+    already passed, and the fallback is exactly the unbounded scan the
+    deadline exists to stop."""
+
+    code = StatusCode.ENGINE_EXECUTE_QUERY
+
+
 class ConfigError(GreptimeError):
     """Invalid or unsupported configuration value."""
 

@@ -220,6 +220,88 @@ def test_window_tile_engages_and_matches(db, monkeypatch):
     assert metrics.TILE_WINDOW_BUILDS.get() == builds + 1
 
 
+def test_window_tile_extends_with_new_columns(db, monkeypatch):
+    """A wider query over the SAME window must EXTEND the cached window
+    tile with the new columns and stay on the tile path.  Round 4 rebuilt
+    the tile, then DISCARDED the rebuild in its race branch — the returned
+    sources lacked the new columns, so every multi-column query after a
+    narrower one over the same window fell back to the CPU scan (the
+    round-4 driver-bench timeout: TSBS double-groupby-5 'warm' at 55 s)."""
+    import numpy as np
+
+    from greptimedb_tpu.parallel.tile_cache import TileCacheManager
+
+    monkeypatch.setattr(TileCacheManager, "_WINDOW_TILE_MIN_ROWS", 1 << 14)
+    _mk_cpu_table(db)
+    n = 1 << 16
+    hosts = np.repeat([f"h{i}" for i in range(8)], n // 8)
+    ts = np.tile(np.arange(n // 8, dtype=np.int64) * 1000, 8)
+    rng = np.random.default_rng(5)
+    db.insert_rows("cpu", pa.table({
+        "host": pa.array(hosts),
+        "region": pa.array(np.repeat("r0", n)),
+        "ts": pa.array(ts, pa.timestamp("ms")),
+        "usage_user": pa.array(rng.uniform(0, 100, n)),
+        "usage_system": pa.array(rng.uniform(0, 100, n)),
+    }))
+    db.sql("ADMIN flush_table('cpu')")
+    w = " WHERE ts >= 1000000 AND ts < 2000000"
+    q1 = f"SELECT host, avg(usage_user) AS a FROM cpu{w} GROUP BY host"
+    q2 = (f"SELECT host, avg(usage_user) AS a, avg(usage_system) AS b,"
+          f" count(*) AS c FROM cpu{w} GROUP BY host")
+    builds = metrics.TILE_WINDOW_BUILDS.get()
+    db.sql_one(q1)  # builds the narrow window tile
+    assert metrics.TILE_WINDOW_BUILDS.get() == builds + 1
+    # the wider query must NOT fall back: surface any tile-path error
+    db.config.query.fallback_to_cpu = False
+    before = _tile_count()
+    try:
+        t1 = db.sql_one(q2)
+    finally:
+        db.config.query.fallback_to_cpu = True
+    assert _tile_count() == before + 1, "wider query left the tile path"
+    try:
+        db.config.query.backend = "cpu"
+        t2 = db.sql_one(q2)
+    finally:
+        db.config.query.backend = "tpu"
+    s1 = t1.sort_by("host").to_pydict()
+    s2 = t2.sort_by("host").to_pydict()
+    assert s1["host"] == s2["host"] and s1["c"] == s2["c"]
+    import numpy as _np
+
+    _np.testing.assert_allclose(s1["a"], s2["a"], rtol=1e-7)
+    _np.testing.assert_allclose(s1["b"], s2["b"], rtol=1e-7)
+    # and the now-complete tile serves the narrow query without a rebuild
+    builds2 = metrics.TILE_WINDOW_BUILDS.get()
+    db.sql_one(q1)
+    db.sql_one(q2)
+    assert metrics.TILE_WINDOW_BUILDS.get() == builds2
+
+
+def test_query_deadline_aborts_cpu_scan(db):
+    """query.timeout_s bounds a statement cooperatively: a CPU-path scan
+    past its deadline raises QueryTimeoutError instead of grinding (the
+    round-4 driver bench died in an unbounded Python parquet scan)."""
+    from greptimedb_tpu.utils.errors import QueryTimeoutError
+
+    _mk_cpu_table(db)
+    _load(db, ticks=30)
+    db.sql("ADMIN flush_table('cpu')")
+    db.config.query.backend = "cpu"
+    db.config.query.timeout_s = 1e-9
+    try:
+        with pytest.raises(QueryTimeoutError):
+            db.sql_one("SELECT host, count(*) AS c FROM cpu GROUP BY host")
+    finally:
+        db.config.query.timeout_s = 0.0
+        db.config.query.backend = "tpu"
+    # disabled again: the same query serves fine
+    assert db.sql_one(
+        "SELECT host, count(*) AS c FROM cpu GROUP BY host"
+    ).num_rows > 0
+
+
 def test_limb_kernel_with_mixed_source_sizes(db):
     """A flushed chunk large enough for the MXU limb kernel merged with a
     tiny memtable tail: both sources must emit structurally identical
