@@ -381,6 +381,16 @@ class MetricEngine:
         """Inject __table_id/__tsid and write into the data region
         (reference row_modifier.rs + engine/put.rs)."""
         phys_meta = self.db.catalog.table(meta.options[LOGICAL_TABLE_OPT], meta.database)
+        # SNAPSHOT the physical schema once: concurrent logical-table
+        # creation widens the physical table by REPLACING phys_meta.schema
+        # (_ensure_physical_labels under _ddl_lock), and round 4 read it
+        # twice — once to build the arrays, once in from_arrays — so a
+        # widen in between raised "Schema and number of arrays unequal"
+        # on the Prometheus ingest hot path.  A consistent old-schema
+        # batch is always safe: the region's read path null-fills columns
+        # a batch predates (_compat_cast), matching the reference's
+        # serialized logical DDL (metric-engine/src/engine.rs:58-90).
+        phys_schema = phys_meta.schema
         label_cols = [c.name for c in meta.schema.tag_columns()]
         n = batch.num_rows
         # Map logical ts/value columns onto the physical pair by semantic
@@ -413,7 +423,7 @@ class MetricEngine:
         # (schemas share them); absent physical labels become nulls.
         by_name = {batch.schema.field(i).name: batch.column(i) for i in range(batch.num_columns)}
         arrays = []
-        for col in phys_meta.schema.columns:
+        for col in phys_schema.columns:
             source = remap.get(col.name, col.name)
             if col.name == TABLE_ID_COL:
                 arrays.append(pa.array([meta.table_id] * n, pa.int64()))
@@ -427,7 +437,7 @@ class MetricEngine:
                 arrays.append(arr)
             else:
                 arrays.append(pa.nulls(n, col.data_type.to_arrow()))
-        phys_batch = pa.RecordBatch.from_arrays(arrays, schema=phys_meta.schema.to_arrow())
+        phys_batch = pa.RecordBatch.from_arrays(arrays, schema=phys_schema.to_arrow())
         return self.db.write_batch(phys_meta, phys_batch)
 
     # ---- read path --------------------------------------------------------

@@ -581,23 +581,32 @@ class TileCacheManager:
         ]
 
     def _evict_locked(self, pinned_regions: set[int]):
-        # limb planes are re-derivable from the resident f64 planes in a
-        # few ms — strip them first so whole super-tiles (whose rebuild
-        # costs a Parquet decode + upload) survive longer
-        if self._used > self.budget:
-            for entry in list(self._super.values()):
+        # Re-derivable planes strip FIRST, and INCREMENTALLY — per limb
+        # column, then per window tile — stopping as soon as the budget
+        # holds.  Round 4 cleared every limb plane and window tile of an
+        # entry at once, so one over-budget allocation evicted every warm
+        # query family's working set and the next query of each family
+        # paid a full rebuild (the per-family churn behind the 72 h bench
+        # blowup).  Limb planes cost a few ms of device quantize to
+        # rebuild; window tiles cost a host gather + upload (seconds);
+        # whole super-tiles cost a Parquet decode — evict in that order.
+        for entry in list(self._super.values()):
+            for key in list(entry.limb_cols):
                 if self._used <= self.budget:
                     break
                 freed = sum(
-                    sum(int(l.nbytes) + int(s.nbytes) for l, s in chunks)
-                    for chunks in entry.limb_cols.values()
+                    int(l.nbytes) + int(s.nbytes)
+                    for l, s in entry.limb_cols.pop(key)
                 )
-                freed += sum(wt["nbytes"] for wt in entry.window_tiles.values())
-                if freed:
-                    entry.limb_cols.clear()
-                    entry.window_tiles.clear()
-                    entry.nbytes -= freed
-                    self._used -= freed
+                entry.nbytes -= freed
+                self._used -= freed
+        for entry in list(self._super.values()):
+            for key in list(entry.window_tiles):
+                if self._used <= self.budget:
+                    break
+                freed = entry.window_tiles.pop(key)["nbytes"]
+                entry.nbytes -= freed
+                self._used -= freed
         while self._used > self.budget and len(self._super) > len(pinned_regions):
             for rid in list(self._super):
                 if rid not in pinned_regions:

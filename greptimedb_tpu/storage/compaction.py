@@ -25,7 +25,7 @@ import pyarrow as pa
 
 from .memtable import _SEQ_COL, _sort_and_dedup
 from .region import Region, _undict
-from .sst import FileMeta
+from .sst import FileMeta, interleaved_overlap_unsafe
 
 # Parquet bytes expand roughly this much when decoded for the merge.
 _DECODE_FACTOR = 4
@@ -263,6 +263,36 @@ def compact_files(region: Region, group: list[FileMeta]) -> FileMeta | None:
     return region.sst_writer.write(merged, level=1)
 
 
+def widen_for_order(
+    sub: list[FileMeta], all_files: list[FileMeta], pos: dict[str, int]
+) -> list[FileMeta]:
+    """Grow an order-unsafe merge group to its safe closure: while a file
+    outside the group both time-overlaps a member and sits between the
+    group's manifest positions (interleaved_overlap_unsafe — one output
+    position cannot rank it correctly), pull it INTO the group.  The
+    closure always exists (at worst every file between min and max
+    position joins) and merging it preserves last-write-wins, so refused
+    picks never starve — they merge with their interleaved overwrites
+    included instead of waiting for a round that may never come."""
+    cur = {f.file_id: f for f in sub}
+    changed = True
+    while changed:
+        changed = False
+        ps = sorted(pos[fid] for fid in cur)
+        lo, hi = ps[0], ps[-1]
+        for x in all_files:
+            if x.file_id in cur or not (lo < pos[x.file_id] < hi):
+                continue
+            if any(
+                x.time_range[1] >= g.time_range[0]
+                and x.time_range[0] <= g.time_range[1]
+                for g in cur.values()
+            ):
+                cur[x.file_id] = x
+                changed = True
+    return sorted(cur.values(), key=lambda m: pos[m.file_id])
+
+
 def compact_region(
     region: Region,
     window_ms: int | None = None,
@@ -291,6 +321,19 @@ def compact_region(
             # count still drops even when one pass can't merge everything
             for sub in split_group_for_memory(group, gate.budget):
                 sub = sorted(sub, key=lambda m: manifest_pos[m.file_id])
+                if not region.append_mode and interleaved_overlap_unsafe(
+                    sub, files, manifest_pos
+                ):
+                    # a partial merge here would resurrect overwritten
+                    # values — widen to the safe closure (pulls the
+                    # interleaved overwrites into the merge) instead of
+                    # skipping, so refused picks never starve
+                    sub = widen_for_order(sub, files, manifest_pos)
+                    if (
+                        sum(f.file_size for f in sub) * _DECODE_FACTOR
+                        > gate.budget
+                    ):
+                        continue  # closure too big this round
                 est = min(
                     sum(f.file_size for f in sub) * _DECODE_FACTOR, gate.budget
                 )
@@ -300,6 +343,11 @@ def compact_region(
                 finally:
                     gate.release(est)
                 adds = [new_meta] if new_meta is not None else []
-                region.apply_compaction(adds, [f.file_id for f in sub])
-                done += 1
+                if region.apply_compaction(adds, [f.file_id for f in sub]):
+                    done += 1
+                elif new_meta is not None:
+                    # commit refused (a flush interleaved an overlapping
+                    # file mid-merge): the output must not enter the
+                    # manifest — discard it and retry a later round
+                    region.sst_reader.delete(new_meta.file_id)
         return done

@@ -119,9 +119,31 @@ class ManifestManager:
         if kind == "change":
             m.schema = Schema.from_json(action["schema"])
         elif kind == "edit":
-            for fd in action.get("files_to_add", []):
-                meta = FileMeta.from_dict(fd)
-                m.files[meta.file_id] = meta
+            anchor = action.get("insert_at")
+            if anchor is not None and anchor in m.files:
+                # ordered insertion (compaction): the merged output takes
+                # the manifest position of its NEWEST input, so files
+                # flushed DURING the merge stay newer than it — scans rank
+                # duplicate (pk, ts) versions by manifest position, and an
+                # appended output would beat data that overwrote its
+                # inputs mid-compaction.  Dict rebuild preserves replay
+                # determinism (the anchor rides the persisted action).
+                rebuilt: dict[str, FileMeta] = {}
+                removes = set(action.get("files_to_remove", []))
+                for k, v in m.files.items():
+                    if k == anchor:
+                        # adds insert AT the anchor's slot (before it, if
+                        # the anchor itself survives the edit)
+                        for fd in action.get("files_to_add", []):
+                            meta = FileMeta.from_dict(fd)
+                            rebuilt[meta.file_id] = meta
+                    if k not in removes:
+                        rebuilt[k] = v
+                m.files = rebuilt
+            else:
+                for fd in action.get("files_to_add", []):
+                    meta = FileMeta.from_dict(fd)
+                    m.files[meta.file_id] = meta
             for fid in action.get("files_to_remove", []):
                 m.files.pop(fid, None)
             if action.get("flushed_entry_id") is not None:

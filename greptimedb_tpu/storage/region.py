@@ -276,13 +276,43 @@ class Region:
         return added
 
     # ---- compaction hook (files swapped by CompactionScheduler) -----------
-    def apply_compaction(self, files_to_add: list[FileMeta], files_to_remove: list[str]):
+    def apply_compaction(
+        self, files_to_add: list[FileMeta], files_to_remove: list[str]
+    ) -> bool:
+        """Commit a compaction edit.  The output is INSERTED at the newest
+        input's manifest position (not appended): flushes landing DURING
+        the merge stay newer, so last-write-wins order — which scans judge
+        by manifest position — survives concurrent overwrites.  Returns
+        False (caller discards the output) when the commit would be
+        unsound: an input vanished, or a file outside the group that
+        time-overlaps an input sits BETWEEN input positions — one output
+        position cannot rank above its older inputs yet below such an
+        interleaved outsider (the reference dedups by persisted per-row
+        sequences instead; mito2/src/read/dedup.rs)."""
         with self._lock:
+            order = list(self.manifest_mgr.manifest.files)
+            pos = {fid: i for i, fid in enumerate(order)}
+            metas = self.manifest_mgr.manifest.files
+            in_pos = sorted(
+                pos[fid] for fid in files_to_remove if fid in pos
+            )
+            if len(in_pos) != len(files_to_remove):
+                return False  # an input left the manifest mid-merge
+            anchor = order[in_pos[-1]] if in_pos else None
+            if not self.append_mode and len(in_pos) > 1:
+                from .sst import interleaved_overlap_unsafe
+
+                inputs = [metas[fid] for fid in files_to_remove]
+                if interleaved_overlap_unsafe(
+                    inputs, list(metas.values()), pos
+                ):
+                    return False
             self.manifest_mgr.apply(
                 {
                     "kind": "edit",
                     "files_to_add": [m.to_dict() for m in files_to_add],
                     "files_to_remove": files_to_remove,
+                    "insert_at": anchor,
                 }
             )
             # Defer physical deletion: in-flight scans may hold the old file
@@ -292,6 +322,7 @@ class Region:
             )
             self._purge_garbage_locked()
         metrics.COMPACTION_TOTAL.inc()
+        return True
 
     def _purge_garbage_locked(self):
         if self._active_scans > 0 or not self._garbage_files:

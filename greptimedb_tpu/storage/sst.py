@@ -84,6 +84,36 @@ class FileMeta:
         )
 
 
+def interleaved_overlap_unsafe(
+    inputs: list[FileMeta],
+    all_files: list[FileMeta],
+    pos: dict[str, int],
+) -> bool:
+    """True when merging `inputs` cannot express last-write-wins with ONE
+    output manifest position: some file outside the group both
+    time-overlaps an input (so they may share (pk, ts) keys) and sits
+    BETWEEN the group's manifest positions (so it is newer than some
+    inputs and older than others).  Shared by the compaction picker and
+    the commit gate in Region.apply_compaction — the two must never
+    diverge (scans rank duplicate versions by manifest position; the
+    reference persists per-row sequences instead, mito2/src/read/dedup.rs)."""
+    in_ids = {f.file_id for f in inputs}
+    ps = sorted(pos[f.file_id] for f in inputs)
+    if len(ps) <= 1:
+        return False
+    lo, hi = ps[0], ps[-1]
+    for x in all_files:
+        if x.file_id in in_ids or not (lo < pos.get(x.file_id, -1) < hi):
+            continue
+        for g in inputs:
+            if (
+                x.time_range[1] >= g.time_range[0]
+                and x.time_range[0] <= g.time_range[1]
+            ):
+                return True
+    return False
+
+
 @dataclass
 class ScanPredicate:
     """Pushed-down predicates the reader can use for pruning: a time range
