@@ -322,9 +322,16 @@ def cmd_metasrv(args):
     import time as _time
 
     while not stop.is_set():
-        election.campaign()
-        if metasrv.is_leader():
-            metasrv.tick(_time.time() * 1000)
+        try:
+            election.campaign()
+            if metasrv.is_leader():
+                metasrv.tick(_time.time() * 1000)
+        except Exception:  # noqa: BLE001 — supervision must outlive one bad tick
+            import logging as _logging
+
+            _logging.getLogger("greptimedb_tpu.metasrv").warning(
+                "supervisor tick failed; retrying", exc_info=True
+            )
         stop.wait(1.0)
     server.stop()
     return 0

@@ -619,66 +619,7 @@ class Database:
                     meta.options.pop(k, None)
                 self.catalog.update_table(meta)
                 return None
-            schema = meta.schema
-            if stmt.action == "add_columns":
-                for cd in stmt.add_columns:
-                    if cd.is_time_index or cd.is_primary_key:
-                        raise InvalidArgumentsError(
-                            "only FIELD columns can be added (tags are part "
-                            "of the primary key; the time index is fixed)"
-                        )
-                    schema = schema.add_column(
-                        ColumnSchema(
-                            name=cd.name,
-                            data_type=ConcreteDataType.parse(cd.type_name),
-                            semantic_type=SemanticType.FIELD,
-                            nullable=True,
-                            default=cd.default,
-                        )
-                    )
-            elif stmt.action == "drop_columns":
-                for name in stmt.drop_columns:
-                    schema = schema.drop_column(name)
-            elif stmt.action == "modify_columns":
-                for name, tname in stmt.modify_columns:
-                    col = schema.column(name)
-                    if col.semantic_type != SemanticType.FIELD:
-                        raise InvalidArgumentsError(
-                            f"only FIELD columns can change type: {name!r}"
-                        )
-                    new_dt = ConcreteDataType.parse(tname)
-                    old_dt = col.data_type
-                    castable = (
-                        (old_dt.is_numeric() and new_dt.is_numeric())
-                        or new_dt == ConcreteDataType.STRING
-                        or old_dt == new_dt
-                    )
-                    if not castable:
-                        # existing SST data must remain scannable: only
-                        # lossless-ish casts are allowed (the reference
-                        # rejects incompatible modify the same way)
-                        raise InvalidArgumentsError(
-                            f"cannot change column {name!r} from "
-                            f"{old_dt.value} to {new_dt.value}"
-                        )
-                    new_cols = [
-                        ColumnSchema(
-                            name=c.name,
-                            data_type=new_dt if c.name == name else c.data_type,
-                            semantic_type=c.semantic_type,
-                            nullable=c.nullable,
-                            default=c.default,
-                            column_id=c.column_id,  # type change keeps identity
-                        )
-                        for c in schema.columns
-                    ]
-                    schema = Schema(
-                        columns=new_cols,
-                        version=schema.version + 1,
-                        next_column_id=schema.next_column_id,
-                    )
-            else:
-                raise UnsupportedError(f"unsupported ALTER action: {stmt.action}")
+            schema = compute_altered_schema(stmt, meta.schema)
             # regions first, catalog publish second (same ordering rationale
             # as pipeline widening: queries never see columns regions lack)
             for rid in meta.region_ids:
@@ -776,16 +717,50 @@ class Database:
         if any(not schema.has_column(c) for c in columns):
             bad = [c for c in columns if not schema.has_column(c)]
             raise InvalidArgumentsError(f"unknown columns in INSERT: {bad}")
+        if getattr(stmt, "query", None) is not None:
+            # INSERT INTO ... SELECT: source columns map POSITIONALLY onto
+            # the target column list (SQL semantics; reference inserter
+            # does the same through its logical plan)
+            result = self.query_engine.execute_select(
+                stmt.query, self.current_database
+            )
+            if result.num_columns != len(columns):
+                raise InvalidArgumentsError(
+                    f"INSERT ... SELECT column count mismatch: target has "
+                    f"{len(columns)}, query returned {result.num_columns}"
+                )
+            by_name = {
+                c: result.column(i).combine_chunks()
+                for i, c in enumerate(columns)
+            }
+            n_rows = result.num_rows
+        else:
+            by_name = {
+                c: [row[i] for row in stmt.rows] for i, c in enumerate(columns)
+            }
+            n_rows = len(stmt.rows)
         arrays = []
         fields = []
-        by_name = {c: [row[i] for row in stmt.rows] for i, c in enumerate(columns)}
         for col in schema.columns:
             field = col.to_arrow()
             if col.name in by_name:
                 values = by_name[col.name]
             else:
-                values = [col.default] * len(stmt.rows)
-            arrays.append(_coerce_array(values, col))
+                values = [col.default] * n_rows
+            if isinstance(values, (pa.Array, pa.ChunkedArray)):
+                # INSERT ... SELECT source: already typed, just cast
+                arr = (
+                    values
+                    if values.type == field.type
+                    else values.cast(field.type)
+                )
+                arrays.append(
+                    arr.combine_chunks()
+                    if isinstance(arr, pa.ChunkedArray)
+                    else arr
+                )
+            else:
+                arrays.append(_coerce_array(values, col))
             fields.append(field)
         batch = pa.RecordBatch.from_arrays(arrays, schema=schema.to_arrow())
         return self.write_batch(meta, batch)
@@ -1408,6 +1383,73 @@ def _coerce_array(values: list, col: ColumnSchema) -> pa.Array:
                 coerced.append(None if v is None else int(v))
         return pa.array(coerced, t)
     return pa.array(values, t)
+
+
+def compute_altered_schema(stmt, schema: Schema) -> Schema:
+    """Schema transform for ALTER TABLE add/drop/modify columns — shared
+    by the standalone Database and the distributed Frontend so the two
+    roles can never diverge on ALTER semantics."""
+    if stmt.action == "add_columns":
+        for cd in stmt.add_columns:
+            if cd.is_time_index or cd.is_primary_key:
+                raise InvalidArgumentsError(
+                    "only FIELD columns can be added (tags are part "
+                    "of the primary key; the time index is fixed)"
+                )
+            schema = schema.add_column(
+                ColumnSchema(
+                    name=cd.name,
+                    data_type=ConcreteDataType.parse(cd.type_name),
+                    semantic_type=SemanticType.FIELD,
+                    nullable=True,
+                    default=cd.default,
+                )
+            )
+        return schema
+    if stmt.action == "drop_columns":
+        for name in stmt.drop_columns:
+            schema = schema.drop_column(name)
+        return schema
+    if stmt.action == "modify_columns":
+        for name, tname in stmt.modify_columns:
+            col = schema.column(name)
+            if col.semantic_type != SemanticType.FIELD:
+                raise InvalidArgumentsError(
+                    f"only FIELD columns can change type: {name!r}"
+                )
+            new_dt = ConcreteDataType.parse(tname)
+            old_dt = col.data_type
+            castable = (
+                (old_dt.is_numeric() and new_dt.is_numeric())
+                or new_dt == ConcreteDataType.STRING
+                or old_dt == new_dt
+            )
+            if not castable:
+                # existing SST data must remain scannable: only
+                # lossless-ish casts are allowed (the reference
+                # rejects incompatible modify the same way)
+                raise InvalidArgumentsError(
+                    f"cannot change column {name!r} from "
+                    f"{old_dt.value} to {new_dt.value}"
+                )
+            new_cols = [
+                ColumnSchema(
+                    name=c.name,
+                    data_type=new_dt if c.name == name else c.data_type,
+                    semantic_type=c.semantic_type,
+                    nullable=c.nullable,
+                    default=c.default,
+                    column_id=c.column_id,  # type change keeps identity
+                )
+                for c in schema.columns
+            ]
+            schema = Schema(
+                columns=new_cols,
+                version=schema.version + 1,
+                next_column_id=schema.next_column_id,
+            )
+        return schema
+    raise UnsupportedError(f"unsupported ALTER action: {stmt.action}")
 
 
 def _conform_batch(batch: pa.RecordBatch, schema: Schema) -> pa.RecordBatch:

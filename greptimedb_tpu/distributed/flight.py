@@ -182,6 +182,19 @@ class DatanodeFlightServer(fl.FlightServerBase):
                 lo = r[0] if lo is None else min(lo, r[0])
                 hi = r[1] if hi is None else max(hi, r[1])
             out = {"bounds": None if lo is None else [lo, hi]}
+        elif kind == "truncate_region":
+            self.engine.truncate_region(body["region_id"])
+            out = {"ok": True}
+        elif kind == "delete_rows":
+            # key batch rides as base64 Arrow IPC (small by construction:
+            # only matched primary keys + timestamps ship)
+            import base64
+            import io
+
+            buf = base64.b64decode(body["ipc"])
+            with pa.ipc.open_stream(io.BytesIO(buf)) as rd:
+                keys = rd.read_all()
+            out = {"deleted": self.engine.delete(body["region_id"], keys)}
         elif kind == "health":
             out = {"ok": True}
         else:
@@ -233,6 +246,21 @@ class FlightDatanodeClient:
 
     def set_region_writable(self, rid: int, writable: bool):
         self._action("set_region_writable", {"region_id": rid, "writable": writable})
+
+    def truncate_region(self, rid: int):
+        self._action("truncate_region", {"region_id": rid})
+
+    def delete_rows(self, rid: int, keys: pa.Table) -> int:
+        import base64
+        import io
+
+        sink = io.BytesIO()
+        with pa.ipc.new_stream(sink, keys.schema) as w:
+            w.write_table(keys)
+        return self._action(
+            "delete_rows",
+            {"region_id": rid, "ipc": base64.b64encode(sink.getvalue()).decode()},
+        )["deleted"]
 
     def alter_region(self, rid: int, schema: Schema):
         self._action("alter_region", {"region_id": rid, "schema": schema.to_json()})

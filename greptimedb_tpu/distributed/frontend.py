@@ -34,12 +34,15 @@ from ..models.catalog import Catalog
 from ..query.engine import QueryEngine
 from ..query.logical_plan import TableScan
 from ..query.sql_parser import (
+    AlterTableStmt,
     CreateTableStmt,
+    DeleteStmt,
     DescribeStmt,
     DropStmt,
     InsertStmt,
     SelectStmt,
     ShowStmt,
+    TruncateStmt,
     UseStmt,
     parse_sql,
 )
@@ -163,9 +166,79 @@ class Frontend:
         if isinstance(stmt, UseStmt):
             self.current_database = stmt.database
             return None
+        if isinstance(stmt, AlterTableStmt):
+            return self._alter(stmt)
+        if isinstance(stmt, DeleteStmt):
+            return self._delete(stmt)
+        if isinstance(stmt, TruncateStmt):
+            return self._truncate(stmt)
         raise UnsupportedError(
             f"the distributed frontend does not support {type(stmt).__name__} yet"
         )
+
+    def _alter(self, stmt: AlterTableStmt):
+        """ALTER through the frontend: regions first (fan alter_region over
+        Flight), catalog publish second — queries never see columns the
+        regions lack (same ordering as the standalone Database._alter and
+        the reference's alter procedure, common/meta/src/ddl/alter_table.rs)."""
+        from ..database import compute_altered_schema
+
+        meta = self._table(stmt.table, self.current_database)
+        if stmt.action == "rename":
+            self.catalog.rename_table(
+                stmt.table, stmt.new_name, self.current_database
+            )
+            return None
+        schema = compute_altered_schema(stmt, meta.schema)
+        routes = self.meta.get_route(meta.table_id)
+        for rid in meta.region_ids:
+            node = self._routed(routes, rid, meta)
+            self._with_client(node, lambda c, _r=rid: c.alter_region(_r, schema))
+        meta.schema = schema
+        self.catalog.update_table(meta)
+        return None
+
+    def _delete(self, stmt: DeleteStmt) -> int:
+        """DELETE: resolve matching keys through the distributed query
+        engine, split by the partition rule, tombstone per region over
+        Flight (reference operator/src/delete.rs routes deletes like
+        inserts)."""
+        from ..query.expr import Column
+
+        meta = self._table(stmt.table, self.current_database)
+        proj = [c.name for c in meta.schema.tag_columns()]
+        if meta.schema.time_index is not None:
+            proj.append(meta.schema.time_index.name)
+        if not proj:
+            raise UnsupportedError("DELETE requires a table with keys")
+        sel = SelectStmt(
+            projections=[Column(c) for c in proj],
+            table=stmt.table,
+            where=stmt.where,
+        )
+        keys = self.query_engine.execute_select(sel, self.current_database)
+        if keys.num_rows == 0:
+            return 0
+        routes = self.meta.get_route(meta.table_id)
+        deleted = 0
+        region_ids = meta.region_ids
+        for i, part in enumerate(meta.partition_rule.split(keys)):
+            if not part.num_rows:
+                continue
+            rid = region_ids[i]
+            node = self._routed(routes, rid, meta)
+            deleted += self._with_client(
+                node, lambda c, _r=rid, _p=part: c.delete_rows(_r, _p)
+            )
+        return deleted
+
+    def _truncate(self, stmt: TruncateStmt):
+        meta = self._table(stmt.table, self.current_database)
+        routes = self.meta.get_route(meta.table_id)
+        for rid in meta.region_ids:
+            node = self._routed(routes, rid, meta)
+            self._with_client(node, lambda c, _r=rid: c.truncate_region(_r))
+        return None
 
     # ---- DDL ---------------------------------------------------------------
     def _create_table(self, stmt: CreateTableStmt):
@@ -240,11 +313,41 @@ class Frontend:
         if any(not schema.has_column(c) for c in columns):
             bad = [c for c in columns if not schema.has_column(c)]
             raise InvalidArgumentsError(f"unknown columns in INSERT: {bad}")
-        by_name = {c: [row[i] for row in stmt.rows] for i, c in enumerate(columns)}
+        if getattr(stmt, "query", None) is not None:
+            # INSERT ... SELECT through the distributed query engine:
+            # source columns map positionally (same as Database._insert —
+            # the two roles must not diverge)
+            result = self.query_engine.execute_select(
+                stmt.query, self.current_database
+            )
+            if result.num_columns != len(columns):
+                raise InvalidArgumentsError(
+                    f"INSERT ... SELECT column count mismatch: target has "
+                    f"{len(columns)}, query returned {result.num_columns}"
+                )
+            by_name = {
+                c: result.column(i).combine_chunks()
+                for i, c in enumerate(columns)
+            }
+            n_rows = result.num_rows
+        else:
+            by_name = {
+                c: [row[i] for row in stmt.rows] for i, c in enumerate(columns)
+            }
+            n_rows = len(stmt.rows)
         arrays = []
         for col in schema.columns:
-            values = by_name.get(col.name, [col.default] * len(stmt.rows))
-            arrays.append(_coerce_array(values, col))
+            values = by_name.get(col.name, [col.default] * n_rows)
+            if isinstance(values, (pa.Array, pa.ChunkedArray)):
+                want = col.data_type.to_arrow()
+                arr = values if values.type == want else values.cast(want)
+                arrays.append(
+                    arr.combine_chunks()
+                    if isinstance(arr, pa.ChunkedArray)
+                    else arr
+                )
+            else:
+                arrays.append(_coerce_array(values, col))
         batch = pa.RecordBatch.from_arrays(arrays, schema=schema.to_arrow())
         return self.write_batch(meta, batch)
 
@@ -352,6 +455,8 @@ class Frontend:
         )
 
     def _scan(self, scan: TableScan) -> pa.Table:
+        if not scan.table:
+            return pa.table({"__dummy": [0]})  # constant SELECTs (UNION arms)
         tables = [t for t in self._region_scan(scan) if t.num_rows]
         meta = self._table(scan.table, scan.database)
         if not tables:

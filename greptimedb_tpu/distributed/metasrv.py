@@ -12,10 +12,11 @@ region_migration/), and places new regions with a selector
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from dataclasses import dataclass, field
 
-from ..utils.errors import IllegalStateError
+from ..utils.errors import IllegalStateError, RetryLaterError
 from .failure_detector import PhiAccrualFailureDetector
 from .kv import KvBackend
 from .procedure import DONE, EXECUTING, Procedure, ProcedureManager
@@ -55,7 +56,12 @@ class RegionFailoverProcedure(Procedure):
         if step == "select_target":
             target = metasrv.select_datanode(exclude={self.state["from_node"]})
             if target is None:
-                raise IllegalStateError("no healthy datanode available for failover")
+                # transient: under load every node can look dead for a
+                # beat (missed heartbeats) — retry, and if retries
+                # exhaust, the supervisor tick re-submits for any region
+                # still routed to a dead node, so failover converges once
+                # a survivor heartbeats again
+                raise RetryLaterError("no healthy datanode available for failover")
             self.state["to_node"] = target
             self.state["step"] = "open_candidate"
             return EXECUTING
@@ -319,20 +325,47 @@ class Metasrv:
             return []  # followers observe; only the leader supervises
         submitted = []
         with self._lock:
-            suspects = [
-                info
+            for info in self.datanodes.values():
+                if info.alive and not info.detector.is_available(now_ms):
+                    info.alive = False
+            # EVERY region still routed to a dead node needs failover —
+            # not just freshly-suspected nodes.  Round 4 submitted only on
+            # the alive->dead edge, so one poisoned procedure (e.g. both
+            # nodes transiently suspected under load -> no healthy target)
+            # orphaned the region forever; re-scanning each tick makes
+            # failover self-healing (reference RegionSupervisor re-detects
+            # the same way).
+            dead = [
+                info.node_id
                 for info in self.datanodes.values()
-                if info.alive and not info.detector.is_available(now_ms)
+                if not info.alive and info.role == "datanode"
             ]
-        for info in suspects:
-            info.alive = False
-            for table_id, region_id in self.regions_on(info.node_id):
+            any_healthy = any(
+                info.alive and info.role == "datanode"
+                for info in self.datanodes.values()
+            )
+        if not any_healthy:
+            # no failover target exists: submitting one synchronous,
+            # backoff-sleeping procedure per orphaned region would stall
+            # the supervisor loop past the election lease — skip this
+            # tick entirely and retry once a survivor heartbeats
+            return submitted
+        for node_id in dead:
+            for table_id, region_id in self.regions_on(node_id):
+                if self.procedures.lock_held(f"region/{region_id}"):
+                    continue  # a failover/migration is already running
                 proc = RegionFailoverProcedure(
                     state={
                         "region_id": region_id,
                         "table_id": table_id,
-                        "from_node": info.node_id,
+                        "from_node": node_id,
                     }
                 )
-                submitted.append(self.procedures.submit(proc))
+                try:
+                    submitted.append(self.procedures.submit(proc))
+                except Exception:  # noqa: BLE001 — retried next tick
+                    logging.getLogger("greptimedb_tpu.metasrv").warning(
+                        "failover of region %s off node %s failed; will retry",
+                        region_id, node_id, exc_info=True,
+                    )
         return submitted

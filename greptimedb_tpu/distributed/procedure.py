@@ -114,6 +114,12 @@ class ProcedureManager:
         self._registry[cls.type_name] = cls
         return cls
 
+    def lock_held(self, key: str) -> bool:
+        """True while some procedure holds this lock key (supervisor
+        re-scan uses it to avoid double-submitting failovers)."""
+        with self._lock:
+            return key in self._locks
+
     # ---- submission -------------------------------------------------------
     def submit(self, procedure: Procedure, procedure_id: str | None = None) -> str:
         """Run synchronously to completion (the reference runs async and

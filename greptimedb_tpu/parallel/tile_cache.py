@@ -83,6 +83,7 @@ from ..storage.dictionary import TableDictionary
 from ..storage.region import OP_COL, Region
 from ..storage.sst import FileMeta
 from ..utils import metrics
+from ..utils.deadline import check_deadline
 from .executor import (
     COUNT_STAR,
     DistGroupByPlan,
@@ -97,6 +98,29 @@ from .executor import (
 # Max rows per device chunk: one chunk's kernel working set fits HBM
 # comfortably even for 10-column programs (see _SuperTiles.cols).
 TILE_CHUNK_ROWS = 1 << 24
+
+# GRAFT_TILE_TIMING=1 prints per-phase wall times of the cold path (the
+# bench's second-process cold probe uses it to attribute cold latency)
+_TIMING = os.environ.get("GRAFT_TILE_TIMING") == "1"
+
+
+def _timed(phase: str):
+    """Context manager printing `phase took N ms` when timing is on."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if _TIMING:
+                print(
+                    f"TILE_TIMING {phase} {(time.perf_counter() - t0) * 1000:.0f}ms",
+                    flush=True,
+                )
+
+    return cm()
 
 
 def _chunk_bounds(pad: int, chunk_rows: int = TILE_CHUNK_ROWS) -> list[tuple[int, int]]:
@@ -759,7 +783,8 @@ class TileCacheManager:
                     region_id=rid, file_ids=ids,
                     num_rows=total, pad=padded_size(max(total, 1)),
                 )
-                self._try_load_persisted(entry)
+                with _timed("super.load_persisted"):
+                    self._try_load_persisted(entry)
             missing = [c for c in need if c not in entry.cols]
             if not missing and entry.valid is not None:
                 metrics.TILE_CACHE_HITS.inc()
@@ -780,6 +805,9 @@ class TileCacheManager:
                 # region is prepared
                 host_tiles = []
                 for meta in included:
+                    check_deadline()  # per-file Parquet decode + encode
+                    if _TIMING:
+                        print(f"TILE_TIMING super.host_tile.{meta.file_id[:8]} start", flush=True)
                     ht = self._file_host_tiles(
                         region, dictionary, meta, host_need, tag_cols + pk_cols, ts_col
                     )
@@ -792,6 +820,8 @@ class TileCacheManager:
                     for ht in host_tiles:
                         self._repair_host_locked(ht, dictionary)
 
+            if entry.order is None and _TIMING:
+                print("TILE_TIMING super.order start", flush=True)
             if entry.order is None:
                 # global (pk, ts) sort of the concatenation — lexsort keys
                 # are listed minor-to-major.  Code repair is a permutation
@@ -843,65 +873,30 @@ class TileCacheManager:
             with self._lock:
                 self._reserve_locked(est, pinned_regions | {rid})
 
-            added = 0
+            acc = [0]
             bounds = _chunk_bounds(entry.pad, self.chunk_rows)
-            if entry.valid is None:
-                v = np.zeros(entry.pad, bool)
-                v[: entry.num_rows] = True
-                entry.valid = self._up_chunks(v, bounds)
-                added += v.nbytes
-            for name in missing:
-                if host_tiles is None:
-                    buf = entry.persisted_cols[name]
-                    nbuf = entry.persisted_nulls.get(name)
-                else:
-                    src = next(
-                        (ht.cols[name] for ht in host_tiles if name in ht.cols), None
-                    )
-                    dtype = src.dtype if src is not None else np.float64
-                    cat = np.concatenate(
-                        [
-                            ht.cols[name]
-                            if name in ht.cols
-                            else np.zeros(ht.num_rows, dtype)
-                            for ht in host_tiles
-                        ]
-                    )
-                    buf = np.zeros(entry.pad, dtype=cat.dtype)
-                    buf[: entry.num_rows] = cat[entry.order]
-                    any_nulls = any(
-                        name in ht.nulls or name in ht.absent for ht in host_tiles
-                    )
-                    nbuf = None
-                    if any_nulls:
-                        ncat = np.concatenate(
-                            [
-                                ht.nulls[name]
-                                if name in ht.nulls
-                                else np.full(ht.num_rows, name not in ht.absent)
-                                for ht in host_tiles
-                            ]
-                        )
-                        nbuf = np.zeros(entry.pad, bool)
-                        nbuf[: entry.num_rows] = ncat[entry.order]
-                entry.cols[name] = self._up_chunks(buf, bounds)
-                added += buf.nbytes
-                if nbuf is not None:
-                    entry.nulls[name] = self._up_chunks(nbuf, bounds)
-                    added += nbuf.nbytes
-                if name in tag_cols or name in pk_cols:
-                    if host_tiles is None:
-                        # persisted codes keep their STORED epoch (repair
-                        # gathers them forward) — persisted_epochs, not
-                        # entry.epochs, is authoritative: release_unneeded
-                        # pops the latter, and restamping a re-upload with
-                        # the current epoch would skip the repair gather
-                        entry.epochs.setdefault(
-                            name,
-                            entry.persisted_epochs.get(name, dictionary.epoch),
-                        )
-                    else:
-                        entry.epochs[name] = dictionary.epoch
+            try:
+                if entry.valid is None:
+                    v = np.zeros(entry.pad, bool)
+                    v[: entry.num_rows] = True
+                    entry.valid = self._up_chunks(v, bounds)
+                    acc[0] += v.nbytes
+                self._upload_missing(
+                    entry, missing, host_tiles, bounds, acc,
+                    tag_cols, pk_cols, dictionary,
+                )
+            except BaseException:
+                # a deadline abort (or OOM) mid-loop must not leave the
+                # already-uploaded planes invisible to the budget: commit
+                # what landed before re-raising (a cache-hit entry is LIVE
+                # in self._super — uncharged planes would accumulate until
+                # the reserve-first eviction could no longer prevent OOM)
+                with self._lock:
+                    entry.nbytes += acc[0]
+                    if self._super.get(rid) is entry:
+                        self._used += acc[0]
+                raise
+            added = acc[0]
             entry.nbytes += added
             with self._lock:
                 old = self._super.pop(rid, None)
@@ -920,6 +915,70 @@ class TileCacheManager:
                 )
             return entry, excluded
         return None, list(metas)
+
+    def _upload_missing(
+        self, entry: _SuperTiles, missing, host_tiles, bounds, acc: list,
+        tag_cols, pk_cols, dictionary,
+    ):
+        """Consolidate + upload the missing columns of a super-tile entry.
+        Device bytes accumulate into acc[0] AS each plane lands, so the
+        caller can commit partial progress when a deadline abort unwinds
+        mid-loop (see super_tiles)."""
+        for name in missing:
+            check_deadline()  # per-column consolidate + upload
+            if _TIMING:
+                print(f"TILE_TIMING super.upload.{name} start", flush=True)
+            if host_tiles is None:
+                buf = entry.persisted_cols[name]
+                nbuf = entry.persisted_nulls.get(name)
+            else:
+                src = next(
+                    (ht.cols[name] for ht in host_tiles if name in ht.cols), None
+                )
+                dtype = src.dtype if src is not None else np.float64
+                cat = np.concatenate(
+                    [
+                        ht.cols[name]
+                        if name in ht.cols
+                        else np.zeros(ht.num_rows, dtype)
+                        for ht in host_tiles
+                    ]
+                )
+                buf = np.zeros(entry.pad, dtype=cat.dtype)
+                buf[: entry.num_rows] = cat[entry.order]
+                any_nulls = any(
+                    name in ht.nulls or name in ht.absent for ht in host_tiles
+                )
+                nbuf = None
+                if any_nulls:
+                    ncat = np.concatenate(
+                        [
+                            ht.nulls[name]
+                            if name in ht.nulls
+                            else np.full(ht.num_rows, name not in ht.absent)
+                            for ht in host_tiles
+                        ]
+                    )
+                    nbuf = np.zeros(entry.pad, bool)
+                    nbuf[: entry.num_rows] = ncat[entry.order]
+            entry.cols[name] = self._up_chunks(buf, bounds)
+            acc[0] += buf.nbytes
+            if nbuf is not None:
+                entry.nulls[name] = self._up_chunks(nbuf, bounds)
+                acc[0] += nbuf.nbytes
+            if name in tag_cols or name in pk_cols:
+                if host_tiles is None:
+                    # persisted codes keep their STORED epoch (repair
+                    # gathers them forward) — persisted_epochs, not
+                    # entry.epochs, is authoritative: release_unneeded
+                    # pops the latter, and restamping a re-upload with
+                    # the current epoch would skip the repair gather
+                    entry.epochs.setdefault(
+                        name,
+                        entry.persisted_epochs.get(name, dictionary.epoch),
+                    )
+                else:
+                    entry.epochs[name] = dictionary.epoch
 
     def repair_super(
         self,
@@ -1076,10 +1135,10 @@ class TileCacheManager:
         )
         with self._lock:
             self._reserve_locked(est, pinned_regions | {entry.region_id})
-        built_all = [
-            (c, [_quantize_limbs_jit(x) for x in chunks])
-            for c, chunks in to_build
-        ]
+        built_all = []
+        for c, chunks in to_build:
+            check_deadline()  # per-column quantize dispatches
+            built_all.append((c, [_quantize_limbs_jit(x) for x in chunks]))
         added = 0
         with self._lock:
             for c, built in built_all:
@@ -1225,17 +1284,19 @@ class TileCacheManager:
         # aborts on a concurrently-evicted host encode
         host_bufs: dict[str, tuple[np.ndarray, np.ndarray | None]] = {}
         for name in missing:
-            src = host_source(name)
-            if src is None:
-                return None  # host encode evicted mid-flight: scan path
-            buf = np.zeros(pad, dtype=src.dtype)
-            buf[:n] = src[idx]
-            nb = None
-            pres = entry.persisted_nulls.get(name)
-            if pres is not None:
-                nb = np.zeros(pad, bool)
-                nb[:n] = np.asarray(pres)[idx]
-            host_bufs[name] = (buf, nb)
+            check_deadline()  # 10-column gathers over 100M rows take seconds each
+            with _timed(f"wtile.gather.{name}"):
+                src = host_source(name)
+                if src is None:
+                    return None  # host encode evicted mid-flight: scan path
+                buf = np.zeros(pad, dtype=src.dtype)
+                buf[:n] = src[idx]
+                nb = None
+                pres = entry.persisted_nulls.get(name)
+                if pres is not None:
+                    nb = np.zeros(pad, bool)
+                    nb[:n] = np.asarray(pres)[idx]
+                host_bufs[name] = (buf, nb)
 
         # reserve what is ABOUT to allocate, counting every plane: f64
         # value + null planes for missing columns, limb digit planes
@@ -1257,10 +1318,13 @@ class TileCacheManager:
         nulls_dev: dict[str, list] = {}
         limbs_dev: dict[str, list] = {}
         for name in missing:
+            check_deadline()  # per-column upload + quantize is device-bound but slow
             buf, nb = host_bufs[name]
-            chunks = self._up_chunks(buf, bounds)
+            with _timed(f"wtile.upload.{name}"):
+                chunks = self._up_chunks(buf, bounds)
             if name in limb_build:
-                limbs_dev[name] = [_quantize_limbs_jit(x) for x in chunks]
+                with _timed(f"wtile.quantize.{name}"):
+                    limbs_dev[name] = [_quantize_limbs_jit(x) for x in chunks]
             # the f64 plane stays EVEN for limb columns: the exact-f64
             # rerun after a failed limb verdict, mixed min/max+avg
             # queries, and cache hits with a different limb set all read
@@ -1702,6 +1766,7 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
         merged = None
         target = None
         for cols, valid, nulls, perm, limbs in sources:
+            check_deadline()  # one dispatch per chunk source
             states = _partial(cols, valid, nulls, dyn, perm, limbs)
             leaves = jax.tree_util.tree_leaves(states)
             dev = next(iter(leaves[0].devices())) if leaves else None

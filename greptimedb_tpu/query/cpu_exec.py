@@ -496,7 +496,8 @@ class CpuExecutor:
     def _sort(self, plan: Sort, t: pa.Table) -> pa.Table:
         keys = []
         work = t
-        for e, asc in plan.keys:
+        nulls_spec = plan.nulls or [None] * len(plan.keys)
+        for (e, asc), nulls_first in zip(plan.keys, nulls_spec):
             inner = strip_alias(e)
             name = inner.name() if not isinstance(inner, Column) else inner.column
             if name not in work.column_names:
@@ -505,6 +506,20 @@ class CpuExecutor:
                 if isinstance(arr, pa.Scalar):
                     arr = pa.array([arr.as_py()] * work.num_rows)
                 work = work.append_column(name, arr)
+            # SQL default: NULLS LAST for ASC, NULLS FIRST for DESC
+            # (PostgreSQL/DataFusion; the reference inherits it).  Arrow
+            # only offers one global null_placement per sort call, so
+            # per-key placement rides an auxiliary is-null flag column
+            # ordered ahead of its value key.
+            want_first = (not asc) if nulls_first is None else nulls_first
+            col = work[name]
+            if col.null_count:
+                flag = pc.is_null(col)
+                fname = f"__nulls_{name}"
+                if fname not in work.column_names:
+                    work = work.append_column(fname, flag)
+                # ascending sorts false<true: nulls-last = ascending flag
+                keys.append((fname, "descending" if want_first else "ascending"))
             keys.append((name, "ascending" if asc else "descending"))
         idx = pc.sort_indices(work, sort_keys=keys)
         return t.take(idx) if set(t.column_names) == set(work.column_names) else work.take(idx).select(t.column_names)

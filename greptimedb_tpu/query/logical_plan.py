@@ -136,6 +136,9 @@ class VectorSearch(LogicalPlan):
 class Sort(LogicalPlan):
     input: LogicalPlan
     keys: list[tuple[Expr, bool]]  # (expr, ascending)
+    # per-key NULLS FIRST/LAST (parallel to keys; None = SQL default:
+    # NULLS LAST for ASC, NULLS FIRST for DESC — PostgreSQL semantics)
+    nulls: list | None = None
 
     def children(self):
         return [self.input]

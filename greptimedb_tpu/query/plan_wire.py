@@ -277,6 +277,10 @@ def split_for_regions(plan: LogicalPlan) -> DistSplit | None:
         elif isinstance(op, Sort):
             if any(expr_to_dict(e) is None for e, _a in op.keys):
                 return None
+            if op.nulls and any(n is not None for n in op.nulls):
+                # explicit NULLS FIRST/LAST is not carried on the wire —
+                # don't ship a sort whose merge would silently drop it
+                return None
             pending_sort = op.keys
         elif isinstance(op, Limit):
             if op.limit is None:

@@ -93,7 +93,8 @@ def _plan_full(
     branches = [stmt] + [s for _, s in stmt.unions]
     last = branches[-1]
     tail_order, tail_limit, tail_offset = last.order_by, last.limit, last.offset
-    branches[-1] = _dc.replace(last, order_by=[], limit=None, offset=0)
+    tail_nulls = last.order_nulls
+    branches[-1] = _dc.replace(last, order_by=[], order_nulls=[], limit=None, offset=0)
     plans = [
         _plan_branch(b, schema_provider, database, cte_plans, view_provider)[0]
         for b in branches
@@ -103,7 +104,7 @@ def _plan_full(
         plan = Union(plan, p, all_)
     if tail_order:
         keys = [(_resolve_order_key(e, stmt.projections), asc) for e, asc in tail_order]
-        plan = Sort(plan, keys)
+        plan = Sort(plan, keys, nulls=tail_nulls or None)
     if tail_limit is not None or tail_offset:
         plan = Limit(plan, tail_limit, tail_offset)
     return plan, Schema(columns=[])
@@ -236,7 +237,7 @@ def _rewrite_vector_search(plan: LogicalPlan, schema: Schema) -> LogicalPlan:
         key.func
     ]
     vs = VectorSearch(node.input, col.column, qb, metric, k, ascending=asc)
-    new_sort = Sort(vs, node.keys)
+    new_sort = Sort(vs, node.keys, nulls=node.nulls)
     inner: LogicalPlan = new_sort
     for p in reversed(projects):
         inner = Project(inner, p.exprs)
@@ -474,7 +475,7 @@ def plan_select(
             # Sort over the aggregate output (hidden agg columns still
             # present), then project them away.
             keys = [(_resolve_positional(e, stmt.projections), asc) for e, asc in stmt.order_by]
-            plan = Sort(plan, keys)
+            plan = Sort(plan, keys, nulls=stmt.order_nulls or None)
             plan = Project(plan, stmt.projections)
             if stmt.distinct:
                 plan = Distinct(plan)
@@ -486,7 +487,7 @@ def plan_select(
                 # ORDER BY runs over the projected output: positional refs
                 # and alias refs become output-column references.
                 keys = [(_resolve_order_key(e, stmt.projections), asc) for e, asc in stmt.order_by]
-                plan = Sort(plan, keys)
+                plan = Sort(plan, keys, nulls=stmt.order_nulls or None)
     else:
         if window_calls:
             plan = Window(plan, window_calls)
@@ -498,13 +499,13 @@ def plan_select(
             plan = Distinct(plan)
             if stmt.order_by:
                 keys = [(_resolve_order_key(e, stmt.projections), asc) for e, asc in stmt.order_by]
-                plan = Sort(plan, keys)
+                plan = Sort(plan, keys, nulls=stmt.order_nulls or None)
         else:
             if stmt.order_by:
                 # Sort below the projection: keys may reference base columns
                 # that the SELECT list drops (aliases resolve to their exprs).
                 keys = [(_resolve_positional(e, stmt.projections), asc) for e, asc in stmt.order_by]
-                plan = Sort(plan, keys)
+                plan = Sort(plan, keys, nulls=stmt.order_nulls or None)
             if not (len(stmt.projections) == 1 and isinstance(stmt.projections[0], Star)):
                 plan = Project(plan, stmt.projections)
 
@@ -615,7 +616,7 @@ def _plan_range_select(
     plan = Project(plan, new_projections)
     if stmt.order_by:
         keys = [(_resolve_order_key(e, new_projections), asc) for e, asc in stmt.order_by]
-        plan = Sort(plan, keys)
+        plan = Sort(plan, keys, nulls=stmt.order_nulls or None)
     else:
         # Deterministic default ordering: by series, then aligned ts
         # (the reference sorts range output the same way for sqlness goldens).
@@ -623,7 +624,7 @@ def _plan_range_select(
         present = {p.name() for p in new_projections}
         keys = [(e, a) for e, a in keys if e.column in present]
         if keys:
-            plan = Sort(plan, keys)
+            plan = Sort(plan, keys, nulls=stmt.order_nulls or None)
     if stmt.limit is not None or stmt.offset:
         plan = Limit(plan, stmt.limit, stmt.offset)
     return plan

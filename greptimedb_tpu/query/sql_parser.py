@@ -111,6 +111,10 @@ class SelectStmt:
     group_by: list[Expr] = field(default_factory=list)
     having: Expr | None = None
     order_by: list[tuple[Expr, bool]] = field(default_factory=list)
+    # Per-key NULLS FIRST/LAST (parallel to order_by; None = the SQL
+    # default, which is NULLS LAST for ASC and NULLS FIRST for DESC —
+    # PostgreSQL/DataFusion semantics, reference parity).
+    order_nulls: list[bool | None] = field(default_factory=list)
     limit: int | None = None
     offset: int = 0
     align: AlignClause | None = None
@@ -223,6 +227,8 @@ class InsertStmt:
     columns: list[str] | None
     rows: list[list[object]]
     database: str | None = None
+    # INSERT INTO ... SELECT: the source query (rows is then empty)
+    query: "SelectStmt | None" = None
 
 
 @dataclass
@@ -667,7 +673,15 @@ class Parser:
                     asc = False
                 elif self.eat_kw("asc"):
                     pass
+                nulls: bool | None = None
+                if self.eat_kw("nulls"):
+                    if self.eat_kw("first"):
+                        nulls = True
+                    else:
+                        self.expect_kw("last")
+                        nulls = False
                 stmt.order_by.append((e, asc))
+                stmt.order_nulls.append(nulls)
                 if not self.eat_op(","):
                     break
         if self.eat_kw("limit"):
@@ -1400,6 +1414,11 @@ class Parser:
             while self.eat_op(","):
                 columns.append(self.ident())
             self.expect_op(")")
+        if self.at_kw("select"):
+            # INSERT INTO t [(cols)] SELECT ... — rows come from a query
+            return InsertStmt(
+                table, columns, [], database=database, query=self.parse_select()
+            )
         self.expect_kw("values")
         rows = []
         while True:

@@ -116,6 +116,12 @@ def try_lower(plan: LogicalPlan, schema: Schema) -> Lowering | None:
         col_schema = schema.column(inner.arg.column)
         if not col_schema.data_type.is_numeric():
             return None
+        if getattr(col_schema.data_type, "value", "") in ("int64", "uint64"):
+            # BIGINT aggregates stay on the authoritative CPU path: the
+            # device kernels accumulate in float64, whose 53-bit mantissa
+            # cannot represent int64 extremes exactly (the reference
+            # returns exact int64 for min/max/sum)
+            return None
         if func == "last_value" and inner.order_by not in (None, ts_col):
             return None
         agg_specs.append((func, inner.arg.column))

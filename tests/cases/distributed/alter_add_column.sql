@@ -1,0 +1,14 @@
+-- distributed ALTER ADD COLUMN: old rows NULL-fill, new rows carry data
+CREATE TABLE dalter (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO dalter VALUES ('a', 1000, 1.5), ('b', 2000, 2.5);
+
+ALTER TABLE dalter ADD COLUMN extra DOUBLE;
+
+INSERT INTO dalter (host, ts, v, extra) VALUES ('c', 3000, 3.5, 30);
+
+SELECT host, v, extra FROM dalter ORDER BY host;
+
+SELECT count(extra) AS with_extra, count(*) AS total FROM dalter;
+
+DROP TABLE dalter;

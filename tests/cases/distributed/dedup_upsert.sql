@@ -1,0 +1,12 @@
+-- same (pk, ts) written twice: last write wins across the cluster
+CREATE TABLE dup (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO dup VALUES ('a', 1000, 1.0), ('b', 2000, 2.0);
+
+INSERT INTO dup VALUES ('a', 1000, 9.0);
+
+SELECT host, v FROM dup ORDER BY host;
+
+SELECT count(*) AS n FROM dup;
+
+DROP TABLE dup;

@@ -1,0 +1,12 @@
+-- DELETE with predicates through the frontend
+CREATE TABLE ddel (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO ddel VALUES ('a', 1000, 1), ('b', 2000, 2), ('c', 3000, 3);
+
+DELETE FROM ddel WHERE host = 'b';
+
+SELECT host FROM ddel ORDER BY host;
+
+SELECT count(*) AS n FROM ddel;
+
+DROP TABLE ddel;

@@ -1,0 +1,12 @@
+-- tag/value/time predicates prune at the region level
+CREATE TABLE dfp (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION ON COLUMNS (host) (host < 'm', host >= 'm');
+
+INSERT INTO dfp VALUES ('a', 1000, 1), ('b', 2000, 2), ('x', 3000, 10), ('z', 4000, 20);
+
+SELECT host FROM dfp WHERE host = 'x' ORDER BY host;
+
+SELECT host FROM dfp WHERE v > 1.5 AND ts < 4000 ORDER BY host;
+
+SELECT count(*) AS n FROM dfp WHERE host IN ('a', 'z');
+
+DROP TABLE dfp;

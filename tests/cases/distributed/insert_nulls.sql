@@ -1,0 +1,10 @@
+-- NULL handling through the distributed write path
+CREATE TABLE dnl (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, note STRING, PRIMARY KEY (host));
+
+INSERT INTO dnl VALUES ('a', 1000, NULL, 'x'), ('b', 2000, 2.5, NULL);
+
+SELECT host, v, note FROM dnl ORDER BY host;
+
+SELECT count(v) AS cv, count(note) AS cn, count(*) AS c FROM dnl;
+
+DROP TABLE dnl;

@@ -1,0 +1,12 @@
+-- ORDER BY + LIMIT ships bounded sub-plans to datanodes
+CREATE TABLE dol (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION ON COLUMNS (host) (host < 'm', host >= 'm');
+
+INSERT INTO dol VALUES ('a', 1000, 5), ('b', 2000, 3), ('x', 3000, 9), ('z', 4000, 1);
+
+SELECT host, v FROM dol ORDER BY v DESC LIMIT 2;
+
+SELECT host, v FROM dol ORDER BY v ASC LIMIT 2 OFFSET 1;
+
+SELECT host FROM dol ORDER BY host LIMIT 3;
+
+DROP TABLE dol;

@@ -1,0 +1,10 @@
+-- scalar aggregates fan out to datanodes and merge states
+CREATE TABLE dagg (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION ON COLUMNS (host) (host < 'm', host >= 'm');
+
+INSERT INTO dagg VALUES ('a', 1000, 1), ('b', 2000, 2), ('x', 3000, 10), ('z', 4000, 20);
+
+SELECT count(*) AS c, sum(v) AS s, min(v) AS mn, max(v) AS mx, avg(v) AS av FROM dagg;
+
+SELECT count(*) AS c FROM dagg WHERE host >= 'm';
+
+DROP TABLE dagg;

@@ -1,0 +1,10 @@
+-- time_bucket group-by across partitioned regions
+CREATE TABLE dtb (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION ON COLUMNS (host) (host < 'm', host >= 'm');
+
+INSERT INTO dtb VALUES ('a', 1000, 1), ('a', 6000, 2), ('x', 2000, 10), ('x', 7000, 20);
+
+SELECT time_bucket('5s', ts) AS tb, count(*) AS c, sum(v) AS s FROM dtb GROUP BY tb ORDER BY tb;
+
+SELECT host, time_bucket('5s', ts) AS tb, max(v) AS m FROM dtb GROUP BY host, tb ORDER BY host, tb;
+
+DROP TABLE dtb;

@@ -1,0 +1,10 @@
+-- TRUNCATE through the frontend
+CREATE TABLE dtr (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO dtr VALUES ('a', 1000, 1), ('b', 2000, 2);
+
+TRUNCATE TABLE dtr;
+
+SELECT count(*) AS n FROM dtr;
+
+DROP TABLE dtr;

@@ -1,0 +1,12 @@
+-- aggregates over NULL group keys and empty inputs
+CREATE TABLE ng (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO ng VALUES ('a', 1000, 1), (NULL, 2000, 2), (NULL, 3000, 4), ('b', 4000, 8);
+
+SELECT host, count(*) AS c, sum(v) AS s FROM ng GROUP BY host ORDER BY host;
+
+SELECT count(*) AS c FROM ng WHERE host IS NULL;
+
+SELECT sum(v) AS s, min(v) AS mn, max(v) AS mx, count(v) AS c FROM ng WHERE v > 100;
+
+DROP TABLE ng;

@@ -1,0 +1,10 @@
+-- quoted identifiers and keyword-ish aliases
+CREATE TABLE aq (host STRING, ts TIMESTAMP TIME INDEX, "select" DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO aq VALUES ('a', 1000, 1.5), ('b', 2000, 2.5);
+
+SELECT host, "select" FROM aq ORDER BY host;
+
+SELECT host AS "group", "select" AS "order" FROM aq ORDER BY "group";
+
+DROP TABLE aq;

@@ -1,0 +1,14 @@
+-- arithmetic over columns, precedence, aliases referenced in ORDER BY
+CREATE TABLE ar (id STRING, ts TIMESTAMP TIME INDEX, a DOUBLE, b DOUBLE, PRIMARY KEY (id));
+
+INSERT INTO ar VALUES ('r1', 1000, 6, 2), ('r2', 2000, 9, 3), ('r3', 3000, 10, 4);
+
+SELECT id, a + b AS s, a - b AS d, a * b AS p, a / b AS q FROM ar ORDER BY id;
+
+SELECT id, (a + b) * 2 AS t FROM ar ORDER BY t DESC;
+
+SELECT id, a % b AS m FROM ar ORDER BY id;
+
+SELECT sum(a + b) AS total FROM ar;
+
+DROP TABLE ar;

@@ -1,0 +1,16 @@
+-- BETWEEN / IN / NOT IN predicate surfaces
+CREATE TABLE bi (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO bi VALUES ('a', 1000, 1), ('b', 2000, 2), ('c', 3000, 3), ('d', 4000, 4), ('e', 5000, 5);
+
+SELECT host FROM bi WHERE v BETWEEN 2 AND 4 ORDER BY host;
+
+SELECT host FROM bi WHERE v NOT BETWEEN 2 AND 4 ORDER BY host;
+
+SELECT host FROM bi WHERE host IN ('a', 'c', 'zz') ORDER BY host;
+
+SELECT host FROM bi WHERE host NOT IN ('a', 'c') ORDER BY host;
+
+SELECT host FROM bi WHERE ts BETWEEN 2000 AND 4000 ORDER BY host;
+
+DROP TABLE bi;

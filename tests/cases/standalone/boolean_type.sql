@@ -1,0 +1,16 @@
+-- BOOLEAN columns and predicates
+CREATE TABLE bt (id STRING, ts TIMESTAMP TIME INDEX, ok BOOLEAN, PRIMARY KEY (id));
+
+INSERT INTO bt VALUES ('r1', 1000, true), ('r2', 2000, false), ('r3', 3000, NULL);
+
+SELECT id, ok FROM bt ORDER BY id;
+
+SELECT id FROM bt WHERE ok ORDER BY id;
+
+SELECT id FROM bt WHERE NOT ok ORDER BY id;
+
+SELECT id FROM bt WHERE ok IS NULL ORDER BY id;
+
+SELECT count(*) AS c FROM bt WHERE ok = false;
+
+DROP TABLE bt;

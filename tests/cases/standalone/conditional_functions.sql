@@ -1,0 +1,14 @@
+-- coalesce / nullif / greatest / least / nested CASE
+CREATE TABLE cf (id STRING, ts TIMESTAMP TIME INDEX, a DOUBLE, b DOUBLE, PRIMARY KEY (id));
+
+INSERT INTO cf VALUES ('r1', 1000, 1, 10), ('r2', 2000, NULL, 20), ('r3', 3000, 3, NULL);
+
+SELECT id, coalesce(a, b, 0) AS c FROM cf ORDER BY id;
+
+SELECT id, nullif(a, 3) AS n FROM cf ORDER BY id;
+
+SELECT id, greatest(a, b) AS g, least(a, b) AS l FROM cf ORDER BY id;
+
+SELECT id, CASE WHEN a IS NULL THEN 'no-a' WHEN a > 1 THEN CASE WHEN b IS NULL THEN 'a-only' ELSE 'both' END ELSE 'small' END AS k FROM cf ORDER BY id;
+
+DROP TABLE cf;

@@ -1,0 +1,12 @@
+-- COUNT(DISTINCT ...) incl. NULL handling
+CREATE TABLE cd (host STRING, ts TIMESTAMP TIME INDEX, tag STRING, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO cd VALUES ('a', 1000, 'x', 1), ('a', 2000, 'y', 1), ('a', 3000, 'x', 2), ('b', 1000, NULL, 3), ('b', 2000, 'z', 3);
+
+SELECT count(DISTINCT tag) AS dt FROM cd;
+
+SELECT count(DISTINCT v) AS dv FROM cd;
+
+SELECT host, count(DISTINCT tag) AS dt, count(*) AS c FROM cd GROUP BY host ORDER BY host;
+
+DROP TABLE cd;

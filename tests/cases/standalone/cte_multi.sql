@@ -1,0 +1,10 @@
+-- multiple CTEs and CTE feeding an aggregate
+CREATE TABLE cm (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO cm VALUES ('a', 1000, 1), ('b', 2000, 2), ('c', 3000, 3);
+
+WITH big AS (SELECT host, v FROM cm WHERE v >= 2), small AS (SELECT host, v FROM cm WHERE v < 2) SELECT host FROM big UNION ALL SELECT host FROM small ORDER BY host;
+
+WITH totals AS (SELECT host, sum(v) AS s FROM cm GROUP BY host) SELECT count(*) AS n, max(s) AS mx FROM totals;
+
+DROP TABLE cm;

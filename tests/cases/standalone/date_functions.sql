@@ -1,0 +1,12 @@
+-- date/time scalar functions
+CREATE TABLE df (id STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY (id));
+
+INSERT INTO df VALUES ('r1', 3723456), ('r2', 86400000);
+
+SELECT id, date_trunc('hour', ts) AS h, date_trunc('minute', ts) AS m FROM df ORDER BY id;
+
+SELECT id, to_unixtime(ts) AS u FROM df ORDER BY id;
+
+SELECT count(*) AS n FROM df WHERE ts < now();
+
+DROP TABLE df;

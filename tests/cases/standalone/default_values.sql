@@ -1,0 +1,12 @@
+-- column DEFAULTs fill omitted insert columns
+CREATE TABLE dv (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE DEFAULT 7.5, n BIGINT DEFAULT 42, PRIMARY KEY (host));
+
+INSERT INTO dv (host, ts) VALUES ('a', 1000);
+
+INSERT INTO dv (host, ts, v) VALUES ('b', 2000, 1.25);
+
+INSERT INTO dv VALUES ('c', 3000, 2.5, 7);
+
+SELECT host, v, n FROM dv ORDER BY host;
+
+DROP TABLE dv;

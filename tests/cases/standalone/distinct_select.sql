@@ -1,0 +1,12 @@
+-- SELECT DISTINCT over single and multiple columns
+CREATE TABLE ds (host STRING, dc STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host, dc));
+
+INSERT INTO ds VALUES ('a', 'e', 1000, 1), ('a', 'e', 2000, 2), ('a', 'w', 3000, 3), ('b', 'e', 4000, 4);
+
+SELECT DISTINCT host FROM ds ORDER BY host;
+
+SELECT DISTINCT host, dc FROM ds ORDER BY host, dc;
+
+SELECT count(*) AS rows_all FROM ds;
+
+DROP TABLE ds;

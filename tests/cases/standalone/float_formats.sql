@@ -1,0 +1,12 @@
+-- float literals: scientific, negative, special ordering
+CREATE TABLE ff (id STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, f FLOAT, PRIMARY KEY (id));
+
+INSERT INTO ff VALUES ('r1', 1000, 1.5e2, 0.25), ('r2', 2000, -3.25e-1, 100), ('r3', 3000, 0, -0.5);
+
+SELECT id, v, f FROM ff ORDER BY id;
+
+SELECT id FROM ff WHERE v < 0 ORDER BY id;
+
+SELECT max(v) AS mx, min(f) AS mn FROM ff;
+
+DROP TABLE ff;

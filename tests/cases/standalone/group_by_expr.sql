@@ -1,0 +1,14 @@
+-- GROUP BY on expressions and multiple keys
+CREATE TABLE ge (host STRING, dc STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host, dc));
+
+INSERT INTO ge VALUES ('a', 'e', 1000, 1), ('a', 'w', 2000, 2), ('b', 'e', 3000, 3), ('b', 'w', 4000, 4), ('a', 'e', 5000, 5);
+
+SELECT host, dc, count(*) AS c FROM ge GROUP BY host, dc ORDER BY host, dc;
+
+SELECT dc, sum(v) AS s FROM ge GROUP BY dc ORDER BY dc;
+
+SELECT time_bucket('2s', ts) AS tb, count(*) AS c FROM ge GROUP BY tb ORDER BY tb;
+
+SELECT host, count(*) AS c FROM ge WHERE dc = 'e' GROUP BY host ORDER BY host;
+
+DROP TABLE ge;

@@ -1,0 +1,12 @@
+-- HAVING filters on aggregate outputs (reference tests/cases/standalone/common/select)
+CREATE TABLE hv (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO hv VALUES ('a', 1000, 1), ('a', 2000, 2), ('b', 1000, 10), ('c', 1000, 5), ('c', 2000, 6), ('c', 3000, 7);
+
+SELECT host, count(*) AS c FROM hv GROUP BY host HAVING count(*) > 1 ORDER BY host;
+
+SELECT host, sum(v) AS s FROM hv GROUP BY host HAVING sum(v) >= 10 ORDER BY host;
+
+SELECT host, avg(v) AS a FROM hv GROUP BY host HAVING avg(v) > 1.4 AND count(*) < 3 ORDER BY host;
+
+DROP TABLE hv;

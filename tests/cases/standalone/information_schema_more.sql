@@ -1,0 +1,10 @@
+-- information_schema breadth: columns/partitions/region_peers shapes
+CREATE TABLE ism (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+SELECT table_name FROM information_schema.tables WHERE table_name = 'ism';
+
+SELECT column_name, data_type, semantic_type FROM information_schema.columns WHERE table_name = 'ism' ORDER BY column_name;
+
+SELECT count(*) AS engines FROM information_schema.engines;
+
+DROP TABLE ism;

@@ -1,0 +1,14 @@
+-- INSERT INTO ... SELECT between tables
+CREATE TABLE src_is (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+CREATE TABLE dst_is (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO src_is VALUES ('a', 1000, 1), ('b', 2000, 2), ('c', 3000, 3);
+
+INSERT INTO dst_is SELECT host, ts, v * 10 FROM src_is WHERE v >= 2;
+
+SELECT host, v FROM dst_is ORDER BY host;
+
+DROP TABLE src_is;
+
+DROP TABLE dst_is;

@@ -1,0 +1,14 @@
+-- INSERT ... SELECT with projection/rename and aggregation source
+CREATE TABLE isw_src (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+CREATE TABLE isw_rollup (host STRING, ts TIMESTAMP TIME INDEX, total DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO isw_src VALUES ('a', 1000, 1), ('a', 2000, 2), ('b', 1000, 10);
+
+INSERT INTO isw_rollup SELECT host, max(ts) AS ts, sum(v) FROM isw_src GROUP BY host;
+
+SELECT host, total FROM isw_rollup ORDER BY host;
+
+DROP TABLE isw_src;
+
+DROP TABLE isw_rollup;

@@ -1,0 +1,16 @@
+-- join feeding aggregation
+CREATE TABLE ja_m (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+CREATE TABLE ja_dim (host STRING, ts TIMESTAMP TIME INDEX, dc STRING, PRIMARY KEY (host));
+
+INSERT INTO ja_m VALUES ('a', 1000, 1), ('a', 2000, 2), ('b', 1000, 10), ('c', 1000, 100);
+
+INSERT INTO ja_dim VALUES ('a', 1, 'east'), ('b', 1, 'west'), ('c', 1, 'east');
+
+SELECT d.dc, sum(m.v) AS s FROM ja_m m JOIN ja_dim d ON m.host = d.host GROUP BY d.dc ORDER BY d.dc;
+
+SELECT d.dc, count(*) AS c FROM ja_m m INNER JOIN ja_dim d ON m.host = d.host GROUP BY d.dc ORDER BY d.dc;
+
+DROP TABLE ja_m;
+
+DROP TABLE ja_dim;

@@ -1,0 +1,10 @@
+-- math scalar functions (reference common/function/math)
+SELECT abs(-2.5) AS a, round(2.567) AS r, floor(2.9) AS f, ceil(2.1) AS c;
+
+SELECT power(2, 10) AS p, sqrt(16.0) AS s;
+
+SELECT exp(0.0) AS e, ln(1.0) AS l, log10(100.0) AS lg;
+
+SELECT sin(0.0) AS sn, cos(0.0) AS cs;
+
+SELECT 17 % 5 AS m, 17 / 4 AS d, 2.5 * 4 AS mul, 1 - 9 AS neg;

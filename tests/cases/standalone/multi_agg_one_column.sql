@@ -1,0 +1,10 @@
+-- many aggregates over one column in one pass
+CREATE TABLE mo (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO mo VALUES ('a', 1000, 2), ('a', 2000, 4), ('a', 3000, 6), ('b', 1000, 10);
+
+SELECT host, count(v) AS c, sum(v) AS s, min(v) AS mn, max(v) AS mx, avg(v) AS av FROM mo GROUP BY host ORDER BY host;
+
+SELECT count(v) AS c, sum(v) AS s, min(v) AS mn, max(v) AS mx, avg(v) AS av FROM mo;
+
+DROP TABLE mo;

@@ -1,0 +1,14 @@
+-- ORDER BY with NULLs and mixed directions
+CREATE TABLE onl (id STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (id));
+
+INSERT INTO onl VALUES ('r1', 1000, 3), ('r2', 2000, NULL), ('r3', 3000, 1), ('r4', 4000, NULL), ('r5', 5000, 2);
+
+SELECT id, v FROM onl ORDER BY v ASC, id;
+
+SELECT id, v FROM onl ORDER BY v DESC, id;
+
+SELECT id, v FROM onl ORDER BY v ASC NULLS FIRST, id;
+
+SELECT id, v FROM onl ORDER BY v DESC NULLS LAST, id;
+
+DROP TABLE onl;

@@ -1,0 +1,14 @@
+-- RANGE queries: BY grouping with FILL variants
+CREATE TABLE rbf (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO rbf VALUES ('a', 0, 1), ('a', 10000, 5), ('b', 0, 2), ('b', 20000, 8);
+
+SELECT ts, host, min(v) RANGE '5s' FROM rbf ALIGN '5s' BY (host) ORDER BY host, ts;
+
+SELECT ts, host, max(v) RANGE '5s' FILL PREV FROM rbf ALIGN '5s' BY (host) ORDER BY host, ts;
+
+SELECT ts, host, avg(v) RANGE '5s' FILL LINEAR FROM rbf ALIGN '5s' BY (host) ORDER BY host, ts;
+
+SELECT ts, host, sum(v) RANGE '5s' FILL 0 FROM rbf ALIGN '5s' BY (host) ORDER BY host, ts;
+
+DROP TABLE rbf;

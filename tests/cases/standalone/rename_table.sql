@@ -1,0 +1,12 @@
+-- ALTER TABLE ... RENAME TO
+CREATE TABLE rn_old (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO rn_old VALUES ('a', 1000, 1.5);
+
+ALTER TABLE rn_old RENAME TO rn_new;
+
+SELECT host, v FROM rn_new ORDER BY host;
+
+SELECT count(*) FROM rn_old;
+
+DROP TABLE rn_new;

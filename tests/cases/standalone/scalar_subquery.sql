@@ -1,0 +1,12 @@
+-- scalar + IN subqueries in predicates
+CREATE TABLE sq (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO sq VALUES ('a', 1000, 1), ('b', 2000, 5), ('c', 3000, 9);
+
+SELECT host FROM sq WHERE v > (SELECT avg(v) FROM sq) ORDER BY host;
+
+SELECT host FROM sq WHERE host IN (SELECT host FROM sq WHERE v >= 5) ORDER BY host;
+
+SELECT (SELECT max(v) FROM sq) AS mx;
+
+DROP TABLE sq;

@@ -1,0 +1,8 @@
+-- self join with aliases
+CREATE TABLE sj (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, buddy STRING, PRIMARY KEY (host));
+
+INSERT INTO sj VALUES ('a', 1000, 1, 'b'), ('b', 2000, 2, 'c'), ('c', 3000, 3, 'a');
+
+SELECT x.host AS me, y.host AS them, y.v AS their_v FROM sj x JOIN sj y ON x.buddy = y.host ORDER BY me;
+
+DROP TABLE sj;

@@ -1,0 +1,18 @@
+-- string scalar functions (reference common/function/string)
+CREATE TABLE sf (id STRING, ts TIMESTAMP TIME INDEX, s STRING, PRIMARY KEY (id));
+
+INSERT INTO sf VALUES ('r1', 1000, 'Hello World'), ('r2', 2000, '  pad  '), ('r3', 3000, NULL);
+
+SELECT id, upper(s) AS u, lower(s) AS l FROM sf ORDER BY id;
+
+SELECT id, length(s) AS n FROM sf ORDER BY id;
+
+SELECT id, substr(s, 1, 5) AS pre FROM sf ORDER BY id;
+
+SELECT id, trim(s) AS t FROM sf ORDER BY id;
+
+SELECT id, replace(s, 'l', 'L') AS r FROM sf ORDER BY id;
+
+SELECT id, concat(id, ':', s) AS c FROM sf ORDER BY id;
+
+DROP TABLE sf;

@@ -1,0 +1,14 @@
+-- TQL with PromQL function surface over SQL-created data
+CREATE TABLE tqf (host STRING, ts TIMESTAMP TIME INDEX, val DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO tqf VALUES ('a', 0, 1), ('a', 5000, 3), ('a', 10000, 6), ('b', 0, 2), ('b', 5000, 2), ('b', 10000, 8);
+
+TQL EVAL (0, 10, '5s') tqf;
+
+TQL EVAL (0, 10, '5s') sum(tqf);
+
+TQL EVAL (0, 10, '5s') sum by (host) (tqf);
+
+TQL EVAL (10, 10, '5s') rate(tqf[10s]);
+
+DROP TABLE tqf;

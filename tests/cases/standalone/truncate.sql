@@ -1,0 +1,16 @@
+-- TRUNCATE TABLE clears rows, keeps schema
+CREATE TABLE tr (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO tr VALUES ('a', 1000, 1), ('b', 2000, 2);
+
+SELECT count(*) AS n FROM tr;
+
+TRUNCATE TABLE tr;
+
+SELECT count(*) AS n FROM tr;
+
+INSERT INTO tr VALUES ('c', 3000, 3);
+
+SELECT host, v FROM tr ORDER BY host;
+
+DROP TABLE tr;

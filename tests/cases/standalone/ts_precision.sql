@@ -1,0 +1,20 @@
+-- timestamp precisions coexist and compare correctly
+CREATE TABLE tp_ms (id STRING, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY (id));
+
+CREATE TABLE tp_s (id STRING, ts TIMESTAMP(0) TIME INDEX, PRIMARY KEY (id));
+
+INSERT INTO tp_ms VALUES ('a', 1500), ('b', 2500);
+
+INSERT INTO tp_s VALUES ('a', 2), ('b', 3);
+
+SELECT id, ts FROM tp_ms ORDER BY id;
+
+SELECT id, ts FROM tp_s ORDER BY id;
+
+SELECT count(*) AS n FROM tp_ms WHERE ts >= 2000;
+
+SELECT count(*) AS n FROM tp_s WHERE ts >= 3;
+
+DROP TABLE tp_ms;
+
+DROP TABLE tp_s;

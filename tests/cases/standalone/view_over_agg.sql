@@ -1,0 +1,18 @@
+-- views over aggregates, view of view
+CREATE TABLE va (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO va VALUES ('a', 1000, 1), ('a', 2000, 3), ('b', 1000, 10);
+
+CREATE VIEW va_sum AS SELECT host, sum(v) AS s FROM va GROUP BY host;
+
+SELECT host, s FROM va_sum ORDER BY host;
+
+CREATE VIEW va_big AS SELECT host FROM va_sum WHERE s > 5;
+
+SELECT host FROM va_big ORDER BY host;
+
+DROP VIEW va_big;
+
+DROP VIEW va_sum;
+
+DROP TABLE va;

@@ -1,0 +1,12 @@
+-- nested boolean predicates with parentheses
+CREATE TABLE wc (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, n BIGINT, PRIMARY KEY (host));
+
+INSERT INTO wc VALUES ('a', 1000, 1, 10), ('b', 2000, 2, 20), ('c', 3000, 3, 30), ('d', 4000, 4, 40);
+
+SELECT host FROM wc WHERE (v > 1 AND n < 40) OR host = 'a' ORDER BY host;
+
+SELECT host FROM wc WHERE NOT (v > 2) ORDER BY host;
+
+SELECT host FROM wc WHERE v > 1 AND (n = 20 OR n = 40) ORDER BY host;
+
+DROP TABLE wc;

@@ -1,0 +1,14 @@
+-- lead/lag/first_value window functions over partitions
+CREATE TABLE wl (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO wl VALUES ('a', 1000, 1), ('a', 2000, 2), ('a', 3000, 3), ('b', 1000, 10), ('b', 2000, 20);
+
+SELECT host, v, lag(v) OVER (PARTITION BY host ORDER BY ts) AS prev FROM wl ORDER BY host, ts;
+
+SELECT host, v, lead(v) OVER (PARTITION BY host ORDER BY ts) AS nxt FROM wl ORDER BY host, ts;
+
+SELECT host, v, first_value(v) OVER (PARTITION BY host ORDER BY ts) AS fv FROM wl ORDER BY host, ts;
+
+SELECT host, v, row_number() OVER (PARTITION BY host ORDER BY ts DESC) AS rn FROM wl ORDER BY host, ts;
+
+DROP TABLE wl;

@@ -45,12 +45,19 @@ def render_result(result) -> str:
 
 
 def split_statements(text: str) -> list[str]:
-    """Split on ; at top level (quote-aware); keep full statement text."""
-    out, cur, in_str = [], [], False
+    """Split on ; at top level (quote- AND comment-aware); keep full
+    statement text.  A ';' inside a '--' line comment must NOT split —
+    round 4's splitter broke the leading case comment into a bogus
+    statement, so the CREATE never ran when goldens were generated and
+    the whole case passed vacuously on recorded errors."""
+    out, cur, in_str, in_comment = [], [], False, False
     i = 0
     while i < len(text):
         c = text[i]
-        if c == "'" and not in_str:
+        if in_comment:
+            if c == "\n":
+                in_comment = False
+        elif c == "'" and not in_str:
             in_str = True
         elif c == "'" and in_str:
             if i + 1 < len(text) and text[i + 1] == "'":
@@ -58,7 +65,9 @@ def split_statements(text: str) -> list[str]:
                 i += 1
             else:
                 in_str = False
-        if c == ";" and not in_str:
+        elif c == "-" and not in_str and i + 1 < len(text) and text[i + 1] == "-":
+            in_comment = True
+        if c == ";" and not in_str and not in_comment:
             stmt = "".join(cur).strip()
             if stmt:
                 out.append(stmt)
@@ -72,7 +81,7 @@ def split_statements(text: str) -> list[str]:
     return out
 
 
-def run_case(path: str, db) -> str:
+def run_case(path: str, db, outcomes: list | None = None) -> str:
     with open(path) as f:
         text = f.read()
     chunks = []
@@ -87,10 +96,29 @@ def run_case(path: str, db) -> str:
         try:
             result = db.sql_one(exec_text)
             chunks.append(render_result(result))
+            if outcomes is not None:
+                outcomes.append("ok")
         except Exception as e:  # noqa: BLE001
             chunks.append(f"Error: {type(e).__name__}: {e}")
+            if outcomes is not None:
+                outcomes.append("error")
         chunks.append("")
     return "\n".join(chunks).rstrip() + "\n"
+
+
+def check_golden_sane(name: str, outcomes: list):
+    """Refuse to record a golden whose FIRST statement errored: that is
+    almost always a broken case (setup failed -> every later result is a
+    cascading error and the comparison passes vacuously).  Deliberate
+    error cases must not put the error first."""
+    if "error" in name:
+        return  # deliberate error-surface cases start with failures
+    if outcomes and outcomes[0] == "error":
+        raise RuntimeError(
+            f"{name}: first statement errored while generating the golden "
+            f"— the case setup is broken (round-4 distributed goldens "
+            f"recorded nothing but cascading errors this way)"
+        )
 
 
 def _make_db(backend: str):
@@ -118,10 +146,12 @@ def run_all(update: bool = False, backends: tuple[str, ...] = ("cpu", "tpu")) ->
         golden = case[:-4] + ".result"
         if update:
             db = _make_db("cpu")
+            outcomes: list = []
             try:
-                got = run_case(case, db)
+                got = run_case(case, db, outcomes)
             finally:
                 db.close()
+            check_golden_sane(name, outcomes)
             with open(golden, "w") as f:
                 f.write(got)
             continue
@@ -171,10 +201,12 @@ def run_all_distributed(update: bool = False) -> list[str]:
         for name in names:
             case = os.path.join(DIST_CASES_DIR, name)
             db = _make_db("cpu")
+            outcomes: list = []
             try:
-                got = run_case(case, db)
+                got = run_case(case, db, outcomes)
             finally:
                 db.close()
+            check_golden_sane(name, outcomes)
             with open(case[:-4] + ".result", "w") as f:
                 f.write(got)
         return []

@@ -359,3 +359,48 @@ def test_drop_table_procedure_crash_resume(cluster):
     assert "drop1" in resumed
     assert not cluster.catalog.has_table("dpc")
     assert cluster.metasrv.get_route(meta.table_id) == {}
+
+
+def test_failover_self_heals_when_all_nodes_look_dead(cluster):
+    """Under load every datanode can miss heartbeats at once: the first
+    failover attempt finds no healthy target and poisons.  The supervisor
+    tick must RE-SUBMIT failover for regions still routed to dead nodes
+    (not just on the alive->dead edge), so the cluster converges once a
+    survivor heartbeats again — round 4 orphaned the region forever and
+    the process-level tick crashed on the raised error."""
+    c = cluster
+    schema = cpu_schema()
+    c.create_table("cpu", schema, partitions=1)
+    c.insert("cpu", make_batch(schema, ["a", "b"], [0, 1000], [1.0, 2.0]))
+    for dn in c.datanodes.values():
+        dn.engine.flush_all()
+    # warm the detectors
+    for _ in range(10):
+        c._now[0] += 1000
+        c.heartbeat_all()
+    table_id = c.catalog.table("cpu").table_id
+    routes0 = c.metasrv.get_route(table_id)
+    victim = routes0[next(iter(routes0))]
+    c.kill_datanode(victim)
+
+    # EVERY node goes silent long enough to be suspected
+    c._now[0] += 600_000
+    submitted = c.metasrv.tick(c._now[0])
+    # no healthy target: nothing orphaned, nothing crashed
+    assert submitted == []
+    routes = c.metasrv.get_route(table_id)
+    assert routes == routes0, "route must not move while no target exists"
+
+    # survivors resume heartbeating; the next ticks must re-detect the
+    # dead node's regions and complete the failover
+    for _ in range(5):
+        c._now[0] += 1000
+        c.heartbeat_all()
+        c.metasrv.tick(c._now[0])
+    routes = c.metasrv.get_route(table_id)
+    assert all(n != victim for n in routes.values()), (
+        f"region still routed to dead node: {routes}"
+    )
+    # data survives via shared storage + WAL replay on the new node
+    t = c.query("SELECT count(*) AS c FROM cpu")
+    assert t["c"].to_pylist() == [2]
