@@ -82,6 +82,7 @@ from ..ops.tiles import padded_size
 from ..storage.dictionary import TableDictionary
 from ..storage.region import OP_COL, Region
 from ..storage.sst import FileMeta
+from ..query import passes
 from ..utils import metrics
 from ..utils.deadline import check_deadline
 from .executor import (
@@ -271,6 +272,9 @@ class TileCacheManager:
         # cold path has no consolidation step to pay, so ours must not
         # either).  None disables persistence.
         self.persist_dir = persist_dir
+        # QueryConfig wired by the engine: pass toggles (disabled_passes)
+        # reach chunk placement through it
+        self.config = None
         self._persist_pool: set[str] = set()  # filesets being written
         self._lock = threading.RLock()
         self._super: OrderedDict[int, _SuperTiles] = OrderedDict()
@@ -591,7 +595,11 @@ class TileCacheManager:
         threading.Thread(target=write, name="tile-persist", daemon=True).start()
 
     def chunk_device(self, i: int):
-        """Device for chunk index i (round-robin over local devices)."""
+        """Device for chunk index i (round-robin over local devices;
+        disabling the chunk_placement pass pins every chunk to device 0,
+        e.g. while debugging a multi-device state merge)."""
+        if not passes.enabled("chunk_placement", self.config):
+            return self.devices[0]
         return self.devices[i % len(self.devices)]
 
     def _up_chunks(self, buf: np.ndarray, bounds) -> list:
@@ -2007,6 +2015,25 @@ class TileExecutor:
                     and not schema.column(c).nullable
                 ):
                     limb_skip_upload.add(c)
+        has_sum_avg = any(
+            funcs & {"sum", "avg"} for funcs in per_col_funcs.values()
+        )
+        if self.config_acc_dtype() == "limb" and has_sum_avg:
+            passes.note(
+                "limb_quantize", True,
+                "sum/avg accumulate via MXU fixed-point limb matmuls",
+                f64_upload_skipped=len(limb_skip_upload),
+            )
+        elif has_sum_avg:
+            passes.note(
+                "limb_quantize", False,
+                "exact float accumulation (disabled or configured off)",
+            )
+        else:
+            passes.note(
+                "limb_quantize", False,
+                "no sum/avg aggregate: compare/count kernels only",
+            )
         device_value_cols = [c for c in value_cols if c not in limb_skip_upload]
         super_entries: list[_SuperTiles] = []
         slots: list = []
@@ -2066,15 +2093,29 @@ class TileExecutor:
         # with numpy — no device link round-trip at all.  The reference
         # serves these through its inverted index + page pruning; here the
         # sorted encode cache plays that role.
-        host_table = self._host_execute(
-            plan, dyn_host, super_entries,
-            [s for s in slots if not isinstance(s, _SuperTiles)],
-            schema, ctx, use_ts, pk, value_cols, all_tag_cols, dedup_regions,
-        )
+        host_table = None
+        hfp_enabled = passes.enabled("host_fast_path", self.config)
+        if hfp_enabled:
+            host_table = self._host_execute(
+                plan, dyn_host, super_entries,
+                [s for s in slots if not isinstance(s, _SuperTiles)],
+                schema, ctx, use_ts, pk, value_cols, all_tag_cols,
+                dedup_regions,
+            )
         if host_table is not None:
             metrics.TILE_LOWERED_TOTAL.inc()
             metrics.TILE_HOST_FAST_PATH.inc()
+            passes.note(
+                "host_fast_path", True,
+                "pk-equality slice served from sorted host planes",
+                rows_out=host_table.num_rows,
+            )
             return host_table
+        passes.note(
+            "host_fast_path", False,
+            "query not selective enough for the sorted-host binary search"
+            if hfp_enabled else "pass disabled",
+        )
 
         device_sources = []
         limb_need = self._limb_sum_cols(plan)
@@ -2082,14 +2123,27 @@ class TileExecutor:
             if isinstance(s, _SuperTiles):
                 need_cols = self._plan_cols(plan)
                 dedup = s.region_id in dedup_regions
-                if dedup and not self.cache.ensure_dedup_keep(s):
-                    return None  # host planes evicted: scan path owns it
+                if dedup:
+                    dp_enabled = passes.enabled("dedup_plane", self.config)
+                    if not dp_enabled or not self.cache.ensure_dedup_keep(s):
+                        passes.note(
+                            "dedup_plane", False,
+                            "keep plane unavailable: merge scan owns dedup"
+                            if dp_enabled else "pass disabled",
+                        )
+                        return None  # host planes evicted: scan path owns it
+                    passes.note(
+                        "dedup_plane", True,
+                        "overlapping-SST LWW dedup lowered to a device keep "
+                        "mask", region=s.region_id,
+                    )
                 if (
                     not plan.time_major
                     and window is not None
                     and use_ts
                     and window[0] > -(1 << 61)
                     and window[1] < (1 << 61)
+                    and passes.enabled("window_tile", self.config)
                 ):
                     # windowed query over deep retention: gather ONLY the
                     # in-window (and dedup-surviving) rows into a compact
@@ -2100,8 +2154,18 @@ class TileExecutor:
                         set(limb_need), dedup, ctx.dictionary.epoch,
                     )
                     if wsrc is not None:
+                        passes.note(
+                            "window_tile", True,
+                            "in-window rows gathered into a compact tile",
+                            region=s.region_id, sources=len(wsrc),
+                        )
                         device_sources.extend(wsrc)
                         continue
+                    passes.note(
+                        "window_tile", False,
+                        "window covers most of retention (or tile build "
+                        "declined): full-tile scan with device masking",
+                    )
                 if s.nbytes > self.cache.budget // 2:
                     # one-entry deployments: make room for THIS query's
                     # planes by dropping the entry's own unused columns
@@ -2180,6 +2244,19 @@ class TileExecutor:
             "bucket_origin": np.int64(dyn_host["bucket_origin"]),
             "bucket_interval": np.int64(dyn_host["bucket_interval"]),
         }
+        ndev = len(self.cache.devices)
+        placed = ndev > 1 and passes.enabled("chunk_placement", self.config)
+        if placed:
+            why = (f"{len(device_sources)} tile chunk(s) round-robin over "
+                   f"{ndev} devices, states merged N:1")
+        elif ndev > 1:
+            why = "pass disabled: all chunks pinned to device 0"
+        else:
+            why = f"{len(device_sources)} tile chunk(s) on the single device"
+        passes.note(
+            "chunk_placement", placed, why,
+            chunks=len(device_sources), devices=ndev,
+        )
         metrics.TILE_LOWERED_TOTAL.inc()
         # first pass normally runs the MXU limb kernel; when its per-group
         # error bound fails the verdict (mixed-magnitude data sharing
@@ -2366,7 +2443,22 @@ class TileExecutor:
         # layout strategy
         pk = [c.name for c in schema.tag_columns()]
         layout_tags = _choose_layout(pk, tag_cols, bucket_col is not None)
-        time_major = bucket_col is not None and not tag_cols and layout_tags is None
+        time_major = (
+            bucket_col is not None
+            and not tag_cols
+            and layout_tags is None
+            and passes.enabled("time_major", self.config)
+        )
+        if time_major:
+            passes.note(
+                "time_major", True,
+                "bucket-only group-by reduces over a time-major permutation",
+            )
+        elif bucket_col is not None and not tag_cols:
+            passes.note(
+                "time_major", False,
+                "time-major disabled or layout claims the sort order",
+            )
         if (
             layout_tags is not None
             and needs_ts_order
@@ -2444,7 +2536,7 @@ class TileExecutor:
         import jax as _jax
 
         mode = getattr(self.config, "tile_acc_dtype", "limb")
-        if mode == "limb":
+        if mode == "limb" and passes.enabled("limb_quantize", self.config):
             return "limb"
         return "float64" if _jax.config.jax_enable_x64 else "float32"
 

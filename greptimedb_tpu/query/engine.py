@@ -20,6 +20,7 @@ from ..utils import metrics
 from ..utils.config import QueryConfig
 from ..utils.errors import PlanError, TableNotFoundError
 from ..utils.tracing import span
+from . import passes
 from .cpu_exec import CpuExecutor
 from .logical_plan import LogicalPlan, TableScan
 from .planner import plan_query
@@ -101,6 +102,7 @@ class QueryEngine:
                     lowering is not None
                     and self.config.tpu_min_rows > 0
                     and self._tile_ctx is not None
+                    and passes.enabled("cost_route", self.config)
                 ):
                     est = self._estimate_scan_rows(lowering.scan, schema)
                     if (
@@ -116,7 +118,19 @@ class QueryEngine:
                         # plain scan over a parallelized one for tiny
                         # inputs)
                         metrics.TPU_ROUTED_TO_CPU.inc()
+                        passes.note(
+                            "cost_route", True,
+                            f"estimated {est} rows < tpu_min_rows="
+                            f"{self.config.tpu_min_rows} and tiles not "
+                            "resident: local CPU path", est_rows=est,
+                        )
                         lowering = None
+                    else:
+                        passes.note(
+                            "cost_route", False,
+                            "scan large enough (or tiles resident) for the "
+                            "device path", est_rows=est,
+                        )
                 if lowering is not None:
                     # the HBM super-tile path wins whenever it applies
                     # (standalone hot path: resident tiles, one dispatch,
@@ -141,7 +155,11 @@ class QueryEngine:
                     if table is not None:
                         return table
                     backend = "cpu"
-                if lowering is not None and self._partial_agg is not None:
+                if (
+                    lowering is not None
+                    and self._partial_agg is not None
+                    and passes.enabled("state_ship", self.config)
+                ):
                     # distributed: ship the aggregate, merge states — never
                     # rows — across nodes (reference MergeScan split)
                     from .dist_agg import merge_states, spec_from_lowering
@@ -158,6 +176,12 @@ class QueryEngine:
                                 info["state_bytes"] = sum(s.nbytes for s in states)
                         if states is not None:
                             backend = "dist_states"
+                            passes.note(
+                                "state_ship", True,
+                                "aggregate decomposed into mergeable "
+                                "states shipped from datanodes",
+                                nodes=len(states),
+                            )
                             with _stage("dist.merge_states") as info:
                                 merged = merge_states(states, spec)
                                 info["groups"] = merged.num_rows
@@ -179,7 +203,9 @@ class QueryEngine:
                             schema,
                             time_bounds=lambda: self._time_bounds(scan.table, scan.database),
                         )
-            if self._subplan is not None:
+            if self._subplan is not None and passes.enabled(
+                "subplan_ship", self.config
+            ):
                 # general sub-plan shipping: push the maximal commutative
                 # prefix (filter/project/sort/limit) below the region-merge
                 # boundary so datanodes return BOUNDED rows instead of the
@@ -190,6 +216,12 @@ class QueryEngine:
 
                 split = split_for_regions(plan)
                 if split is not None:
+                    passes.note(
+                        "subplan_ship", True,
+                        "commutative prefix shipped below the "
+                        "region-merge boundary",
+                        categories=",".join(split.categories),
+                    )
                     from .analyze import stage as _stage
 
                     with _stage("dist.subplan") as info:
@@ -267,6 +299,14 @@ class QueryEngine:
         lowered = try_lower(plan, schema) if schema.columns else None
         lines = plan.describe().split("\n")
         backend = ["tpu" if lowered is not None else "cpu"] * len(lines)
+        # static pass listing (reference EXPLAIN shows the optimizer rule
+        # pipeline); per-query firing needs EXPLAIN ANALYZE
+        lines.append("── optimizer passes ──")
+        backend.append("")
+        for p in passes.registry():
+            state = "on" if passes.enabled(p.name, self.config) else "DISABLED"
+            lines.append(f"  [{p.kind}] {p.name} ({state})")
+            backend.append(p.description)
         return pa.table({"plan": lines, "backend": backend})
 
     def explain_analyze(self, stmt: SelectStmt, database: str = "public") -> pa.Table:
@@ -277,8 +317,9 @@ class QueryEngine:
         plan, schema = plan_query(stmt, self.schema_of, database, self.view_of)
         lowered = try_lower(plan, schema) if schema.columns else None
         collector = StageCollector()
+        trace = passes.PassTrace()
         t0 = time.perf_counter()
-        with use_collector(collector):
+        with use_collector(collector), passes.use_trace(trace):
             result = self.execute_plan(plan, schema)
         total_ms = (time.perf_counter() - t0) * 1000.0
         backend = "cpu"
@@ -290,7 +331,20 @@ class QueryEngine:
             elif any(n.startswith("tpu.") for n in names):
                 backend = "tpu"
         collector.add("output", 0.0, {"rows": result.num_rows}, depth=0)
-        return render(collector, plan.describe().split("\n"), total_ms, backend)
+        table = render(collector, plan.describe().split("\n"), total_ms, backend)
+        # optimizer-pass decisions: which strategies fired and why
+        # (reference analyze.rs renders per-rule effects the same way)
+        stages = table["stage"].to_pylist() + ["── optimizer passes ──"]
+        mets = table["metrics"].to_pylist() + [""]
+        for p, d, n_fired in trace.summary():
+            if d is None:
+                continue  # decision point never reached for this plan shape
+            mark = "fired" if d.fired else "skipped"
+            extra = "".join(f" {k}={v}" for k, v in d.attrs.items())
+            count = f" x{n_fired}" if n_fired > 1 else ""
+            stages.append(f"  {p.name}")
+            mets.append(f"{mark}{count}: {d.why}{extra}")
+        return pa.table({"stage": stages, "metrics": mets})
 
 
 def _merge_subplan_results(tables, split) -> pa.Table:

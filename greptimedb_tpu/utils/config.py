@@ -150,6 +150,13 @@ class QueryConfig:
     # grinding unbounded (the reference cancels the DataFusion stream on
     # its request timeouts).
     timeout_s: float = 0.0
+    # Named optimizer passes to switch off (query/passes.py registry) —
+    # comma list via env: GREPTIMEDB_TPU__QUERY__DISABLED_PASSES=
+    # "window_tile,host_fast_path".  Each strategy decision point checks
+    # `passes.enabled(name, config)`, so disabling one composes with the
+    # rest (the reference removes individual physical optimizer rules the
+    # same way in its tests).
+    disabled_passes: tuple = ()
 
 
 @dataclasses.dataclass

@@ -131,7 +131,21 @@ def test_frontend_failover_after_datanode_crash(cluster_env):
     cluster.procs[victim].kill()
     cluster.procs[victim].wait(timeout=15)
 
-    deadline = time.time() + 600  # single-core CI: failover competes with the suite
+    # Deterministic failure detection via the metasrv's injectable tick
+    # clock (round-4 flake: waiting for the phi detector to trip on WALL
+    # time raced the suite's single-core saturation).  A far-future tick
+    # marks every node suspect; the survivor's next real heartbeat
+    # revives it; a present-time tick then submits failover for the
+    # regions still routed to the dead node — no wall-clock lease waits.
+    far_future = time.time() * 1000 + 600_000
+    meta.tick(far_future)
+    hb_deadline = time.time() + 60
+    while time.time() < hb_deadline:
+        time.sleep(0.6)  # > --heartbeat-s so the survivor re-registers
+        if meta.tick(time.time() * 1000):
+            break  # failover procedure submitted
+
+    deadline = time.time() + 600  # safety net; the tick above makes this fast
     last = None
     while time.time() < deadline:
         try:
