@@ -73,6 +73,12 @@ WARM_REPS = int(os.environ.get("GRAFT_BENCH_REPS", 5))
 BUDGET_S = float(os.environ.get("GRAFT_BENCH_BUDGET_S", 3000))
 PARTIAL_PATH = os.environ.get("GRAFT_BENCH_PARTIAL", "BENCH_PARTIAL.json")
 HTTP_INGEST_ROWS = int(os.environ.get("GRAFT_BENCH_HTTP_ROWS", 400_000))
+# larger-than-HBM probe: >=2^28 rows, region-streamed (see
+# _larger_than_hbm_probe).  Starts only when the TSBS suite finished with
+# wall clock to spare; every stage runs under query deadlines so the
+# worst case stays bounded.
+LTH_ROWS = int(os.environ.get("GRAFT_BENCH_LTH_ROWS", 1 << 28))
+LTH_START_MAX_S = float(os.environ.get("GRAFT_BENCH_LTH_START_MAX_S", 3300))
 
 END = T0 + HOURS * 3600_000
 W12 = (END - 12 * 3600_000, END)
@@ -280,6 +286,143 @@ def _http_ingest_probe(db) -> dict:
         }
     finally:
         srv.stop()
+
+
+def _larger_than_hbm_probe() -> dict:
+    """>=2^28 rows whose device working set exceeds the tile budget:
+    the engine's region-streamed path (tile_cache._streamed_execute)
+    builds/dispatches/releases one region at a time.  Recorded evidence:
+    per-region wall times (flatness = the 1B-row trajectory — more rows
+    is more regions at the same per-region cost, bounded HBM throughout)
+    and the resident-bytes ceiling.  Reference scale anchor: the 1B-row
+    JSONBench claim (reference README.md:104-106) and TSBS
+    docs/benchmarks/tsbs/v0.12.0.md."""
+    import shutil
+    import tempfile
+
+    from greptimedb_tpu.database import Database
+    from greptimedb_tpu.parallel import tile_cache as tc
+    from greptimedb_tpu.utils import metrics as m
+
+    out: dict = {"rows": LTH_ROWS}
+    n_parts = 16
+    metrics_n = 3
+    budget_mb = int(os.environ.get("GRAFT_BENCH_LTH_BUDGET_MB", 4096))
+    home = None
+    db = None
+    try:
+        home = tempfile.mkdtemp(prefix="graft_lth_")
+        db = Database(data_home=home)
+        db.config.query.tpu_min_rows = 300_000
+        db.config.query.tile_cache_mb = budget_mb
+        if db.query_engine.tile_cache is not None:
+            db.query_engine.tile_cache.budget = budget_mb << 20
+        out["tile_budget_mb"] = budget_mb
+        cols_sql = ", ".join(f"m{i} DOUBLE" for i in range(metrics_n))
+        db.sql(
+            f"CREATE TABLE big (hostname STRING, ts TIMESTAMP(3) TIME INDEX,"
+            f" {cols_sql}, PRIMARY KEY (hostname))"
+            f" PARTITION BY HASH (hostname) PARTITIONS {n_parts}"
+            f" WITH (append_mode = 'true')"
+        )
+        n_hosts = 256
+        hosts_arr = np.array([f"host_{i:03d}" for i in range(n_hosts)])
+        chunk = 4_194_304
+        rng = np.random.default_rng(17)
+        gt_sum = np.zeros(n_hosts)
+        gt_cnt = np.zeros(n_hosts, np.int64)
+        t0 = time.perf_counter()
+        done = 0
+        while done < LTH_ROWS:
+            n = min(chunk, LTH_ROWS - done)
+            hidx = np.arange(done, done + n) % n_hosts
+            ts = T0 + np.arange(done, done + n, dtype=np.int64) * 50
+            vals = {f"m{i}": rng.uniform(0, 100, n) for i in range(metrics_n)}
+            batch = pa.table({
+                "hostname": pa.array(hosts_arr[hidx]),
+                "ts": pa.array(ts, pa.timestamp("ms")),
+                **{k: pa.array(v) for k, v in vals.items()},
+            })
+            db.insert_rows("big", batch)
+            np.add.at(gt_sum, hidx, vals["m0"])
+            np.add.at(gt_cnt, hidx, 1)
+            done += n
+            if _elapsed() > BUDGET_S + 900:
+                out["ingest_aborted_at_rows"] = done
+                return out
+        db.storage.flush_all()
+        out["ingest_s"] = round(time.perf_counter() - t0, 1)
+        _emit({"event": "lth_ingested", "rows": done,
+               "secs": out["ingest_s"], "elapsed_s": round(_elapsed(), 1)})
+
+        agg = ", ".join(
+            f"sum(m{i}) AS s{i}, avg(m{i}) AS a{i}" for i in range(metrics_n)
+        )
+        sql = (f"SELECT hostname, count(*) AS c, {agg} FROM big"
+               f" GROUP BY hostname ORDER BY hostname")
+        stream0 = m.TILE_STREAM_QUERIES.get()
+        try:
+            db.config.query.timeout_s = 900.0
+            t0 = time.perf_counter()
+            table = db.sql_one(sql)
+            out["cold_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+            out["streamed"] = m.TILE_STREAM_QUERIES.get() > stream0
+            chunk_ms = list(tc.LAST_STREAM_CHUNK_MS)
+            if chunk_ms:
+                med = float(np.median(chunk_ms))
+                out["region_ms_median"] = round(med, 1)
+                out["region_ms_max"] = round(max(chunk_ms), 1)
+                out["regions"] = len(chunk_ms)
+                if len(chunk_ms) > 2:
+                    # region 0 pays the one-off XLA compile; flatness is
+                    # about the steady state the 1B-row trajectory rides
+                    tail = chunk_ms[1:]
+                    out["region_flatness_excl_compile"] = round(
+                        max(tail) / max(float(np.median(tail)), 1e-9), 2
+                    )
+            cache = db.query_engine.tile_cache
+            if cache is not None:
+                out["resident_mb_after"] = cache._used >> 20
+            # one warm rep: planes re-stream (they were released), host
+            # consolidation + dictionary cached
+            db.config.query.timeout_s = 600.0
+            t0 = time.perf_counter()
+            table = db.sql_one(sql)
+            out["warm_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+            if tc.LAST_STREAM_CHUNK_MS:
+                warm_chunks = list(tc.LAST_STREAM_CHUNK_MS)
+                out["warm_region_ms_median"] = round(
+                    float(np.median(warm_chunks)), 1
+                )
+                if len(warm_chunks) > 1:
+                    out["warm_region_flatness"] = round(
+                        max(warm_chunks)
+                        / max(float(np.median(warm_chunks)), 1e-9), 2
+                    )
+            # verify against independent numpy ground truth
+            got_h = table["hostname"].to_pylist()
+            got_c = table["c"].to_pylist()
+            got_s = table["s0"].to_pylist()
+            ok = len(got_h) == n_hosts
+            for h, c, s in zip(got_h, got_c, got_s):
+                i = int(h.split("_")[1])
+                ok = ok and c == int(gt_cnt[i]) and abs(
+                    s - gt_sum[i]
+                ) < 1e-7 * max(abs(gt_sum[i]), 1.0)
+            out["verified"] = bool(ok)
+        finally:
+            db.config.query.timeout_s = 0.0
+    except Exception as e:  # noqa: BLE001 — probe must never kill the bench
+        out["error"] = repr(e)
+    finally:
+        if db is not None:
+            try:
+                db.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if home is not None:
+            shutil.rmtree(home, ignore_errors=True)
+    return out
 
 
 def main():
@@ -507,6 +650,25 @@ def main():
                            "rows_out": int(_parts[2])})
         except Exception as e:  # noqa: BLE001 — probe must never kill the bench
             detail["cold_probe_error"] = repr(e)
+
+    # ---- larger-than-HBM probe ---------------------------------------------
+    if not budget_hit and LTH_ROWS > 0 and _elapsed() < LTH_START_MAX_S:
+        try:
+            detail["larger_than_hbm"] = _larger_than_hbm_probe()
+        except Exception as e:  # noqa: BLE001 — probe must never kill the bench
+            detail["larger_than_hbm"] = {"error": repr(e)}
+        _emit({"event": "larger_than_hbm",
+               **detail["larger_than_hbm"],
+               "elapsed_s": round(_elapsed(), 1)})
+        _write_partial({"detail": detail, "queries": results})
+    elif LTH_ROWS > 0:
+        detail["larger_than_hbm"] = {
+            "skipped": (
+                "TSBS wall budget exhausted" if budget_hit
+                else f"elapsed {round(_elapsed())}s past start cutoff "
+                     f"{round(LTH_START_MAX_S)}s"
+            )
+        }
 
     # ---- summary -----------------------------------------------------------
     detail["hbm_tile_cache"] = (

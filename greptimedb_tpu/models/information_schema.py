@@ -58,7 +58,13 @@ def _tables(db) -> pa.Table:
 def _columns(db) -> pa.Table:
     rows = {"table_schema": [], "table_name": [], "column_name": [], "data_type": [],
             "semantic_type": [], "is_nullable": [], "column_default": []}
+    rows["column_key"] = []
     sem_names = {SemanticType.TAG: "TAG", SemanticType.FIELD: "FIELD", SemanticType.TIMESTAMP: "TIMESTAMP"}
+    # column_key mirrors the reference's columns view (information_schema
+    # columns.rs): PRI for primary-key members, TIME INDEX for the time
+    # index, empty for fields
+    keys = {SemanticType.TAG: "PRI", SemanticType.TIMESTAMP: "TIME INDEX",
+            SemanticType.FIELD: ""}
     for database in db.catalog.databases():
         for meta in db.catalog.tables(database):
             for c in meta.schema.columns:
@@ -69,6 +75,7 @@ def _columns(db) -> pa.Table:
                 rows["semantic_type"].append(sem_names[c.semantic_type])
                 rows["is_nullable"].append("YES" if c.nullable else "NO")
                 rows["column_default"].append(str(c.default) if c.default is not None else None)
+                rows["column_key"].append(keys[c.semantic_type])
     return pa.table(rows)
 
 
@@ -99,6 +106,27 @@ def _engines(db) -> pa.Table:
             ],
         }
     )
+
+
+def _region_peers(db) -> pa.Table:
+    """information_schema.region_peers (reference
+    common/catalog information_schema/region_peers.rs): one row per
+    region with its hosting peer; standalone hosts everything on peer 0."""
+    rows = {"table_catalog": [], "table_schema": [], "table_name": [],
+            "region_id": [], "peer_id": [], "peer_addr": [], "is_leader": [],
+            "status": []}
+    for database in db.catalog.databases():
+        for meta in db.catalog.tables(database):
+            for rid in meta.region_ids:
+                rows["table_catalog"].append("greptime")
+                rows["table_schema"].append(database)
+                rows["table_name"].append(meta.name)
+                rows["region_id"].append(rid)
+                rows["peer_id"].append(0)
+                rows["peer_addr"].append("")
+                rows["is_leader"].append("Yes")
+                rows["status"].append("ALIVE")
+    return pa.table(rows)
 
 
 def _cluster_info(db) -> pa.Table:
@@ -200,6 +228,7 @@ _TABLES = {
     "tables": _tables,
     "columns": _columns,
     "region_statistics": _region_statistics,
+    "region_peers": _region_peers,
     "engines": _engines,
     "cluster_info": _cluster_info,
     "process_list": _process_list,

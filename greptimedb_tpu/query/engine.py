@@ -245,7 +245,9 @@ class QueryEngine:
                 # the device-path failure from operators (a silent
                 # catch here masked a TPU-only lowering bug once)
                 logging.getLogger("greptimedb_tpu.query").warning(
-                    "tpu path failed; serving from cpu", exc_info=True
+                    "tpu path failed; serving from cpu (tile cache: %s)",
+                    self.tile_cache.stats() if self.tile_cache else {},
+                    exc_info=True,
                 )
                 with span("query.cpu_fallback"):
                     return self.cpu.execute(plan)

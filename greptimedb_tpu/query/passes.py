@@ -83,6 +83,13 @@ register(
     "layout",
 )
 register(
+    "stream_spill",
+    "working sets larger than the HBM budget execute region-by-region: "
+    "build planes, dispatch partials, merge [G] states, release — peak "
+    "HBM stays one region's working set",
+    "layout",
+)
+register(
     "chunk_placement",
     "place 2^24-row tile chunks round-robin across local devices with "
     "N:1 state merge",

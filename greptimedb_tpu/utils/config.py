@@ -136,6 +136,14 @@ class QueryConfig:
     # data_home; empty disables.
     tile_persist_enable: bool = True
     tile_persist_dir: str = ""
+    # Region-streamed execution for working sets LARGER THAN the HBM
+    # budget (parallel/tile_cache.py _streamed_execute): when the
+    # estimated device planes of a query exceed tile_stream_threshold x
+    # tile_cache_mb, regions build -> dispatch -> merge states -> release
+    # one at a time, so peak HBM stays one region's working set (the
+    # 1B-row trajectory: per-region latency is flat, total is linear).
+    tile_stream_enable: bool = True
+    tile_stream_threshold: float = 0.6
     # Accumulation mode for tile-path sum/avg: "limb" routes them through
     # the MXU fixed-point kernel (ops/aggregate.py limb_segment_sums; one
     # batched matmul for every column).  Precision: ~1e-9 relative

@@ -1,0 +1,14 @@
+-- BETWEEN / IN predicates push below the region merge
+CREATE TABLE bid (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 4;
+
+INSERT INTO bid VALUES ('h0', 1000, 1.0), ('h1', 2000, 5.0), ('h2', 3000, 10.0), ('h3', 4000, 15.0), ('h4', 5000, 20.0), ('h5', 6000, 25.0);
+
+SELECT host FROM bid WHERE v BETWEEN 5 AND 20 ORDER BY host;
+
+SELECT host FROM bid WHERE host IN ('h1', 'h4', 'h5') ORDER BY host;
+
+SELECT count(*) AS c FROM bid WHERE ts BETWEEN 2000 AND 5000;
+
+SELECT host FROM bid WHERE v NOT BETWEEN 5 AND 20 ORDER BY host;
+
+DROP TABLE bid;

@@ -1,0 +1,12 @@
+-- DISTINCT dedupes across region boundaries
+CREATE TABLE dsp (host STRING, dc STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host, dc)) PARTITION BY HASH (host) PARTITIONS 4;
+
+INSERT INTO dsp VALUES ('h0', 'east', 1000, 1.0), ('h1', 'east', 1000, 2.0), ('h2', 'west', 1000, 3.0), ('h3', 'east', 1000, 4.0), ('h4', 'west', 1000, 5.0), ('h5', 'north', 1000, 6.0);
+
+SELECT DISTINCT dc FROM dsp ORDER BY dc;
+
+SELECT count(DISTINCT dc) AS dcs FROM dsp;
+
+SELECT DISTINCT dc, v > 3.5 AS big FROM dsp ORDER BY dc, big;
+
+DROP TABLE dsp;

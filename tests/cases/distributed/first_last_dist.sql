@@ -1,0 +1,12 @@
+-- first/last_value states merge in ts order across regions
+CREATE TABLE fld (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 4;
+
+INSERT INTO fld VALUES ('h0', 3000, 30.0), ('h0', 1000, 10.0), ('h1', 2000, 5.0), ('h1', 4000, 45.0), ('h2', 1000, 7.0), ('h3', 5000, 50.0);
+
+SELECT host, first_value(v) AS f, last_value(v) AS l FROM fld GROUP BY host ORDER BY host;
+
+SELECT last_value(v) AS newest FROM fld;
+
+SELECT min(ts) AS lo, max(ts) AS hi FROM fld;
+
+DROP TABLE fld;

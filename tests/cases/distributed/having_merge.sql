@@ -1,0 +1,12 @@
+-- HAVING evaluated on merged cross-region aggregate states
+CREATE TABLE hm (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 4;
+
+INSERT INTO hm VALUES ('h0', 1000, 1.0), ('h0', 2000, 2.0), ('h1', 1000, 10.0), ('h2', 1000, 5.0), ('h2', 2000, 6.0), ('h2', 3000, 7.0), ('h3', 1000, 100.0);
+
+SELECT host, count(*) AS c FROM hm GROUP BY host HAVING count(*) > 1 ORDER BY host;
+
+SELECT host, sum(v) AS s FROM hm GROUP BY host HAVING sum(v) >= 10 ORDER BY host;
+
+SELECT host, avg(v) AS a FROM hm GROUP BY host HAVING avg(v) > 2 AND count(*) >= 2 ORDER BY host;
+
+DROP TABLE hm;

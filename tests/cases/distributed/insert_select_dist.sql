@@ -1,0 +1,16 @@
+-- INSERT .. SELECT through the frontend re-partitions derived rows
+CREATE TABLE isd_src (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 4;
+
+CREATE TABLE isd_dst (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 4;
+
+INSERT INTO isd_src VALUES ('h0', 1000, 1.0), ('h1', 2000, 2.0), ('h2', 3000, 3.0), ('h3', 4000, 4.0);
+
+INSERT INTO isd_dst SELECT host, ts, v FROM isd_src WHERE v > 1.5;
+
+SELECT host, v FROM isd_dst ORDER BY host;
+
+SELECT count(*) AS c FROM isd_dst;
+
+DROP TABLE isd_src;
+
+DROP TABLE isd_dst;

@@ -1,0 +1,20 @@
+-- Several partitioned tables in one session; cross-table scalar subquery
+CREATE TABLE mt_a (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 4;
+
+CREATE TABLE mt_b (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 2;
+
+INSERT INTO mt_a VALUES ('h0', 1000, 1.0), ('h1', 1000, 2.0), ('h2', 1000, 3.0);
+
+INSERT INTO mt_b VALUES ('h0', 1000, 10.0), ('h1', 1000, 20.0);
+
+SELECT host FROM mt_a WHERE v > (SELECT avg(v) FROM mt_a) ORDER BY host;
+
+SELECT count(*) AS na FROM mt_a;
+
+SELECT count(*) AS nb FROM mt_b;
+
+SHOW TABLES;
+
+DROP TABLE mt_a;
+
+DROP TABLE mt_b;

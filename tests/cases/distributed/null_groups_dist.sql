@@ -1,0 +1,12 @@
+-- NULL tag groups merge correctly across regions with NULLS placement
+CREATE TABLE ngd (host STRING, dc STRING NULL, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host, dc)) PARTITION BY HASH (host) PARTITIONS 4;
+
+INSERT INTO ngd VALUES ('h0', 'east', 1000, 1.0), ('h1', NULL, 1000, 2.0), ('h2', 'west', 1000, 3.0), ('h3', NULL, 1000, 4.0), ('h4', 'east', 1000, 5.0);
+
+SELECT dc, sum(v) AS s FROM ngd GROUP BY dc ORDER BY dc NULLS LAST;
+
+SELECT dc, count(*) AS c FROM ngd GROUP BY dc ORDER BY dc NULLS FIRST;
+
+SELECT count(*) AS null_rows FROM ngd WHERE dc IS NULL;
+
+DROP TABLE ngd;

@@ -1,0 +1,18 @@
+-- ADMIN functions: flush/compact and querying after (reference common/admin)
+CREATE TABLE afc (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO afc VALUES ('a', 1000, 1.0), ('b', 2000, 2.0);
+
+ADMIN flush_table('afc');
+
+INSERT INTO afc VALUES ('c', 3000, 3.0);
+
+ADMIN flush_table('afc');
+
+ADMIN compact_table('afc');
+
+SELECT host, v FROM afc ORDER BY host;
+
+SELECT count(*) AS c FROM afc;
+
+DROP TABLE afc;

@@ -1,0 +1,14 @@
+-- Aggregates over computed expressions (reference common/select aggregates over exprs)
+CREATE TABLE ae (host STRING, ts TIMESTAMP TIME INDEX, a DOUBLE, b DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO ae VALUES ('x', 1000, 1, 10), ('x', 2000, 2, 20), ('y', 1000, 3, 30), ('y', 2000, 4, 40);
+
+SELECT host, sum(a + b) AS s, avg(a * b) AS p FROM ae GROUP BY host ORDER BY host;
+
+SELECT host, max(b - a) AS mx, min(b / a) AS mn FROM ae GROUP BY host ORDER BY host;
+
+SELECT sum(a) + sum(b) AS total FROM ae;
+
+SELECT host, sum(a) / count(*) AS manual_avg, avg(a) AS built_avg FROM ae GROUP BY host ORDER BY host;
+
+DROP TABLE ae;

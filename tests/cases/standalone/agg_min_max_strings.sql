@@ -1,0 +1,12 @@
+-- min/max over string and timestamp columns (reference common/select minmax types)
+CREATE TABLE ms (host STRING, ts TIMESTAMP TIME INDEX, name STRING, PRIMARY KEY (host));
+
+INSERT INTO ms VALUES ('a', 1000, 'pear'), ('a', 2000, 'apple'), ('b', 3000, 'zebra'), ('b', 4000, 'mango');
+
+SELECT host, min(name) AS mn, max(name) AS mx FROM ms GROUP BY host ORDER BY host;
+
+SELECT min(ts) AS first_ts, max(ts) AS last_ts FROM ms;
+
+SELECT min(name) AS global_min FROM ms;
+
+DROP TABLE ms;

@@ -1,0 +1,18 @@
+-- ALTER TABLE add columns with defaults; old rows backfill (reference alter cases)
+CREATE TABLE acd (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO acd VALUES ('a', 1000, 1.0), ('b', 2000, 2.0);
+
+ALTER TABLE acd ADD COLUMN w DOUBLE DEFAULT 7.5;
+
+SELECT host, v, w FROM acd ORDER BY host;
+
+INSERT INTO acd VALUES ('c', 3000, 3.0, 9.0);
+
+SELECT host, v, w FROM acd ORDER BY host;
+
+ALTER TABLE acd ADD COLUMN note STRING;
+
+SELECT host, w, note FROM acd ORDER BY host;
+
+DROP TABLE acd;

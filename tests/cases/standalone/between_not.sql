@@ -1,0 +1,14 @@
+-- NOT BETWEEN / NOT IN complements (reference common/select between)
+CREATE TABLE bn (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO bn VALUES ('a', 1000, 1), ('b', 2000, 5), ('c', 3000, 10), ('d', 4000, 15);
+
+SELECT host FROM bn WHERE v NOT BETWEEN 4 AND 11 ORDER BY host;
+
+SELECT host FROM bn WHERE host NOT IN ('a', 'd') ORDER BY host;
+
+SELECT host FROM bn WHERE ts NOT BETWEEN 1500 AND 3500 ORDER BY host;
+
+SELECT count(*) AS c FROM bn WHERE v BETWEEN 1 AND 15 AND host NOT IN ('b');
+
+DROP TABLE bn;

@@ -1,0 +1,16 @@
+-- Boolean columns: aggregation, filtering, ordering (reference common/types/boolean)
+CREATE TABLE bl (host STRING, ts TIMESTAMP TIME INDEX, up BOOLEAN, PRIMARY KEY (host));
+
+INSERT INTO bl VALUES ('a', 1000, true), ('b', 2000, false), ('c', 3000, true), ('d', 4000, NULL);
+
+SELECT host, up FROM bl ORDER BY host;
+
+SELECT count(*) AS n_up FROM bl WHERE up;
+
+SELECT count(*) AS n_down FROM bl WHERE NOT up;
+
+SELECT count(up) AS non_null, count(*) AS total FROM bl;
+
+SELECT host FROM bl WHERE up IS NULL;
+
+DROP TABLE bl;

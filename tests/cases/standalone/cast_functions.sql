@@ -1,0 +1,14 @@
+-- CAST/:: conversions across types (reference common/select cast cases)
+CREATE TABLE cf (host STRING, ts TIMESTAMP TIME INDEX, d DOUBLE, i BIGINT, s STRING, PRIMARY KEY (host));
+
+INSERT INTO cf VALUES ('a', 1000, 3.99, 42, '17'), ('b', 2000, -2.5, -7, '99');
+
+SELECT host, CAST(d AS BIGINT) AS di, CAST(i AS DOUBLE) AS idd FROM cf ORDER BY host;
+
+SELECT host, CAST(s AS BIGINT) AS si, s::DOUBLE AS sd FROM cf ORDER BY host;
+
+SELECT host, CAST(i AS STRING) AS is2, CAST(d AS STRING) AS ds FROM cf ORDER BY host;
+
+SELECT host, CAST(ts AS BIGINT) AS tsi FROM cf ORDER BY host;
+
+DROP TABLE cf;

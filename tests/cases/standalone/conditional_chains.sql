@@ -1,0 +1,14 @@
+-- coalesce/nullif/ifnull chains (reference common/function/conditional)
+CREATE TABLE cc (host STRING, ts TIMESTAMP TIME INDEX, a DOUBLE, b DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO cc VALUES ('x', 1000, NULL, 5.0), ('y', 2000, 3.0, NULL), ('z', 3000, NULL, NULL);
+
+SELECT host, coalesce(a, b, 0.0) AS c FROM cc ORDER BY host;
+
+SELECT host, ifnull(a, -1.0) AS ia, isnull(b) AS nb FROM cc ORDER BY host;
+
+SELECT host, nullif(coalesce(a, b, 9.0), 9.0) AS n FROM cc ORDER BY host;
+
+SELECT host, CASE WHEN a IS NULL AND b IS NULL THEN 'both' WHEN a IS NULL THEN 'a' ELSE 'none' END AS missing FROM cc ORDER BY host;
+
+DROP TABLE cc;

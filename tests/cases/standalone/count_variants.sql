@@ -1,0 +1,12 @@
+-- count(*) vs count(col) vs count(DISTINCT) null handling (reference common/select/count)
+CREATE TABLE cv (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO cv VALUES ('a', 1000, 1.0), ('a', 2000, NULL), ('b', 1000, 2.0), ('b', 2000, 2.0), ('c', 1000, NULL);
+
+SELECT count(*) AS star, count(v) AS col, count(DISTINCT v) AS dist FROM cv;
+
+SELECT host, count(*) AS star, count(v) AS col FROM cv GROUP BY host ORDER BY host;
+
+SELECT count(DISTINCT host) AS hosts FROM cv;
+
+DROP TABLE cv;

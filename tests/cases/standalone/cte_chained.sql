@@ -1,0 +1,10 @@
+-- Chained CTEs referencing earlier CTEs (reference common/select cte)
+CREATE TABLE ctc (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO ctc VALUES ('a', 1000, 1), ('b', 2000, 4), ('c', 3000, 9), ('d', 4000, 16);
+
+WITH doubled AS (SELECT host, v * 2 AS d FROM ctc), big AS (SELECT host, d FROM doubled WHERE d > 4) SELECT host, d FROM big ORDER BY host;
+
+WITH stats AS (SELECT avg(v) AS m FROM ctc) SELECT host FROM ctc, stats WHERE v > m ORDER BY host;
+
+DROP TABLE ctc;

@@ -1,0 +1,14 @@
+-- date_part/extract over timestamps (reference common/function/datetime)
+CREATE TABLE dp (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO dp VALUES ('a', 1719849600000, 1.0), ('b', 1735689600000, 2.0);
+
+SELECT host, date_part('year', ts) AS y, date_part('month', ts) AS m, date_part('day', ts) AS d FROM dp ORDER BY host;
+
+SELECT host, date_part('dow', ts) AS dow, date_part('doy', ts) AS doy, date_part('quarter', ts) AS q FROM dp ORDER BY host;
+
+SELECT host, to_unixtime(ts) AS u FROM dp ORDER BY host;
+
+SELECT host, date_format(ts, '%Y-%m-%d') AS f FROM dp ORDER BY host;
+
+DROP TABLE dp;

@@ -1,0 +1,18 @@
+-- DELETE with varied predicates (reference common/delete)
+CREATE TABLE dw (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO dw VALUES ('a', 1000, 1), ('a', 2000, 2), ('b', 1000, 10), ('b', 2000, 20), ('c', 1000, 100);
+
+DELETE FROM dw WHERE host = 'c';
+
+SELECT host, count(*) AS c FROM dw GROUP BY host ORDER BY host;
+
+DELETE FROM dw WHERE host = 'a' AND ts = 1000;
+
+SELECT host, ts, v FROM dw ORDER BY host, ts;
+
+DELETE FROM dw;
+
+SELECT count(*) AS remaining FROM dw;
+
+DROP TABLE dw;

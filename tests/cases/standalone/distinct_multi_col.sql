@@ -1,0 +1,12 @@
+-- SELECT DISTINCT over multiple columns and expressions (reference common/select distinct)
+CREATE TABLE dm (host STRING, dc STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host, dc));
+
+INSERT INTO dm VALUES ('a', 'e', 1000, 1), ('a', 'e', 2000, 1), ('a', 'w', 3000, 2), ('b', 'e', 4000, 1), ('b', 'e', 5000, 3);
+
+SELECT DISTINCT host, dc FROM dm ORDER BY host, dc;
+
+SELECT DISTINCT v FROM dm ORDER BY v;
+
+SELECT DISTINCT host, v > 1.5 AS big FROM dm ORDER BY host, big;
+
+DROP TABLE dm;

@@ -1,0 +1,8 @@
+-- EXPLAIN renders the static optimizer-pass pipeline (reference query/src/optimizer rules surfaced via EXPLAIN)
+CREATE TABLE ep (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO ep VALUES ('a', 1000, 1.0), ('b', 2000, 2.0);
+
+EXPLAIN SELECT host, time_bucket('1s', ts) AS tb, avg(v) AS a FROM ep WHERE ts >= 0 AND ts < 10000 GROUP BY host, tb;
+
+DROP TABLE ep;

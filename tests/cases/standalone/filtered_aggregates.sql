@@ -1,0 +1,14 @@
+-- Aggregates constrained by rich WHERE combos (reference common/select filters + aggr)
+CREATE TABLE fa (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, flag BOOLEAN, PRIMARY KEY (host));
+
+INSERT INTO fa VALUES ('a', 1000, 1, true), ('a', 2000, 2, false), ('a', 3000, 3, true), ('b', 1000, 10, false), ('b', 2000, 20, true);
+
+SELECT host, sum(v) AS s FROM fa WHERE flag GROUP BY host ORDER BY host;
+
+SELECT host, count(*) AS c FROM fa WHERE NOT flag OR v > 15 GROUP BY host ORDER BY host;
+
+SELECT host, avg(v) AS a FROM fa WHERE v BETWEEN 2 AND 20 AND ts < 3000 GROUP BY host ORDER BY host;
+
+SELECT count(*) AS c FROM fa WHERE host IN ('a', 'b') AND flag = true;
+
+DROP TABLE fa;

@@ -1,0 +1,12 @@
+-- first_value/last_value ordering semantics per group (reference common/select first_last)
+CREATE TABLE flb (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO flb VALUES ('a', 3000, 30), ('a', 1000, 10), ('a', 2000, 20), ('b', 2000, 5), ('b', 1000, 50);
+
+SELECT host, first_value(v) AS f, last_value(v) AS l FROM flb GROUP BY host ORDER BY host;
+
+SELECT last_value(v) AS newest FROM flb;
+
+SELECT host, last_value(ts) AS last_ts FROM flb GROUP BY host ORDER BY host;
+
+DROP TABLE flb;

@@ -1,0 +1,12 @@
+-- GROUP BY / ORDER BY ordinal positions (reference common/select positions)
+CREATE TABLE gp (host STRING, dc STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host, dc));
+
+INSERT INTO gp VALUES ('a', 'dc1', 1000, 1), ('a', 'dc2', 2000, 2), ('b', 'dc1', 3000, 3), ('b', 'dc2', 4000, 4);
+
+SELECT host, sum(v) AS s FROM gp GROUP BY 1 ORDER BY 1;
+
+SELECT host, dc, sum(v) AS s FROM gp GROUP BY 1, 2 ORDER BY 1, 2;
+
+SELECT host, sum(v) AS s FROM gp GROUP BY host ORDER BY 2 DESC;
+
+DROP TABLE gp;

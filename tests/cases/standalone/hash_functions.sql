@@ -1,0 +1,12 @@
+-- Hash/digest scalar functions (reference common/function md5/sha256/hex)
+CREATE TABLE hf (host STRING, ts TIMESTAMP TIME INDEX, v BIGINT, PRIMARY KEY (host));
+
+INSERT INTO hf VALUES ('a', 1000, 255), ('b', 2000, 4096);
+
+SELECT host, md5(host) AS m FROM hf ORDER BY host;
+
+SELECT host, sha256(host) AS s FROM hf ORDER BY host;
+
+SELECT host, hex(v) AS h FROM hf ORDER BY host;
+
+DROP TABLE hf;

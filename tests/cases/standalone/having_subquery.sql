@@ -1,0 +1,10 @@
+-- HAVING with subquery comparisons (reference common/select having+subquery)
+CREATE TABLE hs (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO hs VALUES ('a', 1000, 1), ('a', 2000, 2), ('b', 1000, 10), ('b', 2000, 20), ('c', 1000, 100);
+
+SELECT host, sum(v) AS s FROM hs GROUP BY host HAVING sum(v) > (SELECT avg(v) FROM hs) ORDER BY host;
+
+SELECT host, count(*) AS c FROM hs GROUP BY host HAVING count(*) = (SELECT max(c) FROM (SELECT count(*) AS c FROM hs GROUP BY host) t) ORDER BY host;
+
+DROP TABLE hs;

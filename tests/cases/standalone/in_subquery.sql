@@ -1,0 +1,18 @@
+-- IN / NOT IN with subqueries (reference common/select in_subquery)
+CREATE TABLE iq_main (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+CREATE TABLE iq_allow (host STRING, ts TIMESTAMP TIME INDEX, PRIMARY KEY (host));
+
+INSERT INTO iq_main VALUES ('a', 1000, 1), ('b', 2000, 2), ('c', 3000, 3);
+
+INSERT INTO iq_allow VALUES ('a', 1000), ('c', 1000);
+
+SELECT host FROM iq_main WHERE host IN (SELECT host FROM iq_allow) ORDER BY host;
+
+SELECT host FROM iq_main WHERE host NOT IN (SELECT host FROM iq_allow) ORDER BY host;
+
+SELECT host FROM iq_main WHERE v IN (SELECT max(v) FROM iq_main);
+
+DROP TABLE iq_main;
+
+DROP TABLE iq_allow;

@@ -1,0 +1,14 @@
+-- information_schema runtime views: region_peers/partitions shapes (reference information_schema cases)
+CREATE TABLE isr (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 2;
+
+INSERT INTO isr VALUES ('a', 1000, 1.0), ('b', 2000, 2.0);
+
+SELECT count(*) AS parts FROM information_schema.partitions WHERE table_name = 'isr';
+
+SELECT count(*) AS peers FROM information_schema.region_peers;
+
+SELECT table_schema, table_name FROM information_schema.tables WHERE table_name = 'isr';
+
+SELECT column_name, column_key FROM information_schema.columns WHERE table_name = 'isr' ORDER BY column_name;
+
+DROP TABLE isr;

@@ -1,0 +1,14 @@
+-- Multi-row inserts with partial column lists and defaults (reference common/insert)
+CREATE TABLE imt (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE DEFAULT 1.5, note STRING NULL, PRIMARY KEY (host));
+
+INSERT INTO imt (host, ts) VALUES ('a', 1000), ('b', 2000);
+
+INSERT INTO imt (host, ts, v) VALUES ('c', 3000, 9.0);
+
+INSERT INTO imt (host, ts, note) VALUES ('d', 4000, 'hello');
+
+SELECT host, v, note FROM imt ORDER BY host;
+
+SELECT count(*) AS defaulted FROM imt WHERE v = 1.5;
+
+DROP TABLE imt;

@@ -1,0 +1,14 @@
+-- Interval arithmetic with timestamps (reference common/types/interval)
+CREATE TABLE iv (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO iv VALUES ('a', '2026-03-01 00:00:00', 1.0), ('b', '2026-03-01 06:30:00', 2.0);
+
+SELECT host, ts + INTERVAL '1 hour' AS plus_h FROM iv ORDER BY host;
+
+SELECT host, ts - INTERVAL '30 minutes' AS minus_m FROM iv ORDER BY host;
+
+SELECT host FROM iv WHERE ts > '2026-03-01 00:00:00'::TIMESTAMP + INTERVAL '1 hour';
+
+SELECT host, ts + INTERVAL '2 days' AS plus_d FROM iv ORDER BY host;
+
+DROP TABLE iv;

@@ -1,0 +1,20 @@
+-- Joins with table aliases and mixed conditions (reference common/select join)
+CREATE TABLE jm (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+CREATE TABLE jd (host STRING, ts TIMESTAMP TIME INDEX, dc STRING, PRIMARY KEY (host));
+
+INSERT INTO jm VALUES ('a', 1000, 1.5), ('b', 2000, 2.5), ('c', 3000, 3.5);
+
+INSERT INTO jd VALUES ('a', 1000, 'east'), ('b', 2000, 'west');
+
+SELECT m.host, m.v, d.dc FROM jm m JOIN jd d ON m.host = d.host ORDER BY m.host;
+
+SELECT m.host, m.v, d.dc FROM jm m LEFT JOIN jd d ON m.host = d.host ORDER BY m.host;
+
+SELECT m.host FROM jm m JOIN jd d ON m.host = d.host AND d.dc = 'east';
+
+SELECT count(*) AS pairs FROM jm m, jd d WHERE m.host = d.host;
+
+DROP TABLE jm;
+
+DROP TABLE jd;

@@ -1,0 +1,14 @@
+-- LIKE/NOT LIKE pattern corners (reference common/select like)
+CREATE TABLE le (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO le VALUES ('web-01', 1000, 1), ('web-02', 2000, 2), ('db-01', 3000, 3), ('cache_x', 4000, 4);
+
+SELECT host FROM le WHERE host LIKE 'web-%' ORDER BY host;
+
+SELECT host FROM le WHERE host LIKE '%-0_' ORDER BY host;
+
+SELECT host FROM le WHERE host NOT LIKE '%-%' ORDER BY host;
+
+SELECT host FROM le WHERE host ILIKE 'WEB%' ORDER BY host;
+
+DROP TABLE le;

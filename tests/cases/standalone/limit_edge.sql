@@ -1,0 +1,14 @@
+-- LIMIT/OFFSET edges: zero, beyond cardinality, with ties (reference common/select limit)
+CREATE TABLE lim (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO lim VALUES ('a', 1000, 1), ('b', 2000, 2), ('c', 3000, 3), ('d', 4000, 4);
+
+SELECT host FROM lim ORDER BY host LIMIT 0;
+
+SELECT host FROM lim ORDER BY host LIMIT 100;
+
+SELECT host FROM lim ORDER BY host LIMIT 2 OFFSET 3;
+
+SELECT host FROM lim ORDER BY host OFFSET 2;
+
+DROP TABLE lim;

@@ -1,0 +1,16 @@
+-- Rounding/clamping math functions (reference common/function/math)
+CREATE TABLE mr (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO mr VALUES ('a', 1000, 2.567), ('b', 2000, -3.21), ('c', 3000, 9.999);
+
+SELECT host, round(v) AS r0, round(v, 1) AS r1, trunc(v) AS t FROM mr ORDER BY host;
+
+SELECT host, clamp(v, -1.0, 5.0) AS c, greatest(v, 0.0) AS g, least(v, 1.0) AS l FROM mr ORDER BY host;
+
+SELECT host, degrees(v) AS d, radians(v) AS ra FROM mr WHERE host = 'a';
+
+SELECT round(pi(), 4) AS p;
+
+SELECT host, cbrt(v) AS cb, atan2(v, 2.0) AS a2 FROM mr WHERE host = 'c';
+
+DROP TABLE mr;

@@ -1,0 +1,22 @@
+-- Metric engine: logical tables over one physical region (reference metric-engine cases)
+CREATE TABLE phy_ops (ts TIMESTAMP TIME INDEX, val DOUBLE) ENGINE = metric WITH (physical_metric_table = 'true');
+
+CREATE TABLE req_total (ts TIMESTAMP TIME INDEX, val DOUBLE, path STRING, PRIMARY KEY (path)) ENGINE = metric WITH (on_physical_table = 'phy_ops');
+
+CREATE TABLE err_total (ts TIMESTAMP TIME INDEX, val DOUBLE, code STRING, PRIMARY KEY (code)) ENGINE = metric WITH (on_physical_table = 'phy_ops');
+
+INSERT INTO req_total VALUES (1000, 5.0, '/api'), (2000, 7.0, '/web');
+
+INSERT INTO err_total VALUES (1000, 1.0, '500'), (2000, 2.0, '404');
+
+SELECT path, val FROM req_total ORDER BY path;
+
+SELECT code, val FROM err_total ORDER BY code;
+
+SELECT sum(val) AS s FROM req_total;
+
+DROP TABLE req_total;
+
+DROP TABLE err_total;
+
+DROP TABLE phy_ops;

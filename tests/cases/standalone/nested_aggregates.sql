@@ -1,0 +1,14 @@
+-- Aggregates of expressions of aggregates via subqueries (reference common/select nested agg)
+CREATE TABLE na (host STRING, dc STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host, dc));
+
+INSERT INTO na VALUES ('a', 'e', 1000, 1), ('a', 'w', 2000, 2), ('b', 'e', 3000, 4), ('b', 'w', 4000, 8), ('c', 'e', 5000, 16);
+
+SELECT max(s) AS max_per_host FROM (SELECT host, sum(v) AS s FROM na GROUP BY host) t;
+
+SELECT avg(c) AS avg_count FROM (SELECT dc, count(*) AS c FROM na GROUP BY dc) t;
+
+SELECT count(*) AS n_hosts FROM (SELECT host FROM na GROUP BY host) t;
+
+SELECT sum(mx) AS total_of_max FROM (SELECT host, max(v) AS mx FROM na GROUP BY host) t;
+
+DROP TABLE na;

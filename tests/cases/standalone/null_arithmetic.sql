@@ -1,0 +1,14 @@
+-- NULL propagation through arithmetic and comparisons (reference common/select null semantics)
+CREATE TABLE np (host STRING, ts TIMESTAMP TIME INDEX, a DOUBLE, b DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO np VALUES ('x', 1000, 1.0, NULL), ('y', 2000, NULL, 2.0), ('z', 3000, 3.0, 4.0);
+
+SELECT host, a + b AS s, a * b AS p FROM np ORDER BY host;
+
+SELECT host FROM np WHERE a > 0 ORDER BY host;
+
+SELECT host FROM np WHERE a IS NULL OR b IS NULL ORDER BY host;
+
+SELECT host, a IS NOT NULL AND b IS NOT NULL AS both_set FROM np ORDER BY host;
+
+DROP TABLE np;

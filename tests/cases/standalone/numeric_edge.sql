@@ -1,0 +1,12 @@
+-- Numeric edge cases: division by zero, modulo, negatives (reference common/select arithmetic edges)
+CREATE TABLE ne (host STRING, ts TIMESTAMP TIME INDEX, a DOUBLE, b BIGINT, PRIMARY KEY (host));
+
+INSERT INTO ne VALUES ('x', 1000, 7.5, -3), ('y', 2000, -7.5, 3), ('z', 3000, 0.0, 5);
+
+SELECT host, a % 2.0 AS m, b % 2 AS mi FROM ne ORDER BY host;
+
+SELECT host, abs(a) AS aa, abs(b) AS ab, sign(a) AS sa FROM ne ORDER BY host;
+
+SELECT host, a / b AS q FROM ne ORDER BY host;
+
+DROP TABLE ne;

@@ -1,0 +1,14 @@
+-- ORDER BY computed expressions and multiple directions (reference common/order)
+CREATE TABLE oe (host STRING, ts TIMESTAMP TIME INDEX, a DOUBLE, b DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO oe VALUES ('p', 1000, 1, 9), ('q', 2000, 2, 5), ('r', 3000, 3, 1), ('s', 4000, 4, 8);
+
+SELECT host, a + b AS s FROM oe ORDER BY a + b DESC;
+
+SELECT host, a, b FROM oe ORDER BY b DESC, a ASC;
+
+SELECT host FROM oe ORDER BY abs(b - 5.0), host;
+
+SELECT host, a * b AS p FROM oe ORDER BY 2 DESC LIMIT 2;
+
+DROP TABLE oe;

@@ -1,0 +1,12 @@
+-- NULL groups ordering with NULLS FIRST/LAST (reference common/order null groups)
+CREATE TABLE ng (host STRING, ts TIMESTAMP TIME INDEX, dc STRING NULL, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO ng VALUES ('a', 1000, 'east', 1), ('b', 2000, NULL, 2), ('c', 3000, 'west', 3), ('d', 4000, NULL, 4);
+
+SELECT dc, sum(v) AS s FROM ng GROUP BY dc ORDER BY dc NULLS FIRST;
+
+SELECT dc, sum(v) AS s FROM ng GROUP BY dc ORDER BY dc NULLS LAST;
+
+SELECT dc, count(*) AS c FROM ng GROUP BY dc ORDER BY dc DESC NULLS FIRST;
+
+DROP TABLE ng;

@@ -1,0 +1,12 @@
+-- RANGE queries with varied aggregate functions (reference range query cases)
+CREATE TABLE ra (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO ra VALUES ('a', 0, 1), ('a', 5000, 2), ('a', 10000, 3), ('a', 15000, 4), ('b', 0, 10), ('b', 10000, 30);
+
+SELECT ts, host, min(v) RANGE '10s' AS mn, max(v) RANGE '10s' AS mx FROM ra ALIGN '10s' ORDER BY host, ts;
+
+SELECT ts, host, sum(v) RANGE '10s' AS s, count(v) RANGE '10s' AS c FROM ra ALIGN '10s' ORDER BY host, ts;
+
+SELECT ts, host, first_value(v) RANGE '20s' AS f, last_value(v) RANGE '20s' AS l FROM ra ALIGN '20s' ORDER BY host, ts;
+
+DROP TABLE ra;

@@ -1,0 +1,12 @@
+-- regexp_match predicate filtering (reference common/function regexp)
+CREATE TABLE rf (host STRING, ts TIMESTAMP TIME INDEX, msg STRING, PRIMARY KEY (host));
+
+INSERT INTO rf VALUES ('a', 1000, 'error: disk full'), ('b', 2000, 'warn: slow io'), ('c', 3000, 'error: oom');
+
+SELECT host FROM rf WHERE regexp_match(msg, '^error') ORDER BY host;
+
+SELECT host, regexp_match(msg, 'disk|oom') AS m FROM rf ORDER BY host;
+
+SELECT count(*) AS errs FROM rf WHERE regexp_match(msg, 'error.*');
+
+DROP TABLE rf;

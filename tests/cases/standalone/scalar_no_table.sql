@@ -1,0 +1,12 @@
+-- Scalar SELECTs without a table (reference common/select scalar)
+SELECT 1 + 1 AS two;
+
+SELECT 'hello' AS greeting, 42 AS answer;
+
+SELECT round(sqrt(2.0), 4) AS r2;
+
+SELECT upper('abc') AS u, length('hello') AS l;
+
+SELECT CASE WHEN 1 < 2 THEN 'yes' ELSE 'no' END AS logic;
+
+SELECT coalesce(NULL, 'fallback') AS c;

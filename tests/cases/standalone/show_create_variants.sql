@@ -1,0 +1,12 @@
+-- SHOW CREATE TABLE round-trips options (reference show/show_create cases)
+CREATE TABLE scv (host STRING, ts TIMESTAMP(3) TIME INDEX, v DOUBLE DEFAULT 0.5, n BIGINT NULL, PRIMARY KEY (host)) WITH (append_mode = 'true');
+
+SHOW CREATE TABLE scv;
+
+CREATE TABLE scv2 (ts TIMESTAMP TIME INDEX, v DOUBLE);
+
+SHOW CREATE TABLE scv2;
+
+DROP TABLE scv;
+
+DROP TABLE scv2;

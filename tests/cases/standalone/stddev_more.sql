@@ -1,0 +1,12 @@
+-- Population vs sample stddev/variance (reference common/select stats)
+CREATE TABLE sv (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO sv VALUES ('a', 1000, 2), ('a', 2000, 4), ('a', 3000, 4), ('a', 4000, 4), ('a', 5000, 5), ('a', 6000, 5), ('a', 7000, 7), ('a', 8000, 9);
+
+SELECT round(stddev_pop(v), 6) AS sp, round(var_pop(v), 6) AS vp FROM sv;
+
+SELECT round(stddev(v), 6) AS ss, round(var_samp(v), 6) AS vs FROM sv;
+
+SELECT host, round(stddev_pop(v), 3) AS sp FROM sv GROUP BY host;
+
+DROP TABLE sv;

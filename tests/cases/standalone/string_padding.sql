@@ -1,0 +1,14 @@
+-- String padding/search functions (reference tests/cases/standalone/common/function/string)
+CREATE TABLE sp (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO sp VALUES ('alpha', 1000, 1.5), ('beta', 2000, 2.5), ('gamma', 3000, 3.5);
+
+SELECT host, lpad(host, 8, '.') AS lp, rpad(host, 8, '*') AS rp FROM sp ORDER BY host;
+
+SELECT host, strpos(host, 'a') AS p, repeat(host, 2) AS r FROM sp ORDER BY host;
+
+SELECT host, split_part(host, 'a', 1) AS s1, split_part(host, 'a', 2) AS s2 FROM sp ORDER BY host;
+
+SELECT host, starts_with(host, 'ga') AS sw, ends_with(host, 'ta') AS ew FROM sp ORDER BY host;
+
+DROP TABLE sp;

@@ -1,0 +1,12 @@
+-- time_bucket bucket sizes and grouping stability (reference common/function time_bucket)
+CREATE TABLE tbo (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO tbo VALUES ('a', 0, 1), ('a', 90000, 2), ('a', 180000, 3), ('a', 270000, 4);
+
+SELECT time_bucket('1m', ts) AS tb, sum(v) AS s FROM tbo GROUP BY tb ORDER BY tb;
+
+SELECT time_bucket('2m', ts) AS tb, count(*) AS c FROM tbo GROUP BY tb ORDER BY tb;
+
+SELECT time_bucket('90s', ts) AS tb, max(v) AS m FROM tbo GROUP BY tb ORDER BY tb;
+
+DROP TABLE tbo;

@@ -1,0 +1,14 @@
+-- Timestamp literal comparisons in WHERE (reference common/types/timestamp filters)
+CREATE TABLE tc (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO tc VALUES ('a', '2026-01-01 00:00:00', 1.0), ('b', '2026-01-01 12:00:00', 2.0), ('c', '2026-01-02 00:00:00', 3.0);
+
+SELECT host FROM tc WHERE ts >= '2026-01-01 06:00:00' ORDER BY host;
+
+SELECT host FROM tc WHERE ts = '2026-01-01 12:00:00';
+
+SELECT count(*) AS c FROM tc WHERE ts < '2026-01-02 00:00:00';
+
+SELECT host FROM tc WHERE ts > '2026-01-01 00:00:00' AND ts < '2026-01-02 00:00:00';
+
+DROP TABLE tc;

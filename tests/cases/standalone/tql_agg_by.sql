@@ -1,0 +1,16 @@
+-- TQL aggregation with by/without grouping (reference promql aggregate cases)
+CREATE TABLE ta (host STRING, dc STRING, greptime_value DOUBLE, greptime_timestamp TIMESTAMP(3) TIME INDEX, PRIMARY KEY (host, dc));
+
+INSERT INTO ta VALUES ('a', 'e', 1.0, 0), ('a', 'w', 2.0, 0), ('b', 'e', 4.0, 0), ('b', 'w', 8.0, 0);
+
+TQL EVAL (0, 0, '30s') sum by (host) (ta);
+
+TQL EVAL (0, 0, '30s') sum by (dc) (ta);
+
+TQL EVAL (0, 0, '30s') max(ta);
+
+TQL EVAL (0, 0, '30s') count(ta);
+
+TQL EVAL (0, 0, '30s') avg by (host) (ta);
+
+DROP TABLE ta;

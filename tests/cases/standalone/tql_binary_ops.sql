@@ -1,0 +1,14 @@
+-- TQL binary operations between selectors and scalars (reference promql binop cases)
+CREATE TABLE tb2 (host STRING, greptime_value DOUBLE, greptime_timestamp TIMESTAMP(3) TIME INDEX, PRIMARY KEY (host));
+
+INSERT INTO tb2 VALUES ('a', 4.0, 0), ('a', 8.0, 30000), ('b', 10.0, 0), ('b', 20.0, 30000);
+
+TQL EVAL (0, 30, '30s') tb2 * 2;
+
+TQL EVAL (0, 30, '30s') tb2 + 100;
+
+TQL EVAL (0, 30, '30s') tb2 / tb2;
+
+TQL EVAL (0, 30, '30s') -tb2;
+
+DROP TABLE tb2;

@@ -1,0 +1,18 @@
+-- TQL scalar functions over range vectors (reference promql function cases)
+CREATE TABLE tf2 (host STRING, greptime_value DOUBLE, greptime_timestamp TIMESTAMP(3) TIME INDEX, PRIMARY KEY (host));
+
+INSERT INTO tf2 VALUES ('a', 1.0, 0), ('a', 4.0, 15000), ('a', 9.0, 30000), ('a', 16.0, 45000), ('a', 25.0, 60000);
+
+TQL EVAL (60, 60, '60s') max_over_time(tf2[60s]);
+
+TQL EVAL (60, 60, '60s') min_over_time(tf2[60s]);
+
+TQL EVAL (60, 60, '60s') avg_over_time(tf2[60s]);
+
+TQL EVAL (60, 60, '60s') count_over_time(tf2[60s]);
+
+TQL EVAL (0, 60, '30s') sqrt(tf2);
+
+TQL EVAL (0, 60, '30s') clamp(tf2, 2, 20);
+
+DROP TABLE tf2;

@@ -1,0 +1,18 @@
+-- Mixed timestamp precisions across tables (reference common/types/timestamp precision)
+CREATE TABLE tp_s (ts TIMESTAMP(0) TIME INDEX, v DOUBLE);
+
+CREATE TABLE tp_us (ts TIMESTAMP(6) TIME INDEX, v DOUBLE);
+
+INSERT INTO tp_s VALUES (1700000000, 1.0);
+
+INSERT INTO tp_us VALUES (1700000000000000, 2.0);
+
+SELECT CAST(ts AS BIGINT) AS t, v FROM tp_s;
+
+SELECT CAST(ts AS BIGINT) AS t, v FROM tp_us;
+
+SELECT count(*) AS c FROM tp_s WHERE ts >= 1600000000;
+
+DROP TABLE tp_s;
+
+DROP TABLE tp_us;

@@ -1,0 +1,18 @@
+-- UNION/UNION ALL shape coercion and dedup (reference common/select union)
+CREATE TABLE u1 (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+CREATE TABLE u2 (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO u1 VALUES ('a', 1000, 1), ('b', 2000, 2);
+
+INSERT INTO u2 VALUES ('b', 2000, 2), ('c', 3000, 3);
+
+SELECT host, v FROM u1 UNION SELECT host, v FROM u2 ORDER BY host;
+
+SELECT host, v FROM u1 UNION ALL SELECT host, v FROM u2 ORDER BY host, v;
+
+SELECT host FROM u1 UNION ALL SELECT 'zz' ORDER BY host;
+
+DROP TABLE u1;
+
+DROP TABLE u2;

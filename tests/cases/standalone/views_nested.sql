@@ -1,0 +1,18 @@
+-- Views over views and view + where pushdown (reference common/view cases)
+CREATE TABLE vn (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO vn VALUES ('a', 1000, 1), ('a', 2000, 2), ('b', 1000, 10), ('b', 2000, 20);
+
+CREATE VIEW vn_sums AS SELECT host, sum(v) AS s FROM vn GROUP BY host;
+
+CREATE VIEW vn_big AS SELECT host, s FROM vn_sums WHERE s > 5;
+
+SELECT * FROM vn_big ORDER BY host;
+
+SELECT count(*) AS c FROM vn_sums;
+
+DROP VIEW vn_big;
+
+DROP VIEW vn_sums;
+
+DROP TABLE vn;

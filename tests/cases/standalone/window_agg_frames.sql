@@ -1,0 +1,12 @@
+-- Window aggregates over partitions (reference common/select window)
+CREATE TABLE wf (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO wf VALUES ('a', 1000, 1), ('a', 2000, 2), ('a', 3000, 3), ('b', 1000, 10), ('b', 2000, 20);
+
+SELECT host, ts, sum(v) OVER (PARTITION BY host ORDER BY ts) AS run_sum FROM wf ORDER BY host, ts;
+
+SELECT host, ts, avg(v) OVER (PARTITION BY host) AS part_avg FROM wf ORDER BY host, ts;
+
+SELECT host, ts, count(*) OVER () AS total FROM wf ORDER BY host, ts;
+
+DROP TABLE wf;
