@@ -179,14 +179,24 @@ def _emit_final():
     detail, results = _STATE["detail"], _STATE["results"]
     ok = {k: v for k, v in results.items() if "vs_baseline" in v}
     if ok:
-        detail["geomean_vs_baseline_all"] = round(
-            math.exp(sum(math.log(v["vs_baseline"]) for v in ok.values()) / len(ok)), 2
-        )
-        heavy = [k for k in ok if ok[k]["reference_ms"] >= 500]
-        if heavy:
-            detail["geomean_vs_baseline_heavy"] = round(
-                math.exp(sum(math.log(ok[k]["vs_baseline"]) for k in heavy) / len(heavy)), 2
+        try:
+            # max(x, 1e-9): a pathological rep can round vs_baseline to
+            # 0.0 and log(0) must not kill the ONLY summary line (a
+            # validation run died exactly here)
+            detail["geomean_vs_baseline_all"] = round(
+                math.exp(sum(
+                    math.log(max(v["vs_baseline"], 1e-9)) for v in ok.values()
+                ) / len(ok)), 2
             )
+            heavy = [k for k in ok if ok[k]["reference_ms"] >= 500]
+            if heavy:
+                detail["geomean_vs_baseline_heavy"] = round(
+                    math.exp(sum(
+                        math.log(max(ok[k]["vs_baseline"], 1e-9)) for k in heavy
+                    ) / len(heavy)), 2
+                )
+        except Exception as e:  # noqa: BLE001 — summary must still land
+            detail["geomean_error"] = repr(e)
     detail["queries"] = results
     headline = _STATE["headline"] or {"warm_ms": None, "vs_baseline": None}
     _emit(
@@ -578,9 +588,12 @@ def main():
             warm_ms = float(np.median(walls))
             rb1 = (m.TILE_READBACK_MS.sum(), m.TILE_READBACK_MS.total())
             n_rb = rb1[1] - rb0[1]
+            ratio = ref_ms / warm_ms
             entry.update(
                 warm_ms=round(warm_ms, 2),
-                vs_baseline=round(ref_ms / warm_ms, 2),
+                # keep 4 decimals below 0.05: round(0.0027, 2) == 0.0
+                # poisoned the geomean log in a validation run
+                vs_baseline=round(ratio, 2 if ratio >= 0.05 else 4),
                 rows_out=table.num_rows,
                 warm_reps_done=len(walls),
             )

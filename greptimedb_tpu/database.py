@@ -669,11 +669,16 @@ class Database:
 
     def _drop(self, stmt: DropStmt):
         if stmt.kind == "flow":
+            if stmt.database and stmt.database != self.current_database:
+                from .utils.errors import UnsupportedError
+
+                raise UnsupportedError("flows are not database-scoped")
             self.flows.drop_flow(stmt.name, if_exists=stmt.if_exists)
             return None
         if stmt.kind == "view":
             self.catalog.drop_view(
-                stmt.name, self.current_database, if_exists=stmt.if_exists
+                stmt.name, stmt.database or self.current_database,
+                if_exists=stmt.if_exists,
             )
             return None
         if stmt.kind == "database":
@@ -685,10 +690,11 @@ class Database:
                 self.dicts.drop(f"{stmt.name}.{meta.name}")
             self.catalog.drop_database(stmt.name)
             return None
-        if stmt.if_exists and not self.catalog.has_table(stmt.name, self.current_database):
+        db_name = stmt.database or self.current_database
+        if stmt.if_exists and not self.catalog.has_table(stmt.name, db_name):
             return None
 
-        meta = self.catalog.table(stmt.name, self.current_database)
+        meta = self.catalog.table(stmt.name, db_name)
         if is_logical_meta(meta):
             self.metric.drop_logical_table(meta)
             return None
@@ -698,13 +704,13 @@ class Database:
         from .storage import file_engine as fe
 
         external = fe.is_external_meta(meta)
-        meta = self.catalog.drop_table(stmt.name, self.current_database)
+        meta = self.catalog.drop_table(stmt.name, db_name)
         if not external:  # external tables own no regions (files stay put)
             for rid in meta.region_ids:
                 self.storage.drop_region(rid)
                 if self.query_engine.tile_cache is not None:
                     self.query_engine.tile_cache.invalidate_region(rid, set())
-        self.dicts.drop(f"{self.current_database}.{stmt.name}")
+        self.dicts.drop(f"{db_name}.{stmt.name}")
         return None
 
     # ---- DML --------------------------------------------------------------
@@ -840,9 +846,10 @@ class Database:
         from .models import information_schema as info
 
         if stmt.what == "tables":
-            if info.is_information_schema(self.current_database):
+            db_name = stmt.database or self.current_database
+            if info.is_information_schema(db_name):
                 return pa.table({"Tables": info.table_names()})
-            names = [m.name for m in self.catalog.tables(self.current_database)]
+            names = [m.name for m in self.catalog.tables(db_name)]
             return pa.table({"Tables": filter_like(names, stmt.like)})
         if stmt.what == "databases":
             return pa.table({"Database": self.catalog.databases()})

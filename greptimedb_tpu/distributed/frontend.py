@@ -280,7 +280,7 @@ class Frontend:
     def _drop(self, stmt: DropStmt):
         if stmt.kind != "table":
             raise UnsupportedError(f"DROP {stmt.kind} is standalone-only for now")
-        database = self.current_database
+        database = getattr(stmt, "database", None) or self.current_database
         try:
             meta = self._table(stmt.name, database)
         except TableNotFoundError:
@@ -386,7 +386,8 @@ class Frontend:
 
         if stmt.what == "tables":
             self.catalog.reload()
-            names = [m.name for m in self.catalog.tables(self.current_database)]
+            db_name = getattr(stmt, "database", None) or self.current_database
+            names = [m.name for m in self.catalog.tables(db_name)]
             return pa.table({"Tables": filter_like(names, stmt.like)})
         if stmt.what == "databases":
             self.catalog.reload()

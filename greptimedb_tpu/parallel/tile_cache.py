@@ -1270,10 +1270,16 @@ class TileCacheManager:
             if n == 0 or n > entry.num_rows * self._WINDOW_TILE_MAX_COVER:
                 return None
         # pad to a 2^22 grid: bounded compile-shape variety, chunks stay
-        # BLOCK_ROWS multiples
+        # BLOCK_ROWS multiples.  Window tiles dispatch at 2^22-row chunks
+        # (not the 2^24 super-tile chunk): a 10-column limb program over a
+        # 2^24 chunk allocates multi-GB transients (f64->bf16 casts, digit
+        # planes, masks for every column scheduled concurrently) — the
+        # round-4 driver dg-all OOM.  Equal-size chunks also mean ONE
+        # compile shape per tile, and the size is stable across column
+        # extensions (cached planes and new planes must chunk identically).
         grid = 1 << 22
         pad = -(-n // grid) * grid
-        bounds = _chunk_bounds(pad, self.chunk_rows)
+        bounds = _chunk_bounds(pad, min(self.chunk_rows, grid))
 
         # nullable columns without a persisted null plane can't build
         # their gathered mask here — full super-tile path owns those.

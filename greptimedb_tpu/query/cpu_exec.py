@@ -136,10 +136,19 @@ def _eval_binary(e: BinaryOp, table: pa.Table):
         return pc.and_kleene(l, r)
     if op == "or":
         return pc.or_kleene(l, r)
-    if op == "like":
+    if op in ("like", "ilike"):
+        import re as _re
+
         pattern = r.as_py() if isinstance(r, pa.Scalar) else r
-        regex = pattern.replace("%", ".*").replace("_", ".")
-        return pc.match_substring_regex(l, f"^{regex}$")
+        # only % and _ are LIKE wildcards; every other char is literal
+        # (unescaped regex metachars matched wrongly / raised)
+        regex = "".join(
+            ".*" if ch == "%" else "." if ch == "_" else _re.escape(ch)
+            for ch in pattern
+        )
+        return pc.match_substring_regex(
+            l, f"^{regex}$", ignore_case=(op == "ilike")
+        )
     cmp = {
         "=": pc.equal,
         "!=": pc.not_equal,
@@ -154,8 +163,68 @@ def _eval_binary(e: BinaryOp, table: pa.Table):
         return cmp[op](l, r)
     arith = {"+": pc.add, "-": pc.subtract, "*": pc.multiply, "/": pc.divide, "%": _mod}
     if op in arith:
+        # timestamp +/- integer treats the integer as milliseconds (the
+        # unit INTERVAL literals parse to) cast to the timestamp's
+        # duration unit — Arrow has no timestamp+int kernel
+        l, r = _interval_align(l, r, op)
         return arith[op](l, r)
     raise PlanError(f"unknown binary op {op}")
+
+
+_TS_UNIT_PER_MS = {"s": 0.001, "ms": 1, "us": 1000, "ns": 1_000_000}
+
+
+def _float_to_int_cast(v, arrow_t):
+    """float -> int with arrow-rs `as`-cast semantics (the reference's
+    CAST): truncate toward zero, saturate out-of-range, NaN -> 0.  A raw
+    pyarrow safe=False cast wraps NaN/overflow to INT_MIN instead."""
+    import numpy as np
+
+    info = np.iinfo(arrow_t.to_pandas_dtype())
+    t = pc.trunc(v)
+    scalar = isinstance(t, pa.Scalar)
+    if scalar:
+        x = t.as_py()
+        if x is None or x != x:  # NULL stays NULL; NaN -> 0
+            x = None if x is None else 0
+        else:
+            x = min(max(int(x), info.min), info.max)
+        return pa.scalar(x, arrow_t)
+    nan = pc.is_nan(t)
+    hi = float(info.max)
+    if int(hi) > info.max:  # float(2^63-1) rounds UP to 2^63: step below
+        hi = float(np.nextafter(hi, 0))
+    clamped = pc.min_element_wise(
+        pc.max_element_wise(t, pa.scalar(float(info.min))), pa.scalar(hi)
+    )
+    base = pc.cast(clamped, arrow_t, safe=False)
+    return pc.if_else(nan, pa.scalar(0, arrow_t), base)
+
+
+def _interval_align(l, r, op):
+    def is_ts(x):
+        return pa.types.is_timestamp(getattr(x, "type", pa.null()))
+
+    def is_int(x):
+        t = getattr(x, "type", None)
+        return t is not None and (pa.types.is_integer(t) or pa.types.is_floating(t))
+
+    def to_dur(ms_val, unit):
+        factor = _TS_UNIT_PER_MS[unit]
+        if isinstance(ms_val, pa.Scalar):
+            return pa.scalar(round(ms_val.as_py() * factor), pa.duration(unit))
+        # float64 -> duration has no arrow kernel; go through int64
+        as_int = pc.cast(
+            pc.round(pc.multiply(pc.cast(ms_val, pa.float64()), factor)),
+            pa.int64(),
+        )
+        return pc.cast(as_int, pa.duration(unit))
+
+    if is_ts(l) and is_int(r) and op in ("+", "-"):
+        return l, to_dur(r, l.type.unit)
+    if is_ts(r) and is_int(l) and op == "+":
+        return to_dur(l, r.type.unit), r
+    return l, r
 
 
 def _mod(l, r):
@@ -227,7 +296,12 @@ def _eval_func(e: FuncCall, table: pa.Table):
         from ..datatypes.data_type import ConcreteDataType
 
         target = ConcreteDataType.parse(args[1].value)
-        return pc.cast(v, target.to_arrow())
+        arrow_t = target.to_arrow()
+        if pa.types.is_integer(arrow_t) and pa.types.is_floating(
+            getattr(v, "type", pa.null())
+        ):
+            return _float_to_int_cast(v, arrow_t)
+        return pc.cast(v, arrow_t)
     if f in ("matches", "matches_term"):
         from ..storage.index import matches_mask, matches_term_mask
 
@@ -444,16 +518,39 @@ class CpuExecutor:
                     pa_fn = "count_distinct"
                 if pa_fn is None:
                     raise PlanError(f"unsupported aggregate: {fn}")
-                if fn in ("last_value", "first_value") and agg.order_by:
-                    work = _sorted_by(work, agg.order_by)
-                specs.append((argname, pa_fn))
+                if fn in ("last_value", "first_value"):
+                    if agg.order_by:
+                        work = _sorted_by(work, agg.order_by)
+                    else:
+                        # implicit time order: the device kernel's LAST is
+                        # by time index, and the scan's (pk, ts) sort made
+                        # the CPU's row-order last the last PK's row
+                        # instead — sort by the (single) timestamp column
+                        # so both backends agree (reference lastpoint
+                        # semantics)
+                        ts_cols = [
+                            c for c in work.column_names
+                            if pa.types.is_timestamp(work[c].type)
+                        ]
+                        if len(ts_cols) == 1:
+                            work = work.take(pc.sort_indices(
+                                work, [(ts_cols[0], "ascending")]
+                            ))
+                if pa_fn in ("stddev", "variance"):
+                    # SQL: stddev/var are SAMPLE statistics (n-1), the
+                    # _pop variants population — arrow defaults to ddof=0
+                    specs.append((argname, pa_fn, 0 if fn.endswith("_pop") else 1))
+                else:
+                    specs.append((argname, pa_fn))
                 out_names.append(out_name)
 
         if not group_names:
             # Global aggregate (no GROUP BY): aggregate whole table.
             cols = {}
-            for (argname, pa_fn), out_name in zip(specs, out_names):
-                cols[out_name] = [_global_agg(work[argname], pa_fn)]
+            for spec, out_name in zip(specs, out_names):
+                argname, pa_fn = spec[0], spec[1]
+                ddof = spec[2] if len(spec) > 2 else None
+                cols[out_name] = [_global_agg(work[argname], pa_fn, ddof)]
             for argname, fn, params, out_name in sketch_specs:
                 col = work[argname]
                 col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
@@ -469,11 +566,14 @@ class CpuExecutor:
             )
             specs.append(("__rowidx", "list"))
         gb = work.group_by(group_names, use_threads=False)
-        result = gb.aggregate(specs)
+        result = gb.aggregate([
+            (s[0], s[1], pc.VarianceOptions(ddof=s[2])) if len(s) > 2 else s
+            for s in specs
+        ])
         # pyarrow names outputs "{col}_{fn}"; rename to our agg names.
         rename = {}
-        for (argname, pa_fn), out_name in zip(specs, out_names):
-            rename[f"{argname}_{pa_fn}"] = out_name
+        for spec, out_name in zip(specs, out_names):
+            rename[f"{spec[0]}_{spec[1]}"] = out_name
         new_names = [rename.get(n, n) for n in result.column_names]
         result = result.rename_columns(new_names)
         if sketch_specs:
@@ -1373,8 +1473,11 @@ def _udd_new(params: tuple):
         ) from None
 
 
-def _global_agg(col, pa_fn: str):
+def _global_agg(col, pa_fn: str, ddof=None):
     col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    if pa_fn in ("stddev", "variance") and ddof is not None:
+        fn = pc.stddev if pa_fn == "stddev" else pc.variance
+        return fn(col, ddof=ddof).as_py()
     fn = {
         "sum": pc.sum, "mean": pc.mean, "min": pc.min, "max": pc.max,
         "count": pc.count, "stddev": pc.stddev, "variance": pc.variance,

@@ -190,6 +190,7 @@ class DropStmt:
     kind: str  # table|database|flow|view
     name: str
     if_exists: bool = False
+    database: str | None = None  # DROP TABLE <db>.<table>
 
 
 @dataclass
@@ -236,6 +237,7 @@ class ShowStmt:
     what: str  # tables|databases|create_table
     target: str | None = None
     like: str | None = None
+    database: str | None = None  # SHOW TABLES FROM <db>
 
 
 @dataclass
@@ -821,7 +823,7 @@ class Parser:
         if self.at_kw("not"):
             save = self.i
             self.next()
-            if self.at_kw("in", "like", "between"):
+            if self.at_kw("in", "like", "ilike", "between"):
                 negated = True
             else:
                 self.i = save
@@ -841,6 +843,10 @@ class Parser:
         if self.eat_kw("like"):
             pattern = self.parse_additive()
             e = BinaryOp("like", left, pattern)
+            return UnaryOp("not", e) if negated else e
+        if self.eat_kw("ilike"):
+            pattern = self.parse_additive()
+            e = BinaryOp("ilike", left, pattern)
             return UnaryOp("not", e) if negated else e
         if negated and self.eat_kw("between"):
             low = self.parse_additive()
@@ -931,6 +937,24 @@ class Parser:
                 return self.parse_case()
             name = self.ident()
             if self.at_op("("):
+                if name.lower() == "cast":
+                    # CAST(expr AS TYPE) — standard form alongside `::`
+                    save = self.i
+                    self.next()
+                    inner = self.parse_expr()
+                    if self.eat_kw("as"):
+                        tname = self.ident().lower()
+                        if self.eat_op("("):
+                            # precision/dim stays part of the type name:
+                            # timestamp(9), vector(3) resolve differently
+                            p = self.next().value
+                            self.expect_op(")")
+                            tname = f"{tname}({p})"
+                        self.expect_op(")")
+                        return self._maybe_cast(
+                            FuncCall("cast", (inner, Literal(tname)))
+                        )
+                    self.i = save  # a UDF literally named cast(...)
                 return self._maybe_cast(self.parse_call(name))
             # Qualified column reference: alias.column (resolved against the
             # join output at execution; see cpu_exec column resolution).
@@ -1062,6 +1086,10 @@ class Parser:
     def parse_call(self, name: str) -> Expr:
         self.expect_op("(")
         lname = name.lower()
+        # SQL-standard sample-statistic aliases normalize at parse time so
+        # every execution path (arrow hash-agg, numpy tile finalize,
+        # distributed state merge) sees one canonical name
+        lname = {"var_samp": "var", "stddev_samp": "stddev"}.get(lname, lname)
         if lname == "count" and self.at_op("*"):
             self.next()
             self.expect_op(")")
@@ -1398,7 +1426,11 @@ class Parser:
         if self.eat_kw("if"):
             self.expect_kw("exists")
             if_exists = True
-        return DropStmt(kind, self.ident(), if_exists=if_exists)
+        name = self.ident()
+        database = None
+        if self.eat_op("."):
+            database, name = name, self.ident()
+        return DropStmt(kind, name, if_exists=if_exists, database=database)
 
     def parse_insert(self):
         self.expect_kw("insert")
@@ -1437,10 +1469,13 @@ class Parser:
     def parse_show(self):
         self.expect_kw("show")
         if self.eat_kw("tables"):
+            database = None
+            if self.eat_kw("from", "in"):
+                database = self.ident()
             like = None
             if self.eat_kw("like"):
                 like = self.next().value.strip("'")
-            return ShowStmt("tables", like=like)
+            return ShowStmt("tables", like=like, database=database)
         if self.eat_kw("databases", "schemas"):
             return ShowStmt("databases")
         if self.eat_kw("flows"):

@@ -51,12 +51,35 @@ class Lowering:
     agg_exprs: list[Expr] = field(default_factory=list)
 
 
+def _post_has_subquery(node) -> bool:
+    from .expr import Expr, PlannedSubquery, Subquery
+
+    exprs: list = []
+    if isinstance(node, Having):
+        exprs.append(node.predicate)
+    elif isinstance(node, Project):
+        exprs.extend(node.exprs)
+    elif isinstance(node, Sort):
+        exprs.extend(e for e, _asc in node.keys)
+    for e in exprs:
+        if isinstance(e, Expr) and any(
+            isinstance(x, (Subquery, PlannedSubquery)) for x in e.walk()
+        ):
+            return True
+    return False
+
+
 def try_lower(plan: LogicalPlan, schema: Schema) -> Lowering | None:
     """Walk from the root: collect post-aggregation ops until the Aggregate,
     then prove Aggregate(TableScan) matches the kernel shape."""
     post: list[LogicalPlan] = []
     node = plan
     while isinstance(node, (Limit, Sort, Project, Having)):
+        if _post_has_subquery(node):
+            # the post-op replay resolves every TableScan to the kernel's
+            # RESULT table — a scalar subquery over a real table would
+            # silently read the wrong data (caught by having_subquery)
+            return None
         post.append(node)
         node = node.children()[0]
     if not isinstance(node, Aggregate):
@@ -295,7 +318,10 @@ class TpuExecutor:
             elif isinstance(op, Project):
                 plan = Project(plan, op.exprs)
             elif isinstance(op, Sort):
-                plan = Sort(plan, op.keys)
+                # keep the per-key NULLS FIRST/LAST spec — dropping it
+                # made the merged-states path diverge from standalone on
+                # ORDER BY <nullable tag> (caught by null_groups_dist)
+                plan = Sort(plan, op.keys, nulls=op.nulls)
             elif isinstance(op, Limit):
                 plan = Limit(plan, op.limit, op.offset)
         cpu = CpuExecutor(lambda _scan: table)

@@ -143,7 +143,11 @@ class QueryConfig:
     # one at a time, so peak HBM stays one region's working set (the
     # 1B-row trajectory: per-region latency is flat, total is linear).
     tile_stream_enable: bool = True
-    tile_stream_threshold: float = 0.6
+    # Stream only when the planes genuinely cannot be resident: estimates
+    # below budget keep the all-at-once cached path (0.6 misfired at TSBS
+    # scale — a 5.8 GB fits-fine working set streamed, so every 'warm'
+    # rep re-uploaded and released everything)
+    tile_stream_threshold: float = 0.9
     # Accumulation mode for tile-path sum/avg: "limb" routes them through
     # the MXU fixed-point kernel (ops/aggregate.py limb_segment_sums; one
     # batched matmul for every column).  Precision: ~1e-9 relative
