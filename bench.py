@@ -321,12 +321,21 @@ def _larger_than_hbm_probe() -> dict:
     home = None
     db = None
     try:
+        # ~25 GB of Parquet+WAL for 2^28 rows; refusing beats filling the
+        # disk under the main dataset (a validation run hit 100%)
+        free_gb = shutil.disk_usage(tempfile.gettempdir()).free / 2**30
+        if free_gb < 35:
+            out["skipped"] = f"only {free_gb:.0f} GB free disk (need 35)"
+            return out
         home = tempfile.mkdtemp(prefix="graft_lth_")
         db = Database(data_home=home)
         db.config.query.tpu_min_rows = 300_000
         db.config.query.tile_cache_mb = budget_mb
         if db.query_engine.tile_cache is not None:
             db.query_engine.tile_cache.budget = budget_mb << 20
+            # throwaway dataset: persisted consolidations would double the
+            # disk footprint for a cold-start the probe doesn't measure
+            db.query_engine.tile_cache.persist_dir = None
         out["tile_budget_mb"] = budget_mb
         cols_sql = ", ".join(f"m{i} DOUBLE" for i in range(metrics_n))
         db.sql(

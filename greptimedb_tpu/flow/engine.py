@@ -38,7 +38,17 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from ..datatypes.schema import ColumnSchema, ConcreteDataType, Schema, SemanticType
-from ..query.expr import AggCall, Alias, BinaryOp, Column, Expr, FuncCall, Literal
+from ..query.expr import (
+    AggCall,
+    Alias,
+    BinaryOp,
+    Column,
+    Expr,
+    FuncCall,
+    Literal,
+    find_agg_calls,
+    map_aggs,
+)
 from ..query.sql_parser import CreateFlowStmt, SelectStmt, parse_sql
 from ..utils.errors import (
     FlowAlreadyExistsError,
@@ -82,32 +92,75 @@ def _strip_alias(e: Expr) -> Expr:
     return e.expr if isinstance(e, Alias) else e
 
 
+def _resolved_group_exprs(stmt: SelectStmt) -> list[tuple[Expr, str]]:
+    """Group-by exprs with SELECT-alias references resolved: GROUP BY w
+    where the projection is `time_bucket('10s', ts) AS w` groups by the
+    bucket expr (the planner resolves aliases the same way); returns
+    (expr, output name) pairs."""
+    alias_map = {
+        p.alias: p.expr for p in stmt.projections if isinstance(p, Alias)
+    }
+    out: list[tuple[Expr, str]] = []
+    for g in stmt.group_by:
+        e = _strip_alias(g)
+        if isinstance(e, Column) and e.column in alias_map:
+            out.append((alias_map[e.column], e.column))
+        else:
+            out.append((e, g.name()))
+    return out
+
+
+def _streamable_agg(a: AggCall) -> bool:
+    return (
+        a.func in _STREAMABLE_AGGS
+        and a.range_ms is None
+        and not a.distinct  # DISTINCT states are not decomposable —
+        # count(DISTINCT x) must take batching mode, not stream wrongly
+    )
+
+
 def _is_streamable(stmt: SelectStmt) -> bool:
-    """Streaming handles: single-table SELECT of group-by keys + decomposable
-    aggregates, no HAVING/ORDER/LIMIT (reference transform/ restricts the
-    streaming plan class similarly)."""
+    """Streaming handles: single-table SELECT of group-by keys, decomposable
+    aggregates, and EXPRESSIONS over those aggregates (sum(a)/count(b),
+    max(v)-min(v), round(avg(v), 2)...) — the reference's streaming plan
+    class maintains per-agg state and computes the surrounding expression
+    at emit (flow/src/transform/).  No HAVING/ORDER/LIMIT."""
     if stmt.table is None or stmt.having is not None or stmt.order_by or stmt.limit:
         return False
     if stmt.align is not None:
         return False
-    group_names = {g.name() for g in stmt.group_by}
+    resolved = _resolved_group_exprs(stmt)
+    group_names = {name for _e, name in resolved}
+    group_inners = [e for e, _n in resolved]
     has_agg = False
     non_agg_inners = set()
     for p in stmt.projections:
         inner = _strip_alias(p)
-        if isinstance(inner, AggCall):
-            if inner.func not in _STREAMABLE_AGGS or inner.range_ms is not None:
+        aggs = find_agg_calls(inner)
+        if aggs:
+            if not all(_streamable_agg(a) for a in aggs):
                 return False
+            # every column reference must live INSIDE an aggregate: a raw
+            # row column in an agg expression has no per-group value
+            inside: set[int] = set()
+            for a in aggs:
+                for x in a.walk():
+                    inside.add(id(x))
+            for x in inner.walk():
+                if isinstance(x, Column) and id(x) not in inside:
+                    return False
             has_agg = True
-        elif inner.name() not in group_names:
+        elif inner not in group_inners and inner.name() not in group_names:
             return False
         else:
             non_agg_inners.add(inner)
     # Every group key must surface in the SELECT list: the sink row is keyed
     # by projected columns only, so a dropped key would collapse distinct
     # groups into one sink row (batching mode handles those correctly).
-    for g in stmt.group_by:
-        if _strip_alias(g) not in non_agg_inners:
+    for e, name in resolved:
+        if e not in non_agg_inners and name not in {
+            i.name() for i in non_agg_inners
+        }:
             return False
     return has_agg
 
@@ -118,8 +171,7 @@ def _time_window_ms(stmt: SelectStmt) -> int | None:
     plan's time window expr, `batching_mode/time_window.rs`)."""
     from ..query.cpu_exec import _interval_ms
 
-    for g in stmt.group_by:
-        g = _strip_alias(g)
+    for g, _name in _resolved_group_exprs(stmt):
         if isinstance(g, FuncCall) and g.func in ("date_bin", "time_bucket"):
             try:
                 return _interval_ms(g.args[0], None)
@@ -168,21 +220,33 @@ class StreamingFlowTask:
         self.info = info
         self.db = db
         self.stmt: SelectStmt = parse_sql(info.sql)[0]
-        self.aggs: list[tuple[AggCall, str]] = []
+        # one _AggState per UNIQUE AggCall; projections may be expressions
+        # over several aggregates (sum(a)/count(b)) — they evaluate from
+        # the states at emit time
+        self.unique_aggs: list[AggCall] = []
+        self._agg_idx: dict[AggCall, int] = {}
+        # (out_name, expr) for agg-bearing projections, in SELECT order
+        self.agg_outputs: list[tuple[str, Expr]] = []
         self.key_names: list[str] = []
         proj_by_expr: dict = {}
         for p in self.stmt.projections:
             inner = _strip_alias(p)
-            if isinstance(inner, AggCall):
-                self.aggs.append((inner, p.name()))
+            inner_aggs = find_agg_calls(inner)
+            if inner_aggs:
+                for a in inner_aggs:
+                    if a not in self._agg_idx:
+                        self._agg_idx[a] = len(self.unique_aggs)
+                        self.unique_aggs.append(a)
+                self.agg_outputs.append((p.name(), inner))
             else:
                 self.key_names.append(p.name())
                 proj_by_expr[inner] = p.name()
         # group-by exprs carry their projection's output alias when one
-        # matches structurally (frozen dataclass equality)
+        # matches structurally (frozen dataclass equality); SELECT-alias
+        # group references (GROUP BY w) resolve to the aliased expr
         self.group_exprs = [
-            (_strip_alias(g), proj_by_expr.get(_strip_alias(g), g.name()))
-            for g in self.stmt.group_by
+            (e, proj_by_expr.get(e, name))
+            for e, name in _resolved_group_exprs(self.stmt)
         ]
         # state: group key tuple -> [per-agg _AggState]
         self.state: dict[tuple, list[_AggState]] = {}
@@ -204,7 +268,7 @@ class StreamingFlowTask:
                 arr = pa.array([arr] * table.num_rows)
             key_cols.append(arr.to_pylist() if hasattr(arr, "to_pylist") else list(arr))
         agg_inputs = []
-        for agg, _name in self.aggs:
+        for agg in self.unique_aggs:
             if agg.arg is None:
                 agg_inputs.append(np.ones(table.num_rows))
             else:
@@ -221,10 +285,10 @@ class StreamingFlowTask:
             for k, idxs in by_key.items():
                 states = self.state.get(k)
                 if states is None:
-                    states = [_AggState() for _ in self.aggs]
+                    states = [_AggState() for _ in self.unique_aggs]
                     self.state[k] = states
                 sel = np.asarray(idxs)
-                for j, (agg, _n) in enumerate(self.aggs):
+                for j, agg in enumerate(self.unique_aggs):
                     vals = agg_inputs[j][sel]
                     if agg.func == "count" and agg.arg is None:
                         states[j].count += len(sel)
@@ -263,9 +327,9 @@ class StreamingFlowTask:
 
     # -- write touched groups into the sink --------------------------------
     def _emit(self, touched: set[tuple], now_ms: int):
+        from ..query.cpu_exec import eval_expr
         cols: dict[str, list] = {n: [] for n in self.key_names}
-        for _agg, name in self.aggs:
-            cols[name] = []
+        agg_vals: list[list] = [[] for _ in self.unique_aggs]
         # snapshot accumulator values under the lock: servers ingest from
         # multiple threads and _AggState fields are not individually atomic
         with self._lock:
@@ -276,11 +340,32 @@ class StreamingFlowTask:
                 for (_, name), v in zip(self.group_exprs, k):
                     if name in cols:
                         cols[name].append(v)
-                for j, (agg, name) in enumerate(self.aggs):
-                    cols[name].append(states[j].get(agg.func))
-        n_out = len(next(iter(cols.values()))) if cols else 0
+                for j, agg in enumerate(self.unique_aggs):
+                    agg_vals[j].append(states[j].get(agg.func))
+        n_out = len(agg_vals[0]) if agg_vals else 0
         if n_out == 0:
             return
+        # evaluate each output expression over the per-group state values:
+        # AggCall nodes rewrite to columns of a small states table, the
+        # surrounding arithmetic/scalar functions run through the normal
+        # CPU expression evaluator (reference streaming computes the
+        # surrounding expr from its decomposed states the same way)
+        states_table = pa.table({
+            f"__agg_{j}": pa.array(
+                vals,
+                pa.int64() if self.unique_aggs[j].func == "count"
+                else pa.float64(),
+            )
+            for j, vals in enumerate(agg_vals)
+        })
+        for out_name, expr in self.agg_outputs:
+            rewritten = map_aggs(
+                expr, lambda a: Column(f"__agg_{self._agg_idx[a]}")
+            )
+            out = eval_expr(rewritten, states_table)
+            if isinstance(out, pa.Scalar):
+                out = pa.array([out.as_py()] * n_out)
+            cols[out_name] = out.to_pylist()
         sink_schema = self._ensure_sink(cols)
         batch = _sink_batch(sink_schema, cols, n_out, now_ms)
         meta = self.db.catalog.table(self.info.sink_table, self.info.database)
@@ -291,7 +376,7 @@ class StreamingFlowTask:
             self.db,
             self.info,
             key_names=self.key_names,
-            agg_names=[n for _a, n in self.aggs],
+            agg_names=[n for n, _e in self.agg_outputs],
             sample_cols=cols,
             time_key=self._time_key_name(),
         )
@@ -313,9 +398,28 @@ class BatchingFlowTask:
         self.db = db
         self.stmt: SelectStmt = parse_sql(info.sql)[0]
         self.window_ms = _time_window_ms(self.stmt) or 3_600_000
-        self.dirty: set[int] = set()  # window start ms
-        self.last_eval_ms = 0
+        # window start ms -> mark sequence; a window retires after a
+        # re-run ONLY if no insert re-marked it meanwhile (a plain set
+        # lost a concurrent mark: the re-run's SELECT predates the new
+        # row, then retire dropped the window — stale sink forever)
+        self.dirty: dict[int, int] = {}
+        self._mark_seq = 0
+        # a fresh flow is due one interval after CREATE, not instantly
+        # (last_eval 0 made `now - last_eval` astronomically large, so
+        # the background ticker raced every test/deployment setup)
+        self.last_eval_ms = int(_time.time() * 1000)
         self._lock = threading.Lock()
+        # Dirty-window state survives restarts: a crash mid-backlog must
+        # resume the unprocessed windows, not silently drop them
+        # (reference batching_mode/engine.rs:59 persists task state).
+        # Windows clear AFTER their re-run upserts land, so a crash
+        # between evaluation and save re-runs them — upserts are
+        # idempotent under the sink's last-write-wins dedup.
+        self._state_path = os.path.join(
+            db.config.storage.data_home, "flow_state",
+            f"flow_{info.flow_id}.json",
+        )
+        self._load_state()
         # group-key output names (projection aliases for group-by exprs) so
         # the auto-created sink marks only true keys as tags
         proj_by_expr = {
@@ -324,8 +428,37 @@ class BatchingFlowTask:
             if not isinstance(_strip_alias(p), AggCall)
         }
         self.key_names = [
-            proj_by_expr.get(_strip_alias(g), g.name()) for g in self.stmt.group_by
+            proj_by_expr.get(e, name)
+            for e, name in _resolved_group_exprs(self.stmt)
         ]
+
+    def _load_state(self):
+        try:
+            with open(self._state_path) as f:
+                st = json.load(f)
+            self.dirty = {int(w): 0 for w in st.get("dirty", [])}
+            self.last_eval_ms = int(st.get("last_eval_ms", self.last_eval_ms))
+        except (OSError, ValueError):
+            pass  # no saved state (fresh flow) or torn file: start clean
+
+    def _save_state_locked(self):
+        try:
+            os.makedirs(os.path.dirname(self._state_path), exist_ok=True)
+            tmp = self._state_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({
+                    "dirty": sorted(self.dirty),
+                    "last_eval_ms": self.last_eval_ms,
+                }, f)
+            os.replace(tmp, self._state_path)
+        except OSError:
+            pass  # best-effort: state re-marks on the next insert
+
+    def drop_state(self):
+        try:
+            os.remove(self._state_path)
+        except OSError:
+            pass
 
     def on_insert(self, table: pa.Table, now_ms: int):
         """Mark dirty windows from the inserted timestamps (reference
@@ -338,8 +471,10 @@ class BatchingFlowTask:
 
         ts = _ts_to_ms(table.column(ts_col.name))
         with self._lock:
+            self._mark_seq += 1
             for w in np.unique(ts // self.window_ms):
-                self.dirty.add(int(w) * self.window_ms)
+                self.dirty[int(w) * self.window_ms] = self._mark_seq
+            self._save_state_locked()
 
     def due(self, now_ms: int) -> bool:
         interval = self.info.eval_interval_ms or 10_000
@@ -349,12 +484,23 @@ class BatchingFlowTask:
         with self._lock:
             if not self.dirty or (not force and not self.due(now_ms)):
                 return False
-            windows = sorted(self.dirty)
-            self.dirty.clear()
+            # snapshot (window, mark-seq), don't clear: a window leaves
+            # the dirty set only after its re-run lands AND no concurrent
+            # insert re-marked it, so a crash mid-backlog resumes and a
+            # mid-eval insert re-evaluates next tick
+            snapshot = dict(self.dirty)
+            windows = sorted(snapshot)
             self.last_eval_ms = now_ms
         if self.info.expire_after_ms is not None:
             horizon = now_ms - self.info.expire_after_ms
+            expired = [w for w in windows if w + self.window_ms <= horizon]
             windows = [w for w in windows if w + self.window_ms > horizon]
+            if expired:
+                with self._lock:
+                    for w in expired:
+                        if self.dirty.get(w) == snapshot[w]:
+                            del self.dirty[w]
+                    self._save_state_locked()
             if not windows:
                 return False
         src = self.db.catalog.table(self.info.source_table, self.info.database).schema
@@ -375,9 +521,15 @@ class BatchingFlowTask:
             stmt2 = parse_sql(self.info.sql)[0]
             stmt2.where = bound if stmt.where is None else BinaryOp("and", stmt.where, bound)
             result = self.db.query_engine.execute_select(stmt2, self.info.database)
-            if result.num_rows == 0:
-                continue
-            self._upsert(result, now_ms)
+            if result.num_rows:
+                self._upsert(result, now_ms)
+            # retire the range's windows UNLESS an insert re-marked one
+            # while the re-run executed (its rows may postdate the SELECT)
+            with self._lock:
+                for w in range(lo, hi, self.window_ms):
+                    if w in snapshot and self.dirty.get(w) == snapshot[w]:
+                        del self.dirty[w]
+                self._save_state_locked()
         return True
 
     def _upsert(self, result: pa.Table, now_ms: int):
@@ -637,7 +789,9 @@ class FlowManager:
                 return
             raise FlowNotFoundError(f"flow not found: {name}")
         info = self.infos.pop(name)
-        self.flows.pop(name)
+        task = self.flows.pop(name)
+        if hasattr(task, "drop_state"):
+            task.drop_state()  # batching dirty-window file must not orphan
         key = (info.source_table, info.database)
         self._by_source[key] = [n for n in self._by_source.get(key, []) if n != name]
         self._save()

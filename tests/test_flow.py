@@ -198,3 +198,89 @@ def test_flow_persistence(tmp_path):
         assert out.column("s").to_pylist() == [2.5]
     finally:
         db2.close()
+
+
+def test_streaming_flow_agg_expressions(db):
+    """Expressions over multiple aggregates stream: per-agg state is
+    maintained once per unique AggCall and the surrounding arithmetic is
+    computed at emit (reference flow/src/transform streaming plans)."""
+    _mk_source(db)
+    db.sql(
+        "CREATE FLOW ratios SINK TO cpu_ratios AS "
+        "SELECT host, sum(v) / count(v) AS manual_avg, max(v) - min(v) AS spread,"
+        " round(avg(v), 2) AS ra FROM cpu GROUP BY host"
+    )
+    assert db.flows.infos["ratios"].mode == "streaming"
+    db.sql("INSERT INTO cpu VALUES ('a', 1000, 1.0), ('a', 2000, 2.0), ('a', 3000, 6.0), ('b', 1000, 10.0)")
+    out = db.sql_one("SELECT host, manual_avg, spread, ra FROM cpu_ratios ORDER BY host")
+    assert out.column("host").to_pylist() == ["a", "b"]
+    assert out.column("manual_avg").to_pylist() == [3.0, 10.0]
+    assert out.column("spread").to_pylist() == [5.0, 0.0]
+    assert out.column("ra").to_pylist() == [3.0, 10.0]
+    # incremental fold keeps the expression consistent with its states
+    db.sql("INSERT INTO cpu VALUES ('a', 4000, 11.0)")
+    out = db.sql_one("SELECT manual_avg, spread FROM cpu_ratios WHERE host = 'a'")
+    assert out.column("manual_avg").to_pylist() == [5.0]
+    assert out.column("spread").to_pylist() == [10.0]
+
+
+def test_streaming_flow_multi_window_group(db):
+    """Two time_bucket granularities as group dimensions stream together
+    (multi-window plan)."""
+    _mk_source(db)
+    db.sql(
+        "CREATE FLOW mw SINK TO cpu_mw AS "
+        "SELECT host, time_bucket('10s', ts) AS w10, time_bucket('60s', ts) AS w60,"
+        " sum(v) AS s FROM cpu GROUP BY host, w10, w60"
+    )
+    assert db.flows.infos["mw"].mode == "streaming"
+    db.sql("INSERT INTO cpu VALUES ('a', 5000, 1.0), ('a', 15000, 2.0), ('a', 65000, 4.0)")
+    out = db.sql_one("SELECT w10, w60, s FROM cpu_mw ORDER BY w10")
+    assert [int(t.timestamp()) for t in out.column("w10").to_pylist()] == [0, 10, 60]
+    assert [int(t.timestamp()) for t in out.column("w60").to_pylist()] == [0, 0, 60]
+    assert out.column("s").to_pylist() == [1.0, 2.0, 4.0]
+
+
+def test_count_distinct_routes_to_batching(db):
+    """DISTINCT aggregates are not decomposable: the flow must take the
+    batching (re-run) mode instead of streaming a wrong count."""
+    _mk_source(db)
+    db.sql(
+        "CREATE FLOW cd SINK TO cpu_cd AS "
+        "SELECT host, count(DISTINCT v) AS dv FROM cpu GROUP BY host"
+    )
+    assert db.flows.infos["cd"].mode == "batching"
+    db.sql("INSERT INTO cpu VALUES ('a', 1000, 1.0), ('a', 2000, 1.0), ('a', 3000, 2.0)")
+    db.sql("ADMIN flush_flow('cd')")
+    out = db.sql_one("SELECT dv FROM cpu_cd")
+    assert out.column("dv").to_pylist() == [2]
+
+
+def test_batching_dirty_windows_survive_restart(tmp_path):
+    """Crash mid-backlog: dirty windows persist and a fresh process
+    resumes them (reference batching_mode/engine.rs:59 task state)."""
+    home = str(tmp_path / "fdb")
+    db = Database(data_home=home)
+    db.sql("CREATE TABLE cpu (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host))")
+    db.sql(
+        "CREATE FLOW agg SINK TO cpu_agg EVAL INTERVAL '1h' AS "
+        "SELECT host, time_bucket('10s', ts) AS w, max(v) AS m, count(DISTINCT v) AS dv"
+        " FROM cpu GROUP BY host, w"
+    )
+    assert db.flows.infos["agg"].mode == "batching"
+    db.sql("INSERT INTO cpu VALUES ('a', 1000, 1.0), ('a', 2000, 7.0), ('b', 12000, 3.0)")
+    # no tick/flush: the backlog is dirty when the process dies
+    task = db.flows.flows["agg"]
+    assert task.dirty, "windows should be marked dirty"
+    db.close()
+
+    db2 = Database(data_home=home)
+    task2 = db2.flows.flows["agg"]
+    assert set(task2.dirty) == set(task.dirty), "dirty windows must survive restart"
+    task2.tick(now_ms=10_000_000, force=True)
+    out = db2.sql_one("SELECT host, m, dv FROM cpu_agg ORDER BY host")
+    assert out.column("host").to_pylist() == ["a", "b"]
+    assert out.column("m").to_pylist() == [7.0, 3.0]
+    assert out.column("dv").to_pylist() == [2, 1]
+    assert not task2.dirty, "processed windows must retire"
+    db2.close()
