@@ -59,6 +59,13 @@ register(
     "routing",
 )
 register(
+    "cold_host_serve",
+    "serve a COLD grouped aggregate straight from the host consolidation "
+    "(numpy bincount) instead of paying plane uploads; the next query "
+    "builds the device tiles",
+    "routing",
+)
+register(
     "dedup_plane",
     "lower last-write-wins dedup of overlapping SSTs to a device-side "
     "keep mask instead of falling back to the merge scan",

@@ -23,6 +23,9 @@ def db(tmp_path, monkeypatch):
     # fixture exercises the same decision points the TSBS run does
     monkeypatch.setattr(TileCacheManager, "_WINDOW_TILE_MIN_ROWS", 1 << 14)
     d = Database(data_home=str(tmp_path / "db"))
+    # device-path pass visibility is under test; cold-serve routing would
+    # answer the first (EXPLAIN ANALYZE) query from host instead
+    d.config.query.disabled_passes = ("cold_host_serve",)
     yield d
     d.close()
 

@@ -84,6 +84,7 @@ def test_tile_path_engages_and_matches_cpu(db):
 
 
 def test_warm_query_hits_cache(db):
+    db.config.query.disabled_passes = ("cold_host_serve",)  # device-path mechanics under test
     _mk_cpu_table(db)
     _load(db)
     db.sql("ADMIN flush_table('cpu')")
@@ -175,6 +176,7 @@ def test_window_tile_engages_and_matches(db, monkeypatch):
     """Windowed query over deep retention gathers a compact window tile
     (kernel scans the window, not the retention) — results must equal the
     CPU path, including combined with overwrite dedup."""
+    db.config.query.disabled_passes = ("cold_host_serve",)  # device-path mechanics under test
     import numpy as np
 
     from greptimedb_tpu.parallel.tile_cache import TileCacheManager
@@ -227,6 +229,7 @@ def test_window_tile_extends_with_new_columns(db, monkeypatch):
     sources lacked the new columns, so every multi-column query after a
     narrower one over the same window fell back to the CPU scan (the
     round-4 driver-bench timeout: TSBS double-groupby-5 'warm' at 55 s)."""
+    db.config.query.disabled_passes = ("cold_host_serve",)  # device-path mechanics under test
     import numpy as np
 
     from greptimedb_tpu.parallel.tile_cache import TileCacheManager
@@ -351,6 +354,7 @@ def test_limb_mixed_magnitude_reruns_exact(db):
     """Groups of tiny values co-blocked with huge values break the limb
     kernel's shared per-block scale; the per-group error-bound verdict
     must detect it and transparently rerun in exact f64."""
+    db.config.query.disabled_passes = ("cold_host_serve",)  # device-path mechanics under test
     import numpy as np
 
     _mk_cpu_table(db)
@@ -762,3 +766,42 @@ def test_host_fast_path_includes_memtable(db):
     t1, t2 = _both(db, q)
     _assert_equal(t1, t2, ["c"])
     assert t1["c"].to_pylist()[0] == 60
+
+
+def test_cold_host_serve_then_device_build(db):
+    """A cold grouped aggregate answers from the host consolidation with
+    ZERO device plane uploads (on the remote-TPU harness uploads dominate
+    cold latency); the next touch builds the HBM tiles so warm reps keep
+    the one-dispatch path.  Results match the CPU path in both phases."""
+    _mk_cpu_table(db)
+    _load(db, hosts=8, ticks=400)
+    db.sql("ADMIN flush_table('cpu')")
+    q = ("SELECT host, time_bucket('30s', ts) AS tb, avg(usage_user) AS a,"
+         " max(usage_system) AS m, count(*) AS c FROM cpu GROUP BY host, tb")
+    served0 = None
+    cache = db.query_engine.tile_cache
+    t1 = db.sql_one(q)
+    entries = list(cache._super.values())
+    assert entries, "super-tile entry should exist after the cold query"
+    assert all(getattr(e, "cold_served", False) for e in entries), (
+        "cold query must be host-served once"
+    )
+    assert all(not e.cols for e in entries), (
+        f"cold serve must not upload planes: {[list(e.cols) for e in entries]}"
+    )
+    # second touch builds the device planes
+    t2 = db.sql_one(q)
+    assert any(e.cols for e in cache._super.values()), (
+        "second touch must build device tiles"
+    )
+    db.config.query.backend = "cpu"
+    t3 = db.sql_one(q)
+    db.config.query.backend = "tpu"
+    for t in (t1, t2):
+        s1 = t.sort_by([("host", "ascending"), ("tb", "ascending")]).to_pydict()
+        s3 = t3.sort_by([("host", "ascending"), ("tb", "ascending")]).to_pydict()
+        assert s1["host"] == s3["host"] and s1["c"] == s3["c"]
+        import numpy as _np
+
+        _np.testing.assert_allclose(s1["a"], s3["a"], rtol=1e-9)
+        _np.testing.assert_allclose(s1["m"], s3["m"], rtol=1e-12)
