@@ -561,6 +561,7 @@ def main():
             break
         cold_ms = None
         entry_build_ms = None
+        build_err = None
         walls: list[float] = []
         table = None
         err = None
@@ -591,7 +592,7 @@ def main():
             except Exception as be:  # noqa: BLE001 — a timed-out build
                 # rep commits partial planes; the timed reps finish them
                 entry_build_ms = None
-                err = repr(be)
+                build_err = repr(be)
             rep_errs = 0
             for _rep in range(WARM_REPS):
                 if _elapsed() > BUDGET_S and walls:
@@ -624,6 +625,8 @@ def main():
             entry["cold_ms"] = round(cold_ms, 1)
         if entry_build_ms is not None:
             entry["build_ms"] = entry_build_ms
+        if build_err is not None:
+            entry["build_error"] = build_err
         if walls:
             warm_ms = float(np.median(walls))
             rb1 = (m.TILE_READBACK_MS.sum(), m.TILE_READBACK_MS.total())

@@ -403,6 +403,37 @@ class Database:
             )
             if m:
                 self.set_session_timezone(m.group(1).strip())
+                return None
+            if _re.match(r"(?is)^set\s+session\s+disabled_passes\b", stmt.raw):
+                raise InvalidArgumentsError(
+                    "disabled_passes is instance-global (it reconfigures "
+                    "the shared query engine); use SET [GLOBAL] "
+                    "disabled_passes = '...'"
+                )
+            m = _re.match(
+                r"(?is)^set\s+(?:global\s+)?disabled_passes\s*(?:=|to)\s*"
+                r"(?:'([^']*)'|([A-Za-z0-9_,\s]+?))\s*;?\s*$",
+                stmt.raw,
+            )
+            if m:
+                # operator control over the optimizer-pass pipeline
+                # (query/passes.py registry; EXPLAIN shows the effect) —
+                # GLOBAL semantics: the engine is shared, so this changes
+                # planning for every connection until reset
+                from .query import passes as _passes
+
+                raw_val = m.group(1) if m.group(1) is not None else m.group(2)
+                names = tuple(
+                    n.strip() for n in raw_val.split(",") if n.strip()
+                )
+                known = {p.name for p in _passes.registry()}
+                bad = [n for n in names if n not in known]
+                if bad:
+                    raise InvalidArgumentsError(
+                        f"unknown optimizer pass(es) {bad}; known: "
+                        f"{sorted(known)}"
+                    )
+                self.config.query.disabled_passes = names
             return None
         if isinstance(stmt, TransactionStmt):
             return None  # accepted client-bootstrap no-ops

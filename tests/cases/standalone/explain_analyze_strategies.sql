@@ -1,0 +1,10 @@
+-- EXPLAIN pins which physical strategies exist for a shape (reference optimizer EXPLAIN goldens); the static pipeline is deterministic
+CREATE TABLE eas (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO eas VALUES ('a', 1000, 1.0), ('b', 2000, 2.0);
+
+EXPLAIN SELECT host, max(v) AS m FROM eas WHERE host = 'a' GROUP BY host;
+
+EXPLAIN SELECT count(*) AS c FROM eas WHERE v > 1.5;
+
+DROP TABLE eas;

@@ -1,0 +1,10 @@
+-- Disabling a named optimizer pass surfaces in EXPLAIN (reference removes individual physical rules in tests the same way)
+CREATE TABLE edp (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+SET disabled_passes = 'window_tile,limb_quantize';
+
+EXPLAIN SELECT host, avg(v) AS a FROM edp GROUP BY host;
+
+SET disabled_passes = '';
+
+DROP TABLE edp;
