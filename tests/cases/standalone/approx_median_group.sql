@@ -1,0 +1,10 @@
+-- approx percentile per group (reference common/function percentile)
+CREATE TABLE apg (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO apg VALUES ('a', 1000, 1), ('a', 2000, 2), ('a', 3000, 3), ('a', 4000, 4), ('a', 5000, 5), ('b', 1000, 10), ('b', 2000, 20), ('b', 3000, 30);
+
+SELECT host, approx_percentile_cont(v) AS p50 FROM apg GROUP BY host ORDER BY host;
+
+SELECT approx_percentile_cont(v) AS p50 FROM apg;
+
+DROP TABLE apg;

@@ -1,0 +1,12 @@
+-- CASE expressions inside aggregates (conditional aggregation; reference common/select case+agg)
+CREATE TABLE cia (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, status STRING, PRIMARY KEY (host));
+
+INSERT INTO cia VALUES ('a', 1000, 10, 'ok'), ('a', 2000, 20, 'err'), ('b', 1000, 30, 'ok'), ('b', 2000, 40, 'ok');
+
+SELECT host, sum(CASE WHEN status = 'err' THEN v ELSE 0 END) AS err_v FROM cia GROUP BY host ORDER BY host;
+
+SELECT host, count(CASE WHEN status = 'ok' THEN 1 END) AS oks FROM cia GROUP BY host ORDER BY host;
+
+SELECT sum(CASE WHEN v > 15 THEN 1 ELSE 0 END) AS big FROM cia;
+
+DROP TABLE cia;

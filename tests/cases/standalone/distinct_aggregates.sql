@@ -1,0 +1,12 @@
+-- DISTINCT inside aggregates beyond count (reference common/select distinct agg)
+CREATE TABLE dag (host STRING, ts TIMESTAMP TIME INDEX, v BIGINT, PRIMARY KEY (host));
+
+INSERT INTO dag VALUES ('a', 1000, 5), ('a', 2000, 5), ('a', 3000, 7), ('b', 1000, 5), ('b', 2000, 9);
+
+SELECT host, count(DISTINCT v) AS dv, count(v) AS cv FROM dag GROUP BY host ORDER BY host;
+
+SELECT count(DISTINCT host) AS dh FROM dag;
+
+SELECT count(DISTINCT v) AS dv FROM dag WHERE v > 5;
+
+DROP TABLE dag;

@@ -1,0 +1,10 @@
+-- group by over multiple nullable tags (reference common/select null groups)
+CREATE TABLE gnt (a STRING NULL, b STRING NULL, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (a, b));
+
+INSERT INTO gnt VALUES ('x', 'p', 1000, 1), ('x', NULL, 2000, 2), (NULL, 'p', 3000, 4), (NULL, NULL, 4000, 8);
+
+SELECT a, b, sum(v) AS s FROM gnt GROUP BY a, b ORDER BY a NULLS LAST, b NULLS LAST;
+
+SELECT count(*) AS groups FROM (SELECT a, b FROM gnt GROUP BY a, b) t;
+
+DROP TABLE gnt;

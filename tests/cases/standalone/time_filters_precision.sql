@@ -1,0 +1,12 @@
+-- mixed-precision timestamp filter literals (reference common/types/timestamp filters)
+CREATE TABLE tfp (host STRING, ts TIMESTAMP(6) TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO tfp VALUES ('a', 1700000000000000, 1.0), ('b', 1700000001000000, 2.0), ('c', 1700000002500000, 3.0);
+
+SELECT host FROM tfp WHERE ts >= 1700000001000000 ORDER BY host;
+
+SELECT host FROM tfp WHERE ts > '2023-11-14 22:13:21' ORDER BY host;
+
+SELECT count(*) AS c FROM tfp WHERE ts BETWEEN 1700000000000000 AND 1700000002000000;
+
+DROP TABLE tfp;
