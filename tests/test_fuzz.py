@@ -349,3 +349,126 @@ def test_fuzz_failover_under_churn(tmp_path):
         assert kills >= 1, "chaos never fired; loosen the schedule"
     finally:
         c.close()
+
+
+def test_fuzz_execution_regimes_match_cpu(tmp_path):
+    """Randomized equivalence across the tile path's execution regimes —
+    cold host-serve, region-streamed beyond-budget, and warm device tiles
+    — every result must equal the authoritative CPU path (the reference's
+    'identical result sets' sqlness bar applied to random shapes)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from greptimedb_tpu.database import Database
+
+    import os as _os
+
+    rng = np.random.default_rng(int(_os.environ.get("FUZZ_SEED", 99)))
+    db = Database(data_home=str(tmp_path / "db"))
+    # the manager captured chunk_rows at construction: set it THERE so the
+    # 32k-row regions really split into multiple chunks
+    db.query_engine.tile_cache.chunk_rows = 1 << 14
+    n = 1 << 15
+    parts = int(rng.choice([1, 4]))
+    db.sql(
+        "CREATE TABLE fz (host STRING, dc STRING, ts TIMESTAMP TIME INDEX,"
+        " a DOUBLE, b DOUBLE, PRIMARY KEY (host, dc))"
+        + (f" PARTITION BY HASH (host) PARTITIONS {parts}" if parts > 1 else "")
+        + " WITH (append_mode = 'true')"
+    )
+    hosts = np.array([f"h{i % 12}" for i in range(n)])
+    dcs = np.array([f"d{i % 3}" for i in range(n)])
+    ts = np.arange(n, dtype=np.int64) * 250
+    a = rng.uniform(-50, 150, n)
+    b = rng.uniform(0, 1e6, n)
+    b[rng.random(n) < 0.05] = np.nan  # NULLs through the null planes
+    db.insert_rows("fz", pa.table({
+        "host": pa.array(hosts), "dc": pa.array(dcs),
+        "ts": pa.array(ts, pa.timestamp("ms")),
+        "a": pa.array(a), "b": pa.array(np.where(np.isnan(b), None, b)),
+    }))
+    db.storage.flush_all()
+    end = int(ts[-1])
+
+    def rand_query():
+        aggs = rng.choice(
+            ["sum(a) AS s", "avg(a) AS av", "count(*) AS c", "min(a) AS mn",
+             "max(b) AS mx", "count(b) AS cb", "avg(b) AS ab"],
+            size=rng.integers(1, 4), replace=False,
+        )
+        group = rng.choice(["host", "host, dc", "dc", ""])
+        bucket = rng.choice(["", ", time_bucket('30s', ts) AS tb"])
+        sel_group = group + (bucket if group else bucket.lstrip(", "))
+        where = []
+        if rng.random() < 0.5:
+            lo = int(rng.integers(0, end // 2))
+            hi = int(rng.integers(lo + 1000, end + 1))
+            where.append(f"ts >= {lo} AND ts < {hi}")
+        if rng.random() < 0.3:
+            where.append(f"a > {float(rng.uniform(-50, 100)):.2f}")
+        if rng.random() < 0.3:
+            where.append(f"host = 'h{int(rng.integers(0, 12))}'")
+        sql = "SELECT "
+        if sel_group:
+            sql += sel_group + ", "
+        sql += ", ".join(aggs) + " FROM fz"
+        if where:
+            sql += " WHERE " + " AND ".join(where)
+        gb = [g for g in [group, "tb" if bucket else ""] if g]
+        if gb:
+            sql += " GROUP BY " + ", ".join(gb)
+        return sql
+
+    checked = 0
+    try:
+        for i in range(24):
+            # rotate regimes: tiny budget -> streamed; fresh cache -> cold
+            # serve; repeated query -> warm tiles
+            regime = i % 3
+            cache = db.query_engine.tile_cache
+            if regime == 0:
+                cache.budget = 1 << 20  # stream territory
+            else:
+                cache.budget = 8 << 30
+            if regime == 1:
+                # drop device+host cache state: next query cold-serves.
+                # Regions evicted from _super can still hold warm HOST tiles
+                # under the separate host budget - clear those too
+                rids = set(cache._super) | {k[0] for k in cache._host}
+                for rid in rids:
+                    cache.invalidate_region(rid, set())
+            sql = rand_query()
+            db.config.query.backend = "tpu"
+            t1 = db.sql_one(sql)
+            if regime == 2:
+                t1 = db.sql_one(sql)  # warm rep
+            db.config.query.backend = "cpu"
+            t2 = db.sql_one(sql)
+            db.config.query.backend = "tpu"
+            assert t1.num_rows == t2.num_rows, (sql, t1.num_rows, t2.num_rows)
+            if t1.num_rows == 0:
+                continue
+            keys = [c for c in t1.column_names if c in ("host", "dc", "tb")]
+            s1 = t1.sort_by([(k, "ascending") for k in keys]).to_pydict() if keys else t1.to_pydict()
+            s2 = t2.sort_by([(k, "ascending") for k in keys]).to_pydict() if keys else t2.to_pydict()
+            for col in t1.column_names:
+                v1, v2 = s1[col], s2[col]
+                if col in keys or col in ("c", "cb"):
+                    assert [str(x) for x in v1] == [str(x) for x in v2], (sql, col)
+                else:
+                    for x, y in zip(v1, v2):
+                        if x is None or y is None or (
+                            isinstance(x, float) and x != x
+                        ):
+                            assert (x is None or x != x) == (
+                                y is None or y != y
+                            ), (sql, col, x, y)
+                        else:
+                            assert abs(x - y) <= 1e-6 * max(1.0, abs(y)), (
+                                sql, col, x, y,
+                            )
+            checked += 1
+        assert checked >= 12, f"only {checked} non-empty comparisons"
+    finally:
+        db.close()
+
