@@ -1,0 +1,12 @@
+-- operator precedence and parentheses (reference common/select arithmetic)
+CREATE TABLE ap2 (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO ap2 VALUES ('a', 1000, 2.0), ('b', 2000, 3.0);
+
+SELECT host, v + 2 * 3 AS no_paren, (v + 2) * 3 AS with_paren FROM ap2 ORDER BY host;
+
+SELECT host, -v + 10 AS neg, v * v - v AS quad FROM ap2 ORDER BY host;
+
+SELECT host, v / 2 / 2 AS chained FROM ap2 ORDER BY host;
+
+DROP TABLE ap2;

@@ -1,0 +1,16 @@
+-- join two time-series tables on tag + time (reference common/select ts join)
+CREATE TABLE mtj_a (host STRING, ts TIMESTAMP TIME INDEX, cpu DOUBLE, PRIMARY KEY (host));
+
+CREATE TABLE mtj_b (host STRING, ts TIMESTAMP TIME INDEX, mem DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO mtj_a VALUES ('x', 1000, 10.0), ('x', 2000, 20.0), ('y', 1000, 30.0);
+
+INSERT INTO mtj_b VALUES ('x', 1000, 100.0), ('x', 2000, 200.0), ('y', 2000, 300.0);
+
+SELECT a.host, a.cpu, b.mem FROM mtj_a a JOIN mtj_b b ON a.host = b.host AND a.ts = b.ts ORDER BY a.host, a.cpu;
+
+SELECT a.host, a.cpu, b.mem FROM mtj_a a LEFT JOIN mtj_b b ON a.host = b.host AND a.ts = b.ts ORDER BY a.host, a.cpu;
+
+DROP TABLE mtj_a;
+
+DROP TABLE mtj_b;

@@ -1,0 +1,12 @@
+-- TQL EVAL with lookback behavior at range edges (reference promql eval edges)
+CREATE TABLE tse (host STRING, greptime_value DOUBLE, greptime_timestamp TIMESTAMP(3) TIME INDEX, PRIMARY KEY (host));
+
+INSERT INTO tse VALUES ('a', 1.0, 0), ('a', 2.0, 60000), ('a', 3.0, 120000);
+
+TQL EVAL (0, 120, '60s') tse;
+
+TQL EVAL (30, 150, '60s') tse;
+
+TQL EVAL (0, 120, '120s') tse{host="a"};
+
+DROP TABLE tse;

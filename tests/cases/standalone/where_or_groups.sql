@@ -1,0 +1,12 @@
+-- OR groups with parentheses in WHERE (reference common/select where)
+CREATE TABLE wog (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO wog VALUES ('a', 1000, 1), ('b', 2000, 5), ('c', 3000, 10), ('d', 4000, 20);
+
+SELECT host FROM wog WHERE (host = 'a' OR host = 'd') AND v < 15 ORDER BY host;
+
+SELECT host FROM wog WHERE host = 'a' OR (v > 8 AND v < 15) ORDER BY host;
+
+SELECT count(*) AS c FROM wog WHERE NOT (v > 4 AND v < 15);
+
+DROP TABLE wog;
