@@ -30,6 +30,7 @@ import pyarrow.flight as fl
 
 from ..datatypes.schema import Schema
 from ..storage.sst import ScanPredicate
+from ..utils import fault_injection
 from ..utils.errors import RegionNotFoundError
 
 
@@ -226,6 +227,10 @@ class FlightDatanodeClient:
     def _action(self, kind: str, body: dict) -> dict:
         if not self.alive:
             raise ConnectionError(f"datanode {self.node_id} is down")
+        # fires BEFORE the FlightError->ConnectionError conversion below, so
+        # injected pyarrow exceptions reach callers raw — the same way a
+        # connect-time failure escapes the conversion in production
+        fault_injection.fire("flight.do_action", node_id=self.node_id, kind=kind)
         try:
             results = list(self._client.do_action(fl.Action(kind, json.dumps(body).encode())))
         except fl.FlightError as e:
@@ -280,6 +285,7 @@ class FlightDatanodeClient:
     def write(self, rid: int, batch: pa.RecordBatch) -> int:
         if not self.alive:
             raise ConnectionError(f"datanode {self.node_id} is down")
+        fault_injection.fire("flight.do_put", node_id=self.node_id, region_id=rid)
         descriptor = fl.FlightDescriptor.for_command(json.dumps({"region_id": rid}).encode())
         try:
             writer, meta_reader = self._client.do_put(descriptor, batch.schema)
@@ -296,6 +302,7 @@ class FlightDatanodeClient:
     def scan(self, rid: int, pred: ScanPredicate, projection: list[str] | None = None) -> pa.Table:
         if not self.alive:
             raise ConnectionError(f"datanode {self.node_id} is down")
+        fault_injection.fire("flight.do_get", node_id=self.node_id, region_id=rid)
         ticket = fl.Ticket(encode_scan_ticket(rid, pred, projection))
         try:
             return self._client.do_get(ticket).read_all()
@@ -305,6 +312,7 @@ class FlightDatanodeClient:
     def partial_agg(self, rid: int, pred: ScanPredicate, spec_dict: dict) -> pa.Table:
         if not self.alive:
             raise ConnectionError(f"datanode {self.node_id} is down")
+        fault_injection.fire("flight.do_get", node_id=self.node_id, region_id=rid)
         ticket = fl.Ticket(encode_scan_ticket(rid, pred, agg=spec_dict))
         try:
             return self._client.do_get(ticket).read_all()
@@ -314,6 +322,7 @@ class FlightDatanodeClient:
     def execute_plan(self, rid: int, plan_dict: dict) -> pa.Table:
         if not self.alive:
             raise ConnectionError(f"datanode {self.node_id} is down")
+        fault_injection.fire("flight.do_get", node_id=self.node_id, region_id=rid)
         ticket = fl.Ticket(
             encode_scan_ticket(rid, ScanPredicate(), plan=plan_dict)
         )

@@ -23,9 +23,11 @@ the in-process Cluster does.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time as _time
+from concurrent.futures import TimeoutError as _FuturesTimeout
 
 import pyarrow as pa
 
@@ -47,15 +49,23 @@ from ..query.sql_parser import (
     parse_sql,
 )
 from ..storage.sst import ScanPredicate
+from ..utils import tracing
 from ..utils.config import Config
+from ..utils.deadline import current_deadline, deadline_scope, propagate
 from ..utils.errors import (
+    GreptimeError,
+    IllegalStateError,
     InvalidArgumentsError,
+    QueryTimeoutError,
     RetryLaterError,
     TableNotFoundError,
     UnsupportedError,
 )
+from ..utils.retry import RetryPolicy, is_transient
 from .flight import FlightDatanodeClient
 from .meta_service import MetaClient
+
+_LOG = logging.getLogger("greptimedb_tpu.frontend")
 
 
 class Frontend:
@@ -79,6 +89,16 @@ class Frontend:
         # data-proximate compute and ship bounded states/rows
         self._clients: dict[int, FlightDatanodeClient] = {}
         self._clients_lock = threading.Lock()
+        # one retry policy governs every frontend->datanode request
+        # (reference client/src/region.rs RegionRequester retries with
+        # channel invalidation); tests may swap it for a tighter one
+        self.retry_policy = RetryPolicy(
+            max_attempts=4, base_delay_s=0.05, max_delay_s=1.0
+        )
+        # fan-out pool is shared across queries and shut down in close()
+        # (round-1 built a fresh ThreadPoolExecutor per _fanout call)
+        self._pool = None
+        self._pool_lock = threading.Lock()
         self.query_engine = QueryEngine(
             schema_provider=lambda t, d: self._table(t, d).schema,
             scan_provider=self._scan,
@@ -104,18 +124,83 @@ class Frontend:
             self._clients[node_id] = c
         return c
 
+    def _drop_client(self, node_id: int | None):
+        if node_id is None:
+            return
+        with self._clients_lock:
+            self._clients.pop(node_id, None)
+
     def _with_client(self, node_id: int, fn):
-        """Run `fn(client)`; on a connection failure drop the cached
-        client, re-resolve the node's address from the metasrv, and retry
-        ONCE — a restarted datanode comes back on a fresh port, and the
-        old Flight channel reports errors without ever marking itself
-        dead (reference client_manager channel invalidation)."""
+        """Run `fn(client)` against a FIXED node under the retry policy; a
+        transient failure drops the cached client so the next attempt
+        re-resolves the node's address from the metasrv — a restarted
+        datanode comes back on a fresh port, and the old Flight channel
+        reports errors without ever marking itself dead (reference
+        client_manager channel invalidation).  Route-aware calls go through
+        `_call_region`, which additionally re-fetches the region route."""
         try:
-            return fn(self._client(node_id))
-        except ConnectionError:
-            with self._clients_lock:
-                self._clients.pop(node_id, None)
-            return fn(self._client(node_id))
+            return self.retry_policy.call(
+                lambda: fn(self._client(node_id)),
+                on_retry=lambda exc, attempt: self._drop_client(node_id),
+            )
+        except Exception as exc:  # noqa: BLE001 — classified below
+            wrapped = self._wrap_exhausted(exc, f"datanode {node_id}")
+            if wrapped is exc:
+                raise
+            raise wrapped from exc
+
+    def _call_region(self, meta, rid: int, fn, routes: dict | None = None):
+        """Run `fn(client, rid)` against region `rid`'s CURRENT route with
+        bounded backoff.  Between attempts the cached client is dropped and
+        the route is re-fetched from the metasrv, so a completed
+        `RegionFailoverProcedure` is consumed by in-flight queries/writes:
+        the retried sub-request lands on the failed-over replica instead of
+        hammering the dead node (reference frontend invalidates its
+        table-route cache on request failure)."""
+        state = {"routes": routes, "node": None}
+
+        def attempt():
+            r = state["routes"]
+            if r is None:
+                try:
+                    r = self.meta.get_route(meta.table_id)
+                except (OSError, RuntimeError, IllegalStateError) as exc:
+                    # metasrv churn (restart, mid-election 409, 5xx reply,
+                    # refused connection as URLError) is exactly what the
+                    # retry budget exists to ride out — reclassify so the
+                    # policy keeps attempting instead of aborting hard
+                    raise RetryLaterError(
+                        f"route fetch for table {meta.table_id} failed: {exc}"
+                    ) from exc
+            node = self._routed(r, rid, meta)
+            state["node"] = node
+            return fn(self._client(node), rid)
+
+        def on_retry(exc, attempt_no):
+            self._drop_client(state["node"])
+            state["node"] = None
+            state["routes"] = None  # force a fresh route on the next attempt
+
+        try:
+            return self.retry_policy.call(attempt, on_retry=on_retry)
+        except Exception as exc:  # noqa: BLE001 — classified below
+            wrapped = self._wrap_exhausted(exc, f"region {rid} of {meta.name!r}")
+            if wrapped is exc:
+                raise
+            raise wrapped from exc
+
+    def _wrap_exhausted(self, exc: Exception, what: str) -> Exception:
+        """A transient error that survived the whole retry budget must
+        reach the SQL surface as RETRY_LATER (status 2001), never as a raw
+        ConnectionError/Flight exception that protocol layers map to an
+        opaque 500 — writes and DDL get the same retryable contract the
+        read fan-out's give_up() provides."""
+        if is_transient(exc) and not isinstance(exc, GreptimeError):
+            return RetryLaterError(
+                f"{what} unavailable after "
+                f"{self.retry_policy.max_attempts} attempts: {exc}"
+            )
+        return exc
 
     def _table(self, name: str, database: str | None = None):
         database = database or self.current_database
@@ -152,7 +237,11 @@ class Frontend:
 
     def _execute(self, stmt):
         if isinstance(stmt, SelectStmt):
-            return self.query_engine.execute_select(stmt, self.current_database)
+            # same per-statement budget as Database._execute: the fan-out
+            # (and every retry sleep under it) checks this deadline, so a
+            # hung datanode yields QueryTimeoutError, not a stuck query
+            with deadline_scope(self.config.query.timeout_s):
+                return self.query_engine.execute_select(stmt, self.current_database)
         if isinstance(stmt, CreateTableStmt):
             return self._create_table(stmt)
         if isinstance(stmt, InsertStmt):
@@ -192,8 +281,9 @@ class Frontend:
         schema = compute_altered_schema(stmt, meta.schema)
         routes = self.meta.get_route(meta.table_id)
         for rid in meta.region_ids:
-            node = self._routed(routes, rid, meta)
-            self._with_client(node, lambda c, _r=rid: c.alter_region(_r, schema))
+            self._call_region(
+                meta, rid, lambda c, r: c.alter_region(r, schema), routes=routes
+            )
         meta.schema = schema
         self.catalog.update_table(meta)
         return None
@@ -226,9 +316,8 @@ class Frontend:
             if not part.num_rows:
                 continue
             rid = region_ids[i]
-            node = self._routed(routes, rid, meta)
-            deleted += self._with_client(
-                node, lambda c, _r=rid, _p=part: c.delete_rows(_r, _p)
+            deleted += self._call_region(
+                meta, rid, lambda c, r, _p=part: c.delete_rows(r, _p), routes=routes
             )
         return deleted
 
@@ -236,11 +325,36 @@ class Frontend:
         meta = self._table(stmt.table, self.current_database)
         routes = self.meta.get_route(meta.table_id)
         for rid in meta.region_ids:
-            node = self._routed(routes, rid, meta)
-            self._with_client(node, lambda c, _r=rid: c.truncate_region(_r))
+            self._call_region(
+                meta, rid, lambda c, r: c.truncate_region(r), routes=routes
+            )
         return None
 
     # ---- DDL ---------------------------------------------------------------
+    def _cleanup(self, op: str, fn, **attrs):
+        """Best-effort rollback/cleanup step.  Only errors cleanup can do
+        nothing about are swallowed — transient transport failures, the
+        database's own status-coded errors (region already gone, metasrv
+        mid-election), and the meta client's RuntimeError surface for
+        metasrv 5xx replies.  Anything else (TypeError, KeyError, ...) is
+        a bug and propagates.  Every swallowed error is recorded on a
+        tracing span AND logged, so cleanup failures are observable
+        instead of silently dropped (round-1 used bare `except
+        Exception: pass`)."""
+        with tracing.span(f"frontend.cleanup.{op}", **attrs) as s:
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — re-raised unless benign
+                if not (
+                    is_transient(e)
+                    or isinstance(e, (GreptimeError, OSError, RuntimeError))
+                ):
+                    raise
+                s.attributes["error"] = f"{type(e).__name__}: {e}"
+                _LOG.warning(
+                    "cleanup step %s %s failed: %s", op, attrs or "", e
+                )
+
     def _create_table(self, stmt: CreateTableStmt):
         if stmt.external or stmt.engine in ("file", "metric"):
             raise UnsupportedError(
@@ -255,14 +369,16 @@ class Frontend:
                     node = self.meta.select_datanode()
                     if node is None:
                         raise RetryLaterError("no live datanode to place region on")
-                    self._client(node).open_region(rid, schema)
+                    self._with_client(node, lambda c, _r=rid: c.open_region(_r, schema))
                     routes[rid] = node
             except Exception:
                 for rid, node in routes.items():
-                    try:
-                        self._client(node).close_region(rid)
-                    except Exception:  # noqa: BLE001 — best-effort rollback
-                        pass
+                    self._cleanup(
+                        "close_region",
+                        lambda _r=rid, _n=node: self._client(_n).close_region(_r),
+                        region_id=rid,
+                        node_id=node,
+                    )
                 raise
             self.meta.set_route(m.table_id, routes)
 
@@ -293,16 +409,19 @@ class Frontend:
             node = routes.get(rid)
             if node is None:
                 continue
-            try:
-                self._client(node).close_region(rid)
-            except Exception:  # noqa: BLE001 — the region is unrouted already
-                pass
-        try:
-            # clear the metasrv route so dead table ids don't accumulate
-            # in the KV (Cluster's DropTableProcedure removes metadata)
-            self.meta.set_route(meta.table_id, {})
-        except Exception:  # noqa: BLE001 — best-effort cleanup
-            pass
+            self._cleanup(
+                "close_region",
+                lambda _r=rid, _n=node: self._client(_n).close_region(_r),
+                region_id=rid,
+                node_id=node,
+            )
+        # clear the metasrv route so dead table ids don't accumulate
+        # in the KV (Cluster's DropTableProcedure removes metadata)
+        self._cleanup(
+            "clear_route",
+            lambda: self.meta.set_route(meta.table_id, {}),
+            table_id=meta.table_id,
+        )
         return None
 
     # ---- DML ---------------------------------------------------------------
@@ -352,7 +471,10 @@ class Frontend:
         return self.write_batch(meta, batch)
 
     def write_batch(self, meta, batch: pa.RecordBatch) -> int:
-        """Per-region fan-out over Flight DoPut (reference Inserter)."""
+        """Per-region fan-out over Flight DoPut (reference Inserter).  Each
+        region write runs under the retry policy with route refresh, so a
+        write in flight when its datanode dies lands on the failed-over
+        replica once the metasrv moves the route."""
         routes = self.meta.get_route(meta.table_id)
         table = pa.Table.from_batches([batch])
         affected = 0
@@ -361,9 +483,10 @@ class Frontend:
             if part.num_rows == 0:
                 continue
             rid = region_ids[i]
-            node = self._routed(routes, rid, meta)
             for b in part.to_batches():
-                affected += self._with_client(node, lambda c: c.write(rid, b))
+                affected += self._call_region(
+                    meta, rid, lambda c, r, _b=b: c.write(r, _b), routes=routes
+                )
         return affected
 
     def insert_rows(self, table: str, rows, database: str | None = None) -> int:
@@ -416,44 +539,127 @@ class Frontend:
             )
         return node
 
+    def _executor(self):
+        with self._pool_lock:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                # sized for I/O-bound waiting, not CPU: workers spend their
+                # time blocked on Flight RPCs (and retry backoff sleeps), so
+                # the pool must absorb several concurrent multi-region
+                # queries without one query's regions starving another's
+                # into a spurious deadline
+                self._pool = ThreadPoolExecutor(
+                    max_workers=32,
+                    thread_name_prefix=f"frontend{self.node_id}-fanout",
+                )
+            return self._pool
+
     def _fanout(self, meta, fn):
+        """Run `fn(client, rid)` for every region of `meta` concurrently on
+        the shared pool (reference MergeScanExec fans sub-queries per
+        region, merge_scan.rs:250-330).  Semantics:
+
+          * each region request runs under the retry policy with route
+            refresh (`_call_region`), so mid-query failover is consumed;
+          * the active query deadline crosses into the pool workers
+            (deadline.propagate) AND bounds the gather — a datanode that
+            hangs without erroring yields QueryTimeoutError, never a stuck
+            frontend;
+          * regions still failing transiently after retries surface as ONE
+            RetryLaterError naming the failed region ids (the SQL layer's
+            retryable status), while non-transient errors propagate as-is.
+        """
         routes = self.meta.get_route(meta.table_id)
         rids = meta.region_ids
-        if len(rids) <= 1:
-            return [fn(rid, self._routed(routes, rid, meta)) for rid in rids]
-        from concurrent.futures import ThreadPoolExecutor
+        deadline = current_deadline()
 
-        with ThreadPoolExecutor(max_workers=min(len(rids), 8)) as pool:
-            return list(
-                pool.map(lambda r: fn(r, self._routed(routes, r, meta)), rids)
-            )
+        def give_up(failed: list[int], last_exc: Exception):
+            raise RetryLaterError(
+                f"regions {failed} of {meta.name!r} unavailable after "
+                f"{self.retry_policy.max_attempts} attempts: {last_exc}"
+            ) from last_exc
+
+        if len(rids) <= 1 and deadline is None:
+            results = []
+            for rid in rids:
+                try:
+                    results.append(self._call_region(meta, rid, fn, routes=routes))
+                except Exception as exc:  # noqa: BLE001 — classified below
+                    if not is_transient(exc):
+                        raise
+                    give_up([rid], exc)
+            return results
+        pool = self._executor()
+        futures = {
+            rid: pool.submit(propagate(self._call_region), meta, rid, fn, routes)
+            for rid in rids
+        }
+        results: list = []
+        failed: list[int] = []
+        last_exc: Exception | None = None
+
+        def note_failure(rid: int, exc: Exception):
+            nonlocal last_exc
+            if not is_transient(exc):
+                raise exc
+            failed.append(rid)
+            last_exc = exc
+
+        try:
+            for rid, fut in futures.items():
+                timeout = None
+                if deadline is not None:
+                    timeout = max(deadline - _time.monotonic(), 0.0)
+                settle_done = False
+                try:
+                    results.append(fut.result(timeout=timeout))
+                    continue
+                except (TimeoutError, _FuturesTimeout):
+                    # concurrent.futures.TimeoutError aliases TimeoutError
+                    # only on 3.11+, so both spellings are caught.  An
+                    # undone future means the GATHER outlived the query
+                    # deadline; a done one either re-raised the worker's
+                    # own TimeoutError or finished in the race window just
+                    # as the gather timed out — read its REAL outcome below
+                    if not fut.done():
+                        raise QueryTimeoutError(
+                            f"distributed fan-out for {meta.name!r} exceeded "
+                            f"the query deadline; region {rid} still pending"
+                        ) from None
+                    settle_done = True
+                except QueryTimeoutError:
+                    raise
+                except Exception as exc:  # noqa: BLE001 — classified
+                    note_failure(rid, exc)
+                if settle_done:
+                    try:
+                        results.append(fut.result())
+                    except QueryTimeoutError:
+                        raise
+                    except Exception as exc:  # noqa: BLE001 — classified
+                        note_failure(rid, exc)
+        finally:
+            # no-op for completed futures; sheds queued work on early exit
+            for fut in futures.values():
+                fut.cancel()
+        if failed:
+            give_up(failed, last_exc)
+        return results
 
     def _region_scan(self, scan: TableScan) -> list[pa.Table]:
         meta = self._table(scan.table, scan.database)
         pred = self._pred(scan)
-        return self._fanout(
-            meta,
-            lambda rid, node: self._with_client(node, lambda c: c.scan(rid, pred)),
-        )
+        return self._fanout(meta, lambda c, rid: c.scan(rid, pred))
 
     def _partial_agg(self, scan: TableScan, spec_dict: dict) -> list[pa.Table]:
         meta = self._table(scan.table, scan.database)
         pred = self._pred(scan)
-        return self._fanout(
-            meta,
-            lambda rid, node: self._with_client(
-                node, lambda c: c.partial_agg(rid, pred, spec_dict)
-            ),
-        )
+        return self._fanout(meta, lambda c, rid: c.partial_agg(rid, pred, spec_dict))
 
     def _sub_plan(self, scan: TableScan, plan_dict: dict) -> list[pa.Table]:
         meta = self._table(scan.table, scan.database)
-        return self._fanout(
-            meta,
-            lambda rid, node: self._with_client(
-                node, lambda c: c.execute_plan(rid, plan_dict)
-            ),
-        )
+        return self._fanout(meta, lambda c, rid: c.execute_plan(rid, plan_dict))
 
     def _scan(self, scan: TableScan) -> pa.Table:
         if not scan.table:
@@ -469,8 +675,9 @@ class Frontend:
         routes = self.meta.get_route(meta.table_id)
         lo = hi = None
         for rid in meta.region_ids:
-            node = self._routed(routes, rid, meta)
-            b = self._with_client(node, lambda c: c.time_bounds(rid))
+            b = self._call_region(
+                meta, rid, lambda c, r: c.time_bounds(r), routes=routes
+            )
             if b is None:
                 continue
             lo = b[0] if lo is None else min(lo, b[0])
@@ -489,5 +696,9 @@ class Frontend:
             pass
 
     def close(self):
+        with self._pool_lock:
+            if self._pool is not None:
+                self._pool.shutdown(wait=False, cancel_futures=True)
+                self._pool = None
         with self._clients_lock:
             self._clients.clear()

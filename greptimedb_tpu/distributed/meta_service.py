@@ -21,6 +21,7 @@ import threading
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..utils import fault_injection
 from ..utils.errors import IllegalStateError
 from .metasrv import Metasrv
 
@@ -178,6 +179,9 @@ class MetaClient:
         self, node_id: int, stats: list, now_ms: float, role: str = "datanode",
         addr: str | None = None,
     ) -> dict:
+        # a blackholed heartbeat (armed per-node in chaos tests) models a
+        # network partition between this node and the metasrv
+        fault_injection.fire("meta.heartbeat", node_id=node_id, role=role)
         return self._call(
             "/heartbeat",
             {"node_id": node_id, "stats": stats, "now_ms": now_ms, "role": role,
@@ -185,6 +189,7 @@ class MetaClient:
         )
 
     def get_route(self, table_id: int) -> dict[int, int]:
+        fault_injection.fire("meta.get_route", table_id=table_id)
         out = self._call("/route/get", {"table_id": table_id})
         return {int(k): v for k, v in out["routes"].items()}
 

@@ -30,6 +30,8 @@ import pyarrow as pa
 import pyarrow.compute as pc
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils.jax_compat import shard_map as _shard_map
+
 from ..ops.aggregate import (
     BLOCK_ROWS,
     _FAST_MIN_ROWS,
@@ -419,7 +421,7 @@ def _compiled_step(mesh: Mesh, plan: DistGroupByPlan):
         nulls = {k: v[0] for k, v in nulls.items()}
         return _device_step(plan, cols, valid[0], nulls)
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         per_device,
         mesh=mesh,
         in_specs=P(REGION_AXIS, None),

@@ -27,7 +27,7 @@ from collections import OrderedDict
 
 import pyarrow as pa
 
-from ..utils import metrics
+from ..utils import fault_injection, metrics
 from ..utils.errors import ConfigError
 
 OBJECT_STORE_READS = metrics.Counter("object_store_reads", "object store read ops")
@@ -309,48 +309,52 @@ class PrefixStore(ObjectStore):
 
 class RetryLayer(ObjectStore):
     """Retry transient IO errors with exponential backoff (reference wraps
-    every store in opendal's RetryLayer)."""
+    every store in opendal's RetryLayer).  Backoff/classification live in
+    the repo-wide `utils/retry.py` policy — this layer only names the
+    fault-injection point each operation fires under, so chaos tests can
+    make the backing store flaky and watch the retries absorb it."""
 
     def __init__(self, inner: ObjectStore, attempts: int = 3, base_delay_s: float = 0.05):
-        self.inner = inner
-        self.attempts = max(1, attempts)  # 0/negative would mean "never even try"
-        self.base_delay_s = base_delay_s
+        from ..utils.retry import RetryPolicy, is_transient_io
 
-    def _retry(self, fn, *args):
-        last: Exception | None = None
-        for i in range(self.attempts):
-            try:
-                return fn(*args)
-            except FileNotFoundError:
-                raise  # not transient
-            except OSError as e:
-                last = e
-                time.sleep(self.base_delay_s * (2**i))
-        raise last  # type: ignore[misc]
+        self.inner = inner
+        self.policy = RetryPolicy(
+            # 0/negative attempts would mean "never even try"
+            max_attempts=max(1, attempts),
+            base_delay_s=base_delay_s,
+            classify=is_transient_io,
+        )
+
+    def _retry(self, point, fn, *args):
+        def attempt():
+            fault_injection.fire(point)
+            return fn(*args)
+
+        return self.policy.call(attempt)
 
     def read(self, key):
-        return self._retry(self.inner.read, key)
+        return self._retry("store.read", self.inner.read, key)
 
     def write(self, key, data):
-        return self._retry(self.inner.write, key, data)
+        return self._retry("store.write", self.inner.write, key, data)
 
     def put_file(self, key, local_src):
-        return self._retry(self.inner.put_file, key, local_src)
+        return self._retry("store.write", self.inner.put_file, key, local_src)
 
     def open_input(self, key):
-        return self._retry(self.inner.open_input, key)
+        return self._retry("store.read", self.inner.open_input, key)
 
     def exists(self, key):
         return self.inner.exists(key)
 
     def delete(self, key):
-        return self._retry(self.inner.delete, key)
+        return self._retry("store.write", self.inner.delete, key)
 
     def list(self, prefix=""):
-        return self._retry(self.inner.list, prefix)
+        return self._retry("store.read", self.inner.list, prefix)
 
     def size(self, key):
-        return self._retry(self.inner.size, key)
+        return self._retry("store.read", self.inner.size, key)
 
     def scratch_path(self, key):
         return self.inner.scratch_path(key)

@@ -40,6 +40,7 @@ import zlib
 
 import pyarrow as pa
 
+from ..utils import fault_injection
 from ..utils.errors import StorageError
 from .wal import WalEntry, _decode_batch, _encode_batch
 
@@ -97,6 +98,7 @@ class SharedLogStore:
 
     # ---- write -------------------------------------------------------------
     def append(self, topic: str, region_id: int, entry_id: int, batch: pa.RecordBatch):
+        fault_injection.fire("wal.append", topic=topic, region_id=region_id)
         payload = _encode_batch(batch)
         frame = _FRAME.pack(len(payload), zlib.crc32(payload), region_id, entry_id) + payload
         with self._lock:

@@ -10,8 +10,15 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
 from typing import Any
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: TOML loading degrades gracefully
+    try:
+        import tomli as tomllib  # type: ignore[no-redef]
+    except ModuleNotFoundError:
+        tomllib = None  # type: ignore[assignment]
 
 ENV_PREFIX = "GREPTIMEDB_TPU"
 
@@ -240,6 +247,11 @@ class Config:
         """defaults -> TOML at `path` -> GREPTIMEDB_TPU__SECTION__KEY env vars."""
         layers: dict = {}
         if path and os.path.exists(path):
+            if tomllib is None:
+                raise RuntimeError(
+                    "TOML config files need Python >= 3.11 (tomllib) or the "
+                    "tomli package; env-var configuration is unaffected"
+                )
             with open(path, "rb") as f:
                 layers = _deep_merge(layers, tomllib.load(f))
         env = env if env is not None else dict(os.environ)

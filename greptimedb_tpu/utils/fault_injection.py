@@ -1,0 +1,194 @@
+"""Process-wide fault-injection registry for chaos testing.
+
+The reference exercises its fault-tolerance machinery with black-box fuzz
+targets that kill real processes (reference tests-fuzz/targets/failover);
+that is slow and non-deterministic.  This registry gives the same coverage
+in-process: hot paths call `fire("<point>")` at named injection points, and
+a test arms a *fault plan* against a point — fail the next N calls with a
+specific error class, inject latency, or run a callback (e.g. "complete the
+failover now") at exactly that moment.
+
+Named points wired into the codebase:
+
+    flight.do_get      FlightDatanodeClient scan/partial_agg/execute_plan
+    flight.do_put      FlightDatanodeClient.write
+    flight.do_action   FlightDatanodeClient._action (open/close/flush/...)
+    store.read         object-store reads (under RetryLayer, so injected
+    store.write        faults exercise the retry path)
+    wal.append         SharedLogStore.append
+    meta.heartbeat     MetaClient.handle_heartbeat
+    meta.get_route     MetaClient.get_route
+
+Production overhead is near zero: `fire()` is a module-level function whose
+fast path is one read of a module global (`_ARMED`) — no locks, no dict
+lookups — until a test arms a plan.  Plans are thread-safe; concurrent
+callers decrement the same fail budget under the registry lock.
+
+Usage (tests):
+
+    from greptimedb_tpu.utils import fault_injection as fi
+
+    plan = fi.REGISTRY.arm("flight.do_get", fail_times=2,
+                           error=fl.FlightUnavailableError)
+    ... run the query; first two region sub-queries raise, retries win ...
+    assert plan.trips == 2
+    fi.REGISTRY.disarm()
+
+or scoped:
+
+    with fi.REGISTRY.armed("store.write", fail_times=1, error=TimeoutError):
+        engine.flush_region(rid)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+POINTS = frozenset(
+    {
+        "flight.do_get",
+        "flight.do_put",
+        "flight.do_action",
+        "store.read",
+        "store.write",
+        "wal.append",
+        "meta.heartbeat",
+        "meta.get_route",
+    }
+)
+
+# Module-level fast flag: fire() returns immediately while no plan is armed
+# anywhere in the process.  Only the registry mutates it, under its lock.
+_ARMED = False
+
+
+class FaultPlan:
+    """One armed fault at one point.
+
+    Behaviour per matching hit, in order: first `skip` hits pass through,
+    the next `fail_times` hits *trip* (sleep `latency_s`, run `callback`,
+    raise `error` if set), every later hit passes through again — the
+    "fail-N-then-succeed" shape retry tests need.  A plan with no error is
+    a pure hook (latency and/or callback only).
+    """
+
+    def __init__(
+        self,
+        point: str,
+        *,
+        fail_times: int = 1,
+        error: type[BaseException] | BaseException | None = None,
+        latency_s: float = 0.0,
+        skip: int = 0,
+        match=None,
+        callback=None,
+    ):
+        if point not in POINTS:
+            raise ValueError(f"unknown fault point {point!r}; known: {sorted(POINTS)}")
+        self.point = point
+        self.fail_times = fail_times
+        self.error = error
+        self.latency_s = latency_s
+        self.skip = skip
+        self.match = match
+        self.callback = callback
+        self.hits = 0  # matching calls observed (including pass-throughs)
+        self.trips = 0  # calls that actually injected the fault
+
+    def _make_error(self) -> BaseException | None:
+        if self.error is None:
+            return None
+        if isinstance(self.error, BaseException):
+            return self.error
+        try:
+            return self.error(f"injected fault at {self.point}")
+        except TypeError:
+            # some exception classes (pyarrow Flight) take no free-form args
+            return self.error()
+
+
+class FaultRegistry:
+    """Thread-safe map of point -> armed plans (a test may stack several)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plans: dict[str, list[FaultPlan]] = {}
+
+    # ---- arming ------------------------------------------------------------
+    def arm(self, point: str, **kwargs) -> FaultPlan:
+        global _ARMED
+        plan = FaultPlan(point, **kwargs)
+        with self._lock:
+            self._plans.setdefault(point, []).append(plan)
+            _ARMED = True
+        return plan
+
+    def disarm(self, point: str | None = None):
+        """Remove every plan at `point`, or every plan everywhere."""
+        global _ARMED
+        with self._lock:
+            if point is None:
+                self._plans.clear()
+            else:
+                self._plans.pop(point, None)
+            _ARMED = bool(self._plans)
+
+    def remove(self, plan: FaultPlan):
+        """Remove one specific plan, leaving any stacked plans at the same
+        point armed."""
+        global _ARMED
+        with self._lock:
+            plans = self._plans.get(plan.point)
+            if plans is not None and plan in plans:
+                plans.remove(plan)
+                if not plans:
+                    self._plans.pop(plan.point, None)
+            _ARMED = bool(self._plans)
+
+    @contextlib.contextmanager
+    def armed(self, point: str, **kwargs):
+        plan = self.arm(point, **kwargs)
+        try:
+            yield plan
+        finally:
+            self.remove(plan)
+
+    # ---- firing ------------------------------------------------------------
+    def fire(self, point: str, **ctx):
+        """Called from injection points.  Decides under the lock, acts
+        (sleep/callback/raise) outside it so a latency fault never blocks
+        other threads' fault decisions."""
+        to_trip: FaultPlan | None = None
+        with self._lock:
+            for plan in self._plans.get(point, ()):
+                if plan.match is not None and not plan.match(ctx):
+                    continue
+                plan.hits += 1
+                if plan.hits <= plan.skip:
+                    continue
+                if plan.trips >= plan.fail_times:
+                    continue
+                plan.trips += 1
+                to_trip = plan
+                break
+        if to_trip is None:
+            return
+        if to_trip.latency_s:
+            time.sleep(to_trip.latency_s)
+        if to_trip.callback is not None:
+            to_trip.callback(ctx)
+        err = to_trip._make_error()
+        if err is not None:
+            raise err
+
+
+REGISTRY = FaultRegistry()
+
+
+def fire(point: str, **ctx):
+    """Hot-path hook: no-op unless some plan is armed process-wide."""
+    if not _ARMED:
+        return
+    REGISTRY.fire(point, **ctx)

@@ -1,0 +1,15 @@
+"""Version-compat shims for jax APIs that moved between releases.
+
+`shard_map` graduated from `jax.experimental.shard_map` to the top-level
+`jax.shard_map` around 0.4.38 with the same (mesh, in_specs, out_specs)
+call shape; this module exposes one name that works on both, so call sites
+(and tests) never reach into private fallbacks.
+"""
+
+from __future__ import annotations
+
+import jax
+
+shard_map = getattr(jax, "shard_map", None)
+if shard_map is None:  # pragma: no cover — depends on installed jax
+    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]

@@ -1,0 +1,123 @@
+"""The repo's single retry/backoff implementation.
+
+The reference retries transient failures in two places with one shared
+shape: opendal's RetryLayer under every object store and the frontend's
+region-request retry with route invalidation (reference
+client/src/region.rs + object-store layers).  This module is the one
+backoff implementation both of our paths use: exponential backoff with
+full jitter, a max-attempt bound, and cooperative deadline awareness —
+a retry loop running under `utils/deadline.py` never sleeps past the
+query's deadline and re-raises `QueryTimeoutError` instead of burning
+attempts after time is up.
+
+Classifiers, not inheritance, decide what is transient:
+
+  * `is_transient` — the wire-level classifier: builtin ConnectionError
+    (our clients' "node is down" surface), pyarrow Flight's
+    FlightUnavailableError / FlightTimedOutError / FlightInternalError
+    (what a killed or restarting datanode actually raises — the round-1
+    frontend caught only ConnectionError, so real transport failures were
+    never retried), TimeoutError, and our RetryLaterError.
+  * `is_transient_io` — the object-store classifier: any OSError except
+    FileNotFoundError (a missing object is an answer, not a blip), plus
+    everything `is_transient` covers.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+
+from .deadline import check_deadline, current_deadline
+from .errors import QueryTimeoutError, RetryLaterError
+
+
+def _flight_transient_classes() -> tuple[type, ...]:
+    try:
+        import pyarrow.flight as fl
+    except ImportError:  # pragma: no cover — pyarrow is a hard dep elsewhere
+        return ()
+    return (
+        fl.FlightUnavailableError,
+        fl.FlightTimedOutError,
+        fl.FlightInternalError,
+    )
+
+
+_FLIGHT_TRANSIENT = _flight_transient_classes()
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Wire-level transient classifier (see module docstring)."""
+    if isinstance(exc, QueryTimeoutError):
+        return False  # the deadline is spent; retrying cannot help
+    if isinstance(exc, FileNotFoundError):
+        return False
+    if isinstance(exc, (ConnectionError, TimeoutError, RetryLaterError)):
+        return True
+    return bool(_FLIGHT_TRANSIENT) and isinstance(exc, _FLIGHT_TRANSIENT)
+
+
+def is_transient_io(exc: BaseException) -> bool:
+    """Object-store classifier: OSError minus FileNotFoundError, plus the
+    wire-level set (a store backed by a remote raises either family)."""
+    if isinstance(exc, FileNotFoundError):
+        return False
+    return isinstance(exc, OSError) or is_transient(exc)
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff + full jitter, bounded by attempts AND deadline.
+
+    `classify` decides retryability (defaults to `is_transient`); `call`
+    runs a thunk under the policy, invoking `on_retry(exc, attempt)` before
+    each re-attempt so callers can invalidate caches (drop a dead client,
+    re-fetch a region route) between tries.
+    """
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    jitter: bool = True
+    classify: object = None  # callable(exc) -> bool; None = is_transient
+
+    def backoff_s(self, attempt: int) -> float:
+        """Delay before attempt `attempt` (1-based retries: attempt 0 is
+        the first try and never sleeps)."""
+        cap = min(self.max_delay_s, self.base_delay_s * (2 ** max(attempt - 1, 0)))
+        if not self.jitter:
+            return cap
+        # full jitter (AWS architecture blog): uniform in [0, cap] breaks
+        # retry synchronization across regions/threads
+        return random.uniform(0.0, cap)
+
+    def _sleep(self, seconds: float):
+        """Sleep, but never past the active cooperative deadline."""
+        d = current_deadline()
+        if d is not None:
+            remaining = d - time.monotonic()
+            if remaining <= 0:
+                check_deadline()  # raises QueryTimeoutError
+            seconds = min(seconds, remaining)
+        if seconds > 0:
+            time.sleep(seconds)
+
+    def call(self, fn, *args, on_retry=None, **kwargs):
+        classify = self.classify or is_transient
+        attempts = max(1, self.max_attempts)
+        last: BaseException | None = None
+        for attempt in range(attempts):
+            if attempt:
+                self._sleep(self.backoff_s(attempt))
+            check_deadline()
+            try:
+                return fn(*args, **kwargs)
+            except BaseException as exc:  # noqa: BLE001 — classified below
+                if not classify(exc) or attempt == attempts - 1:
+                    raise
+                last = exc
+                if on_retry is not None:
+                    on_retry(exc, attempt)
+        raise last  # pragma: no cover — loop always returns or raises

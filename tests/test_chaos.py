@@ -1,0 +1,356 @@
+"""Chaos suite: the fault-injection registry driven end-to-end.
+
+The cluster here is the real distributed plane in one process — a
+`MetasrvServer` over HTTP, `FlightDatanode`s on real localhost sockets, and
+a `Frontend` talking to both — with TIME injected (heartbeats/ticks run on
+a logical clock) so failure detection and failover are deterministic, and
+FAULTS injected through `utils/fault_injection.py` so the exact moment a
+dependency breaks is scripted instead of raced (the reference does this
+black-box and slow in tests-fuzz/targets/failover).
+"""
+
+import pyarrow as pa
+import pyarrow.flight as fl
+import pytest
+
+from greptimedb_tpu.distributed.flight import FlightDatanode
+from greptimedb_tpu.distributed.frontend import Frontend
+from greptimedb_tpu.distributed.kv import MemoryKvBackend
+from greptimedb_tpu.distributed.meta_service import MetaClient, MetasrvServer
+from greptimedb_tpu.distributed.metasrv import Metasrv
+from greptimedb_tpu.utils import fault_injection as fi
+from greptimedb_tpu.utils.errors import QueryTimeoutError, RetryLaterError
+from greptimedb_tpu.utils.retry import RetryPolicy, is_transient
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    fi.REGISTRY.disarm()
+    yield
+    fi.REGISTRY.disarm()
+
+
+class _FlightNodeManager:
+    """Metasrv's datanode gateway over the chaos cluster's Flight clients."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def open_region(self, node_id, rid):
+        self.cluster.datanodes[node_id].client.open_region(rid)
+
+    def close_region_quiet(self, node_id, rid):
+        dn = self.cluster.datanodes.get(node_id)
+        if dn is not None and dn.alive:
+            try:
+                dn.client.close_region(rid)
+            except Exception:  # noqa: BLE001 — quiet by contract
+                pass
+
+    def flush_region(self, node_id, rid):
+        self.cluster.datanodes[node_id].client.flush_region(rid)
+
+    def set_region_writable(self, node_id, rid, writable):
+        self.cluster.datanodes[node_id].client.set_region_writable(rid, writable)
+
+
+class ChaosCluster:
+    """1 metasrv (HTTP) + N Flight datanodes + 1 frontend, logical clock."""
+
+    def __init__(self, root: str, num_datanodes: int = 2):
+        self.home = root
+        self.now = [1_000_000.0]  # logical ms fed to heartbeats/ticks
+        self.kv = MemoryKvBackend()
+        self.datanodes = {
+            i: FlightDatanode(i, self.home) for i in range(num_datanodes)
+        }
+        self.metasrv = Metasrv(self.kv, _FlightNodeManager(self))
+        for i, dn in self.datanodes.items():
+            self.metasrv.register_datanode(
+                i, dn.location.removeprefix("grpc://")
+            )
+        self.server = MetasrvServer(self.metasrv).start()
+        self.frontend = Frontend(self.home, [self.server.address])
+        # tight backoff: chaos tests stay inside tier-1
+        self.frontend.retry_policy = RetryPolicy(
+            max_attempts=4, base_delay_s=0.01, max_delay_s=0.05
+        )
+
+    def heartbeat_live(self, advance_ms: float = 1000.0):
+        self.now[0] += advance_ms
+        for nid, dn in self.datanodes.items():
+            if dn.alive:
+                self.metasrv.handle_heartbeat(nid, [], self.now[0])
+
+    def establish_cadence(self, rounds: int = 8):
+        for _ in range(rounds):
+            self.heartbeat_live()
+
+    def fail_over_dead_node(self):
+        """Deterministic failover: a far-future tick suspects everyone, the
+        survivors' next heartbeat revives them, and the following tick
+        submits + synchronously runs failover for regions still routed to
+        dead nodes (same drill as the black-box frontend-role test)."""
+        self.now[0] += 600_000
+        self.metasrv.tick(self.now[0])
+        self.heartbeat_live()
+        return self.metasrv.tick(self.now[0])
+
+    def route_of(self, table: str) -> tuple:
+        meta = self.frontend.catalog.table(table, "public")
+        return meta, self.metasrv.get_route(meta.table_id)
+
+    def close(self):
+        self.frontend.close()
+        self.server.stop()
+        for dn in self.datanodes.values():
+            if dn.alive:
+                dn.shutdown()
+
+
+@pytest.fixture()
+def chaos(tmp_path):
+    c = ChaosCluster(str(tmp_path / "shared"))
+    yield c
+    c.close()
+
+
+def _setup_table(chaos, name="t1"):
+    chaos.frontend.sql(
+        f"CREATE TABLE {name} (host STRING, ts TIMESTAMP TIME INDEX,"
+        " v DOUBLE, PRIMARY KEY (host))"
+    )
+    chaos.frontend.sql(
+        f"INSERT INTO {name} VALUES ('a', 1000, 1.0), ('b', 2000, 2.0),"
+        " ('c', 3000, 3.0)"
+    )
+    chaos.establish_cadence()
+    meta, routes = chaos.route_of(name)
+    rid = meta.region_ids[0]
+    return meta, rid, routes[rid]
+
+
+# ---- killed datanode mid-request: failover consumed via route refresh -----
+
+
+@pytest.mark.chaos
+def test_query_survives_datanode_kill_via_failover(chaos):
+    """Kill the region's datanode, then query.  Attempt 1 hits the dead
+    node; between attempts the frontend re-fetches the route, and a hook on
+    that exact refresh completes the failover — so the retried sub-query
+    lands on the promoted replica.  No raw Flight error escapes, no
+    unbounded retry."""
+    meta, rid, owner = _setup_table(chaos)
+    chaos.datanodes[owner].kill()
+
+    completed = []
+
+    def complete_failover(ctx):
+        completed.append(chaos.fail_over_dead_node())
+
+    # skip=1: the fan-out's initial route fetch passes through (still the
+    # dead owner), the refresh between retry attempts trips the hook
+    plan = fi.REGISTRY.arm(
+        "meta.get_route", fail_times=1, skip=1, callback=complete_failover
+    )
+    out = chaos.frontend.sql_one("SELECT count(*) AS c FROM t1")
+    assert out["c"].to_pylist() == [3]
+    assert plan.trips == 1 and completed and completed[0]
+    _meta, new_routes = chaos.route_of("t1")
+    assert new_routes[rid] != owner
+
+
+@pytest.mark.chaos
+def test_write_survives_datanode_kill_via_failover(chaos):
+    """Same drill on the DoPut path: an INSERT in flight when the region's
+    datanode dies retries onto the failed-over replica, and the rows are
+    durable there (shared WAL replay)."""
+    meta, rid, owner = _setup_table(chaos)
+    chaos.datanodes[owner].kill()
+
+    plan = fi.REGISTRY.arm(
+        "meta.get_route", fail_times=1, skip=1,
+        callback=lambda ctx: chaos.fail_over_dead_node(),
+    )
+    n = chaos.frontend.sql_one("INSERT INTO t1 VALUES ('d', 4000, 4.0)")
+    assert n == 1
+    assert plan.trips == 1
+    out = chaos.frontend.sql_one("SELECT count(*) AS c FROM t1")
+    assert out["c"].to_pylist() == [4]
+    _meta, new_routes = chaos.route_of("t1")
+    assert new_routes[rid] != owner
+
+
+# ---- regression: round-1 retried only builtin ConnectionError -------------
+
+
+@pytest.mark.chaos
+def test_flight_errors_are_retried_not_just_connectionerror(chaos):
+    """Round-1 `_with_client` caught ONLY builtin ConnectionError, but
+    pyarrow Flight raises FlightUnavailableError / FlightTimedOutError —
+    neither subclasses ConnectionError, so the retry was dead code for real
+    transport failures.  The unified classifier must treat them as
+    transient and the query path must absorb an injected one."""
+    for exc_cls in (fl.FlightUnavailableError, fl.FlightTimedOutError):
+        assert not issubclass(exc_cls, ConnectionError)  # the old bug
+        assert is_transient(exc_cls("boom"))
+
+    _setup_table(chaos, "t2")
+    plan = fi.REGISTRY.arm(
+        "flight.do_get", fail_times=1, error=fl.FlightUnavailableError
+    )
+    out = chaos.frontend.sql_one("SELECT count(*) AS c FROM t2")
+    assert out["c"].to_pylist() == [3]
+    assert plan.trips == 1  # the fault fired and a retry absorbed it
+
+
+@pytest.mark.chaos
+def test_bounded_retry_surfaces_retry_later_with_region_ids(chaos):
+    """When every attempt fails transiently, the frontend gives up after
+    max_attempts and raises RetryLaterError naming the failed regions —
+    never an unbounded retry, never a raw Flight exception."""
+    meta, rid, _owner = _setup_table(chaos, "t3")
+    plan = fi.REGISTRY.arm(
+        "flight.do_get", fail_times=100, error=fl.FlightUnavailableError
+    )
+    with pytest.raises(RetryLaterError, match=str(rid)):
+        chaos.frontend.sql_one("SELECT count(*) AS c FROM t3")
+    # every execution path (including the engine's tpu->cpu fallback re-run)
+    # is bounded by max_attempts per fan-out — a handful of trips, not an
+    # unbounded hammering of the region
+    assert plan.trips >= chaos.frontend.retry_policy.max_attempts
+    assert plan.trips <= 3 * chaos.frontend.retry_policy.max_attempts
+
+
+# ---- deadlines across the fan-out -----------------------------------------
+
+
+@pytest.mark.chaos
+def test_query_deadline_aborts_hung_fanout(chaos):
+    """A datanode that hangs (injected latency, no error) must not hang the
+    query: with config.query.timeout_s set, the fan-out gather aborts with
+    QueryTimeoutError at the deadline."""
+    _setup_table(chaos, "t4")
+    fi.REGISTRY.arm("flight.do_get", fail_times=100, latency_s=5.0)
+    chaos.frontend.config.query.timeout_s = 0.4
+    try:
+        with pytest.raises(QueryTimeoutError):
+            chaos.frontend.sql_one("SELECT count(*) AS c FROM t4")
+    finally:
+        chaos.frontend.config.query.timeout_s = 0.0
+
+
+# ---- lease fencing on a partitioned (blackholed-heartbeat) writer ---------
+
+
+@pytest.mark.chaos
+def test_blackholed_heartbeats_fence_stale_writer(chaos):
+    """Partition a datanode from the metasrv by blackholing its heartbeats
+    at the meta client: its lease lapses on its own clock and the alive
+    keeper fences writes locally (distributed/alive_keeper.py) while the
+    supervisor fails the region over — split-brain averted from both
+    sides."""
+    from greptimedb_tpu.distributed.alive_keeper import (
+        RegionAliveKeeper,
+        RegionLeaseExpiredError,
+    )
+    from greptimedb_tpu.distributed.metasrv import LEASE_MS
+
+    meta, rid, owner = _setup_table(chaos, "t5")
+    keeper = RegionAliveKeeper(owner)
+    client = MetaClient([chaos.server.address])
+
+    # a healthy heartbeat through the real meta client grants the lease
+    reply = client.handle_heartbeat(owner, [], chaos.now[0])
+    keeper.renew(reply["lease_regions"], reply["lease_until_ms"])
+    assert rid in reply["lease_regions"]
+    keeper.check_write(rid, chaos.now[0])  # lease valid
+
+    # partition: every further heartbeat from this node is blackholed
+    fi.REGISTRY.arm(
+        "meta.heartbeat", fail_times=100, error=ConnectionError,
+        match=lambda ctx: ctx.get("node_id") == owner,
+    )
+    chaos.now[0] += LEASE_MS * 4
+    with pytest.raises(ConnectionError):
+        client.handle_heartbeat(owner, [], chaos.now[0])
+    with pytest.raises(RegionLeaseExpiredError):
+        keeper.check_write(rid, chaos.now[0])
+    # the OTHER node's heartbeats are not matched by the plan
+    other = next(n for n in chaos.datanodes if n != owner)
+    assert "lease_until_ms" in client.handle_heartbeat(other, [], chaos.now[0])
+
+
+# ---- flaky object store under flush/compaction ----------------------------
+
+
+@pytest.mark.chaos
+def test_flaky_object_store_flush_absorbed_by_retry_layer(tmp_path):
+    """SST uploads that fail transiently (remote-store weather) are
+    absorbed by the RetryLayer, now running on the unified policy: the
+    flush completes, the data stays readable, and the fault counters prove
+    the failures actually happened."""
+    from greptimedb_tpu.storage.engine import TimeSeriesEngine
+    from greptimedb_tpu.utils.config import StorageConfig
+    from tests.test_flight import cpu_schema, make_batch
+
+    cfg = StorageConfig(data_home=str(tmp_path), store_type="mock_remote")
+    engine = TimeSeriesEngine(cfg)
+    try:
+        engine.create_region(7, cpu_schema())
+        engine.write(
+            7, make_batch(cpu_schema(), ["a", "b"], [1000, 2000], [1.0, 2.0])
+        )
+        plan = fi.REGISTRY.arm(
+            "store.write", fail_times=2, error=TimeoutError
+        )
+        engine.flush_region(7)
+        assert plan.trips == 2  # two injected failures, retries absorbed both
+        from greptimedb_tpu.storage.sst import ScanPredicate
+
+        assert engine.scan(7, ScanPredicate()).num_rows == 2
+    finally:
+        fi.REGISTRY.disarm()
+        engine.close()
+
+
+@pytest.mark.chaos
+def test_flaky_shared_wal_append_absorbed_by_frontend_retry(chaos):
+    """A transient shared-WAL append failure on the datanode surfaces to
+    the frontend as a failed DoPut; the unified retry re-sends the write
+    and the second append lands.  (The WAL hook fires datanode-side; the
+    retry loop is the frontend's.)"""
+    import os
+    import threading
+
+    from greptimedb_tpu.distributed.flight import (
+        DatanodeFlightServer,
+        FlightDatanodeClient,
+    )
+    from greptimedb_tpu.storage.engine import TimeSeriesEngine
+    from greptimedb_tpu.storage.sst import ScanPredicate
+    from greptimedb_tpu.utils.config import StorageConfig
+    from tests.test_flight import cpu_schema, make_batch
+
+    cfg = StorageConfig(
+        data_home=os.path.join(chaos.home, "walnode"), wal_provider="shared_file"
+    )
+    engine = TimeSeriesEngine(cfg)
+    server = DatanodeFlightServer(engine)
+    t = threading.Thread(target=server.serve, daemon=True)
+    t.start()
+    try:
+        client = FlightDatanodeClient(9, server.location)
+        schema = cpu_schema()
+        client.open_region(9216, schema)
+        plan = fi.REGISTRY.arm("wal.append", fail_times=1, error=OSError)
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.01)
+        n = policy.call(
+            lambda: client.write(9216, make_batch(schema, ["x"], [1000], [9.0]))
+        )
+        assert n == 1
+        assert plan.trips == 1
+        assert client.scan(9216, ScanPredicate()).num_rows == 1
+    finally:
+        server.shutdown()
+        engine.close()

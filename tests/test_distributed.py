@@ -160,6 +160,58 @@ def test_phi_detector_adapts_to_cadence():
     assert det.is_available(t + 15_000)
 
 
+def test_phi_detector_bootstrap_synthetic_sample():
+    """The FIRST heartbeat seeds a synthetic two-point sample around
+    first_heartbeat_estimate_ms (mean +/- mean/4, like the reference/Akka
+    bootstrap) so phi is meaningful before any real inter-arrival data."""
+    det = PhiAccrualFailureDetector(first_heartbeat_estimate_ms=1000.0)
+    assert det.phi(0.0) == 0.0  # no heartbeat yet: nothing to suspect
+    det.heartbeat(0.0)
+    assert sorted(det._intervals) == [750.0, 1250.0]
+    # right after the single heartbeat the node is comfortably available
+    assert det.is_available(500.0)
+    # and a long silence trips even with only the synthetic sample
+    assert not det.is_available(120_000.0)
+
+
+def test_phi_detector_exponent_clamps():
+    """The logistic approximation's exponent is clamped at +/-700 —
+    beyond that exp() overflows a double while the probability is 0/1 to
+    machine precision anyway (failure_detector.py:54-57)."""
+    det = PhiAccrualFailureDetector()
+    t = 0.0
+    for _ in range(20):
+        det.heartbeat(t)
+        t += 1000.0  # tight cadence -> var 0 -> std floored at 100ms
+    last = t - 1000.0
+    # mean interval (1000) + acceptable pause (3000) = 4000; y=(e-4000)/100.
+    # elapsed far past the mean: exponent < -700 -> clamp to phi=300
+    assert det.phi(last + 60_000.0) == 300.0
+    # elapsed far below the mean: exponent > 700 -> clamp to phi=0
+    assert det.phi(last + 1.0) == 0.0
+    # no overflow anywhere in between
+    for elapsed in range(0, 70_000, 500):
+        p = det.phi(last + elapsed)
+        assert 0.0 <= p <= 300.0
+
+
+def test_phi_detector_available_to_suspect_transition():
+    """phi grows monotonically as the silence stretches; the availability
+    verdict flips exactly once when it crosses the threshold."""
+    det = PhiAccrualFailureDetector(threshold=8.0)
+    t = 0.0
+    for _ in range(30):
+        det.heartbeat(t)
+        t += 1000.0
+    last = t - 1000.0
+    phis = [det.phi(last + e) for e in range(0, 30_000, 250)]
+    assert all(b >= a for a, b in zip(phis, phis[1:]))  # monotone in silence
+    verdicts = [det.is_available(last + e) for e in range(0, 30_000, 250)]
+    assert verdicts[0] and not verdicts[-1]
+    flips = sum(1 for a, b in zip(verdicts, verdicts[1:]) if a != b)
+    assert flips == 1  # available -> suspect exactly once, no flapping
+
+
 # ---- cluster ---------------------------------------------------------------
 
 

@@ -1,0 +1,229 @@
+"""Unit tests for the unified retry policy (utils/retry.py) and the
+fault-injection registry (utils/fault_injection.py)."""
+
+import time
+
+import pyarrow.flight as fl
+import pytest
+
+from greptimedb_tpu.utils import fault_injection as fi
+from greptimedb_tpu.utils.deadline import deadline_scope
+from greptimedb_tpu.utils.errors import QueryTimeoutError, RetryLaterError
+from greptimedb_tpu.utils.retry import (
+    RetryPolicy,
+    is_transient,
+    is_transient_io,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    fi.REGISTRY.disarm()
+    yield
+    fi.REGISTRY.disarm()
+
+
+# ---- classifiers -----------------------------------------------------------
+
+
+def test_transient_classifier_covers_wire_errors():
+    for exc in (
+        ConnectionError("down"),
+        TimeoutError("slow"),
+        RetryLaterError("later"),
+        fl.FlightUnavailableError("gone"),
+        fl.FlightTimedOutError("late"),
+        fl.FlightInternalError("broke"),
+    ):
+        assert is_transient(exc), exc
+    for exc in (
+        ValueError("bad"),
+        FileNotFoundError("missing"),
+        QueryTimeoutError("deadline"),
+        KeyError("oops"),
+    ):
+        assert not is_transient(exc), exc
+
+
+def test_io_classifier_adds_oserror_but_not_filenotfound():
+    assert is_transient_io(OSError("disk sneeze"))
+    assert is_transient_io(ConnectionError("down"))
+    assert not is_transient_io(FileNotFoundError("missing"))
+    assert not is_transient_io(ValueError("bad"))
+
+
+# ---- RetryPolicy -----------------------------------------------------------
+
+
+def test_policy_retries_then_succeeds():
+    calls = {"n": 0}
+    retries = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("blip")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.001)
+    out = policy.call(flaky, on_retry=lambda exc, a: retries.append(a))
+    assert out == "ok"
+    assert calls["n"] == 3
+    assert retries == [0, 1]
+
+
+def test_policy_gives_up_after_max_attempts():
+    calls = {"n": 0}
+
+    def always_down():
+        calls["n"] += 1
+        raise ConnectionError("down")
+
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.001)
+    with pytest.raises(ConnectionError):
+        policy.call(always_down)
+    assert calls["n"] == 3
+
+
+def test_policy_never_retries_non_transient():
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise ValueError("bug")
+
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=5, base_delay_s=0.001).call(broken)
+    assert calls["n"] == 1
+
+
+def test_policy_backoff_is_bounded():
+    policy = RetryPolicy(base_delay_s=0.1, max_delay_s=0.4, jitter=False)
+    assert policy.backoff_s(1) == pytest.approx(0.1)
+    assert policy.backoff_s(2) == pytest.approx(0.2)
+    assert policy.backoff_s(3) == pytest.approx(0.4)
+    assert policy.backoff_s(10) == pytest.approx(0.4)  # capped
+    jittered = RetryPolicy(base_delay_s=0.1, max_delay_s=0.4)
+    for a in range(1, 8):
+        assert 0.0 <= jittered.backoff_s(a) <= 0.4
+
+
+def test_policy_respects_deadline_instead_of_burning_attempts():
+    """Under an expired/expiring deadline the loop must raise
+    QueryTimeoutError quickly, not sleep through its full backoff budget."""
+    calls = {"n": 0}
+
+    def always_down():
+        calls["n"] += 1
+        raise ConnectionError("down")
+
+    policy = RetryPolicy(max_attempts=1000, base_delay_s=0.02, max_delay_s=0.02)
+    t0 = time.monotonic()
+    with deadline_scope(0.1):
+        with pytest.raises(QueryTimeoutError):
+            policy.call(always_down)
+    assert time.monotonic() - t0 < 5.0  # nowhere near 1000 * 20ms
+    assert calls["n"] < 1000
+
+
+def test_policy_custom_classifier():
+    calls = {"n": 0}
+
+    def odd_failure():
+        calls["n"] += 1
+        raise KeyError("weird but known-transient here")
+
+    policy = RetryPolicy(
+        max_attempts=2, base_delay_s=0.001,
+        classify=lambda e: isinstance(e, KeyError),
+    )
+    with pytest.raises(KeyError):
+        policy.call(odd_failure)
+    assert calls["n"] == 2  # the custom classifier made KeyError retryable
+
+
+# ---- FaultRegistry ---------------------------------------------------------
+
+
+def test_registry_rejects_unknown_points():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        fi.REGISTRY.arm("flight.do_teleport")
+
+
+def test_fire_is_noop_when_disarmed():
+    # must not raise, must not require any armed state
+    fi.fire("flight.do_get", node_id=1)
+    assert fi._ARMED is False
+
+
+def test_fail_n_then_succeed():
+    plan = fi.REGISTRY.arm("store.read", fail_times=2, error=TimeoutError)
+    for _ in range(2):
+        with pytest.raises(TimeoutError):
+            fi.fire("store.read")
+    fi.fire("store.read")  # budget spent: passes
+    assert plan.hits == 3 and plan.trips == 2
+
+
+def test_skip_offsets_the_fault_window():
+    plan = fi.REGISTRY.arm("store.read", fail_times=1, skip=2, error=OSError)
+    fi.fire("store.read")
+    fi.fire("store.read")
+    with pytest.raises(OSError):
+        fi.fire("store.read")
+    fi.fire("store.read")
+    assert plan.hits == 4 and plan.trips == 1
+
+
+def test_match_filters_by_context():
+    plan = fi.REGISTRY.arm(
+        "meta.heartbeat", fail_times=10, error=ConnectionError,
+        match=lambda ctx: ctx.get("node_id") == 7,
+    )
+    fi.fire("meta.heartbeat", node_id=3)  # unmatched: passes
+    with pytest.raises(ConnectionError):
+        fi.fire("meta.heartbeat", node_id=7)
+    assert plan.hits == 1 and plan.trips == 1  # unmatched calls not counted
+
+
+def test_latency_only_plan_is_a_pure_delay():
+    fi.REGISTRY.arm("wal.append", fail_times=1, latency_s=0.05)
+    t0 = time.monotonic()
+    fi.fire("wal.append")
+    assert time.monotonic() - t0 >= 0.05
+    t0 = time.monotonic()
+    fi.fire("wal.append")  # budget spent: no delay
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_callback_runs_at_the_trip_point():
+    seen = []
+    fi.REGISTRY.arm(
+        "meta.get_route", fail_times=1,
+        callback=lambda ctx: seen.append(ctx.get("table_id")),
+    )
+    fi.fire("meta.get_route", table_id=42)
+    assert seen == [42]
+
+
+def test_armed_scope_disarms_on_exit():
+    with fi.REGISTRY.armed("store.write", fail_times=1, error=OSError):
+        with pytest.raises(OSError):
+            fi.fire("store.write")
+    fi.fire("store.write")  # disarmed: no-op
+    assert fi._ARMED is False
+
+
+def test_armed_scope_leaves_stacked_plans_armed():
+    """armed() must remove only ITS plan on exit — an enclosing scope's
+    plan at the same point keeps firing (plans stack)."""
+    outer = fi.REGISTRY.arm("store.read", fail_times=1, skip=1, error=OSError)
+    with fi.REGISTRY.armed("store.read", fail_times=1, error=TimeoutError):
+        with pytest.raises(TimeoutError):
+            fi.fire("store.read")  # inner plan trips first
+    # inner gone, outer (skip=1 consumed by nothing: its hits counted too)
+    # still armed and trips on its next eligible hit
+    with pytest.raises(OSError):
+        fi.fire("store.read")
+    assert outer.trips == 1
+    fi.REGISTRY.disarm()

@@ -138,7 +138,9 @@ def test_device_mesh_sketch_merge():
             counts = jax.lax.psum(counts, "regions")
             return regs, counts
 
-        return jax.shard_map(
+        from greptimedb_tpu.utils.jax_compat import shard_map
+
+        return shard_map(
             step,
             mesh=mesh,
             in_specs=(P("regions"), P("regions"), P("regions")),
