@@ -293,6 +293,9 @@ def cmd_metasrv(args):
         def open_region(self, node_id: int, rid: int):
             self._client(node_id).open_region(rid)
 
+        def open_follower(self, node_id: int, rid: int):
+            self._client(node_id).open_region(rid, writable=False)
+
         def close_region_quiet(self, node_id: int, rid: int):
             try:
                 self._client(node_id).close_region(rid)

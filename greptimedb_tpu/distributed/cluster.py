@@ -60,6 +60,14 @@ class Datanode:
                 raise
             self.engine.create_region(rid, schema)
 
+    def open_follower(self, rid: int, schema: Schema | None = None):
+        """Read-only follower replica: open the region over the shared
+        storage but never accept writes or run compaction for it (two
+        compactors on shared storage corrupt the manifest — the same
+        reason the alive keeper closes lapsed regions)."""
+        self.open_region(rid, schema)
+        self.engine.region(rid).set_writable(False)
+
     def close_region(self, rid: int):
         self.engine.close_region(rid)
 
@@ -145,6 +153,10 @@ class NodeManager:
     def open_region(self, node_id: int, rid: int):
         schema = self.cluster.schema_of_region(rid)
         self.cluster.datanodes[node_id].open_region(rid, schema)
+
+    def open_follower(self, node_id: int, rid: int):
+        schema = self.cluster.schema_of_region(rid)
+        self.cluster.datanodes[node_id].open_follower(rid, schema)
 
     def close_region_quiet(self, node_id: int, rid: int):
         dn = self.cluster.datanodes.get(node_id)

@@ -31,7 +31,42 @@ import pyarrow.flight as fl
 from ..datatypes.schema import Schema
 from ..storage.sst import ScanPredicate
 from ..utils import fault_injection
-from ..utils.errors import RegionNotFoundError
+from ..utils.errors import RegionNotFoundError, RegionReadonlyError
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _retryable_region_errors():
+    """Server-side: cross the wire as FlightUnavailableError for failures
+    a RETRY genuinely fixes — region read-only (mid-migration downgraded
+    leader), region not-found (route moved, old owner closed it), and
+    datanode-side storage weather (OSError minus FileNotFoundError, the
+    `is_transient_io` contract: a flaky shared WAL/object store heals).
+    The reference maps RegionBusy/RegionNotReady to retryable statuses the
+    same way.  Everything else reaches the client as FlightServerError,
+    which the transient classifier correctly refuses to retry."""
+    try:
+        yield
+    except (RegionReadonlyError, RegionNotFoundError) as e:
+        raise fl.FlightUnavailableError(f"{type(e).__name__}: {e}") from e
+    except OSError as e:
+        if isinstance(e, FileNotFoundError):
+            raise  # a missing object is an answer, not weather
+        raise fl.FlightUnavailableError(f"{type(e).__name__}: {e}") from e
+
+
+def _connection_error(node_id: int, e: fl.FlightError) -> BaseException:
+    """Map TRANSPORT-level Flight failures (node unreachable, channel
+    timed out) to ConnectionError — the repo-wide "node is down" surface.
+    Application errors the server raised (FlightServerError wrapping e.g.
+    a read-only-region refusal or a bad request) must NOT take this path:
+    ConnectionError is classified transient, and a permanent error dressed
+    as transient burns the whole retry budget and reaches the client as
+    RETRY_LATER for something a retry can never fix."""
+    if isinstance(e, (fl.FlightUnavailableError, fl.FlightTimedOutError)):
+        return ConnectionError(f"datanode {node_id}: {e}")
+    return e
 
 
 def encode_scan_ticket(
@@ -108,30 +143,34 @@ class DatanodeFlightServer(fl.FlightServerBase):
     # ---- reads (do_get) ---------------------------------------------------
     def do_get(self, context, ticket: fl.Ticket):
         rid, pred, projection, agg, plan = decode_scan_ticket(ticket.ticket)
-        if plan is not None:
-            # general sub-plan: bounded rows back, never the raw region
-            return fl.RecordBatchStream(execute_region_plan(self.engine, rid, plan))
-        table = self.engine.scan(rid, pred)
-        if agg is not None:
-            from ..query.dist_agg import AggSpec, partial_states
+        with _retryable_region_errors():
+            if plan is not None:
+                # general sub-plan: bounded rows back, never the raw region
+                return fl.RecordBatchStream(
+                    execute_region_plan(self.engine, rid, plan)
+                )
+            table = self.engine.scan(rid, pred)
+            if agg is not None:
+                from ..query.dist_agg import AggSpec, partial_states
 
-            # lower/state stage runs HERE; only [groups]-sized states ship
-            return fl.RecordBatchStream(
-                partial_states(table, AggSpec.from_dict(agg))
-            )
-        if projection:
-            keep = [c for c in projection if c in table.column_names]
-            table = table.select(keep)
-        return fl.RecordBatchStream(table)
+                # lower/state stage runs HERE; only [groups]-sized states ship
+                return fl.RecordBatchStream(
+                    partial_states(table, AggSpec.from_dict(agg))
+                )
+            if projection:
+                keep = [c for c in projection if c in table.column_names]
+                table = table.select(keep)
+            return fl.RecordBatchStream(table)
 
     # ---- writes (do_put) --------------------------------------------------
     def do_put(self, context, descriptor: fl.FlightDescriptor, reader, writer):
         cmd = json.loads(descriptor.command.decode())
         rid = cmd["region_id"]
         affected = 0
-        for chunk in reader:
-            with self._lock:
-                affected += self.engine.write(rid, chunk.data)
+        with _retryable_region_errors():
+            for chunk in reader:
+                with self._lock:
+                    affected += self.engine.write(rid, chunk.data)
         writer.write(json.dumps({"affected_rows": affected}).encode())
 
     # ---- control (do_action) ----------------------------------------------
@@ -146,6 +185,11 @@ class DatanodeFlightServer(fl.FlightServerBase):
                 if body.get("schema") is None:
                     raise
                 self.engine.create_region(rid, Schema.from_json(body["schema"]))
+            if body.get("writable") is False:
+                # read-only follower replica: serves scans off the shared
+                # storage, refuses writes, and is skipped by the
+                # compaction scheduler (single-compactor invariant)
+                self.engine.region(rid).set_writable(False)
             out = {"ok": True}
         elif kind == "close_region":
             self.engine.close_region(body["region_id"])
@@ -234,14 +278,23 @@ class FlightDatanodeClient:
         try:
             results = list(self._client.do_action(fl.Action(kind, json.dumps(body).encode())))
         except fl.FlightError as e:
-            raise ConnectionError(f"datanode {self.node_id}: {e}") from e
+            raise _connection_error(self.node_id, e) from e
         return json.loads(results[0].body.to_pybytes().decode()) if results else {}
 
-    def open_region(self, rid: int, schema: Schema | None = None):
+    def open_region(
+        self, rid: int, schema: Schema | None = None, writable: bool = True
+    ):
         self._action(
             "open_region",
-            {"region_id": rid, "schema": schema.to_json() if schema else None},
+            {
+                "region_id": rid,
+                "schema": schema.to_json() if schema else None,
+                "writable": writable,
+            },
         )
+
+    def open_follower(self, rid: int, schema: Schema | None = None):
+        self.open_region(rid, schema, writable=False)
 
     def close_region(self, rid: int):
         self._action("close_region", {"region_id": rid})
@@ -294,7 +347,7 @@ class FlightDatanodeClient:
             buf = meta_reader.read()
             writer.close()
         except fl.FlightError as e:
-            raise ConnectionError(f"datanode {self.node_id}: {e}") from e
+            raise _connection_error(self.node_id, e) from e
         if buf is None:
             return 0
         return json.loads(buf.to_pybytes().decode())["affected_rows"]
@@ -307,7 +360,7 @@ class FlightDatanodeClient:
         try:
             return self._client.do_get(ticket).read_all()
         except fl.FlightError as e:
-            raise ConnectionError(f"datanode {self.node_id}: {e}") from e
+            raise _connection_error(self.node_id, e) from e
 
     def partial_agg(self, rid: int, pred: ScanPredicate, spec_dict: dict) -> pa.Table:
         if not self.alive:
@@ -317,7 +370,7 @@ class FlightDatanodeClient:
         try:
             return self._client.do_get(ticket).read_all()
         except fl.FlightError as e:
-            raise ConnectionError(f"datanode {self.node_id}: {e}") from e
+            raise _connection_error(self.node_id, e) from e
 
     def execute_plan(self, rid: int, plan_dict: dict) -> pa.Table:
         if not self.alive:
@@ -329,7 +382,7 @@ class FlightDatanodeClient:
         try:
             return self._client.do_get(ticket).read_all()
         except fl.FlightError as e:
-            raise ConnectionError(f"datanode {self.node_id}: {e}") from e
+            raise _connection_error(self.node_id, e) from e
 
     def kill(self):
         self.alive = False
@@ -363,6 +416,9 @@ class FlightDatanode:
 
     def open_region(self, rid: int, schema=None):
         self.client.open_region(rid, schema)
+
+    def open_follower(self, rid: int, schema=None):
+        self.client.open_follower(rid, schema)
 
     def close_region(self, rid: int):
         self.client.close_region(rid)

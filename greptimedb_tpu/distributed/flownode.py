@@ -20,10 +20,17 @@ Wire surface (Arrow Flight, like the datanode role):
 from __future__ import annotations
 
 import json
+import logging
+import queue
 import threading
+import time as _time
 
 import pyarrow as pa
 import pyarrow.flight as fl
+
+from ..utils import fault_injection, metrics
+
+_LOG = logging.getLogger("greptimedb_tpu.flownode")
 
 
 class FlownodeFlightServer(fl.FlightServerBase):
@@ -76,9 +83,14 @@ class FlownodeClient:
 
     def __init__(self, node_id: int, location: str):
         self.node_id = node_id
+        self.location = location
         self._client = fl.connect(location)
 
     def mirror_insert(self, table: str, database: str, batch: pa.Table) -> int:
+        # chaos hook: a flownode restarting / unreachable mid-mirror — the
+        # frontend's BestEffortMirror retries in the background, the user's
+        # write has already returned
+        fault_injection.fire("flow.mirror", node_id=self.node_id, table=table)
         descriptor = fl.FlightDescriptor.for_command(
             json.dumps(
                 {"flow_mirror": {"table": table, "database": database}}
@@ -99,6 +111,201 @@ class FlownodeClient:
             )
         )
         return json.loads(results[0].body.to_pybytes().decode())
+
+
+class BestEffortMirror:
+    """Frontend-side flow mirroring that can NEVER fail a user's write.
+
+    The reference detaches its `FlowMirrorTask` from the insert future
+    (operator/src/insert.rs:397-406) for exactly this reason: flows are a
+    derived view, the user's write is the source of truth.  Here mirrored
+    batches go onto a bounded in-process queue drained by one background
+    thread; a delivery failure is retried with backoff up to
+    `max_attempts` and then dropped (counted, logged) — the write path
+    observes none of it.
+
+    Flownode discovery goes through the metasrv (`role="flownode"`
+    addresses) and is cached for `discovery_ttl_s`, so the write hot path
+    pays at most one metasrv round-trip per TTL — and zero ongoing cost
+    when no flownode is registered.
+    """
+
+    def __init__(
+        self,
+        meta_client,
+        max_attempts: int = 5,
+        discovery_ttl_s: float = 5.0,
+        queue_max: int = 1024,
+        backoff_s: float = 0.05,
+    ):
+        self.meta = meta_client
+        self.max_attempts = max_attempts
+        self.discovery_ttl_s = discovery_ttl_s
+        self.backoff_s = backoff_s
+        self._queue: queue.Queue = queue.Queue(maxsize=queue_max)
+        self._pending = 0
+        self._pending_lock = threading.Lock()
+        self._clients: dict[int, FlownodeClient] = {}
+        self._addr_cache: tuple[float, dict[int, str]] = (0.0, {})
+        self._thread: threading.Thread | None = None
+        self._thread_lock = threading.Lock()
+        self._stop = threading.Event()
+
+    # ---- discovery ---------------------------------------------------------
+    def flownodes(self) -> dict[int, str]:
+        cached_at, addrs = self._addr_cache
+        if _time.monotonic() - cached_at < self.discovery_ttl_s:
+            return addrs
+        try:
+            addrs = self.meta.node_addresses(role="flownode")
+        except Exception:  # noqa: BLE001 — discovery is best-effort too
+            addrs = {}
+        self._addr_cache = (_time.monotonic(), addrs)
+        return addrs
+
+    def _client(self, node_id: int, addr: str) -> FlownodeClient:
+        c = self._clients.get(node_id)
+        if c is None or c.location != f"grpc://{addr}":
+            c = FlownodeClient(node_id, f"grpc://{addr}")
+            self._clients[node_id] = c
+        return c
+
+    # ---- submission (write hot path) --------------------------------------
+    def submit(self, table: str, database: str, batch: pa.Table) -> bool:
+        """Enqueue one mirrored batch; returns whether it was enqueued.
+        Never raises, never blocks beyond a full-queue drop."""
+        if not self.flownodes():
+            return False
+        item = {"table": table, "database": database, "batch": batch, "attempt": 0}
+        # count BEFORE enqueueing: a drain() racing the worker must never
+        # observe pending==0 while this batch sits in the queue
+        with self._pending_lock:
+            self._pending += 1
+        try:
+            self._queue.put_nowait(item)
+        except queue.Full:
+            self._settle()
+            metrics.FLOW_MIRROR_DROPPED_TOTAL.inc()
+            return False
+        metrics.FLOW_MIRROR_TOTAL.inc()
+        self._ensure_thread()
+        return True
+
+    def _ensure_thread(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._thread_lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="flow-mirror", daemon=True
+            )
+            self._thread.start()
+
+    # ---- worker ------------------------------------------------------------
+    def _deliver(self, item: dict) -> bool:
+        """Deliver to every target flownode, tracking outcomes PER NODE so
+        a retry re-sends only to nodes whose attempt FAILED.  Semantics are
+        AT-LEAST-ONCE (same as the reference's detached FlowMirrorTask): a
+        node whose reply was read is never re-sent, but an ambiguous
+        failure — batch applied, reply lost — duplicates on retry.  Exactly
+        -once needs a batch id the flownode dedupes on (ROADMAP)."""
+        current = self.flownodes()
+        pending = item.get("pending")
+        targets = current if pending is None else {
+            # refresh the address from discovery when the node re-registered
+            nid: current.get(nid, addr) for nid, addr in pending.items()
+        }
+        if not targets:
+            # discovery came back empty (metasrv briefly unreachable caches
+            # {} for a TTL): that is a FAILED attempt, not a delivery to
+            # zero nodes — retry, and drop with the counted/logged path if
+            # it keeps happening (a silently settled batch would vanish)
+            metrics.FLOW_MIRROR_FAILURES_TOTAL.inc()
+            return False
+        failed: dict[int, str] = {}
+        for node_id, addr in targets.items():
+            try:
+                self._client(node_id, addr).mirror_insert(
+                    item["table"], item["database"], item["batch"]
+                )
+            except Exception as exc:  # noqa: BLE001 — mirrors never propagate
+                metrics.FLOW_MIRROR_FAILURES_TOTAL.inc()
+                self._clients.pop(node_id, None)  # fresh channel next try
+                failed[node_id] = addr
+                _LOG.warning(
+                    "flow mirror of %r to flownode %s failed (attempt %s): %s",
+                    item["table"], node_id, item["attempt"] + 1, exc,
+                )
+        item["pending"] = failed or None
+        return not failed
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                item = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            not_before = item.get("not_before", 0.0)
+            now = _time.monotonic()
+            if not_before > now:
+                # not due yet: rotate it to the back so OTHER batches keep
+                # flowing (sleeping the backoff inline would head-of-line
+                # block every queued batch behind one sick flownode); the
+                # short wait bounds spinning when this is the only item
+                self._stop.wait(min(not_before - now, 0.05))
+                self._requeue(item)
+                continue
+            if self._deliver(item):
+                self._settle()
+                continue
+            item["attempt"] += 1
+            if item["attempt"] >= self.max_attempts:
+                metrics.FLOW_MIRROR_DROPPED_TOTAL.inc()
+                _LOG.error(
+                    "flow mirror of %r dropped after %s attempts",
+                    item["table"], item["attempt"],
+                )
+                self._settle()
+                continue
+            # bounded backoff before the re-attempt, expressed as a
+            # deadline on the item (ordering within a flow is already
+            # approximate — flows fold commutative states)
+            item["not_before"] = _time.monotonic() + min(
+                self.backoff_s * (2 ** item["attempt"]), 1.0
+            )
+            self._requeue(item)
+
+    def _requeue(self, item: dict):
+        try:
+            self._queue.put_nowait(item)
+        except queue.Full:
+            metrics.FLOW_MIRROR_DROPPED_TOTAL.inc()
+            self._settle()
+
+    def _settle(self):
+        with self._pending_lock:
+            self._pending -= 1
+
+    # ---- test/teardown surface ---------------------------------------------
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Wait until every submitted mirror was delivered or dropped
+        (tests; deterministic assertions on best-effort delivery)."""
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            with self._pending_lock:
+                if self._pending <= 0:
+                    return True
+            _time.sleep(0.01)
+        return False
+
+    def close(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=1.0)
+        self._clients.clear()
 
 
 def run_flownode(node_id: int, data_home: str, addr: str, metasrv_addr: str | None):

@@ -27,7 +27,6 @@ import logging
 import os
 import threading
 import time as _time
-from concurrent.futures import TimeoutError as _FuturesTimeout
 
 import pyarrow as pa
 
@@ -49,7 +48,13 @@ from ..query.sql_parser import (
     parse_sql,
 )
 from ..storage.sst import ScanPredicate
-from ..utils import tracing
+from ..utils import metrics, tracing
+from ..utils.circuit_breaker import (
+    BreakerBoard,
+    CircuitBreaker,
+    CircuitOpenError,
+    LatencyTracker,
+)
 from ..utils.config import Config
 from ..utils.deadline import current_deadline, deadline_scope, propagate
 from ..utils.errors import (
@@ -63,6 +68,7 @@ from ..utils.errors import (
 )
 from ..utils.retry import RetryPolicy, is_transient
 from .flight import FlightDatanodeClient
+from .flownode import BestEffortMirror
 from .meta_service import MetaClient
 
 _LOG = logging.getLogger("greptimedb_tpu.frontend")
@@ -89,6 +95,25 @@ class Frontend:
         # data-proximate compute and ship bounded states/rows
         self._clients: dict[int, FlightDatanodeClient] = {}
         self._clients_lock = threading.Lock()
+        # per-datanode circuit breakers ride the client cache: a flapping
+        # node sheds load the moment its failure rate trips, long before
+        # its metasrv lease lapses (utils/circuit_breaker.py); disabled
+        # breakers cost one config check per call
+        self._breakers = BreakerBoard(self._make_breaker)
+        # recent sub-request latencies feed the adaptive hedge delay
+        self._latency = LatencyTracker()
+        # follower lookups are TTL-cached per table: the follower set
+        # changes only on add_follower/failover, and a per-query metasrv
+        # round-trip would tax every SELECT once hedging is on.  Staleness
+        # is benign — a hedge to an ex-follower fails and the primary wins
+        self._follower_cache: dict[int, tuple[float, dict[int, list[int]]]] = {}
+        self._follower_ttl_s = 5.0
+        # mirrored inserts to flownodes are best-effort and asynchronous:
+        # a mirror failure retries in the background, never the user write.
+        # The mirror gets its OWN MetaClient — its discovery runs on a
+        # background thread, and sharing the SQL path's client would share
+        # the cached-leader state across threads
+        self.mirror = BestEffortMirror(MetaClient(metasrv_peers))
         # one retry policy governs every frontend->datanode request
         # (reference client/src/region.rs RegionRequester retries with
         # channel invalidation); tests may swap it for a tighter one
@@ -110,6 +135,56 @@ class Frontend:
         )
 
     # ---- peers -------------------------------------------------------------
+    def _make_breaker(self, node_id: int) -> CircuitBreaker | None:
+        bc = self.config.breaker
+        if not bc.enable:
+            return None
+        return CircuitBreaker(
+            name=f"datanode-{node_id}",
+            window=bc.window,
+            min_calls=bc.min_calls,
+            failure_rate=bc.failure_rate,
+            open_cooldown_s=bc.open_cooldown_s,
+            half_open_probes=bc.half_open_probes,
+        )
+
+    def _breaker(self, node_id: int | None) -> CircuitBreaker | None:
+        if node_id is None:
+            return None
+        return self._breakers.get(node_id)
+
+    def _guarded_call(self, node_id: int, thunk, record_latency: bool = False):
+        """One datanode call under the node's circuit breaker: an open
+        breaker fails fast (CircuitOpenError is RETRY_LATER-shaped, so
+        retry loops re-route instead of aborting), outcomes feed the
+        breaker's window.  `record_latency` samples the call into the
+        hedge-delay tracker — READ sub-queries only, or a batch-insert
+        workload would inflate the adaptive read p95 until hedging never
+        fires."""
+        br = self._breaker(node_id)
+        if br is not None and not br.allow():
+            metrics.BREAKER_SHED_TOTAL.inc()
+            raise CircuitOpenError(
+                f"datanode {node_id} circuit open; shedding load"
+            )
+        t0 = _time.monotonic()
+        try:
+            out = thunk()
+        except Exception as exc:  # noqa: BLE001 — classified, re-raised
+            if br is not None:
+                if is_transient(exc):
+                    br.record_failure()
+                else:
+                    # no verdict on the node's health: a half-open probe
+                    # slot spent on this call must be returned, not leaked
+                    br.release_probe()
+            raise
+        if br is not None:
+            br.record_success()
+        if record_latency:
+            self._latency.record(_time.monotonic() - t0)
+        return out
+
     def _client(self, node_id: int) -> FlightDatanodeClient:
         with self._clients_lock:
             c = self._clients.get(node_id)
@@ -140,7 +215,9 @@ class Frontend:
         `_call_region`, which additionally re-fetches the region route."""
         try:
             return self.retry_policy.call(
-                lambda: fn(self._client(node_id)),
+                lambda: self._guarded_call(
+                    node_id, lambda: fn(self._client(node_id))
+                ),
                 on_retry=lambda exc, attempt: self._drop_client(node_id),
             )
         except Exception as exc:  # noqa: BLE001 — classified below
@@ -149,14 +226,22 @@ class Frontend:
                 raise
             raise wrapped from exc
 
-    def _call_region(self, meta, rid: int, fn, routes: dict | None = None):
+    def _call_region(
+        self, meta, rid: int, fn, routes: dict | None = None,
+        inflight: dict | None = None, record_latency: bool = False,
+    ):
         """Run `fn(client, rid)` against region `rid`'s CURRENT route with
         bounded backoff.  Between attempts the cached client is dropped and
         the route is re-fetched from the metasrv, so a completed
         `RegionFailoverProcedure` is consumed by in-flight queries/writes:
         the retried sub-request lands on the failed-over replica instead of
         hammering the dead node (reference frontend invalidates its
-        table-route cache on request failure)."""
+        table-route cache on request failure).  A node whose circuit
+        breaker is open is skipped WITHOUT a wire call — the retry budget
+        is spent on route refreshes (consuming failover) instead of
+        timeouts against a flapping node.  `inflight`, when given, tracks
+        the node currently serving `rid` so a timed-out fan-out can drop
+        the right client."""
         state = {"routes": routes, "node": None}
 
         def attempt():
@@ -174,12 +259,18 @@ class Frontend:
                     ) from exc
             node = self._routed(r, rid, meta)
             state["node"] = node
-            return fn(self._client(node), rid)
+            if inflight is not None:
+                inflight[rid] = node
+            return self._guarded_call(
+                node, lambda: fn(self._client(node), rid),
+                record_latency=record_latency,
+            )
 
         def on_retry(exc, attempt_no):
             self._drop_client(state["node"])
             state["node"] = None
             state["routes"] = None  # force a fresh route on the next attempt
+            metrics.ROUTE_REFRESH_TOTAL.inc()
 
         try:
             return self.retry_policy.call(attempt, on_retry=on_retry)
@@ -487,6 +578,11 @@ class Frontend:
                 affected += self._call_region(
                     meta, rid, lambda c, r, _b=b: c.write(r, _b), routes=routes
                 )
+        if affected:
+            # flows are a derived view: mirror AFTER the write is durable,
+            # asynchronously, and never let a mirror failure reach the user
+            # (reference detaches FlowMirrorTask the same way)
+            self.mirror.submit(meta.name, meta.database, table)
         return affected
 
     def insert_rows(self, table: str, rows, database: str | None = None) -> int:
@@ -555,6 +651,130 @@ class Frontend:
                 )
             return self._pool
 
+    # ---- hedged reads ------------------------------------------------------
+    def _followers_for(self, meta) -> dict[int, list[int]]:
+        """Follower replicas per region, or {} when hedging is off (the
+        off-safe default: replica.read_followers=False, hedge_delay_ms=0)."""
+        if not (
+            self.config.replica.read_followers
+            and self.config.query.hedge_delay_ms > 0
+        ):
+            return {}
+        cached = self._follower_cache.get(meta.table_id)
+        if cached is not None and _time.monotonic() - cached[0] < self._follower_ttl_s:
+            return cached[1]
+        try:
+            followers = self.meta.get_followers(meta.table_id)
+        except Exception:  # noqa: BLE001 — hedging is advisory, reads proceed
+            followers = {}
+        self._follower_cache[meta.table_id] = (_time.monotonic(), followers)
+        return followers
+
+    def _hedge_delay_s(self) -> float:
+        """Configured floor, raised to the observed latency percentile once
+        enough sub-requests have been sampled ("hedge after the p95")."""
+        base = self.config.query.hedge_delay_ms / 1000.0
+        p = self._latency.percentile(self.config.query.hedge_percentile)
+        return base if p is None else max(base, p)
+
+    def _hedge_call(self, node: int, rid: int, fn):
+        """ONE attempt against a follower — no retries, no route refresh:
+        the primary (which has both) is still in flight; the hedge only
+        exists to beat its tail."""
+        return self._guarded_call(
+            node, lambda: fn(self._client(node), rid), record_latency=True
+        )
+
+    def _submit_hedge(self, pool, flist: list[int], rid: int, fn):
+        """Pick the first follower whose breaker would admit a call (a
+        non-consuming peek — the consuming gate runs in `_guarded_call`
+        inside the worker); (None, None) when every follower is shedding."""
+        for node in flist:
+            br = self._breaker(node)
+            if br is not None and not br.would_allow():
+                continue
+            metrics.HEDGE_REQUESTS_TOTAL.inc()
+            return node, pool.submit(propagate(self._hedge_call), node, rid, fn)
+        return None, None
+
+    def _settle_region(
+        self, rid: int, fut, meta, fn, flist, hedge_delay, deadline, pool,
+        hedges, t0,
+    ):
+        """Wait for region `rid`'s primary sub-request; once it has been
+        outstanding `hedge_delay` (measured from the FAN-OUT submit time
+        `t0`, so regions settled later in the gather hedge on schedule, not
+        a fresh delay each), duplicate it to a follower and take whichever
+        answers first (reference: hedged requests over MergeScan fan-out;
+        The Tail at Scale).  Raises QueryTimeoutError when the deadline
+        expires with nothing settled."""
+        from concurrent.futures import FIRST_COMPLETED
+        from concurrent.futures import wait as _futures_wait
+
+        def remaining():
+            return max(deadline - _time.monotonic(), 0.0) if deadline is not None else None
+
+        waiting = {fut}
+        hedge = None
+        hedge_considered = not flist or hedge_delay is None
+        errors: list[Exception] = []
+        while True:
+            if deadline is not None and remaining() <= 0.0:
+                raise QueryTimeoutError(
+                    f"distributed fan-out for {meta.name!r} exceeded "
+                    f"the query deadline; region {rid} still pending"
+                )
+            if not hedge_considered:
+                due = max(0.0, hedge_delay - (_time.monotonic() - t0))
+                timeout = due if deadline is None else min(due, remaining())
+            else:
+                timeout = remaining()
+            done, _pending = _futures_wait(
+                waiting, timeout=timeout, return_when=FIRST_COMPLETED
+            )
+            if not done:
+                if not hedge_considered:
+                    hedge_considered = True
+                    # fire the hedge only if it was the HEDGE timer that
+                    # elapsed — a deadline-bounded wait expiring must not
+                    # dispatch a duplicate read just to abandon it
+                    if (
+                        _time.monotonic() - t0 >= hedge_delay
+                        and (deadline is None or remaining() > 0.0)
+                    ):
+                        hedge_node, hedge = self._submit_hedge(pool, flist, rid, fn)
+                        if hedge is not None:
+                            hedges[rid] = (hedge_node, hedge)
+                            waiting.add(hedge)
+                    continue
+                raise QueryTimeoutError(
+                    f"distributed fan-out for {meta.name!r} exceeded "
+                    f"the query deadline; region {rid} still pending"
+                )
+            for f in done:
+                waiting.discard(f)
+                try:
+                    value = f.result()
+                except QueryTimeoutError:
+                    raise
+                except Exception as exc:  # noqa: BLE001 — maybe the twin wins
+                    # the PRIMARY's error first: the hedge is a single
+                    # best-effort attempt against a possibly-stale follower
+                    # (its failure must not mask/reclassify the region's
+                    # real outcome when both sides fail)
+                    if f is hedge:
+                        errors.append(exc)
+                    else:
+                        errors.insert(0, exc)
+                    continue
+                if f is hedge:
+                    metrics.HEDGE_WINS_TOTAL.inc()
+                return value
+            if not waiting:
+                raise errors[0]
+            # one attempt failed but its twin is still in flight: wait it out
+            hedge_considered = True
+
     def _fanout(self, meta, fn):
         """Run `fn(client, rid)` for every region of `meta` concurrently on
         the shared pool (reference MergeScanExec fans sub-queries per
@@ -562,10 +782,17 @@ class Frontend:
 
           * each region request runs under the retry policy with route
             refresh (`_call_region`), so mid-query failover is consumed;
+          * nodes with an open circuit breaker are skipped without a wire
+            call (load shedding; see `_guarded_call`);
+          * with follower replicas registered and hedging enabled, a region
+            sub-query still outstanding after the hedge delay is duplicated
+            to a follower — first response wins;
           * the active query deadline crosses into the pool workers
             (deadline.propagate) AND bounds the gather — a datanode that
             hangs without erroring yields QueryTimeoutError, never a stuck
-            frontend;
+            frontend — and the hung sub-request is ABANDONED: its future is
+            detached and its client dropped, so the next query dials a
+            fresh connection instead of queueing behind the hung call;
           * regions still failing transiently after retries surface as ONE
             RetryLaterError naming the failed region ids (the SQL layer's
             retryable status), while non-transient errors propagate as-is.
@@ -573,6 +800,8 @@ class Frontend:
         routes = self.meta.get_route(meta.table_id)
         rids = meta.region_ids
         deadline = current_deadline()
+        followers = self._followers_for(meta)
+        hedge_delay = self._hedge_delay_s() if followers else None
 
         def give_up(failed: list[int], last_exc: Exception):
             raise RetryLaterError(
@@ -580,24 +809,35 @@ class Frontend:
                 f"{self.retry_policy.max_attempts} attempts: {last_exc}"
             ) from last_exc
 
-        if len(rids) <= 1 and deadline is None:
+        if len(rids) <= 1 and deadline is None and not followers:
             results = []
             for rid in rids:
                 try:
-                    results.append(self._call_region(meta, rid, fn, routes=routes))
+                    results.append(
+                        self._call_region(
+                            meta, rid, fn, routes=routes, record_latency=True
+                        )
+                    )
                 except Exception as exc:  # noqa: BLE001 — classified below
                     if not is_transient(exc):
                         raise
                     give_up([rid], exc)
             return results
         pool = self._executor()
+        inflight: dict[int, int] = {}
+        t0 = _time.monotonic()
         futures = {
-            rid: pool.submit(propagate(self._call_region), meta, rid, fn, routes)
+            rid: pool.submit(
+                propagate(self._call_region), meta, rid, fn, routes, inflight,
+                True,
+            )
             for rid in rids
         }
+        hedges: dict[int, object] = {}
         results: list = []
         failed: list[int] = []
         last_exc: Exception | None = None
+        timed_out = False
 
         def note_failure(rid: int, exc: Exception):
             nonlocal last_exc
@@ -608,41 +848,35 @@ class Frontend:
 
         try:
             for rid, fut in futures.items():
-                timeout = None
-                if deadline is not None:
-                    timeout = max(deadline - _time.monotonic(), 0.0)
-                settle_done = False
                 try:
-                    results.append(fut.result(timeout=timeout))
-                    continue
-                except (TimeoutError, _FuturesTimeout):
-                    # concurrent.futures.TimeoutError aliases TimeoutError
-                    # only on 3.11+, so both spellings are caught.  An
-                    # undone future means the GATHER outlived the query
-                    # deadline; a done one either re-raised the worker's
-                    # own TimeoutError or finished in the race window just
-                    # as the gather timed out — read its REAL outcome below
-                    if not fut.done():
-                        raise QueryTimeoutError(
-                            f"distributed fan-out for {meta.name!r} exceeded "
-                            f"the query deadline; region {rid} still pending"
-                        ) from None
-                    settle_done = True
+                    results.append(
+                        self._settle_region(
+                            rid, fut, meta, fn, followers.get(rid),
+                            hedge_delay, deadline, pool, hedges, t0,
+                        )
+                    )
                 except QueryTimeoutError:
+                    timed_out = True
                     raise
                 except Exception as exc:  # noqa: BLE001 — classified
                     note_failure(rid, exc)
-                if settle_done:
-                    try:
-                        results.append(fut.result())
-                    except QueryTimeoutError:
-                        raise
-                    except Exception as exc:  # noqa: BLE001 — classified
-                        note_failure(rid, exc)
         finally:
             # no-op for completed futures; sheds queued work on early exit
-            for fut in futures.values():
+            for fut in list(futures.values()) + [f for _n, f in hedges.values()]:
                 fut.cancel()
+            if timed_out:
+                # deadline expired with sub-requests still running: DETACH
+                # them (nobody joins a hung worker) and drop their clients
+                # so the next query dials a fresh Flight connection instead
+                # of sharing a channel with a stuck call
+                for rid, fut in futures.items():
+                    if not fut.done() and not fut.cancelled():
+                        metrics.FANOUT_ABANDONED_TOTAL.inc()
+                        self._drop_client(inflight.get(rid))
+                for node, fut in hedges.values():
+                    if not fut.done() and not fut.cancelled():
+                        metrics.FANOUT_ABANDONED_TOTAL.inc()
+                        self._drop_client(node)
         if failed:
             give_up(failed, last_exc)
         return results
@@ -696,6 +930,7 @@ class Frontend:
             pass
 
     def close(self):
+        self.mirror.close()
         with self._pool_lock:
             if self._pool is not None:
                 self._pool.shutdown(wait=False, cancel_futures=True)

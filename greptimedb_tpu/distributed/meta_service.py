@@ -102,6 +102,16 @@ class MetasrvServer:
         if path == "/route/set":
             m.set_route(int(body["table_id"]), {int(k): v for k, v in body["routes"].items()})
             return {"ok": True}
+        if path == "/follower/add":
+            m.add_follower(
+                int(body["table_id"]), int(body["region_id"]), int(body["node_id"])
+            )
+            return {"ok": True}
+        if path == "/follower/get":
+            return {"followers": {
+                str(k): v
+                for k, v in m.get_followers(int(body["table_id"])).items()
+            }}
         if path == "/select":
             node = m.select_datanode(exclude=set(body.get("exclude", [])))
             return {"node_id": node}
@@ -122,6 +132,9 @@ class MetaClient:
 
     def __init__(self, peers: list[str]):
         self.peers = list(peers)
+        # cached leader; treated as a SNAPSHOT by _call so concurrent
+        # threads (SQL path + background mirror discovery share nothing
+        # else) can never observe a half-cleared leader mid-call
         self._leader: str | None = None
 
     # ---- leader discovery --------------------------------------------------
@@ -137,15 +150,15 @@ class MetaClient:
         raise IllegalStateError(f"no metasrv leader among {self.peers}")
 
     def _call(self, path: str, body: dict) -> dict:
-        if self._leader is None:
-            self.ask_leader()
+        leader = self._leader
+        if leader is None:
+            leader = self.ask_leader()
         try:
-            return self._post(self._leader, path, body)
+            return self._post(leader, path, body)
         except (OSError, IllegalStateError):
             # leadership moved: re-probe once (reference ask_leader retry)
             self._leader = None
-            self.ask_leader()
-            return self._post(self._leader, path, body)
+            return self._post(self.ask_leader(), path, body)
 
     @staticmethod
     def _post(peer: str, path: str, body: dict) -> dict:
@@ -195,6 +208,17 @@ class MetaClient:
 
     def set_route(self, table_id: int, routes: dict[int, int]):
         self._call("/route/set", {"table_id": table_id, "routes": {str(k): v for k, v in routes.items()}})
+
+    def add_follower(self, table_id: int, region_id: int, node_id: int):
+        """Open a read-only follower replica and record it in the route."""
+        self._call(
+            "/follower/add",
+            {"table_id": table_id, "region_id": region_id, "node_id": node_id},
+        )
+
+    def get_followers(self, table_id: int) -> dict[int, list[int]]:
+        out = self._call("/follower/get", {"table_id": table_id})
+        return {int(k): [int(n) for n in v] for k, v in out["followers"].items()}
 
     def select_datanode(self, exclude=frozenset()) -> int | None:
         return self._call("/select", {"exclude": sorted(exclude)})["node_id"]

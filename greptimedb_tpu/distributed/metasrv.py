@@ -16,13 +16,60 @@ import logging
 import threading
 from dataclasses import dataclass, field
 
+from ..models.partition import RegionRoute
+from ..utils import fault_injection
 from ..utils.errors import IllegalStateError, RetryLaterError
+from ..utils.retry import is_transient
 from .failure_detector import PhiAccrualFailureDetector
 from .kv import KvBackend
 from .procedure import DONE, EXECUTING, Procedure, ProcedureManager
 
 ROUTE_PREFIX = "/table_route/"
 LEASE_MS = 10_000
+
+
+class FaultInjectingNodeManager:
+    """Transparent wrapper around any NodeManager implementation that fires
+    named fault points before each metasrv->datanode call, so failover /
+    migration / repartition procedures get the same chaos coverage the
+    frontend->datanode path has (the reference fuzzes these by killing real
+    processes; tests-fuzz/targets/failover).  Points:
+
+        node.open_region   (also fired for follower opens)
+        node.close_region
+        node.flush_region
+        node.set_writable
+    """
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def open_region(self, node_id: int, rid: int):
+        fault_injection.fire("node.open_region", node_id=node_id, region_id=rid)
+        return self._inner.open_region(node_id, rid)
+
+    def open_follower(self, node_id: int, rid: int):
+        fault_injection.fire(
+            "node.open_region", node_id=node_id, region_id=rid, follower=True
+        )
+        return self._inner.open_follower(node_id, rid)
+
+    def close_region_quiet(self, node_id: int, rid: int):
+        fault_injection.fire("node.close_region", node_id=node_id, region_id=rid)
+        return self._inner.close_region_quiet(node_id, rid)
+
+    def flush_region(self, node_id: int, rid: int):
+        fault_injection.fire("node.flush_region", node_id=node_id, region_id=rid)
+        return self._inner.flush_region(node_id, rid)
+
+    def set_region_writable(self, node_id: int, rid: int, writable: bool):
+        fault_injection.fire(
+            "node.set_writable", node_id=node_id, region_id=rid, writable=writable
+        )
+        return self._inner.set_region_writable(node_id, rid, writable)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
 
 
 @dataclass
@@ -43,7 +90,13 @@ class DatanodeInfo:
 class RegionFailoverProcedure(Procedure):
     """Durable failover state machine (reference region_migration.rs:737):
       select_target -> open_candidate -> update_metadata -> done.
-    State: {step, region_id, table_id, from_node, to_node}."""
+    State: {step, region_id, table_id, from_node, to_node, tried}.
+
+    `open_candidate` failing transiently does NOT poison the procedure:
+    the failed candidate is recorded in `tried` and the machine loops back
+    to `select_target`, excluding every candidate that already failed —
+    the retry-or-rollback contract the reference gets from its
+    error-handling per migration state."""
 
     type_name = "region_failover"
 
@@ -54,7 +107,19 @@ class RegionFailoverProcedure(Procedure):
         metasrv: "Metasrv" = ctx.services["metasrv"]
         step = self.state.get("step", "select_target")
         if step == "select_target":
-            target = metasrv.select_datanode(exclude={self.state["from_node"]})
+            exclude = {self.state["from_node"], *self.state.get("tried", [])}
+            # an existing follower replica already has the region open
+            # read-only over the shared storage — promoting it is the
+            # cheapest failover target (reference prefers follower peers)
+            target = None
+            for f in metasrv.followers_of(
+                self.state["table_id"], self.state["region_id"]
+            ):
+                if f not in exclude and metasrv.is_alive_datanode(f):
+                    target = f
+                    break
+            if target is None:
+                target = metasrv.select_datanode(exclude=exclude)
             if target is None:
                 # transient: under load every node can look dead for a
                 # beat (missed heartbeats) — retry, and if retries
@@ -68,8 +133,46 @@ class RegionFailoverProcedure(Procedure):
         if step == "open_candidate":
             # Shared storage: the target opens the region from the common
             # data dir (the reference requires remote WAL/shared storage for
-            # failover the same way).
-            metasrv.node_manager.open_region(self.state["to_node"], self.state["region_id"])
+            # failover the same way).  A PROMOTED FOLLOWER already holds the
+            # region open read-only — the writable flip is what makes it
+            # the leader (open_region alone returns the existing read-only
+            # region unchanged).
+            try:
+                metasrv.node_manager.open_region(
+                    self.state["to_node"], self.state["region_id"]
+                )
+                metasrv.node_manager.set_region_writable(
+                    self.state["to_node"], self.state["region_id"], True
+                )
+            except Exception as exc:  # noqa: BLE001 — classified below
+                if not is_transient(exc):
+                    raise
+                # the candidate itself is sick: retry on the NEXT candidate
+                # instead of hammering this one / poisoning the procedure.
+                # Best-effort close first so a half-promoted candidate never
+                # lingers open while another node takes the region.
+                try:
+                    metasrv.node_manager.close_region_quiet(
+                        self.state["to_node"], self.state["region_id"]
+                    )
+                except Exception:  # noqa: BLE001 — best-effort by contract
+                    pass
+                # the close above tore down a promoted follower's read-only
+                # open too: stop advertising it as a replica, or hedged
+                # reads and the NEXT failover would keep picking a node
+                # that no longer serves the region
+                metasrv.remove_follower(
+                    self.state["table_id"], self.state["region_id"],
+                    self.state["to_node"],
+                )
+                self.state.setdefault("tried", []).append(self.state["to_node"])
+                self.state["step"] = "select_target"
+                logging.getLogger("greptimedb_tpu.metasrv").warning(
+                    "failover open_candidate on node %s failed (%s); "
+                    "retrying on the next candidate",
+                    self.state["to_node"], exc,
+                )
+                return EXECUTING
             self.state["step"] = "update_metadata"
             return EXECUTING
         if step == "update_metadata":
@@ -118,6 +221,11 @@ class RegionMigrationProcedure(Procedure):
             return EXECUTING
         if step == "open_candidate":
             nm.open_region(self.state["to_node"], rid)
+            # the target may be an existing READ-ONLY follower replica:
+            # open_region returns it unchanged, so the writable flip is
+            # what actually promotes it (same fix as failover's
+            # open_candidate — a migrated-onto follower must take writes)
+            nm.set_region_writable(self.state["to_node"], rid, True)
             self.state["step"] = "update_metadata"
             return EXECUTING
         if step == "update_metadata":
@@ -163,6 +271,11 @@ class Metasrv:
         metasrv.rs:577-618); on takeover the new leader re-arms unfinished
         procedures from the shared KV."""
         self.kv = kv
+        # every metasrv->datanode call crosses the fault-injection gateway,
+        # so procedure-side chaos (open_candidate failing mid-failover) is
+        # scriptable regardless of the node manager implementation
+        if not isinstance(node_manager, FaultInjectingNodeManager):
+            node_manager = FaultInjectingNodeManager(node_manager)
         self.node_manager = node_manager
         self.datanodes: dict[int, DatanodeInfo] = {}
         self.procedures = ProcedureManager(kv, services={"metasrv": self})
@@ -220,7 +333,8 @@ class Metasrv:
             if self.selector == "load_based":
                 loads = {n: 0 for n in healthy}
                 for _key, raw in self.kv.range(ROUTE_PREFIX).items():
-                    for _rid, node in json.loads(raw).items():
+                    for _rid, v in json.loads(raw).items():
+                        node = RegionRoute.from_wire(v).leader
                         if node in loads:
                             loads[node] += 1
                 self._rr_counter += 1
@@ -230,29 +344,111 @@ class Metasrv:
             return healthy[self._rr_counter % len(healthy)]
 
     # ---- routes -----------------------------------------------------------
-    def set_route(self, table_id: int, routes: dict[int, int]):
+    # KV values per region are a bare leader node id (the pre-replica form,
+    # still what most tables hold) or {"leader": n, "followers": [...]}
+    # once read replicas exist — models/partition.py RegionRoute wire form.
+    def set_route(self, table_id: int, routes: dict):
         if not routes:
             # dropping the last route DELETES the key: dead table ids must
             # not accumulate in the KV (DropTableProcedure / frontend DROP)
             self.kv.delete(ROUTE_PREFIX + str(table_id))
             return
-        self.kv.put(ROUTE_PREFIX + str(table_id), json.dumps({str(k): v for k, v in routes.items()}))
+        wire = {}
+        for k, v in routes.items():
+            if isinstance(v, RegionRoute):
+                v = v.to_wire()
+            wire[str(k)] = v
+        self.kv.put(ROUTE_PREFIX + str(table_id), json.dumps(wire))
+
+    def get_route_full(self, table_id: int) -> dict[int, RegionRoute]:
+        raw = self.kv.get(ROUTE_PREFIX + str(table_id))
+        if not raw:
+            return {}
+        return {int(k): RegionRoute.from_wire(v) for k, v in json.loads(raw).items()}
 
     def get_route(self, table_id: int) -> dict[int, int]:
-        raw = self.kv.get(ROUTE_PREFIX + str(table_id))
-        return {int(k): v for k, v in json.loads(raw).items()} if raw else {}
+        """Leader-only view (what writes and default reads consult)."""
+        return {k: r.leader for k, r in self.get_route_full(table_id).items()}
 
     def update_route(self, table_id: int, region_id: int, node_id: int):
-        routes = self.get_route(table_id)
-        routes[region_id] = node_id
-        self.set_route(table_id, routes)
+        # route mutations are read-modify-write over the whole table value:
+        # serialize them under the metasrv lock or a concurrent failover
+        # and follower-add could silently overwrite each other's region
+        with self._lock:
+            routes = self.get_route_full(table_id)
+            prev = routes.get(region_id)
+            followers = list(prev.followers) if prev else []
+            if node_id in followers:
+                followers.remove(node_id)  # promoted follower is now the leader
+            routes[region_id] = RegionRoute(node_id, followers)
+            self.set_route(table_id, routes)
+
+    # ---- follower replicas -------------------------------------------------
+    def add_follower(self, table_id: int, region_id: int, node_id: int):
+        """Open a read-only follower replica of `region_id` on `node_id`
+        and record it in the route (reference: follower peers in
+        RegionRoute; our shared storage plays the role of replication).
+        The follower serves the region as of its open (manifest + shared
+        WAL replay) — bounded-staleness reads; re-adding refreshes nothing
+        yet (ROADMAP: follower freshness)."""
+        with self._lock:
+            route = self.get_route_full(table_id).get(region_id)
+            if route is None:
+                raise IllegalStateError(f"region {region_id} has no route")
+            if node_id == route.leader:
+                raise IllegalStateError(
+                    f"node {node_id} already leads region {region_id}"
+                )
+            info = self.datanodes.get(node_id)
+            if not (info and info.alive and info.role == "datanode"):
+                raise IllegalStateError(f"datanode {node_id} is not alive")
+            if node_id in route.followers:
+                return
+        # the (possibly slow) datanode call runs OUTSIDE the lock —
+        # heartbeats must not stall behind a follower open — and the
+        # route is re-read under the lock before recording
+        self.node_manager.open_follower(node_id, region_id)
+        with self._lock:
+            routes = self.get_route_full(table_id)
+            route = routes.get(region_id)
+            if route is not None and node_id not in route.followers:
+                route.followers.append(node_id)
+                self.set_route(table_id, routes)
+
+    def remove_follower(self, table_id: int, region_id: int, node_id: int):
+        """Drop a follower from a region's route (its read-only open is
+        gone or being retired); no-op when it was not a follower."""
+        with self._lock:
+            routes = self.get_route_full(table_id)
+            route = routes.get(region_id)
+            if route is not None and node_id in route.followers:
+                route.followers.remove(node_id)
+                self.set_route(table_id, routes)
+
+    def get_followers(self, table_id: int) -> dict[int, list[int]]:
+        return {
+            rid: list(r.followers)
+            for rid, r in self.get_route_full(table_id).items()
+            if r.followers
+        }
+
+    def followers_of(self, table_id: int, region_id: int) -> list[int]:
+        r = self.get_route_full(table_id).get(region_id)
+        return list(r.followers) if r else []
+
+    def is_alive_datanode(self, node_id: int) -> bool:
+        with self._lock:
+            info = self.datanodes.get(node_id)
+            return bool(info and info.alive and info.role == "datanode")
 
     def regions_on(self, node_id: int) -> list[tuple[int, int]]:
+        """Regions whose LEADER is `node_id` (follower opens grant no
+        lease and trigger no failover — they are read-only by contract)."""
         out = []
         for key, raw in self.kv.range(ROUTE_PREFIX).items():
             table_id = int(key[len(ROUTE_PREFIX) :])
-            for region_id, n in json.loads(raw).items():
-                if n == node_id:
+            for region_id, v in json.loads(raw).items():
+                if RegionRoute.from_wire(v).leader == node_id:
                     out.append((table_id, int(region_id)))
         return out
 

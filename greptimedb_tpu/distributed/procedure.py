@@ -18,7 +18,9 @@ import traceback
 import uuid
 from dataclasses import dataclass, field
 
+from ..utils import metrics
 from ..utils.errors import IllegalStateError, RetryLaterError
+from ..utils.retry import is_transient
 from .kv import KvBackend
 
 PROC_PREFIX = "/procedure/"
@@ -144,16 +146,26 @@ class ProcedureManager:
         while True:
             try:
                 status = procedure.execute(ctx)
-            except RetryLaterError:
+            except Exception as exc:
+                # RetryLaterError AND wire-transient failures retry with
+                # backoff (a datanode restarting mid-procedure must not
+                # poison a failover; the reference's procedure runner
+                # retries its retryable error class the same way) —
+                # anything else rolls back and poisons.
+                if not is_transient(exc):
+                    status = self._poison(
+                        procedure, ctx, record, traceback.format_exc(limit=3)
+                    )
+                    return
                 retries += 1
+                metrics.PROCEDURE_RETRIES_TOTAL.inc(type=procedure.type_name)
                 if retries > self.max_retries:
-                    status = self._poison(procedure, ctx, record, "retries exhausted")
+                    status = self._poison(
+                        procedure, ctx, record, f"retries exhausted: {exc}"
+                    )
                     return
                 time.sleep(min(0.01 * (2**retries), 0.5))
                 continue
-            except Exception:
-                status = self._poison(procedure, ctx, record, traceback.format_exc(limit=3))
-                return
             retries = 0
             record.state = procedure.state
             record.status = status

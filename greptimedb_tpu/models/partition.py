@@ -22,6 +22,31 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 
+@dataclass
+class RegionRoute:
+    """One region's placement: the leader datanode that serves writes plus
+    optional read-only follower replicas (reference
+    partition/src/manager.rs RegionRoute with leader_peer + follower_peers).
+
+    The wire/KV form stays backward compatible: a bare int is a route with
+    no followers (what every pre-replica KV holds), a dict carries both.
+    """
+
+    leader: int
+    followers: list[int] = field(default_factory=list)
+
+    def to_wire(self):
+        if not self.followers:
+            return self.leader
+        return {"leader": self.leader, "followers": list(self.followers)}
+
+    @staticmethod
+    def from_wire(v) -> "RegionRoute":
+        if isinstance(v, dict):
+            return RegionRoute(int(v["leader"]), [int(f) for f in v.get("followers", [])])
+        return RegionRoute(int(v))
+
+
 class PartitionRule:
     def num_partitions(self) -> int:
         raise NotImplementedError

@@ -131,6 +131,11 @@ class CompactionScheduler:
                 region = self.engine.region(rid)
             except Exception:  # noqa: BLE001 — region closed mid-round
                 continue
+            if not getattr(region, "writable", True):
+                # follower replica / downgraded leader: compaction belongs
+                # to the leader — two compactors on shared storage would
+                # corrupt the manifest
+                continue
             try:
                 done += compact_region(
                     region,
@@ -164,6 +169,8 @@ class CompactionScheduler:
                     region = self.engine.region(rid)
                 except Exception:  # noqa: BLE001 — closed between list and get
                     continue
+                if not getattr(region, "writable", True):
+                    continue  # follower replica: the leader compacts
                 try:
                     n = compact_region(
                         region,

@@ -1,0 +1,240 @@
+"""Per-peer circuit breakers: shed load from a flapping node BEFORE its
+lease lapses.
+
+The reference relies on channel invalidation + the metasrv's phi-accrual
+detector to stop traffic to a dead datanode, but both are slow for a
+*flapping* node: the lease takes `LEASE_MS` to lapse, and until then every
+frontend request burns its full retry budget (attempts x backoff) against
+a node that answers just often enough to stay "alive".  A circuit breaker
+is the standard tail-tolerance fix (hedged-requests literature; the
+reference's meta client carries the same idea in its leader re-probe
+loop): count recent outcomes per peer, and once the failure rate over a
+sliding window crosses a threshold, fail calls to that peer *immediately*
+for a cooldown — the frontend's retry loop then spends its budget on
+route refreshes (consuming failover) instead of wire timeouts.
+
+State machine (classic closed/open/half-open):
+
+    CLOSED     normal; outcomes recorded into a count-based sliding
+               window.  When the window holds >= min_calls samples and
+               the failure rate >= failure_rate, the breaker trips OPEN.
+    OPEN       `allow()` returns False (callers fail fast) until
+               open_cooldown_s has elapsed, then the next `allow()`
+               transitions to HALF_OPEN.
+    HALF_OPEN  a bounded probe budget (half_open_probes) passes through;
+               all probes succeeding -> CLOSED (window reset), any probe
+               failing -> OPEN again (fresh cooldown).
+
+The clock is injectable so chaos tests drive cooldown expiry
+deterministically instead of sleeping.  Thread safety: one lock per
+breaker; `allow()`/`record_*` are O(1).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from . import metrics
+from .errors import RetryLaterError
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+# numeric encoding for the breaker_state gauge (Prometheus wants numbers)
+_STATE_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+
+class CircuitOpenError(RetryLaterError):
+    """Raised by callers that consult a breaker and find it open.
+
+    Subclasses RetryLaterError on purpose: an open circuit is the same
+    retryable contract as a transient wire failure — the SQL surface maps
+    it to RETRY_LATER, and retry loops may re-route around it — but the
+    distinct type lets tests (and logs) tell "shed by breaker" apart from
+    "failed on the wire".
+    """
+
+
+class CircuitBreaker:
+    """One peer's breaker (see module docstring for the state machine)."""
+
+    def __init__(
+        self,
+        name: str = "",
+        window: int = 20,
+        min_calls: int = 5,
+        failure_rate: float = 0.5,
+        open_cooldown_s: float = 5.0,
+        half_open_probes: int = 1,
+        clock=time.monotonic,
+    ):
+        self.name = name
+        self.window = max(1, int(window))
+        self.min_calls = max(1, int(min_calls))
+        self.failure_rate = failure_rate
+        self.open_cooldown_s = open_cooldown_s
+        self.half_open_probes = max(1, int(half_open_probes))
+        self.clock = clock
+        self.trips = 0  # lifetime OPEN transitions
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._outcomes: deque[bool] = deque(maxlen=self.window)
+        self._opened_at = 0.0
+        self._probes_issued = 0
+        self._probe_successes = 0
+        self._publish(CLOSED)
+
+    # ---- state -------------------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _publish(self, state: str):
+        if self.name:
+            metrics.BREAKER_STATE.set(_STATE_CODE[state], node=self.name)
+
+    def _trip_open(self):
+        """Lock held."""
+        self._state = OPEN
+        self._opened_at = self.clock()
+        self.trips += 1
+        self._outcomes.clear()
+        if self.name:
+            metrics.BREAKER_TRIPS_TOTAL.inc(node=self.name)
+        self._publish(OPEN)
+
+    def _close(self):
+        """Lock held."""
+        self._state = CLOSED
+        self._outcomes.clear()
+        self._publish(CLOSED)
+
+    # ---- call gate ---------------------------------------------------------
+    def allow(self) -> bool:
+        """May a call proceed right now?  OPEN past its cooldown flips to
+        HALF_OPEN and admits up to half_open_probes probes."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self.clock() - self._opened_at < self.open_cooldown_s:
+                    return False
+                self._state = HALF_OPEN
+                self._probes_issued = 0
+                self._probe_successes = 0
+                self._publish(HALF_OPEN)
+            # HALF_OPEN: bounded probe budget
+            if self._probes_issued < self.half_open_probes:
+                self._probes_issued += 1
+                return True
+            return False
+
+    def would_allow(self) -> bool:
+        """Non-consuming peek: would `allow()` admit a call right now?
+        Never spends a half-open probe slot and never transitions state —
+        for pre-flight checks (e.g. picking a hedge target) where the
+        consuming `allow()` runs later at the actual call site."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                return self.clock() - self._opened_at >= self.open_cooldown_s
+            return self._probes_issued < self.half_open_probes
+
+    def release_probe(self):
+        """Return a half-open probe slot whose call produced NO verdict
+        (a non-transient error says nothing about the node's health).
+        Without this the slot leaks and the breaker sheds forever."""
+        with self._lock:
+            if self._state == HALF_OPEN and self._probes_issued > 0:
+                self._probes_issued -= 1
+
+    def check(self):
+        """`allow()` or raise CircuitOpenError (convenience for call sites
+        that want the retryable-error contract instead of a bool)."""
+        if not self.allow():
+            raise CircuitOpenError(
+                f"circuit for {self.name or 'peer'} is open; shedding load"
+            )
+
+    # ---- outcome recording -------------------------------------------------
+    def record_success(self):
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._probe_successes += 1
+                if self._probe_successes >= self.half_open_probes:
+                    self._close()
+                return
+            if self._state == CLOSED:
+                self._outcomes.append(True)
+
+    def record_failure(self):
+        with self._lock:
+            if self._state == HALF_OPEN:
+                # the probe failed: the node is still sick — re-open with a
+                # fresh cooldown
+                self._trip_open()
+                return
+            if self._state != CLOSED:
+                return
+            self._outcomes.append(False)
+            if len(self._outcomes) >= self.min_calls:
+                failures = sum(1 for ok in self._outcomes if not ok)
+                if failures / len(self._outcomes) >= self.failure_rate:
+                    self._trip_open()
+
+
+class LatencyTracker:
+    """Bounded sample of recent call latencies; feeds the adaptive hedge
+    delay ("hedge after the p95" — The Tail at Scale).  O(1) record, O(n
+    log n) percentile over a small fixed window."""
+
+    def __init__(self, window: int = 128, min_samples: int = 16):
+        self._samples: deque[float] = deque(maxlen=window)
+        self.min_samples = min_samples
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float):
+        with self._lock:
+            self._samples.append(seconds)
+
+    def percentile(self, q: float) -> float | None:
+        """The q-quantile of recent latencies, or None while there are too
+        few samples to call it a distribution."""
+        with self._lock:
+            if len(self._samples) < self.min_samples:
+                return None
+            s = sorted(self._samples)
+        idx = min(int(q * len(s)), len(s) - 1)
+        return s[idx]
+
+
+class BreakerBoard:
+    """Lazily-built map of peer key -> CircuitBreaker sharing one config
+    (the frontend keys it per datanode inside its client cache)."""
+
+    def __init__(self, factory):
+        """`factory(key) -> CircuitBreaker | None`; None disables breaking
+        for that key (and is not cached, so flipping config on re-checks)."""
+        self._factory = factory
+        self._breakers: dict = {}
+        self._lock = threading.Lock()
+
+    def get(self, key) -> CircuitBreaker | None:
+        with self._lock:
+            b = self._breakers.get(key)
+        if b is not None:
+            return b
+        b = self._factory(key)
+        if b is None:
+            return None
+        with self._lock:
+            return self._breakers.setdefault(key, b)
+
+    def states(self) -> dict:
+        with self._lock:
+            return {k: b.state for k, b in self._breakers.items()}

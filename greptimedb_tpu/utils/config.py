@@ -176,6 +176,16 @@ class QueryConfig:
     # rest (the reference removes individual physical optimizer rules the
     # same way in its tests).
     disabled_passes: tuple = ()
+    # Hedged region reads (tail tolerance): once a region sub-query has been
+    # outstanding this long, the frontend sends a duplicate to a follower
+    # replica and takes whichever lands first.  0 disables hedging; it also
+    # requires replica.read_followers and at least one registered follower,
+    # so single-node setups are unaffected.
+    hedge_delay_ms: float = 0.0
+    # Once enough sub-query latencies are observed, the hedge delay adapts
+    # to this percentile of recent latencies (hedge_delay_ms stays the
+    # floor) — the "hedge after the p95" recipe.
+    hedge_percentile: float = 0.95
 
 
 @dataclasses.dataclass
@@ -217,6 +227,30 @@ class SlowQueryConfig:
 
 
 @dataclasses.dataclass
+class BreakerConfig:
+    """Per-datanode circuit breakers in the frontend's client cache
+    (utils/circuit_breaker.py).  Default OFF: a single-node setup never
+    pays the bookkeeping, and tests opt in explicitly."""
+
+    enable: bool = False
+    window: int = 20  # sliding window of recent call outcomes (count-based)
+    min_calls: int = 5  # don't judge a node on fewer samples than this
+    failure_rate: float = 0.5  # trip when failures/window >= this
+    open_cooldown_s: float = 5.0  # OPEN -> HALF_OPEN after this long
+    half_open_probes: int = 1  # probe budget while HALF_OPEN
+
+
+@dataclasses.dataclass
+class ReplicaConfig:
+    """Follower read replicas: read-only opens of a region on extra
+    datanodes over the shared storage, registered in the metasrv route
+    table.  Default OFF — followers must be added explicitly
+    (MetaClient.add_follower) and reads only consult them when enabled."""
+
+    read_followers: bool = False
+
+
+@dataclasses.dataclass
 class MemoryConfig:
     """Admission-style memory governance (reference common/memory-manager,
     servers request_memory_limiter `max_in_flight_write_bytes`,
@@ -238,9 +272,58 @@ class Config:
     slow_query: SlowQueryConfig = dataclasses.field(default_factory=SlowQueryConfig)
     memory: MemoryConfig = dataclasses.field(default_factory=MemoryConfig)
     telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
+    breaker: BreakerConfig = dataclasses.field(default_factory=BreakerConfig)
+    replica: ReplicaConfig = dataclasses.field(default_factory=ReplicaConfig)
 
     def __post_init__(self):
         self.storage.__post_init__()
+        self.validate()
+
+    def validate(self):
+        """Reject nonsense knob values with errors that name the knob —
+        a breaker with failure_rate=0 would trip on the first blip and a
+        negative hedge delay would hedge every read immediately; both are
+        config mistakes, not modes."""
+        from .errors import ConfigError
+
+        q, b = self.query, self.breaker
+        if q.hedge_delay_ms < 0:
+            raise ConfigError(
+                "query.hedge_delay_ms must be >= 0 milliseconds (0 disables hedging); "
+                f"got {q.hedge_delay_ms!r}"
+            )
+        if not (0.0 < q.hedge_percentile < 1.0):
+            raise ConfigError(
+                "query.hedge_percentile must be in (0, 1) — a fraction of the "
+                f"latency distribution; got {q.hedge_percentile!r}"
+            )
+        if b.window < 1:
+            raise ConfigError(
+                f"breaker.window must be >= 1 recent calls; got {b.window!r}"
+            )
+        if b.min_calls < 1:
+            raise ConfigError(
+                f"breaker.min_calls must be >= 1; got {b.min_calls!r}"
+            )
+        if b.min_calls > b.window:
+            raise ConfigError(
+                f"breaker.min_calls ({b.min_calls}) cannot exceed breaker.window "
+                f"({b.window}) — the window can never hold enough samples to trip"
+            )
+        if not (0.0 < b.failure_rate <= 1.0):
+            raise ConfigError(
+                "breaker.failure_rate must be in (0, 1] — the failing fraction of "
+                f"the window that trips the breaker; got {b.failure_rate!r}"
+            )
+        if b.open_cooldown_s <= 0:
+            raise ConfigError(
+                "breaker.open_cooldown_s must be > 0 seconds (how long an open "
+                f"breaker sheds before probing); got {b.open_cooldown_s!r}"
+            )
+        if b.half_open_probes < 1:
+            raise ConfigError(
+                f"breaker.half_open_probes must be >= 1; got {b.half_open_probes!r}"
+            )
 
     @classmethod
     def load(cls, path: str | None = None, env: dict[str, str] | None = None) -> "Config":

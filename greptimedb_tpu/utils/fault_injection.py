@@ -18,6 +18,12 @@ Named points wired into the codebase:
     wal.append         SharedLogStore.append
     meta.heartbeat     MetaClient.handle_heartbeat
     meta.get_route     MetaClient.get_route
+    node.open_region   metasrv->datanode NodeManager gateway (procedure-side
+    node.close_region  faults: open_candidate failing mid-failover, flushes
+    node.flush_region  and downgrade fences failing mid-migration) — fired
+    node.set_writable  by FaultInjectingNodeManager in distributed/metasrv.py
+    flow.mirror        FlownodeClient.mirror_insert (frontend->flownode
+                       mirrored inserts; best-effort by contract)
 
 Production overhead is near zero: `fire()` is a module-level function whose
 fast path is one read of a module global (`_ARMED`) — no locks, no dict
@@ -56,6 +62,11 @@ POINTS = frozenset(
         "wal.append",
         "meta.heartbeat",
         "meta.get_route",
+        "node.open_region",
+        "node.close_region",
+        "node.flush_region",
+        "node.set_writable",
+        "flow.mirror",
     }
 )
 

@@ -172,3 +172,42 @@ TILE_STREAM_QUERIES = REGISTRY.counter("greptime_tile_stream_total", "Queries wh
 DIST_STATE_QUERIES = REGISTRY.counter("greptime_query_dist_state_total", "Distributed queries merged from shipped states")
 COMPACTION_BACKGROUND = REGISTRY.counter("greptime_mito_compaction_background_total", "Background compaction merges")
 COMPACTION_FAILED = REGISTRY.counter("greptime_mito_compaction_failed_total", "Compaction rounds that errored")
+
+# Fault-tolerance / tail-tolerance metrics (frontend + metasrv planes).
+RETRY_ATTEMPTS_TOTAL = REGISTRY.counter(
+    "greptime_retry_attempts_total", "Retry re-attempts under the unified RetryPolicy"
+)
+ROUTE_REFRESH_TOTAL = REGISTRY.counter(
+    "greptime_route_refresh_total", "Region route re-fetches between retry attempts"
+)
+BREAKER_STATE = REGISTRY.gauge(
+    "greptime_breaker_state", "Circuit breaker state per peer (0 closed, 1 open, 2 half-open)"
+)
+BREAKER_TRIPS_TOTAL = REGISTRY.counter(
+    "greptime_breaker_trips_total", "Circuit breaker closed/half-open -> open transitions"
+)
+BREAKER_SHED_TOTAL = REGISTRY.counter(
+    "greptime_breaker_shed_total", "Calls failed fast because the peer's breaker was open"
+)
+HEDGE_REQUESTS_TOTAL = REGISTRY.counter(
+    "greptime_hedge_requests_total", "Hedged duplicate region reads sent to followers"
+)
+HEDGE_WINS_TOTAL = REGISTRY.counter(
+    "greptime_hedge_wins_total", "Hedged reads that returned before the primary"
+)
+FANOUT_ABANDONED_TOTAL = REGISTRY.counter(
+    "greptime_fanout_abandoned_total",
+    "In-flight region sub-requests abandoned at deadline expiry (client dropped)",
+)
+PROCEDURE_RETRIES_TOTAL = REGISTRY.counter(
+    "greptime_procedure_step_retries_total", "Procedure steps retried after transient failures"
+)
+FLOW_MIRROR_TOTAL = REGISTRY.counter(
+    "greptime_flow_mirror_total", "Flow mirror batches enqueued to flownodes"
+)
+FLOW_MIRROR_FAILURES_TOTAL = REGISTRY.counter(
+    "greptime_flow_mirror_failures_total", "Flow mirror deliveries that failed an attempt"
+)
+FLOW_MIRROR_DROPPED_TOTAL = REGISTRY.counter(
+    "greptime_flow_mirror_dropped_total", "Flow mirror batches dropped after exhausting retries"
+)

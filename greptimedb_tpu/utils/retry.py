@@ -29,6 +29,7 @@ import random
 import time
 from dataclasses import dataclass
 
+from . import metrics
 from .deadline import check_deadline, current_deadline
 from .errors import QueryTimeoutError, RetryLaterError
 
@@ -118,6 +119,7 @@ class RetryPolicy:
                 if not classify(exc) or attempt == attempts - 1:
                     raise
                 last = exc
+                metrics.RETRY_ATTEMPTS_TOTAL.inc()
                 if on_retry is not None:
                     on_retry(exc, attempt)
         raise last  # pragma: no cover — loop always returns or raises

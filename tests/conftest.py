@@ -22,6 +22,67 @@ jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 
+# ---- stall watchdog --------------------------------------------------------
+# If any single test runs longer than WATCHDOG_S, dump EVERY thread's stack
+# to a side file (fd-capture-proof — pytest redirects fd 2, so faulthandler's
+# default target vanishes into the capture tempfile).  Purely diagnostic: the
+# run is not killed, but a hung tier-1 run leaves the evidence behind.
+_WATCHDOG_S = float(os.environ.get("GREPTIMEDB_TPU_TEST_WATCHDOG_S", "600"))
+_WATCHDOG_FILE = os.environ.get(
+    "GREPTIMEDB_TPU_TEST_WATCHDOG_FILE", "/tmp/greptimedb_tpu_test_watchdog.txt"
+)
+_watchdog_fh = None
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    import faulthandler
+
+    global _watchdog_fh
+    if _WATCHDOG_S > 0:
+        if _watchdog_fh is None:
+            _watchdog_fh = open(_WATCHDOG_FILE, "w")
+        _watchdog_fh.truncate(0)
+        _watchdog_fh.seek(0)
+        _watchdog_fh.write(f"watchdog armed for: {item.nodeid}\n")
+        _watchdog_fh.flush()
+        faulthandler.dump_traceback_later(
+            _WATCHDOG_S, exit=False, file=_watchdog_fh
+        )
+    yield
+    if _WATCHDOG_S > 0:
+        faulthandler.cancel_dump_traceback_later()
+
+
+def pytest_sessionstart(session):
+    """Every NAMED fault-injection point must be exercised by at least one
+    test: a new point landing without a chaos/unit test firing it is dead
+    coverage, and this check fails the run before a single test executes.
+    The check is static (scans test sources for the point name in an
+    arm()/armed()/fire() call) so it holds for any test subset the session
+    actually runs."""
+    import pathlib
+    import re
+
+    from greptimedb_tpu.utils.fault_injection import POINTS
+
+    root = pathlib.Path(__file__).parent
+    blob = "\n".join(
+        p.read_text(encoding="utf-8") for p in sorted(root.glob("test_*.py"))
+    )
+    missing = [
+        point
+        for point in sorted(POINTS)
+        if not re.search(r"""['"]{}['"]""".format(re.escape(point)), blob)
+    ]
+    if missing:
+        raise pytest.UsageError(
+            "fault-injection points with no test exercising them: "
+            f"{missing} — add a chaos test arming each point "
+            "(tests/test_chaos.py) before registering it in "
+            "greptimedb_tpu/utils/fault_injection.py"
+        )
+
 
 @pytest.fixture()
 def tmp_engine(tmp_path):

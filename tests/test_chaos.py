@@ -9,6 +9,8 @@ dependency breaks is scripted instead of raced (the reference does this
 black-box and slow in tests-fuzz/targets/failover).
 """
 
+import time as _time
+
 import pyarrow as pa
 import pyarrow.flight as fl
 import pytest
@@ -19,6 +21,8 @@ from greptimedb_tpu.distributed.kv import MemoryKvBackend
 from greptimedb_tpu.distributed.meta_service import MetaClient, MetasrvServer
 from greptimedb_tpu.distributed.metasrv import Metasrv
 from greptimedb_tpu.utils import fault_injection as fi
+from greptimedb_tpu.utils import metrics
+from greptimedb_tpu.utils.circuit_breaker import CLOSED, HALF_OPEN, OPEN
 from greptimedb_tpu.utils.errors import QueryTimeoutError, RetryLaterError
 from greptimedb_tpu.utils.retry import RetryPolicy, is_transient
 
@@ -38,6 +42,9 @@ class _FlightNodeManager:
 
     def open_region(self, node_id, rid):
         self.cluster.datanodes[node_id].client.open_region(rid)
+
+    def open_follower(self, node_id, rid):
+        self.cluster.datanodes[node_id].client.open_region(rid, writable=False)
 
     def close_region_quiet(self, node_id, rid):
         dn = self.cluster.datanodes.get(node_id)
@@ -312,6 +319,325 @@ def test_flaky_object_store_flush_absorbed_by_retry_layer(tmp_path):
     finally:
         fi.REGISTRY.disarm()
         engine.close()
+
+
+# ---- DoPut / DoAction transient faults are absorbed by the same policy ----
+
+
+@pytest.mark.chaos
+def test_write_and_ddl_transient_flight_faults_absorbed(chaos):
+    """The DoPut (INSERT) and DoAction (TRUNCATE et al.) paths ride the
+    same retry policy as DoGet: one injected transport failure per path is
+    absorbed without surfacing to SQL."""
+    _setup_table(chaos, "t12")
+    put_plan = fi.REGISTRY.arm(
+        "flight.do_put", fail_times=1, error=fl.FlightUnavailableError
+    )
+    n = chaos.frontend.sql_one("INSERT INTO t12 VALUES ('d', 4000, 4.0)")
+    assert n == 1 and put_plan.trips == 1
+    act_plan = fi.REGISTRY.arm(
+        "flight.do_action", fail_times=1, error=fl.FlightUnavailableError
+    )
+    chaos.frontend.sql_one("TRUNCATE TABLE t12")
+    assert act_plan.trips == 1
+    out = chaos.frontend.sql_one("SELECT count(*) AS c FROM t12")
+    assert out["c"].to_pylist() == [0]
+
+
+# ---- circuit breaker: flapping node sheds load before its lease lapses ----
+
+
+@pytest.mark.chaos
+def test_breaker_sheds_flapping_node_and_half_open_probe_restores(chaos):
+    """A flapping datanode trips its breaker after the failure-rate window
+    fills; while OPEN, further queries fail fast WITHOUT touching the wire
+    (the lease has not lapsed — this is load shedding ahead of failover).
+    After the cooldown a half-open probe restores the node."""
+    meta, rid, owner = _setup_table(chaos, "t6")
+    fe = chaos.frontend
+    fe.config.breaker.enable = True
+    fe.config.breaker.window = 8
+    fe.config.breaker.min_calls = 2
+    fe.config.breaker.failure_rate = 0.5
+    fe.config.breaker.open_cooldown_s = 30.0
+    breaker = fe._breaker(owner)
+    clk = [0.0]
+    breaker.clock = lambda: clk[0]  # deterministic cooldown, no sleeping
+
+    plan = fi.REGISTRY.arm(
+        "flight.do_get", fail_times=1000, error=fl.FlightUnavailableError,
+        match=lambda ctx: ctx.get("node_id") == owner,
+    )
+    with pytest.raises(RetryLaterError):
+        fe.sql_one("SELECT count(*) AS c FROM t6")
+    assert breaker.state == OPEN and breaker.trips == 1
+    assert metrics.BREAKER_STATE.get(node=f"datanode-{owner}") == 1
+
+    # while OPEN every attempt is shed: the retry budget burns on fast
+    # CircuitOpenErrors + route refreshes, not on wire calls to the node
+    hits_when_open = plan.hits
+    shed0 = metrics.BREAKER_SHED_TOTAL.get()
+    with pytest.raises(RetryLaterError):
+        fe.sql_one("SELECT count(*) AS c FROM t6")
+    assert plan.hits == hits_when_open  # zero wire calls reached the node
+    assert metrics.BREAKER_SHED_TOTAL.get() > shed0
+
+    # node recovers; cooldown elapses; the half-open probe restores it
+    fi.REGISTRY.disarm()
+    clk[0] += 31.0
+    out = fe.sql_one("SELECT count(*) AS c FROM t6")
+    assert out["c"].to_pylist() == [3]
+    assert breaker.state == CLOSED
+    assert metrics.BREAKER_STATE.get(node=f"datanode-{owner}") == 0
+    rendered = metrics.REGISTRY.render()
+    assert "greptime_breaker_state" in rendered
+    assert "greptime_breaker_trips_total" in rendered
+    assert "greptime_retry_attempts_total" in rendered
+
+
+# ---- hedged follower reads beat a slow region -----------------------------
+
+
+@pytest.mark.chaos
+def test_hedged_read_beats_slow_region_within_deadline(chaos):
+    """One region is artificially slowed (latency fault on its leader, no
+    error).  With a follower replica registered and hedging enabled, the
+    fan-out duplicates the slow sub-query to the follower after the hedge
+    delay and returns the follower's answer — well inside the query
+    deadline the slow leader alone would have blown."""
+    meta, rid, owner = _setup_table(chaos, "t7")
+    other = next(n for n in chaos.datanodes if n != owner)
+    client = MetaClient([chaos.server.address])
+    client.add_follower(meta.table_id, rid, other)
+    assert client.get_followers(meta.table_id) == {rid: [other]}
+
+    fe = chaos.frontend
+    fe.config.replica.read_followers = True
+    fe.config.query.hedge_delay_ms = 50.0
+    fe.config.query.timeout_s = 5.0
+    fi.REGISTRY.arm(
+        "flight.do_get", fail_times=100, latency_s=3.0,
+        match=lambda ctx: ctx.get("node_id") == owner,
+    )
+    reqs0 = metrics.HEDGE_REQUESTS_TOTAL.get()
+    wins0 = metrics.HEDGE_WINS_TOTAL.get()
+    try:
+        t0 = _time.monotonic()
+        out = fe.sql_one("SELECT count(*) AS c FROM t7")
+        elapsed = _time.monotonic() - t0
+    finally:
+        fe.config.query.timeout_s = 0.0
+        fe.config.query.hedge_delay_ms = 0.0
+        fe.config.replica.read_followers = False
+    assert out["c"].to_pylist() == [3]
+    assert elapsed < 2.5  # under the 3 s slowdown AND the 5 s deadline
+    assert metrics.HEDGE_REQUESTS_TOTAL.get() - reqs0 >= 1
+    assert metrics.HEDGE_WINS_TOTAL.get() - wins0 >= 1
+    rendered = metrics.REGISTRY.render()
+    assert "greptime_hedge_requests_total" in rendered
+    assert "greptime_hedge_wins_total" in rendered
+
+
+# ---- deadline expiry abandons the in-flight Flight call --------------------
+
+
+@pytest.mark.chaos
+def test_deadline_abandons_inflight_call_and_drops_client(chaos):
+    """After QueryTimeoutError the hung sub-request is DETACHED: the gather
+    never joins it, and the node's cached client is dropped so the next
+    query dials a fresh connection instead of queueing behind the hung
+    call."""
+    meta, rid, owner = _setup_table(chaos, "t8")
+    fi.REGISTRY.arm("flight.do_get", fail_times=100, latency_s=5.0)
+    chaos.frontend.config.query.timeout_s = 0.4
+    abandoned0 = metrics.FANOUT_ABANDONED_TOTAL.get()
+    try:
+        with pytest.raises(QueryTimeoutError):
+            chaos.frontend.sql_one("SELECT count(*) AS c FROM t8")
+    finally:
+        chaos.frontend.config.query.timeout_s = 0.0
+    assert metrics.FANOUT_ABANDONED_TOTAL.get() - abandoned0 >= 1
+    assert owner not in chaos.frontend._clients
+
+
+# ---- metasrv procedures survive NodeManager faults -------------------------
+
+
+@pytest.mark.chaos
+def test_open_candidate_fault_retries_next_candidate(tmp_path):
+    """Failover's open_candidate fails on the first target: the procedure
+    records the candidate as tried and re-selects, completing on the next
+    one — never poisoned, never an orphaned region."""
+    chaos = ChaosCluster(str(tmp_path / "shared3"), num_datanodes=3)
+    try:
+        meta, rid, owner = _setup_table(chaos, "t9")
+        chaos.datanodes[owner].kill()
+        plan = fi.REGISTRY.arm(
+            "node.open_region", fail_times=1, error=ConnectionError
+        )
+        submitted = chaos.fail_over_dead_node()
+        assert submitted
+        assert plan.trips == 1  # first candidate's open failed...
+        _meta, routes = chaos.route_of("t9")
+        assert routes[rid] != owner  # ...and the region still failed over
+        out = chaos.frontend.sql_one("SELECT count(*) AS c FROM t9")
+        assert out["c"].to_pylist() == [3]
+        recs = chaos.metasrv.procedures.list_records()
+        failovers = [r for r in recs if r.type_name == "region_failover"]
+        assert failovers and all(r.status == "done" for r in failovers)
+        assert owner in failovers[-1].state.get("tried", []) or routes[rid] != owner
+    finally:
+        fi.REGISTRY.disarm()
+        chaos.close()
+
+
+@pytest.mark.chaos
+def test_migration_survives_transient_node_manager_faults(chaos):
+    """Every metasrv->datanode call of a migration (flush, downgrade
+    fence, close) can fail transiently once; the procedure manager retries
+    the step instead of poisoning, and the migration completes."""
+    meta, rid, owner = _setup_table(chaos, "t10")
+    other = next(n for n in chaos.datanodes if n != owner)
+    retries0 = metrics.PROCEDURE_RETRIES_TOTAL.get(type="region_migration")
+    plans = [
+        fi.REGISTRY.arm("node.flush_region", fail_times=1, error=ConnectionError),
+        fi.REGISTRY.arm("node.set_writable", fail_times=1, error=ConnectionError),
+        fi.REGISTRY.arm("node.close_region", fail_times=1, error=ConnectionError),
+    ]
+    chaos.metasrv.migrate_region(meta.table_id, rid, other)
+    assert all(p.trips == 1 for p in plans)
+    assert (
+        metrics.PROCEDURE_RETRIES_TOTAL.get(type="region_migration") - retries0 >= 3
+    )
+    _meta, routes = chaos.route_of("t10")
+    assert routes[rid] == other
+    out = chaos.frontend.sql_one("SELECT count(*) AS c FROM t10")
+    assert out["c"].to_pylist() == [3]
+    rendered = metrics.REGISTRY.render()
+    assert "greptime_procedure_step_retries_total" in rendered
+
+
+@pytest.mark.chaos
+def test_failover_promotes_follower_and_region_stays_writable(chaos):
+    """Failover prefers promoting an existing follower (it already has the
+    region open over the shared storage) — and the promotion must flip the
+    follower's read-only open to writable, or the 'new leader' would
+    reject every INSERT."""
+    meta, rid, owner = _setup_table(chaos, "t13")
+    other = next(n for n in chaos.datanodes if n != owner)
+    client = MetaClient([chaos.server.address])
+    client.add_follower(meta.table_id, rid, other)
+
+    chaos.datanodes[owner].kill()
+    chaos.fail_over_dead_node()
+    _meta, routes = chaos.route_of("t13")
+    assert routes[rid] == other  # the follower was promoted, not a cold node
+    # promotion removed it from the follower set (it IS the leader now)
+    assert client.get_followers(meta.table_id) == {}
+    # the promoted region accepts writes: the read-only follower open was
+    # flipped writable during open_candidate
+    n = chaos.frontend.sql_one("INSERT INTO t13 VALUES ('d', 4000, 4.0)")
+    assert n == 1
+    out = chaos.frontend.sql_one("SELECT count(*) AS c FROM t13")
+    assert out["c"].to_pylist() == [4]
+
+
+@pytest.mark.chaos
+def test_migration_onto_follower_promotes_writable(chaos):
+    """Planned migration onto a node that already holds the region as a
+    read-only follower must flip it writable (same promotion contract as
+    failover) — and drop it from the follower set."""
+    meta, rid, owner = _setup_table(chaos, "t14")
+    other = next(n for n in chaos.datanodes if n != owner)
+    client = MetaClient([chaos.server.address])
+    client.add_follower(meta.table_id, rid, other)
+    chaos.metasrv.migrate_region(meta.table_id, rid, other)
+    _meta, routes = chaos.route_of("t14")
+    assert routes[rid] == other
+    assert client.get_followers(meta.table_id) == {}
+    n = chaos.frontend.sql_one("INSERT INTO t14 VALUES ('d', 4000, 4.0)")
+    assert n == 1
+    out = chaos.frontend.sql_one("SELECT count(*) AS c FROM t14")
+    assert out["c"].to_pylist() == [4]
+
+
+@pytest.mark.chaos
+def test_flight_error_classification_transport_vs_application(chaos):
+    """Transport failures (node unreachable) become ConnectionError
+    (transient, retried); REGION-STATE errors a retry genuinely fixes
+    (read-only mid-migration, not-found after a route move) cross the
+    wire as FlightUnavailableError (transient); everything else stays a
+    FlightServerError that the classifier refuses to retry — a permanent
+    error must not burn the retry budget and surface as RETRY_LATER."""
+    from tests.test_flight import cpu_schema, make_batch
+
+    meta, rid, owner = _setup_table(chaos, "t15")
+    dn = chaos.datanodes[owner]
+    batch = make_batch(cpu_schema(), ["z"], [9000], [9.0])
+    # read-only region: retryable by contract (downgraded mid-migration)
+    dn.client.set_region_writable(rid, False)
+    with pytest.raises(ConnectionError) as ei:
+        dn.client.write(rid, batch)
+    assert is_transient(ei.value)
+    dn.client.set_region_writable(rid, True)
+    # missing region: retryable by contract (route moved, owner closed it)
+    with pytest.raises(ConnectionError) as ei:
+        dn.client.scan(99999, __import__(
+            "greptimedb_tpu.storage.sst", fromlist=["ScanPredicate"]
+        ).ScanPredicate())
+    assert is_transient(ei.value)
+    # application error (unknown action): must NOT be dressed as transient
+    with pytest.raises(fl.FlightError) as ei:
+        dn.client._action("definitely_not_an_action", {})
+    assert not isinstance(ei.value, ConnectionError)
+    assert not is_transient(ei.value)
+
+
+# ---- flownode mirroring is best-effort -------------------------------------
+
+
+@pytest.mark.chaos
+def test_flow_mirror_is_best_effort_and_retries_in_background(chaos, tmp_path):
+    """A mirror delivery failure NEVER fails the user's write: the batch is
+    retried in the background and eventually reaches the flownode."""
+    import threading
+
+    from greptimedb_tpu.database import Database
+    from greptimedb_tpu.distributed.flownode import FlownodeFlightServer
+
+    _setup_table(chaos, "t11")
+    fdb = Database(data_home=str(tmp_path / "flowdb"))
+    server = FlownodeFlightServer(fdb)
+    t = threading.Thread(target=server.serve, daemon=True)
+    t.start()
+    try:
+        seen = []
+        orig = fdb.flows.mirror_insert
+
+        def spying_mirror(table, database, batch):
+            seen.append((table, batch.num_rows))
+            return orig(table, database, batch)
+
+        fdb.flows.mirror_insert = spying_mirror
+        # flownodes register through role-tagged heartbeats (metasrv
+        # address discovery); bust the frontend's discovery TTL cache so
+        # the next write sees it immediately
+        chaos.metasrv.handle_heartbeat(
+            97, [], chaos.now[0], role="flownode",
+            addr=server.location.removeprefix("grpc://"),
+        )
+        chaos.frontend.mirror._addr_cache = (0.0, {})
+        plan = fi.REGISTRY.arm("flow.mirror", fail_times=1, error=ConnectionError)
+        n = chaos.frontend.sql_one("INSERT INTO t11 VALUES ('d', 4000, 4.0)")
+        assert n == 1  # the write returned before/regardless of the mirror
+        assert chaos.frontend.mirror.drain(10.0)
+        assert plan.trips == 1  # first delivery hit the injected fault
+        assert seen and seen[-1] == ("t11", 1)  # background retry delivered
+        out = chaos.frontend.sql_one("SELECT count(*) AS c FROM t11")
+        assert out["c"].to_pylist() == [4]
+    finally:
+        server.shutdown()
+        fdb.close()
 
 
 @pytest.mark.chaos

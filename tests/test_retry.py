@@ -1,5 +1,6 @@
-"""Unit tests for the unified retry policy (utils/retry.py) and the
-fault-injection registry (utils/fault_injection.py)."""
+"""Unit tests for the unified retry policy (utils/retry.py), the
+fault-injection registry (utils/fault_injection.py), the per-peer circuit
+breaker (utils/circuit_breaker.py), and the new robustness config knobs."""
 
 import time
 
@@ -7,8 +8,22 @@ import pyarrow.flight as fl
 import pytest
 
 from greptimedb_tpu.utils import fault_injection as fi
+from greptimedb_tpu.utils.circuit_breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerBoard,
+    CircuitBreaker,
+    CircuitOpenError,
+    LatencyTracker,
+)
+from greptimedb_tpu.utils.config import Config
 from greptimedb_tpu.utils.deadline import deadline_scope
-from greptimedb_tpu.utils.errors import QueryTimeoutError, RetryLaterError
+from greptimedb_tpu.utils.errors import (
+    ConfigError,
+    QueryTimeoutError,
+    RetryLaterError,
+)
 from greptimedb_tpu.utils.retry import (
     RetryPolicy,
     is_transient,
@@ -212,6 +227,199 @@ def test_armed_scope_disarms_on_exit():
             fi.fire("store.write")
     fi.fire("store.write")  # disarmed: no-op
     assert fi._ARMED is False
+
+
+# ---- CircuitBreaker --------------------------------------------------------
+
+
+def _breaker(clk, **kw):
+    kw.setdefault("window", 4)
+    kw.setdefault("min_calls", 2)
+    kw.setdefault("failure_rate", 0.5)
+    kw.setdefault("open_cooldown_s", 10.0)
+    kw.setdefault("half_open_probes", 1)
+    return CircuitBreaker(name="test-node", clock=lambda: clk[0], **kw)
+
+
+def test_breaker_trips_at_failure_rate():
+    clk = [0.0]
+    b = _breaker(clk)
+    assert b.state == CLOSED and b.allow()
+    b.record_failure()
+    assert b.state == CLOSED  # min_calls not reached: one blip never trips
+    b.record_failure()
+    assert b.state == OPEN and b.trips == 1
+    assert not b.allow()  # sheds while open
+    with pytest.raises(CircuitOpenError):
+        b.check()
+
+
+def test_breaker_successes_keep_it_closed():
+    clk = [0.0]
+    b = _breaker(clk, window=4, min_calls=2, failure_rate=0.75)
+    # 1 failure in a window of 4 recent calls = 25% < 75%: stays closed
+    for _ in range(3):
+        b.record_success()
+    b.record_failure()
+    assert b.state == CLOSED and b.allow()
+
+
+def test_breaker_half_open_probe_restores():
+    clk = [0.0]
+    b = _breaker(clk, open_cooldown_s=5.0)
+    b.record_failure()
+    b.record_failure()
+    assert b.state == OPEN
+    clk[0] += 4.9
+    assert not b.allow()  # cooldown not elapsed
+    clk[0] += 0.2
+    assert b.allow()  # first call past cooldown is the probe
+    assert b.state == HALF_OPEN
+    assert not b.allow()  # probe budget (1) spent: others still shed
+    b.record_success()
+    assert b.state == CLOSED and b.allow()  # probe succeeded: reset
+
+
+def test_breaker_failed_probe_reopens_with_fresh_cooldown():
+    clk = [0.0]
+    b = _breaker(clk, open_cooldown_s=5.0)
+    b.record_failure()
+    b.record_failure()
+    clk[0] += 6.0
+    assert b.allow() and b.state == HALF_OPEN
+    b.record_failure()  # the node is still sick
+    assert b.state == OPEN and b.trips == 2
+    assert not b.allow()  # fresh cooldown started at the failed probe
+    clk[0] += 6.0
+    assert b.allow() and b.state == HALF_OPEN
+
+
+def test_breaker_window_reset_after_close():
+    """Reset on close: pre-trip history must not poison the fresh window."""
+    clk = [0.0]
+    b = _breaker(clk, open_cooldown_s=1.0)
+    b.record_failure()
+    b.record_failure()
+    clk[0] += 2.0
+    assert b.allow()
+    b.record_success()  # closed again
+    b.record_failure()  # 1 failure in a FRESH window: below min_calls
+    assert b.state == CLOSED
+
+
+def test_breaker_would_allow_is_non_consuming():
+    """would_allow() must never spend a half-open probe slot: a pre-flight
+    peek (hedge target selection) followed by the consuming allow() at the
+    call site counts as ONE probe, not two."""
+    clk = [0.0]
+    b = _breaker(clk, open_cooldown_s=5.0, half_open_probes=1)
+    assert b.would_allow()
+    b.record_failure()
+    b.record_failure()
+    assert not b.would_allow()  # open, cooling down
+    clk[0] += 6.0
+    for _ in range(3):
+        assert b.would_allow()  # peeking repeatedly consumes nothing
+    assert b.allow() and b.state == HALF_OPEN  # the probe slot is intact
+    assert not b.allow()
+
+
+def test_breaker_release_probe_returns_the_slot():
+    """A probe call that dies with NO verdict on the node (non-transient
+    error) must return its slot, or the breaker sheds forever."""
+    clk = [0.0]
+    b = _breaker(clk, open_cooldown_s=5.0, half_open_probes=1)
+    b.record_failure()
+    b.record_failure()
+    clk[0] += 6.0
+    assert b.allow()  # probe slot spent
+    assert not b.allow()
+    b.release_probe()  # the call produced no outcome
+    assert b.allow()  # the slot is available again
+    b.record_success()
+    assert b.state == CLOSED
+
+
+def test_circuit_open_error_is_transient():
+    """An open circuit must keep the RETRY_LATER contract: retry loops
+    re-route around it, the SQL surface maps it to status 2001."""
+    assert is_transient(CircuitOpenError("shed"))
+    assert isinstance(CircuitOpenError("shed"), RetryLaterError)
+
+
+def test_breaker_board_is_lazy_and_caches():
+    made = []
+
+    def factory(key):
+        if key == "disabled":
+            return None
+        made.append(key)
+        return CircuitBreaker(name=str(key))
+
+    board = BreakerBoard(factory)
+    assert board.get("disabled") is None
+    b1 = board.get(7)
+    assert board.get(7) is b1  # cached
+    assert made == [7]
+    assert board.states() == {7: CLOSED}
+
+
+def test_latency_tracker_needs_min_samples():
+    t = LatencyTracker(window=32, min_samples=4)
+    for v in (0.1, 0.2, 0.3):
+        t.record(v)
+    assert t.percentile(0.95) is None  # too few samples to call it a p95
+    t.record(0.4)
+    assert t.percentile(0.95) == pytest.approx(0.4)
+    assert t.percentile(0.5) == pytest.approx(0.3)
+
+
+# ---- config validation -----------------------------------------------------
+
+
+def test_config_defaults_validate_and_are_off_safe():
+    c = Config()
+    assert c.breaker.enable is False
+    assert c.replica.read_followers is False
+    assert c.query.hedge_delay_ms == 0.0  # hedging off by default
+
+
+@pytest.mark.parametrize(
+    "mutate, match",
+    [
+        (lambda c: setattr(c.query, "hedge_delay_ms", -1.0), "hedge_delay_ms"),
+        (lambda c: setattr(c.query, "hedge_percentile", 1.5), "hedge_percentile"),
+        (lambda c: setattr(c.breaker, "window", 0), "breaker.window"),
+        (lambda c: setattr(c.breaker, "min_calls", 0), "breaker.min_calls"),
+        (lambda c: setattr(c.breaker, "min_calls", 99), "cannot exceed"),
+        (lambda c: setattr(c.breaker, "failure_rate", 0.0), "failure_rate"),
+        (lambda c: setattr(c.breaker, "failure_rate", 1.5), "failure_rate"),
+        (lambda c: setattr(c.breaker, "open_cooldown_s", 0.0), "open_cooldown_s"),
+        (lambda c: setattr(c.breaker, "half_open_probes", 0), "half_open_probes"),
+    ],
+)
+def test_config_rejects_bad_robustness_knobs(mutate, match):
+    c = Config()
+    mutate(c)
+    with pytest.raises(ConfigError, match=match):
+        c.validate()
+
+
+def test_config_env_overlay_reaches_new_sections():
+    c = Config.load(env={
+        "GREPTIMEDB_TPU__BREAKER__ENABLE": "true",
+        "GREPTIMEDB_TPU__BREAKER__WINDOW": "8",
+        "GREPTIMEDB_TPU__REPLICA__READ_FOLLOWERS": "1",
+        "GREPTIMEDB_TPU__QUERY__HEDGE_DELAY_MS": "25",
+    })
+    assert c.breaker.enable is True and c.breaker.window == 8
+    assert c.replica.read_followers is True
+    assert c.query.hedge_delay_ms == 25.0
+
+
+def test_config_load_rejects_bad_env_values():
+    with pytest.raises(ConfigError, match="failure_rate"):
+        Config.load(env={"GREPTIMEDB_TPU__BREAKER__FAILURE_RATE": "2.0"})
 
 
 def test_armed_scope_leaves_stacked_plans_armed():
