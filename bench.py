@@ -73,12 +73,25 @@ WARM_REPS = int(os.environ.get("GRAFT_BENCH_REPS", 5))
 BUDGET_S = float(os.environ.get("GRAFT_BENCH_BUDGET_S", 3000))
 PARTIAL_PATH = os.environ.get("GRAFT_BENCH_PARTIAL", "BENCH_PARTIAL.json")
 HTTP_INGEST_ROWS = int(os.environ.get("GRAFT_BENCH_HTTP_ROWS", 400_000))
+# GRAFT_BENCH_PREWARM=1 (default): after flush, Database.prewarm() builds
+# the super-tiles + limb planes OFF the query path, so per-query "cold"
+# stops paying 10-170 s of consolidation and the whole suite fits the
+# wall budget (the rc=0 mandate).  =0 restores first-query cold builds.
+PREWARM = os.environ.get("GRAFT_BENCH_PREWARM", "1") != "0"
 # larger-than-HBM probe: >=2^28 rows, region-streamed (see
 # _larger_than_hbm_probe).  Starts only when the TSBS suite finished with
 # wall clock to spare; every stage runs under query deadlines so the
 # worst case stays bounded.
 LTH_ROWS = int(os.environ.get("GRAFT_BENCH_LTH_ROWS", 1 << 28))
-LTH_START_MAX_S = float(os.environ.get("GRAFT_BENCH_LTH_START_MAX_S", 3300))
+# the probe must START early enough that its bounded stages still finish
+# inside the wall budget (round-5 default of 3300 s sat PAST the 3000 s
+# budget — the probe began after the budget and the driver's timeout won)
+LTH_START_MAX_S = float(
+    os.environ.get("GRAFT_BENCH_LTH_START_MAX_S", BUDGET_S * 0.55)
+)
+# hard rc=0 guarantee: a watchdog emits the final summary line and exits 0
+# this many seconds BEFORE the budget, whatever is still running
+WATCHDOG_GRACE_S = float(os.environ.get("GRAFT_BENCH_WATCHDOG_GRACE_S", 45))
 
 END = T0 + HOURS * 3600_000
 W12 = (END - 12 * 3600_000, END)
@@ -170,13 +183,32 @@ def _write_partial(payload: dict):
 # state shared with the final-summary emitter so a signal handler (or an
 # escaping exception) can still print the one-line record
 _STATE: dict = {"detail": {}, "results": {}, "headline": None, "emitted": False}
+import threading as _threading
+
+# RLock, not Lock: the SIGTERM handler runs ON the main thread — if the
+# main thread is mid-emit when the signal lands, a plain Lock would
+# self-deadlock the handler (and then the watchdog), reproducing the
+# exact hang this machinery exists to prevent
+_EMIT_LOCK = _threading.RLock()
 
 
 def _emit_final():
-    if _STATE["emitted"]:
-        return
-    _STATE["emitted"] = True
-    detail, results = _STATE["detail"], _STATE["results"]
+    # the budget watchdog thread and the main thread can race here: the
+    # record must be exactly ONE line, and the watchdog's os._exit must
+    # not truncate a line the main thread is mid-writing — so the WHOLE
+    # emission holds the lock (a racing caller blocks, then no-ops)
+    with _EMIT_LOCK:
+        if _STATE["emitted"]:
+            return
+        _STATE["emitted"] = True
+        _emit_final_locked()
+
+
+def _emit_final_locked():
+    # shallow snapshots: the watchdog can emit while the main thread is
+    # still inserting per-query entries — iterating the live dicts could
+    # tear mid-json.dumps
+    detail, results = dict(_STATE["detail"]), dict(_STATE["results"])
     ok = {k: v for k, v in results.items() if "vs_baseline" in v}
     if ok:
         try:
@@ -226,6 +258,45 @@ for _sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(_sig, _on_term)
     except (ValueError, OSError):
         pass
+
+
+def _start_budget_watchdog():
+    """rc=0 within GRAFT_BENCH_BUDGET_S, unconditionally: whatever phase
+    is still running (a stuck query, a probe, even XLA compile), the
+    watchdog emits the one-line summary with everything that finished and
+    exits 0 before the driver's external timeout can produce rc=124
+    (rounds 2-5 all timed out; the official record stayed unparsed)."""
+    import threading
+
+    def run():
+        while True:
+            left = BUDGET_S - WATCHDOG_GRACE_S - _elapsed()
+            if left <= 0:
+                break
+            time.sleep(min(left, 5.0))
+        if _STATE["emitted"]:
+            return
+        _STATE["detail"]["budget_watchdog_fired"] = True
+        try:
+            _emit_final()
+        except BaseException:  # noqa: BLE001 — the main thread mutates
+            # results/detail concurrently; a torn iteration must not kill
+            # the watchdog before it can exit 0 with SOME parseable line
+            try:
+                _emit({
+                    "metric": "tsbs_double_groupby_1_e2e_warm_p50",
+                    "value": None, "unit": "ms", "vs_baseline": None,
+                    "detail": {"budget_watchdog_fired": True,
+                               "emit_error": True},
+                })
+            except BaseException:  # noqa: BLE001
+                pass
+        try:
+            sys.stdout.flush()
+        finally:
+            os._exit(0)
+
+    threading.Thread(target=run, name="bench-budget-watchdog", daemon=True).start()
 
 
 def _probe_link(jax, jnp) -> dict:
@@ -366,7 +437,9 @@ def _larger_than_hbm_probe() -> dict:
             np.add.at(gt_sum, hidx, vals["m0"])
             np.add.at(gt_cnt, hidx, 1)
             done += n
-            if _elapsed() > BUDGET_S + 900:
+            if _elapsed() > BUDGET_S - 300:
+                # the probe's queries + the summary must still fit INSIDE
+                # the wall budget (the rc=0 contract) — stop ingesting
                 out["ingest_aborted_at_rows"] = done
                 return out
         db.storage.flush_all()
@@ -380,8 +453,12 @@ def _larger_than_hbm_probe() -> dict:
         sql = (f"SELECT hostname, count(*) AS c, {agg} FROM big"
                f" GROUP BY hostname ORDER BY hostname")
         stream0 = m.TILE_STREAM_QUERIES.get()
+
+        def probe_timeout(ceiling: float) -> float:
+            return max(min(ceiling, BUDGET_S - WATCHDOG_GRACE_S - _elapsed() - 20), 20.0)
+
         try:
-            db.config.query.timeout_s = 900.0
+            db.config.query.timeout_s = probe_timeout(900.0)
             t0 = time.perf_counter()
             table = db.sql_one(sql)
             out["cold_ms"] = round((time.perf_counter() - t0) * 1000, 1)
@@ -404,7 +481,7 @@ def _larger_than_hbm_probe() -> dict:
                 out["resident_mb_after"] = cache._used >> 20
             # one warm rep: planes re-stream (they were released), host
             # consolidation + dictionary cached
-            db.config.query.timeout_s = 600.0
+            db.config.query.timeout_s = probe_timeout(600.0)
             t0 = time.perf_counter()
             table = db.sql_one(sql)
             out["warm_ms"] = round((time.perf_counter() - t0) * 1000, 1)
@@ -446,6 +523,7 @@ def _larger_than_hbm_probe() -> dict:
 
 def main():
     ensure_x64()
+    _start_budget_watchdog()
     import tempfile
 
     import jax
@@ -532,6 +610,26 @@ def main():
            "elapsed_s": round(_elapsed(), 1)})
     _write_partial({"detail": detail, "queries": results})
 
+    # ---- prewarm phase (cold path off the query path) ----------------------
+    if PREWARM and _elapsed() < BUDGET_S * 0.6:
+        try:
+            pw0 = m.PREWARM_BUILDS.get()
+            t0 = time.perf_counter()
+            db.config.query.timeout_s = max(
+                min(600.0, BUDGET_S * 0.6 - _elapsed()), 30.0
+            )
+            try:
+                db.prewarm(tables=["cpu"])
+            finally:
+                db.config.query.timeout_s = 0.0
+            detail["prewarm_s"] = round(time.perf_counter() - t0, 1)
+            detail["prewarm_builds"] = m.PREWARM_BUILDS.get() - pw0
+            _emit({"event": "prewarm", "secs": detail["prewarm_s"],
+                   "regions_built": detail["prewarm_builds"],
+                   "elapsed_s": round(_elapsed(), 1)})
+        except Exception as e:  # noqa: BLE001 — prewarm must never kill the bench
+            detail["prewarm_error"] = repr(e)
+
     # ---- honest protocol-path ingest probe ---------------------------------
     if HTTP_INGEST_ROWS > 0 and _elapsed() < BUDGET_S:
         try:
@@ -566,7 +664,6 @@ def main():
         table = None
         err = None
         try:
-            rb0 = (m.TILE_READBACK_MS.sum(), m.TILE_READBACK_MS.total())
             # HARD per-query watchdog (round-4 driver lesson): cold pays
             # consolidation/upload/compile, so it gets the wide ceiling;
             # warm reps must be cache hits, so a rep that degrades to a
@@ -593,6 +690,16 @@ def main():
                 # rep commits partial planes; the timed reps finish them
                 entry_build_ms = None
                 build_err = repr(be)
+            # readback accounting over the TIMED reps only (cold/build
+            # fetches would poison the warm number), recorded for EVERY
+            # query — readback_bytes is the honest O(rows_out) evidence;
+            # readback_ms conflates transfer with waiting out the async
+            # dispatch (device_get blocks on compute)
+            rb0 = (
+                m.TPU_READBACK_MS.sum(), m.TPU_READBACK_MS.total(),
+                m.TPU_READBACK_BYTES.get(),
+            )
+            cc0 = m.TPU_COMPILE_CACHE_MISSES.get()
             rep_errs = 0
             for _rep in range(WARM_REPS):
                 if _elapsed() > BUDGET_S and walls:
@@ -629,7 +736,10 @@ def main():
             entry["build_error"] = build_err
         if walls:
             warm_ms = float(np.median(walls))
-            rb1 = (m.TILE_READBACK_MS.sum(), m.TILE_READBACK_MS.total())
+            rb1 = (
+                m.TPU_READBACK_MS.sum(), m.TPU_READBACK_MS.total(),
+                m.TPU_READBACK_BYTES.get(),
+            )
             n_rb = rb1[1] - rb0[1]
             ratio = ref_ms / warm_ms
             entry.update(
@@ -639,9 +749,14 @@ def main():
                 vs_baseline=round(ratio, 2 if ratio >= 0.05 else 4),
                 rows_out=table.num_rows,
                 warm_reps_done=len(walls),
+                # uniform for EVERY query (0 = served without a device
+                # fetch: host fast path / cold serve / CPU route)
+                device_fetches=int(n_rb),
+                readback_ms_avg=round((rb1[0] - rb0[0]) / n_rb, 2) if n_rb else 0.0,
+                readback_bytes_avg=round((rb1[2] - rb0[2]) / n_rb) if n_rb else 0,
+                # a warm rep that re-traces is a cache bug: make it visible
+                compile_misses_warm=int(m.TPU_COMPILE_CACHE_MISSES.get() - cc0),
             )
-            if n_rb:
-                entry["readback_ms_avg"] = round((rb1[0] - rb0[0]) / n_rb, 1)
         if err is not None:
             if walls:
                 # reps that landed define the result; the stray failure
@@ -699,7 +814,11 @@ def main():
         try:
             out = subprocess.run(
                 [sys.executable, "-c", code, home, probe_sql],
-                capture_output=True, text=True, timeout=600,
+                capture_output=True, text=True,
+                timeout=max(
+                    min(600.0, BUDGET_S - WATCHDOG_GRACE_S - _elapsed() - 20),
+                    30.0,
+                ),
                 env={**os.environ, "PYTHONUNBUFFERED": "1"},
             )
             for line in out.stdout.splitlines():
@@ -736,6 +855,12 @@ def main():
         db.query_engine.tile_cache.stats() if db.query_engine.tile_cache else {}
     )
     detail["budget_exhausted"] = budget_hit
+    detail["tpu_compile_cache"] = {
+        "hits": m.TPU_COMPILE_CACHE_HITS.get(),
+        "misses": m.TPU_COMPILE_CACHE_MISSES.get(),
+    }
+    detail["device_finalized_queries"] = m.TPU_DEVICE_FINALIZE.get()
+    detail["readback_bytes_total"] = m.TPU_READBACK_BYTES.get()
     detail["method"] = (
         "end-to-end Database.sql() wall time over real flushed Parquet SSTs: "
         "parse+plan+lowering+ONE dispatch+ONE device fetch+finalize. Warm = "

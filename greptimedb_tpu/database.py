@@ -174,6 +174,9 @@ class Database:
         self._plan_cache_lock = threading.Lock()
         self.telemetry = TelemetryTask(self, self.config.telemetry).start()
         self._reopen_regions()
+        self._prewarm_thread = None
+        if getattr(self.config, "tile", None) is not None and self.config.tile.prewarm_on_flush:
+            self._start_flush_prewarmer()
 
     # ---- session state (reference session QueryContext) -------------------
     # Stored in a contextvar holding MUTABLE per-connection state, not a
@@ -253,6 +256,11 @@ class Database:
             raise InvalidArgumentsError(f"unknown time zone: {tz!r}") from exc
 
     def close(self):
+        if getattr(self, "_prewarm_thread", None) is not None:
+            with self._prewarm_cv:
+                self._prewarm_stop = True
+                self._prewarm_cv.notify()
+            self._prewarm_thread.join(timeout=5.0)
         self.telemetry.stop()
         self.event_recorder.stop()
         self.flows.stop()
@@ -1076,6 +1084,122 @@ class Database:
             regions=regions,
             append_mode=any(r.append_mode for r in regions),
         )
+
+    # ---- tile prewarm (cold path off the query path) ----------------------
+    def prewarm(self, tables=None, database: str | None = None) -> dict:
+        """Build HBM super-tiles for flushed data OFF the query path: host
+        consolidation (Parquet decode + dictionary encode + (pk, ts)
+        lexsort), device plane uploads and MXU limb quantization — the
+        10-170 s the FIRST query of each TSBS family otherwise pays.
+        Explicit form of `tile.prewarm_on_flush`; returns per-table build
+        stats.  `tables` restricts to the named tables (bare or
+        db-qualified); best-effort throughout."""
+        from .models import information_schema as info
+
+        te = self.query_engine._tile_executor
+        if te is None:
+            return {}
+        out: dict = {}
+        dbs = [database] if database else self.catalog.databases()
+        want = set(tables) if tables else None
+        cfg_tables = set(getattr(self.config.tile, "prewarm_tables", ()) or ())
+        for db in dbs:
+            if info.is_information_schema(db):
+                continue
+            for meta in self.catalog.tables(db):
+                key = f"{db}.{meta.name}"
+                if want is not None and meta.name not in want and key not in want:
+                    continue
+                if cfg_tables and meta.name not in cfg_tables and key not in cfg_tables:
+                    continue
+                ctx = self._tile_context(TableScan(table=meta.name, database=db))
+                if ctx is None:
+                    continue
+                try:
+                    from .utils.deadline import deadline_scope
+
+                    schema = self._schema_of(meta.name, db)
+                    # arm the per-statement deadline ourselves: sql() does
+                    # this for queries, but prewarm is not a statement —
+                    # without it query.timeout_s would be advisory here
+                    # and a huge consolidation could run unbounded
+                    with deadline_scope(self.config.query.timeout_s):
+                        out[key] = te.prewarm(
+                            ctx, schema,
+                            limbs=getattr(self.config.tile, "prewarm_limbs", True),
+                        )
+                except Exception as e:  # noqa: BLE001 — prewarm never fails callers
+                    out[key] = {"error": repr(e)}
+        return out
+
+    def _start_flush_prewarmer(self):
+        """tile.prewarm_on_flush: coalesce flush notifications per table
+        and rebuild its super-tiles on a background thread once the storm
+        settles (tile.prewarm_debounce_s after the LAST flush)."""
+        import time as _t
+
+        from .models.catalog import MAX_REGIONS_PER_TABLE
+
+        self._prewarm_pending: dict[str, float] = {}
+        self._prewarm_cv = threading.Condition()
+        self._prewarm_stop = False
+        # table_id -> "db.table" memo so a flush storm doesn't pay an
+        # O(all tables) catalog scan per flush; a stale entry (rename/
+        # drop) just prewarms a missing table, which no-ops
+        tid_cache: dict[int, str] = {}
+
+        def resolve(tid: int) -> str | None:
+            key = tid_cache.get(tid)
+            if key is not None:
+                return key
+            for db in self.catalog.databases():
+                for meta in self.catalog.tables(db):
+                    if meta.table_id == tid:
+                        tid_cache[tid] = f"{db}.{meta.name}"
+                        return tid_cache[tid]
+            return None
+
+        def on_flush(region_id: int):
+            key = resolve(region_id // MAX_REGIONS_PER_TABLE)
+            if key is None:
+                return
+            with self._prewarm_cv:
+                self._prewarm_pending[key] = _t.monotonic()
+                self._prewarm_cv.notify()
+
+        def loop():
+            import time as _t
+
+            debounce = max(self.config.tile.prewarm_debounce_s, 0.0)
+            while True:
+                with self._prewarm_cv:
+                    while not self._prewarm_pending and not self._prewarm_stop:
+                        self._prewarm_cv.wait(timeout=1.0)
+                    if self._prewarm_stop:
+                        return
+                    now = _t.monotonic()
+                    due = [
+                        k
+                        for k, t in self._prewarm_pending.items()
+                        if now - t >= debounce
+                    ]
+                    if not due:
+                        self._prewarm_cv.wait(timeout=max(debounce / 4, 0.05))
+                        continue
+                    for k in due:
+                        self._prewarm_pending.pop(k, None)
+                for key in due:
+                    db, _, name = key.partition(".")
+                    try:
+                        self.prewarm(tables=[name], database=db)
+                    except Exception:  # noqa: BLE001 — background, advisory
+                        pass
+
+        self._prewarm_thread = threading.Thread(
+            target=loop, name="tile-prewarm", daemon=True
+        )
+        self._prewarm_thread.start()
+        self.storage.flush_listeners.append(on_flush)
 
     def _vector_search(self, vs) -> pa.Table:
         """Top-k nearest rows for a VectorSearch node.

@@ -124,6 +124,17 @@ class Frontend:
         # (round-1 built a fresh ThreadPoolExecutor per _fanout call)
         self._pool = None
         self._pool_lock = threading.Lock()
+        # shared timer wheel arming EVERY region's hedge at fan-out submit
+        # (not as the sequential settle loop reaches it) — the ROADMAP
+        # "fully concurrent hedge scheduling" item.  Constructed eagerly
+        # (the wheel's own thread starts lazily on first schedule) so
+        # concurrent first fan-outs cannot race a lazy init into two
+        # wheels, one of which close() would never stop.
+        from ..utils.timer_wheel import TimerWheel
+
+        self._hedge_wheel = TimerWheel(
+            name=f"frontend{node_id}-hedge-wheel"
+        )
         self.query_engine = QueryEngine(
             schema_provider=lambda t, d: self._table(t, d).schema,
             scan_provider=self._scan,
@@ -685,95 +696,108 @@ class Frontend:
             node, lambda: fn(self._client(node), rid), record_latency=True
         )
 
-    def _submit_hedge(self, pool, flist: list[int], rid: int, fn):
+    def _submit_hedge(self, pool, flist: list[int], rid: int, hedge_fn):
         """Pick the first follower whose breaker would admit a call (a
         non-consuming peek — the consuming gate runs in `_guarded_call`
-        inside the worker); (None, None) when every follower is shedding."""
+        inside the worker); (None, None) when every follower is shedding.
+        `hedge_fn` is the deadline-propagated hedge thunk pre-wrapped on
+        the fan-out thread (the wheel thread has no deadline context)."""
         for node in flist:
             br = self._breaker(node)
             if br is not None and not br.would_allow():
                 continue
             metrics.HEDGE_REQUESTS_TOTAL.inc()
-            return node, pool.submit(propagate(self._hedge_call), node, rid, fn)
+            return node, pool.submit(hedge_fn, node, rid)
         return None, None
 
-    def _settle_region(
-        self, rid: int, fut, meta, fn, flist, hedge_delay, deadline, pool,
-        hedges, t0,
+    def _arm_hedge(
+        self, pool, rid: int, fut, flist, hedge_delay, deadline, hedges,
+        queues, hedge_fn,
     ):
-        """Wait for region `rid`'s primary sub-request; once it has been
-        outstanding `hedge_delay` (measured from the FAN-OUT submit time
-        `t0`, so regions settled later in the gather hedge on schedule, not
-        a fresh delay each), duplicate it to a follower and take whichever
-        answers first (reference: hedged requests over MergeScan fan-out;
-        The Tail at Scale).  Raises QueryTimeoutError when the deadline
-        expires with nothing settled."""
-        from concurrent.futures import FIRST_COMPLETED
-        from concurrent.futures import wait as _futures_wait
+        """Arm region `rid`'s hedge on the shared timer wheel at FAN-OUT
+        SUBMIT time: every region's hedge fires at t0 + hedge_delay
+        concurrently, regardless of where the sequential settle loop is
+        (previously a slow early region delayed every later region's
+        hedge past its schedule).  The callback runs on the wheel thread:
+        cheap checks + one pool submit."""
+
+        def arm():
+            if fut.done():
+                return  # primary already answered (or failed): no hedge
+            if deadline is not None and _time.monotonic() >= deadline:
+                return  # a dead query must not dispatch duplicate reads
+            node, hedge = self._submit_hedge(pool, flist, rid, hedge_fn)
+            if hedge is not None:
+                hedges[rid] = (node, hedge)
+                hedge.add_done_callback(queues[rid].put)
+
+        return self._hedge_wheel.schedule(hedge_delay, arm)
+
+    def _settle_region(self, rid: int, fut, meta, q, timer, hedges, deadline):
+        """Wait for region `rid`'s primary sub-request (and its hedge, if
+        the wheel armed one — first response wins; reference: hedged
+        requests over MergeScan fan-out; The Tail at Scale).  Completions
+        arrive on the region's queue via future done-callbacks, so a
+        hedge armed while this loop is blocked wakes it naturally.
+        Raises QueryTimeoutError when the deadline expires with nothing
+        settled."""
+        import queue as _queue
 
         def remaining():
             return max(deadline - _time.monotonic(), 0.0) if deadline is not None else None
 
-        waiting = {fut}
-        hedge = None
-        hedge_considered = not flist or hedge_delay is None
         errors: list[Exception] = []
+        primary_done = False
+        hedge_done = False
         while True:
             if deadline is not None and remaining() <= 0.0:
                 raise QueryTimeoutError(
                     f"distributed fan-out for {meta.name!r} exceeded "
                     f"the query deadline; region {rid} still pending"
                 )
-            if not hedge_considered:
-                due = max(0.0, hedge_delay - (_time.monotonic() - t0))
-                timeout = due if deadline is None else min(due, remaining())
-            else:
-                timeout = remaining()
-            done, _pending = _futures_wait(
-                waiting, timeout=timeout, return_when=FIRST_COMPLETED
-            )
-            if not done:
-                if not hedge_considered:
-                    hedge_considered = True
-                    # fire the hedge only if it was the HEDGE timer that
-                    # elapsed — a deadline-bounded wait expiring must not
-                    # dispatch a duplicate read just to abandon it
-                    if (
-                        _time.monotonic() - t0 >= hedge_delay
-                        and (deadline is None or remaining() > 0.0)
-                    ):
-                        hedge_node, hedge = self._submit_hedge(pool, flist, rid, fn)
-                        if hedge is not None:
-                            hedges[rid] = (hedge_node, hedge)
-                            waiting.add(hedge)
-                    continue
+            try:
+                f = q.get(timeout=remaining())
+            except _queue.Empty:
                 raise QueryTimeoutError(
                     f"distributed fan-out for {meta.name!r} exceeded "
                     f"the query deadline; region {rid} still pending"
-                )
-            for f in done:
-                waiting.discard(f)
-                try:
-                    value = f.result()
-                except QueryTimeoutError:
-                    raise
-                except Exception as exc:  # noqa: BLE001 — maybe the twin wins
-                    # the PRIMARY's error first: the hedge is a single
-                    # best-effort attempt against a possibly-stale follower
-                    # (its failure must not mask/reclassify the region's
-                    # real outcome when both sides fail)
-                    if f is hedge:
-                        errors.append(exc)
-                    else:
-                        errors.insert(0, exc)
-                    continue
-                if f is hedge:
-                    metrics.HEDGE_WINS_TOTAL.inc()
-                return value
-            if not waiting:
+                ) from None
+            entry = hedges.get(rid)
+            hedge_fut = entry[1] if entry is not None else None
+            is_hedge = hedge_fut is not None and f is hedge_fut
+            if is_hedge:
+                hedge_done = True
+            else:
+                primary_done = True
+            try:
+                value = f.result()
+            except QueryTimeoutError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — maybe the twin wins
+                # the PRIMARY's error first: the hedge is a single
+                # best-effort attempt against a possibly-stale follower
+                # (its failure must not mask/reclassify the region's
+                # real outcome when both sides fail)
+                if is_hedge:
+                    errors.append(exc)
+                else:
+                    errors.insert(0, exc)
+                if not primary_done:
+                    continue  # hedge failed, primary still in flight
+                # primary has failed: is a hedge still (or about to be)
+                # in flight?  cancel() True = the wheel will never arm
+                # one; False = the arm callback ran — wait it out (it is
+                # cheap) and re-check what it submitted.
+                if timer is not None and not timer.cancel():
+                    timer.wait(5.0)
+                    entry = hedges.get(rid)
+                    hedge_fut = entry[1] if entry is not None else None
+                if hedge_fut is not None and not hedge_done:
+                    continue  # wait for the in-flight hedge
                 raise errors[0]
-            # one attempt failed but its twin is still in flight: wait it out
-            hedge_considered = True
+            if is_hedge:
+                metrics.HEDGE_WINS_TOTAL.inc()
+            return value
 
     def _fanout(self, meta, fn):
         """Run `fn(client, rid)` for every region of `meta` concurrently on
@@ -823,9 +847,10 @@ class Frontend:
                         raise
                     give_up([rid], exc)
             return results
+        import queue as _queue
+
         pool = self._executor()
         inflight: dict[int, int] = {}
-        t0 = _time.monotonic()
         futures = {
             rid: pool.submit(
                 propagate(self._call_region), meta, rid, fn, routes, inflight,
@@ -833,7 +858,28 @@ class Frontend:
             )
             for rid in rids
         }
+        # per-region completion queues fed by future done-callbacks: the
+        # settle loop blocks on its region's queue, so hedges armed by the
+        # wheel while it waits wake it without polling
+        queues = {rid: _queue.SimpleQueue() for rid in rids}
+        for rid, fut in futures.items():
+            fut.add_done_callback(queues[rid].put)
         hedges: dict[int, object] = {}
+        timers: dict[int, object] = {}
+        if hedge_delay is not None:
+            # deadline context is thread-local: wrap the hedge call HERE
+            # so the wheel-thread submit still propagates this query's
+            # deadline into the pool worker
+            hedge_fn = propagate(
+                lambda node, hrid: self._hedge_call(node, hrid, fn)
+            )
+            for rid, fut in futures.items():
+                flist = followers.get(rid)
+                if flist:
+                    timers[rid] = self._arm_hedge(
+                        pool, rid, fut, flist, hedge_delay, deadline,
+                        hedges, queues, hedge_fn,
+                    )
         results: list = []
         failed: list[int] = []
         last_exc: Exception | None = None
@@ -851,8 +897,8 @@ class Frontend:
                 try:
                     results.append(
                         self._settle_region(
-                            rid, fut, meta, fn, followers.get(rid),
-                            hedge_delay, deadline, pool, hedges, t0,
+                            rid, fut, meta, queues[rid], timers.get(rid),
+                            hedges, deadline,
                         )
                     )
                 except QueryTimeoutError:
@@ -861,6 +907,14 @@ class Frontend:
                 except Exception as exc:  # noqa: BLE001 — classified
                     note_failure(rid, exc)
         finally:
+            # cancel pending timers; a callback already RUNNING on the
+            # wheel thread may still be inserting into `hedges`, so wait
+            # it out before iterating the dict (a mid-iteration insert
+            # raises RuntimeError inside this finally, replacing the real
+            # outcome and skipping the abandoned-client cleanup)
+            for timer in timers.values():
+                if not timer.cancel():
+                    timer.wait(1.0)
             # no-op for completed futures; sheds queued work on early exit
             for fut in list(futures.values()) + [f for _n, f in hedges.values()]:
                 fut.cancel()
@@ -930,6 +984,7 @@ class Frontend:
             pass
 
     def close(self):
+        self._hedge_wheel.stop()
         self.mirror.close()
         with self._pool_lock:
             if self._pool is not None:

@@ -876,6 +876,108 @@ def psum_states(state: AggState, axis_name: str) -> AggState:
     return out
 
 
+def topk_group_select(
+    mask: jnp.ndarray,
+    order_keys: list[tuple],
+    cap: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k over finalized [G] states: the device half of ORDER BY/LIMIT
+    pushdown (and of empty-group compaction, with no order keys).
+
+    `mask` marks surviving groups (non-empty AND HAVING-true);
+    `order_keys` is a list of (values [G], isnull [G] | None, ascending,
+    nulls_first).  Returns (sel [cap] int32 group ids, n_out int32): the
+    first `cap` groups ordered survivors-first, then by each key with an
+    explicit null bucket, ties broken by group id ASCENDING — exactly the
+    order a stable host sort produces over the gid-ordered aggregate
+    table, so device truncation is bit-identical to the host replay.
+
+    Implemented as one multi-operand `lax.sort` rather than
+    `jax.lax.top_k`: the gid tiebreak and per-key null buckets need a
+    lexicographic total order a single top_k operand cannot encode
+    without colliding masked groups with genuine -inf values; G is
+    planner-bounded so the full sort is cheap next to the aggregation."""
+    g = mask.shape[0]
+    gid = jnp.arange(g, dtype=jnp.int32)
+    keys = [jnp.where(mask, jnp.int8(0), jnp.int8(1))]
+    for values, isnull, ascending, nulls_first in order_keys:
+        v = values
+        if isnull is not None:
+            nb = jnp.where(
+                isnull,
+                jnp.int8(-1 if nulls_first else 1),
+                jnp.int8(0),
+            )
+            keys.append(nb)
+            v = jnp.where(isnull, 0, v)
+        if jnp.issubdtype(v.dtype, jnp.integer) or v.dtype == bool:
+            v = v.astype(jnp.int64)
+        else:
+            v = v.astype(jnp.float64)
+        keys.append(v if ascending else -v)
+    keys.append(gid)
+    sorted_ops = jax.lax.sort(tuple(keys), num_keys=len(keys))
+    sel = jax.lax.slice_in_dim(sorted_ops[-1], 0, cap)
+    return sel, jnp.sum(mask).astype(jnp.int32)
+
+
+def having_mask(tree, ref_value, values: jnp.ndarray, shape) -> jnp.ndarray:
+    """On-device HAVING over finalized states with SQL's Kleene 3-valued
+    semantics (NULL-aware and/or/not — the CPU executor's pc.and_kleene
+    path).  `tree` is the encoded predicate from
+    query/device_finalize.py; `ref_value(ref) -> (value [G], isnull [G] |
+    None)` resolves aggregate refs; `values` carries the comparison
+    literals by slot (runtime args, so thresholds reuse the compile).
+    Returns the boolean keep mask (unknown = dropped, per SQL)."""
+
+    def ev(node):
+        kind = node[0]
+        ones = jnp.ones(shape, bool)
+        if kind in ("cmp", "cmpref"):
+            if kind == "cmp":
+                _k, op, ref, slot = node
+                x, xnull = ref_value(ref)
+                y, ynull = values[slot], None
+            else:
+                _k, op, ref1, ref2 = node
+                x, xnull = ref_value(ref1)
+                y, ynull = ref_value(ref2)
+            x = x.astype(jnp.float64)
+            y = jnp.asarray(y, jnp.float64)
+            v = {
+                "=": lambda: x == y, "!=": lambda: x != y,
+                "<": lambda: x < y, "<=": lambda: x <= y,
+                ">": lambda: x > y, ">=": lambda: x >= y,
+            }[op]()
+            valid = ones
+            if xnull is not None:
+                valid = valid & ~xnull
+            if ynull is not None:
+                valid = valid & ~ynull
+            return v, valid
+        if kind == "isnull":
+            _k, ref, neg = node
+            _v, isn = ref_value(ref)
+            isn = jnp.zeros(shape, bool) if isn is None else isn
+            return (~isn if neg else isn), ones
+        if kind == "not":
+            v, valid = ev(node[1])
+            return ~v, valid
+        av, avalid = ev(node[1])
+        bv, bvalid = ev(node[2])
+        if kind == "and":
+            return av & bv, (
+                (avalid & bvalid) | (avalid & ~av) | (bvalid & ~bv)
+            )
+        # "or"
+        return av | bv, (
+            (avalid & bvalid) | (avalid & av) | (bvalid & bv)
+        )
+
+    v, valid = ev(tree)
+    return v & valid
+
+
 def finalize(
     state: AggState, aggs: tuple[str, ...], counts=None
 ) -> dict[str, jnp.ndarray]:

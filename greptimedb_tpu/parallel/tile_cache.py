@@ -1661,8 +1661,27 @@ def _value_to_numpy(col) -> np.ndarray | None:
 # ---- the single-dispatch program -------------------------------------------
 
 
+_program_cache_lock = threading.Lock()
+
+
+def _tile_program_cached(plan, nullable_cols, spec):
+    """_tile_program + compile-cache hit/miss accounting (the lru_cache is
+    the in-process program cache; the persistent XLA cache sits below).
+    The lock makes the miss-delta attribution exact under concurrent
+    queries — program BUILD is cheap closure assembly (XLA tracing happens
+    at first dispatch), so serializing it costs nothing."""
+    with _program_cache_lock:
+        before = _tile_program.cache_info().misses
+        out = _tile_program(plan, nullable_cols, spec)
+        if _tile_program.cache_info().misses > before:
+            metrics.TPU_COMPILE_CACHE_MISSES.inc()
+        else:
+            metrics.TPU_COMPILE_CACHE_HITS.inc()
+    return out
+
+
 @functools.lru_cache(maxsize=256)
-def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
+def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=None):
     """jit program over ALL of a query's sources: per-source partial
     states (blocked/scatter kernels), merged pairwise, FINALIZED on
     device, and packed into TWO result buffers — int32 [Ki, G] for
@@ -1692,11 +1711,22 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
     when no output consumes an exact count (presence/count rows then only
     NULL-gate via `> 0`).  Small results ship full-precision — their
     transfer is round-trip-bound, not byte-bound.
-    Returns (fn, int_layout, acc32_layout, acc64_layout)."""
+
+    With `spec` (a query.device_finalize DeviceFinalizeSpec) the program
+    extends the lowering boundary PAST the aggregate: HAVING masks, the
+    ORDER BY key sort (ties broken by group id ascending — exactly the
+    CPU replay's stable sort over the gid-ordered aggregate table) and
+    LIMIT truncation all run on device over the finalized [G] states, and
+    the fetch ships a compact [K, cap] buffer + the selected group-id
+    vector + a survivor count instead of the full group space — the
+    O(rows_out) readback contract.  Compact results skip the f32/uint8
+    byte packing (they are small; f64 keeps them bit-identical to the
+    host path on the same aggregates).
+    Returns (fn, int_layout, acc32_layout, acc64_layout, int_dtype)."""
     per_col_aggs: dict[str, set] = {}
     for func, col in plan.agg_specs:
         per_col_aggs.setdefault(col, set()).add(_FUNC_TO_KERNEL[func])
-    pack_bytes = plan.num_groups >= 1 << 14
+    pack_bytes = plan.num_groups >= 1 << 14 and spec is None
     int_layout: list[tuple[str, str]] = [("__presence", "count")]
     acc32_layout: list[tuple[str, str]] = []
     acc64_layout: list[tuple[str, str]] = []
@@ -1743,7 +1773,56 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
         lambda a, b: {k: merge_states(a[k], b[k]) for k in a}
     )
 
-    def _final(merged):
+    def _device_select(merged, outs, presence, hv):
+        """Device finalization: HAVING mask (ops/aggregate.having_mask)
+        -> top-k-over-states (ops/aggregate.topk_group_select) -> the
+        first `cap` group ids.  Returns (sel_gids [cap] int32, n_out)."""
+        from ..ops.aggregate import having_mask, topk_group_select
+
+        g = presence.shape[0]
+        gid = jnp.arange(g, dtype=jnp.int32)
+        dims = list(plan.tag_cards)
+        if plan.bucket_col is not None:
+            dims.append(plan.n_buckets)
+
+        def ref_val(ref):
+            """-> (value [G], isnull [G] | None).  Dim refs decode from
+            the gid iota (tag codes are value-sorted, NULL last, so code
+            order IS SQL-default order); agg refs read the finalized
+            outputs with the same count>0 NULL gate the host applies."""
+            if ref[0] == "dim":
+                i = ref[1]
+                div = 1
+                for c in dims[i + 1:]:
+                    div *= c
+                return (gid // div) % dims[i], None
+            _kind, col, agg = ref
+            if col == COUNT_STAR or col not in merged:
+                return presence, None
+            if agg == "count":
+                cc = merged[col].counts
+                return (cc if cc is not None else presence), None
+            counts = merged[col].counts
+            isnull = (counts == 0) if counts is not None else None
+            v = outs[col][agg]
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                # the host masks NaN outputs to NULL (inf-inf etc.); the
+                # device key must use the same NULL bucket or the two
+                # paths place such groups differently under ORDER BY
+                nan = jnp.isnan(v)
+                isnull = nan if isnull is None else (isnull | nan)
+            return v, isnull
+
+        mask = presence > 0
+        if spec.having is not None:
+            mask = mask & having_mask(spec.having, ref_val, hv, (g,))
+        order_keys = []
+        for ref, asc, nulls_first in spec.order:
+            v, isn = ref_val(ref)
+            order_keys.append((v, isn, asc, nulls_first))
+        return topk_group_select(mask, order_keys, spec.cap)
+
+    def _final(merged, hv):
         presence = merged["__presence"].counts
         outs = {"__presence": {"count": presence}}
         for col, aggs in per_col_aggs.items():
@@ -1751,6 +1830,16 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
                 outs[col] = finalize(
                     merged[col], tuple(sorted(aggs)), counts=presence
                 )
+        if spec is not None:
+            sel, n_out = _device_select(merged, outs, presence, hv)
+
+            def pick(row):
+                return row[sel]
+        else:
+            sel = n_out = None
+
+            def pick(row):
+                return row
 
         def as_int(row):
             if int_dtype == jnp.uint8:
@@ -1766,12 +1855,17 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
             return row.astype(jnp.int32)
 
         parts = [
-            jnp.stack([as_int(outs[col][agg]) for col, agg in int_layout])
+            jnp.stack([pick(as_int(outs[col][agg])) for col, agg in int_layout])
         ]
         if acc32_layout:
             parts.append(jnp.stack(
-                [outs[col][agg].astype(jnp.float32) for col, agg in acc32_layout]
+                [pick(outs[col][agg]).astype(jnp.float32) for col, agg in acc32_layout]
             ))
+        if spec is not None:
+            # compact-path extras: the selected group ids (host tag/bucket
+            # decode) and the survivor count ride the same flat buffer
+            parts.append(sel.astype(jnp.int32).reshape(1, -1))
+            parts.append(n_out.astype(jnp.int32).reshape(1, 1))
         # ONE flat byte buffer for the 8/32-bit rows: jax.device_get of
         # several arrays costs extra link round-trips on the remote-device
         # harness (~100 ms each), so ints + f32 rows bitcast to bytes and
@@ -1794,12 +1888,13 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
                 )
             flat.append(ok.astype(jnp.uint8).reshape(1))
         buf = jnp.concatenate(flat) if len(flat) > 1 else flat[0]
+        out_g = spec.cap if spec is not None else presence.shape[0]
         if acc64_layout:
             accs64 = jnp.stack(
-                [outs[col][agg].astype(jnp.float64) for col, agg in acc64_layout]
+                [pick(outs[col][agg]).astype(jnp.float64) for col, agg in acc64_layout]
             )
         else:
-            accs64 = jnp.zeros((0, presence.shape[0]), jnp.float64)
+            accs64 = jnp.zeros((0, out_g), jnp.float64)
         return buf, accs64
 
     final_jit = jax.jit(_final)
@@ -1813,11 +1908,19 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
         # sync=True (region-streamed mode) blocks after each merge so the
         # producer can safely RELEASE a region's input planes before
         # building the next one — peak HBM stays one region's working set.
+        metrics.TPU_DEVICE_DISPATCHES.inc()
+        hv = jnp.asarray(
+            dyn.get("having_values") or (0.0,), jnp.float64
+        )
+        pdyn = {
+            k: dyn[k]
+            for k in ("filter_values", "bucket_origin", "bucket_interval")
+        }
         merged = None
         target = None
         for cols, valid, nulls, perm, limbs in sources:
             check_deadline()  # one dispatch per chunk source
-            states = _partial(cols, valid, nulls, dyn, perm, limbs)
+            states = _partial(cols, valid, nulls, pdyn, perm, limbs)
             leaves = jax.tree_util.tree_leaves(states)
             dev = next(iter(leaves[0].devices())) if leaves else None
             if merged is None:
@@ -1830,7 +1933,7 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...]):
                 jax.block_until_ready(jax.tree_util.tree_leaves(merged))
         if merged is None:
             raise ValueError("tile program received no sources")
-        return final_jit(merged)
+        return final_jit(merged, hv)
 
     return (
         run_all,
@@ -2184,7 +2287,7 @@ class TileExecutor:
         )
         if built is None:
             return None
-        plan, dyn_host = built
+        plan, dyn_host, fspec = built
         if plan.num_groups > self.config.max_groups * 64:
             return None  # group space too large for dense [G] states
         if plan.internal_groups > self.config.max_internal_groups:
@@ -2384,6 +2487,7 @@ class TileExecutor:
             "filter_values": tuple(dyn_host["filter_values"]),
             "bucket_origin": np.int64(dyn_host["bucket_origin"]),
             "bucket_interval": np.int64(dyn_host["bucket_interval"]),
+            "having_values": tuple(dyn_host["having_values"]),
         }
         ndev = len(self.cache.devices)
         placed = ndev > 1 and passes.enabled("chunk_placement", self.config)
@@ -2404,13 +2508,13 @@ class TileExecutor:
         # blocks), rerun the same sources with exact f64 accumulation
         for attempt_plan in (plan, dataclasses.replace(plan, acc_dtype="float64")):
             program, int_layout, acc32_layout, acc64_layout, int_dtype = (
-                _tile_program(attempt_plan, nullable_cols)
+                _tile_program_cached(attempt_plan, nullable_cols, fspec)
             )
             try:
                 packed = program(tuple(device_sources), dyn)
                 table = self._finalize(
                     packed, int_layout, acc32_layout, acc64_layout, int_dtype,
-                    attempt_plan, lowering, schema, ctx, dyn_host,
+                    attempt_plan, lowering, schema, ctx, dyn_host, fspec,
                 )
             except Exception as e:  # noqa: BLE001 — only OOM is retryable
                 if "RESOURCE_EXHAUSTED" not in str(e):
@@ -2432,7 +2536,7 @@ class TileExecutor:
                 packed = program(tuple(device_sources), dyn)
                 table = self._finalize(
                     packed, int_layout, acc32_layout, acc64_layout, int_dtype,
-                    attempt_plan, lowering, schema, ctx, dyn_host,
+                    attempt_plan, lowering, schema, ctx, dyn_host, fspec,
                 )
             if table is not None:
                 return table
@@ -2491,7 +2595,7 @@ class TileExecutor:
         )
         if built is None:
             return None
-        plan, dyn_host = built
+        plan, dyn_host, fspec = built
         if plan.time_major:
             # time-major copies double a region's planes and the
             # permutation build is per-entry; bucket-only group-bys at
@@ -2512,6 +2616,7 @@ class TileExecutor:
             "filter_values": tuple(dyn_host["filter_values"]),
             "bucket_origin": np.int64(dyn_host["bucket_origin"]),
             "bucket_interval": np.int64(dyn_host["bucket_interval"]),
+            "having_values": tuple(dyn_host["having_values"]),
         }
         n_regions = sum(1 for _r, m, _t in region_sources if m)
         bail: dict = {}
@@ -2609,7 +2714,7 @@ class TileExecutor:
             plan, dataclasses.replace(plan, acc_dtype="float64")
         ):
             program, int_layout, acc32_layout, acc64_layout, int_dtype = (
-                _tile_program(attempt_plan, nullable_cols)
+                _tile_program_cached(attempt_plan, nullable_cols, fspec)
             )
             LAST_STREAM_CHUNK_MS.clear()  # per attempt: the f64 rerun
             # (limb verdict failure) re-streams and re-records
@@ -2645,7 +2750,7 @@ class TileExecutor:
                 metrics.TILE_LOWERED_TOTAL.inc()
             table = self._finalize(
                 packed, int_layout, acc32_layout, acc64_layout, int_dtype,
-                attempt_plan, lowering, schema, ctx, dyn_host,
+                attempt_plan, lowering, schema, ctx, dyn_host, fspec,
             )
             if table is not None:
                 return table
@@ -2729,11 +2834,12 @@ class TileExecutor:
             unit_ns = schema.time_index.data_type.timestamp_unit_ns()
             interval_native = max(int(interval * 1_000_000) // max(unit_ns, 1), 1)
             origin = origin_hint + ((lo - origin_hint) // interval_native) * interval_native
-            n_buckets = max(int((hi - origin + interval_native - 1) // interval_native), 1)
-            n_buckets = _quantize_soft(n_buckets)
+            n_buckets_real = max(int((hi - origin + interval_native - 1) // interval_native), 1)
+            n_buckets = _quantize_soft(n_buckets_real)
             bucket_col = ts_col
         else:
             bucket_col, interval_native, origin, n_buckets = None, 1, 0, 1
+            n_buckets_real = 1
 
         # filters: tag values -> sorted codes (order-preserving, so even
         # inequalities translate); time range -> explicit ts filters.
@@ -2887,8 +2993,71 @@ class TileExecutor:
             "filter_values": filter_vals,
             "bucket_origin": origin,
             "bucket_interval": interval_native,
+            "having_values": (),
         }
-        return plan, dyn_host
+        spec = self._plan_device_finalize(
+            lowering, schema, ctx, plan, dyn_host, n_buckets_real
+        )
+        return plan, dyn_host, spec
+
+    def _plan_device_finalize(
+        self, lowering, schema, ctx, plan, dyn_host, n_buckets_real
+    ):
+        """Decide whether (and how) this query's post-plan finalizes on
+        device.  Engages when the device can consume Sort/Limit/HAVING
+        operators, or when the real group bound is far enough under the
+        padded group space that compaction alone pays (> 2x).  With no
+        LIMIT, `cap` is a true upper bound on non-empty groups (real
+        dictionary cardinalities x real bucket count), so the compact
+        fetch can never overflow and no second dispatch is ever needed."""
+        enabled = passes.enabled("device_finalize", self.config) and getattr(
+            self.config, "device_topk", True
+        )
+        if not enabled or plan.num_groups <= 1:
+            if not enabled:
+                passes.note(
+                    "device_finalize", False,
+                    "pass disabled or query.device_topk off: full-buffer "
+                    "fetch + host post-ops",
+                )
+            return None
+        from ..query.device_finalize import (
+            DeviceFinalizeSpec,
+            derive_post_lowering,
+        )
+
+        post = derive_post_lowering(lowering, schema)
+        if post is None:
+            passes.note(
+                "device_finalize", False,
+                "post-plan not resolvable to device refs: host replay",
+            )
+            return None
+        real_groups = max(n_buckets_real, 1)
+        for t in plan.group_tags:
+            real_groups *= max(ctx.dictionary.cardinality(t), 1)
+        if post.limit is not None:
+            cap = min(plan.num_groups, post.offset + post.limit)
+        else:
+            cap = min(plan.num_groups, _quantize_soft(real_groups))
+        if cap <= 0 or not (post.consumed or cap * 2 <= plan.num_groups):
+            passes.note(
+                "device_finalize", False,
+                "no consumable Sort/LIMIT/HAVING and compaction would not "
+                "shrink the fetch: full-buffer path",
+                cap=cap, groups=plan.num_groups,
+            )
+            return None
+        dyn_host["having_values"] = tuple(post.having_values)
+        dyn_host["post_consumed"] = post.consumed
+        return DeviceFinalizeSpec(
+            order=post.order,
+            having=post.having,
+            n_having_values=len(post.having_values),
+            limit=post.limit,
+            offset=post.offset,
+            cap=int(cap),
+        )
 
     def config_acc_dtype(self) -> str:
         import jax as _jax
@@ -2897,6 +3066,73 @@ class TileExecutor:
         if mode == "limb" and passes.enabled("limb_quantize", self.config):
             return "limb"
         return "float64" if _jax.config.jax_enable_x64 else "float32"
+
+    # -- prewarm -------------------------------------------------------------
+    def prewarm(self, ctx: TileContext, schema, limbs: bool = True) -> dict:
+        """Build a table's super-tiles OFF the query path: host
+        consolidation (Parquet decode + dictionary encode + (pk, ts)
+        lexsort), device plane upload for every numeric field, and
+        (optionally) the MXU limb quantization — the dominant cold-query
+        costs, paid at flush time (tile.prewarm_on_flush) or explicitly
+        (Database.prewarm) instead of on the first query of each TSBS
+        family.  XLA compiles still happen on first dispatch but ride the
+        persistent compilation cache (utils/jax_env.py).  Best-effort: a
+        region that cannot tile is skipped, never an error."""
+        t0 = time.perf_counter()
+        built = 0
+        pk = [c.name for c in schema.tag_columns()]
+        ts_name = schema.time_index.name if schema.time_index else None
+        value_cols = [
+            c.name for c in schema.field_columns() if c.data_type.is_numeric()
+        ]
+        limb_wanted = limbs and self.config_acc_dtype() == "limb"
+        pinned_ids = {r.region_id for r in ctx.regions}
+        nonnull = [
+            c
+            for c in value_cols
+            if schema.has_column(c) and not schema.column(c).nullable
+        ]
+        # the table lock (which serializes queries' epoch-sensitive
+        # sections) is taken PER REGION, not across the whole build: a
+        # background prewarm of a 10-170 s multi-region table must stall
+        # a concurrent query by at most one region's build
+        for region in ctx.regions:
+            with ctx.dictionary.table_lock:
+                region.pin_scan()
+                try:
+                    metas, _mems, version = region.tile_snapshot()
+                    self.cache.invalidate_region_if_changed(
+                        region.region_id,
+                        {m.file_id for m in metas},
+                        version,
+                    )
+                    if not metas:
+                        continue
+                    entry, _excluded = self.cache.super_tiles(
+                        region, ctx.dictionary, metas, pk, ts_name,
+                        value_cols, pinned_ids, pk,
+                    )
+                    if entry is None:
+                        continue
+                    built += 1
+                    if limb_wanted and nonnull:
+                        self.cache.ensure_limbs(
+                            entry, nonnull, False, pinned_ids
+                        )
+                except QueryTimeoutError:
+                    raise
+                except Exception:  # noqa: BLE001 — prewarm is best-effort
+                    logging.getLogger("greptimedb_tpu.tile").warning(
+                        "prewarm skipped region %s", region.region_id,
+                        exc_info=True,
+                    )
+                finally:
+                    region.unpin_scan()
+        ms = (time.perf_counter() - t0) * 1000.0
+        if built:
+            metrics.PREWARM_BUILDS.inc(built)
+        metrics.PREWARM_MS.observe(ms)
+        return {"regions_built": built, "ms": round(ms, 1)}
 
     # -- host fast path ------------------------------------------------------
     _HOST_PATH_MAX_ROWS = 4 << 20
@@ -3379,13 +3615,18 @@ class TileExecutor:
 
     def _finalize(
         self, packed, int_layout, acc32_layout, acc64_layout, int_dtype,
-        plan, lowering, schema, ctx, dyn_host,
+        plan, lowering, schema, ctx, dyn_host, spec=None,
     ):
         # ONE host fetch total, regardless of how many aggregates ran
         t0 = time.perf_counter()
         buf, accs64 = jax.device_get(packed)
         buf = np.asarray(buf)
-        metrics.TILE_READBACK_MS.observe((time.perf_counter() - t0) * 1000.0)
+        accs64 = np.asarray(accs64)
+        ms = (time.perf_counter() - t0) * 1000.0
+        metrics.TILE_READBACK_MS.observe(ms)
+        metrics.TPU_READBACK_MS.observe(ms)
+        metrics.TPU_READBACK_BYTES.inc(buf.nbytes + accs64.nbytes)
+        metrics.TPU_DEVICE_FETCHES.inc()
         if plan.acc_dtype == "limb" and self._limb_sum_cols(plan):
             if buf[-1] == 0:
                 # quantization-error bound exceeded 1e-7 of some group's
@@ -3393,7 +3634,7 @@ class TileExecutor:
                 # rerun with exact f64 accumulation
                 metrics.TILE_LIMB_RERUNS.inc()
                 return None
-        g = plan.num_groups
+        g = spec.cap if spec is not None else plan.num_groups
         bit_packed = int_dtype == jnp.uint8
         int_row = -(-g // 8) if bit_packed else g
         ni = len(int_layout)
@@ -3405,6 +3646,14 @@ class TileExecutor:
         accs32 = np.frombuffer(
             buf[off : off + n32 * g * 4].tobytes(), np.float32
         ).reshape(n32, g)
+        off += n32 * g * 4
+        sel = n_out = None
+        if spec is not None:
+            sel = np.frombuffer(
+                buf[off : off + g * 4].tobytes(), np.int32
+            )
+            off += g * 4
+            n_out = int(np.frombuffer(buf[off : off + 4].tobytes(), np.int32)[0])
         finals: dict[str, dict[str, np.ndarray]] = {}
         for i, (col, agg) in enumerate(int_layout):
             row = ints[i]
@@ -3415,7 +3664,79 @@ class TileExecutor:
             finals.setdefault(col, {})[agg] = accs32[i].astype(np.float64)
         for i, (col, agg) in enumerate(acc64_layout):
             finals.setdefault(col, {})[agg] = accs64[i]
+        if spec is not None:
+            table = self._assemble_compact(
+                finals, plan, ctx, dyn_host, sel, n_out, spec
+            )
+            # the device consumed these post-ops: the host replay
+            # (tpu_exec._run_post_ops) must skip exactly them
+            lowering.post_done = dyn_host.get("post_consumed", frozenset())
+            metrics.TPU_DEVICE_FINALIZE.inc()
+            passes.note(
+                "device_finalize", True,
+                "Sort/LIMIT/HAVING + compaction ran on device: fetch is "
+                "O(rows_out)",
+                rows_out=table.num_rows, cap=spec.cap,
+                groups=plan.num_groups,
+                fetched_bytes=buf.nbytes + accs64.nbytes,
+            )
+            return table
         return self._assemble_result(finals, plan, ctx, dyn_host)
+
+    def _assemble_compact(
+        self, finals, plan, ctx, dyn_host, sel, n_out, spec
+    ):
+        """Compact [K, cap] buffers + selected group ids -> SQL rows in
+        DEVICE order (the consumed Sort/Limit already ordered and
+        truncated them).  Same naming and NULL-gating as
+        `_assemble_result`; the host's only remaining work is the
+        offset/limit slice and the tag/bucket decode over rows_out ids."""
+        rows_avail = max(min(n_out, spec.cap), 0)
+        start, stop = 0, rows_avail
+        if spec.limit is not None:
+            start = min(spec.offset, rows_avail)
+            stop = min(start + spec.limit, rows_avail)
+        sl = slice(start, stop)
+        idx = np.asarray(sel[sl], np.int64)
+        cols: dict[str, object] = {}
+        dims: list[tuple[str, int]] = list(
+            zip(plan.group_tags, plan.tag_cards)
+        )
+        if plan.bucket_col is not None:
+            dims.append(("__bucket", plan.n_buckets))
+        decoded = {}
+        div = 1
+        for name, card in reversed(dims):
+            decoded[name] = (idx // div) % card
+            div *= card
+        for tag in plan.group_tags:
+            values = ctx.dictionary.values(tag)
+            codes = decoded[tag]
+            cols[tag] = [
+                values[c] if c < len(values) else None for c in codes
+            ]
+        if plan.bucket_col is not None:
+            origin = dyn_host["bucket_origin"]
+            interval = dyn_host["bucket_interval"]
+            cols[plan.bucket_col] = (
+                origin + decoded["__bucket"].astype(np.int64) * interval
+            )
+        for func, col in plan.agg_specs:
+            out = finals.get(col, {})
+            kernel = _FUNC_TO_KERNEL[func]
+            arr = out.get(kernel)
+            if arr is None and kernel == "count":
+                arr = finals["__presence"]["count"]
+            arr = np.asarray(arr)[sl]
+            col_count = np.asarray(out.get("count", finals["__presence"]["count"]))[sl]
+            if col == COUNT_STAR:
+                cols["count(*)"] = pa.array(arr.astype(np.int64))
+            elif func == "count":
+                cols[f"count({col})"] = pa.array(arr.astype(np.int64))
+            else:
+                vals = np.where(col_count > 0, arr, np.nan)
+                cols[f"{func}({col})"] = pa.array(vals, mask=np.isnan(vals))
+        return pa.table(cols)
 
     def _assemble_result(self, finals, plan, ctx, dyn_host):
         """Shared [G]-state -> SQL rows assembly for the device and host

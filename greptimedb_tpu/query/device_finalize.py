@@ -1,0 +1,296 @@
+"""Device-side result finalization: lowering Sort/LIMIT/HAVING past the
+aggregate boundary.
+
+The tile program (parallel/tile_cache.py `_tile_program`) finalizes
+aggregates into [K, G] buffers and, historically, shipped ALL G groups to
+the host where the post-plan (HAVING / ORDER BY / LIMIT) replayed on the
+CPU executor — an O(groups) fetch for queries whose answer is 5 rows
+(TSBS groupby-orderby-limit) and a full-buffer fetch even for plain
+group-bys whose padded group space is mostly empty.  This module extends
+the lowering boundary PAST the aggregate, the classic fused-data-path
+move (cf. "Data Path Fusion in GPU for Analytical Query Processing"):
+keep intermediates on the accelerator, materialize only final output.
+
+`derive_post_lowering` pattern-matches the post-plan the TPU planner
+already collected (tpu_exec.Lowering.post_ops, outer-first) and returns a
+`DevicePost` describing what the compiled program can finalize on device:
+
+  * HAVING predicates over lowered aggregate outputs (comparisons against
+    numeric literals, BETWEEN, IS [NOT] NULL, combined with Kleene
+    and/or/not — the exact 3-valued semantics the CPU executor's
+    pc.and_kleene path implements);
+  * ORDER BY over group dimensions (tag columns / the time bucket) or
+    aggregate outputs, multi-key, with per-key NULLS FIRST/LAST.  Tag
+    keys ride the value-sorted dictionary codes (storage/dictionary.py:
+    code order IS value order, NULL is the max code), so only the SQL
+    default null placement is consumable for tag keys; aggregate keys
+    carry an explicit null bucket and accept either placement;
+  * LIMIT/OFFSET — the program ships the first offset+limit survivors.
+
+Ties at the limit boundary break by group id ascending — identical to the
+CPU replay, whose stable sort preserves the gid-ascending row order the
+aggregate table is emitted in.  Anything unresolvable (subqueries were
+already rejected by try_lower, arithmetic over aggregates, non-default
+nulls on a tag key, expressions the env can't name) stops consumption at
+that operator; everything outward of the stop replays on the host over
+the (already small) device result, and `query.device_topk = false`
+restores the old full-buffer path exactly.
+
+The derivation is pure planning (no jax imports): the device evaluation
+of the encoded HAVING tree and sort keys lives in the tile program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .expr import (
+    AggCall,
+    Alias,
+    Between,
+    BinaryOp,
+    Column,
+    Expr,
+    FuncCall,
+    IsNull,
+    Literal,
+    UnaryOp,
+    strip_alias,
+)
+from .logical_plan import Having, Limit, Project, Sort
+
+# mirror of parallel/executor.py COUNT_STAR (kept literal so this module
+# stays jax-free and import-light for the planner)
+_COUNT_STAR = "__count_star"
+
+_FUNC_TO_KERNEL = {
+    "sum": "sum",
+    "count": "count",
+    "min": "min",
+    "max": "max",
+    "avg": "avg",
+    "mean": "avg",
+    "last_value": "last",
+}
+
+_CMP_OPS = ("=", "!=", "<", "<=", ">", ">=")
+
+
+@dataclass(frozen=True)
+class DeviceFinalizeSpec:
+    """Compile-static description of on-device finalization — part of the
+    tile program's cache key, so it is fully hashable and carries NO
+    literal values (HAVING literals ride `dyn['having_values']` by slot
+    index, like filter literals, so changing a threshold reuses the
+    compile).
+
+    `order` entries are ((ref...), ascending, nulls_first) where ref is
+    ("dim", i) — the i-th group dimension in gid composition order (tags
+    in group order, bucket last) — or ("agg", col, kernel_agg).
+    `having` is the encoded predicate tree (see _encode_having).
+    `cap` is the padded row capacity of the compact result buffers; with
+    no LIMIT it is a TRUE upper bound on non-empty groups (real dictionary
+    cardinalities x real bucket count), so the compact fetch can never
+    overflow."""
+
+    order: tuple = ()
+    having: object = None
+    n_having_values: int = 0
+    limit: int | None = None
+    offset: int = 0
+    cap: int = 0
+
+
+@dataclass
+class DevicePost:
+    """Derivation result: the spec fields that come from the post-plan,
+    plus the runtime literal values and WHICH post_ops indices the device
+    consumed (tpu_exec._run_post_ops skips exactly those on replay)."""
+
+    order: tuple = ()
+    having: object = None
+    having_values: tuple = ()
+    limit: int | None = None
+    offset: int = 0
+    consumed: frozenset = frozenset()
+
+
+def _build_env(lowering, schema) -> dict[str, tuple] | None:
+    """Output-name -> device ref for everything the aggregate produces."""
+    group_tags = list(lowering.group_tags)
+    env: dict[str, tuple] = {}
+    for ge in lowering.group_exprs:
+        inner = strip_alias(ge)
+        if isinstance(inner, Column) and inner.column in group_tags:
+            ref = ("dim", group_tags.index(inner.column))
+        elif isinstance(inner, FuncCall) and lowering.bucket is not None:
+            ref = ("dim", len(group_tags))  # the bucket dimension
+        else:
+            return None
+        env[ge.name()] = ref
+        env[inner.name()] = ref
+    for ae in lowering.agg_exprs:
+        inner = strip_alias(ae)
+        if not isinstance(inner, AggCall):
+            return None
+        kernel = _FUNC_TO_KERNEL.get(inner.func)
+        if kernel is None:
+            return None
+        col = inner.arg.column if inner.arg is not None else _COUNT_STAR
+        ref = ("agg", col, kernel)
+        env[ae.name()] = ref
+        env[inner.name()] = ref
+    return env
+
+
+def _num_literal(e: Expr):
+    if isinstance(e, Literal) and isinstance(e.value, (int, float)) and not isinstance(e.value, bool):
+        return float(e.value)
+    return None
+
+
+def _ref_of(e: Expr, env: dict) -> tuple | None:
+    inner = strip_alias(e)
+    if isinstance(inner, Column):
+        return env.get(inner.column)
+    return env.get(inner.name())
+
+
+_SWAP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}
+
+
+def _encode_having(pred: Expr, env: dict, values: list) -> object | None:
+    """Predicate -> hashable tree over agg refs and literal SLOTS.
+
+    Nodes: ("cmp", op, ref, slot) | ("cmpref", op, ref, ref) |
+    ("isnull", ref, negated) | ("and"|"or", l, r) | ("not", x).
+    Only aggregate refs are supported — tag comparisons would need
+    string->code encoding at literal positions, which the authoritative
+    host replay already serves."""
+    if isinstance(pred, BinaryOp) and pred.op in ("and", "or"):
+        l = _encode_having(pred.left, env, values)
+        if l is None:
+            return None
+        r = _encode_having(pred.right, env, values)
+        if r is None:
+            return None
+        return (pred.op, l, r)
+    if isinstance(pred, UnaryOp) and pred.op == "not":
+        x = _encode_having(pred.operand, env, values)
+        if x is None:
+            return None
+        return ("not", x)
+    if isinstance(pred, Between):
+        lo = _encode_having(
+            BinaryOp(">=", pred.expr, pred.low), env, values
+        )
+        hi = _encode_having(
+            BinaryOp("<=", pred.expr, pred.high), env, values
+        )
+        if lo is None or hi is None:
+            return None
+        both = ("and", lo, hi)
+        return ("not", both) if pred.negated else both
+    if isinstance(pred, IsNull):
+        ref = _ref_of(pred.expr, env)
+        if ref is None or ref[0] != "agg":
+            return None
+        return ("isnull", ref, bool(pred.negated))
+    if isinstance(pred, BinaryOp) and pred.op in _CMP_OPS:
+        lref, rref = _ref_of(pred.left, env), _ref_of(pred.right, env)
+        lval, rval = _num_literal(pred.left), _num_literal(pred.right)
+        if lref is not None and lref[0] == "agg" and rval is not None:
+            values.append(rval)
+            return ("cmp", pred.op, lref, len(values) - 1)
+        if rref is not None and rref[0] == "agg" and lval is not None:
+            values.append(lval)
+            return ("cmp", _SWAP[pred.op], rref, len(values) - 1)
+        if (
+            lref is not None and rref is not None
+            and lref[0] == "agg" and rref[0] == "agg"
+        ):
+            return ("cmpref", pred.op, lref, rref)
+    return None
+
+
+def derive_post_lowering(lowering, schema) -> DevicePost | None:
+    """Walk post_ops innermost-out, consuming what the device program can
+    finalize.  Consumption stops at the first unconsumable operator —
+    everything outward replays on the host over the compact result, which
+    is order/cardinality-correct because the device applies a prefix of
+    the original pipeline.  Pass-through Projects are never consumed (a
+    5-row projection is host noise) but extend the name environment so a
+    Sort above `SELECT max(x) AS mu` resolves `mu`."""
+    env = _build_env(lowering, schema)
+    if env is None:
+        return None
+    post = DevicePost()
+    values: list = []
+    sort_taken = False
+    limit_taken = False
+    for idx in range(len(lowering.post_ops) - 1, -1, -1):
+        op = lowering.post_ops[idx]
+        if isinstance(op, Project):
+            # extend env through pure renames; opaque outputs simply
+            # don't resolve if referenced above
+            for e in op.exprs:
+                ref = _ref_of(e, env)
+                if ref is not None:
+                    env[e.name()] = ref
+                    if isinstance(e, Alias):
+                        env[e.alias] = ref
+            continue
+        if isinstance(op, Having) and not sort_taken and not limit_taken:
+            # encode into a scratch copy (slot indices stay aligned with
+            # the shared list via the length offset); commit on success
+            # so a failed encode leaves no stray slots behind
+            scratch = list(values)
+            tree = _encode_having(op.predicate, env, scratch)
+            if tree is None:
+                break
+            values[:] = scratch
+            post.having = (
+                tree if post.having is None else ("and", post.having, tree)
+            )
+            post.consumed = post.consumed | {idx}
+            continue
+        if isinstance(op, Sort) and not sort_taken and not limit_taken:
+            keys = []
+            nulls_spec = op.nulls or [None] * len(op.keys)
+            ok = True
+            for (e, asc), nf in zip(op.keys, nulls_spec):
+                ref = _ref_of(e, env)
+                if ref is None:
+                    ok = False
+                    break
+                want_first = (not asc) if nf is None else bool(nf)
+                if ref[0] == "dim":
+                    is_bucket = (
+                        lowering.bucket is not None
+                        and ref[1] == len(lowering.group_tags)
+                    )
+                    # tag codes are value-sorted with NULL as the max
+                    # code: code order gives exactly the SQL-default
+                    # placement (ASC nulls last / DESC nulls first);
+                    # an explicit non-default placement can't ride it
+                    if not is_bucket and want_first != (not asc):
+                        ok = False
+                        break
+                keys.append((ref, bool(asc), want_first))
+            if not ok:
+                break
+            post.order = tuple(keys)
+            post.consumed = post.consumed | {idx}
+            sort_taken = True
+            continue
+        if isinstance(op, Limit) and not limit_taken:
+            if op.limit is None or op.limit < 0 or op.offset < 0:
+                break
+            post.limit = int(op.limit)
+            post.offset = int(op.offset)
+            post.consumed = post.consumed | {idx}
+            limit_taken = True
+            continue
+        break
+    post.having_values = tuple(values)
+    return post

@@ -301,6 +301,15 @@ def map_aggs(e: Expr, fn) -> Expr:
         return UnaryOp(e.op, map_aggs(e.operand, fn))
     if isinstance(e, FuncCall):
         return FuncCall(e.func, tuple(map_aggs(a, fn) for a in e.args))
+    if isinstance(e, Between):
+        return Between(
+            map_aggs(e.expr, fn), map_aggs(e.low, fn), map_aggs(e.high, fn),
+            e.negated,
+        )
+    if isinstance(e, IsNull):
+        return IsNull(map_aggs(e.expr, fn), e.negated)
+    if isinstance(e, InList):
+        return InList(map_aggs(e.expr, fn), e.values, e.negated)
     return e
 
 

@@ -84,6 +84,13 @@ register(
     "layout",
 )
 register(
+    "device_finalize",
+    "run Sort/LIMIT/HAVING and result compaction on device over the "
+    "finalized [K, G] states so the one device->host fetch is O(rows_out) "
+    "instead of O(groups)",
+    "layout",
+)
+register(
     "time_major",
     "permute value planes time-major so bucket-only group-bys reduce "
     "over contiguous runs",

@@ -49,6 +49,10 @@ class Lowering:
     post_ops: list[LogicalPlan] = field(default_factory=list)  # outer-first
     group_exprs: list[Expr] = field(default_factory=list)
     agg_exprs: list[Expr] = field(default_factory=list)
+    # indices into post_ops the DEVICE program already applied (set by the
+    # tile executor when Sort/LIMIT/HAVING finalized on device — see
+    # query/device_finalize.py); _run_post_ops skips exactly these
+    post_done: frozenset = frozenset()
 
 
 def _post_has_subquery(node) -> bool:
@@ -307,12 +311,22 @@ class TpuExecutor:
 
     def _run_post_ops(self, table: pa.Table, lowering: Lowering) -> pa.Table:
         """Replay Having/Project/Sort/Limit over the aggregated table with
-        the CPU executor (the small, frontend-side upper plan)."""
-        if not lowering.post_ops:
+        the CPU executor (the small, frontend-side upper plan).  Operators
+        the device program already finalized (lowering.post_done — on-device
+        Sort/LIMIT/HAVING over the [K, G] states) are skipped; the replay
+        order of the rest is preserved, which stays correct because the
+        skipped set is always an inner prefix modulo pass-through Projects
+        (see query/device_finalize.py)."""
+        remaining = [
+            op
+            for i, op in enumerate(lowering.post_ops)
+            if i not in lowering.post_done
+        ]
+        if not remaining:
             return table
         # Rebuild the post-plan bottom-up over a scan of the result table.
         plan: LogicalPlan = TableScan(table="__tpu_result")
-        for op in reversed(lowering.post_ops):
+        for op in reversed(remaining):
             if isinstance(op, Having):
                 plan = Having(plan, op.predicate)
             elif isinstance(op, Project):

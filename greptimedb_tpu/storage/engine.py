@@ -67,6 +67,10 @@ class TimeSeriesEngine:
         )
         self._regions: dict[int, Region] = {}
         self._lock = threading.Lock()
+        # flush listeners: called with the region id after a flush that
+        # added SSTs (the tile.prewarm_on_flush hook rides this); always
+        # best-effort, never on the write path's critical section
+        self.flush_listeners: list = []
         self.compactor = None
         self.flusher = None
         self._workers = None  # lazy sharded write loops (storage/worker.py)
@@ -222,6 +226,12 @@ class TimeSeriesEngine:
         self.buffer_mgr.set_region_usage(region_id, region.memtable.memory_usage)
         if added and self.compactor is not None:
             self.compactor.notify_flush(region_id)
+        if added:
+            for cb in list(self.flush_listeners):
+                try:
+                    cb(region_id)
+                except Exception:  # noqa: BLE001 — listeners are advisory
+                    pass
 
     def flush_all(self):
         if self.flusher is not None:

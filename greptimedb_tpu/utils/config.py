@@ -162,6 +162,14 @@ class QueryConfig:
     # value but loses low bits beyond that — set "float64" for exact f64
     # accumulation (per-column VPU kernels, ~6x slower at TSBS scale).
     tile_acc_dtype: str = "limb"
+    # Device-side result finalization (parallel/tile_cache.py + the
+    # "device_finalize" pass): recognized Sort/LIMIT/HAVING post-plans and
+    # empty-group compaction run INSIDE the compiled tile program over the
+    # finalized [K, G] states, so the single device->host fetch ships
+    # O(rows_out) bytes (a [K, limit]/[K, top_groups] buffer + a compact
+    # group-id vector) instead of O(groups).  Off restores the host
+    # post-op path exactly (full-buffer fetch, CPU Sort/Limit/Having).
+    device_topk: bool = True
     # Per-statement wall-clock budget (seconds; 0 disables).  Enforced
     # cooperatively (utils/deadline.py): scan loops, row-group reads and
     # plan-node execution check it between units of work, so a query that
@@ -251,6 +259,27 @@ class ReplicaConfig:
 
 
 @dataclasses.dataclass
+class TileConfig:
+    """HBM super-tile lifecycle knobs that are about WHEN tiles build, not
+    how queries run (those live under query.*): `prewarm_on_flush` moves
+    the cold-path consolidation + upload + limb quantize off the first
+    query of each TSBS family and onto a background thread at flush time,
+    reusing the persistent XLA compilation cache (utils/jax_env.py).
+    `Database.prewarm()` is the explicit form of the same build."""
+
+    # Build super-tiles (and limb planes) in the background after a flush
+    # lands, so the first query of a family stops paying the 10-170 s cold.
+    prewarm_on_flush: bool = False
+    # Coalesce flush storms: a region's prewarm runs this long after its
+    # LAST flush notification, not once per flush.
+    prewarm_debounce_s: float = 2.0
+    # Also quantize MXU limb planes during prewarm (sum/avg families).
+    prewarm_limbs: bool = True
+    # Restrict prewarm to these tables (empty = every tileable base table).
+    prewarm_tables: tuple = ()
+
+
+@dataclasses.dataclass
 class MemoryConfig:
     """Admission-style memory governance (reference common/memory-manager,
     servers request_memory_limiter `max_in_flight_write_bytes`,
@@ -274,6 +303,7 @@ class Config:
     telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
     breaker: BreakerConfig = dataclasses.field(default_factory=BreakerConfig)
     replica: ReplicaConfig = dataclasses.field(default_factory=ReplicaConfig)
+    tile: TileConfig = dataclasses.field(default_factory=TileConfig)
 
     def __post_init__(self):
         self.storage.__post_init__()
@@ -286,7 +316,17 @@ class Config:
         config mistakes, not modes."""
         from .errors import ConfigError
 
-        q, b = self.query, self.breaker
+        q, b, t = self.query, self.breaker, self.tile
+        if not isinstance(q.device_topk, bool):
+            raise ConfigError(
+                "query.device_topk must be a boolean (on-device Sort/LIMIT/"
+                f"HAVING finalization); got {q.device_topk!r}"
+            )
+        if t.prewarm_debounce_s < 0:
+            raise ConfigError(
+                "tile.prewarm_debounce_s must be >= 0 seconds (how long after "
+                f"the last flush a prewarm build starts); got {t.prewarm_debounce_s!r}"
+            )
         if q.hedge_delay_ms < 0:
             raise ConfigError(
                 "query.hedge_delay_ms must be >= 0 milliseconds (0 disables hedging); "

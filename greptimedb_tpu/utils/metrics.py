@@ -169,6 +169,49 @@ TILE_PERSIST_WRITES = REGISTRY.counter("greptime_tile_persist_writes_total", "Su
 TILE_WINDOW_BUILDS = REGISTRY.counter("greptime_tile_window_builds_total", "Compact window tiles gathered from sorted encodes")
 TILE_HOST_FAST_PATH = REGISTRY.counter("greptime_tile_host_fast_path_total", "Selective queries served from the sorted host encode cache")
 TILE_STREAM_QUERIES = REGISTRY.counter("greptime_tile_stream_total", "Queries whose working set exceeded the HBM budget, executed region-streamed")
+
+# Device-side result finalization + readback accounting (the O(rows_out)
+# fetch contract): BYTES are the honest unit on a remote-device link —
+# greptime_tile_readback_ms conflates compute with transfer because
+# device_get blocks on the async dispatch, so tests and the bench assert
+# on bytes.  Dispatch/fetch counters back the one-dispatch-one-fetch
+# invariant test.
+TPU_READBACK_BYTES = REGISTRY.counter(
+    "greptime_tpu_readback_bytes_total",
+    "Device->host result bytes fetched per lowered query (the O(rows_out) contract)",
+)
+TPU_READBACK_MS = REGISTRY.histogram(
+    "greptime_tpu_readback_ms",
+    "Device->host result fetch milliseconds (includes waiting out the async dispatch)",
+)
+TPU_DEVICE_DISPATCHES = REGISTRY.counter(
+    "greptime_tpu_device_dispatches_total",
+    "Compiled tile programs dispatched (one per lowered query attempt)",
+)
+TPU_DEVICE_FETCHES = REGISTRY.counter(
+    "greptime_tpu_device_fetches_total",
+    "Device->host result fetches (one per lowered query attempt)",
+)
+TPU_DEVICE_FINALIZE = REGISTRY.counter(
+    "greptime_tpu_device_finalize_total",
+    "Queries whose Sort/Limit/HAVING/compaction ran on device (O(rows_out) readback)",
+)
+TPU_COMPILE_CACHE_HITS = REGISTRY.counter(
+    "greptime_tpu_compile_cache_hits_total",
+    "Tile-program builds served from the in-process program cache",
+)
+TPU_COMPILE_CACHE_MISSES = REGISTRY.counter(
+    "greptime_tpu_compile_cache_misses_total",
+    "Tile-program builds that traced + compiled fresh",
+)
+PREWARM_BUILDS = REGISTRY.counter(
+    "greptime_tpu_prewarm_builds_total",
+    "Regions whose super-tiles/limb planes were built by prewarm (off the query path)",
+)
+PREWARM_MS = REGISTRY.histogram(
+    "greptime_tpu_prewarm_ms",
+    "Wall milliseconds spent in prewarm builds",
+)
 DIST_STATE_QUERIES = REGISTRY.counter("greptime_query_dist_state_total", "Distributed queries merged from shipped states")
 COMPACTION_BACKGROUND = REGISTRY.counter("greptime_mito_compaction_background_total", "Background compaction merges")
 COMPACTION_FAILED = REGISTRY.counter("greptime_mito_compaction_failed_total", "Compaction rounds that errored")

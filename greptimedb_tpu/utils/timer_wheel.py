@@ -1,0 +1,116 @@
+"""Shared timer wheel: arm many one-shot timers cheaply on one thread.
+
+The frontend's hedged reads need one timer PER REGION of a fan-out, all
+anchored at the fan-out submit time — the previous implementation armed
+each region's hedge only when the sequential settle loop reached it, so a
+slow early region delayed every later region's hedge (the ROADMAP
+"fully concurrent hedge scheduling" item).  A wheel arms them all at
+submit: callbacks fire on the wheel thread at their deadline regardless
+of which region the gather is currently waiting on.
+
+Callbacks must be cheap (the frontend's submits a pool task).  Entries
+support cancel(): True means the callback will never run; False means it
+already started — `wait()` then blocks until it finished, so callers can
+distinguish "no hedge will ever exist" from "a hedge may be in flight".
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+
+
+class TimerEntry:
+    __slots__ = ("_fn", "_state", "_done", "_lock")
+
+    # states: pending -> (cancelled | running -> fired)
+    def __init__(self, fn):
+        self._fn = fn
+        self._state = "pending"
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+
+    def cancel(self) -> bool:
+        """True if the callback will never run."""
+        with self._lock:
+            if self._state == "pending":
+                self._state = "cancelled"
+                self._done.set()
+                return True
+            return self._state == "cancelled"
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the callback finished (or was cancelled)."""
+        return self._done.wait(timeout)
+
+    def _run(self):
+        with self._lock:
+            if self._state != "pending":
+                return
+            self._state = "running"
+        try:
+            # a raising callback must not kill the SHARED wheel thread —
+            # that would silently disable every future timer (e.g. all
+            # hedged reads of a frontend); log and carry on
+            self._fn()
+        except Exception:  # noqa: BLE001 — isolation over propagation
+            import logging
+
+            logging.getLogger("greptimedb_tpu.timer_wheel").warning(
+                "timer callback raised", exc_info=True
+            )
+        finally:
+            self._state = "fired"
+            self._done.set()
+
+
+class TimerWheel:
+    """Min-heap of (deadline, entry) served by one lazy daemon thread."""
+
+    def __init__(self, name: str = "timer-wheel"):
+        self._name = name
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._cv = threading.Condition()
+        self._thread: threading.Thread | None = None
+        self._stopped = False
+
+    def schedule(self, delay_s: float, fn) -> TimerEntry:
+        entry = TimerEntry(fn)
+        when = time.monotonic() + max(delay_s, 0.0)
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("timer wheel stopped")
+            heapq.heappush(self._heap, (when, next(self._seq), entry))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, name=self._name, daemon=True
+                )
+                self._thread.start()
+            self._cv.notify()
+        return entry
+
+    def stop(self):
+        with self._cv:
+            self._stopped = True
+            for _w, _s, entry in self._heap:
+                entry.cancel()
+            self._heap.clear()
+            self._cv.notify()
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._heap and not self._stopped:
+                    self._cv.wait(timeout=1.0)
+                if self._stopped:
+                    return
+                now = time.monotonic()
+                when, _seq, entry = self._heap[0]
+                if when > now:
+                    self._cv.wait(timeout=when - now)
+                    continue
+                heapq.heappop(self._heap)
+            entry._run()  # outside the lock: callbacks may schedule more
