@@ -40,6 +40,7 @@ Prints ONE final JSON line; headline = double-groupby-1 warm end-to-end p50.
 from __future__ import annotations
 
 import faulthandler
+import hashlib
 import json
 import math
 import os
@@ -91,7 +92,30 @@ LTH_START_MAX_S = float(
 )
 # hard rc=0 guarantee: a watchdog emits the final summary line and exits 0
 # this many seconds BEFORE the budget, whatever is still running
-WATCHDOG_GRACE_S = float(os.environ.get("GRAFT_BENCH_WATCHDOG_GRACE_S", 45))
+WATCHDOG_GRACE_S = float(os.environ.get("GRAFT_BENCH_WATCHDOG_GRACE_S", 60))
+# Persistent dataset + tile-artifact home: ingested SSTs, persisted
+# super-tile consolidations (_persist_async) and the XLA compile cache
+# survive under a dataset-parameter hash, so the ~260 s ingest and the
+# first-build colds are paid ONCE — later runs (and the second-process
+# cold probe) reopen and go straight to queries.  Empty disables (fresh
+# tmpdir per run).
+DATA_DIR = os.environ.get(
+    "GRAFT_BENCH_DATA_DIR",
+    os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "graft_bench_data"
+    ),
+)
+
+
+def _dataset_key() -> str:
+    sig = json.dumps(
+        {
+            "hosts": N_HOSTS, "hours": HOURS, "scrape": SCRAPE_S,
+            "metrics": METRICS, "seed": 7, "t0": T0, "v": 1,
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha1(sig.encode()).hexdigest()[:12]
 
 END = T0 + HOURS * 3600_000
 W12 = (END - 12 * 3600_000, END)
@@ -241,6 +265,12 @@ def _emit_final_locked():
         }
     )
     _write_partial({"detail": detail, "queries": results})
+    try:
+        # tells the guard process the record landed (see _start_guard)
+        with open(PARTIAL_PATH + ".done", "w") as f:
+            f.write("1")
+    except OSError:
+        pass
 
 
 def _on_term(signum, frame):  # noqa: ARG001 — signal signature
@@ -274,6 +304,17 @@ def _start_budget_watchdog():
             if left <= 0:
                 break
             time.sleep(min(left, 5.0))
+            # keep the on-disk partial fresh on every tick: even if this
+            # thread never gets to emit (a wedged native op holds the
+            # GIL), the guard process can still publish a parseable
+            # record from the last write BEFORE the deadline
+            try:
+                _write_partial({
+                    "detail": dict(_STATE["detail"]),
+                    "queries": dict(_STATE["results"]),
+                })
+            except Exception:  # noqa: BLE001 — bookkeeping only
+                pass
         if _STATE["emitted"]:
             return
         _STATE["detail"]["budget_watchdog_fired"] = True
@@ -297,6 +338,53 @@ def _start_budget_watchdog():
             os._exit(0)
 
     threading.Thread(target=run, name="bench-budget-watchdog", daemon=True).start()
+
+
+def _start_guard_process():
+    """Wedge-proof parseable-output guarantee: a tiny subprocess sharing
+    this process's stdout that, if the parent has NOT emitted its summary
+    by the deadline (done-marker absent), prints a one-line record built
+    from BENCH_PARTIAL.json itself.  The in-process watchdog cannot run
+    when a native op (XLA compile, a blocked device fetch) wedges every
+    Python thread — rounds 2-5 all ended rc=124 with the record emitted
+    only AFTER the driver's kill, i.e. never.  The guard's line lands on
+    the shared stdout BEFORE the deadline regardless of parent state."""
+    import subprocess
+
+    deadline = max(BUDGET_S - max(WATCHDOG_GRACE_S / 3.0, 15.0), 30.0)
+    code = (
+        "import json,os,sys,time\n"
+        "deadline=float(sys.argv[1]); partial=sys.argv[2]; ppid=int(sys.argv[3])\n"
+        "marker=partial+'.done'\n"
+        "t0=time.time()\n"
+        "while time.time()-t0 < deadline:\n"
+        "    time.sleep(2.0)\n"
+        "    if os.path.exists(marker): sys.exit(0)\n"
+        "    try: os.kill(ppid, 0)\n"
+        "    except OSError: sys.exit(0)\n"
+        "if os.path.exists(marker): sys.exit(0)\n"
+        "detail={'guard_emitted': True}; queries={}\n"
+        "try:\n"
+        "    with open(partial) as f: d=json.load(f)\n"
+        "    detail.update(d.get('detail', {})); queries=d.get('queries', {})\n"
+        "except Exception: pass\n"
+        "detail['queries']=queries\n"
+        "print(json.dumps({'metric':'tsbs_double_groupby_1_e2e_warm_p50',"
+        "'value':None,'unit':'ms','vs_baseline':None,'detail':detail}),"
+        " flush=True)\n"
+    )
+    try:
+        os.unlink(PARTIAL_PATH + ".done")
+    except OSError:
+        pass
+    try:
+        subprocess.Popen(
+            [sys.executable, "-c", code, str(deadline), PARTIAL_PATH,
+             str(os.getpid())],
+            stdin=subprocess.DEVNULL, stdout=None, stderr=subprocess.DEVNULL,
+        )
+    except Exception:  # noqa: BLE001 — the guard is insurance, not a dep
+        pass
 
 
 def _probe_link(jax, jnp) -> dict:
@@ -524,6 +612,8 @@ def _larger_than_hbm_probe() -> dict:
 def main():
     ensure_x64()
     _start_budget_watchdog()
+    _start_guard_process()
+    import shutil
     import tempfile
 
     import jax
@@ -536,7 +626,27 @@ def main():
     results: dict = _STATE["results"]
     headline = None
 
-    home = tempfile.mkdtemp(prefix="graft_bench_")
+    # persistent dataset home: the ingest + flush + persisted tile
+    # consolidations are keyed by the dataset-parameter hash and reused
+    # by later runs (and this run's second-process cold probe)
+    reuse = False
+    marker = None
+    if DATA_DIR:
+        home = os.path.join(DATA_DIR, f"tsbs_{_dataset_key()}")
+        marker = os.path.join(home, "INGESTED.json")
+        if os.path.exists(marker):
+            try:
+                with open(marker) as f:
+                    reuse = json.load(f).get("key") == _dataset_key()
+            except Exception:  # noqa: BLE001 — torn marker = no reuse
+                reuse = False
+        if not reuse and os.path.isdir(home) and os.listdir(home):
+            # torn previous ingest (killed mid-run): start clean
+            shutil.rmtree(home, ignore_errors=True)
+        os.makedirs(home, exist_ok=True)
+    else:
+        home = tempfile.mkdtemp(prefix="graft_bench_")
+    detail["dataset_reused"] = reuse
     db = Database(data_home=home)
     # cost-based routing: sub-threshold scans run on the LOCAL CPU path
     # (no tunnel round-trip) — the same local-vs-local comparison the
@@ -556,12 +666,16 @@ def main():
     if os.environ.get("GRAFT_BENCH_NO_FALLBACK"):
         db.config.query.fallback_to_cpu = False
     cols_sql = ", ".join(f"{mm} DOUBLE" for mm in METRICS)
-    db.sql(
-        f"CREATE TABLE cpu (hostname STRING, ts TIMESTAMP(3) TIME INDEX, "
-        f"{cols_sql}, PRIMARY KEY (hostname)) WITH (append_mode = 'true')"
-    )
+    if not reuse:
+        db.sql(
+            f"CREATE TABLE cpu (hostname STRING, ts TIMESTAMP(3) TIME INDEX, "
+            f"{cols_sql}, PRIMARY KEY (hostname)) WITH (append_mode = 'true')"
+        )
 
     # ---- ingest (chunked; the servers' insert_rows path) -------------------
+    # On reuse the SSTs are already on disk: the loop still runs the SAME
+    # rng stream to rebuild the independent ground truth, skipping only
+    # the inserts — generation is ~seconds, ingest was the ~260 s cost.
     rng = np.random.default_rng(7)
     ticks_total = HOURS * 3600 // SCRAPE_S
     chunk_ticks = max(1, 2_000_000 // N_HOSTS)
@@ -575,17 +689,18 @@ def main():
         ts = np.broadcast_to(ts, (ticks, N_HOSTS)).reshape(-1)
         hs = np.broadcast_to(hosts_arr[None, :], (ticks, N_HOSTS)).reshape(-1)
         vals = {mm: rng.uniform(0.0, 100.0, ticks * N_HOSTS) for mm in METRICS}
-        batch = pa.table(
-            {
-                "hostname": pa.array(hs),
-                "ts": pa.array(ts, pa.timestamp("ms")),
-                **{mm: pa.array(vals[mm], pa.float64()) for mm in METRICS},
-            }
-        )
-        t0 = time.perf_counter()
-        db.insert_rows("cpu", batch)
-        t_ing += time.perf_counter() - t0
-        n_rows += batch.num_rows
+        if not reuse:
+            batch = pa.table(
+                {
+                    "hostname": pa.array(hs),
+                    "ts": pa.array(ts, pa.timestamp("ms")),
+                    **{mm: pa.array(vals[mm], pa.float64()) for mm in METRICS},
+                }
+            )
+            t0 = time.perf_counter()
+            db.insert_rows("cpu", batch)
+            t_ing += time.perf_counter() - t0
+        n_rows += ticks * N_HOSTS
         in_w = (ts >= W12[0]) & (ts < W12[1])
         if in_w.any():
             hour = ((ts[in_w] - W12[0]) // 3600_000).astype(np.int64)
@@ -600,13 +715,22 @@ def main():
                 acc[0] += sums[k]
                 acc[1] += int(cnts[k])
     t0 = time.perf_counter()
-    db.storage.flush_all()
+    if not reuse:
+        db.storage.flush_all()
     t_flush = time.perf_counter() - t0
     detail["rows"] = n_rows
-    detail["ingest_inprocess_rows_per_sec"] = round(n_rows / t_ing)
+    if not reuse:
+        detail["ingest_inprocess_rows_per_sec"] = round(n_rows / max(t_ing, 1e-9))
     detail["ingest_reference_rows_per_sec"] = 326_839
     detail["flush_secs"] = round(t_flush, 1)
-    _emit({"event": "ingested", "rows": n_rows, "secs": round(t_ing + t_flush, 1),
+    if marker and not reuse:
+        try:
+            with open(marker, "w") as f:
+                json.dump({"key": _dataset_key(), "rows": n_rows}, f)
+        except OSError:
+            pass
+    _emit({"event": "ingested", "rows": n_rows, "reused": reuse,
+           "secs": round(t_ing + t_flush, 1),
            "elapsed_s": round(_elapsed(), 1)})
     _write_partial({"detail": detail, "queries": results})
 
@@ -699,6 +823,11 @@ def main():
                 m.TPU_READBACK_MS.sum(), m.TPU_READBACK_MS.total(),
                 m.TPU_READBACK_BYTES.get(),
             )
+            rbs0 = (
+                m.TPU_READBACK_TRANSFER_MS.sum(),
+                m.TPU_READBACK_DECODE_MS.sum(),
+                m.TPU_READBACK_STREAMED.get(),
+            )
             cc0 = m.TPU_COMPILE_CACHE_MISSES.get()
             rep_errs = 0
             for _rep in range(WARM_REPS):
@@ -753,6 +882,17 @@ def main():
                 # fetch: host fast path / cold serve / CPU route)
                 device_fetches=int(n_rb),
                 readback_ms_avg=round((rb1[0] - rb0[0]) / n_rb, 2) if n_rb else 0.0,
+                # transfer vs host-decode split per query (streamed-
+                # readback wins must be attributable, not inferred)
+                readback_transfer_ms_avg=round(
+                    (m.TPU_READBACK_TRANSFER_MS.sum() - rbs0[0]) / n_rb, 2
+                ) if n_rb else 0.0,
+                readback_decode_ms_avg=round(
+                    (m.TPU_READBACK_DECODE_MS.sum() - rbs0[1]) / n_rb, 2
+                ) if n_rb else 0.0,
+                readback_streamed=int(
+                    m.TPU_READBACK_STREAMED.get() - rbs0[2]
+                ),
                 readback_bytes_avg=round((rb1[2] - rb0[2]) / n_rb) if n_rb else 0,
                 # a warm rep that re-traces is a cache bug: make it visible
                 compile_misses_warm=int(m.TPU_COMPILE_CACHE_MISSES.get() - cc0),
@@ -861,6 +1001,13 @@ def main():
     }
     detail["device_finalized_queries"] = m.TPU_DEVICE_FINALIZE.get()
     detail["readback_bytes_total"] = m.TPU_READBACK_BYTES.get()
+    detail["readback_streamed_total"] = m.TPU_READBACK_STREAMED.get()
+    detail["tile_delta"] = {
+        "merges": m.TILE_DELTA_MERGES.get(),
+        "rows": m.TILE_DELTA_ROWS.get(),
+        "pipelined_builds": m.TILE_PIPELINED_BUILDS.get(),
+        "precompiles": m.TPU_PRECOMPILES.get(),
+    }
     detail["method"] = (
         "end-to-end Database.sql() wall time over real flushed Parquet SSTs: "
         "parse+plan+lowering+ONE dispatch+ONE device fetch+finalize. Warm = "

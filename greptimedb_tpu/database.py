@@ -164,6 +164,11 @@ class Database:
             view_provider=self._view_stmt,
             vector_search_provider=self._vector_search,
         )
+        # Lifecycle knobs (tile.incremental delta maintenance,
+        # tile.pipelined_build) reach the cache through config.tile, read
+        # at decision time so tests and operators can flip them live.
+        if self.query_engine.tile_cache is not None:
+            self.query_engine.tile_cache.tile_config = self.config.tile
         from collections import OrderedDict
 
         from .utils.telemetry_report import TelemetryTask
@@ -1159,7 +1164,12 @@ class Database:
                         return tid_cache[tid]
             return None
 
-        def on_flush(region_id: int):
+        def on_flush(region_id: int, added_file_ids=None):
+            # `added_file_ids` is the engine's delta notification (the SSTs
+            # this flush appended): the debounced prewarm below re-enters
+            # TileCacheManager.super_tiles, which merges exactly those
+            # files' rows into the cached entry (tile.incremental) instead
+            # of rebuilding — so a flush storm costs O(sum of deltas).
             key = resolve(region_id // MAX_REGIONS_PER_TABLE)
             if key is None:
                 return
@@ -1199,7 +1209,9 @@ class Database:
             target=loop, name="tile-prewarm", daemon=True
         )
         self._prewarm_thread.start()
-        self.storage.flush_listeners.append(on_flush)
+        # delta_listeners carries (region_id, added_file_ids) — the
+        # incremental build consumes exactly those files' rows
+        self.storage.delta_listeners.append(on_flush)
 
     def _vector_search(self, vs) -> pa.Table:
         """Top-k nearest rows for a VectorSearch node.

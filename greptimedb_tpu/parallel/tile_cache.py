@@ -140,6 +140,90 @@ def _chunk_bounds(pad: int, chunk_rows: int = TILE_CHUNK_ROWS) -> list[tuple[int
     ]
 
 
+def _lex_merge_positions(
+    old_keys: list[np.ndarray], new_keys: list[np.ndarray]
+) -> np.ndarray:
+    """Merge positions of two LEXICOGRAPHICALLY sorted runs: for each row
+    of the (sorted) delta run, the number of old-run rows that precede it
+    in the merged order.  Ties place the old run FIRST (side='right'),
+    which is exactly flush order — so merging with these positions is
+    bit-identical to the stable lexsort of the full concatenation a
+    from-scratch rebuild performs.  Keys are listed major-first.
+
+    Vectorized binary search over the old run: O(delta · keys · log old)
+    — the delta build's whole point is that no O(total · log total)
+    re-sort happens."""
+    n_old = len(old_keys[0]) if old_keys else 0
+    n_new = len(new_keys[0]) if new_keys else 0
+    if n_new == 0:
+        return np.zeros(0, np.int64)
+    lo = np.zeros(n_new, np.int64)
+    if n_old == 0:
+        return lo
+    hi = np.full(n_new, n_old, np.int64)
+    while True:
+        active = lo < hi
+        if not active.any():
+            break
+        mid = (lo + hi) >> 1
+        # inactive lanes (lo == hi) may sit at n_old: clip the index —
+        # their comparison result is discarded by the `active` mask
+        safe = np.minimum(mid, n_old - 1)
+        # lexicographic old[mid] <= new: undecided ties fall through to
+        # the next (more minor) key; fully-equal keys compare <=.
+        gt = np.zeros(n_new, bool)
+        decided = np.zeros(n_new, bool)
+        for a, b in zip(old_keys, new_keys):
+            av = a[safe]
+            lt_k = ~decided & (av < b)
+            gt_k = ~decided & (av > b)
+            gt |= gt_k
+            decided |= lt_k | gt_k
+        le = ~gt
+        lo = np.where(active & le, mid + 1, lo)
+        hi = np.where(active & ~le, mid, hi)
+    return lo
+
+
+@functools.partial(jax.jit, static_argnames=("old_n", "new_pad"))
+def _delta_patch(full, delta_vals, pos, old_n: int, new_pad: int):
+    """On-device plane patch for a delta merge: scatter the resident
+    (sorted) rows and the uploaded delta-sorted run into the merged
+    order.  Only `pos` (O(delta) int32) and `delta_vals` cross the
+    host->device link — the old rows move at HBM bandwidth."""
+    n_delta = delta_vals.shape[0]
+    iota_old = jnp.arange(old_n, dtype=jnp.int32)
+    idx_old = iota_old + jnp.searchsorted(pos, iota_old, side="right").astype(
+        jnp.int32
+    )
+    idx_new = pos + jnp.arange(n_delta, dtype=jnp.int32)
+    out = jnp.zeros(new_pad, full.dtype)
+    out = out.at[idx_old].set(full[:old_n])
+    out = out.at[idx_new].set(delta_vals.astype(full.dtype))
+    return out
+
+
+def _entry_device_bytes(entry: "_SuperTiles") -> int:
+    """Recompute an entry's resident device bytes from its live planes
+    (the delta merge swaps whole plane sets; recomputing beats chasing
+    increments)."""
+    total = 0
+    for d in (entry.cols, entry.nulls, entry.tm_cols, entry.tm_nulls):
+        for chunks in d.values():
+            total += sum(int(x.nbytes) for x in chunks)
+    for planes in (
+        entry.valid, entry.valid_dedup, entry.tm_valid, entry.tm_valid_dedup
+    ):
+        if planes is not None:
+            total += sum(int(x.nbytes) for x in planes)
+    if entry.perm is not None:
+        total += int(entry.perm.nbytes)
+    for chunks in entry.limb_cols.values():
+        total += sum(int(l.nbytes) + int(s.nbytes) for l, s in chunks)
+    total += sum(wt["nbytes"] for wt in entry.window_tiles.values())
+    return total
+
+
 @dataclass
 class TileContext:
     """What the Database hands the tile executor for one table scan."""
@@ -285,6 +369,9 @@ class TileCacheManager:
         # QueryConfig wired by the engine: pass toggles (disabled_passes)
         # reach chunk placement through it
         self.config = None
+        # TileConfig wired by the Database: lifecycle knobs (incremental
+        # delta maintenance, pipelined cold builds).  None = defaults on.
+        self.tile_config = None
         self._persist_pool: set[str] = set()  # filesets being written
         self._lock = threading.RLock()
         self._super: OrderedDict[int, _SuperTiles] = OrderedDict()
@@ -296,6 +383,12 @@ class TileCacheManager:
         # row-count mismatch): excluded from the entry; queries whose
         # window touches them fall back to the scan path
         self._bad_files: set[tuple[int, str]] = set()
+
+    def _tile_opt(self, name: str, default):
+        """Lifecycle knob lookup: config.tile when wired, else default."""
+        if self.tile_config is not None:
+            return getattr(self.tile_config, name, default)
+        return default
 
     # ---- bookkeeping -------------------------------------------------------
     def has_region(self, region_id: int) -> bool:
@@ -791,13 +884,45 @@ class TileCacheManager:
             with self._lock:
                 entry = self._super.get(rid)
                 if entry is not None:
-                    if entry.file_ids != ids:
-                        dropped = self._super.pop(rid)
-                        self._used -= dropped.nbytes
-                        self._host_used -= dropped.host_nbytes
-                        entry = None
-                    else:
-                        self._super.move_to_end(rid)
+                    self._super.move_to_end(rid)
+            if entry is not None and entry.file_ids != ids:
+                # a flush APPENDED files: extend the cached entry in place
+                # (delta encode + merge of sorted runs + on-device plane
+                # patch) instead of rebuilding from scratch — post-flush
+                # cold cost becomes O(delta rows).  Compactions/removals
+                # change the prefix and take the full rebuild.
+                extended = None
+                if not self._tile_opt("incremental", True):
+                    why = "tile.incremental off: full rebuild"
+                elif not passes.enabled("incremental_tile", self.config):
+                    why = "pass disabled: full rebuild"
+                elif not (
+                    len(ids) > len(entry.file_ids)
+                    and ids[: len(entry.file_ids)] == entry.file_ids
+                ):
+                    why = (
+                        "file set not an append of the cached one "
+                        "(compaction/removal): full rebuild"
+                    )
+                elif entry.order is None:
+                    why = "cached entry has no sort order yet: full rebuild"
+                else:
+                    why = "delta could not merge: full rebuild"
+                    extended = self._delta_extend(
+                        region, dictionary, entry, included, ids, host_need,
+                        tag_cols + pk_cols, ts_col, sort_cols,
+                        pinned_regions,
+                    )
+                if extended is None:
+                    passes.note("incremental_tile", False, why, region=rid)
+                    with self._lock:
+                        if self._super.get(rid) is entry:
+                            dropped = self._super.pop(rid)
+                            self._used -= dropped.nbytes
+                            self._host_used -= dropped.host_nbytes
+                    entry = None
+                else:
+                    entry = extended
             if entry is None:
                 total = sum(m.num_rows for m in included)
                 entry = _SuperTiles(
@@ -957,6 +1082,71 @@ class TileCacheManager:
             return entry, excluded
         return None, list(metas)
 
+    def _consolidate_column(self, entry: _SuperTiles, name, host_tiles):
+        """Host-side assembly of one column's consolidated (sorted,
+        padded) value buffer + optional null plane — the producer stage of
+        the pipelined cold build (CPU-bound: concat + order gather; mmap
+        page-in on the persisted path)."""
+        if host_tiles is None:
+            return entry.persisted_cols[name], entry.persisted_nulls.get(name)
+        src = next(
+            (ht.cols[name] for ht in host_tiles if name in ht.cols), None
+        )
+        dtype = src.dtype if src is not None else np.float64
+        cat = np.concatenate(
+            [
+                ht.cols[name]
+                if name in ht.cols
+                else np.zeros(ht.num_rows, dtype)
+                for ht in host_tiles
+            ]
+        )
+        buf = np.zeros(entry.pad, dtype=cat.dtype)
+        buf[: entry.num_rows] = cat[entry.order]
+        any_nulls = any(
+            name in ht.nulls or name in ht.absent for ht in host_tiles
+        )
+        nbuf = None
+        if any_nulls:
+            ncat = np.concatenate(
+                [
+                    ht.nulls[name]
+                    if name in ht.nulls
+                    else np.full(ht.num_rows, name not in ht.absent)
+                    for ht in host_tiles
+                ]
+            )
+            nbuf = np.zeros(entry.pad, bool)
+            nbuf[: entry.num_rows] = ncat[entry.order]
+        return buf, nbuf
+
+    def _land_column(
+        self, entry: _SuperTiles, name, buf, nbuf, bounds, acc: list,
+        tag_cols, pk_cols, dictionary, host_tiles,
+    ):
+        """Consumer stage: upload one consolidated column (+ null plane)
+        and stamp its dictionary epoch."""
+        if _TIMING:
+            print(f"TILE_TIMING super.upload.{name} start", flush=True)
+        entry.cols[name] = self._up_chunks(buf, bounds)
+        acc[0] += buf.nbytes
+        if nbuf is not None:
+            entry.nulls[name] = self._up_chunks(nbuf, bounds)
+            acc[0] += nbuf.nbytes
+        if name in tag_cols or name in pk_cols:
+            if host_tiles is None:
+                # persisted codes keep their STORED epoch (repair
+                # gathers them forward) — persisted_epochs, not
+                # entry.epochs, is authoritative: release_unneeded
+                # pops the latter, and restamping a re-upload with
+                # the current epoch would skip the repair gather
+                entry.epochs.setdefault(
+                    name,
+                    entry.persisted_epochs.get(name, dictionary.epoch),
+                )
+            else:
+                entry.epochs[name] = dictionary.epoch
+
     def _upload_missing(
         self, entry: _SuperTiles, missing, host_tiles, bounds, acc: list,
         tag_cols, pk_cols, dictionary,
@@ -964,62 +1154,350 @@ class TileCacheManager:
         """Consolidate + upload the missing columns of a super-tile entry.
         Device bytes accumulate into acc[0] AS each plane lands, so the
         caller can commit partial progress when a deadline abort unwinds
-        mid-loop (see super_tiles)."""
-        for name in missing:
-            check_deadline()  # per-column consolidate + upload
-            if _TIMING:
-                print(f"TILE_TIMING super.upload.{name} start", flush=True)
-            if host_tiles is None:
-                buf = entry.persisted_cols[name]
-                nbuf = entry.persisted_nulls.get(name)
+        mid-loop (see super_tiles).
+
+        With tile.pipelined_build (and the pipelined_build pass) on, the
+        serial per-column encode->upload loop becomes a two-stage
+        pipeline: a small worker pool consolidates column N+1 on the host
+        while column N's chunks cross the host->device link — the
+        overlap-compute-with-transfer discipline applied to the cold
+        path.  Workers inherit the caller's query deadline (propagate)."""
+        pipeline = (
+            self._tile_opt("pipelined_build", True)
+            and len(missing) > 1
+            and passes.enabled("pipelined_build", self.config)
+        )
+        if not pipeline:
+            for name in missing:
+                check_deadline()  # per-column consolidate + upload
+                buf, nbuf = self._consolidate_column(entry, name, host_tiles)
+                self._land_column(
+                    entry, name, buf, nbuf, bounds, acc,
+                    tag_cols, pk_cols, dictionary, host_tiles,
+                )
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..utils.deadline import propagate
+
+        workers = max(1, int(self._tile_opt("build_workers", 2)))
+        metrics.TILE_PIPELINED_BUILDS.inc()
+        passes.note(
+            "pipelined_build", True,
+            f"{len(missing)} column encodes overlap uploads on "
+            f"{workers} worker(s)",
+            columns=len(missing),
+        )
+        with ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="tile-build"
+        ) as pool:
+            pending = list(missing)
+            inflight: list[tuple] = []
+
+            def pump():
+                # bounded look-ahead: at most workers+1 consolidated
+                # buffers alive at once (each is pad * itemsize of host
+                # RAM — unbounded submission would hold every column)
+                while pending and len(inflight) <= workers:
+                    nm = pending.pop(0)
+                    inflight.append((
+                        nm,
+                        pool.submit(
+                            propagate(self._consolidate_column),
+                            entry, nm, host_tiles,
+                        ),
+                    ))
+
+            pump()
+            while inflight:
+                name, fut = inflight.pop(0)
+                buf, nbuf = fut.result()
+                pump()  # next column consolidates while this one uploads
+                check_deadline()
+                self._land_column(
+                    entry, name, buf, nbuf, bounds, acc,
+                    tag_cols, pk_cols, dictionary, host_tiles,
+                )
+
+    def _delta_extend(
+        self,
+        region: Region,
+        dictionary: TableDictionary,
+        entry: _SuperTiles,
+        included: list[FileMeta],
+        ids: tuple[str, ...],
+        host_need: list[str],
+        tag_like: list[str],
+        ts_col: str | None,
+        sort_cols: list[str],
+        pinned_regions: set[int],
+    ) -> _SuperTiles | None:
+        """Extend a cached super-tile IN PLACE after a flush appended
+        files: host-encode ONLY the delta files, merge their (pk, ts)-
+        sorted run into the cached sorted order (a binary-search merge of
+        two sorted runs — no O(total log total) re-sort), and PATCH every
+        resident device plane with one on-device scatter (`_delta_patch`)
+        so only the O(delta) positions + values cross the host->device
+        link.  Re-derivable planes (time-major copies, perm, limb planes,
+        dedup masks) drop and rebuild lazily from the patched planes;
+        window tiles whose window cannot contain a delta row survive
+        untouched.  Returns the extended entry (committed atomically under
+        the cache lock), or None when the delta cannot merge — the caller
+        then falls back to the drop-and-rebuild path, which is also the
+        exact `tile.incremental = false` behavior.
+
+        Parity invariant: both runs were STABLY sorted and ties resolve
+        old-run-first (= flush order), so the merged (order, sorted_host)
+        is bit-identical to a from-scratch stable lexsort of the full
+        concatenation — asserted by tests/test_tile_incremental.py.
+
+        Concurrency: every super_tiles caller holds the table's
+        dictionary lock (queries' epoch-sensitive section, prewarm's
+        per-region section), which serializes delta merges per table.
+        The commit below still re-checks the entry's identity AND that
+        its (file_ids, num_rows) are exactly the state this merge was
+        computed against, so even a caller bypassing the lock could
+        never double-apply a delta — it falls back to the rebuild."""
+        rid = entry.region_id
+        old_k = len(entry.file_ids)
+        old_ids = entry.file_ids
+        delta_metas = included[old_k:]
+        delta_rows = sum(m.num_rows for m in delta_metas)
+        if delta_rows == 0:
+            return None
+        if any(c not in entry.sorted_host for c in sort_cols):
+            return None  # entry predates a sort column: rebuild owns it
+        t_start = time.perf_counter()
+
+        # 1. host-encode the delta files only (per-file cache; old files
+        # are never touched).  Resident device columns must be patchable,
+        # so the delta decode also covers them.
+        resident = sorted(set(entry.cols) | set(entry.nulls))
+        need = list(dict.fromkeys(host_need + resident))
+        delta_tiles: list[_FileHostTiles] = []
+        for meta in delta_metas:
+            check_deadline()  # per-delta-file Parquet decode + encode
+            ht = self._file_host_tiles(
+                region, dictionary, meta, need, tag_like, ts_col
+            )
+            if ht is None:
+                return None  # bad delta file: the rebuild path re-gates it
+            delta_tiles.append(ht)
+
+        # 2. one epoch for every code plane BEFORE keys are compared: the
+        # delta encode may have grown the dictionary
+        with self._lock:
+            for ht in delta_tiles:
+                self._repair_host_locked(ht, dictionary)
+        self.repair_super([entry], dictionary, sorted(entry.epochs))
+
+        # 3. sort the delta, merge the two sorted runs
+        old_n = entry.num_rows
+        total = old_n + delta_rows
+        new_pad = padded_size(max(total, 1))
+        delta_cats = {
+            c: np.concatenate([ht.cols[c] for ht in delta_tiles])
+            for c in sort_cols
+        }
+        if sort_cols:
+            delta_order = np.lexsort(
+                [delta_cats[c] for c in reversed(sort_cols)]
+            ).astype(np.int64)
+        else:
+            delta_order = np.arange(delta_rows, dtype=np.int64)
+        delta_sorted = {c: delta_cats[c][delta_order] for c in sort_cols}
+        old_sorted = {c: np.asarray(entry.sorted_host[c]) for c in sort_cols}
+        pos = _lex_merge_positions(
+            [old_sorted[c] for c in sort_cols],
+            [delta_sorted[c] for c in sort_cols],
+        )
+        shift = np.searchsorted(pos, np.arange(old_n), side="right")
+        old_global = np.arange(old_n, dtype=np.int64) + shift
+        delta_global = pos + np.arange(delta_rows, dtype=np.int64)
+        new_order = np.empty(total, np.int32)
+        new_order[old_global] = np.asarray(entry.order, np.int32)
+        new_order[delta_global] = (old_n + delta_order).astype(np.int32)
+        new_sorted: dict[str, np.ndarray] = {}
+        for c in sort_cols:
+            arr = np.empty(total, old_sorted[c].dtype)
+            arr[old_global] = old_sorted[c]
+            arr[delta_global] = delta_sorted[c].astype(old_sorted[c].dtype)
+            new_sorted[c] = arr
+        new_offsets = np.concatenate([
+            np.asarray(entry.file_row_offsets),
+            old_n + np.cumsum([m.num_rows for m in delta_metas]),
+        ]).astype(np.int64)
+
+        # 4. patch resident device planes (single-device only: chunked
+        # multi-device planes have no cheap global scatter — those drop
+        # and re-upload lazily, still skipping the re-sort).
+        bounds = _chunk_bounds(new_pad, self.chunk_rows)
+        patch_device = entry.valid is not None and len(self.devices) == 1
+        patched_cols: dict[str, list] = {}
+        patched_nulls: dict[str, list] = {}
+        new_valid = None
+        if patch_device:
+            est = new_pad  # valid plane
+            for name, chunks in entry.cols.items():
+                # output plane + the jnp.concatenate transient of the old
+                # chunks inside patch() (skipped for single-chunk entries)
+                est += new_pad * chunks[0].dtype.itemsize * (
+                    2 if len(chunks) > 1 else 1
+                )
+            est += (len(entry.nulls) + len(entry.cols)) * new_pad  # nulls
+            with self._lock:
+                self._reserve_locked(est, pinned_regions | {rid})
+            pos_dev = jnp.asarray(pos.astype(np.int32))
+
+            def delta_col(name, dtype):
+                cat = np.concatenate([
+                    ht.cols[name]
+                    if name in ht.cols
+                    else np.zeros(ht.num_rows, dtype)
+                    for ht in delta_tiles
+                ])
+                return np.ascontiguousarray(cat[delta_order])
+
+            def delta_null(name):
+                if not any(
+                    name in ht.nulls or name in ht.absent
+                    for ht in delta_tiles
+                ):
+                    return None
+                ncat = np.concatenate([
+                    ht.nulls[name]
+                    if name in ht.nulls
+                    else np.full(ht.num_rows, name not in ht.absent)
+                    for ht in delta_tiles
+                ])
+                return np.ascontiguousarray(ncat[delta_order])
+
+            def patch(chunks, delta_np):
+                full = (
+                    jnp.concatenate(chunks) if len(chunks) > 1 else chunks[0]
+                )
+                out = _delta_patch(
+                    full, jnp.asarray(delta_np), pos_dev, old_n, new_pad
+                )
+                return [out[a:b] for a, b in bounds]
+
+            try:
+                for name, chunks in entry.cols.items():
+                    check_deadline()  # per-column delta upload + scatter
+                    dv = delta_col(name, np.dtype(chunks[0].dtype))
+                    patched_cols[name] = patch(chunks, dv)
+                    dn = delta_null(name)
+                    if name in entry.nulls:
+                        if dn is None:
+                            dn = np.ones(delta_rows, bool)
+                        patched_nulls[name] = patch(entry.nulls[name], dn)
+                    elif dn is not None and not dn.all():
+                        # the delta introduces the column's FIRST nulls:
+                        # old rows are all present
+                        patched_nulls[name] = patch(
+                            [jnp.ones(old_n, bool)], dn
+                        )
+                new_valid = [
+                    jnp.arange(a, b, dtype=jnp.int64) < total
+                    for a, b in bounds
+                ]
+            except QueryTimeoutError:
+                raise  # entry untouched: the old file set stays queryable
+            except Exception:  # noqa: BLE001 — e.g. device OOM mid-patch
+                # the contract is "None = caller falls back to the full
+                # rebuild", whose own OOM handling (reserve-first +
+                # emergency release) owns the recovery; the entry is
+                # untouched because the commit below never ran
+                logging.getLogger("greptimedb_tpu.tile").warning(
+                    "delta plane patch failed; falling back to rebuild",
+                    exc_info=True,
+                )
+                return None
+
+        # 5. atomic commit: nothing above mutated the entry, so a deadline
+        # abort or merge failure leaves the old file set fully queryable
+        delta_ts = delta_sorted.get(ts_col) if ts_col else None
+        with self._lock:
+            if (
+                self._super.get(rid) is not entry
+                or entry.file_ids != old_ids
+                or entry.num_rows != old_n
+            ):
+                # evicted or mutated mid-merge: the rebuild owns it (and a
+                # delta can never double-apply)
+                return None
+            old_dev = entry.nbytes
+            old_host = entry.host_nbytes
+            entry.file_ids = ids
+            entry.num_rows = total
+            entry.pad = new_pad
+            entry.order = new_order
+            entry.sorted_host = new_sorted
+            entry.host_epochs = {
+                c: dictionary.epoch for c in sort_cols if c != ts_col
+            }
+            entry.file_row_offsets = new_offsets
+            entry.keep_host = None
+            entry.valid_dedup = None
+            if patch_device:
+                entry.cols = patched_cols
+                entry.nulls = patched_nulls
+                entry.valid = new_valid
             else:
-                src = next(
-                    (ht.cols[name] for ht in host_tiles if name in ht.cols), None
-                )
-                dtype = src.dtype if src is not None else np.float64
-                cat = np.concatenate(
-                    [
-                        ht.cols[name]
-                        if name in ht.cols
-                        else np.zeros(ht.num_rows, dtype)
-                        for ht in host_tiles
-                    ]
-                )
-                buf = np.zeros(entry.pad, dtype=cat.dtype)
-                buf[: entry.num_rows] = cat[entry.order]
-                any_nulls = any(
-                    name in ht.nulls or name in ht.absent for ht in host_tiles
-                )
-                nbuf = None
-                if any_nulls:
-                    ncat = np.concatenate(
-                        [
-                            ht.nulls[name]
-                            if name in ht.nulls
-                            else np.full(ht.num_rows, name not in ht.absent)
-                            for ht in host_tiles
-                        ]
-                    )
-                    nbuf = np.zeros(entry.pad, bool)
-                    nbuf[: entry.num_rows] = ncat[entry.order]
-            entry.cols[name] = self._up_chunks(buf, bounds)
-            acc[0] += buf.nbytes
-            if nbuf is not None:
-                entry.nulls[name] = self._up_chunks(nbuf, bounds)
-                acc[0] += nbuf.nbytes
-            if name in tag_cols or name in pk_cols:
-                if host_tiles is None:
-                    # persisted codes keep their STORED epoch (repair
-                    # gathers them forward) — persisted_epochs, not
-                    # entry.epochs, is authoritative: release_unneeded
-                    # pops the latter, and restamping a re-upload with
-                    # the current epoch would skip the repair gather
-                    entry.epochs.setdefault(
-                        name,
-                        entry.persisted_epochs.get(name, dictionary.epoch),
-                    )
-                else:
-                    entry.epochs[name] = dictionary.epoch
+                entry.cols = {}
+                entry.nulls = {}
+                entry.valid = None
+                entry.epochs = {}
+            # re-derivable planes rebuild lazily from the patched planes
+            entry.tm_cols = {}
+            entry.tm_nulls = {}
+            entry.tm_valid = None
+            entry.tm_valid_dedup = None
+            entry.perm = None
+            entry.limb_cols = {}
+            # window tiles whose window cannot contain a delta row stay
+            # bit-identical; intersecting ones rebuild on next touch
+            if delta_ts is not None and len(delta_ts):
+                dmin, dmax = int(delta_ts[0]), int(delta_ts[-1])
+            else:
+                dmin, dmax = -(1 << 62), 1 << 62
+            for key in [
+                k
+                for k in entry.window_tiles
+                if dmax >= k[0] and dmin < k[1]
+            ]:
+                del entry.window_tiles[key]
+            # the persisted store describes the OLD file set
+            entry.persisted_cols = {}
+            entry.persisted_nulls = {}
+            entry.persisted_epochs = {}
+            entry.cold_served = False
+            entry.nbytes = _entry_device_bytes(entry)
+            entry.host_nbytes = (
+                entry.order.nbytes
+                + entry.file_row_offsets.nbytes
+                + sum(a.nbytes for a in entry.sorted_host.values())
+            )
+            self._used += entry.nbytes - old_dev
+            self._host_used += entry.host_nbytes - old_host
+            self._evict_locked(pinned_regions | {rid})
+        metrics.TILE_DELTA_MERGES.inc()
+        metrics.TILE_DELTA_ROWS.inc(delta_rows)
+        passes.note(
+            "incremental_tile", True,
+            f"{delta_rows} delta rows merged into the cached super-tile "
+            "(sorted-run merge + on-device plane patch)",
+            region=rid, delta_rows=delta_rows, total_rows=total,
+            ms=round((time.perf_counter() - t_start) * 1000, 1),
+        )
+        if _TIMING:
+            print(
+                f"TILE_TIMING super.delta_merge "
+                f"{(time.perf_counter() - t_start) * 1000:.0f}ms "
+                f"({delta_rows} rows)",
+                flush=True,
+            )
+        return entry
 
     def repair_super(
         self,
@@ -1935,6 +2413,12 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
             raise ValueError("tile program received no sources")
         return final_jit(merged, hv)
 
+    # shape-metadata precompile hook (pipelined cold path): the executor
+    # lowers+compiles this jit from ShapeDtypeStructs in the background
+    # while plane uploads are still in flight — the persistent XLA cache
+    # then serves the dispatch-time compile as a hit
+    run_all._partial_jit = partial_jit
+
     return (
         run_all,
         tuple(int_layout),
@@ -1951,6 +2435,15 @@ class TileExecutor:
     def __init__(self, cache: TileCacheManager, config):
         self.cache = cache
         self.config = config
+        # program signatures already precompiled (or dispatched): warm
+        # queries must not spawn background compile threads
+        self._precompiled: set = set()
+        self._precompile_lock = threading.Lock()
+        # per-query readback attribution (transfer vs decode ms): written
+        # by _finalize, read by tpu_exec.try_tile for EXPLAIN ANALYZE.
+        # Thread-local, NOT a global-metric delta — concurrent queries
+        # would cross-attribute each other's readback time
+        self._rb_local = threading.local()
 
     # -- public entry --------------------------------------------------------
     def execute(self, lowering, schema, time_bounds, ctx: TileContext):
@@ -2344,6 +2837,21 @@ class TileExecutor:
             )
             return cold_table
 
+        # pipelined cold path, stage 3: start the tile program's jit
+        # trace/compile from shape metadata ALONE, in the background —
+        # XLA compiles (into the persistent compilation cache) while the
+        # plane uploads below are still crossing the link, instead of
+        # serializing encode -> upload -> compile
+        if (
+            super_entries
+            and self.cache._tile_opt("pipelined_build", True)
+            and passes.enabled("pipelined_build", self.config)
+        ):
+            self._precompile_async(
+                plan, fspec, super_entries[0], dyn_host,
+                tag_names | set(pk), ts_name, limb_skip_upload,
+            )
+
         # device path: upload the planes the host-only build deferred
         # (warm entries hit the cache and return immediately)
         for region, metas, _mems in region_sources:
@@ -2541,6 +3049,101 @@ class TileExecutor:
             if table is not None:
                 return table
         return None  # unreachable: the f64 pass never fails the verdict
+
+    def _precompile_async(
+        self, plan, fspec, entry, dyn_host, tag_like, ts_name, skip_f64,
+    ):
+        """Best-effort background compile of the tile program for
+        `entry`'s chunk shape, started BEFORE the data planes finish
+        uploading: chunk shapes are known from metadata (pow2 pad /
+        chunk_rows), dtypes from the host encodes, so a
+        jax.ShapeDtypeStruct lowering + compile can run concurrently with
+        the uploads and land in the persistent XLA compilation cache —
+        the dispatch-time compile then hits.  The nullable-column set is
+        guessed from host-side knowledge; a wrong guess wastes one
+        background compile and the dispatch path compiles its real
+        signature as usual.  Never raises, never blocks the query.  The
+        worker is NON-daemon (a daemon thread torn down inside an XLA
+        compile aborts interpreter shutdown) and each program signature
+        spawns at most once per executor."""
+        try:
+            null_guess = set(entry.nulls) | set(entry.persisted_nulls)
+            nullable = tuple(sorted(
+                c
+                for _f, c in plan.agg_specs
+                if c != COUNT_STAR and c in null_guess
+            ))
+            need_cols = self._plan_cols(plan)
+            limb_need = list(self._limb_sum_cols(plan))
+            rows0 = min(entry.pad, self.cache.chunk_rows)
+            pad = entry.pad
+
+            def col_dtype(c):
+                if c in entry.cols:
+                    return np.dtype(entry.cols[c][0].dtype)
+                if c in entry.persisted_cols:
+                    return np.dtype(entry.persisted_cols[c].dtype)
+                if c in tag_like:
+                    return np.dtype(np.int32)
+                if c == ts_name:
+                    return np.dtype(np.int64)
+                return np.dtype(np.float64)
+
+            dtypes = {c: col_dtype(c) for c in need_cols}
+            pdyn = {
+                "filter_values": tuple(dyn_host["filter_values"]),
+                "bucket_origin": np.int64(dyn_host["bucket_origin"]),
+                "bucket_interval": np.int64(dyn_host["bucket_interval"]),
+            }
+            sig = (plan, nullable, fspec, rows0)
+            with self._precompile_lock:
+                if sig in self._precompiled:
+                    return  # already compiled (or a warm program exists)
+                self._precompiled.add(sig)
+        except Exception:  # noqa: BLE001 — purely an optimization
+            return
+
+        def run():
+            try:
+                program, *_layouts = _tile_program_cached(
+                    plan, nullable, fspec
+                )
+                pj = getattr(program, "_partial_jit", None)
+                if pj is None:
+                    return
+                sd = jax.ShapeDtypeStruct
+                cols_spec = {
+                    c: sd((rows0,), dtypes[c])
+                    for c in need_cols
+                    if c not in skip_f64
+                }
+                nulls_spec = {
+                    c: sd((rows0,), np.bool_)
+                    for c in nullable
+                    if c in need_cols
+                }
+                limbs_spec = {}
+                if (
+                    plan.acc_dtype == "limb"
+                    and limb_need
+                    and pad % BLOCK_ROWS == 0
+                    and rows0 >= _LIMB_MIN_ROWS
+                ):
+                    limb_struct = jax.eval_shape(
+                        quantize_limbs, sd((rows0,), np.float64)
+                    )
+                    limbs_spec = {c: limb_struct for c in limb_need}
+                pj.lower(
+                    cols_spec, sd((rows0,), np.bool_), nulls_spec,
+                    pdyn, None, limbs=limbs_spec,
+                ).compile()
+                metrics.TPU_PRECOMPILES.inc()
+            except Exception:  # noqa: BLE001 — best-effort, see docstring
+                pass
+
+        threading.Thread(
+            target=run, name="tile-precompile", daemon=False
+        ).start()
 
     def _streamed_execute(
         self, lowering, schema, scan, ctx, time_bounds, region_sources,
@@ -3040,7 +3643,15 @@ class TileExecutor:
             cap = min(plan.num_groups, post.offset + post.limit)
         else:
             cap = min(plan.num_groups, _quantize_soft(real_groups))
-        if cap <= 0 or not (post.consumed or cap * 2 <= plan.num_groups):
+        # last_value plans (TSBS lastpoint) ALWAYS take the compact path
+        # (cap is min'd against num_groups above, so it always fits):
+        # their LAST states scan the full retention, so the result should
+        # ship O(rows_out) like the other finalized queries instead of
+        # the padded group space + a host-side empty-group scan
+        has_last = any(f == "last_value" for f, _c in plan.agg_specs)
+        if cap <= 0 or not (
+            post.consumed or cap * 2 <= plan.num_groups or has_last
+        ):
             passes.note(
                 "device_finalize", False,
                 "no consumable Sort/LIMIT/HAVING and compaction would not "
@@ -3613,20 +4224,68 @@ class TileExecutor:
                 d["avg"] = d["sum"] / np.maximum(cnt, 1)
         return self._assemble_result(finals, plan, ctx, dyn_host)
 
+    def _fetch_result(self, packed):
+        """ONE logical device->host fetch of the packed result trio.
+        Large results stream as chunked device_gets with transfer
+        overlapping the host-side copy (query.streamed_readback); small
+        results keep the single batched device_get — on a remote-device
+        link extra round-trips would cost more than the overlap saves."""
+        from .executor import streamed_device_get
+
+        chunk = max(int(getattr(self.config, "readback_chunk_kb", 1024)), 64) << 10
+        total = sum(
+            int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize for p in packed
+        )
+        streamed = (
+            getattr(self.config, "streamed_readback", True)
+            and passes.enabled("streamed_readback", self.config)
+            and total >= 2 * chunk
+        )
+        if streamed:
+            out = streamed_device_get(list(packed), chunk)
+            metrics.TPU_READBACK_STREAMED.inc()
+            passes.note(
+                "streamed_readback", True,
+                f"{total >> 10} KiB fetched as ~{chunk >> 10} KiB slices "
+                "overlapped with the host copy",
+                bytes=total,
+            )
+            return np.asarray(out[0]), np.asarray(out[1])
+        buf, accs64 = jax.device_get(packed)
+        return np.asarray(buf), np.asarray(accs64)
+
     def _finalize(
         self, packed, int_layout, acc32_layout, acc64_layout, int_dtype,
         plan, lowering, schema, ctx, dyn_host, spec=None,
     ):
-        # ONE host fetch total, regardless of how many aggregates ran
+        # ONE logical host fetch total, regardless of how many aggregates
+        # ran; transfer and host-decode are metered separately so
+        # streamed-readback wins stay attributable (the combined
+        # readback_ms conflates link time with waiting out the dispatch)
         t0 = time.perf_counter()
-        buf, accs64 = jax.device_get(packed)
-        buf = np.asarray(buf)
-        accs64 = np.asarray(accs64)
+        buf, accs64 = self._fetch_result(packed)
         ms = (time.perf_counter() - t0) * 1000.0
         metrics.TILE_READBACK_MS.observe(ms)
         metrics.TPU_READBACK_MS.observe(ms)
+        metrics.TPU_READBACK_TRANSFER_MS.observe(ms)
         metrics.TPU_READBACK_BYTES.inc(buf.nbytes + accs64.nbytes)
         metrics.TPU_DEVICE_FETCHES.inc()
+        self._rb_local.transfer_ms = ms
+        t_dec = time.perf_counter()
+        try:
+            return self._decode_result(
+                buf, accs64, int_layout, acc32_layout, acc64_layout,
+                int_dtype, plan, lowering, ctx, dyn_host, spec,
+            )
+        finally:
+            dec_ms = (time.perf_counter() - t_dec) * 1000.0
+            metrics.TPU_READBACK_DECODE_MS.observe(dec_ms)
+            self._rb_local.decode_ms = dec_ms
+
+    def _decode_result(
+        self, buf, accs64, int_layout, acc32_layout, acc64_layout,
+        int_dtype, plan, lowering, ctx, dyn_host, spec,
+    ):
         if plan.acc_dtype == "limb" and self._limb_sum_cols(plan):
             if buf[-1] == 0:
                 # quantization-error bound exceeded 1e-7 of some group's

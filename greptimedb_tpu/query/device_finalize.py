@@ -36,6 +36,15 @@ that operator; everything outward of the stop replays on the host over
 the (already small) device result, and `query.device_topk = false`
 restores the old full-buffer path exactly.
 
+Even with NOTHING consumable, the compact path still engages for
+empty-group compaction when it shrinks the fetch at least 2x — and
+unconditionally (whenever the compact cap fits the group space) for
+plans carrying `last_value` (TSBS lastpoint): their LAST states scan the
+full retention, so the result should ship O(rows_out) like the other
+finalized queries instead of the padded group space plus a host-side
+empty-group pass.  The engage decision lives in
+parallel/tile_cache.py `_plan_device_finalize`.
+
 The derivation is pure planning (no jax imports): the device evaluation
 of the encoded HAVING tree and sort keys lives in the tile program.
 """

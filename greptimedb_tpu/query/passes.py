@@ -84,6 +84,26 @@ register(
     "layout",
 )
 register(
+    "incremental_tile",
+    "extend an existing super-tile IN PLACE when a flush appends files: "
+    "delta encode + merge of sorted runs + on-device plane patch, so "
+    "post-flush cold cost is O(delta rows) instead of a full rebuild",
+    "layout",
+)
+register(
+    "pipelined_build",
+    "overlap the cold build's host encode with device upload over a "
+    "worker pool, and start the tile program's compile from shape "
+    "metadata before uploads finish",
+    "layout",
+)
+register(
+    "streamed_readback",
+    "split large device->host result fetches into chunked device_gets "
+    "with transfer overlapping host-side decode",
+    "layout",
+)
+register(
     "device_finalize",
     "run Sort/LIMIT/HAVING and result compaction on device over the "
     "finalized [K, G] states so the one device->host fetch is O(rows_out) "

@@ -202,10 +202,26 @@ class TpuExecutor:
         if ctx is None:
             return None
         with stage("tpu.tile_cache") as info:
+            # per-query transfer vs host-decode split of the readback
+            # (greptime_tpu_readback_{transfer,decode}_ms): surfaces in
+            # EXPLAIN ANALYZE so streamed-readback wins are attributable
+            # per query.  Thread-local on the executor — execute() runs
+            # on THIS thread, and global-metric deltas would cross-
+            # attribute concurrent queries' readbacks.
+            rbl = getattr(self.tile_executor, "_rb_local", None)
+            if rbl is not None:
+                rbl.transfer_ms = rbl.decode_ms = None
             table = self.tile_executor.execute(
                 lowering, schema, lambda: time_bounds(), ctx
             )
             info["hit"] = table is not None
+            if (
+                table is not None
+                and rbl is not None
+                and getattr(rbl, "transfer_ms", None) is not None
+            ):
+                info["readback_transfer_ms"] = round(rbl.transfer_ms, 2)
+                info["readback_decode_ms"] = round(rbl.decode_ms or 0.0, 2)
         if table is None:
             return None
         with stage("tpu.post_ops"):

@@ -71,6 +71,12 @@ class TimeSeriesEngine:
         # added SSTs (the tile.prewarm_on_flush hook rides this); always
         # best-effort, never on the write path's critical section
         self.flush_listeners: list = []
+        # delta listeners: called with (region_id, added_file_ids) — the
+        # flush's delta notification, so tile maintenance can size its
+        # incremental work.  A SEPARATE list (not arity-sniffed off
+        # flush_listeners): signature guessing misdispatches callbacks
+        # with defaulted or **kw second parameters
+        self.delta_listeners: list = []
         self.compactor = None
         self.flusher = None
         self._workers = None  # lazy sharded write loops (storage/worker.py)
@@ -227,9 +233,20 @@ class TimeSeriesEngine:
         if added and self.compactor is not None:
             self.compactor.notify_flush(region_id)
         if added:
+            # delta notification: listeners learn WHICH SSTs the flush
+            # appended, so tile maintenance can size its delta work (the
+            # incremental super-tile build merges exactly these files'
+            # rows instead of rebuilding from scratch)
+            ids = [m.file_id for m in added]
+            metrics.TILE_FLUSH_DELTA_FILES.inc(len(ids))
             for cb in list(self.flush_listeners):
                 try:
                     cb(region_id)
+                except Exception:  # noqa: BLE001 — listeners are advisory
+                    pass
+            for cb in list(self.delta_listeners):
+                try:
+                    cb(region_id, ids)
                 except Exception:  # noqa: BLE001 — listeners are advisory
                     pass
 

@@ -170,6 +170,16 @@ class QueryConfig:
     # group-id vector) instead of O(groups).  Off restores the host
     # post-op path exactly (full-buffer fetch, CPU Sort/Limit/Having).
     device_topk: bool = True
+    # Streamed device->host readback (parallel/executor.py
+    # streamed_device_get): large result fetches split into
+    # readback_chunk_kb-sized device_get slices with ONE slice in flight
+    # while the previous one copies into the host buffer, so transfer
+    # overlaps host-side decode instead of serializing ahead of it.
+    # Small results (< 2 chunks) keep the single batched fetch — on a
+    # remote-device link extra round-trips would cost more than the
+    # overlap saves.  Off restores the one-device_get path bit-for-bit.
+    streamed_readback: bool = True
+    readback_chunk_kb: int = 1024
     # Per-statement wall-clock budget (seconds; 0 disables).  Enforced
     # cooperatively (utils/deadline.py): scan loops, row-group reads and
     # plan-node execution check it between units of work, so a query that
@@ -277,6 +287,21 @@ class TileConfig:
     prewarm_limbs: bool = True
     # Restrict prewarm to these tables (empty = every tileable base table).
     prewarm_tables: tuple = ()
+    # Incremental (delta) super-tile maintenance: when a flush APPENDS
+    # files to a region's set, merge only the new rows into the existing
+    # entry — delta encode, merge of two sorted runs (not a re-sort),
+    # on-device patch of resident planes — so post-flush cold cost is
+    # O(delta rows), not O(total rows).  Off restores the
+    # invalidate-and-rebuild-from-scratch path bit-for-bit.
+    incremental: bool = True
+    # Pipelined cold build: host-encode of column N+1 overlaps the device
+    # upload of column N over a small worker pool, and the tile program's
+    # jit trace/compile starts from shape metadata alone, before data
+    # upload finishes.  Off restores the serial encode->upload->compile
+    # loop.
+    pipelined_build: bool = True
+    # Host consolidation workers feeding the pipelined upload (>= 1).
+    build_workers: int = 2
 
 
 @dataclasses.dataclass
@@ -321,6 +346,28 @@ class Config:
             raise ConfigError(
                 "query.device_topk must be a boolean (on-device Sort/LIMIT/"
                 f"HAVING finalization); got {q.device_topk!r}"
+            )
+        if not isinstance(t.incremental, bool):
+            raise ConfigError(
+                "tile.incremental must be a boolean (delta super-tile "
+                f"maintenance on flush); got {t.incremental!r}"
+            )
+        if not isinstance(q.streamed_readback, bool):
+            raise ConfigError(
+                "query.streamed_readback must be a boolean (chunked "
+                f"device->host fetches overlapped with decode); got "
+                f"{q.streamed_readback!r}"
+            )
+        if q.readback_chunk_kb < 64:
+            raise ConfigError(
+                "query.readback_chunk_kb must be >= 64 KiB — smaller slices "
+                "pay more link round-trips than the transfer they carry; "
+                f"got {q.readback_chunk_kb!r}"
+            )
+        if t.build_workers < 1:
+            raise ConfigError(
+                "tile.build_workers must be >= 1 host consolidation worker; "
+                f"got {t.build_workers!r}"
             )
         if t.prewarm_debounce_s < 0:
             raise ConfigError(

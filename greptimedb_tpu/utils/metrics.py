@@ -169,6 +169,30 @@ TILE_PERSIST_WRITES = REGISTRY.counter("greptime_tile_persist_writes_total", "Su
 TILE_WINDOW_BUILDS = REGISTRY.counter("greptime_tile_window_builds_total", "Compact window tiles gathered from sorted encodes")
 TILE_HOST_FAST_PATH = REGISTRY.counter("greptime_tile_host_fast_path_total", "Selective queries served from the sorted host encode cache")
 TILE_STREAM_QUERIES = REGISTRY.counter("greptime_tile_stream_total", "Queries whose working set exceeded the HBM budget, executed region-streamed")
+TILE_DELTA_MERGES = REGISTRY.counter(
+    "greptime_tile_delta_merges_total",
+    "Super-tile entries extended IN PLACE by a flush delta (merge of sorted "
+    "runs + on-device plane patch) instead of a from-scratch rebuild",
+)
+TILE_DELTA_ROWS = REGISTRY.counter(
+    "greptime_tile_delta_rows_total",
+    "Rows merged into existing super-tiles by delta builds (the O(delta) "
+    "post-flush cold contract)",
+)
+TILE_FLUSH_DELTA_FILES = REGISTRY.counter(
+    "greptime_tile_flush_delta_files_total",
+    "SST files announced to flush listeners as delta notifications",
+)
+TILE_PIPELINED_BUILDS = REGISTRY.counter(
+    "greptime_tile_pipelined_builds_total",
+    "Cold super-tile builds whose host encode overlapped device upload "
+    "(the three-stage encode/upload/compile pipeline)",
+)
+TPU_PRECOMPILES = REGISTRY.counter(
+    "greptime_tpu_precompile_total",
+    "Tile-program compiles started from shape metadata alone, before data "
+    "upload finished (pipelined cold path)",
+)
 
 # Device-side result finalization + readback accounting (the O(rows_out)
 # fetch contract): BYTES are the honest unit on a remote-device link —
@@ -183,6 +207,21 @@ TPU_READBACK_BYTES = REGISTRY.counter(
 TPU_READBACK_MS = REGISTRY.histogram(
     "greptime_tpu_readback_ms",
     "Device->host result fetch milliseconds (includes waiting out the async dispatch)",
+)
+TPU_READBACK_TRANSFER_MS = REGISTRY.histogram(
+    "greptime_tpu_readback_transfer_ms",
+    "Device->host transfer milliseconds of the result fetch (wire/link time, "
+    "including waiting out the async dispatch on the first slice)",
+)
+TPU_READBACK_DECODE_MS = REGISTRY.histogram(
+    "greptime_tpu_readback_decode_ms",
+    "Host-side milliseconds decoding the fetched result buffers into Arrow "
+    "rows (unpack, NULL-gate, tag/bucket decode, table assembly)",
+)
+TPU_READBACK_STREAMED = REGISTRY.counter(
+    "greptime_tpu_readback_streamed_total",
+    "Result fetches split into chunked device_gets overlapped with host "
+    "decode (query.streamed_readback)",
 )
 TPU_DEVICE_DISPATCHES = REGISTRY.counter(
     "greptime_tpu_device_dispatches_total",

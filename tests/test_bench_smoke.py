@@ -1,0 +1,117 @@
+"""bench-smoke: a ~60 s mini-bench through the FULL engine path (one
+query family, tiny dataset, prewarm + delta-flush + query) so cold-path
+regressions fail tier-1 instead of only surfacing in the 4-round bench
+record.  Select alone with `pytest -m bench_smoke`.
+
+Wall-clock assertions are deliberately loose (CI machines vary); the
+hard contracts are metric-based: prewarm builds the tiles off the query
+path, the post-flush delta merges instead of rebuilding, the delta
+query is no slower than the initial cold (which pays consolidation +
+XLA compile), and results match the authoritative CPU path.
+"""
+
+import math
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.utils import metrics
+from greptimedb_tpu.utils.config import Config
+
+N_HOSTS = 8
+TICKS = 720  # 2 h at 10 s scrape
+T0 = 1_767_225_600_000
+
+
+def _ingest(db, tick_lo, tick_hi, seed):
+    rng = np.random.default_rng(seed)
+    ticks = tick_hi - tick_lo
+    ts = (
+        T0 + (tick_lo + np.arange(ticks, dtype=np.int64))[:, None] * 10_000
+    )
+    ts = np.broadcast_to(ts, (ticks, N_HOSTS)).reshape(-1)
+    hosts = np.broadcast_to(
+        np.array([f"host_{i}" for i in range(N_HOSTS)])[None, :],
+        (ticks, N_HOSTS),
+    ).reshape(-1)
+    db.insert_rows("cpu", pa.table({
+        "hostname": pa.array(hosts),
+        "ts": pa.array(ts, pa.timestamp("ms")),
+        "usage_user": pa.array(rng.uniform(0, 100, ticks * N_HOSTS)),
+        "usage_system": pa.array(rng.uniform(0, 100, ticks * N_HOSTS)),
+    }))
+    return ticks * N_HOSTS
+
+
+@pytest.mark.bench_smoke
+def test_bench_smoke_prewarm_delta_query(tmp_path):
+    t_suite = time.perf_counter()
+    cfg = Config()
+    cfg.storage.compaction_background_enable = False
+    db = Database(data_home=str(tmp_path / "bench"), config=cfg)
+    try:
+        db.sql(
+            "CREATE TABLE cpu (hostname STRING, ts TIMESTAMP(3) TIME INDEX,"
+            " usage_user DOUBLE, usage_system DOUBLE,"
+            " PRIMARY KEY (hostname)) WITH (append_mode = 'true')"
+        )
+        n = _ingest(db, 0, TICKS, seed=1)
+        db.storage.flush_all()
+
+        # prewarm: the cold consolidation runs OFF the query path
+        pw0 = metrics.PREWARM_BUILDS.get()
+        db.prewarm(tables=["cpu"])
+        assert metrics.PREWARM_BUILDS.get() > pw0
+
+        q = (
+            "SELECT hostname, time_bucket('1m', ts) AS tb,"
+            " avg(usage_user) AS au FROM cpu GROUP BY hostname, tb"
+        )
+        lowered0 = metrics.TILE_LOWERED_TOTAL.get()
+        t0 = time.perf_counter()
+        db.sql_one(q)
+        db.sql_one(q)  # device planes warm (cold-serve answered once)
+        initial_cold_ms = (time.perf_counter() - t0) * 1000
+        assert metrics.TILE_LOWERED_TOTAL.get() > lowered0, (
+            "mini-bench query did not take the tile path"
+        )
+
+        # delta flush (~5% new rows) + re-query: must delta-merge, not
+        # rebuild, and serve no slower than the initial cold
+        merges0 = metrics.TILE_DELTA_MERGES.get()
+        entry = next(iter(db.query_engine.tile_cache._super.values()))
+        _ingest(db, TICKS, TICKS + TICKS // 20, seed=2)
+        db.storage.flush_all()
+        t0 = time.perf_counter()
+        t_delta = db.sql_one(q)
+        delta_ms = (time.perf_counter() - t0) * 1000
+        assert metrics.TILE_DELTA_MERGES.get() == merges0 + 1, (
+            "post-flush query rebuilt the super-tile instead of delta-merging"
+        )
+        assert (
+            next(iter(db.query_engine.tile_cache._super.values())) is entry
+        )
+        assert delta_ms <= max(initial_cold_ms, 1000.0), (
+            f"delta cold ({delta_ms:.0f} ms) regressed past the initial "
+            f"cold ({initial_cold_ms:.0f} ms)"
+        )
+
+        # correctness vs the authoritative CPU path
+        db.config.query.backend = "cpu"
+        t_cpu = db.sql_one(q)
+        db.config.query.backend = "tpu"
+        k = [("hostname", "ascending"), ("tb", "ascending")]
+        got = t_delta.sort_by(k).to_pydict()
+        want = t_cpu.sort_by(k).to_pydict()
+        assert got["hostname"] == want["hostname"]
+        for x, y in zip(got["au"], want["au"]):
+            assert math.isclose(x, y, rel_tol=1e-9), (x, y)
+        assert n == TICKS * N_HOSTS
+    finally:
+        db.close()
+    assert time.perf_counter() - t_suite < 60, (
+        "bench-smoke exceeded its 60 s budget"
+    )
