@@ -117,9 +117,14 @@ def cmd_datanode(args):
 
     from .distributed.flight import DatanodeFlightServer
     from .storage.engine import TimeSeriesEngine
-    from .utils.config import StorageConfig
+    from .utils.config import Config
 
-    engine = TimeSeriesEngine(StorageConfig(data_home=args.data_home))
+    # layered config (env vars incl. GREPTIMEDB_TPU__REPLICA__SYNC_INTERVAL_MS,
+    # which Config copies down to storage.follower_sync_interval_ms) with the
+    # CLI data_home overriding whatever the layers said
+    storage_cfg = Config.load().storage
+    storage_cfg.data_home = args.data_home
+    engine = TimeSeriesEngine(storage_cfg)
     host, port = (args.addr.rsplit(":", 1) + ["0"])[:2]
     server = DatanodeFlightServer(engine, f"grpc://{host}:{port}")
     import threading

@@ -179,6 +179,7 @@ class Cluster:
         num_datanodes: int = 3,
         clock=None,
         transport: str = "inprocess",
+        target_followers: int = 0,
     ):
         self.data_home = data_home
         self.clock = clock or (lambda: _time.time() * 1000)
@@ -194,7 +195,9 @@ class Cluster:
             self.datanodes = {i: FlightDatanode(i, data_home) for i in range(num_datanodes)}
         else:
             self.datanodes = {i: Datanode(i, data_home) for i in range(num_datanodes)}
-        self.metasrv = Metasrv(self.kv, NodeManager(self))
+        self.metasrv = Metasrv(
+            self.kv, NodeManager(self), target_followers=target_followers
+        )
         for i, dn in self.datanodes.items():
             self.metasrv.register_datanode(i)
             if hasattr(dn, "_clock"):

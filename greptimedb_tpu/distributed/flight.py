@@ -30,10 +30,15 @@ import pyarrow.flight as fl
 
 from ..datatypes.schema import Schema
 from ..storage.sst import ScanPredicate
-from ..utils import fault_injection
+from ..utils import fault_injection, metrics
 from ..utils.errors import RegionNotFoundError, RegionReadonlyError
 
 import contextlib
+
+# Feature detection for best-effort in-flight call cancellation: pyarrow
+# grew FlightStreamReader.cancel() over time — when the installed build
+# lacks it, deadline expiry keeps today's detach-and-drop fallback.
+_READER_HAS_CANCEL = hasattr(fl.FlightStreamReader, "cancel")
 
 
 @contextlib.contextmanager
@@ -266,6 +271,68 @@ class FlightDatanodeClient:
         self.location = location
         self._client = fl.connect(location)
         self.alive = True
+        # in-flight do_get calls, so a deadline-expired fan-out can reach
+        # in and cancel the wire call itself instead of only detaching the
+        # worker future (the call would otherwise run to completion
+        # server-side).  Each token carries the call's reader once do_get
+        # returned one; a call still blocked INSIDE do_get (the server
+        # computes the scan before the stream opens) has none yet and is
+        # aborted by closing the channel instead.
+        self._inflight_lock = threading.Lock()
+        self._inflight: list[dict] = []
+
+    @contextlib.contextmanager
+    def _track_call(self):
+        token: dict = {"reader": None, "thread": threading.get_ident()}
+        with self._inflight_lock:
+            self._inflight.append(token)
+        try:
+            yield token
+        finally:
+            with self._inflight_lock:
+                if token in self._inflight:
+                    self._inflight.remove(token)
+
+    def cancel_inflight(self, threads: set | None = None) -> int:
+        """Best-effort cancellation of in-flight do_get calls: readers get
+        a feature-detected FlightStreamReader.cancel(); calls still blocked
+        before the stream opened are aborted by closing the client channel.
+        `threads` scopes the cancel to calls issued from those worker
+        threads — the client cache is frontend-wide, so a concurrent
+        query's healthy call on the same (now cache-evicted) client must
+        not be cancelled along with the abandoned one.  The channel close
+        tears down EVERY call on the channel, so it only fires when no
+        foreign call is sharing it.  Returns how many cancels were issued;
+        0 when the installed pyarrow exposes neither surface — the
+        caller's detach-and-drop fallback still applies."""
+        with self._inflight_lock:
+            tokens = list(self._inflight)
+        mine = [
+            t for t in tokens if threads is None or t.get("thread") in threads
+        ]
+        cancelled = 0
+        pre_stream = 0
+        for token in mine:
+            reader = token.get("reader")
+            if reader is None:
+                pre_stream += 1
+                continue
+            if not _READER_HAS_CANCEL:
+                continue
+            try:
+                reader.cancel()
+                cancelled += 1
+            except Exception:  # noqa: BLE001 — cancellation is best-effort
+                pass
+        if pre_stream and len(mine) == len(tokens):
+            try:
+                self._client.close()
+                cancelled += pre_stream
+            except Exception:  # noqa: BLE001 — cancellation is best-effort
+                pass
+        if cancelled:
+            metrics.FANOUT_CANCELLED_TOTAL.inc(cancelled)
+        return cancelled
 
     # -- lifecycle ----------------------------------------------------------
     def _action(self, kind: str, body: dict) -> dict:
@@ -358,7 +425,9 @@ class FlightDatanodeClient:
         fault_injection.fire("flight.do_get", node_id=self.node_id, region_id=rid)
         ticket = fl.Ticket(encode_scan_ticket(rid, pred, projection))
         try:
-            return self._client.do_get(ticket).read_all()
+            with self._track_call() as token:
+                token["reader"] = self._client.do_get(ticket)
+                return token["reader"].read_all()
         except fl.FlightError as e:
             raise _connection_error(self.node_id, e) from e
 
@@ -368,7 +437,9 @@ class FlightDatanodeClient:
         fault_injection.fire("flight.do_get", node_id=self.node_id, region_id=rid)
         ticket = fl.Ticket(encode_scan_ticket(rid, pred, agg=spec_dict))
         try:
-            return self._client.do_get(ticket).read_all()
+            with self._track_call() as token:
+                token["reader"] = self._client.do_get(ticket)
+                return token["reader"].read_all()
         except fl.FlightError as e:
             raise _connection_error(self.node_id, e) from e
 
@@ -380,7 +451,9 @@ class FlightDatanodeClient:
             encode_scan_ticket(rid, ScanPredicate(), plan=plan_dict)
         )
         try:
-            return self._client.do_get(ticket).read_all()
+            with self._track_call() as token:
+                token["reader"] = self._client.do_get(ticket)
+                return token["reader"].read_all()
         except fl.FlightError as e:
             raise _connection_error(self.node_id, e) from e
 
@@ -393,12 +466,14 @@ class FlightDatanode:
     port, served from a daemon thread (the reference spawns a tokio server
     task per datanode, datanode/src/service.rs)."""
 
-    def __init__(self, node_id: int, shared_data_home: str):
+    def __init__(self, node_id: int, shared_data_home: str, wal_provider: str = "local"):
         from ..utils.config import StorageConfig
         from ..storage.engine import TimeSeriesEngine
 
         self.node_id = node_id
-        self.engine = TimeSeriesEngine(StorageConfig(data_home=shared_data_home))
+        self.engine = TimeSeriesEngine(
+            StorageConfig(data_home=shared_data_home, wal_provider=wal_provider)
+        )
         self.server = DatanodeFlightServer(self.engine)
         self._thread = threading.Thread(target=self.server.serve, daemon=True)
         self._thread.start()

@@ -24,6 +24,7 @@ import logging
 import queue
 import threading
 import time as _time
+import uuid
 
 import pyarrow as pa
 import pyarrow.flight as fl
@@ -33,11 +34,96 @@ from ..utils import fault_injection, metrics
 _LOG = logging.getLogger("greptimedb_tpu.flownode")
 
 
+class MirrorDedupe:
+    """Exactly-once gate for mirrored batches: per mirror SOURCE (one
+    frontend's BestEffortMirror instance), a bounded high-water-mark window
+    of seen batch ids.  An applied-but-reply-lost batch comes back on retry
+    with the same (source, batch_id) and is skipped instead of
+    double-counted — the hole BestEffortMirror's at-least-once delivery
+    left open.
+
+    Window semantics: ids are assigned monotonically by the source, so an
+    id at or below `max_seen - window` is an ANCIENT retry and counts as a
+    duplicate; above that floor, membership in the seen set decides.  The
+    below-floor call is deliberate: such a retry is ambiguous (applied
+    with the reply lost, or never applied and out-delivered by >window
+    newer batches), and the mirror is best-effort at DELIVERY (full-queue
+    and attempt-exhaustion drops already exist) but exactly-once at
+    APPLICATION — so the ambiguity resolves to "drop" (counted in
+    greptime_flow_dedupe_total), never to "maybe double-count".  Sizing:
+    window must exceed the batches that can overtake one retrying item,
+    bounded by the mirror's queue depth x retry attempts — the 4096
+    default is ~4x that bound at the defaults.
+
+    Memory is bounded twice over: the per-source seen set is pruned
+    lazily to the floor, and sources themselves (one per frontend mirror
+    instance, a fresh uuid per restart) are LRU-capped so weeks of
+    frontend churn cannot accrete state on a long-lived flownode.
+    Eviction is idle-aware: a source inside `idle_evict_s` of its last
+    touch may still have an applied-but-reply-lost batch in flight, and
+    dropping its window would double-apply the retry — such sources are
+    kept past `max_sources`, up to a 4x hard cap that bounds memory
+    against pathological churn (only at that cap can an actively-retrying
+    source lose its window)."""
+
+    def __init__(self, window: int = 4096, max_sources: int = 256,
+                 idle_evict_s: float = 600.0, clock=_time.monotonic):
+        self.window = window
+        self.max_sources = max_sources
+        self.idle_evict_s = idle_evict_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        # source -> [max_seen, seen ids above the floor, last_used]; dict
+        # order doubles as the LRU (every touch re-inserts at the end —
+        # including is_duplicate, so a source stuck in a retry loop stays
+        # recent even though it never registers a new id)
+        self._sources: dict[str, list] = {}
+
+    def is_duplicate(self, source: str, batch_id: int) -> bool:
+        with self._lock:
+            entry = self._sources.pop(source, None)
+            if entry is None:
+                return False
+            entry[2] = self._clock()
+            self._sources[source] = entry
+            max_seen, seen = entry[0], entry[1]
+            if batch_id <= max_seen - self.window:
+                return True  # below the window floor: an ancient retry
+            return batch_id in seen
+
+    def register(self, source: str, batch_id: int):
+        """Record an APPLIED batch (called after the flow engine absorbed
+        it, before the reply ships — so a lost reply leaves the id
+        registered and the retry dedupes)."""
+        with self._lock:
+            entry = self._sources.pop(source, None) or [0, set(), 0.0]
+            max_seen, seen = entry[0], entry[1]
+            seen.add(batch_id)
+            max_seen = max(max_seen, batch_id)
+            floor = max_seen - self.window
+            # prune lazily — only once the set carries half-a-window of
+            # dead weight — so the hot path stays amortized O(1) instead
+            # of rebuilding an O(window) set per applied batch (stale
+            # below-floor ids are harmless: the floor check fires first)
+            if floor > 0 and len(seen) > self.window + self.window // 2:
+                seen = {b for b in seen if b > floor}
+            now = self._clock()
+            self._sources[source] = [max_seen, seen, now]
+            hard_cap = self.max_sources * 4
+            while len(self._sources) > self.max_sources:
+                oldest = next(iter(self._sources))
+                if (now - self._sources[oldest][2] < self.idle_evict_s
+                        and len(self._sources) <= hard_cap):
+                    break  # every over-cap source may still be retrying
+                self._sources.pop(oldest)
+
+
 class FlownodeFlightServer(fl.FlightServerBase):
     def __init__(self, db, location: str = "grpc://127.0.0.1:0"):
         super().__init__(location)
         self.db = db
         self.flows = db.flows  # FlowManager
+        self.dedupe = MirrorDedupe()
 
     @property
     def location(self) -> str:
@@ -47,11 +133,36 @@ class FlownodeFlightServer(fl.FlightServerBase):
     def do_put(self, context, descriptor: fl.FlightDescriptor, reader, writer):
         cmd = json.loads(descriptor.command.decode())
         mirror = cmd["flow_mirror"]
+        source, batch_id = mirror.get("source"), mirror.get("batch_id")
+        if (
+            source is not None
+            and batch_id is not None
+            and self.dedupe.is_duplicate(source, int(batch_id))
+        ):
+            # applied on a previous attempt whose reply was lost: absorb
+            # the retry without feeding the flow engine twice.  Drain the
+            # stream before replying — returning early can fail the
+            # client's still-pending write_batch/done_writing once the
+            # batch outgrows the flow-control window, turning the dedupe
+            # into a spurious delivery failure that retries forever
+            for _chunk in reader:
+                pass
+            metrics.FLOW_DEDUPE_TOTAL.inc()
+            writer.write(json.dumps({"rows": 0, "dedup": True}).encode())
+            return
         batches = [chunk.data for chunk in reader]
         if not batches:
             return
         table = pa.Table.from_batches(batches)
         self.flows.mirror_insert(mirror["table"], mirror.get("database", "public"), table)
+        if source is not None and batch_id is not None:
+            self.dedupe.register(source, int(batch_id))
+        # chaos hook: an error injected HERE is the applied-but-reply-lost
+        # scenario — the batch is absorbed and registered, the client sees
+        # a failed attempt and retries, and the retry must dedupe
+        fault_injection.fire(
+            "flow.dedupe", source=source, batch_id=batch_id, table=mirror["table"]
+        )
         writer.write(json.dumps({"rows": table.num_rows}).encode())
 
     def do_action(self, context, action: fl.Action):
@@ -86,15 +197,26 @@ class FlownodeClient:
         self.location = location
         self._client = fl.connect(location)
 
-    def mirror_insert(self, table: str, database: str, batch: pa.Table) -> int:
+    def mirror_insert(
+        self,
+        table: str,
+        database: str,
+        batch: pa.Table,
+        source: str | None = None,
+        batch_id: int | None = None,
+    ) -> int:
         # chaos hook: a flownode restarting / unreachable mid-mirror — the
         # frontend's BestEffortMirror retries in the background, the user's
         # write has already returned
         fault_injection.fire("flow.mirror", node_id=self.node_id, table=table)
+        mirror = {"table": table, "database": database}
+        if source is not None and batch_id is not None:
+            # exactly-once handle: the flownode dedupes retries of an
+            # applied-but-reply-lost batch on (source, batch_id)
+            mirror["source"] = source
+            mirror["batch_id"] = batch_id
         descriptor = fl.FlightDescriptor.for_command(
-            json.dumps(
-                {"flow_mirror": {"table": table, "database": database}}
-            ).encode()
+            json.dumps({"flow_mirror": mirror}).encode()
         )
         writer, meta_reader = self._client.do_put(descriptor, batch.schema)
         for b in batch.to_batches():
@@ -142,6 +264,12 @@ class BestEffortMirror:
         self.max_attempts = max_attempts
         self.discovery_ttl_s = discovery_ttl_s
         self.backoff_s = backoff_s
+        # exactly-once handle: every submitted batch carries a monotonic id
+        # under this mirror's unique source token; flownodes dedupe retries
+        # of applied-but-reply-lost batches on (source, batch_id)
+        self.source_id = f"mirror-{uuid.uuid4().hex[:12]}"
+        self._batch_seq = 0
+        self._seq_lock = threading.Lock()
         self._queue: queue.Queue = queue.Queue(maxsize=queue_max)
         self._pending = 0
         self._pending_lock = threading.Lock()
@@ -176,7 +304,13 @@ class BestEffortMirror:
         Never raises, never blocks beyond a full-queue drop."""
         if not self.flownodes():
             return False
-        item = {"table": table, "database": database, "batch": batch, "attempt": 0}
+        with self._seq_lock:
+            self._batch_seq += 1
+            batch_id = self._batch_seq
+        item = {
+            "table": table, "database": database, "batch": batch,
+            "attempt": 0, "batch_id": batch_id,
+        }
         # count BEFORE enqueueing: a drain() racing the worker must never
         # observe pending==0 while this batch sits in the queue
         with self._pending_lock:
@@ -206,11 +340,12 @@ class BestEffortMirror:
     # ---- worker ------------------------------------------------------------
     def _deliver(self, item: dict) -> bool:
         """Deliver to every target flownode, tracking outcomes PER NODE so
-        a retry re-sends only to nodes whose attempt FAILED.  Semantics are
-        AT-LEAST-ONCE (same as the reference's detached FlowMirrorTask): a
-        node whose reply was read is never re-sent, but an ambiguous
-        failure — batch applied, reply lost — duplicates on retry.  Exactly
-        -once needs a batch id the flownode dedupes on (ROADMAP)."""
+        a retry re-sends only to nodes whose attempt FAILED.  Wire-level
+        delivery stays at-least-once (same as the reference's detached
+        FlowMirrorTask), but every batch carries (source_id, batch_id) and
+        the flownode dedupes on it — an ambiguous failure (batch applied,
+        reply lost) no longer duplicates on retry: EXACTLY-ONCE
+        application."""
         current = self.flownodes()
         pending = item.get("pending")
         targets = current if pending is None else {
@@ -228,7 +363,8 @@ class BestEffortMirror:
         for node_id, addr in targets.items():
             try:
                 self._client(node_id, addr).mirror_insert(
-                    item["table"], item["database"], item["batch"]
+                    item["table"], item["database"], item["batch"],
+                    source=self.source_id, batch_id=item.get("batch_id"),
                 )
             except Exception as exc:  # noqa: BLE001 — mirrors never propagate
                 metrics.FLOW_MIRROR_FAILURES_TOTAL.inc()

@@ -211,10 +211,28 @@ class Frontend:
         return c
 
     def _drop_client(self, node_id: int | None):
+        """Evict a node's cached Flight client; returns the evicted client
+        (None when absent) so deadline abandonment can best-effort cancel
+        its in-flight calls before letting it go."""
         if node_id is None:
-            return
+            return None
         with self._clients_lock:
-            self._clients.pop(node_id, None)
+            return self._clients.pop(node_id, None)
+
+    def _abandon_client(self, node_id: int | None, threads: set | None = None):
+        """Deadline-expiry path: drop the node's client AND attempt to
+        cancel its in-flight Flight readers (feature-detected pyarrow
+        cancel; detach-and-drop stays the fallback) so the wire call stops
+        burning the datanode instead of running to completion server-side.
+        `threads` restricts the cancel to the abandoned workers' own calls
+        — the client is shared, and a concurrent query's healthy call must
+        survive the eviction."""
+        dropped = self._drop_client(node_id)
+        if dropped is not None:
+            try:
+                dropped.cancel_inflight(threads)
+            except Exception:  # noqa: BLE001 — cancellation is best-effort
+                pass
 
     def _with_client(self, node_id: int, fn):
         """Run `fn(client)` against a FIXED node under the retry policy; a
@@ -271,7 +289,10 @@ class Frontend:
             node = self._routed(r, rid, meta)
             state["node"] = node
             if inflight is not None:
-                inflight[rid] = node
+                # (node, worker thread): a timed-out fan-out drops the right
+                # client AND scopes in-flight cancellation to this worker's
+                # own wire call
+                inflight[rid] = (node, threading.get_ident())
             return self._guarded_call(
                 node, lambda: fn(self._client(node), rid),
                 record_latency=record_latency,
@@ -664,8 +685,19 @@ class Frontend:
 
     # ---- hedged reads ------------------------------------------------------
     def _followers_for(self, meta) -> dict[int, list[int]]:
-        """Follower replicas per region, or {} when hedging is off (the
-        off-safe default: replica.read_followers=False, hedge_delay_ms=0)."""
+        """Hedge-eligible follower replicas per region, or {} when hedging
+        is off (the off-safe default: replica.read_followers=False,
+        hedge_delay_ms=0).  With replica.max_lag_ms set, followers whose
+        reported staleness exceeds the bound are filtered out HERE — a
+        hedge must beat the primary's tail, not serve data older than the
+        contract allows.  Unknown lag (no heartbeat stats yet) stays
+        eligible — the pre-freshness behavior.  A follower that never
+        syncs reports lag growing from its open time, so max_lag_ms with
+        tailing disabled would silently gate every follower out within
+        max_lag_ms of its open; Config.validate rejects that combination
+        (manual sync_followers() deployments refresh last_sync_ms and
+        stay gateable, which is why the gate itself doesn't key off
+        sync_interval_ms)."""
         if not (
             self.config.replica.read_followers
             and self.config.query.hedge_delay_ms > 0
@@ -675,9 +707,23 @@ class Frontend:
         if cached is not None and _time.monotonic() - cached[0] < self._follower_ttl_s:
             return cached[1]
         try:
-            followers = self.meta.get_followers(meta.table_id)
+            followers, lag = self.meta.get_followers_full(meta.table_id)
         except Exception:  # noqa: BLE001 — hedging is advisory, reads proceed
-            followers = {}
+            followers, lag = {}, {}
+        max_lag = self.config.replica.max_lag_ms
+        if max_lag > 0 and followers:
+            gated: dict[int, list[int]] = {}
+            for rid, nodes in followers.items():
+                keep = []
+                for node in nodes:
+                    node_lag = lag.get(rid, {}).get(node)
+                    if node_lag is not None and node_lag > max_lag:
+                        metrics.HEDGE_SKIPPED_STALE_TOTAL.inc()
+                        continue
+                    keep.append(node)
+                if keep:
+                    gated[rid] = keep
+            followers = gated
         self._follower_cache[meta.table_id] = (_time.monotonic(), followers)
         return followers
 
@@ -850,7 +896,7 @@ class Frontend:
         import queue as _queue
 
         pool = self._executor()
-        inflight: dict[int, int] = {}
+        inflight: dict[int, tuple[int, int]] = {}  # rid -> (node, worker thread)
         futures = {
             rid: pool.submit(
                 propagate(self._call_region), meta, rid, fn, routes, inflight,
@@ -866,13 +912,16 @@ class Frontend:
             fut.add_done_callback(queues[rid].put)
         hedges: dict[int, object] = {}
         timers: dict[int, object] = {}
+        hedge_threads: dict[int, int] = {}  # rid -> hedge worker thread
         if hedge_delay is not None:
             # deadline context is thread-local: wrap the hedge call HERE
             # so the wheel-thread submit still propagates this query's
             # deadline into the pool worker
-            hedge_fn = propagate(
-                lambda node, hrid: self._hedge_call(node, hrid, fn)
-            )
+            def _hedge_worker(node, hrid):
+                hedge_threads[hrid] = threading.get_ident()
+                return self._hedge_call(node, hrid, fn)
+
+            hedge_fn = propagate(_hedge_worker)
             for rid, fut in futures.items():
                 flist = followers.get(rid)
                 if flist:
@@ -920,17 +969,34 @@ class Frontend:
                 fut.cancel()
             if timed_out:
                 # deadline expired with sub-requests still running: DETACH
-                # them (nobody joins a hung worker) and drop their clients
-                # so the next query dials a fresh Flight connection instead
-                # of sharing a channel with a stuck call
+                # them (nobody joins a hung worker), best-effort CANCEL the
+                # in-flight Flight readers when the installed pyarrow
+                # supports it, and drop their clients so the next query
+                # dials a fresh connection instead of sharing a channel
+                # with a stuck call
+                # group abandoned workers PER NODE before cancelling: the
+                # client is shared per datanode, so abandoning region-by-
+                # region would evict it on the first call and leave the
+                # second worker's in-flight call uncancelled (and its
+                # foreign-looking token would also suppress the channel-
+                # close fallback for the first)
+                abandoned: dict[int | None, set] = {}
                 for rid, fut in futures.items():
                     if not fut.done() and not fut.cancelled():
                         metrics.FANOUT_ABANDONED_TOTAL.inc()
-                        self._drop_client(inflight.get(rid))
-                for node, fut in hedges.values():
+                        entry = inflight.get(rid)
+                        if entry is not None:
+                            node, worker = entry
+                            abandoned.setdefault(node, set()).add(worker)
+                for hrid, (node, fut) in hedges.items():
                     if not fut.done() and not fut.cancelled():
                         metrics.FANOUT_ABANDONED_TOTAL.inc()
-                        self._drop_client(node)
+                        worker = hedge_threads.get(hrid)
+                        workers = abandoned.setdefault(node, set())
+                        if worker is not None:
+                            workers.add(worker)
+                for node, workers in abandoned.items():
+                    self._abandon_client(node, workers)
         if failed:
             give_up(failed, last_exc)
         return results

@@ -108,10 +108,20 @@ class MetasrvServer:
             )
             return {"ok": True}
         if path == "/follower/get":
-            return {"followers": {
-                str(k): v
-                for k, v in m.get_followers(int(body["table_id"])).items()
-            }}
+            table_id = int(body["table_id"])
+            followers = m.get_followers(table_id)
+            return {
+                "followers": {str(k): v for k, v in followers.items()},
+                # per (region, follower) staleness from heartbeat stats, so
+                # frontends can gate hedging on replica.max_lag_ms without
+                # a second round-trip
+                "lag": {
+                    str(rid): {str(n): ms for n, ms in nodes.items()}
+                    for rid, nodes in m.follower_lag(
+                        table_id, followers
+                    ).items()
+                },
+            }
         if path == "/select":
             node = m.select_datanode(exclude=set(body.get("exclude", [])))
             return {"node_id": node}
@@ -217,8 +227,23 @@ class MetaClient:
         )
 
     def get_followers(self, table_id: int) -> dict[int, list[int]]:
+        return self.get_followers_full(table_id)[0]
+
+    def get_followers_full(
+        self, table_id: int
+    ) -> tuple[dict[int, list[int]], dict[int, dict[int, float]]]:
+        """(followers, lag): follower node ids per region plus each
+        follower's reported staleness in ms (absent = unknown, treated as
+        hedge-eligible)."""
         out = self._call("/follower/get", {"table_id": table_id})
-        return {int(k): [int(n) for n in v] for k, v in out["followers"].items()}
+        followers = {
+            int(k): [int(n) for n in v] for k, v in out["followers"].items()
+        }
+        lag = {
+            int(rid): {int(n): float(ms) for n, ms in nodes.items()}
+            for rid, nodes in out.get("lag", {}).items()
+        }
+        return followers, lag
 
     def select_datanode(self, exclude=frozenset()) -> int | None:
         return self._call("/select", {"exclude": sorted(exclude)})["node_id"]

@@ -17,7 +17,7 @@ import threading
 from dataclasses import dataclass, field
 
 from ..models.partition import RegionRoute
-from ..utils import fault_injection
+from ..utils import fault_injection, metrics
 from ..utils.errors import IllegalStateError, RetryLaterError
 from ..utils.retry import is_transient
 from .failure_detector import PhiAccrualFailureDetector
@@ -261,8 +261,66 @@ class RegionMigrationProcedure(Procedure):
                 pass
 
 
+class FollowerPlacementProcedure(Procedure):
+    """Durable follower placement for ONE region (the selector pass's unit
+    of work): keep `target` read-only followers on distinct live datanodes.
+      select -> open -> (loop until the deficit is filled) -> done.
+    State: {table_id, region_id, target, node, tried, step}.
+
+    A candidate whose open fails transiently (or that died between select
+    and open) is recorded in `tried` and the machine loops back to select —
+    the same retry-on-the-NEXT-candidate contract as failover.  Running out
+    of distinct healthy datanodes finishes the procedure quietly: the next
+    supervisor tick re-submits once membership recovers."""
+
+    type_name = "follower_placement"
+
+    def lock_keys(self):
+        # same lock key as failover/migration: placement must never race a
+        # failover that is about to promote or close this region's replicas
+        return [f"region/{self.state['region_id']}"]
+
+    def execute(self, ctx):
+        metasrv: "Metasrv" = ctx.services["metasrv"]
+        table_id = self.state["table_id"]
+        rid = self.state["region_id"]
+        step = self.state.get("step", "select")
+        if step == "select":
+            route = metasrv.get_route_full(table_id).get(rid)
+            if route is None:
+                return DONE  # table dropped mid-placement
+            current = metasrv.followers_of(table_id, rid)
+            if len(current) >= self.state["target"]:
+                return DONE
+            exclude = {route.leader, *current, *self.state.get("tried", [])}
+            node = metasrv.select_datanode(exclude=exclude)
+            if node is None:
+                return DONE  # not enough distinct nodes NOW; next tick retries
+            self.state["node"] = node
+            self.state["step"] = "open"
+            return EXECUTING
+        if step == "open":
+            try:
+                metasrv.add_follower(table_id, rid, self.state["node"])
+            except Exception as exc:  # noqa: BLE001 — classified below
+                if not (is_transient(exc) or isinstance(exc, IllegalStateError)):
+                    raise
+                # candidate sick or died between select and open: move on
+                self.state.setdefault("tried", []).append(self.state["node"])
+                self.state["step"] = "select"
+                logging.getLogger("greptimedb_tpu.metasrv").warning(
+                    "follower placement of region %s on node %s failed (%s); "
+                    "trying the next candidate", rid, self.state["node"], exc,
+                )
+                return EXECUTING
+            metrics.FOLLOWER_PLACEMENTS_TOTAL.inc()
+            self.state["step"] = "select"  # loop until the deficit is filled
+            return EXECUTING
+        return DONE
+
+
 class Metasrv:
-    def __init__(self, kv: KvBackend, node_manager, election=None):
+    def __init__(self, kv: KvBackend, node_manager, election=None, target_followers: int = 0):
         """node_manager: gateway to datanodes (open_region/close_region...);
         the in-process analogue of the reference's NodeManager gRPC clients.
 
@@ -281,6 +339,11 @@ class Metasrv:
         self.procedures = ProcedureManager(kv, services={"metasrv": self})
         self.procedures.register(RegionFailoverProcedure)
         self.procedures.register(RegionMigrationProcedure)
+        self.procedures.register(FollowerPlacementProcedure)
+        # replica.target_followers: the selector keeps this many read-only
+        # followers per region on distinct live datanodes (0 = manual
+        # placement via add_follower only)
+        self.target_followers = target_followers
         self._rr_counter = 0
         self._lock = threading.RLock()
         self.maintenance_mode = False
@@ -425,16 +488,56 @@ class Metasrv:
                 route.followers.remove(node_id)
                 self.set_route(table_id, routes)
 
+    def _live_followers(self, route: RegionRoute) -> list[int]:
+        """Filter a route's follower list against LIVE membership: after a
+        failover (or a follower node's death) the recorded id may name a
+        datanode that no longer holds the region — returning it would make
+        a hedged read burn its one shot on a dead node."""
+        return [
+            f for f in route.followers
+            if f != route.leader and self.is_alive_datanode(f)
+        ]
+
     def get_followers(self, table_id: int) -> dict[int, list[int]]:
-        return {
-            rid: list(r.followers)
-            for rid, r in self.get_route_full(table_id).items()
-            if r.followers
-        }
+        out = {}
+        for rid, r in self.get_route_full(table_id).items():
+            live = self._live_followers(r)
+            if live:
+                out[rid] = live
+        return out
 
     def followers_of(self, table_id: int, region_id: int) -> list[int]:
         r = self.get_route_full(table_id).get(region_id)
-        return list(r.followers) if r else []
+        return self._live_followers(r) if r else []
+
+    def follower_lag(
+        self, table_id: int, followers: dict[int, list[int]] | None = None
+    ) -> dict[int, dict[int, float]]:
+        """Per (region, follower) staleness in ms, read from the followers'
+        own heartbeat stats (Region.stat follower_lag_ms: time since the
+        region's last successful WAL-tail sync).  Regions/nodes that have
+        not reported yet are simply absent — the frontend treats unknown
+        lag as hedge-eligible (off-safe: without syncing there are no
+        stats and hedging keeps its pre-freshness behavior).  Pass the
+        `get_followers` result when the caller already computed it, to
+        skip re-materializing the route."""
+        with self._lock:
+            stats_by_node = {
+                n: list(info.last_stats) for n, info in self.datanodes.items()
+            }
+        if followers is None:
+            followers = self.get_followers(table_id)
+        out: dict[int, dict[int, float]] = {}
+        for rid, nodes in followers.items():
+            for node in nodes:
+                for s in stats_by_node.get(node, ()):
+                    if not isinstance(s, dict):
+                        s = getattr(s, "__dict__", {})
+                    if s.get("region_id") == rid and s.get("writable") is False:
+                        out.setdefault(rid, {})[node] = float(
+                            s.get("follower_lag_ms", 0.0)
+                        )
+        return out
 
     def is_alive_datanode(self, node_id: int) -> bool:
         with self._lock:
@@ -563,5 +666,58 @@ class Metasrv:
                     logging.getLogger("greptimedb_tpu.metasrv").warning(
                         "failover of region %s off node %s failed; will retry",
                         region_id, node_id, exc_info=True,
+                    )
+        submitted.extend(self._follower_placement_round())
+        return submitted
+
+    def _follower_placement_round(self) -> list[str]:
+        """Selector pass (replica.target_followers): garbage-collect
+        followers recorded on dead nodes, then submit one placement
+        procedure per region whose live follower count is below target —
+        creating replicas on node join / after failover and converging
+        within one supervisor tick of membership change.  Off (target=0)
+        this scans nothing, so manual add_follower deployments are
+        untouched."""
+        if self.target_followers <= 0:
+            return []
+        submitted: list[str] = []
+        for key, raw in self.kv.range(ROUTE_PREFIX).items():
+            table_id = int(key[len(ROUTE_PREFIX):])
+            for rid_s, v in json.loads(raw).items():
+                rid = int(rid_s)
+                route = RegionRoute.from_wire(v)
+                live = set(self._live_followers(route))
+                for f in route.followers:
+                    if f not in live:
+                        # dead node / now-the-leader: drop the stale id and
+                        # best-effort close the replica on the node — a
+                        # FLAPPING node (suspected dead, still running)
+                        # would otherwise keep an orphan follower open
+                        # forever, tailing the WAL and pinning its prune
+                        # low-watermark alongside the GC'd route entry
+                        self.remove_follower(table_id, rid, f)
+                        if f != route.leader:
+                            try:
+                                self.node_manager.close_region_quiet(f, rid)
+                            except Exception:  # noqa: BLE001 — node may be
+                                pass  # truly dead; close is best-effort
+                        metrics.FOLLOWER_GC_TOTAL.inc()
+                if len(live) >= self.target_followers:
+                    continue
+                if self.procedures.lock_held(f"region/{rid}"):
+                    continue  # failover/migration owns this region right now
+                proc = FollowerPlacementProcedure(
+                    state={
+                        "table_id": table_id,
+                        "region_id": rid,
+                        "target": self.target_followers,
+                    }
+                )
+                try:
+                    submitted.append(self.procedures.submit(proc))
+                except Exception:  # noqa: BLE001 — retried next tick
+                    logging.getLogger("greptimedb_tpu.metasrv").warning(
+                        "follower placement for region %s failed; will retry",
+                        rid, exc_info=True,
                     )
         return submitted

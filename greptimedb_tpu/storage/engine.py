@@ -95,6 +95,16 @@ class TimeSeriesEngine:
                 max_inactive_runs=self.config.compaction_max_inactive_window_runs,
                 memory_mb=getattr(self.config, "compaction_memory_mb", 512),
             )
+        # Follower freshness loop (replica.sync_interval_ms, copied down to
+        # storage.follower_sync_interval_ms): read-only regions tail the
+        # shared WAL + refresh their manifest view on this cadence.  0 (the
+        # default) starts no thread and keeps open-time-snapshot followers.
+        self.follower_syncer = None
+        interval_ms = getattr(self.config, "follower_sync_interval_ms", 0.0)
+        if interval_ms and interval_ms > 0:
+            from .maintenance import FollowerSyncer
+
+            self.follower_syncer = FollowerSyncer(self, interval_ms)
 
     # ---- region lifecycle -------------------------------------------------
     def create_region(
@@ -155,7 +165,10 @@ class TimeSeriesEngine:
 
     def close_region(self, region_id: int):
         with self._lock:
-            self._regions.pop(region_id, None)
+            region = self._regions.pop(region_id, None)
+        if region is not None and not region.writable:
+            # a closing follower must stop pinning the shared-WAL tail
+            region.release_follower_watermark()
         self.buffer_mgr.remove_region(region_id)
 
     def drop_region(self, region_id: int):
@@ -267,6 +280,31 @@ class TimeSeriesEngine:
     def region_statistics(self) -> list[RegionStat]:
         return [r.stat() for r in list(self._regions.values())]
 
+    # ---- follower freshness -----------------------------------------------
+    def sync_followers(self) -> dict[int, int]:
+        """One WAL-tail + manifest-refresh round over every READ-ONLY
+        region this engine hosts; returns {region_id: entries_applied}.
+        Failures are per-region and transient by contract (shared-storage
+        weather, a segment pruned mid-replay): the round records them and
+        the next round resumes from the persisted applied position."""
+        import logging
+
+        out: dict[int, int] = {}
+        for region in list(self._regions.values()):
+            if region.writable:
+                continue
+            try:
+                applied, _refreshed = region.follower_sync()
+            except Exception as exc:  # noqa: BLE001 — next round retries
+                metrics.FOLLOWER_SYNC_FAILURES_TOTAL.inc()
+                logging.getLogger("greptimedb_tpu.engine").warning(
+                    "follower sync of region %s failed: %s",
+                    region.region_id, exc,
+                )
+                continue
+            out[region.region_id] = applied
+        return out
+
     # ---- helpers ----------------------------------------------------------
     def _region_dir(self, region_id: int) -> str:
         return os.path.join(self.config.effective_sst_dir(), f"region_{region_id}")
@@ -313,10 +351,16 @@ class TimeSeriesEngine:
                 yield chunk
 
     def close(self):
+        if self.follower_syncer is not None:
+            self.follower_syncer.stop()
         if self._workers is not None:
             self._workers.stop()
         if self.flusher is not None:
             self.flusher.stop()
         if self.compactor is not None:
             self.compactor.stop()
+        for rid in self.region_ids():
+            region = self._regions.get(rid)
+            if region is not None and not region.writable:
+                region.release_follower_watermark()
         self.wal_mgr.close()

@@ -20,6 +20,43 @@ import threading
 from ..utils import metrics
 
 
+class FollowerSyncer:
+    """Follower freshness loop (replica.sync_interval_ms): every interval,
+    each READ-ONLY region this engine hosts replays the shared-WAL tail
+    past its applied entry id and refreshes its manifest view when the
+    leader's version advanced — so hedged reads against followers are
+    bounded-staleness instead of frozen-at-open snapshots.
+
+    One daemon thread per engine (like FlushScheduler); a round's failures
+    are per-region and retried next round (Region.follower_sync resumes
+    from the persisted applied position).  `sync_now()` runs one round
+    inline for deterministic tests."""
+
+    def __init__(self, engine, interval_ms: float):
+        self.engine = engine
+        self.interval_s = max(interval_ms, 1.0) / 1000.0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="follower-sync", daemon=True
+        )
+        self._thread.start()
+
+    def sync_now(self) -> dict[int, int]:
+        return self.engine.sync_followers()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.engine.sync_followers()
+            except Exception:  # noqa: BLE001 — engine logs per-region; a
+                # whole-round failure must never kill the loop
+                pass
+
+
 class FlushScheduler:
     """Background flush worker: threshold-triggered flushes run OFF the
     write path (reference mito2/src/flush.rs FlushScheduler — the write

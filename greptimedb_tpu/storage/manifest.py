@@ -113,8 +113,8 @@ class ManifestManager:
                 self._write_checkpoint()
             return self.manifest
 
-    def _apply_in_memory(self, action: dict, version: int):
-        m = self.manifest
+    def _apply_in_memory(self, action: dict, version: int, manifest: RegionManifest | None = None):
+        m = manifest if manifest is not None else self.manifest
         kind = action.get("kind")
         if kind == "change":
             m.schema = Schema.from_json(action["schema"])
@@ -197,9 +197,25 @@ class ManifestManager:
             if v is None or v < base_version - 2 * self.checkpoint_distance:
                 continue
             action = json.loads(self.store.read(name))
-            self.__dict__["manifest"] = manifest  # allow _apply_in_memory use
-            self._apply_in_memory(action, v)
+            self._apply_in_memory(action, v, manifest=manifest)
         return manifest
+
+    def refresh(self) -> tuple[RegionManifest, bool]:
+        """Re-read the manifest log from shared storage and adopt the fresh
+        state when another holder (the region's LEADER — this is the
+        follower-replica path) advanced it; returns (manifest, changed).
+
+        Read-only by construction: recovery never writes, so a follower can
+        refresh on a cadence without racing the leader's appends.  A delta
+        or checkpoint GC'd between list and read surfaces as a transient
+        error the caller retries next round — the previous view stays
+        installed, never a half-applied one."""
+        with self._lock:
+            fresh = self._recover()
+            if fresh.manifest_version <= self.manifest.manifest_version:
+                return self.manifest, False
+            self.manifest = fresh
+            return fresh, True
 
     def destroy(self):
         for name in self.store.list():

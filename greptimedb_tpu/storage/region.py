@@ -52,6 +52,13 @@ class RegionStat:
     memtable_bytes: int
     wal_entry_id: int
     flushed_entry_id: int
+    # follower-replica fields (ride heartbeat stats to the metasrv so the
+    # frontend can gate hedging on staleness): lag_ms is milliseconds since
+    # the last successful WAL-tail sync; lag_entries is best-effort (the
+    # log head is only observed at sync time)
+    writable: bool = True
+    follower_lag_entries: int = 0
+    follower_lag_ms: float = 0.0
 
 
 class Region:
@@ -142,6 +149,16 @@ class Region:
                 self.manifest_mgr.manifest.truncated_entry_id or 0,
             )
         )
+        # Replay progress marker: the highest WAL entry id applied to this
+        # region's memtable.  Leaders advance it on every write; followers
+        # advance it as follower_sync() tails the shared log, and the
+        # shared-WAL prune keeps everything a registered follower has not
+        # yet applied.
+        self.applied_entry_id = 0
+        self.last_sync_ms = time.time() * 1000
+        # set once the follower watermark is released (close/promotion);
+        # an in-flight sync round must not re-pin the shared log after it
+        self._lw_released = False
         self._replay_wal()
 
     # ---- open/replay ------------------------------------------------------
@@ -150,11 +167,14 @@ class Region:
         flushed = self.manifest_mgr.manifest.flushed_entry_id
         truncated = self.manifest_mgr.manifest.truncated_entry_id or 0
         start = max(flushed, truncated)
+        last = start
         replayed = 0
         for entry in self.wal.replay(start):
             self.sequence += 1
             self.memtable.write(self._conform(entry.batch), self.sequence)
+            last = entry.entry_id
             replayed += entry.batch.num_rows
+        self.applied_entry_id = last
         return replayed
 
     # ---- write ------------------------------------------------------------
@@ -171,6 +191,7 @@ class Region:
             self.wal.append(batch)
             self.sequence += 1
             self.memtable.write(batch, self.sequence)
+            self.applied_entry_id = self.wal.last_entry_id
         metrics.WRITE_ROWS_TOTAL.inc(batch.num_rows)
         return batch.num_rows
 
@@ -755,16 +776,123 @@ class Region:
             self.sst_reader.schema = new_schema
             self.memtable = make_memtable(new_schema, self.time_partition_ms, self.memtable_kind)
 
+    # ---- follower freshness (bounded-staleness replicas) ------------------
+    def follower_sync(self) -> tuple[int, bool]:
+        """One freshness round for a READ-ONLY follower region: refresh the
+        manifest view when the leader's version advanced (flush/compaction/
+        truncate/alter — compaction-deleted SSTs drop out of the file list
+        before a hedged read trips over them), then replay the shared-WAL
+        tail past `applied_entry_id` into the memtable.  Returns
+        (entries_applied, manifest_refreshed).
+
+        Correctness of the refresh path: adopting a fresh manifest resets
+        the memtable and restarts the tail from the NEW flushed watermark —
+        rows the leader flushed are now served from the refreshed SST set,
+        rows it has not are still in the log above the watermark, so the
+        follower view equals what a fresh open would build, without the
+        open cost.  A leader never runs this (writable regions return
+        immediately), so the snapshot behavior with syncing disabled is
+        bit-for-bit the pre-freshness one."""
+        from ..utils import fault_injection
+
+        fault_injection.fire("replica.sync", region_id=self.region_id)
+        with self._lock:
+            if self.writable:
+                return 0, False
+            applied, refreshed = self._catch_up_locked()
+            applied_to = self.applied_entry_id
+        # register the replay low-watermark OUTSIDE the region lock (it
+        # writes a shared file); shared-WAL prune keeps everything above it
+        register = getattr(self.wal, "register_replay_position", None)
+        if register is not None:
+            register(applied_to)
+            # close_region/promotion may have released the watermark while
+            # the registration write was in flight — a released region must
+            # never be re-pinned by a stale sync round (the orphan would
+            # hold pruning back for the whole registration TTL)
+            with self._lock:
+                released = self._lw_released
+            if released:
+                self.release_follower_watermark()
+        label = str(self.region_id)
+        metrics.FOLLOWER_SYNC_TOTAL.inc()
+        metrics.FOLLOWER_LAG_ENTRIES.set(0.0, region=label)
+        metrics.FOLLOWER_LAG_MS.set(0.0, region=label)
+        return applied, refreshed
+
+    def _catch_up_locked(self) -> tuple[int, bool]:
+        """Adopt the leader's manifest state if it advanced, then replay the
+        log tail past `applied_entry_id` into the memtable.  Shared by the
+        follower sync round and the promotion path (`set_writable(True)`).
+        Returns (entries_applied, manifest_refreshed)."""
+        manifest, refreshed = self.manifest_mgr.refresh()
+        if refreshed:
+            metrics.FOLLOWER_MANIFEST_REFRESH_TOTAL.inc()
+            if manifest.schema is not None:
+                self.schema = manifest.schema
+                self.sst_writer.schema = manifest.schema
+                self.sst_reader.schema = manifest.schema
+            self.memtable = make_memtable(
+                self.schema, self.time_partition_ms, self.memtable_kind
+            )
+            self._frozen_memtables.clear()
+            self.sequence = manifest.flushed_sequence
+            self.applied_entry_id = max(
+                manifest.flushed_entry_id, manifest.truncated_entry_id or 0
+            )
+        applied = 0
+        for entry in self.wal.replay(self.applied_entry_id):
+            self.sequence += 1
+            self.memtable.write(self._conform(entry.batch), self.sequence)
+            self.applied_entry_id = entry.entry_id
+            applied += 1
+        self.wal.advance_to(self.applied_entry_id)
+        self.last_sync_ms = time.time() * 1000
+        return applied, refreshed
+
+    def release_follower_watermark(self):
+        """Stop holding the shared WAL back (follower closed/promoted).
+        Latches `_lw_released` so an in-flight sync round that registers
+        concurrently undoes its own registration (see follower_sync)."""
+        with self._lock:
+            self._lw_released = True
+        release = getattr(self.wal, "release_replay_position", None)
+        if release is not None:
+            release()
+
     def set_writable(self, writable: bool):
         """Leader/follower role flip (reference set_region_role).  Takes
         the region lock so a downgrade returns only after in-flight writes
         finish their WAL append — the migration candidate's catch-up replay
         must never race a torn tail."""
         with self._lock:
+            was = self.writable
+            if writable and not was:
+                # promotion catch-up: adopt the leader's final manifest
+                # state and replay the un-applied shared-log tail BEFORE
+                # the first write — entries above the last sync round would
+                # otherwise be lost from the memtable, and the first append
+                # would reuse entry ids the old leader already wrote to the
+                # shared topic (append allocates last_entry_id + 1)
+                self._catch_up_locked()
+            if not writable:
+                # (re)entering the follower role: sync rounds may pin the
+                # shared log again (a later promotion re-latches)
+                self._lw_released = False
             self.writable = writable
+        if writable and not was:
+            # a promoted follower must not keep pinning the shared log
+            self.release_follower_watermark()
 
     def stat(self) -> RegionStat:
         m = self.manifest_mgr.manifest
+        lag_entries, lag_ms = 0, 0.0
+        if not self.writable:
+            lag_entries = max(0, self.wal.last_entry_id - self.applied_entry_id)
+            lag_ms = max(0.0, time.time() * 1000 - self.last_sync_ms)
+            label = str(self.region_id)
+            metrics.FOLLOWER_LAG_ENTRIES.set(lag_entries, region=label)
+            metrics.FOLLOWER_LAG_MS.set(lag_ms, region=label)
         return RegionStat(
             region_id=self.region_id,
             num_rows=sum(f.num_rows for f in m.files.values()) + self.memtable.num_rows,
@@ -773,6 +901,9 @@ class Region:
             memtable_bytes=self.memtable.memory_usage,
             wal_entry_id=self.wal.last_entry_id,
             flushed_entry_id=m.flushed_entry_id,
+            writable=self.writable,
+            follower_lag_entries=lag_entries,
+            follower_lag_ms=lag_ms,
         )
 
     def files(self) -> list[FileMeta]:

@@ -36,16 +36,23 @@ import json
 import os
 import struct
 import threading
+import time
+import uuid
 import zlib
 
 import pyarrow as pa
 
-from ..utils import fault_injection
-from ..utils.errors import StorageError
+from ..utils import fault_injection, metrics
+from ..utils.errors import RetryLaterError, StorageError
 from .wal import WalEntry, _decode_batch, _encode_batch
 
 _FRAME = struct.Struct("<IIQQ")
 SEGMENT_BYTES_DEFAULT = 4 << 20
+# A follower registration older than this is ignored by prune: a follower
+# that died (or stopped syncing) must not hold the shared log hostage
+# forever.  Live followers refresh their position every sync round, which
+# is orders of magnitude more frequent.
+FOLLOWER_LW_TTL_S = 600.0
 
 
 class SharedLogStore:
@@ -65,6 +72,21 @@ class SharedLogStore:
         if os.path.exists(self._flushed_path):
             with open(self._flushed_path) as f:
                 self._flushed = {k: int(v) for k, v in json.load(f).items()}
+        # follower replay low-watermarks: {region: {holder: [entry_id, ts]}}.
+        # Followers register the entry id they have applied up to; prune
+        # keeps min(flushed, follower_lw) so the tail a follower still
+        # needs is never deleted under it.  Registrations expire after
+        # follower_lw_ttl_s so a dead follower cannot pin the log forever.
+        self.follower_lw_ttl_s = FOLLOWER_LW_TTL_S
+        self._followers: dict[str, dict[str, list]] = {}
+        # (region, holder) pairs registered THROUGH this instance — the only
+        # entries this instance is authoritative for on reload; everything
+        # else is read from disk, so another instance's unregister (follower
+        # closed/promoted) deletes for real instead of being resurrected by
+        # our stale in-memory copy on the next persist
+        self._own: set[tuple[str, str]] = set()
+        self._followers_path = os.path.join(root, "followers.json")
+        self._reload_followers_locked()
 
     # ---- topics ------------------------------------------------------------
     def _topic_dir(self, topic: str) -> str:
@@ -134,19 +156,39 @@ class SharedLogStore:
     def _read_segment(self, path: str, region_id: int, from_entry_id: int, tolerate_tail: bool):
         with open(path, "rb") as f:
             while True:
+                # chaos hook: a test can run prune() at exactly this moment
+                # to race segment deletion against a reader holding the file
+                fault_injection.fire(
+                    "wal.prune_during_read", path=path, region_id=region_id
+                )
                 header = f.read(_FRAME.size)
                 if len(header) < _FRAME.size:
                     if header and not tolerate_tail:
-                        raise StorageError(f"corrupt sealed wal segment {path}")
+                        raise self._sealed_read_error(path)
                     return
                 length, crc, rid, entry_id = _FRAME.unpack(header)
                 payload = f.read(length)
                 if len(payload) < length or zlib.crc32(payload) != crc:
                     if not tolerate_tail:
-                        raise StorageError(f"corrupt sealed wal segment {path}")
+                        raise self._sealed_read_error(path)
                     return  # torn tail of the active segment — stop here
                 if rid == region_id and entry_id > from_entry_id:
                     yield WalEntry(entry_id, _decode_batch(payload))
+
+    @staticmethod
+    def _sealed_read_error(path: str) -> Exception:
+        """A sealed segment is immutable after the .idx marker lands, so a
+        short/CRC-failing frame in one means either real corruption or the
+        segment was PRUNED under this reader (the platform let the unlink
+        orphan the open handle's view).  The pruned case is retryable by
+        contract — the replay restarts from the caller's watermark and the
+        pruned entries were flushed/covered anyway — and must never surface
+        as a mid-frame decode crash."""
+        if not os.path.exists(path):
+            return RetryLaterError(
+                f"wal segment {path} pruned during read; retry the replay"
+            )
+        return StorageError(f"corrupt sealed wal segment {path}")
 
     def last_entry_id(self, topic: str, region_id: int) -> int:
         last = 0
@@ -184,29 +226,121 @@ class SharedLogStore:
     def flushed(self, region_id: int) -> int:
         return self._flushed.get(str(region_id), 0)
 
+    # ---- follower replay low-watermarks ------------------------------------
+    def _reload_followers_locked(self):
+        """Adopt the registrations other store instances persisted (a
+        follower datanode registers through ITS store object; the leader's
+        prune must see it).  Disk is authoritative for holders this
+        instance did not register — including DELETIONS: an unregister
+        persisted elsewhere must not be resurrected from our stale
+        in-memory copy.  For our OWN holders the newest timestamp wins."""
+        try:
+            with open(self._followers_path) as f:
+                on_disk = json.load(f)
+        except (OSError, ValueError):
+            on_disk = {}
+        merged: dict[str, dict[str, list]] = {
+            rid: {
+                holder: [int(entry_id), float(ts)]
+                for holder, (entry_id, ts) in holders.items()
+            }
+            for rid, holders in on_disk.items()
+        }
+        for rid, holder in self._own:
+            val = self._followers.get(rid, {}).get(holder)
+            if val is None:
+                continue
+            cur = merged.setdefault(rid, {}).get(holder)
+            if cur is None or val[1] >= cur[1]:
+                merged[rid][holder] = val
+        self._followers = merged
+
+    def _persist_followers_locked(self):
+        tmp = self._followers_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._followers, f)
+        os.replace(tmp, self._followers_path)
+
+    def register_follower(self, region_id: int, holder: str, entry_id: int):
+        """Record that follower `holder` has replayed region `region_id` up
+        to `entry_id`: prune keeps min(flushed, follower_lw) so the tail
+        this follower still needs is never deleted under it."""
+        with self._lock:
+            key = (str(region_id), holder)
+            if key in self._own:
+                cur = self._followers.get(key[0], {}).get(holder)
+                # unchanged position + still-fresh stamp: skip the whole
+                # reload-merge-rewrite cycle.  follower_sync re-registers
+                # every round, so an idle cluster would otherwise rewrite
+                # the shared followers.json once per region per interval;
+                # refreshing only past half the TTL keeps the on-disk
+                # stamp at most ttl/2 stale — liveness still holds.
+                if (cur is not None and cur[0] == int(entry_id)
+                        and time.time() - cur[1] < self.follower_lw_ttl_s / 2):
+                    return
+            self._own.add(key)
+            self._reload_followers_locked()
+            self._followers.setdefault(str(region_id), {})[holder] = [
+                int(entry_id), time.time(),
+            ]
+            self._persist_followers_locked()
+
+    def unregister_follower(self, region_id: int, holder: str):
+        """Drop a follower's registration (it closed or was promoted) so
+        it stops holding segments back."""
+        with self._lock:
+            self._own.discard((str(region_id), holder))
+            self._reload_followers_locked()
+            holders = self._followers.get(str(region_id))
+            if holders and holder in holders:
+                del holders[holder]
+                if not holders:
+                    del self._followers[str(region_id)]
+                self._persist_followers_locked()
+
+    def _follower_lw_locked(self, region_key: str) -> int | None:
+        """Minimum replay position over FRESH follower registrations, or
+        None when no live follower constrains this region."""
+        holders = self._followers.get(region_key)
+        if not holders:
+            return None
+        cutoff = time.time() - self.follower_lw_ttl_s
+        fresh = [e for e, ts in holders.values() if ts >= cutoff]
+        return min(fresh) if fresh else None
+
     def prune(self, topic: str) -> int:
-        """Delete sealed segments whose every entry is flushed; returns the
-        number of segments removed (the reference's wal_prune procedure
-        advances Kafka's trim point under the same condition)."""
+        """Delete sealed segments whose every entry is flushed AND replayed
+        past by every live follower; returns the number of segments removed
+        (the reference's wal_prune procedure advances Kafka's trim point
+        under the flushed condition; the follower low-watermark is what
+        keeps bounded-staleness replicas from losing the tail they are
+        about to replay)."""
         removed = 0
         d = self._topic_dir(topic)
         with self._lock:
             self._reload_flushed_locked()  # see other datanodes' flush marks
+            self._reload_followers_locked()  # and followers' replay marks
             for name in self._segments(topic):
                 idx_path = os.path.join(d, name + ".idx")
                 if not os.path.exists(idx_path):
                     break  # active segment — nothing newer is prunable either
                 with open(idx_path) as f:
                     max_by_region = json.load(f)
-                if all(
-                    self._flushed.get(rid, 0) >= max_id
-                    for rid, max_id in max_by_region.items()
-                ):
-                    os.remove(os.path.join(d, name))
-                    os.remove(idx_path)
-                    removed += 1
-                else:
+                held = False
+                for rid, max_id in max_by_region.items():
+                    if self._flushed.get(rid, 0) < max_id:
+                        held = True
+                        break
+                    lw = self._follower_lw_locked(rid)
+                    if lw is not None and lw < max_id:
+                        metrics.WAL_PRUNE_HELD_TOTAL.inc()
+                        held = True
+                        break
+                if held:
                     break  # keep order: never punch holes in the log
+                os.remove(os.path.join(d, name))
+                os.remove(idx_path)
+                removed += 1
         return removed
 
     def prune_all(self) -> int:
@@ -292,6 +426,11 @@ class RemoteRegionWal:
         self.region_id = region_id
         self._lock = threading.Lock()
         self.last_entry_id = store.last_entry_id(topic, region_id)
+        # per-instance holder token for follower low-watermark registration
+        # (leader and follower engines hold distinct instances over the
+        # same shared directory, like they'd hold distinct Kafka consumers)
+        self._holder = uuid.uuid4().hex[:12]
+        self._registered = False
 
     def advance_to(self, entry_id: int):
         with self._lock:
@@ -313,8 +452,21 @@ class RemoteRegionWal:
         obsolete on Kafka likewise only moves indexes)."""
         self.store.set_flushed(self.region_id, up_to_entry_id)
 
+    # ---- follower replay position (bounded-staleness replicas) -------------
+    def register_replay_position(self, entry_id: int):
+        """A follower tailing this log records how far it has applied;
+        prune keeps every entry a registered follower still needs."""
+        self.store.register_follower(self.region_id, self._holder, entry_id)
+        self._registered = True
+
+    def release_replay_position(self):
+        """Stop constraining prune (follower closed or was promoted)."""
+        if self._registered:
+            self.store.unregister_follower(self.region_id, self._holder)
+            self._registered = False
+
     def close(self):
-        pass  # topic files are owned by the store
+        self.release_replay_position()  # topic files are owned by the store
 
 
 class RemoteWalManager:

@@ -91,6 +91,12 @@ class StorageConfig:
     store_retry_attempts: int = 3
     write_cache_enable: bool = False  # local staging in front of non-fs stores
     write_cache_capacity_mb: int = 512
+    # Storage-plane mirror of replica.sync_interval_ms: engines built from
+    # a bare StorageConfig (datanode roles) read the follower-sync cadence
+    # here; Config.__post_init__ copies the replica.* knob down so the
+    # user-facing surface stays `replica.sync_interval_ms`.  0 = no
+    # follower tailing (open-time snapshots).
+    follower_sync_interval_ms: float = 0.0
 
     def __post_init__(self):
         # NOTE: wal_dir/sst_dir stay EMPTY unless explicitly set — they are
@@ -263,9 +269,27 @@ class ReplicaConfig:
     """Follower read replicas: read-only opens of a region on extra
     datanodes over the shared storage, registered in the metasrv route
     table.  Default OFF — followers must be added explicitly
-    (MetaClient.add_follower) and reads only consult them when enabled."""
+    (MetaClient.add_follower) or placed by the metasrv selector
+    (target_followers > 0), and reads only consult them when enabled."""
 
     read_followers: bool = False
+    # Follower freshness: every sync_interval_ms a follower replays the
+    # shared-WAL tail past its applied entry id and refreshes its manifest
+    # view when the leader's manifest version advanced (so compaction-
+    # deleted SSTs are dropped before a hedged read trips over them).
+    # 0 disables tailing entirely and restores the open-time-snapshot
+    # behavior bit-for-bit.
+    sync_interval_ms: float = 0.0
+    # Hedge gating: the fan-out skips hedging to a follower whose reported
+    # lag (ms since its last successful sync) exceeds this bound, so
+    # hedged reads are bounded-staleness by contract.  0 disables gating
+    # (any registered follower is hedge-eligible, today's behavior).
+    max_lag_ms: float = 0.0
+    # Automatic placement: the metasrv selector keeps this many followers
+    # per region on distinct live datanodes — creating them on node
+    # join/failover and garbage-collecting orphans on node death.
+    # 0 keeps placement manual (MetaClient.add_follower only).
+    target_followers: int = 0
 
 
 @dataclasses.dataclass
@@ -332,6 +356,12 @@ class Config:
 
     def __post_init__(self):
         self.storage.__post_init__()
+        # replica.sync_interval_ms is the user-facing follower-tailing
+        # knob; engines only see StorageConfig, so copy it down (an
+        # explicitly-set storage.follower_sync_interval_ms survives when
+        # the replica knob is off)
+        if self.replica.sync_interval_ms > 0:
+            self.storage.follower_sync_interval_ms = self.replica.sync_interval_ms
         self.validate()
 
     def validate(self):
@@ -341,7 +371,35 @@ class Config:
         config mistakes, not modes."""
         from .errors import ConfigError
 
-        q, b, t = self.query, self.breaker, self.tile
+        q, b, t, r = self.query, self.breaker, self.tile, self.replica
+        if r.sync_interval_ms < 0:
+            raise ConfigError(
+                "replica.sync_interval_ms must be >= 0 milliseconds (0 disables "
+                f"follower WAL tailing); got {r.sync_interval_ms!r}"
+            )
+        if r.max_lag_ms < 0:
+            raise ConfigError(
+                "replica.max_lag_ms must be >= 0 milliseconds (0 disables hedge "
+                f"staleness gating); got {r.max_lag_ms!r}"
+            )
+        if (r.max_lag_ms > 0 and r.sync_interval_ms <= 0
+                and self.storage.follower_sync_interval_ms <= 0):
+            # a never-syncing follower's reported lag grows from open time,
+            # so this combination silently gates every follower out of
+            # hedging within max_lag_ms of its open — a config mistake,
+            # not a mode
+            raise ConfigError(
+                "replica.max_lag_ms > 0 requires follower WAL tailing "
+                "(replica.sync_interval_ms > 0), or every follower ages "
+                f"out of hedging at its open-time snapshot; got max_lag_ms="
+                f"{r.max_lag_ms!r} with sync_interval_ms="
+                f"{r.sync_interval_ms!r}"
+            )
+        if r.target_followers < 0:
+            raise ConfigError(
+                "replica.target_followers must be >= 0 followers per region "
+                f"(0 keeps placement manual); got {r.target_followers!r}"
+            )
         if not isinstance(q.device_topk, bool):
             raise ConfigError(
                 "query.device_topk must be a boolean (on-device Sort/LIMIT/"

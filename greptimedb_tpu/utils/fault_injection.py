@@ -24,6 +24,17 @@ Named points wired into the codebase:
     node.set_writable  by FaultInjectingNodeManager in distributed/metasrv.py
     flow.mirror        FlownodeClient.mirror_insert (frontend->flownode
                        mirrored inserts; best-effort by contract)
+    flow.dedupe        FlownodeFlightServer.do_put AFTER a mirrored batch is
+                       applied + registered in the dedupe window but BEFORE
+                       the reply is written — an injected error here IS the
+                       applied-but-reply-lost retry scenario exactly-once
+                       dedupe exists for
+    wal.prune_during_read  SharedLogStore._read_segment between frames, so a
+                       test can run prune at the precise moment a reader
+                       holds a sealed segment open
+    replica.sync       Region.follower_sync entry (per sync round, before
+                       the region lock) — wedge/fail the follower tailing
+                       loop on cue
 
 Production overhead is near zero: `fire()` is a module-level function whose
 fast path is one read of a module global (`_ARMED`) — no locks, no dict
@@ -67,6 +78,9 @@ POINTS = frozenset(
         "node.flush_region",
         "node.set_writable",
         "flow.mirror",
+        "flow.dedupe",
+        "wal.prune_during_read",
+        "replica.sync",
     }
 )
 

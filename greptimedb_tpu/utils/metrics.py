@@ -293,3 +293,59 @@ FLOW_MIRROR_FAILURES_TOTAL = REGISTRY.counter(
 FLOW_MIRROR_DROPPED_TOTAL = REGISTRY.counter(
     "greptime_flow_mirror_dropped_total", "Flow mirror batches dropped after exhausting retries"
 )
+FLOW_DEDUPE_TOTAL = REGISTRY.counter(
+    "greptime_flow_dedupe_total",
+    "Mirrored batches the flownode deduplicated by (source, batch_id) — "
+    "applied-but-reply-lost retries that would have double-counted",
+)
+
+# Follower freshness (bounded-staleness replicas): per-region lag gauges
+# exported by the follower's own engine, and the hedge/placement/pruning
+# counters that ride on them.
+FOLLOWER_LAG_ENTRIES = REGISTRY.gauge(
+    "greptime_follower_lag_entries",
+    "WAL entries a follower region has not yet replayed (best-effort: the "
+    "log head is observed at sync time)",
+)
+FOLLOWER_LAG_MS = REGISTRY.gauge(
+    "greptime_follower_lag_ms",
+    "Milliseconds since a follower region's last successful WAL-tail sync "
+    "(grows monotonically while the sync loop is wedged or disabled)",
+)
+FOLLOWER_SYNC_TOTAL = REGISTRY.counter(
+    "greptime_follower_sync_total", "Follower WAL-tail sync rounds completed"
+)
+FOLLOWER_SYNC_FAILURES_TOTAL = REGISTRY.counter(
+    "greptime_follower_sync_failures_total",
+    "Follower sync rounds that failed (transient WAL/manifest weather)",
+)
+FOLLOWER_MANIFEST_REFRESH_TOTAL = REGISTRY.counter(
+    "greptime_follower_manifest_refresh_total",
+    "Follower manifest-view refreshes taken because the leader's manifest "
+    "version advanced (flush/compaction/truncate/alter)",
+)
+HEDGE_SKIPPED_STALE_TOTAL = REGISTRY.counter(
+    "greptime_hedge_skipped_stale_total",
+    "Hedge candidates skipped because the follower's lag exceeded "
+    "replica.max_lag_ms",
+)
+FANOUT_CANCELLED_TOTAL = REGISTRY.counter(
+    "greptime_fanout_cancelled_total",
+    "In-flight Flight calls best-effort cancelled at deadline expiry "
+    "(feature-detected reader cancel, channel close for calls still "
+    "waiting on the stream; detach-and-drop is the fallback)",
+)
+FOLLOWER_PLACEMENTS_TOTAL = REGISTRY.counter(
+    "greptime_follower_placements_total",
+    "Followers opened by the metasrv placement selector",
+)
+FOLLOWER_GC_TOTAL = REGISTRY.counter(
+    "greptime_follower_gc_total",
+    "Orphaned followers (dead node / now-the-leader) garbage-collected "
+    "from region routes by the placement pass",
+)
+WAL_PRUNE_HELD_TOTAL = REGISTRY.counter(
+    "greptime_wal_prune_held_total",
+    "Shared-WAL segments whose deletion was held back by a follower "
+    "replay low-watermark",
+)

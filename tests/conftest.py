@@ -34,11 +34,30 @@ _WATCHDOG_FILE = os.environ.get(
 _watchdog_fh = None
 
 
+def _dump_follower_lag(fh):
+    """Write the per-region follower lag gauges into the watchdog file just
+    before the faulthandler stack dump fires: a wedged follower sync loop
+    (the thread stuck, lag_ms growing) leaves numeric evidence next to the
+    stacks instead of only an inscrutable hang."""
+    try:
+        from greptimedb_tpu.utils import metrics as _m
+
+        lines = ["", "-- follower lag at watchdog deadline --"]
+        lines += _m.FOLLOWER_LAG_ENTRIES.render()
+        lines += _m.FOLLOWER_LAG_MS.render()
+        fh.write("\n".join(lines) + "\n")
+        fh.flush()
+    except Exception:  # noqa: BLE001 — diagnostics must never fail a test
+        pass
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_protocol(item, nextitem):
     import faulthandler
+    import threading
 
     global _watchdog_fh
+    lag_timer = None
     if _WATCHDOG_S > 0:
         if _watchdog_fh is None:
             _watchdog_fh = open(_WATCHDOG_FILE, "w")
@@ -46,12 +65,23 @@ def pytest_runtest_protocol(item, nextitem):
         _watchdog_fh.seek(0)
         _watchdog_fh.write(f"watchdog armed for: {item.nodeid}\n")
         _watchdog_fh.flush()
+        # the lag snapshot runs a beat BEFORE faulthandler's C-level dump
+        # (which cannot run Python code) so both land in the same file
+        lag_timer = threading.Timer(
+            max(_WATCHDOG_S - 2.0, _WATCHDOG_S * 0.9),
+            _dump_follower_lag,
+            args=(_watchdog_fh,),
+        )
+        lag_timer.daemon = True
+        lag_timer.start()
         faulthandler.dump_traceback_later(
             _WATCHDOG_S, exit=False, file=_watchdog_fh
         )
     yield
     if _WATCHDOG_S > 0:
         faulthandler.cancel_dump_traceback_later()
+        if lag_timer is not None:
+            lag_timer.cancel()
 
 
 def pytest_sessionstart(session):

@@ -64,14 +64,23 @@ class _FlightNodeManager:
 class ChaosCluster:
     """1 metasrv (HTTP) + N Flight datanodes + 1 frontend, logical clock."""
 
-    def __init__(self, root: str, num_datanodes: int = 2):
+    def __init__(
+        self,
+        root: str,
+        num_datanodes: int = 2,
+        wal_provider: str = "local",
+        target_followers: int = 0,
+    ):
         self.home = root
         self.now = [1_000_000.0]  # logical ms fed to heartbeats/ticks
         self.kv = MemoryKvBackend()
         self.datanodes = {
-            i: FlightDatanode(i, self.home) for i in range(num_datanodes)
+            i: FlightDatanode(i, self.home, wal_provider=wal_provider)
+            for i in range(num_datanodes)
         }
-        self.metasrv = Metasrv(self.kv, _FlightNodeManager(self))
+        self.metasrv = Metasrv(
+            self.kv, _FlightNodeManager(self), target_followers=target_followers
+        )
         for i, dn in self.datanodes.items():
             self.metasrv.register_datanode(
                 i, dn.location.removeprefix("grpc://")
@@ -87,7 +96,13 @@ class ChaosCluster:
         self.now[0] += advance_ms
         for nid, dn in self.datanodes.items():
             if dn.alive:
-                self.metasrv.handle_heartbeat(nid, [], self.now[0])
+                # real region stats ride the heartbeat so the metasrv's
+                # follower-lag view (hedge staleness gating) has input
+                self.metasrv.handle_heartbeat(
+                    nid,
+                    [s.__dict__ for s in dn.engine.region_statistics()],
+                    self.now[0],
+                )
 
     def establish_cadence(self, rounds: int = 8):
         for _ in range(rounds):
@@ -680,3 +695,693 @@ def test_flaky_shared_wal_append_absorbed_by_frontend_retry(chaos):
     finally:
         server.shutdown()
         engine.close()
+
+
+# ---- follower freshness: WAL-tail replay bounds hedged-read staleness ------
+
+
+@pytest.fixture()
+def repl(tmp_path):
+    """3-datanode cluster on the shared-topic remote WAL (the follower
+    tailing path the reference gets from Kafka)."""
+    c = ChaosCluster(
+        str(tmp_path / "shared_repl"), num_datanodes=3, wal_provider="shared_file"
+    )
+    yield c
+    c.close()
+
+
+@pytest.mark.chaos
+def test_follower_tails_wal_and_hedge_serves_fresh_rows(repl):
+    """With syncing disabled a follower is an open-time snapshot (the
+    pre-freshness contract, bit-for-bit); one sync round replays the
+    shared-WAL tail and the hedged read serves the NEW rows."""
+    from greptimedb_tpu.storage.sst import ScanPredicate
+
+    meta, rid, owner = _setup_table(repl, "tf1")
+    other = next(n for n in repl.datanodes if n != owner)
+    client = MetaClient([repl.server.address])
+    client.add_follower(meta.table_id, rid, other)
+
+    # leader takes two more rows AFTER the follower opened
+    repl.frontend.sql_one("INSERT INTO tf1 VALUES ('d', 4000, 4.0), ('e', 5000, 5.0)")
+    follower_engine = repl.datanodes[other].engine
+    # snapshot behavior while replica.sync_interval_ms=0: frozen at open
+    assert follower_engine.scan(rid, ScanPredicate()).num_rows == 3
+
+    synced = follower_engine.sync_followers()
+    assert synced.get(rid, 0) >= 1  # the tail was replayed
+    assert follower_engine.scan(rid, ScanPredicate()).num_rows == 5
+
+    # hedged read against the fresh follower beats a slowed leader
+    fe = repl.frontend
+    fe.config.replica.read_followers = True
+    fe.config.query.hedge_delay_ms = 50.0
+    fe.config.replica.max_lag_ms = 60_000.0  # freshly synced: well inside
+    fe.config.query.timeout_s = 5.0
+    fe._follower_cache.clear()
+    repl.heartbeat_live()  # ship follower lag stats to the metasrv
+    fi.REGISTRY.arm(
+        "flight.do_get", fail_times=100, latency_s=3.0,
+        match=lambda ctx: ctx.get("node_id") == owner,
+    )
+    wins0 = metrics.HEDGE_WINS_TOTAL.get()
+    try:
+        out = fe.sql_one("SELECT count(*) AS c FROM tf1")
+    finally:
+        fe.config.query.timeout_s = 0.0
+        fi.REGISTRY.disarm("flight.do_get")
+    assert out["c"].to_pylist() == [5]  # the hedge saw the tailed rows
+    assert metrics.HEDGE_WINS_TOTAL.get() - wins0 >= 1
+    rendered = metrics.REGISTRY.render()
+    assert "greptime_follower_lag_ms" in rendered
+    assert "greptime_follower_lag_entries" in rendered
+
+    # staleness gating: make the follower report a lag beyond the bound —
+    # the fan-out must stop hedging to it instead of serving stale data
+    follower_engine.region(rid).last_sync_ms -= 10_000.0
+    repl.heartbeat_live()
+    fe.config.replica.max_lag_ms = 1_000.0
+    fe._follower_cache.clear()
+    skipped0 = metrics.HEDGE_SKIPPED_STALE_TOTAL.get()
+    assert fe._followers_for(meta) == {}
+    assert metrics.HEDGE_SKIPPED_STALE_TOTAL.get() - skipped0 >= 1
+    fe.config.replica.read_followers = False
+    fe.config.query.hedge_delay_ms = 0.0
+    fe.config.replica.max_lag_ms = 0.0
+
+
+@pytest.mark.chaos
+def test_leader_compaction_under_live_follower_hedge_wins_after_refresh(repl):
+    """A leader compaction deletes SSTs the follower's frozen manifest
+    still references — exactly the hedge-breaking scenario.  The manifest
+    refresh in the sync round adopts the post-compaction file set, and the
+    hedge wins again."""
+    from greptimedb_tpu.storage.compaction import compact_region
+    from greptimedb_tpu.storage.sst import ScanPredicate
+
+    meta, rid, owner = _setup_table(repl, "tf2")
+    leader = repl.datanodes[owner]
+    # two flushed SSTs with OVERLAPPING time ranges = two sorted runs in
+    # one window, which the TWCS picker must merge
+    leader.client.flush_region(rid)
+    repl.frontend.sql_one("INSERT INTO tf2 VALUES ('d', 1500, 4.0)")
+    leader.client.flush_region(rid)
+    leader_region = leader.engine.region(rid)
+    assert len(leader_region.files()) >= 2
+
+    other = next(n for n in repl.datanodes if n != owner)
+    client = MetaClient([repl.server.address])
+    client.add_follower(meta.table_id, rid, other)
+    follower_region = repl.datanodes[other].engine.region(rid)
+    frozen_files = {f.file_id for f in follower_region.files()}
+
+    # compact with zero GC grace: the inputs are deleted from shared
+    # storage IMMEDIATELY, while the follower's manifest still names them
+    leader_region.gc_grace_secs = 0.0
+    assert compact_region(leader_region, max_active_runs=1, max_inactive_runs=1) >= 1
+    live_files = {f.file_id for f in leader_region.files()}
+    assert live_files != frozen_files
+    # the follower's frozen view now points at deleted SSTs: a direct scan
+    # trips over the missing files (this is what the refresh fixes)
+    with pytest.raises(OSError):
+        follower_region.scan(ScanPredicate())
+
+    refreshes0 = metrics.FOLLOWER_MANIFEST_REFRESH_TOTAL.get()
+    repl.datanodes[other].engine.sync_followers()
+    assert metrics.FOLLOWER_MANIFEST_REFRESH_TOTAL.get() - refreshes0 >= 1
+    assert {f.file_id for f in follower_region.files()} == live_files
+    assert follower_region.scan(ScanPredicate()).num_rows == 4
+
+    fe = repl.frontend
+    fe.config.replica.read_followers = True
+    fe.config.query.hedge_delay_ms = 50.0
+    fe.config.query.timeout_s = 5.0
+    fe._follower_cache.clear()
+    fi.REGISTRY.arm(
+        "flight.do_get", fail_times=100, latency_s=3.0,
+        match=lambda ctx: ctx.get("node_id") == owner,
+    )
+    wins0 = metrics.HEDGE_WINS_TOTAL.get()
+    try:
+        out = fe.sql_one("SELECT count(*) AS c FROM tf2")
+    finally:
+        fe.config.query.timeout_s = 0.0
+        fe.config.query.hedge_delay_ms = 0.0
+        fe.config.replica.read_followers = False
+        fi.REGISTRY.disarm("flight.do_get")
+    assert out["c"].to_pylist() == [4]
+    assert metrics.HEDGE_WINS_TOTAL.get() - wins0 >= 1
+
+
+@pytest.mark.chaos
+def test_follower_sync_fault_absorbed_and_next_round_catches_up(repl):
+    """A sync round that dies (injected storage weather at the replica.sync
+    point) is recorded and absorbed — the follower keeps serving its last
+    view, and the NEXT round resumes from the persisted applied position."""
+    from greptimedb_tpu.storage.sst import ScanPredicate
+
+    meta, rid, owner = _setup_table(repl, "tf3")
+    other = next(n for n in repl.datanodes if n != owner)
+    MetaClient([repl.server.address]).add_follower(meta.table_id, rid, other)
+    repl.frontend.sql_one("INSERT INTO tf3 VALUES ('d', 4000, 4.0)")
+
+    follower_engine = repl.datanodes[other].engine
+    plan = fi.REGISTRY.arm("replica.sync", fail_times=1, error=OSError)
+    fails0 = metrics.FOLLOWER_SYNC_FAILURES_TOTAL.get()
+    assert follower_engine.sync_followers() == {}  # round failed, no raise
+    assert plan.trips == 1
+    assert metrics.FOLLOWER_SYNC_FAILURES_TOTAL.get() - fails0 == 1
+    assert follower_engine.scan(rid, ScanPredicate()).num_rows == 3  # old view
+    assert follower_engine.sync_followers().get(rid, 0) >= 1  # healed
+    assert follower_engine.scan(rid, ScanPredicate()).num_rows == 4
+
+
+def test_follower_sync_interval_thread_tails_without_explicit_calls(tmp_path):
+    """storage.follower_sync_interval_ms > 0 (the copy-down target of
+    replica.sync_interval_ms) starts the background FollowerSyncer: a
+    read-only region converges on the leader's writes with no explicit
+    sync calls."""
+    from greptimedb_tpu.storage.engine import TimeSeriesEngine
+    from greptimedb_tpu.storage.sst import ScanPredicate
+    from greptimedb_tpu.utils.config import StorageConfig
+    from tests.test_flight import cpu_schema, make_batch
+
+    home = str(tmp_path / "shared")
+    leader = TimeSeriesEngine(StorageConfig(data_home=home, wal_provider="shared_file"))
+    follower = TimeSeriesEngine(StorageConfig(
+        data_home=home, wal_provider="shared_file", follower_sync_interval_ms=20.0
+    ))
+    try:
+        assert follower.follower_syncer is not None
+        assert leader.follower_syncer is None  # off-safe default
+        schema = cpu_schema()
+        leader.create_region(5120, schema)
+        leader.write(5120, make_batch(schema, ["a"], [1000], [1.0]))
+        follower.open_region(5120)
+        follower.region(5120).set_writable(False)
+        leader.write(5120, make_batch(schema, ["b", "c"], [2000, 3000], [2.0, 3.0]))
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline:
+            if follower.scan(5120, ScanPredicate()).num_rows == 3:
+                break
+            _time.sleep(0.02)
+        assert follower.scan(5120, ScanPredicate()).num_rows == 3
+    finally:
+        follower.close()
+        leader.close()
+
+
+@pytest.mark.chaos
+def test_promotion_replays_unapplied_wal_tail(repl):
+    """Rows written after a follower's last sync round must survive its
+    promotion: set_writable(True) replays the un-applied shared-log tail
+    before the region takes writes, and the promoted leader's first append
+    must not reuse entry ids the dead leader already wrote to the topic
+    (a fresh open replaying the log would see the collision as lost or
+    duplicated rows)."""
+    meta, rid, owner = _setup_table(repl, "tpc")
+    other = next(n for n in repl.datanodes if n != owner)
+    MetaClient([repl.server.address]).add_follower(meta.table_id, rid, other)
+    # sync_interval_ms=0: the follower never tails these two rows
+    repl.frontend.sql_one(
+        "INSERT INTO tpc VALUES ('d', 4000, 4.0), ('e', 5000, 5.0)"
+    )
+    repl.datanodes[owner].kill()
+    repl.fail_over_dead_node()
+    _meta, routes = repl.route_of("tpc")
+    assert routes[rid] == other  # the follower was promoted
+    out = repl.frontend.sql_one("SELECT count(*) AS c FROM tpc")
+    assert out["c"].to_pylist() == [5]  # promotion replayed the tail
+    # the promoted leader appends with a FRESH entry id: a cold open over
+    # the shared home replays the whole topic — an id collision (append
+    # below the dead leader's head) would surface as missing/doubled rows
+    repl.frontend.sql_one("INSERT INTO tpc VALUES ('f', 6000, 6.0)")
+    from greptimedb_tpu.storage.engine import TimeSeriesEngine
+    from greptimedb_tpu.storage.sst import ScanPredicate
+    from greptimedb_tpu.utils.config import StorageConfig
+
+    fresh = TimeSeriesEngine(
+        StorageConfig(data_home=repl.home, wal_provider="shared_file")
+    )
+    try:
+        fresh.open_region(rid)
+        assert fresh.scan(rid, ScanPredicate()).num_rows == 6
+    finally:
+        fresh.close()
+
+
+# ---- shared-WAL pruning vs followers and in-flight readers -----------------
+
+
+def _wal_batch():
+    from tests.test_flight import cpu_schema, make_batch
+
+    return make_batch(cpu_schema(), ["x"], [1000], [1.0])
+
+
+def test_shared_wal_prune_respects_follower_low_watermark(tmp_path):
+    """prune keeps min(flushed, follower_lw): a registered follower that
+    has not replayed past a segment pins it, a caught-up (or expired, or
+    unregistered) one releases it."""
+    from greptimedb_tpu.storage.remote_wal import SharedLogStore
+
+    store = SharedLogStore(str(tmp_path / "wal"), segment_bytes=128)
+    batch = _wal_batch()
+    # every append overflows the tiny segment and seals it (2 fsyncs each),
+    # so 4 entries per wave keeps the test fast while still giving several
+    # sealed segments to prune
+    for i in range(1, 5):
+        store.append("topic_0", 7, i, batch)
+    assert len(store._segments("topic_0")) >= 3
+    store.set_flushed(7, 4)
+
+    held0 = metrics.WAL_PRUNE_HELD_TOTAL.get()
+    store.register_follower(7, "h1", 0)  # follower has replayed nothing yet
+    assert store.prune("topic_0") == 0  # flushed, but the follower needs it
+    assert metrics.WAL_PRUNE_HELD_TOTAL.get() > held0
+
+    store.register_follower(7, "h1", 4)  # caught up: releases the hold
+    assert store.prune("topic_0") >= 1
+
+    # a dead follower's stale registration must not pin the log forever
+    for i in range(5, 9):
+        store.append("topic_0", 7, i, batch)
+    store.set_flushed(7, 8)
+    store.register_follower(7, "h2", 0)
+    store.follower_lw_ttl_s = 0.0  # everything is instantly stale
+    assert store.prune("topic_0") >= 1
+
+    # unregister releases explicitly (promotion / follower close)
+    store.unregister_follower(7, "h1")  # else their marks revive below
+    store.unregister_follower(7, "h2")
+    store.follower_lw_ttl_s = 600.0
+    for i in range(9, 13):
+        store.append("topic_0", 7, i, batch)
+    store.set_flushed(7, 12)
+    store.register_follower(7, "h3", 0)
+    assert store.prune("topic_0") == 0
+    store.unregister_follower(7, "h3")
+    assert store.prune("topic_0") >= 1
+
+
+def test_released_watermark_not_repinned_by_stale_sync(tmp_path):
+    """close_region/promotion releases the follower's shared-WAL replay
+    watermark; a sync round racing the release (registration runs outside
+    the region lock) must undo its own registration instead of leaving an
+    orphan that pins pruning for the whole registration TTL."""
+    import json as _json
+
+    from greptimedb_tpu.storage.engine import TimeSeriesEngine
+    from greptimedb_tpu.utils.config import StorageConfig
+    from tests.test_flight import cpu_schema, make_batch
+
+    home = str(tmp_path / "shared")
+    leader = TimeSeriesEngine(
+        StorageConfig(data_home=home, wal_provider="shared_file")
+    )
+    follower = TimeSeriesEngine(
+        StorageConfig(data_home=home, wal_provider="shared_file")
+    )
+    try:
+        schema = cpu_schema()
+        leader.create_region(5121, schema)
+        leader.write(5121, make_batch(schema, ["a"], [1000], [1.0]))
+        follower.open_region(5121)
+        region = follower.region(5121)
+        region.set_writable(False)
+        region.follower_sync()  # registers the replay watermark
+        store = follower.wal_mgr.store
+        with open(store._followers_path) as f:
+            assert _json.load(f).get("5121")
+        region.release_follower_watermark()  # the close/promotion path
+        region.follower_sync()  # stale round: must not re-pin the log
+        with open(store._followers_path) as f:
+            assert not _json.load(f).get("5121")
+    finally:
+        follower.close()
+        leader.close()
+
+
+def test_register_follower_skips_rewrite_when_position_unchanged(tmp_path):
+    """follower_sync re-registers its replay position every round; an
+    unchanged position with a still-fresh TTL stamp must not rewrite the
+    shared followers.json (constant disk churn on an idle cluster), while
+    a position advance — or a stamp past half the TTL — still persists."""
+    from greptimedb_tpu.storage.remote_wal import SharedLogStore
+
+    store = SharedLogStore(str(tmp_path / "wal"))
+    store.register_follower(9, "h", 3)
+    with open(store._followers_path) as f:
+        before = f.read()
+    store.register_follower(9, "h", 3)  # unchanged + fresh: skipped
+    with open(store._followers_path) as f:
+        assert f.read() == before  # the TTL stamp was not rewritten
+    store.register_follower(9, "h", 5)  # position advanced: persisted
+    with open(store._followers_path) as f:
+        advanced = f.read()
+    assert advanced != before
+    store.follower_lw_ttl_s = 0.0  # stamp now counts as stale
+    store.register_follower(9, "h", 5)  # same position, stale stamp: refresh
+    with open(store._followers_path) as f:
+        assert f.read() != advanced
+
+
+def test_follower_unregister_not_resurrected_by_other_store_instance(tmp_path):
+    """Two store instances over one shared root (leader + follower
+    engines): after instance A unregisters its holder, instance B's next
+    persist (reload-then-write) must NOT resurrect A's deleted watermark
+    from B's stale in-memory copy — disk is authoritative for holders an
+    instance doesn't own."""
+    from greptimedb_tpu.storage.remote_wal import SharedLogStore
+
+    root = str(tmp_path / "wal")
+    a = SharedLogStore(root, segment_bytes=128)
+    b = SharedLogStore(root, segment_bytes=128)
+    batch = _wal_batch()
+    for i in range(1, 5):
+        a.append("topic_0", 7, i, batch)
+    a.set_flushed(7, 4)
+
+    a.register_follower(7, "ha", 0)  # pins the log
+    assert b.prune("topic_0") == 0  # b reloaded ha's mark into memory
+    a.unregister_follower(7, "ha")  # promotion/close: release for real
+    b.register_follower(7, "hb", 4)  # b persists; must not revive ha
+    assert a.prune("topic_0") >= 1  # ha is gone, hb is caught up
+
+
+def test_wal_prune_racing_read_finishes_or_surfaces_retryable(tmp_path):
+    """A prune landing while a reader holds a sealed segment open must let
+    the reader either finish the segment or see a CLEAN retryable error —
+    never a mid-frame decode crash (the wal.prune_during_read point runs
+    the prune at exactly the racy moment)."""
+    from greptimedb_tpu.storage.remote_wal import SharedLogStore
+    from greptimedb_tpu.utils.errors import StorageError
+
+    store = SharedLogStore(str(tmp_path / "wal"), segment_bytes=128)
+    batch = _wal_batch()
+    for i in range(1, 11):
+        store.append("topic_0", 7, i, batch)
+    store.set_flushed(7, 10)
+
+    pruned = []
+    plan = fi.REGISTRY.arm(
+        "wal.prune_during_read", fail_times=1, skip=2,
+        callback=lambda ctx: pruned.append(store.prune_all()),
+    )
+    seen: list[int] = []
+    try:
+        for entry in store.read("topic_0", 7, 0):
+            seen.append(entry.entry_id)
+    except RetryLaterError:
+        pass  # the clean retryable contract — acceptable outcome
+    except StorageError as exc:  # pragma: no cover - the bug this test pins
+        pytest.fail(f"mid-frame decode crash leaked through: {exc}")
+    assert plan.trips == 1 and pruned and pruned[0] >= 1
+    assert seen == sorted(seen)  # whatever was read is ordered, no torn frame
+
+
+def test_pruned_sealed_segment_classified_retryable_not_corrupt(tmp_path):
+    """The sealed-read classifier: a short frame in a sealed segment whose
+    file VANISHED is 'pruned during read' (RetryLaterError); one whose file
+    is still there is real corruption (StorageError)."""
+    import os
+
+    from greptimedb_tpu.storage.remote_wal import SharedLogStore
+    from greptimedb_tpu.utils.errors import StorageError
+
+    missing = str(tmp_path / "gone.seg")
+    err = SharedLogStore._sealed_read_error(missing)
+    assert isinstance(err, RetryLaterError)
+
+    present = str(tmp_path / "there.seg")
+    with open(present, "wb") as f:
+        f.write(b"garbage")
+    err = SharedLogStore._sealed_read_error(present)
+    assert isinstance(err, StorageError)
+    os.remove(present)
+
+
+# ---- exactly-once flow mirroring -------------------------------------------
+
+
+@pytest.mark.chaos
+def test_flow_mirror_exactly_once_across_100_reply_loss_retries(chaos, tmp_path):
+    """Every one of 100 mirrored batches has its FIRST delivery applied but
+    the reply lost (error injected AFTER apply+register at flow.dedupe);
+    the background retry must be deduplicated on (source, batch_id) — zero
+    duplicate applications across all 100."""
+    import threading
+
+    from greptimedb_tpu.database import Database
+    from greptimedb_tpu.distributed.flownode import FlownodeFlightServer
+
+    fdb = Database(data_home=str(tmp_path / "flowdb"))
+    server = FlownodeFlightServer(fdb)
+    t = threading.Thread(target=server.serve, daemon=True)
+    t.start()
+    try:
+        applied = []
+        orig = fdb.flows.mirror_insert
+
+        def spying_mirror(table, database, batch):
+            applied.append(batch.num_rows)
+            return orig(table, database, batch)
+
+        fdb.flows.mirror_insert = spying_mirror
+        chaos.metasrv.handle_heartbeat(
+            97, [], chaos.now[0], role="flownode",
+            addr=server.location.removeprefix("grpc://"),
+        )
+        mirror = chaos.frontend.mirror
+        mirror._addr_cache = (0.0, {})
+        mirror.backoff_s = 0.002  # keep 100 retry backoffs inside tier-1
+
+        plan = fi.REGISTRY.arm(
+            "flow.dedupe", fail_times=1000, error=ConnectionError
+        )
+        dedup0 = metrics.FLOW_DEDUPE_TOTAL.get()
+        batch = pa.table({"v": [1.0]})
+        for _ in range(100):
+            assert mirror.submit("t_once", "public", batch)
+        assert mirror.drain(30.0)
+        # every batch applied EXACTLY once: 100 applications, 100 lost
+        # replies, 100 deduplicated retries, zero duplicates
+        assert plan.trips == 100
+        assert len(applied) == 100 and sum(applied) == 100
+        assert metrics.FLOW_DEDUPE_TOTAL.get() - dedup0 == 100
+        assert "greptime_flow_dedupe_total" in metrics.REGISTRY.render()
+    finally:
+        fi.REGISTRY.disarm("flow.dedupe")
+        server.shutdown()
+        fdb.close()
+
+
+def test_mirror_dedupe_window_semantics():
+    """Bounded high-water-mark window: ids below the floor are ancient
+    retries (duplicates by construction); above it the seen set decides."""
+    from greptimedb_tpu.distributed.flownode import MirrorDedupe
+
+    d = MirrorDedupe(window=4)
+    assert not d.is_duplicate("s", 1)
+    d.register("s", 1)
+    assert d.is_duplicate("s", 1)  # applied-but-reply-lost retry
+    assert not d.is_duplicate("s", 2)  # fresh id
+    for b in (5, 6, 7, 8):
+        d.register("s", b)
+    assert d.is_duplicate("s", 2)  # below the floor (8 - 4): ancient
+    assert not d.is_duplicate("other", 1)  # sources are independent
+
+
+def test_mirror_dedupe_eviction_is_idle_aware():
+    """A source inside the idle horizon may still have an applied-but-
+    reply-lost batch retrying — over-cap eviction must spare it (its
+    window dropping would double-apply the retry), evict it once idle,
+    and still bound memory at the hard cap under pathological churn."""
+    from greptimedb_tpu.distributed.flownode import MirrorDedupe
+
+    clk = [0.0]
+    d = MirrorDedupe(window=4, max_sources=2, idle_evict_s=100.0,
+                     clock=lambda: clk[0])
+    d.register("hot", 1)
+    d.register("a", 1)
+    d.register("b", 1)  # over cap, but every source is recent: all kept
+    assert len(d._sources) == 3
+    assert d.is_duplicate("hot", 1)  # the window survived the over-cap insert
+    clk[0] = 200.0
+    assert d.is_duplicate("hot", 1)  # touch: "hot" stays recent at t=200
+    d.register("c", 1)  # "a"/"b" idle past the horizon: evicted down to cap
+    assert len(d._sources) <= 2
+    assert d.is_duplicate("hot", 1)  # the active source kept its window
+    assert not d.is_duplicate("a", 1)  # the idle one lost its state
+    # hard cap bounds memory even when nothing ever goes idle
+    d2 = MirrorDedupe(window=4, max_sources=1, idle_evict_s=1e9,
+                      clock=lambda: clk[0])
+    for i in range(10):
+        d2.register(f"s{i}", 1)
+    assert len(d2._sources) <= 4
+
+
+# ---- automatic follower placement ------------------------------------------
+
+
+@pytest.mark.chaos
+def test_selector_places_and_restores_target_followers(tmp_path):
+    """replica.target_followers=1: the supervisor tick creates a follower
+    on a distinct live datanode, and after that follower's node dies the
+    next tick round garbage-collects the orphan and re-places on a
+    survivor — within one heartbeat round of the kill."""
+    repl = ChaosCluster(
+        str(tmp_path / "shared_sel"), num_datanodes=3, target_followers=1
+    )
+    try:
+        meta, rid, owner = _setup_table(repl, "tsel")
+        placed0 = metrics.FOLLOWER_PLACEMENTS_TOTAL.get()
+        repl.metasrv.tick(repl.now[0])
+        followers = repl.metasrv.followers_of(meta.table_id, rid)
+        assert len(followers) == 1 and followers[0] != owner
+        assert metrics.FOLLOWER_PLACEMENTS_TOTAL.get() - placed0 == 1
+        recs = repl.metasrv.procedures.list_records()
+        placements = [r for r in recs if r.type_name == "follower_placement"]
+        assert placements and all(r.status == "done" for r in placements)
+
+        # kill the follower's node: GC the orphan, re-place on the survivor
+        dead = followers[0]
+        survivor = next(n for n in repl.datanodes if n not in (owner, dead))
+        repl.datanodes[dead].kill()
+        gc0 = metrics.FOLLOWER_GC_TOTAL.get()
+        repl.fail_over_dead_node()  # suspect -> revive survivors -> tick
+        followers = repl.metasrv.followers_of(meta.table_id, rid)
+        assert followers == [survivor]
+        assert metrics.FOLLOWER_GC_TOTAL.get() - gc0 >= 1
+        # the new follower actually serves: hedge against a slowed leader
+        fe = repl.frontend
+        fe.config.replica.read_followers = True
+        fe.config.query.hedge_delay_ms = 50.0
+        fe.config.query.timeout_s = 5.0
+        fe._follower_cache.clear()
+        fi.REGISTRY.arm(
+            "flight.do_get", fail_times=100, latency_s=3.0,
+            match=lambda ctx: ctx.get("node_id") == owner,
+        )
+        try:
+            out = fe.sql_one("SELECT count(*) AS c FROM tsel")
+        finally:
+            fe.config.query.timeout_s = 0.0
+            fe.config.query.hedge_delay_ms = 0.0
+            fe.config.replica.read_followers = False
+            fi.REGISTRY.disarm("flight.do_get")
+        assert out["c"].to_pylist() == [3]
+    finally:
+        repl.close()
+
+
+@pytest.mark.chaos
+def test_get_followers_filters_nodes_that_no_longer_hold_the_region(chaos):
+    """A follower recorded in the route whose datanode died must not be
+    returned by get_followers — the hedge would burn its single shot on a
+    dead node.  The raw route may still carry the stale id; the READ
+    surface filters it against live membership."""
+    meta, rid, owner = _setup_table(chaos, "tgf")
+    other = next(n for n in chaos.datanodes if n != owner)
+    client = MetaClient([chaos.server.address])
+    client.add_follower(meta.table_id, rid, other)
+    assert chaos.metasrv.get_followers(meta.table_id) == {rid: [other]}
+
+    chaos.datanodes[other].kill()
+    chaos.now[0] += 600_000
+    chaos.metasrv.tick(chaos.now[0])  # suspect everyone
+    chaos.heartbeat_live()  # revive the survivors (the owner)
+    # the stale id is still recorded in the KV route...
+    route = chaos.metasrv.get_route_full(meta.table_id)[rid]
+    assert other in route.followers
+    # ...but every read surface filters it against live membership
+    assert chaos.metasrv.get_followers(meta.table_id) == {}
+    assert chaos.metasrv.followers_of(meta.table_id, rid) == []
+    assert client.get_followers(meta.table_id) == {}
+
+
+# ---- best-effort in-flight call cancellation at deadline expiry ------------
+
+
+@pytest.mark.chaos
+def test_deadline_expiry_cancels_inflight_reader_when_supported(chaos):
+    """Deadline expiry attempts a real cancel() on the hung do_get reader
+    (feature-detected; detach-and-drop stays the fallback).  The hang is
+    injected SERVER-side (store.read latency) so a genuine wire call is in
+    flight when the deadline trips."""
+    from greptimedb_tpu.distributed import flight as flight_mod
+
+    meta, rid, owner = _setup_table(chaos, "tcx")
+    chaos.datanodes[owner].client.flush_region(rid)  # scans must hit the store
+    # latency only has to outlive the 0.4s deadline comfortably; the fixture
+    # teardown waits out whatever residue the hung server thread still sleeps
+    fi.REGISTRY.arm("store.read", fail_times=100, latency_s=1.5)
+    chaos.frontend.config.query.timeout_s = 0.4
+    cancelled0 = metrics.FANOUT_CANCELLED_TOTAL.get()
+    abandoned0 = metrics.FANOUT_ABANDONED_TOTAL.get()
+    try:
+        with pytest.raises(QueryTimeoutError):
+            chaos.frontend.sql_one("SELECT count(*) AS c FROM tcx")
+    finally:
+        chaos.frontend.config.query.timeout_s = 0.0
+        fi.REGISTRY.disarm("store.read")
+    assert metrics.FANOUT_ABANDONED_TOTAL.get() - abandoned0 >= 1
+    if flight_mod._READER_HAS_CANCEL:
+        assert metrics.FANOUT_CANCELLED_TOTAL.get() - cancelled0 >= 1
+    else:  # pragma: no cover - depends on the installed pyarrow
+        assert metrics.FANOUT_CANCELLED_TOTAL.get() == cancelled0
+
+
+def test_cancel_inflight_cancels_readers_and_closes_pre_stream_calls():
+    """Unit: cancel_inflight() issues a feature-detected reader.cancel()
+    for calls whose stream opened, closes the channel for calls still
+    blocked inside do_get, and counts exactly what it cancelled."""
+    from greptimedb_tpu.distributed import flight as flight_mod
+
+    class _FakeReader:
+        def __init__(self):
+            self.cancelled = False
+
+        def cancel(self):
+            self.cancelled = True
+
+    class _FakeChannel:
+        def __init__(self):
+            self.closed = False
+
+        def close(self):
+            self.closed = True
+
+    client = flight_mod.FlightDatanodeClient.__new__(
+        flight_mod.FlightDatanodeClient
+    )
+    import threading
+
+    client._inflight_lock = threading.Lock()
+    reader = _FakeReader()
+    channel = _FakeChannel()
+    client._client = channel
+    client._inflight = [{"reader": reader}, {"reader": None}]
+    if not flight_mod._READER_HAS_CANCEL:  # pragma: no cover
+        pytest.skip("installed pyarrow has no FlightStreamReader.cancel")
+    n0 = metrics.FANOUT_CANCELLED_TOTAL.get()
+    assert client.cancel_inflight() == 2
+    assert reader.cancelled and channel.closed
+    assert metrics.FANOUT_CANCELLED_TOTAL.get() - n0 == 2
+
+    # thread scoping: the client cache is frontend-wide, so a deadline-
+    # expired query must cancel only ITS OWN workers' calls — a concurrent
+    # healthy query's reader on the same client survives, and the channel
+    # is NOT closed while a foreign pre-stream call shares it
+    ours, theirs = _FakeReader(), _FakeReader()
+    channel2 = _FakeChannel()
+    client._client = channel2
+    client._inflight = [
+        {"reader": ours, "thread": 1},
+        {"reader": theirs, "thread": 2},
+        {"reader": None, "thread": 2},  # foreign pre-stream call
+    ]
+    assert client.cancel_inflight({1}) == 1
+    assert ours.cancelled and not theirs.cancelled
+    assert not channel2.closed

@@ -396,6 +396,10 @@ def test_config_defaults_validate_and_are_off_safe():
         (lambda c: setattr(c.breaker, "failure_rate", 1.5), "failure_rate"),
         (lambda c: setattr(c.breaker, "open_cooldown_s", 0.0), "open_cooldown_s"),
         (lambda c: setattr(c.breaker, "half_open_probes", 0), "half_open_probes"),
+        # staleness gating without tailing: every follower would age out of
+        # hedging at its open-time snapshot — reject the combination
+        (lambda c: setattr(c.replica, "max_lag_ms", 1000.0),
+         "requires follower WAL tailing"),
     ],
 )
 def test_config_rejects_bad_robustness_knobs(mutate, match):
