@@ -137,6 +137,16 @@ class Database:
             self.config.memory.max_in_flight_write_bytes,
             self.config.memory.max_concurrent_queries,
             getattr(self.config.memory, "max_scan_bytes", 0),
+            gate_wait_s=getattr(self.config.memory, "gate_wait_s", 5.0),
+        )
+        from .utils.admission import AdmissionController
+
+        # Multi-tenant admission in FRONT of the flat memory gates: which
+        # statement runs next (weighted fairness + EDF), and which should
+        # not wait at all (queue-depth / wait-time / deadline shedding).
+        # Off by default — admission.enable=False is a pure pass-through.
+        self.admission = AdmissionController(
+            self.config.admission, self.config.memory
         )
         from .storage.dictionary import DictionaryRegistry
         from .utils.jax_env import ensure_compilation_cache
@@ -169,6 +179,15 @@ class Database:
         # at decision time so tests and operators can flip them live.
         if self.query_engine.tile_cache is not None:
             self.query_engine.tile_cache.tile_config = self.config.tile
+            # overload-survival knobs (dispatch coalescing, HBM feedback)
+            self.query_engine.tile_cache.admission_config = self.config.admission
+            from .utils import metrics as _metrics
+
+            _metrics.HBM_CHUNK_ROWS.set(self.query_engine.tile_cache.chunk_rows)
+            if self.config.admission.hbm_probe:
+                self.query_engine.tile_cache.probe_hbm(
+                    self.config.admission.hbm_probe_headroom
+                )
         from collections import OrderedDict
 
         from .utils.telemetry_report import TelemetryTask
@@ -311,6 +330,8 @@ class Database:
 
             with deadline_scope(
                 self.config.query.timeout_s
+            ), self.admission.admit(
+                self.current_database
             ), self.memory.query_guard(), self.process_manager.track(
                 self.current_database, query_text or "SELECT ..."
             ), SlowQueryTimer(
@@ -362,7 +383,9 @@ class Database:
         if isinstance(stmt, AdminStmt):
             return self._admin(stmt)
         if isinstance(stmt, TqlStmt):
-            with self.memory.query_guard(), self.process_manager.track(
+            with self.admission.admit(
+                self.current_database
+            ), self.memory.query_guard(), self.process_manager.track(
                 self.current_database, query_text or "TQL ..."
             ), SlowQueryTimer(
                 self.event_recorder, self.config.slow_query,
@@ -827,6 +850,19 @@ class Database:
             raise UnsupportedError(
                 f"external table {meta.name!r} is read-only"
             )
+        if not system:
+            # writes share the admission budget with queries (same device,
+            # same flush/compaction pressure); system writes (event
+            # recorder) bypass it like they bypass the write-bytes budget.
+            # Reentrancy-safe: a flow sink write issued from an admitted
+            # statement's thread passes through instead of self-queueing.
+            with self.admission.admit(meta.database, kind="write"):
+                return self._write_batch_admitted(meta, batch, mirror)
+        return self._write_batch_admitted(meta, batch, mirror, system=True)
+
+    def _write_batch_admitted(
+        self, meta, batch: pa.RecordBatch, mirror: bool, system: bool = False
+    ) -> int:
         if is_logical_meta(meta):
             affected = self.metric.write_logical(meta, batch)
             if mirror and self.flows.infos:

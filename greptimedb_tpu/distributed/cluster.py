@@ -196,7 +196,8 @@ class Cluster:
         else:
             self.datanodes = {i: Datanode(i, data_home) for i in range(num_datanodes)}
         self.metasrv = Metasrv(
-            self.kv, NodeManager(self), target_followers=target_followers
+            self.kv, NodeManager(self), target_followers=target_followers,
+            clock_ms=self.clock,
         )
         for i, dn in self.datanodes.items():
             self.metasrv.register_datanode(i)

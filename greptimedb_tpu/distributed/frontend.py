@@ -108,6 +108,13 @@ class Frontend:
         # is benign — a hedge to an ex-follower fails and the primary wins
         self._follower_cache: dict[int, tuple[float, dict[int, list[int]]]] = {}
         self._follower_ttl_s = 5.0
+        # same multi-tenant admission layer as the standalone Database
+        # (off by default): which statement runs next, which sheds now
+        from ..utils.admission import AdmissionController
+
+        self.admission = AdmissionController(
+            self.config.admission, self.config.memory
+        )
         # mirrored inserts to flownodes are best-effort and asynchronous:
         # a mirror failure retries in the background, never the user write.
         # The mirror gets its OWN MetaClient — its discovery runs on a
@@ -258,6 +265,7 @@ class Frontend:
     def _call_region(
         self, meta, rid: int, fn, routes: dict | None = None,
         inflight: dict | None = None, record_latency: bool = False,
+        write: bool = False,
     ):
         """Run `fn(client, rid)` against region `rid`'s CURRENT route with
         bounded backoff.  Between attempts the cached client is dropped and
@@ -293,10 +301,24 @@ class Frontend:
                 # client AND scopes in-flight cancellation to this worker's
                 # own wire call
                 inflight[rid] = (node, threading.get_ident())
-            return self._guarded_call(
-                node, lambda: fn(self._client(node), rid),
-                record_latency=record_latency,
-            )
+            try:
+                return self._guarded_call(
+                    node, lambda: fn(self._client(node), rid),
+                    record_latency=record_latency,
+                )
+            except CircuitOpenError:
+                # breaker-aware write routing (the PR-2 follow-up): a
+                # WRITE meeting an open breaker asks the metasrv to fail
+                # the region over NOW instead of waiting for lease-lapse
+                # detection.  The metasrv refuses while the node's lease
+                # is live (it may be healthy from everyone else's view) —
+                # then the write sheds like a read.  On acceptance the
+                # failover runs synchronously server-side, so the retry
+                # policy's next attempt (route refresh) lands on the
+                # promoted candidate.
+                if write and self.config.breaker.write_hedge:
+                    self._request_write_failover(meta, rid, node)
+                raise
 
         def on_retry(exc, attempt_no):
             self._drop_client(state["node"])
@@ -311,6 +333,29 @@ class Frontend:
             if wrapped is exc:
                 raise
             raise wrapped from exc
+
+    def _request_write_failover(self, meta, rid: int, node: int):
+        """Best-effort frontend-initiated failover for a write shed by an
+        open breaker (breaker.write_hedge).  Never raises: a refusal
+        (lease live, procedure already running, metasrv churn) simply
+        leaves the CircuitOpenError to the retry loop."""
+        try:
+            pid = self.meta.request_failover(meta.table_id, rid, node)
+        except Exception as exc:  # noqa: BLE001 — hedging is best-effort
+            _LOG.warning(
+                "write-hedge failover request for region %s off node %s "
+                "failed: %s", rid, node, exc,
+            )
+            metrics.WRITE_HEDGE_REFUSED_TOTAL.inc()
+            return
+        if pid:
+            metrics.WRITE_HEDGE_TOTAL.inc()
+            _LOG.info(
+                "write hedged off open-breaker node %s: region %s failed "
+                "over (procedure %s)", node, rid, pid,
+            )
+        else:
+            metrics.WRITE_HEDGE_REFUSED_TOTAL.inc()
 
     def _wrap_exhausted(self, exc: Exception, what: str) -> Exception:
         """A transient error that survived the whole retry budget must
@@ -363,7 +408,9 @@ class Frontend:
             # same per-statement budget as Database._execute: the fan-out
             # (and every retry sleep under it) checks this deadline, so a
             # hung datanode yields QueryTimeoutError, not a stuck query
-            with deadline_scope(self.config.query.timeout_s):
+            with deadline_scope(self.config.query.timeout_s), self.admission.admit(
+                self.current_database
+            ):
                 return self.query_engine.execute_select(stmt, self.current_database)
         if isinstance(stmt, CreateTableStmt):
             return self._create_table(stmt)
@@ -440,7 +487,8 @@ class Frontend:
                 continue
             rid = region_ids[i]
             deleted += self._call_region(
-                meta, rid, lambda c, r, _p=part: c.delete_rows(r, _p), routes=routes
+                meta, rid, lambda c, r, _p=part: c.delete_rows(r, _p),
+                routes=routes, write=True,
             )
         return deleted
 
@@ -602,14 +650,16 @@ class Frontend:
         table = pa.Table.from_batches([batch])
         affected = 0
         region_ids = meta.region_ids
-        for i, part in enumerate(meta.partition_rule.split(table)):
-            if part.num_rows == 0:
-                continue
-            rid = region_ids[i]
-            for b in part.to_batches():
-                affected += self._call_region(
-                    meta, rid, lambda c, r, _b=b: c.write(r, _b), routes=routes
-                )
+        with self.admission.admit(meta.database, kind="write"):
+            for i, part in enumerate(meta.partition_rule.split(table)):
+                if part.num_rows == 0:
+                    continue
+                rid = region_ids[i]
+                for b in part.to_batches():
+                    affected += self._call_region(
+                        meta, rid, lambda c, r, _b=b: c.write(r, _b),
+                        routes=routes, write=True,
+                    )
         if affected:
             # flows are a derived view: mirror AFTER the write is durable,
             # asynchronously, and never let a mirror failure reach the user

@@ -130,6 +130,20 @@ class MetasrvServer:
                 int(body["table_id"]), int(body["region_id"]), int(body["to_node"])
             )
             return {"procedure_id": pid}
+        if path == "/failover/request":
+            # breaker-aware write routing: now_ms is optional — when
+            # absent the metasrv checks the lease against its own
+            # heartbeat-arrival stamps (a wire caller has no way to know
+            # the cluster's heartbeat clock domain, and substituting the
+            # server wall clock here would trivially bypass the fencing
+            # whenever heartbeats ride a logical clock)
+            now = body.get("now_ms")
+            pid = m.request_failover(
+                int(body["table_id"]), int(body["region_id"]),
+                int(body["from_node"]),
+                float(now) if now is not None else None,
+            )
+            return {"procedure_id": pid}
         if path == "/tick":
             return {"submitted": m.tick(float(body["now_ms"]))}
         raise ValueError(f"unknown path {path}")
@@ -253,6 +267,23 @@ class MetaClient:
             "/migrate",
             {"table_id": table_id, "region_id": region_id, "to_node": to_node},
         )["procedure_id"]
+
+    def request_failover(
+        self, table_id: int, region_id: int, from_node: int,
+        now_ms: float | None = None,
+    ) -> str | None:
+        """Ask the metasrv to fail `region_id` over off `from_node` NOW
+        (breaker-aware write routing).  Raises IllegalStateError while the
+        node's lease is still live; returns the procedure id once the
+        failover ran, or None when nothing needed doing (already failed
+        over / a procedure already holds the region)."""
+        body = {
+            "table_id": table_id, "region_id": region_id,
+            "from_node": from_node,
+        }
+        if now_ms is not None:
+            body["now_ms"] = now_ms
+        return self._call("/failover/request", body).get("procedure_id")
 
     def tick(self, now_ms: float) -> list[str]:
         return self._call("/tick", {"now_ms": now_ms})["submitted"]

@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time as _time
 from dataclasses import dataclass, field
 
 from ..models.partition import RegionRoute
@@ -78,6 +79,11 @@ class DatanodeInfo:
     alive: bool = True
     role: str = "datanode"  # datanode | flownode | frontend
     detector: PhiAccrualFailureDetector = field(default_factory=PhiAccrualFailureDetector)
+    # heartbeat arrival stamped on the metasrv's OWN clock: lease-liveness
+    # checks whose caller cannot know the heartbeat clock domain
+    # (request_failover from a frontend) compare against this, never
+    # against the heartbeat payload's now_ms
+    last_heartbeat_local_ms: float | None = None
     mailbox: list[dict] = field(default_factory=list)  # pending Instructions
     last_stats: list = field(default_factory=list)
     # network address of the node's serving endpoint (Flight for
@@ -107,6 +113,18 @@ class RegionFailoverProcedure(Procedure):
         metasrv: "Metasrv" = ctx.services["metasrv"]
         step = self.state.get("step", "select_target")
         if step == "select_target":
+            # Re-verify the route under the region lock: two concurrent
+            # requesters (frontend hedges tripping together, or a hedge
+            # racing the supervisor tick) can both pass the pre-submit
+            # checks, and procedure locks QUEUE rather than reject — the
+            # second procedure would then run with a stale from_node and
+            # promote a SECOND writable leader.  If the region already
+            # moved off from_node, the failover is done; do nothing.
+            current = metasrv.get_route_full(self.state["table_id"]).get(
+                self.state["region_id"]
+            )
+            if current is None or current.leader != self.state["from_node"]:
+                return DONE
             exclude = {self.state["from_node"], *self.state.get("tried", [])}
             # an existing follower replica already has the region open
             # read-only over the shared storage — promoting it is the
@@ -320,7 +338,10 @@ class FollowerPlacementProcedure(Procedure):
 
 
 class Metasrv:
-    def __init__(self, kv: KvBackend, node_manager, election=None, target_followers: int = 0):
+    def __init__(
+        self, kv: KvBackend, node_manager, election=None,
+        target_followers: int = 0, clock_ms=None,
+    ):
         """node_manager: gateway to datanodes (open_region/close_region...);
         the in-process analogue of the reference's NodeManager gRPC clients.
 
@@ -348,6 +369,11 @@ class Metasrv:
         self._lock = threading.RLock()
         self.maintenance_mode = False
         self.selector = "round_robin"  # or "load_based"
+        # the metasrv's own clock (ms), used ONLY for stamps it both
+        # writes and reads (heartbeat arrival -> lease liveness), so the
+        # comparison stays in one domain no matter what clock the
+        # heartbeat payloads carry; injectable for logical-clock tests
+        self.clock_ms = clock_ms or (lambda: _time.time() * 1000.0)
         self.election = election
         if election is not None:
             election.on_leader_start.append(self._on_leader_start)
@@ -574,6 +600,7 @@ class Metasrv:
                     f"give the {role} a distinct node id"
                 )
             info.detector.heartbeat(now_ms)
+            info.last_heartbeat_local_ms = self.clock_ms()
             info.alive = True
             info.last_stats = region_stats
             if addr is not None:
@@ -613,6 +640,75 @@ class Metasrv:
             }
         )
         return self.procedures.submit(proc)
+
+    def request_failover(
+        self, table_id: int, region_id: int, from_node: int,
+        now_ms: float | None = None,
+    ) -> str | None:
+        """Frontend-initiated failover (breaker-aware write routing): a
+        frontend whose circuit breaker opened on `from_node` asks for the
+        region to move NOW instead of waiting for the supervisor tick.
+
+        Refused with IllegalStateError while the node's region lease is
+        still live — the node may be healthy from everyone else's view,
+        and moving a leased region risks a double-writer; the lease-lapse
+        wait is exactly the fencing the datanode's own write gate keys
+        on.  The liveness comparison must stay in ONE clock domain: a
+        caller that shares the heartbeat clock (tests driving a logical
+        clock against the metasrv object) passes now_ms; a caller that
+        cannot know it (the frontend's write hedge, over the wire) omits
+        it and the check runs against the metasrv's own heartbeat-arrival
+        stamps.  Once the lease lapsed this runs the same durable
+        RegionFailoverProcedure the supervisor would, synchronously, so
+        the caller's next route refresh sees the promoted candidate.
+        Returns the procedure id, or None when nothing needed doing
+        (already failed over / a procedure already holds the region)."""
+        if self.maintenance_mode:
+            raise IllegalStateError("metasrv is in maintenance mode")
+        with self._lock:
+            info = self.datanodes.get(from_node)
+            if now_ms is not None:
+                last_hb = info.detector._last_heartbeat_ms if info else None
+            else:
+                now_ms = self.clock_ms()
+                last_hb = info.last_heartbeat_local_ms if info else None
+            if last_hb is None:
+                # No heartbeat on record — a metasrv restart empties the
+                # in-memory map while routes (and the node's real lease)
+                # persist.  Fencing must refuse what it cannot prove
+                # lapsed, not wave it through; the supervisor tick owns
+                # failover for genuinely dead nodes.
+                raise IllegalStateError(
+                    f"datanode {from_node} has no heartbeat on record; "
+                    "cannot prove its region lease lapsed — refusing "
+                    "frontend-initiated failover"
+                )
+            if now_ms < last_hb + LEASE_MS:
+                raise IllegalStateError(
+                    f"datanode {from_node} region lease is live for "
+                    f"another {last_hb + LEASE_MS - now_ms:.0f} ms; "
+                    "refusing frontend-initiated failover"
+                )
+            # lease lapsed: the supervisor would mark it on its next
+            # tick anyway, and a dead node must not receive placement
+            info.alive = False
+        route = self.get_route_full(table_id).get(region_id)
+        if route is None:
+            raise IllegalStateError(f"region {region_id} has no route")
+        if route.leader != from_node:
+            return None  # already failed over: caller refreshes the route
+        if self.procedures.lock_held(f"region/{region_id}"):
+            return None  # a failover/migration is already running
+        proc = RegionFailoverProcedure(
+            state={
+                "region_id": region_id,
+                "table_id": table_id,
+                "from_node": from_node,
+            }
+        )
+        pid = self.procedures.submit(proc)
+        metrics.FAILOVER_REQUESTED_TOTAL.inc()
+        return pid
 
     # ---- supervisor tick (reference RegionSupervisor) ---------------------
     def tick(self, now_ms: float) -> list[str]:

@@ -85,8 +85,9 @@ from ..storage.region import OP_COL, Region
 from ..storage.sst import FileMeta
 from ..query import passes
 from ..utils import metrics
-from ..utils.deadline import check_deadline
+from ..utils.deadline import check_deadline, current_deadline
 from ..utils.errors import QueryTimeoutError
+from ..utils.fault_injection import fire as _fault_fire
 from .executor import (
     COUNT_STAR,
     DistGroupByPlan,
@@ -372,6 +373,10 @@ class TileCacheManager:
         # TileConfig wired by the Database: lifecycle knobs (incremental
         # delta maintenance, pipelined cold builds).  None = defaults on.
         self.tile_config = None
+        # AdmissionConfig wired by the Database: overload-survival knobs
+        # (dispatch coalescing, HBM probe, halve-chunk retry).  None =
+        # everything off, pre-layer behavior bit-for-bit.
+        self.admission_config = None
         self._persist_pool: set[str] = set()  # filesets being written
         self._lock = threading.RLock()
         self._super: OrderedDict[int, _SuperTiles] = OrderedDict()
@@ -529,6 +534,65 @@ class TileCacheManager:
                 self._evict_locked(pinned_regions)
             finally:
                 self.budget = saved
+
+    def probe_hbm(self, headroom: float = 0.9) -> int:
+        """Startup allocation probe (admission.hbm_probe): measure REAL
+        free device memory — a touch allocation forces the runtime to
+        materialize its allocator, then `memory_stats` reports what is
+        actually free — and clamp the tile budget to headroom x measured
+        instead of trusting the configured model-based number.  Backends
+        without memory_stats (CPU, some plugins) report 0 and leave the
+        configured budget in force.  Returns the measured free bytes."""
+        free = 0
+        try:
+            dev = self.devices[0]
+            probe = jax.device_put(np.zeros(1 << 16, np.uint8), dev)
+            probe.block_until_ready()
+            stats = dev.memory_stats() or {}
+            del probe
+            limit = int(stats.get("bytes_limit", 0))
+            in_use = int(stats.get("bytes_in_use", 0))
+            free = max(limit - in_use, 0)
+        except Exception:  # noqa: BLE001 — the probe is best-effort
+            free = 0
+        metrics.HBM_PROBE_FREE_BYTES.set(free)
+        if free > 0:
+            clamped = int(free * headroom)
+            if clamped < self.budget:
+                logging.getLogger("greptimedb_tpu.tile").warning(
+                    "HBM probe: measured free %d MB < configured tile "
+                    "budget %d MB; clamping to %d MB (headroom %.2f)",
+                    free >> 20, self.budget >> 20, clamped >> 20, headroom,
+                )
+                self.budget = clamped
+        return free
+
+    def degrade_chunks(self, floor_rows: int) -> bool:
+        """Closed HBM feedback loop, step 2 (admission.hbm_retry): after a
+        RESOURCE_EXHAUSTED survived the one-shot emergency retry, halve
+        the chunk geometry (never below `floor_rows`) and drop every
+        super-tile entry so the rebuild uploads at the smaller size —
+        each dispatch's working set halves, which is the degradation the
+        runtime asked for.  Per-file host encodes and persisted
+        consolidations survive, so the rebuild is consolidate (or mmap)
+        + upload, not a Parquet re-read.  In-flight queries keep their
+        arrays alive via references.  Returns False once already at the
+        floor (the caller stops halving and lets the error surface)."""
+        with self._lock:
+            # Clamp the floor to the CURRENT geometry: a floor above a
+            # small configured tile_chunk_rows must never GROW the
+            # per-dispatch working set mid-OOM.
+            floor = min(max(int(floor_rows), 4096), self.chunk_rows)
+            new = max(self.chunk_rows // 2, floor)
+            halved = new < self.chunk_rows
+            self.chunk_rows = new
+            for rid in list(self._super):
+                dropped = self._super.pop(rid)
+                self._used -= dropped.nbytes
+                self._host_used -= dropped.host_nbytes
+                self._region_versions.pop(rid, None)
+        metrics.HBM_CHUNK_ROWS.set(self.chunk_rows)
+        return halved
 
     # ---- persisted consolidated encodes ------------------------------------
     def _fileset_dir(self, region_id: int, file_ids: tuple[str, ...]) -> str | None:
@@ -2428,6 +2492,22 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
     )
 
 
+class _InflightFamily:
+    """One in-flight device dispatch N same-family queries share: the
+    leader executes, waiters block on `event` and adopt the finalized
+    result (plus the leader's post_done set, so a waiter's host replay
+    skips exactly the post-ops the device already applied)."""
+
+    __slots__ = ("event", "result", "post_done", "error", "waiters")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result = None
+        self.post_done = frozenset()
+        self.error = None
+        self.waiters = 0
+
+
 class TileExecutor:
     """Aggregation over cached HBM super-tiles; returns None when not
     applicable so the caller can fall back to the authoritative path."""
@@ -2444,14 +2524,163 @@ class TileExecutor:
         # Thread-local, NOT a global-metric delta — concurrent queries
         # would cross-attribute each other's readback time
         self._rb_local = threading.local()
+        # dispatch coalescing (admission.coalesce): family key -> the
+        # in-flight dispatch concurrent same-family queries attach to
+        self._coalesce_lock = threading.Lock()
+        self._inflight: dict = {}
 
     # -- public entry --------------------------------------------------------
     def execute(self, lowering, schema, time_bounds, ctx: TileContext):
         t0 = time.perf_counter()
-        out = self._try_execute(lowering, schema, time_bounds, ctx)
+        adm = self.cache.admission_config
+        if adm is not None and getattr(adm, "coalesce", False):
+            out = self._coalesced_execute(lowering, schema, time_bounds, ctx, adm)
+        else:
+            out = self._overload_safe_execute(lowering, schema, time_bounds, ctx, adm)
         if out is not None:
             metrics.TILE_QUERY_ELAPSED.observe(time.perf_counter() - t0)
         return out
+
+    # -- overload survival ---------------------------------------------------
+    def _overload_safe_execute(self, lowering, schema, time_bounds, ctx, adm):
+        """`_try_execute` under the closed HBM feedback loop
+        (admission.hbm_retry): a RESOURCE_EXHAUSTED that survived the
+        dispatch-site emergency retry triggers emergency release + a
+        halve-chunk rebuild, so forced overcommit degrades to smaller
+        dispatches instead of a failed query.  Off (hbm_retry=False) the
+        error propagates exactly as before this layer existed."""
+        try:
+            return self._try_execute(lowering, schema, time_bounds, ctx)
+        except Exception as exc:  # noqa: BLE001 — only OOM enters the loop
+            if (
+                adm is None
+                or not getattr(adm, "hbm_retry", False)
+                or "RESOURCE_EXHAUSTED" not in str(exc)
+            ):
+                raise
+            last = exc
+        log = logging.getLogger("greptimedb_tpu.tile")
+        for attempt in range(max(int(adm.hbm_retry_attempts), 1)):
+            metrics.HBM_EXHAUSTED_TOTAL.inc()
+            halved = self.cache.degrade_chunks(int(adm.min_chunk_rows))
+            self.cache.emergency_release(set())
+            log.warning(
+                "device OOM survived emergency retry: chunk_rows -> %d "
+                "(attempt %d/%d), rebuilding with smaller dispatches",
+                self.cache.chunk_rows, attempt + 1, adm.hbm_retry_attempts,
+            )
+            try:
+                return self._try_execute(lowering, schema, time_bounds, ctx)
+            except Exception as exc:  # noqa: BLE001 — classified below
+                if "RESOURCE_EXHAUSTED" not in str(exc):
+                    raise
+                last = exc
+                if not halved:
+                    break  # at the floor and still exhausted: surface it
+        raise last
+
+    # -- dispatch coalescing -------------------------------------------------
+    @staticmethod
+    def _post_op_fp(op):
+        """Full-fidelity fingerprint of one post-op plan node.  Plan-node
+        __repr__s are display-oriented and LOSSY — Sort omits its nulls
+        (NULLS FIRST/LAST) field, Having/Project render exprs via name()
+        — so two queries differing only there would falsely coalesce and
+        a waiter would adopt the wrong ordering.  Fingerprint the fields
+        themselves instead (Exprs are frozen dataclasses whose default
+        reprs carry every field); `input` is the child subtree, already
+        covered by the scan/group/agg parts of the family key."""
+        return (
+            type(op).__name__,
+            repr({
+                f.name: getattr(op, f.name)
+                for f in dataclasses.fields(op)
+                if f.name != "input"
+            }),
+        )
+
+    @staticmethod
+    def _family_key(lowering, ctx: TileContext):
+        """Identity of a query family AND its data snapshot: two queries
+        coalesce only when the logical plan fingerprints match and no
+        region took a write/flush/compaction between them (manifest
+        version covers flush/compaction, the WAL tail id covers memtable
+        writes) — a waiter's result must be bit-identical to a solo run.
+        None = not fingerprintable, run solo."""
+        try:
+            versions = tuple(
+                (
+                    r.region_id,
+                    r.manifest_mgr.manifest.manifest_version,
+                    r.wal.last_entry_id,
+                )
+                for r in ctx.regions
+            )
+            plan_fp = repr((
+                lowering.scan, tuple(lowering.group_tags), lowering.bucket,
+                tuple(lowering.agg_specs), lowering.group_exprs,
+                lowering.agg_exprs,
+                tuple(TileExecutor._post_op_fp(op) for op in lowering.post_ops),
+            ))
+        except Exception:  # noqa: BLE001 — fingerprinting is best-effort
+            return None
+        return (ctx.table_key, ctx.append_mode, plan_fp, versions)
+
+    def _coalesced_execute(self, lowering, schema, time_bounds, ctx, adm):
+        """Shared-data-path across concurrent queries: the first arrival
+        of a (family, snapshot) becomes the LEADER and runs the dispatch;
+        later arrivals attach as WAITERS to the same in-flight future and
+        adopt the finalized result instead of serializing a duplicate
+        dispatch behind the table lock (the GPU data-path fusion idea
+        applied across queries instead of across operators)."""
+        key = self._family_key(lowering, ctx)
+        if key is None:
+            return self._overload_safe_execute(lowering, schema, time_bounds, ctx, adm)
+        with self._coalesce_lock:
+            rec = self._inflight.get(key)
+            leader = rec is None
+            if leader:
+                rec = self._inflight[key] = _InflightFamily()
+            else:
+                rec.waiters += 1
+        if leader:
+            # leader: execute, publish, wake the coalition
+            try:
+                out = self._overload_safe_execute(
+                    lowering, schema, time_bounds, ctx, adm
+                )
+                rec.result = out
+                rec.post_done = lowering.post_done
+                return out
+            except BaseException as exc:
+                rec.error = exc
+                raise
+            finally:
+                with self._coalesce_lock:
+                    self._inflight.pop(key, None)
+                    had_waiters = rec.waiters
+                if had_waiters:
+                    metrics.DISPATCH_COALESCE_LEADERS_TOTAL.inc()
+                rec.event.set()
+        # waiter: attach to the leader's in-flight dispatch
+        _fault_fire("dispatch.coalesce", table=ctx.table_key)
+        deadline = current_deadline()
+        while not rec.event.is_set():
+            timeout = None if deadline is None else deadline - time.monotonic()
+            if timeout is not None and timeout <= 0:
+                check_deadline()  # the waiter's own budget owns its fate
+            rec.event.wait(timeout)
+        if rec.error is not None:
+            # the leader's failure may be its own (deadline, injected
+            # fault): run solo under this query's budget instead of
+            # inheriting a verdict that may not apply
+            return self._overload_safe_execute(
+                lowering, schema, time_bounds, ctx, adm
+            )
+        if rec.result is not None:
+            metrics.DISPATCH_COALESCED_TOTAL.inc()
+            lowering.post_done = rec.post_done
+        return rec.result
 
     def _try_execute(self, lowering, schema, time_bounds, ctx: TileContext):
         scan = lowering.scan
@@ -3019,6 +3248,10 @@ class TileExecutor:
                 _tile_program_cached(attempt_plan, nullable_cols, fspec)
             )
             try:
+                # fault point: arm with an error whose text contains
+                # RESOURCE_EXHAUSTED to drive the emergency-release +
+                # halve-chunk feedback loop without a real 16 GB set
+                _fault_fire("hbm.exhausted", table=ctx.table_key)
                 packed = program(tuple(device_sources), dyn)
                 table = self._finalize(
                     packed, int_layout, acc32_layout, acc64_layout, int_dtype,
@@ -3041,6 +3274,7 @@ class TileExecutor:
                     if isinstance(s, _SuperTiles):
                         self.cache.release_unneeded(s, need)
                 self.cache.emergency_release(pinned_ids)
+                _fault_fire("hbm.exhausted", table=ctx.table_key)
                 packed = program(tuple(device_sources), dyn)
                 table = self._finalize(
                     packed, int_layout, acc32_layout, acc64_layout, int_dtype,
@@ -3322,6 +3556,7 @@ class TileExecutor:
             LAST_STREAM_CHUNK_MS.clear()  # per attempt: the f64 rerun
             # (limb verdict failure) re-streams and re-records
             try:
+                _fault_fire("hbm.exhausted", table=ctx.table_key)
                 packed = program(make_sources(), dyn, sync=True)
             except QueryTimeoutError:
                 raise  # the deadline owns the query

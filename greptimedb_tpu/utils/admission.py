@@ -1,0 +1,337 @@
+"""Multi-tenant admission control: weighted queues, deadline-aware
+dispatch, queue-depth and wait-time shedding.
+
+This is the robustness layer that turns overload into a graceful-
+degradation regime instead of a failure mode (ROADMAP open item 3).  The
+flat gates in `utils/memory.py` answer "may one more statement run?";
+this layer answers "WHICH statement runs next, and which should not wait
+at all":
+
+  * every tenant (database) gets its own FIFO-ish queue, drained by a
+    stride scheduler — a weight-4 tenant is granted 4x the slots of a
+    weight-1 tenant under contention, and an idle tenant costs nothing
+    (weighted fair queueing, the classic WFQ/stride formulation);
+  * within a tenant, higher `priority` runs first, then the EARLIEST
+    deadline (EDF — the statement with the least slack is the one a
+    FIFO would time out), then arrival order;
+  * a statement whose deadline cannot absorb the EXPECTED queue wait is
+    shed immediately with `RetryLaterError` (same vocabulary as the
+    circuit breakers in utils/circuit_breaker.py: the client should back
+    off and retry, nothing is broken) — burning queue time on a query
+    that will time out anyway wastes the very resource being protected;
+  * arrivals past `max_queue_depth`, and waiters past
+    `max_queue_wait_ms`, are shed the same way (queue-depth and
+    wait-time shedding).
+
+Expected wait is estimated as (queued ahead + 1) / max_concurrent x an
+EWMA of recent service times — deliberately crude (admission decisions
+must be O(1)); the deadline comparison uses it as a LOWER bound, so the
+estimate being half the true wait only delays the shed to the wait-time
+bound, never breaks correctness.
+
+Everything is off-safe: `admission.enable = False` makes `admit()` a
+zero-cost pass-through, restoring pre-layer behavior bit-for-bit.
+
+Role-equivalents in the reference: `max_concurrent_queries` +
+`request_memory_limiter` are the flat gates this layer subsumes; the
+deadline/priority ordering corresponds to the reference's frontend
+read-preference + per-request timeout plumbing, applied at admission
+time instead of after dispatch.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+
+from . import metrics
+from .deadline import current_deadline
+from .errors import RetryLaterError
+from .fault_injection import fire
+from .memory import SERVICE_EWMA_SEED_S, ewma_update, expected_wait_s
+
+
+class AdmissionShedError(RetryLaterError):
+    """Shed by the admission layer (queue depth, wait bound, or a
+    deadline that cannot absorb the expected queue wait).  Subclasses
+    RetryLaterError on purpose — same retryable client contract as a
+    breaker trip, distinct type so tests and logs can tell them apart."""
+
+
+@dataclass(order=True)
+class _Waiter:
+    # sort key: priority DESC (negated), earliest deadline first (None
+    # sorts last via +inf), then arrival order.  seq is unique, so the
+    # key never ties and the compare=False fields never participate.
+    sort_key: tuple = field(init=False, repr=False)
+    priority: int = field(default=0, compare=False)
+    deadline: float | None = field(default=None, compare=False)
+    seq: int = field(default=0, compare=False)
+    event: threading.Event = field(default_factory=threading.Event, compare=False)
+    admitted: bool = field(default=False, compare=False)
+
+    def __post_init__(self):
+        self.sort_key = (
+            -self.priority,
+            self.deadline if self.deadline is not None else float("inf"),
+            self.seq,
+        )
+
+
+class _TenantQueue:
+    def __init__(self, weight: int):
+        self.weight = max(1, weight)
+        self.stride = 1.0 / self.weight
+        self.vpass = 0.0  # stride-scheduler virtual pass
+        self.waiters: list[_Waiter] = []
+
+
+class AdmissionController:
+    """Per-tenant weighted admission in front of the query/write paths.
+
+    `admit(tenant)` returns a context manager: entering either runs
+    immediately (free slot, no earlier claims), queues until dispatched,
+    or raises `AdmissionShedError`; exiting releases the slot and
+    dispatches the next waiter.  Thread-safe; configured live through
+    the shared AdmissionConfig object (tests and operators flip knobs
+    at runtime, decisions read them at use time)."""
+
+    def __init__(self, config, memory_config=None, clock=time.monotonic):
+        self.config = config
+        self.memory_config = memory_config
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._tenants: dict[str, _TenantQueue] = {}
+        self._running = 0
+        self._seq = itertools.count()
+        # global virtual time: the pass of the most recent grant.  Every
+        # tenant is clamped up to it on touch, so neither a newcomer nor a
+        # tenant returning from idle joins BEHIND the pack (a stale-low
+        # vpass would monopolize dispatch until it caught up — the
+        # classic stride-scheduler rejoin bug)
+        self._vtime = 0.0
+        # EWMA of service times feeding the expected-wait estimate
+        # (shared rule set with MemoryGovernor — utils/memory.py)
+        self._service_s = SERVICE_EWMA_SEED_S
+        # reentrancy guard: a statement that already holds a slot must not
+        # claim (or deadlock on) a second one for nested work on the same
+        # thread — INSERT ... SELECT, flow mirror writes, cursor re-entry
+        self._tls = threading.local()
+
+    # ---- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "running": self._running,
+                "queued": {
+                    t: len(q.waiters)
+                    for t, q in self._tenants.items()
+                    if q.waiters
+                },
+                "est_service_s": self._service_s,
+            }
+
+    def _limit(self) -> int:
+        limit = int(getattr(self.config, "max_concurrent", 0) or 0)
+        if limit <= 0 and self.memory_config is not None:
+            limit = int(getattr(self.memory_config, "max_concurrent_queries", 0) or 0)
+        return limit
+
+    # ---- scheduling core ---------------------------------------------------
+    def _queued_total_locked(self) -> int:
+        return sum(len(q.waiters) for q in self._tenants.values())
+
+    def _expected_wait_s_locked(self, limit: int) -> float:
+        """Lower-bound estimate of how long a NEW arrival waits for a
+        slot: everyone ahead of it (plus itself) drains at `limit`
+        statements per service time."""
+        return expected_wait_s(
+            self._service_s, self._queued_total_locked(), limit
+        )
+
+    def _tenant_locked(self, tenant: str) -> _TenantQueue:
+        q = self._tenants.get(tenant)
+        if q is None:
+            q = self._tenants[tenant] = _TenantQueue(self.config.weight_of(tenant))
+        else:
+            # live weight changes (tests flip config at runtime)
+            w = self.config.weight_of(tenant)
+            if w != q.weight:
+                q.weight = w
+                q.stride = 1.0 / w
+        # join (and rejoin-from-idle) at the global virtual time: a tenant
+        # whose pass fell behind while it was idle must not replay its
+        # missed slots against everyone else (standard stride join)
+        if q.vpass < self._vtime:
+            q.vpass = self._vtime
+        return q
+
+    def _dispatch_locked(self):
+        """Grant freed slots to waiters: pick the non-empty tenant with
+        the smallest virtual pass, pop its best waiter, wake it."""
+        limit = self._limit()
+        while self._running < limit:
+            candidates = [
+                (q.vpass, t) for t, q in self._tenants.items() if q.waiters
+            ]
+            if not candidates:
+                return
+            _, tenant = min(candidates)
+            q = self._tenants[tenant]
+            q.waiters.sort()
+            w = q.waiters.pop(0)
+            self._vtime = max(self._vtime, q.vpass)
+            q.vpass += q.stride
+            metrics.ADMISSION_QUEUE_DEPTH.set(len(q.waiters), tenant=tenant)
+            w.admitted = True
+            self._running += 1
+            metrics.ADMISSION_RUNNING.set(self._running)
+            w.event.set()
+
+    def _shed(self, tenant: str, reason: str, detail: str):
+        metrics.ADMISSION_SHED_TOTAL.inc(reason=reason)
+        raise AdmissionShedError(
+            f"admission shed ({reason}) for tenant {tenant!r}: {detail}"
+        )
+
+    # ---- public gate -------------------------------------------------------
+    def admit(self, tenant: str, priority: int = 0, kind: str = "query"):
+        """Context manager admitting one statement for `tenant`.
+
+        Off (`admission.enable = False`) this is a pure pass-through —
+        no lock, no metrics, no fault point."""
+        import contextlib
+
+        if not getattr(self.config, "enable", False):
+            return contextlib.nullcontext()
+        if getattr(self._tls, "held", 0):
+            return contextlib.nullcontext()
+        return self._admit_cm(tenant, priority, kind)
+
+    def _admit_cm(self, tenant: str, priority: int, kind: str):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            try:
+                fire("admission.shed", tenant=tenant, kind=kind)
+            except BaseException as exc:
+                metrics.ADMISSION_SHED_TOTAL.inc(reason="injected")
+                raise exc
+            t_enter = self.clock()
+            # service time is measured from the GRANT, not from admit
+            # entry: folding queue wait into the EWMA would inflate the
+            # expected-wait estimate under congestion (more waiting ->
+            # bigger estimate -> more deadline sheds, a feedback loop)
+            t_granted = self._acquire(tenant, priority, t_enter)
+            self._tls.held = getattr(self._tls, "held", 0) + 1
+            try:
+                yield
+            finally:
+                self._tls.held -= 1
+                self._release(t_granted)
+
+        return cm()
+
+    def _acquire(self, tenant: str, priority: int, t_enter: float) -> float:
+        """Block (or shed) until a slot is granted; returns the grant
+        timestamp so _release charges only true service time."""
+        deadline = current_deadline()
+        limit = self._limit()
+        waiter: _Waiter | None = None
+        with self._lock:
+            q = self._tenant_locked(tenant)
+            if limit <= 0 or (self._running < limit and not q.waiters
+                              and self._queued_total_locked() == 0):
+                # free slot, nobody queued anywhere: run now (the common
+                # un-contended case costs one lock round-trip)
+                self._running += 1
+                metrics.ADMISSION_RUNNING.set(self._running)
+                metrics.ADMISSION_ADMITTED_TOTAL.inc()
+                metrics.ADMISSION_WAIT_MS.observe(0.0)
+                # charge the tenant's pass so bursts that alternate with
+                # queueing still honor weights
+                self._vtime = max(self._vtime, q.vpass)
+                q.vpass += q.stride
+                return self.clock()
+            # ---- must queue: shed checks first -----------------------------
+            if len(q.waiters) >= int(self.config.max_queue_depth):
+                self._shed(
+                    tenant, "queue_depth",
+                    f"{len(q.waiters)} already queued "
+                    f"(admission.max_queue_depth={self.config.max_queue_depth})",
+                )
+            expected = self._expected_wait_s_locked(limit)
+            if deadline is not None:
+                remaining = deadline - self.clock()
+                if remaining <= expected:
+                    # the deadline cannot absorb the queue: shed NOW so
+                    # the client retries elsewhere instead of timing out
+                    # here (deadline-aware dispatch ordering's dual)
+                    self._shed(
+                        tenant, "deadline",
+                        f"deadline headroom {max(remaining, 0.0) * 1000:.0f} ms "
+                        f"< expected queue wait {expected * 1000:.0f} ms",
+                    )
+            max_wait_ms = float(self.config.max_queue_wait_ms)
+            sort_deadline = deadline
+            if sort_deadline is None and max_wait_ms > 0:
+                # EDF key for a deadline-LESS statement: its wait-time shed
+                # bound — it must run by then or shed anyway.  Sorting it
+                # at +inf instead starved writes behind any continuous
+                # stream of deadlined queries (observed in the mixed
+                # harness: 1 ingest batch in 10 s).
+                sort_deadline = t_enter + max_wait_ms / 1000.0
+            waiter = _Waiter(
+                priority=priority, deadline=sort_deadline, seq=next(self._seq)
+            )
+            q.waiters.append(waiter)
+            metrics.ADMISSION_QUEUE_DEPTH.set(len(q.waiters), tenant=tenant)
+        # ---- wait outside the lock (bounded, deadline-clipped) -------------
+        budget = max_wait_ms / 1000.0 if max_wait_ms > 0 else float("inf")
+        if deadline is not None:
+            budget = min(budget, max(deadline - self.clock(), 0.0))
+        wait_until = self.clock() + budget
+        while not waiter.event.is_set():
+            timeout = wait_until - self.clock()
+            if timeout > 0:
+                if waiter.event.wait(
+                    timeout=None if timeout == float("inf") else timeout
+                ):
+                    break
+                continue  # spurious early return: re-check the budget
+            with self._lock:
+                if waiter.admitted:
+                    break  # dispatched in the race window: keep the slot
+                tq = self._tenants.get(tenant)
+                if tq is not None and waiter in tq.waiters:
+                    tq.waiters.remove(waiter)
+                    metrics.ADMISSION_QUEUE_DEPTH.set(
+                        len(tq.waiters), tenant=tenant
+                    )
+            reason = (
+                "deadline"
+                if deadline is not None and deadline - self.clock() <= 0
+                else "wait_timeout"
+            )
+            self._shed(
+                tenant, reason,
+                f"queued {(self.clock() - t_enter) * 1000:.0f} ms "
+                f"without a slot (limit {self._limit()})",
+            )
+        t_granted = self.clock()
+        metrics.ADMISSION_WAIT_MS.observe((t_granted - t_enter) * 1000.0)
+        metrics.ADMISSION_ADMITTED_TOTAL.inc()
+        return t_granted
+
+    def _release(self, t_granted: float):
+        elapsed = max(self.clock() - t_granted, 0.0)
+        with self._lock:
+            self._running = max(self._running - 1, 0)
+            metrics.ADMISSION_RUNNING.set(self._running)
+            # recent behavior dominates the EWMA so the expected-wait
+            # estimate tracks load shifts inside seconds
+            self._service_s = ewma_update(self._service_s, elapsed)
+            self._dispatch_locked()

@@ -262,6 +262,11 @@ class BreakerConfig:
     failure_rate: float = 0.5  # trip when failures/window >= this
     open_cooldown_s: float = 5.0  # OPEN -> HALF_OPEN after this long
     half_open_probes: int = 1  # probe budget while HALF_OPEN
+    # Breaker-aware write routing: when a WRITE meets an open breaker,
+    # ask the metasrv to fail the region over to a candidate (refused
+    # while the node's lease is still live) and retry against the new
+    # leader instead of failing fast.  Off = writes shed like reads.
+    write_hedge: bool = False
 
 
 @dataclasses.dataclass
@@ -329,6 +334,63 @@ class TileConfig:
 
 
 @dataclasses.dataclass
+class AdmissionConfig:
+    """Multi-tenant admission control in front of the query/write paths
+    (utils/admission.py) and the tile executor's overload machinery
+    (parallel/tile_cache.py).  EVERYTHING here defaults off-safe: with
+    `enable = False` (and coalesce/hbm_* off) the engine behaves
+    bit-for-bit as before this layer existed."""
+
+    # Master switch for the per-tenant weighted admission queues.
+    enable: bool = False
+    # Concurrent statements the scheduler admits at once.  0 falls back
+    # to memory.max_concurrent_queries; if both are 0 admission never
+    # queues (ordering/shedding need a finite concurrency budget).
+    max_concurrent: int = 0
+    # Per-tenant pending-queue cap: an arrival past this depth is shed
+    # immediately with RETRY_LATER (queue-depth shedding).
+    max_queue_depth: int = 64
+    # Longest a query may sit queued before it is shed (wait-time
+    # shedding).  Deadlined queries additionally clip to their own
+    # remaining budget; 0 disables the wait bound (deadline-only).
+    max_queue_wait_ms: float = 2000.0
+    # Weighted fairness: "tenant:weight" pairs (e.g. "gold:4,free:1");
+    # unlisted tenants get default_weight.  Weights drive a stride
+    # scheduler — a weight-4 tenant drains 4x the slots of a weight-1
+    # tenant under contention, and an idle tenant costs nothing.
+    tenant_weights: tuple = ()
+    default_weight: int = 1
+    # Dispatch coalescing: concurrent queries of one family attach to a
+    # single in-flight device dispatch (leader executes, waiters share
+    # the finalized result — the shared-data-path idea applied across
+    # concurrent queries).
+    coalesce: bool = False
+    # Startup allocation probe: measure REAL free device memory
+    # (device.memory_stats + a touch allocation) and clamp the tile
+    # budget to hbm_probe_headroom x measured-free instead of trusting
+    # the configured model-based budget.
+    hbm_probe: bool = False
+    hbm_probe_headroom: float = 0.9
+    # Closed HBM feedback loop: a RESOURCE_EXHAUSTED escaping the tile
+    # path's one-shot emergency retry triggers emergency_release + a
+    # halve-chunk-rows retry (down to min_chunk_rows), so forced
+    # overcommit degrades to smaller dispatches instead of failing.
+    hbm_retry: bool = False
+    hbm_retry_attempts: int = 3
+    min_chunk_rows: int = 1 << 18
+
+    def weight_of(self, tenant: str) -> int:
+        for pair in self.tenant_weights:
+            name, _, w = str(pair).partition(":")
+            if name == tenant:
+                try:
+                    return max(1, int(w))
+                except ValueError:
+                    return max(1, int(self.default_weight))
+        return max(1, int(self.default_weight))
+
+
+@dataclasses.dataclass
 class MemoryConfig:
     """Admission-style memory governance (reference common/memory-manager,
     servers request_memory_limiter `max_in_flight_write_bytes`,
@@ -339,6 +401,11 @@ class MemoryConfig:
     # Bounded-memory scans: windowed scan slices are admitted against this
     # budget (0 = unlimited), so one huge SELECT cannot OOM the process.
     max_scan_bytes: int = 0
+    # Longest an UNdeadlined statement blocks for a concurrency slot
+    # before degrading to RETRY_LATER (deadlined statements clip to their
+    # own remaining budget; fail-fast happens only when the deadline
+    # cannot absorb the expected queue wait).
+    gate_wait_s: float = 5.0
 
 
 @dataclasses.dataclass
@@ -353,6 +420,7 @@ class Config:
     breaker: BreakerConfig = dataclasses.field(default_factory=BreakerConfig)
     replica: ReplicaConfig = dataclasses.field(default_factory=ReplicaConfig)
     tile: TileConfig = dataclasses.field(default_factory=TileConfig)
+    admission: AdmissionConfig = dataclasses.field(default_factory=AdmissionConfig)
 
     def __post_init__(self):
         self.storage.__post_init__()
@@ -468,6 +536,58 @@ class Config:
         if b.half_open_probes < 1:
             raise ConfigError(
                 f"breaker.half_open_probes must be >= 1; got {b.half_open_probes!r}"
+            )
+        a = self.admission
+        if a.max_concurrent < 0:
+            raise ConfigError(
+                "admission.max_concurrent must be >= 0 statements (0 falls "
+                f"back to memory.max_concurrent_queries); got {a.max_concurrent!r}"
+            )
+        if a.max_queue_depth < 1:
+            raise ConfigError(
+                "admission.max_queue_depth must be >= 1 queued statements "
+                f"per tenant; got {a.max_queue_depth!r}"
+            )
+        if a.max_queue_wait_ms < 0:
+            raise ConfigError(
+                "admission.max_queue_wait_ms must be >= 0 milliseconds "
+                f"(0 = deadline-bounded only); got {a.max_queue_wait_ms!r}"
+            )
+        if a.default_weight < 1:
+            raise ConfigError(
+                f"admission.default_weight must be >= 1; got {a.default_weight!r}"
+            )
+        for pair in a.tenant_weights:
+            name, sep, w = str(pair).partition(":")
+            if not sep or not name:
+                raise ConfigError(
+                    "admission.tenant_weights entries must be 'tenant:weight' "
+                    f"pairs; got {pair!r}"
+                )
+            try:
+                if int(w) < 1:
+                    raise ValueError
+            except ValueError:
+                raise ConfigError(
+                    "admission.tenant_weights weight must be an integer >= 1; "
+                    f"got {pair!r}"
+                ) from None
+        if not (0.0 < a.hbm_probe_headroom <= 1.0):
+            raise ConfigError(
+                "admission.hbm_probe_headroom must be in (0, 1] — the "
+                "fraction of measured-free HBM the tile budget may take; "
+                f"got {a.hbm_probe_headroom!r}"
+            )
+        if a.hbm_retry_attempts < 1:
+            raise ConfigError(
+                "admission.hbm_retry_attempts must be >= 1 halve-and-retry "
+                f"rounds; got {a.hbm_retry_attempts!r}"
+            )
+        if a.min_chunk_rows < 4096:
+            raise ConfigError(
+                "admission.min_chunk_rows must be >= 4096 (the kernel block "
+                "size — halving below one block cannot help an OOM); got "
+                f"{a.min_chunk_rows!r}"
             )
 
     @classmethod

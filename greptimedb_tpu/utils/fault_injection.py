@@ -35,6 +35,20 @@ Named points wired into the codebase:
     replica.sync       Region.follower_sync entry (per sync round, before
                        the region lock) — wedge/fail the follower tailing
                        loop on cue
+    admission.shed     AdmissionController.admit entry (utils/admission.py):
+                       arming an error forces the next arrivals to shed
+                       (counted under reason="injected"); a pure hook
+                       observes every admission attempt
+    hbm.exhausted      TileExecutor dispatch choke point, immediately
+                       before each compiled tile program invocation —
+                       arm with an error whose text contains
+                       RESOURCE_EXHAUSTED to simulate device OOM and
+                       drive the emergency-release + halve-chunk retry
+                       loop without a real 16 GB working set
+    dispatch.coalesce  TileExecutor waiter path, fired when a query
+                       attaches to another query's in-flight device
+                       dispatch (ctx: table) — observe/perturb coalition
+                       formation at exactly the attach moment
 
 Production overhead is near zero: `fire()` is a module-level function whose
 fast path is one read of a module global (`_ARMED`) — no locks, no dict
@@ -81,6 +95,9 @@ POINTS = frozenset(
         "flow.dedupe",
         "wal.prune_during_read",
         "replica.sync",
+        "admission.shed",
+        "hbm.exhausted",
+        "dispatch.coalesce",
     }
 )
 

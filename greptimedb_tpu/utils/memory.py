@@ -10,10 +10,35 @@ admission gate.  0 budget = unlimited (the reference's default)."""
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from contextlib import contextmanager
 
 from . import metrics
+from .deadline import current_deadline
 from .errors import RetryLaterError
+
+# ---- shared expected-wait estimator ---------------------------------------
+# One rule set for BOTH admission layers (AdmissionController's tenant
+# queues and MemoryGovernor's concurrency gate) so tuning and the
+# measurement contract can never drift between the two copies:
+#   - service time is an EWMA seeded small (a cold gate never sheds its
+#     first burst) and updated ONLY with time measured from slot GRANT to
+#     release — folding queue wait in would inflate the estimate under
+#     congestion into a shed feedback loop;
+#   - a new arrival's expected wait is a deliberate LOWER bound: everyone
+#     ahead of it (plus itself) drains at `limit` statements per service
+#     time.
+SERVICE_EWMA_SEED_S = 0.05
+SERVICE_EWMA_ALPHA = 0.2
+
+
+def ewma_update(service_s: float, elapsed_s: float) -> float:
+    return service_s + SERVICE_EWMA_ALPHA * (max(elapsed_s, 0.0) - service_s)
+
+
+def expected_wait_s(service_s: float, ahead: int, limit: int) -> float:
+    return service_s * float(ahead + 1) / float(max(limit, 1))
 
 WRITE_REJECTED = metrics.Counter(
     "memory_write_requests_rejected", "writes rejected by the in-flight byte budget"
@@ -66,14 +91,30 @@ class MemoryGovernor:
         max_in_flight_write_bytes: int = 0,
         max_concurrent_queries: int = 0,
         max_scan_bytes: int = 0,
+        gate_wait_s: float = 5.0,
     ):
         self.max_write_bytes = max_in_flight_write_bytes
         self.max_queries = max_concurrent_queries
         self.max_scan_bytes = max_scan_bytes
+        # Longest an UNdeadlined statement blocks for a concurrency slot
+        # before degrading to RETRY_LATER; deadlined statements clip to
+        # their own remaining budget instead.
+        self.gate_wait_s = gate_wait_s
         self._lock = threading.Lock()
+        self._gate = threading.Condition(self._lock)
         self._in_flight_bytes = 0
         self._running_queries = 0
         self._scan_bytes = 0
+        # EWMA of recent query service times: the expected-queue-wait
+        # estimate deciding fail-fast vs block (shared rule set — see
+        # module-level estimator above)
+        self._service_s = SERVICE_EWMA_SEED_S
+        # FIFO of waiter tokens: slots freed by releases hand off to the
+        # HEAD, and fresh arrivals queue behind existing waiters — without
+        # this, sustained arrivals barge past notified waiters every time
+        # a slot turns over and a queued statement starves to its shed
+        # bound despite continuous capacity churn
+        self._gate_queue: deque = deque()
 
     # ---- write admission ---------------------------------------------------
     @contextmanager
@@ -101,21 +142,90 @@ class MemoryGovernor:
     # ---- query admission ---------------------------------------------------
     @contextmanager
     def query_guard(self):
+        """Concurrency gate with a bounded, deadline-clipped wait.
+
+        The round-1 gate rejected the instant the limit was reached —
+        even a statement with 10 s of deadline headroom got RETRY_LATER
+        while a slot would have freed in 50 ms.  Now the gate fails fast
+        ONLY when the statement's deadline cannot absorb the expected
+        queue wait (EWMA service time x waiters ahead); otherwise it
+        blocks until a slot frees, bounded by min(remaining deadline,
+        gate_wait_s), and degrades to RETRY_LATER only when that bound
+        expires with the gate still full."""
         if self.max_queries <= 0:
             yield
             return
-        with self._lock:
-            if self._running_queries >= self.max_queries:
-                QUERY_REJECTED.inc()
-                raise RetryLaterError(
-                    f"too many concurrent queries (limit {self.max_queries}); retry later"
+        t0 = time.monotonic()
+        deadline = current_deadline()
+        with self._gate:
+            # queue behind EXISTING waiters even when capacity is free:
+            # admitting fresh arrivals ahead of the FIFO would starve a
+            # notified waiter every time a slot turns over
+            if self._running_queries >= self.max_queries or self._gate_queue:
+                expected = expected_wait_s(
+                    self._service_s, len(self._gate_queue), self.max_queries
+                )
+                budget = self.gate_wait_s
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= expected:
+                        QUERY_REJECTED.inc()
+                        raise RetryLaterError(
+                            f"too many concurrent queries (limit "
+                            f"{self.max_queries}) and deadline headroom "
+                            f"{max(remaining, 0.0) * 1000:.0f} ms cannot absorb "
+                            f"the expected {expected * 1000:.0f} ms queue wait; "
+                            "retry later"
+                        )
+                    budget = min(budget, remaining)
+                wait_until = time.monotonic() + budget
+                token = object()
+                self._gate_queue.append(token)
+                try:
+                    while (
+                        self._running_queries >= self.max_queries
+                        or self._gate_queue[0] is not token
+                    ):
+                        timeout = wait_until - time.monotonic()
+                        if timeout <= 0:
+                            QUERY_REJECTED.inc()
+                            raise RetryLaterError(
+                                f"too many concurrent queries (limit "
+                                f"{self.max_queries}) after blocking "
+                                f"{(time.monotonic() - t0) * 1000:.0f} ms; "
+                                "retry later"
+                            )
+                        self._gate.wait(timeout=timeout)
+                    self._gate_queue.popleft()  # our token: slot is ours
+                finally:
+                    try:
+                        self._gate_queue.remove(token)  # shed path only
+                    except ValueError:
+                        pass
+                    # a granted or shed HEAD changes who queue[0] is —
+                    # wake everyone so the new head re-evaluates (notify()
+                    # could wake a non-head that just re-sleeps)
+                    self._gate.notify_all()
+                metrics.GOVERNOR_GATE_WAIT_MS.observe(
+                    (time.monotonic() - t0) * 1000.0
                 )
             self._running_queries += 1
+        # service time is measured from the GRANT: folding gate wait into
+        # the EWMA would drag the estimate toward gate_wait_s under
+        # congestion and re-create the instant-reject behavior this gate
+        # exists to eliminate
+        t_granted = time.monotonic()
         try:
             yield
         finally:
-            with self._lock:
+            elapsed = max(time.monotonic() - t_granted, 0.0)
+            with self._gate:
                 self._running_queries -= 1
+                self._service_s = ewma_update(self._service_s, elapsed)
+                # notify_all: notify() could hand the wakeup to a waiter
+                # that is not the FIFO head, which re-sleeps — and the
+                # head never hears about the freed slot
+                self._gate.notify_all()
 
     # ---- scan admission ----------------------------------------------------
     @contextmanager

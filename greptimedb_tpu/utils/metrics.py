@@ -33,6 +33,14 @@ class Counter:
     def get(self, **labels) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
+    def total(self) -> float:
+        """Sum across every label combination.  get() with no labels reads
+        only the unlabeled key — which stays 0 forever on a counter whose
+        inc() sites always attach labels — so aggregate readers (bench
+        records, dashboards) must use this instead."""
+        with self._lock:
+            return sum(self._values.values())
+
     def render(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         for key, v in sorted(self._values.items()):
@@ -348,4 +356,74 @@ WAL_PRUNE_HELD_TOTAL = REGISTRY.counter(
     "greptime_wal_prune_held_total",
     "Shared-WAL segments whose deletion was held back by a follower "
     "replay low-watermark",
+)
+
+# Multi-tenant admission control + overload survival (utils/admission.py,
+# the tile executor's coalescing/HBM feedback in parallel/tile_cache.py).
+ADMISSION_QUEUE_DEPTH = REGISTRY.gauge(
+    "greptime_admission_queue_depth",
+    "Statements currently queued by the admission scheduler, per tenant",
+)
+ADMISSION_RUNNING = REGISTRY.gauge(
+    "greptime_admission_running",
+    "Statements currently admitted and executing under the admission gate",
+)
+ADMISSION_WAIT_MS = REGISTRY.histogram(
+    "greptime_admission_wait_ms",
+    "Milliseconds a statement waited in the admission queue before running",
+)
+ADMISSION_ADMITTED_TOTAL = REGISTRY.counter(
+    "greptime_admission_admitted_total",
+    "Statements admitted by the scheduler (immediately or after queueing)",
+)
+ADMISSION_SHED_TOTAL = REGISTRY.counter(
+    "greptime_admission_shed_total",
+    "Statements shed by the admission layer (labels: reason = "
+    "queue_depth | deadline | wait_timeout | injected)",
+)
+DISPATCH_COALESCED_TOTAL = REGISTRY.counter(
+    "greptime_dispatch_coalesced_total",
+    "Tile queries served by attaching to another query's in-flight "
+    "device dispatch (leader executes once, waiters share the result)",
+)
+DISPATCH_COALESCE_LEADERS_TOTAL = REGISTRY.counter(
+    "greptime_dispatch_coalesce_leader_total",
+    "Tile dispatches that executed as a coalition leader with >= 1 waiter",
+)
+HBM_EXHAUSTED_TOTAL = REGISTRY.counter(
+    "greptime_hbm_exhausted_total",
+    "RESOURCE_EXHAUSTED dispatch failures absorbed by the closed HBM "
+    "feedback loop (emergency release + halve-chunk retry)",
+)
+HBM_CHUNK_ROWS = REGISTRY.gauge(
+    "greptime_hbm_chunk_rows",
+    "Current tile chunk size in rows (halved by the HBM feedback loop "
+    "after RESOURCE_EXHAUSTED; never below admission.min_chunk_rows)",
+)
+HBM_PROBE_FREE_BYTES = REGISTRY.gauge(
+    "greptime_hbm_probe_free_bytes",
+    "Free device memory measured by the startup allocation probe "
+    "(0 = probe unavailable on this backend)",
+)
+GOVERNOR_GATE_WAIT_MS = REGISTRY.histogram(
+    "greptime_memory_gate_wait_ms",
+    "Milliseconds a statement blocked in MemoryGovernor's concurrency "
+    "gate before a slot freed (deadline-clipped bounded wait)",
+)
+WRITE_HEDGE_TOTAL = REGISTRY.counter(
+    "greptime_write_hedge_total",
+    "Writes that met an open breaker and successfully hedged to the "
+    "failover candidate (breaker.write_hedge; metasrv accepted the "
+    "frontend-initiated failover)",
+)
+WRITE_HEDGE_REFUSED_TOTAL = REGISTRY.counter(
+    "greptime_write_hedge_refused_total",
+    "Write-hedge failover requests the metasrv refused (node lease still "
+    "live / procedure already running / metasrv churn): the write sheds "
+    "like a read",
+)
+FAILOVER_REQUESTED_TOTAL = REGISTRY.counter(
+    "greptime_failover_requested_total",
+    "Frontend-initiated failovers the metasrv accepted and ran "
+    "(breaker-aware write routing)",
 )

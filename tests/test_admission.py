@@ -1,0 +1,463 @@
+"""Unit tests for the multi-tenant admission layer (utils/admission.py)
+and the MemoryGovernor's bounded, deadline-clipped concurrency gate
+(utils/memory.py) — scheduler semantics driven deterministically, no
+Database needed: weighted fairness, EDF ordering, the three shed paths,
+reentrancy, and the governor's fail-fast-vs-block boundary."""
+
+import threading
+import time
+
+import pytest
+
+from greptimedb_tpu.utils.admission import AdmissionController, AdmissionShedError
+from greptimedb_tpu.utils.config import AdmissionConfig, Config
+from greptimedb_tpu.utils.deadline import deadline_scope
+from greptimedb_tpu.utils.errors import ConfigError, RetryLaterError
+from greptimedb_tpu.utils.memory import MemoryGovernor
+
+
+def _cfg(**kw) -> AdmissionConfig:
+    cfg = AdmissionConfig(enable=True, max_concurrent=1)
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_disabled_is_pass_through():
+    ctl = AdmissionController(_cfg(enable=False))
+    # no lock, no counters: N nested/parallel admits are all no-ops
+    with ctl.admit("a"), ctl.admit("a"), ctl.admit("b"):
+        assert ctl.stats()["running"] == 0
+
+
+def test_uncontended_admit_runs_immediately():
+    ctl = AdmissionController(_cfg(max_concurrent=2))
+    with ctl.admit("a"):
+        assert ctl.stats()["running"] == 1
+    assert ctl.stats()["running"] == 0
+
+
+def test_reentrant_admit_same_thread_takes_one_slot():
+    """INSERT ... SELECT / flow-mirror writes re-enter on the admitted
+    statement's own thread: the nested admit must pass through instead of
+    queueing on (and deadlocking against) its own slot."""
+    ctl = AdmissionController(_cfg(max_concurrent=1))
+    with ctl.admit("a"):
+        with ctl.admit("a", kind="write"):  # would deadlock pre-guard
+            assert ctl.stats()["running"] == 1
+
+
+def test_queue_depth_shed():
+    ctl = AdmissionController(_cfg(max_concurrent=1, max_queue_depth=1))
+    release = threading.Event()
+    entered = threading.Event()
+
+    def hold():
+        with ctl.admit("a"):
+            entered.set()
+            release.wait(5.0)
+
+    t_hold = threading.Thread(target=hold)
+    t_hold.start()
+    assert entered.wait(2.0)
+    queued = threading.Event()
+
+    def queue_one():
+        with ctl.admit("a"):
+            queued.set()
+
+    t_q = threading.Thread(target=queue_one)
+    t_q.start()
+    deadline = time.monotonic() + 2.0
+    while ctl.stats()["queued"].get("a", 0) < 1:
+        assert time.monotonic() < deadline, "waiter never queued"
+        time.sleep(0.005)
+    # depth 1 reached: the next arrival sheds instantly
+    with pytest.raises(AdmissionShedError, match="queue_depth"):
+        with ctl.admit("a"):
+            pass
+    release.set()
+    t_hold.join(2.0)
+    t_q.join(2.0)
+    assert queued.is_set()
+
+
+def test_deadline_cannot_absorb_expected_wait_sheds_immediately():
+    ctl = AdmissionController(_cfg(max_concurrent=1))
+    ctl._service_s = 5.0  # expected queue wait: 5 s per slot
+    release = threading.Event()
+    entered = threading.Event()
+
+    def hold():
+        with ctl.admit("a"):
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=hold)
+    t.start()
+    assert entered.wait(2.0)
+    t0 = time.monotonic()
+    with deadline_scope(0.2):  # cannot absorb the expected 5 s
+        with pytest.raises(AdmissionShedError, match="deadline"):
+            with ctl.admit("a"):
+                pass
+    assert time.monotonic() - t0 < 0.15, "deadline shed must not wait"
+    release.set()
+    t.join(2.0)
+
+
+def test_wait_timeout_shed_and_is_retry_later():
+    ctl = AdmissionController(_cfg(max_concurrent=1, max_queue_wait_ms=80.0))
+    release = threading.Event()
+    entered = threading.Event()
+
+    def hold():
+        with ctl.admit("a"):
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=hold)
+    t.start()
+    assert entered.wait(2.0)
+    with pytest.raises(RetryLaterError, match="wait_timeout"):
+        with ctl.admit("a"):
+            pass
+    release.set()
+    t.join(2.0)
+
+
+def test_weighted_fairness_under_contention():
+    """A weight-3 tenant drains ~3x the slots of a weight-1 tenant while
+    both queues stay non-empty (stride scheduling)."""
+    cfg = _cfg(
+        max_concurrent=1, tenant_weights=("gold:3", "free:1"),
+        max_queue_wait_ms=0.0, max_queue_depth=100,
+    )
+    ctl = AdmissionController(cfg)
+    order: list[str] = []
+    start = threading.Barrier(13)
+    done = []
+
+    def worker(tenant):
+        start.wait(5.0)
+        with ctl.admit(tenant):
+            order.append(tenant)
+            time.sleep(0.005)
+        done.append(tenant)
+
+    threads = [
+        threading.Thread(target=worker, args=("gold" if i % 2 else "free",))
+        for i in range(12)
+    ]
+    for t in threads:
+        t.start()
+    start.wait(5.0)
+    for t in threads:
+        t.join(10.0)
+    assert len(order) == 12
+    # inspect the CONTENDED middle (first admit may race the barrier):
+    # gold must lead free decisively in the first 8 grants
+    head = order[:8]
+    assert head.count("gold") >= 2 * head.count("free") - 1, order
+
+
+def test_priority_then_edf_within_tenant():
+    """Within one tenant: higher priority first, then earliest deadline."""
+    cfg = _cfg(max_concurrent=1, max_queue_wait_ms=0.0)
+    ctl = AdmissionController(cfg)
+    release = threading.Event()
+    entered = threading.Event()
+
+    def hold():
+        with ctl.admit("t"):
+            entered.set()
+            release.wait(5.0)
+
+    holder = threading.Thread(target=hold)
+    holder.start()
+    assert entered.wait(2.0)
+    order: list[str] = []
+    ready = []
+
+    def queued(name, priority, deadline_s):
+        def run():
+            ready.append(name)
+            with deadline_scope(deadline_s) if deadline_s else _noop():
+                with ctl.admit("t", priority=priority):
+                    order.append(name)
+                    time.sleep(0.002)
+
+        t = threading.Thread(target=run)
+        t.start()
+        return t
+
+    import contextlib
+
+    def _noop():
+        return contextlib.nullcontext()
+
+    threads = [queued("late", 0, 60.0)]
+    _wait_for_queue(ctl, "t", 1)
+    threads.append(queued("early", 0, 5.0))
+    _wait_for_queue(ctl, "t", 2)
+    threads.append(queued("vip", 5, 60.0))
+    _wait_for_queue(ctl, "t", 3)
+    release.set()
+    holder.join(2.0)
+    for t in threads:
+        t.join(5.0)
+    assert order == ["vip", "early", "late"]
+
+
+def _wait_for_queue(ctl, tenant, n, timeout=2.0):
+    deadline = time.monotonic() + timeout
+    while ctl.stats()["queued"].get(tenant, 0) < n:
+        assert time.monotonic() < deadline, (ctl.stats(), n)
+        time.sleep(0.002)
+
+
+def test_deadline_less_statement_not_starved_by_deadlined_stream():
+    """A deadline-less write queued among deadlined queries sorts at its
+    wait-time shed bound, NOT +inf — a continuous stream of deadlined
+    arrivals must not starve it (the mixed-harness regression)."""
+    cfg = _cfg(max_concurrent=1, max_queue_wait_ms=10_000.0)
+    ctl = AdmissionController(cfg)
+    release = threading.Event()
+    entered = threading.Event()
+
+    def hold():
+        with ctl.admit("t"):
+            entered.set()
+            release.wait(5.0)
+
+    holder = threading.Thread(target=hold)
+    holder.start()
+    assert entered.wait(2.0)
+    order = []
+
+    def write():
+        with ctl.admit("t", kind="write"):  # NO deadline
+            order.append("write")
+            time.sleep(0.002)
+
+    def query(i):
+        with deadline_scope(30.0):
+            with ctl.admit("t"):
+                order.append(f"q{i}")
+                time.sleep(0.002)
+
+    tw = threading.Thread(target=write)
+    tw.start()
+    _wait_for_queue(ctl, "t", 1)
+    tq = [threading.Thread(target=query, args=(i,)) for i in range(3)]
+    for t in tq:
+        t.start()
+    _wait_for_queue(ctl, "t", 4)
+    release.set()
+    holder.join(2.0)
+    tw.join(5.0)
+    for t in tq:
+        t.join(5.0)
+    # the write arrived FIRST; with the implicit EDF key (arrival + wait
+    # bound, 10 s < the queries' 30 s deadlines) it runs first
+    assert order[0] == "write", order
+
+
+# ---- MemoryGovernor: bounded, deadline-clipped gate -------------------------
+
+
+def test_governor_blocks_until_slot_frees_instead_of_instant_reject():
+    """The round-1 gate rejected instantly at the limit; now a statement
+    with deadline headroom blocks (bounded) and completes."""
+    gov = MemoryGovernor(max_concurrent_queries=1)
+    release = threading.Event()
+    entered = threading.Event()
+
+    def hold():
+        with gov.query_guard():
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=hold)
+    t.start()
+    assert entered.wait(2.0)
+    threading.Timer(0.15, release.set).start()
+    t0 = time.monotonic()
+    with deadline_scope(10.0):
+        with gov.query_guard():
+            waited = time.monotonic() - t0
+    assert 0.1 <= waited < 5.0, waited
+    t.join(2.0)
+
+
+def test_governor_fails_fast_when_deadline_cannot_absorb_wait():
+    gov = MemoryGovernor(max_concurrent_queries=1)
+    gov._service_s = 5.0
+    release = threading.Event()
+    entered = threading.Event()
+
+    def hold():
+        with gov.query_guard():
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=hold)
+    t.start()
+    assert entered.wait(2.0)
+    t0 = time.monotonic()
+    with deadline_scope(0.2):
+        with pytest.raises(RetryLaterError, match="cannot absorb"):
+            with gov.query_guard():
+                pass
+    assert time.monotonic() - t0 < 0.15
+    release.set()
+    t.join(2.0)
+
+
+def test_governor_bounded_wait_expires_to_retry_later():
+    gov = MemoryGovernor(max_concurrent_queries=1, gate_wait_s=0.1)
+    release = threading.Event()
+    entered = threading.Event()
+
+    def hold():
+        with gov.query_guard():
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=hold)
+    t.start()
+    assert entered.wait(2.0)
+    with pytest.raises(RetryLaterError, match="after blocking"):
+        with gov.query_guard():
+            pass
+    release.set()
+    t.join(2.0)
+
+
+# ---- config validation ------------------------------------------------------
+
+
+def test_admission_config_validation():
+    cfg = Config()
+    cfg.admission.tenant_weights = ("gold:4", "free:1")
+    cfg.validate()
+    assert cfg.admission.weight_of("gold") == 4
+    assert cfg.admission.weight_of("unknown") == 1
+
+    for bad in (
+        {"max_concurrent": -1},
+        {"max_queue_depth": 0},
+        {"max_queue_wait_ms": -1.0},
+        {"default_weight": 0},
+        {"tenant_weights": ("gold",)},
+        {"tenant_weights": ("gold:0",)},
+        {"tenant_weights": ("gold:x",)},
+        {"hbm_probe_headroom": 0.0},
+        {"hbm_probe_headroom": 1.5},
+        {"hbm_retry_attempts": 0},
+        {"min_chunk_rows": 100},
+    ):
+        c = Config()
+        for k, v in bad.items():
+            setattr(c.admission, k, v)
+        with pytest.raises(ConfigError):
+            c.validate()
+
+
+def test_governor_fifo_handoff_no_barging():
+    """Freed slots hand off to the FIFO head: waiters are granted in
+    arrival order, and a fresh arrival must queue behind existing waiters
+    even while capacity is momentarily free — without this, sustained
+    arrivals starve a notified waiter every time a slot turns over."""
+    gov = MemoryGovernor(max_concurrent_queries=1, gate_wait_s=5.0)
+    order = []
+    release = threading.Event()
+    holding = threading.Event()
+
+    def holder():
+        with gov.query_guard():
+            holding.set()
+            release.wait(5.0)
+
+    def waiter(name, started):
+        started.set()
+        with gov.query_guard():
+            order.append(name)
+
+    h = threading.Thread(target=holder)
+    h.start()
+    assert holding.wait(5.0)
+    threads = []
+    for name in ("w1", "w2", "w3"):
+        started = threading.Event()
+        t = threading.Thread(target=waiter, args=(name, started))
+        t.start()
+        assert started.wait(5.0)
+        # wait until this waiter is actually queued before starting the
+        # next, so arrival order is deterministic
+        deadline = time.monotonic() + 5.0
+        while len(gov._gate_queue) < len(threads) + 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        threads.append(t)
+    release.set()
+    h.join(5.0)
+    for t in threads:
+        t.join(5.0)
+    assert order == ["w1", "w2", "w3"], f"grants out of FIFO order: {order}"
+
+    # barging: capacity free but a (stuck) waiter queued -> fresh arrival
+    # must block behind it, and proceed once the queue drains
+    sentinel = object()
+    with gov._gate:
+        gov._gate_queue.append(sentinel)
+    acquired = threading.Event()
+
+    def fresh():
+        with gov.query_guard():
+            acquired.set()
+
+    f = threading.Thread(target=fresh)
+    f.start()
+    assert not acquired.wait(0.2), "fresh arrival barged past a queued waiter"
+    with gov._gate:
+        gov._gate_queue.remove(sentinel)
+        gov._gate.notify_all()
+    assert acquired.wait(5.0)
+    f.join(5.0)
+
+
+def test_family_key_distinguishes_sort_nulls():
+    """Plan-node __repr__s are lossy (Sort omits NULLS FIRST/LAST), so the
+    coalescing fingerprint must read the fields themselves: queries
+    differing only in NULL placement must never share a dispatch."""
+    from greptimedb_tpu.parallel.tile_cache import TileExecutor
+    from greptimedb_tpu.query.expr import Column
+    from greptimedb_tpu.query.logical_plan import Sort
+
+    keys = [(Column("a"), True)]
+    default = TileExecutor._post_op_fp(Sort(input=None, keys=keys, nulls=None))
+    first = TileExecutor._post_op_fp(
+        Sort(input=None, keys=keys, nulls=["first"])
+    )
+    assert default != first
+    # same shape still fingerprints identically (coalescing stays possible)
+    assert default == TileExecutor._post_op_fp(
+        Sort(input=None, keys=keys, nulls=None)
+    )
+
+
+def test_degrade_chunks_floor_never_grows_working_set():
+    """A min_chunk_rows floor ABOVE the configured tile_chunk_rows must
+    clamp to the current geometry, not quadruple the per-dispatch working
+    set mid-OOM (degrade then reports False so the caller stops retrying
+    and surfaces the error instead of amplifying it)."""
+    from greptimedb_tpu.parallel.tile_cache import TileCacheManager
+
+    small = TileCacheManager(budget_bytes=1 << 20, chunk_rows=65536)
+    assert small.degrade_chunks(262144) is False
+    assert small.chunk_rows == 65536
+    # the normal rung still halves down toward the floor
+    big = TileCacheManager(budget_bytes=1 << 20, chunk_rows=1 << 24)
+    assert big.degrade_chunks(4096) is True
+    assert big.chunk_rows == 1 << 23

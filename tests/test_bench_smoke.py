@@ -115,3 +115,58 @@ def test_bench_smoke_prewarm_delta_query(tmp_path):
     assert time.perf_counter() - t_suite < 60, (
         "bench-smoke exceeded its 60 s budget"
     )
+
+
+@pytest.mark.bench_smoke
+def test_bench_smoke_mixed_overload(tmp_path):
+    """`bench.py --mode mixed` smoke: concurrent ingest+query against a
+    tile budget FORCED below the working set, admission + coalescing +
+    HBM feedback all on.  The graceful-degradation contract: rc=0, ZERO
+    failed queries, a parseable record carrying p50/p99, and >= 1
+    coalesced dispatch (concurrent same-family queries shared an
+    in-flight dispatch)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    t_suite = time.perf_counter()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "GRAFT_MIXED_SECONDS": "12",
+        "GRAFT_MIXED_HOSTS": "16",
+        "GRAFT_MIXED_TICKS": "400",
+        "GRAFT_MIXED_QUERY_WORKERS": "6",
+        "GRAFT_MIXED_INGEST_WORKERS": "1",
+        "GRAFT_BENCH_BUDGET_S": "150",
+        "GRAFT_BENCH_PARTIAL": str(tmp_path / "mixed_partial.json"),
+    }
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--mode", "mixed"],
+        capture_output=True, text=True, timeout=170, env=env, cwd=str(tmp_path),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    record = None
+    for line in out.stdout.splitlines():
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if obj.get("metric") == "mixed_load_e2e_p99":
+            record = obj
+    assert record is not None, out.stdout[-2000:]
+    d = record["detail"]
+    assert d["zero_failed_queries"] and d["failed"] == 0, d.get("errors")
+    assert d["queries"] > 0 and d["ingest_batches"] > 0
+    # the record must carry the latency shape (p50 overall + p99 headline)
+    assert record["value"] is not None and d["p50_ms"] is not None
+    for fam, stats in d["families"].items():
+        assert stats["n"] > 0, f"family {fam} never completed a query"
+        assert stats["p99_ms"] is not None
+    # coalesced dispatches observable under concurrent same-family load
+    assert d["coalesced_dispatches"] > 0
+    assert time.perf_counter() - t_suite < 60, (
+        "mixed bench-smoke exceeded its 60 s budget"
+    )

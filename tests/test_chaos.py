@@ -79,7 +79,12 @@ class ChaosCluster:
             for i in range(num_datanodes)
         }
         self.metasrv = Metasrv(
-            self.kv, _FlightNodeManager(self), target_followers=target_followers
+            self.kv, _FlightNodeManager(self), target_followers=target_followers,
+            # the metasrv's own stamps live on the SAME logical clock the
+            # heartbeats ride, so lease fencing is testable without wall
+            # sleeps (and a frontend hedge can't bypass it by omitting
+            # now_ms — the domain-consistent check is the whole point)
+            clock_ms=lambda: self.now[0],
         )
         for i, dn in self.datanodes.items():
             self.metasrv.register_datanode(
@@ -1385,3 +1390,332 @@ def test_cancel_inflight_cancels_readers_and_closes_pre_stream_calls():
     assert client.cancel_inflight({1}) == 1
     assert ours.cancelled and not theirs.cancelled
     assert not channel2.closed
+
+
+# ---- admission control + overload survival (PR 6) ---------------------------
+# A standalone Database drives the tile executor's coalescing and the closed
+# HBM feedback loop (no cluster needed: the overload surface is the device);
+# the ChaosCluster drives breaker-aware write routing.
+
+
+_ADM_QUERY = (
+    "SELECT hostname, time_bucket('1m', ts) AS tb, avg(usage_user) AS a "
+    "FROM cpu GROUP BY hostname, tb"
+)
+_ADM_SORT = [("hostname", "ascending"), ("tb", "ascending")]
+
+
+def _admission_db(tmp_path, **admission_knobs):
+    """Tiny TSBS-shaped Database with the tile path forced on."""
+    import numpy as np
+
+    from greptimedb_tpu.database import Database
+    from greptimedb_tpu.utils.config import Config
+
+    cfg = Config()
+    cfg.storage.compaction_background_enable = False
+    cfg.query.tpu_min_rows = 1  # everything takes the device path
+    for k, v in admission_knobs.items():
+        setattr(cfg.admission, k, v)
+    cfg.validate()
+    db = Database(data_home=str(tmp_path / "adm"), config=cfg)
+    db.sql(
+        "CREATE TABLE cpu (hostname STRING, ts TIMESTAMP(3) TIME INDEX,"
+        " usage_user DOUBLE, PRIMARY KEY (hostname))"
+        " WITH (append_mode = 'true')"
+    )
+    n_hosts, ticks = 8, 400
+    ts = 1_700_000_000_000 + np.arange(ticks, dtype=np.int64)[:, None] * 10_000
+    ts = np.broadcast_to(ts, (ticks, n_hosts)).reshape(-1)
+    hs = np.broadcast_to(
+        np.array([f"h{i}" for i in range(n_hosts)])[None, :], (ticks, n_hosts)
+    ).reshape(-1)
+    rng = np.random.default_rng(5)
+    db.insert_rows("cpu", pa.table({
+        "hostname": pa.array(hs),
+        "ts": pa.array(ts, pa.timestamp("ms")),
+        "usage_user": pa.array(rng.uniform(0, 100, ticks * n_hosts)),
+    }))
+    db.storage.flush_all()
+    return db
+
+
+@pytest.mark.chaos
+def test_coalesced_dispatch_waiters_bit_identical(tmp_path):
+    """N concurrent same-family queries coalesce onto shared in-flight
+    dispatches (leader executes, waiters attach) and every waiter's result
+    is bit-identical to a solo run.  The `dispatch.coalesce` fault point
+    observes each attach."""
+    import threading
+
+    db = _admission_db(tmp_path, coalesce=True)
+    try:
+        solo = db.sql_one(_ADM_QUERY)  # cold serve
+        solo = db.sql_one(_ADM_QUERY)  # device planes warm
+        want = solo.sort_by(_ADM_SORT).to_pydict()
+
+        hook = fi.REGISTRY.arm("dispatch.coalesce", fail_times=0)  # observe
+        c0 = metrics.DISPATCH_COALESCED_TOTAL.get()
+        results = [None] * 8
+        errors = []
+
+        def run(i):
+            try:
+                results[i] = db.sql_one(_ADM_QUERY)
+            except Exception as exc:  # noqa: BLE001 — asserted below
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=run, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        coalesced = metrics.DISPATCH_COALESCED_TOTAL.get() - c0
+        assert coalesced >= 1, "no query attached to an in-flight dispatch"
+        assert hook.hits >= 1  # the attach moment is observable
+        for r in results:
+            assert r.sort_by(_ADM_SORT).to_pydict() == want
+        assert metrics.DISPATCH_COALESCE_LEADERS_TOTAL.get() >= 1
+        assert "greptime_dispatch_coalesced_total" in metrics.REGISTRY.render()
+    finally:
+        fi.REGISTRY.disarm()
+        db.close()
+
+
+@pytest.mark.chaos
+def test_coalesce_off_is_pass_through(tmp_path):
+    """admission.coalesce=False (the default): concurrent same-family
+    queries never attach to each other — pre-PR behavior bit-for-bit."""
+    import threading
+
+    db = _admission_db(tmp_path)  # all knobs at defaults (off)
+    try:
+        db.sql_one(_ADM_QUERY)
+        c0 = metrics.DISPATCH_COALESCED_TOTAL.get()
+        threads = [
+            threading.Thread(target=lambda: db.sql_one(_ADM_QUERY))
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert metrics.DISPATCH_COALESCED_TOTAL.get() == c0
+    finally:
+        db.close()
+
+
+@pytest.mark.chaos
+def test_concurrent_queries_survive_forced_hbm_overcommit(tmp_path):
+    """N concurrent queries against a tile budget forced far below the
+    working set, with RESOURCE_EXHAUSTED injected at the dispatch choke
+    point: the closed feedback loop (emergency release + halve-chunk
+    rebuild, CPU route as the last rung) absorbs everything — ZERO failed
+    queries, bounded wall time, correct results."""
+    import threading
+
+    db = _admission_db(
+        tmp_path, coalesce=False, hbm_retry=True, min_chunk_rows=4096,
+    )
+    try:
+        solo = db.sql_one(_ADM_QUERY)
+        solo = db.sql_one(_ADM_QUERY)
+        want = solo.sort_by(_ADM_SORT).to_pydict()
+        # forced overcommit: budget far below the working set
+        db.query_engine.tile_cache.budget = 1 << 18
+        chunk0 = db.query_engine.tile_cache.chunk_rows
+        ex0 = metrics.HBM_EXHAUSTED_TOTAL.get()
+        plan = fi.REGISTRY.arm(
+            "hbm.exhausted", fail_times=6,
+            error=RuntimeError("RESOURCE_EXHAUSTED: injected overcommit"),
+        )
+        results, errors, walls = [None] * 6, [], [None] * 6
+
+        def run(i):
+            t0 = _time.perf_counter()
+            try:
+                results[i] = db.sql_one(_ADM_QUERY)
+            except Exception as exc:  # noqa: BLE001 — zero-failed contract
+                errors.append(exc)
+            walls[i] = (_time.perf_counter() - t0) * 1000
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, f"queries failed under overcommit: {errors[:3]}"
+        for r in results:
+            assert r.sort_by(_ADM_SORT).to_pydict() == want
+        assert plan.trips >= 1  # the injected OOMs really fired
+        # the feedback loop engaged (halved chunks) unless every retry was
+        # absorbed by the dispatch-site emergency release alone
+        assert (
+            metrics.HBM_EXHAUSTED_TOTAL.get() > ex0
+            or db.query_engine.tile_cache.chunk_rows < chunk0
+        )
+        assert max(walls) < 60_000, f"p99 unbounded: {sorted(walls)}"
+    finally:
+        fi.REGISTRY.disarm()
+        db.close()
+
+
+@pytest.mark.chaos
+def test_shed_vs_queue_boundary_under_deadline_pressure(tmp_path):
+    """The admission boundary: a queued statement whose deadline can
+    absorb the expected wait BLOCKS (bounded) and completes once the slot
+    frees; one whose deadline cannot is shed IMMEDIATELY with
+    RetryLaterError; `admission.shed` injection forces the shed path."""
+    import threading
+
+    from greptimedb_tpu.utils.admission import AdmissionShedError
+
+    db = _admission_db(
+        tmp_path, enable=True, max_concurrent=1, max_queue_wait_ms=10_000.0,
+    )
+    try:
+        db.sql_one(_ADM_QUERY)  # warm
+        release = threading.Event()
+        holding = threading.Event()
+
+        def hold_slot():
+            with db.admission.admit("public"):
+                holding.set()
+                release.wait(timeout=20.0)
+
+        holder = threading.Thread(target=hold_slot)
+        holder.start()
+        assert holding.wait(timeout=5.0)
+
+        # generous deadline -> queues, then completes when the slot frees
+        db.config.query.timeout_s = 10.0
+        releaser = threading.Timer(0.3, release.set)
+        releaser.start()
+        t0 = _time.perf_counter()
+        out = db.sql_one(_ADM_QUERY)
+        waited_ms = (_time.perf_counter() - t0) * 1000
+        assert out.num_rows > 0
+        assert waited_ms >= 200, "should have queued behind the held slot"
+        holder.join(timeout=5.0)
+
+        # slot held again + deadline that cannot absorb the expected wait
+        # -> immediate shed, not a slow timeout
+        release.clear()
+        holding.clear()
+        holder = threading.Thread(target=hold_slot)
+        holder.start()
+        assert holding.wait(timeout=5.0)
+        db.admission._service_s = 2.0  # expected wait >> the 0.2 s deadline
+        db.config.query.timeout_s = 0.2
+        shed0 = metrics.ADMISSION_SHED_TOTAL.get(reason="deadline")
+        t0 = _time.perf_counter()
+        with pytest.raises(RetryLaterError):
+            db.sql_one(_ADM_QUERY)
+        shed_ms = (_time.perf_counter() - t0) * 1000
+        assert shed_ms < 150, "deadline shed must be immediate, not a wait"
+        assert metrics.ADMISSION_SHED_TOTAL.get(reason="deadline") > shed0
+        release.set()
+        holder.join(timeout=5.0)
+
+        # injected shed: the fault point forces the next arrival to shed
+        db.config.query.timeout_s = 0.0
+        plan = fi.REGISTRY.arm(
+            "admission.shed", fail_times=1, error=AdmissionShedError("injected")
+        )
+        with pytest.raises(RetryLaterError):
+            db.sql_one(_ADM_QUERY)
+        assert plan.trips == 1
+        out = db.sql_one(_ADM_QUERY)  # next arrival passes
+        assert out.num_rows > 0
+    finally:
+        fi.REGISTRY.disarm()
+        db.config.query.timeout_s = 0.0
+        db.close()
+
+
+@pytest.mark.chaos
+def test_write_meeting_open_breaker_hedges_to_failover_candidate(chaos):
+    """Breaker-aware write routing (the PR-2 follow-up): a WRITE meeting
+    an open breaker asks the metasrv for an immediate failover (the
+    owner's lease has genuinely lapsed — the clock advanced past LEASE_MS
+    with no heartbeats) and the retried write lands on the promoted
+    candidate — instead of failing fast for the whole cooldown."""
+    from greptimedb_tpu.distributed.metasrv import LEASE_MS
+
+    meta, rid, owner = _setup_table(chaos, "wh1")
+    # the owner goes silent: its region lease lapses on the shared
+    # logical clock, so the metasrv will honor the frontend's hedge
+    chaos.now[0] += LEASE_MS * 2
+    fe = chaos.frontend
+    fe.config.breaker.enable = True
+    fe.config.breaker.write_hedge = True
+    fe.config.breaker.window = 8
+    fe.config.breaker.min_calls = 2
+    fe.config.breaker.failure_rate = 0.5
+    fe.config.breaker.open_cooldown_s = 300.0  # no half-open rescue here
+
+    # flap the owner's DoPut: attempts 1-2 fail and trip the breaker,
+    # attempt 3 meets the OPEN breaker -> hedge -> synchronous failover,
+    # attempt 4 lands on the promoted candidate — the very write that
+    # tripped the breaker survives inside its own retry budget
+    hedged0 = metrics.WRITE_HEDGE_TOTAL.get()
+    fi.REGISTRY.arm(
+        "flight.do_put", fail_times=1000, error=fl.FlightUnavailableError,
+        match=lambda ctx: ctx.get("node_id") == owner,
+    )
+    n = fe.sql_one("INSERT INTO wh1 VALUES ('y', 6000, 6.0)")
+    assert n == 1
+    assert fe._breaker(owner).state == OPEN
+    assert metrics.WRITE_HEDGE_TOTAL.get() - hedged0 == 1
+    _meta, new_routes = chaos.route_of("wh1")
+    assert new_routes[rid] != owner, "region did not fail over"
+    # the row is durable on the promoted candidate, and later writes go
+    # straight there (closed breaker, no wire calls to the flapping node)
+    out = fe.sql_one("SELECT count(*) AS c FROM wh1 WHERE host = 'y'")
+    assert out["c"].to_pylist() == [1]
+    assert fe.sql_one("INSERT INTO wh1 VALUES ('z', 7000, 7.0)") == 1
+
+
+@pytest.mark.chaos
+def test_write_hedge_refused_while_lease_live_and_off_safe(chaos):
+    """The metasrv refuses a frontend-initiated failover while the node's
+    region lease is live (logical clock: heartbeats are fresh), and with
+    breaker.write_hedge=False an open breaker sheds writes exactly as
+    before — no failover request, route unchanged."""
+    from greptimedb_tpu.utils.errors import IllegalStateError
+
+    meta, rid, owner = _setup_table(chaos, "wh2")
+    # lease live on the logical clock -> refusal
+    with pytest.raises(IllegalStateError, match="lease is live"):
+        chaos.metasrv.request_failover(
+            meta.table_id, rid, owner, chaos.now[0] + 1000.0
+        )
+    # the wire path (no now_ms, what a real frontend sends) must hit the
+    # same fencing: the metasrv compares its OWN heartbeat-arrival stamps,
+    # so omitting now_ms cannot bypass the double-writer guard
+    with pytest.raises(IllegalStateError, match="lease is live"):
+        chaos.frontend.meta.request_failover(meta.table_id, rid, owner)
+
+    fe = chaos.frontend
+    fe.config.breaker.enable = True
+    fe.config.breaker.write_hedge = False  # off-safe default
+    fe.config.breaker.window = 8
+    fe.config.breaker.min_calls = 2
+    fe.config.breaker.failure_rate = 0.5
+    fe.config.breaker.open_cooldown_s = 300.0
+    fi.REGISTRY.arm(
+        "flight.do_put", fail_times=1000, error=fl.FlightUnavailableError,
+        match=lambda ctx: ctx.get("node_id") == owner,
+    )
+    with pytest.raises(RetryLaterError):
+        fe.sql_one("INSERT INTO wh2 VALUES ('x', 5000, 5.0)")
+    assert fe._breaker(owner).state == OPEN
+    hedged0 = metrics.WRITE_HEDGE_TOTAL.get()
+    with pytest.raises(RetryLaterError):
+        fe.sql_one("INSERT INTO wh2 VALUES ('y', 6000, 6.0)")
+    assert metrics.WRITE_HEDGE_TOTAL.get() == hedged0
+    _meta, routes = chaos.route_of("wh2")
+    assert routes[rid] == owner, "write_hedge=False must never move a region"

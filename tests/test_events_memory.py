@@ -90,7 +90,9 @@ def test_write_budget_rejects_oversize():
 
 
 def test_query_concurrency_gate():
-    gov = MemoryGovernor(max_concurrent_queries=2)
+    # tight gate_wait_s: the gate now BLOCKS (bounded) for a slot instead
+    # of rejecting instantly; this test exercises the give-up path
+    gov = MemoryGovernor(max_concurrent_queries=2, gate_wait_s=0.05)
     entered = threading.Barrier(3)
     release = threading.Event()
     rejected = []
@@ -162,6 +164,9 @@ def test_db_query_gate_integration(tmp_path):
     th.start()
     started.wait(5)
     d.storage.scan = orig
+    # the gate blocks (bounded) for a slot now; with the slot still held
+    # past the bound it degrades to RETRY_LATER
+    d.memory.gate_wait_s = 0.1
     with pytest.raises(RetryLaterError):
         d.sql("SELECT * FROM t")
     release.set()

@@ -182,3 +182,64 @@ def test_cli_role_subcommands(tmp_path):
     finally:
         stop(dn)
         stop(ms)
+
+
+# ---- frontend-initiated failover fencing (breaker-aware write routing) ----
+
+
+class _RecordingNodeManager(_NullNodeManager):
+    def __init__(self):
+        self.opened = []
+
+    def open_region(self, node_id, region_id):
+        self.opened.append((node_id, region_id))
+
+
+def test_request_failover_refuses_without_heartbeat_evidence():
+    """Fencing must refuse what it cannot prove lapsed: a node with no
+    heartbeat on record (metasrv restart loses the in-memory map while
+    routes and the node's real lease persist) is NOT fair game for a
+    frontend-initiated failover — in either clock domain."""
+    from greptimedb_tpu.distributed.kv import MemoryKvBackend as KV
+
+    m = Metasrv(KV(), _NullNodeManager())
+    m.register_datanode(1)
+    m.register_datanode(2)
+    m.set_route(42, {43008: 1})
+    with pytest.raises(IllegalStateError, match="no heartbeat on record"):
+        m.request_failover(42, 43008, 1)  # wire path (no now_ms)
+    with pytest.raises(IllegalStateError, match="no heartbeat on record"):
+        m.request_failover(42, 43008, 1, 1_000_000.0)  # explicit clock
+
+
+def test_stale_failover_procedure_is_a_noop():
+    """Two requesters can both pass the pre-submit checks (procedure locks
+    QUEUE, not reject): the second procedure runs with a stale from_node
+    after the first already moved the region.  It must re-verify the route
+    and do NOTHING — running anyway would promote a second writable
+    leader."""
+    from greptimedb_tpu.distributed.kv import MemoryKvBackend as KV
+    from greptimedb_tpu.distributed.metasrv import (
+        LEASE_MS,
+        RegionFailoverProcedure,
+    )
+
+    nm = _RecordingNodeManager()
+    m = Metasrv(KV(), nm, clock_ms=lambda: 1_000.0)
+    for n in (1, 2, 3):
+        m.register_datanode(n)
+        m.handle_heartbeat(n, [], 1_000.0)
+    m.set_route(42, {43008: 1})
+    # legit failover once the lease lapsed on the heartbeat clock
+    pid = m.request_failover(42, 43008, 1, 1_000.0 + LEASE_MS * 2)
+    assert pid is not None
+    moved_to = m.get_route(42)[43008]
+    assert moved_to != 1
+    nm.opened.clear()
+    # a stale duplicate (same from_node, route already moved) must no-op
+    stale = RegionFailoverProcedure(
+        state={"region_id": 43008, "table_id": 42, "from_node": 1}
+    )
+    m.procedures.submit(stale)
+    assert nm.opened == [], "stale failover must not open any region"
+    assert m.get_route(42)[43008] == moved_to, "route must not move again"
