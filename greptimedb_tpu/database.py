@@ -35,6 +35,7 @@ from .query.sql_parser import (
     DeleteStmt,
     DescribeStmt,
     DropStmt,
+    ExplainFlowStmt,
     ExplainStmt,
     FetchCursorStmt,
     InsertStmt,
@@ -359,6 +360,8 @@ class Database:
             return self._show(stmt)
         if isinstance(stmt, DescribeStmt):
             return self._describe(stmt)
+        if isinstance(stmt, ExplainFlowStmt):
+            return self._explain_flow(stmt.name)
         if isinstance(stmt, ExplainStmt):
             if isinstance(stmt.inner, SelectStmt):
                 if stmt.analyze:
@@ -937,9 +940,20 @@ class Database:
             meta = self.catalog.table(stmt.target, self.current_database)
             return pa.table({"Table": [meta.name], "Create Table": [_render_create(meta)]})
         if stmt.what == "flows":
-            flows = self.flows.list_flows()
-            names = filter_like([f.name for f in flows], stmt.like)
-            return pa.table({"Flows": names})
+            flows = [
+                f
+                for f in self.flows.list_flows()
+                if stmt.like is None or f.name in filter_like([f.name], stmt.like)
+            ]
+            return pa.table(
+                {
+                    "Flows": [f.name for f in flows],
+                    "Mode": [f.mode for f in flows],
+                    "Source": [", ".join(f.all_sources()) for f in flows],
+                    "Sink": [f.sink_table for f in flows],
+                    "Fallback Reason": [f.fallback_reason or "" for f in flows],
+                }
+            )
         if stmt.what == "views":
             names = sorted(self.catalog.views(self.current_database))
             return pa.table({"Views": filter_like(names, stmt.like)})
@@ -973,6 +987,22 @@ class Database:
     def _describe(self, stmt: DescribeStmt):
         meta = self.catalog.table(stmt.table, self.current_database)
         return render_describe(meta)
+
+    def _explain_flow(self, name: str):
+        """EXPLAIN FLOW <name>: the flow's operator graph — mode, operator
+        chain, and (for batch fallbacks) the inexpressible feature that
+        caused the degradation."""
+        info = self.flows.infos.get(name)
+        if info is None:
+            from .utils.errors import FlowNotFoundError
+
+            raise FlowNotFoundError(f"flow not found: {name}")
+        task = self.flows.flows[name]
+        if hasattr(task, "describe"):
+            lines = task.describe()
+        else:
+            lines = [f"{info.mode} flow sink={info.sink_table}"]
+        return pa.table({"Flow": [name] * len(lines), "Plan": lines})
 
     # ---- ADMIN ------------------------------------------------------------
     def _admin(self, stmt: AdminStmt):

@@ -181,6 +181,27 @@ class FlownodeFlightServer(fl.FlightServerBase):
             out = {"rows": self.flows.flush_flow(body["name"]) or 0}
         elif kind == "list_flows":
             out = {"flows": [i.to_dict() for i in self.flows.list_flows()]}
+        elif kind == "explain_flow":
+            # operator-graph introspection over the wire (EXPLAIN FLOW's
+            # flownode-side twin): mode + operator chain + fallback reason
+            name = body["name"]
+            info = self.flows.infos.get(name)
+            if info is None:
+                from ..utils.errors import FlowNotFoundError
+
+                raise FlowNotFoundError(f"flow not found: {name}")
+            task = self.flows.flows[name]
+            plan = (
+                task.describe()
+                if hasattr(task, "describe")
+                else [f"{info.mode} flow sink={info.sink_table}"]
+            )
+            out = {
+                "name": name,
+                "mode": info.mode,
+                "fallback_reason": info.fallback_reason,
+                "plan": plan,
+            }
         elif kind == "health":
             out = {"ok": True, "flows": len(self.flows.infos)}
         else:

@@ -8,4 +8,11 @@ operator/src/insert.rs:397-406 `FlowMirrorTask`).
 
 from .engine import BatchingFlowTask, FlowInfo, FlowManager, StreamingFlowTask
 
-__all__ = ["FlowManager", "FlowInfo", "StreamingFlowTask", "BatchingFlowTask"]
+__all__ = [
+    "FlowManager",
+    "FlowInfo",
+    "StreamingFlowTask",
+    "BatchingFlowTask",
+    # incremental dataflow (flow/dataflow.py) exports lazily to keep the
+    # legacy import surface cheap: `from greptimedb_tpu.flow import dataflow`
+]

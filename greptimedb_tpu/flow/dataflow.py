@@ -1,0 +1,1049 @@
+"""Incremental dataflow: diff-driven operator graphs for CREATE FLOW.
+
+The reference's flow layer renders map/filter/reduce and joins as
+incremental operators over diff rows (Hydroflow-inspired `repr::DiffRow`,
+src/flow/src/expr + src/flow/src/plan).  This module is that substrate for
+plans the streaming engine's decomposable-aggregate gate cannot take:
+
+* **ProjectFlowTask** — map/filter/project views: every mirrored insert
+  becomes a diff batch (rows + multiplicities) that is filtered, expired
+  and projected straight into the sink table.  No periodic re-runs; the
+  sink's (tags, time index) last-write-wins dedup gives upsert semantics.
+
+* **IncAggFlowTask** — decomposable aggregates PLUS `count(DISTINCT x)`
+  via per-group set states (the bag-semantics trick: a distinct count is
+  decomposable once the state is the value set, not the count).
+
+* **WindowRecomputeTask** — single-table windowed aggregates the fold
+  states cannot express (HAVING, stddev/percentiles/sketches): a diff
+  dirties exactly the time windows its rows touch and those windows are
+  recomputed immediately by re-running the flow SQL with an injected
+  time bound.  The recompute goes through the normal query engine, so the
+  aggregate rebuild dispatches through the device tile path (delta-extended
+  super-tiles, coalesced dispatches) — materialized-view maintenance rides
+  the TPU.
+
+* **JoinFlowTask** — dirty-window inner joins: each side's join keys are
+  indexed against the time windows they appear in; a diff on the
+  time-axis side dirties its own windows, a diff on the other side probes
+  the index to find exactly the windows its keys can affect.  Only those
+  windows re-run.
+
+Plans none of these classes can express fall back to the periodic batch
+engine with the reason recorded (`FlowInfo.fallback_reason`, SHOW FLOWS,
+EXPLAIN FLOW, `greptime_flow_batch_fallback_total{reason}`) — the silent
+`_is_streamable` degradation is gone.  `flow.incremental = false` disables
+the whole subsystem and restores the pre-dataflow ladder bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from dataclasses import dataclass
+
+import numpy as np
+import pyarrow as pa
+
+from ..datatypes.schema import SemanticType
+from ..query.expr import (
+    AggCall,
+    BinaryOp,
+    Column,
+    FuncCall,
+    Literal,
+    PlannedSubquery,
+    Star,
+    Subquery,
+    WindowCall,
+    find_agg_calls,
+    split_conjuncts,
+)
+from ..query.sql_parser import (
+    AGG_FUNCS,
+    JoinItem,
+    SelectStmt,
+    TableRef,
+    parse_sql,
+)
+from ..utils import fault_injection, metrics
+from .engine import (
+    StreamingFlowTask,
+    _AggState,
+    _coalesce_windows,
+    _ensure_sink_table,
+    _ms_to_native,
+    _resolved_group_exprs,
+    _sink_batch,
+    _streamable_agg,
+    _strip_alias,
+    _time_window_ms,
+)
+
+# Sentinel for NaN in distinct sets: NaN != NaN, so raw floats would count
+# every NaN as a fresh distinct value where Arrow's count_distinct counts
+# one.
+_NAN = ("__nan__",)
+
+
+@dataclass
+class DiffBatch:
+    """Rows plus per-row multiplicities (bag semantics).  Inserts arrive
+    with multiplicity +1; operators compose over the pair so a future
+    delete/retract path slots in without reshaping the graph."""
+
+    rows: pa.Table
+    mults: np.ndarray
+
+    @classmethod
+    def inserts(cls, rows: pa.Table) -> "DiffBatch":
+        return cls(rows, np.ones(rows.num_rows, dtype=np.int64))
+
+    def filter(self, mask) -> "DiffBatch":
+        import pyarrow.compute as pc
+
+        if isinstance(mask, pa.Scalar):
+            if mask.as_py():
+                return self
+            return DiffBatch(self.rows.slice(0, 0), self.mults[:0])
+        mask = pc.fill_null(mask, False)  # NULL predicates drop the row
+        if isinstance(mask, pa.ChunkedArray):
+            mask = mask.combine_chunks()
+        keep = mask.to_numpy(zero_copy_only=False).astype(bool)
+        return DiffBatch(self.rows.filter(mask), self.mults[keep])
+
+    @property
+    def num_rows(self) -> int:
+        return self.rows.num_rows
+
+
+def _count_diff(diff: DiffBatch):
+    metrics.FLOW_DIFF_BATCHES_TOTAL.inc()
+    metrics.FLOW_DIFF_ROWS_TOTAL.inc(float(int(diff.mults.sum())))
+
+
+# ---- plan classification ----------------------------------------------------
+
+
+def _all_exprs(stmt: SelectStmt):
+    for p in stmt.projections:
+        if not isinstance(p, Star):
+            yield p
+    if stmt.where is not None:
+        yield stmt.where
+    if stmt.having is not None:
+        yield stmt.having
+    for g in stmt.group_by:
+        yield g
+
+
+def _incagg_agg_ok(a: AggCall) -> bool:
+    return _streamable_agg(a) or (
+        a.func == "count" and a.distinct and a.range_ms is None
+    )
+
+
+def _agg_shape_ok(stmt: SelectStmt, agg_ok) -> bool:
+    """The streamable SELECT shape with a pluggable per-aggregate gate:
+    group keys projected, every column reference inside an aggregate,
+    aggregates passing `agg_ok` (mirrors engine._is_streamable, which is
+    this shape with the decomposable-aggregate gate)."""
+    resolved = _resolved_group_exprs(stmt)
+    group_names = {name for _e, name in resolved}
+    group_inners = [e for e, _n in resolved]
+    has_agg = False
+    non_agg_inners = set()
+    for p in stmt.projections:
+        inner = _strip_alias(p)
+        aggs = find_agg_calls(inner)
+        if aggs:
+            if not all(agg_ok(a) for a in aggs):
+                return False
+            inside: set[int] = set()
+            for a in aggs:
+                for x in a.walk():
+                    inside.add(id(x))
+            for x in inner.walk():
+                if isinstance(x, Column) and id(x) not in inside:
+                    return False
+            has_agg = True
+        elif inner not in group_inners and inner.name() not in group_names:
+            return False
+        else:
+            non_agg_inners.add(inner)
+    for e, name in resolved:
+        if e not in non_agg_inners and name not in {
+            i.name() for i in non_agg_inners
+        }:
+            return False
+    return has_agg
+
+
+def _split_qual(name: str) -> tuple[str | None, str]:
+    if "." in name:
+        q, base = name.rsplit(".", 1)
+        return q, base
+    return None, name
+
+
+def _side_names(ref: TableRef) -> set[str]:
+    return {ref.table} | ({ref.alias} if ref.alias else set())
+
+
+def classify(stmt: SelectStmt, schema_of, database: str):
+    """Decide which dataflow class (if any) can maintain this flow plan.
+
+    Returns ("project" | "incagg" | "window" | "join", None) or
+    (None, reason) where `reason` is the first graph-inexpressible feature
+    found — it becomes the batch-fallback label.
+    """
+    if stmt.unions:
+        return None, "union"
+    if stmt.ctes:
+        return None, "cte"
+    if stmt.distinct:
+        return None, "select_distinct"
+    if stmt.align is not None:
+        return None, "align"
+    if stmt.order_by or stmt.limit is not None:
+        return None, "order_limit"
+    if any(isinstance(p, Star) for p in stmt.projections):
+        return None, "star_projection"
+    for e in _all_exprs(stmt):
+        for x in e.walk():
+            if isinstance(x, (Subquery, PlannedSubquery)):
+                return None, "subquery"
+            if isinstance(x, WindowCall):
+                return None, "window_function"
+
+    fi = stmt.from_item
+    if isinstance(fi, JoinItem):
+        return _classify_join(stmt, fi, schema_of, database)
+    if stmt.table is None:
+        return None, "no_source_table"
+
+    schema = schema_of(stmt.table, stmt.database or database)
+    aggs = [a for e in _all_exprs(stmt) for a in find_agg_calls(e)]
+    if not aggs:
+        if stmt.group_by:
+            return None, "group_without_agg"
+        if stmt.having is not None:
+            return None, "having_without_agg"
+        if schema.time_index is None:
+            return None, "no_time_index"
+        if _projected_column_out(stmt, schema.time_index.name) is None:
+            return None, "time_index_not_projected"
+        # Every source TAG must be projected: the sink is keyed by
+        # (projected tags, time index), so dropping one would collapse
+        # rows distinct only in that tag via last-write-wins — silently
+        # wrong 1:1 correspondence.  Such plans take the labeled batch
+        # fallback instead.
+        for col in schema.tag_columns():
+            if _projected_column_out(stmt, col.name) is None:
+                return None, "tags_not_projected"
+        return "project", None
+
+    if any(a.range_ms is not None for a in aggs):
+        return None, "range_aggregate"
+    if stmt.having is None and _agg_shape_ok(stmt, _incagg_agg_ok):
+        return "incagg", None
+    # Window recompute: the engine re-runs the SQL per dirty window, so any
+    # aggregate it can execute qualifies — but the sink must be keyed by a
+    # projected time window or per-window upserts would collide.
+    if any(a.func not in AGG_FUNCS for a in aggs):
+        return None, "unsupported_agg"
+    if not _agg_shape_ok(stmt, lambda a: a.func in AGG_FUNCS and a.range_ms is None):
+        return None, "raw_column_outside_group"
+    if schema.time_index is None:
+        return None, "no_time_index"
+    names = (
+        _side_names(stmt.from_item)
+        if isinstance(stmt.from_item, TableRef)
+        else {stmt.table}
+    )
+    if _window_key(stmt, names, schema.time_index.name) is None:
+        return None, "no_time_window"
+    return "window", None
+
+
+def _classify_join(stmt: SelectStmt, fi: JoinItem, schema_of, database: str):
+    if fi.how != "inner":
+        return None, "outer_join"
+    if not (isinstance(fi.left, TableRef) and isinstance(fi.right, TableRef)):
+        return None, "join_shape"
+    # Both sides must live in the flow's database: insert mirroring is
+    # keyed by (table, flow database), so a cross-db side would never
+    # receive diffs — its probe path would be silently dead.
+    for ref in (fi.left, fi.right):
+        if ref.database is not None and ref.database != database:
+            return None, "cross_db_join"
+    try:
+        lschema = schema_of(fi.left.table, fi.left.database or database)
+        rschema = schema_of(fi.right.table, fi.right.database or database)
+    except Exception:  # noqa: BLE001 — missing table: create_flow reports it
+        return None, "plan_error"
+    pairs = _equi_pairs(fi, lschema, rschema)
+    if not pairs:
+        return None, "join_condition"
+    aggs = [a for e in _all_exprs(stmt) for a in find_agg_calls(e)]
+    if aggs:
+        if any(a.func not in AGG_FUNCS or a.range_ms is not None for a in aggs):
+            return None, "unsupported_agg"
+        if not _agg_shape_ok(stmt, lambda a: a.func in AGG_FUNCS):
+            return None, "raw_column_outside_group"
+    if _join_axis(stmt, fi, lschema, rschema) is None:
+        return None, "time_index_not_projected"
+    return "join", None
+
+
+def _projected_column_out(stmt: SelectStmt, col: str, quals: set[str] | None = None) -> str | None:
+    """Output name of a projection that is a bare reference to `col`
+    (optionally qualified by one of `quals`), or None."""
+    for p in stmt.projections:
+        inner = _strip_alias(p)
+        if isinstance(inner, Column):
+            q, base = _split_qual(inner.column)
+            if base == col and (q is None or quals is None or q in quals):
+                return p.name()
+    return None
+
+
+def _window_key(stmt: SelectStmt, axis_names: set[str], ts_name: str):
+    """Find the sink's time-window key over the axis timestamp: a grouped
+    + projected date_bin/time_bucket over it (window = bucket width), or
+    the grouped + projected raw timestamp (window = flow.window_ms).
+    Returns (out_name, window_ms_or_None) or None."""
+    proj_by_expr = {
+        _strip_alias(p): p.name()
+        for p in stmt.projections
+        if not find_agg_calls(_strip_alias(p))
+    }
+    for e, name in _resolved_group_exprs(stmt):
+        out = proj_by_expr.get(e, name)
+        if isinstance(e, FuncCall) and e.func in ("date_bin", "time_bucket"):
+            for a in e.args:
+                if isinstance(a, Column):
+                    q, base = _split_qual(a.column)
+                    if base == ts_name and (q is None or q in axis_names):
+                        return out, _time_window_ms(stmt)
+        if isinstance(e, Column):
+            q, base = _split_qual(e.column)
+            if base == ts_name and (q is None or q in axis_names):
+                return out, None
+    if not stmt.group_by:
+        out = _projected_column_out(stmt, ts_name, axis_names)
+        if out is not None:
+            return out, None
+    return None
+
+
+def _equi_pairs(fi: JoinItem, lschema, rschema) -> list[tuple[str, str]]:
+    """(left column, right column) equality pairs from USING / ON."""
+    lnames, rnames = _side_names(fi.left), _side_names(fi.right)
+    if fi.using:
+        return [
+            (u, u)
+            for u in fi.using
+            if lschema.has_column(u) and rschema.has_column(u)
+        ]
+    pairs: list[tuple[str, str]] = []
+
+    def side_of(col: Column) -> tuple[str, str] | None:
+        q, base = _split_qual(col.column)
+        if q is not None:
+            if q in lnames and lschema.has_column(base):
+                return "l", base
+            if q in rnames and rschema.has_column(base):
+                return "r", base
+            return None
+        in_l, in_r = lschema.has_column(base), rschema.has_column(base)
+        if in_l and not in_r:
+            return "l", base
+        if in_r and not in_l:
+            return "r", base
+        return None
+
+    for conj in split_conjuncts(fi.on):
+        if not (
+            isinstance(conj, BinaryOp)
+            and conj.op == "="
+            and isinstance(conj.left, Column)
+            and isinstance(conj.right, Column)
+        ):
+            continue  # residual predicate: the engine's join applies it
+        a, b = side_of(conj.left), side_of(conj.right)
+        if a is None or b is None or a[0] == b[0]:
+            continue
+        l, r = (a[1], b[1]) if a[0] == "l" else (b[1], a[1])
+        pairs.append((l, r))
+    return pairs
+
+
+def _join_axis(stmt: SelectStmt, fi: JoinItem, lschema, rschema):
+    """Pick the join's time axis: the side whose time index drives the
+    sink's window key.  Returns (side, ref, schema, window_out, window_ms)
+    with side in {"l", "r"}, or None."""
+    for side, ref, schema in (("l", fi.left, lschema), ("r", fi.right, rschema)):
+        ti = schema.time_index
+        if ti is None:
+            continue
+        found = _window_key(stmt, _side_names(ref), ti.name)
+        if found is not None:
+            return side, ref, schema, found[0], found[1]
+    return None
+
+
+# ---- shared sink upsert -----------------------------------------------------
+
+
+def _upsert_result(
+    db, info, key_names: list[str], time_key: str | None,
+    result: pa.Table, now_ms: int,
+):
+    if result.num_rows == 0:
+        return
+    cols = {
+        name: result.column(i).to_pylist()
+        for i, name in enumerate(result.column_names)
+    }
+    if time_key is None:
+        for name, col_type in zip(result.column_names, result.schema.types):
+            if pa.types.is_timestamp(col_type):
+                time_key = name
+                break
+    sink_schema = _ensure_sink_table(
+        db,
+        info,
+        key_names=key_names,
+        agg_names=[n for n in result.column_names if n not in key_names],
+        sample_cols=cols,
+        time_key=time_key,
+        arrow_schema=result.schema,
+        derive_types=True,
+    )
+    batch = _sink_batch(sink_schema, cols, result.num_rows, now_ms)
+    meta = db.catalog.table(info.sink_table, info.database)
+    db.write_batch(meta, batch, mirror=False)
+
+
+# ---- map/filter/project flows ----------------------------------------------
+
+
+class ProjectFlowTask:
+    """Append-mode dataflow for SELECTs with no aggregates: diff batches
+    run filter -> expiry -> project and land in the sink directly.  The
+    sink mirrors the source's key structure restricted to the projected
+    columns, so last-write-wins dedup preserves 1:1 row correspondence."""
+
+    mode = "dataflow"
+    wants_source = False
+
+    def __init__(self, info, db):
+        self.info = info
+        self.db = db
+        self.stmt: SelectStmt = parse_sql(info.sql)[0]
+        schema = db.catalog.table(info.source_table, info.database).schema
+        self.ts_name = schema.time_index.name
+        self.time_out = _projected_column_out(self.stmt, self.ts_name)
+        self.outputs = [(p.name(), _strip_alias(p)) for p in self.stmt.projections]
+        self.key_names = [
+            p.name()
+            for p in self.stmt.projections
+            if isinstance(_strip_alias(p), Column)
+            and schema.has_column(_strip_alias(p).column)
+            and schema.column(_strip_alias(p).column).semantic_type
+            == SemanticType.TAG
+        ]
+        self._ts_unit = (
+            schema.time_index.to_arrow().type.unit
+            if pa.types.is_timestamp(schema.time_index.to_arrow().type)
+            else "ms"
+        )
+
+    def on_insert(self, table: pa.Table, now_ms: int):
+        from ..query.cpu_exec import eval_expr
+
+        fault_injection.fire(
+            "flow.diff_apply", flow=self.info.name, rows=table.num_rows
+        )
+        diff = DiffBatch.inserts(table)
+        _count_diff(diff)
+        if self.stmt.where is not None:
+            diff = diff.filter(eval_expr(self.stmt.where, diff.rows))
+        diff = self._expire(diff, now_ms)
+        if diff.num_rows == 0:
+            return
+        cols: dict[str, list] = {}
+        arrays: dict[str, pa.Array] = {}
+        for name, expr in self.outputs:
+            out = eval_expr(expr, diff.rows)
+            if isinstance(out, pa.Scalar):
+                out = pa.array([out.as_py()] * diff.num_rows, out.type)
+            if isinstance(out, pa.ChunkedArray):
+                out = out.combine_chunks()
+            arrays[name] = out
+            cols[name] = out.to_pylist()
+        sink_schema = _ensure_sink_table(
+            self.db,
+            self.info,
+            key_names=self.key_names,
+            agg_names=[n for n in cols if n not in self.key_names],
+            sample_cols=cols,
+            time_key=self.time_out,
+            arrow_schema=pa.schema(
+                [pa.field(n, a.type) for n, a in arrays.items()]
+            ),
+            derive_types=True,
+        )
+        batch = _sink_batch(sink_schema, cols, diff.num_rows, now_ms)
+        meta = self.db.catalog.table(self.info.sink_table, self.info.database)
+        self.db.write_batch(meta, batch, mirror=False)
+
+    def _expire(self, diff: DiffBatch, now_ms: int) -> DiffBatch:
+        if self.info.expire_after_ms is None or diff.num_rows == 0:
+            return diff
+        horizon = _ms_to_native(
+            now_ms - self.info.expire_after_ms, self._ts_unit, ceil=False
+        )
+        ts = diff.rows.column(self.ts_name)
+        import pyarrow.compute as pc
+
+        keep = pc.fill_null(
+            pc.greater_equal(pc.cast(ts, pa.int64()), pa.scalar(horizon)), False
+        )
+        kept = diff.filter(keep)
+        expired = diff.num_rows - kept.num_rows
+        if expired:
+            metrics.FLOW_EXPIRED_TOTAL.inc(expired)
+            fault_injection.fire(
+                "flow.expire", flow=self.info.name, expired=expired
+            )
+        return kept
+
+    def flush(self, now_ms: int):
+        pass  # diffs land synchronously; nothing is buffered
+
+    def describe(self) -> list[str]:
+        lines = [f"Dataflow[project] sink={self.info.sink_table}"]
+        lines.append(f"  Source[{self.info.source_table}] -> DiffBatch(+1)")
+        if self.stmt.where is not None:
+            lines.append(f"  -> Filter[{self.stmt.where.name()}]")
+        if self.info.expire_after_ms is not None:
+            lines.append(f"  -> Expire[after={self.info.expire_after_ms}ms]")
+        lines.append(
+            "  -> Project[" + ", ".join(n for n, _ in self.outputs) + "]"
+        )
+        lines.append(
+            f"  -> AppendSink[{self.info.sink_table}"
+            f" keys={self.key_names} time={self.time_out}]"
+        )
+        return lines
+
+
+# ---- incremental aggregates with DISTINCT states ---------------------------
+
+
+class _DistinctState:
+    """Per-group value set backing count(DISTINCT x): the decomposable
+    state is the set itself, folded per diff, counted at emit."""
+
+    __slots__ = ("values",)
+
+    def __init__(self):
+        self.values: set = set()
+
+    def update(self, vals):
+        for v in vals:
+            if v is None:
+                continue
+            if isinstance(v, float) and v != v:
+                v = _NAN  # all NaNs count as one distinct value
+            self.values.add(v)
+
+    def get(self, func: str):
+        return len(self.values)
+
+
+class IncAggFlowTask(StreamingFlowTask):
+    """StreamingFlowTask extended with per-group set states so
+    count(DISTINCT x) streams instead of degrading to batch re-runs."""
+
+    mode = "dataflow"
+    wants_source = False
+    sink_derive_types = True  # distinct counts land as INT64, not FLOAT64
+
+    def _make_state(self, agg: AggCall):
+        if agg.distinct:
+            return _DistinctState()
+        return _AggState()
+
+    def _agg_input(self, agg: AggCall, table: pa.Table):
+        from ..query.cpu_exec import eval_expr
+
+        if agg.arg is None:
+            return np.ones(table.num_rows)
+        arr = eval_expr(agg.arg, table)
+        vals = arr.to_pylist() if hasattr(arr, "to_pylist") else list(arr)
+        if agg.distinct:
+            out = np.empty(len(vals), dtype=object)
+            out[:] = vals
+            return out
+        return np.asarray(vals, dtype=float)
+
+    def on_insert(self, table: pa.Table, now_ms: int):
+        fault_injection.fire(
+            "flow.diff_apply", flow=self.info.name, rows=table.num_rows
+        )
+        _count_diff(DiffBatch.inserts(table))
+        super().on_insert(table, now_ms)
+
+    def describe(self) -> list[str]:
+        lines = [f"Dataflow[incremental-aggregate] sink={self.info.sink_table}"]
+        lines.append(f"  Source[{self.info.source_table}] -> DiffBatch(+1)")
+        if self.stmt.where is not None:
+            lines.append(f"  -> Filter[{self.stmt.where.name()}]")
+        keys = ", ".join(name for _e, name in self.group_exprs)
+        states = ", ".join(
+            ("distinct-set " if a.distinct else "fold ") + a.name()
+            for a in self.unique_aggs
+        )
+        lines.append(f"  -> GroupStates[keys=({keys}); {states}]")
+        if self.info.expire_after_ms is not None:
+            lines.append(f"  -> Expire[after={self.info.expire_after_ms}ms]")
+        lines.append(f"  -> UpsertSink[{self.info.sink_table}]")
+        return lines
+
+
+# ---- dirty-window recompute core -------------------------------------------
+
+
+class _DirtyWindowMixin:
+    """Shared dirty-window bookkeeping + bounded recompute: diffs mark
+    windows (mark-seq guarded, as in the batch engine: a window retires
+    only if no insert re-marked it mid-recompute) and the marked windows
+    re-run the flow SQL with an injected time bound through the normal
+    query engine — the heavy aggregate rebuild rides the device tile path."""
+
+    def _init_windows(self, window_ms: int | None, defer: bool):
+        cfg = getattr(self.db.config, "flow", None)
+        self.window_ms = window_ms or (cfg.window_ms if cfg else 3_600_000)
+        self.max_windows = cfg.max_windows_per_recompute if cfg else 64
+        self.defer = defer
+        self.dirty: dict[int, int] = {}
+        self._mark_seq = 0
+        self.last_eval_ms = int(_time.time() * 1000)
+        self._lock = threading.Lock()
+        self.recomputes = 0
+
+    def _mark_windows(self, windows, now_ms: int) -> None:
+        with self._lock:
+            self._mark_seq += 1
+            for w in windows:
+                self.dirty[int(w)] = self._mark_seq
+        self._expire_windows(now_ms)
+
+    def _expire_windows(self, now_ms: int):
+        if self.info.expire_after_ms is None:
+            return
+        horizon = now_ms - self.info.expire_after_ms
+        with self._lock:
+            dead = [w for w in self.dirty if w + self.window_ms <= horizon]
+            for w in dead:
+                del self.dirty[w]
+        if dead:
+            metrics.FLOW_EXPIRED_TOTAL.inc(len(dead))
+            fault_injection.fire(
+                "flow.expire", flow=self.info.name, expired=len(dead)
+            )
+
+    def due(self, now_ms: int) -> bool:
+        interval = self.info.eval_interval_ms or 10_000
+        return bool(self.dirty) and now_ms - self.last_eval_ms >= interval
+
+    def tick(self, now_ms: int, force: bool = False) -> bool:
+        """Deferred (EVAL INTERVAL) evaluation — and the catch-up path for
+        immediate flows whose last diff dirtied more windows than
+        max_windows_per_recompute allowed in one pass."""
+        if not force and not self.due(now_ms):
+            return False
+        self.last_eval_ms = now_ms
+        return self._recompute(now_ms)
+
+    def flush(self, now_ms: int):
+        self.tick(now_ms, force=True)
+
+    def _maybe_recompute(self, now_ms: int):
+        if not self.defer:
+            self.last_eval_ms = now_ms
+            self._recompute(now_ms)
+
+    def _recompute(self, now_ms: int) -> bool:
+        with self._lock:
+            if not self.dirty:
+                return False
+            snapshot = dict(self.dirty)
+        windows = sorted(snapshot)[: self.max_windows]
+        metrics.FLOW_DIRTY_WINDOWS_TOTAL.inc(len(windows))
+        from ..parallel.tile_cache import flow_maintenance
+
+        for lo, hi in _coalesce_windows(windows, self.window_ms):
+            bound = BinaryOp(
+                "and",
+                BinaryOp(
+                    ">=",
+                    Column(self.bound_column),
+                    Literal(_ms_to_native(lo, self.ts_unit, ceil=False)),
+                ),
+                BinaryOp(
+                    "<",
+                    Column(self.bound_column),
+                    Literal(_ms_to_native(hi, self.ts_unit, ceil=True)),
+                ),
+            )
+            stmt2 = parse_sql(self.info.sql)[0]
+            stmt2.where = (
+                bound
+                if stmt2.where is None
+                else BinaryOp("and", stmt2.where, bound)
+            )
+            before = metrics.TPU_DEVICE_DISPATCHES.total()
+            with flow_maintenance():
+                result = self.db.query_engine.execute_select(
+                    stmt2, self.info.database
+                )
+            if metrics.TPU_DEVICE_DISPATCHES.total() > before:
+                self.recomputes += 1
+            # REPLACE the window: without the delete, a group that flips
+            # out of HAVING (or a join row whose key match vanished on a
+            # dimension update) would survive in the sink with stale
+            # values — upserts alone cannot retract.
+            self._delete_window_rows(lo, hi)
+            _upsert_result(
+                self.db, self.info, self.key_names, self.time_out, result, now_ms
+            )
+            with self._lock:
+                for w in range(lo, hi, self.window_ms):
+                    if w in snapshot and self.dirty.get(w) == snapshot[w]:
+                        del self.dirty[w]
+        return True
+
+    def _delete_window_rows(self, lo: int, hi: int):
+        """Tombstone the sink's rows in [lo, hi) before re-upserting the
+        window's fresh result (mirrors Database._delete, with the flow's
+        database explicit)."""
+        try:
+            meta = self.db.catalog.table(self.info.sink_table, self.info.database)
+        except Exception:  # noqa: BLE001 — first recompute: sink not created yet
+            return
+        schema = meta.schema
+        ti = schema.time_index
+        if ti is None or ti.name != self.time_out:
+            # a pre-existing sink keyed on something other than the flow's
+            # window column: a ranged delete would hit unrelated rows, so
+            # keep upsert-only (batch-engine parity) for such sinks
+            return
+        unit = (
+            ti.to_arrow().type.unit
+            if pa.types.is_timestamp(ti.to_arrow().type)
+            else "ms"
+        )
+        proj = [c.name for c in schema.tag_columns()] + [ti.name]
+        bound = BinaryOp(
+            "and",
+            BinaryOp(">=", Column(ti.name), Literal(_ms_to_native(lo, unit, ceil=False))),
+            BinaryOp("<", Column(ti.name), Literal(_ms_to_native(hi, unit, ceil=True))),
+        )
+        sel = SelectStmt(
+            projections=[Column(c) for c in proj],
+            table=self.info.sink_table,
+            database=self.info.database,
+            where=bound,
+        )
+        keys = self.db.query_engine.execute_select(sel, self.info.database)
+        if keys.num_rows == 0:
+            return
+        region_ids = meta.region_ids
+        for i, part in enumerate(meta.partition_rule.split(keys)):
+            if part.num_rows:
+                self.db.storage.delete(region_ids[i], part)
+
+    def _windows_of(self, table: pa.Table, ts_name: str) -> np.ndarray:
+        from ..query.cpu_exec import _ts_to_ms
+
+        if ts_name not in table.column_names:
+            return np.empty(0, dtype=np.int64)
+        ts = _ts_to_ms(table.column(ts_name))
+        return np.unique(ts // self.window_ms) * self.window_ms
+
+
+class WindowRecomputeTask(_DirtyWindowMixin):
+    """Single-table windowed aggregates beyond the fold states (HAVING,
+    stddev, percentiles, sketches): insert-driven dirty-window recompute
+    through the query engine — the per-window aggregate rebuild dispatches
+    through the device tile path."""
+
+    mode = "dataflow"
+    wants_source = False
+
+    def __init__(self, info, db, defer: bool = False):
+        self.info = info
+        self.db = db
+        self.stmt: SelectStmt = parse_sql(info.sql)[0]
+        schema = db.catalog.table(info.source_table, info.database).schema
+        self.ts_name = schema.time_index.name
+        self.bound_column = self.ts_name
+        self.ts_unit = (
+            schema.time_index.to_arrow().type.unit
+            if pa.types.is_timestamp(schema.time_index.to_arrow().type)
+            else "ms"
+        )
+        names = (
+            _side_names(self.stmt.from_item)
+            if isinstance(self.stmt.from_item, TableRef)
+            else {info.source_table}
+        )
+        key = _window_key(self.stmt, names, self.ts_name)
+        self.time_out, window_ms = key if key else (None, None)
+        proj_by_expr = {
+            _strip_alias(p): p.name()
+            for p in self.stmt.projections
+            if not find_agg_calls(_strip_alias(p))
+        }
+        self.key_names = [
+            proj_by_expr.get(e, name)
+            for e, name in _resolved_group_exprs(self.stmt)
+        ]
+        self._init_windows(window_ms, defer)
+
+    def on_insert(self, table: pa.Table, now_ms: int):
+        fault_injection.fire(
+            "flow.diff_apply", flow=self.info.name, rows=table.num_rows
+        )
+        _count_diff(DiffBatch.inserts(table))
+        windows = self._windows_of(table, self.ts_name)
+        if windows.size == 0:
+            return
+        self._mark_windows(windows, now_ms)
+        self._maybe_recompute(now_ms)
+
+    def describe(self) -> list[str]:
+        lines = [f"Dataflow[window-recompute] sink={self.info.sink_table}"]
+        lines.append(
+            f"  Source[{self.info.source_table}] -> DiffBatch(+1)"
+            f" -> DirtyWindows[{self.window_ms}ms"
+            + (", deferred" if self.defer else ", immediate")
+            + "]"
+        )
+        if self.info.expire_after_ms is not None:
+            lines.append(f"  -> Expire[after={self.info.expire_after_ms}ms]")
+        lines.append(
+            "  -> WindowRecompute[engine SELECT per dirty range;"
+            " device tile path]"
+        )
+        lines.append(
+            f"  -> UpsertSink[{self.info.sink_table}"
+            f" keys={self.key_names} time={self.time_out}]"
+        )
+        return lines
+
+
+class JoinFlowTask(_DirtyWindowMixin):
+    """Dirty-window inner join: per-side join-key indexes bound the
+    recompute to exactly the output windows a diff can affect.
+
+    The time-axis side's diffs dirty their own windows directly (and feed
+    the key->windows index); the other side's diffs probe that index — a
+    new right-side row for key k can only change output windows where the
+    axis side already has rows with key k.  Rows present before the flow
+    was created are not indexed (flows see ingest from creation onward,
+    as in the reference)."""
+
+    mode = "dataflow"
+    wants_source = True
+
+    def __init__(self, info, db, defer: bool = False):
+        self.info = info
+        self.db = db
+        self.stmt: SelectStmt = parse_sql(info.sql)[0]
+        fi = self.stmt.from_item
+        schema_of = lambda t, d: db.catalog.table(t, d).schema  # noqa: E731
+        lschema = schema_of(fi.left.table, fi.left.database or info.database)
+        rschema = schema_of(fi.right.table, fi.right.database or info.database)
+        self.pairs = _equi_pairs(fi, lschema, rschema)
+        side, ref, schema, time_out, window_ms = _join_axis(
+            self.stmt, fi, lschema, rschema
+        )
+        self.axis_side = side
+        self.axis_table = ref.table
+        self.other_table = (fi.right if side == "l" else fi.left).table
+        self.axis_name = ref.alias or ref.table
+        self.ts_name = schema.time_index.name
+        self.ts_unit = (
+            schema.time_index.to_arrow().type.unit
+            if pa.types.is_timestamp(schema.time_index.to_arrow().type)
+            else "ms"
+        )
+        self.bound_column = f"{self.axis_name}.{self.ts_name}"
+        self.time_out = time_out
+        # key column base names per side, aligned pairwise
+        self.axis_keys = [l if side == "l" else r for l, r in self.pairs]
+        self.other_keys = [r if side == "l" else l for l, r in self.pairs]
+        # axis-side index: join key tuple -> window starts it appears in
+        self.key_windows: dict[tuple, set[int]] = {}
+        aggs = [a for e in _all_exprs(self.stmt) for a in find_agg_calls(e)]
+        if aggs:
+            proj_by_expr = {
+                _strip_alias(p): p.name()
+                for p in self.stmt.projections
+                if not find_agg_calls(_strip_alias(p))
+            }
+            self.key_names = [
+                proj_by_expr.get(e, name)
+                for e, name in _resolved_group_exprs(self.stmt)
+            ]
+        else:
+            self.key_names = []
+            for p in self.stmt.projections:
+                inner = _strip_alias(p)
+                if not isinstance(inner, Column):
+                    continue
+                q, base = _split_qual(inner.column)
+                for names, sch in (
+                    (_side_names(fi.left), lschema),
+                    (_side_names(fi.right), rschema),
+                ):
+                    if (q is None or q in names) and sch.has_column(base):
+                        if sch.column(base).semantic_type == SemanticType.TAG:
+                            self.key_names.append(p.name())
+                        break
+        self._init_windows(window_ms, defer)
+
+    def on_insert(self, table: pa.Table, now_ms: int, source: str | None = None):
+        fault_injection.fire(
+            "flow.diff_apply", flow=self.info.name, rows=table.num_rows,
+            source=source,
+        )
+        _count_diff(DiffBatch.inserts(table))
+        dirtied: set[int] = set()
+        source = source or self.axis_table
+        if source == self.axis_table:
+            windows = self._windows_of(table, self.ts_name)
+            keys = self._key_tuples(table, self.axis_keys)
+            from ..query.cpu_exec import _ts_to_ms
+
+            if keys is not None and self.ts_name in table.column_names:
+                row_w = (
+                    _ts_to_ms(table.column(self.ts_name)) // self.window_ms
+                ) * self.window_ms
+                with self._lock:
+                    for k, w in zip(keys, row_w):
+                        self.key_windows.setdefault(k, set()).add(int(w))
+            dirtied.update(int(w) for w in windows)
+        if source == self.other_table:
+            keys = self._key_tuples(table, self.other_keys)
+            if keys is not None:
+                with self._lock:
+                    for k in set(keys):
+                        dirtied.update(self.key_windows.get(k, ()))
+        self._expire_index(now_ms)
+        if not dirtied:
+            return
+        fault_injection.fire(
+            "flow.join_dirty", flow=self.info.name, source=source,
+            windows=len(dirtied),
+        )
+        self._mark_windows(dirtied, now_ms)
+        self._maybe_recompute(now_ms)
+
+    def _key_tuples(self, table: pa.Table, key_cols: list[str]):
+        if any(c not in table.column_names for c in key_cols):
+            return None
+        cols = [table.column(c).to_pylist() for c in key_cols]
+        return list(zip(*cols)) if cols else None
+
+    def _expire_index(self, now_ms: int):
+        """Bound the key->windows index: EXPIRE AFTER prunes windows that
+        can no longer be recomputed (fully below the horizon)."""
+        if self.info.expire_after_ms is None:
+            return
+        horizon = now_ms - self.info.expire_after_ms
+        expired = 0
+        with self._lock:
+            for k in list(self.key_windows):
+                ws = self.key_windows[k]
+                dead = {w for w in ws if w + self.window_ms <= horizon}
+                if dead:
+                    expired += len(dead)
+                    ws -= dead
+                    if not ws:
+                        del self.key_windows[k]
+        if expired:
+            metrics.FLOW_EXPIRED_TOTAL.inc(expired)
+            fault_injection.fire(
+                "flow.expire", flow=self.info.name, expired=expired
+            )
+
+    def describe(self) -> list[str]:
+        fi = self.stmt.from_item
+        lines = [f"Dataflow[dirty-window-join] sink={self.info.sink_table}"]
+        lines.append(
+            f"  Source[{fi.left.table}] |x| Source[{fi.right.table}]"
+            f" on {self.pairs} -> DiffBatch(+1)"
+        )
+        lines.append(
+            f"  -> KeyIndex[axis={self.axis_table}.{self.ts_name};"
+            f" key->windows({self.window_ms}ms)]"
+        )
+        if self.info.expire_after_ms is not None:
+            lines.append(f"  -> Expire[after={self.info.expire_after_ms}ms]")
+        lines.append(
+            "  -> DirtyWindowJoin[recompute touched windows via engine"
+            + (", deferred" if self.defer else ", immediate")
+            + "]"
+        )
+        lines.append(
+            f"  -> UpsertSink[{self.info.sink_table}"
+            f" keys={self.key_names} time={self.time_out}]"
+        )
+        return lines
+
+
+# ---- task factory -----------------------------------------------------------
+
+
+_TASKS = {
+    "project": ProjectFlowTask,
+    "incagg": IncAggFlowTask,
+    "window": WindowRecomputeTask,
+    "join": JoinFlowTask,
+}
+
+
+def build_task(info, db):
+    """Re-classify a persisted flow definition and build its dataflow
+    task.  Raises when the plan no longer classifies (schema drift) — the
+    manager degrades it to the batch engine with reason plan_error."""
+    from ..utils.errors import UnsupportedError
+
+    stmt = parse_sql(info.sql)[0]
+    kind, reason = classify(
+        stmt, lambda t, d: db.catalog.table(t, d).schema, info.database
+    )
+    if kind is None:
+        raise UnsupportedError(f"plan no longer dataflow-expressible: {reason}")
+    cls = _TASKS[kind]
+    if kind in ("window", "join"):
+        return cls(info, db, defer=info.eval_interval_ms is not None)
+    return cls(info, db)
+
+
+def source_tables(stmt: SelectStmt) -> list[str]:
+    """Source tables a dataflow plan reads (joins have two)."""
+    fi = stmt.from_item
+    if isinstance(fi, JoinItem):
+        out = []
+        for ref in (fi.left, fi.right):
+            if isinstance(ref, TableRef) and ref.table not in out:
+                out.append(ref.table)
+        return out
+    return [stmt.table] if stmt.table else []

@@ -49,7 +49,8 @@ from ..query.expr import (
     find_agg_calls,
     map_aggs,
 )
-from ..query.sql_parser import CreateFlowStmt, SelectStmt, parse_sql
+from ..query.sql_parser import CreateFlowStmt, JoinItem, SelectStmt, TableRef, parse_sql
+from ..utils import metrics
 from ..utils.errors import (
     FlowAlreadyExistsError,
     FlowNotFoundError,
@@ -74,18 +75,29 @@ class FlowInfo:
     sink_table: str
     database: str
     sql: str
-    mode: str  # streaming | batching
+    mode: str  # streaming | dataflow | batching
     expire_after_ms: int | None = None
     eval_interval_ms: int | None = None
     comment: str | None = None
     created_at_ms: int = 0
+    # Why this flow is NOT incrementally maintained (batch mode only):
+    # the first graph-inexpressible feature found at CREATE time.  None
+    # for streaming/dataflow modes — the silent `_is_streamable`
+    # degradation always leaves a trace now.
+    fallback_reason: str | None = None
+    # All source tables (joins have two); source_table stays the primary.
+    source_tables: list | None = None
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
 
     @classmethod
     def from_dict(cls, d: dict) -> "FlowInfo":
-        return cls(**d)
+        known = {f.name for f in __import__("dataclasses").fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def all_sources(self) -> list:
+        return self.source_tables or [self.source_table]
 
 
 def _strip_alias(e: Expr) -> Expr:
@@ -252,6 +264,21 @@ class StreamingFlowTask:
         self.state: dict[tuple, list[_AggState]] = {}
         self._lock = threading.Lock()
 
+    # -- state hooks (IncAggFlowTask overrides these to add DISTINCT set
+    # states; the fold loop below is shared) --------------------------------
+    def _make_state(self, agg: AggCall):
+        return _AggState()
+
+    def _agg_input(self, agg: AggCall, table: pa.Table):
+        from ..query.cpu_exec import eval_expr
+
+        if agg.arg is None:
+            return np.ones(table.num_rows)
+        arr = eval_expr(agg.arg, table)
+        return np.asarray(
+            arr.to_pylist() if hasattr(arr, "to_pylist") else arr, dtype=float
+        )
+
     # -- fold one mirrored batch -------------------------------------------
     def on_insert(self, table: pa.Table, now_ms: int):
         from ..query.cpu_exec import eval_expr
@@ -267,14 +294,7 @@ class StreamingFlowTask:
             if not isinstance(arr, (pa.Array, pa.ChunkedArray)):
                 arr = pa.array([arr] * table.num_rows)
             key_cols.append(arr.to_pylist() if hasattr(arr, "to_pylist") else list(arr))
-        agg_inputs = []
-        for agg in self.unique_aggs:
-            if agg.arg is None:
-                agg_inputs.append(np.ones(table.num_rows))
-            else:
-                arr = eval_expr(agg.arg, table)
-                np_arr = np.asarray(arr.to_pylist() if hasattr(arr, "to_pylist") else arr, dtype=float)
-                agg_inputs.append(np_arr)
+        agg_inputs = [self._agg_input(agg, table) for agg in self.unique_aggs]
         touched: set[tuple] = set()
         with self._lock:
             rows = range(table.num_rows)
@@ -285,7 +305,7 @@ class StreamingFlowTask:
             for k, idxs in by_key.items():
                 states = self.state.get(k)
                 if states is None:
-                    states = [_AggState() for _ in self.unique_aggs]
+                    states = [self._make_state(a) for a in self.unique_aggs]
                     self.state[k] = states
                 sel = np.asarray(idxs)
                 for j, agg in enumerate(self.unique_aggs):
@@ -324,6 +344,13 @@ class StreamingFlowTask:
         dead = [k for k in self.state if _as_ms(k[ti]) < horizon]
         for k in dead:
             del self.state[k]
+        if dead:
+            from ..utils import fault_injection, metrics
+
+            metrics.FLOW_EXPIRED_TOTAL.inc(len(dead))
+            fault_injection.fire(
+                "flow.expire", flow=self.info.name, expired=len(dead)
+            )
 
     # -- write touched groups into the sink --------------------------------
     def _emit(self, touched: set[tuple], now_ms: int):
@@ -371,6 +398,10 @@ class StreamingFlowTask:
         meta = self.db.catalog.table(self.info.sink_table, self.info.database)
         self.db.write_batch(meta, batch, mirror=False)
 
+    # Dataflow subclasses derive sink FIELD types from the computed arrays
+    # (count(DISTINCT) -> INT64); the legacy streaming sink stays FLOAT64.
+    sink_derive_types = False
+
     def _ensure_sink(self, cols: dict[str, list]) -> Schema:
         return _ensure_sink_table(
             self.db,
@@ -379,6 +410,7 @@ class StreamingFlowTask:
             agg_names=[n for n, _e in self.agg_outputs],
             sample_cols=cols,
             time_key=self._time_key_name(),
+            derive_types=self.sink_derive_types,
         )
 
     def _time_key_name(self) -> str | None:
@@ -390,6 +422,21 @@ class StreamingFlowTask:
             touched = set(self.state.keys())
         if touched:
             self._emit(touched, now_ms)
+
+    def describe(self) -> list[str]:
+        lines = [f"Streaming[decomposable-aggregate] sink={self.info.sink_table}"]
+        lines.append(f"  Source[{self.info.source_table}]")
+        if self.stmt.where is not None:
+            lines.append(f"  -> Filter[{self.stmt.where.name()}]")
+        keys = ", ".join(name for _e, name in self.group_exprs)
+        lines.append(
+            "  -> FoldStates[keys=(" + keys + "); "
+            + ", ".join(a.name() for a in self.unique_aggs) + "]"
+        )
+        if self.info.expire_after_ms is not None:
+            lines.append(f"  -> Expire[after={self.info.expire_after_ms}ms]")
+        lines.append(f"  -> UpsertSink[{self.info.sink_table}]")
+        return lines
 
 
 class BatchingFlowTask:
@@ -555,6 +602,24 @@ class BatchingFlowTask:
     def flush(self, now_ms: int):
         self.tick(now_ms, force=True)
 
+    def describe(self) -> list[str]:
+        reason = self.info.fallback_reason or "eval_interval"
+        interval = self.info.eval_interval_ms or 10_000
+        lines = [
+            f"Batch[periodic re-run] sink={self.info.sink_table} "
+            f"fallback_reason={reason}"
+        ]
+        lines.append(
+            f"  Source[{self.info.source_table}] -> "
+            f"MarkDirtyWindows[{self.window_ms}ms]"
+        )
+        lines.append(
+            f"  -> PeriodicEval[every {interval}ms: re-run SQL over dirty "
+            "ranges]"
+        )
+        lines.append(f"  -> UpsertSink[{self.info.sink_table}]")
+        return lines
+
 
 def _coalesce_windows(windows: list[int], width: int) -> list[tuple[int, int]]:
     out: list[tuple[int, int]] = []
@@ -615,6 +680,18 @@ def _coerce(values: list, col: ColumnSchema) -> pa.Array:
         return pc.cast(arr, target)
 
 
+def _derived_field_type(pa_type) -> ConcreteDataType:
+    if pa.types.is_string(pa_type) or pa.types.is_large_string(pa_type):
+        return ConcreteDataType.STRING
+    if pa.types.is_boolean(pa_type):
+        return ConcreteDataType.BOOLEAN
+    if pa.types.is_integer(pa_type):
+        return ConcreteDataType.INT64
+    if pa.types.is_timestamp(pa_type):
+        return ConcreteDataType.TIMESTAMP_MILLISECOND
+    return ConcreteDataType.FLOAT64
+
+
 def _ensure_sink_table(
     db,
     info: FlowInfo,
@@ -623,10 +700,14 @@ def _ensure_sink_table(
     sample_cols: dict[str, list],
     time_key: str | None,
     arrow_schema: pa.Schema | None = None,
+    derive_types: bool = False,
 ) -> Schema:
     """Auto-create the sink table from the flow's output shape (the
     reference auto-creates sink tables on flow creation,
-    flow/src/adapter.rs `create_table_from_relation`)."""
+    flow/src/adapter.rs `create_table_from_relation`).  `derive_types`
+    (dataflow tasks) keeps FIELD columns at their computed Arrow type —
+    a projected string/int column must not coerce to FLOAT64; the legacy
+    streaming/batching callers keep the historical float sinks bit-for-bit."""
     try:
         return db.catalog.table(info.sink_table, info.database).schema
     except TableNotFoundError:
@@ -649,6 +730,8 @@ def _ensure_sink_table(
                 dt, sem = ConcreteDataType.INT64, SemanticType.TAG
             else:
                 dt, sem = ConcreteDataType.FLOAT64, SemanticType.FIELD
+        elif derive_types:
+            dt, sem = _derived_field_type(pa_type), SemanticType.FIELD
         else:
             dt, sem = ConcreteDataType.FLOAT64, SemanticType.FIELD
         columns.append(
@@ -699,13 +782,57 @@ class FlowManager:
         self._load()
 
     # -- DDL ----------------------------------------------------------------
+    def _incremental_enabled(self) -> bool:
+        cfg = getattr(self.db.config, "flow", None)
+        return bool(cfg and cfg.incremental)
+
+    def _choose_mode(self, stmt: CreateFlowStmt, source_db: str):
+        """The degradation ladder: streaming (decomposable aggregates) ->
+        dataflow (diff-driven graph) -> batching, with the first
+        inexpressible feature recorded as the fallback reason.  With
+        flow.incremental off the pre-dataflow ladder applies bit-for-bit."""
+        from . import dataflow as df
+
+        q = stmt.query
+        if stmt.eval_interval_ms is not None and q.table is not None:
+            # user asked for periodic eval on a single-table plan: the
+            # batch engine is that, exactly (joins instead DEFER their
+            # dirty-window recompute to the interval below)
+            return "batching", "eval_interval"
+        if q.table is not None and _is_streamable(q):
+            return "streaming", None
+        if not self._incremental_enabled():
+            return "batching", "incremental_disabled"
+        kind, reason = df.classify(
+            q, lambda t, d: self.db.catalog.table(t, d).schema, source_db
+        )
+        if kind is not None:
+            return "dataflow", None
+        return "batching", reason or "non_streamable"
+
     def create_flow(self, stmt: CreateFlowStmt, database: str) -> FlowInfo:
         # validate the new definition BEFORE touching any existing flow so a
         # failed CREATE OR REPLACE leaves the old flow intact
-        if stmt.query.table is None:
-            raise InvalidArgumentsError("flow query must read FROM a source table")
+        from . import dataflow as df
+
         source_db = stmt.query.database or database
-        self.db.catalog.table(stmt.query.table, source_db)  # must exist
+        sources = df.source_tables(stmt.query)
+        if stmt.query.table is None:
+            fi = stmt.query.from_item
+            join_ok = (
+                self._incremental_enabled()
+                and isinstance(fi, JoinItem)
+                and isinstance(fi.left, TableRef)
+                and isinstance(fi.right, TableRef)
+            )
+            if not join_ok:
+                raise InvalidArgumentsError(
+                    "flow query must read FROM a source table"
+                )
+            for ref in (fi.left, fi.right):
+                self.db.catalog.table(ref.table, ref.database or source_db)
+        else:
+            self.db.catalog.table(stmt.query.table, source_db)  # must exist
         # Every GROUP BY key must surface in the SELECT list: the sink table
         # is keyed by the projected columns, so a dropped key would collapse
         # distinct groups into one sink row (silently wrong results in either
@@ -719,21 +846,26 @@ class FlowManager:
                     f"flow GROUP BY key {gi.name()!r} must appear in the SELECT "
                     "list (the sink table is keyed by projected columns)"
                 )
+        mode, reason = self._choose_mode(stmt, source_db)
+        if mode == "batching" and stmt.query.table is None:
+            # the batch engine is single-table; an inexpressible join has
+            # no safe fallback — fail loudly with the reason instead of
+            # materializing wrong results
+            raise UnsupportedError(
+                f"flow over a join cannot be maintained: {reason}"
+            )
         if stmt.name in self.flows:
             if stmt.if_not_exists:
                 return self.infos[stmt.name]
             if not stmt.or_replace:
                 raise FlowAlreadyExistsError(f"flow already exists: {stmt.name}")
             self.drop_flow(stmt.name)
-        mode = (
-            "batching"
-            if stmt.eval_interval_ms is not None or not _is_streamable(stmt.query)
-            else "streaming"
-        )
+        if mode == "batching":
+            metrics.FLOW_BATCH_FALLBACK_TOTAL.inc(reason=reason)
         info = FlowInfo(
             flow_id=self._next_id,
             name=stmt.name,
-            source_table=stmt.query.table,
+            source_table=sources[0] if sources else stmt.query.table,
             sink_table=stmt.sink_table,
             database=source_db,
             sql=stmt.query_sql,
@@ -742,6 +874,8 @@ class FlowManager:
             eval_interval_ms=stmt.eval_interval_ms,
             comment=stmt.comment,
             created_at_ms=self.clock(),
+            fallback_reason=reason if mode == "batching" else None,
+            source_tables=sources if len(sources) > 1 else None,
         )
         self._next_id += 1
         self._register(info)
@@ -749,15 +883,38 @@ class FlowManager:
         return info
 
     def _register(self, info: FlowInfo):
-        task = (
-            StreamingFlowTask(info, self.db)
-            if info.mode == "streaming"
-            else BatchingFlowTask(info, self.db)
-        )
+        if info.mode == "dataflow" and not self._incremental_enabled():
+            # the emergency-off knob must also cover flows created BEFORE
+            # it was flipped: degrade persisted dataflow flows to the
+            # batch engine on registration (join flows run best-effort —
+            # only axis-side inserts mark windows; re-runs evaluate the
+            # full SQL, so results stay correct when they run)
+            self.last_error = (
+                f"flow {info.name}: degraded to batch (flow.incremental=false)"
+            )
+            info.mode, info.fallback_reason = "batching", "incremental_disabled"
+            metrics.FLOW_BATCH_FALLBACK_TOTAL.inc(reason="incremental_disabled")
+        if info.mode == "streaming":
+            task = StreamingFlowTask(info, self.db)
+        elif info.mode == "dataflow":
+            from . import dataflow as df
+
+            try:
+                task = df.build_task(info, self.db)
+            except Exception as e:  # noqa: BLE001 — schema drifted under a
+                # persisted definition: degrade to the batch engine (with
+                # the trace) rather than dropping the flow on restart
+                self.last_error = f"flow {info.name}: dataflow rebuild: {e}"
+                info.mode, info.fallback_reason = "batching", "plan_error"
+                metrics.FLOW_BATCH_FALLBACK_TOTAL.inc(reason="plan_error")
+                task = BatchingFlowTask(info, self.db)
+        else:
+            task = BatchingFlowTask(info, self.db)
         self.flows[info.name] = task
         self.infos[info.name] = info
-        self._by_source.setdefault((info.source_table, info.database), []).append(info.name)
-        if info.mode == "batching":
+        for t in info.all_sources():
+            self._by_source.setdefault((t, info.database), []).append(info.name)
+        if info.mode == "batching" or hasattr(task, "due"):
             self._ensure_ticker()
 
     def _ensure_ticker(self):
@@ -792,8 +949,9 @@ class FlowManager:
         task = self.flows.pop(name)
         if hasattr(task, "drop_state"):
             task.drop_state()  # batching dirty-window file must not orphan
-        key = (info.source_table, info.database)
-        self._by_source[key] = [n for n in self._by_source.get(key, []) if n != name]
+        for t in info.all_sources():
+            key = (t, info.database)
+            self._by_source[key] = [n for n in self._by_source.get(key, []) if n != name]
         self._save()
 
     def flush_flow(self, name: str) -> int:
@@ -815,16 +973,23 @@ class FlowManager:
             # mirroring is best-effort (the reference detaches FlowMirrorTask):
             # a broken flow must not fail the user's insert
             try:
-                self.flows[n].on_insert(t, now)
+                task = self.flows[n]
+                if getattr(task, "wants_source", False):
+                    # multi-source dataflow (joins): the task routes the
+                    # diff by which side it arrived on
+                    task.on_insert(t, now, source=table)
+                else:
+                    task.on_insert(t, now)
             except Exception as e:
                 self.last_error = f"flow {n}: {e}"
 
     def tick(self):
         """Periodic driver for batching flows (reference batching engine's
-        eval loop, batching_mode/task.rs)."""
+        eval loop, batching_mode/task.rs) and for dataflow tasks with
+        deferred (EVAL INTERVAL) or overflow dirty windows."""
         now = self.clock()
-        for task in self.flows.values():
-            if isinstance(task, BatchingFlowTask):
+        for task in list(self.flows.values()):
+            if isinstance(task, BatchingFlowTask) or hasattr(task, "due"):
                 task.tick(now)
 
     # -- introspection ------------------------------------------------------
@@ -837,7 +1002,8 @@ class FlowManager:
         return sorted(
             i.name
             for i in self.infos.values()
-            if i.database == database and table in (i.source_table, i.sink_table)
+            if i.database == database
+            and table in (*i.all_sources(), i.sink_table)
         )
 
     # -- persistence --------------------------------------------------------

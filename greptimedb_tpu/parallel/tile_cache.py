@@ -54,6 +54,7 @@ None and the authoritative scan path runs.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import logging
@@ -102,6 +103,31 @@ from .executor import (
 # Max rows per device chunk: one chunk's kernel working set fits HBM
 # comfortably even for 10-column programs (see _SuperTiles.cols).
 TILE_CHUNK_ROWS = 1 << 24
+
+# ---- flow-maintenance attribution ------------------------------------------
+# Dirty-window flow recompute (flow/dataflow.py) drives its per-window
+# aggregate rebuild through the normal engine entry, so it reuses this
+# module's whole machinery — super-tiles, delta-extend, dispatch
+# coalescing.  The thread-local scope below lets the dispatch site
+# attribute those device dispatches to materialized-view maintenance
+# (greptime_flow_device_dispatch_total) without threading a flag through
+# every call layer.
+_FLOW_MAINT = threading.local()
+
+
+@contextlib.contextmanager
+def flow_maintenance():
+    """Scope marking the current thread's dispatches as flow maintenance."""
+    prev = getattr(_FLOW_MAINT, "depth", 0)
+    _FLOW_MAINT.depth = prev + 1
+    try:
+        yield
+    finally:
+        _FLOW_MAINT.depth = prev
+
+
+def _in_flow_maintenance() -> bool:
+    return getattr(_FLOW_MAINT, "depth", 0) > 0
 
 # GRAFT_TILE_TIMING=1 prints per-phase wall times of the cold path (the
 # bench's second-process cold probe uses it to attribute cold latency)
@@ -2451,6 +2477,8 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
         # producer can safely RELEASE a region's input planes before
         # building the next one — peak HBM stays one region's working set.
         metrics.TPU_DEVICE_DISPATCHES.inc()
+        if _in_flow_maintenance():
+            metrics.FLOW_DEVICE_DISPATCH_TOTAL.inc()
         hv = jnp.asarray(
             dyn.get("having_values") or (0.0,), jnp.float64
         )

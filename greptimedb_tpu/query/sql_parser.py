@@ -252,6 +252,15 @@ class ExplainStmt:
 
 
 @dataclass
+class ExplainFlowStmt:
+    """`EXPLAIN FLOW <name>`: render the flow's operator graph (mode,
+    operators, fallback reason) — the introspection half of the
+    incremental-dataflow degradation ladder."""
+
+    name: str
+
+
+@dataclass
 class TqlStmt:
     kind: str  # eval|explain|analyze
     start: float
@@ -430,6 +439,8 @@ class Parser:
             return DescribeStmt(self.ident())
         if self.at_kw("explain"):
             self.next()
+            if self.eat_kw("flow"):
+                return ExplainFlowStmt(self.ident())
             analyze = self.eat_kw("analyze")
             return ExplainStmt(analyze, self.parse_statement())
         if self.at_kw("tql"):

@@ -334,6 +334,31 @@ class TileConfig:
 
 
 @dataclasses.dataclass
+class FlowConfig:
+    """Incremental dataflow for materialized views (flow/dataflow.py).
+
+    `incremental = True` routes CREATE FLOW plans the operator graph can
+    express — map/filter/project, count(DISTINCT), dirty-window inner
+    joins, windowed heavy aggregates — through diff-driven incremental
+    maintenance; plans it cannot express fall back to the periodic-batch
+    engine with the reason recorded (SHOW FLOWS / EXPLAIN FLOW /
+    greptime_flow_batch_fallback_total).  `incremental = False` restores
+    the pre-dataflow mode selection bit-for-bit: decomposable single-table
+    aggregates stream, everything else batches, joins are rejected."""
+
+    incremental: bool = True
+    # Dirty-window granularity for recompute flows whose plan has no
+    # date_bin/time_bucket group key (joins/projections over raw
+    # timestamps): diffs dirty ranges of this width.
+    window_ms: int = 3_600_000
+    # Upper bound on windows recomputed per diff batch; the overflow stays
+    # dirty and is picked up by the next diff/flush (protects the insert
+    # path from a single backfill batch fanning into thousands of
+    # synchronous re-runs).
+    max_windows_per_recompute: int = 64
+
+
+@dataclasses.dataclass
 class AdmissionConfig:
     """Multi-tenant admission control in front of the query/write paths
     (utils/admission.py) and the tile executor's overload machinery
@@ -421,6 +446,7 @@ class Config:
     replica: ReplicaConfig = dataclasses.field(default_factory=ReplicaConfig)
     tile: TileConfig = dataclasses.field(default_factory=TileConfig)
     admission: AdmissionConfig = dataclasses.field(default_factory=AdmissionConfig)
+    flow: FlowConfig = dataclasses.field(default_factory=FlowConfig)
 
     def __post_init__(self):
         self.storage.__post_init__()
@@ -588,6 +614,23 @@ class Config:
                 "admission.min_chunk_rows must be >= 4096 (the kernel block "
                 "size — halving below one block cannot help an OOM); got "
                 f"{a.min_chunk_rows!r}"
+            )
+        fl = self.flow
+        if not isinstance(fl.incremental, bool):
+            raise ConfigError(
+                "flow.incremental must be a boolean (diff-driven dataflow "
+                f"maintenance for CREATE FLOW); got {fl.incremental!r}"
+            )
+        if fl.window_ms < 1:
+            raise ConfigError(
+                "flow.window_ms must be >= 1 millisecond — the dirty-window "
+                "granularity for recompute flows without a time-bucket "
+                f"group key; got {fl.window_ms!r}"
+            )
+        if fl.max_windows_per_recompute < 1:
+            raise ConfigError(
+                "flow.max_windows_per_recompute must be >= 1 window per "
+                f"diff batch; got {fl.max_windows_per_recompute!r}"
             )
 
     @classmethod

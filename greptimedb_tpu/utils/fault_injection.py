@@ -49,6 +49,17 @@ Named points wired into the codebase:
                        attaches to another query's in-flight device
                        dispatch (ctx: table) — observe/perturb coalition
                        formation at exactly the attach moment
+    flow.diff_apply    dataflow task entry (flow/dataflow.py), fired per
+                       mirrored diff batch BEFORE the operator graph folds
+                       it — an injected error here exercises the
+                       best-effort mirror contract (the user's insert must
+                       survive, the flow records last_error)
+    flow.join_dirty    dirty-window join marking (ctx: flow, side,
+                       windows) — fired when a diff batch dirties output
+                       windows, before the recompute runs
+    flow.expire        flow EXPIRE AFTER dropping rows/states/index
+                       windows (ctx: flow, expired count) — fired only
+                       when something is actually expired
 
 Production overhead is near zero: `fire()` is a module-level function whose
 fast path is one read of a module global (`_ARMED`) — no locks, no dict
@@ -98,6 +109,9 @@ POINTS = frozenset(
         "admission.shed",
         "hbm.exhausted",
         "dispatch.coalesce",
+        "flow.diff_apply",
+        "flow.join_dirty",
+        "flow.expire",
     }
 )
 

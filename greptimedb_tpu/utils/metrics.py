@@ -307,6 +307,41 @@ FLOW_DEDUPE_TOTAL = REGISTRY.counter(
     "applied-but-reply-lost retries that would have double-counted",
 )
 
+# Incremental dataflow (flow/dataflow.py): diff-driven map/filter/project/
+# join flows with dirty-window recompute.  The fallback counter is the
+# observability half of the degradation ladder — a CREATE FLOW that cannot
+# take the incremental graph leaves a labeled trace instead of silently
+# degrading to periodic batch re-runs.
+FLOW_BATCH_FALLBACK_TOTAL = REGISTRY.counter(
+    "greptime_flow_batch_fallback_total",
+    "CREATE FLOW plans that fell back to periodic batch re-runs "
+    "(labels: reason = the first graph-inexpressible feature found)",
+)
+FLOW_DIFF_BATCHES_TOTAL = REGISTRY.counter(
+    "greptime_flow_diff_batches_total",
+    "Insert diff batches propagated through dataflow operator graphs",
+)
+FLOW_DIFF_ROWS_TOTAL = REGISTRY.counter(
+    "greptime_flow_diff_rows_total",
+    "Diff rows (sum of multiplicities) propagated through dataflow "
+    "operator graphs",
+)
+FLOW_DIRTY_WINDOWS_TOTAL = REGISTRY.counter(
+    "greptime_flow_dirty_windows_total",
+    "Time windows recomputed by dirty-window dataflow operators "
+    "(joins + heavy-aggregate window recompute)",
+)
+FLOW_EXPIRED_TOTAL = REGISTRY.counter(
+    "greptime_flow_expired_total",
+    "Diff rows / group states / index windows dropped by flow EXPIRE AFTER",
+)
+FLOW_DEVICE_DISPATCH_TOTAL = REGISTRY.counter(
+    "greptime_flow_device_dispatch_total",
+    "Flow window recomputes whose aggregate state rebuild dispatched "
+    "through the device tile path (materialized-view maintenance riding "
+    "the TPU)",
+)
+
 # Follower freshness (bounded-staleness replicas): per-region lag gauges
 # exported by the follower's own engine, and the hedge/placement/pruning
 # counters that ride on them.

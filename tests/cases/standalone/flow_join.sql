@@ -1,0 +1,45 @@
+-- CREATE FLOW over an inner join streams insert-driven: per-side join-key
+-- indexes bound the dirty-window recompute to exactly the output windows
+-- a diff can touch.
+CREATE TABLE metrics_f (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host));
+
+CREATE TABLE hostinfo_f (host STRING, hts TIMESTAMP TIME INDEX, region STRING, PRIMARY KEY(host));
+
+CREATE FLOW join_f SINK TO joined_f AS SELECT m.host AS host, m.ts AS ts, m.v AS v, h.region AS region FROM metrics_f m JOIN hostinfo_f h ON m.host = h.host;
+
+EXPLAIN FLOW join_f;
+
+INSERT INTO hostinfo_f VALUES ('a', 1, 'us-east'), ('b', 1, 'eu-west');
+
+INSERT INTO metrics_f VALUES ('a', 1000, 1.0), ('b', 2000, 2.0);
+
+SELECT host, ts, v, region FROM joined_f ORDER BY host;
+
+-- a dimension update probes the key index and refreshes only the windows
+-- where the key appeared
+INSERT INTO hostinfo_f VALUES ('a', 1, 'ap-south');
+
+SELECT host, ts, v, region FROM joined_f ORDER BY host;
+
+-- an aggregated join windows by the left time index
+CREATE FLOW jagg_f SINK TO joined_agg_f AS SELECT h.region AS region, time_bucket('10s', m.ts) AS w, sum(m.v) AS s FROM metrics_f m JOIN hostinfo_f h ON m.host = h.host GROUP BY region, w;
+
+INSERT INTO metrics_f VALUES ('a', 3000, 4.0), ('b', 12000, 8.0);
+
+SELECT region, w, s FROM joined_agg_f ORDER BY region, w;
+
+-- a graph-inexpressible plan records its fallback reason instead of
+-- degrading silently
+CREATE FLOW top_f SINK TO top_sink_f AS SELECT host, sum(v) AS s FROM metrics_f GROUP BY host ORDER BY s DESC LIMIT 1;
+
+SHOW FLOWS;
+
+DROP FLOW top_f;
+
+DROP FLOW jagg_f;
+
+DROP FLOW join_f;
+
+DROP TABLE metrics_f;
+
+DROP TABLE hostinfo_f;

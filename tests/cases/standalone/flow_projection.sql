@@ -1,0 +1,34 @@
+-- CREATE FLOW over a projection streams insert-driven (incremental
+-- dataflow): diff batches run filter -> project straight into the sink,
+-- no periodic batch re-runs.
+CREATE TABLE cpu_f (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY(host));
+
+CREATE FLOW proj_f SINK TO cpu_proj_f AS SELECT host, ts, v * 2 AS dbl FROM cpu_f WHERE v > 0;
+
+SHOW FLOWS;
+
+EXPLAIN FLOW proj_f;
+
+INSERT INTO cpu_f VALUES ('a', 1000, 1.0), ('b', 2000, -1.0), ('a', 3000, 2.5);
+
+SELECT host, ts, dbl FROM cpu_proj_f ORDER BY host, ts;
+
+-- the second insert folds incrementally, no flush/tick needed
+INSERT INTO cpu_f VALUES ('b', 4000, 4.0);
+
+SELECT host, ts, dbl FROM cpu_proj_f ORDER BY host, ts;
+
+-- count(DISTINCT) maintains per-group set states instead of batch re-runs
+CREATE FLOW cd_f SINK TO cpu_cd_f AS SELECT host, count(DISTINCT v) AS dv FROM cpu_f GROUP BY host;
+
+EXPLAIN FLOW cd_f;
+
+INSERT INTO cpu_f VALUES ('a', 5000, 1.0), ('a', 6000, 9.0);
+
+SELECT host, dv FROM cpu_cd_f ORDER BY host;
+
+DROP FLOW cd_f;
+
+DROP FLOW proj_f;
+
+DROP TABLE cpu_f;

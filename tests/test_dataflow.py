@@ -1,0 +1,662 @@
+"""Incremental dataflow tests (flow/dataflow.py): diff-driven
+map/filter/project flows, count(DISTINCT) set states, dirty-window joins,
+windowed heavy-aggregate recompute through the device tile path, the
+batch-fallback observability ladder, and the flow fault points
+(flow.diff_apply / flow.join_dirty / flow.expire)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.utils import fault_injection as fi
+from greptimedb_tpu.utils import metrics
+from greptimedb_tpu.utils.config import Config
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(data_home=str(tmp_path))
+    yield d
+    d.close()
+
+
+def _mk_source(db):
+    db.sql(
+        "CREATE TABLE cpu (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE,"
+        " PRIMARY KEY(host))"
+    )
+
+
+def _rows(t: pa.Table, cols):
+    data = [t.column(c).to_pylist() for c in cols]
+    return sorted(zip(*data), key=lambda r: tuple(str(x) for x in r))
+
+
+def _assert_equiv(db, flow_sql: str, sink: str, cols):
+    """Sink contents must equal a from-scratch batch run of the flow SQL."""
+    want = db.sql_one(flow_sql)
+    got = db.sql_one(f"SELECT {', '.join(cols)} FROM {sink}")
+    assert _rows(want, cols) == _rows(got, cols)
+
+
+# ---- map/filter/project flows ----------------------------------------------
+
+
+def test_projection_flow_streams_without_batch_runs(db):
+    _mk_source(db)
+    before = metrics.FLOW_BATCH_FALLBACK_TOTAL.total()
+    db.sql(
+        "CREATE FLOW proj SINK TO cpu_proj AS "
+        "SELECT host, ts, v * 2 AS dbl FROM cpu WHERE v > 0"
+    )
+    assert db.flows.infos["proj"].mode == "dataflow"
+    # the headline acceptance: a projection flow leaves NO batch fallback
+    assert metrics.FLOW_BATCH_FALLBACK_TOTAL.total() == before
+    db.sql(
+        "INSERT INTO cpu VALUES ('a', 1000, 1.0), ('b', 2000, -1.0), ('a', 3000, 2.5)"
+    )
+    assert db.flows.last_error is None
+    out = db.sql_one("SELECT host, dbl FROM cpu_proj ORDER BY host, dbl")
+    assert out.column("host").to_pylist() == ["a", "a"]
+    assert out.column("dbl").to_pylist() == [2.0, 5.0]
+    # second insert propagates incrementally (no flush/tick needed)
+    db.sql("INSERT INTO cpu VALUES ('b', 4000, 4.0)")
+    out = db.sql_one("SELECT dbl FROM cpu_proj WHERE host = 'b'")
+    assert out.column("dbl").to_pylist() == [8.0]
+    _assert_equiv(
+        db,
+        "SELECT host, ts, v * 2 AS dbl FROM cpu WHERE v > 0",
+        "cpu_proj",
+        ["host", "ts", "dbl"],
+    )
+
+
+def test_projection_flow_preserves_string_fields(db):
+    _mk_source(db)
+    db.sql("ALTER TABLE cpu ADD COLUMN note STRING")
+    db.sql(
+        "CREATE FLOW notes SINK TO cpu_notes AS SELECT host, ts, note FROM cpu"
+    )
+    assert db.flows.infos["notes"].mode == "dataflow"
+    db.sql("INSERT INTO cpu (host, ts, v, note) VALUES ('a', 1000, 1.0, 'hot')")
+    assert db.flows.last_error is None
+    out = db.sql_one("SELECT note FROM cpu_notes")
+    assert out.column("note").to_pylist() == ["hot"]
+
+
+def test_projection_flow_expiry(db):
+    _mk_source(db)
+    now_ms = db.flows.clock()
+    db.sql(
+        "CREATE FLOW recent SINK TO cpu_recent EXPIRE AFTER '1h' AS "
+        "SELECT host, ts, v FROM cpu"
+    )
+    with fi.REGISTRY.armed("flow.expire", error=None) as plan:
+        db.sql(
+            f"INSERT INTO cpu VALUES ('old', 1000, 1.0), ('new', {now_ms}, 2.0)"
+        )
+        assert plan.hits >= 1  # the stale row was expired, observably
+    out = db.sql_one("SELECT host FROM cpu_recent")
+    assert out.column("host").to_pylist() == ["new"]
+
+
+def test_dropped_tag_falls_back_with_reason(db):
+    """A projection that drops one of several TAG columns would collapse
+    rows distinct only in that tag (the sink is keyed by projected tags +
+    time index) — such plans take the labeled batch fallback instead of
+    silently merging rows."""
+    db.sql(
+        "CREATE TABLE multi (host STRING, region STRING, ts TIMESTAMP TIME"
+        " INDEX, v DOUBLE, PRIMARY KEY(host, region))"
+    )
+    db.sql("CREATE FLOW mp SINK TO smp AS SELECT host, ts, v FROM multi")
+    info = db.flows.infos["mp"]
+    assert info.mode == "batching"
+    assert info.fallback_reason == "tags_not_projected"
+    # projecting every tag keeps the incremental path
+    db.sql(
+        "CREATE FLOW mp2 SINK TO smp2 AS SELECT host, region, ts, v FROM multi"
+    )
+    assert db.flows.infos["mp2"].mode == "dataflow"
+    db.sql(
+        "INSERT INTO multi VALUES ('a', 'r1', 1000, 1.0),"
+        " ('a', 'r2', 1000, 2.0)"
+    )
+    out = db.sql_one("SELECT v FROM smp2 ORDER BY v")
+    assert out.column("v").to_pylist() == [1.0, 2.0]  # no collapse
+
+
+def test_cross_db_join_rejected(db):
+    db.sql("CREATE DATABASE otherdb")
+    db.sql(
+        "CREATE TABLE ax (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE,"
+        " PRIMARY KEY(host))"
+    )
+    db.sql(
+        "CREATE TABLE otherdb.dim (host STRING, hts TIMESTAMP TIME INDEX,"
+        " region STRING, PRIMARY KEY(host))"
+    )
+    # a cross-db side would never receive mirrored diffs (the mirror
+    # registry is keyed by the flow's database) — reject with the reason
+    with pytest.raises(Exception) as exc:
+        db.sql(
+            "CREATE FLOW xj SINK TO sxj AS "
+            "SELECT a.host AS host, a.ts AS ts, d.region AS region "
+            "FROM ax a JOIN otherdb.dim d ON a.host = d.host"
+        )
+    assert "cross_db_join" in str(exc.value)
+
+
+def test_window_recompute_retracts_having_dropouts(db):
+    """A recomputed window REPLACES the sink's rows: a group that flips
+    out of HAVING must disappear from the sink, not survive with a stale
+    aggregate."""
+    _mk_source(db)
+    sql = (
+        "SELECT host, time_bucket('10s', ts) AS w, avg(v) AS a FROM cpu"
+        " GROUP BY host, w HAVING avg(v) < 10"
+    )
+    db.sql(f"CREATE FLOW hdrop SINK TO shdrop AS {sql}")
+    db.sql("INSERT INTO cpu VALUES ('a', 1000, 5.0)")
+    assert db.sql_one("SELECT a FROM shdrop").column("a").to_pylist() == [5.0]
+    # the same window's avg jumps past the HAVING bound: the recompute
+    # yields no rows for the group and the stale sink row is retracted
+    db.sql("INSERT INTO cpu VALUES ('a', 2000, 100.0)")
+    assert db.flows.last_error is None
+    assert db.sql_one("SELECT a FROM shdrop").num_rows == 0
+    _assert_equiv(db, sql, "shdrop", ["host", "w", "a"])
+
+
+def test_incremental_off_degrades_persisted_dataflow_flows(tmp_path):
+    """flow.incremental=false must also cover flows created BEFORE the
+    knob was flipped: on restart they degrade to the batch engine."""
+    home = str(tmp_path / "deg")
+    db = Database(data_home=home)
+    _mk_source(db)
+    db.sql(
+        "CREATE FLOW cd SINK TO scd AS "
+        "SELECT host, count(DISTINCT v) AS dv FROM cpu GROUP BY host"
+    )
+    assert db.flows.infos["cd"].mode == "dataflow"
+    db.close()
+    cfg = Config()
+    cfg.storage.data_home = home
+    cfg.flow.incremental = False
+    db2 = Database(config=cfg)
+    try:
+        info = db2.flows.infos["cd"]
+        assert info.mode == "batching"
+        assert info.fallback_reason == "incremental_disabled"
+        # the degraded flow still materializes, just periodically
+        db2.sql("INSERT INTO cpu VALUES ('a', 1000, 3.0)")
+        db2.sql("ADMIN flush_flow('cd')")
+        out = db2.sql_one("SELECT dv FROM scd")
+        assert out.column("dv").to_pylist() == [1]
+    finally:
+        db2.close()
+
+
+def test_time_index_not_projected_falls_back_with_reason(db):
+    _mk_source(db)
+    before = metrics.FLOW_BATCH_FALLBACK_TOTAL.get(
+        reason="time_index_not_projected"
+    )
+    db.sql("CREATE FLOW hosts SINK TO cpu_hosts AS SELECT host, v FROM cpu")
+    info = db.flows.infos["hosts"]
+    assert info.mode == "batching"
+    assert info.fallback_reason == "time_index_not_projected"
+    assert (
+        metrics.FLOW_BATCH_FALLBACK_TOTAL.get(reason="time_index_not_projected")
+        == before + 1
+    )
+
+
+# ---- count(DISTINCT) set states --------------------------------------------
+
+
+def test_count_distinct_streams_incrementally(db):
+    _mk_source(db)
+    before = metrics.FLOW_BATCH_FALLBACK_TOTAL.total()
+    db.sql(
+        "CREATE FLOW cd SINK TO cpu_cd AS "
+        "SELECT host, count(DISTINCT v) AS dv, sum(v) AS s FROM cpu GROUP BY host"
+    )
+    assert db.flows.infos["cd"].mode == "dataflow"
+    assert metrics.FLOW_BATCH_FALLBACK_TOTAL.total() == before
+    db.sql(
+        "INSERT INTO cpu VALUES ('a', 1000, 1.0), ('a', 2000, 1.0), ('a', 3000, 2.0)"
+    )
+    assert db.flows.last_error is None
+    out = db.sql_one("SELECT dv, s FROM cpu_cd")
+    assert out.column("dv").to_pylist() == [2]
+    assert out.column("s").to_pylist() == [4.0]
+    # incremental fold: repeat value does not bump the distinct count
+    db.sql("INSERT INTO cpu VALUES ('a', 4000, 2.0), ('a', 5000, 7.0)")
+    out = db.sql_one("SELECT dv, s FROM cpu_cd")
+    assert out.column("dv").to_pylist() == [3]
+    assert out.column("s").to_pylist() == [13.0]
+    _assert_equiv(
+        db,
+        "SELECT host, count(DISTINCT v) AS dv, sum(v) AS s FROM cpu GROUP BY host",
+        "cpu_cd",
+        ["host", "dv", "s"],
+    )
+
+
+# ---- dirty-window joins -----------------------------------------------------
+
+
+def _mk_join_sources(db):
+    db.sql(
+        "CREATE TABLE metrics_t (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE,"
+        " PRIMARY KEY(host))"
+    )
+    db.sql(
+        "CREATE TABLE hostinfo (host STRING, hts TIMESTAMP TIME INDEX,"
+        " region STRING, PRIMARY KEY(host))"
+    )
+
+
+JOIN_FLOW_SQL = (
+    "SELECT m.host AS host, m.ts AS ts, m.v AS v, h.region AS region "
+    "FROM metrics_t m JOIN hostinfo h ON m.host = h.host"
+)
+
+
+def test_join_flow_streams_insert_driven(db):
+    _mk_join_sources(db)
+    before = metrics.FLOW_BATCH_FALLBACK_TOTAL.total()
+    db.sql(f"CREATE FLOW jf SINK TO joined AS {JOIN_FLOW_SQL}")
+    info = db.flows.infos["jf"]
+    assert info.mode == "dataflow"
+    assert sorted(info.all_sources()) == ["hostinfo", "metrics_t"]
+    assert metrics.FLOW_BATCH_FALLBACK_TOTAL.total() == before
+    db.sql("INSERT INTO hostinfo VALUES ('a', 1, 'us-east'), ('b', 2, 'eu')")
+    with fi.REGISTRY.armed("flow.join_dirty", error=None) as plan:
+        db.sql(
+            "INSERT INTO metrics_t VALUES ('a', 1000, 1.0), ('b', 2000, 2.0)"
+        )
+        assert plan.hits >= 1
+    assert db.flows.last_error is None
+    out = db.sql_one("SELECT host, region, v FROM joined ORDER BY host")
+    assert out.column("host").to_pylist() == ["a", "b"]
+    assert out.column("region").to_pylist() == ["us-east", "eu"]
+    # a RIGHT-side diff probes the key index and recomputes only the
+    # windows where 'a' appeared — the joined view picks up the new region
+    # (same hts key: the dimension row is UPDATED, not duplicated)
+    db.sql("INSERT INTO hostinfo VALUES ('a', 1, 'ap-south')")
+    out = db.sql_one("SELECT region FROM joined WHERE host = 'a'")
+    assert out.column("region").to_pylist() == ["ap-south"]
+    _assert_equiv(db, JOIN_FLOW_SQL, "joined", ["host", "ts", "v", "region"])
+
+
+def test_join_flow_aggregated_windows(db):
+    _mk_join_sources(db)
+    sql = (
+        "SELECT h.region AS region, time_bucket('10s', m.ts) AS w,"
+        " sum(m.v) AS s FROM metrics_t m JOIN hostinfo h ON m.host = h.host"
+        " GROUP BY region, w"
+    )
+    db.sql(f"CREATE FLOW jagg SINK TO joined_agg AS {sql}")
+    assert db.flows.infos["jagg"].mode == "dataflow"
+    db.sql("INSERT INTO hostinfo VALUES ('a', 1, 'us'), ('b', 2, 'us')")
+    db.sql(
+        "INSERT INTO metrics_t VALUES ('a', 1000, 1.0), ('b', 2000, 2.0),"
+        " ('a', 12000, 4.0)"
+    )
+    assert db.flows.last_error is None
+    out = db.sql_one("SELECT w, s FROM joined_agg ORDER BY w")
+    assert out.column("s").to_pylist() == [3.0, 4.0]
+    _assert_equiv(db, sql, "joined_agg", ["region", "w", "s"])
+
+
+def test_outer_join_flow_is_rejected_with_reason(db):
+    _mk_join_sources(db)
+    with pytest.raises(Exception) as exc:
+        db.sql(
+            "CREATE FLOW oj SINK TO oj_sink AS "
+            "SELECT m.host AS host, m.ts AS ts, h.region AS region "
+            "FROM metrics_t m LEFT JOIN hostinfo h ON m.host = h.host"
+        )
+    assert "outer_join" in str(exc.value)
+
+
+# ---- windowed heavy-aggregate recompute (device tile path) -----------------
+
+
+def test_window_recompute_having_rides_device_path(db):
+    _mk_source(db)
+    sql = (
+        "SELECT host, time_bucket('10s', ts) AS w, sum(v) AS s FROM cpu"
+        " GROUP BY host, w HAVING sum(v) > 1"
+    )
+    db.sql(f"CREATE FLOW heavy SINK TO cpu_heavy AS {sql}")
+    info = db.flows.infos["heavy"]
+    assert info.mode == "dataflow"
+    before = metrics.FLOW_DEVICE_DISPATCH_TOTAL.total()
+    hosts = ", ".join(
+        f"('h{i}', {1000 + i * 7}, {float(i)})" for i in range(64)
+    )
+    db.sql(f"INSERT INTO cpu VALUES {hosts}")
+    # the insert-driven dirty-window recompute went through the engine and
+    # its aggregate rebuild dispatched through the device tile path
+    assert db.flows.last_error is None
+    assert metrics.FLOW_DEVICE_DISPATCH_TOTAL.total() > before
+    db.sql("ADMIN flush_table('cpu')")
+    db.sql("INSERT INTO cpu VALUES ('h1', 2000, 5.0), ('h2', 12000, 9.0)")
+    assert db.flows.last_error is None
+    _assert_equiv(db, sql, "cpu_heavy", ["host", "w", "s"])
+
+
+def test_window_recompute_stddev(db):
+    _mk_source(db)
+    sql = (
+        "SELECT host, time_bucket('10s', ts) AS w, stddev(v) AS sd FROM cpu"
+        " GROUP BY host, w"
+    )
+    db.sql(f"CREATE FLOW sd SINK TO cpu_sd AS {sql}")
+    assert db.flows.infos["sd"].mode == "dataflow"
+    db.sql(
+        "INSERT INTO cpu VALUES ('a', 1000, 1.0), ('a', 2000, 3.0), ('a', 3000, 5.0)"
+    )
+    assert db.flows.last_error is None
+    out = db.sql_one("SELECT sd FROM cpu_sd")
+    assert out.column("sd").to_pylist() == pytest.approx([2.0])
+    # out-of-order backfill dirties ONLY its window and recomputes it
+    db.sql("INSERT INTO cpu VALUES ('a', 1500, 9.0)")
+    _assert_equiv(db, sql, "cpu_sd", ["host", "w", "sd"])
+
+
+# ---- randomized equivalence -------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [7, 23])
+def test_randomized_equivalence(tmp_path, seed):
+    """Seeded fuzz: out-of-order multi-batch ingest through projection,
+    count(DISTINCT), windowed-aggregate and join flows must leave every
+    sink identical to a from-scratch batch run of its SQL."""
+    rng = np.random.default_rng(seed)
+    db = Database(data_home=str(tmp_path / f"s{seed}"))
+    try:
+        _mk_source(db)
+        _mk_join_sources(db)
+        flows = {
+            "f_proj": (
+                "SELECT host, ts, v * 10 AS sv FROM cpu WHERE v >= 0.2",
+                "s_proj", ["host", "ts", "sv"],
+            ),
+            "f_cd": (
+                "SELECT host, count(DISTINCT v) AS dv, max(v) AS mx FROM cpu"
+                " GROUP BY host",
+                "s_cd", ["host", "dv", "mx"],
+            ),
+            "f_win": (
+                "SELECT host, time_bucket('5s', ts) AS w, sum(v) AS s,"
+                " count(v) AS n FROM cpu GROUP BY host, w HAVING count(v) > 0",
+                "s_win", ["host", "w", "s", "n"],
+            ),
+            "f_join": (JOIN_FLOW_SQL, "s_join", ["host", "ts", "v", "region"]),
+        }
+        for name, (sql, sink, _cols) in flows.items():
+            db.sql(f"CREATE FLOW {name} SINK TO {sink} AS {sql}")
+            assert db.flows.infos[name].mode == "dataflow", name
+        regions = ["us", "eu", "ap"]
+        hosts = [f"h{i}" for i in range(4)]
+        for h in hosts:
+            db.sql(
+                f"INSERT INTO hostinfo VALUES ('{h}', 1,"
+                f" '{rng.choice(regions)}')"
+            )
+        # unique (host, ts) pairs, inserted in shuffled batches so arrival
+        # order is wildly out of time order
+        all_ts = rng.permutation(np.arange(1000, 61000, 500))
+        pairs = [(hosts[i % len(hosts)], int(t)) for i, t in enumerate(all_ts)]
+        for batch in np.array_split(np.arange(len(pairs)), 6):
+            values = ", ".join(
+                f"('{pairs[i][0]}', {pairs[i][1]},"
+                f" {round(float(rng.random()), 2)})"
+                for i in batch
+            )
+            db.sql(f"INSERT INTO cpu VALUES {values}")
+            db.sql(
+                f"INSERT INTO metrics_t VALUES ('{rng.choice(hosts)}',"
+                f" {int(rng.integers(1000, 61000))},"
+                f" {round(float(rng.random()), 2)})"
+            )
+            if rng.random() < 0.5:  # dimension churn probes the join index
+                # same hts key per host: the dimension row is UPDATED
+                # in place (one row per host), not duplicated
+                db.sql(
+                    f"INSERT INTO hostinfo VALUES ('{rng.choice(hosts)}',"
+                    f" 1, '{rng.choice(regions)}')"
+                )
+        assert db.flows.last_error is None
+        for name, (sql, sink, cols) in flows.items():
+            _assert_equiv(db, sql, sink, cols)
+    finally:
+        db.close()
+
+
+# ---- fallback observability -------------------------------------------------
+
+
+def test_fallback_surfaces_in_show_and_explain(db):
+    _mk_source(db)
+    db.sql(
+        "CREATE FLOW topk SINK TO cpu_top AS "
+        "SELECT host, sum(v) AS s FROM cpu GROUP BY host ORDER BY s DESC LIMIT 2"
+    )
+    info = db.flows.infos["topk"]
+    assert info.mode == "batching" and info.fallback_reason == "order_limit"
+    shows = db.sql_one("SHOW FLOWS")
+    assert shows.column("Flows").to_pylist() == ["topk"]
+    assert shows.column("Mode").to_pylist() == ["batching"]
+    assert shows.column("Fallback Reason").to_pylist() == ["order_limit"]
+    plan = db.sql_one("EXPLAIN FLOW topk")
+    text = "\n".join(plan.column("Plan").to_pylist())
+    assert "fallback_reason=order_limit" in text
+    assert metrics.FLOW_BATCH_FALLBACK_TOTAL.get(reason="order_limit") >= 1
+
+
+def test_explain_flow_operator_graphs(db):
+    _mk_source(db)
+    _mk_join_sources(db)
+    db.sql("CREATE FLOW p SINK TO sp AS SELECT host, ts, v FROM cpu")
+    db.sql(
+        "CREATE FLOW s SINK TO ss AS SELECT host, sum(v) AS t FROM cpu GROUP BY host"
+    )
+    db.sql(f"CREATE FLOW j SINK TO sj AS {JOIN_FLOW_SQL}")
+    explain = {
+        n: "\n".join(
+            db.sql_one(f"EXPLAIN FLOW {n}").column("Plan").to_pylist()
+        )
+        for n in ("p", "s", "j")
+    }
+    assert "Dataflow[project]" in explain["p"]
+    assert "Streaming[decomposable-aggregate]" in explain["s"]
+    assert "Dataflow[dirty-window-join]" in explain["j"]
+    assert "KeyIndex" in explain["j"]
+
+
+def test_incremental_off_restores_pre_pr_ladder(tmp_path):
+    cfg = Config()
+    cfg.storage.data_home = str(tmp_path)
+    cfg.flow.incremental = False
+    db = Database(config=cfg)
+    try:
+        _mk_source(db)
+        _mk_join_sources(db)
+        # projections and DISTINCT degrade to batching, joins are rejected —
+        # exactly the pre-dataflow behavior
+        db.sql("CREATE FLOW p SINK TO sp AS SELECT host, ts, v FROM cpu")
+        assert db.flows.infos["p"].mode == "batching"
+        db.sql(
+            "CREATE FLOW cd SINK TO scd AS "
+            "SELECT host, count(DISTINCT v) AS dv FROM cpu GROUP BY host"
+        )
+        assert db.flows.infos["cd"].mode == "batching"
+        db.sql(
+            "CREATE FLOW st SINK TO sst AS "
+            "SELECT host, sum(v) AS s FROM cpu GROUP BY host"
+        )
+        assert db.flows.infos["st"].mode == "streaming"
+        with pytest.raises(Exception):
+            db.sql(f"CREATE FLOW j SINK TO sj AS {JOIN_FLOW_SQL}")
+        # batch fallback still WORKS (flush-driven), it is just periodic
+        db.sql("INSERT INTO cpu VALUES ('a', 1000, 1.5)")
+        db.sql("ADMIN flush_flow('cd')")
+        out = db.sql_one("SELECT dv FROM scd")
+        assert out.column("dv").to_pylist() == [1]
+    finally:
+        db.close()
+
+
+def test_dataflow_persistence_across_restart(tmp_path):
+    home = str(tmp_path / "fdb")
+    db = Database(data_home=home)
+    _mk_source(db)
+    db.sql(
+        "CREATE FLOW cd SINK TO cpu_cd AS "
+        "SELECT host, count(DISTINCT v) AS dv FROM cpu GROUP BY host"
+    )
+    db.sql("INSERT INTO cpu VALUES ('a', 1000, 1.0)")
+    db.close()
+    db2 = Database(data_home=home)
+    try:
+        assert db2.flows.infos["cd"].mode == "dataflow"
+        # distinct state rebuilds from fresh ingest (like streaming state);
+        # the pre-restart sink row survives and keeps updating
+        db2.sql("INSERT INTO cpu VALUES ('a', 2000, 5.0), ('a', 3000, 5.0)")
+        out = db2.sql_one("SELECT dv FROM cpu_cd")
+        assert out.column("dv").to_pylist() == [1]
+    finally:
+        db2.close()
+
+
+# ---- fault points -----------------------------------------------------------
+
+
+def test_diff_apply_fault_is_best_effort(db):
+    _mk_source(db)
+    db.sql("CREATE FLOW p SINK TO sp AS SELECT host, ts, v FROM cpu")
+    with fi.REGISTRY.armed(
+        "flow.diff_apply", fail_times=1, error=RuntimeError
+    ) as plan:
+        # the user's insert must survive a flow blowing up mid-mirror
+        db.sql("INSERT INTO cpu VALUES ('a', 1000, 1.0)")
+        assert plan.trips == 1
+    assert db.flows.last_error is not None and "p" in db.flows.last_error
+    assert db.sql_one("SELECT count(*) AS c FROM cpu").column("c").to_pylist() == [1]
+    # the next diff propagates normally again
+    db.sql("INSERT INTO cpu VALUES ('a', 2000, 2.0)")
+    out = db.sql_one("SELECT v FROM sp ORDER BY v")
+    assert out.column("v").to_pylist() == [2.0]
+
+
+def test_join_dirty_fault_observes_windows(db):
+    _mk_join_sources(db)
+    db.sql(f"CREATE FLOW jf SINK TO joined AS {JOIN_FLOW_SQL}")
+    db.sql("INSERT INTO hostinfo VALUES ('a', 1, 'us')")
+    seen = []
+    with fi.REGISTRY.armed(
+        "flow.join_dirty", error=None, callback=lambda ctx: seen.append(ctx)
+    ):
+        db.sql("INSERT INTO metrics_t VALUES ('a', 1000, 1.0)")
+    assert seen and seen[0]["windows"] >= 1 and seen[0]["source"] == "metrics_t"
+
+
+def test_expire_fault_point_fires_on_window_expiry(db):
+    _mk_source(db)
+    now_ms = db.flows.clock()
+    db.sql(
+        "CREATE FLOW w SINK TO sw EXPIRE AFTER '1h' AS "
+        "SELECT host, time_bucket('10s', ts) AS w, sum(v) AS s,"
+        " count(DISTINCT v) AS dv FROM cpu GROUP BY host, w"
+    )
+    assert db.flows.infos["w"].mode == "dataflow"
+    with fi.REGISTRY.armed("flow.expire", error=None) as plan:
+        db.sql(
+            f"INSERT INTO cpu VALUES ('old', 1000, 1.0), ('new', {now_ms}, 2.0)"
+        )
+        assert plan.hits >= 1
+
+
+# ---- tier-1 flow smoke: frontend-shaped mirror -> flownode -> sink ----------
+
+
+def test_flow_smoke_live_flownode_e2e(tmp_path):
+    """~20 s tier-1 smoke: insert-triggered diff propagation end-to-end
+    (mirror client -> live flownode Flight server -> sink table) for a
+    projection AND a join flow, with zero batch re-runs asserted via the
+    fallback counter and diff counters moving."""
+    from greptimedb_tpu.distributed.flownode import (
+        FlownodeClient,
+        FlownodeFlightServer,
+    )
+
+    db = Database(data_home=str(tmp_path / "fn"))
+    server = None
+    try:
+        _mk_join_sources(db)
+        server = FlownodeFlightServer(db)
+        import threading
+
+        threading.Thread(target=server.serve, daemon=True).start()
+        client = FlownodeClient(1, server.location)
+        assert client.action("health")["ok"] is True
+        # the datanode-side writes land on shared storage first (a real
+        # frontend writes regions, THEN mirrors the same batch to
+        # flownodes); no flows exist yet so nothing is locally mirrored
+        db.sql("INSERT INTO hostinfo VALUES ('a', 1, 'us'), ('b', 2, 'eu')")
+        db.sql(
+            "INSERT INTO metrics_t VALUES ('a', 1000, 1.0), ('b', 2000, 2.0),"
+            " ('a', 3000, -1.0)"
+        )
+        fallback_before = metrics.FLOW_BATCH_FALLBACK_TOTAL.total()
+        client.action("create_flow", {
+            "sql": "CREATE FLOW proj SINK TO proj_sink AS "
+                   "SELECT host, ts, v FROM metrics_t WHERE v > 0",
+        })
+        client.action("create_flow", {"sql": f"CREATE FLOW jf SINK TO join_sink AS {JOIN_FLOW_SQL}"})
+        flows = {f["name"]: f for f in client.action("list_flows")["flows"]}
+        assert flows["proj"]["mode"] == "dataflow"
+        assert flows["jf"]["mode"] == "dataflow"
+        assert metrics.FLOW_BATCH_FALLBACK_TOTAL.total() == fallback_before
+        diff_before = metrics.FLOW_DIFF_ROWS_TOTAL.total()
+        # mirrored inserts over the wire, like a frontend's BestEffortMirror
+        client.mirror_insert(
+            "hostinfo", "public",
+            pa.table({
+                "host": ["a", "b"],
+                "hts": pa.array([1, 2], pa.timestamp("ms")),
+                "region": ["us", "eu"],
+            }),
+            source="smoke", batch_id=1,
+        )
+        client.mirror_insert(
+            "metrics_t", "public",
+            pa.table({
+                "host": ["a", "b", "a"],
+                "ts": pa.array([1000, 2000, 3000], pa.timestamp("ms")),
+                "v": [1.0, 2.0, -1.0],
+            }),
+            source="smoke", batch_id=2,
+        )
+        assert db.flows.last_error is None
+        assert metrics.FLOW_DIFF_ROWS_TOTAL.total() > diff_before
+        out = db.sql_one("SELECT host, v FROM proj_sink ORDER BY host")
+        assert out.column("v").to_pylist() == [1.0, 2.0]
+        out = db.sql_one("SELECT host, region FROM join_sink ORDER BY host, ts")
+        assert out.column("host").to_pylist() == ["a", "a", "b"]
+        assert out.column("region").to_pylist() == ["us", "us", "eu"]
+        # the wire surface exposes the operator graph too
+        plan = client.action("explain_flow", {"name": "jf"})
+        assert plan["mode"] == "dataflow"
+        assert any("DirtyWindowJoin" in l for l in plan["plan"])
+        # still zero batch fallbacks after the whole run
+        assert metrics.FLOW_BATCH_FALLBACK_TOTAL.total() == fallback_before
+    finally:
+        if server is not None:
+            server.shutdown()
+        db.close()

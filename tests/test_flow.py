@@ -241,17 +241,19 @@ def test_streaming_flow_multi_window_group(db):
     assert out.column("s").to_pylist() == [1.0, 2.0, 4.0]
 
 
-def test_count_distinct_routes_to_batching(db):
-    """DISTINCT aggregates are not decomposable: the flow must take the
-    batching (re-run) mode instead of streaming a wrong count."""
+def test_count_distinct_streams_via_dataflow(db):
+    """DISTINCT aggregates are not decomposable as scalar folds, but the
+    dataflow subsystem maintains them as per-group value-set states — the
+    flow streams instead of degrading to periodic batch re-runs (the
+    pre-dataflow behavior is preserved under flow.incremental=false,
+    tests/test_dataflow.py::test_incremental_off_restores_pre_pr_ladder)."""
     _mk_source(db)
     db.sql(
         "CREATE FLOW cd SINK TO cpu_cd AS "
         "SELECT host, count(DISTINCT v) AS dv FROM cpu GROUP BY host"
     )
-    assert db.flows.infos["cd"].mode == "batching"
+    assert db.flows.infos["cd"].mode == "dataflow"
     db.sql("INSERT INTO cpu VALUES ('a', 1000, 1.0), ('a', 2000, 1.0), ('a', 3000, 2.0)")
-    db.sql("ADMIN flush_flow('cd')")
     out = db.sql_one("SELECT dv FROM cpu_cd")
     assert out.column("dv").to_pylist() == [2]
 
