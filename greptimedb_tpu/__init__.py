@@ -15,6 +15,8 @@ Layout mirrors the reference's layer map (SURVEY.md section 1):
   datatypes/  L0 type system (ConcreteDataType/Schema/vectors over Arrow)
   storage/    L1/L2 storage substrate + region engine (WAL, memtable, SST,
               manifest, flush, compaction)
+  index/      log-scale secondary indexes: segmented term index with
+              ranged puffin reads + the per-SST TermIndexReader router
   models/     table/catalog data model + region routing (metadata plane)
   query/      L5 query engine: SQL + PromQL front doors, logical plans,
               CPU executor (authoritative) and the TPU physical planner

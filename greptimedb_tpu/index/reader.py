@@ -1,0 +1,188 @@
+"""TermIndexReader: the shared per-SST index router.
+
+Scan-time pruning (`storage/sst.py`) and the query planner's stats
+probe both consult ONE object per SST sidecar instead of parsing blob
+formats inline.  The router:
+
+* serves the segmented term index (ranged reads, bounded memory) when
+  the sidecar carries it, and falls back to the legacy whole-blob
+  InvertedIndex / FulltextIndex / BloomIndex parses otherwise — SSTs
+  written before `index.segmented` existed stay fully readable;
+* degrades EVERY index failure (missing blob, torn segment, injected
+  `index.segment_read` fault) to `None` = "cannot prune": the residual
+  per-row filter still runs, so a broken index can cost a full scan but
+  never a wrong result;
+* answers `distinct_terms(column)` from the segmented meta blob — the
+  table stats the `agg_strategy` planner pass sizes its hash table from,
+  one small ranged read per (file, column).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import numpy as np
+
+from ..storage import index as legacy
+from ..storage.index import BLOOM_BLOB, FULLTEXT_BLOB, INVERTED_BLOB, VECTOR_BLOB
+from ..storage.puffin import PuffinReader
+from .segmented import (
+    INDEX_BYTES_READ,
+    INDEX_DEGRADED,
+    TERM_META_BLOB,
+    SegmentedTermIndex,
+)
+
+log = logging.getLogger("greptimedb_tpu.index")
+
+
+class TermIndexReader:
+    """Lazily-parsing router over one SST's puffin sidecar."""
+
+    def __init__(self, store, file_id: str):
+        self.file_id = file_id
+        self._puffin = PuffinReader(store, f"{file_id}.puffin", ranged=True)
+        self._cache_key = f"{getattr(store, 'root', id(store))}/{file_id}"
+        self._metas = None  # blob list, or False when the sidecar is absent/broken
+        self._parsed: dict[tuple, object] = {}  # (column, blob_type) -> parsed|None
+
+    # -- sidecar inventory ----------------------------------------------------
+
+    def _blobs(self):
+        if self._metas is None:
+            try:
+                if not self._puffin.exists():
+                    self._metas = False
+                else:
+                    self._metas = self._puffin.blobs()
+            except Exception as e:  # noqa: BLE001 — degrade, never fail the scan
+                log.warning("unreadable index sidecar %s: %s", self.file_id, e)
+                INDEX_DEGRADED.inc()
+                self._metas = False
+        return self._metas or []
+
+    def exists(self) -> bool:
+        return bool(self._blobs())
+
+    def _find(self, blob_type: str, column: str, **props):
+        for m in self._blobs():
+            if (
+                m.blob_type == blob_type
+                and m.properties.get("column") == column
+                and all(m.properties.get(k) == v for k, v in props.items())
+            ):
+                return m
+        return None
+
+    def _get(self, column: str, blob_type: str, kind: str | None = None):
+        """Parsed handle for (column, blob_type), cached; None = absent."""
+        key = (column, blob_type, kind)
+        if key in self._parsed:
+            return self._parsed[key]
+        out = None
+        try:
+            if blob_type == TERM_META_BLOB:
+                bm = self._find(TERM_META_BLOB, column, kind=kind)
+                if bm is not None:
+                    before = self._puffin.bytes_read
+                    meta = json.loads(self._puffin.read_blob(bm))
+                    INDEX_BYTES_READ.inc(max(self._puffin.bytes_read - before, 0))
+                    out = SegmentedTermIndex(
+                        self._puffin, self._cache_key, column, kind, meta
+                    )
+            else:
+                bm = self._find(blob_type, column)
+                if bm is not None:
+                    blob = self._puffin.read_blob(bm)
+                    if blob_type == INVERTED_BLOB:
+                        out = legacy.InvertedIndex(blob)
+                    elif blob_type == FULLTEXT_BLOB:
+                        out = legacy.FulltextIndex(blob)
+                    elif blob_type == BLOOM_BLOB:
+                        out = legacy.BloomIndex(blob)
+                    elif blob_type == VECTOR_BLOB:
+                        out = legacy.VectorIndex(blob)
+        except Exception as e:  # noqa: BLE001 — degrade, never fail the scan
+            log.warning(
+                "index blob %s/%s of %s unreadable: %s",
+                column, blob_type, self.file_id, e,
+            )
+            INDEX_DEGRADED.inc()
+            out = None
+        self._parsed[key] = out
+        return out
+
+    # -- the one search entry point ------------------------------------------
+
+    def search(self, column: str, op: str, value) -> np.ndarray | None:
+        """Row-segment candidacy bitmap for `column op value`, or None
+        when this sidecar cannot (or must not, after an error) prune."""
+        try:
+            if op in ("match", "match_term"):
+                seg = self._get(column, TERM_META_BLOB, "fulltext")
+                if seg is not None:
+                    bm = seg.search(op, value)
+                    if bm is not None:
+                        return bm
+                ft = self._get(column, FULLTEXT_BLOB)
+                return ft.search(op, value) if ft is not None else None
+            seg = self._get(column, TERM_META_BLOB, "inverted")
+            if seg is not None:
+                bm = seg.search(op, value)
+                if bm is not None:
+                    return bm
+            inv = self._get(column, INVERTED_BLOB)
+            if inv is not None:
+                bm = inv.search(op, value)
+                if bm is not None:
+                    return bm
+            bloom = self._get(column, BLOOM_BLOB)
+            return bloom.search(op, value) if bloom is not None else None
+        except Exception as e:  # noqa: BLE001 — the full-scan-degrade contract
+            log.warning(
+                "index lookup %s %s on %s degraded to full scan: %s",
+                column, op, self.file_id, e,
+            )
+            INDEX_DEGRADED.inc()
+            return None
+
+    def segment_rows(self) -> int:
+        """Row-segment granularity of this sidecar's indexes."""
+        for m in self._blobs():
+            if m.blob_type == TERM_META_BLOB:
+                h = self._get(
+                    m.properties.get("column"), TERM_META_BLOB, m.properties.get("kind")
+                )
+                if h is not None:
+                    return h.segment_rows
+        for col, bt in [
+            (m.properties.get("column"), m.blob_type)
+            for m in self._blobs()
+            if m.blob_type in (BLOOM_BLOB, INVERTED_BLOB, FULLTEXT_BLOB)
+        ]:
+            h = self._get(col, bt)
+            if h is not None:
+                return h.segment_rows
+        return legacy.DEFAULT_SEGMENT_ROWS
+
+    # -- auxiliary consumers --------------------------------------------------
+
+    def vector_index(self, column: str):
+        return self._get(column, VECTOR_BLOB)
+
+    def distinct_terms(self, column: str) -> int | None:
+        """Exact unique-term count of `column` IN THIS FILE, from the
+        segmented meta blob (one small ranged read) — the cheap stats
+        feed for the hash/sort aggregation planner.  None when this file
+        has no segmented index for the column."""
+        try:
+            seg = self._get(column, TERM_META_BLOB, "inverted")
+            return None if seg is None else int(seg.n_terms)
+        except Exception:  # noqa: BLE001 — stats are advisory
+            return None
+
+    def has_segmented(self, column: str) -> bool:
+        return self._find(TERM_META_BLOB, column, kind="inverted") is not None or (
+            self._find(TERM_META_BLOB, column, kind="fulltext") is not None
+        )

@@ -1,0 +1,411 @@
+"""Segmented term index: fence-keyed term segments with ranged reads.
+
+Layout (per indexed column, inside the SST's puffin sidecar):
+
+    greptime-term-index-meta-v1   {column, kind}
+        JSON: segment geometry + the sparse FENCE-KEY array (first term
+        of every segment) + per-segment term counts.  Small: one fence
+        per `seg_terms` terms (10^6 terms @ 512/segment = ~2000 fences).
+
+    greptime-term-seg-v1          {column, kind, seg}  x n_segments
+        Binary: the segment's sorted term dictionary (len-prefixed
+        bytes) followed by one delta-encoded varint posting list per
+        term.  Postings are ROW-SEGMENT ids (the same `segment_rows`
+        granularity the bloom/legacy indexes prune at), so a decoded
+        posting list expands to the row-segment candidacy bitmap the
+        scan-time applier already consumes.
+
+A term lookup binary-searches the fence keys (in memory after one small
+meta read), issues ONE ranged puffin read for the single term segment
+that can contain the term, and decodes O(seg_terms) entries — O(log
+terms) time and O(segment) memory regardless of index size.  Decoded
+segments live in a process-wide LRU so repeated lookups (dashboards
+re-filtering the same tag) skip the read entirely.
+
+Terms are stored as their canonical `storage.index._encode_value` bytes
+(NULL sorts first via its \\x00 sentinel), so build-time and search-time
+normalization agree with the legacy formats byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import struct
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..utils import metrics
+from ..utils.fault_injection import fire as _fault_fire
+
+TERM_META_BLOB = "greptime-term-index-meta-v1"
+TERM_SEGMENT_BLOB = "greptime-term-seg-v1"
+
+# terms longer than this are truncated at build AND lookup: collisions
+# only widen the candidate bitmap (the residual filter stays exact)
+MAX_TERM_BYTES = 1024
+
+INDEX_LOOKUP_MS = metrics.REGISTRY.histogram(
+    "greptime_index_lookup_ms",
+    "Milliseconds per term-index lookup (fence search + segment read + decode)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 500.0),
+)
+INDEX_SEGMENTS_READ = metrics.REGISTRY.counter(
+    "greptime_index_segments_read_total",
+    "Term-index segment blobs fetched from storage (LRU misses)",
+)
+INDEX_BYTES_READ = metrics.REGISTRY.counter(
+    "greptime_index_bytes_read_total",
+    "Bytes fetched from term-index sidecars via ranged reads",
+)
+INDEX_SEGMENT_CACHE_HITS = metrics.REGISTRY.counter(
+    "greptime_index_segment_cache_hits_total",
+    "Term-index segment lookups served from the decoded-segment LRU",
+)
+INDEX_DEGRADED = metrics.REGISTRY.counter(
+    "greptime_index_degraded_total",
+    "Index lookups that degraded to a full scan after a read error",
+)
+
+
+# ---- varint codec -----------------------------------------------------------
+
+
+def _write_varint(out: bytearray, v: int):
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    v = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return v, pos
+        shift += 7
+
+
+# ---- build ------------------------------------------------------------------
+
+
+def build_term_postings(
+    column: pa.Array, segment_rows: int
+) -> tuple[list[bytes], list[np.ndarray], int]:
+    """Tag column -> (sorted term bytes, per-term row-segment id arrays,
+    n_row_segments).  Vectorized via dictionary encoding, like the legacy
+    inverted builder, but with NO cardinality cap — segmenting is what
+    makes high cardinality affordable."""
+    from ..storage.index import _encode_value
+
+    n = len(column)
+    if isinstance(column, pa.ChunkedArray):
+        column = column.combine_chunks()
+    if pa.types.is_dictionary(column.type):
+        column = pc.cast(column, column.type.value_type)
+    n_segs = (n + segment_rows - 1) // segment_rows
+    d = pc.dictionary_encode(column)
+    dict_vals = d.dictionary.to_pylist()
+    codes = np.asarray(
+        pc.fill_null(pc.cast(d.indices, pa.int64()), len(dict_vals)), dtype=np.int64
+    )
+    seg_ids = np.arange(n, dtype=np.int64) // segment_rows
+    # unique (code, row-seg) pairs; nulls ride code == len(dict_vals)
+    pair = codes * n_segs + seg_ids
+    pair = np.unique(pair)
+    pcodes = pair // n_segs
+    psegs = (pair % n_segs).astype(np.int64)
+    keys = [_encode_value(v)[:MAX_TERM_BYTES] for v in dict_vals]
+    if (codes == len(dict_vals)).any():
+        keys.append(_encode_value(None))
+    # group by term bytes (several dict values can normalize to one key);
+    # pair is sorted, so pcodes is sorted — run boundaries, not per-code
+    # masks (a mask per code is O(terms * pairs))
+    by_key: dict[bytes, list[np.ndarray]] = {}
+    uniq, starts = np.unique(pcodes, return_index=True)
+    ends = np.append(starts[1:], len(pcodes))
+    for code, s, e in zip(uniq, starts, ends):
+        by_key.setdefault(keys[int(code)], []).append(psegs[s:e])
+    terms = sorted(by_key)
+    postings = [
+        np.unique(np.concatenate(by_key[t])) if len(by_key[t]) > 1 else by_key[t][0]
+        for t in terms
+    ]
+    return terms, postings, n_segs
+
+
+def build_token_postings(
+    column: pa.Array, segment_rows: int
+) -> tuple[list[bytes], list[np.ndarray], int]:
+    """Tokenized text column -> sorted token postings (fulltext kind)."""
+    from ..storage.index import tokenize
+
+    n = len(column)
+    if isinstance(column, pa.ChunkedArray):
+        column = column.combine_chunks()
+    if pa.types.is_dictionary(column.type):
+        column = pc.cast(column, column.type.value_type)
+    n_segs = (n + segment_rows - 1) // segment_rows
+    vocab: dict[str, set] = {}
+    for i, v in enumerate(column.to_pylist()):
+        if v is None:
+            continue
+        seg = i // segment_rows
+        for t in tokenize(str(v)):
+            vocab.setdefault(t, set()).add(seg)
+    tok_by_bytes: dict[bytes, set] = {}
+    for t, segs in vocab.items():
+        tok_by_bytes.setdefault(t.encode()[:MAX_TERM_BYTES], set()).update(segs)
+    terms = sorted(tok_by_bytes)
+    postings = [np.array(sorted(tok_by_bytes[t]), dtype=np.int64) for t in terms]
+    return terms, postings, n_segs
+
+
+def _encode_segment(terms: list[bytes], postings: list[np.ndarray]) -> bytes:
+    out = bytearray()
+    out += struct.pack("<I", len(terms))
+    for t in terms:
+        out += struct.pack("<H", len(t))
+        out += t
+    for p in postings:
+        _write_varint(out, len(p))
+        prev = 0
+        for v in p.tolist():
+            _write_varint(out, v - prev)
+            prev = v
+    return bytes(out)
+
+
+def _decode_segment(buf: bytes) -> dict[bytes, np.ndarray]:
+    (n_terms,) = struct.unpack_from("<I", buf, 0)
+    pos = 4
+    terms: list[bytes] = []
+    for _ in range(n_terms):
+        (ln,) = struct.unpack_from("<H", buf, pos)
+        pos += 2
+        terms.append(buf[pos : pos + ln])
+        pos += ln
+    out: dict[bytes, np.ndarray] = {}
+    for t in terms:
+        cnt, pos = _read_varint(buf, pos)
+        vals = np.empty(cnt, dtype=np.int64)
+        prev = 0
+        for i in range(cnt):
+            d, pos = _read_varint(buf, pos)
+            prev += d
+            vals[i] = prev
+        out[t] = vals
+    return out
+
+
+def write_term_index(
+    writer,
+    column: str,
+    kind: str,
+    terms: list[bytes],
+    postings: list[np.ndarray],
+    *,
+    segment_rows: int,
+    n_rows: int,
+    n_segs: int,
+    seg_terms: int = 512,
+) -> int:
+    """Emit the meta blob + one segment blob per `seg_terms` terms into
+    `writer` (a PuffinWriter).  Returns the number of segment blobs."""
+    fences: list[str] = []
+    seg_lens: list[int] = []
+    n_written = 0
+    for start in range(0, len(terms), seg_terms):
+        seg_t = terms[start : start + seg_terms]
+        seg_p = postings[start : start + seg_terms]
+        # latin-1 maps bytes 1:1 into JSON-safe codepoints, so the fence
+        # round-trips EXACTLY even when MAX_TERM_BYTES truncation cut a
+        # multibyte character in half — a utf-8 'replace' decode would
+        # mangle such a fence and misroute every lookup near it (wrongly
+        # pruning row groups that hold the term)
+        fences.append(seg_t[0].decode("latin-1"))
+        seg_lens.append(len(seg_t))
+        writer.add_blob(
+            TERM_SEGMENT_BLOB,
+            _encode_segment(seg_t, seg_p),
+            {"column": column, "kind": kind, "seg": n_written},
+        )
+        n_written += 1
+    meta = {
+        "version": 1,
+        "kind": kind,
+        "segment_rows": segment_rows,
+        "n_rows": n_rows,
+        "n_segs": n_segs,
+        "n_terms": len(terms),
+        "seg_terms": seg_terms,
+        "fences": fences,
+        "seg_lens": seg_lens,
+    }
+    writer.add_blob(
+        TERM_META_BLOB, json.dumps(meta).encode(), {"column": column, "kind": kind}
+    )
+    return n_written
+
+
+# ---- decoded-segment LRU ----------------------------------------------------
+
+
+class SegmentCache:
+    """Process-wide LRU of DECODED term segments, keyed by
+    (sidecar identity, column, kind, segment id).  Entry-bounded: each
+    entry is O(seg_terms) small objects, so a few hundred entries is a
+    few MB — the working set of a dashboard's hot tags."""
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = capacity
+        self._data: OrderedDict[tuple, dict] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key: tuple):
+        with self._lock:
+            v = self._data.get(key)
+            if v is not None:
+                self._data.move_to_end(key)
+            return v
+
+    def put(self, key: tuple, value: dict):
+        with self._lock:
+            self._data[key] = value
+            self._data.move_to_end(key)
+            while len(self._data) > self.capacity:
+                self._data.popitem(last=False)
+
+    def clear(self):
+        with self._lock:
+            self._data.clear()
+
+
+SEGMENT_CACHE = SegmentCache()
+
+
+# ---- read -------------------------------------------------------------------
+
+
+class SegmentedTermIndex:
+    """One column's segmented term index, bound to a ranged PuffinReader.
+
+    Holds the parsed meta (fence keys pre-encoded for bisect) and fetches
+    term segments on demand through the shared LRU; every storage touch
+    is a ranged read metered by greptime_index_{segments,bytes}_read."""
+
+    def __init__(self, puffin, cache_key: str, column: str, kind: str, meta: dict):
+        self._puffin = puffin
+        self._cache_key = cache_key
+        self.column = column
+        self.kind = kind
+        self.segment_rows = meta["segment_rows"]
+        self.n_segs = meta["n_segs"]
+        self.n_terms = meta["n_terms"]
+        self._fences = [f.encode("latin-1") for f in meta["fences"]]
+        self._seg_blobs: dict[int, object] | None = None  # seg id -> BlobMeta
+
+    def _segment_blob(self, seg: int):
+        if self._seg_blobs is None:
+            self._seg_blobs = {
+                m.properties.get("seg"): m
+                for m in self._puffin.blobs()
+                if m.blob_type == TERM_SEGMENT_BLOB
+                and m.properties.get("column") == self.column
+                and m.properties.get("kind") == self.kind
+            }
+        return self._seg_blobs.get(seg)
+
+    def _segment(self, seg: int) -> dict[bytes, np.ndarray]:
+        key = (self._cache_key, self.column, self.kind, seg)
+        cached = SEGMENT_CACHE.get(key)
+        if cached is not None:
+            INDEX_SEGMENT_CACHE_HITS.inc()
+            return cached
+        _fault_fire("index.segment_read", column=self.column, seg=seg)
+        bm = self._segment_blob(seg)
+        if bm is None:
+            raise FileNotFoundError(
+                f"term segment {seg} of {self.column} missing from {self._puffin.key}"
+            )
+        before = self._puffin.bytes_read
+        blob = self._puffin.read_blob(bm)
+        INDEX_SEGMENTS_READ.inc()
+        INDEX_BYTES_READ.inc(max(self._puffin.bytes_read - before, len(blob)))
+        decoded = _decode_segment(blob)
+        SEGMENT_CACHE.put(key, decoded)
+        return decoded
+
+    def lookup(self, term_bytes: bytes) -> np.ndarray:
+        """Row-segment candidacy bitmap for ONE term.  Exact: an absent
+        term returns all-False (the index is complete over the file)."""
+        t0 = time.perf_counter()
+        try:
+            out = np.zeros(self.n_segs, dtype=bool)
+            term_bytes = term_bytes[:MAX_TERM_BYTES]
+            i = bisect.bisect_right(self._fences, term_bytes) - 1
+            if i < 0:
+                return out
+            segs = self._segment(i).get(term_bytes)
+            if segs is not None:
+                out[segs] = True
+            return out
+        finally:
+            INDEX_LOOKUP_MS.observe((time.perf_counter() - t0) * 1000.0)
+
+    # -- predicate answering (mirrors the legacy classes' search API) --------
+
+    def search(self, op: str, value) -> np.ndarray | None:
+        if self.kind == "fulltext":
+            return self._search_fulltext(op, value)
+        return self._search_inverted(op, value)
+
+    def _search_inverted(self, op: str, value) -> np.ndarray | None:
+        from ..storage.index import _encode_value
+
+        if op == "=":
+            return self.lookup(_encode_value(value))
+        if op == "in":
+            out = np.zeros(self.n_segs, dtype=bool)
+            for v in value:
+                out |= self.lookup(_encode_value(v))
+            return out
+        # "!=" would have to union every OTHER term's postings — an
+        # O(index) read that defeats the segmented contract; decline to
+        # prune (the residual filter stays exact)
+        return None
+
+    def _search_fulltext(self, op: str, value) -> np.ndarray | None:
+        from ..storage.index import parse_match_query, tokenize
+
+        if op == "match_term":
+            toks = tokenize(str(value))
+            if not toks:
+                return None
+            out = np.ones(self.n_segs, dtype=bool)
+            for t in toks:
+                out &= self.lookup(t.encode())
+            return out
+        if op != "match":
+            return None
+        out = np.zeros(self.n_segs, dtype=bool)
+        for terms, _phrases, _negs in parse_match_query(str(value)):
+            # terms AND; phrases need substring scans over the whole
+            # vocabulary (the legacy reader's _substr_token_segs), which a
+            # ranged-read index cannot answer in O(segment) — skip the
+            # phrase constraint (conservative: keeps more segments);
+            # negations cannot prune either
+            cand = np.ones(self.n_segs, dtype=bool)
+            for t in terms:
+                cand &= self.lookup(t.encode())
+            out |= cand
+        return out

@@ -57,6 +57,7 @@ class AggState:
 def raw_group_ids(
     components: list[tuple[jnp.ndarray, int]],
     shape: tuple[int, ...] | None = None,
+    dtype=jnp.int32,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Mixed-radix combine (component, cardinality) pairs into dense gids.
 
@@ -66,15 +67,17 @@ def raw_group_ids(
     the ids is preserved for the block fast path.
 
     `components` may be empty (ungrouped aggregate, one global group); pass
-    `shape` so the all-zeros gid array can be built."""
+    `shape` so the all-zeros gid array can be built.  `dtype=jnp.int64`
+    serves the hash strategy, whose sparse group space may exceed int32
+    (the dense path never materializes [G] there, so a wide id is free)."""
     if not components and shape is None:
         raise ValueError("raw_group_ids needs `shape` when components is empty")
     if components:
         shape = components[0][0].shape
-    gid = jnp.zeros(shape, dtype=jnp.int32)
+    gid = jnp.zeros(shape, dtype=dtype)
     in_range = jnp.ones(shape, dtype=bool)
     for comp, card in components:
-        c = comp.astype(jnp.int32)
+        c = comp.astype(dtype)
         in_range = in_range & (c >= 0) & (c < card)
         gid = gid * card + jnp.clip(c, 0, card - 1)
     return gid, in_range
@@ -95,6 +98,84 @@ def group_ids(
 def time_bucket(ts: jnp.ndarray, origin: int, interval: int) -> jnp.ndarray:
     """Floor timestamps into interval buckets (reference date_bin / RANGE ALIGN)."""
     return ((ts - origin) // interval).astype(jnp.int32)
+
+
+# ---- hash group-by ----------------------------------------------------------
+#
+# The alternative to the dense mixed-radix group space: when the PADDED
+# group space G = prod(tag_cards) * n_buckets dwarfs the number of groups
+# that actually occur (sparse cross products, log-style high-cardinality
+# keys), dense [G] state rows waste HBM, readback bytes and finalize work
+# — and past the planner's max_groups bound the dense path refuses
+# outright.  The hash/sort group-by study (arXiv:2411.13245) is the
+# motivation: neither strategy dominates, the winner flips with group
+# cardinality and duplication, so the engine carries both and a planner
+# pass picks per query.
+
+HASH_EMPTY = -1  # table sentinel; real gids are >= 0
+
+
+def hash_group_slots(
+    table_keys: jnp.ndarray,  # [H] int64, HASH_EMPTY where unoccupied
+    gids: jnp.ndarray,        # [n] int64 raw group ids
+    active: jnp.ndarray,      # [n] bool rows that participate
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Insert-or-find every active row's group id in a linear-probing
+    device hash table; returns (table_keys', slots [n] int32, overflow).
+
+    Deterministic by construction, so a multi-source fold that threads
+    `table_keys` through source after source assigns every gid exactly
+    one slot, stable across the whole query: per probe round, all active
+    rows claim their probe position with a scatter-min (ties broken by
+    smallest gid — data-order independent), winners land, losers advance
+    one position.  Masked rows and overflow rows (table full — the
+    planner sizes H at 2x the distinct estimate, so this means the
+    estimate was badly wrong) report slot == H; `overflow` counts rows
+    that never placed so the caller can rerun on the dense path instead
+    of ever returning a wrong result.
+
+    Cost per round is one [n] scatter-min + one [n] gather; rounds track
+    the longest probe cluster (O(log n) expected at load <= 0.5, so the
+    hard round cap below never binds in a correctly-sized table — it
+    bounds the FULL-table pathology, where unplaceable rows would
+    otherwise probe all H positions before reporting overflow)."""
+    h = table_keys.shape[0]
+    bits = max(int(h).bit_length() - 1, 1)  # h = 2^bits
+    mult = jnp.uint64(0x9E3779B97F4A7C15)
+    h0 = ((gids.astype(jnp.uint64) * mult) >> jnp.uint64(64 - bits)).astype(jnp.int32)
+    h0 = jnp.minimum(h0, jnp.int32(h - 1))
+    maxi = jnp.int64(2**63 - 1)
+    n = gids.shape[0]
+    max_rounds = min(2 * h, 1024)
+
+    def cond(state):
+        _table, _slots, _probe, act, rounds = state
+        return jnp.any(act) & (rounds < max_rounds)
+
+    def body(state):
+        table, slots, probe, act, rounds = state
+        pos = (h0 + probe) & jnp.int32(h - 1)
+        safe_pos = jnp.where(act, pos, 0)
+        claim = jnp.full((h,), maxi, jnp.int64).at[safe_pos].min(
+            jnp.where(act, gids, maxi)
+        )
+        table = jnp.where((table == HASH_EMPTY) & (claim != maxi), claim, table)
+        found = act & (table[pos] == gids)
+        slots = jnp.where(found, pos, slots)
+        act = act & ~found
+        probe = jnp.where(act, probe + 1, probe)
+        return table, slots, probe, act, rounds + 1
+
+    init = (
+        table_keys,
+        jnp.full((n,), h, jnp.int32),
+        jnp.zeros((n,), jnp.int32),
+        active,
+        jnp.int32(0),
+    )
+    table, slots, _probe, act, _rounds = jax.lax.while_loop(cond, body, init)
+    overflow = jnp.sum(act, dtype=jnp.int32)
+    return table, slots, overflow
 
 
 # Fast-path geometry: rows are processed in blocks of BLOCK_ROWS; a block
@@ -436,6 +517,7 @@ def segment_aggregate(
     ts: jnp.ndarray | None = None,
     acc_dtype=jnp.float32,
     span: int = BLOCK_SPAN,
+    force_scatter: bool = False,
 ) -> AggState:
     """Per-shard partial aggregation (the lower/state stage).
 
@@ -469,7 +551,10 @@ def segment_aggregate(
     if mask is None:
         mask = gids < num_groups
     n = values.shape[0]
-    if n < _FAST_MIN_ROWS:
+    if force_scatter or n < _FAST_MIN_ROWS:
+        # force_scatter: hash-strategy callers pass hashed slot ids, which
+        # are unclustered by construction — skip compiling the blocked
+        # branch and its runtime guard entirely
         return _segment_scatter(values, gids, num_groups, aggs, mask, ts, acc_dtype)
 
     g32 = gids.astype(jnp.int32)
@@ -650,6 +735,7 @@ def segment_aggregate_multi(
     base_mask: jnp.ndarray,  # [n] the filter mask before null-gating
     acc_dtype=jnp.float32,
     span: int = BLOCK_SPAN,
+    force_scatter: bool = False,
 ) -> AggState:
     """Multi-column variant of `segment_aggregate`: C value columns share
     ONE layout guard and ONE compiled branch pair (blocked / scatter),
@@ -666,7 +752,7 @@ def segment_aggregate_multi(
     if LAST in aggs:
         raise ValueError("segment_aggregate_multi does not support LAST")
     n = values[0].shape[0]
-    use_fast = n >= _FAST_MIN_ROWS
+    use_fast = n >= _FAST_MIN_ROWS and not force_scatter
     if not use_fast:
         return _stack_states([
             _segment_scatter(v, gids, num_groups, aggs, m, None, acc_dtype)

@@ -37,6 +37,7 @@ from ..ops.aggregate import (
     _FAST_MIN_ROWS,
     AggState,
     finalize,
+    hash_group_slots,
     limb_segment_sums,
     psum_states,
     quantize_limbs,
@@ -99,6 +100,16 @@ class DistGroupByPlan:
     # groups per 4096-row block (e.g. hour buckets over long windows)
     # still take the scatter-free kernel.
     block_span: int = 16
+    # Device group-by strategy (the `agg_strategy` planner pass):
+    # "sort" = the dense mixed-radix path above (states are [G], the
+    # (pk, ts) sort makes the blocked kernel engage);
+    # "hash" = group ids hash into a `hash_slots`-sized device table
+    # (ops/aggregate.hash_group_slots) threaded through every source of
+    # the query, states are [hash_slots + 1] and the host decodes slot ->
+    # group key from the table — the dense [G] space never materializes,
+    # so group spaces far past max_groups stay executable.
+    agg_strategy: str = "sort"
+    hash_slots: int = 0
 
     @property
     def num_groups(self) -> int:
@@ -217,7 +228,7 @@ def _apply_filters(plan: DistGroupByPlan, columns, mask, values=None):
     return mask
 
 
-def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=None, perm=None, count_cols=None, limbs=None):
+def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=None, perm=None, count_cols=None, limbs=None, hash_table=None):
     """Shared lower/state stage: mask -> group ids -> partial AggStates.
     No collectives — callers merge across devices (psum) or across tile
     sources (merge_states).  `dyn` optionally carries runtime-dynamic plan
@@ -238,7 +249,17 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
     matmul for ALL such columns instead of a per-column VPU pass; min/max/
     last keep the f64 blocked kernels.  `limbs` optionally supplies cached
     quantized planes per column (dict col -> (limbs, scale)); missing
-    columns quantize in-program from their f64 plane."""
+    columns quantize in-program from their f64 plane.
+
+    With `plan.agg_strategy == "hash"` the caller must pass `hash_table`
+    (the [hash_slots] int64 key table threaded across this query's
+    sources) and gets back `(states, hash_table')`: group ids are
+    composed in int64 (the sparse space may exceed int32), hashed to
+    compact slots, and every kernel aggregates into [hash_slots + 1]
+    scatter-space — the dense [G] never exists on device.  States carry
+    an extra `__hash_overflow` row counting rows the table could not
+    place (sum-merged across sources) so the executor can fall back to
+    the dense path instead of ever returning a wrong result."""
     acc = jnp.float32 if plan.acc_dtype == "float32" else jnp.float64
     if perm is not None:
         columns = {k: v[perm] for k, v in columns.items()}
@@ -268,14 +289,29 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
         interval = plan.bucket_interval if dyn is None else dyn["bucket_interval"]
         b = time_bucket(columns[plan.bucket_col], origin, interval)
         components.append((b, plan.n_buckets))
-    n_internal = plan.internal_groups
-    # raw in-range ids + mask (NOT overflow-encoded): keeps scan-order
-    # sortedness intact so segment_aggregate's block kernel can engage.
-    # Tail padding rows (valid=False) get the max id so they don't break
-    # the ascending-order guard; their mask keeps them out of every sum.
-    gids, in_range = raw_group_ids(components, shape=valid.shape)
-    mask = mask & in_range
-    gids = jnp.where(valid, gids, n_internal - 1)
+    is_hash = plan.agg_strategy == "hash"
+    overflow = None
+    if is_hash:
+        if hash_table is None:
+            raise ValueError("hash agg strategy requires the threaded hash_table")
+        # int64 ids: the SPARSE space may exceed int32 — it never
+        # materializes, only its occupied keys do (one per table slot)
+        gid64, in_range = raw_group_ids(
+            components, shape=valid.shape, dtype=jnp.int64
+        )
+        active = mask & in_range
+        hash_table, gids, overflow = hash_group_slots(hash_table, gid64, active)
+        mask = active
+        n_internal = plan.hash_slots
+    else:
+        n_internal = plan.internal_groups
+        # raw in-range ids + mask (NOT overflow-encoded): keeps scan-order
+        # sortedness intact so segment_aggregate's block kernel can engage.
+        # Tail padding rows (valid=False) get the max id so they don't break
+        # the ascending-order guard; their mask keeps them out of every sum.
+        gids, in_range = raw_group_ids(components, shape=valid.shape)
+        mask = mask & in_range
+        gids = jnp.where(valid, gids, n_internal - 1)
 
     ts = None
     if plan.ts_col is not None and plan.ts_col in columns:
@@ -330,6 +366,7 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
             states[col] = fold(segment_aggregate(
                 columns[col], gids, n_internal, key,
                 mask=col_mask, ts=ts, acc_dtype=acc, span=plan.block_span,
+                force_scatter=is_hash,
             ))
             continue
         # Count-pass sharing: for a column with NO null mask, its count
@@ -399,7 +436,7 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
         ]
         multi = segment_aggregate_multi(
             vals, gids, n_internal, key, col_masks, mask, acc_dtype=acc,
-            span=plan.block_span,
+            span=plan.block_span, force_scatter=is_hash,
         )
         for i, c in enumerate(cols):
             states[c] = fold(AggState(
@@ -453,6 +490,11 @@ def compute_partial_states(plan: DistGroupByPlan, columns, valid, nulls, dyn=Non
         states["__presence"] = fold(AggState(counts=lpresence))
     elif presence_from is not None:
         states["__presence"] = AggState(counts=states[presence_from].counts)
+    if is_hash:
+        # sum-merges across sources like any count; > 0 after the final
+        # merge means some row never found a slot -> dense-path rerun
+        states["__hash_overflow"] = AggState(counts=overflow.reshape(1))
+        return states, hash_table
     return states
 
 

@@ -84,7 +84,7 @@ from ..ops.tiles import padded_size
 from ..storage.dictionary import TableDictionary
 from ..storage.region import OP_COL, Region
 from ..storage.sst import FileMeta
-from ..query import passes
+from ..query import analyze, passes
 from ..utils import metrics
 from ..utils.deadline import check_deadline, current_deadline
 from ..utils.errors import QueryTimeoutError
@@ -103,6 +103,12 @@ from .executor import (
 # Max rows per device chunk: one chunk's kernel working set fits HBM
 # comfortably even for 10-column programs (see _SuperTiles.cols).
 TILE_CHUNK_ROWS = 1 << 24
+# Hash-strategy gids are int64 mixed-radix composites; past this padded
+# group-space size the composition would WRAP and silently alias distinct
+# groups (the dense path is protected by max_groups, the hash path needs
+# its own ceiling).  Margin below 2^63 keeps every intermediate
+# `gid * card + c` in range too.
+_HASH_GID_LIMIT = 1 << 62
 
 # ---- flow-maintenance attribution ------------------------------------------
 # Dirty-window flow recompute (flow/dataflow.py) drives its per-window
@@ -2290,10 +2296,28 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
     O(rows_out) readback contract.  Compact results skip the f32/uint8
     byte packing (they are small; f64 keeps them bit-identical to the
     host path on the same aggregates).
+    With `plan.agg_strategy == "hash"` the program carries a
+    [hash_slots] int64 key table through the per-source fold
+    (ops/aggregate.hash_group_slots assigns each gid one stable slot
+    across ALL sources), every state row is [hash_slots]-sized, and the
+    fetch ships (buf, accs64, table_keys) — the host decodes slot ->
+    group key from the table, so the dense [G] space never exists on
+    device OR on the wire.  An overflow byte rides the flat buffer like
+    the limb verdict: 1 means some row never found a slot and the caller
+    must rerun on the dense path (never a wrong result).
+
     Returns (fn, int_layout, acc32_layout, acc64_layout, int_dtype)."""
     per_col_aggs: dict[str, set] = {}
     for func, col in plan.agg_specs:
         per_col_aggs.setdefault(col, set()).add(_FUNC_TO_KERNEL[func])
+    is_hash = plan.agg_strategy == "hash"
+    # spec (device finalize) and hash are mutually exclusive by planner
+    # construction: hash results are already compact (O(slots)), and the
+    # host replay owns Sort/LIMIT/HAVING for them
+    assert spec is None or not is_hash
+    # byte-packing keys off the LOGICAL group space for BOTH strategies,
+    # so hash and sort ship identical value precision (f32 avgs, uint8
+    # presence bits) and stay bit-comparable end to end
     pack_bytes = plan.num_groups >= 1 << 14 and spec is None
     int_layout: list[tuple[str, str]] = [("__presence", "count")]
     acc32_layout: list[tuple[str, str]] = []
@@ -2334,7 +2358,11 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
         static_argnames=(),
     )
 
-    def _partial(cols, valid, nulls, dyn, perm, limbs):
+    def _partial(cols, valid, nulls, dyn, perm, limbs, hash_table=None):
+        if is_hash:
+            return partial_jit(
+                cols, valid, nulls, dyn, perm, limbs=limbs, hash_table=hash_table
+            )
         return partial_jit(cols, valid, nulls, dyn, perm, limbs=limbs)
 
     merge_jit = jax.jit(
@@ -2390,7 +2418,7 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
             order_keys.append((v, isn, asc, nulls_first))
         return topk_group_select(mask, order_keys, spec.cap)
 
-    def _final(merged, hv):
+    def _final(merged, hv, table_keys=None):
         presence = merged["__presence"].counts
         outs = {"__presence": {"count": presence}}
         for col, aggs in per_col_aggs.items():
@@ -2455,6 +2483,12 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
                     err <= jnp.maximum(jnp.abs(s) * 1e-7, 1e-12)
                 )
             flat.append(ok.astype(jnp.uint8).reshape(1))
+        if is_hash:
+            # trailing verdict byte, like the limb bound: 0 = clean,
+            # 1 = some row never placed -> caller reruns dense
+            flat.append(
+                (merged["__hash_overflow"].counts > 0).astype(jnp.uint8).reshape(1)
+            )
         buf = jnp.concatenate(flat) if len(flat) > 1 else flat[0]
         out_g = spec.cap if spec is not None else presence.shape[0]
         if acc64_layout:
@@ -2463,6 +2497,8 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
             )
         else:
             accs64 = jnp.zeros((0, out_g), jnp.float64)
+        if is_hash:
+            return buf, accs64, table_keys
         return buf, accs64
 
     final_jit = jax.jit(_final)
@@ -2488,9 +2524,29 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
         }
         merged = None
         target = None
+        table_keys = None
+        if is_hash:
+            from ..ops.aggregate import HASH_EMPTY
+
+            table_keys = jnp.full((plan.hash_slots,), HASH_EMPTY, jnp.int64)
         for cols, valid, nulls, perm, limbs in sources:
             check_deadline()  # one dispatch per chunk source
-            states = _partial(cols, valid, nulls, pdyn, perm, limbs)
+            if is_hash:
+                # the key table follows the chunk (jit inputs must share a
+                # device); the [H] hop is tiny next to the chunk planes
+                in_leaves = jax.tree_util.tree_leaves((cols, valid))
+                src_dev = (
+                    next(iter(in_leaves[0].devices()))
+                    if in_leaves and hasattr(in_leaves[0], "devices")
+                    else None
+                )
+                if src_dev is not None:
+                    table_keys = jax.device_put(table_keys, src_dev)
+                states, table_keys = _partial(
+                    cols, valid, nulls, pdyn, perm, limbs, hash_table=table_keys
+                )
+            else:
+                states = _partial(cols, valid, nulls, pdyn, perm, limbs)
             leaves = jax.tree_util.tree_leaves(states)
             dev = next(iter(leaves[0].devices())) if leaves else None
             if merged is None:
@@ -2503,7 +2559,9 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
                 jax.block_until_ready(jax.tree_util.tree_leaves(merged))
         if merged is None:
             raise ValueError("tile program received no sources")
-        return final_jit(merged, hv)
+        if is_hash and target is not None:
+            table_keys = jax.device_put(table_keys, target)
+        return final_jit(merged, hv, table_keys)
 
     # shape-metadata precompile hook (pipelined cold path): the executor
     # lowers+compiles this jit from ShapeDtypeStructs in the background
@@ -2903,8 +2961,18 @@ class TileExecutor:
             and not lowering.group_tags
             and layout_probe is None  # same probe _try_execute computed
         )
+        # agg-strategy probe runs BEFORE limb decisions: a hash plan
+        # accumulates exact f64, so its value columns must keep their f64
+        # plane uploads (skipping them would strand the query)
+        agg_probe = self._choose_agg_strategy(
+            lowering, schema, scan, ctx, tag_cols, time_bounds
+        )
         limb_skip_upload: set[str] = set()
-        if self.config_acc_dtype() == "limb" and not time_major_probe:
+        if (
+            self.config_acc_dtype() == "limb"
+            and not time_major_probe
+            and agg_probe is None
+        ):
             for c, funcs in per_col_funcs.items():
                 if (
                     funcs & {"sum", "avg"}
@@ -2917,7 +2985,13 @@ class TileExecutor:
         has_sum_avg = any(
             funcs & {"sum", "avg"} for funcs in per_col_funcs.values()
         )
-        if self.config_acc_dtype() == "limb" and has_sum_avg:
+        if agg_probe is not None and has_sum_avg:
+            passes.note(
+                "limb_quantize", False,
+                "hash agg strategy accumulates exact f64 (hashed slot ids "
+                "defeat the limb block geometry)",
+            )
+        elif self.config_acc_dtype() == "limb" and has_sum_avg:
             passes.note(
                 "limb_quantize", True,
                 "sum/avg accumulate via MXU fixed-point limb matmuls",
@@ -3033,15 +3107,23 @@ class TileExecutor:
         # its runtime-dynamic parameters (filter literals, bucket
         # geometry) — changing a literal or window reuses the compile
         built = self._build_plan(
-            lowering, schema, scan, ctx, tag_cols, time_bounds, use_ts
+            lowering, schema, scan, ctx, tag_cols, time_bounds, use_ts,
+            agg_probe=agg_probe,
         )
         if built is None:
             return None
         plan, dyn_host, fspec = built
-        if plan.num_groups > self.config.max_groups * 64:
-            return None  # group space too large for dense [G] states
-        if plan.internal_groups > self.config.max_internal_groups:
-            return None
+        if plan.agg_strategy == "hash":
+            # the dense [G] space never materializes — only the slot
+            # table must fit, and _size_hash_slots already clamps it to
+            # the internal-groups bound (this is what lets group spaces
+            # past max_groups stay on the device path at all)
+            pass
+        else:
+            if plan.num_groups > self.config.max_groups * 64:
+                return None  # group space too large for dense [G] states
+            if plan.internal_groups > self.config.max_internal_groups:
+                return None
 
         # 4. phase B — dictionary is final for this query: repair stale
         # device tiles with one gather, build perms, encode memtail
@@ -3054,7 +3136,10 @@ class TileExecutor:
         # serves these through its inverted index + page pruning; here the
         # sorted encode cache plays that role.
         host_table = None
-        hfp_enabled = passes.enabled("host_fast_path", self.config)
+        dense_host_ok = plan.num_groups <= self.config.max_groups * 64
+        hfp_enabled = (
+            passes.enabled("host_fast_path", self.config) and dense_host_ok
+        )
         if hfp_enabled:
             host_table = self._host_execute(
                 plan, dyn_host, super_entries,
@@ -3078,12 +3163,16 @@ class TileExecutor:
         )
 
         # 4.6 cold grouped serve: device planes not built yet -> answer
-        # from the host consolidation (no uploads), once per entry
-        cold_table = self._host_cold_grouped(
-            plan, dyn_host, super_entries,
-            [s for s in slots if not isinstance(s, _SuperTiles)],
-            ctx, use_ts, value_cols, all_tag_cols, dedup_regions, window,
-        )
+        # from the host consolidation (no uploads), once per entry.
+        # Gated on the dense group bound: the host fold materializes [G]
+        # numpy states, which a hash-scale group space would blow up.
+        cold_table = None
+        if dense_host_ok:
+            cold_table = self._host_cold_grouped(
+                plan, dyn_host, super_entries,
+                [s for s in slots if not isinstance(s, _SuperTiles)],
+                ctx, use_ts, value_cols, all_tag_cols, dedup_regions, window,
+            )
         if cold_table is not None:
             metrics.TILE_LOWERED_TOTAL.inc()
             passes.note(
@@ -3101,6 +3190,8 @@ class TileExecutor:
         # serializing encode -> upload -> compile
         if (
             super_entries
+            and plan.agg_strategy != "hash"  # hash partials thread the
+            # key table; shape-only precompile doesn't model it
             and self.cache._tile_opt("pipelined_build", True)
             and passes.enabled("pipelined_build", self.config)
         ):
@@ -3268,10 +3359,40 @@ class TileExecutor:
             chunks=len(device_sources), devices=ndev,
         )
         metrics.TILE_LOWERED_TOTAL.inc()
+        metrics.AGG_STRATEGY_TOTAL.inc(strategy=plan.agg_strategy)
+        if plan.agg_strategy == "hash":
+            passes.note(
+                "agg_strategy", True, agg_probe["why"],
+                slots=plan.hash_slots, groups=plan.num_groups,
+                distinct_est=agg_probe["d_est"], stats=agg_probe["stats_src"],
+            )
+            analyze.record(
+                "agg_strategy", strategy="hash", slots=plan.hash_slots,
+                dense_groups=plan.num_groups,
+            )
+        elif tag_cols:
+            analyze.record(
+                "agg_strategy", strategy="sort", dense_groups=plan.num_groups
+            )
         # first pass normally runs the MXU limb kernel; when its per-group
         # error bound fails the verdict (mixed-magnitude data sharing
-        # blocks), rerun the same sources with exact f64 accumulation
-        for attempt_plan in (plan, dataclasses.replace(plan, acc_dtype="float64")):
+        # blocks), rerun the same sources with exact f64 accumulation.
+        # A hash plan's rerun rung is the DENSE plan instead (slot-table
+        # overflow = the distinct estimate was badly low) — and only when
+        # the dense bounds allow it; otherwise the scan path owns it.
+        if plan.agg_strategy == "hash":
+            attempts = [plan]
+            dense = dataclasses.replace(
+                plan, agg_strategy="sort", hash_slots=0, acc_dtype="float64"
+            )
+            if (
+                dense.num_groups <= self.config.max_groups * 64
+                and dense.internal_groups <= self.config.max_internal_groups
+            ):
+                attempts.append(dense)
+        else:
+            attempts = [plan, dataclasses.replace(plan, acc_dtype="float64")]
+        for attempt_plan in attempts:
             program, int_layout, acc32_layout, acc64_layout, int_dtype = (
                 _tile_program_cached(attempt_plan, nullable_cols, fspec)
             )
@@ -3310,7 +3431,9 @@ class TileExecutor:
                 )
             if table is not None:
                 return table
-        return None  # unreachable: the f64 pass never fails the verdict
+        # reachable only for a hash plan whose slot table overflowed AND
+        # whose dense twin exceeds the [G] bounds: the scan path owns it
+        return None
 
     def _precompile_async(
         self, plan, fspec, entry, dyn_host, tag_like, ts_name, skip_f64,
@@ -3461,6 +3584,12 @@ class TileExecutor:
         if built is None:
             return None
         plan, dyn_host, fspec = built
+        if tag_cols:
+            passes.note(
+                "agg_strategy", False,
+                "region-streamed execution keeps dense [G] states (the "
+                "per-region release cycle owns HBM already)",
+            )
         if plan.time_major:
             # time-major copies double a region's planes and the
             # permutation build is per-entry; bucket-only group-bys at
@@ -3614,6 +3743,7 @@ class TileExecutor:
                 )
                 metrics.TILE_STREAM_QUERIES.inc()
                 metrics.TILE_LOWERED_TOTAL.inc()
+                metrics.AGG_STRATEGY_TOTAL.inc(strategy="sort")
             table = self._finalize(
                 packed, int_layout, acc32_layout, acc64_layout, int_dtype,
                 attempt_plan, lowering, schema, ctx, dyn_host, fspec,
@@ -3682,14 +3812,9 @@ class TileExecutor:
         v[:n] = True
         return (out_cols, jnp.asarray(v), out_nulls)
 
-    def _build_plan(self, lowering, schema, scan, ctx, tag_cols, time_bounds, use_ts):
-        """Returns (plan, dyn_host): `plan` is the compile-static structure
-        (filter literals replaced by placeholders, n_buckets quantized to a
-        power of two) and `dyn_host` carries the runtime values — so
-        dashboards that vary literals or time windows reuse one compile.
-        Also decides the LAYOUT strategy (direct / hierarchical /
-        time-major) from the primary-key order — see module docstring."""
-        d = ctx.dictionary
+    def _bucket_geometry(self, lowering, schema, scan, time_bounds):
+        """(bucket_col, interval_native, origin, n_buckets_real, n_buckets)
+        shared by the plan builder and the agg-strategy probe."""
         if lowering.bucket is not None:
             ts_col, interval, origin_hint = lowering.bucket
             if scan.time_range is not None and scan.time_range[0] > -(1 << 61) and scan.time_range[1] < (1 << 61):
@@ -3702,10 +3827,147 @@ class TileExecutor:
             origin = origin_hint + ((lo - origin_hint) // interval_native) * interval_native
             n_buckets_real = max(int((hi - origin + interval_native - 1) // interval_native), 1)
             n_buckets = _quantize_soft(n_buckets_real)
-            bucket_col = ts_col
-        else:
-            bucket_col, interval_native, origin, n_buckets = None, 1, 0, 1
-            n_buckets_real = 1
+            return ts_col, interval_native, origin, n_buckets_real, n_buckets
+        return None, 1, 0, 1, 1
+
+    def _size_hash_slots(self, d_est: int) -> int:
+        """Slot-table size for a distinct-key estimate: next power of two
+        past 2x (load factor <= 0.5), floored at 1024, capped at the
+        internal-groups bound.  ONE implementation for the choose-time
+        probe and the plan builder — a drifting headroom factor between
+        them would desynchronize estimate from runtime table size.
+
+        The result must stay a power of two: hash_group_slots addresses
+        with `& (H - 1)`, and a non-pow2 H would strand most slots and
+        overflow every dispatch — so a non-pow2 max_internal_groups knob
+        clamps DOWN to its largest contained power of two."""
+        cap = max(int(self.config.max_internal_groups), 1 << 10)
+        cap = 1 << (cap.bit_length() - 1)  # largest pow2 <= cap
+        slots = 1 << 10
+        while slots < 2 * d_est and slots < cap:
+            slots <<= 1
+        return min(slots, cap)
+
+    def _choose_agg_strategy(
+        self, lowering, schema, scan, ctx, tag_cols, time_bounds,
+        region_sources=None,
+    ):
+        """Pick hash vs sort BEFORE the plan is built, from table stats:
+        per-tag distinct estimates (dictionary cardinality when warm, the
+        segmented term index's per-file term counts when cold) against
+        the padded dense group space.  The hash/sort winner flips with
+        group cardinality (arXiv:2411.13245): dense [G] states win while
+        G is small and the (pk, ts) sort feeds the blocked kernel; a
+        slot table sized to the DISTINCT keys wins when G is sparse —
+        and is the only option once G exceeds the dense-path bound.
+
+        Runs early because the decision gates limb-plane uploads (hash
+        accumulates exact f64); returns a dict consumed by _build_plan,
+        or None meaning "sort, the pre-hash path"."""
+        knob = getattr(self.config, "agg_strategy", "auto")
+        enabled = passes.enabled("agg_strategy", self.config)
+        has_last = any(f == "last_value" for f, _c in lowering.agg_specs)
+        why_sort = None
+        if not enabled:
+            why_sort = "pass disabled"
+        elif knob == "sort":
+            why_sort = "query.agg_strategy=sort forces the dense path"
+        elif not tag_cols:
+            why_sort = "bucket-only group-by: dense space is one axis, trivially small"
+        elif has_last:
+            why_sort = "last_value needs the ts-ordered dense kernels"
+        if why_sort is not None:
+            passes.note("agg_strategy", False, why_sort)
+            return None
+        d = ctx.dictionary
+        est_rows = max(sum(r.approx_rows() for r in ctx.regions), 1)
+        _bc, _iv, _orig, n_buckets_real, n_buckets = self._bucket_geometry(
+            lowering, schema, scan, time_bounds
+        )
+        d_prod = 1
+        g_est = n_buckets
+        src = "dictionary"
+        for t in tag_cols:
+            card = d.cardinality(t)
+            if card <= 0:
+                # cold start: the dictionary has not encoded this column
+                # yet — ask the segmented term index metas (one small
+                # ranged read per file, cached)
+                for region in ctx.regions:
+                    n = region.distinct_estimate(t)
+                    if n:
+                        card = max(card, n)
+                        src = "term_index"
+                card = max(card, 1)
+            d_prod *= card
+            g_est *= _quantize_card(card)
+        if g_est >= _HASH_GID_LIMIT:
+            # the mixed-radix gid must fit int64: past this the composed
+            # ids would WRAP and alias distinct groups into one slot with
+            # no overflow verdict — decline (the scan path owns it)
+            passes.note(
+                "agg_strategy", False,
+                f"padded group space {g_est} exceeds the int64 gid range: "
+                "neither strategy can address it; scan path owns the query",
+            )
+            return None
+        d_est = min(est_rows, d_prod * max(n_buckets_real, 1))
+        slots = self._size_hash_slots(d_est)
+        if slots < 2 * d_est and knob != "hash":
+            # the cap clamped the table below 2x the distinct estimate:
+            # overflow is likely and the dispatch would be wasted work —
+            # auto declines upfront (forced hash proceeds: the estimate
+            # is an upper bound and the overflow verdict stays the net)
+            passes.note(
+                "agg_strategy", False,
+                f"~{d_est} distinct keys exceed half the {slots}-slot cap "
+                "(query.max_internal_groups): hash would overflow, dense/"
+                "scan paths own the query",
+            )
+            return None
+        info = {
+            "strategy": "hash",
+            "slots": slots,
+            "d_est": int(d_est),
+            "g_est": int(g_est),
+            "stats_src": src,
+        }
+        if knob == "hash":
+            info["why"] = (
+                f"query.agg_strategy=hash forced: ~{d_est} distinct keys "
+                f"into {slots} slots (dense space {g_est})"
+            )
+            return info
+        min_space = int(getattr(self.config, "agg_hash_min_group_space", 1 << 16))
+        if g_est >= min_space and d_est * 4 <= g_est:
+            info["why"] = (
+                f"sparse group space: ~{d_est} distinct keys ({src}) vs "
+                f"{g_est} dense groups -> {slots}-slot hash table"
+            )
+            return info
+        passes.note(
+            "agg_strategy", False,
+            f"dense space {g_est} is small or well-filled (~{d_est} "
+            "distinct keys): sorted dense states win",
+            groups=int(g_est), distinct_est=int(d_est),
+        )
+        return None
+
+    def _build_plan(self, lowering, schema, scan, ctx, tag_cols, time_bounds, use_ts,
+                    agg_probe=None):
+        """Returns (plan, dyn_host): `plan` is the compile-static structure
+        (filter literals replaced by placeholders, n_buckets quantized to a
+        power of two) and `dyn_host` carries the runtime values — so
+        dashboards that vary literals or time windows reuse one compile.
+        Also decides the LAYOUT strategy (direct / hierarchical /
+        time-major) from the primary-key order — see module docstring.
+        `agg_probe` (a _choose_agg_strategy result) switches the plan to
+        the hash group-by: no layout fold, no time-major, exact f64
+        accumulation, slot table re-sized from the now-final dictionary."""
+        d = ctx.dictionary
+        bucket_col, interval_native, origin, n_buckets_real, n_buckets = (
+            self._bucket_geometry(lowering, schema, scan, time_bounds)
+        )
 
         # filters: tag values -> sorted codes (order-preserving, so even
         # inequalities translate); time range -> explicit ts filters.
@@ -3772,9 +4034,13 @@ class TileExecutor:
 
         # layout strategy
         pk = [c.name for c in schema.tag_columns()]
-        layout_tags = _choose_layout(pk, tag_cols, bucket_col is not None)
+        is_hash = agg_probe is not None and agg_probe.get("strategy") == "hash"
+        layout_tags = (
+            None if is_hash else _choose_layout(pk, tag_cols, bucket_col is not None)
+        )
         time_major = (
-            bucket_col is not None
+            not is_hash
+            and bucket_col is not None
             and not tag_cols
             and layout_tags is None
             and passes.enabled("time_major", self.config)
@@ -3836,6 +4102,29 @@ class TileExecutor:
         while block_span < min(span_est, 128):
             block_span <<= 1
 
+        acc_dtype = self.config_acc_dtype()
+        hash_slots = 0
+        if is_hash:
+            # the dictionary is FINAL here (every encode ran), so re-check
+            # the gid range (cards can GROW between probe and build) and
+            # re-size the slot table from exact per-tag distinct counts
+            # with 2x headroom (load factor <= 0.5) against co-occurrence
+            # we cannot know without scanning
+            g_final = max(n_buckets, 1)
+            d_prod = 1
+            for t in tag_cols:
+                card = max(d.cardinality(t), 1)
+                d_prod *= card
+                g_final *= _quantize_card(card)
+            if g_final >= _HASH_GID_LIMIT:
+                return None  # int64 gids would wrap: scan path owns it
+            d_est = min(max(est_rows, 1), d_prod * max(n_buckets_real, 1))
+            hash_slots = self._size_hash_slots(d_est)
+            # hash accumulates exact f64: slot ids defeat both the limb
+            # kernel's block geometry and the blocked guard, and the MXU
+            # batch would only hit its scatter fallback anyway
+            if acc_dtype == "limb":
+                acc_dtype = "float64" if jax.config.jax_enable_x64 else "float32"
         plan = DistGroupByPlan(
             group_tags=tuple(tag_cols),
             tag_cards=tuple(_quantize_card(d.cardinality(t)) for t in tag_cols),
@@ -3845,7 +4134,7 @@ class TileExecutor:
             n_buckets=n_buckets,
             agg_specs=tuple(norm_specs),
             filters=tuple(enc_filters),
-            acc_dtype=self.config_acc_dtype(),
+            acc_dtype=acc_dtype,
             ts_col=use_ts if needs_ts_order else None,
             filter_null_cols=filter_null_cols,
             layout_tags=None if layout_tags is None else tuple(layout_tags),
@@ -3854,6 +4143,8 @@ class TileExecutor:
             else tuple(_quantize_card(d.cardinality(t)) for t in layout_tags),
             time_major=time_major,
             block_span=block_span,
+            agg_strategy="hash" if is_hash else "sort",
+            hash_slots=hash_slots,
         )
         dyn_host = {
             "filter_values": filter_vals,
@@ -3861,6 +4152,15 @@ class TileExecutor:
             "bucket_interval": interval_native,
             "having_values": (),
         }
+        if is_hash:
+            # hash results are already compact (O(slots) fetch + host
+            # slot->key decode); Sort/LIMIT/HAVING replay on host
+            passes.note(
+                "device_finalize", False,
+                "hash agg strategy ships compact slots; host post-ops own "
+                "Sort/LIMIT/HAVING",
+            )
+            return plan, dyn_host, None
         spec = self._plan_device_finalize(
             lowering, schema, ctx, plan, dyn_host, n_buckets_real
         )
@@ -4513,9 +4813,9 @@ class TileExecutor:
                 "overlapped with the host copy",
                 bytes=total,
             )
-            return np.asarray(out[0]), np.asarray(out[1])
-        buf, accs64 = jax.device_get(packed)
-        return np.asarray(buf), np.asarray(accs64)
+            return tuple(np.asarray(p) for p in out)
+        got = jax.device_get(packed)
+        return tuple(np.asarray(p) for p in got)
 
     def _finalize(
         self, packed, int_layout, acc32_layout, acc64_layout, int_dtype,
@@ -4526,12 +4826,15 @@ class TileExecutor:
         # streamed-readback wins stay attributable (the combined
         # readback_ms conflates link time with waiting out the dispatch)
         t0 = time.perf_counter()
-        buf, accs64 = self._fetch_result(packed)
+        fetched = self._fetch_result(packed)
+        buf, accs64 = fetched[0], fetched[1]
+        # hash strategy ships the slot->gid key table as a third part
+        table_keys = fetched[2] if len(fetched) > 2 else None
         ms = (time.perf_counter() - t0) * 1000.0
         metrics.TILE_READBACK_MS.observe(ms)
         metrics.TPU_READBACK_MS.observe(ms)
         metrics.TPU_READBACK_TRANSFER_MS.observe(ms)
-        metrics.TPU_READBACK_BYTES.inc(buf.nbytes + accs64.nbytes)
+        metrics.TPU_READBACK_BYTES.inc(sum(p.nbytes for p in fetched))
         metrics.TPU_DEVICE_FETCHES.inc()
         self._rb_local.transfer_ms = ms
         t_dec = time.perf_counter()
@@ -4539,6 +4842,7 @@ class TileExecutor:
             return self._decode_result(
                 buf, accs64, int_layout, acc32_layout, acc64_layout,
                 int_dtype, plan, lowering, ctx, dyn_host, spec,
+                table_keys=table_keys,
             )
         finally:
             dec_ms = (time.perf_counter() - t_dec) * 1000.0
@@ -4547,8 +4851,14 @@ class TileExecutor:
 
     def _decode_result(
         self, buf, accs64, int_layout, acc32_layout, acc64_layout,
-        int_dtype, plan, lowering, ctx, dyn_host, spec,
+        int_dtype, plan, lowering, ctx, dyn_host, spec, table_keys=None,
     ):
+        is_hash = plan.agg_strategy == "hash"
+        if is_hash and buf[-1] != 0:
+            # slot-table overflow: the distinct-key estimate was badly
+            # low; the caller reruns on the dense path (never wrong)
+            metrics.AGG_HASH_OVERFLOW.inc()
+            return None
         if plan.acc_dtype == "limb" and self._limb_sum_cols(plan):
             if buf[-1] == 0:
                 # quantization-error bound exceeded 1e-7 of some group's
@@ -4556,7 +4866,12 @@ class TileExecutor:
                 # rerun with exact f64 accumulation
                 metrics.TILE_LIMB_RERUNS.inc()
                 return None
-        g = spec.cap if spec is not None else plan.num_groups
+        if spec is not None:
+            g = spec.cap
+        elif is_hash:
+            g = plan.hash_slots
+        else:
+            g = plan.num_groups
         bit_packed = int_dtype == jnp.uint8
         int_row = -(-g // 8) if bit_packed else g
         ni = len(int_layout)
@@ -4603,7 +4918,77 @@ class TileExecutor:
                 fetched_bytes=buf.nbytes + accs64.nbytes,
             )
             return table
+        if is_hash:
+            return self._assemble_hash_result(
+                finals, plan, ctx, dyn_host, table_keys
+            )
         return self._assemble_result(finals, plan, ctx, dyn_host)
+
+    def _group_key_columns(self, plan, ctx, dyn_host, gids) -> dict:
+        """gid vector -> ordered {tag..., bucket} output columns: the
+        mixed-radix decode shared by every compact assembly (identical to
+        GroupByResult.to_table's, so all paths agree byte-for-byte)."""
+        cols: dict[str, object] = {}
+        dims: list[tuple[str, int]] = list(zip(plan.group_tags, plan.tag_cards))
+        if plan.bucket_col is not None:
+            dims.append(("__bucket", plan.n_buckets))
+        decoded = {}
+        div = 1
+        for name, card in reversed(dims):
+            decoded[name] = (gids // div) % card
+            div *= card
+        for tag in plan.group_tags:
+            values = ctx.dictionary.values(tag)
+            codes = decoded[tag]
+            cols[tag] = [values[c] if c < len(values) else None for c in codes]
+        if plan.bucket_col is not None:
+            cols[plan.bucket_col] = (
+                dyn_host["bucket_origin"]
+                + decoded["__bucket"].astype(np.int64) * dyn_host["bucket_interval"]
+            )
+        return cols
+
+    @staticmethod
+    def _append_agg_columns(cols, finals, plan, indexer):
+        """Append the per-agg-spec output columns, rows taken via
+        `indexer` (a slice or fancy index into the finalized buffers) —
+        ONE copy of the count-sharing/NULL-gating/naming contract the
+        compact and hash assemblies must keep in lockstep."""
+        presence = finals["__presence"]["count"]
+        for func, col in plan.agg_specs:
+            out = finals.get(col, {})
+            kernel = _FUNC_TO_KERNEL[func]
+            arr = out.get(kernel)
+            if arr is None and kernel == "count":
+                arr = presence  # count-pass sharing: presence IS the count
+            arr = np.asarray(arr)[indexer]
+            col_count = np.asarray(out.get("count", presence))[indexer]
+            if col == COUNT_STAR:
+                cols["count(*)"] = pa.array(arr.astype(np.int64))
+            elif func == "count":
+                cols[f"count({col})"] = pa.array(arr.astype(np.int64))
+            else:
+                vals = np.where(col_count > 0, arr, np.nan)
+                cols[f"{func}({col})"] = pa.array(vals, mask=np.isnan(vals))
+        return cols
+
+    def _assemble_hash_result(self, finals, plan, ctx, dyn_host, table_keys):
+        """[K, hash_slots] buffers + the slot->gid key table -> SQL rows.
+
+        Bit-for-bit twin of the dense `_assemble_result` + to_table pair:
+        occupied slots are ordered by their group id ASCENDING (exactly
+        the order the dense path's nonzero scan over [G] produces), tags
+        and buckets decode from the gid with the same mixed radix, and
+        NULL gating/naming are shared verbatim — the only difference is
+        that empty groups never existed to be skipped."""
+        keys = np.asarray(table_keys, dtype=np.int64)
+        presence = np.asarray(finals["__presence"]["count"])
+        occ = (keys >= 0) & (presence[: keys.shape[0]] > 0)
+        slot_idx = np.nonzero(occ)[0]
+        order = np.argsort(keys[slot_idx], kind="stable")
+        slots = slot_idx[order]
+        cols = self._group_key_columns(plan, ctx, dyn_host, keys[slots])
+        return pa.table(self._append_agg_columns(cols, finals, plan, slots))
 
     def _assemble_compact(
         self, finals, plan, ctx, dyn_host, sel, n_out, spec
@@ -4620,45 +5005,8 @@ class TileExecutor:
             stop = min(start + spec.limit, rows_avail)
         sl = slice(start, stop)
         idx = np.asarray(sel[sl], np.int64)
-        cols: dict[str, object] = {}
-        dims: list[tuple[str, int]] = list(
-            zip(plan.group_tags, plan.tag_cards)
-        )
-        if plan.bucket_col is not None:
-            dims.append(("__bucket", plan.n_buckets))
-        decoded = {}
-        div = 1
-        for name, card in reversed(dims):
-            decoded[name] = (idx // div) % card
-            div *= card
-        for tag in plan.group_tags:
-            values = ctx.dictionary.values(tag)
-            codes = decoded[tag]
-            cols[tag] = [
-                values[c] if c < len(values) else None for c in codes
-            ]
-        if plan.bucket_col is not None:
-            origin = dyn_host["bucket_origin"]
-            interval = dyn_host["bucket_interval"]
-            cols[plan.bucket_col] = (
-                origin + decoded["__bucket"].astype(np.int64) * interval
-            )
-        for func, col in plan.agg_specs:
-            out = finals.get(col, {})
-            kernel = _FUNC_TO_KERNEL[func]
-            arr = out.get(kernel)
-            if arr is None and kernel == "count":
-                arr = finals["__presence"]["count"]
-            arr = np.asarray(arr)[sl]
-            col_count = np.asarray(out.get("count", finals["__presence"]["count"]))[sl]
-            if col == COUNT_STAR:
-                cols["count(*)"] = pa.array(arr.astype(np.int64))
-            elif func == "count":
-                cols[f"count({col})"] = pa.array(arr.astype(np.int64))
-            else:
-                vals = np.where(col_count > 0, arr, np.nan)
-                cols[f"{func}({col})"] = pa.array(vals, mask=np.isnan(vals))
-        return pa.table(cols)
+        cols = self._group_key_columns(plan, ctx, dyn_host, idx)
+        return pa.table(self._append_agg_columns(cols, finals, plan, sl))
 
     def _assemble_result(self, finals, plan, ctx, dyn_host):
         """Shared [G]-state -> SQL rows assembly for the device and host

@@ -66,6 +66,14 @@ register(
     "routing",
 )
 register(
+    "agg_strategy",
+    "pick the device group-by strategy per query from table stats: dense "
+    "mixed-radix states exploiting the (pk, ts) sort, or a hash table "
+    "sized to the distinct-key estimate when the padded group space is "
+    "sparse (the hash/sort winner flips with group cardinality)",
+    "layout",
+)
+register(
     "dedup_plane",
     "lower last-write-wins dedup of overlapping SSTs to a device-side "
     "keep mask instead of falling back to the merge scan",

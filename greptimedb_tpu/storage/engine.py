@@ -126,6 +126,9 @@ class TimeSeriesEngine:
                 index_enable=self.config.index_enable,
                 index_segment_rows=self.config.index_segment_rows,
                 index_inverted_max_terms=self.config.index_inverted_max_terms,
+                index_segmented=getattr(self.config, "index_segmented", True),
+                index_segment_terms=getattr(self.config, "index_segment_terms", 512),
+                index_max_terms=getattr(self.config, "index_max_terms", 1 << 20),
                 append_mode=append_mode,
                 merge_mode=merge_mode,
                 memtable_kind=memtable_kind
@@ -155,6 +158,9 @@ class TimeSeriesEngine:
                 index_enable=self.config.index_enable,
                 index_segment_rows=self.config.index_segment_rows,
                 index_inverted_max_terms=self.config.index_inverted_max_terms,
+                index_segmented=getattr(self.config, "index_segmented", True),
+                index_segment_terms=getattr(self.config, "index_segment_terms", 512),
+                index_max_terms=getattr(self.config, "index_max_terms", 1 << 20),
                 append_mode=append_mode,
                 merge_mode=merge_mode,
                 memtable_kind=memtable_kind

@@ -43,6 +43,14 @@ class ObjectStore:
     def read(self, key: str) -> bytes:
         raise NotImplementedError
 
+    def read_range(self, key: str, offset: int, length: int) -> bytes:
+        """Ranged read of `length` bytes at `offset` (reference: opendal
+        `read_with(..).range(..)`; S3/GCS range GETs).  The segmented term
+        index depends on this being O(length), not O(object): backends
+        with seekable storage override it — this default exists so exotic
+        layers stay correct, not fast."""
+        return self.read(key)[offset : offset + length]
+
     def write(self, key: str, data: bytes) -> None:
         """Atomic full-object write."""
         raise NotImplementedError
@@ -101,6 +109,12 @@ class FsObjectStore(ObjectStore):
         OBJECT_STORE_READS.inc()
         with open(self._p(key), "rb") as f:
             return f.read()
+
+    def read_range(self, key: str, offset: int, length: int) -> bytes:
+        OBJECT_STORE_READS.inc()
+        with open(self._p(key), "rb") as f:
+            f.seek(offset)
+            return f.read(length)
 
     def write(self, key: str, data: bytes) -> None:
         OBJECT_STORE_WRITES.inc()
@@ -234,6 +248,11 @@ class SimulatedRemoteStore(ObjectStore):
         self._network("read")
         return self._backing.read(key)
 
+    def read_range(self, key, offset, length):
+        # one network round per range GET, like a real remote store
+        self._network("read_range")
+        return self._backing.read_range(key, offset, length)
+
     def write(self, key, data):
         self._network("write")
         self._backing.write(key, data)
@@ -278,6 +297,9 @@ class PrefixStore(ObjectStore):
 
     def read(self, key):
         return self.inner.read(self._k(key))
+
+    def read_range(self, key, offset, length):
+        return self.inner.read_range(self._k(key), offset, length)
 
     def write(self, key, data):
         self.inner.write(self._k(key), data)
@@ -334,6 +356,9 @@ class RetryLayer(ObjectStore):
 
     def read(self, key):
         return self._retry("store.read", self.inner.read, key)
+
+    def read_range(self, key, offset, length):
+        return self._retry("store.read", self.inner.read_range, key, offset, length)
 
     def write(self, key, data):
         return self._retry("store.write", self.inner.write, key, data)
@@ -405,6 +430,19 @@ class LruCacheLayer(ObjectStore):
         data = self.inner.read(key)
         self._put(key, data)
         return data
+
+    def read_range(self, key, offset, length):
+        # a cached whole object answers the range locally; otherwise pass
+        # the range through WITHOUT populating the cache (caching whole
+        # objects on ranged access would defeat the bounded-read contract)
+        with self._lock:
+            data = self._cache.get(key)
+            if data is not None:
+                self._cache.move_to_end(key)
+        if data is not None:
+            OBJECT_STORE_CACHE_HITS.inc()
+            return data[offset : offset + length]
+        return self.inner.read_range(key, offset, length)
 
     def write(self, key, data):
         self.inner.write(key, data)
@@ -505,6 +543,16 @@ class WriteCacheLayer(ObjectStore):
         self._stage(local, data)
         self._track(key, len(data))
         return data
+
+    def read_range(self, key, offset, length):
+        local = self._p(key)
+        if os.path.exists(local):
+            OBJECT_STORE_CACHE_HITS.inc()
+            self._touch(key)
+            with open(local, "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+        return self.inner.read_range(key, offset, length)
 
     def _stage(self, local: str, data: bytes):
         # tmp+rename so concurrent readers never observe a half-written file.

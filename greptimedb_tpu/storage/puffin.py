@@ -85,8 +85,17 @@ class PuffinWriter:
 
 
 class PuffinReader:
-    def __init__(self, store_or_path, key: str | None = None):
+    """`ranged=False` (default) reads the whole container once and slices —
+    right for small sidecars consumed blob-by-blob.  `ranged=True` reads
+    the footer via a tail range and each blob via its own ranged read, so
+    touching ONE blob of a large container (a segmented term index with
+    thousands of segment blobs) costs O(blob), not O(file); `bytes_read`
+    accumulates the ranged bytes actually fetched for observability."""
+
+    def __init__(self, store_or_path, key: str | None = None, ranged: bool = False):
         self.store, self.key = _as_store(store_or_path, key)
+        self.ranged = ranged
+        self.bytes_read = 0
         self._metas: list[BlobMeta] | None = None
         self._data: bytes | None = None
 
@@ -94,26 +103,43 @@ class PuffinReader:
         return self.store.exists(self.key)
 
     def _payload(self) -> bytes:
-        # Index sidecars are small (bounded by cardinality caps); one ranged
-        # read beats three for every blob on a remote store.
+        # Legacy whole-blob sidecars are small (bounded by cardinality
+        # caps); one read beats three for every blob on a remote store.
         if self._data is None:
             self._data = self.store.read(self.key)
         return self._data
 
     def blobs(self) -> list[BlobMeta]:
         if self._metas is None:
-            data = self._payload()
-            if data[:4] != MAGIC:
-                raise ValueError(f"bad puffin magic in {self.key}")
-            tail = data[-12:]
-            footer_len = struct.unpack("<I", tail[:4])[0]
-            if tail[8:] != MAGIC:
-                raise ValueError(f"bad puffin trailer in {self.key}")
-            footer = json.loads(data[len(data) - 12 - footer_len : len(data) - 12])
+            if self.ranged:
+                size = self.store.size(self.key)
+                tail = self.store.read_range(self.key, max(size - 12, 0), 12)
+                self.bytes_read += len(tail)
+                footer_len = struct.unpack("<I", tail[:4])[0]
+                if tail[8:] != MAGIC:
+                    raise ValueError(f"bad puffin trailer in {self.key}")
+                footer_raw = self.store.read_range(
+                    self.key, size - 12 - footer_len, footer_len
+                )
+                self.bytes_read += len(footer_raw)
+                footer = json.loads(footer_raw)
+            else:
+                data = self._payload()
+                if data[:4] != MAGIC:
+                    raise ValueError(f"bad puffin magic in {self.key}")
+                tail = data[-12:]
+                footer_len = struct.unpack("<I", tail[:4])[0]
+                if tail[8:] != MAGIC:
+                    raise ValueError(f"bad puffin trailer in {self.key}")
+                footer = json.loads(data[len(data) - 12 - footer_len : len(data) - 12])
             self._metas = [BlobMeta.from_dict(d) for d in footer["blobs"]]
         return self._metas
 
     def read_blob(self, meta: BlobMeta) -> bytes:
+        if self.ranged and self._data is None:
+            out = self.store.read_range(self.key, meta.offset, meta.length)
+            self.bytes_read += len(out)
+            return out
         data = self._payload()
         return data[meta.offset : meta.offset + meta.length]
 

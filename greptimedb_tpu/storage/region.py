@@ -75,6 +75,9 @@ class Region:
         index_enable: bool = True,
         index_segment_rows: int = 1024,
         index_inverted_max_terms: int = 4096,
+        index_segmented: bool = True,
+        index_segment_terms: int = 512,
+        index_max_terms: int = 1 << 20,
         append_mode: bool = False,
         merge_mode: str | None = None,
         memtable_kind: str = "time_partition",
@@ -121,6 +124,9 @@ class Region:
             index_enable=index_enable,
             index_segment_rows=index_segment_rows,
             index_inverted_max_terms=index_inverted_max_terms,
+            index_segmented=index_segmented,
+            index_segment_terms=index_segment_terms,
+            index_max_terms=index_max_terms,
         )
         self.sst_reader = SstReader(sst_store, self.schema)
 
@@ -739,6 +745,24 @@ class Region:
             rows += self.memtable.num_rows
             rows += sum(m.num_rows for m in self._frozen_memtables)
         return rows
+
+    def distinct_estimate(self, column: str) -> int | None:
+        """Upper-bound distinct-value estimate for `column` from the
+        per-SST segmented term index metas (one small cached ranged read
+        per file): the sum of per-file term counts over-counts values
+        shared across files, which is the safe direction for sizing a
+        hash table.  None when no file carries a segmented index for the
+        column (the planner falls back to dictionary cardinality)."""
+        with self._lock:
+            files = list(self.manifest_mgr.manifest.files.values())
+        total = None
+        for meta in files:
+            if column not in meta.indexed_columns:
+                continue
+            n = self.sst_reader.distinct_terms(meta, column)
+            if n is not None:
+                total = n if total is None else total + n
+        return total
 
     def tile_snapshot(self) -> tuple[list[FileMeta], list[Memtable], int]:
         """Consistent (files, memtables, manifest_version) snapshot for the

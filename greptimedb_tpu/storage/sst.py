@@ -20,7 +20,7 @@ import pyarrow.compute as pc
 import pyarrow.parquet as pq
 
 from ..datatypes.schema import Schema
-from ..utils import metrics
+from ..utils import fault_injection, metrics
 from ..utils.deadline import check_deadline, current_deadline
 from . import index as idx
 from .index import BLOOM_BLOB, FULLTEXT_BLOB, INVERTED_BLOB, VECTOR_BLOB
@@ -133,6 +133,9 @@ class SstWriter:
         index_enable: bool = True,
         index_segment_rows: int = idx.DEFAULT_SEGMENT_ROWS,
         index_inverted_max_terms: int = 4096,
+        index_segmented: bool = True,
+        index_segment_terms: int = 512,
+        index_max_terms: int = 1 << 20,
     ):
         # A bare directory path means "local fs store rooted there" — the
         # common standalone config and what unit tests pass.
@@ -142,12 +145,23 @@ class SstWriter:
         self.index_enable = index_enable
         self.index_segment_rows = index_segment_rows
         self.index_inverted_max_terms = index_inverted_max_terms
+        # Segmented term index (greptimedb_tpu/index/): fence-keyed term
+        # segments with ranged reads.  On (the default) it REPLACES the
+        # whole-blob inverted/fulltext payloads for new SSTs and lifts
+        # the legacy cardinality cap to `index_max_terms`; off restores
+        # the legacy formats bit-for-bit (old sidecars stay readable
+        # either way — the read router handles both).
+        self.index_segmented = index_segmented
+        self.index_segment_terms = index_segment_terms
+        self.index_max_terms = index_max_terms
 
     def _build_indexes(self, table: pa.Table, file_id: str) -> tuple[list[str], int]:
-        """Build bloom + inverted indexes over tag columns, and tokenized
+        """Build bloom + term indexes over tag columns, and tokenized
         fulltext indexes over FULLTEXT-declared text columns, into the
         puffin sidecar (reference mito2/src/sst/index/indexer/ builds
         during flush; fulltext_index/ for the tantivy analogue)."""
+        from .. import index as term_index
+
         cols = [c.name for c in self.schema.tag_columns() if c.name in table.column_names]
         ft_cols = [
             c.name
@@ -161,6 +175,7 @@ class SstWriter:
         ]
         if not cols and not ft_cols and not vec_cols:
             return [], 0
+        fault_injection.fire("index.build", file=file_id)
         writer = PuffinWriter(self.store, f"{file_id}.puffin")
         indexed = []
         for name in cols:
@@ -168,20 +183,46 @@ class SstWriter:
             col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
             bloom = idx.build_bloom_index(col, self.index_segment_rows)
             writer.add_blob(BLOOM_BLOB, bloom, {"column": name})
-            inverted = idx.build_inverted_index(
-                col, self.index_segment_rows, self.index_inverted_max_terms
-            )
-            if inverted is not None:
-                writer.add_blob(INVERTED_BLOB, inverted, {"column": name})
+            if self.index_segmented:
+                terms, postings, n_segs = term_index.build_term_postings(
+                    col, self.index_segment_rows
+                )
+                if len(terms) <= self.index_max_terms:
+                    term_index.write_term_index(
+                        writer, name, "inverted", terms, postings,
+                        segment_rows=self.index_segment_rows,
+                        n_rows=len(col), n_segs=n_segs,
+                        seg_terms=self.index_segment_terms,
+                    )
+            else:
+                inverted = idx.build_inverted_index(
+                    col, self.index_segment_rows, self.index_inverted_max_terms
+                )
+                if inverted is not None:
+                    writer.add_blob(INVERTED_BLOB, inverted, {"column": name})
             indexed.append(name)
         for name in ft_cols:
             col = table[name]
             col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
-            ft = idx.build_fulltext_index(col, self.index_segment_rows)
-            if ft is not None:
-                writer.add_blob(FULLTEXT_BLOB, ft, {"column": name})
-                if name not in indexed:
-                    indexed.append(name)
+            if self.index_segmented:
+                toks, postings, n_segs = term_index.build_token_postings(
+                    col, self.index_segment_rows
+                )
+                if toks and len(toks) <= self.index_max_terms:
+                    term_index.write_term_index(
+                        writer, name, "fulltext", toks, postings,
+                        segment_rows=self.index_segment_rows,
+                        n_rows=len(col), n_segs=n_segs,
+                        seg_terms=self.index_segment_terms,
+                    )
+                    if name not in indexed:
+                        indexed.append(name)
+            else:
+                ft = idx.build_fulltext_index(col, self.index_segment_rows)
+                if ft is not None:
+                    writer.add_blob(FULLTEXT_BLOB, ft, {"column": name})
+                    if name not in indexed:
+                        indexed.append(name)
         for c in vec_cols:
             col = table[c.name]
             col = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
@@ -233,7 +274,18 @@ class SstWriter:
         self.store.put_file(key, scratch)
         indexed, index_size = ([], 0)
         if self.index_enable:
-            indexed, index_size = self._build_indexes(table, file_id)
+            try:
+                indexed, index_size = self._build_indexes(table, file_id)
+            except Exception as e:  # noqa: BLE001 — an index build failure
+                # must never lose the data write: the SST lands without a
+                # sidecar (unpruned but correct), and the failure is loud
+                import logging
+
+                logging.getLogger("greptimedb_tpu.index").warning(
+                    "index build for %s failed; SST written unindexed: %s",
+                    file_id, e,
+                )
+                indexed, index_size = [], 0
         return FileMeta(
             file_id=file_id,
             time_range=(t_min, t_max),
@@ -351,8 +403,12 @@ class SstReader:
     def _prune_with_indexes(
         self, pf: pq.ParquetFile, meta: FileMeta, pred: ScanPredicate, groups: list[int]
     ) -> list[int]:
-        """Row-group pruning via the puffin sidecar's bloom/inverted indexes
-        (reference mito2/src/read/scan_region.rs:479-487 index appliers)."""
+        """Row-group pruning via the puffin sidecar, routed through the
+        shared TermIndexReader (reference mito2/src/read/scan_region.rs
+        index appliers): segmented term index with ranged reads when the
+        sidecar carries it, legacy whole-blob parses otherwise.  Any
+        index failure degrades to no pruning — the residual filter keeps
+        results exact."""
         usable = [
             (name, op, value)
             for name, op, value in pred.filters
@@ -361,30 +417,20 @@ class SstReader:
         ]
         if not usable:
             return groups
-        sidecar = self._load_sidecar(meta)
-        if sidecar is None:
+        reader = self.term_index(meta)
+        if reader is None:
             return groups
         seg_bitmap: np.ndarray | None = None
         for name, op, value in usable:
-            index_map = sidecar.get(name)
-            if not index_map:
+            bm = reader.search(name, op, value)
+            if bm is None:
                 continue
-            bm = None
             if op in ("match", "match_term"):
-                if FULLTEXT_BLOB in index_map:
-                    bm = index_map[FULLTEXT_BLOB].search(op, value)
-                    if bm is not None:
-                        INDEX_FULLTEXT_PRUNES.inc()
-            else:
-                if INVERTED_BLOB in index_map:
-                    bm = index_map[INVERTED_BLOB].search(op, value)
-                if bm is None and BLOOM_BLOB in index_map:
-                    bm = index_map[BLOOM_BLOB].search(op, value)
-            if bm is not None:
-                seg_bitmap = bm if seg_bitmap is None else (seg_bitmap & bm)
+                INDEX_FULLTEXT_PRUNES.inc()
+            seg_bitmap = bm if seg_bitmap is None else (seg_bitmap & bm)
         if seg_bitmap is None:
             return groups
-        seg_rows = sidecar["__segment_rows__"]
+        seg_rows = reader.segment_rows()
         md = pf.metadata
         offsets = [0]
         for g in range(md.num_row_groups):
@@ -397,43 +443,30 @@ class SstReader:
                 keep.append(g)
         return keep
 
-    def _load_sidecar(self, meta: FileMeta) -> dict | None:
-        """column -> {blob_type -> parsed index object}, cached per file so
-        repeated scans skip the zlib/unpackbits decode entirely."""
+    def term_index(self, meta: FileMeta):
+        """The file's cached TermIndexReader, or None without a sidecar."""
+        from ..index import TermIndexReader
+
         cached = _INDEX_CACHE.get(meta.file_id)
         if cached is not None:
             return cached
-        reader = PuffinReader(self.store, f"{meta.file_id}.puffin")
+        reader = TermIndexReader(self.store, meta.file_id)
         if not reader.exists():
             return None
-        out: dict = {}
-        seg_rows = idx.DEFAULT_SEGMENT_ROWS
-        for bm in reader.blobs():
-            col = bm.properties.get("column")
-            blob = reader.read_blob(bm)
-            if bm.blob_type == BLOOM_BLOB:
-                parsed = idx.BloomIndex(blob)
-            elif bm.blob_type == INVERTED_BLOB:
-                parsed = idx.InvertedIndex(blob)
-            elif bm.blob_type == FULLTEXT_BLOB:
-                parsed = idx.FulltextIndex(blob)
-            elif bm.blob_type == VECTOR_BLOB:
-                out.setdefault(col, {})[VECTOR_BLOB] = idx.VectorIndex(blob)
-                continue  # no segment granularity
-            else:
-                continue
-            out.setdefault(col, {})[bm.blob_type] = parsed
-            seg_rows = parsed.segment_rows
-        out["__segment_rows__"] = seg_rows
-        _INDEX_CACHE.put(meta.file_id, out)
-        return out
+        _INDEX_CACHE.put(meta.file_id, reader)
+        return reader
+
+    def distinct_terms(self, meta: FileMeta, column: str) -> int | None:
+        """Unique-term count of `column` in this SST from the segmented
+        index meta (one small ranged read; None when unindexed) — the
+        planner's distinct-key stats feed."""
+        reader = self.term_index(meta)
+        return None if reader is None else reader.distinct_terms(column)
 
     def vector_index(self, meta: FileMeta, column: str):
         """Parsed per-SST IVF index for `column`, or None."""
-        sidecar = self._load_sidecar(meta)
-        if not sidecar:
-            return None
-        return sidecar.get(column, {}).get(VECTOR_BLOB)
+        reader = self.term_index(meta)
+        return None if reader is None else reader.vector_index(column)
 
     def _prune_row_groups(self, pf: pq.ParquetFile, pred: ScanPredicate, ts_name) -> list[int]:
         md = pf.metadata

@@ -70,7 +70,13 @@ class StorageConfig:
     # SST secondary indexes (reference mito2 `[region_engine.mito.index]`):
     index_enable: bool = True
     index_segment_rows: int = 1024  # bloom/inverted segment granularity
-    index_inverted_max_terms: int = 4096  # cardinality cap for inverted index
+    index_inverted_max_terms: int = 4096  # cardinality cap for LEGACY inverted index
+    # Storage-plane mirrors of the user-facing `index.*` section (engines
+    # built from a bare StorageConfig see these; Config.__post_init__
+    # copies the index.* knobs down, same pattern as follower_sync):
+    index_segmented: bool = True
+    index_segment_terms: int = 512
+    index_max_terms: int = 1 << 20
     # WAL provider (reference `[wal] provider = "raft_engine" | "kafka"`):
     # "local" = per-region append logs (raft-engine analogue);
     # "shared_file" = shared-topic segmented log on wal_dir (the remote-WAL
@@ -200,6 +206,17 @@ class QueryConfig:
     # rest (the reference removes individual physical optimizer rules the
     # same way in its tests).
     disabled_passes: tuple = ()
+    # Device group-by strategy (the `agg_strategy` optimizer pass,
+    # parallel/tile_cache.py): "auto" picks hash vs sort per query from
+    # table stats (distinct-key estimates via the segmented term index +
+    # tag dictionaries vs the dense group-space size — the hash/sort
+    # winner flips with group cardinality, arXiv:2411.13245); "sort"
+    # forces the dense mixed-radix path (pre-hash behavior bit-for-bit);
+    # "hash" forces the hash-table path wherever structurally possible.
+    agg_strategy: str = "auto"
+    # Auto only considers hash when the dense (padded) group space is at
+    # least this large — below it dense [G] states are trivially cheap.
+    agg_hash_min_group_space: int = 1 << 16
     # Hedged region reads (tail tolerance): once a region sub-query has been
     # outstanding this long, the frontend sends a duplicate to a follower
     # replica and takes whichever lands first.  0 disables hedging; it also
@@ -334,6 +351,28 @@ class TileConfig:
 
 
 @dataclasses.dataclass
+class IndexConfig:
+    """Segmented term index (greptimedb_tpu/index/): new SSTs write their
+    inverted/fulltext term indexes as fence-keyed term segments with
+    per-segment puffin blobs, so a term lookup is binary search over
+    in-memory fence keys + ONE ranged read of one segment — O(log terms)
+    time, O(segment) memory, no cardinality cap below `max_terms`.
+
+    `segmented = False` restores the legacy whole-blob formats for new
+    SSTs bit-for-bit (including the 4096-term inverted cap); sidecars of
+    EITHER vintage stay readable — the read router handles both."""
+
+    segmented: bool = True
+    # Terms per segment blob: the unit of both lookup memory and ranged
+    # read size.  512 terms ≈ 10-40 KB per segment at typical tag widths.
+    segment_terms: int = 512
+    # Hard cardinality ceiling for building a term index at all (beyond
+    # it the column keeps only its bloom filters).  High on purpose: the
+    # segmented format is built FOR high cardinality.
+    max_terms: int = 1 << 20
+
+
+@dataclasses.dataclass
 class FlowConfig:
     """Incremental dataflow for materialized views (flow/dataflow.py).
 
@@ -447,9 +486,23 @@ class Config:
     tile: TileConfig = dataclasses.field(default_factory=TileConfig)
     admission: AdmissionConfig = dataclasses.field(default_factory=AdmissionConfig)
     flow: FlowConfig = dataclasses.field(default_factory=FlowConfig)
+    index: IndexConfig = dataclasses.field(default_factory=IndexConfig)
 
     def __post_init__(self):
         self.storage.__post_init__()
+        # index.* is the user-facing surface for the segmented term index;
+        # engines only see StorageConfig, so copy the knobs down — but,
+        # like the replica.sync copy, only when the index knob was
+        # actually engaged (moved off its default), so an explicitly-set
+        # storage.index_* survives (a bare StorageConfig is the engines'
+        # own config surface and tests set it directly)
+        ix_defaults = IndexConfig()
+        if self.index.segmented != ix_defaults.segmented:
+            self.storage.index_segmented = self.index.segmented
+        if self.index.segment_terms != ix_defaults.segment_terms:
+            self.storage.index_segment_terms = self.index.segment_terms
+        if self.index.max_terms != ix_defaults.max_terms:
+            self.storage.index_max_terms = self.index.max_terms
         # replica.sync_interval_ms is the user-facing follower-tailing
         # knob; engines only see StorageConfig, so copy it down (an
         # explicitly-set storage.follower_sync_interval_ms survives when
@@ -614,6 +667,36 @@ class Config:
                 "admission.min_chunk_rows must be >= 4096 (the kernel block "
                 "size — halving below one block cannot help an OOM); got "
                 f"{a.min_chunk_rows!r}"
+            )
+        ix = self.index
+        if not isinstance(ix.segmented, bool):
+            raise ConfigError(
+                "index.segmented must be a boolean (fence-keyed segmented "
+                f"term index for new SSTs); got {ix.segmented!r}"
+            )
+        if ix.segment_terms < 16:
+            raise ConfigError(
+                "index.segment_terms must be >= 16 terms per segment — "
+                "smaller segments pay a ranged read per handful of terms; "
+                f"got {ix.segment_terms!r}"
+            )
+        if ix.max_terms < ix.segment_terms:
+            raise ConfigError(
+                f"index.max_terms ({ix.max_terms}) cannot be below "
+                f"index.segment_terms ({ix.segment_terms}) — the index "
+                "could never hold even one full segment"
+            )
+        if q.agg_strategy not in ("auto", "hash", "sort"):
+            raise ConfigError(
+                "query.agg_strategy must be 'auto', 'hash' or 'sort' (the "
+                "device group-by strategy; 'sort' restores the dense "
+                f"pre-hash path bit-for-bit); got {q.agg_strategy!r}"
+            )
+        if q.agg_hash_min_group_space < 1024:
+            raise ConfigError(
+                "query.agg_hash_min_group_space must be >= 1024 groups — "
+                "below that the dense path is always cheaper than a hash "
+                f"table; got {q.agg_hash_min_group_space!r}"
             )
         fl = self.flow
         if not isinstance(fl.incremental, bool):

@@ -60,6 +60,15 @@ Named points wired into the codebase:
     flow.expire        flow EXPIRE AFTER dropping rows/states/index
                        windows (ctx: flow, expired count) — fired only
                        when something is actually expired
+    index.segment_read segmented term-index segment fetch
+                       (index/segmented.py, before the ranged read; ctx:
+                       column, seg) — an injected error here must degrade
+                       the lookup to a full-scan mask, never a wrong
+                       result (TermIndexReader catches and returns None)
+    index.build        SST index sidecar build entry (storage/sst.py
+                       _build_indexes; ctx: file) — an injected error
+                       yields an SST with NO sidecar (unpruned but
+                       correct); the write itself must survive
 
 Production overhead is near zero: `fire()` is a module-level function whose
 fast path is one read of a module global (`_ARMED`) — no locks, no dict
@@ -112,6 +121,8 @@ POINTS = frozenset(
         "flow.diff_apply",
         "flow.join_dirty",
         "flow.expire",
+        "index.segment_read",
+        "index.build",
     }
 )
 

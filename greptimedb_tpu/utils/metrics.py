@@ -172,6 +172,15 @@ TILE_QUERY_ELAPSED = REGISTRY.histogram("greptime_query_tile_elapsed", "Tile-pat
 TILE_LOWERED_TOTAL = REGISTRY.counter("greptime_query_tile_lowered_total", "Queries served from the HBM tile cache")
 TILE_READBACK_MS = REGISTRY.histogram("greptime_tile_readback_ms", "Device->host result fetch milliseconds per tile query")
 TILE_LIMB_RERUNS = REGISTRY.counter("greptime_tile_limb_reruns_total", "Tile queries rerun in exact f64 after the limb error-bound verdict failed")
+AGG_STRATEGY_TOTAL = REGISTRY.counter(
+    "greptime_agg_strategy_total",
+    "Device group-by dispatches by chosen strategy {strategy=hash|sort}",
+)
+AGG_HASH_OVERFLOW = REGISTRY.counter(
+    "greptime_agg_hash_overflow_total",
+    "Hash group-by dispatches whose slot table overflowed (distinct-key "
+    "estimate badly low) and fell back to the dense path",
+)
 TILE_PERSIST_HITS = REGISTRY.counter("greptime_tile_persist_hits_total", "Super-tile consolidations loaded from the persisted store (cold-start skip)")
 TILE_PERSIST_WRITES = REGISTRY.counter("greptime_tile_persist_writes_total", "Super-tile consolidations written to the persisted store")
 TILE_WINDOW_BUILDS = REGISTRY.counter("greptime_tile_window_builds_total", "Compact window tiles gathered from sorted encodes")

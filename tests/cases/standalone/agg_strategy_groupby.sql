@@ -1,0 +1,22 @@
+-- adaptive hash/sort device group-by: tag-filtered grouped aggregates
+-- over flushed SSTs.  Values are binary-exact (halves/quarters) so sums
+-- are associativity-proof: this golden must render byte-identically
+-- under agg_strategy auto/hash/sort and index.segmented on/off
+-- (tests/test_golden_knobs.py runs exactly that matrix).
+CREATE TABLE fleet (host STRING, dc STRING, ts TIMESTAMP TIME INDEX, cpu DOUBLE, mem DOUBLE, PRIMARY KEY (host, dc));
+
+INSERT INTO fleet VALUES ('h01', 'east', 0, 10.5, 1.25), ('h02', 'east', 0, 20.25, 2.5), ('h03', 'west', 0, 30.75, 3.75), ('h01', 'east', 60000, 11.5, 1.5), ('h02', 'east', 60000, 21.25, NULL), ('h03', 'west', 60000, 31.5, 4.25), ('h04', 'west', 60000, 40.0, 5.0), ('h01', 'east', 120000, 12.25, 1.75), ('h04', 'west', 120000, 41.5, NULL);
+
+ADMIN flush_table('fleet');
+
+SELECT host, dc, count(*) AS c, sum(cpu) AS sc, avg(cpu) AS ac, min(mem) AS mn, max(mem) AS mx, count(mem) AS cm FROM fleet GROUP BY host, dc ORDER BY host, dc;
+
+SELECT dc, count(*) AS c, sum(cpu) AS sc FROM fleet WHERE host != 'h04' GROUP BY dc ORDER BY dc;
+
+SELECT host, time_bucket('1m', ts) AS tb, avg(cpu) AS ac FROM fleet WHERE dc = 'east' GROUP BY host, tb ORDER BY host, tb;
+
+SELECT host, sum(cpu) AS sc FROM fleet GROUP BY host HAVING sum(cpu) > 60 ORDER BY sc DESC;
+
+SELECT host, dc, max(cpu) AS mc FROM fleet WHERE host IN ('h01', 'h03') GROUP BY host, dc ORDER BY host;
+
+DROP TABLE fleet;

@@ -1,0 +1,30 @@
+-- segmented term index: tag-filter and MATCHES queries over FLUSHED SSTs
+-- (flush builds the puffin sidecar, so pruning actually routes through
+-- the fence-keyed segment reads; results must be identical either way)
+CREATE TABLE svc_logs (ts TIMESTAMP TIME INDEX, svc STRING, msg STRING FULLTEXT INDEX, v DOUBLE, PRIMARY KEY (svc));
+
+INSERT INTO svc_logs VALUES (0, 'auth', 'login ok for user alpha', 1.5), (1000, 'auth', 'login failed for user beta', 2.5), (2000, 'billing', 'invoice created', 3.0), (3000, 'billing', 'payment error: card declined', 4.5), (4000, 'search', 'query timeout error', 5.0), (5000, 'search', 'reindex complete', 0.5), (6000, 'auth', 'token refresh ok', 1.0);
+
+ADMIN flush_table('svc_logs');
+
+SELECT svc, msg FROM svc_logs WHERE svc = 'auth' ORDER BY ts;
+
+SELECT svc, msg FROM svc_logs WHERE svc IN ('billing', 'search') ORDER BY ts;
+
+SELECT svc, msg FROM svc_logs WHERE svc != 'auth' ORDER BY ts;
+
+SELECT svc, msg FROM svc_logs WHERE matches(msg, 'error') ORDER BY ts;
+
+SELECT svc, msg FROM svc_logs WHERE matches(msg, 'login -failed') ORDER BY ts;
+
+SELECT svc, msg FROM svc_logs WHERE matches(msg, '"card declined"') ORDER BY ts;
+
+SELECT svc, msg FROM svc_logs WHERE matches_term(msg, 'timeout') ORDER BY ts;
+
+SELECT svc, msg FROM svc_logs WHERE matches(msg, 'ok OR complete') ORDER BY ts;
+
+SELECT svc, count(*) AS c, sum(v) AS sv FROM svc_logs WHERE svc = 'auth' GROUP BY svc;
+
+SELECT svc, msg FROM svc_logs WHERE svc = 'nope' ORDER BY ts;
+
+DROP TABLE svc_logs;

@@ -168,3 +168,226 @@ def test_end_to_end_index_correctness(tmp_path):
     expect = sum(1 for i in range(2000) if i % 7 == 3)
     assert r.to_pylist()[0]["count(*)"] == expect
     d.close()
+
+
+# ---- segmented term index (greptimedb_tpu/index/) ---------------------------
+
+from greptimedb_tpu import index as term_index
+from greptimedb_tpu.index.segmented import (
+    INDEX_BYTES_READ,
+    INDEX_DEGRADED,
+    INDEX_SEGMENTS_READ,
+    TERM_META_BLOB,
+    TERM_SEGMENT_BLOB,
+)
+from greptimedb_tpu.utils import fault_injection as fi
+
+
+def test_segmented_sidecar_layout(tmp_path):
+    """Default writer emits fence-keyed segment blobs + one meta blob per
+    tag column instead of the legacy whole-blob inverted payload."""
+    _, meta = _write_sst(tmp_path)
+    r = PuffinReader(str(tmp_path / f"{meta.file_id}.puffin"))
+    types = [b.blob_type for b in r.blobs()]
+    assert TERM_META_BLOB in types
+    assert TERM_SEGMENT_BLOB in types
+    assert idx.INVERTED_BLOB not in types  # replaced, not duplicated
+    assert idx.BLOOM_BLOB in types  # blooms still ride along
+
+
+def test_segmented_pruning_is_ranged(tmp_path):
+    """A term lookup reads O(segment) bytes of a sidecar, not O(file)."""
+    _, meta = _write_sst(tmp_path)
+    r = SstReader(str(tmp_path), SCHEMA)
+    b0 = INDEX_BYTES_READ.get()
+    t = r.read(meta, ScanPredicate(filters=[("host", "=", "h3")]))
+    assert t.num_rows == 500 and set(t["host"].to_pylist()) == {"h3"}
+    bytes_read = INDEX_BYTES_READ.get() - b0
+    assert 0 < bytes_read < meta.index_file_size  # strictly less than the blob
+
+
+def test_legacy_format_still_readable(tmp_path):
+    """index.segmented=false writes the old whole-blob formats, and the
+    new TermIndexReader router serves them — old SSTs keep working."""
+    w = SstWriter(
+        str(tmp_path), SCHEMA, row_group_size=500, index_segment_rows=250,
+        index_segmented=False,
+    )
+    n = 4000
+    table = pa.table(
+        {
+            "ts": pa.array(np.arange(n, dtype=np.int64), pa.timestamp("ms")),
+            "host": pa.array([f"h{i // 500}" for i in range(n)]),
+            "v": pa.array(np.random.default_rng(0).uniform(size=n)),
+        }
+    )
+    meta = w.write(table)
+    pr = PuffinReader(str(tmp_path / f"{meta.file_id}.puffin"))
+    types = [b.blob_type for b in pr.blobs()]
+    assert idx.INVERTED_BLOB in types and TERM_META_BLOB not in types
+    r = SstReader(str(tmp_path), SCHEMA)
+    before = INDEX_PRUNED_GROUPS.get()
+    t = r.read(meta, ScanPredicate(filters=[("host", "=", "h5")]))
+    assert t.num_rows == 500 and set(t["host"].to_pylist()) == {"h5"}
+    assert INDEX_PRUNED_GROUPS.get() - before == 7
+    # legacy != pruning still answered (segmented declines it)
+    t = r.read(meta, ScanPredicate(filters=[("host", "!=", "h5")]))
+    assert t.num_rows == 3500
+
+
+def test_segmented_matches_legacy_pruning(tmp_path):
+    """Same data, both formats: identical surviving rows for =/in, and
+    the segmented bitmap for '=' is exact (same segments as legacy)."""
+    rng = np.random.default_rng(3)
+    n = 3000
+    hosts = [f"h{rng.integers(0, 40):02d}" for _ in range(n)]
+    table = pa.table(
+        {
+            "ts": pa.array(np.arange(n, dtype=np.int64), pa.timestamp("ms")),
+            "host": pa.array(hosts),
+            "v": pa.array(rng.uniform(size=n)),
+        }
+    )
+    outs = []
+    for segmented in (True, False):
+        sub = tmp_path / ("seg" if segmented else "legacy")
+        w = SstWriter(
+            str(sub), SCHEMA, row_group_size=300, index_segment_rows=100,
+            index_segmented=segmented, index_segment_terms=8,
+        )
+        meta = w.write(table)
+        r = SstReader(str(sub), SCHEMA)
+        t = r.read(meta, ScanPredicate(filters=[("host", "in", ("h03", "h17"))]))
+        outs.append(t.sort_by([("ts", "ascending")]))
+    assert outs[0].equals(outs[1])
+
+
+def test_segment_read_fault_degrades_to_full_scan(tmp_path):
+    """An injected segment-read error must cost pruning, never rows."""
+    _, meta = _write_sst(tmp_path)
+    r = SstReader(str(tmp_path), SCHEMA)
+    d0 = INDEX_DEGRADED.get()
+    with fi.REGISTRY.armed("index.segment_read", fail_times=100, error=OSError):
+        t = r.read(meta, ScanPredicate(filters=[("host", "=", "h3")]))
+    # bloom may still prune (it parses whole-blob), but the RESULT is what
+    # the contract is about: exactly the h3 rows survive the residual filter
+    assert t.num_rows == 500 and set(t["host"].to_pylist()) == {"h3"}
+    assert INDEX_DEGRADED.get() > d0
+
+
+def test_index_build_fault_writes_unindexed_sst(tmp_path):
+    """An injected build error yields an SST with no sidecar; the data
+    write itself survives and scans stay correct."""
+    w = SstWriter(str(tmp_path), SCHEMA, row_group_size=500)
+    n = 1000
+    table = pa.table(
+        {
+            "ts": pa.array(np.arange(n, dtype=np.int64), pa.timestamp("ms")),
+            "host": pa.array([f"h{i // 500}" for i in range(n)]),
+            "v": pa.array(np.zeros(n)),
+        }
+    )
+    with fi.REGISTRY.armed("index.build", fail_times=1, error=RuntimeError):
+        meta = w.write(table)
+    assert meta is not None and meta.indexed_columns == []
+    assert not os.path.exists(tmp_path / f"{meta.file_id}.puffin")
+    r = SstReader(str(tmp_path), SCHEMA)
+    t = r.read(meta, ScanPredicate(filters=[("host", "=", "h1")]))
+    assert t.num_rows == 500
+
+
+def test_segmented_null_terms_and_distinct_stats(tmp_path):
+    w = SstWriter(str(tmp_path), SCHEMA, row_group_size=100, index_segment_rows=100)
+    n = 600
+    hosts = [None if i % 3 == 0 else f"h{i % 5}" for i in range(n)]
+    table = pa.table(
+        {
+            "ts": pa.array(np.arange(n, dtype=np.int64), pa.timestamp("ms")),
+            "host": pa.array(hosts, pa.string()),
+            "v": pa.array(np.zeros(n)),
+        }
+    )
+    meta = w.write(table)
+    r = SstReader(str(tmp_path), SCHEMA)
+    # NULL never satisfies '=', the residual filter guarantees it; the
+    # index must not crash on the null term either way
+    t = r.read(meta, ScanPredicate(filters=[("host", "=", "h1")]))
+    assert all(v == "h1" for v in t["host"].to_pylist())
+    # distinct stats: 4 non-null hosts (h0 never occurs on non-null rows:
+    # i%3!=0 and i%5==0 -> h0 occurs at i=5,10,20,25...; so 5 values) + null
+    stats = r.distinct_terms(meta, "host")
+    uniq = len(set(hosts))  # includes None
+    assert stats == uniq
+
+
+@pytest.mark.slow
+def test_million_term_index_bounded_lookup(tmp_path):
+    """The log-scale acceptance: 10^6 unique terms, and a term lookup
+    reads O(segments touched) bytes — thousands, against an index of tens
+    of MB — with the result exact."""
+    n = 1_000_000
+    w = SstWriter(
+        str(tmp_path), SCHEMA, row_group_size=1 << 16, index_segment_rows=1024,
+    )
+    terms = np.array([f"trace_{i:07d}" for i in range(n)])
+    rng = np.random.default_rng(11)
+    rng.shuffle(terms)
+    table = pa.table(
+        {
+            "ts": pa.array(np.arange(n, dtype=np.int64), pa.timestamp("ms")),
+            "host": pa.array(terms),
+            "v": pa.array(np.zeros(n)),
+        }
+    )
+    meta = w.write(table)
+    assert meta.index_file_size > 5 << 20  # a real multi-MB index
+    r = SstReader(str(tmp_path), SCHEMA)
+    reader = r.term_index(meta)
+    assert reader.distinct_terms("host") == n
+    b0, s0 = INDEX_BYTES_READ.get(), INDEX_SEGMENTS_READ.get()
+    t = r.read(meta, ScanPredicate(filters=[("host", "=", "trace_0123456")]))
+    assert t.num_rows == 1
+    segs_read = INDEX_SEGMENTS_READ.get() - s0
+    bytes_read = INDEX_BYTES_READ.get() - b0
+    assert segs_read <= 2  # fence search -> ONE term segment
+    # bounded by O(segments touched): meta (fences) + one segment blob,
+    # orders of magnitude below the whole sidecar
+    assert bytes_read < meta.index_file_size / 50
+
+
+def test_fence_keys_roundtrip_mid_multibyte_truncation(tmp_path):
+    """A term truncated mid-multibyte-character at MAX_TERM_BYTES can
+    become a segment fence; the latin-1 JSON round-trip must reproduce
+    its exact bytes or lookups near it silently misroute."""
+    from greptimedb_tpu.index import segmented as seg
+
+    long_tail = "é" * 700  # 2 bytes each: 1400 bytes, truncated at 1024
+    col = pa.array(
+        [f"aa_{i:03d}" for i in range(40)]
+        + ["zz_" + long_tail] * 5  # truncation cuts a 2-byte char in half
+        + ["zz_zz"] * 5
+    )
+    terms, postings, n_segs = term_index.build_term_postings(col, 10)
+    # the truncated term's bytes end mid-character
+    trunc = [t for t in terms if t.startswith(b"zz_\xc3")][0]
+    assert len(trunc) == seg.MAX_TERM_BYTES
+    p = str(tmp_path / "f.puffin")
+    w = PuffinWriter(p)
+    term_index.write_term_index(
+        w, "h", "inverted", terms, postings,
+        segment_rows=10, n_rows=len(col), n_segs=n_segs, seg_terms=8,
+    )
+    w.finish()
+    import json as _json
+
+    r = PuffinReader(p, ranged=True)
+    meta_bm = [m for m in r.blobs() if m.blob_type == TERM_META_BLOB][0]
+    meta = _json.loads(r.read_blob(meta_bm))
+    s = term_index.SegmentedTermIndex(r, "k", "h", "inverted", meta)
+    # every stored term must be findable, including ones at/after the
+    # truncated fence
+    for t, post in zip(terms, postings):
+        bm = s.lookup(t)  # routes through the fence binary search
+        expect = np.zeros(n_segs, bool)
+        expect[post] = True
+        assert (bm == expect).all(), t[:40]
