@@ -286,6 +286,9 @@ class Database:
                 self._prewarm_stop = True
                 self._prewarm_cv.notify()
             self._prewarm_thread.join(timeout=5.0)
+        from .utils import self_trace
+
+        self_trace.stop(self)
         self.telemetry.stop()
         self.event_recorder.stop()
         self.flows.stop()
@@ -328,8 +331,16 @@ class Database:
 
         if isinstance(stmt, SelectStmt):
             from .utils.deadline import deadline_scope
+            from .utils.self_trace import statement_trace
 
-            with deadline_scope(
+            # statement_trace is OUTERMOST so admission queue wait, the
+            # memory gate and the whole engine pipeline are stages of the
+            # statement's trace (and the tail decision sees the true
+            # end-to-end latency); off (trace.self=false) it is a pure
+            # pass-through
+            with statement_trace(
+                self, "sql", query_text or "SELECT ...", self.current_database
+            ), deadline_scope(
                 self.config.query.timeout_s
             ), self.admission.admit(
                 self.current_database
@@ -355,7 +366,15 @@ class Database:
         if isinstance(stmt, DropStmt):
             return self._drop(stmt)
         if isinstance(stmt, InsertStmt):
-            return self._insert(stmt)
+            from .utils.self_trace import statement_trace
+
+            # the WRITE hot path is traced too: routing, per-region WAL
+            # appends and flow mirroring all become child stages
+            with statement_trace(
+                self, "insert", query_text or "INSERT ...",
+                self.current_database,
+            ):
+                return self._insert(stmt)
         if isinstance(stmt, ShowStmt):
             return self._show(stmt)
         if isinstance(stmt, DescribeStmt):
@@ -386,7 +405,12 @@ class Database:
         if isinstance(stmt, AdminStmt):
             return self._admin(stmt)
         if isinstance(stmt, TqlStmt):
-            with self.admission.admit(
+            from .utils.self_trace import statement_trace
+
+            with statement_trace(
+                self, "tql", query_text or "TQL ...", self.current_database,
+                is_promql=True,
+            ), self.admission.admit(
                 self.current_database
             ), self.memory.query_guard(), self.process_manager.track(
                 self.current_database, query_text or "TQL ..."
@@ -1356,14 +1380,19 @@ class Database:
                 self._plan_cache.move_to_end(key)
             else:
                 hit = None
+        from .utils import tracing
+
         if hit is not None:
             plan, schema = hit[1], hit[2]
+            tracing.set_attribute("plan_cache", "hit")
         else:
             from .query.planner import plan_query, plan_uncacheable
 
-            plan, schema = plan_query(
-                stmt, self._schema_of, self.current_database, self._view_stmt
-            )
+            with tracing.span("query.plan", table=stmt.table or "") as s:
+                plan, schema = plan_query(
+                    stmt, self._schema_of, self.current_database, self._view_stmt
+                )
+                s.attributes["plan_ms"] = round(s.duration() * 1000.0, 3)
             if not plan_uncacheable(plan):
                 with self._plan_cache_lock:
                     self._plan_cache[key] = (self.catalog.revision, plan, schema)

@@ -30,7 +30,7 @@ import pyarrow.flight as fl
 
 from ..datatypes.schema import Schema
 from ..storage.sst import ScanPredicate
-from ..utils import fault_injection, metrics
+from ..utils import fault_injection, metrics, tracing
 from ..utils.errors import RegionNotFoundError, RegionReadonlyError
 
 import contextlib
@@ -80,6 +80,7 @@ def encode_scan_ticket(
     projection: list[str] | None = None,
     agg: dict | None = None,
     plan: dict | None = None,
+    trace: dict | None = None,
 ) -> bytes:
     """The wire form of a region sub-query (the reference ships a substrait
     `LogicalPlan`).  Three escalating shapes ride the same ticket:
@@ -87,26 +88,36 @@ def encode_scan_ticket(
     + a serialized logical sub-plan (query/plan_wire.py — the datanode
     executes filter/project/sort/limit below the merge boundary and ships
     BOUNDED rows, the reference's region_server.rs:245 handle_remote_read
-    over substrait bytes)."""
-    return json.dumps(
-        {
-            "region_id": rid,
-            "time_range": list(pred.time_range) if pred.time_range else None,
-            "filters": [list(f) for f in pred.filters],
-            "projection": projection,
-            "agg": agg,
-            "plan": plan,
-        }
-    ).encode()
+    over substrait bytes).  `trace` carries the caller's W3C
+    `traceparent` header so the datanode's spans stitch under the
+    frontend's fan-out span (reference tracing_context in request
+    headers); absent, the ticket is byte-identical to the pre-trace wire
+    form."""
+    body = {
+        "region_id": rid,
+        "time_range": list(pred.time_range) if pred.time_range else None,
+        "filters": [list(f) for f in pred.filters],
+        "projection": projection,
+        "agg": agg,
+        "plan": plan,
+    }
+    if trace:
+        body["trace"] = trace
+    return json.dumps(body).encode()
 
 
-def decode_scan_ticket(raw: bytes) -> tuple[int, ScanPredicate, list[str] | None, dict | None, dict | None]:
+def decode_scan_ticket(
+    raw: bytes,
+) -> tuple[int, ScanPredicate, list[str] | None, dict | None, dict | None, dict]:
     d = json.loads(raw.decode())
     pred = ScanPredicate(
         time_range=tuple(d["time_range"]) if d["time_range"] else None,
         filters=[tuple(f) for f in d["filters"]],
     )
-    return d["region_id"], pred, d.get("projection"), d.get("agg"), d.get("plan")
+    return (
+        d["region_id"], pred, d.get("projection"), d.get("agg"),
+        d.get("plan"), d.get("trace") or {},
+    )
 
 
 def execute_region_plan(engine, rid: int, plan_dict: dict):
@@ -147,21 +158,48 @@ class DatanodeFlightServer(fl.FlightServerBase):
 
     # ---- reads (do_get) ---------------------------------------------------
     def do_get(self, context, ticket: fl.Ticket):
-        rid, pred, projection, agg, plan = decode_scan_ticket(ticket.ticket)
-        with _retryable_region_errors():
+        rid, pred, projection, agg, plan, trace = decode_scan_ticket(ticket.ticket)
+        if plan is not None:
+            stage = "datanode.subplan"
+        elif agg is not None:
+            stage = "datanode.partial_agg"
+        else:
+            stage = "datanode.scan"
+        # the frontend's traceparent rides the ticket: `extract_context`
+        # finally earns its keep — the datanode's scan/state stage becomes
+        # a child of the fan-out's per-region span across the Flight hop.
+        # No trace header = no span, the pre-trace behavior exactly.
+        span_cm = (
+            tracing.extract_context(
+                trace, name=stage, service="greptimedb_tpu.datanode",
+                region=rid,
+            )
+            if trace
+            else contextlib.nullcontext()
+        )
+        with span_cm as span, _retryable_region_errors():
             if plan is not None:
                 # general sub-plan: bounded rows back, never the raw region
-                return fl.RecordBatchStream(
-                    execute_region_plan(self.engine, rid, plan)
-                )
+                out = execute_region_plan(self.engine, rid, plan)
+                if span is not None:
+                    span.attributes["rows"] = out.num_rows
+                    span.attributes["bytes"] = out.nbytes
+                return fl.RecordBatchStream(out)
             table = self.engine.scan(rid, pred)
+            if span is not None:
+                # scan + index-pruning yield: what this sub-query actually
+                # read and ships back over the wire
+                span.attributes["rows"] = table.num_rows
+                span.attributes["bytes"] = table.nbytes
             if agg is not None:
                 from ..query.dist_agg import AggSpec, partial_states
 
                 # lower/state stage runs HERE; only [groups]-sized states ship
-                return fl.RecordBatchStream(
-                    partial_states(table, AggSpec.from_dict(agg))
-                )
+                states = partial_states(table, AggSpec.from_dict(agg))
+                if span is not None:
+                    span.attributes["state_rows"] = states.num_rows
+                    span.attributes["state_bytes"] = states.nbytes
+                return fl.RecordBatchStream(states)
             if projection:
                 keep = [c for c in projection if c in table.column_names]
                 table = table.select(keep)
@@ -171,11 +209,22 @@ class DatanodeFlightServer(fl.FlightServerBase):
     def do_put(self, context, descriptor: fl.FlightDescriptor, reader, writer):
         cmd = json.loads(descriptor.command.decode())
         rid = cmd["region_id"]
+        trace = cmd.get("trace") or {}
         affected = 0
-        with _retryable_region_errors():
+        span_cm = (
+            tracing.extract_context(
+                trace, name="datanode.write",
+                service="greptimedb_tpu.datanode", region=rid,
+            )
+            if trace
+            else contextlib.nullcontext()
+        )
+        with span_cm as span, _retryable_region_errors():
             for chunk in reader:
                 with self._lock:
                     affected += self.engine.write(rid, chunk.data)
+            if span is not None:
+                span.attributes["rows"] = affected
         writer.write(json.dumps({"affected_rows": affected}).encode())
 
     # ---- control (do_action) ----------------------------------------------
@@ -406,7 +455,11 @@ class FlightDatanodeClient:
         if not self.alive:
             raise ConnectionError(f"datanode {self.node_id} is down")
         fault_injection.fire("flight.do_put", node_id=self.node_id, region_id=rid)
-        descriptor = fl.FlightDescriptor.for_command(json.dumps({"region_id": rid}).encode())
+        cmd = {"region_id": rid}
+        trace = tracing.inject_context()
+        if trace:
+            cmd["trace"] = trace
+        descriptor = fl.FlightDescriptor.for_command(json.dumps(cmd).encode())
         try:
             writer, meta_reader = self._client.do_put(descriptor, batch.schema)
             writer.write_batch(batch)
@@ -423,7 +476,11 @@ class FlightDatanodeClient:
         if not self.alive:
             raise ConnectionError(f"datanode {self.node_id} is down")
         fault_injection.fire("flight.do_get", node_id=self.node_id, region_id=rid)
-        ticket = fl.Ticket(encode_scan_ticket(rid, pred, projection))
+        ticket = fl.Ticket(
+            encode_scan_ticket(
+                rid, pred, projection, trace=tracing.inject_context() or None
+            )
+        )
         try:
             with self._track_call() as token:
                 token["reader"] = self._client.do_get(ticket)
@@ -435,7 +492,11 @@ class FlightDatanodeClient:
         if not self.alive:
             raise ConnectionError(f"datanode {self.node_id} is down")
         fault_injection.fire("flight.do_get", node_id=self.node_id, region_id=rid)
-        ticket = fl.Ticket(encode_scan_ticket(rid, pred, agg=spec_dict))
+        ticket = fl.Ticket(
+            encode_scan_ticket(
+                rid, pred, agg=spec_dict, trace=tracing.inject_context() or None
+            )
+        )
         try:
             with self._track_call() as token:
                 token["reader"] = self._client.do_get(ticket)
@@ -448,7 +509,10 @@ class FlightDatanodeClient:
             raise ConnectionError(f"datanode {self.node_id} is down")
         fault_injection.fire("flight.do_get", node_id=self.node_id, region_id=rid)
         ticket = fl.Ticket(
-            encode_scan_ticket(rid, ScanPredicate(), plan=plan_dict)
+            encode_scan_ticket(
+                rid, ScanPredicate(), plan=plan_dict,
+                trace=tracing.inject_context() or None,
+            )
         )
         try:
             with self._track_call() as token:

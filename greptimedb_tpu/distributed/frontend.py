@@ -74,6 +74,20 @@ from .meta_service import MetaClient
 _LOG = logging.getLogger("greptimedb_tpu.frontend")
 
 
+def _maybe_span(name: str, parent, **attrs):
+    """A tracing span only when the statement is being traced (`parent`
+    non-None): fan-out workers run on pool threads, which do not inherit
+    contextvars, so the parent is captured on the submitting thread and
+    passed explicitly — this is what stitches per-region sub-query spans
+    under the statement root across the thread (and, via the injected
+    traceparent, the Flight) boundary."""
+    if parent is None:
+        import contextlib
+
+        return contextlib.nullcontext()
+    return tracing.span(name, parent=parent, **attrs)
+
+
 class Frontend:
     """Distributed SQL front door over remote datanodes."""
 
@@ -88,7 +102,10 @@ class Frontend:
         self.meta = MetaClient(metasrv_peers)
         self.catalog = Catalog(os.path.join(data_home, "catalog.json"))
         self.current_database = "public"
-        self.config = Config()
+        # layered load so env configuration (GREPTIMEDB_TPU__TRACE__SELF,
+        # breaker/replica knobs, ...) reaches the deployable frontend role
+        # the same way it reaches `greptimedb_tpu datanode`
+        self.config = Config.load()
         # backend stays "tpu" so the engine's distributed planner engages
         # (state shipping / sub-plan fan-out); with no tile context the
         # frontend never touches local devices — datanodes own the
@@ -182,6 +199,7 @@ class Frontend:
         br = self._breaker(node_id)
         if br is not None and not br.allow():
             metrics.BREAKER_SHED_TOTAL.inc()
+            tracing.add_event("breaker.shed", node=node_id)
             raise CircuitOpenError(
                 f"datanode {node_id} circuit open; shedding load"
             )
@@ -325,6 +343,12 @@ class Frontend:
             state["node"] = None
             state["routes"] = None  # force a fresh route on the next attempt
             metrics.ROUTE_REFRESH_TOTAL.inc()
+            # retries are point-in-time facts on the region's span, not
+            # stages: a hedged/retried read shows every attempt in ONE trace
+            tracing.add_event(
+                "retry", region=rid, attempt=attempt_no,
+                error=f"{type(exc).__name__}: {exc}"[:200],
+            )
 
         try:
             return self.retry_policy.call(attempt, on_retry=on_retry)
@@ -385,7 +409,7 @@ class Frontend:
     def sql(self, text: str) -> list:
         """Execute ;-separated SQL; returns a list of results (pa.Table
         for queries, int affected-rows for writes, None for DDL)."""
-        return [self._execute(stmt) for stmt in parse_sql(text)]
+        return [self._execute(stmt, query_text=text) for stmt in parse_sql(text)]
 
     def sql_one(self, text: str):
         out = self.sql(text)
@@ -403,19 +427,32 @@ class Frontend:
     def session_timezone(self) -> str:
         return "UTC"
 
-    def _execute(self, stmt):
+    def _execute(self, stmt, query_text: str | None = None):
         if isinstance(stmt, SelectStmt):
+            from ..utils.self_trace import statement_trace
+
             # same per-statement budget as Database._execute: the fan-out
             # (and every retry sleep under it) checks this deadline, so a
-            # hung datanode yields QueryTimeoutError, not a stuck query
-            with deadline_scope(self.config.query.timeout_s), self.admission.admit(
+            # hung datanode yields QueryTimeoutError, not a stuck query.
+            # statement_trace is outermost so admission wait, fan-out and
+            # per-region sub-queries are stages of one trace (off-safe:
+            # trace.self=false is a pass-through)
+            with statement_trace(
+                self, "sql", query_text or "SELECT ...", self.current_database
+            ), deadline_scope(self.config.query.timeout_s), self.admission.admit(
                 self.current_database
             ):
                 return self.query_engine.execute_select(stmt, self.current_database)
         if isinstance(stmt, CreateTableStmt):
             return self._create_table(stmt)
         if isinstance(stmt, InsertStmt):
-            return self._insert(stmt)
+            from ..utils.self_trace import statement_trace
+
+            with statement_trace(
+                self, "insert", query_text or "INSERT ...",
+                self.current_database,
+            ):
+                return self._insert(stmt)
         if isinstance(stmt, ShowStmt):
             return self._show(stmt)
         if isinstance(stmt, DescribeStmt):
@@ -526,32 +563,53 @@ class Frontend:
                     "cleanup step %s %s failed: %s", op, attrs or "", e
                 )
 
+    def _place_regions(self, m, schema):
+        """Open `m`'s regions on selected datanodes and publish the route
+        (shared by CREATE TABLE and programmatic system-table creation)."""
+        routes: dict[int, int] = {}
+        try:
+            for rid in m.region_ids:
+                node = self.meta.select_datanode()
+                if node is None:
+                    raise RetryLaterError("no live datanode to place region on")
+                self._with_client(node, lambda c, _r=rid: c.open_region(_r, schema))
+                routes[rid] = node
+        except Exception:
+            for rid, node in routes.items():
+                self._cleanup(
+                    "close_region",
+                    lambda _r=rid, _n=node: self._client(_n).close_region(_r),
+                    region_id=rid,
+                    node_id=node,
+                )
+            raise
+        self.meta.set_route(m.table_id, routes)
+
+    def ensure_system_table(self, name: str, schema, database: str = "public"):
+        """Create a single-region system table if missing (the frontend
+        twin of servers/otlp.py ensure_table — used by the self-trace
+        writer to land span rows through the normal write path)."""
+        try:
+            return self._table(name, database)
+        except TableNotFoundError:
+            pass
+        from ..models.partition import SingleRegionRule
+
+        return self.catalog.create_table(
+            name,
+            schema,
+            partition_rule=SingleRegionRule(),
+            database=database,
+            if_not_exists=True,
+            on_create=lambda m: self._place_regions(m, schema),
+        )
+
     def _create_table(self, stmt: CreateTableStmt):
         if stmt.external or stmt.engine in ("file", "metric"):
             raise UnsupportedError(
                 "external/metric tables are standalone-only for now"
             )
         schema, rule = build_schema_and_rule(stmt)
-
-        def place_regions(m):
-            routes: dict[int, int] = {}
-            try:
-                for rid in m.region_ids:
-                    node = self.meta.select_datanode()
-                    if node is None:
-                        raise RetryLaterError("no live datanode to place region on")
-                    self._with_client(node, lambda c, _r=rid: c.open_region(_r, schema))
-                    routes[rid] = node
-            except Exception:
-                for rid, node in routes.items():
-                    self._cleanup(
-                        "close_region",
-                        lambda _r=rid, _n=node: self._client(_n).close_region(_r),
-                        region_id=rid,
-                        node_id=node,
-                    )
-                raise
-            self.meta.set_route(m.table_id, routes)
 
         self.catalog.create_table(
             stmt.name,
@@ -560,7 +618,7 @@ class Frontend:
             database=getattr(stmt, "database", None) or self.current_database,
             if_not_exists=stmt.if_not_exists,
             options=stmt.options,
-            on_create=place_regions,
+            on_create=lambda m: self._place_regions(m, schema),
         )
         return None
 
@@ -650,16 +708,21 @@ class Frontend:
         table = pa.Table.from_batches([batch])
         affected = 0
         region_ids = meta.region_ids
+        trace_parent = tracing.current_span()
         with self.admission.admit(meta.database, kind="write"):
             for i, part in enumerate(meta.partition_rule.split(table)):
                 if part.num_rows == 0:
                     continue
                 rid = region_ids[i]
                 for b in part.to_batches():
-                    affected += self._call_region(
-                        meta, rid, lambda c, r, _b=b: c.write(r, _b),
-                        routes=routes, write=True,
-                    )
+                    with _maybe_span(
+                        "write.region", trace_parent, region=rid,
+                        rows=b.num_rows,
+                    ):
+                        affected += self._call_region(
+                            meta, rid, lambda c, r, _b=b: c.write(r, _b),
+                            routes=routes, write=True,
+                        )
         if affected:
             # flows are a derived view: mirror AFTER the write is durable,
             # asynchronously, and never let a mirror failure reach the user
@@ -893,6 +956,7 @@ class Frontend:
                 raise errors[0]
             if is_hedge:
                 metrics.HEDGE_WINS_TOTAL.inc()
+                tracing.add_event("hedge.win", region=rid)
             return value
 
     def _fanout(self, meta, fn):
@@ -922,6 +986,9 @@ class Frontend:
         deadline = current_deadline()
         followers = self._followers_for(meta)
         hedge_delay = self._hedge_delay_s() if followers else None
+        # captured HERE (the statement's thread): pool workers see no
+        # contextvars, so each region sub-query span is parented explicitly
+        trace_parent = tracing.current_span()
 
         def give_up(failed: list[int], last_exc: Exception):
             raise RetryLaterError(
@@ -933,11 +1000,12 @@ class Frontend:
             results = []
             for rid in rids:
                 try:
-                    results.append(
-                        self._call_region(
-                            meta, rid, fn, routes=routes, record_latency=True
+                    with _maybe_span("fanout.region", trace_parent, region=rid):
+                        results.append(
+                            self._call_region(
+                                meta, rid, fn, routes=routes, record_latency=True
+                            )
                         )
-                    )
                 except Exception as exc:  # noqa: BLE001 — classified below
                     if not is_transient(exc):
                         raise
@@ -947,12 +1015,17 @@ class Frontend:
 
         pool = self._executor()
         inflight: dict[int, tuple[int, int]] = {}  # rid -> (node, worker thread)
+
+        def _region_worker(rid):
+            # one child span per region sub-query; its traceparent is
+            # injected into the Flight ticket by the client, extracted on
+            # the datanode (the reference propagates tracing context
+            # across every RPC boundary the same way)
+            with _maybe_span("fanout.region", trace_parent, region=rid):
+                return self._call_region(meta, rid, fn, routes, inflight, True)
+
         futures = {
-            rid: pool.submit(
-                propagate(self._call_region), meta, rid, fn, routes, inflight,
-                True,
-            )
-            for rid in rids
+            rid: pool.submit(propagate(_region_worker), rid) for rid in rids
         }
         # per-region completion queues fed by future done-callbacks: the
         # settle loop blocks on its region's queue, so hedges armed by the
@@ -969,7 +1042,10 @@ class Frontend:
             # deadline into the pool worker
             def _hedge_worker(node, hrid):
                 hedge_threads[hrid] = threading.get_ident()
-                return self._hedge_call(node, hrid, fn)
+                with _maybe_span(
+                    "fanout.hedge", trace_parent, region=hrid, node=node
+                ):
+                    return self._hedge_call(node, hrid, fn)
 
             hedge_fn = propagate(_hedge_worker)
             for rid, fut in futures.items():
@@ -1100,6 +1176,9 @@ class Frontend:
             pass
 
     def close(self):
+        from ..utils import self_trace
+
+        self_trace.stop(self)
         self._hedge_wheel.stop()
         self.mirror.close()
         with self._pool_lock:

@@ -85,7 +85,7 @@ from ..storage.dictionary import TableDictionary
 from ..storage.region import OP_COL, Region
 from ..storage.sst import FileMeta
 from ..query import analyze, passes
-from ..utils import metrics
+from ..utils import metrics, tracing
 from ..utils.deadline import check_deadline, current_deadline
 from ..utils.errors import QueryTimeoutError
 from ..utils.fault_injection import fire as _fault_fire
@@ -937,6 +937,38 @@ class TileCacheManager:
         pk_cols: list[str],
         device_upload: bool = True,
     ) -> tuple[_SuperTiles | None, list[FileMeta]]:
+        """Traced facade over `_super_tiles_impl`: one `tile.build` span
+        per region with the resolved mode — warm hit, delta extend,
+        persisted load or cold build — so ROADMAP's cold-path hunts read
+        the structure off a trace instead of print statements."""
+        with tracing.span(
+            "tile.build", region=region.region_id, files=len(metas)
+        ) as s:
+            out = self._super_tiles_impl(
+                region, dictionary, metas, tag_cols, ts_col, value_cols,
+                pinned_regions, pk_cols, device_upload, s,
+            )
+            entry = out[0]
+            if entry is not None:
+                s.attributes.setdefault("mode", "cold")
+                s.attributes["rows"] = entry.num_rows
+            else:
+                s.attributes.setdefault("mode", "none")
+            return out
+
+    def _super_tiles_impl(
+        self,
+        region: Region,
+        dictionary: TableDictionary,
+        metas: list[FileMeta],
+        tag_cols: list[str],
+        ts_col: str | None,
+        value_cols: list[str],
+        pinned_regions: set[int],
+        pk_cols: list[str],
+        device_upload: bool = True,
+        build_span=None,
+    ) -> tuple[_SuperTiles | None, list[FileMeta]]:
         """Cached (or freshly consolidated) device tiles for one region's
         SST set.  Returns (entry, excluded): `excluded` lists files that
         cannot join the super-tile (missing tag/ts column, row-count
@@ -1009,6 +1041,8 @@ class TileCacheManager:
                         tag_cols + pk_cols, ts_col, sort_cols,
                         pinned_regions,
                     )
+                if extended is not None and build_span is not None:
+                    build_span.attributes["mode"] = "delta"
                 if extended is None:
                     passes.note("incremental_tile", False, why, region=rid)
                     with self._lock:
@@ -1030,6 +1064,8 @@ class TileCacheManager:
             missing = [c for c in need if c not in entry.cols]
             if not missing and entry.valid is not None:
                 metrics.TILE_CACHE_HITS.inc()
+                if build_span is not None and "mode" not in build_span.attributes:
+                    build_span.attributes["mode"] = "warm"
                 return entry, excluded
 
             # a matching persisted consolidation already holds the order +
@@ -1041,6 +1077,8 @@ class TileCacheManager:
             host_tiles: list[_FileHostTiles] | None
             if use_persisted:
                 host_tiles = None
+                if build_span is not None and "mode" not in build_span.attributes:
+                    build_span.attributes["mode"] = "persisted"
             else:
                 # host encodes (cheap when cached); these may GROW the
                 # dictionary, so callers build the plan only after every
@@ -2244,13 +2282,15 @@ def _tile_program_cached(plan, nullable_cols, spec):
     The lock makes the miss-delta attribution exact under concurrent
     queries — program BUILD is cheap closure assembly (XLA tracing happens
     at first dispatch), so serializing it costs nothing."""
-    with _program_cache_lock:
+    with _program_cache_lock, tracing.span("tile.compile") as s:
         before = _tile_program.cache_info().misses
         out = _tile_program(plan, nullable_cols, spec)
         if _tile_program.cache_info().misses > before:
             metrics.TPU_COMPILE_CACHE_MISSES.inc()
+            s.attributes["cache"] = "miss"
         else:
             metrics.TPU_COMPILE_CACHE_HITS.inc()
+            s.attributes["cache"] = "hit"
     return out
 
 
@@ -2650,6 +2690,14 @@ class TileExecutor:
             metrics.HBM_EXHAUSTED_TOTAL.inc()
             halved = self.cache.degrade_chunks(int(adm.min_chunk_rows))
             self.cache.emergency_release(set())
+            # degrade rounds are events on the statement's trace, so an
+            # OOM-surviving query shows every halve-and-retry rung
+            tracing.add_event(
+                "hbm.degrade",
+                attempt=attempt + 1,
+                chunk_rows=self.cache.chunk_rows,
+                halved=halved,
+            )
             log.warning(
                 "device OOM survived emergency retry: chunk_rows -> %d "
                 "(attempt %d/%d), rebuilding with smaller dispatches",
@@ -2765,6 +2813,7 @@ class TileExecutor:
             )
         if rec.result is not None:
             metrics.DISPATCH_COALESCED_TOTAL.inc()
+            tracing.add_event("dispatch.coalesced", table=ctx.table_key)
             lowering.post_done = rec.post_done
         return rec.result
 
@@ -3401,7 +3450,12 @@ class TileExecutor:
                 # RESOURCE_EXHAUSTED to drive the emergency-release +
                 # halve-chunk feedback loop without a real 16 GB set
                 _fault_fire("hbm.exhausted", table=ctx.table_key)
-                packed = program(tuple(device_sources), dyn)
+                with tracing.span(
+                    "tile.dispatch",
+                    strategy=attempt_plan.agg_strategy,
+                    acc=attempt_plan.acc_dtype,
+                ):
+                    packed = program(tuple(device_sources), dyn)
                 table = self._finalize(
                     packed, int_layout, acc32_layout, acc64_layout, int_dtype,
                     attempt_plan, lowering, schema, ctx, dyn_host, fspec,
@@ -3423,8 +3477,17 @@ class TileExecutor:
                     if isinstance(s, _SuperTiles):
                         self.cache.release_unneeded(s, need)
                 self.cache.emergency_release(pinned_ids)
+                tracing.add_event(
+                    "hbm.emergency_release", table=ctx.table_key
+                )
                 _fault_fire("hbm.exhausted", table=ctx.table_key)
-                packed = program(tuple(device_sources), dyn)
+                with tracing.span(
+                    "tile.dispatch",
+                    strategy=attempt_plan.agg_strategy,
+                    acc=attempt_plan.acc_dtype,
+                    retry=True,
+                ):
+                    packed = program(tuple(device_sources), dyn)
                 table = self._finalize(
                     packed, int_layout, acc32_layout, acc64_layout, int_dtype,
                     attempt_plan, lowering, schema, ctx, dyn_host, fspec,
@@ -3714,7 +3777,13 @@ class TileExecutor:
             # (limb verdict failure) re-streams and re-records
             try:
                 _fault_fire("hbm.exhausted", table=ctx.table_key)
-                packed = program(make_sources(), dyn, sync=True)
+                with tracing.span(
+                    "tile.dispatch",
+                    strategy=attempt_plan.agg_strategy,
+                    acc=attempt_plan.acc_dtype,
+                    streamed=True,
+                ):
+                    packed = program(make_sources(), dyn, sync=True)
             except QueryTimeoutError:
                 raise  # the deadline owns the query
             except Exception as e:  # noqa: BLE001 — fall to all-at-once
@@ -4824,30 +4893,40 @@ class TileExecutor:
         # ONE logical host fetch total, regardless of how many aggregates
         # ran; transfer and host-decode are metered separately so
         # streamed-readback wins stay attributable (the combined
-        # readback_ms conflates link time with waiting out the dispatch)
-        t0 = time.perf_counter()
-        fetched = self._fetch_result(packed)
-        buf, accs64 = fetched[0], fetched[1]
-        # hash strategy ships the slot->gid key table as a third part
-        table_keys = fetched[2] if len(fetched) > 2 else None
-        ms = (time.perf_counter() - t0) * 1000.0
-        metrics.TILE_READBACK_MS.observe(ms)
-        metrics.TPU_READBACK_MS.observe(ms)
-        metrics.TPU_READBACK_TRANSFER_MS.observe(ms)
-        metrics.TPU_READBACK_BYTES.inc(sum(p.nbytes for p in fetched))
-        metrics.TPU_DEVICE_FETCHES.inc()
-        self._rb_local.transfer_ms = ms
-        t_dec = time.perf_counter()
-        try:
-            return self._decode_result(
-                buf, accs64, int_layout, acc32_layout, acc64_layout,
-                int_dtype, plan, lowering, ctx, dyn_host, spec,
-                table_keys=table_keys,
+        # readback_ms conflates link time with waiting out the dispatch).
+        # The span carries both figures: on an async dispatch the transfer
+        # time here INCLUDES waiting out the device compute, which is what
+        # makes readback the honest place to look for slow dispatches.
+        with tracing.span("tile.readback") as rb_span:
+            t0 = time.perf_counter()
+            fetched = self._fetch_result(packed)
+            buf, accs64 = fetched[0], fetched[1]
+            # hash strategy ships the slot->gid key table as a third part
+            table_keys = fetched[2] if len(fetched) > 2 else None
+            ms = (time.perf_counter() - t0) * 1000.0
+            metrics.TILE_READBACK_MS.observe(ms)
+            metrics.TPU_READBACK_MS.observe(ms)
+            metrics.TPU_READBACK_TRANSFER_MS.observe(ms)
+            metrics.TPU_READBACK_BYTES.inc(sum(p.nbytes for p in fetched))
+            metrics.TPU_DEVICE_FETCHES.inc()
+            self._rb_local.transfer_ms = ms
+            rb_span.attributes["transfer_ms"] = round(ms, 3)
+            rb_span.attributes["bytes"] = sum(p.nbytes for p in fetched)
+            rb_span.attributes["device_finalize"] = bool(
+                getattr(lowering, "post_done", None)
             )
-        finally:
-            dec_ms = (time.perf_counter() - t_dec) * 1000.0
-            metrics.TPU_READBACK_DECODE_MS.observe(dec_ms)
-            self._rb_local.decode_ms = dec_ms
+            t_dec = time.perf_counter()
+            try:
+                return self._decode_result(
+                    buf, accs64, int_layout, acc32_layout, acc64_layout,
+                    int_dtype, plan, lowering, ctx, dyn_host, spec,
+                    table_keys=table_keys,
+                )
+            finally:
+                dec_ms = (time.perf_counter() - t_dec) * 1000.0
+                metrics.TPU_READBACK_DECODE_MS.observe(dec_ms)
+                self._rb_local.decode_ms = dec_ms
+                rb_span.attributes["decode_ms"] = round(dec_ms, 3)
 
     def _decode_result(
         self, buf, accs64, int_layout, acc32_layout, acc64_layout,

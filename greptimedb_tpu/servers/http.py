@@ -162,7 +162,14 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._handle_prof_mem(params)
             return self._send(404, {"error": f"no route {route}"})
         except GreptimeError as e:
-            self._send(400, {"error": str(e), "code": int(e.status_code())})
+            # the root trace id (attached by the self-observability loop
+            # when trace.self is on) makes a user-reported failure one
+            # Jaeger lookup away
+            payload = {"error": str(e), "code": int(e.status_code())}
+            trace_id = getattr(e, "trace_id", None)
+            if trace_id:
+                payload["trace_id"] = trace_id
+            self._send(400, payload)
         except Exception as e:  # noqa: BLE001
             import logging
             import traceback
@@ -305,9 +312,14 @@ class _Handler(BaseHTTPRequestHandler):
         if params.get("db"):
             self.db.current_database = params["db"]
         from ..utils import kernel_executor
+        from ..utils.tracing import protocol_scope
 
         outputs = []
-        for result in kernel_executor.run(lambda: list(self.db.sql(sql))):
+        # protocol tag for the statement's root span (kernel_executor runs
+        # the closure under a COPY of this context, so the scope crosses)
+        with protocol_scope("http"):
+            results = kernel_executor.run(lambda: list(self.db.sql(sql)))
+        for result in results:
             if isinstance(result, int):
                 outputs.append({"affectedrows": result})
             elif result is None:

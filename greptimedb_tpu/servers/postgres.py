@@ -430,8 +430,12 @@ class _Handler(socketserver.BaseRequestHandler):
         if first in ("DISCARD", "RESET"):
             yield None, first
             return
+        from ..utils.tracing import protocol_scope
+
         for stmt in parse_sql(sql):
-            result = srv.db.execute_stmt(stmt, query_text=sql)
+            # protocol tag for the statement's root span (self-observability)
+            with protocol_scope("postgres"):
+                result = srv.db.execute_stmt(stmt, query_text=sql)
             if isinstance(result, pa.Table):
                 yield result, ""
             elif isinstance(stmt, InsertStmt):

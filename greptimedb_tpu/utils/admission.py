@@ -46,6 +46,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from contextlib import nullcontext as _nullcontext
+
 from . import metrics
 from .deadline import current_deadline
 from .errors import RetryLaterError
@@ -225,7 +227,22 @@ class AdmissionController:
             # entry: folding queue wait into the EWMA would inflate the
             # expected-wait estimate under congestion (more waiting ->
             # bigger estimate -> more deadline sheds, a feedback loop)
-            t_granted = self._acquire(tenant, priority, t_enter)
+            from . import tracing
+
+            # queue wait is a traced stage of the statement when one is
+            # being traced (a shed raises through the span and is marked
+            # as its error status); untraced statements skip the span
+            wait_cm = (
+                tracing.span("admission.wait", tenant=tenant, kind=kind)
+                if tracing.current_span() is not None
+                else _nullcontext()
+            )
+            with wait_cm as wait_span:
+                t_granted = self._acquire(tenant, priority, t_enter)
+                if wait_span is not None:
+                    wait_span.attributes["wait_ms"] = round(
+                        (t_granted - t_enter) * 1000.0, 3
+                    )
             self._tls.held = getattr(self._tls, "held", 0) + 1
             try:
                 yield

@@ -258,6 +258,42 @@ class TelemetryConfig:
 
 
 @dataclasses.dataclass
+class TraceConfig:
+    """Self-observability loop (utils/self_trace.py): end-to-end statement
+    tracing exported into the database's OWN trace table, a tail-sampled
+    slow-query log with full span trees, and a periodic /metrics
+    self-scrape into the metric engine — the zero-egress twin of the
+    reference's standalone self-monitoring (its standalone mode imports
+    its own telemetry).
+
+    Everything is off-safe: `enabled = False` (the `trace.self` knob on
+    the TOML/env surface — `self` cannot be a dataclass field name)
+    restores today's behavior bit-for-bit — no root statement spans, no
+    writer threads, no scrape.  With it on, fast statements head-sample
+    at `sample_ratio`; statements slower than `slow_query_ms` (or
+    erroring) are always kept AND land in greptime_private.slow_queries
+    with their span tree."""
+
+    # TOML/env alias: `[trace] self = true` / GREPTIMEDB_TPU__TRACE__SELF.
+    _ALIASES = {"self": "enabled"}
+
+    enabled: bool = False
+    # Head-sampling ratio for statements that finish fast and clean; slow
+    # or erroring statements are force-kept regardless (tail sampling).
+    sample_ratio: float = 0.01
+    # Force-keep threshold: a statement slower than this keeps its full
+    # trace and writes a slow_queries row with the span tree attached.
+    slow_query_ms: float = 5000.0
+    # Metric self-scrape cadence: every interval the /metrics registry is
+    # snapshotted into the metric engine (database greptime_private is NOT
+    # used — rows land in `public` so PromQL/TQL range queries work
+    # without USE), 0 disables.  Standalone only (needs the metric engine).
+    scrape_interval_s: float = 0.0
+    # SelfTraceWriter drain cadence (exporter ring -> opentelemetry_traces).
+    export_interval_s: float = 0.25
+
+
+@dataclasses.dataclass
 class SlowQueryConfig:
     """Slow-query recording (reference common/telemetry SlowQueryOptions +
     event recorder into greptime_private.slow_queries)."""
@@ -487,6 +523,7 @@ class Config:
     admission: AdmissionConfig = dataclasses.field(default_factory=AdmissionConfig)
     flow: FlowConfig = dataclasses.field(default_factory=FlowConfig)
     index: IndexConfig = dataclasses.field(default_factory=IndexConfig)
+    trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
 
     def __post_init__(self):
         self.storage.__post_init__()
@@ -698,6 +735,33 @@ class Config:
                 "below that the dense path is always cheaper than a hash "
                 f"table; got {q.agg_hash_min_group_space!r}"
             )
+        tr = self.trace
+        if not isinstance(tr.enabled, bool):
+            raise ConfigError(
+                "trace.self must be a boolean (self-observability loop: "
+                f"statement tracing into the own trace store); got {tr.enabled!r}"
+            )
+        if not (0.0 <= tr.sample_ratio <= 1.0):
+            raise ConfigError(
+                "trace.sample_ratio must be in [0, 1] — the head-sampling "
+                f"fraction for fast clean statements; got {tr.sample_ratio!r}"
+            )
+        if tr.slow_query_ms < 0:
+            raise ConfigError(
+                "trace.slow_query_ms must be >= 0 milliseconds (statements "
+                "slower than this force-keep their trace and land in "
+                f"slow_queries); got {tr.slow_query_ms!r}"
+            )
+        if tr.scrape_interval_s < 0:
+            raise ConfigError(
+                "trace.scrape_interval_s must be >= 0 seconds (0 disables "
+                f"the /metrics self-scrape); got {tr.scrape_interval_s!r}"
+            )
+        if tr.export_interval_s <= 0:
+            raise ConfigError(
+                "trace.export_interval_s must be > 0 seconds — the "
+                f"SelfTraceWriter drain cadence; got {tr.export_interval_s!r}"
+            )
         fl = self.flow
         if not isinstance(fl.incremental, bool):
             raise ConfigError(
@@ -747,6 +811,12 @@ class Config:
             overlay = d.get(section_field.name, {})
             if not isinstance(overlay, dict):
                 continue
+            # per-section key aliases (e.g. the documented `trace.self`
+            # knob maps to TraceConfig.enabled — `self` cannot be a
+            # dataclass field name)
+            aliases = getattr(type(section), "_ALIASES", {})
+            if aliases:
+                overlay = {aliases.get(k, k): v for k, v in overlay.items()}
             for f in dataclasses.fields(section):
                 if f.name in overlay:
                     raw = overlay[f.name]

@@ -40,6 +40,9 @@ _SLOW_QUERY_DDL = (
     "  query STRING,"
     "  is_promql BOOLEAN,"
     "  query_database STRING,"
+    "  trace_id STRING,"
+    "  fingerprint STRING,"
+    "  span_tree STRING,"
     "  ts TIMESTAMP(3),"
     "  TIME INDEX (ts),"
     "  PRIMARY KEY (seq)"
@@ -87,7 +90,14 @@ class EventRecorder:
         threshold_ms: int,
         database: str,
         is_promql: bool = False,
+        trace_id: str = "",
+        fingerprint: str = "",
+        span_tree: str = "",
     ):
+        """`trace_id`/`fingerprint`/`span_tree` are filled by the
+        self-observability loop (utils/self_trace.py) when a traced
+        statement is force-kept: a user-reported slow query is then one
+        Jaeger lookup away from its full span tree."""
         self._offer(
             (
                 SLOW_QUERY_TABLE,
@@ -97,6 +107,9 @@ class EventRecorder:
                     "query": query,
                     "is_promql": is_promql,
                     "query_database": database,
+                    "trace_id": trace_id,
+                    "fingerprint": fingerprint,
+                    "span_tree": span_tree,
                     "ts": int(time.time() * 1000),
                 },
             )
@@ -139,7 +152,54 @@ class EventRecorder:
             self.db.catalog.create_database(EVENTS_DATABASE, if_not_exists=True)
         self.db.sql(_SLOW_QUERY_DDL)
         self.db.sql(_EVENTS_DDL)
+        self._migrate_slow_queries()
         self._ready = True
+
+    def _migrate_slow_queries(self):
+        """A pre-existing data dir created before the self-observability
+        loop holds a slow_queries table WITHOUT the trace columns, and
+        CREATE IF NOT EXISTS keeps that old schema — _conform_batch would
+        then silently drop trace_id/fingerprint/span_tree from every row.
+        Widen in place (regions first, catalog second — the ALTER
+        ordering rule), programmatically because ALTER TABLE does not
+        take db-qualified names and this thread must not flip the shared
+        current_database."""
+        from ..datatypes.data_type import ConcreteDataType
+        from ..datatypes.schema import ColumnSchema, SemanticType
+
+        try:
+            meta = self.db.catalog.table(SLOW_QUERY_TABLE, EVENTS_DATABASE)
+            missing = [
+                c
+                for c in ("trace_id", "fingerprint", "span_tree")
+                if not meta.schema.has_column(c)
+            ]
+            if not missing:
+                return
+            with self.db.ddl_lock:
+                meta = self.db.catalog.table(SLOW_QUERY_TABLE, EVENTS_DATABASE)
+                schema = meta.schema
+                for name in missing:
+                    if schema.has_column(name):
+                        continue
+                    schema = schema.add_column(
+                        ColumnSchema(
+                            name=name,
+                            data_type=ConcreteDataType.STRING,
+                            semantic_type=SemanticType.FIELD,
+                            nullable=True,
+                        )
+                    )
+                for rid in meta.region_ids:
+                    self.db.storage.region(rid).alter_schema(schema)
+                meta.schema = schema
+                self.db.catalog.update_table(meta)
+        except Exception:  # noqa: BLE001 — the recorder must never kill the server
+            import logging
+
+            logging.getLogger("greptimedb_tpu.events").warning(
+                "slow_queries trace-column migration failed", exc_info=True
+            )
 
     def _run(self):
         pending: dict[str, list[dict]] = {}
@@ -219,6 +279,13 @@ class SlowQueryTimer:
 
     def __exit__(self, *exc):
         if self.recorder is None or not self.cfg.enable:
+            return False
+        from . import tracing
+
+        if tracing.active_collector() is not None:
+            # a self-traced statement's slow row is written by the trace
+            # finalizer (utils/self_trace.py) WITH its span tree attached;
+            # writing here too would duplicate the row
             return False
         elapsed_ms = int((time.perf_counter() - self._t0) * 1000)
         if elapsed_ms < self.cfg.threshold_ms:

@@ -69,6 +69,12 @@ Named points wired into the codebase:
                        _build_indexes; ctx: file) — an injected error
                        yields an SST with NO sidecar (unpruned but
                        correct); the write itself must survive
+    trace.self_write   SelfTraceWriter flush (utils/self_trace.py), fired
+                       before each batch of spans is written into the own
+                       trace table — an injected error here proves the
+                       best-effort contract: the batch is dropped and
+                       counted, the traced query is never failed or
+                       slowed
 
 Production overhead is near zero: `fire()` is a module-level function whose
 fast path is one read of a module global (`_ARMED`) — no locks, no dict
@@ -123,6 +129,7 @@ POINTS = frozenset(
         "flow.expire",
         "index.segment_read",
         "index.build",
+        "trace.self_write",
     }
 )
 

@@ -152,6 +152,45 @@ class Registry:
             lines.extend(self._metrics[name].render())
         return "\n".join(lines) + "\n"
 
+    def snapshot(self) -> list[tuple[str, str, list[tuple[dict, float]]]]:
+        """Point-in-time numeric view of every metric, for the metric
+        self-scrape (utils/self_trace.py MetricScrapeTask): a list of
+        (name, kind, [(labels, value)]) with histograms expanded into
+        Prometheus-convention `_bucket` (cumulative, `le` label) / `_sum`
+        / `_count` series — the exact series a real Prometheus scrape of
+        /metrics would store, so PromQL over the self-scraped tables
+        behaves like PromQL over an external scrape."""
+        with self._lock:
+            metrics_items = list(self._metrics.items())
+        out: list[tuple[str, str, list[tuple[dict, float]]]] = []
+        for name, m in metrics_items:
+            if isinstance(m, Histogram):
+                buckets: list[tuple[dict, float]] = []
+                sums: list[tuple[dict, float]] = []
+                counts: list[tuple[dict, float]] = []
+                with m._lock:
+                    keys = list(m._counts)
+                    for key in keys:
+                        labels = dict(key)
+                        cum = 0
+                        for ub, c in zip(m.buckets, m._counts[key]):
+                            cum += c
+                            buckets.append(({**labels, "le": repr(ub)}, float(cum)))
+                        buckets.append(({**labels, "le": "+Inf"}, float(m._totals[key])))
+                        sums.append((labels, float(m._sums[key])))
+                        counts.append((labels, float(m._totals[key])))
+                if counts:
+                    out.append((f"{name}_bucket", "histogram", buckets))
+                    out.append((f"{name}_sum", "histogram", sums))
+                    out.append((f"{name}_count", "histogram", counts))
+                continue
+            kind = "gauge" if isinstance(m, Gauge) else "counter"
+            with m._lock:
+                entries = [(dict(key), float(v)) for key, v in m._values.items()]
+            if entries:
+                out.append((name, kind, entries))
+        return out
+
 
 REGISTRY = Registry()
 
@@ -470,4 +509,35 @@ FAILOVER_REQUESTED_TOTAL = REGISTRY.counter(
     "greptime_failover_requested_total",
     "Frontend-initiated failovers the metasrv accepted and ran "
     "(breaker-aware write routing)",
+)
+
+# Self-observability loop (utils/tracing.py ring exporter +
+# utils/self_trace.py writer/scrape): the database tracing itself into its
+# own trace store, slow-query log and metric engine.
+TRACE_SPANS_DROPPED = REGISTRY.counter(
+    "greptime_trace_spans_dropped_total",
+    "Spans shed by the exporter ring buffer (oldest-first) because the "
+    "self-trace writer fell behind or self-tracing is off",
+)
+TRACE_SAMPLED_TOTAL = REGISTRY.counter(
+    "greptime_trace_sampled_total",
+    "Tail-sampling decisions per traced statement (labels: decision = "
+    "slow | error | sampled | dropped)",
+)
+SELF_TRACE_ROWS = REGISTRY.counter(
+    "greptime_self_trace_rows_total",
+    "Span rows the SelfTraceWriter wrote into the own trace table",
+)
+SELF_TRACE_WRITE_FAILURES = REGISTRY.counter(
+    "greptime_self_trace_write_failures_total",
+    "Self-trace write batches dropped after a write failure (best-effort "
+    "by contract: a trace-write failure never fails the traced query)",
+)
+SELF_SCRAPE_ROWS = REGISTRY.counter(
+    "greptime_self_scrape_rows_total",
+    "Metric samples the self-scrape task wrote into the metric engine",
+)
+SELF_SCRAPE_RUNS = REGISTRY.counter(
+    "greptime_self_scrape_runs_total",
+    "Completed /metrics self-scrape rounds",
 )

@@ -114,6 +114,55 @@ def pytest_sessionstart(session):
         )
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _span_taxonomy_gate():
+    """Every DOTTED span stage name emitted while the session ran must
+    appear in the README's documented span taxonomy (the
+    `<!-- span-taxonomy:begin -->` block) — stage names are a stable
+    contract consumed by operators querying the own trace store, so
+    instrumentation cannot silently drift from the docs.  Undotted names
+    are exempt: tests create synthetic spans ("parent", "child") that are
+    not product stages.  Mirrors the fault-point coverage gate above,
+    enforced at session teardown because spans are only known after the
+    tests ran."""
+    yield
+    import fnmatch
+    import pathlib
+    import re
+
+    from greptimedb_tpu.utils.tracing import SEEN_SPAN_NAMES
+
+    seen = {n for n in SEEN_SPAN_NAMES if "." in n}
+    if not seen:
+        return
+    readme = pathlib.Path(__file__).parent.parent / "README.md"
+    text = readme.read_text(encoding="utf-8")
+    m = re.search(
+        r"<!-- span-taxonomy:begin -->(.*?)<!-- span-taxonomy:end -->",
+        text,
+        re.S,
+    )
+    assert m, (
+        "README.md lost its span-taxonomy block "
+        "(<!-- span-taxonomy:begin --> ... <!-- span-taxonomy:end -->)"
+    )
+    taxonomy = set(re.findall(r"`([^`\s]+)`", m.group(1)))
+    unmatched = sorted(
+        n
+        for n in seen
+        if n not in taxonomy
+        and not any(
+            fnmatch.fnmatch(n, pat) for pat in taxonomy if "*" in pat
+        )
+    )
+    assert not unmatched, (
+        f"span stage names emitted but missing from the README span "
+        f"taxonomy: {unmatched} — document them in the "
+        "<!-- span-taxonomy:begin --> block (stage names are a stable "
+        "contract) or rename the span"
+    )
+
+
 @pytest.fixture()
 def tmp_engine(tmp_path):
     from greptimedb_tpu.storage.engine import TimeSeriesEngine

@@ -37,18 +37,25 @@ def make_batch(schema, hosts, tss, vals):
 
 def test_ticket_roundtrip():
     pred = ScanPredicate(time_range=(10, 20), filters=[("host", "=", "h1")])
-    rid, out, proj, agg, plan = decode_scan_ticket(encode_scan_ticket(7, pred, ["ts", "v"]))
+    rid, out, proj, agg, plan, trace = decode_scan_ticket(
+        encode_scan_ticket(7, pred, ["ts", "v"])
+    )
     assert rid == 7
     assert out.time_range == (10, 20)
     assert out.filters == [("host", "=", "h1")]
     assert proj == ["ts", "v"]
     assert agg is None
     assert plan is None
+    assert trace == {}
     spec = {"group_tags": ["host"], "bucket": None, "agg_specs": [["count", None]]}
-    _rid, _out, _proj, agg2, _plan = decode_scan_ticket(
+    _rid, _out, _proj, agg2, _plan, _trace = decode_scan_ticket(
         encode_scan_ticket(7, pred, agg=spec)
     )
     assert agg2 == spec
+    # the traceparent rides the ticket and round-trips untouched
+    hdr = {"traceparent": f"00-{'ab' * 16}-{'cd' * 8}-01"}
+    *_rest, trace2 = decode_scan_ticket(encode_scan_ticket(7, pred, trace=hdr))
+    assert trace2 == hdr
 
 
 @pytest.fixture()

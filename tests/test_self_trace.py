@@ -1,0 +1,504 @@
+"""Self-observability loop: end-to-end hot-path tracing into the
+database's own trace store, slow-query log and metric self-scrape.
+
+Covers the whole contract: ring-buffer exporter semantics, exception
+marking on spans, tolerant traceparent parsing, tail sampling
+(slow/error force-keep vs head sampling), the SelfTraceWriter draining
+into `opentelemetry_traces` (queryable via the own Jaeger API), span
+parenting ACROSS the Flight hop on a live process cluster, slow-query
+capture with span trees, trace-write-failure harmlessness (fault point
+`trace.self_write`), the reentrancy guard (self-trace writes generate no
+spans), and the /metrics self-scrape into the metric engine.
+"""
+
+import json
+import time as _time
+
+import pytest
+
+from greptimedb_tpu.utils import fault_injection as fi
+from greptimedb_tpu.utils import metrics, tracing
+from greptimedb_tpu.utils.errors import RetryLaterError, TableNotFoundError
+from greptimedb_tpu.utils.self_trace import (
+    MetricScrapeTask,
+    statement_fingerprint,
+    statement_trace,
+)
+from greptimedb_tpu.utils.tracing import EXPORTER, Span, SpanExporter, extract_context, span
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.REGISTRY.disarm()
+    yield
+    fi.REGISTRY.disarm()
+
+
+def _mk_span(i: int) -> Span:
+    return Span(name=f"s{i}", trace_id="t" * 32, span_id=f"{i:016d}", parent_id=None)
+
+
+# ---- satellite: exporter ring buffer ---------------------------------------
+
+
+def test_exporter_ring_drops_oldest_and_counts():
+    exp = SpanExporter(capacity=3)
+    before = metrics.TRACE_SPANS_DROPPED.total()
+    for i in range(5):
+        exp.export(_mk_span(i))
+    names = [s.name for s in exp.spans()]
+    # ring semantics: the NEWEST spans survive, the oldest are shed
+    assert names == ["s2", "s3", "s4"]
+    assert exp.dropped == 2
+    # drain empties atomically and publishes the accumulated drop count
+    # (synced here, off the per-span hot path)
+    assert [s.name for s in exp.drain()] == ["s2", "s3", "s4"]
+    assert exp.spans() == []
+    assert exp.dropped == 0
+    assert metrics.TRACE_SPANS_DROPPED.total() - before == 2
+
+
+# ---- satellite: exception marking + tolerant traceparent -------------------
+
+
+def test_span_records_exception_as_status_and_event():
+    EXPORTER.drain()
+    with pytest.raises(ValueError):
+        with span("excboom"):
+            raise ValueError("kaput")
+    got = [s for s in EXPORTER.spans() if s.name == "excboom"]
+    assert len(got) == 1
+    s = got[0]
+    assert s.status == "ERROR"
+    assert "kaput" in s.status_message
+    evs = [e for e in s.events if e["name"] == "exception"]
+    assert evs and evs[0]["attrs"]["type"] == "ValueError"
+    assert s.end is not None  # raised-through spans are still finished
+
+
+def test_extract_context_tolerates_malformed_version():
+    trace_id, span_id = "ab" * 16, "cd" * 8
+    # a non-zero, valid-hex future version is accepted (W3C forward compat)
+    with extract_context({"traceparent": f"01-{trace_id}-{span_id}-00"}) as s:
+        assert s.trace_id == trace_id and s.parent_id == span_id
+    # malformed version / reserved version / junk ids degrade to a fresh
+    # root instead of seeding a span with a garbage trace id
+    for bad in (
+        f"zz-{trace_id}-{span_id}-01",      # non-hex version
+        f"ff-{trace_id}-{span_id}-01",      # reserved version
+        f"0-{trace_id}-{span_id}-01",       # short version
+        f"00-{'g' * 32}-{span_id}-01",      # non-hex trace id
+        f"00-{trace_id}-{'zz' * 8}-01",     # non-hex span id
+        f"00-{'0' * 32}-{span_id}-01",      # all-zero trace id
+        "garbage",
+        "",
+    ):
+        with extract_context({"traceparent": bad}) as s:
+            assert s.parent_id is None, bad
+            assert s.trace_id != trace_id, bad
+
+
+def test_statement_fingerprint_normalizes_literals():
+    a = statement_fingerprint("SELECT * FROM t WHERE x = 5 AND s = 'abc'")
+    b = statement_fingerprint("select *   FROM t  where x = 99 and s = 'zzz'")
+    c = statement_fingerprint("SELECT count(*) FROM t")
+    assert a == b
+    assert a != c
+
+
+# ---- standalone loop -------------------------------------------------------
+
+
+@pytest.fixture()
+def sdb(tmp_path):
+    from greptimedb_tpu.database import Database
+    from greptimedb_tpu.utils.config import Config
+
+    cfg = Config()
+    cfg.trace.enabled = True
+    cfg.trace.sample_ratio = 1.0
+    # tests flush the writer explicitly; a long interval keeps the
+    # background thread out of the way
+    cfg.trace.export_interval_s = 60.0
+    db = Database(cfg, data_home=str(tmp_path))
+    db.sql(
+        "CREATE TABLE t (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY,"
+        " v DOUBLE)"
+    )
+    db.sql("INSERT INTO t VALUES (1000, 'a', 1.0), (2000, 'b', 2.0)")
+    yield db
+    db.close()
+
+
+def test_standalone_trace_written_and_jaeger_queryable(sdb):
+    out = sdb.sql_one("SELECT host, sum(v) FROM t GROUP BY host ORDER BY host")
+    assert out.num_rows == 2
+    tid = sdb.last_trace_id
+    assert tid and sdb.last_trace_kept
+    assert sdb._self_trace_writer.flush() > 0
+    rows = sdb.sql_one(
+        f"SELECT span_name, parent_span_id, span_id, span_attributes "
+        f"FROM opentelemetry_traces WHERE trace_id = '{tid}'"
+    )
+    d = rows.to_pydict()
+    names = set(d["span_name"])
+    assert "statement.sql" in names
+    assert "query.plan" in names or "query.tpu" in names
+    # the root carries the statement fingerprint + protocol
+    root_attrs = json.loads(
+        d["span_attributes"][d["span_name"].index("statement.sql")]
+    )
+    assert root_attrs["fingerprint"]
+    assert root_attrs["protocol"] == "api"
+    # queryable through the database's OWN Jaeger endpoint
+    from greptimedb_tpu.servers import jaeger
+
+    tr = jaeger.get_trace(sdb, tid)
+    assert len(tr["data"]) == 1
+    assert len(tr["data"][0]["spans"]) == rows.num_rows
+    # every non-root span parents to another span of the SAME trace
+    ids = set(d["span_id"])
+    for name, pid in zip(d["span_name"], d["parent_span_id"]):
+        if name != "statement.sql" and pid:
+            assert pid in ids, (name, pid)
+
+
+def test_admission_wait_is_a_traced_stage(sdb):
+    sdb.config.admission.enable = True
+    try:
+        sdb.sql_one("SELECT count(*) FROM t")
+        tid = sdb.last_trace_id
+        sdb._self_trace_writer.flush()
+        rows = sdb.sql_one(
+            f"SELECT span_name FROM opentelemetry_traces WHERE trace_id = '{tid}'"
+        )
+        assert "admission.wait" in set(rows["span_name"].to_pylist())
+    finally:
+        sdb.config.admission.enable = False
+
+
+def test_tail_sampling_drops_fast_clean_statements(sdb):
+    sdb.config.trace.sample_ratio = 0.0
+    EXPORTER.drain()
+    sdb.sql_one("SELECT count(*) FROM t")
+    assert sdb.last_trace_kept is False
+    tid = sdb.last_trace_id
+    # the dropped trace's spans never reach the exporter
+    assert not [s for s in EXPORTER.spans() if s.trace_id == tid]
+
+
+def test_slow_query_log_captures_span_tree(sdb):
+    sdb.config.trace.slow_query_ms = 0.0  # every statement is "slow"
+    sql = "SELECT host, sum(v) FROM t GROUP BY host"
+    sdb.sql_one(sql)
+    tid = sdb.last_trace_id
+    sdb.event_recorder.flush()
+    rows = sdb.sql_one(
+        f"SELECT query, trace_id, fingerprint, span_tree FROM "
+        f"greptime_private.slow_queries WHERE trace_id = '{tid}'"
+    )
+    assert rows.num_rows == 1
+    assert rows["fingerprint"][0].as_py() == statement_fingerprint(sql)
+    tree = json.loads(rows["span_tree"][0].as_py())
+    names = {n["name"] for n in tree}
+    assert "statement.sql" in names
+    # parent ids stitch the tree: the root is in the rendered spans
+    roots = [n for n in tree if n["parent_id"] is None]
+    assert len(roots) == 1 and roots[0]["name"] == "statement.sql"
+
+
+def test_legacy_slow_query_config_stays_authoritative(sdb):
+    # slow_query.threshold_ms BELOW trace.slow_query_ms keeps logging the
+    # in-between queries (the row), even though the trace itself samples
+    sdb.config.trace.slow_query_ms = 60_000.0
+    sdb.config.slow_query.threshold_ms = 0
+    sdb.sql_one("SELECT count(*) FROM t")
+    tid = sdb.last_trace_id
+    sdb.event_recorder.flush()
+    rows = sdb.sql_one(
+        f"SELECT threshold_ms FROM greptime_private.slow_queries "
+        f"WHERE trace_id = '{tid}'"
+    )
+    assert rows.num_rows == 1
+    assert rows["threshold_ms"][0].as_py() == 0  # the bound that fired
+    # and slow_query.enable=false suppresses the row entirely
+    sdb.config.slow_query.enable = False
+    sdb.config.trace.slow_query_ms = 0.0
+    sdb.sql_one("SELECT count(*) FROM t")
+    tid2 = sdb.last_trace_id
+    sdb.event_recorder.flush()
+    rows = sdb.sql_one(
+        f"SELECT seq FROM greptime_private.slow_queries WHERE trace_id = '{tid2}'"
+    )
+    assert rows.num_rows == 0
+    sdb.config.slow_query.enable = True
+    sdb.config.trace.slow_query_ms = 5000.0
+
+
+def test_preexisting_slow_queries_table_gains_trace_columns(tmp_path):
+    """Upgrade path: a data dir whose slow_queries table predates the
+    trace columns is widened in place by the recorder's migration — rows
+    keep their trace_id/span_tree instead of _conform_batch silently
+    dropping them."""
+    from greptimedb_tpu.database import Database
+    from greptimedb_tpu.utils.config import Config
+
+    cfg = Config()
+    cfg.trace.enabled = True
+    cfg.trace.sample_ratio = 1.0
+    cfg.trace.slow_query_ms = 0.0
+    cfg.trace.export_interval_s = 60.0
+    db = Database(cfg, data_home=str(tmp_path))
+    try:
+        # the OLD pre-trace schema, created before the recorder ever runs
+        db.sql("CREATE DATABASE IF NOT EXISTS greptime_private")
+        db.sql(
+            "CREATE TABLE IF NOT EXISTS greptime_private.slow_queries ("
+            " seq STRING, cost_time_ms BIGINT, threshold_ms BIGINT,"
+            " query STRING, is_promql BOOLEAN, query_database STRING,"
+            " ts TIMESTAMP(3), TIME INDEX (ts), PRIMARY KEY (seq))"
+        )
+        db.sql("CREATE TABLE t2 (ts TIMESTAMP TIME INDEX, v DOUBLE)")
+        db.sql("INSERT INTO t2 VALUES (1000, 1.0)")
+        db.sql_one("SELECT count(*) FROM t2")
+        tid = db.last_trace_id
+        db.event_recorder.flush()
+        rows = db.sql_one(
+            f"SELECT trace_id, span_tree FROM greptime_private.slow_queries "
+            f"WHERE trace_id = '{tid}'"
+        )
+        assert rows.num_rows == 1
+        assert json.loads(rows["span_tree"][0].as_py())
+    finally:
+        db.close()
+
+
+def test_erroring_statement_force_kept_with_trace(sdb):
+    sdb.config.trace.sample_ratio = 0.0  # only the error keeps it
+    before = metrics.TRACE_SAMPLED_TOTAL.get(decision="error")
+    with pytest.raises(TableNotFoundError):
+        sdb.sql_one("SELECT * FROM no_such_table_here")
+    assert sdb.last_trace_kept is True
+    assert metrics.TRACE_SAMPLED_TOTAL.get(decision="error") == before + 1
+    sdb.event_recorder.flush()
+    rows = sdb.sql_one(
+        f"SELECT query FROM greptime_private.slow_queries "
+        f"WHERE trace_id = '{sdb.last_trace_id}'"
+    )
+    assert rows.num_rows == 1
+    assert "no_such_table_here" in rows["query"][0].as_py()
+
+
+def test_trace_write_failure_never_fails_the_query(sdb):
+    plan = fi.REGISTRY.arm(
+        "trace.self_write", fail_times=100, error=RuntimeError
+    )
+    before = metrics.SELF_TRACE_WRITE_FAILURES.total()
+    out = sdb.sql_one("SELECT count(*) FROM t")  # traced query: unaffected
+    assert out.num_rows == 1
+    assert sdb._self_trace_writer.flush() == 0  # batch dropped, not raised
+    assert plan.trips >= 1
+    assert metrics.SELF_TRACE_WRITE_FAILURES.total() > before
+    fi.REGISTRY.disarm()
+    # the loop heals: the next batch writes
+    sdb.sql_one("SELECT count(*) FROM t")
+    assert sdb._self_trace_writer.flush() > 0
+
+
+def test_self_trace_writes_generate_no_spans(sdb):
+    sdb.sql_one("SELECT count(*) FROM t")
+    # seed exactly one known span, then flush: the write itself must not
+    # create spans (reentrancy guard), so a second flush finds NOTHING
+    with span("reentry"):
+        pass
+    assert sdb._self_trace_writer.flush() > 0
+    assert EXPORTER.spans() == []
+    assert sdb._self_trace_writer.flush() == 0
+
+
+def test_suppressed_scope_is_a_noop():
+    EXPORTER.drain()
+    with tracing.suppressed():
+        with span("ghost.stage") as s:
+            assert tracing.inject_context() == {}
+        with extract_context({"traceparent": f"00-{'ab' * 16}-{'cd' * 8}-01"}) as s2:
+            pass
+    assert EXPORTER.spans() == []
+    # suppressed spans never enter the taxonomy-seen set either
+    assert "ghost.stage" not in tracing.SEEN_SPAN_NAMES
+
+
+def test_metric_self_scrape_range_queryable(sdb):
+    task = MetricScrapeTask(sdb, sdb.config.trace)
+    n = task.run_once()
+    assert n > 0
+    _time.sleep(0.01)
+    task.run_once()  # second sample so rate() has a range
+    rows = sdb.sql_one("SELECT * FROM greptime_mito_write_rows_total")
+    assert rows.num_rows >= 2
+    val_col = [c for c in rows.column_names if c == "greptime_value"]
+    assert val_col and rows[val_col[0]][0].as_py() > 0
+    # PromQL over OUR storage: rate() of a self-scraped counter
+    now_s = int(_time.time())
+    tql = sdb.sql_one(
+        f"TQL EVAL ({now_s - 60}, {now_s + 60}, '30s') "
+        f"rate(greptime_mito_write_rows_total[1m])"
+    )
+    assert "value" in tql.column_names
+
+
+def test_error_messages_carry_the_trace_id(tmp_path):
+    from greptimedb_tpu.utils import self_trace
+    from greptimedb_tpu.utils.config import Config
+
+    class Owner:
+        config = Config()
+
+    Owner.config.trace.enabled = True
+    Owner.config.trace.sample_ratio = 0.0
+    Owner.config.trace.export_interval_s = 60.0
+    owner = Owner()
+    try:
+        with pytest.raises(RetryLaterError) as ei:
+            with statement_trace(owner, "sql", "SELECT 1", "public"):
+                raise RetryLaterError("regions [1] unavailable")
+        assert ei.value.trace_id == owner.last_trace_id
+        assert f"trace_id={owner.last_trace_id}" in str(ei.value)
+    finally:
+        self_trace.stop(owner)
+
+
+# ---- distributed e2e: one trace across the Flight hop ----------------------
+
+
+@pytest.fixture()
+def mini_cluster(tmp_path):
+    """1 metasrv + 2 Flight datanodes + 1 frontend with self-tracing on —
+    the live process cluster of the acceptance criterion."""
+    from greptimedb_tpu.distributed.flight import FlightDatanode
+    from greptimedb_tpu.distributed.frontend import Frontend
+    from greptimedb_tpu.distributed.kv import MemoryKvBackend
+    from greptimedb_tpu.distributed.meta_service import MetasrvServer
+    from greptimedb_tpu.distributed.metasrv import Metasrv
+    from greptimedb_tpu.utils.retry import RetryPolicy
+
+    home = str(tmp_path / "shared")
+    kv = MemoryKvBackend()
+    datanodes = {i: FlightDatanode(i, home) for i in range(2)}
+    metasrv = Metasrv(kv, None)
+    for i, dn in datanodes.items():
+        metasrv.register_datanode(i, dn.location.removeprefix("grpc://"))
+        metasrv.handle_heartbeat(i, [], _time.time() * 1000)
+    server = MetasrvServer(metasrv).start()
+    fe = Frontend(home, [server.address])
+    fe.retry_policy = RetryPolicy(max_attempts=4, base_delay_s=0.01, max_delay_s=0.05)
+    fe.config.trace.enabled = True
+    fe.config.trace.sample_ratio = 1.0
+    fe.config.trace.export_interval_s = 60.0
+    fe.sql(
+        "CREATE TABLE t (ts TIMESTAMP TIME INDEX, host STRING PRIMARY KEY,"
+        " v DOUBLE) PARTITION BY HASH(host) PARTITIONS 2"
+    )
+    fe.sql(
+        "INSERT INTO t VALUES (1000, 'a', 1.0), (2000, 'b', 2.0),"
+        " (3000, 'c', 3.0)"
+    )
+    yield fe, datanodes
+    fe.close()
+    server.stop()
+    for dn in datanodes.values():
+        dn.shutdown()
+
+
+def test_distributed_trace_parents_across_flight_hop(mini_cluster):
+    import pyarrow.flight as fl
+
+    fe, _datanodes = mini_cluster
+    # one transient region failure mid-query: the retry must show up as a
+    # span EVENT on the region's span, under the same single trace
+    fi.REGISTRY.arm(
+        "flight.do_get", fail_times=1, error=fl.FlightUnavailableError
+    )
+    out = fe.sql_one("SELECT host, sum(v) FROM t GROUP BY host ORDER BY host")
+    fi.REGISTRY.disarm()
+    assert out.num_rows == 3
+    tid = fe.last_trace_id
+    assert tid and fe.last_trace_kept
+    assert fe._self_trace_writer.flush() > 0
+    rows = fe.sql_one(
+        f"SELECT span_name, span_id, parent_span_id, service_name, "
+        f"span_events FROM opentelemetry_traces WHERE trace_id = '{tid}'"
+    )
+    d = rows.to_pydict()
+    by_id = dict(zip(d["span_id"], d["span_name"]))
+    names = d["span_name"]
+    # ONE trace holding frontend root + per-region fan-out + datanode spans
+    assert names.count("statement.sql") == 1
+    assert names.count("fanout.region") == 2
+    datanode_spans = [
+        (n, p, svc)
+        for n, p, svc in zip(names, d["parent_span_id"], d["service_name"])
+        if n.startswith("datanode.")
+    ]
+    assert len(datanode_spans) >= 2
+    for n, parent, svc in datanode_spans:
+        # correct parent ids ACROSS the Flight boundary: each datanode
+        # span hangs under a fanout.region span, tagged with its role
+        assert by_id.get(parent) == "fanout.region", (n, parent)
+        assert svc == "greptimedb_tpu.datanode"
+    root_id = d["span_id"][names.index("statement.sql")]
+    for n, parent in zip(names, d["parent_span_id"]):
+        if n == "fanout.region":
+            assert parent == root_id
+    # the injected transient failure surfaced as a retry event
+    all_events = " ".join(d["span_events"])
+    assert '"retry"' in all_events
+    # and the whole tree is served by the database's OWN Jaeger endpoint
+    from greptimedb_tpu.servers import jaeger
+
+    tr = jaeger.get_trace(fe, tid)
+    assert len(tr["data"][0]["spans"]) == rows.num_rows
+
+
+def test_distributed_insert_traces_the_write_hot_path(mini_cluster):
+    fe, _datanodes = mini_cluster
+    fe.sql("INSERT INTO t VALUES (4000, 'd', 4.0)")
+    tid = fe.last_trace_id
+    assert tid
+    assert fe._self_trace_writer.flush() > 0
+    rows = fe.sql_one(
+        f"SELECT span_name FROM opentelemetry_traces WHERE trace_id = '{tid}'"
+    )
+    names = set(rows["span_name"].to_pylist())
+    assert "statement.insert" in names
+    assert "write.region" in names
+    assert "datanode.write" in names
+
+
+def test_sampled_out_trace_leaves_no_orphan_datanode_spans(mini_cluster):
+    """The receiving side of the Flight hop joins the caller's collector
+    (trace-id registry), so a tail-dropped trace drops its datanode spans
+    too — no root-less orphan rows accumulating per sampled-out query."""
+    fe, _datanodes = mini_cluster
+    fe.config.trace.sample_ratio = 0.0
+    EXPORTER.drain()
+    fe.sql_one("SELECT count(*) FROM t")
+    assert fe.last_trace_kept is False
+    tid = fe.last_trace_id
+    assert not [s for s in EXPORTER.spans() if s.trace_id == tid]
+
+
+def test_trace_self_off_is_todays_behavior(mini_cluster):
+    fe, _datanodes = mini_cluster
+    fe.config.trace.enabled = False
+    EXPORTER.drain()
+    fe.last_trace_id = None
+    out = fe.sql_one("SELECT count(*) FROM t")
+    assert out.num_rows == 1
+    # no root statement span, no per-region spans, nothing traced
+    assert fe.last_trace_id is None
+    assert not [
+        s
+        for s in EXPORTER.spans()
+        if s.name.startswith(("statement.", "fanout.", "datanode."))
+    ]
