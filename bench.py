@@ -1172,6 +1172,224 @@ def main():
     db.close()
 
 
+# ---- multichip mode (--devices N) -------------------------------------------
+# The REAL-path multichip record (MULTICHIP_r06+): the same TSBS dataset
+# the tsbs mode persists (reused via GRAFT_BENCH_DATA_DIR — ingest and
+# tile consolidations are paid once), driven through the PRODUCTION tile
+# executor with `tile.mesh_devices` swept over a per-device-count curve.
+# This replaces the dryrun records: every number is a Database.sql() wall
+# time through shard_map dispatch + collective merge, and the emitted
+# record carries warm p50 per (query, device count) plus the 1->N
+# scaling factor for the heavy queries.  Budget-gated per device count
+# like the LTH probe: whatever finished is a parseable record.
+
+MULTICHIP_QUERIES = [
+    # the heavy queries the scaling claim is about, plus the widened
+    # sg-5-* multi-column x multi-host shape and cpu-max-all-8 (now on
+    # the tile path)
+    ("double-groupby-1", _q(W12, 1, funcs="avg")),
+    ("double-groupby-5", _q(W12, 5, funcs="avg")),
+    ("double-groupby-all", _q(W12, 10, funcs="avg")),
+    ("single-groupby-5-8-1", _q(W1, 5, hosts=HOSTS8, bucket="1m")),
+    ("cpu-max-all-8", _q(W8, 10, hosts=HOSTS8)),
+]
+
+
+def multichip_main(max_devices: int):
+    """Per-device-count scaling curve through the real mesh tile path."""
+    ensure_x64()
+    _start_budget_watchdog()
+    import shutil
+    import tempfile
+
+    import jax
+
+    from greptimedb_tpu.database import Database
+    from greptimedb_tpu.utils import metrics as m
+
+    detail: dict = _STATE["detail"]
+    results: dict = _STATE["results"]
+    avail = len(jax.devices())
+    max_devices = min(max_devices, avail)
+    counts = [1]
+    while counts[-1] * 2 <= max_devices:
+        counts.append(counts[-1] * 2)
+    detail.update({
+        "mode": "multichip",
+        "device": str(jax.devices()[0]),
+        "devices_available": avail,
+        "device_counts": counts,
+        "dataset_hours": HOURS,
+    })
+
+    reuse = False
+    if DATA_DIR:
+        home = os.path.join(DATA_DIR, f"tsbs_{_dataset_key()}")
+        marker = os.path.join(home, "INGESTED.json")
+        if os.path.exists(marker):
+            try:
+                with open(marker) as f:
+                    reuse = json.load(f).get("key") == _dataset_key()
+            except Exception:  # noqa: BLE001 — torn marker = no reuse
+                reuse = False
+        if not reuse and os.path.isdir(home) and os.listdir(home):
+            shutil.rmtree(home, ignore_errors=True)
+        os.makedirs(home, exist_ok=True)
+    else:
+        home = tempfile.mkdtemp(prefix="graft_multichip_")
+    detail["dataset_reused"] = reuse
+    db = Database(data_home=home)
+    db.config.query.tpu_min_rows = int(
+        os.environ.get("GRAFT_TPU_MIN_ROWS", 300_000)
+    )
+    tile_mb = int(os.environ.get("GRAFT_TILE_CACHE_MB", 9216))
+    db.config.query.tile_cache_mb = tile_mb
+    if db.query_engine.tile_cache is not None:
+        db.query_engine.tile_cache.budget = tile_mb << 20
+
+    if not reuse:
+        # same generator stream as the tsbs mode so the persisted
+        # artifacts are interchangeable between the two records
+        cols_sql = ", ".join(f"{mm} DOUBLE" for mm in METRICS)
+        db.sql(
+            f"CREATE TABLE cpu (hostname STRING, ts TIMESTAMP(3) TIME INDEX, "
+            f"{cols_sql}, PRIMARY KEY (hostname)) WITH (append_mode = 'true')"
+        )
+        rng = np.random.default_rng(7)
+        ticks_total = HOURS * 3600 // SCRAPE_S
+        chunk_ticks = max(1, 2_000_000 // N_HOSTS)
+        hosts_arr = np.array([f"host_{i}" for i in range(N_HOSTS)])
+        n_rows = 0
+        for start in range(0, ticks_total, chunk_ticks):
+            ticks = min(chunk_ticks, ticks_total - start)
+            ts = T0 + (start + np.arange(ticks, dtype=np.int64))[:, None] * (
+                SCRAPE_S * 1000
+            )
+            ts = np.broadcast_to(ts, (ticks, N_HOSTS)).reshape(-1)
+            hs = np.broadcast_to(
+                hosts_arr[None, :], (ticks, N_HOSTS)
+            ).reshape(-1)
+            vals = {
+                mm: rng.uniform(0.0, 100.0, ticks * N_HOSTS) for mm in METRICS
+            }
+            db.insert_rows("cpu", pa.table({
+                "hostname": pa.array(hs),
+                "ts": pa.array(ts, pa.timestamp("ms")),
+                **{mm: pa.array(vals[mm], pa.float64()) for mm in METRICS},
+            }))
+            n_rows += ticks * N_HOSTS
+            if _remaining() < 120:
+                break  # record whatever ingested; rc=0 beats completeness
+        db.storage.flush_all()
+        detail["rows"] = n_rows
+        if DATA_DIR:
+            try:
+                with open(marker, "w") as f:
+                    json.dump({"key": _dataset_key(), "rows": n_rows}, f)
+            except OSError:
+                pass
+        _emit({"event": "ingested", "rows": n_rows,
+               "elapsed_s": round(_elapsed(), 1)})
+
+    only = os.environ.get("GRAFT_BENCH_ONLY")
+    queries = [
+        q for q in MULTICHIP_QUERIES if only is None or q[0] in only.split(",")
+    ]
+    curve: dict[str, dict] = {name: {} for name, _sql in queries}
+    min_remaining = float(
+        os.environ.get("GRAFT_MULTICHIP_MIN_REMAINING_S", 120)
+    )
+    for n_dev in counts:
+        if _remaining() < min_remaining:
+            detail.setdefault("skipped_device_counts", []).append(n_dev)
+            _emit({"event": "budget_gate", "skipped_devices": n_dev,
+                   "remaining_s": round(_remaining(), 1)})
+            continue
+        db.config.tile.mesh_devices = n_dev
+        for name, sql in queries:
+            if _remaining() < min_remaining / 2:
+                break
+            walls: list[float] = []
+            err = None
+            mesh0 = m.TILE_MESH_DISPATCHES.get()
+            try:
+                db.config.query.timeout_s = min(
+                    600.0, max(_remaining(), 30.0)
+                )
+                db.sql_one(sql)  # cold/build rep (uncounted)
+                for _rep in range(WARM_REPS):
+                    db.config.query.timeout_s = min(
+                        120.0, max(_remaining(), 15.0)
+                    )
+                    t0 = time.perf_counter()
+                    db.sql_one(sql)
+                    walls.append((time.perf_counter() - t0) * 1000)
+            except Exception as e:  # noqa: BLE001 — record what landed
+                err = repr(e)
+            finally:
+                db.config.query.timeout_s = 0.0
+            entry: dict = {"devices": n_dev}
+            if walls:
+                entry["warm_ms"] = round(float(np.median(walls)), 2)
+                entry["warm_reps_done"] = len(walls)
+            entry["mesh_dispatches"] = int(
+                m.TILE_MESH_DISPATCHES.get() - mesh0
+            )
+            if err is not None:
+                entry["error"] = err
+            curve[name][str(n_dev)] = entry
+            _emit({"query": name, **entry,
+                   "elapsed_s": round(_elapsed(), 1)})
+            _write_partial({"detail": detail, "queries": results})
+    db.config.tile.mesh_devices = 0
+
+    # scaling factors 1 -> max measured, per query + heavy geomean
+    factors = []
+    for name, per_dev in curve.items():
+        ms1 = per_dev.get("1", {}).get("warm_ms")
+        top = str(counts[-1])
+        msn = per_dev.get(top, {}).get("warm_ms")
+        rec = {"curve": per_dev}
+        if ms1 and msn:
+            rec["scaling_1_to_max"] = round(ms1 / msn, 2)
+            factors.append(ms1 / msn)
+        results[name] = rec
+    detail["mesh_degraded_total"] = m.TILE_MESH_DEGRADED.get()
+    detail["method"] = (
+        "end-to-end Database.sql() wall time through the PRODUCTION tile "
+        "path with tile.mesh_devices swept per device count: shard_map "
+        "partial aggregation over the regions mesh + psum/pmin/pmax "
+        "merge, device-finalize post-merge.  Dataset/tile artifacts "
+        "reused from the persisted tsbs-mode home.  warm_ms = p50 of "
+        f"{WARM_REPS} cache-hit reps; scaling_1_to_max = warm_ms(1 dev) "
+        "/ warm_ms(max devs)."
+    )
+    headline_val = (
+        round(float(np.exp(np.mean(np.log(factors)))), 2) if factors else None
+    )
+    _STATE["headline"] = {
+        "warm_ms": headline_val, "vs_baseline": headline_val,
+    }
+    with _EMIT_LOCK:
+        if not _STATE["emitted"]:
+            _STATE["emitted"] = True
+            _emit({
+                "metric": "multichip_heavy_scaling_geomean",
+                "value": headline_val,
+                "unit": "x (1 device -> max devices warm speedup)",
+                "vs_baseline": headline_val,
+                "detail": detail,
+                "queries": results,
+            })
+            _write_partial({"detail": detail, "queries": results})
+            try:
+                with open(PARTIAL_PATH + ".done", "w") as f:
+                    f.write("1")
+            except OSError:
+                pass
+    db.close()
+
+
 # ---- mixed ingest+query overload mode (--mode mixed) -----------------------
 # The production-concurrency harness (ROADMAP open item 3): N query workers
 # race M ingest workers against ONE device under admission control, dispatch
@@ -1446,7 +1664,17 @@ if __name__ == "__main__":
             mode = sys.argv[idx]
             if mode not in ("tsbs", "mixed"):
                 raise ValueError(f"unknown --mode {mode!r} (tsbs | mixed)")
-        if mode == "mixed":
+        devices_n = None
+        if "--devices" in sys.argv:
+            idx = sys.argv.index("--devices") + 1
+            if idx >= len(sys.argv):
+                raise ValueError("--devices requires a device count")
+            devices_n = int(sys.argv[idx])
+            if devices_n < 1:
+                raise ValueError(f"--devices must be >= 1, got {devices_n}")
+        if devices_n is not None:
+            multichip_main(devices_n)
+        elif mode == "mixed":
             mixed_main()
         else:
             main()

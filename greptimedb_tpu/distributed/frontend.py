@@ -983,6 +983,25 @@ class Frontend:
         """
         routes = self.meta.get_route(meta.table_id)
         rids = meta.region_ids
+        submit_rids = rids
+        mesh_n = int(getattr(self.config.tile, "mesh_devices", 0) or 0)
+        if mesh_n > 0 and len(rids) > 1:
+            # Device-local fan-out (tile.mesh_devices): SUBMIT region
+            # sub-queries in their co-located mesh-device order — the
+            # same region -> device mapping the tile cache places
+            # super-tile chunks with (parallel/mesh.py
+            # region_device_index) — so a datanode's work starts on the
+            # device that already holds its region shards instead of
+            # interleaving every region through device 0 first.  Results
+            # are still SETTLED and returned in the original region-id
+            # order: the fan-out's output feeds state merges and scan
+            # concats whose fold order must not change with a locality
+            # knob.
+            from ..parallel.mesh import region_device_index
+
+            submit_rids = sorted(
+                rids, key=lambda r: (region_device_index(r, mesh_n), r)
+            )
         deadline = current_deadline()
         followers = self._followers_for(meta)
         hedge_delay = self._hedge_delay_s() if followers else None
@@ -1025,8 +1044,11 @@ class Frontend:
                 return self._call_region(meta, rid, fn, routes, inflight, True)
 
         futures = {
-            rid: pool.submit(propagate(_region_worker), rid) for rid in rids
+            rid: pool.submit(propagate(_region_worker), rid)
+            for rid in submit_rids
         }
+        # settle in ORIGINAL region order regardless of submit order
+        futures = {rid: futures[rid] for rid in rids}
         # per-region completion queues fed by future done-callbacks: the
         # settle loop blocks on its region's queue, so hedges armed by the
         # wheel while it waits wake it without polling

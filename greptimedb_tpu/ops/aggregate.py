@@ -938,6 +938,72 @@ def merge_states(a: AggState, b: AggState) -> AggState:
     return out
 
 
+def pack_f64_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """IEEE-754 bit pattern of float64 values as two int32 words
+    (..., [hi, lo]), composed ARITHMETICALLY — frexp + integer shifts —
+    because the TPU x64 rewrite has no lowering for a 64-bit
+    bitcast-convert (the reason f64 result rows historically rode a
+    second fetch array; see _tile_program).  int32 words bitcast to
+    bytes fine, so f64 rows can join the one flat result buffer and the
+    whole compact readback ships as a SINGLE device_get.
+
+    Bit-exact for every NORMAL finite value and signed zero; +/-inf keep
+    their sign; NaNs canonicalize to the quiet NaN (payloads never
+    survive SQL semantics — a NaN output only ever means NULL or
+    propagates as NaN either way).  Subnormals degrade to signed zero on
+    backends that flush denormals in arithmetic (XLA CPU treats a
+    subnormal operand as zero even in comparisons, so no arithmetic
+    re-encode can see one); device kernels flush them identically in the
+    aggregation itself, so this loses nothing the dispatch had."""
+    xf = x.astype(jnp.float64)
+    neg = jnp.signbit(xf)
+    ax = jnp.abs(xf)
+    # jnp.frexp mis-decomposes subnormals (observed m=0.5/e=-1074 for
+    # every subnormal on the CPU backend): pre-scale them into the
+    # normal range by an exact power of two and correct the exponent
+    tiny = ax < jnp.float64(2.2250738585072014e-308)  # < DBL_MIN
+    m, e = jnp.frexp(jnp.where(tiny, ax * jnp.float64(2.0**64), ax))
+    e = e - jnp.where(tiny, 64, 0)  # ax = m * 2^e with m in [0.5, 1)
+    # 2^52 <= mi < 2^53 exactly (m has <= 53 significant bits); the
+    # garbage mi produces for inf/NaN inputs is discarded by the wheres
+    mi = (m * jnp.float64(1 << 53)).astype(jnp.int64)
+    be = e.astype(jnp.int64) + 1022  # IEEE biased exponent
+    # subnormals: biased exponent <= 0 stores as 0 with the mantissa
+    # shifted right — exact, true subnormals have the low bits free
+    shift = jnp.clip(1 - be, 0, 54)
+    frac = jnp.where(be > 0, mi - (jnp.int64(1) << 52), mi >> shift)
+    stored_e = jnp.clip(be, 0, 0x7FE)
+    is_zero = ax == 0
+    is_inf = jnp.isinf(xf)
+    is_nan = jnp.isnan(xf)
+    frac = jnp.where(is_zero | is_inf, jnp.int64(0), frac)
+    frac = jnp.where(is_nan, jnp.int64(1) << 51, frac)  # canonical qNaN
+    stored_e = jnp.where(is_zero, jnp.int64(0), stored_e)
+    stored_e = jnp.where(is_inf | is_nan, jnp.int64(0x7FF), stored_e)
+    frac_hi = (frac >> 32).astype(jnp.int32)  # 20 bits
+    frac_lo = frac & jnp.int64(0xFFFFFFFF)
+    # wrap the low word into signed int32 range without a 64->32 bitcast
+    lo = (frac_lo - ((frac_lo >> 31) << 32)).astype(jnp.int32)
+    hi = (stored_e.astype(jnp.int32) << 20) | frac_hi
+    # sign bit via addition: hi is < 2^31 here, so adding INT32_MIN sets
+    # exactly bit 31 in two's complement
+    hi = hi + jnp.where(neg, jnp.int32(-(2**31)), jnp.int32(0))
+    return jnp.stack([hi, lo], axis=-1)
+
+
+def unpack_f64_bits(hilo) -> "object":
+    """Host-side inverse of `pack_f64_bits`: (..., [hi, lo]) int32 words
+    back to float64 via a numpy view — the device never needed the
+    64-bit bitcast, the host always had it."""
+    import numpy as np
+
+    arr = np.asarray(hilo, dtype=np.int32)
+    hi = arr[..., 0].astype(np.uint32).astype(np.uint64)
+    lo = arr[..., 1].astype(np.uint32).astype(np.uint64)
+    bits = np.ascontiguousarray((hi << np.uint64(32)) | lo)
+    return bits.view(np.float64)
+
+
 def psum_states(state: AggState, axis_name: str) -> AggState:
     """Merge partials across a mesh axis with XLA collectives over ICI.
 

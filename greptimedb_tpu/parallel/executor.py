@@ -7,6 +7,13 @@ segment reductions, and the upper/merge aggregate rides an all-reduce
 (psum/pmin/pmax) over the `regions` mesh axis — replacing the reference's
 N:1 Flight stream merge at the frontend.
 
+`compute_partial_states` below is the shared lower stage for BOTH this
+table-fed mesh path and the HBM super-tile executor — including its
+promoted multi-chip form (parallel/tile_cache.py `_mesh_merge_program`,
+`tile.mesh_devices`), which runs the same per-source math under shard_map
+and merges with the same psum/pmin/pmax collectives plus an
+order-preserving fold for float sums.
+
 Host-side responsibilities (the "frontend" role):
   - union tag dictionaries across region tables so codes agree globally
     (the reference ships dictionary mappings inside Flight IPC frames,

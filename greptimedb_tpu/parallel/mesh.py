@@ -36,3 +36,18 @@ def make_mesh(n_devices: int | None = None, axis: str = REGION_AXIS) -> Mesh:
             )
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis,))
+
+
+def region_device_index(region_id: int, n_devices: int) -> int:
+    """Stable region -> mesh-device slot (the co-location contract).
+
+    One mapping shared by the tile cache's chunk placement and the
+    frontend's fan-out ordering: a region's super-tile chunks live on
+    (a run starting at) this device, and the frontend visits regions in
+    device order, so the scan fan-out of a datanode's regions is
+    device-local instead of scattering every region's first chunk onto
+    device 0.  Mirrors the reference co-locating a region's MergeScan
+    stream with its owning datanode."""
+    if n_devices <= 0:
+        return 0
+    return int(region_id) % int(n_devices)

@@ -73,6 +73,7 @@ import pyarrow.compute as pc
 from ..ops.aggregate import (
     BLOCK_ROWS,
     _FAST_MIN_ROWS as _LIMB_MIN_ROWS,
+    AggState,
     finalize,
     merge_states,
     quantize_limbs,
@@ -89,6 +90,7 @@ from ..utils import metrics, tracing
 from ..utils.deadline import check_deadline, current_deadline
 from ..utils.errors import QueryTimeoutError
 from ..utils.fault_injection import fire as _fault_fire
+from ..utils.jax_compat import shard_map as _shard_map
 from .executor import (
     COUNT_STAR,
     DistGroupByPlan,
@@ -97,6 +99,7 @@ from .executor import (
     _quantize_card,
     compute_partial_states,
 )
+from .mesh import REGION_AXIS
 
 
 
@@ -410,6 +413,7 @@ class TileCacheManager:
         # everything off, pre-layer behavior bit-for-bit.
         self.admission_config = None
         self._persist_pool: set[str] = set()  # filesets being written
+        self._meshes: dict[int, object] = {}  # n_devices -> cached Mesh
         self._lock = threading.RLock()
         self._super: OrderedDict[int, _SuperTiles] = OrderedDict()
         self._host: OrderedDict[tuple[int, str], _FileHostTiles] = OrderedDict()
@@ -795,21 +799,48 @@ class TileCacheManager:
 
         threading.Thread(target=write, name="tile-persist", daemon=True).start()
 
-    def chunk_device(self, i: int):
+    def mesh(self, n_devices: int):
+        """The (cached) 1-D `regions` mesh for multi-chip tile dispatch
+        (tile.mesh_devices); built lazily per device count."""
+        with self._lock:
+            m = self._meshes.get(n_devices)
+            if m is None:
+                from .mesh import make_mesh
+
+                m = self._meshes[n_devices] = make_mesh(n_devices)
+            return m
+
+    def mesh_devices(self) -> int:
+        """Live tile.mesh_devices knob, clamped to what exists."""
+        n = int(self._tile_opt("mesh_devices", 0) or 0)
+        return min(max(n, 0), len(self.devices))
+
+    def chunk_device(self, i: int, region_id: int | None = None):
         """Device for chunk index i (round-robin over local devices;
         disabling the chunk_placement pass pins every chunk to device 0,
-        e.g. while debugging a multi-device state merge)."""
+        e.g. while debugging a multi-device state merge).  With the mesh
+        path on (tile.mesh_devices > 0) a region's chunks start at the
+        region's co-located device slot (parallel/mesh.py
+        region_device_index) so single-chunk regions land whole on their
+        owning datanode's device and the mesh dispatch consumes them
+        without a cross-device hop."""
         if not passes.enabled("chunk_placement", self.config):
             return self.devices[0]
+        mesh_n = self.mesh_devices()
+        if mesh_n > 0 and region_id is not None:
+            from .mesh import region_device_index
+
+            base = region_device_index(region_id, mesh_n)
+            return self.devices[(base + i) % mesh_n]
         return self.devices[i % len(self.devices)]
 
-    def _up_chunks(self, buf: np.ndarray, bounds) -> list:
+    def _up_chunks(self, buf: np.ndarray, bounds, region_id: int | None = None) -> list:
         """Upload a consolidated host buffer chunk-wise, each chunk onto
         its round-robin device (single-device: plain uploads)."""
         if len(self.devices) <= 1:
             return [jnp.asarray(buf[a:b]) for a, b in bounds]
         return [
-            jax.device_put(buf[a:b], self.chunk_device(i))
+            jax.device_put(buf[a:b], self.chunk_device(i, region_id))
             for i, (a, b) in enumerate(bounds)
         ]
 
@@ -1179,7 +1210,7 @@ class TileCacheManager:
                 if entry.valid is None:
                     v = np.zeros(entry.pad, bool)
                     v[: entry.num_rows] = True
-                    entry.valid = self._up_chunks(v, bounds)
+                    entry.valid = self._up_chunks(v, bounds, entry.region_id)
                     acc[0] += v.nbytes
                 self._upload_missing(
                     entry, missing, host_tiles, bounds, acc,
@@ -1262,10 +1293,10 @@ class TileCacheManager:
         and stamp its dictionary epoch."""
         if _TIMING:
             print(f"TILE_TIMING super.upload.{name} start", flush=True)
-        entry.cols[name] = self._up_chunks(buf, bounds)
+        entry.cols[name] = self._up_chunks(buf, bounds, entry.region_id)
         acc[0] += buf.nbytes
         if nbuf is not None:
-            entry.nulls[name] = self._up_chunks(nbuf, bounds)
+            entry.nulls[name] = self._up_chunks(nbuf, bounds, entry.region_id)
             acc[0] += nbuf.nbytes
         if name in tag_cols or name in pk_cols:
             if host_tiles is None:
@@ -1765,7 +1796,7 @@ class TileCacheManager:
                 np_chunks = self.host_column_chunks(entry, c)
                 if np_chunks is not None and len(self.devices) > 1:
                     chunks = [
-                        jax.device_put(x, self.chunk_device(i))
+                        jax.device_put(x, self.chunk_device(i, entry.region_id))
                         for i, x in enumerate(np_chunks)
                     ]
                 else:
@@ -1980,7 +2011,7 @@ class TileCacheManager:
             check_deadline()  # per-column upload + quantize is device-bound but slow
             buf, nb = host_bufs[name]
             with _timed(f"wtile.upload.{name}"):
-                chunks = self._up_chunks(buf, bounds)
+                chunks = self._up_chunks(buf, bounds, entry.region_id)
             if name in limb_build:
                 with _timed(f"wtile.quantize.{name}"):
                     limbs_dev[name] = [_quantize_limbs_jit(x) for x in chunks]
@@ -1990,7 +2021,7 @@ class TileCacheManager:
             # columns[c] — window tiles are small enough to afford both
             cols_dev[name] = chunks
             if nb is not None:
-                nulls_dev[name] = self._up_chunks(nb, bounds)
+                nulls_dev[name] = self._up_chunks(nb, bounds, entry.region_id)
         for name in missing_limbs:
             # column already on the tile: quantize straight from its
             # resident device chunks, no host gather
@@ -2001,7 +2032,7 @@ class TileCacheManager:
         if valid is None:
             v = np.zeros(pad, bool)
             v[:n] = True
-            valid = self._up_chunks(v, bounds)
+            valid = self._up_chunks(v, bounds, entry.region_id)
 
         def plane_bytes(kind: str, chunks) -> int:
             if kind == "limbs":
@@ -2109,7 +2140,7 @@ class TileCacheManager:
                 keep[: n - 1] &= ~same
             bounds = _chunk_bounds(entry.pad, self.chunk_rows)
             entry.keep_host = keep[:n]
-            entry.valid_dedup = self._up_chunks(keep, bounds)
+            entry.valid_dedup = self._up_chunks(keep, bounds, entry.region_id)
             added = entry.pad  # device bools
             entry.nbytes += added
             entry.host_nbytes += entry.keep_host.nbytes
@@ -2335,7 +2366,12 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
     vector + a survivor count instead of the full group space — the
     O(rows_out) readback contract.  Compact results skip the f32/uint8
     byte packing (they are small; f64 keeps them bit-identical to the
-    host path on the same aggregates).
+    host path on the same aggregates) and their f64 rows join the SAME
+    flat byte buffer as arithmetically-composed IEEE bit pairs
+    (ops/aggregate.pack_f64_bits), so the whole compact result —
+    lastpoint included — is ONE device_get of one array (each extra
+    fetched array paid its own ~100 ms round-trip on the remote tunnel:
+    the lastpoint 3-RTT floor).
     With `plan.agg_strategy == "hash"` the program carries a
     [hash_slots] int64 key table through the per-source fold
     (ops/aggregate.hash_group_slots assigns each gid one stable slot
@@ -2502,6 +2538,19 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
             # decode) and the survivor count ride the same flat buffer
             parts.append(sel.astype(jnp.int32).reshape(1, -1))
             parts.append(n_out.astype(jnp.int32).reshape(1, 1))
+            if acc64_layout:
+                # f64 rows JOIN the flat buffer as arithmetically-composed
+                # IEEE bit pairs (ops/aggregate.pack_f64_bits — the TPU x64
+                # rewrite cannot lower a 64-bit bitcast), so the whole
+                # compact result — lastpoint included — ships as ONE
+                # device_get of one buffer instead of a buffer pair; on
+                # the remote tunnel each extra array cost a ~100 ms
+                # round-trip (the lastpoint 3-RTT floor the ROADMAP flags)
+                from ..ops.aggregate import pack_f64_bits
+
+                parts.append(pack_f64_bits(jnp.stack(
+                    [pick(outs[col][agg]) for col, agg in acc64_layout]
+                )))
         # ONE flat byte buffer for the 8/32-bit rows: jax.device_get of
         # several arrays costs extra link round-trips on the remote-device
         # harness (~100 ms each), so ints + f32 rows bitcast to bytes and
@@ -2530,7 +2579,11 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
                 (merged["__hash_overflow"].counts > 0).astype(jnp.uint8).reshape(1)
             )
         buf = jnp.concatenate(flat) if len(flat) > 1 else flat[0]
-        out_g = spec.cap if spec is not None else presence.shape[0]
+        if spec is not None:
+            # compact path: EVERYTHING (f64 rows included, bit-packed
+            # above) rides the one flat buffer — a single-array fetch
+            return (buf,)
+        out_g = presence.shape[0]
         if acc64_layout:
             accs64 = jnp.stack(
                 [pick(outs[col][agg]).astype(jnp.float64) for col, agg in acc64_layout]
@@ -2608,6 +2661,9 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
     # while plane uploads are still in flight — the persistent XLA cache
     # then serves the dispatch-time compile as a hit
     run_all._partial_jit = partial_jit
+    # the mesh path (tile.mesh_devices) reuses THIS finalize so its
+    # result packing is byte-identical to the single-chip dispatch
+    run_all._final_jit = final_jit
 
     return (
         run_all,
@@ -2616,6 +2672,427 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
         tuple(acc64_layout),
         int_dtype,
     )
+
+
+# ---- multi-chip mesh execution (tile.mesh_devices) --------------------------
+#
+# The promotion of the MULTICHIP dryrun to the real tile path: the same
+# per-source partial-state math runs under shard_map over the 1-D
+# `regions` mesh — every device scans + partially aggregates its shard of
+# the chunk sources in ONE collective dispatch — and the merge rides XLA
+# collectives over ICI instead of the host-side N:1 device_put loop.
+#
+# Accumulation-order contract (the dense/hash parity bar from the
+# agg-strategy work): counts merge with psum and min/max with pmin/pmax —
+# integer adds and order statistics are bit-exact under ANY reduction
+# order — while float sums and LAST states, whose merge is order-
+# sensitive, all_gather the per-source partials and fold them in GLOBAL
+# SOURCE ORDER, exactly the single-chip loop's left fold.  The merged
+# states are therefore bit-identical for any mesh size (1 device == 8
+# devices == the single-chip path when sources form one shape run).
+# Device-finalize (ORDER BY/LIMIT/HAVING + compaction) runs ONCE
+# post-merge on the first mesh device via the same final_jit the
+# single-chip program uses, so readback stays O(rows_out) from one chip.
+
+
+class _MeshIneligible(Exception):
+    """Query shape the mesh program does not express (per-source perms,
+    hash plans over heterogeneous source shapes): degrade silently to the
+    single-chip dispatch — never an error."""
+
+
+def _mesh_runs(device_sources) -> list[list]:
+    """Split the global source list into CONTIGUOUS runs of identical
+    pytree structure + leaf shapes/dtypes: one shard_map dispatch per run
+    (stacking needs uniform shapes), cross-run states merge pairwise in
+    run order.  Contiguity preserves the global source order inside each
+    run, which is what the sums fold keys on."""
+    runs: list[list] = []
+    last_sig = None
+    for src in device_sources:
+        cols, valid, nulls, perm, limbs = src
+        if perm is not None:
+            raise _MeshIneligible(
+                "per-source permutation has no stacked mesh form"
+            )
+        leaves, treedef = jax.tree_util.tree_flatten((cols, valid, nulls, limbs))
+        sig = (
+            treedef,
+            tuple((tuple(l.shape), str(l.dtype)) for l in leaves),
+        )
+        if runs and sig == last_sig:
+            runs[-1].append(src)
+        else:
+            runs.append([src])
+            last_sig = sig
+    return runs
+
+
+def _stack_mesh_inputs(mesh, devices, sources, n_local):
+    """Stack one run's sources into global [D, S, ...] arrays sharded
+    over the `regions` axis with zero cross-device movement for sources
+    already resident on their mesh device (chunk placement co-locates
+    them); off-mesh sources hop once.  Devices short of S sources pad
+    with all-invalid dummies (valid=False ⇒ identity states).  Returns
+    (global_data, positions) where positions[k] = (device, local slot)
+    of global source k — the static fold order.
+
+    The per-dispatch jnp.stack DOES copy each device's local planes once
+    (HBM-bandwidth, device-local — no link traffic).  Deliberately NOT
+    cached: a resident stacked copy would permanently double every warm
+    entry's HBM footprint (the budget's scarcest resource), while the
+    transient copy lives only for the dispatch and costs microseconds
+    per GB next to the aggregation pass it feeds.  Revisit if profiles
+    ever show the stack dominating a warm mesh dispatch."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .mesh import REGION_AXIS
+
+    n_dev = len(devices)
+    dev_index = {d: i for i, d in enumerate(devices)}
+    per_dev: list[list] = [[] for _ in range(n_dev)]
+    positions: list[tuple[int, int]] = []
+    for k, (cols, valid, nulls, _perm, limbs) in enumerate(sources):
+        d = dev_index.get(
+            next(iter(valid.devices())) if hasattr(valid, "devices") else None
+        )
+        if d is None or len(per_dev[d]) >= n_local:
+            d = min(range(n_dev), key=lambda i: (len(per_dev[i]), i))
+        positions.append((d, len(per_dev[d])))
+        per_dev[d].append((cols, valid, nulls, limbs))
+    template = per_dev[positions[0][0]][0] if sources else None
+    stacked = []
+    for d, dev in enumerate(devices):
+        srcs = list(per_dev[d])
+        while len(srcs) < n_local:
+            srcs.append(
+                jax.tree_util.tree_map(
+                    lambda l: jax.device_put(jnp.zeros(l.shape, l.dtype), dev),
+                    template,
+                )
+            )
+        moved = [jax.device_put(s, dev) for s in srcs]
+        stacked.append(
+            jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *moved)
+        )
+    leaves0, treedef = jax.tree_util.tree_flatten(stacked[0])
+    per_dev_leaves = [jax.tree_util.tree_flatten(s)[0] for s in stacked]
+    sharding = NamedSharding(mesh, P(REGION_AXIS))
+    out_leaves = []
+    for i, leaf0 in enumerate(leaves0):
+        shards = [per_dev_leaves[d][i][None] for d in range(n_dev)]
+        out_leaves.append(
+            jax.make_array_from_single_device_arrays(
+                (n_dev,) + tuple(leaf0.shape), sharding, shards
+            )
+        )
+    return jax.tree_util.tree_unflatten(treedef, out_leaves), tuple(positions)
+
+
+@functools.lru_cache(maxsize=64)
+def _mesh_merge_program(plan, nullable_cols, mesh, n_local, positions):
+    """jit'd shard_map over the `regions` mesh computing per-source
+    partial AggStates (this device's n_local stacked sources) and merging
+    them with collectives — see the module-section comment above for the
+    order contract.  Hash plans thread a LOCAL key table per device, then
+    merge by keyed scatter before/through the collective: the gathered
+    per-device tables union into one deterministic table
+    (ops/aggregate.hash_group_slots over their keys — scatter-min claims,
+    data-order independent) and every source's state rows scatter through
+    its device's slot map in global source order.  Returns the merged
+    state dict (plus the union key table for hash), replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.aggregate import HASH_EMPTY, hash_group_slots
+    from .mesh import REGION_AXIS
+
+    is_hash = plan.agg_strategy == "hash"
+    n_dev = int(mesh.devices.size)
+    real = positions
+
+    def per_device(data, dyn):
+        cols, valid, nulls, limbs = data
+        local_states = []
+        table = (
+            jnp.full((plan.hash_slots,), HASH_EMPTY, jnp.int64)
+            if is_hash
+            else None
+        )
+        for s in range(n_local):
+            src_cols = {k: v[0, s] for k, v in cols.items()}
+            src_nulls = {k: v[0, s] for k, v in nulls.items()}
+            src_limbs = {
+                k: jax.tree_util.tree_map(lambda l: l[0, s], v)
+                for k, v in limbs.items()
+            }
+            if is_hash:
+                st, table = compute_partial_states(
+                    plan, src_cols, valid[0, s], src_nulls, dyn, None,
+                    count_cols=nullable_cols, limbs=src_limbs,
+                    hash_table=table,
+                )
+            else:
+                st = compute_partial_states(
+                    plan, src_cols, valid[0, s], src_nulls, dyn, None,
+                    count_cols=nullable_cols, limbs=src_limbs,
+                )
+            local_states.append(st)
+
+        def gathered(sts, get):
+            # [D, S, rows]: every device sees every source's partial
+            return jax.lax.all_gather(
+                jnp.stack([get(st) for st in sts]), REGION_AXIS
+            )
+
+        if is_hash:
+            # keyed-scatter merge: union the per-device tables, then fold
+            # every source's rows through its device's slot map
+            tables = jax.lax.all_gather(table, REGION_AXIS)  # [D, H]
+            keys_flat = tables.reshape(-1)
+            union = jnp.full((plan.hash_slots,), HASH_EMPTY, jnp.int64)
+            union, uslots, overflow_u = hash_group_slots(
+                union, keys_flat, keys_flat != HASH_EMPTY
+            )
+            slot_map = uslots.reshape(n_dev, plan.hash_slots)
+
+            def dev_idx(d, rows):
+                m = slot_map[d]
+                if rows == plan.hash_slots + 1:
+                    # the trailing masked/overflow row maps onto itself
+                    m = jnp.concatenate(
+                        [m, jnp.full((1,), plan.hash_slots, m.dtype)]
+                    )
+                return m
+
+            merged = {}
+            for key in local_states[0]:
+                sts = [ls[key] for ls in local_states]
+                if key == "__hash_overflow":
+                    local = sts[0].counts
+                    for st in sts[1:]:
+                        local = local + st.counts
+                    total = jax.lax.psum(local, REGION_AXIS)
+                    total = total + overflow_u.astype(total.dtype).reshape(1)
+                    merged[key] = AggState(counts=total)
+                    continue
+                kwargs = {}
+                if sts[0].sums is not None:
+                    g = gathered(sts, lambda st: st.sums)
+                    rows = g.shape[-1]
+                    acc = jnp.zeros((rows,), g.dtype)
+                    for d, s in real:
+                        acc = acc.at[dev_idx(d, rows)].add(g[d, s])
+                    kwargs["sums"] = acc
+                if sts[0].counts is not None:
+                    g = gathered(sts, lambda st: st.counts)
+                    rows = g.shape[-1]
+                    acc = jnp.zeros((rows,), g.dtype)
+                    for d, s in real:
+                        acc = acc.at[dev_idx(d, rows)].add(g[d, s])
+                    kwargs["counts"] = acc
+                if sts[0].mins is not None:
+                    g = gathered(sts, lambda st: st.mins)
+                    rows = g.shape[-1]
+                    acc = jnp.full((rows,), jnp.finfo(g.dtype).max, g.dtype)
+                    for d, s in real:
+                        acc = acc.at[dev_idx(d, rows)].min(g[d, s])
+                    kwargs["mins"] = acc
+                if sts[0].maxs is not None:
+                    g = gathered(sts, lambda st: st.maxs)
+                    rows = g.shape[-1]
+                    acc = jnp.full((rows,), jnp.finfo(g.dtype).min, g.dtype)
+                    for d, s in real:
+                        acc = acc.at[dev_idx(d, rows)].max(g[d, s])
+                    kwargs["maxs"] = acc
+                merged[key] = AggState(**kwargs)
+            return merged, union
+
+        merged = {}
+        for key in local_states[0]:
+            sts = [ls[key] for ls in local_states]
+            kwargs = {}
+            if sts[0].counts is not None:
+                local = sts[0].counts
+                for st in sts[1:]:
+                    local = local + st.counts
+                kwargs["counts"] = jax.lax.psum(local, REGION_AXIS)
+            if sts[0].mins is not None:
+                local = sts[0].mins
+                for st in sts[1:]:
+                    local = jnp.minimum(local, st.mins)
+                kwargs["mins"] = jax.lax.pmin(local, REGION_AXIS)
+            if sts[0].maxs is not None:
+                local = sts[0].maxs
+                for st in sts[1:]:
+                    local = jnp.maximum(local, st.maxs)
+                kwargs["maxs"] = jax.lax.pmax(local, REGION_AXIS)
+            if sts[0].sums is not None:
+                g = gathered(sts, lambda st: st.sums)
+                d0, s0 = real[0]
+                acc = g[d0, s0]
+                for d, s in real[1:]:
+                    acc = acc + g[d, s]
+                kwargs["sums"] = acc
+            if sts[0].last_ts is not None:
+                gt = gathered(sts, lambda st: st.last_ts)
+                gv = gathered(sts, lambda st: st.last_val)
+                d0, s0 = real[0]
+                lt, lv = gt[d0, s0], gv[d0, s0]
+                for d, s in real[1:]:
+                    bt, bv = gt[d, s], gv[d, s]
+                    # ties go to the later source — merge_states' rule
+                    newer = bt >= lt
+                    lv = jnp.where(newer, bv, lv)
+                    lt = jnp.maximum(lt, bt)
+                kwargs["last_ts"], kwargs["last_val"] = lt, lv
+            merged[key] = AggState(**kwargs)
+        return merged
+
+    # the outputs ARE replicated — collectives plus a fold every device
+    # computes identically — but the static replication checker cannot
+    # prove it through the gather-indexed fold; disable the check under
+    # whichever keyword this jax spells it
+    kw = {}
+    for name in ("check_rep", "check_vma"):
+        try:
+            import inspect
+
+            if name in inspect.signature(_shard_map).parameters:
+                kw = {name: False}
+                break
+        except (TypeError, ValueError):  # pragma: no cover — exotic jax
+            break
+    return jax.jit(
+        _shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(REGION_AXIS), P()),
+            out_specs=P(),
+            **kw,
+        )
+    )
+
+
+# cross-run merge on the first mesh device (tiny [G] leaves); shared
+# trace cache across queries
+_mesh_cross_merge = jax.jit(
+    lambda a, b: {k: merge_states(a[k], b[k]) for k in a}
+)
+
+
+@functools.lru_cache(maxsize=32)
+def _mesh_hash_cross_program(plan):
+    """Cross-run merge for hash plans: two runs' slot spaces are keyed by
+    DIFFERENT tables, so the pairwise merge is a keyed scatter — union
+    the two key tables deterministically, then scatter both runs' state
+    rows through their slot maps (a first, b second: run order)."""
+    from ..ops.aggregate import HASH_EMPTY, hash_group_slots
+
+    h = plan.hash_slots
+
+    def cross(a, akeys, b, bkeys):
+        keys = jnp.concatenate([akeys, bkeys])
+        union = jnp.full((h,), HASH_EMPTY, jnp.int64)
+        union, slots, overflow_u = hash_group_slots(
+            union, keys, keys != HASH_EMPTY
+        )
+        ia, ib = slots[:h], slots[h:]
+
+        def idx(part, rows):
+            if rows == h + 1:  # trailing masked/overflow row -> itself
+                part = jnp.concatenate([part, jnp.full((1,), h, part.dtype)])
+            return part
+
+        out = {}
+        for key in a:
+            sa, sb = a[key], b[key]
+            if key == "__hash_overflow":
+                tot = sa.counts + sb.counts
+                out[key] = AggState(
+                    counts=tot + overflow_u.astype(tot.dtype).reshape(1)
+                )
+                continue
+            kwargs = {}
+            if sa.sums is not None:
+                rows = sa.sums.shape[0]
+                acc = jnp.zeros((rows,), sa.sums.dtype)
+                acc = acc.at[idx(ia, rows)].add(sa.sums)
+                acc = acc.at[idx(ib, rows)].add(sb.sums)
+                kwargs["sums"] = acc
+            if sa.counts is not None:
+                rows = sa.counts.shape[0]
+                acc = jnp.zeros((rows,), sa.counts.dtype)
+                acc = acc.at[idx(ia, rows)].add(sa.counts)
+                acc = acc.at[idx(ib, rows)].add(sb.counts)
+                kwargs["counts"] = acc
+            if sa.mins is not None:
+                rows = sa.mins.shape[0]
+                acc = jnp.full((rows,), jnp.finfo(sa.mins.dtype).max, sa.mins.dtype)
+                acc = acc.at[idx(ia, rows)].min(sa.mins)
+                acc = acc.at[idx(ib, rows)].min(sb.mins)
+                kwargs["mins"] = acc
+            if sa.maxs is not None:
+                rows = sa.maxs.shape[0]
+                acc = jnp.full((rows,), jnp.finfo(sa.maxs.dtype).min, sa.maxs.dtype)
+                acc = acc.at[idx(ia, rows)].max(sa.maxs)
+                acc = acc.at[idx(ib, rows)].max(sb.maxs)
+                kwargs["maxs"] = acc
+            out[key] = AggState(**kwargs)
+        return out, union
+
+    return jax.jit(cross)
+
+
+def _mesh_run(plan, nullable_cols, mesh, device_sources, pdyn, hv, program):
+    """Execute one query's sources on the mesh: one shard_map dispatch
+    per shape run, cross-run pairwise merge, then the single-chip
+    program's OWN final_jit on the first mesh device (device-finalize
+    once, post-merge).  Returns the packed result buffers exactly as the
+    single-chip run_all would."""
+    devices = [mesh.devices.reshape(-1)[i] for i in range(mesh.devices.size)]
+    runs = _mesh_runs(device_sources)
+    merged = None
+    table_keys = None
+    for sources in runs:
+        n_local = -(-len(sources) // len(devices))
+        data, positions = _stack_mesh_inputs(mesh, devices, sources, n_local)
+        prog = _mesh_merge_program(
+            plan, nullable_cols, mesh, n_local, positions
+        )
+        out = prog(data, pdyn)
+        if plan.agg_strategy == "hash":
+            states, keys = out
+            if merged is None:
+                merged, table_keys = states, keys
+            else:
+                merged, table_keys = _mesh_hash_cross_program(plan)(
+                    merged, table_keys, states, keys
+                )
+        else:
+            states = out
+            merged = (
+                states
+                if merged is None
+                else _mesh_cross_merge(merged, states)
+            )
+    if merged is None:
+        raise ValueError("mesh program received no sources")
+    merged = jax.device_put(merged, devices[0])
+    if table_keys is not None:
+        table_keys = jax.device_put(table_keys, devices[0])
+    packed = program._final_jit(merged, hv, table_keys)
+    # Dispatch is ASYNC: a runtime failure in the collective program
+    # would otherwise surface at fetch time, OUTSIDE the caller's degrade
+    # handler, and fail a query the single chip can answer.  Settling
+    # here costs nothing — the very next step is the blocking fetch —
+    # and makes "any collective failure degrades" actually hold.
+    jax.block_until_ready(jax.tree_util.tree_leaves(packed))
+    # count the dispatch only once it SUCCEEDED: a degraded attempt must
+    # not double-count against the single-chip dispatch that follows
+    metrics.TPU_DEVICE_DISPATCHES.inc()
+    if _in_flow_maintenance():
+        metrics.FLOW_DEVICE_DISPATCH_TOTAL.inc()
+    return packed
 
 
 class _InflightFamily:
@@ -3445,17 +3922,26 @@ class TileExecutor:
             program, int_layout, acc32_layout, acc64_layout, int_dtype = (
                 _tile_program_cached(attempt_plan, nullable_cols, fspec)
             )
+            # multi-chip first (tile.mesh_devices > 0): the same sources
+            # under shard_map with collective merge; ANY failure there
+            # degrades to the single-chip dispatch below, never an error
+            packed = self._mesh_attempt(
+                attempt_plan, nullable_cols, device_sources, dyn, ctx,
+                program,
+            )
             try:
-                # fault point: arm with an error whose text contains
-                # RESOURCE_EXHAUSTED to drive the emergency-release +
-                # halve-chunk feedback loop without a real 16 GB set
-                _fault_fire("hbm.exhausted", table=ctx.table_key)
-                with tracing.span(
-                    "tile.dispatch",
-                    strategy=attempt_plan.agg_strategy,
-                    acc=attempt_plan.acc_dtype,
-                ):
-                    packed = program(tuple(device_sources), dyn)
+                if packed is None:
+                    # fault point: arm with an error whose text contains
+                    # RESOURCE_EXHAUSTED to drive the emergency-release +
+                    # halve-chunk feedback loop without a real 16 GB set
+                    _fault_fire("hbm.exhausted", table=ctx.table_key)
+                    with tracing.span(
+                        "tile.dispatch",
+                        strategy=attempt_plan.agg_strategy,
+                        acc=attempt_plan.acc_dtype,
+                        mesh_devices=0,
+                    ):
+                        packed = program(tuple(device_sources), dyn)
                 table = self._finalize(
                     packed, int_layout, acc32_layout, acc64_layout, int_dtype,
                     attempt_plan, lowering, schema, ctx, dyn_host, fspec,
@@ -3820,6 +4306,82 @@ class TileExecutor:
             if table is not None:
                 return table
         return None  # unreachable: the f64 pass never fails the verdict
+
+    # -- multi-chip dispatch -------------------------------------------------
+    def _mesh_attempt(
+        self, attempt_plan, nullable_cols, device_sources, dyn, ctx, program,
+    ):
+        """Try the multi-chip shard_map dispatch (tile.mesh_devices > 0).
+        Returns the packed result buffers, or None to run the single-chip
+        dispatch instead — shape ineligible, pass disabled, or ANY
+        failure in the collective program (the degrade contract: a broken
+        mesh must never fail a query the single chip can answer)."""
+        mesh_n = self.cache.mesh_devices()
+        if mesh_n <= 0:
+            return None
+        if not passes.enabled("mesh_dispatch", self.config):
+            passes.note(
+                "mesh_dispatch", False, "pass disabled: single-chip dispatch"
+            )
+            return None
+        pdyn = {
+            k: dyn[k]
+            for k in ("filter_values", "bucket_origin", "bucket_interval")
+        }
+        hv = jnp.asarray(dyn.get("having_values") or (0.0,), jnp.float64)
+        try:
+            mesh = self.cache.mesh(mesh_n)
+            # fault point: an injected error here IS a collective failure
+            # at the shard_map merge choke point — the degrade path below
+            # must serve the query from the single chip, bit-correct
+            _fault_fire(
+                "mesh.collective", table=ctx.table_key, devices=mesh_n
+            )
+            with tracing.span(
+                "tile.dispatch",
+                strategy=attempt_plan.agg_strategy,
+                acc=attempt_plan.acc_dtype,
+                mesh_devices=mesh_n,
+                shard_axis=REGION_AXIS,
+            ):
+                packed = _mesh_run(
+                    attempt_plan, nullable_cols, mesh, device_sources,
+                    pdyn, hv, program,
+                )
+            metrics.TILE_MESH_DISPATCHES.inc()
+            passes.note(
+                "mesh_dispatch", True,
+                f"{len(device_sources)} source(s) sharded over the "
+                f"{mesh_n}-device `{REGION_AXIS}` mesh: per-device partial "
+                "aggregates, psum/pmin/pmax merge, finalize once "
+                "post-merge",
+                devices=mesh_n, sources=len(device_sources),
+            )
+            return packed
+        except QueryTimeoutError:
+            raise  # the deadline owns the query, mesh or not
+        except _MeshIneligible as mi:
+            passes.note(
+                "mesh_dispatch", False, f"{mi}: single-chip dispatch"
+            )
+            return None
+        except Exception as exc:  # noqa: BLE001 — degrade, never fail
+            metrics.TILE_MESH_DEGRADED.inc()
+            tracing.add_event(
+                "mesh.degraded",
+                table=ctx.table_key,
+                error=type(exc).__name__,
+            )
+            logging.getLogger("greptimedb_tpu.tile").warning(
+                "mesh dispatch failed; degrading to single-chip: %s",
+                exc, exc_info=True,
+            )
+            passes.note(
+                "mesh_dispatch", False,
+                f"collective failure ({type(exc).__name__}): degraded to "
+                "the single-chip dispatch",
+            )
+            return None
 
     # -- helpers -------------------------------------------------------------
     @staticmethod
@@ -4379,6 +4941,11 @@ class TileExecutor:
 
     # -- host fast path ------------------------------------------------------
     _HOST_PATH_MAX_ROWS = 4 << 20
+    # Multi-key slices larger than this many (rows x value columns) cells
+    # route to the warm tile dispatch instead of the frontend-thread
+    # numpy pass (the cpu-max-all-8 contention fix); single-key probes
+    # are exempt — they are the host path's whole reason to exist.
+    _HOST_PATH_MAX_CELLS = 1 << 17
 
     def _host_cold_grouped(
         self, plan, dyn_host, super_entries, mem_slots,
@@ -4714,6 +5281,43 @@ class TileExecutor:
         for func, col in plan.agg_specs:
             per_col_aggs.setdefault(col, set()).add(_FUNC_TO_KERNEL[func])
 
+        # Multi-key wide slices (TSBS cpu-max-all-8: 8 hosts x 10 value
+        # columns) leave the host path once the device planes are warm:
+        # the numpy pass scales with keys x columns ON THE FRONTEND
+        # THREAD, so under concurrency it contends for the very CPU the
+        # admission layer is protecting, while the warm tile dispatch is
+        # flat.  Single-key probes (cpu-max-all-1, high-cpu-1) keep the
+        # zero-round-trip host serve; cold planes keep it too — an upload
+        # would cost more than the slice.
+        plan_value_cols = [
+            c for c in per_col_aggs if c != COUNT_STAR
+        ]
+        if (
+            len(eq_codes) > 1
+            and total * max(len(plan_value_cols), 1) > self._HOST_PATH_MAX_CELLS
+        ):
+            warm = super_entries and all(
+                all(
+                    c in e.cols
+                    or ("" + c) in e.limb_cols
+                    or any(
+                        c in wt["cols"] or c in wt["limbs"]
+                        for wt in e.window_tiles.values()
+                    )
+                    for c in plan_value_cols
+                )
+                for e in super_entries
+            )
+            if warm:
+                passes.note(
+                    "host_fast_path", False,
+                    f"{len(eq_codes)}-key x {len(plan_value_cols)}-column "
+                    "slice with warm device planes: tile dispatch beats "
+                    "the contention-sensitive host pass",
+                    keys=len(eq_codes), rows=total,
+                )
+                return None
+
         finals: dict[str, dict[str, np.ndarray]] = {
             "__presence": {"count": np.zeros(n_buckets, np.int64)}
         }
@@ -4900,7 +5504,11 @@ class TileExecutor:
         with tracing.span("tile.readback") as rb_span:
             t0 = time.perf_counter()
             fetched = self._fetch_result(packed)
-            buf, accs64 = fetched[0], fetched[1]
+            # compact (device-finalize) results are ONE flat buffer — the
+            # f64 rows ride it as packed bit pairs; full-buffer results
+            # keep the (buf, accs64) pair
+            buf = fetched[0]
+            accs64 = fetched[1] if len(fetched) > 1 else None
             # hash strategy ships the slot->gid key table as a third part
             table_keys = fetched[2] if len(fetched) > 2 else None
             ms = (time.perf_counter() - t0) * 1000.0
@@ -4970,6 +5578,18 @@ class TileExecutor:
             )
             off += g * 4
             n_out = int(np.frombuffer(buf[off : off + 4].tobytes(), np.int32)[0])
+            off += 4
+            if acc64_layout:
+                # f64 rows rode the flat buffer as IEEE bit pairs
+                # (pack_f64_bits): decode back to float64 on the host
+                from ..ops.aggregate import unpack_f64_bits
+
+                n64 = len(acc64_layout)
+                pairs = np.frombuffer(
+                    buf[off : off + n64 * g * 8].tobytes(), np.int32
+                ).reshape(n64, g, 2)
+                off += n64 * g * 8
+                accs64 = unpack_f64_bits(pairs)
         finals: dict[str, dict[str, np.ndarray]] = {}
         for i, (col, agg) in enumerate(int_layout):
             row = ints[i]
@@ -4994,7 +5614,7 @@ class TileExecutor:
                 "O(rows_out)",
                 rows_out=table.num_rows, cap=spec.cap,
                 groups=plan.num_groups,
-                fetched_bytes=buf.nbytes + accs64.nbytes,
+                fetched_bytes=buf.nbytes,
             )
             return table
         if is_hash:

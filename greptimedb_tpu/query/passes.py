@@ -138,6 +138,16 @@ register(
     "distributed",
 )
 register(
+    "mesh_dispatch",
+    "run the single-dispatch tile program under shard_map over the "
+    "`regions` device mesh (tile.mesh_devices): each device scans + "
+    "partially aggregates its shard, states merge via psum/pmin/pmax "
+    "collectives (hash tables by keyed scatter into a union table), "
+    "device-finalize runs once post-merge; any failure degrades to the "
+    "single-chip dispatch",
+    "distributed",
+)
+register(
     "state_ship",
     "ship partial aggregate STATES (not rows) from datanodes and merge "
     "at the frontend (MergeScan)",

@@ -384,6 +384,18 @@ class TileConfig:
     pipelined_build: bool = True
     # Host consolidation workers feeding the pipelined upload (>= 1).
     build_workers: int = 2
+    # Multi-chip sharded execution (parallel/tile_cache.py mesh path):
+    # N > 0 runs the single-dispatch tile program under shard_map over a
+    # 1-D `regions` mesh of the first N local devices — each device scans
+    # + partially aggregates its shard of the super-tile chunks and the
+    # partial AggStates merge via psum/pmin/pmax collectives (hash-slot
+    # tables merge by keyed scatter into a union table first), with
+    # device-finalize running once post-merge so readback stays
+    # O(rows_out) from one chip.  0 (default) keeps today's single-chip
+    # dispatch path bit-for-bit; any collective failure degrades to that
+    # path automatically (fault point `mesh.collective`).  Values above
+    # the available device count are rejected at config validation.
+    mesh_devices: int = 0
 
 
 @dataclasses.dataclass
@@ -611,6 +623,35 @@ class Config:
                 "tile.build_workers must be >= 1 host consolidation worker; "
                 f"got {t.build_workers!r}"
             )
+        if not isinstance(t.mesh_devices, int) or isinstance(t.mesh_devices, bool):
+            raise ConfigError(
+                "tile.mesh_devices must be an integer device count "
+                f"(0 = single-chip dispatch); got {t.mesh_devices!r}"
+            )
+        if t.mesh_devices < 0:
+            raise ConfigError(
+                "tile.mesh_devices must be >= 0 devices (0 = single-chip "
+                f"dispatch, N = shard over the first N); got {t.mesh_devices!r}"
+            )
+        if t.mesh_devices > 0:
+            # reject more mesh devices than the process can see — a mesh
+            # the runtime cannot build would otherwise fail at the first
+            # dispatch instead of at config time (jax is already resident
+            # in any process that runs queries; tolerate its absence so a
+            # config-only tool can still validate the rest)
+            try:
+                import jax
+
+                available = len(jax.devices())
+            except Exception:  # noqa: BLE001 — no runtime: skip the bound
+                available = None
+            if available is not None and t.mesh_devices > available:
+                raise ConfigError(
+                    f"tile.mesh_devices ({t.mesh_devices}) exceeds the "
+                    f"{available} available local device(s) — the regions "
+                    "mesh cannot be built; lower it or raise "
+                    "XLA_FLAGS=--xla_force_host_platform_device_count"
+                )
         if t.prewarm_debounce_s < 0:
             raise ConfigError(
                 "tile.prewarm_debounce_s must be >= 0 seconds (how long after "

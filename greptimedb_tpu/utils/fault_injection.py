@@ -75,6 +75,14 @@ Named points wired into the codebase:
                        best-effort contract: the batch is dropped and
                        counted, the traced query is never failed or
                        slowed
+    mesh.collective    multi-chip tile dispatch (parallel/tile_cache.py
+                       mesh path), fired at the host-side choke point of
+                       the shard_map merge — immediately before the
+                       compiled collective program executes (ctx: table,
+                       devices).  An injected error here proves the
+                       degrade contract: the query falls back to the
+                       single-chip dispatch path and still returns the
+                       correct answer (greptime_tile_mesh_degraded_total)
 
 Production overhead is near zero: `fire()` is a module-level function whose
 fast path is one read of a module global (`_ARMED`) — no locks, no dict
@@ -130,6 +138,7 @@ POINTS = frozenset(
         "index.segment_read",
         "index.build",
         "trace.self_write",
+        "mesh.collective",
     }
 )
 

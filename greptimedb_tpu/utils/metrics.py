@@ -283,6 +283,16 @@ TPU_DEVICE_DISPATCHES = REGISTRY.counter(
     "greptime_tpu_device_dispatches_total",
     "Compiled tile programs dispatched (one per lowered query attempt)",
 )
+TILE_MESH_DISPATCHES = REGISTRY.counter(
+    "greptime_tile_mesh_dispatches_total",
+    "Tile dispatches executed under shard_map on the regions device mesh "
+    "(tile.mesh_devices > 0)",
+)
+TILE_MESH_DEGRADED = REGISTRY.counter(
+    "greptime_tile_mesh_degraded_total",
+    "Mesh tile dispatches that failed (collective error / OOM) and "
+    "degraded to the single-chip path",
+)
 TPU_DEVICE_FETCHES = REGISTRY.counter(
     "greptime_tpu_device_fetches_total",
     "Device->host result fetches (one per lowered query attempt)",

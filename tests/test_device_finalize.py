@@ -254,11 +254,18 @@ def test_fetch_bytes_scale_with_rows_out(db, name, q, n_aggs):
     )
 
 
-def test_one_dispatch_one_fetch_per_lowered_query(db):
-    q = (
+@pytest.mark.parametrize(
+    "q",
+    [
         "SELECT host, time_bucket('30s', ts) AS tb, avg(u) AS au FROM t"
-        " GROUP BY host, tb ORDER BY tb DESC LIMIT 4"
-    )
+        " GROUP BY host, tb ORDER BY tb DESC LIMIT 4",
+        # lastpoint: its f64 rows ride the one flat buffer as packed IEEE
+        # bit pairs, so the compact fetch is a single device_get too
+        # (the 3-RTT floor fix) — still exactly one dispatch, one fetch
+        "SELECT host, last_value(u) AS lu FROM t GROUP BY host",
+    ],
+)
+def test_one_dispatch_one_fetch_per_lowered_query(db, q):
     db.sql_one(q)  # warm
     d0 = metrics.TPU_DEVICE_DISPATCHES.get()
     f0 = metrics.TPU_DEVICE_FETCHES.get()
