@@ -286,6 +286,11 @@ class Database:
                 self._prewarm_stop = True
                 self._prewarm_cv.notify()
             self._prewarm_thread.join(timeout=5.0)
+        te = getattr(self.query_engine, "_tile_executor", None)
+        if te is not None:
+            # stop the fused family builder: pending background builds are
+            # abandoned and their waiters woken before storage closes
+            te.shutdown_fused()
         from .utils import self_trace
 
         self_trace.stop(self)

@@ -528,6 +528,34 @@ def _compiled_step(mesh: Mesh, plan: DistGroupByPlan):
     return jax.jit(sharded)
 
 
+def host_last_winners(g, t, v, lexsort_cap: int = 1 << 22):
+    """Numpy twin of the device last_value kernel for ONE source range:
+    one (gid, ts, value) winner per gid present in `g`, where the winner
+    is the max-ts row and a ts tie resolves to the LAST row in scan order
+    (the device `_segment_blocked_last` highest-row-index rule — layout is
+    (pk, ts, write-order) sorted, so that is exactly last-write-wins).
+
+    Rows already sorted (gid non-decreasing, ts non-decreasing within each
+    gid run) take the O(n)-compare run-boundary path; unsorted tails
+    lexsort, whose STABLE order preserves the same tie rule.  Returns
+    None when the range is unsorted beyond `lexsort_cap` rows (callers
+    fall back to the device path).  Cross-source merging is the caller's
+    job: fold winners in source order with ties going to the later source
+    (`merge_states`' newer_or_tie rule)."""
+    if not len(g):
+        return g[:0], t[:0], v[:0]
+    runs_ok = bool(np.all(g[1:] >= g[:-1])) and bool(
+        np.all((g[1:] != g[:-1]) | (t[1:] >= t[:-1]))
+    )
+    if not runs_ok:
+        if len(g) > lexsort_cap:
+            return None
+        order = np.lexsort((t, g))
+        g, t, v = g[order], t[order], v[order]
+    ends = np.append(np.flatnonzero(g[1:] != g[:-1]), len(g) - 1)
+    return g[ends], t[ends], v[ends]
+
+
 @dataclass
 class GroupByResult:
     """Finalized aggregates plus the host-side group key decode."""

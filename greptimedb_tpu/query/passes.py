@@ -61,8 +61,18 @@ register(
 register(
     "cold_host_serve",
     "serve a COLD grouped aggregate straight from the host consolidation "
-    "(numpy bincount) instead of paying plane uploads; the next query "
-    "builds the device tiles",
+    "(bounded numpy pass — bincount folds, run-boundary last_value, "
+    "unique-compacted hash-scale group spaces) instead of paying plane "
+    "uploads; with tile.fused_build the fused family build then warms the "
+    "device planes in the background, otherwise the next query builds them",
+    "routing",
+)
+register(
+    "fused_build",
+    "consolidate the family's plane-requirement manifests into ONE cold "
+    "build pass: decode each SST file once, host-encode each column once, "
+    "batch uploads through the pipelined producer/consumer, and coalesce "
+    "concurrent cold builds onto one in-flight future",
     "routing",
 )
 register(

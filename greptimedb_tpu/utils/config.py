@@ -384,6 +384,22 @@ class TileConfig:
     pipelined_build: bool = True
     # Host consolidation workers feeding the pipelined upload (>= 1).
     build_workers: int = 2
+    # Fused family cold build (parallel/tile_cache.py): query plans (and
+    # prewarm) emit plane-requirement manifests; a cold grouped query of a
+    # NEW family answers from the host consolidation immediately while one
+    # consolidated background build — the UNION of the family's manifests
+    # (decode each SST once, encode each column once, one batched upload
+    # through the pipelined producer/consumer) — warms the device planes;
+    # concurrent cold builds for overlapping manifests coalesce onto one
+    # in-flight build future whose waiters adopt the leader's planes.
+    # False restores the per-query build ladder bit-for-bit: cold-serve at
+    # most once per entry, device planes built synchronously on the next
+    # touch, no background builder.
+    fused_build: bool = True
+    # Deadline for one background fused family build (upload + limb
+    # quantize + compile + priming dispatch); an expired build surfaces as
+    # a failed future and waiters fall back to building solo.
+    fused_build_timeout_s: float = 900.0
     # Multi-chip sharded execution (parallel/tile_cache.py mesh path):
     # N > 0 runs the single-dispatch tile program under shard_map over a
     # 1-D `regions` mesh of the first N local devices — each device scans
@@ -652,6 +668,17 @@ class Config:
                     "mesh cannot be built; lower it or raise "
                     "XLA_FLAGS=--xla_force_host_platform_device_count"
                 )
+        if not isinstance(t.fused_build, bool):
+            raise ConfigError(
+                "tile.fused_build must be a boolean (fused one-pass family "
+                f"cold builds + universal cold-serve); got {t.fused_build!r}"
+            )
+        if t.fused_build_timeout_s <= 0:
+            raise ConfigError(
+                "tile.fused_build_timeout_s must be > 0 seconds (deadline "
+                "for one background fused family build); got "
+                f"{t.fused_build_timeout_s!r}"
+            )
         if t.prewarm_debounce_s < 0:
             raise ConfigError(
                 "tile.prewarm_debounce_s must be >= 0 seconds (how long after "

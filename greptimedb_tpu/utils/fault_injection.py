@@ -139,6 +139,7 @@ POINTS = frozenset(
         "index.build",
         "trace.self_write",
         "mesh.collective",
+        "tile.fused_build",
     }
 )
 

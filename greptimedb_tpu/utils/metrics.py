@@ -235,6 +235,42 @@ TILE_DELTA_ROWS = REGISTRY.counter(
     "Rows merged into existing super-tiles by delta builds (the O(delta) "
     "post-flush cold contract)",
 )
+TILE_FUSED_MANIFESTS = REGISTRY.counter(
+    "greptime_tile_fused_manifests_total",
+    "Plane-requirement manifests recorded by query plans / prewarm for the "
+    "fused family build planner",
+)
+TILE_FUSED_BUILDS = REGISTRY.counter(
+    "greptime_tile_fused_builds_total",
+    "Fused family builds: one consolidated pass building the UNION of the "
+    "family's plane manifests (decode each SST once, encode each column "
+    "once, one batched upload)",
+)
+TILE_FUSED_DECODES_SAVED = REGISTRY.counter(
+    "greptime_tile_fused_decodes_saved_total",
+    "SST file decodes avoided because the fused family pass already holds "
+    "the file's host-encoded columns (per file per build request)",
+)
+TILE_FUSED_ENCODES_SAVED = REGISTRY.counter(
+    "greptime_tile_fused_encodes_saved_total",
+    "Per-column host encodes avoided because an earlier family member of "
+    "the fused build already encoded the column",
+)
+TILE_FILE_DECODES = REGISTRY.counter(
+    "greptime_tile_file_decodes_total",
+    "Real SST Parquet decodes performed by the tile build path — the "
+    "fused-build contract is exactly ONE per source file per family build",
+)
+TILE_BUILD_COALESCED = REGISTRY.counter(
+    "greptime_tile_build_coalesced_total",
+    "Cold tile builds that did NOT run because an in-flight fused family "
+    "build covered them; the waiter adopted the leader's planes",
+)
+TILE_COLD_SERVES = REGISTRY.counter(
+    "greptime_tile_cold_serves_total",
+    "Queries answered from the host consolidation by the cold-serve router "
+    "while device planes build in the background",
+)
 TILE_FLUSH_DELTA_FILES = REGISTRY.counter(
     "greptime_tile_flush_delta_files_total",
     "SST files announced to flush listeners as delta notifications",

@@ -1,0 +1,385 @@
+"""Fused one-pass family cold build + universal cold-serve
+(parallel/tile_cache.py, tile.fused_build).
+
+Contracts under test:
+  * bit-parity: warm device results after a FUSED family build are
+    byte-identical to warm results after per-query builds
+    (tile.fused_build=false), across sort/hash strategies, null
+    tags/values, delta-extend interleavings and the 1-device mesh path;
+  * one-pass: a multi-query family cold build decodes each source SST
+    file exactly ONCE (greptime_tile_file_decodes_total);
+  * universal cold-serve: every family's FIRST query (grouped avg,
+    last_value lastpoint, hash-scale group spaces) answers from the host
+    consolidation with zero device plane uploads;
+  * build coalescing: a second same-family query joins the in-flight
+    background build instead of building solo
+    (greptime_tile_build_coalesced_total);
+  * fault `tile.fused_build`: a failed background build never poisons
+    queries — the next touch builds solo and answers correctly.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.utils import fault_injection as fi
+from greptimedb_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.REGISTRY.disarm()
+    yield
+    fi.REGISTRY.disarm()
+
+
+def _mk(db, append=True):
+    with_clause = " WITH (append_mode = 'true')" if append else ""
+    db.sql(
+        "CREATE TABLE cpu (host STRING, ts TIMESTAMP(3) TIME INDEX,"
+        " u DOUBLE, v DOUBLE, w DOUBLE, PRIMARY KEY (host))" + with_clause
+    )
+
+
+def _load(db, rng, hosts=6, ticks=160, t0=0):
+    rows = []
+    for t in range(ticks):
+        for h in range(hosts):
+            # null tags and null values ride along (the parity suite's
+            # nullable coverage); u stays non-null so limb planes engage
+            host = "NULL" if rng.random() < 0.02 else f"'h{h}'"
+            v = "NULL" if rng.random() < 0.1 else f"{rng.uniform(0, 100):.6f}"
+            rows.append(
+                f"({host}, {t0 + t * 1000}, {rng.uniform(0, 100):.6f},"
+                f" {v}, {rng.uniform(0, 100):.6f})"
+            )
+    db.sql("INSERT INTO cpu VALUES " + ",".join(rows))
+
+
+FAMILY = [
+    # distinct plane manifests: different columns, window on/off,
+    # last_value, scalar aggregate with value filter
+    "SELECT host, time_bucket('30s', ts) AS tb, avg(u) AS a, count(*) AS c"
+    " FROM cpu WHERE ts >= 20000 AND ts < 120000 GROUP BY host, tb",
+    "SELECT host, time_bucket('30s', ts) AS tb, avg(v) AS a, max(w) AS m"
+    " FROM cpu WHERE ts >= 20000 AND ts < 120000 GROUP BY host, tb",
+    "SELECT host, last_value(u) AS lu FROM cpu GROUP BY host",
+    "SELECT count(*) AS n, max(u) AS m FROM cpu WHERE u > 50.0",
+]
+
+
+def _drain_fused(db, timeout=30.0):
+    """Wait until the background fused builder has no in-flight work."""
+    te = db.query_engine._tile_executor
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with te._fused_lock:
+            if not te._fused_builds and not te._fused_queue:
+                return
+        time.sleep(0.02)
+    raise AssertionError("fused builds did not drain")
+
+
+def _exact_equal(t1, t2, msg=""):
+    assert t1.num_rows == t2.num_rows, (msg, t1.num_rows, t2.num_rows)
+    assert t1.column_names == t2.column_names, msg
+    for name in t1.column_names:
+        a, b = t1[name].to_pylist(), t2[name].to_pylist()
+        for x, y in zip(a, b):
+            if isinstance(x, float) and isinstance(y, float):
+                ok = (x == y) or (np.isnan(x) and np.isnan(y))
+                assert ok, (msg, name, x, y)
+            else:
+                assert x == y, (msg, name, x, y)
+
+
+def _run_family(tmp_path, tag, fused, strategy="auto", mesh=0, delta=True):
+    d = Database(data_home=str(tmp_path / f"fb_{tag}"))
+    try:
+        d.config.tile.fused_build = fused
+        d.config.tile.mesh_devices = mesh
+        d.config.query.agg_strategy = strategy
+        d.config.query.tpu_min_rows = 1
+        rng = np.random.default_rng(7)
+        _mk(d)
+        _load(d, rng)
+        d.sql("ADMIN flush_table('cpu')")
+        for q in FAMILY:
+            d.sql_one(q)  # cold pass (host-served under fused)
+        if delta:
+            # delta-extend interleaving: an appended flush mid-family
+            _load(d, rng, ticks=30, t0=200_000)
+            d.sql("ADMIN flush_table('cpu')")
+            for q in FAMILY:
+                d.sql_one(q)
+        if fused:
+            _drain_fused(d)
+        warm = []
+        for q in FAMILY:
+            d.sql_one(q)  # settle any remaining build
+            warm.append(d.sql_one(q))  # warm device rep
+        return warm
+    finally:
+        d.close()
+
+
+@pytest.mark.parametrize(
+    "strategy,mesh", [("auto", 0), ("sort", 1), ("hash", 0)]
+)
+def test_fused_family_warm_bit_parity(tmp_path, strategy, mesh):
+    """Warm device results after the fused family build are byte-identical
+    to warm results after per-query builds — the planes the one-pass build
+    materializes ARE the per-query planes."""
+    fused = _run_family(
+        tmp_path, f"on_{strategy}_{mesh}", True, strategy, mesh
+    )
+    legacy = _run_family(
+        tmp_path, f"off_{strategy}_{mesh}", False, strategy, mesh
+    )
+    for q, t1, t2 in zip(FAMILY, fused, legacy):
+        k = [(t1.column_names[0], "ascending")]
+        if "tb" in t1.column_names:
+            k.append(("tb", "ascending"))
+        _exact_equal(t1.sort_by(k), t2.sort_by(k), q)
+
+
+def test_fused_cold_serves_every_family_before_planes(tmp_path):
+    """Every family's FIRST query answers from the host consolidation —
+    zero device plane uploads on the query path — including lastpoint
+    (last_value) and the scalar filtered aggregate."""
+    d = Database(data_home=str(tmp_path / "serve"))
+    try:
+        d.config.query.tpu_min_rows = 1
+        rng = np.random.default_rng(11)
+        _mk(d)
+        _load(d, rng)
+        d.sql("ADMIN flush_table('cpu')")
+        d.prewarm(tables=["cpu"])  # host consolidation off the query path
+        cache = d.query_engine.tile_cache
+        for e in cache._super.values():
+            assert not e.cols, "fused prewarm must not upload device planes"
+        cs0 = metrics.TILE_COLD_SERVES.get()
+        mf0 = metrics.TILE_FUSED_MANIFESTS.get()
+        cold = []
+        for q in FAMILY:
+            cold.append(d.sql_one(q))
+        assert metrics.TILE_COLD_SERVES.get() - cs0 == len(FAMILY), (
+            "every family's first touch must host-serve"
+        )
+        assert metrics.TILE_FUSED_MANIFESTS.get() - mf0 >= len(FAMILY)
+        _drain_fused(d)
+        # parity of the cold host serves vs the authoritative CPU path
+        d.config.query.backend = "cpu"
+        for q, t in zip(FAMILY, cold):
+            ref = d.sql_one(q)
+            k = [(t.column_names[0], "ascending")]
+            if "tb" in t.column_names:
+                k.append(("tb", "ascending"))
+            s1 = t.sort_by(k).to_pydict()
+            s2 = ref.sort_by(k).to_pydict()
+            assert list(s1) == list(s2), q
+            for c in s1:
+                for x, y in zip(s1[c], s2[c]):
+                    if isinstance(x, float) and isinstance(y, float):
+                        assert (
+                            x == y
+                            or (np.isnan(x) and np.isnan(y))
+                            or abs(x - y) <= 1e-9 * max(1.0, abs(y))
+                        ), (q, c, x, y)
+                    else:
+                        assert x == y, (q, c, x, y)
+    finally:
+        d.close()
+
+
+def test_fused_decode_once_contract(tmp_path):
+    """The one-pass contract, metric-asserted: a whole multi-query family
+    cold build decodes each source SST file exactly once."""
+    d = Database(data_home=str(tmp_path / "once"))
+    try:
+        d.config.query.tpu_min_rows = 1
+        rng = np.random.default_rng(3)
+        _mk(d)
+        _load(d, rng)
+        d.sql("ADMIN flush_table('cpu')")
+        n_files = sum(
+            len(d.storage.region(rid).tile_snapshot()[0])
+            for meta in d.catalog.tables("public")
+            for rid in meta.region_ids
+        )
+        assert n_files >= 1
+        d0 = metrics.TILE_FILE_DECODES.get()
+        for q in FAMILY:
+            d.sql_one(q)
+        _drain_fused(d)
+        for q in FAMILY:
+            d.sql_one(q)  # warm reps must not re-decode either
+        decodes = metrics.TILE_FILE_DECODES.get() - d0
+        assert decodes == n_files, (
+            f"family build decoded {decodes} times for {n_files} files — "
+            "the fused pass must decode each source file exactly once"
+        )
+    finally:
+        d.close()
+
+
+def test_fused_build_coalesces_concurrent_queries(tmp_path):
+    """While the background family build is in flight, a second query of
+    the family WAITS on it (adopting the leader's planes) instead of
+    running a duplicate full build."""
+    d = Database(data_home=str(tmp_path / "coal"))
+    try:
+        d.config.query.tpu_min_rows = 1
+        rng = np.random.default_rng(5)
+        _mk(d)
+        _load(d, rng, ticks=80)
+        d.sql("ADMIN flush_table('cpu')")
+        q = FAMILY[0]
+        # hold the background builder at the fault point long enough for
+        # the second query to observe the in-flight build
+        plan = fi.REGISTRY.arm(
+            "tile.fused_build", fail_times=1, latency_s=1.5
+        )
+        c0 = metrics.TILE_BUILD_COALESCED.get()
+        t1 = d.sql_one(q)  # host-served; schedules the build
+        t2 = d.sql_one(q)  # must join the in-flight build
+        assert plan.hits >= 1
+        assert metrics.TILE_BUILD_COALESCED.get() > c0, (
+            "second family query must coalesce onto the in-flight build"
+        )
+        _exact_equal(
+            t1.sort_by([("host", "ascending"), ("tb", "ascending")]),
+            t2.sort_by([("host", "ascending"), ("tb", "ascending")]),
+        )
+    finally:
+        d.close()
+
+
+def test_fused_build_fault_leaves_queries_healthy(tmp_path):
+    """fault point tile.fused_build: a background build that dies never
+    fails (or wrongs) a query — the next touch builds solo."""
+    d = Database(data_home=str(tmp_path / "fault"))
+    try:
+        d.config.query.tpu_min_rows = 1
+        rng = np.random.default_rng(9)
+        _mk(d)
+        _load(d, rng, ticks=60)
+        d.sql("ADMIN flush_table('cpu')")
+        fi.REGISTRY.arm(
+            "tile.fused_build", fail_times=10, error=RuntimeError
+        )
+        q = FAMILY[0]
+        t1 = d.sql_one(q)  # host-served; background build will fail
+        _drain_fused(d)
+        t2 = d.sql_one(q)  # solo build on the query path
+        fi.REGISTRY.disarm()
+        t3 = d.sql_one(q)
+        d.config.query.backend = "cpu"
+        ref = d.sql_one(q)
+        d.config.query.backend = "tpu"
+        k = [("host", "ascending"), ("tb", "ascending")]
+        for t in (t1, t2, t3):
+            s1 = t.sort_by(k).to_pydict()
+            s2 = ref.sort_by(k).to_pydict()
+            assert s1["host"] == s2["host"] and s1["c"] == s2["c"]
+            np.testing.assert_allclose(s1["a"], s2["a"], rtol=1e-9)
+    finally:
+        d.close()
+
+
+def test_fused_hash_scale_group_space_cold_serve(tmp_path):
+    """A group space past the dense 2^22 bound (three-tag composite)
+    cold-serves through the unique-compacted fold."""
+    d = Database(data_home=str(tmp_path / "hashscale"))
+    try:
+        d.config.query.tpu_min_rows = 1
+        d.sql(
+            "CREATE TABLE m (a STRING, b STRING, c STRING,"
+            " ts TIMESTAMP(3) TIME INDEX, x DOUBLE, PRIMARY KEY (a, b, c))"
+            " WITH (append_mode = 'true')"
+        )
+        rng = np.random.default_rng(13)
+        rows = []
+        for i in range(600):
+            rows.append(
+                f"('a{rng.integers(0, 200)}', 'b{rng.integers(0, 200)}',"
+                f" 'c{rng.integers(0, 200)}', {i * 1000},"
+                f" {rng.uniform(0, 10):.6f})"
+            )
+        d.sql("INSERT INTO m VALUES " + ",".join(rows))
+        d.sql("ADMIN flush_table('m')")
+        cs0 = metrics.TILE_COLD_SERVES.get()
+        q = "SELECT a, b, c, sum(x) AS s, count(*) AS n FROM m GROUP BY a, b, c"
+        t = d.sql_one(q)
+        assert metrics.TILE_COLD_SERVES.get() > cs0, (
+            "hash-scale group space must cold-serve via the compact fold"
+        )
+        d.config.query.backend = "cpu"
+        ref = d.sql_one(q)
+        k = [("a", "ascending"), ("b", "ascending"), ("c", "ascending")]
+        s1, s2 = t.sort_by(k).to_pydict(), ref.sort_by(k).to_pydict()
+        assert s1["a"] == s2["a"] and s1["n"] == s2["n"]
+        np.testing.assert_allclose(s1["s"], s2["s"], rtol=1e-9)
+    finally:
+        d.close()
+
+
+def test_build_gate_coalesces_prewarm_and_queries(tmp_path):
+    """The per-table build gate: N concurrent fused builds collapse to one
+    leader; waiters adopt (greptime_tile_build_coalesced_total)."""
+    d = Database(data_home=str(tmp_path / "gate"))
+    try:
+        cache = d.query_engine.tile_cache
+        ran = []
+        c0 = metrics.TILE_BUILD_COALESCED.get()
+        barrier = threading.Barrier(3)
+
+        def enter():
+            barrier.wait()
+            with cache.build_gate("public.cpu") as leader:
+                if leader:
+                    time.sleep(0.2)  # hold the gate so others must wait
+                ran.append(leader)
+
+        ts = [threading.Thread(target=enter) for _ in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert sorted(ran) == [False, False, True]
+        assert metrics.TILE_BUILD_COALESCED.get() - c0 == 2
+    finally:
+        d.close()
+
+
+def test_fused_off_restores_serve_once_ladder(tmp_path):
+    """tile.fused_build=false: the legacy ladder bit-for-bit — cold-serve
+    at most once per entry, the SECOND touch builds device planes on the
+    query path, and no background builder thread ever runs."""
+    d = Database(data_home=str(tmp_path / "legacy"))
+    try:
+        d.config.tile.fused_build = False
+        d.config.query.tpu_min_rows = 1
+        rng = np.random.default_rng(17)
+        _mk(d)
+        _load(d, rng, ticks=60)
+        d.sql("ADMIN flush_table('cpu')")
+        q = FAMILY[0]
+        d.sql_one(q)
+        cache = d.query_engine.tile_cache
+        entries = list(cache._super.values())
+        assert entries and all(e.cold_served for e in entries)
+        assert all(not e.cols for e in entries), (
+            "legacy cold serve must not upload planes"
+        )
+        te = d.query_engine._tile_executor
+        assert te._fused_thread is None, (
+            "fused_build=false must never spawn the background builder"
+        )
+        d.sql_one(q)  # second touch: synchronous device build
+        assert any(e.cols for e in cache._super.values())
+    finally:
+        d.close()

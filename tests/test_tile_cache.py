@@ -772,7 +772,11 @@ def test_cold_host_serve_then_device_build(db):
     """A cold grouped aggregate answers from the host consolidation with
     ZERO device plane uploads (on the remote-TPU harness uploads dominate
     cold latency); the next touch builds the HBM tiles so warm reps keep
-    the one-dispatch path.  Results match the CPU path in both phases."""
+    the one-dispatch path.  Results match the CPU path in both phases.
+    Pinned to the LEGACY ladder (tile.fused_build=false) — under the fused
+    planner the second touch joins a background build instead
+    (tests/test_fused_build.py covers that contract)."""
+    db.config.tile.fused_build = False
     _mk_cpu_table(db)
     _load(db, hosts=8, ticks=400)
     db.sql("ADMIN flush_table('cpu')")
