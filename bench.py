@@ -59,6 +59,7 @@ if hasattr(faulthandler, "register") and hasattr(signal, "SIGTERM"):
 
 import numpy as np
 import pyarrow as pa
+import pyarrow.compute as pc
 
 from greptimedb_tpu.utils.jax_env import ensure_x64
 
@@ -262,7 +263,7 @@ _COMPACT_QUERY_KEYS = ("cold_ms", "warm_ms", "vs_baseline", "reference_ms")
 _COMPACT_DETAIL_KEYS = (
     "device", "rows", "dataset_hours", "geomean_vs_baseline_all",
     "geomean_vs_baseline_heavy", "prewarm_s", "budget_watchdog_fired",
-    "killed_by_signal", "budget_exhausted", "dataset_reused",
+    "killed_by_signal", "budget_exhausted", "dataset_reused", "tql",
 )
 
 
@@ -773,6 +774,200 @@ def _agg_strategy_probe(db) -> dict:
     return out
 
 
+def _numpy_rate_twin_ms(sid, ts, vals, num_series, start, end, step, rng_ms):
+    """Host-numpy reference for PromQL rate over flat sorted samples —
+    the TQL phase's equivalent of the TSBS reference_ms twin: vectorized
+    reset strip + K-windows-per-sample fold + extrapolatedRate, timed.
+    Returns (elapsed_ms, defined_cell_count)."""
+    t0 = time.perf_counter()
+    steps = np.arange(start, end + 1, step, dtype=np.int64)
+    W = len(steps)
+    k = -(-rng_ms // step)
+    G = num_series * W
+    prev_v = np.concatenate([vals[:1], vals[:-1]])
+    prev_s = np.concatenate([sid[:1], sid[:-1]])
+    same = sid == prev_s
+    if len(same):
+        same[0] = False
+    drop = np.where(same & (vals < prev_v), prev_v, 0.0)
+    cum = np.cumsum(drop)
+    idx = np.arange(len(sid))
+    marked = np.where(~same, idx, 0)
+    last_first = np.maximum.accumulate(marked)
+    adj = vals + (cum - (cum - drop)[last_first])
+    w0 = np.maximum(np.ceil((ts - start) / step).astype(np.int64), 0)
+    count = np.zeros(G, np.int64)
+    first_ts = np.full(G, np.iinfo(np.int64).max)
+    last_ts = np.full(G, np.iinfo(np.int64).min)
+    fv = np.zeros(G)
+    lv = np.zeros(G)
+    sidW = sid.astype(np.int64) * W
+    for j in range(k):
+        w = w0 + j
+        t_w = start + w * step
+        in_w = (w < W) & (ts <= t_w) & (ts > t_w - rng_ms)
+        g = (sidW + w)[in_w]
+        np.add.at(count, g, 1)
+        np.minimum.at(first_ts, g, ts[in_w])
+        np.maximum.at(last_ts, g, ts[in_w])
+    for j in range(k):
+        w = w0 + j
+        t_w = start + w * step
+        in_w = (w < W) & (ts <= t_w) & (ts > t_w - rng_ms)
+        g = (sidW + w)[in_w]
+        at_f = ts[in_w] == first_ts[g]
+        at_l = ts[in_w] == last_ts[g]
+        fv[g[at_f]] = adj[in_w][at_f]
+        lv[g[at_l]] = adj[in_w][at_l]
+    defined = count >= 2
+    si = (last_ts - first_ts).astype(np.float64)
+    safe_c = np.maximum(count, 2)
+    avg_b = si / (safe_c - 1)
+    w_idx = np.arange(G, dtype=np.int64) % W
+    t_end = start + w_idx * step
+    d_s = (first_ts - (t_end - rng_ms)).astype(np.float64)
+    d_e = (t_end - last_ts).astype(np.float64)
+    thr = avg_b * 1.1
+    ext_s = np.where(d_s < thr, d_s, avg_b / 2.0)
+    ext_e = np.where(d_e < thr, d_e, avg_b / 2.0)
+    result = lv - fv
+    with np.errstate(all="ignore"):
+        zero_dur = np.where(result > 0, si * (fv / np.where(result == 0, 1.0, result)), np.inf)
+        ext_s = np.minimum(ext_s, np.where(zero_dur < 0, ext_s, zero_dur))
+        safe_si = np.where(si == 0, 1.0, si)
+        rate = result * ((si + ext_s + ext_e) / safe_si) / (rng_ms / 1000.0)
+    n_def = int(defined.sum())
+    _sink = float(np.nansum(np.where(defined, rate, 0.0)))  # force compute
+    return (time.perf_counter() - t0) * 1000.0, n_def
+
+
+def _tql_phase(db) -> dict:
+    """TQL bench phase (ISSUE 13): PromQL rate / increase / sum by
+    (hostname) of rate over a single-field metric twin of the persisted
+    TSBS cpu data — warm tile path vs the legacy upload-per-query path
+    (tql.tile=false) vs the host-numpy reference twin.  Every step is
+    gated on REMAINING budget with the abort point recorded, so this
+    phase can never jeopardize the main record."""
+    from greptimedb_tpu.utils import metrics as m
+
+    out: dict = {}
+    te_ms = END
+    ts_ms = END - 2 * 3600_000  # last 2 h of the dataset
+    # single-field metric table (the PromQL engine needs one value
+    # column); persists with the dataset dir and is reused across runs
+    have = 0
+    try:
+        have = db.sql_one("SELECT count(*) AS n FROM tql_cpu")["n"][0].as_py()
+    except Exception:  # noqa: BLE001 — table does not exist yet
+        db.sql(
+            "CREATE TABLE tql_cpu (hostname STRING, greptime_value DOUBLE,"
+            " ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY (hostname))"
+            " WITH (append_mode = 'true')"
+        )
+    src = db.sql_one(
+        f"SELECT hostname, ts, usage_user FROM cpu"
+        f" WHERE ts >= {ts_ms} AND ts < {te_ms}"
+    )
+    if have < src.num_rows:
+        t0 = time.perf_counter()
+        batch = pa.table({
+            "hostname": src["hostname"],
+            "greptime_value": pc.cast(src["usage_user"], pa.float64()),
+            "ts": src["ts"],
+        })
+        db.insert_rows("tql_cpu", batch)
+        db.storage.flush_all()
+        out["ingest_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+    out["rows"] = src.num_rows
+    if _remaining() < 180:
+        out["skipped"] = "remaining budget after tql ingest"
+        return out
+
+    # host-numpy reference twin over the same flat samples
+    hn = src["hostname"].to_pylist()
+    ts_np = np.asarray(pc.cast(src["ts"], pa.int64()).to_numpy(zero_copy_only=False))
+    v_np = np.asarray(pc.cast(src["usage_user"], pa.float64()).to_numpy(zero_copy_only=False))
+    combos: dict = {}
+    sid = np.empty(len(hn), np.int32)
+    for i, h in enumerate(hn):
+        if h not in combos:
+            combos[h] = len(combos)
+        sid[i] = combos[h]
+    order = np.lexsort((ts_np, sid))
+    sid, ts_np, v_np = sid[order], ts_np[order], v_np[order]
+    start_s, end_s = ts_ms // 1000 + 600, te_ms // 1000 - 60
+    start, end, step, rng_ms = start_s * 1000, end_s * 1000, 60_000, 300_000
+    twin_ms, twin_cells = _numpy_rate_twin_ms(
+        sid, ts_np, v_np, len(combos), start, end, step, rng_ms
+    )
+    out["twin_ms"] = round(twin_ms, 1)
+    out["twin_cells"] = twin_cells
+
+    queries = [
+        ("rate", f"TQL EVAL ({start_s}, {end_s}, '60s') rate(tql_cpu[5m])",
+         True),
+        ("sumby", f"TQL EVAL ({start_s}, {end_s}, '60s')"
+                  " sum by (hostname) (rate(tql_cpu[5m]))", True),
+        ("inc1", f"TQL EVAL ({start_s}, {end_s}, '60s')"
+                 " increase(tql_cpu{hostname='host_1'}[5m])", False),
+    ]
+    for name, q, heavy in queries:
+        if _remaining() < 120:
+            out.setdefault("skipped_queries", []).append(
+                {"query": name, "reason": "remaining budget"}
+            )
+            continue
+        rec: dict = {"heavy": heavy}
+        try:
+            db.config.query.timeout_s = max(min(240.0, _remaining() - 30), 20.0)
+            cs0 = m.TQL_TILE_COLD_SERVES.get()
+            t0 = time.perf_counter()
+            t = db.sql_one(q)
+            rec["cold_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+            rec["rows_out"] = t.num_rows
+            rec["cold_served"] = int(m.TQL_TILE_COLD_SERVES.get() - cs0)
+            # wait out the background family build (budget-bounded)
+            te = db.query_engine._tile_executor
+            deadline = time.monotonic() + max(min(120.0, _remaining() - 60), 5.0)
+            while time.monotonic() < deadline:
+                with te._fused_lock:
+                    if not te._fused_builds and not te._fused_queue:
+                        break
+                time.sleep(0.1)
+            walls = []
+            d0 = m.TQL_TILE_DISPATCHES.get()
+            for _ in range(3):
+                if _remaining() < 60:
+                    break
+                t0 = time.perf_counter()
+                db.sql_one(q)
+                walls.append((time.perf_counter() - t0) * 1000)
+            if walls:
+                rec["warm_ms"] = round(float(np.median(walls)), 1)
+                rec["tile_dispatches"] = int(m.TQL_TILE_DISPATCHES.get() - d0)
+            legacy = []
+            db.config.tql.tile = False
+            try:
+                for _ in range(2):
+                    if _remaining() < 60:
+                        break
+                    t0 = time.perf_counter()
+                    db.sql_one(q)
+                    legacy.append((time.perf_counter() - t0) * 1000)
+            finally:
+                db.config.tql.tile = True
+            if legacy:
+                rec["legacy_ms"] = round(float(np.median(legacy)), 1)
+            if walls and legacy:
+                rec["vs_legacy"] = round(rec["legacy_ms"] / max(rec["warm_ms"], 1e-9), 2)
+        except Exception as e:  # noqa: BLE001 — record, keep phasing
+            rec["error"] = repr(e)
+        finally:
+            db.config.query.timeout_s = 0.0
+        out[name] = rec
+    return out
+
+
 def main():
     ensure_x64()
     _start_budget_watchdog()
@@ -959,6 +1154,12 @@ def main():
         err = None
         cs0 = m.TILE_COLD_SERVES.get()
         bc0 = m.TILE_BUILD_COALESCED.get()
+        # cold-phase readback accounting starts HERE: the cold query +
+        # the untimed build rep fetch through the same counters, and
+        # mixing them into the warm average made the record misleading
+        # (dg-5: warm_ms 290 with readback_ms_avg 8431)
+        rb_cold0 = m.TPU_READBACK_MS.sum()
+        rep_readback: list[float] = []
         try:
             # HARD per-query watchdog (round-4 driver lesson): cold pays
             # consolidation/upload/compile, so it gets the wide ceiling;
@@ -1020,6 +1221,7 @@ def main():
                 db.config.query.timeout_s = min(
                     120.0, max(_remaining(), 15.0)
                 )
+                rb_rep0 = m.TPU_READBACK_MS.sum()
                 t0 = time.perf_counter()
                 try:
                     table = db.sql_one(sql)
@@ -1032,6 +1234,7 @@ def main():
                         raise
                     continue
                 walls.append((time.perf_counter() - t0) * 1000)
+                rep_readback.append(m.TPU_READBACK_MS.sum() - rb_rep0)
         except _BudgetSkip:
             pass  # recorded via build_skipped; cold_ms already landed
         except Exception as e:  # noqa: BLE001 — one bad query must not kill the run
@@ -1077,7 +1280,12 @@ def main():
                 # uniform for EVERY query (0 = served without a device
                 # fetch: host fast path / cold serve / CPU route)
                 device_fetches=int(n_rb),
-                readback_ms_avg=round((rb1[0] - rb0[0]) / n_rb, 2) if n_rb else 0.0,
+                # WARM-only: median of per-rep readback deltas — a rep
+                # that rebuilt planes no longer poisons the average
+                readback_ms_avg=round(float(np.median(rep_readback)), 2)
+                if rep_readback else 0.0,
+                # cold + untimed build rep readback, reported separately
+                readback_ms_cold=round(rb0[0] - rb_cold0, 2),
                 # transfer vs host-decode split per query (streamed-
                 # readback wins must be attributable, not inferred)
                 readback_transfer_ms_avg=round(
@@ -1141,6 +1349,44 @@ def main():
                    "elapsed_s": round(_elapsed(), 1)})
         except Exception as e:  # noqa: BLE001 — probe must never kill the bench
             detail["agg_strategy_probe"] = {"error": repr(e)}
+        _write_partial({"detail": detail, "queries": results})
+
+    # ---- TQL phase ---------------------------------------------------------
+    # PromQL rate / increase / sum-by over a single-field twin of the
+    # persisted cpu data: warm tile path vs legacy upload-per-query vs
+    # the host-numpy reference.  REMAINING-budget gated with the skip
+    # reason recorded — it can never jeopardize the main record.
+    if os.environ.get("GRAFT_BENCH_TQL", "1") != "0":
+        if budget_hit or _remaining() < 300:
+            detail["tql"] = {
+                "skipped": "remaining budget below tql-phase floor",
+                "remaining_s": round(_remaining(), 1),
+            }
+        else:
+            try:
+                tql_full = _tql_phase(db)
+                detail["tql_full"] = tql_full
+                # compact digest for the <1.9 KB record: per query
+                # [warm, legacy, speedup] plus the twin reference
+                digest: dict = {}
+                for k in ("rate", "sumby", "inc1"):
+                    r = tql_full.get(k)
+                    if isinstance(r, dict) and "warm_ms" in r:
+                        digest[k] = [
+                            r.get("warm_ms"), r.get("legacy_ms"),
+                            r.get("vs_legacy"),
+                        ]
+                    elif isinstance(r, dict) and "error" in r:
+                        digest[k] = {"error": str(r["error"])[:40]}
+                if "twin_ms" in tql_full:
+                    digest["twin_ms"] = tql_full["twin_ms"]
+                if "skipped" in tql_full:
+                    digest["skipped"] = tql_full["skipped"]
+                detail["tql"] = digest
+                _emit({"event": "tql_phase", **tql_full,
+                       "elapsed_s": round(_elapsed(), 1)})
+            except Exception as e:  # noqa: BLE001 — phase must never kill
+                detail["tql"] = {"error": repr(e)[:80]}
         _write_partial({"detail": detail, "queries": results})
 
     # ---- second-process cold probe -----------------------------------------

@@ -43,6 +43,42 @@ class RangeSpec:
         return -(-self.range_ // self.step)  # ceil
 
 
+def strip_counter_resets_segmented(
+    series: jnp.ndarray, values: jnp.ndarray, valid: jnp.ndarray
+) -> jnp.ndarray:
+    """`strip_counter_resets` for PADDED tile planes: invalid rows (pad
+    rows, dedup losers, rows outside the fetch range) may sit BETWEEN a
+    series' samples, so "previous sample" means the previous VALID row of
+    the same series, found with a cummax over valid row indices.  The
+    accumulation mirrors `strip_counter_resets` operation-for-operation
+    (global cumsum of reset adds, then per-series baseline subtraction):
+    invalid rows contribute exact 0.0 terms to the cumsum, so on the same
+    logical sample sequence the output is BIT-identical to running the
+    dense kernel on the compacted array.  Only valid rows' outputs are
+    meaningful."""
+    n = series.shape[0]
+    idx = jnp.arange(n)
+    last_valid = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(valid, idx, -1)
+    )
+    prev_idx = jnp.concatenate([jnp.full((1,), -1), last_valid[:-1]])
+    safe_prev = jnp.clip(prev_idx, 0, None)
+    pv = jnp.take(values, safe_prev)
+    ps = jnp.take(series, safe_prev)
+    same = valid & (prev_idx >= 0) & (ps == series)
+    reset_add = jnp.where(same & (values < pv), pv, 0.0)
+    cum = jnp.cumsum(reset_add)
+    is_first = valid & ~same
+    marked = jnp.where(is_first, idx, -1)
+    last_first_idx = jax.lax.associative_scan(jnp.maximum, marked)
+    baseline = jnp.where(
+        last_first_idx >= 0,
+        jnp.take(cum - reset_add, jnp.clip(last_first_idx, 0, None)),
+        0.0,
+    )
+    return values + (cum - baseline)
+
+
 def strip_counter_resets(series: jnp.ndarray, values: jnp.ndarray, valid: jnp.ndarray):
     """Per-series monotonic re-accumulation: after a counter reset
     (v[i] < v[i-1]), add the pre-reset level so adjusted values never
@@ -98,9 +134,40 @@ def range_windows(
     Window w covers (t_w - range, t_w] with t_w = start + w*step —
     Prometheus range selector semantics (left-open, right-closed).
     """
-    n_steps = spec.num_steps
-    k = spec.windows_per_sample
+    return range_windows_dyn(
+        series, ts, values, valid,
+        start=spec.start, step=spec.step, range_=spec.range_,
+        n_steps=spec.num_steps, k=spec.windows_per_sample,
+        num_series=num_series, acc_dtype=acc_dtype,
+    )
+
+
+def range_windows_dyn(
+    series: jnp.ndarray,
+    ts: jnp.ndarray,
+    values: jnp.ndarray,
+    valid: jnp.ndarray,
+    start,
+    step,
+    range_,
+    n_steps: int,
+    k: int,
+    num_series: int,
+    acc_dtype=jnp.float64,
+    n_steps_actual=None,
+) -> WindowStats:
+    """`range_windows` with the evaluation grid split into STATIC shape
+    parameters (`n_steps`, `k` — the [S*W] layout and the per-sample
+    window unroll) and DYNAMIC values (`start`/`step`/`range_` may be
+    traced scalars), so one compiled program serves every query in a
+    (padded-series, padded-steps, padded-k) shape bucket — a dashboard
+    sliding its window re-hits the compile cache instead of re-tracing.
+    `n_steps_actual` (dynamic, defaults to `n_steps`) masks the padded
+    windows past the real grid; arithmetic on the surviving windows is
+    identical to the static form, so results are bit-identical."""
     num_groups = num_series * n_steps
+    if n_steps_actual is None:
+        n_steps_actual = n_steps
     segs = num_groups + 1
     v = values.astype(acc_dtype)
 
@@ -117,12 +184,12 @@ def range_windows(
     max_ = jnp.full(segs, small, acc_dtype)
 
     # First window index that can contain sample t: smallest w with t_w >= t.
-    w0 = jnp.ceil((ts - spec.start) / spec.step).astype(jnp.int32)
+    w0 = jnp.ceil((ts - start) / step).astype(jnp.int32)
     w0 = jnp.maximum(w0, 0)
     for j in range(k):  # static unroll: samples fall in at most k windows
         w = w0 + j
-        t_w = spec.start + w.astype(jnp.int64) * spec.step
-        in_win = valid & (w >= 0) & (w < n_steps) & (ts <= t_w) & (ts > t_w - spec.range_)
+        t_w = start + w.astype(jnp.int64) * step
+        in_win = valid & (w >= 0) & (w < n_steps_actual) & (ts <= t_w) & (ts > t_w - range_)
         gid = jnp.where(in_win, series.astype(jnp.int32) * n_steps + w, num_groups)
         count = count + jax.ops.segment_sum(in_win.astype(jnp.int32), gid, num_segments=segs)
         first_ts = jnp.minimum(
@@ -149,8 +216,8 @@ def range_windows(
     lv = jnp.full(num_groups + 1, small, acc_dtype)
     for j in range(k):
         w = w0 + j
-        t_w = spec.start + w.astype(jnp.int64) * spec.step
-        in_win = valid & (w >= 0) & (w < n_steps) & (ts <= t_w) & (ts > t_w - spec.range_)
+        t_w = start + w.astype(jnp.int64) * step
+        in_win = valid & (w >= 0) & (w < n_steps_actual) & (ts <= t_w) & (ts > t_w - range_)
         gid = jnp.where(in_win, series.astype(jnp.int32) * n_steps + w, num_groups)
         safe_gid = jnp.clip(gid, 0, num_groups - 1)
         at_first = in_win & (ts == first_ts[safe_gid])
@@ -188,11 +255,26 @@ def extrapolated_rate(
     is_rate divides by range seconds).  For counters the caller must have
     applied `strip_counter_resets` so last-first already includes resets.
     """
+    return extrapolated_rate_dyn(
+        stats, spec.start, spec.step, spec.range_, spec.num_steps, kind
+    )
+
+
+def extrapolated_rate_dyn(
+    stats: WindowStats,
+    start,
+    step,
+    range_,
+    n_steps: int,
+    kind: str,  # "rate" | "increase" | "delta"
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """`extrapolated_rate` with dynamic grid values (traced scalars OK);
+    `n_steps` is the STATIC [S*W] layout width.  Same arithmetic, so
+    results are bit-identical to the static form on the real windows."""
     num_groups = stats.count.shape[0]
-    n_steps = spec.num_steps
     w = jnp.arange(num_groups, dtype=jnp.int64) % n_steps
-    t_end = spec.start + w * spec.step
-    t_start = t_end - spec.range_
+    t_end = start + w * step
+    t_start = t_end - range_
 
     defined = stats.count >= 2
     sampled_interval = (stats.last_ts - stats.first_ts).astype(jnp.float64)
@@ -218,8 +300,32 @@ def extrapolated_rate(
     safe_si = jnp.where(sampled_interval == 0, 1.0, sampled_interval)
     value = result * (extrapolate_to / safe_si)
     if kind == "rate":
-        value = value / (spec.range_ / 1000.0)
+        value = value / (range_ / 1000.0)
     return value, defined
+
+
+def merge_disjoint_stats(a: WindowStats, b: WindowStats) -> WindowStats:
+    """Union of per-(series, window) stats from sources whose SERIES are
+    disjoint (the partition rule puts each pk in exactly one region): a
+    cell is non-empty in at most one input, so this is pure selection —
+    no cross-source arithmetic — and the merged stats are bit-identical
+    to computing each series on its owning source alone, regardless of
+    merge order or device count."""
+    own_a = a.count > 0
+
+    def pick(x, y):
+        return jnp.where(own_a, x, y)
+
+    return WindowStats(
+        count=pick(a.count, b.count),
+        first_ts=pick(a.first_ts, b.first_ts),
+        last_ts=pick(a.last_ts, b.last_ts),
+        first_val=pick(a.first_val, b.first_val),
+        last_val=pick(a.last_val, b.last_val),
+        sum=pick(a.sum, b.sum),
+        min=pick(a.min, b.min),
+        max=pick(a.max, b.max),
+    )
 
 
 def over_time(stats: WindowStats, func: str) -> tuple[jnp.ndarray, jnp.ndarray]:

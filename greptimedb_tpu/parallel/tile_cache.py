@@ -431,7 +431,11 @@ class _FamilyBuild:
 
 @dataclass
 class _FusedItem:
-    """One queued background family build (ghost execution inputs)."""
+    """One queued background family build (ghost execution inputs).
+    SQL families carry the lowering for the default ghost execution;
+    other engines (the TQL tile path) pass `run` — a self-contained
+    callable that warms + primes their family — and leave the lowering
+    fields None."""
 
     fp: tuple
     rec: _FamilyBuild
@@ -440,6 +444,7 @@ class _FusedItem:
     time_bounds: object
     ctx: TileContext
     manifest: PlaneManifest
+    run: object = None  # callable | None — custom ghost execution
 
 
 class TileCacheManager:
@@ -3626,19 +3631,46 @@ class TileExecutor:
         fused pass, then primes each family's compile + dispatch."""
         import copy
 
-        self.cache.record_manifest(manifest)
         fp = self._plan_fp(lowering, ctx)
         if fp is None:
+            self.cache.record_manifest(manifest)
             return
         ghost = copy.copy(lowering)
         ghost.post_done = frozenset()
+        self._fused_enqueue(_FusedItem(
+            fp=fp, rec=None, lowering=ghost, schema=schema,
+            time_bounds=time_bounds, ctx=ctx, manifest=manifest,
+        ))
+
+    def fused_schedule_custom(self, fp, manifest, ctx: TileContext, schema,
+                              run):
+        """Schedule a NON-SQL family build (the TQL tile path): same
+        manifest recording, same consolidated union pass, same build
+        coalescing/bookkeeping — but the per-family ghost execution is
+        the caller's `run` callable instead of a lowering replay."""
+        self._fused_enqueue(_FusedItem(
+            fp=fp, rec=None, lowering=None, schema=schema,
+            time_bounds=None, ctx=ctx, manifest=manifest, run=run,
+        ))
+
+    def fused_first_touch_fp(self, fp) -> bool:
+        """True when `fp` has never been served, built nor queued."""
+        with self._fused_lock:
+            return (
+                fp not in self._fused_served
+                and fp not in self._fused_done
+                and fp not in self._fused_builds
+            )
+
+    def _fused_enqueue(self, item: _FusedItem):
+        self.cache.record_manifest(item.manifest)
         spawn = False
         with self._fused_lock:
-            self._mark_fused_locked(self._fused_served, fp)
+            self._mark_fused_locked(self._fused_served, item.fp)
             if (
                 self._fused_stop
-                or fp in self._fused_builds
-                or fp in self._fused_done
+                or item.fp in self._fused_builds
+                or item.fp in self._fused_done
             ):
                 return
             if len(self._fused_queue) >= 128:
@@ -3647,11 +3679,8 @@ class TileExecutor:
                 # pathological one must degrade to the legacy ladder, not
                 # an unbounded build queue
                 return
-            rec = self._fused_builds[fp] = _FamilyBuild()
-            self._fused_queue.append(_FusedItem(
-                fp=fp, rec=rec, lowering=ghost, schema=schema,
-                time_bounds=time_bounds, ctx=ctx, manifest=manifest,
-            ))
+            item.rec = self._fused_builds[item.fp] = _FamilyBuild()
+            self._fused_queue.append(item)
             if not self._fused_worker_live:
                 self._fused_worker_live = True
                 self._fused_thread = threading.Thread(
@@ -3720,10 +3749,14 @@ class TileExecutor:
                                     "tile.fused_build", table=tkey,
                                     phase="ghost",
                                 )
-                                self._overload_safe_execute(
-                                    it.lowering, it.schema, it.time_bounds,
-                                    it.ctx, self.cache.admission_config,
-                                )
+                                if it.run is not None:
+                                    it.run()
+                                else:
+                                    self._overload_safe_execute(
+                                        it.lowering, it.schema,
+                                        it.time_bounds, it.ctx,
+                                        self.cache.admission_config,
+                                    )
                     except BaseException as e:  # noqa: BLE001 — waiters
                         # must never inherit a builder-side verdict
                         err = e

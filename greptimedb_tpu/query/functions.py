@@ -158,15 +158,36 @@ def _least(*args):
 
 
 @register("rate")
-def _rate_scalar(x):
-    # greptime scalar `rate(col)`: per-row delta / time — approximated as diff
+def _rate_scalar(x, ts=None):
+    """greptime scalar `rate(val, ts)` (reference
+    common/function/src/scalars/math/rate.rs RateFunction): per-row
+    value delta divided by the elapsed time delta, NULL for the first
+    row and wherever time does not advance.  The deltas are raw numeric
+    differences in the ts argument's own unit, exactly like the
+    reference (no seconds normalization)."""
     v = np.atleast_1d(np.asarray(_np(x), dtype=np.float64))
+    if ts is None:
+        raise PlanError(
+            "rate(value, timestamp) requires the timestamp column: the "
+            "per-row delta must divide by elapsed time"
+        )
+    if isinstance(ts, (pa.Array, pa.ChunkedArray)) and pa.types.is_timestamp(
+        ts.type
+    ):
+        ts = pc.cast(ts, pa.int64())
+    t = np.atleast_1d(np.asarray(_np(ts), dtype=np.float64))
     if len(v) == 0:
         return pa.array([], pa.float64())
-    out = np.empty_like(v)
-    out[0] = np.nan
-    out[1:] = np.diff(v)
-    return pa.array(out)
+    if len(t) != len(v):
+        raise PlanError("rate(value, timestamp): argument lengths differ")
+    out = np.full(len(v), np.nan)
+    if len(v) > 1:
+        dv = np.diff(v)
+        dt = np.diff(t)
+        with np.errstate(all="ignore"):
+            out[1:] = np.where(dt > 0, dv / np.where(dt > 0, dt, 1.0), np.nan)
+    mask = ~np.isnan(out)
+    return pa.array(out.tolist(), pa.float64(), mask=~mask)
 
 
 # ---- string ----------------------------------------------------------------

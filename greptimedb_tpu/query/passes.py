@@ -76,6 +76,15 @@ register(
     "routing",
 )
 register(
+    "tql_tile",
+    "route PromQL range-vector evaluation (rate/increase/*_over_time + "
+    "the by-label sum/avg/min/max/count fold) through the warm device "
+    "tile path: one fused dispatch over cached planes with a compacted "
+    "[series_out, steps] readback; cold families answer from the legacy "
+    "scan and schedule the background fused build",
+    "routing",
+)
+register(
     "agg_strategy",
     "pick the device group-by strategy per query from table stats: dense "
     "mixed-radix states exploiting the (pk, ts) sort, or a hash table "

@@ -85,10 +85,33 @@ class Scalar:
         return np.broadcast_to(v, (n_steps,))
 
 
+_TILE_UNSET = object()
+
+
 class PromqlEngine:
     def __init__(self, db, lookback_ms: int = DEFAULT_LOOKBACK_MS):
         self.db = db
         self.lookback_ms = lookback_ms
+        self._tile = _TILE_UNSET
+
+    def _tile_exec(self):
+        """Warm TQL tile-path executor (query/promql/tile_exec.py), or
+        None when the database has no tile cache / `tql.tile` is off."""
+        if self._tile is _TILE_UNSET:
+            self._tile = None
+            qe = getattr(self.db, "query_engine", None)
+            cfg = getattr(self.db, "config", None)
+            if (
+                qe is not None
+                and getattr(qe, "tile_cache", None) is not None
+                and getattr(qe, "_tile_executor", None) is not None
+                and getattr(cfg, "tql", None) is not None
+                and cfg.tql.tile
+            ):
+                from .tile_exec import TqlTileExecutor
+
+                self._tile = TqlTileExecutor(self.db)
+        return self._tile
 
     # ---- public API (mirrors the HTTP /api/v1 surface) --------------------
     def query_range(self, promql: str, start_ms: int, end_ms: int, step_ms: int) -> pa.Table:
@@ -260,6 +283,23 @@ class PromqlEngine:
         return self._broadcast_fixed(fixed, start, end, step)
 
     def _eval_range_func(self, func: str, sel: VectorSelector, range_ms: int, start, end, step):
+        # warm TQL hot path first (the `tql_tile` pass): one fused device
+        # dispatch over cached tile planes; any miss (cold family,
+        # ineligible shape, tile failure) falls through to the legacy
+        # scan-and-upload evaluation below, bit-for-bit tql.tile=false
+        tile = self._tile_exec()
+        if tile is not None:
+            at_ms = self._resolve_at(sel.at_spec, start, end)
+            s0, e0, st0 = (
+                (start, end, step) if at_ms is None
+                else (at_ms, at_ms, max(step, 1))
+            )
+            out = tile.try_range_eval(func, sel, range_ms, s0, e0, st0)
+            if out is not None:
+                return (
+                    out if at_ms is None
+                    else self._broadcast_fixed(out, start, end, step)
+                )
         return self._with_at(
             sel.at_spec, start, end, step,
             lambda s, e, st: self._range_from_samples(
@@ -435,6 +475,9 @@ class PromqlEngine:
         return Matrix(names, out_values, m.values, m.steps)
 
     def _eval_aggregate(self, node: AggregateExpr, start, end, step):
+        fused = self._try_fused_aggregate(node, start, end, step)
+        if fused is not None:
+            return fused
         m = self._eval(node.expr, start, end, step)
         if isinstance(m, Scalar):
             return m
@@ -496,6 +539,59 @@ class PromqlEngine:
         else:
             raise UnsupportedError(f"promql: aggregation {node.op} not supported")
         return Matrix(keep, list(groups.keys()), out, m.steps)
+
+    def _try_fused_aggregate(self, node: AggregateExpr, start, end, step):
+        """sum/avg/min/max/count by(...) over a range function on a plain
+        selector: the whole expression — window kernels AND the by-label
+        fold — compiles into the ONE tile dispatch (the `tql_tile` pass),
+        so the readback ships [groups, steps] instead of the per-series
+        matrix.  Returns None whenever the fused shape does not apply;
+        the caller then evaluates per-series and folds host-side, which
+        the tile path still accelerates through `_eval_range_func`."""
+        if node.op not in ("sum", "avg", "mean", "min", "max", "count"):
+            return None
+        if node.param is not None:
+            return None
+        tile = self._tile_exec()
+        if tile is None:
+            return None
+        expr = node.expr
+        while isinstance(expr, ParenExpr):
+            expr = expr.expr
+        sel = func = range_ms = None
+        if isinstance(expr, FunctionCall):
+            f = expr.func
+            if f in _RATE_FUNCS or f in _OVER_TIME or f in ("irate", "idelta"):
+                rargs = [
+                    a for a in expr.args
+                    if isinstance(a, (MatrixSelector, SubqueryExpr))
+                ]
+                if (
+                    len(expr.args) == 1
+                    and len(rargs) == 1
+                    and isinstance(rargs[0], MatrixSelector)
+                ):
+                    sel = rargs[0].vector
+                    func = {"irate": "rate", "idelta": "delta"}.get(f, f)
+                    range_ms = rargs[0].range_ms
+        elif isinstance(expr, VectorSelector):
+            # instant vector = last_over_time over the lookback window
+            sel, func, range_ms = expr, "last_over_time", self.lookback_ms
+        if sel is None:
+            return None
+        agg = (node.op, node.by, node.without)
+        at_ms = self._resolve_at(sel.at_spec, start, end)
+        if at_ms is None:
+            return tile.try_range_eval(
+                func, sel, range_ms, start, end, step, agg=agg
+            )
+        fixed = tile.try_range_eval(
+            func, sel, range_ms, at_ms, at_ms, max(step, 1), agg=agg
+        )
+        return (
+            None if fixed is None
+            else self._broadcast_fixed(fixed, start, end, step)
+        )
 
     def _eval_binary(self, node: BinaryExpr, start, end, step):
         l = self._eval(node.left, start, end, step)

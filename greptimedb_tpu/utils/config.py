@@ -415,6 +415,34 @@ class TileConfig:
 
 
 @dataclasses.dataclass
+class TqlConfig:
+    """Warm TQL hot path (query/promql/tile_exec.py, the `tql_tile`
+    optimizer pass): PromQL range-vector evaluation — rate/increase/
+    delta, *_over_time, and the by-label sum/avg/min/max/count fold —
+    runs as ONE fused dispatch over the device tile cache, sharing the
+    SQL path's plane manifests, fused background builds, delta-extend
+    and build coalescing.  Programs are cached per padded (series,
+    steps, windows-per-sample) shape bucket with the grid and matcher
+    literals as dynamic inputs, so a sliding dashboard re-hits the
+    compile cache with zero host->device plane traffic.
+
+    `tile = False` restores the legacy upload-per-query evaluation
+    bit-for-bit; ANY tile-path failure (fault point `tql.tile`) degrades
+    to that path too (`greptime_tql_tile_degraded_total`)."""
+
+    tile: bool = True
+    # Upper bound on padded series x padded steps cells per evaluation
+    # ([S, W] f64 window-stat planes live on device); beyond it the
+    # query stays on the legacy path.
+    max_cells: int = 1 << 22
+    # Per-series results larger than this fetch in TWO round-trips:
+    # presence first, then a device-side gather of only the present
+    # rows — the compacted [series_out, steps] readback.  Below it one
+    # batched round-trip wins (RTT-bound, not byte-bound).
+    compact_readback_kb: int = 1024
+
+
+@dataclasses.dataclass
 class IndexConfig:
     """Segmented term index (greptimedb_tpu/index/): new SSTs write their
     inverted/fulltext term indexes as fence-keyed term segments with
@@ -551,6 +579,7 @@ class Config:
     admission: AdmissionConfig = dataclasses.field(default_factory=AdmissionConfig)
     flow: FlowConfig = dataclasses.field(default_factory=FlowConfig)
     index: IndexConfig = dataclasses.field(default_factory=IndexConfig)
+    tql: TqlConfig = dataclasses.field(default_factory=TqlConfig)
     trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
 
     def __post_init__(self):
@@ -683,6 +712,26 @@ class Config:
             raise ConfigError(
                 "tile.prewarm_debounce_s must be >= 0 seconds (how long after "
                 f"the last flush a prewarm build starts); got {t.prewarm_debounce_s!r}"
+            )
+        tq = self.tql
+        if not isinstance(tq.tile, bool):
+            raise ConfigError(
+                "tql.tile must be a boolean (warm TQL device tile path; "
+                f"false = legacy upload-per-query evaluation); got {tq.tile!r}"
+            )
+        if not isinstance(tq.max_cells, int) or isinstance(tq.max_cells, bool) \
+                or tq.max_cells < 1:
+            raise ConfigError(
+                "tql.max_cells must be a positive integer bound on padded "
+                f"series x steps cells per evaluation; got {tq.max_cells!r}"
+            )
+        if not isinstance(tq.compact_readback_kb, int) \
+                or isinstance(tq.compact_readback_kb, bool) \
+                or tq.compact_readback_kb < 1:
+            raise ConfigError(
+                "tql.compact_readback_kb must be a positive size in KiB "
+                "(per-series results past it fetch via the two-phase "
+                f"compacted readback); got {tq.compact_readback_kb!r}"
             )
         if q.hedge_delay_ms < 0:
             raise ConfigError(

@@ -140,6 +140,7 @@ POINTS = frozenset(
         "trace.self_write",
         "mesh.collective",
         "tile.fused_build",
+        "tql.tile",
     }
 )
 

@@ -333,6 +333,21 @@ TPU_DEVICE_FETCHES = REGISTRY.counter(
     "greptime_tpu_device_fetches_total",
     "Device->host result fetches (one per lowered query attempt)",
 )
+TQL_TILE_DISPATCHES = REGISTRY.counter(
+    "greptime_tql_tile_dispatch_total",
+    "TQL range-vector evaluations served warm from device tiles in one "
+    "fused dispatch (the tql_tile pass)",
+)
+TQL_TILE_DEGRADED = REGISTRY.counter(
+    "greptime_tql_tile_degraded_total",
+    "TQL tile-path attempts that failed (fault tql.tile / device error) "
+    "and degraded to the legacy upload-per-query path",
+)
+TQL_TILE_COLD_SERVES = REGISTRY.counter(
+    "greptime_tql_tile_cold_serves_total",
+    "Cold TQL queries answered from the legacy scan while their family's "
+    "background plane build was scheduled",
+)
 TPU_DEVICE_FINALIZE = REGISTRY.counter(
     "greptime_tpu_device_finalize_total",
     "Queries whose Sort/Limit/HAVING/compaction ran on device (O(rows_out) readback)",

@@ -1,0 +1,26 @@
+-- TQL through the warm tile path (tql_tile pass): byte-identical under
+-- {cpu, tpu} x {tql.tile on, off} x {cold, warm} — no trailing DROP and
+-- idempotent statements, so the knob-matrix test replays the whole case
+-- on a WARM database (tests/test_tql_tile_golden.py)
+CREATE TABLE IF NOT EXISTS ttile (host STRING, greptime_value DOUBLE, ts TIMESTAMP(3) TIME INDEX, PRIMARY KEY (host));
+
+INSERT INTO ttile VALUES
+  ('a', 10, 0), ('a', 14, 15000), ('a', 20, 30000), ('a', 2, 45000), ('a', 8, 60000), ('a', 11, 75000), ('a', 16, 90000),
+  ('b', 100, 0), ('b', 108, 15000), ('b', 116, 30000), ('b', 124, 45000), ('b', 132, 60000), ('b', 140, 75000), ('b', 148, 90000),
+  ('c', 1, 0), ('c', 3, 30000), ('c', 6, 60000), ('c', 10, 90000);
+
+ADMIN flush_table('ttile');
+
+TQL EVAL (30, 90, '30s') rate(ttile[1m]);
+
+TQL EVAL (30, 90, '30s') increase(ttile[1m]);
+
+TQL EVAL (30, 90, '30s') avg_over_time(ttile[1m]);
+
+TQL EVAL (30, 90, '30s') sum by (host) (rate(ttile[1m]));
+
+TQL EVAL (30, 90, '30s') max(ttile);
+
+TQL EVAL (30, 90, '30s') count_over_time(ttile{host=~'[ab]'}[1m]);
+
+TQL EVAL (30, 90, '30s') last_over_time(ttile{host!='b'}[1m] offset 30s);

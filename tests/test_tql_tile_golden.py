@@ -1,0 +1,75 @@
+"""Golden byte-identity for the TQL tile path: the tql_tile.sql case
+renders BYTE-identically to its committed golden under every combination
+of
+
+    backend   cpu | tpu
+    tql.tile  on  | off
+    warmth    cold (fresh db) | warm (same db, case replayed after the
+              background fused build drained — the second pass re-flushes
+              and answers from device planes)
+
+— i.e. routing TQL through the device tile cache never changes a result,
+only how it is computed.  The case file is idempotent (CREATE IF NOT
+EXISTS, no trailing DROP) precisely so the warm replay is well-defined.
+"""
+
+import os
+import tempfile
+import time
+
+import pytest
+
+from tests.sqlness_runner import CASES_DIR, run_case
+
+CASE = os.path.join(CASES_DIR, "tql_tile.sql")
+
+
+def _db(backend: str, tile: bool):
+    from greptimedb_tpu.database import Database
+    from greptimedb_tpu.utils.config import Config
+
+    cfg = Config()
+    cfg.storage.data_home = tempfile.mkdtemp()
+    cfg.query.backend = backend
+    cfg.tql.tile = tile
+    return Database(config=cfg)
+
+
+def _drain_fused(db, timeout=60.0):
+    te = db.query_engine._tile_executor
+    if te is None:
+        return
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with te._fused_lock:
+            if not te._fused_builds and not te._fused_queue:
+                return
+        time.sleep(0.05)
+    raise AssertionError("fused builder did not drain")
+
+
+@pytest.mark.parametrize(
+    "backend,tile",
+    [("cpu", True), ("cpu", False), ("tpu", True), ("tpu", False)],
+)
+def test_tql_tile_golden_matrix(backend, tile):
+    with open(CASE[:-4] + ".result") as f:
+        want = f.read()
+    db = _db(backend, tile)
+    try:
+        cold = run_case(CASE, db)
+        assert cold == want, (
+            f"COLD diverged under backend={backend} tql.tile={tile}"
+        )
+        _drain_fused(db)
+        warm = run_case(CASE, db)
+        assert warm == want, (
+            f"WARM diverged under backend={backend} tql.tile={tile}"
+        )
+        if tile and backend == "tpu":
+            # the warm replay genuinely exercised the tile dispatch
+            from greptimedb_tpu.utils import metrics as m
+
+            assert m.TQL_TILE_DISPATCHES.get() > 0
+    finally:
+        db.close()
