@@ -178,6 +178,14 @@ class Database:
         # Lifecycle knobs (tile.incremental delta maintenance,
         # tile.pipelined_build) reach the cache through config.tile, read
         # at decision time so tests and operators can flip them live.
+        # Device flight recorder: per-dispatch introspection ring behind
+        # information_schema.device_dispatches / EXPLAIN ANALYZE's
+        # device-stage split / /debug/tile.  The ring is process-wide
+        # (like the span exporter); the most recently opened Database's
+        # knobs govern it.
+        from .utils import flight_recorder as _flight_recorder
+
+        _flight_recorder.RECORDER.configure(getattr(self.config, "recorder", None))
         if self.query_engine.tile_cache is not None:
             self.query_engine.tile_cache.tile_config = self.config.tile
             # overload-survival knobs (dispatch coalescing, HBM feedback)
@@ -1014,6 +1022,17 @@ class Database:
         raise UnsupportedError(f"unsupported SHOW {stmt.what}")
 
     def _describe(self, stmt: DescribeStmt):
+        from .models import information_schema as info
+
+        if info.is_information_schema(self.current_database):
+            # virtual system tables: synthesize the meta shim
+            # render_describe needs (reference DESC on information_schema
+            # works the same way) — schemas here are a stable contract
+            # documented in README "Runtime introspection"
+            import types
+
+            schema = info.schema_of(self, stmt.table)
+            return render_describe(types.SimpleNamespace(schema=schema))
         meta = self.catalog.table(stmt.table, self.current_database)
         return render_describe(meta)
 

@@ -31,7 +31,12 @@ def build(db, table: str) -> pa.Table:
 
 
 def schema_of(db, table: str) -> Schema:
-    t = build(db, table)
+    # runtime-introspection tables can be LARGE (the dispatch ring, the
+    # per-plane cache walk under the cache lock): schema questions
+    # (DESCRIBE, planning) build their EMPTY twin instead of
+    # materializing state that is discarded after reading .schema
+    empty = _EMPTY_TABLES.get(table.lower())
+    t = empty() if empty is not None else build(db, table)
     return Schema(
         columns=[
             ColumnSchema(f.name, ConcreteDataType.from_arrow(f.type), SemanticType.FIELD)
@@ -224,6 +229,176 @@ def _process_list(db) -> pa.Table:
     )
 
 
+def _table_of_region(db) -> dict:
+    """region_id -> (database, table_name) reverse map."""
+    out = {}
+    for database in db.catalog.databases():
+        for meta in db.catalog.tables(database):
+            for rid in meta.region_ids:
+                out[rid] = (database, meta.name)
+    return out
+
+
+def _tile_cache(db):
+    qe = getattr(db, "query_engine", None)
+    return getattr(qe, "tile_cache", None)
+
+
+def _tile_cache_entries(db) -> pa.Table:
+    """information_schema.tile_cache_entries: one row per resident device
+    plane of each region's super-tile (the runtime-introspection twin of
+    region_statistics for the HBM tile cache).  Schema is a stable
+    contract (README "Runtime introspection"); the entry walk is a
+    single under-lock snapshot (TileCacheManager.introspect_entries)
+    shared with /debug/tile."""
+    rows = _tce_rows()
+    cache = _tile_cache(db)
+    if cache is not None:
+        region_names = _table_of_region(db)
+        for e in cache.introspect_entries():
+            names = region_names.get(e["region_id"], ("", ""))
+            for kind, plane, dev_b, host_b, chunks in e["planes"]:
+                rows["table_schema"].append(names[0])
+                rows["table_name"].append(names[1])
+                rows["region_id"].append(e["region_id"])
+                rows["plane"].append(plane)
+                rows["kind"].append(kind)
+                rows["state"].append(e["state"])
+                rows["device_bytes"].append(dev_b)
+                rows["host_bytes"].append(host_b)
+                rows["rows"].append(e["rows"])
+                rows["padded_rows"].append(e["padded_rows"])
+                rows["chunks"].append(chunks)
+                rows["delta_extends"].append(e["delta_extends"])
+                rows["last_hit_ms"].append(e["last_hit_ms"])
+    return _tce_table(rows)
+
+
+def _tce_rows() -> dict:
+    return {
+        "table_schema": [], "table_name": [], "region_id": [], "plane": [],
+        "kind": [], "state": [], "device_bytes": [], "host_bytes": [],
+        "rows": [], "padded_rows": [], "chunks": [], "delta_extends": [],
+        "last_hit_ms": [],
+    }
+
+
+def _tce_table(rows: dict) -> pa.Table:
+    return pa.table({
+        "table_schema": pa.array(rows["table_schema"], pa.string()),
+        "table_name": pa.array(rows["table_name"], pa.string()),
+        "region_id": pa.array(rows["region_id"], pa.int64()),
+        "plane": pa.array(rows["plane"], pa.string()),
+        "kind": pa.array(rows["kind"], pa.string()),
+        "state": pa.array(rows["state"], pa.string()),
+        "device_bytes": pa.array(rows["device_bytes"], pa.int64()),
+        "host_bytes": pa.array(rows["host_bytes"], pa.int64()),
+        "rows": pa.array(rows["rows"], pa.int64()),
+        "padded_rows": pa.array(rows["padded_rows"], pa.int64()),
+        "chunks": pa.array(rows["chunks"], pa.int64()),
+        "delta_extends": pa.array(rows["delta_extends"], pa.int64()),
+        "last_hit_ms": pa.array(rows["last_hit_ms"], pa.int64()),
+    })
+
+
+def _device_dispatches(db) -> pa.Table:
+    """information_schema.device_dispatches: the flight-recorder ring —
+    one row per tile dispatch (SQL tile / TQL tile / mesh table path),
+    newest last.  Ghost rows are the background fused builder's priming
+    dispatches; per-query views filter `ghost = 'false'`."""
+    from ..utils.flight_recorder import RECORDER
+
+    return _dispatch_table(RECORDER.snapshot())
+
+
+def _dispatch_table(recs: list) -> pa.Table:
+    import json as _json
+
+    from ..utils.flight_recorder import STAGES
+
+    cols: dict[str, list] = {
+        "seq": [], "ts": [], "table_name": [], "trace_id": [], "plan_fp": [],
+        "strategy": [], "build_mode": [], "mesh_devices": [],
+        "compile_cache": [], "ghost": [],
+    }
+    stage_cols = {f"{s}_ms": [] for s in STAGES}
+    tail: dict[str, list] = {
+        "bytes_up": [], "bytes_down": [], "hbm_in_use": [], "hbm_budget": [],
+        "flags": [], "regions": [],
+    }
+    for r in recs:
+        cols["seq"].append(r.seq)
+        cols["ts"].append(r.ts_ms)
+        cols["table_name"].append(r.table)
+        cols["trace_id"].append(r.trace_id)
+        cols["plan_fp"].append(r.plan_fp)
+        cols["strategy"].append(r.strategy)
+        cols["build_mode"].append(r.build_mode)
+        cols["mesh_devices"].append(r.mesh_devices)
+        cols["compile_cache"].append(r.compile_cache)
+        cols["ghost"].append("true" if r.ghost else "false")
+        for s in STAGES:
+            stage_cols[f"{s}_ms"].append(round(r.stage_ms(s), 3))
+        tail["bytes_up"].append(r.bytes_up)
+        tail["bytes_down"].append(r.bytes_down)
+        tail["hbm_in_use"].append(r.hbm_in_use)
+        tail["hbm_budget"].append(r.hbm_budget)
+        tail["flags"].append(",".join(r.flags))
+        tail["regions"].append(_json.dumps([list(x) for x in r.regions]))
+    return pa.table({
+        "seq": pa.array(cols["seq"], pa.int64()),
+        "ts": pa.array(cols["ts"], pa.timestamp("ms")),
+        "table_name": pa.array(cols["table_name"], pa.string()),
+        "trace_id": pa.array(cols["trace_id"], pa.string()),
+        "plan_fp": pa.array(cols["plan_fp"], pa.string()),
+        "strategy": pa.array(cols["strategy"], pa.string()),
+        "build_mode": pa.array(cols["build_mode"], pa.string()),
+        "mesh_devices": pa.array(cols["mesh_devices"], pa.int64()),
+        "compile_cache": pa.array(cols["compile_cache"], pa.string()),
+        "ghost": pa.array(cols["ghost"], pa.string()),
+        **{k: pa.array(v, pa.float64()) for k, v in stage_cols.items()},
+        "bytes_up": pa.array(tail["bytes_up"], pa.int64()),
+        "bytes_down": pa.array(tail["bytes_down"], pa.int64()),
+        "hbm_in_use": pa.array(tail["hbm_in_use"], pa.int64()),
+        "hbm_budget": pa.array(tail["hbm_budget"], pa.int64()),
+        "flags": pa.array(tail["flags"], pa.string()),
+        "regions": pa.array(tail["regions"], pa.string()),
+    })
+
+
+def _device_memory(db) -> pa.Table:
+    """information_schema.device_memory: per-device HBM accounting — the
+    runtime's own numbers (memory_stats) next to the tile cache's budget
+    loop (budget, in-use, headroom, degrade rounds); one shared
+    collector (TileCacheManager.device_memory_rows) with /debug/tile."""
+    cache = _tile_cache(db)
+    return _device_memory_table(
+        cache.device_memory_rows() if cache is not None else []
+    )
+
+
+def _device_memory_table(mem_rows: list) -> pa.Table:
+    rows = {
+        "device": [], "device_kind": [], "bytes_in_use": [], "bytes_limit": [],
+        "tile_budget": [], "tile_in_use": [], "tile_headroom": [],
+        "chunk_rows": [], "degrade_rounds": [],
+    }
+    for r in mem_rows:
+        for k in rows:
+            rows[k].append(r[k])
+    return pa.table({
+        "device": pa.array(rows["device"], pa.int64()),
+        "device_kind": pa.array(rows["device_kind"], pa.string()),
+        "bytes_in_use": pa.array(rows["bytes_in_use"], pa.int64()),
+        "bytes_limit": pa.array(rows["bytes_limit"], pa.int64()),
+        "tile_budget": pa.array(rows["tile_budget"], pa.int64()),
+        "tile_in_use": pa.array(rows["tile_in_use"], pa.int64()),
+        "tile_headroom": pa.array(rows["tile_headroom"], pa.int64()),
+        "chunk_rows": pa.array(rows["chunk_rows"], pa.int64()),
+        "degrade_rounds": pa.array(rows["degrade_rounds"], pa.int64()),
+    })
+
+
 _TABLES = {
     "tables": _tables,
     "columns": _columns,
@@ -236,6 +411,20 @@ _TABLES = {
     "partitions": _partitions,
     "flows": _flows,
     "views": _views,
+    "tile_cache_entries": _tile_cache_entries,
+    "device_dispatches": _device_dispatches,
+    "device_memory": _device_memory,
+}
+
+
+# Empty twins of the runtime-introspection tables: schema questions
+# (DESCRIBE, planning) read these instead of materializing the dispatch
+# ring / walking the tile cache under its lock.  Must construct with the
+# exact column set + types of the live builders (the goldens pin both).
+_EMPTY_TABLES = {
+    "tile_cache_entries": lambda: _tce_table(_tce_rows()),
+    "device_dispatches": lambda: _dispatch_table([]),
+    "device_memory": lambda: _device_memory_table([]),
 }
 
 

@@ -723,8 +723,23 @@ def distributed_groupby(
     )
 
     # 6. Compile + run + finalize.
+    import time as _time
+
+    from ..utils import flight_recorder
+
+    t0 = _time.perf_counter()
     step = _compiled_step(mesh, plan)
+    flight_recorder.stage_add(
+        "compile", (_time.perf_counter() - t0) * 1000.0
+    )
+    t0 = _time.perf_counter()
     states = step(cols_stacked, valid_stacked, nulls_stacked)
+    flight_recorder.stage_add(
+        "dispatch", (_time.perf_counter() - t0) * 1000.0
+    )
+    flight_recorder.note(
+        strategy="mesh_table", mesh_devices=int(mesh.devices.size)
+    )
 
     outputs: dict[str, np.ndarray] = {}
     per_col_aggs: dict[str, set] = {}
@@ -740,15 +755,21 @@ def distributed_groupby(
     # np.asarray conversions below each paid a link round-trip on the
     # remote harness), metered as transfer time so readback stays
     # attributable on the mesh path too
-    import time as _time
-
     from ..utils import metrics as _metrics
 
     t0 = _time.perf_counter()
     presence_np, finals = jax.device_get((presence, finals))
-    _metrics.TPU_READBACK_TRANSFER_MS.observe(
-        (_time.perf_counter() - t0) * 1000.0
-    )
+    fetch_ms = (_time.perf_counter() - t0) * 1000.0
+    _metrics.TPU_READBACK_TRANSFER_MS.observe(fetch_ms)
+    flight_recorder.stage_add("readback_transfer", fetch_ms)
+    flight_recorder.add_bytes(down=int(
+        np.asarray(presence_np).nbytes
+        + sum(
+            np.asarray(a).nbytes
+            for d in finals.values()
+            for a in d.values()
+        )
+    ))
     presence_np = np.asarray(presence_np)
     non_empty = presence_np > 0
     for func, col in norm_specs:

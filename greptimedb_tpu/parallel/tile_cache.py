@@ -86,7 +86,7 @@ from ..storage.dictionary import TableDictionary
 from ..storage.region import OP_COL, Region
 from ..storage.sst import FileMeta
 from ..query import analyze, passes
-from ..utils import metrics, tracing
+from ..utils import flight_recorder, metrics, tracing
 from ..utils.deadline import check_deadline, current_deadline
 from ..utils.errors import QueryTimeoutError
 from ..utils.fault_injection import fire as _fault_fire
@@ -394,6 +394,11 @@ class _SuperTiles:
     persisted_epochs: dict[str, int] = field(default_factory=dict)
     nbytes: int = 0
     host_nbytes: int = 0  # sorted_host/order/offsets bytes (host budget)
+    # introspection (information_schema.tile_cache_entries): in-place
+    # delta merges absorbed since the entry was built, and the wall-clock
+    # stamp of the last query that touched it
+    delta_extends: int = 0
+    last_hit: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -508,6 +513,9 @@ class TileCacheManager:
         # full builds (prewarm-on-flush racing a live query, two cold
         # queries) coalesce onto the leader's build (build_gate)
         self._build_events: dict[tuple, threading.Event] = {}
+        # halve-chunk degrade rounds survived (information_schema
+        # device_memory / the flight recorder's HBM snapshot)
+        self.degrade_rounds = 0
 
     _MANIFESTS_PER_TABLE = 64
 
@@ -763,8 +771,92 @@ class TileCacheManager:
                 self._used -= dropped.nbytes
                 self._host_used -= dropped.host_nbytes
                 self._region_versions.pop(rid, None)
+            self.degrade_rounds += 1
         metrics.HBM_CHUNK_ROWS.set(self.chunk_rows)
         return halved
+
+    # ---- introspection snapshots (information_schema + /debug/tile) -------
+    def introspect_entries(self) -> list[dict]:
+        """Point-in-time snapshot of every resident super-tile entry for
+        the introspection surfaces (information_schema.tile_cache_entries
+        and /debug/tile).  The WHOLE walk — including each entry's plane
+        dicts — runs under the cache lock: a background fused build, limb
+        quantize or eviction mutates those dicts concurrently, and
+        iterating them unlocked is a 'dictionary changed size during
+        iteration' crash on exactly the query an operator runs while the
+        system is busy.  One shared impl so the two surfaces cannot
+        diverge."""
+        out: list[dict] = []
+        with self._lock:
+            for rid, e in self._super.items():
+                state = "cold_served" if e.cold_served else (
+                    "persisted" if e.persisted_cols and not e.cols else "live"
+                )
+                planes: list[tuple] = []  # (kind, plane, dev_b, host_b, chunks)
+                for name, chunks in sorted(e.cols.items()):
+                    planes.append(("column", name,
+                                   sum(int(c.nbytes) for c in chunks), 0,
+                                   len(chunks)))
+                for name, chunks in sorted(e.nulls.items()):
+                    planes.append(("null", name,
+                                   sum(int(c.nbytes) for c in chunks), 0,
+                                   len(chunks)))
+                for name, chunks in sorted(e.tm_cols.items()):
+                    planes.append(("time_major", name,
+                                   sum(int(c.nbytes) for c in chunks), 0,
+                                   len(chunks)))
+                for name, chunks in sorted(e.limb_cols.items()):
+                    planes.append(("limb", name,
+                                   sum(int(l.nbytes) + int(s.nbytes)
+                                       for l, s in chunks), 0, len(chunks)))
+                for key, wt in sorted(e.window_tiles.items(), key=repr):
+                    planes.append(("window", f"[{key[0]},{key[1]})",
+                                   int(wt.get("nbytes", 0)), 0, 1))
+                for name, arr in sorted(e.persisted_cols.items()):
+                    planes.append(("persisted", name, 0, int(arr.nbytes), 1))
+                for name, arr in sorted(e.sorted_host.items()):
+                    planes.append(("sorted_host", name, 0, int(arr.nbytes), 1))
+                out.append({
+                    "region_id": rid,
+                    "state": state,
+                    "rows": e.num_rows,
+                    "padded_rows": e.pad,
+                    "device_bytes": int(e.nbytes),
+                    "host_bytes": int(e.host_nbytes),
+                    "columns": sorted(e.cols),
+                    "time_major": sorted(e.tm_cols),
+                    "limbs": sorted(e.limb_cols),
+                    "window_tiles": len(e.window_tiles),
+                    "persisted": sorted(e.persisted_cols),
+                    "delta_extends": e.delta_extends,
+                    "cold_served": e.cold_served,
+                    "last_hit_ms": int(e.last_hit * 1000),
+                    "planes": planes,
+                })
+        return out
+
+    def device_memory_rows(self) -> list[dict]:
+        """Per-device HBM accounting — the runtime's own memory_stats
+        beside the tile cache's budget loop; shared by
+        information_schema.device_memory and /debug/tile."""
+        rows: list[dict] = []
+        for i, dev in enumerate(self.devices):
+            try:
+                stats = dev.memory_stats() or {}
+            except Exception:  # noqa: BLE001 — CPU devices have no stats
+                stats = {}
+            rows.append({
+                "device": i,
+                "device_kind": str(dev),
+                "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                "bytes_limit": int(stats.get("bytes_limit", 0)),
+                "tile_budget": int(self.budget),
+                "tile_in_use": int(self._used),
+                "tile_headroom": int(self.budget - self._used),
+                "chunk_rows": int(self.chunk_rows),
+                "degrade_rounds": int(self.degrade_rounds),
+            })
+        return rows
 
     # ---- persisted consolidated encodes ------------------------------------
     def _fileset_dir(self, region_id: int, file_ids: tuple[str, ...]) -> str | None:
@@ -1021,13 +1113,22 @@ class TileCacheManager:
 
     def _up_chunks(self, buf: np.ndarray, bounds, region_id: int | None = None) -> list:
         """Upload a consolidated host buffer chunk-wise, each chunk onto
-        its round-robin device (single-device: plain uploads)."""
+        its round-robin device (single-device: plain uploads).  The one
+        host->device chokepoint for plane traffic, so the flight
+        recorder meters its wall time + bytes as the `upload` stage."""
+        t0 = time.perf_counter()
         if len(self.devices) <= 1:
-            return [jnp.asarray(buf[a:b]) for a, b in bounds]
-        return [
-            jax.device_put(buf[a:b], self.chunk_device(i, region_id))
-            for i, (a, b) in enumerate(bounds)
-        ]
+            out = [jnp.asarray(buf[a:b]) for a, b in bounds]
+        else:
+            out = [
+                jax.device_put(buf[a:b], self.chunk_device(i, region_id))
+                for i, (a, b) in enumerate(bounds)
+            ]
+        flight_recorder.stage_add(
+            "upload", (time.perf_counter() - t0) * 1000.0
+        )
+        flight_recorder.add_bytes(up=int(buf.nbytes))
+        return out
 
     def _evict_locked(self, pinned_regions: set[int]):
         # Re-derivable planes strip FIRST, and INCREMENTALLY — per limb
@@ -1174,6 +1275,8 @@ class TileCacheManager:
         with tracing.span(
             "tile.build", region=region.region_id, files=len(metas)
         ) as s:
+            t0 = time.perf_counter()
+            up0 = flight_recorder.stage_total("upload")
             out = self._super_tiles_impl(
                 region, dictionary, metas, tag_cols, ts_col, value_cols,
                 pinned_regions, pk_cols, device_upload, s,
@@ -1182,11 +1285,24 @@ class TileCacheManager:
             if entry is not None:
                 s.attributes.setdefault("mode", "cold")
                 s.attributes["rows"] = entry.num_rows
+                entry.last_hit = time.time()
             else:
                 s.attributes.setdefault("mode", "none")
             if _in_fused_build() and s.attributes["mode"] == "cold":
                 # a real cold build performed by the fused family builder
                 s.attributes["mode"] = "fused"
+            if entry is not None:
+                # flight recorder: this region's build leg.  Upload ms
+                # accumulated INSIDE the call (the _up_chunks chokepoint)
+                # is metered as its own stage, so build = host-side
+                # consolidation only.
+                build_ms = (time.perf_counter() - t0) * 1000.0
+                build_ms -= flight_recorder.stage_total("upload") - up0
+                flight_recorder.stage_add("build", max(build_ms, 0.0))
+                flight_recorder.region_build(
+                    region.region_id, s.attributes["mode"],
+                    max(build_ms, 0.0), entry.num_rows,
+                )
             return out
 
     def _super_tiles_impl(
@@ -1848,6 +1964,7 @@ class TileCacheManager:
             self._used += entry.nbytes - old_dev
             self._host_used += entry.host_nbytes - old_host
             self._evict_locked(pinned_regions | {rid})
+        entry.delta_extends += 1
         metrics.TILE_DELTA_MERGES.inc()
         metrics.TILE_DELTA_ROWS.inc(delta_rows)
         passes.note(
@@ -2673,6 +2790,7 @@ def _tile_program_cached(plan, nullable_cols, spec):
     queries — program BUILD is cheap closure assembly (XLA tracing happens
     at first dispatch), so serializing it costs nothing."""
     with _program_cache_lock, tracing.span("tile.compile") as s:
+        t0 = time.perf_counter()
         before = _tile_program.cache_info().misses
         out = _tile_program(plan, nullable_cols, spec)
         if _tile_program.cache_info().misses > before:
@@ -2681,6 +2799,10 @@ def _tile_program_cached(plan, nullable_cols, spec):
         else:
             metrics.TPU_COMPILE_CACHE_HITS.inc()
             s.attributes["cache"] = "hit"
+        flight_recorder.stage_add(
+            "compile", (time.perf_counter() - t0) * 1000.0
+        )
+        flight_recorder.note(compile_cache=s.attributes["cache"])
     return out
 
 
@@ -3810,6 +3932,9 @@ class TileExecutor:
             metrics.HBM_EXHAUSTED_TOTAL.inc()
             halved = self.cache.degrade_chunks(int(adm.min_chunk_rows))
             self.cache.emergency_release(set())
+            # the retried _try_execute opens a fresh recorder scope; arm
+            # its degraded flag now (this thread re-enters immediately)
+            flight_recorder.flag_next("degraded")
             # degrade rounds are events on the statement's trace, so an
             # OOM-surviving query shows every halve-and-retry rung
             tracing.add_event(
@@ -3935,9 +4060,42 @@ class TileExecutor:
             metrics.DISPATCH_COALESCED_TOTAL.inc()
             tracing.add_event("dispatch.coalesced", table=ctx.table_key)
             lowering.post_done = rec.post_done
+            # the waiter ran no dispatch of its own: record the adoption
+            # so per-query views show WHERE the time went (waiting on the
+            # leader's in-flight dispatch, not a duplicate one)
+            if flight_recorder.RECORDER.enabled:
+                flight_recorder.RECORDER.emit(flight_recorder.DispatchRecord(
+                    ts_ms=int(time.time() * 1000), table=ctx.table_key,
+                    trace_id=tracing.current_trace_id() or "",
+                    plan_fp=self._recorder_fp(lowering, ctx),
+                    strategy="coalesced", flags=("coalesced",),
+                ))
         return rec.result
 
+    def _recorder_fp(self, lowering, ctx: TileContext) -> str:
+        """Short stable plan-family fingerprint for the flight recorder
+        (12 hex chars of the literal-insensitive `_plan_fp`)."""
+        fp = self._plan_fp(lowering, ctx)
+        if fp is None:
+            return ""
+        import hashlib
+
+        return hashlib.sha1(repr(fp).encode()).hexdigest()[:12]
+
     def _try_execute(self, lowering, schema, time_bounds, ctx: TileContext):
+        if not flight_recorder.RECORDER.enabled:
+            # recorder off = no fingerprint assembly, no draft: the
+            # documented off-cost is this one flag read
+            return self._try_execute_impl(lowering, schema, time_bounds, ctx)
+        with flight_recorder.dispatch_scope(
+            table=ctx.table_key,
+            plan_fp=self._recorder_fp(lowering, ctx),
+            ghost=_in_fused_build(),
+            hbm=lambda: (self.cache._used, self.cache.budget),
+        ):
+            return self._try_execute_impl(lowering, schema, time_bounds, ctx)
+
+    def _try_execute_impl(self, lowering, schema, time_bounds, ctx: TileContext):
         scan = lowering.scan
         ts_name = schema.time_index.name if schema.time_index else None
         tag_cols = list(lowering.group_tags)
@@ -4324,6 +4482,8 @@ class TileExecutor:
         if host_table is not None:
             metrics.TILE_LOWERED_TOTAL.inc()
             metrics.TILE_HOST_FAST_PATH.inc()
+            flight_recorder.note(strategy="host", build_mode="host_fast")
+            flight_recorder.mark()
             if host_hints.get("wide_cold") and self._fused_first_touch(
                 lowering, ctx
             ):
@@ -4373,6 +4533,8 @@ class TileExecutor:
         if cold_table is not None:
             metrics.TILE_LOWERED_TOTAL.inc()
             metrics.TILE_COLD_SERVES.inc()
+            flight_recorder.note(strategy="host", build_mode="cold_serve")
+            flight_recorder.mark()
             if fused_serve:
                 win_manifest = None
                 if (
@@ -4692,7 +4854,15 @@ class TileExecutor:
                         acc=attempt_plan.acc_dtype,
                         mesh_devices=0,
                     ):
+                        t_disp = time.perf_counter()
                         packed = program(tuple(device_sources), dyn)
+                        flight_recorder.stage_add(
+                            "dispatch",
+                            (time.perf_counter() - t_disp) * 1000.0,
+                        )
+                        flight_recorder.note(
+                            strategy=attempt_plan.agg_strategy
+                        )
                 table = self._finalize(
                     packed, int_layout, acc32_layout, acc64_layout, int_dtype,
                     attempt_plan, lowering, schema, ctx, dyn_host, fspec,
@@ -4724,7 +4894,13 @@ class TileExecutor:
                     acc=attempt_plan.acc_dtype,
                     retry=True,
                 ):
+                    t_disp = time.perf_counter()
                     packed = program(tuple(device_sources), dyn)
+                    flight_recorder.stage_add(
+                        "dispatch", (time.perf_counter() - t_disp) * 1000.0
+                    )
+                    flight_recorder.note(strategy=attempt_plan.agg_strategy)
+                    flight_recorder.flag("retry")
                 table = self._finalize(
                     packed, int_layout, acc32_layout, acc64_layout, int_dtype,
                     attempt_plan, lowering, schema, ctx, dyn_host, fspec,
@@ -5020,7 +5196,13 @@ class TileExecutor:
                     acc=attempt_plan.acc_dtype,
                     streamed=True,
                 ):
+                    t_disp = time.perf_counter()
                     packed = program(make_sources(), dyn, sync=True)
+                    flight_recorder.stage_add(
+                        "dispatch", (time.perf_counter() - t_disp) * 1000.0
+                    )
+                    flight_recorder.note(strategy=attempt_plan.agg_strategy)
+                    flight_recorder.flag("streamed")
             except QueryTimeoutError:
                 raise  # the deadline owns the query
             except Exception as e:  # noqa: BLE001 — fall to all-at-once
@@ -5096,9 +5278,16 @@ class TileExecutor:
                 mesh_devices=mesh_n,
                 shard_axis=REGION_AXIS,
             ):
+                t_disp = time.perf_counter()
                 packed = _mesh_run(
                     attempt_plan, nullable_cols, mesh, device_sources,
                     pdyn, hv, program,
+                )
+                flight_recorder.stage_add(
+                    "dispatch", (time.perf_counter() - t_disp) * 1000.0
+                )
+                flight_recorder.note(
+                    strategy=attempt_plan.agg_strategy, mesh_devices=mesh_n
                 )
             metrics.TILE_MESH_DISPATCHES.inc()
             passes.note(
@@ -5119,6 +5308,7 @@ class TileExecutor:
             return None
         except Exception as exc:  # noqa: BLE001 — degrade, never fail
             metrics.TILE_MESH_DEGRADED.inc()
+            flight_recorder.flag("mesh_degraded")
             tracing.add_event(
                 "mesh.degraded",
                 table=ctx.table_key,
@@ -6592,6 +6782,10 @@ class TileExecutor:
             rb_span.attributes["device_finalize"] = bool(
                 getattr(lowering, "post_done", None)
             )
+            flight_recorder.stage_add("readback_transfer", ms)
+            flight_recorder.add_bytes(
+                down=int(sum(p.nbytes for p in fetched))
+            )
             t_dec = time.perf_counter()
             try:
                 return self._decode_result(
@@ -6604,6 +6798,7 @@ class TileExecutor:
                 metrics.TPU_READBACK_DECODE_MS.observe(dec_ms)
                 self._rb_local.decode_ms = dec_ms
                 rb_span.attributes["decode_ms"] = round(dec_ms, 3)
+                flight_recorder.stage_add("readback_decode", dec_ms)
 
     def _decode_result(
         self, buf, accs64, int_layout, acc32_layout, acc64_layout,

@@ -82,6 +82,15 @@ def record(name: str, **attrs):
         c.add(name, 0.0, attrs)
 
 
+def timed(name: str, elapsed_ms: float, depth: int | None = None, **attrs):
+    """Stage with an externally-measured duration — the flight recorder's
+    device-stage split re-renders measured milliseconds here without
+    re-timing them."""
+    c = _collector.get()
+    if c is not None:
+        c.add(name, float(elapsed_ms), attrs, depth=depth)
+
+
 def render(c: StageCollector, plan_lines: list[str], total_ms: float, backend: str):
     """Render the metric tree as (stage, metrics) rows.
 

@@ -70,7 +70,7 @@ from ...ops.rate import (
     range_windows_dyn,
     strip_counter_resets_segmented,
 )
-from ...utils import metrics
+from ...utils import flight_recorder, metrics
 from ...utils import tracing
 from ...utils.errors import QueryTimeoutError
 from ...utils.fault_injection import fire as _fault_fire
@@ -307,7 +307,24 @@ class TqlTileExecutor:
             return None
         try:
             _fault_fire("tql.tile", table=sel.metric, func=func)
-            return self._attempt(func, sel, range_ms, start, end, step, agg)
+            from ...parallel.tile_cache import _in_fused_build
+
+            cache = self.cache
+            # db-qualified key, matching the SQL tile path's
+            # ctx.table_key so device_dispatches.table_name filters see
+            # both strategies for one table
+            table_key = f"{self.db.current_database}.{sel.metric}"
+            with flight_recorder.dispatch_scope(
+                table=table_key, strategy="tql",
+                ghost=_in_fused_build(),
+                hbm=(
+                    (lambda: (cache._used, cache.budget))
+                    if cache is not None else None
+                ),
+            ):
+                return self._attempt(
+                    func, sel, range_ms, start, end, step, agg
+                )
         except QueryTimeoutError:
             raise  # the deadline owns the query, tile or not
         except _Ineligible as ie:
@@ -425,6 +442,10 @@ class TqlTileExecutor:
                             func, sel, range_ms, start, end, step, agg,
                         )
                         metrics.TQL_TILE_COLD_SERVES.inc()
+                        flight_recorder.note(
+                            strategy="tql", build_mode="cold_serve"
+                        )
+                        flight_recorder.mark()
                         passes.note(
                             "tql_tile", False,
                             "cold: served from the legacy scan; background "
@@ -699,11 +720,14 @@ class TqlTileExecutor:
 
         ghost = _in_fused_build()
         mesh_n = self.cache.mesh_devices()
+        import time as _time
+
         with tracing.span(
             "tile.dispatch", strategy="tql", func=func,
             series=s_pad, steps=w, regions=len(sources),
             mesh_devices=mesh_n,
         ):
+            t_disp = _time.perf_counter()
             if mesh_n > 0 and len(sources) > 1:
                 mat, pres = self._mesh_dispatch(
                     csig, sources, region_sigs, dyn, sources_meta, ghost
@@ -716,6 +740,12 @@ class TqlTileExecutor:
                 if not ghost:
                     metrics.TPU_DEVICE_DISPATCHES.inc()
                 mat, pres = fn(tuple(sources), dyn)
+            flight_recorder.stage_add(
+                "dispatch", (_time.perf_counter() - t_disp) * 1000.0
+            )
+            flight_recorder.note(
+                strategy="tql", mesh_devices=mesh_n, build_mode="warm"
+            )
             np_mat, np_pres, pregathered = self._readback(
                 mat, pres, ghost, cfg, compact_ok=agg_op is None
             )
@@ -791,8 +821,12 @@ class TqlTileExecutor:
             np_mat, np_pres = jax.device_get((mat, pres))
             np_mat = np.asarray(np_mat)
             np_pres = [np.asarray(p) for p in np_pres]
+        ms = (_time.perf_counter() - t0) * 1000.0
+        flight_recorder.stage_add("readback_transfer", ms)
+        flight_recorder.add_bytes(
+            down=int(np_mat.nbytes + sum(p.nbytes for p in np_pres))
+        )
         if not ghost:
-            ms = (_time.perf_counter() - t0) * 1000.0
             metrics.TPU_DEVICE_FETCHES.inc()
             metrics.TPU_READBACK_MS.observe(ms)
             metrics.TPU_READBACK_BYTES.inc(

@@ -201,6 +201,8 @@ class TpuExecutor:
         ctx = self.tile_context_provider(scan)
         if ctx is None:
             return None
+        from ..utils import flight_recorder
+
         with stage("tpu.tile_cache") as info:
             # per-query transfer vs host-decode split of the readback
             # (greptime_tpu_readback_{transfer,decode}_ms): surfaces in
@@ -211,6 +213,7 @@ class TpuExecutor:
             rbl = getattr(self.tile_executor, "_rb_local", None)
             if rbl is not None:
                 rbl.transfer_ms = rbl.decode_ms = None
+            flight_recorder.clear_last()
             table = self.tile_executor.execute(
                 lowering, schema, lambda: time_bounds(), ctx
             )
@@ -222,10 +225,49 @@ class TpuExecutor:
             ):
                 info["readback_transfer_ms"] = round(rbl.transfer_ms, 2)
                 info["readback_decode_ms"] = round(rbl.decode_ms or 0.0, 2)
+            if table is not None:
+                self._analyze_device_stages(flight_recorder)
         if table is None:
             return None
         with stage("tpu.post_ops"):
             return self._shape_output(table, lowering, schema)
+
+    @staticmethod
+    def _analyze_device_stages(flight_recorder):
+        """Render the flight recorder's per-stage device split for this
+        query into the EXPLAIN ANALYZE tree: the REAL measured stage
+        milliseconds (upload/compile/dispatch/readback-transfer/
+        readback-decode) plus one line per region build leg, replacing
+        the coarse tile_cache total as the only device evidence.  No-op
+        when EXPLAIN ANALYZE is not running or the recorder is off."""
+        from . import analyze
+
+        if analyze.active_collector() is None:
+            return
+        rec = flight_recorder.last_record()
+        if rec is None:
+            return
+        for name in flight_recorder.STAGES:
+            ms = rec.stage_ms(name)
+            attrs = {}
+            if name == "compile" and rec.compile_cache:
+                attrs["cache"] = rec.compile_cache
+            if name == "dispatch":
+                attrs["strategy"] = rec.strategy
+                if rec.mesh_devices:
+                    attrs["mesh_devices"] = rec.mesh_devices
+            if name == "upload" and rec.bytes_up:
+                attrs["bytes"] = rec.bytes_up
+            if name == "readback_transfer" and rec.bytes_down:
+                attrs["bytes"] = rec.bytes_down
+            analyze.timed(f"device.{name}", ms, **attrs)
+        for region_id, mode, build_ms, rows in rec.regions:
+            analyze.timed(
+                "device.region", build_ms,
+                region=region_id, mode=mode, rows=rows,
+            )
+        if rec.flags:
+            analyze.record("device.flags", flags=",".join(rec.flags))
 
     def execute(self, lowering: Lowering, schema: Schema, time_bounds) -> pa.Table:
         """time_bounds: callback () -> (min_ts, max_ts) over the scanned data,
@@ -258,7 +300,13 @@ class TpuExecutor:
             info["regions"] = len(region_tables)
             info["rows"] = sum(t.num_rows for t in region_tables)
         needs_ts = any(f == "last_value" for f, _ in lowering.agg_specs)
-        with stage("tpu.device_groupby") as info:
+        from ..utils import flight_recorder
+
+        with stage("tpu.device_groupby") as info, \
+                flight_recorder.dispatch_scope(
+                    table=f"{scan.database}.{scan.table}",
+                    strategy="mesh_table",
+                ):
             result = distributed_groupby(
                 self.mesh,
                 region_tables,

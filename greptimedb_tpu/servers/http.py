@@ -160,6 +160,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._handle_prof_cpu(params)
             if route == "/debug/prof/mem":
                 return self._handle_prof_mem(params)
+            if route == "/debug/tile":
+                return self._handle_tile(params)
             return self._send(404, {"error": f"no route {route}"})
         except GreptimeError as e:
             # the root trace id (attached by the self-observability loop
@@ -280,6 +282,51 @@ class _Handler(BaseHTTPRequestHandler):
         total = sum(s.size for s in snap.statistics("filename"))
         lines.append(f"total traced: {total / 1024 / 1024:.1f} MiB")
         return self._send(200, ("\n".join(lines) + "\n").encode(), "text/plain")
+
+    def _handle_tile(self, params):
+        """Glass-box view of the TPU hot path (sits beside /debug/prof/*):
+        the flight recorder's newest dispatch records, the tile cache's
+        per-region residency summary, and per-device HBM accounting —
+        the same data information_schema.{device_dispatches,
+        tile_cache_entries, device_memory} serves over SQL, as one JSON
+        document for curl-level debugging.  `?n=` bounds the dispatch
+        tail (default 50); `?table=` filters it."""
+        from ..utils.flight_recorder import RECORDER
+
+        n = max(int(params.get("n", "50")), 1)
+        table_filter = params.get("table")
+        recs = RECORDER.snapshot()
+        if table_filter:
+            recs = [r for r in recs if r.table == table_filter]
+        cache = getattr(
+            getattr(self.db, "query_engine", None), "tile_cache", None
+        )
+        entries = []
+        memory = []
+        if cache is not None:
+            # the same under-lock snapshot + device collector the
+            # information_schema tables use — two surfaces, one impl
+            for e in cache.introspect_entries():
+                entries.append({k: v for k, v in e.items() if k != "planes"})
+            memory = cache.device_memory_rows()
+        return self._send(200, {
+            "recorder": {
+                "enabled": RECORDER.enabled,
+                "ring_size": RECORDER.ring_size,
+                "records": len(recs),
+                "dropped_since_start": RECORDER.dropped,
+            },
+            "dispatches": [r.to_dict() for r in recs[-n:]],
+            "tile_cache": (
+                {**cache.stats(),
+                 "budget": int(cache.budget),
+                 "chunk_rows": int(cache.chunk_rows),
+                 "degrade_rounds": int(cache.degrade_rounds)}
+                if cache is not None else {}
+            ),
+            "entries": entries,
+            "memory": memory,
+        })
 
     def _handle_jaeger(self, endpoint: str, params):
         from . import jaeger

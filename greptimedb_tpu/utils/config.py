@@ -294,6 +294,27 @@ class TraceConfig:
 
 
 @dataclasses.dataclass
+class RecorderConfig:
+    """Device flight recorder (utils/flight_recorder.py): every tile
+    dispatch appends one bounded record — plan fingerprint + trace id,
+    strategy, build mode, per-stage ms, bytes up/down, HBM snapshot and
+    degrade/coalesce/retry flags — into a drop-oldest ring surfaced via
+    `information_schema.device_dispatches`, EXPLAIN ANALYZE's
+    device-stage split and the `/debug/tile` endpoint.
+
+    Default ON: the steady-state cost is one thread-local dict per
+    dispatch plus a handful of perf_counter reads (the tier-1 bench
+    smoke pins the warm-dispatch overhead under noise).  `enabled =
+    false` makes the whole surface a no-op — empty tables, coarse
+    EXPLAIN totals, today's behavior bit-for-bit."""
+
+    enabled: bool = True
+    # Records kept before drop-oldest eviction (one record ≈ 600 bytes of
+    # host RAM; 4096 ≈ 2.5 MB).
+    ring_size: int = 4096
+
+
+@dataclasses.dataclass
 class SlowQueryConfig:
     """Slow-query recording (reference common/telemetry SlowQueryOptions +
     event recorder into greptime_private.slow_queries)."""
@@ -581,6 +602,7 @@ class Config:
     index: IndexConfig = dataclasses.field(default_factory=IndexConfig)
     tql: TqlConfig = dataclasses.field(default_factory=TqlConfig)
     trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
+    recorder: RecorderConfig = dataclasses.field(default_factory=RecorderConfig)
 
     def __post_init__(self):
         self.storage.__post_init__()
@@ -878,6 +900,19 @@ class Config:
             raise ConfigError(
                 "trace.export_interval_s must be > 0 seconds — the "
                 f"SelfTraceWriter drain cadence; got {tr.export_interval_s!r}"
+            )
+        rec = self.recorder
+        if not isinstance(rec.enabled, bool):
+            raise ConfigError(
+                "recorder.enabled must be a boolean (device flight "
+                "recorder behind information_schema.device_dispatches); "
+                f"got {rec.enabled!r}"
+            )
+        if not (16 <= int(rec.ring_size) <= (1 << 20)):
+            raise ConfigError(
+                "recorder.ring_size must be in [16, 1048576] records — "
+                "the drop-oldest ring bound of the device flight "
+                f"recorder; got {rec.ring_size!r}"
             )
         fl = self.flow
         if not isinstance(fl.incremental, bool):

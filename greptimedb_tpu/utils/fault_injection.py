@@ -141,6 +141,7 @@ POINTS = frozenset(
         "mesh.collective",
         "tile.fused_build",
         "tql.tile",
+        "recorder.emit",
     }
 )
 

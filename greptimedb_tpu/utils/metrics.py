@@ -602,3 +602,20 @@ SELF_SCRAPE_RUNS = REGISTRY.counter(
     "greptime_self_scrape_runs_total",
     "Completed /metrics self-scrape rounds",
 )
+
+# Device flight recorder (utils/flight_recorder.py): the per-dispatch
+# introspection ring behind information_schema.device_dispatches,
+# EXPLAIN ANALYZE's device-stage split and /debug/tile.
+RECORDER_RECORDS = REGISTRY.counter(
+    "greptime_recorder_records_total",
+    "Dispatch records appended to the flight-recorder ring",
+)
+RECORDER_DROPPED = REGISTRY.counter(
+    "greptime_recorder_dropped_total",
+    "Flight-recorder records evicted oldest-first by the bounded ring",
+)
+RECORDER_ERRORS = REGISTRY.counter(
+    "greptime_recorder_errors_total",
+    "Flight-recorder emit failures swallowed (recording is best-effort "
+    "by contract: a recorder failure never fails the recorded query)",
+)

@@ -1,0 +1,49 @@
+-- information_schema device-introspection goldens (PR 14): the flight
+-- recorder's device_dispatches ring, the tile cache's per-plane
+-- tile_cache_entries view, device_memory, plus the pre-existing
+-- region_statistics and cluster_info.  Schemas are a stable contract
+-- (README "Runtime introspection"); every SELECT here is chosen to
+-- render byte-identically on the cpu AND tpu backends and independent
+-- of device count.
+
+CREATE TABLE golden_iseg (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO golden_iseg VALUES ('a', 1000, 1.5), ('b', 2000, 2.5), ('a', 3000, 3.0);
+
+ADMIN flush_table('golden_iseg');
+
+SELECT table_schema, table_name, table_type, engine, region_count FROM information_schema.tables WHERE table_name = 'golden_iseg';
+
+SELECT region_rows, sst_num, memtable_size FROM information_schema.region_statistics WHERE region_rows > 0;
+
+SELECT peer_id, peer_type, peer_addr FROM information_schema.cluster_info;
+
+-- the runtime-introspection tables scan clean on a fresh database: no
+-- tile activity for this table yet, so the per-plane and per-dispatch
+-- views are empty (and the filters keep records of OTHER tables in the
+-- process-wide recorder ring out of the golden)
+
+SELECT count(*) AS planes FROM information_schema.tile_cache_entries WHERE table_name = 'golden_iseg';
+
+SELECT count(*) AS dispatches FROM information_schema.device_dispatches WHERE table_name = 'public.golden_iseg';
+
+SELECT min(device) AS first_device, min(degrade_rounds) AS degrade_rounds FROM information_schema.device_memory;
+
+-- schemas pinned column-by-column (DESC on information_schema works
+-- like the reference's)
+
+USE information_schema;
+
+DESCRIBE tile_cache_entries;
+
+DESCRIBE device_dispatches;
+
+DESCRIBE device_memory;
+
+DESCRIBE region_statistics;
+
+DESCRIBE cluster_info;
+
+USE public;
+
+DROP TABLE golden_iseg;

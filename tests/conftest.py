@@ -163,6 +163,52 @@ def _span_taxonomy_gate():
     )
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _metrics_name_gate():
+    """Every `greptime_*` metric name registered while the session ran
+    must appear in the README's documented metric inventory (the
+    `<!-- metrics:begin -->` block) — metric names are a stable contract
+    consumed by dashboards and the self-scrape, so a new counter landing
+    undocumented is instrumentation drift.  Twin of the span-taxonomy
+    gate below, enforced at session teardown because label-created
+    metrics only exist after the tests ran."""
+    yield
+    import fnmatch
+    import pathlib
+    import re
+
+    from greptimedb_tpu.utils.metrics import REGISTRY
+
+    with REGISTRY._lock:
+        seen = {n for n in REGISTRY._metrics if n.startswith("greptime_")}
+    if not seen:
+        return
+    readme = pathlib.Path(__file__).parent.parent / "README.md"
+    text = readme.read_text(encoding="utf-8")
+    m = re.search(
+        r"<!-- metrics:begin -->(.*?)<!-- metrics:end -->", text, re.S
+    )
+    assert m, (
+        "README.md lost its metric-inventory block "
+        "(<!-- metrics:begin --> ... <!-- metrics:end -->)"
+    )
+    documented = set(re.findall(r"`([^`\s]+)`", m.group(1)))
+    unmatched = sorted(
+        n
+        for n in seen
+        if n not in documented
+        and not any(
+            fnmatch.fnmatch(n, pat) for pat in documented if "*" in pat
+        )
+    )
+    assert not unmatched, (
+        f"greptime_* metric names registered but missing from the README "
+        f"metric inventory: {unmatched} — document them in the "
+        "<!-- metrics:begin --> block (metric names are a stable "
+        "contract) or rename the metric"
+    )
+
+
 @pytest.fixture()
 def tmp_engine(tmp_path):
     from greptimedb_tpu.storage.engine import TimeSeriesEngine
