@@ -301,6 +301,7 @@ _COMPACT_DETAIL_KEYS = (
     "device", "rows", "dataset_hours", "geomean_vs_baseline_all",
     "geomean_vs_baseline_heavy", "prewarm_s", "budget_watchdog_fired",
     "killed_by_signal", "budget_exhausted", "dataset_reused", "tql",
+    "ingest",
 )
 
 
@@ -411,12 +412,20 @@ def _clamp_record(record: dict) -> dict:
         d["cold_over_2x_ref"] = co[:4] + [f"+{len(co) - 4} more"]
     if size(record) <= _RECORD_BYTES_MAX:
         return record
-    # 3. drop the stage-attribution string (full recorder detail lives
+    # 3. slim the ingest digest to its headline — one "rows/s;frames/
+    # writes" string — BEFORE spending the per-query stage digests; the
+    # full ingest stage breakdown survives in BENCH_PARTIAL.json
+    ing = d.get("ingest")
+    if isinstance(ing, dict):
+        d["ingest"] = f"{ing.get('rps', '?')};{ing.get('fw', '?')}"
+    if size(record) <= _RECORD_BYTES_MAX:
+        return record
+    # 4. drop the stage-attribution string (full recorder detail lives
     # in BENCH_PARTIAL.json)
     d.pop("stages", None)
     if size(record) <= _RECORD_BYTES_MAX:
         return record
-    # 4. slim the tql digest to its scalar evidence
+    # 5. slim the tql digest to its scalar evidence
     tql = d.get("tql")
     if isinstance(tql, dict):
         d["tql"] = {
@@ -424,13 +433,13 @@ def _clamp_record(record: dict) -> dict:
         } or {"trimmed": True}
     if size(record) <= _RECORD_BYTES_MAX:
         return record
-    # 5. truncate error strings hard
+    # 6. truncate error strings hard
     for entry in q.values():
         if "error" in entry:
             entry["error"] = str(entry["error"])[:24]
     if size(record) <= _RECORD_BYTES_MAX:
         return record
-    # 6. last resort (the all-queries-timed-out regime, where every ms
+    # 7. last resort (the all-queries-timed-out regime, where every ms
     # figure is 6+ digits): drop per-query reference_ms — the reference
     # numbers are static constants published in bench.py's QUERIES table
     # and the driver's baseline, so the failed-run evidence (cold/warm/
@@ -643,10 +652,35 @@ def _http_ingest_probe(db) -> dict:
                 resp.read()
             total += batch_rows
         t_total = time.perf_counter() - t0
-        return {
+        out = {
             "ingest_http_rows_per_sec": round(total / max(t_total, 1e-9)),
             "ingest_http_rows": total,
         }
+        # parse-path attribution: the vectorized columnar parse (what the
+        # server ran above) vs the per-line Point parser it replaced on
+        # this shape — the probe's rows/s improvement must be assertable
+        # from the record, not inferred
+        try:
+            from greptimedb_tpu.servers.influx import (
+                parse_line_protocol, parse_line_protocol_columnar,
+            )
+
+            body = bodies[0]
+            t0 = time.perf_counter()
+            for _ in range(3):
+                assert parse_line_protocol_columnar(body, "ns") is not None
+            t_col = (time.perf_counter() - t0) / 3 * 1000
+            t0 = time.perf_counter()
+            parse_line_protocol(body.decode(), "ns")
+            t_point = (time.perf_counter() - t0) * 1000
+            out["ingest_http_parse"] = {
+                "columnar_ms": round(t_col, 1),
+                "point_ms": round(t_point, 1),
+                "speedup": round(t_point / max(t_col, 1e-9), 1),
+            }
+        except Exception as e:  # noqa: BLE001 — attribution is best-effort
+            out["ingest_http_parse"] = {"error": repr(e)[:60]}
+        return out
     finally:
         srv.stop()
 
@@ -1158,12 +1192,24 @@ def main():
     gt: dict[int, list] = {}  # (host, hour) ground truth for double-groupby-1
     n_rows = 0
     t_ing = 0.0
+    t_synth = 0.0
+    # per-stage attribution baselines (greptime_ingest_*): a slow r06
+    # ingest must name its stage, not just its total
+    ing0 = {
+        "split": m.INGEST_SPLIT_MS.sum(), "wal": m.INGEST_WAL_MS.sum(),
+        "mem": m.INGEST_MEMTABLE_MS.sum(),
+        "enc": m.INGEST_FLUSH_ENCODE_MS.sum(),
+        "frames": m.INGEST_WAL_FRAMES.get(),
+        "writes": m.INGEST_WRITES_TOTAL.get(),
+    }
     for start in range(0, ticks_total, chunk_ticks):
+        t_s0 = time.perf_counter()
         ticks = min(chunk_ticks, ticks_total - start)
         ts = T0 + (start + np.arange(ticks, dtype=np.int64))[:, None] * (SCRAPE_S * 1000)
         ts = np.broadcast_to(ts, (ticks, N_HOSTS)).reshape(-1)
         hs = np.broadcast_to(hosts_arr[None, :], (ticks, N_HOSTS)).reshape(-1)
         vals = {mm: rng.uniform(0.0, 100.0, ticks * N_HOSTS) for mm in METRICS}
+        t_synth += time.perf_counter() - t_s0
         if not reuse:
             batch = pa.table(
                 {
@@ -1196,6 +1242,37 @@ def main():
     detail["rows"] = n_rows
     if not reuse:
         detail["ingest_inprocess_rows_per_sec"] = round(n_rows / max(t_ing, 1e-9))
+        # compact per-stage digest for the summary record (clamp-aware:
+        # the stage string is dropped before per-query evidence if the
+        # line outgrows the tail capture) — a slow r06 ingest names its
+        # stage, not just a total.  `st` = seconds per stage ("sy" synth,
+        # "in" insert wall, "sp" split, "wa" wal, "me" memtable, "fe"
+        # flush encode incl. async, "fl" final flush_all); `fw` =
+        # frames/writes — merged-frame evidence (frames < writes when
+        # group commit coalesced).  Stage seconds come from the
+        # greptime_ingest_* histograms; the full breakdown also lands in
+        # BENCH_PARTIAL.json via `ingest_stages`.
+        stages_s = {
+            "sy": t_synth, "in": t_ing,
+            "sp": (m.INGEST_SPLIT_MS.sum() - ing0["split"]) / 1000,
+            "wa": (m.INGEST_WAL_MS.sum() - ing0["wal"]) / 1000,
+            "me": (m.INGEST_MEMTABLE_MS.sum() - ing0["mem"]) / 1000,
+            "fe": (m.INGEST_FLUSH_ENCODE_MS.sum() - ing0["enc"]) / 1000,
+            "fl": t_flush,
+        }
+        frames = int(m.INGEST_WAL_FRAMES.get() - ing0["frames"])
+        writes = int(m.INGEST_WRITES_TOTAL.get() - ing0["writes"])
+        detail["ingest"] = {
+            "rps": detail["ingest_inprocess_rows_per_sec"],
+            "st": ",".join(
+                f"{k}{round(v) if v >= 10 else round(v, 1)}"
+                for k, v in stages_s.items()
+            ),
+            "fw": f"{frames}/{writes}",
+        }
+        detail["ingest_stages"] = {
+            k: round(v, 2) for k, v in stages_s.items()
+        }
     detail["ingest_reference_rows_per_sec"] = 326_839
     detail["flush_secs"] = round(t_flush, 1)
     if marker and not reuse:

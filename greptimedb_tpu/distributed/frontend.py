@@ -679,10 +679,10 @@ class Frontend:
             }
             n_rows = result.num_rows
         else:
-            by_name = {
-                c: [row[i] for row in stmt.rows] for i, c in enumerate(columns)
-            }
+            from ..database import rows_to_columns
+
             n_rows = len(stmt.rows)
+            by_name = rows_to_columns(stmt.rows, columns)
         arrays = []
         for col in schema.columns:
             values = by_name.get(col.name, [col.default] * n_rows)

@@ -56,12 +56,31 @@ class PartitionRule:
         raise NotImplementedError
 
     def split(self, table: pa.Table) -> list[pa.Table]:
-        """Split rows into per-partition tables (reference split_rows)."""
+        """Split rows into per-partition tables (reference split_rows):
+        ONE compute pass for the indices, ONE stable-ordered `take`, then
+        zero-copy slices — instead of one filter mask per partition.
+        Row order within each partition is preserved (stable argsort), so
+        last-write-wins append order survives routing."""
         n = self.num_partitions()
         if n == 1 or table.num_rows == 0:
             return [table] + [table.schema.empty_table() for _ in range(n - 1)]
         idx = self.partition_indices(table)
-        return [table.filter(pa.array(idx == p)) for p in range(n)]
+        counts = np.bincount(idx, minlength=n)
+        empty = table.schema.empty_table()
+        hot = int(counts.argmax())
+        if counts[hot] == table.num_rows:
+            # all rows in one partition (the bulk-ingest common case):
+            # skip the take copy entirely
+            out = [empty] * n
+            out[hot] = table
+            return out
+        order = np.argsort(idx, kind="stable")
+        taken = table.take(pa.array(order))
+        offsets = np.concatenate(([0], np.cumsum(counts)))
+        return [
+            taken.slice(int(offsets[p]), int(counts[p])) if counts[p] else empty
+            for p in range(n)
+        ]
 
     def to_dict(self) -> dict:
         raise NotImplementedError
@@ -106,15 +125,23 @@ class HashPartitionRule(PartitionRule):
             col = table[c]
             if pa.types.is_dictionary(col.type):
                 col = pc.cast(col, col.type.value_type)
-            vals = col.to_pylist()
-            # crc32 per distinct value, broadcast via a small cache — stable
-            # across processes (unlike Python hash()).
-            cache: dict = {}
-            hc = np.empty(table.num_rows, dtype=np.uint64)
-            for i, v in enumerate(vals):
-                if v not in cache:
-                    cache[v] = zlib.crc32(repr(v).encode())
-                hc[i] = cache[v]
+            if isinstance(col, pa.ChunkedArray):
+                col = col.combine_chunks()
+            # crc32 per DISTINCT value (dictionary-encode in C++), gathered
+            # back via one vectorized take — stable across processes
+            # (unlike Python hash()) and identical to the per-row loop.
+            enc = pc.dictionary_encode(col)
+            salts = np.array(
+                [zlib.crc32(repr(v).encode()) for v in enc.dictionary.to_pylist()]
+                or [0],
+                dtype=np.uint64,
+            )
+            idxs = np.asarray(pc.fill_null(enc.indices, -1), dtype=np.int64)
+            hc = np.where(
+                idxs >= 0,
+                salts[np.clip(idxs, 0, len(salts) - 1)],
+                np.uint64(zlib.crc32(repr(None).encode())),
+            )
             h = h * np.uint64(1000003) + hc
         return (h % np.uint64(self.n)).astype(np.int32)
 
@@ -192,8 +219,34 @@ class RangePartitionRule(PartitionRule):
         return len(self.bounds) + 1
 
     def partition_indices(self, table: pa.Table) -> np.ndarray:
+        n = table.num_rows
+        if not self.bounds:
+            return np.zeros(n, dtype=np.int32)
+        # Sorted bounds (the only shape CREATE emits): the break-at-first-
+        # failing-bound count equals the total >=-count, which vectorizes
+        # to one compute pass per bound (nulls compare null -> False -> 0,
+        # matching the scalar loop's None handling).
+        try:
+            ascending = all(
+                self.bounds[i] <= self.bounds[i + 1]
+                for i in range(len(self.bounds) - 1)
+            )
+        except TypeError:
+            ascending = False
+        if ascending:
+            try:
+                out = np.zeros(n, dtype=np.int32)
+                col = table[self.column]
+                for b in self.bounds:
+                    ge = pc.fill_null(pc.greater_equal(col, pa.scalar(b)), False)
+                    if isinstance(ge, pa.ChunkedArray):
+                        ge = ge.combine_chunks()
+                    out += np.asarray(ge, dtype=np.int32)
+                return out
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError, pa.ArrowTypeError):
+                pass  # mixed-type bounds: scalar loop below decides
         vals = table[self.column].to_pylist()
-        out = np.empty(table.num_rows, dtype=np.int32)
+        out = np.empty(n, dtype=np.int32)
         for i, v in enumerate(vals):
             p = 0
             for b in self.bounds:

@@ -90,12 +90,15 @@ _PRECISION_FRAC = {"ns": (1, 1_000_000), "us": (1, 1_000), "u": (1, 1_000),
 
 
 def parse_line_protocol_columnar(body, precision: str = "ns"):
-    """Columnar fast path for homogeneous batches (native
-    gt_lp_parse_homogeneous): returns (measurement, pa.Table, tag_keys)
-    ready for the bulk insert path, or None (fall back to the Point
-    parser).  The hot scrape/TSBS shape — one measurement, fixed tags,
-    float fields — skips per-point Python objects entirely.  `body` may
-    be bytes (preferred: no str round-trip) or str."""
+    """Columnar fast path for homogeneous batches: returns
+    (measurement, pa.Table, tag_keys) ready for the bulk insert path, or
+    None (fall back to the Point parser).  The hot scrape/TSBS shape —
+    one measurement, fixed tags, float fields — skips per-point Python
+    objects entirely.  Parses native (gt_lp_parse_homogeneous) when the
+    lib is available, else through the batch-split Python columnar
+    parser (`_parse_homogeneous_py`) — both build arrays per COLUMN, so
+    even the fallback never materializes per-line dicts.  `body` may be
+    bytes (preferred: no str round-trip) or str."""
     frac = _PRECISION_FRAC.get(precision)
     if frac is None:
         return None
@@ -104,8 +107,12 @@ def parse_line_protocol_columnar(body, precision: str = "ns"):
     buf = bytes(body) if isinstance(body, (bytes, bytearray)) else body.encode()
     out = native.lp_parse_homogeneous(buf, frac[0], frac[1])
     if out is None:
+        out = _parse_homogeneous_py(buf, frac[0], frac[1])
+    if out is None:
         return None
+    import numpy as _np
     import pyarrow as _pa
+    import pyarrow.compute as _pc
 
     measurement, tag_keys, field_keys, ts, fields, tag_spans = out
     # a tag or field named like the timestamp column, or any duplicate
@@ -117,13 +124,120 @@ def parse_line_protocol_columnar(body, precision: str = "ns"):
     cols: dict = {}
     for t, key in enumerate(tag_keys):
         spans = tag_spans[:, t]
-        cols[key] = _pa.array(
-            [buf[s:e].decode() for s, e in spans], _pa.string()
+        # decode each DISTINCT tag value once, materialize the full
+        # column via one C++ take — tag columns repeat heavily (hosts),
+        # so per-line .decode() was the parse's dominant Python cost
+        uniq, inv = _np.unique(spans, axis=0, return_inverse=True)
+        vals = _pa.array([buf[s:e].decode() for s, e in uniq], _pa.string())
+        cols[key] = _pc.take(
+            vals, _pa.array(inv.reshape(-1).astype(_np.int64))
         )
     cols["ts"] = _pa.array(ts, _pa.timestamp("ms"))
     for f, key in enumerate(field_keys):
         cols[key] = _pa.array(fields[:, f], _pa.float64())
     return measurement, _pa.table(cols), tag_keys
+
+
+def _parse_homogeneous_py(buf: bytes, mult_num: int, mult_den: int):
+    """Pure-Python columnar parse of a HOMOGENEOUS batch: batch-split the
+    body into lines, verify every line repeats line 1's (measurement, tag
+    keys, field keys) shape, and build per-COLUMN arrays — timestamps and
+    float fields convert in bulk through numpy, repeated measurement+tag
+    heads parse once through a memo.  Returns the same tuple shape the
+    native parser produces, or None (caller falls back to the exact Point
+    parser): escapes, quotes, comments, string/int/bool fields, missing
+    timestamps and ragged shapes all bail."""
+    if b"\\" in buf or b'"' in buf or b"#" in buf:
+        return None
+    try:
+        text = buf.decode()
+    except UnicodeDecodeError:
+        return None
+    lines = text.split("\n")
+    rows = [ln.split(" ") for ln in lines if ln and not ln.isspace()]
+    if not rows or any(len(r) != 3 for r in rows):
+        return None
+    head0 = rows[0][0].split(",")
+    measurement = head0[0]
+    tag_keys = []
+    for kv in head0[1:]:
+        k, sep, _v = kv.partition("=")
+        if not sep:
+            return None
+        tag_keys.append(k)
+    field_keys = []
+    for kv in rows[0][1].split(","):
+        k, sep, _v = kv.partition("=")
+        if not sep:
+            return None
+        field_keys.append(k)
+    n = len(rows)
+    import numpy as _np
+
+    # measurement+tags heads: each DISTINCT head (same host/series)
+    # validates once; values ship as byte spans below, so per-row work is
+    # one memo hit
+    head_memo: dict[str, bool] = {}
+    for r in rows:
+        if r[0] in head_memo:
+            continue
+        hp = r[0].split(",")
+        if len(hp) != 1 + len(tag_keys) or hp[0] != measurement:
+            return None
+        for j, kv in enumerate(hp[1:]):
+            k, sep, _v = kv.partition("=")
+            if not sep or k != tag_keys[j]:
+                return None
+        head_memo[r[0]] = True
+    # float fields: collect value substrings per column, convert in bulk
+    field_strs: list[list] = [[] for _ in field_keys]
+    for r in rows:
+        fp = r[1].split(",")
+        if len(fp) != len(field_keys):
+            return None
+        for j, kv in enumerate(fp):
+            k, sep, v = kv.partition("=")
+            if not sep or k != field_keys[j]:
+                return None
+            field_strs[j].append(v)
+    try:
+        fields = _np.empty((n, len(field_keys)), dtype=_np.float64)
+        for j, vs in enumerate(field_strs):
+            fields[:, j] = _np.array(vs, dtype=_np.float64)
+        ts_raw = _np.array([r[2] for r in rows], dtype=_np.int64)
+    except (ValueError, OverflowError):
+        return None  # int/bool/string field values or bad timestamps
+    ts = ts_raw * mult_num // mult_den  # integer exact, like the native path
+    # tag spans into the ORIGINAL buffer so the caller's unique-decode
+    # assembly works unchanged: rebuild offsets per line head
+    tag_spans = _np.zeros((n, len(tag_keys), 2), dtype=_np.int64)
+    if tag_keys:
+        # byte offsets: lines were split on "\n" and heads on " ", both
+        # 1 byte wide, so offsets reconstruct exactly (ascii separators)
+        span_memo: dict[str, list] = {}
+        line_off = 0
+        i = 0
+        for ln in lines:
+            if not ln or ln.isspace():
+                line_off += len(ln.encode()) + 1
+                continue
+            head = rows[i][0]
+            spans = span_memo.get(head)
+            if spans is None:
+                spans = []
+                off = len(measurement.encode()) + 1  # past "measurement,"
+                for j, kv in enumerate(head.split(",")[1:]):
+                    k, _sep, v = kv.partition("=")
+                    koff = off + len(k.encode()) + 1
+                    spans.append((koff, koff + len(v.encode())))
+                    off = koff + len(v.encode()) + 1
+                span_memo[head] = spans
+            for j, (s, e) in enumerate(spans):
+                tag_spans[i, j, 0] = line_off + s
+                tag_spans[i, j, 1] = line_off + e
+            line_off += len(ln.encode()) + 1
+            i += 1
+    return measurement, tag_keys, field_keys, ts, fields, tag_spans
 
 
 def parse_line_protocol(body: str, precision: str = "ns") -> list[Point]:

@@ -133,7 +133,9 @@ class TimeSeriesEngine:
                 merge_mode=merge_mode,
                 memtable_kind=memtable_kind
                 or getattr(self.config, "memtable_kind", "time_partition"),
+                flush_workers=getattr(self.config, "ingest_flush_workers", 2),
             )
+            self._wire_ingest(region)
             self._regions[region_id] = region
             return region
 
@@ -165,9 +167,19 @@ class TimeSeriesEngine:
                 merge_mode=merge_mode,
                 memtable_kind=memtable_kind
                 or getattr(self.config, "memtable_kind", "time_partition"),
+                flush_workers=getattr(self.config, "ingest_flush_workers", 2),
             )
+            self._wire_ingest(region)
             self._regions[region_id] = region
             return region
+
+    def _wire_ingest(self, region: Region):
+        """Flush-overlapped ingest (ingest.flush_overlap): give the region
+        the write-buffer manager so freezing a memtable moves its bytes
+        out of the mutable budget for the duration of the encode.  Off =
+        no hook = pre-overlap stall accounting bit-for-bit."""
+        if getattr(self.config, "ingest_flush_overlap", True):
+            region.buffer_mgr = self.buffer_mgr
 
     def close_region(self, region_id: int):
         with self._lock:
@@ -209,6 +221,25 @@ class TimeSeriesEngine:
                 if not self.buffer_mgr.should_stall():
                     break
         rows = region.write(batch)
+        self._post_write(region_id, region)
+        return rows
+
+    def write_group(self, region_id: int, batches: list[pa.RecordBatch]) -> list[int]:
+        """Group-commit write (ingest.group_commit): one WAL frame for the
+        whole group, per-write entry ids and row counts.  Same stall /
+        flush-pressure envelope as `write`."""
+        region = self.region(region_id)
+        if self.buffer_mgr.should_stall():
+            metrics.WRITE_STALL_TOTAL.inc()
+            for rid in self.buffer_mgr.pick_flush_candidates():
+                self.flush_region(rid)
+                if not self.buffer_mgr.should_stall():
+                    break
+        rows = region.write_group(batches)
+        self._post_write(region_id, region)
+        return rows
+
+    def _post_write(self, region_id: int, region: Region):
         self.buffer_mgr.set_region_usage(region_id, region.memtable.memory_usage)
         if self.buffer_mgr.should_flush_region(region_id) or self.buffer_mgr.should_flush_engine():
             # threshold flush runs OFF the write path (reference
@@ -217,7 +248,6 @@ class TimeSeriesEngine:
                 self.flusher.schedule(region_id)
             else:
                 self.flush_region(region_id)
-        return rows
 
     def delete(self, region_id: int, keys: pa.Table) -> int:
         """Tombstone-delete rows by (primary key, time index) keys.
@@ -338,6 +368,15 @@ class TimeSeriesEngine:
         affected rows (pipelined ingest: protocol servers overlap decode
         of the next request with this write's WAL+memtable apply)."""
         return self.workers.submit_write(region_id, batch)
+
+    def pending_writes(self, region_id: int) -> bool:
+        """True when the region's worker loop has queued requests — i.e.
+        a submitted write would coalesce into a drain group (WAL group
+        commit) rather than run solo.  Never spawns the worker threads:
+        no workers yet means nothing is pending."""
+        if self._workers is None:
+            return False
+        return not self._workers._worker_for(region_id).queue.empty()
 
     def scan_stream(
         self,

@@ -58,8 +58,9 @@ class Memtable:
             self._bytes += batch.nbytes
             if ts_col is not None and batch.num_rows:
                 ts = batch.column(batch.schema.get_field_index(ts_col.name))
-                lo = pc.min(ts).cast(pa.int64()).as_py()
-                hi = pc.max(ts).cast(pa.int64()).as_py()
+                mm = pc.min_max(ts)  # one pass, not two
+                lo = mm["min"].cast(pa.int64()).as_py()
+                hi = mm["max"].cast(pa.int64()).as_py()
                 self._min_ts = lo if self._min_ts is None else min(self._min_ts, lo)
                 self._max_ts = hi if self._max_ts is None else max(self._max_ts, hi)
 
@@ -129,6 +130,30 @@ def _sort_and_dedup(table: pa.Table, schema: Schema, dedup: bool) -> pa.Table:
         keys.append(ts_col.name)
     if not keys:
         return table
+    fast = _key_codes(table, keys)
+    if fast is not None:
+        # Vectorized fast path (the flush/scan hot shape: string tags +
+        # int/timestamp keys): rank-encode each key column to int64 codes
+        # ordering EXACTLY like arrow's ascending nulls-last comparator
+        # (the small per-column dictionary is ranked BY arrow), then one
+        # stable np.lexsort over the codes — string comparisons happen
+        # O(distinct values), not O(rows log rows).
+        msf, eq_cols = fast
+        seq = np.asarray(
+            table[_SEQ_COL].combine_chunks(), dtype=np.int64
+        )
+        order = np.lexsort(tuple(reversed(msf + [seq])))
+        table = table.take(pa.array(order))
+        if not dedup or table.num_rows <= 1:
+            return table
+        n = table.num_rows
+        same = np.ones(n - 1, dtype=bool)
+        for arr in eq_cols:
+            a = arr[order]
+            same &= a[:-1] == a[1:]
+        keep = np.ones(n, dtype=bool)
+        keep[:-1] = ~same
+        return table.filter(pa.array(keep))
     sort_keys = [(k, "ascending") for k in keys] + [(_SEQ_COL, "ascending")]
     idx = pc.sort_indices(table, sort_keys=sort_keys)
     table = table.take(idx)
@@ -149,6 +174,72 @@ def _sort_and_dedup(table: pa.Table, schema: Schema, dedup: bool) -> pa.Table:
     keep = np.ones(n, dtype=bool)
     keep[:-1] = ~same  # row i dropped if identical key to row i+1 (later seq)
     return table.filter(pa.array(keep))
+
+
+def _key_codes(table: pa.Table, keys: list[str]):
+    """int64 code arrays ordering identically to arrow's ascending
+    nulls-last sort over `keys`, or None when a column's type is not
+    covered (floats etc. keep the arrow sort path).
+
+    Returns (msf, eq_cols): `msf` = most-significant-first lexsort keys
+    (a nullable int column contributes [is_null, value] so nulls land
+    last); `eq_cols` = one pair-compare array per contributed key (code
+    equality <=> arrow value equality, nulls equal each other — the
+    dedup adjacency contract of the legacy loop)."""
+    msf: list[np.ndarray] = []
+    eq_cols: list[np.ndarray] = []
+    for k in keys:
+        col = table[k]
+        if isinstance(col, pa.ChunkedArray):
+            col = col.combine_chunks()
+        t = col.type
+        if pa.types.is_dictionary(t):
+            col = pc.cast(col, t.value_type)
+            t = col.type
+        if (pa.types.is_string(t) or pa.types.is_large_string(t)
+                or pa.types.is_binary(t)):
+            enc = pc.dictionary_encode(col)
+            d = enc.dictionary
+            # rank the (small) dictionary with ARROW's own comparator so
+            # the code order is bit-identical to its string sort
+            order = np.asarray(pc.sort_indices(d), dtype=np.int64)
+            ranks = np.empty(len(d), dtype=np.int64)
+            ranks[order] = np.arange(len(d), dtype=np.int64)
+            idxs = np.asarray(pc.fill_null(enc.indices, -1), dtype=np.int64)
+            if len(d) == 0:  # all-null column: one code for everything
+                codes = np.zeros(len(idxs), dtype=np.int64)
+            else:
+                codes = np.where(
+                    idxs >= 0,
+                    ranks[np.clip(idxs, 0, len(d) - 1)],
+                    np.int64(len(d)),  # nulls past every rank = nulls last
+                )
+            msf.append(codes)
+            eq_cols.append(codes)
+        elif (pa.types.is_integer(t) or pa.types.is_timestamp(t)
+                or pa.types.is_boolean(t)):
+            try:
+                vals = np.asarray(
+                    pc.fill_null(pc.cast(col, pa.int64()), 0), dtype=np.int64
+                )
+            except pa.ArrowInvalid:
+                # uint64 values past 2^63 don't fit the code space —
+                # keep the arrow sort path for this table
+                return None
+            if col.null_count:
+                isnull = np.asarray(pc.is_null(col), dtype=np.int64)
+                msf.append(isnull)  # nulls after values (ascending 0 < 1)
+                msf.append(vals)
+                # (value, is_null) pairs compare equal exactly when the
+                # logical values do (null == null, null != 0)
+                eq_cols.append(vals)
+                eq_cols.append(isnull)
+            else:
+                msf.append(vals)
+                eq_cols.append(vals)
+        else:
+            return None
+    return msf, eq_cols
 
 
 def _isnan(a: np.ndarray) -> np.ndarray:
@@ -214,8 +305,9 @@ class TimeSeriesMemtable(Memtable):
             self._bytes += batch.nbytes
             if ts_col is not None and batch.num_rows:
                 ts = batch.column(batch.schema.get_field_index(ts_col.name))
-                lo = pc.min(ts).cast(pa.int64()).as_py()
-                hi = pc.max(ts).cast(pa.int64()).as_py()
+                mm = pc.min_max(ts)  # one pass, not two
+                lo = mm["min"].cast(pa.int64()).as_py()
+                hi = mm["max"].cast(pa.int64()).as_py()
                 self._min_ts = lo if self._min_ts is None else min(self._min_ts, lo)
                 self._max_ts = hi if self._max_ts is None else max(self._max_ts, hi)
 
@@ -328,8 +420,9 @@ class PartitionTreeMemtable(Memtable):
             self._bytes += batch.nbytes
             if ts_col is not None and batch.num_rows:
                 ts = batch.column(batch.schema.get_field_index(ts_col.name))
-                lo = pc.min(ts).cast(pa.int64()).as_py()
-                hi = pc.max(ts).cast(pa.int64()).as_py()
+                mm = pc.min_max(ts)  # one pass, not two
+                lo = mm["min"].cast(pa.int64()).as_py()
+                hi = mm["max"].cast(pa.int64()).as_py()
                 self._min_ts = lo if self._min_ts is None else min(self._min_ts, lo)
                 self._max_ts = hi if self._max_ts is None else max(self._max_ts, hi)
 

@@ -81,6 +81,7 @@ class Region:
         append_mode: bool = False,
         merge_mode: str | None = None,
         memtable_kind: str = "time_partition",
+        flush_workers: int = 1,
     ):
         from .object_store import FsObjectStore, ObjectStore
 
@@ -165,6 +166,24 @@ class Region:
         # set once the follower watermark is released (close/promotion);
         # an in-flight sync round must not re-pin the shared log after it
         self._lw_released = False
+        # Pipelined ingest: parallel per-SST flush encode pool width, the
+        # optional write-buffer freeze hook (set by the engine when
+        # ingest.flush_overlap is on — flush moves the frozen memtable's
+        # bytes out of the mutable budget so writes keep flowing during
+        # the encode), and the last write's per-stage wall (wal/memtable
+        # ms — the write.region span attrs; single-writer-per-region makes
+        # the unlocked read safe).
+        # clamp to REAL cores: on a 1-core box the pool (and the window
+        # slicing keyed off it) is pure overhead — more files, more index
+        # builds, zero parallelism
+        try:
+            cores = len(os.sched_getaffinity(0))
+        except AttributeError:  # non-linux
+            cores = os.cpu_count() or 1
+        self.flush_workers = max(1, min(flush_workers, cores))
+        self.buffer_mgr = None
+        self.last_write_stage_ms: dict = {}
+        self._conform_cache: tuple | None = None
         self._replay_wal()
 
     # ---- open/replay ------------------------------------------------------
@@ -194,12 +213,61 @@ class Region:
             if not self.writable:
                 raise RegionReadonlyError(f"region {self.region_id} is read-only")
             batch = self._conform(batch)
+            t0 = time.perf_counter()
             self.wal.append(batch)
+            t1 = time.perf_counter()
             self.sequence += 1
             self.memtable.write(batch, self.sequence)
+            t2 = time.perf_counter()
             self.applied_entry_id = self.wal.last_entry_id
+        wal_ms, mem_ms = (t1 - t0) * 1000, (t2 - t1) * 1000
+        self.last_write_stage_ms = {"wal": wal_ms, "memtable": mem_ms}
+        metrics.INGEST_WAL_MS.observe(wal_ms)
+        metrics.INGEST_MEMTABLE_MS.observe(mem_ms)
+        metrics.INGEST_WRITES_TOTAL.inc()
         metrics.WRITE_ROWS_TOTAL.inc(batch.num_rows)
         return batch.num_rows
+
+    def write_group(self, batches: list[pa.RecordBatch]) -> list[int]:
+        """Group commit (ingest.group_commit): one WAL frame for a whole
+        region-worker drain group, one entry id AND one sequence per write
+        — live state equals a crash replay of the same frame entry for
+        entry.  Returns per-write affected row counts in order."""
+        from ..utils import fault_injection
+
+        if not batches:
+            return []
+        with self._lock:
+            if not self.writable:
+                raise RegionReadonlyError(f"region {self.region_id} is read-only")
+            fault_injection.fire(
+                "ingest.group_commit", region_id=self.region_id, n=len(batches)
+            )
+            conformed = [self._conform(b) for b in batches]
+            t0 = time.perf_counter()
+            append_group = getattr(self.wal, "append_group", None)
+            if append_group is not None:
+                append_group(conformed)
+            else:  # a WAL impl without group frames: per-write appends
+                for b in conformed:
+                    self.wal.append(b)
+            t1 = time.perf_counter()
+            # one sequence per write, exactly like replay assigns them
+            for b in conformed:
+                self.sequence += 1
+                self.memtable.write(b, self.sequence)
+            t2 = time.perf_counter()
+            self.applied_entry_id = self.wal.last_entry_id
+        wal_ms, mem_ms = (t1 - t0) * 1000, (t2 - t1) * 1000
+        self.last_write_stage_ms = {
+            "wal": wal_ms, "memtable": mem_ms, "group": len(batches),
+        }
+        metrics.INGEST_WAL_MS.observe(wal_ms)
+        metrics.INGEST_MEMTABLE_MS.observe(mem_ms)
+        metrics.INGEST_WRITES_TOTAL.inc(len(batches))
+        rows = [b.num_rows for b in conformed]
+        metrics.WRITE_ROWS_TOTAL.inc(sum(rows))
+        return rows
 
     def _conform(self, batch: pa.RecordBatch) -> pa.RecordBatch:
         """Project a write onto the region's current schema (+ the __op
@@ -208,7 +276,14 @@ class Region:
         __op=0, and columns come out in schema order so every memtable chunk
         shares one schema (the reference's write-compat shim,
         mito2/src/read/compat.rs, does this on read instead)."""
-        target = self.schema.to_arrow().append(pa.field(OP_COL, pa.int8()))
+        cache = self._conform_cache
+        if cache is None or cache[0] is not self.schema:
+            # keyed on schema object identity: ALTER/manifest refresh swap
+            # the Schema instance, invalidating the cached Arrow target
+            target = self.schema.to_arrow().append(pa.field(OP_COL, pa.int8()))
+            self._conform_cache = (self.schema, target)
+        else:
+            target = cache[1]
         if batch.schema.equals(target):
             return batch
         n = batch.num_rows
@@ -258,21 +333,24 @@ class Region:
             if self.memtable.is_empty():
                 return []
             frozen = self.memtable
+            frozen_bytes = frozen.memory_usage
             frozen_entry_id = self.wal.last_entry_id
             frozen_sequence = self.sequence
             self.memtable = make_memtable(self.schema, self.time_partition_ms, self.memtable_kind)
             self._frozen_memtables.append(frozen)
+            if self.buffer_mgr is not None:
+                # flush overlap (ingest.flush_overlap): the frozen bytes
+                # leave the MUTABLE budget now, so new writes are admitted
+                # while this encode runs; the flushing bucket keeps the
+                # total bounded (see WriteBufferManager.should_stall)
+                self.buffer_mgr.freeze_region(self.region_id, frozen_bytes)
         t0 = time.perf_counter()
-        added: list[FileMeta] = []
-        for _window_start, table in frozen.split_by_time_partition(
-            # last_non_null must NOT last-row-dedup on flush: older
-            # versions' non-null fields are still live until the READ-side
-            # fieldwise merge combines them
-            dedup=not self.append_mode and self.merge_mode != "last_non_null"
-        ):
-            meta = self.sst_writer.write(table, level=0)
-            if meta is not None:
-                added.append(meta)
+        try:
+            added = self._encode_sst_windows(frozen)
+        finally:
+            if self.buffer_mgr is not None:
+                self.buffer_mgr.unfreeze_region(self.region_id, frozen_bytes)
+        metrics.INGEST_FLUSH_ENCODE_MS.observe((time.perf_counter() - t0) * 1000)
         with self._lock:
             truncated = self.manifest_mgr.manifest.truncated_entry_id or 0
             if truncated >= frozen_entry_id:
@@ -301,6 +379,53 @@ class Region:
         metrics.FLUSH_TOTAL.inc()
         metrics.FLUSH_ELAPSED.observe(time.perf_counter() - t0)
         return added
+
+    # Rows per SST slice when one time window dominates a flush: a
+    # window's sorted run splits into consecutive slices (disjoint key
+    # ranges by construction) so the encode pool has work even when the
+    # whole flush lands in ONE window (the TSBS shape: days-wide
+    # partitions, minutes-wide flushes).
+    _FLUSH_SLICE_ROWS = 1 << 20
+
+    def _encode_sst_windows(self, frozen: Memtable) -> list[FileMeta]:
+        """Encode the frozen memtable's time windows into SSTs — in
+        parallel over `flush_workers` (ingest.flush_workers; Parquet
+        encode and index builds release the GIL, so the pool overlaps
+        real work).  Big single-window flushes slice their sorted run
+        into consecutive ~1M-row SSTs: slices of a sorted table cover
+        disjoint (pk, ts) ranges, so downstream merge/dedup treats them
+        exactly like any other L0 run split.  Output order stays window
+        order (slices in run order), so manifest positions are
+        deterministic."""
+        parts = frozen.split_by_time_partition(
+            # last_non_null must NOT last-row-dedup on flush: older
+            # versions' non-null fields are still live until the READ-side
+            # fieldwise merge combines them
+            dedup=not self.append_mode and self.merge_mode != "last_non_null"
+        )
+        tables: list[pa.Table] = []
+        for _w, t in parts:
+            if (self.flush_workers > 1
+                    and t.num_rows > 2 * self._FLUSH_SLICE_ROWS):
+                step = self._FLUSH_SLICE_ROWS
+                tables.extend(
+                    t.slice(off, step) for off in range(0, t.num_rows, step)
+                )
+            else:
+                tables.append(t)
+        if self.flush_workers > 1 and len(tables) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=min(self.flush_workers, len(tables)),
+                thread_name_prefix=f"flush-encode-{self.region_id}",
+            ) as ex:
+                metas = list(ex.map(
+                    lambda t: self.sst_writer.write(t, level=0), tables
+                ))
+        else:
+            metas = [self.sst_writer.write(t, level=0) for t in tables]
+        return [m for m in metas if m is not None]
 
     # ---- compaction hook (files swapped by CompactionScheduler) -----------
     def apply_compaction(

@@ -44,7 +44,14 @@ import pyarrow as pa
 
 from ..utils import fault_injection, metrics
 from ..utils.errors import RetryLaterError, StorageError
-from .wal import WalEntry, _decode_batch, _encode_batch
+from .wal import (
+    GROUP_FLAG,
+    WalEntry,
+    _decode_batch,
+    _decode_group,
+    _encode_batch,
+    _encode_group,
+)
 
 _FRAME = struct.Struct("<IIQQ")
 SEGMENT_BYTES_DEFAULT = 4 << 20
@@ -122,10 +129,32 @@ class SharedLogStore:
     def append(self, topic: str, region_id: int, entry_id: int, batch: pa.RecordBatch):
         fault_injection.fire("wal.append", topic=topic, region_id=region_id)
         payload = _encode_batch(batch)
-        frame = _FRAME.pack(len(payload), zlib.crc32(payload), region_id, entry_id) + payload
+        header = _FRAME.pack(
+            len(payload), zlib.crc32(memoryview(payload)), region_id, entry_id
+        )
+        self._write_frame(topic, (header, payload), region_id, entry_id)
+
+    def append_group(
+        self, topic: str, region_id: int, last_entry_id: int,
+        batches: list[pa.RecordBatch],
+    ):
+        """One frame for a whole drain group (ids `last - n + 1 .. last`);
+        the segment index records the REAL last id so pruning semantics
+        are identical to frame-per-write."""
+        fault_injection.fire("wal.append", topic=topic, region_id=region_id)
+        head, ipc = _encode_group(batches)
+        header = _FRAME.pack(
+            len(head) + len(ipc),
+            zlib.crc32(memoryview(ipc), zlib.crc32(head)),
+            region_id, last_entry_id | GROUP_FLAG,
+        )
+        self._write_frame(topic, (header, head, ipc), region_id, last_entry_id)
+
+    def _write_frame(self, topic: str, parts: tuple, region_id: int, entry_id: int):
+        metrics.INGEST_WAL_BYTES.inc(sum(len(p) for p in parts))
         with self._lock:
             seg = self._active_segment(topic)
-            seg.write(frame, region_id, entry_id)
+            seg.write(parts, region_id, entry_id)
             if seg.size >= self.segment_bytes:
                 seg.seal()
                 self._active.pop(topic, None)
@@ -172,7 +201,16 @@ class SharedLogStore:
                     if not tolerate_tail:
                         raise self._sealed_read_error(path)
                     return  # torn tail of the active segment — stop here
-                if rid == region_id and entry_id > from_entry_id:
+                if rid != region_id:
+                    continue
+                if entry_id & GROUP_FLAG:
+                    last = entry_id & ~GROUP_FLAG
+                    subs = _decode_group(payload)
+                    first = last - len(subs) + 1
+                    for i, b in enumerate(subs):
+                        if first + i > from_entry_id:
+                            yield WalEntry(first + i, b)
+                elif entry_id > from_entry_id:
                     yield WalEntry(entry_id, _decode_batch(payload))
 
     @staticmethod
@@ -381,15 +419,23 @@ class _ActiveSegment:
                 if len(payload) < length or zlib.crc32(payload) != crc:
                     break
                 key = str(rid)
-                seg.max_by_region[key] = max(seg.max_by_region.get(key, 0), entry_id)
+                # group frames carry the group's LAST id (flagged)
+                seg.max_by_region[key] = max(
+                    seg.max_by_region.get(key, 0), entry_id & ~GROUP_FLAG
+                )
         return seg
 
-    def write(self, frame: bytes, region_id: int, entry_id: int):
-        self._file.write(frame)
+    def write(self, frame, region_id: int, entry_id: int):
+        """`frame` is bytes or a tuple of buffer parts (header, payload
+        …) written back to back — writers avoid payload-sized concat
+        copies this way."""
+        parts = frame if isinstance(frame, tuple) else (frame,)
+        for p in parts:
+            self._file.write(p)
         self._file.flush()
         if self.fsync:
             os.fsync(self._file.fileno())
-        self.size += len(frame)
+        self.size += sum(len(p) for p in parts)
         key = str(region_id)
         self.max_by_region[key] = max(self.max_by_region.get(key, 0), entry_id)
 
@@ -441,7 +487,23 @@ class RemoteRegionWal:
             entry_id = self.last_entry_id + 1
             self.store.append(self.topic, self.region_id, entry_id, batch)
             self.last_entry_id = entry_id
-            return entry_id
+        metrics.INGEST_WAL_FRAMES.inc()
+        return entry_id
+
+    def append_group(self, batches: list[pa.RecordBatch]) -> list[int]:
+        """Group-commit twin of RegionWal.append_group over the shared
+        topic: one frame, per-write entry ids."""
+        if len(batches) == 1:
+            return [self.append(batches[0])]
+        with self._lock:
+            first = self.last_entry_id + 1
+            last = self.last_entry_id + len(batches)
+            self.store.append_group(self.topic, self.region_id, last, batches)
+            self.last_entry_id = last
+        metrics.INGEST_WAL_FRAMES.inc()
+        metrics.INGEST_GROUP_FRAMES.inc()
+        metrics.INGEST_GROUP_WRITES.inc(len(batches))
+        return list(range(first, last + 1))
 
     def replay(self, from_entry_id: int):
         yield from self.store.read(self.topic, self.region_id, from_entry_id)

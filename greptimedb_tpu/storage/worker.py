@@ -89,9 +89,13 @@ class RegionWorkerLoop:
             self._handle(batch)
 
     def _handle(self, reqs: list[_WriteRequest]):
-        """Group by region; one engine.write per (region, merged batch) —
-        WAL append and memtable lock amortize across the group
-        (reference handle_write_requests, worker/handle_write.rs:40)."""
+        """Group by region; one WAL frame + one memtable lock per (region,
+        drained group) (reference handle_write_requests,
+        worker/handle_write.rs:40).  With ingest.group_commit on the group
+        commits through engine.write_group — ONE frame carrying one entry
+        id per request, so replay/lag/prune semantics match frame-per-
+        write.  Off restores the legacy merge (one batch, one entry id)
+        bit-for-bit."""
         by_region: dict[int, list[_WriteRequest]] = {}
         for r in reqs:
             by_region.setdefault(r.region_id, []).append(r)
@@ -99,7 +103,18 @@ class RegionWorkerLoop:
             try:
                 if len(group) == 1:
                     rows = self.engine.write(rid, group[0].batch)
+                    self._stamp_stages(rid, group)
                     group[0].future.set_result(rows)
+                    continue
+                write_group = getattr(self.engine, "write_group", None)
+                if write_group is not None and getattr(
+                    getattr(self.engine, "config", None),
+                    "ingest_group_commit", True,
+                ):
+                    rows_list = write_group(rid, [g.batch for g in group])
+                    self._stamp_stages(rid, group)
+                    for g, n in zip(group, rows_list):
+                        g.future.set_result(n)
                     continue
                 merged = pa.Table.from_batches(
                     [g.batch for g in group]
@@ -109,12 +124,25 @@ class RegionWorkerLoop:
                     if merged.num_rows
                     else group[0].batch
                 )
+                self._stamp_stages(rid, group)
                 for g in group:
                     g.future.set_result(g.batch.num_rows)
             except Exception as e:  # noqa: BLE001 — deliver per-request
                 for g in group:
                     if not g.future.done():
                         g.future.set_exception(e)
+
+    def _stamp_stages(self, rid: int, group: list[_WriteRequest]):
+        """Attach the write's per-stage wall to each request's future
+        BEFORE resolving it: the submitting thread reads it off the
+        future, so a concurrent caller's later write on this region can
+        never be mis-attributed to this statement's write.region span."""
+        try:
+            stages = self.engine.region(rid).last_write_stage_ms
+        except Exception:  # noqa: BLE001 — attribution only
+            return
+        for g in group:
+            g.future.stage_ms = stages
 
 
 class WorkerGroup:

@@ -103,6 +103,13 @@ class StorageConfig:
     # user-facing surface stays `replica.sync_interval_ms`.  0 = no
     # follower tailing (open-time snapshots).
     follower_sync_interval_ms: float = 0.0
+    # Storage-plane mirrors of the user-facing `ingest.*` section (same
+    # copy-down pattern as index.*/replica.*): WAL group commit on the
+    # region-worker loops, the flush encode pool width, and write
+    # admission during an in-flight flush encode.
+    ingest_group_commit: bool = True
+    ingest_flush_workers: int = 2
+    ingest_flush_overlap: bool = True
 
     def __post_init__(self):
         # NOTE: wal_dir/sst_dir stay EMPTY unless explicitly set — they are
@@ -486,6 +493,39 @@ class IndexConfig:
 
 
 @dataclasses.dataclass
+class IngestConfig:
+    """Pipelined columnar ingest (storage/worker.py + storage/wal.py +
+    storage/region.py).  Everything here is off-safe: all three knobs at
+    their off positions restore the pre-pipeline write path bit-for-bit
+    (frame-per-write WAL bytes, serial flush encode, stall-on-flush).
+
+    Durability note: with `group_commit` on and `storage.wal_fsync` on,
+    the fsync runs once per MERGED frame, not once per write — every
+    acked write is still durable (futures resolve only after the group
+    frame is written and fsynced), but writes share their fsync with the
+    group.  An operator who needs one fsync *syscall* per write request
+    must run with `group_commit = false`."""
+
+    # Merge each region-worker drain group into ONE WAL frame (one Arrow
+    # IPC encode, one write syscall, one optional fsync) while keeping
+    # per-write entry ids — replay, follower lag accounting and
+    # shared-WAL pruning see the same entries as frame-per-write.  Also
+    # routes single-region inserts through the worker loops so WAL
+    # appends overlap the caller building its next batch.
+    group_commit: bool = True
+    # Flush encode pool: SSTs of one flush (one per time window) encode
+    # Parquet + indexes concurrently on this many workers.  1 = the
+    # serial pre-pipeline loop.
+    flush_workers: int = 2
+    # Admit new writes while a flush encode is in flight: freezing a
+    # memtable moves its bytes out of the mutable write-buffer budget
+    # into a flushing bucket, so ingest keeps running during the encode.
+    # Total (mutable + flushing) stays bounded at 2x the global buffer
+    # limit before writes stall.
+    flush_overlap: bool = True
+
+
+@dataclasses.dataclass
 class FlowConfig:
     """Incremental dataflow for materialized views (flow/dataflow.py).
 
@@ -600,6 +640,7 @@ class Config:
     admission: AdmissionConfig = dataclasses.field(default_factory=AdmissionConfig)
     flow: FlowConfig = dataclasses.field(default_factory=FlowConfig)
     index: IndexConfig = dataclasses.field(default_factory=IndexConfig)
+    ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
     tql: TqlConfig = dataclasses.field(default_factory=TqlConfig)
     trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
     recorder: RecorderConfig = dataclasses.field(default_factory=RecorderConfig)
@@ -625,6 +666,15 @@ class Config:
         # the replica knob is off)
         if self.replica.sync_interval_ms > 0:
             self.storage.follower_sync_interval_ms = self.replica.sync_interval_ms
+        # ingest.* is the user-facing pipelined-ingest surface; engines
+        # only see StorageConfig, so copy engaged knobs down like index.*
+        ing_defaults = IngestConfig()
+        if self.ingest.group_commit != ing_defaults.group_commit:
+            self.storage.ingest_group_commit = self.ingest.group_commit
+        if self.ingest.flush_workers != ing_defaults.flush_workers:
+            self.storage.ingest_flush_workers = self.ingest.flush_workers
+        if self.ingest.flush_overlap != ing_defaults.flush_overlap:
+            self.storage.ingest_flush_overlap = self.ingest.flush_overlap
         self.validate()
 
     def validate(self):
@@ -861,6 +911,29 @@ class Config:
                 f"index.max_terms ({ix.max_terms}) cannot be below "
                 f"index.segment_terms ({ix.segment_terms}) — the index "
                 "could never hold even one full segment"
+            )
+        ing = self.ingest
+        if not isinstance(ing.group_commit, bool):
+            raise ConfigError(
+                "ingest.group_commit must be a boolean (merge each region-"
+                "worker drain group into one WAL frame; false restores "
+                "frame-per-write bytes bit-for-bit — the shape to run when "
+                "you need one fsync SYSCALL per write rather than per-write "
+                f"durability, which group commit preserves); got "
+                f"{ing.group_commit!r}"
+            )
+        if not isinstance(ing.flush_overlap, bool):
+            raise ConfigError(
+                "ingest.flush_overlap must be a boolean (admit writes while "
+                f"a flush encode is in flight); got {ing.flush_overlap!r}"
+            )
+        if not isinstance(ing.flush_workers, int) \
+                or isinstance(ing.flush_workers, bool) \
+                or not (1 <= ing.flush_workers <= 64):
+            raise ConfigError(
+                "ingest.flush_workers must be an integer in [1, 64] — the "
+                "per-flush SST encode pool width (1 = serial pre-pipeline "
+                f"loop); got {ing.flush_workers!r}"
             )
         if q.agg_strategy not in ("auto", "hash", "sort"):
             raise ConfigError(

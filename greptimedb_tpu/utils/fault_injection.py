@@ -142,6 +142,7 @@ POINTS = frozenset(
         "tile.fused_build",
         "tql.tile",
         "recorder.emit",
+        "ingest.group_commit",
     }
 )
 

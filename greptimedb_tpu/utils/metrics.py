@@ -200,6 +200,31 @@ FLUSH_TOTAL = REGISTRY.counter("greptime_mito_flush_total", "Memtable flushes")
 FLUSH_ELAPSED = REGISTRY.histogram("greptime_mito_flush_elapsed", "Flush seconds")
 COMPACTION_TOTAL = REGISTRY.counter("greptime_mito_compaction_total", "Compactions")
 WRITE_STALL_TOTAL = REGISTRY.counter("greptime_mito_write_stall_total", "Write stalls")
+# Pipelined columnar ingest: per-stage timings + WAL frame accounting.
+# The stage histograms split a write's wall time between partition-split
+# (frontend), WAL append and memtable apply; flush_encode covers the
+# Parquet+index encode of one flush.  The frame counters are the
+# group-commit observability contract: with ingest.group_commit on,
+# wal_frames_total grows SLOWER than writes_total (merged frames), and
+# group_writes_total counts the write entries those merged frames carried.
+INGEST_SPLIT_MS = REGISTRY.histogram(
+    "greptime_ingest_split_ms", "Partition-rule row routing milliseconds per write batch")
+INGEST_WAL_MS = REGISTRY.histogram(
+    "greptime_ingest_wal_ms", "WAL append milliseconds per write (group appends count once)")
+INGEST_MEMTABLE_MS = REGISTRY.histogram(
+    "greptime_ingest_memtable_ms", "Memtable apply milliseconds per write")
+INGEST_FLUSH_ENCODE_MS = REGISTRY.histogram(
+    "greptime_ingest_flush_encode_ms", "Parquet + index encode milliseconds per flush")
+INGEST_WRITES_TOTAL = REGISTRY.counter(
+    "greptime_ingest_writes_total", "Write requests through the region write path")
+INGEST_WAL_FRAMES = REGISTRY.counter(
+    "greptime_ingest_wal_frames_total", "WAL frames written (solo or merged group)")
+INGEST_WAL_BYTES = REGISTRY.counter(
+    "greptime_ingest_wal_bytes_total", "WAL bytes written (frame headers + payload)")
+INGEST_GROUP_FRAMES = REGISTRY.counter(
+    "greptime_ingest_wal_group_frames_total", "Merged group-commit WAL frames written")
+INGEST_GROUP_WRITES = REGISTRY.counter(
+    "greptime_ingest_wal_group_writes_total", "Write entries carried by merged group frames")
 QUERY_ELAPSED = REGISTRY.histogram("greptime_query_elapsed", "Query seconds")
 TPU_LOWERED_TOTAL = REGISTRY.counter("greptime_query_tpu_lowered_total", "Plans lowered to TPU")
 TPU_FALLBACK_TOTAL = REGISTRY.counter("greptime_query_tpu_fallback_total", "Plans that fell back to CPU")

@@ -184,6 +184,101 @@ def test_bench_smoke_fused_cold_path(tmp_path):
 
 
 @pytest.mark.bench_smoke
+def test_bench_smoke_ingest_pipeline(tmp_path):
+    """ISSUE 15 ingest micro-check: pipelined (group commit + vectorized
+    routing + flush overlap, the defaults) vs legacy ingest on a small
+    dataset — bit-identical query results, the greptime_ingest_* stage
+    metrics present, and merged-frame evidence (WAL frames < writes)
+    asserted via counters.  No wall-clock assertion: CI-safe."""
+    from concurrent.futures import Future
+
+    from greptimedb_tpu.storage.worker import _WriteRequest
+
+    def mk_db(name, pipelined: bool) -> Database:
+        cfg = Config()
+        cfg.storage.compaction_background_enable = False
+        if not pipelined:
+            cfg.storage.ingest_group_commit = False
+            cfg.storage.ingest_flush_workers = 1
+            cfg.storage.ingest_flush_overlap = False
+        db = Database(data_home=str(tmp_path / name), config=cfg)
+        db.sql(
+            "CREATE TABLE cpu (hostname STRING, ts TIMESTAMP(3) TIME INDEX,"
+            " usage_user DOUBLE, usage_system DOUBLE, PRIMARY KEY (hostname))"
+            " PARTITION BY HASH (hostname) PARTITIONS 2"
+        )
+        return db
+
+    db_new = mk_db("pipelined", True)
+    db_old = mk_db("legacy", False)
+    try:
+        w0 = metrics.INGEST_WRITES_TOTAL.get()
+        f0 = metrics.INGEST_WAL_FRAMES.get()
+        split0 = metrics.INGEST_SPLIT_MS.total()
+        wal0 = metrics.INGEST_WAL_MS.total()
+        mem0 = metrics.INGEST_MEMTABLE_MS.total()
+        enc0 = metrics.INGEST_FLUSH_ENCODE_MS.total()
+        for db in (db_new, db_old):
+            for lo in range(0, 300, 100):
+                _ingest(db, lo, lo + 100, seed=lo)
+            # the multi-row VALUES path (zip transpose + coercion)
+            db.sql(
+                "INSERT INTO cpu VALUES"
+                " ('host_0', 1767225600001, 1.5, 2.5),"
+                " ('host_1', 1767225600002, 3.5, 4.5)"
+            )
+        # a deterministic drained group through the pipelined worker:
+        # five requests commit as ONE merged WAL frame, five entry ids
+        engine = db_new.storage
+        frames1 = metrics.INGEST_WAL_FRAMES.get()
+        writes1 = metrics.INGEST_WRITES_TOTAL.get()
+        rid = db_new.catalog.table("cpu", "public").region_ids[0]
+        reqs = [
+            _WriteRequest(rid, pa.record_batch(
+                {"hostname": pa.array([f"gh_{i}"]),
+                 "ts": pa.array([T0 + 10_000_000 + i], pa.timestamp("ms")),
+                 "usage_user": pa.array([1.0]),
+                 "usage_system": pa.array([2.0])},
+            ), Future())
+            for i in range(5)
+        ]
+        engine.workers._worker_for(rid)._handle(reqs)
+        assert [r.future.result(timeout=30) for r in reqs] == [1] * 5
+        assert metrics.INGEST_WAL_FRAMES.get() - frames1 == 1
+        assert metrics.INGEST_WRITES_TOTAL.get() - writes1 == 5
+        db_old.sql(
+            "INSERT INTO cpu VALUES"
+            + ", ".join(
+                f"('gh_{i}', {T0 + 10_000_000 + i}, 1.0, 2.0)"
+                for i in range(5)
+            )
+        )
+        # merged-frame evidence overall: fewer frames than write requests
+        writes_d = metrics.INGEST_WRITES_TOTAL.get() - w0
+        frames_d = metrics.INGEST_WAL_FRAMES.get() - f0
+        assert writes_d > 0 and frames_d < writes_d, (frames_d, writes_d)
+        # every ingest stage metric observed something
+        assert metrics.INGEST_SPLIT_MS.total() > split0
+        assert metrics.INGEST_WAL_MS.total() > wal0
+        assert metrics.INGEST_MEMTABLE_MS.total() > mem0
+        db_new.storage.flush_all()
+        db_old.storage.flush_all()
+        assert metrics.INGEST_FLUSH_ENCODE_MS.total() > enc0
+        # bit-identical query results across the two ladders
+        for q in (
+            "SELECT hostname, ts, usage_user, usage_system FROM cpu"
+            " ORDER BY hostname, ts",
+            "SELECT hostname, avg(usage_user), count(usage_system) FROM cpu"
+            " GROUP BY hostname ORDER BY hostname",
+        ):
+            t_new, t_old = db_new.sql_one(q), db_old.sql_one(q)
+            assert t_new.to_pydict() == t_old.to_pydict(), q
+    finally:
+        db_new.close()
+        db_old.close()
+
+
+@pytest.mark.bench_smoke
 def test_bench_smoke_mixed_overload(tmp_path):
     """`bench.py --mode mixed` smoke: concurrent ingest+query against a
     tile budget FORCED below the working set, admission + coalescing +
@@ -291,6 +386,14 @@ def test_compact_record_stays_under_tail_capture():
                 "twin_ms": 99999.9,
                 "skipped": "remaining budget below tql-phase floor",
             },
+            # the ISSUE 15 ingest digest at its widest (all stages 5
+            # digits + worst-case frame accounting) — clamp step 4b slims
+            # it to its headline when the line is contended
+            "ingest": {
+                "rps": 398_000,
+                "st": "sy99999,in99999,sp99999,wa99999,me99999,fe99999,fl99999",
+                "fw": "1036800/103680000",
+            },
         }
         record = bench._build_record()
         line = json.dumps(record, separators=(",", ":"))
@@ -303,6 +406,9 @@ def test_compact_record_stays_under_tail_capture():
     assert all("cold_ms" in v or "error" in v for v in q.values())
     assert "cold_over_2x_ref" in record["detail"]
     assert record["detail"]["tql"].get("skipped")
+    # the ingest digest survives clamping as its headline string:
+    # rows/s + the frames/writes merge evidence
+    assert record["detail"]["ingest"] == "398000;1036800/103680000"
     assert len(line) < 1900, (
         f"compact record is {len(line)} bytes — it will not survive the "
         f"driver's ~2000-byte tail capture: {line[:300]}..."
@@ -339,12 +445,19 @@ def test_compact_record_realistic_keeps_stage_digests():
             "dataset_hours": 72,
             "prewarm_s": 210.4,
             "budget_exhausted": False,
-            "dataset_reused": True,
+            # a run that emits an ingest digest by definition did NOT
+            # reuse the dataset (the digest only exists for real ingests)
+            "dataset_reused": False,
             "tql": {
                 "rate": [1.9, 38.2, 20.1],
                 "sumby": [2.3, 41.0, 17.8],
                 "inc1": [1.7, 36.9, 21.7],
                 "twin_ms": 55.0,
+            },
+            "ingest": {
+                "rps": 812_400,
+                "st": "sy12.1,in128,sp3.1,wa41.2,me22.8,fe88.0,fl9.4",
+                "fw": "52/52",
             },
         }
         record = bench._build_record()
@@ -357,6 +470,10 @@ def test_compact_record_realistic_keeps_stage_digests():
     )
     assert stages.split(",") == ["di3.2"] * 15
     assert record["detail"]["tql"]["rate"] == [1.9, 38.2, 20.1]
+    # the ingest digest keeps at least its headline (rows/s + frame
+    # merge evidence) alongside the surviving stage digests
+    ing = record["detail"]["ingest"]
+    assert (ing == "812400;52/52") or ing.get("rps") == 812_400
     assert len(line) < 1900, f"realistic record is {len(line)} bytes"
 
 
