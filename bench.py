@@ -2001,6 +2001,146 @@ MIXED_INGEST_WORKERS = int(os.environ.get("GRAFT_MIXED_INGEST_WORKERS", 2))
 MIXED_OVERCOMMIT_MB = int(os.environ.get("GRAFT_MIXED_OVERCOMMIT_MB", 1))
 
 
+MIXED_HOTSPOT_STEPS = int(os.environ.get("GRAFT_MIXED_HOTSPOT_STEPS", 160))
+
+
+def _hotspot_phase() -> dict:
+    """Elastic hot-spot scenario: skewed ingest (every row on one tag key)
+    drives a single region hot on a 3-node cluster with the balancer ON;
+    the balancer must auto-split the table while writes and reads keep
+    running.  Zero-failed-query contract: reads never raise and always see
+    every acked row; writes may surface RetryLaterError only as the
+    documented retryable fence race (the retry must then land).  Latencies
+    are split into pre_split/post_split phases so the reconfiguration cost
+    is visible in the record."""
+    import tempfile
+
+    from greptimedb_tpu.datatypes import (
+        ColumnSchema,
+        ConcreteDataType,
+        Schema,
+        SemanticType,
+    )
+    from greptimedb_tpu.distributed.cluster import Cluster
+    from greptimedb_tpu.utils.config import Config
+    from greptimedb_tpu.utils.errors import RetryLaterError
+
+    cfg = Config()
+    cfg.balance.enabled = True
+    cfg.balance.ewma_alpha = 0.6
+    cfg.balance.min_dwell_ticks = 2
+    cfg.balance.cooldown_ticks = 2
+    cfg.balance.split_hot_score = 12.0
+    cfg.balance.merge_cold_score = 2.0
+    cfg.validate()
+    now = [1_000_000.0]
+    schema = Schema(columns=[
+        ColumnSchema("host", ConcreteDataType.STRING, SemanticType.TAG),
+        ColumnSchema(
+            "ts", ConcreteDataType.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP
+        ),
+        ColumnSchema("v", ConcreteDataType.FLOAT64),
+    ])
+    c = Cluster(
+        tempfile.mkdtemp(prefix="graft_hotspot_"), num_datanodes=3,
+        clock=lambda: now[0], config=cfg,
+    )
+    acked = 0
+    key = 0
+    failed_queries = 0
+    retried_writes = 0
+    write_exhausted = 0
+    lat: dict[str, list] = {"pre_split": [], "post_split": []}
+    first_split_step = None
+    try:
+        c.create_table("hot", schema)
+        for _ in range(4):
+            now[0] += 1000
+            c.heartbeat_all()
+        split_seen = False
+        for step in range(MIXED_HOTSPOT_STEPS):
+            now[0] += 250
+            n = 4 + (step % 7)
+            batch = pa.RecordBatch.from_arrays(
+                [
+                    pa.array(["h0"] * n, pa.string()),  # pure hot spot
+                    pa.array(
+                        [(key + i) * 1000 for i in range(n)],
+                        pa.timestamp("ms"),
+                    ),
+                    pa.array([float(key + i) for i in range(n)]),
+                ],
+                schema=schema.to_arrow(),
+            )
+            key += n
+            for _attempt in range(4):
+                try:
+                    c.insert("hot", batch)
+                    acked += n
+                    break
+                except RetryLaterError:
+                    # the ONE permitted surface: a write racing the split
+                    # fence; the retry after the swap must land
+                    retried_writes += 1
+                    now[0] += 500
+                    c.heartbeat_all()
+                    c.supervise()
+            else:
+                write_exhausted += 1
+            t0 = time.perf_counter()
+            try:
+                t = c.query("SELECT count(*) AS n FROM hot")
+                if t["n"].to_pylist() != [acked]:
+                    failed_queries += 1
+            except Exception:  # noqa: BLE001 — the zero-failed contract
+                failed_queries += 1
+            wall = (time.perf_counter() - t0) * 1000
+            if step % 3 == 0:
+                c.heartbeat_all()
+                c.supervise()
+            if not split_seen:
+                split_seen = any(
+                    d["ok"] and d["kind"] == "split"
+                    for d in c.balancer.decisions
+                )
+                if split_seen:
+                    first_split_step = step
+            lat["post_split" if split_seen else "pre_split"].append(wall)
+        splits = [
+            d for d in c.balancer.decisions if d["ok"] and d["kind"] == "split"
+        ]
+        regions = len(c.catalog.table("hot", "public").region_ids)
+        phases = {}
+        for ph, walls in lat.items():
+            if not walls:
+                phases[ph] = {"n": 0}
+                continue
+            arr = np.array(walls)
+            p50 = float(np.percentile(arr, 50))
+            p99 = float(np.percentile(arr, 99))
+            # clamp-order aware: rounding may never invert p50 <= p99
+            phases[ph] = {
+                "n": len(walls),
+                "p50_ms": round(min(p50, p99), 2),
+                "p99_ms": round(max(p50, p99), 2),
+            }
+        return {
+            "steps": MIXED_HOTSPOT_STEPS,
+            "acked_rows": acked,
+            "retried_writes": retried_writes,
+            "write_retries_exhausted": write_exhausted,
+            "splits_enacted": len(splits),
+            "first_split_step": first_split_step,
+            "regions": regions,
+            "auto_split": bool(splits) and regions >= 2,
+            "failed_queries": failed_queries,
+            "zero_failed_queries": failed_queries == 0 and write_exhausted == 0,
+            "phases": phases,
+        }
+    finally:
+        c.close()
+
+
 def mixed_main():
     """Concurrent ingest+query under forced HBM overcommit; emits one JSON
     line with p50/p99 per query family and the overload-survival counters."""
@@ -2190,6 +2330,20 @@ def mixed_main():
     for w in workers:
         w.join(timeout=60.0)
     db.config.query.timeout_s = 0.0
+
+    # Elastic hot-spot scenario on a distributed cluster (balancer ON):
+    # the record asserts the skew auto-split with zero failed queries.
+    try:
+        hotspot = _hotspot_phase()
+    except Exception as exc:  # noqa: BLE001 — surfaced in the record
+        hotspot = {"error": repr(exc)[:200], "auto_split": False,
+                   "zero_failed_queries": False}
+    detail["hotspot"] = hotspot
+    _emit({"event": "mixed_hotspot", **{
+        k: hotspot.get(k)
+        for k in ("auto_split", "zero_failed_queries", "splits_enacted",
+                  "regions", "first_split_step")
+    }, "elapsed_s": round(_elapsed(), 1)})
 
     per_family = {}
     all_walls: list[float] = []

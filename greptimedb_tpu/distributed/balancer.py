@@ -1,0 +1,395 @@
+"""Elastic balancer: load-driven region split / merge / migration.
+
+Role-equivalent of the reference metasrv's region balancer + repartition
+driver (meta-srv repartition RFC 2025-06-20, region migration procedures):
+the cluster already owns durable `RepartitionProcedure` and
+`RegionMigrationProcedure` machinery, but until this module nothing ever
+invoked them autonomously.  The `LoadBalancer` closes that loop as a
+supervisor-side tick:
+
+  score    fold heartbeat RegionStats (rows written since the last tick,
+           resident memtable bytes) with flight-recorder-derived device
+           build/dispatch milliseconds into one EWMA load score per region
+  detect   hot regions (score over an absolute floor AND a multiple of the
+           mean sibling score), cold tables (every sibling under a floor)
+           and overloaded datanodes (aggregate score over a multiple of
+           the fleet median)
+  act      drive the EXISTING durable procedures: split a hot table's
+           partition rule (n -> min(2n, cap)), merge a cold table's
+           (n -> n//2), migrate the hottest region off an overloaded node
+
+Hysteresis is the contract that keeps this safe to leave on: scores are
+EWMA-smoothed (`balance.ewma_alpha`), a condition must persist for
+`balance.min_dwell_ticks` consecutive ticks before the balancer acts, a
+table rests for `balance.cooldown_ticks` after any decision, and at most
+ONE decision is enacted per tick — a one-tick burst can never trigger a
+repartition, and a split must settle before a merge of the same table can
+even start dwelling.  Every enacted decision is a span (`balance.decide`),
+a metric (`greptime_balance_*_total`) and a fault point (`balance.decide`,
+fired before the procedure is submitted so an injected failure provably
+leaves routes untouched).
+
+With `balance.enabled = false` (the default) `tick()` returns immediately
+without reading a single stat — bit-for-bit the pre-balancer cluster.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from ..models.partition import HashPartitionRule, SingleRegionRule
+from ..utils import fault_injection, metrics, tracing
+from ..utils.flight_recorder import RECORDER
+
+# Decision kinds, in enactment priority order: shedding an overloaded node
+# beats reshaping one table's rule, splitting heat beats compacting cold.
+MIGRATE = "migrate"
+SPLIT = "split"
+MERGE = "merge"
+
+
+class LoadBalancer:
+    """One balancer per cluster supervisor.  Not thread-safe against
+    concurrent `tick()` calls (the supervisor loop is single-threaded);
+    `state()` may be read concurrently and takes the internal lock."""
+
+    def __init__(self, cluster, config):
+        self.cluster = cluster
+        self.cfg = config
+        self._lock = threading.Lock()
+        self._ticks = 0
+        self._scores: dict[int, float] = {}  # region id -> EWMA score
+        self._raw: dict[int, dict] = {}  # region id -> last raw components
+        self._prev_rows: dict[int, int] = {}  # region id -> last seen num_rows
+        self._dwell: dict[tuple, int] = {}  # condition key -> consecutive ticks
+        self._cooldown: dict[tuple, int] = {}  # (db, table) -> ticks left
+        self._last_decision: dict[tuple, str] = {}  # (db, table) -> summary
+        self._rec_cursor = RECORDER.cursor()
+        self.decisions: deque = deque(maxlen=256)  # enacted + failed, for tests
+
+    # ---- the tick ---------------------------------------------------------
+    def tick(self) -> list[dict]:
+        """One balancing round; returns the decisions enacted (at most one)
+        plus any that failed.  Never raises: a broken decision is recorded
+        and re-proposed by a later tick, the supervisor loop survives."""
+        if not self.cfg.enabled:
+            return []
+        with self._lock:
+            self._ticks += 1
+            for key in [k for k, v in self._cooldown.items() if v > 0]:
+                self._cooldown[key] -= 1
+            tables = self._observe()
+            candidates = self._detect(tables)
+            return self._admit_and_enact(tables, candidates)
+
+    # ---- observe: stats -> EWMA scores ------------------------------------
+    def _observe(self) -> dict[tuple, dict]:
+        """Fold heartbeat stats + flight-recorder costs into per-region EWMA
+        scores; returns {(db, table): {"meta":, "routes":, "scores": {rid: s}}}."""
+        cfg = self.cfg
+        metasrv = self.cluster.metasrv
+        # Heartbeat RegionStats, leader view: a region's write load is what
+        # its route leader reported last round (followers echo the same
+        # rows at a lag; counting them would double the score).
+        stats_by_node: dict[int, dict[int, dict]] = {}
+        for node_id, info in metasrv.datanodes.items():
+            for s in info.last_stats or []:
+                stats_by_node.setdefault(node_id, {})[int(s["region_id"])] = s
+        # Flight-recorder device costs since the last tick, per region.
+        dispatch_ms: dict[int, float] = {}
+        for rec in RECORDER.since(self._rec_cursor):
+            for leg in rec.regions:
+                rid, _mode, build_ms = int(leg[0]), leg[1], float(leg[2])
+                dispatch_ms[rid] = dispatch_ms.get(rid, 0.0) + build_ms
+        self._rec_cursor = RECORDER.cursor()
+
+        tables: dict[tuple, dict] = {}
+        live_rids: set[int] = set()
+        for db in self.cluster.catalog.databases():
+            for meta in self.cluster.catalog.tables(db):
+                routes = metasrv.get_route(meta.table_id)
+                scores: dict[int, float] = {}
+                for rid in meta.region_ids:
+                    live_rids.add(rid)
+                    node = routes.get(rid)
+                    stat = stats_by_node.get(node, {}).get(rid, {})
+                    rows = int(stat.get("num_rows", 0))
+                    prev = self._prev_rows.get(rid)
+                    self._prev_rows[rid] = rows
+                    # first sighting scores 0: pre-existing rows are not load
+                    rows_delta = max(0, rows - prev) if prev is not None else 0
+                    memtable_mb = float(stat.get("memtable_bytes", 0)) / (1 << 20)
+                    raw = (
+                        cfg.write_weight * rows_delta
+                        + cfg.memtable_mb_weight * memtable_mb
+                        + cfg.dispatch_ms_weight * dispatch_ms.get(rid, 0.0)
+                    )
+                    ewma = (
+                        cfg.ewma_alpha * raw
+                        + (1.0 - cfg.ewma_alpha) * self._scores.get(rid, 0.0)
+                    )
+                    self._scores[rid] = ewma
+                    scores[rid] = ewma
+                    self._raw[rid] = {
+                        "rows_delta": rows_delta,
+                        "memtable_mb": round(memtable_mb, 3),
+                        "dispatch_ms": round(dispatch_ms.get(rid, 0.0), 3),
+                        "node": node,
+                    }
+                tables[(db, meta.name)] = {
+                    "meta": meta,
+                    "routes": routes,
+                    "scores": scores,
+                }
+        # regions dropped by a past repartition must not leak score state
+        for stale in set(self._scores) - live_rids:
+            self._scores.pop(stale, None)
+            self._raw.pop(stale, None)
+            self._prev_rows.pop(stale, None)
+        return tables
+
+    # ---- detect: scores -> candidate conditions ---------------------------
+    def _detect(self, tables: dict[tuple, dict]) -> list[dict]:
+        """Evaluate the decision ladder; returns candidate decisions (the
+        dwell counters advance here, enactment gating happens later)."""
+        cfg = self.cfg
+        candidates: list[dict] = []
+
+        # 1. overloaded datanode -> migrate its hottest region away
+        alive = {
+            nid for nid, info in self.cluster.metasrv.datanodes.items() if info.alive
+        }
+        node_scores = {nid: 0.0 for nid in alive}
+        node_regions: dict[int, list[tuple[float, int, tuple]]] = {}
+        for tkey, t in tables.items():
+            for rid, score in t["scores"].items():
+                node = t["routes"].get(rid)
+                if node in node_scores:
+                    node_scores[node] += score
+                    node_regions.setdefault(node, []).append((score, rid, tkey))
+        if len(alive) >= 2 and node_scores:
+            ordered = sorted(node_scores.values())
+            median = ordered[len(ordered) // 2]
+            hot_node = max(node_scores, key=node_scores.get)
+            overloaded = (
+                node_scores[hot_node] >= cfg.split_hot_score
+                and node_scores[hot_node] > cfg.migrate_ratio * median
+                and node_regions.get(hot_node)
+            )
+            if overloaded:
+                score, rid, tkey = max(node_regions[hot_node])
+                target = min(
+                    (n for n in alive if n != hot_node), key=lambda n: node_scores[n]
+                )
+                # The move must actually lower the peak: post-move the target
+                # carries its load PLUS the region.  A node hot because of one
+                # single hot region would just ping-pong it (the new holder
+                # becomes exactly as overloaded) — that heat is a SPLIT's to
+                # fix, so the migrate rung stands aside for it.
+                improves = node_scores[target] + score < node_scores[hot_node]
+                if improves:
+                    candidates.append(
+                        {
+                            "kind": MIGRATE,
+                            "key": (MIGRATE, hot_node),
+                            "table_key": tkey,
+                            "region_id": rid,
+                            "from_node": hot_node,
+                            "to_node": target,
+                            "score": score,
+                        }
+                    )
+
+        # 2/3. per-table: split heat, merge cold
+        for tkey, t in tables.items():
+            scores = t["scores"]
+            if not scores:
+                continue
+            n = len(scores)
+            smax = max(scores.values())
+            mean = sum(scores.values()) / n
+            split_to = min(n * 2, cfg.max_regions_per_table)
+            hot = (
+                split_to > n
+                and smax >= cfg.split_hot_score
+                and (n == 1 or smax >= cfg.split_hot_ratio * mean)
+            )
+            if hot and self._partition_columns(t["meta"]):
+                candidates.append(
+                    {
+                        "kind": SPLIT,
+                        "key": (SPLIT, tkey),
+                        "table_key": tkey,
+                        "to_partitions": split_to,
+                        "score": smax,
+                    }
+                )
+            cold = n > 1 and smax < cfg.merge_cold_score
+            if cold:
+                candidates.append(
+                    {
+                        "kind": MERGE,
+                        "key": (MERGE, tkey),
+                        "table_key": tkey,
+                        "to_partitions": max(1, n // 2),
+                        "score": smax,
+                    }
+                )
+
+        # dwell accounting: conditions persist or reset
+        seen = {c["key"] for c in candidates}
+        for key in [k for k in self._dwell if k not in seen]:
+            del self._dwell[key]
+        for c in candidates:
+            self._dwell[c["key"]] = self._dwell.get(c["key"], 0) + 1
+            c["dwell"] = self._dwell[c["key"]]
+        return candidates
+
+    # ---- admit + enact ----------------------------------------------------
+    def _admit_and_enact(self, tables: dict, candidates: list[dict]) -> list[dict]:
+        cfg = self.cfg
+        prio = {MIGRATE: 0, SPLIT: 1, MERGE: 2}
+        actionable = []
+        for c in sorted(candidates, key=lambda c: (prio[c["kind"]], -c["score"])):
+            if c["dwell"] < cfg.min_dwell_ticks:
+                metrics.BALANCE_SKIPPED_HYSTERESIS_TOTAL.inc()
+                continue
+            if self._cooldown.get(c["table_key"], 0) > 0:
+                metrics.BALANCE_SKIPPED_HYSTERESIS_TOTAL.inc()
+                continue
+            if self._locked(tables[c["table_key"]]["meta"]):
+                metrics.BALANCE_SKIPPED_HYSTERESIS_TOTAL.inc()
+                continue
+            actionable.append(c)
+        if not actionable:
+            return []
+        # one decision per tick: the highest-priority hottest admissible one
+        enacted = self._enact(tables, actionable[0])
+        for c in actionable[1:]:
+            metrics.BALANCE_SKIPPED_HYSTERESIS_TOTAL.inc()
+        return [enacted]
+
+    def _enact(self, tables: dict, c: dict) -> dict:
+        db, table = c["table_key"]
+        kind = c["kind"]
+        record = {
+            "tick": self._ticks,
+            "kind": kind,
+            "database": db,
+            "table": table,
+            "score": round(c["score"], 3),
+            "ok": False,
+        }
+        try:
+            with tracing.span(
+                "balance.decide",
+                decision=kind,
+                table=f"{db}.{table}",
+                score=round(c["score"], 3),
+                dwell=c["dwell"],
+            ):
+                fault_injection.fire(
+                    "balance.decide", decision=kind, table=table, **{
+                        k: c[k] for k in ("region_id", "to_node", "to_partitions")
+                        if k in c
+                    },
+                )
+                metrics.BALANCE_DECISIONS_TOTAL.inc(decision=kind)
+                if kind == MIGRATE:
+                    record["region_id"] = c["region_id"]
+                    record["from_node"] = c["from_node"]
+                    record["to_node"] = c["to_node"]
+                    self.cluster.migrate_region(
+                        table, c["region_id"], c["to_node"], database=db
+                    )
+                    metrics.BALANCE_MIGRATIONS_TOTAL.inc()
+                    summary = (
+                        f"migrate r{c['region_id']} "
+                        f"{c['from_node']}->{c['to_node']}@t{self._ticks}"
+                    )
+                else:
+                    meta = tables[c["table_key"]]["meta"]
+                    rule = self._rule_for(meta, c["to_partitions"])
+                    record["to_partitions"] = c["to_partitions"]
+                    self.cluster.repartition_table(table, rule, database=db)
+                    if kind == SPLIT:
+                        metrics.BALANCE_SPLITS_TOTAL.inc()
+                    else:
+                        metrics.BALANCE_MERGES_TOTAL.inc()
+                    summary = f"{kind}->{c['to_partitions']}@t{self._ticks}"
+            record["ok"] = True
+        except Exception as exc:  # noqa: BLE001 — a failed decision must
+            # not break the supervisor loop; the condition re-dwells and a
+            # later tick retries (routes are untouched: the fault point
+            # fires before submission, and a failed procedure rolled back)
+            summary = f"{kind} failed: {type(exc).__name__}@t{self._ticks}"
+            record["error"] = f"{type(exc).__name__}: {exc}"
+        self._last_decision[c["table_key"]] = summary
+        self._cooldown[c["table_key"]] = self.cfg.cooldown_ticks
+        del self._dwell[c["key"]]
+        self.decisions.append(record)
+        return record
+
+    # ---- helpers ----------------------------------------------------------
+    def _partition_columns(self, meta) -> list[str]:
+        rule = meta.partition_rule
+        cols = list(getattr(rule, "columns", []) or [])
+        if not cols:
+            cols = meta.schema.primary_key()
+        return cols
+
+    def _rule_for(self, meta, n: int):
+        if n <= 1:
+            return SingleRegionRule()
+        return HashPartitionRule(columns=self._partition_columns(meta), n=n)
+
+    def _locked(self, meta) -> bool:
+        """A region procedure in flight (failover, migration, another
+        repartition) vetoes a new decision on the same table."""
+        managers = [self.cluster.procedures, self.cluster.metasrv.procedures]
+        for rid in meta.region_ids:
+            if any(m.lock_held(f"region/{rid}") for m in managers):
+                return True
+        return any(
+            m.lock_held(f"table/{meta.database}/{meta.name}") for m in managers
+        )
+
+    # ---- introspection (information_schema.region_balance) ----------------
+    def state(self) -> list[dict]:
+        """Per-region balancer view: score, raw components, dwell of the
+        hottest condition touching the region's table, last decision.
+        Empty while disabled — a balancer that reads no stats has no view
+        (information_schema.region_balance mirrors this)."""
+        if not self.cfg.enabled:
+            return []
+        with self._lock:
+            rows = []
+            for db in self.cluster.catalog.databases():
+                for meta in self.cluster.catalog.tables(db):
+                    tkey = (db, meta.name)
+                    for rid in meta.region_ids:
+                        raw = self._raw.get(rid, {})
+                        node = raw.get("node")
+                        dwell = max(
+                            self._dwell.get((SPLIT, tkey), 0),
+                            self._dwell.get((MERGE, tkey), 0),
+                            self._dwell.get((MIGRATE, node), 0)
+                            if node is not None
+                            else 0,
+                        )
+                        rows.append(
+                            {
+                                "region_id": rid,
+                                "table_name": meta.name,
+                                "database": db,
+                                "node_id": raw.get("node"),
+                                "score": round(self._scores.get(rid, 0.0), 3),
+                                "rows_delta": raw.get("rows_delta", 0),
+                                "memtable_mb": raw.get("memtable_mb", 0.0),
+                                "dispatch_ms": raw.get("dispatch_ms", 0.0),
+                                "dwell": dwell,
+                                "last_decision": self._last_decision.get(tkey, ""),
+                            }
+                        )
+            return rows

@@ -180,9 +180,11 @@ class Cluster:
         clock=None,
         transport: str = "inprocess",
         target_followers: int = 0,
+        config: Config | None = None,
     ):
         self.data_home = data_home
         self.clock = clock or (lambda: _time.time() * 1000)
+        self.config = config or Config()
         self.kv = MemoryKvBackend()
         self.catalog = Catalog(os.path.join(data_home, "catalog.json"))
         self.transport = transport
@@ -200,7 +202,13 @@ class Cluster:
             clock_ms=self.clock,
         )
         for i, dn in self.datanodes.items():
-            self.metasrv.register_datanode(i)
+            # Flight datanodes register their socket address so an external
+            # Frontend can discover peers through a MetasrvServer on top of
+            # this cluster (the elastic sqlness/chaos harness).
+            addr = getattr(dn, "location", None)
+            self.metasrv.register_datanode(
+                i, addr.removeprefix("grpc://") if addr else None
+            )
             if hasattr(dn, "_clock"):
                 dn._clock = self.clock
         from .procedure import ProcedureManager
@@ -227,14 +235,19 @@ class Cluster:
         self._write_locks_guard = threading.Lock()
         self.current_database = "public"
         self.query_engine = QueryEngine(
-            schema_provider=lambda t, d: self.catalog.table(t, d).schema,
+            schema_provider=self._schema_of,
             scan_provider=self._scan,
             region_scan_provider=self._region_scan,
             time_bounds_provider=self._time_bounds,
-            config=Config().query,
+            config=self.config.query,
             partial_agg_provider=self._partial_agg,
             subplan_provider=self._sub_plan,
         )
+        from .balancer import LoadBalancer
+
+        # Elastic balancer: default OFF (balance.enabled=false makes
+        # tick() a no-op, bit-for-bit the pre-balancer cluster).
+        self.balancer = LoadBalancer(self, self.config.balance)
 
     # ---- DDL (frontend -> metasrv placement -> datanodes) -----------------
     def create_table(self, name: str, schema: Schema, partitions: int = 1, database: str = "public"):
@@ -343,7 +356,20 @@ class Cluster:
         with ThreadPoolExecutor(max_workers=min(len(region_ids), 8)) as pool:
             return list(pool.map(fn, region_ids))
 
+    def _schema_of(self, table: str, database: str) -> Schema:
+        from ..models import information_schema as info
+
+        if info.is_information_schema(database):
+            return info.schema_of(self, table)
+        return self.catalog.table(table, database).schema
+
     def _region_scan(self, scan: TableScan) -> list[pa.Table]:
+        from ..models import information_schema as info
+
+        if info.is_information_schema(scan.database):
+            # cluster-side system tables (region_balance reads the live
+            # balancer; catalog-backed views read the shared catalog)
+            return [info.build(self, scan.table)]
         meta = self.catalog.table(scan.table, scan.database)
         routes = self.metasrv.get_route(meta.table_id)
         pred = self._pred(scan)
@@ -351,10 +377,22 @@ class Cluster:
             meta.region_ids, lambda rid: self.datanodes[routes[rid]].scan(rid, pred)
         )
 
+    def _info_schema_table(self, scan: TableScan) -> pa.Table:
+        from ..models import information_schema as info
+        from ..storage.sst import _apply_residual
+
+        return _apply_residual(info.build(self, scan.table), self._pred(scan), None)
+
     def _partial_agg(self, scan: TableScan, spec_dict: dict) -> list[pa.Table]:
         """Lower/state stage fan-out: each region's datanode aggregates
         locally and returns [groups]-sized states (reference MergeScan
         do_get per region, merge_scan.rs:250-330)."""
+        from ..models import information_schema as info
+
+        if info.is_information_schema(scan.database):
+            from ..query.dist_agg import AggSpec, partial_states
+
+            return [partial_states(self._info_schema_table(scan), AggSpec.from_dict(spec_dict))]
         meta = self.catalog.table(scan.table, scan.database)
         routes = self.metasrv.get_route(meta.table_id)
         pred = self._pred(scan)
@@ -367,6 +405,24 @@ class Cluster:
         """Fan a serialized sub-plan out to every region's datanode
         (reference MergeScan do_get per region with substrait bytes,
         merge_scan.rs:250); each returns BOUNDED rows."""
+        from ..models import information_schema as info
+
+        if info.is_information_schema(scan.database):
+            # virtual tables live on the frontend/metasrv side: run the
+            # shipped sub-plan over the built table, same as a datanode
+            # would over its region scan (flight.execute_region_plan)
+            from ..query.cpu_exec import CpuExecutor
+            from ..query.plan_wire import plan_from_dict
+
+            plan = plan_from_dict(plan_dict)
+
+            def provider(s):
+                t = self._info_schema_table(s)
+                if s.projection:
+                    t = t.select([c for c in s.projection if c in t.column_names])
+                return t
+
+            return [CpuExecutor(provider).execute(plan)]
         meta = self.catalog.table(scan.table, scan.database)
         routes = self.metasrv.get_route(meta.table_id)
         return self._fanout(
@@ -375,6 +431,10 @@ class Cluster:
         )
 
     def _scan(self, scan: TableScan) -> pa.Table:
+        from ..models import information_schema as info
+
+        if info.is_information_schema(scan.database):
+            return info.build(self, scan.table)
         tables = [t for t in self._region_scan(scan) if t.num_rows]
         meta = self.catalog.table(scan.table, scan.database)
         if not tables:
@@ -407,7 +467,11 @@ class Cluster:
         now = self.clock()
         for node_id, dn in self.datanodes.items():
             if dn.alive:
-                reply = self.metasrv.handle_heartbeat(node_id, dn.region_stats(), now)
+                addr = getattr(dn, "location", None)
+                reply = self.metasrv.handle_heartbeat(
+                    node_id, dn.region_stats(), now,
+                    addr=addr.removeprefix("grpc://") if addr else None,
+                )
                 if hasattr(dn, "alive_keeper"):
                     dn.alive_keeper.renew(
                         reply["lease_regions"], reply["lease_until_ms"]
@@ -429,7 +493,17 @@ class Cluster:
             dn.close_region(instr["region_id"])
 
     def supervise(self):
-        return self.metasrv.tick(self.clock())
+        out = self.metasrv.tick(self.clock())
+        # Balancer rides the supervisor cadence: failover scanning first
+        # (a dead node's regions must move before load shaping), then at
+        # most one elastic decision.  No-op while balance.enabled=false.
+        self.balancer.tick()
+        return out
+
+    def balance_tick(self):
+        """One explicit balancer round (tests drive this directly when
+        they want balancing without the failover supervisor)."""
+        return self.balancer.tick()
 
     def gc_round(self, grace_ms: float = 60_000.0) -> list[str]:
         """Cross-node SST GC: gather every live datanode's file refs,

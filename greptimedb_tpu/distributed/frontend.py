@@ -88,6 +88,15 @@ def _maybe_span(name: str, parent, **attrs):
     return tracing.span(name, parent=parent, **attrs)
 
 
+class _MetaChangedError(RetryLaterError):
+    """A retry discovered the table's region set changed underneath the
+    in-flight request (a repartition swapped the partition generation).
+    Subclasses RetryLaterError so anywhere it escapes uncaught it keeps
+    the retryable SQL contract; `write_batch` and the read providers
+    catch it specifically to re-run against the FRESH meta instead of
+    bubbling a retryable error for work the frontend can finish itself."""
+
+
 class Frontend:
     """Distributed SQL front door over remote datanodes."""
 
@@ -349,6 +358,24 @@ class Frontend:
                 "retry", region=rid, attempt=attempt_no,
                 error=f"{type(exc).__name__}: {exc}"[:200],
             )
+            if write:
+                # A write retry racing a repartition must not burn the rest
+                # of the budget against the fenced (read-only) or already-
+                # dropped old region: re-check the catalog once per retry —
+                # fence up -> surface RetryLaterError NOW for the client's
+                # coarse retry; region set swapped -> _MetaChangedError so
+                # write_batch re-splits the batch through the new rule.
+                self.catalog.reload()
+                fresh = self.catalog.table(meta.name, meta.database)
+                if fresh.options.get("repartitioning"):
+                    raise RetryLaterError(
+                        f"table {meta.name!r} is repartitioning; retry the write"
+                    ) from exc
+                if fresh.region_ids != meta.region_ids:
+                    raise _MetaChangedError(
+                        f"table {meta.name!r} repartitioned mid-write "
+                        f"(region {rid} superseded); re-splitting"
+                    ) from exc
 
         try:
             return self.retry_policy.call(attempt, on_retry=on_retry)
@@ -703,7 +730,34 @@ class Frontend:
         """Per-region fan-out over Flight DoPut (reference Inserter).  Each
         region write runs under the retry policy with route refresh, so a
         write in flight when its datanode dies lands on the failed-over
-        replica once the metasrv moves the route."""
+        replica once the metasrv moves the route.  A repartition racing the
+        write is absorbed here: an active fence surfaces as RetryLaterError
+        without burning the per-region retry budget, and a completed swap
+        re-splits the WHOLE batch through the new rule — safe because
+        region writes are last-write-wins upserts on (primary key, ts), so
+        replaying rows that landed pre-swap (and were copied) dedups."""
+        for _ in range(3):
+            if meta.options.get("repartitioning"):
+                # confirm against the shared catalog before shedding: this
+                # meta may be a stale cache of an already-popped fence
+                self.catalog.reload()
+                meta = self.catalog.table(meta.name, meta.database)
+                if meta.options.get("repartitioning"):
+                    raise RetryLaterError(
+                        f"table {meta.name!r} is repartitioning; retry the write"
+                    )
+            try:
+                return self._write_batch_once(meta, batch)
+            except _MetaChangedError:
+                self.catalog.reload()
+                meta = self.catalog.table(meta.name, meta.database)
+                tracing.add_event(
+                    "write.meta_refresh", table=meta.name,
+                    regions=len(meta.region_ids),
+                )
+        return self._write_batch_once(meta, batch)
+
+    def _write_batch_once(self, meta, batch: pa.RecordBatch) -> int:
         routes = self.meta.get_route(meta.table_id)
         table = pa.Table.from_batches([batch])
         affected = 0
@@ -1149,19 +1203,54 @@ class Frontend:
             give_up(failed, last_exc)
         return results
 
+    def _with_fresh_meta(self, table: str, database: str | None, run):
+        """Run `run(meta)` with repartition-staleness recovery: when every
+        retry under it failed (RetryLaterError) and a catalog reload shows
+        the table's region set CHANGED — a repartition swapped generations
+        and dropped the old regions this meta still names — re-run against
+        the fresh meta instead of surfacing a retryable error for a query
+        the frontend can answer.  Route refresh alone cannot absorb a
+        repartition for reads: the region IDS change, not just their
+        placement.  Unchanged region set = a real outage: re-raise."""
+        meta = self._table(table, database)
+        for _ in range(3):
+            try:
+                return run(meta)
+            except RetryLaterError:
+                self.catalog.reload()
+                fresh = self._table(table, database)
+                if fresh.region_ids == meta.region_ids:
+                    raise
+                tracing.add_event(
+                    "read.meta_refresh", table=table,
+                    regions=len(fresh.region_ids),
+                )
+                meta = fresh
+        return run(meta)
+
     def _region_scan(self, scan: TableScan) -> list[pa.Table]:
-        meta = self._table(scan.table, scan.database)
         pred = self._pred(scan)
-        return self._fanout(meta, lambda c, rid: c.scan(rid, pred))
+        return self._with_fresh_meta(
+            scan.table, scan.database,
+            lambda meta: self._fanout(meta, lambda c, rid: c.scan(rid, pred)),
+        )
 
     def _partial_agg(self, scan: TableScan, spec_dict: dict) -> list[pa.Table]:
-        meta = self._table(scan.table, scan.database)
         pred = self._pred(scan)
-        return self._fanout(meta, lambda c, rid: c.partial_agg(rid, pred, spec_dict))
+        return self._with_fresh_meta(
+            scan.table, scan.database,
+            lambda meta: self._fanout(
+                meta, lambda c, rid: c.partial_agg(rid, pred, spec_dict)
+            ),
+        )
 
     def _sub_plan(self, scan: TableScan, plan_dict: dict) -> list[pa.Table]:
-        meta = self._table(scan.table, scan.database)
-        return self._fanout(meta, lambda c, rid: c.execute_plan(rid, plan_dict))
+        return self._with_fresh_meta(
+            scan.table, scan.database,
+            lambda meta: self._fanout(
+                meta, lambda c, rid: c.execute_plan(rid, plan_dict)
+            ),
+        )
 
     def _scan(self, scan: TableScan) -> pa.Table:
         if not scan.table:
@@ -1173,18 +1262,20 @@ class Frontend:
         return pa.concat_tables(tables, promote_options="permissive")
 
     def _time_bounds(self, table: str, database: str):
-        meta = self._table(table, database)
-        routes = self.meta.get_route(meta.table_id)
-        lo = hi = None
-        for rid in meta.region_ids:
-            b = self._call_region(
-                meta, rid, lambda c, r: c.time_bounds(r), routes=routes
-            )
-            if b is None:
-                continue
-            lo = b[0] if lo is None else min(lo, b[0])
-            hi = b[1] if hi is None else max(hi, b[1])
-        return (lo or 0, hi or 0)
+        def run(meta):
+            routes = self.meta.get_route(meta.table_id)
+            lo = hi = None
+            for rid in meta.region_ids:
+                b = self._call_region(
+                    meta, rid, lambda c, r: c.time_bounds(r), routes=routes
+                )
+                if b is None:
+                    continue
+                lo = b[0] if lo is None else min(lo, b[0])
+                hi = b[1] if hi is None else max(hi, b[1])
+            return (lo or 0, hi or 0)
+
+        return self._with_fresh_meta(table, database, run)
 
     # ---- liveness ----------------------------------------------------------
     def heartbeat(self):

@@ -247,6 +247,15 @@ class RegionMigrationProcedure(Procedure):
             self.state["step"] = "update_metadata"
             return EXECUTING
         if step == "update_metadata":
+            # the point of no return: a failure injected here (a "torn
+            # migration") must roll back to the old leader — the route
+            # never moves, the candidate closes, writes resume on from_node
+            fault_injection.fire(
+                "migration.swap",
+                region_id=rid,
+                from_node=self.state["from_node"],
+                to_node=self.state["to_node"],
+            )
             metasrv.update_route(self.state["table_id"], rid, self.state["to_node"])
             self.state["step"] = "close_downgraded"
             return EXECUTING

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from ..models.catalog import MAX_REGIONS_PER_TABLE, region_id
 from ..models.partition import PartitionRule
+from ..utils import fault_injection
 from ..utils.errors import IllegalStateError, InvalidArgumentsError
 from .procedure import DONE, EXECUTING, Procedure
 
@@ -78,6 +79,20 @@ class RepartitionProcedure(Procedure):
         with cluster.table_write_lock(self.state["database"], self.state["table"]):
             meta.options["repartitioning"] = True
             cluster.catalog.update_table(meta)
+        # Quiesce the old regions at the DATANODES too: the catalog fence
+        # only guards writers that consult this catalog before writing (the
+        # in-process insert path); an external Frontend racing the copy over
+        # Flight holds a pre-fence route and would land rows the copy never
+        # sees.  Read-only old regions turn that write into a
+        # RegionReadonlyError -> transient -> the frontend retries, re-checks
+        # the fence, and surfaces RetryLaterError — zero lost acked writes.
+        # Idempotent on crash-resume; reads (and the copy scan) still serve.
+        for rid_s, node in self.state["old_routes"].items():
+            dn = cluster.datanodes.get(int(node))
+            if dn is not None and getattr(dn, "alive", True):
+                cluster.metasrv.node_manager.set_region_writable(
+                    int(node), int(rid_s), False
+                )
         self.state["step"] = "create_staging"
         return EXECUTING
 
@@ -118,6 +133,9 @@ class RepartitionProcedure(Procedure):
             for i in range(new_rule.num_partitions())
         ]
         for old_rid_s, node in self.state["old_routes"].items():
+            fault_injection.fire(
+                "repartition.copy", table=self.state["table"], region=int(old_rid_s)
+            )
             table = cluster.datanodes[int(node)].scan(int(old_rid_s), ScanPredicate())
             if table.num_rows == 0:
                 continue
@@ -178,6 +196,16 @@ class RepartitionProcedure(Procedure):
                 if dn is not None and getattr(dn, "alive", True):
                     try:
                         dn.engine.drop_region(int(rid_s))
+                    except Exception:
+                        pass
+            # un-quiesce: the old regions stay authoritative, so writes
+            # must flow again (best-effort per node; a dead node's regions
+            # are failover's problem, not rollback's)
+            for rid_s, node in (self.state.get("old_routes") or {}).items():
+                dn = cluster.datanodes.get(int(node))
+                if dn is not None and getattr(dn, "alive", True):
+                    try:
+                        dn.set_region_writable(int(rid_s), True)
                     except Exception:
                         pass
             if meta.options.pop("repartitioning", None):

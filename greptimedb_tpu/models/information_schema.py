@@ -399,10 +399,53 @@ def _device_memory_table(mem_rows: list) -> pa.Table:
     })
 
 
+def _region_balance(db) -> pa.Table:
+    """information_schema.region_balance: the elastic balancer's live
+    view — per-region EWMA load score, its raw inputs (rows/s delta,
+    memtable MB, recorder-attributed dispatch ms), hysteresis dwell and
+    the table's last enacted decision.  Empty in standalone mode (no
+    balancer) and when `balance.enabled` is off (the balancer reads no
+    stats, so it has no scores to show)."""
+    balancer = getattr(db, "balancer", None)
+    return _region_balance_table(balancer.state() if balancer is not None else [])
+
+
+def _region_balance_table(state_rows: list) -> pa.Table:
+    rows = {
+        "region_id": [], "table_schema": [], "table_name": [], "node_id": [],
+        "score": [], "rows_delta": [], "memtable_mb": [], "dispatch_ms": [],
+        "dwell": [], "last_decision": [],
+    }
+    for r in state_rows:
+        rows["region_id"].append(r["region_id"])
+        rows["table_schema"].append(r["database"])
+        rows["table_name"].append(r["table_name"])
+        rows["node_id"].append(r["node_id"])
+        rows["score"].append(round(r["score"], 3))
+        rows["rows_delta"].append(r["rows_delta"])
+        rows["memtable_mb"].append(round(r["memtable_mb"], 3))
+        rows["dispatch_ms"].append(round(r["dispatch_ms"], 3))
+        rows["dwell"].append(r["dwell"])
+        rows["last_decision"].append(r["last_decision"] or "")
+    return pa.table({
+        "region_id": pa.array(rows["region_id"], pa.int64()),
+        "table_schema": pa.array(rows["table_schema"], pa.string()),
+        "table_name": pa.array(rows["table_name"], pa.string()),
+        "node_id": pa.array(rows["node_id"], pa.int64()),
+        "score": pa.array(rows["score"], pa.float64()),
+        "rows_delta": pa.array(rows["rows_delta"], pa.int64()),
+        "memtable_mb": pa.array(rows["memtable_mb"], pa.float64()),
+        "dispatch_ms": pa.array(rows["dispatch_ms"], pa.float64()),
+        "dwell": pa.array(rows["dwell"], pa.int64()),
+        "last_decision": pa.array(rows["last_decision"], pa.string()),
+    })
+
+
 _TABLES = {
     "tables": _tables,
     "columns": _columns,
     "region_statistics": _region_statistics,
+    "region_balance": _region_balance,
     "region_peers": _region_peers,
     "engines": _engines,
     "cluster_info": _cluster_info,
@@ -422,6 +465,7 @@ _TABLES = {
 # ring / walking the tile cache under its lock.  Must construct with the
 # exact column set + types of the live builders (the goldens pin both).
 _EMPTY_TABLES = {
+    "region_balance": lambda: _region_balance_table([]),
     "tile_cache_entries": lambda: _tce_table(_tce_rows()),
     "device_dispatches": lambda: _dispatch_table([]),
     "device_memory": lambda: _device_memory_table([]),

@@ -626,6 +626,47 @@ class MemoryConfig:
 
 
 @dataclasses.dataclass
+class BalanceConfig:
+    """Elastic balancer (distributed/balancer.py): load-driven region
+    split/merge/migration driven from heartbeat RegionStats + flight-
+    recorder dispatch costs.  Default OFF — with `enabled=false` the
+    balancer tick is a no-op and the cluster behaves bit-for-bit as
+    before this knob existed."""
+
+    enabled: bool = False
+    # EWMA smoothing factor for per-region load scores (1.0 = raw last
+    # observation, no smoothing).
+    ewma_alpha: float = 0.3
+    # Consecutive ticks a condition (hot region / cold table / overloaded
+    # node) must persist before the balancer acts — a one-tick burst can
+    # never trigger a split/merge/migration.
+    min_dwell_ticks: int = 3
+    # Ticks a table rests after any decision before the balancer will
+    # touch it again (anti-flap: a split must settle before a merge of
+    # the same table can even start dwelling).
+    cooldown_ticks: int = 5
+    # A region is HOT when its EWMA score exceeds this absolute floor AND
+    # split_hot_ratio x the mean score of its siblings.
+    split_hot_score: float = 512.0
+    split_hot_ratio: float = 2.0
+    # A table is COLD when every region's EWMA score is below this; cold
+    # multi-region tables merge down to half the partitions.
+    merge_cold_score: float = 1.0
+    # A datanode is OVERLOADED when its aggregate score exceeds the fleet
+    # median by this ratio; its hottest region migrates to the least
+    # loaded live node.
+    migrate_ratio: float = 2.0
+    # Split ceiling per table (the catalog's hard cap is 1024).
+    max_regions_per_table: int = 16
+    # Score weights: rows written since the last tick, resident memtable
+    # MiB (heartbeat RegionStats), and flight-recorder device build/
+    # dispatch milliseconds attributed to the region.
+    write_weight: float = 1.0
+    memtable_mb_weight: float = 1.0
+    dispatch_ms_weight: float = 1.0
+
+
+@dataclasses.dataclass
 class Config:
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
     query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
@@ -644,6 +685,7 @@ class Config:
     tql: TqlConfig = dataclasses.field(default_factory=TqlConfig)
     trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
     recorder: RecorderConfig = dataclasses.field(default_factory=RecorderConfig)
+    balance: BalanceConfig = dataclasses.field(default_factory=BalanceConfig)
 
     def __post_init__(self):
         self.storage.__post_init__()
@@ -1004,6 +1046,62 @@ class Config:
                 "flow.max_windows_per_recompute must be >= 1 window per "
                 f"diff batch; got {fl.max_windows_per_recompute!r}"
             )
+        bal = self.balance
+        if not isinstance(bal.enabled, bool):
+            raise ConfigError(
+                "balance.enabled must be a boolean (elastic region "
+                f"split/merge/migration tick); got {bal.enabled!r}"
+            )
+        if not (0.0 < bal.ewma_alpha <= 1.0):
+            raise ConfigError(
+                "balance.ewma_alpha must be in (0, 1] — the EWMA smoothing "
+                f"factor for region load scores; got {bal.ewma_alpha!r}"
+            )
+        if bal.min_dwell_ticks < 1:
+            raise ConfigError(
+                "balance.min_dwell_ticks must be >= 1 tick — 0 would let a "
+                "single burst trigger a repartition, defeating hysteresis; "
+                f"got {bal.min_dwell_ticks!r}"
+            )
+        if bal.cooldown_ticks < 0:
+            raise ConfigError(
+                "balance.cooldown_ticks must be >= 0 ticks of post-decision "
+                f"rest per table; got {bal.cooldown_ticks!r}"
+            )
+        if bal.split_hot_score <= 0:
+            raise ConfigError(
+                "balance.split_hot_score must be > 0 — the absolute EWMA "
+                f"score floor for a hot region; got {bal.split_hot_score!r}"
+            )
+        if bal.split_hot_ratio < 1.0:
+            raise ConfigError(
+                "balance.split_hot_ratio must be >= 1 — a hot region must "
+                "be at least as loaded as its mean sibling; got "
+                f"{bal.split_hot_ratio!r}"
+            )
+        if bal.merge_cold_score < 0:
+            raise ConfigError(
+                "balance.merge_cold_score must be >= 0 (0 disables merges); "
+                f"got {bal.merge_cold_score!r}"
+            )
+        if bal.migrate_ratio < 1.0:
+            raise ConfigError(
+                "balance.migrate_ratio must be >= 1 — the overload multiple "
+                f"of the fleet median score; got {bal.migrate_ratio!r}"
+            )
+        if not (1 <= bal.max_regions_per_table <= 1024):
+            raise ConfigError(
+                "balance.max_regions_per_table must be in [1, 1024] (the "
+                f"catalog region-id space per table); got "
+                f"{bal.max_regions_per_table!r}"
+            )
+        for wname in ("write_weight", "memtable_mb_weight", "dispatch_ms_weight"):
+            w = getattr(bal, wname)
+            if not isinstance(w, (int, float)) or isinstance(w, bool) or w < 0:
+                raise ConfigError(
+                    f"balance.{wname} must be a number >= 0 (its term's "
+                    f"contribution to the region load score); got {w!r}"
+                )
 
     @classmethod
     def load(cls, path: str | None = None, env: dict[str, str] | None = None) -> "Config":

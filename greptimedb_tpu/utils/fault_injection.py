@@ -83,6 +83,25 @@ Named points wired into the codebase:
                        degrade contract: the query falls back to the
                        single-chip dispatch path and still returns the
                        correct answer (greptime_tile_mesh_degraded_total)
+    balance.decide     elastic balancer decision enactment
+                       (distributed/balancer.py), fired after hysteresis
+                       admits a decision but BEFORE the procedure is
+                       submitted (ctx: decision, table, region/node).  An
+                       injected error here must leave routes and data
+                       untouched — the decision is dropped, counted, and
+                       re-proposed on a later tick
+    repartition.copy   repartition data copy (distributed/repartition.py
+                       _step_copy_data), fired per source region before
+                       its rows are scanned into staging (ctx: table,
+                       region).  A non-transient injected error rolls the
+                       procedure back: staging is dropped, the write
+                       fence pops, old routes stay authoritative
+    migration.swap     region migration route swap (distributed/metasrv.py
+                       update_metadata step), fired immediately before
+                       the route flips to the candidate (ctx: region,
+                       from/to node).  A non-transient injected error
+                       rolls back: candidate closes, the old leader is
+                       re-enabled, the route never moves
 
 Production overhead is near zero: `fire()` is a module-level function whose
 fast path is one read of a module global (`_ARMED`) — no locks, no dict
@@ -143,6 +162,9 @@ POINTS = frozenset(
         "tql.tile",
         "recorder.emit",
         "ingest.group_commit",
+        "balance.decide",
+        "repartition.copy",
+        "migration.swap",
     }
 )
 

@@ -644,3 +644,29 @@ RECORDER_ERRORS = REGISTRY.counter(
     "Flight-recorder emit failures swallowed (recording is best-effort "
     "by contract: a recorder failure never fails the recorded query)",
 )
+
+# Elastic balancer (distributed/balancer.py): load-driven region
+# split/merge/migration decisions behind information_schema.region_balance.
+BALANCE_DECISIONS_TOTAL = REGISTRY.counter(
+    "greptime_balance_decisions_total",
+    "Balancer decisions that cleared hysteresis and were enacted "
+    "(labels: decision = split | merge | migrate)",
+)
+BALANCE_SPLITS_TOTAL = REGISTRY.counter(
+    "greptime_balance_splits_total",
+    "Hot-region splits the balancer drove through RepartitionProcedure",
+)
+BALANCE_MERGES_TOTAL = REGISTRY.counter(
+    "greptime_balance_merges_total",
+    "Cold-sibling merges the balancer drove through RepartitionProcedure",
+)
+BALANCE_MIGRATIONS_TOTAL = REGISTRY.counter(
+    "greptime_balance_migrations_total",
+    "Region migrations the balancer drove off overloaded datanodes",
+)
+BALANCE_SKIPPED_HYSTERESIS_TOTAL = REGISTRY.counter(
+    "greptime_balance_skipped_hysteresis_total",
+    "Decisions deferred by hysteresis (EWMA dwell not yet met, table "
+    "cooling down after a recent decision, or a conflicting procedure "
+    "holds the region lock)",
+)

@@ -1,0 +1,16 @@
+-- ALTER ADD COLUMN with a DEFAULT backfills reads over every region.
+CREATE TABLE dalt (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+INSERT INTO dalt VALUES ('h0', 1000, 1.0), ('h1', 1000, 2.0), ('h2', 1000, 3.0);
+
+ALTER TABLE dalt ADD COLUMN q DOUBLE DEFAULT 2.5;
+
+SELECT host, v, q FROM dalt ORDER BY host;
+
+INSERT INTO dalt VALUES ('h3', 2000, 4.0, 9.0);
+
+SELECT sum(q) AS sq, count(*) AS n FROM dalt;
+
+SELECT host, q FROM dalt WHERE q > 2.5 ORDER BY host;
+
+DROP TABLE dalt;

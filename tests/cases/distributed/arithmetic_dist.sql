@@ -1,0 +1,12 @@
+-- Arithmetic expressions and precedence over partitioned data.
+CREATE TABLE darith (host STRING, ts TIMESTAMP TIME INDEX, a DOUBLE, b DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+INSERT INTO darith VALUES ('h0', 1000, 2.0, 3.0), ('h1', 1000, 4.0, 5.0), ('h2', 2000, 6.0, 7.0);
+
+SELECT host, a + b AS s, a * b AS p, b - a AS d FROM darith ORDER BY host;
+
+SELECT host, a + b * 2 AS prec, (a + b) * 2 AS grouped FROM darith ORDER BY host;
+
+SELECT sum(a * b) AS dot, sum(a) * sum(b) AS cross FROM darith;
+
+DROP TABLE darith;

@@ -1,0 +1,12 @@
+-- CASE expressions evaluate per-region and merge cleanly over partitions.
+CREATE TABLE dcase (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+INSERT INTO dcase VALUES ('h0', 1000, 1.0), ('h1', 1000, 5.0), ('h2', 1000, 9.0), ('h3', 2000, 2.0), ('h4', 2000, 6.0), ('h5', 2000, 10.0);
+
+SELECT host, CASE WHEN v < 3.0 THEN 'low' WHEN v < 8.0 THEN 'mid' ELSE 'high' END AS band FROM dcase ORDER BY host;
+
+SELECT CASE WHEN v < 5.0 THEN 'small' ELSE 'big' END AS band, count(*) AS n FROM dcase GROUP BY band ORDER BY band;
+
+SELECT sum(CASE WHEN v > 4.0 THEN 1 ELSE 0 END) AS hot FROM dcase;
+
+DROP TABLE dcase;

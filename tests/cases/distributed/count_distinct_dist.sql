@@ -1,0 +1,13 @@
+-- COUNT(DISTINCT ...) needs exact cross-region dedup, not just summed
+-- partial counts.
+CREATE TABLE dcd (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 4;
+
+INSERT INTO dcd VALUES ('h0', 1000, 1.0), ('h1', 1000, 2.0), ('h2', 1000, 1.0), ('h3', 1000, 2.0), ('h0', 2000, 3.0), ('h1', 2000, 1.0);
+
+SELECT count(DISTINCT v) AS dv FROM dcd;
+
+SELECT count(DISTINCT host) AS dh, count(*) AS n FROM dcd;
+
+SELECT host, count(DISTINCT v) AS dv FROM dcd GROUP BY host ORDER BY host;
+
+DROP TABLE dcd;

@@ -1,0 +1,10 @@
+-- WITH-clause CTEs evaluate once over the merged distributed scan.
+CREATE TABLE dcte (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+INSERT INTO dcte VALUES ('h0', 1000, 1.0), ('h1', 1000, 2.0), ('h2', 1000, 3.0), ('h0', 2000, 4.0), ('h1', 2000, 5.0), ('h2', 2000, 6.0);
+
+WITH per_host AS (SELECT host, sum(v) AS s FROM dcte GROUP BY host) SELECT host, s FROM per_host ORDER BY host;
+
+WITH hot AS (SELECT host FROM dcte WHERE v > 4.0) SELECT count(*) AS n FROM hot;
+
+DROP TABLE dcte;

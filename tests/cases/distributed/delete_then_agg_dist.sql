@@ -1,0 +1,19 @@
+-- DELETE tombstones apply per-region; aggregates afterwards see only the
+-- surviving rows from every region.
+CREATE TABLE ddel (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+INSERT INTO ddel VALUES ('h0', 1000, 1.0), ('h1', 1000, 2.0), ('h2', 1000, 3.0), ('h0', 2000, 4.0), ('h1', 2000, 5.0), ('h2', 2000, 6.0);
+
+SELECT count(*) AS n, sum(v) AS s FROM ddel;
+
+DELETE FROM ddel WHERE v < 3.0;
+
+SELECT count(*) AS n, sum(v) AS s FROM ddel;
+
+SELECT host, v FROM ddel ORDER BY host, ts;
+
+DELETE FROM ddel WHERE host = 'h2';
+
+SELECT count(*) AS n FROM ddel;
+
+DROP TABLE ddel;

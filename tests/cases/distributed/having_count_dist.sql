@@ -1,0 +1,10 @@
+-- HAVING filters on merged aggregates, never on per-region partials.
+CREATE TABLE dhc (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 4;
+
+INSERT INTO dhc VALUES ('h0', 1000, 1.0), ('h0', 2000, 2.0), ('h0', 3000, 3.0), ('h1', 1000, 4.0), ('h1', 2000, 5.0), ('h2', 1000, 6.0);
+
+SELECT host, count(*) AS n FROM dhc GROUP BY host HAVING count(*) >= 2 ORDER BY host;
+
+SELECT host, sum(v) AS s FROM dhc GROUP BY host HAVING sum(v) > 5.0 ORDER BY host;
+
+DROP TABLE dhc;

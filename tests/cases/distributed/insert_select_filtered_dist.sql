@@ -1,0 +1,17 @@
+-- INSERT ... SELECT with a filter moves rows between two partitioned
+-- tables through the distributed read AND write paths in one statement.
+CREATE TABLE disrc (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+CREATE TABLE didst (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 2;
+
+INSERT INTO disrc VALUES ('h0', 1000, 1.0), ('h1', 1000, 5.0), ('h2', 1000, 9.0), ('h3', 2000, 3.0), ('h4', 2000, 7.0);
+
+INSERT INTO didst SELECT host, ts, v FROM disrc WHERE v > 4.0;
+
+SELECT host, v FROM didst ORDER BY host;
+
+SELECT count(*) AS n, sum(v) AS s FROM didst;
+
+DROP TABLE disrc;
+
+DROP TABLE didst;

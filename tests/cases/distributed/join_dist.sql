@@ -1,0 +1,16 @@
+-- Inner join between two partitioned tables with different region counts.
+CREATE TABLE djm (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+CREATE TABLE djd (host STRING, ts TIMESTAMP TIME INDEX, w DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 2;
+
+INSERT INTO djm VALUES ('h0', 1000, 1.0), ('h1', 1000, 2.0), ('h2', 1000, 3.0);
+
+INSERT INTO djd VALUES ('h0', 1000, 10.0), ('h2', 1000, 30.0), ('h9', 1000, 90.0);
+
+SELECT m.host, m.v, d.w FROM djm m JOIN djd d ON m.host = d.host ORDER BY m.host;
+
+SELECT count(*) AS matched FROM djm m JOIN djd d ON m.host = d.host;
+
+DROP TABLE djm;
+
+DROP TABLE djd;

@@ -1,0 +1,13 @@
+-- ORDER BY ... LIMIT/OFFSET must apply the global ordering after the
+-- per-region merge, not a per-region limit.
+CREATE TABLE dlim (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 4;
+
+INSERT INTO dlim VALUES ('h0', 1000, 9.0), ('h1', 1000, 3.0), ('h2', 1000, 7.0), ('h3', 1000, 1.0), ('h4', 1000, 5.0), ('h5', 1000, 8.0), ('h6', 1000, 2.0), ('h7', 1000, 6.0);
+
+SELECT host, v FROM dlim ORDER BY v DESC LIMIT 3;
+
+SELECT host, v FROM dlim ORDER BY v ASC LIMIT 2 OFFSET 2;
+
+SELECT host FROM dlim ORDER BY host LIMIT 4;
+
+DROP TABLE dlim;

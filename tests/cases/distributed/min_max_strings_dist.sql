@@ -1,0 +1,10 @@
+-- min/max over STRING columns merge lexicographically across regions.
+CREATE TABLE dms (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 4;
+
+INSERT INTO dms VALUES ('kiwi', 1000, 1.0), ('apple', 1000, 2.0), ('zebra', 1000, 3.0), ('mango', 2000, 4.0), ('banana', 2000, 5.0);
+
+SELECT min(host) AS lo, max(host) AS hi FROM dms;
+
+SELECT min(host) AS lo, max(host) AS hi, count(*) AS n FROM dms WHERE v > 1.5;
+
+DROP TABLE dms;

@@ -1,0 +1,14 @@
+-- NULL propagation through expressions and aggregates across regions.
+CREATE TABLE dnull (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+INSERT INTO dnull VALUES ('h0', 1000, 1.0), ('h1', 1000, NULL), ('h2', 1000, 3.0), ('h3', 2000, NULL), ('h4', 2000, 5.0);
+
+SELECT host, v, v + 1.0 AS v1 FROM dnull ORDER BY host;
+
+SELECT count(*) AS rows, count(v) AS nonnull, sum(v) AS s FROM dnull;
+
+SELECT host FROM dnull WHERE v IS NULL ORDER BY host;
+
+SELECT coalesce(v, 0.0) AS cv, count(*) AS n FROM dnull GROUP BY cv ORDER BY cv;
+
+DROP TABLE dnull;

@@ -1,0 +1,11 @@
+-- NULL ordering is part of the merge contract: NULLS FIRST/LAST must hold
+-- after combining per-region sorted streams.
+CREATE TABLE dnord (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+INSERT INTO dnord VALUES ('h0', 1000, 3.0), ('h1', 1000, NULL), ('h2', 1000, 1.0), ('h3', 2000, NULL), ('h4', 2000, 2.0);
+
+SELECT host, v FROM dnord ORDER BY v ASC NULLS FIRST, host;
+
+SELECT host, v FROM dnord ORDER BY v DESC NULLS LAST, host;
+
+DROP TABLE dnord;

@@ -1,0 +1,19 @@
+-- Zero-failed-query failover: the datanode owning a region dies between
+-- statements; phi detection promotes the region elsewhere from shared
+-- storage, and the same SELECTs/INSERTs keep rendering identically.
+CREATE TABLE rfail (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 2;
+
+INSERT INTO rfail VALUES ('h0', 1000, 1.0), ('h1', 1000, 2.0), ('h2', 2000, 3.0), ('h3', 2000, 4.0);
+
+SELECT count(*) AS n, sum(v) AS s FROM rfail;
+
+-- reconfigure: failover rfail
+SELECT count(*) AS n, sum(v) AS s FROM rfail;
+
+SELECT host, v FROM rfail WHERE v > 2.0 ORDER BY host;
+
+INSERT INTO rfail VALUES ('h4', 3000, 5.0);
+
+SELECT host, max(v) AS m FROM rfail GROUP BY host ORDER BY host;
+
+DROP TABLE rfail;

@@ -1,0 +1,16 @@
+-- Zero-failed-query merge: 4 hash regions collapse back to a single
+-- region mid-case; row set, aggregates, and later writes are unaffected.
+CREATE TABLE rmerge (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 4;
+
+INSERT INTO rmerge VALUES ('a', 1000, 10.0), ('b', 1000, 20.0), ('c', 1000, 30.0), ('d', 1000, 40.0), ('e', 2000, 50.0);
+
+SELECT count(*) AS n, min(v) AS lo, max(v) AS hi FROM rmerge;
+
+-- reconfigure: merge rmerge 1
+SELECT count(*) AS n, min(v) AS lo, max(v) AS hi FROM rmerge;
+
+INSERT INTO rmerge VALUES ('f', 3000, 60.0);
+
+SELECT host, v FROM rmerge ORDER BY host;
+
+DROP TABLE rmerge;

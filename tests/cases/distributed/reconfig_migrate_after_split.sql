@@ -1,0 +1,20 @@
+-- Stacked reconfigurations: split to 2 regions, then migrate one of the
+-- new regions to another node.  The frontend absorbs BOTH route changes
+-- mid-case with no visible difference from the standalone golden.
+CREATE TABLE rstack (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO rstack VALUES ('h0', 1000, 1.0), ('h1', 1000, 2.0), ('h2', 1000, 3.0), ('h3', 2000, 4.0);
+
+SELECT count(*) AS n FROM rstack;
+
+-- reconfigure: split rstack 2
+SELECT host, v FROM rstack ORDER BY host;
+
+-- reconfigure: migrate rstack
+SELECT count(*) AS n, sum(v) AS s, max(v) AS hi FROM rstack;
+
+INSERT INTO rstack VALUES ('h4', 3000, 5.0);
+
+SELECT host, v FROM rstack WHERE ts >= 2000 ORDER BY host;
+
+DROP TABLE rstack;

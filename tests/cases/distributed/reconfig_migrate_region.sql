@@ -1,0 +1,17 @@
+-- Zero-failed-query migration: a region leader moves to a different
+-- datanode between statements; reads and writes through the frontend
+-- (whose route cache is now stale) keep working without visible errors.
+CREATE TABLE rmig (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 2;
+
+INSERT INTO rmig VALUES ('h0', 1000, 1.5), ('h1', 1000, 2.5), ('h2', 2000, 3.5), ('h3', 2000, 4.5);
+
+SELECT host, v FROM rmig ORDER BY host;
+
+-- reconfigure: migrate rmig
+SELECT host, v FROM rmig ORDER BY host;
+
+INSERT INTO rmig VALUES ('h4', 3000, 5.5);
+
+SELECT count(*) AS n, sum(v) AS s FROM rmig;
+
+DROP TABLE rmig;

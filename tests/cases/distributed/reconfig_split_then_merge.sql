@@ -1,0 +1,22 @@
+-- A full elasticity round trip in one case: split 1 -> 3 regions, keep
+-- querying, then merge 3 -> 1; results stay byte-identical throughout
+-- and writes land in whichever topology is current.
+CREATE TABLE rcycle (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO rcycle VALUES ('a', 1000, 1.0), ('b', 1000, 2.0), ('c', 1000, 3.0);
+
+-- reconfigure: split rcycle 3
+SELECT count(*) AS n FROM rcycle;
+
+INSERT INTO rcycle VALUES ('d', 2000, 4.0), ('e', 2000, 5.0);
+
+SELECT host, v FROM rcycle ORDER BY host;
+
+-- reconfigure: merge rcycle 1
+SELECT count(*) AS n, sum(v) AS s FROM rcycle;
+
+INSERT INTO rcycle VALUES ('f', 3000, 6.0);
+
+SELECT host, v FROM rcycle ORDER BY host;
+
+DROP TABLE rcycle;

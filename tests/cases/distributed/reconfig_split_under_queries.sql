@@ -1,0 +1,22 @@
+-- Zero-failed-query split: the table starts on ONE region, a cluster-side
+-- repartition to 4 hash regions fires between statements, and every query
+-- before/after renders byte-identically to the standalone golden (the
+-- frontend's cached meta is stale across the swap and must self-heal).
+CREATE TABLE rsplit (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO rsplit VALUES ('h0', 1000, 1.0), ('h1', 1000, 2.0), ('h2', 1000, 3.0), ('h3', 1000, 4.0), ('h4', 2000, 5.0), ('h5', 2000, 6.0);
+
+SELECT count(*) AS n, sum(v) AS s FROM rsplit;
+
+-- reconfigure: split rsplit 4
+SELECT count(*) AS n, sum(v) AS s FROM rsplit;
+
+SELECT host, v FROM rsplit WHERE ts >= 2000 ORDER BY host;
+
+INSERT INTO rsplit VALUES ('h6', 3000, 7.0), ('h7', 3000, 8.0);
+
+SELECT host, avg(v) AS a FROM rsplit GROUP BY host ORDER BY host;
+
+SELECT count(*) AS n FROM rsplit;
+
+DROP TABLE rsplit;

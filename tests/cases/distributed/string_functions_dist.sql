@@ -1,0 +1,12 @@
+-- String scalar functions through the distributed plan-shipping path.
+CREATE TABLE dstr (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+INSERT INTO dstr VALUES ('web-01', 1000, 1.0), ('web-02', 1000, 2.0), ('db-01', 2000, 3.0), ('db-02', 2000, 4.0);
+
+SELECT host, upper(host) AS up, length(host) AS len FROM dstr ORDER BY host;
+
+SELECT host FROM dstr WHERE host LIKE 'web%' ORDER BY host;
+
+SELECT substr(host, 1, 2) AS kind, count(*) AS n FROM dstr GROUP BY kind ORDER BY kind;
+
+DROP TABLE dstr;

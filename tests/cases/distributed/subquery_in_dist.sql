@@ -1,0 +1,11 @@
+-- IN (subquery) over partitioned tables: the inner result set gathers
+-- from all regions before filtering the outer scan.
+CREATE TABLE dsq (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+INSERT INTO dsq VALUES ('h0', 1000, 1.0), ('h1', 1000, 5.0), ('h2', 1000, 9.0), ('h3', 2000, 2.0), ('h4', 2000, 8.0);
+
+SELECT host, v FROM dsq WHERE host IN (SELECT host FROM dsq WHERE v > 4.0) ORDER BY host;
+
+SELECT count(*) AS n FROM dsq WHERE v >= (SELECT avg(v) FROM dsq);
+
+DROP TABLE dsq;

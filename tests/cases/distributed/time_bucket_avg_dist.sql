@@ -1,0 +1,10 @@
+-- time_bucket grouping with avg merges per-region partial sums/counts.
+CREATE TABLE dtb (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+INSERT INTO dtb VALUES ('h0', 0, 1.0), ('h1', 500, 2.0), ('h2', 900, 3.0), ('h0', 1000, 4.0), ('h1', 1500, 5.0), ('h2', 2100, 6.0);
+
+SELECT time_bucket('1 second', ts) AS b, avg(v) AS a, count(*) AS n FROM dtb GROUP BY b ORDER BY b;
+
+SELECT time_bucket('2 seconds', ts) AS b, sum(v) AS s FROM dtb GROUP BY b ORDER BY b;
+
+DROP TABLE dtb;

@@ -1,0 +1,12 @@
+-- Timestamp range predicates prune and filter consistently across regions.
+CREATE TABLE dtf (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+INSERT INTO dtf VALUES ('h0', 1000, 1.0), ('h1', 2000, 2.0), ('h2', 3000, 3.0), ('h0', 4000, 4.0), ('h1', 5000, 5.0), ('h2', 6000, 6.0);
+
+SELECT host, ts, v FROM dtf WHERE ts >= 3000 AND ts < 6000 ORDER BY ts, host;
+
+SELECT count(*) AS n FROM dtf WHERE ts > 1000 AND ts <= 5000;
+
+SELECT host, min(ts) AS first_ts, max(ts) AS last_ts FROM dtf GROUP BY host ORDER BY host;
+
+DROP TABLE dtf;

@@ -1,0 +1,16 @@
+-- TRUNCATE empties every region; the table is immediately writable again.
+CREATE TABLE dtr (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+INSERT INTO dtr VALUES ('h0', 1000, 1.0), ('h1', 1000, 2.0), ('h2', 1000, 3.0), ('h3', 2000, 4.0);
+
+SELECT count(*) AS n FROM dtr;
+
+TRUNCATE TABLE dtr;
+
+SELECT count(*) AS n FROM dtr;
+
+INSERT INTO dtr VALUES ('h0', 3000, 7.0), ('h4', 3000, 8.0);
+
+SELECT host, v FROM dtr ORDER BY host;
+
+DROP TABLE dtr;

@@ -1,0 +1,16 @@
+-- UNION ALL across two partitioned tables fans out to both route sets.
+CREATE TABLE dua (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 2;
+
+CREATE TABLE dub (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+INSERT INTO dua VALUES ('a0', 1000, 1.0), ('a1', 1000, 2.0);
+
+INSERT INTO dub VALUES ('b0', 1000, 3.0), ('b1', 1000, 4.0), ('b2', 1000, 5.0);
+
+SELECT host, v FROM dua UNION ALL SELECT host, v FROM dub ORDER BY host;
+
+SELECT count(*) AS n FROM (SELECT host FROM dua UNION ALL SELECT host FROM dub);
+
+DROP TABLE dua;
+
+DROP TABLE dub;

@@ -1,0 +1,11 @@
+-- Variance/stddev partial states must merge exactly across regions
+-- (sum/sumsq/count merge, not averaged averages).
+CREATE TABLE dvar (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 4;
+
+INSERT INTO dvar VALUES ('h0', 1000, 2.0), ('h1', 1000, 4.0), ('h2', 1000, 4.0), ('h3', 1000, 4.0), ('h4', 1000, 5.0), ('h5', 1000, 5.0), ('h6', 1000, 7.0), ('h7', 1000, 9.0);
+
+SELECT var_pop(v) AS vp, stddev_pop(v) AS sp FROM dvar;
+
+SELECT avg(v) AS a, count(v) AS n FROM dvar;
+
+DROP TABLE dvar;

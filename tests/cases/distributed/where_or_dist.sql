@@ -1,0 +1,13 @@
+-- OR-of-ANDs predicates: residual filters that cannot prune by partition
+-- key must still evaluate exactly on every region.
+CREATE TABLE dwo (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host)) PARTITION BY HASH (host) PARTITIONS 3;
+
+INSERT INTO dwo VALUES ('h0', 1000, 1.0), ('h1', 2000, 2.0), ('h2', 3000, 3.0), ('h3', 4000, 4.0), ('h4', 5000, 5.0);
+
+SELECT host, v FROM dwo WHERE (v < 2.0 OR v > 4.0) ORDER BY host;
+
+SELECT host, v FROM dwo WHERE (host = 'h1' AND v > 1.0) OR (host = 'h3' AND ts >= 4000) ORDER BY host;
+
+SELECT count(*) AS n FROM dwo WHERE NOT (v BETWEEN 2.0 AND 4.0);
+
+DROP TABLE dwo;

@@ -81,7 +81,10 @@ def split_statements(text: str) -> list[str]:
     return out
 
 
-def run_case(path: str, db, outcomes: list | None = None) -> str:
+RECONFIG_PREFIX = "-- reconfigure:"
+
+
+def run_case(path: str, db, outcomes: list | None = None, hook=None) -> str:
     with open(path) as f:
         text = f.read()
     chunks = []
@@ -91,6 +94,16 @@ def run_case(path: str, db, outcomes: list | None = None) -> str:
             l for l in stmt.splitlines() if not l.strip().startswith("--")
         ).strip()
         chunks.append(stmt + ";")
+        # `-- reconfigure: <action> <table> [...]` directives fire a
+        # cluster-side reconfiguration between statements.  They live in
+        # comment lines so golden generation (hook=None) ignores them: the
+        # standalone golden is byte-identical with or without the
+        # reconfiguration, which is exactly the zero-failed-query bar.
+        if hook is not None:
+            for line in stmt.splitlines():
+                ls = line.strip()
+                if ls.startswith(RECONFIG_PREFIX):
+                    hook(ls[len(RECONFIG_PREFIX):].strip())
         if not exec_text:
             continue
         try:
@@ -182,6 +195,120 @@ def run_all(update: bool = False, backends: tuple[str, ...] = ("cpu", "tpu")) ->
     return failures
 
 
+class _ReconfigHarness:
+    """Live elastic cluster for `reconfig_*` distributed cases: in-process
+    Cluster over real Flight sockets + a MetasrvServer + an EXTERNAL
+    Frontend that executes the case SQL.  `-- reconfigure:` directives fire
+    cluster-side split/merge/migration/failover between statements while
+    the frontend keeps its (now stale) cached TableMeta — byte-equality
+    with the standalone golden proves reconfiguration never surfaces in
+    query results (the zero-failed-query contract, reference
+    RegionMigrationManager + repartition procedure docs)."""
+
+    def __init__(self, root: str):
+        from greptimedb_tpu.distributed.cluster import Cluster
+        from greptimedb_tpu.distributed.frontend import Frontend
+        from greptimedb_tpu.distributed.meta_service import MetasrvServer
+        from greptimedb_tpu.utils.retry import RetryPolicy
+
+        self.now = [1_000_000.0]
+        self.cluster = Cluster(
+            root, num_datanodes=3, clock=lambda: self.now[0], transport="flight"
+        )
+        self.server = MetasrvServer(self.cluster.metasrv).start()
+        self.frontend = Frontend(root, [self.server.address])
+        self.frontend.retry_policy = RetryPolicy(
+            max_attempts=4, base_delay_s=0.01, max_delay_s=0.05
+        )
+
+    def reconfigure(self, directive: str):
+        from greptimedb_tpu.models.partition import HashPartitionRule, SingleRegionRule
+
+        c = self.cluster
+        c.catalog.reload()  # the frontend's DDL/DML landed via the shared file
+        parts = directive.split()
+        action, table = parts[0], parts[1]
+        meta = c.catalog.table(table, "public")
+        if action in ("split", "merge"):
+            n = int(parts[2])
+            rule = (
+                HashPartitionRule(list(meta.schema.primary_key()), n)
+                if n > 1
+                else SingleRegionRule()
+            )
+            c.repartition_table(table, rule)
+        elif action == "migrate":
+            routes = c.metasrv.get_route(meta.table_id)
+            rid = meta.region_ids[0]
+            src = routes[rid]
+            dst = next(
+                nid
+                for nid, dn in sorted(c.datanodes.items())
+                if dn.alive and nid != src
+            )
+            c.migrate_region(table, rid, dst)
+        elif action == "failover":
+            routes = c.metasrv.get_route(meta.table_id)
+            victim = routes[meta.region_ids[0]]
+            # Failover replays manifest + WAL from shared storage; flush so
+            # every acked row is durable before the node dies.
+            for dn in c.datanodes.values():
+                if dn.alive:
+                    dn.engine.flush_all()
+            for _ in range(8):  # establish a heartbeat cadence so phi can trip
+                c.heartbeat_all()
+                self.now[0] += 1000.0
+            c.kill_datanode(victim)
+            for _ in range(30):
+                self.now[0] += 1000.0
+                c.heartbeat_all()  # only live nodes heartbeat
+                if c.supervise():
+                    break
+        else:
+            raise RuntimeError(f"unknown reconfigure directive: {directive!r}")
+
+    def close(self):
+        self.frontend.close()
+        self.server.stop()
+        for dn in self.cluster.datanodes.values():
+            if dn.alive:
+                dn.shutdown()
+
+
+def _run_reconfig_cases(cases: list[str], failures: list[str]):
+    """Run all reconfig cases on ONE shared elastic flight cluster — the
+    reconfigurations are per-table (each case owns its tables), so the
+    harness amortizes across cases.  Failover cases run LAST: killing a
+    datanode is the one cluster-wide mutation, so nothing may follow it."""
+    import shutil
+    import tempfile
+
+    if not cases:
+        return
+    root = tempfile.mkdtemp(prefix="sqlness_reconfig_")
+    harness = _ReconfigHarness(root)
+    try:
+        for case in sorted(cases, key=lambda p: "failover" in os.path.basename(p)):
+            name = os.path.basename(case)
+            with open(case[:-4] + ".result") as f:
+                want = f.read()
+            got = run_case(case, harness.frontend, hook=harness.reconfigure)
+            if got != want:
+                import difflib
+
+                diff = "\n".join(
+                    difflib.unified_diff(
+                        want.splitlines(), got.splitlines(),
+                        "golden[standalone-cpu]", "actual[distributed]",
+                        lineterm="",
+                    )
+                )
+                failures.append(f"{name} [distributed]:\n{diff}")
+    finally:
+        harness.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_all_distributed(update: bool = False) -> list[str]:
     """Distributed sqlness tier (reference tests/cases/distributed run
     against a bare-mode process cluster, tests/runner/src/env/bare.rs):
@@ -215,6 +342,7 @@ def run_all_distributed(update: bool = False) -> list[str]:
 
     from greptimedb_tpu.distributed.frontend import Frontend
 
+    reconfig_cases = []
     root = tempfile.mkdtemp(prefix="sqlness_dist_")
     cluster = ProcCluster(root, num_datanodes=2)
     try:
@@ -224,6 +352,12 @@ def run_all_distributed(update: bool = False) -> list[str]:
             golden = case[:-4] + ".result"
             if not os.path.exists(golden):
                 failures.append(f"{name}: missing golden {golden}")
+                continue
+            if name.startswith("reconfig_"):
+                # reconfig cases mutate topology (split/merge/migration/
+                # failover) and run on their own elastic flight cluster so
+                # the shared ProcCluster stays pristine for the others.
+                reconfig_cases.append(case)
                 continue
             with open(golden) as f:
                 want = f.read()
@@ -241,6 +375,7 @@ def run_all_distributed(update: bool = False) -> list[str]:
                 failures.append(f"{name} [distributed]:\n{diff}")
     finally:
         cluster.stop()
+    _run_reconfig_cases(reconfig_cases, failures)
     return failures
 
 

@@ -1719,3 +1719,314 @@ def test_write_hedge_refused_while_lease_live_and_off_safe(chaos):
     assert metrics.WRITE_HEDGE_TOTAL.get() == hedged0
     _meta, routes = chaos.route_of("wh2")
     assert routes[rid] == owner, "write_hedge=False must never move a region"
+
+
+# ---- elastic repartitioning: write fence, balancer fault points ------------
+# These drive the in-process Cluster facade (procedures + balancer live
+# there) and, for the frontend-race regression, the same Cluster in flight
+# transport behind a MetasrvServer with an EXTERNAL Frontend whose catalog
+# view goes stale the moment a repartition swaps the region set.
+
+
+def _elastic_schema():
+    from greptimedb_tpu.datatypes.data_type import ConcreteDataType as DT
+    from greptimedb_tpu.datatypes.schema import ColumnSchema, Schema, SemanticType
+
+    return Schema(
+        [
+            ColumnSchema("host", DT.STRING, SemanticType.TAG),
+            ColumnSchema("ts", DT.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP),
+            ColumnSchema("val", DT.FLOAT64),
+        ]
+    )
+
+
+def _elastic_rows(schema, n, base_ms, hosts=7):
+    return pa.RecordBatch.from_pydict(
+        {
+            "host": [f"h{i % hosts}" for i in range(n)],
+            "ts": pa.array([base_ms + i for i in range(n)], pa.timestamp("ms")),
+            "val": [float(i) for i in range(n)],
+        },
+        schema=schema.to_arrow(),
+    )
+
+
+def _elastic_cluster(tmp_path, enabled=True, **knobs):
+    """In-process 3-node cluster with an aggressive (test-cadence) balancer."""
+    from greptimedb_tpu.distributed.cluster import Cluster
+    from greptimedb_tpu.utils.config import Config
+
+    cfg = Config()
+    cfg.balance.enabled = enabled
+    if enabled:
+        cfg.balance.ewma_alpha = 1.0
+        cfg.balance.min_dwell_ticks = 2
+        cfg.balance.cooldown_ticks = 1
+        cfg.balance.split_hot_score = 10.0
+    for k, v in knobs.items():
+        setattr(cfg.balance, k, v)
+    cfg.validate()
+    now = [1_000_000.0]
+    c = Cluster(
+        str(tmp_path / "elastic"), num_datanodes=3,
+        clock=lambda: now[0], config=cfg,
+    )
+    schema = _elastic_schema()
+    c.create_table("metrics", schema)
+    return c, now, schema
+
+
+def _cluster_count(c, table="metrics"):
+    t = c.query(f"SELECT count(*) AS c FROM {table}")
+    return t.column("c")[0].as_py()
+
+
+def _load_round(c, now, schema, n=200):
+    c.insert("metrics", _elastic_rows(schema, n, int(now[0])))
+    now[0] += 1000
+    c.heartbeat_all()
+    return c.balance_tick()
+
+
+@pytest.mark.chaos
+def test_balance_decide_fault_drops_decision_and_reproposes(tmp_path):
+    """An error injected at `balance.decide` — after hysteresis admitted the
+    decision, before the procedure is submitted — must be absorbed by the
+    balancer: routes and the catalog stay exactly as they were, queries and
+    writes keep working, and the SAME pressure re-proposes the decision on a
+    later tick once the cooldown drains."""
+    c, now, schema = _elastic_cluster(tmp_path)
+    meta = c.catalog.table("metrics", "public")
+    routes_before = dict(c.metasrv.get_route(meta.table_id))
+    regions_before = list(meta.region_ids)
+
+    plan = fi.REGISTRY.arm("balance.decide", fail_times=1, error=RuntimeError)
+    dropped = None
+    for _ in range(10):
+        decs = _load_round(c, now, schema)
+        if decs:
+            dropped = decs[0]
+            break
+    assert dropped is not None and plan.trips == 1
+    assert not dropped["ok"] and "injected fault" in dropped["error"]
+
+    # the dropped decision left no trace in routing or metadata
+    meta = c.catalog.table("metrics", "public")
+    assert list(meta.region_ids) == regions_before
+    assert dict(c.metasrv.get_route(meta.table_id)) == routes_before
+    assert "repartitioning" not in meta.options
+    baseline = _cluster_count(c)
+    assert c.insert("metrics", _elastic_rows(schema, 50, 77_000_000)) == 50
+    assert _cluster_count(c) == baseline + 50
+
+    # the pressure is still there: with the fault gone, a later tick enacts
+    fi.REGISTRY.disarm()
+    enacted = None
+    for _ in range(10):
+        decs = _load_round(c, now, schema)
+        if decs and decs[0]["ok"]:
+            enacted = decs[0]
+            break
+    assert enacted is not None, "decision was never re-proposed after the drop"
+
+
+@pytest.mark.chaos
+def test_repartition_copy_fault_rolls_back_fence_and_data_intact(tmp_path):
+    """A non-transient fault at `repartition.copy` poisons the procedure;
+    rollback must drop the staging regions, pop the write fence, restore the
+    old regions writable — no rows lost, writes and a clean re-run work."""
+    from greptimedb_tpu.models.partition import HashPartitionRule
+    from greptimedb_tpu.utils.errors import IllegalStateError
+
+    c, now, schema = _elastic_cluster(tmp_path, enabled=False)
+    c.insert("metrics", _elastic_rows(schema, 100, 1000))
+    meta = c.catalog.table("metrics", "public")
+    regions_before = list(meta.region_ids)
+
+    plan = fi.REGISTRY.arm("repartition.copy", fail_times=1, error=ValueError)
+    with pytest.raises(IllegalStateError):
+        c.repartition_table("metrics", HashPartitionRule(["host"], 2))
+    assert plan.trips == 1
+
+    meta = c.catalog.table("metrics", "public")
+    assert list(meta.region_ids) == regions_before, "swap must not have happened"
+    assert "repartitioning" not in meta.options, "fence must be popped"
+    assert _cluster_count(c) == 100
+    # old regions writable again: the fence rollback re-enabled them
+    assert c.insert("metrics", _elastic_rows(schema, 20, 50_000)) == 20
+    assert _cluster_count(c) == 120
+
+    # a clean re-run from the rolled-back state succeeds, rows preserved
+    fi.REGISTRY.disarm()
+    c.repartition_table("metrics", HashPartitionRule(["host"], 2))
+    meta = c.catalog.table("metrics", "public")
+    assert len(meta.region_ids) == 2
+    assert _cluster_count(c) == 120
+
+
+@pytest.mark.chaos
+def test_migration_swap_fault_rolls_back_route_and_leader(tmp_path):
+    """A torn migration — error injected at `migration.swap`, immediately
+    before the route flip — must roll back: route unchanged, the candidate
+    closed, the old leader re-enabled for writes."""
+    from greptimedb_tpu.utils.errors import IllegalStateError
+
+    c, now, schema = _elastic_cluster(tmp_path, enabled=False)
+    c.insert("metrics", _elastic_rows(schema, 60, 1000))
+    meta = c.catalog.table("metrics", "public")
+    rid = meta.region_ids[0]
+    owner = c.metasrv.get_route(meta.table_id)[rid]
+    target = next(n for n in c.datanodes if n != owner)
+
+    plan = fi.REGISTRY.arm("migration.swap", fail_times=1, error=ValueError)
+    with pytest.raises(IllegalStateError):
+        c.migrate_region("metrics", rid, target)
+    assert plan.trips == 1
+    assert c.metasrv.get_route(meta.table_id)[rid] == owner, "route must not move"
+    assert rid not in c.datanodes[target].engine.region_ids(), "candidate closed"
+    # old leader takes writes again (rollback re-enabled it)
+    assert c.insert("metrics", _elastic_rows(schema, 10, 90_000)) == 10
+    assert _cluster_count(c) == 70
+
+    # the same migration, clean, lands
+    fi.REGISTRY.disarm()
+    c.migrate_region("metrics", rid, target)
+    assert c.metasrv.get_route(meta.table_id)[rid] == target
+    assert _cluster_count(c) == 70
+
+
+@pytest.mark.chaos
+def test_balancer_default_off_is_bit_for_bit_noop(tmp_path):
+    """balance.enabled=false (the default Config) must be indistinguishable
+    from the pre-balancer cluster: tick() returns nothing, reads no stats,
+    and the hottest conceivable load never moves a region or submits a
+    procedure."""
+    c, now, schema = _elastic_cluster(tmp_path, enabled=False)
+    meta = c.catalog.table("metrics", "public")
+    routes_before = dict(c.metasrv.get_route(meta.table_id))
+
+    for _ in range(8):
+        decs = _load_round(c, now, schema, n=500)
+        assert decs == []
+        c.supervise()
+
+    meta = c.catalog.table("metrics", "public")
+    assert list(meta.region_ids) == list(routes_before)
+    assert dict(c.metasrv.get_route(meta.table_id)) == routes_before
+    moving = {"repartition", "region_migration"}
+    for mgr in (c.procedures, c.metasrv.procedures):
+        assert not [r for r in mgr.list_records() if r.type_name in moving]
+    assert c.query(
+        "SELECT * FROM information_schema.region_balance"
+    ).num_rows == 0, "a disabled balancer must not even accumulate scores"
+
+
+# ---- frontend racing a live repartition (zero-failed-query contract) -------
+
+
+class _ElasticFlightHarness:
+    """Cluster in flight transport + MetasrvServer + EXTERNAL Frontend.
+    The frontend shares the file-backed catalog but caches TableMeta, so a
+    cluster-side repartition makes its view stale mid-request — exactly the
+    race the write-fence re-check and read meta-refresh exist for."""
+
+    def __init__(self, root):
+        from greptimedb_tpu.distributed.cluster import Cluster
+
+        self.now = [1_000_000.0]
+        self.cluster = Cluster(
+            root, num_datanodes=2, clock=lambda: self.now[0], transport="flight"
+        )
+        self.server = MetasrvServer(self.cluster.metasrv).start()
+        self.frontend = Frontend(root, [self.server.address])
+        self.frontend.retry_policy = RetryPolicy(
+            max_attempts=4, base_delay_s=0.01, max_delay_s=0.05
+        )
+
+    def close(self):
+        self.frontend.close()
+        self.server.stop()
+        for dn in self.cluster.datanodes.values():
+            if dn.alive:
+                dn.shutdown()
+
+
+@pytest.fixture()
+def elastic_flight(tmp_path):
+    h = _ElasticFlightHarness(str(tmp_path / "elastic_flight"))
+    h.cluster.create_table("ef", _elastic_schema())
+    h.cluster.insert("ef", _elastic_rows(_elastic_schema(), 30, 1000))
+    yield h
+    h.close()
+
+
+@pytest.mark.chaos
+def test_frontend_write_racing_fence_sheds_promptly(elastic_flight):
+    """Regression (satellite 6): a frontend write racing an in-flight
+    repartition used to burn its whole retry budget against read-only old
+    regions before giving up.  The retry's route refresh must RE-CHECK the
+    fence: one datanode round-trip, then RetryLaterError — and once the
+    fence pops, the same stale frontend writes without manual reloads."""
+    h = elastic_flight
+    cluster, fe = h.cluster, h.frontend
+    assert fe.sql_one("SELECT count(*) AS c FROM ef")["c"].to_pylist() == [30]
+    n = fe.sql_one("INSERT INTO ef VALUES ('w0', 70000, 1.0)")
+    assert n == 1  # frontend meta is now cached and warm
+
+    # Freeze the copy window: fence in the catalog + old regions read-only
+    # at the datanodes (exactly what RepartitionProcedure._step_prepare
+    # commits before any rows move).
+    meta = cluster.catalog.table("ef", "public")
+    with cluster.table_write_lock("public", "ef"):
+        meta.options["repartitioning"] = True
+        cluster.catalog.update_table(meta)
+    for rid, node in cluster.metasrv.get_route(meta.table_id).items():
+        cluster.metasrv.node_manager.set_region_writable(node, rid, False)
+
+    puts = fi.REGISTRY.arm("flight.do_put", fail_times=0)  # pure hit counter
+    with pytest.raises(RetryLaterError, match="repartitioning"):
+        fe.sql_one("INSERT INTO ef VALUES ('w1', 71000, 2.0)")
+    assert puts.hits == 1, (
+        "fence must surface after ONE datanode round-trip, "
+        f"not burn the retry budget (saw {puts.hits} DoPut calls)"
+    )
+
+    # Fence pops cluster-side; the frontend's CACHED meta still says
+    # repartitioning — the pre-check must reload-confirm, not livelock.
+    meta = cluster.catalog.table("ef", "public")
+    meta.options.pop("repartitioning", None)
+    cluster.catalog.update_table(meta)
+    for rid, node in cluster.metasrv.get_route(meta.table_id).items():
+        cluster.metasrv.node_manager.set_region_writable(node, rid, True)
+    assert fe.sql_one("INSERT INTO ef VALUES ('w2', 72000, 3.0)") == 1
+    assert fe.sql_one("SELECT count(*) AS c FROM ef")["c"].to_pylist() == [32]
+
+
+@pytest.mark.chaos
+def test_frontend_absorbs_completed_swap_mid_write_and_mid_read(elastic_flight):
+    """A repartition that COMPLETES while the frontend holds the old meta:
+    the old region ids are gone, so the first attempt fails region-not-found.
+    Writes must re-split the batch through the fresh rule and land; reads
+    must refresh the region set and answer — zero failed queries, zero lost
+    acked writes, no manual catalog reloads by the client."""
+    from greptimedb_tpu.models.partition import HashPartitionRule
+
+    h = elastic_flight
+    cluster, fe = h.cluster, h.frontend
+    assert fe.sql_one("SELECT count(*) AS c FROM ef")["c"].to_pylist() == [30]
+    assert fe.sql_one("INSERT INTO ef VALUES ('s0', 80000, 1.0)") == 1
+
+    old_regions = list(cluster.catalog.table("ef", "public").region_ids)
+    cluster.repartition_table("ef", HashPartitionRule(["host"], 2))
+    fresh = cluster.catalog.table("ef", "public")
+    assert list(fresh.region_ids) != old_regions and len(fresh.region_ids) == 2
+    # the frontend still holds the PRE-swap meta (no reload has happened)
+    assert list(fe.catalog.table("ef", "public").region_ids) == old_regions
+
+    assert fe.sql_one("INSERT INTO ef VALUES ('s1', 81000, 2.0)") == 1
+    out = fe.sql_one("SELECT count(*) AS c FROM ef")
+    assert out["c"].to_pylist() == [32], "acked rows lost across the swap"
+    # per-host read exercises the partitioned fan-out post-refresh
+    out = fe.sql_one("SELECT count(*) AS c FROM ef WHERE host = 's1'")
+    assert out["c"].to_pylist() == [1]

@@ -472,3 +472,300 @@ def test_fuzz_execution_regimes_match_cpu(tmp_path):
     finally:
         db.close()
 
+
+# ---- elastic balancer chaos fuzz: churn + faults + invariants --------------
+#
+# The balancer splits/merges/migrates regions autonomously while writers,
+# readers and flushes run and injected faults fire (node kill, procedure
+# step failures at "repartition.copy" / "migration.swap", dropped decisions
+# at "balance.decide").  After every run the cluster must quiesce to a state
+# where four invariants hold:
+#   no-lost-acked-rows    every acked key is served exactly once
+#   no-double-leader      at most one live writable copy per region
+#   routes-converge       every routed region lives open on a live node
+#   procedures-terminal   no procedure record is left EXECUTING
+
+
+def _elastic_fuzz_schema():
+    from greptimedb_tpu.datatypes import (
+        ColumnSchema,
+        ConcreteDataType,
+        Schema,
+        SemanticType,
+    )
+
+    return Schema(
+        columns=[
+            ColumnSchema("host", ConcreteDataType.STRING, SemanticType.TAG),
+            ColumnSchema(
+                "ts", ConcreteDataType.TIMESTAMP_MILLISECOND, SemanticType.TIMESTAMP
+            ),
+            ColumnSchema("v", ConcreteDataType.FLOAT64),
+        ]
+    )
+
+
+def _elastic_fuzz_config():
+    from greptimedb_tpu.utils.config import Config
+
+    cfg = Config()
+    cfg.balance.enabled = True
+    cfg.balance.ewma_alpha = 0.6
+    cfg.balance.min_dwell_ticks = 2
+    cfg.balance.cooldown_ticks = 2
+    cfg.balance.split_hot_score = 12.0
+    cfg.balance.merge_cold_score = 2.0
+    cfg.balance.max_regions_per_table = 8
+    cfg.validate()
+    return cfg
+
+
+def _check_elastic_invariants(c, table="fz"):
+    from greptimedb_tpu.distributed.procedure import EXECUTING
+
+    # procedures-terminal: nothing is wedged mid-flight
+    for mgr in (c.procedures, c.metasrv.procedures):
+        stuck = [r for r in mgr.list_records() if r.status == EXECUTING]
+        assert not stuck, f"non-terminal procedures after quiesce: {stuck}"
+    meta = c.catalog.table(table, "public")
+    routes = c.metasrv.get_route(meta.table_id)
+    # routes-converge: the route covers exactly the catalog's region set and
+    # every entry points at a live node actually serving the region
+    assert set(routes) == set(meta.region_ids)
+    for rid, node in routes.items():
+        dn = c.datanodes[node]
+        assert dn.alive, f"region {rid} routed to dead node {node}"
+        assert rid in dn.engine.region_ids(), f"region {rid} not open on {node}"
+    # no-double-leader: lease fencing means at most ONE live writable copy
+    for rid in meta.region_ids:
+        writable_on = [
+            nid
+            for nid, dn in c.datanodes.items()
+            if dn.alive
+            and any(
+                s.region_id == rid and s.writable
+                for s in dn.engine.region_statistics()
+            )
+        ]
+        assert len(writable_on) <= 1, (
+            f"double leader for region {rid}: writable on {writable_on}"
+        )
+        if writable_on:
+            assert writable_on == [routes[rid]], (
+                f"writable copy of {rid} on {writable_on}, route says {routes[rid]}"
+            )
+
+
+def _run_elastic_fuzz(tmp_path, seed, ops):
+    """One seeded fuzz run; returns (enacted, kills, reader_errors)."""
+    from greptimedb_tpu.distributed.cluster import Cluster
+    from greptimedb_tpu.utils import fault_injection as fi
+    from greptimedb_tpu.utils.errors import GreptimeError, RetryLaterError
+
+    rng = random.Random(seed)
+    now = [1_000_000.0]
+    schema = _elastic_fuzz_schema()
+    c = Cluster(
+        str(tmp_path / f"s{seed}"), num_datanodes=3,
+        clock=lambda: now[0], config=_elastic_fuzz_config(),
+    )
+    acked: list[int] = []
+    maybe: list[int] = []  # raised mid-insert: rows MAY have partially landed
+    key = 0
+    kills = 0
+    reader_errors = 0
+    faults_armed = 0
+    try:
+        c.create_table("fz", schema)
+        for _ in range(4):
+            now[0] += 1000
+            c.heartbeat_all()
+        for step in range(ops):
+            now[0] += rng.choice([100, 250, 500])
+            roll = rng.random()
+            if roll < 0.55:
+                n = rng.randint(1, 8)
+                keys = list(range(key, key + n))
+                key += n
+                # skew: most rows hammer one tag so ONE hash partition runs
+                # hot and keeps proposing splits while others idle into merges
+                batch = pa.RecordBatch.from_arrays(
+                    [
+                        pa.array(
+                            [
+                                f"h{k % 13}" if rng.random() < 0.3 else "h0"
+                                for k in keys
+                            ],
+                            pa.string(),
+                        ),
+                        pa.array([k * 1000 for k in keys], pa.timestamp("ms")),
+                        pa.array([float(k) for k in keys]),
+                    ],
+                    schema=schema.to_arrow(),
+                )
+                try:
+                    c.insert("fz", batch)
+                    acked.extend(keys)
+                except (RetryLaterError, ConnectionError, GreptimeError, OSError):
+                    maybe.extend(keys)
+                    now[0] += 500
+                    c.heartbeat_all()
+                    c.supervise()
+            elif roll < 0.80:
+                try:
+                    t = c.query("SELECT count(*) AS n FROM fz")
+                    assert t["n"].to_pylist()[0] >= 0
+                except (GreptimeError, ConnectionError, OSError):
+                    reader_errors += 1
+            elif roll < 0.88:
+                alive = [d for d in c.datanodes.values() if d.alive]
+                if alive:
+                    try:
+                        rng.choice(alive).engine.flush_all()
+                    except (GreptimeError, OSError):
+                        pass
+            if step % 4 == 0:
+                c.heartbeat_all()
+            if step % 8 == 0:
+                c.supervise()  # failover scan + one balancer decision
+            # chaos: one node dies mid-run (flush first: shared storage is
+            # the durability story, same as the failover fuzz targets)
+            if kills < 1 and step == ops // 2:
+                for dn in c.datanodes.values():
+                    if dn.alive:
+                        dn.engine.flush_all()
+                victim = rng.choice(
+                    [n for n, d in c.datanodes.items() if d.alive]
+                )
+                c.kill_datanode(victim)
+                kills += 1
+            # chaos: procedure-step faults at the registered points; each
+            # trips ONCE at the next decision/copy/swap and must roll back
+            if faults_armed < 6 and rng.random() < 0.01:
+                point = rng.choice(
+                    ["balance.decide", "repartition.copy", "migration.swap"]
+                )
+                fi.REGISTRY.arm(
+                    point,
+                    fail_times=1,
+                    error=RuntimeError if point == "balance.decide" else ValueError,
+                )
+                faults_armed += 1
+        fi.REGISTRY.disarm()
+
+        # quiesce: drive heartbeats + supervision until every acked row is
+        # served exactly once (maybe-rows may or may not have landed)
+        expected, universe = set(acked), set(acked) | set(maybe)
+        got = None
+        for _ in range(150):
+            now[0] += 1000
+            c.heartbeat_all()
+            c.supervise()
+            try:
+                vals = c.query("SELECT v FROM fz")["v"].to_pylist()
+            except (GreptimeError, ConnectionError, OSError):
+                continue
+            got = [int(v) for v in vals]
+            s = set(got)
+            if len(got) == len(s) and expected <= s <= universe:
+                break
+        assert got is not None, "cluster never served a full read after chaos"
+        s = set(got)
+        assert len(got) == len(s), f"{len(got) - len(s)} duplicate rows served"
+        assert expected <= s, f"lost {len(expected - s)} acked rows"
+        assert s <= universe, f"{len(s - universe)} phantom rows served"
+        _check_elastic_invariants(c)
+        enacted = [d for d in c.balancer.decisions if d["ok"]]
+        return enacted, kills, reader_errors
+    finally:
+        fi.REGISTRY.disarm()
+        c.close()
+
+
+@pytest.mark.parametrize("seed", [11, 1213, 990017])
+def test_fuzz_elastic_balancer_churn(tmp_path, seed):
+    """Tier-1-sized elastic chaos: ~350 ops of skewed writes / reads /
+    flushes with the balancer live, one node kill and injected procedure
+    faults; all four invariants must hold after quiesce and the balancer
+    must have actually enacted at least one decision (the churn is real)."""
+    enacted, kills, _ = _run_elastic_fuzz(tmp_path, seed, ops=350)
+    assert kills == 1, "the node kill never fired"
+    assert enacted, "balancer never enacted a decision; churn was hollow"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [11, 1213, 990017])
+def test_fuzz_elastic_balancer_churn_soak(tmp_path, seed):
+    """The >=10k-op soak variant of the elastic chaos fuzz (same driver,
+    same invariants, two orders of magnitude more ops per seed)."""
+    enacted, kills, _ = _run_elastic_fuzz(tmp_path, seed, ops=10_000)
+    assert kills == 1
+    assert enacted
+
+
+def test_fuzz_hotspot_autosplit_zero_failed_queries(tmp_path):
+    """The headline robustness contract, no kills and no faults: skewed
+    ingest drives the balancer to auto-split the hot table while writers
+    and readers run — and NOTHING is allowed to fail.  Writes may surface
+    RetryLaterError only as the documented retryable contract (the retry
+    must then succeed); reads must never raise at all; zero lost rows."""
+    from greptimedb_tpu.distributed.cluster import Cluster
+    from greptimedb_tpu.utils.errors import RetryLaterError
+
+    rng = random.Random(0xE1A57)
+    now = [1_000_000.0]
+    schema = _elastic_fuzz_schema()
+    c = Cluster(
+        str(tmp_path / "hot"), num_datanodes=3,
+        clock=lambda: now[0], config=_elastic_fuzz_config(),
+    )
+    try:
+        c.create_table("hot", schema)
+        for _ in range(4):
+            now[0] += 1000
+            c.heartbeat_all()
+        acked = 0
+        key = 0
+        for step in range(160):
+            now[0] += 250
+            n = rng.randint(4, 10)
+            batch = pa.RecordBatch.from_arrays(
+                [
+                    pa.array(["h0"] * n, pa.string()),  # pure hot spot
+                    pa.array(
+                        [(key + i) * 1000 for i in range(n)], pa.timestamp("ms")
+                    ),
+                    pa.array([float(key + i) for i in range(n)]),
+                ],
+                schema=schema.to_arrow(),
+            )
+            key += n
+            for attempt in range(4):
+                try:
+                    c.insert("hot", batch)
+                    acked += n
+                    break
+                except RetryLaterError:
+                    # the ONE permitted surface: a write racing the split
+                    # fence; the retry after the swap must land
+                    now[0] += 500
+                    c.heartbeat_all()
+                    c.supervise()
+            else:
+                pytest.fail("write retries exhausted during auto-split")
+            # reads are under the zero-failed contract: no raise, full data
+            t = c.query("SELECT count(*) AS n FROM hot")
+            assert t["n"].to_pylist() == [acked]
+            if step % 3 == 0:
+                c.heartbeat_all()
+                c.supervise()
+        splits = [
+            d for d in c.balancer.decisions if d["ok"] and d["kind"] == "split"
+        ]
+        assert splits, "hot spot never auto-split"
+        meta = c.catalog.table("hot", "public")
+        assert len(meta.region_ids) >= 2
+        assert c.query("SELECT count(*) AS n FROM hot")["n"].to_pylist() == [acked]
+        _check_elastic_invariants(c, "hot")
+    finally:
+        c.close()
