@@ -108,6 +108,24 @@ DATA_DIR = os.environ.get(
 )
 
 
+def _argv_value(flag: str, default: str) -> str:
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == flag and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith(flag + "="):
+            return a.split("=", 1)[1]
+    return default
+
+
+# --wal-backend kafka-fake: run a wire-latency probe of the group-commit
+# path against an offline fake broker (remote/fake_kafka.py) next to the
+# in-process ingest.  The headline ingest numbers stay on the local WAL.
+WAL_BACKEND = _argv_value(
+    "--wal-backend", os.environ.get("GRAFT_BENCH_WAL_BACKEND", "local")
+)
+
+
 def _dataset_key() -> str:
     sig = json.dumps(
         {
@@ -683,6 +701,87 @@ def _http_ingest_probe(db) -> dict:
         return out
     finally:
         srv.stop()
+
+
+def _wal_wire_probe() -> dict:
+    """--wal-backend kafka-fake: group commits over a real socket to the
+    fake broker vs the local file WAL on the same shape — the wire-latency
+    datapoint for the remote WAL, kept OFF the headline ingest numbers
+    (throwaway tempdir engines, small row count)."""
+    import shutil
+    import tempfile
+
+    from greptimedb_tpu.datatypes import (
+        ColumnSchema, ConcreteDataType, Schema, SemanticType,
+    )
+    from greptimedb_tpu.remote.fake_kafka import FakeKafkaBroker
+    from greptimedb_tpu.storage.engine import TimeSeriesEngine
+    from greptimedb_tpu.utils.config import StorageConfig
+
+    schema = Schema(columns=[
+        ColumnSchema("hostname", ConcreteDataType.STRING, SemanticType.TAG),
+        ColumnSchema(
+            "ts", ConcreteDataType.TIMESTAMP_MILLISECOND,
+            SemanticType.TIMESTAMP,
+        ),
+        ColumnSchema("usage_user", ConcreteDataType.FLOAT64),
+    ])
+    rng = np.random.default_rng(11)
+    groups, per_group, rows = 100, 4, 500
+
+    def batches(g):
+        ts0 = (g * per_group + 1) * 10_000
+        return [
+            pa.RecordBatch.from_arrays(
+                [
+                    pa.array([f"host_{i % 97}" for i in range(rows)]),
+                    pa.array(
+                        [ts0 + b * 1000 + i for i in range(rows)],
+                        pa.timestamp("ms"),
+                    ),
+                    pa.array(rng.uniform(0, 100, rows)),
+                ],
+                schema=schema.to_arrow(),
+            )
+            for b in range(per_group)
+        ]
+
+    def drive(cfg) -> dict:
+        engine = TimeSeriesEngine(cfg)
+        engine.create_region(1, schema)
+        lat = []
+        t0 = time.perf_counter()
+        for g in range(groups):
+            t1 = time.perf_counter()
+            engine.write_group(1, batches(g))
+            lat.append(time.perf_counter() - t1)
+        total = time.perf_counter() - t0
+        engine.close()
+        lat.sort()
+        return {
+            "rows_per_sec": round(groups * per_group * rows / max(total, 1e-9)),
+            "commit_p50_ms": round(lat[len(lat) // 2] * 1000, 3),
+            "commit_p99_ms": round(lat[int(len(lat) * 0.99)] * 1000, 3),
+        }
+
+    home = tempfile.mkdtemp(prefix="graft_walwire_")
+    try:
+        with FakeKafkaBroker() as broker:
+            wire = drive(StorageConfig(
+                data_home=os.path.join(home, "kafka"),
+                wal_provider="kafka",
+                wal_kafka_endpoints=broker.endpoint,
+            ))
+        local = drive(StorageConfig(data_home=os.path.join(home, "local")))
+        return {
+            "backend": "kafka-fake",
+            "rows": groups * per_group * rows,
+            "group_size": per_group,
+            "wire": wire,
+            "local": local,
+        }
+    finally:
+        shutil.rmtree(home, ignore_errors=True)
 
 
 def _larger_than_hbm_probe() -> dict:
@@ -1315,6 +1414,22 @@ def main():
                    "elapsed_s": round(_elapsed(), 1)})
         except Exception as e:  # noqa: BLE001 — probe must never kill the bench
             detail["ingest_http_error"] = repr(e)
+
+    # ---- remote-WAL wire probe (--wal-backend kafka-fake) ------------------
+    if WAL_BACKEND == "kafka-fake":
+        if _remaining() < 60:
+            detail["wal_wire"] = {
+                "skipped": "remaining budget below wal-wire floor"
+            }
+        else:
+            try:
+                detail["wal_wire"] = _wal_wire_probe()
+                _emit({"event": "wal_wire", **detail["wal_wire"],
+                       "elapsed_s": round(_elapsed(), 1)})
+            except Exception as e:  # noqa: BLE001 — probe must never kill
+                detail["wal_wire"] = {"error": repr(e)[:80]}
+    elif WAL_BACKEND != "local":
+        detail["wal_wire"] = {"skipped": f"unknown backend {WAL_BACKEND!r}"}
 
     # ---- link probes -------------------------------------------------------
     import jax.numpy as jnp

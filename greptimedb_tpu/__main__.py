@@ -122,9 +122,24 @@ def cmd_datanode(args):
     # layered config (env vars incl. GREPTIMEDB_TPU__REPLICA__SYNC_INTERVAL_MS,
     # which Config copies down to storage.follower_sync_interval_ms) with the
     # CLI data_home overriding whatever the layers said
-    storage_cfg = Config.load().storage
+    full_cfg = Config.load()
+    storage_cfg = full_cfg.storage
     storage_cfg.data_home = args.data_home
     engine = TimeSeriesEngine(storage_cfg)
+    # OTLP self-export: a bare datanode has no writer path for its own
+    # spans (PR's trace table lives behind the SQL frontend), so when
+    # trace.otlp_endpoint points at a frontend/standalone OTLP ingest,
+    # ship the span ring there as protobuf batches instead
+    otlp_task = None
+    otlp_endpoint = getattr(full_cfg.trace, "otlp_endpoint", "")
+    if otlp_endpoint:
+        from .utils.self_trace import OtlpExportTask
+
+        otlp_task = OtlpExportTask(
+            otlp_endpoint, full_cfg.trace,
+            service=f"greptimedb_tpu.datanode.{args.node_id}",
+        ).start()
+        print(f"otlp self-export -> {otlp_endpoint}", flush=True)
     host, port = (args.addr.rsplit(":", 1) + ["0"])[:2]
     server = DatanodeFlightServer(engine, f"grpc://{host}:{port}")
     import threading
@@ -196,6 +211,8 @@ def cmd_datanode(args):
     try:
         stop.wait()
     finally:
+        if otlp_task is not None:
+            otlp_task.stop()
         server.shutdown()
         engine.close()
     return 0
@@ -313,8 +330,19 @@ def cmd_metasrv(args):
         def set_region_writable(self, node_id: int, rid: int, writable: bool):
             self._client(node_id).set_region_writable(rid, writable)
 
-    kv = FileKvBackend(args.kv_dir)
-    election = LeaseElection(kv, args.node_id)
+    if getattr(args, "etcd_endpoints", None):
+        # wire-level deployment: cluster metadata AND leader election live
+        # in etcd (lease + create-revision CAS) so multiple metasrv
+        # processes coordinate without a shared filesystem
+        from .remote.etcd import EtcdClient, EtcdElection, EtcdKvBackend
+
+        kv = EtcdKvBackend(args.etcd_endpoints)
+        election = EtcdElection(
+            EtcdClient(args.etcd_endpoints), args.node_id
+        )
+    else:
+        kv = FileKvBackend(args.kv_dir)
+        election = LeaseElection(kv, args.node_id)
     node_manager = RemoteNodeManager()
     metasrv = Metasrv(kv, node_manager, election=election)
     node_manager.metasrv = metasrv
@@ -525,6 +553,11 @@ def main(argv=None):
     p.add_argument(
         "--datanode", action="append",
         help="node_id=host:port mapping (repeatable)",
+    )
+    p.add_argument(
+        "--etcd-endpoints", default="",
+        help="etcd v3 grpc-gateway endpoints (host:port[,host:port]); "
+        "replaces --kv-dir with a wire-level KV + election backend",
     )
     p.set_defaults(fn=cmd_metasrv)
 

@@ -36,13 +36,21 @@ class Datanode:
     datanode/src/region_server.rs:92).  Each datanode opens only the
     regions routed to it."""
 
-    def __init__(self, node_id: int, shared_data_home: str):
+    def __init__(self, node_id: int, shared_data_home: str,
+                 storage_config: StorageConfig | None = None):
         self.node_id = node_id
         # The WAL dir is SHARED like the SSTs: the analogue of the
         # reference's remote WAL (Kafka), which is what makes failover able
         # to replay a dead node's unflushed writes.  Single-writer-per-region
         # is enforced by the metasrv routes, as in the reference's leases.
-        cfg = StorageConfig(data_home=shared_data_home)
+        # A caller-supplied storage config (remote WAL/store knobs engaged)
+        # is re-homed onto the shared dir instead.
+        if storage_config is not None:
+            import dataclasses
+
+            cfg = dataclasses.replace(storage_config, data_home=shared_data_home)
+        else:
+            cfg = StorageConfig(data_home=shared_data_home)
         self.engine = TimeSeriesEngine(cfg)
         self.alive = True
         from .alive_keeper import RegionAliveKeeper
@@ -185,7 +193,22 @@ class Cluster:
         self.data_home = data_home
         self.clock = clock or (lambda: _time.time() * 1000)
         self.config = config or Config()
-        self.kv = MemoryKvBackend()
+        etcd_eps = getattr(self.config.remote, "etcd_endpoints", "") \
+            if hasattr(self.config, "remote") else ""
+        if etcd_eps:
+            # wire-level metasrv backend: cluster metadata + routes live in
+            # (a fake or real) etcd instead of the in-process map
+            from ..remote.etcd import EtcdKvBackend
+
+            self.kv = EtcdKvBackend(
+                etcd_eps,
+                pool_size=self.config.remote.pool_size,
+                call_deadline_s=self.config.remote.call_deadline_s,
+                connect_timeout_s=self.config.remote.connect_timeout_s,
+                retry_attempts=self.config.remote.retry_attempts,
+            )
+        else:
+            self.kv = MemoryKvBackend()
         self.catalog = Catalog(os.path.join(data_home, "catalog.json"))
         self.transport = transport
         if transport == "flight":
@@ -196,7 +219,19 @@ class Cluster:
 
             self.datanodes = {i: FlightDatanode(i, data_home) for i in range(num_datanodes)}
         else:
-            self.datanodes = {i: Datanode(i, data_home) for i in range(num_datanodes)}
+            # propagate the storage config only when a remote backend knob
+            # is engaged — datanodes otherwise keep their plain shared-dir
+            # defaults (bit-for-bit with earlier builds)
+            st = self.config.storage
+            remote_engaged = bool(
+                getattr(st, "wal_kafka_endpoints", "")
+                or getattr(st, "store_s3_endpoint", "")
+            )
+            self.datanodes = {
+                i: Datanode(i, data_home,
+                            storage_config=st if remote_engaged else None)
+                for i in range(num_datanodes)
+            }
         self.metasrv = Metasrv(
             self.kv, NodeManager(self), target_followers=target_followers,
             clock_ms=self.clock,

@@ -1078,10 +1078,15 @@ class Parser:
 
     def parse_case(self) -> Expr:
         self.expect_kw("case")
+        # simple form: CASE <operand> WHEN <value> THEN ... — desugars to
+        # the searched form with `operand = value` conditions
+        operand = None if self.at_kw("when") else self.parse_expr()
         branches = []
         default = Literal(None)
         while self.eat_kw("when"):
             cond = self.parse_expr()
+            if operand is not None:
+                cond = BinaryOp("=", operand, cond)
             self.expect_kw("then")
             val = self.parse_expr()
             branches.append((cond, val))

@@ -1,0 +1,24 @@
+"""Wire-level remote backend adapters (etcd v3 / Kafka / S3).
+
+The reference deployment talks to three remote systems: etcd for
+metadata + election, Kafka for the shared remote WAL, S3 for the object
+store.  This package holds real wire clients for all three — speaking
+JSON-over-HTTP (etcd gRPC-gateway), the Kafka binary framing, and
+SigV4-signed S3 REST — behind the exact interfaces the in-memory sims
+already implement (`distributed/kv.py`, `storage/remote_wal.py`'s store
+surface, `storage/object_store.py`).  Each client ships with an offline
+local fake speaking the same protocol, so the contract battery and chaos
+suite run with zero egress.
+
+Everything routes through one wire resilience layer (`wire.py`):
+connection pooling, per-call deadlines, per-protocol retry
+classification, circuit breaking, and socket-level fault points.
+"""
+
+from .wire import (  # noqa: F401
+    Connection,
+    RemoteProtocolError,
+    WireBackend,
+    http_call,
+    parse_endpoints,
+)

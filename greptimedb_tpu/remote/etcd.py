@@ -1,0 +1,300 @@
+"""etcd v3 wire client (gRPC-gateway JSON dialect) + drop-in adapters.
+
+Three layers, mirroring how the reference talks to etcd
+(meta-srv/src/election/etcd.rs and the etcd KvBackend):
+
+  * `EtcdClient` — the protocol: KV range/put/delete/**txn** and lease
+    grant/keepalive/revoke over the `/v3/*` JSON gateway, base64 keys,
+    stringified int64s, routed through the shared `WireBackend`
+    (pooling, deadlines, retries, breaker, `wire.etcd` fault point);
+  * `EtcdKvBackend` — `distributed/kv.py`'s `KvBackend` interface over
+    the client.  `compare_and_put` compiles to a single etcd txn
+    (CREATE-revision == 0 for expect-absent, VALUE equality otherwise),
+    so linearizability rides the server, not client luck; `batch_put`
+    is one txn with N puts (atomic, like the reference's batch route
+    updates);
+  * `EtcdElection` — `LeaseElection`'s surface (campaign/resign/
+    is_leader/leader + transition callbacks) implemented the etcd way:
+    grant a lease, campaign with a txn `create_revision == 0 -> put
+    key with lease`, renew by keepalive.  **Fencing is server-side**:
+    when the lease expires the key vanishes atomically, so a partitioned
+    ex-leader cannot renew (keepalive answers TTL=0 — the observable
+    fence refusal) and a rival's campaign wins cleanly.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+
+from ..distributed.kv import KvBackend
+from .wire import RemoteProtocolError, WireBackend, http_call, parse_endpoints
+
+ELECTION_KEY = "/election/metasrv_leader"
+
+
+def _b64(b: bytes | str) -> str:
+    if isinstance(b, str):
+        b = b.encode("utf-8")
+    return base64.b64encode(b).decode("ascii")
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s) if s else b""
+
+
+def prefix_range_end(prefix: bytes) -> bytes:
+    """etcd's prefix query convention: range_end = prefix with its last
+    non-0xff byte incremented ("\\x00" = the whole keyspace)."""
+    for i in reversed(range(len(prefix))):
+        if prefix[i] < 0xFF:
+            return prefix[:i] + bytes([prefix[i] + 1])
+    return b"\x00"
+
+
+class EtcdClient:
+    """JSON gRPC-gateway exchanges over the wire layer.  One `call` is
+    one POST — idempotent at this layer (range/put/lease ops trivially;
+    txn because compares re-evaluate server-side on the retried copy)."""
+
+    def __init__(self, endpoints: str, *, name: str = "etcd", **wire_kw):
+        self.wire = WireBackend(
+            "etcd", parse_endpoints(endpoints), name=name, **wire_kw
+        )
+
+    def close(self):
+        self.wire.close()
+
+    def _post(self, op: str, path: str, payload: dict) -> dict:
+        body = json.dumps(payload).encode("utf-8")
+
+        def exchange(conn):
+            status, _headers, resp = http_call(
+                conn, "POST", path,
+                headers={"content-type": "application/json"}, body=body,
+            )
+            if status >= 500:
+                raise RemoteProtocolError(
+                    f"etcd {path} -> {status}: {resp[:200]!r}",
+                    retriable=True,
+                )
+            if status >= 400:
+                raise RemoteProtocolError(
+                    f"etcd {path} -> {status}: {resp[:200]!r}"
+                )
+            return json.loads(resp or b"{}")
+
+        return self.wire.call(op, exchange)
+
+    # ---- kv ------------------------------------------------------------
+    def range(self, key: bytes, range_end: bytes | None = None,
+              limit: int = 0) -> list[dict]:
+        payload: dict = {"key": _b64(key)}
+        if range_end:
+            payload["range_end"] = _b64(range_end)
+        if limit:
+            payload["limit"] = str(limit)
+        resp = self._post("range", "/v3/kv/range", payload)
+        return [
+            {
+                "key": _unb64(kv.get("key", "")),
+                "value": _unb64(kv.get("value", "")),
+                "create_revision": int(kv.get("create_revision", "0")),
+                "mod_revision": int(kv.get("mod_revision", "0")),
+                "lease": int(kv.get("lease", "0")),
+            }
+            for kv in resp.get("kvs", [])
+        ]
+
+    def put(self, key: bytes, value: bytes, lease: int = 0):
+        payload: dict = {"key": _b64(key), "value": _b64(value)}
+        if lease:
+            payload["lease"] = str(lease)
+        self._post("put", "/v3/kv/put", payload)
+
+    def delete(self, key: bytes, range_end: bytes | None = None) -> int:
+        payload: dict = {"key": _b64(key)}
+        if range_end:
+            payload["range_end"] = _b64(range_end)
+        resp = self._post("delete", "/v3/kv/deleterange", payload)
+        return int(resp.get("deleted", "0"))
+
+    def txn(self, compare: list[dict], success: list[dict],
+            failure: list[dict] | None = None) -> tuple[bool, list[dict]]:
+        resp = self._post("txn", "/v3/kv/txn", {
+            "compare": compare, "success": success,
+            "failure": failure or [],
+        })
+        return bool(resp.get("succeeded")), resp.get("responses", [])
+
+    # txn building blocks
+    @staticmethod
+    def cmp_create_absent(key: bytes) -> dict:
+        return {"key": _b64(key), "target": "CREATE", "result": "EQUAL",
+                "create_revision": "0"}
+
+    @staticmethod
+    def cmp_value_equal(key: bytes, value: bytes) -> dict:
+        return {"key": _b64(key), "target": "VALUE", "result": "EQUAL",
+                "value": _b64(value)}
+
+    @staticmethod
+    def op_put(key: bytes, value: bytes, lease: int = 0) -> dict:
+        req: dict = {"key": _b64(key), "value": _b64(value)}
+        if lease:
+            req["lease"] = str(lease)
+        return {"request_put": req}
+
+    # ---- leases --------------------------------------------------------
+    def lease_grant(self, ttl_s: int) -> int:
+        resp = self._post("lease_grant", "/v3/lease/grant",
+                          {"TTL": str(ttl_s)})
+        return int(resp["ID"])
+
+    def lease_keepalive(self, lease_id: int) -> int:
+        """Returns the refreshed TTL; 0 means the lease is gone — the
+        fence refusal a partitioned ex-leader observes."""
+        resp = self._post("lease_keepalive", "/v3/lease/keepalive",
+                          {"ID": str(lease_id)})
+        return int(resp.get("result", {}).get("TTL", "0"))
+
+    def lease_revoke(self, lease_id: int):
+        self._post("lease_revoke", "/v3/lease/revoke",
+                   {"ID": str(lease_id)})
+
+
+class EtcdKvBackend(KvBackend):
+    """`KvBackend` over the wire client — the same interface
+    `MemoryKvBackend`/`FileKvBackend` implement, so Metasrv, procedures,
+    and the elastic balancer run unchanged on a real coordination store."""
+
+    def __init__(self, endpoints: str, *, name: str = "etcd-kv", **wire_kw):
+        self.client = EtcdClient(endpoints, name=name, **wire_kw)
+
+    def close(self):
+        self.client.close()
+
+    def get(self, key: str) -> str | None:
+        hits = self.client.range(key.encode("utf-8"))
+        return hits[0]["value"].decode("utf-8") if hits else None
+
+    def put(self, key: str, value: str):
+        self.client.put(key.encode("utf-8"), value.encode("utf-8"))
+
+    def delete(self, key: str):
+        self.client.delete(key.encode("utf-8"))
+
+    def range(self, prefix: str) -> dict[str, str]:
+        p = prefix.encode("utf-8")
+        hits = self.client.range(p, prefix_range_end(p))
+        return {
+            kv["key"].decode("utf-8"): kv["value"].decode("utf-8")
+            for kv in hits
+        }
+
+    def compare_and_put(self, key: str, expect: str | None,
+                        value: str) -> bool:
+        k = key.encode("utf-8")
+        v = value.encode("utf-8")
+        if expect is None:
+            cmp = EtcdClient.cmp_create_absent(k)
+        else:
+            cmp = EtcdClient.cmp_value_equal(k, expect.encode("utf-8"))
+        ok, _ = self.client.txn([cmp], [EtcdClient.op_put(k, v)])
+        return ok
+
+    def batch_put(self, kvs: dict[str, str]):
+        ops = [
+            EtcdClient.op_put(k.encode("utf-8"), v.encode("utf-8"))
+            for k, v in kvs.items()
+        ]
+        if ops:
+            self.client.txn([], ops)
+
+
+class EtcdElection:
+    """`LeaseElection`-shaped campaign over real etcd leases.
+
+    The sim fences with a timestamp inside the value; here the fence is
+    the lease itself — the server deletes the key when the TTL clock
+    runs out, and a keepalive on the dead lease answers TTL=0.  A
+    partitioned leader's campaign() therefore returns False (its
+    keepalive fails or refuses) while the rival's create-revision txn
+    wins exactly once."""
+
+    def __init__(self, client: EtcdClient, node_id: str,
+                 lease_ms: int = 3000, key: str = ELECTION_KEY):
+        self.client = client
+        self.node_id = node_id
+        self.lease_ttl_s = max(1, int(round(lease_ms / 1000)))
+        self.key = key.encode("utf-8")
+        self._lease: int | None = None
+        self._was_leader = False
+        self.on_leader_start: list = []
+        self.on_leader_stop: list = []
+
+    # ---- campaign ------------------------------------------------------
+    def campaign(self) -> bool:
+        won = False
+        try:
+            if self._lease is not None:
+                # renew path: refresh the lease, then verify we still
+                # hold the key (TTL=0 == the server fenced us out)
+                if self.client.lease_keepalive(self._lease) > 0:
+                    won = self._holder() == self.node_id
+                if not won:
+                    self._lease = None
+            if not won and self._holder() is None:
+                lease = self.client.lease_grant(self.lease_ttl_s)
+                ok, _ = self.client.txn(
+                    [EtcdClient.cmp_create_absent(self.key)],
+                    [EtcdClient.op_put(
+                        self.key, self.node_id.encode("utf-8"), lease
+                    )],
+                )
+                if ok:
+                    self._lease = lease
+                    won = True
+                else:
+                    # lost the race: give the orphan lease back
+                    self.client.lease_revoke(lease)
+        except Exception:
+            # partitioned / remote down: we cannot prove leadership, so
+            # we are not the leader (the lease will fence us server-side)
+            self._lease = None
+            won = False
+        self._transition(won)
+        return won
+
+    def resign(self):
+        if self._lease is not None:
+            try:
+                self.client.lease_revoke(self._lease)
+            except Exception:
+                pass
+            self._lease = None
+        self._transition(False)
+
+    # ---- observers -----------------------------------------------------
+    def _holder(self) -> str | None:
+        hits = self.client.range(self.key)
+        return hits[0]["value"].decode("utf-8") if hits else None
+
+    def is_leader(self) -> bool:
+        try:
+            return self._holder() == self.node_id
+        except Exception:
+            return False
+
+    def leader(self) -> str | None:
+        return self._holder()
+
+    def _transition(self, is_leader_now: bool):
+        if is_leader_now and not self._was_leader:
+            self._was_leader = True
+            for cb in self.on_leader_start:
+                cb()
+        elif not is_leader_now and self._was_leader:
+            self._was_leader = False
+            for cb in self.on_leader_stop:
+                cb()

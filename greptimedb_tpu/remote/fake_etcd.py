@@ -1,0 +1,379 @@
+"""Offline fake etcd v3 server (gRPC-gateway JSON surface).
+
+Speaks the same wire dialect the real etcd gRPC-gateway exposes on
+`/v3/*`: JSON bodies, base64-encoded keys/values, stringified int64s,
+one global **revision** that every mutation bumps, per-key
+`create_revision` / `mod_revision` / `version`, and **leases** with TTL
+clocks — an expired lease deletes its attached keys, which is exactly
+the mechanism leader fencing rides on.
+
+The clock is injectable so chaos tests advance lease time by fiat
+instead of sleeping: `FakeEtcdServer(clock=lambda: t[0])`.
+
+No egress, no etcd binary: `ThreadingHTTPServer` on a loopback port.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _b64d(s: str) -> bytes:
+    return base64.b64decode(s) if s else b""
+
+
+def _b64e(b: bytes) -> str:
+    return base64.b64encode(b).decode("ascii")
+
+
+def _int(v, default=0) -> int:
+    """The gateway stringifies int64; accept both forms."""
+    if v is None or v == "":
+        return default
+    return int(v)
+
+
+class _Lease:
+    __slots__ = ("id", "ttl_s", "deadline", "keys")
+
+    def __init__(self, lease_id: int, ttl_s: float, now: float):
+        self.id = lease_id
+        self.ttl_s = ttl_s
+        self.deadline = now + ttl_s
+        self.keys: set[bytes] = set()
+
+
+class FakeEtcdState:
+    """KV map + revision counter + lease table, all under one lock (the
+    real etcd serializes through raft apply; one lock gives the same
+    linearizable-single-writer semantics)."""
+
+    def __init__(self, clock=None):
+        self.clock = clock or time.monotonic
+        self.lock = threading.RLock()
+        self.revision = 1
+        self.kvs: dict[bytes, dict] = {}
+        self.leases: dict[int, _Lease] = {}
+        self._next_lease = 1000
+
+    # ---- leases --------------------------------------------------------
+    def expire_leases(self):
+        """Run before every request: drop expired leases and the keys
+        attached to them (each deletion is a revision bump, like a real
+        etcd lease revoke)."""
+        now = self.clock()
+        with self.lock:
+            dead = [l for l in self.leases.values() if now >= l.deadline]
+            for lease in dead:
+                for key in list(lease.keys):
+                    if self.kvs.get(key, {}).get("lease") == lease.id:
+                        self.revision += 1
+                        del self.kvs[key]
+                del self.leases[lease.id]
+
+    def grant(self, ttl_s: float, lease_id: int = 0) -> _Lease:
+        with self.lock:
+            if not lease_id:
+                self._next_lease += 1
+                lease_id = self._next_lease
+            lease = _Lease(lease_id, ttl_s, self.clock())
+            self.leases[lease_id] = lease
+            return lease
+
+    def keepalive(self, lease_id: int) -> float:
+        """Refresh the TTL clock; returns the new TTL, or 0 when the
+        lease is gone (the real keepalive stream answers TTL=0)."""
+        with self.lock:
+            lease = self.leases.get(lease_id)
+            if lease is None:
+                return 0.0
+            lease.deadline = self.clock() + lease.ttl_s
+            return lease.ttl_s
+
+    def revoke(self, lease_id: int):
+        with self.lock:
+            lease = self.leases.pop(lease_id, None)
+            if lease is None:
+                return
+            for key in list(lease.keys):
+                if self.kvs.get(key, {}).get("lease") == lease_id:
+                    self.revision += 1
+                    del self.kvs[key]
+
+    # ---- kv ------------------------------------------------------------
+    def put(self, key: bytes, value: bytes, lease_id: int = 0) -> None:
+        with self.lock:
+            if lease_id and lease_id not in self.leases:
+                raise KeyError("etcdserver: requested lease not found")
+            self.revision += 1
+            old = self.kvs.get(key)
+            if old is None:
+                self.kvs[key] = {
+                    "value": value,
+                    "create_revision": self.revision,
+                    "mod_revision": self.revision,
+                    "version": 1,
+                    "lease": lease_id,
+                }
+            else:
+                old["value"] = value
+                old["mod_revision"] = self.revision
+                old["version"] += 1
+                old["lease"] = lease_id
+            if lease_id:
+                self.leases[lease_id].keys.add(key)
+
+    def range(self, key: bytes, range_end: bytes, limit: int = 0):
+        with self.lock:
+            if not range_end:
+                hits = [(key, self.kvs[key])] if key in self.kvs else []
+            elif range_end == b"\x00":
+                hits = sorted(
+                    (k, v) for k, v in self.kvs.items() if k >= key
+                )
+            else:
+                hits = sorted(
+                    (k, v) for k, v in self.kvs.items()
+                    if key <= k < range_end
+                )
+            total = len(hits)
+            if limit:
+                hits = hits[:limit]
+            return [
+                {
+                    "key": _b64e(k),
+                    "value": _b64e(v["value"]),
+                    "create_revision": str(v["create_revision"]),
+                    "mod_revision": str(v["mod_revision"]),
+                    "version": str(v["version"]),
+                    "lease": str(v["lease"]),
+                }
+                for k, v in hits
+            ], total
+
+    def delete_range(self, key: bytes, range_end: bytes) -> int:
+        with self.lock:
+            if not range_end:
+                victims = [key] if key in self.kvs else []
+            elif range_end == b"\x00":
+                victims = [k for k in self.kvs if k >= key]
+            else:
+                victims = [k for k in self.kvs if key <= k < range_end]
+            for k in victims:
+                self.revision += 1
+                del self.kvs[k]
+            return len(victims)
+
+    # ---- txn -----------------------------------------------------------
+    def check_compare(self, cmp: dict) -> bool:
+        key = _b64d(cmp.get("key", ""))
+        target = cmp.get("target", "VALUE")
+        result = cmp.get("result", "EQUAL")
+        with self.lock:
+            kv = self.kvs.get(key)
+            if target == "VALUE":
+                actual = kv["value"] if kv else None
+                expect = _b64d(cmp.get("value", ""))
+                if actual is None:
+                    # etcd: a VALUE compare against a missing key fails
+                    return False
+            elif target == "CREATE":
+                actual = kv["create_revision"] if kv else 0
+                expect = _int(cmp.get("create_revision"))
+            elif target == "MOD":
+                actual = kv["mod_revision"] if kv else 0
+                expect = _int(cmp.get("mod_revision"))
+            elif target == "VERSION":
+                actual = kv["version"] if kv else 0
+                expect = _int(cmp.get("version"))
+            else:
+                raise ValueError(f"unknown compare target {target!r}")
+        if result == "EQUAL":
+            return actual == expect
+        if result == "NOT_EQUAL":
+            return actual != expect
+        if result == "GREATER":
+            return actual > expect
+        if result == "LESS":
+            return actual < expect
+        raise ValueError(f"unknown compare result {result!r}")
+
+    def apply_op(self, op: dict) -> dict:
+        if "request_put" in op:
+            req = op["request_put"]
+            self.put(
+                _b64d(req.get("key", "")), _b64d(req.get("value", "")),
+                _int(req.get("lease")),
+            )
+            return {"response_put": {}}
+        if "request_range" in op:
+            req = op["request_range"]
+            kvs, count = self.range(
+                _b64d(req.get("key", "")), _b64d(req.get("range_end", "")),
+                _int(req.get("limit")),
+            )
+            return {"response_range": {"kvs": kvs, "count": str(count)}}
+        if "request_delete_range" in op:
+            req = op["request_delete_range"]
+            deleted = self.delete_range(
+                _b64d(req.get("key", "")), _b64d(req.get("range_end", "")),
+            )
+            return {"response_delete_range": {"deleted": str(deleted)}}
+        raise ValueError(f"unknown txn op {sorted(op)!r}")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "fake-etcd/3.5"
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _reply(self, status: int, obj: dict):
+        body = json.dumps(obj).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):  # noqa: N802 — http.server naming
+        state: FakeEtcdState = self.server.state  # type: ignore[attr-defined]
+        srv = self.server
+        with srv.knob_lock:  # type: ignore[attr-defined]
+            if srv.fail_queue:  # type: ignore[attr-defined]
+                status = srv.fail_queue.pop(0)  # type: ignore[attr-defined]
+                self._reply(status, {"error": "injected failure",
+                                     "code": 14})
+                return
+        length = int(self.headers.get("Content-Length", "0"))
+        try:
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError:
+            self._reply(400, {"error": "bad json", "code": 3})
+            return
+        state.expire_leases()
+        try:
+            handler = {
+                "/v3/kv/range": self._kv_range,
+                "/v3/kv/put": self._kv_put,
+                "/v3/kv/deleterange": self._kv_delete,
+                "/v3/kv/txn": self._kv_txn,
+                "/v3/lease/grant": self._lease_grant,
+                "/v3/lease/keepalive": self._lease_keepalive,
+                "/v3/lease/revoke": self._lease_revoke,
+            }.get(self.path)
+            if handler is None:
+                self._reply(404, {"error": f"no route {self.path}",
+                                  "code": 12})
+                return
+            handler(state, req)
+        except KeyError as exc:
+            self._reply(400, {"error": str(exc.args[0]), "code": 5})
+        except (ValueError, TypeError) as exc:
+            self._reply(400, {"error": str(exc), "code": 3})
+
+    def _header(self, state: FakeEtcdState) -> dict:
+        return {"revision": str(state.revision)}
+
+    def _kv_range(self, state: FakeEtcdState, req: dict):
+        kvs, count = state.range(
+            _b64d(req.get("key", "")), _b64d(req.get("range_end", "")),
+            _int(req.get("limit")),
+        )
+        self._reply(200, {"header": self._header(state), "kvs": kvs,
+                          "count": str(count)})
+
+    def _kv_put(self, state: FakeEtcdState, req: dict):
+        state.put(
+            _b64d(req.get("key", "")), _b64d(req.get("value", "")),
+            _int(req.get("lease")),
+        )
+        self._reply(200, {"header": self._header(state)})
+
+    def _kv_delete(self, state: FakeEtcdState, req: dict):
+        deleted = state.delete_range(
+            _b64d(req.get("key", "")), _b64d(req.get("range_end", "")),
+        )
+        self._reply(200, {"header": self._header(state),
+                          "deleted": str(deleted)})
+
+    def _kv_txn(self, state: FakeEtcdState, req: dict):
+        with state.lock:
+            ok = all(state.check_compare(c) for c in req.get("compare", []))
+            ops = req.get("success" if ok else "failure", []) or []
+            responses = [state.apply_op(op) for op in ops]
+        self._reply(200, {"header": self._header(state),
+                          "succeeded": ok, "responses": responses})
+
+    def _lease_grant(self, state: FakeEtcdState, req: dict):
+        lease = state.grant(float(_int(req.get("TTL"), 5)),
+                            _int(req.get("ID")))
+        self._reply(200, {"header": self._header(state),
+                          "ID": str(lease.id), "TTL": str(int(lease.ttl_s))})
+
+    def _lease_keepalive(self, state: FakeEtcdState, req: dict):
+        lease_id = _int(req.get("ID"))
+        ttl = state.keepalive(lease_id)
+        self._reply(200, {"result": {
+            "header": self._header(state),
+            "ID": str(lease_id), "TTL": str(int(ttl)),
+        }})
+
+    def _lease_revoke(self, state: FakeEtcdState, req: dict):
+        state.revoke(_int(req.get("ID")))
+        self._reply(200, {"header": self._header(state)})
+
+
+class FakeEtcdServer:
+    """Loopback fake etcd: `start()` binds an ephemeral port, `endpoint`
+    is a ready-to-use `host:port` for `remote.etcd_endpoints`.
+
+    Chaos knobs: `fail_requests(n, status)` makes the next n requests
+    answer with an injected 5xx (transient-classifier fodder); pass a
+    `clock` callable to drive lease expiry without sleeping.
+    """
+
+    def __init__(self, clock=None):
+        self.state = FakeEtcdState(clock=clock)
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.state = self.state  # type: ignore[attr-defined]
+        self._httpd.fail_queue = []  # type: ignore[attr-defined]
+        self._httpd.knob_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def endpoint(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def fail_requests(self, n: int, status: int = 503):
+        with self._httpd.knob_lock:  # type: ignore[attr-defined]
+            self._httpd.fail_queue.extend([status] * n)  # type: ignore[attr-defined]
+
+    def start(self) -> "FakeEtcdServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fake-etcd", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "FakeEtcdServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

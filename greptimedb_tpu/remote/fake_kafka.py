@@ -1,0 +1,349 @@
+"""Offline fake Kafka broker speaking the binary wire framing.
+
+Implements the slice of the Kafka protocol the remote WAL needs —
+Produce / Fetch / ListOffsets / DeleteRecords / InitProducerId — over
+the real framing shape (`[i32 size][i16 api_key][i16 api_version]
+[i32 correlation_id][i16 client_id_len][client_id][body]`, big-endian,
+length-prefixed strings/bytes), with the two broker behaviors the
+durability contract actually leans on:
+
+  * **idempotent-producer sequence numbers**: each producer's batches
+    carry a base sequence per topic; a duplicate (a client retry of an
+    already-applied batch whose ack was lost) is acked again with the
+    original offset instead of being appended twice, and a gap is
+    rejected with OUT_OF_ORDER_SEQUENCE_NUMBER — this is what makes
+    "broker kill mid-group-commit loses no acked row AND duplicates no
+    row" provable;
+  * **segment retention**: records live in bounded segments;
+    DeleteRecords advances the log-start offset and whole segments below
+    it are dropped, mirroring how the reference's wal-prune procedure
+    trims Kafka.
+
+Chaos knobs: `lose_acks(n)` appends the next n produce batches but cuts
+the connection before the ack (the retry/dedupe scenario);
+`fail_produce(n, code)` rejects with a retriable error code;
+`stop()`/`restart()` bounce the listener while keeping the log (a broker
+restart with its disk intact).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+API_PRODUCE = 0
+API_FETCH = 1
+API_LIST_OFFSETS = 2
+API_DELETE_RECORDS = 21
+API_INIT_PRODUCER_ID = 22
+
+ERR_NONE = 0
+ERR_OFFSET_OUT_OF_RANGE = 1
+ERR_UNKNOWN_TOPIC = 3
+ERR_REQUEST_TIMED_OUT = 7
+ERR_OUT_OF_ORDER_SEQUENCE = 45
+
+SEGMENT_RECORDS_DEFAULT = 256
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise ValueError("short frame")
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def i16(self) -> int:
+        return struct.unpack(">h", self.take(2))[0]
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self.take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack(">q", self.take(8))[0]
+
+    def string(self) -> str:
+        return self.take(self.i16()).decode("utf-8")
+
+    def bytes_(self) -> bytes:
+        n = self.i32()
+        return b"" if n < 0 else self.take(n)
+
+
+def _str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack(">h", len(b)) + b
+
+
+def _bytes(b: bytes) -> bytes:
+    return struct.pack(">i", len(b)) + b
+
+
+class _Segment:
+    __slots__ = ("base", "records")
+
+    def __init__(self, base: int):
+        self.base = base
+        self.records: list[tuple[int, bytes, bytes]] = []  # (offset, k, v)
+
+
+class _Topic:
+    def __init__(self, segment_records: int):
+        self.segment_records = segment_records
+        self.segments: list[_Segment] = [_Segment(0)]
+        self.next_offset = 0
+        self.log_start = 0
+        # idempotence: producer_id -> (next expected seq, last acked
+        # (base_seq, base_offset)) — enough to re-ack the most recent
+        # duplicate, which is the only retry the wire client ever sends
+        self.producers: dict[int, tuple[int, tuple[int, int]]] = {}
+
+    def append(self, key: bytes, value: bytes) -> int:
+        seg = self.segments[-1]
+        if len(seg.records) >= self.segment_records:
+            seg = _Segment(self.next_offset)
+            self.segments.append(seg)
+        off = self.next_offset
+        seg.records.append((off, key, value))
+        self.next_offset += 1
+        return off
+
+    def fetch(self, offset: int, max_records: int):
+        out = []
+        for seg in self.segments:
+            if not seg.records or seg.records[-1][0] < offset:
+                continue
+            for rec in seg.records:
+                if rec[0] >= offset:
+                    out.append(rec)
+                    if len(out) >= max_records:
+                        return out
+        return out
+
+    def delete_before(self, before: int) -> int:
+        self.log_start = max(self.log_start, min(before, self.next_offset))
+        # segment retention: drop whole segments strictly below log-start
+        while (len(self.segments) > 1
+               and self.segments[0].records
+               and self.segments[0].records[-1][0] < self.log_start):
+            self.segments.pop(0)
+        return self.log_start
+
+
+class FakeKafkaState:
+    def __init__(self, segment_records: int = SEGMENT_RECORDS_DEFAULT):
+        self.lock = threading.RLock()
+        self.topics: dict[str, _Topic] = {}
+        self.segment_records = segment_records
+        self.next_producer_id = 7000
+        # chaos knobs
+        self.ack_loss_budget = 0
+        self.produce_fail_queue: list[int] = []
+
+    def topic(self, name: str) -> _Topic:
+        with self.lock:
+            t = self.topics.get(name)
+            if t is None:
+                t = _Topic(self.segment_records)
+                self.topics[name] = t
+            return t
+
+
+class _LostAck(Exception):
+    """Raised after a successful append to make the handler cut the
+    connection instead of acking — the client-visible shape of an ack
+    lost on the wire."""
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        state: FakeKafkaState = self.server.state  # type: ignore[attr-defined]
+        sock = self.request
+        try:
+            while True:
+                head = self._recv_exactly(sock, 4)
+                if head is None:
+                    return
+                (size,) = struct.unpack(">i", head)
+                frame = self._recv_exactly(sock, size)
+                if frame is None:
+                    return  # torn request: never applied, never acked
+                try:
+                    resp = self._dispatch(state, frame)
+                except _LostAck:
+                    return  # applied, but the ack never makes it out
+                except ValueError:
+                    return  # malformed frame: drop the connection
+                sock.sendall(struct.pack(">i", len(resp)) + resp)
+        except (ConnectionError, OSError):
+            return
+
+    @staticmethod
+    def _recv_exactly(sock: socket.socket, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _dispatch(self, state: FakeKafkaState, frame: bytes) -> bytes:
+        r = _Reader(frame)
+        api_key = r.i16()
+        r.i16()  # api_version — single-version fake
+        corr = r.i32()
+        r.string()  # client_id
+        body = {
+            API_PRODUCE: self._produce,
+            API_FETCH: self._fetch,
+            API_LIST_OFFSETS: self._list_offsets,
+            API_DELETE_RECORDS: self._delete_records,
+            API_INIT_PRODUCER_ID: self._init_producer_id,
+        }[api_key](state, r)
+        return struct.pack(">i", corr) + body
+
+    def _init_producer_id(self, state: FakeKafkaState, r: _Reader) -> bytes:
+        with state.lock:
+            state.next_producer_id += 1
+            pid = state.next_producer_id
+        return struct.pack(">hq", ERR_NONE, pid)
+
+    def _produce(self, state: FakeKafkaState, r: _Reader) -> bytes:
+        topic_name = r.string()
+        producer_id = r.i64()
+        base_seq = r.i32()
+        n = r.i32()
+        records = [(r.bytes_(), r.bytes_()) for _ in range(n)]
+        with state.lock:
+            if state.produce_fail_queue:
+                code = state.produce_fail_queue.pop(0)
+                return struct.pack(">hq", code, -1)
+            topic = state.topic(topic_name)
+            expected, last_ack = topic.producers.get(producer_id, (0, (-1, -1)))
+            if base_seq == last_ack[0]:
+                # duplicate of the last applied batch: re-ack, no append
+                return struct.pack(">hq", ERR_NONE, last_ack[1])
+            if base_seq != expected:
+                return struct.pack(
+                    ">hq", ERR_OUT_OF_ORDER_SEQUENCE, -1
+                )
+            base_offset = -1
+            for key, value in records:
+                off = topic.append(key, value)
+                if base_offset < 0:
+                    base_offset = off
+            topic.producers[producer_id] = (
+                expected + n, (base_seq, base_offset)
+            )
+            if state.ack_loss_budget > 0:
+                state.ack_loss_budget -= 1
+                raise _LostAck()
+        return struct.pack(">hq", ERR_NONE, base_offset)
+
+    def _fetch(self, state: FakeKafkaState, r: _Reader) -> bytes:
+        topic_name = r.string()
+        offset = r.i64()
+        max_records = r.i32()
+        with state.lock:
+            topic = state.topic(topic_name)
+            if offset < topic.log_start:
+                return struct.pack(
+                    ">hqqi", ERR_OFFSET_OUT_OF_RANGE,
+                    topic.log_start, topic.next_offset, 0,
+                )
+            recs = topic.fetch(offset, max_records)
+            out = struct.pack(
+                ">hqqi", ERR_NONE, topic.log_start, topic.next_offset,
+                len(recs),
+            )
+            for off, key, value in recs:
+                out += struct.pack(">q", off) + _bytes(key) + _bytes(value)
+            return out
+
+    def _list_offsets(self, state: FakeKafkaState, r: _Reader) -> bytes:
+        topic_name = r.string()
+        with state.lock:
+            topic = state.topic(topic_name)
+            return struct.pack(
+                ">hqq", ERR_NONE, topic.log_start, topic.next_offset
+            )
+
+    def _delete_records(self, state: FakeKafkaState, r: _Reader) -> bytes:
+        topic_name = r.string()
+        before = r.i64()
+        with state.lock:
+            topic = state.topic(topic_name)
+            new_start = topic.delete_before(before)
+            return struct.pack(">hq", ERR_NONE, new_start)
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class FakeKafkaBroker:
+    """Loopback fake broker.  `stop()`/`restart()` bounce the listener
+    while `state` (the log) survives — the chaos suite's broker kill."""
+
+    def __init__(self, segment_records: int = SEGMENT_RECORDS_DEFAULT):
+        self.state = FakeKafkaState(segment_records=segment_records)
+        self._port = 0
+        self._server: _Server | None = None
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @property
+    def endpoint(self) -> str:
+        return f"127.0.0.1:{self._port}"
+
+    # ---- chaos knobs ---------------------------------------------------
+    def lose_acks(self, n: int):
+        with self.state.lock:
+            self.state.ack_loss_budget += n
+
+    def fail_produce(self, n: int, code: int = ERR_REQUEST_TIMED_OUT):
+        with self.state.lock:
+            self.state.produce_fail_queue.extend([code] * n)
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self) -> "FakeKafkaBroker":
+        self._server = _Server(("127.0.0.1", self._port), _Handler)
+        self._server.state = self.state  # type: ignore[attr-defined]
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="fake-kafka", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def restart(self):
+        """Rebind the same port over the surviving log."""
+        self.stop()
+        self.start()
+
+    def __enter__(self) -> "FakeKafkaBroker":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

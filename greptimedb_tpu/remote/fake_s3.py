@@ -1,0 +1,424 @@
+"""Offline fake S3 HTTP server (the REST/XML dialect, SigV4-verified).
+
+Serves the slice of the S3 API the object-store adapter uses, at real
+wire fidelity where the contracts live:
+
+  * **SigV4**: every request's `Authorization` header is re-derived
+    server-side (canonical request -> string-to-sign -> HMAC chain with
+    the configured secret) and the payload is checked against
+    `x-amz-content-sha256` — a mis-signed or tampered request gets the
+    genuine 403 `SignatureDoesNotMatch` XML;
+  * **ranged GET** (`Range: bytes=a-b` -> 206 + Content-Range);
+  * **multipart upload** (POST `?uploads` -> UploadId, PUT
+    `?partNumber=N&uploadId=`, POST complete with part manifest, DELETE
+    abort);
+  * **conditional PUT** (`If-None-Match: *` -> 412 when the key exists);
+  * **503 SlowDown** throttling via the `slow_down(n)` chaos knob, with
+    a Retry-After header the client's backoff must honor.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import threading
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from xml.sax.saxutils import escape
+
+DEFAULT_ACCESS_KEY = "greptime-test-ak"
+DEFAULT_SECRET_KEY = "greptime-test-sk"
+
+
+def _sha256(b: bytes) -> str:
+    return hashlib.sha256(b).hexdigest()
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode("utf-8"), hashlib.sha256).digest()
+
+
+def sigv4_signature(secret: str, date_stamp: str, region: str,
+                    string_to_sign: str) -> str:
+    k = _hmac(("AWS4" + secret).encode("utf-8"), date_stamp)
+    k = _hmac(k, region)
+    k = _hmac(k, "s3")
+    k = _hmac(k, "aws4_request")
+    return hmac.new(
+        k, string_to_sign.encode("utf-8"), hashlib.sha256
+    ).hexdigest()
+
+
+class FakeS3State:
+    def __init__(self, access_key: str, secret_key: str, region: str):
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.lock = threading.RLock()
+        self.buckets: dict[str, dict[str, bytes]] = {}
+        self.uploads: dict[str, dict] = {}  # id -> {bucket, key, parts}
+        self.slow_down_budget = 0
+        self.slow_down_retry_after = 0.05
+        self.request_count = 0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "fake-s3/1.0"
+
+    def log_message(self, *args):
+        pass
+
+    # ---- plumbing ------------------------------------------------------
+    def _reply(self, status: int, body: bytes = b"",
+               headers: dict | None = None):
+        self.send_response(status)
+        hdrs = {"Content-Length": str(len(body))}
+        if headers:
+            hdrs.update(headers)
+        for k, v in hdrs.items():
+            self.send_header(k, v)
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _error(self, status: int, code: str, message: str,
+               headers: dict | None = None):
+        body = (
+            "<?xml version=\"1.0\"?><Error>"
+            f"<Code>{escape(code)}</Code>"
+            f"<Message>{escape(message)}</Message></Error>"
+        ).encode("utf-8")
+        hdrs = {"Content-Type": "application/xml"}
+        if headers:
+            hdrs.update(headers)
+        self._reply(status, body, hdrs)
+
+    def _state(self) -> FakeS3State:
+        return self.server.state  # type: ignore[attr-defined]
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(length) if length else b""
+
+    # ---- sigv4 verification --------------------------------------------
+    def _verify_sig(self, body: bytes) -> bool:
+        state = self._state()
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256 "):
+            self._error(403, "AccessDenied", "missing sigv4 authorization")
+            return False
+        try:
+            fields = dict(
+                part.strip().split("=", 1)
+                for part in auth[len("AWS4-HMAC-SHA256 "):].split(",")
+            )
+            access_key, date_stamp, region, service, term = (
+                fields["Credential"].split("/")
+            )
+            signed_headers = fields["SignedHeaders"].split(";")
+            got_sig = fields["Signature"]
+        except (KeyError, ValueError):
+            self._error(403, "AccessDenied", "malformed authorization")
+            return False
+        if access_key != state.access_key:
+            self._error(403, "InvalidAccessKeyId", access_key)
+            return False
+        payload_hash = self.headers.get("x-amz-content-sha256", "")
+        if payload_hash != _sha256(body):
+            self._error(400, "XAmzContentSHA256Mismatch",
+                        "payload hash mismatch")
+            return False
+        parsed = urllib.parse.urlsplit(self.path)
+        query = urllib.parse.parse_qsl(
+            parsed.query, keep_blank_values=True
+        )
+        canonical_query = "&".join(
+            f"{urllib.parse.quote(k, safe='')}"
+            f"={urllib.parse.quote(v, safe='')}"
+            for k, v in sorted(query)
+        )
+        canonical_headers = "".join(
+            f"{h}:{(self.headers.get(h) or '').strip()}\n"
+            for h in signed_headers
+        )
+        canonical_request = "\n".join([
+            self.command, urllib.parse.quote(parsed.path, safe="/"),
+            canonical_query, canonical_headers,
+            ";".join(signed_headers), payload_hash,
+        ])
+        amz_date = self.headers.get("x-amz-date", "")
+        string_to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date,
+            f"{date_stamp}/{region}/{service}/{term}",
+            _sha256(canonical_request.encode("utf-8")),
+        ])
+        want_sig = sigv4_signature(
+            state.secret_key, date_stamp, region, string_to_sign
+        )
+        if not hmac.compare_digest(want_sig, got_sig):
+            self._error(403, "SignatureDoesNotMatch", "signature mismatch")
+            return False
+        return True
+
+    # ---- request gate --------------------------------------------------
+    def _gate(self) -> tuple[bytes, str, str, dict] | None:
+        """Common front half: throttling knob, body, sigv4, path parse.
+        Returns (body, bucket, key, query) or None if already replied."""
+        state = self._state()
+        body = self._read_body()
+        with state.lock:
+            state.request_count += 1
+            if state.slow_down_budget > 0:
+                state.slow_down_budget -= 1
+                retry_after = state.slow_down_retry_after
+                self._error(
+                    503, "SlowDown", "Please reduce your request rate.",
+                    headers={"Retry-After": f"{retry_after:.3f}"},
+                )
+                return None
+        if not self._verify_sig(body):
+            return None
+        parsed = urllib.parse.urlsplit(self.path)
+        parts = parsed.path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        query = dict(
+            urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
+        )
+        return body, bucket, key, query
+
+    def _bucket(self, name: str) -> dict[str, bytes]:
+        state = self._state()
+        with state.lock:
+            return state.buckets.setdefault(name, {})
+
+    # ---- verbs ---------------------------------------------------------
+    def do_PUT(self):  # noqa: N802
+        gate = self._gate()
+        if gate is None:
+            return
+        body, bucket, key, query = gate
+        state = self._state()
+        objs = self._bucket(bucket)
+        if "partNumber" in query and "uploadId" in query:
+            with state.lock:
+                up = state.uploads.get(query["uploadId"])
+                if up is None or up["bucket"] != bucket or up["key"] != key:
+                    self._error(404, "NoSuchUpload", query["uploadId"])
+                    return
+                up["parts"][int(query["partNumber"])] = body
+            self._reply(200, headers={"ETag": f'"{_sha256(body)[:32]}"'})
+            return
+        with state.lock:
+            if self.headers.get("If-None-Match") == "*" and key in objs:
+                self._error(412, "PreconditionFailed",
+                            "object already exists")
+                return
+            objs[key] = body
+        self._reply(200, headers={"ETag": f'"{_sha256(body)[:32]}"'})
+
+    def do_GET(self):  # noqa: N802
+        gate = self._gate()
+        if gate is None:
+            return
+        _body, bucket, key, query = gate
+        state = self._state()
+        objs = self._bucket(bucket)
+        if not key and "list-type" in query:
+            self._list(objs, query)
+            return
+        with state.lock:
+            data = objs.get(key)
+        if data is None:
+            self._error(404, "NoSuchKey", key)
+            return
+        rng = self.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            spec = rng[len("bytes="):]
+            start_s, _, end_s = spec.partition("-")
+            start = int(start_s)
+            end = int(end_s) if end_s else len(data) - 1
+            end = min(end, len(data) - 1)
+            if start >= len(data):
+                self._error(416, "InvalidRange", rng)
+                return
+            chunk = data[start:end + 1]
+            self._reply(206, chunk, headers={
+                "Content-Range": f"bytes {start}-{end}/{len(data)}",
+            })
+            return
+        self._reply(200, data)
+
+    def _list(self, objs: dict[str, bytes], query: dict):
+        prefix = query.get("prefix", "")
+        delimiter = query.get("delimiter", "")
+        state = self._state()
+        with state.lock:
+            keys = sorted(k for k in objs if k.startswith(prefix))
+            sizes = {k: len(objs[k]) for k in keys}
+        contents: list[str] = []
+        common: list[str] = []
+        seen: set[str] = set()
+        for k in keys:
+            rest = k[len(prefix):]
+            if delimiter and delimiter in rest:
+                cp = prefix + rest.split(delimiter, 1)[0] + delimiter
+                if cp not in seen:
+                    seen.add(cp)
+                    common.append(cp)
+                continue
+            contents.append(k)
+        xml = ["<?xml version=\"1.0\"?><ListBucketResult>"]
+        for k in contents:
+            xml.append(
+                f"<Contents><Key>{escape(k)}</Key>"
+                f"<Size>{sizes[k]}</Size></Contents>"
+            )
+        for cp in common:
+            xml.append(
+                f"<CommonPrefixes><Prefix>{escape(cp)}</Prefix>"
+                "</CommonPrefixes>"
+            )
+        xml.append("</ListBucketResult>")
+        self._reply(200, "".join(xml).encode("utf-8"),
+                    headers={"Content-Type": "application/xml"})
+
+    def do_HEAD(self):  # noqa: N802
+        # HEAD carries no body and must not write one on errors either
+        state = self._state()
+        with state.lock:
+            state.request_count += 1
+            if state.slow_down_budget > 0:
+                state.slow_down_budget -= 1
+                self.send_response(503)
+                self.send_header("Retry-After",
+                                 f"{state.slow_down_retry_after:.3f}")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+        if not self._verify_sig(b""):
+            return
+        parsed = urllib.parse.urlsplit(self.path)
+        parts = parsed.path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        objs = self._bucket(bucket)
+        with state.lock:
+            data = objs.get(key)
+        if data is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+
+    def do_DELETE(self):  # noqa: N802
+        gate = self._gate()
+        if gate is None:
+            return
+        _body, bucket, key, query = gate
+        state = self._state()
+        if "uploadId" in query:
+            with state.lock:
+                state.uploads.pop(query["uploadId"], None)
+            self._reply(204)
+            return
+        objs = self._bucket(bucket)
+        with state.lock:
+            objs.pop(key, None)
+        self._reply(204)
+
+    def do_POST(self):  # noqa: N802
+        gate = self._gate()
+        if gate is None:
+            return
+        body, bucket, key, query = gate
+        state = self._state()
+        if "uploads" in query:
+            upload_id = uuid.uuid4().hex
+            with state.lock:
+                state.uploads[upload_id] = {
+                    "bucket": bucket, "key": key, "parts": {},
+                }
+            xml = (
+                "<?xml version=\"1.0\"?><InitiateMultipartUploadResult>"
+                f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+                f"<UploadId>{upload_id}</UploadId>"
+                "</InitiateMultipartUploadResult>"
+            ).encode("utf-8")
+            self._reply(200, xml,
+                        headers={"Content-Type": "application/xml"})
+            return
+        if "uploadId" in query:
+            with state.lock:
+                up = state.uploads.pop(query["uploadId"], None)
+                if up is None or up["bucket"] != bucket or up["key"] != key:
+                    self._error(404, "NoSuchUpload",
+                                query.get("uploadId", ""))
+                    return
+                if not up["parts"]:
+                    self._error(400, "InvalidRequest", "no parts uploaded")
+                    return
+                assembled = b"".join(
+                    up["parts"][n] for n in sorted(up["parts"])
+                )
+                self._bucket(bucket)[key] = assembled
+            xml = (
+                "<?xml version=\"1.0\"?><CompleteMultipartUploadResult>"
+                f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+                "</CompleteMultipartUploadResult>"
+            ).encode("utf-8")
+            self._reply(200, xml,
+                        headers={"Content-Type": "application/xml"})
+            return
+        self._error(400, "InvalidRequest", "unsupported POST")
+
+
+class FakeS3Server:
+    """Loopback fake S3.  `slow_down(n)` makes the next n requests
+    answer 503 SlowDown + Retry-After (the throttle-storm chaos knob)."""
+
+    def __init__(self, access_key: str = DEFAULT_ACCESS_KEY,
+                 secret_key: str = DEFAULT_SECRET_KEY,
+                 region: str = "us-east-1"):
+        self.state = FakeS3State(access_key, secret_key, region)
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.state = self.state  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def endpoint(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def slow_down(self, n: int, retry_after_s: float = 0.05):
+        with self.state.lock:
+            self.state.slow_down_budget += n
+            self.state.slow_down_retry_after = retry_after_s
+
+    def start(self) -> "FakeS3Server":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fake-s3", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "FakeS3Server":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,426 @@
+"""Kafka wire client + shared-WAL adapter over the binary framing.
+
+`KafkaWireClient` speaks the broker dialect `fake_kafka.py` serves
+(Produce / Fetch / ListOffsets / DeleteRecords / InitProducerId over
+`[i32 size][i16 api_key][i16 api_version][i32 corr_id][client_id]
+[body]`), routed through the shared `WireBackend` so every exchange
+gets pooling, deadlines, per-protocol retries (retriable Kafka error
+codes + transport failures), breaker shedding, and the `wire.kafka`
+fault point.
+
+`KafkaSharedLog` then implements the exact store surface
+`storage/remote_wal.py`'s `RemoteRegionWal` consumes — append /
+append_group / read / last_entry_id / set_flushed / follower
+low-watermarks / prune — so the engine's remote-WAL path runs unchanged
+over a broker instead of a shared directory:
+
+  * WAL frames become records: key = `(region_id, entry_id)` (group
+    frames set the same bit-62 GROUP_FLAG in the key), value = the very
+    payload `_encode_batch`/`_encode_group` produce, so replay decoding
+    is shared with the file-backed store byte for byte;
+  * durability of the ack rides **idempotent-producer sequences**: the
+    client stamps each produce with a per-topic base sequence, so a
+    retried batch whose ack was lost is re-acked by the broker, never
+    appended twice — group commit loses no acked row and duplicates
+    none across a broker kill;
+  * flushed watermarks and follower replay positions live as control
+    records in a `__meta` topic (latest record wins, tombstones
+    unregister), replacing `flushed.json`/`followers.json`;
+  * prune = the longest record prefix where every entry is flushed and
+    follower-covered, advanced via DeleteRecords — order preserved,
+    holes never punched, exactly like the segment store.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+import time
+
+from ..storage.wal import GROUP_FLAG, WalEntry, _decode_batch, _decode_group
+from ..utils import fault_injection, metrics
+from ..utils.errors import StorageError
+from .fake_kafka import (
+    API_DELETE_RECORDS,
+    API_FETCH,
+    API_INIT_PRODUCER_ID,
+    API_LIST_OFFSETS,
+    API_PRODUCE,
+    ERR_NONE,
+    ERR_OFFSET_OUT_OF_RANGE,
+    ERR_OUT_OF_ORDER_SEQUENCE,
+    _Reader,
+    _bytes,
+    _str,
+)
+from .wire import RemoteProtocolError, WireBackend, parse_endpoints
+
+# codes the broker may answer transiently (timeouts, leadership churn)
+_RETRIABLE_CODES = frozenset({5, 6, 7, 14})
+_KEY = struct.Struct(">QQ")
+META_TOPIC = "__meta"
+FETCH_MAX_RECORDS = 512
+
+
+class KafkaProtocolError(RemoteProtocolError):
+    def __init__(self, api: str, code: int):
+        super().__init__(
+            f"kafka {api} failed with error code {code}",
+            retriable=code in _RETRIABLE_CODES,
+        )
+        self.code = code
+
+
+class KafkaWireClient:
+    """One producer identity + framed request/response exchanges."""
+
+    def __init__(self, endpoints: str, *, client_id: str = "greptime",
+                 name: str = "kafka", **wire_kw):
+        self.client_id = client_id
+        self.wire = WireBackend(
+            "kafka", parse_endpoints(endpoints), name=name, **wire_kw
+        )
+        self._corr = 0
+        self._corr_lock = threading.Lock()
+        self._producer_id: int | None = None
+
+    def close(self):
+        self.wire.close()
+
+    # ---- framing -------------------------------------------------------
+    def _exchange(self, op: str, api_key: int, body: bytes) -> _Reader:
+        with self._corr_lock:
+            self._corr += 1
+            corr = self._corr
+        frame = (
+            struct.pack(">hhi", api_key, 0, corr)
+            + _str(self.client_id) + body
+        )
+        wire_frame = struct.pack(">i", len(frame)) + frame
+
+        def call(conn):
+            conn.send(wire_frame)
+            (size,) = struct.unpack(">i", conn.recv_exactly(4))
+            resp = _Reader(conn.recv_exactly(size))
+            got_corr = resp.i32()
+            if got_corr != corr:
+                raise RemoteProtocolError(
+                    f"kafka correlation mismatch: sent {corr}, got "
+                    f"{got_corr}", retriable=True,
+                )
+            return resp
+
+        return self.wire.call(op, call)
+
+    # ---- protocol ops --------------------------------------------------
+    def producer_id(self) -> int:
+        if self._producer_id is None:
+            r = self._exchange("init_producer_id", API_INIT_PRODUCER_ID, b"")
+            code, pid = r.i16(), r.i64()
+            if code != ERR_NONE:
+                raise KafkaProtocolError("init_producer_id", code)
+            self._producer_id = pid
+        return self._producer_id
+
+    def produce(self, topic: str, records: list[tuple[bytes, bytes]],
+                base_seq: int) -> int:
+        body = (
+            _str(topic)
+            + struct.pack(">qii", self.producer_id(), base_seq, len(records))
+            + b"".join(_bytes(k) + _bytes(v) for k, v in records)
+        )
+        r = self._exchange("produce", API_PRODUCE, body)
+        code, base_offset = r.i16(), r.i64()
+        if code == ERR_OUT_OF_ORDER_SEQUENCE:
+            raise StorageError(
+                f"kafka producer sequence gap on {topic!r} (seq {base_seq})"
+            )
+        if code != ERR_NONE:
+            raise KafkaProtocolError("produce", code)
+        return base_offset
+
+    def fetch(self, topic: str, offset: int,
+              max_records: int = FETCH_MAX_RECORDS):
+        """-> (log_start, high_watermark, [(offset, key, value)]).
+        A below-log-start offset answers the valid range with no records;
+        the caller restarts at log_start (those entries were pruned, i.e.
+        flushed past)."""
+        body = _str(topic) + struct.pack(">qi", offset, max_records)
+        r = self._exchange("fetch", API_FETCH, body)
+        code = r.i16()
+        log_start, hwm, n = r.i64(), r.i64(), r.i32()
+        if code == ERR_OFFSET_OUT_OF_RANGE:
+            return log_start, hwm, []
+        if code != ERR_NONE:
+            raise KafkaProtocolError("fetch", code)
+        recs = [(r.i64(), r.bytes_(), r.bytes_()) for _ in range(n)]
+        return log_start, hwm, recs
+
+    def list_offsets(self, topic: str) -> tuple[int, int]:
+        r = self._exchange("list_offsets", API_LIST_OFFSETS, _str(topic))
+        code = r.i16()
+        if code != ERR_NONE:
+            raise KafkaProtocolError("list_offsets", code)
+        return r.i64(), r.i64()
+
+    def delete_records(self, topic: str, before_offset: int) -> int:
+        body = _str(topic) + struct.pack(">q", before_offset)
+        r = self._exchange("delete_records", API_DELETE_RECORDS, body)
+        code = r.i16()
+        if code != ERR_NONE:
+            raise KafkaProtocolError("delete_records", code)
+        return r.i64()
+
+
+class KafkaSharedLog:
+    """`SharedLogStore`-surface adapter over a broker (what the engine's
+    `RemoteRegionWal` plugs into when `wal_provider = "kafka"`)."""
+
+    def __init__(self, endpoints: str, *, client_id: str = "greptime",
+                 follower_lw_ttl_s: float = 600.0, **wire_kw):
+        self.client = KafkaWireClient(
+            endpoints, client_id=client_id, **wire_kw
+        )
+        self.follower_lw_ttl_s = follower_lw_ttl_s
+        self._lock = threading.RLock()
+        self._seq: dict[str, int] = {}          # per-topic produce sequence
+        self._topics: set[str] = set()
+        self._flushed: dict[str, int] = {}
+        self._followers: dict[str, dict[str, list]] = {}
+        self._meta_offset = 0
+
+    # ---- meta topic ----------------------------------------------------
+    def _refresh_meta(self):
+        """Fold control records other instances appended since our last
+        look (flushed marks are monotonic-max; follower registrations:
+        latest record wins, an empty value is a tombstone)."""
+        with self._lock:
+            offset = self._meta_offset
+            while True:
+                log_start, hwm, recs = self.client.fetch(META_TOPIC, offset)
+                if not recs and offset < log_start:
+                    offset = log_start
+                    continue
+                for off, key, value in recs:
+                    self._apply_meta(key, value)
+                    offset = off + 1
+                if offset >= hwm or not recs:
+                    break
+            self._meta_offset = max(self._meta_offset, offset)
+
+    def _apply_meta(self, key: bytes, value: bytes):
+        kind, _, rest = key.decode("utf-8").partition(":")
+        if kind == "flushed":
+            mark = int(value)
+            if mark > self._flushed.get(rest, 0):
+                self._flushed[rest] = mark
+        elif kind == "follower":
+            rid, _, holder = rest.partition(":")
+            if not value:
+                holders = self._followers.get(rid)
+                if holders is not None:
+                    holders.pop(holder, None)
+                    if not holders:
+                        del self._followers[rid]
+            else:
+                entry_id, ts = json.loads(value)
+                self._followers.setdefault(rid, {})[holder] = [
+                    int(entry_id), float(ts),
+                ]
+
+    def _append_meta(self, key: str, value: bytes):
+        with self._lock:
+            seq = self._seq.get(META_TOPIC, 0)
+            self.client.produce(
+                META_TOPIC, [(key.encode("utf-8"), value)], seq
+            )
+            self._seq[META_TOPIC] = seq + 1
+
+    # ---- write ---------------------------------------------------------
+    def _produce_frame(self, topic: str, region_id: int, entry_field: int,
+                       payload: bytes):
+        metrics.INGEST_WAL_BYTES.inc(len(payload) + _KEY.size)
+        key = _KEY.pack(region_id, entry_field)
+        with self._lock:
+            self._topics.add(topic)
+            seq = self._seq.get(topic, 0)
+            self.client.produce(topic, [(key, payload)], seq)
+            self._seq[topic] = seq + 1
+
+    def append(self, topic: str, region_id: int, entry_id: int, batch):
+        from ..storage.wal import _encode_batch
+
+        fault_injection.fire("wal.append", topic=topic, region_id=region_id)
+        self._produce_frame(topic, region_id, entry_id, _encode_batch(batch))
+
+    def append_group(self, topic: str, region_id: int, last_entry_id: int,
+                     batches: list):
+        from ..storage.wal import _encode_group
+
+        fault_injection.fire("wal.append", topic=topic, region_id=region_id)
+        head, ipc = _encode_group(batches)
+        self._produce_frame(
+            topic, region_id, last_entry_id | GROUP_FLAG, head + ipc
+        )
+
+    # ---- read ----------------------------------------------------------
+    def _records(self, topic: str):
+        """All live records of a topic in offset order (paged fetches;
+        restarts at log_start when the tail was pruned under us)."""
+        offset = 0
+        while True:
+            log_start, hwm, recs = self.client.fetch(topic, offset)
+            if not recs:
+                if offset < log_start:
+                    offset = log_start
+                    continue
+                return
+            yield from recs
+            offset = recs[-1][0] + 1
+            if offset >= hwm:
+                return
+
+    def read(self, topic: str, region_id: int, from_entry_id: int):
+        self._topics.add(topic)
+        for _off, key, payload in self._records(topic):
+            rid, entry_field = _KEY.unpack(key)
+            if rid != region_id:
+                continue
+            if entry_field & GROUP_FLAG:
+                last = entry_field & ~GROUP_FLAG
+                subs = _decode_group(payload)
+                first = last - len(subs) + 1
+                for i, b in enumerate(subs):
+                    if first + i > from_entry_id:
+                        yield WalEntry(first + i, b)
+            elif entry_field > from_entry_id:
+                yield WalEntry(entry_field, _decode_batch(payload))
+
+    def last_entry_id(self, topic: str, region_id: int) -> int:
+        self._refresh_meta()
+        last = 0
+        for entry in self.read(topic, region_id, 0):
+            last = entry.entry_id
+        return max(last, self._flushed.get(str(region_id), 0))
+
+    def topics(self) -> list[str]:
+        return sorted(self._topics)
+
+    # ---- flush watermarks & follower low-watermarks --------------------
+    def set_flushed(self, region_id: int, entry_id: int):
+        with self._lock:
+            key = str(region_id)
+            if self._flushed.get(key, 0) >= entry_id:
+                return
+            self._flushed[key] = entry_id
+            self._append_meta(f"flushed:{key}", str(entry_id).encode())
+
+    def flushed(self, region_id: int) -> int:
+        with self._lock:
+            return self._flushed.get(str(region_id), 0)
+
+    def register_follower(self, region_id: int, holder: str, entry_id: int):
+        with self._lock:
+            rec = [int(entry_id), time.time()]
+            self._followers.setdefault(str(region_id), {})[holder] = rec
+            self._append_meta(
+                f"follower:{region_id}:{holder}",
+                json.dumps(rec).encode("utf-8"),
+            )
+
+    def unregister_follower(self, region_id: int, holder: str):
+        with self._lock:
+            holders = self._followers.get(str(region_id))
+            if holders is not None:
+                holders.pop(holder, None)
+                if not holders:
+                    del self._followers[str(region_id)]
+            self._append_meta(f"follower:{region_id}:{holder}", b"")
+
+    def _follower_lw(self, region_key: str) -> int | None:
+        holders = self._followers.get(region_key)
+        if not holders:
+            return None
+        cutoff = time.time() - self.follower_lw_ttl_s
+        fresh = [e for e, ts in holders.values() if ts >= cutoff]
+        return min(fresh) if fresh else None
+
+    # ---- prune ---------------------------------------------------------
+    def prune(self, topic: str) -> int:
+        """Advance the broker trim point past the longest fully-covered
+        record prefix; returns records pruned.  Same hold rules as the
+        segment store: an unflushed or follower-needed entry stops the
+        walk — order kept, no holes."""
+        with self._lock:
+            self._refresh_meta()
+            log_start, _hwm = self.client.list_offsets(topic)
+            cut = log_start
+            for off, key, _payload in self._records(topic):
+                rid, entry_field = _KEY.unpack(key)
+                last = entry_field & ~GROUP_FLAG
+                region_key = str(rid)
+                if self._flushed.get(region_key, 0) < last:
+                    break
+                lw = self._follower_lw(region_key)
+                if lw is not None and lw < last:
+                    metrics.WAL_PRUNE_HELD_TOTAL.inc()
+                    break
+                cut = off + 1
+            if cut <= log_start:
+                return 0
+            new_start = self.client.delete_records(topic, cut)
+            return max(0, new_start - log_start)
+
+    def prune_all(self) -> int:
+        return sum(self.prune(t) for t in self.topics())
+
+    def close(self):
+        self.client.close()
+
+
+class KafkaWalManager:
+    """`RemoteWalManager` twin with the broker-backed store — same topic
+    sharding (`region % num_topics`), same `RemoteRegionWal` on top."""
+
+    def __init__(self, endpoints: str, num_topics: int = 4, *,
+                 client_id: str = "greptime", **wire_kw):
+        self.store = KafkaSharedLog(
+            endpoints, client_id=client_id, **wire_kw
+        )
+        self.num_topics = max(1, num_topics)
+        # pre-register the topic shard names so prune_all covers every
+        # shard even before the first append lands on it
+        for i in range(self.num_topics):
+            self.store._topics.add(f"topic_{i}")
+        self._regions: dict[int, object] = {}
+        self._lock = threading.Lock()
+
+    def topic_of(self, region_id: int) -> str:
+        return f"topic_{region_id % self.num_topics}"
+
+    def region_wal(self, region_id: int):
+        from ..storage.remote_wal import RemoteRegionWal
+
+        with self._lock:
+            wal = self._regions.get(region_id)
+            if wal is None:
+                wal = RemoteRegionWal(
+                    self.store, self.topic_of(region_id), region_id
+                )
+                self._regions[region_id] = wal
+            return wal
+
+    def drop_region(self, region_id: int):
+        with self._lock:
+            wal = self._regions.pop(region_id, None)
+        if wal is not None:
+            self.store.set_flushed(region_id, wal.last_entry_id)
+
+    def prune(self) -> int:
+        return self.store.prune_all()
+
+    def close(self):
+        with self._lock:
+            self._regions.clear()
+        self.store.close()

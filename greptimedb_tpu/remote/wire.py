@@ -1,0 +1,377 @@
+"""The shared wire resilience layer under every remote backend adapter.
+
+One stack, three protocols.  The etcd, Kafka, and S3 clients all issue
+their calls through a `WireBackend`, which owns:
+
+  * a **connection pool** per endpoint (plain TCP sockets, checked out
+    for the duration of one protocol exchange, discarded on any error so
+    a half-read stream never poisons the next call);
+  * a **per-call deadline** — the socket timeout for every connect/send/
+    recv is clamped to the remaining cooperative deadline from
+    `utils/deadline.py`, so a remote stall surfaces as `TimeoutError`
+    instead of wedging a query past its budget;
+  * a **retry policy** from `utils/retry.py` with a per-protocol
+    transient classifier (an etcd 5xx retries, a txn-compare miss does
+    not; a Kafka retriable error code retries, an out-of-order sequence
+    does not; an S3 503 SlowDown retries honoring Retry-After, a 404
+    does not);
+  * a **circuit breaker** per endpoint (`utils/circuit_breaker.py`) so a
+    dead remote sheds fast instead of making every caller ride the full
+    retry ladder.
+
+Fault points: `wire.<backend>` fires once per attempt before the socket
+work (protocol-level injection: timeouts, protocol errors, throttles),
+and `socket.connect` / `socket.send` / `socket.recv` fire inside the
+connection itself (transport-level injection: resets, drops, partial
+frames via the plan callback, latency).  The chaos suite drives both.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from collections import deque
+
+from ..utils import fault_injection, metrics
+from ..utils.circuit_breaker import CircuitBreaker, CircuitOpenError
+from ..utils.deadline import current_deadline
+from ..utils.errors import ConfigError
+from ..utils.retry import RetryPolicy, is_transient
+
+
+class RemoteProtocolError(Exception):
+    """The remote answered, but with a protocol-level failure.  Carries
+    `retriable` (feeds the per-protocol classifier) and optionally
+    `retry_after_s` (a server-named cooldown the retry policy honors)."""
+
+    def __init__(self, message: str, *, retriable: bool = False,
+                 retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retriable = retriable
+        self.retry_after_s = retry_after_s
+
+
+def parse_endpoints(spec: str) -> list[tuple[str, int]]:
+    """'host:port[,host:port...]' -> [(host, port)].  Raises ConfigError
+    on malformed entries so bad addresses fail at config time, not on
+    the first call."""
+    out: list[tuple[str, int]] = []
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        # tolerate a scheme prefix ("http://h:p") — the wire layer is TCP
+        if "//" in raw:
+            raw = raw.split("//", 1)[1]
+        raw = raw.rstrip("/")
+        host, sep, port = raw.rpartition(":")
+        if not sep or not host:
+            raise ConfigError(
+                f"remote endpoint {raw!r} is not host:port"
+            )
+        try:
+            out.append((host, int(port)))
+        except ValueError:
+            raise ConfigError(
+                f"remote endpoint {raw!r} has a non-numeric port"
+            ) from None
+    if not out:
+        raise ConfigError(f"remote endpoint list {spec!r} is empty")
+    return out
+
+
+def _remaining_timeout(default: float) -> float:
+    """Socket timeout for the next blocking op: the configured per-call
+    deadline, clamped to whatever is left of the cooperative deadline."""
+    d = current_deadline()
+    if d is None:
+        return default
+    remaining = d - time.monotonic()
+    if remaining <= 0:
+        # let the blocking call fail immediately rather than raising a
+        # QueryTimeoutError from a non-query worker thread
+        return 0.001
+    return min(default, remaining)
+
+
+class Connection:
+    """One pooled TCP connection.  Every transport op fires its socket
+    fault point *before* touching the kernel, passing the connection in
+    the ctx so plan callbacks can forge partial frames (send a prefix,
+    then reset) — the fakes then see torn wire bytes, not clean EOFs."""
+
+    def __init__(self, backend: str, host: str, port: int,
+                 connect_timeout_s: float, io_timeout_s: float):
+        self.backend = backend
+        self.host = host
+        self.port = port
+        self.io_timeout_s = io_timeout_s
+        fault_injection.fire(
+            "socket.connect", backend=backend, host=host, port=port
+        )
+        self.sock = socket.create_connection(
+            (host, port), timeout=_remaining_timeout(connect_timeout_s)
+        )
+        self.closed = False
+
+    # raw_* bypass the fault points — plan callbacks use them to emit
+    # deliberately torn frames without recursing into injection.
+    def raw_send(self, data: bytes):
+        self.sock.sendall(data)
+
+    def send(self, data: bytes):
+        fault_injection.fire(
+            "socket.send", backend=self.backend, conn=self, data=data,
+            host=self.host, port=self.port,
+        )
+        self.sock.settimeout(_remaining_timeout(self.io_timeout_s))
+        self.sock.sendall(data)
+
+    def recv_exactly(self, n: int) -> bytes:
+        fault_injection.fire(
+            "socket.recv", backend=self.backend, conn=self, want=n,
+            host=self.host, port=self.port,
+        )
+        self.sock.settimeout(_remaining_timeout(self.io_timeout_s))
+        chunks: list[bytes] = []
+        got = 0
+        while got < n:
+            chunk = self.sock.recv(n - got)
+            if not chunk:
+                raise ConnectionResetError(
+                    f"{self.backend} peer {self.host}:{self.port} closed "
+                    f"mid-frame ({got}/{n} bytes)"
+                )
+            chunks.append(chunk)
+            got += len(chunk)
+        return b"".join(chunks)
+
+    def recv_line(self, limit: int = 65536) -> bytes:
+        """Read through CRLF (HTTP status/header lines)."""
+        fault_injection.fire(
+            "socket.recv", backend=self.backend, conn=self, want=-1,
+            host=self.host, port=self.port,
+        )
+        self.sock.settimeout(_remaining_timeout(self.io_timeout_s))
+        buf = bytearray()
+        while not buf.endswith(b"\r\n"):
+            if len(buf) > limit:
+                raise RemoteProtocolError("header line exceeds limit")
+            chunk = self.sock.recv(1)
+            if not chunk:
+                raise ConnectionResetError(
+                    f"{self.backend} peer closed mid-line"
+                )
+            buf += chunk
+        return bytes(buf[:-2])
+
+    def close(self):
+        if not self.closed:
+            self.closed = True
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+
+def http_call(conn: Connection, method: str, path: str,
+              headers: dict | None = None,
+              body: bytes = b"") -> tuple[int, dict, bytes]:
+    """Minimal HTTP/1.1 exchange over a pooled connection (the etcd
+    gateway and S3 clients are both HTTP; the fakes always answer with
+    Content-Length, so no chunked decoding is needed)."""
+    hdrs = {"host": f"{conn.host}:{conn.port}",
+            "content-length": str(len(body)),
+            "connection": "keep-alive"}
+    if headers:
+        hdrs.update({k.lower(): v for k, v in headers.items()})
+    head = f"{method} {path} HTTP/1.1\r\n" + "".join(
+        f"{k}: {v}\r\n" for k, v in hdrs.items()
+    ) + "\r\n"
+    conn.send(head.encode("utf-8") + body)
+
+    status_line = conn.recv_line()
+    parts = status_line.split(None, 2)
+    if len(parts) < 2 or not parts[0].startswith(b"HTTP/"):
+        raise RemoteProtocolError(f"bad status line {status_line!r}")
+    status = int(parts[1])
+    resp_headers: dict[str, str] = {}
+    while True:
+        line = conn.recv_line()
+        if not line:
+            break
+        name, _, value = line.partition(b":")
+        resp_headers[name.decode("latin-1").strip().lower()] = (
+            value.decode("latin-1").strip()
+        )
+    # HEAD answers with the entity's Content-Length but no body; 204/304
+    # are bodiless by definition
+    length = int(resp_headers.get("content-length", "0"))
+    if method == "HEAD" or status in (204, 304):
+        length = 0
+    payload = conn.recv_exactly(length) if length else b""
+    if resp_headers.get("connection", "").lower() == "close":
+        conn.close()
+    return status, resp_headers, payload
+
+
+class WireBackend:
+    """Pool + deadline + retry + breaker for one remote backend.
+
+    `call(op, fn)` runs `fn(conn)` — one complete protocol exchange —
+    under the retry policy.  Any exception discards the connection (the
+    stream position is unknowable after a failure) and counts against
+    the endpoint's breaker; only classified-transient errors retry.
+    """
+
+    def __init__(self, backend: str, endpoints: list[tuple[str, int]], *,
+                 pool_size: int = 2, call_deadline_s: float = 5.0,
+                 connect_timeout_s: float = 2.0, retry_attempts: int = 5,
+                 classify=None, breaker: bool = True, name: str = ""):
+        if not endpoints:
+            raise ConfigError(f"wire backend {backend!r} has no endpoints")
+        self.backend = backend
+        self.name = name or backend
+        self.endpoints = list(endpoints)
+        self.pool_size = max(1, int(pool_size))
+        self.call_deadline_s = call_deadline_s
+        self.connect_timeout_s = connect_timeout_s
+        self._classify = classify or self._default_classify
+        self.policy = RetryPolicy(
+            max_attempts=max(1, int(retry_attempts)),
+            base_delay_s=0.02, max_delay_s=1.0,
+            classify=self._classify,
+        )
+        self._pools: dict[tuple[str, int], deque[Connection]] = {
+            ep: deque() for ep in self.endpoints
+        }
+        self._cooldown_s = 0.5
+        self._breakers: dict[tuple[str, int], CircuitBreaker] | None = None
+        if breaker:
+            self._breakers = {
+                ep: CircuitBreaker(
+                    name=f"{self.name}@{ep[0]}:{ep[1]}",
+                    min_calls=4, failure_rate=0.5,
+                    open_cooldown_s=self._cooldown_s,
+                )
+                for ep in self.endpoints
+            }
+        self._lock = threading.Lock()
+        self._rr = 0
+        self.closed = False
+
+    @staticmethod
+    def _default_classify(exc: BaseException) -> bool:
+        if isinstance(exc, RemoteProtocolError):
+            return exc.retriable
+        if isinstance(exc, socket.timeout):
+            return True
+        if isinstance(exc, FileNotFoundError):
+            return False
+        return isinstance(exc, OSError) or is_transient(exc)
+
+    # ---- pool ----------------------------------------------------------
+    def _pick_endpoint(self) -> tuple[str, int]:
+        """Round-robin over endpoints whose breaker admits a call; if all
+        breakers are open, shed with CircuitOpenError (retriable — the
+        policy backs off, by which time a cooldown may have elapsed)."""
+        n = len(self.endpoints)
+        with self._lock:
+            start = self._rr
+            self._rr = (self._rr + 1) % n
+        for i in range(n):
+            ep = self.endpoints[(start + i) % n]
+            b = self._breakers.get(ep) if self._breakers else None
+            if b is None or b.allow():
+                return ep
+        metrics.BREAKER_SHED_TOTAL.inc(node=self.name)
+        exc = CircuitOpenError(
+            f"all {self.backend} endpoints are circuit-open; shedding"
+        )
+        # tell the retry policy to wait out the cooldown instead of
+        # burning its remaining attempts against a breaker that cannot
+        # close any sooner
+        exc.retry_after_s = self._cooldown_s
+        raise exc
+
+    def _checkout(self, ep: tuple[str, int]) -> Connection:
+        with self._lock:
+            pool = self._pools[ep]
+            while pool:
+                conn = pool.popleft()
+                if not conn.closed:
+                    return conn
+        return Connection(
+            self.backend, ep[0], ep[1],
+            self.connect_timeout_s, self.call_deadline_s,
+        )
+
+    def _checkin(self, ep: tuple[str, int], conn: Connection):
+        if conn.closed:
+            return
+        with self._lock:
+            pool = self._pools[ep]
+            if len(pool) < self.pool_size and not self.closed:
+                pool.append(conn)
+                return
+        conn.close()
+
+    # ---- the call path -------------------------------------------------
+    def call(self, op: str, fn):
+        """Run `fn(conn)` with retries/breaker/metrics.  `fn` must be one
+        complete request/response exchange (it may be re-run on a fresh
+        connection after a transient failure, so callers make their
+        exchanges idempotent — sequence numbers, CAS, conditional PUT)."""
+        start = time.monotonic()
+        metrics.REMOTE_CALLS_TOTAL.inc(backend=self.backend, op=op)
+
+        def attempt():
+            ep = self._pick_endpoint()
+            fault_injection.fire(
+                f"wire.{self.backend}", backend=self.backend, op=op,
+                client=self.name, endpoint=f"{ep[0]}:{ep[1]}",
+            )
+            conn = self._checkout(ep)
+            breaker = self._breakers.get(ep) if self._breakers else None
+            try:
+                result = fn(conn)
+            except BaseException as exc:
+                conn.close()
+                if breaker is not None:
+                    if self._classify(exc):
+                        breaker.record_failure()
+                    else:
+                        # a protocol-level "no" (404, compare miss) is a
+                        # healthy answer: the endpoint responded
+                        breaker.record_success()
+                if getattr(exc, "retry_after_s", 0.0):
+                    metrics.REMOTE_THROTTLED_TOTAL.inc(backend=self.backend)
+                raise
+            else:
+                if breaker is not None:
+                    breaker.record_success()
+                self._checkin(ep, conn)
+                return result
+
+        def on_retry(exc, attempt_no):
+            metrics.REMOTE_RETRIES_TOTAL.inc(backend=self.backend)
+
+        try:
+            return self.policy.call(attempt, on_retry=on_retry)
+        except BaseException:
+            metrics.REMOTE_ERRORS_TOTAL.inc(backend=self.backend, op=op)
+            raise
+        finally:
+            metrics.REMOTE_CALL_MS.observe(
+                (time.monotonic() - start) * 1000.0, backend=self.backend
+            )
+
+    def close(self):
+        with self._lock:
+            self.closed = True
+            conns = [c for pool in self._pools.values() for c in pool]
+            for pool in self._pools.values():
+                pool.clear()
+        for c in conns:
+            c.close()

@@ -50,12 +50,25 @@ class TimeSeriesEngine:
                 segment_bytes=getattr(self.config, "wal_segment_mb", 4) << 20,
             )
         elif provider == "kafka":
-            from ..utils.errors import ConfigError
+            endpoints = getattr(self.config, "wal_kafka_endpoints", "")
+            if not endpoints:
+                from ..utils.errors import ConfigError
 
-            raise ConfigError(
-                "wal provider 'kafka' requires network access, which this build "
-                "does not ship; use 'shared_file' on shared storage for the "
-                "same failover semantics"
+                raise ConfigError(
+                    "wal provider 'kafka' needs remote.kafka_endpoints (a "
+                    "broker address — remote/fake_kafka.py runs one offline); "
+                    "use 'shared_file' on shared storage for the same "
+                    "failover semantics without a broker"
+                )
+            from ..remote.kafka import KafkaWalManager
+
+            self.wal_mgr = KafkaWalManager(
+                endpoints,
+                num_topics=getattr(self.config, "wal_num_topics", 4),
+                pool_size=getattr(self.config, "remote_pool_size", 2),
+                call_deadline_s=getattr(self.config, "remote_call_deadline_s", 5.0),
+                connect_timeout_s=getattr(self.config, "remote_connect_timeout_s", 2.0),
+                retry_attempts=getattr(self.config, "remote_retry_attempts", 5),
             )
         else:
             from ..utils.errors import ConfigError

@@ -81,13 +81,16 @@ class StorageConfig:
     # "local" = per-region append logs (raft-engine analogue);
     # "shared_file" = shared-topic segmented log on wal_dir (the remote-WAL
     # interface with a file backend — point wal_dir at shared storage for
-    # stateless-datanode failover); "kafka" is surfaced but gated (no egress).
+    # stateless-datanode failover); "kafka" = the wire-protocol adapter
+    # over a broker (requires remote.kafka_endpoints; the offline fake in
+    # remote/fake_kafka.py speaks the same framing for no-egress runs).
     wal_provider: str = "local"
     wal_num_topics: int = 4
     wal_segment_mb: int = 4
     # Object store under SSTs/manifests (reference `[storage]` with OpenDAL
-    # fs/s3/gcs/oss/azblob builders).  Remote types are surfaced but gated in
-    # this build (no egress); "memory" exists for tests.
+    # fs/s3/gcs/oss/azblob builders).  "s3" = the SigV4 REST adapter
+    # (requires remote.s3_endpoint; remote/fake_s3.py is the offline
+    # twin); gcs/oss/azblob stay gated (no egress); "memory" for tests.
     store_type: str = "fs"
     # mock_remote tuning (SimulatedRemoteStore): per-op latency and
     # transient-failure injection for exercising the remote layer stack
@@ -110,6 +113,21 @@ class StorageConfig:
     ingest_group_commit: bool = True
     ingest_flush_workers: int = 2
     ingest_flush_overlap: bool = True
+    # Storage-plane mirrors of the user-facing `remote.*` section (same
+    # copy-down pattern): wire-adapter endpoints + shared wire-layer
+    # knobs.  Engines built from a bare StorageConfig read these;
+    # empty endpoints keep the in-memory/file sims.
+    wal_kafka_endpoints: str = ""
+    store_s3_endpoint: str = ""
+    store_s3_bucket: str = "greptimedb"
+    store_s3_region: str = "us-east-1"
+    store_s3_access_key: str = ""
+    store_s3_secret_key: str = ""
+    store_s3_multipart_mb: int = 8
+    remote_pool_size: int = 2
+    remote_call_deadline_s: float = 5.0
+    remote_connect_timeout_s: float = 2.0
+    remote_retry_attempts: int = 5
 
     def __post_init__(self):
         # NOTE: wal_dir/sst_dir stay EMPTY unless explicitly set — they are
@@ -298,6 +316,11 @@ class TraceConfig:
     scrape_interval_s: float = 0.0
     # SelfTraceWriter drain cadence (exporter ring -> opentelemetry_traces).
     export_interval_s: float = 0.25
+    # OTLP/HTTP self-export for roles with no local writer (bare
+    # datanodes): spans drain to `<endpoint>/v1/otlp/v1/traces` as OTLP
+    # protobuf over the wire client instead of into a local table.
+    # Empty = off (standalone/frontend keep their in-process writers).
+    otlp_endpoint: str = ""
 
 
 @dataclasses.dataclass
@@ -667,6 +690,44 @@ class BalanceConfig:
 
 
 @dataclasses.dataclass
+class RemoteConfig:
+    """Wire-level remote backends (remote/): etcd v3 for metadata KV +
+    election, Kafka for the shared WAL, S3 for the object store — each a
+    real protocol client behind the same interface its in-memory sim
+    implements.  Default OFF: every endpoint empty keeps the sims and
+    today's behavior bit-for-bit.
+
+    Engagement is two-knob by design: the endpoint here supplies the
+    address, the existing backend selector opts the subsystem in
+    (`storage.wal_provider = "kafka"`, `storage.store_type = "s3"`;
+    etcd engages on the endpoint alone since the cluster KV had no
+    selector).  An endpoint-less selector fails validation instead of
+    silently falling back."""
+
+    # etcd v3 gRPC-gateway endpoints ("host:port[,host:port]") for the
+    # cluster metadata KV and metasrv election.  Empty = MemoryKvBackend.
+    etcd_endpoints: str = ""
+    # Kafka broker endpoints for the shared remote WAL; engaged together
+    # with `storage.wal_provider = "kafka"`.
+    kafka_endpoints: str = ""
+    # S3 REST endpoint + bucket/credentials; engaged together with
+    # `storage.store_type = "s3"`.
+    s3_endpoint: str = ""
+    s3_bucket: str = "greptimedb"
+    s3_region: str = "us-east-1"
+    s3_access_key: str = ""
+    s3_secret_key: str = ""
+    # Writes above this size go as multipart uploads.
+    s3_multipart_mb: int = 8
+    # Shared wire-layer knobs (all three adapters): pooled connections
+    # per endpoint, per-call deadline, connect timeout, retry ladder.
+    pool_size: int = 2
+    call_deadline_s: float = 5.0
+    connect_timeout_s: float = 2.0
+    retry_attempts: int = 5
+
+
+@dataclasses.dataclass
 class Config:
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
     query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
@@ -686,6 +747,7 @@ class Config:
     trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
     recorder: RecorderConfig = dataclasses.field(default_factory=RecorderConfig)
     balance: BalanceConfig = dataclasses.field(default_factory=BalanceConfig)
+    remote: RemoteConfig = dataclasses.field(default_factory=RemoteConfig)
 
     def __post_init__(self):
         self.storage.__post_init__()
@@ -717,6 +779,33 @@ class Config:
             self.storage.ingest_flush_workers = self.ingest.flush_workers
         if self.ingest.flush_overlap != ing_defaults.flush_overlap:
             self.storage.ingest_flush_overlap = self.ingest.flush_overlap
+        # remote.* is the user-facing wire-adapter surface; engines only
+        # see StorageConfig, so copy engaged knobs down like index.* —
+        # with every endpoint at its empty default nothing moves and the
+        # storage plane stays bit-for-bit the sims
+        rm, rm_defaults = self.remote, RemoteConfig()
+        if rm.kafka_endpoints != rm_defaults.kafka_endpoints:
+            self.storage.wal_kafka_endpoints = rm.kafka_endpoints
+        if rm.s3_endpoint != rm_defaults.s3_endpoint:
+            self.storage.store_s3_endpoint = rm.s3_endpoint
+        if rm.s3_bucket != rm_defaults.s3_bucket:
+            self.storage.store_s3_bucket = rm.s3_bucket
+        if rm.s3_region != rm_defaults.s3_region:
+            self.storage.store_s3_region = rm.s3_region
+        if rm.s3_access_key != rm_defaults.s3_access_key:
+            self.storage.store_s3_access_key = rm.s3_access_key
+        if rm.s3_secret_key != rm_defaults.s3_secret_key:
+            self.storage.store_s3_secret_key = rm.s3_secret_key
+        if rm.s3_multipart_mb != rm_defaults.s3_multipart_mb:
+            self.storage.store_s3_multipart_mb = rm.s3_multipart_mb
+        if rm.pool_size != rm_defaults.pool_size:
+            self.storage.remote_pool_size = rm.pool_size
+        if rm.call_deadline_s != rm_defaults.call_deadline_s:
+            self.storage.remote_call_deadline_s = rm.call_deadline_s
+        if rm.connect_timeout_s != rm_defaults.connect_timeout_s:
+            self.storage.remote_connect_timeout_s = rm.connect_timeout_s
+        if rm.retry_attempts != rm_defaults.retry_attempts:
+            self.storage.remote_retry_attempts = rm.retry_attempts
         self.validate()
 
     def validate(self):
@@ -1102,6 +1191,69 @@ class Config:
                     f"balance.{wname} must be a number >= 0 (its term's "
                     f"contribution to the region load score); got {w!r}"
                 )
+        rm = self.remote
+        for ep_name in ("etcd_endpoints", "kafka_endpoints", "s3_endpoint"):
+            spec = getattr(rm, ep_name)
+            if not spec:
+                continue
+            # parse now so a malformed address fails at config time, not
+            # on the adapter's first call
+            from ..remote.wire import parse_endpoints
+
+            try:
+                parse_endpoints(spec)
+            except ConfigError as exc:
+                raise ConfigError(
+                    f"remote.{ep_name} must be host:port[,host:port]; "
+                    f"got {spec!r} ({exc})"
+                ) from None
+        if self.storage.wal_provider == "kafka" and not (
+            rm.kafka_endpoints or self.storage.wal_kafka_endpoints
+        ):
+            raise ConfigError(
+                "storage.wal_provider = 'kafka' requires "
+                "remote.kafka_endpoints (a broker address — the offline "
+                "fake in remote/fake_kafka.py works); the in-memory sims "
+                "stay on 'local'/'shared_file'"
+            )
+        if self.storage.store_type == "s3" and not (
+            rm.s3_endpoint or self.storage.store_s3_endpoint
+        ):
+            raise ConfigError(
+                "storage.store_type = 's3' requires remote.s3_endpoint "
+                "(an S3 REST address — the offline fake in "
+                "remote/fake_s3.py works); 'fs'/'memory' need no endpoint"
+            )
+        if rm.s3_endpoint and not (rm.s3_access_key and rm.s3_secret_key):
+            raise ConfigError(
+                "remote.s3_endpoint is set but remote.s3_access_key / "
+                "remote.s3_secret_key are empty — SigV4 signing needs both"
+            )
+        if rm.pool_size < 1:
+            raise ConfigError(
+                "remote.pool_size must be >= 1 pooled connection per "
+                f"endpoint; got {rm.pool_size!r}"
+            )
+        if rm.call_deadline_s <= 0:
+            raise ConfigError(
+                "remote.call_deadline_s must be > 0 seconds (the per-call "
+                f"socket budget); got {rm.call_deadline_s!r}"
+            )
+        if rm.connect_timeout_s <= 0:
+            raise ConfigError(
+                "remote.connect_timeout_s must be > 0 seconds; got "
+                f"{rm.connect_timeout_s!r}"
+            )
+        if rm.retry_attempts < 1:
+            raise ConfigError(
+                "remote.retry_attempts must be >= 1 total attempts; got "
+                f"{rm.retry_attempts!r}"
+            )
+        if rm.s3_multipart_mb < 1:
+            raise ConfigError(
+                "remote.s3_multipart_mb must be >= 1 MiB (the multipart "
+                f"upload threshold/part size); got {rm.s3_multipart_mb!r}"
+            )
 
     @classmethod
     def load(cls, path: str | None = None, env: dict[str, str] | None = None) -> "Config":

@@ -102,6 +102,23 @@ Named points wired into the codebase:
                        from/to node).  A non-transient injected error
                        rolls back: candidate closes, the old leader is
                        re-enabled, the route never moves
+    wire.etcd          remote backend wire adapters (remote/wire.py
+    wire.kafka         WireBackend.call), fired once per retry attempt
+    wire.s3            BEFORE the socket work (ctx: backend, op, client,
+                       endpoint) — protocol-level injection: arm a
+                       TimeoutError to time a call out, a
+                       RemoteProtocolError(retriable=True) to drive the
+                       per-protocol retry classifier, or a match= filter
+                       on `client` to partition one node's etcd client
+                       while its rivals keep talking
+    socket.connect     transport-level points inside remote/wire.py's
+    socket.send        pooled Connection (ctx: backend, host, port; send/
+    socket.recv        recv also pass conn + data/want) — arm
+                       ConnectionResetError for a reset, TimeoutError for
+                       a silent drop, latency_s for a slow link, or a
+                       callback that conn.raw_send()s a prefix of
+                       ctx["data"] then raises to put a torn frame on the
+                       wire
 
 Production overhead is near zero: `fire()` is a module-level function whose
 fast path is one read of a module global (`_ARMED`) — no locks, no dict
@@ -165,6 +182,16 @@ POINTS = frozenset(
         "balance.decide",
         "repartition.copy",
         "migration.swap",
+        # wire-level remote backends (remote/): per-attempt protocol
+        # injection on each adapter, plus transport-level points inside
+        # the pooled connection (resets, drops, latency; partial frames
+        # via a plan callback that raw_send()s a prefix then raises)
+        "wire.etcd",
+        "wire.kafka",
+        "wire.s3",
+        "socket.connect",
+        "socket.send",
+        "socket.recv",
     }
 )
 

@@ -670,3 +670,41 @@ BALANCE_SKIPPED_HYSTERESIS_TOTAL = REGISTRY.counter(
     "cooling down after a recent decision, or a conflicting procedure "
     "holds the region lock)",
 )
+
+# Wire-level remote backends (remote/): etcd v3 / Kafka / S3 adapters
+# routed through the shared wire resilience layer.
+REMOTE_CALLS_TOTAL = REGISTRY.counter(
+    "greptime_remote_calls_total",
+    "Remote backend wire calls issued (labels: backend = etcd | kafka | "
+    "s3, op = protocol-level operation name)",
+)
+REMOTE_ERRORS_TOTAL = REGISTRY.counter(
+    "greptime_remote_errors_total",
+    "Remote backend wire calls that failed after exhausting the retry "
+    "policy (labels: backend, op)",
+)
+REMOTE_RETRIES_TOTAL = REGISTRY.counter(
+    "greptime_remote_retries_total",
+    "Transient remote-call failures that were retried by the wire layer "
+    "(labels: backend)",
+)
+REMOTE_CALL_MS = REGISTRY.histogram(
+    "greptime_remote_call_elapsed_ms",
+    "End-to-end remote call latency in milliseconds, retries included "
+    "(labels: backend)",
+)
+REMOTE_THROTTLED_TOTAL = REGISTRY.counter(
+    "greptime_remote_throttled_total",
+    "Server throttle responses honored with a Retry-After style backoff "
+    "(S3 503 SlowDown; labels: backend)",
+)
+OTLP_SELF_EXPORT_SPANS = REGISTRY.counter(
+    "greptime_otlp_self_export_spans_total",
+    "Self-observability spans shipped over the wire as OTLP protobuf by "
+    "roles with no local writer (bare datanodes)",
+)
+OTLP_SELF_EXPORT_FAILURES = REGISTRY.counter(
+    "greptime_otlp_self_export_failures_total",
+    "OTLP self-export batches dropped after the wire layer gave up "
+    "(export is best-effort: a full buffer never blocks the hot path)",
+)

@@ -111,7 +111,11 @@ class RetryPolicy:
         last: BaseException | None = None
         for attempt in range(attempts):
             if attempt:
-                self._sleep(self.backoff_s(attempt))
+                # A server that named its own cooldown (S3 503 SlowDown with
+                # Retry-After) overrides jittered backoff when it asks for
+                # longer — retrying sooner than told just burns the attempt.
+                hinted = float(getattr(last, "retry_after_s", 0.0) or 0.0)
+                self._sleep(max(self.backoff_s(attempt), hinted))
             check_deadline()
             try:
                 return fn(*args, **kwargs)

@@ -384,6 +384,121 @@ class SelfTraceWriter:
         self._thread.join(timeout=5.0)
 
 
+def spans_to_otlp(spans, service: str = "") -> bytes:
+    """Finished `tracing.Span`s -> one OTLP ExportTraceServiceRequest
+    (protobuf bytes) — the wire twin of `spans_to_table`, for roles with
+    no local writer to drain into.  `service` overrides the resource
+    service.name (a bare datanode's spans default to the standalone
+    service label, which would misattribute them)."""
+    from ..servers.otlp import OtlpSpan, encode_traces_request
+
+    if not service:
+        service = (spans[0].service if spans else "") or "greptimedb_tpu"
+    out = []
+    for s in spans:
+        out.append(OtlpSpan(
+            trace_id=s.trace_id,
+            span_id=s.span_id,
+            parent_span_id=s.parent_id or "",
+            name=s.name,
+            kind=2 if s.parent_id is None else 1,  # SERVER / INTERNAL
+            start_unix_nano=int(s.start * 1_000_000_000),
+            end_unix_nano=int((s.end or s.start) * 1_000_000_000),
+            attrs={k: str(v) for k, v in s.attributes.items()},
+            events=[
+                {
+                    "time_unix_nano": int(e.get("ts", 0) * 1_000_000_000),
+                    "name": e.get("name", ""),
+                    "attrs": {k: str(v) for k, v in e.get("attrs", {}).items()},
+                }
+                for e in s.events
+            ],
+            status_code=2 if s.status == "ERROR" else (1 if s.status == "OK" else 0),
+            status_message=s.status_message,
+        ))
+    return encode_traces_request(
+        {"service.name": service}, out,
+        scope_name="greptimedb_tpu.self_trace",
+    )
+
+
+class OtlpExportTask:
+    """OTLP/HTTP self-export for roles with NO writer path (a bare
+    datanode in a multi-process cluster has regions but no SQL frontend):
+    drain the exporter ring and POST protobuf trace batches to
+    `trace.otlp_endpoint` — normally a frontend/standalone's own
+    `/v1/otlp/v1/traces`, closing the loop so datanode spans land in the
+    same `opentelemetry_traces` table as everyone else's.
+
+    Best-effort like every self-observability path: a failed batch is
+    dropped and counted, never retried into the hot path's way."""
+
+    def __init__(self, endpoint: str, cfg=None, service: str = "",
+                 interval_s: float | None = None):
+        from ..remote.wire import parse_endpoints
+
+        self.host, self.port = parse_endpoints(endpoint)[0]
+        self.service = service or "greptimedb_tpu.datanode"
+        self.interval_s = (
+            interval_s if interval_s is not None
+            else getattr(cfg, "export_interval_s", 1.0)
+        )
+        self._stop = threading.Event()
+        self._flush_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="otlp-self-export"
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(max(self.interval_s, 0.05)):
+            self.flush()
+        self.flush()  # final best-effort drain on close
+
+    def flush(self) -> int:
+        """Drain + POST one batch synchronously; returns spans shipped
+        (0 on failure — the batch is dropped and counted)."""
+        with self._flush_lock:
+            spans = tracing.EXPORTER.drain()
+            if not spans:
+                return 0
+            body = spans_to_otlp(spans, service=self.service)
+            try:
+                import http.client
+
+                conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=5.0
+                )
+                try:
+                    conn.request(
+                        "POST", "/v1/otlp/v1/traces", body=body,
+                        headers={"Content-Type": "application/x-protobuf"},
+                    )
+                    resp = conn.getresponse()
+                    resp.read()
+                    if resp.status >= 400:
+                        raise OSError(f"otlp export -> {resp.status}")
+                finally:
+                    conn.close()
+            except Exception:  # noqa: BLE001 — best-effort by contract
+                metrics.OTLP_SELF_EXPORT_FAILURES.inc()
+                _LOG.debug(
+                    "otlp self-export failed; dropping %d spans",
+                    len(spans), exc_info=True,
+                )
+                return 0
+            metrics.OTLP_SELF_EXPORT_SPANS.inc(len(spans))
+            return len(spans)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=5.0)
+
+
 class MetricScrapeTask:
     """Periodic snapshot of the /metrics registry into the metric engine:
     counters/gauges verbatim, histograms expanded into Prometheus

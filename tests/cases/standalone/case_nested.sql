@@ -1,0 +1,12 @@
+-- CASE nested inside CASE, in projections and predicates
+CREATE TABLE cn (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO cn VALUES ('a', 5.0, 0), ('b', 25.0, 1000), ('c', 75.0, 2000), ('d', NULL, 3000);
+
+SELECT k, CASE WHEN v < 50 THEN CASE WHEN v < 10 THEN 'tiny' ELSE 'small' END ELSE CASE WHEN v < 90 THEN 'big' ELSE 'huge' END END AS band FROM cn ORDER BY k;
+
+SELECT k, CASE WHEN v IS NULL THEN 'missing' ELSE CASE WHEN v > 50 THEN 'hot' ELSE 'cold' END END AS state FROM cn ORDER BY k;
+
+SELECT k FROM cn WHERE CASE WHEN v IS NULL THEN false ELSE CASE WHEN v > 10 THEN true ELSE false END END ORDER BY k;
+
+DROP TABLE cn;

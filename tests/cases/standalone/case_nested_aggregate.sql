@@ -1,0 +1,14 @@
+-- aggregates over nested CASE and CASE over aggregates
+CREATE TABLE cna (k STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO cna VALUES ('a', 5.0, 0), ('b', 25.0, 1000), ('c', 75.0, 2000), ('d', 95.0, 3000);
+
+SELECT sum(CASE WHEN v < 50 THEN CASE WHEN v < 10 THEN 1 ELSE 2 END ELSE 0 END) AS weighted_small FROM cna;
+
+SELECT count(CASE WHEN v > 50 THEN 1 END) AS hot_rows, count(*) AS all_rows FROM cna;
+
+SELECT CASE WHEN avg(v) > 40 THEN 'high-avg' ELSE 'low-avg' END AS verdict FROM cna;
+
+SELECT CASE WHEN max(v) > 90 THEN CASE WHEN min(v) < 10 THEN 'wide' ELSE 'high' END ELSE 'narrow' END AS spread FROM cna;
+
+DROP TABLE cna;

@@ -1,0 +1,14 @@
+-- the simple (operand) CASE form, alone and nested in a searched CASE
+CREATE TABLE csf (k STRING, tier STRING, v DOUBLE, ts TIMESTAMP TIME INDEX, PRIMARY KEY (k));
+
+INSERT INTO csf VALUES ('a', 'gold', 10.0, 0), ('b', 'silver', 20.0, 1000), ('c', 'bronze', 30.0, 2000), ('d', 'tin', 40.0, 3000);
+
+SELECT k, CASE tier WHEN 'gold' THEN 1 WHEN 'silver' THEN 2 WHEN 'bronze' THEN 3 ELSE 99 END AS rank FROM csf ORDER BY k;
+
+SELECT k, CASE tier WHEN 'gold' THEN 'precious' WHEN 'silver' THEN 'precious' ELSE 'base' END AS kind FROM csf ORDER BY k;
+
+SELECT k, CASE WHEN v < 25 THEN CASE tier WHEN 'gold' THEN 'cheap-gold' ELSE 'cheap-other' END ELSE 'expensive' END AS label FROM csf ORDER BY k;
+
+SELECT CASE tier WHEN 'tin' THEN upper(tier) ELSE lower(tier) END AS mapped FROM csf ORDER BY k;
+
+DROP TABLE csf;

@@ -1,0 +1,12 @@
+-- aggregate over an information_schema join: column counts per table
+CREATE TABLE isa1 (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+CREATE TABLE isa2 (host STRING, dc STRING, ts TIMESTAMP TIME INDEX, u DOUBLE, w DOUBLE, PRIMARY KEY (host, dc));
+
+SELECT t.table_name, count(*) AS cols FROM information_schema.tables t JOIN information_schema.columns c ON t.table_name = c.table_name WHERE t.table_name IN ('isa1', 'isa2') GROUP BY t.table_name ORDER BY t.table_name;
+
+SELECT c.semantic_type, count(*) AS n FROM information_schema.tables t JOIN information_schema.columns c ON t.table_name = c.table_name WHERE t.table_name IN ('isa1', 'isa2') GROUP BY c.semantic_type ORDER BY c.semantic_type;
+
+DROP TABLE isa1;
+
+DROP TABLE isa2;

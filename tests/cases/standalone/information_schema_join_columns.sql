@@ -1,0 +1,8 @@
+-- join information_schema.tables to .columns on table_name
+CREATE TABLE isj (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+SELECT t.table_name, c.column_name, c.semantic_type FROM information_schema.tables t JOIN information_schema.columns c ON t.table_name = c.table_name WHERE t.table_name = 'isj' ORDER BY c.column_name;
+
+SELECT t.engine, c.column_name FROM information_schema.tables t JOIN information_schema.columns c ON t.table_name = c.table_name WHERE t.table_name = 'isj' AND c.semantic_type = 'TIMESTAMP' ORDER BY c.column_name;
+
+DROP TABLE isj;

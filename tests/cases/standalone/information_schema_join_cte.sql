@@ -1,0 +1,8 @@
+-- CTE over information_schema joined back to tables
+CREATE TABLE isc (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+WITH tag_cols AS (SELECT table_name, column_name FROM information_schema.columns WHERE semantic_type = 'TAG') SELECT t.table_name, g.column_name FROM information_schema.tables t JOIN tag_cols g ON t.table_name = g.table_name WHERE t.table_name = 'isc' ORDER BY g.column_name;
+
+WITH field_counts AS (SELECT table_name, count(*) AS n FROM information_schema.columns WHERE semantic_type = 'FIELD' GROUP BY table_name) SELECT t.table_name, f.n FROM information_schema.tables t JOIN field_counts f ON t.table_name = f.table_name WHERE t.table_name = 'isc' ORDER BY t.table_name;
+
+DROP TABLE isc;

@@ -1,0 +1,16 @@
+-- interval arithmetic across units (ms .. weeks), both add and subtract
+CREATE TABLE iam (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO iam VALUES ('a', '2026-03-01 00:00:00', 1.0), ('b', '2026-03-15 12:00:00', 2.0);
+
+SELECT host, ts + INTERVAL '500 milliseconds' AS plus_ms FROM iam ORDER BY host;
+
+SELECT host, ts + INTERVAL '90 seconds' AS plus_s FROM iam ORDER BY host;
+
+SELECT host, ts + INTERVAL '1 week' AS plus_w FROM iam ORDER BY host;
+
+SELECT host, ts - INTERVAL '2 weeks' AS minus_w FROM iam ORDER BY host;
+
+SELECT host, ts + INTERVAL '1.5 hours' AS plus_frac FROM iam ORDER BY host;
+
+DROP TABLE iam;

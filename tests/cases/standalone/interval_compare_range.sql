@@ -1,0 +1,14 @@
+-- interval-shifted bounds inside predicates
+CREATE TABLE icr (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO icr VALUES ('a', '2026-03-01 00:00:00', 1.0), ('b', '2026-03-01 01:00:00', 2.0), ('c', '2026-03-01 02:30:00', 3.0), ('d', '2026-03-02 00:00:00', 4.0);
+
+SELECT host FROM icr WHERE ts >= '2026-03-01 00:00:00'::TIMESTAMP + INTERVAL '1 hour' ORDER BY host;
+
+SELECT host FROM icr WHERE ts < '2026-03-02 00:00:00'::TIMESTAMP - INTERVAL '90 minutes' ORDER BY host;
+
+SELECT host FROM icr WHERE ts BETWEEN '2026-03-01 00:00:00'::TIMESTAMP + INTERVAL '30 minutes' AND '2026-03-01 00:00:00'::TIMESTAMP + INTERVAL '3 hours' ORDER BY host;
+
+SELECT count(*) AS in_first_day FROM icr WHERE ts < '2026-03-01 00:00:00'::TIMESTAMP + INTERVAL '1 day';
+
+DROP TABLE icr;

@@ -1,0 +1,12 @@
+-- grouping rows by interval-derived buckets
+CREATE TABLE igb (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO igb VALUES ('a', '2026-03-01 00:10:00', 1.0), ('b', '2026-03-01 00:50:00', 2.0), ('c', '2026-03-01 01:10:00', 3.0), ('d', '2026-03-01 02:05:00', 4.0);
+
+SELECT hour(ts) AS h, count(*) AS n FROM igb GROUP BY h ORDER BY h;
+
+SELECT hour(ts + INTERVAL '30 minutes') AS shifted_h, count(*) AS n FROM igb GROUP BY shifted_h ORDER BY shifted_h;
+
+SELECT count(*) AS recent FROM igb WHERE ts >= '2026-03-01 02:05:00'::TIMESTAMP - INTERVAL '1 hour';
+
+DROP TABLE igb;

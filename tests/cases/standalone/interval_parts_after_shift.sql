@@ -1,0 +1,14 @@
+-- calendar fields read back from interval-shifted timestamps
+CREATE TABLE ips (host STRING, ts TIMESTAMP TIME INDEX, v DOUBLE, PRIMARY KEY (host));
+
+INSERT INTO ips VALUES ('a', '2026-02-28 23:30:00', 1.0), ('b', '2026-12-31 12:00:00', 2.0);
+
+SELECT host, day(ts + INTERVAL '1 hour') AS d, month(ts + INTERVAL '1 hour') AS m FROM ips ORDER BY host;
+
+SELECT host, year(ts + INTERVAL '1 day') AS y FROM ips ORDER BY host;
+
+SELECT host, hour(ts - INTERVAL '45 minutes') AS h, minute(ts - INTERVAL '45 minutes') AS mi FROM ips ORDER BY host;
+
+SELECT host, date_part('day', ts + INTERVAL '36 hours') AS shifted_day FROM ips ORDER BY host;
+
+DROP TABLE ips;

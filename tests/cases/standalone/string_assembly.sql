@@ -1,0 +1,16 @@
+-- assembly + case mapping: concat_ws, capitalize, nested transforms
+CREATE TABLE sas (id STRING, ts TIMESTAMP TIME INDEX, a STRING, b STRING, PRIMARY KEY (id));
+
+INSERT INTO sas VALUES ('r1', 1000, 'hello', 'world'), ('r2', 2000, 'TPU', 'db'), ('r3', 3000, 'x', NULL);
+
+SELECT id, concat_ws('-', a, b) AS joined FROM sas ORDER BY id;
+
+SELECT id, capitalize(a) AS cap FROM sas ORDER BY id;
+
+SELECT id, upper(concat(a, b)) AS shout FROM sas ORDER BY id;
+
+SELECT id, reverse(lower(a)) AS rl FROM sas ORDER BY id;
+
+SELECT concat_ws('/', 'a', 'b', 'c') AS const_join;
+
+DROP TABLE sas;

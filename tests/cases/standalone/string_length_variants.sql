@@ -1,0 +1,14 @@
+-- the three length spellings agree, and compose with trim/pad
+CREATE TABLE slv (id STRING, ts TIMESTAMP TIME INDEX, s STRING, PRIMARY KEY (id));
+
+INSERT INTO slv VALUES ('r1', 1000, 'metrics'), ('r2', 2000, '  spaced  '), ('r3', 3000, '');
+
+SELECT id, length(s) AS l, char_length(s) AS cl, character_length(s) AS chl FROM slv ORDER BY id;
+
+SELECT id, length(trim(s)) AS trimmed FROM slv ORDER BY id;
+
+SELECT id, length(ltrim(s)) AS lt, length(rtrim(s)) AS rt FROM slv ORDER BY id;
+
+SELECT id FROM slv WHERE length(s) > 7 ORDER BY id;
+
+DROP TABLE slv;

@@ -1,0 +1,16 @@
+-- NULL flow through string functions and the coalesce family
+CREATE TABLE snp (id STRING, ts TIMESTAMP TIME INDEX, s STRING, PRIMARY KEY (id));
+
+INSERT INTO snp VALUES ('r1', 1000, 'present'), ('r2', 2000, NULL), ('r3', 3000, '');
+
+SELECT id, upper(s) AS u, length(s) AS n FROM snp ORDER BY id;
+
+SELECT id, coalesce(s, '<none>') AS c FROM snp ORDER BY id;
+
+SELECT id, ifnull(s, 'fallback') AS f FROM snp ORDER BY id;
+
+SELECT id, nullif(s, '') AS empty_as_null FROM snp ORDER BY id;
+
+SELECT id, coalesce(nullif(s, ''), 'blank-or-null') AS norm FROM snp ORDER BY id;
+
+DROP TABLE snp;

@@ -1,0 +1,18 @@
+-- substring-search functions: strpos/instr/position, contains, starts/ends_with
+CREATE TABLE ssf (id STRING, ts TIMESTAMP TIME INDEX, s STRING, PRIMARY KEY (id));
+
+INSERT INTO ssf VALUES ('r1', 1000, 'observability'), ('r2', 2000, 'database'), ('r3', 3000, 'tpu-trace');
+
+SELECT id, strpos(s, 'a') AS p FROM ssf ORDER BY id;
+
+SELECT id, instr(s, 'base') AS p FROM ssf ORDER BY id;
+
+SELECT id, contains(s, 'trace') AS hit FROM ssf ORDER BY id;
+
+SELECT id FROM ssf WHERE starts_with(s, 'tpu') ORDER BY id;
+
+SELECT id FROM ssf WHERE ends_with(s, 'base') ORDER BY id;
+
+SELECT id, strpos(s, 'zz') AS missing FROM ssf ORDER BY id;
+
+DROP TABLE ssf;

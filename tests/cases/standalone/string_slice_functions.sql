@@ -1,0 +1,16 @@
+-- slicing/assembly: left/right, reverse, repeat, split_part
+CREATE TABLE ssl (id STRING, ts TIMESTAMP TIME INDEX, s STRING, PRIMARY KEY (id));
+
+INSERT INTO ssl VALUES ('r1', 1000, 'alpha:beta:gamma'), ('r2', 2000, 'xyz'), ('r3', 3000, NULL);
+
+SELECT id, left(s, 5) AS l, right(s, 5) AS r FROM ssl ORDER BY id;
+
+SELECT id, reverse(s) AS rev FROM ssl ORDER BY id;
+
+SELECT id, repeat(s, 2) AS twice FROM ssl ORDER BY id;
+
+SELECT id, split_part(s, ':', 1) AS p1, split_part(s, ':', 2) AS p2 FROM ssl ORDER BY id;
+
+SELECT id, split_part(s, ':', 9) AS overflow FROM ssl ORDER BY id;
+
+DROP TABLE ssl;

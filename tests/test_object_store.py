@@ -121,7 +121,7 @@ def test_retry_layer_retries_transient_errors():
 
 def test_build_object_store_gates_remote_types(tmp_path):
     cfg = StorageConfig(data_home=str(tmp_path), store_type="s3")
-    with pytest.raises(ConfigError, match="network"):
+    with pytest.raises(ConfigError, match="remote.s3_endpoint"):
         build_object_store(cfg)
     with pytest.raises(ConfigError, match="unknown"):
         build_object_store(StorageConfig(data_home=str(tmp_path), store_type="ftp"))

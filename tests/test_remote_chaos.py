@@ -1,0 +1,257 @@
+"""Wire-level chaos: socket faults, broker kills, partitions, throttle storms.
+
+The three headline scenarios the remote backends must survive:
+
+  1. broker kill mid-group-commit — every acked row replays, exactly once;
+  2. etcd lease expiry during a partition — never two leaders, and the
+     fenced ex-leader OBSERVES the refusal (keepalive answers TTL=0);
+  3. S3 SlowDown storm — reads/writes degrade to Retry-After pacing plus
+     breaker shed, with zero failed operations.
+
+Plus the socket-level primitives (`socket.connect` / `socket.send` /
+`socket.recv`) and per-protocol wire points (`wire.etcd` / `wire.kafka` /
+`wire.s3`) every scenario builds on: each is armed here at least once, which
+is what the conftest fault-point coverage gate checks for.
+"""
+
+import socket as socket_mod
+
+import pytest
+
+from greptimedb_tpu.remote.etcd import EtcdClient, EtcdElection, EtcdKvBackend
+from greptimedb_tpu.remote.fake_etcd import FakeEtcdServer
+from greptimedb_tpu.remote.fake_kafka import FakeKafkaBroker
+from greptimedb_tpu.remote.fake_s3 import (
+    DEFAULT_ACCESS_KEY,
+    DEFAULT_SECRET_KEY,
+    FakeS3Server,
+)
+from greptimedb_tpu.remote.kafka import KafkaSharedLog
+from greptimedb_tpu.remote.s3 import S3ObjectStore
+from greptimedb_tpu.remote.wire import RemoteProtocolError
+from greptimedb_tpu.storage.engine import TimeSeriesEngine
+from greptimedb_tpu.storage.sst import ScanPredicate
+from greptimedb_tpu.utils import fault_injection as fi
+from greptimedb_tpu.utils import metrics
+from greptimedb_tpu.utils.config import StorageConfig
+
+from test_storage import cpu_schema, make_batch
+
+SCHEMA = cpu_schema()
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    fi.REGISTRY.disarm()
+    yield
+    fi.REGISTRY.disarm()
+
+
+def _s3_store(server, **kw):
+    return S3ObjectStore(
+        server.endpoint, "chaos-bucket",
+        access_key=DEFAULT_ACCESS_KEY, secret_key=DEFAULT_SECRET_KEY, **kw
+    )
+
+
+# ---- socket-level fault primitives ------------------------------------------
+
+
+def test_socket_connect_refused_retries_through(tmp_path):
+    """A connect-time fault is transient: the wire layer retries on a
+    fresh socket and the call succeeds without the caller noticing."""
+    with FakeS3Server() as server:
+        store = _s3_store(server)
+        before = metrics.REMOTE_RETRIES_TOTAL.total()
+        fi.REGISTRY.arm(
+            "socket.connect", fail_times=1, error=ConnectionRefusedError
+        )
+        store.write("k", b"v")
+        assert store.read("k") == b"v"
+        assert metrics.REMOTE_RETRIES_TOTAL.total() > before
+        store.close()
+
+
+def test_socket_recv_timeout_retries_through():
+    """A stalled response (recv timeout) is retried on a new connection —
+    the etcd gateway's GETs are idempotent by construction."""
+    with FakeEtcdServer() as server:
+        kv = EtcdKvBackend(server.endpoint)
+        kv.put("stall", "value")
+        fi.REGISTRY.arm(
+            "socket.recv", fail_times=1, error=socket_mod.timeout
+        )
+        assert kv.get("stall") == "value"
+        kv.close()
+
+
+def test_socket_send_torn_frame_does_not_corrupt_broker():
+    """Crash mid-send: the plan pushes a PREFIX of the produce frame onto
+    the wire (via `raw_send`, bypassing injection) and then fails the
+    send.  The broker sees torn bytes + EOF and must drop them; the
+    client's retry lands the append exactly once."""
+    with FakeKafkaBroker() as broker:
+        log = KafkaSharedLog(broker.endpoint, call_deadline_s=2.0)
+
+        def tear(ctx):
+            ctx["conn"].raw_send(ctx["data"][:7])
+
+        fi.REGISTRY.arm(
+            "socket.send", fail_times=1, callback=tear,
+            error=ConnectionResetError,
+            match=lambda ctx: ctx["backend"] == "kafka" and len(ctx["data"]) > 64,
+        )
+        log.append("topic_0", 1, 1, make_batch(SCHEMA, ["a"], [1], [0.1]))
+        log.append("topic_0", 1, 2, make_batch(SCHEMA, ["b"], [2], [0.2]))
+        ids = [e.entry_id for e in log.read("topic_0", 1, 0)]
+        assert ids == [1, 2]  # exactly once, no torn-frame ghosts
+        log.close()
+
+
+# ---- per-protocol wire points ----------------------------------------------
+
+
+def test_wire_s3_transient_errors_recover():
+    with FakeS3Server() as server:
+        store = _s3_store(server)
+        store.write("obj", b"payload")
+        fi.REGISTRY.arm(
+            "wire.s3", fail_times=2,
+            error=RemoteProtocolError("injected s3 blip", retriable=True),
+        )
+        assert store.read("obj") == b"payload"
+        store.close()
+
+
+def test_wire_etcd_nonretriable_surfaces_immediately():
+    """A non-retriable protocol error must NOT be retried (retries on a
+    definitive 'no' would hide bugs and hammer the server)."""
+    with FakeEtcdServer() as server:
+        kv = EtcdKvBackend(server.endpoint)
+        calls_before = metrics.REMOTE_ERRORS_TOTAL.total()
+        fi.REGISTRY.arm(
+            "wire.etcd", fail_times=1,
+            error=RemoteProtocolError("injected definitive no"),
+        )
+        with pytest.raises(RemoteProtocolError):
+            kv.get("whatever")
+        assert metrics.REMOTE_ERRORS_TOTAL.total() == calls_before + 1
+        kv.close()
+
+
+# ---- scenario 1: broker kill mid-group-commit ------------------------------
+
+
+def test_chaos_broker_kill_mid_group_commit_loses_no_acked_row(tmp_path):
+    """Ack loss at the worst moment (group frame appended broker-side,
+    ack dropped) + a full broker restart: every acked row must replay,
+    exactly once — the idempotent-producer dedupe is what makes the
+    retry safe."""
+    with FakeKafkaBroker() as broker:
+        cfg = StorageConfig(
+            data_home=str(tmp_path), wal_provider="kafka",
+            wal_kafka_endpoints=broker.endpoint,
+            remote_call_deadline_s=2.0,
+        )
+        engine = TimeSeriesEngine(cfg)
+        engine.create_region(1, SCHEMA)
+        engine.write(1, make_batch(SCHEMA, ["a"], [1000], [0.1]))
+
+        # one transient broker error on the produce path, then the kill:
+        # the ack for the group frame is lost AFTER the broker applied it
+        fi.REGISTRY.arm(
+            "wire.kafka", fail_times=1,
+            error=RemoteProtocolError("injected broker blip", retriable=True),
+            match=lambda ctx: ctx["op"] == "produce",
+        )
+        broker.lose_acks(1)
+        n = engine.write_group(1, [
+            make_batch(SCHEMA, ["b"], [2000], [0.2]),
+            make_batch(SCHEMA, ["c"], [3000], [0.3]),
+        ])
+        assert len(n) == 2  # the writes ACKED despite the chaos
+        engine.write(1, make_batch(SCHEMA, ["d"], [4000], [0.4]))
+        engine.close()
+
+        broker.restart()  # kill + cold start; segments survive
+
+        recovered = TimeSeriesEngine(cfg)
+        recovered.open_region(1)
+        t = recovered.scan(1, ScanPredicate())
+        hosts = sorted(t.column("host").to_pylist())
+        assert hosts == ["a", "b", "c", "d"]  # nothing lost, nothing doubled
+        recovered.close()
+
+
+# ---- scenario 2: partition + lease expiry -> never two leaders --------------
+
+
+def test_chaos_partition_lease_expiry_never_double_leader():
+    """The leader is partitioned; its lease expires server-side; a rival
+    takes over.  At no observation point are there two leaders, and when
+    the partition heals the ex-leader gets the explicit fence refusal
+    (keepalive on the dead lease answers TTL=0)."""
+    now = [1000.0]
+    with FakeEtcdServer(clock=lambda: now[0]) as server:
+        client_a = EtcdClient(server.endpoint, name="etcd-a", retry_attempts=2)
+        client_b = EtcdClient(server.endpoint, name="etcd-b", retry_attempts=2)
+        a = EtcdElection(client_a, "node-a", lease_ms=3000)
+        b = EtcdElection(client_b, "node-b", lease_ms=3000)
+
+        assert a.campaign() is True
+        assert b.campaign() is False
+        fenced_lease = a._lease
+
+        # partition node-a: every wire call from its client fails
+        fi.REGISTRY.arm(
+            "wire.etcd", fail_times=10_000, error=ConnectionResetError,
+            match=lambda ctx: ctx["client"] == "etcd-a",
+        )
+        assert a.campaign() is False  # cannot prove leadership -> not leader
+        assert b.campaign() is False  # lease still live -> no takeover yet
+        assert b.leader() == "node-a"
+
+        now[0] += 4.0  # the partitioned leader's lease runs out
+        assert b.campaign() is True
+        assert a.campaign() is False  # still partitioned
+        assert b.is_leader()
+
+        fi.REGISTRY.disarm("wire.etcd")  # partition heals
+        # the explicit fence refusal: the old lease is dead server-side
+        assert client_a.lease_keepalive(fenced_lease) == 0
+        assert a.campaign() is False  # node-b holds the key; no steal-back
+        assert a.leader() == "node-b"
+        assert b.is_leader() and not a.is_leader()
+        client_a.close()
+        client_b.close()
+
+
+# ---- scenario 3: S3 SlowDown storm -----------------------------------------
+
+
+def test_chaos_s3_slowdown_storm_zero_failed_queries(tmp_path):
+    """A 503 SlowDown storm during reads AND a flush: every operation
+    degrades to Retry-After pacing (plus breaker shed once the failure
+    rate trips it) and ultimately succeeds — zero failed queries."""
+    with FakeS3Server() as server:
+        cfg = StorageConfig(
+            data_home=str(tmp_path), store_type="s3",
+            store_s3_endpoint=server.endpoint,
+            store_s3_access_key=DEFAULT_ACCESS_KEY,
+            store_s3_secret_key=DEFAULT_SECRET_KEY,
+            store_s3_bucket="chaos-bucket",
+        )
+        engine = TimeSeriesEngine(cfg)
+        engine.create_region(1, SCHEMA)
+        engine.write(1, make_batch(SCHEMA, ["a", "b"], [1000, 2000], [0.1, 0.2]))
+        engine.flush_region(1)
+
+        throttled_before = metrics.REMOTE_THROTTLED_TOTAL.total()
+        server.slow_down(4, retry_after_s=0.02)
+        engine.write(1, make_batch(SCHEMA, ["c"], [3000], [0.3]))
+        engine.flush_region(1)  # SST writes ride the storm
+        for _ in range(3):  # queries during the storm
+            t = engine.scan(1, ScanPredicate())
+            assert sorted(t.column("host").to_pylist()) == ["a", "b", "c"]
+        assert metrics.REMOTE_THROTTLED_TOTAL.total() > throttled_before
+        engine.close()

@@ -1,0 +1,504 @@
+"""Shared contract battery: sim and wire backends run the SAME tests.
+
+Each backend family ships two implementations of one interface — an
+in-memory/file sim and a wire-level client over an offline fake server:
+
+  * `KvBackend`:   `MemoryKvBackend`        vs `EtcdKvBackend` + fake etcd
+  * election:      `LeaseElection`          vs `EtcdElection`  + fake etcd
+  * WAL log store: `SharedLogStore` (files) vs `KafkaSharedLog` + fake broker
+  * `ObjectStore`: `MemoryObjectStore`      vs `S3ObjectStore` + fake S3
+
+The battery is ONE parametrized suite: every test body below runs
+unmodified against both parametrizations — backend-specific code lives
+only in the harness fixtures (construction, reopen, crash simulation),
+never in the assertions.  A wire adapter that needs its own fork of a
+contract test has a bug by definition.
+"""
+
+import pytest
+
+from greptimedb_tpu.distributed.election import LeaseElection
+from greptimedb_tpu.distributed.kv import MemoryKvBackend
+from greptimedb_tpu.remote.etcd import EtcdClient, EtcdElection, EtcdKvBackend
+from greptimedb_tpu.remote.fake_etcd import FakeEtcdServer
+from greptimedb_tpu.remote.fake_kafka import FakeKafkaBroker
+from greptimedb_tpu.remote.fake_s3 import (
+    DEFAULT_ACCESS_KEY,
+    DEFAULT_SECRET_KEY,
+    FakeS3Server,
+)
+from greptimedb_tpu.remote.kafka import KafkaSharedLog
+from greptimedb_tpu.remote.s3 import S3ObjectStore
+from greptimedb_tpu.storage.object_store import MemoryObjectStore
+from greptimedb_tpu.storage.remote_wal import SharedLogStore
+
+from test_storage import cpu_schema, make_batch
+
+SCHEMA = cpu_schema()
+
+
+# ===========================================================================
+# KV backend
+# ===========================================================================
+
+
+class _KvHarness:
+    """Backend-specific construction only; the contract lives in the tests."""
+
+    def __init__(self, param, tmp_path):
+        self.param = param
+        self._server = None
+        self._views = []
+        if param == "wire":
+            self._server = FakeEtcdServer().start()
+
+    def view(self):
+        """A fresh client over the SAME underlying store (a second process
+        in sim terms; a second connection in wire terms)."""
+        if self.param == "sim":
+            if not self._views:
+                self._views.append(MemoryKvBackend())
+            return self._views[0]
+        kv = EtcdKvBackend(self._server.endpoint)
+        self._views.append(kv)
+        return kv
+
+    def close(self):
+        for v in self._views:
+            if hasattr(v, "close"):
+                v.close()
+        if self._server is not None:
+            self._server.stop()
+
+
+@pytest.fixture(params=["sim", "wire"])
+def kv_harness(request, tmp_path):
+    h = _KvHarness(request.param, tmp_path)
+    yield h
+    h.close()
+
+
+def test_kv_put_get_delete_roundtrip(kv_harness):
+    kv = kv_harness.view()
+    assert kv.get("a") is None
+    kv.put("a", "1")
+    assert kv.get("a") == "1"
+    kv.put("a", "2")  # overwrite is last-writer-wins
+    assert kv.get("a") == "2"
+    kv.delete("a")
+    assert kv.get("a") is None
+    kv.delete("a")  # idempotent
+
+
+def test_kv_range_returns_prefix_only(kv_harness):
+    kv = kv_harness.view()
+    kv.put("/routes/t1/0", "n0")
+    kv.put("/routes/t1/1", "n1")
+    kv.put("/routes/t2/0", "nX")
+    kv.put("/other", "y")
+    got = kv.range("/routes/t1/")
+    assert got == {"/routes/t1/0": "n0", "/routes/t1/1": "n1"}
+    assert kv.range("/nothing/") == {}
+
+
+def test_kv_cas_create_race_single_winner(kv_harness):
+    """Linearizable create: of two expect-absent CAS attempts through two
+    independent views, exactly one wins."""
+    a, b = kv_harness.view(), kv_harness.view()
+    wins = [a.compare_and_put("lock", None, "A"), b.compare_and_put("lock", None, "B")]
+    assert sorted(wins) == [False, True]
+    holder = a.get("lock")
+    assert holder in ("A", "B")
+    # the loser observes the winner's value through its own view
+    assert b.get("lock") == holder
+
+
+def test_kv_cas_stale_expectation_fails(kv_harness):
+    kv = kv_harness.view()
+    kv.put("k", "v1")
+    assert kv.compare_and_put("k", "v1", "v2") is True
+    # stale expect (the old value) must fail and change nothing
+    assert kv.compare_and_put("k", "v1", "v3") is False
+    assert kv.get("k") == "v2"
+    # expect-absent on an existing key must fail
+    assert kv.compare_and_put("k", None, "v4") is False
+    assert kv.get("k") == "v2"
+
+
+def test_kv_batch_put_all_visible(kv_harness):
+    kv = kv_harness.view()
+    kv.batch_put({f"/b/{i}": str(i) for i in range(10)})
+    got = kv.range("/b/")
+    assert got == {f"/b/{i}": str(i) for i in range(10)}
+
+
+def test_kv_views_share_state(kv_harness):
+    """Read-after-write across views: a write through one client is
+    immediately visible through another (no per-client caching)."""
+    a, b = kv_harness.view(), kv_harness.view()
+    a.put("shared", "from-a")
+    assert b.get("shared") == "from-a"
+    b.delete("shared")
+    assert a.get("shared") is None
+
+
+# ===========================================================================
+# Leader election + lease fencing
+# ===========================================================================
+
+
+class _ElectionHarness:
+    """Two candidates over one store, with a manually-advanced clock so
+    lease expiry is deterministic (no sleeping)."""
+
+    LEASE_MS = 3000
+
+    def __init__(self, param):
+        self.param = param
+        self.now = [1000.0]  # seconds
+        self._clients = []
+        if param == "sim":
+            self._kv = MemoryKvBackend()
+            self._server = None
+        else:
+            self._server = FakeEtcdServer(clock=lambda: self.now[0]).start()
+
+    def candidate(self, node_id):
+        if self.param == "sim":
+            return LeaseElection(
+                self._kv, node_id, lease_ms=self.LEASE_MS,
+                clock=lambda: self.now[0] * 1000.0,
+            )
+        client = EtcdClient(self._server.endpoint, retry_attempts=1)
+        self._clients.append(client)
+        return EtcdElection(client, node_id, lease_ms=self.LEASE_MS)
+
+    def advance(self, seconds):
+        self.now[0] += seconds
+
+    def close(self):
+        for c in self._clients:
+            c.close()
+        if self._server is not None:
+            self._server.stop()
+
+
+@pytest.fixture(params=["sim", "wire"])
+def election_harness(request):
+    h = _ElectionHarness(request.param)
+    yield h
+    h.close()
+
+
+def test_election_single_leader(election_harness):
+    a = election_harness.candidate("node-a")
+    b = election_harness.candidate("node-b")
+    assert a.campaign() is True
+    assert b.campaign() is False
+    assert a.is_leader() and not b.is_leader()
+    assert a.leader() == b.leader() == "node-a"
+    # renewal keeps the loser out indefinitely within the lease
+    election_harness.advance(1.0)
+    assert a.campaign() is True
+    assert b.campaign() is False
+
+
+def test_election_lease_expiry_hands_over(election_harness):
+    a = election_harness.candidate("node-a")
+    b = election_harness.candidate("node-b")
+    assert a.campaign() is True
+    # a stops campaigning (crashed); the lease runs out
+    election_harness.advance(4.0)
+    assert b.campaign() is True
+    assert b.is_leader()
+    # the ex-leader's next campaign observes the fence: it is NOT leader
+    # and must not steal the key back
+    assert a.campaign() is False
+    assert b.is_leader() and not a.is_leader()
+    assert a.leader() == "node-b"
+
+
+def test_election_resign_frees_key(election_harness):
+    a = election_harness.candidate("node-a")
+    b = election_harness.candidate("node-b")
+    assert a.campaign() is True
+    a.resign()
+    assert not a.is_leader()
+    assert b.campaign() is True
+
+
+def test_election_transition_callbacks(election_harness):
+    log = []
+    a = election_harness.candidate("node-a")
+    a.on_leader_start.append(lambda: log.append("start"))
+    a.on_leader_stop.append(lambda: log.append("stop"))
+    a.campaign()
+    a.campaign()  # renewal must not re-fire start
+    assert log == ["start"]
+    election_harness.advance(4.0)
+    b = election_harness.candidate("node-b")
+    assert b.campaign() is True
+    a.campaign()  # fenced out -> stop fires exactly once
+    assert log == ["start", "stop"]
+
+
+# ===========================================================================
+# WAL shared-log store
+# ===========================================================================
+
+
+class _WalHarness:
+    TOPIC = "topic_0"
+
+    def __init__(self, param, tmp_path):
+        self.param = param
+        self.tmp_path = tmp_path
+        self._broker = None
+        self._stores = []
+        if param == "wire":
+            self._broker = FakeKafkaBroker().start()
+
+    def store(self):
+        if self.param == "sim":
+            s = SharedLogStore(str(self.tmp_path / "wal"), segment_bytes=1 << 20)
+        else:
+            s = KafkaSharedLog(self._broker.endpoint, call_deadline_s=2.0)
+        self._stores.append(s)
+        return s
+
+    def reopen(self):
+        """A new store instance over the same durable log (restart)."""
+        return self.store()
+
+    def crash_mid_append(self, store, region_id, entry_id, batch):
+        """Simulate a crash/fault in the middle of ONE append and return
+        whether the entry is allowed to be present afterwards.  The
+        contract both backends must honor: the outcome is ATOMIC — the
+        entry is either fully replayable or fully absent, and every
+        previously-acked entry survives.
+
+        sim : a torn frame is written directly to the active segment
+              (header promises more bytes than follow) — entry absent.
+        wire: the broker appends but the ack is lost; the client's retry
+              hits the idempotent-producer dedupe — entry present once.
+        """
+        if self.param == "sim":
+            import glob
+            import os
+            import struct
+            import zlib
+
+            from greptimedb_tpu.storage.wal import _encode_batch
+
+            payload = _encode_batch(batch)
+            header = struct.Struct("<IIQQ").pack(
+                len(payload), zlib.crc32(payload), region_id, entry_id
+            )
+            segs = sorted(
+                glob.glob(os.path.join(str(self.tmp_path / "wal"), self.TOPIC, "*.seg"))
+            )
+            with open(segs[-1], "ab") as f:
+                f.write(header + payload[: max(1, len(payload) // 2)])
+            return False
+        self._broker.lose_acks(1)
+        store.append(self.TOPIC, region_id, entry_id, batch)
+        return True
+
+    def close(self):
+        for s in self._stores:
+            if hasattr(s, "close"):
+                s.close()
+        if self._broker is not None:
+            self._broker.stop()
+
+
+@pytest.fixture(params=["sim", "wire"])
+def wal_harness(request, tmp_path):
+    h = _WalHarness(request.param, tmp_path)
+    yield h
+    h.close()
+
+
+def _ids(store, topic, region, frm=0):
+    return [e.entry_id for e in store.read(topic, region, frm)]
+
+
+def test_wal_append_replay_in_order(wal_harness):
+    store = wal_harness.store()
+    t = wal_harness.TOPIC
+    for eid in (1, 2, 3):
+        store.append(t, 7, eid, make_batch(SCHEMA, [f"h{eid}"], [eid], [0.1]))
+    assert _ids(store, t, 7) == [1, 2, 3]
+    # replay-from-watermark skips covered entries
+    assert _ids(store, t, 7, frm=2) == [3]
+    # other regions on the same topic do not leak in
+    store.append(t, 8, 1, make_batch(SCHEMA, ["x"], [9], [0.2]))
+    assert _ids(store, t, 7) == [1, 2, 3]
+    assert _ids(store, t, 8) == [1]
+    # payloads survive the roundtrip
+    entries = list(store.read(t, 7, 0))
+    assert entries[0].batch.column(0).to_pylist() == ["h1"]
+
+
+def test_wal_group_append_expands_to_entries(wal_harness):
+    store = wal_harness.store()
+    t = wal_harness.TOPIC
+    batches = [make_batch(SCHEMA, [f"g{i}"], [i], [0.1]) for i in range(3)]
+    store.append_group(t, 5, 3, batches)  # ids 1..3 in one frame
+    assert _ids(store, t, 5) == [1, 2, 3]
+    assert _ids(store, t, 5, frm=1) == [2, 3]
+    assert store.last_entry_id(t, 5) == 3
+
+
+def test_wal_survives_reopen(wal_harness):
+    store = wal_harness.store()
+    t = wal_harness.TOPIC
+    store.append(t, 1, 1, make_batch(SCHEMA, ["a"], [1], [0.1]))
+    store.append_group(t, 1, 3, [
+        make_batch(SCHEMA, ["b"], [2], [0.2]),
+        make_batch(SCHEMA, ["c"], [3], [0.3]),
+    ])
+    again = wal_harness.reopen()
+    assert _ids(again, t, 1) == [1, 2, 3]
+    assert again.last_entry_id(t, 1) == 3
+
+
+def test_wal_prune_respects_flushed_watermark(wal_harness):
+    store = wal_harness.store()
+    t = wal_harness.TOPIC
+    for eid in range(1, 6):
+        store.append(t, 2, eid, make_batch(SCHEMA, ["h"], [eid], [0.1]))
+    store.set_flushed(2, 3)
+    assert store.flushed(2) == 3
+    store.prune(t)
+    # entries above the watermark are still replayable from it
+    assert _ids(store, t, 2, frm=3) == [4, 5]
+    # and last_entry_id never went backwards
+    assert store.last_entry_id(t, 2) == 5
+
+
+def test_wal_follower_holds_prune(wal_harness):
+    store = wal_harness.store()
+    t = wal_harness.TOPIC
+    for eid in range(1, 6):
+        store.append(t, 3, eid, make_batch(SCHEMA, ["h"], [eid], [0.1]))
+    store.register_follower(3, "node-9", 1)  # follower replayed up to 1
+    store.set_flushed(3, 5)
+    store.prune(t)
+    # the follower still needs 2..5: its tail must not vanish under it
+    assert _ids(store, t, 3, frm=1) == [2, 3, 4, 5]
+    store.unregister_follower(3, "node-9")
+    store.prune(t)
+    assert store.last_entry_id(t, 3) == 5
+
+
+def test_wal_torn_append_is_atomic(wal_harness):
+    """Crash mid-append: acked prefix survives bit-exact, the interrupted
+    entry is all-or-nothing, and replay never yields garbage."""
+    store = wal_harness.store()
+    t = wal_harness.TOPIC
+    for eid in (1, 2, 3):
+        store.append(t, 4, eid, make_batch(SCHEMA, [f"h{eid}"], [eid], [0.1]))
+    landed = wal_harness.crash_mid_append(
+        store, 4, 4, make_batch(SCHEMA, ["torn"], [4], [0.4])
+    )
+    again = wal_harness.reopen()
+    expect = [1, 2, 3] + ([4] if landed else [])
+    assert _ids(again, t, 4) == expect
+    for e in again.read(t, 4, 0):
+        assert e.batch.num_rows == 1  # every surviving frame decodes cleanly
+
+
+# ===========================================================================
+# Object store
+# ===========================================================================
+
+
+class _StoreHarness:
+    def __init__(self, param):
+        self.param = param
+        self._server = None
+        self._stores = []
+        if param == "wire":
+            self._server = FakeS3Server().start()
+
+    def store(self):
+        if self.param == "sim":
+            s = MemoryObjectStore()
+        else:
+            # tiny multipart threshold so the "large blob" contract test
+            # actually exercises the multipart path on the wire
+            s = S3ObjectStore(
+                self._server.endpoint, "contract-bucket",
+                access_key=DEFAULT_ACCESS_KEY, secret_key=DEFAULT_SECRET_KEY,
+                multipart_bytes=1024,
+            )
+        self._stores.append(s)
+        return s
+
+    def close(self):
+        for s in self._stores:
+            if hasattr(s, "close"):
+                s.close()
+        if self._server is not None:
+            self._server.stop()
+
+
+@pytest.fixture(params=["sim", "wire"])
+def store_harness(request):
+    h = _StoreHarness(request.param)
+    yield h
+    h.close()
+
+
+def test_store_read_after_write(store_harness):
+    s = store_harness.store()
+    s.write("a/b.sst", b"hello world")
+    assert s.read("a/b.sst") == b"hello world"
+    s.write("a/b.sst", b"v2")  # overwrite is atomic full-object
+    assert s.read("a/b.sst") == b"v2"
+    assert s.exists("a/b.sst")
+    assert s.size("a/b.sst") == 2
+
+
+def test_store_missing_key_raises(store_harness):
+    s = store_harness.store()
+    with pytest.raises(FileNotFoundError):
+        s.read("nope")
+    assert not s.exists("nope")
+    s.delete("nope")  # delete of a missing key is a no-op, not an error
+
+
+def test_store_ranged_reads(store_harness):
+    s = store_harness.store()
+    blob = bytes(range(256)) * 4
+    s.write("ranged", blob)
+    assert s.read_range("ranged", 0, 16) == blob[:16]
+    assert s.read_range("ranged", 100, 50) == blob[100:150]
+    assert s.read_range("ranged", len(blob) - 10, 10) == blob[-10:]
+
+
+def test_store_large_blob_roundtrip(store_harness):
+    """Bigger than the wire store's multipart threshold: the sim writes it
+    whole, the wire store goes through initiate/part/complete — the caller
+    cannot tell the difference."""
+    s = store_harness.store()
+    blob = bytes([i % 251 for i in range(5000)])
+    s.write("big/sst", blob)
+    assert s.read("big/sst") == blob
+    assert s.size("big/sst") == len(blob)
+    assert s.read_range("big/sst", 2040, 100) == blob[2040:2140]
+
+
+def test_store_list_children(store_harness):
+    s = store_harness.store()
+    s.write("t/1/a.sst", b"x")
+    s.write("t/1/b.sst", b"y")
+    s.write("t/2/c.sst", b"z")
+    s.write("top.txt", b"w")
+    assert s.list("t/1") == ["a.sst", "b.sst"]
+    # immediate children only: subdirectories appear as names, their
+    # contents do not
+    assert s.list("t") == ["1", "2"]
+    s.delete("t/1/a.sst")
+    assert s.list("t/1") == ["b.sst"]

@@ -1,0 +1,158 @@
+"""Tier-1 smoke: a 3-node cluster running ALL THREE wire adapters at once.
+
+Cluster metadata and routes live in fake etcd, the WAL is the fake Kafka
+broker, SSTs go to fake S3 — every byte of coordination, log, and object
+traffic crosses real sockets through the wire resilience layer.  The
+cluster takes writes, answers distributed queries, and survives one
+datanode failover with zero failed queries.
+"""
+
+import pytest
+
+from greptimedb_tpu.distributed.cluster import Cluster
+from greptimedb_tpu.remote.fake_etcd import FakeEtcdServer
+from greptimedb_tpu.remote.fake_kafka import FakeKafkaBroker
+from greptimedb_tpu.remote.fake_s3 import (
+    DEFAULT_ACCESS_KEY,
+    DEFAULT_SECRET_KEY,
+    FakeS3Server,
+)
+from greptimedb_tpu.utils.config import Config, RemoteConfig, StorageConfig
+
+from test_storage import cpu_schema, make_batch
+
+SCHEMA = cpu_schema()
+
+
+@pytest.fixture()
+def wire_cluster(tmp_path):
+    with FakeEtcdServer() as etcd, FakeKafkaBroker() as broker, \
+            FakeS3Server() as s3:
+        cfg = Config(
+            storage=StorageConfig(wal_provider="kafka", store_type="s3"),
+            remote=RemoteConfig(
+                etcd_endpoints=etcd.endpoint,
+                kafka_endpoints=broker.endpoint,
+                s3_endpoint=s3.endpoint,
+                s3_access_key=DEFAULT_ACCESS_KEY,
+                s3_secret_key=DEFAULT_SECRET_KEY,
+                call_deadline_s=3.0,
+            ),
+        )
+        cfg.validate()
+        now = [0.0]
+        c = Cluster(str(tmp_path), num_datanodes=3, clock=lambda: now[0],
+                    config=cfg)
+        c._now = now
+        yield c, etcd
+        c.close()
+
+
+def test_wire_cluster_write_query_failover(wire_cluster):
+    cluster, etcd = wire_cluster
+    from greptimedb_tpu.remote.etcd import EtcdKvBackend
+    from greptimedb_tpu.remote.kafka import KafkaWalManager
+    from greptimedb_tpu.remote.s3 import S3ObjectStore
+
+    # every layer is actually on the wire, not a sim that happens to work
+    assert isinstance(cluster.kv, EtcdKvBackend)
+    for dn in cluster.datanodes.values():
+        assert isinstance(dn.engine.wal_mgr, KafkaWalManager)
+        store = dn.engine.object_store
+        while hasattr(store, "inner"):
+            store = store.inner
+        assert isinstance(store, S3ObjectStore)
+
+    schema = SCHEMA
+    cluster.create_table("cpu", schema, partitions=3)
+
+    hosts = [f"h{i}" for i in range(12)]
+    batch = make_batch(
+        schema, hosts, list(range(0, 12_000, 1000)),
+        [float(i) for i in range(12)],
+    )
+    assert cluster.insert("cpu", batch) == 12
+
+    # distributed query fans out over Flight-less in-process datanodes but
+    # routes come from etcd and region scans replay from kafka + s3
+    t = cluster.query("SELECT count(*) FROM cpu")
+    assert t["count(*)"].to_pylist() == [12]
+    t = cluster.query(
+        "SELECT host, max(usage_user) FROM cpu GROUP BY host ORDER BY host"
+    )
+    assert t.num_rows == 12
+
+    # flush HALF the cluster so failover must replay the rest from the
+    # broker-backed WAL (the acked-row-durability point of a remote WAL)
+    table_id = cluster.catalog.table("cpu").table_id
+    routes = cluster.metasrv.get_route(table_id)
+    victim = next(iter(set(routes.values())))
+    victim_regions = [r for r, n in routes.items() if n == victim]
+    for rid, node in routes.items():
+        if node != victim:
+            cluster.datanodes[node].engine.flush_region(rid)
+
+    for _ in range(10):
+        cluster.heartbeat_all()
+        cluster._now[0] += 1000.0
+    assert cluster.supervise() == []
+
+    cluster.kill_datanode(victim)
+    submitted = []
+    for _ in range(30):
+        cluster._now[0] += 1000.0
+        cluster.heartbeat_all()
+        submitted += cluster.supervise()
+        if submitted:
+            break
+    assert len(submitted) == len(victim_regions)
+
+    new_routes = cluster.metasrv.get_route(table_id)
+    assert all(n != victim for n in new_routes.values())
+
+    # zero failed queries: the full dataset survives, including the dead
+    # node's never-flushed rows (replayed from the fake broker)
+    t = cluster.query("SELECT count(*) FROM cpu")
+    assert t["count(*)"].to_pylist() == [12]
+    t = cluster.query("SELECT host FROM cpu ORDER BY host")
+    assert t["host"].to_pylist() == sorted(hosts)
+
+    # and the routes the survivors use really live in etcd
+    raw = EtcdKvBackend(etcd.endpoint)
+    assert raw.range("/") != {}
+    raw.close()
+
+    # writes keep flowing after the failover
+    assert cluster.insert(
+        "cpu", make_batch(schema, ["post-failover"], [99_000], [9.9])
+    ) == 1
+    t = cluster.query("SELECT count(*) FROM cpu")
+    assert t["count(*)"].to_pylist() == [13]
+
+
+def test_default_config_stays_on_sims(tmp_path):
+    """Off-safe parity: with no remote.* knob engaged, nothing touches a
+    socket — the engine keeps the local WAL + fs store and the cluster
+    keeps the in-memory KV, bit-for-bit with earlier builds."""
+    from greptimedb_tpu.distributed.kv import MemoryKvBackend
+    from greptimedb_tpu.storage.engine import TimeSeriesEngine
+    from greptimedb_tpu.storage.object_store import FsObjectStore
+    from greptimedb_tpu.storage.wal import WalManager
+
+    cfg = Config()
+    cfg.validate()
+    assert cfg.storage.wal_kafka_endpoints == ""
+    assert cfg.storage.store_s3_endpoint == ""
+    assert cfg.remote.etcd_endpoints == ""
+
+    engine = TimeSeriesEngine(StorageConfig(data_home=str(tmp_path / "e")))
+    assert isinstance(engine.wal_mgr, WalManager)
+    store = engine.object_store
+    while hasattr(store, "inner"):
+        store = store.inner
+    assert isinstance(store, FsObjectStore)
+    engine.close()
+
+    cluster = Cluster(str(tmp_path / "c"), num_datanodes=1)
+    assert isinstance(cluster.kv, MemoryKvBackend)
+    cluster.close()

@@ -502,3 +502,55 @@ def test_trace_self_off_is_todays_behavior(mini_cluster):
         for s in EXPORTER.spans()
         if s.name.startswith(("statement.", "fanout.", "datanode."))
     ]
+
+
+# ---- OTLP self-export (bare-datanode roles) --------------------------------
+
+
+def test_otlp_self_export_ships_spans_to_remote_ingest(sdb):
+    """A role with no writer path (bare datanode) drains its span ring as
+    OTLP/HTTP protobuf into a frontend's own trace ingest: the spans land
+    in the SAME `opentelemetry_traces` table, service-labeled for the
+    exporting node."""
+    from greptimedb_tpu.servers.http import HttpServer
+    from greptimedb_tpu.utils.self_trace import OtlpExportTask
+
+    server = HttpServer(sdb).start(warm=False)
+    try:
+        EXPORTER.drain()  # only the synthetic datanode spans below
+        with span("export-parent", region=3):
+            with span("export-child"):
+                pass
+        task = OtlpExportTask(
+            server.address, service="greptimedb_tpu.datanode.7",
+            interval_s=60.0,
+        )
+        before = metrics.OTLP_SELF_EXPORT_SPANS.total()
+        assert task.flush() == 2
+        assert metrics.OTLP_SELF_EXPORT_SPANS.total() == before + 2
+        assert task.flush() == 0  # ring drained; nothing re-shipped
+        out = sdb.sql_one(
+            "SELECT service_name, span_name FROM public.opentelemetry_traces"
+            " WHERE service_name = 'greptimedb_tpu.datanode.7'"
+            " ORDER BY span_name"
+        )
+        assert out["span_name"].to_pylist() == ["export-child", "export-parent"]
+        task.stop()
+    finally:
+        server.stop()
+
+
+def test_otlp_self_export_failure_is_counted_not_raised():
+    """Export is best-effort: with the collector gone the batch is dropped
+    and counted — the exporting role never sees an exception."""
+    from greptimedb_tpu.utils.self_trace import OtlpExportTask
+
+    EXPORTER.drain()
+    with span("export-doomed", region=1):
+        pass
+    # a port nothing listens on
+    task = OtlpExportTask("127.0.0.1:9", interval_s=60.0)
+    before = metrics.OTLP_SELF_EXPORT_FAILURES.total()
+    assert task.flush() == 0
+    assert metrics.OTLP_SELF_EXPORT_FAILURES.total() == before + 1
+    task.stop()
