@@ -319,7 +319,8 @@ _COMPACT_DETAIL_KEYS = (
     "device", "rows", "dataset_hours", "geomean_vs_baseline_all",
     "geomean_vs_baseline_heavy", "prewarm_s", "budget_watchdog_fired",
     "killed_by_signal", "budget_exhausted", "dataset_reused", "tql",
-    "ingest",
+    "ingest", "qps_sweep", "batched_members", "result_cache_hits",
+    "zero_failed_queries",
 )
 
 
@@ -412,7 +413,10 @@ def _clamp_record(record: dict) -> dict:
     if size(record) <= _RECORD_BYTES_MAX:
         return record
     d = record.get("detail") or {}
-    q = d.get("queries") or {}
+    # tsbs records carry a per-query dict here; the mixed record reuses
+    # the key as a completed-queries COUNTER — treat that as "no queries"
+    q = d.get("queries")
+    q = q if isinstance(q, dict) else {}
     # 1. round per-query millisecond floats >= 100 to ints (123456.8 ->
     # 123457; sub-100 ms figures keep their decimals — that precision is
     # the measurement)
@@ -428,6 +432,27 @@ def _clamp_record(record: dict) -> dict:
     co = d.get("cold_over_2x_ref")
     if isinstance(co, list) and len(co) > 4:
         d["cold_over_2x_ref"] = co[:4] + [f"+{len(co) - 4} more"]
+    if size(record) <= _RECORD_BYTES_MAX:
+        return record
+    # 2b. mixed-mode conveniences, cheapest first: the hotspot phase
+    # latencies and long error strings are diagnostics whose full copies
+    # live in BENCH_PARTIAL.json
+    hs = d.get("hotspot")
+    if isinstance(hs, dict):
+        hs.pop("phases", None)
+    errs = d.get("errors")
+    if isinstance(errs, list) and errs:
+        d["errors"] = [str(e)[:40] for e in errs[:2]]
+    if size(record) <= _RECORD_BYTES_MAX:
+        return record
+    # 2c. only then spend the sweep CURVES — the knee/sustained scalars
+    # (the verdict) survive in every regime
+    sw = d.get("qps_sweep")
+    if isinstance(sw, dict):
+        for mode in ("off", "on"):
+            ms = sw.get(mode)
+            if isinstance(ms, dict):
+                ms.pop("curve", None)
     if size(record) <= _RECORD_BYTES_MAX:
         return record
     # 3. slim the ingest digest to its headline — one "rows/s;frames/
@@ -2118,6 +2143,231 @@ MIXED_OVERCOMMIT_MB = int(os.environ.get("GRAFT_MIXED_OVERCOMMIT_MB", 1))
 
 MIXED_HOTSPOT_STEPS = int(os.environ.get("GRAFT_MIXED_HOTSPOT_STEPS", 160))
 
+# ---- dashboard-fleet QPS sweep (cross-query batching + result cache) -------
+# Offered-load levels (queries/s), swept twice: batching+cache OFF, then
+# ON.  The headline is the knee — the highest offered load the engine
+# sustains (achieved within 85% of offered) at bounded p99.
+MIXED_SWEEP_QPS = tuple(
+    float(x)
+    for x in os.environ.get(
+        "GRAFT_MIXED_SWEEP_QPS", "25,50,100,200,400,800,1600"
+    ).split(",")
+    if x.strip()
+)
+MIXED_SWEEP_SECONDS = float(os.environ.get("GRAFT_MIXED_SWEEP_SECONDS", 2.5))
+MIXED_SWEEP_WORKERS = int(os.environ.get("GRAFT_MIXED_SWEEP_WORKERS", 8))
+MIXED_BATCH_WINDOW_MS = float(os.environ.get("GRAFT_MIXED_BATCH_WINDOW_MS", 2.0))
+MIXED_RESULT_CACHE_MB = int(os.environ.get("GRAFT_MIXED_RESULT_CACHE_MB", 64))
+
+
+def _mixed_fleet(lo12: int, end_ms: int) -> list:
+    """The dashboard fleet: DISTINCT panel queries (different aggregates,
+    group shapes, literals) over the same fixed window — the shape PR 6
+    coalescing canNOT merge (plans differ) and the batcher exists for."""
+    fleet = [
+        ("panel-groupby", (
+            f"SELECT hostname, time_bucket('1h', ts) AS tb, "
+            f"avg(usage_user) AS au FROM cpu WHERE ts >= {lo12} AND "
+            f"ts < {end_ms} GROUP BY hostname, tb"
+        )),
+        ("panel-max", (
+            f"SELECT time_bucket('1h', ts) AS tb, max(usage_user) AS mu, "
+            f"min(usage_user) AS nu FROM cpu WHERE ts >= {lo12} AND "
+            f"ts < {end_ms} GROUP BY tb"
+        )),
+        ("panel-count", (
+            f"SELECT count(*) AS n, max(usage_system) AS mx FROM cpu "
+            f"WHERE ts >= {lo12} AND ts < {end_ms}"
+        )),
+    ]
+    for i in range(3):
+        fleet.append((f"panel-host{i}", (
+            f"SELECT time_bucket('1h', ts) AS tb, avg(usage_user) AS au, "
+            f"max(usage_system) AS ms FROM cpu WHERE hostname = 'host_{i}' "
+            f"AND ts >= {lo12} AND ts < {end_ms} GROUP BY tb"
+        )))
+    return fleet
+
+
+def _sweep_level(db, fleet, offered_qps: float, seconds: float, workers: int) -> dict:
+    """Open-loop arrival pacing: arrival i is SCHEDULED at t0 + i/qps
+    regardless of completions — the generator never slows down when the
+    server does, so achieved < offered IS the overload signal (a closed
+    loop would flatter the knee by self-throttling)."""
+    import threading
+
+    from greptimedb_tpu.utils.errors import RetryLaterError
+
+    walls: list[float] = []
+    c = {"cursor": 0, "ok": 0, "shed": 0, "failed": 0}
+    lock = threading.Lock()
+    t0 = time.perf_counter()
+    deadline = t0 + seconds
+    total = max(int(offered_qps * seconds), 1)
+
+    def worker():
+        while True:
+            now = time.perf_counter()
+            if now > deadline:
+                return
+            with lock:
+                i = c["cursor"]
+                if i >= total:
+                    return
+                c["cursor"] = i + 1
+            at = t0 + i / offered_qps
+            delay = at - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            _name, sql = fleet[i % len(fleet)]
+            tq = time.perf_counter()
+            try:
+                db.sql_one(sql)
+            except RetryLaterError:
+                with lock:
+                    c["shed"] += 1
+                continue
+            except Exception:  # noqa: BLE001 — the zero-failed contract
+                with lock:
+                    c["failed"] += 1
+                continue
+            wall = (time.perf_counter() - tq) * 1000
+            with lock:
+                c["ok"] += 1
+                walls.append(wall)
+
+    threads = [
+        threading.Thread(target=worker, daemon=True) for _ in range(workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=seconds + 60)
+    elapsed = max(time.perf_counter() - t0, 1e-6)
+    arr = np.array(walls) if walls else None
+    return {
+        "offered_qps": offered_qps,
+        "achieved_qps": round(c["ok"] / elapsed, 1),
+        "p50_ms": round(float(np.percentile(arr, 50)), 2) if arr is not None else None,
+        "p99_ms": round(float(np.percentile(arr, 99)), 2) if arr is not None else None,
+        "ok": c["ok"],
+        "shed": c["shed"],
+        "failed": c["failed"],
+    }
+
+
+def _sweep_knee(levels: list) -> dict:
+    """The knee: the highest-throughput level still keeping up with its
+    offered rate (achieved >= 85% of offered); past it the curve bends —
+    falling back to the best-achieved level when every level is bent."""
+    kept = [
+        lv for lv in levels
+        if lv["achieved_qps"] >= 0.85 * lv["offered_qps"]
+    ]
+    pool = kept or levels
+    return max(pool, key=lambda lv: lv["achieved_qps"])
+
+
+def _qps_sweep_phase(db, lo12: int, end_ms: int) -> dict:
+    """Sweep the offered-load ladder twice — batching+cache OFF then ON —
+    on the now-static snapshot (ingest stopped, so the dashboard fleet's
+    repeated aligned windows are cacheable, exactly the between-ticks
+    regime the result cache exists for).  OFF runs first so plane builds
+    and XLA compiles are paid OUTSIDE the ON timings."""
+    fleet = _mixed_fleet(lo12, end_ms)
+    bcfg = db.config.batch
+    db.config.query.timeout_s = 30.0
+    sweep: dict = {"batch_window_ms": MIXED_BATCH_WINDOW_MS,
+                   "fleet": len(fleet), "workers": MIXED_SWEEP_WORKERS}
+    for mode in ("off", "on"):
+        if mode == "on":
+            bcfg.window_ms = MIXED_BATCH_WINDOW_MS
+            bcfg.result_cache_mb = MIXED_RESULT_CACHE_MB
+        else:
+            bcfg.window_ms = 0.0
+            bcfg.result_cache_mb = 0
+            for _name, sql in fleet:  # warm: build + compile off the clock
+                db.sql_one(sql)
+        levels = [
+            _sweep_level(db, fleet, qps, MIXED_SWEEP_SECONDS, MIXED_SWEEP_WORKERS)
+            for qps in MIXED_SWEEP_QPS
+        ]
+        knee = _sweep_knee(levels)
+        sweep[mode] = {
+            "curve": [
+                [lv["offered_qps"], lv["achieved_qps"], lv["p50_ms"],
+                 lv["p99_ms"], lv["shed"]]
+                for lv in levels
+            ],
+            "knee_offered_qps": knee["offered_qps"],
+            "knee_qps": knee["achieved_qps"],
+            "p99_at_knee_ms": knee["p99_ms"],
+            "sustained_qps": max(lv["achieved_qps"] for lv in levels),
+            "failed": sum(lv["failed"] for lv in levels),
+        }
+        _emit({"event": "mixed_qps_sweep", "mode": mode,
+               "knee_qps": sweep[mode]["knee_qps"],
+               "sustained_qps": sweep[mode]["sustained_qps"],
+               "elapsed_s": round(_elapsed(), 1)})
+    off_s = max(sweep["off"]["sustained_qps"], 1e-9)
+    sweep["speedup"] = round(sweep["on"]["sustained_qps"] / off_s, 1)
+    return sweep
+
+
+def _batch_burst_phase(db, fleet_n: int = 4) -> dict:
+    """Deterministic mega-dispatch evidence: K DISTINCT warm panel
+    queries released at a barrier inside one WIDE batch window — the
+    record's batched_members counter cannot depend on probabilistic
+    steady-state overlap.  The result cache is held OFF for the burst
+    (a cache hit never dispatches, so it would starve the batcher)."""
+    import threading
+
+    from greptimedb_tpu.utils import metrics as m
+
+    bcfg = db.config.batch
+    win0, mb0 = bcfg.window_ms, bcfg.result_cache_mb
+    bcfg.window_ms, bcfg.result_cache_mb = 60.0, 0
+    lo = T0
+    hi = T0 + 3600_000
+    fleet = _mixed_fleet(lo, hi)[:fleet_n]
+    d0 = m.QUERY_BATCH_DISPATCHES_TOTAL.get()
+    m0 = m.QUERY_BATCH_MEMBERS_TOTAL.get()
+    failed = 0
+    rounds = 0
+    try:
+        for _name, sql in fleet:  # warm every family (build + mark)
+            db.sql_one(sql)
+            db.sql_one(sql)
+        for rounds in range(1, 6):
+            barrier = threading.Barrier(len(fleet))
+
+            def one(sql):
+                nonlocal failed
+                try:
+                    barrier.wait(timeout=30)
+                    db.sql_one(sql)
+                except Exception:  # noqa: BLE001 — counted in the record
+                    failed += 1
+
+            threads = [
+                threading.Thread(target=one, args=(sql,), daemon=True)
+                for _name, sql in fleet
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            if m.QUERY_BATCH_DISPATCHES_TOTAL.get() > d0:
+                break
+    finally:
+        bcfg.window_ms, bcfg.result_cache_mb = win0, mb0
+    return {
+        "dispatches": m.QUERY_BATCH_DISPATCHES_TOTAL.get() - d0,
+        "members": m.QUERY_BATCH_MEMBERS_TOTAL.get() - m0,
+        "rounds": rounds,
+        "failed": failed,
+    }
+
 
 def _hotspot_phase() -> dict:
     """Elastic hot-spot scenario: skewed ingest (every row on one tag key)
@@ -2444,6 +2694,32 @@ def mixed_main():
     stop.set()
     for w in workers:
         w.join(timeout=60.0)
+
+    # Dashboard-fleet QPS sweep (cross-query batching + result cache):
+    # offered-load ladder OFF then ON over the now-static snapshot; the
+    # record carries both curves, the knee, and the ON/OFF speedup.
+    try:
+        qps_sweep = _qps_sweep_phase(db, lo12, end_ms)
+    except Exception as exc:  # noqa: BLE001 — surfaced in the record
+        qps_sweep = {"error": repr(exc)[:200]}
+    detail["qps_sweep"] = qps_sweep
+    _write_partial({"detail": detail, "queries": {}})
+
+    # Deterministic mega-dispatch evidence (distinct warm queries at a
+    # barrier in one wide window) so batched_members never flakes to 0.
+    try:
+        burst = _batch_burst_phase(db)
+    except Exception as exc:  # noqa: BLE001 — surfaced in the record
+        burst = {"error": repr(exc)[:200], "dispatches": 0, "members": 0}
+    detail["batch_dispatches"] = burst.get("dispatches", 0)
+    detail["batched_members"] = burst.get("members", 0)
+    detail["batch_burst"] = burst
+    detail["result_cache_hits"] = m.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get()
+    _emit({"event": "mixed_batch_phase",
+           "batched_members": detail["batched_members"],
+           "result_cache_hits": detail["result_cache_hits"],
+           "sweep_speedup": qps_sweep.get("speedup"),
+           "elapsed_s": round(_elapsed(), 1)})
     db.config.query.timeout_s = 0.0
 
     # Elastic hot-spot scenario on a distributed cluster (balancer ON):
@@ -2501,14 +2777,17 @@ def mixed_main():
     with _EMIT_LOCK:
         if not _STATE["emitted"]:
             _STATE["emitted"] = True
-            _emit({
+            # the emitted line must fit the driver's tail capture like the
+            # tsbs record does; the partial keeps the UNCLAMPED detail
+            record = _clamp_record({
                 "metric": "mixed_load_e2e_p99",
                 "value": p99,
                 "unit": "ms",
                 "vs_baseline": None,
-                "detail": detail,
+                "detail": json.loads(json.dumps(detail)),
             })
-            _write_partial({"detail": detail, "queries": {}})
+            _emit(record)
+            _write_partial({"detail": detail, "queries": {}}, record=record)
             try:
                 with open(PARTIAL_PATH + ".done", "w") as f:
                     f.write("1")

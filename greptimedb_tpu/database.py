@@ -190,6 +190,8 @@ class Database:
             self.query_engine.tile_cache.tile_config = self.config.tile
             # overload-survival knobs (dispatch coalescing, HBM feedback)
             self.query_engine.tile_cache.admission_config = self.config.admission
+            # cross-query batching window + windowed result cache
+            self.query_engine.tile_cache.batch_config = self.config.batch
             from .utils import metrics as _metrics
 
             _metrics.HBM_CHUNK_ROWS.set(self.query_engine.tile_cache.chunk_rows)

@@ -91,6 +91,13 @@ from ..utils.deadline import check_deadline, current_deadline
 from ..utils.errors import QueryTimeoutError
 from ..utils.fault_injection import fire as _fault_fire
 from ..utils.jax_compat import shard_map as _shard_map
+from .batcher import (
+    PendingFetch,
+    QueryBatcher,
+    WindowedResultCache,
+    defer_active as _defer_fetch_active,
+    defer_suppressed as _defer_fetch_suppressed,
+)
 from .executor import (
     COUNT_STAR,
     DistGroupByPlan,
@@ -493,6 +500,12 @@ class TileCacheManager:
         # (dispatch coalescing, HBM probe, halve-chunk retry).  None =
         # everything off, pre-layer behavior bit-for-bit.
         self.admission_config = None
+        # BatchConfig wired by the Database: cross-query batching window
+        # + windowed result cache.  None = both off, pre-layer bit-for-bit.
+        self.batch_config = None
+        # WindowedResultCache, created lazily by the executor when
+        # batch.result_cache_mb > 0; invalidate_region purges it
+        self.result_cache = None
         self._persist_pool: set[str] = set()  # filesets being written
         self._meshes: dict[int, object] = {}  # n_devices -> cached Mesh
         self._lock = threading.RLock()
@@ -615,6 +628,9 @@ class TileCacheManager:
                 self._used -= dropped.nbytes
                 self._host_used -= dropped.host_nbytes
             self._region_versions.pop(region_id, None)
+        rc = self.result_cache
+        if rc is not None:
+            rc.purge_region(region_id)
 
     def invalidate_region_if_changed(
         self, region_id: int, keep_file_ids: set[str], manifest_version: int
@@ -3628,6 +3644,9 @@ class TileExecutor:
         self._fused_worker_live = False
         self._fused_thread = None
         self._fused_stop = False
+        # cross-query batcher (batch.window_ms): idle until the knob is
+        # on AND a family is warm; holds only a lock and an open-batch map
+        self._batcher = QueryBatcher(self)
 
     _FUSED_FAMILIES_MAX = 4096
 
@@ -3635,18 +3654,74 @@ class TileExecutor:
     def execute(self, lowering, schema, time_bounds, ctx: TileContext):
         t0 = time.perf_counter()
         fp = None
-        if self._fused_enabled() and not _in_fused_build():
+        bc = self.cache.batch_config
+        batching = (
+            bc is not None
+            and float(getattr(bc, "window_ms", 0) or 0) > 0
+            and not _in_fused_build()
+            and not _defer_fetch_active()
+        )
+        if (self._fused_enabled() or batching) and not _in_fused_build():
             fp = self._plan_fp(lowering, ctx)
-            if fp is not None:
+            if fp is not None and self._fused_enabled():
                 # build-side coalescing: a family whose fused build is in
                 # flight WAITS and adopts the leader's planes instead of
                 # running a second full build under the table lock
                 self._fused_join(fp)
         adm = self.cache.admission_config
-        if adm is not None and getattr(adm, "coalesce", False):
-            out = self._coalesced_execute(lowering, schema, time_bounds, ctx, adm)
-        else:
-            out = self._overload_safe_execute(lowering, schema, time_bounds, ctx, adm)
+        # windowed result cache: probe BEFORE any dispatch.  The key is
+        # computed once here and reused for the store below, so a write
+        # landing mid-query can only strand an unreachable old-versions
+        # entry — never publish a newer result under an older snapshot key
+        rc = None if _in_fused_build() else self._result_cache(bc)
+        ck = None
+        if rc is not None:
+            ck = WindowedResultCache.key_for(self, lowering, schema, ctx)
+            hit = None
+            if ck is not None:
+                try:
+                    _fault_fire(
+                        "batch.result_cache", op="get", table=ctx.table_key
+                    )
+                    hit = rc.get(ck)
+                except Exception:  # noqa: BLE001 — a failing probe is a miss
+                    hit = None
+            if hit is not None:
+                table, post_done = hit
+                lowering.post_done = post_done
+                metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.inc()
+                metrics.TILE_QUERY_ELAPSED.observe(time.perf_counter() - t0)
+                tracing.add_event(
+                    "tile.result_cache_hit", table=ctx.table_key
+                )
+                flight_recorder.emit_adopted(flight_recorder.DispatchRecord(
+                    ts_ms=int(time.time() * 1000), table=ctx.table_key,
+                    trace_id=tracing.current_trace_id() or "",
+                    plan_fp=self._recorder_fp(lowering, ctx),
+                    strategy="result_cache", flags=("cache_hit",),
+                ))
+                return table
+        out = None
+        ran = False
+        if batching and fp is not None:
+            with self._fused_lock:
+                warm = fp in self._fused_done
+            if warm:
+                # warm family inside the batching window: pack with any
+                # concurrent warm peers into one fused mega-dispatch
+                out = self._batcher.submit(
+                    lowering, schema, time_bounds, ctx, adm, bc
+                )
+                ran = True
+        if not ran:
+            if adm is not None and getattr(adm, "coalesce", False):
+                out = self._coalesced_execute(
+                    lowering, schema, time_bounds, ctx, adm
+                )
+            else:
+                out = self._overload_safe_execute(
+                    lowering, schema, time_bounds, ctx, adm
+                )
         if out is not None:
             metrics.TILE_QUERY_ELAPSED.observe(time.perf_counter() - t0)
             if fp is not None:
@@ -3655,7 +3730,30 @@ class TileExecutor:
                         # the device path answered without a host serve:
                         # the family is warm — stop first-touch probing
                         self._mark_fused_locked(self._fused_done, fp)
+            if rc is not None and ck is not None:
+                try:
+                    _fault_fire(
+                        "batch.result_cache", op="put", table=ctx.table_key
+                    )
+                    rc.put(ck, out, lowering.post_done)
+                except Exception:  # noqa: BLE001 — a failing store keeps
+                    pass  # the computed result; the cache is best-effort
         return out
+
+    def _result_cache(self, bc):
+        """The process-wide WindowedResultCache, created lazily the first
+        time batch.result_cache_mb engages (None while the knob is 0)."""
+        if bc is None or int(getattr(bc, "result_cache_mb", 0) or 0) <= 0:
+            return None
+        rc = self.cache.result_cache
+        if rc is None:
+            with self._coalesce_lock:
+                rc = self.cache.result_cache
+                if rc is None:
+                    rc = self.cache.result_cache = WindowedResultCache(
+                        int(bc.result_cache_mb) << 20
+                    )
+        return rc
 
     # -- fused family builds (tile.fused_build) ------------------------------
     def _fused_enabled(self) -> bool:
@@ -4381,12 +4479,17 @@ class TileExecutor:
                 and win_rows <= 0.55 * total_rows
             )
             if est_dev > threshold * self.cache.budget and not window_served:
-                streamed = self._streamed_execute(
-                    lowering, schema, scan, ctx, time_bounds, region_sources,
-                    dedup_regions, ts_name, tag_cols, all_tag_cols,
-                    value_cols, use_ts, device_value_cols, pinned_ids, pk,
-                    window, in_window, est_dev,
-                )
+                # the streamed path releases each region's planes right
+                # after folding its partials: its fetches must stay
+                # eager even under a batch leader's deferred-fetch scope
+                with _defer_fetch_suppressed():
+                    streamed = self._streamed_execute(
+                        lowering, schema, scan, ctx, time_bounds,
+                        region_sources, dedup_regions, ts_name, tag_cols,
+                        all_tag_cols, value_cols, use_ts,
+                        device_value_cols, pinned_ids, pk, window,
+                        in_window, est_dev,
+                    )
                 if streamed is not None:
                     return streamed
                 # shape not streamable (dedup/time-major/bail): the
@@ -6750,6 +6853,21 @@ class TileExecutor:
         self, packed, int_layout, acc32_layout, acc64_layout, int_dtype,
         plan, lowering, schema, ctx, dyn_host, spec=None,
     ):
+        if _defer_fetch_active() and not _in_fused_build():
+            # batch-leader mode: the dispatch is in flight on the device
+            # stream; hand back the output leaves + the decode
+            # continuation so the batcher can fetch EVERY member's
+            # results in one device_get.  The leaves are the program's
+            # own output buffers — plane eviction only drops references,
+            # so they stay alive until the mega-fetch lands.
+            return PendingFetch(
+                leaves=packed,
+                finish=functools.partial(
+                    self._finish_fetched, int_layout, acc32_layout,
+                    acc64_layout, int_dtype, plan, lowering, schema, ctx,
+                    dyn_host, spec,
+                ),
+            )
         # ONE logical host fetch total, regardless of how many aggregates
         # ran; transfer and host-decode are metered separately so
         # streamed-readback wins stay attributable (the combined
@@ -6799,6 +6917,31 @@ class TileExecutor:
                 self._rb_local.decode_ms = dec_ms
                 rb_span.attributes["decode_ms"] = round(dec_ms, 3)
                 flight_recorder.stage_add("readback_decode", dec_ms)
+
+    def _finish_fetched(
+        self, int_layout, acc32_layout, acc64_layout, int_dtype, plan,
+        lowering, schema, ctx, dyn_host, spec, fetched,
+    ):
+        """Deferred-fetch continuation: everything `_finalize` does AFTER
+        `_fetch_result`, applied to leaves the batcher already brought
+        home inside the mega-readback.  Returns the decoded table, or
+        None on a rerun verdict (the member then degrades to solo)."""
+        fetched = tuple(np.asarray(p) for p in fetched)
+        buf = fetched[0]
+        accs64 = fetched[1] if len(fetched) > 1 else None
+        table_keys = fetched[2] if len(fetched) > 2 else None
+        metrics.TPU_READBACK_BYTES.inc(sum(p.nbytes for p in fetched))
+        t_dec = time.perf_counter()
+        try:
+            return self._decode_result(
+                buf, accs64, int_layout, acc32_layout, acc64_layout,
+                int_dtype, plan, lowering, ctx, dyn_host, spec,
+                table_keys=table_keys,
+            )
+        finally:
+            dec_ms = (time.perf_counter() - t_dec) * 1000.0
+            metrics.TPU_READBACK_DECODE_MS.observe(dec_ms)
+            self._rb_local.decode_ms = dec_ms
 
     def _decode_result(
         self, buf, accs64, int_layout, acc32_layout, acc64_layout,

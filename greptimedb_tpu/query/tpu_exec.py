@@ -247,6 +247,12 @@ class TpuExecutor:
         rec = flight_recorder.last_record()
         if rec is None:
             return
+        if rec.strategy == "result_cache":
+            # zero-dispatch serve: the one line that matters is WHY
+            analyze.record("device.result_cache", result_cache="hit")
+            if rec.flags:
+                analyze.record("device.flags", flags=",".join(rec.flags))
+            return
         for name in flight_recorder.STAGES:
             ms = rec.stage_ms(name)
             attrs = {}

@@ -631,6 +631,38 @@ class AdmissionConfig:
 
 
 @dataclasses.dataclass
+class BatchConfig:
+    """Cross-query device batching + windowed result cache
+    (parallel/batcher.py, hooked into the tile executor).  EVERYTHING
+    here defaults off-safe: with `window_ms = 0` and `result_cache_mb
+    = 0` the dispatch path behaves bit-for-bit as before this layer
+    existed.
+
+    Batching extends PR 6 coalescing from *identical* plans to
+    *distinct* plans over the same resident table: warm queries that
+    arrive within `window_ms` of each other are dispatched back-to-back
+    on the device stream and their packed result buffers come home in
+    ONE readback, amortizing the per-dispatch tunnel RTT across the
+    batch.  Results are bit-identical to solo runs — members share the
+    readback, never each other's math — and any member that cannot be
+    packed degrades to its own solo dispatch."""
+
+    # Batching window: a warm query waits up to this long for peers to
+    # join its mega-dispatch.  0 disables batching entirely (today's
+    # path, bit-for-bit).
+    window_ms: float = 0.0
+    # Most members one mega-dispatch may carry; arrivals past the cap
+    # start the next batch rather than queueing behind this one.
+    max_members: int = 16
+    # Windowed result cache budget.  Keyed on (literal-insensitive plan
+    # fingerprint + literal digest, per-region manifest version + WAL
+    # tail id, bucket-aligned time window) so a sliding dashboard
+    # re-serves without any dispatch; flush/delta bumps the manifest
+    # version out from under stale entries.  0 disables the cache.
+    result_cache_mb: int = 0
+
+
+@dataclasses.dataclass
 class MemoryConfig:
     """Admission-style memory governance (reference common/memory-manager,
     servers request_memory_limiter `max_in_flight_write_bytes`,
@@ -740,6 +772,7 @@ class Config:
     replica: ReplicaConfig = dataclasses.field(default_factory=ReplicaConfig)
     tile: TileConfig = dataclasses.field(default_factory=TileConfig)
     admission: AdmissionConfig = dataclasses.field(default_factory=AdmissionConfig)
+    batch: BatchConfig = dataclasses.field(default_factory=BatchConfig)
     flow: FlowConfig = dataclasses.field(default_factory=FlowConfig)
     index: IndexConfig = dataclasses.field(default_factory=IndexConfig)
     ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
@@ -1024,6 +1057,23 @@ class Config:
                 "admission.min_chunk_rows must be >= 4096 (the kernel block "
                 "size — halving below one block cannot help an OOM); got "
                 f"{a.min_chunk_rows!r}"
+            )
+        bt = self.batch
+        if bt.window_ms < 0:
+            raise ConfigError(
+                "batch.window_ms must be >= 0 milliseconds (0 disables "
+                f"cross-query batching); got {bt.window_ms!r}"
+            )
+        if bt.max_members < 2:
+            raise ConfigError(
+                "batch.max_members must be >= 2 queries per mega-dispatch "
+                "— a one-member batch is just a solo dispatch with extra "
+                f"latency; got {bt.max_members!r}"
+            )
+        if bt.result_cache_mb < 0:
+            raise ConfigError(
+                "batch.result_cache_mb must be >= 0 MB (0 disables the "
+                f"windowed result cache); got {bt.result_cache_mb!r}"
             )
         ix = self.index
         if not isinstance(ix.segmented, bool):

@@ -83,6 +83,21 @@ Named points wired into the codebase:
                        degrade contract: the query falls back to the
                        single-chip dispatch path and still returns the
                        correct answer (greptime_tile_mesh_degraded_total)
+    batch.pack         cross-query batcher pack point (parallel/
+                       batcher.py), fired immediately before the batch's
+                       deferred result buffers are flattened into the
+                       single mega-readback (ctx: members, leaves).  An
+                       injected error here proves the degrade contract:
+                       every member falls back to its own solo dispatch
+                       and still returns the bit-identical answer —
+                       packing can delay a query, never corrupt one
+    batch.result_cache windowed result cache probe/store (parallel/
+                       batcher.py via the tile executor; ctx: op =
+                       "get"/"put", table).  An injected error here is
+                       swallowed: a failing cache lookup falls through
+                       to a normal dispatch and a failing store keeps
+                       the computed result — the cache is an
+                       accelerator, never a correctness dependency
     balance.decide     elastic balancer decision enactment
                        (distributed/balancer.py), fired after hysteresis
                        admits a decision but BEFORE the procedure is
@@ -179,6 +194,8 @@ POINTS = frozenset(
         "tql.tile",
         "recorder.emit",
         "ingest.group_commit",
+        "batch.pack",
+        "batch.result_cache",
         "balance.decide",
         "repartition.copy",
         "migration.swap",

@@ -368,6 +368,20 @@ def add_bytes(up: int = 0, down: int = 0):
     d.rec.bytes_down += int(down)
 
 
+def emit_adopted(rec: DispatchRecord) -> bool:
+    """Emit a record built outside any scope (result-cache hits and
+    coalesced waiters finish on paths that never open dispatch_scope)
+    and adopt it as this thread's `last_record()` so EXPLAIN ANALYZE
+    still sees the per-query outcome.  Returns False (and adopts
+    nothing) while the recorder is disabled."""
+    if not RECORDER.enabled:
+        return False
+    if RECORDER.emit(rec):
+        _tls.last = rec
+        return True
+    return False
+
+
 def last_record() -> DispatchRecord | None:
     """The record most recently emitted from THIS thread's scope — the
     per-query view EXPLAIN ANALYZE reads (ghost records are emitted on

@@ -559,6 +559,27 @@ DISPATCH_COALESCE_LEADERS_TOTAL = REGISTRY.counter(
     "greptime_dispatch_coalesce_leader_total",
     "Tile dispatches that executed as a coalition leader with >= 1 waiter",
 )
+QUERY_BATCH_DISPATCHES_TOTAL = REGISTRY.counter(
+    "greptime_query_batch_dispatches_total",
+    "Fused mega-dispatches executed by the cross-query batcher (>= 2 "
+    "distinct warm queries sharing one packed device readback)",
+)
+QUERY_BATCH_MEMBERS_TOTAL = REGISTRY.counter(
+    "greptime_query_batch_members_total",
+    "Queries whose result came home inside a batched mega-readback "
+    "(members per dispatch = members_total / dispatches_total)",
+)
+QUERY_BATCH_RESULT_CACHE_HITS_TOTAL = REGISTRY.counter(
+    "greptime_query_batch_result_cache_hits_total",
+    "Warm queries served from the windowed result cache with zero "
+    "device dispatch (key: plan fingerprint + literal digest + region "
+    "versions + aligned window)",
+)
+QUERY_BATCH_RESULT_CACHE_EVICTIONS_TOTAL = REGISTRY.counter(
+    "greptime_query_batch_result_cache_evictions_total",
+    "Result-cache entries dropped: LRU pressure against "
+    "batch.result_cache_mb or region invalidation on flush/delta",
+)
 HBM_EXHAUSTED_TOTAL = REGISTRY.counter(
     "greptime_hbm_exhausted_total",
     "RESOURCE_EXHAUSTED dispatch failures absorbed by the closed HBM "

@@ -1,0 +1,363 @@
+"""Cross-query device batching + windowed result cache (parallel/batcher.py).
+
+The batcher packs N DISTINCT concurrent warm queries into one fused
+mega-dispatch (shared readback, per-query decode) under the PR 6
+coalescing contract extended to distinct plans: every batched result must
+be BIT-identical to a solo run, and every failure mode must degrade to a
+solo dispatch, never a wrong answer.  The windowed result cache re-serves
+a repeated aligned-window query with ZERO device dispatch and is
+invalidated by the same version machinery coalescing keys on.
+
+Fault points exercised here (the conftest coverage gate):
+    "batch.pack"          pack failure -> members solo, results correct
+    "batch.result_cache"  cache get/put failure -> miss/skip, never error
+"""
+
+import io
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from greptimedb_tpu.database import Database
+from greptimedb_tpu.utils import fault_injection as fi
+from greptimedb_tpu.utils import metrics
+from greptimedb_tpu.utils.config import BatchConfig, Config
+
+
+def _ser(t: pa.Table) -> bytes:
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, t.schema) as w:
+        w.write_table(t)
+    return sink.getvalue()
+
+
+def _mk_db(tmp_path, name, *, strategy="auto", mesh=0, device_topk=True,
+           window_ms=0.0, cache_mb=0):
+    cfg = Config()
+    cfg.storage.compaction_background_enable = False
+    cfg.query.tpu_min_rows = 1  # everything takes the device path
+    cfg.query.agg_strategy = strategy
+    cfg.query.device_topk = device_topk
+    # deterministic warmth: with the fused builder off, the first device
+    # dispatch itself marks the family warm (= batch-eligible)
+    cfg.tile.fused_build = False
+    cfg.tile.mesh_devices = mesh
+    cfg.batch.window_ms = window_ms
+    cfg.batch.result_cache_mb = cache_mb
+    cfg.validate()
+    return Database(data_home=str(tmp_path / name), config=cfg)
+
+
+def _load(db, seed, n=5_000, n_keys=120, nulls=True, null_tags=True):
+    """Seeded random load with null tags AND null values (the agg-parity
+    loader shape): integer-valued v keeps sums exact across strategies."""
+    rng = np.random.default_rng(seed)
+    db.sql(
+        "CREATE TABLE t (k STRING, g STRING, ts TIMESTAMP TIME INDEX,"
+        " v DOUBLE, w DOUBLE, PRIMARY KEY (k, g)) WITH (append_mode='true')"
+    )
+    keys = rng.integers(0, n_keys, n)
+    ks = np.array([f"k{i:05d}" for i in keys])
+    gs = np.array([f"g{i % 7}" for i in keys])
+    g_arr = (
+        pa.array(
+            [None if i % 11 == 0 else g for i, g in enumerate(gs)], pa.string()
+        )
+        if null_tags
+        else pa.array(gs)
+    )
+    v = rng.integers(-500, 500, n).astype(np.float64)
+    v_arr = (
+        pa.array(
+            [None if i % 7 == 0 else x for i, x in enumerate(v)], pa.float64()
+        )
+        if nulls
+        else pa.array(v)
+    )
+    db.insert_rows("t", pa.table({
+        "k": pa.array(ks),
+        "g": g_arr,
+        "ts": pa.array(np.arange(n, dtype=np.int64) * 1000, pa.timestamp("ms")),
+        "v": v_arr,
+        "w": pa.array(rng.uniform(-1e3, 1e3, n)),
+    }))
+    db.storage.flush_all()
+
+
+# N DISTINCT plan families over one table — different aggregates, filter
+# structures and group shapes.  None are bit-identical (PR 6 coalescing
+# would merge none of them); ALL are warm-batchable against table t.
+_QUERIES = (
+    "SELECT k, g, sum(v) AS sv, count(*) AS c FROM t GROUP BY k, g",
+    "SELECT g, max(w) AS xw, min(w) AS mw FROM t GROUP BY g",
+    "SELECT time_bucket('1m', ts) AS tb, sum(v) AS sv FROM t GROUP BY tb",
+    "SELECT g, avg(v) AS av, count(v) AS cv FROM t GROUP BY g",
+    "SELECT g, count(v) AS cv FROM t WHERE g = 'g3' GROUP BY g",
+)
+
+
+def _concurrent(db, queries, rounds=1):
+    """Run each query on its own thread, all released together; returns
+    (results, errors) with results index-aligned to `queries`."""
+    results = [None] * len(queries)
+    errors = []
+    barrier = threading.Barrier(len(queries))
+
+    def run(i, q):
+        try:
+            barrier.wait(timeout=30)
+            for _ in range(rounds):
+                results[i] = db.sql_one(q)
+        except Exception as exc:  # noqa: BLE001 — asserted by callers
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=run, args=(i, q))
+        for i, q in enumerate(queries)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, errors
+
+
+@pytest.mark.parametrize(
+    "strategy,mesh,device_topk,seed",
+    [
+        ("sort", 0, True, 2),   # dense strategy, single chip, device finalize
+        ("hash", 0, False, 3),  # hash strategy, single chip, host post-ops
+        ("sort", 1, False, 4),  # dense strategy through the 1-device mesh
+        ("hash", 1, True, 5),   # hash strategy, mesh + device finalize
+    ],
+)
+def test_batched_vs_solo_bit_parity(tmp_path, strategy, mesh, device_topk, seed):
+    """N distinct concurrent queries batched into a mega-dispatch return
+    BYTE-identical tables to their solo runs, across strategies, null
+    tags/values, device-finalize on/off and mesh 0/1."""
+    db = _mk_db(
+        tmp_path, "parity", strategy=strategy, mesh=mesh,
+        device_topk=device_topk, window_ms=60.0,
+    )
+    try:
+        _load(db, seed)
+        # sequential runs are batches of ONE: the leader takes the plain
+        # solo dispatch path (no deferred fetch) — these warm every
+        # family AND capture the solo reference bytes
+        solo = {}
+        for q in _QUERIES:
+            db.sql_one(q)  # cold: plane build + warm marking
+            solo[q] = _ser(db.sql_one(q))
+        d0 = metrics.QUERY_BATCH_DISPATCHES_TOTAL.get()
+        m0 = metrics.QUERY_BATCH_MEMBERS_TOTAL.get()
+        results, errors = _concurrent(db, _QUERIES)
+        assert not errors
+        for q, r in zip(_QUERIES, results):
+            assert _ser(r) == solo[q], (
+                f"batched result diverged from solo for {q!r} under "
+                f"strategy={strategy} mesh={mesh} device_topk={device_topk}"
+            )
+        assert metrics.QUERY_BATCH_DISPATCHES_TOTAL.get() - d0 >= 1, (
+            "no mega-dispatch formed: batching never engaged"
+        )
+        assert metrics.QUERY_BATCH_MEMBERS_TOTAL.get() - m0 >= 2
+    finally:
+        db.close()
+
+
+def test_window_zero_is_bit_for_bit_off(tmp_path):
+    """batch.window_ms=0 (the default): concurrent distinct queries never
+    batch and never touch a batch counter — today's path bit-for-bit."""
+    db = _mk_db(tmp_path, "off", window_ms=0.0)
+    try:
+        _load(db, 6)
+        for q in _QUERIES[:3]:
+            db.sql_one(q)
+            db.sql_one(q)
+        d0 = metrics.QUERY_BATCH_DISPATCHES_TOTAL.get()
+        m0 = metrics.QUERY_BATCH_MEMBERS_TOTAL.get()
+        h0 = metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get()
+        results, errors = _concurrent(db, _QUERIES[:3])
+        assert not errors
+        assert all(r is not None for r in results)
+        assert metrics.QUERY_BATCH_DISPATCHES_TOTAL.get() == d0
+        assert metrics.QUERY_BATCH_MEMBERS_TOTAL.get() == m0
+        assert metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get() == h0
+    finally:
+        db.close()
+
+
+# ---- windowed result cache --------------------------------------------------
+
+_CACHE_Q = (
+    "SELECT k, g, sum(v) AS sv, count(*) AS c FROM t"
+    " WHERE ts >= '1970-01-01T00:00:00' AND ts < '1970-01-01T01:00:00'"
+    " GROUP BY k, g"
+)
+
+
+def test_result_cache_rehit_zero_dispatch(tmp_path):
+    """Re-asking the same aligned window re-serves from the cache with
+    ZERO device dispatch (asserted via the device-fetch counter) and the
+    served bytes are identical."""
+    db = _mk_db(tmp_path, "rc", cache_mb=32)
+    try:
+        _load(db, 7)
+        db.sql_one(_CACHE_Q)  # cold
+        first = db.sql_one(_CACHE_Q)  # warm device run, cached
+        h0 = metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get()
+        f0 = metrics.TPU_DEVICE_FETCHES.get()
+        again = db.sql_one(_CACHE_Q)
+        assert metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get() - h0 >= 1
+        assert metrics.TPU_DEVICE_FETCHES.get() == f0, (
+            "a cache re-hit must not touch the device"
+        )
+        assert _ser(again) == _ser(first)
+    finally:
+        db.close()
+
+
+def test_result_cache_invalidated_by_write_and_flush(tmp_path):
+    """Any write moves the WAL tail and any flush bumps the manifest
+    version: both key components change, so stale entries are simply
+    unreachable — the re-run misses, dispatches, and sees the new rows."""
+    db = _mk_db(tmp_path, "rcinv", cache_mb=32)
+    try:
+        _load(db, 8, n=2_000)
+        db.sql_one(_CACHE_Q)
+        before = db.sql_one(_CACHE_Q)  # cached
+        h0 = metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get()
+        db.sql_one(_CACHE_Q)
+        assert metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get() - h0 == 1
+
+        # memtable write: WAL tail advances -> old key unreachable
+        db.insert_rows("t", pa.table({
+            "k": pa.array(["k00000"]),
+            "g": pa.array(["g0"]),
+            "ts": pa.array(np.array([5_000], np.int64), pa.timestamp("ms")),
+            "v": pa.array([100.0]),
+            "w": pa.array([1.0]),
+        }))
+        h1 = metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get()
+        after_write = db.sql_one(_CACHE_Q)
+        assert metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get() == h1, (
+            "a write must invalidate the cached window"
+        )
+        total = lambda t: sum(x for x in t.column("c").to_pylist())  # noqa: E731
+        assert total(after_write) == total(before) + 1
+
+        # flush: manifest version bumps AND invalidate_region purges
+        db.storage.flush_all()
+        h2 = metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get()
+        after_flush = db.sql_one(_CACHE_Q)
+        assert metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get() == h2
+        assert total(after_flush) == total(after_write)
+        # and the new snapshot re-caches: the NEXT ask re-hits
+        db.sql_one(_CACHE_Q)
+        assert metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get() == h2 + 1
+    finally:
+        db.close()
+
+
+def test_result_cache_lru_eviction_unit():
+    """Byte-bounded LRU: entries past batch.result_cache_mb evict oldest
+    first and the eviction counter moves; purge_region drops exactly the
+    region's entries."""
+    from greptimedb_tpu.parallel.batcher import WindowedResultCache
+
+    class _T:
+        def __init__(self, nbytes):
+            self.nbytes = nbytes
+
+    def key(i, region=1):
+        return (f"plan{i}", "lits", ("raw", 0, 10), ((region, 3, 7),))
+
+    rc = WindowedResultCache(8 << 10)  # 8 KiB budget
+    e0 = metrics.QUERY_BATCH_RESULT_CACHE_EVICTIONS_TOTAL.get()
+    rc.put(key(1), _T(2 << 10), frozenset())
+    rc.put(key(2), _T(2 << 10), frozenset())
+    assert rc.get(key(1)) is not None and rc.get(key(2)) is not None
+    rc.put(key(3), _T(5 << 10), frozenset())  # overflows: key(1) is LRU...
+    # key(1) was touched by get() after key(2): key(2) evicts first
+    assert rc.get(key(2)) is None
+    assert metrics.QUERY_BATCH_RESULT_CACHE_EVICTIONS_TOTAL.get() > e0
+    # an entry larger than the whole budget is never admitted
+    rc.put(key(4), _T(64 << 10), frozenset())
+    assert rc.get(key(4)) is None
+    # purge_region drops only the region's entries
+    rc.put(key(5, region=9), _T(1 << 10), frozenset())
+    rc.purge_region(1)
+    assert rc.get(key(3)) is None and rc.get(key(1)) is None
+    assert rc.get(key(5, region=9)) is not None
+
+
+# ---- fault points: harmless and heals ---------------------------------------
+
+def test_batch_pack_fault_degrades_to_solo_and_heals(tmp_path):
+    """An injected `batch.pack` failure solos every member of the batch:
+    all queries still answer, bit-identical — then the next batch packs
+    normally (the layer heals)."""
+    db = _mk_db(tmp_path, "packfault", window_ms=60.0)
+    try:
+        _load(db, 9)
+        solo = {}
+        for q in _QUERIES[:4]:
+            db.sql_one(q)
+            solo[q] = _ser(db.sql_one(q))
+        plan = fi.REGISTRY.arm(
+            "batch.pack", fail_times=1, error=RuntimeError
+        )
+        try:
+            tripped = False
+            for _ in range(6):  # batch formation is timing-dependent
+                results, errors = _concurrent(db, _QUERIES[:4])
+                assert not errors
+                for q, r in zip(_QUERIES[:4], results):
+                    assert _ser(r) == solo[q], (
+                        "a pack failure must degrade to solo, never wrong"
+                    )
+                if plan.trips >= 1:
+                    tripped = True
+                    break
+            assert tripped, "no batch ever reached the pack point"
+        finally:
+            fi.REGISTRY.disarm()
+        # heals: with the fault gone, packing works again
+        d0 = metrics.QUERY_BATCH_DISPATCHES_TOTAL.get()
+        for _ in range(6):
+            results, errors = _concurrent(db, _QUERIES[:4])
+            assert not errors
+            if metrics.QUERY_BATCH_DISPATCHES_TOTAL.get() > d0:
+                break
+        assert metrics.QUERY_BATCH_DISPATCHES_TOTAL.get() > d0
+        for q, r in zip(_QUERIES[:4], results):
+            assert _ser(r) == solo[q]
+    finally:
+        db.close()
+
+
+def test_result_cache_fault_is_a_miss_and_heals(tmp_path):
+    """An injected `batch.result_cache` failure turns the probe into a
+    miss and the store into a skip — the query dispatches normally and
+    answers correctly; once the fault clears, hits resume."""
+    db = _mk_db(tmp_path, "rcfault", cache_mb=32)
+    try:
+        _load(db, 10, n=2_000)
+        db.sql_one(_CACHE_Q)
+        want = _ser(db.sql_one(_CACHE_Q))  # cached
+        h0 = metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get()
+        with fi.REGISTRY.armed(
+            "batch.result_cache", fail_times=2, error=RuntimeError
+        ) as plan:
+            got = db.sql_one(_CACHE_Q)  # get fires -> miss; put fires -> skip
+            assert _ser(got) == want
+            assert plan.trips >= 1
+        assert metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get() == h0
+        # heals: the entry is still there (or re-stored); the next ask hits
+        db.sql_one(_CACHE_Q)
+        assert metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get() > h0
+    finally:
+        db.close()

@@ -301,6 +301,10 @@ def test_bench_smoke_mixed_overload(tmp_path):
         "GRAFT_MIXED_TICKS": "400",
         "GRAFT_MIXED_QUERY_WORKERS": "6",
         "GRAFT_MIXED_INGEST_WORKERS": "1",
+        # keep the batching phases inside this test's 60 s budget (the
+        # dedicated sweep contract lives in test_bench_smoke_qps_sweep)
+        "GRAFT_MIXED_SWEEP_QPS": "10,25",
+        "GRAFT_MIXED_SWEEP_SECONDS": "1.0",
         "GRAFT_BENCH_BUDGET_S": "150",
         "GRAFT_BENCH_PARTIAL": str(tmp_path / "mixed_partial.json"),
     }
@@ -331,6 +335,74 @@ def test_bench_smoke_mixed_overload(tmp_path):
     assert time.perf_counter() - t_suite < 60, (
         "mixed bench-smoke exceeded its 60 s budget"
     )
+
+
+@pytest.mark.bench_smoke
+def test_bench_smoke_qps_sweep(tmp_path):
+    """`bench.py --mode mixed` QPS-sweep smoke: the dashboard-fleet
+    offered-load ladder runs OFF then ON, the record carries both curves
+    (offered -> achieved, p50/p99, shed) plus the knee and speedup, the
+    deterministic burst proves a mega-dispatch happened
+    (batched_members > 0), the ON sweep proves the result cache served
+    (result_cache_hits > 0), zero queries failed, and the emitted line
+    stays inside the driver's tail capture."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "GRAFT_MIXED_SECONDS": "6",
+        "GRAFT_MIXED_HOSTS": "16",
+        "GRAFT_MIXED_TICKS": "400",
+        "GRAFT_MIXED_QUERY_WORKERS": "6",
+        "GRAFT_MIXED_INGEST_WORKERS": "1",
+        "GRAFT_MIXED_SWEEP_QPS": "10,30",
+        "GRAFT_MIXED_SWEEP_SECONDS": "1.5",
+        "GRAFT_MIXED_HOTSPOT_STEPS": "40",
+        "GRAFT_BENCH_BUDGET_S": "150",
+        "GRAFT_BENCH_PARTIAL": str(tmp_path / "sweep_partial.json"),
+    }
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--mode", "mixed"],
+        capture_output=True, text=True, timeout=170, env=env, cwd=str(tmp_path),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    record = line = None
+    for raw in out.stdout.splitlines():
+        try:
+            obj = json.loads(raw)
+        except ValueError:
+            continue
+        if obj.get("metric") == "mixed_load_e2e_p99":
+            record, line = obj, raw
+    assert record is not None, out.stdout[-2000:]
+    d = record["detail"]
+    assert d["zero_failed_queries"] and d["failed"] == 0, d.get("errors")
+    sweep = d["qps_sweep"]
+    assert "error" not in sweep, sweep
+    for mode in ("off", "on"):
+        ms = sweep[mode]
+        # the curve: one [offered, achieved, p50, p99, shed] row per level
+        assert len(ms["curve"]) == 2
+        for offered, achieved, p50, p99, shed in ms["curve"]:
+            assert offered > 0 and achieved > 0
+            assert p50 is not None and p99 is not None and p50 <= p99
+            assert shed >= 0
+        assert ms["knee_qps"] > 0 and ms["knee_offered_qps"] > 0
+        assert ms["sustained_qps"] >= ms["knee_qps"]
+        assert ms["p99_at_knee_ms"] is not None
+        assert ms["failed"] == 0
+    assert sweep["speedup"] > 0
+    # the deterministic burst packed >= 2 DISTINCT queries into one
+    # mega-dispatch, and the ON sweep re-served from the result cache
+    assert d["batched_members"] >= 2 and d["batch_dispatches"] >= 1
+    assert d["result_cache_hits"] > 0
+    # the emitted line survives the driver's ~2000-byte tail capture
+    assert len(json.dumps(record, separators=(",", ":"))) < 1900, line
 
 
 def test_compact_record_stays_under_tail_capture():
@@ -475,6 +547,95 @@ def test_compact_record_realistic_keeps_stage_digests():
     ing = record["detail"]["ingest"]
     assert (ing == "812400;52/52") or ing.get("rps") == 812_400
     assert len(line) < 1900, f"realistic record is {len(line)} bytes"
+
+
+def test_compact_record_mixed_sweep_worstcase_clamps():
+    """Worst-case MIXED record (the shape mixed_main emits): full-ladder
+    sweep curves with 6-digit figures, five long error strings, the
+    hotspot phase latencies and every counter populated — the clamp must
+    land it under the driver's ~2000-byte tail capture while the verdict
+    scalars (knee/sustained QPS, speedup, batched_members,
+    result_cache_hits, zero_failed_queries) survive."""
+    import importlib
+    import json
+
+    bench = importlib.import_module("bench")
+    curve = [
+        [float(q), round(q * 0.993, 1), 104857.36, 123456.78, 99999]
+        for q in (25, 50, 100, 200, 400, 800, 1600)
+    ]
+    detail = {
+        "mode": "mixed",
+        "device": "TFRT_CPU_0 (remote tunnel; machine-features quieted)",
+        "hosts": 64, "seed_ticks": 1500, "seconds": 30.0,
+        "query_workers": 8, "ingest_workers": 2, "tile_budget_mb": 1,
+        "seed_rows": 96_000,
+        "qps_sweep": {
+            "batch_window_ms": 2.0, "fleet": 6, "workers": 8,
+            "off": {"curve": curve, "knee_offered_qps": 1600.0,
+                    "knee_qps": 104857.3, "p99_at_knee_ms": 123456.78,
+                    "sustained_qps": 104857.3, "failed": 0},
+            "on": {"curve": curve, "knee_offered_qps": 1600.0,
+                   "knee_qps": 104857.3, "p99_at_knee_ms": 123456.78,
+                   "sustained_qps": 104857.3, "failed": 0},
+            "speedup": 104857.3,
+        },
+        "batch_dispatches": 1_048_576.0, "batched_members": 1_048_576.0,
+        "batch_burst": {"dispatches": 1_048_576.0, "members": 1_048_576.0,
+                        "rounds": 5, "failed": 0},
+        "result_cache_hits": 104_857_600.0,
+        "hotspot": {
+            "steps": 160, "acked_rows": 1_048_576, "retried_writes": 99,
+            "write_retries_exhausted": 0, "splits_enacted": 3,
+            "first_split_step": 42, "regions": 8, "auto_split": True,
+            "failed_queries": 0, "zero_failed_queries": True,
+            "phases": {
+                "pre_split": {"n": 42, "p50_ms": 104857.36,
+                              "p99_ms": 123456.78},
+                "post_split": {"n": 118, "p50_ms": 104857.36,
+                               "p99_ms": 123456.78},
+            },
+        },
+        "queries": 1_048_576, "failed": 0, "shed": 99_999,
+        "ingest_batches": 99_999, "ingest_failed": 0,
+        "families": {
+            name: {"n": 99_999, "p50_ms": 104857.4, "p99_ms": 123456.8}
+            for name in ("double-groupby", "cpu-max-host", "high-cpu-all")
+        },
+        "errors": [
+            f"family-{i}: QueryTimeoutError('query exceeded its deadline "
+            f"of 600.0 s after spending it all inside one wedged dispatch')"
+            for i in range(5)
+        ],
+        "coalesced_dispatches": 104_857_600.0,
+        "coalition_leaders": 104_857_600.0,
+        "admission": {"admitted": 104_857_600.0, "shed": 99_999},
+        "hbm": {"probe_free_bytes": 103_680_000_000, "exhausted": 99_999.0,
+                "chunk_rows": 16_777_216},
+        "zero_failed_queries": True, "p50_ms": 104857.4,
+    }
+    record = bench._clamp_record({
+        "metric": "mixed_load_e2e_p99", "value": 123456.78, "unit": "ms",
+        "vs_baseline": None, "detail": detail,
+    })
+    line = json.dumps(record, separators=(",", ":"))
+    assert len(line) < 1900, (
+        f"worst-case mixed record is {len(line)} bytes — it will not "
+        f"survive the driver's ~2000-byte tail capture: {line[:300]}..."
+    )
+    d = record["detail"]
+    # the verdict scalars survive every clamp step
+    for mode in ("off", "on"):
+        assert d["qps_sweep"][mode]["knee_qps"] == 104857.3
+        assert d["qps_sweep"][mode]["sustained_qps"] == 104857.3
+    assert d["qps_sweep"]["speedup"] == 104857.3
+    assert d["batched_members"] == 1_048_576.0
+    assert d["result_cache_hits"] == 104_857_600.0
+    assert d["zero_failed_queries"] is True
+    # conveniences were spent, not the verdict: curves + hotspot phases
+    assert "curve" not in d["qps_sweep"]["on"]
+    assert "phases" not in d["hotspot"]
+    assert len(d["errors"]) <= 2 and all(len(e) <= 40 for e in d["errors"])
 
 
 def test_recorder_overhead_within_noise(tmp_path):

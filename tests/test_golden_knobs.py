@@ -9,6 +9,7 @@ every combination of:
     backend          cpu | tpu (tile path)
     index.segmented  on  | off  (segmented vs legacy whole-blob sidecars)
     query.agg_strategy  auto | hash | sort
+    batch.window_ms  0 | on  (+ result cache: cross-query batching layer)
 
 — i.e. turning the new machinery on, off, or forcing it never changes a
 result, only how it is computed.
@@ -24,7 +25,7 @@ from tests.sqlness_runner import CASES_DIR, run_case
 CASES = ("term_index.sql", "agg_strategy_groupby.sql")
 
 
-def _db(backend: str, segmented: bool, strategy: str):
+def _db(backend: str, segmented: bool, strategy: str, batch_ms: float = 0.0):
     from greptimedb_tpu.database import Database
     from greptimedb_tpu.utils.config import Config
 
@@ -33,31 +34,37 @@ def _db(backend: str, segmented: bool, strategy: str):
     cfg.query.backend = backend
     cfg.query.agg_strategy = strategy
     cfg.index.segmented = segmented
+    cfg.batch.window_ms = batch_ms
+    if batch_ms:
+        cfg.batch.result_cache_mb = 8
     cfg.__post_init__()  # re-run the index.* -> storage copy-down
     return Database(config=cfg)
 
 
 @pytest.mark.parametrize(
-    "backend,segmented,strategy",
+    "backend,segmented,strategy,batch_ms",
     [
-        ("cpu", True, "auto"),   # authoritative path, new index format
-        ("cpu", False, "auto"),  # authoritative path, legacy index format
-        ("tpu", True, "hash"),   # tile path, forced hash, new format
-        ("tpu", True, "sort"),   # tile path, forced dense, new format
-        ("tpu", False, "auto"),  # tile path, legacy format, planner's pick
+        ("cpu", True, "auto", 0.0),   # authoritative path, new index format
+        ("cpu", False, "auto", 0.0),  # authoritative path, legacy format
+        ("tpu", True, "hash", 0.0),   # tile path, forced hash, new format
+        ("tpu", True, "sort", 0.0),   # tile path, forced dense, new format
+        ("tpu", False, "auto", 0.0),  # tile path, legacy, planner's pick
+        ("cpu", True, "auto", 2.0),   # batching+cache on: no-op off-device
+        ("tpu", True, "auto", 2.0),   # batching+cache on over the tile path
     ],
 )
-def test_golden_knob_matrix(backend, segmented, strategy):
+def test_golden_knob_matrix(backend, segmented, strategy, batch_ms):
     for name in CASES:
         case = os.path.join(CASES_DIR, name)
         with open(case[:-4] + ".result") as f:
             want = f.read()
-        db = _db(backend, segmented, strategy)
+        db = _db(backend, segmented, strategy, batch_ms)
         try:
             got = run_case(case, db)
         finally:
             db.close()
         assert got == want, (
             f"{name} under backend={backend} segmented={segmented} "
-            f"agg_strategy={strategy} diverged from the golden"
+            f"agg_strategy={strategy} batch.window_ms={batch_ms} "
+            "diverged from the golden"
         )
