@@ -33,6 +33,10 @@ Latency context printed in `detail`: this harness drives a REMOTE TPU over
 a tunnel whose round-trip is ~100 ms — measured honestly as
 `tunnel_rtt_ms` (a fresh-buffer device fetch).  Any query that touches the
 device pays >= 1 RTT end-to-end; co-located deployments pay microseconds.
+`--mode mixed --rtt-ms N` (env GRAFT_BENCH_RTT_MS) makes that tunnel
+reproducible offline: every dispatch/fetch boundary sleeps a symmetric
+half-RTT, so the QPS-knee sweep measures the regime where batching + mega-
+program fusion (ONE XLA invocation per batch tick) pays for itself.
 
 Prints ONE final JSON line; headline = double-groupby-1 warm end-to-end p50.
 """
@@ -2274,15 +2278,21 @@ def _qps_sweep_phase(db, lo12: int, end_ms: int) -> dict:
     repeated aligned windows are cacheable, exactly the between-ticks
     regime the result cache exists for).  OFF runs first so plane builds
     and XLA compiles are paid OUTSIDE the ON timings."""
+    from greptimedb_tpu.utils import metrics as _m
+    from greptimedb_tpu.utils import rtt_sim as _rtt
+
     fleet = _mixed_fleet(lo12, end_ms)
     bcfg = db.config.batch
     db.config.query.timeout_s = 30.0
     sweep: dict = {"batch_window_ms": MIXED_BATCH_WINDOW_MS,
-                   "fleet": len(fleet), "workers": MIXED_SWEEP_WORKERS}
+                   "fleet": len(fleet), "workers": MIXED_SWEEP_WORKERS,
+                   "rtt_ms": round(_rtt.rtt_ms(), 1)}
     for mode in ("off", "on"):
         if mode == "on":
             bcfg.window_ms = MIXED_BATCH_WINDOW_MS
             bcfg.result_cache_mb = MIXED_RESULT_CACHE_MB
+            bcfg.fuse_programs = True
+            fused0 = _m.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get()
         else:
             bcfg.window_ms = 0.0
             bcfg.result_cache_mb = 0
@@ -2305,6 +2315,12 @@ def _qps_sweep_phase(db, lo12: int, end_ms: int) -> dict:
             "sustained_qps": max(lv["achieved_qps"] for lv in levels),
             "failed": sum(lv["failed"] for lv in levels),
         }
+        if mode == "on":
+            # mega-fusion evidence for the ON sweep: ticks that executed
+            # as ONE XLA invocation (scalar — survives every clamp trim)
+            sweep["on"]["fused_dispatches"] = int(
+                _m.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get() - fused0
+            )
         _emit({"event": "mixed_qps_sweep", "mode": mode,
                "knee_qps": sweep[mode]["knee_qps"],
                "sustained_qps": sweep[mode]["sustained_qps"],
@@ -2332,6 +2348,7 @@ def _batch_burst_phase(db, fleet_n: int = 4) -> dict:
     fleet = _mixed_fleet(lo, hi)[:fleet_n]
     d0 = m.QUERY_BATCH_DISPATCHES_TOTAL.get()
     m0 = m.QUERY_BATCH_MEMBERS_TOTAL.get()
+    f0 = m.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get()
     failed = 0
     rounds = 0
     try:
@@ -2364,6 +2381,9 @@ def _batch_burst_phase(db, fleet_n: int = 4) -> dict:
     return {
         "dispatches": m.QUERY_BATCH_DISPATCHES_TOTAL.get() - d0,
         "members": m.QUERY_BATCH_MEMBERS_TOTAL.get() - m0,
+        "fused_dispatches": int(
+            m.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get() - f0
+        ),
         "rounds": rounds,
         "failed": failed,
     }
@@ -2518,8 +2538,16 @@ def mixed_main():
 
     from greptimedb_tpu.database import Database
     from greptimedb_tpu.utils import metrics as m
+    from greptimedb_tpu.utils import rtt_sim
     from greptimedb_tpu.utils.config import Config
     from greptimedb_tpu.utils.errors import RetryLaterError
+
+    # synthetic tunnel RTT (--rtt-ms / GRAFT_BENCH_RTT_MS): every device
+    # dispatch/fetch boundary pays a symmetric half-RTT sleep, making the
+    # remote-tunnel QPS knee — and the one-invocation-per-tick fusion
+    # win — reproducible offline.  0 (the default) is a strict no-op.
+    rtt_ms = float(os.environ.get("GRAFT_BENCH_RTT_MS", "0") or 0)
+    rtt_sim.configure(rtt_ms)
 
     detail: dict = _STATE["detail"]
     detail.update({
@@ -2529,6 +2557,7 @@ def mixed_main():
         "query_workers": MIXED_QUERY_WORKERS,
         "ingest_workers": MIXED_INGEST_WORKERS,
         "tile_budget_mb": MIXED_OVERCOMMIT_MB,
+        "rtt_ms": round(rtt_ms, 1),
     })
     cfg = Config()
     # the admission/overload stack under test, all knobs ON
@@ -2715,9 +2744,14 @@ def mixed_main():
     detail["batched_members"] = burst.get("members", 0)
     detail["batch_burst"] = burst
     detail["result_cache_hits"] = m.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get()
+    detail["fused_dispatches"] = int(
+        m.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get()
+    )
+    detail["fuse_degraded"] = int(m.QUERY_BATCH_FUSE_DEGRADED_TOTAL.get())
     _emit({"event": "mixed_batch_phase",
            "batched_members": detail["batched_members"],
            "result_cache_hits": detail["result_cache_hits"],
+           "fused_dispatches": detail["fused_dispatches"],
            "sweep_speedup": qps_sweep.get("speedup"),
            "elapsed_s": round(_elapsed(), 1)})
     db.config.query.timeout_s = 0.0
@@ -2880,6 +2914,16 @@ if __name__ == "__main__":
             devices_n = int(argv[idx])
             if devices_n < 1:
                 raise ValueError(f"--devices must be >= 1, got {devices_n}")
+        if "--rtt-ms" in argv:
+            # synthetic tunnel RTT for mixed mode; rides the env so the
+            # supervisor's child (and any forked phase) inherits it
+            idx = argv.index("--rtt-ms") + 1
+            if idx >= len(argv):
+                raise ValueError("--rtt-ms requires a millisecond value")
+            rtt_arg = float(argv[idx])
+            if rtt_arg < 0:
+                raise ValueError(f"--rtt-ms must be >= 0, got {rtt_arg}")
+            os.environ["GRAFT_BENCH_RTT_MS"] = str(rtt_arg)
         if (
             not worker
             and devices_n is None

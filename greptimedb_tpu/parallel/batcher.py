@@ -35,9 +35,32 @@ one dispatch; this module extends the same contract to DISTINCT plans:
     entry, never publish a newer result under an older snapshot key.
     LRU-bounded by `batch.result_cache_mb` (0 = off).
 
+  * **Mega-program fusion** (`batch.fuse_programs`, default ON) — the
+    leader goes one step further than the shared readback: each member's
+    dispatch is CAPTURED at the executor's dispatch site (lowered plan,
+    device-resident sources, dynamic traced inputs, decode continuation)
+    instead of executed, and the whole tick compiles into ONE fused XLA
+    program that replays every member's fold op-for-op as independent
+    branches over the shared resident planes — one XLA invocation per
+    batch tick, not per member, so the chip rather than the dispatch
+    loop sets the ceiling.  The fused program is keyed on the multiset
+    of the members' literal-insensitive program keys (plan structure +
+    shape buckets; literals, grids and time bounds ride as dynamic
+    traced inputs, PR 13-style), so a dashboard fleet sliding its
+    windows re-hits the fused compile cache with zero recompiles.  Any
+    capture, trace, compile, or dispatch failure — including a
+    multi-member HBM exhaustion, which must retry at per-member
+    granularity to shrink — degrades to the per-member packed path
+    above (`greptime_batch_fuse_degraded_total`); a member the capture
+    cannot reach (host/cold/streamed serves) is answered by the
+    per-member path in the same tick (partial fusion).
+
 Fault points: `batch.pack` fires immediately before the mega-readback;
-`batch.result_cache` fires on every cache get/put.  Both degrade, never
-corrupt: a pack failure solos every member, a cache failure is a miss.
+`batch.result_cache` fires on every cache get/put; `batch.fuse` fires
+before each member's capture (op="capture") and before the fused
+dispatch (op="fuse").  All degrade, never corrupt: a pack failure solos
+every member, a cache failure is a miss, a fuse failure re-runs the
+tick through the per-member path.
 """
 
 from __future__ import annotations
@@ -49,7 +72,7 @@ from collections import OrderedDict
 
 import jax
 
-from ..utils import flight_recorder, metrics, tracing
+from ..utils import flight_recorder, metrics, rtt_sim, tracing
 from ..utils.deadline import check_deadline, current_deadline
 from ..utils.fault_injection import fire as _fault_fire
 
@@ -87,6 +110,55 @@ def defer_suppressed():
         yield
     finally:
         _DEFER.active = prev
+
+
+# ---- mega-fusion dispatch capture -------------------------------------------
+# Thread-local flag the batch leader raises around each member's execute:
+# the executor's dispatch site sees it and returns a CapturedDispatch
+# (everything the fused program needs, nothing executed) instead of
+# dispatching.  Serve paths that answer BEFORE the dispatch site (host
+# fast path, cold consolidation, streamed spill) return their final
+# result straight through the capture — those members simply aren't
+# fusable this tick and the per-member path owns them.
+
+_CAPTURE = threading.local()
+
+
+def capture_active() -> bool:
+    return getattr(_CAPTURE, "active", False)
+
+
+@contextlib.contextmanager
+def capture_dispatch():
+    prev = getattr(_CAPTURE, "active", False)
+    _CAPTURE.active = True
+    try:
+        yield
+    finally:
+        _CAPTURE.active = prev
+
+
+class CapturedDispatch:
+    """One member's dispatch-ready state, captured instead of executed.
+
+    `key` is the member's `_tile_program` cache key (plan, nullable
+    count-cols, finalize spec) — literal-insensitive by the dynamic-spec
+    contract, so the multiset of member keys IS the fused program's
+    compile key.  `sources`/`dyn` are the device-resident source planes
+    and the dynamic traced inputs for this specific tick.  `finish` is
+    the decode continuation (host-fetched leaves in, decoded pa.Table or
+    a rerun-verdict None out — same contract as `PendingFetch.finish`).
+    Only the FIRST attempts-ladder rung is captured: a rerun verdict in
+    the fused leaves degrades the member to a solo run that walks the
+    full ladder."""
+
+    __slots__ = ("key", "sources", "dyn", "finish")
+
+    def __init__(self, key, sources, dyn, finish):
+        self.key = key
+        self.sources = sources
+        self.dyn = dyn
+        self.finish = finish
 
 
 class PendingFetch:
@@ -373,8 +445,99 @@ class QueryBatcher:
         except BaseException:  # noqa: BLE001 — owner thread owns the error
             m.solo = True
 
+    def _fusion_enabled(self, bc) -> bool:
+        if bc is None or not bool(getattr(bc, "fuse_programs", True)):
+            return False
+        # the fused trace replays the single-chip fold inline; the mesh
+        # path shards planes across datanode devices with host-side
+        # device_put hops that cannot ride one trace — it keeps
+        # per-member dispatch.  Non-mesh multi-device hosts fuse: the
+        # dispatcher colocates the member planes onto one chip first.
+        try:
+            return self._ex.cache.mesh_devices() == 0
+        except Exception:  # noqa: BLE001 — unknowable topology: don't fuse
+            return False
+
+    def _run_fused(self, primaries: list[_Member], adm) -> list[_Member]:
+        """Capture every member's dispatch, fuse the captured set into
+        ONE XLA invocation, decode each member from the fused leaves.
+        Returns the members the per-member packed path still owns:
+        capture-ineligible members (their capture ran to a final answer
+        or an injected `batch.fuse` capture fault marked them unfusable),
+        plus EVERY captured member when the fused dispatch itself fails —
+        degrade, never wrong."""
+        ex = self._ex
+        captured: list[tuple[_Member, CapturedDispatch]] = []
+        leftover: list[_Member] = []
+        for m in primaries:
+            try:
+                _fault_fire("batch.fuse", op="capture", table=m.ctx.table_key)
+            except BaseException:  # noqa: BLE001 — member unfusable this tick
+                leftover.append(m)
+                continue
+            try:
+                with capture_dispatch():
+                    out = ex._overload_safe_execute(
+                        m.lowering, m.schema, m.time_bounds, m.ctx, adm
+                    )
+            except BaseException:  # noqa: BLE001 — degrade, never propagate
+                m.solo = True
+                continue
+            if isinstance(out, CapturedDispatch):
+                captured.append((m, out))
+            else:
+                # host fast path / cold serve / streamed / inapplicable:
+                # the capture ran through to a final answer — the member
+                # is already served, nothing to fuse for it
+                m.result = out
+                m.post_done = m.lowering.post_done
+                m.served = True
+        if len(captured) < 2:
+            # nothing worth fusing: hand the captures back to the
+            # per-member path (planes stay warm; relowering is cheap)
+            leftover.extend(m for m, _ in captured)
+            return leftover
+        try:
+            _fault_fire("batch.fuse", op="fuse", members=len(captured))
+            tables, info = ex._fused_dispatch([cd for _, cd in captured])
+        except BaseException:  # noqa: BLE001 — whole-tick degrade
+            metrics.QUERY_BATCH_FUSE_DEGRADED_TOTAL.inc()
+            leftover.extend(m for m, _ in captured)
+            return leftover
+        served = 0
+        for (m, _cd), table in zip(captured, tables):
+            if table is None:
+                # rerun verdict (hash overflow / limb bound) or decode
+                # failure: the solo rerun walks the full attempts ladder
+                m.solo = True
+                continue
+            m.result = table
+            m.post_done = m.lowering.post_done
+            m.served = True
+            served += 1
+        metrics.QUERY_BATCH_DISPATCHES_TOTAL.inc()
+        metrics.QUERY_BATCH_MEMBERS_TOTAL.inc(served)
+        metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.inc()
+        metrics.QUERY_BATCH_FUSE_MEMBERS.observe(float(len(captured)))
+        flight_recorder.emit_fused_batch(
+            table=captured[0][0].ctx.table_key,
+            plan_fps=[
+                ex._recorder_fp(m.lowering, m.ctx) for m, _ in captured
+            ],
+            members=len(captured),
+            warmup=bool(info.get("traced")),
+            stages_ms=info.get("stages_ms") or {},
+            bytes_down=int(info.get("bytes_down") or 0),
+        )
+        return leftover
+
     def _run_packed(self, primaries: list[_Member], adm):
         ex = self._ex
+        bc = getattr(ex.cache, "batch_config", None)
+        if len(primaries) >= 2 and self._fusion_enabled(bc):
+            primaries = self._run_fused(primaries, adm)
+            if not primaries:
+                return
         pendings: list[tuple[_Member, PendingFetch]] = []
         for m in primaries:
             # the member's own dispatch record (opened inside
@@ -408,7 +571,8 @@ class QueryBatcher:
                 leaves.extend(p.leaves)
             t0 = time.perf_counter()
             with tracing.span("tile.batch_readback", members=len(pendings)):
-                fetched = jax.device_get(leaves)
+                with rtt_sim.round_trip():
+                    fetched = jax.device_get(leaves)
             transfer_ms = (time.perf_counter() - t0) * 1000.0
         except BaseException:  # noqa: BLE001 — pack failure solos everyone
             for m, _ in pendings:

@@ -86,15 +86,17 @@ from ..storage.dictionary import TableDictionary
 from ..storage.region import OP_COL, Region
 from ..storage.sst import FileMeta
 from ..query import analyze, passes
-from ..utils import flight_recorder, metrics, tracing
+from ..utils import flight_recorder, metrics, rtt_sim, tracing
 from ..utils.deadline import check_deadline, current_deadline
 from ..utils.errors import QueryTimeoutError
 from ..utils.fault_injection import fire as _fault_fire
 from ..utils.jax_compat import shard_map as _shard_map
 from .batcher import (
+    CapturedDispatch,
     PendingFetch,
     QueryBatcher,
     WindowedResultCache,
+    capture_active as _capture_active,
     defer_active as _defer_fetch_active,
     defer_suppressed as _defer_fetch_suppressed,
 )
@@ -3173,6 +3175,80 @@ def _tile_program(plan: DistGroupByPlan, nullable_cols: tuple[str, ...], spec=No
     )
 
 
+# ---- mega-program fusion (batch.fuse_programs) ------------------------------
+#
+# ONE fused XLA program over a whole batch tick: each member of the tick
+# contributes its `_tile_program` pieces as an independent branch of a
+# single outer jit, so N distinct warm queries over the same resident
+# planes cost ONE XLA invocation instead of N.  The members' folds are
+# replayed op-for-op (partial states per source, pairwise merge in
+# source order, device finalize) via each member's own partial_jit /
+# final_jit — jit-of-jit INLINES them into the one executable, so every
+# member's result leaves are bit-identical to its solo dispatch.
+#
+# Compile-once contract: the lru key is the multiset (sorted tuple) of
+# the members' `_tile_program` cache keys — literal-insensitive plan
+# structure + shape buckets.  Literals, bucket geometry, HAVING bounds,
+# and the source planes themselves ride as dynamic traced inputs, so a
+# dashboard fleet sliding its windows re-hits BOTH this cache and jit's
+# trace cache with zero recompiles.  `_MEGA_STATS["traces"]` moves once
+# per outer (re)trace — the slid-window zero-recompile tests read its
+# delta directly.
+
+_MEGA_STATS = {"traces": 0, "programs": 0}
+
+
+@functools.lru_cache(maxsize=64)
+def _mega_program(member_keys: tuple):
+    """Fused program over `member_keys`, each a `_tile_program` cache key
+    (plan, nullable count-cols, finalize spec).  The returned jit takes
+    one argument: a tuple of per-member (sources, pdyn, hv) pytrees, and
+    returns the tuple of per-member packed result leaves — exactly what
+    each member's solo `run_all` would have returned, emitted from one
+    dispatch.  Single-device only (the caller gates): the solo path's
+    per-source device hops don't exist inside one trace."""
+    pieces = [_tile_program(*k) for k in member_keys]
+    plans = [k[0] for k in member_keys]
+
+    def _fused(member_inputs):
+        _MEGA_STATS["traces"] += 1
+        from ..ops.aggregate import HASH_EMPTY
+
+        outs = []
+        for (run_all, *_), plan, (sources, pdyn, hv) in zip(
+            pieces, plans, member_inputs
+        ):
+            partial_jit = run_all._partial_jit
+            final_jit = run_all._final_jit
+            is_hash = plan.agg_strategy == "hash"
+            table_keys = (
+                jnp.full((plan.hash_slots,), HASH_EMPTY, jnp.int64)
+                if is_hash
+                else None
+            )
+            merged = None
+            for cols, valid, nulls, perm, limbs in sources:
+                if is_hash:
+                    states, table_keys = partial_jit(
+                        cols, valid, nulls, pdyn, perm, limbs=limbs,
+                        hash_table=table_keys,
+                    )
+                else:
+                    states = partial_jit(
+                        cols, valid, nulls, pdyn, perm, limbs=limbs
+                    )
+                merged = (
+                    states
+                    if merged is None
+                    else {k: merge_states(merged[k], states[k]) for k in merged}
+                )
+            outs.append(final_jit(merged, hv, table_keys))
+        return tuple(outs)
+
+    _MEGA_STATS["programs"] += 1
+    return jax.jit(_fused)
+
+
 # ---- multi-chip mesh execution (tile.mesh_devices) --------------------------
 #
 # The promotion of the MULTICHIP dryrun to the real tile path: the same
@@ -3686,6 +3762,15 @@ class TileExecutor:
                     hit = rc.get(ck)
                 except Exception:  # noqa: BLE001 — a failing probe is a miss
                     hit = None
+            if hit is not None and not self._versions_current(ctx, ck[3]):
+                # adoption-time re-validation (the purge_region race): a
+                # write can land between this key's version snapshot and
+                # the probe winning the cache lock; the racing purge may
+                # not have dropped the entry yet.  A key whose versions
+                # no longer match the LIVE region state must not serve —
+                # the same snapshot-pinning rule `_family_key` applies to
+                # dispatch coalescing, enforced at the cache boundary.
+                hit = None
             if hit is not None:
                 table, post_done = hit
                 lowering.post_done = post_done
@@ -3735,10 +3820,40 @@ class TileExecutor:
                     _fault_fire(
                         "batch.result_cache", op="put", table=ctx.table_key
                     )
-                    rc.put(ck, out, lowering.post_done)
+                    # store-time re-validation: the batch window means the
+                    # key's version snapshot and the actual dispatch can be
+                    # tens of ms apart (the leader SLEEPS out window_ms
+                    # before executing).  A write landing in that gap makes
+                    # the dispatch read NEWER data than the key claims —
+                    # publishing it under the older snapshot key would let
+                    # a racing adopter serve a stale/mismatched window that
+                    # purge_region has no entry to drop yet.  Skip the
+                    # store instead; the next aligned ask re-caches under
+                    # the current versions.
+                    if self._versions_current(ctx, ck[3]):
+                        rc.put(ck, out, lowering.post_done)
                 except Exception:  # noqa: BLE001 — a failing store keeps
                     pass  # the computed result; the cache is best-effort
         return out
+
+    @staticmethod
+    def _versions_current(ctx, versions) -> bool:
+        """True when every region's (manifest version, WAL tail id) still
+        matches the snapshot a result-cache key was computed from.  Used
+        on BOTH cache boundaries: a store whose key predates a mid-query
+        write must not publish, and a probe must not adopt an entry whose
+        key no longer names the live snapshot."""
+        try:
+            return versions == tuple(
+                (
+                    r.region_id,
+                    r.manifest_mgr.manifest.manifest_version,
+                    r.wal.last_entry_id,
+                )
+                for r in ctx.regions
+            )
+        except Exception:  # noqa: BLE001 — unverifiable means not current
+            return False
 
     def _result_cache(self, bc):
         """The process-wide WindowedResultCache, created lazily the first
@@ -4896,10 +5011,13 @@ class TileExecutor:
             "chunk_placement", placed, why,
             chunks=len(device_sources), devices=ndev,
         )
-        if not _in_fused_build():
+        if not _in_fused_build() and not _capture_active():
             # ghost (background-build) dispatches stay out of the per-
             # query counters: a metric delta a test or dashboard reads
-            # around one query must not absorb the builder's priming run
+            # around one query must not absorb the builder's priming run.
+            # A fusion CAPTURE also defers these: whichever path finally
+            # answers the member (the fused dispatch or the per-member
+            # degrade re-running this code) emits them exactly once.
             metrics.TILE_LOWERED_TOTAL.inc()
             metrics.AGG_STRATEGY_TOTAL.inc(strategy=plan.agg_strategy)
         if plan.agg_strategy == "hash":
@@ -4934,6 +5052,29 @@ class TileExecutor:
                 attempts.append(dense)
         else:
             attempts = [plan, dataclasses.replace(plan, acc_dtype="float64")]
+        if _capture_active() and not _in_fused_build():
+            # mega-fusion capture (batch.fuse_programs): the batch leader
+            # wants this member's dispatch-ready state, not a dispatch.
+            # Only the first attempts rung is captured — a rerun verdict
+            # (hash-slot overflow / limb bound) decoded from the fused
+            # leaves degrades the member to a solo run that walks the
+            # full ladder, exactly like the packed path's verdicts.
+            # Going through _tile_program_cached keeps compile-cache
+            # hit/miss accounting identical to a solo dispatch.
+            first = attempts[0]
+            _program, int_layout, acc32_layout, acc64_layout, int_dtype = (
+                _tile_program_cached(first, nullable_cols, fspec)
+            )
+            return CapturedDispatch(
+                key=(first, nullable_cols, fspec),
+                sources=tuple(device_sources),
+                dyn=dyn,
+                finish=functools.partial(
+                    self._finish_fetched, int_layout, acc32_layout,
+                    acc64_layout, int_dtype, first, lowering, schema, ctx,
+                    dyn_host, fspec,
+                ),
+            )
         for attempt_plan in attempts:
             program, int_layout, acc32_layout, acc64_layout, int_dtype = (
                 _tile_program_cached(attempt_plan, nullable_cols, fspec)
@@ -4958,7 +5099,8 @@ class TileExecutor:
                         mesh_devices=0,
                     ):
                         t_disp = time.perf_counter()
-                        packed = program(tuple(device_sources), dyn)
+                        with rtt_sim.round_trip(enabled=not _in_fused_build()):
+                            packed = program(tuple(device_sources), dyn)
                         flight_recorder.stage_add(
                             "dispatch",
                             (time.perf_counter() - t_disp) * 1000.0,
@@ -4998,7 +5140,8 @@ class TileExecutor:
                     retry=True,
                 ):
                     t_disp = time.perf_counter()
-                    packed = program(tuple(device_sources), dyn)
+                    with rtt_sim.round_trip(enabled=not _in_fused_build()):
+                        packed = program(tuple(device_sources), dyn)
                     flight_recorder.stage_add(
                         "dispatch", (time.perf_counter() - t_disp) * 1000.0
                     )
@@ -6837,7 +6980,8 @@ class TileExecutor:
             and total >= 2 * chunk
         )
         if streamed:
-            out = streamed_device_get(list(packed), chunk)
+            with rtt_sim.round_trip(enabled=not _in_fused_build()):
+                out = streamed_device_get(list(packed), chunk)
             metrics.TPU_READBACK_STREAMED.inc()
             passes.note(
                 "streamed_readback", True,
@@ -6846,7 +6990,8 @@ class TileExecutor:
                 bytes=total,
             )
             return tuple(np.asarray(p) for p in out)
-        got = jax.device_get(packed)
+        with rtt_sim.round_trip(enabled=not _in_fused_build()):
+            got = jax.device_get(packed)
         return tuple(np.asarray(p) for p in got)
 
     def _finalize(
@@ -6917,6 +7062,94 @@ class TileExecutor:
                 self._rb_local.decode_ms = dec_ms
                 rb_span.attributes["decode_ms"] = round(dec_ms, 3)
                 flight_recorder.stage_add("readback_decode", dec_ms)
+
+    def _fused_dispatch(self, cds):
+        """Dispatch N captured members as ONE fused XLA invocation and
+        decode each member from the shared readback.  Returns (tables,
+        info): tables[i] is member i's decoded result — None means a
+        rerun verdict or decode failure, and that member degrades to a
+        solo run.  Raises on any trace/compile/dispatch failure: the
+        batcher then degrades the WHOLE tick to the per-member packed
+        path, which owns the HBM halve-and-retry ladder — a multi-member
+        RESOURCE_EXHAUSTED retried at mega granularity would just
+        exhaust again, while per-member dispatches retry at a size the
+        emergency release can actually satisfy."""
+        # canonicalize the multiset: member order inside the program is
+        # sort-by-key, so {A,B} and {B,A} ticks share one compile
+        order = sorted(range(len(cds)), key=lambda i: repr(cds[i].key))
+        keys = tuple(cds[i].key for i in order)
+        with _program_cache_lock, tracing.span("tile.compile") as s:
+            t0 = time.perf_counter()
+            before = _mega_program.cache_info().misses
+            fused = _mega_program(keys)
+            if _mega_program.cache_info().misses > before:
+                metrics.TPU_COMPILE_CACHE_MISSES.inc()
+                s.attributes["cache"] = "miss"
+            else:
+                metrics.TPU_COMPILE_CACHE_HITS.inc()
+                s.attributes["cache"] = "hit"
+            compile_ms = (time.perf_counter() - t0) * 1000.0
+        inputs = []
+        for i in order:
+            cd = cds[i]
+            # same host-side dynamic-input assembly as run_all, so the
+            # traced values match the solo dispatch dtype-for-dtype
+            hv = jnp.asarray(
+                cd.dyn.get("having_values") or (0.0,), jnp.float64
+            )
+            pdyn = {
+                k: cd.dyn[k]
+                for k in ("filter_values", "bucket_origin", "bucket_interval")
+            }
+            inputs.append((cd.sources, pdyn, hv))
+        if len(self.cache.devices) > 1:
+            # non-mesh chunk placement round-robins planes over local
+            # devices, but one jit needs colocated inputs: hop every
+            # member's planes to device 0 (a no-op for leaves already
+            # there).  pdyn/hv stay host-side so their weak-typing
+            # matches the solo run_all trace exactly.
+            dev0 = self.cache.devices[0]
+            inputs = [
+                (jax.device_put(sources, dev0), pdyn, hv)
+                for sources, pdyn, hv in inputs
+            ]
+        traces0 = _MEGA_STATS["traces"]
+        metrics.TPU_DEVICE_DISPATCHES.inc()
+        with tracing.span("tile.fused_dispatch", members=len(cds)):
+            t_disp = time.perf_counter()
+            with rtt_sim.round_trip():
+                packed_all = fused(tuple(inputs))
+            dispatch_ms = (time.perf_counter() - t_disp) * 1000.0
+        leaves = [a for packed in packed_all for a in packed]
+        t_rb = time.perf_counter()
+        with tracing.span("tile.batch_readback", members=len(cds)):
+            with rtt_sim.round_trip():
+                fetched = jax.device_get(leaves)
+        transfer_ms = (time.perf_counter() - t_rb) * 1000.0
+        tables = [None] * len(cds)
+        off = 0
+        for pos, i in enumerate(order):
+            cd = cds[i]
+            part = fetched[off : off + len(packed_all[pos])]
+            off += len(packed_all[pos])
+            # the per-member lowering counters the capture deferred:
+            # exactly one inc per member now that the fused path answers
+            metrics.TILE_LOWERED_TOTAL.inc()
+            metrics.AGG_STRATEGY_TOTAL.inc(strategy=cd.key[0].agg_strategy)
+            try:
+                tables[i] = cd.finish(part)
+            except Exception:  # noqa: BLE001 — this member degrades solo
+                tables[i] = None
+        info = {
+            "traced": _MEGA_STATS["traces"] > traces0,
+            "stages_ms": {
+                "compile": compile_ms,
+                "dispatch": dispatch_ms,
+                "readback_transfer": transfer_ms,
+            },
+            "bytes_down": int(sum(getattr(a, "nbytes", 0) for a in fetched)),
+        }
+        return tables, info
 
     def _finish_fetched(
         self, int_layout, acc32_layout, acc64_layout, int_dtype, plan,

@@ -253,6 +253,28 @@ class TpuExecutor:
             if rec.flags:
                 analyze.record("device.flags", flags=",".join(rec.flags))
             return
+        if rec.strategy == "fused_batch":
+            # this query's math ran as one branch of a mega-fused batch
+            # tick: the stage times are TICK-level (shared by every
+            # member), so render them under the fused header instead of
+            # pretending they were paid per query
+            members = next(
+                (f.split("=", 1)[1] for f in rec.flags
+                 if f.startswith("members=")),
+                "?",
+            )
+            analyze.record("device.fused_batch", members=members)
+            for name in ("compile", "dispatch", "readback_transfer"):
+                ms = rec.stage_ms(name)
+                attrs = {"shared": True}
+                if name == "compile" and rec.compile_cache:
+                    attrs["cache"] = rec.compile_cache
+                if name == "readback_transfer" and rec.bytes_down:
+                    attrs["bytes"] = rec.bytes_down
+                analyze.timed(f"device.{name}", ms, **attrs)
+            if rec.flags:
+                analyze.record("device.flags", flags=",".join(rec.flags))
+            return
         for name in flight_recorder.STAGES:
             ms = rec.stage_ms(name)
             attrs = {}

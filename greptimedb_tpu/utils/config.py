@@ -660,6 +660,14 @@ class BatchConfig:
     # re-serves without any dispatch; flush/delta bumps the manifest
     # version out from under stale entries.  0 disables the cache.
     result_cache_mb: int = 0
+    # Mega-program fusion: the members of a batch tick compile into ONE
+    # fused XLA program (shared plane scan, per-member masks/folds as
+    # fused branches) keyed on the multiset of their literal-insensitive
+    # program keys — one XLA invocation per tick, not per member.  Only
+    # engages when batching does (window_ms > 0, single device, mesh
+    # off); any trace/compile/dispatch failure degrades to the
+    # per-member packed path, so False restores that path bit-for-bit.
+    fuse_programs: bool = True
 
 
 @dataclasses.dataclass
@@ -1074,6 +1082,12 @@ class Config:
             raise ConfigError(
                 "batch.result_cache_mb must be >= 0 MB (0 disables the "
                 f"windowed result cache); got {bt.result_cache_mb!r}"
+            )
+        if not isinstance(bt.fuse_programs, bool):
+            raise ConfigError(
+                "batch.fuse_programs must be a boolean (fuse a batch "
+                "tick's member programs into one XLA invocation); got "
+                f"{bt.fuse_programs!r}"
             )
         ix = self.index
         if not isinstance(ix.segmented, bool):

@@ -91,6 +91,16 @@ Named points wired into the codebase:
                        every member falls back to its own solo dispatch
                        and still returns the bit-identical answer —
                        packing can delay a query, never corrupt one
+    batch.fuse         mega-program fusion point (parallel/batcher.py),
+                       fired before each member's dispatch capture
+                       (ctx: op = "capture", table) and before the fused
+                       single-invocation dispatch (ctx: op = "fuse",
+                       members).  An injected capture error marks that
+                       member unfusable (partial fusion: the rest still
+                       fuse); an injected fuse error degrades the whole
+                       tick to the per-member packed path — every member
+                       still answers bit-identically, with no duplicated
+                       side effects (greptime_batch_fuse_degraded_total)
     batch.result_cache windowed result cache probe/store (parallel/
                        batcher.py via the tile executor; ctx: op =
                        "get"/"put", table).  An injected error here is
@@ -195,6 +205,7 @@ POINTS = frozenset(
         "recorder.emit",
         "ingest.group_commit",
         "batch.pack",
+        "batch.fuse",
         "batch.result_cache",
         "balance.decide",
         "repartition.copy",

@@ -77,7 +77,7 @@ class DispatchRecord:
     table: str = ""
     trace_id: str = ""
     plan_fp: str = ""
-    strategy: str = ""  # sort | hash | tql | mesh_table | host
+    strategy: str = ""  # sort | hash | tql | mesh_table | host | batched | result_cache | fused_batch
     build_mode: str = ""  # warm | delta | persisted | cold | fused | cold_serve | host_fast
     mesh_devices: int = 0
     compile_cache: str = ""  # hit | miss | "" (no compile this dispatch)
@@ -380,6 +380,37 @@ def emit_adopted(rec: DispatchRecord) -> bool:
         _tls.last = rec
         return True
     return False
+
+
+def emit_fused_batch(table: str, plan_fps, members: int, warmup: bool = False,
+                     stages_ms=None, bytes_down: int = 0) -> bool:
+    """One record per mega-fused batch tick: strategy `fused_batch`, the
+    member count in flags, every member's family fingerprint comma-joined
+    in `plan_fp`.  The tick that paid the fused trace (the warm-up) is
+    ghost-labeled with a `fuse_warmup` flag so per-query latency views
+    separate the one-time compile from steady-state one-invocation
+    ticks — same convention as the cold builder's ghost dispatch."""
+    if not RECORDER.enabled:
+        return False
+    flags = ["batched", "fused", f"members={int(members)}"]
+    if warmup:
+        flags.append("fuse_warmup")
+    try:
+        from . import tracing
+        trace_id = tracing.current_trace_id() or ""
+    except Exception:  # noqa: BLE001 — tracing is optional here
+        trace_id = ""
+    return emit_adopted(DispatchRecord(
+        ts_ms=int(time.time() * 1000),
+        table=table,
+        trace_id=trace_id,
+        plan_fp=",".join(plan_fps),
+        strategy="fused_batch",
+        ghost=bool(warmup),
+        flags=tuple(flags),
+        stages_ms={k: round(float(v), 3) for k, v in (stages_ms or {}).items()},
+        bytes_down=int(bytes_down),
+    ))
 
 
 def last_record() -> DispatchRecord | None:

@@ -569,6 +569,21 @@ QUERY_BATCH_MEMBERS_TOTAL = REGISTRY.counter(
     "Queries whose result came home inside a batched mega-readback "
     "(members per dispatch = members_total / dispatches_total)",
 )
+QUERY_BATCH_FUSED_DISPATCHES_TOTAL = REGISTRY.counter(
+    "greptime_batch_fused_dispatches_total",
+    "Batch ticks whose members executed as ONE mega-fused XLA invocation "
+    "(shared plane scan, per-member masks/folds/finalize fused branches)",
+)
+QUERY_BATCH_FUSE_MEMBERS = REGISTRY.histogram(
+    "greptime_batch_fuse_members",
+    "Members fused into one mega-program invocation, per batch tick",
+    buckets=(2, 3, 4, 6, 8, 12, 16, 24, 32),
+)
+QUERY_BATCH_FUSE_DEGRADED_TOTAL = REGISTRY.counter(
+    "greptime_batch_fuse_degraded_total",
+    "Batch ticks that fell back to per-member dispatches after a fused "
+    "capture/trace/compile/dispatch failure (served correctly, unfused)",
+)
 QUERY_BATCH_RESULT_CACHE_HITS_TOTAL = REGISTRY.counter(
     "greptime_query_batch_result_cache_hits_total",
     "Warm queries served from the windowed result cache with zero "

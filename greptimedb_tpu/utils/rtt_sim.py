@@ -1,0 +1,49 @@
+"""Synthetic device-tunnel RTT injection for offline benchmarking.
+
+The production deployment reaches its accelerators over a tunnel whose
+round-trip time (~103 ms observed) dwarfs warm compute: every dispatch
+submission and every `device_get` pays the link, so the per-member
+dispatch loop — not the chip — sets the dashboard-fleet QPS ceiling.
+Local fakes hide that entirely.  `bench.py --rtt-ms N` (env
+`GRAFT_BENCH_RTT_MS`) configures this module to sleep out a symmetric
+half-RTT on each side of every device boundary crossing, making the
+tunnel knee — and the mega-fusion win of ONE invocation per batch tick —
+reproducible offline.
+
+Off by default (`configure(0)` / unset env): `round_trip()` is a
+zero-overhead no-op and the hot path is bit-for-bit today's.  Ghost
+dispatches inside the fused cold build never pay the simulated link
+(they never pay the real one either — the build pipelines uploads).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+_RTT_S: float = 0.0
+
+
+def configure(rtt_ms: float) -> None:
+    """Set the simulated symmetric round-trip time in milliseconds
+    (0 disables).  Process-global: the bench owns it, tests must reset."""
+    global _RTT_S
+    _RTT_S = max(float(rtt_ms), 0.0) / 1000.0
+
+
+def rtt_ms() -> float:
+    return _RTT_S * 1000.0
+
+
+@contextlib.contextmanager
+def round_trip(enabled: bool = True):
+    """Sleep half the configured RTT before and after the wrapped device
+    boundary crossing (submit or fetch) — the symmetric tunnel model."""
+    half = _RTT_S / 2.0 if enabled else 0.0
+    if half > 0.0:
+        time.sleep(half)
+    try:
+        yield
+    finally:
+        if half > 0.0:
+            time.sleep(half)

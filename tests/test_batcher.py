@@ -301,6 +301,10 @@ def test_batch_pack_fault_degrades_to_solo_and_heals(tmp_path):
     all queries still answer, bit-identical — then the next batch packs
     normally (the layer heals)."""
     db = _mk_db(tmp_path, "packfault", window_ms=60.0)
+    # the pack point lives on the per-member packed path; with fusion on
+    # a clean tick answers through the fused dispatch instead (its own
+    # `batch.fuse` point, covered in test_mega_fusion.py)
+    db.config.batch.fuse_programs = False
     try:
         _load(db, 9)
         solo = {}
@@ -359,5 +363,90 @@ def test_result_cache_fault_is_a_miss_and_heals(tmp_path):
         # heals: the entry is still there (or re-stored); the next ask hits
         db.sql_one(_CACHE_Q)
         assert metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get() > h0
+    finally:
+        db.close()
+
+
+def _insert_probe_row(db):
+    """One row inside _CACHE_Q's window: advances the WAL tail (version
+    snapshot moves) and shows up in the window's count(*) total."""
+    db.insert_rows("t", pa.table({
+        "k": pa.array(["k00000"]),
+        "g": pa.array(["g0"]),
+        "ts": pa.array(np.array([5_000], np.int64), pa.timestamp("ms")),
+        "v": pa.array([100.0]),
+        "w": pa.array([1.0]),
+    }))
+
+
+def test_result_cache_revalidates_versions_against_racing_write(tmp_path):
+    """The purge_region race: the cache key's version snapshot and the
+    cache-lock acquisition are not atomic, so a write can land in between
+    — most visibly across the batch window, where the leader SLEEPS tens
+    of ms between key computation and dispatch.  Both boundaries must
+    re-validate against the LIVE region versions: a store whose snapshot
+    went stale mid-query must not publish (the dispatch read NEWER data
+    than the key claims), and a probe must not adopt an entry the racing
+    purge has not dropped yet."""
+    total = lambda t: sum(t.column("c").to_pylist())  # noqa: E731
+    db = _mk_db(tmp_path, "rcrace", cache_mb=32)
+    try:
+        _load(db, 11, n=2_000)
+        db.sql_one(_CACHE_Q)
+        base = total(db.sql_one(_CACHE_Q))  # warm + cached
+        rc = db.query_engine.tile_cache.result_cache
+        assert rc is not None
+
+        # store boundary: flush empties the cache, then a write lands
+        # between the key snapshot and the store (injected right before
+        # the probe, i.e. after key_for ran) — the result the dispatch
+        # computes INCLUDES the new row, so publishing it under the
+        # pre-write snapshot key would hand later adopters a mismatched
+        # window.  The store must skip.
+        db.storage.flush_all()  # purge: the key's entry is gone
+        e0 = rc.stats()["entries"]
+        with fi.REGISTRY.armed(
+            "batch.result_cache", fail_times=1,
+            callback=lambda ctx: _insert_probe_row(db),
+            match=lambda ctx: ctx.get("op") == "get",
+        ) as plan:
+            raced = db.sql_one(_CACHE_Q)
+            assert plan.trips == 1
+        assert total(raced) == base + 1, "the dispatch must see the write"
+        assert rc.stats()["entries"] == e0, (
+            "a store whose version snapshot went stale mid-query must "
+            "not publish under the old key"
+        )
+
+        # heals: the next clean ask re-caches under the current versions
+        # and the one after that is a genuine hit
+        h0 = metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get()
+        recached = db.sql_one(_CACHE_Q)
+        assert total(recached) == base + 1
+        db.sql_one(_CACHE_Q)
+        assert metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get() == h0 + 1
+
+        # adoption boundary: the cache now holds a current-version entry;
+        # a write landing between THIS probe's key snapshot and the cache
+        # lock makes that entry stale while it still sits in the cache
+        # (the purge has no hook on memtable writes).  The probe's raw
+        # get() HITS — adoption-time re-validation must drop it and
+        # dispatch against the live data.
+        h1 = metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get()
+        with fi.REGISTRY.armed(
+            "batch.result_cache", fail_times=1,
+            callback=lambda ctx: _insert_probe_row(db),
+            match=lambda ctx: ctx.get("op") == "get",
+        ) as plan:
+            adopted = db.sql_one(_CACHE_Q)
+            assert plan.trips == 1
+        assert metrics.QUERY_BATCH_RESULT_CACHE_HITS_TOTAL.get() == h1, (
+            "a probe must not adopt an entry whose versions no longer "
+            "match the live regions"
+        )
+        assert total(adopted) == base + 2, (
+            "the revalidated miss must serve the LIVE window, not the "
+            "stale cached one"
+        )
     finally:
         db.close()

@@ -337,20 +337,19 @@ def test_bench_smoke_mixed_overload(tmp_path):
     )
 
 
-@pytest.mark.bench_smoke
-def test_bench_smoke_qps_sweep(tmp_path):
-    """`bench.py --mode mixed` QPS-sweep smoke: the dashboard-fleet
-    offered-load ladder runs OFF then ON, the record carries both curves
-    (offered -> achieved, p50/p99, shed) plus the knee and speedup, the
-    deterministic burst proves a mega-dispatch happened
-    (batched_members > 0), the ON sweep proves the result cache served
-    (result_cache_hits > 0), zero queries failed, and the emitted line
-    stays inside the driver's tail capture."""
+@pytest.fixture(scope="module")
+def sweep_record(tmp_path_factory):
+    """ONE `bench.py --mode mixed --rtt-ms 100` subprocess shared by the
+    QPS-sweep and fused-batch smokes (both read the same record; two
+    subprocess runs would double the wall cost for no extra coverage).
+    The injected 100 ms tunnel RTT makes this the tunneled-TPU shape —
+    every sweep/burst contract below must hold under it too."""
     import json
     import os
     import subprocess
     import sys
 
+    tmp_path = tmp_path_factory.mktemp("sweep")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {
         **os.environ,
@@ -367,8 +366,10 @@ def test_bench_smoke_qps_sweep(tmp_path):
         "GRAFT_BENCH_PARTIAL": str(tmp_path / "sweep_partial.json"),
     }
     out = subprocess.run(
-        [sys.executable, os.path.join(repo, "bench.py"), "--mode", "mixed"],
-        capture_output=True, text=True, timeout=170, env=env, cwd=str(tmp_path),
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--mode", "mixed", "--rtt-ms", "100"],
+        capture_output=True, text=True, timeout=200, env=env,
+        cwd=str(tmp_path),
     )
     assert out.returncode == 0, out.stderr[-2000:]
     record = line = None
@@ -380,6 +381,21 @@ def test_bench_smoke_qps_sweep(tmp_path):
         if obj.get("metric") == "mixed_load_e2e_p99":
             record, line = obj, raw
     assert record is not None, out.stdout[-2000:]
+    return record, line
+
+
+@pytest.mark.bench_smoke
+def test_bench_smoke_qps_sweep(sweep_record):
+    """`bench.py --mode mixed` QPS-sweep smoke: the dashboard-fleet
+    offered-load ladder runs OFF then ON, the record carries both curves
+    (offered -> achieved, p50/p99, shed) plus the knee and speedup, the
+    deterministic burst proves a mega-dispatch happened
+    (batched_members > 0), the ON sweep proves the result cache served
+    (result_cache_hits > 0), zero queries failed, and the emitted line
+    stays inside the driver's tail capture."""
+    import json
+
+    record, line = sweep_record
     d = record["detail"]
     assert d["zero_failed_queries"] and d["failed"] == 0, d.get("errors")
     sweep = d["qps_sweep"]
@@ -402,6 +418,28 @@ def test_bench_smoke_qps_sweep(tmp_path):
     assert d["batched_members"] >= 2 and d["batch_dispatches"] >= 1
     assert d["result_cache_hits"] > 0
     # the emitted line survives the driver's ~2000-byte tail capture
+    assert len(json.dumps(record, separators=(",", ":"))) < 1900, line
+
+
+@pytest.mark.bench_smoke
+def test_bench_smoke_fused_batch(sweep_record):
+    """`bench.py --mode mixed --rtt-ms 100` smoke (same subprocess as
+    the sweep test): the tunneled-TPU shape — symmetric 100 ms synthetic
+    host<->device RTT around every dispatch and fetch boundary — with
+    mega-program fusion on.  The contract: rc=0, the record carries the
+    injected rtt_ms, at least one batch tick answered as ONE fused XLA
+    invocation (fused_dispatches >= 1), zero failed queries, and the
+    emitted line stays inside the driver's tail capture."""
+    import json
+
+    record, line = sweep_record
+    d = record["detail"]
+    assert d["zero_failed_queries"] and d["failed"] == 0, d.get("errors")
+    assert d["rtt_ms"] == 100
+    # the deterministic burst (and/or the ON sweep) fused >= 1 batch
+    # tick into a single XLA invocation under the injected RTT
+    assert d["fused_dispatches"] >= 1, d
+    assert d["batched_members"] >= 2 and d["batch_dispatches"] >= 1
     assert len(json.dumps(record, separators=(",", ":"))) < 1900, line
 
 

@@ -1,0 +1,354 @@
+"""Mega-program fusion (batch.fuse_programs): one XLA invocation per
+batch tick.
+
+The batcher's fused pre-phase captures every member's dispatch at the
+executor's dispatch site and compiles the whole tick into ONE fused
+program (parallel/tile_cache._mega_program) — the contract under test:
+
+  * results BYTE-identical to N solo runs (the members' own partial/
+    final jit pieces are inlined op-for-op, never re-derived math);
+  * exactly ONE device dispatch per fused tick (TPU_DEVICE_DISPATCHES
+    delta == 1 for N >= 3 members);
+  * compile-once: a slid-window replay of the same member multiset hits
+    the fused compile cache with ZERO recompiles (literals, bucket
+    geometry and time bounds ride as dynamic traced inputs);
+  * every failure mode degrades — partial fusion for an unfusable
+    member, whole-tick degrade to the per-member packed path on a fuse
+    failure (including a multi-member RESOURCE_EXHAUSTED, whose retry
+    semantics belong to the per-member ladder), solo rerun on a decode
+    verdict — and `batch.fuse_programs = false` restores the per-member
+    path bit-for-bit.
+
+Fault points exercised here (the conftest coverage gate):
+    "batch.fuse"  op="capture" -> member unfusable (partial fusion);
+                  op="fuse"    -> whole tick degrades to per-member
+
+The sort- and hash-strategy databases are module-scoped (seeded load +
+family warm-up amortized across the tests; every assertion below is a
+per-round metric delta, so sharing is safe).
+"""
+
+import pytest
+
+from test_batcher import _QUERIES, _concurrent, _load, _mk_db, _ser
+
+from greptimedb_tpu.parallel import tile_cache
+from greptimedb_tpu.utils import fault_injection as fi
+from greptimedb_tpu.utils import metrics
+
+# batch window for fusion tests: wide enough that barrier-released
+# threads reliably land in ONE tick (still under the leader's 250 ms
+# sleep cap), short enough to keep each retry round cheap
+_WIN = 120.0
+_N_ROWS = 2_500  # covers the slid windows below (ts reaches ~41 min)
+
+
+@pytest.fixture(scope="module")
+def sort_db(tmp_path_factory):
+    db = _mk_db(
+        tmp_path_factory.mktemp("fusion"), "fsort",
+        strategy="sort", window_ms=_WIN,
+    )
+    _load(db, 21, n=_N_ROWS)
+    yield db
+    db.close()
+
+
+@pytest.fixture(scope="module")
+def hash_db(tmp_path_factory):
+    db = _mk_db(
+        tmp_path_factory.mktemp("fusion"), "fhash",
+        strategy="hash", window_ms=_WIN,
+    )
+    _load(db, 23, n=_N_ROWS)
+    yield db
+    db.close()
+
+
+def _warm_with_refs(db, queries):
+    """Warm every family (cold build + warm marking) and capture the solo
+    reference bytes the fused results must match exactly.  The batch
+    window is zeroed for the duration so each reference runs the DIRECT
+    solo path (and skips the leader's window sleep)."""
+    solo = {}
+    bc = db.config.batch
+    win, bc.window_ms = bc.window_ms, 0.0
+    try:
+        for q in queries:
+            db.sql_one(q)
+            solo[q] = _ser(db.sql_one(q))
+    finally:
+        bc.window_ms = win
+    return solo
+
+
+def _fused_round(db, queries, rounds=8):
+    """Retry barrier-released concurrent rounds until one executes as a
+    CLEAN fused tick (1 fused dispatch, every query a member).  Returns
+    that round's results; fails the test if no clean tick forms."""
+    for _ in range(rounds):
+        f0 = metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get()
+        m0 = metrics.QUERY_BATCH_MEMBERS_TOTAL.get()
+        results, errors = _concurrent(db, queries)
+        assert not errors, errors
+        if (
+            metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get() - f0 == 1
+            and metrics.QUERY_BATCH_MEMBERS_TOTAL.get() - m0 == len(queries)
+        ):
+            return results
+    pytest.fail("no clean fused tick formed (timing-dependent membership)")
+
+
+@pytest.mark.parametrize("dbfix", ["sort_db", "hash_db"])
+def test_fused_vs_solo_bit_parity(request, dbfix):
+    """N distinct warm queries fused into one invocation return
+    BYTE-identical tables to their solo runs — dense (sort) and hash
+    strategies, null tags AND null values in the load."""
+    db = request.getfixturevalue(dbfix)
+    solo = _warm_with_refs(db, _QUERIES)
+    results = _fused_round(db, _QUERIES)
+    for q, r in zip(_QUERIES, results):
+        assert _ser(r) == solo[q], (
+            f"fused result diverged from solo for {q!r} on {dbfix}"
+        )
+
+
+def test_fused_vs_solo_bit_parity_host_post_ops(tmp_path):
+    """Same parity with device finalize OFF (host post-ops decode path):
+    the capture's finish continuation must slice the fused leaves the
+    same way the solo readback does."""
+    db = _mk_db(
+        tmp_path, "fhost", strategy="sort", device_topk=False,
+        window_ms=_WIN,
+    )
+    try:
+        _load(db, 22, n=_N_ROWS)
+        solo = _warm_with_refs(db, _QUERIES[:3])
+        results = _fused_round(db, _QUERIES[:3])
+        for q, r in zip(_QUERIES[:3], results):
+            assert _ser(r) == solo[q]
+    finally:
+        db.close()
+
+
+def test_mega_dispatch_count_invariant(sort_db):
+    """The tentpole invariant: one batch tick of N >= 3 distinct warm
+    fusable queries executes exactly ONE XLA invocation."""
+    db = sort_db
+    queries = _QUERIES[:4]
+    solo = _warm_with_refs(db, queries)
+    for _ in range(8):
+        d0 = metrics.TPU_DEVICE_DISPATCHES.get()
+        f0 = metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get()
+        m0 = metrics.QUERY_BATCH_MEMBERS_TOTAL.get()
+        results, errors = _concurrent(db, queries)
+        assert not errors, errors
+        fused = metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get() - f0
+        members = metrics.QUERY_BATCH_MEMBERS_TOTAL.get() - m0
+        if fused == 1 and members == len(queries):
+            # a clean all-member fused tick: the whole round cost
+            # exactly one device dispatch
+            assert metrics.TPU_DEVICE_DISPATCHES.get() - d0 == 1, (
+                "a fused tick must be ONE XLA invocation, not one "
+                "per member"
+            )
+            for q, r in zip(queries, results):
+                assert _ser(r) == solo[q]
+            return
+    pytest.fail("no clean fused tick formed in 8 rounds")
+
+
+_SLID_W1 = (
+    "SELECT k, g, sum(v) AS sv FROM t WHERE ts >= '1970-01-01T00:10:00'"
+    " AND ts < '1970-01-01T00:40:00' GROUP BY k, g",
+    "SELECT time_bucket('1m', ts) AS tb, sum(v) AS sv FROM t"
+    " WHERE ts >= '1970-01-01T00:10:00' AND ts < '1970-01-01T00:40:00'"
+    " GROUP BY tb",
+    "SELECT g, count(v) AS cv FROM t WHERE g = 'g3' AND"
+    " ts >= '1970-01-01T00:10:00' AND ts < '1970-01-01T00:40:00'"
+    " GROUP BY g",
+)
+# the dashboard slide: both bounds shift one bucket, the filter literal
+# changes — plan STRUCTURE (and so every program key) is unchanged
+_SLID_W2 = tuple(
+    q.replace("00:10:00", "00:11:00")
+    .replace("00:40:00", "00:41:00")
+    .replace("'g3'", "'g4'")
+    for q in _SLID_W1
+)
+
+
+@pytest.mark.parametrize("dbfix", ["sort_db", "hash_db"])
+def test_slid_window_replay_zero_recompile(request, dbfix):
+    """Compile-once contract: after a fused tick at window W, the same
+    member multiset slid one bucket (new bounds, new literals) re-hits
+    the fused program with ZERO recompiles — no new outer trace, no
+    fused-cache miss, no compile-cache miss."""
+    db = request.getfixturevalue(dbfix)
+    _warm_with_refs(db, _SLID_W1)
+    _fused_round(db, _SLID_W1)  # pays the one fused trace
+    bc = db.config.batch
+    win, bc.window_ms = bc.window_ms, 0.0
+    try:
+        solo2 = {q: _ser(db.sql_one(q)) for q in _SLID_W2}
+    finally:
+        bc.window_ms = win
+    for _ in range(8):
+        t0 = tile_cache._MEGA_STATS["traces"]
+        mp0 = tile_cache._mega_program.cache_info().misses
+        c0 = metrics.TPU_COMPILE_CACHE_MISSES.get()
+        f0 = metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get()
+        m0 = metrics.QUERY_BATCH_MEMBERS_TOTAL.get()
+        results, errors = _concurrent(db, _SLID_W2)
+        assert not errors, errors
+        if (
+            metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get() - f0 == 1
+            and metrics.QUERY_BATCH_MEMBERS_TOTAL.get() - m0
+            == len(_SLID_W2)
+        ):
+            assert tile_cache._MEGA_STATS["traces"] - t0 == 0, (
+                "the slid replay re-traced the fused program"
+            )
+            assert tile_cache._mega_program.cache_info().misses == mp0
+            assert metrics.TPU_COMPILE_CACHE_MISSES.get() - c0 == 0, (
+                "the slid replay missed the compile cache"
+            )
+            for q, r in zip(_SLID_W2, results):
+                assert _ser(r) == solo2[q]
+            return
+    pytest.fail("no clean fused tick formed for the slid window")
+
+
+def test_fuse_capture_fault_partial_fusion(sort_db):
+    """A tick mixing fusable and unfusable members: an injected capture
+    failure marks ONE member unfusable; the rest still fuse and the
+    outlier answers via the per-member path — all bit-identical."""
+    db = sort_db
+    queries = _QUERIES[:4]
+    solo = _warm_with_refs(db, queries)
+    plan = fi.REGISTRY.arm(
+        "batch.fuse", fail_times=1, error=RuntimeError,
+        match=lambda ctx: ctx.get("op") == "capture",
+    )
+    try:
+        for _ in range(8):
+            f0 = metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get()
+            results, errors = _concurrent(db, queries)
+            assert not errors, errors
+            for q, r in zip(queries, results):
+                assert _ser(r) == solo[q], (
+                    "an unfusable member must degrade, never diverge"
+                )
+            fused = metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get() - f0
+            if plan.trips >= 1 and fused >= 1:
+                # the fault fired AND the remaining members fused in
+                # the same run: partial fusion, proven
+                return
+        pytest.fail("capture fault never coincided with a fused tick")
+    finally:
+        fi.REGISTRY.disarm()
+
+
+@pytest.mark.parametrize(
+    "error",
+    [
+        RuntimeError,  # generic trace/compile failure
+        # multi-member HBM exhaustion: the fused path must NOT own the
+        # halve-and-retry ladder (a mega-sized retry would just exhaust
+        # again) — it degrades and the per-member path retries at a
+        # size the emergency release can satisfy
+        lambda: RuntimeError("injected RESOURCE_EXHAUSTED: fused dispatch"),
+    ],
+)
+def test_fuse_fault_degrades_whole_tick_with_no_duplicate_effects(
+    sort_db, error
+):
+    """An injected failure at the fused dispatch degrades the WHOLE tick
+    to the per-member packed path: every member answers bit-identically,
+    the degrade counter moves, no fused dispatch is recorded, and the
+    per-member bookkeeping happens exactly once (no duplicated side
+    effects from the abandoned capture) — then the next tick fuses again
+    (the layer heals)."""
+    err = error() if callable(error) and not isinstance(error, type) else error
+    db = sort_db
+    queries = _QUERIES[:4]
+    solo = _warm_with_refs(db, queries)
+    plan = fi.REGISTRY.arm(
+        "batch.fuse", fail_times=1, error=err,
+        match=lambda ctx: ctx.get("op") == "fuse",
+    )
+    try:
+        tripped = False
+        for _ in range(8):
+            f0 = metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get()
+            g0 = metrics.QUERY_BATCH_FUSE_DEGRADED_TOTAL.get()
+            d0 = metrics.QUERY_BATCH_DISPATCHES_TOTAL.get()
+            m0 = metrics.QUERY_BATCH_MEMBERS_TOTAL.get()
+            results, errors = _concurrent(db, queries)
+            assert not errors, errors
+            for q, r in zip(queries, results):
+                assert _ser(r) == solo[q], (
+                    "a fuse failure must degrade, never diverge"
+                )
+            if plan.trips >= 1:
+                tripped = True
+                assert (
+                    metrics.QUERY_BATCH_FUSE_DEGRADED_TOTAL.get() - g0 >= 1
+                )
+                if (
+                    metrics.QUERY_BATCH_MEMBERS_TOTAL.get() - m0
+                    == len(queries)
+                ):
+                    # clean degrade round: the per-member path served
+                    # the tick ONCE — one batch dispatch, no fused
+                    # dispatch, no double-count from the capture
+                    assert (
+                        metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get()
+                        - f0
+                        == 0
+                    )
+                    assert (
+                        metrics.QUERY_BATCH_DISPATCHES_TOTAL.get() - d0
+                        == 1
+                    )
+                break
+        assert tripped, "no tick ever reached the fuse point"
+    finally:
+        fi.REGISTRY.disarm()
+    # heals: with the fault gone, fusion engages again
+    f0 = metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get()
+    for _ in range(8):
+        results, errors = _concurrent(db, queries)
+        assert not errors
+        if metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get() > f0:
+            break
+    assert metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get() > f0
+    for q, r in zip(queries, results):
+        assert _ser(r) == solo[q]
+
+
+def test_fuse_programs_off_restores_per_member_path(sort_db):
+    """batch.fuse_programs=false: batching still engages (PR 18's packed
+    readback path, bit-for-bit) but no fused program is ever built."""
+    db = sort_db
+    queries = _QUERIES[:4]
+    db.config.batch.fuse_programs = False
+    try:
+        solo = _warm_with_refs(db, queries)
+        f0 = metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get()
+        g0 = metrics.QUERY_BATCH_FUSE_DEGRADED_TOTAL.get()
+        d0 = metrics.QUERY_BATCH_DISPATCHES_TOTAL.get()
+        for _ in range(6):
+            results, errors = _concurrent(db, queries)
+            assert not errors, errors
+            for q, r in zip(queries, results):
+                assert _ser(r) == solo[q]
+            if metrics.QUERY_BATCH_DISPATCHES_TOTAL.get() > d0:
+                break
+        assert metrics.QUERY_BATCH_DISPATCHES_TOTAL.get() > d0, (
+            "per-member batching must still engage with fusion off"
+        )
+        assert metrics.QUERY_BATCH_FUSED_DISPATCHES_TOTAL.get() == f0
+        assert metrics.QUERY_BATCH_FUSE_DEGRADED_TOTAL.get() == g0
+    finally:
+        db.config.batch.fuse_programs = True
