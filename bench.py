@@ -444,6 +444,15 @@ def _clamp_record(record: dict) -> dict:
     hs = d.get("hotspot")
     if isinstance(hs, dict):
         hs.pop("phases", None)
+    # the device-health digest keeps its verdict scalars (wedged /
+    # quarantines / healed / zero_failed_queries); nested per-state maps
+    # are diagnostics whose full copy lives in BENCH_PARTIAL.json
+    dvh = d.get("device_health")
+    if isinstance(dvh, dict):
+        d["device_health"] = {
+            k: v for k, v in dvh.items()
+            if not isinstance(v, (dict, list))
+        }
     errs = d.get("errors")
     if isinstance(errs, list) and errs:
         d["errors"] = [str(e)[:40] for e in errs[:2]]
@@ -2526,6 +2535,64 @@ def _hotspot_phase() -> dict:
         c.close()
 
 
+def _device_wedge_phase(db, sql: str) -> dict:
+    """Chaos leg over the now-static snapshot: wedge ONE warm dispatch
+    (fault point `device.wedge` blocking the supervised worker) and prove
+    the device-health contract end to end — the wedged query still
+    answers via the degrade ladder, the devices quarantine, the heal
+    prober re-admits them, and a post-heal query matches.  The record's
+    `device_health` digest carries the verdict scalars."""
+    import threading
+
+    from greptimedb_tpu.utils import device_health as dh
+    from greptimedb_tpu.utils import fault_injection as fi
+
+    sup = dh.SUPERVISOR
+    out: dict = {"supervised": sup.enabled, "wedged": False,
+                 "healed": False, "zero_failed_queries": False}
+    if not sup.enabled or db.query_engine.tile_cache is None:
+        return out
+    # the hotspot phase booted its own cluster Databases, each of which
+    # re-pointed the process-wide supervisor at ITS config — wire it back
+    # to this db, with a chaos-speed deadline (restored below)
+    cache = db.query_engine.tile_cache
+    saved_timeout = db.config.device.call_timeout_s
+    db.config.device.call_timeout_s = 2.0
+    sup.configure(db.config.device, cache.devices)
+    db.config.query.timeout_s = 30.0
+    try:
+        want = db.sql_one(sql).num_rows  # warm + reference
+        release = threading.Event()
+        t0 = time.perf_counter()
+        try:
+            with fi.REGISTRY.armed(
+                "device.wedge", fail_times=1,
+                match=lambda ctx: ctx.get("kind") == "dispatch",
+                callback=lambda ctx: release.wait(timeout=60),
+            ) as plan:
+                got = db.sql_one(sql)  # must still answer, degraded
+        finally:
+            release.set()
+        out["wedge_wall_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+        out["wedged"] = plan.trips >= 1
+        answered = got is not None and got.num_rows == want
+        out["quarantines"] = int(sup.digest().get("quarantines", 0))
+        n = len(cache.devices)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if sup.healthy_indices(n) == tuple(range(n)):
+                break
+            time.sleep(0.05)
+        out["healed"] = sup.healthy_indices(n) == tuple(range(n))
+        post_heal = db.sql_one(sql).num_rows == want
+        out["post_heal_ok"] = post_heal
+        out["zero_failed_queries"] = answered and post_heal
+        out.update(sup.digest())
+        return out
+    finally:
+        db.config.device.call_timeout_s = saved_timeout
+
+
 def mixed_main():
     """Concurrent ingest+query under forced HBM overcommit; emits one JSON
     line with p50/p99 per query family and the overload-survival counters."""
@@ -2768,6 +2835,20 @@ def mixed_main():
         k: hotspot.get(k)
         for k in ("auto_split", "zero_failed_queries", "splits_enacted",
                   "regions", "first_split_step")
+    }, "elapsed_s": round(_elapsed(), 1)})
+
+    # Device-health chaos leg: wedge one warm dispatch, watch quarantine
+    # + heal, zero failed queries throughout (fault point `device.wedge`).
+    try:
+        wedge = _device_wedge_phase(db, families[1][1])
+    except Exception as exc:  # noqa: BLE001 — surfaced in the record
+        wedge = {"error": repr(exc)[:200], "wedged": False,
+                 "healed": False, "zero_failed_queries": False}
+    detail["device_health"] = wedge
+    _emit({"event": "mixed_device_wedge", **{
+        k: wedge.get(k)
+        for k in ("supervised", "wedged", "quarantines", "healed",
+                  "zero_failed_queries", "wedge_wall_ms")
     }, "elapsed_s": round(_elapsed(), 1)})
 
     per_family = {}

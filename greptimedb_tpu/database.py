@@ -186,6 +186,18 @@ class Database:
         from .utils import flight_recorder as _flight_recorder
 
         _flight_recorder.RECORDER.configure(getattr(self.config, "recorder", None))
+        # Device health supervisor: process-wide like the recorder — the
+        # most recently opened Database's device.* knobs govern it.  It
+        # must see the tile cache's device list (not jax.devices()) so
+        # health state lines up with chunk-placement indices.
+        from .utils import device_health as _device_health
+
+        _device_health.SUPERVISOR.configure(
+            getattr(self.config, "device", None),
+            self.query_engine.tile_cache.devices
+            if self.query_engine.tile_cache is not None
+            else None,
+        )
         if self.query_engine.tile_cache is not None:
             self.query_engine.tile_cache.tile_config = self.config.tile
             # overload-survival knobs (dispatch coalescing, HBM feedback)

@@ -399,6 +399,46 @@ def _device_memory_table(mem_rows: list) -> pa.Table:
     })
 
 
+def _device_health(db) -> pa.Table:
+    """information_schema.device_health: the device supervisor's per-device
+    state machine — current state (HEALTHY/SUSPECT/QUARANTINED/PROBING),
+    abandonment and quarantine counters, heal history and the last error
+    that moved the needle; one shared collector
+    (DeviceSupervisor.health_rows) with /debug/tile."""
+    from ..utils import device_health
+
+    cache = _tile_cache(db)
+    return _device_health_table(device_health.SUPERVISOR.health_rows(
+        cache.devices if cache is not None else None
+    ))
+
+
+def _device_health_table(health_rows: list) -> pa.Table:
+    rows = {
+        "device": [], "device_kind": [], "state": [],
+        "consecutive_failures": [], "abandoned_calls": [], "quarantines": [],
+        "heals": [], "last_probe_ms": [], "quarantine_age_ms": [],
+        "last_error": [],
+    }
+    for r in health_rows:
+        for k in rows:
+            rows[k].append(r[k])
+    return pa.table({
+        "device": pa.array(rows["device"], pa.int64()),
+        "device_kind": pa.array(rows["device_kind"], pa.string()),
+        "state": pa.array(rows["state"], pa.string()),
+        "consecutive_failures": pa.array(
+            rows["consecutive_failures"], pa.int64()
+        ),
+        "abandoned_calls": pa.array(rows["abandoned_calls"], pa.int64()),
+        "quarantines": pa.array(rows["quarantines"], pa.int64()),
+        "heals": pa.array(rows["heals"], pa.int64()),
+        "last_probe_ms": pa.array(rows["last_probe_ms"], pa.int64()),
+        "quarantine_age_ms": pa.array(rows["quarantine_age_ms"], pa.int64()),
+        "last_error": pa.array(rows["last_error"], pa.string()),
+    })
+
+
 def _region_balance(db) -> pa.Table:
     """information_schema.region_balance: the elastic balancer's live
     view — per-region EWMA load score, its raw inputs (rows/s delta,
@@ -457,6 +497,7 @@ _TABLES = {
     "tile_cache_entries": _tile_cache_entries,
     "device_dispatches": _device_dispatches,
     "device_memory": _device_memory,
+    "device_health": _device_health,
 }
 
 
@@ -469,6 +510,7 @@ _EMPTY_TABLES = {
     "tile_cache_entries": lambda: _tce_table(_tce_rows()),
     "device_dispatches": lambda: _dispatch_table([]),
     "device_memory": lambda: _device_memory_table([]),
+    "device_health": lambda: _device_health_table([]),
 }
 
 

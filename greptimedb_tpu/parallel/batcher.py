@@ -72,7 +72,7 @@ from collections import OrderedDict
 
 import jax
 
-from ..utils import flight_recorder, metrics, rtt_sim, tracing
+from ..utils import device_health, flight_recorder, metrics, rtt_sim, tracing
 from ..utils.deadline import check_deadline, current_deadline
 from ..utils.fault_injection import fire as _fault_fire
 
@@ -380,22 +380,35 @@ class QueryBatcher:
 
     def _lead(self, batch, m, key, adm, bc):
         # wait out the window for peers (bounded by the leader's own
-        # remaining deadline), close the batch, run it, wake everyone
-        window_s = min(float(bc.window_ms) / 1000.0, self._WINDOW_CAP_S)
-        deadline = current_deadline()
-        if deadline is not None:
-            window_s = max(min(window_s, deadline - time.monotonic()), 0.0)
-        if window_s > 0:
-            time.sleep(window_s)
-        with self._lock:
-            batch.closed = True
-            if self._open.get(key) is batch:
-                del self._open[key]
+        # remaining deadline), close the batch, run it, wake everyone.
+        # The ENTIRE body sits under one try/finally: a leader dying in
+        # the window sleep or the lock-close step (deadline alarm, async
+        # interrupt, wedge-abandon raise) before the old finally was
+        # entered used to strand every already-enqueued joiner on an
+        # event nobody would ever set — they'd hang until their own
+        # deadline instead of soloing immediately.  The finally both
+        # closes the batch (so no NEW joiner can board a dead batch) and
+        # wakes every peer with the solo-rerun verdict (served=False).
         try:
-            self._run(batch, adm)
-        except BaseException:  # noqa: BLE001 — every member degrades solo
-            pass
+            window_s = min(float(bc.window_ms) / 1000.0, self._WINDOW_CAP_S)
+            deadline = current_deadline()
+            if deadline is not None:
+                window_s = max(min(window_s, deadline - time.monotonic()), 0.0)
+            if window_s > 0:
+                time.sleep(window_s)
+            with self._lock:
+                batch.closed = True
+                if self._open.get(key) is batch:
+                    del self._open[key]
+            try:
+                self._run(batch, adm)
+            except BaseException:  # noqa: BLE001 — every member degrades solo
+                pass
         finally:
+            with self._lock:
+                batch.closed = True
+                if self._open.get(key) is batch:
+                    del self._open[key]
             for peer in batch.members:
                 if peer is not m:
                     peer.event.set()
@@ -572,7 +585,9 @@ class QueryBatcher:
             t0 = time.perf_counter()
             with tracing.span("tile.batch_readback", members=len(pendings)):
                 with rtt_sim.round_trip():
-                    fetched = jax.device_get(leaves)
+                    fetched = device_health.supervised_call(
+                        "readback", lambda: jax.device_get(leaves)
+                    )
             transfer_ms = (time.perf_counter() - t0) * 1000.0
         except BaseException:  # noqa: BLE001 — pack failure solos everyone
             for m, _ in pendings:

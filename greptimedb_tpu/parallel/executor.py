@@ -732,8 +732,15 @@ def distributed_groupby(
     flight_recorder.stage_add(
         "compile", (_time.perf_counter() - t0) * 1000.0
     )
+    from ..utils import device_health as _device_health
+
+    mesh_slots = tuple(range(int(mesh.devices.size)))
     t0 = _time.perf_counter()
-    states = step(cols_stacked, valid_stacked, nulls_stacked)
+    states = _device_health.supervised_call(
+        "dispatch",
+        lambda: step(cols_stacked, valid_stacked, nulls_stacked),
+        devices=mesh_slots,
+    )
     flight_recorder.stage_add(
         "dispatch", (_time.perf_counter() - t0) * 1000.0
     )
@@ -758,7 +765,11 @@ def distributed_groupby(
     from ..utils import metrics as _metrics
 
     t0 = _time.perf_counter()
-    presence_np, finals = jax.device_get((presence, finals))
+    presence_np, finals = _device_health.supervised_call(
+        "readback",
+        lambda: jax.device_get((presence, finals)),
+        devices=mesh_slots,
+    )
     fetch_ms = (_time.perf_counter() - t0) * 1000.0
     _metrics.TPU_READBACK_TRANSFER_MS.observe(fetch_ms)
     flight_recorder.stage_add("readback_transfer", fetch_ms)

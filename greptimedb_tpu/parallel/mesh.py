@@ -21,14 +21,23 @@ def local_device_count() -> int:
     return jax.local_device_count()
 
 
-def make_mesh(n_devices: int | None = None, axis: str = REGION_AXIS) -> Mesh:
+def make_mesh(
+    n_devices: int | None = None,
+    axis: str = REGION_AXIS,
+    devices: list | None = None,
+) -> Mesh:
     """1-D mesh over (up to) n_devices local devices.
 
     A 1-D `regions` axis is the right shape for scan fan-out + all-reduce
     merge; model-parallel style 2-D meshes are unnecessary because the DB
     hot path has no weight matrices to shard.
+
+    Pass an explicit `devices` list to build the mesh over a subset — the
+    device-health supervisor shrinks the mesh to the surviving (healthy)
+    device set this way after a quarantine.
     """
-    devices = jax.devices()
+    if devices is None:
+        devices = jax.devices()
     if n_devices is not None:
         if n_devices > len(devices):
             raise ValueError(

@@ -86,7 +86,7 @@ from ..storage.dictionary import TableDictionary
 from ..storage.region import OP_COL, Region
 from ..storage.sst import FileMeta
 from ..query import analyze, passes
-from ..utils import flight_recorder, metrics, rtt_sim, tracing
+from ..utils import device_health, flight_recorder, metrics, rtt_sim, tracing
 from ..utils.deadline import check_deadline, current_deadline
 from ..utils.errors import QueryTimeoutError
 from ..utils.fault_injection import fire as _fault_fire
@@ -171,6 +171,39 @@ def fused_build_scope():
 
 def _in_fused_build() -> bool:
     return getattr(_FUSED_BUILD, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def _ambient_scope(token):
+    """Re-establish the CALLER's flow-maintenance / fused-build depths on
+    the device supervisor's worker thread: dispatch-time attribution
+    (greptime_flow_device_dispatch_total, the fused builder's ghost
+    counter skips) reads these thread-locals inside the supervised
+    callable."""
+    flow, fused = token
+    prev = (getattr(_FLOW_MAINT, "depth", 0), getattr(_FUSED_BUILD, "depth", 0))
+    _FLOW_MAINT.depth, _FUSED_BUILD.depth = flow, fused
+    try:
+        yield
+    finally:
+        _FLOW_MAINT.depth, _FUSED_BUILD.depth = prev
+
+
+device_health.register_scope_propagator(
+    lambda: (
+        getattr(_FLOW_MAINT, "depth", 0),
+        getattr(_FUSED_BUILD, "depth", 0),
+    ),
+    _ambient_scope,
+)
+
+# The background fused builder's ghost dispatches are best-effort work no
+# query is waiting on: on a saturated box they can genuinely outlast the
+# foreground call deadline, and abandoning one would quarantine every
+# device (dropping all resident planes) over a harmless stall.  Bypass
+# supervision on the builder thread — its own failure handling already
+# owns errors there, and the foreground path it primes stays supervised.
+device_health.register_bypass(_in_fused_build)
 
 # GRAFT_TILE_TIMING=1 prints per-phase wall times of the cold path (the
 # bench's second-process cold probe uses it to attribute cold latency)
@@ -509,7 +542,7 @@ class TileCacheManager:
         # batch.result_cache_mb > 0; invalidate_region purges it
         self.result_cache = None
         self._persist_pool: set[str] = set()  # filesets being written
-        self._meshes: dict[int, object] = {}  # n_devices -> cached Mesh
+        self._meshes: dict[tuple, object] = {}  # (n, device ids) -> Mesh
         self._lock = threading.RLock()
         self._super: OrderedDict[int, _SuperTiles] = OrderedDict()
         self._host: OrderedDict[tuple[int, str], _FileHostTiles] = OrderedDict()
@@ -531,6 +564,13 @@ class TileCacheManager:
         # halve-chunk degrade rounds survived (information_schema
         # device_memory / the flight recorder's HBM snapshot)
         self.degrade_rounds = 0
+        # last device-health generation this cache synced against: a
+        # quarantine bumps the supervisor's generation, and health_sync
+        # drops device planes lazily on the next query (resident planes
+        # on a wedged device are unreachable state, not truth).  Snapshot
+        # the live generation: a cache born after an old quarantine holds
+        # nothing worth invalidating
+        self._health_gen = device_health.SUPERVISOR.generation
 
     _MANIFESTS_PER_TABLE = 64
 
@@ -744,10 +784,17 @@ class TileCacheManager:
         free = 0
         try:
             dev = self.devices[0]
-            probe = jax.device_put(np.zeros(1 << 16, np.uint8), dev)
-            probe.block_until_ready()
-            stats = dev.memory_stats() or {}
-            del probe
+
+            def _probe():
+                probe = jax.device_put(np.zeros(1 << 16, np.uint8), dev)
+                probe.block_until_ready()
+                stats = dev.memory_stats() or {}
+                del probe
+                return stats
+
+            stats = device_health.supervised_call(
+                "memory_stats", _probe, devices=(0,)
+            )
             limit = int(stats.get("bytes_limit", 0))
             in_use = int(stats.get("bytes_in_use", 0))
             free = max(limit - in_use, 0)
@@ -860,7 +907,11 @@ class TileCacheManager:
         rows: list[dict] = []
         for i, dev in enumerate(self.devices):
             try:
-                stats = dev.memory_stats() or {}
+                stats = device_health.supervised_call(
+                    "memory_stats",
+                    lambda d=dev: d.memory_stats() or {},
+                    devices=(i,),
+                ) or {}
             except Exception:  # noqa: BLE001 — CPU devices have no stats
                 stats = {}
             rows.append({
@@ -1096,52 +1147,124 @@ class TileCacheManager:
 
     def mesh(self, n_devices: int):
         """The (cached) 1-D `regions` mesh for multi-chip tile dispatch
-        (tile.mesh_devices); built lazily per device count."""
+        (tile.mesh_devices); built lazily per device count — over the
+        SURVIVING device set, so a quarantine re-shards the mesh onto
+        healthy chips (the cache key carries the device identities)."""
+        devs = tuple(self.placement_devices()[:n_devices])
+        key = (n_devices, tuple(id(d) for d in devs))
         with self._lock:
-            m = self._meshes.get(n_devices)
+            m = self._meshes.get(key)
             if m is None:
                 from .mesh import make_mesh
 
-                m = self._meshes[n_devices] = make_mesh(n_devices)
+                m = self._meshes[key] = make_mesh(
+                    n_devices, devices=list(devs)
+                )
             return m
 
     def mesh_devices(self) -> int:
-        """Live tile.mesh_devices knob, clamped to what exists."""
+        """Live tile.mesh_devices knob, clamped to what exists AND
+        answers: quarantined devices don't count, so the mesh path
+        shrinks to the surviving set (1 survivor = single-chip)."""
         n = int(self._tile_opt("mesh_devices", 0) or 0)
-        return min(max(n, 0), len(self.devices))
+        return min(max(n, 0), len(self.placement_devices()))
+
+    def placement_devices(self) -> list:
+        """Devices eligible for chunk placement / mesh sharding: the
+        healthy subset per the device supervisor.  With every device
+        quarantined the full list returns (the executor bails to the
+        host path before dispatching; an empty list would just crash
+        placement arithmetic)."""
+        sup = device_health.SUPERVISOR
+        if not sup.enabled:
+            return self.devices
+        idx = sup.healthy_indices(len(self.devices))
+        if not idx or len(idx) == len(self.devices):
+            return self.devices
+        return [self.devices[i] for i in idx]
+
+    def health_sync(self):
+        """Lazy quarantine reaction, called on the query path before any
+        dispatch: when the supervisor's generation moved (a device was
+        quarantined or healed since the last sync), drop every super-tile
+        entry's device planes — chunks round-robin across ALL devices, so
+        any entry may hold planes on the wedged chip, and a rebuild on
+        the surviving set is exactly what the fused builder is for.
+        Host-side encodes and the windowed result cache survive (both
+        host memory, both still correct)."""
+        sup = device_health.SUPERVISOR
+        if not sup.enabled:
+            return
+        gen = sup.generation
+        if gen == self._health_gen:
+            return
+        with self._lock:
+            if gen == self._health_gen:
+                return
+            self._health_gen = gen
+            for rid in list(self._super):
+                dropped = self._super.pop(rid)
+                self._used -= dropped.nbytes
+                self._host_used -= dropped.host_nbytes
+                self._region_versions.pop(rid, None)
+        metrics.TILE_HEALTH_INVALIDATIONS.inc()
+        logging.getLogger("greptimedb_tpu.tile").warning(
+            "device health generation %d: dropped device planes for "
+            "rebuild on the surviving device set", gen,
+        )
 
     def chunk_device(self, i: int, region_id: int | None = None):
-        """Device for chunk index i (round-robin over local devices;
-        disabling the chunk_placement pass pins every chunk to device 0,
-        e.g. while debugging a multi-device state merge).  With the mesh
-        path on (tile.mesh_devices > 0) a region's chunks start at the
-        region's co-located device slot (parallel/mesh.py
-        region_device_index) so single-chunk regions land whole on their
-        owning datanode's device and the mesh dispatch consumes them
-        without a cross-device hop."""
+        """Device for chunk index i (round-robin over healthy local
+        devices; disabling the chunk_placement pass pins every chunk to
+        the first healthy device, e.g. while debugging a multi-device
+        state merge).  With the mesh path on (tile.mesh_devices > 0) a
+        region's chunks start at the region's co-located device slot
+        (parallel/mesh.py region_device_index) so single-chunk regions
+        land whole on their owning datanode's device and the mesh
+        dispatch consumes them without a cross-device hop."""
+        devs = self.placement_devices()
         if not passes.enabled("chunk_placement", self.config):
-            return self.devices[0]
+            return devs[0]
         mesh_n = self.mesh_devices()
         if mesh_n > 0 and region_id is not None:
             from .mesh import region_device_index
 
             base = region_device_index(region_id, mesh_n)
-            return self.devices[(base + i) % mesh_n]
-        return self.devices[i % len(self.devices)]
+            return devs[(base + i) % mesh_n]
+        return devs[i % len(devs)]
 
     def _up_chunks(self, buf: np.ndarray, bounds, region_id: int | None = None) -> list:
         """Upload a consolidated host buffer chunk-wise, each chunk onto
         its round-robin device (single-device: plain uploads).  The one
         host->device chokepoint for plane traffic, so the flight
-        recorder meters its wall time + bytes as the `upload` stage."""
+        recorder meters its wall time + bytes as the `upload` stage —
+        and a supervised call (device_health): a wedged upload abandons
+        at the hard deadline instead of hanging the query."""
         t0 = time.perf_counter()
         if len(self.devices) <= 1:
-            out = [jnp.asarray(buf[a:b]) for a, b in bounds]
+            out = device_health.supervised_call(
+                "upload",
+                lambda: [jnp.asarray(buf[a:b]) for a, b in bounds],
+                devices=(0,),
+            )
         else:
-            out = [
-                jax.device_put(buf[a:b], self.chunk_device(i, region_id))
+            # placement decided on the caller thread (it reads config /
+            # supervisor state); only the raw uploads ride the worker
+            placed = [
+                (self.chunk_device(i, region_id), a, b)
                 for i, (a, b) in enumerate(bounds)
             ]
+            dev_index = {id(d): i for i, d in enumerate(self.devices)}
+            involved = tuple(sorted({
+                dev_index[id(d)] for d, _, _ in placed if id(d) in dev_index
+            })) or (0,)
+            out = device_health.supervised_call(
+                "upload",
+                lambda: [
+                    jax.device_put(buf[a:b], d) for d, a, b in placed
+                ],
+                devices=involved,
+            )
         flight_recorder.stage_add(
             "upload", (time.perf_counter() - t0) * 1000.0
         )
@@ -3729,6 +3852,16 @@ class TileExecutor:
     # -- public entry --------------------------------------------------------
     def execute(self, lowering, schema, time_bounds, ctx: TileContext):
         t0 = time.perf_counter()
+        # device-health reaction point: drop device planes when a
+        # quarantine/heal moved the generation, and bail to the scan path
+        # outright when NO device is currently serving — the supervised
+        # call layer would only fail-fast the dispatch anyway, and the
+        # scan path answers from host memory
+        self.cache.health_sync()
+        sup = device_health.SUPERVISOR
+        if sup.enabled and sup.all_quarantined(len(self.cache.devices)):
+            flight_recorder.flag_next("device_all_quarantined")
+            return None
         fp = None
         bc = self.cache.batch_config
         batching = (
@@ -5100,7 +5233,10 @@ class TileExecutor:
                     ):
                         t_disp = time.perf_counter()
                         with rtt_sim.round_trip(enabled=not _in_fused_build()):
-                            packed = program(tuple(device_sources), dyn)
+                            packed = device_health.supervised_call(
+                                "dispatch",
+                                lambda: program(tuple(device_sources), dyn),
+                            )
                         flight_recorder.stage_add(
                             "dispatch",
                             (time.perf_counter() - t_disp) * 1000.0,
@@ -5141,7 +5277,10 @@ class TileExecutor:
                 ):
                     t_disp = time.perf_counter()
                     with rtt_sim.round_trip(enabled=not _in_fused_build()):
-                        packed = program(tuple(device_sources), dyn)
+                        packed = device_health.supervised_call(
+                            "dispatch",
+                            lambda: program(tuple(device_sources), dyn),
+                        )
                     flight_recorder.stage_add(
                         "dispatch", (time.perf_counter() - t_disp) * 1000.0
                     )
@@ -5525,9 +5664,17 @@ class TileExecutor:
                 shard_axis=REGION_AXIS,
             ):
                 t_disp = time.perf_counter()
-                packed = _mesh_run(
-                    attempt_plan, nullable_cols, mesh, device_sources,
-                    pdyn, hv, program,
+                # supervised with the mesh's device slots as the blast
+                # radius; shape-ineligibility is a benign verdict, not a
+                # device error, so it never feeds the breaker
+                packed = device_health.supervised_call(
+                    "mesh",
+                    lambda: _mesh_run(
+                        attempt_plan, nullable_cols, mesh, device_sources,
+                        pdyn, hv, program,
+                    ),
+                    devices=tuple(range(mesh_n)),
+                    countable=lambda e: not isinstance(e, _MeshIneligible),
                 )
                 flight_recorder.stage_add(
                     "dispatch", (time.perf_counter() - t_disp) * 1000.0
@@ -6981,7 +7128,10 @@ class TileExecutor:
         )
         if streamed:
             with rtt_sim.round_trip(enabled=not _in_fused_build()):
-                out = streamed_device_get(list(packed), chunk)
+                out = device_health.supervised_call(
+                    "readback",
+                    lambda: streamed_device_get(list(packed), chunk),
+                )
             metrics.TPU_READBACK_STREAMED.inc()
             passes.note(
                 "streamed_readback", True,
@@ -6991,7 +7141,9 @@ class TileExecutor:
             )
             return tuple(np.asarray(p) for p in out)
         with rtt_sim.round_trip(enabled=not _in_fused_build()):
-            got = jax.device_get(packed)
+            got = device_health.supervised_call(
+                "readback", lambda: jax.device_get(packed)
+            )
         return tuple(np.asarray(p) for p in got)
 
     def _finalize(
@@ -7109,22 +7261,30 @@ class TileExecutor:
             # there).  pdyn/hv stay host-side so their weak-typing
             # matches the solo run_all trace exactly.
             dev0 = self.cache.devices[0]
-            inputs = [
-                (jax.device_put(sources, dev0), pdyn, hv)
-                for sources, pdyn, hv in inputs
-            ]
+            inputs = device_health.supervised_call(
+                "upload",
+                lambda: [
+                    (jax.device_put(sources, dev0), pdyn, hv)
+                    for sources, pdyn, hv in inputs
+                ],
+                devices=(0,),
+            )
         traces0 = _MEGA_STATS["traces"]
         metrics.TPU_DEVICE_DISPATCHES.inc()
         with tracing.span("tile.fused_dispatch", members=len(cds)):
             t_disp = time.perf_counter()
             with rtt_sim.round_trip():
-                packed_all = fused(tuple(inputs))
+                packed_all = device_health.supervised_call(
+                    "dispatch", lambda: fused(tuple(inputs))
+                )
             dispatch_ms = (time.perf_counter() - t_disp) * 1000.0
         leaves = [a for packed in packed_all for a in packed]
         t_rb = time.perf_counter()
         with tracing.span("tile.batch_readback", members=len(cds)):
             with rtt_sim.round_trip():
-                fetched = jax.device_get(leaves)
+                fetched = device_health.supervised_call(
+                    "readback", lambda: jax.device_get(leaves)
+                )
         transfer_ms = (time.perf_counter() - t_rb) * 1000.0
         tables = [None] * len(cds)
         off = 0

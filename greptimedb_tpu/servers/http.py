@@ -288,8 +288,8 @@ class _Handler(BaseHTTPRequestHandler):
         the flight recorder's newest dispatch records, the tile cache's
         per-region residency summary, and per-device HBM accounting —
         the same data information_schema.{device_dispatches,
-        tile_cache_entries, device_memory} serves over SQL, as one JSON
-        document for curl-level debugging.  `?n=` bounds the dispatch
+        tile_cache_entries, device_memory, device_health} serves over SQL,
+        as one JSON document for curl-level debugging.  `?n=` bounds the dispatch
         tail (default 50); `?table=` filters it."""
         from ..utils.flight_recorder import RECORDER
 
@@ -309,6 +309,9 @@ class _Handler(BaseHTTPRequestHandler):
             for e in cache.introspect_entries():
                 entries.append({k: v for k, v in e.items() if k != "planes"})
             memory = cache.device_memory_rows()
+        from ..utils import device_health
+
+        sup = device_health.SUPERVISOR
         return self._send(200, {
             "recorder": {
                 "enabled": RECORDER.enabled,
@@ -326,6 +329,12 @@ class _Handler(BaseHTTPRequestHandler):
             ),
             "entries": entries,
             "memory": memory,
+            "device_health": {
+                **sup.digest(),
+                "devices": sup.health_rows(
+                    cache.devices if cache is not None else None
+                ),
+            },
         })
 
     def _handle_jaeger(self, endpoint: str, params):

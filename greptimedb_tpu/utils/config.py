@@ -768,6 +768,32 @@ class RemoteConfig:
 
 
 @dataclasses.dataclass
+class DeviceConfig:
+    """Device health supervisor (utils/device_health.py): every blocking
+    device interaction (upload, compile+dispatch, readback, memory_stats
+    probe, mesh collective) runs on a dedicated per-device worker thread
+    under a hard deadline; a call that neither returns nor raises is
+    abandoned (worker thread written off — a wedged native call cannot be
+    cancelled), the device quarantines, and the query degrades down the
+    existing ladder instead of hanging.  `supervised = false` restores
+    direct in-thread calls bit-for-bit."""
+
+    supervised: bool = True
+    # Hard per-call deadline in seconds; each supervised call is further
+    # clamped to the statement's remaining deadline budget.
+    call_timeout_s: float = 30.0
+    # Consecutive raised device errors (not HBM RESOURCE_EXHAUSTED — the
+    # halve-and-retry ladder owns those) before a SUSPECT device
+    # quarantines, breaker-style.
+    error_threshold: int = 3
+    # Heal prober: a QUARANTINED device re-admits only after this many
+    # consecutive ghost dispatches complete within call_timeout_s.
+    probe_successes: int = 3
+    # Seconds between heal-probe rounds.
+    probe_interval_s: float = 1.0
+
+
+@dataclasses.dataclass
 class Config:
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
     query: QueryConfig = dataclasses.field(default_factory=QueryConfig)
@@ -789,6 +815,7 @@ class Config:
     recorder: RecorderConfig = dataclasses.field(default_factory=RecorderConfig)
     balance: BalanceConfig = dataclasses.field(default_factory=BalanceConfig)
     remote: RemoteConfig = dataclasses.field(default_factory=RemoteConfig)
+    device: DeviceConfig = dataclasses.field(default_factory=DeviceConfig)
 
     def __post_init__(self):
         self.storage.__post_init__()
@@ -856,6 +883,35 @@ class Config:
         config mistakes, not modes."""
         from .errors import ConfigError
 
+        dv = self.device
+        if not isinstance(dv.supervised, bool):
+            raise ConfigError(
+                "device.supervised must be a boolean (per-device worker-"
+                f"thread call supervision); got {dv.supervised!r}"
+            )
+        if dv.call_timeout_s <= 0:
+            raise ConfigError(
+                "device.call_timeout_s must be > 0 seconds (the hard "
+                "deadline every supervised device call is abandoned at); "
+                f"got {dv.call_timeout_s!r}"
+            )
+        if dv.error_threshold < 1:
+            raise ConfigError(
+                "device.error_threshold must be >= 1 consecutive raised "
+                "device errors before quarantine; got "
+                f"{dv.error_threshold!r}"
+            )
+        if dv.probe_successes < 1:
+            raise ConfigError(
+                "device.probe_successes must be >= 1 consecutive in-"
+                "deadline heal probes before re-admission; got "
+                f"{dv.probe_successes!r}"
+            )
+        if dv.probe_interval_s <= 0:
+            raise ConfigError(
+                "device.probe_interval_s must be > 0 seconds between "
+                f"heal-probe rounds; got {dv.probe_interval_s!r}"
+            )
         q, b, t, r = self.query, self.breaker, self.tile, self.replica
         if r.sync_interval_ms < 0:
             raise ConfigError(

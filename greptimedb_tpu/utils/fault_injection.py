@@ -144,6 +144,22 @@ Named points wired into the codebase:
                        callback that conn.raw_send()s a prefix of
                        ctx["data"] then raises to put a torn frame on the
                        wire
+    device.wedge       device health supervisor (utils/device_health.py),
+                       fired INSIDE the per-device worker thread
+                       immediately before every supervised device call
+                       (ctx: kind = upload | dispatch | readback | mesh |
+                       memory_stats | probe, device).  Arm a callback
+                       that blocks on a test-controlled Event to wedge
+                       the worker exactly like stuck native code: the
+                       supervising thread abandons the call at its hard
+                       deadline, quarantines the device, and the query
+                       degrades down the existing ladder — zero failed
+                       queries, the worker thread written off
+    device.error       same spot, for the raised-error path: arm an
+                       error to drive the breaker-style SUSPECT ->
+                       QUARANTINED transition (error_threshold
+                       consecutive raised device errors) without any
+                       wedge
 
 Production overhead is near zero: `fire()` is a module-level function whose
 fast path is one read of a module global (`_ARMED`) — no locks, no dict
@@ -220,6 +236,10 @@ POINTS = frozenset(
         "socket.connect",
         "socket.send",
         "socket.recv",
+        # device health supervisor: in-worker wedge (never-returns via a
+        # test-controlled Event) + raised-error storm
+        "device.wedge",
+        "device.error",
     }
 )
 

@@ -744,3 +744,50 @@ OTLP_SELF_EXPORT_FAILURES = REGISTRY.counter(
     "OTLP self-export batches dropped after the wire layer gave up "
     "(export is best-effort: a full buffer never blocks the hot path)",
 )
+
+# Device health supervisor (utils/device_health.py): bounded device calls,
+# wedge detection, quarantine + heal behind
+# information_schema.device_health.
+DEVICE_HEALTH_TRANSITIONS = REGISTRY.counter(
+    "greptime_device_health_transitions_total",
+    "Device health state-machine transitions (labels: to = HEALTHY | "
+    "SUSPECT | QUARANTINED | PROBING)",
+)
+DEVICE_HEALTH_STATE = REGISTRY.gauge(
+    "greptime_device_health_state",
+    "Current per-device health state (labels: device; 0 healthy, "
+    "1 suspect, 2 quarantined, 3 probing)",
+)
+DEVICE_HEALTH_ABANDONED = REGISTRY.counter(
+    "greptime_device_health_abandoned_calls_total",
+    "Supervised device calls abandoned at their hard deadline — the "
+    "future detached and the worker thread written off, since a wedged "
+    "native call cannot be cancelled (labels: kind = upload | dispatch | "
+    "readback | mesh | memory_stats | probe)",
+)
+DEVICE_HEALTH_QUARANTINES = REGISTRY.counter(
+    "greptime_device_health_quarantines_total",
+    "Devices quarantined (abandoned call, or error_threshold consecutive "
+    "raised device errors)",
+)
+DEVICE_HEALTH_HEALS = REGISTRY.counter(
+    "greptime_device_health_heals_total",
+    "Quarantined devices re-admitted after probe_successes consecutive "
+    "in-deadline ghost dispatches",
+)
+DEVICE_HEALTH_PROBES = REGISTRY.counter(
+    "greptime_device_health_probes_total",
+    "Heal-prober ghost dispatches against quarantined devices "
+    "(labels: result = ok | fail)",
+)
+DEVICE_WORKER_REFILLS = REGISTRY.counter(
+    "greptime_device_worker_refills_total",
+    "Replacement device-call worker threads spawned after an abandonment "
+    "wrote the previous worker off (the supervisor's bounded thread leak)",
+)
+TILE_HEALTH_INVALIDATIONS = REGISTRY.counter(
+    "greptime_tile_health_invalidations_total",
+    "Tile-cache device-plane drops triggered by a device-health "
+    "generation change (quarantine or heal): entries rebuild on the "
+    "surviving device set",
+)

@@ -1,0 +1,21 @@
+-- information_schema.device_health golden (PR 20): the device health
+-- supervisor's live per-device state machine (utils/device_health.py).
+-- Schema is a stable contract (README "Device health").  On a fresh
+-- database with supervision on and no faults injected every device is
+-- HEALTHY with zeroed counters; the `device = 0` filter keeps the
+-- golden device-count independent, and excluding the wall-clock
+-- `last_probe_ms` and backend-specific `device_kind` keeps it
+-- byte-identical on the cpu AND tpu backends.
+
+SELECT device, state, consecutive_failures, abandoned_calls, quarantines, heals, quarantine_age_ms, last_error FROM information_schema.device_health WHERE device = 0;
+
+SELECT count(*) > 0 AS has_devices FROM information_schema.device_health;
+
+-- schema pinned column-by-column (DESC on information_schema works
+-- like the reference's)
+
+USE information_schema;
+
+DESCRIBE device_health;
+
+USE public;

@@ -209,6 +209,57 @@ def _metrics_name_gate():
     )
 
 
+# ---- abandoned device-worker thread gate -----------------------------------
+# The device supervisor (utils/device_health.py) writes off a worker thread
+# when its call wedges past the hard deadline — that is the designed bounded
+# leak, but ONLY tests that deliberately wedge a device (@pytest.mark.wedge)
+# may create one, and those tests must release their wedge Events so the
+# orphan exits.  Anything else alive at session end is a real thread leak.
+_wedge_attributed: set = set()
+
+
+@pytest.fixture(autouse=True)
+def _wedge_thread_attribution(request):
+    from greptimedb_tpu.utils import device_health
+
+    sup = device_health.SUPERVISOR
+    before = {id(t) for t in sup.abandoned_worker_threads()}
+    yield
+    new = [t for t in sup.abandoned_worker_threads() if id(t) not in before]
+    if not new:
+        return
+    if request.node.get_closest_marker("wedge") is None:
+        pytest.fail(
+            "test abandoned device-worker thread(s) "
+            f"{[t.name for t in new]} without @pytest.mark.wedge — either "
+            "mark the test `wedge` (and release the wedge at teardown) or "
+            "stop wedging the supervisor"
+        )
+    _wedge_attributed.update(id(t) for t in new)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _device_worker_leak_gate():
+    """No abandoned device-worker thread may still be ALIVE at session end
+    unless a `wedge`-marked test created it (and even those are expected to
+    release their wedge Events — a brief grace join absorbs the exit race).
+    Twin of the README gates above: the supervisor's thread leak is bounded
+    by design, and this keeps 'bounded' honest suite-wide."""
+    yield
+    from greptimedb_tpu.utils import device_health
+
+    leaked = []
+    for t in device_health.SUPERVISOR.abandoned_worker_threads():
+        if t.is_alive():
+            t.join(timeout=2.0)
+        if t.is_alive() and id(t) not in _wedge_attributed:
+            leaked.append(t.name)
+    assert not leaked, (
+        f"abandoned device-worker thread(s) still alive at session end "
+        f"and not attributed to any @pytest.mark.wedge test: {leaked}"
+    )
+
+
 @pytest.fixture()
 def tmp_engine(tmp_path):
     from greptimedb_tpu.storage.engine import TimeSeriesEngine

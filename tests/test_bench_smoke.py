@@ -650,6 +650,12 @@ def test_compact_record_mixed_sweep_worstcase_clamps():
         "admission": {"admitted": 104_857_600.0, "shed": 99_999},
         "hbm": {"probe_free_bytes": 103_680_000_000, "exhausted": 99_999.0,
                 "chunk_rows": 16_777_216},
+        "device_health": {
+            "supervised": True, "wedged": True, "wedge_wall_ms": 123456.7,
+            "quarantines": 8, "healed": True, "post_heal_ok": True,
+            "zero_failed_queries": True, "abandoned_calls": 8, "heals": 8,
+            "states": {f"QUARANTINED_{i}": "QUARANTINED" for i in range(8)},
+        },
         "zero_failed_queries": True, "p50_ms": 104857.4,
     }
     record = bench._clamp_record({
@@ -674,6 +680,13 @@ def test_compact_record_mixed_sweep_worstcase_clamps():
     assert "curve" not in d["qps_sweep"]["on"]
     assert "phases" not in d["hotspot"]
     assert len(d["errors"]) <= 2 and all(len(e) <= 40 for e in d["errors"])
+    # the device-health digest survives clamping with its verdict scalars
+    # (nested per-state maps are the convenience spent)
+    dvh = d["device_health"]
+    assert dvh["wedged"] is True and dvh["healed"] is True
+    assert dvh["quarantines"] == 8
+    assert dvh["zero_failed_queries"] is True
+    assert "states" not in dvh
 
 
 def test_recorder_overhead_within_noise(tmp_path):
